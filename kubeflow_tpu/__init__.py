@@ -1,0 +1,23 @@
+"""kubeflow_tpu — a TPU-native ML orchestration + training framework.
+
+A ground-up rebuild of the capabilities of the Kubeflow platform
+(training-operator, Katib, Pipelines, KServe, central components), designed
+TPU-first: jobs rendezvous via `jax.distributed`, compute runs as pjit/shard_map
+SPMD programs over `jax.sharding.Mesh` axes, hot kernels are Pallas, and the
+control plane is a native (C++) reconciler core with Python policy on top.
+
+Layer map (mirrors SURVEY.md §1):
+  api/        CRD-equivalent typed specs (JAXJob, Experiment, InferenceService, ...)
+  controller/ reconcilers, gang scheduling, env-contract injection
+  runtime/    process launch: local runner, multi-process gang, rendezvous registry
+  parallel/   mesh builder, shardings (dp/fsdp/tp/pp/sp/ep), pipeline loop
+  ops/        pallas kernels (ring attention, fused ops)
+  models/     in-tree model library (MNIST MLP, ResNet-50, BERT)
+  train/      trainer loop, orbax checkpointing, metrics emission
+  sweep/      hyperparameter search engine (Katib parity)
+  serving/    model server + InferenceService controller (KServe parity)
+  pipelines/  DSL -> IR compiler + runner (KFP parity)
+  metadata/   lineage/metadata store, C++-backed (MLMD parity)
+"""
+
+__version__ = "0.1.0"

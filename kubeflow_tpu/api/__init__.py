@@ -1,0 +1,68 @@
+"""Typed spec layer — the CRD-equivalent API surface.
+
+Reference parity: training-operator pkg/apis/kubeflow.org/v1 (common_types.go,
+tfjob_types.go, pytorchjob_types.go, mpijob_types.go, ...) — unverified cites,
+see SURVEY.md §0. Specs are plain dataclasses with dict/YAML round-trip and a
+validation pass equivalent to the reference's admission webhooks.
+"""
+
+from kubeflow_tpu.api.common import (
+    CleanPodPolicy,
+    ElasticPolicy,
+    JobCondition,
+    JobConditionType,
+    JobStatus,
+    ObjectMeta,
+    ReplicaSpec,
+    ReplicaStatus,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    ContainerSpec,
+    PodTemplateSpec,
+)
+from kubeflow_tpu.api.jobs import (
+    JAXJob,
+    JAXJobSpec,
+    JobKind,
+    MPIJob,
+    PyTorchJob,
+    TFJob,
+    TrainJob,
+    REPLICA_WORKER,
+    REPLICA_CHIEF,
+    REPLICA_PS,
+    REPLICA_MASTER,
+    REPLICA_LAUNCHER,
+)
+from kubeflow_tpu.api.validation import ValidationError, validate_job
+
+__all__ = [
+    "CleanPodPolicy",
+    "ContainerSpec",
+    "ElasticPolicy",
+    "JAXJob",
+    "JAXJobSpec",
+    "JobCondition",
+    "JobConditionType",
+    "JobKind",
+    "JobStatus",
+    "MPIJob",
+    "ObjectMeta",
+    "PodTemplateSpec",
+    "PyTorchJob",
+    "ReplicaSpec",
+    "ReplicaStatus",
+    "RestartPolicy",
+    "RunPolicy",
+    "SchedulingPolicy",
+    "TFJob",
+    "TrainJob",
+    "ValidationError",
+    "validate_job",
+    "REPLICA_WORKER",
+    "REPLICA_CHIEF",
+    "REPLICA_PS",
+    "REPLICA_MASTER",
+    "REPLICA_LAUNCHER",
+]

@@ -1,0 +1,229 @@
+"""Common job-spec types shared by every job kind.
+
+Reference parity: training-operator pkg/apis/kubeflow.org/v1/common_types.go
+(ReplicaSpec, RunPolicy, JobCondition, JobStatus, ReplicaStatus — unverified,
+SURVEY.md §2.1). Field names follow the CRD's camelCase in serialized form and
+snake_case in Python.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import enum
+from dataclasses import dataclass, field
+from typing import Any
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+class RestartPolicy(str, enum.Enum):
+    """Per-replica restart policy (common_types.go RestartPolicy).
+
+    EXIT_CODE: retry only on retryable exit codes (1-127 => permanent failure,
+    128+ => retryable), mirroring the reference's ExitCode semantics.
+    """
+
+    NEVER = "Never"
+    ON_FAILURE = "OnFailure"
+    ALWAYS = "Always"
+    EXIT_CODE = "ExitCode"
+
+
+class CleanPodPolicy(str, enum.Enum):
+    """What to do with replica processes when the job finishes."""
+
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+class JobConditionType(str, enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    SUSPENDED = "Suspended"
+
+
+# Exit codes 128+ (signals, OOM-kill analogues) are retryable under the
+# ExitCode restart policy; 1-127 are permanent. Matches the reference's
+# convention for RestartPolicyExitCode.
+RETRYABLE_EXIT_CODE_MIN = 128
+
+
+def is_retryable_exit_code(code: int) -> bool:
+    return code >= RETRYABLE_EXIT_CODE_MIN
+
+
+@dataclass
+class ObjectMeta:
+    """Minimal object metadata (k8s ObjectMeta analogue)."""
+
+    name: str = ""
+    namespace: str = "default"
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    uid: str = ""
+    # Set by the object store on admission (k8s semantics); empty until then so
+    # spec serialization stays deterministic for golden-file tests.
+    creation_timestamp: str = ""
+
+
+@dataclass
+class ContainerSpec:
+    """The command a replica runs. A pod-container analogue: in this runtime a
+    'container' is an OS process (the fake-cluster maps image -> interpreter).
+    """
+
+    image: str = "python"
+    command: list[str] = field(default_factory=list)
+    args: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    working_dir: str = ""
+    # Resource requests; the TPU resource key mirrors GKE's `google.com/tpu`.
+    resources: dict[str, Any] = field(default_factory=dict)
+    ports: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class PodTemplateSpec:
+    """Template for the worker process ('pod') of one replica."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    container: ContainerSpec = field(default_factory=ContainerSpec)
+    # Scheduler hint, e.g. "gang" (volcano analogue) or "default".
+    scheduler_name: str = "gang"
+    node_selector: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ReplicaSpec:
+    """One replica group (worker/ps/chief/master/launcher)."""
+
+    replicas: int = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: RestartPolicy = RestartPolicy.ON_FAILURE
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs (RunPolicy.SchedulingPolicy in the reference)."""
+
+    min_available: int | None = None
+    queue: str = "default"
+    priority_class: str = ""
+    # TPU slice topology the gang must land on, e.g. "2x4" (v5e-8).
+    # The slice is the atomic scheduling unit on TPU (SURVEY.md §2.2).
+    slice_topology: str = ""
+
+
+@dataclass
+class ElasticPolicy:
+    """Elastic scaling policy (pytorchjob ElasticPolicy analogue).
+
+    On TPU, elasticity is slice-granular: scale by whole slices, and every
+    scale event is a re-mesh (coordinator restart + jax.distributed re-init).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    max_restarts: int = 3
+    # Rendezvous backend: "jax" (jax.distributed coordination service) for
+    # JAXJob; PyTorchJob honors this verbatim in PET_RDZV_BACKEND (c10d/etcd).
+    rdzv_backend: str = "jax"
+    nproc_per_node: int = 1
+
+
+@dataclass
+class RunPolicy:
+    """Job-level execution policy (common_types.go RunPolicy)."""
+
+    clean_pod_policy: CleanPodPolicy = CleanPodPolicy.RUNNING
+    ttl_seconds_after_finished: int | None = None
+    active_deadline_seconds: int | None = None
+    backoff_limit: int = 3
+    scheduling_policy: SchedulingPolicy | None = None
+    suspend: bool = False
+    elastic_policy: ElasticPolicy | None = None
+
+
+@dataclass
+class JobCondition:
+    type: JobConditionType = JobConditionType.CREATED
+    status: bool = True
+    reason: str = ""
+    message: str = ""
+    last_transition_time: str = field(default_factory=_utcnow)
+
+
+@dataclass
+class ReplicaStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    # label selector string for this replica group's pods, as the reference
+    # surfaces in ReplicaStatus.Selector
+    selector: str = ""
+
+
+@dataclass
+class JobStatus:
+    conditions: list[JobCondition] = field(default_factory=list)
+    replica_statuses: dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: str | None = None
+    completion_time: str | None = None
+    last_reconcile_time: str | None = None
+    restart_count: int = 0
+
+    # -- condition helpers (pkg/util/status.go analogues) --
+
+    def condition(self, ctype: JobConditionType) -> JobCondition | None:
+        for c in self.conditions:
+            if c.type == ctype:
+                return c
+        return None
+
+    def has_condition(self, ctype: JobConditionType) -> bool:
+        c = self.condition(ctype)
+        return c is not None and c.status
+
+    def set_condition(
+        self, ctype: JobConditionType, reason: str = "", message: str = ""
+    ) -> None:
+        """Append/refresh a condition. Running/Restarting/terminal conditions are
+        mutually exclusive, mirroring the reference's updateJobConditions."""
+        new = JobCondition(type=ctype, status=True, reason=reason, message=message)
+        exclusive = {
+            JobConditionType.RUNNING,
+            JobConditionType.RESTARTING,
+            JobConditionType.SUCCEEDED,
+            JobConditionType.FAILED,
+            JobConditionType.SUSPENDED,
+        }
+        out: list[JobCondition] = []
+        for c in self.conditions:
+            if c.type == ctype:
+                continue
+            if ctype in exclusive and c.type in exclusive:
+                c = dataclasses.replace(c, status=False)
+            out.append(c)
+        out.append(new)
+        self.conditions = out
+
+    @property
+    def is_finished(self) -> bool:
+        return self.has_condition(JobConditionType.SUCCEEDED) or self.has_condition(
+            JobConditionType.FAILED
+        )
+
+    @property
+    def is_succeeded(self) -> bool:
+        return self.has_condition(JobConditionType.SUCCEEDED)
+
+    @property
+    def is_failed(self) -> bool:
+        return self.has_condition(JobConditionType.FAILED)

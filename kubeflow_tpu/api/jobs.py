@@ -1,0 +1,162 @@
+"""Job kinds — the TrainJob family.
+
+Reference parity: training-operator pkg/apis/kubeflow.org/v1/{tfjob_types.go,
+pytorchjob_types.go, mpijob_types.go} (unverified, SURVEY.md §2.1).
+
+The flagship kind is JAXJob: a gang of identical SPMD worker processes that
+rendezvous through `jax.distributed.initialize`. TFJob/PyTorchJob/MPIJob specs
+are kept for migration parity — their env contracts are synthesized exactly
+(controller/envcontract.py), so a user moving off the reference finds the same
+knobs, but the recommended path is JAXJob.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.common import (
+    JobStatus,
+    ObjectMeta,
+    ReplicaSpec,
+    RunPolicy,
+)
+
+# Canonical replica type names (label values under
+# training.kubeflow.org/replica-type in the reference).
+REPLICA_WORKER = "worker"
+REPLICA_CHIEF = "chief"
+REPLICA_PS = "ps"
+REPLICA_MASTER = "master"
+REPLICA_LAUNCHER = "launcher"
+REPLICA_EVALUATOR = "evaluator"
+
+
+class JobKind(str, enum.Enum):
+    JAX = "JAXJob"
+    TF = "TFJob"
+    PYTORCH = "PyTorchJob"
+    MPI = "MPIJob"
+    XGBOOST = "XGBoostJob"
+    PADDLE = "PaddleJob"
+
+
+# Default rendezvous ports, matching the reference's per-framework defaults.
+DEFAULT_PORTS = {
+    JobKind.JAX: 1234,       # jax.distributed coordinator
+    JobKind.TF: 2222,        # tfjob default port
+    JobKind.PYTORCH: 23456,  # MASTER_PORT default in pytorch envvar.go
+    JobKind.MPI: 22,
+    JobKind.XGBOOST: 9991,
+    JobKind.PADDLE: 36543,
+}
+
+# Which replica type's completion decides job success, per kind
+# (tfjob: chief, else worker-0 / master / launcher).
+SUCCESS_REPLICA = {
+    JobKind.JAX: REPLICA_WORKER,
+    JobKind.TF: REPLICA_CHIEF,      # falls back to worker if no chief
+    JobKind.PYTORCH: REPLICA_MASTER,
+    JobKind.MPI: REPLICA_LAUNCHER,
+    JobKind.XGBOOST: REPLICA_MASTER,
+    JobKind.PADDLE: REPLICA_MASTER,
+}
+
+
+@dataclass
+class JAXJobSpec:
+    replica_specs: dict[str, ReplicaSpec] = field(default_factory=dict)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    # Port the worker-0 coordination service listens on.
+    coordinator_port: int = DEFAULT_PORTS[JobKind.JAX]
+    # Number of slices for multislice (DCN/megascale) jobs; 1 = single slice.
+    num_slices: int = 1
+
+
+@dataclass
+class TrainJob:
+    """Base class for every training job kind."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JAXJobSpec = field(default_factory=JAXJobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    kind: JobKind = JobKind.JAX
+    api_version: str = "kubeflow-tpu.org/v1"
+
+    # -- naming conventions (pkg/core/pod.go GenGeneralName analogues) --
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def replica_name(self, rtype: str, index: int) -> str:
+        return f"{self.metadata.name}-{rtype}-{index}"
+
+    def replica_hostname(self, rtype: str, index: int) -> str:
+        """Stable DNS-style name for a replica — the headless-Service contract.
+        In the fake cluster this resolves via the rendezvous registry."""
+        return f"{self.replica_name(rtype, index)}.{self.metadata.name}.{self.metadata.namespace}"
+
+    def total_replicas(self) -> int:
+        return sum(rs.replicas for rs in self.spec.replica_specs.values())
+
+    def labels(self, rtype: str | None = None, index: int | None = None) -> dict[str, str]:
+        """Label conventions, mirroring training.kubeflow.org/* labels."""
+        out = {
+            "kubeflow-tpu.org/job-name": self.metadata.name,
+            "kubeflow-tpu.org/job-kind": self.kind.value,
+        }
+        if rtype is not None:
+            out["kubeflow-tpu.org/replica-type"] = rtype
+        if index is not None:
+            out["kubeflow-tpu.org/replica-index"] = str(index)
+        return out
+
+
+@dataclass
+class JAXJob(TrainJob):
+    kind: JobKind = JobKind.JAX
+
+
+@dataclass
+class TFJob(TrainJob):
+    kind: JobKind = JobKind.TF
+
+
+@dataclass
+class PyTorchJob(TrainJob):
+    kind: JobKind = JobKind.PYTORCH
+
+
+@dataclass
+class MPIJob(TrainJob):
+    kind: JobKind = JobKind.MPI
+
+
+@dataclass
+class XGBoostJob(TrainJob):
+    kind: JobKind = JobKind.XGBOOST
+
+
+@dataclass
+class PaddleJob(TrainJob):
+    kind: JobKind = JobKind.PADDLE
+
+
+_KIND_TO_CLS = {
+    JobKind.JAX: JAXJob,
+    JobKind.TF: TFJob,
+    JobKind.PYTORCH: PyTorchJob,
+    JobKind.MPI: MPIJob,
+    JobKind.XGBOOST: XGBoostJob,
+    JobKind.PADDLE: PaddleJob,
+}
+
+
+def job_class_for_kind(kind: JobKind | str) -> type[TrainJob]:
+    return _KIND_TO_CLS[JobKind(kind)]

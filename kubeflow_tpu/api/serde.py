@@ -1,0 +1,110 @@
+"""Dict/YAML round-trip for spec dataclasses.
+
+Serialized form uses camelCase keys + kind/apiVersion envelope so manifests
+look like the reference's CR YAML (samples/ fixtures double as docs + tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import types
+import typing
+from typing import Any, get_args, get_origin, get_type_hints
+
+import yaml
+
+from kubeflow_tpu.api.jobs import JobKind, TrainJob, job_class_for_kind
+
+
+def _camel(s: str) -> str:
+    head, *rest = s.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+def _snake(s: str) -> str:
+    out = []
+    for ch in s:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def to_dict(obj: Any) -> Any:
+    """Dataclass -> plain dict with camelCase keys; drops empty/None fields."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = to_dict(getattr(obj, f.name))
+            if v is None or v == {} or v == [] or v == "":
+                continue
+            out[_camel(f.name)] = v
+        return out
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def _from_dict(cls: type, data: Any) -> Any:
+    if data is None:
+        return None
+    origin = get_origin(cls)
+    if origin is typing.Union or origin is types.UnionType:
+        args = [a for a in get_args(cls) if a is not type(None)]
+        if not args:
+            return data
+        return _from_dict(args[0], data)
+    if dataclasses.is_dataclass(cls):
+        hints = get_type_hints(cls)
+        kwargs = {}
+        by_camel = {_camel(f.name): f.name for f in dataclasses.fields(cls)}
+        for key, val in (data or {}).items():
+            fname = by_camel.get(key, _snake(key))
+            if fname not in hints:
+                continue  # forward-compat: ignore unknown fields like the apiserver
+            kwargs[fname] = _from_dict(hints[fname], val)
+        return cls(**kwargs)
+    if origin is dict:
+        _, vt = get_args(cls)
+        return {k: _from_dict(vt, v) for k, v in (data or {}).items()}
+    if origin in (list, tuple):
+        (vt,) = get_args(cls) or (Any,)
+        return [_from_dict(vt, v) for v in (data or [])]
+    if isinstance(cls, type) and issubclass(cls, enum.Enum):
+        return cls(data)
+    return data
+
+
+def job_to_dict(job: TrainJob) -> dict:
+    d = to_dict(job)
+    d.pop("kind", None)
+    d.pop("apiVersion", None)
+    # A never-reconciled status serializes to noise ({restartCount: 0}); drop it
+    # so spec manifests are deterministic golden files.
+    if not job.status.conditions and job.status.start_time is None:
+        d.pop("status", None)
+    return {"apiVersion": job.api_version, "kind": job.kind.value, **d}
+
+
+def job_to_yaml(job: TrainJob) -> str:
+    return yaml.safe_dump(job_to_dict(job), sort_keys=False)
+
+
+def job_from_dict(data: dict) -> TrainJob:
+    kind = JobKind(data["kind"])
+    cls = job_class_for_kind(kind)
+    body = {k: v for k, v in data.items() if k not in ("kind", "apiVersion")}
+    job = _from_dict(cls, body)
+    job.kind = kind
+    return job
+
+
+def job_from_yaml(text: str) -> TrainJob:
+    return job_from_dict(yaml.safe_load(text))

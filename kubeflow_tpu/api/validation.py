@@ -1,0 +1,148 @@
+"""Admission validation — the webhook analogue.
+
+Reference parity: training-operator pkg/webhooks/ validating webhooks
+(replica sanity, port presence, elastic bounds — unverified, SURVEY.md §2.1).
+Pure functions: given a job, raise ValidationError or return normalized job.
+"""
+
+from __future__ import annotations
+
+import re
+
+from kubeflow_tpu.api.common import RestartPolicy
+from kubeflow_tpu.api.jobs import (
+    JobKind,
+    REPLICA_CHIEF,
+    REPLICA_LAUNCHER,
+    REPLICA_MASTER,
+    REPLICA_PS,
+    REPLICA_WORKER,
+    REPLICA_EVALUATOR,
+    TrainJob,
+)
+
+# RFC-1123 subdomain, as kube-apiserver enforces on object names.
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+VALID_REPLICA_TYPES = {
+    JobKind.JAX: {REPLICA_WORKER},
+    JobKind.TF: {REPLICA_CHIEF, REPLICA_WORKER, REPLICA_PS, REPLICA_MASTER, REPLICA_EVALUATOR},
+    JobKind.PYTORCH: {REPLICA_MASTER, REPLICA_WORKER},
+    JobKind.MPI: {REPLICA_LAUNCHER, REPLICA_WORKER},
+    JobKind.XGBOOST: {REPLICA_MASTER, REPLICA_WORKER},
+    JobKind.PADDLE: {REPLICA_MASTER, REPLICA_WORKER},
+}
+
+# TPU slice topologies valid for v5e (chips = product; SURVEY.md §2.2: the
+# slice is the atomic gang unit).
+_TOPOLOGY_RE = re.compile(r"^\d+x\d+(x\d+)?$")
+
+
+class ValidationError(ValueError):
+    def __init__(self, field: str, message: str):
+        self.field = field
+        super().__init__(f"{field}: {message}")
+
+
+def validate_job(job: TrainJob) -> TrainJob:
+    """Validate + default a job spec in place. Raises ValidationError."""
+    if not job.metadata.name:
+        raise ValidationError("metadata.name", "name is required")
+    if not _NAME_RE.match(job.metadata.name) or len(job.metadata.name) > 63:
+        raise ValidationError(
+            "metadata.name",
+            f"{job.metadata.name!r} must be a lowercase RFC-1123 label (<=63 chars)",
+        )
+
+    if not job.spec.replica_specs:
+        raise ValidationError("spec.replicaSpecs", "at least one replica type required")
+
+    allowed = VALID_REPLICA_TYPES[job.kind]
+    for rtype, rs in job.spec.replica_specs.items():
+        if rtype not in allowed:
+            raise ValidationError(
+                f"spec.replicaSpecs[{rtype}]",
+                f"invalid replica type for {job.kind.value}; allowed: {sorted(allowed)}",
+            )
+        if rs.replicas < 0:
+            raise ValidationError(
+                f"spec.replicaSpecs[{rtype}].replicas", "must be >= 0"
+            )
+        if rs.restart_policy not in RestartPolicy:
+            raise ValidationError(
+                f"spec.replicaSpecs[{rtype}].restartPolicy", "invalid policy"
+            )
+
+    # Kind-specific topology rules (webhook parity).
+    if job.kind == JobKind.TF:
+        chief_like = sum(
+            job.spec.replica_specs.get(t, None) is not None
+            and job.spec.replica_specs[t].replicas
+            for t in (REPLICA_CHIEF, REPLICA_MASTER)
+        )
+        if chief_like > 1:
+            raise ValidationError(
+                "spec.replicaSpecs", "TFJob may have at most one chief/master replica"
+            )
+    if job.kind in (JobKind.PYTORCH, JobKind.XGBOOST, JobKind.PADDLE):
+        master = job.spec.replica_specs.get(REPLICA_MASTER)
+        if master is not None and master.replicas > 1:
+            raise ValidationError(
+                f"spec.replicaSpecs[{REPLICA_MASTER}].replicas", "must be <= 1"
+            )
+    if job.kind == JobKind.MPI:
+        launcher = job.spec.replica_specs.get(REPLICA_LAUNCHER)
+        if launcher is None or launcher.replicas != 1:
+            raise ValidationError(
+                f"spec.replicaSpecs[{REPLICA_LAUNCHER}]", "MPIJob requires exactly one launcher"
+            )
+    if job.kind == JobKind.JAX:
+        workers = job.spec.replica_specs.get(REPLICA_WORKER)
+        if workers is None or workers.replicas < 1:
+            raise ValidationError(
+                f"spec.replicaSpecs[{REPLICA_WORKER}]", "JAXJob requires >= 1 worker"
+            )
+        if not (0 < job.spec.coordinator_port < 65536):
+            raise ValidationError("spec.coordinatorPort", "must be a valid port")
+        if job.spec.num_slices < 1:
+            raise ValidationError("spec.numSlices", "must be >= 1")
+        if workers.replicas % job.spec.num_slices != 0:
+            raise ValidationError(
+                "spec.numSlices",
+                f"worker count {workers.replicas} must be divisible by "
+                f"numSlices {job.spec.num_slices} (slices are equal-sized)",
+            )
+
+    rp = job.spec.run_policy
+    if rp.backoff_limit < 0:
+        raise ValidationError("spec.runPolicy.backoffLimit", "must be >= 0")
+    if rp.ttl_seconds_after_finished is not None and rp.ttl_seconds_after_finished < 0:
+        raise ValidationError("spec.runPolicy.ttlSecondsAfterFinished", "must be >= 0")
+    if rp.active_deadline_seconds is not None and rp.active_deadline_seconds <= 0:
+        raise ValidationError("spec.runPolicy.activeDeadlineSeconds", "must be > 0")
+
+    ep = rp.elastic_policy
+    if ep is not None:
+        if ep.min_replicas < 1 or ep.max_replicas < ep.min_replicas:
+            raise ValidationError(
+                "spec.runPolicy.elasticPolicy", "need 1 <= minReplicas <= maxReplicas"
+            )
+        if ep.max_restarts < 0:
+            raise ValidationError("spec.runPolicy.elasticPolicy.maxRestarts", "must be >= 0")
+
+    sp = rp.scheduling_policy
+    if sp is not None:
+        total = job.total_replicas()
+        if sp.min_available is None:
+            sp.min_available = total  # default: full gang (PodGroup minMember = Σreplicas)
+        if sp.min_available > total:
+            raise ValidationError(
+                "spec.runPolicy.schedulingPolicy.minAvailable",
+                f"{sp.min_available} exceeds total replicas {total}",
+            )
+        if sp.slice_topology and not _TOPOLOGY_RE.match(sp.slice_topology):
+            raise ValidationError(
+                "spec.runPolicy.schedulingPolicy.sliceTopology",
+                f"{sp.slice_topology!r} is not like '2x4'",
+            )
+    return job

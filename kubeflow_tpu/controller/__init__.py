@@ -1,0 +1,7 @@
+"""Controller layer: reconcilers, gang scheduling, env injection.
+
+Reference parity: training-operator pkg/controller.v1/* (Go reconcilers over
+controller-runtime — unverified cites, SURVEY.md §2.1). Here the reconcile
+core's hot bookkeeping (work queue, expectations) is native C++
+(kubeflow_tpu/native) with Python policy on top.
+"""

@@ -1,0 +1,248 @@
+"""Rendezvous env-contract synthesis — layer L3, 'the distributed glue'.
+
+This is the moment the platform earns its keep: materializing the ~6 env vars
++ stable DNS names that let N freshly-started processes find each other.
+
+Reference parity (unverified cites, SURVEY.md §2.1/§3.1):
+  - TFJob:      pkg/controller.v1/tensorflow/tfjob_controller.go#SetClusterSpec
+                (TF_CONFIG JSON {cluster:{worker:[...],ps:[...]},task:{type,index}})
+  - PyTorchJob: pkg/controller.v1/pytorch/envvar.go#SetPodEnv
+                (MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK, elastic PET_*)
+  - MPIJob:     pkg/controller.v1/mpi (hostfile ConfigMap)
+  - XGBoost/Paddle: DMLC_* / PADDLE_* env families
+
+TPU-native contract (the flagship JAXJob): `jax.distributed.initialize` needs
+coordinator address + process count + process id; GKE TPU adds TPU_WORKER_ID,
+TPU_WORKER_HOSTNAMES, and for multislice MEGASCALE_* (SURVEY.md §3 note).
+
+Everything here is a pure function of (job, rtype, index) -> env dict, so the
+whole contract is unit-testable byte-for-byte with no cluster — the
+reference's own highest-value test pattern (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import json
+
+from kubeflow_tpu.api.jobs import (
+    DEFAULT_PORTS,
+    JobKind,
+    REPLICA_CHIEF,
+    REPLICA_LAUNCHER,
+    REPLICA_MASTER,
+    REPLICA_PS,
+    REPLICA_WORKER,
+    REPLICA_EVALUATOR,
+    TrainJob,
+)
+
+# Order TF_CONFIG cluster roles are emitted in (stable ordering matters for
+# golden tests and for ps/worker index semantics).
+_TF_ROLE_ORDER = [REPLICA_CHIEF, REPLICA_MASTER, REPLICA_WORKER, REPLICA_PS, REPLICA_EVALUATOR]
+
+
+def replica_addresses(job: TrainJob, rtype: str, port: int | None = None) -> list[str]:
+    """host:port list for one replica group — the headless-Service DNS contract."""
+    if port is None:
+        port = DEFAULT_PORTS[job.kind]
+    rs = job.spec.replica_specs.get(rtype)
+    if rs is None:
+        return []
+    return [f"{job.replica_hostname(rtype, i)}:{port}" for i in range(rs.replicas)]
+
+
+# ---------------------------------------------------------------- JAX (flagship)
+
+def jax_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
+    """Env for one JAXJob worker process.
+
+    Process 0 hosts the jax.distributed coordination service; every process
+    gets the same coordinator address + its own process id. The GKE TPU var
+    shapes (TPU_WORKER_ID/TPU_WORKER_HOSTNAMES/MEGASCALE_*) are emitted too so
+    the same synthesis would be correct on a real TPU nodepool.
+    """
+    port = job.spec.coordinator_port
+    workers = job.spec.replica_specs[REPLICA_WORKER].replicas
+    coord = f"{job.replica_hostname(REPLICA_WORKER, 0)}:{port}"
+    hostnames = ",".join(
+        job.replica_hostname(REPLICA_WORKER, i) for i in range(workers)
+    )
+    env = {
+        "JAX_COORDINATOR_ADDRESS": coord,
+        "JAX_NUM_PROCESSES": str(workers),
+        "JAX_PROCESS_ID": str(index),
+        # GKE TPU-shaped vars (jax.distributed auto-detects these on Cloud TPU)
+        "TPU_WORKER_ID": str(index),
+        "TPU_WORKER_HOSTNAMES": hostnames,
+    }
+    if job.spec.num_slices > 1:
+        # validate_job enforces workers % num_slices == 0 (equal-sized slices).
+        per_slice = workers // job.spec.num_slices
+        env["MEGASCALE_COORDINATOR_ADDRESS"] = coord
+        env["MEGASCALE_NUM_SLICES"] = str(job.spec.num_slices)
+        env["MEGASCALE_SLICE_ID"] = str(index // per_slice)
+    return env
+
+
+# ---------------------------------------------------------------------- TFJob
+
+def tf_config(job: TrainJob, rtype: str, index: int, port: int | None = None) -> str:
+    """TF_CONFIG JSON for one replica (SetClusterSpec parity)."""
+    if port is None:
+        port = DEFAULT_PORTS[JobKind.TF]
+    cluster: dict[str, list[str]] = {}
+    for role in _TF_ROLE_ORDER:
+        addrs = replica_addresses(job, role, port)
+        if addrs:
+            cluster[role] = addrs
+    payload = {
+        "cluster": cluster,
+        "task": {"type": rtype, "index": index},
+        "environment": "cloud",
+    }
+    return json.dumps(payload, separators=(",", ":"), sort_keys=False)
+
+
+def tf_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
+    return {"TF_CONFIG": tf_config(job, rtype, index)}
+
+
+# ------------------------------------------------------------------ PyTorchJob
+
+def pytorch_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
+    """MASTER_ADDR/PORT, WORLD_SIZE, RANK (+ PET_* when elastic).
+
+    Rank convention mirrors envvar.go: master is rank 0; worker i is rank i+1
+    when a master replica exists, else rank i.
+    """
+    port = DEFAULT_PORTS[JobKind.PYTORCH]
+    has_master = REPLICA_MASTER in job.spec.replica_specs
+    master_host = (
+        job.replica_hostname(REPLICA_MASTER, 0)
+        if has_master
+        else job.replica_hostname(REPLICA_WORKER, 0)
+    )
+    world = job.total_replicas()
+    if rtype == REPLICA_MASTER:
+        rank = 0
+    else:
+        rank = index + 1 if has_master else index
+
+    env = {
+        "MASTER_ADDR": master_host,
+        "MASTER_PORT": str(port),
+        "WORLD_SIZE": str(world),
+        "RANK": str(rank),
+    }
+    ep = job.spec.run_policy.elastic_policy
+    if ep is not None:
+        env.update(
+            {
+                "PET_RDZV_BACKEND": ep.rdzv_backend,
+                "PET_RDZV_ENDPOINT": f"{master_host}:{port}",
+                "PET_MIN_NNODES": str(ep.min_replicas),
+                "PET_MAX_NNODES": str(ep.max_replicas),
+                "PET_NNODES": f"{ep.min_replicas}:{ep.max_replicas}",
+                "PET_NPROC_PER_NODE": str(ep.nproc_per_node),
+                "PET_MAX_RESTARTS": str(ep.max_restarts),
+            }
+        )
+    return env
+
+
+# --------------------------------------------------------------------- MPIJob
+
+def mpi_hostfile(job: TrainJob, slots_per_worker: int = 1) -> str:
+    """Hostfile content (the ConfigMap the MPI controller mounts)."""
+    rs = job.spec.replica_specs.get(REPLICA_WORKER)
+    n = rs.replicas if rs else 0
+    return "".join(
+        f"{job.replica_hostname(REPLICA_WORKER, i)} slots={slots_per_worker}\n"
+        for i in range(n)
+    )
+
+
+def mpi_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
+    rs = job.spec.replica_specs.get(REPLICA_WORKER)
+    n = rs.replicas if rs else 0
+    env = {
+        "OMPI_MCA_orte_keep_fqdn_hostnames": "true",
+        "OMPI_MCA_orte_default_hostfile": "/etc/mpi/hostfile",
+    }
+    if rtype == REPLICA_LAUNCHER:
+        env["OMPI_MCA_orte_set_default_slots"] = "1"
+        env["MPI_NUM_WORKERS"] = str(n)
+    return env
+
+
+# ------------------------------------------------------------ XGBoost / Paddle
+
+def xgboost_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
+    """Rabit tracker env (DMLC_* family)."""
+    port = DEFAULT_PORTS[JobKind.XGBOOST]
+    has_master = REPLICA_MASTER in job.spec.replica_specs
+    master_host = (
+        job.replica_hostname(REPLICA_MASTER, 0)
+        if has_master
+        else job.replica_hostname(REPLICA_WORKER, 0)
+    )
+    workers = job.spec.replica_specs.get(REPLICA_WORKER)
+    n_workers = workers.replicas if workers else 0
+    if rtype == REPLICA_MASTER:
+        rank = 0
+    else:
+        rank = index + 1 if has_master else index
+    return {
+        "MASTER_HOST": master_host,
+        "MASTER_PORT": str(port),
+        "WORLD_SIZE": str(job.total_replicas()),
+        "RANK": str(rank),
+        "WORKER_HOSTS": ",".join(a.rsplit(":", 1)[0] for a in replica_addresses(job, REPLICA_WORKER, port)),
+        "WORKER_PORT": str(port),
+        "DMLC_TRACKER_URI": master_host,
+        "DMLC_TRACKER_PORT": str(port),
+        "DMLC_NUM_WORKER": str(n_workers),
+    }
+
+
+def paddle_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
+    port = DEFAULT_PORTS[JobKind.PADDLE]
+    all_eps = replica_addresses(job, REPLICA_MASTER, port) + replica_addresses(
+        job, REPLICA_WORKER, port
+    )
+    rank = 0 if rtype == REPLICA_MASTER else index + (
+        1 if REPLICA_MASTER in job.spec.replica_specs else 0
+    )
+    return {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(job.total_replicas()),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(all_eps),
+        "PADDLE_CURRENT_ENDPOINT": all_eps[rank] if rank < len(all_eps) else "",
+    }
+
+
+# ------------------------------------------------------------------- dispatch
+
+_SYNTH = {
+    JobKind.JAX: jax_env,
+    JobKind.TF: tf_env,
+    JobKind.PYTORCH: pytorch_env,
+    JobKind.MPI: mpi_env,
+    JobKind.XGBOOST: xgboost_env,
+    JobKind.PADDLE: paddle_env,
+}
+
+
+def synthesize_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
+    """Full env for one replica process: framework contract + identity labels.
+
+    User-specified container env wins over synthesized env, matching the
+    reference controllers' append-if-absent behavior.
+    """
+    env = dict(_SYNTH[job.kind](job, rtype, index))
+    env.setdefault("JOB_NAME", job.metadata.name)
+    env.setdefault("REPLICA_TYPE", rtype)
+    env.setdefault("REPLICA_INDEX", str(index))
+    user_env = job.spec.replica_specs[rtype].template.container.env
+    env.update(user_env)
+    return env

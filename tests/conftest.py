@@ -1,0 +1,25 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual devices so every sharding/mesh test exercises
+real multi-device SPMD without TPU hardware (the driver separately dry-runs
+multi-chip via __graft_entry__.dryrun_multichip). Must run before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    return jax.devices("cpu")

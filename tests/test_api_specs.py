@@ -1,0 +1,200 @@
+"""P0 spec-layer tests: validation + serde round-trip.
+
+Mirrors the reference's webhook unit tests (SURVEY.md §4: table-driven tests
+asserting admission decisions with no cluster).
+"""
+
+import dataclasses
+
+import pytest
+
+from kubeflow_tpu.api import (
+    CleanPodPolicy,
+    ContainerSpec,
+    ElasticPolicy,
+    JAXJob,
+    JAXJobSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    ValidationError,
+    validate_job,
+    REPLICA_WORKER,
+    REPLICA_LAUNCHER,
+    REPLICA_MASTER,
+)
+from kubeflow_tpu.api.jobs import MPIJob, PyTorchJob, TFJob
+from kubeflow_tpu.api.serde import job_from_yaml, job_to_yaml
+
+
+def mk_jaxjob(name="mnist", workers=4, **spec_kw) -> JAXJob:
+    return JAXJob(
+        metadata=ObjectMeta(name=name, namespace="team-a"),
+        spec=JAXJobSpec(
+            replica_specs={
+                REPLICA_WORKER: ReplicaSpec(
+                    replicas=workers,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(
+                            command=["python", "-m", "train"],
+                            env={"USER_VAR": "1"},
+                        )
+                    ),
+                    restart_policy=RestartPolicy.EXIT_CODE,
+                )
+            },
+            **spec_kw,
+        ),
+    )
+
+
+class TestValidation:
+    def test_valid_job_passes(self):
+        validate_job(mk_jaxjob())
+
+    def test_bad_name_rejected(self):
+        with pytest.raises(ValidationError, match="metadata.name"):
+            validate_job(mk_jaxjob(name="Bad_Name"))
+
+    def test_missing_workers_rejected(self):
+        job = mk_jaxjob()
+        job.spec.replica_specs = {}
+        with pytest.raises(ValidationError):
+            validate_job(job)
+
+    def test_invalid_replica_type_for_kind(self):
+        job = mk_jaxjob()
+        job.spec.replica_specs["ps"] = ReplicaSpec(replicas=1)
+        with pytest.raises(ValidationError, match="invalid replica type"):
+            validate_job(job)
+
+    def test_pytorch_master_at_most_one(self):
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="pt"),
+            spec=JAXJobSpec(
+                replica_specs={
+                    REPLICA_MASTER: ReplicaSpec(replicas=2),
+                    REPLICA_WORKER: ReplicaSpec(replicas=2),
+                }
+            ),
+        )
+        with pytest.raises(ValidationError, match="master"):
+            validate_job(job)
+
+    def test_mpi_requires_single_launcher(self):
+        job = MPIJob(
+            metadata=ObjectMeta(name="mpi"),
+            spec=JAXJobSpec(replica_specs={REPLICA_WORKER: ReplicaSpec(replicas=2)}),
+        )
+        with pytest.raises(ValidationError, match="launcher"):
+            validate_job(job)
+
+    def test_elastic_bounds(self):
+        job = mk_jaxjob(
+            run_policy=RunPolicy(
+                elastic_policy=ElasticPolicy(min_replicas=4, max_replicas=2)
+            )
+        )
+        with pytest.raises(ValidationError, match="elasticPolicy"):
+            validate_job(job)
+
+    def test_min_available_defaults_to_gang(self):
+        job = mk_jaxjob(
+            workers=8, run_policy=RunPolicy(scheduling_policy=SchedulingPolicy())
+        )
+        validate_job(job)
+        assert job.spec.run_policy.scheduling_policy.min_available == 8
+
+    def test_bad_slice_topology(self):
+        job = mk_jaxjob(
+            run_policy=RunPolicy(
+                scheduling_policy=SchedulingPolicy(slice_topology="banana")
+            )
+        )
+        with pytest.raises(ValidationError, match="sliceTopology"):
+            validate_job(job)
+
+    def test_backoff_limit_nonnegative(self):
+        job = mk_jaxjob(run_policy=RunPolicy(backoff_limit=-1))
+        with pytest.raises(ValidationError, match="backoffLimit"):
+            validate_job(job)
+
+
+class TestSerde:
+    def test_yaml_round_trip(self):
+        job = mk_jaxjob(
+            run_policy=RunPolicy(
+                clean_pod_policy=CleanPodPolicy.ALL,
+                backoff_limit=5,
+                scheduling_policy=SchedulingPolicy(min_available=4, queue="tpu"),
+            )
+        )
+        text = job_to_yaml(job)
+        back = job_from_yaml(text)
+        assert back.kind == job.kind
+        assert back.metadata.name == "mnist"
+        assert back.metadata.namespace == "team-a"
+        rs = back.spec.replica_specs[REPLICA_WORKER]
+        assert rs.replicas == 4
+        assert rs.restart_policy == RestartPolicy.EXIT_CODE
+        assert rs.template.container.command == ["python", "-m", "train"]
+        assert back.spec.run_policy.clean_pod_policy == CleanPodPolicy.ALL
+        assert back.spec.run_policy.scheduling_policy.queue == "tpu"
+
+    def test_yaml_envelope(self):
+        text = job_to_yaml(mk_jaxjob())
+        assert "kind: JAXJob" in text
+        assert "apiVersion: kubeflow-tpu.org/v1" in text
+
+    def test_sample_fixture_loads_and_validates(self):
+        # samples/ doubles as fixtures: schema drift breaks this test.
+        import pathlib
+
+        text = (
+            pathlib.Path(__file__).parent.parent / "samples" / "jaxjob_mnist.yaml"
+        ).read_text()
+        job = validate_job(job_from_yaml(text))
+        assert job.name == "mnist"
+        assert job.spec.replica_specs[REPLICA_WORKER].replicas == 1
+        # serialization is deterministic (no invented timestamps/status)
+        assert job_to_yaml(job) == job_to_yaml(job_from_yaml(job_to_yaml(job)))
+
+    def test_multislice_divisibility_enforced(self):
+        job = mk_jaxjob(workers=8)
+        job.spec.num_slices = 3
+        with pytest.raises(ValidationError, match="numSlices"):
+            validate_job(job)
+
+    def test_unknown_fields_ignored(self):
+        text = job_to_yaml(mk_jaxjob()).replace(
+            "spec:", "futureField: 1\nspec:"
+        )
+        back = job_from_yaml(text)
+        assert back.metadata.name == "mnist"
+
+
+class TestStatusMachine:
+    def test_exclusive_conditions(self):
+        job = mk_jaxjob()
+        st = job.status
+        st.set_condition(JobConditionType.CREATED, "JobCreated")
+        st.set_condition(JobConditionType.RUNNING, "JobRunning")
+        assert st.has_condition(JobConditionType.RUNNING)
+        st.set_condition(JobConditionType.SUCCEEDED, "JobSucceeded")
+        assert st.is_succeeded and st.is_finished
+        assert not st.has_condition(JobConditionType.RUNNING)  # flipped to False
+        # Created survives terminal transitions (non-exclusive)
+        assert st.has_condition(JobConditionType.CREATED)
+
+    def test_replica_naming_convention(self):
+        job = mk_jaxjob()
+        assert job.replica_name(REPLICA_WORKER, 3) == "mnist-worker-3"
+        assert (
+            job.replica_hostname(REPLICA_WORKER, 0) == "mnist-worker-0.mnist.team-a"
+        )
+        labels = job.labels(REPLICA_WORKER, 2)
+        assert labels["kubeflow-tpu.org/replica-index"] == "2"

@@ -1,0 +1,169 @@
+"""Env-contract tests — byte-for-byte assertions on rendezvous synthesis.
+
+This mirrors the reference's highest-value test pattern (SURVEY.md §4):
+tfjob_controller_test.go / pod_test.go assert exact TF_CONFIG / env output
+as pure string construction, no cluster needed.
+"""
+
+import json
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    ElasticPolicy,
+    JAXJob,
+    JAXJobSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RunPolicy,
+    REPLICA_WORKER,
+    REPLICA_MASTER,
+    REPLICA_PS,
+    REPLICA_CHIEF,
+    REPLICA_LAUNCHER,
+)
+from kubeflow_tpu.api.jobs import MPIJob, PyTorchJob, TFJob, XGBoostJob
+from kubeflow_tpu.controller import envcontract
+
+
+def _job(cls, name, replicas: dict, ns="default", **spec_kw):
+    return cls(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=JAXJobSpec(
+            replica_specs={t: ReplicaSpec(replicas=n) for t, n in replicas.items()},
+            **spec_kw,
+        ),
+    )
+
+
+class TestJAXEnv:
+    def test_worker_env_exact(self):
+        job = _job(JAXJob, "trainer", {REPLICA_WORKER: 4}, ns="ml")
+        env = envcontract.jax_env(job, REPLICA_WORKER, 2)
+        assert env["JAX_COORDINATOR_ADDRESS"] == "trainer-worker-0.trainer.ml:1234"
+        assert env["JAX_NUM_PROCESSES"] == "4"
+        assert env["JAX_PROCESS_ID"] == "2"
+        assert env["TPU_WORKER_ID"] == "2"
+        assert env["TPU_WORKER_HOSTNAMES"] == (
+            "trainer-worker-0.trainer.ml,trainer-worker-1.trainer.ml,"
+            "trainer-worker-2.trainer.ml,trainer-worker-3.trainer.ml"
+        )
+        assert "MEGASCALE_COORDINATOR_ADDRESS" not in env
+
+    def test_multislice_megascale_env(self):
+        job = _job(JAXJob, "big", {REPLICA_WORKER: 8}, num_slices=2)
+        env = envcontract.jax_env(job, REPLICA_WORKER, 0)
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "big-worker-0.big.default:1234"
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        # equal-sized slices: workers 0-3 -> slice 0, workers 4-7 -> slice 1
+        assert envcontract.jax_env(job, REPLICA_WORKER, 3)["MEGASCALE_SLICE_ID"] == "0"
+        assert envcontract.jax_env(job, REPLICA_WORKER, 4)["MEGASCALE_SLICE_ID"] == "1"
+
+    def test_user_env_wins(self):
+        job = _job(JAXJob, "j", {REPLICA_WORKER: 2})
+        job.spec.replica_specs[REPLICA_WORKER].template = PodTemplateSpec(
+            container=ContainerSpec(env={"JAX_NUM_PROCESSES": "999", "EXTRA": "x"})
+        )
+        env = envcontract.synthesize_env(job, REPLICA_WORKER, 1)
+        assert env["JAX_NUM_PROCESSES"] == "999"
+        assert env["EXTRA"] == "x"
+        assert env["REPLICA_INDEX"] == "1"
+
+
+class TestTFConfig:
+    def test_ps_worker_chief_topology(self):
+        job = _job(
+            TFJob, "dist", {REPLICA_CHIEF: 1, REPLICA_WORKER: 2, REPLICA_PS: 1}
+        )
+        cfg = json.loads(envcontract.tf_config(job, REPLICA_WORKER, 1))
+        assert cfg["cluster"] == {
+            "chief": ["dist-chief-0.dist.default:2222"],
+            "worker": [
+                "dist-worker-0.dist.default:2222",
+                "dist-worker-1.dist.default:2222",
+            ],
+            "ps": ["dist-ps-0.dist.default:2222"],
+        }
+        assert cfg["task"] == {"type": "worker", "index": 1}
+
+    def test_tf_config_is_compact_json(self):
+        job = _job(TFJob, "t", {REPLICA_WORKER: 1})
+        raw = envcontract.tf_config(job, REPLICA_WORKER, 0)
+        assert ": " not in raw and ", " not in raw  # compact separators
+
+
+class TestPyTorchEnv:
+    def test_master_rank_zero(self):
+        job = _job(PyTorchJob, "pt", {REPLICA_MASTER: 1, REPLICA_WORKER: 3})
+        env = envcontract.pytorch_env(job, REPLICA_MASTER, 0)
+        assert env == {
+            "MASTER_ADDR": "pt-master-0.pt.default",
+            "MASTER_PORT": "23456",
+            "WORLD_SIZE": "4",
+            "RANK": "0",
+        }
+
+    def test_worker_rank_offset_with_master(self):
+        job = _job(PyTorchJob, "pt", {REPLICA_MASTER: 1, REPLICA_WORKER: 3})
+        assert envcontract.pytorch_env(job, REPLICA_WORKER, 0)["RANK"] == "1"
+        assert envcontract.pytorch_env(job, REPLICA_WORKER, 2)["RANK"] == "3"
+
+    def test_worker_rank_without_master(self):
+        job = _job(PyTorchJob, "pt", {REPLICA_WORKER: 4})
+        env = envcontract.pytorch_env(job, REPLICA_WORKER, 0)
+        assert env["RANK"] == "0"
+        assert env["MASTER_ADDR"] == "pt-worker-0.pt.default"
+
+    def test_elastic_pet_env(self):
+        job = _job(
+            PyTorchJob,
+            "el",
+            {REPLICA_WORKER: 2},
+            run_policy=RunPolicy(
+                elastic_policy=ElasticPolicy(
+                    min_replicas=2,
+                    max_replicas=8,
+                    max_restarts=5,
+                    nproc_per_node=4,
+                    rdzv_backend="c10d",
+                )
+            ),
+        )
+        env = envcontract.pytorch_env(job, REPLICA_WORKER, 1)
+        assert env["PET_RDZV_BACKEND"] == "c10d"
+        assert env["PET_RDZV_ENDPOINT"] == "el-worker-0.el.default:23456"
+        assert env["PET_NNODES"] == "2:8"
+        assert env["PET_NPROC_PER_NODE"] == "4"
+        assert env["PET_MAX_RESTARTS"] == "5"
+
+
+class TestMPI:
+    def test_hostfile(self):
+        job = _job(MPIJob, "bert", {REPLICA_LAUNCHER: 1, REPLICA_WORKER: 3})
+        hf = envcontract.mpi_hostfile(job, slots_per_worker=8)
+        assert hf == (
+            "bert-worker-0.bert.default slots=8\n"
+            "bert-worker-1.bert.default slots=8\n"
+            "bert-worker-2.bert.default slots=8\n"
+        )
+
+    def test_launcher_env(self):
+        job = _job(MPIJob, "bert", {REPLICA_LAUNCHER: 1, REPLICA_WORKER: 3})
+        env = envcontract.mpi_env(job, REPLICA_LAUNCHER, 0)
+        assert env["MPI_NUM_WORKERS"] == "3"
+        assert env["OMPI_MCA_orte_default_hostfile"] == "/etc/mpi/hostfile"
+
+
+class TestXGBoost:
+    def test_rabit_tracker_env(self):
+        job = _job(XGBoostJob, "xgb", {REPLICA_MASTER: 1, REPLICA_WORKER: 2})
+        env = envcontract.xgboost_env(job, REPLICA_WORKER, 1)
+        assert env["DMLC_TRACKER_URI"] == "xgb-master-0.xgb.default"
+        assert env["DMLC_NUM_WORKER"] == "2"
+        assert env["RANK"] == "2"
+
+    def test_workers_only_falls_back_to_worker_zero(self):
+        job = _job(XGBoostJob, "xgb", {REPLICA_WORKER: 4})
+        env = envcontract.xgboost_env(job, REPLICA_WORKER, 3)
+        assert env["MASTER_HOST"] == "xgb-worker-0.xgb.default"
+        assert env["RANK"] == "3"  # no master: ranks 0..n-1, never == WORLD_SIZE
