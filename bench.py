@@ -1,0 +1,90 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Flagship metric (BASELINE.md north star): images/sec/chip on the largest
+in-tree model available. Falls back gracefully: resnet50 > mnist-mlp.
+vs_baseline: the reference publishes no numbers (BASELINE.json published={}),
+so vs_baseline is the ratio to this repo's first recorded measurement
+(BENCH_BASELINE in this file), 1.0 on the first run.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# First recorded round-1 number for this metric on the axon v5e chip; later
+# rounds report vs_baseline against it.
+BENCH_BASELINE_IMAGES_PER_SEC = None  # set after first driver run
+
+
+def bench_mnist_mlp(steps: int = 60, batch_size: int = 512) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import MnistMLP
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_image_dataset
+
+    ds = synthetic_image_dataset(
+        n_train=batch_size * 4, n_test=batch_size, shape=(28, 28, 1)
+    )
+    trainer = Trainer(
+        MnistMLP(hidden=(512, 256)),
+        TrainerConfig(batch_size=batch_size, steps=steps, log_every_steps=10**9),
+    )
+    state = trainer.init_state(ds.x_train[:batch_size])
+    batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
+    # warmup/compile
+    state, m = trainer.train_step(state, batch)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = trainer.train_step(state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    ips = steps * batch_size / dt
+    return {"metric": "mnist_mlp_images_per_sec_per_chip", "value": round(ips, 1)}
+
+
+def main() -> None:
+    import os
+
+    if os.environ.get("KFT_BENCH_PLATFORM"):
+        # debugging escape hatch (e.g. KFT_BENCH_PLATFORM=cpu when the TPU
+        # tunnel is unavailable); config update, not env — see utils/device.py
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["KFT_BENCH_PLATFORM"])
+    result = None
+    try:
+        from kubeflow_tpu.models import resnet  # noqa: F401  (lands in P3)
+
+        has_resnet = True
+    except ImportError:
+        has_resnet = False
+
+    if has_resnet:
+        from bench_resnet import bench_resnet50  # optional future module
+
+        result = bench_resnet50()
+    else:
+        result = bench_mnist_mlp()
+
+    baseline = BENCH_BASELINE_IMAGES_PER_SEC
+    vs = round(result["value"] / baseline, 3) if baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": result["metric"],
+                "value": result["value"],
+                "unit": "images/sec/chip",
+                "vs_baseline": vs,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""North-star config #1: MNIST single-worker training.
+
+Reference parity: kubeflow/examples mnist TFJob image (SURVEY.md L6),
+rebuilt as the in-tree flax example. Device picked by one flag.
+
+  python -m examples.mnist --device=cpu --epochs=8
+  python -m examples.mnist --device=tpu --epochs=8
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+    p.add_argument("--epochs", type=int, default=100)
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--model", default="mlp", choices=["mlp", "cnn"])
+    p.add_argument("--checkpoint-dir", default=None)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+
+    from kubeflow_tpu.models import MnistCNN, MnistMLP
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import load_digits_dataset
+
+    dataset = load_digits_dataset()
+    model = MnistMLP() if args.model == "mlp" else MnistCNN()
+    trainer = Trainer(
+        model,
+        TrainerConfig(
+            batch_size=args.batch_size,
+            epochs=args.epochs,
+            steps=args.steps,
+            learning_rate=args.lr,
+            checkpoint_dir=args.checkpoint_dir,
+        ),
+    )
+    _, metrics = trainer.fit(dataset)
+    return metrics["final_accuracy"]
+
+
+if __name__ == "__main__":
+    acc = main()
+    # exit code signals job verdict to the controller (ExitCode restart policy)
+    raise SystemExit(0 if acc > 0.9 else 1)

@@ -90,6 +90,11 @@ def job_to_dict(job: TrainJob) -> dict:
     # so spec manifests are deterministic golden files.
     if not job.status.conditions and job.status.start_time is None:
         d.pop("status", None)
+    # JAX-only spec fields must not leak into other kinds' manifests
+    # (migration parity: a TFJob CR has no coordinatorPort/numSlices).
+    if job.kind != JobKind.JAX and "spec" in d:
+        d["spec"].pop("coordinatorPort", None)
+        d["spec"].pop("numSlices", None)
     return {"apiVersion": job.api_version, "kind": job.kind.value, **d}
 
 

@@ -41,10 +41,30 @@ from kubeflow_tpu.api.jobs import (
 _TF_ROLE_ORDER = [REPLICA_CHIEF, REPLICA_MASTER, REPLICA_WORKER, REPLICA_PS, REPLICA_EVALUATOR]
 
 
+def _has(job: TrainJob, rtype: str) -> bool:
+    """A replica group 'exists' only with replicas > 0 (a zero-replica spec
+    must not become a rendezvous target)."""
+    rs = job.spec.replica_specs.get(rtype)
+    return rs is not None and rs.replicas > 0
+
+
+def job_port(job: TrainJob, rtype: str | None = None) -> int:
+    """Rendezvous port: a user-declared container port wins over the
+    per-framework default (the reference controllers read the named container
+    port for TF_CONFIG/MASTER_PORT)."""
+    for t, rs in job.spec.replica_specs.items():
+        if rtype is not None and t != rtype:
+            continue
+        ports = rs.template.container.ports
+        if ports:
+            return next(iter(ports.values()))
+    return DEFAULT_PORTS[job.kind]
+
+
 def replica_addresses(job: TrainJob, rtype: str, port: int | None = None) -> list[str]:
     """host:port list for one replica group — the headless-Service DNS contract."""
     if port is None:
-        port = DEFAULT_PORTS[job.kind]
+        port = job_port(job)
     rs = job.spec.replica_specs.get(rtype)
     if rs is None:
         return []
@@ -89,7 +109,7 @@ def jax_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
 def tf_config(job: TrainJob, rtype: str, index: int, port: int | None = None) -> str:
     """TF_CONFIG JSON for one replica (SetClusterSpec parity)."""
     if port is None:
-        port = DEFAULT_PORTS[JobKind.TF]
+        port = job_port(job)
     cluster: dict[str, list[str]] = {}
     for role in _TF_ROLE_ORDER:
         addrs = replica_addresses(job, role, port)
@@ -115,8 +135,8 @@ def pytorch_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
     Rank convention mirrors envvar.go: master is rank 0; worker i is rank i+1
     when a master replica exists, else rank i.
     """
-    port = DEFAULT_PORTS[JobKind.PYTORCH]
-    has_master = REPLICA_MASTER in job.spec.replica_specs
+    port = job_port(job)
+    has_master = _has(job, REPLICA_MASTER)
     master_host = (
         job.replica_hostname(REPLICA_MASTER, 0)
         if has_master
@@ -179,8 +199,8 @@ def mpi_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
 
 def xgboost_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
     """Rabit tracker env (DMLC_* family)."""
-    port = DEFAULT_PORTS[JobKind.XGBOOST]
-    has_master = REPLICA_MASTER in job.spec.replica_specs
+    port = job_port(job)
+    has_master = _has(job, REPLICA_MASTER)
     master_host = (
         job.replica_hostname(REPLICA_MASTER, 0)
         if has_master
@@ -206,12 +226,12 @@ def xgboost_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
 
 
 def paddle_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
-    port = DEFAULT_PORTS[JobKind.PADDLE]
+    port = job_port(job)
     all_eps = replica_addresses(job, REPLICA_MASTER, port) + replica_addresses(
         job, REPLICA_WORKER, port
     )
     rank = 0 if rtype == REPLICA_MASTER else index + (
-        1 if REPLICA_MASTER in job.spec.replica_specs else 0
+        1 if _has(job, REPLICA_MASTER) else 0
     )
     return {
         "PADDLE_TRAINER_ID": str(rank),

@@ -1,0 +1,10 @@
+"""In-tree model library (the reference ships these as example images —
+kubeflow/examples mnist / resnet / bert, SURVEY.md L6).
+
+Models are flax modules with logical-axis param annotations so the same
+module runs 1-device or sharded over the mesh's model/fsdp axes.
+"""
+
+from kubeflow_tpu.models.mnist import MnistCNN, MnistMLP
+
+__all__ = ["MnistMLP", "MnistCNN"]

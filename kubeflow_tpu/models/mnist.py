@@ -1,0 +1,46 @@
+"""MNIST-class models — north-star config #1 (BASELINE.md: >97% test acc).
+
+Small enough that TPU considerations are trivial, but written the same way
+as the big models: static shapes, channels-last, f32 params with optional
+bf16 compute handled by the trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistMLP(nn.Module):
+    """MLP for flat image vectors (sklearn digits 64-d or MNIST 784-d)."""
+
+    hidden: Sequence[int] = (128, 64)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class MnistCNN(nn.Module):
+    """Conv net for (H, W, C) images."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 2:  # flat input: assume square grayscale
+            side = int(x.shape[-1] ** 0.5)
+            x = x.reshape((x.shape[0], side, side, 1))
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.avg_pool(x, (2, 2), (2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.avg_pool(x, (2, 2), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(self.num_classes)(x)

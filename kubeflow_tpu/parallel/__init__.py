@@ -1,0 +1,20 @@
+"""Parallelism layer: mesh building, shardings, pipeline/sequence parallel.
+
+Replaces the reference's strategy zoo (DDP/FSDP/Megatron-TP/DeepSpeed-PP/
+MoE-EP over NCCL — SURVEY.md §2.2) with the TPU-native single mechanism:
+a `jax.sharding.Mesh` with named axes and NamedSharding/shard_map
+annotations; XLA inserts the ICI/DCN collectives.
+"""
+
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh, AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_CONTEXT, AXIS_EXPERT, AXIS_PIPELINE
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_MODEL",
+    "AXIS_CONTEXT",
+    "AXIS_EXPERT",
+    "AXIS_PIPELINE",
+]

@@ -1,0 +1,94 @@
+"""Device mesh construction — the one mechanism under every strategy.
+
+The canonical axis vocabulary (SURVEY.md §2.2 table):
+  data     pure data parallel (gradient allreduce)
+  fsdp     data parallel with sharded params/optimizer (ZeRO-3 analogue)
+  model    tensor parallel (matmul sharding over ICI)
+  context  sequence/context parallel (ring attention KV rotation)
+  pipeline pipeline stages (microbatch loop over ppermute)
+  expert   MoE expert parallel (all-to-all dispatch)
+
+Mesh axes are ordered fastest-varying-last onto the physical topology; ICI
+bandwidth favors putting `model`/`context` on the innermost (intra-slice)
+dimension and `data` on the outermost (inter-slice DCN) dimension — the
+scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_MODEL = "model"
+AXIS_CONTEXT = "context"
+AXIS_PIPELINE = "pipeline"
+AXIS_EXPERT = "expert"
+
+# Outer-to-inner canonical order: data-like axes ride DCN, model-like ride ICI.
+CANONICAL_ORDER = [AXIS_DATA, AXIS_FSDP, AXIS_PIPELINE, AXIS_EXPERT, AXIS_CONTEXT, AXIS_MODEL]
+
+
+@dataclass
+class MeshConfig:
+    """Sizes per axis; -1 on at most one axis means 'all remaining devices'."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    context: int = 1
+    pipeline: int = 1
+    expert: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {
+            AXIS_DATA: self.data,
+            AXIS_FSDP: self.fsdp,
+            AXIS_PIPELINE: self.pipeline,
+            AXIS_EXPERT: self.expert,
+            AXIS_CONTEXT: self.context,
+            AXIS_MODEL: self.model,
+        }
+
+
+def build_mesh(
+    config: MeshConfig | None = None, devices: list | None = None
+) -> Mesh:
+    """Build a Mesh over `devices` (default: all local devices).
+
+    Axes of size 1 are kept in the mesh so sharding specs can always name
+    them — XLA erases trivial axes at compile time, so this costs nothing.
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+
+    sizes = config.sizes()
+    wild = [a for a, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one axis may be -1, got {wild}")
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    if wild:
+        if n % fixed != 0:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes[wild[0]] = n // fixed
+    elif fixed != n:
+        raise ValueError(f"axis sizes {sizes} product {fixed} != {n} devices")
+
+    axis_names = tuple(CANONICAL_ORDER)
+    shape = tuple(sizes[a] for a in axis_names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def single_device_mesh() -> Mesh:
+    """1-device mesh with the full axis vocabulary (all sizes 1 except data)."""
+    return build_mesh(MeshConfig(), jax.devices()[:1])

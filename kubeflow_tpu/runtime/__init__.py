@@ -1,0 +1,11 @@
+"""Runtime layer: turning specs into real OS processes.
+
+The 'kubelet' of this framework. A replica 'pod' is a subprocess; the
+rendezvous registry is the headless-Service DNS analogue (SURVEY.md §7 P2:
+the cluster is a fake-cluster runtime launching real local processes,
+mirroring how the reference tests itself via envtest without clusters).
+"""
+
+from kubeflow_tpu.runtime.local import LocalRunner, ReplicaResult
+
+__all__ = ["LocalRunner", "ReplicaResult"]

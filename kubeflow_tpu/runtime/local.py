@@ -1,0 +1,121 @@
+"""LocalRunner: run a job's replicas as real subprocesses.
+
+P1 scope (SURVEY.md §7): launch, env-inject, wait, verdict. Gang semantics,
+restart policies, and the reconcile loop live in the controller (P2) — the
+runner is the kubelet, not the scheduler.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from kubeflow_tpu.api.common import JobConditionType
+from kubeflow_tpu.api.jobs import SUCCESS_REPLICA, TrainJob, REPLICA_CHIEF, REPLICA_WORKER
+from kubeflow_tpu.api.validation import validate_job
+from kubeflow_tpu.controller.envcontract import synthesize_env
+from kubeflow_tpu.runtime.rendezvous import LocalResolver
+
+
+@dataclass
+class ReplicaResult:
+    rtype: str
+    index: int
+    exit_code: int
+    log_path: str
+    duration_s: float
+
+
+@dataclass
+class JobResult:
+    succeeded: bool
+    replicas: list[ReplicaResult] = field(default_factory=list)
+
+    def logs(self, rtype: str = REPLICA_WORKER, index: int = 0) -> str:
+        for r in self.replicas:
+            if r.rtype == rtype and r.index == index:
+                return Path(r.log_path).read_text()
+        raise KeyError(f"{rtype}-{index}")
+
+
+class LocalRunner:
+    """Runs every replica of a (validated) job as a local subprocess."""
+
+    def __init__(self, log_dir: str | None = None, inherit_env: bool = True):
+        self.log_dir = Path(log_dir or ".kubeflow_tpu/logs")
+        self.inherit_env = inherit_env
+
+    def run(self, job: TrainJob, timeout: float | None = None) -> JobResult:
+        validate_job(job)
+        resolver = LocalResolver(job)
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+
+        procs: list[tuple[str, int, subprocess.Popen, str, float]] = []
+        for rtype, rs in job.spec.replica_specs.items():
+            for i in range(rs.replicas):
+                c = rs.template.container
+                cmd = list(c.command) + list(c.args)
+                if not cmd:
+                    raise ValueError(f"replica {rtype} has no command")
+                env = dict(os.environ) if self.inherit_env else {}
+                env.update(resolver.rewrite_env(synthesize_env(job, rtype, i)))
+                log_path = str(self.log_dir / f"{job.replica_name(rtype, i)}.log")
+                logf = open(log_path, "wb")
+                proc = subprocess.Popen(
+                    cmd,
+                    env=env,
+                    stdout=logf,
+                    stderr=subprocess.STDOUT,
+                    cwd=c.working_dir or None,
+                )
+                procs.append((rtype, i, proc, log_path, time.monotonic()))
+
+        deadline = (
+            time.monotonic() + timeout
+            if timeout is not None
+            else (
+                time.monotonic() + job.spec.run_policy.active_deadline_seconds
+                if job.spec.run_policy.active_deadline_seconds
+                else None
+            )
+        )
+        results: list[ReplicaResult] = []
+        for rtype, i, proc, log_path, t0 in procs:
+            remaining = None if deadline is None else max(0.1, deadline - time.monotonic())
+            try:
+                code = proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                code = proc.wait()
+            results.append(
+                ReplicaResult(rtype, i, code, log_path, time.monotonic() - t0)
+            )
+
+        success_rtype = SUCCESS_REPLICA[job.kind]
+        if success_rtype not in job.spec.replica_specs:
+            # TFJob chief fallback, master fallback: worker-0 decides
+            success_rtype = REPLICA_WORKER
+        verdict = all(
+            r.exit_code == 0
+            for r in results
+            if r.rtype == success_rtype and (r.index == 0 or r.rtype == REPLICA_WORKER)
+        )
+
+        st = job.status
+        st.start_time = st.start_time or _now()
+        if verdict:
+            st.set_condition(JobConditionType.SUCCEEDED, "JobSucceeded")
+        else:
+            st.set_condition(JobConditionType.FAILED, "JobFailed")
+        st.completion_time = _now()
+        return JobResult(succeeded=verdict, replicas=results)
+
+
+def _now() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")

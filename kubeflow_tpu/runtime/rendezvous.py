@@ -1,0 +1,48 @@
+"""Rendezvous registry — headless-Service DNS for local processes.
+
+The env contract names replicas by stable DNS-style hostnames
+(`{job}-{rtype}-{i}.{job}.{ns}`). On a real cluster those resolve via
+headless Services; locally we rewrite them to 127.0.0.1 with per-job unique
+ports so `jax.distributed.initialize` and friends connect for real.
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.jobs import TrainJob
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class LocalResolver:
+    """Maps replica hostnames to loopback endpoints for one job."""
+
+    job: TrainJob
+    port_map: dict[str, int] = field(default_factory=dict)
+
+    def endpoint(self, rtype: str, index: int) -> str:
+        host = self.job.replica_hostname(rtype, index)
+        if host not in self.port_map:
+            self.port_map[host] = free_port()
+        return f"127.0.0.1:{self.port_map[host]}"
+
+    def rewrite_env(self, env: dict[str, str]) -> dict[str, str]:
+        """Replace every known hostname[:port] in env values with loopback."""
+        # Ensure every replica has a mapping before rewriting.
+        for rtype, rs in self.job.spec.replica_specs.items():
+            for i in range(rs.replicas):
+                self.endpoint(rtype, i)
+        out = {}
+        for k, v in env.items():
+            for host, port in self.port_map.items():
+                v = v.replace(f"{host}:{self.job.spec.coordinator_port}", f"127.0.0.1:{port}")
+                v = v.replace(host, "127.0.0.1")
+            out[k] = v
+        return out

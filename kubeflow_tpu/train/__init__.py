@@ -1,0 +1,11 @@
+"""Training loop layer: trainer, data, metrics, checkpointing.
+
+This is in-tree "user workload" territory in the reference (kubeflow/examples
+images — SURVEY.md L6) plus the checkpoint/resume contract the platform
+guarantees (SURVEY.md §5.4). TPU-native: one jit-compiled train step, static
+shapes, donated buffers, orbax async checkpoints.
+"""
+
+from kubeflow_tpu.train.trainer import Trainer, TrainerConfig, TrainState
+
+__all__ = ["Trainer", "TrainerConfig", "TrainState"]

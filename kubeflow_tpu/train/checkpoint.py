@@ -1,0 +1,53 @@
+"""Checkpoint/resume contract — orbax-backed.
+
+Reference parity: the platform delegates checkpointing to frameworks and
+guarantees restart semantics + durable paths (SURVEY.md §5.4). Here orbax
+async checkpointing is the in-tree contract; the controller guarantees the
+same checkpoint dir across gang restarts, so `restore_latest` + step-offset
+resume is all a trainer needs for fault tolerance.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class Checkpointer:
+    """Thin orbax CheckpointManager wrapper with a stable save/restore API."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, abstract_state: Any) -> tuple[int, Any] | None:
+        """Restore newest checkpoint into the structure/shardings of
+        `abstract_state` (a real or jax.eval_shape state). None if empty."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+        return step, restored
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

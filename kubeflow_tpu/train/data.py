@@ -1,0 +1,95 @@
+"""In-memory datasets + batch iterator.
+
+Offline environment: no downloads. Real data = sklearn digits (8x8 grayscale
+digits, 1797 samples — the honest offline MNIST stand-in; an MLP reaches >97%
+test accuracy, matching BASELINE.md config #1's pass criterion). Synthetic
+generators provide MNIST-/ImageNet-/BERT-shaped batches for throughput
+benchmarks where content doesn't matter.
+
+TPU notes: batches are host numpy, converted to device arrays at the jit
+boundary; shapes are static per epoch (remainder batches dropped) so XLA
+compiles once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclass
+class Dataset:
+    x_train: np.ndarray
+    y_train: np.ndarray
+    x_test: np.ndarray
+    y_test: np.ndarray
+    num_classes: int
+
+    @property
+    def input_shape(self) -> tuple[int, ...]:
+        return self.x_train.shape[1:]
+
+
+def load_digits_dataset(test_fraction: float = 0.2, seed: int = 0) -> Dataset:
+    """sklearn digits, normalized to [0,1], deterministic split."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = (d.data / 16.0).astype(np.float32)
+    y = d.target.astype(np.int32)
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(len(x))
+    x, y = x[perm], y[perm]
+    n_test = int(len(x) * test_fraction)
+    return Dataset(
+        x_train=x[n_test:], y_train=y[n_test:],
+        x_test=x[:n_test], y_test=y[:n_test],
+        num_classes=10,
+    )
+
+
+def synthetic_image_dataset(
+    n_train: int = 1024,
+    n_test: int = 256,
+    shape: tuple[int, ...] = (28, 28, 1),
+    num_classes: int = 10,
+    seed: int = 0,
+) -> Dataset:
+    """Procedural image classification set with learnable class structure:
+    each class is a fixed random template + noise, so accuracy is meaningful."""
+    rng = np.random.RandomState(seed)
+    templates = rng.normal(0, 1, size=(num_classes, *shape)).astype(np.float32)
+
+    def make(n: int) -> tuple[np.ndarray, np.ndarray]:
+        y = rng.randint(0, num_classes, size=n).astype(np.int32)
+        x = templates[y] + rng.normal(0, 0.5, size=(n, *shape)).astype(np.float32)
+        return x.astype(np.float32), y
+
+    x_tr, y_tr = make(n_train)
+    x_te, y_te = make(n_test)
+    return Dataset(x_tr, y_tr, x_te, y_te, num_classes)
+
+
+def batches(
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    *,
+    seed: int | None = None,
+    drop_remainder: bool = True,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """One epoch of (x, y) minibatches; static shapes when drop_remainder."""
+    n = len(x)
+    idx = np.arange(n)
+    if seed is not None:
+        np.random.RandomState(seed).shuffle(idx)
+    stop = (n // batch_size) * batch_size if drop_remainder else n
+    for i in range(0, stop, batch_size):
+        sl = idx[i : i + batch_size]
+        yield x[sl], y[sl]
+
+
+def steps_per_epoch(n: int, batch_size: int) -> int:
+    return n // batch_size

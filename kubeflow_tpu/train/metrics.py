@@ -1,0 +1,62 @@
+"""Metrics emission in the sweep-collector contract.
+
+Reference parity: Katib's metrics collector tails stdout and regex-parses
+`name=value` lines (pkg/webhook/v1beta1/pod/inject_webhook.go + file
+metricscollector — unverified, SURVEY.md §2.4). Trainers here print the same
+shape, so the in-tree sweep engine (kubeflow_tpu/sweep) and any log-scraper
+can collect objectives without instrumentation.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import time
+
+# The collector's parse regex: `<name>=<float>` tokens on a line.
+METRIC_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_./-]*)=(-?\d+(?:\.\d+)?(?:[eE]-?\d+)?)")
+
+
+def emit(step: int | None = None, file=None, **metrics: float) -> str:
+    """Print one metrics line: `step=3 loss=0.123 accuracy=0.98`."""
+    parts = []
+    if step is not None:
+        parts.append(f"step={step}")
+    for k, v in metrics.items():
+        parts.append(f"{k}={float(v):.6g}")
+    line = " ".join(parts)
+    print(line, file=file or sys.stdout, flush=True)
+    return line
+
+
+def parse_line(line: str) -> dict[str, float]:
+    """Collector side: extract all name=value pairs from one log line."""
+    return {m.group(1): float(m.group(2)) for m in METRIC_RE.finditer(line)}
+
+
+class Timer:
+    """Wall-clock throughput meter (images/sec, steps/sec)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._items = 0
+        self._steps = 0
+
+    def tick(self, items: int = 0) -> None:
+        self._items += items
+        self._steps += 1
+
+    @property
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    @property
+    def items_per_sec(self) -> float:
+        return self._items / max(self.elapsed, 1e-9)
+
+    @property
+    def steps_per_sec(self) -> float:
+        return self._steps / max(self.elapsed, 1e-9)

@@ -1,0 +1,245 @@
+"""The trainer: one jit-compiled SPMD step over a device mesh.
+
+TPU-first design notes:
+  - ONE traced/compiled train step (static shapes, donated state buffers);
+    the Python loop only feeds numpy batches and reads scalars.
+  - Mesh-aware from day one: the same trainer runs 1-device or N-device;
+    parallelism is data placement (parallel/sharding.py), not code.
+  - bfloat16 compute path via `compute_dtype` (params stay f32; matmuls run
+    on the MXU in bf16).
+  - Metrics print in the sweep-collector `name=value` contract.
+
+Reference parity: replaces the user-image training loops the platform
+launches (kubeflow/examples mnist et al. — SURVEY.md L6) with an in-tree,
+device-flag-selectable equivalent (north-star configs #1-#3).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import Mesh
+
+from kubeflow_tpu.parallel import build_mesh, MeshConfig
+from kubeflow_tpu.parallel.sharding import shard_batch, shard_state
+from kubeflow_tpu.train import metrics as metrics_lib
+from kubeflow_tpu.train.checkpoint import Checkpointer
+from kubeflow_tpu.train.data import Dataset, batches
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+@dataclass
+class TrainerConfig:
+    batch_size: int = 128
+    epochs: int = 1
+    steps: int | None = None          # overrides epochs when set
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    warmup_steps: int = 0
+    seed: int = 0
+    compute_dtype: Any = jnp.float32  # bfloat16 for MXU-heavy models
+    eval_every_epochs: int = 1
+    checkpoint_dir: str | None = None
+    checkpoint_every_steps: int = 200
+    log_every_steps: int = 50
+    mesh: MeshConfig | None = None    # None => single-device mesh semantics
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+class Trainer:
+    """Classification trainer for a flax module `model(x) -> logits`.
+
+    apply_fn can be overridden for models needing rngs/mutable state; it
+    receives (params, x, rng, train) and returns logits.
+    """
+
+    def __init__(
+        self,
+        model,
+        config: TrainerConfig,
+        tx: optax.GradientTransformation | None = None,
+        apply_fn: Callable | None = None,
+        loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = cross_entropy_loss,
+        mesh: Mesh | None = None,
+    ):
+        self.model = model
+        self.config = config
+        self.mesh = mesh if mesh is not None else build_mesh(config.mesh or MeshConfig())
+        self.loss_fn = loss_fn
+        self.apply_fn = apply_fn or (
+            lambda params, x, rng, train: model.apply({"params": params}, x)
+        )
+        self.tx = tx if tx is not None else self._default_tx()
+        self._jit_train_step = jax.jit(self._train_step, donate_argnums=0)
+        self._jit_eval_step = jax.jit(self._eval_step)
+        self.checkpointer = (
+            Checkpointer(config.checkpoint_dir) if config.checkpoint_dir else None
+        )
+
+    def _default_tx(self) -> optax.GradientTransformation:
+        c = self.config
+        lr: Any = c.learning_rate
+        if c.warmup_steps:
+            lr = optax.linear_schedule(0.0, c.learning_rate, c.warmup_steps)
+        if c.weight_decay:
+            return optax.adamw(lr, weight_decay=c.weight_decay)
+        return optax.adam(lr)
+
+    # ------------------------------------------------------------------ init
+
+    def init_state(self, sample_x: np.ndarray) -> TrainState:
+        rng = jax.random.PRNGKey(self.config.seed)
+        p_rng, s_rng = jax.random.split(rng)
+        params = self.model.init(p_rng, jnp.asarray(sample_x))["params"]
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=self.tx.init(params),
+            rng=s_rng,
+        )
+        return shard_state(state, self.mesh)
+
+    # ------------------------------------------------------------------ steps
+
+    def _train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
+        x, y = batch
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        x = x.astype(self.config.compute_dtype)
+
+        def loss_of(params):
+            logits = self.apply_fn(params, x, step_rng, True)
+            return self.loss_fn(logits.astype(jnp.float32), y), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_of, has_aux=True)(state.params)
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        acc = (jnp.argmax(logits, -1) == y).mean()
+        new_state = state.replace(
+            step=state.step + 1, params=params, opt_state=opt_state
+        )
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    def _eval_step(self, state: TrainState, batch) -> dict:
+        x, y, w = batch  # w: validity mask for padded tail batches
+        logits = self.apply_fn(
+            state.params, x.astype(self.config.compute_dtype), state.rng, False
+        )
+        logits = logits.astype(jnp.float32)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return {
+            "loss_sum": (per_ex * w).sum(),
+            "correct": ((jnp.argmax(logits, -1) == y) * w).sum(),
+            "count": w.sum(),
+        }
+
+    def train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
+        return self._jit_train_step(state, shard_batch(batch, self.mesh))
+
+    # ------------------------------------------------------------------- fit
+
+    def fit(
+        self,
+        dataset: Dataset,
+        *,
+        resume: bool = True,
+        on_epoch_end: Callable[[int, dict], None] | None = None,
+    ) -> tuple[TrainState, dict]:
+        c = self.config
+        state = self.init_state(dataset.x_train[: c.batch_size])
+
+        start_step = 0
+        if resume and self.checkpointer is not None:
+            restored = self.checkpointer.restore_latest(state)
+            if restored is not None:
+                start_step, state = restored
+                metrics_lib.emit(step=start_step, resumed=1)
+
+        per_epoch = len(dataset.x_train) // c.batch_size
+        total_steps = c.steps if c.steps is not None else c.epochs * per_epoch
+        timer = metrics_lib.Timer()
+        global_step = start_step
+        last = {}
+
+        epoch = global_step // max(per_epoch, 1)
+        while global_step < total_steps:
+            for bx, by in batches(
+                dataset.x_train, dataset.y_train, c.batch_size, seed=c.seed + epoch
+            ):
+                if global_step >= total_steps:
+                    break
+                state, m = self.train_step(state, (bx, by))
+                global_step += 1
+                timer.tick(items=len(bx))
+                if global_step % c.log_every_steps == 0 or global_step == total_steps:
+                    last = {k: float(v) for k, v in m.items()}
+                    metrics_lib.emit(
+                        step=global_step,
+                        **last,
+                        images_per_sec=timer.items_per_sec,
+                        steps_per_sec=timer.steps_per_sec,
+                    )
+                if (
+                    self.checkpointer is not None
+                    and global_step % c.checkpoint_every_steps == 0
+                ):
+                    self.checkpointer.save(global_step, state)
+            epoch += 1
+            if epoch % c.eval_every_epochs == 0:
+                ev = self.evaluate(state, dataset)
+                metrics_lib.emit(step=global_step, **{f"eval_{k}": v for k, v in ev.items()})
+                last.update({f"eval_{k}": v for k, v in ev.items()})
+                if on_epoch_end is not None:
+                    on_epoch_end(epoch, ev)
+
+        if self.checkpointer is not None:
+            self.checkpointer.save(global_step, state)
+            self.checkpointer.wait()
+        final_eval = self.evaluate(state, dataset)
+        metrics_lib.emit(step=global_step, **{f"final_{k}": v for k, v in final_eval.items()})
+        return state, {**last, **{f"final_{k}": v for k, v in final_eval.items()}}
+
+    # ------------------------------------------------------------------ eval
+
+    def evaluate(self, state: TrainState, dataset: Dataset) -> dict[str, float]:
+        c = self.config
+        bs = min(c.batch_size, len(dataset.x_test))
+        # round bs down to a multiple of the batch-sharding divisor
+        div = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        bs = max(div, (bs // div) * div)
+        tot_loss, correct, count = 0.0, 0, 0
+        # tail batch is zero-padded to the static shape and masked, keeping
+        # one compiled shape while covering every test example
+        for bx, by in batches(
+            dataset.x_test, dataset.y_test, bs, drop_remainder=False
+        ):
+            n = len(bx)
+            if n < bs:
+                pad = bs - n
+                bx = np.concatenate([bx, np.zeros((pad, *bx.shape[1:]), bx.dtype)])
+                by = np.concatenate([by, np.zeros((pad,), by.dtype)])
+            w = (np.arange(bs) < n).astype(np.float32)
+            m = self._jit_eval_step(state, shard_batch((bx, by, w), self.mesh))
+            tot_loss += float(m["loss_sum"])
+            correct += int(m["correct"])
+            count += int(m["count"])
+        return {
+            "loss": tot_loss / max(count, 1),
+            "accuracy": correct / max(count, 1),
+        }

@@ -1,0 +1,5 @@
+"""Shared utilities: device selection, logging, timing."""
+
+from kubeflow_tpu.utils.device import select_device
+
+__all__ = ["select_device"]

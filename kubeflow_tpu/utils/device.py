@@ -1,0 +1,42 @@
+"""Device selection — the north star's "select device via a single flag".
+
+The reference platform selects hardware by pod resource requests
+(`nvidia.com/gpu`, `google.com/tpu`); here a single `--device=tpu|cpu` flag
+picks the JAX platform. Must be called before any jax import touches a
+backend, hence the env-var approach.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def select_device(device: str) -> str:
+    """Pin the JAX platform. Call before the first jax array op.
+
+    device: "tpu" | "cpu" | "auto". Returns the platform string chosen.
+    """
+    if device == "auto":
+        return os.environ.get("JAX_PLATFORMS", "") or "auto"
+    if device not in ("tpu", "cpu"):
+        raise ValueError(f"unknown device {device!r}; expected tpu|cpu|auto")
+
+    platform = device
+    if device == "tpu":
+        # TPU may be served by an out-of-tree PJRT plugin under another
+        # platform name (e.g. "axon" in this environment); respect it.
+        env = os.environ.get("JAX_PLATFORMS", "")
+        for cand in env.split(","):
+            if cand and cand != "cpu":
+                platform = cand
+                break
+
+    import jax  # local import: reading jax.config is safe pre-backend
+
+    if jax.config.jax_platforms != platform:
+        try:
+            jax.config.update("jax_platforms", platform)
+        except RuntimeError:
+            # backend already initialized; env var is the only lever left
+            os.environ["JAX_PLATFORMS"] = platform
+    return platform

@@ -108,6 +108,20 @@ class TestPyTorchEnv:
         assert envcontract.pytorch_env(job, REPLICA_WORKER, 0)["RANK"] == "1"
         assert envcontract.pytorch_env(job, REPLICA_WORKER, 2)["RANK"] == "3"
 
+    def test_zero_replica_master_treated_as_absent(self):
+        job = _job(PyTorchJob, "pt", {REPLICA_MASTER: 0, REPLICA_WORKER: 2})
+        env = envcontract.pytorch_env(job, REPLICA_WORKER, 1)
+        assert env["MASTER_ADDR"] == "pt-worker-0.pt.default"
+        assert env["RANK"] == "1"  # never >= WORLD_SIZE
+
+    def test_container_port_overrides_default(self):
+        job = _job(PyTorchJob, "pt", {REPLICA_MASTER: 1, REPLICA_WORKER: 1})
+        job.spec.replica_specs[REPLICA_MASTER].template.container.ports = {
+            "pytorchjob-port": 3333
+        }
+        env = envcontract.pytorch_env(job, REPLICA_WORKER, 0)
+        assert env["MASTER_PORT"] == "3333"
+
     def test_worker_rank_without_master(self):
         job = _job(PyTorchJob, "pt", {REPLICA_WORKER: 4})
         env = envcontract.pytorch_env(job, REPLICA_WORKER, 0)

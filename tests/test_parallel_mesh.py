@@ -1,0 +1,68 @@
+"""Mesh + sharding unit tests on the 8-device virtual CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, MeshConfig, build_mesh
+from kubeflow_tpu.parallel.sharding import (
+    batch_sharding,
+    fsdp_param_pspec,
+    param_shardings,
+    shard_batch,
+)
+
+
+class TestBuildMesh:
+    def test_default_all_data(self):
+        mesh = build_mesh()
+        assert mesh.shape[AXIS_DATA] == 8
+        assert mesh.shape[AXIS_MODEL] == 1
+
+    def test_wildcard_fills_remaining(self):
+        mesh = build_mesh(MeshConfig(data=-1, model=2))
+        assert mesh.shape[AXIS_DATA] == 4
+        assert mesh.shape[AXIS_MODEL] == 2
+
+    def test_explicit_sizes_must_multiply_out(self):
+        with pytest.raises(ValueError, match="devices"):
+            build_mesh(MeshConfig(data=3, model=2))
+
+    def test_two_wildcards_rejected(self):
+        with pytest.raises(ValueError, match="-1"):
+            build_mesh(MeshConfig(data=-1, fsdp=-1))
+
+    def test_all_axes_present(self):
+        mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2))
+        assert set(mesh.axis_names) == {
+            "data", "fsdp", "model", "context", "pipeline", "expert",
+        }
+
+
+class TestSharding:
+    def test_batch_spread_over_devices(self):
+        mesh = build_mesh(MeshConfig(data=4, fsdp=2))
+        x = np.zeros((32, 10), np.float32)
+        xs = shard_batch(x, mesh)
+        # batch dim split 8 ways -> each shard holds 4 rows
+        shard = xs.addressable_shards[0]
+        assert shard.data.shape == (4, 10)
+
+    def test_fsdp_pspec_prefers_largest_divisible_dim(self):
+        assert fsdp_param_pspec((784, 512), 8) == P(AXIS_FSDP, None)
+        assert fsdp_param_pspec((512, 100), 8) == P(AXIS_FSDP, None)
+        assert fsdp_param_pspec((100, 512), 8) == P(None, AXIS_FSDP)
+
+    def test_small_params_replicated(self):
+        assert fsdp_param_pspec((128,), 8) == P()
+
+    def test_indivisible_replicated(self):
+        assert fsdp_param_pspec((63, 65), 8, min_size=1) == P()
+
+    def test_param_shardings_tree(self):
+        mesh = build_mesh(MeshConfig(data=1, fsdp=8))
+        params = {"w": np.zeros((1024, 256)), "b": np.zeros((256,))}
+        sh = param_shardings(params, mesh)
+        assert sh["w"].spec == P(AXIS_FSDP, None)
+        assert sh["b"].spec == P()

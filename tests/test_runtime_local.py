@@ -1,0 +1,97 @@
+"""LocalRunner tests: real subprocesses, env-contract delivery, verdicts."""
+
+import sys
+import textwrap
+
+import pytest
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    JAXJob,
+    JAXJobSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RunPolicy,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.runtime import LocalRunner
+
+
+def script_job(tmp_path, name, body, replicas=2, **spec_kw):
+    path = tmp_path / f"{name}.py"
+    path.write_text(textwrap.dedent(body))
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        spec=JAXJobSpec(
+            replica_specs={
+                REPLICA_WORKER: ReplicaSpec(
+                    replicas=replicas,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(command=[sys.executable, str(path)])
+                    ),
+                )
+            },
+            **spec_kw,
+        ),
+    )
+
+
+def test_env_contract_delivered(tmp_path):
+    job = script_job(
+        tmp_path,
+        "envcheck",
+        """
+        import os, sys
+        assert os.environ["JAX_NUM_PROCESSES"] == "2"
+        pid = int(os.environ["JAX_PROCESS_ID"])
+        coord = os.environ["JAX_COORDINATOR_ADDRESS"]
+        assert coord.startswith("127.0.0.1:"), coord  # rewritten for local run
+        print(f"proc={pid} ok=1")
+        """,
+    )
+    res = LocalRunner(log_dir=str(tmp_path / "logs")).run(job, timeout=60)
+    assert res.succeeded
+    assert job.status.is_succeeded
+    assert "proc=0 ok=1" in res.logs(REPLICA_WORKER, 0)
+    assert "proc=1 ok=1" in res.logs(REPLICA_WORKER, 1)
+
+
+def test_failing_worker_fails_job(tmp_path):
+    job = script_job(
+        tmp_path,
+        "failjob",
+        """
+        import os, sys
+        sys.exit(3 if os.environ["JAX_PROCESS_ID"] == "1" else 0)
+        """,
+    )
+    res = LocalRunner(log_dir=str(tmp_path / "logs")).run(job, timeout=60)
+    assert not res.succeeded
+    assert job.status.is_failed
+    codes = {(r.rtype, r.index): r.exit_code for r in res.replicas}
+    assert codes[(REPLICA_WORKER, 1)] == 3
+
+
+def test_active_deadline_kills_job(tmp_path):
+    job = script_job(
+        tmp_path,
+        "hangjob",
+        """
+        import time
+        time.sleep(300)
+        """,
+        replicas=1,
+        run_policy=RunPolicy(active_deadline_seconds=2),
+    )
+    res = LocalRunner(log_dir=str(tmp_path / "logs")).run(job)
+    assert not res.succeeded
+    assert res.replicas[0].exit_code != 0
+    assert res.replicas[0].duration_s < 30
+
+
+def test_no_command_rejected(tmp_path):
+    job = script_job(tmp_path, "nocmd", "pass", replicas=1)
+    job.spec.replica_specs[REPLICA_WORKER].template.container.command = []
+    with pytest.raises(ValueError, match="no command"):
+        LocalRunner(log_dir=str(tmp_path / "logs")).run(job)
