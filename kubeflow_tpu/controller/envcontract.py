@@ -49,22 +49,20 @@ def _has(job: TrainJob, rtype: str) -> bool:
 
 
 def job_port(job: TrainJob, rtype: str | None = None) -> int:
-    """Rendezvous port: a user-declared container port wins over the
-    per-framework default (the reference controllers read the named container
-    port for TF_CONFIG/MASTER_PORT)."""
-    for t, rs in job.spec.replica_specs.items():
-        if rtype is not None and t != rtype:
-            continue
-        ports = rs.template.container.ports
-        if ports:
-            return next(iter(ports.values()))
+    """Rendezvous port for one replica group: that group's own declared
+    container port wins over the per-framework default (the reference
+    controllers read each replica's named container port)."""
+    if rtype is not None:
+        rs = job.spec.replica_specs.get(rtype)
+        if rs is not None and rs.template.container.ports:
+            return next(iter(rs.template.container.ports.values()))
     return DEFAULT_PORTS[job.kind]
 
 
 def replica_addresses(job: TrainJob, rtype: str, port: int | None = None) -> list[str]:
     """host:port list for one replica group — the headless-Service DNS contract."""
     if port is None:
-        port = job_port(job)
+        port = job_port(job, rtype)
     rs = job.spec.replica_specs.get(rtype)
     if rs is None:
         return []
@@ -107,9 +105,8 @@ def jax_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
 # ---------------------------------------------------------------------- TFJob
 
 def tf_config(job: TrainJob, rtype: str, index: int, port: int | None = None) -> str:
-    """TF_CONFIG JSON for one replica (SetClusterSpec parity)."""
-    if port is None:
-        port = job_port(job)
+    """TF_CONFIG JSON for one replica (SetClusterSpec parity). Each role's
+    addresses carry that role's own port."""
     cluster: dict[str, list[str]] = {}
     for role in _TF_ROLE_ORDER:
         addrs = replica_addresses(job, role, port)
@@ -135,13 +132,10 @@ def pytorch_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
     Rank convention mirrors envvar.go: master is rank 0; worker i is rank i+1
     when a master replica exists, else rank i.
     """
-    port = job_port(job)
     has_master = _has(job, REPLICA_MASTER)
-    master_host = (
-        job.replica_hostname(REPLICA_MASTER, 0)
-        if has_master
-        else job.replica_hostname(REPLICA_WORKER, 0)
-    )
+    master_rtype = REPLICA_MASTER if has_master else REPLICA_WORKER
+    port = job_port(job, master_rtype)
+    master_host = job.replica_hostname(master_rtype, 0)
     world = job.total_replicas()
     if rtype == REPLICA_MASTER:
         rank = 0
@@ -199,13 +193,10 @@ def mpi_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
 
 def xgboost_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
     """Rabit tracker env (DMLC_* family)."""
-    port = job_port(job)
     has_master = _has(job, REPLICA_MASTER)
-    master_host = (
-        job.replica_hostname(REPLICA_MASTER, 0)
-        if has_master
-        else job.replica_hostname(REPLICA_WORKER, 0)
-    )
+    master_rtype = REPLICA_MASTER if has_master else REPLICA_WORKER
+    port = job_port(job, master_rtype)
+    master_host = job.replica_hostname(master_rtype, 0)
     workers = job.spec.replica_specs.get(REPLICA_WORKER)
     n_workers = workers.replicas if workers else 0
     if rtype == REPLICA_MASTER:
@@ -226,9 +217,8 @@ def xgboost_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
 
 
 def paddle_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
-    port = job_port(job)
-    all_eps = replica_addresses(job, REPLICA_MASTER, port) + replica_addresses(
-        job, REPLICA_WORKER, port
+    all_eps = replica_addresses(job, REPLICA_MASTER) + replica_addresses(
+        job, REPLICA_WORKER
     )
     rank = 0 if rtype == REPLICA_MASTER else index + (
         1 if _has(job, REPLICA_MASTER) else 0

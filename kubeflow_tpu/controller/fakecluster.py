@@ -1,0 +1,193 @@
+"""FakeCluster — the in-process apiserver/etcd analogue.
+
+Reference parity: controller-runtime's envtest (real apiserver, no kubelet)
++ client-go fake clients (SURVEY.md §4). Here: a versioned object store with
+watch streams. Pods ARE eventually executed — by the PodRuntime (podruntime
+.py), which is more than envtest does — so e2e tests run real processes.
+
+Objects are plain dataclasses; keys are "ns/name". Watch events are
+(event_type, kind, obj) tuples delivered to subscriber queues.
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from kubeflow_tpu.api.common import ObjectMeta
+
+
+class EventType(str, enum.Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    exit_code: int | None = None
+    node: str = ""          # set by a scheduler => "bound"
+    pid: int | None = None
+    message: str = ""
+    start_time: float | None = None
+    finish_time: float | None = None
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    command: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    working_dir: str = ""
+    scheduler_name: str = "default"
+    group_name: str = ""    # PodGroup membership (gang annotation analogue)
+    restart_policy: str = "Never"  # pod-level: runtime never restarts; the
+    # controller owns restart semantics (matches operator behavior)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class PodGroup:
+    """Gang-scheduling unit (volcano PodGroup analogue)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_member: int = 1
+    queue: str = "default"
+    # TPU slice topology this gang occupies (atomic unit, SURVEY.md §2.2)
+    slice_topology: str = ""
+    phase: str = "Pending"  # Pending -> Running once gang-bound
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class ClusterEvent:
+    """k8s Event analogue (observability, SURVEY.md §5.5)."""
+
+    object_key: str
+    kind: str
+    reason: str
+    message: str
+    type: str = "Normal"
+    timestamp: float = field(default_factory=time.time)
+
+
+class FakeCluster:
+    """Thread-safe object store + watch hub."""
+
+    KINDS = ("jobs", "pods", "podgroups", "experiments", "trials", "inferenceservices")
+
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._objects: dict[str, dict[str, Any]] = {k: {} for k in self.KINDS}
+        self._watchers: list[queue.Queue] = []
+        self._rv = 0
+        self.events: list[ClusterEvent] = []
+        self.capacity_chips = 8  # schedulable "chips" for the gang scheduler
+
+    # ------------------------------------------------------------------ CRUD
+
+    def create(self, kind: str, obj: Any) -> Any:
+        with self._mu:
+            key = self._key(obj)
+            if key in self._objects[kind]:
+                raise KeyError(f"{kind} {key} already exists")
+            if not obj.metadata.uid:
+                self._rv += 1
+                obj.metadata.uid = f"uid-{self._rv}"
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = _ts()
+            self._objects[kind][key] = obj
+            self._notify(EventType.ADDED, kind, obj)
+            return obj
+
+    def update(self, kind: str, obj: Any) -> Any:
+        with self._mu:
+            key = self._key(obj)
+            if key not in self._objects[kind]:
+                raise KeyError(f"{kind} {key} not found")
+            self._objects[kind][key] = obj
+            self._notify(EventType.MODIFIED, kind, obj)
+            return obj
+
+    def delete(self, kind: str, key: str) -> Any | None:
+        with self._mu:
+            obj = self._objects[kind].pop(key, None)
+            if obj is not None:
+                self._notify(EventType.DELETED, kind, obj)
+            return obj
+
+    def get(self, kind: str, key: str) -> Any | None:
+        with self._mu:
+            return self._objects[kind].get(key)
+
+    def list(
+        self, kind: str, selector: Callable[[Any], bool] | None = None
+    ) -> list[Any]:
+        with self._mu:
+            objs = list(self._objects[kind].values())
+        return [o for o in objs if selector is None or selector(o)]
+
+    # ----------------------------------------------------------------- watch
+
+    def watch(self, replay: bool = True) -> queue.Queue:
+        """Subscribe to all events; optionally replay current objects as
+        ADDED (informer initial list+watch semantics)."""
+        q: queue.Queue = queue.Queue()
+        with self._mu:
+            if replay:
+                for kind in self.KINDS:
+                    for obj in self._objects[kind].values():
+                        q.put((EventType.ADDED, kind, obj))
+            self._watchers.append(q)
+        return q
+
+    def unwatch(self, q: queue.Queue) -> None:
+        with self._mu:
+            if q in self._watchers:
+                self._watchers.remove(q)
+
+    def _notify(self, etype: EventType, kind: str, obj: Any) -> None:
+        for q in self._watchers:
+            q.put((etype, kind, obj))
+
+    # ---------------------------------------------------------------- events
+
+    def record_event(
+        self, kind: str, key: str, reason: str, message: str, type: str = "Normal"
+    ) -> None:
+        with self._mu:
+            self.events.append(ClusterEvent(key, kind, reason, message, type))
+
+    def events_for(self, key: str) -> list[ClusterEvent]:
+        with self._mu:
+            return [e for e in self.events if e.object_key == key]
+
+    @staticmethod
+    def _key(obj: Any) -> str:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def _ts() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")

@@ -1,0 +1,112 @@
+"""Gang scheduler — the volcano / scheduler-plugins analogue.
+
+All-or-nothing binding: a PodGroup's pods bind only when (a) at least
+min_member of them are pending and (b) the cluster has capacity for the
+whole gang. On TPU the gang maps to a slice: slice_topology gives the chip
+count, and a gang occupies whole slices (SURVEY.md §2.2 gang semantics).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+from kubeflow_tpu.controller.fakecluster import (
+    EventType,
+    FakeCluster,
+    Pod,
+    PodGroup,
+    PodPhase,
+)
+
+
+def topology_chips(topology: str) -> int:
+    """'2x4' -> 8 chips; empty -> 1 chip per pod."""
+    if not topology:
+        return 0
+    return math.prod(int(d) for d in topology.split("x"))
+
+
+class GangScheduler:
+    def __init__(self, cluster: FakeCluster):
+        self.cluster = cluster
+        self._stop = threading.Event()
+        self._mu = threading.Lock()
+        self._bound_chips: dict[str, int] = {}  # group key -> chips held
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._loop, name="gang-scheduler", daemon=True)
+        t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------------------ loop
+
+    def _loop(self) -> None:
+        q = self.cluster.watch()
+        while not self._stop.is_set():
+            try:
+                etype, kind, obj = q.get(timeout=0.5)
+            except Exception:
+                # periodic retry: a gang may fit now that capacity freed up
+                self._try_schedule()
+                continue
+            if kind == "podgroups" and etype == EventType.DELETED:
+                with self._mu:
+                    self._bound_chips.pop(obj.key, None)
+            if kind in ("pods", "podgroups"):
+                self._try_schedule()
+
+    def _try_schedule(self) -> None:
+        with self._mu:
+            groups = self.cluster.list("podgroups")
+            for pg in groups:
+                if pg.phase == "Running":
+                    # release capacity when the gang has fully exited
+                    members = self._members(pg)
+                    if members and all(
+                        p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+                        for p in members
+                    ):
+                        pass  # capacity released on podgroup delete
+                    continue
+                members = self._members(pg)
+                pending = [
+                    p for p in members
+                    if p.status.phase == PodPhase.PENDING and not p.status.node
+                ]
+                if len(pending) < pg.min_member:
+                    continue
+                chips_needed = topology_chips(pg.slice_topology) or len(pending)
+                used = sum(self._bound_chips.values())
+                if used + chips_needed > self.cluster.capacity_chips:
+                    self.cluster.record_event(
+                        "podgroups", pg.key, "Unschedulable",
+                        f"gang needs {chips_needed} chips, "
+                        f"{self.cluster.capacity_chips - used} free",
+                        type="Warning",
+                    )
+                    continue
+                # all-or-nothing bind
+                for i, p in enumerate(pending):
+                    p.status.node = f"slice-0-host-{i}"
+                    self.cluster.update("pods", p)
+                self._bound_chips[pg.key] = chips_needed
+                pg.phase = "Running"
+                self.cluster.update("podgroups", pg)
+                self.cluster.record_event(
+                    "podgroups", pg.key, "Scheduled",
+                    f"gang of {len(pending)} bound ({chips_needed} chips)",
+                )
+
+    def _members(self, pg: PodGroup) -> list[Pod]:
+        return self.cluster.list(
+            "pods",
+            lambda p: p.group_name == pg.metadata.name
+            and p.metadata.namespace == pg.metadata.namespace,
+        )
+
+    def release(self, group_key: str) -> None:
+        with self._mu:
+            self._bound_chips.pop(group_key, None)

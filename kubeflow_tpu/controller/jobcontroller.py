@@ -1,0 +1,437 @@
+"""JobController — the generic gang reconciler for every job kind.
+
+Reference parity (unverified cites, SURVEY.md §2.1): the common JobController
+(pkg/controller.v1/common/{job_controller.go, job.go#ReconcileJobs,
+pod.go#ReconcilePods, expectation.go}) that TFJob/PyTorchJob/... reconcilers
+share. Level-triggered: watch events only enqueue keys; reconcile() computes
+desired state from scratch each pass. The hot bookkeeping (work queue with
+per-key backoff, expectations) is the native C++ core.
+
+TPU gang semantics: a non-elastic SPMD gang cannot lose a process — any
+worker failure triggers a whole-gang restart from checkpoint (bounded by
+runPolicy.backoffLimit), not a single-pod restart (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubeflow_tpu.api.common import (
+    CleanPodPolicy,
+    JobConditionType,
+    ReplicaStatus,
+    RestartPolicy,
+    is_retryable_exit_code,
+)
+from kubeflow_tpu.api.jobs import SUCCESS_REPLICA, JobKind, TrainJob, REPLICA_WORKER
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.controller.envcontract import synthesize_env
+from kubeflow_tpu.controller.fakecluster import (
+    EventType,
+    FakeCluster,
+    Pod,
+    PodGroup,
+    PodPhase,
+)
+from kubeflow_tpu.native import Expectations, WorkQueue
+from kubeflow_tpu.runtime.rendezvous import LocalResolver
+
+JOB_NAME_LABEL = "kubeflow-tpu.org/job-name"
+REPLICA_TYPE_LABEL = "kubeflow-tpu.org/replica-type"
+REPLICA_INDEX_LABEL = "kubeflow-tpu.org/replica-index"
+
+
+class JobController:
+    """Reconciles every job in the cluster. Start one per process."""
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        workers: int = 1,
+        resync_period_s: float = 5.0,
+        local_rewrite: bool = True,
+    ):
+        self.cluster = cluster
+        self.wq = WorkQueue(base_delay_s=0.005, max_delay_s=10.0)
+        self.exp = Expectations(ttl_s=30.0)
+        self.local_rewrite = local_rewrite
+        self.resync_period_s = resync_period_s
+        self._resolvers: dict[str, LocalResolver] = {}
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._n_workers = workers
+        # prometheus-style counters (SURVEY.md §5.5)
+        self.metrics = {
+            "reconcile_total": 0,
+            "reconcile_errors_total": 0,
+            "jobs_created_total": 0,
+            "jobs_succeeded_total": 0,
+            "jobs_failed_total": 0,
+            "jobs_restarted_total": 0,
+            "pods_created_total": 0,
+            "pods_deleted_total": 0,
+        }
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._watch_loop, name="job-informer", daemon=True)
+        t.start()
+        self._threads.append(t)
+        for i in range(self._n_workers):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"job-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._resync_loop, name="job-resync", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.wq.shutdown()
+
+    # -------------------------------------------------------------- informer
+
+    def _watch_loop(self) -> None:
+        q = self.cluster.watch()
+        while not self._stop.is_set():
+            try:
+                etype, kind, obj = q.get(timeout=0.2)
+            except Exception:
+                continue
+            if kind == "jobs":
+                self.wq.add(self.cluster._key(obj))
+            elif kind == "pods":
+                job_name = obj.metadata.labels.get(JOB_NAME_LABEL)
+                if not job_name:
+                    continue
+                key = f"{obj.metadata.namespace}/{job_name}"
+                if etype == EventType.ADDED:
+                    self.exp.creation_observed(key)
+                elif etype == EventType.DELETED:
+                    self.exp.deletion_observed(key)
+                self.wq.add(key)
+
+    def _resync_loop(self) -> None:
+        """Periodic full resync (informer resync analogue): catches anything
+        a lost watch event would otherwise strand."""
+        while not self._stop.wait(self.resync_period_s):
+            for job in self.cluster.list("jobs"):
+                self.wq.add(self.cluster._key(job))
+
+    def _worker_loop(self) -> None:
+        while True:
+            key = self.wq.get(timeout_s=0.5)
+            if key is None:
+                if self.wq.shutting_down:
+                    return
+                continue
+            try:
+                self.metrics["reconcile_total"] += 1
+                requeue_after = self.reconcile(key)
+                self.wq.forget(key)
+                if requeue_after is not None:
+                    self.wq.add_after(key, requeue_after)
+            except Exception as exc:  # noqa: BLE001 — reconcile must not die
+                self.metrics["reconcile_errors_total"] += 1
+                self.cluster.record_event(
+                    "jobs", key, "ReconcileError", str(exc), type="Warning"
+                )
+                self.wq.add_rate_limited(key)
+            finally:
+                self.wq.done(key)
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, key: str) -> float | None:
+        """One level-triggered pass. Returns optional requeue delay."""
+        job: TrainJob | None = self.cluster.get("jobs", key)
+        if job is None:
+            self.exp.delete(key)
+            self.wq.forget(key)
+            self._resolvers.pop(key, None)
+            return None
+
+        st = job.status
+        if not st.conditions:
+            st.set_condition(JobConditionType.CREATED, "JobCreated")
+            self.metrics["jobs_created_total"] += 1
+            self.cluster.record_event("jobs", key, "JobCreated", "created")
+
+        pods = self._owned_pods(job)
+
+        # -- terminal state: cleanup, TTL
+        if st.is_finished:
+            return self._cleanup_finished(job, key, pods)
+
+        # -- suspension (runPolicy.suspend)
+        if job.spec.run_policy.suspend:
+            self._delete_pods(key, pods)
+            self._delete_podgroup(job)
+            st.set_condition(JobConditionType.SUSPENDED, "JobSuspended")
+            self.cluster.update("jobs", job)
+            return None
+        if st.has_condition(JobConditionType.SUSPENDED):
+            st.set_condition(JobConditionType.RESTARTING, "JobResumed")
+            self.cluster.update("jobs", job)
+
+        # -- active deadline
+        rp = job.spec.run_policy
+        if rp.active_deadline_seconds and st.start_time:
+            age = time.time() - _parse_ts(st.start_time)
+            if age > rp.active_deadline_seconds:
+                self._fail(job, key, pods, "DeadlineExceeded",
+                           f"active for {age:.0f}s > {rp.active_deadline_seconds}s")
+                return None
+
+        # -- stale-cache guard: wait out pending create/deletes
+        if not self.exp.satisfied(key):
+            return 0.05
+
+        # -- failure handling (gang semantics)
+        failed = [p for p in pods if p.status.phase == PodPhase.FAILED]
+        if failed:
+            return self._handle_failures(job, key, pods, failed)
+
+        # -- success detection
+        if self._is_succeeded(job, pods):
+            st.set_condition(JobConditionType.SUCCEEDED, "JobSucceeded")
+            st.completion_time = _now_ts()
+            self.metrics["jobs_succeeded_total"] += 1
+            self.cluster.record_event("jobs", key, "JobSucceeded", "completed")
+            self._update_replica_statuses(job, pods)
+            self.cluster.update("jobs", job)
+            return 0.0  # immediate cleanup pass
+
+    # -- pod/podgroup creation
+        created = self._reconcile_pods(job, key, pods)
+
+        if st.start_time is None:
+            st.start_time = _now_ts()
+        running = [p for p in pods if p.status.phase == PodPhase.RUNNING]
+        if running and len(running) == job.total_replicas():
+            if not st.has_condition(JobConditionType.RUNNING):
+                st.set_condition(JobConditionType.RUNNING, "JobRunning")
+                self.cluster.record_event("jobs", key, "JobRunning", "all replicas running")
+        self._update_replica_statuses(job, pods)
+        self.cluster.update("jobs", job)
+        return 0.2 if created else None
+
+    # ---------------------------------------------------------- sub-steps
+
+    def _owned_pods(self, job: TrainJob) -> list[Pod]:
+        return self.cluster.list(
+            "pods",
+            lambda p: p.metadata.labels.get(JOB_NAME_LABEL) == job.metadata.name
+            and p.metadata.namespace == job.metadata.namespace,
+        )
+
+    def _reconcile_pods(self, job: TrainJob, key: str, pods: list[Pod]) -> int:
+        existing = {
+            (
+                p.metadata.labels.get(REPLICA_TYPE_LABEL),
+                int(p.metadata.labels.get(REPLICA_INDEX_LABEL, -1)),
+            )
+            for p in pods
+        }
+        to_create: list[tuple[str, int]] = []
+        for rtype, rs in job.spec.replica_specs.items():
+            for i in range(rs.replicas):
+                if (rtype, i) not in existing:
+                    to_create.append((rtype, i))
+        if not to_create:
+            return 0
+
+        self._ensure_podgroup(job)
+        resolver = self._resolvers.setdefault(key, LocalResolver(job))
+        self.exp.expect_creations(key, len(to_create))
+        for rtype, i in to_create:
+            env = synthesize_env(job, rtype, i)
+            if self.local_rewrite:
+                env = resolver.rewrite_env(env)
+            c = job.spec.replica_specs[rtype].template.container
+            pod = Pod(
+                metadata=ObjectMeta(
+                    name=job.replica_name(rtype, i),
+                    namespace=job.metadata.namespace,
+                    labels=job.labels(rtype, i),
+                ),
+                command=list(c.command) + list(c.args),
+                env=env,
+                working_dir=c.working_dir,
+                scheduler_name=job.spec.replica_specs[rtype].template.scheduler_name,
+                group_name=job.metadata.name,
+            )
+            self.cluster.create("pods", pod)
+            self.metrics["pods_created_total"] += 1
+        return len(to_create)
+
+    def _ensure_podgroup(self, job: TrainJob) -> None:
+        pg_key = f"{job.metadata.namespace}/{job.metadata.name}"
+        if self.cluster.get("podgroups", pg_key) is not None:
+            return
+        sp = job.spec.run_policy.scheduling_policy
+        pg = PodGroup(
+            metadata=ObjectMeta(
+                name=job.metadata.name, namespace=job.metadata.namespace
+            ),
+            min_member=(sp.min_available if sp and sp.min_available else job.total_replicas()),
+            queue=sp.queue if sp else "default",
+            slice_topology=sp.slice_topology if sp else "",
+        )
+        self.cluster.create("podgroups", pg)
+
+    def _handle_failures(
+        self, job: TrainJob, key: str, pods: list[Pod], failed: list[Pod]
+    ) -> float | None:
+        st = job.status
+        rp = job.spec.run_policy
+        # Decide retryability from each failed pod's replica restart policy.
+        retryable = True
+        for p in failed:
+            rtype = p.metadata.labels.get(REPLICA_TYPE_LABEL, REPLICA_WORKER)
+            rs = job.spec.replica_specs.get(rtype)
+            policy = rs.restart_policy if rs else RestartPolicy.NEVER
+            if policy == RestartPolicy.NEVER:
+                retryable = False
+            elif policy == RestartPolicy.EXIT_CODE:
+                if not is_retryable_exit_code(p.status.exit_code or 1):
+                    retryable = False
+        if not retryable or st.restart_count >= rp.backoff_limit:
+            reason = (
+                "BackoffLimitExceeded"
+                if retryable
+                else "NonRetryableExit"
+            )
+            self._fail(job, key, pods,
+                       reason,
+                       f"{len(failed)} replica(s) failed "
+                       f"(restarts={st.restart_count}/{rp.backoff_limit})")
+            return None
+        # gang restart: tear down ALL pods, restart from checkpoint
+        st.restart_count += 1
+        self.metrics["jobs_restarted_total"] += 1
+        st.set_condition(
+            JobConditionType.RESTARTING,
+            "GangRestart",
+            f"restart {st.restart_count}/{rp.backoff_limit}",
+        )
+        self.cluster.record_event(
+            "jobs", key, "GangRestart",
+            f"worker failure -> gang restart {st.restart_count}",
+            type="Warning",
+        )
+        self._delete_pods(key, pods)
+        self._delete_podgroup(job)
+        self.cluster.update("jobs", job)
+        return 0.05
+
+    def _is_succeeded(self, job: TrainJob, pods: list[Pod]) -> bool:
+        by = {
+            (
+                p.metadata.labels.get(REPLICA_TYPE_LABEL),
+                int(p.metadata.labels.get(REPLICA_INDEX_LABEL, -1)),
+            ): p
+            for p in pods
+        }
+        if job.kind == JobKind.JAX:
+            workers = job.spec.replica_specs.get(REPLICA_WORKER)
+            n = workers.replicas if workers else 0
+            if n == 0:
+                return False
+            return all(
+                (p := by.get((REPLICA_WORKER, i))) is not None
+                and p.status.phase == PodPhase.SUCCEEDED
+                for i in range(n)
+            )
+        success_rtype = SUCCESS_REPLICA[job.kind]
+        if success_rtype not in job.spec.replica_specs:
+            success_rtype = REPLICA_WORKER
+        p = by.get((success_rtype, 0))
+        return p is not None and p.status.phase == PodPhase.SUCCEEDED
+
+    def _cleanup_finished(
+        self, job: TrainJob, key: str, pods: list[Pod]
+    ) -> float | None:
+        policy = job.spec.run_policy.clean_pod_policy
+        if policy == CleanPodPolicy.ALL:
+            doomed = pods
+        elif policy == CleanPodPolicy.RUNNING:
+            doomed = [
+                p for p in pods
+                if p.status.phase in (PodPhase.RUNNING, PodPhase.PENDING)
+            ]
+        else:
+            doomed = []
+        if doomed:
+            self._delete_pods(key, doomed)
+        self._delete_podgroup(job)
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is not None and job.status.completion_time:
+            age = time.time() - _parse_ts(job.status.completion_time)
+            if age >= ttl:
+                self.cluster.delete("jobs", key)
+                return None
+            return ttl - age
+        return None
+
+    def _fail(
+        self, job: TrainJob, key: str, pods: list[Pod], reason: str, msg: str
+    ) -> None:
+        job.status.set_condition(JobConditionType.FAILED, reason, msg)
+        job.status.completion_time = _now_ts()
+        self.metrics["jobs_failed_total"] += 1
+        self.cluster.record_event("jobs", key, reason, msg, type="Warning")
+        self._update_replica_statuses(job, pods)
+        self.cluster.update("jobs", job)
+
+    def _delete_pods(self, key: str, pods: list[Pod]) -> None:
+        if not pods:
+            return
+        self.exp.expect_deletions(key, len(pods))
+        for p in pods:
+            self.cluster.delete("pods", p.key)
+            self.metrics["pods_deleted_total"] += 1
+
+    def _delete_podgroup(self, job: TrainJob) -> None:
+        self.cluster.delete(
+            "podgroups", f"{job.metadata.namespace}/{job.metadata.name}"
+        )
+
+    def _update_replica_statuses(self, job: TrainJob, pods: list[Pod]) -> None:
+        stats: dict[str, ReplicaStatus] = {}
+        for rtype in job.spec.replica_specs:
+            stats[rtype] = ReplicaStatus(
+                selector=f"{JOB_NAME_LABEL}={job.metadata.name},"
+                f"{REPLICA_TYPE_LABEL}={rtype}"
+            )
+        for p in pods:
+            rtype = p.metadata.labels.get(REPLICA_TYPE_LABEL)
+            if rtype not in stats:
+                continue
+            ph = p.status.phase
+            if ph in (PodPhase.RUNNING, PodPhase.PENDING):
+                stats[rtype].active += 1
+            elif ph == PodPhase.SUCCEEDED:
+                stats[rtype].succeeded += 1
+            elif ph == PodPhase.FAILED:
+                stats[rtype].failed += 1
+        job.status.replica_statuses = stats
+        job.status.last_reconcile_time = _now_ts()
+
+
+def _now_ts() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _parse_ts(ts: str) -> float:
+    import datetime
+
+    return datetime.datetime.strptime(ts, "%Y-%m-%dT%H:%M:%SZ").replace(
+        tzinfo=datetime.timezone.utc
+    ).timestamp()

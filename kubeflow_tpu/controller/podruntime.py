@@ -1,0 +1,171 @@
+"""PodRuntime — the kubelet analogue: bound pods become real subprocesses.
+
+Also hosts the default (non-gang) scheduler and the fault injector used by
+failure-handling tests (SURVEY.md §5.3: the reference has no built-in fault
+injection; its e2e tests kill pods manually — here it's first-class).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+from kubeflow_tpu.controller.fakecluster import (
+    EventType,
+    FakeCluster,
+    Pod,
+    PodPhase,
+)
+
+
+class PodRuntime:
+    """Watches pods; launches bound ones as subprocesses; reaps exits."""
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        log_dir: str = ".kubeflow_tpu/pod-logs",
+        inherit_env: bool = True,
+        bind_pending_default: bool = True,
+    ):
+        self.cluster = cluster
+        self.log_dir = Path(log_dir)
+        self.inherit_env = inherit_env
+        self.bind_pending_default = bind_pending_default
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # ---------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        t = threading.Thread(target=self._watch_loop, name="pod-runtime", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._mu:
+            procs = [proc for _, proc in self._procs.values()]
+        for p in procs:
+            try:
+                p.kill()
+            except ProcessLookupError:
+                pass
+
+    # ---------------------------------------------------------------- watching
+
+    def _watch_loop(self) -> None:
+        q = self.cluster.watch()
+        while not self._stop.is_set():
+            try:
+                etype, kind, obj = q.get(timeout=0.2)
+            except Exception:
+                continue
+            if kind != "pods":
+                continue
+            pod: Pod = obj
+            if etype == EventType.DELETED:
+                self._kill(pod.key)
+                continue
+            if pod.status.phase == PodPhase.PENDING:
+                if not pod.status.node and (
+                    pod.scheduler_name == "default" and self.bind_pending_default
+                ):
+                    pod.status.node = "local-node"
+                    self.cluster.update("pods", pod)
+                elif pod.status.node:
+                    self._launch(pod)
+
+    # ---------------------------------------------------------------- execution
+
+    def _launch(self, pod: Pod) -> None:
+        with self._mu:
+            held = self._procs.get(pod.key)
+            if held is not None:
+                held_uid, held_proc = held
+                if held_uid == pod.metadata.uid:
+                    return  # already running this incarnation
+                # same name, new incarnation (gang restart): the old process
+                # must die before the new one starts
+                try:
+                    os.killpg(held_proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            log_path = self.log_dir / f"{pod.metadata.name}.log"
+            env = dict(os.environ) if self.inherit_env else {}
+            env.update(pod.env)
+            try:
+                proc = subprocess.Popen(
+                    pod.command,
+                    env=env,
+                    stdout=open(log_path, "wb"),
+                    stderr=subprocess.STDOUT,
+                    cwd=pod.working_dir or None,
+                    start_new_session=True,  # isolate signals per pod
+                )
+            except OSError as exc:
+                pod.status.phase = PodPhase.FAILED
+                pod.status.exit_code = 127
+                pod.status.message = str(exc)
+                self.cluster.update("pods", pod)
+                return
+            self._procs[pod.key] = (pod.metadata.uid, proc)
+        pod.status.phase = PodPhase.RUNNING
+        pod.status.pid = proc.pid
+        pod.status.start_time = time.time()
+        self.cluster.update("pods", pod)
+        threading.Thread(
+            target=self._reap, args=(pod.key, pod.metadata.uid, proc), daemon=True
+        ).start()
+
+    def _reap(self, key: str, uid: str, proc: subprocess.Popen) -> None:
+        code = proc.wait()
+        with self._mu:
+            held = self._procs.get(key)
+            if held is not None and held[1] is proc:
+                self._procs.pop(key, None)
+        pod = self.cluster.get("pods", key)
+        if pod is None or pod.metadata.uid != uid:
+            return  # a newer incarnation owns this name now
+        pod.status.exit_code = code
+        pod.status.finish_time = time.time()
+        pod.status.phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
+        self.cluster.update("pods", pod)
+
+    def _kill(self, key: str) -> None:
+        with self._mu:
+            held = self._procs.pop(key, None)
+        if held is not None:
+            _, proc = held
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    proc.kill()
+                except ProcessLookupError:
+                    pass
+
+    # ---------------------------------------------------------------- faults
+
+    def inject_kill(self, key: str, sig: int = signal.SIGKILL) -> bool:
+        """Fault injector: kill a running pod's process (worker-loss drill)."""
+        with self._mu:
+            held = self._procs.get(key)
+        if held is None:
+            return False
+        _, proc = held
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            proc.send_signal(sig)
+        return True
+
+    def log_path(self, pod_name: str) -> Path:
+        return self.log_dir / f"{pod_name}.log"

@@ -1,0 +1,291 @@
+"""ctypes bindings for the native core (libkfcore.so).
+
+Native components (SURVEY.md §2.8 ledger): work queue + expectations (the
+reference's Go controller machinery) and the metadata store (the reference's
+C++ MLMD server). Built on demand with `make`; sanitizer self-tests run via
+`make check` (ASan/UBSan) and `make tsan`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_DIR = Path(__file__).parent
+_LIB_PATH = _DIR / "build" / "libkfcore.so"
+_BUILD_LOCK = threading.Lock()
+_lib = None
+
+
+def ensure_built() -> Path:
+    """Build libkfcore.so if missing or stale (source newer than lib)."""
+    srcs = sorted((_DIR / "src").glob("*.cc"))
+    stale = not _LIB_PATH.exists() or any(
+        s.stat().st_mtime > _LIB_PATH.stat().st_mtime
+        for s in srcs
+        if s.name != "selftest.cc"
+    )
+    if stale:
+        with _BUILD_LOCK:
+            subprocess.run(
+                ["make", str(_LIB_PATH.relative_to(_DIR))],
+                cwd=_DIR,
+                check=True,
+                capture_output=True,
+            )
+    return _LIB_PATH
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        path = ensure_built()
+        L = ctypes.CDLL(str(path))
+        # workqueue
+        L.kf_wq_new.restype = ctypes.c_void_p
+        L.kf_wq_new.argtypes = [ctypes.c_double, ctypes.c_double]
+        L.kf_wq_free.argtypes = [ctypes.c_void_p]
+        L.kf_wq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_wq_add_after.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
+        L.kf_wq_add_rate_limited.restype = ctypes.c_double
+        L.kf_wq_add_rate_limited.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_wq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_wq_num_requeues.restype = ctypes.c_int
+        L.kf_wq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_wq_get.restype = ctypes.c_void_p  # manual free => void_p not char_p
+        L.kf_wq_get.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        L.kf_wq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_wq_len.restype = ctypes.c_int
+        L.kf_wq_len.argtypes = [ctypes.c_void_p]
+        L.kf_wq_shutdown.argtypes = [ctypes.c_void_p]
+        L.kf_wq_shutting_down.restype = ctypes.c_int
+        L.kf_wq_shutting_down.argtypes = [ctypes.c_void_p]
+        L.kf_free.argtypes = [ctypes.c_void_p]
+        # expectations
+        L.kf_exp_new.restype = ctypes.c_void_p
+        L.kf_exp_new.argtypes = [ctypes.c_double]
+        L.kf_exp_free.argtypes = [ctypes.c_void_p]
+        L.kf_exp_expect_creations.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        L.kf_exp_expect_deletions.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        L.kf_exp_creation_observed.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_exp_deletion_observed.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_exp_satisfied.restype = ctypes.c_int
+        L.kf_exp_satisfied.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_exp_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_exp_counts.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
+        ]
+        # metastore
+        L.kf_ms_open.restype = ctypes.c_void_p
+        L.kf_ms_open.argtypes = [ctypes.c_char_p]
+        L.kf_ms_close.argtypes = [ctypes.c_void_p]
+        L.kf_ms_put_artifact.restype = ctypes.c_longlong
+        L.kf_ms_put_artifact.argtypes = [ctypes.c_void_p, ctypes.c_longlong] + [ctypes.c_char_p] * 4
+        L.kf_ms_put_execution.restype = ctypes.c_longlong
+        L.kf_ms_put_execution.argtypes = [ctypes.c_void_p, ctypes.c_longlong] + [ctypes.c_char_p] * 4
+        L.kf_ms_put_event.restype = ctypes.c_int
+        L.kf_ms_put_event.argtypes = [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong, ctypes.c_int]
+        for fn in ("kf_ms_get_artifact", "kf_ms_get_execution"):
+            getattr(L, fn).restype = ctypes.c_void_p
+            getattr(L, fn).argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        for fn in ("kf_ms_list_artifacts", "kf_ms_list_executions"):
+            getattr(L, fn).restype = ctypes.c_void_p
+            getattr(L, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        L.kf_ms_events.restype = ctypes.c_void_p
+        L.kf_ms_events.argtypes = [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong]
+        _lib = L
+    return _lib
+
+
+def _take_string(ptr: int | None) -> str | None:
+    """Copy a malloc'd C string and free it."""
+    if not ptr:
+        return None
+    L = lib()
+    s = ctypes.string_at(ptr).decode()
+    L.kf_free(ptr)
+    return s
+
+
+class WorkQueue:
+    """Rate-limited delaying work queue (client-go workqueue semantics)."""
+
+    def __init__(self, base_delay_s: float = 0.005, max_delay_s: float = 60.0):
+        self._L = lib()
+        self._h = self._L.kf_wq_new(base_delay_s, max_delay_s)
+
+    def add(self, key: str) -> None:
+        self._L.kf_wq_add(self._h, key.encode())
+
+    def add_after(self, key: str, delay_s: float) -> None:
+        self._L.kf_wq_add_after(self._h, key.encode(), delay_s)
+
+    def add_rate_limited(self, key: str) -> float:
+        return self._L.kf_wq_add_rate_limited(self._h, key.encode())
+
+    def forget(self, key: str) -> None:
+        self._L.kf_wq_forget(self._h, key.encode())
+
+    def num_requeues(self, key: str) -> int:
+        return self._L.kf_wq_num_requeues(self._h, key.encode())
+
+    def get(self, timeout_s: float = -1.0) -> str | None:
+        return _take_string(self._L.kf_wq_get(self._h, timeout_s))
+
+    def done(self, key: str) -> None:
+        self._L.kf_wq_done(self._h, key.encode())
+
+    def __len__(self) -> int:
+        return self._L.kf_wq_len(self._h)
+
+    def shutdown(self) -> None:
+        self._L.kf_wq_shutdown(self._h)
+
+    @property
+    def shutting_down(self) -> bool:
+        return bool(self._L.kf_wq_shutting_down(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._L.kf_wq_free(self._h)
+            self._h = None
+
+
+class Expectations:
+    """ControllerExpectations: duplicate-action guard for reconcilers."""
+
+    def __init__(self, ttl_s: float = 300.0):
+        self._L = lib()
+        self._h = self._L.kf_exp_new(ttl_s)
+
+    def expect_creations(self, key: str, n: int) -> None:
+        self._L.kf_exp_expect_creations(self._h, key.encode(), n)
+
+    def expect_deletions(self, key: str, n: int) -> None:
+        self._L.kf_exp_expect_deletions(self._h, key.encode(), n)
+
+    def creation_observed(self, key: str) -> None:
+        self._L.kf_exp_creation_observed(self._h, key.encode())
+
+    def deletion_observed(self, key: str) -> None:
+        self._L.kf_exp_deletion_observed(self._h, key.encode())
+
+    def satisfied(self, key: str) -> bool:
+        return bool(self._L.kf_exp_satisfied(self._h, key.encode()))
+
+    def delete(self, key: str) -> None:
+        self._L.kf_exp_delete(self._h, key.encode())
+
+    def counts(self, key: str) -> tuple[int, int]:
+        a = ctypes.c_longlong()
+        d = ctypes.c_longlong()
+        self._L.kf_exp_counts(self._h, key.encode(), ctypes.byref(a), ctypes.byref(d))
+        return a.value, d.value
+
+    def close(self) -> None:
+        if self._h:
+            self._L.kf_exp_free(self._h)
+            self._h = None
+
+
+_FS, _RS = "\x1f", "\x1e"
+
+
+def _unescape(s: str) -> str:
+    out, i = [], 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            i += 1
+            out.append({"\\": "\\", "n": "\n", "f": _FS, "r": _RS}.get(s[i], s[i]))
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_records(raw: str | None, fields: list[str]) -> list[dict]:
+    if not raw:
+        return []
+    out = []
+    for rec in raw.split(_RS):
+        vals = [_unescape(f) for f in rec.split(_FS)]
+        if len(vals) == len(fields):
+            out.append(dict(zip(fields, vals)))
+    return out
+
+
+_ARTIFACT_FIELDS = ["id", "type", "name", "uri", "props", "ts"]
+_EXECUTION_FIELDS = ["id", "type", "name", "state", "props", "ts"]
+_EVENT_FIELDS = ["execution_id", "artifact_id", "direction", "ts"]
+
+
+class MetadataStore:
+    """Lineage store (MLMD analogue): artifacts, executions, events."""
+
+    INPUT, OUTPUT = 0, 1
+
+    def __init__(self, path: str):
+        self._L = lib()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._h = self._L.kf_ms_open(path.encode())
+
+    def put_artifact(
+        self, type: str, name: str, uri: str = "", props: str = "{}", id: int = 0
+    ) -> int:
+        return self._L.kf_ms_put_artifact(
+            self._h, id, type.encode(), name.encode(), uri.encode(), props.encode()
+        )
+
+    def put_execution(
+        self, type: str, name: str, state: str = "NEW", props: str = "{}", id: int = 0
+    ) -> int:
+        return self._L.kf_ms_put_execution(
+            self._h, id, type.encode(), name.encode(), state.encode(), props.encode()
+        )
+
+    def put_event(self, execution_id: int, artifact_id: int, direction: int) -> None:
+        rc = self._L.kf_ms_put_event(self._h, execution_id, artifact_id, direction)
+        if rc != 0:
+            raise KeyError(
+                f"unknown execution {execution_id} or artifact {artifact_id}"
+            )
+
+    def get_artifact(self, id: int) -> dict | None:
+        recs = _parse_records(
+            _take_string(self._L.kf_ms_get_artifact(self._h, id)), _ARTIFACT_FIELDS
+        )
+        return recs[0] if recs else None
+
+    def get_execution(self, id: int) -> dict | None:
+        recs = _parse_records(
+            _take_string(self._L.kf_ms_get_execution(self._h, id)), _EXECUTION_FIELDS
+        )
+        return recs[0] if recs else None
+
+    def list_artifacts(self, type: str = "") -> list[dict]:
+        return _parse_records(
+            _take_string(self._L.kf_ms_list_artifacts(self._h, type.encode())),
+            _ARTIFACT_FIELDS,
+        )
+
+    def list_executions(self, type: str = "") -> list[dict]:
+        return _parse_records(
+            _take_string(self._L.kf_ms_list_executions(self._h, type.encode())),
+            _EXECUTION_FIELDS,
+        )
+
+    def events(self, execution_id: int = 0, artifact_id: int = 0) -> list[dict]:
+        return _parse_records(
+            _take_string(self._L.kf_ms_events(self._h, execution_id, artifact_id)),
+            _EVENT_FIELDS,
+        )
+
+    def close(self) -> None:
+        if self._h:
+            self._L.kf_ms_close(self._h)
+            self._h = None

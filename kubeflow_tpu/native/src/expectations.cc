@@ -1,0 +1,116 @@
+// ControllerExpectations — the logical race guard between informer-cache
+// catch-up and reconcile (SURVEY.md §5.2: prevents duplicate pod creation).
+//
+// A reconciler that just created N pods must not create them again on the
+// next (stale-cache) reconcile: it records ExpectCreations(key, N); observed
+// creations decrement; SatisfiedExpectations gates the next creation pass.
+// Expectations expire after a TTL so a lost watch event can't deadlock the
+// controller (same 5-minute default as the reference).
+
+#include <chrono>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Expectation {
+  long long adds = 0;
+  long long dels = 0;
+  Clock::time_point stamp;
+};
+
+class Expectations {
+ public:
+  explicit Expectations(double ttl_s) : ttl_(ttl_s) {}
+
+  void ExpectCreations(const std::string& key, long long n) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto& e = map_[key];
+    e.adds = n;
+    e.dels = 0;
+    e.stamp = Clock::now();
+  }
+
+  void ExpectDeletions(const std::string& key, long long n) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto& e = map_[key];
+    e.dels = n;
+    e.stamp = Clock::now();
+  }
+
+  void CreationObserved(const std::string& key) { Lower(key, true); }
+  void DeletionObserved(const std::string& key) { Lower(key, false); }
+
+  // True when no outstanding expectations (or they expired / were never set).
+  bool Satisfied(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = map_.find(key);
+    if (it == map_.end()) return true;
+    const auto& e = it->second;
+    if (e.adds <= 0 && e.dels <= 0) return true;
+    double age =
+        std::chrono::duration<double>(Clock::now() - e.stamp).count();
+    return age > ttl_;  // expired: force a fresh reconcile pass
+  }
+
+  void Delete(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    map_.erase(key);
+  }
+
+  void Counts(const std::string& key, long long* adds, long long* dels) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = map_.find(key);
+    *adds = it == map_.end() ? 0 : it->second.adds;
+    *dels = it == map_.end() ? 0 : it->second.dels;
+  }
+
+ private:
+  void Lower(const std::string& key, bool add) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = map_.find(key);
+    if (it == map_.end()) return;
+    auto& e = it->second;
+    if (add && e.adds > 0) e.adds--;
+    if (!add && e.dels > 0) e.dels--;
+  }
+
+  std::mutex mu_;
+  std::map<std::string, Expectation> map_;
+  double ttl_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kf_exp_new(double ttl_s) { return new Expectations(ttl_s); }
+void kf_exp_free(void* e) { delete static_cast<Expectations*>(e); }
+void kf_exp_expect_creations(void* e, const char* key, long long n) {
+  static_cast<Expectations*>(e)->ExpectCreations(key, n);
+}
+void kf_exp_expect_deletions(void* e, const char* key, long long n) {
+  static_cast<Expectations*>(e)->ExpectDeletions(key, n);
+}
+void kf_exp_creation_observed(void* e, const char* key) {
+  static_cast<Expectations*>(e)->CreationObserved(key);
+}
+void kf_exp_deletion_observed(void* e, const char* key) {
+  static_cast<Expectations*>(e)->DeletionObserved(key);
+}
+int kf_exp_satisfied(void* e, const char* key) {
+  return static_cast<Expectations*>(e)->Satisfied(key) ? 1 : 0;
+}
+void kf_exp_delete(void* e, const char* key) {
+  static_cast<Expectations*>(e)->Delete(key);
+}
+void kf_exp_counts(void* e, const char* key, long long* adds,
+                   long long* dels) {
+  static_cast<Expectations*>(e)->Counts(key, adds, dels);
+}
+
+}  // extern "C"

@@ -1,0 +1,291 @@
+// Metadata/lineage store — the MLMD analogue, the platform's one upstream
+// C++ service (SURVEY.md §2.6/§2.8: ml-metadata store server ships with
+// Pipelines). Artifacts, executions, and input/output events with lineage
+// queries, persisted to an append-only escaped-record log (no sqlite dev
+// headers in this environment) and replayed into an in-memory index on open.
+//
+// Wire format for query results (parsed by the ctypes wrapper):
+//   fields separated by 0x1F (unit sep), records by 0x1E (record sep).
+// Log format: one escaped line per record; '\\', '\n', 0x1F, 0x1E escaped.
+
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr char kFS = '\x1f';  // field separator
+constexpr char kRS = '\x1e';  // record separator
+
+std::string Escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\x1f': out += "\\f"; break;
+      case '\x1e': out += "\\r"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+
+std::string Unescape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '\\' && i + 1 < s.size()) {
+      switch (s[++i]) {
+        case '\\': out += '\\'; break;
+        case 'n': out += '\n'; break;
+        case 'f': out += '\x1f'; break;
+        case 'r': out += '\x1e'; break;
+        default: out += s[i];
+      }
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+std::vector<std::string> SplitFields(const std::string& s) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (char c : s) {
+    if (c == kFS) {
+      out.push_back(cur);
+      cur.clear();
+    } else {
+      cur += c;
+    }
+  }
+  out.push_back(cur);
+  return out;
+}
+
+struct Artifact {
+  long long id;
+  std::string type, name, uri, props;
+  long long ts;
+};
+
+struct Execution {
+  long long id;
+  std::string type, name, state, props;
+  long long ts;
+};
+
+struct Event {
+  long long execution_id, artifact_id;
+  int direction;  // 0 = input, 1 = output
+  long long ts;
+};
+
+class MetaStore {
+ public:
+  explicit MetaStore(const std::string& path) : path_(path) {
+    Replay();
+    log_.open(path_, std::ios::app);
+  }
+
+  long long PutArtifact(long long id, const std::string& type,
+                        const std::string& name, const std::string& uri,
+                        const std::string& props) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (id == 0) id = ++next_artifact_id_;
+    else if (id > next_artifact_id_) next_artifact_id_ = id;
+    Artifact a{id, type, name, uri, props, Now()};
+    artifacts_[id] = a;
+    AppendLog('A', SerializeArtifact(a));
+    return id;
+  }
+
+  long long PutExecution(long long id, const std::string& type,
+                         const std::string& name, const std::string& state,
+                         const std::string& props) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (id == 0) id = ++next_execution_id_;
+    else if (id > next_execution_id_) next_execution_id_ = id;
+    Execution e{id, type, name, state, props, Now()};
+    executions_[id] = e;
+    AppendLog('E', SerializeExecution(e));
+    return id;
+  }
+
+  int PutEvent(long long exec_id, long long art_id, int direction) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!executions_.count(exec_id) || !artifacts_.count(art_id)) return -1;
+    Event v{exec_id, art_id, direction, Now()};
+    events_.push_back(v);
+    AppendLog('V', SerializeEvent(v));
+    return 0;
+  }
+
+  std::string GetArtifact(long long id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = artifacts_.find(id);
+    return it == artifacts_.end() ? "" : SerializeArtifact(it->second);
+  }
+
+  std::string GetExecution(long long id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = executions_.find(id);
+    return it == executions_.end() ? "" : SerializeExecution(it->second);
+  }
+
+  std::string ListArtifacts(const std::string& type) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string out;
+    for (auto& [id, a] : artifacts_) {
+      if (!type.empty() && a.type != type) continue;
+      if (!out.empty()) out += kRS;
+      out += SerializeArtifact(a);
+    }
+    return out;
+  }
+
+  std::string ListExecutions(const std::string& type) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string out;
+    for (auto& [id, e] : executions_) {
+      if (!type.empty() && e.type != type) continue;
+      if (!out.empty()) out += kRS;
+      out += SerializeExecution(e);
+    }
+    return out;
+  }
+
+  std::string EventsFor(long long exec_id, long long art_id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string out;
+    for (auto& v : events_) {
+      if (exec_id != 0 && v.execution_id != exec_id) continue;
+      if (art_id != 0 && v.artifact_id != art_id) continue;
+      if (!out.empty()) out += kRS;
+      out += SerializeEvent(v);
+    }
+    return out;
+  }
+
+ private:
+  static long long Now() {
+    return static_cast<long long>(::time(nullptr));
+  }
+
+  // Fields are escaped individually so a field may contain any byte,
+  // including the separators and newlines.
+  std::string SerializeArtifact(const Artifact& a) {
+    std::ostringstream os;
+    os << a.id << kFS << Escape(a.type) << kFS << Escape(a.name) << kFS
+       << Escape(a.uri) << kFS << Escape(a.props) << kFS << a.ts;
+    return os.str();
+  }
+
+  std::string SerializeExecution(const Execution& e) {
+    std::ostringstream os;
+    os << e.id << kFS << Escape(e.type) << kFS << Escape(e.name) << kFS
+       << Escape(e.state) << kFS << Escape(e.props) << kFS << e.ts;
+    return os.str();
+  }
+
+  std::string SerializeEvent(const Event& v) {
+    std::ostringstream os;
+    os << v.execution_id << kFS << v.artifact_id << kFS << v.direction << kFS
+       << v.ts;
+    return os.str();
+  }
+
+  void AppendLog(char tag, const std::string& record) {
+    // record fields are already escaped; no raw newlines remain
+    log_ << tag << record << "\n";
+    log_.flush();
+  }
+
+  void Replay() {
+    std::ifstream in(path_);
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      char tag = line[0];
+      auto f = SplitFields(line.substr(1));
+      if (tag == 'A' && f.size() == 6) {
+        Artifact a{atoll(f[0].c_str()), Unescape(f[1]), Unescape(f[2]),
+                   Unescape(f[3]), Unescape(f[4]), atoll(f[5].c_str())};
+        artifacts_[a.id] = a;
+        if (a.id > next_artifact_id_) next_artifact_id_ = a.id;
+      } else if (tag == 'E' && f.size() == 6) {
+        Execution e{atoll(f[0].c_str()), Unescape(f[1]), Unescape(f[2]),
+                    Unescape(f[3]), Unescape(f[4]), atoll(f[5].c_str())};
+        executions_[e.id] = e;
+        if (e.id > next_execution_id_) next_execution_id_ = e.id;
+      } else if (tag == 'V' && f.size() == 4) {
+        events_.push_back(Event{atoll(f[0].c_str()), atoll(f[1].c_str()),
+                                atoi(f[2].c_str()), atoll(f[3].c_str())});
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::string path_;
+  std::ofstream log_;
+  std::map<long long, Artifact> artifacts_;
+  std::map<long long, Execution> executions_;
+  std::vector<Event> events_;
+  long long next_artifact_id_ = 0;
+  long long next_execution_id_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kf_ms_open(const char* path) { return new MetaStore(path); }
+void kf_ms_close(void* h) { delete static_cast<MetaStore*>(h); }
+
+long long kf_ms_put_artifact(void* h, long long id, const char* type,
+                             const char* name, const char* uri,
+                             const char* props) {
+  return static_cast<MetaStore*>(h)->PutArtifact(id, type, name, uri, props);
+}
+long long kf_ms_put_execution(void* h, long long id, const char* type,
+                              const char* name, const char* state,
+                              const char* props) {
+  return static_cast<MetaStore*>(h)->PutExecution(id, type, name, state,
+                                                  props);
+}
+int kf_ms_put_event(void* h, long long exec_id, long long art_id,
+                    int direction) {
+  return static_cast<MetaStore*>(h)->PutEvent(exec_id, art_id, direction);
+}
+
+static char* ToC(const std::string& s) {
+  if (s.empty()) return nullptr;
+  return strdup(s.c_str());
+}
+
+char* kf_ms_get_artifact(void* h, long long id) {
+  return ToC(static_cast<MetaStore*>(h)->GetArtifact(id));
+}
+char* kf_ms_get_execution(void* h, long long id) {
+  return ToC(static_cast<MetaStore*>(h)->GetExecution(id));
+}
+char* kf_ms_list_artifacts(void* h, const char* type) {
+  return ToC(static_cast<MetaStore*>(h)->ListArtifacts(type ? type : ""));
+}
+char* kf_ms_list_executions(void* h, const char* type) {
+  return ToC(static_cast<MetaStore*>(h)->ListExecutions(type ? type : ""));
+}
+char* kf_ms_events(void* h, long long exec_id, long long art_id) {
+  return ToC(static_cast<MetaStore*>(h)->EventsFor(exec_id, art_id));
+}
+
+}  // extern "C"

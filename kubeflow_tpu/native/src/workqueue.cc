@@ -1,0 +1,218 @@
+// Rate-limited delaying work queue — the reconcile engine's native core.
+//
+// Semantics mirror the reference's controller work queue (client-go
+// workqueue, consumed by every Go operator — SURVEY.md §2.8 native ledger):
+//   - Add: dedupe while queued; if the key is mid-processing, mark dirty and
+//     re-queue on Done (level-triggered reconciliation).
+//   - Get: blocks until an item or shutdown.
+//   - AddAfter: delay heap serviced by a background thread.
+//   - AddRateLimited/Forget/NumRequeues: per-key exponential backoff.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this environment).
+
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct DelayedItem {
+  Clock::time_point when;
+  std::string key;
+  bool operator>(const DelayedItem& o) const { return when > o.when; }
+};
+
+class WorkQueue {
+ public:
+  WorkQueue(double base_delay_s, double max_delay_s)
+      : base_delay_(base_delay_s), max_delay_(max_delay_s) {
+    delay_thread_ = std::thread([this] { DelayLoop(); });
+  }
+
+  ~WorkQueue() {
+    ShutDown();
+    delay_thread_.join();
+  }
+
+  void Add(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    AddLocked(key);
+    cv_.notify_one();
+  }
+
+  void AddAfter(const std::string& key, double delay_s) {
+    if (delay_s <= 0) {
+      Add(key);
+      return;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    delayed_.push({Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                      std::chrono::duration<double>(delay_s)),
+                   key});
+    delay_cv_.notify_one();
+  }
+
+  double AddRateLimited(const std::string& key) {
+    double delay;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      int n = requeues_[key]++;
+      delay = base_delay_ * std::pow(2.0, n);
+      if (delay > max_delay_) delay = max_delay_;
+    }
+    AddAfter(key, delay);
+    return delay;
+  }
+
+  void Forget(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    requeues_.erase(key);
+  }
+
+  int NumRequeues(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = requeues_.find(key);
+    return it == requeues_.end() ? 0 : it->second;
+  }
+
+  // Returns false on shutdown/timeout; fills key otherwise.
+  bool Get(double timeout_s, std::string* key) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return shutdown_ || !queue_.empty(); };
+    if (timeout_s < 0) {
+      cv_.wait(lk, pred);
+    } else if (!cv_.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                             pred)) {
+      return false;
+    }
+    if (queue_.empty()) return false;  // shutdown
+    *key = queue_.front();
+    queue_.pop_front();
+    queued_.erase(*key);
+    processing_.insert(*key);
+    return true;
+  }
+
+  void Done(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    processing_.erase(key);
+    if (dirty_.erase(key)) {
+      AddLocked(key);
+      cv_.notify_one();
+    }
+  }
+
+  int Len() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int>(queue_.size());
+  }
+
+  void ShutDown() {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutdown_ = true;
+    cv_.notify_all();
+    delay_cv_.notify_all();
+  }
+
+  bool ShuttingDown() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return shutdown_;
+  }
+
+ private:
+  void AddLocked(const std::string& key) {
+    if (shutdown_) return;
+    if (processing_.count(key)) {
+      dirty_.insert(key);  // re-add when Done
+      return;
+    }
+    if (queued_.insert(key).second) queue_.push_back(key);
+  }
+
+  void DelayLoop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!shutdown_) {
+      if (delayed_.empty()) {
+        delay_cv_.wait(lk, [this] { return shutdown_ || !delayed_.empty(); });
+        continue;
+      }
+      auto next = delayed_.top().when;
+      if (Clock::now() >= next) {
+        AddLocked(delayed_.top().key);
+        delayed_.pop();
+        cv_.notify_one();
+      } else {
+        delay_cv_.wait_until(lk, next);
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable delay_cv_;
+  std::deque<std::string> queue_;
+  std::set<std::string> queued_;
+  std::set<std::string> processing_;
+  std::set<std::string> dirty_;
+  std::map<std::string, int> requeues_;
+  std::priority_queue<DelayedItem, std::vector<DelayedItem>,
+                      std::greater<DelayedItem>>
+      delayed_;
+  bool shutdown_ = false;
+  double base_delay_;
+  double max_delay_;
+  std::thread delay_thread_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kf_wq_new(double base_delay_s, double max_delay_s) {
+  return new WorkQueue(base_delay_s, max_delay_s);
+}
+void kf_wq_free(void* q) { delete static_cast<WorkQueue*>(q); }
+void kf_wq_add(void* q, const char* key) {
+  static_cast<WorkQueue*>(q)->Add(key);
+}
+void kf_wq_add_after(void* q, const char* key, double delay_s) {
+  static_cast<WorkQueue*>(q)->AddAfter(key, delay_s);
+}
+double kf_wq_add_rate_limited(void* q, const char* key) {
+  return static_cast<WorkQueue*>(q)->AddRateLimited(key);
+}
+void kf_wq_forget(void* q, const char* key) {
+  static_cast<WorkQueue*>(q)->Forget(key);
+}
+int kf_wq_num_requeues(void* q, const char* key) {
+  return static_cast<WorkQueue*>(q)->NumRequeues(key);
+}
+// Returns a malloc'd key or nullptr; caller frees with kf_free.
+char* kf_wq_get(void* q, double timeout_s) {
+  std::string key;
+  if (!static_cast<WorkQueue*>(q)->Get(timeout_s, &key)) return nullptr;
+  return strdup(key.c_str());
+}
+void kf_wq_done(void* q, const char* key) {
+  static_cast<WorkQueue*>(q)->Done(key);
+}
+int kf_wq_len(void* q) { return static_cast<WorkQueue*>(q)->Len(); }
+void kf_wq_shutdown(void* q) { static_cast<WorkQueue*>(q)->ShutDown(); }
+int kf_wq_shutting_down(void* q) {
+  return static_cast<WorkQueue*>(q)->ShuttingDown() ? 1 : 0;
+}
+void kf_free(void* p) { free(p); }
+
+}  // extern "C"

@@ -67,7 +67,7 @@ def shard_batch(batch: Any, mesh: Mesh) -> Any:
     return jax.tree.map(lambda x: jax.device_put(x, s), batch)
 
 
-def shard_state(state: Any, mesh: Mesh, param_tree_path: str = "params") -> Any:
+def shard_state(state: Any, mesh: Mesh) -> Any:
     """Place a TrainState: params/opt_state FSDP-sharded, scalars replicated."""
 
     def one(leaf):

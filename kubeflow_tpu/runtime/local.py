@@ -51,6 +51,12 @@ class LocalRunner:
 
     def run(self, job: TrainJob, timeout: float | None = None) -> JobResult:
         validate_job(job)
+        # reject unlaunchable specs before spawning anything (no orphan leak)
+        for rtype, rs in job.spec.replica_specs.items():
+            if rs.replicas > 0 and not (
+                rs.template.container.command or rs.template.container.args
+            ):
+                raise ValueError(f"replica {rtype} has no command")
         resolver = LocalResolver(job)
         self.log_dir.mkdir(parents=True, exist_ok=True)
 
@@ -59,19 +65,17 @@ class LocalRunner:
             for i in range(rs.replicas):
                 c = rs.template.container
                 cmd = list(c.command) + list(c.args)
-                if not cmd:
-                    raise ValueError(f"replica {rtype} has no command")
                 env = dict(os.environ) if self.inherit_env else {}
                 env.update(resolver.rewrite_env(synthesize_env(job, rtype, i)))
                 log_path = str(self.log_dir / f"{job.replica_name(rtype, i)}.log")
-                logf = open(log_path, "wb")
-                proc = subprocess.Popen(
-                    cmd,
-                    env=env,
-                    stdout=logf,
-                    stderr=subprocess.STDOUT,
-                    cwd=c.working_dir or None,
-                )
+                with open(log_path, "wb") as logf:  # child dups the fd
+                    proc = subprocess.Popen(
+                        cmd,
+                        env=env,
+                        stdout=logf,
+                        stderr=subprocess.STDOUT,
+                        cwd=c.working_dir or None,
+                    )
                 procs.append((rtype, i, proc, log_path, time.monotonic()))
 
         deadline = (
@@ -96,14 +100,15 @@ class LocalRunner:
             )
 
         success_rtype = SUCCESS_REPLICA[job.kind]
-        if success_rtype not in job.spec.replica_specs:
+        rs = job.spec.replica_specs.get(success_rtype)
+        if rs is None or rs.replicas == 0:
             # TFJob chief fallback, master fallback: worker-0 decides
             success_rtype = REPLICA_WORKER
-        verdict = all(
-            r.exit_code == 0
-            for r in results
+        deciders = [
+            r for r in results
             if r.rtype == success_rtype and (r.index == 0 or r.rtype == REPLICA_WORKER)
-        )
+        ]
+        verdict = bool(deciders) and all(r.exit_code == 0 for r in deciders)
 
         st = job.status
         st.start_time = st.start_time or _now()

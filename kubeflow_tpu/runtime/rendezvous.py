@@ -34,15 +34,24 @@ class LocalResolver:
         return f"127.0.0.1:{self.port_map[host]}"
 
     def rewrite_env(self, env: dict[str, str]) -> dict[str, str]:
-        """Replace every known hostname[:port] in env values with loopback."""
-        # Ensure every replica has a mapping before rewriting.
+        """Replace every known hostname[:anyport] in env values with loopback.
+
+        A `host:port` occurrence maps to that host's unique loopback port
+        (whatever framework port the contract used — 2222, 23456, ...), so
+        per-replica endpoints stay distinct locally; a bare hostname maps to
+        127.0.0.1.
+        """
+        import re
+
         for rtype, rs in self.job.spec.replica_specs.items():
             for i in range(rs.replicas):
                 self.endpoint(rtype, i)
         out = {}
         for k, v in env.items():
             for host, port in self.port_map.items():
-                v = v.replace(f"{host}:{self.job.spec.coordinator_port}", f"127.0.0.1:{port}")
+                v = re.sub(
+                    rf"{re.escape(host)}:\d+", f"127.0.0.1:{port}", v
+                )
                 v = v.replace(host, "127.0.0.1")
             out[k] = v
         return out

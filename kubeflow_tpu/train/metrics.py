@@ -14,7 +14,9 @@ import sys
 import time
 
 # The collector's parse regex: `<name>=<float>` tokens on a line.
-METRIC_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_./-]*)=(-?\d+(?:\.\d+)?(?:[eE]-?\d+)?)")
+METRIC_RE = re.compile(
+    r"([A-Za-z_][A-Za-z0-9_./-]*)=(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
+)
 
 
 def emit(step: int | None = None, file=None, **metrics: float) -> str:

@@ -172,6 +172,11 @@ class Trainer:
                 metrics_lib.emit(step=start_step, resumed=1)
 
         per_epoch = len(dataset.x_train) // c.batch_size
+        if per_epoch == 0:
+            raise ValueError(
+                f"batch_size {c.batch_size} exceeds train set size "
+                f"{len(dataset.x_train)}: no full batch can be formed"
+            )
         total_steps = c.steps if c.steps is not None else c.epochs * per_epoch
         timer = metrics_lib.Timer()
         global_step = start_step
