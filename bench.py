@@ -1,51 +1,109 @@
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Flagship metric (BASELINE.md north star): images/sec/chip on the largest
-in-tree model available. Falls back gracefully: resnet50 > mnist-mlp.
+Flagship metric (BASELINE.md north star #2): ResNet-50 images/sec/chip,
+synthetic ImageNet-shaped data, bf16 compute, one jit-compiled train step.
 vs_baseline: the reference publishes no numbers (BASELINE.json published={}),
 so vs_baseline is the ratio to this repo's first recorded measurement
-(BENCH_BASELINE in this file), 1.0 on the first run.
+(BENCH_BASELINE_IMAGES_PER_SEC below), 1.0 until that constant is set from
+the first driver run (BENCH_r1.json).
+
+  python bench.py                 # flagship resnet50
+  python bench.py --suite         # all benches, one JSON line each (flagship last)
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
-import numpy as np
-
-# First recorded round-1 number for this metric on the axon v5e chip; later
-# rounds report vs_baseline against it.
-BENCH_BASELINE_IMAGES_PER_SEC = None  # set after first driver run
+# First recorded round-1 number on the axon v5e chip; later rounds report
+# vs_baseline against it.
+BENCH_BASELINE_IMAGES_PER_SEC = None  # set from BENCH_r1.json after round 1
 
 
-def bench_mnist_mlp(steps: int = 60, batch_size: int = 512) -> dict:
+def _timed_steps(trainer, state, batch, steps: int):
     import jax
-    import jax.numpy as jnp
 
-    from kubeflow_tpu.models import MnistMLP
-    from kubeflow_tpu.train import Trainer, TrainerConfig
-    from kubeflow_tpu.train.data import synthetic_image_dataset
-
-    ds = synthetic_image_dataset(
-        n_train=batch_size * 4, n_test=batch_size, shape=(28, 28, 1)
-    )
-    trainer = Trainer(
-        MnistMLP(hidden=(512, 256)),
-        TrainerConfig(batch_size=batch_size, steps=steps, log_every_steps=10**9),
-    )
-    state = trainer.init_state(ds.x_train[:batch_size])
-    batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
-    # warmup/compile
-    state, m = trainer.train_step(state, batch)
+    state, m = trainer.train_step(state, batch)  # compile + warmup
     jax.block_until_ready(m["loss"])
     t0 = time.perf_counter()
     for _ in range(steps):
         state, m = trainer.train_step(state, batch)
     jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
-    ips = steps * batch_size / dt
-    return {"metric": "mnist_mlp_images_per_sec_per_chip", "value": round(ips, 1)}
+    return time.perf_counter() - t0
+
+
+def bench_resnet50(steps: int = 30, batch_size: int = 128, image_size: int = 224) -> dict:
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import ResNet50
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_image_dataset
+
+    ds = synthetic_image_dataset(
+        n_train=batch_size, n_test=batch_size,
+        shape=(image_size, image_size, 3), num_classes=1000,
+    )
+    trainer = Trainer(
+        ResNet50(num_classes=1000, dtype=jnp.bfloat16),
+        TrainerConfig(batch_size=batch_size, compute_dtype=jnp.bfloat16,
+                      log_every_steps=10**9),
+    )
+    state = trainer.init_state(ds.x_train[:batch_size])
+    batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
+    dt = _timed_steps(trainer, state, batch, steps)
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(steps * batch_size / dt, 1),
+        "unit": "images/sec/chip",
+    }
+
+
+def bench_bert_base(steps: int = 20, batch_size: int = 16, seq_len: int = 128) -> dict:
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import BertConfig, BertForSequenceClassification
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_text_dataset
+
+    cfg = BertConfig.base(dtype=jnp.bfloat16, dropout_rate=0.0)
+    ds = synthetic_text_dataset(n_train=batch_size, n_test=batch_size,
+                                seq_len=seq_len, vocab_size=cfg.vocab_size)
+    trainer = Trainer(
+        BertForSequenceClassification(cfg, num_classes=2),
+        TrainerConfig(batch_size=batch_size, compute_dtype=jnp.bfloat16,
+                      log_every_steps=10**9),
+    )
+    state = trainer.init_state(ds.x_train[:batch_size])
+    batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
+    dt = _timed_steps(trainer, state, batch, steps)
+    return {
+        "metric": "bert_base_steps_per_sec",
+        "value": round(steps / dt, 3),
+        "unit": "steps/sec",
+    }
+
+
+def bench_mnist_mlp(steps: int = 60, batch_size: int = 512) -> dict:
+    from kubeflow_tpu.models import MnistMLP
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_image_dataset
+
+    ds = synthetic_image_dataset(n_train=batch_size * 2, n_test=batch_size,
+                                 shape=(28, 28, 1))
+    trainer = Trainer(
+        MnistMLP(hidden=(512, 256)),
+        TrainerConfig(batch_size=batch_size, log_every_steps=10**9),
+    )
+    state = trainer.init_state(ds.x_train[:batch_size])
+    batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
+    dt = _timed_steps(trainer, state, batch, steps)
+    return {
+        "metric": "mnist_mlp_images_per_sec_per_chip",
+        "value": round(steps * batch_size / dt, 1),
+        "unit": "images/sec/chip",
+    }
 
 
 def main() -> None:
@@ -57,33 +115,17 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", os.environ["KFT_BENCH_PLATFORM"])
-    result = None
-    try:
-        from kubeflow_tpu.models import resnet  # noqa: F401  (lands in P3)
 
-        has_resnet = True
-    except ImportError:
-        has_resnet = False
-
-    if has_resnet:
-        from bench_resnet import bench_resnet50  # optional future module
-
-        result = bench_resnet50()
-    else:
-        result = bench_mnist_mlp()
-
-    baseline = BENCH_BASELINE_IMAGES_PER_SEC
-    vs = round(result["value"] / baseline, 3) if baseline else 1.0
-    print(
-        json.dumps(
-            {
-                "metric": result["metric"],
-                "value": result["value"],
-                "unit": "images/sec/chip",
-                "vs_baseline": vs,
-            }
+    suite = "--suite" in sys.argv
+    benches = [bench_mnist_mlp, bench_bert_base, bench_resnet50] if suite else [bench_resnet50]
+    for bench in benches:
+        r = bench()
+        vs = (
+            round(r["value"] / BENCH_BASELINE_IMAGES_PER_SEC, 3)
+            if BENCH_BASELINE_IMAGES_PER_SEC and "resnet50" in r["metric"]
+            else 1.0
         )
-    )
+        print(json.dumps({**r, "vs_baseline": vs}))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,86 @@
+"""North-star config #3: BERT gang fine-tune, long-context capable.
+
+Reference parity: the reference runs BERT via Horovod/MPIJob user images
+(SURVEY.md §3.2); here the in-tree encoder fine-tunes under the Trainer with
+any mesh: dp/fsdp/tp axes plus `context` for ring/Ulysses sequence
+parallelism at long sequence lengths (capability the reference platform
+never had — SURVEY.md §5.7).
+
+  python -m examples.bert --device=tpu --size=base --steps=100
+  python -m examples.bert --size=tiny --seq-len=2048 --attention=ring --context=4
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+    p.add_argument("--size", default="base", choices=["tiny", "base"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--num-classes", type=int, default=2)
+    p.add_argument("--lr", type=float, default=5e-5)
+    p.add_argument("--attention", default="dense", choices=["dense", "ring", "ulysses", "flash"])
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--no-bf16", dest="bf16", action="store_false")
+    p.add_argument("--data-parallel", type=int, default=-1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--model-parallel", type=int, default=1)
+    p.add_argument("--context", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import BertConfig, BertForSequenceClassification
+    from kubeflow_tpu.parallel import MeshConfig
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_text_dataset
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    mk = BertConfig.tiny if args.size == "tiny" else BertConfig.base
+    cfg = mk(
+        dtype=dtype,
+        attention=args.attention,
+        max_len=max(args.seq_len, 512),
+        dropout_rate=0.0 if args.attention != "dense" else 0.1,
+    )
+    ds = synthetic_text_dataset(
+        n_train=args.batch_size * 8,
+        n_test=args.batch_size * 2,
+        seq_len=args.seq_len,
+        vocab_size=cfg.vocab_size,
+        num_classes=args.num_classes,
+    )
+    trainer = Trainer(
+        BertForSequenceClassification(cfg, num_classes=args.num_classes),
+        TrainerConfig(
+            batch_size=args.batch_size,
+            steps=args.steps,
+            learning_rate=args.lr,
+            warmup_steps=min(100, args.steps // 10),
+            compute_dtype=dtype,
+            checkpoint_dir=args.checkpoint_dir,
+            mesh=MeshConfig(
+                data=args.data_parallel,
+                fsdp=args.fsdp,
+                model=args.model_parallel,
+                context=args.context,
+            ),
+            log_every_steps=10,
+        ),
+    )
+    _, metrics = trainer.fit(ds)
+    return metrics.get("final_accuracy", 0.0)
+
+
+if __name__ == "__main__":
+    main()

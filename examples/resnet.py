@@ -1,0 +1,73 @@
+"""North-star config #2: ResNet distributed data-parallel training.
+
+Reference parity: the reference runs torchvision ResNet-50 DDP under a
+PyTorchJob (SURVEY.md §2.2 data-parallel row); here the in-tree flax ResNet
+trains under the same Trainer on any mesh. Offline environment => synthetic
+ImageNet-shaped data for throughput, digits for a real-accuracy smoke run.
+
+  python -m examples.resnet --device=tpu --variant=50 --steps=100
+  python -m examples.resnet --device=cpu --variant=18 --small --steps=20
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+    p.add_argument("--variant", default="50", choices=["18", "34", "50", "101", "152"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--small", action="store_true", help="3x3 stem for small images")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--no-bf16", dest="bf16", action="store_false")
+    p.add_argument("--data-parallel", type=int, default=-1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+
+    import jax.numpy as jnp
+
+    import kubeflow_tpu.models as models
+    from kubeflow_tpu.parallel import MeshConfig
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_image_dataset
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model = getattr(models, f"ResNet{args.variant}")(
+        num_classes=args.num_classes, dtype=dtype, small_inputs=args.small
+    )
+    size = args.image_size if not args.small else 32
+    dataset = synthetic_image_dataset(
+        n_train=args.batch_size * 8,
+        n_test=args.batch_size * 2,
+        shape=(size, size, 3),
+        num_classes=args.num_classes,
+    )
+    trainer = Trainer(
+        model,
+        TrainerConfig(
+            batch_size=args.batch_size,
+            steps=args.steps,
+            learning_rate=args.lr,
+            compute_dtype=dtype,
+            checkpoint_dir=args.checkpoint_dir,
+            mesh=MeshConfig(data=args.data_parallel, fsdp=args.fsdp),
+            log_every_steps=10,
+        ),
+    )
+    _, metrics = trainer.fit(dataset)
+    return metrics.get("final_accuracy", 0.0)
+
+
+if __name__ == "__main__":
+    main()

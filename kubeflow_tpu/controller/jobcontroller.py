@@ -156,6 +156,7 @@ class JobController:
             return None
 
         st = job.status
+        entry_fp = _status_fingerprint(st)
         if not st.conditions:
             st.set_condition(JobConditionType.CREATED, "JobCreated")
             self.metrics["jobs_created_total"] += 1
@@ -217,7 +218,12 @@ class JobController:
                 st.set_condition(JobConditionType.RUNNING, "JobRunning")
                 self.cluster.record_event("jobs", key, "JobRunning", "all replicas running")
         self._update_replica_statuses(job, pods)
-        self.cluster.update("jobs", job)
+        # only publish a MODIFIED event on real change — an unconditional
+        # update would re-enqueue this key via the informer and turn every
+        # live job into a self-triggering hot reconcile loop
+        if _status_fingerprint(st) != entry_fp:
+            st.last_reconcile_time = _now_ts()
+            self.cluster.update("jobs", job)
         return 0.2 if created else None
 
     # ---------------------------------------------------------- sub-steps
@@ -420,7 +426,21 @@ class JobController:
             elif ph == PodPhase.FAILED:
                 stats[rtype].failed += 1
         job.status.replica_statuses = stats
-        job.status.last_reconcile_time = _now_ts()
+
+
+def _status_fingerprint(st) -> tuple:
+    """Hashable snapshot of the reconcile-relevant status (excludes
+    last_reconcile_time, which must never itself trigger an update)."""
+    return (
+        tuple((c.type, c.status, c.reason, c.message) for c in st.conditions),
+        tuple(
+            (rt, rs.active, rs.succeeded, rs.failed)
+            for rt, rs in sorted(st.replica_statuses.items())
+        ),
+        st.start_time,
+        st.completion_time,
+        st.restart_count,
+    )
 
 
 def _now_ts() -> str:

@@ -54,10 +54,14 @@ class PodRuntime:
         with self._mu:
             procs = [proc for _, proc in self._procs.values()]
         for p in procs:
+            # kill the whole session (pods may fork workers), like _kill does
             try:
-                p.kill()
-            except ProcessLookupError:
-                pass
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    p.kill()
+                except ProcessLookupError:
+                    pass
 
     # ---------------------------------------------------------------- watching
 
@@ -102,14 +106,15 @@ class PodRuntime:
             env = dict(os.environ) if self.inherit_env else {}
             env.update(pod.env)
             try:
-                proc = subprocess.Popen(
-                    pod.command,
-                    env=env,
-                    stdout=open(log_path, "wb"),
-                    stderr=subprocess.STDOUT,
-                    cwd=pod.working_dir or None,
-                    start_new_session=True,  # isolate signals per pod
-                )
+                with open(log_path, "wb") as logf:  # child dups the fd
+                    proc = subprocess.Popen(
+                        pod.command,
+                        env=env,
+                        stdout=logf,
+                        stderr=subprocess.STDOUT,
+                        cwd=pod.working_dir or None,
+                        start_new_session=True,  # isolate signals per pod
+                    )
             except OSError as exc:
                 pod.status.phase = PodPhase.FAILED
                 pod.status.exit_code = 127

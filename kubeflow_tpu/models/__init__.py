@@ -5,6 +5,33 @@ Models are flax modules with logical-axis param annotations so the same
 module runs 1-device or sharded over the mesh's model/fsdp axes.
 """
 
+from kubeflow_tpu.models.bert import (
+    BertConfig,
+    BertEncoder,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+)
 from kubeflow_tpu.models.mnist import MnistCNN, MnistMLP
+from kubeflow_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
 
-__all__ = ["MnistMLP", "MnistCNN"]
+__all__ = [
+    "BertConfig",
+    "BertEncoder",
+    "BertForMaskedLM",
+    "BertForSequenceClassification",
+    "MnistMLP",
+    "MnistCNN",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+]

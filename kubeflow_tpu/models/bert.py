@@ -1,0 +1,227 @@
+"""BERT encoder family — north-star config #3 (BASELINE.md: BERT-base steps/sec).
+
+Reference parity: the reference fine-tunes BERT via Horovod user images under
+MPIJob (SURVEY.md §3.2); here the encoder is in-tree and every parallelism
+axis is first-class:
+
+  - TP (Megatron-style) is *declarative*: PARTITION_RULES map param paths to
+    PartitionSpecs over the mesh's (fsdp, model) axes; XLA's SPMD partitioner
+    inserts the all-gathers/reduce-scatters — no hand-written collectives.
+  - Activation shardings are pinned at the residual stream via
+    with_sharding_constraint (P(("data","fsdp"), None, None)) so the
+    partitioner never materializes a replicated (B, L, H) tensor.
+  - Attention is pluggable (`attention=`): "dense" (this file),
+    "ring" / "ulysses" (kubeflow_tpu.parallel.ring_attention) for context
+    parallelism over the `context` mesh axis.
+  - bf16 compute / f32 params; static seq_len; padding via pad_token_id==0
+    derived inside the model, so the data pipeline ships one int32 array.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_DATA, AXIS_FSDP, AXIS_MODEL
+
+# Param-path regex -> PartitionSpec. fsdp shards the "long" dim that the
+# model axis leaves free; tiny params (LayerNorm, biases) replicate via the
+# default heuristic in parallel/sharding.py.
+PARTITION_RULES: list[tuple[str, P]] = [
+    (r"(query|key|value)/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
+    (r"attn_out/kernel$", P(AXIS_MODEL, AXIS_FSDP)),
+    (r"mlp_up/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
+    (r"mlp_down/kernel$", P(AXIS_MODEL, AXIS_FSDP)),
+    (r"token_embed/embedding$", P(AXIS_MODEL, AXIS_FSDP)),
+    (r"(position_embed|type_embed)/embedding$", P(None, AXIS_FSDP)),
+    (r"pooler/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
+    (r"mlm_dense/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
+]
+
+# residual-stream activation layout: batch over data axes, hidden replicated
+ACT_SPEC = P((AXIS_DATA, AXIS_FSDP), AXIS_CONTEXT, None)
+
+
+def constrain(x: jax.Array, spec: P) -> jax.Array:
+    """Sharding pin that is a no-op when no ambient mesh is set."""
+    if jax.sharding.get_abstract_mesh().empty:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dropout_rate: float = 0.1
+    pad_token_id: int = 0
+    dtype: Any = jnp.float32
+    attention: str = "dense"  # dense | ring | ulysses
+    attention_block: int = 128  # ring attention KV block size
+
+    @staticmethod
+    def base(**kw) -> "BertConfig":
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        d = dict(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                 mlp_dim=128, max_len=128)
+        d.update(kw)
+        return BertConfig(**d)
+
+
+def dense_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0, block=None):
+    """Reference softmax attention: (B, L, H, D) tensors, additive bias."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(depth).astype(q.dtype)
+    if bias is not None:
+        scores = scores + bias
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_rng is not None and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+def _resolve_attention(kind: str) -> Callable:
+    if kind == "dense":
+        return dense_attention
+    if kind in ("ring", "ulysses", "flash"):
+        from kubeflow_tpu.parallel import ring_attention as ra
+
+        return {
+            "ring": ra.ring_attention,
+            "ulysses": ra.ulysses_attention,
+            "flash": ra.flash_attention,
+        }[kind]
+    raise ValueError(f"unknown attention kind {kind!r}")
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool):
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (c.num_heads, head_dim), dtype=c.dtype, name=name
+        )
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        # additive bias from padding mask: (B, 1, 1, L)
+        bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
+        rng = self.make_rng("dropout") if train and c.dropout_rate > 0 else None
+        attn_fn = _resolve_attention(c.attention)
+        y = attn_fn(q, k, v, bias, dropout_rng=rng,
+                    dropout_rate=c.dropout_rate if train else 0.0,
+                    block=c.attention_block)
+        y = nn.DenseGeneral(
+            c.hidden_size, axis=(-2, -1), dtype=c.dtype, name="attn_out"
+        )(y)
+        return y
+
+
+class BertLayer(nn.Module):
+    """Post-LN transformer block (original BERT residual structure)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool):
+        c = self.cfg
+        y = SelfAttention(c, name="attention")(x, mask, train)
+        y = nn.Dropout(c.dropout_rate, deterministic=not train)(y)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x + y)
+        x = constrain(x, ACT_SPEC)
+        y = nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_up")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_down")(y)
+        y = nn.Dropout(c.dropout_rate, deterministic=not train)(y)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x + y)
+        return constrain(x, ACT_SPEC)
+
+
+class BertEncoder(nn.Module):
+    """Embeddings + transformer stack; returns (B, L, H) hidden states.
+
+    token_embed can be a shared nn.Embed (weight tying with an MLM head).
+    """
+
+    cfg: BertConfig
+    token_embed: Any = None
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False, token_type_ids=None):
+        c = self.cfg
+        mask = input_ids != c.pad_token_id
+        embed_mod = self.token_embed or nn.Embed(
+            c.vocab_size, c.hidden_size, dtype=c.dtype, name="token_embed"
+        )
+        embed = embed_mod(input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None, :]
+        embed = embed + nn.Embed(c.max_len, c.hidden_size, dtype=c.dtype,
+                                 name="position_embed")(pos)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        embed = embed + nn.Embed(2, c.hidden_size, dtype=c.dtype,
+                                 name="type_embed")(token_type_ids)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_embed")(embed)
+        x = nn.Dropout(c.dropout_rate, deterministic=not train)(x)
+        x = constrain(x, ACT_SPEC)
+        for i in range(c.num_layers):
+            x = BertLayer(c, name=f"layer_{i}")(x, mask, train)
+        return x
+
+
+class BertForSequenceClassification(nn.Module):
+    """[CLS]-pooled classifier — the north-star fine-tune head."""
+
+    cfg: BertConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False):
+        x = BertEncoder(self.cfg, name="encoder")(input_ids, train)
+        cls = x[:, 0]
+        pooled = jnp.tanh(nn.Dense(self.cfg.hidden_size, dtype=self.cfg.dtype,
+                                   name="pooler")(cls))
+        pooled = nn.Dropout(self.cfg.dropout_rate, deterministic=not train)(pooled)
+        logits = nn.Dense(self.num_classes, dtype=self.cfg.dtype,
+                          name="classifier")(pooled)
+        return logits.astype(jnp.float32)
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM head with tied input embeddings (pretraining parity)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False):
+        c = self.cfg
+        token_embed = nn.Embed(
+            c.vocab_size, c.hidden_size, dtype=c.dtype, name="token_embed"
+        )
+        x = BertEncoder(c, token_embed=token_embed, name="encoder")(input_ids, train)
+        x = nn.gelu(nn.Dense(c.hidden_size, dtype=c.dtype, name="mlm_dense")(x))
+        x = nn.LayerNorm(dtype=c.dtype, name="mlm_ln")(x)
+        logits = token_embed.attend(x)  # tied output projection
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros, (c.vocab_size,)
+        ).astype(c.dtype)
+        return logits.astype(jnp.float32)
+
+
+# the Trainer picks TP rules up from the model class (trainer.py)
+for _cls in (BertEncoder, BertForSequenceClassification, BertForMaskedLM):
+    _cls.PARTITION_RULES = PARTITION_RULES

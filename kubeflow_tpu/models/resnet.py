@@ -1,0 +1,134 @@
+"""ResNet family — north-star config #2 (BASELINE.md: ResNet-50 images/sec/chip).
+
+TPU-first choices:
+  - channels-last NHWC (XLA's native conv layout on TPU; MXU tiles want the
+    channel dim innermost),
+  - bf16 compute / f32 params via the `dtype` attr (trainer casts inputs),
+  - BatchNorm under jit SPMD: the batch axis is sharded over the mesh's
+    data axes, so the mean/var reductions XLA inserts are *global* psums —
+    sync-BN for free, no NCCL sync-BN plumbing like the reference's user
+    images (kubeflow/examples resnet — SURVEY.md L6) need,
+  - static shapes everywhere; stride/padding arithmetic resolved at trace.
+
+Parity target: the reference platform launches torchvision/TF ResNet-50 user
+images under TFJob/PyTorchJob (SURVEY.md §2.2 data-parallel row); here the
+model is in-tree so every parallelism axis can be tested end-to-end.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut on shape change."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.act(self.norm()(y))
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.act(self.norm()(y))
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale: residual branch starts as identity,
+        # the standard trick for stable large-batch training
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = self.norm()(residual)
+        return self.act(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 block (ResNet-18/34)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.act(self.norm()(y))
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = self.norm()(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet over NHWC images.
+
+    stage_sizes/block pick the variant; `small_inputs` swaps the 7x7/stride-2
+    stem + maxpool for a 3x3 stem (CIFAR/MNIST-scale images).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.float32
+    small_inputs: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:  # flat grayscale vectors (mnist-style fixtures)
+            side = int(x.shape[-1] ** 0.5)
+            x = x.reshape((x.shape[0], side, side, 1))
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = conv(self.width, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.width, (7, 7), strides=(2, 2), name="conv_init")(x)
+        x = nn.relu(norm(name="bn_init")(x))
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.width * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock)
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3), block_cls=BottleneckBlock)

@@ -1,0 +1,119 @@
+"""Pipeline parallelism — GPipe-style microbatch loop over the `pipeline` axis.
+
+The reference has no in-platform PP (DeepSpeed/Megatron user images supply it
+— SURVEY.md §2.2); here it is a first-class, single-program SPMD construct:
+
+  - per-stage params are stacked on a leading stage axis sharded over the
+    mesh's `pipeline` axis (one stage's weights per device group),
+  - a lax.scan runs n_micro + n_stages - 1 ticks; each tick every stage
+    applies itself to its current microbatch and the activation ring rotates
+    one hop via ppermute (single-program — no MPMD runtime needed, cf. the
+    MPMD PP paper in PAPERS.md for the road not taken),
+  - reverse-mode autodiff through scan+ppermute yields the backward pipeline
+    automatically — no hand-written 1F1B schedule.
+
+Bubble fraction is (S-1)/(T+S-1) as in GPipe; raise n_micro to amortize.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE
+
+
+def stack_stage_params(per_stage: list[Any]) -> Any:
+    """Stack a list of per-stage param pytrees on a new leading stage axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def stage_pspec(params_stacked: Any) -> Any:
+    """PartitionSpec tree sharding the leading stage axis over `pipeline`."""
+    return jax.tree.map(
+        lambda x: P(AXIS_PIPELINE, *([None] * (jnp.ndim(x) - 1))), params_stacked
+    )
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    params_stacked: Any,
+    x: jax.Array,
+    n_micro: int,
+    axis_name: str = AXIS_PIPELINE,
+) -> jax.Array:
+    """Apply a pipeline of identical-signature stages to a global batch.
+
+    stage_fn(stage_params, activation) -> activation, same shape contract at
+    every stage boundary. params_stacked has leading dim n_stages (sharded
+    over `pipeline`); x is (B, ...) with B % n_micro == 0. Must run inside
+    jit under an ambient mesh containing the `pipeline` axis.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    n_stages = mesh.shape[axis_name]
+    if x.shape[0] % n_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible by n_micro {n_micro}")
+    if n_stages == 1:
+        params0 = jax.tree.map(lambda p: p[0], params_stacked)
+        return stage_fn(params0, x)
+
+    mb = x.shape[0] // n_micro
+    x_mb = x.reshape(n_micro, mb, *x.shape[1:])
+
+    def per_device(params_local, x_mb):
+        # params_local leading dim is 1 (this device's stage); squeeze it
+        params = jax.tree.map(lambda p: p[0], params_local)
+        stage = jax.lax.axis_index(axis_name)
+        ring = jax.lax.axis_size(axis_name)
+        perm = [(i, (i + 1) % ring) for i in range(ring)]
+        ticks = n_micro + n_stages - 1
+
+        def tick(carry, t):
+            circ, outbuf = carry
+            # stage 0 ingests microbatch t (zeros after the last one);
+            # other stages consume what rotated in from the previous stage
+            feed_idx = jnp.clip(t, 0, n_micro - 1)
+            feeding = (t < n_micro).astype(x_mb.dtype)
+            inp = jnp.where(
+                stage == 0,
+                jnp.take(x_mb, feed_idx, axis=0) * feeding,
+                circ,
+            )
+            out = stage_fn(params, inp)
+            # last stage emits microbatch t-(S-1) once the pipe is full
+            emit_idx = t - (n_stages - 1)
+            is_emit = jnp.logical_and(stage == ring - 1, emit_idx >= 0)
+            outbuf = jax.lax.cond(
+                is_emit,
+                lambda ob: jax.lax.dynamic_update_index_in_dim(
+                    ob, out, jnp.maximum(emit_idx, 0), 0
+                ),
+                lambda ob: ob,
+                outbuf,
+            )
+            circ = jax.lax.ppermute(out, axis_name, perm)
+            return (circ, outbuf), None
+
+        init = (
+            jnp.zeros_like(x_mb[0]),
+            jnp.zeros((n_micro, *x_mb.shape[1:]), x_mb.dtype),
+        )
+        (circ, outbuf), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+        # only the last stage holds real outputs; psum broadcasts them so the
+        # result is replicated over the pipeline axis
+        outbuf = jnp.where(stage == ring - 1, outbuf, jnp.zeros_like(outbuf))
+        return jax.lax.psum(outbuf, axis_name)
+
+    pspec = jax.tree.map(
+        lambda x: P(axis_name, *([None] * (jnp.ndim(x) - 1))), params_stacked
+    )
+    out_mb = jax.shard_map(
+        per_device,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(params_stacked, x_mb)
+    return out_mb.reshape(n_micro * mb, *out_mb.shape[2:])

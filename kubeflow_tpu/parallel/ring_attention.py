@@ -1,0 +1,330 @@
+"""Context-parallel attention: ring attention, Ulysses, and a pallas flash kernel.
+
+The reference platform has NO sequence parallelism anywhere (SURVEY.md §5.7)
+— it schedules containers and never sees sequence length. For capability
+parity as a long-context training platform, this module supplies it
+TPU-first:
+
+  ring_attention     KV blocks rotate around the ICI ring via ppermute while
+                     each device accumulates online-softmax partial results —
+                     sequence memory per chip is L/ring_size, compute overlaps
+                     communication (Liu et al., Ring Attention; PAPERS.md).
+  ulysses_attention  all-to-all head scatter: re-shard (seq/ctx, heads) ->
+                     (seq, heads/ctx), run dense/blockwise attention locally,
+                     scatter back (DeepSpeed-Ulysses; PAPERS.md).
+  flash_attention    single-device blockwise-softmax pallas kernel (VMEM
+                     accumulators, MXU matmuls, f32 softmax), custom-VJP'd
+                     with a recomputing jnp backward.
+
+All functions share the signature of models.bert.dense_attention:
+  (q, k, v, bias, dropout_rng, dropout_rate, block) -> out
+with q/k/v: (B, L, H, D), bias: (B, 1, 1, L) additive, out: (B, L, H, D).
+Attention-probability dropout is unsupported in the context-parallel paths
+(standard for ring implementations); pass dropout_rate=0.
+
+Layout contract under context parallelism (models/bert.py ACT_SPEC):
+  q/k/v sharded P((data, fsdp), context, model, None) — seq over `context`,
+  heads over `model`; bias P((data, fsdp), None, None, context).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:  # pallas import kept optional so CPU-only paths never require Mosaic
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+)
+
+NEG_INF = -1e9
+
+QKV_SPEC = P((AXIS_DATA, AXIS_FSDP), AXIS_CONTEXT, AXIS_MODEL, None)
+BIAS_SPEC = P((AXIS_DATA, AXIS_FSDP), None, None, AXIS_CONTEXT)
+
+
+def _context_size() -> int:
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty:
+        try:  # eager path; raises inside jit, where abstract mesh is set
+            mesh = jax.sharding.get_mesh()
+        except ValueError:
+            return 1
+    if mesh.empty or AXIS_CONTEXT not in mesh.shape:
+        return 1
+    return mesh.shape[AXIS_CONTEXT]
+
+
+# --------------------------------------------------------------------- jnp core
+
+
+def _online_block(carry, kv, q, scale):
+    """One online-softmax accumulation step against a KV block.
+
+    carry: (o_acc f32 (B,Lq,H,D), m (B,H,Lq,1) running max, l (B,H,Lq,1) sum)
+    kv:    (k_blk, v_blk, bias_blk (B,1,1,Lk))
+    """
+    o_acc, m, l = carry
+    k_blk, v_blk, bias_blk = kv
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k_blk).astype(jnp.float32) * scale
+    s = s + bias_blk.astype(jnp.float32)
+    m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l * corr + p.sum(-1, keepdims=True)
+    pv = jnp.einsum("bhlm,bmhd->blhd", p.astype(q.dtype), v_blk).astype(jnp.float32)
+    o_new = o_acc * corr.transpose(0, 2, 1, 3) + pv
+    return (o_new, m_new, l_new)
+
+
+def _finalize(o_acc, m, l, dtype):
+    return (o_acc / l.transpose(0, 2, 1, 3)).astype(dtype)
+
+
+def _init_carry(q):
+    b, lq, h, d = q.shape
+    return (
+        jnp.zeros((b, lq, h, d), jnp.float32),
+        jnp.full((b, h, lq, 1), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, lq, 1), jnp.float32),
+    )
+
+
+def blockwise_attention(q, k, v, bias, block: int = 256):
+    """Memory-efficient attention: lax.scan over KV blocks, online softmax.
+
+    Differentiable everywhere (the autodiff of scan recomputes nothing extra
+    beyond the saved block residuals); the numerics reference for both the
+    pallas kernel and the ring path.
+    """
+    b, lk, h, d = k.shape
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    block = min(block, lk)
+    n_blocks = lk // block
+    if n_blocks * block != lk:  # ragged tail: fall back to one block
+        n_blocks, block = 1, lk
+    kb = k.reshape(b, n_blocks, block, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, n_blocks, block, h, d).transpose(1, 0, 2, 3, 4)
+    bias_b = bias.reshape(b, 1, 1, n_blocks, block).transpose(3, 0, 1, 2, 4)
+
+    def step(carry, kv):
+        return _online_block(carry, kv, q, scale), None
+
+    carry, _ = jax.lax.scan(step, _init_carry(q), (kb, vb, bias_b))
+    return _finalize(*carry, q.dtype)
+
+
+# ------------------------------------------------------------------------ ring
+
+
+def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                   block: int = 256, axis_name: str = AXIS_CONTEXT):
+    """Ring attention over the `context` mesh axis.
+
+    Inside: per-device online-softmax accumulation against the local KV
+    block, then ppermute rotates (k, v, bias) one hop around the ring;
+    after ring_size steps every query block has seen every KV block. The
+    softmax statistics (m, l) make the result exactly equal to dense
+    attention — verified in tests to 1e-5.
+    """
+    if dropout_rate:
+        raise NotImplementedError("attention dropout unsupported in ring path")
+    ctx = _context_size()
+    if ctx == 1:
+        return blockwise_attention(q, k, v, bias, block)
+
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def per_device(q, k, v, bias):
+        ring = jax.lax.axis_size(axis_name)
+        perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+        def step(i, carry_kv):
+            carry, kv = carry_kv
+            carry = _online_block(carry, kv, q, scale)
+            # rotate KV (+ its bias slice) one hop; unconditional so the
+            # collective never sits inside data-dependent control flow (the
+            # final rotation just restores original placement). XLA overlaps
+            # the ppermute with the next iteration's matmuls.
+            kv = jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
+            return (carry, kv)
+
+        carry, _ = jax.lax.fori_loop(
+            0, ring, step, (_init_carry(q), (k, v, bias))
+        )
+        return _finalize(*carry, q.dtype)
+
+    return jax.shard_map(
+        per_device,
+        in_specs=(QKV_SPEC, QKV_SPEC, QKV_SPEC, BIAS_SPEC),
+        out_specs=QKV_SPEC,
+        check_vma=False,
+    )(q, k, v, bias)
+
+
+# --------------------------------------------------------------------- ulysses
+
+
+def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                      block: int = 256, axis_name: str = AXIS_CONTEXT):
+    """Ulysses context parallelism: all-to-all seq<->head re-shard.
+
+    Each device exchanges its sequence shard for a head shard (one all-to-all
+    over ICI), runs full-sequence blockwise attention on its heads, and
+    scatters back. Cheaper than ring when heads >= ring size and sequence
+    fits after the exchange.
+    """
+    if dropout_rate:
+        raise NotImplementedError("attention dropout unsupported in ulysses path")
+    ctx = _context_size()
+    if ctx == 1:
+        return blockwise_attention(q, k, v, bias, block)
+    mesh = jax.sharding.get_abstract_mesh()
+    model = mesh.shape.get(AXIS_MODEL, 1)
+    heads = q.shape[2]
+    if (heads // model) % ctx:
+        raise ValueError(
+            f"ulysses needs heads/model_parallel ({heads}/{model}) divisible "
+            f"by context axis size {ctx}; use ring attention instead"
+        )
+
+    def per_device(q, k, v, bias):
+        # (b, l/ctx, h_loc, d) -> (b, L, h_loc/ctx, d)
+        a2a = functools.partial(
+            jax.lax.all_to_all, axis_name=axis_name, split_axis=2,
+            concat_axis=1, tiled=True,
+        )
+        qg, kg, vg = a2a(q), a2a(k), a2a(v)
+        bias_g = jax.lax.all_gather(
+            bias, axis_name, axis=3, tiled=True
+        )
+        o = blockwise_attention(qg, kg, vg, bias_g, block)
+        return jax.lax.all_to_all(
+            o, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    return jax.shard_map(
+        per_device,
+        in_specs=(QKV_SPEC, QKV_SPEC, QKV_SPEC, BIAS_SPEC),
+        out_specs=QKV_SPEC,
+        check_vma=False,
+    )(q, k, v, bias)
+
+
+# ------------------------------------------------------------------ pallas fwd
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, scale: float, n_kv: int):
+    """Flash-attention forward tile: one (batch*head, q_block) position,
+    sequential grid over KV blocks with VMEM online-softmax accumulators."""
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]  # (bq, d)
+    k = k_ref[0]  # (bk, d)
+    v = v_ref[0]  # (bk, d)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (bq, bk)
+    s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
+    m_prev = m_scr[:]  # (bq, 1)
+    m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_scr[:] = l_scr[:] * corr + p.sum(-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = m_new
+
+    @pl.when(ik == n_kv - 1)
+    def _():
+        o_ref[0] = (acc_scr[:] / l_scr[:]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, bias, block_q: int, block_k: int):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = 1.0 / (d**0.5)
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q or lk % block_k:
+        return blockwise_attention(q, k, v, bias)
+    # fold heads into batch: (B*H, L, D)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    n_q, n_kv = lq // block_q, lk // block_k
+
+    kernel = functools.partial(_flash_kernel, scale=scale, n_kv=n_kv)
+    of = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec(
+                (1, 1, 1, block_k), lambda bh, iq, ik, h=h: (bh // h, 0, 0, ik)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=jax.default_backend() == "cpu",
+    )(qf, kf, vf, bias)
+    return of.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, bias, block_q, block_k):
+    return _flash_forward(q, k, v, bias, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, bias, block_q, block_k):
+    return _flash_forward(q, k, v, bias, block_q, block_k), (q, k, v, bias)
+
+
+def _flash_bwd(block_q, block_k, residuals, g):
+    q, k, v, bias = residuals
+    # recomputing jnp backward — memory-efficient via the scan in
+    # blockwise_attention; a fused pallas bwd kernel is a later optimization
+    _, vjp = jax.vjp(lambda q, k, v, bias: blockwise_attention(q, k, v, bias,
+                                                               block_k), q, k, v, bias)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                    block: int = 128):
+    """Pallas flash attention (single device / per-shard). Differentiable via
+    a recomputing backward; attention dropout unsupported."""
+    if dropout_rate:
+        raise NotImplementedError("attention dropout unsupported in flash path")
+    return _flash(q, k, v, bias, block, block)

@@ -5,19 +5,25 @@ FSDP: params additionally sharded over `fsdp` on their largest divisible
       axis (ZeRO-3 analogue — XLA all-gathers weights per layer and
       reduce-scatters grads; optimizer state inherits the param sharding
       through optax's tree structure).
-TP:   models annotate logical axes (flax partitioning) mapped via RULES;
-      handled in kubeflow_tpu/models with nn.with_partitioning.
+TP:   models publish PARTITION_RULES — (path_regex, PartitionSpec) pairs
+      matched against the '/'-joined param path (t5x-style). Rules win over
+      the FSDP heuristic; unmatched params fall back to it. The same rules
+      apply to optimizer state because adam's mu/nu trees embed the param
+      path as a suffix of their own tree path.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import re
+from typing import Any, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP
+
+Rules = Sequence[tuple[str, P]]
 
 
 def batch_pspec() -> P:
@@ -61,20 +67,79 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def put_global(x: Any, sharding: NamedSharding) -> Any:
+    """Place one host array under a sharding, single- or multi-process.
+
+    Multi-process convention: every process holds the full host value (data
+    pipelines are seed-deterministic), and each device picks its slice via
+    make_array_from_callback — the multi-host-safe construction (device_put
+    cannot target non-addressable devices).
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
 def shard_batch(batch: Any, mesh: Mesh) -> Any:
     """Place a host batch onto the mesh, split along the data axes."""
     s = batch_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, s), batch)
+    return jax.tree.map(lambda x: put_global(x, s), batch)
 
 
-def shard_state(state: Any, mesh: Mesh) -> Any:
-    """Place a TrainState: params/opt_state FSDP-sharded, scalars replicated."""
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
 
-    def one(leaf):
-        if np.ndim(leaf) == 0:
-            return jax.device_put(leaf, replicated(mesh))
-        fsdp_size = mesh.shape[AXIS_FSDP]
-        ns = NamedSharding(mesh, fsdp_param_pspec(np.shape(leaf), fsdp_size))
-        return jax.device_put(leaf, ns)
 
-    return jax.tree.map(one, state)
+def _spec_fits(spec: P, shape: tuple[int, ...], mesh: Mesh) -> bool:
+    """A rule spec applies only if rank matches and every named dim divides."""
+    if len(spec) > len(shape):
+        return False
+    for dim, axes in enumerate(spec):
+        if axes is None:
+            continue
+        axes = axes if isinstance(axes, tuple) else (axes,)
+        size = int(np.prod([mesh.shape[a] for a in axes]))
+        if shape[dim] % size != 0:
+            return False
+    return True
+
+
+def state_pspec(
+    path_str: str, shape: tuple[int, ...], mesh: Mesh, rules: Rules | None
+) -> P:
+    """PartitionSpec for one state leaf: rules first, FSDP heuristic second."""
+    if len(shape) == 0:
+        return P()
+    if rules:
+        for pattern, spec in rules:
+            if re.search(pattern, path_str) and _spec_fits(spec, shape, mesh):
+                return spec
+    return fsdp_param_pspec(shape, mesh.shape[AXIS_FSDP])
+
+
+def shard_state(state: Any, mesh: Mesh, rules: Rules | None = None) -> Any:
+    """Place a TrainState: params/opt_state rule- or FSDP-sharded, scalars
+    replicated. Paths are matched on the full state path, so rules written
+    against param paths also hit the mirrored adam mu/nu trees."""
+    return jax.tree.map(put_global, state, state_shardings(state, mesh, rules))
+
+
+def state_shardings(state: Any, mesh: Mesh, rules: Rules | None = None) -> Any:
+    """NamedSharding pytree matching `state` (for jit out_shardings/ckpt)."""
+
+    def one(path, leaf):
+        spec = state_pspec(_path_str(path), np.shape(leaf), mesh, rules)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, state)

@@ -1,0 +1,75 @@
+"""Worker-side distributed bootstrap — the consumer of the L3 env contract.
+
+The controller synthesizes JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID (controller/envcontract.py#jax_env); this module is the other
+half: a worker process calls `initialize_from_env()` first thing, which wires
+`jax.distributed.initialize` (the gRPC coordination service built into
+jaxlib — the TPU-native replacement for the reference's c10d/NCCL rendezvous,
+SURVEY.md §2.3) and returns the process topology.
+
+Works identically on: real multi-host TPU slices (env comes from GKE), local
+multi-process CPU gangs (env comes from the fake cluster's LocalResolver),
+and single-process runs (no env -> no-op).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class DistContext:
+    process_id: int
+    num_processes: int
+    coordinator: str | None
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def initialize_from_env(
+    platform: str | None = None, local_device_count: int | None = None
+) -> DistContext:
+    """Initialize jax.distributed from the JAXJob env contract.
+
+    platform: force a jax platform ("cpu" for local gangs — two processes
+    cannot share the one axon TPU chip). local_device_count: virtual CPU
+    devices this process contributes (overrides any inherited XLA_FLAGS —
+    pod processes inherit the parent env, which may carry a test harness's
+    device-count flag). Must run before any other jax use.
+    """
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    n = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    pid = int(os.environ.get("JAX_PROCESS_ID", "0"))
+
+    if local_device_count is not None:
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={local_device_count}"
+        ).strip()
+
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if coord and n > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=n, process_id=pid
+        )
+    return DistContext(process_id=pid, num_processes=n, coordinator=coord)
+
+
+def shutdown() -> None:
+    import jax
+
+    if jax.process_count() > 1:
+        jax.distributed.shutdown()

@@ -46,12 +46,18 @@ class LocalResolver:
         for rtype, rs in self.job.spec.replica_specs.items():
             for i in range(rs.replicas):
                 self.endpoint(rtype, i)
+        # Longest-first + boundary lookahead so 'job-worker-1' never rewrites
+        # the prefix of 'job-worker-10' (hostname chars are [A-Za-z0-9.-]).
+        hosts = sorted(self.port_map, key=len, reverse=True)
         out = {}
         for k, v in env.items():
-            for host, port in self.port_map.items():
+            for host in hosts:
+                port = self.port_map[host]
                 v = re.sub(
                     rf"{re.escape(host)}:\d+", f"127.0.0.1:{port}", v
                 )
-                v = v.replace(host, "127.0.0.1")
+                v = re.sub(
+                    rf"{re.escape(host)}(?![A-Za-z0-9.-])", "127.0.0.1", v
+                )
             out[k] = v
         return out

@@ -72,6 +72,39 @@ def synthetic_image_dataset(
     return Dataset(x_tr, y_tr, x_te, y_te, num_classes)
 
 
+def synthetic_text_dataset(
+    n_train: int = 1024,
+    n_test: int = 256,
+    seq_len: int = 128,
+    vocab_size: int = 1024,
+    num_classes: int = 2,
+    pad_token_id: int = 0,
+    seed: int = 0,
+) -> Dataset:
+    """Token-sequence classification set with learnable class structure:
+    each class draws tokens from its own skewed unigram distribution, with
+    random-length tail padding so padding masks are exercised."""
+    rng = np.random.RandomState(seed)
+    # class-specific token distributions over [1, vocab) (0 reserved for pad)
+    logits = rng.normal(0, 1.5, size=(num_classes, vocab_size - 1))
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+
+    def make(n: int) -> tuple[np.ndarray, np.ndarray]:
+        y = rng.randint(0, num_classes, size=n).astype(np.int32)
+        x = np.zeros((n, seq_len), np.int32)
+        for i in range(n):
+            length = rng.randint(seq_len // 2, seq_len + 1)
+            x[i, :length] = rng.choice(
+                vocab_size - 1, size=length, p=probs[y[i]]
+            ) + 1
+        x[:, :] = np.where(x == 0, pad_token_id, x)
+        return x, y
+
+    x_tr, y_tr = make(n_train)
+    x_te, y_te = make(n_test)
+    return Dataset(x_tr, y_tr, x_te, y_te, num_classes)
+
+
 def batches(
     x: np.ndarray,
     y: np.ndarray,

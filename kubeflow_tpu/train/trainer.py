@@ -16,6 +16,7 @@ device-flag-selectable equivalent (north-star configs #1-#3).
 
 from __future__ import annotations
 
+import inspect
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -40,6 +41,9 @@ class TrainState(struct.PyTreeNode):
     params: Any
     opt_state: Any
     rng: jax.Array
+    # non-param variable collections (e.g. {"batch_stats": ...}); empty dict
+    # for purely functional models
+    extra: Any = struct.field(default_factory=dict)
 
 
 @dataclass
@@ -66,8 +70,10 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
 class Trainer:
     """Classification trainer for a flax module `model(x) -> logits`.
 
-    apply_fn can be overridden for models needing rngs/mutable state; it
-    receives (params, x, rng, train) and returns logits.
+    Handles models with mutable collections (BatchNorm batch_stats) and a
+    `train: bool` kwarg automatically. apply_fn can be overridden for exotic
+    models; it receives (params, extra, x, rng, train) and returns
+    (logits, new_extra) where extra is the dict of non-param collections.
     """
 
     def __init__(
@@ -78,20 +84,39 @@ class Trainer:
         apply_fn: Callable | None = None,
         loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = cross_entropy_loss,
         mesh: Mesh | None = None,
+        partition_rules: Any = None,
     ):
         self.model = model
         self.config = config
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh or MeshConfig())
-        self.loss_fn = loss_fn
-        self.apply_fn = apply_fn or (
-            lambda params, x, rng, train: model.apply({"params": params}, x)
+        # models may publish TP rules as a PARTITION_RULES attribute
+        self.partition_rules = (
+            partition_rules
+            if partition_rules is not None
+            else getattr(model, "PARTITION_RULES", None)
         )
+        self.loss_fn = loss_fn
+        self._accepts_train = model is not None and (
+            "train" in inspect.signature(model.__call__).parameters
+        )
+        self.apply_fn = apply_fn or self._default_apply
         self.tx = tx if tx is not None else self._default_tx()
         self._jit_train_step = jax.jit(self._train_step, donate_argnums=0)
         self._jit_eval_step = jax.jit(self._eval_step)
         self.checkpointer = (
             Checkpointer(config.checkpoint_dir) if config.checkpoint_dir else None
         )
+
+    def _default_apply(self, params, extra, x, rng, train):
+        variables = {"params": params, **extra}
+        kwargs = {"train": train} if self._accepts_train else {}
+        rngs = {"dropout": rng}
+        if train and extra:
+            logits, updates = self.model.apply(
+                variables, x, rngs=rngs, mutable=list(extra), **kwargs
+            )
+            return logits, dict(updates)
+        return self.model.apply(variables, x, rngs=rngs, **kwargs), extra
 
     def _default_tx(self) -> optax.GradientTransformation:
         c = self.config
@@ -107,39 +132,52 @@ class Trainer:
     def init_state(self, sample_x: np.ndarray) -> TrainState:
         rng = jax.random.PRNGKey(self.config.seed)
         p_rng, s_rng = jax.random.split(rng)
-        params = self.model.init(p_rng, jnp.asarray(sample_x))["params"]
+        x = self._cast(jnp.asarray(sample_x))
+        kwargs = {"train": False} if self._accepts_train else {}
+        variables = dict(self.model.init(p_rng, x, **kwargs))
+        params = variables.pop("params")
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=self.tx.init(params),
             rng=s_rng,
+            extra=variables,
         )
-        return shard_state(state, self.mesh)
+        return shard_state(state, self.mesh, self.partition_rules)
 
     # ------------------------------------------------------------------ steps
+
+    def _cast(self, x):
+        """Cast float leaves to compute_dtype; ints (token ids) untouched."""
+        dt = self.config.compute_dtype
+        return jax.tree.map(
+            lambda a: a.astype(dt) if jnp.issubdtype(a.dtype, jnp.floating) else a, x
+        )
 
     def _train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         x, y = batch
         step_rng = jax.random.fold_in(state.rng, state.step)
-        x = x.astype(self.config.compute_dtype)
+        x = self._cast(x)
 
         def loss_of(params):
-            logits = self.apply_fn(params, x, step_rng, True)
-            return self.loss_fn(logits.astype(jnp.float32), y), logits
+            logits, new_extra = self.apply_fn(params, state.extra, x, step_rng, True)
+            return self.loss_fn(logits.astype(jnp.float32), y), (logits, new_extra)
 
-        (loss, logits), grads = jax.value_and_grad(loss_of, has_aux=True)(state.params)
+        (loss, (logits, new_extra)), grads = jax.value_and_grad(
+            loss_of, has_aux=True
+        )(state.params)
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         acc = (jnp.argmax(logits, -1) == y).mean()
         new_state = state.replace(
-            step=state.step + 1, params=params, opt_state=opt_state
+            step=state.step + 1, params=params, opt_state=opt_state, extra=new_extra
         )
         return new_state, {"loss": loss, "accuracy": acc}
 
     def _eval_step(self, state: TrainState, batch) -> dict:
         x, y, w = batch  # w: validity mask for padded tail batches
-        logits = self.apply_fn(
-            state.params, x.astype(self.config.compute_dtype), state.rng, False
+        logits, _ = self.apply_fn(
+            state.params, state.extra, self._cast(x), state.rng, False
         )
         logits = logits.astype(jnp.float32)
         per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
@@ -150,7 +188,10 @@ class Trainer:
         }
 
     def train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
-        return self._jit_train_step(state, shard_batch(batch, self.mesh))
+        # ambient mesh enables P-form with_sharding_constraint pins inside
+        # models (bert.constrain) without threading the mesh through modules
+        with jax.set_mesh(self.mesh):
+            return self._jit_train_step(state, shard_batch(batch, self.mesh))
 
     # ------------------------------------------------------------------- fit
 
@@ -240,7 +281,8 @@ class Trainer:
                 bx = np.concatenate([bx, np.zeros((pad, *bx.shape[1:]), bx.dtype)])
                 by = np.concatenate([by, np.zeros((pad,), by.dtype)])
             w = (np.arange(bs) < n).astype(np.float32)
-            m = self._jit_eval_step(state, shard_batch((bx, by, w), self.mesh))
+            with jax.set_mesh(self.mesh):
+                m = self._jit_eval_step(state, shard_batch((bx, by, w), self.mesh))
             tot_loss += float(m["loss_sum"])
             correct += int(m["correct"])
             count += int(m["count"])

@@ -1,0 +1,116 @@
+"""BERT family tests: shapes, param count, TP sharding, end-to-end training."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.models import (
+    BertConfig,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+)
+from kubeflow_tpu.models.bert import PARTITION_RULES
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.sharding import state_pspec
+from kubeflow_tpu.train import Trainer, TrainerConfig
+from kubeflow_tpu.train.data import synthetic_text_dataset
+
+
+def test_bert_base_param_count():
+    model = BertForMaskedLM(BertConfig.base())
+    ids = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(variables["params"]))
+    # BERT-base ~110M with tied MLM head
+    assert 105_000_000 < n < 115_000_000
+
+
+def test_bert_classifier_forward_and_padding_invariance():
+    cfg = BertConfig.tiny(dropout_rate=0.0)
+    model = BertForSequenceClassification(cfg, num_classes=3)
+    ids = np.random.RandomState(0).randint(1, cfg.vocab_size, (2, 32)).astype(np.int32)
+    ids[:, 20:] = cfg.pad_token_id
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))
+    out = model.apply(variables, jnp.asarray(ids))
+    assert out.shape == (2, 3)
+    # changing content in padded region must not change logits
+    ids2 = ids.copy()
+    ids2[:, 25] = 0  # already pad; flip a padded position's would-be value
+    out2 = model.apply(variables, jnp.asarray(ids2))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-5)
+
+
+def test_bert_mlm_logits_shape():
+    cfg = BertConfig.tiny()
+    model = BertForMaskedLM(cfg)
+    ids = jnp.ones((2, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    out = model.apply(variables, ids)
+    assert out.shape == (2, 16, cfg.vocab_size)
+
+
+def test_partition_rules_cover_matmul_params():
+    cfg = BertConfig.tiny()
+    model = BertForSequenceClassification(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2))
+    from flax.traverse_util import flatten_dict
+
+    flat = flatten_dict(params)
+    tp_hits = 0
+    for path, leaf in flat.items():
+        ps = "/".join(path)
+        spec = state_pspec(ps, leaf.shape, mesh, PARTITION_RULES)
+        if "model" in jax.tree.leaves(tuple(spec)):
+            tp_hits += 1
+        if re.search(r"(query|key|value|mlp_up|mlp_down|attn_out)/kernel", ps):
+            assert "model" in jax.tree.leaves(tuple(spec)), ps
+    assert tp_hits >= 6 * cfg.num_layers  # qkv+out+2 mlp kernels per layer
+
+
+def test_bert_trains_dp_tp_mesh():
+    cfg = BertConfig.tiny(dropout_rate=0.0)
+    ds = synthetic_text_dataset(
+        n_train=128, n_test=32, seq_len=32, vocab_size=cfg.vocab_size
+    )
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2))
+    trainer = Trainer(
+        BertForSequenceClassification(cfg, num_classes=2),
+        TrainerConfig(batch_size=16, steps=25, learning_rate=1e-3,
+                      log_every_steps=10**9),
+        mesh=mesh,
+    )
+    # verify TP placement actually happened
+    state = trainer.init_state(ds.x_train[:16])
+    qkernel = state.params["encoder"]["layer_0"]["attention"]["query"]["kernel"]
+    assert "model" in jax.tree.leaves(tuple(qkernel.sharding.spec))
+    _, metrics = trainer.fit(ds)
+    assert metrics["final_accuracy"] > 0.7  # unigram classes are separable
+
+
+def test_bert_single_device_matches_tp_numerics():
+    cfg = BertConfig.tiny(dropout_rate=0.0)
+    ds = synthetic_text_dataset(n_train=32, n_test=8, seq_len=16,
+                                vocab_size=cfg.vocab_size)
+    batch = (ds.x_train[:8], ds.y_train[:8])
+    losses = {}
+    for name, mcfg in {
+        "single": MeshConfig(data=1),
+        "tp": MeshConfig(data=2, model=4),
+    }.items():
+        devices = jax.devices()[:1] if name == "single" else None
+        mesh = build_mesh(mcfg, devices)
+        trainer = Trainer(
+            BertForSequenceClassification(cfg, num_classes=2),
+            TrainerConfig(batch_size=8, log_every_steps=10**9),
+            mesh=mesh,
+        )
+        state = trainer.init_state(ds.x_train[:8])
+        _, m = trainer.train_step(state, batch)
+        losses[name] = float(m["loss"])
+    assert losses["single"] == pytest.approx(losses["tp"], rel=1e-4)

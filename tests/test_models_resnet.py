@@ -1,0 +1,78 @@
+"""ResNet model family + BatchNorm-through-trainer tests (CPU 8-device mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import ResNet18, ResNet50
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.train import Trainer, TrainerConfig
+from kubeflow_tpu.train.data import synthetic_image_dataset
+
+
+def tiny_resnet(**kw):
+    """Narrow ResNet-18-shaped net: fast on CPU, same code paths as 50."""
+    return ResNet18(num_classes=10, width=8, small_inputs=True, **kw)
+
+
+def test_resnet50_forward_shape_and_params():
+    model = ResNet50(num_classes=1000)
+    x = jnp.zeros((2, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 1000)
+    assert "batch_stats" in variables
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(variables["params"]))
+    # canonical ResNet-50 parameter count ~25.5M
+    assert 25_000_000 < n_params < 26_000_000
+
+
+def test_batchnorm_stats_update_through_trainer():
+    ds = synthetic_image_dataset(n_train=64, n_test=16, shape=(16, 16, 3))
+    trainer = Trainer(tiny_resnet(), TrainerConfig(batch_size=16, steps=2))
+    state = trainer.init_state(ds.x_train[:16])
+    assert "batch_stats" in state.extra
+    before = jax.tree.map(np.asarray, state.extra["batch_stats"])
+    state, m = trainer.train_step(state, (ds.x_train[:16], ds.y_train[:16]))
+    after = jax.tree.map(np.asarray, state.extra["batch_stats"])
+    diffs = jax.tree.map(lambda a, b: float(np.abs(a - b).max()), before, after)
+    assert max(jax.tree.leaves(diffs)) > 0  # running stats moved
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_resnet_trains_on_synthetic_data():
+    ds = synthetic_image_dataset(n_train=256, n_test=64, shape=(16, 16, 3))
+    trainer = Trainer(
+        tiny_resnet(),
+        TrainerConfig(batch_size=32, steps=30, learning_rate=3e-3,
+                      log_every_steps=10**9),
+    )
+    _, metrics = trainer.fit(ds)
+    # learnable template dataset: even a tiny net should beat chance x3
+    assert metrics["final_accuracy"] > 0.3
+
+
+def test_resnet_dp_fsdp_mesh_step():
+    mesh = build_mesh(MeshConfig(data=4, fsdp=2))
+    ds = synthetic_image_dataset(n_train=64, n_test=16, shape=(16, 16, 3))
+    trainer = Trainer(
+        tiny_resnet(), TrainerConfig(batch_size=16), mesh=mesh
+    )
+    state = trainer.init_state(ds.x_train[:16])
+    state, m = trainer.train_step(state, (ds.x_train[:16], ds.y_train[:16]))
+    jax.block_until_ready(m["loss"])
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_resnet_bf16_compute():
+    ds = synthetic_image_dataset(n_train=32, n_test=16, shape=(16, 16, 3))
+    trainer = Trainer(
+        tiny_resnet(dtype=jnp.bfloat16),
+        TrainerConfig(batch_size=16, compute_dtype=jnp.bfloat16),
+    )
+    state = trainer.init_state(ds.x_train[:16])
+    # params stay f32 (param_dtype default), compute in bf16
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(state.params))
+    state, m = trainer.train_step(state, (ds.x_train[:16], ds.y_train[:16]))
+    assert np.isfinite(float(m["loss"]))
