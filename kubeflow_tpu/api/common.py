@@ -15,8 +15,13 @@ from dataclasses import dataclass, field
 from typing import Any
 
 
-def _utcnow() -> str:
+def utcnow() -> str:
+    """Canonical timestamp format for every object/status in the platform
+    (jobcontroller._parse_ts assumes exactly this shape)."""
     return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+_utcnow = utcnow
 
 
 class RestartPolicy(str, enum.Enum):
@@ -71,6 +76,9 @@ class ObjectMeta:
     # Set by the object store on admission (k8s semantics); empty until then so
     # spec serialization stays deterministic for golden-file tests.
     creation_timestamp: str = ""
+    # Optimistic-concurrency token (k8s resourceVersion): bumped by the store
+    # on every write; a stale-version update is rejected with ConflictError.
+    resource_version: int = 0
 
 
 @dataclass

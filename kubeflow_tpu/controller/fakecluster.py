@@ -19,13 +19,18 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
-from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.api.common import ObjectMeta, utcnow as _ts
 
 
 class EventType(str, enum.Enum):
     ADDED = "ADDED"
     MODIFIED = "MODIFIED"
     DELETED = "DELETED"
+
+
+class ConflictError(Exception):
+    """Optimistic-concurrency failure: the object changed since it was read
+    (k8s 409 Conflict analogue). Callers re-read and retry."""
 
 
 class PodPhase(str, enum.Enum):
@@ -70,8 +75,12 @@ class PodGroup:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     min_member: int = 1
     queue: str = "default"
-    # TPU slice topology this gang occupies (atomic unit, SURVEY.md §2.2)
+    # PER-SLICE TPU topology (atomic unit, SURVEY.md §2.2); informational —
+    # the scheduler charges `chips`.
     slice_topology: str = ""
+    # Total chip reservation: topology chips x num_slices, set by the job
+    # controller; 0 = charge one chip per pod.
+    chips: int = 0
     phase: str = "Pending"  # Pending -> Running once gang-bound
 
     @property
@@ -116,15 +125,29 @@ class FakeCluster:
                 obj.metadata.uid = f"uid-{self._rv}"
             if not obj.metadata.creation_timestamp:
                 obj.metadata.creation_timestamp = _ts()
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
             self._objects[kind][key] = obj
             self._notify(EventType.ADDED, kind, obj)
             return obj
 
     def update(self, kind: str, obj: Any) -> Any:
+        """Swap in `obj`. Rejects stale writes: obj's resource_version must
+        match the stored one (always true when mutating the stored object in
+        place; snapshot writers get ConflictError and must re-read)."""
         with self._mu:
             key = self._key(obj)
-            if key not in self._objects[kind]:
+            stored = self._objects[kind].get(key)
+            if stored is None:
                 raise KeyError(f"{kind} {key} not found")
+            if obj.metadata.resource_version != stored.metadata.resource_version:
+                raise ConflictError(
+                    f"{kind} {key}: resource_version "
+                    f"{obj.metadata.resource_version} != "
+                    f"{stored.metadata.resource_version}"
+                )
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
             self._objects[kind][key] = obj
             self._notify(EventType.MODIFIED, kind, obj)
             return obj
@@ -136,9 +159,14 @@ class FakeCluster:
                 self._notify(EventType.DELETED, kind, obj)
             return obj
 
-    def get(self, kind: str, key: str) -> Any | None:
+    def get(self, kind: str, key: str, copy_obj: bool = False) -> Any | None:
+        """Fetch by key. copy_obj=True returns a deep snapshot — required by
+        any caller that mutates and writes back (read-copy-update), so
+        concurrent writers are detected via resource_version instead of
+        silently interleaving on a shared live object."""
         with self._mu:
-            return self._objects[kind].get(key)
+            obj = self._objects[kind].get(key)
+            return copy.deepcopy(obj) if copy_obj and obj is not None else obj
 
     def list(
         self, kind: str, selector: Callable[[Any], bool] | None = None
@@ -185,9 +213,3 @@ class FakeCluster:
     @staticmethod
     def _key(obj: Any) -> str:
         return f"{obj.metadata.namespace}/{obj.metadata.name}"
-
-
-def _ts() -> str:
-    import datetime
-
-    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")

@@ -63,13 +63,32 @@ class GangScheduler:
             groups = self.cluster.list("podgroups")
             for pg in groups:
                 if pg.phase == "Running":
-                    # release capacity when the gang has fully exited
-                    members = self._members(pg)
-                    if members and all(
-                        p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
-                        for p in members
-                    ):
-                        pass  # capacity released on podgroup delete
+                    # an admitted gang may still grow members (min_member can
+                    # be below the replica total): bind late arrivals so they
+                    # are never stranded pending behind an already-bound gang
+                    late = [
+                        p for p in self._members(pg)
+                        if p.status.phase == PodPhase.PENDING and not p.status.node
+                    ]
+                    if late:
+                        # chip-reserved gangs already hold their whole slices;
+                        # count-sized gangs need capacity for the extras
+                        extra = 0 if pg.chips else len(late)
+                        used = sum(self._bound_chips.values())
+                        if used + extra > self.cluster.capacity_chips:
+                            self.cluster.record_event(
+                                "podgroups", pg.key, "Unschedulable",
+                                f"late members need {extra} chips, "
+                                f"{self.cluster.capacity_chips - used} free",
+                                type="Warning",
+                            )
+                            continue
+                        for i, p in enumerate(late):
+                            p.status.node = f"slice-0-host-late-{i}"
+                            self.cluster.update("pods", p)
+                        self._bound_chips[pg.key] = (
+                            self._bound_chips.get(pg.key, 0) + extra
+                        )
                     continue
                 members = self._members(pg)
                 pending = [
@@ -78,7 +97,7 @@ class GangScheduler:
                 ]
                 if len(pending) < pg.min_member:
                     continue
-                chips_needed = topology_chips(pg.slice_topology) or len(pending)
+                chips_needed = pg.chips or len(pending)
                 used = sum(self._bound_chips.values())
                 if used + chips_needed > self.cluster.capacity_chips:
                     self.cluster.record_event(

@@ -23,11 +23,13 @@ from kubeflow_tpu.api.common import (
     ReplicaStatus,
     RestartPolicy,
     is_retryable_exit_code,
+    utcnow as _now_ts,
 )
 from kubeflow_tpu.api.jobs import SUCCESS_REPLICA, JobKind, TrainJob, REPLICA_WORKER
 from kubeflow_tpu.api.common import ObjectMeta
 from kubeflow_tpu.controller.envcontract import synthesize_env
 from kubeflow_tpu.controller.fakecluster import (
+    ConflictError,
     EventType,
     FakeCluster,
     Pod,
@@ -40,6 +42,10 @@ from kubeflow_tpu.runtime.rendezvous import LocalResolver
 JOB_NAME_LABEL = "kubeflow-tpu.org/job-name"
 REPLICA_TYPE_LABEL = "kubeflow-tpu.org/replica-type"
 REPLICA_INDEX_LABEL = "kubeflow-tpu.org/replica-index"
+# World size the pod's env contract was synthesized for. SPMD cannot change
+# world size live: any mismatch with the current spec forces a whole-gang
+# re-mesh (elastic scale event), never an in-place patch.
+WORLD_SIZE_LABEL = "kubeflow-tpu.org/world-size"
 
 
 class JobController:
@@ -69,6 +75,7 @@ class JobController:
             "jobs_succeeded_total": 0,
             "jobs_failed_total": 0,
             "jobs_restarted_total": 0,
+            "jobs_remeshed_total": 0,
             "pods_created_total": 0,
             "pods_deleted_total": 0,
         }
@@ -135,6 +142,11 @@ class JobController:
                 self.wq.forget(key)
                 if requeue_after is not None:
                     self.wq.add_after(key, requeue_after)
+            except ConflictError:
+                # benign: object changed under this pass (client scale/suspend
+                # or a peer worker); the conflicting write's own watch event
+                # re-enqueues the key, but requeue anyway for belt-and-braces
+                self.wq.add_rate_limited(key)
             except Exception as exc:  # noqa: BLE001 — reconcile must not die
                 self.metrics["reconcile_errors_total"] += 1
                 self.cluster.record_event(
@@ -147,8 +159,16 @@ class JobController:
     # ------------------------------------------------------------- reconcile
 
     def reconcile(self, key: str) -> float | None:
-        """One level-triggered pass. Returns optional requeue delay."""
-        job: TrainJob | None = self.cluster.get("jobs", key)
+        """One level-triggered pass. Returns optional requeue delay.
+
+        Works on a deep snapshot of the job (read-copy-update): every
+        status write goes through cluster.update, which rejects the write
+        with ConflictError if a client mutated the spec mid-pass — the pass
+        is then simply retried against fresh state. This is the same
+        optimistic-concurrency discipline the reference controllers get from
+        the k8s apiserver's resourceVersion.
+        """
+        job: TrainJob | None = self.cluster.get("jobs", key, copy_obj=True)
         if job is None:
             self.exp.delete(key)
             self.wq.forget(key)
@@ -158,7 +178,12 @@ class JobController:
         st = job.status
         entry_fp = _status_fingerprint(st)
         if not st.conditions:
+            # persist-then-emit: a ConflictError before the persist must not
+            # have incremented counters or recorded events (replay hazard)
             st.set_condition(JobConditionType.CREATED, "JobCreated")
+            job = self.cluster.update("jobs", job)
+            st = job.status
+            entry_fp = _status_fingerprint(st)
             self.metrics["jobs_created_total"] += 1
             self.cluster.record_event("jobs", key, "JobCreated", "created")
 
@@ -170,10 +195,13 @@ class JobController:
 
         # -- suspension (runPolicy.suspend)
         if job.spec.run_policy.suspend:
-            self._delete_pods(key, pods)
+            if pods:
+                self._delete_pods(key, pods)
             self._delete_podgroup(job)
-            st.set_condition(JobConditionType.SUSPENDED, "JobSuspended")
-            self.cluster.update("jobs", job)
+            self._resolvers.pop(key, None)
+            if not st.has_condition(JobConditionType.SUSPENDED):
+                st.set_condition(JobConditionType.SUSPENDED, "JobSuspended")
+                self.cluster.update("jobs", job)
             return None
         if st.has_condition(JobConditionType.SUSPENDED):
             st.set_condition(JobConditionType.RESTARTING, "JobResumed")
@@ -192,6 +220,26 @@ class JobController:
         if not self.exp.satisfied(key):
             return 0.05
 
+        # -- elastic re-mesh: pods built for a different world size must all
+        # go; the gang restarts at the new size from checkpoint (slice-
+        # granular scaling, SURVEY.md §2.2/§5.3)
+        if pods and self._needs_remesh(job, pods):
+            st.set_condition(
+                JobConditionType.RESTARTING,
+                "ElasticRemesh",
+                f"re-meshing gang to {job.total_replicas()} replicas",
+            )
+            self.cluster.update("jobs", job)
+            self._delete_pods(key, pods)
+            self._delete_podgroup(job)
+            self._resolvers.pop(key, None)
+            self.metrics["jobs_remeshed_total"] += 1
+            self.cluster.record_event(
+                "jobs", key, "ElasticRemesh",
+                f"scale -> {job.total_replicas()} replicas (gang re-mesh)",
+            )
+            return 0.05
+
         # -- failure handling (gang semantics)
         failed = [p for p in pods if p.status.phase == PodPhase.FAILED]
         if failed:
@@ -201,10 +249,10 @@ class JobController:
         if self._is_succeeded(job, pods):
             st.set_condition(JobConditionType.SUCCEEDED, "JobSucceeded")
             st.completion_time = _now_ts()
-            self.metrics["jobs_succeeded_total"] += 1
-            self.cluster.record_event("jobs", key, "JobSucceeded", "completed")
             self._update_replica_statuses(job, pods)
             self.cluster.update("jobs", job)
+            self.metrics["jobs_succeeded_total"] += 1
+            self.cluster.record_event("jobs", key, "JobSucceeded", "completed")
             return 0.0  # immediate cleanup pass
 
     # -- pod/podgroup creation
@@ -227,6 +275,17 @@ class JobController:
         return 0.2 if created else None
 
     # ---------------------------------------------------------- sub-steps
+
+    def _needs_remesh(self, job: TrainJob, pods: list[Pod]) -> bool:
+        """True when any live pod's env contract was synthesized for a world
+        size other than the spec's current one. Pods predating the label are
+        grandfathered; a fully-succeeded gang is left to success detection."""
+        if all(p.status.phase == PodPhase.SUCCEEDED for p in pods):
+            return False
+        want = str(job.total_replicas())
+        return any(
+            p.metadata.labels.get(WORLD_SIZE_LABEL, want) != want for p in pods
+        )
 
     def _owned_pods(self, job: TrainJob) -> list[Pod]:
         return self.cluster.list(
@@ -252,18 +311,29 @@ class JobController:
             return 0
 
         self._ensure_podgroup(job)
-        resolver = self._resolvers.setdefault(key, LocalResolver(job))
+        # The resolver must persist across passes within one gang incarnation
+        # (pods created in different passes need identical port maps), but a
+        # stale one — built for a different replica set, e.g. after a
+        # suspend -> scale -> resume — would leave new hostnames unrewritten.
+        resolver = self._resolvers.get(key)
+        if resolver is None or _replica_signature(resolver.job) != _replica_signature(job):
+            resolver = LocalResolver(job)
+            self._resolvers[key] = resolver
         self.exp.expect_creations(key, len(to_create))
         for rtype, i in to_create:
             env = synthesize_env(job, rtype, i)
             if self.local_rewrite:
                 env = resolver.rewrite_env(env)
             c = job.spec.replica_specs[rtype].template.container
+            # job-level labels (e.g. the experiment label) propagate to pods,
+            # mirroring k8s template-label propagation
+            labels = {**job.metadata.labels, **job.labels(rtype, i)}
+            labels[WORLD_SIZE_LABEL] = str(job.total_replicas())
             pod = Pod(
                 metadata=ObjectMeta(
                     name=job.replica_name(rtype, i),
                     namespace=job.metadata.namespace,
-                    labels=job.labels(rtype, i),
+                    labels=labels,
                 ),
                 command=list(c.command) + list(c.args),
                 env=env,
@@ -280,13 +350,21 @@ class JobController:
         if self.cluster.get("podgroups", pg_key) is not None:
             return
         sp = job.spec.run_policy.scheduling_policy
+        # Clamp to the current total: a stale min_available above the post-
+        # scale-down replica count would make the gang unsatisfiable forever.
+        total = job.total_replicas()
+        from kubeflow_tpu.controller.gang import topology_chips
+
+        topo = sp.slice_topology if sp else ""
         pg = PodGroup(
             metadata=ObjectMeta(
                 name=job.metadata.name, namespace=job.metadata.namespace
             ),
-            min_member=(sp.min_available if sp and sp.min_available else job.total_replicas()),
+            min_member=(min(sp.min_available, total) if sp and sp.min_available else total),
             queue=sp.queue if sp else "default",
-            slice_topology=sp.slice_topology if sp else "",
+            slice_topology=topo,
+            # a multislice job reserves num_slices whole slices
+            chips=topology_chips(topo) * max(job.spec.num_slices, 1),
         )
         self.cluster.create("podgroups", pg)
 
@@ -295,6 +373,13 @@ class JobController:
     ) -> float | None:
         st = job.status
         rp = job.spec.run_policy
+        # Elastic jobs budget restarts via ElasticPolicy.max_restarts
+        # (torchelastic PET_MAX_RESTARTS parity); others via backoff_limit.
+        limit = (
+            rp.elastic_policy.max_restarts
+            if rp.elastic_policy is not None
+            else rp.backoff_limit
+        )
         # Decide retryability from each failed pod's replica restart policy.
         retryable = True
         for p in failed:
@@ -306,7 +391,7 @@ class JobController:
             elif policy == RestartPolicy.EXIT_CODE:
                 if not is_retryable_exit_code(p.status.exit_code or 1):
                     retryable = False
-        if not retryable or st.restart_count >= rp.backoff_limit:
+        if not retryable or st.restart_count >= limit:
             reason = (
                 "BackoffLimitExceeded"
                 if retryable
@@ -315,24 +400,27 @@ class JobController:
             self._fail(job, key, pods,
                        reason,
                        f"{len(failed)} replica(s) failed "
-                       f"(restarts={st.restart_count}/{rp.backoff_limit})")
+                       f"(restarts={st.restart_count}/{limit})")
             return None
-        # gang restart: tear down ALL pods, restart from checkpoint
+        # gang restart: tear down ALL pods, restart from checkpoint.
+        # Persist the incremented count BEFORE deleting pods: a conflict here
+        # retries cleanly, whereas deleting first and conflicting after would
+        # lose the increment and grant a free restart.
         st.restart_count += 1
-        self.metrics["jobs_restarted_total"] += 1
         st.set_condition(
             JobConditionType.RESTARTING,
             "GangRestart",
-            f"restart {st.restart_count}/{rp.backoff_limit}",
+            f"restart {st.restart_count}/{limit}",
         )
+        self.cluster.update("jobs", job)
+        self._delete_pods(key, pods)
+        self._delete_podgroup(job)
+        self.metrics["jobs_restarted_total"] += 1
         self.cluster.record_event(
             "jobs", key, "GangRestart",
             f"worker failure -> gang restart {st.restart_count}",
             type="Warning",
         )
-        self._delete_pods(key, pods)
-        self._delete_podgroup(job)
-        self.cluster.update("jobs", job)
         return 0.05
 
     def _is_succeeded(self, job: TrainJob, pods: list[Pod]) -> bool:
@@ -389,10 +477,10 @@ class JobController:
     ) -> None:
         job.status.set_condition(JobConditionType.FAILED, reason, msg)
         job.status.completion_time = _now_ts()
-        self.metrics["jobs_failed_total"] += 1
-        self.cluster.record_event("jobs", key, reason, msg, type="Warning")
         self._update_replica_statuses(job, pods)
         self.cluster.update("jobs", job)
+        self.metrics["jobs_failed_total"] += 1
+        self.cluster.record_event("jobs", key, reason, msg, type="Warning")
 
     def _delete_pods(self, key: str, pods: list[Pod]) -> None:
         if not pods:
@@ -428,6 +516,30 @@ class JobController:
         job.status.replica_statuses = stats
 
 
+def delete_job_cascade(cluster: FakeCluster, name: str, namespace: str = "default") -> None:
+    """Tear down a job and everything it owns (pods, podgroup) — the shared
+    delete path for the SDK client, sweep engine, and anything else that
+    removes jobs out-of-band."""
+    key = f"{namespace}/{name}"
+    for p in cluster.list(
+        "pods",
+        lambda p: p.metadata.labels.get(JOB_NAME_LABEL) == name
+        and p.metadata.namespace == namespace,
+    ):
+        cluster.delete("pods", p.key)
+    cluster.delete("podgroups", key)
+    cluster.delete("jobs", key)
+
+
+def _replica_signature(job: TrainJob) -> tuple:
+    """Identity of a job's rendezvous-relevant shape: if this changes, the
+    old incarnation's resolver/port map no longer covers the replica set."""
+    return (
+        tuple(sorted((rt, rs.replicas) for rt, rs in job.spec.replica_specs.items())),
+        job.spec.coordinator_port,
+    )
+
+
 def _status_fingerprint(st) -> tuple:
     """Hashable snapshot of the reconcile-relevant status (excludes
     last_reconcile_time, which must never itself trigger an update)."""
@@ -441,12 +553,6 @@ def _status_fingerprint(st) -> tuple:
         st.completion_time,
         st.restart_count,
     )
-
-
-def _now_ts() -> str:
-    import datetime
-
-    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
 def _parse_ts(ts: str) -> float:

@@ -1,0 +1,291 @@
+"""P4: elastic scaling + checkpoint-resume drills.
+
+Reference parity: PyTorchJob ElasticPolicy (torchelastic min/max nnodes,
+max_restarts — SURVEY.md §2.2 'Elastic DP', §5.3). TPU semantics differ by
+design: every scale event is a whole-gang re-mesh (SPMD world size is
+compile-time), resumed from checkpoint, at slice granularity.
+"""
+
+import sys
+import textwrap
+import time
+
+import pytest
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    ElasticPolicy,
+    JAXJob,
+    JAXJobSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.client import Platform, TrainingClient
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16)
+    with p:
+        yield p
+
+
+@pytest.fixture()
+def client(platform):
+    return TrainingClient(platform)
+
+
+def elastic_job(tmp_path, name, body, replicas=2, ep=None, restart=RestartPolicy.ON_FAILURE):
+    path = tmp_path / f"{name}.py"
+    path.write_text(textwrap.dedent(body))
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        spec=JAXJobSpec(
+            replica_specs={
+                REPLICA_WORKER: ReplicaSpec(
+                    replicas=replicas,
+                    restart_policy=restart,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(command=[sys.executable, str(path)])
+                    ),
+                )
+            },
+            run_policy=RunPolicy(
+                elastic_policy=ep or ElasticPolicy(min_replicas=1, max_replicas=8)
+            ),
+        ),
+    )
+
+
+def wait_running(client, name, n, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        j = client.get_job(name)
+        rs = j.status.replica_statuses.get(REPLICA_WORKER)
+        if rs and rs.active == n and j.status.has_condition(JobConditionType.RUNNING):
+            return j
+        time.sleep(0.1)
+    raise TimeoutError(f"{name}: never reached {n} running replicas")
+
+
+class TestElasticScale:
+    def test_scale_up_remeshes_gang(self, client, tmp_path):
+        marker = tmp_path / "go"
+        job = elastic_job(
+            tmp_path,
+            "growjob",
+            f"""
+            import os, time
+            while not os.path.exists({str(marker)!r}):
+                time.sleep(0.05)
+            print("world", os.environ["JAX_NUM_PROCESSES"],
+                  "rank", os.environ["JAX_PROCESS_ID"])
+            """,
+            replicas=2,
+        )
+        client.create_job(job)
+        wait_running(client, "growjob", 2)
+
+        client.scale_job("growjob", 4)
+        wait_running(client, "growjob", 4)
+        marker.write_text("go")
+        done = client.wait_for_job_conditions("growjob", timeout_s=30)
+        assert done.status.is_succeeded
+        assert done.status.replica_statuses[REPLICA_WORKER].succeeded == 4
+        assert any(e.reason == "ElasticRemesh" for e in client.get_events("growjob"))
+        # every post-remesh worker saw the new world size in its env contract
+        for i in range(4):
+            assert "world 4" in client.get_job_logs("growjob", index=i)
+
+    def test_scale_down_remeshes_gang(self, client, tmp_path):
+        marker = tmp_path / "go"
+        job = elastic_job(
+            tmp_path,
+            "shrinkjob",
+            f"""
+            import os, time
+            while not os.path.exists({str(marker)!r}):
+                time.sleep(0.05)
+            print("world", os.environ["JAX_NUM_PROCESSES"])
+            """,
+            replicas=4,
+        )
+        client.create_job(job)
+        wait_running(client, "shrinkjob", 4)
+        client.scale_job("shrinkjob", 2)
+        wait_running(client, "shrinkjob", 2)
+        marker.write_text("go")
+        done = client.wait_for_job_conditions("shrinkjob", timeout_s=30)
+        assert done.status.is_succeeded
+        assert done.status.replica_statuses[REPLICA_WORKER].succeeded == 2
+        # stale high-index pods are gone, not orphaned
+        assert client.cluster.get("pods", "default/shrinkjob-worker-3") is None
+
+    def test_scale_down_with_gang_policy_not_deadlocked(self, client, tmp_path):
+        """A stale min_available above the new replica count must not leave
+        the re-meshed gang unschedulable."""
+        from kubeflow_tpu.api import SchedulingPolicy
+
+        marker = tmp_path / "go"
+        job = elastic_job(
+            tmp_path,
+            "gangshrink",
+            f"""
+            import os, time
+            while not os.path.exists({str(marker)!r}):
+                time.sleep(0.05)
+            """,
+            replicas=4,
+        )
+        job.spec.run_policy.scheduling_policy = SchedulingPolicy(min_available=4)
+        client.create_job(job)
+        wait_running(client, "gangshrink", 4)
+        client.scale_job("gangshrink", 2)
+        wait_running(client, "gangshrink", 2)
+        marker.write_text("go")
+        done = client.wait_for_job_conditions("gangshrink", timeout_s=30)
+        assert done.status.is_succeeded
+
+    def test_scale_up_with_gang_policy_binds_all(self, client, tmp_path):
+        """Scale-up must not strand pods: a min_available sized for the old
+        gang may admit a partial gang; late members still get bound."""
+        from kubeflow_tpu.api import SchedulingPolicy
+
+        marker = tmp_path / "go"
+        job = elastic_job(
+            tmp_path,
+            "ganggrow",
+            f"""
+            import os, time
+            while not os.path.exists({str(marker)!r}):
+                time.sleep(0.05)
+            print("world", os.environ["JAX_NUM_PROCESSES"])
+            """,
+            replicas=2,
+        )
+        job.spec.run_policy.scheduling_policy = SchedulingPolicy(min_available=2)
+        client.create_job(job)
+        wait_running(client, "ganggrow", 2)
+        client.scale_job("ganggrow", 4)
+        wait_running(client, "ganggrow", 4)
+        marker.write_text("go")
+        done = client.wait_for_job_conditions("ganggrow", timeout_s=30)
+        assert done.status.is_succeeded
+        assert done.status.replica_statuses[REPLICA_WORKER].succeeded == 4
+
+    def test_scale_finished_job_rejected(self, client, tmp_path):
+        job = elastic_job(tmp_path, "donejob", "print('bye')", replicas=1)
+        client.create_job(job)
+        client.wait_for_job_conditions("donejob", timeout_s=30)
+        with pytest.raises(ValueError, match="already finished"):
+            client.scale_job("donejob", 2)
+
+    def test_scale_validation(self, client, tmp_path):
+        job = elastic_job(
+            tmp_path, "boundsjob", "import time; time.sleep(30)",
+            replicas=2, ep=ElasticPolicy(min_replicas=2, max_replicas=4),
+        )
+        client.create_job(job)
+        with pytest.raises(ValueError, match="outside elastic range"):
+            client.scale_job("boundsjob", 8)
+        with pytest.raises(ValueError, match="outside elastic range"):
+            client.scale_job("boundsjob", 1)
+
+    def test_scale_requires_elastic_policy(self, client, tmp_path):
+        path = tmp_path / "rigid.py"
+        path.write_text("import time; time.sleep(30)")
+        job = JAXJob(
+            metadata=ObjectMeta(name="rigid"),
+            spec=JAXJobSpec(
+                replica_specs={
+                    REPLICA_WORKER: ReplicaSpec(
+                        replicas=2,
+                        template=PodTemplateSpec(
+                            container=ContainerSpec(command=[sys.executable, str(path)])
+                        ),
+                    )
+                }
+            ),
+        )
+        client.create_job(job)
+        with pytest.raises(ValueError, match="no elasticPolicy"):
+            client.scale_job("rigid", 4)
+
+    def test_slice_granular_scale(self, client, tmp_path):
+        """With num_slices>1, scaling must move by whole slices and num_slices
+        tracks the new size."""
+        job = elastic_job(
+            tmp_path, "sliced", "import time; time.sleep(30)",
+            replicas=4, ep=ElasticPolicy(min_replicas=2, max_replicas=8),
+        )
+        job.spec.num_slices = 2  # 2 workers per slice
+        client.create_job(job)
+        with pytest.raises(ValueError, match="whole slices"):
+            client.scale_job("sliced", 5)
+        client.scale_job("sliced", 6)
+        assert client.get_job("sliced").spec.num_slices == 3
+
+
+class TestElasticRestarts:
+    def test_max_restarts_budget(self, client, tmp_path):
+        job = elastic_job(
+            tmp_path, "crashelastic", "raise SystemExit(3)",
+            replicas=1,
+            ep=ElasticPolicy(min_replicas=1, max_replicas=2, max_restarts=1),
+        )
+        job.spec.run_policy.backoff_limit = 10  # must NOT be the limit used
+        client.create_job(job)
+        done = client.wait_for_job_conditions("crashelastic", timeout_s=60)
+        assert done.status.is_failed
+        assert done.status.restart_count == 1
+
+
+class TestCheckpointResume:
+    def test_gang_restart_resumes_from_checkpoint(self, client, platform, tmp_path):
+        """Worker 'trains' with file checkpoints; a fault-injected kill mid-run
+        triggers a gang restart; the rerun resumes from the checkpointed step
+        (the controller guarantees the same checkpoint dir across restarts)."""
+        ckpt = tmp_path / "ckpt"
+        armed = tmp_path / "armed"   # tells the test the first run is mid-loop
+        job = elastic_job(
+            tmp_path,
+            "resumable",
+            f"""
+            import os, time
+            ckpt, total = {str(ckpt)!r}, 40
+            start = int(open(ckpt).read()) if os.path.exists(ckpt) else 0
+            print("start_step", start, flush=True)
+            for step in range(start, total):
+                time.sleep(0.05)
+                with open(ckpt + ".tmp", "w") as f:
+                    f.write(str(step + 1))
+                os.replace(ckpt + ".tmp", ckpt)
+                if step == 5:
+                    open({str(armed)!r}, "w").write("x")
+            print("final_step", total)
+            """,
+            replicas=1,
+        )
+        client.create_job(job)
+        deadline = time.monotonic() + 30
+        while not armed.exists():
+            assert time.monotonic() < deadline, "worker never reached step 5"
+            time.sleep(0.05)
+        assert platform.pod_runtime.inject_kill("default/resumable-worker-0")
+        done = client.wait_for_job_conditions("resumable", timeout_s=60)
+        assert done.status.is_succeeded
+        assert done.status.restart_count >= 1
+        log = client.get_job_logs("resumable")
+        # the resumed incarnation started past step 0
+        resumed_starts = [
+            int(line.split()[1])
+            for line in log.splitlines()
+            if line.startswith("start_step")
+        ]
+        assert resumed_starts and resumed_starts[-1] > 0
+        assert "final_step 40" in log
