@@ -13,16 +13,17 @@ import time
 from pathlib import Path
 
 from kubeflow_tpu.api.common import JobConditionType
-from kubeflow_tpu.api.jobs import TrainJob
+from kubeflow_tpu.api.jobs import REPLICA_WORKER, TrainJob
 from kubeflow_tpu.api.validation import validate_job
-from kubeflow_tpu.controller.fakecluster import FakeCluster
+from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
 from kubeflow_tpu.controller.gang import GangScheduler
-from kubeflow_tpu.controller.jobcontroller import JobController
+from kubeflow_tpu.controller.jobcontroller import JobController, delete_job_cascade
 from kubeflow_tpu.controller.podruntime import PodRuntime
 
 
 class Platform:
-    """One in-process 'cluster': apiserver + scheduler + kubelet + operator."""
+    """One in-process 'cluster': apiserver + scheduler + kubelet + operators
+    (job controller + experiment controller)."""
 
     def __init__(
         self,
@@ -30,22 +31,36 @@ class Platform:
         capacity_chips: int = 8,
         controller_workers: int = 2,
     ):
+        from kubeflow_tpu.sweep.controller import ExperimentController
+
         self.cluster = FakeCluster()
         self.cluster.capacity_chips = capacity_chips
         self.pod_runtime = PodRuntime(self.cluster, log_dir=log_dir)
         self.gang_scheduler = GangScheduler(self.cluster)
         self.controller = JobController(self.cluster, workers=controller_workers)
+        self.experiment_controller = ExperimentController(
+            self.cluster, log_reader=self._read_pod_log
+        )
         self._started = False
+
+    def _read_pod_log(self, pod_name: str) -> str:
+        path = self.pod_runtime.log_path(pod_name)
+        try:
+            return path.read_text()
+        except OSError:
+            return ""
 
     def start(self) -> "Platform":
         if not self._started:
             self.pod_runtime.start()
             self.gang_scheduler.start()
             self.controller.start()
+            self.experiment_controller.start()
             self._started = True
         return self
 
     def stop(self) -> None:
+        self.experiment_controller.stop()
         self.controller.stop()
         self.gang_scheduler.stop()
         self.pod_runtime.stop()
@@ -81,28 +96,80 @@ class TrainingClient:
         )
 
     def delete_job(self, name: str, namespace: str = "default") -> None:
-        key = f"{namespace}/{name}"
-        for p in self.cluster.list(
-            "pods", lambda p: p.metadata.labels.get("kubeflow-tpu.org/job-name") == name
-            and p.metadata.namespace == namespace
-        ):
-            self.cluster.delete("pods", p.key)
-        self.cluster.delete("podgroups", key)
-        self.cluster.delete("jobs", key)
+        delete_job_cascade(self.cluster, name, namespace)
+
+    def scale_job(
+        self, name: str, replicas: int, namespace: str = "default"
+    ) -> TrainJob:
+        """Elastic scale: set the worker count of a running JAXJob.
+
+        TPU elasticity is slice-granular (SURVEY.md §2.2): the new size must
+        keep whole slices, and the change lands as a whole-gang re-mesh
+        (coordinator restart + resume from checkpoint), never a live resize.
+        Requires an ElasticPolicy and min_replicas <= replicas <= max_replicas.
+        """
+        def mutate(job: TrainJob) -> None:
+            if job.status.is_finished:
+                raise ValueError(f"job {name} already finished; cannot scale")
+            ep = job.spec.run_policy.elastic_policy
+            if ep is None:
+                raise ValueError(f"job {name} has no elasticPolicy; cannot scale")
+            if not (ep.min_replicas <= replicas <= ep.max_replicas):
+                raise ValueError(
+                    f"replicas {replicas} outside elastic range "
+                    f"[{ep.min_replicas}, {ep.max_replicas}]"
+                )
+            workers = job.spec.replica_specs.get(REPLICA_WORKER)
+            if workers is None:
+                raise ValueError(f"job {name} has no worker replicas; cannot scale")
+            old_total = job.total_replicas()
+            if job.spec.num_slices > 1:
+                per_slice = workers.replicas // job.spec.num_slices
+                if replicas % per_slice:
+                    raise ValueError(
+                        f"replicas {replicas} not a multiple of per-slice worker "
+                        f"count {per_slice} (scale by whole slices)"
+                    )
+                job.spec.num_slices = replicas // per_slice
+            workers.replicas = replicas
+            sp = job.spec.run_policy.scheduling_policy
+            if sp is not None and sp.min_available is not None:
+                # full-gang intent follows the new size; an explicit partial
+                # min stays, clamped to remain satisfiable
+                if sp.min_available >= old_total:
+                    sp.min_available = job.total_replicas()
+                else:
+                    sp.min_available = min(sp.min_available, job.total_replicas())
+
+        return self._read_modify_write(name, namespace, mutate)
+
+    def _read_modify_write(
+        self, name: str, namespace: str, mutate, retries: int = 10
+    ) -> TrainJob:
+        """Optimistic-concurrency update: snapshot, mutate, swap; retry on
+        ConflictError (the controller writes status concurrently)."""
+        for _ in range(retries):
+            job = self.cluster.get("jobs", f"{namespace}/{name}", copy_obj=True)
+            if job is None:
+                raise KeyError(name)
+            mutate(job)
+            try:
+                return self.cluster.update("jobs", job)
+            except ConflictError:
+                time.sleep(0.01)
+        raise ConflictError(f"update of {namespace}/{name} kept conflicting")
 
     def suspend_job(self, name: str, namespace: str = "default") -> None:
-        job = self.get_job(name, namespace)
-        if job is None:
-            raise KeyError(name)
-        job.spec.run_policy.suspend = True
-        self.cluster.update("jobs", job)
+        def mutate(job: TrainJob) -> None:
+            job.spec.run_policy.suspend = True
+
+        self._read_modify_write(name, namespace, mutate)
 
     def resume_job(self, name: str, namespace: str = "default") -> None:
-        job = self.get_job(name, namespace)
-        if job is None:
-            raise KeyError(name)
-        job.spec.run_policy.suspend = False
-        self.cluster.update("jobs", job)
+        def mutate(job: TrainJob) -> None:
+            job.spec.run_policy.suspend = False
+
+        self._read_modify_write(name, namespace, mutate)
 
     # ---------------------------------------------------------------- status
 

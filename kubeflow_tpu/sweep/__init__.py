@@ -1,0 +1,66 @@
+"""Hyperparameter sweep engine — Katib parity (SURVEY.md §2.4).
+
+Experiment -> Suggestion -> Trial, where each trial is a rendered JAXJob
+launched through the same control plane as any other job. Suggestion
+algorithms are in-process (random/grid/TPE); metrics come from the
+`name=value` stdout contract the trainer already emits (§5.5).
+"""
+
+from kubeflow_tpu.sweep.api import (
+    AlgorithmSpec,
+    EarlyStoppingSpec,
+    Experiment,
+    ExperimentSpec,
+    ExperimentStatus,
+    FeasibleSpace,
+    Metric,
+    Objective,
+    ObjectiveType,
+    Observation,
+    ParameterAssignment,
+    ParameterSpec,
+    ParameterType,
+    Trial,
+    TrialSpec,
+    TrialStatus,
+    TrialTemplate,
+    TrialParameterSpec,
+)
+from kubeflow_tpu.sweep.client import SweepClient
+from kubeflow_tpu.sweep.collector import parse_metrics, observation_from_log
+from kubeflow_tpu.sweep.controller import ExperimentController
+from kubeflow_tpu.sweep.suggest import (
+    GridSuggester,
+    RandomSuggester,
+    TPESuggester,
+    get_suggester,
+)
+
+__all__ = [
+    "AlgorithmSpec",
+    "EarlyStoppingSpec",
+    "Experiment",
+    "ExperimentSpec",
+    "ExperimentStatus",
+    "ExperimentController",
+    "FeasibleSpace",
+    "GridSuggester",
+    "Metric",
+    "Objective",
+    "ObjectiveType",
+    "Observation",
+    "ParameterAssignment",
+    "ParameterSpec",
+    "ParameterType",
+    "RandomSuggester",
+    "SweepClient",
+    "TPESuggester",
+    "Trial",
+    "TrialSpec",
+    "TrialStatus",
+    "TrialTemplate",
+    "TrialParameterSpec",
+    "get_suggester",
+    "observation_from_log",
+    "parse_metrics",
+]

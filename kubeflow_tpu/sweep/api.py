@@ -1,0 +1,272 @@
+"""Sweep CR-equivalents: Experiment / Trial (+ embedded suggestion config).
+
+Reference parity (unverified cites, SURVEY.md §2.4): katib
+pkg/apis/controller/experiments/v1beta1/experiment_types.go and
+trials/v1beta1/trial_types.go. The Suggestion CR is collapsed into the
+experiment controller's in-process suggester — its gRPC boundary exists in
+the reference because algorithms run as separate Deployments; here they are
+library calls (the algorithms themselves are Python upstream too).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.common import ObjectMeta
+
+
+class ParameterType(str, enum.Enum):
+    DOUBLE = "double"
+    INT = "int"
+    CATEGORICAL = "categorical"
+    DISCRETE = "discrete"
+
+
+@dataclass
+class FeasibleSpace:
+    """Search domain for one parameter (min/max for numeric, list for
+    categorical/discrete; step optionally quantizes numeric grids)."""
+
+    min: str = ""
+    max: str = ""
+    list: list[str] = field(default_factory=lambda: [])
+    step: str = ""
+
+
+@dataclass
+class ParameterSpec:
+    name: str = ""
+    parameter_type: ParameterType = ParameterType.DOUBLE
+    feasible_space: FeasibleSpace = field(default_factory=FeasibleSpace)
+
+
+class ObjectiveType(str, enum.Enum):
+    MINIMIZE = "minimize"
+    MAXIMIZE = "maximize"
+
+
+@dataclass
+class Objective:
+    type: ObjectiveType = ObjectiveType.MAXIMIZE
+    # stop the experiment early once the best trial reaches this value
+    goal: float | None = None
+    objective_metric_name: str = ""
+    additional_metric_names: list[str] = field(default_factory=lambda: [])
+
+
+@dataclass
+class AlgorithmSpec:
+    algorithm_name: str = "random"  # random | grid | tpe
+    settings: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class EarlyStoppingSpec:
+    """medianstop parity: kill running trials whose objective is worse than
+    the median of completed trials (after min_trials_required complete)."""
+
+    algorithm_name: str = "medianstop"
+    min_trials_required: int = 3
+
+
+@dataclass
+class TrialParameterSpec:
+    """Binds a ${trialParameters.<name>} placeholder to a search parameter."""
+
+    name: str = ""
+    description: str = ""
+    reference: str = ""  # ParameterSpec.name this placeholder takes its value from
+
+
+@dataclass
+class TrialTemplate:
+    """The job a trial runs: any TrainJob manifest (YAML) with
+    ${trialParameters.x} placeholders — exactly how the reference launches
+    TFJobs/PyTorchJobs from experiments, and how JAXJobs launch here."""
+
+    trial_spec: str = ""  # YAML manifest with placeholders
+    trial_parameters: list[TrialParameterSpec] = field(default_factory=lambda: [])
+
+
+@dataclass
+class ExperimentSpec:
+    parameters: list[ParameterSpec] = field(default_factory=lambda: [])
+    objective: Objective = field(default_factory=Objective)
+    algorithm: AlgorithmSpec = field(default_factory=AlgorithmSpec)
+    trial_template: TrialTemplate = field(default_factory=TrialTemplate)
+    max_trial_count: int = 10
+    parallel_trial_count: int = 3
+    max_failed_trial_count: int = 3
+    early_stopping: EarlyStoppingSpec | None = None
+    # metrics are read from this replica's log (worker-0 by default)
+    metrics_replica_type: str = "worker"
+
+
+@dataclass
+class ParameterAssignment:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class Metric:
+    name: str = ""
+    latest: float = 0.0
+    min: float = 0.0
+    max: float = 0.0
+
+
+@dataclass
+class Observation:
+    metrics: list[Metric] = field(default_factory=lambda: [])
+
+    def metric(self, name: str) -> Metric | None:
+        for m in self.metrics:
+            if m.name == name:
+                return m
+        return None
+
+
+class TrialCondition(str, enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    EARLY_STOPPED = "EarlyStopped"
+    METRICS_UNAVAILABLE = "MetricsUnavailable"
+
+
+@dataclass
+class TrialSpec:
+    parameter_assignments: list[ParameterAssignment] = field(default_factory=lambda: [])
+    # fully-rendered manifest (template with assignments substituted)
+    rendered_spec: str = ""
+
+
+@dataclass
+class TrialStatus:
+    condition: TrialCondition = TrialCondition.CREATED
+    observation: Observation = field(default_factory=Observation)
+    start_time: str = ""
+    completion_time: str = ""
+
+    @property
+    def is_finished(self) -> bool:
+        return self.condition in (
+            TrialCondition.SUCCEEDED,
+            TrialCondition.FAILED,
+            TrialCondition.EARLY_STOPPED,
+            TrialCondition.METRICS_UNAVAILABLE,
+        )
+
+
+@dataclass
+class Trial:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TrialSpec = field(default_factory=TrialSpec)
+    status: TrialStatus = field(default_factory=TrialStatus)
+    kind: str = "Trial"
+    api_version: str = "kubeflow-tpu.org/v1beta1"
+
+    def assignments_dict(self) -> dict[str, str]:
+        return {a.name: a.value for a in self.spec.parameter_assignments}
+
+
+class ExperimentCondition(str, enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class OptimalTrial:
+    trial_name: str = ""
+    parameter_assignments: list[ParameterAssignment] = field(default_factory=lambda: [])
+    observation: Observation = field(default_factory=Observation)
+
+
+@dataclass
+class ExperimentStatus:
+    condition: ExperimentCondition = ExperimentCondition.CREATED
+    trials: int = 0
+    trials_running: int = 0
+    trials_succeeded: int = 0
+    trials_failed: int = 0
+    trials_early_stopped: int = 0
+    current_optimal_trial: OptimalTrial | None = None
+    start_time: str = ""
+    completion_time: str = ""
+    message: str = ""
+
+    @property
+    def is_finished(self) -> bool:
+        return self.condition in (
+            ExperimentCondition.SUCCEEDED,
+            ExperimentCondition.FAILED,
+        )
+
+
+@dataclass
+class Experiment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ExperimentSpec = field(default_factory=ExperimentSpec)
+    status: ExperimentStatus = field(default_factory=ExperimentStatus)
+    kind: str = "Experiment"
+    api_version: str = "kubeflow-tpu.org/v1beta1"
+
+
+def render_trial_spec(template: TrialTemplate, assignments: dict[str, str]) -> str:
+    """Substitute ${trialParameters.<name>} placeholders (katib's
+    trialTemplate substitution contract)."""
+    out = template.trial_spec
+    for tp in template.trial_parameters:
+        value = assignments.get(tp.reference or tp.name)
+        if value is None:
+            raise ValueError(
+                f"trial parameter {tp.name!r} references unknown search "
+                f"parameter {tp.reference!r}"
+            )
+        out = out.replace("${trialParameters." + tp.name + "}", value)
+    return out
+
+
+def validate_experiment(exp: Experiment) -> Experiment:
+    """Admission checks (katib experiment webhook parity)."""
+    if not exp.metadata.name:
+        raise ValueError("experiment: metadata.name is required")
+    if not exp.spec.parameters:
+        raise ValueError("experiment: at least one search parameter required")
+    names = set()
+    for p in exp.spec.parameters:
+        if not p.name or p.name in names:
+            raise ValueError(f"experiment: duplicate/empty parameter name {p.name!r}")
+        names.add(p.name)
+        fs = p.feasible_space
+        if p.parameter_type in (ParameterType.DOUBLE, ParameterType.INT):
+            if fs.min == "" or fs.max == "":
+                raise ValueError(f"parameter {p.name}: numeric space needs min/max")
+            if float(fs.min) > float(fs.max):
+                raise ValueError(f"parameter {p.name}: min > max")
+        else:
+            if not fs.list:
+                raise ValueError(f"parameter {p.name}: categorical space needs list")
+    if not exp.spec.objective.objective_metric_name:
+        raise ValueError("experiment: objective.objectiveMetricName required")
+    if exp.spec.algorithm.algorithm_name not in ("random", "grid", "tpe"):
+        raise ValueError(
+            f"experiment: unknown algorithm "
+            f"{exp.spec.algorithm.algorithm_name!r} (random|grid|tpe)"
+        )
+    if exp.spec.max_trial_count < 1 or exp.spec.parallel_trial_count < 1:
+        raise ValueError("experiment: trial counts must be >= 1")
+    if not exp.spec.trial_template.trial_spec:
+        raise ValueError("experiment: trialTemplate.trialSpec required")
+    for tp in exp.spec.trial_template.trial_parameters:
+        ref = tp.reference or tp.name
+        if ref not in names:
+            raise ValueError(
+                f"trialParameter {tp.name!r} references unknown parameter {ref!r}"
+            )
+    return exp

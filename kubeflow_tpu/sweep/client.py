@@ -1,0 +1,195 @@
+"""SweepClient — KatibClient parity (create_experiment / tune / wait).
+
+Reference parity (unverified cites, SURVEY.md §2.4): katib
+sdk/python/v1beta1 KatibClient.{create_experiment, tune, get_experiment,
+wait_for_experiment_condition, get_optimal_hyperparameters}. `tune()` wraps a
+plain Python function into a trial job by templating its source into a
+generated script — the same trick the reference SDK uses to containerize a
+function, minus the container image.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.sweep.api import (
+    AlgorithmSpec,
+    EarlyStoppingSpec,
+    Experiment,
+    ExperimentSpec,
+    Objective,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    Trial,
+    TrialParameterSpec,
+    TrialTemplate,
+    validate_experiment,
+)
+
+_CAST = {
+    ParameterType.DOUBLE: "float",
+    ParameterType.INT: "int",
+    ParameterType.CATEGORICAL: "str",
+    ParameterType.DISCRETE: "str",
+}
+
+
+class SweepClient:
+    def __init__(self, platform, work_dir: str = ".kubeflow_tpu/sweeps"):
+        self.platform = platform
+        self.cluster = platform.cluster
+        self.work_dir = Path(work_dir)
+
+    # ------------------------------------------------------------------ CRUD
+
+    def create_experiment(self, exp: Experiment) -> Experiment:
+        validate_experiment(exp)
+        return self.cluster.create("experiments", exp)
+
+    def get_experiment(self, name: str, namespace: str = "default") -> Experiment | None:
+        return self.cluster.get("experiments", f"{namespace}/{name}")
+
+    def list_trials(self, name: str, namespace: str = "default") -> list[Trial]:
+        return sorted(
+            self.cluster.list(
+                "trials",
+                lambda t: t.metadata.labels.get("kubeflow-tpu.org/experiment-name")
+                == name
+                and t.metadata.namespace == namespace,
+            ),
+            key=lambda t: t.metadata.name,
+        )
+
+    def delete_experiment(self, name: str, namespace: str = "default") -> None:
+        from kubeflow_tpu.controller.jobcontroller import delete_job_cascade
+
+        for t in self.list_trials(name, namespace):
+            delete_job_cascade(self.cluster, t.metadata.name, namespace)
+            self.cluster.delete("trials", f"{namespace}/{t.metadata.name}")
+        self.cluster.delete("experiments", f"{namespace}/{name}")
+
+    # ---------------------------------------------------------------- status
+
+    def wait_for_experiment(
+        self, name: str, namespace: str = "default", timeout_s: float = 300.0,
+        poll_s: float = 0.2,
+    ) -> Experiment:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            exp = self.get_experiment(name, namespace)
+            if exp is not None and exp.status.is_finished:
+                return exp
+            time.sleep(poll_s)
+        raise TimeoutError(f"experiment {namespace}/{name} not finished in {timeout_s}s")
+
+    def get_optimal_hyperparameters(
+        self, name: str, namespace: str = "default"
+    ) -> dict[str, str]:
+        exp = self.get_experiment(name, namespace)
+        if exp is None or exp.status.current_optimal_trial is None:
+            return {}
+        return {
+            a.name: a.value
+            for a in exp.status.current_optimal_trial.parameter_assignments
+        }
+
+    # ------------------------------------------------------------------ tune
+
+    def tune(
+        self,
+        name: str,
+        objective_fn,
+        parameters: list[ParameterSpec],
+        objective_metric: str,
+        objective_type: ObjectiveType = ObjectiveType.MAXIMIZE,
+        goal: float | None = None,
+        algorithm: str = "random",
+        algorithm_settings: dict[str, str] | None = None,
+        max_trial_count: int = 10,
+        parallel_trial_count: int = 3,
+        max_failed_trial_count: int = 3,
+        early_stopping: EarlyStoppingSpec | None = None,
+        namespace: str = "default",
+    ) -> Experiment:
+        """Sweep a plain Python function.
+
+        `objective_fn(**params)` must print metrics in `name=value` form
+        (metrics_lib.emit does). Its source is templated into a generated
+        trial script; parameters arrive via a TRIAL_PARAMETERS JSON env var
+        rendered from ${trialParameters.*} placeholders.
+        """
+        self.work_dir.mkdir(parents=True, exist_ok=True)
+        src = textwrap.dedent(inspect.getsource(objective_fn))
+        casts = {p.name: _CAST[p.parameter_type] for p in parameters}
+        script = self.work_dir / f"{name}-trial.py"
+        script.write_text(
+            src
+            + textwrap.dedent(
+                f"""
+                if __name__ == "__main__":
+                    import json, os
+                    _casts = {casts!r}
+                    _raw = json.loads(os.environ["TRIAL_PARAMETERS"])
+                    _params = {{
+                        k: {{"float": float, "int": int, "str": str}}[_casts[k]](v)
+                        for k, v in _raw.items()
+                    }}
+                    {objective_fn.__name__}(**_params)
+                """
+            )
+        )
+        params_json = json.dumps(
+            {p.name: "${trialParameters." + p.name + "}" for p in parameters}
+        )
+        trial_spec = {
+            "apiVersion": "kubeflow-tpu.org/v1",
+            "kind": "JAXJob",
+            "spec": {
+                "replicaSpecs": {
+                    "worker": {
+                        "replicas": 1,
+                        "template": {
+                            "container": {
+                                "command": [sys.executable, str(script.resolve())],
+                                "env": {"TRIAL_PARAMETERS": params_json},
+                            }
+                        },
+                    }
+                }
+            },
+        }
+        import yaml
+
+        exp = Experiment(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            spec=ExperimentSpec(
+                parameters=parameters,
+                objective=Objective(
+                    type=objective_type,
+                    goal=goal,
+                    objective_metric_name=objective_metric,
+                ),
+                algorithm=AlgorithmSpec(
+                    algorithm_name=algorithm, settings=algorithm_settings or {}
+                ),
+                trial_template=TrialTemplate(
+                    trial_spec=yaml.safe_dump(trial_spec, sort_keys=False),
+                    trial_parameters=[
+                        TrialParameterSpec(name=p.name, reference=p.name)
+                        for p in parameters
+                    ],
+                ),
+                max_trial_count=max_trial_count,
+                parallel_trial_count=parallel_trial_count,
+                max_failed_trial_count=max_failed_trial_count,
+                early_stopping=early_stopping,
+            ),
+        )
+        return self.create_experiment(exp)

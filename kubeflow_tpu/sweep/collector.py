@@ -1,0 +1,58 @@
+"""Metrics collection from trial logs — the sidecar-collector analogue.
+
+Reference parity (unverified cites, SURVEY.md §2.4): katib's mutating pod
+webhook injects a sidecar that tails stdout and regex-parses `metric=value`
+pairs into the observation log (pkg/webhook/v1beta1/pod/inject_webhook.go,
+cmd/metricscollector/v1beta1/file-metricscollector). Here there is no
+sidecar to inject: the pod runtime already captures every pod's stdout to a
+log file, and the collector parses it post-hoc (or live, for early
+stopping) with the same regex contract.
+
+The trainer's metrics_lib.emit prints exactly this format
+(`step=120 loss=0.41 accuracy=0.88 ...`), so in-tree models are collectable
+with zero configuration.
+"""
+
+from __future__ import annotations
+
+import re
+
+from kubeflow_tpu.sweep.api import Metric, Observation
+
+# katib's file-metricscollector default filter, era-dependent:
+# ([\w|-]+)\s*=\s*((-?\d+)(\.\d+)?([Ee][+-]?\d+)?) — extended with [./] in
+# names for namespaced metrics like eval/loss.
+METRIC_RE = re.compile(
+    r"([\w./|-]+)\s*=\s*([+-]?\d+(?:\.\d+)?(?:[Ee][+-]?\d+)?)(?![\w.])"
+)
+
+
+def parse_metrics(text: str, names: set[str] | None = None) -> dict[str, list[float]]:
+    """All `name=value` observations in log order, optionally filtered to
+    `names`. Returns {metric: [v0, v1, ...]} timelines."""
+    out: dict[str, list[float]] = {}
+    for line in text.splitlines():
+        for m in METRIC_RE.finditer(line):
+            name, val = m.group(1), m.group(2)
+            if names is not None and name not in names:
+                continue
+            try:
+                out.setdefault(name, []).append(float(val))
+            except ValueError:
+                continue
+    return out
+
+
+def observation_from_log(
+    text: str, objective_metric: str, additional: list[str] | None = None
+) -> Observation:
+    """Build a trial Observation (latest/min/max per metric) from a log."""
+    names = {objective_metric, *(additional or [])}
+    timelines = parse_metrics(text, names)
+    obs = Observation()
+    for name in sorted(timelines):
+        vals = timelines[name]
+        obs.metrics.append(
+            Metric(name=name, latest=vals[-1], min=min(vals), max=max(vals))
+        )
+    return obs

@@ -1,0 +1,544 @@
+"""ExperimentController — experiment/trial reconciliation.
+
+Reference parity (unverified cites, SURVEY.md §2.4, §3.3): katib
+pkg/controller.v1beta1/experiment/experiment_controller.go (creates trials
+from suggestions, tracks optimal) + trial/trial_controller.go (watches the
+underlying job, extracts the objective). One controller owns both loops here
+because the Suggestion hop is in-process.
+
+Trial jobs are ordinary TrainJobs reconciled by the same JobController as
+user jobs — the sweep engine composes with, not bypasses, the control plane.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+import zlib
+from typing import Callable
+
+from kubeflow_tpu.api.serde import job_from_yaml
+from kubeflow_tpu.api.validation import validate_job
+from kubeflow_tpu.controller.fakecluster import (
+    ConflictError,
+    EventType,
+    FakeCluster,
+)
+from kubeflow_tpu.controller.jobcontroller import delete_job_cascade
+from kubeflow_tpu.native import WorkQueue
+from kubeflow_tpu.sweep.api import (
+    Experiment,
+    ExperimentCondition,
+    ObjectiveType,
+    OptimalTrial,
+    ParameterAssignment,
+    Trial,
+    TrialCondition,
+    TrialSpec,
+    render_trial_spec,
+)
+from kubeflow_tpu.api.common import ObjectMeta, utcnow as _now
+from kubeflow_tpu.sweep.collector import observation_from_log
+from kubeflow_tpu.sweep.suggest import get_suggester
+
+EXPERIMENT_LABEL = "kubeflow-tpu.org/experiment-name"
+
+
+class ExperimentController:
+    """Reconciles experiments: suggest -> render -> launch -> observe."""
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        log_reader: Callable[[str], str],
+        workers: int = 1,
+        resync_period_s: float = 0.5,
+    ):
+        self.cluster = cluster
+        self.log_reader = log_reader
+        self.wq = WorkQueue(base_delay_s=0.005, max_delay_s=5.0)
+        self.resync_period_s = resync_period_s
+        self._stop = threading.Event()
+        self._n_workers = workers
+        # finished trials' logs are immutable: cache their objective
+        # timelines so the medianstop hot path isn't O(trials) file reads
+        self._timeline_cache: dict[str, list[float]] = {}
+        self.metrics = {
+            "experiments_created_total": 0,
+            "experiments_succeeded_total": 0,
+            "experiments_failed_total": 0,
+            "trials_created_total": 0,
+            "trials_early_stopped_total": 0,
+        }
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        threading.Thread(
+            target=self._watch_loop, name="exp-informer", daemon=True
+        ).start()
+        for i in range(self._n_workers):
+            threading.Thread(
+                target=self._worker_loop, name=f"exp-worker-{i}", daemon=True
+            ).start()
+        threading.Thread(
+            target=self._resync_loop, name="exp-resync", daemon=True
+        ).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.wq.shutdown()
+
+    # -------------------------------------------------------------- informer
+
+    def _watch_loop(self) -> None:
+        q = self.cluster.watch()
+        while not self._stop.is_set():
+            try:
+                etype, kind, obj = q.get(timeout=0.2)
+            except Exception:
+                continue
+            if kind == "experiments":
+                self.wq.add(self.cluster._key(obj))
+            elif kind in ("trials", "jobs", "pods"):
+                exp_name = obj.metadata.labels.get(EXPERIMENT_LABEL)
+                if exp_name:
+                    self.wq.add(f"{obj.metadata.namespace}/{exp_name}")
+
+    def _resync_loop(self) -> None:
+        # doubles as the early-stopping poller: running trials' live logs are
+        # only re-examined on reconcile
+        while not self._stop.wait(self.resync_period_s):
+            for exp in self.cluster.list("experiments"):
+                if not exp.status.is_finished:
+                    self.wq.add(self.cluster._key(exp))
+
+    def _worker_loop(self) -> None:
+        while True:
+            key = self.wq.get(timeout_s=0.5)
+            if key is None:
+                if self.wq.shutting_down:
+                    return
+                continue
+            try:
+                requeue = self.reconcile(key)
+                self.wq.forget(key)
+                if requeue is not None:
+                    self.wq.add_after(key, requeue)
+            except ConflictError:
+                self.wq.add_rate_limited(key)
+            except Exception as exc:  # noqa: BLE001
+                self.cluster.record_event(
+                    "experiments", key, "ReconcileError", str(exc), type="Warning"
+                )
+                self.wq.add_rate_limited(key)
+            finally:
+                self.wq.done(key)
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, key: str) -> float | None:
+        exp: Experiment | None = self.cluster.get("experiments", key, copy_obj=True)
+        if exp is None:
+            return None
+        st = exp.status
+        entry = _exp_fingerprint(st)
+        if st.condition == ExperimentCondition.CREATED and not st.start_time:
+            # persist-then-emit: a conflicting/failing pass must not replay
+            # the created counter/event
+            st.start_time = _now()
+            exp = self.cluster.update("experiments", exp)
+            st = exp.status
+            self.metrics["experiments_created_total"] += 1
+            self.cluster.record_event("experiments", key, "ExperimentCreated", "created")
+
+        trials = self._owned_trials(exp)
+        if st.is_finished:
+            self._kill_running(exp, trials)
+            return None
+
+        # -- sync each trial with its underlying job
+        for t in trials:
+            if not t.status.is_finished:
+                self._sync_trial(exp, t)
+        trials = self._owned_trials(exp)
+
+        # -- early stopping (medianstop)
+        if exp.spec.early_stopping is not None:
+            self._median_stop(exp, trials)
+            trials = self._owned_trials(exp)
+
+        # -- aggregate status
+        finished = [t for t in trials if t.status.is_finished]
+        succeeded = [t for t in trials if t.status.condition == TrialCondition.SUCCEEDED]
+        failed = [
+            t for t in trials
+            if t.status.condition
+            in (TrialCondition.FAILED, TrialCondition.METRICS_UNAVAILABLE)
+        ]
+        st.trials = len(trials)
+        st.trials_running = len(trials) - len(finished)
+        st.trials_succeeded = len(succeeded)
+        st.trials_failed = len(failed)
+        st.trials_early_stopped = sum(
+            1 for t in trials if t.status.condition == TrialCondition.EARLY_STOPPED
+        )
+        best = self._optimal(exp, succeeded)
+        if best is not None:
+            st.current_optimal_trial = best
+
+        # -- termination
+        obj = exp.spec.objective
+        goal_met = (
+            best is not None
+            and obj.goal is not None
+            and _better_or_equal(
+                obj.type,
+                best.observation.metric(obj.objective_metric_name).latest,
+                obj.goal,
+            )
+        )
+        if goal_met:
+            return self._finish(
+                exp, key, trials, ExperimentCondition.SUCCEEDED, "GoalReached"
+            )
+        if len(failed) > exp.spec.max_failed_trial_count:
+            return self._finish(
+                exp, key, trials, ExperimentCondition.FAILED, "MaxFailedTrialsReached"
+            )
+        if len(finished) >= exp.spec.max_trial_count:
+            return self._finish(
+                exp, key, trials, ExperimentCondition.SUCCEEDED, "MaxTrialsReached"
+            )
+
+        # -- spawn new trials up to parallelism
+        active = len(trials) - len(finished)
+        budget = min(
+            exp.spec.parallel_trial_count - active,
+            exp.spec.max_trial_count - len(trials),
+        )
+        created = 0
+        if budget > 0:
+            created = self._spawn_trials(exp, trials, budget)
+            if created == 0 and active == 0:
+                # search space exhausted (grid): wrap up with what we have
+                return self._finish(
+                    exp, key, trials, ExperimentCondition.SUCCEEDED, "SpaceExhausted"
+                )
+        if st.condition == ExperimentCondition.CREATED and trials:
+            st.condition = ExperimentCondition.RUNNING
+        if _exp_fingerprint(st) != entry:
+            self.cluster.update("experiments", exp)
+        return 0.2 if created else None
+
+    # ------------------------------------------------------------- sub-steps
+
+    def _owned_trials(self, exp: Experiment) -> list[Trial]:
+        return sorted(
+            self.cluster.list(
+                "trials",
+                lambda t: t.metadata.labels.get(EXPERIMENT_LABEL)
+                == exp.metadata.name
+                and t.metadata.namespace == exp.metadata.namespace,
+            ),
+            key=lambda t: t.metadata.name,
+        )
+
+    def _sync_trial(self, exp: Experiment, trial: Trial) -> None:
+        tkey = f"{trial.metadata.namespace}/{trial.metadata.name}"
+        trial = self.cluster.get("trials", tkey, copy_obj=True)
+        if trial is None:
+            return
+        job = self.cluster.get("jobs", tkey)
+        changed = False
+        if job is None:
+            # Job vanished (TTL cleanup, manual delete) or was never admitted.
+            # A finished run leaves its verdict in the log — recover it rather
+            # than re-running a completed trial.
+            obs = self._observe(exp, trial)
+            obj_name = exp.spec.objective.objective_metric_name
+            if obs.metric(obj_name) is not None:
+                trial.status.condition = TrialCondition.SUCCEEDED
+                trial.status.observation = obs
+                trial.status.completion_time = _now()
+                changed = True
+            elif trial.status.condition == TrialCondition.CREATED:
+                try:
+                    self._create_trial_job(exp, trial)
+                except Exception as exc:  # noqa: BLE001 — bad template => trial fails
+                    trial.status.condition = TrialCondition.FAILED
+                    trial.status.completion_time = _now()
+                    self.cluster.record_event(
+                        "trials", tkey, "TrialJobInvalid", str(exc), type="Warning"
+                    )
+                    changed = True
+            else:
+                trial.status.condition = TrialCondition.FAILED
+                trial.status.completion_time = _now()
+                self.cluster.record_event(
+                    "trials", tkey, "TrialJobLost",
+                    "underlying job disappeared without metrics", type="Warning",
+                )
+                changed = True
+        elif job.status.is_succeeded:
+            obs = self._observe(exp, trial)
+            obj_name = exp.spec.objective.objective_metric_name
+            if obs.metric(obj_name) is not None:
+                trial.status.condition = TrialCondition.SUCCEEDED
+            else:
+                trial.status.condition = TrialCondition.METRICS_UNAVAILABLE
+                self.cluster.record_event(
+                    "trials", tkey, "MetricsUnavailable",
+                    f"objective {obj_name!r} not found in trial log",
+                    type="Warning",
+                )
+            trial.status.observation = obs
+            trial.status.completion_time = _now()
+            changed = True
+        elif job.status.is_failed:
+            trial.status.condition = TrialCondition.FAILED
+            trial.status.observation = self._observe(exp, trial)
+            trial.status.completion_time = _now()
+            changed = True
+        elif trial.status.condition == TrialCondition.CREATED:
+            from kubeflow_tpu.api.common import JobConditionType
+
+            if job.status.has_condition(JobConditionType.RUNNING):
+                trial.status.condition = TrialCondition.RUNNING
+                changed = True
+        if changed:
+            self.cluster.update("trials", trial)
+
+    def _observe(self, exp: Experiment, trial: Trial):
+        log = self.log_reader(
+            f"{trial.metadata.name}-{exp.spec.metrics_replica_type}-0"
+        )
+        obj = exp.spec.objective
+        return observation_from_log(
+            log, obj.objective_metric_name, obj.additional_metric_names
+        )
+
+    def _median_stop(self, exp: Experiment, trials: list[Trial]) -> None:
+        """medianstop parity: a running trial is killed when the running
+        average of its objective history is strictly worse than the median of
+        completed trials' averages truncated to the SAME number of
+        observations — step alignment keeps warming-up trials (whose first
+        epochs are always 'bad') from being culled unfairly."""
+        es = exp.spec.early_stopping
+        obj = exp.spec.objective
+        done_timelines = [
+            tl for t in trials
+            if t.status.condition == TrialCondition.SUCCEEDED
+            and (tl := self._done_timeline(exp, t))
+        ]
+        if len(done_timelines) < es.min_trials_required:
+            return
+        for t in trials:
+            if t.status.is_finished:
+                continue
+            tv = self._objective_timeline(exp, t)
+            if not tv:
+                continue  # no signal yet
+            k = len(tv)
+            avg = sum(tv) / k
+            median = statistics.median(
+                sum(tl[:k]) / min(k, len(tl)) for tl in done_timelines
+            )
+            if _strictly_worse(obj.type, avg, median):
+                tkey = f"{t.metadata.namespace}/{t.metadata.name}"
+                # Never destroy finished work: if the underlying job (or its
+                # metrics pod) already completed, let _sync_trial record the
+                # real verdict instead of culling a done trial whose success
+                # simply hasn't been synced yet.
+                job = self.cluster.get("jobs", tkey)
+                if job is not None and job.status.is_finished:
+                    continue
+                pod = self.cluster.get(
+                    "pods",
+                    f"{t.metadata.namespace}/{t.metadata.name}-"
+                    f"{exp.spec.metrics_replica_type}-0",
+                )
+                if pod is not None and pod.status.phase.value in (
+                    "Succeeded", "Failed"
+                ):
+                    continue
+                self._delete_trial_job(t)
+                tc = self.cluster.get("trials", tkey, copy_obj=True)
+                if tc is None:
+                    continue
+                tc.status.condition = TrialCondition.EARLY_STOPPED
+                tc.status.observation = self._observe(exp, t)
+                tc.status.completion_time = _now()
+                self.cluster.update("trials", tc)
+                self.metrics["trials_early_stopped_total"] += 1
+                self.cluster.record_event(
+                    "trials", tkey, "EarlyStopped",
+                    f"avg {obj.objective_metric_name}={avg:.6g} over {k} "
+                    f"observation(s) worse than median {median:.6g}",
+                )
+
+    def _objective_timeline(self, exp: Experiment, trial: Trial) -> list[float]:
+        from kubeflow_tpu.sweep.collector import parse_metrics
+
+        name = exp.spec.objective.objective_metric_name
+        log = self.log_reader(
+            f"{trial.metadata.name}-{exp.spec.metrics_replica_type}-0"
+        )
+        return parse_metrics(log, {name}).get(name, [])
+
+    def _done_timeline(self, exp: Experiment, trial: Trial) -> list[float]:
+        key = f"{trial.metadata.namespace}/{trial.metadata.name}"
+        tl = self._timeline_cache.get(key)
+        if tl is None:
+            tl = self._objective_timeline(exp, trial)
+            if tl:
+                self._timeline_cache[key] = tl
+        return tl
+
+    def _optimal(self, exp: Experiment, succeeded: list[Trial]) -> OptimalTrial | None:
+        obj = exp.spec.objective
+        best_t, best_v = None, None
+        for t in succeeded:
+            m = t.status.observation.metric(obj.objective_metric_name)
+            if m is None:
+                continue
+            if best_v is None or _strictly_better(obj.type, m.latest, best_v):
+                best_t, best_v = t, m.latest
+        if best_t is None:
+            return None
+        return OptimalTrial(
+            trial_name=best_t.metadata.name,
+            parameter_assignments=list(best_t.spec.parameter_assignments),
+            observation=best_t.status.observation,
+        )
+
+    def _spawn_trials(self, exp: Experiment, trials: list[Trial], count: int) -> int:
+        obj = exp.spec.objective
+        history = []
+        for t in trials:
+            m = t.status.observation.metric(obj.objective_metric_name)
+            history.append(
+                (t.assignments_dict(), m.latest if m is not None else None)
+            )
+        seed = int(exp.spec.algorithm.settings.get(
+            "seed", zlib.crc32(exp.metadata.name.encode()) & 0x7FFFFFFF
+        ))
+        suggester = get_suggester(
+            exp.spec.algorithm.algorithm_name,
+            exp.spec.parameters,
+            seed=seed + len(trials),  # decorrelate successive reconcile passes
+            objective_type=obj.type,
+            settings=exp.spec.algorithm.settings,
+        )
+        suggestions = suggester.suggest(history, count)
+        created = 0
+        for a in suggestions:
+            name = f"{exp.metadata.name}-{len(trials) + created:04d}"
+            trial = Trial(
+                metadata=ObjectMeta(
+                    name=name,
+                    namespace=exp.metadata.namespace,
+                    labels={EXPERIMENT_LABEL: exp.metadata.name},
+                ),
+                spec=TrialSpec(
+                    parameter_assignments=[
+                        ParameterAssignment(name=k, value=v) for k, v in a.items()
+                    ],
+                    rendered_spec=render_trial_spec(exp.spec.trial_template, a),
+                ),
+            )
+            try:
+                self.cluster.create("trials", trial)
+            except KeyError:
+                continue  # name collision with a concurrent pass: skip
+            self._create_trial_job(exp, trial)
+            self.metrics["trials_created_total"] += 1
+            created += 1
+        return created
+
+    def _create_trial_job(self, exp: Experiment, trial: Trial) -> None:
+        job = job_from_yaml(trial.spec.rendered_spec)
+        job.metadata.name = trial.metadata.name
+        job.metadata.namespace = trial.metadata.namespace
+        job.metadata.labels[EXPERIMENT_LABEL] = exp.metadata.name
+        validate_job(job)
+        try:
+            self.cluster.create("jobs", job)
+        except KeyError:
+            pass  # already exists
+
+    def _delete_trial_job(self, trial: Trial) -> None:
+        delete_job_cascade(
+            self.cluster, trial.metadata.name, trial.metadata.namespace
+        )
+
+    def _kill_running(self, exp: Experiment, trials: list[Trial]) -> None:
+        for t in trials:
+            if t.status.is_finished:
+                continue
+            tkey = f"{t.metadata.namespace}/{t.metadata.name}"
+            self._delete_trial_job(t)
+            tc = self.cluster.get("trials", tkey, copy_obj=True)
+            if tc is None:
+                continue
+            tc.status.condition = TrialCondition.EARLY_STOPPED
+            tc.status.completion_time = _now()
+            self.cluster.update("trials", tc)
+
+    def _finish(
+        self,
+        exp: Experiment,
+        key: str,
+        trials: list[Trial],
+        cond: ExperimentCondition,
+        reason: str,
+    ) -> None:
+        exp.status.condition = cond
+        exp.status.message = reason
+        exp.status.completion_time = _now()
+        self.cluster.update("experiments", exp)
+        if cond == ExperimentCondition.SUCCEEDED:
+            self.metrics["experiments_succeeded_total"] += 1
+        else:
+            self.metrics["experiments_failed_total"] += 1
+        self.cluster.record_event("experiments", key, reason, f"experiment {cond.value}")
+        self._kill_running(exp, trials)
+        return None
+
+
+# ---------------------------------------------------------------- comparators
+
+def _strictly_better(t: ObjectiveType, a: float, b: float) -> bool:
+    return a < b if t == ObjectiveType.MINIMIZE else a > b
+
+
+def _strictly_worse(t: ObjectiveType, a: float, b: float) -> bool:
+    return a > b if t == ObjectiveType.MINIMIZE else a < b
+
+
+def _better_or_equal(t: ObjectiveType, a: float, b: float) -> bool:
+    return a <= b if t == ObjectiveType.MINIMIZE else a >= b
+
+
+def _exp_fingerprint(st) -> tuple:
+    return (
+        st.condition,
+        st.trials,
+        st.trials_running,
+        st.trials_succeeded,
+        st.trials_failed,
+        st.trials_early_stopped,
+        st.message,
+        st.current_optimal_trial.trial_name if st.current_optimal_trial else "",
+        (
+            tuple(
+                (m.name, m.latest)
+                for m in st.current_optimal_trial.observation.metrics
+            )
+            if st.current_optimal_trial
+            else ()
+        ),
+    )
+
+

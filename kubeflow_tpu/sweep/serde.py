@@ -1,0 +1,46 @@
+"""Experiment/Trial YAML round-trip (katib CR manifest parity).
+
+Reuses the generic camelCase dataclass codec from api/serde.py so sweep
+manifests look like the reference's Experiment CRs (samples/ has fixtures).
+"""
+
+from __future__ import annotations
+
+import yaml
+
+from kubeflow_tpu.api.serde import _from_dict, to_dict
+from kubeflow_tpu.sweep.api import Experiment, Trial
+
+
+def experiment_to_dict(exp: Experiment) -> dict:
+    d = to_dict(exp)
+    d.pop("kind", None)
+    d.pop("apiVersion", None)
+    if exp.status.condition.value == "Created" and not exp.status.start_time:
+        d.pop("status", None)
+    return {"apiVersion": exp.api_version, "kind": exp.kind, **d}
+
+
+def experiment_to_yaml(exp: Experiment) -> str:
+    return yaml.safe_dump(experiment_to_dict(exp), sort_keys=False)
+
+
+def experiment_from_dict(data: dict) -> Experiment:
+    body = {k: v for k, v in data.items() if k not in ("kind", "apiVersion")}
+    return _from_dict(Experiment, body)
+
+
+def experiment_from_yaml(text: str) -> Experiment:
+    return experiment_from_dict(yaml.safe_load(text))
+
+
+def trial_to_dict(t: Trial) -> dict:
+    d = to_dict(t)
+    d.pop("kind", None)
+    d.pop("apiVersion", None)
+    return {"apiVersion": t.api_version, "kind": t.kind, **d}
+
+
+def trial_from_dict(data: dict) -> Trial:
+    body = {k: v for k, v in data.items() if k not in ("kind", "apiVersion")}
+    return _from_dict(Trial, body)

@@ -1,0 +1,210 @@
+"""Suggestion algorithms: random, grid, TPE.
+
+Reference parity (unverified cites, SURVEY.md §2.4): katib
+pkg/suggestion/v1beta1/{hyperopt,optuna}/service.py behind the Suggestion
+gRPC service. Here the algorithms are the same kind of code (Python), minus
+the Deployment/gRPC hop: a Suggester is a pure function of (space, history)
+-> assignments, which also makes it deterministic and unit-testable.
+
+TPE follows Bergstra et al.'s tree-structured Parzen estimator recipe
+(split history at a quantile into good/bad, model each with a Parzen mixture,
+maximize the good/bad density ratio over sampled candidates) implemented
+with numpy only — independent per dimension, like hyperopt's default.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+import numpy as np
+
+from kubeflow_tpu.sweep.api import (
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+)
+
+# history entry: (assignments: dict[str, str], objective: float | None)
+History = list[tuple[dict[str, str], float | None]]
+
+
+def _format(p: ParameterSpec, v: float) -> str:
+    if p.parameter_type == ParameterType.INT:
+        return str(int(round(v)))
+    return f"{v:.6g}"
+
+
+class RandomSuggester:
+    def __init__(self, parameters: list[ParameterSpec], seed: int = 0):
+        self.parameters = parameters
+        self.rng = np.random.default_rng(seed)
+
+    def suggest(self, history: History, count: int) -> list[dict[str, str]]:
+        out = []
+        for _ in range(count):
+            a: dict[str, str] = {}
+            for p in self.parameters:
+                fs = p.feasible_space
+                if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+                    a[p.name] = str(fs.list[self.rng.integers(len(fs.list))])
+                else:
+                    lo, hi = float(fs.min), float(fs.max)
+                    v = self.rng.uniform(lo, hi)
+                    if fs.step:
+                        step = float(fs.step)
+                        v = lo + round((v - lo) / step) * step
+                        v = min(v, hi)
+                    a[p.name] = _format(p, v)
+            out.append(a)
+        return out
+
+
+class GridSuggester:
+    """Enumerates the cartesian grid in a stable order, skipping points
+    already tried (reconcile is level-triggered: 'which points exist' is
+    derived from history, no internal cursor)."""
+
+    def __init__(self, parameters: list[ParameterSpec], seed: int = 0,
+                 default_grid_points: int = 4):
+        self.parameters = parameters
+        self.default_grid_points = default_grid_points
+
+    def _axis(self, p: ParameterSpec) -> list[str]:
+        fs = p.feasible_space
+        if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+            return [str(v) for v in fs.list]
+        lo, hi = float(fs.min), float(fs.max)
+        if fs.step:
+            n = int(math.floor((hi - lo) / float(fs.step))) + 1
+            vals = [lo + i * float(fs.step) for i in range(n)]
+        else:
+            n = self.default_grid_points
+            vals = [lo + (hi - lo) * i / (n - 1) for i in range(n)] if n > 1 else [lo]
+        return [_format(p, v) for v in vals]
+
+    def suggest(self, history: History, count: int) -> list[dict[str, str]]:
+        tried = {tuple(sorted(h[0].items())) for h in history}
+        out = []
+        axes = [self._axis(p) for p in self.parameters]
+        for combo in itertools.product(*axes):
+            a = {p.name: v for p, v in zip(self.parameters, combo)}
+            if tuple(sorted(a.items())) in tried:
+                continue
+            out.append(a)
+            if len(out) >= count:
+                break
+        return out
+
+    def grid_size(self) -> int:
+        return math.prod(len(self._axis(p)) for p in self.parameters)
+
+
+class TPESuggester:
+    def __init__(
+        self,
+        parameters: list[ParameterSpec],
+        seed: int = 0,
+        objective_type: ObjectiveType = ObjectiveType.MAXIMIZE,
+        gamma: float = 0.25,
+        n_candidates: int = 24,
+        n_startup: int = 5,
+    ):
+        self.parameters = parameters
+        self.rng = np.random.default_rng(seed)
+        self.objective_type = objective_type
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.n_startup = n_startup
+        self._random = RandomSuggester(parameters, seed=seed + 1)
+
+    def suggest(self, history: History, count: int) -> list[dict[str, str]]:
+        observed = [(a, o) for a, o in history if o is not None]
+        if len(observed) < self.n_startup:
+            return self._random.suggest(history, count)
+        # Sort so "good" is always the head (minimize: ascending).
+        sign = 1.0 if self.objective_type == ObjectiveType.MINIMIZE else -1.0
+        ranked = sorted(observed, key=lambda h: sign * h[1])
+        n_good = max(1, int(np.ceil(self.gamma * len(ranked))))
+        good, bad = ranked[:n_good], ranked[n_good:] or ranked[:1]
+        return [self._suggest_one(good, bad) for _ in range(count)]
+
+    def _suggest_one(self, good: History, bad: History) -> dict[str, str]:
+        a: dict[str, str] = {}
+        for p in self.parameters:
+            fs = p.feasible_space
+            if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+                a[p.name] = self._categorical(p, good, bad)
+            else:
+                lo, hi = float(fs.min), float(fs.max)
+                gv = np.array([float(h[0][p.name]) for h in good if p.name in h[0]])
+                bv = np.array([float(h[0][p.name]) for h in bad if p.name in h[0]])
+                if len(gv) == 0:
+                    v = self.rng.uniform(lo, hi)
+                else:
+                    # Parzen bandwidth ~ range / sqrt(n)
+                    bw = max((hi - lo) / max(np.sqrt(len(gv)), 1.0), 1e-12)
+                    cand = self.rng.normal(
+                        gv[self.rng.integers(len(gv), size=self.n_candidates)], bw
+                    )
+                    cand = np.clip(cand, lo, hi)
+                    score = self._log_parzen(cand, gv, bw) - self._log_parzen(
+                        cand, bv if len(bv) else gv, bw
+                    )
+                    v = float(cand[np.argmax(score)])
+                if fs.step:
+                    step = float(fs.step)
+                    v = lo + round((v - lo) / step) * step
+                    v = min(v, hi)
+                a[p.name] = _format(p, v)
+        return a
+
+    def _categorical(self, p: ParameterSpec, good: History, bad: History) -> str:
+        choices = [str(v) for v in p.feasible_space.list]
+        # Laplace-smoothed good-frequency vs bad-frequency ratio sampling
+        gcounts = np.array(
+            [1.0 + sum(1 for h in good if h[0].get(p.name) == c) for c in choices]
+        )
+        bcounts = np.array(
+            [1.0 + sum(1 for h in bad if h[0].get(p.name) == c) for c in choices]
+        )
+        w = gcounts / bcounts
+        w = w / w.sum()
+        return choices[self.rng.choice(len(choices), p=w)]
+
+    @staticmethod
+    def _log_parzen(x: np.ndarray, centers: np.ndarray, bw: float) -> np.ndarray:
+        d = (x[:, None] - centers[None, :]) / bw
+        log_k = -0.5 * d * d - np.log(bw * np.sqrt(2 * np.pi))
+        m = log_k.max(axis=1, keepdims=True)
+        return (m + np.log(np.exp(log_k - m).sum(axis=1, keepdims=True))).ravel() - np.log(
+            len(centers)
+        )
+
+
+def get_suggester(
+    name: str,
+    parameters: list[ParameterSpec],
+    seed: int = 0,
+    objective_type: ObjectiveType = ObjectiveType.MAXIMIZE,
+    settings: dict[str, str] | None = None,
+):
+    settings = settings or {}
+    if name == "random":
+        return RandomSuggester(parameters, seed=seed)
+    if name == "grid":
+        return GridSuggester(
+            parameters,
+            seed=seed,
+            default_grid_points=int(settings.get("defaultGridPoints", 4)),
+        )
+    if name == "tpe":
+        return TPESuggester(
+            parameters,
+            seed=seed,
+            objective_type=objective_type,
+            gamma=float(settings.get("gamma", 0.25)),
+            n_candidates=int(settings.get("nCandidates", 24)),
+            n_startup=int(settings.get("nStartup", 5)),
+        )
+    raise ValueError(f"unknown suggestion algorithm {name!r} (random|grid|tpe)")

@@ -1,0 +1,418 @@
+"""P5: sweep engine (Katib parity) tests.
+
+Mirrors the reference's layering (SURVEY.md §2.4, §3.3): suggesters unit-
+tested as pure functions, the collector against raw log text, and the
+experiment controller end-to-end over the in-process platform with real
+trial subprocesses.
+"""
+
+import sys
+import textwrap
+
+import pytest
+
+from kubeflow_tpu.client import Platform
+from kubeflow_tpu.sweep import (
+    AlgorithmSpec,
+    EarlyStoppingSpec,
+    Experiment,
+    ExperimentSpec,
+    FeasibleSpace,
+    Objective,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    SweepClient,
+    TrialParameterSpec,
+    TrialTemplate,
+    get_suggester,
+    observation_from_log,
+    parse_metrics,
+)
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.sweep.api import render_trial_spec, validate_experiment
+
+
+def p_double(name, lo, hi, step=""):
+    return ParameterSpec(
+        name=name,
+        parameter_type=ParameterType.DOUBLE,
+        feasible_space=FeasibleSpace(min=str(lo), max=str(hi), step=str(step)),
+    )
+
+
+def p_int(name, lo, hi):
+    return ParameterSpec(
+        name=name,
+        parameter_type=ParameterType.INT,
+        feasible_space=FeasibleSpace(min=str(lo), max=str(hi)),
+    )
+
+
+def p_cat(name, values):
+    return ParameterSpec(
+        name=name,
+        parameter_type=ParameterType.CATEGORICAL,
+        feasible_space=FeasibleSpace(list=[str(v) for v in values]),
+    )
+
+
+class TestSuggesters:
+    def test_random_within_bounds_and_deterministic(self):
+        params = [p_double("lr", 1e-4, 1e-1), p_int("bs", 8, 64), p_cat("opt", ["adam", "sgd"])]
+        s1 = get_suggester("random", params, seed=7)
+        s2 = get_suggester("random", params, seed=7)
+        a = s1.suggest([], 5)
+        assert a == s2.suggest([], 5)
+        for x in a:
+            assert 1e-4 <= float(x["lr"]) <= 1e-1
+            assert 8 <= int(x["bs"]) <= 64
+            assert x["opt"] in ("adam", "sgd")
+
+    def test_grid_enumerates_and_skips_tried(self):
+        params = [p_double("lr", 0.1, 0.4, step=0.1), p_cat("opt", ["a", "b"])]
+        g = get_suggester("grid", params)
+        assert g.grid_size() == 8
+        first = g.suggest([], 3)
+        assert len(first) == 3
+        rest = g.suggest([(a, None) for a in first], 100)
+        assert len(rest) == 5  # remaining points only
+        all_pts = {tuple(sorted(a.items())) for a in first + rest}
+        assert len(all_pts) == 8
+        assert g.suggest([(a, None) for a in first + rest], 10) == []
+
+    def test_tpe_prefers_good_region(self):
+        # objective = -(x-0.8)^2, maximize => optimum at 0.8
+        params = [p_double("x", 0.0, 1.0)]
+        tpe = get_suggester(
+            "tpe", params, seed=3, objective_type=ObjectiveType.MAXIMIZE
+        )
+        history = []
+        rng_vals = [i / 19 for i in range(20)]
+        for v in rng_vals:
+            history.append(({"x": f"{v:.4f}"}, -((v - 0.8) ** 2)))
+        sugg = tpe.suggest(history, 20)
+        mean_x = sum(float(a["x"]) for a in sugg) / len(sugg)
+        assert mean_x > 0.55  # pulled toward the good region
+
+    def test_unknown_algorithm(self):
+        with pytest.raises(ValueError, match="unknown suggestion algorithm"):
+            get_suggester("cmaes", [p_double("x", 0, 1)])
+
+
+class TestCollector:
+    def test_parse_name_value_lines(self):
+        log = textwrap.dedent(
+            """
+            step=10 loss=0.52 accuracy=0.81 images_per_sec=1200.5
+            noise without metrics
+            step=20 loss=0.41 accuracy=0.88 images_per_sec=1210.0
+            eval_loss=0.39
+            """
+        )
+        t = parse_metrics(log)
+        assert t["loss"] == [0.52, 0.41]
+        assert t["accuracy"] == [0.81, 0.88]
+        assert t["eval_loss"] == [0.39]
+
+    def test_observation_latest_min_max(self):
+        log = "loss=0.9\nloss=0.3\nloss=0.5\n"
+        obs = observation_from_log(log, "loss")
+        m = obs.metric("loss")
+        assert (m.latest, m.min, m.max) == (0.5, 0.3, 0.9)
+
+    def test_missing_objective(self):
+        obs = observation_from_log("nothing here", "loss")
+        assert obs.metric("loss") is None
+
+    def test_scientific_notation(self):
+        t = parse_metrics("lr=1e-3 loss=5.2E-01")
+        assert t["lr"] == [1e-3]
+        assert t["loss"] == [0.52]
+
+
+class TestTemplate:
+    def test_render_substitution(self):
+        tpl = TrialTemplate(
+            trial_spec="command: [train, --lr=${trialParameters.lr}]",
+            trial_parameters=[TrialParameterSpec(name="lr", reference="lr")],
+        )
+        out = render_trial_spec(tpl, {"lr": "0.01"})
+        assert out == "command: [train, --lr=0.01]"
+
+    def test_render_unknown_reference(self):
+        tpl = TrialTemplate(
+            trial_spec="x: ${trialParameters.lr}",
+            trial_parameters=[TrialParameterSpec(name="lr", reference="nope")],
+        )
+        with pytest.raises(ValueError, match="unknown search"):
+            render_trial_spec(tpl, {"lr": "0.01"})
+
+    def test_validate_experiment(self):
+        exp = Experiment(
+            metadata=ObjectMeta(name="e1"),
+            spec=ExperimentSpec(
+                parameters=[p_double("lr", 0.1, 0.2)],
+                objective=Objective(objective_metric_name="loss"),
+                trial_template=TrialTemplate(trial_spec="kind: JAXJob"),
+            ),
+        )
+        validate_experiment(exp)
+        exp.spec.parameters[0].feasible_space.min = "0.5"
+        with pytest.raises(ValueError, match="min > max"):
+            validate_experiment(exp)
+
+
+class TestSerde:
+    def test_sample_manifest_roundtrip(self):
+        from pathlib import Path
+
+        from kubeflow_tpu.sweep.serde import (
+            experiment_from_yaml,
+            experiment_to_yaml,
+        )
+
+        text = Path("samples/experiment_tpe.yaml").read_text()
+        exp = experiment_from_yaml(text)
+        validate_experiment(exp)
+        assert exp.metadata.name == "mnist-tpe"
+        assert exp.spec.algorithm.algorithm_name == "tpe"
+        assert exp.spec.objective.goal == 0.97
+        assert exp.spec.early_stopping.min_trials_required == 3
+        assert [p.name for p in exp.spec.parameters] == ["lr", "batchSize"]
+        # round-trip is stable
+        again = experiment_from_yaml(experiment_to_yaml(exp))
+        assert experiment_to_yaml(again) == experiment_to_yaml(exp)
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16)
+    with p:
+        yield p
+
+
+@pytest.fixture()
+def sweep(platform, tmp_path):
+    return SweepClient(platform, work_dir=str(tmp_path / "sweeps"))
+
+
+def quadratic_trial_template(tmp_path):
+    """Trial job: reports objective = -(x-0.6)^2 (max at x=0.6)."""
+    script = tmp_path / "trial.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import os
+            x = float(os.environ["X_PARAM"])
+            print(f"objective={-(x - 0.6) ** 2}")
+            """
+        )
+    )
+    spec = textwrap.dedent(
+        f"""
+        apiVersion: kubeflow-tpu.org/v1
+        kind: JAXJob
+        spec:
+          replicaSpecs:
+            worker:
+              replicas: 1
+              template:
+                container:
+                  command: [{sys.executable}, {script}]
+                  env:
+                    X_PARAM: "${{trialParameters.x}}"
+        """
+    )
+    return TrialTemplate(
+        trial_spec=spec,
+        trial_parameters=[TrialParameterSpec(name="x", reference="x")],
+    )
+
+
+class TestExperimentE2E:
+    def test_random_experiment_completes(self, platform, sweep, tmp_path):
+        exp = Experiment(
+            metadata=ObjectMeta(name="rand-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0)],
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"
+                ),
+                algorithm=AlgorithmSpec(algorithm_name="random"),
+                trial_template=quadratic_trial_template(tmp_path),
+                max_trial_count=6,
+                parallel_trial_count=3,
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("rand-exp", timeout_s=120)
+        assert done.status.condition.value == "Succeeded"
+        assert done.status.trials_succeeded >= 6
+        best = done.status.current_optimal_trial
+        assert best is not None
+        # optimal trial's objective must equal max over all succeeded trials
+        vals = [
+            t.status.observation.metric("objective").latest
+            for t in sweep.list_trials("rand-exp")
+            if t.status.observation.metric("objective") is not None
+        ]
+        assert best.observation.metric("objective").latest == max(vals)
+
+    def test_grid_exhausts_space(self, platform, sweep, tmp_path):
+        exp = Experiment(
+            metadata=ObjectMeta(name="grid-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0, step=0.5)],  # {0, 0.5, 1}
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"
+                ),
+                algorithm=AlgorithmSpec(algorithm_name="grid"),
+                trial_template=quadratic_trial_template(tmp_path),
+                max_trial_count=50,  # larger than the grid: exhaustion ends it
+                parallel_trial_count=3,
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("grid-exp", timeout_s=120)
+        assert done.status.condition.value == "Succeeded"
+        assert done.status.message == "SpaceExhausted"
+        assert done.status.trials == 3
+        # x=0.5 is the best grid point for -(x-0.6)^2
+        assert sweep.get_optimal_hyperparameters("grid-exp") == {"x": "0.5"}
+
+    def test_goal_stops_early(self, platform, sweep, tmp_path):
+        exp = Experiment(
+            metadata=ObjectMeta(name="goal-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.55, 0.65)],  # every trial is near-optimal
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE,
+                    objective_metric_name="objective",
+                    goal=-0.01,
+                ),
+                algorithm=AlgorithmSpec(algorithm_name="random"),
+                trial_template=quadratic_trial_template(tmp_path),
+                max_trial_count=40,
+                parallel_trial_count=2,
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("goal-exp", timeout_s=120)
+        assert done.status.condition.value == "Succeeded"
+        assert done.status.message == "GoalReached"
+        assert done.status.trials < 40
+
+    def test_failed_trials_fail_experiment(self, platform, sweep, tmp_path):
+        script = tmp_path / "bad.py"
+        script.write_text("raise SystemExit(1)")
+        spec = textwrap.dedent(
+            f"""
+            apiVersion: kubeflow-tpu.org/v1
+            kind: JAXJob
+            spec:
+              replicaSpecs:
+                worker:
+                  replicas: 1
+                  restartPolicy: Never
+                  template:
+                    container:
+                      command: [{sys.executable}, {script}]
+            """
+        )
+        exp = Experiment(
+            metadata=ObjectMeta(name="fail-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0)],
+                objective=Objective(objective_metric_name="objective"),
+                trial_template=TrialTemplate(trial_spec=spec),
+                max_trial_count=10,
+                parallel_trial_count=2,
+                max_failed_trial_count=2,
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("fail-exp", timeout_s=120)
+        assert done.status.condition.value == "Failed"
+        assert done.status.message == "MaxFailedTrialsReached"
+
+    def test_median_early_stopping(self, platform, sweep, tmp_path):
+        """Trials report their objective immediately, then linger; medianstop
+        must kill lingering trials that sit below the completed median."""
+        script = tmp_path / "linger.py"
+        script.write_text(
+            textwrap.dedent(
+                """
+                import os, time
+                x = float(os.environ["X_PARAM"])
+                print(f"objective={x}", flush=True)
+                # good trials finish fast; bad ones linger and must be stopped
+                if x < 0.5:
+                    time.sleep(300)
+                """
+            )
+        )
+        spec = textwrap.dedent(
+            f"""
+            apiVersion: kubeflow-tpu.org/v1
+            kind: JAXJob
+            spec:
+              replicaSpecs:
+                worker:
+                  replicas: 1
+                  template:
+                    container:
+                      command: [{sys.executable}, {script}]
+                      env:
+                        X_PARAM: "${{trialParameters.x}}"
+            """
+        )
+        exp = Experiment(
+            metadata=ObjectMeta(name="median-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0, step=0.25)],  # 5 grid points
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"
+                ),
+                algorithm=AlgorithmSpec(algorithm_name="grid"),
+                trial_template=TrialTemplate(
+                    trial_spec=spec,
+                    trial_parameters=[TrialParameterSpec(name="x", reference="x")],
+                ),
+                max_trial_count=5,
+                parallel_trial_count=5,
+                # 3 = every fast-finishing good trial: medianstop only arms
+                # once all of {0.5, 0.75, 1.0} have completed, so culls are
+                # deterministically confined to the lingerers {0, 0.25}
+                early_stopping=EarlyStoppingSpec(min_trials_required=3),
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("median-exp", timeout_s=120)
+        assert done.status.condition.value == "Succeeded"
+        # x in {0, 0.25} linger below the median of {0.5, 0.75, 1.0}
+        assert done.status.trials_early_stopped >= 1
+        assert done.status.trials_succeeded >= 3
+
+    def test_tune_function_e2e(self, platform, sweep):
+        done_exp = sweep.tune(
+            name="tune-exp",
+            objective_fn=_tune_objective,
+            parameters=[p_double("x", 0.0, 1.0), p_cat("mode", ["a", "b"])],
+            objective_metric="score",
+            objective_type=ObjectiveType.MAXIMIZE,
+            max_trial_count=4,
+            parallel_trial_count=2,
+            algorithm="random",
+        )
+        assert done_exp.metadata.name == "tune-exp"
+        done = sweep.wait_for_experiment("tune-exp", timeout_s=120)
+        assert done.status.condition.value == "Succeeded"
+        assert done.status.trials_succeeded >= 4
+        best = sweep.get_optimal_hyperparameters("tune-exp")
+        assert set(best) == {"x", "mode"}
+
+
+def _tune_objective(x, mode):
+    bonus = 0.1 if mode == "a" else 0.0
+    print(f"score={-(x - 0.5) ** 2 + bonus}")
