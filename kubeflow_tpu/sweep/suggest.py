@@ -76,7 +76,9 @@ class GridSuggester:
             return [str(v) for v in fs.list]
         lo, hi = float(fs.min), float(fs.max)
         if fs.step:
-            n = int(math.floor((hi - lo) / float(fs.step))) + 1
+            # epsilon keeps fp error from dropping the max boundary point
+            # ((0.3-0.1)/0.1 == 1.9999... would otherwise lose 0.3)
+            n = int(math.floor((hi - lo) / float(fs.step) + 1e-9)) + 1
             vals = [lo + i * float(fs.step) for i in range(n)]
         else:
             n = self.default_grid_points

@@ -69,6 +69,12 @@ class TestSuggesters:
             assert 8 <= int(x["bs"]) <= 64
             assert x["opt"] in ("adam", "sgd")
 
+    def test_grid_keeps_fp_boundary_point(self):
+        # (0.3-0.1)/0.1 floors to 1 without the epsilon; 0.3 must survive
+        g = get_suggester("grid", [p_double("lr", 0.1, 0.3, step=0.1)])
+        pts = [a["lr"] for a in g.suggest([], 10)]
+        assert pts == ["0.1", "0.2", "0.3"]
+
     def test_grid_enumerates_and_skips_tried(self):
         params = [p_double("lr", 0.1, 0.4, step=0.1), p_cat("opt", ["a", "b"])]
         g = get_suggester("grid", params)
