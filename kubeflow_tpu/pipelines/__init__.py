@@ -1,0 +1,47 @@
+"""Pipelines subsystem — KFP parity (SURVEY.md §2.6).
+
+@component/@pipeline DSL -> compiled IR (PipelineSpec-shaped YAML) ->
+local DAG runner with step caching and C++ MLMD-analogue lineage, plus
+recurring schedules (ScheduledWorkflow analogue).
+"""
+
+from kubeflow_tpu.pipelines.compiler import (
+    compile_pipeline,
+    compile_to_yaml,
+    validate_ir,
+)
+from kubeflow_tpu.pipelines.dsl import (
+    Component,
+    Pipeline,
+    PipelineParam,
+    Task,
+    TaskOutput,
+    component,
+    pipeline,
+)
+from kubeflow_tpu.pipelines.runner import (
+    LocalPipelineRunner,
+    PipelineRun,
+    TaskResult,
+    TaskState,
+)
+from kubeflow_tpu.pipelines.scheduled import RecurringRun, ScheduleManager
+
+__all__ = [
+    "Component",
+    "LocalPipelineRunner",
+    "Pipeline",
+    "PipelineParam",
+    "PipelineRun",
+    "RecurringRun",
+    "ScheduleManager",
+    "Task",
+    "TaskOutput",
+    "TaskResult",
+    "TaskState",
+    "compile_pipeline",
+    "compile_to_yaml",
+    "component",
+    "pipeline",
+    "validate_ir",
+]

@@ -1,0 +1,162 @@
+"""Compiler: Pipeline DAG -> IR YAML.
+
+Reference parity (unverified cites, SURVEY.md §2.6): kfp
+sdk/python/kfp/compiler/compiler.py lowering the DSL to the PipelineSpec
+proto IR. The IR here mirrors PipelineSpec's shape (pipelineInfo / root dag
+/ components / deploymentSpec with per-executor source) in plain YAML, and
+is the contract the runner and golden-file tests consume — compilation is
+pure and cluster-free, the reference's own highest-leverage test seam
+(SURVEY.md §4 'golden-file IR tests').
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import yaml
+
+from kubeflow_tpu.pipelines.dsl import (
+    Pipeline,
+    PipelineParam,
+    TaskOutput,
+)
+
+SCHEMA_VERSION = "kubeflow-tpu.org/pipelinespec/v1"
+
+
+def compile_pipeline(pipeline: Pipeline) -> dict:
+    """Lower a traced Pipeline to its IR dict."""
+    components: dict[str, Any] = {}
+    executors: dict[str, Any] = {}
+    tasks: dict[str, Any] = {}
+
+    for task in pipeline.tasks.values():
+        comp_key = f"comp-{task.component.name}"
+        exec_key = f"exec-{task.component.name}"
+        if comp_key not in components:
+            comp_def: dict[str, Any] = {
+                "executorLabel": exec_key,
+                "inputDefinitions": {
+                    "parameters": {
+                        p: {"parameterType": t}
+                        for p, t in task.component.inputs.items()
+                    }
+                },
+            }
+            if task.component.output_type is not None:
+                comp_def["outputDefinitions"] = {
+                    "parameters": {
+                        "Output": {"parameterType": task.component.output_type}
+                    }
+                }
+            components[comp_key] = comp_def
+            executors[exec_key] = {
+                "pythonFunction": {
+                    "functionName": task.component.fn.__name__,
+                    "source": task.component.source,
+                }
+            }
+
+        inputs: dict[str, Any] = {}
+        for pname, value in task.arguments.items():
+            if isinstance(value, TaskOutput):
+                inputs[pname] = {
+                    "taskOutputParameter": {
+                        "producerTask": value.producer,
+                        "outputParameterKey": value.key,
+                    }
+                }
+            elif isinstance(value, PipelineParam):
+                inputs[pname] = {"componentInputParameter": value.name}
+            else:
+                inputs[pname] = {"runtimeValue": {"constant": value}}
+        entry: dict[str, Any] = {
+            "componentRef": {"name": comp_key},
+            "inputs": {"parameters": inputs},
+        }
+        deps = task.dependencies()
+        if deps:
+            entry["dependentTasks"] = deps
+        tasks[task.name] = entry
+
+    ir: dict[str, Any] = {
+        "schemaVersion": SCHEMA_VERSION,
+        "pipelineInfo": {
+            "name": pipeline.name,
+            "description": pipeline.description,
+        },
+        "root": {
+            "inputDefinitions": {
+                "parameters": {
+                    p.name: _root_param(p) for p in pipeline.params.values()
+                }
+            },
+            "dag": {"tasks": tasks},
+        },
+        "components": components,
+        "deploymentSpec": {"executors": executors},
+    }
+    if pipeline.result is not None:
+        ir["root"]["outputFrom"] = {
+            "producerTask": pipeline.result.producer,
+            "outputParameterKey": pipeline.result.key,
+        }
+    return ir
+
+
+def _root_param(p: PipelineParam) -> dict:
+    d: dict[str, Any] = {"parameterType": p.param_type}
+    if p.default is not None:
+        d["defaultValue"] = p.default
+    return d
+
+
+def compile_to_yaml(pipeline: Pipeline) -> str:
+    return yaml.safe_dump(compile_pipeline(pipeline), sort_keys=False)
+
+
+def validate_ir(ir: dict) -> dict:
+    """Structural checks the runner relies on (apiserver admission parity)."""
+    if ir.get("schemaVersion") != SCHEMA_VERSION:
+        raise ValueError(f"unsupported schemaVersion {ir.get('schemaVersion')!r}")
+    tasks = ir.get("root", {}).get("dag", {}).get("tasks", {})
+    comps = ir.get("components", {})
+    executors = ir.get("deploymentSpec", {}).get("executors", {})
+    for tname, t in tasks.items():
+        cref = t.get("componentRef", {}).get("name")
+        if cref not in comps:
+            raise ValueError(f"task {tname}: unknown component {cref!r}")
+        if comps[cref].get("executorLabel") not in executors:
+            raise ValueError(f"task {tname}: component {cref} has no executor")
+        for dep in t.get("dependentTasks", []):
+            if dep not in tasks:
+                raise ValueError(f"task {tname}: unknown dependency {dep!r}")
+        for pname, v in t.get("inputs", {}).get("parameters", {}).items():
+            if "taskOutputParameter" in v:
+                prod = v["taskOutputParameter"]["producerTask"]
+                if prod not in tasks:
+                    raise ValueError(
+                        f"task {tname}: input {pname} references unknown "
+                        f"producer {prod!r}"
+                    )
+    # acyclicity
+    state: dict[str, int] = {}
+
+    def visit(n: str) -> None:
+        if state.get(n) == 1:
+            raise ValueError(f"dependency cycle through task {n!r}")
+        if state.get(n) == 2:
+            return
+        state[n] = 1
+        t = tasks[n]
+        deps = set(t.get("dependentTasks", []))
+        for v in t.get("inputs", {}).get("parameters", {}).values():
+            if "taskOutputParameter" in v:
+                deps.add(v["taskOutputParameter"]["producerTask"])
+        for d in deps:
+            visit(d)
+        state[n] = 2
+
+    for n in tasks:
+        visit(n)
+    return ir

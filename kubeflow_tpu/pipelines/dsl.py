@@ -1,0 +1,202 @@
+"""Pipeline DSL — @component / @pipeline decorators.
+
+Reference parity (unverified cites, SURVEY.md §2.6): kfp sdk/python/kfp/dsl
+— `@dsl.component` turns a self-contained Python function into a pipeline
+step; `@dsl.pipeline` traces a function that wires components into a DAG.
+Tracing works the same way the kfp SDK's does: calling a component inside a
+pipeline function does not execute it — it records a Task node and returns
+a placeholder output to thread into downstream calls.
+
+Like kfp's lightweight components, a component function must be
+SELF-CONTAINED: imports it needs go inside the function body, because the
+executor runs its extracted source in a fresh interpreter.
+"""
+
+from __future__ import annotations
+
+import inspect
+import textwrap
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+_TYPE_MAP = {
+    str: "STRING",
+    int: "NUMBER_INTEGER",
+    float: "NUMBER_DOUBLE",
+    bool: "BOOLEAN",
+    list: "LIST",
+    dict: "STRUCT",
+}
+
+
+def _param_type(annotation) -> str:
+    return _TYPE_MAP.get(annotation, "STRING")
+
+
+@dataclass
+class Component:
+    """A pipeline step: a named, typed, source-extracted Python function."""
+
+    name: str
+    fn: Callable
+    source: str
+    inputs: dict[str, str]            # param name -> IR type
+    defaults: dict[str, Any]
+    output_type: str | None           # None = no return value
+
+    def __call__(self, *args, **kwargs):
+        ctx = _PipelineContext.current()
+        if ctx is None:
+            # outside a pipeline: behave as the plain function (unit tests)
+            return self.fn(*args, **kwargs)
+        bound = inspect.signature(self.fn).bind_partial(*args, **kwargs)
+        task = ctx.add_task(self, dict(bound.arguments))
+        return task.output
+
+
+def component(fn: Callable | None = None, *, name: str | None = None):
+    """Wrap a self-contained function as a Component."""
+
+    def wrap(f: Callable) -> Component:
+        sig = inspect.signature(f)
+        inputs, defaults = {}, {}
+        for pname, p in sig.parameters.items():
+            inputs[pname] = _param_type(p.annotation)
+            if p.default is not inspect.Parameter.empty:
+                defaults[pname] = p.default
+        out_t = (
+            None
+            if sig.return_annotation in (inspect.Signature.empty, None)
+            else _param_type(sig.return_annotation)
+        )
+        return Component(
+            name=name or f.__name__.replace("_", "-"),
+            fn=f,
+            source=_clean_source(f),
+            inputs=inputs,
+            defaults=defaults,
+            output_type=out_t,
+        )
+
+    return wrap(fn) if fn is not None else wrap
+
+
+def _clean_source(f: Callable) -> str:
+    """Function source with any @component decorator lines stripped (the
+    executor must see a plain def)."""
+    lines = textwrap.dedent(inspect.getsource(f)).splitlines()
+    start = next(i for i, ln in enumerate(lines) if ln.lstrip().startswith("def "))
+    return "\n".join(lines[start:]) + "\n"
+
+
+@dataclass(frozen=True)
+class TaskOutput:
+    """Placeholder for a task's return value during tracing."""
+
+    producer: str       # task name
+    key: str = "Output"
+
+
+@dataclass(frozen=True)
+class PipelineParam:
+    """Placeholder for a pipeline-level input parameter."""
+
+    name: str
+    param_type: str = "STRING"
+    default: Any = None
+
+
+@dataclass
+class Task:
+    name: str
+    component: Component
+    arguments: dict[str, Any]         # const | TaskOutput | PipelineParam
+    explicit_deps: list[str] = field(default_factory=lambda: [])
+
+    @property
+    def output(self) -> TaskOutput:
+        return TaskOutput(producer=self.name)
+
+    def after(self, *others: "Task | TaskOutput") -> "Task":
+        for o in others:
+            self.explicit_deps.append(o.producer if isinstance(o, TaskOutput) else o.name)
+        return self
+
+    def dependencies(self) -> list[str]:
+        deps = {
+            v.producer for v in self.arguments.values() if isinstance(v, TaskOutput)
+        }
+        deps.update(self.explicit_deps)
+        return sorted(deps)
+
+
+@dataclass
+class Pipeline:
+    name: str
+    description: str
+    params: dict[str, PipelineParam]
+    tasks: dict[str, Task]
+    # the traced function's return (a TaskOutput) — the run's output
+    result: TaskOutput | None = None
+
+
+class _PipelineContext:
+    _local = threading.local()
+
+    def __init__(self, name: str, description: str):
+        self.pipeline = Pipeline(name, description, {}, {})
+        self._counts: dict[str, int] = {}
+
+    @classmethod
+    def current(cls) -> "_PipelineContext | None":
+        return getattr(cls._local, "ctx", None)
+
+    def __enter__(self):
+        self._local.ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        self._local.ctx = None
+
+    def add_task(self, comp: Component, arguments: dict[str, Any]) -> Task:
+        n = self._counts.get(comp.name, 0)
+        self._counts[comp.name] = n + 1
+        tname = comp.name if n == 0 else f"{comp.name}-{n + 1}"
+        task = Task(name=tname, component=comp, arguments=arguments)
+        self.pipeline.tasks[tname] = task
+        return task
+
+
+def pipeline(fn: Callable | None = None, *, name: str | None = None,
+             description: str = ""):
+    """Trace a pipeline function into a Pipeline DAG."""
+
+    def wrap(f: Callable) -> Callable[..., Pipeline]:
+        pname = name or f.__name__.replace("_", "-")
+
+        def build(**overrides) -> Pipeline:
+            sig = inspect.signature(f)
+            ctx = _PipelineContext(pname, description or (f.__doc__ or "").strip())
+            placeholders = {}
+            for arg_name, p in sig.parameters.items():
+                default = None if p.default is inspect.Parameter.empty else p.default
+                if arg_name in overrides:
+                    default = overrides[arg_name]
+                pp = PipelineParam(
+                    name=arg_name, param_type=_param_type(p.annotation),
+                    default=default,
+                )
+                ctx.pipeline.params[arg_name] = pp
+                placeholders[arg_name] = pp
+            with ctx:
+                result = f(**placeholders)
+            if isinstance(result, TaskOutput):
+                ctx.pipeline.result = result
+            return ctx.pipeline
+
+        build.__name__ = f.__name__
+        build.pipeline_name = pname
+        return build
+
+    return wrap(fn) if fn is not None else wrap

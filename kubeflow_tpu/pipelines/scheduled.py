@@ -1,0 +1,87 @@
+"""Recurring runs — ScheduledWorkflow analogue.
+
+Reference parity (unverified cites, SURVEY.md §2.6): pipelines
+backend/src/crd/controller/scheduledworkflow — cron/interval-triggered
+pipeline runs with run history and concurrency control. Interval-based
+here (the cron-expression surface collapses to a period), driven by a
+daemon thread per schedule.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.pipelines.runner import LocalPipelineRunner, PipelineRun
+
+
+@dataclass
+class RecurringRun:
+    name: str
+    ir: dict
+    arguments: dict
+    interval_s: float
+    max_runs: int | None = None       # None = until stop()
+    enabled: bool = True
+    history: list[PipelineRun] = field(default_factory=lambda: [])
+
+
+class ScheduleManager:
+    def __init__(self, runner: LocalPipelineRunner):
+        self.runner = runner
+        self._schedules: dict[str, RecurringRun] = {}
+        self._threads: dict[str, threading.Thread] = {}
+        self._stop_flags: dict[str, threading.Event] = {}
+
+    def create(
+        self,
+        name: str,
+        ir: dict,
+        arguments: dict | None = None,
+        interval_s: float = 60.0,
+        max_runs: int | None = None,
+    ) -> RecurringRun:
+        if name in self._schedules:
+            raise KeyError(f"schedule {name!r} already exists")
+        rr = RecurringRun(
+            name=name, ir=ir, arguments=arguments or {},
+            interval_s=interval_s, max_runs=max_runs,
+        )
+        self._schedules[name] = rr
+        stop = threading.Event()
+        self._stop_flags[name] = stop
+        t = threading.Thread(
+            target=self._loop, args=(rr, stop), name=f"sched-{name}", daemon=True
+        )
+        self._threads[name] = t
+        t.start()
+        return rr
+
+    def _loop(self, rr: RecurringRun, stop: threading.Event) -> None:
+        while not stop.wait(rr.interval_s):
+            if not rr.enabled:
+                continue
+            run = self.runner.run(rr.ir, rr.arguments)
+            rr.history.append(run)
+            if rr.max_runs is not None and len(rr.history) >= rr.max_runs:
+                return
+
+    def get(self, name: str) -> RecurringRun | None:
+        return self._schedules.get(name)
+
+    def pause(self, name: str) -> None:
+        self._schedules[name].enabled = False
+
+    def resume(self, name: str) -> None:
+        self._schedules[name].enabled = True
+
+    def delete(self, name: str) -> None:
+        if name in self._stop_flags:
+            self._stop_flags.pop(name).set()
+        self._schedules.pop(name, None)
+        self._threads.pop(name, None)
+
+    def stop_all(self) -> None:
+        for name in list(self._schedules):
+            self.delete(name)

@@ -1,0 +1,214 @@
+"""P7: pipelines (KFP parity) tests.
+
+Mirrors the reference's kfp test strategy (SURVEY.md §4): golden-file IR
+compilation tests (pure, no execution), then runner e2e with caching,
+lineage, failure propagation, and recurring schedules.
+"""
+
+import time
+from pathlib import Path
+
+import pytest
+import yaml
+
+from kubeflow_tpu.native import MetadataStore
+from kubeflow_tpu.pipelines import (
+    LocalPipelineRunner,
+    ScheduleManager,
+    TaskState,
+    compile_pipeline,
+    compile_to_yaml,
+    component,
+    pipeline,
+    validate_ir,
+)
+
+GOLDEN = Path(__file__).parent / "golden" / "pipeline_add_square.yaml"
+
+
+@component
+def add(a: float, b: float) -> float:
+    return a + b
+
+
+@component
+def square(x: float) -> float:
+    return x * x
+
+
+@component
+def fail_step(x: float) -> float:
+    raise RuntimeError("intentional failure")
+
+
+@pipeline(name="add-square", description="adds then squares")
+def add_square(a: float = 2.0, b: float = 3.0):
+    s = add(a=a, b=b)
+    return square(x=s)
+
+
+@pipeline(name="diamond")
+def diamond(a: float = 1.0):
+    left = add(a=a, b=1.0)
+    right = add(a=a, b=2.0)
+    return add(a=left, b=right)
+
+
+class TestDSL:
+    def test_component_plain_call(self):
+        # outside a pipeline, components behave as their function
+        assert add(a=2.0, b=3.0) == 5.0
+
+    def test_trace_builds_dag(self):
+        p = add_square()
+        assert set(p.tasks) == {"add", "square"}
+        assert p.tasks["square"].dependencies() == ["add"]
+        assert p.result.producer == "square"
+
+    def test_duplicate_component_names(self):
+        p = diamond()
+        assert set(p.tasks) == {"add", "add-2", "add-3"}
+        assert sorted(p.tasks["add-3"].dependencies()) == ["add", "add-2"]
+
+    def test_explicit_after(self):
+        @pipeline(name="seq")
+        def seq():
+            first = add(a=1.0, b=1.0)
+            # no data dependency — ordering must come from .after()
+            t = square.__call__(x=3.0)
+            from kubeflow_tpu.pipelines.dsl import _PipelineContext
+
+            ctx = _PipelineContext.current()
+            ctx.pipeline.tasks["square"].after(ctx.pipeline.tasks["add"])
+            return t
+
+        p = seq()
+        assert p.tasks["square"].dependencies() == ["add"]
+
+
+class TestCompiler:
+    def test_golden_ir(self):
+        ir = compile_pipeline(add_square())
+        validate_ir(ir)
+        golden = yaml.safe_load(GOLDEN.read_text())
+        assert ir == golden, (
+            "IR drifted from golden file; if intentional, regenerate with:\n"
+            "python -c 'from tests.test_pipelines import regen; regen()'"
+        )
+
+    def test_cycle_detection(self):
+        ir = compile_pipeline(add_square())
+        ir["root"]["dag"]["tasks"]["add"]["dependentTasks"] = ["square"]
+        with pytest.raises(ValueError, match="cycle"):
+            validate_ir(ir)
+
+    def test_unknown_dependency(self):
+        ir = compile_pipeline(add_square())
+        ir["root"]["dag"]["tasks"]["add"]["dependentTasks"] = ["nope"]
+        with pytest.raises(ValueError, match="unknown dependency"):
+            validate_ir(ir)
+
+
+class TestRunner:
+    def test_run_end_to_end(self, tmp_path):
+        runner = LocalPipelineRunner(work_dir=str(tmp_path))
+        run = runner.run(compile_pipeline(add_square()), {"a": 2.0, "b": 3.0})
+        assert run.succeeded
+        assert run.tasks["add"].output == 5.0
+        assert run.output == 25.0
+
+    def test_defaults_applied(self, tmp_path):
+        runner = LocalPipelineRunner(work_dir=str(tmp_path))
+        run = runner.run(compile_pipeline(add_square()))
+        assert run.output == 25.0  # (2+3)^2 from declared defaults
+
+    def test_caching_second_run(self, tmp_path):
+        runner = LocalPipelineRunner(work_dir=str(tmp_path))
+        ir = compile_pipeline(add_square())
+        r1 = runner.run(ir, {"a": 1.0, "b": 1.0})
+        assert all(t.state == TaskState.SUCCEEDED for t in r1.tasks.values())
+        r2 = runner.run(ir, {"a": 1.0, "b": 1.0})
+        assert all(t.state == TaskState.CACHED for t in r2.tasks.values())
+        assert r2.output == 4.0
+        # different args miss the cache
+        r3 = runner.run(ir, {"a": 2.0, "b": 2.0})
+        assert r3.tasks["add"].state == TaskState.SUCCEEDED
+
+    def test_failure_skips_downstream(self, tmp_path):
+        @pipeline(name="failing")
+        def failing(a: float = 1.0):
+            bad = fail_step(x=a)
+            return square(x=bad)
+
+        runner = LocalPipelineRunner(work_dir=str(tmp_path))
+        run = runner.run(compile_pipeline(failing()))
+        assert not run.succeeded
+        assert run.tasks["fail-step"].state == TaskState.FAILED
+        assert "intentional failure" in run.tasks["fail-step"].error
+        assert run.tasks["square"].state == TaskState.SKIPPED
+
+    def test_diamond_order_and_output(self, tmp_path):
+        runner = LocalPipelineRunner(work_dir=str(tmp_path))
+        run = runner.run(compile_pipeline(diamond()), {"a": 1.0})
+        # (1+1) + (1+2) = 5
+        assert run.output == 5.0
+
+    def test_lineage_recorded(self, tmp_path):
+        ms = MetadataStore(str(tmp_path / "mlmd.db"))
+        runner = LocalPipelineRunner(work_dir=str(tmp_path), metadata_store=ms)
+        run = runner.run(compile_pipeline(add_square()), {"a": 2.0, "b": 3.0})
+        execs = ms.list_executions("pipeline_task")
+        assert len(execs) == 2
+        runs = ms.list_executions("pipeline_run")
+        assert len(runs) == 1 and runs[0]["state"] == "COMPLETE"
+        # the square task consumed the add task's output artifact value
+        arts = ms.list_artifacts("parameter")
+        by_name = {a["name"]: a for a in arts}
+        out_add = by_name[f"{run.run_id}/add/out/Output"]
+        in_sq = by_name[f"{run.run_id}/square/in/x"]
+        assert "5.0" in out_add["props"] and "5.0" in in_sq["props"]
+        # events link execution->artifact in both directions
+        assert any(e["direction"] == "1" for e in ms.events())
+        assert any(e["direction"] == "0" for e in ms.events())
+        ms.close()
+
+
+class TestScheduled:
+    def test_recurring_runs(self, tmp_path):
+        runner = LocalPipelineRunner(work_dir=str(tmp_path), cache=False)
+        mgr = ScheduleManager(runner)
+        rr = mgr.create(
+            "every-tick", compile_pipeline(add_square()),
+            {"a": 1.0, "b": 2.0}, interval_s=0.2, max_runs=2,
+        )
+        deadline = time.monotonic() + 30
+        while len(rr.history) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        mgr.stop_all()
+        assert len(rr.history) == 2
+        assert all(r.succeeded for r in rr.history)
+        assert rr.history[0].output == 9.0
+
+    def test_pause_resume(self, tmp_path):
+        runner = LocalPipelineRunner(work_dir=str(tmp_path), cache=False)
+        mgr = ScheduleManager(runner)
+        rr = mgr.create(
+            "pausable", compile_pipeline(add_square()),
+            {"a": 1.0, "b": 2.0}, interval_s=0.2,
+        )
+        mgr.pause("pausable")
+        n = len(rr.history)
+        time.sleep(0.8)
+        assert len(rr.history) == n  # nothing ran while paused
+        mgr.resume("pausable")
+        deadline = time.monotonic() + 30
+        while len(rr.history) <= n and time.monotonic() < deadline:
+            time.sleep(0.05)
+        mgr.stop_all()
+        assert len(rr.history) > n
+
+
+def regen():
+    """Regenerate the golden IR file (run from repo root)."""
+    GOLDEN.parent.mkdir(parents=True, exist_ok=True)
+    GOLDEN.write_text(compile_to_yaml(add_square()))
