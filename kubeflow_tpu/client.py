@@ -31,6 +31,7 @@ class Platform:
         capacity_chips: int = 8,
         controller_workers: int = 2,
     ):
+        from kubeflow_tpu.serving.controller import InferenceServiceController
         from kubeflow_tpu.sweep.controller import ExperimentController
 
         self.cluster = FakeCluster()
@@ -40,6 +41,10 @@ class Platform:
         self.controller = JobController(self.cluster, workers=controller_workers)
         self.experiment_controller = ExperimentController(
             self.cluster, log_reader=self._read_pod_log
+        )
+        self.isvc_controller = InferenceServiceController(
+            self.cluster,
+            model_cache_dir=str(Path(log_dir).parent / "model-cache"),
         )
         self._started = False
 
@@ -56,10 +61,12 @@ class Platform:
             self.gang_scheduler.start()
             self.controller.start()
             self.experiment_controller.start()
+            self.isvc_controller.start()
             self._started = True
         return self
 
     def stop(self) -> None:
+        self.isvc_controller.stop()
         self.experiment_controller.stop()
         self.controller.stop()
         self.gang_scheduler.stop()

@@ -1,0 +1,127 @@
+"""ControllerBase — the shared reconciler scaffolding.
+
+Reference parity: controller-runtime's manager/controller plumbing (informer
+-> work queue -> reconcile workers with rate-limited requeue, plus periodic
+resync), which every operator in the reference reuses rather than re-
+implements (SURVEY.md §2.1 'Common JobController'). Subclasses provide:
+
+  - kind_filter(etype, kind, obj) -> key | None   (what enqueues what)
+  - resync_keys() -> iterable[str]                (periodic full resync)
+  - reconcile(key) -> float | None                (the business logic)
+
+ConflictError from optimistic-concurrency writes is treated as benign
+(requeue, no error event) — the conflicting write's own watch event
+re-triggers the key anyway.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
+from kubeflow_tpu.native import WorkQueue
+
+
+class ControllerBase:
+    #: object kind whose events carry reconcile errors (for record_event)
+    ERROR_EVENT_KIND = "jobs"
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        name: str,
+        workers: int = 1,
+        resync_period_s: float = 5.0,
+        wq_base_delay_s: float = 0.005,
+        wq_max_delay_s: float = 10.0,
+    ):
+        self.cluster = cluster
+        self.name = name
+        self.wq = WorkQueue(base_delay_s=wq_base_delay_s, max_delay_s=wq_max_delay_s)
+        self.resync_period_s = resync_period_s
+        self._stop = threading.Event()
+        self._n_workers = workers
+        self.metrics: dict[str, int] = {
+            "reconcile_total": 0,
+            "reconcile_errors_total": 0,
+        }
+
+    # ------------------------------------------------------ subclass hooks
+
+    def kind_filter(self, etype, kind: str, obj) -> str | None:
+        """Map a watch event to a reconcile key (None = ignore)."""
+        raise NotImplementedError
+
+    def resync_keys(self) -> Iterable[str]:
+        """Keys to re-enqueue every resync period."""
+        raise NotImplementedError
+
+    def reconcile(self, key: str) -> float | None:
+        """One level-triggered pass; optional requeue delay in seconds."""
+        raise NotImplementedError
+
+    def observe_event(self, etype, kind: str, obj) -> None:
+        """Optional extra event bookkeeping (e.g. expectations)."""
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        threading.Thread(
+            target=self._watch_loop, name=f"{self.name}-informer", daemon=True
+        ).start()
+        for i in range(self._n_workers):
+            threading.Thread(
+                target=self._worker_loop, name=f"{self.name}-worker-{i}", daemon=True
+            ).start()
+        threading.Thread(
+            target=self._resync_loop, name=f"{self.name}-resync", daemon=True
+        ).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.wq.shutdown()
+
+    # ----------------------------------------------------------- internals
+
+    def _watch_loop(self) -> None:
+        q = self.cluster.watch()
+        while not self._stop.is_set():
+            try:
+                etype, kind, obj = q.get(timeout=0.2)
+            except Exception:  # queue.Empty only
+                continue
+            self.observe_event(etype, kind, obj)
+            key = self.kind_filter(etype, kind, obj)
+            if key is not None:
+                self.wq.add(key)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_period_s):
+            for key in self.resync_keys():
+                self.wq.add(key)
+
+    def _worker_loop(self) -> None:
+        while True:
+            key = self.wq.get(timeout_s=0.5)
+            if key is None:
+                if self.wq.shutting_down:
+                    return
+                continue
+            try:
+                self.metrics["reconcile_total"] += 1
+                requeue_after = self.reconcile(key)
+                self.wq.forget(key)
+                if requeue_after is not None:
+                    self.wq.add_after(key, requeue_after)
+            except ConflictError:
+                self.wq.add_rate_limited(key)
+            except Exception as exc:  # noqa: BLE001 — reconcile must not die
+                self.metrics["reconcile_errors_total"] += 1
+                self.cluster.record_event(
+                    self.ERROR_EVENT_KIND, key, "ReconcileError", str(exc),
+                    type="Warning",
+                )
+                self.wq.add_rate_limited(key)
+            finally:
+                self.wq.done(key)

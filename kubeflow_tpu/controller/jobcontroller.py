@@ -14,7 +14,6 @@ runPolicy.backoffLimit), not a single-pod restart (SURVEY.md §5.3).
 
 from __future__ import annotations
 
-import threading
 import time
 
 from kubeflow_tpu.api.common import (
@@ -27,16 +26,16 @@ from kubeflow_tpu.api.common import (
 )
 from kubeflow_tpu.api.jobs import SUCCESS_REPLICA, JobKind, TrainJob, REPLICA_WORKER
 from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.controller.base import ControllerBase
 from kubeflow_tpu.controller.envcontract import synthesize_env
 from kubeflow_tpu.controller.fakecluster import (
-    ConflictError,
     EventType,
     FakeCluster,
     Pod,
     PodGroup,
     PodPhase,
 )
-from kubeflow_tpu.native import Expectations, WorkQueue
+from kubeflow_tpu.native import Expectations
 from kubeflow_tpu.runtime.rendezvous import LocalResolver
 
 JOB_NAME_LABEL = "kubeflow-tpu.org/job-name"
@@ -48,7 +47,7 @@ REPLICA_INDEX_LABEL = "kubeflow-tpu.org/replica-index"
 WORLD_SIZE_LABEL = "kubeflow-tpu.org/world-size"
 
 
-class JobController:
+class JobController(ControllerBase):
     """Reconciles every job in the cluster. Start one per process."""
 
     def __init__(
@@ -58,19 +57,14 @@ class JobController:
         resync_period_s: float = 5.0,
         local_rewrite: bool = True,
     ):
-        self.cluster = cluster
-        self.wq = WorkQueue(base_delay_s=0.005, max_delay_s=10.0)
+        super().__init__(
+            cluster, name="job", workers=workers, resync_period_s=resync_period_s
+        )
         self.exp = Expectations(ttl_s=30.0)
         self.local_rewrite = local_rewrite
-        self.resync_period_s = resync_period_s
         self._resolvers: dict[str, LocalResolver] = {}
-        self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
-        self._n_workers = workers
         # prometheus-style counters (SURVEY.md §5.5)
-        self.metrics = {
-            "reconcile_total": 0,
-            "reconcile_errors_total": 0,
+        self.metrics.update({
             "jobs_created_total": 0,
             "jobs_succeeded_total": 0,
             "jobs_failed_total": 0,
@@ -78,83 +72,33 @@ class JobController:
             "jobs_remeshed_total": 0,
             "pods_created_total": 0,
             "pods_deleted_total": 0,
-        }
-
-    # ------------------------------------------------------------- lifecycle
-
-    def start(self) -> None:
-        t = threading.Thread(target=self._watch_loop, name="job-informer", daemon=True)
-        t.start()
-        self._threads.append(t)
-        for i in range(self._n_workers):
-            t = threading.Thread(
-                target=self._worker_loop, name=f"job-worker-{i}", daemon=True
-            )
-            t.start()
-            self._threads.append(t)
-        t = threading.Thread(target=self._resync_loop, name="job-resync", daemon=True)
-        t.start()
-        self._threads.append(t)
-
-    def stop(self) -> None:
-        self._stop.set()
-        self.wq.shutdown()
+        })
 
     # -------------------------------------------------------------- informer
 
-    def _watch_loop(self) -> None:
-        q = self.cluster.watch()
-        while not self._stop.is_set():
-            try:
-                etype, kind, obj = q.get(timeout=0.2)
-            except Exception:
-                continue
-            if kind == "jobs":
-                self.wq.add(self.cluster._key(obj))
-            elif kind == "pods":
-                job_name = obj.metadata.labels.get(JOB_NAME_LABEL)
-                if not job_name:
-                    continue
-                key = f"{obj.metadata.namespace}/{job_name}"
-                if etype == EventType.ADDED:
-                    self.exp.creation_observed(key)
-                elif etype == EventType.DELETED:
-                    self.exp.deletion_observed(key)
-                self.wq.add(key)
+    def observe_event(self, etype, kind: str, obj) -> None:
+        if kind != "pods":
+            return
+        job_name = obj.metadata.labels.get(JOB_NAME_LABEL)
+        if not job_name:
+            return
+        key = f"{obj.metadata.namespace}/{job_name}"
+        if etype == EventType.ADDED:
+            self.exp.creation_observed(key)
+        elif etype == EventType.DELETED:
+            self.exp.deletion_observed(key)
 
-    def _resync_loop(self) -> None:
-        """Periodic full resync (informer resync analogue): catches anything
-        a lost watch event would otherwise strand."""
-        while not self._stop.wait(self.resync_period_s):
-            for job in self.cluster.list("jobs"):
-                self.wq.add(self.cluster._key(job))
+    def kind_filter(self, etype, kind: str, obj) -> str | None:
+        if kind == "jobs":
+            return self.cluster._key(obj)
+        if kind == "pods":
+            job_name = obj.metadata.labels.get(JOB_NAME_LABEL)
+            if job_name:
+                return f"{obj.metadata.namespace}/{job_name}"
+        return None
 
-    def _worker_loop(self) -> None:
-        while True:
-            key = self.wq.get(timeout_s=0.5)
-            if key is None:
-                if self.wq.shutting_down:
-                    return
-                continue
-            try:
-                self.metrics["reconcile_total"] += 1
-                requeue_after = self.reconcile(key)
-                self.wq.forget(key)
-                if requeue_after is not None:
-                    self.wq.add_after(key, requeue_after)
-            except ConflictError:
-                # benign: object changed under this pass (client scale/suspend
-                # or a peer worker); the conflicting write's own watch event
-                # re-enqueues the key, but requeue anyway for belt-and-braces
-                self.wq.add_rate_limited(key)
-            except Exception as exc:  # noqa: BLE001 — reconcile must not die
-                self.metrics["reconcile_errors_total"] += 1
-                self.cluster.record_event(
-                    "jobs", key, "ReconcileError", str(exc), type="Warning"
-                )
-                self.wq.add_rate_limited(key)
-            finally:
-                self.wq.done(key)
+    def resync_keys(self):
+        return [self.cluster._key(j) for j in self.cluster.list("jobs")]
 
     # ------------------------------------------------------------- reconcile
 

@@ -1,0 +1,40 @@
+"""Serving subsystem — KServe parity (SURVEY.md §2.5).
+
+InferenceService spec -> predictor replica processes running an XLA-jitted
+model behind v1/v2 inference-protocol REST, with storage-initializer model
+pulling and controller-managed readiness/self-healing.
+"""
+
+from kubeflow_tpu.serving.api import (
+    InferenceService,
+    InferenceServiceSpec,
+    InferenceServiceStatus,
+    PredictorRuntime,
+    PredictorSpec,
+    TransformerSpec,
+    validate_isvc,
+)
+from kubeflow_tpu.serving.client import ServingClient
+from kubeflow_tpu.serving.controller import InferenceServiceController
+from kubeflow_tpu.serving.model import JaxModel, Model, load_model_class, save_predictor
+from kubeflow_tpu.serving.server import ModelServer
+from kubeflow_tpu.serving.storage import pull_model, resolve_uri
+
+__all__ = [
+    "InferenceService",
+    "InferenceServiceController",
+    "InferenceServiceSpec",
+    "InferenceServiceStatus",
+    "JaxModel",
+    "Model",
+    "ModelServer",
+    "PredictorRuntime",
+    "PredictorSpec",
+    "ServingClient",
+    "TransformerSpec",
+    "load_model_class",
+    "pull_model",
+    "resolve_uri",
+    "save_predictor",
+    "validate_isvc",
+]

@@ -1,0 +1,96 @@
+"""Serving CR-equivalents: InferenceService.
+
+Reference parity (unverified cites, SURVEY.md §2.5): kserve
+pkg/apis/serving/v1beta1 InferenceService{predictor,transformer,explainer}.
+Deployment mode is the RawDeployment analogue — the Knative/Istio serverless
+stack is intentionally out of scope (SURVEY.md §7 'what NOT to build');
+replica processes are managed directly by the ISVC controller.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.common import ObjectMeta
+
+
+class PredictorRuntime(str, enum.Enum):
+    # In-tree JAX runtime: model dir holds config.json + params.msgpack for
+    # an in-tree family; server builds the module and jit-compiles predict.
+    JAX = "jax"
+    # Custom runtime: user supplies "pkg.module:ModelClass" (the kserve
+    # custom-predictor container analogue, minus the container).
+    CUSTOM = "custom"
+
+
+@dataclass
+class PredictorSpec:
+    runtime: PredictorRuntime = PredictorRuntime.JAX
+    # gs:// s3:// pvc:// file:// or bare path; pulled by the storage
+    # initializer into the pod's model dir (/mnt/models contract)
+    storage_uri: str = ""
+    # CUSTOM runtime: import path "package.module:ClassName"
+    model_class: str = ""
+    replicas: int = 1
+    # batch axis the server pads requests to (0 = compile per batch shape)
+    max_batch_size: int = 0
+    env: dict[str, str] = field(default_factory=dict)
+    # device flag forwarded to the server process (tpu|cpu)
+    device: str = ""
+
+
+@dataclass
+class TransformerSpec:
+    """Pre/post-processing hop (kserve transformer analogue): a CUSTOM model
+    class whose preprocess/postprocess wrap the predictor call."""
+
+    model_class: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class InferenceServiceSpec:
+    predictor: PredictorSpec = field(default_factory=PredictorSpec)
+    transformer: TransformerSpec | None = None
+
+
+@dataclass
+class ReplicaEndpoint:
+    url: str = ""
+    ready: bool = False
+
+
+@dataclass
+class InferenceServiceStatus:
+    ready: bool = False
+    url: str = ""  # primary endpoint (replica 0)
+    replicas_ready: int = 0
+    endpoints: list[ReplicaEndpoint] = field(default_factory=lambda: [])
+    message: str = ""
+
+
+@dataclass
+class InferenceService:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: InferenceServiceSpec = field(default_factory=InferenceServiceSpec)
+    status: InferenceServiceStatus = field(default_factory=InferenceServiceStatus)
+    kind: str = "InferenceService"
+    api_version: str = "kubeflow-tpu.org/v1beta1"
+
+
+def validate_isvc(isvc: InferenceService) -> InferenceService:
+    if not isvc.metadata.name:
+        raise ValueError("inferenceservice: metadata.name required")
+    p = isvc.spec.predictor
+    if p.replicas < 1:
+        raise ValueError("inferenceservice: predictor.replicas must be >= 1")
+    if p.runtime == PredictorRuntime.JAX and not p.storage_uri:
+        raise ValueError("inferenceservice: jax runtime requires storageUri")
+    if p.runtime == PredictorRuntime.CUSTOM and not p.model_class:
+        raise ValueError(
+            "inferenceservice: custom runtime requires modelClass 'module:Class'"
+        )
+    if isvc.spec.transformer is not None and not isvc.spec.transformer.model_class:
+        raise ValueError("inferenceservice: transformer requires modelClass")
+    return isvc

@@ -1,0 +1,106 @@
+"""ServingClient — KServeClient parity.
+
+Reference parity (unverified cites, SURVEY.md §2.5): kserve python/kserve
+KServeClient.{create, get, wait_isvc_ready, delete} plus request helpers.
+predict()/infer() round-robin over ready replica endpoints (the Service
+load-balancer analogue).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import time
+import urllib.error
+import urllib.request
+
+from kubeflow_tpu.serving.api import InferenceService, validate_isvc
+from kubeflow_tpu.serving.controller import ISVC_LABEL
+
+
+class ServingClient:
+    def __init__(self, platform):
+        self.platform = platform
+        self.cluster = platform.cluster
+        self._rr = itertools.count()
+
+    # ------------------------------------------------------------------ CRUD
+
+    def create(self, isvc: InferenceService) -> InferenceService:
+        validate_isvc(isvc)
+        return self.cluster.create("inferenceservices", isvc)
+
+    def get(self, name: str, namespace: str = "default") -> InferenceService | None:
+        return self.cluster.get("inferenceservices", f"{namespace}/{name}")
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        # ISVC first: deleting pods first would race the controller's
+        # self-heal, which could re-spawn server processes for a service
+        # that is about to disappear
+        self.cluster.delete("inferenceservices", f"{namespace}/{name}")
+        for p in self.cluster.list(
+            "pods",
+            lambda p: p.metadata.labels.get(ISVC_LABEL) == name
+            and p.metadata.namespace == namespace,
+        ):
+            self.cluster.delete("pods", p.key)
+
+    def wait_ready(
+        self, name: str, namespace: str = "default", timeout_s: float = 120.0,
+        poll_s: float = 0.2,
+    ) -> InferenceService:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            isvc = self.get(name, namespace)
+            if isvc is not None and isvc.status.ready:
+                return isvc
+            time.sleep(poll_s)
+        raise TimeoutError(f"inferenceservice {namespace}/{name} not ready in {timeout_s}s")
+
+    # -------------------------------------------------------------- requests
+
+    def _endpoint(self, name: str, namespace: str) -> str:
+        isvc = self.get(name, namespace)
+        if isvc is None:
+            raise KeyError(name)
+        ready = [e.url for e in isvc.status.endpoints if e.ready]
+        if not ready:
+            raise RuntimeError(f"inferenceservice {name} has no ready replicas")
+        return ready[next(self._rr) % len(ready)]
+
+    def _post(self, url: str, payload: dict, timeout_s: float) -> dict:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")
+            raise RuntimeError(f"HTTP {exc.code} from {url}: {detail}") from exc
+
+    def predict(
+        self, name: str, instances: list, namespace: str = "default",
+        timeout_s: float = 30.0,
+    ) -> dict:
+        """v1 protocol: {"instances": [...]} -> {"predictions": [...]}."""
+        base = self._endpoint(name, namespace)
+        return self._post(
+            f"{base}/v1/models/{name}:predict", {"instances": instances}, timeout_s
+        )
+
+    def infer(
+        self, name: str, data, shape: list[int], datatype: str = "FP32",
+        namespace: str = "default", timeout_s: float = 30.0,
+    ) -> dict:
+        """v2 Open Inference Protocol infer call."""
+        base = self._endpoint(name, namespace)
+        payload = {
+            "inputs": [
+                {"name": "input-0", "shape": shape, "datatype": datatype,
+                 "data": data}
+            ]
+        }
+        return self._post(f"{base}/v2/models/{name}/infer", payload, timeout_s)

@@ -1,0 +1,242 @@
+"""InferenceServiceController — predictor replica management + readiness.
+
+Reference parity (unverified cites, SURVEY.md §2.5): kserve
+pkg/controller/v1beta1/inferenceservice in RawDeployment mode: reconcile the
+ISVC into predictor replicas, surface readiness + URL in status, self-heal
+dead replicas. Serverless (Knative activator / scale-to-zero) is out of
+scope by design (SURVEY.md §7).
+
+Each replica is a pod running `python -m kubeflow_tpu.serving.server`; the
+replica's port is allocated at pod-creation time and recorded in a pod
+annotation (the Service/Endpoint analogue the client reads).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.controller.base import ControllerBase
+from kubeflow_tpu.controller.fakecluster import (
+    FakeCluster,
+    Pod,
+    PodPhase,
+)
+from kubeflow_tpu.runtime.rendezvous import free_port
+from kubeflow_tpu.serving.api import InferenceService, PredictorRuntime
+import kubeflow_tpu
+
+# the server subprocess must be able to import this package regardless of
+# the parent's cwd
+_PKG_ROOT = str(Path(kubeflow_tpu.__file__).resolve().parent.parent)
+
+ISVC_LABEL = "kubeflow-tpu.org/inferenceservice"
+PORT_ANNOTATION = "kubeflow-tpu.org/serving-port"
+REPLICA_INDEX_LABEL = "kubeflow-tpu.org/replica-index"
+
+
+def probe_ready(url: str, timeout_s: float = 0.5) -> bool:
+    try:
+        with urllib.request.urlopen(f"{url}/v2/health/ready", timeout=timeout_s) as r:
+            return json.loads(r.read()).get("ready", False)
+    except Exception:  # noqa: BLE001 — any failure = not ready
+        return False
+
+
+class InferenceServiceController(ControllerBase):
+    ERROR_EVENT_KIND = "inferenceservices"
+
+    def __init__(self, cluster: FakeCluster, workers: int = 1,
+                 resync_period_s: float = 1.0, model_cache_dir: str = ".kubeflow_tpu/model-cache"):
+        # readiness probing rides the resync cadence
+        super().__init__(
+            cluster, name="isvc", workers=workers,
+            resync_period_s=resync_period_s,
+            wq_base_delay_s=0.01, wq_max_delay_s=5.0,
+        )
+        self.model_cache_dir = model_cache_dir
+        # probes are blocking HTTP calls: run them off a pool so one slow
+        # replica can't serialize readiness detection for everything else
+        self._probe_pool = ThreadPoolExecutor(max_workers=8,
+                                              thread_name_prefix="isvc-probe")
+        self.metrics.update({
+            "isvc_created_total": 0,
+            "isvc_ready_total": 0,
+            "predictor_pods_created_total": 0,
+            "predictor_pods_restarted_total": 0,
+        })
+
+    def stop(self) -> None:
+        super().stop()
+        self._probe_pool.shutdown(wait=False)
+
+    # -------------------------------------------------------------- informer
+
+    def kind_filter(self, etype, kind: str, obj) -> str | None:
+        if kind == "inferenceservices":
+            return self.cluster._key(obj)
+        if kind == "pods":
+            name = obj.metadata.labels.get(ISVC_LABEL)
+            if name:
+                return f"{obj.metadata.namespace}/{name}"
+        return None
+
+    def resync_keys(self):
+        return [self.cluster._key(i) for i in self.cluster.list("inferenceservices")]
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, key: str) -> float | None:
+        isvc: InferenceService | None = self.cluster.get(
+            "inferenceservices", key, copy_obj=True
+        )
+        if isvc is None:
+            # cascade: a deleted service must not leave server processes
+            # behind (e.g. self-heal recreated a pod mid-deletion)
+            ns, _, name = key.partition("/")
+            for p in self.cluster.list(
+                "pods",
+                lambda p: p.metadata.labels.get(ISVC_LABEL) == name
+                and p.metadata.namespace == ns,
+            ):
+                self.cluster.delete("pods", p.key)
+            return None
+        pods = self._owned_pods(isvc)
+
+        # self-heal: serving replicas must always run; any exited replica
+        # (crash OR clean exit) is replaced
+        for p in pods:
+            if p.status.phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
+                self.cluster.delete("pods", p.key)
+                self.metrics["predictor_pods_restarted_total"] += 1
+                self.cluster.record_event(
+                    "inferenceservices", key, "PredictorRestarted",
+                    f"replica {p.metadata.name} exited "
+                    f"(code {p.status.exit_code}); recreating",
+                    type="Warning",
+                )
+        pods = [p for p in pods if p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)]
+
+        # create missing replicas
+        have = {int(p.metadata.labels.get(REPLICA_INDEX_LABEL, -1)) for p in pods}
+        created = 0
+        for i in range(isvc.spec.predictor.replicas):
+            if i not in have:
+                self._create_replica(isvc, i)
+                created += 1
+        # drop excess replicas after a scale-down (highest index first)
+        for p in sorted(
+            pods,
+            key=lambda p: int(p.metadata.labels.get(REPLICA_INDEX_LABEL, -1)),
+            reverse=True,
+        ):
+            if int(p.metadata.labels.get(REPLICA_INDEX_LABEL, -1)) >= isvc.spec.predictor.replicas:
+                self.cluster.delete("pods", p.key)
+        pods = self._owned_pods(isvc)
+
+        # probe readiness per running replica (concurrently: each probe can
+        # block up to its timeout)
+        from kubeflow_tpu.serving.api import ReplicaEndpoint
+
+        ordered = sorted(
+            pods, key=lambda p: int(p.metadata.labels.get(REPLICA_INDEX_LABEL, 0))
+        )
+        urls = [
+            f"http://127.0.0.1:{p.metadata.annotations.get(PORT_ANNOTATION, '')}"
+            if p.metadata.annotations.get(PORT_ANNOTATION) else ""
+            for p in ordered
+        ]
+        futures = [
+            self._probe_pool.submit(probe_ready, url)
+            if (p.status.phase == PodPhase.RUNNING and url) else None
+            for p, url in zip(ordered, urls)
+        ]
+        endpoints = [
+            ReplicaEndpoint(url=url, ready=(f is not None and f.result()))
+            for url, f in zip(urls, futures)
+        ]
+
+        st = isvc.status
+        before = (st.ready, st.replicas_ready, st.url,
+                  tuple((e.url, e.ready) for e in st.endpoints))
+        st.endpoints = endpoints
+        st.replicas_ready = sum(1 for e in endpoints if e.ready)
+        newly_ready = st.replicas_ready > 0 and not st.ready
+        st.ready = st.replicas_ready > 0
+        ready_eps = [e for e in endpoints if e.ready]
+        st.url = ready_eps[0].url if ready_eps else ""
+        after = (st.ready, st.replicas_ready, st.url,
+                 tuple((e.url, e.ready) for e in st.endpoints))
+        if before != after:
+            self.cluster.update("inferenceservices", isvc)
+            if newly_ready:
+                self.metrics["isvc_ready_total"] += 1
+                self.cluster.record_event(
+                    "inferenceservices", key, "Ready",
+                    f"{st.replicas_ready}/{isvc.spec.predictor.replicas} "
+                    f"replicas ready at {st.url}",
+                )
+        # keep probing until the full replica set is ready
+        if created or st.replicas_ready < isvc.spec.predictor.replicas:
+            return 0.3
+        return None
+
+    # ------------------------------------------------------------- sub-steps
+
+    def _owned_pods(self, isvc: InferenceService) -> list[Pod]:
+        return self.cluster.list(
+            "pods",
+            lambda p: p.metadata.labels.get(ISVC_LABEL) == isvc.metadata.name
+            and p.metadata.namespace == isvc.metadata.namespace,
+        )
+
+    def _create_replica(self, isvc: InferenceService, index: int) -> None:
+        p = isvc.spec.predictor
+        port = free_port()
+        cmd = [
+            sys.executable, "-m", "kubeflow_tpu.serving.server",
+            "--model-name", isvc.metadata.name,
+            "--runtime", p.runtime.value,
+            "--port", str(port),
+            # per-replica dir: concurrent replicas pulling the same model
+            # must not clobber each other's files mid-load
+            "--model-dir",
+            f"{self.model_cache_dir}/{isvc.metadata.namespace}/r{index}",
+        ]
+        if p.storage_uri:
+            cmd += ["--storage-uri", p.storage_uri]
+        if p.model_class:
+            cmd += ["--model-class", p.model_class]
+        if p.device:
+            cmd += ["--device", p.device]
+        if isvc.spec.transformer is not None:
+            cmd += ["--transformer-class", isvc.spec.transformer.model_class]
+        env = dict(p.env)
+        env["PYTHONPATH"] = _PKG_ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else (os.pathsep + os.environ["PYTHONPATH"] if os.environ.get("PYTHONPATH") else "")
+        )
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=f"{isvc.metadata.name}-predictor-{index}",
+                namespace=isvc.metadata.namespace,
+                labels={
+                    ISVC_LABEL: isvc.metadata.name,
+                    REPLICA_INDEX_LABEL: str(index),
+                },
+                annotations={PORT_ANNOTATION: str(port)},
+            ),
+            command=cmd,
+            env=env,
+            scheduler_name="default",  # serving pods bypass gang scheduling
+        )
+        try:
+            self.cluster.create("pods", pod)
+        except KeyError:
+            return  # replaced concurrently
+        self.metrics["predictor_pods_created_total"] += 1

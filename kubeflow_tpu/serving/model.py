@@ -1,0 +1,191 @@
+"""Model API + the in-tree JAX predictor runtime.
+
+Reference parity (unverified cites, SURVEY.md §2.5): kserve
+python/kserve/kserve/model.py Model{load, preprocess, predict, postprocess}
+— the lifecycle a custom predictor implements — plus the framework-runtime
+wrappers (python/sklearnserver etc.), whose TPU-relevant analogue is a
+JAX/flax predictor that jit-compiles (XLA) at load and serves from the
+device (SURVEY.md §2.5 'XLA-AOT-compiled model on a TPU nodepool').
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+
+class Model:
+    """Base predictor. Subclass and override load/predict (and optionally
+    preprocess/postprocess); the server drives the full chain per request."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ready = False
+
+    def load(self) -> None:
+        self.ready = True
+
+    def preprocess(self, inputs: Any) -> Any:
+        return inputs
+
+    def predict(self, inputs: Any) -> Any:
+        raise NotImplementedError
+
+    def postprocess(self, outputs: Any) -> Any:
+        return outputs
+
+    def __call__(self, inputs: Any) -> Any:
+        return self.postprocess(self.predict(self.preprocess(inputs)))
+
+
+def load_model_class(path: str) -> type[Model]:
+    """Import 'package.module:ClassName' (custom-runtime contract)."""
+    mod_name, _, cls_name = path.partition(":")
+    if not cls_name:
+        raise ValueError(f"modelClass {path!r} must look like 'module:Class'")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    if not issubclass(cls, Model):
+        raise TypeError(f"{path} is not a kubeflow_tpu.serving.Model subclass")
+    return cls
+
+
+class TransformedModel(Model):
+    """Transformer hop (kserve transformer analogue, in-process): the
+    transformer's preprocess/postprocess wrap the predictor's full chain."""
+
+    def __init__(self, name: str, predictor: Model, transformer: Model):
+        super().__init__(name)
+        self.predictor = predictor
+        self.transformer = transformer
+
+    def load(self) -> None:
+        if not self.predictor.ready:
+            self.predictor.load()
+        if not self.transformer.ready:
+            self.transformer.load()
+        self.ready = True
+
+    def preprocess(self, inputs: Any) -> Any:
+        return self.transformer.preprocess(inputs)
+
+    def predict(self, inputs: Any) -> Any:
+        return self.predictor(inputs)
+
+    def postprocess(self, outputs: Any) -> Any:
+        return self.transformer.postprocess(outputs)
+
+
+# ------------------------------------------------------------ JAX runtime
+
+CONFIG_FILE = "config.json"
+PARAMS_FILE = "params.msgpack"
+
+
+def _build_family(family: str, kwargs: dict):
+    """In-tree model registry for the jax runtime (models/ package)."""
+    from kubeflow_tpu import models as M
+
+    if family == "mnist-mlp":
+        return M.MnistMLP(**kwargs)
+    if family == "mnist-cnn":
+        return M.MnistCNN(**kwargs)
+    if family.startswith("resnet"):
+        ctor = {
+            "resnet18": M.ResNet18, "resnet34": M.ResNet34,
+            "resnet50": M.ResNet50, "resnet101": M.ResNet101,
+            "resnet152": M.ResNet152,
+        }[family]
+        return ctor(**kwargs)
+    if family == "bert-classifier":
+        cfg_kw = kwargs.pop("config", {})
+        cfg = M.BertConfig.tiny(**cfg_kw) if kwargs.pop("size", "tiny") == "tiny" \
+            else M.BertConfig.base(**cfg_kw)
+        return M.BertForSequenceClassification(cfg=cfg, **kwargs)
+    raise ValueError(f"unknown model family {family!r}")
+
+
+def save_predictor(
+    model_dir: str | Path,
+    family: str,
+    variables: dict,
+    example_input: np.ndarray,
+    **family_kwargs,
+) -> Path:
+    """Write the jax-runtime model-dir contract: config.json (family +
+    kwargs + example input signature) and params.msgpack (all variable
+    collections). `variables` is {'params': ..., maybe 'batch_stats': ...}."""
+    from flax import serialization
+
+    d = Path(model_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    example = np.asarray(example_input)
+    (d / CONFIG_FILE).write_text(
+        json.dumps(
+            {
+                "family": family,
+                "kwargs": family_kwargs,
+                "input_shape": list(example.shape),
+                "input_dtype": str(example.dtype),
+            },
+            indent=2,
+        )
+    )
+    (d / PARAMS_FILE).write_bytes(serialization.to_bytes(variables))
+    return d
+
+
+class JaxModel(Model):
+    """In-tree-family predictor: rebuilds the flax module from config.json,
+    restores params, and jit-compiles inference at load (warmup on the
+    recorded example shape, so the first request pays no compile)."""
+
+    def __init__(self, name: str, model_dir: str | Path):
+        super().__init__(name)
+        self.model_dir = Path(model_dir)
+        self._predict_fn = None
+        self.config: dict = {}
+
+    def load(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        from flax import serialization
+
+        self.config = json.loads((self.model_dir / CONFIG_FILE).read_text())
+        module = _build_family(self.config["family"], dict(self.config["kwargs"]))
+        example = np.zeros(
+            self.config["input_shape"], dtype=self.config["input_dtype"]
+        )
+        kwargs = {}
+        import inspect
+
+        if "train" in inspect.signature(module.__call__).parameters:
+            kwargs["train"] = False
+        target = module.init(jax.random.PRNGKey(0), jnp.asarray(example), **kwargs)
+        variables = serialization.from_bytes(
+            target, (self.model_dir / PARAMS_FILE).read_bytes()
+        )
+
+        @jax.jit
+        def predict_fn(x):
+            return module.apply(variables, x, **kwargs)
+
+        # warmup: trace+compile on the recorded signature
+        predict_fn(jnp.asarray(example)).block_until_ready()
+        self._predict_fn = predict_fn
+        self.ready = True
+
+    def predict(self, inputs: np.ndarray) -> np.ndarray:
+        x = np.asarray(inputs, dtype=self.config["input_dtype"])
+        return np.asarray(self._predict_fn(x))
+
+    def postprocess(self, outputs: np.ndarray) -> dict:
+        """Classification contract: logits -> class + per-class scores."""
+        logits = np.asarray(outputs, dtype=np.float32)
+        return {
+            "predictions": np.argmax(logits, axis=-1).tolist(),
+            "logits": logits.tolist(),
+        }

@@ -1,0 +1,30 @@
+"""InferenceService YAML round-trip (kserve CR manifest parity)."""
+
+from __future__ import annotations
+
+import yaml
+
+from kubeflow_tpu.api.serde import _from_dict, to_dict
+from kubeflow_tpu.serving.api import InferenceService
+
+
+def isvc_to_dict(isvc: InferenceService) -> dict:
+    d = to_dict(isvc)
+    d.pop("kind", None)
+    d.pop("apiVersion", None)
+    if not isvc.status.ready and not isvc.status.endpoints:
+        d.pop("status", None)
+    return {"apiVersion": isvc.api_version, "kind": isvc.kind, **d}
+
+
+def isvc_to_yaml(isvc: InferenceService) -> str:
+    return yaml.safe_dump(isvc_to_dict(isvc), sort_keys=False)
+
+
+def isvc_from_dict(data: dict) -> InferenceService:
+    body = {k: v for k, v in data.items() if k not in ("kind", "apiVersion")}
+    return _from_dict(InferenceService, body)
+
+
+def isvc_from_yaml(text: str) -> InferenceService:
+    return isvc_from_dict(yaml.safe_load(text))

@@ -1,0 +1,276 @@
+"""ModelServer — REST surface speaking the v1 and v2 inference protocols.
+
+Reference parity (unverified cites, SURVEY.md §2.5, §3.5): kserve
+python/kserve/kserve/model_server.py + protocol/ — v1 (`:predict`) and v2
+Open Inference Protocol endpoints. Implemented on http.server (stdlib) so
+the serving path has zero web-framework dependencies; JSON tensors in/out.
+
+Routes:
+  GET  /v2                         server metadata
+  GET  /v2/health/live             liveness
+  GET  /v2/health/ready            readiness (all models loaded)
+  GET  /v2/models/{m}              model metadata
+  GET  /v2/models/{m}/ready        per-model readiness
+  POST /v2/models/{m}/infer        OIP inference
+  GET  /v1/models/{m}              v1 status
+  POST /v1/models/{m}:predict      v1 inference ({"instances": [...]})
+
+Run as a pod: python -m kubeflow_tpu.serving.server --model-name m ...
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from kubeflow_tpu.serving.model import Model
+
+SERVER_NAME = "kubeflow-tpu-modelserver"
+SERVER_VERSION = "0.1"
+
+_V2_TO_NP = {
+    "FP16": np.float16, "FP32": np.float32, "FP64": np.float64,
+    "INT8": np.int8, "INT16": np.int16, "INT32": np.int32, "INT64": np.int64,
+    "UINT8": np.uint8, "BOOL": np.bool_,
+}
+_NP_TO_V2 = {np.dtype(v): k for k, v in _V2_TO_NP.items()}
+
+
+def _np_to_datatype(arr: np.ndarray) -> str:
+    return _NP_TO_V2.get(arr.dtype, "FP32")
+
+
+class ModelServer:
+    """Hosts a repository of models behind one HTTP port."""
+
+    def __init__(self, models: list[Model] | None = None, port: int = 8080,
+                 host: str = "127.0.0.1"):
+        self.models: dict[str, Model] = {m.name: m for m in (models or [])}
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def register(self, model: Model) -> None:
+        self.models[model.name] = model
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self, block: bool = False) -> "ModelServer":
+        for m in self.models.values():
+            if not m.ready:
+                m.load()
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        if block:
+            self._httpd.serve_forever()
+        else:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------ handlers
+
+    def handle_get(self, path: str) -> tuple[int, dict]:
+        if path == "/v2":
+            return 200, {
+                "name": SERVER_NAME,
+                "version": SERVER_VERSION,
+                "extensions": [],
+            }
+        if path == "/v2/health/live":
+            return 200, {"live": True}
+        if path == "/v2/health/ready":
+            ready = all(m.ready for m in self.models.values()) and bool(self.models)
+            return (200 if ready else 503), {"ready": ready}
+        if path.startswith("/v2/models/") and path.endswith("/ready"):
+            name = path[len("/v2/models/"):-len("/ready")]
+            m = self.models.get(name)
+            if m is None:
+                return 404, {"error": f"model {name!r} not found"}
+            return (200 if m.ready else 503), {"name": name, "ready": m.ready}
+        if path.startswith("/v2/models/"):
+            name = path[len("/v2/models/"):]
+            m = self.models.get(name)
+            if m is None:
+                return 404, {"error": f"model {name!r} not found"}
+            meta = {"name": name, "platform": "jax-xla", "versions": ["1"]}
+            cfg = getattr(m, "config", None)
+            if cfg:
+                meta["inputs"] = [{
+                    "name": "input-0",
+                    "datatype": _NP_TO_V2.get(np.dtype(cfg["input_dtype"]), "FP32"),
+                    "shape": [-1, *cfg["input_shape"][1:]],
+                }]
+            return 200, meta
+        if path.startswith("/v1/models/"):
+            name = path[len("/v1/models/"):]
+            m = self.models.get(name)
+            if m is None:
+                return 404, {"error": f"model {name!r} not found"}
+            return 200, {"name": name, "ready": m.ready}
+        return 404, {"error": f"no route {path!r}"}
+
+    def handle_post(self, path: str, body: dict) -> tuple[int, dict]:
+        if path.startswith("/v1/models/") and path.endswith(":predict"):
+            name = path[len("/v1/models/"):-len(":predict")]
+            return self._predict_v1(name, body)
+        if path.startswith("/v2/models/") and path.endswith("/infer"):
+            name = path[len("/v2/models/"):-len("/infer")]
+            return self._infer_v2(name, body)
+        return 404, {"error": f"no route {path!r}"}
+
+    def _get_ready_model(self, name: str) -> Model | tuple[int, dict]:
+        m = self.models.get(name)
+        if m is None:
+            return 404, {"error": f"model {name!r} not found"}
+        if not m.ready:
+            return 503, {"error": f"model {name!r} not ready"}
+        return m
+
+    def _predict_v1(self, name: str, body: dict) -> tuple[int, dict]:
+        m = self._get_ready_model(name)
+        if isinstance(m, tuple):
+            return m
+        instances = body.get("instances")
+        if instances is None:
+            return 400, {"error": "v1 request must carry 'instances'"}
+        try:
+            out = m(np.asarray(instances))
+        except Exception as exc:  # noqa: BLE001 — surface as 500, keep serving
+            return 500, {"error": f"{type(exc).__name__}: {exc}"}
+        if isinstance(out, dict) and "predictions" in out:
+            return 200, out
+        return 200, {"predictions": np.asarray(out).tolist()}
+
+    def _infer_v2(self, name: str, body: dict) -> tuple[int, dict]:
+        m = self._get_ready_model(name)
+        if isinstance(m, tuple):
+            return m
+        inputs = body.get("inputs") or []
+        if not inputs:
+            return 400, {"error": "v2 request must carry 'inputs'"}
+        t = inputs[0]
+        try:
+            arr = np.asarray(
+                t["data"], dtype=_V2_TO_NP.get(t.get("datatype", "FP32"), np.float32)
+            ).reshape(t["shape"])
+            out = m(arr)
+        except Exception as exc:  # noqa: BLE001
+            return 500, {"error": f"{type(exc).__name__}: {exc}"}
+        if isinstance(out, dict):  # classification postprocess contract
+            arrays = [
+                ("predictions", np.asarray(out["predictions"])),
+                ("logits", np.asarray(out.get("logits", []), dtype=np.float32)),
+            ]
+        else:
+            arrays = [("output-0", np.asarray(out))]
+        return 200, {
+            "model_name": name,
+            "model_version": "1",
+            "outputs": [
+                {
+                    "name": k,
+                    "shape": list(v.shape),
+                    "datatype": _np_to_datatype(v),
+                    "data": v.ravel().tolist(),
+                }
+                for k, v in arrays
+            ],
+        }
+
+
+def _make_handler(server: ModelServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route to stdout for pod logs
+            print(f"[http] {fmt % args}", flush=True)
+
+        def _reply(self, code: int, payload: dict) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            code, payload = server.handle_get(self.path)
+            self._reply(code, payload)
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as exc:
+                self._reply(400, {"error": f"bad json: {exc}"})
+                return
+            code, payload = server.handle_post(self.path, body)
+            self._reply(code, payload)
+
+    return Handler
+
+
+# -------------------------------------------------------------------- main
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    from kubeflow_tpu.serving.model import JaxModel, load_model_class
+    from kubeflow_tpu.serving.storage import pull_model
+
+    ap = argparse.ArgumentParser(description="kubeflow-tpu model server")
+    ap.add_argument("--model-name", required=True)
+    ap.add_argument("--storage-uri", default="")
+    ap.add_argument("--model-dir", default=".kubeflow_tpu/models")
+    ap.add_argument("--runtime", default="jax", choices=["jax", "custom"])
+    ap.add_argument("--model-class", default="")
+    ap.add_argument("--transformer-class", default="")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--device", default="", help="tpu|cpu (default: env)")
+    args = ap.parse_args(argv)
+
+    if args.device:
+        from kubeflow_tpu.utils.device import select_device
+
+        select_device(args.device)
+
+    if args.runtime == "jax":
+        model_dir = args.model_dir
+        if args.storage_uri:
+            model_dir = pull_model(args.storage_uri, f"{args.model_dir}/{args.model_name}")
+        model: Model = JaxModel(args.model_name, model_dir)
+    else:
+        cls = load_model_class(args.model_class)
+        model = cls(args.model_name)
+    if args.transformer_class:
+        from kubeflow_tpu.serving.model import TransformedModel
+
+        t_cls = load_model_class(args.transformer_class)
+        model = TransformedModel(
+            args.model_name, model, t_cls(f"{args.model_name}-transformer")
+        )
+
+    srv = ModelServer([model], port=args.port, host=args.host)
+    srv.start(block=False)
+    print(f"server ready url={srv.url} model={args.model_name}", flush=True)
+    threading.Event().wait()  # serve until killed
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Storage initializer — pulls a model into the pod's model dir.
+
+Reference parity (unverified cites, SURVEY.md §2.5): kserve
+python/kserve/kserve/storage/storage.py, which runs as an initContainer and
+materializes gs://, s3://, pvc://, hf://, file:// URIs under /mnt/models.
+This environment has zero egress, so the remote schemes are gated with a
+clear error instead of stubbed-but-broken downloads; pvc:// resolves under a
+configurable local volume root (the PVC mount analogue).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+
+# PVC mount root: pvc://volume-name/sub/path -> $KFTPU_PVC_ROOT/volume-name/sub/path
+PVC_ROOT_ENV = "KFTPU_PVC_ROOT"
+DEFAULT_PVC_ROOT = ".kubeflow_tpu/volumes"
+
+_REMOTE_SCHEMES = ("gs://", "s3://", "hf://", "http://", "https://")
+
+
+def resolve_uri(storage_uri: str) -> Path:
+    """Map a storage URI to a local source path (no copy)."""
+    uri = storage_uri.strip()
+    for scheme in _REMOTE_SCHEMES:
+        if uri.startswith(scheme):
+            raise RuntimeError(
+                f"storage scheme {scheme!r} needs network egress, which this "
+                f"environment does not have; stage the model locally and use "
+                f"file:// or pvc:// instead"
+            )
+    if uri.startswith("pvc://"):
+        root = Path(os.environ.get(PVC_ROOT_ENV, DEFAULT_PVC_ROOT))
+        return root / uri[len("pvc://"):]
+    if uri.startswith("file://"):
+        return Path(uri[len("file://"):])
+    return Path(uri)
+
+
+def pull_model(storage_uri: str, dest_dir: str | Path) -> Path:
+    """Materialize the model under dest_dir (the /mnt/models contract).
+    Returns the destination path. Idempotent: re-pull replaces."""
+    src = resolve_uri(storage_uri)
+    if not src.exists():
+        raise FileNotFoundError(f"storage uri {storage_uri!r} -> {src} not found")
+    dest = Path(dest_dir)
+    if dest.exists():
+        shutil.rmtree(dest)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    if src.is_dir():
+        shutil.copytree(src, dest)
+    else:
+        dest.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(src, dest / src.name)
+    return dest

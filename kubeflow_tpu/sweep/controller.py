@@ -13,20 +13,14 @@ user jobs — the sweep engine composes with, not bypasses, the control plane.
 from __future__ import annotations
 
 import statistics
-import threading
-import time
 import zlib
 from typing import Callable
 
 from kubeflow_tpu.api.serde import job_from_yaml
 from kubeflow_tpu.api.validation import validate_job
-from kubeflow_tpu.controller.fakecluster import (
-    ConflictError,
-    EventType,
-    FakeCluster,
-)
+from kubeflow_tpu.controller.base import ControllerBase
+from kubeflow_tpu.controller.fakecluster import FakeCluster
 from kubeflow_tpu.controller.jobcontroller import delete_job_cascade
-from kubeflow_tpu.native import WorkQueue
 from kubeflow_tpu.sweep.api import (
     Experiment,
     ExperimentCondition,
@@ -45,8 +39,10 @@ from kubeflow_tpu.sweep.suggest import get_suggester
 EXPERIMENT_LABEL = "kubeflow-tpu.org/experiment-name"
 
 
-class ExperimentController:
+class ExperimentController(ControllerBase):
     """Reconciles experiments: suggest -> render -> launch -> observe."""
+
+    ERROR_EVENT_KIND = "experiments"
 
     def __init__(
         self,
@@ -55,93 +51,56 @@ class ExperimentController:
         workers: int = 1,
         resync_period_s: float = 0.5,
     ):
-        self.cluster = cluster
+        # resync doubles as the early-stopping poller: running trials' live
+        # logs are only re-examined on reconcile
+        super().__init__(
+            cluster, name="exp", workers=workers, resync_period_s=resync_period_s,
+            wq_max_delay_s=5.0,
+        )
         self.log_reader = log_reader
-        self.wq = WorkQueue(base_delay_s=0.005, max_delay_s=5.0)
-        self.resync_period_s = resync_period_s
-        self._stop = threading.Event()
-        self._n_workers = workers
         # finished trials' logs are immutable: cache their objective
         # timelines so the medianstop hot path isn't O(trials) file reads
         self._timeline_cache: dict[str, list[float]] = {}
-        self.metrics = {
+        # key -> uid so a delete-while-running can still evict its entries
+        self._uid_by_key: dict[str, str] = {}
+        self.metrics.update({
             "experiments_created_total": 0,
             "experiments_succeeded_total": 0,
             "experiments_failed_total": 0,
             "trials_created_total": 0,
             "trials_early_stopped_total": 0,
-        }
-
-    # ------------------------------------------------------------- lifecycle
-
-    def start(self) -> None:
-        threading.Thread(
-            target=self._watch_loop, name="exp-informer", daemon=True
-        ).start()
-        for i in range(self._n_workers):
-            threading.Thread(
-                target=self._worker_loop, name=f"exp-worker-{i}", daemon=True
-            ).start()
-        threading.Thread(
-            target=self._resync_loop, name="exp-resync", daemon=True
-        ).start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        self.wq.shutdown()
+        })
 
     # -------------------------------------------------------------- informer
 
-    def _watch_loop(self) -> None:
-        q = self.cluster.watch()
-        while not self._stop.is_set():
-            try:
-                etype, kind, obj = q.get(timeout=0.2)
-            except Exception:
-                continue
-            if kind == "experiments":
-                self.wq.add(self.cluster._key(obj))
-            elif kind in ("trials", "jobs", "pods"):
-                exp_name = obj.metadata.labels.get(EXPERIMENT_LABEL)
-                if exp_name:
-                    self.wq.add(f"{obj.metadata.namespace}/{exp_name}")
+    def kind_filter(self, etype, kind: str, obj) -> str | None:
+        if kind == "experiments":
+            return self.cluster._key(obj)
+        if kind in ("trials", "jobs", "pods"):
+            exp_name = obj.metadata.labels.get(EXPERIMENT_LABEL)
+            if exp_name:
+                return f"{obj.metadata.namespace}/{exp_name}"
+        return None
 
-    def _resync_loop(self) -> None:
-        # doubles as the early-stopping poller: running trials' live logs are
-        # only re-examined on reconcile
-        while not self._stop.wait(self.resync_period_s):
-            for exp in self.cluster.list("experiments"):
-                if not exp.status.is_finished:
-                    self.wq.add(self.cluster._key(exp))
-
-    def _worker_loop(self) -> None:
-        while True:
-            key = self.wq.get(timeout_s=0.5)
-            if key is None:
-                if self.wq.shutting_down:
-                    return
-                continue
-            try:
-                requeue = self.reconcile(key)
-                self.wq.forget(key)
-                if requeue is not None:
-                    self.wq.add_after(key, requeue)
-            except ConflictError:
-                self.wq.add_rate_limited(key)
-            except Exception as exc:  # noqa: BLE001
-                self.cluster.record_event(
-                    "experiments", key, "ReconcileError", str(exc), type="Warning"
-                )
-                self.wq.add_rate_limited(key)
-            finally:
-                self.wq.done(key)
+    def resync_keys(self):
+        return [
+            self.cluster._key(e)
+            for e in self.cluster.list("experiments")
+            if not e.status.is_finished
+        ]
 
     # ------------------------------------------------------------- reconcile
 
     def reconcile(self, key: str) -> float | None:
         exp: Experiment | None = self.cluster.get("experiments", key, copy_obj=True)
         if exp is None:
+            uid = self._uid_by_key.pop(key, None)
+            if uid is not None:
+                prefix = f"{uid}/"
+                for k in [k for k in self._timeline_cache if k.startswith(prefix)]:
+                    del self._timeline_cache[k]
             return None
+        self._uid_by_key[key] = exp.metadata.uid
         st = exp.status
         entry = _exp_fingerprint(st)
         if st.condition == ExperimentCondition.CREATED and not st.start_time:
@@ -388,13 +347,20 @@ class ExperimentController:
         return parse_metrics(log, {name}).get(name, [])
 
     def _done_timeline(self, exp: Experiment, trial: Trial) -> list[float]:
-        key = f"{trial.metadata.namespace}/{trial.metadata.name}"
+        # keyed by experiment uid so a deleted-and-recreated experiment with
+        # recycled trial names can never see the previous run's timelines
+        key = f"{exp.metadata.uid}/{trial.metadata.namespace}/{trial.metadata.name}"
         tl = self._timeline_cache.get(key)
         if tl is None:
             tl = self._objective_timeline(exp, trial)
             if tl:
                 self._timeline_cache[key] = tl
         return tl
+
+    def _drop_timelines(self, exp: Experiment) -> None:
+        prefix = f"{exp.metadata.uid}/"
+        for k in [k for k in self._timeline_cache if k.startswith(prefix)]:
+            del self._timeline_cache[k]
 
     def _optimal(self, exp: Experiment, succeeded: list[Trial]) -> OptimalTrial | None:
         obj = exp.spec.objective
@@ -504,6 +470,7 @@ class ExperimentController:
             self.metrics["experiments_failed_total"] += 1
         self.cluster.record_event("experiments", key, reason, f"experiment {cond.value}")
         self._kill_running(exp, trials)
+        self._drop_timelines(exp)
         return None
 
 

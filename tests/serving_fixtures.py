@@ -1,0 +1,32 @@
+"""Custom predictor/transformer classes for serving tests.
+
+Lives in an importable module (not the test file) because the custom-runtime
+contract loads 'module:Class' inside the server subprocess.
+"""
+
+import numpy as np
+
+from kubeflow_tpu.serving.model import Model
+
+
+class DoubleModel(Model):
+    """Predicts 2*x — trivially verifiable through the whole HTTP stack."""
+
+    def load(self):
+        self.ready = True
+
+    def predict(self, inputs):
+        return np.asarray(inputs) * 2.0
+
+
+class PlusOneTransformer(Model):
+    """preprocess adds 1, postprocess flips sign: output = -((x+1)*2)."""
+
+    def load(self):
+        self.ready = True
+
+    def preprocess(self, inputs):
+        return np.asarray(inputs) + 1.0
+
+    def postprocess(self, outputs):
+        return (-np.asarray(outputs)).tolist()
