@@ -71,6 +71,10 @@ class JAXJobSpec:
     coordinator_port: int = DEFAULT_PORTS[JobKind.JAX]
     # Number of slices for multislice (DCN/megascale) jobs; 1 = single slice.
     num_slices: int = 1
+    # First-class profiling toggle (SURVEY.md §5.1): when set, workers get
+    # KFTPU_PROFILE_DIR and the in-tree trainer writes a jax.profiler
+    # (perfetto-compatible) trace per process under it.
+    profile_dir: str = ""
 
 
 @dataclass

@@ -46,7 +46,16 @@ class Platform:
             self.cluster,
             model_cache_dir=str(Path(log_dir).parent / "model-cache"),
         )
+        self.metrics_server = None  # started on demand
         self._started = False
+
+    def start_metrics_server(self, port: int = 0) -> str:
+        """Expose GET /metrics (Prometheus text) + /healthz; returns the URL."""
+        from kubeflow_tpu.observability import MetricsServer
+
+        if self.metrics_server is None:
+            self.metrics_server = MetricsServer(self, port=port).start()
+        return self.metrics_server.url
 
     def _read_pod_log(self, pod_name: str) -> str:
         path = self.pod_runtime.log_path(pod_name)
@@ -66,6 +75,9 @@ class Platform:
         return self
 
     def stop(self) -> None:
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         self.isvc_controller.stop()
         self.experiment_controller.stop()
         self.controller.stop()

@@ -99,6 +99,9 @@ def jax_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
         env["MEGASCALE_COORDINATOR_ADDRESS"] = coord
         env["MEGASCALE_NUM_SLICES"] = str(job.spec.num_slices)
         env["MEGASCALE_SLICE_ID"] = str(index // per_slice)
+    if job.spec.profile_dir:
+        # per-process subdir so N workers' traces never collide
+        env["KFTPU_PROFILE_DIR"] = f"{job.spec.profile_dir}/process-{index}"
     return env
 
 

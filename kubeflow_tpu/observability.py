@@ -1,0 +1,106 @@
+"""Observability — Prometheus-text /metrics endpoint for the platform.
+
+Reference parity (unverified cites, SURVEY.md §5.5): every operator exposes
+a controller-runtime Prometheus endpoint (workqueue depth, reconcile
+totals, custom counters). Here one endpoint aggregates all in-process
+controllers, the object store, and the pod runtime.
+
+Format is the Prometheus text exposition format, served by stdlib
+http.server — scrape `GET /metrics`.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def render_metrics(platform) -> str:
+    """Aggregate platform state into Prometheus text format."""
+    lines: list[str] = []
+
+    def counter(name: str, value, help_: str = "") -> None:
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {value}")
+
+    def gauge(name: str, value, help_: str = "", labels: str = "") -> None:
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {value}")
+
+    controllers = {
+        "job": platform.controller,
+        "experiment": platform.experiment_controller,
+        "isvc": platform.isvc_controller,
+    }
+    for cname, ctrl in controllers.items():
+        for mname, v in sorted(ctrl.metrics.items()):
+            counter(f"kftpu_{cname}_{mname}", v)
+        gauge(
+            f"kftpu_{cname}_workqueue_depth", len(ctrl.wq),
+            help_="pending reconcile keys",
+        )
+
+    cluster = platform.cluster
+    # one TYPE line, then one sample per label — repeated TYPE lines for the
+    # same metric are invalid exposition format and fail real scrapes
+    lines.append("# TYPE kftpu_objects gauge")
+    for kind in cluster.KINDS:
+        lines.append(f'kftpu_objects{{kind="{kind}"}} {len(cluster.list(kind))}')
+    gauge("kftpu_events_total", len(cluster.events))
+    gauge(
+        "kftpu_capacity_chips", cluster.capacity_chips,
+        help_="schedulable chips in the gang scheduler",
+    )
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """GET /metrics and GET /healthz on a local port."""
+
+    def __init__(self, platform, port: int = 0, host: str = "127.0.0.1"):
+        self.platform = platform
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+
+    def start(self) -> "MetricsServer":
+        plat = self.platform
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass  # metrics scrapes are not worth log noise
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/metrics":
+                    body = render_metrics(plat).encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

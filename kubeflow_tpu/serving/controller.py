@@ -64,6 +64,7 @@ class InferenceServiceController(ControllerBase):
         # replica can't serialize readiness detection for everything else
         self._probe_pool = ThreadPoolExecutor(max_workers=8,
                                               thread_name_prefix="isvc-probe")
+        self._seen: set[str] = set()
         self.metrics.update({
             "isvc_created_total": 0,
             "isvc_ready_total": 0,
@@ -105,7 +106,11 @@ class InferenceServiceController(ControllerBase):
                 and p.metadata.namespace == ns,
             ):
                 self.cluster.delete("pods", p.key)
+            self._seen.discard(key)
             return None
+        if key not in self._seen:
+            self._seen.add(key)
+            self.metrics["isvc_created_total"] += 1
         pods = self._owned_pods(isvc)
 
         # self-heal: serving replicas must always run; any exited replica

@@ -96,9 +96,7 @@ class ExperimentController(ControllerBase):
         if exp is None:
             uid = self._uid_by_key.pop(key, None)
             if uid is not None:
-                prefix = f"{uid}/"
-                for k in [k for k in self._timeline_cache if k.startswith(prefix)]:
-                    del self._timeline_cache[k]
+                self._drop_timelines(uid)
             return None
         self._uid_by_key[key] = exp.metadata.uid
         st = exp.status
@@ -357,8 +355,8 @@ class ExperimentController(ControllerBase):
                 self._timeline_cache[key] = tl
         return tl
 
-    def _drop_timelines(self, exp: Experiment) -> None:
-        prefix = f"{exp.metadata.uid}/"
+    def _drop_timelines(self, uid: str) -> None:
+        prefix = f"{uid}/"
         for k in [k for k in self._timeline_cache if k.startswith(prefix)]:
             del self._timeline_cache[k]
 
@@ -470,7 +468,7 @@ class ExperimentController(ControllerBase):
             self.metrics["experiments_failed_total"] += 1
         self.cluster.record_event("experiments", key, reason, f"experiment {cond.value}")
         self._kill_running(exp, trials)
-        self._drop_timelines(exp)
+        self._drop_timelines(exp.metadata.uid)
         return None
 
 

@@ -61,6 +61,9 @@ class TrainerConfig:
     checkpoint_every_steps: int = 200
     log_every_steps: int = 50
     mesh: MeshConfig | None = None    # None => single-device mesh semantics
+    # jax.profiler trace output dir; "" defers to the platform's
+    # KFTPU_PROFILE_DIR env (the JAXJob profile toggle, SURVEY.md §5.1)
+    profile_dir: str = ""
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -196,6 +199,27 @@ class Trainer:
     # ------------------------------------------------------------------- fit
 
     def fit(
+        self,
+        dataset: Dataset,
+        *,
+        resume: bool = True,
+        on_epoch_end: Callable[[int, dict], None] | None = None,
+    ) -> tuple[TrainState, dict]:
+        import os
+
+        profile_dir = self.config.profile_dir or os.environ.get(
+            "KFTPU_PROFILE_DIR", ""
+        )
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
+            try:
+                return self._fit(dataset, resume=resume, on_epoch_end=on_epoch_end)
+            finally:
+                jax.profiler.stop_trace()
+                metrics_lib.emit(profile_trace_written=1)
+        return self._fit(dataset, resume=resume, on_epoch_end=on_epoch_end)
+
+    def _fit(
         self,
         dataset: Dataset,
         *,

@@ -1,0 +1,97 @@
+"""P8: observability tests — /metrics endpoint, profiler toggle."""
+
+import sys
+import textwrap
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    JAXJob,
+    JAXJobSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.client import Platform, TrainingClient
+from kubeflow_tpu.controller.envcontract import synthesize_env
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    p = Platform(log_dir=str(tmp_path / "pod-logs"))
+    with p:
+        yield p
+
+
+class TestMetricsEndpoint:
+    def test_scrape_after_job(self, platform, tmp_path):
+        url = platform.start_metrics_server()
+        client = TrainingClient(platform)
+        script = tmp_path / "ok.py"
+        script.write_text("print('done')")
+        client.create_job(
+            JAXJob(
+                metadata=ObjectMeta(name="obsjob"),
+                spec=JAXJobSpec(
+                    replica_specs={
+                        REPLICA_WORKER: ReplicaSpec(
+                            replicas=1,
+                            template=PodTemplateSpec(
+                                container=ContainerSpec(
+                                    command=[sys.executable, str(script)]
+                                )
+                            ),
+                        )
+                    }
+                ),
+            )
+        )
+        client.wait_for_job_conditions("obsjob", timeout_s=30)
+        with urllib.request.urlopen(f"{url}/metrics", timeout=5) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        assert "kftpu_job_jobs_succeeded_total 1" in body
+        assert "kftpu_job_reconcile_total" in body
+        assert 'kftpu_objects{kind="jobs"} 1' in body
+        assert "kftpu_experiment_workqueue_depth" in body
+        assert "kftpu_isvc_workqueue_depth" in body
+        with urllib.request.urlopen(f"{url}/healthz", timeout=5) as r:
+            assert r.read() == b"ok\n"
+
+
+class TestProfilerToggle:
+    def test_env_contract_carries_profile_dir(self, tmp_path):
+        job = JAXJob(
+            metadata=ObjectMeta(name="profjob"),
+            spec=JAXJobSpec(
+                replica_specs={REPLICA_WORKER: ReplicaSpec(replicas=2)},
+                profile_dir=str(tmp_path / "traces"),
+            ),
+        )
+        env = synthesize_env(job, REPLICA_WORKER, 1)
+        assert env["KFTPU_PROFILE_DIR"] == str(tmp_path / "traces") + "/process-1"
+        # absent when not requested
+        job.spec.profile_dir = ""
+        assert "KFTPU_PROFILE_DIR" not in synthesize_env(job, REPLICA_WORKER, 0)
+
+    def test_trainer_writes_trace(self, tmp_path):
+        from kubeflow_tpu.models import MnistMLP
+        from kubeflow_tpu.train import Trainer, TrainerConfig
+        from kubeflow_tpu.train.data import synthetic_image_dataset
+
+        ds = synthetic_image_dataset(n_train=64, n_test=32, shape=(8, 8, 1))
+        trainer = Trainer(
+            MnistMLP(hidden=(8,)),
+            TrainerConfig(
+                batch_size=32, steps=2, log_every_steps=1,
+                profile_dir=str(tmp_path / "trace"),
+            ),
+        )
+        trainer.fit(ds)
+        # jax.profiler writes plugins/profile/<ts>/*.trace.json.gz (or .pb)
+        produced = list((tmp_path / "trace").rglob("*"))
+        assert any(p.is_file() for p in produced), "no trace files written"
