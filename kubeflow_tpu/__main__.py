@@ -1,0 +1,3 @@
+from kubeflow_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,251 @@
+"""CLI — `python -m kubeflow_tpu <command>`.
+
+Reference parity: the reference platform is driven by kubectl + per-project
+CLIs (kfctl-era; SURVEY.md §2.7) against CR manifests. This CLI takes the
+same CR-shaped YAML (samples/) and drives the in-process platform one-shot:
+
+  run          -f job.yaml        submit a TrainJob, wait, print verdict+logs
+  validate     -f job.yaml        admission-check a manifest
+  render-env   -f job.yaml        print the synthesized rendezvous env
+  sweep        -f experiment.yaml run an Experiment, print the optimal trial
+  serve        -f isvc.yaml       serve an InferenceService until Ctrl-C
+  pipeline-compile module:fn      compile a @pipeline function to IR YAML
+  pipeline-run -f ir.yaml         execute compiled IR locally
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+from pathlib import Path
+
+
+def _read(path: str) -> str:
+    return sys.stdin.read() if path == "-" else Path(path).read_text()
+
+
+# ------------------------------------------------------------------ commands
+
+def cmd_validate(args) -> int:
+    from kubeflow_tpu.api.serde import job_from_yaml, job_to_yaml
+    from kubeflow_tpu.api.validation import validate_job
+
+    job = validate_job(job_from_yaml(_read(args.filename)))
+    print(job_to_yaml(job), end="")
+    print(f"# {job.kind.value} {job.namespace}/{job.name}: OK", file=sys.stderr)
+    return 0
+
+
+def cmd_render_env(args) -> int:
+    from kubeflow_tpu.api.serde import job_from_yaml
+    from kubeflow_tpu.api.validation import validate_job
+    from kubeflow_tpu.controller.envcontract import synthesize_env
+
+    job = validate_job(job_from_yaml(_read(args.filename)))
+    env = synthesize_env(job, args.rtype, args.index)
+    for k in sorted(env):
+        print(f"{k}={env[k]}")
+    return 0
+
+
+def cmd_run(args) -> int:
+    from kubeflow_tpu.api.serde import job_from_yaml
+    from kubeflow_tpu.client import Platform, TrainingClient
+
+    job = job_from_yaml(_read(args.filename))
+    with Platform(capacity_chips=args.capacity_chips, log_dir=args.log_dir) as platform:
+        client = TrainingClient(platform)
+        client.create_job(job)
+        print(f"{job.kind.value} {job.namespace}/{job.name} created", file=sys.stderr)
+        done = client.wait_for_job_conditions(
+            job.name, job.namespace, timeout_s=args.timeout
+        )
+        for cond in done.status.conditions:
+            if cond.status:
+                print(f"condition: {cond.type.value} ({cond.reason})", file=sys.stderr)
+        if args.logs:
+            for rtype, rs in job.spec.replica_specs.items():
+                for i in range(rs.replicas):
+                    print(f"--- {rtype}-{i} ---")
+                    print(client.get_job_logs(job.name, job.namespace, rtype, i), end="")
+        return 0 if done.status.is_succeeded else 1
+
+
+def cmd_sweep(args) -> int:
+    from kubeflow_tpu.client import Platform
+    from kubeflow_tpu.sweep import SweepClient
+    from kubeflow_tpu.sweep.serde import experiment_from_yaml
+
+    exp = experiment_from_yaml(_read(args.filename))
+    with Platform(capacity_chips=args.capacity_chips, log_dir=args.log_dir) as platform:
+        sweep = SweepClient(platform)
+        sweep.create_experiment(exp)
+        print(f"experiment {exp.metadata.name} created "
+              f"(max {exp.spec.max_trial_count} trials)", file=sys.stderr)
+        done = sweep.wait_for_experiment(
+            exp.metadata.name, exp.metadata.namespace, timeout_s=args.timeout
+        )
+        best = done.status.current_optimal_trial
+        print(json.dumps({
+            "condition": done.status.condition.value,
+            "message": done.status.message,
+            "trials": done.status.trials,
+            "succeeded": done.status.trials_succeeded,
+            "earlyStopped": done.status.trials_early_stopped,
+            "optimal": {
+                "trial": best.trial_name if best else None,
+                "parameters": (
+                    {a.name: a.value for a in best.parameter_assignments}
+                    if best else {}
+                ),
+                "metrics": (
+                    {m.name: m.latest for m in best.observation.metrics}
+                    if best else {}
+                ),
+            },
+        }, indent=2))
+        return 0 if done.status.condition.value == "Succeeded" else 1
+
+
+def cmd_serve(args) -> int:
+    from kubeflow_tpu.client import Platform
+    from kubeflow_tpu.serving import ServingClient
+    from kubeflow_tpu.serving.serde import isvc_from_yaml
+
+    isvc = isvc_from_yaml(_read(args.filename))
+    with Platform(log_dir=args.log_dir) as platform:
+        serving = ServingClient(platform)
+        serving.create(isvc)
+        ready = serving.wait_ready(
+            isvc.metadata.name, isvc.metadata.namespace, timeout_s=args.timeout
+        )
+        print(f"ready: {ready.status.url}")
+        print(f"  v1: POST {ready.status.url}/v1/models/{isvc.metadata.name}:predict")
+        print(f"  v2: POST {ready.status.url}/v2/models/{isvc.metadata.name}/infer")
+        try:
+            import threading
+
+            threading.Event().wait()  # hold until Ctrl-C
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+def _load_pipeline(spec: str):
+    mod_name, _, fn_name = spec.partition(":")
+    if not fn_name:
+        raise SystemExit(f"pipeline ref {spec!r} must look like 'module:function'")
+    return getattr(importlib.import_module(mod_name), fn_name)
+
+
+def cmd_pipeline_compile(args) -> int:
+    from kubeflow_tpu.pipelines import compile_to_yaml
+
+    text = compile_to_yaml(_load_pipeline(args.pipeline)())
+    if args.output:
+        Path(args.output).write_text(text)
+        print(f"wrote {args.output}", file=sys.stderr)
+    else:
+        print(text, end="")
+    return 0
+
+
+def cmd_pipeline_run(args) -> int:
+    import contextlib
+
+    import yaml
+
+    from kubeflow_tpu.pipelines import LocalPipelineRunner
+
+    ir = yaml.safe_load(_read(args.filename))
+    arguments = {}
+    for kv in args.arg or []:
+        k, _, v = kv.partition("=")
+        try:
+            arguments[k] = json.loads(v)
+        except json.JSONDecodeError:
+            arguments[k] = v
+    # trainJob steps need a live control plane; spin one up only then
+    needs_platform = any(
+        "trainJob" in ex
+        for ex in ir.get("deploymentSpec", {}).get("executors", {}).values()
+    )
+    with contextlib.ExitStack() as stack:
+        platform = None
+        if needs_platform:
+            from kubeflow_tpu.client import Platform
+
+            platform = stack.enter_context(Platform(log_dir=args.log_dir))
+        runner = LocalPipelineRunner(
+            work_dir=args.work_dir, cache=not args.no_cache, platform=platform
+        )
+        run = runner.run(ir, arguments)
+    print(json.dumps({
+        "runId": run.run_id,
+        "state": run.state.value,
+        "tasks": {t: r.state.value for t, r in run.tasks.items()},
+        "output": run.output,
+    }, indent=2))
+    return 0 if run.succeeded else 1
+
+
+# ---------------------------------------------------------------------- main
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kubeflow_tpu", description="TPU-native ML platform CLI"
+    )
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    def add(name, fn, **kwargs):
+        p = sub.add_parser(name, **kwargs)
+        p.set_defaults(fn=fn)
+        return p
+
+    p = add("run", cmd_run, help="submit a TrainJob manifest and wait")
+    p.add_argument("-f", "--filename", required=True, help="manifest ('-' = stdin)")
+    p.add_argument("--logs", action="store_true", help="print replica logs at the end")
+    p.add_argument("--timeout", type=float, default=3600.0)
+    p.add_argument("--capacity-chips", type=int, default=8)
+    p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+
+    p = add("validate", cmd_validate, help="admission-check a manifest")
+    p.add_argument("-f", "--filename", required=True)
+
+    p = add("render-env", cmd_render_env,
+            help="print the synthesized rendezvous env for one replica")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--rtype", default="worker")
+    p.add_argument("--index", type=int, default=0)
+
+    p = add("sweep", cmd_sweep, help="run an Experiment manifest")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--timeout", type=float, default=3600.0)
+    p.add_argument("--capacity-chips", type=int, default=8)
+    p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+
+    p = add("serve", cmd_serve, help="serve an InferenceService until Ctrl-C")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+
+    p = add("pipeline-compile", cmd_pipeline_compile,
+            help="compile a @pipeline function (module:fn) to IR YAML")
+    p.add_argument("pipeline")
+    p.add_argument("-o", "--output", default="")
+
+    p = add("pipeline-run", cmd_pipeline_run, help="execute compiled IR")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--arg", action="append", metavar="KEY=VALUE")
+    p.add_argument("--work-dir", default=".kubeflow_tpu/pipelines")
+    p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+    p.add_argument("--no-cache", action="store_true")
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -103,7 +103,10 @@ class ClusterEvent:
 class FakeCluster:
     """Thread-safe object store + watch hub."""
 
-    KINDS = ("jobs", "pods", "podgroups", "experiments", "trials", "inferenceservices")
+    KINDS = (
+        "jobs", "pods", "podgroups", "experiments", "trials",
+        "inferenceservices", "poddefaults",
+    )
 
     def __init__(self) -> None:
         self._mu = threading.RLock()

@@ -35,6 +35,7 @@ from kubeflow_tpu.controller.fakecluster import (
     PodGroup,
     PodPhase,
 )
+from kubeflow_tpu.controller.poddefault import apply_pod_defaults
 from kubeflow_tpu.native import Expectations
 from kubeflow_tpu.runtime.rendezvous import LocalResolver
 
@@ -285,6 +286,7 @@ class JobController(ControllerBase):
                 scheduler_name=job.spec.replica_specs[rtype].template.scheduler_name,
                 group_name=job.metadata.name,
             )
+            apply_pod_defaults(self.cluster, pod)  # admission mutation
             self.cluster.create("pods", pod)
             self.metrics["pods_created_total"] += 1
         return len(to_create)

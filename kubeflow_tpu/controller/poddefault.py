@@ -1,0 +1,61 @@
+"""PodDefault — label-selected pod mutation at admission.
+
+Reference parity (unverified cites, SURVEY.md §2.7): kubeflow/kubeflow
+components/admission-webhook — the `PodDefault` CR + mutating webhook that
+injects env/volumes/annotations into pods whose labels match the selector.
+Here the mutation happens at the moment a controller creates a pod (the
+admission point of this control plane).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.controller.fakecluster import FakeCluster, Pod
+
+
+@dataclass
+class PodDefaultSpec:
+    # pods whose labels contain ALL of these match (matchLabels semantics)
+    selector: dict[str, str] = field(default_factory=dict)
+    # injected iff the pod doesn't already set the key (user/contract wins)
+    env: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    description: str = ""
+
+
+@dataclass
+class PodDefault:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodDefaultSpec = field(default_factory=PodDefaultSpec)
+    kind: str = "PodDefault"
+    api_version: str = "kubeflow-tpu.org/v1alpha1"
+
+
+def matches(pd: PodDefault, pod: Pod) -> bool:
+    if pd.metadata.namespace != pod.metadata.namespace:
+        return False
+    sel = pd.spec.selector
+    return bool(sel) and all(
+        pod.metadata.labels.get(k) == v for k, v in sel.items()
+    )
+
+
+def apply_pod_defaults(cluster: FakeCluster, pod: Pod) -> list[str]:
+    """Mutate `pod` in place with every matching PodDefault; returns the
+    names applied (recorded as a pod annotation, like the webhook does)."""
+    applied: list[str] = []
+    for pd in cluster.list("poddefaults"):
+        if not matches(pd, pod):
+            continue
+        for k, v in pd.spec.env.items():
+            pod.env.setdefault(k, v)
+        for k, v in pd.spec.annotations.items():
+            pod.metadata.annotations.setdefault(k, v)
+        applied.append(pd.metadata.name)
+    if applied:
+        pod.metadata.annotations["kubeflow-tpu.org/poddefaults"] = ",".join(
+            sorted(applied)
+        )
+    return applied

@@ -18,6 +18,7 @@ from kubeflow_tpu.pipelines.dsl import (
     TaskOutput,
     component,
     pipeline,
+    train_job,
 )
 from kubeflow_tpu.pipelines.runner import (
     LocalPipelineRunner,
@@ -43,5 +44,6 @@ __all__ = [
     "compile_to_yaml",
     "component",
     "pipeline",
+    "train_job",
     "validate_ir",
 ]

@@ -50,12 +50,21 @@ def compile_pipeline(pipeline: Pipeline) -> dict:
                     }
                 }
             components[comp_key] = comp_def
-            executors[exec_key] = {
-                "pythonFunction": {
-                    "functionName": task.component.fn.__name__,
-                    "source": task.component.source,
+            manifest = getattr(task.component, "train_job_manifest", None)
+            if manifest is not None:
+                executors[exec_key] = {"trainJob": {
+                    "manifest": manifest,
+                    "timeoutSeconds": getattr(
+                        task.component, "train_job_timeout_s", 3600.0
+                    ),
+                }}
+            else:
+                executors[exec_key] = {
+                    "pythonFunction": {
+                        "functionName": task.component.fn.__name__,
+                        "source": task.component.source,
+                    }
                 }
-            }
 
         inputs: dict[str, Any] = {}
         for pname, value in task.arguments.items():
@@ -126,8 +135,11 @@ def validate_ir(ir: dict) -> dict:
         cref = t.get("componentRef", {}).get("name")
         if cref not in comps:
             raise ValueError(f"task {tname}: unknown component {cref!r}")
-        if comps[cref].get("executorLabel") not in executors:
+        ex = executors.get(comps[cref].get("executorLabel"))
+        if ex is None:
             raise ValueError(f"task {tname}: component {cref} has no executor")
+        if not ({"pythonFunction", "trainJob"} & set(ex)):
+            raise ValueError(f"task {tname}: executor has no known runtime")
         for dep in t.get("dependentTasks", []):
             if dep not in tasks:
                 raise ValueError(f"task {tname}: unknown dependency {dep!r}")

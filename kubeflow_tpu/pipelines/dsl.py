@@ -168,6 +168,40 @@ class _PipelineContext:
         return task
 
 
+@dataclass
+class TrainJobComponent:
+    """A pipeline step that launches a TrainJob through the platform —
+    the reference's core composition (a KFP step creating a TFJob/
+    PyTorchJob CR, SURVEY.md §3.4 recursing into §3.1). The manifest may
+    carry ${param} placeholders bound via `arguments`."""
+
+    name: str
+    manifest: str
+    timeout_s: float = 3600.0
+
+    def __call__(self, **arguments) -> TaskOutput:
+        ctx = _PipelineContext.current()
+        if ctx is None:
+            raise RuntimeError("train_job steps can only be called inside a @pipeline")
+        comp = Component(
+            name=self.name,
+            fn=None,  # no python executor — the runner launches the job
+            source="",
+            inputs={k: "STRING" for k in arguments},
+            defaults={},
+            output_type="STRUCT",
+        )
+        comp.train_job_manifest = self.manifest
+        comp.train_job_timeout_s = self.timeout_s
+        task = ctx.add_task(comp, arguments)
+        return task.output
+
+
+def train_job(name: str, manifest: str, timeout_s: float = 3600.0) -> TrainJobComponent:
+    """Declare a TrainJob-launching step for use inside @pipeline."""
+    return TrainJobComponent(name=name, manifest=manifest, timeout_s=timeout_s)
+
+
 def pipeline(fn: Callable | None = None, *, name: str | None = None,
              description: str = ""):
     """Trace a pipeline function into a Pipeline DAG."""

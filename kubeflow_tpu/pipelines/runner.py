@@ -65,7 +65,11 @@ class LocalPipelineRunner:
         work_dir: str = ".kubeflow_tpu/pipelines",
         metadata_store: MetadataStore | None = None,
         cache: bool = True,
+        platform=None,
     ):
+        # platform enables trainJob steps (pipeline -> TrainJob recursion);
+        # python-function steps never need it
+        self.platform = platform
         self.work_dir = Path(work_dir)
         self.cache_dir = self.work_dir / "cache"
         self.cache_enabled = cache
@@ -182,9 +186,13 @@ class LocalPipelineRunner:
         result = run.tasks[tname]
         comp = ir["components"][spec["componentRef"]["name"]]
         executor = ir["deploymentSpec"]["executors"][comp["executorLabel"]]
+        inputs = self._resolve_inputs(run, spec)
+        if "trainJob" in executor:
+            self._run_train_job_task(run, run_dir, tname, executor, inputs,
+                                     run_exec_id)
+            return
         source = executor["pythonFunction"]["source"]
         fn_name = executor["pythonFunction"]["functionName"]
-        inputs = self._resolve_inputs(run, spec)
 
         # cache key: exact executor source + resolved inputs (KFP cache
         # fingerprint parity: component + args hash)
@@ -239,6 +247,69 @@ class LocalPipelineRunner:
         if self.cache_enabled:
             self.cache_dir.mkdir(parents=True, exist_ok=True)
             cache_file.write_text(json.dumps({"output": result.output}))
+        self._record_lineage(run, tname, inputs, result, run_exec_id)
+
+    def _run_train_job_task(self, run: PipelineRun, run_dir: Path, tname: str,
+                            executor: dict, inputs: dict,
+                            run_exec_id: int | None) -> None:
+        """Launch a TrainJob through the platform and adopt its verdict.
+        Never cached: a training run's value is its side effects
+        (checkpoints), not a JSON output."""
+        from kubeflow_tpu.api.serde import job_from_yaml
+        from kubeflow_tpu.client import TrainingClient
+
+        result = run.tasks[tname]
+        if self.platform is None:
+            result.state = TaskState.FAILED
+            result.error = (
+                "trainJob step requires LocalPipelineRunner(platform=...)"
+            )
+            self._record_lineage(run, tname, inputs, result, run_exec_id)
+            return
+        manifest = executor["trainJob"]["manifest"]
+        timeout_s = float(executor["trainJob"].get("timeoutSeconds", 3600.0))
+        for k, v in inputs.items():
+            manifest = manifest.replace("${" + k + "}", str(v))
+        job = job_from_yaml(manifest)
+        # Unique name per (run, step): seq+timestamp from run_id plus the
+        # task name, so two steps sharing a manifest name in one run — or
+        # back-to-back runs in the same second — never collide on the CR name.
+        suffix = "-".join(run.run_id.rsplit("-", 2)[-2:])
+        job.metadata.name = f"{job.metadata.name}-{tname}-{suffix}"[-63:].strip("-")
+        client = TrainingClient(self.platform)
+        t0 = time.monotonic()
+        result.state = TaskState.RUNNING
+        try:
+            client.create_job(job)
+            done = client.wait_for_job_conditions(
+                job.metadata.name, job.metadata.namespace, timeout_s=timeout_s
+            )
+        except Exception as exc:  # noqa: BLE001 — bad manifest => task fails
+            result.state = TaskState.FAILED
+            result.error = f"{type(exc).__name__}: {exc}"
+            # a timed-out (or unwaitable) job must not run on as an orphan
+            try:
+                client.delete_job(job.metadata.name, job.metadata.namespace)
+            except Exception:  # noqa: BLE001
+                pass
+            self._record_lineage(run, tname, inputs, result, run_exec_id)
+            return
+        result.duration_s = time.monotonic() - t0
+        conditions = [
+            {"type": c.type.value, "reason": c.reason}
+            for c in done.status.conditions if c.status
+        ]
+        result.output = {
+            "jobName": job.metadata.name,
+            "succeeded": done.status.is_succeeded,
+            "restartCount": done.status.restart_count,
+            "conditions": conditions,
+        }
+        result.state = (
+            TaskState.SUCCEEDED if done.status.is_succeeded else TaskState.FAILED
+        )
+        if not done.status.is_succeeded:
+            result.error = f"job {job.metadata.name} failed: {conditions}"
         self._record_lineage(run, tname, inputs, result, run_exec_id)
 
     def _record_lineage(self, run: PipelineRun, tname: str, inputs: dict,

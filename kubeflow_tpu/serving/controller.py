@@ -240,6 +240,9 @@ class InferenceServiceController(ControllerBase):
             env=env,
             scheduler_name="default",  # serving pods bypass gang scheduling
         )
+        from kubeflow_tpu.controller.poddefault import apply_pod_defaults
+
+        apply_pod_defaults(self.cluster, pod)  # admission mutation
         try:
             self.cluster.create("pods", pod)
         except KeyError:

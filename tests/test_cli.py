@@ -1,0 +1,101 @@
+"""CLI tests — manifests through `python -m kubeflow_tpu` verbs."""
+
+import json
+import sys
+import textwrap
+
+import pytest
+import yaml
+
+from kubeflow_tpu.cli import main
+
+
+def job_yaml(tmp_path, name="clijob", body="print('cli ok')", replicas=2):
+    script = tmp_path / f"{name}.py"
+    script.write_text(textwrap.dedent(body))
+    manifest = tmp_path / f"{name}.yaml"
+    manifest.write_text(yaml.safe_dump({
+        "apiVersion": "kubeflow-tpu.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name},
+        "spec": {
+            "replicaSpecs": {
+                "worker": {
+                    "replicas": replicas,
+                    "template": {"container": {
+                        "command": [sys.executable, str(script)],
+                    }},
+                }
+            }
+        },
+    }))
+    return str(manifest)
+
+
+class TestValidateAndRender:
+    def test_validate_ok(self, tmp_path, capsys):
+        rc = main(["validate", "-f", job_yaml(tmp_path)])
+        assert rc == 0
+        assert "kind: JAXJob" in capsys.readouterr().out
+
+    def test_validate_rejects_bad_name(self, tmp_path):
+        path = job_yaml(tmp_path)
+        text = open(path).read().replace("name: clijob", "name: Bad_Name")
+        open(path, "w").write(text)
+        with pytest.raises(ValueError, match="RFC-1123"):
+            main(["validate", "-f", path])
+
+    def test_render_env(self, tmp_path, capsys):
+        rc = main(["render-env", "-f", job_yaml(tmp_path), "--index", "1"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "JAX_NUM_PROCESSES=2" in out
+        assert "JAX_PROCESS_ID=1" in out
+        assert "TPU_WORKER_HOSTNAMES=" in out
+
+
+class TestRun:
+    def test_run_success_with_logs(self, tmp_path, capsys):
+        rc = main(["run", "-f", job_yaml(tmp_path), "--logs", "--timeout", "60",
+                   "--log-dir", str(tmp_path / "logs")])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("cli ok") == 2
+
+    def test_run_failure_exit_code(self, tmp_path):
+        path = job_yaml(tmp_path, name="clifail", body="raise SystemExit(1)",
+                        replicas=1)
+        # keep retries short
+        d = yaml.safe_load(open(path))
+        d["spec"]["runPolicy"] = {"backoffLimit": 0}
+        open(path, "w").write(yaml.safe_dump(d))
+        rc = main(["run", "-f", path, "--timeout", "60",
+                   "--log-dir", str(tmp_path / "logs")])
+        assert rc == 1
+
+
+class TestPipelineVerbs:
+    def test_compile_and_run(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.syspath_prepend(str(tmp_path))
+        (tmp_path / "clipipe.py").write_text(textwrap.dedent("""
+            from kubeflow_tpu.pipelines import component, pipeline
+
+            @component
+            def double(x: float) -> float:
+                return x * 2
+
+            @pipeline(name="cli-pipe")
+            def my_pipe(x: float = 4.0):
+                return double(x=x)
+        """))
+        ir_path = tmp_path / "ir.yaml"
+        rc = main(["pipeline-compile", "clipipe:my_pipe", "-o", str(ir_path)])
+        assert rc == 0
+        rc = main([
+            "pipeline-run", "-f", str(ir_path),
+            "--arg", "x=10", "--work-dir", str(tmp_path / "runs"),
+        ])
+        assert rc == 0
+        result = json.loads(capsys.readouterr().out)
+        assert result["state"] == "Succeeded"
+        assert result["output"] == 20.0

@@ -1,0 +1,181 @@
+"""Non-JAX job kinds through the full control plane.
+
+Reference parity (SURVEY.md §3.1/§3.2): each framework kind has its own
+success topology — TFJob's chief decides, PyTorchJob's master decides,
+MPIJob's launcher decides while workers idle (sshd analogue) — and
+CleanPodPolicy reaps the survivors. The env-contract synthesis is unit-
+tested byte-for-byte in test_envcontract.py; here the semantics run live.
+"""
+
+import sys
+import textwrap
+import time
+
+import pytest
+
+from kubeflow_tpu.api import (
+    CleanPodPolicy,
+    ContainerSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RunPolicy,
+    REPLICA_CHIEF,
+    REPLICA_LAUNCHER,
+    REPLICA_MASTER,
+    REPLICA_PS,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.api.jobs import JAXJobSpec, MPIJob, PyTorchJob, TFJob
+from kubeflow_tpu.client import Platform, TrainingClient
+from kubeflow_tpu.controller.fakecluster import PodPhase
+
+
+@pytest.fixture()
+def client(tmp_path):
+    with Platform(log_dir=str(tmp_path / "pod-logs")) as p:
+        yield TrainingClient(p)
+
+
+def _spec(tmp_path, name, body) -> ContainerSpec:
+    path = tmp_path / f"{name}.py"
+    path.write_text(textwrap.dedent(body))
+    return ContainerSpec(command=[sys.executable, str(path)])
+
+
+def _replicas(tmp_path, job_name, groups):
+    """groups: {rtype: (count, script_body)}"""
+    return {
+        rtype: ReplicaSpec(
+            replicas=count,
+            template=PodTemplateSpec(
+                container=_spec(tmp_path, f"{job_name}-{rtype}", body)
+            ),
+        )
+        for rtype, (count, body) in groups.items()
+    }
+
+
+class TestMPIJob:
+    def test_launcher_decides_workers_reaped(self, client, tmp_path):
+        job = MPIJob(
+            metadata=ObjectMeta(name="mpi1"),
+            spec=JAXJobSpec(
+                replica_specs=_replicas(
+                    tmp_path, "mpi1",
+                    {
+                        REPLICA_LAUNCHER: (1, """
+                            import os
+                            assert os.environ["MPI_NUM_WORKERS"] == "2"
+                            print("mpirun done")
+                        """),
+                        # workers idle like sshd; must be reaped on success
+                        REPLICA_WORKER: (2, "import time; time.sleep(300)"),
+                    },
+                ),
+                run_policy=RunPolicy(clean_pod_policy=CleanPodPolicy.RUNNING),
+            ),
+        )
+        client.create_job(job)
+        done = client.wait_for_job_conditions("mpi1", timeout_s=60)
+        assert done.status.is_succeeded
+        assert "mpirun done" in client.get_job_logs("mpi1", rtype="launcher")
+        # running workers were reaped by CleanPodPolicy.RUNNING
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            live = [
+                p for p in client.cluster.list("pods")
+                if p.metadata.labels.get("kubeflow-tpu.org/job-name") == "mpi1"
+                and p.status.phase in (PodPhase.RUNNING, PodPhase.PENDING)
+            ]
+            if not live:
+                return
+            time.sleep(0.2)
+        pytest.fail(f"workers not reaped: {[p.metadata.name for p in live]}")
+
+
+class TestTFJob:
+    def test_chief_decides_with_ps(self, client, tmp_path):
+        job = TFJob(
+            metadata=ObjectMeta(name="tf1"),
+            spec=JAXJobSpec(
+                replica_specs=_replicas(
+                    tmp_path, "tf1",
+                    {
+                        REPLICA_CHIEF: (1, """
+                            import json, os
+                            cfg = json.loads(os.environ["TF_CONFIG"])
+                            assert cfg["task"]["type"] == "chief"
+                            assert len(cfg["cluster"]["worker"]) == 2
+                            assert len(cfg["cluster"]["ps"]) == 1
+                            print("chief trained")
+                        """),
+                        REPLICA_WORKER: (2, """
+                            import json, os
+                            cfg = json.loads(os.environ["TF_CONFIG"])
+                            assert cfg["task"]["type"] == "worker"
+                            print("worker", cfg["task"]["index"], "ok")
+                        """),
+                        REPLICA_PS: (1, "import time; time.sleep(300)"),
+                    },
+                ),
+                run_policy=RunPolicy(clean_pod_policy=CleanPodPolicy.RUNNING),
+            ),
+        )
+        client.create_job(job)
+        done = client.wait_for_job_conditions("tf1", timeout_s=60)
+        assert done.status.is_succeeded
+        assert "chief trained" in client.get_job_logs("tf1", rtype="chief")
+
+
+class TestPyTorchJob:
+    def test_master_decides(self, client, tmp_path):
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="pt1"),
+            spec=JAXJobSpec(
+                replica_specs=_replicas(
+                    tmp_path, "pt1",
+                    {
+                        REPLICA_MASTER: (1, """
+                            import os
+                            assert os.environ["RANK"] == "0"
+                            assert os.environ["WORLD_SIZE"] == "3"
+                            assert os.environ["MASTER_ADDR"].startswith("127.")
+                            print("master done")
+                        """),
+                        REPLICA_WORKER: (2, """
+                            import os
+                            assert os.environ["RANK"] in ("1", "2")
+                            print("worker done")
+                        """),
+                    },
+                ),
+            ),
+        )
+        client.create_job(job)
+        done = client.wait_for_job_conditions("pt1", timeout_s=60)
+        assert done.status.is_succeeded
+        assert done.status.replica_statuses[REPLICA_MASTER].succeeded == 1
+
+    def test_master_failure_fails_job(self, client, tmp_path):
+        from kubeflow_tpu.api import RestartPolicy
+
+        specs = _replicas(
+            tmp_path, "pt2",
+            {
+                REPLICA_MASTER: (1, "raise SystemExit(1)"),
+                REPLICA_WORKER: (1, "import time; time.sleep(300)"),
+            },
+        )
+        for rs in specs.values():
+            rs.restart_policy = RestartPolicy.NEVER
+        job = PyTorchJob(
+            metadata=ObjectMeta(name="pt2"),
+            spec=JAXJobSpec(
+                replica_specs=specs,
+            ),
+        )
+        client.create_job(job)
+        done = client.wait_for_job_conditions("pt2", timeout_s=60)
+        assert done.status.is_failed
