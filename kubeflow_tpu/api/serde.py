@@ -82,6 +82,18 @@ def _from_dict(cls: type, data: Any) -> Any:
     return data
 
 
+# Manifest `kind:` string -> API/store bucket. Shared routing table for the
+# apiserver (POST dispatch) and remote clients (apply), so they cannot drift.
+MANIFEST_KINDS = {
+    "JAXJob": "jobs", "TFJob": "jobs", "PyTorchJob": "jobs", "MPIJob": "jobs",
+    "XGBoostJob": "jobs", "PaddleJob": "jobs",
+    "Experiment": "experiments",
+    "InferenceService": "inferenceservices",
+    "PodDefault": "poddefaults",
+    "Profile": "profiles",
+}
+
+
 def job_to_dict(job: TrainJob) -> dict:
     d = to_dict(job)
     d.pop("kind", None)

@@ -1,0 +1,264 @@
+"""Platform API server — the kube-apiserver analogue over HTTP.
+
+Reference parity: the reference's entire L1 surface is a network API
+(kube-apiserver CRUD on CRs — SURVEY.md §1; plus the KFP apiserver,
+§2.6). This serves the in-process control plane's object store over REST
+so that CLIs and SDKs in OTHER processes can drive the platform the way
+kubectl/k8s clients drive the reference:
+
+  GET    /healthz | /metrics | /readyz
+  GET    /api/v1/{kind}                     list (all namespaces)
+  GET    /api/v1/{kind}/{ns}/{name}         get
+  POST   /api/v1/{kind}                     create (manifest body)
+  DELETE /api/v1/{kind}/{ns}/{name}         delete (cascade for jobs/isvc)
+  GET    /api/v1/jobs/{ns}/{name}/logs?replicaType=worker&index=0
+  POST   /api/v1/jobs/{ns}/{name}/scale     {"replicas": N}
+  GET    /api/v1/events/{ns}/{name}         events for an object
+
+Optimistic-concurrency conflicts surface as 409; admission failures as 422.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeflow_tpu.api.serde import (
+    MANIFEST_KINDS,
+    job_from_dict,
+    job_to_dict,
+    to_dict,
+)
+from kubeflow_tpu.api.validation import ValidationError, validate_job
+from kubeflow_tpu.controller.fakecluster import ConflictError
+
+
+def _serialize(kind: str, obj) -> dict:
+    if kind == "jobs":
+        d = job_to_dict(obj)
+        # status matters over the wire even when the spec-serializer would
+        # drop a pristine one
+        d["status"] = to_dict(obj.status)
+        return d
+    if kind == "experiments":
+        from kubeflow_tpu.sweep.serde import experiment_to_dict
+
+        d = experiment_to_dict(obj)
+        d["status"] = to_dict(obj.status)
+        return d
+    if kind == "inferenceservices":
+        from kubeflow_tpu.serving.serde import isvc_to_dict
+
+        d = isvc_to_dict(obj)
+        d["status"] = to_dict(obj.status)
+        return d
+    return to_dict(obj)
+
+
+def _deserialize(manifest: dict):
+    kind = manifest.get("kind", "")
+    bucket = MANIFEST_KINDS.get(kind)
+    if bucket is None:
+        raise ValidationError("kind", f"unknown kind {kind!r}")
+    if bucket == "jobs":
+        job = job_from_dict(manifest)
+        validate_job(job)
+        return bucket, job
+    if bucket == "profiles":
+        from kubeflow_tpu.api.serde import _from_dict
+        from kubeflow_tpu.controller.profile import Profile
+
+        body = {k: v for k, v in manifest.items() if k not in ("kind", "apiVersion")}
+        return bucket, _from_dict(Profile, body)
+    if bucket == "experiments":
+        from kubeflow_tpu.sweep.api import validate_experiment
+        from kubeflow_tpu.sweep.serde import experiment_from_dict
+
+        exp = experiment_from_dict(manifest)
+        validate_experiment(exp)
+        return bucket, exp
+    if bucket == "inferenceservices":
+        from kubeflow_tpu.serving.api import validate_isvc
+        from kubeflow_tpu.serving.serde import isvc_from_dict
+
+        isvc = isvc_from_dict(manifest)
+        validate_isvc(isvc)
+        return bucket, isvc
+    # PodDefault
+    from kubeflow_tpu.api.serde import _from_dict
+    from kubeflow_tpu.controller.poddefault import PodDefault
+
+    body = {k: v for k, v in manifest.items() if k not in ("kind", "apiVersion")}
+    return bucket, _from_dict(PodDefault, body)
+
+
+class PlatformServer:
+    """Serves a Platform over REST."""
+
+    def __init__(self, platform, port: int = 8080, host: str = "127.0.0.1"):
+        self.platform = platform
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # ------------------------------------------------------------- routing
+
+    def handle(self, method: str, path: str, body: dict | None) -> tuple[int, object]:
+        cluster = self.platform.cluster
+        parsed = urllib.parse.urlparse(path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        parts = [p for p in parsed.path.split("/") if p]
+
+        if parsed.path == "/healthz" or parsed.path == "/readyz":
+            return 200, {"ok": True}
+        if parsed.path == "/metrics":
+            from kubeflow_tpu.observability import render_metrics
+
+            return 200, render_metrics(self.platform)  # raw text
+        if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
+            return 404, {"error": f"no route {parsed.path!r}"}
+        kind = parts[2]
+
+        # -------- events
+        if kind == "events" and len(parts) == 5:
+            evs = cluster.events_for(f"{parts[3]}/{parts[4]}")
+            return 200, [
+                {"reason": e.reason, "message": e.message, "type": e.type,
+                 "timestamp": e.timestamp}
+                for e in evs
+            ]
+
+        if kind not in cluster.KINDS:
+            return 404, {"error": f"unknown kind {kind!r}"}
+
+        # -------- subresources on jobs
+        if kind == "jobs" and len(parts) == 6 and parts[5] == "logs" and method == "GET":
+            if cluster.get("jobs", f"{parts[3]}/{parts[4]}") is None:
+                return 404, {"error": f"job {parts[3]}/{parts[4]} not found"}
+            pod_name = f"{parts[4]}-{query.get('replicaType', 'worker')}-{query.get('index', '0')}"
+            return 200, self.platform._read_pod_log(pod_name)  # raw text
+        if kind == "jobs" and len(parts) == 6 and parts[5] == "scale" and method == "POST":
+            from kubeflow_tpu.client import TrainingClient
+
+            try:
+                job = TrainingClient(self.platform).scale_job(
+                    parts[4], int((body or {}).get("replicas", 0)), parts[3]
+                )
+            except KeyError:
+                return 404, {"error": f"job {parts[3]}/{parts[4]} not found"}
+            except ValueError as exc:
+                return 422, {"error": str(exc)}
+            return 200, _serialize("jobs", job)
+
+        # -------- CRUD
+        if method == "GET" and len(parts) == 3:
+            return 200, [_serialize(kind, o) for o in cluster.list(kind)]
+        if method == "GET" and len(parts) == 5:
+            obj = cluster.get(kind, f"{parts[3]}/{parts[4]}")
+            if obj is None:
+                return 404, {"error": f"{kind} {parts[3]}/{parts[4]} not found"}
+            return 200, _serialize(kind, obj)
+        if method == "POST" and len(parts) == 3:
+            if body is None:
+                return 400, {"error": "manifest body required"}
+            try:
+                bucket, obj = _deserialize(body)
+            except (ValidationError, ValueError) as exc:
+                return 422, {"error": str(exc)}
+            if bucket != kind:
+                return 422, {"error": f"manifest kind belongs to {bucket!r}, not {kind!r}"}
+            if kind == "jobs":
+                from kubeflow_tpu.controller.profile import check_job_admission
+
+                try:
+                    check_job_admission(cluster, obj)
+                except ValueError as exc:
+                    return 422, {"error": str(exc)}
+            try:
+                cluster.create(kind, obj)
+            except KeyError as exc:
+                return 409, {"error": str(exc)}
+            return 201, _serialize(kind, obj)
+        if method == "DELETE" and len(parts) == 5:
+            key = f"{parts[3]}/{parts[4]}"
+            if cluster.get(kind, key) is None:
+                return 404, {"error": f"{kind} {key} not found"}
+            if kind == "jobs":
+                from kubeflow_tpu.controller.jobcontroller import delete_job_cascade
+
+                delete_job_cascade(cluster, parts[4], parts[3])
+            elif kind == "inferenceservices":
+                from kubeflow_tpu.serving import ServingClient
+
+                ServingClient(self.platform).delete(parts[4], parts[3])
+            elif kind == "experiments":
+                from kubeflow_tpu.sweep import SweepClient
+
+                SweepClient(self.platform).delete_experiment(parts[4], parts[3])
+            else:
+                cluster.delete(kind, key)
+            return 200, {"deleted": key}
+        return 405, {"error": f"{method} not supported on {parsed.path!r}"}
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "PlatformServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _dispatch(self, method):
+                body = None
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length))
+                    except json.JSONDecodeError as exc:
+                        self._reply(400, {"error": f"bad json: {exc}"})
+                        return
+                try:
+                    code, payload = server.handle(method, self.path, body)
+                except ConflictError as exc:
+                    code, payload = 409, {"error": str(exc)}
+                except Exception as exc:  # noqa: BLE001 — surface as 500
+                    code, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}
+                self._reply(code, payload)
+
+            def _reply(self, code, payload):
+                if isinstance(payload, str):
+                    data, ctype = payload.encode(), "text/plain"
+                else:
+                    data, ctype = json.dumps(payload).encode(), "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+            def do_DELETE(self):  # noqa: N802
+                self._dispatch("DELETE")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -191,6 +191,77 @@ def cmd_pipeline_run(args) -> int:
     return 0 if run.succeeded else 1
 
 
+def cmd_platform(args) -> int:
+    """Run the control plane as a daemon serving the REST API."""
+    from kubeflow_tpu.apiserver import PlatformServer
+    from kubeflow_tpu.client import Platform
+
+    with Platform(capacity_chips=args.capacity_chips, log_dir=args.log_dir) as platform:
+        server = PlatformServer(platform, port=args.port, host=args.host).start()
+        print(f"platform API serving at {server.url}", flush=True)
+        try:
+            import threading
+
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            server.stop()
+    return 0
+
+
+def _remote(args):
+    from kubeflow_tpu.remote import RemoteClient
+
+    return RemoteClient(args.server)
+
+
+def cmd_apply(args) -> int:
+    out = _remote(args).apply(_read(args.filename))
+    meta = out.get("metadata", {})
+    print(f"{out.get('kind')} {meta.get('namespace')}/{meta.get('name')} created")
+    return 0
+
+
+def cmd_get(args) -> int:
+    client = _remote(args)
+    if args.name:
+        print(json.dumps(client.get(args.kind, args.name, args.namespace), indent=2))
+        return 0
+    objs = client.list(args.kind)
+    if not args.all_namespaces:
+        objs = [
+            o for o in objs
+            if o.get("metadata", {}).get("namespace", "default") == args.namespace
+        ]
+    for o in objs:
+        meta = o.get("metadata", {})
+        status = o.get("status", {})
+        conds = [c["type"] for c in status.get("conditions", []) if c.get("status", True)]
+        state = conds[-1] if conds else status.get("condition", "")
+        print(f"{meta.get('namespace', '?')}/{meta.get('name', '?')}\t{state}")
+    if not objs:
+        print(f"no {args.kind} found", file=sys.stderr)
+    return 0
+
+
+def cmd_logs(args) -> int:
+    print(_remote(args).job_logs(args.name, args.namespace, args.rtype, args.index),
+          end="")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    out = _remote(args).delete(args.kind, args.name, args.namespace)
+    print(f"deleted {out.get('deleted')}")
+    return 0
+
+
+def cmd_scale(args) -> int:
+    out = _remote(args).scale_job(args.name, args.replicas, args.namespace)
+    workers = out.get("spec", {}).get("replicaSpecs", {}).get("worker", {})
+    print(f"scaled {args.namespace}/{args.name} to {workers.get('replicas')} workers")
+    return 0
+
+
 # ---------------------------------------------------------------------- main
 
 def main(argv: list[str] | None = None) -> int:
@@ -242,6 +313,43 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--work-dir", default=".kubeflow_tpu/pipelines")
     p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
     p.add_argument("--no-cache", action="store_true")
+
+    p = add("platform", cmd_platform,
+            help="run the control plane as a daemon with the REST API")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--capacity-chips", type=int, default=8)
+    p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+
+    def server_arg(p):
+        p.add_argument("--server", default="http://127.0.0.1:8080",
+                       help="platform API server URL")
+        return p
+
+    p = server_arg(add("apply", cmd_apply, help="create from a manifest (remote)"))
+    p.add_argument("-f", "--filename", required=True)
+
+    p = server_arg(add("get", cmd_get, help="list/get objects (remote)"))
+    p.add_argument("kind")
+    p.add_argument("name", nargs="?", default="")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("-A", "--all-namespaces", action="store_true")
+
+    p = server_arg(add("logs", cmd_logs, help="print a job replica's log (remote)"))
+    p.add_argument("name")
+    p.add_argument("--rtype", default="worker")
+    p.add_argument("--index", type=int, default=0)
+    p.add_argument("-n", "--namespace", default="default")
+
+    p = server_arg(add("delete", cmd_delete, help="delete an object (remote)"))
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.add_argument("-n", "--namespace", default="default")
+
+    p = server_arg(add("scale", cmd_scale, help="elastically scale a job (remote)"))
+    p.add_argument("name")
+    p.add_argument("replicas", type=int)
+    p.add_argument("-n", "--namespace", default="default")
 
     args = ap.parse_args(argv)
     return args.fn(args)

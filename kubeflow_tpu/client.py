@@ -18,6 +18,7 @@ from kubeflow_tpu.api.validation import validate_job
 from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
 from kubeflow_tpu.controller.gang import GangScheduler
 from kubeflow_tpu.controller.jobcontroller import JobController, delete_job_cascade
+from kubeflow_tpu.controller.profile import check_job_admission
 from kubeflow_tpu.controller.podruntime import PodRuntime
 
 
@@ -31,6 +32,7 @@ class Platform:
         capacity_chips: int = 8,
         controller_workers: int = 2,
     ):
+        from kubeflow_tpu.controller.profile import ProfileController
         from kubeflow_tpu.serving.controller import InferenceServiceController
         from kubeflow_tpu.sweep.controller import ExperimentController
 
@@ -46,6 +48,7 @@ class Platform:
             self.cluster,
             model_cache_dir=str(Path(log_dir).parent / "model-cache"),
         )
+        self.profile_controller = ProfileController(self.cluster)
         self.metrics_server = None  # started on demand
         self._started = False
 
@@ -71,6 +74,7 @@ class Platform:
             self.controller.start()
             self.experiment_controller.start()
             self.isvc_controller.start()
+            self.profile_controller.start()
             self._started = True
         return self
 
@@ -78,6 +82,7 @@ class Platform:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
+        self.profile_controller.stop()
         self.isvc_controller.stop()
         self.experiment_controller.stop()
         self.controller.stop()
@@ -103,6 +108,7 @@ class TrainingClient:
 
     def create_job(self, job: TrainJob) -> TrainJob:
         validate_job(job)
+        check_job_admission(self.cluster, job)  # namespace quota (Profile)
         return self.cluster.create("jobs", job)
 
     def get_job(self, name: str, namespace: str = "default") -> TrainJob | None:

@@ -105,7 +105,7 @@ class FakeCluster:
 
     KINDS = (
         "jobs", "pods", "podgroups", "experiments", "trials",
-        "inferenceservices", "poddefaults",
+        "inferenceservices", "poddefaults", "profiles", "namespaces",
     )
 
     def __init__(self) -> None:

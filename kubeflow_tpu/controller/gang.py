@@ -12,6 +12,7 @@ import math
 import threading
 
 from kubeflow_tpu.controller.fakecluster import (
+    ConflictError,
     EventType,
     FakeCluster,
     Pod,
@@ -30,6 +31,7 @@ def topology_chips(topology: str) -> int:
 class GangScheduler:
     def __init__(self, cluster: FakeCluster):
         self.cluster = cluster
+        self.errors = 0  # surfaced so silent failures are still countable
         self._stop = threading.Event()
         self._mu = threading.Lock()
         self._bound_chips: dict[str, int] = {}  # group key -> chips held
@@ -50,13 +52,25 @@ class GangScheduler:
                 etype, kind, obj = q.get(timeout=0.5)
             except Exception:
                 # periodic retry: a gang may fit now that capacity freed up
-                self._try_schedule()
+                self._try_schedule_safe()
                 continue
             if kind == "podgroups" and etype == EventType.DELETED:
                 with self._mu:
                     self._bound_chips.pop(obj.key, None)
             if kind in ("pods", "podgroups"):
-                self._try_schedule()
+                self._try_schedule_safe()
+
+    def _try_schedule_safe(self) -> None:
+        try:
+            self._try_schedule()
+        except ConflictError:
+            pass  # an object was replaced mid-pass; next event retries
+        except Exception as exc:  # noqa: BLE001 — the scheduler must not die
+            self.errors += 1
+            self.cluster.record_event(
+                "podgroups", "-/gang-scheduler", "SchedulerError",
+                f"{type(exc).__name__}: {exc}", type="Warning",
+            )
 
     def _try_schedule(self) -> None:
         with self._mu:
@@ -83,12 +97,14 @@ class GangScheduler:
                                 type="Warning",
                             )
                             continue
-                        for i, p in enumerate(late):
-                            p.status.node = f"slice-0-host-late-{i}"
-                            self.cluster.update("pods", p)
+                        if extra and self._ns_quota_blocked(pg, extra):
+                            continue
+                        # reserve before binding: a failed pod update must
+                        # never leave bound pods holding uncounted chips
                         self._bound_chips[pg.key] = (
                             self._bound_chips.get(pg.key, 0) + extra
                         )
+                        self._bind(late, prefix="slice-0-host-late")
                     continue
                 members = self._members(pg)
                 pending = [
@@ -107,17 +123,58 @@ class GangScheduler:
                         type="Warning",
                     )
                     continue
-                # all-or-nothing bind
-                for i, p in enumerate(pending):
-                    p.status.node = f"slice-0-host-{i}"
-                    self.cluster.update("pods", p)
+                # per-namespace chip quota (Profile, SURVEY.md §2.7)
+                if self._ns_quota_blocked(pg, chips_needed):
+                    continue
+                # All-or-nothing ADMISSION: reserve chips + flip the group to
+                # Running first; then bind members. If a member bind fails
+                # mid-loop (pod replaced concurrently), the reservation is
+                # already counted and the survivors are picked up by the
+                # late-member path above — never an uncounted half-gang.
                 self._bound_chips[pg.key] = chips_needed
                 pg.phase = "Running"
-                self.cluster.update("podgroups", pg)
+                try:
+                    self.cluster.update("podgroups", pg)
+                except (ConflictError, KeyError):
+                    # group replaced/deleted under us: release and move on
+                    self._bound_chips.pop(pg.key, None)
+                    continue
+                self._bind(pending, prefix="slice-0-host")
                 self.cluster.record_event(
                     "podgroups", pg.key, "Scheduled",
                     f"gang of {len(pending)} bound ({chips_needed} chips)",
                 )
+
+    def _bind(self, pods: list[Pod], prefix: str) -> None:
+        """Bind each pod, tolerating concurrent replacement of individuals
+        (the group's reservation is already held by the caller)."""
+        for i, p in enumerate(pods):
+            p.status.node = f"{prefix}-{i}"
+            try:
+                self.cluster.update("pods", p)
+            except (ConflictError, KeyError):
+                continue  # this member was replaced; late path rebinds it
+
+    def _ns_quota_blocked(self, pg: PodGroup, chips_needed: int) -> bool:
+        from kubeflow_tpu.controller.profile import namespace_quota
+
+        ns = pg.metadata.namespace
+        quota = namespace_quota(self.cluster, ns)
+        if quota is None or quota.chips is None:
+            return False
+        ns_used = sum(
+            c for k, c in self._bound_chips.items()
+            if k.split("/", 1)[0] == ns
+        )
+        if ns_used + chips_needed > quota.chips:
+            self.cluster.record_event(
+                "podgroups", pg.key, "QuotaExceeded",
+                f"namespace {ns} quota {quota.chips} chips, "
+                f"{quota.chips - ns_used} free",
+                type="Warning",
+            )
+            return True
+        return False
 
     def _members(self, pg: PodGroup) -> list[Pod]:
         return self.cluster.list(

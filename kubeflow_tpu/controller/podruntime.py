@@ -15,6 +15,7 @@ import time
 from pathlib import Path
 
 from kubeflow_tpu.controller.fakecluster import (
+    ConflictError,
     EventType,
     FakeCluster,
     Pod,
@@ -36,7 +37,8 @@ class PodRuntime:
         self.log_dir = Path(log_dir)
         self.inherit_env = inherit_env
         self.bind_pending_default = bind_pending_default
-        self._procs: dict[str, subprocess.Popen] = {}
+        self.errors = 0  # surfaced so silent failures are still countable
+        self._procs: dict[str, tuple[str, subprocess.Popen]] = {}
         self._mu = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -74,18 +76,36 @@ class PodRuntime:
                 continue
             if kind != "pods":
                 continue
-            pod: Pod = obj
-            if etype == EventType.DELETED:
-                self._kill(pod.key)
-                continue
-            if pod.status.phase == PodPhase.PENDING:
-                if not pod.status.node and (
-                    pod.scheduler_name == "default" and self.bind_pending_default
-                ):
-                    pod.status.node = "local-node"
-                    self.cluster.update("pods", pod)
-                elif pod.status.node:
-                    self._launch(pod)
+            try:
+                self._handle_pod_event(etype, obj)
+            except ConflictError:
+                continue  # stale event for a replaced incarnation — drop it
+            except Exception as exc:  # noqa: BLE001 — the kubelet must not die
+                self.errors += 1
+                self.cluster.record_event(
+                    "pods", obj.key, "PodRuntimeError",
+                    f"{type(exc).__name__}: {exc}", type="Warning",
+                )
+
+    def _handle_pod_event(self, etype: EventType, pod: Pod) -> None:
+        if etype == EventType.DELETED:
+            self._kill(pod.key)
+            return
+        # Events deliver the object as of notify time; after a delete+
+        # recreate (gang re-mesh) under the same name, the store holds a NEW
+        # incarnation — act only on the current one.
+        current = self.cluster.get("pods", pod.key)
+        if current is None or current.metadata.uid != pod.metadata.uid:
+            return
+        pod = current
+        if pod.status.phase == PodPhase.PENDING:
+            if not pod.status.node and (
+                pod.scheduler_name == "default" and self.bind_pending_default
+            ):
+                pod.status.node = "local-node"
+                self.cluster.update("pods", pod)
+            elif pod.status.node:
+                self._launch(pod)
 
     # ---------------------------------------------------------------- execution
 
@@ -142,7 +162,10 @@ class PodRuntime:
         pod.status.exit_code = code
         pod.status.finish_time = time.time()
         pod.status.phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
-        self.cluster.update("pods", pod)
+        try:
+            self.cluster.update("pods", pod)
+        except (ConflictError, KeyError):
+            pass  # pod replaced/deleted while exiting; verdict is moot
 
     def _kill(self, key: str) -> None:
         with self._mu:

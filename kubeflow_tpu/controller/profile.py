@@ -1,0 +1,114 @@
+"""Profile — multi-tenancy namespaces + quotas.
+
+Reference parity (unverified cites, SURVEY.md §2.7): kubeflow/kubeflow
+components/profile-controller (+kfam): a `Profile` CR materializes a
+namespace with RBAC and resource quotas. The UX layers (Istio policies,
+dashboards) are out of scope (SURVEY.md §7); what this keeps is the
+platform-semantic core: profile -> namespace lifecycle, per-namespace chip
+quota enforced by the gang scheduler, and a max-jobs admission quota.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.controller.base import ControllerBase
+from kubeflow_tpu.controller.fakecluster import FakeCluster
+
+
+@dataclass
+class ProfileQuota:
+    # cap on simultaneously-bound chips for gangs in this namespace
+    chips: int | None = None
+    # cap on unfinished jobs admitted in this namespace
+    max_jobs: int | None = None
+
+
+@dataclass
+class ProfileSpec:
+    owner: str = ""
+    quota: ProfileQuota = field(default_factory=ProfileQuota)
+
+
+@dataclass
+class Profile:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ProfileSpec = field(default_factory=ProfileSpec)
+    kind: str = "Profile"
+    api_version: str = "kubeflow-tpu.org/v1"
+
+
+@dataclass
+class Namespace:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    owner_profile: str = ""
+    kind: str = "Namespace"
+
+
+def namespace_quota(cluster: FakeCluster, namespace: str) -> ProfileQuota | None:
+    """The quota governing a namespace (profile name == namespace name),
+    or None when the namespace is unmanaged (unlimited)."""
+    prof: Profile | None = cluster.get("profiles", f"default/{namespace}")
+    return prof.spec.quota if prof is not None else None
+
+
+def check_job_admission(cluster: FakeCluster, job) -> None:
+    """max-jobs quota at admission (ResourceQuota object-count analogue).
+    Raises ValueError when the namespace is at its cap."""
+    quota = namespace_quota(cluster, job.metadata.namespace)
+    if quota is None or quota.max_jobs is None:
+        return
+    active = [
+        j for j in cluster.list("jobs")
+        if j.metadata.namespace == job.metadata.namespace
+        and not j.status.is_finished
+    ]
+    if len(active) >= quota.max_jobs:
+        raise ValueError(
+            f"namespace {job.metadata.namespace!r} is at its quota of "
+            f"{quota.max_jobs} active job(s)"
+        )
+
+
+class ProfileController(ControllerBase):
+    """Profile -> Namespace lifecycle."""
+
+    ERROR_EVENT_KIND = "profiles"
+
+    def __init__(self, cluster: FakeCluster, workers: int = 1,
+                 resync_period_s: float = 5.0):
+        super().__init__(
+            cluster, name="profile", workers=workers,
+            resync_period_s=resync_period_s,
+        )
+
+    def kind_filter(self, etype, kind: str, obj) -> str | None:
+        if kind == "profiles":
+            return self.cluster._key(obj)
+        return None
+
+    def resync_keys(self):
+        return [self.cluster._key(p) for p in self.cluster.list("profiles")]
+
+    def reconcile(self, key: str) -> float | None:
+        prof: Profile | None = self.cluster.get("profiles", key)
+        name = key.split("/", 1)[1]
+        ns_key = f"-/{name}"
+        if prof is None:
+            # profile gone -> release the namespace object (running jobs are
+            # not killed; their cleanup stays with their own controllers)
+            self.cluster.delete("namespaces", ns_key)
+            return None
+        if self.cluster.get("namespaces", ns_key) is None:
+            self.cluster.create(
+                "namespaces",
+                Namespace(
+                    metadata=ObjectMeta(name=name, namespace="-"),
+                    owner_profile=prof.metadata.name,
+                ),
+            )
+            self.cluster.record_event(
+                "profiles", key, "NamespaceCreated", f"namespace {name} ready"
+            )
+        return None

@@ -31,8 +31,29 @@ def compile_pipeline(pipeline: Pipeline) -> dict:
     tasks: dict[str, Any] = {}
 
     for task in pipeline.tasks.values():
+        manifest = getattr(task.component, "train_job_manifest", None)
+        if manifest is not None:
+            exec_def: dict[str, Any] = {"trainJob": {
+                "manifest": manifest,
+                "timeoutSeconds": getattr(
+                    task.component, "train_job_timeout_s", 3600.0
+                ),
+            }}
+        else:
+            exec_def = {
+                "pythonFunction": {
+                    "functionName": task.component.fn.__name__,
+                    "source": task.component.source,
+                }
+            }
         comp_key = f"comp-{task.component.name}"
         exec_key = f"exec-{task.component.name}"
+        if comp_key in components and executors.get(exec_key) != exec_def:
+            # same component NAME, different body (e.g. two train_job steps
+            # named alike with different manifests): fall back to the unique
+            # task name so neither silently runs the other's executor
+            comp_key = f"comp-{task.name}"
+            exec_key = f"exec-{task.name}"
         if comp_key not in components:
             comp_def: dict[str, Any] = {
                 "executorLabel": exec_key,
@@ -50,21 +71,7 @@ def compile_pipeline(pipeline: Pipeline) -> dict:
                     }
                 }
             components[comp_key] = comp_def
-            manifest = getattr(task.component, "train_job_manifest", None)
-            if manifest is not None:
-                executors[exec_key] = {"trainJob": {
-                    "manifest": manifest,
-                    "timeoutSeconds": getattr(
-                        task.component, "train_job_timeout_s", 3600.0
-                    ),
-                }}
-            else:
-                executors[exec_key] = {
-                    "pythonFunction": {
-                        "functionName": task.component.fn.__name__,
-                        "source": task.component.source,
-                    }
-                }
+            executors[exec_key] = exec_def
 
         inputs: dict[str, Any] = {}
         for pname, value in task.arguments.items():

@@ -14,6 +14,7 @@ from __future__ import annotations
 import enum
 import hashlib
 import json
+import re
 import subprocess
 import sys
 import textwrap
@@ -270,6 +271,17 @@ class LocalPipelineRunner:
         timeout_s = float(executor["trainJob"].get("timeoutSeconds", 3600.0))
         for k, v in inputs.items():
             manifest = manifest.replace("${" + k + "}", str(v))
+        if "${" in manifest:
+            # a forgotten argument must fail fast, not train with a literal
+            # '${lr}' string
+            leftover = sorted(set(re.findall(r"\$\{([\w.-]+)\}", manifest)))
+            result.state = TaskState.FAILED
+            result.error = (
+                f"unresolved manifest placeholder(s) {leftover}; pass them as "
+                f"arguments to the train_job step"
+            )
+            self._record_lineage(run, tname, inputs, result, run_exec_id)
+            return
         job = job_from_yaml(manifest)
         # Unique name per (run, step): seq+timestamp from run_id plus the
         # task name, so two steps sharing a manifest name in one run — or

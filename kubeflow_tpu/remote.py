@@ -1,0 +1,111 @@
+"""RemoteClient — SDK over the platform REST API.
+
+Reference parity: the training-operator/katib/kserve SDKs are all k8s API
+clients over HTTPS (SURVEY.md §2.1 'Python SDK'); this is the same shape
+against the PlatformServer, so a process that did NOT start the platform
+can apply manifests, watch verdicts, read logs, and scale jobs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import yaml
+
+
+class ApiError(RuntimeError):
+    def __init__(self, code: int, message: str):
+        self.code = code
+        super().__init__(f"HTTP {code}: {message}")
+
+
+class RemoteClient:
+    def __init__(self, server: str, timeout_s: float = 10.0):
+        self.server = server.rstrip("/")
+        self.timeout_s = timeout_s
+
+    # -------------------------------------------------------------- plumbing
+
+    def _request(self, method: str, path: str, body: dict | None = None):
+        req = urllib.request.Request(
+            f"{self.server}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                raw = r.read()
+                ctype = r.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")
+            try:
+                detail = json.loads(detail).get("error", detail)
+            except json.JSONDecodeError:
+                pass
+            raise ApiError(exc.code, detail) from exc
+        if ctype.startswith("application/json"):
+            return json.loads(raw)
+        return raw.decode()
+
+    # ------------------------------------------------------------------ CRUD
+
+    def apply(self, manifest: str | dict) -> dict:
+        """kubectl-apply analogue: create from a YAML manifest (text) or dict.
+        The kind in the manifest picks the API group."""
+        data = yaml.safe_load(manifest) if isinstance(manifest, str) else manifest
+        from kubeflow_tpu.api.serde import MANIFEST_KINDS
+
+        bucket = MANIFEST_KINDS.get(data.get("kind", ""))
+        if bucket is None:
+            raise ValueError(f"unknown kind {data.get('kind')!r}")
+        return self._request("POST", f"/api/v1/{bucket}", data)
+
+    def list(self, kind: str) -> list[dict]:
+        return self._request("GET", f"/api/v1/{kind}")
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> dict:
+        return self._request("GET", f"/api/v1/{kind}/{namespace}/{name}")
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> dict:
+        return self._request("DELETE", f"/api/v1/{kind}/{namespace}/{name}")
+
+    def events(self, name: str, namespace: str = "default") -> list[dict]:
+        return self._request("GET", f"/api/v1/events/{namespace}/{name}")
+
+    # ------------------------------------------------------------------ jobs
+
+    def job_logs(self, name: str, namespace: str = "default",
+                 replica_type: str = "worker", index: int = 0) -> str:
+        q = urllib.parse.urlencode({"replicaType": replica_type, "index": index})
+        return self._request("GET", f"/api/v1/jobs/{namespace}/{name}/logs?{q}")
+
+    def scale_job(self, name: str, replicas: int, namespace: str = "default") -> dict:
+        return self._request(
+            "POST", f"/api/v1/jobs/{namespace}/{name}/scale", {"replicas": replicas}
+        )
+
+    def wait_for_job(self, name: str, namespace: str = "default",
+                     timeout_s: float = 600.0, poll_s: float = 0.5) -> dict:
+        """Poll until the job reaches a terminal condition."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            job = self.get("jobs", name, namespace)
+            conds = {
+                c["type"] for c in job.get("status", {}).get("conditions", [])
+                if c.get("status", True)
+            }
+            if conds & {"Succeeded", "Failed"}:
+                return job
+            time.sleep(poll_s)
+        raise TimeoutError(f"job {namespace}/{name} not finished in {timeout_s}s")
+
+    def healthz(self) -> bool:
+        try:
+            return bool(self._request("GET", "/healthz").get("ok"))
+        except (ApiError, OSError):
+            return False

@@ -422,11 +422,23 @@ class ExperimentController(ControllerBase):
         return created
 
     def _create_trial_job(self, exp: Experiment, trial: Trial) -> None:
+        from kubeflow_tpu.controller.profile import check_job_admission
+
         job = job_from_yaml(trial.spec.rendered_spec)
         job.metadata.name = trial.metadata.name
         job.metadata.namespace = trial.metadata.namespace
         job.metadata.labels[EXPERIMENT_LABEL] = exp.metadata.name
         validate_job(job)
+        try:
+            check_job_admission(self.cluster, job)
+        except ValueError as exc:
+            # namespace at its job quota: leave the trial pending; the next
+            # sync retries once capacity frees up (quota = backpressure)
+            self.cluster.record_event(
+                "trials", f"{trial.metadata.namespace}/{trial.metadata.name}",
+                "QuotaExceeded", str(exc), type="Warning",
+            )
+            return
         try:
             self.cluster.create("jobs", job)
         except KeyError:

@@ -224,6 +224,22 @@ class TestTrainJobStep:
             assert run.output.startswith("job=pipetrain-")
             assert run.output.endswith("ok=True")
 
+    def test_same_name_different_manifests_not_merged(self):
+        from kubeflow_tpu.pipelines import train_job
+
+        @pipeline(name="twins")
+        def twins():
+            a = train_job("step", "kind: JAXJob\nmetadata: {name: a}")()
+            train_job("step", "kind: JAXJob\nmetadata: {name: b}")().producer
+
+        ir = compile_pipeline(twins())
+        validate_ir(ir)
+        manifests = {
+            ex["trainJob"]["manifest"]
+            for ex in ir["deploymentSpec"]["executors"].values()
+        }
+        assert len(manifests) == 2  # neither step silently runs the other's
+
     def test_train_job_without_platform_fails_cleanly(self, tmp_path):
         from kubeflow_tpu.pipelines import train_job
 

@@ -1,0 +1,127 @@
+"""Profile / namespace-quota tests (profile-controller + kfam parity, §2.7)."""
+
+import sys
+import textwrap
+import time
+
+import pytest
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    JAXJob,
+    JAXJobSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RunPolicy,
+    SchedulingPolicy,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.client import Platform, TrainingClient
+from kubeflow_tpu.controller.profile import Profile, ProfileQuota, ProfileSpec
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    with Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16) as p:
+        yield p
+
+
+def make_profile(platform, name, chips=None, max_jobs=None):
+    platform.cluster.create(
+        "profiles",
+        Profile(
+            metadata=ObjectMeta(name=name),
+            spec=ProfileSpec(
+                owner=f"{name}@example.com",
+                quota=ProfileQuota(chips=chips, max_jobs=max_jobs),
+            ),
+        ),
+    )
+
+
+def sleep_job(tmp_path, name, namespace, replicas=1, topology=""):
+    script = tmp_path / "sleep.py"
+    script.write_text("import time; time.sleep(60)")
+    rp = RunPolicy()
+    if topology:
+        rp.scheduling_policy = SchedulingPolicy(slice_topology=topology)
+    return JAXJob(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=JAXJobSpec(
+            replica_specs={
+                REPLICA_WORKER: ReplicaSpec(
+                    replicas=replicas,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(command=[sys.executable, str(script)])
+                    ),
+                )
+            },
+            run_policy=rp,
+        ),
+    )
+
+
+class TestNamespaceLifecycle:
+    def test_profile_creates_namespace(self, platform):
+        make_profile(platform, "team-a")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if platform.cluster.get("namespaces", "-/team-a") is not None:
+                break
+            time.sleep(0.1)
+        ns = platform.cluster.get("namespaces", "-/team-a")
+        assert ns is not None and ns.owner_profile == "team-a"
+
+    def test_profile_delete_releases_namespace(self, platform):
+        make_profile(platform, "team-b")
+        deadline = time.monotonic() + 10
+        while platform.cluster.get("namespaces", "-/team-b") is None:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        platform.cluster.delete("profiles", "default/team-b")
+        deadline = time.monotonic() + 10
+        while platform.cluster.get("namespaces", "-/team-b") is not None:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+
+
+class TestQuotas:
+    def test_max_jobs_admission(self, platform, tmp_path):
+        make_profile(platform, "capped", max_jobs=1)
+        client = TrainingClient(platform)
+        client.create_job(sleep_job(tmp_path, "j1", "capped"))
+        with pytest.raises(ValueError, match="quota of 1 active job"):
+            client.create_job(sleep_job(tmp_path, "j2", "capped"))
+        # other namespaces unaffected
+        client.create_job(sleep_job(tmp_path, "j3", "default"))
+
+    def test_chip_quota_blocks_gang(self, platform, tmp_path):
+        make_profile(platform, "small", chips=4)
+        client = TrainingClient(platform)
+        # 2x4 slice = 8 chips > quota 4, though cluster capacity (16) is fine
+        client.create_job(sleep_job(tmp_path, "big", "small", replicas=2,
+                                    topology="2x4"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            evs = platform.cluster.events_for("small/big")
+            if any(e.reason == "QuotaExceeded" for e in evs):
+                break
+            time.sleep(0.1)
+        assert any(e.reason == "QuotaExceeded" for e in evs)
+        j = client.get_job("big", "small")
+        assert not j.status.is_finished  # pending, not failed
+
+    def test_chip_quota_allows_within(self, platform, tmp_path):
+        make_profile(platform, "roomy", chips=8)
+        client = TrainingClient(platform)
+        client.create_job(sleep_job(tmp_path, "fits", "roomy", replicas=2,
+                                    topology="2x2"))  # 4 chips
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            j = client.get_job("fits", "roomy")
+            rs = j.status.replica_statuses.get(REPLICA_WORKER)
+            if rs and rs.active == 2:
+                return
+            time.sleep(0.1)
+        pytest.fail("gang within quota never scheduled")
