@@ -1,0 +1,92 @@
+"""Pipelines demo: prep -> train (JAXJob) -> report.
+
+Reference parity: a KFP pipeline whose middle step launches a training job
+CR (SURVEY.md §3.4 recursing into §3.1), rebuilt on the local runner and
+the in-process platform.
+
+  python -m examples.pipeline_mnist --device=cpu --steps=150
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import textwrap
+
+from kubeflow_tpu.pipelines import component, pipeline, train_job
+
+
+@component
+def choose_lr(base: float, scale: float) -> float:
+    return base * scale
+
+
+@component
+def report(job: dict, lr: float) -> str:
+    status = "succeeded" if job["succeeded"] else "FAILED"
+    return f"training {status} (job={job['jobName']}, lr={lr}, restarts={job['restartCount']})"
+
+
+def build_pipeline(device: str, steps: int):
+    manifest = textwrap.dedent(f"""
+        apiVersion: kubeflow-tpu.org/v1
+        kind: JAXJob
+        metadata: {{name: pipeline-mnist}}
+        spec:
+          replicaSpecs:
+            worker:
+              replicas: 1
+              template:
+                container:
+                  command:
+                    - {sys.executable}
+                    - -m
+                    - examples.sweep_mnist_trial
+                    - --device={device}
+                    - --steps={steps}
+                    - --lr=${{lr}}
+                    - --batch-size=128
+        """)
+
+    @pipeline(name="mnist-train-pipe", description="prep -> train -> report")
+    def mnist_pipe(base_lr: float = 1e-3, scale: float = 2.0):
+        lr = choose_lr(base=base_lr, scale=scale)
+        job = train_job("launch-training", manifest)(lr=lr)
+        return report(job=job, lr=lr)
+
+    return mnist_pipe
+
+
+def main(argv: list[str] | None = None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="cpu", choices=["tpu", "cpu"])
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--work-dir", default=".kubeflow_tpu/pipeline-mnist")
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.client import Platform
+    from kubeflow_tpu.native import MetadataStore
+    from kubeflow_tpu.pipelines import LocalPipelineRunner, compile_pipeline
+
+    ir = compile_pipeline(build_pipeline(args.device, args.steps)())
+    ms = MetadataStore(f"{args.work_dir}/mlmd.db")
+    with Platform() as platform:
+        runner = LocalPipelineRunner(
+            work_dir=args.work_dir, metadata_store=ms, platform=platform
+        )
+        run = runner.run(ir)
+        result = {
+            "run_id": run.run_id,
+            "state": run.state.value,
+            "tasks": {t: r.state.value for t, r in run.tasks.items()},
+            "report": run.output,
+            "lineage_executions": len(ms.list_executions("pipeline_task")),
+        }
+        print(json.dumps(result, indent=2))
+    ms.close()
+    return result
+
+
+if __name__ == "__main__":
+    main()

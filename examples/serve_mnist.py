@@ -1,0 +1,87 @@
+"""North-star config #5: serve a trained predictor.
+
+Reference parity: train-then-serve through the platform (SURVEY.md §3.5) —
+train mnist briefly, save the jax-runtime model dir, stand up an
+InferenceService, and query it over the v1 and v2 protocols.
+
+  python -m examples.serve_mnist --device=cpu --steps=200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv: list[str] | None = None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="cpu", choices=["tpu", "cpu"])
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--model-dir", default=".kubeflow_tpu/serve-mnist-model")
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+
+    import numpy as np
+
+    from kubeflow_tpu.api.common import ObjectMeta
+    from kubeflow_tpu.client import Platform
+    from kubeflow_tpu.models import MnistMLP
+    from kubeflow_tpu.serving import (
+        InferenceService,
+        InferenceServiceSpec,
+        PredictorRuntime,
+        PredictorSpec,
+        ServingClient,
+        save_predictor,
+    )
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import load_digits_dataset
+
+    # ---- train + export (the storage-initializer source)
+    ds = load_digits_dataset()
+    trainer = Trainer(
+        MnistMLP(), TrainerConfig(batch_size=128, steps=args.steps)
+    )
+    state, metrics = trainer.fit(ds)
+    variables = {"params": state.params, **state.extra}
+    save_predictor(
+        args.model_dir, "mnist-mlp",
+        {k: __import__("jax").device_get(v) for k, v in variables.items()},
+        np.zeros((1, ds.x_train.shape[-1]), np.float32),
+    )
+
+    # ---- serve + query
+    with Platform() as platform:
+        serving = ServingClient(platform)
+        serving.create(
+            InferenceService(
+                metadata=ObjectMeta(name="mnist"),
+                spec=InferenceServiceSpec(
+                    predictor=PredictorSpec(
+                        runtime=PredictorRuntime.JAX,
+                        storage_uri=f"file://{args.model_dir}",
+                        device=args.device,
+                    )
+                ),
+            )
+        )
+        isvc = serving.wait_ready("mnist", timeout_s=300)
+        x = ds.x_test[:4].astype("float32")
+        v1 = serving.predict("mnist", x.tolist())
+        v2 = serving.infer("mnist", x.ravel().tolist(), shape=list(x.shape))
+        result = {
+            "url": isvc.status.url,
+            "train_accuracy": metrics["final_accuracy"],
+            "v1_predictions": v1["predictions"],
+            "true_labels": ds.y_test[:4].tolist(),
+            "v2_output_shape": v2["outputs"][0]["shape"],
+        }
+        print(json.dumps(result, indent=2))
+        return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""North-star config #4: hyperparameter sweep launching trial jobs.
+
+Reference parity: a Katib Experiment tuning the mnist example
+(SURVEY.md §3.3), rebuilt on the in-process platform — trials are real
+JAXJob subprocesses running examples.mnist, metrics are collected from the
+`name=value` stdout contract, and TPE proposes the next points.
+
+  python -m examples.sweep_mnist --device=cpu --max-trials=6
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import textwrap
+
+
+def main(argv: list[str] | None = None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="cpu", choices=["tpu", "cpu"])
+    p.add_argument("--max-trials", type=int, default=6)
+    p.add_argument("--parallel", type=int, default=2)
+    p.add_argument("--steps", type=int, default=120)
+    p.add_argument("--algorithm", default="tpe",
+                   choices=["random", "grid", "tpe", "cmaes"])
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.client import Platform
+    from kubeflow_tpu.sweep import (
+        AlgorithmSpec,
+        Experiment,
+        ExperimentSpec,
+        FeasibleSpace,
+        Objective,
+        ObjectiveType,
+        ParameterSpec,
+        ParameterType,
+        SweepClient,
+        TrialParameterSpec,
+        TrialTemplate,
+    )
+    from kubeflow_tpu.api.common import ObjectMeta
+
+    trial_spec = textwrap.dedent(f"""
+        apiVersion: kubeflow-tpu.org/v1
+        kind: JAXJob
+        spec:
+          replicaSpecs:
+            worker:
+              replicas: 1
+              template:
+                container:
+                  command:
+                    - {sys.executable}
+                    - -m
+                    - examples.sweep_mnist_trial
+                    - --device={args.device}
+                    - --steps={args.steps}
+                    - --lr=${{trialParameters.lr}}
+                    - --batch-size=${{trialParameters.batchSize}}
+        """)
+    exp = Experiment(
+        metadata=ObjectMeta(name="mnist-sweep"),
+        spec=ExperimentSpec(
+            parameters=[
+                ParameterSpec(
+                    name="lr", parameter_type=ParameterType.DOUBLE,
+                    feasible_space=FeasibleSpace(min="0.0003", max="0.03"),
+                ),
+                ParameterSpec(
+                    name="batchSize", parameter_type=ParameterType.CATEGORICAL,
+                    feasible_space=FeasibleSpace(list=["64", "128", "256"]),
+                ),
+            ],
+            objective=Objective(
+                type=ObjectiveType.MAXIMIZE,
+                objective_metric_name="final_accuracy",
+            ),
+            algorithm=AlgorithmSpec(algorithm_name=args.algorithm),
+            trial_template=TrialTemplate(
+                trial_spec=trial_spec,
+                trial_parameters=[
+                    TrialParameterSpec(name="lr", reference="lr"),
+                    TrialParameterSpec(name="batchSize", reference="batchSize"),
+                ],
+            ),
+            max_trial_count=args.max_trials,
+            parallel_trial_count=args.parallel,
+        ),
+    )
+    with Platform() as platform:
+        sweep = SweepClient(platform)
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("mnist-sweep", timeout_s=3600)
+        best = done.status.current_optimal_trial
+        result = {
+            "condition": done.status.condition.value,
+            "trials": done.status.trials,
+            "best_params": sweep.get_optimal_hyperparameters("mnist-sweep"),
+            "best_accuracy": (
+                best.observation.metric("final_accuracy").latest if best else None
+            ),
+        }
+        print(json.dumps(result, indent=2))
+        return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""One sweep trial: mnist training at the assigned hyperparameters.
+
+Run by examples.sweep_mnist's trial template; prints the `name=value`
+metrics the collector parses (the trainer emits them natively).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="cpu", choices=["tpu", "cpu"])
+    p.add_argument("--steps", type=int, default=120)
+    p.add_argument("--lr", type=float, required=True)
+    p.add_argument("--batch-size", type=int, required=True)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+
+    from kubeflow_tpu.models import MnistMLP
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import load_digits_dataset
+
+    trainer = Trainer(
+        MnistMLP(),
+        TrainerConfig(
+            batch_size=args.batch_size, steps=args.steps,
+            learning_rate=args.lr, log_every_steps=50,
+        ),
+    )
+    trainer.fit(load_digits_dataset())
+
+
+if __name__ == "__main__":
+    main()

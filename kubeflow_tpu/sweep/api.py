@@ -254,11 +254,21 @@ def validate_experiment(exp: Experiment) -> Experiment:
                 raise ValueError(f"parameter {p.name}: categorical space needs list")
     if not exp.spec.objective.objective_metric_name:
         raise ValueError("experiment: objective.objectiveMetricName required")
-    if exp.spec.algorithm.algorithm_name not in ("random", "grid", "tpe"):
+    algo = exp.spec.algorithm.algorithm_name
+    if algo not in ("random", "grid", "tpe", "cmaes"):
         raise ValueError(
-            f"experiment: unknown algorithm "
-            f"{exp.spec.algorithm.algorithm_name!r} (random|grid|tpe)"
+            f"experiment: unknown algorithm {algo!r} (random|grid|tpe|cmaes)"
         )
+    if algo == "cmaes":
+        for p in exp.spec.parameters:
+            if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+                raise ValueError(
+                    f"experiment: cmaes supports numeric parameters only; "
+                    f"{p.name!r} is {p.parameter_type.value}"
+                )
+        pop = exp.spec.algorithm.settings.get("popsize")
+        if pop is not None and int(pop) < 2:
+            raise ValueError("experiment: cmaes popsize must be >= 2")
     if exp.spec.max_trial_count < 1 or exp.spec.parallel_trial_count < 1:
         raise ValueError("experiment: trial counts must be >= 1")
     if not exp.spec.trial_template.trial_spec:

@@ -35,6 +35,15 @@ def _format(p: ParameterSpec, v: float) -> str:
     return f"{v:.6g}"
 
 
+def _snap_step(p: ParameterSpec, v: float) -> float:
+    """Quantize a numeric value onto the parameter's step grid."""
+    fs = p.feasible_space
+    if not fs.step:
+        return v
+    lo, hi, step = float(fs.min), float(fs.max), float(fs.step)
+    return min(lo + round((v - lo) / step) * step, hi)
+
+
 class RandomSuggester:
     def __init__(self, parameters: list[ParameterSpec], seed: int = 0):
         self.parameters = parameters
@@ -49,13 +58,8 @@ class RandomSuggester:
                 if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
                     a[p.name] = str(fs.list[self.rng.integers(len(fs.list))])
                 else:
-                    lo, hi = float(fs.min), float(fs.max)
-                    v = self.rng.uniform(lo, hi)
-                    if fs.step:
-                        step = float(fs.step)
-                        v = lo + round((v - lo) / step) * step
-                        v = min(v, hi)
-                    a[p.name] = _format(p, v)
+                    v = self.rng.uniform(float(fs.min), float(fs.max))
+                    a[p.name] = _format(p, _snap_step(p, v))
             out.append(a)
         return out
 
@@ -154,11 +158,7 @@ class TPESuggester:
                         cand, bv if len(bv) else gv, bw
                     )
                     v = float(cand[np.argmax(score)])
-                if fs.step:
-                    step = float(fs.step)
-                    v = lo + round((v - lo) / step) * step
-                    v = min(v, hi)
-                a[p.name] = _format(p, v)
+                a[p.name] = _format(p, _snap_step(p, v))
         return a
 
     def _categorical(self, p: ParameterSpec, good: History, bad: History) -> str:
@@ -182,6 +182,131 @@ class TPESuggester:
         return (m + np.log(np.exp(log_k - m).sum(axis=1, keepdims=True))).ravel() - np.log(
             len(centers)
         )
+
+
+class CMAESSuggester:
+    """(mu/mu_w, lambda)-CMA-ES over numeric parameters (katib's optuna
+    cmaes parity). Reconciliation is stateless, so the strategy state
+    (mean, step size, covariance) is REPLAYED from the observed history on
+    every call: completed trials are consumed in creation order as
+    generations of size lambda — deterministic and restart-safe.
+
+    Categorical parameters are not supported (same restriction as upstream
+    CMA-ES samplers); validate at experiment admission.
+    """
+
+    def __init__(
+        self,
+        parameters: list[ParameterSpec],
+        seed: int = 0,
+        objective_type: ObjectiveType = ObjectiveType.MAXIMIZE,
+        popsize: int | None = None,
+        sigma0: float = 0.3,
+    ):
+        for p in parameters:
+            if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+                raise ValueError(
+                    f"cmaes supports numeric parameters only; {p.name!r} is "
+                    f"{p.parameter_type.value}"
+                )
+        self.parameters = parameters
+        self.seed = seed
+        self.objective_type = objective_type
+        self.d = len(parameters)
+        self.popsize = popsize if popsize is not None else (4 + int(3 * np.log(self.d)))
+        if self.popsize < 2:
+            raise ValueError(f"cmaes popsize must be >= 2, got {self.popsize}")
+        self.sigma0 = sigma0
+        # bounds are fixed at construction — parse once
+        self._lo = np.array([float(p.feasible_space.min) for p in parameters])
+        self._hi = np.array([float(p.feasible_space.max) for p in parameters])
+        self._span = np.where(self._hi > self._lo, self._hi - self._lo, 1.0)
+
+    # normalized [0,1]^d <-> parameter space
+
+    def _to_unit(self, a: dict[str, str]) -> np.ndarray:
+        x = np.array([float(a[p.name]) for p in self.parameters])
+        return (x - self._lo) / self._span
+
+    def _from_unit(self, u: np.ndarray) -> dict[str, str]:
+        x = self._lo + np.clip(u, 0.0, 1.0) * (self._hi - self._lo)
+        return {
+            p.name: _format(p, _snap_step(p, float(v)))
+            for p, v in zip(self.parameters, x)
+        }
+
+    def suggest(self, history: History, count: int) -> list[dict[str, str]]:
+        d, lam = self.d, self.popsize
+        mu = lam // 2
+        w = np.log(mu + 0.5) - np.log(np.arange(1, mu + 1))
+        w = w / w.sum()
+        mu_eff = 1.0 / (w ** 2).sum()
+        # standard CMA learning rates (Hansen's tutorial defaults)
+        cc = (4 + mu_eff / d) / (d + 4 + 2 * mu_eff / d)
+        cs = (mu_eff + 2) / (d + mu_eff + 5)
+        c1 = 2 / ((d + 1.3) ** 2 + mu_eff)
+        cmu = min(1 - c1, 2 * (mu_eff - 2 + 1 / mu_eff) / ((d + 2) ** 2 + mu_eff))
+        damps = 1 + 2 * max(0.0, np.sqrt((mu_eff - 1) / (d + 1)) - 1) + cs
+        chi_n = np.sqrt(d) * (1 - 1 / (4 * d) + 1 / (21 * d * d))
+
+        mean = np.full(d, 0.5)
+        sigma = self.sigma0
+        C = np.eye(d)
+        pc = np.zeros(d)
+        ps = np.zeros(d)
+        sign = 1.0 if self.objective_type == ObjectiveType.MINIMIZE else -1.0
+
+        names = {p.name for p in self.parameters}
+        observed = [
+            (a, o) for a, o in history
+            if o is not None and names <= set(a)  # tolerate foreign entries
+        ]
+        # replay complete generations
+        for g in range(len(observed) // lam):
+            gen = observed[g * lam:(g + 1) * lam]
+            xs = np.stack([self._to_unit(a) for a, _ in gen])
+            order = np.argsort([sign * o for _, o in gen])
+            elite = xs[order[:mu]]
+            old_mean = mean
+            mean = w @ elite
+            try:
+                # inv(L) whitens C: inv(L) C inv(L)^T = I
+                inv_sqrt_C = np.linalg.inv(np.linalg.cholesky(C))
+            except np.linalg.LinAlgError:
+                # fp drift made C non-PD: reset the covariance model rather
+                # than brick every future replay of this history
+                C = np.eye(d)
+                inv_sqrt_C = np.eye(d)
+            y = (mean - old_mean) / max(sigma, 1e-12)
+            ps = (1 - cs) * ps + np.sqrt(cs * (2 - cs) * mu_eff) * (inv_sqrt_C @ y)
+            h_sig = float(
+                np.linalg.norm(ps)
+                / np.sqrt(1 - (1 - cs) ** (2 * (g + 1)))
+                < (1.4 + 2 / (d + 1)) * chi_n
+            )
+            pc = (1 - cc) * pc + h_sig * np.sqrt(cc * (2 - cc) * mu_eff) * y
+            dz = (elite - old_mean) / max(sigma, 1e-12)
+            C = (
+                (1 - c1 - cmu) * C
+                + c1 * (np.outer(pc, pc) + (1 - h_sig) * cc * (2 - cc) * C)
+                + cmu * (dz.T * w) @ dz
+            )
+            C = (C + C.T) / 2  # keep symmetric under fp error
+            sigma = sigma * np.exp((cs / damps) * (np.linalg.norm(ps) / chi_n - 1))
+            sigma = float(np.clip(sigma, 1e-6, 1.0))
+
+        # sample the next ask()s; rng keyed by how far the replay got so the
+        # same history always yields the same suggestions
+        rng = np.random.default_rng(self.seed + len(observed))
+        try:
+            A = np.linalg.cholesky(C)
+        except np.linalg.LinAlgError:
+            A = np.eye(d)
+        out = []
+        for _ in range(count):
+            z = rng.standard_normal(d)
+            out.append(self._from_unit(mean + sigma * (A @ z)))
+        return out
 
 
 def get_suggester(
@@ -209,4 +334,14 @@ def get_suggester(
             n_candidates=int(settings.get("nCandidates", 24)),
             n_startup=int(settings.get("nStartup", 5)),
         )
-    raise ValueError(f"unknown suggestion algorithm {name!r} (random|grid|tpe)")
+    if name == "cmaes":
+        return CMAESSuggester(
+            parameters,
+            seed=seed,
+            objective_type=objective_type,
+            popsize=int(settings["popsize"]) if "popsize" in settings else None,
+            sigma0=float(settings.get("sigma", 0.3)),
+        )
+    raise ValueError(
+        f"unknown suggestion algorithm {name!r} (random|grid|tpe|cmaes)"
+    )

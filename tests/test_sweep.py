@@ -101,9 +101,59 @@ class TestSuggesters:
         mean_x = sum(float(a["x"]) for a in sugg) / len(sugg)
         assert mean_x > 0.55  # pulled toward the good region
 
+    def test_cmaes_converges_on_quadratic(self):
+        # maximize -(x-0.7)^2 - (y-0.2)^2; CMA should contract toward (0.7, 0.2)
+        params = [p_double("x", 0.0, 1.0), p_double("y", 0.0, 1.0)]
+        cma = get_suggester("cmaes", params, seed=5,
+                            objective_type=ObjectiveType.MAXIMIZE)
+        history = []
+        for _ in range(12):  # generations
+            batch = cma.suggest(history, cma.popsize)
+            for a in batch:
+                x, y = float(a["x"]), float(a["y"])
+                history.append((a, -((x - 0.7) ** 2) - (y - 0.2) ** 2))
+        final = cma.suggest(history, 8)
+        mean_x = sum(float(a["x"]) for a in final) / len(final)
+        mean_y = sum(float(a["y"]) for a in final) / len(final)
+        assert abs(mean_x - 0.7) < 0.15
+        assert abs(mean_y - 0.2) < 0.15
+
+    def test_cmaes_handles_correlated_objective(self):
+        # maximize -(x+y-1)^2 - 0.05*(x-y)^2: the optimum is a correlated
+        # ridge along x+y=1 — exercises the covariance/whitening path that
+        # an axis-aligned objective never touches
+        params = [p_double("x", 0.0, 1.0), p_double("y", 0.0, 1.0)]
+        cma = get_suggester("cmaes", params, seed=11,
+                            objective_type=ObjectiveType.MAXIMIZE)
+        history = []
+        for _ in range(15):
+            for a in cma.suggest(history, cma.popsize):
+                x, y = float(a["x"]), float(a["y"])
+                history.append((a, -((x + y - 1) ** 2) - 0.05 * (x - y) ** 2))
+        final = cma.suggest(history, 8)
+        vals = [float(a["x"]) + float(a["y"]) for a in final]
+        assert all("nan" not in (a["x"] + a["y"]) for a in final)
+        assert abs(sum(vals) / len(vals) - 1.0) < 0.2
+
+    def test_cmaes_popsize_validation(self):
+        with pytest.raises(ValueError, match="popsize must be >= 2"):
+            get_suggester("cmaes", [p_double("x", 0, 1)],
+                          settings={"popsize": "1"})
+
+    def test_cmaes_deterministic_replay(self):
+        params = [p_double("x", 0.0, 1.0)]
+        h = [({"x": f"{v:.3f}"}, -v) for v in (0.1, 0.5, 0.9, 0.3, 0.7, 0.2)]
+        a = get_suggester("cmaes", params, seed=1).suggest(h, 4)
+        b = get_suggester("cmaes", params, seed=1).suggest(h, 4)
+        assert a == b
+
+    def test_cmaes_rejects_categorical(self):
+        with pytest.raises(ValueError, match="numeric parameters only"):
+            get_suggester("cmaes", [p_cat("opt", ["a", "b"])])
+
     def test_unknown_algorithm(self):
         with pytest.raises(ValueError, match="unknown suggestion algorithm"):
-            get_suggester("cmaes", [p_double("x", 0, 1)])
+            get_suggester("simulated-annealing", [p_double("x", 0, 1)])
 
 
 class TestCollector:
