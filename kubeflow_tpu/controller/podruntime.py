@@ -103,7 +103,10 @@ class PodRuntime:
                 pod.scheduler_name == "default" and self.bind_pending_default
             ):
                 pod.status.node = "local-node"
-                self.cluster.update("pods", pod)
+                try:
+                    self.cluster.update("pods", pod)
+                except KeyError:
+                    return  # deleted between our read and write
             elif pod.status.node:
                 self._launch(pod)
 
@@ -139,13 +142,22 @@ class PodRuntime:
                 pod.status.phase = PodPhase.FAILED
                 pod.status.exit_code = 127
                 pod.status.message = str(exc)
-                self.cluster.update("pods", pod)
+                try:
+                    self.cluster.update("pods", pod)
+                except KeyError:
+                    pass  # deleted concurrently; nothing to report against
                 return
             self._procs[pod.key] = (pod.metadata.uid, proc)
         pod.status.phase = PodPhase.RUNNING
         pod.status.pid = proc.pid
         pod.status.start_time = time.time()
-        self.cluster.update("pods", pod)
+        try:
+            self.cluster.update("pods", pod)
+        except KeyError:
+            # the pod was deleted while we were spawning its process: the
+            # process must not outlive its (gone) pod
+            self._kill(pod.key)
+            return
         threading.Thread(
             target=self._reap, args=(pod.key, pod.metadata.uid, proc), daemon=True
         ).start()

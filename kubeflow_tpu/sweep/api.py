@@ -267,8 +267,25 @@ def validate_experiment(exp: Experiment) -> Experiment:
                     f"{p.name!r} is {p.parameter_type.value}"
                 )
         pop = exp.spec.algorithm.settings.get("popsize")
-        if pop is not None and int(pop) < 2:
-            raise ValueError("experiment: cmaes popsize must be >= 2")
+        if pop is not None:
+            try:
+                pop_i = int(pop)
+            except ValueError:
+                raise ValueError(
+                    f"experiment: cmaes popsize must be an integer, got {pop!r}"
+                ) from None
+            if pop_i < 2:
+                raise ValueError("experiment: cmaes popsize must be >= 2")
+        sigma = exp.spec.algorithm.settings.get("sigma")
+        if sigma is not None:
+            try:
+                sigma_f = float(sigma)
+            except ValueError:
+                raise ValueError(
+                    f"experiment: cmaes sigma must be a number, got {sigma!r}"
+                ) from None
+            if sigma_f <= 0:
+                raise ValueError("experiment: cmaes sigma must be > 0")
     if exp.spec.max_trial_count < 1 or exp.spec.parallel_trial_count < 1:
         raise ValueError("experiment: trial counts must be >= 1")
     if not exp.spec.trial_template.trial_spec:

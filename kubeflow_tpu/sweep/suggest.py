@@ -1,4 +1,4 @@
-"""Suggestion algorithms: random, grid, TPE.
+"""Suggestion algorithms: random, grid, TPE, CMA-ES.
 
 Reference parity (unverified cites, SURVEY.md §2.4): katib
 pkg/suggestion/v1beta1/{hyperopt,optuna}/service.py behind the Suggestion

@@ -1,0 +1,162 @@
+"""Concurrency stress drill — the `go test -race` analogue (SURVEY.md §4).
+
+Several client threads hammer one platform with create / scale / suspend /
+resume / kill / delete while the controllers reconcile; at the end every
+invariant the control plane promises must hold: no orphaned pods or
+podgroups, no leaked worker processes, no dead controller threads, every
+surviving job at a coherent terminal state. The C++ core gets the same
+treatment natively via `make check` (ASan) and `make tsan`.
+"""
+
+import random
+import sys
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    ElasticPolicy,
+    JAXJob,
+    JAXJobSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RunPolicy,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.client import Platform, TrainingClient
+from kubeflow_tpu.controller.fakecluster import PodPhase
+
+JOBS_PER_THREAD = 4
+THREADS = 3
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    with Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=64) as p:
+        yield p
+
+
+def test_concurrent_lifecycle_chaos(platform, tmp_path):
+    client = TrainingClient(platform)
+    release = tmp_path / "release"
+    script = tmp_path / "worker.py"
+    script.write_text(
+        f"import os, time\n"
+        f"while not os.path.exists({str(release)!r}):\n"
+        f"    time.sleep(0.05)\n"
+    )
+    errors: list[str] = []
+
+    def job_for(name):
+        return JAXJob(
+            metadata=ObjectMeta(name=name),
+            spec=JAXJobSpec(
+                replica_specs={
+                    REPLICA_WORKER: ReplicaSpec(
+                        replicas=2,
+                        template=PodTemplateSpec(
+                            container=ContainerSpec(
+                                command=[sys.executable, str(script)]
+                            )
+                        ),
+                    )
+                },
+                run_policy=RunPolicy(
+                    backoff_limit=5,
+                    elastic_policy=ElasticPolicy(min_replicas=1, max_replicas=4),
+                ),
+            ),
+        )
+
+    deleted: set[str] = set()
+    deleted_mu = threading.Lock()
+
+    def chaos(tid: int):
+        rng = random.Random(tid)
+        try:
+            names = [f"chaos-{tid}-{i}" for i in range(JOBS_PER_THREAD)]
+            for name in names:
+                client.create_job(job_for(name))
+            for _ in range(12):
+                name = rng.choice(names)
+                op = rng.random()
+                try:
+                    if op < 0.35:
+                        client.scale_job(name, rng.randint(1, 4))
+                    elif op < 0.55:
+                        client.suspend_job(name)
+                        time.sleep(0.05)
+                        client.resume_job(name)
+                    elif op < 0.7:
+                        platform.pod_runtime.inject_kill(
+                            f"default/{name}-worker-0"
+                        )
+                    elif op < 0.8:
+                        client.delete_job(name)
+                        with deleted_mu:
+                            deleted.add(name)
+                except (KeyError, ValueError):
+                    pass  # racing a deletion/terminal state: legal client error
+                time.sleep(rng.random() * 0.1)
+        except Exception as exc:  # noqa: BLE001 — fail the test, don't hang it
+            errors.append(f"thread {tid}: {type(exc).__name__}: {exc}")
+
+    threads = [
+        threading.Thread(target=chaos, args=(t,), daemon=True)
+        for t in range(THREADS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "chaos thread hung"
+    assert not errors, errors
+
+    # let the dust settle, then open the gate so survivors can finish
+    release.write_text("go")
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        unfinished = [
+            j for j in client.list_jobs()
+            if not j.status.is_finished
+        ]
+        if not unfinished:
+            break
+        time.sleep(0.25)
+    assert not unfinished, (
+        f"jobs never reached terminal state: "
+        f"{[(j.metadata.name, [c.type.value for c in j.status.conditions if c.status]) for j in unfinished]}"
+    )
+
+    # ---- invariants
+    cluster = platform.cluster
+    job_names = {j.metadata.name for j in cluster.list("jobs")}
+    # 1. no orphaned pods (every pod's owner job exists)
+    orphans = [
+        p.metadata.name for p in cluster.list("pods")
+        if p.metadata.labels.get("kubeflow-tpu.org/job-name") not in job_names
+    ]
+    assert not orphans, f"orphaned pods: {orphans}"
+    # 2. no podgroups for finished jobs (cleanup ran)
+    stale_pgs = [
+        pg.metadata.name for pg in cluster.list("podgroups")
+        if pg.metadata.name not in job_names
+        or cluster.get("jobs", pg.key).status.is_finished
+    ]
+    assert not stale_pgs, f"stale podgroups: {stale_pgs}"
+    # 3. no running processes for finished/deleted jobs
+    time.sleep(1.0)
+    leaked = {
+        key: uid for key, (uid, proc) in platform.pod_runtime._procs.items()
+        if proc.poll() is None
+    }
+    assert not leaked, f"leaked worker processes: {leaked}"
+    # 4. runtime/scheduler threads never hit internal errors
+    assert platform.pod_runtime.errors == 0
+    assert platform.gang_scheduler.errors == 0
+    # 5. deleted jobs are really gone
+    for name in deleted:
+        assert cluster.get("jobs", f"default/{name}") is None
