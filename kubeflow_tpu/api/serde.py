@@ -91,6 +91,7 @@ MANIFEST_KINDS = {
     "InferenceService": "inferenceservices",
     "PodDefault": "poddefaults",
     "Profile": "profiles",
+    "Tensorboard": "tensorboards",
 }
 
 

@@ -33,6 +33,7 @@ class Platform:
         controller_workers: int = 2,
     ):
         from kubeflow_tpu.controller.profile import ProfileController
+        from kubeflow_tpu.controller.tensorboard import TensorboardController
         from kubeflow_tpu.serving.controller import InferenceServiceController
         from kubeflow_tpu.sweep.controller import ExperimentController
 
@@ -49,6 +50,7 @@ class Platform:
             model_cache_dir=str(Path(log_dir).parent / "model-cache"),
         )
         self.profile_controller = ProfileController(self.cluster)
+        self.tensorboard_controller = TensorboardController(self.cluster)
         self.metrics_server = None  # started on demand
         self._started = False
 
@@ -75,6 +77,7 @@ class Platform:
             self.experiment_controller.start()
             self.isvc_controller.start()
             self.profile_controller.start()
+            self.tensorboard_controller.start()
             self._started = True
         return self
 
@@ -82,6 +85,7 @@ class Platform:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
+        self.tensorboard_controller.stop()
         self.profile_controller.stop()
         self.isvc_controller.stop()
         self.experiment_controller.stop()

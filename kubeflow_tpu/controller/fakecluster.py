@@ -106,6 +106,7 @@ class FakeCluster:
     KINDS = (
         "jobs", "pods", "podgroups", "experiments", "trials",
         "inferenceservices", "poddefaults", "profiles", "namespaces",
+        "tensorboards",
     )
 
     def __init__(self) -> None:

@@ -1,0 +1,131 @@
+"""TensorboardController — Tensorboard CR -> a live TensorBoard process.
+
+Reference parity (unverified cites, SURVEY.md §2.7, §5.1): kubeflow/kubeflow
+components/tensorboard-controller — a `Tensorboard` CR materializes a
+TensorBoard Deployment over a logdir. Here the deployment is a pod running
+`python -m tensorboard.main`, with the same readiness/self-heal treatment
+serving predictors get.
+"""
+
+from __future__ import annotations
+
+import sys
+import urllib.request
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.controller.base import ControllerBase
+from kubeflow_tpu.controller.fakecluster import FakeCluster, Pod, PodPhase
+from kubeflow_tpu.runtime.rendezvous import free_port
+
+TB_LABEL = "kubeflow-tpu.org/tensorboard"
+PORT_ANNOTATION = "kubeflow-tpu.org/serving-port"
+
+
+@dataclass
+class TensorboardSpec:
+    logdir: str = ""
+
+
+@dataclass
+class TensorboardStatus:
+    ready: bool = False
+    url: str = ""
+
+
+@dataclass
+class Tensorboard:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TensorboardSpec = field(default_factory=TensorboardSpec)
+    status: TensorboardStatus = field(default_factory=TensorboardStatus)
+    kind: str = "Tensorboard"
+    api_version: str = "kubeflow-tpu.org/v1alpha1"
+
+
+def _probe(url: str, timeout_s: float = 0.5) -> bool:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return r.status == 200
+    except Exception:  # noqa: BLE001 — any failure = not ready
+        return False
+
+
+class TensorboardController(ControllerBase):
+    ERROR_EVENT_KIND = "tensorboards"
+
+    def __init__(self, cluster: FakeCluster, workers: int = 1,
+                 resync_period_s: float = 2.0):
+        super().__init__(
+            cluster, name="tb", workers=workers, resync_period_s=resync_period_s,
+        )
+
+    def kind_filter(self, etype, kind: str, obj) -> str | None:
+        if kind == "tensorboards":
+            return self.cluster._key(obj)
+        if kind == "pods":
+            name = obj.metadata.labels.get(TB_LABEL)
+            if name:
+                return f"{obj.metadata.namespace}/{name}"
+        return None
+
+    def resync_keys(self):
+        return [self.cluster._key(t) for t in self.cluster.list("tensorboards")]
+
+    def reconcile(self, key: str) -> float | None:
+        tb: Tensorboard | None = self.cluster.get("tensorboards", key, copy_obj=True)
+        ns, _, name = key.partition("/")
+        pods = self.cluster.list(
+            "pods",
+            lambda p: p.metadata.labels.get(TB_LABEL) == name
+            and p.metadata.namespace == ns,
+        )
+        if tb is None:
+            for p in pods:
+                self.cluster.delete("pods", p.key)
+            return None
+
+        # self-heal exited servers
+        for p in pods:
+            if p.status.phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
+                self.cluster.delete("pods", p.key)
+        pods = [p for p in pods if p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)]
+        if not pods:
+            self._create_pod(tb)
+            return 0.5
+
+        pod = pods[0]
+        port = pod.metadata.annotations.get(PORT_ANNOTATION, "")
+        url = f"http://127.0.0.1:{port}" if port else ""
+        ready = pod.status.phase == PodPhase.RUNNING and bool(url) and _probe(url)
+        if (ready, url if ready else "") != (tb.status.ready, tb.status.url):
+            tb.status.ready = ready
+            tb.status.url = url if ready else ""
+            self.cluster.update("tensorboards", tb)
+            if ready:
+                self.cluster.record_event(
+                    "tensorboards", key, "Ready", f"tensorboard at {url}"
+                )
+        return None if ready else 0.5
+
+    def _create_pod(self, tb: Tensorboard) -> None:
+        port = free_port()
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=f"{tb.metadata.name}-tensorboard-0",
+                namespace=tb.metadata.namespace,
+                labels={TB_LABEL: tb.metadata.name},
+                annotations={PORT_ANNOTATION: str(port)},
+            ),
+            command=[
+                sys.executable, "-m", "tensorboard.main",
+                "--logdir", tb.spec.logdir,
+                "--port", str(port),
+                "--host", "127.0.0.1",
+                "--load_fast", "false",
+            ],
+            scheduler_name="default",
+        )
+        try:
+            self.cluster.create("pods", pod)
+        except KeyError:
+            pass

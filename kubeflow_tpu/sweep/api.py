@@ -101,6 +101,12 @@ class ExperimentSpec:
     early_stopping: EarlyStoppingSpec | None = None
     # metrics are read from this replica's log (worker-0 by default)
     metrics_replica_type: str = "worker"
+    # "stdout" (name=value log lines) or "tfevents" (TensorBoard event files
+    # under tfevents_dir — katib's tfevent-metricscollector parity)
+    metrics_source: str = "stdout"
+    # tfevents source: dir pattern, ${trialName} substituted per trial; the
+    # trial template should point KFTPU_EVENT_DIR at the same place
+    tfevents_dir: str = ""
 
 
 @dataclass
@@ -290,6 +296,13 @@ def validate_experiment(exp: Experiment) -> Experiment:
         raise ValueError("experiment: trial counts must be >= 1")
     if not exp.spec.trial_template.trial_spec:
         raise ValueError("experiment: trialTemplate.trialSpec required")
+    if exp.spec.metrics_source not in ("stdout", "tfevents"):
+        raise ValueError(
+            f"experiment: metricsSource {exp.spec.metrics_source!r} "
+            f"(stdout|tfevents)"
+        )
+    if exp.spec.metrics_source == "tfevents" and not exp.spec.tfevents_dir:
+        raise ValueError("experiment: tfevents metricsSource needs tfeventsDir")
     for tp in exp.spec.trial_template.trial_parameters:
         ref = tp.reference or tp.name
         if ref not in names:

@@ -49,6 +49,10 @@ def observation_from_log(
     """Build a trial Observation (latest/min/max per metric) from a log."""
     names = {objective_metric, *(additional or [])}
     timelines = parse_metrics(text, names)
+    return _observation(timelines)
+
+
+def _observation(timelines: dict[str, list[float]]) -> Observation:
     obs = Observation()
     for name in sorted(timelines):
         vals = timelines[name]
@@ -56,3 +60,49 @@ def observation_from_log(
             Metric(name=name, latest=vals[-1], min=min(vals), max=max(vals))
         )
     return obs
+
+
+# ---------------------------------------------------------------- tfevents
+
+def parse_tfevents(logdir: str, names: set[str] | None = None) -> dict[str, list[float]]:
+    """Scalar timelines from a tfevents dir (katib's tfevent-metricscollector
+    parity, cmd/metricscollector/v1beta1/tfevent-metricscollector). Handles
+    both simple_value and tensor-encoded scalars; step-ordered."""
+    import os
+
+    from tensorboard.backend.event_processing.event_file_loader import (
+        EventFileLoader,
+    )
+
+    points: dict[str, list[tuple[int, float]]] = {}
+    if not os.path.isdir(logdir):
+        return {}
+    files = sorted(
+        os.path.join(root, f)
+        for root, _, fs in os.walk(logdir)
+        for f in fs
+        if "tfevents" in f
+    )
+    for path in files:
+        for ev in EventFileLoader(path).Load():
+            for val in ev.summary.value:
+                if names is not None and val.tag not in names:
+                    continue
+                if val.HasField("simple_value"):
+                    v = float(val.simple_value)
+                elif val.HasField("tensor") and val.tensor.float_val:
+                    v = float(val.tensor.float_val[0])
+                else:
+                    continue
+                points.setdefault(val.tag, []).append((ev.step, v))
+    return {
+        tag: [v for _, v in sorted(pts, key=lambda p: p[0])]
+        for tag, pts in points.items()
+    }
+
+
+def observation_from_tfevents(
+    logdir: str, objective_metric: str, additional: list[str] | None = None
+) -> Observation:
+    names = {objective_metric, *(additional or [])}
+    return _observation(parse_tfevents(logdir, names))

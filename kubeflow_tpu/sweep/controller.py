@@ -268,13 +268,24 @@ class ExperimentController(ControllerBase):
             self.cluster.update("trials", trial)
 
     def _observe(self, exp: Experiment, trial: Trial):
+        obj = exp.spec.objective
+        if exp.spec.metrics_source == "tfevents":
+            from kubeflow_tpu.sweep.collector import observation_from_tfevents
+
+            return observation_from_tfevents(
+                self._tfevents_dir(exp, trial),
+                obj.objective_metric_name, obj.additional_metric_names,
+            )
         log = self.log_reader(
             f"{trial.metadata.name}-{exp.spec.metrics_replica_type}-0"
         )
-        obj = exp.spec.objective
         return observation_from_log(
             log, obj.objective_metric_name, obj.additional_metric_names
         )
+
+    @staticmethod
+    def _tfevents_dir(exp: Experiment, trial: Trial) -> str:
+        return exp.spec.tfevents_dir.replace("${trialName}", trial.metadata.name)
 
     def _median_stop(self, exp: Experiment, trials: list[Trial]) -> None:
         """medianstop parity: a running trial is killed when the running
@@ -336,9 +347,13 @@ class ExperimentController(ControllerBase):
                 )
 
     def _objective_timeline(self, exp: Experiment, trial: Trial) -> list[float]:
-        from kubeflow_tpu.sweep.collector import parse_metrics
+        from kubeflow_tpu.sweep.collector import parse_metrics, parse_tfevents
 
         name = exp.spec.objective.objective_metric_name
+        if exp.spec.metrics_source == "tfevents":
+            return parse_tfevents(
+                self._tfevents_dir(exp, trial), {name}
+            ).get(name, [])
         log = self.log_reader(
             f"{trial.metadata.name}-{exp.spec.metrics_replica_type}-0"
         )

@@ -36,6 +36,38 @@ def parse_line(line: str) -> dict[str, float]:
     return {m.group(1): float(m.group(2)) for m in METRIC_RE.finditer(line)}
 
 
+class TfEventsWriter:
+    """Scalar tfevents emission for TensorBoard (SURVEY.md §5.1: the
+    reference's TensorBoard story — Tensorboard CR + tfevent collectors).
+    Uses tensorboard's own writer, no TF dependency."""
+
+    def __init__(self, logdir: str):
+        from tensorboard.summary.writer.event_file_writer import EventFileWriter
+
+        self._writer = EventFileWriter(logdir)
+        self.logdir = logdir
+
+    def scalars(self, step: int, **metrics: float) -> None:
+        from tensorboard.compat.proto.event_pb2 import Event
+        from tensorboard.compat.proto.summary_pb2 import Summary
+
+        summary = Summary(
+            value=[
+                Summary.Value(tag=k, simple_value=float(v))
+                for k, v in metrics.items()
+            ]
+        )
+        self._writer.add_event(
+            Event(step=step, wall_time=time.time(), summary=summary)
+        )
+
+    def flush(self) -> None:
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()
+
+
 class Timer:
     """Wall-clock throughput meter (images/sec, steps/sec)."""
 

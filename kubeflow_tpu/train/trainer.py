@@ -64,6 +64,8 @@ class TrainerConfig:
     # jax.profiler trace output dir; "" defers to the platform's
     # KFTPU_PROFILE_DIR env (the JAXJob profile toggle, SURVEY.md §5.1)
     profile_dir: str = ""
+    # tfevents scalar output for TensorBoard; "" defers to KFTPU_EVENT_DIR
+    event_dir: str = ""
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -226,8 +228,13 @@ class Trainer:
         resume: bool = True,
         on_epoch_end: Callable[[int, dict], None] | None = None,
     ) -> tuple[TrainState, dict]:
+        import os
+
         c = self.config
         state = self.init_state(dataset.x_train[: c.batch_size])
+
+        event_dir = c.event_dir or os.environ.get("KFTPU_EVENT_DIR", "")
+        events = metrics_lib.TfEventsWriter(event_dir) if event_dir else None
 
         start_step = 0
         if resume and self.checkpointer is not None:
@@ -265,6 +272,11 @@ class Trainer:
                         images_per_sec=timer.items_per_sec,
                         steps_per_sec=timer.steps_per_sec,
                     )
+                    if events is not None:
+                        events.scalars(
+                            global_step, **last,
+                            images_per_sec=timer.items_per_sec,
+                        )
                 if (
                     self.checkpointer is not None
                     and global_step % c.checkpoint_every_steps == 0
@@ -275,6 +287,10 @@ class Trainer:
                 ev = self.evaluate(state, dataset)
                 metrics_lib.emit(step=global_step, **{f"eval_{k}": v for k, v in ev.items()})
                 last.update({f"eval_{k}": v for k, v in ev.items()})
+                if events is not None:
+                    events.scalars(
+                        global_step, **{f"eval_{k}": v for k, v in ev.items()}
+                    )
                 if on_epoch_end is not None:
                     on_epoch_end(epoch, ev)
 
@@ -283,6 +299,11 @@ class Trainer:
             self.checkpointer.wait()
         final_eval = self.evaluate(state, dataset)
         metrics_lib.emit(step=global_step, **{f"final_{k}": v for k, v in final_eval.items()})
+        if events is not None:
+            events.scalars(
+                global_step, **{f"final_{k}": v for k, v in final_eval.items()}
+            )
+            events.close()
         return state, {**last, **{f"final_{k}": v for k, v in final_eval.items()}}
 
     # ------------------------------------------------------------------ eval
