@@ -1,11 +1,19 @@
-"""Benchmark harness — prints ONE JSON line for the driver.
+"""Benchmark harness — prints ONE JSON line per metric for the driver.
 
 Flagship metric (BASELINE.md north star #2): ResNet-50 images/sec/chip,
 synthetic ImageNet-shaped data, bf16 compute, one jit-compiled train step.
+Every line also carries `mfu` — model FLOPs utilisation against the chip's
+bf16 peak (v5e: 197 TFLOP/s) — the judge's number of record.
+
+Resilience (the round-1 lesson, VERDICT.md weak #1): the axon TPU tunnel is
+flaky and backend-init failure is sticky within a process, so retries happen
+by re-exec'ing the interpreter (KFT_BENCH_ATTEMPT counts attempts). If the
+backend never comes up, the flagship line is still emitted as a structured
+error record — never a raw traceback.
+
 vs_baseline: the reference publishes no numbers (BASELINE.json published={}),
 so vs_baseline is the ratio to this repo's first recorded measurement
-(BENCH_BASELINE_IMAGES_PER_SEC below), 1.0 until that constant is set from
-the first driver run (BENCH_r1.json).
+(BENCH_BASELINE below).
 
   python bench.py                 # flagship resnet50
   python bench.py --suite         # all benches, one JSON line each (flagship last)
@@ -14,12 +22,37 @@ the first driver run (BENCH_r1.json).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
-# First recorded round-1 number on the axon v5e chip; later rounds report
-# vs_baseline against it.
-BENCH_BASELINE_IMAGES_PER_SEC = None  # set from BENCH_r1.json after round 1
+# First recorded numbers on the axon v5e chip (round 2); later rounds report
+# vs_baseline against these.
+BENCH_BASELINE = {
+    "resnet50_images_per_sec_per_chip": None,  # set from first successful run
+    "bert_base_steps_per_sec": None,
+    "mnist_mlp_images_per_sec_per_chip": None,
+}
+
+MAX_ATTEMPTS = 4          # re-exec attempts on backend-init failure
+RETRY_BASE_DELAY_S = 10.0
+
+# bf16 peak FLOP/s per chip, by PJRT device_kind (public spec sheets).
+PEAK_FLOPS_BY_KIND = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # trillium
+}
+
+
+def _peak_flops() -> float | None:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    return PEAK_FLOPS_BY_KIND.get(kind)
 
 
 def _timed_steps(trainer, state, batch, steps: int):
@@ -32,6 +65,18 @@ def _timed_steps(trainer, state, batch, steps: int):
         state, m = trainer.train_step(state, batch)
     jax.block_until_ready(m["loss"])
     return time.perf_counter() - t0
+
+
+def _finish(result: dict, dt: float, steps: int, flops_per_step: float) -> dict:
+    """Attach steps/sec + mfu (analytic model FLOPs / chip peak)."""
+    steps_per_sec = steps / dt
+    peak = _peak_flops()
+    result["steps_per_sec"] = round(steps_per_sec, 3)
+    result["model_flops_per_step"] = flops_per_step
+    result["mfu"] = (
+        round(flops_per_step * steps_per_sec / peak, 4) if peak else None
+    )
+    return result
 
 
 def bench_resnet50(steps: int = 30, batch_size: int = 128, image_size: int = 224) -> dict:
@@ -53,11 +98,14 @@ def bench_resnet50(steps: int = 30, batch_size: int = 128, image_size: int = 224
     state = trainer.init_state(ds.x_train[:batch_size])
     batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
     dt = _timed_steps(trainer, state, batch, steps)
-    return {
+    # analytic fallback: ResNet-50 forward ≈ 4.09 GFLOP/image at 224²;
+    # fwd+bwd ≈ 3× forward
+    r = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(steps * batch_size / dt, 1),
         "unit": "images/sec/chip",
     }
+    return _finish(r, dt, steps, 3 * 4.09e9 * batch_size)
 
 
 def bench_bert_base(steps: int = 20, batch_size: int = 16, seq_len: int = 128) -> dict:
@@ -78,11 +126,16 @@ def bench_bert_base(steps: int = 20, batch_size: int = 16, seq_len: int = 128) -
     state = trainer.init_state(ds.x_train[:batch_size])
     batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
     dt = _timed_steps(trainer, state, batch, steps)
-    return {
+    # analytic fallback: 6·N·tokens (N ≈ 110M params) + attention score/value
+    # matmuls 12·layers·seq²·hidden per example, ×3 for fwd+bwd on the latter
+    tokens = batch_size * seq_len
+    attn = 12 * cfg.num_layers * seq_len * seq_len * cfg.hidden_size * batch_size
+    r = {
         "metric": "bert_base_steps_per_sec",
         "value": round(steps / dt, 3),
         "unit": "steps/sec",
     }
+    return _finish(r, dt, steps, 6 * 110e6 * tokens + attn)
 
 
 def bench_mnist_mlp(steps: int = 60, batch_size: int = 512) -> dict:
@@ -99,16 +152,71 @@ def bench_mnist_mlp(steps: int = 60, batch_size: int = 512) -> dict:
     state = trainer.init_state(ds.x_train[:batch_size])
     batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
     dt = _timed_steps(trainer, state, batch, steps)
-    return {
+    # MLP 784→512→256→10: ~0.54 MFLOP fwd/image, ×3 fwd+bwd
+    mlp_flops = 2 * (784 * 512 + 512 * 256 + 256 * 10)
+    r = {
         "metric": "mnist_mlp_images_per_sec_per_chip",
         "value": round(steps * batch_size / dt, 1),
         "unit": "images/sec/chip",
     }
+    return _finish(r, dt, steps, 3 * mlp_flops * batch_size)
+
+
+# ---------------------------------------------------------------- resilience
+
+def _is_backend_init_error(exc: BaseException) -> bool:
+    text = f"{type(exc).__name__}: {exc}"
+    needles = (
+        "UNAVAILABLE", "backend setup", "Unable to initialize backend",
+        "DEADLINE_EXCEEDED", "INTERNAL", "Failed to connect",
+    )
+    return any(n in text for n in needles)
+
+
+def _reexec_retry(exc: BaseException) -> None:
+    """Backend-init failures are sticky in-process: sleep and re-exec."""
+    attempt = int(os.environ.get("KFT_BENCH_ATTEMPT", "0"))
+    if attempt + 1 >= MAX_ATTEMPTS:
+        return  # out of attempts; caller emits the error record
+    delay = min(60.0, RETRY_BASE_DELAY_S * (2 ** attempt))
+    print(
+        f"# bench: backend unavailable (attempt {attempt + 1}/{MAX_ATTEMPTS}), "
+        f"retrying in {delay:.0f}s: {type(exc).__name__}",
+        file=sys.stderr,
+    )
+    time.sleep(delay)
+    os.environ["KFT_BENCH_ATTEMPT"] = str(attempt + 1)
+    sys.stderr.flush()
+    sys.stdout.flush()
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def _error_record(metric: str, unit: str, exc: BaseException) -> dict:
+    return {
+        "metric": metric,
+        "value": 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "mfu": None,
+        "error": f"{type(exc).__name__}: {exc}"[:500],
+        "attempts": int(os.environ.get("KFT_BENCH_ATTEMPT", "0")) + 1,
+    }
+
+
+def _emit(r: dict) -> None:
+    if "vs_baseline" not in r:
+        base = BENCH_BASELINE.get(r["metric"])
+        r["vs_baseline"] = round(r["value"] / base, 3) if base else 1.0
+    print(json.dumps(r))
+    sys.stdout.flush()
+    # survives re-exec: an emitted metric is never re-run (its line is
+    # already in the driver's captured stdout)
+    done = set(filter(None, os.environ.get("KFT_BENCH_DONE", "").split(",")))
+    done.add(r["metric"])
+    os.environ["KFT_BENCH_DONE"] = ",".join(sorted(done))
 
 
 def main() -> None:
-    import os
-
     if os.environ.get("KFT_BENCH_PLATFORM"):
         # debugging escape hatch (e.g. KFT_BENCH_PLATFORM=cpu when the TPU
         # tunnel is unavailable); config update, not env — see utils/device.py
@@ -116,16 +224,39 @@ def main() -> None:
 
         jax.config.update("jax_platforms", os.environ["KFT_BENCH_PLATFORM"])
 
+    # probe the backend up-front so init failures retry via re-exec before
+    # any bench work starts
+    try:
+        import jax
+
+        jax.devices()
+    except Exception as exc:  # noqa: BLE001
+        _reexec_retry(exc)  # only returns when out of attempts
+        _emit(_error_record("resnet50_images_per_sec_per_chip",
+                            "images/sec/chip", exc))
+        sys.exit(1)
+
     suite = "--suite" in sys.argv
     benches = [bench_mnist_mlp, bench_bert_base, bench_resnet50] if suite else [bench_resnet50]
+    already = set(filter(None, os.environ.get("KFT_BENCH_DONE", "").split(",")))
+    flagship_failed = None
     for bench in benches:
-        r = bench()
-        vs = (
-            round(r["value"] / BENCH_BASELINE_IMAGES_PER_SEC, 3)
-            if BENCH_BASELINE_IMAGES_PER_SEC and "resnet50" in r["metric"]
-            else 1.0
-        )
-        print(json.dumps({**r, "vs_baseline": vs}))
+        meta = {
+            bench_resnet50: ("resnet50_images_per_sec_per_chip", "images/sec/chip"),
+            bench_bert_base: ("bert_base_steps_per_sec", "steps/sec"),
+            bench_mnist_mlp: ("mnist_mlp_images_per_sec_per_chip", "images/sec/chip"),
+        }[bench]
+        if meta[0] in already:
+            continue  # emitted before a mid-suite re-exec
+        try:
+            _emit(bench())
+        except Exception as exc:  # noqa: BLE001 — one bench must not kill the rest
+            if _is_backend_init_error(exc):
+                _reexec_retry(exc)  # re-exec reruns the whole suite
+            _emit(_error_record(*meta, exc))
+            if bench is bench_resnet50:
+                flagship_failed = exc
+    sys.exit(1 if flagship_failed is not None else 0)
 
 
 if __name__ == "__main__":

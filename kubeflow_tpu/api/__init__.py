@@ -34,6 +34,9 @@ from kubeflow_tpu.api.jobs import (
     REPLICA_PS,
     REPLICA_MASTER,
     REPLICA_LAUNCHER,
+    REPLICA_SCHEDULER,
+    REPLICA_SERVER,
+    MXJob,
 )
 from kubeflow_tpu.api.validation import ValidationError, validate_job
 
@@ -65,4 +68,7 @@ __all__ = [
     "REPLICA_PS",
     "REPLICA_MASTER",
     "REPLICA_LAUNCHER",
+    "REPLICA_SCHEDULER",
+    "REPLICA_SERVER",
+    "MXJob",
 ]

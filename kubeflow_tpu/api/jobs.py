@@ -30,6 +30,8 @@ REPLICA_PS = "ps"
 REPLICA_MASTER = "master"
 REPLICA_LAUNCHER = "launcher"
 REPLICA_EVALUATOR = "evaluator"
+REPLICA_SCHEDULER = "scheduler"  # MXNet
+REPLICA_SERVER = "server"        # MXNet
 
 
 class JobKind(str, enum.Enum):
@@ -39,6 +41,7 @@ class JobKind(str, enum.Enum):
     MPI = "MPIJob"
     XGBOOST = "XGBoostJob"
     PADDLE = "PaddleJob"
+    MXNET = "MXJob"
 
 
 # Default rendezvous ports, matching the reference's per-framework defaults.
@@ -49,6 +52,7 @@ DEFAULT_PORTS = {
     JobKind.MPI: 22,
     JobKind.XGBOOST: 9991,
     JobKind.PADDLE: 36543,
+    JobKind.MXNET: 9091,     # mxnet scheduler (DMLC_PS_ROOT_PORT)
 }
 
 # Which replica type's completion decides job success, per kind
@@ -60,6 +64,7 @@ SUCCESS_REPLICA = {
     JobKind.MPI: REPLICA_LAUNCHER,
     JobKind.XGBOOST: REPLICA_MASTER,
     JobKind.PADDLE: REPLICA_MASTER,
+    JobKind.MXNET: REPLICA_WORKER,  # scheduler/server idle; workers decide
 }
 
 
@@ -152,6 +157,11 @@ class PaddleJob(TrainJob):
     kind: JobKind = JobKind.PADDLE
 
 
+@dataclass
+class MXJob(TrainJob):
+    kind: JobKind = JobKind.MXNET
+
+
 _KIND_TO_CLS = {
     JobKind.JAX: JAXJob,
     JobKind.TF: TFJob,
@@ -159,6 +169,7 @@ _KIND_TO_CLS = {
     JobKind.MPI: MPIJob,
     JobKind.XGBOOST: XGBoostJob,
     JobKind.PADDLE: PaddleJob,
+    JobKind.MXNET: MXJob,
 }
 
 

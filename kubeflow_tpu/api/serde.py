@@ -86,7 +86,7 @@ def _from_dict(cls: type, data: Any) -> Any:
 # apiserver (POST dispatch) and remote clients (apply), so they cannot drift.
 MANIFEST_KINDS = {
     "JAXJob": "jobs", "TFJob": "jobs", "PyTorchJob": "jobs", "MPIJob": "jobs",
-    "XGBoostJob": "jobs", "PaddleJob": "jobs",
+    "XGBoostJob": "jobs", "PaddleJob": "jobs", "MXJob": "jobs",
     "Experiment": "experiments",
     "InferenceService": "inferenceservices",
     "PodDefault": "poddefaults",

@@ -16,6 +16,8 @@ from kubeflow_tpu.api.jobs import (
     REPLICA_LAUNCHER,
     REPLICA_MASTER,
     REPLICA_PS,
+    REPLICA_SCHEDULER,
+    REPLICA_SERVER,
     REPLICA_WORKER,
     REPLICA_EVALUATOR,
     TrainJob,
@@ -31,6 +33,7 @@ VALID_REPLICA_TYPES = {
     JobKind.MPI: {REPLICA_LAUNCHER, REPLICA_WORKER},
     JobKind.XGBOOST: {REPLICA_MASTER, REPLICA_WORKER},
     JobKind.PADDLE: {REPLICA_MASTER, REPLICA_WORKER},
+    JobKind.MXNET: {REPLICA_SCHEDULER, REPLICA_SERVER, REPLICA_WORKER},
 }
 
 # TPU slice topologies valid for v5e (chips = product; SURVEY.md §2.2: the
@@ -95,6 +98,13 @@ def validate_job(job: TrainJob) -> TrainJob:
         if launcher is None or launcher.replicas != 1:
             raise ValidationError(
                 f"spec.replicaSpecs[{REPLICA_LAUNCHER}]", "MPIJob requires exactly one launcher"
+            )
+    if job.kind == JobKind.MXNET:
+        sched = job.spec.replica_specs.get(REPLICA_SCHEDULER)
+        if sched is None or sched.replicas != 1:
+            raise ValidationError(
+                f"spec.replicaSpecs[{REPLICA_SCHEDULER}]",
+                "MXJob requires exactly one scheduler",
             )
     if job.kind == JobKind.JAX:
         workers = job.spec.replica_specs.get(REPLICA_WORKER)

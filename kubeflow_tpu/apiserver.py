@@ -138,7 +138,7 @@ class PlatformServer:
             if cluster.get("jobs", f"{parts[3]}/{parts[4]}") is None:
                 return 404, {"error": f"job {parts[3]}/{parts[4]} not found"}
             pod_name = f"{parts[4]}-{query.get('replicaType', 'worker')}-{query.get('index', '0')}"
-            return 200, self.platform._read_pod_log(pod_name)  # raw text
+            return 200, self.platform._read_pod_log(pod_name, parts[3])  # raw text
         if kind == "jobs" and len(parts) == 6 and parts[5] == "scale" and method == "POST":
             from kubeflow_tpu.client import TrainingClient
 

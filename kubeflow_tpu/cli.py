@@ -5,6 +5,7 @@ CLIs (kfctl-era; SURVEY.md §2.7) against CR manifests. This CLI takes the
 same CR-shaped YAML (samples/) and drives the in-process platform one-shot:
 
   run          -f job.yaml        submit a TrainJob, wait, print verdict+logs
+  mpirun       -np N -- cmd ...   mpirun-shaped MPIJob launch (UX parity)
   validate     -f job.yaml        admission-check a manifest
   render-env   -f job.yaml        print the synthesized rendezvous env
   sweep        -f experiment.yaml run an Experiment, print the optimal trial
@@ -70,6 +71,64 @@ def cmd_run(args) -> int:
                 for i in range(rs.replicas):
                     print(f"--- {rtype}-{i} ---")
                     print(client.get_job_logs(job.name, job.namespace, rtype, i), end="")
+        return 0 if done.status.is_succeeded else 1
+
+
+def cmd_mpirun(args) -> int:
+    """mpirun-shaped launch UX (SURVEY.md §2.3 OpenMPI row): build an MPIJob
+    whose launcher runs the given command against a materialized hostfile,
+    with N idle workers forming the gang."""
+    from kubeflow_tpu.api import (
+        ContainerSpec,
+        ObjectMeta,
+        PodTemplateSpec,
+        ReplicaSpec,
+        RunPolicy,
+        CleanPodPolicy,
+        REPLICA_LAUNCHER,
+        REPLICA_WORKER,
+    )
+    from kubeflow_tpu.api.jobs import MPIJob, JAXJobSpec
+    from kubeflow_tpu.client import Platform, TrainingClient
+
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("mpirun: no command given (use: mpirun -np N -- cmd ...)",
+              file=sys.stderr)
+        return 2
+    args.cmd = cmd
+    job = MPIJob(
+        metadata=ObjectMeta(name=args.name),
+        spec=JAXJobSpec(
+            replica_specs={
+                REPLICA_LAUNCHER: ReplicaSpec(
+                    replicas=1,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(command=list(args.cmd))
+                    ),
+                ),
+                REPLICA_WORKER: ReplicaSpec(
+                    replicas=args.np,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(
+                            command=[sys.executable, "-c",
+                                     "import time; time.sleep(10**8)"]
+                        )
+                    ),
+                ),
+            },
+            run_policy=RunPolicy(clean_pod_policy=CleanPodPolicy.RUNNING),
+        ),
+    )
+    with Platform(capacity_chips=args.capacity_chips, log_dir=args.log_dir) as platform:
+        client = TrainingClient(platform)
+        client.create_job(job)
+        done = client.wait_for_job_conditions(
+            args.name, timeout_s=args.timeout
+        )
+        print(client.get_job_logs(args.name, rtype="launcher"), end="")
         return 0 if done.status.is_succeeded else 1
 
 
@@ -290,6 +349,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-f", "--filename", required=True)
     p.add_argument("--rtype", default="worker")
     p.add_argument("--index", type=int, default=0)
+
+    p = add("mpirun", cmd_mpirun,
+            help="mpirun-shaped MPIJob launch: mpirun -np N -- cmd ...")
+    p.add_argument("-np", type=int, default=2, help="number of workers")
+    p.add_argument("--name", default="mpirun")
+    p.add_argument("--timeout", type=float, default=3600.0)
+    p.add_argument("--capacity-chips", type=int, default=8)
+    p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="command to run on the launcher (after --)")
 
     p = add("sweep", cmd_sweep, help="run an Experiment manifest")
     p.add_argument("-f", "--filename", required=True)

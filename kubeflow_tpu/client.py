@@ -43,7 +43,8 @@ class Platform:
         self.gang_scheduler = GangScheduler(self.cluster)
         self.controller = JobController(self.cluster, workers=controller_workers)
         self.experiment_controller = ExperimentController(
-            self.cluster, log_reader=self._read_pod_log
+            self.cluster, log_reader=self._read_pod_log,
+            observation_db=str(Path(log_dir).parent / "sweep-observations.db"),
         )
         self.isvc_controller = InferenceServiceController(
             self.cluster,
@@ -62,8 +63,8 @@ class Platform:
             self.metrics_server = MetricsServer(self, port=port).start()
         return self.metrics_server.url
 
-    def _read_pod_log(self, pod_name: str) -> str:
-        path = self.pod_runtime.log_path(pod_name)
+    def _read_pod_log(self, pod_name: str, namespace: str = "default") -> str:
+        path = self.pod_runtime.log_path(pod_name, namespace)
         try:
             return path.read_text()
         except OSError:
@@ -228,7 +229,7 @@ class TrainingClient:
     def get_job_logs(
         self, name: str, namespace: str = "default", rtype: str = "worker", index: int = 0
     ) -> str:
-        path = self.platform.pod_runtime.log_path(f"{name}-{rtype}-{index}")
+        path = self.platform.pod_runtime.log_path(f"{name}-{rtype}-{index}", namespace)
         return Path(path).read_text() if Path(path).exists() else ""
 
     def get_events(self, name: str, namespace: str = "default") -> list:

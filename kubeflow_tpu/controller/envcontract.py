@@ -23,6 +23,7 @@ reference's own highest-value test pattern (SURVEY.md §4).
 from __future__ import annotations
 
 import json
+import os
 
 from kubeflow_tpu.api.jobs import (
     DEFAULT_PORTS,
@@ -31,6 +32,8 @@ from kubeflow_tpu.api.jobs import (
     REPLICA_LAUNCHER,
     REPLICA_MASTER,
     REPLICA_PS,
+    REPLICA_SCHEDULER,
+    REPLICA_SERVER,
     REPLICA_WORKER,
     REPLICA_EVALUATOR,
     TrainJob,
@@ -179,17 +182,51 @@ def mpi_hostfile(job: TrainJob, slots_per_worker: int = 1) -> str:
     )
 
 
+def mpi_hostfile_path(job: TrainJob) -> str:
+    """Where the job controller materializes the hostfile (the ConfigMap-
+    mount analogue): a per-job path every pod can read. Override the root
+    with KFTPU_STATE_DIR."""
+    root = os.environ.get("KFTPU_STATE_DIR", ".kubeflow_tpu")
+    return os.path.abspath(
+        os.path.join(
+            root, "mpi", job.metadata.namespace, job.metadata.name, "hostfile"
+        )
+    )
+
+
 def mpi_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
     rs = job.spec.replica_specs.get(REPLICA_WORKER)
     n = rs.replicas if rs else 0
     env = {
         "OMPI_MCA_orte_keep_fqdn_hostnames": "true",
-        "OMPI_MCA_orte_default_hostfile": "/etc/mpi/hostfile",
+        # the controller writes this file before any pod starts
+        # (jobcontroller._materialize_hostfile)
+        "OMPI_MCA_orte_default_hostfile": mpi_hostfile_path(job),
     }
     if rtype == REPLICA_LAUNCHER:
         env["OMPI_MCA_orte_set_default_slots"] = "1"
         env["MPI_NUM_WORKERS"] = str(n)
     return env
+
+
+# --------------------------------------------------------------------- MXJob
+
+def mxnet_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
+    """DMLC_* family (reference pkg/controller.v1/mxnet — SURVEY.md §2.1
+    XGBoost/Paddle/MXNet row): every process learns the scheduler's address,
+    its own role, and the server/worker counts."""
+    sched_host = job.replica_hostname(REPLICA_SCHEDULER, 0)
+    port = job_port(job, REPLICA_SCHEDULER)
+    servers = job.spec.replica_specs.get(REPLICA_SERVER)
+    workers = job.spec.replica_specs.get(REPLICA_WORKER)
+    return {
+        "DMLC_ROLE": rtype,
+        "DMLC_PS_ROOT_URI": sched_host,
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_SERVER": str(servers.replicas if servers else 0),
+        "DMLC_NUM_WORKER": str(workers.replicas if workers else 0),
+        "DMLC_USE_KUBERNETES": "1",
+    }
 
 
 # ------------------------------------------------------------ XGBoost / Paddle
@@ -243,6 +280,7 @@ _SYNTH = {
     JobKind.MPI: mpi_env,
     JobKind.XGBOOST: xgboost_env,
     JobKind.PADDLE: paddle_env,
+    JobKind.MXNET: mxnet_env,
 }
 
 

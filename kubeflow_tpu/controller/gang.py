@@ -86,8 +86,18 @@ class GangScheduler:
                     ]
                     if late:
                         # chip-reserved gangs already hold their whole slices;
-                        # count-sized gangs need capacity for the extras
-                        extra = 0 if pg.chips else len(late)
+                        # count-sized gangs need capacity for the extras.
+                        # Reservation is recomputed from members actually
+                        # covered (bound + late) so a member whose bind failed
+                        # and retries here is never charged twice.
+                        if pg.chips:
+                            extra = 0
+                        else:
+                            bound = sum(
+                                1 for p in self._members(pg) if p.status.node
+                            )
+                            held = self._bound_chips.get(pg.key, 0)
+                            extra = max(0, bound + len(late) - held)
                         used = sum(self._bound_chips.values())
                         if used + extra > self.cluster.capacity_chips:
                             self.cluster.record_event(

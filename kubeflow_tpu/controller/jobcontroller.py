@@ -115,6 +115,18 @@ class JobController(ControllerBase):
         """
         job: TrainJob | None = self.cluster.get("jobs", key, copy_obj=True)
         if job is None:
+            # GC analogue: reap anything that outlived (or raced) a deleted
+            # job — a reconcile pass holding a pre-delete snapshot may create
+            # pods after delete_job_cascade ran; their create events re-queue
+            # this key and land here
+            ns, name = key.split("/", 1)
+            for p in self.cluster.list(
+                "pods",
+                lambda p: p.metadata.labels.get(JOB_NAME_LABEL) == name
+                and p.metadata.namespace == ns,
+            ):
+                self.cluster.delete("pods", p.key)
+            self.cluster.delete("podgroups", key)
             self.exp.delete(key)
             self.wq.forget(key)
             self._resolvers.pop(key, None)
@@ -264,6 +276,8 @@ class JobController(ControllerBase):
         if resolver is None or _replica_signature(resolver.job) != _replica_signature(job):
             resolver = LocalResolver(job)
             self._resolvers[key] = resolver
+        if job.kind == JobKind.MPI:
+            self._materialize_hostfile(job, resolver)
         self.exp.expect_creations(key, len(to_create))
         for rtype, i in to_create:
             env = synthesize_env(job, rtype, i)
@@ -290,6 +304,24 @@ class JobController(ControllerBase):
             self.cluster.create("pods", pod)
             self.metrics["pods_created_total"] += 1
         return len(to_create)
+
+    def _materialize_hostfile(self, job: TrainJob, resolver) -> None:
+        """Write the MPI hostfile to its per-job path before any pod starts —
+        the ConfigMap-mount analogue (SURVEY.md §2.1 MPIJob row). Pods find
+        it via OMPI_MCA_orte_default_hostfile (envcontract.mpi_env)."""
+        from pathlib import Path
+
+        from kubeflow_tpu.controller.envcontract import (
+            mpi_hostfile,
+            mpi_hostfile_path,
+        )
+
+        content = mpi_hostfile(job)
+        if self.local_rewrite:
+            content = resolver.rewrite_text(content)
+        path = Path(mpi_hostfile_path(job))
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(content)
 
     def _ensure_podgroup(self, job: TrainJob) -> None:
         pg_key = f"{job.metadata.namespace}/{job.metadata.name}"

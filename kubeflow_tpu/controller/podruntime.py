@@ -125,7 +125,8 @@ class PodRuntime:
                     os.killpg(held_proc.pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
-            log_path = self.log_dir / f"{pod.metadata.name}.log"
+            log_path = self.log_path(pod.metadata.name, pod.metadata.namespace)
+            log_path.parent.mkdir(parents=True, exist_ok=True)
             env = dict(os.environ) if self.inherit_env else {}
             env.update(pod.env)
             try:
@@ -207,5 +208,7 @@ class PodRuntime:
             proc.send_signal(sig)
         return True
 
-    def log_path(self, pod_name: str) -> Path:
-        return self.log_dir / f"{pod_name}.log"
+    def log_path(self, pod_name: str, namespace: str = "default") -> Path:
+        # namespaced so same-named pods in two namespaces never share (and
+        # truncate) one log file — sweeps parse these for objective values
+        return self.log_dir / namespace / f"{pod_name}.log"

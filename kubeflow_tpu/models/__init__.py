@@ -11,6 +11,7 @@ from kubeflow_tpu.models.bert import (
     BertForMaskedLM,
     BertForSequenceClassification,
 )
+from kubeflow_tpu.models.bert_pp import BertPipelineClassifier
 from kubeflow_tpu.models.mnist import MnistCNN, MnistMLP
 from kubeflow_tpu.models.resnet import (
     ResNet,
@@ -26,6 +27,7 @@ __all__ = [
     "BertEncoder",
     "BertForMaskedLM",
     "BertForSequenceClassification",
+    "BertPipelineClassifier",
     "MnistMLP",
     "MnistCNN",
     "ResNet",

@@ -27,7 +27,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from kubeflow_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_DATA, AXIS_FSDP, AXIS_MODEL
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_MODEL,
+)
+from kubeflow_tpu.parallel.moe import MOE_PARTITION_RULES, MoeMlp
 
 # Param-path regex -> PartitionSpec. fsdp shards the "long" dim that the
 # model axis leaves free; tiny params (LayerNorm, biases) replicate via the
@@ -41,10 +48,13 @@ PARTITION_RULES: list[tuple[str, P]] = [
     (r"(position_embed|type_embed)/embedding$", P(None, AXIS_FSDP)),
     (r"pooler/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
     (r"mlm_dense/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
+    *MOE_PARTITION_RULES,
 ]
 
-# residual-stream activation layout: batch over data axes, hidden replicated
-ACT_SPEC = P((AXIS_DATA, AXIS_FSDP), AXIS_CONTEXT, None)
+# residual-stream activation layout: batch over data-like axes (expert
+# parallelism subdivides data parallelism — parallel/moe.py), hidden
+# replicated
+ACT_SPEC = P((AXIS_DATA, AXIS_FSDP, AXIS_EXPERT), AXIS_CONTEXT, None)
 
 
 def constrain(x: jax.Array, spec: P) -> jax.Array:
@@ -52,6 +62,33 @@ def constrain(x: jax.Array, spec: P) -> jax.Array:
     if jax.sharding.get_abstract_mesh().empty:
         return x
     return jax.lax.with_sharding_constraint(x, spec)
+
+
+class VocabEmbed(nn.Embed):
+    """nn.Embed that lowers the lookup to a one-hot matmul when the ambient
+    mesh shards the vocab dim over `model` (TP).
+
+    A plain gather over a vocab-sharded table cannot be partitioned by XLA's
+    SPMD pass — it falls back to rematerializing the full table on every
+    device (the round-1 "Involuntary full rematerialization" cliff). The
+    one-hot contraction is the Megatron/maxtext recipe: the table stays put,
+    XLA inserts one psum over `model`, and the matmul rides the MXU.
+    """
+
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh.empty:
+            return super().__call__(inputs)
+        (table,) = self.promote_dtype(self.embedding, dtype=self.dtype,
+                                      inexact=False)
+        if mesh.shape.get(AXIS_MODEL, 1) > 1:
+            onehot = jax.nn.one_hot(inputs, self.num_embeddings, dtype=table.dtype)
+            return jnp.dot(onehot, table)
+        # No vocab-dim sharding: all-gather any feature shards up-front (the
+        # FSDP gather-at-use contract) so the take sees a replicated operand
+        # and the partitioner never warns about resharding gather output.
+        table = constrain(table, P(None, None))
+        return jnp.take(table, inputs, axis=0)
 
 
 @dataclass(frozen=True)
@@ -67,6 +104,11 @@ class BertConfig:
     dtype: Any = jnp.float32
     attention: str = "dense"  # dense | ring | ulysses
     attention_block: int = 128  # ring attention KV block size
+    # MoE: 0 = dense MLP; >0 replaces every MLP with a MoeMlp of this many
+    # experts, dispatched over the `expert` mesh axis (parallel/moe.py)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
 
     @staticmethod
     def base(**kw) -> "BertConfig":
@@ -143,18 +185,29 @@ class BertLayer(nn.Module):
         y = nn.Dropout(c.dropout_rate, deterministic=not train)(y)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x + y)
         x = constrain(x, ACT_SPEC)
-        y = nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_up")(x)
-        y = nn.gelu(y)
-        y = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_down")(y)
+        if c.moe_experts:
+            y = MoeMlp(
+                hidden_size=c.hidden_size, mlp_dim=c.mlp_dim,
+                num_experts=c.moe_experts, top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
+                name="moe",
+            )(x)
+        else:
+            y = nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_up")(x)
+            y = nn.gelu(y)
+            y = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_down")(y)
         y = nn.Dropout(c.dropout_rate, deterministic=not train)(y)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x + y)
         return constrain(x, ACT_SPEC)
 
 
-class BertEncoder(nn.Module):
-    """Embeddings + transformer stack; returns (B, L, H) hidden states.
+class BertEmbeddings(nn.Module):
+    """Token + position + type embeddings with the post-embedding LN.
 
     token_embed can be a shared nn.Embed (weight tying with an MLM head).
+    Split out of BertEncoder so the pipeline-parallel model (bert_pp.py) can
+    run it outside the stage ring (boundary stages replicate, the stack
+    pipelines — the maxtext recipe).
     """
 
     cfg: BertConfig
@@ -163,21 +216,35 @@ class BertEncoder(nn.Module):
     @nn.compact
     def __call__(self, input_ids, train: bool = False, token_type_ids=None):
         c = self.cfg
-        mask = input_ids != c.pad_token_id
-        embed_mod = self.token_embed or nn.Embed(
+        embed_mod = self.token_embed or VocabEmbed(
             c.vocab_size, c.hidden_size, dtype=c.dtype, name="token_embed"
         )
         embed = embed_mod(input_ids)
         pos = jnp.arange(input_ids.shape[1])[None, :]
-        embed = embed + nn.Embed(c.max_len, c.hidden_size, dtype=c.dtype,
-                                 name="position_embed")(pos)
+        embed = embed + VocabEmbed(c.max_len, c.hidden_size, dtype=c.dtype,
+                                   name="position_embed")(pos)
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        embed = embed + nn.Embed(2, c.hidden_size, dtype=c.dtype,
-                                 name="type_embed")(token_type_ids)
+        embed = embed + VocabEmbed(2, c.hidden_size, dtype=c.dtype,
+                                   name="type_embed")(token_type_ids)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_embed")(embed)
         x = nn.Dropout(c.dropout_rate, deterministic=not train)(x)
-        x = constrain(x, ACT_SPEC)
+        return constrain(x, ACT_SPEC)
+
+
+class BertEncoder(nn.Module):
+    """Embeddings + transformer stack; returns (B, L, H) hidden states."""
+
+    cfg: BertConfig
+    token_embed: Any = None
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False, token_type_ids=None):
+        c = self.cfg
+        mask = input_ids != c.pad_token_id
+        x = BertEmbeddings(c, token_embed=self.token_embed, name="embeddings")(
+            input_ids, train, token_type_ids
+        )
         for i in range(c.num_layers):
             x = BertLayer(c, name=f"layer_{i}")(x, mask, train)
         return x
@@ -209,7 +276,7 @@ class BertForMaskedLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
         c = self.cfg
-        token_embed = nn.Embed(
+        token_embed = VocabEmbed(
             c.vocab_size, c.hidden_size, dtype=c.dtype, name="token_embed"
         )
         x = BertEncoder(c, token_embed=token_embed, name="encoder")(input_ids, train)

@@ -1,0 +1,184 @@
+"""Pipeline-parallel BERT — the encoder stack over the `pipeline` mesh axis.
+
+Reference parity: the reference pipelines models only inside user images
+(DeepSpeed/Megatron under PyTorchJob/MPIJob — SURVEY.md §2.2 PP row, §7
+hard-part 3); here PP is in-tree and composes with the Trainer.
+
+Layout (the maxtext recipe): embeddings and the classifier head are
+replicated over the `pipeline` axis and run outside the ring — they are
+cheap and their activation shapes differ from the stack's. The homogeneous
+transformer stack is split into `num_stages` chunks whose params are stacked
+on a leading stage axis sharded over `pipeline`; microbatches circulate via
+ppermute (parallel/pipeline.py). TP/FSDP/context shardings inside each stage
+stay fully automatic — the same PARTITION_RULES as dense BERT apply, lifted
+onto the stacked stage dim.
+
+This class is a flax-like duck type (init/apply/__call__) rather than an
+nn.Module: the ring runs under a partial-manual shard_map, which is cleaner
+composed functionally than through lifted flax transforms.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.models.bert import (
+    ACT_SPEC,
+    PARTITION_RULES,
+    BertConfig,
+    BertEmbeddings,
+    BertLayer,
+    constrain,
+)
+from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE
+from kubeflow_tpu.parallel.pipeline import gpipe
+
+# dense rules lifted onto stacked stage params (leading `pipeline` dim),
+# plus a catch-all so every stage param is at least stage-sharded
+PP_PARTITION_RULES: list[tuple[str, P]] = [
+    *[
+        (r"stages/.*" + pat, P(AXIS_PIPELINE, *spec))
+        for pat, spec in PARTITION_RULES
+    ],
+    (r"stages/", P(AXIS_PIPELINE)),
+    *PARTITION_RULES,
+]
+
+
+class _Stage(nn.Module):
+    """One pipeline stage: a chunk of BertLayers."""
+
+    cfg: BertConfig
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool = False):
+        for i in range(self.layers_per_stage):
+            x = BertLayer(self.cfg, name=f"layer_{i}")(x, mask, train)
+        return x
+
+
+class _Head(nn.Module):
+    """[CLS] pooler + classifier (outside the ring)."""
+
+    cfg: BertConfig
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cls = x[:, 0]
+        pooled = jnp.tanh(nn.Dense(self.cfg.hidden_size, dtype=self.cfg.dtype,
+                                   name="pooler")(cls))
+        pooled = nn.Dropout(self.cfg.dropout_rate, deterministic=not train)(pooled)
+        logits = nn.Dense(self.num_classes, dtype=self.cfg.dtype,
+                          name="classifier")(pooled)
+        return logits.astype(jnp.float32)
+
+
+class BertPipelineClassifier:
+    """Drop-in for BertForSequenceClassification with a pipelined stack.
+
+    Trainer-compatible duck type: init(rng, x, train=...) -> variables,
+    apply(variables, x, rngs=..., train=...) -> logits.
+    """
+
+    PARTITION_RULES = PP_PARTITION_RULES
+
+    def __init__(
+        self,
+        cfg: BertConfig,
+        num_classes: int = 2,
+        num_stages: int = 2,
+        n_micro: int | None = None,
+    ):
+        if cfg.num_layers % num_stages:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by "
+                f"num_stages {num_stages}"
+            )
+        if cfg.moe_experts:
+            raise NotImplementedError(
+                "MoE inside a pipeline stage is not supported yet"
+            )
+        self.cfg = cfg
+        self.num_classes = num_classes
+        self.num_stages = num_stages
+        # 2 microbatches per stage keeps the GPipe bubble under 1/3
+        self.n_micro = n_micro or 2 * num_stages
+        self._embed = BertEmbeddings(cfg)
+        self._stage = _Stage(cfg, cfg.num_layers // num_stages)
+        self._head = _Head(cfg, num_classes)
+
+    # Trainer introspects __call__ for the `train` kwarg
+    def __call__(self, input_ids, train: bool = False):  # pragma: no cover
+        raise NotImplementedError("use .apply()")
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, rng, input_ids, train: bool = False) -> dict:
+        e_rng, s_rng, h_rng, d_rng = jax.random.split(rng, 4)
+        c = self.cfg
+        ev = self._embed.init({"params": e_rng, "dropout": d_rng},
+                              input_ids, False)
+        x = jnp.zeros(
+            (input_ids.shape[0], input_ids.shape[1], c.hidden_size), c.dtype
+        )
+        mask = jnp.ones(input_ids.shape, bool)
+
+        def one_stage(r):
+            return self._stage.init({"params": r, "dropout": d_rng},
+                                    x, mask, False)["params"]
+
+        stage_params = jax.vmap(one_stage)(
+            jax.random.split(s_rng, self.num_stages)
+        )
+        hv = self._head.init({"params": h_rng, "dropout": d_rng}, x, False)
+        return {
+            "params": {
+                "embeddings": ev["params"],
+                "stages": stage_params,
+                "head": hv["params"],
+            }
+        }
+
+    # ----------------------------------------------------------------- apply
+
+    def apply(self, variables, input_ids, rngs=None, train: bool = False,
+              mutable=None, **_ignored):
+        out = self._apply(variables, input_ids, rngs=rngs, train=train)
+        # flax contract: apply with `mutable` returns (out, updates)
+        return (out, {}) if mutable is not None else out
+
+    def _apply(self, variables, input_ids, rngs=None, train: bool = False):
+        p = variables["params"]
+        c = self.cfg
+        rngs = rngs or {}
+        drop = rngs.get("dropout")
+        mask = input_ids != c.pad_token_id
+        x = self._embed.apply(
+            {"params": p["embeddings"]}, input_ids, train,
+            rngs={"dropout": drop} if (train and drop is not None) else {},
+        )
+
+        def stage_fn(sp, act, *, stage, rng):
+            h, m = act
+            srngs = {"dropout": rng} if (train and rng is not None) else {}
+            h = self._stage.apply({"params": sp}, h, m > 0, train, rngs=srngs)
+            return (constrain(h, ACT_SPEC), m)
+
+        out, _ = gpipe(
+            stage_fn,
+            p["stages"],
+            (x, mask.astype(jnp.int8)),
+            self.n_micro,
+            rng=drop if train else None,
+        )
+        return self._head.apply(
+            {"params": p["head"]}, out, train,
+            rngs={"dropout": drop} if (train and drop is not None) else {},
+        )

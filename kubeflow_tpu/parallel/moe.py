@@ -1,0 +1,164 @@
+"""Expert parallelism — mixture-of-experts dispatch over the `expert` axis.
+
+Reference parity: the reference has no in-platform MoE (DeepSpeed-MoE user
+images supply it — SURVEY.md §2.2 "Expert parallel (EP/MoE)"); here it is a
+first-class construct, TPU-first:
+
+  - EP is a subdivision of data parallelism (the Megatron/DeepSpeed-EP
+    layout): the batch is sharded over (data, fsdp, expert) and expert
+    weights over `expert`, so the token exchange is a true all-to-all that
+    rides ICI inside the expert group.
+  - The dispatch is a *partial-manual* shard_map over ONLY the `expert`
+    axis: `lax.all_to_all` is explicit (the one collective that matters),
+    while fsdp/model/context shardings inside the body stay automatic —
+    XLA still inserts the FSDP all-gathers and TP psums for the expert
+    matmuls. Scaling-book recipe, not hand-scheduled comms.
+  - Top-k softmax router (f32), capacity-factor slotting via cumsum
+    priority, dropped tokens pass through with zero combine weight (the
+    residual connection carries them), Switch-style load-balance aux loss.
+
+Capacity is per expert-shard-group: C = ceil(k * tokens * cf / E) where
+`tokens` is the token count the expert group sees (global over the auto
+data/fsdp axes — slot assignment is a global cumsum, GShard-style).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_MODEL,
+)
+
+# Param-path regex -> PartitionSpec for MoE params (merged into model rules).
+MOE_PARTITION_RULES: list[tuple[str, P]] = [
+    (r"moe/w_up$", P(AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL)),
+    (r"moe/b_up$", P(AXIS_EXPERT, AXIS_MODEL)),
+    (r"moe/w_down$", P(AXIS_EXPERT, AXIS_MODEL, AXIS_FSDP)),
+    (r"moe/b_down$", P(AXIS_EXPERT, AXIS_FSDP)),
+]
+
+
+def _route(logits: jax.Array, top_k: int, capacity: int):
+    """Shared routing math for both the sharded and dense paths.
+
+    logits: (T, E) f32. Returns (combine (T, E, C), dispatch (T, E, C) bool,
+    aux_loss scalar). Tokens beyond an expert's capacity are dropped (zero
+    combine weight); the caller's residual connection carries them through.
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx = jax.lax.top_k(probs, top_k)              # (T, K)
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(idx, e, dtype=logits.dtype)   # (T, K, E)
+
+    # Switch-transformer load balance: E * Σ_e fraction_of_tokens_e · mean_prob_e
+    frac = onehot[:, 0].mean(axis=0)                      # top-1 assignment share
+    aux = e * jnp.sum(frac * probs.mean(axis=0))
+
+    # slot position: cumsum priority in (token-major, then k) order
+    flat = onehot.reshape(t * top_k, e)
+    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat)     # (T*K, E)
+    pos = (pos_in_expert * flat).sum(-1).reshape(t, top_k).astype(jnp.int32)
+    keep = (pos < capacity).astype(logits.dtype)
+    slot = jax.nn.one_hot(pos, capacity, dtype=logits.dtype)  # (T, K, C)
+
+    combine = jnp.einsum("tke,tkc->tec", onehot * (gates * keep)[..., None], slot)
+    dispatch = jnp.einsum("tke,tkc->tec", onehot * keep[..., None], slot)
+    return combine, dispatch, aux
+
+
+class MoeMlp(nn.Module):
+    """Drop-in MoE replacement for a transformer MLP block.
+
+    __call__(x) with x: (B, L, H) returns (B, L, H); the load-balance aux
+    loss is sown into the 'losses' collection (the Trainer adds every
+    'losses' leaf to the objective).
+    """
+
+    hidden_size: int
+    mlp_dim: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h, f, e = self.hidden_size, self.mlp_dim, self.num_experts
+        router = self.param(
+            "router", nn.initializers.normal(stddev=0.02), (h, e), jnp.float32
+        )
+        init = nn.initializers.lecun_normal()
+        w_up = self.param("w_up", init, (e, h, f))
+        b_up = self.param("b_up", nn.initializers.zeros, (e, f))
+        w_down = self.param("w_down", init, (e, f, h))
+        b_down = self.param("b_down", nn.initializers.zeros, (e, h))
+
+        mesh = jax.sharding.get_abstract_mesh()
+        ep = 1 if mesh.empty else mesh.shape.get(AXIS_EXPERT, 1)
+        if e % ep:
+            raise ValueError(f"num_experts {e} not divisible by expert axis {ep}")
+
+        def ffn(xin, wu, bu, wd, bd):
+            """Per-expert FFN: xin (E?, C?, H) against stacked weights."""
+            y = jnp.einsum("ech,ehf->ecf", xin, wu.astype(xin.dtype))
+            y = nn.gelu(y + bu.astype(xin.dtype)[:, None, :])
+            y = jnp.einsum("ecf,efh->ech", y, wd.astype(xin.dtype))
+            return y + bd.astype(xin.dtype)[:, None, :]
+
+        def moe_body(xb, rw, wu, bu, wd, bd):
+            """Manual over `expert` only: xb (B/ep, L, H), wu (E/ep, H, F)."""
+            b, l, _ = xb.shape
+            t = b * l
+            cap = int(np.ceil(self.top_k * t * self.capacity_factor / e))
+            xt = xb.reshape(t, h)
+            logits = xt.astype(jnp.float32) @ rw
+            combine, dispatch, aux = _route(logits, self.top_k, cap)
+            combine = combine.astype(xt.dtype)
+            dispatch = dispatch.astype(xt.dtype)
+            expert_in = jnp.einsum("tec,th->ech", dispatch, xt)  # (E, C, H)
+            if ep > 1:
+                # exchange token slots: (E, C, H) -> (E/ep, ep*C, H); each
+                # group now holds every shard's slots for ITS experts
+                expert_in = jax.lax.all_to_all(
+                    expert_in, AXIS_EXPERT, split_axis=0, concat_axis=1, tiled=True
+                )
+            out = ffn(expert_in, wu, bu, wd, bd)
+            if ep > 1:
+                out = jax.lax.all_to_all(
+                    out, AXIS_EXPERT, split_axis=1, concat_axis=0, tiled=True
+                )
+            y = jnp.einsum("tec,ech->th", combine, out)
+            aux = jax.lax.pmean(aux, AXIS_EXPERT) if ep > 1 else aux
+            return y.reshape(b, l, h), aux
+
+        if mesh.empty or ep == 1:
+            y, aux = moe_body(x, router, w_up, b_up, w_down, b_down)
+        else:
+            y, aux = jax.shard_map(
+                moe_body,
+                mesh=mesh,
+                axis_names={AXIS_EXPERT},
+                in_specs=(
+                    P(AXIS_EXPERT, None, None),   # batch dim carries expert
+                    P(None, None),                # router replicated
+                    P(AXIS_EXPERT, None, None),
+                    P(AXIS_EXPERT, None),
+                    P(AXIS_EXPERT, None, None),
+                    P(AXIS_EXPERT, None),
+                ),
+                out_specs=(P(AXIS_EXPERT, None, None), P()),
+                check_vma=False,
+            )(x, router, w_up, b_up, w_down, b_down)
+        self.sow("losses", "moe_aux", aux,
+                 reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0)
+        return y

@@ -9,8 +9,21 @@ The reference has no in-platform PP (DeepSpeed/Megatron user images supply it
     applies itself to its current microbatch and the activation ring rotates
     one hop via ppermute (single-program — no MPMD runtime needed, cf. the
     MPMD PP paper in PAPERS.md for the road not taken),
+  - the shard_map is *partial-manual* over ONLY `pipeline`: the ppermute is
+    explicit, while data/fsdp/model/context shardings inside each stage stay
+    automatic — XLA still inserts the FSDP all-gathers and TP collectives
+    for the stage body. This is what lets a real (TP+FSDP-sharded) model
+    ride the pipeline, where the round-1 full-manual version could not.
   - reverse-mode autodiff through scan+ppermute yields the backward pipeline
-    automatically — no hand-written 1F1B schedule.
+    automatically — no hand-written 1F1B schedule. Stages are rematerialized
+    (jax.checkpoint) so live activation memory is O(microbatch), the GPipe
+    memory contract.
+
+Activations may be arbitrary pytrees (e.g. (hidden, mask)); every leaf must
+keep the same shape/dtype at every stage boundary — the circulating-ring
+shape contract. Heterogeneous per-stage *behavior* is supported by branching
+on the `stage` index passed to stage_fn (lax.switch over bodies); boundary
+layers with different shapes (embeddings, heads) run outside the ring.
 
 Bubble fraction is (S-1)/(T+S-1) as in GPipe; raise n_micro to amortize.
 """
@@ -26,94 +39,169 @@ from jax.sharding import PartitionSpec as P
 from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE
 
 
+def _pin(tree: Any, batch_dim: int) -> Any:
+    """Pin each leaf's batch dim to the data-like axes (auto axes inside the
+    partial-manual region); keeps the ring body's select/update ops on ONE
+    layout so the partitioner never falls back to full rematerialization."""
+    from kubeflow_tpu.parallel.sharding import BATCH_AXES
+
+    if jax.sharding.get_abstract_mesh().empty:
+        return tree
+
+    def one(a):
+        spec = [None] * jnp.ndim(a)
+        spec[batch_dim] = BATCH_AXES
+        return jax.lax.with_sharding_constraint(a, P(*spec))
+
+    return jax.tree.map(one, tree)
+
+
 def stack_stage_params(per_stage: list[Any]) -> Any:
     """Stack a list of per-stage param pytrees on a new leading stage axis."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
 
 
-def stage_pspec(params_stacked: Any) -> Any:
+def stage_pspec(params_stacked: Any, axis_name: str = AXIS_PIPELINE) -> Any:
     """PartitionSpec tree sharding the leading stage axis over `pipeline`."""
     return jax.tree.map(
-        lambda x: P(AXIS_PIPELINE, *([None] * (jnp.ndim(x) - 1))), params_stacked
+        lambda x: P(axis_name, *([None] * (jnp.ndim(x) - 1))), params_stacked
     )
 
 
-def gpipe(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
-    params_stacked: Any,
-    x: jax.Array,
-    n_micro: int,
-    axis_name: str = AXIS_PIPELINE,
-) -> jax.Array:
-    """Apply a pipeline of identical-signature stages to a global batch.
+def _n_stages(params_stacked: Any) -> int:
+    return jax.tree.leaves(params_stacked)[0].shape[0]
 
-    stage_fn(stage_params, activation) -> activation, same shape contract at
-    every stage boundary. params_stacked has leading dim n_stages (sharded
-    over `pipeline`); x is (B, ...) with B % n_micro == 0. Must run inside
-    jit under an ambient mesh containing the `pipeline` axis.
+
+def gpipe(
+    stage_fn: Callable,
+    params_stacked: Any,
+    x: Any,
+    n_micro: int,
+    *,
+    rng: jax.Array | None = None,
+    axis_name: str = AXIS_PIPELINE,
+    remat: bool = True,
+) -> Any:
+    """Apply a pipeline of stages to a global batch.
+
+    stage_fn(stage_params, activation, *, stage, rng) -> activation, where
+    `activation` is a pytree whose every leaf is (B, ...) with identical
+    shapes at all stage boundaries, `stage` is the stage index (traced
+    scalar — branch with lax.switch for heterogeneous stages) and `rng` is a
+    per-(stage, tick) PRNG key (None when `rng` is not given).
+    params_stacked has leading dim n_stages; with an ambient mesh whose
+    `pipeline` axis matches n_stages the stages run as a ppermute ring; with
+    pipeline=1 they run as a sequential scan (identical numerics). Batch
+    leaves may be sharded over the data-like mesh axes — those shardings
+    stay automatic inside the ring.
     """
     mesh = jax.sharding.get_abstract_mesh()
-    n_stages = mesh.shape[axis_name]
-    if x.shape[0] % n_micro:
-        raise ValueError(f"batch {x.shape[0]} not divisible by n_micro {n_micro}")
-    if n_stages == 1:
-        params0 = jax.tree.map(lambda p: p[0], params_stacked)
-        return stage_fn(params0, x)
+    n_stages = _n_stages(params_stacked)
+    pp = 1 if mesh.empty else mesh.shape.get(axis_name, 1)
+    leaves = jax.tree.leaves(x)
+    batch = leaves[0].shape[0]
+    if batch % n_micro:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
 
-    mb = x.shape[0] // n_micro
-    x_mb = x.reshape(n_micro, mb, *x.shape[1:])
+    body = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
 
-    def per_device(params_local, x_mb):
-        # params_local leading dim is 1 (this device's stage); squeeze it
+    if pp == 1:
+        # no pipeline axis: sequential scan over stages, same numerics
+        def seq_tick(carry, sp):
+            act, s = carry
+            r = None if rng is None else jax.random.fold_in(rng, s)
+            return (body(sp, act, stage=s, rng=r), s + 1), None
+
+        (out, _), _ = jax.lax.scan(
+            seq_tick, (x, jnp.int32(0)), params_stacked
+        )
+        return out
+    if n_stages != pp:
+        raise ValueError(
+            f"{n_stages} stages need pipeline axis {n_stages}, mesh has {pp}"
+        )
+
+    mb = batch // n_micro
+    x_mb = _pin(
+        jax.tree.map(lambda a: a.reshape(n_micro, mb, *a.shape[1:]), x),
+        batch_dim=1,
+    )
+
+    def per_stage(params_local, x_mb):
+        # params_local leading dim is 1 (this device group's stage)
         params = jax.tree.map(lambda p: p[0], params_local)
         stage = jax.lax.axis_index(axis_name)
-        ring = jax.lax.axis_size(axis_name)
+        ring = pp  # == n_stages, checked above
         perm = [(i, (i + 1) % ring) for i in range(ring)]
         ticks = n_micro + n_stages - 1
 
         def tick(carry, t):
             circ, outbuf = carry
-            # stage 0 ingests microbatch t (zeros after the last one);
-            # other stages consume what rotated in from the previous stage
+            # stage 0 ingests microbatch t (zeros after the last one, whose
+            # outputs are discarded); other stages consume what rotated in
             feed_idx = jnp.clip(t, 0, n_micro - 1)
-            feeding = (t < n_micro).astype(x_mb.dtype)
-            inp = jnp.where(
-                stage == 0,
-                jnp.take(x_mb, feed_idx, axis=0) * feeding,
-                circ,
+            inp = _pin(
+                jax.tree.map(
+                    lambda buf, c: jnp.where(
+                        stage == 0,
+                        jnp.take(buf, feed_idx, axis=0)
+                        * (t < n_micro).astype(buf.dtype),
+                        c,
+                    ),
+                    x_mb, circ,
+                ),
+                batch_dim=0,
             )
-            out = stage_fn(params, inp)
+            r = None if rng is None else jax.random.fold_in(
+                jax.random.fold_in(rng, stage), t
+            )
+            out = _pin(body(params, inp, stage=stage, rng=r), batch_dim=0)
             # last stage emits microbatch t-(S-1) once the pipe is full
             emit_idx = t - (n_stages - 1)
             is_emit = jnp.logical_and(stage == ring - 1, emit_idx >= 0)
             outbuf = jax.lax.cond(
                 is_emit,
-                lambda ob: jax.lax.dynamic_update_index_in_dim(
-                    ob, out, jnp.maximum(emit_idx, 0), 0
+                lambda ob: jax.tree.map(
+                    lambda o, b: jax.lax.dynamic_update_index_in_dim(
+                        b, o, jnp.maximum(emit_idx, 0), 0
+                    ),
+                    out, ob,
                 ),
                 lambda ob: ob,
                 outbuf,
             )
-            circ = jax.lax.ppermute(out, axis_name, perm)
-            return (circ, outbuf), None
+            circ = _pin(
+                jax.tree.map(
+                    lambda o: jax.lax.ppermute(o, axis_name, perm), out
+                ),
+                batch_dim=0,
+            )
+            return (circ, _pin(outbuf, batch_dim=1)), None
 
         init = (
-            jnp.zeros_like(x_mb[0]),
-            jnp.zeros((n_micro, *x_mb.shape[1:]), x_mb.dtype),
+            jax.tree.map(lambda a: jnp.zeros_like(a[0]), x_mb),
+            jax.tree.map(lambda a: jnp.zeros_like(a), x_mb),
         )
         (circ, outbuf), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
-        # only the last stage holds real outputs; psum broadcasts them so the
-        # result is replicated over the pipeline axis
-        outbuf = jnp.where(stage == ring - 1, outbuf, jnp.zeros_like(outbuf))
-        return jax.lax.psum(outbuf, axis_name)
+        # only the last stage holds real outputs; psum broadcasts them so
+        # the result is replicated over the pipeline axis
+        outbuf = jax.tree.map(
+            lambda b: jax.lax.psum(
+                jnp.where(stage == ring - 1, b, jnp.zeros_like(b)), axis_name
+            ),
+            outbuf,
+        )
+        return outbuf
 
-    pspec = jax.tree.map(
-        lambda x: P(axis_name, *([None] * (jnp.ndim(x) - 1))), params_stacked
-    )
     out_mb = jax.shard_map(
-        per_device,
-        in_specs=(pspec, P()),
-        out_specs=P(),
+        per_stage,
+        mesh=mesh,
+        axis_names={axis_name},
+        in_specs=(stage_pspec(params_stacked, axis_name),
+                  jax.tree.map(lambda _: P(), x_mb)),
+        out_specs=jax.tree.map(lambda _: P(), x_mb),
         check_vma=False,
     )(params_stacked, x_mb)
-    return out_mb.reshape(n_micro * mb, *out_mb.shape[2:])
+    return jax.tree.map(
+        lambda a: a.reshape(n_micro * mb, *a.shape[2:]), out_mb
+    )

@@ -21,14 +21,18 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeflow_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP
+from kubeflow_tpu.parallel.mesh import AXIS_DATA, AXIS_EXPERT, AXIS_FSDP
 
 Rules = Sequence[tuple[str, P]]
 
+# every data-like mesh axis the batch dim is split over; expert parallelism
+# subdivides data parallelism (parallel/moe.py), so `expert` rides along
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
+
 
 def batch_pspec() -> P:
-    """Leading (batch) dim split over data×fsdp; rest replicated."""
-    return P((AXIS_DATA, AXIS_FSDP))
+    """Leading (batch) dim split over the data-like axes; rest replicated."""
+    return P(BATCH_AXES)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -33,31 +33,34 @@ class LocalResolver:
             self.port_map[host] = free_port()
         return f"127.0.0.1:{self.port_map[host]}"
 
-    def rewrite_env(self, env: dict[str, str]) -> dict[str, str]:
-        """Replace every known hostname[:anyport] in env values with loopback.
+    def _hosts(self) -> list[str]:
+        """Populate the port map for every replica, longest hostname first
+        (so 'job-worker-1' never rewrites the prefix of 'job-worker-10')."""
+        for rtype, rs in self.job.spec.replica_specs.items():
+            for i in range(rs.replicas):
+                self.endpoint(rtype, i)
+        return sorted(self.port_map, key=len, reverse=True)
+
+    def _rewrite(self, text: str, hosts: list[str]) -> str:
+        import re
+
+        for host in hosts:
+            port = self.port_map[host]
+            text = re.sub(rf"{re.escape(host)}:\d+", f"127.0.0.1:{port}", text)
+            text = re.sub(rf"{re.escape(host)}(?![A-Za-z0-9.-])", "127.0.0.1", text)
+        return text
+
+    def rewrite_text(self, text: str) -> str:
+        """Replace every known hostname[:anyport] with loopback.
 
         A `host:port` occurrence maps to that host's unique loopback port
         (whatever framework port the contract used — 2222, 23456, ...), so
         per-replica endpoints stay distinct locally; a bare hostname maps to
-        127.0.0.1.
+        127.0.0.1. Used for env values and for materialized files (the MPI
+        hostfile).
         """
-        import re
+        return self._rewrite(text, self._hosts())
 
-        for rtype, rs in self.job.spec.replica_specs.items():
-            for i in range(rs.replicas):
-                self.endpoint(rtype, i)
-        # Longest-first + boundary lookahead so 'job-worker-1' never rewrites
-        # the prefix of 'job-worker-10' (hostname chars are [A-Za-z0-9.-]).
-        hosts = sorted(self.port_map, key=len, reverse=True)
-        out = {}
-        for k, v in env.items():
-            for host in hosts:
-                port = self.port_map[host]
-                v = re.sub(
-                    rf"{re.escape(host)}:\d+", f"127.0.0.1:{port}", v
-                )
-                v = re.sub(
-                    rf"{re.escape(host)}(?![A-Za-z0-9.-])", "127.0.0.1", v
-                )
-            out[k] = v
-        return out
+    def rewrite_env(self, env: dict[str, str]) -> dict[str, str]:
+        hosts = self._hosts()
+        return {k: self._rewrite(v, hosts) for k, v in env.items()}

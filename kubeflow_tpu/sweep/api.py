@@ -261,10 +261,28 @@ def validate_experiment(exp: Experiment) -> Experiment:
     if not exp.spec.objective.objective_metric_name:
         raise ValueError("experiment: objective.objectiveMetricName required")
     algo = exp.spec.algorithm.algorithm_name
-    if algo not in ("random", "grid", "tpe", "cmaes"):
+    if algo not in (
+        "random", "grid", "tpe", "cmaes",
+        "bayesianoptimization", "gp", "skopt", "hyperband",
+    ):
         raise ValueError(
-            f"experiment: unknown algorithm {algo!r} (random|grid|tpe|cmaes)"
+            f"experiment: unknown algorithm {algo!r} "
+            f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband)"
         )
+    if algo == "hyperband":
+        rp = exp.spec.algorithm.settings.get("resourceParameter", "")
+        by_name = {p.name: p for p in exp.spec.parameters}
+        if rp not in by_name:
+            raise ValueError(
+                "experiment: hyperband needs settings.resourceParameter "
+                "naming one of the experiment parameters"
+            )
+        if by_name[rp].parameter_type in (
+            ParameterType.CATEGORICAL, ParameterType.DISCRETE
+        ):
+            raise ValueError(
+                "experiment: the hyperband resource parameter must be numeric"
+            )
     if algo == "cmaes":
         for p in exp.spec.parameters:
             if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):

@@ -128,7 +128,13 @@ class SweepClient:
         self.work_dir.mkdir(parents=True, exist_ok=True)
         src = textwrap.dedent(inspect.getsource(objective_fn))
         casts = {p.name: _CAST[p.parameter_type] for p in parameters}
-        script = self.work_dir / f"{name}-trial.py"
+        # filename carries namespace + content hash: a re-tune with a changed
+        # objective (or a same-named tune in another namespace) must never
+        # overwrite the script that live trials are executing
+        import hashlib
+
+        digest = hashlib.sha256(src.encode()).hexdigest()[:12]
+        script = self.work_dir / f"{namespace}-{name}-{digest}-trial.py"
         script.write_text(
             src
             + textwrap.dedent(

@@ -47,9 +47,10 @@ class ExperimentController(ControllerBase):
     def __init__(
         self,
         cluster: FakeCluster,
-        log_reader: Callable[[str], str],
+        log_reader: Callable[[str, str], str],
         workers: int = 1,
         resync_period_s: float = 0.5,
+        observation_db: str | None = None,
     ):
         # resync doubles as the early-stopping poller: running trials' live
         # logs are only re-examined on reconcile
@@ -58,6 +59,10 @@ class ExperimentController(ControllerBase):
             wq_max_delay_s=5.0,
         )
         self.log_reader = log_reader
+        # durable observation log (katib db-manager parity, sweep/store.py);
+        # opened lazily so platforms that never sweep pay nothing
+        self._observation_db = observation_db
+        self._observations = None
         # finished trials' logs are immutable: cache their objective
         # timelines so the medianstop hot path isn't O(trials) file reads
         self._timeline_cache: dict[str, list[float]] = {}
@@ -111,6 +116,10 @@ class ExperimentController(ControllerBase):
             self.cluster.record_event("experiments", key, "ExperimentCreated", "created")
 
         trials = self._owned_trials(exp)
+        if not trials and not st.is_finished:
+            restored = self._restore_trials(exp)
+            if restored:
+                trials = self._owned_trials(exp)
         if st.is_finished:
             self._kill_running(exp, trials)
             return None
@@ -160,7 +169,11 @@ class ExperimentController(ControllerBase):
             return self._finish(
                 exp, key, trials, ExperimentCondition.SUCCEEDED, "GoalReached"
             )
-        if len(failed) > exp.spec.max_failed_trial_count:
+        # katib semantics: the experiment fails once the failed-trial count
+        # REACHES maxFailedTrialCount (inclusive bound); 0 = fail-fast on the
+        # first failure, negative = never fail on trial failures
+        fc = exp.spec.max_failed_trial_count
+        if fc >= 0 and len(failed) >= max(fc, 1):
             return self._finish(
                 exp, key, trials, ExperimentCondition.FAILED, "MaxFailedTrialsReached"
             )
@@ -188,6 +201,50 @@ class ExperimentController(ControllerBase):
         if _exp_fingerprint(st) != entry:
             self.cluster.update("experiments", exp)
         return 0.2 if created else None
+
+    # -------------------------------------------------- durable observations
+
+    def _store(self):
+        if self._observation_db and self._observations is None:
+            from kubeflow_tpu.sweep.store import ObservationStore
+
+            self._observations = ObservationStore(self._observation_db)
+        return self._observations
+
+    def _persist(self, exp: Experiment, trial: Trial) -> None:
+        store = self._store()
+        if store is not None and trial.status.is_finished:
+            try:
+                store.record(exp, trial)
+            except Exception as exc:  # noqa: BLE001 — durability is best-effort
+                self.cluster.record_event(
+                    "experiments", self.cluster._key(exp), "ObservationStoreError",
+                    f"{type(exc).__name__}: {exc}", type="Warning",
+                )
+
+    def _restore_trials(self, exp: Experiment) -> int:
+        store = self._store()
+        if store is None:
+            return 0
+        n = 0
+        for t in store.restore(exp):
+            try:
+                self.cluster.create("trials", t)
+                n += 1
+            except KeyError:
+                pass  # already present
+        if n:
+            self.cluster.record_event(
+                "experiments", self.cluster._key(exp), "HistoryRestored",
+                f"restored {n} finished trial(s) from the observation store",
+            )
+        return n
+
+    def stop(self) -> None:
+        super().stop()
+        if self._observations is not None:
+            self._observations.close()
+            self._observations = None
 
     # ------------------------------------------------------------- sub-steps
 
@@ -266,6 +323,7 @@ class ExperimentController(ControllerBase):
                 changed = True
         if changed:
             self.cluster.update("trials", trial)
+            self._persist(exp, trial)
 
     def _observe(self, exp: Experiment, trial: Trial):
         obj = exp.spec.objective
@@ -277,7 +335,8 @@ class ExperimentController(ControllerBase):
                 obj.objective_metric_name, obj.additional_metric_names,
             )
         log = self.log_reader(
-            f"{trial.metadata.name}-{exp.spec.metrics_replica_type}-0"
+            f"{trial.metadata.name}-{exp.spec.metrics_replica_type}-0",
+            trial.metadata.namespace,
         )
         return observation_from_log(
             log, obj.objective_metric_name, obj.additional_metric_names
@@ -339,6 +398,7 @@ class ExperimentController(ControllerBase):
                 tc.status.observation = self._observe(exp, t)
                 tc.status.completion_time = _now()
                 self.cluster.update("trials", tc)
+                self._persist(exp, tc)
                 self.metrics["trials_early_stopped_total"] += 1
                 self.cluster.record_event(
                     "trials", tkey, "EarlyStopped",
@@ -355,7 +415,8 @@ class ExperimentController(ControllerBase):
                 self._tfevents_dir(exp, trial), {name}
             ).get(name, [])
         log = self.log_reader(
-            f"{trial.metadata.name}-{exp.spec.metrics_replica_type}-0"
+            f"{trial.metadata.name}-{exp.spec.metrics_replica_type}-0",
+            trial.metadata.namespace,
         )
         return parse_metrics(log, {name}).get(name, [])
 
@@ -397,9 +458,13 @@ class ExperimentController(ControllerBase):
         history = []
         for t in trials:
             m = t.status.observation.metric(obj.objective_metric_name)
-            history.append(
-                (t.assignments_dict(), m.latest if m is not None else None)
-            )
+            if m is not None:
+                o = m.latest
+            elif t.status.is_finished:
+                o = float("nan")  # finished without objective: ranks worst
+            else:
+                o = None  # still running
+            history.append((t.assignments_dict(), o))
         seed = int(exp.spec.algorithm.settings.get(
             "seed", zlib.crc32(exp.metadata.name.encode()) & 0x7FFFFFFF
         ))
@@ -476,6 +541,7 @@ class ExperimentController(ControllerBase):
             tc.status.condition = TrialCondition.EARLY_STOPPED
             tc.status.completion_time = _now()
             self.cluster.update("trials", tc)
+            self._persist(exp, tc)
 
     def _finish(
         self,

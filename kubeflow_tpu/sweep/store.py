@@ -1,0 +1,117 @@
+"""Durable sweep observation store — katib db-manager parity.
+
+Reference parity (unverified cites, SURVEY.md §2.4): katib's metrics
+collector pushes ReportObservationLog over gRPC to cmd/db-manager, which
+persists observations in MySQL so experiment history survives controller
+restarts. Here finished trials are recorded into the native C++ metadata
+store (native/src/metastore.cc — the same store pipelines use for lineage),
+keyed by experiment spec fingerprint so a restarted platform that re-submits
+the SAME experiment resumes with its full trial history instead of re-running
+completed trials.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.sweep.api import (
+    Experiment,
+    Metric,
+    Observation,
+    ParameterAssignment,
+    Trial,
+    TrialCondition,
+    TrialSpec,
+    TrialStatus,
+)
+
+TRIAL_TYPE = "sweep.trial"
+
+
+def experiment_fingerprint(exp: Experiment) -> str:
+    """Stable hash of the search definition: same spec => same history."""
+    from kubeflow_tpu.api.serde import to_dict
+
+    spec = to_dict(exp.spec)
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+class ObservationStore:
+    def __init__(self, path: str):
+        from kubeflow_tpu.native import MetadataStore
+
+        self._ms = MetadataStore(path)
+        # name -> execution id, so repeated record() calls update in place
+        self._ids: dict[str, int] = {
+            r["name"]: int(r["id"])
+            for r in self._ms.list_executions(TRIAL_TYPE)
+        }
+
+    def close(self) -> None:
+        self._ms.close()
+
+    @staticmethod
+    def _name(exp: Experiment, trial_name: str) -> str:
+        return f"{exp.metadata.namespace}/{exp.metadata.name}/{trial_name}"
+
+    def record(self, exp: Experiment, trial: Trial) -> None:
+        """Persist a finished trial (idempotent upsert by name)."""
+        name = self._name(exp, trial.metadata.name)
+        props = json.dumps({
+            "fingerprint": experiment_fingerprint(exp),
+            "trial": trial.metadata.name,
+            "assignments": trial.assignments_dict(),
+            "metrics": [
+                {"name": m.name, "latest": m.latest, "min": m.min, "max": m.max}
+                for m in trial.status.observation.metrics
+            ],
+            "completion_time": trial.status.completion_time,
+        })
+        self._ids[name] = self._ms.put_execution(
+            TRIAL_TYPE, name, state=trial.status.condition.value, props=props,
+            id=self._ids.get(name, 0),
+        )
+
+    def restore(self, exp: Experiment) -> list[Trial]:
+        """Rebuild finished Trial objects recorded for this experiment.
+
+        Only records whose spec fingerprint matches are returned: a deleted-
+        and-recreated experiment with a different search space starts fresh.
+        """
+        prefix = f"{exp.metadata.namespace}/{exp.metadata.name}/"
+        fp = experiment_fingerprint(exp)
+        out = []
+        for rec in self._ms.list_executions(TRIAL_TYPE):
+            if not rec["name"].startswith(prefix):
+                continue
+            try:
+                props = json.loads(rec["props"])
+            except json.JSONDecodeError:
+                continue
+            if props.get("fingerprint") != fp:
+                continue
+            out.append(Trial(
+                metadata=ObjectMeta(
+                    name=props["trial"],
+                    namespace=exp.metadata.namespace,
+                    labels={"kubeflow-tpu.org/experiment-name": exp.metadata.name},
+                ),
+                spec=TrialSpec(
+                    parameter_assignments=[
+                        ParameterAssignment(name=k, value=v)
+                        for k, v in props.get("assignments", {}).items()
+                    ],
+                ),
+                status=TrialStatus(
+                    condition=TrialCondition(rec["state"]),
+                    observation=Observation(metrics=[
+                        Metric(**m) for m in props.get("metrics", [])
+                    ]),
+                    completion_time=props.get("completion_time", ""),
+                ),
+            ))
+        return sorted(out, key=lambda t: t.metadata.name)

@@ -1,15 +1,26 @@
-"""Suggestion algorithms: random, grid, TPE, CMA-ES.
+"""Suggestion algorithms: random, grid, TPE, CMA-ES, GP-Bayesian, hyperband.
 
 Reference parity (unverified cites, SURVEY.md §2.4): katib
-pkg/suggestion/v1beta1/{hyperopt,optuna}/service.py behind the Suggestion
-gRPC service. Here the algorithms are the same kind of code (Python), minus
-the Deployment/gRPC hop: a Suggester is a pure function of (space, history)
--> assignments, which also makes it deterministic and unit-testable.
+pkg/suggestion/v1beta1/{hyperopt,optuna,skopt,hyperband}/service.py behind
+the Suggestion gRPC service. Here the algorithms are the same kind of code
+(Python), minus the Deployment/gRPC hop: a Suggester is a pure function of
+(space, history) -> assignments, which also makes it deterministic and
+unit-testable.
 
 TPE follows Bergstra et al.'s tree-structured Parzen estimator recipe
 (split history at a quantile into good/bad, model each with a Parzen mixture,
 maximize the good/bad density ratio over sampled candidates) implemented
 with numpy only — independent per dimension, like hyperopt's default.
+
+GP-Bayesian (skopt parity) fits a Matérn-5/2 Gaussian process on the unit
+cube (one-hot categoricals) and maximizes expected improvement over random
+candidate draws — numpy-only, no scipy/skopt dependency.
+
+Hyperband replays successive-halving brackets from the trial history: rung-0
+configs come from an inner suggester, higher rungs promote the top 1/eta by
+objective at the next resource budget. Failed trials arrive as NaN
+objectives (worst rank, never promoted) so a crashed trial cannot stall a
+rung.
 """
 
 from __future__ import annotations
@@ -25,8 +36,15 @@ from kubeflow_tpu.sweep.api import (
     ParameterType,
 )
 
-# history entry: (assignments: dict[str, str], objective: float | None)
+# history entry: (assignments: dict[str, str], objective: float | None).
+# None = still running; NaN = finished without a usable objective (failed).
 History = list[tuple[dict[str, str], float | None]]
+
+
+def _finite(history: History) -> History:
+    return [
+        (a, o) for a, o in history if o is not None and not math.isnan(o)
+    ]
 
 
 def _format(p: ParameterSpec, v: float) -> str:
@@ -125,7 +143,7 @@ class TPESuggester:
         self._random = RandomSuggester(parameters, seed=seed + 1)
 
     def suggest(self, history: History, count: int) -> list[dict[str, str]]:
-        observed = [(a, o) for a, o in history if o is not None]
+        observed = _finite(history)
         if len(observed) < self.n_startup:
             return self._random.suggest(history, count)
         # Sort so "good" is always the head (minimize: ascending).
@@ -258,8 +276,8 @@ class CMAESSuggester:
 
         names = {p.name for p in self.parameters}
         observed = [
-            (a, o) for a, o in history
-            if o is not None and names <= set(a)  # tolerate foreign entries
+            (a, o) for a, o in _finite(history)
+            if names <= set(a)  # tolerate foreign entries
         ]
         # replay complete generations
         for g in range(len(observed) // lam):
@@ -309,6 +327,226 @@ class CMAESSuggester:
         return out
 
 
+class GPBayesSuggester:
+    """skopt-parity Bayesian optimization: Matérn-5/2 GP + expected
+    improvement, numpy-only.
+
+    Numeric parameters are normalized to [0,1]; categoricals one-hot encoded
+    (scaled by 0.5 so a category flip is comparable to a half-range numeric
+    move). EI is maximized over random candidate draws — cheap, and exact
+    enough at sweep scale (katib's skopt service samples similarly).
+    """
+
+    def __init__(
+        self,
+        parameters: list[ParameterSpec],
+        seed: int = 0,
+        objective_type: ObjectiveType = ObjectiveType.MAXIMIZE,
+        n_startup: int = 5,
+        n_candidates: int = 256,
+        length_scale: float = 0.25,
+        noise: float = 1e-6,
+        xi: float = 0.01,
+    ):
+        self.parameters = parameters
+        self.rng = np.random.default_rng(seed)
+        self.objective_type = objective_type
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.noise = noise
+        self.xi = xi
+        self._random = RandomSuggester(parameters, seed=seed + 1)
+
+    def _encode(self, a: dict[str, str]) -> np.ndarray:
+        parts = []
+        for p in self.parameters:
+            fs = p.feasible_space
+            if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+                choices = [str(v) for v in fs.list]
+                v = np.zeros(len(choices))
+                if a.get(p.name) in choices:
+                    v[choices.index(a[p.name])] = 0.5
+                parts.append(v)
+            else:
+                lo, hi = float(fs.min), float(fs.max)
+                span = (hi - lo) or 1.0
+                parts.append(
+                    np.array([(float(a.get(p.name, lo)) - lo) / span])
+                )
+        return np.concatenate(parts)
+
+    def _kernel(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        d = np.sqrt(
+            np.maximum(
+                ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1), 0.0
+            )
+        ) / self.length_scale
+        return (1 + np.sqrt(5) * d + 5 * d * d / 3) * np.exp(-np.sqrt(5) * d)
+
+    def suggest(self, history: History, count: int) -> list[dict[str, str]]:
+        observed = _finite(history)
+        if len(observed) < self.n_startup:
+            return self._random.suggest(history, count)
+        sign = 1.0 if self.objective_type == ObjectiveType.MINIMIZE else -1.0
+        X = np.stack([self._encode(a) for a, _ in observed])
+        y = np.array([sign * o for _, o in observed])  # GP minimizes
+        y_mean, y_std = y.mean(), y.std() or 1.0
+        yn = (y - y_mean) / y_std
+        K = self._kernel(X, X) + self.noise * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            return self._random.suggest(history, count)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        best = yn.min()
+
+        cands = self._random.suggest(history, self.n_candidates)
+        # dedupe against tried points (GP EI at a tried point is ~0 anyway,
+        # but exact repeats waste trials)
+        tried = {tuple(sorted(a.items())) for a, _ in observed}
+        cands = [c for c in cands if tuple(sorted(c.items())) not in tried]
+        if not cands:
+            return self._random.suggest(history, count)
+        Xc = np.stack([self._encode(c) for c in cands])
+        Kc = self._kernel(Xc, X)
+        mu = Kc @ alpha
+        v = np.linalg.solve(L, Kc.T)
+        var = np.maximum(
+            np.diag(self._kernel(Xc, Xc)) - (v * v).sum(0), 1e-12
+        )
+        sd = np.sqrt(var)
+        # expected improvement (minimization form), Phi/phi via erf
+        z = (best - self.xi - mu) / sd
+        Phi = 0.5 * (1 + np.vectorize(math.erf)(z / np.sqrt(2)))
+        phi = np.exp(-0.5 * z * z) / np.sqrt(2 * np.pi)
+        ei = (best - self.xi - mu) * Phi + sd * phi
+        order = np.argsort(-ei)
+        return [cands[i] for i in order[:count]]
+
+
+class HyperbandSuggester:
+    """Hyperband (successive halving) replayed from the trial history.
+
+    One parameter is the *resource* (settings["resourceParameter"], e.g.
+    epochs); its feasible min/max are the r/R budgets. Brackets run
+    s_max..0; rung 0 of a bracket samples fresh configs from an inner
+    suggester at the bracket's lowest budget, each higher rung re-runs the
+    top 1/eta configs (by objective) at eta× the budget. The replay walks
+    the (creation-ordered) history, so reconciliation stays stateless and
+    restart-safe, like the CMA-ES replay.
+    """
+
+    def __init__(
+        self,
+        parameters: list[ParameterSpec],
+        seed: int = 0,
+        objective_type: ObjectiveType = ObjectiveType.MAXIMIZE,
+        resource_parameter: str = "",
+        eta: int = 3,
+        inner: str = "random",
+    ):
+        if not resource_parameter:
+            raise ValueError(
+                "hyperband requires settings.resourceParameter naming the "
+                "budget parameter (e.g. epochs)"
+            )
+        by_name = {p.name: p for p in parameters}
+        if resource_parameter not in by_name:
+            raise ValueError(
+                f"resourceParameter {resource_parameter!r} is not an "
+                f"experiment parameter"
+            )
+        rp = by_name[resource_parameter]
+        if rp.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+            raise ValueError("the resource parameter must be numeric")
+        self.resource = rp
+        self.eta = eta
+        self.objective_type = objective_type
+        self.config_params = [p for p in parameters if p.name != rp.name]
+        self._inner = get_suggester(
+            inner, self.config_params, seed=seed, objective_type=objective_type
+        )
+        self.r_min = float(rp.feasible_space.min)
+        self.r_max = float(rp.feasible_space.max)
+        self.s_max = int(math.floor(
+            math.log(max(self.r_max / max(self.r_min, 1e-12), 1.0), eta)
+        ))
+
+    # ------------------------------------------------------------- schedule
+
+    def brackets(self) -> list[list[tuple[int, float]]]:
+        """[(n_configs, budget) per rung] per bracket, s_max..0."""
+        out = []
+        for s in range(self.s_max, -1, -1):
+            n = int(math.ceil((self.s_max + 1) / (s + 1) * self.eta ** s))
+            r = self.r_max * self.eta ** (-s)
+            rungs = []
+            for i in range(s + 1):
+                n_i = max(1, int(math.floor(n * self.eta ** (-i))))
+                rungs.append((n_i, r * self.eta ** i))
+            out.append(rungs)
+        return out
+
+    def total_trials(self) -> int:
+        return sum(n for b in self.brackets() for n, _ in b)
+
+    def _fmt_resource(self, budget: float) -> str:
+        return _format(self.resource, _snap_step(self.resource, budget))
+
+    def _config_key(self, a: dict[str, str]) -> tuple:
+        return tuple(
+            sorted((k, v) for k, v in a.items() if k != self.resource.name)
+        )
+
+    def suggest(self, history: History, count: int) -> list[dict[str, str]]:
+        idx = 0
+        sign = 1.0 if self.objective_type == ObjectiveType.MINIMIZE else -1.0
+        for rungs in self.brackets():
+            prev_rung: History = []
+            for i, (n_i, budget) in enumerate(rungs):
+                entries = history[idx: idx + n_i]
+                if len(entries) < n_i:
+                    missing = n_i - len(entries)
+                    if i == 0:
+                        fresh = self._inner.suggest(
+                            [  # inner model learns from all finished trials
+                                (a, o) for a, o in _finite(history)
+                            ],
+                            min(missing, count),
+                        )
+                        return [
+                            {**a, self.resource.name: self._fmt_resource(budget)}
+                            for a in fresh
+                        ]
+                    # promotion rung: requires the rung below fully observed
+                    if any(o is None for _, o in prev_rung):
+                        return []  # wait for stragglers
+                    ranked = sorted(
+                        prev_rung,
+                        key=lambda h: (
+                            math.inf if math.isnan(h[1]) else sign * h[1]
+                        ),
+                    )
+                    started = {self._config_key(a) for a, _ in entries}
+                    promos = []
+                    for a, _ in ranked:
+                        if self._config_key(a) in started:
+                            continue
+                        promos.append(
+                            {**{k: v for k, v in a.items()
+                                if k != self.resource.name},
+                             self.resource.name: self._fmt_resource(budget)}
+                        )
+                        started.add(self._config_key(a))
+                        if len(promos) >= min(missing, count):
+                            break
+                    return promos
+                idx += n_i
+                prev_rung = entries
+        return []  # every bracket complete
+
+
 def get_suggester(
     name: str,
     parameters: list[ParameterSpec],
@@ -342,6 +580,26 @@ def get_suggester(
             popsize=int(settings["popsize"]) if "popsize" in settings else None,
             sigma0=float(settings.get("sigma", 0.3)),
         )
+    if name in ("bayesianoptimization", "gp", "skopt"):
+        return GPBayesSuggester(
+            parameters,
+            seed=seed,
+            objective_type=objective_type,
+            n_startup=int(settings.get("nStartup", 5)),
+            n_candidates=int(settings.get("nCandidates", 256)),
+            length_scale=float(settings.get("lengthScale", 0.25)),
+            xi=float(settings.get("xi", 0.01)),
+        )
+    if name == "hyperband":
+        return HyperbandSuggester(
+            parameters,
+            seed=seed,
+            objective_type=objective_type,
+            resource_parameter=settings.get("resourceParameter", ""),
+            eta=int(settings.get("eta", 3)),
+            inner=settings.get("inner", "random"),
+        )
     raise ValueError(
-        f"unknown suggestion algorithm {name!r} (random|grid|tpe|cmaes)"
+        f"unknown suggestion algorithm {name!r} "
+        f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband)"
     )

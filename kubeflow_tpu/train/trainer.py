@@ -17,6 +17,7 @@ device-flag-selectable equivalent (north-star configs #1-#3).
 from __future__ import annotations
 
 import inspect
+import math
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -116,9 +117,13 @@ class Trainer:
         variables = {"params": params, **extra}
         kwargs = {"train": train} if self._accepts_train else {}
         rngs = {"dropout": rng}
-        if train and extra:
+        if train:
+            # 'losses' is a write-only output collection (MoE aux etc.);
+            # it is popped before state update (sow would otherwise
+            # accumulate across steps if fed back in via variables)
+            mutable = list(extra) + ["losses"]
             logits, updates = self.model.apply(
-                variables, x, rngs=rngs, mutable=list(extra), **kwargs
+                variables, x, rngs=rngs, mutable=mutable, **kwargs
             )
             return logits, dict(updates)
         return self.model.apply(variables, x, rngs=rngs, **kwargs), extra
@@ -141,6 +146,7 @@ class Trainer:
         kwargs = {"train": False} if self._accepts_train else {}
         variables = dict(self.model.init(p_rng, x, **kwargs))
         params = variables.pop("params")
+        variables.pop("losses", None)  # output collection, not state
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -166,7 +172,16 @@ class Trainer:
 
         def loss_of(params):
             logits, new_extra = self.apply_fn(params, state.extra, x, step_rng, True)
-            return self.loss_fn(logits.astype(jnp.float32), y), (logits, new_extra)
+            loss = self.loss_fn(logits.astype(jnp.float32), y)
+            # auxiliary objectives sown into the 'losses' collection (e.g.
+            # MoE load-balance, parallel/moe.py) join the objective here;
+            # popped so they never persist into TrainState.extra
+            aux = new_extra.pop("losses", None) if isinstance(new_extra, dict) else None
+            if aux:
+                loss = loss + sum(
+                    jnp.asarray(a, jnp.float32) for a in jax.tree.leaves(aux)
+                )
+            return loss, (logits, new_extra)
 
         (loss, (logits, new_extra)), grads = jax.value_and_grad(
             loss_of, has_aux=True
@@ -312,7 +327,9 @@ class Trainer:
         c = self.config
         bs = min(c.batch_size, len(dataset.x_test))
         # round bs down to a multiple of the batch-sharding divisor
-        div = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        from kubeflow_tpu.parallel.sharding import BATCH_AXES
+
+        div = math.prod(self.mesh.shape[a] for a in BATCH_AXES)
         bs = max(div, (bs // div) * div)
         tot_loss, correct, count = 0.0, 0, 0
         # tail batch is zero-padded to the static shape and masked, keeping

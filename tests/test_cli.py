@@ -99,3 +99,23 @@ class TestPipelineVerbs:
         result = json.loads(capsys.readouterr().out)
         assert result["state"] == "Succeeded"
         assert result["output"] == 20.0
+
+
+def test_mpirun_launch(tmp_path, monkeypatch, capsys):
+    """mpirun-shaped UX: launcher runs the command, reads the real hostfile."""
+    monkeypatch.setenv("KFTPU_STATE_DIR", str(tmp_path / "state"))
+    script = tmp_path / "launcher.py"
+    script.write_text(
+        "import os\n"
+        "hf = os.environ['OMPI_MCA_orte_default_hostfile']\n"
+        "print('hosts:', len(open(hf).read().strip().splitlines()))\n"
+    )
+    from kubeflow_tpu.cli import main
+
+    rc = main([
+        "mpirun", "-np", "2", "--name", "clidemo",
+        "--log-dir", str(tmp_path / "pod-logs"),
+        "--", sys.executable, str(script),
+    ])
+    assert rc == 0
+    assert "hosts: 2" in capsys.readouterr().out

@@ -165,7 +165,38 @@ class TestMPI:
         job = _job(MPIJob, "bert", {REPLICA_LAUNCHER: 1, REPLICA_WORKER: 3})
         env = envcontract.mpi_env(job, REPLICA_LAUNCHER, 0)
         assert env["MPI_NUM_WORKERS"] == "3"
-        assert env["OMPI_MCA_orte_default_hostfile"] == "/etc/mpi/hostfile"
+        # the hostfile points at the per-job path the controller materializes
+        assert env["OMPI_MCA_orte_default_hostfile"] == (
+            envcontract.mpi_hostfile_path(job)
+        )
+        assert env["OMPI_MCA_orte_default_hostfile"].endswith(
+            "mpi/default/bert/hostfile"
+        )
+
+    def test_hostfile_path_respects_state_dir(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("KFTPU_STATE_DIR", str(tmp_path))
+        job = _job(MPIJob, "bert", {REPLICA_LAUNCHER: 1, REPLICA_WORKER: 1})
+        assert envcontract.mpi_hostfile_path(job) == str(
+            tmp_path / "mpi" / "default" / "bert" / "hostfile"
+        )
+
+
+class TestMXNet:
+    def test_dmlc_env(self):
+        from kubeflow_tpu.api.jobs import MXJob, REPLICA_SCHEDULER, REPLICA_SERVER
+
+        job = _job(
+            MXJob, "mx",
+            {REPLICA_SCHEDULER: 1, REPLICA_SERVER: 2, REPLICA_WORKER: 3},
+        )
+        env = envcontract.mxnet_env(job, REPLICA_WORKER, 1)
+        assert env["DMLC_ROLE"] == "worker"
+        assert env["DMLC_PS_ROOT_URI"] == "mx-scheduler-0.mx.default"
+        assert env["DMLC_PS_ROOT_PORT"] == "9091"
+        assert env["DMLC_NUM_SERVER"] == "2"
+        assert env["DMLC_NUM_WORKER"] == "3"
+        sched = envcontract.mxnet_env(job, REPLICA_SCHEDULER, 0)
+        assert sched["DMLC_ROLE"] == "scheduler"
 
 
 class TestXGBoost:

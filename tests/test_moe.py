@@ -1,0 +1,142 @@
+"""MoE / expert-parallel tests (SURVEY.md §2.2 EP row).
+
+Numerics strategy: the dense (no-mesh) path is validated against a brute
+-force per-token loop; the expert-sharded path (expert=2 on the 8-device CPU
+mesh) must match the dense path bit-for-bit modulo reduction order.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.moe import MoeMlp, _route
+
+
+H, F, E, K = 8, 16, 4, 2
+
+
+def _mk(batch=4, seq=6, seed=0):
+    rng = jax.random.PRNGKey(seed)
+    x = jax.random.normal(rng, (batch, seq, H), jnp.float32)
+    mod = MoeMlp(hidden_size=H, mlp_dim=F, num_experts=E, top_k=K,
+                 capacity_factor=4.0)  # ample capacity: no drops
+    variables = mod.init(jax.random.PRNGKey(1), x)
+    return mod, variables, x
+
+
+def _brute_force(params, x):
+    """Per-token top-k routing computed with plain numpy loops."""
+    b, l, h = x.shape
+    xt = np.asarray(x, np.float64).reshape(-1, h)
+    logits = xt @ np.asarray(params["router"], np.float64)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    out = np.zeros_like(xt)
+    for t in range(xt.shape[0]):
+        top = np.argsort(-probs[t])[:K]
+        gates = probs[t][top] / probs[t][top].sum()
+        for gate, e in zip(gates, top):
+            y = xt[t] @ np.asarray(params["w_up"][e], np.float64) + np.asarray(
+                params["b_up"][e], np.float64
+            )
+            # flax nn.gelu default is the tanh approximation
+            y = 0.5 * y * (1 + np.tanh(np.sqrt(2 / np.pi) * (y + 0.044715 * y**3)))
+            y = y @ np.asarray(params["w_down"][e], np.float64) + np.asarray(
+                params["b_down"][e], np.float64
+            )
+            out[t] += gate * y
+    return out.reshape(b, l, h)
+
+
+class TestRouting:
+    def test_no_drops_at_ample_capacity(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (12, E))
+        combine, dispatch, _ = _route(logits, K, capacity=12 * K)
+        # every token keeps exactly K slots with weights summing to 1
+        slots = dispatch.sum(axis=(1, 2))
+        np.testing.assert_allclose(np.asarray(slots), K)
+        np.testing.assert_allclose(
+            np.asarray(combine.sum(axis=(1, 2))), 1.0, rtol=1e-5
+        )
+
+    def test_capacity_drops_lowest_priority(self):
+        # all tokens prefer expert 0 -> only `capacity` of them keep slot 0
+        logits = jnp.tile(jnp.array([[10.0, 0.0, 0.0, 0.0]]), (6, 1))
+        combine, dispatch, _ = _route(logits, 1, capacity=2)
+        kept = np.asarray(dispatch[:, 0, :].sum(axis=-1))
+        np.testing.assert_array_equal(kept, [1, 1, 0, 0, 0, 0])
+
+    def test_aux_loss_prefers_balance(self):
+        t = 64
+        rng = jax.random.PRNGKey(0)
+        uniform = jax.random.normal(rng, (t, E)) * 0.01
+        skewed = uniform.at[:, 0].add(5.0)  # everything routed to expert 0
+        _, _, aux_u = _route(uniform, 1, capacity=t)
+        _, _, aux_s = _route(skewed, 1, capacity=t)
+        assert float(aux_u) < float(aux_s)
+        assert float(aux_u) == pytest.approx(1.0, rel=0.1)
+
+
+class TestMoeMlp:
+    def test_dense_path_matches_brute_force(self):
+        mod, variables, x = _mk()
+        y = mod.apply(variables, x)
+        ref = _brute_force(variables["params"], x)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+
+    def test_expert_sharded_matches_dense(self, cpu_devices):
+        mod, variables, x = _mk(batch=8, seq=4)
+        dense = mod.apply(variables, x)
+
+        mesh = build_mesh(MeshConfig(data=2, fsdp=2, expert=2), cpu_devices[:8])
+        with jax.set_mesh(mesh):
+            xs = jax.device_put(
+                x,
+                jax.sharding.NamedSharding(
+                    mesh, P(("data", "fsdp", "expert"), None, None)
+                ),
+            )
+            sharded = jax.jit(mod.apply)(variables, xs)
+        np.testing.assert_allclose(
+            np.asarray(sharded), np.asarray(dense), rtol=2e-4, atol=2e-4
+        )
+
+    def test_aux_loss_sown(self):
+        mod, variables, x = _mk()
+        _, updates = mod.apply(variables, x, mutable=["losses"])
+        leaves = jax.tree.leaves(updates["losses"])
+        assert len(leaves) == 1 and np.isfinite(float(leaves[0]))
+
+
+class TestMoeBert:
+    def test_bert_moe_trains_on_expert_mesh(self, cpu_devices):
+        from kubeflow_tpu.models import BertConfig, BertForSequenceClassification
+        from kubeflow_tpu.train import Trainer, TrainerConfig
+        from kubeflow_tpu.train.data import synthetic_text_dataset
+
+        cfg = BertConfig.tiny(dropout_rate=0.0, moe_experts=4)
+        mesh = build_mesh(MeshConfig(data=2, fsdp=1, expert=2, model=2),
+                          cpu_devices[:8])
+        bs = 8
+        ds = synthetic_text_dataset(n_train=bs * 2, n_test=bs, seq_len=16,
+                                    vocab_size=cfg.vocab_size)
+        trainer = Trainer(
+            BertForSequenceClassification(cfg, num_classes=2),
+            TrainerConfig(batch_size=bs, steps=2, log_every_steps=10**9),
+            mesh=mesh,
+        )
+        state = trainer.init_state(ds.x_train[:bs])
+        # expert weights must actually be sharded over the expert axis
+        wu = state.params["encoder"]["layer_0"]["moe"]["w_up"]
+        assert wu.sharding.spec[0] == "expert"
+        losses = []
+        for _ in range(3):
+            state, m = trainer.train_step(
+                state, (ds.x_train[:bs], ds.y_train[:bs])
+            )
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(v) for v in losses)
+        assert losses[-1] < losses[0]  # aux + task loss both optimizable

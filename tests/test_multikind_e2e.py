@@ -58,16 +58,23 @@ def _replicas(tmp_path, job_name, groups):
 
 
 class TestMPIJob:
-    def test_launcher_decides_workers_reaped(self, client, tmp_path):
+    def test_launcher_decides_workers_reaped(self, client, tmp_path, monkeypatch):
+        monkeypatch.setenv("KFTPU_STATE_DIR", str(tmp_path / "state"))
         job = MPIJob(
             metadata=ObjectMeta(name="mpi1"),
             spec=JAXJobSpec(
                 replica_specs=_replicas(
                     tmp_path, "mpi1",
                     {
+                        # the launcher reads the REAL hostfile off disk — the
+                        # ConfigMap-mount analogue the controller materializes
                         REPLICA_LAUNCHER: (1, """
                             import os
                             assert os.environ["MPI_NUM_WORKERS"] == "2"
+                            hf = os.environ["OMPI_MCA_orte_default_hostfile"]
+                            lines = open(hf).read().strip().splitlines()
+                            assert len(lines) == 2, lines
+                            assert all("slots=" in l for l in lines), lines
                             print("mpirun done")
                         """),
                         # workers idle like sshd; must be reaped on success
@@ -93,6 +100,38 @@ class TestMPIJob:
                 return
             time.sleep(0.2)
         pytest.fail(f"workers not reaped: {[p.metadata.name for p in live]}")
+
+
+class TestMXJob:
+    def test_workers_decide_scheduler_reaped(self, client, tmp_path):
+        from kubeflow_tpu.api.jobs import MXJob
+        from kubeflow_tpu.api import REPLICA_SCHEDULER, REPLICA_SERVER
+
+        job = MXJob(
+            metadata=ObjectMeta(name="mx1"),
+            spec=JAXJobSpec(
+                replica_specs=_replicas(
+                    tmp_path, "mx1",
+                    {
+                        REPLICA_SCHEDULER: (1, "import time; time.sleep(300)"),
+                        REPLICA_SERVER: (1, "import time; time.sleep(300)"),
+                        REPLICA_WORKER: (2, """
+                            import os
+                            assert os.environ["DMLC_ROLE"] == "worker"
+                            assert os.environ["DMLC_NUM_WORKER"] == "2"
+                            assert os.environ["DMLC_NUM_SERVER"] == "1"
+                            assert os.environ["DMLC_PS_ROOT_URI"]
+                            print("mx worker done")
+                        """),
+                    },
+                ),
+                run_policy=RunPolicy(clean_pod_policy=CleanPodPolicy.RUNNING),
+            ),
+        )
+        client.create_job(job)
+        done = client.wait_for_job_conditions("mx1", timeout_s=60)
+        assert done.status.is_succeeded
+        assert "mx worker done" in client.get_job_logs("mx1", rtype="worker")
 
 
 class TestTFJob:

@@ -11,7 +11,7 @@ from kubeflow_tpu.parallel.pipeline import gpipe, stack_stage_params
 N_STAGES, HIDDEN, BATCH = 4, 16, 8
 
 
-def stage_fn(params, x):
+def stage_fn(params, x, *, stage=None, rng=None):
     return jnp.tanh(x @ params["w"] + params["b"])
 
 
@@ -30,6 +30,62 @@ def sequential(per_stage, x):
     for p in per_stage:
         x = stage_fn(p, x)
     return x
+
+
+def test_gpipe_pytree_activations():
+    """Activations may be pytrees (e.g. (hidden, mask)) — every leaf rides
+    the ring."""
+    per_stage = make_params()
+    x = jnp.asarray(
+        np.random.RandomState(3).normal(0, 1, (BATCH, HIDDEN)).astype(np.float32)
+    )
+    m = jnp.ones((BATCH,), jnp.int8)
+
+    def tree_stage(params, act, *, stage, rng):
+        h, mask = act
+        return stage_fn(params, h), mask
+
+    stacked = stack_stage_params(per_stage)
+    mesh = build_mesh(MeshConfig(data=2, pipeline=4))
+    with jax.set_mesh(mesh):
+        got_h, got_m = jax.jit(
+            lambda p, a: gpipe(tree_stage, p, a, n_micro=4)
+        )(stacked, (x, m))
+    np.testing.assert_allclose(
+        np.asarray(got_h), np.asarray(sequential(per_stage, x)), atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(got_m), np.asarray(m))
+
+
+def test_gpipe_heterogeneous_stage_behavior():
+    """Per-stage behavior can branch on the stage index (lax.switch)."""
+    per_stage = make_params()
+
+    def het_stage(params, x, *, stage, rng):
+        # even stages tanh, odd stages gelu — same shape contract
+        return jax.lax.switch(
+            stage % 2,
+            [lambda v: jnp.tanh(v), jax.nn.gelu],
+            x @ params["w"] + params["b"],
+        )
+
+    def het_sequential(per, x):
+        for i, p in enumerate(per):
+            y = x @ p["w"] + p["b"]
+            x = jnp.tanh(y) if i % 2 == 0 else jax.nn.gelu(y)
+        return x
+
+    stacked = stack_stage_params(per_stage)
+    mesh = build_mesh(MeshConfig(data=2, pipeline=4))
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, a: gpipe(het_stage, p, a, n_micro=4))(
+            stacked, jnp.ones((BATCH, HIDDEN))
+        )
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.asarray(het_sequential(per_stage, jnp.ones((BATCH, HIDDEN)))),
+        atol=1e-5,
+    )
 
 
 @pytest.mark.parametrize("n_micro", [2, 4, 8])

@@ -1,0 +1,170 @@
+"""GP-Bayesian + hyperband suggester unit tests (SURVEY.md §2.4)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.sweep.api import (
+    FeasibleSpace,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+)
+from kubeflow_tpu.sweep.suggest import (
+    GPBayesSuggester,
+    HyperbandSuggester,
+    RandomSuggester,
+    get_suggester,
+)
+
+
+def p_double(name, lo, hi):
+    return ParameterSpec(
+        name=name,
+        parameter_type=ParameterType.DOUBLE,
+        feasible_space=FeasibleSpace(min=str(lo), max=str(hi)),
+    )
+
+
+def p_int(name, lo, hi):
+    return ParameterSpec(
+        name=name,
+        parameter_type=ParameterType.INT,
+        feasible_space=FeasibleSpace(min=str(lo), max=str(hi)),
+    )
+
+
+def _drive(suggester, objective, rounds, per_round=3):
+    """Simulate the controller loop: suggest -> evaluate -> append."""
+    history = []
+    for _ in range(rounds):
+        for a in suggester.suggest(history, per_round):
+            history.append((a, objective(a)))
+    return history
+
+
+class TestGPBayes:
+    OBJECTIVE = staticmethod(lambda a: -(float(a["x"]) - 0.7) ** 2)
+
+    def test_beats_random_on_smooth_objective(self):
+        params = [p_double("x", 0.0, 1.0)]
+        gp_hist = _drive(
+            GPBayesSuggester(params, seed=7, n_startup=4), self.OBJECTIVE, 8
+        )
+        rnd_hist = _drive(
+            RandomSuggester(params, seed=7), self.OBJECTIVE, 8
+        )
+        assert max(o for _, o in gp_hist) >= max(o for _, o in rnd_hist)
+        # and the GP actually converges near the optimum
+        best = max(gp_hist, key=lambda h: h[1])[0]
+        assert abs(float(best["x"]) - 0.7) < 0.1
+
+    def test_minimize_direction(self):
+        params = [p_double("x", 0.0, 1.0)]
+        s = GPBayesSuggester(
+            params, seed=3, n_startup=4,
+            objective_type=ObjectiveType.MINIMIZE,
+        )
+        hist = _drive(s, lambda a: (float(a["x"]) - 0.25) ** 2, 8)
+        best = min(hist, key=lambda h: h[1])[0]
+        assert abs(float(best["x"]) - 0.25) < 0.12
+
+    def test_categoricals_encoded(self):
+        params = [
+            p_double("x", 0.0, 1.0),
+            ParameterSpec(
+                name="opt",
+                parameter_type=ParameterType.CATEGORICAL,
+                feasible_space=FeasibleSpace(list=["adam", "sgd"]),
+            ),
+        ]
+
+        def obj(a):
+            return (1.0 if a["opt"] == "adam" else 0.0) - (float(a["x"]) - 0.5) ** 2
+
+        hist = _drive(GPBayesSuggester(params, seed=5, n_startup=4), obj, 8)
+        best = max(hist, key=lambda h: h[1])[0]
+        assert best["opt"] == "adam"
+
+    def test_nan_history_ignored(self):
+        params = [p_double("x", 0.0, 1.0)]
+        s = GPBayesSuggester(params, seed=1, n_startup=2)
+        history = [({"x": "0.5"}, float("nan"))] * 10 + [
+            ({"x": "0.1"}, 0.1), ({"x": "0.9"}, 0.9),
+        ]
+        out = s.suggest(history, 2)
+        assert len(out) == 2  # no crash, still suggests
+
+    def test_registry(self):
+        s = get_suggester("bayesianoptimization", [p_double("x", 0, 1)])
+        assert isinstance(s, GPBayesSuggester)
+
+
+class TestHyperband:
+    def _mk(self, eta=3, r=1, R=9, inner_seed=0):
+        params = [p_double("lr", 0.001, 0.1), p_int("epochs", r, R)]
+        return HyperbandSuggester(
+            params, seed=inner_seed, resource_parameter="epochs", eta=eta,
+            objective_type=ObjectiveType.MAXIMIZE,
+        )
+
+    def test_schedule(self):
+        hb = self._mk()
+        assert hb.s_max == 2
+        br = hb.brackets()
+        assert [[n for n, _ in rungs] for rungs in br] == [[9, 3, 1], [5, 1], [3]]
+        assert [[round(b) for _, b in rungs] for rungs in br] == [
+            [1, 3, 9], [3, 9], [9]]
+        assert hb.total_trials() == 22
+
+    def test_rung0_uses_min_budget(self):
+        hb = self._mk()
+        out = hb.suggest([], 4)
+        assert len(out) == 4
+        assert all(a["epochs"] == "1" for a in out)
+
+    def test_promotion_picks_best_at_higher_budget(self):
+        hb = self._mk()
+        # fill rung 0 of bracket 0: 9 configs at budget 1
+        history = []
+        for i in range(9):
+            a = hb.suggest(history, 1)[0]
+            history.append((a, float(i)))  # later configs are better
+        out = hb.suggest(history, 9)
+        # rung 1: top 3 of 9 promoted to budget 3
+        assert len(out) == 3
+        assert all(a["epochs"] == "3" for a in out)
+        promoted_lrs = {a["lr"] for a in out}
+        best_lrs = {a["lr"] for a, o in history if o >= 6.0}
+        assert promoted_lrs == best_lrs
+
+    def test_incomplete_rung_waits(self):
+        hb = self._mk()
+        history = []
+        for i in range(9):
+            a = hb.suggest(history, 1)[0]
+            history.append((a, float(i) if i < 8 else None))  # one running
+        assert hb.suggest(history, 9) == []
+
+    def test_failed_trial_never_promoted(self):
+        hb = self._mk()
+        history = []
+        for i in range(9):
+            a = hb.suggest(history, 1)[0]
+            # the would-be-best trial crashed
+            history.append((a, float("nan") if i == 8 else float(i)))
+        out = hb.suggest(history, 3)
+        promoted = {a["lr"] for a in out}
+        crashed_lr = history[8][0]["lr"]
+        assert crashed_lr not in promoted
+
+    def test_full_run_terminates(self):
+        hb = self._mk()
+        history = _drive(hb, lambda a: float(a["lr"]), rounds=40, per_round=5)
+        assert len(history) == hb.total_trials()
+        assert hb.suggest(history, 5) == []
+
+    def test_requires_resource_parameter(self):
+        with pytest.raises(ValueError, match="resourceParameter"):
+            HyperbandSuggester([p_double("lr", 0, 1)], resource_parameter="")

@@ -472,3 +472,154 @@ class TestExperimentE2E:
 def _tune_objective(x, mode):
     bonus = 0.1 if mode == "a" else 0.0
     print(f"score={-(x - 0.5) ** 2 + bonus}")
+
+
+class TestDurableObservations:
+    """Sweep history must survive a platform stop/start (katib db-manager
+    parity — sweep/store.py over the C++ metastore)."""
+
+    def _experiment(self, tmp_path, name="durable-exp"):
+        return Experiment(
+            metadata=ObjectMeta(name=name),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0, step=0.25)],
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE,
+                    objective_metric_name="objective",
+                ),
+                algorithm=AlgorithmSpec(algorithm_name="grid"),
+                trial_template=quadratic_trial_template(tmp_path),
+                max_trial_count=5,
+                parallel_trial_count=3,
+            ),
+        )
+
+    def test_history_survives_restart(self, tmp_path):
+        logs = str(tmp_path / "pod-logs")
+        with Platform(log_dir=logs, capacity_chips=16) as p1:
+            sweep = SweepClient(p1, work_dir=str(tmp_path / "sweeps"))
+            sweep.create_experiment(self._experiment(tmp_path))
+            done = sweep.wait_for_experiment("durable-exp", timeout_s=120)
+            assert done.status.trials_succeeded == 5
+        # platform process "restarts": fresh in-memory store, same disk dirs
+        with Platform(log_dir=logs, capacity_chips=16) as p2:
+            assert p2.cluster.get("experiments", "default/durable-exp") is None
+            sweep2 = SweepClient(p2, work_dir=str(tmp_path / "sweeps"))
+            sweep2.create_experiment(self._experiment(tmp_path))
+            done = sweep2.wait_for_experiment("durable-exp", timeout_s=60)
+            # all 5 grid points restored from the observation store — the
+            # experiment completes without launching a single new pod
+            assert done.status.condition.value == "Succeeded"
+            assert done.status.trials_succeeded == 5
+            events = [e.reason for e in p2.cluster.events_for("default/durable-exp")]
+            assert "HistoryRestored" in events
+            best = done.status.current_optimal_trial
+            assert best is not None
+            assert abs(float(dict(
+                (a.name, a.value) for a in best.parameter_assignments
+            )["x"]) - 0.5) < 1e-9
+
+    def test_changed_spec_starts_fresh(self, tmp_path):
+        logs = str(tmp_path / "pod-logs")
+        with Platform(log_dir=logs, capacity_chips=16) as p1:
+            sweep = SweepClient(p1, work_dir=str(tmp_path / "sweeps"))
+            sweep.create_experiment(self._experiment(tmp_path))
+            sweep.wait_for_experiment("durable-exp", timeout_s=120)
+        with Platform(log_dir=logs, capacity_chips=16) as p2:
+            sweep2 = SweepClient(p2, work_dir=str(tmp_path / "sweeps"))
+            exp = self._experiment(tmp_path)
+            exp.spec.max_trial_count = 3
+            exp.spec.parameters = [p_double("x", 0.0, 1.0, step=0.5)]
+            sweep2.create_experiment(exp)
+            done = sweep2.wait_for_experiment("durable-exp", timeout_s=120)
+            events = [e.reason for e in p2.cluster.events_for("default/durable-exp")]
+            assert "HistoryRestored" not in events
+            assert done.status.trials_succeeded == 3
+
+
+class TestAdvancedSuggesterE2E:
+    def test_gp_bayes_experiment_completes(self, platform, sweep, tmp_path):
+        exp = Experiment(
+            metadata=ObjectMeta(name="gp-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0)],
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"
+                ),
+                algorithm=AlgorithmSpec(
+                    algorithm_name="bayesianoptimization",
+                    settings={"nStartup": "4", "seed": "11"},
+                ),
+                trial_template=quadratic_trial_template(tmp_path),
+                max_trial_count=10,
+                parallel_trial_count=3,
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("gp-exp", timeout_s=180)
+        assert done.status.condition.value == "Succeeded"
+        best = done.status.current_optimal_trial
+        # EI-driven search should land near the x=0.6 optimum
+        x = float({a.name: a.value for a in best.parameter_assignments}["x"])
+        assert abs(x - 0.6) < 0.2
+        assert best.observation.metric("objective").latest > -0.04
+
+    def test_hyperband_experiment_completes(self, platform, sweep, tmp_path):
+        script = tmp_path / "hb_trial.py"
+        script.write_text(
+            textwrap.dedent(
+                """
+                import os
+                x = float(os.environ["X_PARAM"])
+                epochs = int(os.environ["EPOCHS"])
+                print(f"objective={-(x - 0.6) ** 2 - 1.0 / epochs}")
+                """
+            )
+        )
+        spec = textwrap.dedent(
+            f"""
+            apiVersion: kubeflow-tpu.org/v1
+            kind: JAXJob
+            spec:
+              replicaSpecs:
+                worker:
+                  replicas: 1
+                  template:
+                    container:
+                      command: [{sys.executable}, {script}]
+                      env:
+                        X_PARAM: "${{trialParameters.x}}"
+                        EPOCHS: "${{trialParameters.epochs}}"
+            """
+        )
+        exp = Experiment(
+            metadata=ObjectMeta(name="hb-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0), p_int("epochs", 1, 9)],
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"
+                ),
+                algorithm=AlgorithmSpec(
+                    algorithm_name="hyperband",
+                    settings={"resourceParameter": "epochs", "eta": "3"},
+                ),
+                trial_template=TrialTemplate(
+                    trial_spec=spec,
+                    trial_parameters=[
+                        TrialParameterSpec(name="x", reference="x"),
+                        TrialParameterSpec(name="epochs", reference="epochs"),
+                    ],
+                ),
+                max_trial_count=30,  # >= hyperband's 22-trial schedule
+                parallel_trial_count=4,
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("hb-exp", timeout_s=240)
+        # hyperband exhausts its bracket schedule and the experiment closes
+        assert done.status.condition.value == "Succeeded"
+        assert done.status.trials_succeeded >= 22
+        best = done.status.current_optimal_trial
+        a = {p.name: p.value for p in best.parameter_assignments}
+        # the winner must come from the top rung (full budget)
+        assert a["epochs"] == "9"
