@@ -92,6 +92,9 @@ MANIFEST_KINDS = {
     "PodDefault": "poddefaults",
     "Profile": "profiles",
     "Tensorboard": "tensorboards",
+    "PipelineRun": "pipelineruns",
+    "Notebook": "notebooks",
+    "PVCViewer": "pvcviewers",
 }
 
 

@@ -86,12 +86,25 @@ def _deserialize(manifest: dict):
         isvc = isvc_from_dict(manifest)
         validate_isvc(isvc)
         return bucket, isvc
-    # PodDefault
-    from kubeflow_tpu.api.serde import _from_dict
-    from kubeflow_tpu.controller.poddefault import PodDefault
+    if bucket == "pipelineruns":
+        from kubeflow_tpu.pipelines.crd import pipelinerun_from_dict
 
+        return bucket, pipelinerun_from_dict(manifest)
+    # plain dataclass kinds: PodDefault / Tensorboard / Notebook / PVCViewer
+    from kubeflow_tpu.api.serde import _from_dict
+    from kubeflow_tpu.controller.devservers import Notebook, PVCViewer
+    from kubeflow_tpu.controller.poddefault import PodDefault
+    from kubeflow_tpu.controller.tensorboard import Tensorboard
+
+    cls = {
+        "poddefaults": PodDefault,
+        "tensorboards": Tensorboard,
+        "notebooks": Notebook,
+        "pvcviewers": PVCViewer,
+    }[bucket]
     body = {k: v for k, v in manifest.items() if k not in ("kind", "apiVersion")}
-    return bucket, _from_dict(PodDefault, body)
+    body.pop("status", None)
+    return bucket, _from_dict(cls, body)
 
 
 class PlatformServer:

@@ -250,6 +250,35 @@ def cmd_pipeline_run(args) -> int:
     return 0 if run.succeeded else 1
 
 
+def cmd_pipeline_submit(args) -> int:
+    """Submit compiled IR to a REMOTE platform as a PipelineRun and poll."""
+    import yaml
+
+    ir = yaml.safe_load(_read(args.filename))
+    arguments = {}
+    for kv in args.arg or []:
+        k, _, v = kv.partition("=")
+        try:
+            arguments[k] = json.loads(v)
+        except json.JSONDecodeError:
+            arguments[k] = v
+    client = _remote(args)
+    client.submit_pipeline_run(args.name, ir, arguments,
+                               namespace=args.namespace)
+    print(f"pipelinerun {args.namespace}/{args.name} submitted", file=sys.stderr)
+    run = client.wait_for_pipeline_run(
+        args.name, args.namespace, timeout_s=args.timeout
+    )
+    st = run.get("status", {})
+    print(json.dumps({
+        "state": st.get("state"),
+        "tasks": st.get("tasks", {}),
+        "output": st.get("output"),
+        "error": st.get("error", ""),
+    }, indent=2))
+    return 0 if st.get("state") == "Succeeded" else 1
+
+
 def cmd_platform(args) -> int:
     """Run the control plane as a daemon serving the REST API."""
     from kubeflow_tpu.apiserver import PlatformServer
@@ -397,6 +426,14 @@ def main(argv: list[str] | None = None) -> int:
 
     p = server_arg(add("apply", cmd_apply, help="create from a manifest (remote)"))
     p.add_argument("-f", "--filename", required=True)
+
+    p = server_arg(add("pipeline-submit", cmd_pipeline_submit,
+                       help="submit compiled IR to a remote platform and poll"))
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--name", default="pipelinerun")
+    p.add_argument("--arg", action="append", metavar="KEY=VALUE")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--timeout", type=float, default=3600.0)
 
     p = server_arg(add("get", cmd_get, help="list/get objects (remote)"))
     p.add_argument("kind")

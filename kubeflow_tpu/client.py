@@ -32,8 +32,13 @@ class Platform:
         capacity_chips: int = 8,
         controller_workers: int = 2,
     ):
+        from kubeflow_tpu.controller.devservers import (
+            NotebookController,
+            PVCViewerController,
+        )
         from kubeflow_tpu.controller.profile import ProfileController
         from kubeflow_tpu.controller.tensorboard import TensorboardController
+        from kubeflow_tpu.pipelines.crd import PipelineRunController
         from kubeflow_tpu.serving.controller import InferenceServiceController
         from kubeflow_tpu.sweep.controller import ExperimentController
 
@@ -52,6 +57,13 @@ class Platform:
         )
         self.profile_controller = ProfileController(self.cluster)
         self.tensorboard_controller = TensorboardController(self.cluster)
+        self.notebook_controller = NotebookController(self.cluster)
+        self.pvcviewer_controller = PVCViewerController(self.cluster)
+        self.pipelinerun_controller = PipelineRunController(
+            self.cluster,
+            work_dir=str(Path(log_dir).parent / "pipelines"),
+            platform=self,
+        )
         self.metrics_server = None  # started on demand
         self._started = False
 
@@ -79,6 +91,9 @@ class Platform:
             self.isvc_controller.start()
             self.profile_controller.start()
             self.tensorboard_controller.start()
+            self.notebook_controller.start()
+            self.pvcviewer_controller.start()
+            self.pipelinerun_controller.start()
             self._started = True
         return self
 
@@ -86,6 +101,9 @@ class Platform:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
+        self.pipelinerun_controller.stop()
+        self.pvcviewer_controller.stop()
+        self.notebook_controller.stop()
         self.tensorboard_controller.stop()
         self.profile_controller.stop()
         self.isvc_controller.stop()

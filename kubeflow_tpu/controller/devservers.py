@@ -1,0 +1,186 @@
+"""Notebook + PVCViewer controllers — long-lived dev-server CRs.
+
+Reference parity (unverified cites, SURVEY.md §2.7):
+  - kubeflow/kubeflow components/notebook-controller: `Notebook` CR ->
+    StatefulSet + Service running a Jupyter/VSCode image. Here the CR runs a
+    dev-server process (user-specified command, defaulting to a stdlib HTTP
+    file server over the workspace — no Jupyter in this environment) with
+    the same readiness probing + self-heal the tensorboard controller has.
+  - components/pvcviewer-controller: `PVCViewer` CR -> file-browser
+    Deployment over a PVC. Here it serves the volume directory over HTTP.
+
+Both reuse one ServerCRController base: CR -> pod with an injected port,
+HTTP-probed readiness, exited-process self-heal, cascade delete.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.controller.base import ControllerBase
+from kubeflow_tpu.controller.fakecluster import FakeCluster, Pod, PodPhase
+from kubeflow_tpu.controller.tensorboard import PORT_ANNOTATION, _probe
+from kubeflow_tpu.runtime.rendezvous import free_port
+
+
+@dataclass
+class NotebookSpec:
+    # dev-server command; "{port}" placeholders are substituted. Empty =
+    # stdlib HTTP file server over `workspace` (the offline Jupyter stand-in)
+    command: list[str] = field(default_factory=list)
+    workspace: str = "."
+    env: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ServerStatus:
+    ready: bool = False
+    url: str = ""
+
+
+@dataclass
+class Notebook:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NotebookSpec = field(default_factory=NotebookSpec)
+    status: ServerStatus = field(default_factory=ServerStatus)
+    kind: str = "Notebook"
+    api_version: str = "kubeflow-tpu.org/v1beta1"
+
+
+@dataclass
+class PVCViewerSpec:
+    pvc: str = "."  # volume directory to browse
+
+
+@dataclass
+class PVCViewer:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PVCViewerSpec = field(default_factory=PVCViewerSpec)
+    status: ServerStatus = field(default_factory=ServerStatus)
+    kind: str = "PVCViewer"
+    api_version: str = "kubeflow-tpu.org/v1alpha1"
+
+
+class ServerCRController(ControllerBase):
+    """Shared reconcile: CR -> one dev-server pod, probed ready, self-healed."""
+
+    #: subclass config
+    CR_KIND = ""       # cluster bucket ("notebooks" / "pvcviewers")
+    POD_LABEL = ""     # pod -> CR ownership label
+    POD_SUFFIX = ""    # pod name suffix
+
+    def __init__(self, cluster: FakeCluster, workers: int = 1,
+                 resync_period_s: float = 2.0):
+        super().__init__(
+            cluster, name=self.CR_KIND, workers=workers,
+            resync_period_s=resync_period_s,
+        )
+
+    def command_for(self, cr, port: int) -> tuple[list[str], dict[str, str], str]:
+        """(command, env, working_dir) for the server pod."""
+        raise NotImplementedError
+
+    def kind_filter(self, etype, kind: str, obj) -> str | None:
+        if kind == self.CR_KIND:
+            return self.cluster._key(obj)
+        if kind == "pods":
+            name = obj.metadata.labels.get(self.POD_LABEL)
+            if name:
+                return f"{obj.metadata.namespace}/{name}"
+        return None
+
+    def resync_keys(self):
+        return [self.cluster._key(o) for o in self.cluster.list(self.CR_KIND)]
+
+    def reconcile(self, key: str) -> float | None:
+        cr = self.cluster.get(self.CR_KIND, key, copy_obj=True)
+        ns, _, name = key.partition("/")
+        pods = self.cluster.list(
+            "pods",
+            lambda p: p.metadata.labels.get(self.POD_LABEL) == name
+            and p.metadata.namespace == ns,
+        )
+        if cr is None:
+            for p in pods:
+                self.cluster.delete("pods", p.key)
+            return None
+
+        # self-heal exited servers
+        for p in pods:
+            if p.status.phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
+                self.cluster.delete("pods", p.key)
+        pods = [
+            p for p in pods
+            if p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+        ]
+        if not pods:
+            self._create_pod(cr)
+            return 0.5
+
+        pod = pods[0]
+        port = pod.metadata.annotations.get(PORT_ANNOTATION, "")
+        url = f"http://127.0.0.1:{port}" if port else ""
+        ready = pod.status.phase == PodPhase.RUNNING and bool(url) and _probe(url)
+        if (ready, url if ready else "") != (cr.status.ready, cr.status.url):
+            cr.status.ready = ready
+            cr.status.url = url if ready else ""
+            self.cluster.update(self.CR_KIND, cr)
+            if ready:
+                self.cluster.record_event(
+                    self.CR_KIND, key, "Ready", f"{self.POD_SUFFIX} at {url}"
+                )
+        return None if ready else 0.5
+
+    def _create_pod(self, cr) -> None:
+        port = free_port()
+        command, env, workdir = self.command_for(cr, port)
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=f"{cr.metadata.name}-{self.POD_SUFFIX}-0",
+                namespace=cr.metadata.namespace,
+                labels={self.POD_LABEL: cr.metadata.name},
+                annotations={PORT_ANNOTATION: str(port)},
+            ),
+            command=command,
+            env=env,
+            working_dir=workdir,
+            scheduler_name="default",
+        )
+        try:
+            self.cluster.create("pods", pod)
+        except KeyError:
+            pass
+
+
+class NotebookController(ServerCRController):
+    ERROR_EVENT_KIND = "notebooks"
+    CR_KIND = "notebooks"
+    POD_LABEL = "kubeflow-tpu.org/notebook"
+    POD_SUFFIX = "notebook"
+
+    def command_for(self, cr: Notebook, port: int):
+        if cr.spec.command:
+            command = [c.replace("{port}", str(port)) for c in cr.spec.command]
+        else:
+            command = [
+                sys.executable, "-m", "http.server", str(port),
+                "--bind", "127.0.0.1", "--directory", cr.spec.workspace,
+            ]
+        env = {"NOTEBOOK_PORT": str(port), **cr.spec.env}
+        return command, env, cr.spec.workspace
+
+
+class PVCViewerController(ServerCRController):
+    ERROR_EVENT_KIND = "pvcviewers"
+    CR_KIND = "pvcviewers"
+    POD_LABEL = "kubeflow-tpu.org/pvcviewer"
+    POD_SUFFIX = "pvcviewer"
+
+    def command_for(self, cr: PVCViewer, port: int):
+        command = [
+            sys.executable, "-m", "http.server", str(port),
+            "--bind", "127.0.0.1", "--directory", cr.spec.pvc,
+        ]
+        return command, {}, ""

@@ -106,7 +106,7 @@ class FakeCluster:
     KINDS = (
         "jobs", "pods", "podgroups", "experiments", "trials",
         "inferenceservices", "poddefaults", "profiles", "namespaces",
-        "tensorboards",
+        "tensorboards", "pipelineruns", "notebooks", "pvcviewers",
     )
 
     def __init__(self) -> None:

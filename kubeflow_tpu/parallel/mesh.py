@@ -92,3 +92,29 @@ def build_mesh(
 def single_device_mesh() -> Mesh:
     """1-device mesh with the full axis vocabulary (all sizes 1 except data)."""
     return build_mesh(MeshConfig(), jax.devices()[:1])
+
+
+def build_multislice_mesh(
+    num_slices: int, config: MeshConfig | None = None, devices: list | None = None
+) -> Mesh:
+    """Mesh for a multislice (DCN/megascale) job.
+
+    Devices arrive slice-major from jax.devices() (processes are ordered by
+    id and slices are contiguous process ranges — envcontract.jax_env), so
+    with the canonical outer-to-inner axis order the data-like axes span
+    slices (DCN) while model-like axes stay inside a slice (ICI) — the
+    scaling-book placement. Validates that the outermost non-trivial axis is
+    a multiple of num_slices so no ICI-class axis straddles a DCN boundary.
+    """
+    config = config or MeshConfig()
+    mesh = build_mesh(config, devices)
+    # only the data-like outer axes may straddle the DCN boundary; model/
+    # context/expert/pipeline collectives must stay inside one slice's ICI
+    dcn = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+    if dcn % num_slices != 0:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)}: data×fsdp = {dcn} is not a multiple "
+            f"of num_slices {num_slices}; an ICI-class axis would straddle "
+            f"the DCN slice boundary"
+        )
+    return mesh

@@ -79,6 +79,19 @@ def put_global(x: Any, sharding: NamedSharding) -> Any:
     make_array_from_callback — the multi-host-safe construction (device_put
     cannot target non-addressable devices).
     """
+    if isinstance(x, jax.Array) and not isinstance(x, np.ndarray):
+        # already placed (e.g. by prefetch_to_device) — pass through; a
+        # multi-process global array cannot be np.asarray'd
+        if x.sharding.is_equivalent_to(sharding, x.ndim):
+            return x
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        if not x.is_fully_addressable:
+            raise ValueError(
+                f"cannot reshard a global array from {x.sharding} to "
+                f"{sharding} outside jit in multi-process mode"
+            )
+        # process-local array: fall through to the host-copy construction
     if jax.process_count() == 1:
         return jax.device_put(x, sharding)
     arr = np.asarray(x)

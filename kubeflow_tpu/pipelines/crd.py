@@ -1,0 +1,182 @@
+"""PipelineRun CR + controller — pipelines as platform objects over REST.
+
+Reference parity (unverified cites, SURVEY.md §2.6 API-server row): the KFP
+apiserver exposes pipeline/run CRUD as a network API (backend/src/apiserver)
+and hands execution to Argo. Here a PipelineRun object in the cluster store
+carries the compiled IR + arguments; a controller executes it with the
+LocalPipelineRunner (DAG engine + cache + lineage) and mirrors task states
+back onto the CR status — so remote SDKs/CLIs submit and poll runs exactly
+like jobs.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from kubeflow_tpu.api.common import ObjectMeta, utcnow as _now
+from kubeflow_tpu.controller.base import ControllerBase
+from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
+
+
+@dataclass
+class PipelineRunSpec:
+    # compiled IR (pipelines/compiler.py PipelineSpec-shaped dict)
+    pipeline_spec: dict = field(default_factory=dict)
+    arguments: dict = field(default_factory=dict)
+    cache: bool = True
+
+
+@dataclass
+class PipelineRunStatus:
+    state: str = "Pending"  # Pending | Running | Succeeded | Failed
+    tasks: dict[str, str] = field(default_factory=dict)
+    output: Any = None
+    error: str = ""
+    run_id: str = ""
+    start_time: str = ""
+    completion_time: str = ""
+
+    @property
+    def is_finished(self) -> bool:
+        return self.state in ("Succeeded", "Failed")
+
+
+@dataclass
+class PipelineRunCR:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PipelineRunSpec = field(default_factory=PipelineRunSpec)
+    status: PipelineRunStatus = field(default_factory=PipelineRunStatus)
+    kind: str = "PipelineRun"
+    api_version: str = "kubeflow-tpu.org/v1"
+
+
+def pipelinerun_from_dict(manifest: dict) -> PipelineRunCR:
+    from kubeflow_tpu.api.serde import _from_dict
+    from kubeflow_tpu.pipelines.compiler import validate_ir
+
+    body = {k: v for k, v in manifest.items() if k not in ("kind", "apiVersion")}
+    body.pop("status", None)
+    run = _from_dict(PipelineRunCR, body)
+    validate_ir(run.spec.pipeline_spec)
+    return run
+
+
+class PipelineRunController(ControllerBase):
+    """Executes PipelineRun objects; one executor thread per run."""
+
+    ERROR_EVENT_KIND = "pipelineruns"
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        work_dir: str = ".kubeflow_tpu/pipelines",
+        platform=None,
+        workers: int = 1,
+    ):
+        super().__init__(cluster, name="pipelinerun", workers=workers,
+                         resync_period_s=2.0)
+        self.work_dir = work_dir
+        self.platform = platform
+        self._running: set[str] = set()  # uids with a live executor thread
+        self._mu = threading.Lock()
+        self.metrics.update({
+            "pipelineruns_total": 0,
+            "pipelineruns_succeeded_total": 0,
+            "pipelineruns_failed_total": 0,
+        })
+
+    def kind_filter(self, etype, kind: str, obj) -> str | None:
+        return self.cluster._key(obj) if kind == "pipelineruns" else None
+
+    def resync_keys(self):
+        return [
+            self.cluster._key(r)
+            for r in self.cluster.list("pipelineruns")
+            if not r.status.is_finished
+        ]
+
+    def reconcile(self, key: str) -> float | None:
+        run: PipelineRunCR | None = self.cluster.get(
+            "pipelineruns", key, copy_obj=True
+        )
+        if run is None or run.status.is_finished:
+            return None
+        with self._mu:
+            if run.metadata.uid in self._running:
+                return None
+            self._running.add(run.metadata.uid)
+        if run.status.state == "Pending":
+            run.status.state = "Running"
+            run.status.start_time = _now()
+            try:
+                run = self.cluster.update("pipelineruns", run)
+            except (ConflictError, KeyError):
+                with self._mu:
+                    self._running.discard(run.metadata.uid)
+                return 0.1
+            self.metrics["pipelineruns_total"] += 1
+            self.cluster.record_event("pipelineruns", key, "RunStarted", "executing")
+        threading.Thread(
+            target=self._execute, args=(key, run.metadata.uid),
+            name=f"pipelinerun-{run.metadata.name}", daemon=True,
+        ).start()
+        return None
+
+    def _execute(self, key: str, uid: str) -> None:
+        from kubeflow_tpu.pipelines.runner import LocalPipelineRunner
+
+        run = self.cluster.get("pipelineruns", key, copy_obj=True)
+        if run is None or run.metadata.uid != uid:
+            with self._mu:
+                self._running.discard(uid)
+            return
+        try:
+            runner = LocalPipelineRunner(
+                work_dir=self.work_dir,
+                cache=run.spec.cache,
+                platform=self.platform,
+            )
+            result = runner.run(run.spec.pipeline_spec, run.spec.arguments)
+            state = "Succeeded" if result.succeeded else "Failed"
+            tasks = {t: r.state.value for t, r in result.tasks.items()}
+            output, error, run_id = result.output, "", result.run_id
+            if not result.succeeded:
+                error = "; ".join(
+                    f"{t}: {r.error}" for t, r in result.tasks.items() if r.error
+                )
+        except Exception as exc:  # noqa: BLE001 — a bad IR must not kill the controller
+            state, tasks, output, run_id = "Failed", {}, None, ""
+            error = f"{type(exc).__name__}: {exc}"
+        finally:
+            with self._mu:
+                self._running.discard(uid)
+        for _ in range(10):  # optimistic-concurrency retry on status write
+            cur = self.cluster.get("pipelineruns", key, copy_obj=True)
+            if cur is None or cur.metadata.uid != uid:
+                return  # deleted/replaced while executing
+            cur.status.state = state
+            cur.status.tasks = tasks
+            cur.status.output = output
+            cur.status.error = error
+            cur.status.run_id = run_id
+            cur.status.completion_time = _now()
+            try:
+                self.cluster.update("pipelineruns", cur)
+                break
+            except ConflictError:
+                continue
+            except KeyError:
+                return
+        counter = (
+            "pipelineruns_succeeded_total" if state == "Succeeded"
+            else "pipelineruns_failed_total"
+        )
+        self.metrics[counter] += 1
+        self.cluster.record_event(
+            "pipelineruns", key,
+            "RunSucceeded" if state == "Succeeded" else "RunFailed",
+            error or "pipeline complete",
+            type="Normal" if state == "Succeeded" else "Warning",
+        )

@@ -104,6 +104,39 @@ class RemoteClient:
             time.sleep(poll_s)
         raise TimeoutError(f"job {namespace}/{name} not finished in {timeout_s}s")
 
+    # ------------------------------------------------------------- pipelines
+
+    def submit_pipeline_run(
+        self, name: str, pipeline_spec: dict, arguments: dict | None = None,
+        namespace: str = "default", cache: bool = True,
+    ) -> dict:
+        """Submit compiled pipeline IR as a PipelineRun (KFP create_run
+        analogue, SURVEY.md §2.6 API-server row)."""
+        return self.apply({
+            "apiVersion": "kubeflow-tpu.org/v1",
+            "kind": "PipelineRun",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "pipelineSpec": pipeline_spec,
+                "arguments": arguments or {},
+                "cache": cache,
+            },
+        })
+
+    def wait_for_pipeline_run(
+        self, name: str, namespace: str = "default",
+        timeout_s: float = 600.0, poll_s: float = 0.5,
+    ) -> dict:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            run = self.get("pipelineruns", name, namespace)
+            if run.get("status", {}).get("state") in ("Succeeded", "Failed"):
+                return run
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"pipeline run {namespace}/{name} not finished in {timeout_s}s"
+        )
+
     def healthz(self) -> bool:
         try:
             return bool(self._request("GET", "/healthz").get("ok"))

@@ -23,6 +23,10 @@ class DistContext:
     process_id: int
     num_processes: int
     coordinator: str | None
+    # multislice topology (MEGASCALE_* contract, SURVEY.md §2.3): slices are
+    # the DCN-connected units; processes within a slice share ICI
+    num_slices: int = 1
+    slice_id: int = 0
 
     @property
     def is_distributed(self) -> bool:
@@ -31,6 +35,14 @@ class DistContext:
     @property
     def is_coordinator(self) -> bool:
         return self.process_id == 0
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
+
+    @property
+    def processes_per_slice(self) -> int:
+        return self.num_processes // max(self.num_slices, 1)
 
 
 def initialize_from_env(
@@ -47,6 +59,23 @@ def initialize_from_env(
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     n = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
     pid = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    # multislice contract: on real Cloud TPU these are consumed by libtpu's
+    # megascale transport; here they carry the slice topology into the mesh
+    # builder (slice-major device order => data-like axes ride DCN)
+    num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
+    slice_id = int(os.environ.get("MEGASCALE_SLICE_ID", "0"))
+    if num_slices > 1:
+        if n % num_slices:
+            raise ValueError(
+                f"JAX_NUM_PROCESSES {n} not divisible by "
+                f"MEGASCALE_NUM_SLICES {num_slices}"
+            )
+        expect = pid // (n // num_slices)
+        if slice_id != expect:
+            raise ValueError(
+                f"MEGASCALE_SLICE_ID {slice_id} inconsistent with process "
+                f"{pid}/{n} over {num_slices} slices (expected {expect})"
+            )
 
     if local_device_count is not None:
         import re
@@ -65,7 +94,10 @@ def initialize_from_env(
         jax.distributed.initialize(
             coordinator_address=coord, num_processes=n, process_id=pid
         )
-    return DistContext(process_id=pid, num_processes=n, coordinator=coord)
+    return DistContext(
+        process_id=pid, num_processes=n, coordinator=coord,
+        num_slices=num_slices, slice_id=slice_id,
+    )
 
 
 def shutdown() -> None:

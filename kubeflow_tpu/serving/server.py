@@ -43,19 +43,66 @@ def _np_to_datatype(arr: np.ndarray) -> str:
     return _NP_TO_V2.get(arr.dtype, "FP32")
 
 
+class _RawJSON:
+    """Pre-serialized JSON response body (single-serialization hot path)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+
 class ModelServer:
-    """Hosts a repository of models behind one HTTP port."""
+    """Hosts a repository of models behind one HTTP port.
+
+    Agent capabilities (SURVEY.md §2.5 Agent row — serving/agent.py):
+    request/response logging (`request_log_path` + GET /metrics counters),
+    adaptive micro-batching (`max_batch_size` > 0 enables; concurrent
+    requests coalesce into one forward pass), and the v2 repository API
+    (POST /v2/repository/{index,models/{m}/load,models/{m}/unload}) for
+    multi-model load/unload against `repository_dir`.
+    """
 
     def __init__(self, models: list[Model] | None = None, port: int = 8080,
-                 host: str = "127.0.0.1"):
-        self.models: dict[str, Model] = {m.name: m for m in (models or [])}
+                 host: str = "127.0.0.1", request_log_path: str | None = None,
+                 max_batch_size: int = 0, batch_max_latency_ms: float = 5.0,
+                 repository_dir: str = ""):
+        from kubeflow_tpu.serving.agent import MicroBatcher, RequestLogger
+
+        self.models: dict[str, Model] = {}
         self.host = host
         self.port = port
+        self.logger = RequestLogger(request_log_path)
+        self.max_batch_size = max_batch_size
+        self.batch_max_latency_ms = batch_max_latency_ms
+        self.repository_dir = repository_dir
+        self._batchers: dict[str, MicroBatcher] = {}
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        for m in models or []:
+            self.register(m)
 
     def register(self, model: Model) -> None:
+        from kubeflow_tpu.serving.agent import MicroBatcher
+
         self.models[model.name] = model
+        if self.max_batch_size > 0:
+            old = self._batchers.pop(model.name, None)
+            if old is not None:
+                old.stop()
+            self._batchers[model.name] = MicroBatcher(
+                model, self.max_batch_size, self.batch_max_latency_ms
+            )
+
+    def unregister(self, name: str) -> bool:
+        b = self._batchers.pop(name, None)
+        if b is not None:
+            b.stop()
+        return self.models.pop(name, None) is not None
+
+    def _call_model(self, m: Model, arr: np.ndarray):
+        batcher = self._batchers.get(m.name)
+        return batcher(arr) if batcher is not None else m(arr)
 
     # ----------------------------------------------------------- lifecycle
 
@@ -76,6 +123,9 @@ class ModelServer:
         return self
 
     def stop(self) -> None:
+        for b in self._batchers.values():
+            b.stop()
+        self.logger.close()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -86,7 +136,9 @@ class ModelServer:
 
     # ------------------------------------------------------------ handlers
 
-    def handle_get(self, path: str) -> tuple[int, dict]:
+    def handle_get(self, path: str) -> tuple[int, object]:
+        if path == "/metrics":
+            return 200, self.logger.render_metrics()  # raw prometheus text
         if path == "/v2":
             return 200, {
                 "name": SERVER_NAME,
@@ -126,14 +178,72 @@ class ModelServer:
             return 200, {"name": name, "ready": m.ready}
         return 404, {"error": f"no route {path!r}"}
 
-    def handle_post(self, path: str, body: dict) -> tuple[int, dict]:
+    def handle_post(self, path: str, body: dict, req_bytes: int = 0) -> tuple[int, dict]:
         if path.startswith("/v1/models/") and path.endswith(":predict"):
             name = path[len("/v1/models/"):-len(":predict")]
-            return self._predict_v1(name, body)
+            return self._logged(name, "v1", req_bytes, self._predict_v1, body)
         if path.startswith("/v2/models/") and path.endswith("/infer"):
             name = path[len("/v2/models/"):-len("/infer")]
-            return self._infer_v2(name, body)
+            return self._logged(name, "v2", req_bytes, self._infer_v2, body)
+        # ---- v2 repository API (multi-model load/unload)
+        if path == "/v2/repository/index":
+            return 200, [
+                {"name": n, "state": "READY" if m.ready else "UNAVAILABLE",
+                 "version": "1"}
+                for n, m in sorted(self.models.items())
+            ]
+        if path.startswith("/v2/repository/models/") and path.endswith("/load"):
+            name = path[len("/v2/repository/models/"):-len("/load")]
+            return self._repo_load(name, body)
+        if path.startswith("/v2/repository/models/") and path.endswith("/unload"):
+            name = path[len("/v2/repository/models/"):-len("/unload")]
+            if not self.unregister(name):
+                return 404, {"error": f"model {name!r} not found"}
+            return 200, {"name": name, "state": "UNAVAILABLE"}
         return 404, {"error": f"no route {path!r}"}
+
+    def _logged(self, name: str, protocol: str, req_bytes: int, fn, body):
+        import time as _time
+
+        t0 = _time.perf_counter()
+        code, payload = fn(name, body)
+        # serialize exactly once: the handler sends these bytes verbatim
+        data = json.dumps(payload).encode()
+        self.logger.log(
+            name, protocol, code, _time.perf_counter() - t0, req_bytes, len(data)
+        )
+        return code, _RawJSON(data)
+
+    def _repo_load(self, name: str, body: dict) -> tuple[int, dict]:
+        """Load (or reload) a model from the repository dir or a storage URI
+        — the kserve agent multi-model-puller analogue."""
+        import re
+
+        from kubeflow_tpu.serving.model import JaxModel
+        from kubeflow_tpu.serving.storage import pull_model
+
+        # the name becomes a filesystem path component: allowlist it so a
+        # crafted '../..' name can never escape the repository dir (pull_model
+        # rmtree's its destination)
+        if not re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]*", name):
+            return 422, {"error": f"invalid model name {name!r}"}
+        body = body or {}
+        uri = body.get("storage_uri", "")
+        try:
+            if uri:
+                model_dir = pull_model(
+                    uri, f"{self.repository_dir or '.kubeflow_tpu/models'}/{name}"
+                )
+            elif self.repository_dir:
+                model_dir = f"{self.repository_dir}/{name}"
+            else:
+                return 400, {"error": "no storage_uri and no repository_dir"}
+            model = JaxModel(name, model_dir)
+            model.load()
+        except Exception as exc:  # noqa: BLE001 — load failure is a client-visible error
+            return 500, {"error": f"{type(exc).__name__}: {exc}"}
+        self.register(model)
+        return 200, {"name": name, "state": "READY"}
 
     def _get_ready_model(self, name: str) -> Model | tuple[int, dict]:
         m = self.models.get(name)
@@ -151,7 +261,7 @@ class ModelServer:
         if instances is None:
             return 400, {"error": "v1 request must carry 'instances'"}
         try:
-            out = m(np.asarray(instances))
+            out = self._call_model(m, np.asarray(instances))
         except Exception as exc:  # noqa: BLE001 — surface as 500, keep serving
             return 500, {"error": f"{type(exc).__name__}: {exc}"}
         if isinstance(out, dict) and "predictions" in out:
@@ -170,7 +280,7 @@ class ModelServer:
             arr = np.asarray(
                 t["data"], dtype=_V2_TO_NP.get(t.get("datatype", "FP32"), np.float32)
             ).reshape(t["shape"])
-            out = m(arr)
+            out = self._call_model(m, arr)
         except Exception as exc:  # noqa: BLE001
             return 500, {"error": f"{type(exc).__name__}: {exc}"}
         if isinstance(out, dict):  # classification postprocess contract
@@ -200,10 +310,15 @@ def _make_handler(server: ModelServer):
         def log_message(self, fmt, *args):  # route to stdout for pod logs
             print(f"[http] {fmt % args}", flush=True)
 
-        def _reply(self, code: int, payload: dict) -> None:
-            data = json.dumps(payload).encode()
+        def _reply(self, code: int, payload) -> None:
+            if isinstance(payload, _RawJSON):
+                data, ctype = payload.data, "application/json"
+            elif isinstance(payload, str):
+                data, ctype = payload.encode(), "text/plain; version=0.0.4"
+            else:
+                data, ctype = json.dumps(payload).encode(), "application/json"
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -219,7 +334,7 @@ def _make_handler(server: ModelServer):
             except json.JSONDecodeError as exc:
                 self._reply(400, {"error": f"bad json: {exc}"})
                 return
-            code, payload = server.handle_post(self.path, body)
+            code, payload = server.handle_post(self.path, body, req_bytes=length)
             self._reply(code, payload)
 
     return Handler
@@ -243,6 +358,14 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--device", default="", help="tpu|cpu (default: env)")
+    # agent features (SURVEY.md §2.5 Agent row)
+    ap.add_argument("--request-log", default="",
+                    help="JSONL request/response log path")
+    ap.add_argument("--max-batch-size", type=int, default=0,
+                    help=">0 enables adaptive micro-batching")
+    ap.add_argument("--batch-max-latency-ms", type=float, default=5.0)
+    ap.add_argument("--repository-dir", default="",
+                    help="multi-model repository root for /v2/repository API")
     args = ap.parse_args(argv)
 
     if args.device:
@@ -266,7 +389,13 @@ def main(argv: list[str] | None = None) -> None:
             args.model_name, model, t_cls(f"{args.model_name}-transformer")
         )
 
-    srv = ModelServer([model], port=args.port, host=args.host)
+    srv = ModelServer(
+        [model], port=args.port, host=args.host,
+        request_log_path=args.request_log or None,
+        max_batch_size=args.max_batch_size,
+        batch_max_latency_ms=args.batch_max_latency_ms,
+        repository_dir=args.repository_dir,
+    )
     srv.start(block=False)
     print(f"server ready url={srv.url} model={args.model_name}", flush=True)
     threading.Event().wait()  # serve until killed

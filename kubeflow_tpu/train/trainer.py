@@ -34,7 +34,7 @@ from kubeflow_tpu.parallel import build_mesh, MeshConfig
 from kubeflow_tpu.parallel.sharding import shard_batch, shard_state
 from kubeflow_tpu.train import metrics as metrics_lib
 from kubeflow_tpu.train.checkpoint import Checkpointer
-from kubeflow_tpu.train.data import Dataset, batches
+from kubeflow_tpu.train.data import Dataset, batches, prefetch_to_device
 
 
 class TrainState(struct.PyTreeNode):
@@ -271,8 +271,14 @@ class Trainer:
 
         epoch = global_step // max(per_epoch, 1)
         while global_step < total_steps:
-            for bx, by in batches(
-                dataset.x_train, dataset.y_train, c.batch_size, seed=c.seed + epoch
+            # double-buffered host->device prefetch keeps input transfer off
+            # the step critical path (train/data.py)
+            for bx, by in prefetch_to_device(
+                batches(
+                    dataset.x_train, dataset.y_train, c.batch_size,
+                    seed=c.seed + epoch,
+                ),
+                self.mesh,
             ):
                 if global_step >= total_steps:
                     break

@@ -129,3 +129,68 @@ class TestJobLifecycleOverHTTP:
     def test_metrics_over_http(self, remote):
         text = remote._request("GET", "/metrics")
         assert "kftpu_job_reconcile_total" in text
+
+
+class TestPipelineRunsOverREST:
+    """Pipelines as a network API (SURVEY.md §2.6 API-server row)."""
+
+    def _ir(self):
+        from kubeflow_tpu.pipelines import component, pipeline, compile_pipeline
+
+        @component
+        def add(a: float, b: float) -> float:
+            return a + b
+
+        @component
+        def square(x: float) -> float:
+            return x * x
+
+        @pipeline(name="add-square")
+        def add_square(a: float, b: float) -> float:
+            s = add(a=a, b=b)
+            return square(x=s)
+
+        return compile_pipeline(add_square())
+
+    def test_submit_poll_delete(self, remote):
+        remote.submit_pipeline_run("rest-run", self._ir(), {"a": 2.0, "b": 3.0})
+        run = remote.wait_for_pipeline_run("rest-run", timeout_s=120)
+        st = run["status"]
+        assert st["state"] == "Succeeded"
+        assert st["output"] == 25.0
+        assert set(st["tasks"]) == {"add", "square"}
+        # listed + deletable like any other object
+        assert any(
+            r["metadata"]["name"] == "rest-run"
+            for r in remote.list("pipelineruns")
+        )
+        remote.delete("pipelineruns", "rest-run")
+        with pytest.raises(ApiError):
+            remote.get("pipelineruns", "rest-run")
+
+    def test_bad_ir_rejected_422(self, remote):
+        with pytest.raises(ApiError) as ei:
+            remote.apply({
+                "apiVersion": "kubeflow-tpu.org/v1",
+                "kind": "PipelineRun",
+                "metadata": {"name": "bad-run"},
+                "spec": {"pipelineSpec": {"not": "an ir"}, "arguments": {}},
+            })
+        assert ei.value.code == 422
+
+    def test_failing_step_reports_failed(self, remote):
+        from kubeflow_tpu.pipelines import component, pipeline, compile_pipeline
+
+        @component
+        def boom() -> float:
+            raise RuntimeError("step exploded")
+
+        @pipeline(name="boom-pipe")
+        def boom_pipe() -> float:
+            return boom()
+
+        remote.submit_pipeline_run("boom-run", compile_pipeline(boom_pipe()), {})
+        run = remote.wait_for_pipeline_run("boom-run", timeout_s=120)
+        assert run["status"]["state"] == "Failed"
+        assert "boom" in run["status"]["tasks"]
+        assert run["status"]["error"]

@@ -1,0 +1,49 @@
+"""bench.py harness tests (CPU): JSON contract, MFU fields, error records."""
+
+import json
+import subprocess
+import sys
+
+
+def test_bench_mnist_cpu_json_contract():
+    """Run the smallest bench end-to-end in a subprocess on CPU and check
+    the one-JSON-line-per-metric contract the driver parses."""
+    code = (
+        "import bench, json\n"
+        "r = bench.bench_mnist_mlp(steps=5, batch_size=64)\n"
+        "bench._emit(r)\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=300,
+        env={"KFT_BENCH_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/bin:/bin", "HOME": "/root"},
+        cwd=".",
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "mnist_mlp_images_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert rec["unit"] == "images/sec/chip"
+    assert "vs_baseline" in rec
+    assert rec["model_flops_per_step"] > 0
+    assert "mfu" in rec  # None on cpu (no peak table entry), a float on TPU
+
+
+def test_error_record_shape():
+    import bench
+
+    rec = bench._error_record("m", "u", RuntimeError("UNAVAILABLE: boom"))
+    assert rec["value"] == 0.0 and rec["vs_baseline"] == 0.0
+    assert "UNAVAILABLE" in rec["error"]
+    assert rec["attempts"] >= 1
+
+
+def test_backend_error_classifier():
+    import bench
+
+    assert bench._is_backend_init_error(RuntimeError("UNAVAILABLE: x"))
+    assert bench._is_backend_init_error(
+        RuntimeError("Unable to initialize backend 'axon'")
+    )
+    assert not bench._is_backend_init_error(ValueError("shape mismatch"))

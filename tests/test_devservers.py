@@ -1,0 +1,106 @@
+"""Notebook + PVCViewer controller lifecycle tests (SURVEY.md §2.7)."""
+
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.client import Platform
+from kubeflow_tpu.controller.devservers import (
+    Notebook,
+    NotebookSpec,
+    PVCViewer,
+    PVCViewerSpec,
+)
+from kubeflow_tpu.controller.fakecluster import PodPhase
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    with Platform(log_dir=str(tmp_path / "pod-logs")) as p:
+        yield p
+
+
+def _wait_ready(cluster, kind, key, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        cr = cluster.get(kind, key)
+        if cr is not None and cr.status.ready:
+            return cr
+        time.sleep(0.2)
+    raise TimeoutError(f"{kind} {key} never became ready")
+
+
+class TestNotebook:
+    def test_lifecycle_ready_selfheal_delete(self, platform, tmp_path):
+        ws = tmp_path / "workspace"
+        ws.mkdir()
+        (ws / "hello.txt").write_text("notebook content")
+        nb = Notebook(
+            metadata=ObjectMeta(name="nb1"),
+            spec=NotebookSpec(workspace=str(ws)),
+        )
+        platform.cluster.create("notebooks", nb)
+        ready = _wait_ready(platform.cluster, "notebooks", "default/nb1")
+        # the dev server actually serves the workspace
+        with urllib.request.urlopen(f"{ready.status.url}/hello.txt") as r:
+            assert r.read().decode() == "notebook content"
+
+        # self-heal: kill the server process; a new pod must come up ready
+        old_pod = platform.cluster.get("pods", "default/nb1-notebook-0")
+        assert platform.pod_runtime.inject_kill("default/nb1-notebook-0")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pod = platform.cluster.get("pods", "default/nb1-notebook-0")
+            if (
+                pod is not None
+                and pod.metadata.uid != old_pod.metadata.uid
+                and pod.status.phase == PodPhase.RUNNING
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("notebook pod was not self-healed")
+        _wait_ready(platform.cluster, "notebooks", "default/nb1")
+
+        # cascade delete
+        platform.cluster.delete("notebooks", "default/nb1")
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if platform.cluster.get("pods", "default/nb1-notebook-0") is None:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("notebook pod not cleaned up after CR delete")
+
+    def test_custom_command_with_port_substitution(self, platform, tmp_path):
+        import sys
+
+        nb = Notebook(
+            metadata=ObjectMeta(name="nb2"),
+            spec=NotebookSpec(
+                command=[
+                    sys.executable, "-m", "http.server", "{port}",
+                    "--bind", "127.0.0.1", "--directory", str(tmp_path),
+                ],
+            ),
+        )
+        platform.cluster.create("notebooks", nb)
+        ready = _wait_ready(platform.cluster, "notebooks", "default/nb2")
+        assert ready.status.url.startswith("http://127.0.0.1:")
+
+
+class TestPVCViewer:
+    def test_browses_volume(self, platform, tmp_path):
+        vol = tmp_path / "pvc"
+        vol.mkdir()
+        (vol / "artifact.bin").write_bytes(b"\x00\x01")
+        pv = PVCViewer(
+            metadata=ObjectMeta(name="pv1"),
+            spec=PVCViewerSpec(pvc=str(vol)),
+        )
+        platform.cluster.create("pvcviewers", pv)
+        ready = _wait_ready(platform.cluster, "pvcviewers", "default/pv1")
+        with urllib.request.urlopen(ready.status.url) as r:
+            assert "artifact.bin" in r.read().decode()

@@ -66,3 +66,23 @@ class TestSharding:
         sh = param_shardings(params, mesh)
         assert sh["w"].spec == P(AXIS_FSDP, None)
         assert sh["b"].spec == P()
+
+
+class TestMultisliceMesh:
+    def test_data_axes_span_slices(self, cpu_devices):
+        from kubeflow_tpu.parallel import MeshConfig
+        from kubeflow_tpu.parallel.mesh import build_multislice_mesh
+
+        mesh = build_multislice_mesh(
+            2, MeshConfig(data=2, fsdp=2, model=2), cpu_devices[:8]
+        )
+        assert mesh.shape["data"] == 2
+
+    def test_rejects_ici_axis_straddling_dcn(self, cpu_devices):
+        import pytest
+
+        from kubeflow_tpu.parallel import MeshConfig
+        from kubeflow_tpu.parallel.mesh import build_multislice_mesh
+
+        with pytest.raises(ValueError, match="straddle"):
+            build_multislice_mesh(2, MeshConfig(data=1, model=4), cpu_devices[:4])

@@ -1,0 +1,198 @@
+"""Serving agent tests: micro-batching, request logging, multi-model
+repository API (SURVEY.md §2.5 Agent row)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.agent import MicroBatcher, RequestLogger
+from kubeflow_tpu.serving.model import Model
+from kubeflow_tpu.serving.server import ModelServer
+
+
+class CountingModel(Model):
+    """Doubles input; counts forward calls and per-call batch sizes."""
+
+    def __init__(self, name="counter", delay_s=0.0):
+        super().__init__(name)
+        self.calls = 0
+        self.batch_sizes = []
+        self.delay_s = delay_s
+
+    def load(self):
+        self.ready = True
+
+    def predict(self, inputs):
+        self.calls += 1
+        self.batch_sizes.append(len(inputs))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return np.asarray(inputs) * 2.0
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url, raw=False):
+    with urllib.request.urlopen(url) as r:
+        data = r.read()
+        return r.status, (data.decode() if raw else json.loads(data))
+
+
+class TestMicroBatcher:
+    def test_coalesces_concurrent_requests(self):
+        m = CountingModel(delay_s=0.01)
+        m.load()
+        b = MicroBatcher(m, max_batch_size=32, max_latency_ms=25.0)
+        results = {}
+
+        def one(i):
+            results[i] = b(np.full((1, 4), float(i)))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        b.stop()
+        # every request got ITS OWN doubled row back
+        for i, r in results.items():
+            np.testing.assert_allclose(r, np.full((1, 4), 2.0 * i))
+        # and the 16 requests rode fewer forward passes — the TPU win
+        assert m.calls < 16
+        assert sum(m.batch_sizes) == 16
+
+    def test_error_propagates_to_all_waiters(self):
+        class Boom(Model):
+            def load(self):
+                self.ready = True
+
+            def predict(self, inputs):
+                raise RuntimeError("kaput")
+
+        m = Boom("boom")
+        m.load()
+        b = MicroBatcher(m, max_batch_size=8, max_latency_ms=5.0)
+        with pytest.raises(RuntimeError, match="kaput"):
+            b(np.ones((2, 2)))
+        b.stop()
+
+    def test_flushes_on_latency_deadline(self):
+        m = CountingModel()
+        m.load()
+        b = MicroBatcher(m, max_batch_size=1024, max_latency_ms=10.0)
+        out = b(np.ones((3, 2)))  # single request, far below max_batch
+        np.testing.assert_allclose(out, 2.0 * np.ones((3, 2)))
+        b.stop()
+
+
+class TestServerAgentFeatures:
+    @pytest.fixture()
+    def server(self, tmp_path):
+        m = CountingModel()
+        srv = ModelServer(
+            [m], port=0,
+            request_log_path=str(tmp_path / "requests.jsonl"),
+            max_batch_size=16, batch_max_latency_ms=10.0,
+        ).start()
+        yield srv, m, tmp_path
+        srv.stop()
+
+    def test_batched_http_predict_and_logging(self, server):
+        srv, m, tmp_path = server
+        codes = []
+
+        def one(i):
+            code, out = _post(
+                f"{srv.url}/v1/models/counter:predict",
+                {"instances": [[float(i)] * 4]},
+            )
+            codes.append(code)
+            assert out["predictions"] == [[2.0 * i] * 4]
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert codes == [200] * 12
+        assert m.calls < 12  # coalesced
+
+        # request log has one JSONL line per request
+        lines = (tmp_path / "requests.jsonl").read_text().strip().splitlines()
+        assert len(lines) == 12
+        rec = json.loads(lines[0])
+        assert rec["model"] == "counter" and rec["code"] == 200
+        assert rec["latency_ms"] >= 0
+
+        # /metrics exposes counters
+        code, text = _get(f"{srv.url}/metrics", raw=True)
+        assert code == 200
+        assert 'kfserving_requests_total{model="counter",protocol="v1",code="200"} 12' in text
+        assert 'kfserving_request_latency_seconds_count{model="counter"} 12' in text
+
+
+class TestRepositoryAPI:
+    def test_load_unload_multi_model(self, tmp_path):
+        from kubeflow_tpu.models import MnistMLP
+        from kubeflow_tpu.serving.model import save_predictor
+
+        # two model artifacts in one repository dir
+        model = MnistMLP(hidden=(8,))
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        for name in ("alpha", "beta"):
+            save_predictor(tmp_path / name, "mnist-mlp", dict(variables), x,
+                           hidden=[8])
+
+        srv = ModelServer([], port=0, repository_dir=str(tmp_path)).start()
+        try:
+            code, idx = _post(f"{srv.url}/v2/repository/index", {})
+            assert code == 200 and idx == []
+
+            code, out = _post(f"{srv.url}/v2/repository/models/alpha/load", {})
+            assert code == 200 and out["state"] == "READY"
+            code, out = _post(f"{srv.url}/v2/repository/models/beta/load", {})
+            assert code == 200
+
+            code, idx = _post(f"{srv.url}/v2/repository/index", {})
+            assert [m["name"] for m in idx] == ["alpha", "beta"]
+            assert all(m["state"] == "READY" for m in idx)
+
+            # both models serve
+            code, out = _post(
+                f"{srv.url}/v2/models/alpha/infer",
+                {"inputs": [{"name": "input-0", "shape": [1, 28, 28, 1],
+                             "datatype": "FP32",
+                             "data": [0.0] * (28 * 28)}]},
+            )
+            assert code == 200 and out["model_name"] == "alpha"
+
+            code, out = _post(f"{srv.url}/v2/repository/models/alpha/unload", {})
+            assert code == 200 and out["state"] == "UNAVAILABLE"
+            code, idx = _post(f"{srv.url}/v2/repository/index", {})
+            assert [m["name"] for m in idx] == ["beta"]
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"{srv.url}/v1/models/alpha:predict", {"instances": [[0.0]]})
+            assert ei.value.code == 404
+
+            code, out = _post(
+                f"{srv.url}/v2/repository/models/missing/load", {}
+            )
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 500  # missing artifact surfaces as load error
+        finally:
+            srv.stop()
