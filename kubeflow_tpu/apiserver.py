@@ -8,6 +8,10 @@ kubectl/k8s clients drive the reference:
 
   GET    /healthz | /metrics | /readyz
   GET    /api/v1/{kind}                     list (all namespaces)
+  GET    /api/v1/{kind}?watch=true          NDJSON event stream (list+watch:
+                                            current objects replay as ADDED;
+                                            &timeoutSeconds=N bounds it;
+                                            &namespace=/&name= filter)
   GET    /api/v1/{kind}/{ns}/{name}         get
   POST   /api/v1/{kind}                     create (manifest body)
   DELETE /api/v1/{kind}/{ns}/{name}         delete (cascade for jobs/isvc)
@@ -108,7 +112,14 @@ def _deserialize(manifest: dict):
 
 
 class PlatformServer:
-    """Serves a Platform over REST."""
+    """Serves a Platform over REST.
+
+    Watch semantics (kube-apiserver `?watch=true` parity — round-1 weak #7:
+    remote clients previously had only O(poll)): the stream replays current
+    objects as ADDED then tails live events as NDJSON lines
+    `{"type": "ADDED|MODIFIED|DELETED", "object": {...}}` until
+    timeoutSeconds elapses or the client disconnects.
+    """
 
     def __init__(self, platform, port: int = 8080, host: str = "127.0.0.1"):
         self.platform = platform
@@ -215,6 +226,52 @@ class PlatformServer:
             return 200, {"deleted": key}
         return 405, {"error": f"{method} not supported on {parsed.path!r}"}
 
+    # -------------------------------------------------------------- watch
+
+    def stream_watch(self, wfile, kind: str, query: dict) -> None:
+        """Write an NDJSON watch stream for one kind until timeout/disconnect."""
+        import queue as queue_mod
+        import time
+
+        cluster = self.platform.cluster
+        ns_filter = query.get("namespace", "")
+        name_filter = query.get("name", "")
+        timeout_s = min(float(query.get("timeoutSeconds", "60")), 600.0)
+        deadline = time.monotonic() + timeout_s
+
+        def want(obj) -> bool:
+            meta = getattr(obj, "metadata", None)
+            if meta is None:
+                return False
+            if ns_filter and meta.namespace != ns_filter:
+                return False
+            if name_filter and meta.name != name_filter:
+                return False
+            return True
+
+        q = cluster.watch(replay=True)
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    etype, ekind, obj = q.get(
+                        timeout=min(0.5, max(deadline - time.monotonic(), 0.01))
+                    )
+                except queue_mod.Empty:
+                    continue
+                if ekind != kind or not want(obj):
+                    continue
+                line = json.dumps({
+                    "type": etype.name
+                    if hasattr(etype, "name") else str(etype),
+                    "object": _serialize(kind, obj),
+                }) + "\n"
+                wfile.write(line.encode())
+                wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away — normal watch termination
+        finally:
+            cluster.unwatch(q)
+
     # ----------------------------------------------------------- lifecycle
 
     def start(self) -> "PlatformServer":
@@ -225,6 +282,30 @@ class PlatformServer:
                 pass
 
             def _dispatch(self, method):
+                # watch requests stream — they never go through _reply
+                parsed = urllib.parse.urlparse(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                parts = [p for p in parsed.path.split("/") if p]
+                if (
+                    method == "GET"
+                    and query.get("watch") in ("true", "1")
+                    and len(parts) == 3
+                    and parts[0] == "api" and parts[1] == "v1"
+                ):
+                    kind = parts[2]
+                    if kind not in server.platform.cluster.KINDS:
+                        self._reply(404, {"error": f"unknown kind {kind!r}"})
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "identity")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    server.stream_watch(self.wfile, kind, query)
+                    return
+                self._dispatch_plain(method)
+
+            def _dispatch_plain(self, method):
                 body = None
                 length = int(self.headers.get("Content-Length", 0))
                 if length:

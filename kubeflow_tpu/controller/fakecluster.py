@@ -16,6 +16,7 @@ import enum
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -31,6 +32,61 @@ class EventType(str, enum.Enum):
 class ConflictError(Exception):
     """Optimistic-concurrency failure: the object changed since it was read
     (k8s 409 Conflict analogue). Callers re-read and retry."""
+
+
+_ETYPE_CODE = {EventType.ADDED: 0, EventType.MODIFIED: 1, EventType.DELETED: 2}
+
+
+class WatchSubscription:
+    """queue.Queue-shaped view over one native event-hub subscription.
+
+    get() resolves hub (seq, etype, kind, key) records back to the object
+    snapshots the cluster retained; an overflowed (or snapshot-expired)
+    subscriber transparently receives a fresh relist — current objects as
+    ADDED — exactly how an informer recovers from 'resourceVersion expired'.
+    """
+
+    def __init__(self, cluster: "FakeCluster", sub_id: int):
+        self._cluster = cluster
+        self._sub_id = sub_id
+        self._pending: deque = deque()
+        self._closed = False
+
+    def _relist_locked(self) -> None:
+        """Queue a full relist; caller holds cluster._mu."""
+        self._pending.clear()
+        for kind in self._cluster.KINDS:
+            for obj in self._cluster._objects[kind].values():
+                self._pending.append((EventType.ADDED, kind, obj))
+
+    def get(self, timeout: float | None = None):
+        """Next (etype, kind, obj); raises queue.Empty on timeout."""
+        if self._pending:
+            return self._pending.popleft()
+        if self._closed:
+            raise queue.Empty
+        hub = self._cluster._hub
+        rc, seq, etype_code, _kind, _key = hub.poll(
+            self._sub_id, 0.0 if timeout is None else timeout
+        )
+        if rc == hub.EVENT:
+            with self._cluster._mu:
+                snap = self._cluster._snapshots.get(seq)
+                if snap is None:  # window expired under extreme lag
+                    self._relist_locked()
+            if snap is not None:
+                return snap
+            return self.get(timeout=0.0)
+        if rc == hub.OVERFLOWED:
+            with self._cluster._mu:
+                self._relist_locked()
+            return self.get(timeout=0.0)
+        raise queue.Empty  # EMPTY or GONE
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._cluster._hub.unsubscribe(self._sub_id)
 
 
 class PodPhase(str, enum.Enum):
@@ -109,10 +165,22 @@ class FakeCluster:
         "tensorboards", "pipelineruns", "notebooks", "pvcviewers",
     )
 
+    #: per-subscriber buffered events before a forced relist (native hub)
+    WATCH_CAPACITY = 4096
+
     def __init__(self) -> None:
+        from kubeflow_tpu.native import EventHub
+
         self._mu = threading.RLock()
         self._objects: dict[str, dict[str, Any]] = {k: {} for k in self.KINDS}
-        self._watchers: list[queue.Queue] = []
+        # native informer fan-out (SURVEY.md §2.8 "Go controller machinery"):
+        # sequencing + bounded per-subscriber buffers live in C++
+        # (native/src/eventhub.cc); object snapshots stay here, keyed by seq,
+        # in a window matching the hub capacity so memory is bounded even
+        # under a stuck REST watch client
+        self._hub = EventHub(self.WATCH_CAPACITY)
+        self._snapshots: dict[int, tuple[EventType, str, Any]] = {}
+        self._snapshot_min = 0
         self._rv = 0
         self.events: list[ClusterEvent] = []
         self.capacity_chips = 8  # schedulable "chips" for the gang scheduler
@@ -181,26 +249,33 @@ class FakeCluster:
 
     # ----------------------------------------------------------------- watch
 
-    def watch(self, replay: bool = True) -> queue.Queue:
+    def watch(self, replay: bool = True) -> "WatchSubscription":
         """Subscribe to all events; optionally replay current objects as
-        ADDED (informer initial list+watch semantics)."""
-        q: queue.Queue = queue.Queue()
+        ADDED (informer initial list+watch semantics). The returned
+        subscription is queue.Queue-shaped (.get(timeout=) raising
+        queue.Empty); a subscriber that falls WATCH_CAPACITY events behind
+        is transparently relisted (k8s "watch too old" semantics)."""
         with self._mu:
+            # subscribe-then-snapshot under the lock: no event can be missed
+            # between the initial list and the live tail
+            sub_id = self._hub.subscribe()
+            sub = WatchSubscription(self, sub_id)
             if replay:
-                for kind in self.KINDS:
-                    for obj in self._objects[kind].values():
-                        q.put((EventType.ADDED, kind, obj))
-            self._watchers.append(q)
-        return q
+                sub._relist_locked()
+        return sub
 
-    def unwatch(self, q: queue.Queue) -> None:
-        with self._mu:
-            if q in self._watchers:
-                self._watchers.remove(q)
+    def unwatch(self, sub: "WatchSubscription") -> None:
+        sub.close()
 
     def _notify(self, etype: EventType, kind: str, obj: Any) -> None:
-        for q in self._watchers:
-            q.put((etype, kind, obj))
+        # caller holds self._mu (all CRUD paths); publish + snapshot are
+        # atomic with respect to subscribe-and-relist
+        seq = self._hub.publish(_ETYPE_CODE[etype], kind, self._key(obj))
+        self._snapshots[seq] = (etype, kind, obj)
+        floor = seq - 2 * self.WATCH_CAPACITY
+        while self._snapshot_min <= floor:
+            self._snapshots.pop(self._snapshot_min, None)
+            self._snapshot_min += 1
 
     # ---------------------------------------------------------------- events
 

@@ -79,6 +79,25 @@ def lib() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong),
         ]
+        # event hub
+        L.kf_hub_new.restype = ctypes.c_void_p
+        L.kf_hub_new.argtypes = [ctypes.c_int]
+        L.kf_hub_free.argtypes = [ctypes.c_void_p]
+        L.kf_hub_subscribe.restype = ctypes.c_longlong
+        L.kf_hub_subscribe.argtypes = [ctypes.c_void_p]
+        L.kf_hub_unsubscribe.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+        L.kf_hub_publish.restype = ctypes.c_longlong
+        L.kf_hub_publish.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        L.kf_hub_poll.restype = ctypes.c_int
+        L.kf_hub_poll.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+        ]
+        L.kf_hub_backlog.restype = ctypes.c_int
+        L.kf_hub_backlog.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
         # metastore
         L.kf_ms_open.restype = ctypes.c_void_p
         L.kf_ms_open.argtypes = [ctypes.c_char_p]
@@ -190,6 +209,58 @@ class Expectations:
         if self._h:
             self._L.kf_exp_free(self._h)
             self._h = None
+
+
+class EventHub:
+    """Broadcast hub with bounded per-subscriber buffers (informer fan-out).
+
+    poll() returns (rc, seq, etype, kind, key): rc 0 = event, 1 = timeout,
+    2 = subscriber overflowed (cleared — relist), 3 = unknown subscriber.
+    """
+
+    EVENT, EMPTY, OVERFLOWED, GONE = 0, 1, 2, 3
+
+    def __init__(self, capacity: int = 4096):
+        self._L = lib()
+        self._h = self._L.kf_hub_new(capacity)
+        self.capacity = capacity
+
+    def subscribe(self) -> int:
+        return self._L.kf_hub_subscribe(self._h)
+
+    def unsubscribe(self, sub_id: int) -> None:
+        self._L.kf_hub_unsubscribe(self._h, sub_id)
+
+    def publish(self, etype: int, kind: str, key: str) -> int:
+        return self._L.kf_hub_publish(self._h, etype, kind.encode(), key.encode())
+
+    def poll(self, sub_id: int, timeout_s: float):
+        seq = ctypes.c_longlong()
+        etype = ctypes.c_int()
+        kind = ctypes.c_void_p()
+        key = ctypes.c_void_p()
+        rc = self._L.kf_hub_poll(
+            self._h, sub_id, timeout_s,
+            ctypes.byref(seq), ctypes.byref(etype),
+            ctypes.byref(kind), ctypes.byref(key),
+        )
+        if rc != 0:
+            return rc, 0, 0, None, None
+        return rc, seq.value, etype.value, _take_string(kind.value), _take_string(key.value)
+
+    def backlog(self, sub_id: int) -> int:
+        return self._L.kf_hub_backlog(self._h, sub_id)
+
+    def close(self) -> None:
+        if self._h:
+            self._L.kf_hub_free(self._h)
+            self._h = None
+
+    def __del__(self):  # clusters are created per test; don't leak the hub
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
 
 _FS, _RS = "\x1f", "\x1e"

@@ -1,0 +1,177 @@
+// Event hub — the informer watch fan-out, native.
+//
+// The reference's Go controllers share one informer event pipeline
+// (client-go sharedIndexInformer: apiserver watch -> bounded per-consumer
+// delivery, slow consumers forced to relist — SURVEY.md §2.8 native ledger,
+// "Go controller machinery"). This is that pipeline's core: a broadcast hub
+// with per-subscriber bounded ring buffers. Publish assigns a global
+// sequence number; each subscriber drains at its own pace; a subscriber
+// that falls more than `capacity` behind is marked OVERFLOWED and must
+// relist (the k8s "watch too old / resourceVersion expired" semantics —
+// the Python fan-out this replaces grew unbounded queues under slow REST
+// watchers).
+//
+// The hub carries only (seq, etype, kind, key) — object snapshots stay on
+// the Python side in a deque bounded to the same capacity, so memory is
+// bounded end-to-end and the C ABI stays string-simple.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this environment).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Event {
+  int64_t seq;
+  int etype;
+  std::string kind;
+  std::string key;
+};
+
+struct Subscriber {
+  std::deque<Event> buf;
+  bool overflowed = false;
+};
+
+class EventHub {
+ public:
+  explicit EventHub(int capacity) : capacity_(capacity < 1 ? 1 : capacity) {}
+
+  int64_t Subscribe() {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t id = next_sub_++;
+    subs_.emplace(id, Subscriber{});
+    return id;
+  }
+
+  void Unsubscribe(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    subs_.erase(id);
+  }
+
+  int64_t Publish(int etype, const char* kind, const char* key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t seq = next_seq_++;
+    for (auto& [id, sub] : subs_) {
+      if (sub.overflowed) continue;  // already requires a relist
+      if (static_cast<int>(sub.buf.size()) >= capacity_) {
+        // slow consumer: drop its backlog, force relist
+        sub.buf.clear();
+        sub.overflowed = true;
+        continue;
+      }
+      sub.buf.push_back(Event{seq, etype, kind, key});
+    }
+    cv_.notify_all();
+    return seq;
+  }
+
+  // rc: 0 = event written to out params, 1 = timeout/empty, 2 = overflowed
+  // (cleared — caller must relist), 3 = unknown subscriber.
+  int Poll(int64_t id, double timeout_s, int64_t* seq, int* etype,
+           std::string* kind, std::string* key) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration_cast<std::chrono::nanoseconds>(
+                        std::chrono::duration<double>(timeout_s < 0 ? 0 : timeout_s));
+    for (;;) {
+      auto it = subs_.find(id);
+      if (it == subs_.end()) return 3;
+      Subscriber& sub = it->second;
+      if (sub.overflowed) {
+        sub.overflowed = false;
+        return 2;
+      }
+      if (!sub.buf.empty()) {
+        Event ev = sub.buf.front();
+        sub.buf.pop_front();
+        *seq = ev.seq;
+        *etype = ev.etype;
+        *kind = ev.kind;
+        *key = ev.key;
+        return 0;
+      }
+      if (timeout_s <= 0 ||
+          cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+        auto again = subs_.find(id);
+        if (again == subs_.end()) return 3;
+        if (again->second.overflowed) {
+          again->second.overflowed = false;
+          return 2;
+        }
+        if (!again->second.buf.empty()) continue;  // raced a publish
+        return 1;
+      }
+    }
+  }
+
+  int Backlog(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = subs_.find(id);
+    return it == subs_.end() ? -1 : static_cast<int>(it->second.buf.size());
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<int64_t, Subscriber> subs_;
+  int capacity_;
+  int64_t next_sub_ = 1;
+  int64_t next_seq_ = 1;
+};
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kf_hub_new(int capacity) { return new EventHub(capacity); }
+void kf_hub_free(void* h) { delete static_cast<EventHub*>(h); }
+
+long long kf_hub_subscribe(void* h) {
+  return static_cast<EventHub*>(h)->Subscribe();
+}
+
+void kf_hub_unsubscribe(void* h, long long id) {
+  static_cast<EventHub*>(h)->Unsubscribe(id);
+}
+
+long long kf_hub_publish(void* h, int etype, const char* kind,
+                         const char* key) {
+  return static_cast<EventHub*>(h)->Publish(etype, kind, key);
+}
+
+// rc as in EventHub::Poll; on rc==0, *out_seq/*out_etype are set and
+// *out_kind/*out_key are malloc'd strings the caller frees via kf_free.
+int kf_hub_poll(void* h, long long id, double timeout_s, long long* out_seq,
+                int* out_etype, char** out_kind, char** out_key) {
+  int64_t seq = 0;
+  int etype = 0;
+  std::string kind, key;
+  int rc = static_cast<EventHub*>(h)->Poll(id, timeout_s, &seq, &etype,
+                                           &kind, &key);
+  if (rc == 0) {
+    *out_seq = seq;
+    *out_etype = etype;
+    *out_kind = dup_string(kind);
+    *out_key = dup_string(key);
+  }
+  return rc;
+}
+
+int kf_hub_backlog(void* h, long long id) {
+  return static_cast<EventHub*>(h)->Backlog(id);
+}
+
+}  // extern "C"

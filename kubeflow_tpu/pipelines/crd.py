@@ -149,26 +149,35 @@ class PipelineRunController(ControllerBase):
         except Exception as exc:  # noqa: BLE001 — a bad IR must not kill the controller
             state, tasks, output, run_id = "Failed", {}, None, ""
             error = f"{type(exc).__name__}: {exc}"
+        try:
+            done = False
+            for _ in range(10):  # optimistic-concurrency retry on status write
+                cur = self.cluster.get("pipelineruns", key, copy_obj=True)
+                if cur is None or cur.metadata.uid != uid:
+                    return  # deleted/replaced while executing
+                cur.status.state = state
+                cur.status.tasks = tasks
+                cur.status.output = output
+                cur.status.error = error
+                cur.status.run_id = run_id
+                cur.status.completion_time = _now()
+                try:
+                    self.cluster.update("pipelineruns", cur)
+                    done = True
+                    break
+                except ConflictError:
+                    continue
+                except KeyError:
+                    return
+            if not done:
+                return
         finally:
+            # only AFTER the terminal status is durable (or the run is gone)
+            # may a resync legally consider this uid idle — discarding
+            # earlier would let reconcile spawn a second executor and run
+            # every pipeline step twice
             with self._mu:
                 self._running.discard(uid)
-        for _ in range(10):  # optimistic-concurrency retry on status write
-            cur = self.cluster.get("pipelineruns", key, copy_obj=True)
-            if cur is None or cur.metadata.uid != uid:
-                return  # deleted/replaced while executing
-            cur.status.state = state
-            cur.status.tasks = tasks
-            cur.status.output = output
-            cur.status.error = error
-            cur.status.run_id = run_id
-            cur.status.completion_time = _now()
-            try:
-                self.cluster.update("pipelineruns", cur)
-                break
-            except ConflictError:
-                continue
-            except KeyError:
-                return
         counter = (
             "pipelineruns_succeeded_total" if state == "Succeeded"
             else "pipelineruns_failed_total"

@@ -89,19 +89,56 @@ class RemoteClient:
             "POST", f"/api/v1/jobs/{namespace}/{name}/scale", {"replicas": replicas}
         )
 
+    # ------------------------------------------------------------------ watch
+
+    def watch(self, kind: str, namespace: str = "", name: str = "",
+              timeout_s: float = 60.0):
+        """NDJSON watch stream: yields {"type": ..., "object": ...} events
+        (list+watch: current objects arrive first as ADDED). Terminates when
+        the server-side timeout elapses."""
+        q = urllib.parse.urlencode({
+            "watch": "true", "timeoutSeconds": f"{timeout_s:.0f}",
+            **({"namespace": namespace} if namespace else {}),
+            **({"name": name} if name else {}),
+        })
+        req = urllib.request.Request(f"{self.server}/api/v1/{kind}?{q}")
+        with urllib.request.urlopen(req, timeout=timeout_s + 10.0) as resp:
+            for line in resp:
+                if line.strip():
+                    yield json.loads(line)
+
     def wait_for_job(self, name: str, namespace: str = "default",
                      timeout_s: float = 600.0, poll_s: float = 0.5) -> dict:
-        """Poll until the job reaches a terminal condition."""
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            job = self.get("jobs", name, namespace)
+        """Watch until the job reaches a terminal condition (falls back to
+        polling if the stream drops — e.g. a server without watch support)."""
+
+        def terminal(job: dict) -> bool:
             conds = {
                 c["type"] for c in job.get("status", {}).get("conditions", [])
                 if c.get("status", True)
             }
-            if conds & {"Succeeded", "Failed"}:
-                return job
-            time.sleep(poll_s)
+            return bool(conds & {"Succeeded", "Failed"})
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                for ev in self.watch(
+                    "jobs", namespace=namespace, name=name,
+                    timeout_s=min(30.0, max(deadline - time.monotonic(), 1.0)),
+                ):
+                    if not isinstance(ev, dict) or "type" not in ev:
+                        # server without watch support returned a plain list
+                        raise OSError("watch unsupported")
+                    if ev["type"] == "DELETED":
+                        raise KeyError(f"job {namespace}/{name} deleted")
+                    if terminal(ev["object"]):
+                        return ev["object"]
+            except (ApiError, OSError, json.JSONDecodeError):
+                # watch unsupported or stream dropped: one polling pass
+                job = self.get("jobs", name, namespace)
+                if terminal(job):
+                    return job
+                time.sleep(poll_s)
         raise TimeoutError(f"job {namespace}/{name} not finished in {timeout_s}s")
 
     # ------------------------------------------------------------- pipelines

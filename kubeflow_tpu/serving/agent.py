@@ -127,7 +127,9 @@ class MicroBatcher:
     # --------------------------------------------------------------- client
 
     def __call__(self, arr: np.ndarray):
-        p = _Pending(arr=np.asarray(arr))
+        # 0-d input would crash the shared worker (len() of unsized object)
+        # — normalize here so one bad request can never kill the batcher
+        p = _Pending(arr=np.atleast_1d(np.asarray(arr)))
         with self._cv:
             if self._stop:
                 raise RuntimeError("batcher stopped")
@@ -162,7 +164,11 @@ class MicroBatcher:
                 while self._q and rows < self.max_batch_size:
                     items.append(self._q.popleft())
                     rows += len(items[-1].arr)
-            self._run(items)
+            try:
+                self._run(items)
+            except BaseException:  # noqa: BLE001 — the worker must not die
+                for p in items:
+                    p.event.set()
 
     def _run(self, items: list[_Pending]) -> None:
         try:

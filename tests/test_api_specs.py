@@ -198,3 +198,29 @@ class TestStatusMachine:
         )
         labels = job.labels(REPLICA_WORKER, 2)
         assert labels["kubeflow-tpu.org/replica-index"] == "2"
+
+
+class TestSampleFixtures:
+    def test_every_sample_deserializes(self):
+        """samples/ doubles as fixtures for EVERY registered kind: each must
+        round-trip the apiserver's deserializer (schema drift breaks this)."""
+        import pathlib
+
+        import yaml as yaml_mod
+
+        from kubeflow_tpu.apiserver import _deserialize
+        from kubeflow_tpu.api.serde import MANIFEST_KINDS
+
+        seen_kinds = set()
+        sample_dir = pathlib.Path(__file__).parent.parent / "samples"
+        for path in sorted(sample_dir.glob("*.yaml")):
+            manifest = yaml_mod.safe_load(path.read_text())
+            bucket, obj = _deserialize(manifest)
+            assert bucket == MANIFEST_KINDS[manifest["kind"]], path.name
+            assert obj.metadata.name, path.name
+            seen_kinds.add(manifest["kind"])
+        # every non-job CR family is represented (jobs covered by JAXJob/MXJob)
+        assert {
+            "JAXJob", "MXJob", "Experiment", "InferenceService", "PodDefault",
+            "Profile", "Tensorboard", "Notebook", "PVCViewer",
+        } <= seen_kinds

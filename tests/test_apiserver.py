@@ -194,3 +194,50 @@ class TestPipelineRunsOverREST:
         assert run["status"]["state"] == "Failed"
         assert "boom" in run["status"]["tasks"]
         assert run["status"]["error"]
+
+
+class TestWatch:
+    """kube-apiserver ?watch=true parity (round-1 weak #7)."""
+
+    def test_watch_streams_lifecycle_events(self, remote, tmp_path):
+        import threading
+
+        events = []
+
+        def watcher():
+            for ev in remote.watch("jobs", name="watchjob", timeout_s=30):
+                events.append(ev)
+                if ev["type"] == "MODIFIED" and {
+                    c["type"] for c in
+                    ev["object"].get("status", {}).get("conditions", [])
+                    if c.get("status", True)
+                } & {"Succeeded", "Failed"}:
+                    return
+
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        remote.apply(job_manifest(tmp_path, name="watchjob", replicas=1))
+        t.join(timeout=60)
+        assert not t.is_alive(), "watch never saw the terminal condition"
+        types = [e["type"] for e in events]
+        assert "ADDED" in types and "MODIFIED" in types
+        assert all(e["object"]["metadata"]["name"] == "watchjob" for e in events)
+
+    def test_wait_for_job_via_watch(self, remote, tmp_path):
+        remote.apply(job_manifest(tmp_path, name="watchwait", replicas=1))
+        job = remote.wait_for_job("watchwait", timeout_s=60)
+        conds = {c["type"] for c in job["status"]["conditions"] if c.get("status", True)}
+        assert "Succeeded" in conds
+
+    def test_watch_replays_existing_as_added(self, remote, tmp_path):
+        remote.apply(job_manifest(tmp_path, name="preexist", replicas=1))
+        remote.wait_for_job("preexist", timeout_s=60)
+        ev = next(iter(remote.watch("jobs", name="preexist", timeout_s=5)))
+        assert ev["type"] == "ADDED"
+        assert ev["object"]["metadata"]["name"] == "preexist"
+
+    def test_watch_unknown_kind_404(self, remote):
+        import urllib.error
+
+        with pytest.raises((ApiError, urllib.error.HTTPError)):
+            list(remote.watch("nonsense", timeout_s=2))

@@ -1,0 +1,104 @@
+"""Native event hub + WatchSubscription semantics (SURVEY.md §2.8:
+the informer fan-out machinery, now C++ like the reference's Go)."""
+
+import queue
+import threading
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.controller.fakecluster import EventType, FakeCluster, Pod
+from kubeflow_tpu.native import EventHub
+
+
+class TestEventHub:
+    def test_broadcast_ordering(self):
+        hub = EventHub(capacity=16)
+        a, b = hub.subscribe(), hub.subscribe()
+        s1 = hub.publish(0, "pods", "ns/x")
+        s2 = hub.publish(1, "pods", "ns/x")
+        assert s2 == s1 + 1
+        for sub in (a, b):
+            rc, seq, et, kind, key = hub.poll(sub, 0.1)
+            assert (rc, seq, et, kind, key) == (0, s1, 0, "pods", "ns/x")
+            rc, seq, et, _, _ = hub.poll(sub, 0.1)
+            assert (rc, seq, et) == (0, s2, 1)
+        hub.close()
+
+    def test_slow_consumer_overflows_and_recovers(self):
+        hub = EventHub(capacity=4)
+        sub = hub.subscribe()
+        for i in range(10):
+            hub.publish(0, "pods", f"ns/p{i}")
+        rc, *_ = hub.poll(sub, 0.0)
+        assert rc == EventHub.OVERFLOWED
+        assert hub.backlog(sub) == 0
+        # after the overflow is consumed, the subscriber receives again
+        hub.publish(0, "pods", "ns/new")
+        rc, _, _, _, key = hub.poll(sub, 0.1)
+        assert rc == EventHub.EVENT and key == "ns/new"
+        hub.close()
+
+    def test_unknown_subscriber(self):
+        hub = EventHub(capacity=4)
+        rc, *_ = hub.poll(999, 0.0)
+        assert rc == EventHub.GONE
+        hub.close()
+
+    def test_poll_blocks_until_publish(self):
+        hub = EventHub(capacity=4)
+        sub = hub.subscribe()
+        got = []
+
+        def consumer():
+            got.append(hub.poll(sub, 5.0))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        hub.publish(2, "jobs", "ns/j")
+        t.join(timeout=10)
+        assert not t.is_alive()
+        rc, _, et, kind, key = got[0]
+        assert (rc, et, kind, key) == (0, 2, "jobs", "ns/j")
+        hub.close()
+
+
+class TestWatchSubscription:
+    def test_replay_then_live_tail(self):
+        c = FakeCluster()
+        c.create("pods", Pod(metadata=ObjectMeta(name="pre")))
+        sub = c.watch()
+        etype, kind, obj = sub.get(timeout=1.0)
+        assert (etype, kind, obj.metadata.name) == (EventType.ADDED, "pods", "pre")
+        c.create("pods", Pod(metadata=ObjectMeta(name="live")))
+        etype, kind, obj = sub.get(timeout=1.0)
+        assert (etype, obj.metadata.name) == (EventType.ADDED, "live")
+        c.unwatch(sub)
+
+    def test_overflowed_watcher_relists(self):
+        c = FakeCluster()
+        sub = c.watch()  # empty replay
+        # out-lag the hub capacity: the subscriber must come back with a
+        # relist (current objects as ADDED), not a crash or a stale stream
+        n = c.WATCH_CAPACITY + 50
+        for i in range(n):
+            c.create("pods", Pod(metadata=ObjectMeta(name=f"p{i:05d}")))
+        seen = {}
+        while True:
+            try:
+                etype, kind, obj = sub.get(timeout=0.2)
+            except queue.Empty:
+                break
+            seen[obj.metadata.name] = etype
+        # every object is represented exactly once post-relist
+        assert len(seen) == n
+        assert all(e == EventType.ADDED for e in seen.values())
+        c.unwatch(sub)
+
+    def test_closed_subscription_raises_empty(self):
+        c = FakeCluster()
+        sub = c.watch()
+        sub.close()
+        try:
+            sub.get(timeout=0.05)
+            raise AssertionError("expected queue.Empty")
+        except queue.Empty:
+            pass
