@@ -8,6 +8,8 @@ never had — SURVEY.md §5.7).
 
   python -m examples.bert --device=tpu --size=base --steps=100
   python -m examples.bert --size=tiny --seq-len=2048 --attention=ring --context=4
+  python -m examples.bert --size=tiny --moe-experts=8 --expert-parallel=2
+  python -m examples.bert --size=tiny --pipeline-stages=2 --data-parallel=4
 """
 
 from __future__ import annotations
@@ -31,6 +33,11 @@ def main(argv: list[str] | None = None) -> float:
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--model-parallel", type=int, default=1)
     p.add_argument("--context", type=int, default=1)
+    # MoE: >0 swaps every MLP for a MoeMlp dispatched over `expert`
+    p.add_argument("--moe-experts", type=int, default=0)
+    p.add_argument("--expert-parallel", type=int, default=1)
+    # PP: >1 pipelines the encoder stack over the `pipeline` axis
+    p.add_argument("--pipeline-stages", type=int, default=1)
     p.add_argument("--checkpoint-dir", default=None)
     args = p.parse_args(argv)
 
@@ -52,6 +59,7 @@ def main(argv: list[str] | None = None) -> float:
         attention=args.attention,
         max_len=max(args.seq_len, 512),
         dropout_rate=0.0 if args.attention != "dense" else 0.1,
+        moe_experts=args.moe_experts,
     )
     ds = synthetic_text_dataset(
         n_train=args.batch_size * 8,
@@ -60,8 +68,25 @@ def main(argv: list[str] | None = None) -> float:
         vocab_size=cfg.vocab_size,
         num_classes=args.num_classes,
     )
+    if args.pipeline_stages > 1:
+        from kubeflow_tpu.models import BertPipelineClassifier
+
+        # microbatches must stay divisible by the data-like mesh extent
+        data_ways = max(args.data_parallel, 1) * args.fsdp * args.expert_parallel
+        n_micro = 2 * args.pipeline_stages
+        while n_micro > 1 and (
+            args.batch_size % n_micro
+            or (args.batch_size // n_micro) % data_ways
+        ):
+            n_micro -= 1
+        model = BertPipelineClassifier(
+            cfg, num_classes=args.num_classes,
+            num_stages=args.pipeline_stages, n_micro=n_micro,
+        )
+    else:
+        model = BertForSequenceClassification(cfg, num_classes=args.num_classes)
     trainer = Trainer(
-        BertForSequenceClassification(cfg, num_classes=args.num_classes),
+        model,
         TrainerConfig(
             batch_size=args.batch_size,
             steps=args.steps,
@@ -74,6 +99,8 @@ def main(argv: list[str] | None = None) -> float:
                 fsdp=args.fsdp,
                 model=args.model_parallel,
                 context=args.context,
+                expert=args.expert_parallel,
+                pipeline=args.pipeline_stages if args.pipeline_stages > 1 else 1,
             ),
             log_every_steps=10,
         ),

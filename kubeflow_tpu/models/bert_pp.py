@@ -164,12 +164,18 @@ class BertPipelineClassifier:
             {"params": p["embeddings"]}, input_ids, train,
             rngs={"dropout": drop} if (train and drop is not None) else {},
         )
+        # the ring (and its transpose psums) runs in f32: a low-precision
+        # all-reduce at the shard_map boundary trips XLA's AllReducePromotion
+        # pass (CHECK crash); stages still compute in the model dtype
+        x = x.astype(jnp.float32)
 
         def stage_fn(sp, act, *, stage, rng):
             h, m = act
             srngs = {"dropout": rng} if (train and rng is not None) else {}
-            h = self._stage.apply({"params": sp}, h, m > 0, train, rngs=srngs)
-            return (constrain(h, ACT_SPEC), m)
+            h = self._stage.apply(
+                {"params": sp}, h.astype(c.dtype), m > 0, train, rngs=srngs
+            )
+            return (constrain(h.astype(jnp.float32), ACT_SPEC), m)
 
         out, _ = gpipe(
             stage_fn,

@@ -122,6 +122,19 @@ def gpipe(
         )
 
     mb = batch // n_micro
+    from kubeflow_tpu.parallel.sharding import BATCH_AXES
+
+    data_ways = 1
+    for a in BATCH_AXES:
+        data_ways *= mesh.shape.get(a, 1)
+    if mb % data_ways:
+        raise ValueError(
+            f"microbatch size {mb} (batch {batch} / n_micro {n_micro}) must "
+            f"be divisible by the data-like mesh extent {data_ways}; lower "
+            f"n_micro or raise the batch size (a non-divisible microbatch "
+            f"forces the partitioner into padded reshards at the ring "
+            f"boundary)"
+        )
     x_mb = _pin(
         jax.tree.map(lambda a: a.reshape(n_micro, mb, *a.shape[1:]), x),
         batch_dim=1,
@@ -184,11 +197,17 @@ def gpipe(
         )
         (circ, outbuf), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
         # only the last stage holds real outputs; psum broadcasts them so
-        # the result is replicated over the pipeline axis
+        # the result is replicated over the pipeline axis. The psum runs in
+        # f32: low-precision all-reduce here trips XLA's AllReducePromotion
+        # pass (CHECK failure cloning the remat boundary copy) and f32 is
+        # numerically safer anyway.
         outbuf = jax.tree.map(
             lambda b: jax.lax.psum(
-                jnp.where(stage == ring - 1, b, jnp.zeros_like(b)), axis_name
-            ),
+                jnp.where(stage == ring - 1, b, jnp.zeros_like(b)).astype(
+                    jnp.float32
+                ),
+                axis_name,
+            ).astype(b.dtype),
             outbuf,
         )
         return outbuf

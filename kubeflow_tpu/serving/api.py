@@ -22,6 +22,13 @@ class PredictorRuntime(str, enum.Enum):
     # Custom runtime: user supplies "pkg.module:ModelClass" (the kserve
     # custom-predictor container analogue, minus the container).
     CUSTOM = "custom"
+    # Framework wrapper runtimes (kserve sklearnserver/torchserve zoo
+    # analogue, serving/runtimes.py): artifact pulled by the storage
+    # initializer, loaded by the matching wrapper.
+    SKLEARN = "sklearn"
+    TORCH = "torch"
+    XGBOOST = "xgboost"
+    LIGHTGBM = "lightgbm"
 
 
 @dataclass
@@ -85,8 +92,10 @@ def validate_isvc(isvc: InferenceService) -> InferenceService:
     p = isvc.spec.predictor
     if p.replicas < 1:
         raise ValueError("inferenceservice: predictor.replicas must be >= 1")
-    if p.runtime == PredictorRuntime.JAX and not p.storage_uri:
-        raise ValueError("inferenceservice: jax runtime requires storageUri")
+    if p.runtime != PredictorRuntime.CUSTOM and not p.storage_uri:
+        raise ValueError(
+            f"inferenceservice: {p.runtime.value} runtime requires storageUri"
+        )
     if p.runtime == PredictorRuntime.CUSTOM and not p.model_class:
         raise ValueError(
             "inferenceservice: custom runtime requires modelClass 'module:Class'"

@@ -1,0 +1,136 @@
+"""Framework predictor runtimes — the kserve wrapper-zoo analogue.
+
+Reference parity (unverified cites, SURVEY.md §2.5 "Framework runtimes"):
+kserve ships python/{sklearnserver,xgbserver,lgbserver,paddleserver,...},
+each a thin Model subclass that loads a serialized artifact from the
+storage-initializer dir and serves predict. Here:
+
+  - SklearnModel: joblib/pickle estimator (model.joblib | model.pkl),
+    predict + predict_proba.
+  - TorchModel: TorchScript (model.pt via torch.jit) or a pickled module
+    (model.pth) on CPU — CUDA-free by design (north star: zero GPU pods);
+    TPU-bound users convert to the jax runtime.
+  - XGBoost/LightGBM: their upstream wrappers are one-liners over the same
+    pattern; the packages are absent from this environment, so the runtimes
+    raise a clear error at load (gated, not silently broken).
+
+Select via `--runtime sklearn|torch` on the model server or
+`predictor.runtime` in an InferenceService spec.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from kubeflow_tpu.serving.model import Model
+
+
+class SklearnModel(Model):
+    """sklearnserver parity: loads model.joblib / model.pkl, serves
+    predict(); classifier outputs include probabilities when available."""
+
+    def __init__(self, name: str, model_dir: str | Path):
+        super().__init__(name)
+        self.model_dir = Path(model_dir)
+        self._est = None
+
+    def load(self) -> None:
+        import joblib
+
+        for fname in ("model.joblib", "model.pkl"):
+            path = self.model_dir / fname
+            if path.exists():
+                self._est = joblib.load(path)
+                break
+        else:
+            raise FileNotFoundError(
+                f"no model.joblib/model.pkl under {self.model_dir}"
+            )
+        self.ready = True
+
+    def predict(self, inputs):
+        x = np.asarray(inputs)
+        out = {"predictions": np.asarray(self._est.predict(x)).tolist()}
+        if hasattr(self._est, "predict_proba"):
+            out["probabilities"] = np.asarray(
+                self._est.predict_proba(x)
+            ).tolist()
+        return out
+
+
+class TorchModel(Model):
+    """torchserve-shaped runtime on CPU: TorchScript model.pt preferred,
+    pickled nn.Module model.pth accepted."""
+
+    def __init__(self, name: str, model_dir: str | Path):
+        super().__init__(name)
+        self.model_dir = Path(model_dir)
+        self._mod = None
+
+    def load(self) -> None:
+        import torch
+
+        pt, pth = self.model_dir / "model.pt", self.model_dir / "model.pth"
+        if pt.exists():
+            self._mod = torch.jit.load(str(pt), map_location="cpu")
+        elif pth.exists():
+            # weights_only=False: the artifact is a whole pickled module, the
+            # torchserve-style contract (trusted model store, not user input)
+            self._mod = torch.load(
+                str(pth), map_location="cpu", weights_only=False
+            )
+        else:
+            raise FileNotFoundError(f"no model.pt/model.pth under {self.model_dir}")
+        self._mod.eval()
+        self.ready = True
+
+    def predict(self, inputs):
+        import torch
+
+        with torch.no_grad():
+            out = self._mod(torch.as_tensor(np.asarray(inputs)))
+        return out.numpy()
+
+
+class _MissingPackageModel(Model):
+    """Placeholder for runtimes whose package is not in this image."""
+
+    PACKAGE = ""
+
+    def __init__(self, name: str, model_dir: str | Path):
+        super().__init__(name)
+        self.model_dir = Path(model_dir)
+
+    def load(self) -> None:
+        raise ModuleNotFoundError(
+            f"runtime requires the {self.PACKAGE!r} package, which is not "
+            f"installed in this environment; convert the model to the "
+            f"sklearn/torch/jax runtime or install {self.PACKAGE}"
+        )
+
+
+class XGBoostModel(_MissingPackageModel):
+    PACKAGE = "xgboost"
+
+
+class LightGBMModel(_MissingPackageModel):
+    PACKAGE = "lightgbm"
+
+
+RUNTIMES: dict[str, type] = {
+    "sklearn": SklearnModel,
+    "torch": TorchModel,
+    "xgboost": XGBoostModel,
+    "lightgbm": LightGBMModel,
+}
+
+
+def build_runtime(runtime: str, name: str, model_dir: str | Path) -> Model:
+    cls = RUNTIMES.get(runtime)
+    if cls is None:
+        raise ValueError(
+            f"unknown runtime {runtime!r} (jax|custom|{'|'.join(RUNTIMES)})"
+        )
+    return cls(name, model_dir)

@@ -352,7 +352,10 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--model-name", required=True)
     ap.add_argument("--storage-uri", default="")
     ap.add_argument("--model-dir", default=".kubeflow_tpu/models")
-    ap.add_argument("--runtime", default="jax", choices=["jax", "custom"])
+    ap.add_argument(
+        "--runtime", default="jax",
+        choices=["jax", "custom", "sklearn", "torch", "xgboost", "lightgbm"],
+    )
     ap.add_argument("--model-class", default="")
     ap.add_argument("--transformer-class", default="")
     ap.add_argument("--port", type=int, default=8080)
@@ -373,14 +376,19 @@ def main(argv: list[str] | None = None) -> None:
 
         select_device(args.device)
 
-    if args.runtime == "jax":
+    if args.runtime == "custom":
+        cls = load_model_class(args.model_class)
+        model: Model = cls(args.model_name)
+    else:
         model_dir = args.model_dir
         if args.storage_uri:
             model_dir = pull_model(args.storage_uri, f"{args.model_dir}/{args.model_name}")
-        model: Model = JaxModel(args.model_name, model_dir)
-    else:
-        cls = load_model_class(args.model_class)
-        model = cls(args.model_name)
+        if args.runtime == "jax":
+            model = JaxModel(args.model_name, model_dir)
+        else:
+            from kubeflow_tpu.serving.runtimes import build_runtime
+
+            model = build_runtime(args.runtime, args.model_name, model_dir)
     if args.transformer_class:
         from kubeflow_tpu.serving.model import TransformedModel
 

@@ -264,10 +264,11 @@ def validate_experiment(exp: Experiment) -> Experiment:
     if algo not in (
         "random", "grid", "tpe", "cmaes",
         "bayesianoptimization", "gp", "skopt", "hyperband",
+        "evolution", "nas",
     ):
         raise ValueError(
             f"experiment: unknown algorithm {algo!r} "
-            f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband)"
+            f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband|evolution)"
         )
     if algo == "hyperband":
         rp = exp.spec.algorithm.settings.get("resourceParameter", "")

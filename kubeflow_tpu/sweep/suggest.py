@@ -327,6 +327,80 @@ class CMAESSuggester:
         return out
 
 
+class EvolutionSuggester:
+    """Regularized (aging) evolution — the NAS workhorse (Real et al. 2019,
+    AmoebaNet), and the platform-level analogue of katib's NAS suggestion
+    services: architectures encode as ordinary categorical/int/double
+    parameters (e.g. ops per block, widths, depths), so the same trial
+    plumbing searches architecture space. ENAS/DARTS-style in-graph weight
+    sharing is a model-side technique, not a controller one — what the
+    platform owes is the evolutionary search loop.
+
+    Replay semantics match CMA-ES/hyperband: the population is the last
+    `populationSize` finished trials (aging = oldest die by construction);
+    each suggestion tournament-selects a parent and mutates one parameter.
+    """
+
+    def __init__(
+        self,
+        parameters: list[ParameterSpec],
+        seed: int = 0,
+        objective_type: ObjectiveType = ObjectiveType.MAXIMIZE,
+        population_size: int = 20,
+        tournament_size: int = 5,
+        mutation_rate: float = 0.0,  # 0 => exactly one parameter mutates
+    ):
+        self.parameters = parameters
+        self.objective_type = objective_type
+        self.population_size = population_size
+        self.tournament_size = tournament_size
+        self.mutation_rate = mutation_rate
+        self.seed = seed
+        self._random = RandomSuggester(parameters, seed=seed + 1)
+
+    def _mutate_one(self, a: dict[str, str], rng) -> dict[str, str]:
+        out = dict(a)
+        if self.mutation_rate > 0:
+            chosen = [
+                p for p in self.parameters if rng.random() < self.mutation_rate
+            ] or [self.parameters[rng.integers(len(self.parameters))]]
+        else:
+            chosen = [self.parameters[rng.integers(len(self.parameters))]]
+        for p in chosen:
+            fs = p.feasible_space
+            if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+                choices = [str(v) for v in fs.list if str(v) != out.get(p.name)]
+                if choices:
+                    out[p.name] = str(choices[rng.integers(len(choices))])
+            else:
+                lo, hi = float(fs.min), float(fs.max)
+                # local gaussian move (10% of range), clipped to bounds
+                cur = float(out.get(p.name, (lo + hi) / 2))
+                v = float(np.clip(rng.normal(cur, 0.1 * (hi - lo)), lo, hi))
+                out[p.name] = _format(p, _snap_step(p, v))
+        return out
+
+    def suggest(self, history: History, count: int) -> list[dict[str, str]]:
+        observed = _finite(history)
+        if len(observed) < self.tournament_size:
+            return self._random.suggest(history, count)
+        # aging: only the newest population_size individuals survive
+        population = observed[-self.population_size:]
+        sign = 1.0 if self.objective_type == ObjectiveType.MINIMIZE else -1.0
+        # rng keyed by replay position => deterministic, restart-safe
+        rng = np.random.default_rng(self.seed + len(history))
+        out = []
+        for _ in range(count):
+            k = min(self.tournament_size, len(population))
+            contestants = [
+                population[i]
+                for i in rng.choice(len(population), size=k, replace=False)
+            ]
+            parent = min(contestants, key=lambda h: sign * h[1])
+            out.append(self._mutate_one(parent[0], rng))
+        return out
+
+
 class GPBayesSuggester:
     """skopt-parity Bayesian optimization: Matérn-5/2 GP + expected
     improvement, numpy-only.
@@ -590,6 +664,15 @@ def get_suggester(
             length_scale=float(settings.get("lengthScale", 0.25)),
             xi=float(settings.get("xi", 0.01)),
         )
+    if name in ("evolution", "nas"):
+        return EvolutionSuggester(
+            parameters,
+            seed=seed,
+            objective_type=objective_type,
+            population_size=int(settings.get("populationSize", 20)),
+            tournament_size=int(settings.get("tournamentSize", 5)),
+            mutation_rate=float(settings.get("mutationRate", 0.0)),
+        )
     if name == "hyperband":
         return HyperbandSuggester(
             parameters,
@@ -601,5 +684,5 @@ def get_suggester(
         )
     raise ValueError(
         f"unknown suggestion algorithm {name!r} "
-        f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband)"
+        f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband|evolution)"
     )

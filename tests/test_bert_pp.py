@@ -22,8 +22,10 @@ N_STAGES = 2
 def setup():
     cfg = BertConfig.tiny(dropout_rate=0.0, num_layers=4)
     dense = BertForSequenceClassification(cfg, num_classes=2)
+    # n_micro=2 keeps microbatches (8/2=4) divisible by the data-like mesh
+    # extent (data=2 x fsdp=2) used in these tests
     pp = BertPipelineClassifier(cfg, num_classes=2, num_stages=N_STAGES,
-                                n_micro=4)
+                                n_micro=2)
     rng = jax.random.PRNGKey(0)
     ids = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 1, cfg.vocab_size)
     labels = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 2)

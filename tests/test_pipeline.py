@@ -94,7 +94,12 @@ def test_gpipe_matches_sequential(n_micro):
     x = jnp.asarray(np.random.RandomState(1).normal(0, 1, (BATCH, HIDDEN)).astype(np.float32))
     expected = sequential(per_stage, x)
     stacked = stack_stage_params(per_stage)
-    mesh = build_mesh(MeshConfig(data=2, pipeline=4))
+    # microbatches must divide by the data extent: n_micro=8 -> mb=1 -> data=1
+    mesh = (
+        build_mesh(MeshConfig(data=2, pipeline=4))
+        if n_micro < 8
+        else build_mesh(MeshConfig(data=1, pipeline=4), jax.devices()[:4])
+    )
     with jax.set_mesh(mesh):
         got = jax.jit(lambda p, x: gpipe(stage_fn, p, x, n_micro))(stacked, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)

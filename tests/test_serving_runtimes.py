@@ -1,0 +1,111 @@
+"""Framework runtime wrappers (SURVEY.md §2.5 'Framework runtimes' row)."""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.runtimes import (
+    SklearnModel,
+    TorchModel,
+    XGBoostModel,
+    build_runtime,
+)
+
+
+@pytest.fixture(scope="module")
+def sklearn_artifact(tmp_path_factory):
+    import joblib
+    from sklearn.linear_model import LogisticRegression
+
+    d = tmp_path_factory.mktemp("skl")
+    x = np.array([[0.0], [1.0], [2.0], [3.0]])
+    y = np.array([0, 0, 1, 1])
+    est = LogisticRegression().fit(x, y)
+    joblib.dump(est, d / "model.joblib")
+    return d
+
+
+@pytest.fixture(scope="module")
+def torch_artifact(tmp_path_factory):
+    import torch
+
+    d = tmp_path_factory.mktemp("pt")
+
+    class Doubler(torch.nn.Module):
+        def forward(self, x):
+            return x * 2.0
+
+    torch.jit.script(Doubler()).save(str(d / "model.pt"))
+    return d
+
+
+class TestSklearnRuntime:
+    def test_predict_with_probabilities(self, sklearn_artifact):
+        m = SklearnModel("skl", sklearn_artifact)
+        m.load()
+        out = m(np.array([[0.0], [3.0]]))
+        assert out["predictions"] == [0, 1]
+        probs = np.asarray(out["probabilities"])
+        assert probs.shape == (2, 2)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-6)
+
+    def test_missing_artifact(self, tmp_path):
+        m = SklearnModel("none", tmp_path)
+        with pytest.raises(FileNotFoundError):
+            m.load()
+
+
+class TestTorchRuntime:
+    def test_torchscript_predict(self, torch_artifact):
+        m = TorchModel("pt", torch_artifact)
+        m.load()
+        out = m(np.ones((2, 3), np.float32))
+        np.testing.assert_allclose(out, 2.0 * np.ones((2, 3)))
+
+
+class TestGatedRuntimes:
+    def test_xgboost_gated_with_clear_error(self, tmp_path):
+        m = XGBoostModel("xgb", tmp_path)
+        with pytest.raises(ModuleNotFoundError, match="xgboost"):
+            m.load()
+
+    def test_registry(self, tmp_path):
+        assert isinstance(build_runtime("sklearn", "a", tmp_path), SklearnModel)
+        with pytest.raises(ValueError, match="unknown runtime"):
+            build_runtime("tensorrt", "a", tmp_path)
+
+
+class TestSklearnISVCEnd2End:
+    def test_full_platform_serving(self, sklearn_artifact, tmp_path):
+        """InferenceService with runtime=sklearn through the whole platform:
+        controller -> server pod -> storage init -> v1 predict."""
+        import json
+        import urllib.request
+
+        from kubeflow_tpu.client import Platform
+        from kubeflow_tpu.serving import ServingClient
+        from kubeflow_tpu.serving.api import (
+            InferenceService,
+            InferenceServiceSpec,
+            PredictorRuntime,
+            PredictorSpec,
+        )
+        from kubeflow_tpu.api.common import ObjectMeta
+
+        with Platform(log_dir=str(tmp_path / "pod-logs")) as p:
+            serving = ServingClient(p)
+            serving.create(InferenceService(
+                metadata=ObjectMeta(name="skl-svc"),
+                spec=InferenceServiceSpec(predictor=PredictorSpec(
+                    runtime=PredictorRuntime.SKLEARN,
+                    storage_uri=f"file://{sklearn_artifact}",
+                )),
+            ))
+            ready = serving.wait_ready("skl-svc", timeout_s=90)
+            req = urllib.request.Request(
+                f"{ready.status.url}/v1/models/skl-svc:predict",
+                data=json.dumps({"instances": [[0.0], [3.0]]}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                out = json.loads(r.read())
+            assert out["predictions"] == [0, 1]
