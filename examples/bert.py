@@ -69,10 +69,18 @@ def main(argv: list[str] | None = None) -> float:
         num_classes=args.num_classes,
     )
     if args.pipeline_stages > 1:
+        import jax
+
         from kubeflow_tpu.models import BertPipelineClassifier
 
-        # microbatches must stay divisible by the data-like mesh extent
-        data_ways = max(args.data_parallel, 1) * args.fsdp * args.expert_parallel
+        # microbatches must stay divisible by the data-like mesh extent;
+        # resolve an auto (-1) data axis the same way build_mesh will
+        dp = args.data_parallel
+        if dp == -1:
+            fixed = (args.fsdp * args.model_parallel * args.context
+                     * args.expert_parallel * args.pipeline_stages)
+            dp = max(jax.device_count() // fixed, 1)
+        data_ways = dp * args.fsdp * args.expert_parallel
         n_micro = 2 * args.pipeline_stages
         while n_micro > 1 and (
             args.batch_size % n_micro

@@ -94,29 +94,68 @@ class TorchModel(Model):
         return out.numpy()
 
 
-class _MissingPackageModel(Model):
-    """Placeholder for runtimes whose package is not in this image."""
-
-    PACKAGE = ""
+class XGBoostModel(Model):
+    """xgbserver parity: Booster from model.bst / model.json / model.ubj."""
 
     def __init__(self, name: str, model_dir: str | Path):
         super().__init__(name)
         self.model_dir = Path(model_dir)
+        self._booster = None
 
     def load(self) -> None:
-        raise ModuleNotFoundError(
-            f"runtime requires the {self.PACKAGE!r} package, which is not "
-            f"installed in this environment; convert the model to the "
-            f"sklearn/torch/jax runtime or install {self.PACKAGE}"
-        )
+        try:
+            import xgboost as xgb
+        except ModuleNotFoundError as exc:
+            raise ModuleNotFoundError(
+                "runtime 'xgboost' requires the xgboost package (absent in "
+                "this image); install it or convert the model to the "
+                "sklearn/torch/jax runtime"
+            ) from exc
+        for fname in ("model.bst", "model.json", "model.ubj"):
+            path = self.model_dir / fname
+            if path.exists():
+                self._booster = xgb.Booster()
+                self._booster.load_model(str(path))
+                break
+        else:
+            raise FileNotFoundError(
+                f"no model.bst/model.json/model.ubj under {self.model_dir}"
+            )
+        self.ready = True
+
+    def predict(self, inputs):
+        import xgboost as xgb
+
+        return self._booster.predict(
+            xgb.DMatrix(np.asarray(inputs))
+        ).tolist()
 
 
-class XGBoostModel(_MissingPackageModel):
-    PACKAGE = "xgboost"
+class LightGBMModel(Model):
+    """lgbserver parity: Booster from model.txt."""
 
+    def __init__(self, name: str, model_dir: str | Path):
+        super().__init__(name)
+        self.model_dir = Path(model_dir)
+        self._booster = None
 
-class LightGBMModel(_MissingPackageModel):
-    PACKAGE = "lightgbm"
+    def load(self) -> None:
+        try:
+            import lightgbm as lgb
+        except ModuleNotFoundError as exc:
+            raise ModuleNotFoundError(
+                "runtime 'lightgbm' requires the lightgbm package (absent in "
+                "this image); install it or convert the model to the "
+                "sklearn/torch/jax runtime"
+            ) from exc
+        path = self.model_dir / "model.txt"
+        if not path.exists():
+            raise FileNotFoundError(f"no model.txt under {self.model_dir}")
+        self._booster = lgb.Booster(model_file=str(path))
+        self.ready = True
+
+    def predict(self, inputs):
+        return self._booster.predict(np.asarray(inputs)).tolist()
 
 
 RUNTIMES: dict[str, type] = {

@@ -356,7 +356,6 @@ class EvolutionSuggester:
         self.tournament_size = tournament_size
         self.mutation_rate = mutation_rate
         self.seed = seed
-        self._random = RandomSuggester(parameters, seed=seed + 1)
 
     def _mutate_one(self, a: dict[str, str], rng) -> dict[str, str]:
         out = dict(a)
@@ -383,7 +382,11 @@ class EvolutionSuggester:
     def suggest(self, history: History, count: int) -> list[dict[str, str]]:
         observed = _finite(history)
         if len(observed) < self.tournament_size:
-            return self._random.suggest(history, count)
+            # bootstrap stays replay-deterministic: a fresh rng keyed on the
+            # history position, like the post-bootstrap path
+            return RandomSuggester(
+                self.parameters, seed=self.seed + len(history)
+            ).suggest(history, count)
         # aging: only the newest population_size individuals survive
         population = observed[-self.population_size:]
         sign = 1.0 if self.objective_type == ObjectiveType.MINIMIZE else -1.0
