@@ -1,0 +1,86 @@
+"""Decoder-only causal LM — the long-context flagship example.
+
+The ring path shards the SEQUENCE over the `context` axis with causal
+global-position masking (parallel/ring_attention.py), so sequences far
+beyond one device's attention memory train with the same module:
+
+  python -m examples.gpt --device=tpu --size=small --steps=100
+  python -m examples.gpt --size=tiny --seq-len=4096 --attention=ring --context=4
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+    p.add_argument("--size", default="small", choices=["tiny", "small"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--attention", default="dense",
+                   choices=["dense", "ring", "ulysses", "flash"])
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--no-bf16", dest="bf16", action="store_false")
+    p.add_argument("--data-parallel", type=int, default=-1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--model-parallel", type=int, default=1)
+    p.add_argument("--context", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import (GPTConfig, GPTLM, causal_lm_eval_metrics,
+                                    causal_lm_loss)
+    from kubeflow_tpu.parallel import MeshConfig
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_lm_dataset
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    mk = GPTConfig.tiny if args.size == "tiny" else GPTConfig.small
+    cfg = mk(
+        dtype=dtype,
+        attention=args.attention,
+        max_len=max(args.seq_len, 256),
+        dropout_rate=0.0 if args.attention != "dense" else 0.1,
+    )
+    ds = synthetic_lm_dataset(
+        n_train=args.batch_size * 8,
+        n_test=args.batch_size * 2,
+        seq_len=args.seq_len,
+        vocab_size=cfg.vocab_size,
+    )
+    trainer = Trainer(
+        GPTLM(cfg),
+        TrainerConfig(
+            batch_size=args.batch_size,
+            steps=args.steps,
+            learning_rate=args.lr,
+            warmup_steps=min(100, args.steps // 10),
+            compute_dtype=dtype,
+            checkpoint_dir=args.checkpoint_dir,
+            mesh=MeshConfig(
+                data=args.data_parallel,
+                fsdp=args.fsdp,
+                model=args.model_parallel,
+                context=args.context,
+            ),
+            log_every_steps=10,
+        ),
+        loss_fn=causal_lm_loss,
+        eval_metrics_fn=causal_lm_eval_metrics,
+    )
+    _, metrics = trainer.fit(ds)
+    return metrics.get("final_loss", float("inf"))
+
+
+if __name__ == "__main__":
+    main()

@@ -111,6 +111,83 @@ def _deserialize(manifest: dict):
     return bucket, _from_dict(cls, body)
 
 
+class _Html(str):
+    """String payload the handler serves as text/html (only /ui builds it)."""
+
+
+def _render_dashboard(platform) -> str:
+    """Read-only status page (GET /ui) — the centraldashboard gesture
+    (SURVEY.md §1 L9): one table per object kind, no JS framework, no
+    write paths. Auto-refreshes every 5s."""
+    import html
+
+    cluster = platform.cluster
+
+    def esc(v) -> str:
+        return html.escape(str(v))
+
+    def job_state(j):
+        conds = [c.type.value for c in j.status.conditions if c.status]
+        return conds[-1] if conds else "-"
+
+    sections = [
+        ("Jobs", "jobs", lambda o: (
+            o.kind.value, job_state(o),
+            f"{sum(r.replicas for r in o.spec.replica_specs.values())} replicas",
+        )),
+        ("Experiments", "experiments", lambda o: (
+            o.spec.algorithm.algorithm_name, o.status.condition.value,
+            f"{o.status.trials_succeeded}/{o.status.trials} trials",
+        )),
+        ("InferenceServices", "inferenceservices", lambda o: (
+            o.spec.predictor.runtime.value,
+            "Ready" if o.status.ready else "NotReady", o.status.url or "-",
+        )),
+        ("PipelineRuns", "pipelineruns", lambda o: (
+            "-", o.status.state,
+            f"{sum(1 for s in o.status.tasks.values() if s in ('Succeeded', 'Cached'))}"
+            f"/{len(o.status.tasks)} steps",
+        )),
+        ("Notebooks", "notebooks", lambda o: (
+            "-", "Ready" if o.status.ready else "NotReady", o.status.url or "-",
+        )),
+        ("Tensorboards", "tensorboards", lambda o: (
+            o.spec.logdir, "Ready" if o.status.ready else "NotReady",
+            o.status.url or "-",
+        )),
+    ]
+    parts = [
+        "<!doctype html><html><head><title>kubeflow_tpu</title>",
+        '<meta http-equiv="refresh" content="5">',
+        "<style>body{font-family:monospace;margin:2em}table{border-collapse:"
+        "collapse;margin-bottom:2em}td,th{border:1px solid #999;padding:4px "
+        "10px;text-align:left}th{background:#eee}h2{margin-bottom:4px}"
+        "</style></head><body><h1>kubeflow_tpu platform</h1>",
+    ]
+    for title, kind, row in sections:
+        objs = cluster.list(kind)
+        parts.append(f"<h2>{title} ({len(objs)})</h2>")
+        if not objs:
+            continue
+        parts.append(
+            "<table><tr><th>namespace/name</th><th>detail</th>"
+            "<th>state</th><th>info</th></tr>"
+        )
+        for o in sorted(objs, key=lambda o: (o.metadata.namespace, o.metadata.name)):
+            try:
+                detail, state, info = row(o)
+            except Exception:  # noqa: BLE001 — a bad row must not kill the page
+                detail = state = info = "?"
+            parts.append(
+                f"<tr><td>{esc(o.metadata.namespace)}/{esc(o.metadata.name)}"
+                f"</td><td>{esc(detail)}</td><td>{esc(state)}</td>"
+                f"<td>{esc(info)}</td></tr>"
+            )
+        parts.append("</table>")
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
 class PlatformServer:
     """Serves a Platform over REST.
 
@@ -137,6 +214,10 @@ class PlatformServer:
 
         if parsed.path == "/healthz" or parsed.path == "/readyz":
             return 200, {"ok": True}
+        if parsed.path == "/ui" or parsed.path == "/ui/":
+            # explicit marker type — the reply path must NEVER sniff
+            # content types from payload bytes (pod logs are attacker text)
+            return 200, _Html(_render_dashboard(self.platform))
         if parsed.path == "/metrics":
             from kubeflow_tpu.observability import render_metrics
 
@@ -323,7 +404,9 @@ class PlatformServer:
                 self._reply(code, payload)
 
             def _reply(self, code, payload):
-                if isinstance(payload, str):
+                if isinstance(payload, _Html):
+                    data, ctype = payload.encode(), "text/html"
+                elif isinstance(payload, str):
                     data, ctype = payload.encode(), "text/plain"
                 else:
                     data, ctype = json.dumps(payload).encode(), "application/json"

@@ -12,6 +12,12 @@ from kubeflow_tpu.models.bert import (
     BertForSequenceClassification,
 )
 from kubeflow_tpu.models.bert_pp import BertPipelineClassifier
+from kubeflow_tpu.models.gpt import (
+    GPTConfig,
+    GPTLM,
+    causal_lm_eval_metrics,
+    causal_lm_loss,
+)
 from kubeflow_tpu.models.mnist import MnistCNN, MnistMLP
 from kubeflow_tpu.models.resnet import (
     ResNet,
@@ -28,6 +34,10 @@ __all__ = [
     "BertForMaskedLM",
     "BertForSequenceClassification",
     "BertPipelineClassifier",
+    "GPTConfig",
+    "GPTLM",
+    "causal_lm_loss",
+    "causal_lm_eval_metrics",
     "MnistMLP",
     "MnistCNN",
     "ResNet",

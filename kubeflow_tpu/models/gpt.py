@@ -1,0 +1,195 @@
+"""GPT — decoder-only causal LM, the long-context flagship.
+
+The reference platform ships no models (kubeflow/examples supplies encoder
+images — SURVEY.md L6); this family exists because long-context training is
+first-class here (SURVEY.md §5.7): causal ring attention shards the sequence
+over the `context` axis with GLOBAL-position masking (parallel/ring_attention
+.py), so a sequence 8x one device's memory trains with the same module.
+
+Architecture: pre-LN transformer decoder (GPT-2 shape), learned positions,
+weight-tied LM head, bf16 compute / f32 params. TP/FSDP via the same
+declarative PARTITION_RULES mechanism as BERT.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.models.bert import (
+    ACT_SPEC,
+    VocabEmbed,
+    _resolve_attention,
+    constrain,
+)
+from kubeflow_tpu.parallel.mesh import AXIS_FSDP, AXIS_MODEL
+
+PARTITION_RULES: list[tuple[str, P]] = [
+    (r"(query|key|value)/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
+    (r"attn_out/kernel$", P(AXIS_MODEL, AXIS_FSDP)),
+    (r"mlp_up/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
+    (r"mlp_down/kernel$", P(AXIS_MODEL, AXIS_FSDP)),
+    (r"token_embed/embedding$", P(AXIS_MODEL, AXIS_FSDP)),
+    (r"position_embed/embedding$", P(None, AXIS_FSDP)),
+]
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 1024
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.float32
+    attention: str = "dense"  # dense | ring | ulysses | flash
+    attention_block: int = 128
+
+    @staticmethod
+    def small(**kw) -> "GPTConfig":
+        return GPTConfig(**kw)  # GPT-2 small shape
+
+    @staticmethod
+    def tiny(**kw) -> "GPTConfig":
+        d = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+                 mlp_dim=128, max_len=256)
+        d.update(kw)
+        return GPTConfig(**d)
+
+
+def causal_dense_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                           block=None):
+    """Reference causal softmax attention (numerics baseline for tests)."""
+    depth = q.shape[-1]
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(depth).astype(q.dtype)
+    if bias is not None:
+        s = s + bias
+    lq, lk = q.shape[1], k.shape[1]
+    mask = jnp.tril(jnp.ones((lq, lk), bool))
+    s = jnp.where(mask[None, None], s.astype(jnp.float32), -1e9)
+    probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    if dropout_rng is not None and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, bias, train: bool):
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (c.num_heads, head_dim), dtype=c.dtype, name=name
+        )
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        rng = self.make_rng("dropout") if train and c.dropout_rate > 0 else None
+        if c.attention == "dense":
+            y = causal_dense_attention(
+                q, k, v, bias, dropout_rng=rng,
+                dropout_rate=c.dropout_rate if train else 0.0,
+            )
+        else:
+            attn_fn = _resolve_attention(c.attention)
+            y = attn_fn(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                        block=c.attention_block, causal=True)
+        return nn.DenseGeneral(
+            c.hidden_size, axis=(-2, -1), dtype=c.dtype, name="attn_out"
+        )(y)
+
+
+class GPTBlock(nn.Module):
+    """Pre-LN decoder block (GPT-2 residual structure)."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, bias, train: bool):
+        c = self.cfg
+        y = CausalSelfAttention(c, name="attention")(
+            nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x), bias, train
+        )
+        y = nn.Dropout(c.dropout_rate, deterministic=not train)(y)
+        x = constrain(x + y, ACT_SPEC)
+        h = nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x)
+        h = nn.gelu(nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_up")(h))
+        h = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_down")(h)
+        h = nn.Dropout(c.dropout_rate, deterministic=not train)(h)
+        return constrain(x + h, ACT_SPEC)
+
+
+class GPTLM(nn.Module):
+    """Causal language model: logits over the next token at every position.
+
+    __call__(input_ids (B, L)) -> (B, L, vocab) f32 logits; pad positions
+    carry a large negative additive bias so they are never attended to.
+    """
+
+    cfg: GPTConfig
+    pad_token_id: int = 0
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False):
+        c = self.cfg
+        token_embed = VocabEmbed(
+            c.vocab_size, c.hidden_size, dtype=c.dtype, name="token_embed"
+        )
+        x = token_embed(input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None, :]
+        x = x + VocabEmbed(c.max_len, c.hidden_size, dtype=c.dtype,
+                           name="position_embed")(pos)
+        x = nn.Dropout(c.dropout_rate, deterministic=not train)(x)
+        x = constrain(x, ACT_SPEC)
+        mask = input_ids != self.pad_token_id
+        bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
+        for i in range(c.num_layers):
+            x = GPTBlock(c, name=f"layer_{i}")(x, bias, train)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_final")(x)
+        logits = token_embed.attend(x)  # weight-tied head
+        return logits.astype(jnp.float32)
+
+
+GPTLM.PARTITION_RULES = PARTITION_RULES
+
+
+def causal_lm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Next-token cross entropy; labels == input_ids (the shift happens
+    here), pad labels (0) are masked out of the mean."""
+    import optax
+
+    shift_logits = logits[:, :-1]
+    shift_labels = labels[:, 1:]
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        shift_logits, shift_labels
+    )
+    w = (shift_labels != 0).astype(jnp.float32)
+    return (per_tok * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def causal_lm_eval_metrics(logits: jax.Array, labels: jax.Array):
+    """Per-example (next-token loss, next-token accuracy) — the eval twin of
+    causal_lm_loss, shifted the same way so eval measures what training
+    optimizes (Trainer eval_metrics_fn contract)."""
+    import optax
+
+    shift_logits = logits[:, :-1]
+    shift_labels = labels[:, 1:]
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        shift_logits, shift_labels
+    )
+    w = (shift_labels != 0).astype(jnp.float32)
+    denom = jnp.maximum(w.sum(-1), 1.0)
+    per_ex = (per_tok * w).sum(-1) / denom
+    acc = (
+        ((jnp.argmax(shift_logits, -1) == shift_labels) * w).sum(-1) / denom
+    )
+    return per_ex, acc

@@ -71,16 +71,23 @@ def _context_size() -> int:
 # --------------------------------------------------------------------- jnp core
 
 
-def _online_block(carry, kv, q, scale):
+def _online_block(carry, kv, q, scale, q_pos=None, k_pos=None):
     """One online-softmax accumulation step against a KV block.
 
     carry: (o_acc f32 (B,Lq,H,D), m (B,H,Lq,1) running max, l (B,H,Lq,1) sum)
     kv:    (k_blk, v_blk, bias_blk (B,1,1,Lk))
+    q_pos/k_pos: global token positions (Lq,)/(Lk,) for causal masking —
+    positions, not block indices, so the mask stays correct when blocks live
+    on different ring shards.
     """
     o_acc, m, l = carry
     k_blk, v_blk, bias_blk = kv
     s = jnp.einsum("blhd,bmhd->bhlm", q, k_blk).astype(jnp.float32) * scale
     s = s + bias_blk.astype(jnp.float32)
+    if q_pos is not None:
+        s = s + jnp.where(
+            k_pos[None, :] > q_pos[:, None], NEG_INF, 0.0
+        )[None, None, :, :]
     m_new = jnp.maximum(m, s.max(-1, keepdims=True))
     corr = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new)
@@ -103,12 +110,13 @@ def _init_carry(q):
     )
 
 
-def blockwise_attention(q, k, v, bias, block: int = 256):
+def blockwise_attention(q, k, v, bias, block: int = 256, causal: bool = False):
     """Memory-efficient attention: lax.scan over KV blocks, online softmax.
 
     Differentiable everywhere (the autodiff of scan recomputes nothing extra
     beyond the saved block residuals); the numerics reference for both the
-    pallas kernel and the ring path.
+    pallas kernel and the ring path. causal=True masks k_pos > q_pos (global
+    positions; the ring path reconstructs per-shard positions itself).
     """
     b, lk, h, d = k.shape
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -119,11 +127,19 @@ def blockwise_attention(q, k, v, bias, block: int = 256):
     kb = k.reshape(b, n_blocks, block, h, d).transpose(1, 0, 2, 3, 4)
     vb = v.reshape(b, n_blocks, block, h, d).transpose(1, 0, 2, 3, 4)
     bias_b = bias.reshape(b, 1, 1, n_blocks, block).transpose(3, 0, 1, 2, 4)
+    q_pos = jnp.arange(q.shape[1]) if causal else None
+    k_pos_blocks = jnp.arange(lk).reshape(n_blocks, block)
 
     def step(carry, kv):
-        return _online_block(carry, kv, q, scale), None
+        k_blk, v_blk, bias_blk, kp = kv
+        return _online_block(
+            carry, (k_blk, v_blk, bias_blk), q, scale,
+            q_pos, kp if causal else None,
+        ), None
 
-    carry, _ = jax.lax.scan(step, _init_carry(q), (kb, vb, bias_b))
+    carry, _ = jax.lax.scan(
+        step, _init_carry(q), (kb, vb, bias_b, k_pos_blocks)
+    )
     return _finalize(*carry, q.dtype)
 
 
@@ -131,7 +147,8 @@ def blockwise_attention(q, k, v, bias, block: int = 256):
 
 
 def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
-                   block: int = 256, axis_name: str = AXIS_CONTEXT):
+                   block: int = 256, axis_name: str = AXIS_CONTEXT,
+                   causal: bool = False):
     """Ring attention over the `context` mesh axis.
 
     Inside: per-device online-softmax accumulation against the local KV
@@ -139,22 +156,35 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
     after ring_size steps every query block has seen every KV block. The
     softmax statistics (m, l) make the result exactly equal to dense
     attention — verified in tests to 1e-5.
+
+    causal=True masks with GLOBAL positions: query shard i holds positions
+    [i·L_loc, (i+1)·L_loc); the KV block at ring step s originated on shard
+    (i - s) mod ring, so its positions are reconstructed per step — the
+    hard part of causal ring attention (SURVEY.md §7 hard-part 2).
     """
     if dropout_rate:
         raise NotImplementedError("attention dropout unsupported in ring path")
     ctx = _context_size()
     if ctx == 1:
-        return blockwise_attention(q, k, v, bias, block)
+        return blockwise_attention(q, k, v, bias, block, causal=causal)
 
     scale = 1.0 / (q.shape[-1] ** 0.5)
 
     def per_device(q, k, v, bias):
         ring = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
         perm = [(i, (i + 1) % ring) for i in range(ring)]
+        l_loc = q.shape[1]
+        q_pos = idx * l_loc + jnp.arange(l_loc) if causal else None
 
         def step(i, carry_kv):
             carry, kv = carry_kv
-            carry = _online_block(carry, kv, q, scale)
+            if causal:
+                src = (idx - i) % ring  # shard this KV block originated on
+                k_pos = src * l_loc + jnp.arange(l_loc)
+                carry = _online_block(carry, kv, q, scale, q_pos, k_pos)
+            else:
+                carry = _online_block(carry, kv, q, scale)
             # rotate KV (+ its bias slice) one hop; unconditional so the
             # collective never sits inside data-dependent control flow (the
             # final rotation just restores original placement). XLA overlaps
@@ -179,7 +209,8 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
 
 
 def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
-                      block: int = 256, axis_name: str = AXIS_CONTEXT):
+                      block: int = 256, axis_name: str = AXIS_CONTEXT,
+                      causal: bool = False):
     """Ulysses context parallelism: all-to-all seq<->head re-shard.
 
     Each device exchanges its sequence shard for a head shard (one all-to-all
@@ -191,7 +222,7 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
         raise NotImplementedError("attention dropout unsupported in ulysses path")
     ctx = _context_size()
     if ctx == 1:
-        return blockwise_attention(q, k, v, bias, block)
+        return blockwise_attention(q, k, v, bias, block, causal=causal)
     mesh = jax.sharding.get_abstract_mesh()
     model = mesh.shape.get(AXIS_MODEL, 1)
     heads = q.shape[2]
@@ -211,7 +242,9 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
         bias_g = jax.lax.all_gather(
             bias, axis_name, axis=3, tiled=True
         )
-        o = blockwise_attention(qg, kg, vg, bias_g, block)
+        # after the exchange every device holds the FULL sequence for its
+        # heads, so causal masking is the ordinary global-position mask
+        o = blockwise_attention(qg, kg, vg, bias_g, block, causal=causal)
         return jax.lax.all_to_all(
             o, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
         )
@@ -228,9 +261,11 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
-                  *, scale: float, n_kv: int):
+                  *, scale: float, n_kv: int, causal: bool,
+                  block_q: int, block_k: int):
     """Flash-attention forward tile: one (batch*head, q_block) position,
     sequential grid over KV blocks with VMEM online-softmax accumulators."""
+    iq = pl.program_id(1)
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -239,44 +274,64 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]  # (bq, d)
-    k = k_ref[0]  # (bk, d)
-    v = v_ref[0]  # (bk, d)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (bq, bk)
-    s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
-    m_prev = m_scr[:]  # (bq, 1)
-    m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
-    corr = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_scr[:] = l_scr[:] * corr + p.sum(-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = m_new
+    def _compute():
+        q = q_ref[0]  # (bq, d)
+        k = k_ref[0]  # (bk, d)
+        v = v_ref[0]  # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (bq, bk)
+        s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = s + jnp.where(cols > rows, NEG_INF, 0.0)
+        m_prev = m_scr[:]  # (bq, 1)
+        m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = l_scr[:] * corr + p.sum(-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    if causal:
+        # KV blocks strictly above the diagonal contribute nothing — skip
+        # their matmuls entirely (halves long-context causal FLOPs)
+        pl.when(ik * block_k <= iq * block_q + (block_q - 1))(_compute)
+    else:
+        _compute()
 
     @pl.when(ik == n_kv - 1)
     def _():
         o_ref[0] = (acc_scr[:] / l_scr[:]).astype(o_ref.dtype)
 
 
-def _flash_forward(q, k, v, bias, block_q: int, block_k: int):
+def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
+                   causal: bool = False):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = 1.0 / (d**0.5)
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     if lq % block_q or lk % block_k:
-        return blockwise_attention(q, k, v, bias)
+        return blockwise_attention(q, k, v, bias, causal=causal)
     # fold heads into batch: (B*H, L, D)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     n_q, n_kv = lq // block_q, lk // block_k
 
-    kernel = functools.partial(_flash_kernel, scale=scale, n_kv=n_kv)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, n_kv=n_kv, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
     of = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_kv),
@@ -300,21 +355,25 @@ def _flash_forward(q, k, v, bias, block_q: int, block_k: int):
     return of.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, bias, block_q, block_k):
-    return _flash_forward(q, k, v, bias, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, bias, block_q, block_k, causal):
+    return _flash_forward(q, k, v, bias, block_q, block_k, causal)
 
 
-def _flash_fwd(q, k, v, bias, block_q, block_k):
-    return _flash_forward(q, k, v, bias, block_q, block_k), (q, k, v, bias)
+def _flash_fwd(q, k, v, bias, block_q, block_k, causal):
+    return _flash_forward(q, k, v, bias, block_q, block_k, causal), (q, k, v, bias)
 
 
-def _flash_bwd(block_q, block_k, residuals, g):
+def _flash_bwd(block_q, block_k, causal, residuals, g):
     q, k, v, bias = residuals
     # recomputing jnp backward — memory-efficient via the scan in
     # blockwise_attention; a fused pallas bwd kernel is a later optimization
-    _, vjp = jax.vjp(lambda q, k, v, bias: blockwise_attention(q, k, v, bias,
-                                                               block_k), q, k, v, bias)
+    _, vjp = jax.vjp(
+        lambda q, k, v, bias: blockwise_attention(
+            q, k, v, bias, block_k, causal=causal
+        ),
+        q, k, v, bias,
+    )
     return vjp(g)
 
 
@@ -322,9 +381,9 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
-                    block: int = 128):
+                    block: int = 128, causal: bool = False):
     """Pallas flash attention (single device / per-shard). Differentiable via
     a recomputing backward; attention dropout unsupported."""
     if dropout_rate:
         raise NotImplementedError("attention dropout unsupported in flash path")
-    return _flash(q, k, v, bias, block, block)
+    return _flash(q, k, v, bias, block, block, causal)

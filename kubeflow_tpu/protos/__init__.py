@@ -1,0 +1,1 @@
+"""Protobuf messages (compiled from protos/*.proto via protoc)."""

@@ -51,6 +51,7 @@ class ExperimentController(ControllerBase):
         workers: int = 1,
         resync_period_s: float = 0.5,
         observation_db: str | None = None,
+        suggestion_endpoint: str | None = None,
     ):
         # resync doubles as the early-stopping poller: running trials' live
         # logs are only re-examined on reconcile
@@ -63,6 +64,15 @@ class ExperimentController(ControllerBase):
         # opened lazily so platforms that never sweep pay nothing
         self._observation_db = observation_db
         self._observations = None
+        # None => in-process suggesters; an address restores katib's
+        # suggestion-service-over-gRPC topology (sweep/rpc.py). Created
+        # eagerly: reconcile workers run concurrently and a lazy init would
+        # race/leak channels.
+        self._suggestion_client = None
+        if suggestion_endpoint:
+            from kubeflow_tpu.sweep.rpc import SuggestionClient
+
+            self._suggestion_client = SuggestionClient(suggestion_endpoint)
         # finished trials' logs are immutable: cache their objective
         # timelines so the medianstop hot path isn't O(trials) file reads
         self._timeline_cache: dict[str, list[float]] = {}
@@ -245,6 +255,9 @@ class ExperimentController(ControllerBase):
         if self._observations is not None:
             self._observations.close()
             self._observations = None
+        if self._suggestion_client is not None:
+            self._suggestion_client.close()
+            self._suggestion_client = None
 
     # ------------------------------------------------------------- sub-steps
 
@@ -468,14 +481,25 @@ class ExperimentController(ControllerBase):
         seed = int(exp.spec.algorithm.settings.get(
             "seed", zlib.crc32(exp.metadata.name.encode()) & 0x7FFFFFFF
         ))
-        suggester = get_suggester(
-            exp.spec.algorithm.algorithm_name,
-            exp.spec.parameters,
-            seed=seed + len(trials),  # decorrelate successive reconcile passes
-            objective_type=obj.type,
-            settings=exp.spec.algorithm.settings,
-        )
-        suggestions = suggester.suggest(history, count)
+        if self._suggestion_client is not None:
+            suggestions = self._suggestion_client.get_suggestions(
+                exp.spec.algorithm.algorithm_name,
+                exp.spec.parameters,
+                history,
+                count,
+                settings=dict(exp.spec.algorithm.settings),
+                objective_type=obj.type,
+                seed=seed + len(trials),
+            )
+        else:
+            suggester = get_suggester(
+                exp.spec.algorithm.algorithm_name,
+                exp.spec.parameters,
+                seed=seed + len(trials),  # decorrelate successive passes
+                objective_type=obj.type,
+                settings=exp.spec.algorithm.settings,
+            )
+            suggestions = suggester.suggest(history, count)
         created = 0
         for a in suggestions:
             name = f"{exp.metadata.name}-{len(trials) + created:04d}"

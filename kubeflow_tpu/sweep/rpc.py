@@ -1,0 +1,323 @@
+"""gRPC control plane for sweeps — suggestion service + db-manager parity.
+
+Reference parity (unverified cites, SURVEY.md §2.3/§2.4): katib runs one
+suggestion Deployment per experiment behind gRPC `GetSuggestions` /
+`ValidateAlgorithmSettings` (pkg/apis/manager/v1beta1/api.proto) and a
+db-manager gRPC facade over the observation log. Both surfaces exist here
+over the same wire protocol: protobuf messages (protos/sweep.proto compiled
+with protoc) and grpcio, with service methods wired via
+`method_handlers_generic_handler` — the image ships no grpc_tools codegen
+plugin, and the hand wiring is ~20 lines.
+
+The ExperimentController uses suggesters in-process by default (the gRPC
+hop existed upstream because algorithms ran in separate Deployments);
+pointing it at `suggestion_endpoint` restores the remote topology.
+"""
+
+from __future__ import annotations
+
+import math
+from concurrent import futures
+
+import grpc
+
+from kubeflow_tpu.protos import sweep_pb2 as pb
+from kubeflow_tpu.sweep.api import (
+    FeasibleSpace,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+)
+from kubeflow_tpu.sweep.suggest import get_suggester
+
+SUGGESTION_SERVICE = "kubeflow_tpu.sweep.Suggestion"
+DBMANAGER_SERVICE = "kubeflow_tpu.sweep.DBManager"
+
+
+# ------------------------------------------------------------- proto <-> api
+
+def _param_from_pb(p: pb.Parameter) -> ParameterSpec:
+    return ParameterSpec(
+        name=p.name,
+        parameter_type=ParameterType(p.type),
+        feasible_space=FeasibleSpace(
+            min=p.min, max=p.max, list=list(p.list), step=p.step
+        ),
+    )
+
+
+def _history_from_pb(entries) -> list[tuple[dict[str, str], float | None]]:
+    out = []
+    for e in entries:
+        a = {x.name: x.value for x in e.assignments}
+        if e.failed:
+            out.append((a, float("nan")))
+        elif e.has_objective:
+            out.append((a, e.objective))
+        else:
+            out.append((a, None))
+    return out
+
+
+def history_to_pb(history) -> list[pb.HistoryEntry]:
+    out = []
+    for a, o in history:
+        e = pb.HistoryEntry(
+            assignments=[pb.Assignment(name=k, value=v) for k, v in a.items()]
+        )
+        if o is None:
+            e.has_objective = False
+        elif isinstance(o, float) and math.isnan(o):
+            e.failed = True
+        else:
+            e.has_objective = True
+            e.objective = float(o)
+        out.append(e)
+    return out
+
+
+# ------------------------------------------------------------------ services
+
+class SuggestionService:
+    """katib suggestion-service parity: stateless, algorithm picked per call."""
+
+    def GetSuggestions(self, req: pb.GetSuggestionsRequest, ctx):
+        try:
+            suggester = get_suggester(
+                req.algorithm,
+                [_param_from_pb(p) for p in req.parameters],
+                seed=int(req.seed),
+                objective_type=ObjectiveType(req.objective_type or "maximize"),
+                settings=dict(req.settings),
+            )
+            suggestions = suggester.suggest(
+                _history_from_pb(req.history), int(req.count)
+            )
+        except (ValueError, KeyError) as exc:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        return pb.GetSuggestionsReply(suggestions=[
+            pb.AssignmentSet(assignments=[
+                pb.Assignment(name=k, value=v) for k, v in a.items()
+            ])
+            for a in suggestions
+        ])
+
+    def ValidateAlgorithmSettings(self, req, ctx):
+        try:
+            get_suggester(
+                req.algorithm,
+                [_param_from_pb(p) for p in req.parameters],
+                settings=dict(req.settings),
+            )
+        except (ValueError, KeyError) as exc:
+            return pb.ValidateAlgorithmSettingsReply(ok=False, message=str(exc))
+        return pb.ValidateAlgorithmSettingsReply(ok=True)
+
+
+class DBManagerService:
+    """katib db-manager parity over the durable observation store."""
+
+    def __init__(self, observation_db: str):
+        from kubeflow_tpu.sweep.store import ObservationStore
+
+        self._store = ObservationStore(observation_db)
+
+    def ReportObservation(self, req: pb.ReportObservationRequest, ctx):
+        import json
+
+        name = f"{req.namespace}/{req.experiment}/{req.trial}"
+        props = json.dumps({
+            "fingerprint": req.fingerprint,
+            "trial": req.trial,
+            "assignments": {a.name: a.value for a in req.assignments},
+            "metrics": [
+                {"name": m.name, "latest": m.latest, "min": m.min, "max": m.max}
+                for m in req.metrics
+            ],
+            "completion_time": req.completion_time,
+        })
+        self._store._ids[name] = self._store._ms.put_execution(
+            "sweep.trial", name, state=req.condition, props=props,
+            id=self._store._ids.get(name, 0),
+        )
+        return pb.Empty()
+
+    def GetObservations(self, req: pb.GetObservationsRequest, ctx):
+        import json
+
+        prefix = f"{req.namespace}/{req.experiment}/"
+        out = []
+        for rec in self._store._ms.list_executions("sweep.trial"):
+            if not rec["name"].startswith(prefix):
+                continue
+            try:
+                props = json.loads(rec["props"])
+            except json.JSONDecodeError:
+                continue
+            if req.fingerprint and props.get("fingerprint") != req.fingerprint:
+                continue
+            out.append(pb.TrialObservation(
+                trial=props.get("trial", ""),
+                condition=rec["state"],
+                assignments=[
+                    pb.Assignment(name=k, value=v)
+                    for k, v in props.get("assignments", {}).items()
+                ],
+                metrics=[pb.Metric(**m) for m in props.get("metrics", [])],
+                completion_time=props.get("completion_time", ""),
+            ))
+        return pb.GetObservationsReply(
+            trials=sorted(out, key=lambda t: t.trial)
+        )
+
+    def close(self) -> None:
+        self._store.close()
+
+
+# ------------------------------------------------------------------- wiring
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+
+
+def serve(
+    port: int = 0,
+    host: str = "127.0.0.1",
+    observation_db: str | None = None,
+    max_workers: int = 4,
+):
+    """Start the gRPC server; returns (server, address, dbmanager|None)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    sugg = SuggestionService()
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(SUGGESTION_SERVICE, {
+            "GetSuggestions": _unary(
+                sugg.GetSuggestions, pb.GetSuggestionsRequest
+            ),
+            "ValidateAlgorithmSettings": _unary(
+                sugg.ValidateAlgorithmSettings,
+                pb.ValidateAlgorithmSettingsRequest,
+            ),
+        }),
+    ))
+    db = None
+    if observation_db:
+        db = DBManagerService(observation_db)
+        server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(DBMANAGER_SERVICE, {
+                "ReportObservation": _unary(
+                    db.ReportObservation, pb.ReportObservationRequest
+                ),
+                "GetObservations": _unary(
+                    db.GetObservations, pb.GetObservationsRequest
+                ),
+            }),
+        ))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server, f"{host}:{bound}", db
+
+
+class SuggestionClient:
+    """Typed client over the suggestion + db-manager services."""
+
+    def __init__(self, address: str):
+        self._chan = grpc.insecure_channel(address)
+        self._get = self._chan.unary_unary(
+            f"/{SUGGESTION_SERVICE}/GetSuggestions",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.GetSuggestionsReply.FromString,
+        )
+        self._validate = self._chan.unary_unary(
+            f"/{SUGGESTION_SERVICE}/ValidateAlgorithmSettings",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.ValidateAlgorithmSettingsReply.FromString,
+        )
+        self._report = self._chan.unary_unary(
+            f"/{DBMANAGER_SERVICE}/ReportObservation",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.Empty.FromString,
+        )
+        self._observations = self._chan.unary_unary(
+            f"/{DBMANAGER_SERVICE}/GetObservations",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.GetObservationsReply.FromString,
+        )
+
+    def get_suggestions(
+        self,
+        algorithm: str,
+        parameters: list[ParameterSpec],
+        history,
+        count: int,
+        settings: dict[str, str] | None = None,
+        objective_type: ObjectiveType = ObjectiveType.MAXIMIZE,
+        seed: int = 0,
+    ) -> list[dict[str, str]]:
+        req = pb.GetSuggestionsRequest(
+            algorithm=algorithm,
+            parameters=[_param_to_pb(p) for p in parameters],
+            history=history_to_pb(history),
+            count=count,
+            settings=settings or {},
+            objective_type=objective_type.value,
+            seed=seed,
+        )
+        reply = self._get(req)
+        return [
+            {a.name: a.value for a in s.assignments} for s in reply.suggestions
+        ]
+
+    def validate(self, algorithm: str, parameters, settings=None):
+        reply = self._validate(pb.ValidateAlgorithmSettingsRequest(
+            algorithm=algorithm,
+            parameters=[_param_to_pb(p) for p in parameters],
+            settings=settings or {},
+        ))
+        return reply.ok, reply.message
+
+    def report_observation(self, namespace, experiment, trial, condition,
+                           assignments, metrics, fingerprint="",
+                           completion_time=""):
+        self._report(pb.ReportObservationRequest(
+            namespace=namespace, experiment=experiment, trial=trial,
+            condition=condition, fingerprint=fingerprint,
+            assignments=[
+                pb.Assignment(name=k, value=v) for k, v in assignments.items()
+            ],
+            metrics=[pb.Metric(**m) for m in metrics],
+            completion_time=completion_time,
+        ))
+
+    def get_observations(self, namespace, experiment, fingerprint=""):
+        reply = self._observations(pb.GetObservationsRequest(
+            namespace=namespace, experiment=experiment, fingerprint=fingerprint,
+        ))
+        return [
+            {
+                "trial": t.trial,
+                "condition": t.condition,
+                "assignments": {a.name: a.value for a in t.assignments},
+                "metrics": [
+                    {"name": m.name, "latest": m.latest, "min": m.min,
+                     "max": m.max}
+                    for m in t.metrics
+                ],
+            }
+            for t in reply.trials
+        ]
+
+    def close(self) -> None:
+        self._chan.close()
+
+
+def _param_to_pb(p: ParameterSpec) -> pb.Parameter:
+    fs = p.feasible_space
+    return pb.Parameter(
+        name=p.name, type=p.parameter_type.value,
+        list=list(fs.list), min=fs.min, max=fs.max, step=fs.step,
+    )

@@ -105,6 +105,35 @@ def synthetic_text_dataset(
     return Dataset(x_tr, y_tr, x_te, y_te, num_classes)
 
 
+def synthetic_lm_dataset(
+    n_train: int = 512,
+    n_test: int = 128,
+    seq_len: int = 128,
+    vocab_size: int = 512,
+    seed: int = 0,
+    noise: float = 0.1,
+) -> Dataset:
+    """Causal-LM set with learnable structure: a noisy affine token chain
+    (next = (a·tok + b) mod (V-1) + 1), so next-token loss is reducible.
+    Labels ARE the inputs — models.gpt.causal_lm_loss shifts internally."""
+    rng = np.random.RandomState(seed)
+    a, b = 31, 17  # coprime with vocab-1 keeps the chain full-period-ish
+
+    def make(n: int) -> tuple[np.ndarray, np.ndarray]:
+        x = np.zeros((n, seq_len), np.int32)
+        x[:, 0] = rng.randint(1, vocab_size, size=n)
+        for t in range(1, seq_len):
+            nxt = (x[:, t - 1] * a + b) % (vocab_size - 1) + 1
+            flip = rng.rand(n) < noise
+            nxt[flip] = rng.randint(1, vocab_size, size=flip.sum())
+            x[:, t] = nxt
+        return x, x.copy()
+
+    x_tr, y_tr = make(n_train)
+    x_te, y_te = make(n_test)
+    return Dataset(x_tr, y_tr, x_te, y_te, num_classes=vocab_size)
+
+
 def batches(
     x: np.ndarray,
     y: np.ndarray,

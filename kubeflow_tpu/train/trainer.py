@@ -73,6 +73,18 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def classification_eval_metrics(logits: jax.Array, labels: jax.Array):
+    """Default eval contract: per-example (loss, accuracy), each (B,)."""
+    per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+    # token-level label tensors reduce their trailing dims to per-example
+    while per_ex.ndim > 1:
+        per_ex = per_ex.mean(-1)
+    while acc.ndim > 1:
+        acc = acc.mean(-1)
+    return per_ex, acc
+
+
 class Trainer:
     """Classification trainer for a flax module `model(x) -> logits`.
 
@@ -89,6 +101,7 @@ class Trainer:
         tx: optax.GradientTransformation | None = None,
         apply_fn: Callable | None = None,
         loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = cross_entropy_loss,
+        eval_metrics_fn: Callable | None = None,
         mesh: Mesh | None = None,
         partition_rules: Any = None,
     ):
@@ -102,6 +115,10 @@ class Trainer:
             else getattr(model, "PARTITION_RULES", None)
         )
         self.loss_fn = loss_fn
+        # per-example (loss, accuracy) for eval AND the train-step accuracy
+        # metric; tasks whose loss shifts/masks (causal LM) supply a matching
+        # metric fn so eval numbers measure what training optimizes
+        self.eval_metrics_fn = eval_metrics_fn or classification_eval_metrics
         self._accepts_train = model is not None and (
             "train" in inspect.signature(model.__call__).parameters
         )
@@ -188,7 +205,7 @@ class Trainer:
         )(state.params)
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        acc = (jnp.argmax(logits, -1) == y).mean()
+        acc = self.eval_metrics_fn(logits.astype(jnp.float32), y)[1].mean()
         new_state = state.replace(
             step=state.step + 1, params=params, opt_state=opt_state, extra=new_extra
         )
@@ -200,10 +217,10 @@ class Trainer:
             state.params, state.extra, self._cast(x), state.rng, False
         )
         logits = logits.astype(jnp.float32)
-        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        per_ex, acc = self.eval_metrics_fn(logits, y)
         return {
             "loss_sum": (per_ex * w).sum(),
-            "correct": ((jnp.argmax(logits, -1) == y) * w).sum(),
+            "correct": (acc * w).sum(),
             "count": w.sum(),
         }
 
@@ -352,7 +369,7 @@ class Trainer:
             with jax.set_mesh(self.mesh):
                 m = self._jit_eval_step(state, shard_batch((bx, by, w), self.mesh))
             tot_loss += float(m["loss_sum"])
-            correct += int(m["correct"])
+            correct += float(m["correct"])
             count += int(m["count"])
         return {
             "loss": tot_loss / max(count, 1),

@@ -241,3 +241,17 @@ class TestWatch:
 
         with pytest.raises((ApiError, urllib.error.HTTPError)):
             list(remote.watch("nonsense", timeout_s=2))
+
+
+def test_dashboard_ui(remote, tmp_path):
+    """GET /ui renders the read-only status page (L9 gesture)."""
+    import urllib.request
+
+    remote.apply(job_manifest(tmp_path, name="uijob", replicas=1))
+    remote.wait_for_job("uijob", timeout_s=60)
+    with urllib.request.urlopen(f"{remote.server}/ui") as r:
+        assert r.headers.get_content_type() == "text/html"
+        page = r.read().decode()
+    assert "kubeflow_tpu platform" in page
+    assert "default/uijob" in page
+    assert "Succeeded" in page

@@ -1,0 +1,175 @@
+"""GPT causal LM + causal context-parallel attention tests.
+
+Numerics: every causal path (blockwise, ring over a real context mesh,
+ulysses, flash-interpret) must match the dense causal reference; the ring
+case is the one the SURVEY calls out as hard (global-position masking
+across rotating KV shards).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.gpt import (
+    GPTConfig,
+    GPTLM,
+    causal_dense_attention,
+    causal_lm_loss,
+)
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.parallel import ring_attention as ra
+
+B, L, H, D = 2, 32, 4, 16
+
+
+@pytest.fixture(scope="module")
+def qkvb():
+    rng = jax.random.PRNGKey(0)
+    ks = jax.random.split(rng, 4)
+    q = jax.random.normal(ks[0], (B, L, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, L, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, L, H, D), jnp.float32)
+    # a couple of padded tail positions exercise bias+causal interaction
+    mask = jnp.ones((B, L), bool).at[:, -3:].set(False)
+    bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(jnp.float32)
+    return q, k, v, bias
+
+
+class TestCausalNumerics:
+    def test_blockwise_matches_dense(self, qkvb):
+        q, k, v, bias = qkvb
+        want = causal_dense_attention(q, k, v, bias)
+        got = ra.blockwise_attention(q, k, v, bias, block=8, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got)[:, : L - 3], np.asarray(want)[:, : L - 3],
+            atol=2e-5,
+        )
+
+    def test_ring_matches_dense_on_context_mesh(self, qkvb, cpu_devices):
+        q, k, v, bias = qkvb
+        want = causal_dense_attention(q, k, v, bias)
+        mesh = build_mesh(MeshConfig(data=2, context=4), cpu_devices[:8])
+        with jax.set_mesh(mesh):
+            qs = jax.device_put(q, NamedSharding(mesh, ra.QKV_SPEC))
+            ks_ = jax.device_put(k, NamedSharding(mesh, ra.QKV_SPEC))
+            vs = jax.device_put(v, NamedSharding(mesh, ra.QKV_SPEC))
+            bs = jax.device_put(bias, NamedSharding(mesh, ra.BIAS_SPEC))
+            got = jax.jit(
+                lambda *a: ra.ring_attention(*a, block=8, causal=True)
+            )(qs, ks_, vs, bs)
+        np.testing.assert_allclose(
+            np.asarray(got)[:, : L - 3], np.asarray(want)[:, : L - 3],
+            atol=2e-5,
+        )
+
+    def test_ring_causal_grads_match_dense(self, qkvb, cpu_devices):
+        q, k, v, bias = qkvb
+
+        def loss_dense(q, k, v):
+            return (causal_dense_attention(q, k, v, bias)[:, : L - 3] ** 2).mean()
+
+        g_want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+
+        mesh = build_mesh(MeshConfig(data=2, context=4), cpu_devices[:8])
+        with jax.set_mesh(mesh):
+
+            def loss_ring(q, k, v):
+                o = ra.ring_attention(q, k, v, bias, block=8, causal=True)
+                return (o[:, : L - 3] ** 2).mean()
+
+            g_got = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5
+            )
+
+    def test_ulysses_matches_dense(self, qkvb, cpu_devices):
+        q, k, v, bias = qkvb
+        want = causal_dense_attention(q, k, v, bias)
+        mesh = build_mesh(MeshConfig(data=2, context=4), cpu_devices[:8])
+        with jax.set_mesh(mesh):
+            got = jax.jit(
+                lambda *a: ra.ulysses_attention(*a, block=8, causal=True)
+            )(q, k, v, bias)
+        np.testing.assert_allclose(
+            np.asarray(got)[:, : L - 3], np.asarray(want)[:, : L - 3],
+            atol=2e-5,
+        )
+
+    def test_flash_interpret_matches_dense(self, qkvb):
+        q, k, v, bias = qkvb
+        want = causal_dense_attention(q, k, v, bias)
+        got = ra.flash_attention(q, k, v, bias, block=8, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got)[:, : L - 3], np.asarray(want)[:, : L - 3],
+            atol=2e-5,
+        )
+
+    def test_no_future_leakage(self):
+        """Changing a future token must not change past logits."""
+        cfg = GPTConfig.tiny(dropout_rate=0.0)
+        model = GPTLM(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 1,
+                                 cfg.vocab_size)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        base = model.apply(variables, ids)
+        bumped = model.apply(
+            variables, ids.at[0, 10].set((ids[0, 10] % (cfg.vocab_size - 1)) + 1)
+        )
+        np.testing.assert_allclose(
+            np.asarray(base)[0, :10], np.asarray(bumped)[0, :10], atol=1e-5
+        )
+        assert not np.allclose(
+            np.asarray(base)[0, 10:], np.asarray(bumped)[0, 10:], atol=1e-5
+        )
+
+
+class TestGPTTraining:
+    def test_lm_loss_decreases(self, cpu_devices):
+        from kubeflow_tpu.train import Trainer, TrainerConfig
+        from kubeflow_tpu.train.data import synthetic_lm_dataset
+
+        cfg = GPTConfig.tiny(dropout_rate=0.0)
+        ds = synthetic_lm_dataset(n_train=64, n_test=16, seq_len=32,
+                                  vocab_size=cfg.vocab_size)
+        trainer = Trainer(
+            GPTLM(cfg),
+            TrainerConfig(batch_size=16, steps=30, learning_rate=3e-3,
+                          log_every_steps=10**9),
+            loss_fn=causal_lm_loss,
+        )
+        state = trainer.init_state(ds.x_train[:16])
+        first = last = None
+        for i in range(30):
+            state, m = trainer.train_step(
+                state, (ds.x_train[:16], ds.y_train[:16])
+            )
+            if i == 0:
+                first = float(m["loss"])
+            last = float(m["loss"])
+        assert last < first * 0.8, (first, last)
+        # eval path handles token-level labels
+        ev = trainer.evaluate(state, ds)
+        assert np.isfinite(ev["loss"]) and 0.0 <= ev["accuracy"] <= 1.0
+
+    def test_ring_gpt_trains_on_context_mesh(self, cpu_devices):
+        from kubeflow_tpu.train import Trainer, TrainerConfig
+        from kubeflow_tpu.train.data import synthetic_lm_dataset
+
+        cfg = GPTConfig.tiny(dropout_rate=0.0, attention="ring",
+                             attention_block=8)
+        mesh = build_mesh(MeshConfig(data=2, fsdp=2, context=2),
+                          cpu_devices[:8])
+        ds = synthetic_lm_dataset(n_train=32, n_test=8, seq_len=32,
+                                  vocab_size=cfg.vocab_size)
+        trainer = Trainer(
+            GPTLM(cfg),
+            TrainerConfig(batch_size=8, steps=2, log_every_steps=10**9),
+            loss_fn=causal_lm_loss,
+            mesh=mesh,
+        )
+        state = trainer.init_state(ds.x_train[:8])
+        state, m = trainer.train_step(state, (ds.x_train[:8], ds.y_train[:8]))
+        assert np.isfinite(float(m["loss"]))

@@ -1,0 +1,177 @@
+"""gRPC suggestion service + db-manager tests (SURVEY.md §2.3/§2.4)."""
+
+import math
+
+import pytest
+
+from kubeflow_tpu.sweep.api import (
+    FeasibleSpace,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+)
+from kubeflow_tpu.sweep.rpc import SuggestionClient, serve
+from kubeflow_tpu.sweep.suggest import get_suggester
+
+
+def p_double(name, lo, hi):
+    return ParameterSpec(
+        name=name,
+        parameter_type=ParameterType.DOUBLE,
+        feasible_space=FeasibleSpace(min=str(lo), max=str(hi)),
+    )
+
+
+@pytest.fixture(scope="module")
+def rpc(tmp_path_factory):
+    db = tmp_path_factory.mktemp("obs") / "observations.db"
+    server, address, dbm = serve(port=0, observation_db=str(db))
+    client = SuggestionClient(address)
+    yield client
+    client.close()
+    server.stop(grace=None)
+    if dbm is not None:
+        dbm.close()
+
+
+class TestSuggestionRPC:
+    PARAMS = [p_double("x", 0.0, 1.0)]
+
+    def test_matches_in_process_suggester(self, rpc):
+        history = [({"x": "0.2"}, 0.5), ({"x": "0.8"}, 0.9), ({"x": "0.5"}, None)]
+        remote = rpc.get_suggestions(
+            "tpe", self.PARAMS, history, 3, seed=7,
+            objective_type=ObjectiveType.MAXIMIZE,
+        )
+        local = get_suggester(
+            "tpe", self.PARAMS, seed=7,
+            objective_type=ObjectiveType.MAXIMIZE,
+        ).suggest(history, 3)
+        assert remote == local  # same algorithm, same seed, same wire history
+
+    def test_nan_failed_trials_cross_the_wire(self, rpc):
+        history = [({"x": "0.5"}, float("nan"))] * 3 + [({"x": "0.1"}, 0.4)]
+        out = rpc.get_suggestions("random", self.PARAMS, history, 2, seed=1)
+        assert len(out) == 2
+
+    def test_invalid_algorithm_is_invalid_argument(self, rpc):
+        import grpc
+
+        with pytest.raises(grpc.RpcError) as ei:
+            rpc.get_suggestions("alchemy", self.PARAMS, [], 1)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_validate_settings(self, rpc):
+        ok, _ = rpc.validate("tpe", self.PARAMS)
+        assert ok
+        ok, msg = rpc.validate("hyperband", self.PARAMS)  # no resourceParameter
+        assert not ok and "resourceParameter" in msg
+
+
+class TestDBManagerRPC:
+    def test_report_and_query_observations(self, rpc):
+        for i, (cond, obj) in enumerate([
+            ("Succeeded", 0.91), ("Succeeded", 0.87), ("Failed", 0.0),
+        ]):
+            rpc.report_observation(
+                "default", "rpc-exp", f"rpc-exp-{i:04d}", cond,
+                assignments={"x": str(0.1 * i)},
+                metrics=[{"name": "acc", "latest": obj, "min": obj, "max": obj}],
+                fingerprint="fp1",
+            )
+        trials = rpc.get_observations("default", "rpc-exp", fingerprint="fp1")
+        assert [t["trial"] for t in trials] == [
+            "rpc-exp-0000", "rpc-exp-0001", "rpc-exp-0002"
+        ]
+        assert trials[0]["metrics"][0]["latest"] == pytest.approx(0.91)
+        assert trials[2]["condition"] == "Failed"
+        # fingerprint filter isolates spec versions
+        assert rpc.get_observations("default", "rpc-exp", "other") == []
+
+    def test_report_is_upsert(self, rpc):
+        for cond in ("Running", "Succeeded"):
+            rpc.report_observation(
+                "default", "up-exp", "up-exp-0000", cond,
+                assignments={}, metrics=[], fingerprint="f",
+            )
+        trials = rpc.get_observations("default", "up-exp")
+        assert len(trials) == 1 and trials[0]["condition"] == "Succeeded"
+
+
+class TestControllerOverRPC:
+    def test_experiment_uses_remote_suggestions(self, tmp_path):
+        """Full e2e: the experiment controller fetches every suggestion over
+        real gRPC — katib's suggestion-Deployment topology."""
+        import sys
+        import textwrap
+
+        from kubeflow_tpu.api.common import ObjectMeta
+        from kubeflow_tpu.client import Platform
+        from kubeflow_tpu.sweep import (
+            AlgorithmSpec,
+            Experiment,
+            ExperimentSpec,
+            Objective,
+            SweepClient,
+            TrialParameterSpec,
+            TrialTemplate,
+        )
+        from kubeflow_tpu.sweep.controller import ExperimentController
+
+        server, address, _ = serve(port=0)
+        try:
+            p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16)
+            # swap in an RPC-backed experiment controller before start
+            p.experiment_controller = ExperimentController(
+                p.cluster, log_reader=p._read_pod_log,
+                suggestion_endpoint=address,
+            )
+            with p:
+                script = tmp_path / "trial.py"
+                script.write_text(textwrap.dedent(
+                    """
+                    import os
+                    x = float(os.environ["X_PARAM"])
+                    print(f"objective={-(x - 0.6) ** 2}")
+                    """
+                ))
+                spec = textwrap.dedent(
+                    f"""
+                    apiVersion: kubeflow-tpu.org/v1
+                    kind: JAXJob
+                    spec:
+                      replicaSpecs:
+                        worker:
+                          replicas: 1
+                          template:
+                            container:
+                              command: [{sys.executable}, {script}]
+                              env:
+                                X_PARAM: "${{trialParameters.x}}"
+                    """
+                )
+                sweep = SweepClient(p, work_dir=str(tmp_path / "sweeps"))
+                sweep.create_experiment(Experiment(
+                    metadata=ObjectMeta(name="rpc-sweep"),
+                    spec=ExperimentSpec(
+                        parameters=[p_double("x", 0.0, 1.0)],
+                        objective=Objective(
+                            type=ObjectiveType.MAXIMIZE,
+                            objective_metric_name="objective",
+                        ),
+                        algorithm=AlgorithmSpec(algorithm_name="random"),
+                        trial_template=TrialTemplate(
+                            trial_spec=spec,
+                            trial_parameters=[
+                                TrialParameterSpec(name="x", reference="x")
+                            ],
+                        ),
+                        max_trial_count=4,
+                        parallel_trial_count=2,
+                    ),
+                ))
+                done = sweep.wait_for_experiment("rpc-sweep", timeout_s=120)
+                assert done.status.condition.value == "Succeeded"
+                assert done.status.trials_succeeded >= 4
+        finally:
+            server.stop(grace=None)
