@@ -226,9 +226,9 @@ def cmd_pipeline_run(args) -> int:
             arguments[k] = json.loads(v)
         except json.JSONDecodeError:
             arguments[k] = v
-    # trainJob steps need a live control plane; spin one up only then
+    # trainJob/sweep steps need a live control plane; spin one up only then
     needs_platform = any(
-        "trainJob" in ex
+        "trainJob" in ex or "sweep" in ex
         for ex in ir.get("deploymentSpec", {}).get("executors", {}).values()
     )
     with contextlib.ExitStack() as stack:

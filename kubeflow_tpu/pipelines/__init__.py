@@ -18,6 +18,7 @@ from kubeflow_tpu.pipelines.dsl import (
     TaskOutput,
     component,
     pipeline,
+    sweep,
     train_job,
 )
 from kubeflow_tpu.pipelines.runner import (
@@ -44,6 +45,7 @@ __all__ = [
     "compile_to_yaml",
     "component",
     "pipeline",
+    "sweep",
     "train_job",
     "validate_ir",
 ]

@@ -32,11 +32,19 @@ def compile_pipeline(pipeline: Pipeline) -> dict:
 
     for task in pipeline.tasks.values():
         manifest = getattr(task.component, "train_job_manifest", None)
+        sweep_manifest = getattr(task.component, "sweep_manifest", None)
         if manifest is not None:
             exec_def: dict[str, Any] = {"trainJob": {
                 "manifest": manifest,
                 "timeoutSeconds": getattr(
                     task.component, "train_job_timeout_s", 3600.0
+                ),
+            }}
+        elif sweep_manifest is not None:
+            exec_def = {"sweep": {
+                "manifest": sweep_manifest,
+                "timeoutSeconds": getattr(
+                    task.component, "sweep_timeout_s", 3600.0
                 ),
             }}
         else:
@@ -145,7 +153,7 @@ def validate_ir(ir: dict) -> dict:
         ex = executors.get(comps[cref].get("executorLabel"))
         if ex is None:
             raise ValueError(f"task {tname}: component {cref} has no executor")
-        if not ({"pythonFunction", "trainJob"} & set(ex)):
+        if not ({"pythonFunction", "trainJob", "sweep"} & set(ex)):
             raise ValueError(f"task {tname}: executor has no known runtime")
         for dep in t.get("dependentTasks", []):
             if dep not in tasks:

@@ -202,6 +202,40 @@ def train_job(name: str, manifest: str, timeout_s: float = 3600.0) -> TrainJobCo
     return TrainJobComponent(name=name, manifest=manifest, timeout_s=timeout_s)
 
 
+@dataclass
+class SweepComponent:
+    """A pipeline step that runs a hyperparameter Experiment and outputs the
+    optimal trial — the KFP-launches-Katib composition (SURVEY.md §3.4 ->
+    §3.3): downstream steps consume `optimalParameters` to train/serve with
+    the winning configuration. Manifest placeholders bind via `arguments`."""
+
+    name: str
+    manifest: str
+    timeout_s: float = 3600.0
+
+    def __call__(self, **arguments) -> TaskOutput:
+        ctx = _PipelineContext.current()
+        if ctx is None:
+            raise RuntimeError("sweep steps can only be called inside a @pipeline")
+        comp = Component(
+            name=self.name,
+            fn=None,
+            source="",
+            inputs={k: "STRING" for k in arguments},
+            defaults={},
+            output_type="STRUCT",
+        )
+        comp.sweep_manifest = self.manifest
+        comp.sweep_timeout_s = self.timeout_s
+        task = ctx.add_task(comp, arguments)
+        return task.output
+
+
+def sweep(name: str, manifest: str, timeout_s: float = 3600.0) -> SweepComponent:
+    """Declare an Experiment-running step for use inside @pipeline."""
+    return SweepComponent(name=name, manifest=manifest, timeout_s=timeout_s)
+
+
 def pipeline(fn: Callable | None = None, *, name: str | None = None,
              description: str = ""):
     """Trace a pipeline function into a Pipeline DAG."""

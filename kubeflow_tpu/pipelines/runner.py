@@ -192,6 +192,10 @@ class LocalPipelineRunner:
             self._run_train_job_task(run, run_dir, tname, executor, inputs,
                                      run_exec_id)
             return
+        if "sweep" in executor:
+            self._run_sweep_task(run, run_dir, tname, executor, inputs,
+                                 run_exec_id)
+            return
         source = executor["pythonFunction"]["source"]
         fn_name = executor["pythonFunction"]["functionName"]
 
@@ -267,26 +271,22 @@ class LocalPipelineRunner:
             )
             self._record_lineage(run, tname, inputs, result, run_exec_id)
             return
-        manifest = executor["trainJob"]["manifest"]
         timeout_s = float(executor["trainJob"].get("timeoutSeconds", 3600.0))
-        for k, v in inputs.items():
-            manifest = manifest.replace("${" + k + "}", str(v))
-        if "${" in manifest:
+        try:
             # a forgotten argument must fail fast, not train with a literal
             # '${lr}' string
-            leftover = sorted(set(re.findall(r"\$\{([\w.-]+)\}", manifest)))
-            result.state = TaskState.FAILED
-            result.error = (
-                f"unresolved manifest placeholder(s) {leftover}; pass them as "
-                f"arguments to the train_job step"
+            manifest, suffix = self._resolve_manifest(
+                run, tname, executor["trainJob"]["manifest"], inputs
             )
+        except ValueError as exc:
+            result.state = TaskState.FAILED
+            result.error = str(exc)
             self._record_lineage(run, tname, inputs, result, run_exec_id)
             return
         job = job_from_yaml(manifest)
         # Unique name per (run, step): seq+timestamp from run_id plus the
         # task name, so two steps sharing a manifest name in one run — or
         # back-to-back runs in the same second — never collide on the CR name.
-        suffix = "-".join(run.run_id.rsplit("-", 2)[-2:])
         job.metadata.name = f"{job.metadata.name}-{tname}-{suffix}"[-63:].strip("-")
         client = TrainingClient(self.platform)
         t0 = time.monotonic()
@@ -322,6 +322,101 @@ class LocalPipelineRunner:
         )
         if not done.status.is_succeeded:
             result.error = f"job {job.metadata.name} failed: {conditions}"
+        self._record_lineage(run, tname, inputs, result, run_exec_id)
+
+    @staticmethod
+    def _resolve_manifest(run: PipelineRun, tname: str, manifest: str,
+                          inputs: dict, allow_prefix: str = "") -> tuple[str, str]:
+        """Shared CR-step manifest plumbing: substitute ${param} inputs,
+        reject leftovers (optionally excluding `allow_prefix` placeholders —
+        trialParameters belong to the Experiment, not the pipeline), and
+        return (manifest, unique-name suffix for this run+step)."""
+        for k, v in inputs.items():
+            manifest = manifest.replace("${" + k + "}", str(v))
+        leftover = sorted(set(re.findall(r"\$\{([\w.-]+)\}", manifest)))
+        if allow_prefix:
+            leftover = [x for x in leftover if not x.startswith(allow_prefix)]
+        if leftover:
+            raise ValueError(
+                f"unresolved manifest placeholder(s) {leftover}; pass them "
+                f"as arguments to the {tname!r} step"
+            )
+        suffix = "-".join(run.run_id.rsplit("-", 2)[-2:])
+        return manifest, suffix
+
+    def _run_sweep_task(self, run: PipelineRun, run_dir: Path, tname: str,
+                        executor: dict, inputs: dict,
+                        run_exec_id: int | None) -> None:
+        """Run an Experiment through the platform; output = optimal trial.
+
+        Never cached (trials are side-effectful jobs). Downstream steps
+        consume output["optimalParameters"] — the KFP-then-Katib-then-train
+        composition (SURVEY.md §3.4 recursing into §3.3)."""
+        result = run.tasks[tname]
+        if self.platform is None:
+            result.state = TaskState.FAILED
+            result.error = "sweep step requires LocalPipelineRunner(platform=...)"
+            self._record_lineage(run, tname, inputs, result, run_exec_id)
+            return
+        timeout_s = float(executor["sweep"].get("timeoutSeconds", 3600.0))
+        try:
+            manifest, suffix = self._resolve_manifest(
+                run, tname, executor["sweep"]["manifest"], inputs,
+                allow_prefix="trialParameters",
+            )
+        except ValueError as exc:
+            result.state = TaskState.FAILED
+            result.error = str(exc)
+            self._record_lineage(run, tname, inputs, result, run_exec_id)
+            return
+        from kubeflow_tpu.sweep import SweepClient
+        from kubeflow_tpu.sweep.serde import experiment_from_yaml
+
+        exp = experiment_from_yaml(manifest)
+        exp.metadata.name = (
+            f"{exp.metadata.name}-{tname}-{suffix}"[-63:].strip("-")
+        )
+        client = SweepClient(self.platform, work_dir=str(self.work_dir / "sweeps"))
+        t0 = time.monotonic()
+        result.state = TaskState.RUNNING
+        try:
+            client.create_experiment(exp)
+            done = client.wait_for_experiment(
+                exp.metadata.name, exp.metadata.namespace, timeout_s=timeout_s
+            )
+        except Exception as exc:  # noqa: BLE001 — bad manifest => task fails
+            result.state = TaskState.FAILED
+            result.error = f"{type(exc).__name__}: {exc}"
+            try:
+                client.delete_experiment(exp.metadata.name, exp.metadata.namespace)
+            except Exception:  # noqa: BLE001
+                pass
+            self._record_lineage(run, tname, inputs, result, run_exec_id)
+            return
+        result.duration_s = time.monotonic() - t0
+        best = done.status.current_optimal_trial
+        result.output = {
+            "experimentName": exp.metadata.name,
+            "condition": done.status.condition.value,
+            "trials": done.status.trials,
+            "trialsSucceeded": done.status.trials_succeeded,
+            "optimalTrial": best.trial_name if best else None,
+            "optimalParameters": (
+                {a.name: a.value for a in best.parameter_assignments}
+                if best else {}
+            ),
+            "optimalMetrics": (
+                {m.name: m.latest for m in best.observation.metrics}
+                if best else {}
+            ),
+        }
+        succeeded = done.status.condition.value == "Succeeded" and best is not None
+        result.state = TaskState.SUCCEEDED if succeeded else TaskState.FAILED
+        if not succeeded:
+            result.error = (
+                f"experiment {exp.metadata.name} {done.status.condition.value}: "
+                f"{done.status.message}"
+            )
         self._record_lineage(run, tname, inputs, result, run_exec_id)
 
     def _record_lineage(self, run: PipelineRun, tname: str, inputs: dict,

@@ -55,6 +55,15 @@ class TrainerConfig:
     learning_rate: float = 1e-3
     weight_decay: float = 0.0
     warmup_steps: int = 0
+    # cosine decay to lr_final_fraction·lr, reaching the floor at `steps`
+    # total (decay spans steps - warmup_steps); requires `steps`.
+    # "constant" keeps the warmup->flat behavior
+    lr_schedule: str = "constant"     # constant | cosine
+    lr_final_fraction: float = 0.0
+    grad_clip_norm: float = 0.0       # 0 = off (global-norm clipping)
+    # accumulate this many microbatch grads per optimizer step — big
+    # effective batches without PP; runs as a lax.scan inside ONE jit step
+    grad_accum_steps: int = 1
     seed: int = 0
     compute_dtype: Any = jnp.float32  # bfloat16 for MXU-heavy models
     eval_every_epochs: int = 1
@@ -148,11 +157,26 @@ class Trainer:
     def _default_tx(self) -> optax.GradientTransformation:
         c = self.config
         lr: Any = c.learning_rate
-        if c.warmup_steps:
+        if c.lr_schedule == "cosine":
+            if c.steps is None:
+                raise ValueError("lr_schedule=cosine requires TrainerConfig.steps")
+            lr = optax.warmup_cosine_decay_schedule(
+                init_value=0.0 if c.warmup_steps else c.learning_rate,
+                peak_value=c.learning_rate,
+                warmup_steps=c.warmup_steps,
+                decay_steps=c.steps,
+                end_value=c.learning_rate * c.lr_final_fraction,
+            )
+        elif c.warmup_steps:
             lr = optax.linear_schedule(0.0, c.learning_rate, c.warmup_steps)
-        if c.weight_decay:
-            return optax.adamw(lr, weight_decay=c.weight_decay)
-        return optax.adam(lr)
+        opt = (
+            optax.adamw(lr, weight_decay=c.weight_decay)
+            if c.weight_decay
+            else optax.adam(lr)
+        )
+        if c.grad_clip_norm > 0:
+            opt = optax.chain(optax.clip_by_global_norm(c.grad_clip_norm), opt)
+        return opt
 
     # ------------------------------------------------------------------ init
 
@@ -182,30 +206,73 @@ class Trainer:
             lambda a: a.astype(dt) if jnp.issubdtype(a.dtype, jnp.floating) else a, x
         )
 
+    def _loss_of(self, params, extra, x, y, rng):
+        logits, new_extra = self.apply_fn(params, extra, x, rng, True)
+        loss = self.loss_fn(logits.astype(jnp.float32), y)
+        # auxiliary objectives sown into the 'losses' collection (e.g.
+        # MoE load-balance, parallel/moe.py) join the objective here;
+        # popped so they never persist into TrainState.extra
+        aux = new_extra.pop("losses", None) if isinstance(new_extra, dict) else None
+        if aux:
+            loss = loss + sum(
+                jnp.asarray(a, jnp.float32) for a in jax.tree.leaves(aux)
+            )
+        return loss, (logits, new_extra)
+
     def _train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         x, y = batch
         step_rng = jax.random.fold_in(state.rng, state.step)
         x = self._cast(x)
+        n_acc = max(self.config.grad_accum_steps, 1)
 
-        def loss_of(params):
-            logits, new_extra = self.apply_fn(params, state.extra, x, step_rng, True)
-            loss = self.loss_fn(logits.astype(jnp.float32), y)
-            # auxiliary objectives sown into the 'losses' collection (e.g.
-            # MoE load-balance, parallel/moe.py) join the objective here;
-            # popped so they never persist into TrainState.extra
-            aux = new_extra.pop("losses", None) if isinstance(new_extra, dict) else None
-            if aux:
-                loss = loss + sum(
-                    jnp.asarray(a, jnp.float32) for a in jax.tree.leaves(aux)
+        if n_acc == 1:
+            (loss, (logits, new_extra)), grads = jax.value_and_grad(
+                self._loss_of, has_aux=True
+            )(state.params, state.extra, x, y, step_rng)
+            acc = self.eval_metrics_fn(logits.astype(jnp.float32), y)[1].mean()
+        else:
+            # microbatch scan: grads averaged across n_acc slices before ONE
+            # optimizer update — big effective batches without extra memory
+            mb = x.shape[0] // n_acc
+            if mb * n_acc != x.shape[0]:
+                raise ValueError(
+                    f"batch {x.shape[0]} not divisible by "
+                    f"grad_accum_steps {n_acc}"
                 )
-            return loss, (logits, new_extra)
+            xs = jax.tree.map(
+                lambda a: a.reshape(n_acc, mb, *a.shape[1:]), (x, y)
+            )
 
-        (loss, (logits, new_extra)), grads = jax.value_and_grad(
-            loss_of, has_aux=True
-        )(state.params)
+            def micro(carry, mb_xy):
+                grads_acc, loss_acc, acc_acc, extra, i = carry
+                mx, my = mb_xy
+                rng_i = jax.random.fold_in(step_rng, i)
+                (l, (lg, new_extra)), g = jax.value_and_grad(
+                    self._loss_of, has_aux=True
+                )(state.params, extra, mx, my, rng_i)
+                a = self.eval_metrics_fn(lg.astype(jnp.float32), my)[1].mean()
+                grads_acc = jax.tree.map(jnp.add, grads_acc, g)
+                return (grads_acc, loss_acc + l, acc_acc + a, new_extra,
+                        i + 1), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (grads, loss, acc, new_extra, _), _ = jax.lax.scan(
+                micro,
+                (zeros, jnp.float32(0), jnp.float32(0), state.extra,
+                 jnp.int32(0)),
+                xs,
+            )
+            # back to the param dtype so both accumulation modes feed the
+            # optimizer identically-typed grads
+            grads = jax.tree.map(
+                lambda g, p: (g / n_acc).astype(p.dtype), grads, state.params
+            )
+            loss, acc = loss / n_acc, acc / n_acc
+
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        acc = self.eval_metrics_fn(logits.astype(jnp.float32), y)[1].mean()
         new_state = state.replace(
             step=state.step + 1, params=params, opt_state=opt_state, extra=new_extra
         )
@@ -275,6 +342,39 @@ class Trainer:
                 start_step, state = restored
                 metrics_lib.emit(step=start_step, resumed=1)
 
+        # TPU preemption contract: on SIGTERM save a checkpoint and exit
+        # cleanly so the gang restart resumes instead of losing the epoch
+        # (signals only bind on the main thread; elsewhere skip silently).
+        # The previous handler is restored when fit() returns.
+        preempted = {"flag": False}
+        prev_handler = None
+        if self.checkpointer is not None:
+            import signal as _signal
+
+            def _on_term(signum, frame):
+                preempted["flag"] = True
+
+            try:
+                prev_handler = _signal.signal(_signal.SIGTERM, _on_term)
+            except ValueError:
+                pass
+        try:
+            return self._fit_loop(
+                dataset, c, state, start_step, events, preempted, on_epoch_end
+            )
+        finally:
+            if prev_handler is not None:
+                import signal as _signal
+
+                try:
+                    _signal.signal(_signal.SIGTERM, prev_handler)
+                except ValueError:
+                    pass
+
+    def _fit_loop(self, dataset, c, state, start_step, events, preempted,
+                  on_epoch_end):
+        import os
+
         per_epoch = len(dataset.x_train) // c.batch_size
         if per_epoch == 0:
             raise ValueError(
@@ -315,6 +415,11 @@ class Trainer:
                             global_step, **last,
                             images_per_sec=timer.items_per_sec,
                         )
+                if preempted["flag"]:
+                    self.checkpointer.save(global_step, state)
+                    self.checkpointer.wait()
+                    metrics_lib.emit(step=global_step, preempted=1)
+                    return state, {**last, "preempted": 1.0}
                 if (
                     self.checkpointer is not None
                     and global_step % c.checkpoint_every_steps == 0

@@ -292,3 +292,82 @@ def regen():
     """Regenerate the golden IR file (run from repo root)."""
     GOLDEN.parent.mkdir(parents=True, exist_ok=True)
     GOLDEN.write_text(compile_to_yaml(add_square()))
+
+
+class TestSweepStep:
+    def test_pipeline_sweeps_then_consumes_optimum(self, tmp_path):
+        """KFP-launches-Katib composition: a sweep step finds the best x,
+        a python step consumes optimalParameters downstream."""
+        import sys as _sys
+        import textwrap as _tw
+
+        from kubeflow_tpu.client import Platform
+        from kubeflow_tpu.pipelines import (
+            LocalPipelineRunner,
+            compile_pipeline,
+            component,
+            pipeline,
+            sweep,
+        )
+
+        trial = tmp_path / "trial.py"
+        trial.write_text(_tw.dedent(
+            """
+            import os
+            x = float(os.environ["X_PARAM"])
+            print(f"objective={-(x - 0.5) ** 2}")
+            """
+        ))
+        exp_yaml = _tw.dedent(
+            f"""
+            apiVersion: kubeflow-tpu.org/v1beta1
+            kind: Experiment
+            metadata:
+              name: pipe-sweep
+            spec:
+              parameters:
+                - name: x
+                  parameterType: double
+                  feasibleSpace: {{min: "0.0", max: "1.0", step: "0.25"}}
+              objective:
+                type: maximize
+                objectiveMetricName: objective
+              algorithm:
+                algorithmName: grid
+              maxTrialCount: ${{maxTrials}}
+              parallelTrialCount: 3
+              trialTemplate:
+                trialParameters:
+                  - {{name: x, reference: x}}
+                trialSpec: |
+                  apiVersion: kubeflow-tpu.org/v1
+                  kind: JAXJob
+                  spec:
+                    replicaSpecs:
+                      worker:
+                        replicas: 1
+                        template:
+                          container:
+                            command: [{_sys.executable}, {trial}]
+                            env:
+                              X_PARAM: "${{trialParameters.x}}"
+            """
+        )
+
+        @component
+        def pick_lr(best: dict) -> float:
+            return float(best["optimalParameters"]["x"]) * 10
+
+        @pipeline(name="sweep-then-train")
+        def sweep_then_train(maxTrials: float = 5.0):
+            s = sweep("tune", exp_yaml, timeout_s=180)(maxTrials=maxTrials)
+            return pick_lr(best=s)
+
+        with Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16) as p:
+            runner = LocalPipelineRunner(
+                work_dir=str(tmp_path / "pipe"), platform=p, cache=False
+            )
+            run = runner.run(compile_pipeline(sweep_then_train()), {"maxTrials": 5})
+        assert run.succeeded, {t: (r.state.value, r.error) for t, r in run.tasks.items()}
+        assert run.tasks["tune"].output["optimalParameters"]["x"] == "0.5"
+        assert run.output == 5.0  # 0.5 * 10
