@@ -57,9 +57,26 @@ class TransformerSpec:
 
 
 @dataclass
+class AutoscalingSpec:
+    """HPA analogue for predictors: the controller samples each replica's
+    request counters and sizes the replica set to target_qps_per_replica."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_qps_per_replica: float = 10.0
+    # seconds between scaling decisions (cooldown)
+    scale_interval_s: float = 15.0
+
+
+@dataclass
 class InferenceServiceSpec:
     predictor: PredictorSpec = field(default_factory=PredictorSpec)
     transformer: TransformerSpec | None = None
+    # canary rollout (kserve canaryTrafficPercent): a second predictor spec
+    # served canary_traffic_percent of requests until promoted/rolled back
+    canary: PredictorSpec | None = None
+    canary_traffic_percent: int = 0
+    autoscaling: AutoscalingSpec | None = None
 
 
 @dataclass
@@ -74,6 +91,8 @@ class InferenceServiceStatus:
     url: str = ""  # primary endpoint (replica 0)
     replicas_ready: int = 0
     endpoints: list[ReplicaEndpoint] = field(default_factory=lambda: [])
+    canary_ready: int = 0
+    canary_endpoints: list[ReplicaEndpoint] = field(default_factory=lambda: [])
     message: str = ""
 
 
@@ -102,4 +121,22 @@ def validate_isvc(isvc: InferenceService) -> InferenceService:
         )
     if isvc.spec.transformer is not None and not isvc.spec.transformer.model_class:
         raise ValueError("inferenceservice: transformer requires modelClass")
+    if not (0 <= isvc.spec.canary_traffic_percent <= 100):
+        raise ValueError(
+            "inferenceservice: canaryTrafficPercent must be in [0, 100]"
+        )
+    if isvc.spec.canary_traffic_percent > 0 and isvc.spec.canary is None:
+        raise ValueError(
+            "inferenceservice: canaryTrafficPercent requires a canary predictor"
+        )
+    a = isvc.spec.autoscaling
+    if a is not None:
+        if not (1 <= a.min_replicas <= a.max_replicas):
+            raise ValueError(
+                "inferenceservice: autoscaling needs 1 <= minReplicas <= maxReplicas"
+            )
+        if a.target_qps_per_replica <= 0:
+            raise ValueError(
+                "inferenceservice: autoscaling.targetQpsPerReplica must be > 0"
+            )
     return isvc

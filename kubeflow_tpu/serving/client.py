@@ -23,6 +23,9 @@ class ServingClient:
         self.platform = platform
         self.cluster = platform.cluster
         self._rr = itertools.count()
+        # separate stripe counter: sharing _rr would lock the split check and
+        # the replica selection to opposite parities (skewing both)
+        self._split = itertools.count()
 
     # ------------------------------------------------------------------ CRUD
 
@@ -63,10 +66,69 @@ class ServingClient:
         isvc = self.get(name, namespace)
         if isvc is None:
             raise KeyError(name)
+        # canary traffic split (kserve canaryTrafficPercent): a deterministic
+        # 1-in-100 stripe of requests rides the canary endpoints
+        pct = isvc.spec.canary_traffic_percent
+        if pct > 0 and isvc.spec.canary is not None:
+            canary_ready = [e.url for e in isvc.status.canary_endpoints if e.ready]
+            if canary_ready and (next(self._split) % 100) < pct:
+                return canary_ready[next(self._rr) % len(canary_ready)]
         ready = [e.url for e in isvc.status.endpoints if e.ready]
         if not ready:
             raise RuntimeError(f"inferenceservice {name} has no ready replicas")
         return ready[next(self._rr) % len(ready)]
+
+    # ------------------------------------------------------------- rollouts
+
+    def _read_modify_write(self, name: str, namespace: str, mutate) -> InferenceService:
+        import time as _time
+
+        from kubeflow_tpu.controller.fakecluster import ConflictError
+
+        for _ in range(10):
+            isvc = self.cluster.get(
+                "inferenceservices", f"{namespace}/{name}", copy_obj=True
+            )
+            if isvc is None:
+                raise KeyError(name)
+            mutate(isvc)
+            try:
+                return self.cluster.update("inferenceservices", isvc)
+            except ConflictError:
+                _time.sleep(0.02)
+        raise RuntimeError(f"update of {namespace}/{name} kept conflicting")
+
+    def set_canary(self, name: str, canary, traffic_percent: int,
+                   namespace: str = "default") -> InferenceService:
+        """Start (or retune) a canary rollout."""
+
+        def mutate(isvc):
+            isvc.spec.canary = canary
+            isvc.spec.canary_traffic_percent = traffic_percent
+            validate_isvc(isvc)
+
+        return self._read_modify_write(name, namespace, mutate)
+
+    def promote_canary(self, name: str, namespace: str = "default") -> InferenceService:
+        """Canary becomes the predictor (100% traffic); canary set removed."""
+
+        def mutate(isvc):
+            if isvc.spec.canary is None:
+                raise ValueError(f"inferenceservice {name} has no canary")
+            isvc.spec.predictor = isvc.spec.canary
+            isvc.spec.canary = None
+            isvc.spec.canary_traffic_percent = 0
+
+        return self._read_modify_write(name, namespace, mutate)
+
+    def rollback_canary(self, name: str, namespace: str = "default") -> InferenceService:
+        """Drop the canary; all traffic back on the stable predictor."""
+
+        def mutate(isvc):
+            isvc.spec.canary = None
+            isvc.spec.canary_traffic_percent = 0
+
+        return self._read_modify_write(name, namespace, mutate)
 
     def _post(self, url: str, payload: dict, timeout_s: float) -> dict:
         req = urllib.request.Request(

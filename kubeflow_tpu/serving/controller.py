@@ -38,6 +38,26 @@ _PKG_ROOT = str(Path(kubeflow_tpu.__file__).resolve().parent.parent)
 ISVC_LABEL = "kubeflow-tpu.org/inferenceservice"
 PORT_ANNOTATION = "kubeflow-tpu.org/serving-port"
 REPLICA_INDEX_LABEL = "kubeflow-tpu.org/replica-index"
+CANARY_LABEL = "kubeflow-tpu.org/canary"
+SPEC_HASH_ANNOTATION = "kubeflow-tpu.org/predictor-spec-hash"
+
+
+def _spec_hash(predictor, transformer) -> str:
+    """Fingerprint of everything a replica's command/env derives from; a
+    changed spec rolls the replica (the Deployment-template-hash analogue)."""
+    import hashlib
+
+    from kubeflow_tpu.api.serde import to_dict
+
+    p = to_dict(predictor)
+    # replica COUNT shapes the set, not any one pod — autoscaling must not
+    # roll every replica on each scale decision
+    p.pop("replicas", None)
+    blob = json.dumps(
+        {"p": p, "t": to_dict(transformer) if transformer else None},
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
 
 
 def probe_ready(url: str, timeout_s: float = 0.5) -> bool:
@@ -65,6 +85,9 @@ class InferenceServiceController(ControllerBase):
         self._probe_pool = ThreadPoolExecutor(max_workers=8,
                                               thread_name_prefix="isvc-probe")
         self._seen: set[str] = set()
+        # key -> (monotonic time, {endpoint url -> request total}); per-URL
+        # so a restarted replica's counter reset never reads as load collapse
+        self._qps_samples: dict[str, tuple[float, dict[str, int]]] = {}
         self.metrics.update({
             "isvc_created_total": 0,
             "isvc_ready_total": 0,
@@ -107,17 +130,82 @@ class InferenceServiceController(ControllerBase):
             ):
                 self.cluster.delete("pods", p.key)
             self._seen.discard(key)
+            self._qps_samples.pop(key, None)
             return None
         if key not in self._seen:
             self._seen.add(key)
             self.metrics["isvc_created_total"] += 1
-        pods = self._owned_pods(isvc)
 
-        # self-heal: serving replicas must always run; any exited replica
-        # (crash OR clean exit) is replaced
+        created, endpoints = self._reconcile_replica_set(
+            isvc, key, isvc.spec.predictor, canary=False
+        )
+        if isvc.spec.canary is not None:
+            c_created, c_endpoints = self._reconcile_replica_set(
+                isvc, key, isvc.spec.canary, canary=True
+            )
+        else:
+            c_created, c_endpoints = 0, []
+            # promotion/rollback removed the canary: reap its pods — but only
+            # once the primary serves again (a promotion rolls the primary to
+            # the new spec; the canary bridges that window)
+            if any(e.ready for e in endpoints):
+                for p in self._owned_pods(isvc):
+                    if p.metadata.labels.get(CANARY_LABEL) == "true":
+                        self.cluster.delete("pods", p.key)
+        created += c_created
+
+        st = isvc.status
+        before = (st.ready, st.replicas_ready, st.url, st.canary_ready,
+                  tuple((e.url, e.ready) for e in st.endpoints),
+                  tuple((e.url, e.ready) for e in st.canary_endpoints))
+        st.endpoints = endpoints
+        st.replicas_ready = sum(1 for e in endpoints if e.ready)
+        st.canary_endpoints = c_endpoints
+        st.canary_ready = sum(1 for e in c_endpoints if e.ready)
+        newly_ready = st.replicas_ready > 0 and not st.ready
+        st.ready = st.replicas_ready > 0
+        ready_eps = [e for e in endpoints if e.ready]
+        st.url = ready_eps[0].url if ready_eps else ""
+        after = (st.ready, st.replicas_ready, st.url, st.canary_ready,
+                 tuple((e.url, e.ready) for e in st.endpoints),
+                 tuple((e.url, e.ready) for e in st.canary_endpoints))
+        if before != after:
+            self.cluster.update("inferenceservices", isvc)
+            if newly_ready:
+                self.metrics["isvc_ready_total"] += 1
+                self.cluster.record_event(
+                    "inferenceservices", key, "Ready",
+                    f"{st.replicas_ready}/{isvc.spec.predictor.replicas} "
+                    f"replicas ready at {st.url}",
+                )
+
+        self._autoscale(isvc, key, endpoints)
+
+        # keep probing until the full replica sets are ready
+        want_canary = isvc.spec.canary.replicas if isvc.spec.canary else 0
+        if (created or st.replicas_ready < isvc.spec.predictor.replicas
+                or st.canary_ready < want_canary):
+            return 0.3
+        return None
+
+    def _reconcile_replica_set(self, isvc: InferenceService, key: str,
+                               predictor, canary: bool):
+        """Self-heal + spec-hash roll + scale one replica set; returns
+        (created_count, probed endpoints)."""
+        flag = "true" if canary else ""
+        want_hash = _spec_hash(predictor, isvc.spec.transformer)
+        pods = [
+            p for p in self._owned_pods(isvc)
+            if p.metadata.labels.get(CANARY_LABEL, "") == flag
+        ]
+        deleted: set[str] = set()
+        rolled = False
         for p in pods:
             if p.status.phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
+                # self-heal: serving replicas must always run; any exited
+                # replica (crash OR clean exit) is replaced
                 self.cluster.delete("pods", p.key)
+                deleted.add(p.key)
                 self.metrics["predictor_pods_restarted_total"] += 1
                 self.cluster.record_event(
                     "inferenceservices", key, "PredictorRestarted",
@@ -125,14 +213,31 @@ class InferenceServiceController(ControllerBase):
                     f"(code {p.status.exit_code}); recreating",
                     type="Warning",
                 )
-        pods = [p for p in pods if p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)]
+            elif (
+                not rolled
+                and p.metadata.annotations.get(SPEC_HASH_ANNOTATION) != want_hash
+            ):
+                # rolling update: the spec this pod was built from changed
+                # (e.g. canary promotion). AT MOST ONE stale pod per pass so
+                # a multi-replica set keeps serving through the roll.
+                rolled = True
+                self.cluster.delete("pods", p.key)
+                deleted.add(p.key)
+                self.cluster.record_event(
+                    "inferenceservices", key, "PredictorRolled",
+                    f"replica {p.metadata.name} restarted for spec change",
+                )
+        pods = [
+            p for p in pods
+            if p.key not in deleted
+            and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+        ]
 
-        # create missing replicas
         have = {int(p.metadata.labels.get(REPLICA_INDEX_LABEL, -1)) for p in pods}
         created = 0
-        for i in range(isvc.spec.predictor.replicas):
+        for i in range(predictor.replicas):
             if i not in have:
-                self._create_replica(isvc, i)
+                self._create_replica(isvc, i, predictor, canary=canary)
                 created += 1
         # drop excess replicas after a scale-down (highest index first)
         for p in sorted(
@@ -140,9 +245,14 @@ class InferenceServiceController(ControllerBase):
             key=lambda p: int(p.metadata.labels.get(REPLICA_INDEX_LABEL, -1)),
             reverse=True,
         ):
-            if int(p.metadata.labels.get(REPLICA_INDEX_LABEL, -1)) >= isvc.spec.predictor.replicas:
+            if int(p.metadata.labels.get(REPLICA_INDEX_LABEL, -1)) >= predictor.replicas:
                 self.cluster.delete("pods", p.key)
-        pods = self._owned_pods(isvc)
+                deleted.add(p.key)
+        if created or deleted:
+            pods = [
+                p for p in self._owned_pods(isvc)
+                if p.metadata.labels.get(CANARY_LABEL, "") == flag
+            ]
 
         # probe readiness per running replica (concurrently: each probe can
         # block up to its timeout)
@@ -165,31 +275,77 @@ class InferenceServiceController(ControllerBase):
             ReplicaEndpoint(url=url, ready=(f is not None and f.result()))
             for url, f in zip(urls, futures)
         ]
+        # an in-progress roll counts as pending work (requeue until done)
+        return created + (1 if rolled else 0), endpoints
 
-        st = isvc.status
-        before = (st.ready, st.replicas_ready, st.url,
-                  tuple((e.url, e.ready) for e in st.endpoints))
-        st.endpoints = endpoints
-        st.replicas_ready = sum(1 for e in endpoints if e.ready)
-        newly_ready = st.replicas_ready > 0 and not st.ready
-        st.ready = st.replicas_ready > 0
-        ready_eps = [e for e in endpoints if e.ready]
-        st.url = ready_eps[0].url if ready_eps else ""
-        after = (st.ready, st.replicas_ready, st.url,
-                 tuple((e.url, e.ready) for e in st.endpoints))
-        if before != after:
-            self.cluster.update("inferenceservices", isvc)
-            if newly_ready:
-                self.metrics["isvc_ready_total"] += 1
-                self.cluster.record_event(
-                    "inferenceservices", key, "Ready",
-                    f"{st.replicas_ready}/{isvc.spec.predictor.replicas} "
-                    f"replicas ready at {st.url}",
+    # ------------------------------------------------------------ autoscale
+
+    def _autoscale(self, isvc: InferenceService, key: str, endpoints) -> None:
+        """HPA analogue: size the primary replica set to the observed request
+        rate (kfserving_requests_total deltas from each ready replica's
+        /metrics), clamped to [min, max], one decision per scale interval."""
+        a = isvc.spec.autoscaling
+        if a is None:
+            return
+        import math
+        import re
+        import time
+
+        now = time.monotonic()
+        prev = self._qps_samples.get(key)
+        if prev is not None and now - prev[0] < a.scale_interval_s:
+            return  # inside the decision window: no sampling, no blocking IO
+
+        def fetch(url: str) -> tuple[str, int] | None:
+            try:
+                with urllib.request.urlopen(f"{url}/metrics", timeout=0.5) as r:
+                    text = r.read().decode()
+                return url, sum(
+                    int(m) for m in re.findall(
+                        r"^kfserving_requests_total\{[^}]*\} (\d+)$",
+                        text, re.MULTILINE,
+                    )
                 )
-        # keep probing until the full replica set is ready
-        if created or st.replicas_ready < isvc.spec.predictor.replicas:
-            return 0.3
-        return None
+            except Exception:  # noqa: BLE001 — a dead replica samples as absent
+                return None
+
+        futures = [
+            self._probe_pool.submit(fetch, e.url) for e in endpoints if e.ready
+        ]
+        counts = dict(f.result() for f in futures if f.result() is not None)
+        if not counts:
+            return
+        self._qps_samples[key] = (now, counts)
+        if prev is None:
+            return
+        t0, counts0 = prev
+        dt = max(now - t0, 1e-6)
+        # per-URL deltas: a restarted replica's counter reset (or a scaled-
+        # down replica vanishing) must never read as a load collapse; a
+        # fresh URL's full count accrued within the window
+        delta = sum(
+            max(c - counts0.get(url, 0), 0) for url, c in counts.items()
+        )
+        qps = delta / dt
+        desired = int(
+            min(max(math.ceil(qps / a.target_qps_per_replica), a.min_replicas),
+                a.max_replicas)
+        )
+        if desired == isvc.spec.predictor.replicas:
+            return
+        cur = self.cluster.get("inferenceservices", key, copy_obj=True)
+        if cur is None or cur.spec.autoscaling is None:
+            return
+        cur.spec.predictor.replicas = desired
+        try:
+            self.cluster.update("inferenceservices", cur)
+        except Exception:  # noqa: BLE001 — conflict: next resync re-decides
+            return
+        self.cluster.record_event(
+            "inferenceservices", key, "Autoscaled",
+            f"replicas -> {desired} (observed {qps:.1f} qps, "
+            f"target {a.target_qps_per_replica}/replica)",
+        )
 
     # ------------------------------------------------------------- sub-steps
 
@@ -200,8 +356,10 @@ class InferenceServiceController(ControllerBase):
             and p.metadata.namespace == isvc.metadata.namespace,
         )
 
-    def _create_replica(self, isvc: InferenceService, index: int) -> None:
-        p = isvc.spec.predictor
+    def _create_replica(self, isvc: InferenceService, index: int,
+                        predictor=None, canary: bool = False) -> None:
+        p = predictor if predictor is not None else isvc.spec.predictor
+        kind = "canary" if canary else "predictor"
         port = free_port()
         cmd = [
             sys.executable, "-m", "kubeflow_tpu.serving.server",
@@ -211,7 +369,7 @@ class InferenceServiceController(ControllerBase):
             # per-replica dir: concurrent replicas pulling the same model
             # must not clobber each other's files mid-load
             "--model-dir",
-            f"{self.model_cache_dir}/{isvc.metadata.namespace}/r{index}",
+            f"{self.model_cache_dir}/{isvc.metadata.namespace}/{kind}-r{index}",
         ]
         if p.storage_uri:
             cmd += ["--storage-uri", p.storage_uri]
@@ -226,15 +384,21 @@ class InferenceServiceController(ControllerBase):
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
             else (os.pathsep + os.environ["PYTHONPATH"] if os.environ.get("PYTHONPATH") else "")
         )
+        labels = {
+            ISVC_LABEL: isvc.metadata.name,
+            REPLICA_INDEX_LABEL: str(index),
+        }
+        if canary:
+            labels[CANARY_LABEL] = "true"
         pod = Pod(
             metadata=ObjectMeta(
-                name=f"{isvc.metadata.name}-predictor-{index}",
+                name=f"{isvc.metadata.name}-{kind}-{index}",
                 namespace=isvc.metadata.namespace,
-                labels={
-                    ISVC_LABEL: isvc.metadata.name,
-                    REPLICA_INDEX_LABEL: str(index),
+                labels=labels,
+                annotations={
+                    PORT_ANNOTATION: str(port),
+                    SPEC_HASH_ANNOTATION: _spec_hash(p, isvc.spec.transformer),
                 },
-                annotations={PORT_ANNOTATION: str(port)},
             ),
             command=cmd,
             env=env,

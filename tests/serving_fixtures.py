@@ -30,3 +30,13 @@ class PlusOneTransformer(Model):
 
     def postprocess(self, outputs):
         return (-np.asarray(outputs)).tolist()
+
+
+class TripleModel(Model):
+    """Predicts 3*x — distinguishable from DoubleModel for canary tests."""
+
+    def load(self):
+        self.ready = True
+
+    def predict(self, inputs):
+        return np.asarray(inputs) * 3.0

@@ -1,0 +1,154 @@
+"""Canary traffic-split + autoscaling (kserve canaryTrafficPercent / HPA
+analogue — SURVEY.md §2.5)."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.client import Platform
+from kubeflow_tpu.serving import ServingClient
+from kubeflow_tpu.serving.api import (
+    AutoscalingSpec,
+    InferenceService,
+    InferenceServiceSpec,
+    PredictorRuntime,
+    PredictorSpec,
+)
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    with Platform(log_dir=str(tmp_path / "pod-logs")) as p:
+        yield p
+
+
+def _custom(model_class: str, replicas: int = 1) -> PredictorSpec:
+    return PredictorSpec(
+        runtime=PredictorRuntime.CUSTOM,
+        model_class=model_class,
+        replicas=replicas,
+    )
+
+
+def _wait_canary_ready(serving, name, n=1, timeout_s=60):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        isvc = serving.get(name)
+        if isvc is not None and isvc.status.canary_ready >= n:
+            return isvc
+        time.sleep(0.3)
+    raise TimeoutError(f"canary of {name} never ready")
+
+
+class TestCanaryRollout:
+    def test_split_promote_roll(self, platform):
+        serving = ServingClient(platform)
+        serving.create(InferenceService(
+            metadata=ObjectMeta(name="canary-svc"),
+            spec=InferenceServiceSpec(
+                predictor=_custom("tests.serving_fixtures:DoubleModel"),
+            ),
+        ))
+        serving.wait_ready("canary-svc", timeout_s=60)
+
+        # start a 30% canary on a different model
+        serving.set_canary(
+            "canary-svc", _custom("tests.serving_fixtures:TripleModel"), 30
+        )
+        _wait_canary_ready(serving, "canary-svc")
+
+        # traffic split: over 100 requests both variants must serve, with
+        # the canary in the minority (deterministic 1-in-100 striping)
+        got = {2.0: 0, 3.0: 0}
+        for _ in range(100):
+            out = serving.predict("canary-svc", [[1.0]])
+            got[out["predictions"][0][0]] += 1
+        assert got[3.0] == 30 and got[2.0] == 70, got
+
+        # promote: canary becomes the predictor; pods roll to the new spec
+        serving.promote_canary("canary-svc")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            isvc = serving.get("canary-svc")
+            if (
+                isvc.spec.canary is None
+                and isvc.status.ready
+                and not isvc.status.canary_endpoints
+            ):
+                try:
+                    if serving.predict("canary-svc", [[1.0]])["predictions"][0][0] == 3.0:
+                        break
+                except RuntimeError:
+                    pass  # mid-roll: no ready replicas for a moment
+            time.sleep(0.3)
+        else:
+            pytest.fail("promotion never converged")
+        for _ in range(10):
+            out = serving.predict("canary-svc", [[1.0]])
+            assert out["predictions"][0][0] == 3.0
+
+    def test_rollback_removes_canary_pods(self, platform):
+        serving = ServingClient(platform)
+        serving.create(InferenceService(
+            metadata=ObjectMeta(name="rb-svc"),
+            spec=InferenceServiceSpec(
+                predictor=_custom("tests.serving_fixtures:DoubleModel"),
+                canary=_custom("tests.serving_fixtures:TripleModel"),
+                canary_traffic_percent=50,
+            ),
+        ))
+        serving.wait_ready("rb-svc", timeout_s=60)
+        _wait_canary_ready(serving, "rb-svc")
+        serving.rollback_canary("rb-svc")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            canary_pods = [
+                p for p in platform.cluster.list("pods")
+                if p.metadata.labels.get("kubeflow-tpu.org/canary") == "true"
+                and p.metadata.labels.get("kubeflow-tpu.org/inferenceservice") == "rb-svc"
+            ]
+            if not canary_pods:
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail("canary pods not reaped after rollback")
+        # stable predictor unaffected
+        assert serving.predict("rb-svc", [[1.0]])["predictions"][0][0] == 2.0
+
+
+class TestAutoscaling:
+    def test_scales_up_under_load_then_down(self, platform):
+        serving = ServingClient(platform)
+        serving.create(InferenceService(
+            metadata=ObjectMeta(name="auto-svc"),
+            spec=InferenceServiceSpec(
+                predictor=_custom("tests.serving_fixtures:DoubleModel"),
+                autoscaling=AutoscalingSpec(
+                    min_replicas=1, max_replicas=3,
+                    target_qps_per_replica=3.0, scale_interval_s=2.0,
+                ),
+            ),
+        ))
+        serving.wait_ready("auto-svc", timeout_s=60)
+
+        # hammer for ~6s: well over 3 qps -> must scale past 1 replica
+        deadline = time.monotonic() + 20
+        scaled_up = False
+        while time.monotonic() < deadline and not scaled_up:
+            for _ in range(30):
+                serving.predict("auto-svc", [[1.0]])
+            isvc = serving.get("auto-svc")
+            scaled_up = isvc.spec.predictor.replicas > 1
+        assert scaled_up, "never scaled up under load"
+        events = [e.reason for e in platform.cluster.events_for("default/auto-svc")]
+        assert "Autoscaled" in events
+
+        # idle: must come back down to min_replicas
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            isvc = serving.get("auto-svc")
+            if isvc.spec.predictor.replicas == 1:
+                return
+            time.sleep(0.5)
+        pytest.fail("never scaled back down to min")
