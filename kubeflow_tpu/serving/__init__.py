@@ -11,6 +11,7 @@ from kubeflow_tpu.serving.api import (
     InferenceServiceStatus,
     PredictorRuntime,
     PredictorSpec,
+    ExplainerSpec,
     TransformerSpec,
     validate_isvc,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "PredictorRuntime",
     "PredictorSpec",
     "ServingClient",
+    "ExplainerSpec",
     "TransformerSpec",
     "load_model_class",
     "pull_model",

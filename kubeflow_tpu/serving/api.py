@@ -57,6 +57,16 @@ class TransformerSpec:
 
 
 @dataclass
+class ExplainerSpec:
+    """:explain hop (kserve explainer analogue): a CUSTOM model class whose
+    explain() answers /v1/models/{m}:explain; it receives the predictor
+    chain as predict_fn for black-box perturbation."""
+
+    model_class: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class AutoscalingSpec:
     """HPA analogue for predictors: the controller samples each replica's
     request counters and sizes the replica set to target_qps_per_replica."""
@@ -72,6 +82,7 @@ class AutoscalingSpec:
 class InferenceServiceSpec:
     predictor: PredictorSpec = field(default_factory=PredictorSpec)
     transformer: TransformerSpec | None = None
+    explainer: ExplainerSpec | None = None
     # canary rollout (kserve canaryTrafficPercent): a second predictor spec
     # served canary_traffic_percent of requests until promoted/rolled back
     canary: PredictorSpec | None = None
@@ -121,6 +132,8 @@ def validate_isvc(isvc: InferenceService) -> InferenceService:
         )
     if isvc.spec.transformer is not None and not isvc.spec.transformer.model_class:
         raise ValueError("inferenceservice: transformer requires modelClass")
+    if isvc.spec.explainer is not None and not isvc.spec.explainer.model_class:
+        raise ValueError("inferenceservice: explainer requires modelClass")
     if not (0 <= isvc.spec.canary_traffic_percent <= 100):
         raise ValueError(
             "inferenceservice: canaryTrafficPercent must be in [0, 100]"

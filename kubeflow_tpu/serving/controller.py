@@ -42,7 +42,7 @@ CANARY_LABEL = "kubeflow-tpu.org/canary"
 SPEC_HASH_ANNOTATION = "kubeflow-tpu.org/predictor-spec-hash"
 
 
-def _spec_hash(predictor, transformer) -> str:
+def _spec_hash(predictor, transformer, explainer=None) -> str:
     """Fingerprint of everything a replica's command/env derives from; a
     changed spec rolls the replica (the Deployment-template-hash analogue)."""
     import hashlib
@@ -54,7 +54,8 @@ def _spec_hash(predictor, transformer) -> str:
     # roll every replica on each scale decision
     p.pop("replicas", None)
     blob = json.dumps(
-        {"p": p, "t": to_dict(transformer) if transformer else None},
+        {"p": p, "t": to_dict(transformer) if transformer else None,
+         "e": to_dict(explainer) if explainer else None},
         sort_keys=True,
     )
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
@@ -193,7 +194,8 @@ class InferenceServiceController(ControllerBase):
         """Self-heal + spec-hash roll + scale one replica set; returns
         (created_count, probed endpoints)."""
         flag = "true" if canary else ""
-        want_hash = _spec_hash(predictor, isvc.spec.transformer)
+        want_hash = _spec_hash(predictor, isvc.spec.transformer,
+                                isvc.spec.explainer)
         pods = [
             p for p in self._owned_pods(isvc)
             if p.metadata.labels.get(CANARY_LABEL, "") == flag
@@ -379,7 +381,15 @@ class InferenceServiceController(ControllerBase):
             cmd += ["--device", p.device]
         if isvc.spec.transformer is not None:
             cmd += ["--transformer-class", isvc.spec.transformer.model_class]
+        if isvc.spec.explainer is not None:
+            cmd += ["--explainer-class", isvc.spec.explainer.model_class]
         env = dict(p.env)
+        # transformer/explainer hops run in the same server process: their
+        # env merges in (predictor keys win on collision)
+        if isvc.spec.explainer is not None:
+            env = {**isvc.spec.explainer.env, **env}
+        if isvc.spec.transformer is not None:
+            env = {**isvc.spec.transformer.env, **env}
         env["PYTHONPATH"] = _PKG_ROOT + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
             else (os.pathsep + os.environ["PYTHONPATH"] if os.environ.get("PYTHONPATH") else "")
@@ -397,7 +407,9 @@ class InferenceServiceController(ControllerBase):
                 labels=labels,
                 annotations={
                     PORT_ANNOTATION: str(port),
-                    SPEC_HASH_ANNOTATION: _spec_hash(p, isvc.spec.transformer),
+                    SPEC_HASH_ANNOTATION: _spec_hash(
+                        p, isvc.spec.transformer, isvc.spec.explainer
+                    ),
                 },
             ),
             command=cmd,

@@ -38,8 +38,39 @@ class Model:
     def postprocess(self, outputs: Any) -> Any:
         return outputs
 
+    def explain(self, inputs: Any) -> Any:
+        """kserve :explain contract: override in an explainer model.
+        `self.predict_fn` (bound by the server when an explainer wraps a
+        predictor) calls the underlying predictor."""
+        raise NotImplementedError(f"model {self.name!r} has no explainer")
+
     def __call__(self, inputs: Any) -> Any:
         return self.postprocess(self.predict(self.preprocess(inputs)))
+
+
+class ExplainedModel(Model):
+    """Explainer hop (kserve explainer analogue, in-process): predict flows
+    through the predictor; :explain calls the explainer with a handle on the
+    predictor chain (black-box explainers perturb inputs through it)."""
+
+    def __init__(self, name: str, predictor: Model, explainer: Model):
+        super().__init__(name)
+        self.predictor = predictor
+        self.explainer = explainer
+        self.explainer.predict_fn = predictor  # callable chain handle
+
+    def load(self) -> None:
+        if not self.predictor.ready:
+            self.predictor.load()
+        if not self.explainer.ready:
+            self.explainer.load()
+        self.ready = True
+
+    def predict(self, inputs: Any) -> Any:
+        return self.predictor(inputs)
+
+    def explain(self, inputs: Any) -> Any:
+        return self.explainer.explain(inputs)
 
 
 def load_model_class(path: str) -> type[Model]:

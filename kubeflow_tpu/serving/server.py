@@ -182,6 +182,10 @@ class ModelServer:
         if path.startswith("/v1/models/") and path.endswith(":predict"):
             name = path[len("/v1/models/"):-len(":predict")]
             return self._logged(name, "v1", req_bytes, self._predict_v1, body)
+        if path.startswith("/v1/models/") and path.endswith(":explain"):
+            name = path[len("/v1/models/"):-len(":explain")]
+            return self._logged(name, "v1-explain", req_bytes,
+                                self._explain_v1, body)
         if path.startswith("/v2/models/") and path.endswith("/infer"):
             name = path[len("/v2/models/"):-len("/infer")]
             return self._logged(name, "v2", req_bytes, self._infer_v2, body)
@@ -267,6 +271,25 @@ class ModelServer:
         if isinstance(out, dict) and "predictions" in out:
             return 200, out
         return 200, {"predictions": np.asarray(out).tolist()}
+
+    def _explain_v1(self, name: str, body: dict) -> tuple[int, dict]:
+        m = self._get_ready_model(name)
+        if isinstance(m, tuple):
+            return m
+        # no-explainer is a routing fact, decided by type — a crashing
+        # explainer (incl. a NotImplementedError from user code) is a 500
+        if type(m).explain is Model.explain:
+            return 404, {"error": f"model {name!r} has no explainer"}
+        instances = body.get("instances")
+        if instances is None:
+            return 400, {"error": "v1 request must carry 'instances'"}
+        try:
+            out = m.explain(np.asarray(instances))
+        except Exception as exc:  # noqa: BLE001 — surface as 500, keep serving
+            return 500, {"error": f"{type(exc).__name__}: {exc}"}
+        if isinstance(out, dict):
+            return 200, out
+        return 200, {"explanations": np.asarray(out).tolist()}
 
     def _infer_v2(self, name: str, body: dict) -> tuple[int, dict]:
         m = self._get_ready_model(name)
@@ -358,6 +381,7 @@ def main(argv: list[str] | None = None) -> None:
     )
     ap.add_argument("--model-class", default="")
     ap.add_argument("--transformer-class", default="")
+    ap.add_argument("--explainer-class", default="")
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--device", default="", help="tpu|cpu (default: env)")
@@ -395,6 +419,13 @@ def main(argv: list[str] | None = None) -> None:
         t_cls = load_model_class(args.transformer_class)
         model = TransformedModel(
             args.model_name, model, t_cls(f"{args.model_name}-transformer")
+        )
+    if args.explainer_class:
+        from kubeflow_tpu.serving.model import ExplainedModel
+
+        e_cls = load_model_class(args.explainer_class)
+        model = ExplainedModel(
+            args.model_name, model, e_cls(f"{args.model_name}-explainer")
         )
 
     srv = ModelServer(

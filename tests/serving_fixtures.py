@@ -40,3 +40,16 @@ class TripleModel(Model):
 
     def predict(self, inputs):
         return np.asarray(inputs) * 3.0
+
+
+class SignExplainer(Model):
+    """Black-box explainer: attributes each feature its sign after the
+    predictor chain (exercises the predict_fn handle)."""
+
+    def load(self):
+        self.ready = True
+
+    def explain(self, inputs):
+        preds = np.asarray(self.predict_fn(np.asarray(inputs)))
+        return {"explanations": np.sign(preds).tolist(),
+                "predictions": preds.tolist()}

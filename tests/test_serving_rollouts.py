@@ -152,3 +152,58 @@ class TestAutoscaling:
                 return
             time.sleep(0.5)
         pytest.fail("never scaled back down to min")
+
+
+class TestExplainer:
+    def test_explain_endpoint_through_platform(self, platform):
+        import json
+        import urllib.request
+
+        from kubeflow_tpu.serving.api import ExplainerSpec
+
+        serving = ServingClient(platform)
+        serving.create(InferenceService(
+            metadata=ObjectMeta(name="exp-svc"),
+            spec=InferenceServiceSpec(
+                predictor=_custom("tests.serving_fixtures:DoubleModel"),
+                explainer=ExplainerSpec(
+                    model_class="tests.serving_fixtures:SignExplainer"
+                ),
+            ),
+        ))
+        ready = serving.wait_ready("exp-svc", timeout_s=60)
+        req = urllib.request.Request(
+            f"{ready.status.url}/v1/models/exp-svc:explain",
+            data=json.dumps({"instances": [[-2.0, 3.0]]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["explanations"] == [[-1.0, 1.0]]
+        assert out["predictions"] == [[-4.0, 6.0]]
+        # predict still flows through the predictor untouched
+        assert serving.predict("exp-svc", [[1.0]])["predictions"][0][0] == 2.0
+
+    def test_explain_without_explainer_404(self, platform):
+        import urllib.error
+        import urllib.request
+        import json
+
+        import pytest as _pytest
+
+        serving = ServingClient(platform)
+        serving.create(InferenceService(
+            metadata=ObjectMeta(name="noexp-svc"),
+            spec=InferenceServiceSpec(
+                predictor=_custom("tests.serving_fixtures:DoubleModel"),
+            ),
+        ))
+        ready = serving.wait_ready("noexp-svc", timeout_s=60)
+        req = urllib.request.Request(
+            f"{ready.status.url}/v1/models/noexp-svc:explain",
+            data=json.dumps({"instances": [[1.0]]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with _pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 404
