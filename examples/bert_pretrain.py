@@ -1,0 +1,94 @@
+"""BERT MLM pretraining — the missing half of the BERT story.
+
+Fine-tuning lives in examples/bert.py; this entry point runs the masked-LM
+pretraining objective with tied input/output embeddings (BertForMaskedLM)
+under the same Trainer/mesh machinery:
+
+  python -m examples.bert_pretrain --device=tpu --size=base --steps=200
+  python -m examples.bert_pretrain --size=tiny --fsdp=4 --model-parallel=2
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+    p.add_argument("--size", default="base", choices=["tiny", "base"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--mask-prob", type=float, default=0.15)
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--no-bf16", dest="bf16", action="store_false")
+    p.add_argument("--data-parallel", type=int, default=-1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--model-parallel", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import BertConfig, BertForMaskedLM
+    from kubeflow_tpu.models.bert import masked_lm_eval_metrics, masked_lm_loss
+    from kubeflow_tpu.parallel import MeshConfig
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import (
+        Dataset,
+        mask_tokens_for_mlm,
+        synthetic_text_dataset,
+    )
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    mk = BertConfig.tiny if args.size == "tiny" else BertConfig.base
+    cfg = mk(dtype=dtype, max_len=max(args.seq_len, 512))
+    # the top id is TRULY reserved as [MASK]: data and random replacements
+    # both draw from [1, vocab-1)
+    mask_id = cfg.vocab_size - 1
+    data_vocab = cfg.vocab_size - 1
+    raw = synthetic_text_dataset(
+        n_train=args.batch_size * 8,
+        n_test=args.batch_size * 2,
+        seq_len=args.seq_len,
+        vocab_size=data_vocab,
+    )
+    x_tr, y_tr = mask_tokens_for_mlm(
+        raw.x_train, data_vocab, mask_id, args.mask_prob
+    )
+    x_te, y_te = mask_tokens_for_mlm(
+        raw.x_test, data_vocab, mask_id, args.mask_prob, seed=1
+    )
+    ds = Dataset(x_tr, y_tr, x_te, y_te, num_classes=cfg.vocab_size)
+
+    trainer = Trainer(
+        BertForMaskedLM(cfg),
+        TrainerConfig(
+            batch_size=args.batch_size,
+            steps=args.steps,
+            learning_rate=args.lr,
+            warmup_steps=min(100, args.steps // 10),
+            compute_dtype=dtype,
+            checkpoint_dir=args.checkpoint_dir,
+            mesh=MeshConfig(
+                data=args.data_parallel,
+                fsdp=args.fsdp,
+                model=args.model_parallel,
+            ),
+            log_every_steps=10,
+        ),
+        loss_fn=masked_lm_loss,
+        eval_metrics_fn=masked_lm_eval_metrics,
+    )
+    _, metrics = trainer.fit(ds)
+    return metrics.get("final_loss", float("inf"))
+
+
+if __name__ == "__main__":
+    main()

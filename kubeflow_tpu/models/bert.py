@@ -292,3 +292,35 @@ class BertForMaskedLM(nn.Module):
 # the Trainer picks TP rules up from the model class (trainer.py)
 for _cls in (BertEncoder, BertForSequenceClassification, BertForMaskedLM):
     _cls.PARTITION_RULES = PARTITION_RULES
+
+
+# --------------------------------------------------------------- MLM training
+
+from kubeflow_tpu.train.data import IGNORE_LABEL  # noqa: E402 — shared sentinel
+
+
+def masked_lm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """BERT pretraining objective: cross entropy at masked positions only.
+
+    labels: (B, L) int — original token ids at masked positions,
+    IGNORE_LABEL elsewhere (train/data.py mask_tokens_for_mlm builds them).
+    """
+    import optax
+
+    w = (labels != IGNORE_LABEL).astype(jnp.float32)
+    safe = jnp.where(labels == IGNORE_LABEL, 0, labels)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    return (per_tok * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def masked_lm_eval_metrics(logits: jax.Array, labels: jax.Array):
+    """Per-example (masked loss, masked accuracy) — Trainer eval contract."""
+    import optax
+
+    w = (labels != IGNORE_LABEL).astype(jnp.float32)
+    safe = jnp.where(labels == IGNORE_LABEL, 0, labels)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    denom = jnp.maximum(w.sum(-1), 1.0)
+    per_ex = (per_tok * w).sum(-1) / denom
+    acc = ((jnp.argmax(logits, -1) == safe) * w).sum(-1) / denom
+    return per_ex, acc

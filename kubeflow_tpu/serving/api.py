@@ -40,7 +40,8 @@ class PredictorSpec:
     # CUSTOM runtime: import path "package.module:ClassName"
     model_class: str = ""
     replicas: int = 1
-    # batch axis the server pads requests to (0 = compile per batch shape)
+    # >0 enables server-side adaptive micro-batching: concurrent requests
+    # coalesce into one forward pass of up to this many rows
     max_batch_size: int = 0
     env: dict[str, str] = field(default_factory=dict)
     # device flag forwarded to the server process (tpu|cpu)

@@ -379,6 +379,10 @@ class InferenceServiceController(ControllerBase):
             cmd += ["--model-class", p.model_class]
         if p.device:
             cmd += ["--device", p.device]
+        if p.max_batch_size > 0:
+            # agent micro-batching: concurrent requests coalesce into one
+            # forward pass up to this many rows (serving/agent.py)
+            cmd += ["--max-batch-size", str(p.max_batch_size)]
         if isvc.spec.transformer is not None:
             cmd += ["--transformer-class", isvc.spec.transformer.model_class]
         if isvc.spec.explainer is not None:

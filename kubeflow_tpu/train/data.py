@@ -134,6 +134,38 @@ def synthetic_lm_dataset(
     return Dataset(x_tr, y_tr, x_te, y_te, num_classes=vocab_size)
 
 
+# positions excluded from token-level objectives (HF convention); the single
+# source of truth — models.bert imports it
+IGNORE_LABEL = -100
+
+
+def mask_tokens_for_mlm(
+    x: np.ndarray,
+    vocab_size: int,
+    mask_token_id: int,
+    mask_prob: float = 0.15,
+    pad_token_id: int = 0,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """BERT MLM corruption: of the selected positions, 80% become [MASK],
+    10% a random token drawn from [1, vocab_size), 10% unchanged; labels
+    carry the ORIGINAL ids at selected positions and IGNORE_LABEL elsewhere.
+    Pass the DATA vocab (excluding the mask id) as vocab_size so random
+    replacements never draw the sentinel."""
+    rng = np.random.RandomState(seed)
+    labels = np.full_like(x, IGNORE_LABEL)
+    corrupted = x.copy()
+    selectable = x != pad_token_id
+    selected = (rng.rand(*x.shape) < mask_prob) & selectable
+    labels[selected] = x[selected]
+    roll = rng.rand(*x.shape)
+    corrupted[selected & (roll < 0.8)] = mask_token_id
+    rand_repl = selected & (roll >= 0.8) & (roll < 0.9)
+    random_ids = rng.randint(1, vocab_size, size=x.shape)
+    corrupted[rand_repl] = random_ids[rand_repl]
+    return corrupted, labels
+
+
 def batches(
     x: np.ndarray,
     y: np.ndarray,

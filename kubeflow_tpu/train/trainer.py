@@ -469,7 +469,8 @@ class Trainer:
             if n < bs:
                 pad = bs - n
                 bx = np.concatenate([bx, np.zeros((pad, *bx.shape[1:]), bx.dtype)])
-                by = np.concatenate([by, np.zeros((pad,), by.dtype)])
+                # labels may be token-level (B, L) — pad with the full shape
+                by = np.concatenate([by, np.zeros((pad, *by.shape[1:]), by.dtype)])
             w = (np.arange(bs) < n).astype(np.float32)
             with jax.set_mesh(self.mesh):
                 m = self._jit_eval_step(state, shard_batch((bx, by, w), self.mesh))

@@ -114,3 +114,60 @@ def test_bert_single_device_matches_tp_numerics():
         _, m = trainer.train_step(state, batch)
         losses[name] = float(m["loss"])
     assert losses["single"] == pytest.approx(losses["tp"], rel=1e-4)
+
+
+class TestMaskedLM:
+    def test_mask_corruption_contract(self):
+        import numpy as np
+
+        from kubeflow_tpu.train.data import mask_tokens_for_mlm
+
+        x = np.random.RandomState(0).randint(1, 100, size=(8, 64)).astype(np.int32)
+        x[:, -5:] = 0  # padding
+        corrupted, labels = mask_tokens_for_mlm(x, 100, mask_token_id=99,
+                                                mask_prob=0.3)
+        sel = labels != -100
+        assert 0 < sel.sum() < x.size
+        assert not sel[:, -5:].any()  # padding never selected
+        # labels carry ORIGINAL ids; unselected positions untouched
+        np.testing.assert_array_equal(labels[sel], x[sel])
+        np.testing.assert_array_equal(corrupted[~sel], x[~sel])
+        assert (corrupted[sel] == 99).mean() > 0.5  # ~80% become [MASK]
+
+    def test_mlm_loss_decreases(self):
+        import numpy as np
+
+        from kubeflow_tpu.models import BertConfig, BertForMaskedLM
+        from kubeflow_tpu.models.bert import (
+            masked_lm_eval_metrics,
+            masked_lm_loss,
+        )
+        from kubeflow_tpu.train import Trainer, TrainerConfig
+        from kubeflow_tpu.train.data import (
+            Dataset,
+            mask_tokens_for_mlm,
+            synthetic_text_dataset,
+        )
+
+        cfg = BertConfig.tiny(dropout_rate=0.0)
+        raw = synthetic_text_dataset(n_train=32, n_test=16, seq_len=32,
+                                     vocab_size=cfg.vocab_size)
+        x_tr, y_tr = mask_tokens_for_mlm(
+            raw.x_train, cfg.vocab_size, cfg.vocab_size - 1, 0.25
+        )
+        ds = Dataset(x_tr, y_tr, raw.x_test, raw.y_test, cfg.vocab_size)
+        trainer = Trainer(
+            BertForMaskedLM(cfg),
+            TrainerConfig(batch_size=16, steps=25, learning_rate=3e-3,
+                          log_every_steps=10**9),
+            loss_fn=masked_lm_loss,
+            eval_metrics_fn=masked_lm_eval_metrics,
+        )
+        state = trainer.init_state(ds.x_train[:16])
+        first = last = None
+        for i in range(25):
+            state, m = trainer.train_step(state, (ds.x_train[:16], ds.y_train[:16]))
+            if i == 0:
+                first = float(m["loss"])
+            last = float(m["loss"])
+        assert np.isfinite(last) and last < first * 0.9, (first, last)
