@@ -65,6 +65,18 @@ class Platform:
             platform=self,
         )
         self.metrics_server = None  # started on demand
+        # single registry: observability iterates THIS, so a new controller
+        # can never silently fall out of /metrics
+        self.controllers = {
+            "job": self.controller,
+            "experiment": self.experiment_controller,
+            "isvc": self.isvc_controller,
+            "pipelinerun": self.pipelinerun_controller,
+            "profile": self.profile_controller,
+            "tensorboard": self.tensorboard_controller,
+            "notebook": self.notebook_controller,
+            "pvcviewer": self.pvcviewer_controller,
+        }
         self._started = False
 
     def start_metrics_server(self, port: int = 0) -> str:

@@ -31,12 +31,7 @@ def render_metrics(platform) -> str:
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name}{labels} {value}")
 
-    controllers = {
-        "job": platform.controller,
-        "experiment": platform.experiment_controller,
-        "isvc": platform.isvc_controller,
-    }
-    for cname, ctrl in controllers.items():
+    for cname, ctrl in platform.controllers.items():
         for mname, v in sorted(ctrl.metrics.items()):
             counter(f"kftpu_{cname}_{mname}", v)
         gauge(
