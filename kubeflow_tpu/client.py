@@ -96,16 +96,12 @@ class Platform:
 
     def start(self) -> "Platform":
         if not self._started:
+            # runtime first, then every registered controller — the registry
+            # is the single list (observability iterates the same one)
             self.pod_runtime.start()
             self.gang_scheduler.start()
-            self.controller.start()
-            self.experiment_controller.start()
-            self.isvc_controller.start()
-            self.profile_controller.start()
-            self.tensorboard_controller.start()
-            self.notebook_controller.start()
-            self.pvcviewer_controller.start()
-            self.pipelinerun_controller.start()
+            for ctrl in self.controllers.values():
+                ctrl.start()
             self._started = True
         return self
 
@@ -113,14 +109,8 @@ class Platform:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
-        self.pipelinerun_controller.stop()
-        self.pvcviewer_controller.stop()
-        self.notebook_controller.stop()
-        self.tensorboard_controller.stop()
-        self.profile_controller.stop()
-        self.isvc_controller.stop()
-        self.experiment_controller.stop()
-        self.controller.stop()
+        for ctrl in reversed(list(self.controllers.values())):
+            ctrl.stop()
         self.gang_scheduler.stop()
         self.pod_runtime.stop()
         self._started = False

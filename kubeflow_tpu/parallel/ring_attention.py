@@ -14,7 +14,9 @@ TPU-first:
                      scatter back (DeepSpeed-Ulysses; PAPERS.md).
   flash_attention    single-device blockwise-softmax pallas kernel (VMEM
                      accumulators, MXU matmuls, f32 softmax), custom-VJP'd
-                     with a recomputing jnp backward.
+                     with FUSED pallas backward kernels (dq and dk/dv/dbias
+                     recompute probability tiles from the saved logsumexp —
+                     FlashAttention-2 style, no O(L²) residuals).
 
 All functions share the signature of models.bert.dense_attention:
   (q, k, v, bias, dropout_rng, dropout_rate, block) -> out
@@ -260,7 +262,8 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
 # ------------------------------------------------------------------ pallas fwd
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                  m_scr, l_scr, acc_scr,
                   *, scale: float, n_kv: int, causal: bool,
                   block_q: int, block_k: int):
     """Flash-attention forward tile: one (batch*head, q_block) position,
@@ -311,17 +314,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
     @pl.when(ik == n_kv - 1)
     def _():
         o_ref[0] = (acc_scr[:] / l_scr[:]).astype(o_ref.dtype)
+        # logsumexp residual for the fused backward kernels
+        lse_ref[0] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
 def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
-                   causal: bool = False):
+                   causal: bool = False, want_lse: bool = False):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = 1.0 / (d**0.5)
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     if lq % block_q or lk % block_k:
-        return blockwise_attention(q, k, v, bias, causal=causal)
+        out = blockwise_attention(q, k, v, bias, causal=causal)
+        return (out, None) if want_lse else out
     # fold heads into batch: (B*H, L, D)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
@@ -332,7 +338,7 @@ def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
         _flash_kernel, scale=scale, n_kv=n_kv, causal=causal,
         block_q=block_q, block_k=block_k,
     )
-    of = pl.pallas_call(
+    of, lse = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_kv),
         in_specs=[
@@ -343,8 +349,14 @@ def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
                 (1, 1, 1, block_k), lambda bh, iq, ik, h=h: (bh // h, 0, 0, ik)
             ),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, lq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -352,7 +364,180 @@ def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
         ],
         interpret=jax.default_backend() == "cpu",
     )(qf, kf, vf, bias)
-    return of.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    out = of.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    return (out, lse) if want_lse else out
+
+
+# ------------------------------------------------------------------ pallas bwd
+
+
+def _flash_bwd_scores(q, k, bias_row, lse, scale, causal, iq, ik,
+                      block_q, block_k):
+    """Recompute the probability tile p = exp(s - lse) for one (q, kv) block
+    pair — shared by the dq and dk/dv kernels."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    s = s + bias_row.astype(jnp.float32)[None, :]
+    if causal:
+        rows = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = s + jnp.where(cols > rows, NEG_INF, 0.0)
+    return jnp.exp(s - lse)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
+                     dq_ref, acc_scr, *, scale, n_kv, causal,
+                     block_q, block_k):
+    """dq tile: sequential grid over KV blocks, accumulator in VMEM.
+    ds = p * (dO·vᵀ − D);  dq = scale · Σ_k ds·k."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        p = _flash_bwd_scores(
+            q_ref[0], k_ref[0], bias_ref[0, 0, 0, :], lse_ref[0],
+            scale, causal, iq, ik, block_q, block_k,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dd_ref[0])
+        acc_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(ik * block_k <= iq * block_q + (block_q - 1))(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == n_kv - 1)
+    def _():
+        dq_ref[0] = (acc_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
+                      dk_ref, dv_ref, dbias_ref, dk_scr, dv_scr, db_scr,
+                      *, scale, n_q, causal, block_q, block_k):
+    """dk/dv/dbias tiles: sequential grid over Q blocks per KV block.
+    dv = Σ_q pᵀ·dO;  dk = scale · Σ_q dsᵀ·q;  dbias = Σ_q Σ_rows ds."""
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+        db_scr[:] = jnp.zeros_like(db_scr)
+
+    def _compute():
+        p = _flash_bwd_scores(
+            q_ref[0], k_ref[0], bias_ref[0, 0, 0, :], lse_ref[0],
+            scale, causal, iq, ik, block_q, block_k,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dd_ref[0])
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        db_scr[:] += ds.sum(axis=0, keepdims=True)
+
+    if causal:
+        pl.when(ik * block_k <= iq * block_q + (block_q - 1))(_compute)
+    else:
+        _compute()
+
+    @pl.when(iq == n_q - 1)
+    def _():
+        dk_ref[0] = (dk_scr[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        dbias_ref[0] = db_scr[:].astype(dbias_ref.dtype)
+
+
+def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = 1.0 / (d**0.5)
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    fold = lambda t, L: t.transpose(0, 2, 1, 3).reshape(b * h, L, d)  # noqa: E731
+    qf, kf, vf = fold(q, lq), fold(k, lk), fold(v, lk)
+    of, gf = fold(o, lq), fold(g, lq)
+    # D_i = Σ_d dO_i · O_i  (FlashAttention-2 eq. for the softmax jacobian)
+    dd = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1, keepdims=True)
+    n_q, n_kv = lq // block_q, lk // block_k
+    interpret = jax.default_backend() == "cpu"
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0))
+    bspec = pl.BlockSpec(
+        (1, 1, 1, block_k), lambda bh, iq, ik, h=h: (bh // h, 0, 0, ik)
+    )
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda bh, iq, ik: (bh, iq, 0))
+
+    dqf = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, n_kv=n_kv,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=(b * h, n_q, n_kv),
+        in_specs=[qspec, kspec, kspec, bspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, bias, gf, lse, dd)
+
+    # dkv grid: (bh, KV block, Q block) — q varies fastest
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda bh, ik, iq: (bh, iq, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0))
+    bspec2 = pl.BlockSpec(
+        (1, 1, 1, block_k), lambda bh, ik, iq, h=h: (bh // h, 0, 0, ik)
+    )
+    rowspec2 = pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0))
+    dkf, dvf, dbias_bh = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, n_q=n_q,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=(b * h, n_kv, n_q),
+        in_specs=[qspec2, kspec2, kspec2, bspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[
+            kspec2, kspec2,
+            pl.BlockSpec((1, 1, block_k), lambda bh, ik, iq: (bh, 0, ik)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, lk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, lk), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((1, block_k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, bias, gf, lse, dd)
+
+    unfold = lambda t, L: t.reshape(b, h, L, d).transpose(0, 2, 1, 3)  # noqa: E731
+    dbias = dbias_bh.reshape(b, h, 1, lk).sum(axis=1, keepdims=False)
+    dbias = dbias[:, None, :, :].astype(bias.dtype)  # (B, 1, 1, Lk)
+    return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -361,13 +546,22 @@ def _flash(q, k, v, bias, block_q, block_k, causal):
 
 
 def _flash_fwd(q, k, v, bias, block_q, block_k, causal):
-    return _flash_forward(q, k, v, bias, block_q, block_k, causal), (q, k, v, bias)
+    # one source of truth for the fused-vs-fallback decision: the forward
+    # itself — lse is None exactly when it took the blockwise fallback
+    out, lse = _flash_forward(
+        q, k, v, bias, block_q, block_k, causal, want_lse=True
+    )
+    return out, (q, k, v, bias, out if lse is not None else None, lse)
 
 
 def _flash_bwd(block_q, block_k, causal, residuals, g):
-    q, k, v, bias = residuals
-    # recomputing jnp backward — memory-efficient via the scan in
-    # blockwise_attention; a fused pallas bwd kernel is a later optimization
+    q, k, v, bias, o, lse = residuals
+    if lse is not None:
+        # fused pallas backward: recompute probability tiles from the saved
+        # logsumexp — no O(L²) residuals, no full forward replay
+        return _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k,
+                               causal)
+    # ragged shapes fell back to blockwise in the forward: mirror it here
     _, vjp = jax.vjp(
         lambda q, k, v, bias: blockwise_attention(
             q, k, v, bias, block_k, causal=causal
@@ -382,8 +576,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
                     block: int = 128, causal: bool = False):
-    """Pallas flash attention (single device / per-shard). Differentiable via
-    a recomputing backward; attention dropout unsupported."""
+    """Pallas flash attention (single device / per-shard). Fused pallas
+    forward AND backward; attention dropout unsupported."""
     if dropout_rate:
         raise NotImplementedError("attention dropout unsupported in flash path")
     return _flash(q, k, v, bias, block, block, causal)
