@@ -218,3 +218,73 @@ class TestPyTorchJob:
         client.create_job(job)
         done = client.wait_for_job_conditions("pt2", timeout_s=60)
         assert done.status.is_failed
+
+
+class TestXGBoostJob:
+    def test_rabit_env_and_master_decides(self, client, tmp_path):
+        from kubeflow_tpu.api.jobs import XGBoostJob
+
+        job = XGBoostJob(
+            metadata=ObjectMeta(name="xgb1"),
+            spec=JAXJobSpec(
+                replica_specs=_replicas(
+                    tmp_path, "xgb1",
+                    {
+                        REPLICA_MASTER: (1, """
+                            import os
+                            assert os.environ["DMLC_NUM_WORKER"] == "2"
+                            assert os.environ["RANK"] == "0"
+                            assert os.environ["DMLC_TRACKER_URI"]
+                            print("xgb master done")
+                        """),
+                        # workers idle: success must come from the MASTER
+                        # (proves the success topology) and RUNNING reaps them
+                        REPLICA_WORKER: (2, """
+                            import os, time
+                            assert os.environ["RANK"] in ("1", "2")
+                            time.sleep(300)
+                        """),
+                    },
+                ),
+                run_policy=RunPolicy(clean_pod_policy=CleanPodPolicy.RUNNING),
+            ),
+        )
+        client.create_job(job)
+        done = client.wait_for_job_conditions("xgb1", timeout_s=60)
+        assert done.status.is_succeeded
+        assert "xgb master done" in client.get_job_logs("xgb1", rtype="master")
+
+
+class TestPaddleJob:
+    def test_trainer_endpoints_env(self, client, tmp_path):
+        from kubeflow_tpu.api.jobs import PaddleJob
+
+        job = PaddleJob(
+            metadata=ObjectMeta(name="pd1"),
+            spec=JAXJobSpec(
+                replica_specs=_replicas(
+                    tmp_path, "pd1",
+                    {
+                        REPLICA_MASTER: (1, """
+                            import os
+                            assert os.environ["PADDLE_TRAINER_ID"] == "0"
+                            assert os.environ["PADDLE_TRAINERS_NUM"] == "3"
+                            eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+                            assert len(eps) == 3, eps
+                            assert os.environ["PADDLE_CURRENT_ENDPOINT"] == eps[0]
+                            print("paddle master done")
+                        """),
+                        REPLICA_WORKER: (2, """
+                            import os, time
+                            assert os.environ["PADDLE_TRAINER_ID"] in ("1", "2")
+                            time.sleep(300)
+                        """),
+                    },
+                ),
+                run_policy=RunPolicy(clean_pod_policy=CleanPodPolicy.RUNNING),
+            ),
+        )
+        client.create_job(job)
+        done = client.wait_for_job_conditions("pd1", timeout_s=60)
+        assert done.status.is_succeeded
+        assert "paddle master done" in client.get_job_logs("pd1", rtype="master")
