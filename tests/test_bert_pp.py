@@ -119,3 +119,38 @@ class TestBertPP:
             losses.append(float(m["loss"]))
         assert all(np.isfinite(v) for v in losses)
         assert losses[-1] < losses[0]
+
+
+def test_pp_state_checkpoint_roundtrip(tmp_path, cpu_devices):
+    """Stacked stage params (pipeline-sharded) must survive orbax
+    save/restore — the gang-restart contract for PP jobs."""
+    from kubeflow_tpu.models import BertConfig, BertPipelineClassifier
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_text_dataset
+
+    cfg = BertConfig.tiny(dropout_rate=0.0, num_layers=4)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, pipeline=2), cpu_devices[:8])
+    ds = synthetic_text_dataset(n_train=16, n_test=8, seq_len=16,
+                                vocab_size=cfg.vocab_size)
+    mk = lambda: Trainer(  # noqa: E731
+        BertPipelineClassifier(cfg, num_stages=2, n_micro=2),
+        TrainerConfig(batch_size=8, steps=1, log_every_steps=10**9,
+                      checkpoint_dir=str(tmp_path / "ckpt")),
+        mesh=mesh,
+    )
+    t1 = mk()
+    state = t1.init_state(ds.x_train[:8])
+    state, _ = t1.train_step(state, (ds.x_train[:8], ds.y_train[:8]))
+    t1.checkpointer.save(1, state)
+    t1.checkpointer.wait()
+    want = jax.tree.leaves(state.params)
+
+    t2 = mk()
+    restored = t2.checkpointer.restore_latest(t2.init_state(ds.x_train[:8]))
+    assert restored is not None and restored[0] == 1
+    got = jax.tree.leaves(restored[1].params)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # restored stage params keep the pipeline sharding
+    qk = restored[1].params["stages"]["layer_0"]["attention"]["query"]["kernel"]
+    assert qk.sharding.spec[0] == "pipeline"

@@ -140,3 +140,35 @@ class TestMoeBert:
             losses.append(float(m["loss"]))
         assert all(np.isfinite(v) for v in losses)
         assert losses[-1] < losses[0]  # aux + task loss both optimizable
+
+
+def test_moe_state_checkpoint_roundtrip(tmp_path, cpu_devices):
+    """Expert-sharded MoE params must survive orbax save/restore."""
+    from kubeflow_tpu.models import BertConfig, BertForSequenceClassification
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_text_dataset
+
+    cfg = BertConfig.tiny(dropout_rate=0.0, moe_experts=4)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=1, expert=2, model=2),
+                      cpu_devices[:8])
+    ds = synthetic_text_dataset(n_train=16, n_test=8, seq_len=16,
+                                vocab_size=cfg.vocab_size)
+    mk = lambda: Trainer(  # noqa: E731
+        BertForSequenceClassification(cfg, num_classes=2),
+        TrainerConfig(batch_size=8, steps=1, log_every_steps=10**9,
+                      checkpoint_dir=str(tmp_path / "ckpt")),
+        mesh=mesh,
+    )
+    t1 = mk()
+    state = t1.init_state(ds.x_train[:8])
+    state, _ = t1.train_step(state, (ds.x_train[:8], ds.y_train[:8]))
+    t1.checkpointer.save(1, state)
+    t1.checkpointer.wait()
+    want = np.asarray(state.params["encoder"]["layer_0"]["moe"]["w_up"])
+
+    t2 = mk()
+    restored = t2.checkpointer.restore_latest(t2.init_state(ds.x_train[:8]))
+    assert restored is not None and restored[0] == 1
+    wu = restored[1].params["encoder"]["layer_0"]["moe"]["w_up"]
+    np.testing.assert_allclose(np.asarray(wu), want, atol=1e-6)
+    assert wu.sharding.spec[0] == "expert"
