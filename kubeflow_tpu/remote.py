@@ -119,27 +119,9 @@ class RemoteClient:
             }
             return bool(conds & {"Succeeded", "Failed"})
 
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            try:
-                for ev in self.watch(
-                    "jobs", namespace=namespace, name=name,
-                    timeout_s=min(30.0, max(deadline - time.monotonic(), 1.0)),
-                ):
-                    if not isinstance(ev, dict) or "type" not in ev:
-                        # server without watch support returned a plain list
-                        raise OSError("watch unsupported")
-                    if ev["type"] == "DELETED":
-                        raise KeyError(f"job {namespace}/{name} deleted")
-                    if terminal(ev["object"]):
-                        return ev["object"]
-            except (ApiError, OSError, json.JSONDecodeError):
-                # watch unsupported or stream dropped: one polling pass
-                job = self.get("jobs", name, namespace)
-                if terminal(job):
-                    return job
-                time.sleep(poll_s)
-        raise TimeoutError(f"job {namespace}/{name} not finished in {timeout_s}s")
+        return self._wait_terminal(
+            "jobs", name, namespace, timeout_s, poll_s, terminal
+        )
 
     # ------------------------------------------------------------- pipelines
 
@@ -164,14 +146,45 @@ class RemoteClient:
         self, name: str, namespace: str = "default",
         timeout_s: float = 600.0, poll_s: float = 0.5,
     ) -> dict:
+        return self._wait_terminal(
+            "pipelineruns", name, namespace, timeout_s, poll_s,
+            lambda o: o.get("status", {}).get("state") in ("Succeeded", "Failed"),
+        )
+
+    def _wait_terminal(self, kind: str, name: str, namespace: str,
+                       timeout_s: float, poll_s: float, terminal) -> dict:
+        """Watch until `terminal(obj)`; falls back to polling if the stream
+        drops or the server lacks watch support."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            run = self.get("pipelineruns", name, namespace)
-            if run.get("status", {}).get("state") in ("Succeeded", "Failed"):
-                return run
-            time.sleep(poll_s)
+            try:
+                for ev in self.watch(
+                    kind, namespace=namespace, name=name,
+                    timeout_s=min(30.0, max(deadline - time.monotonic(), 1.0)),
+                ):
+                    if not isinstance(ev, dict) or "type" not in ev:
+                        raise OSError("watch unsupported")
+                    if ev["type"] == "DELETED":
+                        raise KeyError(f"{kind} {namespace}/{name} deleted")
+                    if terminal(ev["object"]):
+                        return ev["object"]
+            except (ApiError, OSError, json.JSONDecodeError):
+                obj = self.get(kind, name, namespace)
+                if terminal(obj):
+                    return obj
+                time.sleep(poll_s)
         raise TimeoutError(
-            f"pipeline run {namespace}/{name} not finished in {timeout_s}s"
+            f"{kind} {namespace}/{name} not finished in {timeout_s}s"
+        )
+
+    def wait_for_experiment(
+        self, name: str, namespace: str = "default",
+        timeout_s: float = 600.0, poll_s: float = 0.5,
+    ) -> dict:
+        return self._wait_terminal(
+            "experiments", name, namespace, timeout_s, poll_s,
+            lambda o: o.get("status", {}).get("condition")
+            in ("Succeeded", "Failed"),
         )
 
     def healthz(self) -> bool:

@@ -255,3 +255,47 @@ def test_dashboard_ui(remote, tmp_path):
     assert "kubeflow_tpu platform" in page
     assert "default/uijob" in page
     assert "Succeeded" in page
+
+
+def test_wait_for_experiment_via_watch(remote, tmp_path):
+    """Experiment waits ride the watch stream like job waits."""
+    import textwrap
+
+    script = tmp_path / "wtrial.py"
+    script.write_text("import os\nprint(f'objective={float(os.environ[\"X\"])}' )\n")
+    manifest = {
+        "apiVersion": "kubeflow-tpu.org/v1beta1",
+        "kind": "Experiment",
+        "metadata": {"name": "watch-exp"},
+        "spec": {
+            "parameters": [{
+                "name": "x", "parameterType": "double",
+                "feasibleSpace": {"min": "0.0", "max": "1.0", "step": "0.5"},
+            }],
+            "objective": {"type": "maximize",
+                          "objectiveMetricName": "objective"},
+            "algorithm": {"algorithmName": "grid"},
+            "maxTrialCount": 3,
+            "parallelTrialCount": 3,
+            "trialTemplate": {
+                "trialParameters": [{"name": "x", "reference": "x"}],
+                "trialSpec": textwrap.dedent(f"""
+                    apiVersion: kubeflow-tpu.org/v1
+                    kind: JAXJob
+                    spec:
+                      replicaSpecs:
+                        worker:
+                          replicas: 1
+                          template:
+                            container:
+                              command: [{sys.executable}, {script}]
+                              env:
+                                X: "${{trialParameters.x}}"
+                """),
+            },
+        },
+    }
+    remote.apply(manifest)
+    exp = remote.wait_for_experiment("watch-exp", timeout_s=120)
+    assert exp["status"]["condition"] == "Succeeded"
+    assert exp["status"]["trialsSucceeded"] >= 3
