@@ -29,10 +29,23 @@ import time
 # First recorded numbers on the axon v5e chip (round 2); later rounds report
 # vs_baseline against these.
 BENCH_BASELINE = {
-    "resnet50_images_per_sec_per_chip": None,  # set from first successful run
-    "bert_base_steps_per_sec": None,
-    "mnist_mlp_images_per_sec_per_chip": None,
+    # First successful full-suite run on the axon v5e chip (2026-07-30 04:47,
+    # round 2, rc=0), recorded under the pre-fix timing protocol (host-born
+    # batch re-uploaded per step; final "sync" via block_until_ready, which
+    # returns early on axon). These are still valid wall-clock numbers for
+    # that protocol: the synchronous per-step arg upload serialized each
+    # dispatch on the host, so the early-return error is bounded by ONE
+    # step's un-drained device tail out of 20-60 timed steps (<= a few %),
+    # unlike the unbounded case of fully-chained device-arg dispatch.
+    # vs_baseline against them therefore reads as "speedup over the round-2
+    # initial protocol, including its upload tax" — tagged via
+    # baseline_protocol on every emitted line until a fixed-protocol baseline
+    # replaces these numbers.
+    "resnet50_images_per_sec_per_chip": 190.6,
+    "bert_base_steps_per_sec": 0.524,
+    "mnist_mlp_images_per_sec_per_chip": 11128.0,
 }
+BASELINE_PROTOCOL = "r2-initial-presync"
 
 MAX_ATTEMPTS = 4          # re-exec attempts on backend-init failure
 RETRY_BASE_DELAY_S = 10.0
@@ -63,17 +76,23 @@ def _timed_steps(trainer, state, batch, steps: int):
 
     from kubeflow_tpu.parallel.sharding import shard_batch
 
-    # place the (constant synthetic) batch on device once: the bench measures
-    # device step throughput; input transfer overlaps via the trainer's
-    # prefetch pipeline in real training (train/data.py prefetch_to_device)
+    # Two axon-tunnel facts shape this loop (measured, see docs/perf.md):
+    #  1. HOST-BORN arrays (device_put/jnp.ones from host data) are re-uploaded
+    #     through the tunnel on EVERY dispatch that takes them as args; outputs
+    #     of on-device computations are not. So the batch is reborn as a jit
+    #     output once — after that, re-passing it each step costs nothing.
+    #  2. jax.block_until_ready returns before remote execution completes, so
+    #     the only true sync is a device->host read. The timing loop ends with
+    #     a scalar loss fetch (the chained/donated state serializes the steps).
     with jax.set_mesh(trainer.mesh):
         batch = shard_batch(batch, trainer.mesh)
+        batch = jax.jit(lambda t: jax.tree.map(lambda x: x + 0, t))(batch)
     state, m = trainer.train_step(state, batch)  # compile + warmup
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])  # true sync (block_until_ready lies through the tunnel)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, m = trainer.train_step(state, batch)
-    jax.block_until_ready(m["loss"])
+    float(m["loss"])  # sync: loss depends on the whole chained step sequence
     return time.perf_counter() - t0
 
 
@@ -274,6 +293,7 @@ def _emit(r: dict) -> None:
     if "vs_baseline" not in r:
         base = BENCH_BASELINE.get(r["metric"])
         r["vs_baseline"] = round(r["value"] / base, 3) if base else 1.0
+    r.setdefault("baseline_protocol", BASELINE_PROTOCOL)
     print(json.dumps(r))
     sys.stdout.flush()
     # survives re-exec: an emitted metric is never re-run (its line is
@@ -299,9 +319,10 @@ def main() -> None:
 
         jax.devices()
         # a tiny op proves the tunnel actually moves data, not just connects
+        # (host read, not block_until_ready — the latter returns early on axon)
         import jax.numpy as jnp
 
-        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+        float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
     except Exception as exc:  # noqa: BLE001
         _reexec_retry(exc)  # only returns when out of attempts
         _emit(_error_record("resnet50_images_per_sec_per_chip",

@@ -135,9 +135,15 @@ def gpipe(
             f"forces the partitioner into padded reshards at the ring "
             f"boundary)"
         )
+    # Microbatch layout is (mb, n_micro, ...): microbatch t is the STRIDED
+    # slice x[t::n_micro], so the batch-sharded dim 0 keeps its sharding
+    # through the reshape (a (n_micro, mb, ...) split would move the sharded
+    # dim and force the partitioner into a full-remat reshard). Per-example
+    # numerics are unchanged; only which examples share a microbatch differs,
+    # which matters to no per-example stage (layernorm etc.).
     x_mb = _pin(
-        jax.tree.map(lambda a: a.reshape(n_micro, mb, *a.shape[1:]), x),
-        batch_dim=1,
+        jax.tree.map(lambda a: a.reshape(mb, n_micro, *a.shape[1:]), x),
+        batch_dim=0,
     )
 
     def per_stage(params_local, x_mb):
@@ -151,13 +157,15 @@ def gpipe(
         def tick(carry, t):
             circ, outbuf = carry
             # stage 0 ingests microbatch t (zeros after the last one, whose
-            # outputs are discarded); other stages consume what rotated in
+            # outputs are discarded); other stages consume what rotated in.
+            # Microbatch t lives at index t of dim 1 (strided layout — the
+            # batch-sharded dim 0 never moves).
             feed_idx = jnp.clip(t, 0, n_micro - 1)
             inp = _pin(
                 jax.tree.map(
                     lambda buf, c: jnp.where(
                         stage == 0,
-                        jnp.take(buf, feed_idx, axis=0)
+                        jnp.take(buf, feed_idx, axis=1)
                         * (t < n_micro).astype(buf.dtype),
                         c,
                     ),
@@ -176,7 +184,7 @@ def gpipe(
                 is_emit,
                 lambda ob: jax.tree.map(
                     lambda o, b: jax.lax.dynamic_update_index_in_dim(
-                        b, o, jnp.maximum(emit_idx, 0), 0
+                        b, o, jnp.maximum(emit_idx, 0), 1
                     ),
                     out, ob,
                 ),
@@ -189,10 +197,10 @@ def gpipe(
                 ),
                 batch_dim=0,
             )
-            return (circ, _pin(outbuf, batch_dim=1)), None
+            return (circ, _pin(outbuf, batch_dim=0)), None
 
         init = (
-            jax.tree.map(lambda a: jnp.zeros_like(a[0]), x_mb),
+            jax.tree.map(lambda a: jnp.zeros_like(a[:, 0]), x_mb),
             jax.tree.map(lambda a: jnp.zeros_like(a), x_mb),
         )
         (circ, outbuf), _ = jax.lax.scan(tick, init, jnp.arange(ticks))

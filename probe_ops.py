@@ -1,0 +1,110 @@
+"""One-shot op characterization of the axon TPU backend (diagnostic, not shipped).
+
+Times individual HLO classes with true host-read sync, printing incrementally.
+Establishes which ops are pathological through the remote tunnel and whether
+device-born vs host-born arrays differ on re-dispatch.
+"""
+import time, sys
+import jax, jax.numpy as jnp
+
+
+# Sync protocol (docs/perf.md item 1): block_until_ready lies through the
+# tunnel, and a host read of only the LAST of N independent dispatches need
+# not wait for the other N-1. So each iteration's output is folded into a
+# scalar token and the loop ends with one host read of the token — data-
+# dependent on every iteration. (A single device executes its queue serially,
+# so total wall time is the sum of the executions.)
+_fold = jax.jit(lambda tok, x: tok + x.ravel()[0].astype(jnp.float32) * 0.0)
+
+
+def t(label, f, *args, iters=5):
+    try:
+        r = f(*args)
+        tok = jnp.zeros(())
+        tok = _fold(tok, jax.tree.leaves(r)[0])  # compile _fold for this shape
+        _ = float(tok)  # warmup + sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = f(*args)
+            tok = _fold(tok, jax.tree.leaves(r)[0])
+        _ = float(tok)  # true sync: depends on all iters' outputs
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        print(f"{label:40s} {ms:9.2f} ms", flush=True)
+        return ms
+    except Exception as e:  # noqa: BLE001
+        print(f"{label:40s} FAILED {type(e).__name__}: {e}", flush=True)
+
+
+print("devices:", jax.devices(), flush=True)
+
+# --- host-born vs device-born re-pass
+N = 1 << 22  # 4M f32 = 16MB
+host_x = jnp.ones((N,), jnp.float32)
+dev_x = jax.jit(lambda: jnp.ones((N,), jnp.float32))()
+add1 = jax.jit(lambda x: x + 1.0)
+t("repass host-born 16MB", add1, host_x)
+t("repass device-born 16MB", add1, dev_x)
+
+# --- matmul classes (bf16)
+mk = lambda *s: jax.jit(lambda: jnp.full(s, 0.01, jnp.bfloat16))()
+a = mk(1024, 1024); b = mk(1024, 1024)
+t("matmul 1024^3 bf16", jax.jit(lambda a, b: a @ b), a, b)
+tall = mk(100352, 128); w128 = mk(128, 128)
+t("matmul tall-skinny (100352,128)@(128,128)", jax.jit(lambda a, b: a @ b), tall, w128)
+
+# --- gather / scatter / one-hot (embedding patterns)
+table = mk(30522, 768)
+idx = jax.jit(lambda: jnp.arange(2048, dtype=jnp.int32) % 30522)()
+t("gather rows table[idx] (2048 of 30522x768)", jax.jit(lambda T, i: T[i]), table, idx)
+onehot = jax.jit(lambda i: jax.nn.one_hot(i, 30522, dtype=jnp.bfloat16))
+t("one-hot(2048,30522) build", onehot, idx)
+t("one-hot @ table", jax.jit(lambda i, T: jax.nn.one_hot(i, 30522, dtype=jnp.bfloat16) @ T), idx, table)
+dy = mk(2048, 768)
+t("scatter-add grad-of-gather", jax.jit(
+    lambda T, i, dy: jnp.zeros_like(T).at[i].add(dy)), table, idx, dy)
+
+# --- elementwise / norm / softmax / transpose / reduce
+x = mk(16, 128, 768)
+t("layernorm (16,128,768)", jax.jit(
+    lambda x: (x - x.mean(-1, keepdims=True)) / jnp.sqrt(x.var(-1, keepdims=True) + 1e-6)), x)
+t("gelu", jax.jit(jax.nn.gelu), x)
+s = mk(16, 12, 128, 128)
+t("softmax (16,12,128,128)", jax.jit(lambda s: jax.nn.softmax(s.astype(jnp.float32), -1)), s)
+big = mk(4096, 4096)
+t("transpose 4096^2", jax.jit(lambda x: x.T.copy()), big)
+t("reduce sum 4096^2", jax.jit(lambda x: x.sum()), big)
+
+# --- convs
+img = mk(128, 56, 56, 64)
+k3 = mk(3, 3, 64, 64)
+t("conv 3x3 56x56x64 bs128", jax.jit(
+    lambda x, w: jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))), img, k3)
+img8 = mk(8, 56, 56, 64)
+t("conv 3x3 56x56x64 bs8", jax.jit(
+    lambda x, w: jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))), img8, k3)
+
+# --- optimizer-shaped pytree update (many buffers)
+tree = [jax.jit(lambda i=i: jnp.full((512, 512), float(i)))() for i in range(40)]
+t("pytree update 40x(512,512)", jax.jit(lambda t: [x * 0.999 + 0.001 for x in t]), tree)
+
+# --- full BERT-ish transformer layer fwd+bwd (no embed)
+def layer(p, x):
+    q = x @ p["q"]; k = x @ p["k"]; v = x @ p["v"]
+    B, L, H = x.shape
+    q = q.reshape(B, L, 12, 64); k = k.reshape(B, L, 12, 64); v = v.reshape(B, L, 12, 64)
+    sc = jnp.einsum("blhd,bmhd->bhlm", q, k) / 8.0
+    pr = jax.nn.softmax(sc.astype(jnp.float32), -1).astype(x.dtype)
+    y = jnp.einsum("bhlm,bmhd->blhd", pr, v).reshape(B, L, H)
+    y = y @ p["o"]
+    h = jax.nn.gelu(y @ p["up"]) @ p["dn"]
+    return ((x + h) ** 2).mean()
+
+p = {k: mk(*s) for k, s in dict(
+    q=(768, 768), k=(768, 768), v=(768, 768), o=(768, 768),
+    up=(768, 3072), dn=(3072, 768)).items()}
+xin = mk(16, 128, 768)
+t("1 bert layer fwd+loss", jax.jit(layer), p, xin)
+t("1 bert layer grad", jax.jit(jax.grad(layer)), p, xin)
+print("probe done", flush=True)
