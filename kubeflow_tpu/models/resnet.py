@@ -91,13 +91,32 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: Any = jnp.float32
     small_inputs: bool = False
+    # Conv lowering: "xla" = lax conv HLO, "im2col" = slices+matmul
+    # (models/conv.py — param-compatible), "auto" = im2col on the axon
+    # backend where conv HLOs run ~200x below matmul throughput
+    # (docs/perf.md), xla elsewhere.
+    conv_impl: str = "auto"
+
+    def _conv_cls(self) -> ModuleDef:
+        impl = self.conv_impl
+        if impl == "auto":
+            import jax
+
+            impl = "im2col" if jax.default_backend() == "axon" else "xla"
+        if impl == "im2col":
+            from kubeflow_tpu.models.conv import ConvCompat
+
+            return ConvCompat  # Im2ColConv under the flax name "Conv"
+        if impl == "xla":
+            return nn.Conv
+        raise ValueError(f"unknown conv_impl {self.conv_impl!r}")
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if x.ndim == 2:  # flat grayscale vectors (mnist-style fixtures)
             side = int(x.shape[-1] ** 0.5)
             x = x.reshape((x.shape[0], side, side, 1))
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        conv = partial(self._conv_cls(), use_bias=False, dtype=self.dtype)
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,

@@ -85,6 +85,22 @@ t("conv 3x3 56x56x64 bs8", jax.jit(
     lambda x, w: jax.lax.conv_general_dilated(
         x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))), img8, k3)
 
+from kubeflow_tpu.models.conv import im2col_conv  # noqa: E402
+
+t("im2col 3x3 56x56x64 bs128", jax.jit(lambda x, w: im2col_conv(x, w)), img, k3)
+t("im2col bwd 3x3 56x56x64 bs128", jax.jit(jax.grad(
+    lambda w, x: (im2col_conv(x, w) ** 2).mean())), k3, img)
+t("conv bwd 3x3 56x56x64 bs128", jax.jit(jax.grad(
+    lambda w, x: (jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")) ** 2).mean())), k3, img)
+t("maxpool 3x3s2 112x112x64 bs128", jax.jit(
+    lambda x: jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")),
+  mk(128, 112, 112, 64))
+t("batchnorm-reduce (128,56,56,64)", jax.jit(
+    lambda x: (x - x.mean((0, 1, 2))) / jnp.sqrt(x.var((0, 1, 2)) + 1e-5)), img)
+
 # --- optimizer-shaped pytree update (many buffers)
 tree = [jax.jit(lambda i=i: jnp.full((512, 512), float(i)))() for i in range(40)]
 t("pytree update 40x(512,512)", jax.jit(lambda t: [x * 0.999 + 0.001 for x in t]), tree)

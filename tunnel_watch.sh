@@ -1,0 +1,30 @@
+#!/bin/bash
+# Watch for the axon TPU tunnel to come alive; when it does, immediately run
+# the op probe and the fixed-protocol bench suite. One-shot: exits after a
+# successful capture (or after MAX_HOURS).
+cd /root/repo
+MAX_HOURS=${MAX_HOURS:-11}
+deadline=$(( $(date +%s) + MAX_HOURS*3600 ))
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
+" >/dev/null 2>&1; then
+    echo "=== tunnel alive at $(date -u +%H:%M:%S) ===" >> tunnel_watch.log
+    timeout 1200 python -u probe_ops.py > probe_results.txt 2>&1
+    probe_rc=$?
+    echo "probe rc=$probe_rc" >> tunnel_watch.log
+    timeout 2400 python bench.py --suite > bench_r2_fixed.jsonl 2>>tunnel_watch.log
+    bench_rc=$?
+    echo "bench rc=$bench_rc" >> tunnel_watch.log
+    if [ "$probe_rc" -eq 0 ] && [ "$bench_rc" -eq 0 ]; then
+      echo "=== capture done at $(date -u +%H:%M:%S) ===" >> tunnel_watch.log
+      exit 0
+    fi
+    # window died mid-capture: keep watching for the next one
+    echo "=== capture incomplete, resuming watch ===" >> tunnel_watch.log
+  fi
+  sleep 180
+done
+echo "tunnel_watch: deadline reached without a live window" >> tunnel_watch.log
+exit 1
