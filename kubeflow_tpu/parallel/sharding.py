@@ -80,9 +80,17 @@ def put_global(x: Any, sharding: NamedSharding) -> Any:
     cannot target non-addressable devices).
     """
     if isinstance(x, jax.Array) and not isinstance(x, np.ndarray):
-        # already placed (e.g. by prefetch_to_device) — pass through; a
-        # multi-process global array cannot be np.asarray'd
-        if x.sharding.is_equivalent_to(sharding, x.ndim):
+        # Already placed (e.g. by prefetch_to_device) — pass through; a
+        # multi-process global array cannot be np.asarray'd. The pass-through
+        # requires an actual NamedSharding, not mere placement equivalence: a
+        # SingleDeviceSharding is "equivalent" to a replicated NamedSharding
+        # on a 1-device mesh, but jit treats them as different input
+        # specializations, so passing it through makes every Trainer pay a
+        # second (on TPU: remote, ~tens of seconds) train-step compile when
+        # the first step's NamedSharding outputs feed back in.
+        if isinstance(x.sharding, NamedSharding) and x.sharding.is_equivalent_to(
+            sharding, x.ndim
+        ):
             return x
         if jax.process_count() == 1:
             return jax.device_put(x, sharding)

@@ -31,7 +31,7 @@ from flax import struct
 from jax.sharding import Mesh
 
 from kubeflow_tpu.parallel import build_mesh, MeshConfig
-from kubeflow_tpu.parallel.sharding import shard_batch, shard_state
+from kubeflow_tpu.parallel.sharding import shard_batch, state_shardings
 from kubeflow_tpu.train import metrics as metrics_lib
 from kubeflow_tpu.train.checkpoint import Checkpointer
 from kubeflow_tpu.train.data import Dataset, batches, prefetch_to_device
@@ -185,17 +185,38 @@ class Trainer:
         p_rng, s_rng = jax.random.split(rng)
         x = self._cast(jnp.asarray(sample_x))
         kwargs = {"train": False} if self._accepts_train else {}
-        variables = dict(self.model.init(p_rng, x, **kwargs))
-        params = variables.pop("params")
-        variables.pop("losses", None)  # output collection, not state
-        state = TrainState(
-            step=jnp.zeros((), jnp.int32),
-            params=params,
-            opt_state=self.tx.init(params),
-            rng=s_rng,
-            extra=variables,
-        )
-        return shard_state(state, self.mesh, self.partition_rules)
+
+        def build(x):
+            variables = dict(self.model.init(p_rng, x, **kwargs))
+            params = variables.pop("params")
+            variables.pop("losses", None)  # output collection, not state
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=self.tx.init(params),
+                rng=s_rng,
+                extra=variables,
+            )
+
+        # Build INSIDE jit with the shardings constrained in-graph: params
+        # materialize directly sharded (never replicated on one device first
+        # — required for models bigger than a single chip's HBM), and the
+        # outputs carry the same concrete compiled layouts the train step
+        # emits, so the step's jit cache sees ONE input specialization. A
+        # host-side build + device_put leaves layout=None, and the second
+        # train_step call then pays a full re-specialization — on TPU a
+        # second multi-second remote compile inside what should be
+        # steady-state stepping. (with_sharding_constraint rather than jit
+        # out_shardings: the latter's outputs also keep layout=None and the
+        # re-specialization returns.)
+        with jax.set_mesh(self.mesh):
+            abstract = jax.eval_shape(build, x)
+            shardings = state_shardings(abstract, self.mesh, self.partition_rules)
+            return jax.jit(
+                lambda x: jax.tree.map(
+                    jax.lax.with_sharding_constraint, build(x), shardings
+                )
+            )(x)
 
     # ------------------------------------------------------------------ steps
 

@@ -1,0 +1,126 @@
+"""BERT step breakdown on the axon backend (diagnostic, not shipped).
+
+Round-2 mystery: BERT-base bs=16 L=128 measured 0.52 steps/s (~2 s/step) on
+the v5e while its ~1.4 TFLOP/step should take ~15 ms at the measured matmul
+throughput. This probe bisects the step: embedding, encoder depth sweep,
+head, loss/grad, optimizer — all with the token-chained true-sync protocol
+from probe_ops.py (block_until_ready lies through the tunnel).
+"""
+import os
+import time
+
+import jax
+
+if os.environ.get("KFT_PROBE_PLATFORM"):
+    # the axon sitecustomize force-registers the TPU plugin; a config update
+    # (which wins over env) is required to actually get CPU
+    jax.config.update("jax_platforms", os.environ["KFT_PROBE_PLATFORM"])
+import jax.numpy as jnp
+
+_fold = jax.jit(lambda tok, x: tok + x.ravel()[0].astype(jnp.float32) * 0.0)
+
+
+def t(label, f, *args, iters=3):
+    try:
+        r = f(*args)
+        tok = jnp.zeros(())
+        tok = _fold(tok, jax.tree.leaves(r)[0])
+        _ = float(tok)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = f(*args)
+            tok = _fold(tok, jax.tree.leaves(r)[0])
+        _ = float(tok)
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        print(f"{label:44s} {ms:9.2f} ms", flush=True)
+        return ms
+    except Exception as e:  # noqa: BLE001
+        print(f"{label:44s} FAILED {type(e).__name__}: {e}", flush=True)
+
+
+def devborn(x):
+    """Rebirth a (pytree of) host-born array(s) as jit outputs so the tunnel
+    stops re-uploading them on every dispatch (docs/perf.md item 2)."""
+    return jax.jit(lambda t_: jax.tree.map(lambda a: a + jnp.zeros((), a.dtype), t_))(x)
+
+
+print("devices:", jax.devices(), flush=True)
+
+from kubeflow_tpu.models import BertConfig, BertForSequenceClassification  # noqa: E402
+from kubeflow_tpu.models.bert import (  # noqa: E402
+    BertEmbeddings, BertLayer, VocabEmbed,
+)
+
+# KFT_PROBE_TINY=1: tiny config for CPU smoke tests of this script itself
+if os.environ.get("KFT_PROBE_TINY"):
+    cfg = BertConfig.tiny(dtype=jnp.bfloat16, dropout_rate=0.0)
+    bs, L = 4, 16
+else:
+    cfg = BertConfig.base(dtype=jnp.bfloat16, dropout_rate=0.0)
+    bs, L = 16, 128
+rng = jax.random.PRNGKey(0)
+ids = devborn(jnp.ones((bs, L), jnp.int32))
+
+# --- raw vocab lookup (gather vs one-hot paths)
+emb = VocabEmbed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype)
+pe = devborn(emb.init(rng, ids))
+t("vocab-embed fwd", jax.jit(lambda p, i: emb.apply(p, i)), pe, ids)
+t("vocab-embed fwd+bwd", jax.jit(jax.grad(
+    lambda p, i: emb.apply(p, i).astype(jnp.float32).sum())), pe, ids)
+
+# --- full embeddings block (token+pos+type+LN)
+embs = BertEmbeddings(cfg)
+pem = devborn(embs.init(rng, ids))
+t("bert-embeddings fwd", jax.jit(lambda p, i: embs.apply(p, i)), pem, ids)
+t("bert-embeddings fwd+bwd", jax.jit(jax.grad(
+    lambda p, i: embs.apply(p, i).astype(jnp.float32).sum())), pem, ids)
+
+# --- one transformer layer given hidden states
+x = devborn(jnp.full((bs, L, cfg.hidden_size), 0.01, cfg.dtype))
+mask = devborn(jnp.ones((bs, L), bool))
+layer = BertLayer(cfg)
+pl = devborn(layer.init(rng, x, mask, False))
+t("1 bert layer fwd", jax.jit(
+    lambda p, x, m: layer.apply(p, x, m, False)), pl, x, mask)
+t("1 bert layer fwd+bwd", jax.jit(jax.grad(
+    lambda p, x, m: layer.apply(
+        p, x, m, False).astype(jnp.float32).sum())), pl, x, mask)
+
+# --- full model fwd / value_and_grad / full train step
+model = BertForSequenceClassification(cfg, num_classes=2)
+pm = devborn(model.init(rng, ids))
+t("full bert fwd", jax.jit(
+    lambda p, i: model.apply(p, i)), pm, ids)
+
+y = devborn(jnp.zeros((bs,), jnp.int32))
+
+
+def loss_fn(p, i, y):
+    logits = model.apply(p, i).astype(jnp.float32)
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+t("full bert loss grad", jax.jit(jax.grad(loss_fn)), pm, ids, y)
+
+from kubeflow_tpu.train import Trainer, TrainerConfig  # noqa: E402
+from kubeflow_tpu.parallel.sharding import shard_batch  # noqa: E402
+
+trainer = Trainer(BertForSequenceClassification(cfg, num_classes=2),
+                  TrainerConfig(batch_size=bs, compute_dtype=jnp.bfloat16,
+                                log_every_steps=10**9))
+state = trainer.init_state(jnp.ones((bs, L), jnp.int32))
+with jax.set_mesh(trainer.mesh):
+    batch = shard_batch((jnp.ones((bs, L), jnp.int32),
+                         jnp.zeros((bs,), jnp.int32)), trainer.mesh)
+    batch = jax.jit(lambda t_: jax.tree.map(lambda a: a + 0, t_))(batch)
+state, m = trainer.train_step(state, batch)
+float(m["loss"])
+t0 = time.perf_counter()
+for _ in range(5):
+    state, m = trainer.train_step(state, batch)
+float(m["loss"])
+print(f"{'full train_step (device-born batch)':44s} "
+      f"{(time.perf_counter() - t0) / 5 * 1e3:9.2f} ms", flush=True)
+print("probe_bert done", flush=True)

@@ -4,8 +4,15 @@ Times individual HLO classes with true host-read sync, printing incrementally.
 Establishes which ops are pathological through the remote tunnel and whether
 device-born vs host-born arrays differ on re-dispatch.
 """
+import os
 import time, sys
-import jax, jax.numpy as jnp
+import jax
+
+if os.environ.get("KFT_PROBE_PLATFORM"):
+    # the axon sitecustomize force-registers the TPU plugin; a config update
+    # (which wins over env) is required to actually get CPU
+    jax.config.update("jax_platforms", os.environ["KFT_PROBE_PLATFORM"])
+import jax.numpy as jnp
 
 
 # Sync protocol (docs/perf.md item 1): block_until_ready lies through the

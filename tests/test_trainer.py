@@ -64,6 +64,35 @@ def test_checkpoint_resume(tmp_path, digits):
     assert int(s3.step) == 15
 
 
+def test_train_step_compiles_exactly_once(digits):
+    """init_state must hand the step arrays with the same shardings AND
+    concrete layouts the step itself emits: a second jit specialization on
+    call 1 means a second (on TPU: remote, multi-second) compile inside
+    steady-state stepping — the round-2 bench poisoner."""
+    import jax
+
+    from kubeflow_tpu.models import MnistMLP
+    from kubeflow_tpu.parallel.sharding import shard_batch
+
+    t = Trainer(
+        MnistMLP(hidden=(16,)),
+        TrainerConfig(batch_size=8, log_every_steps=10**9),
+    )
+    state = t.init_state(digits.x_train[:8])
+    with jax.set_mesh(t.mesh):
+        batch = shard_batch(
+            (digits.x_train[:8], digits.y_train[:8]), t.mesh
+        )
+    for _ in range(3):
+        state, m = t.train_step(state, batch)
+    float(m["loss"])
+    if not hasattr(t._jit_train_step, "_cache_size"):
+        import pytest
+
+        pytest.skip("jax private _cache_size gone; re-pin via jax.monitoring")
+    assert t._jit_train_step._cache_size() == 1
+
+
 def test_metrics_emit_parse_roundtrip(capsys):
     emit(step=7, loss=0.125, accuracy=0.5)
     line = capsys.readouterr().out.strip()

@@ -1,30 +1,47 @@
 #!/bin/bash
-# Watch for the axon TPU tunnel to come alive; when it does, immediately run
-# the op probe and the fixed-protocol bench suite. One-shot: exits after a
-# successful capture (or after MAX_HOURS).
+# Watch for the axon TPU tunnel to come alive; when it does, capture whatever
+# stages are still missing (op probe, fixed-protocol bench, BERT breakdown).
+# Stages are independently retried across tunnel windows; exits 0 when all
+# three artifacts exist (even if the last capture finishes past the
+# deadline), exits 1 once the deadline passes with stages still missing.
 cd /root/repo
 MAX_HOURS=${MAX_HOURS:-11}
 deadline=$(( $(date +%s) + MAX_HOURS*3600 ))
-while [ "$(date +%s)" -lt "$deadline" ]; do
+
+stage() {  # stage <artifact> <timeout_s> <cmd...>
+  local artifact="$1" tmo="$2"; shift 2
+  [ -f "$artifact.done" ] && return 0
+  # stderr goes to a sidecar file, NOT the artifact: bench.py emits JSONL on
+  # stdout and retry/plugin noise on stderr, and mixing them corrupts the
+  # per-line-JSON artifact consumers parse
+  timeout "$tmo" "$@" > "$artifact" 2> "$artifact.stderr"
+  local rc=$?
+  echo "stage $artifact rc=$rc at $(date -u +%H:%M:%S)" >> tunnel_watch.log
+  if [ "$rc" -eq 0 ]; then touch "$artifact.done"; return 0; fi
+  return 1
+}
+
+while :; do
+  if [ -f probe_results.txt.done ] && [ -f bench_r2_fixed.jsonl.done ] \
+     && [ -f probe_bert.txt.done ]; then
+    echo "all stages captured at $(date -u +%H:%M:%S)" >> tunnel_watch.log
+    exit 0
+  fi
+  if [ "$(date +%s)" -ge "$deadline" ]; then
+    echo "tunnel_watch: deadline reached" >> tunnel_watch.log
+    exit 1
+  fi
   if timeout 90 python -c "
 import jax, jax.numpy as jnp
 float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
 " >/dev/null 2>&1; then
     echo "=== tunnel alive at $(date -u +%H:%M:%S) ===" >> tunnel_watch.log
-    timeout 1200 python -u probe_ops.py > probe_results.txt 2>&1
-    probe_rc=$?
-    echo "probe rc=$probe_rc" >> tunnel_watch.log
-    timeout 2400 python bench.py --suite > bench_r2_fixed.jsonl 2>>tunnel_watch.log
-    bench_rc=$?
-    echo "bench rc=$bench_rc" >> tunnel_watch.log
-    if [ "$probe_rc" -eq 0 ] && [ "$bench_rc" -eq 0 ]; then
-      echo "=== capture done at $(date -u +%H:%M:%S) ===" >> tunnel_watch.log
-      exit 0
-    fi
-    # window died mid-capture: keep watching for the next one
-    echo "=== capture incomplete, resuming watch ===" >> tunnel_watch.log
+    # on any stage failure, back off before re-probing: a fast-failing stage
+    # must not hot-loop against an alive tunnel
+    { stage probe_results.txt 1200 python -u probe_ops.py \
+        && stage bench_r2_fixed.jsonl 2400 python bench.py --suite \
+        && stage probe_bert.txt 1500 python -u probe_bert.py; } || sleep 180
+  else
+    sleep 180
   fi
-  sleep 180
 done
-echo "tunnel_watch: deadline reached without a live window" >> tunnel_watch.log
-exit 1
