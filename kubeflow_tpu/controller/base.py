@@ -20,7 +20,7 @@ import threading
 from typing import Iterable
 
 from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
-from kubeflow_tpu.native import WorkQueue
+from kubeflow_tpu.native import ReconcileDriver, WorkQueue
 
 
 class ControllerBase:
@@ -70,10 +70,11 @@ class ControllerBase:
         threading.Thread(
             target=self._watch_loop, name=f"{self.name}-informer", daemon=True
         ).start()
-        for i in range(self._n_workers):
-            threading.Thread(
-                target=self._worker_loop, name=f"{self.name}-worker-{i}", daemon=True
-            ).start()
+        # workers are NATIVE: reconciler.cc owns the thread pool and the
+        # forget/requeue/rate-limit/done discipline (SURVEY.md §2.8 item 2 —
+        # the reference's worker goroutines are native too); only
+        # self.reconcile(key) runs in Python, via the callback below
+        self._driver = ReconcileDriver(self.wq, self._n_workers, self._reconcile_cb)
         threading.Thread(
             target=self._resync_loop, name=f"{self.name}-resync", daemon=True
         ).start()
@@ -81,6 +82,11 @@ class ControllerBase:
     def stop(self) -> None:
         self._stop.set()
         self.wq.shutdown()
+        if getattr(self, "_driver", None) is not None:
+            # close (join + free), not just stop: the driver's callback keeps
+            # this controller strongly reachable until freed
+            self._driver.close()
+            self._driver = None
 
     # ----------------------------------------------------------- internals
 
@@ -101,27 +107,26 @@ class ControllerBase:
             for key in self.resync_keys():
                 self.wq.add(key)
 
-    def _worker_loop(self) -> None:
-        while True:
-            key = self.wq.get(timeout_s=0.5)
-            if key is None:
-                if self.wq.shutting_down:
-                    return
-                continue
+    def _reconcile_cb(self, key_b: bytes, after_ptr) -> int:
+        """The Python half of the native worker loop (reconciler.cc):
+        business logic + metrics/events only — queue discipline is C++'s.
+        Must never raise: ctypes would swallow the exception and report
+        rc=0 (success), silently forgetting a failing key."""
+        key = key_b.decode()
+        try:
+            self.metrics["reconcile_total"] += 1
+            requeue_after = self.reconcile(key)
+            after_ptr[0] = -1.0 if requeue_after is None else float(requeue_after)
+            return 0
+        except ConflictError:
+            return 1
+        except Exception as exc:  # noqa: BLE001 — reconcile must not die
+            self.metrics["reconcile_errors_total"] += 1
             try:
-                self.metrics["reconcile_total"] += 1
-                requeue_after = self.reconcile(key)
-                self.wq.forget(key)
-                if requeue_after is not None:
-                    self.wq.add_after(key, requeue_after)
-            except ConflictError:
-                self.wq.add_rate_limited(key)
-            except Exception as exc:  # noqa: BLE001 — reconcile must not die
-                self.metrics["reconcile_errors_total"] += 1
                 self.cluster.record_event(
                     self.ERROR_EVENT_KIND, key, "ReconcileError", str(exc),
                     type="Warning",
                 )
-                self.wq.add_rate_limited(key)
-            finally:
-                self.wq.done(key)
+            except Exception:  # noqa: BLE001
+                pass
+            return 2

@@ -12,6 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import weakref
 from pathlib import Path
 
 _DIR = Path(__file__).parent
@@ -64,6 +65,14 @@ def lib() -> ctypes.CDLL:
         L.kf_wq_shutting_down.restype = ctypes.c_int
         L.kf_wq_shutting_down.argtypes = [ctypes.c_void_p]
         L.kf_free.argtypes = [ctypes.c_void_p]
+        # reconcile driver
+        L.kf_rd_new.restype = ctypes.c_void_p
+        L.kf_rd_new.argtypes = [ctypes.c_void_p, ctypes.c_int, RECONCILE_CB]
+        L.kf_rd_stop.argtypes = [ctypes.c_void_p]
+        L.kf_rd_free.argtypes = [ctypes.c_void_p]
+        for fn in ("kf_rd_total", "kf_rd_errors", "kf_rd_conflicts"):
+            getattr(L, fn).restype = ctypes.c_long
+            getattr(L, fn).argtypes = [ctypes.c_void_p]
         # expectations
         L.kf_exp_new.restype = ctypes.c_void_p
         L.kf_exp_new.argtypes = [ctypes.c_double]
@@ -118,6 +127,69 @@ def lib() -> ctypes.CDLL:
         L.kf_ms_events.argtypes = [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong]
         _lib = L
     return _lib
+
+
+# int cb(const char* key, double* requeue_after_s) — see reconciler.cc for
+# the 0/1/2 (ok/conflict/error) contract. ctypes acquires the GIL when the
+# C++ worker threads invoke it.
+RECONCILE_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_double)
+)
+
+
+def _finalize_driver(L: ctypes.CDLL, h: int, cb) -> None:
+    """Join + free a native driver. Runs via weakref.finalize — at GC of the
+    wrapper OR at interpreter exit, whichever comes first — because a C++
+    worker invoking the ctypes trampoline after the CFUNCTYPE object (or the
+    interpreter) is gone is undefined behavior. `cb` is carried solely to
+    keep the trampoline alive until the workers are joined. ctypes releases
+    the GIL during kf_rd_stop, so in-flight callbacks can finish."""
+    del cb  # alive until here — that's its whole job
+    try:
+        L.kf_rd_stop(h)
+        L.kf_rd_free(h)
+    except Exception:  # noqa: BLE001 — teardown must not raise
+        pass
+
+
+class ReconcileDriver:
+    """Native worker pool draining a WorkQueue through a Python reconcile
+    callback (reconciler.cc). C++ owns the threads and the full requeue
+    discipline; the callback is the only Python on the hot path."""
+
+    def __init__(self, wq: "WorkQueue", n_workers: int, callback):
+        self._L = lib()
+        # the CFUNCTYPE object must outlive the driver or C++ calls a
+        # collected trampoline; _finalize_driver holds it until join
+        self._cb = callback if isinstance(callback, RECONCILE_CB) else RECONCILE_CB(callback)
+        self._h = self._L.kf_rd_new(wq._h, n_workers, self._cb)
+        self._fin = weakref.finalize(
+            self, _finalize_driver, self._L, self._h, self._cb
+        )
+
+    def stop(self) -> None:
+        """Joins the workers (idempotent; the handle stays valid for metric
+        reads). Shut the queue down first for a prompt join."""
+        if self._h:
+            self._L.kf_rd_stop(self._h)
+
+    @property
+    def total(self) -> int:
+        return self._L.kf_rd_total(self._h) if self._h else 0
+
+    @property
+    def errors(self) -> int:
+        return self._L.kf_rd_errors(self._h) if self._h else 0
+
+    @property
+    def conflicts(self) -> int:
+        return self._L.kf_rd_conflicts(self._h) if self._h else 0
+
+    def close(self) -> None:
+        """Join + free now (equivalent to GC/exit finalization)."""
+        if self._h:
+            self._fin()
+            self._h = None
 
 
 def _take_string(ptr: int | None) -> str | None:

@@ -1,0 +1,123 @@
+// Native reconcile driver — the worker half of the controller runtime.
+//
+// The reference's reconcile machinery is native (Go controller-runtime:
+// worker goroutines draining a rate-limited queue — SURVEY.md §2.8 ledger
+// item 2). Here C++ owns the same responsibilities: the worker thread pool,
+// blocking dequeue, and the full requeue discipline (forget on success,
+// AddAfter for requested requeues, exponential AddRateLimited on
+// conflict/error, Done-with-dirty-replay). Only the business logic — one
+// level-triggered reconcile(key) pass — calls back into Python through a C
+// function pointer (ctypes acquires the GIL for foreign-thread callbacks).
+//
+// Callback contract:
+//   int cb(const char* key, double* requeue_after_s)
+//     return 0 = success  (requeue_after_s >= 0 → schedule a follow-up pass)
+//            1 = conflict (benign optimistic-concurrency loss: rate-limited
+//                          requeue, not counted as an error)
+//            2 = error    (rate-limited requeue, error counter bumped)
+//
+// Layered strictly on the workqueue's C ABI so the queue stays the single
+// source of truth for dedupe/dirty semantics.
+
+#include <atomic>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+// workqueue.cc C ABI
+char* kf_wq_get(void* q, double timeout_s);
+void kf_wq_done(void* q, const char* key);
+void kf_wq_forget(void* q, const char* key);
+void kf_wq_add_after(void* q, const char* key, double delay_s);
+double kf_wq_add_rate_limited(void* q, const char* key);
+int kf_wq_shutting_down(void* q);
+void kf_free(void* p);
+}
+
+namespace {
+
+using ReconcileCb = int (*)(const char* key, double* requeue_after_s);
+
+class ReconcileDriver {
+ public:
+  ReconcileDriver(void* wq, int n_workers, ReconcileCb cb)
+      : wq_(wq), cb_(cb) {
+    workers_.reserve(n_workers);
+    for (int i = 0; i < n_workers; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~ReconcileDriver() { Stop(); }
+
+  void Stop() {
+    stop_.store(true);
+    for (auto& t : workers_) {
+      if (t.joinable()) t.join();
+    }
+    workers_.clear();
+  }
+
+  long Total() const { return total_.load(); }
+  long Errors() const { return errors_.load(); }
+  long Conflicts() const { return conflicts_.load(); }
+
+ private:
+  void WorkerLoop() {
+    // stop_ is checked every iteration, not just on empty-queue timeouts:
+    // Stop() must join promptly even against a never-draining queue.
+    while (!stop_.load()) {
+      char* raw = kf_wq_get(wq_, 0.5);
+      if (raw == nullptr) {
+        if (stop_.load() || kf_wq_shutting_down(wq_)) return;
+        continue;
+      }
+      std::string key(raw);
+      kf_free(raw);
+      double after = -1.0;
+      int rc = cb_(key.c_str(), &after);
+      total_.fetch_add(1);
+      if (rc == 0) {
+        kf_wq_forget(wq_, key.c_str());
+        if (after >= 0.0) kf_wq_add_after(wq_, key.c_str(), after);
+      } else if (rc == 1) {
+        conflicts_.fetch_add(1);
+        kf_wq_add_rate_limited(wq_, key.c_str());
+      } else {
+        errors_.fetch_add(1);
+        kf_wq_add_rate_limited(wq_, key.c_str());
+      }
+      kf_wq_done(wq_, key.c_str());
+    }
+  }
+
+  void* wq_;
+  ReconcileCb cb_;
+  std::atomic<bool> stop_{false};
+  std::atomic<long> total_{0};
+  std::atomic<long> errors_{0};
+  std::atomic<long> conflicts_{0};
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kf_rd_new(void* wq, int n_workers, ReconcileCb cb) {
+  return new ReconcileDriver(wq, n_workers, cb);
+}
+// Stop joins the workers; the queue must already be shut down (or keys
+// drained) for a prompt join — workers wake every 0.5 s regardless.
+void kf_rd_stop(void* rd) { static_cast<ReconcileDriver*>(rd)->Stop(); }
+void kf_rd_free(void* rd) { delete static_cast<ReconcileDriver*>(rd); }
+long kf_rd_total(void* rd) { return static_cast<ReconcileDriver*>(rd)->Total(); }
+long kf_rd_errors(void* rd) {
+  return static_cast<ReconcileDriver*>(rd)->Errors();
+}
+long kf_rd_conflicts(void* rd) {
+  return static_cast<ReconcileDriver*>(rd)->Conflicts();
+}
+
+}  // extern "C"

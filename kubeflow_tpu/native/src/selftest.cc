@@ -40,6 +40,13 @@ long long kf_hub_publish(void*, int, const char*, const char*);
 int kf_hub_poll(void*, long long, double, long long*, int*, char**, char**);
 int kf_hub_backlog(void*, long long);
 
+void* kf_rd_new(void*, int, int (*)(const char*, double*));
+void kf_rd_stop(void*);
+void kf_rd_free(void*);
+long kf_rd_total(void*);
+long kf_rd_errors(void*);
+long kf_rd_conflicts(void*);
+
 void* kf_ms_open(const char*);
 void kf_ms_close(void*);
 long long kf_ms_put_artifact(void*, long long, const char*, const char*,
@@ -100,6 +107,51 @@ int main() {
   assert(kf_wq_num_requeues(q2, "x") == 0);
   kf_wq_shutdown(q2);
   kf_wq_free(q2);
+
+  // --- reconcile driver: native workers drain concurrent adds through a
+  // callback that succeeds, conflicts, or errors by key class; every error/
+  // conflict key is rate-limit-requeued and eventually succeeds (callback
+  // consults a shared attempt map).
+  {
+    static std::atomic<int> ok_calls{0};
+    static std::atomic<int> flaky_first{0};
+    void* q3 = kf_wq_new(0.001, 0.05);
+    void* rd = kf_rd_new(
+        q3, 3, [](const char* key, double* after) -> int {
+          if (strstr(key, "requeue")) {
+            static std::atomic<int> requeue_once{0};
+            *after = requeue_once.fetch_add(1) == 0 ? 0.001 : -1.0;
+            return 0;
+          }
+          if (strstr(key, "conflict")) {
+            // conflict exactly once, then succeed
+            return flaky_first.fetch_add(1) == 0 ? 1 : 0;
+          }
+          if (strstr(key, "error")) {
+            static std::atomic<int> err_once{0};
+            return err_once.fetch_add(1) == 0 ? 2 : 0;
+          }
+          ok_calls.fetch_add(1);
+          return 0;
+        });
+    for (int i = 0; i < 200; ++i) {
+      std::string key = "ok-" + std::to_string(i);
+      kf_wq_add(q3, key.c_str());
+    }
+    kf_wq_add(q3, "conflict-1");
+    kf_wq_add(q3, "error-1");
+    kf_wq_add(q3, "requeue-1");
+    // drain: all keys processed, retries included
+    while (kf_wq_len(q3) > 0) std::this_thread::yield();
+    std::this_thread::sleep_for(std::chrono::milliseconds(300));
+    kf_wq_shutdown(q3);
+    kf_rd_stop(rd);
+    assert(kf_rd_total(rd) >= 203);
+    assert(kf_rd_errors(rd) == 1);
+    assert(kf_rd_conflicts(rd) == 1);
+    kf_rd_free(rd);
+    kf_wq_free(q3);
+  }
 
   // --- expectations: concurrent observers race against Satisfied readers.
   void* e = kf_exp_new(300.0);
