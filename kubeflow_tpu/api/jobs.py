@@ -162,6 +162,60 @@ class MXJob(TrainJob):
     kind: JobKind = JobKind.MXNET
 
 
+# stamped by apply_elastic_scale on every scale; read by the capacity
+# autoscaler as its stabilization-window anchor
+LAST_SCALE_ANNOTATION = "kubeflow-tpu.org/autoscale-last-scale"
+
+
+def apply_elastic_scale(job: TrainJob, replicas: int) -> None:
+    """Mutate `job` in place to `replicas` workers (elastic scale).
+
+    TPU elasticity is slice-granular (SURVEY.md §2.2): the new size must keep
+    whole slices, and the change lands as a whole-gang re-mesh (coordinator
+    restart + resume from checkpoint), never a live resize. Requires an
+    ElasticPolicy and min_replicas <= replicas <= max_replicas. Shared by
+    TrainingClient.scale_job and the capacity autoscaler (the reference's
+    pytorch HPA analogue) so both enforce identical invariants.
+    """
+    if job.status.is_finished:
+        raise ValueError(f"job {job.name} already finished; cannot scale")
+    ep = job.spec.run_policy.elastic_policy
+    if ep is None:
+        raise ValueError(f"job {job.name} has no elasticPolicy; cannot scale")
+    if not (ep.min_replicas <= replicas <= ep.max_replicas):
+        raise ValueError(
+            f"replicas {replicas} outside elastic range "
+            f"[{ep.min_replicas}, {ep.max_replicas}]"
+        )
+    workers = job.spec.replica_specs.get(REPLICA_WORKER)
+    if workers is None:
+        raise ValueError(f"job {job.name} has no worker replicas; cannot scale")
+    old_total = job.total_replicas()
+    if job.spec.num_slices > 1:
+        per_slice = workers.replicas // job.spec.num_slices
+        if replicas % per_slice:
+            raise ValueError(
+                f"replicas {replicas} not a multiple of per-slice worker "
+                f"count {per_slice} (scale by whole slices)"
+            )
+        job.spec.num_slices = replicas // per_slice
+    workers.replicas = replicas
+    # every scale (user or autoscaler) opens a stabilization window: the
+    # capacity autoscaler (controller/autoscaler.py) must not revert a manual
+    # scale inside its cooldown, so the stamp lives in this shared path
+    import time as _time
+
+    job.metadata.annotations[LAST_SCALE_ANNOTATION] = str(_time.time())
+    sp = job.spec.run_policy.scheduling_policy
+    if sp is not None and sp.min_available is not None:
+        # full-gang intent follows the new size; an explicit partial
+        # min stays, clamped to remain satisfiable
+        if sp.min_available >= old_total:
+            sp.min_available = job.total_replicas()
+        else:
+            sp.min_available = min(sp.min_available, job.total_replicas())
+
+
 _KIND_TO_CLS = {
     JobKind.JAX: JAXJob,
     JobKind.TF: TFJob,

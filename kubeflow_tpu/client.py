@@ -13,7 +13,7 @@ import time
 from pathlib import Path
 
 from kubeflow_tpu.api.common import JobConditionType
-from kubeflow_tpu.api.jobs import REPLICA_WORKER, TrainJob
+from kubeflow_tpu.api.jobs import REPLICA_WORKER, TrainJob, apply_elastic_scale
 from kubeflow_tpu.api.validation import validate_job
 from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
 from kubeflow_tpu.controller.gang import GangScheduler
@@ -36,6 +36,7 @@ class Platform:
             NotebookController,
             PVCViewerController,
         )
+        from kubeflow_tpu.controller.autoscaler import TrainingAutoscaler
         from kubeflow_tpu.controller.profile import ProfileController
         from kubeflow_tpu.controller.tensorboard import TensorboardController
         from kubeflow_tpu.pipelines.crd import PipelineRunController
@@ -64,6 +65,7 @@ class Platform:
             work_dir=str(Path(log_dir).parent / "pipelines"),
             platform=self,
         )
+        self.autoscaler = TrainingAutoscaler(self.cluster, self.gang_scheduler)
         self.metrics_server = None  # started on demand
         # single registry: observability iterates THIS, so a new controller
         # can never silently fall out of /metrics
@@ -76,6 +78,7 @@ class Platform:
             "tensorboard": self.tensorboard_controller,
             "notebook": self.notebook_controller,
             "pvcviewer": self.pvcviewer_controller,
+            "autoscaler": self.autoscaler,
         }
         self._started = False
 
@@ -158,40 +161,9 @@ class TrainingClient:
         (coordinator restart + resume from checkpoint), never a live resize.
         Requires an ElasticPolicy and min_replicas <= replicas <= max_replicas.
         """
-        def mutate(job: TrainJob) -> None:
-            if job.status.is_finished:
-                raise ValueError(f"job {name} already finished; cannot scale")
-            ep = job.spec.run_policy.elastic_policy
-            if ep is None:
-                raise ValueError(f"job {name} has no elasticPolicy; cannot scale")
-            if not (ep.min_replicas <= replicas <= ep.max_replicas):
-                raise ValueError(
-                    f"replicas {replicas} outside elastic range "
-                    f"[{ep.min_replicas}, {ep.max_replicas}]"
-                )
-            workers = job.spec.replica_specs.get(REPLICA_WORKER)
-            if workers is None:
-                raise ValueError(f"job {name} has no worker replicas; cannot scale")
-            old_total = job.total_replicas()
-            if job.spec.num_slices > 1:
-                per_slice = workers.replicas // job.spec.num_slices
-                if replicas % per_slice:
-                    raise ValueError(
-                        f"replicas {replicas} not a multiple of per-slice worker "
-                        f"count {per_slice} (scale by whole slices)"
-                    )
-                job.spec.num_slices = replicas // per_slice
-            workers.replicas = replicas
-            sp = job.spec.run_policy.scheduling_policy
-            if sp is not None and sp.min_available is not None:
-                # full-gang intent follows the new size; an explicit partial
-                # min stays, clamped to remain satisfiable
-                if sp.min_available >= old_total:
-                    sp.min_available = job.total_replicas()
-                else:
-                    sp.min_available = min(sp.min_available, job.total_replicas())
-
-        return self._read_modify_write(name, namespace, mutate)
+        return self._read_modify_write(
+            name, namespace, lambda job: apply_elastic_scale(job, replicas)
+        )
 
     def _read_modify_write(
         self, name: str, namespace: str, mutate, retries: int = 10

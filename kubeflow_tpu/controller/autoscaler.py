@@ -1,0 +1,207 @@
+"""Capacity autoscaler for elastic training jobs — the HPA analogue.
+
+Reference parity: the pytorch operator creates a HorizontalPodAutoscaler for
+elastic PyTorchJobs (training-operator pkg/controller.v1/pytorch/hpa.go —
+SURVEY.md §2.1 PyTorchJob row), scaling workers between ElasticPolicy
+min/max on external metrics. A TPU platform has a better native signal than
+pod CPU: **chip capacity**. This controller scales opted-in elastic jobs
+
+  - UP toward max_replicas while idle chips exist and nothing else wants
+    them (finish faster when the cluster is quiet),
+  - DOWN toward min_replicas when other gangs are ready but Unschedulable
+    (yield capacity instead of starving the queue),
+
+always in whole-worker (= whole-slice) steps through the same
+`apply_elastic_scale` mutation the SDK uses, so every invariant (elastic
+bounds, slice granularity, min_available clamping) holds. Each scale lands
+as a gang re-mesh driven by the job controller; a stabilization window
+(cooldown, HPA's stabilizationWindowSeconds analogue) keeps re-mesh churn
+bounded — scaling is expensive on TPU (checkpoint-restore), so the window
+defaults high.
+
+Opt-in via the job annotation `kubeflow-tpu.org/autoscale: "capacity"`.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubeflow_tpu.api.jobs import (
+    LAST_SCALE_ANNOTATION,
+    REPLICA_WORKER,
+    apply_elastic_scale,
+)
+from kubeflow_tpu.controller.base import ControllerBase
+from kubeflow_tpu.controller.fakecluster import EventType, FakeCluster
+from kubeflow_tpu.controller.gang import GangScheduler, topology_chips
+
+AUTOSCALE_ANNOTATION = "kubeflow-tpu.org/autoscale"
+POLICY_CAPACITY = "capacity"
+
+
+class TrainingAutoscaler(ControllerBase):
+    """Scales elastic, annotation-opted-in jobs on chip capacity."""
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        scheduler: GangScheduler,
+        cooldown_s: float = 30.0,
+        **kw,
+    ):
+        super().__init__(cluster, "training-autoscaler", **kw)
+        self.scheduler = scheduler
+        self.cooldown_s = cooldown_s
+        self.metrics.update({
+            "autoscaler_scale_ups_total": 0,
+            "autoscaler_scale_downs_total": 0,
+        })
+
+    # ------------------------------------------------------------- hooks
+
+    def kind_filter(self, etype, kind: str, obj) -> str | None:
+        if kind == "jobs" and etype != EventType.DELETED:
+            if self._opted_in(obj):
+                return f"{obj.namespace}/{obj.name}"
+            return None
+        # capacity changes can unblock any autoscaled job — but only fan out
+        # on events that actually move capacity or demand: group created
+        # (new demand), deleted (chips freed), or bound (phase flipped to
+        # Running). Member-churn MODIFIED events on still-pending groups are
+        # the bulk of bind-storm traffic and change neither.
+        if kind == "podgroups" and (
+            etype in (EventType.ADDED, EventType.DELETED)
+            or getattr(obj, "phase", None) == "Running"
+        ):
+            for key in self.resync_keys():
+                self.wq.add(key)
+        return None
+
+    def resync_keys(self):
+        return [
+            f"{j.namespace}/{j.name}"
+            for j in self.cluster.list("jobs")
+            if self._opted_in(j)
+        ]
+
+    @staticmethod
+    def _opted_in(job) -> bool:
+        return (
+            job.metadata.annotations.get(AUTOSCALE_ANNOTATION) == POLICY_CAPACITY
+            and job.spec.run_policy.elastic_policy is not None
+        )
+
+    # --------------------------------------------------------- reconcile
+
+    def reconcile(self, key: str) -> float | None:
+        job = self.cluster.get("jobs", key, copy_obj=True)
+        if job is None or job.status.is_finished or not self._opted_in(job):
+            return None
+        if job.spec.run_policy.suspend:
+            return None
+        ep = job.spec.run_policy.elastic_policy
+        workers = job.spec.replica_specs.get(REPLICA_WORKER)
+        if workers is None:
+            return None
+        replicas = workers.replicas
+
+        # stabilization window: a re-mesh is a checkpoint-restore cycle;
+        # never thrash
+        last = float(job.metadata.annotations.get(LAST_SCALE_ANNOTATION, 0))
+        remaining = self.cooldown_s - (time.time() - last)
+        if remaining > 0:
+            return remaining
+
+        sp = job.spec.run_policy.scheduling_policy
+        if sp is not None and sp.slice_topology and job.spec.num_slices <= 1:
+            # fixed-chip job: the podgroup reserves topology_chips regardless
+            # of worker count (chips = topo x num_slices), so scaling workers
+            # frees/claims nothing — the capacity policy cannot help, only
+            # burn re-meshes. Chips scale with workers only for count-sized
+            # gangs (1 chip/worker) and multi-slice jobs (whole slices).
+            return None
+
+        chips_per_worker = self._chips_per_worker(job, replicas)
+        own_groups = {f"{job.namespace}/{job.name}"}
+        demand = self.scheduler.pending_demand_chips(exclude_keys=own_groups)
+        free = self.scheduler.free_chips()
+        rs = job.status.replica_statuses.get(REPLICA_WORKER)
+        if rs is not None and (rs.succeeded > 0 or rs.failed > 0):
+            # completing or recovering: pods EXITED — any scale would re-mesh
+            # (restart) a job that is finishing or that the job controller is
+            # already handling. Leave it alone.
+            return None
+        # The bound/unbound signal is the job's PODGROUP phase, not replica
+        # statuses: rs.active counts PENDING pods too, so a created-but-
+        # unbound gang looks "fully active" while its chips still read as
+        # free — growing on that signal wedges the job above capacity.
+        pg = self.cluster.get("podgroups", key)
+        gang_bound = pg is not None and pg.phase == "Running"
+
+        target = replicas
+        unmet = demand - free  # queued demand the free pool cannot absorb
+        if unmet > 0 and replicas > ep.min_replicas:
+            # yield only what the free pool can't cover (a rival that fits in
+            # idle chips binds untouched — a re-mesh for it would be waste),
+            # never below min; one step per cooldown window keeps it damped
+            give = -(-unmet // chips_per_worker)  # ceil
+            target = max(ep.min_replicas, replicas - give)
+        elif not gang_bound:
+            # own gang unbound (mid-re-mesh or starved) — its chips are not
+            # charged, so they read as "free"; growing here would claim chips
+            # the gang itself needs. If idle chips (minus whatever queued
+            # gangs will take) cover the whole gang, just wait for the bind;
+            # if not, the chips were taken — shrink to the largest size that
+            # can actually bind.
+            effective_free = max(0, free - demand)
+            if effective_free < replicas * chips_per_worker:
+                fits = effective_free // chips_per_worker
+                target = max(ep.min_replicas, min(replicas, fits))
+        elif demand == 0 and free >= chips_per_worker and replicas < ep.max_replicas:
+            # steady state (gang bound), idle capacity, nothing queued: grow
+            target = min(ep.max_replicas, replicas + free // chips_per_worker)
+        target = self._slice_align(job, replicas, target)
+        if not (ep.min_replicas <= target <= ep.max_replicas):
+            target = replicas  # alignment left no valid size; stay put
+        if target == replicas:
+            return None
+
+        # optimistic concurrency on the ORIGINAL snapshot: if anything (user
+        # scale, job controller) wrote the job after we read it, the update
+        # conflicts and the native driver requeues — never apply a decision
+        # computed from a stale view onto a newer object. apply_elastic_scale
+        # stamps the stabilization window (shared with manual scale_job).
+        apply_elastic_scale(job, target)
+        self.cluster.update("jobs", job)
+        direction = "up" if target > replicas else "down"
+        self.metrics[f"autoscaler_scale_{direction}s_total"] += 1
+        self.cluster.record_event(
+            "jobs", key, "Autoscaled",
+            f"capacity autoscaler: {replicas} -> {target} workers "
+            f"(free={free} demand={demand} chips/worker={chips_per_worker})",
+        )
+        return self.cooldown_s
+
+    @staticmethod
+    def _chips_per_worker(job, replicas: int) -> int:
+        sp = job.spec.run_policy.scheduling_policy
+        if sp is not None and sp.slice_topology:
+            total = topology_chips(sp.slice_topology) * max(1, job.spec.num_slices)
+            return max(1, total // max(1, replicas))
+        return 1
+
+    @staticmethod
+    def _slice_align(job, replicas: int, target: int) -> int:
+        """Round a target to whole-slice worker multiples (toward `replicas`
+        staying conservative: down when growing, up when shrinking), and
+        clamp to >= one slice — apply_elastic_scale rejects non-multiples."""
+        if job.spec.num_slices <= 1 or target == replicas:
+            return target
+        per_slice = replicas // job.spec.num_slices
+        if per_slice <= 0:
+            return replicas
+        if target > replicas:
+            aligned = (target // per_slice) * per_slice
+        else:
+            aligned = -(-target // per_slice) * per_slice  # ceil
+        return max(per_slice, aligned)

@@ -34,7 +34,12 @@ class GangScheduler:
         self.errors = 0  # surfaced so silent failures are still countable
         self._stop = threading.Event()
         self._mu = threading.Lock()
-        self._bound_chips: dict[str, int] = {}  # group key -> chips held
+        # group key -> (group uid, chips held). The uid guards release: a
+        # re-meshed job deletes + recreates its podgroup under the SAME key,
+        # and the old group's DELETED watch event can arrive after the new
+        # group bound — releasing on key alone would drop the replacement's
+        # reservation and let other gangs overcommit the chips.
+        self._bound_chips: dict[str, tuple[str, int]] = {}
 
     def start(self) -> None:
         t = threading.Thread(target=self._loop, name="gang-scheduler", daemon=True)
@@ -56,7 +61,9 @@ class GangScheduler:
                 continue
             if kind == "podgroups" and etype == EventType.DELETED:
                 with self._mu:
-                    self._bound_chips.pop(obj.key, None)
+                    held = self._bound_chips.get(obj.key)
+                    if held is not None and held[0] == obj.metadata.uid:
+                        self._bound_chips.pop(obj.key)
             if kind in ("pods", "podgroups"):
                 self._try_schedule_safe()
 
@@ -96,9 +103,14 @@ class GangScheduler:
                             bound = sum(
                                 1 for p in self._members(pg) if p.status.node
                             )
-                            held = self._bound_chips.get(pg.key, 0)
+                            entry = self._bound_chips.get(pg.key)
+                            held = (
+                                entry[1]
+                                if entry and entry[0] == pg.metadata.uid
+                                else 0
+                            )
                             extra = max(0, bound + len(late) - held)
-                        used = sum(self._bound_chips.values())
+                        used = sum(c for _, c in self._bound_chips.values())
                         if used + extra > self.cluster.capacity_chips:
                             self.cluster.record_event(
                                 "podgroups", pg.key, "Unschedulable",
@@ -112,7 +124,7 @@ class GangScheduler:
                         # reserve before binding: a failed pod update must
                         # never leave bound pods holding uncounted chips
                         self._bound_chips[pg.key] = (
-                            self._bound_chips.get(pg.key, 0) + extra
+                            pg.metadata.uid, held + extra
                         )
                         self._bind(late, prefix="slice-0-host-late")
                     continue
@@ -124,7 +136,7 @@ class GangScheduler:
                 if len(pending) < pg.min_member:
                     continue
                 chips_needed = pg.chips or len(pending)
-                used = sum(self._bound_chips.values())
+                used = sum(c for _, c in self._bound_chips.values())
                 if used + chips_needed > self.cluster.capacity_chips:
                     self.cluster.record_event(
                         "podgroups", pg.key, "Unschedulable",
@@ -141,7 +153,7 @@ class GangScheduler:
                 # mid-loop (pod replaced concurrently), the reservation is
                 # already counted and the survivors are picked up by the
                 # late-member path above — never an uncounted half-gang.
-                self._bound_chips[pg.key] = chips_needed
+                self._bound_chips[pg.key] = (pg.metadata.uid, chips_needed)
                 pg.phase = "Running"
                 try:
                     self.cluster.update("podgroups", pg)
@@ -155,6 +167,48 @@ class GangScheduler:
                     f"gang of {len(pending)} bound ({chips_needed} chips)",
                 )
 
+    # ------------------------------------------------------- capacity views
+
+    def free_chips(self) -> int:
+        """Chips not held by any bound gang (autoscaler input)."""
+        with self._mu:
+            return self.cluster.capacity_chips - sum(
+                c for _, c in self._bound_chips.values()
+            )
+
+    def pending_demand_chips(self, exclude_keys: set[str] | None = None) -> int:
+        """Total chips wanted by gangs that are ready (>= min_member pending
+        members), not yet bound, and SATISFIABLE — the capacity pressure an
+        autoscaler should yield to. Gangs that can never bind (bigger than
+        total capacity, or namespace-quota-blocked) are excluded: shrinking
+        for them would pin the yielder at min forever while chips sit idle.
+        `exclude_keys` masks a job's own group(s). Pods are grouped in one
+        list pass (this is called from every autoscaled job's reconcile)."""
+        demand = 0
+        with self._mu:
+            holdings = dict(self._bound_chips)
+        bound = {k: uid for k, (uid, _) in holdings.items()}
+        pending_by_group: dict[str, int] = {}
+        for p in self.cluster.list("pods"):
+            if p.group_name and p.status.phase == PodPhase.PENDING and not p.status.node:
+                gk = f"{p.metadata.namespace}/{p.group_name}"
+                pending_by_group[gk] = pending_by_group.get(gk, 0) + 1
+        for pg in self.cluster.list("podgroups"):
+            if pg.phase == "Running" or bound.get(pg.key) == pg.metadata.uid:
+                continue
+            if exclude_keys and pg.key in exclude_keys:
+                continue
+            pending = pending_by_group.get(pg.key, 0)
+            if pending < pg.min_member:
+                continue
+            chips = pg.chips or pending
+            if chips > self.cluster.capacity_chips:
+                continue  # can never bind on this cluster
+            if self._ns_quota_would_block(pg, chips, holdings):
+                continue  # quota, not capacity, is the blocker
+            demand += chips
+        return demand
+
     def _bind(self, pods: list[Pod], prefix: str) -> None:
         """Bind each pod, tolerating concurrent replacement of individuals
         (the group's reservation is already held by the caller)."""
@@ -165,7 +219,12 @@ class GangScheduler:
             except (ConflictError, KeyError):
                 continue  # this member was replaced; late path rebinds it
 
-    def _ns_quota_blocked(self, pg: PodGroup, chips_needed: int) -> bool:
+    def _ns_quota_would_block(
+        self, pg: PodGroup, chips_needed: int, holdings: dict
+    ) -> bool:
+        """Pure quota check (no event) — shared by admission (which holds
+        _mu and passes the live dict) and the demand view (which passes a
+        locked snapshot, since _bound_chips must not be read unlocked)."""
         from kubeflow_tpu.controller.profile import namespace_quota
 
         ns = pg.metadata.namespace
@@ -173,18 +232,28 @@ class GangScheduler:
         if quota is None or quota.chips is None:
             return False
         ns_used = sum(
-            c for k, c in self._bound_chips.items()
-            if k.split("/", 1)[0] == ns
+            c for k, (_, c) in holdings.items() if k.split("/", 1)[0] == ns
         )
-        if ns_used + chips_needed > quota.chips:
-            self.cluster.record_event(
-                "podgroups", pg.key, "QuotaExceeded",
-                f"namespace {ns} quota {quota.chips} chips, "
-                f"{quota.chips - ns_used} free",
-                type="Warning",
-            )
-            return True
-        return False
+        return ns_used + chips_needed > quota.chips
+
+    def _ns_quota_blocked(self, pg: PodGroup, chips_needed: int) -> bool:
+        """Admission-path quota check (caller holds _mu); records the event."""
+        from kubeflow_tpu.controller.profile import namespace_quota
+
+        if not self._ns_quota_would_block(pg, chips_needed, self._bound_chips):
+            return False
+        quota = namespace_quota(self.cluster, pg.metadata.namespace)
+        ns_used = sum(
+            c for k, (_, c) in self._bound_chips.items()
+            if k.split("/", 1)[0] == pg.metadata.namespace
+        )
+        self.cluster.record_event(
+            "podgroups", pg.key, "QuotaExceeded",
+            f"namespace {pg.metadata.namespace} quota {quota.chips} chips, "
+            f"{quota.chips - ns_used} free",
+            type="Warning",
+        )
+        return True
 
     def _members(self, pg: PodGroup) -> list[Pod]:
         return self.cluster.list(
@@ -193,6 +262,3 @@ class GangScheduler:
             and p.metadata.namespace == pg.metadata.namespace,
         )
 
-    def release(self, group_key: str) -> None:
-        with self._mu:
-            self._bound_chips.pop(group_key, None)

@@ -1,0 +1,191 @@
+"""Capacity autoscaler (controller/autoscaler.py) — the HPA analogue.
+
+Reference parity: training-operator creates an HPA for elastic PyTorchJobs
+(SURVEY.md §2.1 PyTorchJob row); here the native scaling signal is chip
+capacity: grow into idle chips, yield to queued gangs.
+"""
+
+import sys
+import textwrap
+import time
+
+import pytest
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    ElasticPolicy,
+    JAXJob,
+    JAXJobSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.client import Platform, TrainingClient
+from kubeflow_tpu.controller.autoscaler import (
+    AUTOSCALE_ANNOTATION,
+    POLICY_CAPACITY,
+)
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=4)
+    # fast loops for tests: the production default cooldown (30 s) models a
+    # checkpoint-restore re-mesh; here we want observable decisions quickly
+    p.autoscaler.cooldown_s = 0.5
+    p.autoscaler.resync_period_s = 0.3
+    with p:
+        yield p
+
+
+@pytest.fixture()
+def client(platform):
+    return TrainingClient(platform)
+
+
+def sleeper_job(tmp_path, name, replicas=1, autoscale=True, max_replicas=4,
+                marker=None):
+    path = tmp_path / f"{name}.py"
+    marker = marker or (tmp_path / f"{name}.go")
+    path.write_text(textwrap.dedent(f"""
+        import os, time
+        while not os.path.exists({str(marker)!r}):
+            time.sleep(0.05)
+    """))
+    meta = ObjectMeta(name=name)
+    if autoscale:
+        meta.annotations[AUTOSCALE_ANNOTATION] = POLICY_CAPACITY
+    return JAXJob(
+        metadata=meta,
+        spec=JAXJobSpec(
+            replica_specs={
+                REPLICA_WORKER: ReplicaSpec(
+                    replicas=replicas,
+                    restart_policy=RestartPolicy.ON_FAILURE,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(command=[sys.executable, str(path)])
+                    ),
+                )
+            },
+            run_policy=RunPolicy(
+                elastic_policy=ElasticPolicy(
+                    min_replicas=1, max_replicas=max_replicas
+                )
+            ),
+        ),
+    ), marker
+
+
+def wait_replicas(client, name, n, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        j = client.get_job(name)
+        rs = j.status.replica_statuses.get(REPLICA_WORKER)
+        if rs and rs.active == n:
+            return j
+        time.sleep(0.1)
+    j = client.get_job(name)
+    raise TimeoutError(f"{name}: never reached {n} active (now: {j.status})")
+
+
+class TestCapacityAutoscaler:
+    def test_scales_up_into_idle_capacity(self, client, tmp_path):
+        job, marker = sleeper_job(tmp_path, "growy", replicas=1)
+        client.create_job(job)
+        # 4 idle chips, nothing queued: should reach max_replicas=4
+        wait_replicas(client, "growy", 4)
+        assert any(e.reason == "Autoscaled" for e in client.get_events("growy"))
+        marker.write_text("go")
+        client.wait_for_job_conditions("growy", timeout_s=30)
+
+    def test_yields_to_queued_gang(self, client, tmp_path, platform):
+        job, marker = sleeper_job(tmp_path, "hog", replicas=1)
+        client.create_job(job)
+        wait_replicas(client, "hog", 4)  # grew into all 4 chips
+
+        # a 2-worker non-elastic gang arrives; it is Unschedulable until the
+        # autoscaler shrinks the hog
+        rival, rival_marker = sleeper_job(
+            tmp_path, "rival", replicas=2, autoscale=False
+        )
+        client.create_job(rival)
+        wait_replicas(client, "rival", 2, timeout=45)
+        # hog yields (or shrinks-to-fit) within a cooldown window or two —
+        # the decision is asynchronous, so poll rather than assert instantly
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            j = client.get_job("hog")
+            if j.spec.replica_specs[REPLICA_WORKER].replicas <= 2:
+                break
+            time.sleep(0.2)
+        assert j.spec.replica_specs[REPLICA_WORKER].replicas <= 2
+        m = platform.autoscaler.metrics
+        assert m["autoscaler_scale_downs_total"] >= 1
+        marker.write_text("go")
+        rival_marker.write_text("go")
+        client.wait_for_job_conditions("hog", timeout_s=30)
+        client.wait_for_job_conditions("rival", timeout_s=30)
+
+    def test_ignores_jobs_without_annotation(self, client, tmp_path):
+        job, marker = sleeper_job(tmp_path, "manual", replicas=1, autoscale=False)
+        client.create_job(job)
+        wait_replicas(client, "manual", 1)
+        time.sleep(1.5)  # several autoscaler resync periods
+        j = client.get_job("manual")
+        assert j.spec.replica_specs[REPLICA_WORKER].replicas == 1
+        assert not any(e.reason == "Autoscaled" for e in client.get_events("manual"))
+        marker.write_text("go")
+        client.wait_for_job_conditions("manual", timeout_s=30)
+
+    def test_fixed_chip_topology_job_left_alone(self, client, tmp_path):
+        """num_slices=1 + slice_topology: chips don't scale with workers, so
+        the capacity policy must not burn re-meshes on it."""
+        from kubeflow_tpu.api import SchedulingPolicy
+
+        job, marker = sleeper_job(tmp_path, "fixed", replicas=2, max_replicas=4)
+        job.spec.run_policy.scheduling_policy = SchedulingPolicy(
+            slice_topology="2x2"  # 4 chips regardless of worker count
+        )
+        client.create_job(job)
+        wait_replicas(client, "fixed", 2)
+        time.sleep(1.5)
+        j = client.get_job("fixed")
+        assert j.spec.replica_specs[REPLICA_WORKER].replicas == 2
+        assert not any(e.reason == "Autoscaled" for e in client.get_events("fixed"))
+        marker.write_text("go")
+        client.wait_for_job_conditions("fixed", timeout_s=30)
+
+    def test_slice_align(self):
+        """Targets round to whole-slice multiples (apply_elastic_scale
+        rejects anything else for multi-slice jobs)."""
+        from kubeflow_tpu.controller.autoscaler import TrainingAutoscaler
+
+        class FakeSpec:
+            num_slices = 2
+
+        class FakeJob:
+            spec = FakeSpec()
+
+        j = FakeJob()
+        # 4 workers over 2 slices -> per_slice=2: grow rounds DOWN, shrink UP
+        assert TrainingAutoscaler._slice_align(j, 4, 5) == 4
+        assert TrainingAutoscaler._slice_align(j, 4, 6) == 6
+        assert TrainingAutoscaler._slice_align(j, 4, 1) == 2
+        assert TrainingAutoscaler._slice_align(j, 4, 3) == 4
+        j.spec.num_slices = 1
+        assert TrainingAutoscaler._slice_align(j, 4, 5) == 5  # no-op
+
+    def test_cooldown_damps_rescale(self, client, tmp_path, platform):
+        platform.autoscaler.cooldown_s = 60.0  # long window
+        job, marker = sleeper_job(tmp_path, "calm", replicas=1, max_replicas=2)
+        client.create_job(job)
+        wait_replicas(client, "calm", 2)  # first scale is allowed (no stamp)
+        # a second decision inside the window must not land even though the
+        # job could in principle keep growing if max were higher
+        events = [e for e in client.get_events("calm") if e.reason == "Autoscaled"]
+        assert len(events) == 1
+        marker.write_text("go")
+        client.wait_for_job_conditions("calm", timeout_s=30)
