@@ -47,3 +47,20 @@ def test_backend_error_classifier():
         RuntimeError("Unable to initialize backend 'axon'")
     )
     assert not bench._is_backend_init_error(ValueError("shape mismatch"))
+
+
+def test_bench_gpt_flash_smoke(monkeypatch):
+    """Long-context GPT bench runs end-to-end (tiny dims, interpret-mode
+    pallas on CPU) and emits the metric contract."""
+    import bench
+    from kubeflow_tpu import models
+
+    monkeypatch.setattr(
+        models.GPTConfig, "small",
+        staticmethod(lambda **kw: models.GPTConfig.tiny(**kw)),
+    )
+    # batch divisible by the 8-device data axis of the test mesh
+    r = bench.bench_gpt2s_flash_2k(steps=1, batch_size=8, seq_len=256)
+    assert r["metric"] == "gpt2s_flash_2k_tokens_per_sec_per_chip"
+    assert r["value"] > 0
+    assert r["model_flops_per_step"] > 0

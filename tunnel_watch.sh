@@ -13,11 +13,20 @@ stage() {  # stage <artifact> <timeout_s> <cmd...>
   [ -f "$artifact.done" ] && return 0
   # stderr goes to a sidecar file, NOT the artifact: bench.py emits JSONL on
   # stdout and retry/plugin noise on stderr, and mixing them corrupts the
-  # per-line-JSON artifact consumers parse
-  timeout "$tmo" "$@" > "$artifact" 2> "$artifact.stderr"
+  # per-line-JSON artifact consumers parse. Output lands in a .tmp first so
+  # a failed/timed-out attempt never truncates lines a previous attempt
+  # already captured — partial output is APPENDED to the artifact instead
+  # (consumers take the last line per metric).
+  timeout "$tmo" "$@" > "$artifact.tmp" 2> "$artifact.stderr"
   local rc=$?
   echo "stage $artifact rc=$rc at $(date -u +%H:%M:%S)" >> tunnel_watch.log
-  if [ "$rc" -eq 0 ]; then touch "$artifact.done"; return 0; fi
+  if [ "$rc" -eq 0 ]; then
+    mv "$artifact.tmp" "$artifact"
+    touch "$artifact.done"
+    return 0
+  fi
+  cat "$artifact.tmp" >> "$artifact" 2>/dev/null
+  rm -f "$artifact.tmp"
   return 1
 }
 
@@ -39,7 +48,7 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
     # on any stage failure, back off before re-probing: a fast-failing stage
     # must not hot-loop against an alive tunnel
     { stage probe_results.txt 1200 python -u probe_ops.py \
-        && stage bench_r2_fixed.jsonl 2400 python bench.py --suite \
+        && stage bench_r2_fixed.jsonl 3600 python bench.py --suite \
         && stage probe_bert.txt 1500 python -u probe_bert.py; } || sleep 180
   else
     sleep 180
