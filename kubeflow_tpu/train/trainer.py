@@ -134,6 +134,7 @@ class Trainer:
         self.apply_fn = apply_fn or self._default_apply
         self.tx = tx if tx is not None else self._default_tx()
         self._jit_train_step = jax.jit(self._train_step, donate_argnums=0)
+        self._fused_cache: dict[int, Callable] = {}  # n -> jitted n-step scan
         self._jit_eval_step = jax.jit(self._eval_step)
         self.checkpointer = (
             Checkpointer(config.checkpoint_dir) if config.checkpoint_dir else None
@@ -317,6 +318,50 @@ class Trainer:
         # models (bert.constrain) without threading the mesh through modules
         with jax.set_mesh(self.mesh):
             return self._jit_train_step(state, shard_batch(batch, self.mesh))
+
+    def train_steps_fused(
+        self, state: TrainState, batch, n: int
+    ) -> tuple[TrainState, dict]:
+        """Run n optimizer steps in ONE jit dispatch — a lax.scan over the
+        step with a constant (device-resident) batch.
+
+        The TPU-idiomatic loop shape for on-device data: host dispatch
+        overhead (a round trip per call on the axon tunnel) is paid once per
+        n steps instead of per step, and XLA can pipeline across iterations.
+        The per-step rng still varies (the step counter folds into the key
+        inside _train_step). Returns the final state and the LAST step's
+        metrics. Real `fit` keeps per-step dispatch — host data arrives per
+        step and prefetch overlaps the transfer — but benches and synthetic-
+        data loops should use this."""
+        fn = self._fused_fn(n)
+        with jax.set_mesh(self.mesh):
+            return fn(state, shard_batch(batch, self.mesh))
+
+    def _fused_fn(self, n: int):
+        fn = self._fused_cache.get(n)
+        if fn is None:
+
+            def many(state, batch):
+                def body(s, _):
+                    return self._train_step(s, batch)
+
+                state, ms = jax.lax.scan(body, state, None, length=n)
+                return state, jax.tree.map(lambda v: v[-1], ms)
+
+            fn = jax.jit(many, donate_argnums=0)
+            self._fused_cache[n] = fn
+        return fn
+
+    def compile_fused(self, state: TrainState, batch, n: int):
+        """AOT-compile the n-step fused program WITHOUT executing it.
+
+        Returns (compiled, placed_batch). Benches use this so warmup costs
+        one compile, not n unmeasured optimizer steps; `compiled(state,
+        placed_batch)` then runs with the jit-declared state donation."""
+        with jax.set_mesh(self.mesh):
+            batch = shard_batch(batch, self.mesh)
+            compiled = self._fused_fn(n).lower(state, batch).compile()
+        return compiled, batch
 
     # ------------------------------------------------------------------- fit
 
