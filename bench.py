@@ -77,30 +77,18 @@ def _peak_flops() -> float | None:
 
 
 def _timed_steps(trainer, state, batch, steps: int):
-    import jax
-
-    from kubeflow_tpu.parallel.sharding import shard_batch
-
     # Protocol (docs/perf.md): ALL `steps` run inside ONE jit dispatch
-    # (Trainer.train_steps_fused: lax.scan over the step, the TPU-idiomatic
-    # loop for on-device data) so per-dispatch tunnel overhead is out of the
-    # measurement. Two axon-tunnel facts still shape the loop:
-    #  1. HOST-BORN arrays (device_put/jnp.ones from host data) are re-uploaded
-    #     through the tunnel on EVERY dispatch that takes them as args; outputs
-    #     of on-device computations are not. So the batch is reborn as a jit
-    #     output once — after that, re-passing it costs nothing.
-    #  2. jax.block_until_ready returns before remote execution completes, so
-    #     the only true sync is a device->host read: the scalar loss fetch,
-    #     which depends on the whole chained step sequence.
-    with jax.set_mesh(trainer.mesh):
-        batch = shard_batch(batch, trainer.mesh)
-        batch = jax.jit(lambda t: jax.tree.map(lambda x: x + 0, t))(batch)
-    # AOT compile once, then ONE warm execution before the timed one: the
-    # first run of a fresh executable carries one-time overheads (output
+    # (lax.scan over the step, the TPU-idiomatic loop for on-device data) so
+    # per-dispatch tunnel overhead is out of the measurement. compile_fused
+    # is the single placement site: it device-births the batch (host-born
+    # args are re-uploaded through the tunnel on every dispatch) and AOT-
+    # compiles without executing. Then ONE warm execution before the timed
+    # one: a fresh executable's first run carries one-time overheads (output
     # allocation, runtime first-touch — measured 5x noise at small n), and
     # compiles — the expensive thing through the remote tunnel — happen
-    # exactly once either way. Total device work is 2n steps, which is small
-    # against a single compile on this backend.
+    # exactly once either way. The only true sync on axon is a device->host
+    # read (block_until_ready returns early): the scalar loss fetch, which
+    # depends on the whole chained step sequence.
     compiled, batch = trainer.compile_fused(state, batch, steps)
     state, m = compiled(state, batch)
     float(m["loss"])  # true sync (block_until_ready lies through the tunnel)

@@ -1,0 +1,150 @@
+"""Hypothesis property tests — serde round-trips and env-contract invariants.
+
+SURVEY.md §4 names pytest + hypothesis as the rebuild's property-testing
+layer (the reference leans on table-driven Go tests; properties subsume the
+tables). Strategy: generate structurally-valid specs across every job kind
+and assert the invariants that matter platform-wide:
+
+  - YAML/dict serde is lossless (the golden-file tests pin formatting; these
+    pin semantics under arbitrary field values),
+  - every replica of a gang derives the SAME rendezvous world (sizes,
+    coordinator address) and its OWN rank — the one property the entire
+    distributed layer rests on (SURVEY.md L3).
+"""
+
+from __future__ import annotations
+
+import string
+
+from hypothesis import given, settings, strategies as st
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    ElasticPolicy,
+    JobKind,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+)
+from kubeflow_tpu.api.jobs import SUCCESS_REPLICA, job_class_for_kind
+from kubeflow_tpu.api.serde import job_from_dict, job_from_yaml, job_to_dict, job_to_yaml
+from kubeflow_tpu.controller import envcontract
+
+_name = st.text(string.ascii_lowercase + string.digits, min_size=1, max_size=12)
+_label_val = st.text(string.ascii_letters + string.digits + "-_.", min_size=0, max_size=20)
+
+
+def _replica_spec(rtype: str) -> st.SearchStrategy[ReplicaSpec]:
+    # chief-like types are singletons by validation; keep draws admissible
+    singleton = rtype in ("master", "chief", "launcher", "scheduler")
+    return st.builds(
+        ReplicaSpec,
+        replicas=st.just(1) if singleton else st.integers(min_value=1, max_value=8),
+        restart_policy=st.sampled_from(list(RestartPolicy)),
+        template=st.just(
+            PodTemplateSpec(container=ContainerSpec(command=["python", "-c", "pass"]))
+        ),
+    )
+
+
+@st.composite
+def train_jobs(draw):
+    kind = draw(st.sampled_from(list(JobKind)))
+    cls = job_class_for_kind(kind)
+    # the kind's primary replica type always present; extras sometimes
+    rtypes = {SUCCESS_REPLICA[kind]}
+    if draw(st.booleans()):
+        rtypes.add(draw(st.sampled_from(["worker", "ps", "evaluator", "master"])))
+    specs = {r: draw(_replica_spec(r)) for r in sorted(rtypes)}
+    rp = RunPolicy(
+        backoff_limit=draw(st.integers(0, 10)),
+        ttl_seconds_after_finished=draw(st.one_of(st.none(), st.integers(0, 3600))),
+        suspend=draw(st.booleans()),
+    )
+    if draw(st.booleans()):
+        lo = draw(st.integers(1, 4))
+        rp.elastic_policy = ElasticPolicy(
+            min_replicas=lo, max_replicas=draw(st.integers(lo, 16))
+        )
+    if draw(st.booleans()):
+        rp.scheduling_policy = SchedulingPolicy(
+            queue=draw(_name), slice_topology=draw(st.sampled_from(["", "2x2", "2x4"]))
+        )
+    job = cls(
+        metadata=ObjectMeta(
+            name=draw(_name),
+            namespace=draw(_name),
+            labels=draw(st.dictionaries(_name, _label_val, max_size=3)),
+            annotations=draw(st.dictionaries(_name, _label_val, max_size=3)),
+        )
+    )
+    job.spec.replica_specs = specs
+    job.spec.run_policy = rp
+    return job
+
+
+@settings(max_examples=60, deadline=None)
+@given(train_jobs())
+def test_yaml_roundtrip_lossless(job):
+    assert job_from_yaml(job_to_yaml(job)) == job
+
+
+@settings(max_examples=60, deadline=None)
+@given(train_jobs())
+def test_dict_roundtrip_lossless(job):
+    assert job_from_dict(job_to_dict(job)) == job
+
+
+@settings(max_examples=40, deadline=None)
+@given(train_jobs())
+def test_env_contract_same_world_per_rank(job):
+    """Every member of an ADMISSIBLE gang derives the same world and its own
+    rank (inadmissible specs — e.g. two pytorch masters — are the admission
+    webhook's job to reject, and validate_job does)."""
+    from hypothesis import assume
+
+    from kubeflow_tpu.api.validation import validate_job
+
+    try:
+        validate_job(job)
+    except Exception:
+        assume(False)  # rejected at admission; not this property's domain
+    worlds = set()
+    ranks: dict[str, list[str]] = {}  # rank key (numbering domain) -> values
+    rank_keys = ("JAX_PROCESS_ID", "RANK", "OMPI_COMM_WORLD_RANK")
+    world_keys = (
+        "JAX_NUM_PROCESSES", "WORLD_SIZE", "PET_NNODES",
+        "JAX_COORDINATOR_ADDRESS", "MASTER_ADDR", "TF_CONFIG",
+        "OMPI_MCA_orte_default_hostfile", "DMLC_NUM_WORKER",
+        "PADDLE_TRAINERS_NUM",
+    )
+    for rtype, rs in job.spec.replica_specs.items():
+        for i in range(rs.replicas):
+            env = envcontract.synthesize_env(job, rtype, i)
+            for k in rank_keys:
+                if k in env:
+                    ranks.setdefault(k, []).append(env[k])
+            world = tuple(
+                (k, v) for k in world_keys
+                if (v := env.get(k)) is not None and "task" not in k.lower()
+            )
+            # TF_CONFIG embeds the member's own task — strip it to the
+            # cluster half, which must be gang-wide identical
+            if "TF_CONFIG" in env:
+                import json
+
+                cluster = json.dumps(
+                    json.loads(env["TF_CONFIG"])["cluster"], sort_keys=True
+                )
+                world = tuple(x for x in world if x[0] != "TF_CONFIG") + (
+                    ("TF_CLUSTER", cluster),
+                )
+            worlds.add(world)
+    assert len(worlds) == 1, f"gang saw {len(worlds)} distinct worlds"
+    # every member got its OWN rank: values within a numbering domain (one
+    # env key = one domain) are pairwise distinct
+    for key, values in ranks.items():
+        assert len(set(values)) == len(values), f"{key} ranks collide: {values}"
