@@ -35,6 +35,12 @@ def batch_pspec() -> P:
     return P(BATCH_AXES)
 
 
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a (k, B, ...) stacked batch chunk: scan dim replicated,
+    batch dim split over the data-like axes (Trainer.train_chunk)."""
+    return NamedSharding(mesh, P(None, BATCH_AXES))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, batch_pspec())
 

@@ -79,9 +79,9 @@ class Timer:
         self._items = 0
         self._steps = 0
 
-    def tick(self, items: int = 0) -> None:
+    def tick(self, items: int = 0, steps: int = 1) -> None:
         self._items += items
-        self._steps += 1
+        self._steps += steps
 
     @property
     def elapsed(self) -> float:

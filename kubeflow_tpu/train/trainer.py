@@ -31,7 +31,12 @@ from flax import struct
 from jax.sharding import Mesh
 
 from kubeflow_tpu.parallel import build_mesh, MeshConfig
-from kubeflow_tpu.parallel.sharding import shard_batch, state_shardings
+from kubeflow_tpu.parallel.sharding import (
+    put_global,
+    shard_batch,
+    stacked_batch_sharding,
+    state_shardings,
+)
 from kubeflow_tpu.train import metrics as metrics_lib
 from kubeflow_tpu.train.checkpoint import Checkpointer
 from kubeflow_tpu.train.data import Dataset, batches, prefetch_to_device
@@ -64,6 +69,12 @@ class TrainerConfig:
     # accumulate this many microbatch grads per optimizer step — big
     # effective batches without PP; runs as a lax.scan inside ONE jit step
     grad_accum_steps: int = 1
+    # run this many optimizer steps per jit dispatch in fit() (lax.scan over
+    # a stacked batch chunk) — amortizes host dispatch overhead, the
+    # TPU-idiomatic steady-state loop. 1 = per-step dispatch (prefetch
+    # overlaps transfers). Log/checkpoint/preemption cadence becomes
+    # chunk-granular.
+    fused_steps: int = 1
     seed: int = 0
     compute_dtype: Any = jnp.float32  # bfloat16 for MXU-heavy models
     eval_every_epochs: int = 1
@@ -136,6 +147,7 @@ class Trainer:
         self._jit_train_step = jax.jit(self._train_step, donate_argnums=0)
         self._fused_cache: dict[int, Callable] = {}  # n -> jitted n-step scan
         self._fused_compiled: dict[int, Any] = {}  # n -> AOT executable
+        self._fused_data_cache: dict[int, Callable] = {}  # k -> data-scan
         self._jit_eval_step = jax.jit(self._eval_step)
         self.checkpointer = (
             Checkpointer(config.checkpoint_dir) if config.checkpoint_dir else None
@@ -348,20 +360,42 @@ class Trainer:
                     pass
             return self._fused_fn(n)(state, batch)
 
-    def _fused_fn(self, n: int):
-        fn = self._fused_cache.get(n)
+    def _fused_builder(self, n: int, scanned_data: bool):
+        """jit'd n-step scan over _train_step, returning the LAST step's
+        metrics. scanned_data=False: the batch is a scan-invariant constant
+        (benches); True: the batch is the scanned xs, one (B, ...) slice per
+        step from a stacked (n, B, ...) chunk (fit's steady state)."""
+        cache = self._fused_data_cache if scanned_data else self._fused_cache
+        fn = cache.get(n)
         if fn is None:
 
             def many(state, batch):
-                def body(s, _):
-                    return self._train_step(s, batch)
+                def body(s, b):
+                    return self._train_step(s, batch if not scanned_data else b)
 
-                state, ms = jax.lax.scan(body, state, None, length=n)
+                state, ms = jax.lax.scan(
+                    body, state,
+                    batch if scanned_data else None,
+                    length=None if scanned_data else n,
+                )
                 return state, jax.tree.map(lambda v: v[-1], ms)
 
             fn = jax.jit(many, donate_argnums=0)
-            self._fused_cache[n] = fn
+            cache[n] = fn
         return fn
+
+    def _fused_fn(self, n: int):
+        return self._fused_builder(n, scanned_data=False)
+
+    def _fused_data_fn(self, k: int):
+        return self._fused_builder(k, scanned_data=True)
+
+    def train_chunk(self, state: TrainState, stacked, k: int):
+        """Run k steps over a host-stacked chunk (k, B, ...) in one dispatch."""
+        with jax.set_mesh(self.mesh):
+            s = stacked_batch_sharding(self.mesh)
+            xs = jax.tree.map(lambda a: put_global(a, s), stacked)
+            return self._fused_data_fn(k)(state, xs)
 
     def compile_fused(self, state: TrainState, batch, n: int):
         """AOT-compile the n-step fused program WITHOUT executing it.
@@ -470,44 +504,100 @@ class Trainer:
         last = {}
 
         epoch = global_step // max(per_epoch, 1)
+
+        # Per-batch-of-steps bookkeeping, shared by both stepping modes.
+        # Returns True when fit must stop (preemption). `took` is how many
+        # optimizer steps the dispatch covered; log/checkpoint fire when
+        # their cadence boundary falls inside the chunk.
+        stop = {"flag": False}
+
+        def after(took: int, m) -> bool:
+            nonlocal global_step, last
+            global_step += took
+            timer.tick(items=took * c.batch_size, steps=took)
+            if (global_step % c.log_every_steps) < took or global_step == total_steps:
+                last = {k: float(v) for k, v in m.items()}
+                metrics_lib.emit(
+                    step=global_step,
+                    **last,
+                    images_per_sec=timer.items_per_sec,
+                    steps_per_sec=timer.steps_per_sec,
+                )
+                if events is not None:
+                    events.scalars(
+                        global_step, **last,
+                        images_per_sec=timer.items_per_sec,
+                    )
+            if preempted["flag"]:
+                self.checkpointer.save(global_step, state)
+                self.checkpointer.wait()
+                metrics_lib.emit(step=global_step, preempted=1)
+                stop["flag"] = True
+                return True
+            if (
+                self.checkpointer is not None
+                and (global_step % c.checkpoint_every_steps) < took
+            ):
+                self.checkpointer.save(global_step, state)
+            return False
+
         while global_step < total_steps:
-            # double-buffered host->device prefetch keeps input transfer off
-            # the step critical path (train/data.py)
-            for bx, by in prefetch_to_device(
-                batches(
+            # Steady-state stepping: per-step dispatch with double-buffered
+            # host->device prefetch (transfer off the critical path), or —
+            # fused_steps > 1 — full chunks of exactly fused_steps run as ONE
+            # k-step lax.scan dispatch (host dispatch amortized, one stacked
+            # upload). Epoch tails and the total_steps boundary fall back to
+            # per-step dispatch so numerics never depend on the chunking and
+            # compile count stays at two programs (k-scan + single step).
+            # a chunk can never exceed an epoch: without the clamp, a
+            # too-large fused_steps would silently run everything per-step
+            # AND without prefetch — worse than fused_steps=1
+            fused_k = min(c.fused_steps, per_epoch)
+            if fused_k > 1:
+                k = fused_k
+                pending: list = []
+                for b in batches(
                     dataset.x_train, dataset.y_train, c.batch_size,
                     seed=c.seed + epoch,
-                ),
-                self.mesh,
-            ):
-                if global_step >= total_steps:
-                    break
-                state, m = self.train_step(state, (bx, by))
-                global_step += 1
-                timer.tick(items=len(bx))
-                if global_step % c.log_every_steps == 0 or global_step == total_steps:
-                    last = {k: float(v) for k, v in m.items()}
-                    metrics_lib.emit(
-                        step=global_step,
-                        **last,
-                        images_per_sec=timer.items_per_sec,
-                        steps_per_sec=timer.steps_per_sec,
-                    )
-                    if events is not None:
-                        events.scalars(
-                            global_step, **last,
-                            images_per_sec=timer.items_per_sec,
-                        )
-                if preempted["flag"]:
-                    self.checkpointer.save(global_step, state)
-                    self.checkpointer.wait()
-                    metrics_lib.emit(step=global_step, preempted=1)
-                    return state, {**last, "preempted": 1.0}
-                if (
-                    self.checkpointer is not None
-                    and global_step % c.checkpoint_every_steps == 0
                 ):
-                    self.checkpointer.save(global_step, state)
+                    if global_step >= total_steps or stop["flag"]:
+                        break
+                    if total_steps - global_step >= k:
+                        pending.append(b)
+                        if len(pending) == k:
+                            stacked = tuple(
+                                np.stack(z) for z in zip(*pending)
+                            )
+                            pending = []
+                            state, m = self.train_chunk(state, stacked, k)
+                            if after(k, m):
+                                break
+                    else:
+                        state, m = self.train_step(state, b)
+                        if after(1, m):
+                            break
+                # epoch tail smaller than a chunk: per-step
+                for b in pending:
+                    if global_step >= total_steps or stop["flag"]:
+                        break
+                    state, m = self.train_step(state, b)
+                    if after(1, m):
+                        break
+            else:
+                for bx, by in prefetch_to_device(
+                    batches(
+                        dataset.x_train, dataset.y_train, c.batch_size,
+                        seed=c.seed + epoch,
+                    ),
+                    self.mesh,
+                ):
+                    if global_step >= total_steps or stop["flag"]:
+                        break
+                    state, m = self.train_step(state, (bx, by))
+                    if after(1, m):
+                        break
+            if stop["flag"]:
+                return state, {**last, "preempted": 1.0}
             epoch += 1
             if epoch % c.eval_every_epochs == 0:
                 ev = self.evaluate(state, dataset)

@@ -124,6 +124,38 @@ def test_fused_steps_match_sequential(digits):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
 
 
+def test_fused_fit_matches_per_step_fit(digits):
+    """fit(fused_steps=k) == fit(fused_steps=1): same data order, same
+    numerics — chunking is a dispatch shape, not a semantic."""
+    import jax
+
+    from kubeflow_tpu.train.data import Dataset
+
+    def run(k: int):
+        t = Trainer(
+            MnistMLP(hidden=(16,)),
+            # steps=11 with fused_steps=4: two full chunks + 3 per-step tail
+            TrainerConfig(batch_size=8, steps=11, fused_steps=k,
+                          log_every_steps=10**9),
+        )
+        state, m = t.fit(
+            Dataset(
+                x_train=digits.x_train[:96], y_train=digits.y_train[:96],
+                x_test=digits.x_test[:16], y_test=digits.y_test[:16],
+                num_classes=10,
+            ),
+            resume=False,
+        )
+        return state, m
+
+    s1, m1 = run(1)
+    s4, m4 = run(4)
+    assert int(s1.step) == int(s4.step) == 11
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s4.params)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    assert abs(m1["final_loss"] - m4["final_loss"]) < 1e-5
+
+
 def test_metrics_emit_parse_roundtrip(capsys):
     emit(step=7, loss=0.125, accuracy=0.5)
     line = capsys.readouterr().out.strip()
