@@ -17,9 +17,12 @@ from kubeflow_tpu.pipelines.dsl import (
     Task,
     TaskOutput,
     component,
+    for_each,
+    on_exit,
     pipeline,
     sweep,
     train_job,
+    when,
 )
 from kubeflow_tpu.pipelines.runner import (
     LocalPipelineRunner,
@@ -44,8 +47,11 @@ __all__ = [
     "compile_pipeline",
     "compile_to_yaml",
     "component",
+    "for_each",
+    "on_exit",
     "pipeline",
     "sweep",
     "train_job",
     "validate_ir",
+    "when",
 ]

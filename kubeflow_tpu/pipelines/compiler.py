@@ -16,10 +16,25 @@ from typing import Any
 import yaml
 
 from kubeflow_tpu.pipelines.dsl import (
+    _OPS,
     Pipeline,
     PipelineParam,
     TaskOutput,
 )
+
+
+def _value_ref(value: Any) -> dict:
+    """Encode a const / TaskOutput / PipelineParam as an IR value binding."""
+    if isinstance(value, TaskOutput):
+        return {
+            "taskOutputParameter": {
+                "producerTask": value.producer,
+                "outputParameterKey": value.key,
+            }
+        }
+    if isinstance(value, PipelineParam):
+        return {"componentInputParameter": value.name}
+    return {"runtimeValue": {"constant": value}}
 
 SCHEMA_VERSION = "kubeflow-tpu.org/pipelinespec/v1"
 
@@ -81,19 +96,9 @@ def compile_pipeline(pipeline: Pipeline) -> dict:
             components[comp_key] = comp_def
             executors[exec_key] = exec_def
 
-        inputs: dict[str, Any] = {}
-        for pname, value in task.arguments.items():
-            if isinstance(value, TaskOutput):
-                inputs[pname] = {
-                    "taskOutputParameter": {
-                        "producerTask": value.producer,
-                        "outputParameterKey": value.key,
-                    }
-                }
-            elif isinstance(value, PipelineParam):
-                inputs[pname] = {"componentInputParameter": value.name}
-            else:
-                inputs[pname] = {"runtimeValue": {"constant": value}}
+        inputs: dict[str, Any] = {
+            pname: _value_ref(value) for pname, value in task.arguments.items()
+        }
         entry: dict[str, Any] = {
             "componentRef": {"name": comp_key},
             "inputs": {"parameters": inputs},
@@ -101,6 +106,19 @@ def compile_pipeline(pipeline: Pipeline) -> dict:
         deps = task.dependencies()
         if deps:
             entry["dependentTasks"] = deps
+        if task.conditions:
+            # kfp triggerPolicy.condition analogue, structured not stringly
+            entry["when"] = [
+                {"lhs": _value_ref(c.lhs), "op": c.op, "rhs": _value_ref(c.rhs)}
+                for c in task.conditions
+            ]
+        if task.iterate_over is not None:
+            items, item_arg = task.iterate_over
+            entry["iterator"] = {
+                "items": _value_ref(items), "itemInput": item_arg,
+            }
+        if task.is_exit_handler:
+            entry["exitHandler"] = True
         tasks[task.name] = entry
 
     ir: dict[str, Any] = {
@@ -166,7 +184,50 @@ def validate_ir(ir: dict) -> dict:
                         f"task {tname}: input {pname} references unknown "
                         f"producer {prod!r}"
                     )
-    # acyclicity
+        for cond in t.get("when", []):
+            if cond.get("op") not in _OPS:
+                raise ValueError(f"task {tname}: bad when operator {cond.get('op')!r}")
+            for side in ("lhs", "rhs"):
+                prod = cond.get(side, {}).get("taskOutputParameter", {}).get("producerTask")
+                if prod is not None and prod not in tasks:
+                    raise ValueError(
+                        f"task {tname}: when references unknown task {prod!r}"
+                    )
+        it = t.get("iterator")
+        if it is not None:
+            if "itemInput" not in it or "items" not in it:
+                raise ValueError(f"task {tname}: malformed iterator")
+            prod = it["items"].get("taskOutputParameter", {}).get("producerTask")
+            if prod is not None and prod not in tasks:
+                raise ValueError(
+                    f"task {tname}: iterator references unknown task {prod!r}"
+                )
+    def all_deps(t: dict) -> set:
+        """EVERY edge the runner follows: inputs, explicit deps, when
+        predicates (both sides), iterator items."""
+        deps = set(t.get("dependentTasks", []))
+        refs = list(t.get("inputs", {}).get("parameters", {}).values())
+        for cond in t.get("when", []):
+            refs += [cond.get("lhs", {}), cond.get("rhs", {})]
+        if t.get("iterator") is not None:
+            refs.append(t["iterator"].get("items", {}))
+        for v in refs:
+            if "taskOutputParameter" in v:
+                deps.add(v["taskOutputParameter"]["producerTask"])
+        return deps
+
+    # nothing may depend on an exit handler: the runner defers exit handlers
+    # to the end, so a dependent would read a PENDING (None) output
+    exit_tasks = {n for n, t in tasks.items() if t.get("exitHandler")}
+    for tname, t in tasks.items():
+        bad = all_deps(t) & exit_tasks
+        if bad and tname not in exit_tasks:
+            raise ValueError(
+                f"task {tname}: depends on exit handler(s) {sorted(bad)} "
+                f"(exit handlers run last; their outputs cannot feed the DAG)"
+            )
+
+    # acyclicity over the SAME edge set the runner's topo sort follows
     state: dict[str, int] = {}
 
     def visit(n: str) -> None:
@@ -175,12 +236,7 @@ def validate_ir(ir: dict) -> dict:
         if state.get(n) == 2:
             return
         state[n] = 1
-        t = tasks[n]
-        deps = set(t.get("dependentTasks", []))
-        for v in t.get("inputs", {}).get("parameters", {}).values():
-            if "taskOutputParameter" in v:
-                deps.add(v["taskOutputParameter"]["producerTask"])
-        for d in deps:
+        for d in all_deps(tasks[n]):
             visit(d)
         state[n] = 2
 

@@ -113,6 +113,13 @@ class Task:
     component: Component
     arguments: dict[str, Any]         # const | TaskOutput | PipelineParam
     explicit_deps: list[str] = field(default_factory=lambda: [])
+    # trigger conditions from enclosing `when(...)` blocks — ALL must hold
+    # or the task (and its dependents) is skipped at runtime
+    conditions: list["Condition"] = field(default_factory=lambda: [])
+    # for_each fan-out: (items value-or-placeholder, loop arg name)
+    iterate_over: tuple[Any, str] | None = None
+    # exit handlers run last, regardless of upstream failure/skip
+    is_exit_handler: bool = False
 
     @property
     def output(self) -> TaskOutput:
@@ -127,6 +134,12 @@ class Task:
         deps = {
             v.producer for v in self.arguments.values() if isinstance(v, TaskOutput)
         }
+        for c in self.conditions:
+            for side in (c.lhs, c.rhs):
+                if isinstance(side, TaskOutput):
+                    deps.add(side.producer)
+        if self.iterate_over is not None and isinstance(self.iterate_over[0], TaskOutput):
+            deps.add(self.iterate_over[0].producer)
         deps.update(self.explicit_deps)
         return sorted(deps)
 
@@ -147,6 +160,7 @@ class _PipelineContext:
     def __init__(self, name: str, description: str):
         self.pipeline = Pipeline(name, description, {}, {})
         self._counts: dict[str, int] = {}
+        self.cond_stack: list["Condition"] = []
 
     @classmethod
     def current(cls) -> "_PipelineContext | None":
@@ -163,9 +177,90 @@ class _PipelineContext:
         n = self._counts.get(comp.name, 0)
         self._counts[comp.name] = n + 1
         tname = comp.name if n == 0 else f"{comp.name}-{n + 1}"
-        task = Task(name=tname, component=comp, arguments=arguments)
+        task = Task(
+            name=tname, component=comp, arguments=arguments,
+            conditions=list(self.cond_stack),
+        )
         self.pipeline.tasks[tname] = task
         return task
+
+
+# ------------------------------------------------------- control flow (v2)
+
+
+@dataclass(frozen=True)
+class Condition:
+    """One `when` predicate: lhs <op> rhs. Either side may be a TaskOutput/
+    PipelineParam placeholder or a constant."""
+
+    lhs: Any
+    op: str       # == != < <= > >=
+    rhs: Any
+
+
+_OPS = {"==", "!=", "<", "<=", ">", ">="}
+
+
+class when:
+    """Conditional block (kfp dsl.If/Condition analogue):
+
+        with dsl.when(score.output, ">", 0.9):
+            deploy(...)
+
+    Every task created inside the block carries the predicate; at runtime a
+    false predicate skips the task and (transitively) its dependents.
+    Nested blocks AND their predicates."""
+
+    def __init__(self, lhs, op: str, rhs):
+        if op not in _OPS:
+            raise ValueError(f"when: unsupported operator {op!r} (use {_OPS})")
+        # both sides may be constants, task outputs, or pipeline params
+        self.cond = Condition(lhs=lhs, op=op, rhs=rhs)
+
+    def __enter__(self):
+        ctx = _PipelineContext.current()
+        if ctx is None:
+            raise RuntimeError("when(...) blocks only apply inside a @pipeline")
+        ctx.cond_stack.append(self.cond)
+        return self
+
+    def __exit__(self, *exc):
+        _PipelineContext.current().cond_stack.pop()
+
+
+def for_each(items, comp: Component, item_arg: str, **fixed) -> TaskOutput:
+    """Fan a component out over a list (kfp dsl.ParallelFor + Collected
+    analogue): `items` is a constant list OR an upstream list output; the
+    component runs once per item with `item_arg` bound to it, and the task's
+    output is the COLLECTED list of per-item outputs, in item order."""
+    ctx = _PipelineContext.current()
+    if ctx is None:
+        raise RuntimeError("for_each can only be used inside a @pipeline")
+    if item_arg not in comp.inputs:
+        raise ValueError(f"for_each: {comp.name} has no input {item_arg!r}")
+    unknown = set(fixed) - set(comp.inputs)
+    if unknown:
+        raise ValueError(f"for_each: {comp.name} has no input(s) {sorted(unknown)}")
+    if item_arg in fixed:
+        raise ValueError(f"for_each: {item_arg!r} is the loop variable, not a fixed arg")
+    task = ctx.add_task(comp, dict(fixed))
+    task.iterate_over = (items, item_arg)
+    return task.output
+
+
+def on_exit(out: TaskOutput) -> TaskOutput:
+    """Mark an already-declared task as an exit handler (kfp dsl.ExitHandler
+    analogue): it runs at the end of the run even when upstream tasks failed
+    or were skipped (its input placeholders resolve to None for non-run
+    producers). Its own failure still fails the run."""
+    ctx = _PipelineContext.current()
+    if ctx is None:
+        raise RuntimeError("on_exit can only be used inside a @pipeline")
+    task = ctx.pipeline.tasks.get(out.producer)
+    if task is None:
+        raise ValueError(f"on_exit: unknown task {out.producer!r}")
+    task.is_exit_handler = True
+    return out
 
 
 @dataclass

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import enum
 import hashlib
+import operator
 import json
 import re
 import subprocess
@@ -113,10 +114,22 @@ class LocalPipelineRunner:
                 props=json.dumps({"pipeline": run.pipeline_name}),
             )
 
-        for tname in self._topo_order(tasks):
+        order = self._topo_order(tasks)
+        # exit handlers run LAST regardless of upstream verdicts (kfp
+        # ExitHandler semantics); everything else keeps topo order
+        order = [t for t in order if not tasks[t].get("exitHandler")] + [
+            t for t in order if tasks[t].get("exitHandler")
+        ]
+        for tname in order:
             spec = tasks[tname]
             deps = self._deps_of(spec)
-            if any(run.tasks[d].state in (TaskState.FAILED, TaskState.SKIPPED) for d in deps):
+            if not spec.get("exitHandler") and any(
+                run.tasks[d].state in (TaskState.FAILED, TaskState.SKIPPED)
+                for d in deps
+            ):
+                run.tasks[tname].state = TaskState.SKIPPED
+                continue
+            if not self._conditions_hold(run, spec):
                 run.tasks[tname].state = TaskState.SKIPPED
                 continue
             self._run_task(ir, run, run_dir, tname, spec, run_exec_id)
@@ -150,10 +163,43 @@ class LocalPipelineRunner:
     @staticmethod
     def _deps_of(spec: dict) -> set[str]:
         deps = set(spec.get("dependentTasks", []))
-        for v in spec.get("inputs", {}).get("parameters", {}).values():
+        refs = list(spec.get("inputs", {}).get("parameters", {}).values())
+        for cond in spec.get("when", []):
+            refs.append(cond.get("lhs", {}))
+        it = spec.get("iterator")
+        if it is not None:
+            refs.append(it.get("items", {}))
+        for v in refs:
             if "taskOutputParameter" in v:
                 deps.add(v["taskOutputParameter"]["producerTask"])
         return deps
+
+    def _resolve_value(self, run: PipelineRun, ref: dict) -> Any:
+        if "runtimeValue" in ref:
+            return ref["runtimeValue"]["constant"]
+        if "componentInputParameter" in ref:
+            return run.arguments[ref["componentInputParameter"]]
+        if "taskOutputParameter" in ref:
+            # a producer that never ran (exit-handler path) resolves to None
+            return run.tasks[ref["taskOutputParameter"]["producerTask"]].output
+        raise ValueError(f"unresolvable value ref {ref!r}")
+
+    _CMP = {
+        "==": operator.eq, "!=": operator.ne, "<": operator.lt,
+        "<=": operator.le, ">": operator.gt, ">=": operator.ge,
+    }
+
+    def _conditions_hold(self, run: PipelineRun, spec: dict) -> bool:
+        for cond in spec.get("when", []):
+            lhs = self._resolve_value(run, cond["lhs"])
+            rhs = self._resolve_value(run, cond["rhs"])
+            try:
+                if not self._CMP[cond["op"]](lhs, rhs):
+                    return False
+            except TypeError:
+                # incomparable types (e.g. None from a skipped producer)
+                return False
+        return True
 
     def _topo_order(self, tasks: dict) -> list[str]:
         order: list[str] = []
@@ -172,15 +218,10 @@ class LocalPipelineRunner:
         return order
 
     def _resolve_inputs(self, run: PipelineRun, spec: dict) -> dict[str, Any]:
-        out = {}
-        for pname, v in spec.get("inputs", {}).get("parameters", {}).items():
-            if "runtimeValue" in v:
-                out[pname] = v["runtimeValue"]["constant"]
-            elif "componentInputParameter" in v:
-                out[pname] = run.arguments[v["componentInputParameter"]]
-            elif "taskOutputParameter" in v:
-                out[pname] = run.tasks[v["taskOutputParameter"]["producerTask"]].output
-        return out
+        return {
+            pname: self._resolve_value(run, v)
+            for pname, v in spec.get("inputs", {}).get("parameters", {}).items()
+        }
 
     def _run_task(self, ir: dict, run: PipelineRun, run_dir: Path, tname: str,
                   spec: dict, run_exec_id: int | None) -> None:
@@ -188,6 +229,11 @@ class LocalPipelineRunner:
         comp = ir["components"][spec["componentRef"]["name"]]
         executor = ir["deploymentSpec"]["executors"][comp["executorLabel"]]
         inputs = self._resolve_inputs(run, spec)
+        if spec.get("iterator") is not None and "pythonFunction" not in executor:
+            result.state = TaskState.FAILED
+            result.error = "iterator tasks require a pythonFunction executor"
+            self._record_lineage(run, tname, inputs, result, run_exec_id)
+            return
         if "trainJob" in executor:
             self._run_train_job_task(run, run_dir, tname, executor, inputs,
                                      run_exec_id)
@@ -196,14 +242,34 @@ class LocalPipelineRunner:
             self._run_sweep_task(run, run_dir, tname, executor, inputs,
                                  run_exec_id)
             return
+        it = spec.get("iterator")
+        items = None
+        if it is not None:
+            items = self._resolve_value(run, it["items"])
+            if isinstance(items, str):
+                try:
+                    items = json.loads(items)
+                except json.JSONDecodeError:
+                    pass  # falls into the not-a-list task failure below
+            if not isinstance(items, list):
+                result.state = TaskState.FAILED
+                result.error = f"iterator items is {type(items).__name__}, not a list"
+                self._record_lineage(run, tname, inputs, result, run_exec_id)
+                return
+
         source = executor["pythonFunction"]["source"]
         fn_name = executor["pythonFunction"]["functionName"]
 
         # cache key: exact executor source + resolved inputs (KFP cache
-        # fingerprint parity: component + args hash)
+        # fingerprint parity: component + args hash); iterator runs key on
+        # the resolved item list too
+        fp_fields = {"src": source, "fn": fn_name, "in": inputs}
+        if it is not None:
+            # iterator-only field: keeps pre-existing non-iterator cache
+            # entries (keyed without "items") valid
+            fp_fields["items"] = items
         fp = hashlib.sha256(
-            json.dumps({"src": source, "fn": fn_name, "in": inputs},
-                       sort_keys=True).encode()
+            json.dumps(fp_fields, sort_keys=True).encode()
         ).hexdigest()
         result.fingerprint = fp
         cache_file = self.cache_dir / f"{fp}.json"
@@ -213,7 +279,45 @@ class LocalPipelineRunner:
             self._record_lineage(run, tname, inputs, result, run_exec_id, cached=True)
             return
 
-        task_dir = run_dir / tname
+        t0 = time.monotonic()
+        result.state = TaskState.RUNNING
+        if it is None:
+            ok, out, err = self._exec_python_once(
+                run_dir / tname, source, fn_name, inputs
+            )
+        else:
+            # fan out over items (per-item subdir); output = collected list
+            outs = []
+            ok, err = True, ""
+            for idx, item in enumerate(items):
+                sub = dict(inputs)
+                sub[it["itemInput"]] = item
+                ok, out_i, err = self._exec_python_once(
+                    run_dir / tname / f"it-{idx}", source, fn_name, sub
+                )
+                if not ok:
+                    err = f"item {idx}: {err}"
+                    break
+                outs.append(out_i)
+            out = outs
+        result.duration_s = time.monotonic() - t0
+        if not ok:
+            result.state = TaskState.FAILED
+            result.error = err
+            self._record_lineage(run, tname, inputs, result, run_exec_id)
+            return
+        result.output = out
+        result.state = TaskState.SUCCEEDED
+        if self.cache_enabled:
+            self.cache_dir.mkdir(parents=True, exist_ok=True)
+            cache_file.write_text(json.dumps({"output": result.output}))
+        self._record_lineage(run, tname, inputs, result, run_exec_id)
+
+    def _exec_python_once(
+        self, task_dir: Path, source: str, fn_name: str, inputs: dict
+    ) -> tuple[bool, Any, str]:
+        """One subprocess execution of a python-function executor (the v2
+        driver/launcher analogue). Returns (ok, output, error)."""
         task_dir.mkdir(parents=True, exist_ok=True)
         (task_dir / "inputs.json").write_text(json.dumps(inputs))
         script = task_dir / "executor.py"
@@ -229,30 +333,20 @@ class LocalPipelineRunner:
                 """
             )
         )
-        t0 = time.monotonic()
-        result.state = TaskState.RUNNING
         proc = subprocess.run(
             [sys.executable, str(script), str(task_dir / "inputs.json"),
              str(task_dir / "output.json")],
             capture_output=True,
             text=True,
         )
-        result.duration_s = time.monotonic() - t0
         (task_dir / "log.txt").write_text(proc.stdout + proc.stderr)
         if proc.returncode != 0:
-            result.state = TaskState.FAILED
-            result.error = (proc.stderr or proc.stdout).strip()[-2000:]
-            self._record_lineage(run, tname, inputs, result, run_exec_id)
-            return
+            return False, None, (proc.stderr or proc.stdout).strip()[-2000:]
         out_file = task_dir / "output.json"
-        result.output = (
+        out = (
             json.loads(out_file.read_text())["output"] if out_file.exists() else None
         )
-        result.state = TaskState.SUCCEEDED
-        if self.cache_enabled:
-            self.cache_dir.mkdir(parents=True, exist_ok=True)
-            cache_file.write_text(json.dumps({"output": result.output}))
-        self._record_lineage(run, tname, inputs, result, run_exec_id)
+        return True, out, ""
 
     def _run_train_job_task(self, run: PipelineRun, run_dir: Path, tname: str,
                             executor: dict, inputs: dict,

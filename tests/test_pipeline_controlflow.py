@@ -1,0 +1,213 @@
+"""KFP-v2 control flow: when-conditions, for_each fan-out, exit handlers.
+
+Reference parity: kfp dsl.If/Condition, dsl.ParallelFor + Collected, and
+dsl.ExitHandler (SURVEY.md §2.6 DSL row). Compile -> validate -> run on the
+local runner, asserting both the IR shape and the runtime semantics.
+"""
+
+import pytest
+
+from kubeflow_tpu.pipelines import dsl
+from kubeflow_tpu.pipelines.compiler import compile_pipeline, validate_ir
+from kubeflow_tpu.pipelines.runner import LocalPipelineRunner, TaskState
+
+
+@dsl.component
+def score(x: int) -> int:
+    return x * 10
+
+
+@dsl.component
+def deploy(tag: str) -> str:
+    return f"deployed-{tag}"
+
+
+@dsl.component
+def square(v: int) -> int:
+    return v * v
+
+
+@dsl.component
+def total(values: list) -> int:
+    return sum(values)
+
+
+@dsl.component
+def cleanup(note: str) -> str:
+    return f"cleaned-{note}"
+
+
+@dsl.component
+def boom():
+    raise RuntimeError("kaboom")
+
+
+def _run(pipe, runner_dir, **args):
+    ir = validate_ir(compile_pipeline(pipe))
+    return LocalPipelineRunner(work_dir=str(runner_dir), cache=False).run(
+        ir, args or None
+    )
+
+
+class TestWhen:
+    def _pipe(self, threshold: int):
+        @dsl.pipeline(name="cond")
+        def p(x: int = 1):
+            s = score(x=x)
+            with dsl.when(s, ">", threshold):
+                deploy(tag="prod")
+            return s
+
+        return p()
+
+    def test_true_branch_runs(self, tmp_path):
+        run = _run(self._pipe(5), tmp_path, x=1)  # score=10 > 5
+        assert run.succeeded
+        assert run.tasks["deploy"].state == TaskState.SUCCEEDED
+        assert run.tasks["deploy"].output == "deployed-prod"
+
+    def test_false_branch_skips_and_cascades(self, tmp_path):
+        @dsl.pipeline(name="cond2")
+        def p(x: int = 1):
+            s = score(x=x)
+            with dsl.when(s, ">", 1000):
+                d = deploy(tag="prod")
+                # downstream of a conditional task skips transitively
+                cleanup(note=d)
+            return s
+
+        run = _run(p(), tmp_path, x=1)
+        assert run.succeeded  # skip is not failure
+        assert run.tasks["deploy"].state == TaskState.SKIPPED
+        assert run.tasks["cleanup"].state == TaskState.SKIPPED
+
+    def test_condition_in_ir(self):
+        ir = compile_pipeline(self._pipe(5))
+        entry = ir["root"]["dag"]["tasks"]["deploy"]
+        assert entry["when"][0]["op"] == ">"
+        assert entry["when"][0]["rhs"] == {"runtimeValue": {"constant": 5}}
+        # the condition's producer is a dependency
+        assert "score" in entry["dependentTasks"]
+
+
+class TestForEach:
+    def test_static_list_fan_out_and_collect(self, tmp_path):
+        @dsl.pipeline(name="fan")
+        def p():
+            outs = dsl.for_each([1, 2, 3], square, "v")
+            return total(values=outs)
+
+        run = _run(p(), tmp_path)
+        assert run.succeeded
+        assert run.tasks["square"].output == [1, 4, 9]
+        assert run.output == 14
+
+    def test_runtime_list_from_upstream(self, tmp_path):
+        @dsl.component
+        def make_items(n: int) -> list:
+            return list(range(n))
+
+        @dsl.pipeline(name="fan2")
+        def p(n: int = 4):
+            items = make_items(n=n)
+            outs = dsl.for_each(items, square, "v")
+            return total(values=outs)
+
+        run = _run(p(), tmp_path, n=4)
+        assert run.succeeded
+        assert run.output == 0 + 1 + 4 + 9
+
+    def test_item_failure_fails_task(self, tmp_path):
+        @dsl.component
+        def invert(v: int) -> float:
+            return 1.0 / v
+
+        @dsl.pipeline(name="fan3")
+        def p():
+            return total(values=dsl.for_each([1, 0], invert, "v"))
+
+        run = _run(p(), tmp_path)
+        assert not run.succeeded
+        assert run.tasks["invert"].state == TaskState.FAILED
+        assert "item 1" in run.tasks["invert"].error
+        assert run.tasks["total"].state == TaskState.SKIPPED
+
+
+class TestExitHandler:
+    def test_runs_after_failure(self, tmp_path):
+        @dsl.pipeline(name="exit")
+        def p():
+            b = boom()
+            d = deploy(tag="never")  # depends on boom -> skipped
+            d2 = cleanup(note="final")
+            dsl.on_exit(d2)
+            _ = d
+
+        # deploy must depend on boom for the skip to be observable
+        pipe = p()
+        pipe.tasks["deploy"].after(pipe.tasks["boom"])
+        run = _run(pipe, tmp_path)
+        assert not run.succeeded  # boom failed
+        assert run.tasks["boom"].state == TaskState.FAILED
+        assert run.tasks["deploy"].state == TaskState.SKIPPED
+        # ...but the exit handler still ran
+        assert run.tasks["cleanup"].state == TaskState.SUCCEEDED
+        assert run.tasks["cleanup"].output == "cleaned-final"
+
+    def test_exit_handler_failure_fails_run(self, tmp_path):
+        @dsl.pipeline(name="exit2")
+        def p():
+            score(x=1)
+            dsl.on_exit(boom())
+
+        run = _run(p(), tmp_path)
+        assert not run.succeeded
+        assert run.tasks["score"].state == TaskState.SUCCEEDED
+        assert run.tasks["boom"].state == TaskState.FAILED
+
+
+class TestControlFlowValidation:
+    def test_dynamic_rhs_condition(self, tmp_path):
+        """Both when() sides may be task outputs."""
+        @dsl.pipeline(name="dyn")
+        def p():
+            a = score(x=1)    # 10
+            b = score(x=2)    # 20
+            with dsl.when(a, "<", b):
+                deploy(tag="winner")
+
+        run = _run(p(), tmp_path)
+        assert run.succeeded
+        assert run.tasks["deploy"].state == TaskState.SUCCEEDED
+
+    def test_depending_on_exit_handler_rejected(self):
+        @dsl.pipeline(name="badexit")
+        def p():
+            c = cleanup(note="x")
+            dsl.on_exit(c)
+            deploy(tag=c)  # consumes an exit handler's output
+
+        with pytest.raises(ValueError, match="exit handler"):
+            validate_ir(compile_pipeline(p()))
+
+    def test_non_json_iterator_string_fails_task_not_run(self, tmp_path):
+        @dsl.component
+        def bad_items() -> str:
+            return "a,b,c"  # not JSON
+
+        @dsl.pipeline(name="badfan")
+        def p():
+            return total(values=dsl.for_each(bad_items(), square, "v"))
+
+        run = _run(p(), tmp_path)
+        assert not run.succeeded
+        assert run.tasks["square"].state == TaskState.FAILED
+        assert "not a list" in run.tasks["square"].error
+
+    def test_for_each_unknown_fixed_arg_rejected(self):
+        @dsl.pipeline(name="badarg")
+        def p():
+            dsl.for_each([1], square, "v", nope=3)
+
+        with pytest.raises(ValueError, match="nope"):
+            p()
