@@ -12,6 +12,9 @@ from kubeflow_tpu.pipelines.compiler import (
 )
 from kubeflow_tpu.pipelines.dsl import (
     Component,
+    InputPath,
+    OutputPath,
+    artifact,
     Pipeline,
     PipelineParam,
     Task,
@@ -34,6 +37,9 @@ from kubeflow_tpu.pipelines.scheduled import RecurringRun, ScheduleManager
 
 __all__ = [
     "Component",
+    "InputPath",
+    "OutputPath",
+    "artifact",
     "LocalPipelineRunner",
     "Pipeline",
     "PipelineParam",

@@ -87,12 +87,18 @@ def compile_pipeline(pipeline: Pipeline) -> dict:
                     }
                 },
             }
+            out_defs: dict[str, Any] = {}
             if task.component.output_type is not None:
-                comp_def["outputDefinitions"] = {
-                    "parameters": {
-                        "Output": {"parameterType": task.component.output_type}
-                    }
+                out_defs["parameters"] = {
+                    "Output": {"parameterType": task.component.output_type}
                 }
+            if task.component.output_artifacts:
+                out_defs["artifacts"] = {
+                    a: {"artifactType": "system.Artifact"}
+                    for a in task.component.output_artifacts
+                }
+            if out_defs:
+                comp_def["outputDefinitions"] = out_defs
             components[comp_key] = comp_def
             executors[exec_key] = exec_def
 

@@ -30,6 +30,18 @@ _TYPE_MAP = {
 }
 
 
+class InputPath:
+    """Parameter annotation: the function receives a FILESYSTEM PATH to an
+    upstream task's artifact file (kfp dsl.InputPath analogue). Wire it with
+    `dsl.artifact(producer_output, "artifact_name")`."""
+
+
+class OutputPath:
+    """Parameter annotation: the runner injects a writable path; whatever
+    the function writes there becomes a named artifact of the task (kfp
+    dsl.OutputPath analogue). Callers never pass these parameters."""
+
+
 def _param_type(annotation) -> str:
     return _TYPE_MAP.get(annotation, "STRING")
 
@@ -44,6 +56,9 @@ class Component:
     inputs: dict[str, str]            # param name -> IR type
     defaults: dict[str, Any]
     output_type: str | None           # None = no return value
+    # OutputPath-annotated params: runner-injected writable paths whose
+    # files become named artifacts (never caller-supplied)
+    output_artifacts: list[str] = field(default_factory=lambda: [])
 
     def __call__(self, *args, **kwargs):
         ctx = _PipelineContext.current()
@@ -51,7 +66,14 @@ class Component:
             # outside a pipeline: behave as the plain function (unit tests)
             return self.fn(*args, **kwargs)
         bound = inspect.signature(self.fn).bind_partial(*args, **kwargs)
-        task = ctx.add_task(self, dict(bound.arguments))
+        args_dict = dict(bound.arguments)
+        supplied = set(args_dict) & set(self.output_artifacts)
+        if supplied:
+            raise ValueError(
+                f"{self.name}: OutputPath parameter(s) {sorted(supplied)} are "
+                f"runner-injected, not caller arguments"
+            )
+        task = ctx.add_task(self, args_dict)
         return task.output
 
 
@@ -60,9 +82,20 @@ def component(fn: Callable | None = None, *, name: str | None = None):
 
     def wrap(f: Callable) -> Component:
         sig = inspect.signature(f)
-        inputs, defaults = {}, {}
+        inputs, defaults, out_artifacts = {}, {}, []
         for pname, p in sig.parameters.items():
-            inputs[pname] = _param_type(p.annotation)
+            if p.annotation is OutputPath:
+                if pname == "Output":
+                    raise ValueError(
+                        "OutputPath parameter cannot be named 'Output' (the "
+                        "reserved return-value key)"
+                    )
+                out_artifacts.append(pname)
+                continue
+            inputs[pname] = (
+                "ARTIFACT_PATH" if p.annotation is InputPath
+                else _param_type(p.annotation)
+            )
             if p.default is not inspect.Parameter.empty:
                 defaults[pname] = p.default
         out_t = (
@@ -77,6 +110,7 @@ def component(fn: Callable | None = None, *, name: str | None = None):
             inputs=inputs,
             defaults=defaults,
             output_type=out_t,
+            output_artifacts=out_artifacts,
         )
 
     return wrap(fn) if fn is not None else wrap
@@ -246,6 +280,21 @@ def for_each(items, comp: Component, item_arg: str, **fixed) -> TaskOutput:
     task = ctx.add_task(comp, dict(fixed))
     task.iterate_over = (items, item_arg)
     return task.output
+
+
+def artifact(out: TaskOutput, name: str) -> TaskOutput:
+    """Reference a producer task's NAMED artifact (an OutputPath file) for a
+    downstream InputPath parameter: `consume(path=dsl.artifact(t, "model"))`.
+    Resolves at runtime to the artifact file's filesystem path."""
+    ctx = _PipelineContext.current()
+    if ctx is not None:
+        task = ctx.pipeline.tasks.get(out.producer)
+        if task is not None and name not in task.component.output_artifacts:
+            raise ValueError(
+                f"artifact: task {out.producer!r} has no OutputPath artifact "
+                f"{name!r} (has {task.component.output_artifacts})"
+            )
+    return TaskOutput(producer=out.producer, key=name)
 
 
 def on_exit(out: TaskOutput) -> TaskOutput:

@@ -16,6 +16,7 @@ import hashlib
 import operator
 import json
 import re
+import shutil
 import subprocess
 import sys
 import textwrap
@@ -42,6 +43,8 @@ class TaskState(str, enum.Enum):
 class TaskResult:
     state: TaskState = TaskState.PENDING
     output: Any = None
+    # named OutputPath artifacts: artifact name -> filesystem path
+    artifacts: dict[str, str] = field(default_factory=dict)
     error: str = ""
     fingerprint: str = ""
     duration_s: float = 0.0
@@ -140,7 +143,14 @@ class LocalPipelineRunner:
             run.state = TaskState.SUCCEEDED
             out_from = ir["root"].get("outputFrom")
             if out_from:
-                run.output = run.tasks[out_from["producerTask"]].output
+                run.output = self._resolve_value(run, {
+                    "taskOutputParameter": {
+                        "producerTask": out_from["producerTask"],
+                        "outputParameterKey": out_from.get(
+                            "outputParameterKey", "Output"
+                        ),
+                    }
+                })
         if self.ms is not None and run_exec_id is not None:
             self.ms.put_execution(
                 "pipeline_run", run_id,
@@ -181,7 +191,11 @@ class LocalPipelineRunner:
             return run.arguments[ref["componentInputParameter"]]
         if "taskOutputParameter" in ref:
             # a producer that never ran (exit-handler path) resolves to None
-            return run.tasks[ref["taskOutputParameter"]["producerTask"]].output
+            t = run.tasks[ref["taskOutputParameter"]["producerTask"]]
+            key = ref["taskOutputParameter"].get("outputParameterKey", "Output")
+            if key == "Output":
+                return t.output
+            return t.artifacts.get(key)  # named OutputPath artifact -> path
         raise ValueError(f"unresolvable value ref {ref!r}")
 
     _CMP = {
@@ -259,32 +273,71 @@ class LocalPipelineRunner:
 
         source = executor["pythonFunction"]["source"]
         fn_name = executor["pythonFunction"]["functionName"]
+        out_artifacts = sorted(
+            comp.get("outputDefinitions", {}).get("artifacts", {})
+        )
+        if out_artifacts and it is not None:
+            result.state = TaskState.FAILED
+            result.error = "iterator tasks cannot declare OutputPath artifacts"
+            self._record_lineage(run, tname, inputs, result, run_exec_id)
+            return
 
         # cache key: exact executor source + resolved inputs (KFP cache
         # fingerprint parity: component + args hash); iterator runs key on
-        # the resolved item list too
-        fp_fields = {"src": source, "fn": fn_name, "in": inputs}
+        # the resolved item list too. Artifact-path INPUTS are fingerprinted
+        # by file CONTENT, not path — paths embed run ids and would never hit.
+        fp_in = dict(inputs)
+        for pname, ptype in comp.get("inputDefinitions", {}).get(
+            "parameters", {}
+        ).items():
+            if ptype.get("parameterType") == "ARTIFACT_PATH" and pname in fp_in:
+                fp_in[pname] = self._content_digest(fp_in[pname])
+        fp_fields = {"src": source, "fn": fn_name, "in": fp_in}
         if it is not None:
             # iterator-only field: keeps pre-existing non-iterator cache
             # entries (keyed without "items") valid
             fp_fields["items"] = items
+        if out_artifacts:
+            fp_fields["artifacts"] = out_artifacts
         fp = hashlib.sha256(
             json.dumps(fp_fields, sort_keys=True).encode()
         ).hexdigest()
         result.fingerprint = fp
         cache_file = self.cache_dir / f"{fp}.json"
         if self.cache_enabled and cache_file.exists():
-            result.output = json.loads(cache_file.read_text())["output"]
-            result.state = TaskState.CACHED
-            self._record_lineage(run, tname, inputs, result, run_exec_id, cached=True)
-            return
+            cached = json.loads(cache_file.read_text())
+            arts = cached.get("artifacts", {})
+            # a pruned cache (json kept, artifact files gone) must MISS, not
+            # hand downstream tasks dangling paths
+            if all(Path(p).exists() for p in arts.values()):
+                result.output = cached["output"]
+                result.artifacts = arts
+                result.state = TaskState.CACHED
+                self._record_lineage(run, tname, inputs, result, run_exec_id,
+                                     cached=True)
+                return
 
         t0 = time.monotonic()
         result.state = TaskState.RUNNING
         if it is None:
+            exec_inputs = dict(inputs)
+            art_dir = run_dir / tname / "artifacts"
+            if out_artifacts:
+                art_dir.mkdir(parents=True, exist_ok=True)
+            for a in out_artifacts:
+                exec_inputs[a] = str(art_dir / a)
             ok, out, err = self._exec_python_once(
-                run_dir / tname, source, fn_name, inputs
+                run_dir / tname, source, fn_name, exec_inputs
             )
+            if ok:
+                missing = [
+                    a for a in out_artifacts if not (art_dir / a).exists()
+                ]
+                if missing:
+                    ok = False
+                    err = f"declared artifact(s) never written: {missing}"
+                else:
+                    result.artifacts = {a: str(art_dir / a) for a in out_artifacts}
         else:
             # fan out over items (per-item subdir); output = collected list
             outs = []
@@ -310,8 +363,26 @@ class LocalPipelineRunner:
         result.state = TaskState.SUCCEEDED
         if self.cache_enabled:
             self.cache_dir.mkdir(parents=True, exist_ok=True)
-            cache_file.write_text(json.dumps({"output": result.output}))
+            # artifact files are copied INTO the cache so a hit stays valid
+            # after its producing run directory is cleaned up
+            cached_arts = {}
+            for a, p in result.artifacts.items():
+                dst = self.cache_dir / f"{fp}-artifacts" / a
+                dst.parent.mkdir(parents=True, exist_ok=True)
+                shutil.copyfile(p, dst)  # constant memory (model-sized files)
+                cached_arts[a] = str(dst)
+            cache_file.write_text(json.dumps(
+                {"output": result.output, "artifacts": cached_arts}
+            ))
         self._record_lineage(run, tname, inputs, result, run_exec_id)
+
+    @staticmethod
+    def _content_digest(path: Any) -> str:
+        try:
+            with open(str(path), "rb") as f:
+                return "sha256:" + hashlib.file_digest(f, "sha256").hexdigest()
+        except OSError:
+            return f"missing:{path}"
 
     def _exec_python_once(
         self, task_dir: Path, source: str, fn_name: str, inputs: dict
@@ -322,7 +393,11 @@ class LocalPipelineRunner:
         (task_dir / "inputs.json").write_text(json.dumps(inputs))
         script = task_dir / "executor.py"
         script.write_text(
-            source
+            # lazy annotations: component sources may annotate params with
+            # dsl.InputPath/OutputPath, which don't exist in the executor
+            # interpreter — PEP 563 keeps them unevaluated strings
+            "from __future__ import annotations\n"
+            + source
             + textwrap.dedent(
                 f"""
                 if __name__ == "__main__":
@@ -539,3 +614,9 @@ class LocalPipelineRunner:
                 props=json.dumps({"value": result.output}),
             )
             self.ms.put_event(exec_id, art, MetadataStore.OUTPUT)
+            for aname, apath in result.artifacts.items():
+                fart = self.ms.put_artifact(
+                    "file", f"{run.run_id}/{tname}/out/{aname}",
+                    uri=apath,
+                )
+                self.ms.put_event(exec_id, fart, MetadataStore.OUTPUT)

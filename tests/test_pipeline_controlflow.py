@@ -211,3 +211,104 @@ class TestControlFlowValidation:
 
         with pytest.raises(ValueError, match="nope"):
             p()
+
+
+class TestArtifacts:
+    def test_output_path_to_input_path(self, tmp_path):
+        @dsl.component
+        def producer(text: str, out: dsl.OutputPath):
+            with open(out, "w") as f:
+                f.write(text.upper())
+
+        @dsl.component
+        def consumer(path: dsl.InputPath) -> str:
+            return open(path).read() + "!"
+
+        @dsl.pipeline(name="arts")
+        def p(msg: str = "hello"):
+            t = producer(text=msg)
+            return consumer(path=dsl.artifact(t, "out"))
+
+        run = _run(p(), tmp_path, msg="hi")
+        assert run.succeeded
+        assert run.output == "HI!"
+        assert "out" in run.tasks["producer"].artifacts
+
+    def test_artifact_cache_survives(self, tmp_path):
+        @dsl.component
+        def producer2(out: dsl.OutputPath):
+            with open(out, "w") as f:
+                f.write("cached-bytes")
+
+        @dsl.component
+        def consumer2(path: dsl.InputPath) -> str:
+            return open(path).read()
+
+        @dsl.pipeline(name="arts2")
+        def p():
+            return consumer2(path=dsl.artifact(producer2(), "out"))
+
+        ir = validate_ir(compile_pipeline(p()))
+        runner = LocalPipelineRunner(work_dir=str(tmp_path), cache=True)
+        r1 = runner.run(ir)
+        assert r1.succeeded and r1.output == "cached-bytes"
+        r2 = runner.run(ir)
+        assert r2.succeeded and r2.output == "cached-bytes"
+        assert r2.tasks["producer2"].state == TaskState.CACHED
+        assert r2.tasks["consumer2"].state == TaskState.CACHED
+
+    def test_missing_artifact_fails_task(self, tmp_path):
+        @dsl.component
+        def lazy(out: dsl.OutputPath):
+            pass  # never writes
+
+        @dsl.pipeline(name="arts3")
+        def p():
+            lazy()
+
+        run = _run(p(), tmp_path)
+        assert not run.succeeded
+        assert "never written" in run.tasks["lazy"].error
+
+    def test_caller_supplying_output_path_rejected(self):
+        @dsl.component
+        def producer3(out: dsl.OutputPath):
+            pass
+
+        @dsl.pipeline(name="arts4")
+        def p():
+            producer3(out="/tmp/nope")
+
+        with pytest.raises(ValueError, match="runner-injected"):
+            p()
+
+    def test_unknown_artifact_name_rejected(self):
+        @dsl.component
+        def producer4(out: dsl.OutputPath):
+            pass
+
+        @dsl.component
+        def consumer4(path: dsl.InputPath) -> str:
+            return "x"
+
+        @dsl.pipeline(name="arts5")
+        def p():
+            t = producer4()
+            consumer4(path=dsl.artifact(t, "wrong"))
+
+        with pytest.raises(ValueError, match="wrong"):
+            p()
+
+    def test_pipeline_returning_artifact(self, tmp_path):
+        @dsl.component
+        def writer(out: dsl.OutputPath):
+            with open(out, "w") as f:
+                f.write("payload")
+
+        @dsl.pipeline(name="arts6")
+        def p():
+            return dsl.artifact(writer(), "out")
+
+        run = _run(p(), tmp_path)
+        assert run.succeeded
+        assert run.output and open(run.output).read() == "payload"
