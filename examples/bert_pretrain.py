@@ -18,6 +18,8 @@ def main(argv: list[str] | None = None) -> float:
     p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
     p.add_argument("--size", default="base", choices=["tiny", "base"])
     p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--fused-steps", type=int, default=1,
+                   help="optimizer steps per jit dispatch (lax.scan chunks)")
     p.add_argument("--batch-size", type=int, default=16)
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--lr", type=float, default=1e-4)
@@ -70,6 +72,7 @@ def main(argv: list[str] | None = None) -> float:
     trainer = Trainer(
         BertForMaskedLM(cfg),
         TrainerConfig(
+            fused_steps=args.fused_steps,
             batch_size=args.batch_size,
             steps=args.steps,
             learning_rate=args.lr,

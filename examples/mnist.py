@@ -16,6 +16,8 @@ def main(argv: list[str] | None = None) -> float:
     p = argparse.ArgumentParser()
     p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
     p.add_argument("--epochs", type=int, default=100)
+    p.add_argument("--fused-steps", type=int, default=1,
+                   help="optimizer steps per jit dispatch (lax.scan chunks)")
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--lr", type=float, default=2e-3)
@@ -36,6 +38,7 @@ def main(argv: list[str] | None = None) -> float:
     trainer = Trainer(
         model,
         TrainerConfig(
+            fused_steps=args.fused_steps,
             batch_size=args.batch_size,
             epochs=args.epochs,
             steps=args.steps,

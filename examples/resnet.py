@@ -19,6 +19,8 @@ def main(argv: list[str] | None = None) -> float:
     p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
     p.add_argument("--variant", default="50", choices=["18", "34", "50", "101", "152"])
     p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--fused-steps", type=int, default=1,
+                   help="optimizer steps per jit dispatch (lax.scan chunks)")
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-classes", type=int, default=1000)
@@ -56,6 +58,7 @@ def main(argv: list[str] | None = None) -> float:
     trainer = Trainer(
         model,
         TrainerConfig(
+            fused_steps=args.fused_steps,
             batch_size=args.batch_size,
             steps=args.steps,
             learning_rate=args.lr,

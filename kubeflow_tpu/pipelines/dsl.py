@@ -73,6 +73,17 @@ class Component:
                 f"{self.name}: OutputPath parameter(s) {sorted(supplied)} are "
                 f"runner-injected, not caller arguments"
             )
+        for pname, v in args_dict.items():
+            if (
+                self.inputs.get(pname) == "ARTIFACT_PATH"
+                and isinstance(v, TaskOutput)
+                and v.key == "Output"
+            ):
+                raise ValueError(
+                    f"{self.name}: InputPath parameter {pname!r} got a task's "
+                    f"return value; wire an artifact with "
+                    f"dsl.artifact(task, \"name\")"
+                )
         task = ctx.add_task(self, args_dict)
         return task.output
 
@@ -277,6 +288,11 @@ def for_each(items, comp: Component, item_arg: str, **fixed) -> TaskOutput:
         raise ValueError(f"for_each: {comp.name} has no input(s) {sorted(unknown)}")
     if item_arg in fixed:
         raise ValueError(f"for_each: {item_arg!r} is the loop variable, not a fixed arg")
+    if comp.output_artifacts:
+        raise ValueError(
+            f"for_each: {comp.name} declares OutputPath artifact(s) "
+            f"{comp.output_artifacts}; iterator tasks cannot produce artifacts"
+        )
     task = ctx.add_task(comp, dict(fixed))
     task.iterate_over = (items, item_arg)
     return task.output

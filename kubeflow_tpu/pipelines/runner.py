@@ -15,6 +15,7 @@ import enum
 import hashlib
 import operator
 import json
+import os
 import re
 import shutil
 import subprocess
@@ -363,23 +364,48 @@ class LocalPipelineRunner:
         result.state = TaskState.SUCCEEDED
         if self.cache_enabled:
             self.cache_dir.mkdir(parents=True, exist_ok=True)
-            # artifact files are copied INTO the cache so a hit stays valid
-            # after its producing run directory is cleaned up
+            # Artifacts (files OR directories — the KFP model-dir pattern) are
+            # copied INTO the cache so a hit stays valid after its producing
+            # run directory is cleaned up. Staged + atomically renamed:
+            # concurrent runs of the same fingerprint must never interleave
+            # writes into the published path (first publisher wins).
             cached_arts = {}
-            for a, p in result.artifacts.items():
-                dst = self.cache_dir / f"{fp}-artifacts" / a
-                dst.parent.mkdir(parents=True, exist_ok=True)
-                shutil.copyfile(p, dst)  # constant memory (model-sized files)
-                cached_arts[a] = str(dst)
-            cache_file.write_text(json.dumps(
+            if result.artifacts:
+                final = self.cache_dir / f"{fp}-artifacts"
+                stage = self.cache_dir / f"{fp}-artifacts.stage-{os.getpid()}-{id(result)}"
+                for a, p in result.artifacts.items():
+                    dst = stage / a
+                    dst.parent.mkdir(parents=True, exist_ok=True)
+                    if Path(p).is_dir():
+                        shutil.copytree(p, dst)
+                    else:
+                        shutil.copyfile(p, dst)  # constant memory
+                try:
+                    os.rename(stage, final)
+                except OSError:
+                    shutil.rmtree(stage, ignore_errors=True)  # racer won
+                cached_arts = {a: str(final / a) for a in result.artifacts}
+            tmp = cache_file.with_suffix(".tmp")
+            tmp.write_text(json.dumps(
                 {"output": result.output, "artifacts": cached_arts}
             ))
+            os.replace(tmp, cache_file)  # atomic publish
         self._record_lineage(run, tname, inputs, result, run_exec_id)
 
     @staticmethod
     def _content_digest(path: Any) -> str:
+        """Constant-memory content hash of an artifact file OR directory
+        (relative names + per-file digests, sorted for determinism)."""
         try:
-            with open(str(path), "rb") as f:
+            p = Path(str(path))
+            if p.is_dir():
+                h = hashlib.sha256()
+                for f in sorted(q for q in p.rglob("*") if q.is_file()):
+                    h.update(str(f.relative_to(p)).encode())
+                    with open(f, "rb") as fh:
+                        h.update(hashlib.file_digest(fh, "sha256").digest())
+                return "sha256dir:" + h.hexdigest()
+            with open(p, "rb") as f:
                 return "sha256:" + hashlib.file_digest(f, "sha256").hexdigest()
         except OSError:
             return f"missing:{path}"

@@ -312,3 +312,44 @@ class TestArtifacts:
         run = _run(p(), tmp_path)
         assert run.succeeded
         assert run.output and open(run.output).read() == "payload"
+
+    def test_directory_artifact(self, tmp_path):
+        @dsl.component
+        def dir_producer(out: dsl.OutputPath):
+            import os
+            os.makedirs(out)
+            with open(os.path.join(out, "weights.txt"), "w") as f:
+                f.write("w1 w2")
+
+        @dsl.component
+        def dir_consumer(path: dsl.InputPath) -> str:
+            import os
+            return open(os.path.join(path, "weights.txt")).read()
+
+        @dsl.pipeline(name="arts7")
+        def p():
+            return dir_consumer(path=dsl.artifact(dir_producer(), "out"))
+
+        ir = validate_ir(compile_pipeline(p()))
+        runner = LocalPipelineRunner(work_dir=str(tmp_path), cache=True)
+        r1 = runner.run(ir)
+        assert r1.succeeded and r1.output == "w1 w2"
+        r2 = runner.run(ir)  # cached directory artifact round-trips
+        assert r2.succeeded and r2.output == "w1 w2"
+        assert r2.tasks["dir-producer"].state == TaskState.CACHED
+
+    def test_plain_output_into_input_path_rejected(self):
+        @dsl.component
+        def plain() -> str:
+            return "x"
+
+        @dsl.component
+        def consumer5(path: dsl.InputPath) -> str:
+            return "y"
+
+        @dsl.pipeline(name="arts8")
+        def p():
+            consumer5(path=plain())
+
+        with pytest.raises(ValueError, match="dsl.artifact"):
+            p()
