@@ -17,13 +17,18 @@ first-class construct, TPU-first:
     priority, dropped tokens pass through with zero combine weight (the
     residual connection carries them), Switch-style load-balance aux loss.
 
-Capacity is per expert-shard-group: C = ceil(k * tokens * cf / E) where
-`tokens` is the token count the expert group sees (global over the auto
-data/fsdp axes — slot assignment is a global cumsum, GShard-style).
+Capacity is LOCAL per (data, fsdp, expert) shard: C = ceil(k * t_local * cf
+/ E) where t_local is the shard's own token count. The dispatch shard_map is
+manual over the data-like axes too, so the slot-assignment cumsum never
+spans data shards — no collective scan inside the router (the Switch/
+DeepSpeed-EP local-dispatch recipe; the earlier GShard-style global cumsum
+ran a cross-shard scan per MoE layer). `global_dispatch=True` restores the
+old behavior (global capacity pool, cross-shard cumsum) for comparison.
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any
 
 import flax.linen as nn
@@ -33,6 +38,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_MODEL,
@@ -90,6 +96,11 @@ class MoeMlp(nn.Module):
     top_k: int = 2
     capacity_factor: float = 2.0
     dtype: Any = jnp.float32
+    # True restores the round-2-initial GShard-style dispatch: one capacity
+    # pool over the whole (data x fsdp x expert) batch, slot cumsum as a
+    # cross-shard collective scan. Default is local dispatch (see module
+    # docstring).
+    global_dispatch: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -107,6 +118,10 @@ class MoeMlp(nn.Module):
         ep = 1 if mesh.empty else mesh.shape.get(AXIS_EXPERT, 1)
         if e % ep:
             raise ValueError(f"num_experts {e} not divisible by expert axis {ep}")
+        # data-like extents: with local dispatch these axes join the manual
+        # region so the router's cumsum stays shard-local
+        dp = 1 if mesh.empty else mesh.shape.get(AXIS_DATA, 1)
+        fs = 1 if mesh.empty else mesh.shape.get(AXIS_FSDP, 1)
 
         def ffn(xin, wu, bu, wd, bd):
             """Per-expert FFN: xin (E?, C?, H) against stacked weights."""
@@ -115,8 +130,10 @@ class MoeMlp(nn.Module):
             y = jnp.einsum("ecf,efh->ech", y, wd.astype(xin.dtype))
             return y + bd.astype(xin.dtype)[:, None, :]
 
-        def moe_body(xb, rw, wu, bu, wd, bd):
-            """Manual over `expert` only: xb (B/ep, L, H), wu (E/ep, H, F)."""
+        def moe_body(xb, rw, wu, bu, wd, bd, manual_axes):
+            """xb (B_local, L, H), wu (E/ep, H, F). With local dispatch the
+            data axes are manual too, so `t` — and the capacity — are
+            per-shard and the cumsum in _route never crosses shards."""
             b, l, _ = xb.shape
             t = b * l
             cap = int(np.ceil(self.top_k * t * self.capacity_factor / e))
@@ -138,25 +155,41 @@ class MoeMlp(nn.Module):
                     out, AXIS_EXPERT, split_axis=1, concat_axis=0, tiled=True
                 )
             y = jnp.einsum("tec,ech->th", combine, out)
-            aux = jax.lax.pmean(aux, AXIS_EXPERT) if ep > 1 else aux
+            reduce_axes = tuple(a for a in manual_axes if mesh.shape.get(a, 1) > 1)
+            if reduce_axes:
+                aux = jax.lax.pmean(aux, reduce_axes)
             return y.reshape(b, l, h), aux
 
-        if mesh.empty or ep == 1:
-            y, aux = moe_body(x, router, w_up, b_up, w_down, b_down)
+        local = not self.global_dispatch
+        manual: tuple = ()
+        if not mesh.empty:
+            if local and (ep > 1 or dp > 1 or fs > 1):
+                manual = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
+                if x.shape[0] % (dp * fs * ep):
+                    # local dispatch needs the batch dim split across ALL
+                    # data-like axes; a batch that only divides the expert
+                    # extent keeps the old expert-only manual region (global
+                    # capacity pool) instead of failing deep inside shard_map
+                    manual = (AXIS_EXPERT,) if ep > 1 else ()
+            elif ep > 1:
+                manual = (AXIS_EXPERT,)
+        if not manual:
+            y, aux = moe_body(x, router, w_up, b_up, w_down, b_down, ())
         else:
+            batch_spec = P(tuple(manual), None, None)
             y, aux = jax.shard_map(
-                moe_body,
+                partial(moe_body, manual_axes=manual),
                 mesh=mesh,
-                axis_names={AXIS_EXPERT},
+                axis_names=set(manual),
                 in_specs=(
-                    P(AXIS_EXPERT, None, None),   # batch dim carries expert
+                    batch_spec,                   # batch dim carries the manual axes
                     P(None, None),                # router replicated
                     P(AXIS_EXPERT, None, None),
                     P(AXIS_EXPERT, None),
                     P(AXIS_EXPERT, None, None),
                     P(AXIS_EXPERT, None),
                 ),
-                out_specs=(P(AXIS_EXPERT, None, None), P()),
+                out_specs=(batch_spec, P()),
                 check_vma=False,
             )(x, router, w_up, b_up, w_down, b_down)
         self.sow("losses", "moe_aux", aux,

@@ -308,9 +308,20 @@ class LocalPipelineRunner:
         if self.cache_enabled and cache_file.exists():
             cached = json.loads(cache_file.read_text())
             arts = cached.get("artifacts", {})
-            # a pruned cache (json kept, artifact files gone) must MISS, not
-            # hand downstream tasks dangling paths
-            if all(Path(p).exists() for p in arts.values()):
+            # a pruned cache (json kept, artifact files gone — or files gone
+            # INSIDE a directory artifact) must MISS, not hand downstream
+            # tasks dangling paths; the manifest lists every file published
+            manifest = cached.get("artifact_files", {})
+            def _cache_intact() -> bool:
+                for a, p in arts.items():
+                    base = Path(p)
+                    if not base.exists():
+                        return False
+                    for rel in manifest.get(a, []):
+                        if not (base / rel).exists():
+                            return False
+                return True
+            if _cache_intact():
                 result.output = cached["output"]
                 result.artifacts = arts
                 result.state = TaskState.CACHED
@@ -385,9 +396,23 @@ class LocalPipelineRunner:
                 except OSError:
                     shutil.rmtree(stage, ignore_errors=True)  # racer won
                 cached_arts = {a: str(final / a) for a in result.artifacts}
-            tmp = cache_file.with_suffix(".tmp")
+            # per-artifact file manifests let a later hit verify directory
+            # artifacts are complete, not just present
+            art_files = {}
+            for a, p in cached_arts.items():
+                base = Path(p)
+                art_files[a] = (
+                    sorted(str(q.relative_to(base)) for q in base.rglob("*") if q.is_file())
+                    if base.is_dir() else []
+                )
+            # unique tmp per publisher: a shared name lets concurrent
+            # same-fingerprint runs truncate each other mid-publish
+            tmp = cache_file.with_name(
+                f"{cache_file.name}.tmp-{os.getpid()}-{id(result)}"
+            )
             tmp.write_text(json.dumps(
-                {"output": result.output, "artifacts": cached_arts}
+                {"output": result.output, "artifacts": cached_arts,
+                 "artifact_files": art_files}
             ))
             os.replace(tmp, cache_file)  # atomic publish
         self._record_lineage(run, tname, inputs, result, run_exec_id)

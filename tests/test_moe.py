@@ -104,6 +104,31 @@ class TestMoeMlp:
             np.asarray(sharded), np.asarray(dense), rtol=2e-4, atol=2e-4
         )
 
+    def test_local_and_global_dispatch_agree_at_ample_capacity(self, cpu_devices):
+        """Per-shard capacity (default) and the GShard-style global pool are
+        semantically identical when nothing drops; only the collective shape
+        differs (local keeps the routing cumsum shard-local)."""
+        mod_l, variables, x = _mk(batch=8, seq=4)
+        mod_g = MoeMlp(
+            hidden_size=mod_l.hidden_size, mlp_dim=mod_l.mlp_dim,
+            num_experts=mod_l.num_experts, top_k=mod_l.top_k,
+            capacity_factor=mod_l.capacity_factor, dtype=mod_l.dtype,
+            global_dispatch=True,
+        )
+        mesh = build_mesh(MeshConfig(data=2, fsdp=2, expert=2), cpu_devices[:8])
+        with jax.set_mesh(mesh):
+            xs = jax.device_put(
+                x,
+                jax.sharding.NamedSharding(
+                    mesh, P(("data", "fsdp", "expert"), None, None)
+                ),
+            )
+            y_local = jax.jit(mod_l.apply)(variables, xs)
+            y_global = jax.jit(mod_g.apply)(variables, xs)
+        np.testing.assert_allclose(
+            np.asarray(y_local), np.asarray(y_global), rtol=2e-4, atol=2e-4
+        )
+
     def test_aux_loss_sown(self):
         mod, variables, x = _mk()
         _, updates = mod.apply(variables, x, mutable=["losses"])
