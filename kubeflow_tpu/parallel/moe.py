@@ -8,11 +8,12 @@ first-class construct, TPU-first:
     layout): the batch is sharded over (data, fsdp, expert) and expert
     weights over `expert`, so the token exchange is a true all-to-all that
     rides ICI inside the expert group.
-  - The dispatch is a *partial-manual* shard_map over ONLY the `expert`
-    axis: `lax.all_to_all` is explicit (the one collective that matters),
-    while fsdp/model/context shardings inside the body stay automatic —
-    XLA still inserts the FSDP all-gathers and TP psums for the expert
-    matmuls. Scaling-book recipe, not hand-scheduled comms.
+  - The dispatch is a *partial-manual* shard_map over the data-like axes
+    (data, fsdp, expert): `lax.all_to_all` is explicit (the one collective
+    that matters) and routing is shard-local, while model/context shardings
+    inside the body stay automatic — XLA still inserts the TP psums for the
+    expert matmuls. (With `global_dispatch=True` only `expert` is manual
+    and fsdp stays auto inside the body.)
   - Top-k softmax router (f32), capacity-factor slotting via cumsum
     priority, dropped tokens pass through with zero combine weight (the
     residual connection carries them), Switch-style load-balance aux loss.
@@ -170,6 +171,16 @@ class MoeMlp(nn.Module):
                     # data-like axes; a batch that only divides the expert
                     # extent keeps the old expert-only manual region (global
                     # capacity pool) instead of failing deep inside shard_map
+                    import warnings
+
+                    warnings.warn(
+                        f"MoeMlp: batch {x.shape[0]} not divisible by the "
+                        f"data-like mesh extent {dp * fs * ep}; falling back "
+                        f"to GLOBAL dispatch (cross-shard routing cumsum, "
+                        f"global capacity pool) — pad the batch for local "
+                        f"dispatch",
+                        stacklevel=2,
+                    )
                     manual = (AXIS_EXPERT,) if ep > 1 else ()
             elif ep > 1:
                 manual = (AXIS_EXPERT,)

@@ -406,7 +406,14 @@ class LocalPipelineRunner:
                     if base.is_dir() else []
                 )
             # unique tmp per publisher: a shared name lets concurrent
-            # same-fingerprint runs truncate each other mid-publish
+            # same-fingerprint runs truncate each other mid-publish. Stray
+            # tmps from crashed publishers are reaped here (best effort) so
+            # the cache dir can't accumulate orphans forever.
+            for stray in self.cache_dir.glob(f"{cache_file.name}.tmp-*"):
+                try:
+                    stray.unlink()
+                except OSError:
+                    pass
             tmp = cache_file.with_name(
                 f"{cache_file.name}.tmp-{os.getpid()}-{id(result)}"
             )

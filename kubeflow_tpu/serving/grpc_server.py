@@ -1,0 +1,206 @@
+"""gRPC v2 Open Inference Protocol — the kserve GRPCInferenceService shape.
+
+Reference parity (SURVEY.md §2.5 model-server row): kserve's ModelServer
+serves v2 over BOTH REST and gRPC (python/kserve/kserve/protocol/grpc).
+Here the gRPC surface wraps the SAME ModelServer instance the HTTP handler
+uses — one model registry, one micro-batcher, one request logger — so the
+two protocols can never disagree about readiness or model state.
+
+Wire details follow the public OIP gRPC contract: typed flat contents
+(fp32_contents etc.) row-major over `shape`; service/method names match
+kserve/triton so a generic OIP gRPC client interoperates. Wiring uses
+`method_handlers_generic_handler` like sweep/rpc.py (no grpc_tools codegen
+plugin in this image).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from concurrent import futures
+
+import grpc
+import numpy as np
+
+from kubeflow_tpu.protos import inference_pb2 as pb
+
+INFERENCE_SERVICE = "kubeflow_tpu.inference.GRPCInferenceService"
+
+# OIP datatype <-> numpy + the typed contents field carrying it
+_DT = {
+    "BOOL": (np.bool_, "bool_contents"),
+    "INT32": (np.int32, "int_contents"),
+    "INT64": (np.int64, "int64_contents"),
+    "UINT32": (np.uint32, "uint_contents"),
+    "FP32": (np.float32, "fp32_contents"),
+    "FP64": (np.float64, "fp64_contents"),
+}
+_NP_TO_DT = {np.dtype(v[0]): k for k, v in _DT.items()}
+
+
+def _to_array(t: pb.InferInputTensor) -> np.ndarray:
+    dt, field = _DT[t.datatype]  # caller validates membership first
+    data = getattr(t.contents, field)
+    return np.asarray(data, dtype=dt).reshape(tuple(t.shape))
+
+
+def _to_tensor(name: str, arr: np.ndarray) -> pb.InferOutputTensor:
+    arr = np.asarray(arr)
+    dtype = _NP_TO_DT.get(arr.dtype)
+    if dtype is None:  # bf16 / f16 and friends travel as FP32
+        arr = arr.astype(np.float32)
+        dtype = "FP32"
+    out = pb.InferOutputTensor(name=name, datatype=dtype, shape=list(arr.shape))
+    getattr(out.contents, _DT[dtype][1]).extend(arr.ravel().tolist())
+    return out
+
+
+class InferenceGrpcService:
+    """The five OIP rpcs over a live ModelServer's registry."""
+
+    def __init__(self, model_server):
+        self.ms = model_server
+
+    def ServerLive(self, req, ctx):
+        return pb.ServerLiveResponse(live=True)
+
+    def ServerReady(self, req, ctx):
+        models = self.ms.models
+        ready = bool(models) and all(m.ready for m in models.values())
+        return pb.ServerReadyResponse(ready=ready)
+
+    def ModelReady(self, req, ctx):
+        m = self.ms.models.get(req.name)
+        if m is None:
+            ctx.abort(grpc.StatusCode.NOT_FOUND, f"model {req.name!r} not found")
+        return pb.ModelReadyResponse(ready=m.ready)
+
+    def ModelMetadata(self, req, ctx):
+        m = self.ms.models.get(req.name)
+        if m is None:
+            ctx.abort(grpc.StatusCode.NOT_FOUND, f"model {req.name!r} not found")
+        resp = pb.ModelMetadataResponse(
+            name=req.name, versions=["1"], platform="jax-xla"
+        )
+        im = self.ms.input_metadata(m)  # shared with HTTP v2
+        if im is not None:
+            resp.inputs.append(pb.TensorMetadata(
+                name=im["name"], datatype=im["datatype"], shape=im["shape"]
+            ))
+        return resp
+
+    def ModelInfer(self, req, ctx):
+        name = req.model_name
+        m = self.ms.models.get(name)
+        if m is None:
+            ctx.abort(grpc.StatusCode.NOT_FOUND, f"model {name!r} not found")
+        if not m.ready:
+            ctx.abort(grpc.StatusCode.UNAVAILABLE, f"model {name!r} not ready")
+        if not req.inputs:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "request carries no inputs")
+        if req.inputs[0].datatype not in _DT:
+            ctx.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unsupported datatype {req.inputs[0].datatype!r} "
+                f"(supported: {sorted(_DT)})",
+            )
+        t0 = _time.perf_counter()
+        try:
+            arr = _to_array(req.inputs[0])
+            out = self.ms._call_model(m, arr)
+        except Exception as exc:  # noqa: BLE001 — surface as INTERNAL, not a crash
+            self.ms.logger.log(name, "v2-grpc", 500,
+                               _time.perf_counter() - t0, req.ByteSize(), 0)
+            ctx.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+        arrays = self.ms.postprocess_arrays(out)  # shared with HTTP v2
+        resp = pb.ModelInferResponse(
+            model_name=name, model_version="1", id=req.id,
+            outputs=[_to_tensor(k, v) for k, v in arrays],
+        )
+        self.ms.logger.log(
+            name, "v2-grpc", 200, _time.perf_counter() - t0,
+            req.ByteSize(), resp.ByteSize(),
+        )
+        return resp
+
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+
+
+def serve_grpc(model_server, port: int = 0, host: str = "127.0.0.1",
+               max_workers: int = 4):
+    """Attach the gRPC OIP surface to a ModelServer; returns (server, addr)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    svc = InferenceGrpcService(model_server)
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(INFERENCE_SERVICE, {
+            "ServerLive": _unary(svc.ServerLive, pb.ServerLiveRequest),
+            "ServerReady": _unary(svc.ServerReady, pb.ServerReadyRequest),
+            "ModelReady": _unary(svc.ModelReady, pb.ModelReadyRequest),
+            "ModelMetadata": _unary(svc.ModelMetadata, pb.ModelMetadataRequest),
+            "ModelInfer": _unary(svc.ModelInfer, pb.ModelInferRequest),
+        }),
+    ))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server, f"{host}:{bound}"
+
+
+class InferenceGrpcClient:
+    """Minimal typed OIP gRPC client (numpy in/out)."""
+
+    def __init__(self, address: str):
+        self._chan = grpc.insecure_channel(address)
+
+        def rpc(method, req_cls, resp_cls):
+            return self._chan.unary_unary(
+                f"/{INFERENCE_SERVICE}/{method}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+
+        self._live = rpc("ServerLive", pb.ServerLiveRequest, pb.ServerLiveResponse)
+        self._ready = rpc("ServerReady", pb.ServerReadyRequest, pb.ServerReadyResponse)
+        self._mready = rpc("ModelReady", pb.ModelReadyRequest, pb.ModelReadyResponse)
+        self._meta = rpc("ModelMetadata", pb.ModelMetadataRequest,
+                         pb.ModelMetadataResponse)
+        self._infer = rpc("ModelInfer", pb.ModelInferRequest, pb.ModelInferResponse)
+
+    def server_live(self) -> bool:
+        return self._live(pb.ServerLiveRequest()).live
+
+    def server_ready(self) -> bool:
+        return self._ready(pb.ServerReadyRequest()).ready
+
+    def model_ready(self, name: str) -> bool:
+        return self._mready(pb.ModelReadyRequest(name=name)).ready
+
+    def model_metadata(self, name: str) -> pb.ModelMetadataResponse:
+        return self._meta(pb.ModelMetadataRequest(name=name))
+
+    def infer(self, name: str, arr: np.ndarray, request_id: str = "") -> dict[str, np.ndarray]:
+        arr = np.asarray(arr)
+        dtype = _NP_TO_DT.get(arr.dtype)
+        if dtype is None:
+            arr = arr.astype(np.float32)
+            dtype = "FP32"
+        t = pb.InferInputTensor(name="input-0", datatype=dtype,
+                                shape=list(arr.shape))
+        getattr(t.contents, _DT[dtype][1]).extend(arr.ravel().tolist())
+        resp = self._infer(pb.ModelInferRequest(
+            model_name=name, id=request_id, inputs=[t]
+        ))
+        out = {}
+        for o in resp.outputs:
+            dt, field = _DT[o.datatype]
+            out[o.name] = np.asarray(
+                getattr(o.contents, field), dtype=dt
+            ).reshape(tuple(o.shape))
+        return out
+
+    def close(self) -> None:
+        self._chan.close()

@@ -162,13 +162,9 @@ class ModelServer:
             if m is None:
                 return 404, {"error": f"model {name!r} not found"}
             meta = {"name": name, "platform": "jax-xla", "versions": ["1"]}
-            cfg = getattr(m, "config", None)
-            if cfg:
-                meta["inputs"] = [{
-                    "name": "input-0",
-                    "datatype": _NP_TO_V2.get(np.dtype(cfg["input_dtype"]), "FP32"),
-                    "shape": [-1, *cfg["input_shape"][1:]],
-                }]
+            im = self.input_metadata(m)
+            if im is not None:
+                meta["inputs"] = [im]
             return 200, meta
         if path.startswith("/v1/models/"):
             name = path[len("/v1/models/"):]
@@ -249,6 +245,29 @@ class ModelServer:
         self.register(model)
         return 200, {"name": name, "state": "READY"}
 
+    @staticmethod
+    def postprocess_arrays(out) -> list[tuple[str, np.ndarray]]:
+        """Normalize a model's output into named v2 tensors — the ONE place
+        both the HTTP and gRPC v2 surfaces get their output contract from."""
+        if isinstance(out, dict):  # classification postprocess contract
+            return [
+                ("predictions", np.asarray(out["predictions"])),
+                ("logits", np.asarray(out.get("logits", []), dtype=np.float32)),
+            ]
+        return [("output-0", np.asarray(out))]
+
+    @staticmethod
+    def input_metadata(m: Model) -> dict | None:
+        """v2 metadata for a model's input tensor (shared HTTP/gRPC)."""
+        cfg = getattr(m, "config", None)
+        if not cfg:
+            return None
+        return {
+            "name": "input-0",
+            "datatype": _NP_TO_V2.get(np.dtype(cfg["input_dtype"]), "FP32"),
+            "shape": [-1, *cfg["input_shape"][1:]],
+        }
+
     def _get_ready_model(self, name: str) -> Model | tuple[int, dict]:
         m = self.models.get(name)
         if m is None:
@@ -306,13 +325,7 @@ class ModelServer:
             out = self._call_model(m, arr)
         except Exception as exc:  # noqa: BLE001
             return 500, {"error": f"{type(exc).__name__}: {exc}"}
-        if isinstance(out, dict):  # classification postprocess contract
-            arrays = [
-                ("predictions", np.asarray(out["predictions"])),
-                ("logits", np.asarray(out.get("logits", []), dtype=np.float32)),
-            ]
-        else:
-            arrays = [("output-0", np.asarray(out))]
+        arrays = self.postprocess_arrays(out)
         return 200, {
             "model_name": name,
             "model_version": "1",
@@ -393,6 +406,8 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--batch-max-latency-ms", type=float, default=5.0)
     ap.add_argument("--repository-dir", default="",
                     help="multi-model repository root for /v2/repository API")
+    ap.add_argument("--grpc-port", type=int, default=-1,
+                    help=">=0 also serves the v2 OIP over gRPC (0 = ephemeral)")
     args = ap.parse_args(argv)
 
     if args.device:
@@ -436,7 +451,14 @@ def main(argv: list[str] | None = None) -> None:
         repository_dir=args.repository_dir,
     )
     srv.start(block=False)
-    print(f"server ready url={srv.url} model={args.model_name}", flush=True)
+    grpc_note = ""
+    if args.grpc_port >= 0:
+        from kubeflow_tpu.serving.grpc_server import serve_grpc
+
+        _, grpc_addr = serve_grpc(srv, port=args.grpc_port, host=args.host)
+        grpc_note = f" grpc={grpc_addr}"
+    print(f"server ready url={srv.url} model={args.model_name}{grpc_note}",
+          flush=True)
     threading.Event().wait()  # serve until killed
 
 

@@ -1,0 +1,85 @@
+"""gRPC v2 Open Inference Protocol (serving/grpc_server.py).
+
+Reference parity: kserve serves v2 over REST AND gRPC from one model
+server (SURVEY.md §2.5). The gRPC surface wraps the same ModelServer the
+HTTP tests exercise, so these tests assert protocol-level agreement too.
+"""
+
+import grpc
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.grpc_server import InferenceGrpcClient, serve_grpc
+from kubeflow_tpu.serving.server import ModelServer
+from tests.serving_fixtures import DoubleModel
+
+
+@pytest.fixture()
+def served(tmp_path):
+    m = DoubleModel(name="double")
+    m.load()
+    ms = ModelServer(
+        models=[m], port=0,
+        request_log_path=str(tmp_path / "reqs.jsonl"),
+    )
+    server, addr = serve_grpc(ms, port=0)
+    client = InferenceGrpcClient(addr)
+    yield ms, client
+    client.close()
+    server.stop(grace=None)
+    ms.logger.close()
+
+
+class TestGrpcOIP:
+    def test_liveness_and_readiness(self, served):
+        ms, client = served
+        assert client.server_live()
+        assert client.server_ready()
+        assert client.model_ready("double")
+
+    def test_metadata(self, served):
+        _, client = served
+        meta = client.model_metadata("double")
+        assert meta.name == "double"
+        assert meta.platform == "jax-xla"
+
+    def test_infer_round_trip(self, served):
+        _, client = served
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out = client.infer("double", x, request_id="r1")
+        np.testing.assert_allclose(out["output-0"], x * 2.0)
+
+    def test_int64_tensor(self, served):
+        _, client = served
+        x = np.arange(4, dtype=np.int64).reshape(2, 2)
+        out = client.infer("double", x)
+        np.testing.assert_allclose(out["output-0"], (x * 2.0))
+
+    def test_unknown_model_not_found(self, served):
+        _, client = served
+        with pytest.raises(grpc.RpcError) as e:
+            client.infer("ghost", np.zeros((1,), np.float32))
+        assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_grpc_and_http_agree(self, served):
+        """Same registry: the HTTP v2 handler and the gRPC service return
+        identical predictions."""
+        ms, client = served
+        x = np.asarray([[1.0, 2.0]], dtype=np.float32)
+        code, http_payload = ms.handle_post(
+            "/v2/models/double/infer",
+            {"inputs": [{"name": "input-0", "datatype": "FP32",
+                         "shape": [1, 2], "data": x.ravel().tolist()}]},
+        )
+        assert code == 200
+        import json
+
+        http_out = json.loads(http_payload.data)["outputs"][0]["data"]
+        grpc_out = client.infer("double", x)["output-0"].ravel().tolist()
+        assert http_out == grpc_out
+
+    def test_requests_logged(self, served):
+        ms, client = served
+        client.infer("double", np.zeros((1, 2), np.float32))
+        metrics = ms.logger.render_metrics()
+        assert "v2-grpc" in metrics
