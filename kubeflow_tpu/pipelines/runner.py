@@ -406,12 +406,15 @@ class LocalPipelineRunner:
                     if base.is_dir() else []
                 )
             # unique tmp per publisher: a shared name lets concurrent
-            # same-fingerprint runs truncate each other mid-publish. Stray
-            # tmps from crashed publishers are reaped here (best effort) so
-            # the cache dir can't accumulate orphans forever.
+            # same-fingerprint runs truncate each other mid-publish. Stale
+            # tmps from CRASHED publishers are reaped best-effort — age-gated
+            # so a live concurrent publisher's in-flight tmp is never
+            # unlinked (a publish takes seconds; an hour-old tmp is dead).
+            cutoff = time.time() - 3600.0
             for stray in self.cache_dir.glob(f"{cache_file.name}.tmp-*"):
                 try:
-                    stray.unlink()
+                    if stray.stat().st_mtime < cutoff:
+                        stray.unlink()
                 except OSError:
                     pass
             tmp = cache_file.with_name(

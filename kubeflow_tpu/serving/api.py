@@ -43,6 +43,10 @@ class PredictorSpec:
     # >0 enables server-side adaptive micro-batching: concurrent requests
     # coalesce into one forward pass of up to this many rows
     max_batch_size: int = 0
+    # serve the v2 Open Inference Protocol over gRPC too (kserve serves v2
+    # on REST and gRPC); each replica binds an ephemeral gRPC port,
+    # surfaced in the pod's grpc-address annotation
+    grpc: bool = False
     env: dict[str, str] = field(default_factory=dict)
     # device flag forwarded to the server process (tpu|cpu)
     device: str = ""

@@ -37,6 +37,7 @@ _PKG_ROOT = str(Path(kubeflow_tpu.__file__).resolve().parent.parent)
 
 ISVC_LABEL = "kubeflow-tpu.org/inferenceservice"
 PORT_ANNOTATION = "kubeflow-tpu.org/serving-port"
+GRPC_PORT_ANNOTATION = "kubeflow-tpu.org/serving-grpc-port"
 REPLICA_INDEX_LABEL = "kubeflow-tpu.org/replica-index"
 CANARY_LABEL = "kubeflow-tpu.org/canary"
 SPEC_HASH_ANNOTATION = "kubeflow-tpu.org/predictor-spec-hash"
@@ -383,6 +384,12 @@ class InferenceServiceController(ControllerBase):
             # agent micro-batching: concurrent requests coalesce into one
             # forward pass up to this many rows (serving/agent.py)
             cmd += ["--max-batch-size", str(p.max_batch_size)]
+        grpc_port = None
+        if getattr(p, "grpc", False):
+            # controller-assigned (like the HTTP port) so the address is
+            # known up front and annotated on the pod
+            grpc_port = free_port()
+            cmd += ["--grpc-port", str(grpc_port)]
         if isvc.spec.transformer is not None:
             cmd += ["--transformer-class", isvc.spec.transformer.model_class]
         if isvc.spec.explainer is not None:
@@ -411,6 +418,8 @@ class InferenceServiceController(ControllerBase):
                 labels=labels,
                 annotations={
                     PORT_ANNOTATION: str(port),
+                    **({GRPC_PORT_ANNOTATION: str(grpc_port)}
+                       if grpc_port is not None else {}),
                     SPEC_HASH_ANNOTATION: _spec_hash(
                         p, isvc.spec.transformer, isvc.spec.explainer
                     ),

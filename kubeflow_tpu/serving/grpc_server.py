@@ -25,20 +25,31 @@ from kubeflow_tpu.protos import inference_pb2 as pb
 
 INFERENCE_SERVICE = "kubeflow_tpu.inference.GRPCInferenceService"
 
-# OIP datatype <-> numpy + the typed contents field carrying it
-_DT = {
-    "BOOL": (np.bool_, "bool_contents"),
-    "INT32": (np.int32, "int_contents"),
-    "INT64": (np.int64, "int64_contents"),
-    "UINT32": (np.uint32, "uint_contents"),
-    "FP32": (np.float32, "fp32_contents"),
-    "FP64": (np.float64, "fp64_contents"),
-}
+# OIP datatype -> (numpy dtype, typed contents field). The dtype SET is
+# derived from the HTTP handler's _V2_TO_NP so the two protocols accept the
+# same datatypes by construction; only the wire field differs per kind.
+# Narrow ints ride the widest typed field of their kind; FP16 values travel
+# in fp32_contents (proto has no fp16 field; precision is preserved).
+from kubeflow_tpu.serving.server import _V2_TO_NP as _HTTP_DT  # noqa: E402
+
+
+def _contents_field(np_dtype) -> str:
+    kind = np.dtype(np_dtype).kind
+    return {
+        "b": "bool_contents",
+        "i": "int64_contents" if np.dtype(np_dtype).itemsize == 8 else "int_contents",
+        "u": "uint_contents",
+        "f": "fp64_contents" if np.dtype(np_dtype).itemsize == 8 else "fp32_contents",
+    }[kind]
+
+
+_DT = {name: (dt, _contents_field(dt)) for name, dt in _HTTP_DT.items()}
+_DT["UINT32"] = (np.uint32, "uint_contents")
 _NP_TO_DT = {np.dtype(v[0]): k for k, v in _DT.items()}
 
 
 def _to_array(t: pb.InferInputTensor) -> np.ndarray:
-    dt, field = _DT[t.datatype]  # caller validates membership first
+    dt, field = _DT[t.datatype]  # caller validates membership + count first
     data = getattr(t.contents, field)
     return np.asarray(data, dtype=dt).reshape(tuple(t.shape))
 
@@ -95,13 +106,28 @@ class InferenceGrpcService:
             ctx.abort(grpc.StatusCode.NOT_FOUND, f"model {name!r} not found")
         if not m.ready:
             ctx.abort(grpc.StatusCode.UNAVAILABLE, f"model {name!r} not ready")
-        if not req.inputs:
-            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "request carries no inputs")
-        if req.inputs[0].datatype not in _DT:
+        if len(req.inputs) != 1:
             ctx.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                f"unsupported datatype {req.inputs[0].datatype!r} "
-                f"(supported: {sorted(_DT)})",
+                f"exactly one input tensor expected, got {len(req.inputs)} "
+                f"(single-input model contract, matching the HTTP v2 surface)",
+            )
+        t = req.inputs[0]
+        if t.datatype not in _DT:
+            ctx.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unsupported datatype {t.datatype!r} (supported: {sorted(_DT)})",
+            )
+        want = 1
+        for d in t.shape:
+            want *= d
+        field = _DT[t.datatype][1]
+        got = len(getattr(t.contents, field))
+        if got != want:
+            ctx.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"{field} carries {got} elements but shape {list(t.shape)} "
+                f"needs {want}",
             )
         t0 = _time.perf_counter()
         try:
@@ -146,6 +172,11 @@ def serve_grpc(model_server, port: int = 0, host: str = "127.0.0.1",
         }),
     ))
     bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        # grpc signals bind failure by returning 0, not raising — match the
+        # HTTP path's loud OSError so a stolen controller-assigned port can
+        # never be advertised as live
+        raise OSError(f"gRPC bind to {host}:{port} failed")
     server.start()
     return server, f"{host}:{bound}"
 

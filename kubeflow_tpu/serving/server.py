@@ -450,13 +450,16 @@ def main(argv: list[str] | None = None) -> None:
         batch_max_latency_ms=args.batch_max_latency_ms,
         repository_dir=args.repository_dir,
     )
-    srv.start(block=False)
+    # gRPC binds BEFORE the HTTP server goes live: the controller's
+    # readiness probe is HTTP, and an annotated gRPC port must never refuse
+    # connections after readiness reports true
     grpc_note = ""
     if args.grpc_port >= 0:
         from kubeflow_tpu.serving.grpc_server import serve_grpc
 
         _, grpc_addr = serve_grpc(srv, port=args.grpc_port, host=args.host)
         grpc_note = f" grpc={grpc_addr}"
+    srv.start(block=False)
     print(f"server ready url={srv.url} model={args.model_name}{grpc_note}",
           flush=True)
     threading.Event().wait()  # serve until killed

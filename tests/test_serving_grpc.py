@@ -83,3 +83,54 @@ class TestGrpcOIP:
         client.infer("double", np.zeros((1, 2), np.float32))
         metrics = ms.logger.render_metrics()
         assert "v2-grpc" in metrics
+
+
+def test_isvc_grpc_predictor_end_to_end(tmp_path):
+    """Platform-launched predictor with grpc=True: the controller assigns and
+    annotates a gRPC port, and OIP inference works against it."""
+    import os
+    import time
+
+    import numpy as np
+
+    from kubeflow_tpu.client import Platform
+    from kubeflow_tpu.serving.api import (
+        InferenceService,
+        InferenceServiceSpec,
+        PredictorRuntime,
+        PredictorSpec,
+    )
+    from kubeflow_tpu.serving.client import ServingClient
+    from kubeflow_tpu.serving.controller import GRPC_PORT_ANNOTATION, ISVC_LABEL
+    from kubeflow_tpu.controller.fakecluster import ObjectMeta
+
+    fixtures_dir = os.path.dirname(os.path.abspath(__file__))
+    with Platform(log_dir=str(tmp_path / "logs")) as p:
+        isvc = InferenceService(
+            metadata=ObjectMeta(name="gdemo"),
+            spec=InferenceServiceSpec(
+                predictor=PredictorSpec(
+                    runtime=PredictorRuntime.CUSTOM,
+                    model_class="serving_fixtures:DoubleModel",
+                    grpc=True,
+                    env={"PYTHONPATH": fixtures_dir},
+                )
+            ),
+        )
+        sc = ServingClient(p)
+        sc.create(isvc)
+        sc.wait_ready("gdemo", timeout_s=60)
+
+        pods = p.cluster.list(
+            "pods",
+            lambda q: q.metadata.labels.get(ISVC_LABEL) == "gdemo",
+        )
+        assert pods
+        gport = pods[0].metadata.annotations.get(GRPC_PORT_ANNOTATION)
+        assert gport, "gRPC port never annotated"
+        client = InferenceGrpcClient(f"127.0.0.1:{gport}")
+        try:
+            out = client.infer("gdemo", np.asarray([[5.0]], np.float32))
+            np.testing.assert_allclose(out["output-0"], [[10.0]])
+        finally:
+            client.close()
