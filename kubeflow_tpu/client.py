@@ -168,18 +168,10 @@ class TrainingClient:
     def _read_modify_write(
         self, name: str, namespace: str, mutate, retries: int = 10
     ) -> TrainJob:
-        """Optimistic-concurrency update: snapshot, mutate, swap; retry on
-        ConflictError (the controller writes status concurrently)."""
-        for _ in range(retries):
-            job = self.cluster.get("jobs", f"{namespace}/{name}", copy_obj=True)
-            if job is None:
-                raise KeyError(name)
-            mutate(job)
-            try:
-                return self.cluster.update("jobs", job)
-            except ConflictError:
-                time.sleep(0.01)
-        raise ConflictError(f"update of {namespace}/{name} kept conflicting")
+        return self.cluster.read_modify_write(
+            "jobs", f"{namespace}/{name}", mutate, retries=retries,
+            backoff_s=0.01,
+        )
 
     def suspend_job(self, name: str, namespace: str = "default") -> None:
         def mutate(job: TrainJob) -> None:

@@ -231,6 +231,29 @@ class FakeCluster:
                 self._notify(EventType.DELETED, kind, obj)
             return obj
 
+    def read_modify_write(
+        self, kind: str, key: str, mutate, retries: int = 10,
+        backoff_s: float = 0.02,
+    ) -> Any:
+        """Optimistic-concurrency update: deep-copied snapshot -> mutate ->
+        swap; retried on ConflictError. The ONE sanctioned way for clients to
+        update stored objects (mutating the live object in place would make
+        half-applied changes visible to controllers and defeat conflict
+        detection — every hand-rolled copy of this loop has eventually
+        dropped the copy)."""
+        import time as _time
+
+        for _ in range(retries):
+            obj = self.get(kind, key, copy_obj=True)
+            if obj is None:
+                raise KeyError(key)
+            mutate(obj)
+            try:
+                return self.update(kind, obj)
+            except ConflictError:
+                _time.sleep(backoff_s)
+        raise ConflictError(f"update of {kind}/{key} kept conflicting")
+
     def get(self, kind: str, key: str, copy_obj: bool = False) -> Any | None:
         """Fetch by key. copy_obj=True returns a deep snapshot — required by
         any caller that mutates and writes back (read-copy-update), so

@@ -81,22 +81,9 @@ class ServingClient:
     # ------------------------------------------------------------- rollouts
 
     def _read_modify_write(self, name: str, namespace: str, mutate) -> InferenceService:
-        import time as _time
-
-        from kubeflow_tpu.controller.fakecluster import ConflictError
-
-        for _ in range(10):
-            isvc = self.cluster.get(
-                "inferenceservices", f"{namespace}/{name}", copy_obj=True
-            )
-            if isvc is None:
-                raise KeyError(name)
-            mutate(isvc)
-            try:
-                return self.cluster.update("inferenceservices", isvc)
-            except ConflictError:
-                _time.sleep(0.02)
-        raise RuntimeError(f"update of {namespace}/{name} kept conflicting")
+        return self.cluster.read_modify_write(
+            "inferenceservices", f"{namespace}/{name}", mutate
+        )
 
     def set_canary(self, name: str, canary, traffic_percent: int,
                    namespace: str = "default") -> InferenceService:

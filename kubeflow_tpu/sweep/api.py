@@ -107,6 +107,10 @@ class ExperimentSpec:
     # tfevents source: dir pattern, ${trialName} substituted per trial; the
     # trial template should point KFTPU_EVENT_DIR at the same place
     tfevents_dir: str = ""
+    # katib resumePolicy: "LongRunning" allows resume_experiment() to raise
+    # maxTrialCount on a finished experiment and continue (durable
+    # observations make the suggester's history survive); "Never" forbids it
+    resume_policy: str = "LongRunning"
 
 
 @dataclass
@@ -311,6 +315,11 @@ def validate_experiment(exp: Experiment) -> Experiment:
                 ) from None
             if sigma_f <= 0:
                 raise ValueError("experiment: cmaes sigma must be > 0")
+    if exp.spec.resume_policy not in ("LongRunning", "Never"):
+        raise ValueError(
+            f"spec.resumePolicy: unknown policy {exp.spec.resume_policy!r} "
+            f"(LongRunning | Never)"
+        )
     if exp.spec.max_trial_count < 1 or exp.spec.parallel_trial_count < 1:
         raise ValueError("experiment: trial counts must be >= 1")
     if not exp.spec.trial_template.trial_spec:

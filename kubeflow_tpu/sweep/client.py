@@ -19,6 +19,7 @@ from pathlib import Path
 
 from kubeflow_tpu.api.common import ObjectMeta
 from kubeflow_tpu.sweep.api import (
+    ExperimentCondition,
     AlgorithmSpec,
     EarlyStoppingSpec,
     Experiment,
@@ -74,6 +75,48 @@ class SweepClient:
             delete_job_cascade(self.cluster, t.metadata.name, namespace)
             self.cluster.delete("trials", f"{namespace}/{t.metadata.name}")
         self.cluster.delete("experiments", f"{namespace}/{name}")
+
+    def resume_experiment(
+        self, name: str, max_trial_count: int, namespace: str = "default"
+    ) -> Experiment:
+        """Resume a SUCCEEDED experiment with a larger trial budget (katib
+        resumePolicy=LongRunning semantics): the terminal condition is
+        cleared and the controller keeps suggesting — its history (all prior
+        trials + durable observations) carries over, so a Bayesian/TPE
+        suggester continues from everything already learned. FAILED
+        experiments are not resumable: the controller would re-fail them on
+        the unchanged failed-trial budget before any new trial ran."""
+
+        def mutate(exp: Experiment) -> None:
+            if exp.spec.resume_policy == "Never":
+                raise ValueError(
+                    f"experiment {name} has resumePolicy=Never; cannot resume"
+                )
+            if not exp.status.is_finished:
+                raise ValueError(f"experiment {name} is still running")
+            if exp.status.condition == ExperimentCondition.FAILED:
+                raise ValueError(
+                    f"experiment {name} finished FAILED; only Succeeded "
+                    f"experiments resume (the failed-trial budget already "
+                    f"tripped and would re-finish it immediately)"
+                )
+            finished = sum(
+                1 for t in self.list_trials(name, namespace)
+                if t.status.is_finished
+            )
+            if max_trial_count <= finished:
+                raise ValueError(
+                    f"maxTrialCount {max_trial_count} must exceed the "
+                    f"{finished} trials already finished"
+                )
+            exp.spec.max_trial_count = max_trial_count
+            exp.status.condition = ExperimentCondition.RUNNING
+            exp.status.completion_time = ""
+            exp.status.message = f"resumed with maxTrialCount={max_trial_count}"
+
+        return self.cluster.read_modify_write(
+            "experiments", f"{namespace}/{name}", mutate, backoff_s=0.05
+        )
 
     # ---------------------------------------------------------------- status
 

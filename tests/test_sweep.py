@@ -623,3 +623,74 @@ class TestAdvancedSuggesterE2E:
         a = {p.name: p.value for p in best.parameter_assignments}
         # the winner must come from the top rung (full budget)
         assert a["epochs"] == "9"
+
+
+class TestResume:
+    def test_resume_continues_finished_experiment(self, platform, sweep, tmp_path):
+        """katib resumePolicy=LongRunning: a finished experiment resumes with
+        a larger budget and the suggester keeps its history."""
+        exp = Experiment(
+            metadata=ObjectMeta(name="resume-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0)],
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"
+                ),
+                algorithm=AlgorithmSpec(algorithm_name="random"),
+                trial_template=quadratic_trial_template(tmp_path),
+                max_trial_count=2,
+                parallel_trial_count=2,
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("resume-exp", timeout_s=120)
+        assert done.status.condition.value == "Succeeded"
+        assert done.status.trials_succeeded >= 2
+
+        sweep.resume_experiment("resume-exp", max_trial_count=4)
+        done2 = sweep.wait_for_experiment("resume-exp", timeout_s=120)
+        assert done2.status.condition.value == "Succeeded"
+        finished = [
+            t for t in sweep.list_trials("resume-exp") if t.status.is_finished
+        ]
+        assert len(finished) >= 4
+        assert done2.status.current_optimal_trial is not None
+
+    def test_resume_never_policy_rejected(self, platform, sweep, tmp_path):
+        exp = Experiment(
+            metadata=ObjectMeta(name="noresume-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0)],
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"
+                ),
+                algorithm=AlgorithmSpec(algorithm_name="random"),
+                trial_template=quadratic_trial_template(tmp_path),
+                max_trial_count=1,
+                parallel_trial_count=1,
+                resume_policy="Never",
+            ),
+        )
+        sweep.create_experiment(exp)
+        sweep.wait_for_experiment("noresume-exp", timeout_s=120)
+        with pytest.raises(ValueError, match="Never"):
+            sweep.resume_experiment("noresume-exp", max_trial_count=3)
+
+    def test_resume_running_experiment_rejected(self, platform, sweep, tmp_path):
+        exp = Experiment(
+            metadata=ObjectMeta(name="running-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_double("x", 0.0, 1.0)],
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="objective"
+                ),
+                algorithm=AlgorithmSpec(algorithm_name="random"),
+                trial_template=quadratic_trial_template(tmp_path),
+                max_trial_count=6,
+                parallel_trial_count=2,
+            ),
+        )
+        sweep.create_experiment(exp)
+        with pytest.raises(ValueError, match="still running"):
+            sweep.resume_experiment("running-exp", max_trial_count=10)
+        sweep.wait_for_experiment("running-exp", timeout_s=120)
