@@ -146,6 +146,24 @@ def cmd_sweep(args) -> int:
         done = sweep.wait_for_experiment(
             exp.metadata.name, exp.metadata.namespace, timeout_s=args.timeout
         )
+        if args.resume_to > 0:
+            # continue the finished sweep with a larger budget in the same
+            # platform session (resumePolicy=LongRunning); an unresumable
+            # outcome (FAILED, GoalReached, budget too small) reports and
+            # falls through to the normal JSON summary instead of crashing
+            try:
+                sweep.resume_experiment(
+                    exp.metadata.name, args.resume_to, exp.metadata.namespace
+                )
+            except ValueError as exc:
+                print(f"not resumed: {exc}", file=sys.stderr)
+            else:
+                print(f"resumed to maxTrialCount={args.resume_to}",
+                      file=sys.stderr)
+                done = sweep.wait_for_experiment(
+                    exp.metadata.name, exp.metadata.namespace,
+                    timeout_s=args.timeout,
+                )
         best = done.status.current_optimal_trial
         print(json.dumps({
             "condition": done.status.condition.value,
@@ -393,6 +411,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-f", "--filename", required=True)
     p.add_argument("--timeout", type=float, default=3600.0)
     p.add_argument("--capacity-chips", type=int, default=8)
+    p.add_argument("--resume-to", type=int, default=0,
+                   help="after completion, resume with this maxTrialCount "
+                        "(resumePolicy=LongRunning)")
     p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
 
     p = add("serve", cmd_serve, help="serve an InferenceService until Ctrl-C")

@@ -15,7 +15,7 @@ from pathlib import Path
 from kubeflow_tpu.api.common import JobConditionType
 from kubeflow_tpu.api.jobs import REPLICA_WORKER, TrainJob, apply_elastic_scale
 from kubeflow_tpu.api.validation import validate_job
-from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
+from kubeflow_tpu.controller.fakecluster import FakeCluster
 from kubeflow_tpu.controller.gang import GangScheduler
 from kubeflow_tpu.controller.jobcontroller import JobController, delete_job_cascade
 from kubeflow_tpu.controller.profile import check_job_admission

@@ -241,8 +241,6 @@ class FakeCluster:
         half-applied changes visible to controllers and defeat conflict
         detection — every hand-rolled copy of this loop has eventually
         dropped the copy)."""
-        import time as _time
-
         for _ in range(retries):
             obj = self.get(kind, key, copy_obj=True)
             if obj is None:
@@ -251,7 +249,7 @@ class FakeCluster:
             try:
                 return self.update(kind, obj)
             except ConflictError:
-                _time.sleep(backoff_s)
+                time.sleep(backoff_s)
         raise ConflictError(f"update of {kind}/{key} kept conflicting")
 
     def get(self, kind: str, key: str, copy_obj: bool = False) -> Any | None:

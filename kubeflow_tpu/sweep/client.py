@@ -100,6 +100,15 @@ class SweepClient:
                     f"experiments resume (the failed-trial budget already "
                     f"tripped and would re-finish it immediately)"
                 )
+            if exp.status.message in ("GoalReached", "SpaceExhausted"):
+                # the controller would re-finish on the unchanged condition
+                # before spawning anything — resuming is a silent no-op
+                raise ValueError(
+                    f"experiment {name} finished via "
+                    f"{exp.status.message}; a larger trial budget cannot "
+                    f"produce more trials (clear objective.goal or widen "
+                    f"the search space instead)"
+                )
             finished = sum(
                 1 for t in self.list_trials(name, namespace)
                 if t.status.is_finished
