@@ -1,0 +1,147 @@
+"""Built-in tfevents viewer — the Tensorboard CR's self-sufficient backend.
+
+The reference's tensorboard-controller launches the real TensorBoard; this
+platform prefers it too, but TensorBoard's CLI is not importable in every
+image (here: `tensorboard.main` needs pkg_resources, absent from this
+venv). A Tensorboard CR must still mean "a live URL showing the training
+curves", so this stdlib server renders the SAME tfevents files (read via
+the sweep collector's parser, written by train.metrics.TfEventsWriter) as
+inline-SVG line charts + JSON endpoints — zero extra dependencies, same
+readiness contract. The tensorboard controller falls back to this module
+whenever real TensorBoard can't start (mirroring the notebook controller's
+stdlib dev-server precedent).
+
+  GET /               HTML: every scalar tag as an SVG line chart
+  GET /data/scalars   JSON: {tag: [[step, value], ...]}
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import math
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+_cache: dict[str, tuple[tuple, dict]] = {}
+
+
+def _series(logdir: str) -> dict[str, list[tuple[int, float]]]:
+    """Parsed scalars, cached on a (path, mtime, size) snapshot — the
+    readiness probe hits / every resync, and re-parsing a long run's
+    tfevents each time would grow without bound. Returns {} (page still
+    serves, with a banner) when the tensorboard proto modules the parser
+    needs are absent entirely — the CR must not flap on a parse error."""
+    import os
+
+    try:
+        from kubeflow_tpu.sweep.collector import parse_tfevents_points
+    except ImportError:
+        return {}
+    snap = tuple(
+        sorted(
+            (p, os.path.getmtime(p), os.path.getsize(p))
+            for root, _, fs in os.walk(logdir)
+            for f in fs
+            if "tfevents" in f and os.path.exists(p := os.path.join(root, f))
+        )
+    )
+    hit = _cache.get(logdir)
+    if hit is not None and hit[0] == snap:
+        return hit[1]
+    try:
+        series = parse_tfevents_points(logdir)
+    except Exception:  # noqa: BLE001 — a torn write must not 500 the probe
+        return hit[1] if hit else {}
+    _cache[logdir] = (snap, series)
+    return series
+
+
+def _svg_chart(points: list[tuple[int, float]], w: int = 520, h: int = 160) -> str:
+    if not points:
+        return "<svg/>"
+    points = [p for p in points if math.isfinite(p[1])]
+    if not points:
+        return "<svg/>"
+    xs = [p[0] for p in points]
+    ys = [p[1] for p in points]
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(ys), max(ys)
+    xr = (x1 - x0) or 1
+    yr = (y1 - y0) or 1.0
+    pad = 8
+    coords = " ".join(
+        f"{pad + (x - x0) / xr * (w - 2 * pad):.1f},"
+        f"{h - pad - (y - y0) / yr * (h - 2 * pad):.1f}"
+        for x, y in points
+    )
+    return (
+        f'<svg viewBox="0 0 {w} {h}" width="{w}" height="{h}" '
+        f'style="background:#fafafa;border:1px solid #ddd">'
+        f'<polyline fill="none" stroke="#2563eb" stroke-width="1.5" '
+        f'points="{coords}"/>'
+        f'<text x="{pad}" y="{pad + 4}" font-size="9">{y1:.5g}</text>'
+        f'<text x="{pad}" y="{h - 2}" font-size="9">{y0:.5g}</text>'
+        f"</svg>"
+    )
+
+
+def make_handler(logdir: str):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # pod logs
+            print(f"tbviewer: {fmt % args}", flush=True)
+
+        def _reply(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path.startswith("/data/scalars"):
+                # non-finite floats serialize as null: bare NaN/Infinity
+                # tokens are invalid JSON to strict parsers
+                data = {
+                    tag: [
+                        [s, v if math.isfinite(v) else None] for s, v in pts
+                    ]
+                    for tag, pts in _series(logdir).items()
+                }
+                self._reply(200, json.dumps(data).encode(), "application/json")
+                return
+            if self.path in ("/", "/index.html"):
+                series = _series(logdir)
+                parts = [
+                    "<!doctype html><title>kubeflow-tpu tfevents viewer</title>",
+                    f"<h2>scalars — {html.escape(logdir)}</h2>",
+                ]
+                if not series:
+                    parts.append("<p>(no tfevents scalars yet — refresh)</p>")
+                for tag in sorted(series):
+                    parts.append(
+                        f"<h4>{html.escape(tag)}</h4>{_svg_chart(series[tag])}"
+                    )
+                self._reply(200, "\n".join(parts).encode(), "text/html")
+                return
+            self._reply(404, b"not found", "text/plain")
+
+    return Handler
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="kubeflow-tpu tfevents viewer")
+    ap.add_argument("--logdir", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+    srv = ThreadingHTTPServer((args.host, args.port), make_handler(args.logdir))
+    print(f"tbviewer ready http://{args.host}:{args.port} "
+          f"logdir={args.logdir}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -107,6 +107,33 @@ class TensorboardController(ControllerBase):
                 )
         return None if ready else 0.5
 
+    @staticmethod
+    def _command(logdir: str, port: int) -> list:
+        """Real TensorBoard when its CLI can actually start; otherwise the
+        built-in tfevents viewer (controller/tbviewer.py) — same readiness
+        contract, same files, zero extra dependencies. TensorBoard's CLI
+        needs pkg_resources, which not every image ships (this one doesn't),
+        and a Tensorboard CR must still produce a live URL."""
+        import importlib.util
+
+        if (
+            importlib.util.find_spec("tensorboard") is not None
+            and importlib.util.find_spec("pkg_resources") is not None
+        ):
+            return [
+                sys.executable, "-m", "tensorboard.main",
+                "--logdir", logdir,
+                "--port", str(port),
+                "--host", "127.0.0.1",
+                "--load_fast", "false",
+            ]
+        return [
+            sys.executable, "-m", "kubeflow_tpu.controller.tbviewer",
+            "--logdir", logdir,
+            "--port", str(port),
+            "--host", "127.0.0.1",
+        ]
+
     def _create_pod(self, tb: Tensorboard) -> None:
         port = free_port()
         pod = Pod(
@@ -116,13 +143,7 @@ class TensorboardController(ControllerBase):
                 labels={TB_LABEL: tb.metadata.name},
                 annotations={PORT_ANNOTATION: str(port)},
             ),
-            command=[
-                sys.executable, "-m", "tensorboard.main",
-                "--logdir", tb.spec.logdir,
-                "--port", str(port),
-                "--host", "127.0.0.1",
-                "--load_fast", "false",
-            ],
+            command=self._command(tb.spec.logdir, port),
             scheduler_name="default",
         )
         try:

@@ -64,10 +64,11 @@ def _observation(timelines: dict[str, list[float]]) -> Observation:
 
 # ---------------------------------------------------------------- tfevents
 
-def parse_tfevents(logdir: str, names: set[str] | None = None) -> dict[str, list[float]]:
-    """Scalar timelines from a tfevents dir (katib's tfevent-metricscollector
-    parity, cmd/metricscollector/v1beta1/tfevent-metricscollector). Handles
-    both simple_value and tensor-encoded scalars; step-ordered."""
+def parse_tfevents_points(
+    logdir: str, names: set[str] | None = None
+) -> dict[str, list[tuple[int, float]]]:
+    """Step-ordered (step, value) pairs per scalar tag — the point-preserving
+    sibling of parse_tfevents (the tbviewer charts need real step x-axes)."""
     import os
 
     from tensorboard.backend.event_processing.event_file_loader import (
@@ -95,9 +96,21 @@ def parse_tfevents(logdir: str, names: set[str] | None = None) -> dict[str, list
                 else:
                     continue
                 points.setdefault(val.tag, []).append((ev.step, v))
+    # stable key-sort: duplicate steps (restarted runs re-logging a step)
+    # keep write order, so "latest" stays the newest write, not the largest
+    # value; NaNs never enter the comparison
     return {
-        tag: [v for _, v in sorted(pts, key=lambda p: p[0])]
-        for tag, pts in points.items()
+        t: sorted(p, key=lambda q: q[0]) for t, p in points.items()
+    }
+
+
+def parse_tfevents(logdir: str, names: set[str] | None = None) -> dict[str, list[float]]:
+    """Scalar timelines from a tfevents dir (katib's tfevent-metricscollector
+    parity, cmd/metricscollector/v1beta1/tfevent-metricscollector). Handles
+    both simple_value and tensor-encoded scalars; step-ordered."""
+    return {
+        tag: [v for _, v in pts]
+        for tag, pts in parse_tfevents_points(logdir, names).items()
     }
 
 

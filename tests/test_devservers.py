@@ -104,3 +104,42 @@ class TestPVCViewer:
         ready = _wait_ready(platform.cluster, "pvcviewers", "default/pv1")
         with urllib.request.urlopen(ready.status.url) as r:
             assert "artifact.bin" in r.read().decode()
+
+
+class TestTensorboard:
+    def test_lifecycle_ready_and_delete(self, platform, tmp_path):
+        """Tensorboard CR -> live tensorboard process over a real logdir."""
+        from kubeflow_tpu.controller.tensorboard import (
+            Tensorboard,
+            TensorboardSpec,
+        )
+        from kubeflow_tpu.train.metrics import TfEventsWriter
+
+        logdir = tmp_path / "runs"
+        w = TfEventsWriter(str(logdir))
+        w.scalars(1, loss=0.5)
+        w.close()
+
+        tb = Tensorboard(
+            metadata=ObjectMeta(name="tb1"),
+            spec=TensorboardSpec(logdir=str(logdir)),
+        )
+        platform.cluster.create("tensorboards", tb)
+        ready = _wait_ready(platform.cluster, "tensorboards", "default/tb1",
+                            timeout_s=90.0)
+        assert ready.status.url
+        with urllib.request.urlopen(ready.status.url, timeout=5) as r:
+            assert r.status == 200
+
+        platform.cluster.delete("tensorboards", "default/tb1")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pods = platform.cluster.list(
+                "pods",
+                lambda p: p.metadata.labels.get(
+                    "kubeflow-tpu.org/tensorboard") == "tb1",
+            )
+            if not pods:
+                return
+            time.sleep(0.2)
+        raise AssertionError("tensorboard pod not cascade-deleted")
