@@ -239,8 +239,11 @@ class TestTrainerUpgrades:
             Trainer(MnistMLP(hidden=(8,)),
                     TrainerConfig(lr_schedule="cosine"))
 
-    def test_preemption_checkpoints_and_resumes(self, tmp_path):
-        """SIGTERM mid-fit saves a checkpoint; the next fit resumes from it."""
+    @pytest.mark.parametrize("fused_steps", [1, 4])
+    def test_preemption_checkpoints_and_resumes(self, tmp_path, fused_steps):
+        """SIGTERM mid-fit saves a checkpoint; the next fit resumes from it.
+        With fused_steps=4 the save provably lands on a chunk boundary
+        (every cadence in the run is a multiple of 4)."""
         import signal
         import subprocess
         import sys
@@ -259,9 +262,10 @@ class TestTrainerUpgrades:
             t = Trainer(
                 MnistMLP(hidden=(16,)),
                 TrainerConfig(batch_size=8, steps=100000,
+                              fused_steps={fused_steps},
                               checkpoint_dir={repr(str(tmp_path / "ckpt"))},
                               checkpoint_every_steps=10**9,
-                              log_every_steps=5),
+                              log_every_steps=8),
             )
             t.fit(ds)
             print("EXITED_CLEANLY", flush=True)
@@ -274,8 +278,14 @@ class TestTrainerUpgrades:
             [sys.executable, str(script)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
-        # wait until it has taken some steps, then deliver the preemption
-        time.sleep(20)
+        # deliver the preemption only once the run has provably taken steps:
+        # poll for the first metrics line (log_every_steps=8 emits one after
+        # 8 steps) instead of a blind sleep that races run completion
+        deadline = time.time() + 90
+        line = ""
+        while time.time() < deadline and "step=" not in line:
+            line = proc.stdout.readline()
+        assert "step=" in line, "run never logged a step"
         proc.send_signal(signal.SIGTERM)
         out, _ = proc.communicate(timeout=120)
         assert proc.returncode == 0, out[-2000:]
@@ -296,3 +306,8 @@ class TestTrainerUpgrades:
         )
         state = t.checkpointer.restore_latest(t.init_state(ds.x_train[:8]))
         assert state is not None and state[0] > 0  # resumed step count
+        if fused_steps > 1:
+            # the preemption check fires at chunk boundaries only, so the
+            # saved step must be a whole number of chunks
+            assert state[0] % fused_steps == 0
+
