@@ -187,6 +187,97 @@ class TrainingClient:
 
     # ---------------------------------------------------------------- status
 
+    def train(
+        self,
+        name: str,
+        *,
+        family: str = "mnist",
+        num_workers: int = 1,
+        namespace: str = "default",
+        device: str = "auto",
+        args: list[str] | None = None,
+        elastic: tuple[int, int] | None = None,
+        wait: bool = True,
+        timeout_s: float = 3600.0,
+    ) -> dict[str, float]:
+        """High-level train() convenience (the reference SDK's
+        TrainingClient.train HF-fine-tune helper, SURVEY.md §2.1 — here over
+        the in-tree model families instead of HF images): build a JAXJob
+        around `python -m examples.<family>`, submit it, wait, and return
+        the final metrics parsed from worker-0's log.
+
+        family: mnist | resnet | bert | bert_pretrain | gpt
+        args:   extra example flags (e.g. ["--steps=200", "--bf16"])
+        elastic: (min_replicas, max_replicas) to attach an ElasticPolicy
+        """
+        import sys as _sys
+
+        from kubeflow_tpu.api.common import (
+            ContainerSpec,
+            ElasticPolicy,
+            ObjectMeta,
+            PodTemplateSpec,
+            ReplicaSpec,
+            RunPolicy,
+        )
+        from kubeflow_tpu.api.jobs import JAXJob, JAXJobSpec
+
+        families = ("mnist", "resnet", "bert", "bert_pretrain", "gpt")
+        if family not in families:
+            raise ValueError(f"unknown family {family!r} (one of {families})")
+        cmd = [_sys.executable, "-m", f"examples.{family}",
+               f"--device={device}", *(args or [])]
+        rp = RunPolicy()
+        if elastic is not None:
+            lo, hi = elastic
+            if not (lo <= num_workers <= hi):
+                raise ValueError(
+                    f"num_workers {num_workers} outside elastic range "
+                    f"[{lo}, {hi}]"
+                )
+            rp.elastic_policy = ElasticPolicy(min_replicas=lo, max_replicas=hi)
+        job = JAXJob(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            spec=JAXJobSpec(
+                replica_specs={REPLICA_WORKER: ReplicaSpec(
+                    replicas=num_workers,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(
+                            command=cmd,
+                            # `examples` is a repo-root package, not an
+                            # installed one: anchor the worker's cwd so
+                            # module resolution never depends on where the
+                            # SDK caller happens to run from
+                            working_dir=str(Path(__file__).resolve().parents[1]),
+                        )
+                    ),
+                )},
+                run_policy=rp,
+            ),
+        )
+        self.create_job(job)
+        if not wait:
+            return {}
+        done = self.wait_for_job_conditions(
+            name, namespace, timeout_s=timeout_s
+        )
+        if not done.status.is_succeeded:
+            failed = next(
+                (c for c in done.status.conditions
+                 if c.type == JobConditionType.FAILED), None
+            )
+            detail = f": {failed.message}" if failed and failed.message else ""
+            raise RuntimeError(f"train job {name} failed{detail}")
+        from kubeflow_tpu.train.metrics import parse_line
+
+        final: dict[str, float] = {}
+        for line in self.get_job_logs(name, namespace).splitlines():
+            parsed = parse_line(line)
+            final.update(
+                {k: v for k, v in parsed.items() if k.startswith("final_")}
+            )
+        return final
+
     def wait_for_job_conditions(
         self,
         name: str,

@@ -119,3 +119,32 @@ def test_mpirun_launch(tmp_path, monkeypatch, capsys):
     ])
     assert rc == 0
     assert "hosts: 2" in capsys.readouterr().out
+
+
+def test_training_client_train_convenience(tmp_path):
+    """TrainingClient.train() (the reference SDK's train() helper): family ->
+    JAXJob -> wait -> final metrics from worker-0's log."""
+    from kubeflow_tpu.client import Platform, TrainingClient
+
+    with Platform(log_dir=str(tmp_path / "logs")) as p:
+        client = TrainingClient(p)
+        # the mnist example's exit code gates on >0.9 accuracy; 20 epochs
+        # converges well past it (same budget as test_digits_converges)
+        final = client.train(
+            "conv-train",
+            family="mnist",
+            device="cpu",
+            args=["--epochs=20"],
+            timeout_s=300,
+        )
+        assert final.get("final_accuracy", 0) > 0.9
+        assert "final_loss" in final
+
+
+def test_training_client_train_rejects_unknown_family(tmp_path):
+    from kubeflow_tpu.client import Platform, TrainingClient
+    import pytest as _pytest
+
+    with Platform(log_dir=str(tmp_path / "logs")) as p:
+        with _pytest.raises(ValueError, match="unknown family"):
+            TrainingClient(p).train("x", family="nope")
