@@ -43,6 +43,10 @@ def t(label, f, *args, iters=5):
 
 
 print("devices:", jax.devices(), flush=True)
+print("default_backend:", jax.default_backend(),
+      "platform:", jax.devices()[0].platform, flush=True)
+# the ResNet conv_impl="auto" switch keys on default_backend() == "axon";
+# this line is the ground truth for that assumption
 
 # --- host-born vs device-born re-pass
 N = 1 << 22  # 4M f32 = 16MB

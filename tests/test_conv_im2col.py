@@ -83,3 +83,17 @@ def test_resnet_im2col_matches_xla_with_shared_params():
         m_i2c.apply(variables, x), m_xla.apply(variables, x),
         atol=5e-4, rtol=5e-4,
     )
+
+
+def test_conv_impl_auto_selection(monkeypatch):
+    """auto -> im2col exactly on the axon backend, stock conv elsewhere."""
+    from kubeflow_tpu.models import conv as conv_mod
+    from kubeflow_tpu.models.resnet import ResNet
+
+    m = ResNet(stage_sizes=(1,), block_cls=None, conv_impl="auto")
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    assert m._conv_cls() is conv_mod.ConvCompat
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert m._conv_cls() is nn.Conv
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert m._conv_cls() is nn.Conv
