@@ -134,4 +134,39 @@ p = {k: mk(*s) for k, s in dict(
 xin = mk(16, 128, 768)
 t("1 bert layer fwd+loss", jax.jit(layer), p, xin)
 t("1 bert layer grad", jax.jit(jax.grad(layer)), p, xin)
+# --- full-model conv head-to-head (LAST: each variant AOT-compiles a full
+# train step through the tunnel, the likeliest section to wedge — a hang
+# here must not cost the cheap measurements above): ResNet-18 (32x32) train step, xla conv
+# vs im2col — the end-to-end evidence for conv_impl="auto" (per-op numbers
+# above don't capture fusion/backward effects)
+def _resnet_step_ms(impl: str) -> None:
+    from kubeflow_tpu.models.resnet import ResNet18
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_image_dataset
+
+    ds = synthetic_image_dataset(n_train=32, n_test=32, shape=(32, 32, 3),
+                                 num_classes=10)
+    trainer = Trainer(
+        ResNet18(num_classes=10, dtype=jnp.bfloat16, small_inputs=True,
+                 conv_impl=impl),
+        TrainerConfig(batch_size=32, compute_dtype=jnp.bfloat16,
+                      log_every_steps=10**9),
+    )
+    from bench import _timed_steps  # the ONE timing protocol (true sync)
+
+    state = trainer.init_state(ds.x_train[:32])
+    batch = (ds.x_train[:32], ds.y_train[:32])
+    steps = 5
+    dt = _timed_steps(trainer, state, batch, steps)
+    print(f"{'resnet18-32px step (' + impl + ')':40s} "
+          f"{dt / steps * 1e3:9.2f} ms", flush=True)
+
+
+for _impl in ("xla", "im2col"):
+    try:
+        _resnet_step_ms(_impl)
+    except Exception as e:  # noqa: BLE001
+        print(f"resnet18 step ({_impl}) FAILED {type(e).__name__}: {e}",
+              flush=True)
+
 print("probe done", flush=True)

@@ -47,7 +47,7 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
     echo "=== tunnel alive at $(date -u +%H:%M:%S) ===" >> tunnel_watch.log
     # on any stage failure, back off before re-probing: a fast-failing stage
     # must not hot-loop against an alive tunnel
-    { stage probe_results.txt 1200 python -u probe_ops.py \
+    { stage probe_results.txt 1800 python -u probe_ops.py \
         && stage bench_r2_fixed.jsonl 3600 python bench.py --suite \
         && stage probe_bert.txt 1500 python -u probe_bert.py; } || sleep 180
   else
