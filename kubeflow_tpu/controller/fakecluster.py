@@ -137,6 +137,10 @@ class PodGroup:
     # Total chip reservation: topology chips x num_slices, set by the job
     # controller; 0 = charge one chip per pod.
     chips: int = 0
+    # Scheduling priority (resolved from SchedulingPolicy.priority_class):
+    # higher binds first under contention, and may PREEMPT strictly-lower-
+    # priority bound gangs (volcano preempt-action analogue).
+    priority: int = 0
     phase: str = "Pending"  # Pending -> Running once gang-bound
 
     @property

@@ -28,6 +28,26 @@ def topology_chips(topology: str) -> int:
     return math.prod(int(d) for d in topology.split("x"))
 
 
+# Well-known priority classes (k8s PriorityClass analogue); numeric strings
+# are accepted verbatim so users can define arbitrary levels.
+PRIORITY_CLASSES = {
+    "": 0,
+    "default": 0,
+    "low": -1000,
+    "high": 1000,
+    "system-critical": 2000,
+}
+
+
+def resolve_priority(priority_class: str) -> int:
+    if priority_class in PRIORITY_CLASSES:
+        return PRIORITY_CLASSES[priority_class]
+    try:
+        return int(priority_class)
+    except ValueError:
+        return 0
+
+
 class GangScheduler:
     def __init__(self, cluster: FakeCluster):
         self.cluster = cluster
@@ -81,7 +101,15 @@ class GangScheduler:
 
     def _try_schedule(self) -> None:
         with self._mu:
-            groups = self.cluster.list("podgroups")
+            # Priority order: under contention the highest-priority gang
+            # admits first; FIFO (creation time) breaks ties so equal-
+            # priority gangs can never starve each other.
+            groups = sorted(
+                self.cluster.list("podgroups"),
+                key=lambda g: (
+                    -g.priority, g.metadata.creation_timestamp, g.key
+                ),
+            )
             for pg in groups:
                 if pg.phase == "Running":
                     # an admitted gang may still grow members (min_member can
@@ -97,17 +125,20 @@ class GangScheduler:
                         # Reservation is recomputed from members actually
                         # covered (bound + late) so a member whose bind failed
                         # and retries here is never charged twice.
+                        entry = self._bound_chips.get(pg.key)
+                        held = (
+                            entry[1]
+                            if entry and entry[0] == pg.metadata.uid
+                            else 0
+                        )
                         if pg.chips:
-                            extra = 0
+                            # chips gangs hold their whole reservation; if
+                            # the entry vanished (never for a bound gang in
+                            # practice), recharge the full amount
+                            extra = 0 if held else pg.chips
                         else:
                             bound = sum(
                                 1 for p in self._members(pg) if p.status.node
-                            )
-                            entry = self._bound_chips.get(pg.key)
-                            held = (
-                                entry[1]
-                                if entry and entry[0] == pg.metadata.uid
-                                else 0
                             )
                             extra = max(0, bound + len(late) - held)
                         used = sum(c for _, c in self._bound_chips.values())
@@ -136,18 +167,28 @@ class GangScheduler:
                 if len(pending) < pg.min_member:
                     continue
                 chips_needed = pg.chips or len(pending)
-                used = sum(c for _, c in self._bound_chips.values())
-                if used + chips_needed > self.cluster.capacity_chips:
-                    self.cluster.record_event(
-                        "podgroups", pg.key, "Unschedulable",
-                        f"gang needs {chips_needed} chips, "
-                        f"{self.cluster.capacity_chips - used} free",
-                        type="Warning",
-                    )
-                    continue
-                # per-namespace chip quota (Profile, SURVEY.md §2.7)
+                # per-namespace chip quota FIRST (Profile, SURVEY.md §2.7):
+                # a quota-blocked gang can never use preempted chips, so it
+                # must not be allowed to evict anyone
                 if self._ns_quota_blocked(pg, chips_needed):
                     continue
+                used = sum(c for _, c in self._bound_chips.values())
+                if used + chips_needed > self.cluster.capacity_chips:
+                    # volcano preempt-action analogue: a higher-priority gang
+                    # may evict strictly-lower-priority bound gangs (their
+                    # jobs gang-restart from checkpoint once capacity frees)
+                    freed = self._try_preempt(
+                        pg, chips_needed - (self.cluster.capacity_chips - used)
+                    )
+                    used = sum(c for _, c in self._bound_chips.values())
+                    if not freed or used + chips_needed > self.cluster.capacity_chips:
+                        self.cluster.record_event(
+                            "podgroups", pg.key, "Unschedulable",
+                            f"gang needs {chips_needed} chips, "
+                            f"{self.cluster.capacity_chips - used} free",
+                            type="Warning",
+                        )
+                        continue
                 # All-or-nothing ADMISSION: reserve chips + flip the group to
                 # Running first; then bind members. If a member bind fails
                 # mid-loop (pod replaced concurrently), the reservation is
@@ -166,6 +207,66 @@ class GangScheduler:
                     "podgroups", pg.key, "Scheduled",
                     f"gang of {len(pending)} bound ({chips_needed} chips)",
                 )
+
+    def _try_preempt(self, pg: PodGroup, need: int) -> bool:
+        """Evict bound gangs with priority strictly below pg's until `need`
+        chips are released. Victims: lowest priority first, then newest
+        (least sunk work). Eviction = unbind (delete pods, reset the group
+        to Pending, release the reservation); the owning job controller
+        recreates the pods and the gang re-admits when capacity allows —
+        the same checkpoint-restart path a worker loss takes. Caller holds
+        _mu. Returns True if enough was (or already were) released."""
+        if need <= 0:
+            return True
+        victims = []
+        available = 0
+        for other in self.cluster.list("podgroups"):
+            entry = self._bound_chips.get(other.key)
+            if entry is None or entry[0] != other.metadata.uid:
+                continue
+            if other.priority >= pg.priority:
+                continue
+            victims.append(other)
+            available += entry[1]
+        if available < need:
+            # preemption cannot succeed: evicting anyway would thrash
+            # lower-priority jobs through pointless restarts every pass
+            return False
+        # lowest priority first; NEWEST first within a level (least sunk
+        # work lost) — two stable sorts
+        victims.sort(key=lambda o: o.metadata.creation_timestamp, reverse=True)
+        victims.sort(key=lambda o: o.priority)
+        released = 0
+        for victim in victims:
+            if released >= need:
+                break
+            entry = self._bound_chips.pop(victim.key, None)
+            if entry is None:
+                continue
+            released += entry[1]
+            victim.phase = "Pending"
+            try:
+                self.cluster.update("podgroups", victim)
+            except (ConflictError, KeyError):
+                pass
+            for p in self._members(victim):
+                try:
+                    self.cluster.delete("pods", p.key)
+                except KeyError:
+                    pass
+            self.cluster.record_event(
+                "podgroups", victim.key, "Preempted",
+                f"evicted ({entry[1]} chips) for higher-priority gang "
+                f"{pg.key} (priority {pg.priority} > {victim.priority})",
+                type="Warning",
+            )
+            self.cluster.record_event(
+                "jobs", victim.key, "Preempted",
+                f"gang preempted by {pg.key}; will gang-restart when "
+                f"capacity frees",
+                type="Warning",
+            )
+        return released >= need
 
     # ------------------------------------------------------- capacity views
 

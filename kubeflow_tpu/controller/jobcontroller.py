@@ -331,7 +331,7 @@ class JobController(ControllerBase):
         # Clamp to the current total: a stale min_available above the post-
         # scale-down replica count would make the gang unsatisfiable forever.
         total = job.total_replicas()
-        from kubeflow_tpu.controller.gang import topology_chips
+        from kubeflow_tpu.controller.gang import resolve_priority, topology_chips
 
         topo = sp.slice_topology if sp else ""
         pg = PodGroup(
@@ -343,6 +343,7 @@ class JobController(ControllerBase):
             slice_topology=topo,
             # a multislice job reserves num_slices whole slices
             chips=topology_chips(topo) * max(job.spec.num_slices, 1),
+            priority=resolve_priority(sp.priority_class if sp else ""),
         )
         self.cluster.create("podgroups", pg)
 
