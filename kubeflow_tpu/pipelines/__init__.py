@@ -23,6 +23,7 @@ from kubeflow_tpu.pipelines.dsl import (
     for_each,
     on_exit,
     pipeline,
+    retry,
     sweep,
     train_job,
     when,
@@ -56,6 +57,7 @@ __all__ = [
     "for_each",
     "on_exit",
     "pipeline",
+    "retry",
     "sweep",
     "train_job",
     "validate_ir",

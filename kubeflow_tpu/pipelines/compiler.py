@@ -125,6 +125,8 @@ def compile_pipeline(pipeline: Pipeline) -> dict:
             }
         if task.is_exit_handler:
             entry["exitHandler"] = True
+        if task.retries:
+            entry["retryPolicy"] = {"maxRetryCount": task.retries}
         tasks[task.name] = entry
 
     ir: dict[str, Any] = {
@@ -199,6 +201,16 @@ def validate_ir(ir: dict) -> dict:
                     raise ValueError(
                         f"task {tname}: when references unknown task {prod!r}"
                     )
+        rp = t.get("retryPolicy")
+        if rp is not None:
+            try:
+                n = int(rp.get("maxRetryCount", 0))
+            except (TypeError, ValueError, AttributeError):
+                raise ValueError(
+                    f"task {tname}: malformed retryPolicy {rp!r}"
+                ) from None
+            if n < 0:
+                raise ValueError(f"task {tname}: negative maxRetryCount")
         it = t.get("iterator")
         if it is not None:
             if "itemInput" not in it or "items" not in it:

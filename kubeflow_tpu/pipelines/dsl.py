@@ -165,10 +165,20 @@ class Task:
     iterate_over: tuple[Any, str] | None = None
     # exit handlers run last, regardless of upstream failure/skip
     is_exit_handler: bool = False
+    # transient-failure retries for this task's executor (kfp set_retry)
+    retries: int = 0
 
     @property
     def output(self) -> TaskOutput:
         return TaskOutput(producer=self.name)
+
+    def set_retries(self, n: int) -> "Task":
+        """Retry the executor up to n extra times on failure (kfp
+        task.set_retry analogue)."""
+        if n < 0:
+            raise ValueError("retries must be >= 0")
+        self.retries = n
+        return self
 
     def after(self, *others: "Task | TaskOutput") -> "Task":
         for o in others:
@@ -311,6 +321,19 @@ def artifact(out: TaskOutput, name: str) -> TaskOutput:
                 f"{name!r} (has {task.component.output_artifacts})"
             )
     return TaskOutput(producer=out.producer, key=name)
+
+
+def retry(out: TaskOutput, n: int) -> TaskOutput:
+    """Attach a retry policy to an already-declared task by its output:
+    `r = dsl.retry(flaky(...), 2)` (kfp task.set_retry analogue)."""
+    ctx = _PipelineContext.current()
+    if ctx is None:
+        raise RuntimeError("retry can only be used inside a @pipeline")
+    task = ctx.pipeline.tasks.get(out.producer)
+    if task is None:
+        raise ValueError(f"retry: unknown task {out.producer!r}")
+    task.set_retries(n)
+    return out
 
 
 def on_exit(out: TaskOutput) -> TaskOutput:

@@ -244,18 +244,25 @@ class LocalPipelineRunner:
         comp = ir["components"][spec["componentRef"]["name"]]
         executor = ir["deploymentSpec"]["executors"][comp["executorLabel"]]
         inputs = self._resolve_inputs(run, spec)
+        retries = int(spec.get("retryPolicy", {}).get("maxRetryCount", 0))
         if spec.get("iterator") is not None and "pythonFunction" not in executor:
             result.state = TaskState.FAILED
             result.error = "iterator tasks require a pythonFunction executor"
             self._record_lineage(run, tname, inputs, result, run_exec_id)
             return
-        if "trainJob" in executor:
-            self._run_train_job_task(run, run_dir, tname, executor, inputs,
-                                     run_exec_id)
-            return
-        if "sweep" in executor:
-            self._run_sweep_task(run, run_dir, tname, executor, inputs,
-                                 run_exec_id)
+        if "trainJob" in executor or "sweep" in executor:
+            # kfp retryPolicy for job-launching steps: resubmit the whole
+            # step (fresh TaskResult per attempt; each attempt records its
+            # own lineage execution)
+            helper = (
+                self._run_train_job_task if "trainJob" in executor
+                else self._run_sweep_task
+            )
+            for attempt in range(retries + 1):
+                helper(run, run_dir, tname, executor, inputs, run_exec_id)
+                if run.tasks[tname].state != TaskState.FAILED or attempt == retries:
+                    return
+                run.tasks[tname] = TaskResult()
             return
         it = spec.get("iterator")
         items = None
@@ -332,34 +339,56 @@ class LocalPipelineRunner:
         t0 = time.monotonic()
         result.state = TaskState.RUNNING
         if it is None:
-            exec_inputs = dict(inputs)
-            art_dir = run_dir / tname / "artifacts"
-            if out_artifacts:
-                art_dir.mkdir(parents=True, exist_ok=True)
-            for a in out_artifacts:
-                exec_inputs[a] = str(art_dir / a)
-            ok, out, err = self._exec_python_once(
-                run_dir / tname, source, fn_name, exec_inputs
-            )
-            if ok:
-                missing = [
-                    a for a in out_artifacts if not (art_dir / a).exists()
-                ]
-                if missing:
-                    ok = False
-                    err = f"declared artifact(s) never written: {missing}"
-                else:
-                    result.artifacts = {a: str(art_dir / a) for a in out_artifacts}
+            # kfp retryPolicy: re-run the executor on failure. Every attempt
+            # gets its OWN dir — including its own artifacts dir, so a failed
+            # attempt's partial artifact files can never satisfy the
+            # missing-check for (or be published as) a later attempt's output
+            for attempt in range(retries + 1):
+                attempt_dir = (
+                    run_dir / tname if attempt == 0
+                    else run_dir / tname / f"retry-{attempt}"
+                )
+                art_dir = attempt_dir / "artifacts"
+                exec_inputs = dict(inputs)
+                if out_artifacts:
+                    art_dir.mkdir(parents=True, exist_ok=True)
+                for a in out_artifacts:
+                    exec_inputs[a] = str(art_dir / a)
+                ok, out, err = self._exec_python_once(
+                    attempt_dir, source, fn_name, exec_inputs
+                )
+                if ok:
+                    missing = [
+                        a for a in out_artifacts if not (art_dir / a).exists()
+                    ]
+                    if missing:
+                        ok = False
+                        err = f"declared artifact(s) never written: {missing}"
+                    else:
+                        result.artifacts = {
+                            a: str(art_dir / a) for a in out_artifacts
+                        }
+                if ok or attempt == retries:
+                    break
         else:
-            # fan out over items (per-item subdir); output = collected list
+            # fan out over items (per-item subdir); output = collected list.
+            # retryPolicy applies PER ITEM (a transient failure re-runs just
+            # that item, not the whole fan-out)
             outs = []
             ok, err = True, ""
             for idx, item in enumerate(items):
                 sub = dict(inputs)
                 sub[it["itemInput"]] = item
-                ok, out_i, err = self._exec_python_once(
-                    run_dir / tname / f"it-{idx}", source, fn_name, sub
-                )
+                for attempt in range(retries + 1):
+                    it_dir = (
+                        run_dir / tname / f"it-{idx}" if attempt == 0
+                        else run_dir / tname / f"it-{idx}" / f"retry-{attempt}"
+                    )
+                    ok, out_i, err = self._exec_python_once(
+                        it_dir, source, fn_name, sub
+                    )
+                    if ok or attempt == retries:
+                        break
                 if not ok:
                     err = f"item {idx}: {err}"
                     break

@@ -353,3 +353,50 @@ class TestArtifacts:
 
         with pytest.raises(ValueError, match="dsl.artifact"):
             p()
+
+
+class TestRetryPolicy:
+    def test_retries_recover_transient_failure(self, tmp_path):
+        marker = tmp_path / "attempts"
+
+        @dsl.component
+        def flaky(counter_path: str) -> str:
+            import os
+            n = int(open(counter_path).read()) if os.path.exists(counter_path) else 0
+            open(counter_path, "w").write(str(n + 1))
+            if n < 2:
+                raise RuntimeError(f"transient failure #{n}")
+            return f"succeeded on attempt {n}"
+
+        @dsl.pipeline(name="retryp")
+        def p():
+            return dsl.retry(flaky(counter_path=str(marker)), 2)
+
+        ir = validate_ir(compile_pipeline(p()))
+        assert ir["root"]["dag"]["tasks"]["flaky"]["retryPolicy"] == {
+            "maxRetryCount": 2
+        }
+        run = LocalPipelineRunner(work_dir=str(tmp_path), cache=False).run(ir)
+        assert run.succeeded
+        assert run.output == "succeeded on attempt 2"
+        # failed attempts keep their own dirs for inspection
+        run_dir = next((tmp_path / "runs").iterdir())
+        assert (run_dir / "flaky" / "retry-1").exists()
+
+    def test_no_retries_fails_first_time(self, tmp_path):
+        marker = tmp_path / "attempts2"
+
+        @dsl.component
+        def flaky2(counter_path: str) -> str:
+            import os
+            n = int(open(counter_path).read()) if os.path.exists(counter_path) else 0
+            open(counter_path, "w").write(str(n + 1))
+            raise RuntimeError("always fails")
+
+        @dsl.pipeline(name="retryp2")
+        def p():
+            flaky2(counter_path=str(marker))
+
+        run = _run(p(), tmp_path)
+        assert not run.succeeded
+        assert open(marker).read() == "1"  # exactly one attempt
