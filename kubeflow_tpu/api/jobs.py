@@ -216,6 +216,60 @@ def apply_elastic_scale(job: TrainJob, replicas: int) -> None:
             sp.min_available = min(sp.min_available, job.total_replicas())
 
 
+TRAIN_FAMILIES = ("mnist", "resnet", "bert", "bert_pretrain", "gpt")
+
+
+def build_example_train_job(
+    name: str,
+    *,
+    family: str,
+    num_workers: int = 1,
+    namespace: str = "default",
+    device: str = "auto",
+    args: list | None = None,
+    interpreter: str = "python",
+    working_dir: str = "",
+    elastic: tuple | None = None,
+) -> "JAXJob":
+    """The ONE builder behind TrainingClient.train() and RemoteClient.train():
+    a JAXJob running `<interpreter> -m examples.<family>`. In-process clients
+    pass sys.executable + the repo root; remote clients pass the symbolic
+    "python" and no working_dir — the SERVER's pod runtime resolves both."""
+    from kubeflow_tpu.api.common import (
+        ContainerSpec,
+        ElasticPolicy,
+        ObjectMeta,
+        PodTemplateSpec,
+        ReplicaSpec,
+        RunPolicy,
+    )
+
+    if family not in TRAIN_FAMILIES:
+        raise ValueError(f"unknown family {family!r} (one of {TRAIN_FAMILIES})")
+    rp = RunPolicy()
+    if elastic is not None:
+        lo, hi = elastic
+        if not (lo <= num_workers <= hi):
+            raise ValueError(
+                f"num_workers {num_workers} outside elastic range [{lo}, {hi}]"
+            )
+        rp.elastic_policy = ElasticPolicy(min_replicas=lo, max_replicas=hi)
+    return JAXJob(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=JAXJobSpec(
+            replica_specs={REPLICA_WORKER: ReplicaSpec(
+                replicas=num_workers,
+                template=PodTemplateSpec(container=ContainerSpec(
+                    command=[interpreter, "-m", f"examples.{family}",
+                             f"--device={device}", *(args or [])],
+                    working_dir=working_dir,
+                )),
+            )},
+            run_policy=rp,
+        ),
+    )
+
+
 _KIND_TO_CLS = {
     JobKind.JAX: JAXJob,
     JobKind.TF: TFJob,

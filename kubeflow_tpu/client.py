@@ -212,48 +212,15 @@ class TrainingClient:
         """
         import sys as _sys
 
-        from kubeflow_tpu.api.common import (
-            ContainerSpec,
-            ElasticPolicy,
-            ObjectMeta,
-            PodTemplateSpec,
-            ReplicaSpec,
-            RunPolicy,
-        )
-        from kubeflow_tpu.api.jobs import JAXJob, JAXJobSpec
+        from kubeflow_tpu.api.jobs import build_example_train_job
 
-        families = ("mnist", "resnet", "bert", "bert_pretrain", "gpt")
-        if family not in families:
-            raise ValueError(f"unknown family {family!r} (one of {families})")
-        cmd = [_sys.executable, "-m", f"examples.{family}",
-               f"--device={device}", *(args or [])]
-        rp = RunPolicy()
-        if elastic is not None:
-            lo, hi = elastic
-            if not (lo <= num_workers <= hi):
-                raise ValueError(
-                    f"num_workers {num_workers} outside elastic range "
-                    f"[{lo}, {hi}]"
-                )
-            rp.elastic_policy = ElasticPolicy(min_replicas=lo, max_replicas=hi)
-        job = JAXJob(
-            metadata=ObjectMeta(name=name, namespace=namespace),
-            spec=JAXJobSpec(
-                replica_specs={REPLICA_WORKER: ReplicaSpec(
-                    replicas=num_workers,
-                    template=PodTemplateSpec(
-                        container=ContainerSpec(
-                            command=cmd,
-                            # `examples` is a repo-root package, not an
-                            # installed one: anchor the worker's cwd so
-                            # module resolution never depends on where the
-                            # SDK caller happens to run from
-                            working_dir=str(Path(__file__).resolve().parents[1]),
-                        )
-                    ),
-                )},
-                run_policy=rp,
-            ),
+        job = build_example_train_job(
+            name, family=family, num_workers=num_workers, namespace=namespace,
+            device=device, args=args, elastic=elastic,
+            # in-process: same environment, so the concrete interpreter and
+            # the repo root are correct here
+            interpreter=_sys.executable,
+            working_dir=str(Path(__file__).resolve().parents[1]),
         )
         self.create_job(job)
         if not wait:
@@ -268,15 +235,9 @@ class TrainingClient:
             )
             detail = f": {failed.message}" if failed and failed.message else ""
             raise RuntimeError(f"train job {name} failed{detail}")
-        from kubeflow_tpu.train.metrics import parse_line
+        from kubeflow_tpu.train.metrics import extract_final_metrics
 
-        final: dict[str, float] = {}
-        for line in self.get_job_logs(name, namespace).splitlines():
-            parsed = parse_line(line)
-            final.update(
-                {k: v for k, v in parsed.items() if k.startswith("final_")}
-            )
-        return final
+        return extract_final_metrics(self.get_job_logs(name, namespace))
 
     def wait_for_job_conditions(
         self,

@@ -129,10 +129,18 @@ class PodRuntime:
             log_path.parent.mkdir(parents=True, exist_ok=True)
             env = dict(os.environ) if self.inherit_env else {}
             env.update(pod.env)
+            command = list(pod.command)
+            if command and command[0] == "python":
+                # symbolic interpreter: manifests and remote clients say
+                # "python"; the SERVER resolves it to its own interpreter
+                # (client-side sys.executable may not exist here)
+                import sys as _sys
+
+                command[0] = _sys.executable
             try:
                 with open(log_path, "wb") as logf:  # child dups the fd
                     proc = subprocess.Popen(
-                        pod.command,
+                        command,
                         env=env,
                         stdout=logf,
                         stderr=subprocess.STDOUT,

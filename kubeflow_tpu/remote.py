@@ -123,6 +123,51 @@ class RemoteClient:
             "jobs", name, namespace, timeout_s, poll_s, terminal
         )
 
+    def train(
+        self,
+        name: str,
+        *,
+        family: str = "mnist",
+        num_workers: int = 1,
+        namespace: str = "default",
+        device: str = "auto",
+        args: list[str] | None = None,
+        elastic: tuple | None = None,
+        wait: bool = True,
+        timeout_s: float = 3600.0,
+    ) -> dict[str, float]:
+        """Remote twin of TrainingClient.train(): build the examples.<family>
+        JAXJob client-side, POST it over REST, ride the watch stream to a
+        terminal condition, and parse final_* metrics from worker-0's log.
+        The command uses the SYMBOLIC interpreter "python" and no working
+        dir — the server's pod runtime resolves both server-side (this
+        client's own paths may not exist there)."""
+        from kubeflow_tpu.api.jobs import build_example_train_job
+        from kubeflow_tpu.api.serde import job_to_dict
+
+        job = build_example_train_job(
+            name, family=family, num_workers=num_workers, namespace=namespace,
+            device=device, args=args, elastic=elastic,
+        )
+        self.apply(job_to_dict(job))
+        if not wait:
+            return {}
+        done = self.wait_for_job(name, namespace, timeout_s=timeout_s)
+        conds = [
+            c for c in done.get("status", {}).get("conditions", [])
+            if c.get("status", True)
+        ]
+        if not any(c["type"] == "Succeeded" for c in conds):
+            failed = next((c for c in conds if c["type"] == "Failed"), None)
+            detail = (
+                f": {failed.get('message')}" if failed and failed.get("message")
+                else f": {sorted(c['type'] for c in conds)}"
+            )
+            raise RuntimeError(f"train job {name} failed{detail}")
+        from kubeflow_tpu.train.metrics import extract_final_metrics
+
+        return extract_final_metrics(self.job_logs(name, namespace))
+
     # ------------------------------------------------------------- pipelines
 
     def submit_pipeline_run(

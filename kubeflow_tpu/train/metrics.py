@@ -36,6 +36,16 @@ def parse_line(line: str) -> dict[str, float]:
     return {m.group(1): float(m.group(2)) for m in METRIC_RE.finditer(line)}
 
 
+def extract_final_metrics(log_text: str) -> dict[str, float]:
+    """final_* scalars from a worker log (the train() helpers' contract)."""
+    final: dict[str, float] = {}
+    for line in log_text.splitlines():
+        final.update(
+            {k: v for k, v in parse_line(line).items() if k.startswith("final_")}
+        )
+    return final
+
+
 class TfEventsWriter:
     """Scalar tfevents emission for TensorBoard (SURVEY.md §5.1: the
     reference's TensorBoard story — Tensorboard CR + tfevent collectors).

@@ -299,3 +299,17 @@ def test_wait_for_experiment_via_watch(remote, tmp_path):
     exp = remote.wait_for_experiment("watch-exp", timeout_s=120)
     assert exp["status"]["condition"] == "Succeeded"
     assert exp["status"]["trialsSucceeded"] >= 3
+
+
+def test_remote_train_convenience(remote):
+    """RemoteClient.train(): REST twin of TrainingClient.train()."""
+    final = remote.train(
+        "remote-train", family="mnist", device="cpu",
+        args=["--epochs=20"], timeout_s=300,
+    )
+    assert final.get("final_accuracy", 0) > 0.9
+
+
+def test_remote_train_unknown_family(remote):
+    with pytest.raises(ValueError, match="unknown family"):
+        remote.train("x", family="nope")
