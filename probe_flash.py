@@ -1,0 +1,178 @@
+"""Mosaic validation of the pallas flash kernels on real TPU hardware.
+
+VERDICT r2 weak #3 / next #3: the flash fwd+bwd kernels had only been
+validated in CPU interpret mode. This probe, run by tunnel_watch.sh at the
+next live window, produces the hardware pass/fail record:
+
+  1. correctness: flash fwd+bwd vs blockwise_attention (the XLA online-
+     softmax reference) at production shapes, causal and non-causal, bf16;
+  2. the VMEM block-size sweep (block in 128/256/512) timing fwd+bwd at
+     GPT-2-small 2k-context shapes, vs the XLA blockwise fallback.
+
+Timing protocol per docs/perf.md: device-born args, warmup dispatches, and a
+final device->host read as the only true sync (block_until_ready returns
+early through the axon tunnel). Mosaic COMPILE failures are recorded as
+FAIL lines and the probe still exits 0 (the verdict was captured; a retry
+would not change it). Tunnel hangs exit nonzero via the watchdog so
+tunnel_watch retries at a later window.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+WATCHDOG_S = 300.0
+_last = [time.monotonic()]
+
+
+def _pet():
+    _last[0] = time.monotonic()
+
+
+def _watchdog():
+    while True:
+        time.sleep(5.0)
+        if time.monotonic() - _last[0] > WATCHDOG_S:
+            print(f"RESULT watchdog=hang idle_s={WATCHDOG_S}", flush=True)
+            os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("KFT_BENCH_PLATFORM"):
+        # debugging escape hatch (the axon sitecustomize force-registers the
+        # TPU plugin; a config update wins over JAX_PLATFORMS env)
+        jax.config.update("jax_platforms", os.environ["KFT_BENCH_PLATFORM"])
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel.ring_attention import (
+        blockwise_attention,
+        flash_attention,
+    )
+
+    dev = jax.devices()[0]
+    print(f"RESULT device_kind={dev.device_kind!r} platform={dev.platform}",
+          flush=True)
+    # tiny op proves the tunnel moves data before we queue compiles
+    float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
+    _pet()
+
+    def born(*shape, key, dtype=jnp.bfloat16):
+        # device-born: output of an on-device op, so later dispatches don't
+        # re-upload host buffers every call (axon quirk, docs/perf.md)
+        x = jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+        return jax.jit(lambda v: (v * 0.125).astype(dtype))(x)
+
+    # ---- 1. correctness at production shape (GPT-2s heads) ----------------
+    b, l, h, d = 2, 1024, 12, 64
+    q = born(b, l, h, d, key=0)
+    k = born(b, l, h, d, key=1)
+    v = born(b, l, h, d, key=2)
+    bias = jnp.zeros((b, 1, 1, l), jnp.bfloat16)
+    ct = born(b, l, h, d, key=3)
+
+    for causal in (False, True):
+        tag = "causal" if causal else "full"
+
+        def loss_flash(q, k, v, bias):
+            return (flash_attention(q, k, v, bias, block=256,
+                                    causal=causal).astype(jnp.float32)
+                    * ct.astype(jnp.float32)).sum()
+
+        def loss_ref(q, k, v, bias):
+            return (blockwise_attention(q, k, v, bias, block=256,
+                                        causal=causal).astype(jnp.float32)
+                    * ct.astype(jnp.float32)).sum()
+
+        try:
+            gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2, 3)))
+            gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))
+            of = jax.jit(lambda *a: flash_attention(*a, block=256,
+                                                    causal=causal))
+            orf = jax.jit(lambda *a: blockwise_attention(*a, block=256,
+                                                         causal=causal))
+            out_err = float(jnp.max(jnp.abs(
+                of(q, k, v, bias).astype(jnp.float32)
+                - orf(q, k, v, bias).astype(jnp.float32))))
+            _pet()
+            errs = []
+            for a, b_ in zip(gf(q, k, v, bias), gr(q, k, v, bias)):
+                errs.append(float(jnp.max(jnp.abs(
+                    a.astype(jnp.float32) - b_.astype(jnp.float32)))))
+            _pet()
+            # bf16 tolerances: one ulp at these magnitudes is ~0.03; grads
+            # accumulate over 1024 keys in f32 then round once
+            ok = out_err < 0.05 and max(errs[:3]) < 0.25 and errs[3] < 2.0
+            print(f"RESULT mosaic_correctness_{tag}="
+                  f"{'PASS' if ok else 'FAIL'} out_err={out_err:.4g} "
+                  f"dq_err={errs[0]:.4g} dk_err={errs[1]:.4g} "
+                  f"dv_err={errs[2]:.4g} dbias_err={errs[3]:.4g}", flush=True)
+        except Exception as exc:  # noqa: BLE001 — record the Mosaic verdict
+            print(f"RESULT mosaic_correctness_{tag}=FAIL "
+                  f"error={type(exc).__name__}", flush=True)
+            traceback.print_exc(file=sys.stderr)
+
+    # ---- 2. block-size sweep at GPT-2s 2k shapes --------------------------
+    b, l, h, d = 4, 2048, 12, 64
+    q = born(b, l, h, d, key=10)
+    k = born(b, l, h, d, key=11)
+    v = born(b, l, h, d, key=12)
+    bias = jnp.zeros((b, 1, 1, l), jnp.bfloat16)
+    ct = born(b, l, h, d, key=13)
+    # causal attention: QK^T + PV are 2·b·h·l²·d each, halved by the mask;
+    # backward recomputes scores and forms dq/dk/dv/ds ≈ 2.5x forward
+    fwd_flops = 2 * 2 * b * h * l * l * d * 0.5
+    total_flops = fwd_flops * 3.5
+
+    def timed(fn, *args, iters=8):
+        val = fn(*args)
+        val = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: float(x.astype(jnp.float32).sum()), val)
+        _pet()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            val = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: float(x.astype(jnp.float32).sum()), val)
+        return (time.perf_counter() - t0) / iters
+
+    def fwd_bwd(attn, **kw):
+        def loss(q, k, v, bias):
+            return (attn(q, k, v, bias, **kw).astype(jnp.float32)
+                    * ct.astype(jnp.float32)).sum()
+
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    for block in (128, 256, 512):
+        try:
+            dt = timed(fwd_bwd(flash_attention, block=block, causal=True),
+                       q, k, v, bias)
+            print(f"RESULT flash_block{block}_ms={dt * 1e3:.2f} "
+                  f"tflops={total_flops / dt / 1e12:.2f}", flush=True)
+        except Exception as exc:  # noqa: BLE001
+            print(f"RESULT flash_block{block}_ms=FAIL "
+                  f"error={type(exc).__name__}", flush=True)
+            traceback.print_exc(file=sys.stderr)
+        _pet()
+    try:
+        dt = timed(fwd_bwd(blockwise_attention, block=256, causal=True),
+                   q, k, v, bias)
+        print(f"RESULT xla_blockwise_ms={dt * 1e3:.2f} "
+              f"tflops={total_flops / dt / 1e12:.2f}", flush=True)
+    except Exception as exc:  # noqa: BLE001
+        print(f"RESULT xla_blockwise_ms=FAIL error={type(exc).__name__}",
+              flush=True)
+    print("RESULT probe_flash=complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

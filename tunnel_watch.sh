@@ -31,8 +31,8 @@ stage() {  # stage <artifact> <timeout_s> <cmd...>
 }
 
 while :; do
-  if [ -f probe_results.txt.done ] && [ -f bench_r2_fixed.jsonl.done ] \
-     && [ -f probe_bert.txt.done ]; then
+  if [ -f probe_results.txt.done ] && [ -f bench_r3_fixed.jsonl.done ] \
+     && [ -f probe_flash.txt.done ] && [ -f probe_bert.txt.done ]; then
     echo "all stages captured at $(date -u +%H:%M:%S)" >> tunnel_watch.log
     exit 0
   fi
@@ -47,8 +47,10 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
     echo "=== tunnel alive at $(date -u +%H:%M:%S) ===" >> tunnel_watch.log
     # on any stage failure, back off before re-probing: a fast-failing stage
     # must not hot-loop against an alive tunnel
-    { stage probe_results.txt 1800 python -u probe_ops.py \
-        && stage bench_r2_fixed.jsonl 3600 python bench.py --suite \
+    { stage bench_r3_fixed.jsonl 3600 env KFT_BENCH_DEADLINE_S=3300 \
+          python bench.py --suite \
+        && stage probe_results.txt 1800 python -u probe_ops.py \
+        && stage probe_flash.txt 1500 python -u probe_flash.py \
         && stage probe_bert.txt 1500 python -u probe_bert.py; } || sleep 180
   else
     sleep 180
