@@ -176,7 +176,11 @@ class LocalPipelineRunner:
         deps = set(spec.get("dependentTasks", []))
         refs = list(spec.get("inputs", {}).get("parameters", {}).values())
         for cond in spec.get("when", []):
+            # BOTH sides: validate_ir's all_deps and the DSL include rhs
+            # producers too; hand-authored IR must topo-order (and
+            # skip-cascade) against them identically (ADVICE r2)
             refs.append(cond.get("lhs", {}))
+            refs.append(cond.get("rhs", {}))
         it = spec.get("iterator")
         if it is not None:
             refs.append(it.get("items", {}))

@@ -6,9 +6,11 @@ Here the gRPC surface wraps the SAME ModelServer instance the HTTP handler
 uses — one model registry, one micro-batcher, one request logger — so the
 two protocols can never disagree about readiness or model state.
 
-Wire details follow the public OIP gRPC contract: typed flat contents
-(fp32_contents etc.) row-major over `shape`; service/method names match
-kserve/triton so a generic OIP gRPC client interoperates. Wiring uses
+Wire details follow the public grpc_predict_v2.proto exactly — package and
+service name (`/inference.GRPCInferenceService/...`), nested tensor
+messages, public field numbers, typed flat contents AND triton-style
+raw_input_contents — so a generic OIP gRPC client interoperates (ADVICE r2:
+a private package/renumbered fields broke that; fixed). Wiring uses
 `method_handlers_generic_handler` like sweep/rpc.py (no grpc_tools codegen
 plugin in this image).
 """
@@ -23,7 +25,7 @@ import numpy as np
 
 from kubeflow_tpu.protos import inference_pb2 as pb
 
-INFERENCE_SERVICE = "kubeflow_tpu.inference.GRPCInferenceService"
+INFERENCE_SERVICE = "inference.GRPCInferenceService"
 
 # OIP datatype -> (numpy dtype, typed contents field). The dtype SET is
 # derived from the HTTP handler's _V2_TO_NP so the two protocols accept the
@@ -35,11 +37,12 @@ from kubeflow_tpu.serving.server import _V2_TO_NP as _HTTP_DT  # noqa: E402
 
 def _contents_field(np_dtype) -> str:
     kind = np.dtype(np_dtype).kind
+    sz = np.dtype(np_dtype).itemsize
     return {
         "b": "bool_contents",
-        "i": "int64_contents" if np.dtype(np_dtype).itemsize == 8 else "int_contents",
-        "u": "uint_contents",
-        "f": "fp64_contents" if np.dtype(np_dtype).itemsize == 8 else "fp32_contents",
+        "i": "int64_contents" if sz == 8 else "int_contents",
+        "u": "uint64_contents" if sz == 8 else "uint_contents",
+        "f": "fp64_contents" if sz == 8 else "fp32_contents",
     }[kind]
 
 
@@ -48,19 +51,30 @@ _DT["UINT32"] = (np.uint32, "uint_contents")
 _NP_TO_DT = {np.dtype(v[0]): k for k, v in _DT.items()}
 
 
-def _to_array(t: pb.InferInputTensor) -> np.ndarray:
+def _to_array(t: pb.ModelInferRequest.InferInputTensor,
+              raw: bytes | None = None) -> np.ndarray:
     dt, field = _DT[t.datatype]  # caller validates membership + count first
+    if raw is not None:  # triton-style raw little-endian payload
+        return np.frombuffer(raw, dtype=np.dtype(dt).newbyteorder("<")) \
+            .astype(dt).reshape(tuple(t.shape))
     data = getattr(t.contents, field)
     return np.asarray(data, dtype=dt).reshape(tuple(t.shape))
 
 
-def _to_tensor(name: str, arr: np.ndarray) -> pb.InferOutputTensor:
+def _resolve_dtype(arr) -> tuple[np.ndarray, str]:
+    """One wire-dtype decision for typed AND raw responses: bf16 / f16 and
+    friends travel as FP32."""
     arr = np.asarray(arr)
     dtype = _NP_TO_DT.get(arr.dtype)
-    if dtype is None:  # bf16 / f16 and friends travel as FP32
-        arr = arr.astype(np.float32)
-        dtype = "FP32"
-    out = pb.InferOutputTensor(name=name, datatype=dtype, shape=list(arr.shape))
+    if dtype is None:
+        arr, dtype = arr.astype(np.float32), "FP32"
+    return arr, dtype
+
+
+def _to_tensor(name: str, arr: np.ndarray) -> pb.ModelInferResponse.InferOutputTensor:
+    arr, dtype = _resolve_dtype(arr)
+    out = pb.ModelInferResponse.InferOutputTensor(
+        name=name, datatype=dtype, shape=list(arr.shape))
     getattr(out.contents, _DT[dtype][1]).extend(arr.ravel().tolist())
     return out
 
@@ -94,7 +108,7 @@ class InferenceGrpcService:
         )
         im = self.ms.input_metadata(m)  # shared with HTTP v2
         if im is not None:
-            resp.inputs.append(pb.TensorMetadata(
+            resp.inputs.append(pb.ModelMetadataResponse.TensorMetadata(
                 name=im["name"], datatype=im["datatype"], shape=im["shape"]
             ))
         return resp
@@ -121,27 +135,53 @@ class InferenceGrpcService:
         want = 1
         for d in t.shape:
             want *= d
-        field = _DT[t.datatype][1]
-        got = len(getattr(t.contents, field))
-        if got != want:
-            ctx.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
-                f"{field} carries {got} elements but shape {list(t.shape)} "
-                f"needs {want}",
-            )
+        raw = req.raw_input_contents[0] if req.raw_input_contents else None
+        if raw is not None:
+            itemsize = np.dtype(_DT[t.datatype][0]).itemsize
+            if len(raw) != want * itemsize:
+                ctx.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"raw_input_contents[0] carries {len(raw)} bytes but "
+                    f"shape {list(t.shape)} x {t.datatype} needs "
+                    f"{want * itemsize}",
+                )
+        else:
+            field = _DT[t.datatype][1]
+            got = len(getattr(t.contents, field))
+            if got != want:
+                ctx.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"{field} carries {got} elements but shape {list(t.shape)} "
+                    f"needs {want}",
+                )
         t0 = _time.perf_counter()
         try:
-            arr = _to_array(req.inputs[0])
+            arr = _to_array(t, raw)
             out = self.ms._call_model(m, arr)
         except Exception as exc:  # noqa: BLE001 — surface as INTERNAL, not a crash
             self.ms.logger.log(name, "v2-grpc", 500,
                                _time.perf_counter() - t0, req.ByteSize(), 0)
             ctx.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
         arrays = self.ms.postprocess_arrays(out)  # shared with HTTP v2
-        resp = pb.ModelInferResponse(
-            model_name=name, model_version="1", id=req.id,
-            outputs=[_to_tensor(k, v) for k, v in arrays],
-        )
+        if raw is not None:
+            # raw in -> raw out (the triton client convention: a client that
+            # speaks raw_input_contents reads raw_output_contents)
+            outputs, raws = [], []
+            for k, v in arrays:
+                a, dtname = _resolve_dtype(v)
+                outputs.append(pb.ModelInferResponse.InferOutputTensor(
+                    name=k, datatype=dtname, shape=list(a.shape)))
+                raws.append(np.ascontiguousarray(
+                    a.astype(a.dtype.newbyteorder("<"))).tobytes())
+            resp = pb.ModelInferResponse(
+                model_name=name, model_version="1", id=req.id,
+                outputs=outputs, raw_output_contents=raws,
+            )
+        else:
+            resp = pb.ModelInferResponse(
+                model_name=name, model_version="1", id=req.id,
+                outputs=[_to_tensor(k, v) for k, v in arrays],
+            )
         self.ms.logger.log(
             name, "v2-grpc", 200, _time.perf_counter() - t0,
             req.ByteSize(), resp.ByteSize(),
@@ -219,18 +259,24 @@ class InferenceGrpcClient:
         if dtype is None:
             arr = arr.astype(np.float32)
             dtype = "FP32"
-        t = pb.InferInputTensor(name="input-0", datatype=dtype,
-                                shape=list(arr.shape))
+        t = pb.ModelInferRequest.InferInputTensor(
+            name="input-0", datatype=dtype, shape=list(arr.shape))
         getattr(t.contents, _DT[dtype][1]).extend(arr.ravel().tolist())
         resp = self._infer(pb.ModelInferRequest(
             model_name=name, id=request_id, inputs=[t]
         ))
         out = {}
-        for o in resp.outputs:
+        for i, o in enumerate(resp.outputs):
             dt, field = _DT[o.datatype]
-            out[o.name] = np.asarray(
-                getattr(o.contents, field), dtype=dt
-            ).reshape(tuple(o.shape))
+            if resp.raw_output_contents:  # raw-speaking server
+                out[o.name] = np.frombuffer(
+                    resp.raw_output_contents[i],
+                    dtype=np.dtype(dt).newbyteorder("<"),
+                ).astype(dt).reshape(tuple(o.shape))
+            else:
+                out[o.name] = np.asarray(
+                    getattr(o.contents, field), dtype=dt
+                ).reshape(tuple(o.shape))
         return out
 
     def close(self) -> None:

@@ -400,3 +400,33 @@ class TestRetryPolicy:
         run = _run(p(), tmp_path)
         assert not run.succeeded
         assert open(marker).read() == "1"  # exactly one attempt
+
+
+class TestRhsDependencyEdge:
+    def test_rhs_producer_failure_cascades_skip(self, tmp_path):
+        """Hand-authored IR may omit dependentTasks; the when-condition's RHS
+        ref alone must order the conditioned task after its producer and
+        cascade a skip when the producer fails (ADVICE r2: _deps_of collected
+        only lhs, so the rhs silently compared against None)."""
+
+        @dsl.component
+        def boom() -> int:
+            raise RuntimeError("no value")
+
+        @dsl.component
+        def act() -> str:
+            return "ran"
+
+        @dsl.pipeline(name="rhsdep")
+        def p():
+            v = boom()
+            with dsl.when(5, ">", v):
+                act()
+
+        ir = validate_ir(compile_pipeline(p()))
+        # simulate hand-authored IR: the edge lives only in the when-ref
+        ir["root"]["dag"]["tasks"]["act"]["dependentTasks"] = []
+        run = LocalPipelineRunner(work_dir=str(tmp_path), cache=False).run(ir)
+        assert not run.succeeded
+        assert run.tasks["boom"].state == TaskState.FAILED
+        assert run.tasks["act"].state == TaskState.SKIPPED
