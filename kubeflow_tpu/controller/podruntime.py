@@ -32,17 +32,22 @@ except Exception:  # noqa: BLE001 — non-Linux/no-libc degrades to stop()/atexi
     _LIBC = None
 
 
-def _die_with_parent() -> None:
+def _die_with_parent(runtime_pid: int) -> None:
     """Child-side preexec: SIGKILL this pod if the runtime process dies.
 
     Teardown hygiene (VERDICT r2 weak #7): atexit/stop() cannot run when the
     hosting process is SIGTERM/SIGKILLed (an aborted pytest run was observed
     leaking a serving.server pod across sessions), but the kernel delivers
-    PR_SET_PDEATHSIG regardless of how the parent died. Only the pre-bound
-    libc call happens here — fork-safe by construction.
+    PR_SET_PDEATHSIG regardless of how the parent died. Only pre-bound libc
+    calls and raw syscalls happen here — fork-safe by construction. The
+    post-prctl getppid check closes the race where the runtime dies between
+    fork() and prctl(): the reparented child sees a different parent and
+    exits instead of leaking unarmed.
     """
     if _LIBC is not None:
         _LIBC.prctl(1, signal.SIGKILL, 0, 0, 0)  # 1 = PR_SET_PDEATHSIG
+        if os.getppid() != runtime_pid:
+            os._exit(1)
 
 
 class PodRuntime:
@@ -179,7 +184,7 @@ class PodRuntime:
                         stderr=subprocess.STDOUT,
                         cwd=pod.working_dir or None,
                         start_new_session=True,  # isolate signals per pod
-                        preexec_fn=_die_with_parent,
+                        preexec_fn=lambda pid=os.getpid(): _die_with_parent(pid),
                     )
             except OSError as exc:
                 pod.status.phase = PodPhase.FAILED

@@ -34,6 +34,7 @@ from kubeflow_tpu.parallel.mesh import (
     AXIS_FSDP,
     AXIS_MODEL,
 )
+from kubeflow_tpu.parallel.sharding import BATCH_AXES
 from kubeflow_tpu.parallel.moe import MOE_PARTITION_RULES, MoeMlp
 
 # Param-path regex -> PartitionSpec. fsdp shards the "long" dim that the
@@ -122,12 +123,25 @@ class BertConfig:
         return BertConfig(**d)
 
 
+# (B, H, L_q, L_k) attention scores: batch over the canonical data-like axes
+# (sharding.BATCH_AXES — one definition, so specs cannot drift when a
+# data-like axis is added), heads over `model`, query positions over
+# `context` (matching ACT_SPEC's L sharding; the key dim is reduced by the
+# softmax and stays gathered, the best dense attention can do under SP).
+# Pinned explicitly because inside remat/scan regions (pipeline stages) the
+# partitioner otherwise picks a different sharding for the forward residual
+# than the backward wants, triggering an involuntary full-remat reshard of
+# the scores gradient at the shard_map boundary.
+SCORES_SPEC = P(BATCH_AXES, AXIS_MODEL, AXIS_CONTEXT, None)
+
+
 def dense_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0, block=None):
     """Reference softmax attention: (B, L, H, D) tensors, additive bias."""
     depth = q.shape[-1]
     scores = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(depth).astype(q.dtype)
     if bias is not None:
         scores = scores + bias
+    scores = constrain(scores, SCORES_SPEC)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     if dropout_rng is not None and dropout_rate > 0.0:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)

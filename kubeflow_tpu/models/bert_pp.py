@@ -71,7 +71,10 @@ class _Head(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        cls = x[:, 0]
+        # batch-pin the CLS slice: its backward (a pad into the ring-exit
+        # cotangent) otherwise inherits the pooler kernel's hidden sharding
+        # and full-remats at the pipeline shard_map boundary
+        cls = constrain(x[:, 0], P((*ACT_SPEC[0],), None))
         pooled = jnp.tanh(nn.Dense(self.cfg.hidden_size, dtype=self.cfg.dtype,
                                    name="pooler")(cls))
         pooled = nn.Dropout(self.cfg.dropout_rate, deterministic=not train)(pooled)
@@ -100,10 +103,6 @@ class BertPipelineClassifier:
             raise ValueError(
                 f"num_layers {cfg.num_layers} not divisible by "
                 f"num_stages {num_stages}"
-            )
-        if cfg.moe_experts:
-            raise NotImplementedError(
-                "MoE inside a pipeline stage is not supported yet"
             )
         self.cfg = cfg
         self.num_classes = num_classes
@@ -150,9 +149,13 @@ class BertPipelineClassifier:
 
     def apply(self, variables, input_ids, rngs=None, train: bool = False,
               mutable=None, **_ignored):
-        out = self._apply(variables, input_ids, rngs=rngs, train=train)
-        # flax contract: apply with `mutable` returns (out, updates)
-        return (out, {}) if mutable is not None else out
+        out, aux = self._apply(variables, input_ids, rngs=rngs, train=train)
+        if mutable is not None:
+            # flax contract: apply with `mutable` returns (out, updates); the
+            # Trainer folds every 'losses' leaf into the objective
+            upd = {"losses": {"moe_aux": aux}} if aux is not None else {}
+            return out, upd
+        return out
 
     def _apply(self, variables, input_ids, rngs=None, train: bool = False):
         p = variables["params"]
@@ -169,22 +172,44 @@ class BertPipelineClassifier:
         # pass (CHECK crash); stages still compute in the model dtype
         x = x.astype(jnp.float32)
 
-        def stage_fn(sp, act, *, stage, rng):
-            h, m = act
-            srngs = {"dropout": rng} if (train and rng is not None) else {}
-            h = self._stage.apply(
-                {"params": sp}, h.astype(c.dtype), m > 0, train, rngs=srngs
-            )
-            return (constrain(h.astype(jnp.float32), ACT_SPEC), m)
+        moe = bool(c.moe_experts)
 
-        out, _ = gpipe(
+        def stage_fn(sp, act, *, stage, rng):
+            h, m = act[0], act[1]
+            srngs = {"dropout": rng} if (train and rng is not None) else {}
+            h, upd = self._stage.apply(
+                {"params": sp}, h.astype(c.dtype), m > 0, train, rngs=srngs,
+                mutable=["losses"],
+            )
+            h = constrain(h.astype(jnp.float32), ACT_SPEC)
+            if not moe:
+                return (h, m)
+            # MoE aux loss rides the ring as a per-example accumulator leaf
+            # ((B,) f32, same shape at every boundary — the gpipe contract):
+            # each stage adds ITS sown aux for THIS microbatch; the bubble's
+            # zero-fed microbatches are discarded with the rest of outbuf.
+            aux = sum(jax.tree.leaves(upd.get("losses", {})), 0.0)
+            return (h, m, act[2] + jnp.asarray(aux, jnp.float32))
+
+        act0 = (x, mask.astype(jnp.int8))
+        if moe:
+            act0 = (*act0, jnp.zeros((x.shape[0],), jnp.float32))
+        out = gpipe(
             stage_fn,
             p["stages"],
-            (x, mask.astype(jnp.int8)),
+            act0,
             self.n_micro,
             rng=drop if train else None,
         )
-        return self._head.apply(
-            {"params": p["head"]}, out, train,
+        # Pin the ring-exit activation to the canonical batch-sharded layout:
+        # without this the head's backward hands the ring a hidden-sharded
+        # cotangent and the partitioner full-remats it at the shard_map
+        # boundary (the composed-mesh involuntary-remat warning).
+        hid = constrain(out[0], ACT_SPEC)
+        logits = self._head.apply(
+            {"params": p["head"]}, hid, train,
             rngs={"dropout": drop} if (train and drop is not None) else {},
         )
+        # mean over examples == mean over microbatches of the per-microbatch
+        # aux sum — the same scale dense BERT's summed sow leaves carry
+        return logits, (out[2].mean() if moe else None)

@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from kubeflow_tpu.parallel.mesh import (
+    AXIS_CONTEXT,
     AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
@@ -120,9 +121,14 @@ class MoeMlp(nn.Module):
         if e % ep:
             raise ValueError(f"num_experts {e} not divisible by expert axis {ep}")
         # data-like extents: with local dispatch these axes join the manual
-        # region so the router's cumsum stays shard-local
+        # region so the router's cumsum stays shard-local. A context-sharded
+        # sequence dim joins too — routing is per-token, so context shards
+        # are just more local tokens (otherwise the partitioner must gather
+        # L at the dispatch boundary, a full-remat reshard under a pipeline
+        # ring with sequence parallelism).
         dp = 1 if mesh.empty else mesh.shape.get(AXIS_DATA, 1)
         fs = 1 if mesh.empty else mesh.shape.get(AXIS_FSDP, 1)
+        cp = 1 if mesh.empty else mesh.shape.get(AXIS_CONTEXT, 1)
 
         def ffn(xin, wu, bu, wd, bd):
             """Per-expert FFN: xin (E?, C?, H) against stacked weights."""
@@ -164,9 +170,9 @@ class MoeMlp(nn.Module):
         local = not self.global_dispatch
         manual: tuple = ()
         if not mesh.empty:
-            if local and (ep > 1 or dp > 1 or fs > 1):
+            if local and (ep > 1 or dp > 1 or fs > 1 or cp > 1):
                 manual = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
-                if x.shape[0] % (dp * fs * ep):
+                if x.shape[0] % (dp * fs * ep) or x.shape[1] % cp:
                     # local dispatch needs the batch dim split across ALL
                     # data-like axes; a batch that only divides the expert
                     # extent keeps the old expert-only manual region (global
@@ -175,19 +181,28 @@ class MoeMlp(nn.Module):
 
                     warnings.warn(
                         f"MoeMlp: batch {x.shape[0]} not divisible by the "
-                        f"data-like mesh extent {dp * fs * ep}; falling back "
+                        f"data-like mesh extent {dp * fs * ep} (or seq "
+                        f"{x.shape[1]} by context {cp}); falling back "
                         f"to GLOBAL dispatch (cross-shard routing cumsum, "
                         f"global capacity pool) — pad the batch for local "
                         f"dispatch",
                         stacklevel=2,
                     )
                     manual = (AXIS_EXPERT,) if ep > 1 else ()
+                elif cp > 1:
+                    # context-sharded tokens are just more local tokens
+                    manual = manual + (AXIS_CONTEXT,)
             elif ep > 1:
                 manual = (AXIS_EXPERT,)
         if not manual:
             y, aux = moe_body(x, router, w_up, b_up, w_down, b_down, ())
         else:
-            batch_spec = P(tuple(manual), None, None)
+            batch_axes = tuple(a for a in manual if a != AXIS_CONTEXT)
+            batch_spec = P(
+                batch_axes,
+                AXIS_CONTEXT if AXIS_CONTEXT in manual else None,
+                None,
+            )
             y, aux = jax.shard_map(
                 partial(moe_body, manual_axes=manual),
                 mesh=mesh,

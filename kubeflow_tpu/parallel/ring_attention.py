@@ -48,14 +48,20 @@ except Exception:  # pragma: no cover
 from kubeflow_tpu.parallel.mesh import (
     AXIS_CONTEXT,
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_MODEL,
 )
+from kubeflow_tpu.parallel.sharding import BATCH_AXES
 
 NEG_INF = -1e9
 
-QKV_SPEC = P((AXIS_DATA, AXIS_FSDP), AXIS_CONTEXT, AXIS_MODEL, None)
-BIAS_SPEC = P((AXIS_DATA, AXIS_FSDP), None, None, AXIS_CONTEXT)
+# batch rides ALL data-like axes — sharding.BATCH_AXES, the one canonical
+# definition (expert parallelism subdivides data parallelism; an earlier
+# hand-inlined tuple omitted expert and silently forced a batch gather at
+# the ring boundary)
+QKV_SPEC = P(BATCH_AXES, AXIS_CONTEXT, AXIS_MODEL, None)
+BIAS_SPEC = P(BATCH_AXES, None, None, AXIS_CONTEXT)
 
 
 def _context_size() -> int:

@@ -154,3 +154,51 @@ def test_pp_state_checkpoint_roundtrip(tmp_path, cpu_devices):
     # restored stage params keep the pipeline sharding
     qk = restored[1].params["stages"]["layer_0"]["attention"]["query"]["kernel"]
     assert qk.sharding.spec[0] == "pipeline"
+
+
+class TestMoeInsidePipeline:
+    """MoE stages inside the pipeline ring (VERDICT r2 next #4): the expert
+    all-to-all dispatch nests under the pipeline shard_map, and the sown
+    load-balance aux rides the ring as an activation leaf."""
+
+    def test_moe_pp_trains_with_aux_loss(self, cpu_devices):
+        from kubeflow_tpu.train import Trainer, TrainerConfig
+        from kubeflow_tpu.train.data import synthetic_text_dataset
+
+        cfg = BertConfig.tiny(dropout_rate=0.0, moe_experts=4)
+        mesh = build_mesh(MeshConfig(data=2, pipeline=2, expert=2),
+                          cpu_devices[:8])
+        bs = 8
+        ds = synthetic_text_dataset(n_train=bs * 2, n_test=bs, seq_len=16,
+                                    vocab_size=cfg.vocab_size)
+        model = BertPipelineClassifier(cfg, num_stages=2, n_micro=2)
+        trainer = Trainer(
+            model,
+            TrainerConfig(batch_size=bs, steps=1, log_every_steps=10**9),
+            mesh=mesh,
+        )
+        state = trainer.init_state(ds.x_train[:bs])
+        # expert weights sharded over BOTH pipeline (stage) and expert axes
+        wu = state.params["stages"]["layer_0"]["moe"]["w_up"]
+        assert wu.sharding.spec[0] == "pipeline"
+        assert wu.sharding.spec[1] == "expert"
+        losses = []
+        for _ in range(3):
+            state, m = trainer.train_step(
+                state, (ds.x_train[:bs], ds.y_train[:bs])
+            )
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(v) for v in losses)
+        assert losses[-1] < losses[0]
+
+    def test_moe_aux_reaches_objective(self, cpu_devices):
+        """apply(..., mutable=[...]) must surface the accumulated aux in the
+        'losses' collection — the Trainer folds it into the objective."""
+        cfg = BertConfig.tiny(dropout_rate=0.0, moe_experts=4)
+        model = BertPipelineClassifier(cfg, num_stages=2, n_micro=2)
+        x = jnp.zeros((4, 16), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        out, upd = model.apply(variables, x, mutable=["losses"])
+        assert out.shape == (4, 2)
+        aux = upd["losses"]["moe_aux"]
+        assert np.isfinite(float(aux)) and float(aux) > 0.0
