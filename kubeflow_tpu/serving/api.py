@@ -50,6 +50,10 @@ class PredictorSpec:
     env: dict[str, str] = field(default_factory=dict)
     # device flag forwarded to the server process (tpu|cpu)
     device: str = ""
+    # JAX runtime only: export + serialize the compiled predictor at deploy
+    # (serving/aot.py) — replicas load the artifact without retracing, and
+    # with a KFT_COMPILE_CACHE env the restart path compiles nothing
+    aot: bool = False
 
 
 @dataclass

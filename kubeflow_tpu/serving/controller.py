@@ -380,6 +380,8 @@ class InferenceServiceController(ControllerBase):
             cmd += ["--model-class", p.model_class]
         if p.device:
             cmd += ["--device", p.device]
+        if getattr(p, "aot", False):
+            cmd += ["--aot"]
         if p.max_batch_size > 0:
             # agent micro-batching: concurrent requests coalesce into one
             # forward pass up to this many rows (serving/agent.py)

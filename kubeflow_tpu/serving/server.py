@@ -21,6 +21,7 @@ Run as a pod: python -m kubeflow_tpu.serving.server --model-name m ...
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -398,6 +399,10 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--device", default="", help="tpu|cpu (default: env)")
+    ap.add_argument("--aot", action="store_true",
+                    help="jax runtime: export+serialize the compiled "
+                         "predictor at load if no artifact exists; replicas "
+                         "then serve the AOT artifact (serving/aot.py)")
     # agent features (SURVEY.md §2.5 Agent row)
     ap.add_argument("--request-log", default="",
                     help="JSONL request/response log path")
@@ -415,6 +420,13 @@ def main(argv: list[str] | None = None) -> None:
 
         select_device(args.device)
 
+    if os.environ.get("KFT_COMPILE_CACHE"):
+        # persistent XLA compile cache (serving/aot.py): pointed at the
+        # cache the deploy step warmed, an AOT cold start compiles nothing
+        from kubeflow_tpu.serving.aot import _compile_cache_on
+
+        _compile_cache_on(os.environ["KFT_COMPILE_CACHE"])
+
     if args.runtime == "custom":
         cls = load_model_class(args.model_class)
         model: Model = cls(args.model_name)
@@ -423,6 +435,14 @@ def main(argv: list[str] | None = None) -> None:
         if args.storage_uri:
             model_dir = pull_model(args.storage_uri, f"{args.model_dir}/{args.model_name}")
         if args.runtime == "jax":
+            if args.aot:
+                from kubeflow_tpu.serving.aot import aot_available, export_predictor
+
+                if not aot_available(model_dir):
+                    export_predictor(
+                        model_dir,
+                        compile_cache=os.environ.get("KFT_COMPILE_CACHE") or None,
+                    )
             model = JaxModel(args.model_name, model_dir)
         else:
             from kubeflow_tpu.serving.runtimes import build_runtime
