@@ -37,6 +37,13 @@ def main(argv: list[str] | None = None) -> float:
     p.add_argument("--context", type=int, default=1)
     # MoE: >0 swaps every MLP for a MoeMlp dispatched over `expert`
     p.add_argument("--moe-experts", type=int, default=0)
+    # NAS surface (SURVEY.md §2.4 ENAS/DARTS row): architecture fields are
+    # ordinary flags, so a sweep Experiment searches architecture space
+    # through the same trial-template substitution as any hyperparameter
+    # (samples/experiment_nas.yaml). 0 = keep the size preset's value.
+    p.add_argument("--num-layers", type=int, default=0)
+    p.add_argument("--num-heads", type=int, default=0)
+    p.add_argument("--mlp-dim", type=int, default=0)
     p.add_argument("--expert-parallel", type=int, default=1)
     # PP: >1 pipelines the encoder stack over the `pipeline` axis
     p.add_argument("--pipeline-stages", type=int, default=1)
@@ -56,12 +63,22 @@ def main(argv: list[str] | None = None) -> float:
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     mk = BertConfig.tiny if args.size == "tiny" else BertConfig.base
+    arch = {
+        k: v
+        for k, v in (
+            ("num_layers", args.num_layers),
+            ("num_heads", args.num_heads),
+            ("mlp_dim", args.mlp_dim),
+        )
+        if v > 0
+    }
     cfg = mk(
         dtype=dtype,
         attention=args.attention,
         max_len=max(args.seq_len, 512),
         dropout_rate=0.0 if args.attention != "dense" else 0.1,
         moe_experts=args.moe_experts,
+        **arch,
     )
     ds = synthetic_text_dataset(
         n_train=args.batch_size * 8,

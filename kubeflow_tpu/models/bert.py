@@ -111,6 +111,16 @@ class BertConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
 
+    def __post_init__(self):
+        # fail fast on malformed architectures: NAS sweeps feed these fields
+        # from search spaces, and a non-dividing head count would silently
+        # train a truncated model (head_dim floor-divides)
+        if self.hidden_size % self.num_heads:
+            raise ValueError(
+                f"hidden_size {self.hidden_size} not divisible by "
+                f"num_heads {self.num_heads}"
+            )
+
     @staticmethod
     def base(**kw) -> "BertConfig":
         return BertConfig(**kw)
