@@ -3,34 +3,180 @@
 Reference parity (unverified cites, SURVEY.md §2.5): kserve
 python/kserve/kserve/storage/storage.py, which runs as an initContainer and
 materializes gs://, s3://, pvc://, hf://, file:// URIs under /mnt/models.
-This environment has zero egress, so the remote schemes are gated with a
-clear error instead of stubbed-but-broken downloads; pvc:// resolves under a
-configurable local volume root (the PVC mount analogue).
+
+Remote schemes (gs/s3/hf/http) go through an ObjectStore provider:
+  - This environment has zero egress, so the default provider raises a
+    clear gated error rather than shipping stubbed-but-broken downloads.
+  - Setting KFTPU_OBJECT_STORE_EMULATOR=<dir> swaps in a file-backed
+    emulator with real object-store semantics — bucket/key-prefix listing,
+    per-object fetch, atomic materialization, and a (size, mtime) pull
+    cache — so every remote-scheme code path (layout, caching, error
+    handling) runs and is tested without egress. Emulator layout:
+    <root>/<scheme>/<bucket>/<key...> (e.g. <root>/gs/my-bucket/model/...).
+
+pvc:// resolves under a configurable local volume root (the PVC mount
+analogue); file:// and bare paths copy from the local filesystem.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
+from dataclasses import dataclass
 from pathlib import Path
+from urllib.parse import urlparse
 
 # PVC mount root: pvc://volume-name/sub/path -> $KFTPU_PVC_ROOT/volume-name/sub/path
 PVC_ROOT_ENV = "KFTPU_PVC_ROOT"
 DEFAULT_PVC_ROOT = ".kubeflow_tpu/volumes"
 
-_REMOTE_SCHEMES = ("gs://", "s3://", "hf://", "http://", "https://")
+# local tree emulating gs://, s3://, hf://, http(s):// object stores
+EMULATOR_ENV = "KFTPU_OBJECT_STORE_EMULATOR"
+
+_REMOTE_SCHEMES = ("gs", "s3", "hf", "http", "https")
+# per-destination pull cache: object key -> (size, mtime) of the fetched copy
+MANIFEST_FILE = ".kft_pull_manifest.json"
+
+
+@dataclass(frozen=True)
+class ObjectInfo:
+    key: str      # full key within the bucket
+    size: int
+    mtime: float
+
+
+class ObjectStore:
+    """Minimal object-store surface the initializer needs: prefix listing
+    and per-object fetch. Real GCS/S3/HF clients implement the same two
+    calls; this environment ships the file-backed emulator only."""
+
+    def list(self, bucket: str, prefix: str) -> list[ObjectInfo]:
+        raise NotImplementedError
+
+    def fetch(self, bucket: str, key: str, dest: Path) -> None:
+        raise NotImplementedError
+
+
+class EmulatedObjectStore(ObjectStore):
+    """File-backed emulator: <root>/<scheme>/<bucket>/<key...>."""
+
+    def __init__(self, scheme: str, root: Path):
+        self.root = Path(root) / scheme
+
+    def list(self, bucket: str, prefix: str) -> list[ObjectInfo]:
+        base = self.root / bucket
+        if not base.is_dir():
+            return []
+        prefix = prefix.strip("/")
+        out = []
+        for p in sorted(base.rglob("*")):
+            if not p.is_file() or p.name == MANIFEST_FILE:
+                continue
+            key = p.relative_to(base).as_posix()
+            # object-store semantics: prefix match on the KEY, with the
+            # "directory" boundary honored (prefix 'model' matches
+            # 'model/x' and 'model' itself, not 'model2/x')
+            if prefix and not (key == prefix or key.startswith(prefix + "/")):
+                continue
+            st = p.stat()
+            out.append(ObjectInfo(key, st.st_size, st.st_mtime))
+        return out
+
+    def fetch(self, bucket: str, key: str, dest: Path) -> None:
+        src = self.root / bucket / key
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        tmp = dest.with_name(dest.name + ".part")
+        shutil.copy2(src, tmp)
+        tmp.replace(dest)  # atomic: a crashed pull never leaves half files
+
+
+def _provider_for(scheme: str) -> ObjectStore:
+    root = os.environ.get(EMULATOR_ENV)
+    if root:
+        return EmulatedObjectStore(scheme, Path(root))
+    raise RuntimeError(
+        f"storage scheme '{scheme}://' needs network egress, which this "
+        f"environment does not have; stage the model locally and use "
+        f"file:// or pvc:// instead, or point {EMULATOR_ENV} at a "
+        f"file-backed emulator tree"
+    )
+
+
+def _split_remote(uri: str) -> tuple[str, str]:
+    """'gs://bucket/a/b' -> ('bucket', 'a/b'); hf://org/model keeps the org
+    as the bucket; http(s) uses the host."""
+    parsed = urlparse(uri)
+    return parsed.netloc, parsed.path.strip("/")
+
+
+def _pull_remote(uri: str, scheme: str, dest: Path) -> Path:
+    bucket, prefix = _split_remote(uri)
+    if not bucket:
+        raise ValueError(f"storage uri {uri!r}: missing bucket/host")
+    store = _provider_for(scheme)
+    objs = store.list(bucket, prefix)
+    if not objs:
+        raise FileNotFoundError(
+            f"storage uri {uri!r}: no objects under bucket {bucket!r} "
+            f"prefix {prefix!r}"
+        )
+    manifest_path = dest / MANIFEST_FILE
+    if dest.exists() and not manifest_path.exists():
+        # dest was materialized by something other than a remote pull (a
+        # local-scheme copy, a stale model): REPLACE, per the idempotence
+        # contract — merging would serve mixed model files
+        shutil.rmtree(dest)
+    dest.mkdir(parents=True, exist_ok=True)
+    try:
+        cache = json.loads(manifest_path.read_text())
+    except (OSError, ValueError):
+        cache = {}
+    new_cache = {}
+    for obj in objs:
+        # dest-relative name: strip the shared prefix "directory"
+        rel = obj.key
+        if prefix and rel == prefix:
+            rel = Path(obj.key).name  # single-object uri
+        elif prefix:
+            rel = obj.key[len(prefix) + 1:]
+        entry = [obj.size, obj.mtime]
+        target = dest / rel
+        if cache.get(rel) == entry and target.exists():
+            new_cache[rel] = entry  # unchanged: skip the fetch
+            continue
+        store.fetch(bucket, obj.key, target)
+        new_cache[rel] = entry
+    # drop whatever the source does not have NOW — diffed against the dest
+    # tree itself, not the previous manifest, so cleanup survives a lost or
+    # corrupted manifest
+    for p in list(dest.rglob("*")):
+        if not p.is_file() or p.name == MANIFEST_FILE:
+            continue
+        if p.relative_to(dest).as_posix() not in new_cache:
+            p.unlink()
+    tmp = manifest_path.with_name(manifest_path.name + ".tmp")
+    tmp.write_text(json.dumps(new_cache))
+    tmp.replace(manifest_path)  # atomic: no torn manifest on crash
+    return dest
+
+
+def _normalize(storage_uri: str) -> tuple[str, str]:
+    """One place deciding remote-vs-local: (stripped uri, scheme or '')."""
+    uri = storage_uri.strip()
+    scheme = urlparse(uri).scheme
+    return uri, (scheme if scheme in _REMOTE_SCHEMES else "")
 
 
 def resolve_uri(storage_uri: str) -> Path:
-    """Map a storage URI to a local source path (no copy)."""
-    uri = storage_uri.strip()
-    for scheme in _REMOTE_SCHEMES:
-        if uri.startswith(scheme):
-            raise RuntimeError(
-                f"storage scheme {scheme!r} needs network egress, which this "
-                f"environment does not have; stage the model locally and use "
-                f"file:// or pvc:// instead"
-            )
+    """Map a LOCAL storage URI to a source path (no copy). Remote schemes
+    have no local source path; pull_model handles them via providers."""
+    uri, scheme = _normalize(storage_uri)
+    if scheme:
+        raise RuntimeError(
+            f"storage scheme {scheme + '://'!r} has no local path; use "
+            f"pull_model to materialize it"
+        )
     if uri.startswith("pvc://"):
         root = Path(os.environ.get(PVC_ROOT_ENV, DEFAULT_PVC_ROOT))
         return root / uri[len("pvc://"):]
@@ -41,8 +187,12 @@ def resolve_uri(storage_uri: str) -> Path:
 
 def pull_model(storage_uri: str, dest_dir: str | Path) -> Path:
     """Materialize the model under dest_dir (the /mnt/models contract).
-    Returns the destination path. Idempotent: re-pull replaces."""
-    src = resolve_uri(storage_uri)
+    Returns the destination path. Idempotent: re-pull replaces (local
+    schemes) or incrementally syncs via the pull cache (remote schemes)."""
+    uri, scheme = _normalize(storage_uri)
+    if scheme:
+        return _pull_remote(uri, scheme, Path(dest_dir))
+    src = resolve_uri(uri)
     if not src.exists():
         raise FileNotFoundError(f"storage uri {storage_uri!r} -> {src} not found")
     dest = Path(dest_dir)

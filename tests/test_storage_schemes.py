@@ -1,0 +1,188 @@
+"""Remote storage schemes through the file-backed emulator (VERDICT r2
+next #8): gs:// s3:// hf:// layout, prefix semantics, the (size, mtime)
+pull cache, stale-file cleanup, error handling, and the egress gate —
+every remote code path runs without network.
+"""
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.storage import (
+    EMULATOR_ENV,
+    MANIFEST_FILE,
+    pull_model,
+)
+
+
+@pytest.fixture()
+def emulator(tmp_path, monkeypatch):
+    root = tmp_path / "object-store"
+    for scheme, bucket in (("gs", "ml-models"), ("s3", "ml-models"),
+                           ("hf", "my-org")):
+        base = root / scheme / bucket / "bert"
+        base.mkdir(parents=True)
+        (base / "config.json").write_text(json.dumps({"scheme": scheme}))
+        (base / "weights" ).mkdir()
+        (base / "weights" / "part-0.bin").write_bytes(b"\x00" * 64)
+    monkeypatch.setenv(EMULATOR_ENV, str(root))
+    return root
+
+
+class TestRemoteSchemes:
+    @pytest.mark.parametrize("uri", [
+        "gs://ml-models/bert", "s3://ml-models/bert", "hf://my-org/bert",
+    ])
+    def test_pull_materializes_tree(self, uri, emulator, tmp_path):
+        dest = pull_model(uri, tmp_path / "dest")
+        assert (dest / "config.json").exists()
+        assert (dest / "weights" / "part-0.bin").read_bytes() == b"\x00" * 64
+        scheme = uri.split(":")[0]
+        assert json.loads((dest / "config.json").read_text())["scheme"] == scheme
+
+    def test_prefix_respects_key_boundaries(self, emulator, tmp_path):
+        """'bert' must not match a sibling 'bert2' key prefix."""
+        other = emulator / "gs" / "ml-models" / "bert2"
+        other.mkdir()
+        (other / "decoy.txt").write_text("x")
+        dest = pull_model("gs://ml-models/bert", tmp_path / "dest")
+        assert not (dest / "decoy.txt").exists()
+        assert not (Path(str(dest) + "2")).exists()
+
+    def test_single_object_uri(self, emulator, tmp_path):
+        dest = pull_model("gs://ml-models/bert/config.json", tmp_path / "one")
+        assert (dest / "config.json").exists()
+
+    def test_pull_cache_skips_unchanged(self, emulator, tmp_path):
+        dest = pull_model("gs://ml-models/bert", tmp_path / "dest")
+        marker = dest / "weights" / "part-0.bin"
+        marker.write_bytes(b"LOCAL-EDIT")  # would be clobbered by a re-fetch
+        pull_model("gs://ml-models/bert", tmp_path / "dest")
+        assert marker.read_bytes() == b"LOCAL-EDIT", \
+            "unchanged object was re-fetched (cache miss)"
+
+    def test_pull_cache_refetches_on_change(self, emulator, tmp_path):
+        dest = pull_model("gs://ml-models/bert", tmp_path / "dest")
+        src = emulator / "gs" / "ml-models" / "bert" / "weights" / "part-0.bin"
+        src.write_bytes(b"\xff" * 128)  # size change
+        pull_model("gs://ml-models/bert", tmp_path / "dest")
+        assert (dest / "weights" / "part-0.bin").read_bytes() == b"\xff" * 128
+
+    def test_stale_files_removed_on_resync(self, emulator, tmp_path):
+        dest = pull_model("gs://ml-models/bert", tmp_path / "dest")
+        assert (dest / "config.json").exists()
+        (emulator / "gs" / "ml-models" / "bert" / "config.json").unlink()
+        pull_model("gs://ml-models/bert", tmp_path / "dest")
+        assert not (dest / "config.json").exists()
+
+    def test_missing_prefix_is_file_not_found(self, emulator, tmp_path):
+        with pytest.raises(FileNotFoundError, match="gs://ml-models/ghost"):
+            pull_model("gs://ml-models/ghost", tmp_path / "dest")
+
+    def test_missing_bucket_is_file_not_found(self, emulator, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            pull_model("s3://no-such-bucket/bert", tmp_path / "dest")
+
+    def test_manifest_never_listed_as_object(self, emulator, tmp_path):
+        """A MANIFEST_FILE sitting in the SOURCE tree (e.g. the emulator
+        root points at a previously pulled dir) must not be fetched as a
+        model object — dest's manifest is always the pull cache."""
+        src_manifest = emulator / "gs" / "ml-models" / "bert" / MANIFEST_FILE
+        src_manifest.write_text("SOURCE-GARBAGE")
+        dest = pull_model("gs://ml-models/bert", tmp_path / "dest")
+        cache = json.loads((dest / MANIFEST_FILE).read_text())
+        assert (dest / MANIFEST_FILE).read_text() != "SOURCE-GARBAGE"
+        assert set(cache) == {"config.json", "weights/part-0.bin"}
+
+    def test_remote_pull_replaces_local_scheme_content(self, emulator, tmp_path):
+        """A dest previously materialized by a LOCAL pull (no manifest) is
+        replaced, not merged — stale files (e.g. an old AOT artifact) must
+        not survive into the remotely pulled model."""
+        local_src = tmp_path / "local-model"
+        local_src.mkdir()
+        (local_src / "stale-artifact.bin").write_bytes(b"old")
+        dest = pull_model(f"file://{local_src}", tmp_path / "dest")
+        assert (dest / "stale-artifact.bin").exists()
+        pull_model("gs://ml-models/bert", tmp_path / "dest")
+        assert not (dest / "stale-artifact.bin").exists()
+        assert (dest / "config.json").exists()
+
+    def test_cleanup_survives_corrupt_manifest(self, emulator, tmp_path):
+        dest = pull_model("gs://ml-models/bert", tmp_path / "dest")
+        (emulator / "gs" / "ml-models" / "bert" / "config.json").unlink()
+        (dest / MANIFEST_FILE).write_text("{torn")  # crashed writer
+        pull_model("gs://ml-models/bert", tmp_path / "dest")
+        assert not (dest / "config.json").exists(), \
+            "stale file survived a corrupt manifest"
+        assert (dest / "weights" / "part-0.bin").exists()
+
+
+class TestEgressGate:
+    def test_gated_without_emulator(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(EMULATOR_ENV, raising=False)
+        with pytest.raises(RuntimeError, match="network egress"):
+            pull_model("gs://bucket/model", tmp_path / "dest")
+
+    def test_gate_message_names_the_escape_hatches(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(EMULATOR_ENV, raising=False)
+        with pytest.raises(RuntimeError, match=EMULATOR_ENV):
+            pull_model("hf://org/model", tmp_path / "dest")
+
+
+def test_isvc_serves_from_gs_scheme(tmp_path, monkeypatch):
+    """End to end: a JAX predictor whose storageUri is gs://, pulled through
+    the emulator by the server pod, serves real predictions."""
+    import jax
+
+    from kubeflow_tpu.client import Platform
+    from kubeflow_tpu.controller.fakecluster import ObjectMeta
+    from kubeflow_tpu.models import MnistMLP
+    from kubeflow_tpu.serving.api import (
+        InferenceService,
+        InferenceServiceSpec,
+        PredictorRuntime,
+        PredictorSpec,
+    )
+    from kubeflow_tpu.serving.client import ServingClient
+    from kubeflow_tpu.serving.controller import ISVC_LABEL, PORT_ANNOTATION
+    from kubeflow_tpu.serving.model import save_predictor
+
+    root = tmp_path / "obj"
+    model = MnistMLP(hidden=(16,), num_classes=10)
+    example = np.zeros((2, 64), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), example)
+    save_predictor(root / "gs" / "models" / "mnist", "mnist-mlp",
+                   dict(variables), example, hidden=[16], num_classes=10)
+
+    with Platform(log_dir=str(tmp_path / "logs")) as p:
+        isvc = InferenceService(
+            metadata=ObjectMeta(name="gsdemo"),
+            spec=InferenceServiceSpec(
+                predictor=PredictorSpec(
+                    runtime=PredictorRuntime.JAX,
+                    storage_uri="gs://models/mnist",
+                    device="cpu",
+                    env={EMULATOR_ENV: str(root)},
+                )
+            ),
+        )
+        sc = ServingClient(p)
+        sc.create(isvc)
+        sc.wait_ready("gsdemo", timeout_s=120)
+        pods = p.cluster.list(
+            "pods", lambda q: q.metadata.labels.get(ISVC_LABEL) == "gsdemo",
+        )
+        port = pods[0].metadata.annotations[PORT_ANNOTATION]
+        import urllib.request
+
+        x = np.random.default_rng(0).normal(size=(2, 64)).astype(np.float32)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/gsdemo:predict",
+            data=json.dumps({"instances": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert len(body["predictions"]) == 2
