@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import threading
 from dataclasses import dataclass
 from pathlib import Path
 from urllib.parse import urlparse
@@ -64,27 +65,41 @@ class EmulatedObjectStore(ObjectStore):
     def __init__(self, scheme: str, root: Path):
         self.root = Path(root) / scheme
 
+    def _base(self, bucket: str) -> Path:
+        # bucket names are single path components; '..', '/', '' would walk
+        # out of the emulator tree (the uri is client-controllable via the
+        # repository load API)
+        if not bucket or "/" in bucket or bucket in (".", ".."):
+            raise ValueError(f"invalid bucket name {bucket!r}")
+        return self.root / bucket
+
     def list(self, bucket: str, prefix: str) -> list[ObjectInfo]:
-        base = self.root / bucket
+        base = self._base(bucket)
         if not base.is_dir():
             return []
         prefix = prefix.strip("/")
+        if ".." in prefix.split("/"):
+            raise ValueError(f"invalid key prefix {prefix!r}")
+        # walk only the prefix subtree (or the single object), not the
+        # whole bucket — listing cost tracks the model, not the store
+        start = base / prefix if prefix else base
+        if start.is_file():
+            candidates = [start]
+        elif start.is_dir():
+            candidates = sorted(p for p in start.rglob("*") if p.is_file())
+        else:
+            return []
         out = []
-        for p in sorted(base.rglob("*")):
-            if not p.is_file() or p.name == MANIFEST_FILE:
+        for p in candidates:
+            if p.name == MANIFEST_FILE:
                 continue
             key = p.relative_to(base).as_posix()
-            # object-store semantics: prefix match on the KEY, with the
-            # "directory" boundary honored (prefix 'model' matches
-            # 'model/x' and 'model' itself, not 'model2/x')
-            if prefix and not (key == prefix or key.startswith(prefix + "/")):
-                continue
             st = p.stat()
             out.append(ObjectInfo(key, st.st_size, st.st_mtime))
         return out
 
     def fetch(self, bucket: str, key: str, dest: Path) -> None:
-        src = self.root / bucket / key
+        src = self._base(bucket) / key
         dest.parent.mkdir(parents=True, exist_ok=True)
         tmp = dest.with_name(dest.name + ".part")
         shutil.copy2(src, tmp)
@@ -115,7 +130,14 @@ def _pull_remote(uri: str, scheme: str, dest: Path) -> Path:
     if not bucket:
         raise ValueError(f"storage uri {uri!r}: missing bucket/host")
     store = _provider_for(scheme)
-    objs = store.list(bucket, prefix)
+    # enforce the key/prefix "directory" boundary HERE, not per-provider:
+    # real S3/GCS listings are plain string-prefix matches, so a provider
+    # that faithfully mirrors them would otherwise leak 'model2/x' into a
+    # pull of 'model'
+    objs = [
+        o for o in store.list(bucket, prefix)
+        if not prefix or o.key == prefix or o.key.startswith(prefix + "/")
+    ]
     if not objs:
         raise FileNotFoundError(
             f"storage uri {uri!r}: no objects under bucket {bucket!r} "
@@ -129,8 +151,12 @@ def _pull_remote(uri: str, scheme: str, dest: Path) -> Path:
         shutil.rmtree(dest)
     dest.mkdir(parents=True, exist_ok=True)
     try:
-        cache = json.loads(manifest_path.read_text())
-    except (OSError, ValueError):
+        manifest = json.loads(manifest_path.read_text())
+        # the cache is only valid for the SAME source: two versions of a
+        # model can share sizes+mtimes (cp -p publishing), so a uri switch
+        # must refetch everything
+        cache = manifest["objects"] if manifest.get("uri") == uri else {}
+    except (OSError, ValueError, TypeError, KeyError):
         cache = {}
     new_cache = {}
     for obj in objs:
@@ -156,7 +182,7 @@ def _pull_remote(uri: str, scheme: str, dest: Path) -> Path:
         if p.relative_to(dest).as_posix() not in new_cache:
             p.unlink()
     tmp = manifest_path.with_name(manifest_path.name + ".tmp")
-    tmp.write_text(json.dumps(new_cache))
+    tmp.write_text(json.dumps({"uri": uri, "objects": new_cache}))
     tmp.replace(manifest_path)  # atomic: no torn manifest on crash
     return dest
 
@@ -185,10 +211,34 @@ def resolve_uri(storage_uri: str) -> Path:
     return Path(uri)
 
 
+# Per-destination locks: the repository API serves concurrent load requests
+# from ThreadingHTTPServer threads; two pulls racing into one dest would
+# cross rmtree/fetch and tear the tree. In-process is sufficient — replicas
+# are separate processes with per-replica dest dirs.
+_PULL_LOCKS: dict[str, threading.Lock] = {}
+_PULL_LOCKS_GUARD = threading.Lock()
+
+
+def _dest_lock(dest: Path) -> threading.Lock:
+    key = str(Path(dest).resolve())
+    with _PULL_LOCKS_GUARD:
+        lock = _PULL_LOCKS.get(key)
+        if lock is None:
+            lock = _PULL_LOCKS[key] = threading.Lock()
+    return lock
+
+
 def pull_model(storage_uri: str, dest_dir: str | Path) -> Path:
     """Materialize the model under dest_dir (the /mnt/models contract).
     Returns the destination path. Idempotent: re-pull replaces (local
-    schemes) or incrementally syncs via the pull cache (remote schemes)."""
+    schemes) or incrementally syncs via the pull cache (remote schemes).
+    Serialized per destination — concurrent loads of the same model are
+    safe."""
+    with _dest_lock(Path(dest_dir)):
+        return _pull_model_locked(storage_uri, dest_dir)
+
+
+def _pull_model_locked(storage_uri: str, dest_dir: str | Path) -> Path:
     uri, scheme = _normalize(storage_uri)
     if scheme:
         return _pull_remote(uri, scheme, Path(dest_dir))

@@ -171,3 +171,20 @@ class TestMaskedLM:
                 first = float(m["loss"])
             last = float(m["loss"])
         assert np.isfinite(last) and last < first * 0.9, (first, last)
+
+
+def test_bert_finetune_accuracy_threshold():
+    """BASELINE.md config #3 accuracy ledger: the BERT fine-tune is
+    accuracy-asserted against a FIXED threshold on the synthetic separable
+    task (the honest stand-in for GLUE — no egress for real task data).
+    Deterministic: converges to ~0.98."""
+    cfg = BertConfig.tiny(dropout_rate=0.0)
+    ds = synthetic_text_dataset(n_train=256, n_test=64, seq_len=32,
+                                vocab_size=cfg.vocab_size)
+    trainer = Trainer(
+        BertForSequenceClassification(cfg, num_classes=2),
+        TrainerConfig(batch_size=32, steps=80, learning_rate=1e-3,
+                      log_every_steps=10**9),
+    )
+    _, metrics = trainer.fit(ds)
+    assert metrics["final_accuracy"] >= 0.95, metrics

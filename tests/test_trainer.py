@@ -24,7 +24,9 @@ def test_digits_converges(digits):
         MnistMLP(), TrainerConfig(batch_size=128, epochs=20, learning_rate=2e-3)
     )
     _, m = trainer.fit(digits)
-    assert m["final_accuracy"] > 0.9
+    # BASELINE.md config #1 criterion (>97% test acc) on the digits stand-in;
+    # deterministic: converges to 0.9721
+    assert m["final_accuracy"] > 0.97
 
 
 def test_fsdp_mesh_matches_single_device(digits):
