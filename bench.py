@@ -228,6 +228,43 @@ def bench_gpt2s_flash_2k(steps: int = 10, batch_size: int = 4, seq_len: int = 20
     return _finish(r, dt, steps, 6 * 124e6 * tokens + attn)
 
 
+def bench_gpt2s_decode(batch_size: int = 8, prompt_len: int = 128,
+                       new_tokens: int = 128) -> dict:
+    """Autoregressive decode throughput (generated tokens/sec/chip) through
+    the KV-cache path — the LLM serving metric. Decode is HBM-bandwidth
+    bound (the whole model streams per token), so MFU here is expected to
+    be small; the number of record is tokens/sec."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.gpt import GPTConfig, GPTLM, generate
+
+    cfg = GPTConfig.small(dtype=jnp.bfloat16, dropout_rate=0.0,
+                          max_len=prompt_len + new_tokens)
+    model = GPTLM(cfg)
+    prompt_host = jax.random.randint(
+        jax.random.PRNGKey(1), (batch_size, prompt_len), 1, cfg.vocab_size,
+        jnp.int32,
+    )
+    prompt = jax.jit(lambda x: x + 0)(prompt_host)  # device-born
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), prompt)
+    gen = jax.jit(lambda v, p: generate(model, v, p, new_tokens))
+    out = gen(variables, prompt)
+    int(out.sum())  # true sync (host read)
+    t0 = time.perf_counter()
+    out = gen(variables, prompt)
+    int(out.sum())
+    dt = time.perf_counter() - t0
+    toks = batch_size * new_tokens
+    r = {
+        "metric": "gpt2s_decode_tokens_per_sec_per_chip",
+        "value": round(toks / dt, 1),
+        "unit": "tokens/sec/chip",
+    }
+    # fwd-only FLOPs per generated token: 2N (N ≈ 124M), + attention reads
+    return _finish(r, dt, new_tokens, 2 * 124e6 * batch_size)
+
+
 def bench_mnist_mlp(steps: int = 60, batch_size: int = 512) -> dict:
     from kubeflow_tpu.models import MnistMLP
     from kubeflow_tpu.train import Trainer, TrainerConfig
@@ -407,6 +444,7 @@ SUITE_BENCHES = [
     (bench_bert_base, "bert_base_steps_per_sec", "steps/sec"),
     FLAGSHIP,
     (bench_gpt2s_flash_2k, "gpt2s_flash_2k_tokens_per_sec_per_chip", "tokens/sec/chip"),
+    (bench_gpt2s_decode, "gpt2s_decode_tokens_per_sec_per_chip", "tokens/sec/chip"),
 ]
 
 

@@ -85,26 +85,64 @@ class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, bias, train: bool):
+    def __call__(self, x, bias, train: bool, decode: bool = False):
         c = self.cfg
         head_dim = c.hidden_size // c.num_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (c.num_heads, head_dim), dtype=c.dtype, name=name
         )
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
-        rng = self.make_rng("dropout") if train and c.dropout_rate > 0 else None
-        if c.attention == "dense":
-            y = causal_dense_attention(
-                q, k, v, bias, dropout_rng=rng,
-                dropout_rate=c.dropout_rate if train else 0.0,
-            )
+        if decode:
+            y = self._cached_attention(q, k, v)
         else:
-            attn_fn = _resolve_attention(c.attention)
-            y = attn_fn(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
-                        block=c.attention_block, causal=True)
+            rng = (self.make_rng("dropout")
+                   if train and c.dropout_rate > 0 else None)
+            if c.attention == "dense":
+                y = causal_dense_attention(
+                    q, k, v, bias, dropout_rng=rng,
+                    dropout_rate=c.dropout_rate if train else 0.0,
+                )
+            else:
+                attn_fn = _resolve_attention(c.attention)
+                y = attn_fn(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
+                            block=c.attention_block, causal=True)
         return nn.DenseGeneral(
             c.hidden_size, axis=(-2, -1), dtype=c.dtype, name="attn_out"
         )(y)
+
+    def _cached_attention(self, q, k, v):
+        """KV-cache attention — ONE static-shape code path for both prefill
+        (L = prompt length) and decode (L = 1), the TPU-idiomatic
+        autoregressive loop: the cache is a fixed (B, max_len, H, D) buffer,
+        new K/V write at the running index via dynamic_update_slice, and
+        every step attends over the full buffer under a position mask — no
+        shape ever depends on how many tokens have been generated, so XLA
+        compiles exactly two executables (prefill + decode step)."""
+        c = self.cfg
+        b, l, h, d = q.shape
+        ck = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((b, c.max_len, h, d), c.dtype))
+        cv = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((b, c.max_len, h, d), c.dtype))
+        idx = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+        cur = idx.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+        idx.value = cur + l
+        q_pos = cur + jnp.arange(l)                      # (L,)
+        k_pos = jnp.arange(c.max_len)                    # (max_len,)
+        s = jnp.einsum("blhd,bmhd->bhlm", q, ck.value).astype(jnp.float32)
+        s = s / jnp.sqrt(jnp.float32(d))
+        # causal + not-yet-written mask in one comparison: a key position is
+        # visible iff it <= this query's position (unwritten slots are all
+        # > cur + l - 1 by construction)
+        visible = k_pos[None, :] <= q_pos[:, None]       # (L, max_len)
+        s = jnp.where(visible[None, None], s, -1e9)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhlm,bmhd->blhd", p, cv.value)
 
 
 class GPTBlock(nn.Module):
@@ -113,10 +151,11 @@ class GPTBlock(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, bias, train: bool):
+    def __call__(self, x, bias, train: bool, decode: bool = False):
         c = self.cfg
         y = CausalSelfAttention(c, name="attention")(
-            nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x), bias, train
+            nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x), bias, train,
+            decode=decode,
         )
         y = nn.Dropout(c.dropout_rate, deterministic=not train)(y)
         x = constrain(x + y, ACT_SPEC)
@@ -138,27 +177,100 @@ class GPTLM(nn.Module):
     pad_token_id: int = 0
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = False):
+    def __call__(self, input_ids, train: bool = False, decode: bool = False):
         c = self.cfg
         token_embed = VocabEmbed(
             c.vocab_size, c.hidden_size, dtype=c.dtype, name="token_embed"
         )
         x = token_embed(input_ids)
-        pos = jnp.arange(input_ids.shape[1])[None, :]
+        if decode:
+            # autoregressive mode: positions continue from the running
+            # offset; attention masking is positional via the KV cache
+            # (generation prompts are unpadded — see generate())
+            pidx = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32))
+            pos = pidx.value + jnp.arange(input_ids.shape[1])[None, :]
+            pidx.value = pidx.value + input_ids.shape[1]
+            bias = None
+        else:
+            pos = jnp.arange(input_ids.shape[1])[None, :]
+            mask = input_ids != self.pad_token_id
+            bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
         x = x + VocabEmbed(c.max_len, c.hidden_size, dtype=c.dtype,
                            name="position_embed")(pos)
         x = nn.Dropout(c.dropout_rate, deterministic=not train)(x)
         x = constrain(x, ACT_SPEC)
-        mask = input_ids != self.pad_token_id
-        bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
         for i in range(c.num_layers):
-            x = GPTBlock(c, name=f"layer_{i}")(x, bias, train)
+            x = GPTBlock(c, name=f"layer_{i}")(x, bias, train, decode=decode)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_final")(x)
         logits = token_embed.attend(x)  # weight-tied head
         return logits.astype(jnp.float32)
 
 
 GPTLM.PARTITION_RULES = PARTITION_RULES
+
+
+def generate(
+    model: GPTLM,
+    variables: dict,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Autoregressive generation with the KV cache — fully jittable.
+
+    prompt_ids: (B, prompt_len) int32, UNPADDED (all prompts same length;
+    generation-time position masking is by cache index, not pad id).
+    Returns (B, max_new_tokens) int32. temperature == 0 -> greedy;
+    otherwise categorical over logits/temperature, restricted to the top_k
+    logits when top_k > 0. Static shapes throughout: ONE prefill executable
+    + ONE decode-step executable inside a lax.scan, the TPU decode shape.
+    The LM's max_len bounds prompt_len + max_new_tokens.
+    """
+    b, prompt_len = prompt_ids.shape
+    if prompt_len + max_new_tokens > model.cfg.max_len:
+        raise ValueError(
+            f"prompt {prompt_len} + {max_new_tokens} new tokens exceeds "
+            f"max_len {model.cfg.max_len}"
+        )
+    if temperature == 0.0:
+        rng = jax.random.PRNGKey(0)  # unused; keeps the scan carry uniform
+    elif rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng")
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / jnp.float32(temperature)
+        if top_k > 0:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+
+    # prefill: one pass over the whole prompt builds the cache
+    logits, cache = model.apply(
+        variables, prompt_ids, decode=True, mutable=["cache"]
+    )
+    rng, key = jax.random.split(rng)
+    tok = sample(logits[:, -1], key)
+
+    def step(carry, _):
+        cache, tok, rng = carry
+        logits, cache = model.apply(
+            {**variables, **cache}, tok[:, None], decode=True,
+            mutable=["cache"],
+        )
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits[:, 0], key)
+        return (cache, nxt, rng), tok
+
+    (_, last, _), toks = jax.lax.scan(
+        step, (cache, tok, rng), None, length=max_new_tokens - 1
+    )
+    out = jnp.concatenate([toks, last[None]], axis=0)
+    return out.T  # (B, max_new_tokens)
 
 
 def causal_lm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:

@@ -53,6 +53,14 @@ def export_predictor(
 
     model_dir = Path(model_dir)
     predict_fn, config, example = _load_predict_fn(model_dir)
+    gen = config.get("generate")
+    if gen is not None and float(gen.get("temperature", 0.0)) > 0.0:
+        raise ValueError(
+            "AOT export supports greedy decode only (temperature == 0): "
+            "sampling needs a fresh per-request rng, which the single-input "
+            "exported artifact cannot receive — serve sampling configs via "
+            "the jit path"
+        )
 
     exp = jax.export.export(jax.jit(predict_fn))(
         jax.ShapeDtypeStruct(example.shape, example.dtype)

@@ -29,6 +29,8 @@ class PredictorRuntime(str, enum.Enum):
     TORCH = "torch"
     XGBOOST = "xgboost"
     LIGHTGBM = "lightgbm"
+    PADDLE = "paddle"
+    PMML = "pmml"
 
 
 @dataclass

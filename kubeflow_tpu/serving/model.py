@@ -136,6 +136,13 @@ def _build_family(family: str, kwargs: dict):
         cfg = M.BertConfig.tiny(**cfg_kw) if kwargs.pop("size", "tiny") == "tiny" \
             else M.BertConfig.base(**cfg_kw)
         return M.BertForSequenceClassification(cfg=cfg, **kwargs)
+    if family == "gpt-lm":
+        from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+
+        cfg_kw = kwargs.pop("config", {})
+        cfg = GPTConfig.tiny(**cfg_kw) if kwargs.pop("size", "tiny") == "tiny" \
+            else GPTConfig.small(**cfg_kw)
+        return GPTLM(cfg, **kwargs)
     raise ValueError(f"unknown model family {family!r}")
 
 
@@ -144,27 +151,30 @@ def save_predictor(
     family: str,
     variables: dict,
     example_input: np.ndarray,
+    generate: dict | None = None,
     **family_kwargs,
 ) -> Path:
     """Write the jax-runtime model-dir contract: config.json (family +
     kwargs + example input signature) and params.msgpack (all variable
-    collections). `variables` is {'params': ..., maybe 'batch_stats': ...}."""
+    collections). `variables` is {'params': ..., maybe 'batch_stats': ...}.
+
+    generate: for causal-LM families, decode parameters (max_new_tokens,
+    temperature, top_k) — the predictor then serves token GENERATION (ids
+    in -> generated ids out, KV-cache decode loop) instead of logits."""
     from flax import serialization
 
     d = Path(model_dir)
     d.mkdir(parents=True, exist_ok=True)
     example = np.asarray(example_input)
-    (d / CONFIG_FILE).write_text(
-        json.dumps(
-            {
-                "family": family,
-                "kwargs": family_kwargs,
-                "input_shape": list(example.shape),
-                "input_dtype": str(example.dtype),
-            },
-            indent=2,
-        )
-    )
+    cfg = {
+        "family": family,
+        "kwargs": family_kwargs,
+        "input_shape": list(example.shape),
+        "input_dtype": str(example.dtype),
+    }
+    if generate is not None:
+        cfg["generate"] = generate
+    (d / CONFIG_FILE).write_text(json.dumps(cfg, indent=2))
     (d / PARAMS_FILE).write_bytes(serialization.to_bytes(variables))
     return d
 
@@ -190,8 +200,32 @@ def _load_predict_fn(model_dir: Path):
         target, (model_dir / PARAMS_FILE).read_bytes()
     )
 
-    def predict_fn(x):
-        return module.apply(variables, x, **kwargs)
+    gen = config.get("generate")
+    if gen is not None:
+        from kubeflow_tpu.models.gpt import generate as _generate
+
+        temperature = float(gen.get("temperature", 0.0))
+        if temperature > 0.0:
+            # per-REQUEST key (passed as a traced argument, derived by the
+            # caller from seed + a call counter): a key baked into the jit
+            # closure would replay the identical "sample" on every request
+            def predict_fn(x, key):
+                return _generate(
+                    module, variables, x,
+                    max_new_tokens=int(gen.get("max_new_tokens", 32)),
+                    temperature=temperature,
+                    top_k=int(gen.get("top_k", 0)),
+                    rng=key,
+                )
+        else:
+            def predict_fn(x):
+                return _generate(
+                    module, variables, x,
+                    max_new_tokens=int(gen.get("max_new_tokens", 32)),
+                )
+    else:
+        def predict_fn(x):
+            return module.apply(variables, x, **kwargs)
 
     return predict_fn, config, example
 
@@ -237,12 +271,41 @@ class JaxModel(Model):
         predict_fn, self.config, example = _load_predict_fn(self.model_dir)
         predict_fn = jax.jit(predict_fn)
         # warmup: trace+compile on the recorded signature
-        predict_fn(jnp.asarray(example)).block_until_ready()
+        if self._sampling:
+            jax.block_until_ready(
+                predict_fn(jnp.asarray(example), jax.random.PRNGKey(0)))
+        else:
+            predict_fn(jnp.asarray(example)).block_until_ready()
         self._predict_fn = predict_fn
         self.ready = True
 
+    @property
+    def _sampling(self) -> bool:
+        gen = self.config.get("generate")
+        return gen is not None and float(gen.get("temperature", 0.0)) > 0.0
+
     def predict(self, inputs: np.ndarray) -> np.ndarray:
         x = np.asarray(inputs, dtype=self.config["input_dtype"])
+        gen = self.config.get("generate")
+        if gen is not None:
+            pad = int(gen.get("pad_token_id", 0))
+            if (x == pad).any():
+                # the decode path has no pad masking (positions are cache-
+                # indexed); a padded prompt would write pads into the KV
+                # cache and sample from a pad position — reject loudly
+                raise ValueError(
+                    f"generation prompts must not contain the pad token id "
+                    f"{pad}: send equal-length unpadded prompts"
+                )
+        if self._sampling:
+            import jax
+
+            seed = int(gen.get("seed", 0))
+            # per-request key: seed folds with a monotonically advancing
+            # call counter so repeated requests sample fresh completions
+            self._calls = getattr(self, "_calls", 0) + 1
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), self._calls)
+            return np.asarray(self._predict_fn(x, key))
         if self._aot_batch is not None:
             from kubeflow_tpu.serving import aot
 
@@ -250,7 +313,11 @@ class JaxModel(Model):
         return np.asarray(self._predict_fn(x))
 
     def postprocess(self, outputs: np.ndarray) -> dict:
-        """Classification contract: logits -> class + per-class scores."""
+        """Classification contract: logits -> class + per-class scores.
+        Generative configs return the generated token ids directly."""
+        if self.config.get("generate") is not None:
+            ids = np.asarray(outputs, dtype=np.int64)
+            return {"predictions": ids.tolist()}
         logits = np.asarray(outputs, dtype=np.float32)
         return {
             "predictions": np.argmax(logits, axis=-1).tolist(),

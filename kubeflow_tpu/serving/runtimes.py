@@ -158,11 +158,82 @@ class LightGBMModel(Model):
         return self._booster.predict(np.asarray(inputs)).tolist()
 
 
+class PaddleModel(Model):
+    """paddleserver parity: inference model from model.pdmodel +
+    model.pdiparams (gated: paddlepaddle is absent in this image)."""
+
+    def __init__(self, name: str, model_dir: str | Path):
+        super().__init__(name)
+        self.model_dir = Path(model_dir)
+        self._predictor = None
+
+    def load(self) -> None:
+        try:
+            import paddle.inference as paddle_infer
+        except ModuleNotFoundError as exc:
+            raise ModuleNotFoundError(
+                "runtime 'paddle' requires the paddlepaddle package (absent "
+                "in this image); install it or convert the model to the "
+                "sklearn/torch/jax runtime"
+            ) from exc
+        pdmodel = self.model_dir / "model.pdmodel"
+        pdparams = self.model_dir / "model.pdiparams"
+        if not pdmodel.exists() or not pdparams.exists():
+            raise FileNotFoundError(
+                f"no model.pdmodel + model.pdiparams under {self.model_dir}"
+            )
+        config = paddle_infer.Config(str(pdmodel), str(pdparams))
+        self._predictor = paddle_infer.create_predictor(config)
+        self.ready = True
+
+    def predict(self, inputs):
+        x = np.asarray(inputs, dtype=np.float32)
+        names = self._predictor.get_input_names()
+        handle = self._predictor.get_input_handle(names[0])
+        handle.reshape(x.shape)
+        handle.copy_from_cpu(x)
+        self._predictor.run()
+        out = self._predictor.get_output_handle(
+            self._predictor.get_output_names()[0]
+        )
+        return out.copy_to_cpu().tolist()
+
+
+class PMMLModel(Model):
+    """pmmlserver parity: PMML pipeline via pypmml (gated: absent here)."""
+
+    def __init__(self, name: str, model_dir: str | Path):
+        super().__init__(name)
+        self.model_dir = Path(model_dir)
+        self._model = None
+
+    def load(self) -> None:
+        try:
+            from pypmml import Model as PmmlModel
+        except ModuleNotFoundError as exc:
+            raise ModuleNotFoundError(
+                "runtime 'pmml' requires the pypmml package (absent in this "
+                "image); install it or convert the model to the "
+                "sklearn/torch/jax runtime"
+            ) from exc
+        candidates = sorted(self.model_dir.glob("*.pmml"))
+        if not candidates:
+            raise FileNotFoundError(f"no *.pmml under {self.model_dir}")
+        self._model = PmmlModel.load(str(candidates[0]))
+        self.ready = True
+
+    def predict(self, inputs):
+        x = np.asarray(inputs)
+        return [self._model.predict(list(map(float, row))) for row in x]
+
+
 RUNTIMES: dict[str, type] = {
     "sklearn": SklearnModel,
     "torch": TorchModel,
     "xgboost": XGBoostModel,
     "lightgbm": LightGBMModel,
+    "paddle": PaddleModel,
+    "pmml": PMMLModel,
 }
 
 

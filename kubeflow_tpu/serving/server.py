@@ -391,7 +391,8 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--model-dir", default=".kubeflow_tpu/models")
     ap.add_argument(
         "--runtime", default="jax",
-        choices=["jax", "custom", "sklearn", "torch", "xgboost", "lightgbm"],
+        choices=["jax", "custom", "sklearn", "torch", "xgboost", "lightgbm",
+                 "paddle", "pmml"],
     )
     ap.add_argument("--model-class", default="")
     ap.add_argument("--transformer-class", default="")

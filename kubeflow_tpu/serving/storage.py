@@ -152,10 +152,14 @@ def _pull_remote(uri: str, scheme: str, dest: Path) -> Path:
     dest.mkdir(parents=True, exist_ok=True)
     try:
         manifest = json.loads(manifest_path.read_text())
+        if not isinstance(manifest, dict):
+            raise ValueError(f"manifest is {type(manifest).__name__}")
         # the cache is only valid for the SAME source: two versions of a
         # model can share sizes+mtimes (cp -p publishing), so a uri switch
         # must refetch everything
         cache = manifest["objects"] if manifest.get("uri") == uri else {}
+        if not isinstance(cache, dict):
+            cache = {}
     except (OSError, ValueError, TypeError, KeyError):
         cache = {}
     new_cache = {}

@@ -68,8 +68,22 @@ class TestGatedRuntimes:
         with pytest.raises(ModuleNotFoundError, match="xgboost"):
             m.load()
 
+    def test_paddle_gated_with_clear_error(self, tmp_path):
+        from kubeflow_tpu.serving.runtimes import PaddleModel
+
+        with pytest.raises(ModuleNotFoundError, match="paddle"):
+            PaddleModel("pd", tmp_path).load()
+
+    def test_pmml_gated_with_clear_error(self, tmp_path):
+        from kubeflow_tpu.serving.runtimes import PMMLModel
+
+        with pytest.raises(ModuleNotFoundError, match="pypmml"):
+            PMMLModel("pm", tmp_path).load()
+
     def test_registry(self, tmp_path):
         assert isinstance(build_runtime("sklearn", "a", tmp_path), SklearnModel)
+        for name in ("paddle", "pmml"):
+            assert build_runtime(name, "a", tmp_path).name == "a"
         with pytest.raises(ValueError, match="unknown runtime"):
             build_runtime("tensorrt", "a", tmp_path)
 
