@@ -44,6 +44,9 @@ def main(argv: list[str] | None = None) -> float:
     p.add_argument("--num-layers", type=int, default=0)
     p.add_argument("--num-heads", type=int, default=0)
     p.add_argument("--mlp-dim", type=int, default=0)
+    # parameter-efficient fine-tune: freeze the base, train rank-r adapters
+    # on the attention/MLP kernels (train/lora.py)
+    p.add_argument("--lora", type=int, default=0, help="LoRA rank (0 = full)")
     p.add_argument("--expert-parallel", type=int, default=1)
     # PP: >1 pipelines the encoder stack over the `pipeline` axis
     p.add_argument("--pipeline-stages", type=int, default=1)
@@ -112,9 +115,21 @@ def main(argv: list[str] | None = None) -> float:
         )
     else:
         model = BertForSequenceClassification(cfg, num_classes=args.num_classes)
+    tx = None
+    if args.lora > 0:
+        from kubeflow_tpu.train import LoraModel, lora_tx
+
+        # works for the pipelined model too: stacked stage kernels get
+        # per-stage adapters, sharded over `pipeline` by the stages/ rule
+        model = LoraModel(model, rank=args.lora)
+        # factory form: wraps the Trainer's config-built schedule (warmup,
+        # cosine, clipping) so only the trainable-set changes, not the
+        # optimizer dynamics
+        tx = lora_tx
     trainer = Trainer(
         model,
-        TrainerConfig(
+        tx=tx,
+        config=TrainerConfig(
             fused_steps=args.fused_steps,
             batch_size=args.batch_size,
             steps=args.steps,

@@ -6,6 +6,13 @@ guarantees (SURVEY.md §5.4). TPU-native: one jit-compiled train step, static
 shapes, donated buffers, orbax async checkpoints.
 """
 
+from kubeflow_tpu.train.lora import (
+    LoraModel,
+    lora_init,
+    lora_merge,
+    lora_tx,
+)
 from kubeflow_tpu.train.trainer import Trainer, TrainerConfig, TrainState
 
-__all__ = ["Trainer", "TrainerConfig", "TrainState"]
+__all__ = ["Trainer", "TrainerConfig", "TrainState", "LoraModel",
+           "lora_init", "lora_merge", "lora_tx"]

@@ -1,0 +1,189 @@
+"""LoRA — low-rank adaptation for parameter-efficient fine-tuning.
+
+The reference platform fine-tunes via user images (Horovod BERT under
+MPIJob, SURVEY.md §3.2) and its modern SDK exposes train()-style LLM
+fine-tuning; the TPU-native analogue is in-tree: freeze the base weights,
+train only low-rank A·B deltas on the attention/MLP kernels (Hu et al.
+2021). TPU-first consequences:
+
+  - the merge W + (alpha/r)·A@B happens functionally per step and XLA fuses
+    it into the consumer matmul's producer chain — no module surgery, so it
+    wraps ANY flax model (BERT, GPT, ViT) via the duck-typed LoraModel;
+  - optimizer state exists ONLY for the adapters (optax.multi_transform
+    freezes the base subtree), cutting Adam's 2x-params HBM to 2x-adapters
+    — the practical enabler for fine-tuning at chip memory;
+  - base params keep the model family's PARTITION_RULES shardings (the
+    rules match path suffixes, so the 'base/' prefix is transparent);
+    adapters are small and replicate.
+
+Usage:
+    lora = LoraModel(BertForSequenceClassification(cfg), rank=8)
+    trainer = Trainer(lora, config, tx=lora_tx(optax.adam(1e-3)))
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import traverse_util
+
+# attention + MLP kernels: the standard LoRA target set
+DEFAULT_TARGETS = (
+    r"(query|key|value|attn_out|mlp_up|mlp_down)/kernel$"
+)
+
+
+def _kernel_layout(path: str, shape: tuple[int, ...]) -> tuple[bool, int, int] | None:
+    """Resolve a kernel's logical (in, out) from its path + shape.
+
+    Returns (stacked, n_in, n_out) or None for shapes LoRA cannot adapt.
+    DenseGeneral kernels are >2-D: q/k/v project hidden -> (heads, head_dim)
+    so everything AFTER the first dim is output; attn_out contracts
+    (heads, head_dim) -> hidden so everything BEFORE the last dim is input.
+    A leading stage dim (pipeline-stacked params live under 'stages/' —
+    models/bert_pp.py) is preserved and batched over.
+    """
+    stacked = path.startswith("stages/") or "/stages/" in path
+    dims = shape[1:] if stacked else shape
+    if len(dims) < 2:
+        return None
+    if re.search(r"attn_out/kernel", path):
+        n_in, n_out = int(np.prod(dims[:-1])), int(dims[-1])
+    else:
+        n_in, n_out = int(dims[0]), int(np.prod(dims[1:]))
+    return stacked, n_in, n_out
+
+
+def lora_init(rng, params: dict, rank: int = 8,
+              targets: str = DEFAULT_TARGETS) -> dict:
+    """Adapter tree for every matching kernel: A ~ N(0, 0.02) of shape
+    (in, r), B = 0 of shape (r, out) — so the initial delta is exactly zero
+    and step 0 reproduces the base model. DenseGeneral kernels adapt their
+    logical (in, out) flattening; pipeline-stacked kernels get per-stage
+    adapters with a leading stage dim."""
+    flat = traverse_util.flatten_dict(params, sep="/")
+    lora: dict[str, Any] = {}
+    keys = jax.random.split(rng, max(len(flat), 1))
+    for i, (path, w) in enumerate(sorted(flat.items())):
+        if not re.search(targets, path):
+            continue
+        layout = _kernel_layout(path, tuple(w.shape))
+        if layout is None:
+            continue
+        stacked, n_in, n_out = layout
+        lead = (w.shape[0],) if stacked else ()
+        lora[path + "/lora_a"] = (
+            jax.random.normal(keys[i], (*lead, n_in, rank), jnp.float32)
+            * 0.02
+        )
+        lora[path + "/lora_b"] = jnp.zeros((*lead, rank, n_out), jnp.float32)
+    if not lora:
+        raise ValueError(
+            f"no kernels matched LoRA targets {targets!r}"
+        )
+    return traverse_util.unflatten_dict(lora, sep="/")
+
+
+def lora_merge(params: dict, lora: dict, alpha: float) -> dict:
+    """W + (alpha/r)·A@B for every adapted kernel (delta reshaped to the
+    kernel's true shape; batched over the leading stage dim for
+    pipeline-stacked kernels); other leaves pass through untouched.
+    Purely functional — safe under jit/grad."""
+    flat_p = traverse_util.flatten_dict(params, sep="/")
+    flat_l = traverse_util.flatten_dict(lora, sep="/")
+    out = dict(flat_p)
+    for path in list(flat_l):
+        if not path.endswith("/lora_a"):
+            continue
+        base_path = path[: -len("/lora_a")]
+        a = flat_l[path]
+        b = flat_l[base_path + "/lora_b"]
+        w = flat_p[base_path]
+        scale = alpha / a.shape[-1]
+        if a.ndim == 3:  # stage-stacked: batch the contraction
+            delta = jnp.einsum("sir,sro->sio", a, b)
+        else:
+            delta = a @ b
+        out[base_path] = w + (scale * delta).reshape(w.shape).astype(w.dtype)
+    return traverse_util.unflatten_dict(out, sep="/")
+
+
+class LoraModel:
+    """Duck-typed wrapper (Trainer-compatible init/apply) that adapts any
+    flax model with LoRA. Param tree: {'base': <frozen>, 'lora': <trained>}.
+    Pair with lora_tx() so the optimizer never touches (or allocates
+    moments for) the base subtree."""
+
+    def __init__(self, model, rank: int = 8, alpha: float = 16.0,
+                 targets: str = DEFAULT_TARGETS):
+        import inspect
+
+        self.model = model
+        self.rank = rank
+        self.alpha = alpha
+        self.targets = targets
+        # mirror the Trainer's own introspection: forward `train` only to
+        # models that take it (mnist/resnet-style __call__s do not)
+        self._accepts_train = (
+            "train" in inspect.signature(model.__call__).parameters
+        )
+        rules = getattr(model, "PARTITION_RULES", None)
+        if rules is not None:
+            # suffix-matching rules see through the 'base/' prefix; adapters
+            # are small and replicate — EXCEPT pipeline-stacked ones, whose
+            # leading stage dim the base rules' stages/ catch-all shards
+            self.PARTITION_RULES = rules
+
+    # Trainer introspects __call__ for the `train` kwarg; declare it
+    # concretely so dropout stays ON during LoRA training
+    def __call__(self, x, train: bool = False):  # pragma: no cover
+        raise NotImplementedError("use .apply()")
+
+    def init(self, rng, x, **kw) -> dict:
+        base_rng, lora_rng = jax.random.split(rng)
+        if self._accepts_train:
+            kw.setdefault("train", False)
+        variables = dict(self.model.init(base_rng, x, **kw))
+        base_params = variables.pop("params")
+        return {
+            "params": {
+                "base": base_params,
+                "lora": lora_init(lora_rng, base_params, self.rank,
+                                  self.targets),
+            },
+            **variables,  # batch_stats etc. stay top-level collections
+        }
+
+    def apply(self, variables, x, rngs=None, train: bool = False,
+              mutable=None, **kw):
+        p = variables["params"]
+        merged = lora_merge(p["base"], p["lora"], self.alpha)
+        rest = {k: v for k, v in variables.items() if k != "params"}
+        if self._accepts_train:
+            kw["train"] = train
+        return self.model.apply(
+            {"params": merged, **rest}, x, rngs=rngs,
+            **({"mutable": mutable} if mutable is not None else {}), **kw,
+        )
+
+
+def lora_labels(params: dict) -> dict:
+    """'lora' / 'frozen' label per top-level subtree (multi_transform)."""
+    return {
+        k: jax.tree.map(lambda _: "lora" if k == "lora" else "frozen", v)
+        for k, v in params.items()
+    }
+
+
+def lora_tx(inner: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Optimizer that trains ONLY the adapters: `inner` applies to the
+    'lora' subtree, the base subtree is frozen with zero updates — and,
+    critically for HBM, gets no optimizer moments."""
+    return optax.multi_transform(
+        {"lora": inner, "frozen": optax.set_to_zero()}, lora_labels
+    )

@@ -143,7 +143,16 @@ class Trainer:
             "train" in inspect.signature(model.__call__).parameters
         )
         self.apply_fn = apply_fn or self._default_apply
-        self.tx = tx if tx is not None else self._default_tx()
+        # tx may be a GradientTransformation, or a FACTORY taking the
+        # config-built default (warmup/cosine schedule + clipping) — so
+        # wrappers like lora_tx compose with the schedule instead of
+        # silently replacing it with a bare optimizer
+        if tx is None:
+            self.tx = self._default_tx()
+        elif isinstance(tx, optax.GradientTransformation):
+            self.tx = tx
+        else:
+            self.tx = tx(self._default_tx())
         self._jit_train_step = jax.jit(self._train_step, donate_argnums=0)
         self._fused_cache: dict[int, Callable] = {}  # n -> jitted n-step scan
         self._fused_compiled: dict[int, Any] = {}  # n -> AOT executable

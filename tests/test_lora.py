@@ -1,0 +1,174 @@
+"""LoRA parameter-efficient fine-tuning: zero-delta init, frozen base,
+adapter-only optimizer state, Trainer integration, mesh compatibility."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.models import BertConfig, BertForSequenceClassification
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.train import (
+    LoraModel,
+    Trainer,
+    TrainerConfig,
+    lora_tx,
+)
+from kubeflow_tpu.train.data import synthetic_text_dataset
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = BertConfig.tiny(dropout_rate=0.0)
+    base = BertForSequenceClassification(cfg, num_classes=2)
+    lora = LoraModel(base, rank=4)
+    ds = synthetic_text_dataset(n_train=64, n_test=32, seq_len=16,
+                                vocab_size=cfg.vocab_size)
+    return cfg, base, lora, ds
+
+
+class TestLoraNumerics:
+    def test_zero_init_matches_base_model(self, setup):
+        """B = 0 at init => adapted model == base model exactly."""
+        cfg, base, lora, ds = setup
+        x = ds.x_train[:4]
+        variables = lora.init(jax.random.PRNGKey(0), x)
+        base_out = base.apply({"params": variables["params"]["base"]}, x)
+        lora_out = lora.apply(variables, x)
+        np.testing.assert_allclose(np.asarray(lora_out),
+                                   np.asarray(base_out), atol=1e-6)
+
+    def test_adapter_count_is_small(self, setup):
+        cfg, base, lora, ds = setup
+        variables = lora.init(jax.random.PRNGKey(0), ds.x_train[:4])
+        n_base = sum(x.size for x in
+                     jax.tree.leaves(variables["params"]["base"]))
+        n_lora = sum(x.size for x in
+                     jax.tree.leaves(variables["params"]["lora"]))
+        assert n_lora < n_base / 5, (n_lora, n_base)
+
+
+class TestLoraTraining:
+    def test_base_frozen_adapters_train_loss_drops(self, setup):
+        cfg, base, lora, ds = setup
+        trainer = Trainer(
+            lora,
+            TrainerConfig(batch_size=16, steps=12, learning_rate=5e-3,
+                          log_every_steps=10**9),
+            tx=lora_tx(optax.adam(5e-3)),
+        )
+        state = trainer.init_state(ds.x_train[:16])
+        base_before = jax.tree.map(np.asarray, state.params["base"])
+        losses = []
+        for i in range(6):
+            state, m = trainer.train_step(
+                state, (ds.x_train[:16], ds.y_train[:16])
+            )
+            losses.append(float(m["loss"]))
+        # base NEVER moves
+        for a, b in zip(jax.tree.leaves(base_before),
+                        jax.tree.leaves(state.params["base"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # adapters DO move, and learn
+        n_changed = sum(
+            int(not np.array_equal(np.zeros_like(b), np.asarray(b)))
+            for k, b in
+            jax.tree_util.tree_flatten_with_path(state.params["lora"])[0]
+            if "lora_b" in str(k)
+        )
+        assert n_changed > 0
+        assert losses[-1] < losses[0]
+
+    def test_optimizer_state_only_for_adapters(self, setup):
+        """The HBM win: Adam moments exist for the lora subtree only."""
+        cfg, base, lora, ds = setup
+        trainer = Trainer(
+            lora,
+            TrainerConfig(batch_size=16, steps=2, log_every_steps=10**9),
+            tx=lora_tx(optax.adam(1e-3)),
+        )
+        state = trainer.init_state(ds.x_train[:16])
+        n_lora = sum(x.size for x in jax.tree.leaves(state.params["lora"]))
+        n_opt = sum(
+            x.size for x in jax.tree.leaves(state.opt_state)
+            if hasattr(x, "size")
+        )
+        # two Adam moments per adapter param (+ scalar counts); if base
+        # moments existed this would be ~2x the FULL param count
+        assert n_opt < 2 * n_lora + 1000, (n_opt, n_lora)
+
+    def test_trains_under_mesh(self, setup, cpu_devices):
+        cfg, base, lora, ds = setup
+        mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2),
+                          cpu_devices[:8])
+        trainer = Trainer(
+            lora,
+            TrainerConfig(batch_size=16, steps=2, log_every_steps=10**9),
+            tx=lora_tx(optax.adam(1e-3)),
+            mesh=mesh,
+        )
+        state = trainer.init_state(ds.x_train[:16])
+        # base kernels keep the family's TP sharding through the prefix
+        qk = state.params["base"]["encoder"]["layer_0"]["attention"]["query"]["kernel"]
+        assert "model" in jax.tree.leaves(tuple(qk.sharding.spec))
+        state, m = trainer.train_step(state, (ds.x_train[:16], ds.y_train[:16]))
+        assert np.isfinite(float(m["loss"]))
+
+
+def test_lora_wraps_gpt(setup):
+    """Family-agnostic: the same wrapper adapts the GPT decoder."""
+    from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+
+    cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=32)
+    lora = LoraModel(GPTLM(cfg), rank=2)
+    ids = jnp.ones((2, 8), jnp.int32)
+    variables = lora.init(jax.random.PRNGKey(0), ids)
+    out = lora.apply(variables, ids)
+    assert out.shape == (2, 8, cfg.vocab_size)
+
+
+def test_lora_wraps_pipeline_model(cpu_devices):
+    """Pipeline-stacked kernels get per-stage adapters (leading stage dim,
+    sharded over `pipeline` by the stages/ catch-all rule); base frozen."""
+    from kubeflow_tpu.models.bert_pp import BertPipelineClassifier
+
+    cfg = BertConfig.tiny(dropout_rate=0.0)
+    pp = BertPipelineClassifier(cfg, num_stages=2, n_micro=2)
+    lora = LoraModel(pp, rank=4)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, pipeline=2),
+                      cpu_devices[:8])
+    ds = synthetic_text_dataset(n_train=16, n_test=8, seq_len=16,
+                                vocab_size=cfg.vocab_size)
+    trainer = Trainer(
+        lora,
+        TrainerConfig(batch_size=8, steps=1, log_every_steps=10**9),
+        tx=lora_tx,  # factory form: wraps the config-built schedule
+        mesh=mesh,
+    )
+    state = trainer.init_state(ds.x_train[:8])
+    qa = state.params["lora"]["stages"]["layer_0"]["attention"]["query"][
+        "kernel"]["lora_a"]
+    assert qa.shape[0] == 2 and qa.shape[-1] == 4  # (stages, in, r)
+    assert qa.sharding.spec[0] == "pipeline"
+    base_before = jax.tree.map(np.asarray, state.params["base"])
+    state, m = trainer.train_step(state, (ds.x_train[:8], ds.y_train[:8]))
+    assert np.isfinite(float(m["loss"]))
+    for a, b in zip(jax.tree.leaves(base_before),
+                    jax.tree.leaves(state.params["base"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_attention_kernels_are_adapted():
+    """DenseGeneral q/k/v ((in, H, D)) and attn_out ((H, D, out)) adapt via
+    their logical (in, out) flattening — not skipped, not misread."""
+    cfg = BertConfig.tiny(dropout_rate=0.0)
+    base = BertForSequenceClassification(cfg, num_classes=2)
+    lora = LoraModel(base, rank=4)
+    x = jnp.ones((2, 8), jnp.int32)
+    variables = lora.init(jax.random.PRNGKey(0), x)
+    att = variables["params"]["lora"]["encoder"]["layer_0"]["attention"]
+    assert att["query"]["kernel"]["lora_a"].shape == (64, 4)
+    assert att["query"]["kernel"]["lora_b"].shape == (4, 64)  # H*D flattened
+    assert att["attn_out"]["kernel"]["lora_a"].shape == (64, 4)  # H*D in
+    assert att["attn_out"]["kernel"]["lora_b"].shape == (4, 64)
