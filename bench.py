@@ -228,6 +228,41 @@ def bench_gpt2s_flash_2k(steps: int = 10, batch_size: int = 4, seq_len: int = 20
     return _finish(r, dt, steps, 6 * 124e6 * tokens + attn)
 
 
+def bench_vitb16(steps: int = 30, batch_size: int = 128, image_size: int = 224) -> dict:
+    """ViT-B/16 images/sec/chip — the MXU-native image-training path. On
+    this backend convs run at 0.3-0.6 TFLOP/s while matmuls hit 117
+    (docs/perf.md), so ViT is the performance-first counterpoint to the
+    conv-bound ResNet flagship: same task shape, all-matmul compute."""
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import ViTClassifier, ViTConfig
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_image_dataset
+
+    cfg = ViTConfig.base(dtype=jnp.bfloat16, dropout_rate=0.0,
+                         image_size=image_size)
+    ds = synthetic_image_dataset(
+        n_train=batch_size, n_test=batch_size,
+        shape=(image_size, image_size, 3), num_classes=1000,
+    )
+    trainer = Trainer(
+        ViTClassifier(cfg),
+        TrainerConfig(batch_size=batch_size, compute_dtype=jnp.bfloat16,
+                      log_every_steps=10**9),
+    )
+    state = trainer.init_state(ds.x_train[:batch_size])
+    batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
+    dt = _timed_steps(trainer, state, batch, steps)
+    # ViT-B/16 fwd ~= 17.6 GFLOP/image at 224^2 (attention + MLP matmuls);
+    # fwd+bwd ~= 3x
+    r = {
+        "metric": "vitb16_images_per_sec_per_chip",
+        "value": round(steps * batch_size / dt, 1),
+        "unit": "images/sec/chip",
+    }
+    return _finish(r, dt, steps, 3 * 17.6e9 * batch_size)
+
+
 def bench_gpt2s_decode(batch_size: int = 8, prompt_len: int = 128,
                        new_tokens: int = 128) -> dict:
     """Autoregressive decode throughput (generated tokens/sec/chip) through
@@ -443,6 +478,7 @@ SUITE_BENCHES = [
     (bench_mnist_mlp, "mnist_mlp_images_per_sec_per_chip", "images/sec/chip"),
     (bench_bert_base, "bert_base_steps_per_sec", "steps/sec"),
     FLAGSHIP,
+    (bench_vitb16, "vitb16_images_per_sec_per_chip", "images/sec/chip"),
     (bench_gpt2s_flash_2k, "gpt2s_flash_2k_tokens_per_sec_per_chip", "tokens/sec/chip"),
     (bench_gpt2s_decode, "gpt2s_decode_tokens_per_sec_per_chip", "tokens/sec/chip"),
 ]

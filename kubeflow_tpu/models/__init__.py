@@ -19,6 +19,7 @@ from kubeflow_tpu.models.gpt import (
     causal_lm_loss,
 )
 from kubeflow_tpu.models.mnist import MnistCNN, MnistMLP
+from kubeflow_tpu.models.vit import ViTClassifier, ViTConfig
 from kubeflow_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -40,6 +41,8 @@ __all__ = [
     "causal_lm_eval_metrics",
     "MnistMLP",
     "MnistCNN",
+    "ViTClassifier",
+    "ViTConfig",
     "ResNet",
     "ResNet18",
     "ResNet34",

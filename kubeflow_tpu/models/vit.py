@@ -1,0 +1,144 @@
+"""ViT — vision transformer classifier (Dosovitskiy et al. 2020).
+
+The reference platform ships no models (user images supply them — SURVEY.md
+L6); this family exists because patch-embedding + encoder turns IMAGE
+workloads into the shape TPUs like best: one big (B, N_patches, H) matmul
+stream onto the MXU instead of the conv lowering this backend runs at
+0.3-0.6 TFLOP/s (docs/perf.md item 4) — ViT is the performance-first
+alternative to ResNet here, not just zoo breadth.
+
+Reuses the BERT encoder block (models/bert.py BertLayer) with an all-ones
+mask, so TP/FSDP PARTITION_RULES, pluggable attention (dense or flash —
+NOT ring/ulysses: the sequence is num_patches + 1 CLS, always odd, so it
+cannot divide a context axis), and activation pinning come for free; the
+patch embed is a single DenseGeneral over flattened patches (a reshape +
+matmul — no conv op).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.bert import (
+    ACT_SPEC,
+    PARTITION_RULES,
+    BertConfig,
+    BertLayer,
+    constrain,
+)
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.float32
+    # dense | flash (seq = patches + CLS is odd — context-parallel ring/
+    # ulysses cannot shard it; flash takes the ragged-tail fallback)
+    attention: str = "dense"
+    attention_block: int = 128
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}"
+            )
+        if self.hidden_size % self.num_heads:
+            raise ValueError(
+                f"hidden_size {self.hidden_size} not divisible by "
+                f"num_heads {self.num_heads}"
+            )
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def encoder_config(self) -> BertConfig:
+        """The BertLayer-compatible view of this config (seq = patches+CLS)."""
+        return BertConfig(
+            vocab_size=2,  # unused by the encoder blocks
+            hidden_size=self.hidden_size,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            mlp_dim=self.mlp_dim,
+            max_len=self.num_patches + 1,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            attention=self.attention,
+            attention_block=self.attention_block,
+        )
+
+    @staticmethod
+    def base(**kw) -> "ViTConfig":
+        return ViTConfig(**kw)  # ViT-B/16 shape
+
+    @staticmethod
+    def tiny(**kw) -> "ViTConfig":
+        d = dict(image_size=32, patch_size=8, num_classes=10, hidden_size=64,
+                 num_layers=2, num_heads=4, mlp_dim=128)
+        d.update(kw)
+        return ViTConfig(**d)
+
+
+class ViTClassifier(nn.Module):
+    """images (B, H, W, C) -> class logits (B, num_classes) f32.
+
+    PARTITION_RULES are BERT's (set below): the encoder params match the
+    same suffixes; patch_embed/head fall to the replicate/fsdp heuristic.
+    """
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        c = self.cfg
+        b, h, w, ch = images.shape
+        p = c.patch_size
+        if (h, w) != (c.image_size, c.image_size):
+            raise ValueError(
+                f"expected {c.image_size}x{c.image_size} images, got {h}x{w}"
+            )
+        # patchify as reshape+transpose, embed as ONE matmul (MXU-native;
+        # never a conv op on this backend)
+        x = images.astype(c.dtype).reshape(b, h // p, p, w // p, p, ch)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, c.num_patches, p * p * ch)
+        x = nn.Dense(c.hidden_size, dtype=c.dtype, name="patch_embed")(x)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, c.hidden_size),
+            jnp.float32,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, c.hidden_size)).astype(c.dtype), x],
+            axis=1,
+        )
+        pos = self.param(
+            "position_embed", nn.initializers.normal(stddev=0.02),
+            (1, c.num_patches + 1, c.hidden_size), jnp.float32,
+        )
+        x = x + pos.astype(c.dtype)
+        x = nn.Dropout(c.dropout_rate, deterministic=not train)(x)
+        x = constrain(x, ACT_SPEC)
+
+        ecfg = self.cfg.encoder_config()
+        mask = jnp.ones((b, c.num_patches + 1), bool)  # no padding in images
+        for i in range(c.num_layers):
+            x = BertLayer(ecfg, name=f"layer_{i}")(x, mask, train)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_final")(x)
+        logits = nn.Dense(c.num_classes, dtype=c.dtype, name="head")(x[:, 0])
+        return logits.astype(jnp.float32)
+
+
+ViTClassifier.PARTITION_RULES = PARTITION_RULES

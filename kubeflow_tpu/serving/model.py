@@ -143,6 +143,11 @@ def _build_family(family: str, kwargs: dict):
         cfg = GPTConfig.tiny(**cfg_kw) if kwargs.pop("size", "tiny") == "tiny" \
             else GPTConfig.small(**cfg_kw)
         return GPTLM(cfg, **kwargs)
+    if family == "vit-classifier":
+        cfg_kw = kwargs.pop("config", {})
+        cfg = M.ViTConfig.tiny(**cfg_kw) if kwargs.pop("size", "tiny") == "tiny" \
+            else M.ViTConfig.base(**cfg_kw)
+        return M.ViTClassifier(cfg, **kwargs)
     raise ValueError(f"unknown model family {family!r}")
 
 
