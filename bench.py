@@ -60,6 +60,40 @@ BENCH_BASELINE = {
 # lines are tagged with WHICH baseline protocol the ratio compares against.
 BASELINE_PROTOCOL = "r2-initial-presync"
 
+
+def _adopt_fixed_baseline() -> None:
+    """Retire the poisoned r2 baseline the moment a fixed-protocol capture
+    exists: tunnel_watch.sh writes bench_r3_fixed.jsonl at the next live
+    window, and every later bench run (including the driver's end-of-round
+    one) then reports vs_baseline against it automatically."""
+    global BASELINE_PROTOCOL
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_r3_fixed.jsonl")
+    try:
+        fixed: dict[str, float] = {}
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                # last line per metric wins (the capture contract); error
+                # records carry value 0.0 and never become a baseline
+                if r.get("metric") and r.get("value") and not r.get("error"):
+                    fixed[r["metric"]] = float(r["value"])
+        if fixed:
+            BENCH_BASELINE.clear()
+            BENCH_BASELINE.update(fixed)
+            BASELINE_PROTOCOL = "r3-fixed"
+    except OSError:
+        pass
+
+
+_adopt_fixed_baseline()
+
 MAX_ATTEMPTS = 4          # re-exec attempts on backend-init failure
 RETRY_BASE_DELAY_S = 10.0
 # the axon tunnel sometimes HANGS (accepts the connection, then never

@@ -172,3 +172,28 @@ def test_attention_kernels_are_adapted():
     assert att["query"]["kernel"]["lora_b"].shape == (4, 64)  # H*D flattened
     assert att["attn_out"]["kernel"]["lora_a"].shape == (64, 4)  # H*D in
     assert att["attn_out"]["kernel"]["lora_b"].shape == (4, 64)
+
+
+def test_lora_state_checkpoint_roundtrip(tmp_path, setup):
+    """{'base', 'lora'} split param trees (and adapter-only opt state) must
+    survive orbax save/restore — the preemption contract for LoRA jobs."""
+    cfg, base, _, ds = setup
+    mk = lambda: Trainer(  # noqa: E731
+        LoraModel(BertForSequenceClassification(cfg, num_classes=2), rank=4),
+        TrainerConfig(batch_size=8, steps=1, log_every_steps=10**9,
+                      checkpoint_dir=str(tmp_path / "ckpt")),
+        tx=lora_tx,
+    )
+    t1 = mk()
+    state = t1.init_state(ds.x_train[:8])
+    state, _ = t1.train_step(state, (ds.x_train[:8], ds.y_train[:8]))
+    t1.checkpointer.save(1, state)
+    t1.checkpointer.wait()
+    want = jax.tree.leaves(state.params)
+
+    t2 = mk()
+    restored = t2.checkpointer.restore_latest(t2.init_state(ds.x_train[:8]))
+    assert restored is not None and restored[0] == 1
+    got = jax.tree.leaves(restored[1].params)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
