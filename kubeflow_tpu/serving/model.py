@@ -157,6 +157,7 @@ def save_predictor(
     variables: dict,
     example_input: np.ndarray,
     generate: dict | None = None,
+    quantize: bool = False,
     **family_kwargs,
 ) -> Path:
     """Write the jax-runtime model-dir contract: config.json (family +
@@ -165,7 +166,10 @@ def save_predictor(
 
     generate: for causal-LM families, decode parameters (max_new_tokens,
     temperature, top_k) — the predictor then serves token GENERATION (ids
-    in -> generated ids out, KV-cache decode loop) instead of logits."""
+    in -> generated ids out, KV-cache decode loop) instead of logits.
+
+    quantize: int8 weight-only artifact (~4x smaller params.msgpack;
+    per-output-channel scales, dequantized once at load — serving/quant.py)."""
     from flax import serialization
 
     d = Path(model_dir)
@@ -179,6 +183,11 @@ def save_predictor(
     }
     if generate is not None:
         cfg["generate"] = generate
+    if quantize:
+        from kubeflow_tpu.serving.quant import quantize_variables
+
+        cfg["quantized"] = True
+        variables = quantize_variables(dict(variables))
     (d / CONFIG_FILE).write_text(json.dumps(cfg, indent=2))
     (d / PARAMS_FILE).write_bytes(serialization.to_bytes(variables))
     return d
@@ -201,9 +210,19 @@ def _load_predict_fn(model_dir: Path):
     if "train" in inspect.signature(module.__call__).parameters:
         kwargs["train"] = False
     target = module.init(jax.random.PRNGKey(0), jnp.asarray(example), **kwargs)
-    variables = serialization.from_bytes(
-        target, (model_dir / PARAMS_FILE).read_bytes()
-    )
+    raw = (model_dir / PARAMS_FILE).read_bytes()
+    if config.get("quantized"):
+        # int8 artifact: its tree shape differs from the module's, so
+        # restore target-free, dequantize, then cast to the target's leaf
+        # dtypes (serving/quant.py)
+        from kubeflow_tpu.serving.quant import dequantize_variables
+
+        deq = dequantize_variables(serialization.msgpack_restore(raw))
+        variables = jax.tree.map(
+            lambda t, x: jnp.asarray(x, t.dtype), target, deq
+        )
+    else:
+        variables = serialization.from_bytes(target, raw)
 
     gen = config.get("generate")
     if gen is not None:

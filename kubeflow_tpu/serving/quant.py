@@ -1,0 +1,92 @@
+"""Int8 weight-only quantization for serving artifacts.
+
+The reference serves float checkpoints; for TPU serving the dominant costs
+are artifact bytes (storage initializer pull, HBM upload) and cold-start
+time. Weight-only int8 cuts params.msgpack ~4x with symmetric per-output-
+channel scales (the standard LLM serving recipe); weights dequantize ONCE
+at load to the model dtype, so runtime numerics and speed are the float
+path's — this is a transport/storage format, not a compute mode.
+
+    save_predictor(..., quantize=True)       # writes int8 + scales
+    JaxModel.load()                          # dequantizes transparently
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+from flax import traverse_util
+
+# quantize big matmul weights; leave LayerNorm/bias/small leaves float
+DEFAULT_TARGETS = r"(kernel|embedding)$"
+MIN_SIZE = 4096
+
+_QKEY = "__int8__"  # marker key inside a quantized leaf's subtree
+
+
+def quantize_variables(variables: dict, targets: str = DEFAULT_TARGETS) -> dict:
+    """params tree -> same tree with matching leaves replaced by
+    {_QKEY: 1, q: int8, scale: f32 per-output-channel}."""
+    flat = traverse_util.flatten_dict(variables, sep="/")
+    out: dict[str, Any] = {}
+    for path, w in flat.items():
+        arr = np.asarray(w)
+        if (re.search(targets, path) and arr.ndim >= 2
+                and arr.size >= MIN_SIZE
+                and arr.dtype.kind == "f"):
+            a32 = arr.astype(np.float32)
+            # symmetric per-output-channel (last dim) scales
+            absmax = np.max(np.abs(a32), axis=tuple(range(arr.ndim - 1)))
+            scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+            q = np.clip(np.rint(a32 / scale), -127, 127).astype(np.int8)
+            out[path + "/" + _QKEY] = np.int8(1)
+            out[path + "/q"] = q
+            out[path + "/scale"] = scale
+        else:
+            out[path] = arr
+    return traverse_util.unflatten_dict(out, sep="/")
+
+
+def dequantize_variables(variables: dict, dtype=None) -> dict:
+    """Inverse of quantize_variables: int8 leaves -> float weights (model
+    dtype resolution happens at apply; dtype here optionally casts)."""
+    flat = traverse_util.flatten_dict(variables, sep="/")
+    out: dict[str, Any] = {}
+    done = set()
+    for path in list(flat):
+        if not path.endswith("/" + _QKEY):
+            continue
+        base = path[: -(len(_QKEY) + 1)]
+        q = np.asarray(flat[base + "/q"], np.float32)
+        scale = np.asarray(flat[base + "/scale"], np.float32)
+        w = q * scale  # broadcast over the last dim
+        out[base] = w.astype(dtype) if dtype is not None else w
+        done.update({path, base + "/q", base + "/scale"})
+    for path, v in flat.items():
+        if path not in done:
+            out[path] = v
+    return traverse_util.unflatten_dict(out, sep="/")
+
+
+def is_quantized(variables: dict) -> bool:
+    return any(
+        p.endswith("/" + _QKEY)
+        for p in traverse_util.flatten_dict(variables, sep="/")
+    )
+
+
+def quantization_error(variables: dict, quantized: dict) -> float:
+    """Max relative per-tensor L2 error across quantized leaves (sanity
+    metric: per-channel int8 on trained nets sits well under 1%)."""
+    deq = dequantize_variables(quantized)
+    a = traverse_util.flatten_dict(variables, sep="/")
+    b = traverse_util.flatten_dict(deq, sep="/")
+    worst = 0.0
+    for path, w in a.items():
+        w = np.asarray(w, np.float32)
+        d = np.asarray(b[path], np.float32)
+        denom = float(np.linalg.norm(w)) or 1.0
+        worst = max(worst, float(np.linalg.norm(w - d)) / denom)
+    return worst

@@ -1,0 +1,95 @@
+"""Int8 weight-only serving artifacts: round-trip fidelity, size win,
+predictor agreement with the float path, AOT composition."""
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.model import PARAMS_FILE, JaxModel, save_predictor
+from kubeflow_tpu.serving.quant import (
+    dequantize_variables,
+    is_quantized,
+    quantization_error,
+    quantize_variables,
+)
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """A briefly trained MLP so weights are non-degenerate."""
+    from kubeflow_tpu.models import MnistMLP
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_image_dataset
+
+    ds = synthetic_image_dataset(n_train=256, n_test=64, shape=(28, 28, 1),
+                                 num_classes=10)
+    model = MnistMLP(hidden=(128, 64))
+    trainer = Trainer(model, TrainerConfig(batch_size=64, steps=20,
+                                           log_every_steps=10**9))
+    state = trainer.init_state(ds.x_train[:64])
+    for _ in range(5):
+        state, _ = trainer.train_step(
+            state, (ds.x_train[:64], ds.y_train[:64])
+        )
+    params = jax.tree.map(np.asarray, state.params)
+    return model, {"params": params}, ds
+
+
+class TestQuantRoundTrip:
+    def test_error_is_small(self, trained):
+        model, variables, ds = trained
+        q = quantize_variables(dict(variables))
+        assert is_quantized(q)
+        err = quantization_error(variables, q)
+        assert err < 0.01, f"per-channel int8 error {err:.4f} >= 1%"
+
+    def test_small_leaves_stay_float(self, trained):
+        model, variables, ds = trained
+        q = quantize_variables(dict(variables))
+        # biases are small: must pass through untouched
+        deq = dequantize_variables(q)
+        b = variables["params"]["Dense_0"]["bias"]
+        np.testing.assert_array_equal(
+            np.asarray(deq["params"]["Dense_0"]["bias"]), np.asarray(b)
+        )
+
+
+class TestQuantServing:
+    def test_artifact_smaller_and_predictions_agree(self, trained, tmp_path):
+        model, variables, ds = trained
+        x = np.asarray(ds.x_test[:32], np.float32)
+        fd = save_predictor(tmp_path / "f", "mnist-mlp", dict(variables),
+                            x[:4], hidden=[128, 64], num_classes=10)
+        qd = save_predictor(tmp_path / "q", "mnist-mlp", dict(variables),
+                            x[:4], quantize=True, hidden=[128, 64],
+                            num_classes=10)
+        f_size = (fd / PARAMS_FILE).stat().st_size
+        q_size = (qd / PARAMS_FILE).stat().st_size
+        assert q_size < f_size / 2.5, (q_size, f_size)
+        assert json.loads((qd / "config.json").read_text())["quantized"]
+
+        fm, qm = JaxModel("f", fd), JaxModel("q", qd)
+        fm.load()
+        qm.load()
+        f_out = np.asarray(fm(x)["predictions"])
+        q_out = np.asarray(qm(x)["predictions"])
+        agree = float((f_out == q_out).mean())
+        assert agree >= 0.95, f"classification agreement {agree:.2f}"
+
+    def test_composes_with_aot(self, trained, tmp_path):
+        from kubeflow_tpu.serving import aot
+
+        model, variables, ds = trained
+        x = np.asarray(ds.x_test[:4], np.float32)
+        qd = save_predictor(tmp_path / "qa", "mnist-mlp", dict(variables),
+                            x, quantize=True, hidden=[128, 64],
+                            num_classes=10)
+        aot.export_predictor(qd)  # dequantized-at-export, baked in
+        jm = JaxModel("qa", qd)
+        jm.load()
+        assert jm._aot_batch == 4
+        out = jm(x)
+        assert len(out["predictions"]) == 4
