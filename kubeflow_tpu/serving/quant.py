@@ -37,8 +37,15 @@ def quantize_variables(variables: dict, targets: str = DEFAULT_TARGETS) -> dict:
                 and arr.size >= MIN_SIZE
                 and arr.dtype.kind == "f"):
             a32 = arr.astype(np.float32)
-            # symmetric per-output-channel (last dim) scales
-            absmax = np.max(np.abs(a32), axis=tuple(range(arr.ndim - 1)))
+            if re.search(r"embedding$", path):
+                # per-ROW (per-token) scales: a shared per-feature scale
+                # would let the largest-magnitude token set the resolution
+                # for every rare small-norm row (and the weight-tied LM
+                # head reads this table for logits)
+                absmax = np.max(np.abs(a32), axis=-1, keepdims=True)
+            else:
+                # symmetric per-output-channel (last dim) scales
+                absmax = np.max(np.abs(a32), axis=tuple(range(arr.ndim - 1)))
             scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
             q = np.clip(np.rint(a32 / scale), -127, 127).astype(np.int8)
             out[path + "/" + _QKEY] = np.int8(1)

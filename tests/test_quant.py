@@ -93,3 +93,17 @@ class TestQuantServing:
         assert jm._aot_batch == 4
         out = jm(x)
         assert len(out["predictions"]) == 4
+
+
+def test_embedding_rows_get_per_row_scales():
+    """A huge-magnitude token must not set the resolution for rare
+    small-norm rows (the weight-tied LM head reads this table)."""
+    table = np.full((100, 64), 0.01, np.float32)
+    table[0] = 10.0
+    v = {"params": {"token_embed": {"embedding": table}}}
+    q = quantize_variables(dict(v))
+    scale = q["params"]["token_embed"]["embedding"]["scale"]
+    assert scale.shape == (100, 1)
+    deq = dequantize_variables(q)["params"]["token_embed"]["embedding"]
+    row_err = np.abs(deq[50] - table[50]).max() / 0.01
+    assert row_err < 0.01, f"rare-row relative error {row_err:.3f}"
