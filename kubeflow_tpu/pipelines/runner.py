@@ -72,10 +72,13 @@ class LocalPipelineRunner:
         metadata_store: MetadataStore | None = None,
         cache: bool = True,
         platform=None,
+        max_parallel: int = 4,
     ):
         # platform enables trainJob steps (pipeline -> TrainJob recursion);
         # python-function steps never need it
         self.platform = platform
+        # independent DAG branches run concurrently up to this width
+        self.max_parallel = max(1, max_parallel)
         self.work_dir = Path(work_dir)
         self.cache_dir = self.work_dir / "cache"
         self.cache_enabled = cache
@@ -120,19 +123,13 @@ class LocalPipelineRunner:
 
         order = self._topo_order(tasks)
         # exit handlers run LAST regardless of upstream verdicts (kfp
-        # ExitHandler semantics); everything else keeps topo order
-        order = [t for t in order if not tasks[t].get("exitHandler")] + [
-            t for t in order if tasks[t].get("exitHandler")
-        ]
-        for tname in order:
+        # ExitHandler semantics); everything else runs through the parallel
+        # DAG executor (independent branches concurrently, like Argo)
+        regular = [t for t in order if not tasks[t].get("exitHandler")]
+        handlers = [t for t in order if tasks[t].get("exitHandler")]
+        self._execute_dag(ir, run, run_dir, tasks, regular, run_exec_id)
+        for tname in handlers:
             spec = tasks[tname]
-            deps = self._deps_of(spec)
-            if not spec.get("exitHandler") and any(
-                run.tasks[d].state in (TaskState.FAILED, TaskState.SKIPPED)
-                for d in deps
-            ):
-                run.tasks[tname].state = TaskState.SKIPPED
-                continue
             if not self._conditions_hold(run, spec):
                 run.tasks[tname].state = TaskState.SKIPPED
                 continue
@@ -170,6 +167,58 @@ class LocalPipelineRunner:
         return run
 
     # --------------------------------------------------------------- helpers
+
+    def _execute_dag(self, ir, run, run_dir, tasks, names, run_exec_id) -> None:
+        """Dependency-driven parallel execution (Argo/KFP semantics):
+        a task launches the moment every dependency SUCCEEDED; any
+        failed/skipped dependency cascades a skip; independent branches run
+        concurrently up to max_parallel (each step is its own subprocess,
+        so the pool parallelizes real work, not bytecode). A failure stops
+        dependents only — independent branches still complete, matching
+        the serial executor's semantics."""
+        from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+        from concurrent.futures import wait as _fwait
+
+        remaining = list(names)
+        futures: dict = {}
+        with ThreadPoolExecutor(max_workers=self.max_parallel) as ex:
+            while remaining or futures:
+                progressed = True
+                while progressed:
+                    progressed = False
+                    for tname in list(remaining):
+                        spec = tasks[tname]
+                        states = [
+                            run.tasks[d].state for d in self._deps_of(spec)
+                        ]
+                        if any(s in (TaskState.FAILED, TaskState.SKIPPED)
+                               for s in states):
+                            run.tasks[tname].state = TaskState.SKIPPED
+                            remaining.remove(tname)
+                            progressed = True
+                        elif all(s in (TaskState.SUCCEEDED, TaskState.CACHED)
+                                 for s in states):
+                            if not self._conditions_hold(run, spec):
+                                run.tasks[tname].state = TaskState.SKIPPED
+                            else:
+                                futures[ex.submit(
+                                    self._run_task, ir, run, run_dir,
+                                    tname, spec, run_exec_id,
+                                )] = tname
+                            remaining.remove(tname)
+                            progressed = True
+                if not futures:
+                    if remaining:  # acyclic per validate_ir; belt-and-braces
+                        raise RuntimeError(
+                            f"pipeline deadlock: unrunnable tasks {remaining}"
+                        )
+                    break
+                done, _ = _fwait(futures, return_when=FIRST_COMPLETED)
+                for f in done:
+                    tname = futures.pop(f)
+                    f.result()  # surface unexpected executor exceptions
+                    if run.tasks[tname].state == TaskState.FAILED:
+                        run.state = TaskState.FAILED
 
     @staticmethod
     def _deps_of(spec: dict) -> set[str]:
@@ -257,16 +306,23 @@ class LocalPipelineRunner:
         if "trainJob" in executor or "sweep" in executor:
             # kfp retryPolicy for job-launching steps: resubmit the whole
             # step (fresh TaskResult per attempt; each attempt records its
-            # own lineage execution)
+            # own lineage execution). Attempts run against a DETACHED
+            # result and publish only the terminal verdict: the concurrent
+            # DAG scheduler must never observe a transient FAILED between
+            # retries (it would permanently skip dependents).
             helper = (
                 self._run_train_job_task if "trainJob" in executor
                 else self._run_sweep_task
             )
+            result.state = TaskState.RUNNING
             for attempt in range(retries + 1):
-                helper(run, run_dir, tname, executor, inputs, run_exec_id)
-                if run.tasks[tname].state != TaskState.FAILED or attempt == retries:
+                attempt_result = TaskResult()
+                helper(run, run_dir, tname, executor, inputs, run_exec_id,
+                       result=attempt_result)
+                if (attempt_result.state != TaskState.FAILED
+                        or attempt == retries):
+                    run.tasks[tname] = attempt_result
                     return
-                run.tasks[tname] = TaskResult()
             return
         it = spec.get("iterator")
         items = None
@@ -519,14 +575,16 @@ class LocalPipelineRunner:
 
     def _run_train_job_task(self, run: PipelineRun, run_dir: Path, tname: str,
                             executor: dict, inputs: dict,
-                            run_exec_id: int | None) -> None:
+                            run_exec_id: int | None,
+                            result: TaskResult | None = None) -> None:
         """Launch a TrainJob through the platform and adopt its verdict.
         Never cached: a training run's value is its side effects
-        (checkpoints), not a JSON output."""
+        (checkpoints), not a JSON output. `result` (when given) is a
+        detached per-attempt record the retry loop publishes terminally."""
         from kubeflow_tpu.api.serde import job_from_yaml
         from kubeflow_tpu.client import TrainingClient
 
-        result = run.tasks[tname]
+        result = result if result is not None else run.tasks[tname]
         if self.platform is None:
             result.state = TaskState.FAILED
             result.error = (
@@ -609,13 +667,16 @@ class LocalPipelineRunner:
 
     def _run_sweep_task(self, run: PipelineRun, run_dir: Path, tname: str,
                         executor: dict, inputs: dict,
-                        run_exec_id: int | None) -> None:
+                        run_exec_id: int | None,
+                        result: TaskResult | None = None) -> None:
         """Run an Experiment through the platform; output = optimal trial.
 
         Never cached (trials are side-effectful jobs). Downstream steps
         consume output["optimalParameters"] — the KFP-then-Katib-then-train
-        composition (SURVEY.md §3.4 recursing into §3.3)."""
-        result = run.tasks[tname]
+        composition (SURVEY.md §3.4 recursing into §3.3). `result` (when
+        given) is a detached per-attempt record the retry loop publishes
+        terminally."""
+        result = result if result is not None else run.tasks[tname]
         if self.platform is None:
             result.state = TaskState.FAILED
             result.error = "sweep step requires LocalPipelineRunner(platform=...)"

@@ -430,3 +430,71 @@ class TestRhsDependencyEdge:
         assert not run.succeeded
         assert run.tasks["boom"].state == TaskState.FAILED
         assert run.tasks["act"].state == TaskState.SKIPPED
+
+
+class TestParallelDag:
+    def test_independent_branches_run_concurrently(self, tmp_path):
+        """Two independent sleep steps must OVERLAP in time (Argo-parity
+        DAG executor): each records its [start, end] interval (processes
+        share CLOCK_MONOTONIC), and the intervals must intersect —
+        load-insensitive, unlike a wall-clock bound."""
+
+        @dsl.component
+        def sleeper_a() -> str:
+            import time
+            t0 = time.monotonic()
+            time.sleep(2)
+            return f"{t0}:{time.monotonic()}"
+
+        @dsl.component
+        def sleeper_b() -> str:
+            import time
+            t0 = time.monotonic()
+            time.sleep(2)
+            return f"{t0}:{time.monotonic()}"
+
+        @dsl.component
+        def join(a: str, b: str) -> str:
+            return a + ";" + b
+
+        @dsl.pipeline(name="par")
+        def p():
+            return join(a=sleeper_a(), b=sleeper_b())
+
+        ir = validate_ir(compile_pipeline(p()))
+        run = LocalPipelineRunner(work_dir=str(tmp_path), cache=False).run(ir)
+        assert run.succeeded
+        (a0, a1), (b0, b1) = (
+            tuple(map(float, part.split(":")))
+            for part in run.output.split(";")
+        )
+        assert a0 < b1 and b0 < a1, (
+            f"branches ran serially: a=[{a0:.1f},{a1:.1f}] "
+            f"b=[{b0:.1f},{b1:.1f}]"
+        )
+
+    def test_failure_skips_dependents_not_siblings(self, tmp_path):
+        @dsl.component
+        def boom() -> str:
+            raise RuntimeError("x")
+
+        @dsl.component
+        def child(v: str) -> str:
+            return v
+
+        @dsl.component
+        def independent() -> str:
+            return "ok"
+
+        @dsl.pipeline(name="parfail")
+        def p():
+            b = boom()
+            child(v=b)
+            independent()
+
+        ir = validate_ir(compile_pipeline(p()))
+        run = LocalPipelineRunner(work_dir=str(tmp_path), cache=False).run(ir)
+        assert not run.succeeded
+        assert run.tasks["boom"].state == TaskState.FAILED
+        assert run.tasks["child"].state == TaskState.SKIPPED
+        assert run.tasks["independent"].state == TaskState.SUCCEEDED
