@@ -112,10 +112,31 @@ def put_global(x: Any, sharding: NamedSharding) -> Any:
     return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 
-def shard_batch(batch: Any, mesh: Mesh) -> Any:
-    """Place a host batch onto the mesh, split along the data axes."""
+def put_process_local(x: Any, sharding: NamedSharding) -> Any:
+    """Assemble a global array from PER-PROCESS shards: each process holds
+    only its own rows (disjoint data loading — train/data.py
+    load_dataset_shards), and jax stitches the global batch across hosts.
+    The complement of put_global's replicated convention; single-process it
+    degenerates to a plain placement."""
+    if isinstance(x, jax.Array) and not isinstance(x, np.ndarray):
+        if isinstance(x.sharding, NamedSharding) and x.sharding.is_equivalent_to(
+            sharding, x.ndim
+        ):
+            return x
+    if jax.process_count() == 1:
+        return jax.device_put(np.asarray(x), sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+
+def shard_batch(batch: Any, mesh: Mesh, process_local: bool = False) -> Any:
+    """Place a host batch onto the mesh, split along the data axes.
+
+    process_local=True treats each process's arrays as ITS shard of the
+    global batch (disjoint per-host data pipelines); the default expects
+    every process to hold the identical full batch."""
     s = batch_sharding(mesh)
-    return jax.tree.map(lambda x: put_global(x, s), batch)
+    place = put_process_local if process_local else put_global
+    return jax.tree.map(lambda x: place(x, s), batch)
 
 
 def _path_str(path) -> str:

@@ -190,7 +190,7 @@ def steps_per_epoch(n: int, batch_size: int) -> int:
 
 
 def prefetch_to_device(
-    it: Iterator, mesh, size: int = 2
+    it: Iterator, mesh, size: int = 2, process_local: bool = False
 ) -> Iterator:
     """Double-buffering host->device prefetch.
 
@@ -209,8 +209,90 @@ def prefetch_to_device(
     buf: deque = deque()
     with jax.set_mesh(mesh):
         for b in it:
-            buf.append(shard_batch(b, mesh))
+            buf.append(shard_batch(b, mesh, process_local=process_local))
             if len(buf) >= size:
                 yield buf.popleft()
         while buf:
             yield buf.popleft()
+
+
+# ------------------------------------------------------------- sharded files
+
+def save_dataset_shards(ds: Dataset, out_dir: str, num_shards: int = 8) -> str:
+    """Write a Dataset as numbered .npz shards + manifest — the on-disk
+    contract multi-host gangs load per-process (reference analogue:
+    tf.data file sharding / torch DistributedSampler; here the unit is a
+    shard FILE so host reads never overlap)."""
+    import json as _json
+    from pathlib import Path as _Path
+
+    d = _Path(out_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    n = len(ds.x_train)
+    num_shards = max(1, min(num_shards, n))
+    bounds = np.linspace(0, n, num_shards + 1, dtype=int)
+    for i in range(num_shards):
+        lo, hi = bounds[i], bounds[i + 1]
+        np.savez(d / f"train-{i:05d}.npz",
+                 x=ds.x_train[lo:hi], y=ds.y_train[lo:hi])
+    np.savez(d / "test.npz", x=ds.x_test, y=ds.y_test)
+    (d / "manifest.json").write_text(_json.dumps({
+        "num_shards": num_shards,
+        "num_classes": int(ds.num_classes),
+        "n_train": int(n),
+    }))
+    return str(d)
+
+
+def load_dataset_shards(
+    data_dir: str,
+    process_id: int | None = None,
+    num_processes: int | None = None,
+) -> Dataset:
+    """Load a sharded dataset, taking only THIS process's shard files
+    (round-robin by index) in a multi-process gang — each host reads a
+    disjoint subset, the per-host data-parallel contract. Defaults to the
+    ambient jax.distributed topology; (0, 1) outside a gang.
+
+    The test split is replicated to every process (eval is cheap and the
+    Trainer's eval runs on the global batch)."""
+    import json as _json
+    from pathlib import Path as _Path
+
+    if (process_id is None) != (num_processes is None):
+        raise ValueError(
+            "pass BOTH process_id and num_processes, or neither (ambient "
+            "jax.distributed topology)"
+        )
+    if process_id is None:
+        import jax
+
+        process_id = jax.process_index()
+        num_processes = jax.process_count()
+    d = _Path(data_dir)
+    meta = _json.loads((d / "manifest.json").read_text())
+    num_shards = int(meta["num_shards"])
+    if num_shards < num_processes:
+        raise ValueError(
+            f"{num_shards} shard(s) cannot feed {num_processes} processes; "
+            f"re-shard with num_shards >= the gang size"
+        )
+    # every process must end with the SAME row count or gang step counts
+    # drift and a collective deadlocks; shard sizes are deterministic from
+    # the manifest, so each process computes the global minimum locally
+    bounds = np.linspace(0, int(meta["n_train"]), num_shards + 1, dtype=int)
+    sizes = bounds[1:] - bounds[:-1]
+    limit = min(
+        int(sizes[p::num_processes].sum()) for p in range(num_processes)
+    )
+    xs, ys = [], []
+    for i in range(process_id, num_shards, num_processes):
+        with np.load(d / f"train-{i:05d}.npz") as z:
+            xs.append(z["x"])
+            ys.append(z["y"])
+    with np.load(d / "test.npz") as test:
+        x_test, y_test = test["x"], test["y"]
+    return Dataset(
+        np.concatenate(xs)[:limit], np.concatenate(ys)[:limit],
+        x_test, y_test, int(meta["num_classes"]),
+    )

@@ -33,6 +33,7 @@ from jax.sharding import Mesh
 from kubeflow_tpu.parallel import build_mesh, MeshConfig
 from kubeflow_tpu.parallel.sharding import (
     put_global,
+    put_process_local,
     shard_batch,
     stacked_batch_sharding,
     state_shardings,
@@ -87,6 +88,12 @@ class TrainerConfig:
     profile_dir: str = ""
     # tfevents scalar output for TensorBoard; "" defers to KFTPU_EVENT_DIR
     event_dir: str = ""
+    # "replicated": every process feeds the identical full batch (the
+    # seed-deterministic pipeline convention); "process_local": each
+    # process feeds ONLY its own rows (disjoint per-host loading via
+    # train/data.py load_dataset_shards) and jax assembles the global
+    # batch across hosts
+    data_placement: str = "replicated"  # replicated | process_local
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -335,11 +342,18 @@ class Trainer:
             "count": w.sum(),
         }
 
+    @property
+    def _process_local(self) -> bool:
+        return self.config.data_placement == "process_local"
+
+    def _place(self, batch):
+        return shard_batch(batch, self.mesh, process_local=self._process_local)
+
     def train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         # ambient mesh enables P-form with_sharding_constraint pins inside
         # models (bert.constrain) without threading the mesh through modules
         with jax.set_mesh(self.mesh):
-            return self._jit_train_step(state, shard_batch(batch, self.mesh))
+            return self._jit_train_step(state, self._place(batch))
 
     def train_steps_fused(
         self, state: TrainState, batch, n: int
@@ -356,7 +370,7 @@ class Trainer:
         step and prefetch overlaps the transfer — but benches and synthetic-
         data loops should use this."""
         with jax.set_mesh(self.mesh):
-            batch = shard_batch(batch, self.mesh)
+            batch = self._place(batch)
             compiled = self._fused_compiled.get(n)
             if compiled is not None:
                 try:
@@ -403,7 +417,8 @@ class Trainer:
         """Run k steps over a host-stacked chunk (k, B, ...) in one dispatch."""
         with jax.set_mesh(self.mesh):
             s = stacked_batch_sharding(self.mesh)
-            xs = jax.tree.map(lambda a: put_global(a, s), stacked)
+            place = put_process_local if self._process_local else put_global
+            xs = jax.tree.map(lambda a: place(a, s), stacked)
             return self._fused_data_fn(k)(state, xs)
 
     def compile_fused(self, state: TrainState, batch, n: int):
@@ -417,7 +432,7 @@ class Trainer:
         benches rely on. `compiled(state, placed_batch)` runs with the
         jit-declared state donation."""
         with jax.set_mesh(self.mesh):
-            batch = shard_batch(batch, self.mesh)
+            batch = self._place(batch)
             batch = jax.jit(lambda t: jax.tree.map(lambda a: a + 0, t))(batch)
             compiled = self._fused_fn(n).lower(state, batch).compile()
             self._fused_compiled[n] = compiled
@@ -595,10 +610,17 @@ class Trainer:
             else:
                 for bx, by in prefetch_to_device(
                     batches(
-                        dataset.x_train, dataset.y_train, c.batch_size,
+                        dataset.x_train, dataset.y_train,
+                        # process_local: each host feeds its 1/P slice of
+                        # the GLOBAL batch (equal counts guaranteed by
+                        # load_dataset_shards), keeping step counts in
+                        # lockstep across the gang
+                        c.batch_size // (jax.process_count()
+                                         if self._process_local else 1),
                         seed=c.seed + epoch,
                     ),
                     self.mesh,
+                    process_local=self._process_local,
                 ):
                     if global_step >= total_steps or stop["flag"]:
                         break
