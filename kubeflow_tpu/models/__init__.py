@@ -12,6 +12,7 @@ from kubeflow_tpu.models.bert import (
     BertForSequenceClassification,
 )
 from kubeflow_tpu.models.bert_pp import BertPipelineClassifier
+from kubeflow_tpu.models.gpt_pp import GPTPipelineLM
 from kubeflow_tpu.models.gpt import (
     GPTConfig,
     GPTLM,
@@ -41,6 +42,7 @@ __all__ = [
     "causal_lm_eval_metrics",
     "MnistMLP",
     "MnistCNN",
+    "GPTPipelineLM",
     "ViTClassifier",
     "ViTConfig",
     "ResNet",

@@ -35,19 +35,11 @@ from kubeflow_tpu.models.bert import (
     BertLayer,
     constrain,
 )
-from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE
-from kubeflow_tpu.parallel.pipeline import gpipe
+from kubeflow_tpu.parallel.pipeline import gpipe, lift_pipeline_rules
 
 # dense rules lifted onto stacked stage params (leading `pipeline` dim),
 # plus a catch-all so every stage param is at least stage-sharded
-PP_PARTITION_RULES: list[tuple[str, P]] = [
-    *[
-        (r"stages/.*" + pat, P(AXIS_PIPELINE, *spec))
-        for pat, spec in PARTITION_RULES
-    ],
-    (r"stages/", P(AXIS_PIPELINE)),
-    *PARTITION_RULES,
-]
+PP_PARTITION_RULES: list[tuple[str, P]] = lift_pipeline_rules(PARTITION_RULES)
 
 
 class _Stage(nn.Module):

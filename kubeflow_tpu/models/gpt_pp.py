@@ -1,0 +1,144 @@
+"""Pipeline-parallel GPT — the decoder stack over the `pipeline` mesh axis.
+
+The long-context flagship at scale: the same GPipe microbatch ring as
+models/bert_pp.py (partial-manual shard_map over `pipeline`; TP/FSDP/
+context shardings stay automatic inside stages), carrying the CAUSAL
+decoder. Ring attention composes inside stages exactly as it does for the
+BERT encoder (tests/test_composed_16dev.py precedent), so sequence
+parallelism and pipeline parallelism stack on the decoder too.
+
+Embeddings and the weight-tied LM head run outside the ring (their
+activation shapes differ from the stack's); the tied table is therefore a
+boundary param, replicated over `pipeline` like the BERT head.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.models.bert import ACT_SPEC, VocabEmbed, constrain
+from kubeflow_tpu.models.gpt import GPTBlock, GPTConfig
+from kubeflow_tpu.models.gpt import PARTITION_RULES as GPT_RULES
+from kubeflow_tpu.parallel.pipeline import gpipe, lift_pipeline_rules
+
+PP_PARTITION_RULES: list[tuple[str, P]] = lift_pipeline_rules(GPT_RULES)
+
+
+class _Stage(nn.Module):
+    cfg: GPTConfig
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, x, bias, train: bool = False):
+        for i in range(self.layers_per_stage):
+            x = GPTBlock(self.cfg, name=f"layer_{i}")(x, bias, train)
+        return x
+
+
+class GPTPipelineLM:
+    """Drop-in for GPTLM with a pipelined decoder stack (training path;
+    KV-cache generation stays on the unpipelined GPTLM — decode is
+    latency-bound and single-stage)."""
+
+    PARTITION_RULES = PP_PARTITION_RULES
+
+    def __init__(self, cfg: GPTConfig, num_stages: int = 2,
+                 n_micro: int | None = None, pad_token_id: int = 0):
+        if cfg.num_layers % num_stages:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by "
+                f"num_stages {num_stages}"
+            )
+        self.cfg = cfg
+        self.pad_token_id = pad_token_id
+        self.num_stages = num_stages
+        self.n_micro = n_micro or 2 * num_stages
+        self._embed_tok = VocabEmbed(cfg.vocab_size, cfg.hidden_size,
+                                     dtype=cfg.dtype, name="token_embed")
+        self._embed_pos = VocabEmbed(cfg.max_len, cfg.hidden_size,
+                                     dtype=cfg.dtype, name="position_embed")
+        self._stage = _Stage(cfg, cfg.num_layers // num_stages)
+
+    # Trainer introspects __call__ for the `train` kwarg
+    def __call__(self, input_ids, train: bool = False):  # pragma: no cover
+        raise NotImplementedError("use .apply()")
+
+    def init(self, rng, input_ids, train: bool = False) -> dict:
+        c = self.cfg
+        t_rng, p_rng, s_rng, d_rng = jax.random.split(rng, 4)
+        tv = self._embed_tok.init(t_rng, input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None, :]
+        pv = self._embed_pos.init(p_rng, pos)
+        x = jnp.zeros(
+            (input_ids.shape[0], input_ids.shape[1], c.hidden_size), c.dtype
+        )
+        bias = jnp.zeros((input_ids.shape[0], 1, 1, input_ids.shape[1]),
+                         c.dtype)
+
+        def one_stage(r):
+            return self._stage.init(
+                {"params": r, "dropout": d_rng}, x, bias, False
+            )["params"]
+
+        stage_params = jax.vmap(one_stage)(
+            jax.random.split(s_rng, self.num_stages)
+        )
+        ln = nn.LayerNorm(dtype=c.dtype, name="ln_final")
+        lv = ln.init(d_rng, x)
+        return {"params": {
+            "token_embed": tv["params"],
+            "position_embed": pv["params"],
+            "stages": stage_params,
+            "ln_final": lv["params"],
+        }}
+
+    def apply(self, variables, input_ids, rngs=None, train: bool = False,
+              mutable=None, **_ignored):
+        out = self._apply(variables, input_ids, rngs=rngs, train=train)
+        return (out, {}) if mutable is not None else out
+
+    def _apply(self, variables, input_ids, rngs=None, train: bool = False):
+        p = variables["params"]
+        c = self.cfg
+        rngs = rngs or {}
+        drop = rngs.get("dropout")
+        tok = self._embed_tok.bind({"params": p["token_embed"]})
+        x = tok(input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None, :]
+        x = x + self._embed_pos.apply({"params": p["position_embed"]}, pos)
+        mask = input_ids != self.pad_token_id
+        bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
+        if train and drop is not None and c.dropout_rate > 0:
+            # embedding dropout, matching dense GPTLM's training path
+            # (nn.Dropout is parameterless — functional apply)
+            x = nn.Dropout(c.dropout_rate, deterministic=False).apply(
+                {}, x, rngs={"dropout": drop}
+            )
+        # f32 through the ring boundary (bert_pp precedent: a low-precision
+        # all-reduce at the shard_map boundary trips AllReducePromotion)
+        x = x.astype(jnp.float32)
+
+        def stage_fn(sp, act, *, stage, rng):
+            h, b = act
+            srngs = {"dropout": rng} if (train and rng is not None) else {}
+            h = self._stage.apply(
+                {"params": sp}, h.astype(c.dtype), b.astype(c.dtype), train,
+                rngs=srngs,
+            )
+            return (constrain(h.astype(jnp.float32), ACT_SPEC), b)
+
+        out, _ = gpipe(
+            stage_fn,
+            p["stages"],
+            (x, bias.astype(jnp.float32)),
+            self.n_micro,
+            rng=drop if train else None,
+        )
+        out = constrain(out, ACT_SPEC)
+        ln = nn.LayerNorm(dtype=c.dtype, name="ln_final")
+        h = ln.apply({"params": p["ln_final"]}, out.astype(c.dtype))
+        logits = tok.attend(h)  # weight-tied head, outside the ring
+        return logits.astype(jnp.float32)

@@ -56,6 +56,20 @@ def _pin(tree: Any, batch_dim: int) -> Any:
     return jax.tree.map(one, tree)
 
 
+def lift_pipeline_rules(rules: list) -> list:
+    """Lift a model family's dense PARTITION_RULES onto pipeline-stacked
+    stage params: each rule re-anchored under 'stages/' with the leading
+    stage dim sharded over `pipeline`, plus a catch-all so every stage
+    param is at least stage-sharded, plus the dense rules for boundary
+    params (embeddings, heads). One definition for every pipelined family
+    (bert_pp, gpt_pp, ...)."""
+    return [
+        *[(r"stages/.*" + pat, P(AXIS_PIPELINE, *spec)) for pat, spec in rules],
+        (r"stages/", P(AXIS_PIPELINE)),
+        *rules,
+    ]
+
+
 def stack_stage_params(per_stage: list[Any]) -> Any:
     """Stack a list of per-stage param pytrees on a new leading stage axis."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
