@@ -105,6 +105,10 @@ class BertConfig:
     dtype: Any = jnp.float32
     attention: str = "dense"  # dense | ring | ulysses
     attention_block: int = 128  # ring attention KV block size
+    # rematerialize each encoder block on backward (jax.checkpoint) — the
+    # long-context HBM lever (activation memory O(seq·hidden), one extra
+    # forward)
+    remat: bool = False
     # MoE: 0 = dense MLP; >0 replaces every MLP with a MoeMlp of this many
     # experts, dispatched over the `expert` mesh axis (parallel/moe.py)
     moe_experts: int = 0
@@ -269,8 +273,11 @@ class BertEncoder(nn.Module):
         x = BertEmbeddings(c, token_embed=self.token_embed, name="embeddings")(
             input_ids, train, token_type_ids
         )
+        layer_cls = (
+            nn.remat(BertLayer, static_argnums=(3,)) if c.remat else BertLayer
+        )
         for i in range(c.num_layers):
-            x = BertLayer(c, name=f"layer_{i}")(x, mask, train)
+            x = layer_cls(c, name=f"layer_{i}")(x, mask, train)
         return x
 
 

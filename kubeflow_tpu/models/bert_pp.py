@@ -43,7 +43,12 @@ PP_PARTITION_RULES: list[tuple[str, P]] = lift_pipeline_rules(PARTITION_RULES)
 
 
 class _Stage(nn.Module):
-    """One pipeline stage: a chunk of BertLayers."""
+    """One pipeline stage: a chunk of BertLayers.
+
+    BertConfig.remat is intentionally not re-applied per layer here: the
+    gpipe ring already jax.checkpoint's the WHOLE stage body (pipeline.py
+    remat=True), which subsumes per-layer remat — only stage-boundary
+    activations survive the forward either way."""
 
     cfg: BertConfig
     layers_per_stage: int

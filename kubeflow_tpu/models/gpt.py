@@ -51,6 +51,10 @@ class GPTConfig:
     dtype: Any = jnp.float32
     attention: str = "dense"  # dense | ring | ulysses | flash
     attention_block: int = 128
+    # rematerialize each block on backward (jax.checkpoint): activation
+    # memory drops from O(layers x seq x hidden) to O(seq x hidden) at the
+    # cost of one extra forward — the standard long-context HBM lever
+    remat: bool = False
 
     @staticmethod
     def small(**kw) -> "GPTConfig":
@@ -200,8 +204,14 @@ class GPTLM(nn.Module):
                            name="position_embed")(pos)
         x = nn.Dropout(c.dropout_rate, deterministic=not train)(x)
         x = constrain(x, ACT_SPEC)
+        # remat never wraps the decode path: generation is forward-only and
+        # its cache writes must not re-execute
+        block_cls = (
+            nn.remat(GPTBlock, static_argnums=(3, 4))
+            if (c.remat and not decode) else GPTBlock
+        )
         for i in range(c.num_layers):
-            x = GPTBlock(c, name=f"layer_{i}")(x, bias, train, decode=decode)
+            x = block_cls(c, name=f"layer_{i}")(x, bias, train, decode)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_final")(x)
         logits = token_embed.attend(x)  # weight-tied head
         return logits.astype(jnp.float32)

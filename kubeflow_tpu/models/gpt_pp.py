@@ -28,6 +28,10 @@ PP_PARTITION_RULES: list[tuple[str, P]] = lift_pipeline_rules(GPT_RULES)
 
 
 class _Stage(nn.Module):
+    """GPTConfig.remat is intentionally not re-applied per layer here: the
+    gpipe ring already jax.checkpoint's the WHOLE stage body, which
+    subsumes per-layer remat (see bert_pp._Stage)."""
+
     cfg: GPTConfig
     layers_per_stage: int
 
