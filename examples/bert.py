@@ -47,6 +47,8 @@ def main(argv: list[str] | None = None) -> float:
     # parameter-efficient fine-tune: freeze the base, train rank-r adapters
     # on the attention/MLP kernels (train/lora.py)
     p.add_argument("--lora", type=int, default=0, help="LoRA rank (0 = full)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize encoder blocks (long-context HBM lever)")
     p.add_argument("--expert-parallel", type=int, default=1)
     # PP: >1 pipelines the encoder stack over the `pipeline` axis
     p.add_argument("--pipeline-stages", type=int, default=1)
@@ -81,6 +83,7 @@ def main(argv: list[str] | None = None) -> float:
         max_len=max(args.seq_len, 512),
         dropout_rate=0.0 if args.attention != "dense" else 0.1,
         moe_experts=args.moe_experts,
+        remat=args.remat,
         **arch,
     )
     ds = synthetic_text_dataset(
