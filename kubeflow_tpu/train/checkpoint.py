@@ -19,22 +19,70 @@ import orbax.checkpoint as ocp
 class Checkpointer:
     """Thin orbax CheckpointManager wrapper with a stable save/restore API."""
 
-    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True, keep_best_metric: str | None = None,
+                 best_mode: str = "max"):
+        """keep_best_metric: retain the max_to_keep BEST checkpoints by this
+        eval-metric key (passed via save(metrics=...)) instead of the newest
+        — the model-selection contract (restore_best serves the winner)."""
         self.directory = os.path.abspath(directory)
+        self.keep_best_metric = keep_best_metric
         os.makedirs(self.directory, exist_ok=True)
+        best_kw = {}
+        if keep_best_metric:
+            best_kw = dict(
+                best_fn=lambda m: float(m[keep_best_metric]),
+                best_mode=best_mode,
+            )
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_save,
+                **best_kw,
             ),
         )
 
-    def save(self, step: int, state: Any) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+    def save(self, step: int, state: Any,
+             metrics: dict | None = None) -> None:
+        """metrics participate in best-ranking (keep_best_metric mode);
+        metric-LESS saves are preserved outside the ranking (rescue/resume
+        saves) and never become best_step."""
+        if (metrics is not None and self.keep_best_metric
+                and self.keep_best_metric not in metrics):
+            raise ValueError(
+                f"keep_best_metric {self.keep_best_metric!r} not in metrics "
+                f"{sorted(metrics)} — fix TrainerConfig.keep_best_metric"
+            )
+        self._mgr.save(
+            step, args=ocp.args.StandardSave(state),
+            **({"metrics": metrics} if metrics is not None else {}),
+        )
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def best_step(self) -> int | None:
+        return self._mgr.best_step()
+
+    def restore_best(self, abstract_state: Any) -> tuple[int, Any] | None:
+        """Restore the best-metric checkpoint (keep_best_metric mode)."""
+        if not self.keep_best_metric:
+            # orbax best_step() falls back to latest_step() when best
+            # tracking is off — silently serving the newest (possibly
+            # worst) checkpoint as "best" must be an error instead
+            raise ValueError(
+                "restore_best requires a Checkpointer constructed with "
+                "keep_best_metric (the mode is not persisted in the "
+                "checkpoint directory)"
+            )
+        step = self._mgr.best_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+        return step, restored
 
     def restore_latest(self, abstract_state: Any) -> tuple[int, Any] | None:
         """Restore newest checkpoint into the structure/shardings of

@@ -88,6 +88,13 @@ class TrainerConfig:
     profile_dir: str = ""
     # tfevents scalar output for TensorBoard; "" defers to KFTPU_EVENT_DIR
     event_dir: str = ""
+    # keep the max_to_keep BEST checkpoints by this eval-metric key (e.g.
+    # "accuracy") instead of the newest — model selection; restore via
+    # Checkpointer.restore_best. Best mode saves at eval cadence (metrics
+    # exist only there) plus preemption; plain mode keeps step-cadence saves.
+    keep_best_metric: str | None = None
+    best_mode: str = "max"            # max | min (e.g. "loss")
+    checkpoint_max_to_keep: int = 3
     # "replicated": every process feeds the identical full batch (the
     # seed-deterministic pipeline convention); "process_local": each
     # process feeds ONLY its own rows (disjoint per-host loading via
@@ -166,7 +173,13 @@ class Trainer:
         self._fused_data_cache: dict[int, Callable] = {}  # k -> data-scan
         self._jit_eval_step = jax.jit(self._eval_step)
         self.checkpointer = (
-            Checkpointer(config.checkpoint_dir) if config.checkpoint_dir else None
+            Checkpointer(
+                config.checkpoint_dir,
+                max_to_keep=config.checkpoint_max_to_keep,
+                keep_best_metric=config.keep_best_metric,
+                best_mode=config.best_mode,
+            )
+            if config.checkpoint_dir else None
         )
 
     def _default_apply(self, params, extra, x, rng, train):
@@ -534,6 +547,7 @@ class Trainer:
         # optimizer steps the dispatch covered; log/checkpoint fire when
         # their cadence boundary falls inside the chunk.
         stop = {"flag": False}
+        last_eval: list = [None]  # newest eval metrics (best-mode saves)
 
         def after(took: int, m) -> bool:
             nonlocal global_step, last
@@ -553,6 +567,12 @@ class Trainer:
                         images_per_sec=timer.items_per_sec,
                     )
             if preempted["flag"]:
+                # rescue saves carry NO metrics: orbax preserves metric-less
+                # checkpoints outside the BestN ranking
+                # (keep_checkpoints_without_metrics), so the rescue is never
+                # GC'd as "not best", never mislabeled with stale metrics,
+                # and never returned by best_step — while restore_latest
+                # still resumes from it
                 self.checkpointer.save(global_step, state)
                 self.checkpointer.wait()
                 metrics_lib.emit(step=global_step, preempted=1)
@@ -560,6 +580,7 @@ class Trainer:
                 return True
             if (
                 self.checkpointer is not None
+                and not c.keep_best_metric
                 and (global_step % c.checkpoint_every_steps) < took
             ):
                 self.checkpointer.save(global_step, state)
@@ -632,6 +653,10 @@ class Trainer:
             epoch += 1
             if epoch % c.eval_every_epochs == 0:
                 ev = self.evaluate(state, dataset)
+                last_eval[0] = dict(ev)
+                if self.checkpointer is not None and c.keep_best_metric:
+                    # best-mode cadence: metrics only exist at evals
+                    self.checkpointer.save(global_step, state, metrics=ev)
                 metrics_lib.emit(step=global_step, **{f"eval_{k}": v for k, v in ev.items()})
                 last.update({f"eval_{k}": v for k, v in ev.items()})
                 if events is not None:
@@ -641,10 +666,10 @@ class Trainer:
                 if on_epoch_end is not None:
                     on_epoch_end(epoch, ev)
 
-        if self.checkpointer is not None:
-            self.checkpointer.save(global_step, state)
-            self.checkpointer.wait()
         final_eval = self.evaluate(state, dataset)
+        if self.checkpointer is not None:
+            self.checkpointer.save(global_step, state, metrics=dict(final_eval))
+            self.checkpointer.wait()
         metrics_lib.emit(step=global_step, **{f"final_{k}": v for k, v in final_eval.items()})
         if events is not None:
             events.scalars(

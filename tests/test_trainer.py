@@ -313,3 +313,55 @@ class TestTrainerUpgrades:
             # saved step must be a whole number of chunks
             assert state[0] % fused_steps == 0
 
+
+
+def test_keep_best_checkpoint_by_metric(tmp_path, digits):
+    """Best-mode retention: the kept/servable checkpoint is the best-eval
+    one, not the newest (orbax best_fn; Checkpointer.restore_best)."""
+    trainer = Trainer(
+        MnistMLP(),
+        TrainerConfig(batch_size=128, epochs=6, learning_rate=2e-3,
+                      checkpoint_dir=str(tmp_path / "ck"),
+                      keep_best_metric="accuracy",
+                      checkpoint_max_to_keep=2,
+                      log_every_steps=10**9),
+    )
+    state, m = trainer.fit(digits)
+    trainer.checkpointer.wait()
+    best = trainer.checkpointer.best_step()
+    assert best is not None
+    restored = trainer.checkpointer.restore_best(
+        trainer.init_state(digits.x_train[:128])
+    )
+    assert restored is not None and restored[0] == best
+    # the best checkpoint's params evaluate at least as well as any other
+    ev_best = trainer.evaluate(restored[1], digits)
+    assert ev_best["accuracy"] >= m["final_accuracy"] - 0.02
+
+
+def test_best_mode_rescue_and_guards(tmp_path, digits):
+    """Best-mode edge semantics: metric-less rescue saves survive BestN GC
+    and never become best; wrong metric keys and misconfigured restore_best
+    fail fast."""
+    from kubeflow_tpu.train.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(tmp_path / "b"), max_to_keep=2, async_save=False,
+                      keep_best_metric="accuracy")
+    t = Trainer(MnistMLP(hidden=(16,)),
+                TrainerConfig(batch_size=8, log_every_steps=10**9))
+    state = t.init_state(digits.x_train[:8])
+    ck.save(1, state, metrics={"accuracy": 0.9})
+    ck.save(2, state, metrics={"accuracy": 0.95})
+    ck.save(3, state, metrics={"accuracy": 0.5})   # worse: GC'd
+    ck.save(4, state)                              # rescue: no metrics
+    ck.wait()
+    assert ck.best_step() == 2
+    assert ck.latest_step() == 4                   # resume target survives
+    with pytest.raises(ValueError, match="keep_best_metric"):
+        ck.save(5, state, metrics={"acc": 1.0})    # wrong key fails fast
+    ck.close()
+
+    plain = Checkpointer(str(tmp_path / "b"), async_save=False)
+    with pytest.raises(ValueError, match="restore_best"):
+        plain.restore_best(state)
+    plain.close()
