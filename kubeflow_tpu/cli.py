@@ -368,6 +368,43 @@ def cmd_scale(args) -> int:
     return 0
 
 
+def cmd_generate(args) -> int:
+    """KV-cache text generation against a saved gpt-lm predictor dir
+    (the serving model-dir contract; tokenizer.json beside it when the
+    prompt is text rather than ids)."""
+    import numpy as np
+
+    from kubeflow_tpu.serving.model import JaxModel
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+    jm = JaxModel("cli", args.model_dir)
+    jm.load()
+    if jm.config.get("generate") is None:
+        print("error: model dir has no generate config (not a gpt-lm "
+              "generative predictor)", file=sys.stderr)
+        return 2
+    tok = None
+    tok_path = Path(args.model_dir) / "tokenizer.json"
+    if tok_path.exists():
+        from kubeflow_tpu.train.tokenizer import Tokenizer
+
+        tok = Tokenizer.load(tok_path)
+    if tok is not None:
+        ids = np.asarray([tok.encode(args.prompt, eos=False)], np.int32)
+    else:
+        try:
+            ids = np.asarray([[int(t) for t in args.prompt.split()]],
+                             np.int32)
+        except ValueError:
+            print("error: no tokenizer.json in the model dir — pass the "
+                  "prompt as space-separated token ids", file=sys.stderr)
+            return 2
+    out = np.asarray(jm(ids)["predictions"])[0]
+    print(tok.decode(out) if tok is not None else " ".join(map(str, out)))
+    return 0
+
+
 # ---------------------------------------------------------------------- main
 
 def main(argv: list[str] | None = None) -> int:
@@ -415,6 +452,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="after completion, resume with this maxTrialCount "
                         "(resumePolicy=LongRunning)")
     p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+
+    p = add("generate", cmd_generate,
+            help="generate text/ids from a saved gpt-lm predictor dir")
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--prompt", required=True,
+                   help="text (tokenizer.json in the dir) or token ids")
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
 
     p = add("serve", cmd_serve, help="serve an InferenceService until Ctrl-C")
     p.add_argument("-f", "--filename", required=True)

@@ -333,6 +333,17 @@ class JaxModel(Model):
         if self._aot_batch is not None:
             from kubeflow_tpu.serving import aot
 
+            want = tuple(self.config["input_shape"][1:])
+            if gen is not None and tuple(x.shape[1:]) != want:
+                # generation prompts cannot pad (decode masks by position,
+                # not pad id), so the exported fixed shape is a hard
+                # contract along every non-batch dim
+                raise ValueError(
+                    f"AOT generative artifact is fixed to prompt shape "
+                    f"{want}; got {tuple(x.shape[1:])} — send "
+                    f"{want[0]}-token prompts or serve via the jit path "
+                    f"(delete {aot.AOT_FILE})"
+                )
             return aot.padded_chunk_predict(self._predict_fn, x, self._aot_batch)
         return np.asarray(self._predict_fn(x))
 

@@ -188,3 +188,34 @@ def test_tp_sharded_decode_matches_single_device(lm, cpu_devices):
             {"params": sharded}, prompt
         )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_cli_generate(tmp_path, lm):
+    from kubeflow_tpu.cli import main as cli_main
+    from kubeflow_tpu.serving import aot
+    from kubeflow_tpu.serving.model import JaxModel, save_predictor
+
+    model, variables, prompt = lm
+    d = save_predictor(
+        tmp_path / "g", "gpt-lm", dict(variables),
+        np.asarray(prompt, np.int32), generate={"max_new_tokens": 4},
+        size="tiny", config={"dropout_rate": 0.0, "max_len": 64},
+    )
+    aot.export_predictor(d)
+    # shape contract: wrong prompt length -> clear error
+    jm = JaxModel("g", d)
+    jm.load()
+    with pytest.raises(ValueError, match="prompt shape"):
+        jm(np.asarray(prompt[:, :3], np.int32))
+    # CLI happy path (ids prompt, no tokenizer.json)
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    ids = " ".join(map(str, np.asarray(prompt)[0]))
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["generate", "--model-dir", str(d),
+                       "--prompt", ids, "--device", "cpu"])
+    assert rc == 0
+    out = buf.getvalue().strip().split()
+    assert len(out) == 4 and all(t.isdigit() for t in out)
