@@ -240,6 +240,8 @@ def generate(
     The LM's max_len bounds prompt_len + max_new_tokens.
     """
     b, prompt_len = prompt_ids.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if prompt_len + max_new_tokens > model.cfg.max_len:
         raise ValueError(
             f"prompt {prompt_len} + {max_new_tokens} new tokens exceeds "
@@ -281,6 +283,115 @@ def generate(
     )
     out = jnp.concatenate([toks, last[None]], axis=0)
     return out.T  # (B, max_new_tokens)
+
+
+def beam_search(
+    model: GPTLM,
+    variables: dict,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    num_beams: int = 4,
+) -> tuple[jax.Array, jax.Array]:
+    """Beam-search decoding with the KV cache — fully jittable, static
+    shapes (beams ride the batch dim; each step reorders the cache rows by
+    beam parent with a batched take).
+
+    Returns (ids (B, max_new_tokens), scores (B,)) for the best beam per
+    input, scores being exact sequence log-probs. All beams decode exactly
+    max_new_tokens tokens (no EOS), so no length penalty is offered — with
+    equal lengths it could never change the winner. Unpadded prompts, as
+    in generate()."""
+    b, prompt_len = prompt_ids.shape
+    k = num_beams
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if prompt_len + max_new_tokens > model.cfg.max_len:
+        raise ValueError(
+            f"prompt {prompt_len} + {max_new_tokens} new tokens exceeds "
+            f"max_len {model.cfg.max_len}"
+        )
+
+    # prefill ONCE per input, then expand the cache to (B*K) rows — the
+    # K beams of an input are identical until the first top-k, so running
+    # K prompt copies through the model would waste (K-1)/K of the prefill
+    logits, cache = model.apply(
+        variables, prompt_ids, decode=True, mutable=["cache"]
+    )
+    cache = jax.tree.map(
+        lambda a: jnp.repeat(a, k, axis=0) if a.ndim and a.shape[0] == b
+        else a,
+        cache,
+    )
+    log_p = jnp.repeat(
+        jax.nn.log_softmax(logits[:, -1].astype(jnp.float32)), k, axis=0
+    )                                                          # (B*K, V)
+    # all beams of an input start identical, so all but beam 0 get -inf
+    # initial score (else top-k picks K copies of the same continuation)
+    vocab = log_p.shape[-1]
+    init_mask = jnp.where(jnp.arange(k) == 0, 0.0, -jnp.inf)   # (K,)
+    scores = jnp.tile(init_mask, (b,))                         # (B*K,)
+
+    def step(carry, _):
+        cache, scores, tok_prev = carry
+        logits, cache = model.apply(
+            {**variables, **cache}, tok_prev[:, None], decode=True,
+            mutable=["cache"],
+        )
+        log_p = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32))
+        total = scores[:, None] + log_p                        # (B*K, V)
+        joint = total.reshape(b, k * vocab)
+        top_scores, top_idx = jax.lax.top_k(joint, k)          # (B, K)
+        parent = top_idx // vocab                              # beam index
+        tok = (top_idx % vocab).astype(jnp.int32)              # (B, K)
+        # flat row index of each new beam's parent
+        rows = (jnp.arange(b)[:, None] * k + parent).reshape(b * k)
+        cache = jax.tree.map(
+            lambda a: jnp.take(a, rows, axis=0) if a.ndim and
+            a.shape[0] == b * k else a,
+            cache,
+        )
+        return (cache, top_scores.reshape(b * k),
+                tok.reshape(b * k)), (tok.reshape(b * k), rows)
+
+    # first real step consumes the prefill logits: fold it into the scan by
+    # seeding tok_prev from the prefill distribution
+    total0 = scores[:, None] + log_p
+    joint0 = total0.reshape(b, k * vocab)
+    s0, i0 = jax.lax.top_k(joint0, k)
+    parent0 = (jnp.arange(b)[:, None] * k + i0 // vocab).reshape(b * k)
+    tok0 = (i0 % vocab).astype(jnp.int32).reshape(b * k)
+    cache = jax.tree.map(
+        lambda a: jnp.take(a, parent0, axis=0) if a.ndim and
+        a.shape[0] == b * k else a,
+        cache,
+    )
+    (cache, scores, last), (toks, parents) = jax.lax.scan(
+        step, (cache, s0.reshape(b * k), tok0), None,
+        length=max_new_tokens - 1,
+    )
+    # backtrack: walk parent pointers from the best final beam
+    all_toks = jnp.concatenate([tok0[None], toks], axis=0)     # (T, B*K)
+    all_parents = jnp.concatenate(
+        [jnp.arange(b * k)[None], parents], axis=0
+    )                                                          # (T, B*K)
+    best = jnp.argmax(scores.reshape(b, k), axis=-1)           # (B,)
+    row = jnp.arange(b) * k + best
+
+    def back(row, t_arr):
+        seq = jnp.zeros((all_toks.shape[0],), jnp.int32)
+
+        def body(i, carry):
+            row, seq = carry
+            t = all_toks.shape[0] - 1 - i
+            seq = seq.at[t].set(t_arr[t, row])
+            row = all_parents[t, row]
+            return (row, seq)
+
+        _, seq = jax.lax.fori_loop(0, all_toks.shape[0], body, (row, seq))
+        return seq
+
+    out = jax.vmap(lambda r: back(r, all_toks))(row)           # (B, T)
+    return out, jnp.take(scores, row)
 
 
 def causal_lm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
