@@ -95,6 +95,14 @@ class TrainerConfig:
     keep_best_metric: str | None = None
     best_mode: str = "max"            # max | min (e.g. "loss")
     checkpoint_max_to_keep: int = 3
+    # stop after this many consecutive evals without improvement on
+    # early_stop_metric (best_mode direction); 0 = off. Epoch-granular
+    # (metrics exist at eval cadence). Pairs with keep_best_metric so the
+    # served model is the pre-plateau best.
+    early_stop_patience: int = 0
+    early_stop_metric: str = "accuracy"
+    early_stop_mode: str = "max"      # max | min — independent of best_mode
+    early_stop_min_delta: float = 0.0
     # "replicated": every process feeds the identical full batch (the
     # seed-deterministic pipeline convention); "process_local": each
     # process feeds ONLY its own rows (disjoint per-host loading via
@@ -548,6 +556,7 @@ class Trainer:
         # their cadence boundary falls inside the chunk.
         stop = {"flag": False}
         last_eval: list = [None]  # newest eval metrics (best-mode saves)
+        es_best, es_bad = None, 0  # early-stopping plateau tracking
 
         def after(took: int, m) -> bool:
             nonlocal global_step, last
@@ -665,6 +674,27 @@ class Trainer:
                     )
                 if on_epoch_end is not None:
                     on_epoch_end(epoch, ev)
+                if c.early_stop_patience > 0:
+                    if c.early_stop_metric not in ev:
+                        raise ValueError(
+                            f"early_stop_metric {c.early_stop_metric!r} "
+                            f"not in eval metrics {sorted(ev)}"
+                        )
+                    cur = float(ev[c.early_stop_metric])
+                    # direction is early_stop_mode's, NOT best_mode's: the
+                    # two knobs may track different metrics (stop on loss,
+                    # keep best by accuracy)
+                    sign = 1.0 if c.early_stop_mode == "max" else -1.0
+                    if (es_best is None
+                            or sign * cur
+                            > sign * es_best + c.early_stop_min_delta):
+                        es_best, es_bad = cur, 0
+                    else:
+                        es_bad += 1
+                        if es_bad >= c.early_stop_patience:
+                            metrics_lib.emit(step=global_step,
+                                             early_stopped=1)
+                            break
 
         final_eval = self.evaluate(state, dataset)
         if self.checkpointer is not None:

@@ -365,3 +365,47 @@ def test_best_mode_rescue_and_guards(tmp_path, digits):
     with pytest.raises(ValueError, match="restore_best"):
         plain.restore_best(state)
     plain.close()
+
+
+def test_early_stopping_on_plateau(digits):
+    """early_stop_patience halts training when eval stops improving by
+    min_delta; deterministic on the digits run (improvement per epoch falls
+    under 1% within a few epochs)."""
+    trainer = Trainer(
+        MnistMLP(),
+        TrainerConfig(batch_size=128, epochs=30, learning_rate=2e-3,
+                      early_stop_patience=1, early_stop_min_delta=0.01,
+                      log_every_steps=10**9),
+    )
+    state, m = trainer.fit(digits)
+    from kubeflow_tpu.train.data import steps_per_epoch
+
+    total = 30 * steps_per_epoch(len(digits.x_train), 128)
+    assert int(state.step) < total, "never early-stopped"
+    assert m["final_accuracy"] > 0.8
+
+
+def test_early_stopping_min_mode_and_validation(digits):
+    """Stopping on a min-metric (loss) uses early_stop_mode, independent of
+    best_mode; a bad metric key fails with a clear error at first eval."""
+    trainer = Trainer(
+        MnistMLP(),
+        TrainerConfig(batch_size=128, epochs=30, learning_rate=2e-3,
+                      early_stop_patience=1, early_stop_metric="loss",
+                      early_stop_mode="min", early_stop_min_delta=0.01,
+                      log_every_steps=10**9),
+    )
+    state, m = trainer.fit(digits)
+    from kubeflow_tpu.train.data import steps_per_epoch
+
+    total = 30 * steps_per_epoch(len(digits.x_train), 128)
+    assert int(state.step) < total
+    assert m["final_accuracy"] > 0.8  # stopped on plateau, not divergence
+
+    bad = Trainer(
+        MnistMLP(),
+        TrainerConfig(batch_size=128, epochs=2, early_stop_patience=1,
+                      early_stop_metric="acc", log_every_steps=10**9),
+    )
+    with pytest.raises(ValueError, match="early_stop_metric"):
+        bad.fit(digits)
