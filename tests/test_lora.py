@@ -197,3 +197,37 @@ def test_lora_state_checkpoint_roundtrip(tmp_path, setup):
     got = jax.tree.leaves(restored[1].params)
     for a, b in zip(got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_lora_wraps_pipelined_gpt(cpu_devices):
+    """LoRA over the pipelined DECODER: per-stage adapters on the stacked
+    GPT kernels, base frozen, trains under a pipeline mesh."""
+    from kubeflow_tpu.models import causal_lm_eval_metrics, causal_lm_loss
+    from kubeflow_tpu.models.gpt import GPTConfig
+    from kubeflow_tpu.models.gpt_pp import GPTPipelineLM
+    from kubeflow_tpu.train.data import synthetic_lm_dataset
+
+    cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=64)
+    lora = LoraModel(GPTPipelineLM(cfg, num_stages=2, n_micro=2), rank=2)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, pipeline=2),
+                      cpu_devices[:8])
+    ds = synthetic_lm_dataset(n_train=16, n_test=8, seq_len=16,
+                              vocab_size=cfg.vocab_size)
+    trainer = Trainer(
+        lora,
+        TrainerConfig(batch_size=8, steps=1, log_every_steps=10**9),
+        loss_fn=causal_lm_loss,
+        eval_metrics_fn=causal_lm_eval_metrics,
+        tx=lora_tx,
+        mesh=mesh,
+    )
+    state = trainer.init_state(ds.x_train[:8])
+    qa = state.params["lora"]["stages"]["layer_0"]["attention"]["query"][
+        "kernel"]["lora_a"]
+    assert qa.shape[0] == 2 and qa.sharding.spec[0] == "pipeline"
+    base_before = jax.tree.map(np.asarray, state.params["base"])
+    state, m = trainer.train_step(state, (ds.x_train[:8], ds.y_train[:8]))
+    assert np.isfinite(float(m["loss"]))
+    for a, b in zip(jax.tree.leaves(base_before),
+                    jax.tree.leaves(state.params["base"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
