@@ -405,6 +405,25 @@ def cmd_generate(args) -> int:
     return 0
 
 
+def cmd_tokenize(args) -> int:
+    """Train a BPE tokenizer from a text file (one document per line) and
+    write tokenizer.json — pairs with `generate` and gpt-lm predictors."""
+    from kubeflow_tpu.train.tokenizer import Tokenizer
+
+    texts = [
+        ln.strip() for ln in Path(args.input).read_text().splitlines()
+        if ln.strip()
+    ]
+    if not texts:
+        print(f"error: {args.input} has no non-empty lines", file=sys.stderr)
+        return 2
+    tok = Tokenizer.train(texts, vocab_size=args.vocab_size)
+    tok.save(args.output)
+    print(f"trained vocab={tok.vocab_size} merges={len(tok.merges)} "
+          f"-> {args.output}")
+    return 0
+
+
 # ---------------------------------------------------------------------- main
 
 def main(argv: list[str] | None = None) -> int:
@@ -452,6 +471,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="after completion, resume with this maxTrialCount "
                         "(resumePolicy=LongRunning)")
     p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+
+    p = add("tokenize", cmd_tokenize,
+            help="train a BPE tokenizer from a text file")
+    p.add_argument("--input", required=True, help="one document per line")
+    p.add_argument("--vocab-size", type=int, default=8192)
+    p.add_argument("-o", "--output", default="tokenizer.json")
 
     p = add("generate", cmd_generate,
             help="generate text/ids from a saved gpt-lm predictor dir")

@@ -81,3 +81,17 @@ def test_text_to_generation_loop(tok):
     # a 60-step tiny model on 3 sentences should emit corpus vocabulary
     assert any(w in text for w in
                ("dog", "fox", "lazy", "quick", "brown", "the")), text
+
+
+def test_cli_tokenize_round_trip(tmp_path):
+    from kubeflow_tpu.cli import main as cli_main
+    from kubeflow_tpu.train.tokenizer import Tokenizer
+
+    src = tmp_path / "corpus.txt"
+    src.write_text("\n".join(CORPUS[:4]) + "\n\n")
+    out = tmp_path / "tok.json"
+    rc = cli_main(["tokenize", "--input", str(src), "--vocab-size", "64",
+                   "-o", str(out)])
+    assert rc == 0
+    tok = Tokenizer.load(out)
+    assert tok.decode(tok.encode(CORPUS[0])) == CORPUS[0]

@@ -6,7 +6,11 @@
 # deadline), exits 1 once the deadline passes with stages still missing.
 cd /root/repo
 MAX_HOURS=${MAX_HOURS:-11}
-deadline=$(( $(date +%s) + MAX_HOURS*3600 ))
+# iteration-based budget: the sandbox wall clock JUMPS (an epoch deadline
+# tripped ~6h early in round 3); each loop iteration is >=180s of probe
+# sleep, so count iterations instead of comparing clocks
+max_iters=$(( MAX_HOURS * 20 ))
+iters=0
 
 stage() {  # stage <artifact> <timeout_s> <cmd...>
   local artifact="$1" tmo="$2"; shift 2
@@ -36,8 +40,9 @@ while :; do
     echo "all stages captured at $(date -u +%H:%M:%S)" >> tunnel_watch.log
     exit 0
   fi
-  if [ "$(date +%s)" -ge "$deadline" ]; then
-    echo "tunnel_watch: deadline reached" >> tunnel_watch.log
+  iters=$(( iters + 1 ))
+  if [ "$iters" -gt "$max_iters" ]; then
+    echo "tunnel_watch: iteration budget reached" >> tunnel_watch.log
     exit 1
   fi
   if timeout 90 python -c "
