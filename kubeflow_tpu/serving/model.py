@@ -226,10 +226,25 @@ def _load_predict_fn(model_dir: Path):
 
     gen = config.get("generate")
     if gen is not None:
+        from kubeflow_tpu.models.gpt import beam_search as _beam_search
         from kubeflow_tpu.models.gpt import generate as _generate
 
         temperature = float(gen.get("temperature", 0.0))
-        if temperature > 0.0:
+        num_beams = int(gen.get("num_beams", 1))
+        if num_beams > 1 and temperature > 0.0:
+            raise ValueError(
+                "generate config: num_beams > 1 and temperature > 0 are "
+                "mutually exclusive (beam search is deterministic)"
+            )
+        if num_beams > 1:
+            def predict_fn(x):
+                ids, _ = _beam_search(
+                    module, variables, x,
+                    max_new_tokens=int(gen.get("max_new_tokens", 32)),
+                    num_beams=num_beams,
+                )
+                return ids
+        elif temperature > 0.0:
             # per-REQUEST key (passed as a traced argument, derived by the
             # caller from seed + a call counter): a key baked into the jit
             # closure would replay the identical "sample" on every request

@@ -325,3 +325,34 @@ def test_micro_batcher_coalesces_generation(lm, tmp_path):
     for i in range(6):
         np.testing.assert_array_equal(got[i], want[i])
     assert calls[0] < 6, "requests never coalesced"
+
+
+def test_serving_beam_config(tmp_path, lm):
+    """generate={'num_beams': K} serves beam-search ids; incompatible with
+    temperature sampling (deterministic by definition)."""
+    from kubeflow_tpu.models.gpt import beam_search
+    from kubeflow_tpu.serving.model import JaxModel, save_predictor
+
+    model, variables, prompt = lm
+    d = save_predictor(
+        tmp_path / "b", "gpt-lm", dict(variables),
+        np.asarray(prompt, np.int32),
+        generate={"max_new_tokens": 5, "num_beams": 3},
+        size="tiny", config={"dropout_rate": 0.0, "max_len": 64},
+    )
+    jm = JaxModel("b", d)
+    jm.load()
+    got = np.asarray(jm(np.asarray(prompt, np.int32))["predictions"])
+    want, _ = beam_search(model, variables, prompt, max_new_tokens=5,
+                          num_beams=3)
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+    bad = save_predictor(
+        tmp_path / "bad", "gpt-lm", dict(variables),
+        np.asarray(prompt, np.int32),
+        generate={"max_new_tokens": 5, "num_beams": 3, "temperature": 0.8},
+        size="tiny", config={"dropout_rate": 0.0, "max_len": 64},
+    )
+    jm2 = JaxModel("bad", bad)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        jm2.load()
