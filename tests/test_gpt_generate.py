@@ -356,3 +356,27 @@ def test_serving_beam_config(tmp_path, lm):
     jm2 = JaxModel("bad", bad)
     with pytest.raises(ValueError, match="mutually exclusive"):
         jm2.load()
+
+
+def test_beam_predictor_aot_exports(tmp_path, lm):
+    """The whole beam-search decode loop serializes as one jax.export
+    artifact and replays identically."""
+    from kubeflow_tpu.models.gpt import beam_search
+    from kubeflow_tpu.serving.aot import export_predictor
+    from kubeflow_tpu.serving.model import JaxModel, save_predictor
+
+    model, variables, prompt = lm
+    d = save_predictor(
+        tmp_path / "ba", "gpt-lm", dict(variables),
+        np.asarray(prompt, np.int32),
+        generate={"max_new_tokens": 5, "num_beams": 3},
+        size="tiny", config={"dropout_rate": 0.0, "max_len": 64},
+    )
+    export_predictor(d)
+    jm = JaxModel("ba", d)
+    jm.load()
+    assert jm._aot_batch == 2
+    got = np.asarray(jm(np.asarray(prompt, np.int32))["predictions"])
+    want, _ = beam_search(model, variables, prompt, max_new_tokens=5,
+                          num_beams=3)
+    np.testing.assert_array_equal(got, np.asarray(want))
