@@ -380,3 +380,56 @@ def test_beam_predictor_aot_exports(tmp_path, lm):
     want, _ = beam_search(model, variables, prompt, max_new_tokens=5,
                           num_beams=3)
     np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_isvc_generative_predictor_http(tmp_path, lm):
+    """gpt-lm through the whole platform: storage pull -> server pod ->
+    v1 JSON predict with integer token instances -> generated ids."""
+    import json as _json
+    import urllib.request
+
+    from kubeflow_tpu.client import Platform
+    from kubeflow_tpu.controller.fakecluster import ObjectMeta
+    from kubeflow_tpu.serving.api import (
+        InferenceService,
+        InferenceServiceSpec,
+        PredictorRuntime,
+        PredictorSpec,
+    )
+    from kubeflow_tpu.serving.client import ServingClient
+    from kubeflow_tpu.serving.controller import ISVC_LABEL, PORT_ANNOTATION
+    from kubeflow_tpu.serving.model import save_predictor
+
+    model, variables, prompt = lm
+    src = save_predictor(
+        tmp_path / "src", "gpt-lm", dict(variables),
+        np.asarray(prompt, np.int32), generate={"max_new_tokens": 4},
+        size="tiny", config={"dropout_rate": 0.0, "max_len": 64},
+    )
+    with Platform(log_dir=str(tmp_path / "logs")) as p:
+        sc = ServingClient(p)
+        sc.create(InferenceService(
+            metadata=ObjectMeta(name="llm"),
+            spec=InferenceServiceSpec(predictor=PredictorSpec(
+                runtime=PredictorRuntime.JAX,
+                storage_uri=f"file://{src}",
+                device="cpu",
+            )),
+        ))
+        sc.wait_ready("llm", timeout_s=120)
+        pods = p.cluster.list(
+            "pods", lambda q: q.metadata.labels.get(ISVC_LABEL) == "llm",
+        )
+        port = pods[0].metadata.annotations[PORT_ANNOTATION]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/llm:predict",
+            data=_json.dumps(
+                {"instances": np.asarray(prompt).tolist()}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        body = _json.loads(urllib.request.urlopen(req, timeout=60).read())
+    want = generate(model, variables, prompt, max_new_tokens=4)
+    np.testing.assert_array_equal(
+        np.asarray(body["predictions"]), np.asarray(want)
+    )
