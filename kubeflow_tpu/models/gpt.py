@@ -29,6 +29,8 @@ from kubeflow_tpu.models.bert import (
 )
 from kubeflow_tpu.parallel.mesh import AXIS_FSDP, AXIS_MODEL
 
+from kubeflow_tpu.parallel.moe import MOE_PARTITION_RULES, MoeMlp
+
 PARTITION_RULES: list[tuple[str, P]] = [
     (r"(query|key|value)/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
     (r"attn_out/kernel$", P(AXIS_MODEL, AXIS_FSDP)),
@@ -36,6 +38,7 @@ PARTITION_RULES: list[tuple[str, P]] = [
     (r"mlp_down/kernel$", P(AXIS_MODEL, AXIS_FSDP)),
     (r"token_embed/embedding$", P(AXIS_MODEL, AXIS_FSDP)),
     (r"position_embed/embedding$", P(None, AXIS_FSDP)),
+    *MOE_PARTITION_RULES,
 ]
 
 
@@ -55,6 +58,24 @@ class GPTConfig:
     # memory drops from O(layers x seq x hidden) to O(seq x hidden) at the
     # cost of one extra forward — the standard long-context HBM lever
     remat: bool = False
+    # MoE decoder (Mixtral shape): 0 = dense MLP; >0 replaces every block's
+    # MLP with a MoeMlp of this many experts over the `expert` mesh axis
+    # (parallel/moe.py — same dispatch as the BERT encoder)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+
+    def __post_init__(self):
+        if self.hidden_size % self.num_heads:
+            raise ValueError(
+                f"hidden_size {self.hidden_size} not divisible by "
+                f"num_heads {self.num_heads}"
+            )
+        if self.moe_experts and self.moe_top_k > self.moe_experts:
+            raise ValueError(
+                f"moe_top_k {self.moe_top_k} > moe_experts "
+                f"{self.moe_experts}"
+            )
 
     @staticmethod
     def small(**kw) -> "GPTConfig":
@@ -164,8 +185,16 @@ class GPTBlock(nn.Module):
         y = nn.Dropout(c.dropout_rate, deterministic=not train)(y)
         x = constrain(x + y, ACT_SPEC)
         h = nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x)
-        h = nn.gelu(nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_up")(h))
-        h = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_down")(h)
+        if c.moe_experts:
+            h = MoeMlp(
+                hidden_size=c.hidden_size, mlp_dim=c.mlp_dim,
+                num_experts=c.moe_experts, top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
+                name="moe",
+            )(h)
+        else:
+            h = nn.gelu(nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_up")(h))
+            h = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_down")(h)
         h = nn.Dropout(c.dropout_rate, deterministic=not train)(h)
         return constrain(x + h, ACT_SPEC)
 

@@ -1,0 +1,96 @@
+"""MoE decoder (Mixtral shape): GPTConfig.moe_experts swaps every block's
+MLP for the expert-parallel MoeMlp — trains with the aux loss, matches
+across expert meshes, and still generates through the KV cache."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import causal_lm_eval_metrics, causal_lm_loss
+from kubeflow_tpu.models.gpt import GPTConfig, GPTLM, generate
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.train import Trainer, TrainerConfig
+from kubeflow_tpu.train.data import synthetic_lm_dataset
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return GPTConfig.tiny(dropout_rate=0.0, max_len=64, moe_experts=4)
+
+
+class TestMoeDecoder:
+    def test_aux_loss_sown(self, cfg):
+        model = GPTLM(cfg)
+        ids = jnp.ones((2, 8), jnp.int32) * 3
+        v = model.init(jax.random.PRNGKey(0), ids)
+        _, upd = model.apply(v, ids, mutable=["losses"])
+        leaves = jax.tree.leaves(upd["losses"])
+        assert leaves and all(np.isfinite(float(x)) for x in leaves)
+        assert sum(float(x) for x in leaves) > 0.0
+
+    def test_trains_under_expert_mesh(self, cfg, cpu_devices):
+        mesh = build_mesh(MeshConfig(data=2, expert=2, model=2),
+                          cpu_devices[:8])
+        ds = synthetic_lm_dataset(n_train=16, n_test=8, seq_len=16,
+                                  vocab_size=cfg.vocab_size)
+        trainer = Trainer(
+            GPTLM(cfg),
+            TrainerConfig(batch_size=8, steps=1, log_every_steps=10**9),
+            loss_fn=causal_lm_loss,
+            eval_metrics_fn=causal_lm_eval_metrics,
+            mesh=mesh,
+        )
+        state = trainer.init_state(ds.x_train[:8])
+        wu = state.params["layer_0"]["moe"]["w_up"]
+        assert wu.sharding.spec[0] == "expert"
+        losses = []
+        for _ in range(3):
+            state, m = trainer.train_step(
+                state, (ds.x_train[:8], ds.y_train[:8])
+            )
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(v) for v in losses)
+        assert losses[-1] < losses[0]
+
+    def test_expert_sharded_matches_replicated(self, cfg, cpu_devices):
+        """Same params, expert-sharded vs single-device: identical logits
+        (the dispatch is a layout, not a semantic)."""
+        model = GPTLM(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 1,
+                                 cfg.vocab_size, jnp.int32)
+        v = model.init(jax.random.PRNGKey(0), ids)
+        ref = model.apply(v, ids)
+        mesh = build_mesh(MeshConfig(data=2, expert=2), cpu_devices[:4])
+        with jax.set_mesh(mesh):
+            from kubeflow_tpu.parallel.sharding import shard_state
+
+            sharded = shard_state(v["params"], mesh, model.PARTITION_RULES)
+            got = jax.jit(
+                lambda p, x: model.apply({"params": p}, x)
+            )(sharded, ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4)
+
+    def test_generates_with_moe(self, cfg):
+        """KV-cache decode through MoE blocks: the router runs per decoded
+        token; sown aux is a silent no-op outside mutable losses."""
+        model = GPTLM(cfg, pad_token_id=-1)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 1,
+                                    cfg.vocab_size, jnp.int32)
+        v = model.init(jax.random.PRNGKey(0), prompt)
+        out = generate(model, v, prompt, max_new_tokens=5)
+        assert out.shape == (2, 5)
+        # greedy must equal the naive full-forward re-run, MoE included
+        ids = prompt
+        for _ in range(5):
+            logits = model.apply(v, ids)
+            nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(ids[:, 5:]))
+
+
+def test_top_k_exceeding_experts_fails_fast():
+    with pytest.raises(ValueError, match="moe_top_k"):
+        GPTConfig.tiny(moe_experts=1)  # default top_k=2 > 1 expert
