@@ -101,8 +101,12 @@ class GPTPipelineLM:
 
     def apply(self, variables, input_ids, rngs=None, train: bool = False,
               mutable=None, **_ignored):
-        out = self._apply(variables, input_ids, rngs=rngs, train=train)
-        return (out, {}) if mutable is not None else out
+        out, aux = self._apply(variables, input_ids, rngs=rngs, train=train)
+        if mutable is not None:
+            # Trainer folds every 'losses' leaf into the objective
+            upd = {"losses": {"moe_aux": aux}} if aux is not None else {}
+            return out, upd
+        return out
 
     def _apply(self, variables, input_ids, rngs=None, train: bool = False):
         p = variables["params"]
@@ -125,24 +129,37 @@ class GPTPipelineLM:
         # all-reduce at the shard_map boundary trips AllReducePromotion)
         x = x.astype(jnp.float32)
 
-        def stage_fn(sp, act, *, stage, rng):
-            h, b = act
-            srngs = {"dropout": rng} if (train and rng is not None) else {}
-            h = self._stage.apply(
-                {"params": sp}, h.astype(c.dtype), b.astype(c.dtype), train,
-                rngs=srngs,
-            )
-            return (constrain(h.astype(jnp.float32), ACT_SPEC), b)
+        moe = bool(c.moe_experts)
 
-        out, _ = gpipe(
+        def stage_fn(sp, act, *, stage, rng):
+            h, b = act[0], act[1]
+            srngs = {"dropout": rng} if (train and rng is not None) else {}
+            h, upd = self._stage.apply(
+                {"params": sp}, h.astype(c.dtype), b.astype(c.dtype), train,
+                rngs=srngs, mutable=["losses"],
+            )
+            h = constrain(h.astype(jnp.float32), ACT_SPEC)
+            if not moe:
+                return (h, b)
+            # MoE aux rides the ring as a per-example accumulator leaf
+            # (bert_pp precedent: same shape at every boundary; bubble
+            # microbatches are discarded with the rest of outbuf)
+            aux = sum(jax.tree.leaves(upd.get("losses", {})), 0.0)
+            return (h, b, act[2] + jnp.asarray(aux, jnp.float32))
+
+        act0 = (x, bias.astype(jnp.float32))
+        if moe:
+            act0 = (*act0, jnp.zeros((x.shape[0],), jnp.float32))
+        out_tree = gpipe(
             stage_fn,
             p["stages"],
-            (x, bias.astype(jnp.float32)),
+            act0,
             self.n_micro,
             rng=drop if train else None,
         )
-        out = constrain(out, ACT_SPEC)
+        out = constrain(out_tree[0], ACT_SPEC)
+        aux_total = out_tree[2].mean() if moe else None
         ln = nn.LayerNorm(dtype=c.dtype, name="ln_final")
         h = ln.apply({"params": p["ln_final"]}, out.astype(c.dtype))
         logits = tok.attend(h)  # weight-tied head, outside the ring
-        return logits.astype(jnp.float32)
+        return logits.astype(jnp.float32), aux_total

@@ -94,3 +94,37 @@ class TestMoeDecoder:
 def test_top_k_exceeding_experts_fails_fast():
     with pytest.raises(ValueError, match="moe_top_k"):
         GPTConfig.tiny(moe_experts=1)  # default top_k=2 > 1 expert
+
+
+def test_moe_inside_gpt_pipeline(cpu_devices):
+    """MoE decoder stages inside the pipeline ring: aux rides the ring as
+    an activation leaf, surfaces via apply(mutable), trains under
+    {data, expert, pipeline}."""
+    from kubeflow_tpu.models.gpt_pp import GPTPipelineLM
+
+    cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=64, moe_experts=4)
+    pp = GPTPipelineLM(cfg, num_stages=2, n_micro=2)
+    ids = jnp.ones((4, 16), jnp.int32) * 3
+    v = pp.init(jax.random.PRNGKey(0), ids)
+    out, upd = pp.apply(v, ids, mutable=["losses"])
+    assert out.shape == (4, 16, cfg.vocab_size)
+    aux = upd["losses"]["moe_aux"]
+    assert np.isfinite(float(aux)) and float(aux) > 0.0
+
+    mesh = build_mesh(MeshConfig(data=2, expert=2, pipeline=2),
+                      cpu_devices[:8])
+    ds = synthetic_lm_dataset(n_train=16, n_test=8, seq_len=16,
+                              vocab_size=cfg.vocab_size)
+    trainer = Trainer(
+        pp,
+        TrainerConfig(batch_size=8, steps=1, log_every_steps=10**9),
+        loss_fn=causal_lm_loss,
+        eval_metrics_fn=causal_lm_eval_metrics,
+        mesh=mesh,
+    )
+    state = trainer.init_state(ds.x_train[:8])
+    wu = state.params["stages"]["layer_0"]["moe"]["w_up"]
+    assert wu.sharding.spec[0] == "pipeline"
+    assert wu.sharding.spec[1] == "expert"
+    state, m = trainer.train_step(state, (ds.x_train[:8], ds.y_train[:8]))
+    assert np.isfinite(float(m["loss"]))
