@@ -32,6 +32,7 @@ so vs_baseline is the ratio to this repo's first recorded measurement
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -151,8 +152,17 @@ def _timed_steps(trainer, state, batch, steps: int):
     float(m["loss"])  # true sync (block_until_ready lies through the tunnel)
     t0 = time.perf_counter()
     state, m = compiled(state, batch)
-    float(m["loss"])
-    return time.perf_counter() - t0
+    loss = float(m["loss"])
+    dt = time.perf_counter() - t0
+    # Numerics honesty (r3 probe_flash lesson: the Mosaic flash backward
+    # produced NaN grads while the wall-clock number looked healthy): a
+    # throughput line for a training step whose loss went non-finite is not
+    # a valid training benchmark — surface it as a structured error instead.
+    if not math.isfinite(loss):
+        raise RuntimeError(
+            f"non-finite loss ({loss}) after timed steps — throughput would "
+            "be timing-valid but numerically meaningless")
+    return dt
 
 
 def _finish(result: dict, dt: float, steps: int, flops_per_step: float) -> dict:

@@ -1,0 +1,102 @@
+"""Bisect the Mosaic flash-backward NaN (probe_flash r3: dq/dk/dbias NaN,
+dv fine, fwd fine). Runs the backward pieces directly on the TPU and prints
+NaN locations per output, then kernel variants to isolate the term."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+import threading
+
+WATCHDOG_S = 480.0
+_last = [time.monotonic()]
+
+
+def _pet():
+    _last[0] = time.monotonic()
+
+
+def _watchdog():
+    while True:
+        time.sleep(5.0)
+        if time.monotonic() - _last[0] > WATCHDOG_S:
+            print("RESULT watchdog=hang", flush=True)
+            os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel.ring_attention import (
+        _flash_backward,
+        _flash_forward,
+        blockwise_attention,
+    )
+
+    print("devices", jax.devices(), flush=True)
+    float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
+    _pet()
+
+    b, l, h, d = 2, 1024, 12, 64
+    block = 256
+
+    def born(*shape, key, dtype=jnp.bfloat16):
+        x = jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+        return jax.jit(lambda v: (v * 0.125).astype(dtype))(x)
+
+    q = born(b, l, h, d, key=0)
+    k = born(b, l, h, d, key=1)
+    v = born(b, l, h, d, key=2)
+    bias = jnp.zeros((b, 1, 1, l), jnp.bfloat16)
+    g = born(b, l, h, d, key=3)
+
+    out, lse = jax.jit(
+        lambda q, k, v, bias: _flash_forward(q, k, v, bias, block, block,
+                                             False, want_lse=True)
+    )(q, k, v, bias)
+    print("fwd nan:", int(jnp.isnan(out.astype(jnp.float32)).sum()),
+          "lse nan:", int(jnp.isnan(lse).sum()),
+          "lse range:", float(lse.min()), float(lse.max()), flush=True)
+    _pet()
+
+    dq, dk, dv, dbias = jax.jit(
+        lambda q, k, v, bias, out, lse, g: _flash_backward(
+            q, k, v, bias, out, lse, g, block, block, False)
+    )(q, k, v, bias, out, lse, g)
+    for name, t in (("dq", dq), ("dk", dk), ("dv", dv), ("dbias", dbias)):
+        tf = t.astype(jnp.float32)
+        n = int(jnp.isnan(tf).sum())
+        print(f"{name}: shape={t.shape} nan={n}/{tf.size}", flush=True)
+        if n:
+            # where: per-seq-position nan counts, first/last nan index
+            flat = jnp.isnan(tf).reshape(tf.shape[0], tf.shape[1], -1).sum(-1)
+            rows = jnp.nonzero(flat.sum(0), size=8, fill_value=-1)[0]
+            print(f"  first seq positions with nan: {list(map(int, rows))}",
+                  flush=True)
+    _pet()
+
+    # reference grads for comparison
+    def loss_ref(q, k, v, bias):
+        return (blockwise_attention(q, k, v, bias, block=block)
+                .astype(jnp.float32) * g.astype(jnp.float32)).sum()
+
+    rq, rk, rv, rb = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))(
+        q, k, v, bias)
+    print("ref dq nan:", int(jnp.isnan(rq.astype(jnp.float32)).sum()),
+          flush=True)
+    _pet()
+
+    if int(jnp.isnan(dq.astype(jnp.float32)).sum()) == 0:
+        err = float(jnp.max(jnp.abs(dq.astype(jnp.float32)
+                                    - rq.astype(jnp.float32))))
+        print("dq err vs ref:", err, flush=True)
+
+    print("probe_flash_debug done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,266 @@
+"""Deep bisection of the Mosaic flash-backward NaN (r3 probe_flash verdict:
+dq/dk/dbias NaN, dv fine, fwd fine, interpret-mode all-pass).
+
+Stages, each printed as a RESULT line so a partial window still informs:
+
+  1. single-block term isolation: a grid=(1,) kernel emitting each
+     intermediate (p, dp, dd-broadcast, ds, dq-tile) for one q/kv block
+     pair — locates the NaN-producing term with no grid revisiting at all;
+  2. multi-block dq kernel variant that writes the accumulator to the
+     output block on EVERY kv step (not only the last) — tests the
+     write-only-on-last-step revisit pattern;
+  3. fori-loop dq rewrite (grid over q blocks only, kv loop inside the
+     kernel, accumulation in a carry — no cross-grid-step scratch): the
+     candidate fix shape if stage 2 implicates the revisit pattern.
+
+CPU interpret mode passes all stages (verified before queueing); the TPU
+run is the verdict.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import threading
+import time
+
+WATCHDOG_S = 480.0
+_last = [time.monotonic()]
+
+
+def _pet():
+    _last[0] = time.monotonic()
+
+
+def _watchdog():
+    while True:
+        time.sleep(5.0)
+        if time.monotonic() - _last[0] > WATCHDOG_S:
+            print("RESULT watchdog=hang", flush=True)
+            os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if os.environ.get("KFT_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["KFT_BENCH_PLATFORM"])
+
+    interpret = jax.default_backend() == "cpu"
+    print(f"RESULT backend={jax.default_backend()} interpret={interpret}",
+          flush=True)
+    float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
+    _pet()
+
+    block = 256
+    d = 64
+    scale = 1.0 / (d ** 0.5)
+
+    def born(*shape, key, dtype=jnp.bfloat16):
+        x = jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+        return jax.jit(lambda v: (v * 0.125).astype(dtype))(x)
+
+    # one q block vs one kv block, bh folded to 1
+    q = born(1, block, d, key=0)
+    k = born(1, block, d, key=1)
+    v = born(1, block, d, key=2)
+    do = born(1, block, d, key=3)
+    # realistic lse/dd computed host-side in f32
+    s_full = (q[0].astype(jnp.float32) @ k[0].astype(jnp.float32).T) * scale
+    lse_host = jax.nn.logsumexp(s_full, axis=-1, keepdims=True)
+    p_host = jnp.exp(s_full - lse_host)
+    o_host = p_host @ v[0].astype(jnp.float32)
+    dd_host = (do[0].astype(jnp.float32) * o_host).sum(-1, keepdims=True)
+    lse = jax.device_put(lse_host[None])        # (1, block, 1) f32
+    dd = jax.device_put(dd_host[None])          # (1, block, 1) f32
+
+    def nan_count(x):
+        return int(jnp.isnan(x.astype(jnp.float32)).sum())
+
+    # ---- stage 1: term isolation, single block, no revisiting ------------
+    def term_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, out_ref,
+                    *, term: str):
+        qb = q_ref[0]
+        kb = k_ref[0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if term == "p":
+            out_ref[0] = p
+        elif term == "dp":
+            out_ref[0] = dp
+        elif term == "ddb":
+            out_ref[0] = jnp.broadcast_to(dd_ref[0], p.shape)
+        elif term == "ds":
+            out_ref[0] = p * (dp - dd_ref[0])
+        elif term == "dq":
+            ds = p * (dp - dd_ref[0])
+            out_ref[0] = jax.lax.dot_general(
+                ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    for term in ("p", "dp", "ddb", "ds", "dq"):
+        try:
+            out = pl.pallas_call(
+                functools.partial(term_kernel, term=term),
+                grid=(1,),
+                in_specs=[
+                    pl.BlockSpec((1, block, d), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, d), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, d), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, d), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, 1), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, 1), lambda i: (0, 0, 0)),
+                ],
+                out_specs=pl.BlockSpec(
+                    (1, block, block) if term != "dq" else (1, block, d),
+                    lambda i: (0, 0, 0)),
+                out_shape=jax.ShapeDtypeStruct(
+                    (1, block, block) if term != "dq" else (1, block, d),
+                    jnp.float32),
+                interpret=interpret,
+            )(q, k, v, do, lse, dd)
+            print(f"RESULT stage1_{term}_nan={nan_count(out)}"
+                  f" max={float(jnp.nanmax(jnp.abs(out))):.4g}", flush=True)
+        except Exception as exc:  # noqa: BLE001
+            print(f"RESULT stage1_{term}=ERROR {type(exc).__name__}",
+                  flush=True)
+        _pet()
+
+    # ---- stage 2: multi-block dq, write-every-step variant ---------------
+    L = 1024
+    nblk = L // block
+    qL = born(1, L, d, key=10)
+    kL = born(1, L, d, key=11)
+    vL = born(1, L, d, key=12)
+    doL = born(1, L, d, key=13)
+    sL = (qL[0].astype(jnp.float32) @ kL[0].astype(jnp.float32).T) * scale
+    lseL_h = jax.nn.logsumexp(sL, axis=-1, keepdims=True)
+    pL = jnp.exp(sL - lseL_h)
+    oL = pL @ vL[0].astype(jnp.float32)
+    ddL_h = (doL[0].astype(jnp.float32) * oL).sum(-1, keepdims=True)
+    dq_ref_host = ((pL * ((doL[0].astype(jnp.float32) @
+                           vL[0].astype(jnp.float32).T) - ddL_h))
+                   @ kL[0].astype(jnp.float32)) * scale
+    lseL = jax.device_put(lseL_h[None])
+    ddL = jax.device_put(ddL_h[None])
+
+    def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
+                  acc_scr, *, every_step: bool):
+        ik = pl.program_id(1)
+
+        @pl.when(ik == 0)
+        def _():
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd_ref[0])
+        acc_scr[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if every_step:
+            dq_ref[0] = (acc_scr[:] * scale).astype(dq_ref.dtype)
+        else:
+            @pl.when(ik == pl.num_programs(1) - 1)
+            def _():
+                dq_ref[0] = (acc_scr[:] * scale).astype(dq_ref.dtype)
+
+    for every_step in (False, True):
+        tag = "everystep" if every_step else "laststep"
+        try:
+            dq = pl.pallas_call(
+                functools.partial(dq_kernel, every_step=every_step),
+                grid=(1, nblk),
+                in_specs=[
+                    pl.BlockSpec((1, block, d), lambda iq, ik: (0, 0, 0)),
+                    pl.BlockSpec((1, block, d), lambda iq, ik: (0, ik, 0)),
+                    pl.BlockSpec((1, block, d), lambda iq, ik: (0, ik, 0)),
+                    pl.BlockSpec((1, block, d), lambda iq, ik: (0, 0, 0)),
+                    pl.BlockSpec((1, block, 1), lambda iq, ik: (0, 0, 0)),
+                    pl.BlockSpec((1, block, 1), lambda iq, ik: (0, 0, 0)),
+                ],
+                out_specs=pl.BlockSpec((1, block, d), lambda iq, ik: (0, 0, 0)),
+                out_shape=jax.ShapeDtypeStruct((1, block, d), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
+                interpret=interpret,
+            )(qL[:, :block], kL, vL, doL[:, :block], lseL[:, :block],
+              ddL[:, :block])
+            err = float(jnp.max(jnp.abs(dq[0] - dq_ref_host[:block])))
+            print(f"RESULT stage2_{tag}_nan={nan_count(dq)} err={err:.4g}",
+                  flush=True)
+        except Exception as exc:  # noqa: BLE001
+            print(f"RESULT stage2_{tag}=ERROR {type(exc).__name__}", flush=True)
+        _pet()
+
+    # ---- stage 3: fori-loop dq (no cross-step scratch) -------------------
+    def dq_loop_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref):
+        qb = q_ref[0]
+        dob = do_ref[0]
+        lseb = lse_ref[0]
+        ddb = dd_ref[0]
+
+        def body(ik, acc):
+            kb = k_ref[0, pl.dslice(ik * block, block), :]
+            vb = v_ref[0, pl.dslice(ik * block, block), :]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            p = jnp.exp(s - lseb)
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - ddb)
+            return acc + jax.lax.dot_general(
+                ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        acc = jax.lax.fori_loop(
+            0, nblk, body, jnp.zeros((block, d), jnp.float32))
+        dq_ref[0] = acc * scale
+
+    try:
+        dq = pl.pallas_call(
+            dq_loop_kernel,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((1, block, d), lambda iq: (0, 0, 0)),
+                pl.BlockSpec((1, L, d), lambda iq: (0, 0, 0)),
+                pl.BlockSpec((1, L, d), lambda iq: (0, 0, 0)),
+                pl.BlockSpec((1, block, d), lambda iq: (0, 0, 0)),
+                pl.BlockSpec((1, block, 1), lambda iq: (0, 0, 0)),
+                pl.BlockSpec((1, block, 1), lambda iq: (0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block, d), lambda iq: (0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, block, d), jnp.float32),
+            interpret=interpret,
+        )(qL[:, :block], kL, vL, doL[:, :block], lseL[:, :block],
+          ddL[:, :block])
+        err = float(jnp.max(jnp.abs(dq[0] - dq_ref_host[:block])))
+        print(f"RESULT stage3_foriloop_nan={nan_count(dq)} err={err:.4g}",
+              flush=True)
+    except Exception as exc:  # noqa: BLE001
+        print(f"RESULT stage3_foriloop=ERROR {type(exc).__name__}", flush=True)
+    _pet()
+
+    print("RESULT probe_flash_debug2=complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

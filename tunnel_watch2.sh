@@ -1,0 +1,49 @@
+#!/bin/bash
+# Round-3 second watcher: capture the flash-backward NaN bisection at the
+# next tunnel window (probe_flash_debug + probe_flash_debug2). Same stage
+# discipline as tunnel_watch.sh.
+cd /root/repo
+MAX_HOURS=${MAX_HOURS:-10}
+max_iters=$(( MAX_HOURS * 20 ))
+iters=0
+
+stage() {  # stage <artifact> <timeout_s> <cmd...>
+  local artifact="$1" tmo="$2"; shift 2
+  [ -f "$artifact.done" ] && return 0
+  timeout "$tmo" "$@" > "$artifact.tmp" 2> "$artifact.stderr"
+  local rc=$?
+  echo "stage $artifact rc=$rc at $(date -u +%H:%M:%S)" >> tunnel_watch2.log
+  if [ "$rc" -eq 0 ]; then
+    mv "$artifact.tmp" "$artifact"
+    touch "$artifact.done"
+    return 0
+  fi
+  cat "$artifact.tmp" >> "$artifact" 2>/dev/null
+  rm -f "$artifact.tmp"
+  return 1
+}
+
+while :; do
+  if [ -f probe_flash_fix.txt.done ] && [ -f probe_flash_debug2.txt.done ] \
+     && [ -f probe_flash_debug.txt.done ]; then
+    echo "all stages captured at $(date -u +%H:%M:%S)" >> tunnel_watch2.log
+    exit 0
+  fi
+  iters=$(( iters + 1 ))
+  if [ "$iters" -gt "$max_iters" ]; then
+    echo "tunnel_watch2: iteration budget reached" >> tunnel_watch2.log
+    exit 1
+  fi
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
+" >/dev/null 2>&1; then
+    echo "=== tunnel alive at $(date -u +%H:%M:%S) ===" >> tunnel_watch2.log
+    { stage probe_flash_fix.txt 1200 python -u probe_flash_fix.py \
+        && stage probe_flash_debug2.txt 900 python -u probe_flash_debug2.py \
+        && stage probe_flash_debug.txt 900 python -u probe_flash_debug.py; } \
+      || sleep 180
+  else
+    sleep 180
+  fi
+done
