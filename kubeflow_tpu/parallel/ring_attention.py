@@ -479,7 +479,168 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
         dbias_ref[0] = db_scr[:].astype(dbias_ref.dtype)
 
 
-def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal):
+# Backward implementation selector. "scratch": cross-grid-step VMEM
+# accumulators (one grid step per (q, kv) block pair, output written on the
+# last step). "loop": one grid step per output block with a fori_loop over
+# the other sequence axis inside the kernel — no cross-step scratch, no
+# write-only-on-last-step output revisiting. Both are numerically identical
+# in interpret mode (test_ring_attention pins it). Default is "loop": the
+# r3 probe_flash hardware verdict showed the scratch variant's ds path
+# NaN-ing under Mosaic (dq/dk/dbias NaN, dv clean) while interpret passes;
+# the loop shape removes the grid-revisit machinery that distinguishes the
+# failing outputs. probe_flash_fix.py re-validates on hardware at the next
+# tunnel window (tunnel_watch2.sh).
+FLASH_BWD_IMPL = "loop"
+
+
+def _flash_dq_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                          dd_ref, dq_ref, *, scale, n_kv, causal,
+                          block_q, block_k):
+    """dq for one q block: fori_loop over kv blocks, accumulator carried as
+    a loop value (registers/VMEM), output written exactly once."""
+    iq = pl.program_id(1)
+    qb = q_ref[0]
+    dob = do_ref[0]
+    lseb = lse_ref[0]
+    ddb = dd_ref[0]
+
+    def body(ik, acc):
+        kb = k_ref[0, pl.dslice(ik * block_k, block_k), :]
+        vb = v_ref[0, pl.dslice(ik * block_k, block_k), :]
+        bias_row = bias_ref[0, 0, 0, pl.dslice(ik * block_k, block_k)]
+        p = _flash_bwd_scores(qb, kb, bias_row, lseb, scale, causal, iq, ik,
+                              block_q, block_k)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - ddb)
+        return acc + jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # kv blocks strictly above the diagonal contribute nothing
+        upper = jnp.minimum(
+            (iq * block_q + block_q - 1) // block_k + 1, n_kv
+        )
+    else:
+        upper = n_kv
+    acc = jax.lax.fori_loop(
+        0, upper, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    )
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                           dd_ref, dk_ref, dv_ref, dbias_ref,
+                           *, scale, n_q, causal, block_q, block_k):
+    """dk/dv/dbias for one kv block: fori_loop over q blocks, three
+    accumulators carried as loop values, outputs written exactly once."""
+    ik = pl.program_id(1)
+    kb = k_ref[0]
+    vb = v_ref[0]
+    bias_row = bias_ref[0, 0, 0, :]
+    d = q_ref.shape[2]
+
+    def body(iq, carry):
+        dk_acc, dv_acc, db_acc = carry
+        qb = q_ref[0, pl.dslice(iq * block_q, block_q), :]
+        dob = do_ref[0, pl.dslice(iq * block_q, block_q), :]
+        lseb = lse_ref[0, pl.dslice(iq * block_q, block_q), :]
+        ddb = dd_ref[0, pl.dslice(iq * block_q, block_q), :]
+        p = _flash_bwd_scores(qb, kb, bias_row, lseb, scale, causal, iq, ik,
+                              block_q, block_k)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - ddb)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        db_acc = db_acc + ds.sum(axis=0, keepdims=True)
+        return dk_acc, dv_acc, db_acc
+
+    if causal:
+        # q blocks strictly above the diagonal see nothing of this kv block
+        lower = (ik * block_k) // block_q
+    else:
+        lower = 0
+    init = (
+        jnp.zeros((block_k, d), jnp.float32),
+        jnp.zeros((block_k, d), jnp.float32),
+        jnp.zeros((1, block_k), jnp.float32),
+    )
+    dk_acc, dv_acc, db_acc = jax.lax.fori_loop(lower, n_q, body, init)
+    dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+    dbias_ref[0] = db_acc.astype(dbias_ref.dtype)
+
+
+def _flash_backward_loop(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
+                         scale, block_q, block_k, n_q, n_kv, causal,
+                         interpret, out_dtypes):
+    """Loop-variant backward: grid over output blocks only; the full
+    opposite-axis sequence is resident per kernel invocation (fine for the
+    per-shard lengths context parallelism leaves on a chip)."""
+    dq_dtype, dk_dtype, dv_dtype = out_dtypes
+    dqf = pl.pallas_call(
+        functools.partial(_flash_dq_loop_kernel, scale=scale, n_kv=n_kv,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=(b * h, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, lk, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, lk, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, 1, lk), lambda bh, iq, h=h: (bh // h, 0, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq: (bh, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), dq_dtype),
+        interpret=interpret,
+    )(qf, kf, vf, bias, gf, lse, dd)
+
+    dkf, dvf, dbias_bh = pl.pallas_call(
+        functools.partial(_flash_dkv_loop_kernel, scale=scale, n_q=n_q,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=(b * h, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, lq, d), lambda bh, ik: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik: (bh, ik, 0)),
+            pl.BlockSpec(
+                (1, 1, 1, block_k), lambda bh, ik, h=h: (bh // h, 0, 0, ik)
+            ),
+            pl.BlockSpec((1, lq, d), lambda bh, ik: (bh, 0, 0)),
+            pl.BlockSpec((1, lq, 1), lambda bh, ik: (bh, 0, 0)),
+            pl.BlockSpec((1, lq, 1), lambda bh, ik: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, ik: (bh, 0, ik)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lk, d), dk_dtype),
+            jax.ShapeDtypeStruct((b * h, lk, d), dv_dtype),
+            jax.ShapeDtypeStruct((b * h, 1, lk), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, bias, gf, lse, dd)
+    return dqf, dkf, dvf, dbias_bh
+
+
+def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
+                    impl: str | None = None):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = 1.0 / (d**0.5)
@@ -492,6 +653,18 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal):
     dd = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1, keepdims=True)
     n_q, n_kv = lq // block_q, lk // block_k
     interpret = jax.default_backend() == "cpu"
+
+    if (impl or FLASH_BWD_IMPL) == "loop":
+        dqf, dkf, dvf, dbias_bh = _flash_backward_loop(
+            qf, kf, vf, bias, gf, lse, dd, b=b, h=h, lq=lq, lk=lk, d=d,
+            scale=scale, block_q=block_q, block_k=block_k, n_q=n_q,
+            n_kv=n_kv, causal=causal, interpret=interpret,
+            out_dtypes=(q.dtype, k.dtype, v.dtype),
+        )
+        unfold = lambda t, L: t.reshape(b, h, L, d).transpose(0, 2, 1, 3)  # noqa: E731
+        dbias = dbias_bh.reshape(b, h, 1, lk).sum(axis=1, keepdims=False)
+        dbias = dbias[:, None, :, :].astype(bias.dtype)  # (B, 1, 1, Lk)
+        return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
 
     qspec = pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0))
