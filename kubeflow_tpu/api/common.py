@@ -96,6 +96,26 @@ class ContainerSpec:
     resources: dict[str, Any] = field(default_factory=dict)
     ports: dict[str, int] = field(default_factory=dict)
 
+    def __post_init__(self) -> None:
+        # YAML turns unquoted numeric-looking values into numbers — common
+        # when sweep trial-template substitution writes `LR: ${...}` without
+        # quotes. Env values and argv elements are string-typed all the way
+        # down (os env / execve), so coerce scalars here instead of letting a
+        # float reach the reconciler and hang the job with an opaque
+        # ReconcileError (observed: "expected string or bytes-like object").
+        def coerce(v):
+            # YAML booleans render as 'true'/'false' (the string the manifest
+            # author wrote), not Python's 'True'/'False'
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if isinstance(v, (int, float)):
+                return str(v)
+            return v
+
+        self.env = {k: coerce(v) for k, v in self.env.items()}
+        self.command = [coerce(v) for v in self.command]
+        self.args = [coerce(v) for v in self.args]
+
 
 @dataclass
 class PodTemplateSpec:

@@ -224,3 +224,24 @@ class TestSampleFixtures:
             "JAXJob", "MXJob", "Experiment", "InferenceService", "PodDefault",
             "Profile", "Tensorboard", "Notebook", "PVCViewer",
         } <= seen_kinds
+
+
+class TestContainerScalarCoercion:
+    """YAML turns unquoted numeric/boolean env values into numbers — the
+    reconciler and execve need strings (r3: a float env value hung jobs in
+    Created with an opaque ReconcileError)."""
+
+    def test_env_command_args_coerced_to_strings(self):
+        from kubeflow_tpu.api.common import ContainerSpec
+
+        c = ContainerSpec(
+            command=["python", 3],
+            args=["--lr", 0.1, True],
+            env={"LR": 0.523, "STEPS": 100, "DEBUG": True, "OFF": False,
+                 "NAME": "x"},
+        )
+        assert c.command == ["python", "3"]
+        assert c.args == ["--lr", "0.1", "true"]
+        # booleans render as the YAML the author wrote, not Python repr
+        assert c.env == {"LR": "0.523", "STEPS": "100", "DEBUG": "true",
+                         "OFF": "false", "NAME": "x"}
