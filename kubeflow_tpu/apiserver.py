@@ -115,10 +115,16 @@ class _Html(str):
     """String payload the handler serves as text/html (only /ui builds it)."""
 
 
+class _Asset(tuple):
+    """(payload_bytes, content_type) for whitelisted static dashboard files —
+    an explicit marker type, same rule as _Html: the reply path never sniffs
+    content types from payload bytes."""
+
+
 def _render_dashboard(platform) -> str:
-    """Read-only status page (GET /ui) — the centraldashboard gesture
-    (SURVEY.md §1 L9): one table per object kind, no JS framework, no
-    write paths. Auto-refreshes every 5s."""
+    """Server-rendered status page (GET /ui/plain) — the no-JS fallback to
+    the SPA dashboard at /ui (SURVEY.md §1 L9): one table per object kind,
+    no write paths. Auto-refreshes every 5s."""
     import html
 
     cluster = platform.cluster
@@ -214,10 +220,26 @@ class PlatformServer:
 
         if parsed.path == "/healthz" or parsed.path == "/readyz":
             return 200, {"ok": True}
-        if parsed.path == "/ui" or parsed.path == "/ui/":
+        if parsed.path == "/ui/plain":
             # explicit marker type — the reply path must NEVER sniff
             # content types from payload bytes (pod logs are attacker text)
             return 200, _Html(_render_dashboard(self.platform))
+        if parsed.path == "/ui" or parsed.path == "/ui/":
+            from kubeflow_tpu.ui import load_asset
+
+            asset = load_asset("index.html")
+            if asset is None:
+                return 500, {"error": "dashboard assets missing"}
+            return 200, _Asset(asset)
+        if parsed.path.startswith("/ui/"):
+            from kubeflow_tpu.ui import load_asset
+
+            # load_asset whitelists filenames, so traversal attempts
+            # ("/ui/../x", encoded or not) fall through to 404 here
+            asset = load_asset(parsed.path[len("/ui/"):])
+            if asset is None:
+                return 404, {"error": f"no asset {parsed.path!r}"}
+            return 200, _Asset(asset)
         if parsed.path == "/metrics":
             from kubeflow_tpu.observability import render_metrics
 
@@ -404,7 +426,9 @@ class PlatformServer:
                 self._reply(code, payload)
 
             def _reply(self, code, payload):
-                if isinstance(payload, _Html):
+                if isinstance(payload, _Asset):
+                    data, ctype = payload
+                elif isinstance(payload, _Html):
                     data, ctype = payload.encode(), "text/html"
                 elif isinstance(payload, str):
                     data, ctype = payload.encode(), "text/plain"

@@ -1,0 +1,37 @@
+"""Static dashboard assets — the centraldashboard / crud-web-apps analogue.
+
+Reference parity (unverified cites, SURVEY.md §2.7): the reference ships web
+UIs as separate TS/Angular apps (components/centraldashboard, crud-web-apps)
+talking to kube-apiserver-shaped backends. Here the same capability is a
+self-contained vanilla-JS single-page app served by the platform apiserver
+(`/ui`): namespace switcher, per-kind CRUD views (jobs, experiments + trials
+with the optimal-trial objective chart — the Katib-UI analogue, inference
+services, pipeline runs with a DAG view — the KFP-frontend analogue,
+notebooks/tensorboards/pvcviewers — the crud-web-apps analogue), live status
+via polling the same REST surface SDKs use. No framework, no CDN, no build
+step — this environment has zero egress, so the app is fully self-hosted.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+_DIR = Path(__file__).parent
+
+# whitelist — the handler must never serve arbitrary paths from the package
+ASSETS: dict[str, str] = {
+    "index.html": "text/html; charset=utf-8",
+    "app.js": "application/javascript; charset=utf-8",
+    "style.css": "text/css; charset=utf-8",
+}
+
+
+def load_asset(name: str) -> tuple[bytes, str] | None:
+    """Return (payload, content_type) for a whitelisted asset, else None."""
+    ctype = ASSETS.get(name)
+    if ctype is None:
+        return None
+    try:
+        return (_DIR / name).read_bytes(), ctype
+    except OSError:
+        return None

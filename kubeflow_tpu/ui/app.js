@@ -1,0 +1,665 @@
+/* kubeflow_tpu dashboard — vanilla-JS SPA over the platform REST API.
+ *
+ * Views poll the same /api/v1 surface the SDKs use (2.5 s interval); hash
+ * routing (#/jobs, #/experiments/default/exp1, ...) keeps every view
+ * linkable. CRUD: create via JSON manifest modal (POST), delete, job scale,
+ * job logs. The experiment detail view is the Katib-UI analogue (trials +
+ * objective chart + optimal trial); the pipeline-run detail view is the
+ * KFP-frontend analogue (task DAG colored by state). */
+"use strict";
+
+const POLL_MS = 2500;
+const $ = (sel) => document.querySelector(sel);
+
+const state = {
+  kind: "overview",   // active view
+  ns: "",             // namespace filter ("" = all)
+  sel: null,          // selected {ns, name} for the detail pane
+  counts: {},         // kind -> object count (sidebar badges)
+  logs: { replicaType: "worker", index: 0 },
+};
+
+// ---------------------------------------------------------------- REST layer
+
+async function api(path, opts) {
+  const r = await fetch(path, opts);
+  const text = await r.text();
+  let body = text;
+  try { body = JSON.parse(text); } catch (e) { /* raw text endpoints */ }
+  if (!r.ok) {
+    const msg = body && body.error ? body.error : r.status + " " + text;
+    throw new Error(msg);
+  }
+  return body;
+}
+
+const list = (kind) => api("/api/v1/" + kind);
+const getObj = (kind, ns, name) => api(`/api/v1/${kind}/${ns}/${name}`);
+const del = (kind, ns, name) =>
+  api(`/api/v1/${kind}/${ns}/${name}`, { method: "DELETE" });
+const create = (kind, manifest) =>
+  api("/api/v1/" + kind, { method: "POST", body: JSON.stringify(manifest) });
+const eventsFor = (ns, name) => api(`/api/v1/events/${ns}/${name}`);
+
+// ------------------------------------------------------------------- helpers
+
+function esc(v) {
+  return String(v == null ? "" : v).replace(/[&<>"']/g, (c) => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
+  }[c]));
+}
+
+const STATE_CLASS = {
+  Succeeded: "ok", Ready: "ok", Cached: "ok", True: "ok",
+  Running: "run", Created: "idle", Pending: "idle", Suspended: "idle",
+  Restarting: "warn", EarlyStopped: "warn", NotReady: "warn",
+  MetricsUnavailable: "warn", Skipped: "idle",
+  Failed: "fail", Error: "fail",
+};
+function badge(s) {
+  const cls = STATE_CLASS[s] || "idle";
+  return `<span class="badge ${cls}">${esc(s)}</span>`;
+}
+
+function jobState(o) {
+  const conds = (o.status && o.status.conditions) || [];
+  const active = conds.filter((c) => c.status);
+  return active.length ? active[active.length - 1].type : "-";
+}
+
+function meta(o) {
+  return { ns: o.metadata.namespace || "default", name: o.metadata.name };
+}
+
+function inNs(o) {
+  return !state.ns || (o.metadata && o.metadata.namespace === state.ns);
+}
+
+// ------------------------------------------------------------ kind registry
+
+// columns: header list; row: object -> cell-html list (after ns/name cell)
+const KINDS = {
+  jobs: {
+    title: "Jobs", manifestKind: "JAXJob",
+    cols: ["kind", "state", "replicas"],
+    row: (o) => [
+      esc(o.kind),
+      badge(jobState(o)),
+      esc(Object.values(o.spec.replicaSpecs || {})
+        .reduce((a, r) => a + (r.replicas || 0), 0) + " replicas"),
+    ],
+  },
+  experiments: {
+    title: "Experiments", manifestKind: "Experiment",
+    cols: ["algorithm", "state", "trials", "best"],
+    row: (o) => {
+      const st = o.status || {};
+      const best = st.currentOptimalTrial &&
+        ((st.currentOptimalTrial.observation || {}).metrics || [])[0];
+      return [
+        esc(((o.spec || {}).algorithm || {}).algorithmName || "-"),
+        badge(st.condition || "-"),
+        esc(`${st.trialsSucceeded || 0}/${st.trials || 0}`),
+        best ? esc(Number(best.value).toPrecision(5)) : "-",
+      ];
+    },
+  },
+  trials: {
+    title: "Trials",
+    cols: ["experiment", "state", "objective", "assignments"],
+    row: (o) => {
+      const m = (((o.status || {}).observation || {}).metrics || [])[0];
+      return [
+        esc((o.metadata.labels || {})["kubeflow-tpu.org/experiment-name"] || "-"),
+        badge((o.status || {}).condition || "-"),
+        m ? esc(Number(m.value).toPrecision(5)) : "-",
+        esc(((o.spec || {}).parameterAssignments || [])
+          .map((a) => `${a.name}=${a.value}`).join(" ")),
+      ];
+    },
+  },
+  inferenceservices: {
+    title: "InferenceServices", manifestKind: "InferenceService",
+    cols: ["runtime", "state", "url"],
+    row: (o) => [
+      esc((((o.spec || {}).predictor || {}).runtime) || "-"),
+      badge((o.status || {}).ready ? "Ready" : "NotReady"),
+      esc((o.status || {}).url || "-"),
+    ],
+  },
+  pipelineruns: {
+    title: "PipelineRuns", manifestKind: "PipelineRun",
+    cols: ["state", "steps"],
+    row: (o) => {
+      const t = (o.status || {}).tasks || {};
+      const done = Object.values(t)
+        .filter((s) => s === "Succeeded" || s === "Cached").length;
+      return [badge((o.status || {}).state || "-"),
+        esc(`${done}/${Object.keys(t).length} steps`)];
+    },
+  },
+  notebooks: {
+    title: "Notebooks", manifestKind: "Notebook",
+    cols: ["state", "url"],
+    row: (o) => [badge((o.status || {}).ready ? "Ready" : "NotReady"),
+      esc((o.status || {}).url || "-")],
+  },
+  tensorboards: {
+    title: "Tensorboards", manifestKind: "Tensorboard",
+    cols: ["logdir", "state", "url"],
+    row: (o) => [esc((o.spec || {}).logdir || "-"),
+      badge((o.status || {}).ready ? "Ready" : "NotReady"),
+      esc((o.status || {}).url || "-")],
+  },
+  pvcviewers: {
+    title: "PVCViewers", manifestKind: "PVCViewer",
+    cols: ["state", "url"],
+    row: (o) => [badge((o.status || {}).ready ? "Ready" : "NotReady"),
+      esc((o.status || {}).url || "-")],
+  },
+  profiles: {
+    title: "Profiles", manifestKind: "Profile",
+    cols: ["owner", "quota"],
+    row: (o) => {
+      const q = (o.spec || {}).resourceQuota || o.resourceQuota || {};
+      return [esc((o.spec || {}).owner || o.owner || "-"),
+        esc(Object.entries(q).map(([k, v]) => `${k}=${v}`).join(" ") || "-")];
+    },
+  },
+  poddefaults: {
+    title: "PodDefaults", manifestKind: "PodDefault",
+    cols: ["selector"],
+    row: (o) => [esc(JSON.stringify((o.spec || {}).selector || o.selector || {}))],
+  },
+  pods: {
+    title: "Pods",
+    cols: ["phase", "job"],
+    row: (o) => [
+      badge((o.status || {}).phase || o.phase || "-"),
+      esc((o.metadata.labels || {})["training.kubeflow-tpu.org/job-name"] ||
+          (o.metadata.labels || {})["job-name"] || "-"),
+    ],
+  },
+};
+
+const NAV = ["overview", "jobs", "experiments", "trials", "inferenceservices",
+  "pipelineruns", "notebooks", "tensorboards", "pvcviewers", "profiles",
+  "poddefaults", "pods"];
+
+// ------------------------------------------------------------------- sidebar
+
+function renderSidebar() {
+  $("#sidebar").innerHTML = NAV.map((k) => {
+    const title = k === "overview" ? "Overview" : KINDS[k].title;
+    const n = k === "overview" ? "" :
+      `<span class="count">${state.counts[k] ?? ""}</span>`;
+    const cls = state.kind === k ? "active" : "";
+    return `<a class="${cls}" href="#/${k}">${title}${n}</a>`;
+  }).join("");
+}
+
+// ------------------------------------------------------------------ overview
+
+async function renderOverview() {
+  const cards = NAV.slice(1).map((k) =>
+    `<div class="card" onclick="location.hash='#/${k}'">
+       <div class="n">${state.counts[k] ?? 0}</div>
+       <div class="k">${KINDS[k].title}</div></div>`).join("");
+  $("#view").innerHTML = `<h2>Overview</h2><div class="cards">${cards}</div>
+    <h3>controller metrics</h3><pre id="metrics-pre">loading…</pre>`;
+  try {
+    const m = await fetch("/metrics").then((r) => r.text());
+    const pre = $("#metrics-pre");
+    if (pre) pre.textContent = m;
+  } catch (e) { /* metrics endpoint optional */ }
+}
+
+// --------------------------------------------------------------- table views
+
+async function renderTable(kind) {
+  const spec = KINDS[kind];
+  const objs = (await list(kind)).filter(inNs)
+    .sort((a, b) => (a.metadata.namespace + a.metadata.name)
+      .localeCompare(b.metadata.namespace + b.metadata.name));
+  state.counts[kind] = objs.length;
+  const createBtn = spec.manifestKind ?
+    `<button id="create-btn">+ Create ${spec.manifestKind}</button>` : "";
+  const head = ["namespace/name", ...spec.cols]
+    .map((c) => `<th>${esc(c)}</th>`).join("");
+  const rows = objs.map((o) => {
+    const { ns, name } = meta(o);
+    const selCls = state.sel && state.sel.ns === ns && state.sel.name === name
+      ? "selected" : "";
+    return `<tr class="row ${selCls}" data-ns="${esc(ns)}" data-name="${esc(name)}">
+      <td>${esc(ns)}/${esc(name)}</td>
+      ${spec.row(o).map((c) => `<td>${c}</td>`).join("")}</tr>`;
+  }).join("");
+  $("#view").innerHTML = `<h2>${spec.title} (${objs.length})</h2>
+    <div class="toolbar">${createBtn}</div>
+    <table><tr>${head}</tr>${rows}</table>`;
+  $("#view").querySelectorAll("tr.row").forEach((tr) => {
+    tr.addEventListener("click", () => {
+      state.sel = { ns: tr.dataset.ns, name: tr.dataset.name };
+      location.hash = `#/${kind}/${state.sel.ns}/${state.sel.name}`;
+    });
+  });
+  const cb = $("#create-btn");
+  if (cb) cb.addEventListener("click", () => openCreateModal(kind));
+}
+
+// -------------------------------------------------------------- detail panes
+
+function kvTable(pairs) {
+  return `<dl class="kv">${pairs.map(([k, v]) =>
+    `<div><dt>${esc(k)}</dt><dd>${v}</dd></div>`).join("")}</dl>`;
+}
+
+async function renderDetail(kind, ns, name) {
+  const pane = $("#detail");
+  let obj;
+  try {
+    obj = await getObj(kind, ns, name);
+  } catch (e) {
+    pane.hidden = false;
+    pane.innerHTML = `<h2>${esc(ns)}/${esc(name)}</h2>
+      <p class="error-text">${esc(e.message)}</p>`;
+    return;
+  }
+  let extra = "";
+  if (kind === "jobs") extra = jobDetail(obj);
+  if (kind === "experiments") extra = await experimentDetail(obj);
+  if (kind === "pipelineruns") extra = pipelineRunDetail(obj);
+  let events = [];
+  try { events = await eventsFor(ns, name); } catch (e) { /* none */ }
+  const evHtml = events.length ?
+    `<h3>events</h3><table>${events.slice(-12).map((e) =>
+      `<tr><td class="muted">${esc(e.timestamp)}</td><td>${esc(e.reason)}</td>
+       <td>${esc(e.message)}</td></tr>`).join("")}</table>` : "";
+  pane.hidden = false;
+  pane.innerHTML = `
+    <div class="toolbar">
+      <button id="close-detail">close</button>
+      <button id="delete-obj" class="danger">delete</button>
+    </div>
+    <h2>${esc(ns)}/${esc(name)}</h2>
+    ${extra}${evHtml}
+    <h3>manifest</h3><pre>${esc(JSON.stringify(obj, null, 2))}</pre>`;
+  $("#close-detail").addEventListener("click", () => {
+    state.sel = null;
+    location.hash = `#/${kind}`;
+  });
+  $("#delete-obj").addEventListener("click", async () => {
+    if (!confirm(`delete ${kind} ${ns}/${name}?`)) return;
+    try { await del(kind, ns, name); } catch (e) { alert(e.message); }
+    state.sel = null;
+    location.hash = `#/${kind}`;
+  });
+  wireDetailControls(kind, ns, name, obj);
+}
+
+function jobDetail(o) {
+  const conds = ((o.status || {}).conditions || []).map((c) =>
+    `<tr><td>${badge(c.type)}</td><td>${esc(c.status)}</td>
+     <td>${esc(c.reason || "")}</td><td>${esc(c.message || "")}</td></tr>`)
+    .join("");
+  const rs = Object.entries((o.status || {}).replicaStatuses || {}).map(
+    ([t, s]) => `<tr><td>${esc(t)}</td><td>${s.active || 0} active</td>
+      <td>${s.succeeded || 0} ok</td><td>${s.failed || 0} failed</td></tr>`)
+    .join("");
+  const types = Object.keys((o.spec || {}).replicaSpecs || { worker: 1 });
+  return `
+    ${kvTable([["kind", esc(o.kind)], ["state", badge(jobState(o))]])}
+    <h3>replica statuses</h3><table>${rs || "<tr><td>-</td></tr>"}</table>
+    <h3>conditions</h3><table>${conds || "<tr><td>-</td></tr>"}</table>
+    <h3>scale</h3><div class="toolbar">
+      <input type="number" id="scale-n" min="0" value="1">
+      <button id="scale-btn">scale workers</button></div>
+    <h3>logs</h3><div class="toolbar">
+      <select id="log-rt">${types.map((t) =>
+        `<option ${t === state.logs.replicaType ? "selected" : ""}>${esc(t)}</option>`)
+        .join("")}</select>
+      <input type="number" id="log-idx" min="0" value="${state.logs.index}">
+      <button id="log-btn">fetch</button></div>
+    <pre id="logs-pre">(fetch to load)</pre>`;
+}
+
+function wireDetailControls(kind, ns, name, obj) {
+  if (kind !== "jobs") return;
+  const scaleBtn = $("#scale-btn");
+  if (scaleBtn) scaleBtn.addEventListener("click", async () => {
+    try {
+      await api(`/api/v1/jobs/${ns}/${name}/scale`, {
+        method: "POST",
+        body: JSON.stringify({ replicas: Number($("#scale-n").value) }),
+      });
+    } catch (e) { alert(e.message); }
+  });
+  const logBtn = $("#log-btn");
+  if (logBtn) logBtn.addEventListener("click", async () => {
+    state.logs.replicaType = $("#log-rt").value;
+    state.logs.index = Number($("#log-idx").value);
+    const q = `replicaType=${encodeURIComponent(state.logs.replicaType)}` +
+      `&index=${state.logs.index}`;
+    try {
+      const text = await fetch(`/api/v1/jobs/${ns}/${name}/logs?${q}`)
+        .then((r) => r.text());
+      $("#logs-pre").textContent = text || "(empty)";
+    } catch (e) { $("#logs-pre").textContent = "error: " + e.message; }
+  });
+}
+
+// ----------------------------------------------- experiment detail (Katib UI)
+
+async function experimentDetail(o) {
+  const expName = o.metadata.name;
+  let trials = [];
+  try {
+    trials = (await list("trials")).filter((t) =>
+      (t.metadata.labels || {})["kubeflow-tpu.org/experiment-name"] === expName
+      && t.metadata.namespace === (o.metadata.namespace || "default"));
+  } catch (e) { /* trials view optional */ }
+  trials.sort((a, b) =>
+    (a.metadata.creationTimestamp || a.metadata.name)
+      .localeCompare(b.metadata.creationTimestamp || b.metadata.name));
+  const objName = (((o.spec || {}).objective || {}).objectiveMetricName) || "objective";
+  const objType = (((o.spec || {}).objective || {}).type) || "maximize";
+  const opt = (o.status || {}).currentOptimalTrial;
+  const optHtml = opt && opt.trialName ? kvTable([
+    ["optimal trial", esc(opt.trialName)],
+    ["assignments", esc((opt.parameterAssignments || [])
+      .map((a) => `${a.name}=${a.value}`).join(" "))],
+    [objName, esc(((opt.observation || {}).metrics || [])
+      .map((m) => `${m.name}=${Number(m.value).toPrecision(6)}`).join(" "))],
+  ]) : `<p class="muted">no optimal trial yet</p>`;
+  const rows = trials.map((t) => {
+    const m = (((t.status || {}).observation || {}).metrics || [])
+      .find((m) => m.name === objName) ||
+      (((t.status || {}).observation || {}).metrics || [])[0];
+    return `<tr><td>${esc(t.metadata.name)}</td>
+      <td>${badge((t.status || {}).condition || "-")}</td>
+      <td>${m ? esc(Number(m.value).toPrecision(5)) : "-"}</td>
+      <td>${esc(((t.spec || {}).parameterAssignments || [])
+        .map((a) => `${a.name}=${a.value}`).join(" "))}</td></tr>`;
+  }).join("");
+  return `
+    ${kvTable([
+      ["algorithm", esc(((o.spec || {}).algorithm || {}).algorithmName || "-")],
+      ["objective", esc(`${objType} ${objName}`)],
+      ["state", badge((o.status || {}).condition || "-")],
+    ])}
+    <h3>optimal trial</h3>${optHtml}
+    <h3>${esc(objName)} per trial</h3>
+    ${trialChart(trials, objName, objType)}
+    <h3>trials (${trials.length})</h3>
+    <table><tr><th>trial</th><th>state</th><th>${esc(objName)}</th>
+      <th>assignments</th></tr>${rows}</table>`;
+}
+
+// Single-series dot plot: objective value per trial, in trial-creation order.
+// One hue (series-1); the best trial gets a 2px surface ring + direct label —
+// the only labeled point. The trials table right below is the table view.
+function trialChart(trials, objName, objType) {
+  const pts = [];
+  trials.forEach((t, i) => {
+    const ms = ((t.status || {}).observation || {}).metrics || [];
+    const m = ms.find((x) => x.name === objName) || ms[0];
+    if (m && isFinite(Number(m.value))) {
+      pts.push({ i, v: Number(m.value), name: t.metadata.name });
+    }
+  });
+  if (pts.length < 2) {
+    return `<p class="muted">not enough observed trials to chart</p>`;
+  }
+  const W = 560, H = 200, L = 56, R = 14, T = 14, B = 30;
+  const xs = pts.map((p) => p.i), vs = pts.map((p) => p.v);
+  const vmin = Math.min(...vs), vmax = Math.max(...vs);
+  const pad = (vmax - vmin || Math.abs(vmax) || 1) * 0.08;
+  const y0 = vmin - pad, y1 = vmax + pad;
+  const x = (i) => L + (W - L - R) * (xs.length > 1 ?
+    (i - xs[0]) / (xs[xs.length - 1] - xs[0] || 1) : 0.5);
+  const y = (v) => T + (H - T - B) * (1 - (v - y0) / (y1 - y0));
+  const ticks = [0, 1, 2, 3].map((k) => y0 + (k / 3) * (y1 - y0));
+  const grid = ticks.map((tv) =>
+    `<line class="gridline" x1="${L}" x2="${W - R}" y1="${y(tv)}" y2="${y(tv)}"/>
+     <text x="${L - 6}" y="${y(tv) + 4}" text-anchor="end">${tv.toPrecision(3)}</text>`)
+    .join("");
+  const bestV = objType === "minimize" ? Math.min(...vs) : Math.max(...vs);
+  const best = pts.find((p) => p.v === bestV);
+  const dots = pts.map((p) =>
+    `<circle class="dot" cx="${x(p.i)}" cy="${y(p.v)}" r="4">
+       <title>${esc(p.name)}\n${esc(objName)}=${p.v}</title></circle>`).join("");
+  const labelAnchor = x(best.i) > W - 110 ? "end" : "start";
+  const labelDx = labelAnchor === "end" ? -8 : 8;
+  return `<svg class="chart" viewBox="0 0 ${W} ${H}" role="img"
+      aria-label="${esc(objName)} per trial">
+    ${grid}
+    <text x="${(L + W - R) / 2}" y="${H - 8}" text-anchor="middle">trial #</text>
+    ${dots}
+    <circle class="best-ring" cx="${x(best.i)}" cy="${y(best.v)}" r="6.5"/>
+    <text class="direct-label" x="${x(best.i) + labelDx}" y="${y(best.v) - 8}"
+      text-anchor="${labelAnchor}">best ${best.v.toPrecision(4)}</text>
+  </svg>`;
+}
+
+// -------------------------------------------- pipeline-run detail (KFP UI)
+
+const TASK_STATE_COLOR = {
+  Succeeded: "var(--status-good)", Cached: "var(--status-good)",
+  Running: "var(--series-1)", Failed: "var(--status-critical)",
+  Skipped: "var(--text-secondary)", Pending: "var(--border)",
+};
+
+function pipelineRunDetail(o) {
+  const ir = ((o.spec || {}).pipelineSpec || {});
+  const tasks = ((ir.root || {}).dag || {}).tasks || {};
+  const states = (o.status || {}).tasks || {};
+  const names = Object.keys(tasks);
+  const header = kvTable([
+    ["state", badge((o.status || {}).state || "-")],
+    ["run id", esc((o.status || {}).runId || "-")],
+    ["error", (o.status || {}).error ?
+      `<span class="error-text">${esc(o.status.error)}</span>` : "-"],
+  ]);
+  if (!names.length) return header;
+  // topo layers: depth = 1 + max(depth of deps)
+  const depth = {};
+  const depsOf = (n) => (tasks[n].dependencies ||
+    tasks[n].dependentTasks || []).filter((d) => tasks[d]);
+  const computeDepth = (n, seen) => {
+    if (depth[n] != null) return depth[n];
+    if (seen.has(n)) return 0; // cycle guard — validator rejects these anyway
+    seen.add(n);
+    const ds = depsOf(n);
+    depth[n] = ds.length ? 1 + Math.max(...ds.map((d) => computeDepth(d, seen))) : 0;
+    return depth[n];
+  };
+  names.forEach((n) => computeDepth(n, new Set()));
+  const layers = [];
+  names.forEach((n) => {
+    (layers[depth[n]] = layers[depth[n]] || []).push(n);
+  });
+  const NW = 150, NH = 40, GX = 60, GY = 16, PAD = 16;
+  const pos = {};
+  layers.forEach((layer, li) => layer.forEach((n, ri) => {
+    pos[n] = { x: PAD + li * (NW + GX), y: PAD + ri * (NH + GY) };
+  }));
+  const W = PAD * 2 + layers.length * NW + (layers.length - 1) * GX;
+  const H = PAD * 2 + Math.max(...layers.map((l) => l.length)) * (NH + GY) - GY;
+  const edges = names.flatMap((n) => depsOf(n).map((d) => {
+    const a = pos[d], b = pos[n];
+    const x1 = a.x + NW, y1 = a.y + NH / 2, x2 = b.x, y2 = b.y + NH / 2;
+    const mx = (x1 + x2) / 2;
+    return `<path class="edge" d="M${x1},${y1} C${mx},${y1} ${mx},${y2} ${x2},${y2}"/>`;
+  })).join("");
+  const nodes = names.map((n) => {
+    const p = pos[n];
+    const st = states[n] || "Pending";
+    const color = TASK_STATE_COLOR[st] || "var(--border)";
+    const shortName = n.length > 18 ? n.slice(0, 17) + "…" : n;
+    return `<g class="node"><title>${esc(n)}: ${esc(st)}</title>
+      <rect x="${p.x}" y="${p.y}" width="${NW}" height="${NH}" rx="4"
+        stroke="${color}"/>
+      <text x="${p.x + 8}" y="${p.y + 17}">${esc(shortName)}</text>
+      <text class="state" x="${p.x + 8}" y="${p.y + 32}">${esc(st)}</text></g>`;
+  }).join("");
+  return `${header}<h3>dag</h3>
+    <svg class="dag" viewBox="0 0 ${W} ${H}" width="${Math.min(W, 680)}">
+      ${edges}${nodes}</svg>`;
+}
+
+// --------------------------------------------------------------- create flow
+
+const CREATE_TEMPLATES = {
+  jobs: {
+    apiVersion: "kubeflow-tpu.org/v1", kind: "JAXJob",
+    metadata: { name: "myjob", namespace: "default" },
+    spec: {
+      replicaSpecs: {
+        worker: {
+          replicas: 1,
+          template: { container: { command: ["python", "train.py"] } },
+        },
+      },
+    },
+  },
+  experiments: {
+    apiVersion: "kubeflow-tpu.org/v1beta1", kind: "Experiment",
+    metadata: { name: "myexp", namespace: "default" },
+    spec: {
+      maxTrialCount: 6, parallelTrialCount: 2,
+      objective: { type: "maximize", objectiveMetricName: "objective" },
+      algorithm: { algorithmName: "random" },
+      parameters: [{ name: "lr", parameterType: "double",
+        feasibleSpace: { min: "0.001", max: "0.1" } }],
+      trialTemplate: {
+        trialParameters: [{ name: "lr", reference: "lr" }],
+        trialSpec: "",
+      },
+    },
+  },
+  notebooks: {
+    apiVersion: "kubeflow-tpu.org/v1", kind: "Notebook",
+    metadata: { name: "mynb", namespace: "default" }, spec: {},
+  },
+  tensorboards: {
+    apiVersion: "kubeflow-tpu.org/v1", kind: "Tensorboard",
+    metadata: { name: "mytb", namespace: "default" },
+    spec: { logdir: "/tmp/logs" },
+  },
+  pvcviewers: {
+    apiVersion: "kubeflow-tpu.org/v1", kind: "PVCViewer",
+    metadata: { name: "myviewer", namespace: "default" }, spec: {},
+  },
+  profiles: {
+    apiVersion: "kubeflow-tpu.org/v1", kind: "Profile",
+    metadata: { name: "team-a" },
+  },
+  poddefaults: {
+    apiVersion: "kubeflow-tpu.org/v1", kind: "PodDefault",
+    metadata: { name: "mydefault", namespace: "default" }, spec: {},
+  },
+  inferenceservices: {
+    apiVersion: "kubeflow-tpu.org/v1beta1", kind: "InferenceService",
+    metadata: { name: "mymodel", namespace: "default" },
+    spec: { predictor: { runtime: "jax", storageUri: "file:///tmp/model" } },
+  },
+  pipelineruns: {
+    apiVersion: "kubeflow-tpu.org/v1", kind: "PipelineRun",
+    metadata: { name: "myrun", namespace: "default" },
+    spec: { pipelineSpec: {}, arguments: {} },
+  },
+};
+
+function openCreateModal(kind) {
+  const tmpl = CREATE_TEMPLATES[kind] ||
+    { kind: KINDS[kind].manifestKind, metadata: { name: "", namespace: "default" } };
+  $("#modal-title").textContent = `Create ${KINDS[kind].manifestKind}`;
+  $("#modal-body").value = JSON.stringify(tmpl, null, 2);
+  $("#modal-error").textContent = "";
+  $("#modal-backdrop").hidden = false;
+  $("#modal-submit").onclick = async () => {
+    let manifest;
+    try {
+      manifest = JSON.parse($("#modal-body").value);
+    } catch (e) {
+      $("#modal-error").textContent = "invalid JSON: " + e.message;
+      return;
+    }
+    try {
+      await create(kind, manifest);
+      $("#modal-backdrop").hidden = true;
+      refresh();
+    } catch (e) {
+      $("#modal-error").textContent = e.message;
+    }
+  };
+  $("#modal-cancel").onclick = () => { $("#modal-backdrop").hidden = true; };
+}
+
+// ------------------------------------------------------- namespaces + router
+
+async function refreshNamespaces() {
+  try {
+    const nss = await list("namespaces");
+    const sel = $("#ns-select");
+    const current = state.ns;
+    const names = [...new Set(nss.map((n) => n.metadata ? n.metadata.name : n.name))]
+      .filter(Boolean).sort();
+    sel.innerHTML = `<option value="">all</option>` + names.map((n) =>
+      `<option value="${esc(n)}" ${n === current ? "selected" : ""}>${esc(n)}</option>`)
+      .join("");
+  } catch (e) { /* namespaces kind optional */ }
+}
+
+async function refreshCounts() {
+  await Promise.all(NAV.slice(1).map(async (k) => {
+    try { state.counts[k] = (await list(k)).filter(inNs).length; }
+    catch (e) { /* kind may not exist */ }
+  }));
+}
+
+function parseHash() {
+  const parts = location.hash.replace(/^#\/?/, "").split("/").filter(Boolean);
+  state.kind = parts[0] || "overview";
+  if (!NAV.includes(state.kind)) state.kind = "overview";
+  state.sel = parts.length >= 3 ? { ns: parts[1], name: parts[2] } : null;
+}
+
+let refreshing = false;
+async function refresh() {
+  if (refreshing) return;
+  refreshing = true;
+  try {
+    parseHash();
+    await refreshCounts();
+    renderSidebar();
+    if (state.kind === "overview") {
+      $("#detail").hidden = true;
+      await renderOverview();
+    } else {
+      await renderTable(state.kind);
+      if (state.sel) await renderDetail(state.kind, state.sel.ns, state.sel.name);
+      else $("#detail").hidden = true;
+    }
+    $("#poll-dot").classList.remove("stale");
+  } catch (e) {
+    $("#poll-dot").classList.add("stale");
+    $("#poll-dot").title = "last poll failed: " + e.message;
+  } finally {
+    refreshing = false;
+  }
+}
+
+window.addEventListener("hashchange", refresh);
+$("#ns-select").addEventListener("change", (e) => {
+  state.ns = e.target.value;
+  refresh();
+});
+
+refreshNamespaces();
+refresh();
+setInterval(() => {
+  // don't clobber the create modal or an in-flight log read
+  if ($("#modal-backdrop").hidden) refresh();
+}, POLL_MS);
+setInterval(refreshNamespaces, POLL_MS * 4);

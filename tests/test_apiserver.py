@@ -244,12 +244,13 @@ class TestWatch:
 
 
 def test_dashboard_ui(remote, tmp_path):
-    """GET /ui renders the read-only status page (L9 gesture)."""
+    """GET /ui/plain renders the read-only no-JS status page (the SPA at
+    /ui is covered by tests/test_dashboard.py)."""
     import urllib.request
 
     remote.apply(job_manifest(tmp_path, name="uijob", replicas=1))
     remote.wait_for_job("uijob", timeout_s=60)
-    with urllib.request.urlopen(f"{remote.server}/ui") as r:
+    with urllib.request.urlopen(f"{remote.server}/ui/plain") as r:
         assert r.headers.get_content_type() == "text/html"
         page = r.read().decode()
     assert "kubeflow_tpu platform" in page

@@ -1,0 +1,209 @@
+"""SPA dashboard tests — the centraldashboard / crud-web-apps analogue.
+
+The SPA is client-rendered, so these tests cover the server half the app
+stands on: asset serving (whitelist, content types, traversal rejection) and
+the exact REST endpoints app.js consumes (list per kind, detail, events,
+trials-by-label for the Katib view, pipelineSpec IR in pipelinerun bodies for
+the DAG view). Reference parity: SURVEY.md §2.7 centraldashboard/crud-web-apps
+and §2.4 Katib UI / §2.6 frontend rows.
+"""
+
+import json
+import sys
+import textwrap
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+from kubeflow_tpu.apiserver import PlatformServer
+from kubeflow_tpu.client import Platform
+
+
+@pytest.fixture()
+def server(tmp_path):
+    with Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16) as p:
+        srv = PlatformServer(p, port=0).start()
+        yield srv
+        srv.stop()
+
+
+def fetch(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+class TestAssets:
+    def test_index_served_at_ui(self, server):
+        code, ctype, body = fetch(f"{server.url}/ui")
+        assert code == 200
+        assert ctype.startswith("text/html")
+        assert b"app.js" in body and b"kubeflow_tpu" in body
+
+    def test_js_and_css_assets(self, server):
+        code, ctype, body = fetch(f"{server.url}/ui/app.js")
+        assert code == 200
+        assert ctype.startswith("application/javascript")
+        # the SPA drives the same API surface the SDKs use
+        assert b"/api/v1/" in body
+        code, ctype, body = fetch(f"{server.url}/ui/style.css")
+        assert code == 200
+        assert ctype.startswith("text/css")
+
+    def test_plain_fallback_still_served(self, server):
+        code, ctype, body = fetch(f"{server.url}/ui/plain")
+        assert code == 200
+        assert ctype.startswith("text/html")
+        assert b"kubeflow_tpu platform" in body
+
+    def test_unknown_asset_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            fetch(f"{server.url}/ui/nope.js")
+        assert ei.value.code == 404
+
+    def test_traversal_rejected(self, server):
+        # encoded traversal must not escape the asset whitelist
+        for path in ("/ui/..%2F..%2Fetc%2Fpasswd", "/ui/%2e%2e/secret"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                fetch(f"{server.url}{path}")
+            assert ei.value.code == 404
+
+
+class TestDataContract:
+    """The JSON shapes app.js renders from, via real HTTP."""
+
+    def _post(self, server, kind, manifest):
+        req = urllib.request.Request(
+            f"{server.url}/api/v1/{kind}", method="POST",
+            data=json.dumps(manifest).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def test_job_rows_and_detail(self, server, tmp_path):
+        script = tmp_path / "ok.py"
+        script.write_text("print('dashboard ok')\n")
+        manifest = {
+            "apiVersion": "kubeflow-tpu.org/v1", "kind": "JAXJob",
+            "metadata": {"name": "dashjob"},
+            "spec": {"replicaSpecs": {"worker": {
+                "replicas": 1,
+                "template": {"container": {
+                    "command": [sys.executable, str(script)]}},
+            }}},
+        }
+        self._post(server, "jobs", manifest)
+        from kubeflow_tpu.client import TrainingClient
+
+        TrainingClient(server.platform).wait_for_job_conditions(
+            "dashjob", timeout_s=60)
+        # list row fields the jobs table renders
+        code, _, body = fetch(f"{server.url}/api/v1/jobs")
+        rows = json.loads(body)
+        (job,) = [r for r in rows if r["metadata"]["name"] == "dashjob"]
+        assert job["kind"] == "JAXJob"
+        assert job["spec"]["replicaSpecs"]["worker"]["replicas"] == 1
+        conds = [c["type"] for c in job["status"]["conditions"] if c["status"]]
+        assert conds[-1] == "Succeeded"
+        # detail-pane extras: events + logs text
+        code, _, body = fetch(f"{server.url}/api/v1/events/default/dashjob")
+        assert code == 200 and json.loads(body)
+        code, ctype, body = fetch(
+            f"{server.url}/api/v1/jobs/default/dashjob/logs"
+            "?replicaType=worker&index=0")
+        assert code == 200
+        assert b"dashboard ok" in body
+
+    def test_trials_listed_with_experiment_label(self, server, tmp_path):
+        """The Katib view joins trials to experiments via the label — the
+        trials kind must be listable over REST and carry it."""
+        script = tmp_path / "trial.py"
+        script.write_text(
+            "import os\nprint(f'objective={float(os.environ[\"LR\"])}')\n")
+        trial_spec = yaml.safe_dump({
+            "apiVersion": "kubeflow-tpu.org/v1", "kind": "JAXJob",
+            "metadata": {"name": "t"},
+            "spec": {"replicaSpecs": {"worker": {
+                "replicas": 1,
+                "template": {"container": {
+                    "command": [sys.executable, str(script)],
+                    "env": {"LR": "${trialParameters.lr}"},
+                }},
+            }}},
+        })
+        manifest = {
+            "apiVersion": "kubeflow-tpu.org/v1beta1", "kind": "Experiment",
+            "metadata": {"name": "dashexp"},
+            "spec": {
+                "maxTrialCount": 2, "parallelTrialCount": 1,
+                "objective": {"type": "maximize",
+                              "objectiveMetricName": "objective"},
+                "algorithm": {"algorithmName": "random"},
+                "parameters": [{"name": "lr", "parameterType": "double",
+                                "feasibleSpace": {"min": "0.1", "max": "0.9"}}],
+                "trialTemplate": {
+                    "trialParameters": [{"name": "lr", "reference": "lr"}],
+                    "trialSpec": trial_spec,
+                },
+            },
+        }
+        self._post(server, "experiments", manifest)
+        from kubeflow_tpu.sweep import SweepClient
+
+        SweepClient(server.platform).wait_for_experiment("dashexp", timeout_s=120)
+        _, _, body = fetch(f"{server.url}/api/v1/trials")
+        trials = [t for t in json.loads(body)
+                  if (t["metadata"].get("labels") or {})
+                  .get("kubeflow-tpu.org/experiment-name") == "dashexp"]
+        assert len(trials) == 2
+        # chart inputs: observed objective values in trial status
+        vals = [m for t in trials
+                for m in t["status"]["observation"]["metrics"]
+                if m["name"] == "objective"]
+        assert len(vals) == 2
+        _, _, body = fetch(f"{server.url}/api/v1/experiments/default/dashexp")
+        exp = json.loads(body)
+        assert exp["status"]["currentOptimalTrial"]["trialName"]
+
+    def test_pipelinerun_body_carries_ir_for_dag(self, server):
+        """The DAG view reads spec.pipelineSpec.root.dag.tasks + status.tasks
+        from the same GET the table uses."""
+        from kubeflow_tpu.pipelines import component, pipeline
+        from kubeflow_tpu.pipelines.compiler import compile_pipeline
+
+        @component
+        def first() -> int:
+            return 2
+
+        @component
+        def second(x: int) -> int:
+            return x * 21
+
+        @pipeline(name="dashpipe")
+        def dashpipe():
+            a = first()
+            second(x=a)
+
+        ir = compile_pipeline(dashpipe())
+        self._post(server, "pipelineruns", {
+            "apiVersion": "kubeflow-tpu.org/v1", "kind": "PipelineRun",
+            "metadata": {"name": "dashrun"},
+            "spec": {"pipelineSpec": ir, "arguments": {}},
+        })
+        import time
+
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            _, _, body = fetch(
+                f"{server.url}/api/v1/pipelineruns/default/dashrun")
+            run = json.loads(body)
+            if run["status"]["state"] in ("Succeeded", "Failed"):
+                break
+            time.sleep(0.5)
+        assert run["status"]["state"] == "Succeeded"
+        tasks = run["spec"]["pipelineSpec"]["root"]["dag"]["tasks"]
+        assert set(tasks) == set(run["status"]["tasks"])
+        # the DAG edge the view draws
+        deps = {n: t.get("dependentTasks", []) for n, t in tasks.items()}
+        assert any(deps[n] for n in deps)
