@@ -31,6 +31,9 @@ class PredictorRuntime(str, enum.Enum):
     LIGHTGBM = "lightgbm"
     PADDLE = "paddle"
     PMML = "pmml"
+    # Triton-repository-shaped runtime (config.pbtxt + <version>/model.<ext>
+    # layout; triton is the OIP reference server, so it rides the v2 paths).
+    TRITON = "triton"
 
 
 @dataclass

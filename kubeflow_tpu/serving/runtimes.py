@@ -227,6 +227,261 @@ class PMMLModel(Model):
         return [self._model.predict(list(map(float, row))) for row in x]
 
 
+# --------------------------------------------------------- triton-shaped
+
+
+def parse_config_pbtxt(text: str) -> dict:
+    """Parse the subset of protobuf text format that triton's config.pbtxt
+    uses: scalar fields (`name: "x"`, `max_batch_size: 8`), enum tokens
+    (`data_type: TYPE_FP32`), repeated message blocks (`input [ {...} ]` or
+    repeated `input { ... }`), and numeric lists (`dims: [ 3, 224 ]`).
+    No protobuf dependency — the grammar is five constructs."""
+    import re
+
+    # strip '#' comments (legal and ubiquitous in triton configs) — but not
+    # inside quoted strings
+    stripped_lines = []
+    for line in text.splitlines():
+        out_chars: list[str] = []
+        in_str = False
+        i = 0
+        while i < len(line):
+            c = line[i]
+            if c == '"' and (i == 0 or line[i - 1] != "\\"):
+                in_str = not in_str
+            elif c == "#" and not in_str:
+                break
+            out_chars.append(c)
+            i += 1
+        stripped_lines.append("".join(out_chars))
+    text = "\n".join(stripped_lines)
+
+    pos = 0
+    tokens = re.findall(
+        r'"(?:[^"\\]|\\.)*"|[\[\]{}:,]|[A-Za-z_][\w.]*|-?\d+\.?\d*', text
+    )
+
+    def parse_value():
+        nonlocal pos
+        tok = tokens[pos]
+        if tok == "{":
+            return parse_block()
+        if tok == "[":
+            pos += 1
+            items = []
+            while tokens[pos] != "]":
+                if tokens[pos] == ",":
+                    pos += 1
+                    continue
+                items.append(parse_value())
+            pos += 1
+            return items
+        pos += 1
+        if tok.startswith('"'):
+            return tok[1:-1]
+        if re.fullmatch(r"-?\d+", tok):
+            return int(tok)
+        if re.fullmatch(r"-?\d+\.\d*", tok):
+            return float(tok)
+        if tok in ("true", "false"):
+            return tok == "true"
+        return tok  # enum token, e.g. TYPE_FP32
+
+    def parse_block():
+        nonlocal pos
+        assert tokens[pos] == "{"
+        pos += 1
+        out: dict = {}
+        # keys that became lists through REPETITION (vs. a '[...]' value):
+        # the distinction keeps a 3rd repeated block appending, not nesting
+        multi: set = set()
+        while tokens[pos] != "}":
+            key = tokens[pos]
+            pos += 1
+            if pos < len(tokens) and tokens[pos] == ":":
+                pos += 1
+            val = parse_value()
+            if key in out:
+                if key not in multi:
+                    out[key] = [out[key]]
+                    multi.add(key)
+                out[key].append(val)
+            else:
+                out[key] = val
+        pos += 1
+        return out
+
+    _REPEATED = {"input", "output", "instance_group"}
+    # wrap the file body in braces and reuse the block parser
+    tokens = ["{"] + tokens + ["}"]
+    cfg = parse_block()
+    # normalize repeated-message fields to lists
+    for key in _REPEATED:
+        if key in cfg and isinstance(cfg[key], dict):
+            cfg[key] = [cfg[key]]
+    return cfg
+
+
+TRITON_DTYPES = {
+    "TYPE_FP32": np.float32, "TYPE_FP64": np.float64,
+    "TYPE_FP16": np.float16, "TYPE_INT64": np.int64,
+    "TYPE_INT32": np.int32, "TYPE_INT16": np.int16, "TYPE_INT8": np.int8,
+    "TYPE_UINT8": np.uint8, "TYPE_BOOL": np.bool_,
+}
+
+
+class TritonModel(Model):
+    """Triton-repository-shaped runtime (kserve's triton ServingRuntime
+    analogue): serves a model laid out as
+
+        <model_dir>/config.pbtxt
+        <model_dir>/<version>/model.<ext>
+
+    with config.pbtxt declaring platform, max_batch_size, and typed
+    input/output tensors (the Open Inference Protocol contract — triton is
+    the OIP reference server, so this runtime rides our v2 endpoints
+    directly). The newest numeric version directory is loaded, as triton's
+    default version policy does. Backends:
+
+      - pytorch_libtorch: TorchScript model.pt (torch is in-image)
+      - onnxruntime_onnx / tensorrt_plan: gated (packages absent here)
+
+    Inputs: a dict name->array (multi-input) or a bare array (bound to the
+    single declared input); dtypes/shapes validated against config.pbtxt.
+    """
+
+    GATED_PLATFORMS = {
+        "onnxruntime_onnx": "onnxruntime",
+        "tensorrt_plan": "tensorrt (GPU-only — out of scope on TPU)",
+    }
+
+    def __init__(self, name: str, model_dir: str | Path):
+        super().__init__(name)
+        self.model_dir = Path(model_dir)
+        self.config: dict = {}
+        self._mod = None
+
+    # ------------------------------------------------------------- layout
+
+    def _pick_version(self) -> Path:
+        versions = sorted(
+            (p for p in self.model_dir.iterdir()
+             if p.is_dir() and p.name.isdigit()),
+            key=lambda p: int(p.name),
+        )
+        if not versions:
+            raise FileNotFoundError(
+                f"no numeric version directory under {self.model_dir} "
+                "(triton repository layout: <model>/<version>/model.<ext>)"
+            )
+        return versions[-1]
+
+    def load(self) -> None:
+        cfg_path = self.model_dir / "config.pbtxt"
+        if not cfg_path.exists():
+            raise FileNotFoundError(f"no config.pbtxt under {self.model_dir}")
+        self.config = parse_config_pbtxt(cfg_path.read_text())
+        platform = self.config.get("platform", "")
+        vdir = self._pick_version()
+        if platform == "pytorch_libtorch":
+            import torch
+
+            pt = vdir / "model.pt"
+            if not pt.exists():
+                raise FileNotFoundError(f"no model.pt under {vdir}")
+            self._mod = torch.jit.load(str(pt), map_location="cpu")
+            self._mod.eval()
+        elif platform in self.GATED_PLATFORMS:
+            raise ModuleNotFoundError(
+                f"triton platform {platform!r} requires "
+                f"{self.GATED_PLATFORMS[platform]}, absent in this image; "
+                "convert the model to pytorch_libtorch or the jax runtime"
+            )
+        else:
+            raise ValueError(
+                f"unsupported triton platform {platform!r} "
+                "(pytorch_libtorch|onnxruntime_onnx|tensorrt_plan)"
+            )
+        self.version = vdir.name
+        self.ready = True
+
+    # ------------------------------------------------------------ serving
+
+    def _input_specs(self) -> list[dict]:
+        return list(self.config.get("input", []))
+
+    def _validate(self, name: str, arr: np.ndarray, spec: dict) -> np.ndarray:
+        want = TRITON_DTYPES.get(spec.get("data_type", ""), None)
+        if want is not None and arr.dtype != np.dtype(want):
+            # safe widening/narrowing within a kind (f64->f32, i64->i32) and
+            # int->float are accepted; value-destroying casts (float->int,
+            # numeric->bool) are config mismatches, as triton rejects them
+            ok = np.can_cast(arr.dtype, want, casting="same_kind") or (
+                arr.dtype.kind in "iu" and np.dtype(want).kind == "f"
+            )
+            if not ok:
+                raise ValueError(
+                    f"input {name!r} dtype {arr.dtype} incompatible with "
+                    f"declared {spec.get('data_type')}"
+                )
+            arr = arr.astype(want)
+        dims = [int(d) for d in spec.get("dims", [])]
+        # config dims exclude the batch dim when max_batch_size > 0
+        batched = int(self.config.get("max_batch_size", 0)) > 0
+        got = list(arr.shape[1:]) if batched else list(arr.shape)
+        if dims and len(got) == len(dims):
+            for g, w in zip(got, dims):
+                if w != -1 and g != w:
+                    raise ValueError(
+                        f"input {name!r} shape {got} does not match "
+                        f"config.pbtxt dims {dims}"
+                    )
+        elif dims:
+            raise ValueError(
+                f"input {name!r} rank {len(got)} does not match "
+                f"config.pbtxt dims {dims}"
+            )
+        mbs = int(self.config.get("max_batch_size", 0))
+        if batched and mbs and arr.shape[0] > mbs:
+            raise ValueError(
+                f"batch {arr.shape[0]} exceeds max_batch_size {mbs}"
+            )
+        return arr
+
+    def predict(self, inputs):
+        import torch
+
+        specs = self._input_specs()
+        if isinstance(inputs, dict):
+            ordered = []
+            for spec in specs:
+                name = spec.get("name", "")
+                if name not in inputs:
+                    raise ValueError(f"missing input tensor {name!r}")
+                ordered.append(self._validate(
+                    name, np.asarray(inputs[name]), spec))
+        else:
+            arr = np.asarray(inputs)
+            if len(specs) > 1:
+                raise ValueError(
+                    f"model declares {len(specs)} inputs; pass a dict of "
+                    f"name->tensor ({[s.get('name') for s in specs]})"
+                )
+            ordered = [self._validate(
+                specs[0].get("name", "input"), arr, specs[0])] if specs else [arr]
+        with torch.no_grad():
+            out = self._mod(*(torch.as_tensor(a) for a in ordered))
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        out_specs = self.config.get("output", [])
+        names = [s.get("name", f"output_{i}") for i, s in enumerate(out_specs)]
+        # a model returning more tensors than config declares must not have
+        # the extras silently zip-truncated — name them positionally
+        names += [f"output_{i}" for i in range(len(names), len(outs))]
+        if len(outs) == 1 and not isinstance(inputs, dict):
+            return outs[0].numpy()
+        return {n: o.numpy().tolist() for n, o in zip(names, outs)}
+
+
 RUNTIMES: dict[str, type] = {
     "sklearn": SklearnModel,
     "torch": TorchModel,
@@ -234,6 +489,7 @@ RUNTIMES: dict[str, type] = {
     "lightgbm": LightGBMModel,
     "paddle": PaddleModel,
     "pmml": PMMLModel,
+    "triton": TritonModel,
 }
 
 

@@ -392,7 +392,7 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument(
         "--runtime", default="jax",
         choices=["jax", "custom", "sklearn", "torch", "xgboost", "lightgbm",
-                 "paddle", "pmml"],
+                 "paddle", "pmml", "triton"],
     )
     ap.add_argument("--model-class", default="")
     ap.add_argument("--transformer-class", default="")

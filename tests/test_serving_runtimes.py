@@ -123,3 +123,236 @@ class TestSklearnISVCEnd2End:
             with urllib.request.urlopen(req) as r:
                 out = json.loads(r.read())
             assert out["predictions"] == [0, 1]
+
+
+@pytest.fixture(scope="module")
+def triton_repo(tmp_path_factory):
+    """Triton model-repository layout: config.pbtxt + numeric version dirs
+    (the newest must win), pytorch_libtorch backend."""
+    import torch
+
+    d = tmp_path_factory.mktemp("triton") / "affine"
+    (d / "1").mkdir(parents=True)
+    (d / "3").mkdir()
+
+    class AffineV1(torch.nn.Module):
+        def forward(self, x):
+            return x * 2.0
+
+    class AffineV3(torch.nn.Module):
+        def forward(self, x):
+            return x * 2.0 + 1.0
+
+    torch.jit.script(AffineV1()).save(str(d / "1" / "model.pt"))
+    torch.jit.script(AffineV3()).save(str(d / "3" / "model.pt"))
+    (d / "config.pbtxt").write_text("""
+name: "affine"
+platform: "pytorch_libtorch"
+max_batch_size: 8
+input [
+  {
+    name: "INPUT0"
+    data_type: TYPE_FP32
+    dims: [ 4 ]
+  }
+]
+output [
+  {
+    name: "OUTPUT0"
+    data_type: TYPE_FP32
+    dims: [ 4 ]
+  }
+]
+""")
+    return d
+
+
+class TestTritonRuntime:
+    def test_parser_handles_pbtxt_grammar(self):
+        from kubeflow_tpu.serving.runtimes import parse_config_pbtxt
+
+        cfg = parse_config_pbtxt("""
+name: "m"
+platform: "pytorch_libtorch"
+max_batch_size: 16
+input [
+  { name: "a" data_type: TYPE_FP32 dims: [ -1, 3 ] },
+  { name: "b" data_type: TYPE_INT64 dims: [ 1 ] }
+]
+output { name: "out" data_type: TYPE_FP32 dims: [ 2 ] }
+instance_group { count: 2 kind: KIND_CPU }
+""")
+        assert cfg["name"] == "m" and cfg["max_batch_size"] == 16
+        assert [i["name"] for i in cfg["input"]] == ["a", "b"]
+        assert cfg["input"][0]["dims"] == [-1, 3]
+        assert cfg["input"][1]["data_type"] == "TYPE_INT64"
+        assert cfg["output"][0]["name"] == "out"
+        assert cfg["instance_group"][0]["kind"] == "KIND_CPU"
+
+    def test_newest_version_served(self, triton_repo):
+        m = build_runtime("triton", "affine", triton_repo)
+        m.load()
+        assert m.version == "3"
+        out = m.predict(np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(out, np.full((2, 4), 3.0))  # v3: 2x+1
+
+    def test_dict_input_and_named_output(self, triton_repo):
+        m = build_runtime("triton", "affine", triton_repo)
+        m.load()
+        out = m.predict({"INPUT0": np.zeros((1, 4), np.float32)})
+        np.testing.assert_allclose(out["OUTPUT0"], np.ones((1, 4)))
+
+    def test_shape_validated_against_config(self, triton_repo):
+        m = build_runtime("triton", "affine", triton_repo)
+        m.load()
+        with pytest.raises(ValueError, match="does not match"):
+            m.predict(np.ones((2, 5), np.float32))
+
+    def test_max_batch_size_enforced(self, triton_repo):
+        m = build_runtime("triton", "affine", triton_repo)
+        m.load()
+        with pytest.raises(ValueError, match="max_batch_size"):
+            m.predict(np.ones((9, 4), np.float32))
+
+    def test_missing_input_tensor_name(self, triton_repo):
+        m = build_runtime("triton", "affine", triton_repo)
+        m.load()
+        with pytest.raises(ValueError, match="INPUT0"):
+            m.predict({"WRONG": np.ones((1, 4), np.float32)})
+
+    def test_onnx_platform_gated(self, tmp_path):
+        d = tmp_path / "onnxm"
+        (d / "1").mkdir(parents=True)
+        (d / "config.pbtxt").write_text(
+            'name: "onnxm"\nplatform: "onnxruntime_onnx"\n')
+        m = build_runtime("triton", "onnxm", d)
+        with pytest.raises(ModuleNotFoundError, match="onnxruntime"):
+            m.load()
+
+    def test_missing_config_rejected(self, tmp_path):
+        m = build_runtime("triton", "empty", tmp_path)
+        with pytest.raises(FileNotFoundError, match="config.pbtxt"):
+            m.load()
+
+    def test_missing_version_dir_rejected(self, tmp_path):
+        d = tmp_path / "noversion"
+        d.mkdir()
+        (d / "config.pbtxt").write_text(
+            'name: "m"\nplatform: "pytorch_libtorch"\n')
+        m = build_runtime("triton", "m", d)
+        with pytest.raises(FileNotFoundError, match="version"):
+            m.load()
+
+
+class TestTritonISVCEnd2End:
+    def test_v2_infer_through_platform(self, triton_repo, tmp_path):
+        """InferenceService with runtime=triton through the platform:
+        controller -> server pod -> storage init (repo dir) -> v2 infer —
+        the OIP path triton itself defines."""
+        import json
+        import urllib.request
+
+        from kubeflow_tpu.client import Platform
+        from kubeflow_tpu.serving import ServingClient
+        from kubeflow_tpu.serving.api import (
+            InferenceService,
+            InferenceServiceSpec,
+            PredictorRuntime,
+            PredictorSpec,
+        )
+        from kubeflow_tpu.api.common import ObjectMeta
+
+        with Platform(log_dir=str(tmp_path / "pod-logs")) as p:
+            serving = ServingClient(p)
+            serving.create(InferenceService(
+                metadata=ObjectMeta(name="triton-svc"),
+                spec=InferenceServiceSpec(predictor=PredictorSpec(
+                    runtime=PredictorRuntime.TRITON,
+                    storage_uri=f"file://{triton_repo}",
+                )),
+            ))
+            ready = serving.wait_ready("triton-svc", timeout_s=90)
+            body = {
+                "inputs": [{
+                    "name": "INPUT0", "shape": [2, 4],
+                    "datatype": "FP32",
+                    "data": [[1.0] * 4, [0.0] * 4],
+                }]
+            }
+            req = urllib.request.Request(
+                f"{ready.status.url}/v2/models/triton-svc/infer",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                out = json.loads(r.read())
+            (tensor,) = out["outputs"]
+            flat = np.asarray(tensor["data"], np.float32).reshape(2, 4)
+            np.testing.assert_allclose(flat[0], 3.0)  # v3 affine: 2x+1
+            np.testing.assert_allclose(flat[1], 1.0)
+
+
+class TestTritonConfigParserEdgeCases:
+    def test_comments_stripped_outside_strings(self):
+        from kubeflow_tpu.serving.runtimes import parse_config_pbtxt
+
+        cfg = parse_config_pbtxt("""
+# the input is NCHW layout
+name: "m"  # trailing comment
+platform: "pytorch_libtorch"
+input { name: "has#hash" dims: [ 2 ] }  # '#' inside the string survives
+""")
+        assert cfg["name"] == "m"
+        assert cfg["platform"] == "pytorch_libtorch"
+        assert cfg["input"][0]["name"] == "has#hash"
+
+    def test_repeated_non_whitelisted_blocks_accumulate_flat(self):
+        from kubeflow_tpu.serving.runtimes import parse_config_pbtxt
+
+        cfg = parse_config_pbtxt("""
+name: "m"
+parameters { key: "a" }
+parameters { key: "b" }
+parameters { key: "c" }
+""")
+        assert cfg["parameters"] == [
+            {"key": "a"}, {"key": "b"}, {"key": "c"}]
+
+    def test_float_to_int_input_rejected_not_truncated(self, triton_repo):
+        from kubeflow_tpu.serving.runtimes import TritonModel
+
+        m = TritonModel("affine", triton_repo)
+        m.load()
+        # config declares TYPE_FP32; int input widens fine
+        out = m.predict(np.ones((1, 4), np.int64))
+        np.testing.assert_allclose(out, 3.0)
+        # but a float input against an int-declared spec must be rejected
+        m.config["input"][0]["data_type"] = "TYPE_INT32"
+        with pytest.raises(ValueError, match="incompatible"):
+            m.predict(np.array([[3.7, 1.2, 0.0, 1.0]], np.float64))
+
+    def test_extra_outputs_named_not_dropped(self, tmp_path):
+        import torch
+        from kubeflow_tpu.serving.runtimes import TritonModel
+
+        d = tmp_path / "twohead"
+        (d / "1").mkdir(parents=True)
+
+        class TwoHead(torch.nn.Module):
+            def forward(self, x):
+                return x * 2.0, x + 1.0
+
+        torch.jit.script(TwoHead()).save(str(d / "1" / "model.pt"))
+        (d / "config.pbtxt").write_text("""
+name: "twohead"
+platform: "pytorch_libtorch"
+max_batch_size: 4
+input [ { name: "X" data_type: TYPE_FP32 dims: [ 2 ] } ]
+output [ { name: "DOUBLED" data_type: TYPE_FP32 dims: [ 2 ] } ]
+""")
+        m = TritonModel("twohead", d)
+        m.load()
+        out = m.predict({"X": np.ones((1, 2), np.float32)})
+        assert set(out) == {"DOUBLED", "output_1"}
+        np.testing.assert_allclose(out["DOUBLED"], [[2.0, 2.0]])
+        np.testing.assert_allclose(out["output_1"], [[2.0, 2.0]])
