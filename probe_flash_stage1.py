@@ -1,0 +1,185 @@
+"""Minimal Mosaic flash-backward NaN bisect — term isolation ONLY.
+
+Both backward impls (scratch accumulators AND fori-loop) NaN identically on
+hardware (probe_flash_fix r3: dq/dk/dbias NaN, dv clean, interpret passes),
+so the bug is in the shared ds = p*(dp - dd) term path, not the grid-revisit
+machinery. This probe emits each intermediate from a grid=(1,) kernel so a
+single short tunnel window localizes the NaN-producing term. Variants cover
+the remaining deltas to the real kernel: the bias-row operand/add and a
+multi-(batch*head) grid.
+
+Every term prints its own RESULT line immediately — a partial window still
+bisects. CPU interpret mode passes all terms (verified before queueing).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+
+WATCHDOG_S = 300.0
+_last = [time.monotonic()]
+
+
+def _pet():
+    _last[0] = time.monotonic()
+
+
+def _watchdog():
+    while True:
+        time.sleep(5.0)
+        if time.monotonic() - _last[0] > WATCHDOG_S:
+            print("RESULT watchdog=hang", flush=True)
+            os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if os.environ.get("KFT_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["KFT_BENCH_PLATFORM"])
+
+    interpret = jax.default_backend() == "cpu"
+    print(f"RESULT backend={jax.default_backend()} interpret={interpret}",
+          flush=True)
+    float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
+    _pet()
+
+    block = 256
+    d = 64
+    scale = 1.0 / (d ** 0.5)
+
+    def born(*shape, key, dtype=jnp.bfloat16):
+        x = jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+        return jax.jit(lambda v: (v * 0.125).astype(dtype))(x)
+
+    q = born(1, block, d, key=0)
+    k = born(1, block, d, key=1)
+    v = born(1, block, d, key=2)
+    do = born(1, block, d, key=3)
+    bias = jnp.zeros((1, 1, 1, block), jnp.bfloat16)
+    s_full = (q[0].astype(jnp.float32) @ k[0].astype(jnp.float32).T) * scale
+    lse_host = jax.nn.logsumexp(s_full, axis=-1, keepdims=True)
+    p_host = jnp.exp(s_full - lse_host)
+    o_host = p_host @ v[0].astype(jnp.float32)
+    dd_host = (do[0].astype(jnp.float32) * o_host).sum(-1, keepdims=True)
+    lse = jax.device_put(lse_host[None])        # (1, block, 1) f32
+    dd = jax.device_put(dd_host[None])          # (1, block, 1) f32
+
+    def nan_count(x):
+        return int(jnp.isnan(x.astype(jnp.float32)).sum())
+
+    # Each term is its own kernel; dead inputs get DCE'd so each RESULT line
+    # isolates exactly the live dataflow for that term.
+    def term_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, bias_ref,
+                    out_ref, *, term: str):
+        qb = q_ref[0]
+        kb = k_ref[0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if term.endswith("_bias"):
+            s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        base = term.replace("_bias", "")
+        if base == "p":
+            out_ref[0] = p
+        elif base == "dp":
+            out_ref[0] = dp
+        elif base == "ddb":
+            out_ref[0] = jnp.broadcast_to(dd_ref[0], (block, block))
+        elif base == "dpmdd":
+            out_ref[0] = dp - dd_ref[0]
+        elif base == "ds":
+            out_ref[0] = p * (dp - dd_ref[0])
+        elif base == "dq":
+            ds = p * (dp - dd_ref[0])
+            out_ref[0] = jax.lax.dot_general(
+                ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    terms = ("p", "dp", "ddb", "dpmdd", "ds", "dq", "ds_bias", "dq_bias")
+    for term in terms:
+        out_last = d if term.replace("_bias", "") == "dq" else block
+        try:
+            out = pl.pallas_call(
+                functools.partial(term_kernel, term=term),
+                grid=(1,),
+                in_specs=[
+                    pl.BlockSpec((1, block, d), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, d), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, d), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, d), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, 1), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, block, 1), lambda i: (0, 0, 0)),
+                    pl.BlockSpec((1, 1, 1, block), lambda i: (0, 0, 0, 0)),
+                ],
+                out_specs=pl.BlockSpec((1, block, out_last),
+                                       lambda i: (0, 0, 0)),
+                out_shape=jax.ShapeDtypeStruct((1, block, out_last),
+                                               jnp.float32),
+                interpret=interpret,
+            )(q, k, v, do, lse, dd, bias)
+            print(f"RESULT stage1_{term}_nan={nan_count(out)}"
+                  f" max={float(jnp.nanmax(jnp.abs(out))):.4g}", flush=True)
+        except Exception as exc:  # noqa: BLE001 — verdict line, keep going
+            print(f"RESULT stage1_{term}=ERROR {type(exc).__name__}",
+                  flush=True)
+        _pet()
+
+    # multi-bh grid over the full ds term (bias in): the shape the real dq
+    # kernel runs at minus the kv-block axis
+    bh = 4
+    qm = born(bh, block, d, key=20)
+    km = born(bh, block, d, key=21)
+    vm = born(bh, block, d, key=22)
+    dom = born(bh, block, d, key=23)
+    biasm = jnp.zeros((bh, 1, 1, block), jnp.bfloat16)
+    sm = jnp.einsum("bqd,bkd->bqk", qm.astype(jnp.float32),
+                    km.astype(jnp.float32)) * scale
+    lsem_h = jax.nn.logsumexp(sm, axis=-1, keepdims=True)
+    pm = jnp.exp(sm - lsem_h)
+    om = jnp.einsum("bqk,bkd->bqd", pm, vm.astype(jnp.float32))
+    ddm_h = (dom.astype(jnp.float32) * om).sum(-1, keepdims=True)
+    lsem = jax.device_put(lsem_h)
+    ddm = jax.device_put(ddm_h)
+
+    try:
+        out = pl.pallas_call(
+            functools.partial(term_kernel, term="dq_bias"),
+            grid=(bh,),
+            in_specs=[
+                pl.BlockSpec((1, block, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block, 1), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block, 1), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, 1, 1, block), lambda i: (i, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block, d), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, block, d), jnp.float32),
+            interpret=interpret,
+        )(qm, km, vm, dom, lsem, ddm, biasm)
+        print(f"RESULT stage1_dq_bhgrid_nan={nan_count(out)}"
+              f" max={float(jnp.nanmax(jnp.abs(out))):.4g}", flush=True)
+    except Exception as exc:  # noqa: BLE001
+        print(f"RESULT stage1_dq_bhgrid=ERROR {type(exc).__name__}",
+              flush=True)
+    _pet()
+
+    print("RESULT probe_flash_stage1=complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()
