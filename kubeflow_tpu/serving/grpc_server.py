@@ -120,50 +120,64 @@ class InferenceGrpcService:
             ctx.abort(grpc.StatusCode.NOT_FOUND, f"model {name!r} not found")
         if not m.ready:
             ctx.abort(grpc.StatusCode.UNAVAILABLE, f"model {name!r} not ready")
-        if len(req.inputs) != 1:
+        if not req.inputs:
             ctx.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                f"exactly one input tensor expected, got {len(req.inputs)} "
-                f"(single-input model contract, matching the HTTP v2 surface)",
+                "request must carry at least one input tensor",
             )
-        t = req.inputs[0]
-        if t.datatype not in _DT:
+        if req.raw_input_contents and \
+                len(req.raw_input_contents) != len(req.inputs):
             ctx.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                f"unsupported datatype {t.datatype!r} (supported: {sorted(_DT)})",
+                f"raw_input_contents carries {len(req.raw_input_contents)} "
+                f"blobs for {len(req.inputs)} inputs (all-raw or all-typed)",
             )
-        want = 1
-        for d in t.shape:
-            want *= d
-        raw = req.raw_input_contents[0] if req.raw_input_contents else None
-        if raw is not None:
-            itemsize = np.dtype(_DT[t.datatype][0]).itemsize
-            if len(raw) != want * itemsize:
+        raw0 = req.raw_input_contents[0] if req.raw_input_contents else None
+        decoded: list[np.ndarray] = []
+        for i, t in enumerate(req.inputs):
+            if t.datatype not in _DT:
                 ctx.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
-                    f"raw_input_contents[0] carries {len(raw)} bytes but "
-                    f"shape {list(t.shape)} x {t.datatype} needs "
-                    f"{want * itemsize}",
+                    f"unsupported datatype {t.datatype!r} "
+                    f"(supported: {sorted(_DT)})",
                 )
-        else:
-            field = _DT[t.datatype][1]
-            got = len(getattr(t.contents, field))
-            if got != want:
-                ctx.abort(
-                    grpc.StatusCode.INVALID_ARGUMENT,
-                    f"{field} carries {got} elements but shape {list(t.shape)} "
-                    f"needs {want}",
-                )
+            want = 1
+            for d in t.shape:
+                want *= d
+            raw = req.raw_input_contents[i] if req.raw_input_contents else None
+            if raw is not None:
+                itemsize = np.dtype(_DT[t.datatype][0]).itemsize
+                if len(raw) != want * itemsize:
+                    ctx.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        f"raw_input_contents[{i}] carries {len(raw)} bytes "
+                        f"but shape {list(t.shape)} x {t.datatype} needs "
+                        f"{want * itemsize}",
+                    )
+            else:
+                field = _DT[t.datatype][1]
+                got = len(getattr(t.contents, field))
+                if got != want:
+                    ctx.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        f"{field} carries {got} elements but shape "
+                        f"{list(t.shape)} needs {want}",
+                    )
+            decoded.append(_to_array(t, raw))
         t0 = _time.perf_counter()
         try:
-            arr = _to_array(t, raw)
+            if len(decoded) == 1:
+                arr = decoded[0]
+            else:  # multi-input model: route by declared tensor names
+                arr = {t.name or f"input-{i}": a
+                       for i, (t, a) in enumerate(zip(req.inputs, decoded))}
             out = self.ms._call_model(m, arr)
         except Exception as exc:  # noqa: BLE001 — surface as INTERNAL, not a crash
             self.ms.logger.log(name, "v2-grpc", 500,
                                _time.perf_counter() - t0, req.ByteSize(), 0)
             ctx.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
         arrays = self.ms.postprocess_arrays(out)  # shared with HTTP v2
-        if raw is not None:
+        if raw0 is not None:
             # raw in -> raw out (the triton client convention: a client that
             # speaks raw_input_contents reads raw_output_contents)
             outputs, raws = [], []

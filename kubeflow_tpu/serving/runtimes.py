@@ -257,31 +257,56 @@ def parse_config_pbtxt(text: str) -> dict:
     text = "\n".join(stripped_lines)
 
     pos = 0
-    tokens = re.findall(
-        r'"(?:[^"\\]|\\.)*"|[\[\]{}:,]|[A-Za-z_][\w.]*|-?\d+\.?\d*', text
+    # every character must land in a token — unmatched input raises instead
+    # of silently desynchronizing the parser (text format has no recovery)
+    _NUM = r"-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?"
+    token_re = re.compile(
+        r'\s*(?:("(?:[^"\\]|\\.)*")|([\[\]{}:,])|([A-Za-z_][\w.]*)'
+        rf"|({_NUM}))"
     )
+    tokens: list[str] = []
+    scan = 0
+    while scan < len(text):
+        m = token_re.match(text, scan)
+        if m is None or m.end() == m.start():
+            rest = text[scan:].lstrip()
+            if not rest:
+                break
+            raise ValueError(
+                f"config.pbtxt parse error near {rest[:20]!r} "
+                f"(offset {scan})"
+            )
+        tok = next(g for g in m.groups() if g is not None)
+        tokens.append(tok)
+        scan = m.end()
+
+    _num_int = re.compile(r"-?\d+")
 
     def parse_value():
         nonlocal pos
+        if pos >= len(tokens):
+            raise ValueError("config.pbtxt truncated: value expected")
         tok = tokens[pos]
         if tok == "{":
             return parse_block()
         if tok == "[":
             pos += 1
             items = []
-            while tokens[pos] != "]":
+            while pos < len(tokens) and tokens[pos] != "]":
                 if tokens[pos] == ",":
                     pos += 1
                     continue
                 items.append(parse_value())
+            if pos >= len(tokens):
+                raise ValueError("config.pbtxt truncated: unclosed '['")
             pos += 1
             return items
         pos += 1
         if tok.startswith('"'):
             return tok[1:-1]
-        if re.fullmatch(r"-?\d+", tok):
+        if _num_int.fullmatch(tok):
             return int(tok)
-        if re.fullmatch(r"-?\d+\.\d*", tok):
+        if re.fullmatch(_NUM, tok):
             return float(tok)
         if tok in ("true", "false"):
             return tok == "true"
@@ -291,25 +316,30 @@ def parse_config_pbtxt(text: str) -> dict:
         nonlocal pos
         assert tokens[pos] == "{"
         pos += 1
-        out: dict = {}
-        # keys that became lists through REPETITION (vs. a '[...]' value):
-        # the distinction keeps a 3rd repeated block appending, not nesting
-        multi: set = set()
-        while tokens[pos] != "}":
+        # text-format repeated-field semantics: every occurrence contributes
+        # items ('[...]' contributes its elements, anything else one item);
+        # repeats CONCATENATE — `dims: [2] dims: [3]` == `dims: [2, 3]`
+        items: dict[str, list] = {}
+        listy: set[str] = set()
+        while pos < len(tokens) and tokens[pos] != "}":
             key = tokens[pos]
             pos += 1
             if pos < len(tokens) and tokens[pos] == ":":
                 pos += 1
+            was_bracket = pos < len(tokens) and tokens[pos] == "["
             val = parse_value()
-            if key in out:
-                if key not in multi:
-                    out[key] = [out[key]]
-                    multi.add(key)
-                out[key].append(val)
+            new = val if was_bracket else [val]
+            if key in items:
+                items[key].extend(new)
+                listy.add(key)
             else:
-                out[key] = val
+                items[key] = new
+                if was_bracket:
+                    listy.add(key)
+        if pos >= len(tokens):
+            raise ValueError("config.pbtxt truncated: unclosed '{'")
         pos += 1
-        return out
+        return {k: v if k in listy else v[0] for k, v in items.items()}
 
     _REPEATED = {"input", "output", "instance_group"}
     # wrap the file body in braces and reuse the block parser
@@ -427,7 +457,8 @@ class TritonModel(Model):
             arr = arr.astype(want)
         dims = [int(d) for d in spec.get("dims", [])]
         # config dims exclude the batch dim when max_batch_size > 0
-        batched = int(self.config.get("max_batch_size", 0)) > 0
+        mbs = int(self.config.get("max_batch_size", 0))
+        batched = mbs > 0
         got = list(arr.shape[1:]) if batched else list(arr.shape)
         if dims and len(got) == len(dims):
             for g, w in zip(got, dims):
@@ -441,8 +472,7 @@ class TritonModel(Model):
                 f"input {name!r} rank {len(got)} does not match "
                 f"config.pbtxt dims {dims}"
             )
-        mbs = int(self.config.get("max_batch_size", 0))
-        if batched and mbs and arr.shape[0] > mbs:
+        if batched and arr.shape[0] > mbs:
             raise ValueError(
                 f"batch {arr.shape[0]} exceeds max_batch_size {mbs}"
             )
@@ -479,7 +509,9 @@ class TritonModel(Model):
         names += [f"output_{i}" for i in range(len(names), len(outs))]
         if len(outs) == 1 and not isinstance(inputs, dict):
             return outs[0].numpy()
-        return {n: o.numpy().tolist() for n, o in zip(names, outs)}
+        # named arrays: ModelServer.postprocess_arrays carries these through
+        # the v2 surfaces as one output tensor per name
+        return {n: o.numpy() for n, o in zip(names, outs)}
 
 
 RUNTIMES: dict[str, type] = {

@@ -101,9 +101,13 @@ class ModelServer:
             b.stop()
         return self.models.pop(name, None) is not None
 
-    def _call_model(self, m: Model, arr: np.ndarray):
+    def _call_model(self, m: Model, arr):
+        # dict inputs (multi-input models) cannot coalesce on a shared batch
+        # axis — they bypass the adaptive batcher
         batcher = self._batchers.get(m.name)
-        return batcher(arr) if batcher is not None else m(arr)
+        if batcher is not None and not isinstance(arr, dict):
+            return batcher(arr)
+        return m(arr)
 
     # ----------------------------------------------------------- lifecycle
 
@@ -250,11 +254,18 @@ class ModelServer:
     def postprocess_arrays(out) -> list[tuple[str, np.ndarray]]:
         """Normalize a model's output into named v2 tensors — the ONE place
         both the HTTP and gRPC v2 surfaces get their output contract from."""
-        if isinstance(out, dict):  # classification postprocess contract
-            return [
-                ("predictions", np.asarray(out["predictions"])),
-                ("logits", np.asarray(out.get("logits", []), dtype=np.float32)),
-            ]
+        if isinstance(out, dict):
+            # the classification postprocess contract is exactly
+            # {predictions[, logits]}; any other key set is a generic
+            # named-output model (e.g. triton multi-output) and every
+            # tensor must survive
+            if "predictions" in out and set(out) <= {"predictions", "logits"}:
+                return [
+                    ("predictions", np.asarray(out["predictions"])),
+                    ("logits",
+                     np.asarray(out.get("logits", []), dtype=np.float32)),
+                ]
+            return [(str(k), np.asarray(v)) for k, v in out.items()]
         return [("output-0", np.asarray(out))]
 
     @staticmethod
@@ -288,8 +299,13 @@ class ModelServer:
             out = self._call_model(m, np.asarray(instances))
         except Exception as exc:  # noqa: BLE001 — surface as 500, keep serving
             return 500, {"error": f"{type(exc).__name__}: {exc}"}
-        if isinstance(out, dict) and "predictions" in out:
-            return 200, out
+        if isinstance(out, dict):
+            # ndarray values (multi-output runtimes) must be JSON-ready
+            body = {k: v.tolist() if isinstance(v, np.ndarray) else v
+                    for k, v in out.items()}
+            if "predictions" in body:
+                return 200, body
+            return 200, {"predictions": body}
         return 200, {"predictions": np.asarray(out).tolist()}
 
     def _explain_v1(self, name: str, body: dict) -> tuple[int, dict]:
@@ -318,11 +334,19 @@ class ModelServer:
         inputs = body.get("inputs") or []
         if not inputs:
             return 400, {"error": "v2 request must carry 'inputs'"}
-        t = inputs[0]
-        try:
-            arr = np.asarray(
-                t["data"], dtype=_V2_TO_NP.get(t.get("datatype", "FP32"), np.float32)
+
+        def decode(t: dict) -> np.ndarray:
+            return np.asarray(
+                t["data"],
+                dtype=_V2_TO_NP.get(t.get("datatype", "FP32"), np.float32),
             ).reshape(t["shape"])
+
+        try:
+            if len(inputs) == 1:
+                arr = decode(inputs[0])
+            else:  # multi-input model: route by declared tensor names
+                arr = {t.get("name", f"input-{i}"): decode(t)
+                       for i, t in enumerate(inputs)}
             out = self._call_model(m, arr)
         except Exception as exc:  # noqa: BLE001
             return 500, {"error": f"{type(exc).__name__}: {exc}"}

@@ -53,3 +53,28 @@ class SignExplainer(Model):
         preds = np.asarray(self.predict_fn(np.asarray(inputs)))
         return {"explanations": np.sign(preds).tolist(),
                 "predictions": preds.tolist()}
+
+
+class AffinePairModel(Model):
+    """Two named inputs a,b -> a*2 + b — exercises the multi-input v2 path
+    (HTTP and gRPC route >1 input tensors as a name->array dict)."""
+
+    def load(self):
+        self.ready = True
+
+    def predict(self, inputs):
+        if not isinstance(inputs, dict):
+            raise ValueError("model declares 2 inputs; pass a dict (a, b)")
+        return np.asarray(inputs["a"]) * 2.0 + np.asarray(inputs["b"])
+
+
+class TwoOutModel(Model):
+    """Generic named multi-output dict (no 'predictions' key) — exercises
+    postprocess_arrays emitting one v2 output tensor per name."""
+
+    def load(self):
+        self.ready = True
+
+    def predict(self, inputs):
+        x = np.asarray(inputs)
+        return {"doubled": x * 2.0, "plus1": x + 1.0}

@@ -331,3 +331,70 @@ class TestISVCE2E:
         np.testing.assert_allclose(
             np.asarray(out["logits"], np.float32), expected, rtol=1e-4
         )
+
+
+class TestMultiTensorV2:
+    """Multi-input requests and generic named multi-output responses over
+    the v2 HTTP surface (the contract multi-tensor runtimes like triton
+    serve through)."""
+
+    @pytest.fixture()
+    def mt_server(self):
+        from tests.serving_fixtures import AffinePairModel, TwoOutModel
+
+        s = ModelServer(
+            [AffinePairModel("pair"), TwoOutModel("twoout")], port=0
+        )
+        s.start()
+        yield s
+        s.stop()
+
+    def test_v2_multi_input_routed_by_name(self, mt_server):
+        code, body = _post(
+            f"{mt_server.url}/v2/models/pair/infer",
+            {"inputs": [
+                {"name": "a", "shape": [1, 2], "datatype": "FP32",
+                 "data": [1.0, 2.0]},
+                {"name": "b", "shape": [1, 2], "datatype": "FP32",
+                 "data": [10.0, 20.0]},
+            ]},
+        )
+        assert code == 200
+        assert body["outputs"][0]["data"] == [12.0, 24.0]
+
+    def test_v2_single_input_against_multi_model_is_500_not_crash(
+            self, mt_server):
+        code, body = _post(
+            f"{mt_server.url}/v2/models/pair/infer",
+            {"inputs": [{"name": "a", "shape": [1], "datatype": "FP32",
+                         "data": [1.0]}]},
+        )
+        assert code == 500 and "dict" in body["error"]
+
+    def test_v2_multi_output_one_tensor_per_name(self, mt_server):
+        code, body = _post(
+            f"{mt_server.url}/v2/models/twoout/infer",
+            {"inputs": [{"name": "x", "shape": [2], "datatype": "FP32",
+                         "data": [1.0, 2.0]}]},
+        )
+        assert code == 200
+        by_name = {o["name"]: o["data"] for o in body["outputs"]}
+        assert by_name == {"doubled": [2.0, 4.0], "plus1": [2.0, 3.0]}
+
+    def test_v1_predict_multi_output_dict_serializes(self, mt_server):
+        code, body = _post(
+            f"{mt_server.url}/v1/models/twoout:predict",
+            {"instances": [1.0, 2.0]},
+        )
+        assert code == 200
+        assert body["predictions"] == {"doubled": [2.0, 4.0],
+                                       "plus1": [2.0, 3.0]}
+
+    def test_v2_output_named_predictions_keeps_siblings(self, mt_server):
+        from kubeflow_tpu.serving.server import ModelServer
+        import numpy as np
+
+        arrays = ModelServer.postprocess_arrays(
+            {"predictions": np.array([1.0]), "scores": np.array([0.5])}
+        )
+        assert [k for k, _ in arrays] == ["predictions", "scores"]

@@ -179,3 +179,36 @@ def test_isvc_grpc_predictor_end_to_end(tmp_path):
             np.testing.assert_allclose(out["output-0"], [[10.0]])
         finally:
             client.close()
+
+
+class TestGrpcMultiInput:
+    def test_two_typed_inputs_routed_as_dict(self, tmp_path):
+        from kubeflow_tpu.protos import inference_pb2 as pb
+        from tests.serving_fixtures import AffinePairModel
+
+        m = AffinePairModel(name="pair")
+        m.load()
+        ms = ModelServer(
+            models=[m], port=0,
+            request_log_path=str(tmp_path / "reqs.jsonl"),
+        )
+        server, addr = serve_grpc(ms, port=0)
+        try:
+            chan = grpc.insecure_channel(addr)
+            req = pb.ModelInferRequest(model_name="pair")
+            for name, vals in (("a", [1.0, 2.0]), ("b", [10.0, 20.0])):
+                t = pb.ModelInferRequest.InferInputTensor(
+                    name=name, datatype="FP32", shape=[1, 2])
+                t.contents.fp32_contents.extend(vals)
+                req.inputs.append(t)
+            resp = chan.unary_unary(
+                "/inference.GRPCInferenceService/ModelInfer",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ModelInferResponse.FromString,
+            )(req, timeout=10)
+            out = resp.outputs[0]
+            assert list(out.contents.fp32_contents) == [12.0, 24.0]
+        finally:
+            chan.close()
+            server.stop(grace=None)
+            ms.logger.close()

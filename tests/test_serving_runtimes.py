@@ -356,3 +356,40 @@ output [ { name: "DOUBLED" data_type: TYPE_FP32 dims: [ 2 ] } ]
         assert set(out) == {"DOUBLED", "output_1"}
         np.testing.assert_allclose(out["DOUBLED"], [[2.0, 2.0]])
         np.testing.assert_allclose(out["output_1"], [[2.0, 2.0]])
+
+
+class TestParserHardening:
+    """Text-format corners triton itself accepts must parse (or fail with a
+    named error, never a desynchronized IndexError)."""
+
+    def test_exponent_floats(self):
+        from kubeflow_tpu.serving.runtimes import parse_config_pbtxt
+
+        cfg = parse_config_pbtxt("""
+name: "m"
+parameters { key: "thr" value: 1e6 }
+parameters { key: "lo" value: 1.5e-3 }
+parameters { key: "dot" value: .5 }
+""")
+        vals = [p["value"] for p in cfg["parameters"]]
+        assert vals == [1e6, 1.5e-3, 0.5]
+
+    def test_repeated_scalar_field_concatenates(self):
+        from kubeflow_tpu.serving.runtimes import parse_config_pbtxt
+
+        cfg = parse_config_pbtxt('input { name: "a" dims: [2] dims: [3] }')
+        assert cfg["input"][0]["dims"] == [2, 3]
+        cfg = parse_config_pbtxt('input { name: "a" dims: 2 dims: 3 }')
+        assert cfg["input"][0]["dims"] == [2, 3]
+
+    def test_garbage_raises_named_parse_error(self):
+        from kubeflow_tpu.serving.runtimes import parse_config_pbtxt
+
+        with pytest.raises(ValueError, match="parse error"):
+            parse_config_pbtxt('name: "m"\nmax_batch_size: 8 @oops')
+
+    def test_truncated_config_raises_named_error(self):
+        from kubeflow_tpu.serving.runtimes import parse_config_pbtxt
+
+        with pytest.raises(ValueError, match="truncated"):
+            parse_config_pbtxt('input { name: "a" dims: [2')
