@@ -95,6 +95,7 @@ MANIFEST_KINDS = {
     "PipelineRun": "pipelineruns",
     "Notebook": "notebooks",
     "PVCViewer": "pvcviewers",
+    "AccessBinding": "bindings",
 }
 
 

@@ -94,9 +94,11 @@ def _deserialize(manifest: dict):
         from kubeflow_tpu.pipelines.crd import pipelinerun_from_dict
 
         return bucket, pipelinerun_from_dict(manifest)
-    # plain dataclass kinds: PodDefault / Tensorboard / Notebook / PVCViewer
+    # plain dataclass kinds: PodDefault / Tensorboard / Notebook /
+    # PVCViewer / AccessBinding
     from kubeflow_tpu.api.serde import _from_dict
     from kubeflow_tpu.controller.devservers import Notebook, PVCViewer
+    from kubeflow_tpu.controller.kfam import AccessBinding, validate_binding
     from kubeflow_tpu.controller.poddefault import PodDefault
     from kubeflow_tpu.controller.tensorboard import Tensorboard
 
@@ -105,10 +107,17 @@ def _deserialize(manifest: dict):
         "tensorboards": Tensorboard,
         "notebooks": Notebook,
         "pvcviewers": PVCViewer,
+        "bindings": AccessBinding,
     }[bucket]
     body = {k: v for k, v in manifest.items() if k not in ("kind", "apiVersion")}
     body.pop("status", None)
-    return bucket, _from_dict(cls, body)
+    obj = _from_dict(cls, body)
+    if bucket == "bindings":
+        try:
+            validate_binding(obj)
+        except ValueError as exc:
+            raise ValidationError("binding", str(exc)) from exc
+    return bucket, obj
 
 
 class _Html(str):
@@ -212,7 +221,8 @@ class PlatformServer:
 
     # ------------------------------------------------------------- routing
 
-    def handle(self, method: str, path: str, body: dict | None) -> tuple[int, object]:
+    def handle(self, method: str, path: str, body: dict | None,
+               user: str = "") -> tuple[int, object]:
         cluster = self.platform.cluster
         parsed = urllib.parse.urlparse(path)
         query = dict(urllib.parse.parse_qsl(parsed.query))
@@ -220,6 +230,8 @@ class PlatformServer:
 
         if parsed.path == "/healthz" or parsed.path == "/readyz":
             return 200, {"ok": True}
+        if parsed.path == "/kfam/v1/bindings":
+            return self._handle_kfam(method, query, body, user)
         if parsed.path == "/ui/plain":
             # explicit marker type — the reply path must NEVER sniff
             # content types from payload bytes (pod logs are attacker text)
@@ -247,6 +259,40 @@ class PlatformServer:
         if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
             return 404, {"error": f"no route {parsed.path!r}"}
         kind = parts[2]
+
+        # -------- kfam authz: every namespaced verb maps here, BEFORE any
+        # route handling, so new routes are covered by construction. Only
+        # enforced when the caller asserts an identity (kubeflow-userid);
+        # profiles/namespaces stay platform-admin surfaces.
+        if user and kind not in ("profiles", "namespaces"):
+            from kubeflow_tpu.controller.kfam import check_access, role_of
+
+            verb_ns: tuple[str, str] | None = None
+            if method == "GET" and len(parts) >= 5:
+                verb_ns = ("get", parts[3])  # object GET, events, logs
+            elif method == "POST" and len(parts) == 3 and body is not None:
+                ns = (body.get("metadata") or {}).get("namespace", "default")
+                verb_ns = ("create", ns)
+            elif method == "POST" and len(parts) == 6:
+                verb_ns = ("scale", parts[3])
+            elif method == "DELETE" and len(parts) == 5:
+                verb_ns = ("delete", parts[3])
+            if verb_ns is not None:
+                try:
+                    check_access(cluster, verb_ns[1], user, verb_ns[0])
+                except PermissionError as exc:
+                    return 403, {"error": str(exc)}
+                # bindings grant access — managing them needs the SAME
+                # admin gate as /kfam/v1/bindings, or any edit-role user
+                # could grant themselves admin through this route
+                if (kind == "bindings"
+                        and verb_ns[0] in ("create", "delete")
+                        and cluster.get(
+                            "profiles", f"default/{verb_ns[1]}") is not None
+                        and role_of(cluster, verb_ns[1], user) != "admin"):
+                    return 403, {"error":
+                                 f"user {user!r} is not an admin of "
+                                 f"{verb_ns[1]!r}"}
 
         # -------- events
         if kind == "events" and len(parts) == 5:
@@ -281,7 +327,15 @@ class PlatformServer:
 
         # -------- CRUD
         if method == "GET" and len(parts) == 3:
-            return 200, [_serialize(kind, o) for o in cluster.list(kind)]
+            objs = cluster.list(kind)
+            if user:
+                # cross-namespace listing shows only what the caller may
+                # read (upstream dashboard posture), never a blanket 403
+                from kubeflow_tpu.controller.kfam import can_read
+
+                objs = [o for o in objs
+                        if can_read(cluster, o.metadata.namespace, user)]
+            return 200, [_serialize(kind, o) for o in objs]
         if method == "GET" and len(parts) == 5:
             obj = cluster.get(kind, f"{parts[3]}/{parts[4]}")
             if obj is None:
@@ -329,12 +383,73 @@ class PlatformServer:
             return 200, {"deleted": key}
         return 405, {"error": f"{method} not supported on {parsed.path!r}"}
 
+    # --------------------------------------------------------------- kfam
+
+    def _handle_kfam(self, method: str, query: dict, body: dict | None,
+                     user: str) -> tuple[int, object]:
+        """The kfam access-management REST surface (upstream
+        components/access-management): GET lists Bindings in the upstream
+        wire shape, POST/DELETE manage a contributor's role. Managing a
+        namespace's bindings requires its admin role when the caller
+        asserts an identity."""
+        from kubeflow_tpu.controller.kfam import (
+            bindings_for,
+            can_read,
+            from_kfam_dict,
+            role_of,
+            to_kfam_dict,
+        )
+
+        cluster = self.platform.cluster
+        if method == "GET":
+            ns = query.get("namespace", "")
+            if ns:
+                if user and not can_read(cluster, ns, user):
+                    return 403, {"error":
+                                 f"user {user!r} has no role in {ns!r}"}
+                items = bindings_for(cluster, ns)
+            else:
+                # the contributor roster is per-namespace information:
+                # identified callers see only namespaces they can read
+                items = [b for b in cluster.list("bindings")
+                         if not user
+                         or can_read(cluster, b.metadata.namespace, user)]
+            return 200, {"bindings": [to_kfam_dict(b) for b in items]}
+        if method not in ("POST", "DELETE"):
+            return 405, {"error": f"{method} not supported on kfam"}
+        if body is None:
+            return 400, {"error": "kfam Binding body required"}
+        try:
+            b = from_kfam_dict(body)
+        except ValueError as exc:
+            return 422, {"error": str(exc)}
+        ns = b.metadata.namespace
+        if cluster.get("profiles", f"default/{ns}") is None:
+            return 404, {"error": f"namespace {ns!r} has no profile"}
+        if user and role_of(cluster, ns, user) != "admin":
+            return 403, {"error":
+                         f"user {user!r} is not an admin of {ns!r}"}
+        key = f"{ns}/{b.metadata.name}"
+        if method == "POST":
+            if cluster.get("bindings", key) is not None:
+                return 409, {"error": f"binding {key} already exists"}
+            cluster.create("bindings", b)
+            return 201, to_kfam_dict(b)
+        if cluster.get("bindings", key) is None:
+            return 404, {"error": f"binding {key} not found"}
+        cluster.delete("bindings", key)
+        return 200, {"deleted": key}
+
     # -------------------------------------------------------------- watch
 
-    def stream_watch(self, wfile, kind: str, query: dict) -> None:
-        """Write an NDJSON watch stream for one kind until timeout/disconnect."""
+    def stream_watch(self, wfile, kind: str, query: dict,
+                     user: str = "") -> None:
+        """Write an NDJSON watch stream for one kind until timeout/disconnect.
+        Identified callers only see namespaces kfam lets them read."""
         import queue as queue_mod
         import time
+
+        from kubeflow_tpu.controller.kfam import can_read
 
         cluster = self.platform.cluster
         ns_filter = query.get("namespace", "")
@@ -349,6 +464,8 @@ class PlatformServer:
             if ns_filter and meta.namespace != ns_filter:
                 return False
             if name_filter and meta.name != name_filter:
+                return False
+            if user and not can_read(cluster, meta.namespace, user):
                 return False
             return True
 
@@ -404,7 +521,10 @@ class PlatformServer:
                     self.send_header("Transfer-Encoding", "identity")
                     self.send_header("Connection", "close")
                     self.end_headers()
-                    server.stream_watch(self.wfile, kind, query)
+                    server.stream_watch(
+                        self.wfile, kind, query,
+                        user=self.headers.get("kubeflow-userid", ""),
+                    )
                     return
                 self._dispatch_plain(method)
 
@@ -418,7 +538,10 @@ class PlatformServer:
                         self._reply(400, {"error": f"bad json: {exc}"})
                         return
                 try:
-                    code, payload = server.handle(method, self.path, body)
+                    code, payload = server.handle(
+                        method, self.path, body,
+                        user=self.headers.get("kubeflow-userid", ""),
+                    )
                 except ConflictError as exc:
                     code, payload = 409, {"error": str(exc)}
                 except Exception as exc:  # noqa: BLE001 — surface as 500

@@ -167,6 +167,7 @@ class FakeCluster:
         "jobs", "pods", "podgroups", "experiments", "trials",
         "inferenceservices", "poddefaults", "profiles", "namespaces",
         "tensorboards", "pipelineruns", "notebooks", "pvcviewers",
+        "bindings",
     )
 
     #: per-subscriber buffered events before a forced relist (native hub)

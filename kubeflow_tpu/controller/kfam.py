@@ -1,0 +1,135 @@
+"""kfam — access management (contributors) for Profile namespaces.
+
+Reference parity (unverified cites, SURVEY.md §2.7): kubeflow/kubeflow
+components/access-management exposes the kfam REST API
+(`/kfam/v1/bindings`): a Binding grants a user a ClusterRole
+(kubeflow-admin/-edit/-view) inside a Profile's namespace, materialized
+upstream as RoleBindings + Istio AuthorizationPolicies. The TPU rebuild
+keeps the platform-semantic core: bindings are cluster objects reconciled
+with the Profile lifecycle, and the apiserver enforces them on namespaced
+routes when the caller identifies itself with the upstream
+`kubeflow-userid` header. The Istio mesh layer is out of scope
+(SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.controller.fakecluster import FakeCluster
+
+#: role -> allowed verbs (upstream ClusterRole aggregation, collapsed)
+ROLES: dict[str, frozenset] = {
+    "admin": frozenset({"get", "list", "watch", "create", "update",
+                        "delete", "scale"}),
+    "edit": frozenset({"get", "list", "watch", "create", "update",
+                       "delete", "scale"}),
+    "view": frozenset({"get", "list", "watch"}),
+}
+
+#: upstream kfam wire names (roleRef.name) <-> platform role names
+_CLUSTERROLE = {"admin": "kubeflow-admin", "edit": "kubeflow-edit",
+                "view": "kubeflow-view"}
+_FROM_CLUSTERROLE = {v: k for k, v in _CLUSTERROLE.items()}
+
+
+def binding_name(user: str, role: str) -> str:
+    """Deterministic object name, mirroring kfam's user-role RoleBinding
+    naming (sanitized: object names are path segments here)."""
+    safe = "".join(c if c.isalnum() or c in "-." else "-" for c in user)
+    return f"{safe}-{role}".lower()
+
+
+@dataclass
+class AccessBinding:
+    """A user's role grant in one namespace (kfam Binding analogue)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    user: str = ""
+    role: str = "edit"  # admin | edit | view
+    kind: str = "AccessBinding"
+    api_version: str = "kubeflow-tpu.org/v1"
+
+
+def validate_binding(b: AccessBinding) -> None:
+    if not b.user:
+        raise ValueError("binding must name a user")
+    if b.role not in ROLES:
+        raise ValueError(
+            f"unknown role {b.role!r} (one of {sorted(ROLES)})")
+    if not b.metadata.namespace:
+        raise ValueError("binding must carry a referredNamespace")
+
+
+def to_kfam_dict(b: AccessBinding) -> dict:
+    """Upstream kfam Binding wire shape."""
+    return {
+        "user": {"kind": "User", "name": b.user},
+        "referredNamespace": b.metadata.namespace,
+        "roleRef": {
+            "kind": "ClusterRole",
+            "name": _CLUSTERROLE.get(b.role, b.role),
+        },
+    }
+
+
+def from_kfam_dict(d: dict) -> AccessBinding:
+    """Parse the upstream wire shape (roleRef kubeflow-* names accepted
+    alongside the bare platform names)."""
+    user = (d.get("user") or {}).get("name", "")
+    ns = d.get("referredNamespace", "")
+    wire_role = (d.get("roleRef") or {}).get("name", "edit")
+    role = _FROM_CLUSTERROLE.get(wire_role, wire_role)
+    b = AccessBinding(
+        metadata=ObjectMeta(name=binding_name(user, role), namespace=ns),
+        user=user, role=role,
+    )
+    validate_binding(b)
+    return b
+
+
+def bindings_for(cluster: FakeCluster, namespace: str) -> list[AccessBinding]:
+    return [b for b in cluster.list("bindings")
+            if b.metadata.namespace == namespace]
+
+
+def role_of(cluster: FakeCluster, namespace: str, user: str) -> str | None:
+    """A user's effective role in a namespace: profile owner is admin
+    (upstream: owner gets the admin RoleBinding), else the strongest
+    binding, else None."""
+    prof = cluster.get("profiles", f"default/{namespace}")
+    if prof is not None and prof.spec.owner and prof.spec.owner == user:
+        return "admin"
+    best: str | None = None
+    order = {"view": 0, "edit": 1, "admin": 2}
+    for b in bindings_for(cluster, namespace):
+        if b.user == user and (best is None or order[b.role] > order[best]):
+            best = b.role
+    return best
+
+
+def can_read(cluster: FakeCluster, namespace: str, user: str) -> bool:
+    """Whether `user` may read objects in `namespace` (any role suffices;
+    unmanaged namespaces are open)."""
+    if cluster.get("profiles", f"default/{namespace}") is None:
+        return True
+    return role_of(cluster, namespace, user) is not None
+
+
+def check_access(cluster: FakeCluster, namespace: str, user: str,
+                 verb: str) -> None:
+    """Raise PermissionError when `user` may not perform `verb` in a
+    profile-managed namespace. Unmanaged namespaces are open (no Profile
+    -> no kfam authz to enforce, the upstream posture for namespaces
+    Kubeflow does not own)."""
+    if cluster.get("profiles", f"default/{namespace}") is None:
+        return
+    role = role_of(cluster, namespace, user)
+    if role is None:
+        raise PermissionError(
+            f"user {user!r} has no role in namespace {namespace!r}")
+    if verb not in ROLES[role]:
+        raise PermissionError(
+            f"user {user!r} role {role!r} does not allow {verb!r} "
+            f"in namespace {namespace!r}")

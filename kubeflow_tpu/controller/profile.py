@@ -92,13 +92,22 @@ class ProfileController(ControllerBase):
         return [self.cluster._key(p) for p in self.cluster.list("profiles")]
 
     def reconcile(self, key: str) -> float | None:
+        from kubeflow_tpu.controller.kfam import (
+            AccessBinding,
+            binding_name,
+            bindings_for,
+        )
+
         prof: Profile | None = self.cluster.get("profiles", key)
         name = key.split("/", 1)[1]
         ns_key = f"-/{name}"
         if prof is None:
-            # profile gone -> release the namespace object (running jobs are
-            # not killed; their cleanup stays with their own controllers)
+            # profile gone -> release the namespace object and its access
+            # bindings (running jobs are not killed; their cleanup stays
+            # with their own controllers)
             self.cluster.delete("namespaces", ns_key)
+            for b in bindings_for(self.cluster, name):
+                self.cluster.delete("bindings", self.cluster._key(b))
             return None
         if self.cluster.get("namespaces", ns_key) is None:
             self.cluster.create(
@@ -111,4 +120,25 @@ class ProfileController(ControllerBase):
             self.cluster.record_event(
                 "profiles", key, "NamespaceCreated", f"namespace {name} ready"
             )
+        # kfam parity: the profile owner holds the admin binding in their
+        # namespace (upstream materializes this RoleBinding at profile
+        # creation). Owner changes revoke the PREVIOUS owner's
+        # reconciler-created binding — admin grants made through kfam are
+        # not labeled and survive.
+        owner_label = {"kubeflow-tpu.org/owned-by": "profile"}
+        for b in bindings_for(self.cluster, name):
+            if (b.metadata.labels.get("kubeflow-tpu.org/owned-by")
+                    == "profile" and b.user != prof.spec.owner):
+                self.cluster.delete("bindings", self.cluster._key(b))
+        if prof.spec.owner:
+            bname = binding_name(prof.spec.owner, "admin")
+            if self.cluster.get("bindings", f"{name}/{bname}") is None:
+                self.cluster.create(
+                    "bindings",
+                    AccessBinding(
+                        metadata=ObjectMeta(name=bname, namespace=name,
+                                            labels=dict(owner_label)),
+                        user=prof.spec.owner, role="admin",
+                    ),
+                )
         return None

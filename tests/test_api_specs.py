@@ -223,6 +223,7 @@ class TestSampleFixtures:
         assert {
             "JAXJob", "MXJob", "Experiment", "InferenceService", "PodDefault",
             "Profile", "Tensorboard", "Notebook", "PVCViewer",
+            "AccessBinding",
         } <= seen_kinds
 
 

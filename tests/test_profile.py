@@ -125,3 +125,222 @@ class TestQuotas:
                 return
             time.sleep(0.1)
         pytest.fail("gang within quota never scheduled")
+
+
+class TestKfam:
+    """Access-management parity (SURVEY.md §2.7 kfam): contributor
+    bindings per Profile namespace, the /kfam/v1/bindings REST surface,
+    and kubeflow-userid enforcement on namespaced routes."""
+
+    def _wait_binding(self, platform, key, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            b = platform.cluster.get("bindings", key)
+            if b is not None:
+                return b
+            time.sleep(0.02)
+        raise AssertionError(f"binding {key} never materialized")
+
+    def test_owner_admin_binding_materialized(self, platform):
+        make_profile(platform, "team-a")
+        b = self._wait_binding(platform, "team-a/team-a-example.com-admin")
+        assert b.user == "team-a@example.com" and b.role == "admin"
+
+    def test_role_resolution_and_access(self, platform):
+        from kubeflow_tpu.controller.kfam import (
+            AccessBinding, check_access, role_of,
+        )
+        from kubeflow_tpu.api.common import ObjectMeta as OM
+
+        make_profile(platform, "team-b")
+        platform.cluster.create("bindings", AccessBinding(
+            metadata=OM(name="viewer-view", namespace="team-b"),
+            user="viewer@example.com", role="view"))
+        assert role_of(platform.cluster, "team-b", "team-b@example.com") == "admin"
+        assert role_of(platform.cluster, "team-b", "viewer@example.com") == "view"
+        assert role_of(platform.cluster, "team-b", "nobody@example.com") is None
+        check_access(platform.cluster, "team-b", "viewer@example.com", "get")
+        with pytest.raises(PermissionError, match="does not allow"):
+            check_access(platform.cluster, "team-b",
+                         "viewer@example.com", "create")
+        with pytest.raises(PermissionError, match="no role"):
+            check_access(platform.cluster, "team-b",
+                         "nobody@example.com", "get")
+        # unmanaged namespaces stay open
+        check_access(platform.cluster, "wild-west", "nobody", "delete")
+
+    def test_profile_delete_cascades_bindings(self, platform):
+        make_profile(platform, "team-c")
+        self._wait_binding(platform, "team-c/team-c-example.com-admin")
+        platform.cluster.delete("profiles", "default/team-c")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            from kubeflow_tpu.controller.kfam import bindings_for
+            if not bindings_for(platform.cluster, "team-c"):
+                return
+            time.sleep(0.02)
+        raise AssertionError("bindings survived profile deletion")
+
+
+class TestKfamRest:
+    """The upstream-shaped /kfam/v1/bindings surface over a live server."""
+
+    @pytest.fixture()
+    def served(self, tmp_path):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from kubeflow_tpu.apiserver import PlatformServer
+
+        with Platform(log_dir=str(tmp_path / "pod-logs"),
+                      capacity_chips=16) as p:
+            server = PlatformServer(p, port=0).start()
+
+            def call(method, path, body=None, user=""):
+                headers = {"Content-Type": "application/json"}
+                if user:
+                    headers["kubeflow-userid"] = user
+                req = urllib.request.Request(
+                    server.url + path,
+                    data=_json.dumps(body).encode() if body is not None else None,
+                    headers=headers, method=method)
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        return r.status, _json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, _json.loads(e.read())
+
+            yield p, call
+            server.stop()
+
+    def _binding(self, ns, user, role="kubeflow-edit"):
+        return {"user": {"kind": "User", "name": user},
+                "referredNamespace": ns,
+                "roleRef": {"kind": "ClusterRole", "name": role}}
+
+    def test_crud_wire_shape(self, served):
+        p, call = served
+        make_profile(p, "team-r")
+        code, _ = call("POST", "/kfam/v1/bindings",
+                       self._binding("team-r", "dev@example.com"))
+        assert code == 201
+        code, body = call("GET", "/kfam/v1/bindings?namespace=team-r")
+        assert code == 200
+        users = {b["user"]["name"]: b["roleRef"]["name"]
+                 for b in body["bindings"]}
+        assert users["dev@example.com"] == "kubeflow-edit"
+        code, _ = call("DELETE", "/kfam/v1/bindings",
+                       self._binding("team-r", "dev@example.com"))
+        assert code == 200
+        code, body = call("GET", "/kfam/v1/bindings?namespace=team-r")
+        assert all(b["user"]["name"] != "dev@example.com"
+                   for b in body["bindings"])
+
+    def test_binding_needs_profile(self, served):
+        p, call = served
+        code, body = call("POST", "/kfam/v1/bindings",
+                          self._binding("ghost", "dev@example.com"))
+        assert code == 404 and "no profile" in body["error"]
+
+    def test_only_admin_manages_bindings(self, served):
+        p, call = served
+        make_profile(p, "team-s")
+        code, _ = call("POST", "/kfam/v1/bindings",
+                       self._binding("team-s", "dev@example.com"),
+                       user="stranger@example.com")
+        assert code == 403
+        code, _ = call("POST", "/kfam/v1/bindings",
+                       self._binding("team-s", "dev@example.com"),
+                       user="team-s@example.com")  # profile owner
+        assert code == 201
+
+    def test_namespaced_routes_enforce_roles(self, served):
+        p, call = served
+        make_profile(p, "team-t")
+        # viewer may read but not create
+        code, _ = call("POST", "/kfam/v1/bindings",
+                       self._binding("team-t", "viewer@example.com",
+                                     "kubeflow-view"),
+                       user="team-t@example.com")
+        assert code == 201
+        manifest = {
+            "kind": "Notebook", "apiVersion": "kubeflow-tpu.org/v1",
+            "metadata": {"name": "nb1", "namespace": "team-t"},
+        }
+        code, body = call("POST", "/api/v1/notebooks", manifest,
+                          user="viewer@example.com")
+        assert code == 403
+        code, _ = call("POST", "/api/v1/notebooks", manifest,
+                       user="team-t@example.com")
+        assert code == 201
+        # anonymous callers (no identity header) stay trusted — in-cluster
+        # SDK posture, kfam enforcement is mesh-edge upstream too
+        code, _ = call("DELETE", "/api/v1/notebooks/team-t/nb1")
+        assert code == 200
+
+    def test_generic_bindings_route_cannot_self_escalate(self, served):
+        p, call = served
+        make_profile(p, "team-u")
+        manifest = {
+            "kind": "AccessBinding", "apiVersion": "kubeflow-tpu.org/v1",
+            "metadata": {"name": "attacker-admin", "namespace": "team-u"},
+            "user": "attacker@example.com", "role": "admin",
+        }
+        code, body = call("POST", "/api/v1/bindings", manifest,
+                          user="attacker@example.com")
+        assert code == 403, (code, body)
+        # the namespace admin may still use the generic route
+        code, _ = call("POST", "/api/v1/bindings", manifest,
+                       user="team-u@example.com")
+        assert code == 201
+
+    def test_identified_reads_are_scoped(self, served):
+        p, call = served
+        make_profile(p, "team-v")
+        nb = {"kind": "Notebook", "apiVersion": "kubeflow-tpu.org/v1",
+              "metadata": {"name": "nb-v", "namespace": "team-v"}}
+        assert call("POST", "/api/v1/notebooks", nb)[0] == 201
+        # roleless identified caller: object GET 403, listing filtered
+        code, _ = call("GET", "/api/v1/notebooks/team-v/nb-v",
+                       user="nobody@example.com")
+        assert code == 403
+        code, body = call("GET", "/api/v1/notebooks",
+                          user="nobody@example.com")
+        assert code == 200 and body == []
+        # the owner sees it
+        code, body = call("GET", "/api/v1/notebooks",
+                          user="team-v@example.com")
+        assert [o["metadata"]["name"] for o in body] == ["nb-v"]
+        # kfam roster is scoped the same way
+        code, _ = call("GET", "/kfam/v1/bindings?namespace=team-v",
+                       user="nobody@example.com")
+        assert code == 403
+        code, body = call("GET", "/kfam/v1/bindings",
+                          user="nobody@example.com")
+        assert code == 200 and body["bindings"] == []
+
+    def test_owner_change_revokes_previous_owner(self, served):
+        import dataclasses
+        import time as _t
+
+        p, call = served
+        make_profile(p, "team-w")
+        deadline = _t.monotonic() + 5.0
+        while _t.monotonic() < deadline:
+            if p.cluster.get("bindings",
+                             "team-w/team-w-example.com-admin") is not None:
+                break
+            _t.sleep(0.02)
+        prof = p.cluster.get("profiles", "default/team-w")
+        prof.spec.owner = "newboss@example.com"
+        p.cluster.update("profiles", prof)
+        deadline = _t.monotonic() + 5.0
+        while _t.monotonic() < deadline:
+            from kubeflow_tpu.controller.kfam import role_of
+            if (role_of(p.cluster, "team-w", "team-w@example.com") is None
+                    and role_of(p.cluster, "team-w",
+                                "newboss@example.com") == "admin"):
+                return
+            _t.sleep(0.02)
+        raise AssertionError("old owner kept admin after owner change")
