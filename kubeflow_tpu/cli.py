@@ -298,7 +298,34 @@ def cmd_pipeline_submit(args) -> int:
 
 
 def cmd_platform(args) -> int:
-    """Run the control plane as a daemon serving the REST API."""
+    """Run the control plane as a daemon serving the REST API — from a
+    KfDef manifest (-f, kfctl-apply analogue) or bare flags."""
+    import threading
+
+    if getattr(args, "kfdef", ""):
+        from pathlib import Path
+
+        from kubeflow_tpu.kfdef import apply_kfdef, load_kfdef
+
+        try:
+            kfdef = load_kfdef(args.kfdef)
+            platform, server = apply_kfdef(
+                kfdef, base_dir=Path(args.kfdef).resolve().parent)
+        except (OSError, ValueError) as exc:
+            print(f"kfdef error: {exc}", file=sys.stderr)
+            return 1
+        apps = kfdef.spec.applications or ["(all)"]
+        print(f"platform {kfdef.metadata.name!r} serving at {server.url} "
+              f"applications={','.join(apps)}", flush=True)
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.stop()
+            platform.stop()
+        return 0
+
     from kubeflow_tpu.apiserver import PlatformServer
     from kubeflow_tpu.client import Platform
 
@@ -306,11 +333,23 @@ def cmd_platform(args) -> int:
         server = PlatformServer(platform, port=args.port, host=args.host).start()
         print(f"platform API serving at {server.url}", flush=True)
         try:
-            import threading
-
             threading.Event().wait()
         except KeyboardInterrupt:
             server.stop()
+    return 0
+
+
+def cmd_platform_init(args) -> int:
+    """Scaffold a kfdef.yaml (kfctl init analogue)."""
+    from kubeflow_tpu.kfdef import init_scaffold
+
+    try:
+        path = init_scaffold(args.directory)
+    except (OSError, FileExistsError) as exc:
+        print(f"init error: {exc}", file=sys.stderr)
+        return 1
+    print(f"wrote {path} — edit it, then: "
+          f"python -m kubeflow_tpu platform -f {path}")
     return 0
 
 
@@ -504,10 +543,17 @@ def main(argv: list[str] | None = None) -> int:
 
     p = add("platform", cmd_platform,
             help="run the control plane as a daemon with the REST API")
+    p.add_argument("-f", "--kfdef", default="",
+                   help="KfDef manifest (kfctl analogue) — overrides the "
+                        "flag-based config below")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--capacity-chips", type=int, default=8)
     p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+
+    p = add("platform-init", cmd_platform_init,
+            help="scaffold a kfdef.yaml deployment manifest (kfctl init)")
+    p.add_argument("directory", nargs="?", default=".")
 
     def server_arg(p):
         p.add_argument("--server", default="http://127.0.0.1:8080",

@@ -1,0 +1,203 @@
+"""KfDef — the declarative platform installer (kfctl parity).
+
+Reference parity (unverified cites, SURVEY.md §2.7 old-fork era):
+`bootstrap/` ships kfctl, a CLI that materializes a whole Kubeflow
+deployment from a KfDef manifest (an application list plus platform
+config). The TPU rebuild keeps the capability: ONE YAML describes the
+platform — capacity, which component families run, tenant profiles to
+pre-create, extra manifests to apply — and `kubeflow_tpu platform -f
+kfdef.yaml` brings it up (`platform init` scaffolds the file). Component
+toggles work through the Platform's controller registry, so a disabled
+application is absent from reconciliation AND /metrics, not merely idle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from kubeflow_tpu.api.common import ObjectMeta
+
+#: application name -> controller-registry keys it owns. "profiles" also
+#: powers kfam authz; it stays on unless explicitly dropped.
+APPLICATIONS: dict[str, tuple[str, ...]] = {
+    "training": ("job", "autoscaler"),
+    "katib": ("experiment",),
+    "kserve": ("isvc",),
+    "pipelines": ("pipelinerun",),
+    "profiles": ("profile",),
+    "devservers": ("tensorboard", "notebook", "pvcviewer"),
+}
+
+
+@dataclass
+class KfDefServer:
+    host: str = "127.0.0.1"
+    port: int = 8080
+
+
+@dataclass
+class KfDefProfile:
+    name: str = ""
+    owner: str = ""
+    chips: int | None = None
+    max_jobs: int | None = None
+
+
+@dataclass
+class KfDefSpec:
+    capacity_chips: int = 8
+    controller_workers: int = 2
+    log_dir: str = ".kubeflow_tpu/pod-logs"
+    server: KfDefServer = field(default_factory=KfDefServer)
+    # empty == all applications (kfctl default manifests posture)
+    applications: list[str] = field(default_factory=list)
+    profiles: list[KfDefProfile] = field(default_factory=list)
+    # extra CR manifests (paths relative to the kfdef file) applied after
+    # bring-up — the ksonnet-prototype analogue
+    resources: list[str] = field(default_factory=list)
+
+
+@dataclass
+class KfDef:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: KfDefSpec = field(default_factory=KfDefSpec)
+    kind: str = "KfDef"
+    api_version: str = "kubeflow-tpu.org/v1"
+
+
+def validate_kfdef(kfdef: KfDef) -> None:
+    unknown = [a for a in kfdef.spec.applications if a not in APPLICATIONS]
+    if unknown:
+        raise ValueError(
+            f"unknown application(s) {unknown} "
+            f"(one of {sorted(APPLICATIONS)})")
+    if kfdef.spec.capacity_chips <= 0:
+        raise ValueError("capacityChips must be positive")
+    if kfdef.spec.controller_workers <= 0:
+        raise ValueError("controllerWorkers must be positive")
+    for p in kfdef.spec.profiles:
+        if not p.name:
+            raise ValueError("every profile needs a name")
+    if (kfdef.spec.profiles and kfdef.spec.applications
+            and "profiles" not in kfdef.spec.applications):
+        raise ValueError(
+            "spec.profiles declared but the 'profiles' application is "
+            "disabled — nothing would reconcile them")
+
+
+def kfdef_from_dict(manifest: dict) -> KfDef:
+    from kubeflow_tpu.api.serde import _from_dict
+
+    body = {k: v for k, v in manifest.items()
+            if k not in ("kind", "apiVersion")}
+    kfdef = _from_dict(KfDef, body)
+    validate_kfdef(kfdef)
+    return kfdef
+
+
+def load_kfdef(path: str | Path) -> KfDef:
+    import yaml
+
+    manifest = yaml.safe_load(Path(path).read_text())
+    if not isinstance(manifest, dict) or manifest.get("kind") != "KfDef":
+        raise ValueError(f"{path}: not a KfDef manifest")
+    return kfdef_from_dict(manifest)
+
+
+SCAFFOLD = """\
+# kubeflow_tpu platform deployment (kfctl KfDef analogue).
+# Bring it up:  python -m kubeflow_tpu platform -f kfdef.yaml
+kind: KfDef
+apiVersion: kubeflow-tpu.org/v1
+metadata:
+  name: kubeflow-tpu
+spec:
+  capacityChips: 8
+  server:
+    host: 127.0.0.1
+    port: 8080
+  # Component families to run (drop entries to slim the deployment;
+  # omit the list entirely to run everything):
+  applications:
+    - training
+    - katib
+    - kserve
+    - pipelines
+    - profiles
+    - devservers
+  # Tenant namespaces created at bring-up (kfam owner bindings follow):
+  profiles:
+    - name: ml-team
+      owner: owner@example.com
+      chips: 4
+  # Extra CR manifests applied after bring-up (paths relative to this file):
+  resources: []
+"""
+
+
+def init_scaffold(directory: str | Path) -> Path:
+    """`platform init` — write a commented kfdef.yaml scaffold."""
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / "kfdef.yaml"
+    if path.exists():
+        raise FileExistsError(f"{path} already exists")
+    path.write_text(SCAFFOLD)
+    return path
+
+
+def apply_kfdef(kfdef: KfDef, base_dir: str | Path = "."):
+    """Materialize the deployment: a started Platform (with only the
+    selected applications registered) plus its REST server. Returns
+    (platform, server); the caller owns shutdown."""
+    from kubeflow_tpu.apiserver import PlatformServer, _deserialize
+    from kubeflow_tpu.client import Platform
+    from kubeflow_tpu.controller.profile import (
+        Profile,
+        ProfileQuota,
+        ProfileSpec,
+    )
+
+    spec = kfdef.spec
+    platform = Platform(
+        log_dir=spec.log_dir,
+        capacity_chips=spec.capacity_chips,
+        controller_workers=spec.controller_workers,
+    )
+    if spec.applications:
+        keep = {key
+                for app in spec.applications
+                for key in APPLICATIONS[app]}
+        for key in list(platform.controllers):
+            if key not in keep:
+                platform.controllers.pop(key)
+    platform.start()
+    server = None
+    try:
+        for p in spec.profiles:
+            platform.cluster.create("profiles", Profile(
+                metadata=ObjectMeta(name=p.name),
+                spec=ProfileSpec(
+                    owner=p.owner,
+                    quota=ProfileQuota(chips=p.chips, max_jobs=p.max_jobs),
+                ),
+            ))
+        import yaml
+
+        for rel in spec.resources:
+            rpath = Path(base_dir) / rel
+            for doc in yaml.safe_load_all(rpath.read_text()):
+                if not doc:
+                    continue
+                bucket, obj = _deserialize(doc)
+                platform.cluster.create(bucket, obj)
+        server = PlatformServer(
+            platform, port=spec.server.port, host=spec.server.host,
+        ).start()
+    except BaseException:
+        if server is not None:
+            server.stop()
+        platform.stop()
+        raise
+    return platform, server

@@ -1,0 +1,158 @@
+"""KfDef declarative installer (kfctl parity, SURVEY.md §2.7 bootstrap/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from kubeflow_tpu.kfdef import (
+    APPLICATIONS,
+    SCAFFOLD,
+    apply_kfdef,
+    init_scaffold,
+    kfdef_from_dict,
+    load_kfdef,
+)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+class TestKfDefSpec:
+    def test_scaffold_is_a_valid_kfdef(self, tmp_path):
+        path = init_scaffold(tmp_path)
+        kfdef = load_kfdef(path)
+        assert kfdef.metadata.name == "kubeflow-tpu"
+        assert set(kfdef.spec.applications) == set(APPLICATIONS)
+        assert kfdef.spec.profiles[0].name == "ml-team"
+
+    def test_scaffold_refuses_overwrite(self, tmp_path):
+        init_scaffold(tmp_path)
+        with pytest.raises(FileExistsError):
+            init_scaffold(tmp_path)
+
+    def test_unknown_application_rejected(self):
+        manifest = yaml.safe_load(SCAFFOLD)
+        manifest["spec"]["applications"] = ["training", "istio"]
+        with pytest.raises(ValueError, match="istio"):
+            kfdef_from_dict(manifest)
+
+    def test_non_kfdef_file_rejected(self, tmp_path):
+        p = tmp_path / "other.yaml"
+        p.write_text("kind: JAXJob\n")
+        with pytest.raises(ValueError, match="not a KfDef"):
+            load_kfdef(p)
+
+
+class TestApply:
+    def test_slim_deployment_runs_only_selected_applications(self, tmp_path):
+        manifest = yaml.safe_load(SCAFFOLD)
+        manifest["spec"]["applications"] = ["training", "profiles"]
+        manifest["spec"]["logDir"] = str(tmp_path / "pod-logs")
+        manifest["spec"]["server"] = {"port": 0}
+        manifest["spec"]["profiles"] = [
+            {"name": "team-x", "owner": "x@example.com", "chips": 4},
+        ]
+        kfdef = kfdef_from_dict(manifest)
+        platform, server = apply_kfdef(kfdef, base_dir=tmp_path)
+        try:
+            assert set(platform.controllers) == {
+                "job", "autoscaler", "profile"}
+            # disabled applications are absent from /metrics too
+            # (registry-driven observability)
+            _, metrics = _get(f"{server.url}/metrics")
+            assert "job" in metrics and "isvc" not in metrics
+            # the profile materialized: namespace + kfam owner binding
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if (platform.cluster.get("namespaces", "-/team-x")
+                        is not None):
+                    break
+                time.sleep(0.05)
+            assert platform.cluster.get("namespaces", "-/team-x") is not None
+            code, body = _get(f"{server.url}/kfam/v1/bindings?namespace=team-x")
+            assert code == 200
+            assert json.loads(body)["bindings"][0]["user"]["name"] == \
+                "x@example.com"
+        finally:
+            server.stop()
+            platform.stop()
+
+    def test_resources_applied_relative_to_kfdef(self, tmp_path):
+        (tmp_path / "extra.yaml").write_text(
+            "kind: PodDefault\n"
+            "apiVersion: kubeflow-tpu.org/v1\n"
+            "metadata: {name: tokens, namespace: default}\n"
+        )
+        manifest = yaml.safe_load(SCAFFOLD)
+        manifest["spec"]["applications"] = ["training"]
+        manifest["spec"]["logDir"] = str(tmp_path / "pod-logs")
+        manifest["spec"]["server"] = {"port": 0}
+        manifest["spec"]["profiles"] = []
+        manifest["spec"]["resources"] = ["extra.yaml"]
+        kfdef = kfdef_from_dict(manifest)
+        platform, server = apply_kfdef(kfdef, base_dir=tmp_path)
+        try:
+            assert platform.cluster.get("poddefaults", "default/tokens") \
+                is not None
+        finally:
+            server.stop()
+            platform.stop()
+
+    def test_bad_resource_rolls_back_cleanly(self, tmp_path):
+        (tmp_path / "bad.yaml").write_text("kind: Nonsense\nmetadata: {}\n")
+        manifest = yaml.safe_load(SCAFFOLD)
+        manifest["spec"]["applications"] = ["training"]
+        manifest["spec"]["logDir"] = str(tmp_path / "pod-logs")
+        manifest["spec"]["server"] = {"port": 0}
+        manifest["spec"]["profiles"] = []
+        manifest["spec"]["resources"] = ["bad.yaml"]
+        kfdef = kfdef_from_dict(manifest)
+        with pytest.raises(Exception, match="Nonsense"):
+            apply_kfdef(kfdef, base_dir=tmp_path)
+        # teardown happened: no orphaned reconciler threads serving pods
+        import threading
+
+        assert not [t for t in threading.enumerate()
+                    if "reconciler" in t.name.lower()]
+
+
+class TestCli:
+    def test_platform_init_scaffolds(self, tmp_path, capsys):
+        from kubeflow_tpu.cli import main
+
+        assert main(["platform-init", str(tmp_path / "deploy")]) == 0
+        out = capsys.readouterr().out
+        assert "kfdef.yaml" in out
+        assert (tmp_path / "deploy" / "kfdef.yaml").exists()
+
+
+class TestValidationHardening:
+    def _manifest(self, **spec):
+        m = yaml.safe_load(SCAFFOLD)
+        m["spec"].update(spec)
+        return m
+
+    def test_profiles_without_profiles_app_rejected(self):
+        m = self._manifest(applications=["training"],
+                           profiles=[{"name": "t", "owner": "o@x"}])
+        with pytest.raises(ValueError, match="'profiles' application"):
+            kfdef_from_dict(m)
+
+    def test_zero_controller_workers_rejected(self):
+        m = self._manifest(controllerWorkers=0)
+        with pytest.raises(ValueError, match="controllerWorkers"):
+            kfdef_from_dict(m)
+
+    def test_cli_user_errors_are_clean(self, tmp_path, capsys):
+        from kubeflow_tpu.cli import main
+
+        assert main(["platform-init", str(tmp_path)]) == 0
+        assert main(["platform-init", str(tmp_path)]) == 1  # exists
+        assert "init error" in capsys.readouterr().err
+        assert main(["platform", "-f", str(tmp_path / "nope.yaml")]) == 1
+        assert "kfdef error" in capsys.readouterr().err
