@@ -1,0 +1,56 @@
+"""DARTS one-shot architecture search on the digits task.
+
+Reference parity: katib's DARTS suggestion service runs the whole
+differentiable search inside ONE trial container and reports the derived
+architecture + its accuracy (SURVEY.md §2.4 NAS row). This is that trial
+workload: supernet search -> derive -> retrain -> katib-format metrics on
+stdout (`accuracy=... architecture=...`), so an Experiment's metrics
+collector picks both up.
+
+  python -m examples.darts_digits --device=cpu --search-steps=300
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+    p.add_argument("--search-steps", type=int, default=400)
+    p.add_argument("--retrain-steps", type=int, default=400)
+    p.add_argument("--num-cells", type=int, default=3)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+
+    from kubeflow_tpu.train.data import load_digits_dataset
+    from kubeflow_tpu.train.oneshot import (
+        OneShotConfig,
+        darts_search,
+        train_arch,
+    )
+
+    ds = load_digits_dataset(seed=args.seed)
+    cfg = OneShotConfig(
+        num_cells=args.num_cells, hidden=args.hidden,
+        search_steps=args.search_steps, seed=args.seed,
+    )
+    result = darts_search(ds.x_train, ds.y_train, ds.x_test, ds.y_test, cfg)
+    acc = train_arch(result.arch, ds.x_train, ds.y_train,
+                     ds.x_test, ds.y_test, cfg,
+                     steps=args.retrain_steps, seed=args.seed)
+    print(f"architecture={'-'.join(result.arch)}")
+    print(f"accuracy={acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(0 if main() > 0.9 else 1)

@@ -265,14 +265,23 @@ def validate_experiment(exp: Experiment) -> Experiment:
     if not exp.spec.objective.objective_metric_name:
         raise ValueError("experiment: objective.objectiveMetricName required")
     algo = exp.spec.algorithm.algorithm_name
+    if algo == "darts":
+        raise ValueError(
+            "experiment: darts is a one-shot IN-TRIAL search, not a "
+            "trial-loop algorithm — run "
+            "kubeflow_tpu.train.oneshot.darts_search inside a single "
+            "trial (examples/darts_digits.py); for controller-driven NAS "
+            "over trials use 'enas' or 'evolution'"
+        )
     if algo not in (
         "random", "grid", "tpe", "cmaes",
         "bayesianoptimization", "gp", "skopt", "hyperband",
-        "evolution", "nas",
+        "evolution", "nas", "enas",
     ):
         raise ValueError(
             f"experiment: unknown algorithm {algo!r} "
-            f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband|evolution)"
+            f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband|"
+            f"evolution|enas)"
         )
     if algo == "hyperband":
         rp = exp.spec.algorithm.settings.get("resourceParameter", "")

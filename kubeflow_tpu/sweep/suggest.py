@@ -82,6 +82,24 @@ class RandomSuggester:
         return out
 
 
+def _axis_values(p: ParameterSpec, default_grid_points: int = 4) -> list[str]:
+    """A parameter's discrete grid (categoricals verbatim; numerics on
+    their step grid, or default_grid_points even samples)."""
+    fs = p.feasible_space
+    if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
+        return [str(v) for v in fs.list]
+    lo, hi = float(fs.min), float(fs.max)
+    if fs.step:
+        # epsilon keeps fp error from dropping the max boundary point
+        # ((0.3-0.1)/0.1 == 1.9999... would otherwise lose 0.3)
+        n = int(math.floor((hi - lo) / float(fs.step) + 1e-9)) + 1
+        vals = [lo + i * float(fs.step) for i in range(n)]
+    else:
+        n = default_grid_points
+        vals = [lo + (hi - lo) * i / (n - 1) for i in range(n)] if n > 1 else [lo]
+    return [_format(p, v) for v in vals]
+
+
 class GridSuggester:
     """Enumerates the cartesian grid in a stable order, skipping points
     already tried (reconcile is level-triggered: 'which points exist' is
@@ -93,19 +111,7 @@ class GridSuggester:
         self.default_grid_points = default_grid_points
 
     def _axis(self, p: ParameterSpec) -> list[str]:
-        fs = p.feasible_space
-        if p.parameter_type in (ParameterType.CATEGORICAL, ParameterType.DISCRETE):
-            return [str(v) for v in fs.list]
-        lo, hi = float(fs.min), float(fs.max)
-        if fs.step:
-            # epsilon keeps fp error from dropping the max boundary point
-            # ((0.3-0.1)/0.1 == 1.9999... would otherwise lose 0.3)
-            n = int(math.floor((hi - lo) / float(fs.step) + 1e-9)) + 1
-            vals = [lo + i * float(fs.step) for i in range(n)]
-        else:
-            n = self.default_grid_points
-            vals = [lo + (hi - lo) * i / (n - 1) for i in range(n)] if n > 1 else [lo]
-        return [_format(p, v) for v in vals]
+        return _axis_values(p, self.default_grid_points)
 
     def suggest(self, history: History, count: int) -> list[dict[str, str]]:
         tried = {tuple(sorted(h[0].items())) for h in history}
@@ -502,6 +508,86 @@ class GPBayesSuggester:
         return [cands[i] for i in order[:count]]
 
 
+class EnasSuggester:
+    """ENAS-style controller (katib pkg/suggestion/v1beta1/nas/enas
+    parity): a LEARNED policy proposes architectures across trials and is
+    updated by policy gradient on their objectives — the reinforcement
+    half of Pham et al.'s ENAS (weight sharing, the other half, lives in
+    the trial workload: see train/oneshot.py's supernet). Upstream drives
+    an LSTM over the decision sequence; here each architecture decision
+    keeps its own softmax logits trained with REINFORCE against an
+    exponential-moving-average baseline. Level-triggered like every
+    suggester in this module: the policy is REPLAYED from history on each
+    call, so identical history yields identical suggestions and the
+    controller survives platform restarts for free.
+    """
+
+    def __init__(self, parameters: list[ParameterSpec], seed: int = 0,
+                 objective_type: ObjectiveType = ObjectiveType.MAXIMIZE,
+                 lr: float = 0.35, baseline_decay: float = 0.7,
+                 temperature: float = 1.0, default_grid_points: int = 4):
+        if temperature <= 0:
+            raise ValueError(
+                f"enas temperature must be positive, got {temperature} "
+                "(it scales the sampling softmax; use a small value like "
+                "0.1 for near-greedy proposals)")
+        self.parameters = parameters
+        self.axes = [_axis_values(p, default_grid_points)
+                     for p in parameters]
+        self.seed = seed
+        self.sign = 1.0 if objective_type == ObjectiveType.MAXIMIZE else -1.0
+        self.lr = lr
+        self.baseline_decay = baseline_decay
+        self.temperature = temperature
+
+    def _policy(self, logits: np.ndarray) -> np.ndarray:
+        return _softmax(logits / self.temperature)
+
+    def _replay(self, history: History) -> list[np.ndarray]:
+        logits = [np.zeros(len(ax)) for ax in self.axes]
+        baseline: float | None = None
+        for assignments, objective in _finite(history):
+            matched = [
+                (d, axis.index(assignments[p.name]))
+                for d, (p, axis) in enumerate(
+                    zip(self.parameters, self.axes))
+                if assignments.get(p.name) in axis
+            ]
+            if not matched:
+                # foreign/hand-injected trial: the policy never produced
+                # it — neither gradient NOR baseline may learn from it
+                continue
+            reward = self.sign * objective
+            adv = reward - (baseline if baseline is not None else reward)
+            baseline = (reward if baseline is None else
+                        self.baseline_decay * baseline
+                        + (1.0 - self.baseline_decay) * reward)
+            for d, idx in matched:
+                # REINFORCE for the SAMPLING policy softmax(logits/T):
+                # ∇_logits log π(idx) = (e_idx − π) / T
+                grad = -self._policy(logits[d])
+                grad[idx] += 1.0
+                logits[d] += self.lr * adv * grad / self.temperature
+        return logits
+
+    def suggest(self, history: History, count: int) -> list[dict[str, str]]:
+        logits = self._replay(history)
+        # fresh draws each call, deterministic given (seed, history length)
+        rng = np.random.default_rng((self.seed, len(history)))
+        out = []
+        for _ in range(count):
+            a: dict[str, str] = {}
+            for d, (p, axis) in enumerate(zip(self.parameters, self.axes)):
+                a[p.name] = axis[rng.choice(len(axis), p=self._policy(logits[d]))]
+            out.append(a)
+        return out
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - x.max())
+    return e / e.sum()
+
+
 class HyperbandSuggester:
     """Hyperband (successive halving) replayed from the trial history.
 
@@ -685,7 +771,25 @@ def get_suggester(
             eta=int(settings.get("eta", 3)),
             inner=settings.get("inner", "random"),
         )
+    if name == "darts":
+        raise ValueError(
+            "darts is a one-shot IN-TRIAL search, not a trial-loop "
+            "algorithm: run kubeflow_tpu.train.oneshot.darts_search inside "
+            "a single trial (examples/darts_digits.py); for "
+            "controller-driven NAS over trials use 'enas' or 'evolution'"
+        )
+    if name == "enas":
+        return EnasSuggester(
+            parameters,
+            seed=seed,
+            objective_type=objective_type,
+            lr=float(settings.get("controllerLr", 0.35)),
+            baseline_decay=float(settings.get("baselineDecay", 0.7)),
+            temperature=float(settings.get("temperature", 1.0)),
+            default_grid_points=int(settings.get("defaultGridPoints", 4)),
+        )
     raise ValueError(
         f"unknown suggestion algorithm {name!r} "
-        f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband|evolution)"
+        f"(random|grid|tpe|cmaes|bayesianoptimization|hyperband|evolution|"
+        f"enas|darts)"
     )

@@ -1,0 +1,232 @@
+"""One-shot differentiable architecture search (DARTS parity).
+
+Reference parity (unverified cites, SURVEY.md §2.4): katib ships a DARTS
+suggestion service (pkg/suggestion/v1beta1/nas/darts) whose trial
+container runs Liu et al.'s continuous relaxation: every layer computes a
+softmax-weighted mixture of candidate ops over SHARED weights, and
+architecture parameters (alphas) are trained by gradient descent
+alongside the weights. The search happens inside ONE trial; the derived
+discrete architecture is the result.
+
+TPU-first shape: the whole supernet is one flax module, both update
+steps are jitted pure functions (no Python control flow over ops — the
+mixture is a weighted sum the compiler fuses), and the alternating
+w-step/alpha-step schedule is first-order DARTS (the practical default;
+the second-order Hessian-vector term buys little and doubles cost).
+
+Controller-over-trials NAS (the ENAS reinforcement half) is
+sweep/suggest.py#EnasSuggester; this module owes the weight-sharing
+half.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+#: candidate ops: name -> activation applied after the cell's shared
+#: Dense transform. "skip" bypasses the transform entirely (identity),
+#: giving the search a depth knob, the DARTS skip-connection analogue.
+CANDIDATE_OPS: dict[str, Callable] = {
+    "relu": nn.relu,
+    "gelu": nn.gelu,
+    "tanh": jnp.tanh,
+    "skip": None,  # identity over the cell input
+}
+
+
+@dataclass
+class OneShotConfig:
+    num_cells: int = 3
+    hidden: int = 64
+    num_classes: int = 10
+    ops: tuple[str, ...] = tuple(CANDIDATE_OPS)
+    # alternating first-order DARTS schedule
+    search_steps: int = 300
+    batch_size: int = 128
+    w_lr: float = 3e-3
+    alpha_lr: float = 2e-2
+    seed: int = 0
+
+
+class MixedCell(nn.Module):
+    """One searchable cell: out = Σ_o softmax(α)_o · o(Dense(x)).
+
+    All candidate op outputs share ONE Dense transform (weight sharing at
+    its purest — the mixture differs only in the nonlinearity/bypass), so
+    the supernet costs one matmul per cell regardless of |ops|: the MXU
+    does the work once and the VPU blends activations XLA fuses into it.
+    """
+
+    hidden: int
+    ops: tuple[str, ...]
+
+    @nn.compact
+    def __call__(self, x):
+        alpha = self.param(
+            "alpha", nn.initializers.zeros, (len(self.ops),), jnp.float32)
+        h = nn.Dense(self.hidden, name="transform")(x)
+        weights = jax.nn.softmax(alpha)
+        parts = []
+        for name, w in zip(self.ops, weights):
+            fn = CANDIDATE_OPS[name]
+            parts.append(w * (x if fn is None else fn(h)))
+        return sum(parts)
+
+
+class SuperNet(nn.Module):
+    """Stacked mixed cells + linear head over flat features."""
+
+    cfg: OneShotConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.cfg.hidden, name="stem")(x)
+        for i in range(self.cfg.num_cells):
+            x = MixedCell(self.cfg.hidden, self.cfg.ops, name=f"cell{i}")(x)
+        return nn.Dense(self.cfg.num_classes, name="head")(x)
+
+
+class DerivedNet(nn.Module):
+    """The discrete network a finished search derives: same topology with
+    each cell's argmax op hardened (retrained from scratch, per DARTS)."""
+
+    cfg: OneShotConfig
+    arch: tuple[str, ...]
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.cfg.hidden, name="stem")(x)
+        for i, op in enumerate(self.arch):
+            fn = CANDIDATE_OPS[op]
+            if fn is None:
+                continue  # skip: cell is a no-op passthrough
+            x = fn(nn.Dense(self.cfg.hidden, name=f"cell{i}")(x))
+        return nn.Dense(self.cfg.num_classes, name="head")(x)
+
+
+def _is_alpha(path: tuple) -> bool:
+    return any(getattr(k, "key", k) == "alpha" for k in path)
+
+
+def _alpha_mask(params, want_alpha: bool):
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: _is_alpha(path) == want_alpha, params)
+
+
+@dataclass
+class SearchResult:
+    arch: tuple[str, ...]
+    alphas: dict[str, np.ndarray]
+    params: dict = field(repr=False, default_factory=dict)
+
+
+def darts_search(x_train, y_train, x_val, y_val,
+                 cfg: OneShotConfig | None = None) -> SearchResult:
+    """First-order DARTS: even steps update weights on the train split,
+    odd steps update alphas on the val split (the bilevel approximation).
+    Returns the derived architecture (argmax alpha per cell)."""
+    cfg = cfg or OneShotConfig()
+    net = SuperNet(cfg)
+    key = jax.random.PRNGKey(cfg.seed)
+    params = net.init(key, jnp.asarray(x_train[:1]))["params"]
+
+    # one optimizer PER role with its own state, stepped only on its own
+    # turn — masking grads into a shared optimizer would still move the
+    # frozen role through stale Adam momentum. Each role's leaves see
+    # either their true gradient or exactly zero, and a zero-grad leaf
+    # under a never-otherwise-touched Adam state has zero moments, hence
+    # an exactly-zero update.
+    tx_w = optax.adam(cfg.w_lr)
+    tx_alpha = optax.adam(cfg.alpha_lr)
+    opt_w = tx_w.init(params)
+    opt_alpha = tx_alpha.init(params)
+
+    def loss_fn(params, x, y):
+        logits = net.apply({"params": params}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames="want_alpha")
+    def step(params, opt_state, x, y, want_alpha: bool):
+        grads = jax.grad(loss_fn)(params, x, y)
+        mask = _alpha_mask(params, want_alpha)
+        grads = jax.tree_util.tree_map(
+            lambda g, m: g if m else jnp.zeros_like(g), grads, mask)
+        tx = tx_alpha if want_alpha else tx_w
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    rng = np.random.default_rng(cfg.seed)
+    x_train = np.asarray(x_train)
+    y_train = np.asarray(y_train)
+    x_val = np.asarray(x_val)
+    y_val = np.asarray(y_val)
+    for i in range(cfg.search_steps):
+        if i % 2 == 0:
+            idx = rng.integers(0, len(x_train), cfg.batch_size)
+            params, opt_w = step(
+                params, opt_w, x_train[idx], y_train[idx],
+                want_alpha=False)
+        else:
+            idx = rng.integers(0, len(x_val), cfg.batch_size)
+            params, opt_alpha = step(
+                params, opt_alpha, x_val[idx], y_val[idx], want_alpha=True)
+
+    alphas = {
+        f"cell{i}": np.asarray(params[f"cell{i}"]["alpha"])
+        for i in range(cfg.num_cells)
+    }
+    arch = tuple(
+        cfg.ops[int(np.argmax(alphas[f"cell{i}"]))]
+        for i in range(cfg.num_cells)
+    )
+    return SearchResult(arch=arch, alphas=alphas,
+                        params=jax.device_get(params))
+
+
+def train_arch(arch: tuple[str, ...], x_train, y_train, x_val, y_val,
+               cfg: OneShotConfig | None = None, steps: int = 300,
+               lr: float = 3e-3, seed: int = 0) -> float:
+    """Retrain a discrete architecture from scratch; returns val accuracy
+    (how DARTS evaluates a derived cell, and how the beat-random test
+    scores candidates on equal footing)."""
+    cfg = cfg or OneShotConfig()
+    net = DerivedNet(cfg, tuple(arch))
+    params = net.init(jax.random.PRNGKey(seed), jnp.asarray(x_train[:1]))[
+        "params"]
+    tx = optax.adam(lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = net.apply({"params": p}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    rng = np.random.default_rng(seed)
+    x_train = np.asarray(x_train)
+    y_train = np.asarray(y_train)
+    for _ in range(steps):
+        idx = rng.integers(0, len(x_train), cfg.batch_size)
+        params, opt_state = step(params, opt_state, x_train[idx],
+                                 y_train[idx])
+
+    @jax.jit
+    def acc(params, x, y):
+        return (net.apply({"params": params}, x).argmax(-1) == y).mean()
+
+    return float(acc(params, jnp.asarray(x_val), jnp.asarray(y_val)))

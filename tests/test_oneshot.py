@@ -1,0 +1,190 @@
+"""NAS parity — ENAS controller suggester + DARTS one-shot search
+(SURVEY.md §2.4 katib nas/{enas,darts} services)."""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.sweep.api import (
+    FeasibleSpace,
+    ParameterSpec,
+    ParameterType,
+)
+from kubeflow_tpu.sweep.suggest import (
+    EnasSuggester,
+    RandomSuggester,
+    get_suggester,
+)
+
+
+def p_cat(name, values):
+    return ParameterSpec(
+        name=name, parameter_type=ParameterType.CATEGORICAL,
+        feasible_space=FeasibleSpace(list=values),
+    )
+
+
+ARCH = [
+    p_cat("op0", ["conv3", "conv5", "sep3", "pool"]),
+    p_cat("op1", ["conv3", "conv5", "sep3", "pool"]),
+    p_cat("width", ["32", "64", "128"]),
+]
+
+
+def _fitness(a):
+    # optimum: (sep3, conv3, 64), with an interaction term so the
+    # controller must learn joint structure, not marginals alone
+    s = (1.0 if a["op0"] == "sep3" else 0.0)
+    s += 0.5 if a["op1"] == "conv3" else 0.0
+    s += 0.5 if a["width"] == "64" else 0.0
+    if a["op0"] == "sep3" and a["op1"] == "conv3":
+        s += 0.5
+    return s
+
+
+def _drive(suggester, fitness, rounds, per_round):
+    history = []
+    for _ in range(rounds):
+        for a in suggester.suggest(history, per_round):
+            history.append((a, fitness(a)))
+    return history
+
+
+class TestEnas:
+    def test_controller_beats_random(self):
+        s = EnasSuggester(ARCH, seed=1)
+        hist = _drive(s, _fitness, rounds=30, per_round=3)
+        rnd = _drive(RandomSuggester(ARCH, seed=1), _fitness,
+                     rounds=30, per_round=3)
+        assert np.mean([o for _, o in hist]) > np.mean([o for _, o in rnd])
+        # the policy concentrates: late suggestions mostly pick the optimum op
+        late = s.suggest(hist, 20)
+        assert sum(a["op0"] == "sep3" for a in late) >= 12
+
+    def test_deterministic_replay(self):
+        s = EnasSuggester(ARCH, seed=5)
+        hist = _drive(s, _fitness, rounds=10, per_round=2)
+        assert s.suggest(hist, 4) == s.suggest(hist, 4)
+
+    def test_failed_and_foreign_trials_ignored(self):
+        s = EnasSuggester(ARCH, seed=2)
+        hist = [
+            ({"op0": "sep3", "op1": "conv3", "width": "64"}, float("nan")),
+            ({"op0": "alien-op", "op1": "conv3", "width": "64"}, 1.0),
+            ({"op0": "sep3", "op1": "conv3", "width": "64"}, None),
+        ]
+        out = s.suggest(hist, 3)  # must not crash, still proposes
+        assert len(out) == 3 and all(a["op0"] in
+                                     ARCH[0].feasible_space.list
+                                     for a in out)
+
+    def test_registry(self):
+        assert isinstance(get_suggester("enas", ARCH), EnasSuggester)
+        with pytest.raises(ValueError, match="one-shot IN-TRIAL"):
+            get_suggester("darts", ARCH)
+
+
+class TestDarts:
+    @pytest.fixture(scope="class")
+    def digits(self):
+        from kubeflow_tpu.train.data import load_digits_dataset
+
+        return load_digits_dataset(seed=0)
+
+    def test_search_derives_trainable_architecture(self, digits):
+        from kubeflow_tpu.train.oneshot import (
+            OneShotConfig,
+            darts_search,
+            train_arch,
+        )
+
+        cfg = OneShotConfig(search_steps=200, seed=0)
+        result = darts_search(digits.x_train, digits.y_train,
+                              digits.x_test, digits.y_test, cfg)
+        assert len(result.arch) == cfg.num_cells
+        assert all(op in cfg.ops for op in result.arch)
+        # alphas moved off uniform: the search expressed a preference
+        probs = [np.exp(a) / np.exp(a).sum()
+                 for a in result.alphas.values()]
+        assert max(p.max() for p in probs) > 1.0 / len(cfg.ops) + 0.05
+        acc = train_arch(result.arch, digits.x_train, digits.y_train,
+                         digits.x_test, digits.y_test, cfg, steps=300)
+        assert acc > 0.9
+
+    def test_all_skip_architecture_is_linear_but_valid(self, digits):
+        from kubeflow_tpu.train.oneshot import OneShotConfig, train_arch
+
+        cfg = OneShotConfig()
+        acc = train_arch(("skip", "skip", "skip"),
+                         digits.x_train, digits.y_train,
+                         digits.x_test, digits.y_test, cfg, steps=200)
+        assert acc > 0.8  # a linear model still learns digits decently
+
+
+class TestEnasHardening:
+    def test_temperature_must_be_positive(self):
+        with pytest.raises(ValueError, match="temperature"):
+            EnasSuggester(ARCH, temperature=0.0)
+
+    def test_foreign_trial_does_not_move_baseline(self):
+        s = EnasSuggester(ARCH, seed=0)
+        legit = [({"op0": "sep3", "op1": "conv3", "width": "64"}, 1.0)]
+        foreign = [({"op0": "alien", "op1": "alien", "width": "alien"},
+                    100.0)]
+        # identical logits whether or not the off-policy outlier is present
+        a = s._replay(legit + foreign + legit)
+        b = s._replay(legit + legit)
+        assert all(np.allclose(x, y) for x, y in zip(a, b))
+
+    def test_temperature_scaled_policy_still_learns(self):
+        s = EnasSuggester(ARCH, seed=4, temperature=2.0)
+        hist = _drive(s, _fitness, rounds=30, per_round=3)
+        rnd = _drive(RandomSuggester(ARCH, seed=4), _fitness,
+                     rounds=30, per_round=3)
+        assert np.mean([o for _, o in hist]) > np.mean([o for _, o in rnd])
+
+    def test_default_grid_points_plumbed(self):
+        from kubeflow_tpu.sweep.api import FeasibleSpace as FS
+
+        dbl = ParameterSpec(
+            name="lr", parameter_type=ParameterType.DOUBLE,
+            feasible_space=FS(min="0", max="1"))
+        s = get_suggester("enas", [dbl],
+                          settings={"defaultGridPoints": "7"})
+        assert len(s.axes[0]) == 7
+
+
+class TestDartsRoleIsolation:
+    def test_weights_frozen_during_alpha_steps(self):
+        """The alternating schedule must be real: an alpha step may not
+        move weights through stale optimizer momentum (first-order DARTS
+        contract)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeflow_tpu.train import oneshot as osn
+
+        cfg = osn.OneShotConfig(search_steps=0, hidden=8, num_cells=1)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 16)).astype(np.float32)
+        y = rng.integers(0, 10, 64).astype(np.int32)
+
+        # drive the real search loop a few steps and snapshot roles around
+        # an odd (alpha) step by instrumenting through public pieces:
+        # run 3 steps (w, alpha, w) and compare against running 2 steps
+        # (w, alpha) — the weights after step 2 must equal those after
+        # step 1 (the alpha step between them touched only alphas)
+        cfg2 = osn.OneShotConfig(search_steps=1, hidden=8, num_cells=1,
+                                 seed=7)
+        r1 = osn.darts_search(x, y, x, y, cfg2)
+        cfg3 = osn.OneShotConfig(search_steps=2, hidden=8, num_cells=1,
+                                 seed=7)
+        r2 = osn.darts_search(x, y, x, y, cfg3)
+        w1 = r1.params["cell0"]["transform"]["kernel"]
+        w2 = r2.params["cell0"]["transform"]["kernel"]
+        assert np.allclose(np.asarray(w1), np.asarray(w2)), \
+            "alpha step moved the shared weights"
+        a1 = r1.params["cell0"]["alpha"]
+        a2 = r2.params["cell0"]["alpha"]
+        assert not np.allclose(np.asarray(a1), np.asarray(a2)), \
+            "alpha step did not move alphas"
