@@ -479,18 +479,81 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
         dbias_ref[0] = db_scr[:].astype(dbias_ref.dtype)
 
 
-# Backward implementation selector. "scratch": cross-grid-step VMEM
-# accumulators (one grid step per (q, kv) block pair, output written on the
-# last step). "loop": one grid step per output block with a fori_loop over
-# the other sequence axis inside the kernel — no cross-step scratch, no
-# write-only-on-last-step output revisiting. Both are numerically identical
-# in interpret mode (test_ring_attention pins it). Default is "loop": the
-# r3 probe_flash hardware verdict showed the scratch variant's ds path
-# NaN-ing under Mosaic (dq/dk/dbias NaN, dv clean) while interpret passes;
-# the loop shape removes the grid-revisit machinery that distinguishes the
-# failing outputs. probe_flash_fix.py re-validates on hardware at the next
-# tunnel window (tunnel_watch2.sh).
-FLASH_BWD_IMPL = "loop"
+# Backward implementation selector.
+#   "xla"     — XLA einsums over KV blocks consuming the pallas forward's
+#               saved (o, lse) residuals: standard FlashAttention-2
+#               backward math, no forward replay, no pallas in the
+#               gradient path. THE DEFAULT: probe_flash_fix (r3, on
+#               hardware) showed BOTH pallas backwards NaN under Mosaic
+#               (dq/dk/dbias NaN, dv clean, interpret passes), so until a
+#               hardware PASS is recorded the training path keeps the
+#               validated pallas forward and a known-good backward.
+#   "scratch" — pallas, cross-grid-step VMEM accumulators.
+#   "loop"    — pallas, fori_loop per output block, no cross-step scratch
+#               (r3 fix candidate; hardware verdict: still NaN — the bug
+#               is in the shared ds dataflow, bisect staged in
+#               tunnel_watch2.sh / probe_flash_stage1.py).
+# All three are numerically identical in interpret/CPU mode
+# (test_ring_attention pins it).
+FLASH_BWD_IMPL = "xla"
+
+
+def _flash_backward_xla(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
+                        scale, block_k, causal, out_dtypes, bias_dtype):
+    """Flash backward as XLA einsums over KV blocks, from saved residuals.
+
+    Cheaper than jax.vjp(blockwise_attention) — which must REPLAY the
+    whole online-softmax forward to rebuild residuals — by one full
+    forward pass: p tiles come from exp(s − lse) with the lse the pallas
+    forward already saved. Memory stays bounded by materializing only a
+    (BH, Lq, block_k) score tile per scan step; XLA keeps the five
+    einsums per block on the MXU. Takes the same prefolded residuals as
+    the pallas variants (one shared prep in _flash_backward).
+    """
+    dq_dtype, dk_dtype, dv_dtype = out_dtypes
+    n_kv = lk // block_k
+    # bias row per folded batch*head: (B,1,1,Lk) -> (BH, Lk)
+    bias_bh = jnp.repeat(
+        bias.reshape(b, lk).astype(jnp.float32), h, axis=0)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (lq, block_k), 0)
+
+    def step(dq_acc, j):
+        kj = jax.lax.dynamic_slice_in_dim(kf, j * block_k, block_k, 1)
+        vj = jax.lax.dynamic_slice_in_dim(vf, j * block_k, block_k, 1)
+        bj = jax.lax.dynamic_slice_in_dim(bias_bh, j * block_k, block_k, 1)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kj,
+                       preferred_element_type=jnp.float32) * scale
+        s = s + bj[:, None, :]
+        if causal:
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (lq, block_k), 1)
+            s = s + jnp.where(cols > rows, NEG_INF, 0.0)
+        p = jnp.exp(s - lse)                                 # (BH, Lq, bk)
+        dp = jnp.einsum("bqd,bkd->bqk", gf, vj,
+                        preferred_element_type=jnp.float32)
+        ds32 = p * (dp - dd)
+        ds = ds32.astype(qf.dtype)  # bf16 onto the MXU, like the kernels
+        p16 = p.astype(qf.dtype)
+        dq_acc = dq_acc + jnp.einsum(
+            "bqk,bkd->bqd", ds, kj, preferred_element_type=jnp.float32)
+        dkj = jnp.einsum("bqk,bqd->bkd", ds, qf,
+                         preferred_element_type=jnp.float32) * scale
+        dvj = jnp.einsum("bqk,bqd->bkd", p16, gf,
+                         preferred_element_type=jnp.float32)
+        # bias is (B, 1, 1, Lk): reduce rows AND heads, in f32 (the
+        # pallas paths sum the f32 ds — a bf16 pre-cast would round
+        # every element before a Lq*h-long reduction)
+        dbj = ds32.sum(1).reshape(b, h, block_k).sum(1)
+        return dq_acc, (dkj, dvj, dbj)
+
+    dq_acc, (dks, dvs, dbs) = jax.lax.scan(
+        step, jnp.zeros((b * h, lq, d), jnp.float32), jnp.arange(n_kv))
+    dqf = (dq_acc * scale).astype(dq_dtype)
+    # scan stacks (n_kv, BH, bk, d): move the block axis back into Lk
+    dkf = jnp.moveaxis(dks, 0, 1).reshape(b * h, lk, d).astype(dk_dtype)
+    dvf = jnp.moveaxis(dvs, 0, 1).reshape(b * h, lk, d).astype(dv_dtype)
+    dbias = jnp.moveaxis(dbs, 0, 1).reshape(b, lk)[:, None, None, :]
+    return dqf, dkf, dvf, dbias.astype(bias_dtype)
 
 
 def _flash_dq_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
@@ -653,6 +716,15 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
     dd = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1, keepdims=True)
     n_q, n_kv = lq // block_q, lk // block_k
     interpret = jax.default_backend() == "cpu"
+
+    if (impl or FLASH_BWD_IMPL) == "xla":
+        dqf, dkf, dvf, dbias = _flash_backward_xla(
+            qf, kf, vf, bias, gf, lse, dd, b=b, h=h, lq=lq, lk=lk, d=d,
+            scale=scale, block_k=block_k, causal=causal,
+            out_dtypes=(q.dtype, k.dtype, v.dtype), bias_dtype=bias.dtype,
+        )
+        unfold = lambda t, L: t.reshape(b, h, L, d).transpose(0, 2, 1, 3)  # noqa: E731
+        return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
 
     if (impl or FLASH_BWD_IMPL) == "loop":
         dqf, dkf, dvf, dbias_bh = _flash_backward_loop(

@@ -1,0 +1,141 @@
+"""Hardware verdict for the new FLASH_BWD_IMPL="xla" default: pallas
+forward (Mosaic-validated) + residual-consuming XLA backward — correctness
+vs the blockwise reference, and fwd+bwd timing vs the pure-XLA path it
+must beat (it saves one forward replay by consuming the saved lse)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+WATCHDOG_S = 420.0
+_last = [time.monotonic()]
+
+
+def _pet():
+    _last[0] = time.monotonic()
+
+
+def _watchdog():
+    while True:
+        time.sleep(5.0)
+        if time.monotonic() - _last[0] > WATCHDOG_S:
+            print("RESULT watchdog=hang", flush=True)
+            os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("KFT_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["KFT_BENCH_PLATFORM"])
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel import ring_attention as ra
+    from kubeflow_tpu.parallel.ring_attention import (
+        blockwise_attention,
+        flash_attention,
+    )
+
+    dev = jax.devices()[0]
+    print(f"RESULT device_kind={dev.device_kind!r} platform={dev.platform}",
+          flush=True)
+    float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
+    _pet()
+    assert ra.FLASH_BWD_IMPL == "xla"
+
+    def born(*shape, key, dtype=jnp.bfloat16):
+        x = jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+        return jax.jit(lambda v: (v * 0.125).astype(dtype))(x)
+
+    # ---- correctness at training shapes ---------------------------------
+    # (tiny on CPU: the interpret-mode pallas forward is minutes-slow at
+    # real shapes, and the CPU pass only sanity-checks the script)
+    small = jax.default_backend() == "cpu"
+    b, l, h, d = (1, 128, 2, 32) if small else (2, 1024, 12, 64)
+    q = born(b, l, h, d, key=0)
+    k = born(b, l, h, d, key=1)
+    v = born(b, l, h, d, key=2)
+    bias = born(b, 1, 1, l, key=4, dtype=jnp.bfloat16)
+    ct = born(b, l, h, d, key=3)
+
+    for causal in (False, True):
+        tag = "causal" if causal else "full"
+
+        def loss_ref(q, k, v, bias, c=causal):
+            return (blockwise_attention(q, k, v, bias, block=256, causal=c)
+                    .astype(jnp.float32) * ct.astype(jnp.float32)).sum()
+
+        def loss_flash(q, k, v, bias, c=causal):
+            return (flash_attention(q, k, v, bias, block=256, causal=c)
+                    .astype(jnp.float32) * ct.astype(jnp.float32)).sum()
+
+        try:
+            ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))(
+                q, k, v, bias)
+            got = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2, 3)))(
+                q, k, v, bias)
+            errs = [
+                float(jnp.max(jnp.abs(
+                    a.astype(jnp.float32) - r.astype(jnp.float32))))
+                for a, r in zip(got, ref)
+            ]
+            ok = max(errs[:3]) < 0.25 and errs[3] < 2.0
+            print(f"RESULT xlabwd_{tag}={'PASS' if ok else 'FAIL'} "
+                  f"dq={errs[0]:.4g} dk={errs[1]:.4g} dv={errs[2]:.4g} "
+                  f"dbias={errs[3]:.4g}", flush=True)
+        except Exception as exc:  # noqa: BLE001 — verdict line
+            print(f"RESULT xlabwd_{tag}=ERROR {type(exc).__name__}",
+                  flush=True)
+        _pet()
+
+    # ---- timing at GPT-2s 2k shapes -------------------------------------
+    b, l = (1, 256) if small else (4, 2048)
+    q = born(b, l, h, d, key=10)
+    k = born(b, l, h, d, key=11)
+    v = born(b, l, h, d, key=12)
+    bias = jnp.zeros((b, 1, 1, l), jnp.bfloat16)
+    ct = born(b, l, h, d, key=13)
+    total_flops = 2 * 2 * b * h * l * l * d * 0.5 * 3.5
+
+    def timed(fn, *args, iters=8):
+        val = fn(*args)
+        val = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: float(x.astype(jnp.float32).sum()), val)
+        _pet()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            val = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: float(x.astype(jnp.float32).sum()), val)
+        return (time.perf_counter() - t0) / iters
+
+    def loss_flash(q, k, v, bias):
+        return (flash_attention(q, k, v, bias, block=256, causal=True)
+                .astype(jnp.float32) * ct.astype(jnp.float32)).sum()
+
+    def loss_bw(q, k, v, bias):
+        return (blockwise_attention(q, k, v, bias, block=256, causal=True)
+                .astype(jnp.float32) * ct.astype(jnp.float32)).sum()
+
+    for tag, fn in (("flash_xlabwd", loss_flash), ("pure_xla", loss_bw)):
+        try:
+            dt = timed(jax.jit(jax.grad(fn, argnums=(0, 1, 2, 3))), q, k, v,
+                       bias)
+            print(f"RESULT {tag}_fwdbwd_ms={dt * 1e3:.2f} "
+                  f"tflops={total_flops / dt / 1e12:.2f}", flush=True)
+        except Exception as exc:  # noqa: BLE001
+            print(f"RESULT {tag}_timing=ERROR {type(exc).__name__}",
+                  flush=True)
+        _pet()
+
+    print("RESULT probe_flash_xlabwd=complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,7 @@ stage() {  # stage <artifact> <timeout_s> <cmd...>
 
 while :; do
   if [ -f probe_flash_stage1.txt.done ] && [ -f probe_flash_fix.txt.done ] \
+     && [ -f probe_flash_xlabwd.txt.done ] \
      && [ -f probe_flash_debug2.txt.done ] \
      && [ -f probe_flash_debug.txt.done ]; then
     echo "all stages captured at $(date -u +%H:%M:%S)" >> tunnel_watch2.log
@@ -41,6 +42,7 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
 " >/dev/null 2>&1; then
     echo "=== tunnel alive at $(date -u +%H:%M:%S) ===" >> tunnel_watch2.log
     { stage probe_flash_stage1.txt 600 python -u probe_flash_stage1.py \
+        && stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py \
         && stage probe_flash_debug2.txt 900 python -u probe_flash_debug2.py \
         && stage probe_flash_fix.txt 1200 python -u probe_flash_fix.py \
         && stage probe_flash_debug.txt 900 python -u probe_flash_debug.py; } \
