@@ -67,6 +67,7 @@ class Platform:
         )
         self.autoscaler = TrainingAutoscaler(self.cluster, self.gang_scheduler)
         self.metrics_server = None  # started on demand
+        self.activator = None  # started on demand (serverless front door)
         # single registry: observability iterates THIS, so a new controller
         # can never silently fall out of /metrics
         self.controllers = {
@@ -90,6 +91,16 @@ class Platform:
             self.metrics_server = MetricsServer(self, port=port).start()
         return self.metrics_server.url
 
+    def start_activator(self, port: int = 0) -> str:
+        """Serverless front door for InferenceServices (Knative activator
+        analogue): stable per-service URLs, canary traffic split, and
+        request-holding scale-from-zero. Returns the URL."""
+        from kubeflow_tpu.serving.activator import Activator
+
+        if self.activator is None:
+            self.activator = Activator(self, port=port).start()
+        return self.activator.url
+
     def _read_pod_log(self, pod_name: str, namespace: str = "default") -> str:
         path = self.pod_runtime.log_path(pod_name, namespace)
         try:
@@ -109,6 +120,9 @@ class Platform:
         return self
 
     def stop(self) -> None:
+        if self.activator is not None:
+            self.activator.stop()
+            self.activator = None
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None

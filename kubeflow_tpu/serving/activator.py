@@ -1,0 +1,178 @@
+"""Activator — the serverless front door (Knative activator analogue).
+
+Reference parity (unverified cites, SURVEY.md §2.5/§3.5): kserve's
+serverless mode rides Knative, whose activator buffers requests for a
+revision scaled to zero, pokes the autoscaler, and proxies once a pod is
+up. The TPU rebuild keeps the platform semantics: ONE stable URL per
+InferenceService (`/<namespace>/<name>/<v1|v2 path>`) that
+
+  - round-robins ready predictor endpoints, honoring the canary traffic
+    split (the istio VirtualService weight analogue),
+  - at zero ready replicas stamps a demand annotation on the ISVC (the
+    controller's scale-from-zero trigger), HOLDS the request through the
+    cold start, and proxies when an endpoint appears — AOT-exported
+    predictors make that window compile-free (serving/aot.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+#: annotation the activator stamps (epoch seconds) when a request arrives
+#: for a scaled-to-zero service; the controller reads it as demand
+DEMAND_ANNOTATION = "serving.kubeflow-tpu.org/activator-demand"
+
+
+class Activator:
+    def __init__(self, platform, port: int = 0, host: str = "127.0.0.1",
+                 activation_timeout_s: float = 45.0):
+        self.platform = platform
+        self.host = host
+        self.port = port
+        self.activation_timeout_s = activation_timeout_s
+        self._httpd: ThreadingHTTPServer | None = None
+        self._rr: dict[str, int] = {}
+        self._rr_mu = threading.Lock()
+
+    # ------------------------------------------------------------- routing
+
+    def _pick_endpoint(self, isvc) -> str | None:
+        """Weighted round-robin: canary endpoints receive
+        canaryTrafficPercent of requests when both sets are ready."""
+        primary = [e.url for e in isvc.status.endpoints if e.ready]
+        canary = [e.url for e in isvc.status.canary_endpoints if e.ready]
+        key = f"{isvc.metadata.namespace}/{isvc.metadata.name}"
+        with self._rr_mu:
+            n = self._rr[key] = self._rr.get(key, -1) + 1
+        pct = isvc.spec.canary_traffic_percent
+        if canary and pct > 0 and (primary == [] or (n % 100) < pct):
+            return canary[n % len(canary)]
+        if primary:
+            return primary[n % len(primary)]
+        return None
+
+    def _signal_demand(self, key: str) -> None:
+        def stamp(isvc):
+            isvc.metadata.annotations[DEMAND_ANNOTATION] = \
+                f"{time.time():.3f}"
+            return isvc
+
+        from kubeflow_tpu.controller.fakecluster import ConflictError
+
+        try:
+            self.platform.cluster.read_modify_write(
+                "inferenceservices", key, stamp)
+        except (KeyError, ConflictError):
+            pass  # deleted mid-request (handle() will 404/503) or hot
+            # contention — the endpoint poll below still observes scale-up
+
+    def _await_endpoint(self, key: str) -> str | None:
+        """Hold the request through a cold start: demand is signalled,
+        then the ISVC status is polled until a ready endpoint appears."""
+        cluster = self.platform.cluster
+        deadline = time.monotonic() + self.activation_timeout_s
+        self._signal_demand(key)
+        while time.monotonic() < deadline:
+            isvc = cluster.get("inferenceservices", key)
+            if isvc is None:
+                return None
+            url = self._pick_endpoint(isvc)
+            if url is not None:
+                return url
+            time.sleep(0.15)
+        return None
+
+    def handle(self, method: str, path: str, body: bytes | None,
+               content_type: str) -> tuple[int, bytes, str]:
+        parts = path.lstrip("/").split("/", 2)
+        if len(parts) < 3:
+            return 404, b'{"error": "route is /<namespace>/<name>/<path>"}', \
+                "application/json"
+        ns, name, rest = parts
+        key = f"{ns}/{name}"
+        isvc = self.platform.cluster.get("inferenceservices", key)
+        if isvc is None:
+            return 404, f'{{"error": "inferenceservice {key} not found"}}' \
+                .encode(), "application/json"
+        url = self._pick_endpoint(isvc)
+        if url is None:
+            url = self._await_endpoint(key)
+        if url is None:
+            return 503, b'{"error": "activation timed out: no replica became ready"}', \
+                "application/json"
+
+        def proxy(endpoint: str):
+            req = urllib.request.Request(
+                f"{endpoint}/{rest}", data=body, method=method,
+                headers={"Content-Type": content_type} if body else {},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60.0) as r:
+                    return r.status, r.read(), \
+                        r.headers.get("Content-Type", "application/json")
+            except urllib.error.HTTPError as e:
+                return e.code, e.read(), \
+                    e.headers.get("Content-Type", "application/json")
+            except (urllib.error.URLError, OSError):
+                return None  # transport failure — caller decides
+
+        out = proxy(url)
+        if out is not None:
+            return out
+        # replica died between probe and proxy: one retry through the
+        # cold-start wait (self-heal will restore it)
+        retry = self._await_endpoint(key)
+        if retry is None:
+            return 503, b'{"error": "no ready replica"}', "application/json"
+        out = proxy(retry)
+        if out is not None:
+            return out
+        return 502, b'{"error": "replica unreachable"}', "application/json"
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "Activator":
+        activator = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                print(f"[activator] {fmt % args}", flush=True)
+
+            def _serve(self, method: str):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else None
+                code, payload, ctype = activator.handle(
+                    method, self.path, body,
+                    self.headers.get("Content-Type", "application/json"),
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                self._serve("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._serve("POST")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True).start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

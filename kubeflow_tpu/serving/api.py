@@ -85,11 +85,15 @@ class AutoscalingSpec:
     """HPA analogue for predictors: the controller samples each replica's
     request counters and sizes the replica set to target_qps_per_replica."""
 
-    min_replicas: int = 1
+    min_replicas: int = 1  # 0 enables serverless scale-to-zero
     max_replicas: int = 4
     target_qps_per_replica: float = 10.0
     # seconds between scaling decisions (cooldown)
     scale_interval_s: float = 15.0
+    # with min_replicas=0: how long the service must be idle (zero
+    # observed qps) before the last replica is reaped (Knative
+    # scale-to-zero grace analogue)
+    scale_to_zero_grace_s: float = 30.0
 
 
 @dataclass
@@ -158,12 +162,18 @@ def validate_isvc(isvc: InferenceService) -> InferenceService:
         )
     a = isvc.spec.autoscaling
     if a is not None:
-        if not (1 <= a.min_replicas <= a.max_replicas):
+        if not (0 <= a.min_replicas <= a.max_replicas) or a.max_replicas < 1:
             raise ValueError(
-                "inferenceservice: autoscaling needs 1 <= minReplicas <= maxReplicas"
+                "inferenceservice: autoscaling needs "
+                "0 <= minReplicas <= maxReplicas, maxReplicas >= 1 "
+                "(minReplicas=0 enables scale-to-zero)"
             )
         if a.target_qps_per_replica <= 0:
             raise ValueError(
                 "inferenceservice: autoscaling.targetQpsPerReplica must be > 0"
+            )
+        if a.scale_to_zero_grace_s <= 0:
+            raise ValueError(
+                "inferenceservice: autoscaling.scaleToZeroGraceS must be > 0"
             )
     return isvc

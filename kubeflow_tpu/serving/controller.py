@@ -3,8 +3,12 @@
 Reference parity (unverified cites, SURVEY.md §2.5): kserve
 pkg/controller/v1beta1/inferenceservice in RawDeployment mode: reconcile the
 ISVC into predictor replicas, surface readiness + URL in status, self-heal
-dead replicas. Serverless (Knative activator / scale-to-zero) is out of
-scope by design (SURVEY.md §7).
+dead replicas. The SERVERLESS mode is covered too, without the
+Istio/Knative stack (that stack is out of scope per SURVEY.md §7, its
+semantics are not): minReplicas=0 reaps the last replica after
+scaleToZeroGraceS of idle, and serving/activator.py is the front door
+that holds requests through the cold start and stamps the demand
+annotation this controller wakes on.
 
 Each replica is a pod running `python -m kubeflow_tpu.serving.server`; the
 replica's port is allocated at pod-creation time and recorded in a pod
@@ -90,6 +94,9 @@ class InferenceServiceController(ControllerBase):
         # key -> (monotonic time, {endpoint url -> request total}); per-URL
         # so a restarted replica's counter reset never reads as load collapse
         self._qps_samples: dict[str, tuple[float, dict[str, int]]] = {}
+        # key -> monotonic time of the last nonzero-qps observation
+        # (drives the scale-to-zero idle grace window)
+        self._last_traffic: dict[str, float] = {}
         self.metrics.update({
             "isvc_created_total": 0,
             "isvc_ready_total": 0,
@@ -133,6 +140,7 @@ class InferenceServiceController(ControllerBase):
                 self.cluster.delete("pods", p.key)
             self._seen.discard(key)
             self._qps_samples.pop(key, None)
+            self._last_traffic.pop(key, None)
             return None
         if key not in self._seen:
             self._seen.add(key)
@@ -286,7 +294,10 @@ class InferenceServiceController(ControllerBase):
     def _autoscale(self, isvc: InferenceService, key: str, endpoints) -> None:
         """HPA analogue: size the primary replica set to the observed request
         rate (kfserving_requests_total deltas from each ready replica's
-        /metrics), clamped to [min, max], one decision per scale interval."""
+        /metrics), clamped to [min, max], one decision per scale interval.
+        minReplicas=0 adds the serverless pair: scale-from-zero when the
+        activator stamps fresh demand, scale-TO-zero after the idle grace
+        window (Knative autoscaler analogue)."""
         a = isvc.spec.autoscaling
         if a is None:
             return
@@ -295,6 +306,24 @@ class InferenceServiceController(ControllerBase):
         import time
 
         now = time.monotonic()
+
+        if isvc.spec.predictor.replicas == 0:
+            # scaled to zero: the only wake signal is activator demand
+            # (no replicas -> no counters to sample); must not sit behind
+            # the decision cooldown — activation latency IS the product
+            from kubeflow_tpu.serving.activator import DEMAND_ANNOTATION
+
+            stamp = isvc.metadata.annotations.get(DEMAND_ANNOTATION, "")
+            try:
+                fresh = (time.time() - float(stamp)) < a.scale_to_zero_grace_s
+            except ValueError:
+                fresh = False
+            if fresh:
+                self._scale_to(isvc, key, a, max(a.min_replicas, 1),
+                               reason="activator demand")
+                self._last_traffic[key] = now
+            return
+
         prev = self._qps_samples.get(key)
         if prev is not None and now - prev[0] < a.scale_interval_s:
             return  # inside the decision window: no sampling, no blocking IO
@@ -320,6 +349,13 @@ class InferenceServiceController(ControllerBase):
             return
         self._qps_samples[key] = (now, counts)
         if prev is None:
+            # first sample for this (possibly restarted) controller: a
+            # nonzero counter is traffic accrued since pod start — it must
+            # refresh the idle clock, or a cold start longer than the
+            # grace window would reap the replica right after it serves
+            # the request that woke it
+            if sum(counts.values()) > 0:
+                self._last_traffic[key] = now
             return
         t0, counts0 = prev
         dt = max(now - t0, 1e-6)
@@ -330,14 +366,32 @@ class InferenceServiceController(ControllerBase):
             max(c - counts0.get(url, 0), 0) for url, c in counts.items()
         )
         qps = delta / dt
+        if qps > 0 or key not in self._last_traffic:
+            self._last_traffic[key] = now
+        floor = a.min_replicas
+        if floor == 0:
+            # serverless: hold one replica while traffic is recent; reap
+            # the last replica only after the idle grace window
+            idle_s = now - self._last_traffic[key]
+            floor = 0 if idle_s >= a.scale_to_zero_grace_s else 1
         desired = int(
-            min(max(math.ceil(qps / a.target_qps_per_replica), a.min_replicas),
+            min(max(math.ceil(qps / a.target_qps_per_replica), floor),
                 a.max_replicas)
         )
         if desired == isvc.spec.predictor.replicas:
             return
+        reason = (f"observed {qps:.1f} qps, "
+                  f"target {a.target_qps_per_replica}/replica"
+                  if desired else
+                  f"idle {now - self._last_traffic[key]:.0f}s >= "
+                  f"scaleToZeroGraceS {a.scale_to_zero_grace_s:.0f}s")
+        self._scale_to(isvc, key, a, desired, reason=reason)
+
+    def _scale_to(self, isvc: InferenceService, key: str, a, desired: int,
+                  reason: str) -> None:
         cur = self.cluster.get("inferenceservices", key, copy_obj=True)
-        if cur is None or cur.spec.autoscaling is None:
+        if (cur is None or cur.spec.autoscaling is None
+                or cur.spec.predictor.replicas == desired):
             return
         cur.spec.predictor.replicas = desired
         try:
@@ -346,8 +400,7 @@ class InferenceServiceController(ControllerBase):
             return
         self.cluster.record_event(
             "inferenceservices", key, "Autoscaled",
-            f"replicas -> {desired} (observed {qps:.1f} qps, "
-            f"target {a.target_qps_per_replica}/replica)",
+            f"replicas -> {desired} ({reason})",
         )
 
     # ------------------------------------------------------------- sub-steps

@@ -207,3 +207,87 @@ class TestExplainer:
         with _pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(req)
         assert ei.value.code == 404
+
+
+class TestScaleToZero:
+    """Serverless (Knative activator analogue): minReplicas=0 reaps the
+    last replica after the idle grace, the activator holds requests
+    through a cold start and triggers scale-from-zero."""
+
+    def _make(self, platform, grace=2.0):
+        serving = ServingClient(platform)
+        serving.create(InferenceService(
+            metadata=ObjectMeta(name="zero-svc"),
+            spec=InferenceServiceSpec(
+                predictor=_custom("tests.serving_fixtures:DoubleModel"),
+                autoscaling=AutoscalingSpec(
+                    min_replicas=0, max_replicas=2,
+                    target_qps_per_replica=1000.0,
+                    scale_interval_s=0.5,
+                    scale_to_zero_grace_s=grace,
+                ),
+            ),
+        ))
+        serving.wait_ready("zero-svc", timeout_s=60)
+        return serving
+
+    def _wait_replicas(self, serving, n, timeout_s=45):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            isvc = serving.get("zero-svc")
+            if (isvc.spec.predictor.replicas == n
+                    and isvc.status.replicas_ready == n):
+                return isvc
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"never reached {n} replicas "
+            f"(spec={isvc.spec.predictor.replicas}, "
+            f"ready={isvc.status.replicas_ready})")
+
+    def test_idle_service_scales_to_zero_and_back(self, platform):
+        import json
+        import urllib.request
+
+        serving = self._make(platform)
+        url = platform.start_activator()
+
+        # warm request through the stable front door
+        req = urllib.request.Request(
+            f"{url}/default/zero-svc/v1/models/zero-svc:predict",
+            data=json.dumps({"instances": [[2.0]]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["predictions"] == [[4.0]]
+
+        # idle past the grace window -> reaped to zero
+        self._wait_replicas(serving, 0)
+        from kubeflow_tpu.serving.controller import ISVC_LABEL
+
+        assert not [
+            p for p in platform.cluster.list("pods")
+            if p.metadata.labels.get(ISVC_LABEL) == "zero-svc"
+        ]
+
+        # a request against the zero-scaled service is HELD through the
+        # cold start and answered (activator demand -> scale-from-zero)
+        t0 = time.monotonic()
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert json.loads(r.read())["predictions"] == [[4.0]]
+        cold_start_s = time.monotonic() - t0
+        isvc = serving.get("zero-svc")
+        assert isvc.spec.predictor.replicas >= 1
+        events = [e.reason for e in
+                  platform.cluster.events_for("default/zero-svc")]
+        assert "Autoscaled" in events
+        assert cold_start_s < 45
+
+    def test_activator_404_for_unknown_service(self, platform):
+        import urllib.error
+        import urllib.request
+
+        platform.start_activator()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{platform.activator.url}/default/ghost/v1/models/g",
+                timeout=10)
+        assert e.value.code == 404
