@@ -31,6 +31,9 @@ def main(argv: list[str] | None = None) -> float:
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--model-parallel", type=int, default=1)
     p.add_argument("--context", type=int, default=1)
+    p.add_argument("--num-kv-heads", type=int, default=0,
+                   help="GQA: KV heads (< num_heads shrinks the KV cache; "
+                        "0 = MHA)")
     p.add_argument("--checkpoint-dir", default=None)
     args = p.parse_args(argv)
 
@@ -53,7 +56,16 @@ def main(argv: list[str] | None = None) -> float:
         attention=args.attention,
         max_len=max(args.seq_len, 256),
         dropout_rate=0.0 if args.attention != "dense" else 0.1,
+        num_kv_heads=args.num_kv_heads,
     )
+    if args.model_parallel > 1 and args.num_kv_heads and \
+            args.num_kv_heads % args.model_parallel:
+        raise SystemExit(
+            f"--num-kv-heads {args.num_kv_heads} must divide by "
+            f"--model-parallel {args.model_parallel}: the K/V kernels "
+            "shard their head axis over the model mesh axis, and a "
+            "non-dividing count silently falls back to a replicated "
+            "(degraded) TP layout")
     ds = synthetic_lm_dataset(
         n_train=args.batch_size * 8,
         n_test=args.batch_size * 2,

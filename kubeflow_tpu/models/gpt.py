@@ -48,6 +48,11 @@ class GPTConfig:
     hidden_size: int = 768
     num_layers: int = 12
     num_heads: int = 12
+    # grouped-query attention (Llama/Mistral shape): K/V projected to this
+    # many heads, each shared by num_heads/num_kv_heads query heads. 0 =
+    # num_heads (MHA); 1 = multi-query. The KV cache shrinks by the same
+    # ratio — the direct lever on decode, which is HBM-bandwidth-bound.
+    num_kv_heads: int = 0
     mlp_dim: int = 3072
     max_len: int = 1024
     dropout_rate: float = 0.1
@@ -70,6 +75,13 @@ class GPTConfig:
             raise ValueError(
                 f"hidden_size {self.hidden_size} not divisible by "
                 f"num_heads {self.num_heads}"
+            )
+        if self.num_kv_heads and (
+                self.num_kv_heads < 0
+                or self.num_heads % self.num_kv_heads):
+            raise ValueError(
+                f"num_kv_heads {self.num_kv_heads} must be a positive "
+                f"divisor of num_heads {self.num_heads} (or 0 for MHA)"
             )
         if self.moe_experts and self.moe_top_k > self.moe_experts:
             raise ValueError(
@@ -113,13 +125,24 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x, bias, train: bool, decode: bool = False):
         c = self.cfg
         head_dim = c.hidden_size // c.num_heads
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (c.num_heads, head_dim), dtype=c.dtype, name=name
+        kv_heads = c.num_kv_heads or c.num_heads
+        heads = lambda n, name: nn.DenseGeneral(  # noqa: E731
+            (n, head_dim), dtype=c.dtype, name=name
         )
-        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        q = heads(c.num_heads, "query")(x)
+        k = heads(kv_heads, "key")(x)
+        v = heads(kv_heads, "value")(x)
         if decode:
             y = self._cached_attention(q, k, v)
         else:
+            if kv_heads != c.num_heads:
+                # training path: broadcast KV groups up to full heads (the
+                # parameter + cache savings stand; the attention kernels
+                # stay single-shape). Decode keeps the grouped einsum and
+                # the small cache — that's where the bandwidth win lives.
+                group = c.num_heads // kv_heads
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
             rng = (self.make_rng("dropout")
                    if train and c.dropout_rate > 0 else None)
             if c.attention == "dense":
@@ -138,19 +161,23 @@ class CausalSelfAttention(nn.Module):
     def _cached_attention(self, q, k, v):
         """KV-cache attention — ONE static-shape code path for both prefill
         (L = prompt length) and decode (L = 1), the TPU-idiomatic
-        autoregressive loop: the cache is a fixed (B, max_len, H, D) buffer,
-        new K/V write at the running index via dynamic_update_slice, and
-        every step attends over the full buffer under a position mask — no
-        shape ever depends on how many tokens have been generated, so XLA
-        compiles exactly two executables (prefill + decode step)."""
+        autoregressive loop: the cache is a fixed (B, max_len, KVH, D)
+        buffer, new K/V write at the running index via
+        dynamic_update_slice, and every step attends over the full buffer
+        under a position mask — no shape ever depends on how many tokens
+        have been generated, so XLA compiles exactly two executables
+        (prefill + decode step). Under GQA (KVH < H) the query heads fold
+        into (KVH, group) and the einsums contract against the small cache
+        directly — the repeated-KV tensor is never materialized."""
         c = self.cfg
         b, l, h, d = q.shape
+        kvh = k.shape[2]
         ck = self.variable(
             "cache", "cached_key",
-            lambda: jnp.zeros((b, c.max_len, h, d), c.dtype))
+            lambda: jnp.zeros((b, c.max_len, kvh, d), c.dtype))
         cv = self.variable(
             "cache", "cached_value",
-            lambda: jnp.zeros((b, c.max_len, h, d), c.dtype))
+            lambda: jnp.zeros((b, c.max_len, kvh, d), c.dtype))
         idx = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
         cur = idx.value
@@ -159,15 +186,17 @@ class CausalSelfAttention(nn.Module):
         idx.value = cur + l
         q_pos = cur + jnp.arange(l)                      # (L,)
         k_pos = jnp.arange(c.max_len)                    # (max_len,)
-        s = jnp.einsum("blhd,bmhd->bhlm", q, ck.value).astype(jnp.float32)
+        qg = q.reshape(b, l, kvh, h // kvh, d)
+        s = jnp.einsum("blkgd,bmkd->bkglm", qg, ck.value).astype(jnp.float32)
         s = s / jnp.sqrt(jnp.float32(d))
         # causal + not-yet-written mask in one comparison: a key position is
         # visible iff it <= this query's position (unwritten slots are all
         # > cur + l - 1 by construction)
         visible = k_pos[None, :] <= q_pos[:, None]       # (L, max_len)
-        s = jnp.where(visible[None, None], s, -1e9)
+        s = jnp.where(visible[None, None, None], s, -1e9)
         p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhlm,bmhd->blhd", p, cv.value)
+        y = jnp.einsum("bkglm,bmkd->blkgd", p, cv.value)
+        return y.reshape(b, l, h, d)
 
 
 class GPTBlock(nn.Module):

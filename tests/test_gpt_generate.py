@@ -433,3 +433,65 @@ def test_isvc_generative_predictor_http(tmp_path, lm):
     np.testing.assert_array_equal(
         np.asarray(body["predictions"]), np.asarray(want)
     )
+
+
+class TestGroupedQueryAttention:
+    """GQA (Llama/Mistral shape): fewer KV heads, grouped-einsum decode
+    over a cache that shrinks by num_heads/num_kv_heads."""
+
+    @pytest.fixture(scope="class")
+    def gqa_lm(self):
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=64, num_kv_heads=2)
+        model = GPTLM(cfg, pad_token_id=-1)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 1,
+                                    cfg.vocab_size, jnp.int32)
+        variables = model.init(jax.random.PRNGKey(2), prompt)
+        return model, variables, prompt
+
+    def test_decode_matches_full_forward(self, gqa_lm):
+        model, variables, prompt = gqa_lm
+        got = generate(model, variables, prompt, max_new_tokens=6)
+        want = _greedy_reference(model, variables, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_cache_shrinks_by_group_ratio(self, gqa_lm):
+        model, variables, prompt = gqa_lm
+        _, cache = model.apply(variables, prompt, decode=True,
+                               mutable=["cache"])
+        key_shapes = [
+            x.shape for x in jax.tree_util.tree_leaves(cache["cache"])
+            if getattr(x, "ndim", 0) == 4
+        ]
+        assert key_shapes and all(s[2] == 2 for s in key_shapes)  # KVH=2
+        # parameters shrink too: key/value kernels are (hidden, KVH, D)
+        p0 = variables["params"]["layer_0"]["attention"]
+        assert p0["key"]["kernel"].shape[1] == 2
+        assert p0["query"]["kernel"].shape[1] == 4
+
+    def test_mqa_single_kv_head(self):
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=32, num_kv_heads=1)
+        model = GPTLM(cfg, pad_token_id=-1)
+        prompt = jnp.array([[1, 2, 3]], jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), prompt)
+        got = generate(model, variables, prompt, max_new_tokens=4)
+        want = _greedy_reference(model, variables, prompt, 4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_training_path_gradients_flow(self, gqa_lm):
+        from kubeflow_tpu.models.gpt import causal_lm_loss
+
+        model, variables, prompt = gqa_lm
+
+        def loss(params):
+            logits = model.apply({"params": params}, prompt)
+            return causal_lm_loss(logits, prompt)
+
+        g = jax.grad(loss)(variables["params"])
+        gk = g["layer_0"]["attention"]["key"]["kernel"]
+        assert float(jnp.abs(gk).sum()) > 0
+
+    def test_invalid_group_rejected(self):
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            GPTConfig.tiny(num_kv_heads=3)  # 4 heads % 3 != 0
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            GPTConfig.tiny(num_kv_heads=-1)
