@@ -34,6 +34,8 @@ def main(argv: list[str] | None = None) -> float:
     p.add_argument("--num-kv-heads", type=int, default=0,
                    help="GQA: KV heads (< num_heads shrinks the KV cache; "
                         "0 = MHA)")
+    p.add_argument("--position-embedding", default="learned",
+                   choices=["learned", "rope"])
     p.add_argument("--checkpoint-dir", default=None)
     args = p.parse_args(argv)
 
@@ -57,6 +59,7 @@ def main(argv: list[str] | None = None) -> float:
         max_len=max(args.seq_len, 256),
         dropout_rate=0.0 if args.attention != "dense" else 0.1,
         num_kv_heads=args.num_kv_heads,
+        position_embedding=args.position_embedding,
     )
     if args.model_parallel > 1 and args.num_kv_heads and \
             args.num_kv_heads % args.model_parallel:

@@ -6,9 +6,11 @@ first-class here (SURVEY.md §5.7): causal ring attention shards the sequence
 over the `context` axis with GLOBAL-position masking (parallel/ring_attention
 .py), so a sequence 8x one device's memory trains with the same module.
 
-Architecture: pre-LN transformer decoder (GPT-2 shape), learned positions,
-weight-tied LM head, bf16 compute / f32 params. TP/FSDP via the same
-declarative PARTITION_RULES mechanism as BERT.
+Architecture: pre-LN transformer decoder (GPT-2 shape), learned OR rotary
+positions (GPTConfig.position_embedding — rope has no position table),
+optional grouped-query attention (num_kv_heads), weight-tied LM head,
+bf16 compute / f32 params. TP/FSDP via the same declarative
+PARTITION_RULES mechanism as BERT.
 """
 
 from __future__ import annotations
@@ -53,6 +55,12 @@ class GPTConfig:
     # num_heads (MHA); 1 = multi-query. The KV cache shrinks by the same
     # ratio — the direct lever on decode, which is HBM-bandwidth-bound.
     num_kv_heads: int = 0
+    # "learned" (GPT-2 absolute embeddings) | "rope" (rotary, the
+    # Llama/Mistral scheme: positions enter as Q/K rotations per layer,
+    # no position table — decode rotates by the cache index, so the
+    # pattern extrapolates with sequence position)
+    position_embedding: str = "learned"
+    rope_theta: float = 10000.0
     mlp_dim: int = 3072
     max_len: int = 1024
     dropout_rate: float = 0.1
@@ -83,6 +91,21 @@ class GPTConfig:
                 f"num_kv_heads {self.num_kv_heads} must be a positive "
                 f"divisor of num_heads {self.num_heads} (or 0 for MHA)"
             )
+        if self.position_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"position_embedding {self.position_embedding!r} "
+                "(learned|rope)")
+        if self.position_embedding == "rope":
+            if (self.hidden_size // self.num_heads) % 2:
+                raise ValueError(
+                    "rope needs an even head_dim "
+                    f"(got {self.hidden_size // self.num_heads})")
+            if self.attention in ("ring", "ulysses"):
+                raise ValueError(
+                    "rope under context parallelism is not wired: the "
+                    "per-shard rotation offset is not plumbed through the "
+                    f"{self.attention} path — use dense|flash, or "
+                    "learned positions with context parallelism")
         if self.moe_experts and self.moe_top_k > self.moe_experts:
             raise ValueError(
                 f"moe_top_k {self.moe_top_k} > moe_experts "
@@ -99,6 +122,20 @@ class GPTConfig:
                  mlp_dim=128, max_len=256)
         d.update(kw)
         return GPTConfig(**d)
+
+
+def apply_rope(x, pos, theta: float = 10000.0):
+    """Rotary position embedding (half-split convention): rotate each
+    head-dim pair by pos * theta^(-2i/d). x: (B, L, H, D), pos: (L,)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # (L, D/2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
 
 
 def causal_dense_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
@@ -135,6 +172,10 @@ class CausalSelfAttention(nn.Module):
         if decode:
             y = self._cached_attention(q, k, v)
         else:
+            if c.position_embedding == "rope":
+                pos = jnp.arange(q.shape[1])
+                q = apply_rope(q, pos, c.rope_theta)
+                k = apply_rope(k, pos, c.rope_theta)
             if kv_heads != c.num_heads:
                 # training path: broadcast KV groups up to full heads (the
                 # parameter + cache savings stand; the attention kernels
@@ -181,10 +222,16 @@ class CausalSelfAttention(nn.Module):
         idx = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
         cur = idx.value
+        q_pos = cur + jnp.arange(l)                      # (L,)
+        if c.position_embedding == "rope":
+            # rotate by ABSOLUTE position before the cache write: cached
+            # keys carry their rotation, so one decode step only rotates
+            # the new (q, k) pair
+            q = apply_rope(q, q_pos, c.rope_theta)
+            k = apply_rope(k, q_pos, c.rope_theta)
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
         idx.value = cur + l
-        q_pos = cur + jnp.arange(l)                      # (L,)
         k_pos = jnp.arange(c.max_len)                    # (max_len,)
         qg = q.reshape(b, l, kvh, h // kvh, d)
         s = jnp.einsum("blkgd,bmkd->bkglm", qg, ck.value).astype(jnp.float32)
@@ -258,8 +305,10 @@ class GPTLM(nn.Module):
             pos = jnp.arange(input_ids.shape[1])[None, :]
             mask = input_ids != self.pad_token_id
             bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
-        x = x + VocabEmbed(c.max_len, c.hidden_size, dtype=c.dtype,
-                           name="position_embed")(pos)
+        if c.position_embedding == "learned":
+            x = x + VocabEmbed(c.max_len, c.hidden_size, dtype=c.dtype,
+                               name="position_embed")(pos)
+        # rope: positions enter per-layer as Q/K rotations — no table
         x = nn.Dropout(c.dropout_rate, deterministic=not train)(x)
         x = constrain(x, ACT_SPEC)
         # remat never wraps the decode path: generation is forward-only and

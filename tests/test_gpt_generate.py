@@ -495,3 +495,52 @@ class TestGroupedQueryAttention:
             GPTConfig.tiny(num_kv_heads=3)  # 4 heads % 3 != 0
         with pytest.raises(ValueError, match="num_kv_heads"):
             GPTConfig.tiny(num_kv_heads=-1)
+
+
+class TestRope:
+    """Rotary position embeddings: per-layer Q/K rotation by absolute
+    position, no learned table; decode rotates by the cache index so
+    cached keys carry their rotation."""
+
+    @pytest.fixture(scope="class")
+    def rope_lm(self):
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=64,
+                             position_embedding="rope", num_kv_heads=2)
+        model = GPTLM(cfg, pad_token_id=-1)
+        prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 5), 1,
+                                    cfg.vocab_size, jnp.int32)
+        variables = model.init(jax.random.PRNGKey(4), prompt)
+        return model, variables, prompt
+
+    def test_decode_matches_full_forward(self, rope_lm):
+        model, variables, prompt = rope_lm
+        got = generate(model, variables, prompt, max_new_tokens=6)
+        want = _greedy_reference(model, variables, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_no_position_table(self, rope_lm):
+        _, variables, _ = rope_lm
+        assert "position_embed" not in variables["params"]
+
+    def test_relative_shift_invariance(self):
+        """The rope attention pattern depends on RELATIVE position: the
+        same bigram later in the sequence attends identically (the
+        property learned absolute embeddings lack)."""
+        from kubeflow_tpu.models.gpt import apply_rope
+
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 2, 8))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 2, 8))
+        def score(qpos, kpos):
+            qr = apply_rope(q, jnp.array([qpos]))
+            kr = apply_rope(k, jnp.array([kpos]))
+            return float(jnp.einsum("blhd,bmhd->bhlm", qr, kr).sum())
+        np.testing.assert_allclose(score(7, 3), score(27, 23), rtol=1e-5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="ring"):
+            GPTConfig.tiny(position_embedding="rope", attention="ring")
+        with pytest.raises(ValueError, match="even head_dim"):
+            GPTConfig.tiny(position_embedding="rope", hidden_size=60,
+                           mlp_dim=120)
+        with pytest.raises(ValueError, match="learned|rope"):
+            GPTConfig.tiny(position_embedding="alibi")
