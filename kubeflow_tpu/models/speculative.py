@@ -1,0 +1,152 @@
+"""Speculative decoding — draft-accelerated generation, target-exact.
+
+Decode is HBM-bandwidth-bound: every generated token streams the whole
+model once (models/gpt.py#generate). Speculative decoding (Leviathan et
+al. 2023 / Chen et al. 2023 pattern) amortizes that: a small DRAFT model
+proposes `gamma` tokens autoregressively, then the TARGET model scores
+all of them in ONE forward pass (a gamma+1-token prefill over the KV
+cache — MXU-shaped work instead of gamma bandwidth-bound steps) and
+accepts the longest prefix it agrees with, emitting its own correction
+token at the first disagreement. Greedy mode here: acceptance is
+argmax-match, so the output is EXACTLY the target model's greedy decode
+for ANY draft — a random draft only costs speed, never correctness
+(pinned by test).
+
+TPU-first shape: `gamma` is static, every round is the same two
+executables (draft scan + target prefill), and the variable accepted
+length only moves the CACHE INDEX — stale cache rows past the index are
+invisible by construction (the position mask attends only to
+k_pos <= q_pos), so "rewinding" after a rejection is one scalar write,
+no buffer surgery. The outer loop is a lax.while_loop on tokens
+generated; everything jits once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.gpt import GPTLM
+
+
+def _set_cache_index(cache: dict, value) -> dict:
+    """Rewind/advance every layer's cache_index (and the LM's pos_index)
+    to `value` — the whole cost of rejecting speculated tokens."""
+    def fix(path, leaf):
+        name = getattr(path[-1], "key", path[-1]) if path else ""
+        if name in ("cache_index", "pos_index"):
+            return jnp.asarray(value, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def speculative_generate(
+    target: GPTLM,
+    target_variables: dict,
+    draft: GPTLM,
+    draft_variables: dict,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    gamma: int = 4,
+):
+    """Greedy speculative decoding. Returns (tokens (1, max_new_tokens),
+    stats dict with 'rounds' and 'drafted_accepted').
+
+    Batch size 1 (rows diverge in accepted length; a batched variant
+    needs per-row cache indices). The draft must share the target's
+    vocabulary; nothing else — architectures, sizes, and even weights may
+    differ arbitrarily.
+    """
+    b, prompt_len = prompt_ids.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative_generate is batch-1 (got batch {b}): accepted "
+            "length diverges per row; run rows as separate calls")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    for m, name in ((target, "target"), (draft, "draft")):
+        if prompt_len + max_new_tokens + gamma + 1 > m.cfg.max_len:
+            raise ValueError(
+                f"{name}.cfg.max_len {m.cfg.max_len} < prompt {prompt_len} "
+                f"+ max_new_tokens {max_new_tokens} + gamma+1 {gamma + 1}")
+
+    # prefill both caches over the prompt; first token comes from the
+    # target alone (same as plain greedy)
+    t_logits, t_cache = target.apply(
+        target_variables, prompt_ids, decode=True, mutable=["cache"])
+    _, d_cache = draft.apply(
+        draft_variables, prompt_ids, decode=True, mutable=["cache"])
+    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (1,)
+
+    buf0 = jnp.zeros((max_new_tokens + gamma + 1,), jnp.int32)
+    buf0 = buf0.at[0].set(first[0])
+
+    def draft_step(carry, _):
+        cache, tok = carry
+        logits, cache = draft.apply(
+            {**draft_variables, **cache}, tok[:, None], decode=True,
+            mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    def round_body(state):
+        buf, n, t_cache, d_cache, rounds, accepted_total = state
+        last = buf[n - 1][None]                                # (1,)
+        # --- draft proposes gamma tokens ------------------------------
+        (d_cache, p_last), proposals = jax.lax.scan(
+            draft_step, (d_cache, last), None, length=gamma)
+        proposals = proposals[:, 0]                            # (gamma,)
+        # one extra draft step writes p_gamma into the draft cache (its
+        # proposal is discarded) so an all-accepted round leaves no
+        # unwritten row below the advanced cache index
+        (d_cache, _), _ = draft_step((d_cache, p_last), None)
+        # --- target scores last + ALL proposals in ONE pass -----------
+        inp = jnp.concatenate([last, proposals])[None, :]   # (1, gamma+1)
+        logits, t_cache_adv = target.apply(
+            {**target_variables, **t_cache}, inp, decode=True,
+            mutable=["cache"])
+        # t_tokens[i] = target's own choice after accepting i proposals
+        t_tokens = jnp.argmax(logits[0], axis=-1).astype(
+            jnp.int32)                                      # (gamma+1,)
+        # accept while the draft matches the target's own choice
+        agree = jnp.cumprod(
+            (proposals == t_tokens[:gamma]).astype(jnp.int32))
+        a = agree.sum()                     # accepted draft tokens, 0..gamma
+        # emit proposals[:a], then the target's correction t_tokens[a]
+        # (when a == gamma that's the target's continuation past the whole
+        # accepted block); slots past a+1 hold the correction too — they
+        # are overwritten by the next round or trimmed at max_new_tokens
+        padded = jnp.concatenate([proposals, jnp.zeros((1,), jnp.int32)])
+        upd = jnp.where(jnp.arange(gamma + 1) < a, padded, t_tokens[a])
+        buf = jax.lax.dynamic_update_slice(buf, upd, (n,))
+        n = n + a + 1
+        # --- cache bookkeeping ----------------------------------------
+        # both caches wrote gamma+1 rows (last + proposals); only
+        # last + the a accepted stay valid. Rows past the index are
+        # unreachable (the position mask attends k_pos <= q_pos), so ONE
+        # scalar write is the whole rewind.
+        base = prompt_len + n - 1
+        t_cache = {"cache": _set_cache_index(
+            t_cache_adv["cache"], base)}
+        d_cache = {"cache": _set_cache_index(d_cache["cache"], base)}
+        return (buf, n, t_cache, d_cache, rounds + 1, accepted_total + a)
+
+    def cond(state):
+        _, n, *_rest = state
+        return n < max_new_tokens
+
+    state0 = (buf0, jnp.asarray(1, jnp.int32),
+              {"cache": _set_cache_index(t_cache["cache"],
+                                         prompt_len)},
+              {"cache": _set_cache_index(d_cache["cache"], prompt_len)},
+              jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+    buf, n, _, _, rounds, accepted = jax.lax.while_loop(
+        cond, round_body, state0)
+    return buf[None, :max_new_tokens], {
+        "rounds": rounds, "drafted_accepted": accepted,
+        "tokens": jnp.minimum(n, max_new_tokens),
+    }

@@ -1,0 +1,112 @@
+"""Speculative decoding: draft-accelerated, provably target-exact
+(models/speculative.py)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.gpt import GPTConfig, GPTLM, generate
+from kubeflow_tpu.models.speculative import speculative_generate
+
+
+@pytest.fixture(scope="module")
+def target_lm():
+    cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96)
+    model = GPTLM(cfg, pad_token_id=-1)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 1,
+                                cfg.vocab_size, jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), prompt)
+    return model, variables, prompt
+
+
+def _draft(seed: int, **kw):
+    cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96, hidden_size=32,
+                         num_heads=2, mlp_dim=64, num_layers=1, **kw)
+    model = GPTLM(cfg, pad_token_id=-1)
+    variables = model.init(jax.random.PRNGKey(seed),
+                           jnp.ones((1, 4), jnp.int32))
+    return model, variables
+
+
+class TestTargetExactness:
+    def test_random_draft_preserves_target_output(self, target_lm):
+        """The defining property: ANY draft (here an untrained 1-layer
+        net) yields exactly the target's greedy decode — speculation
+        trades speed, never correctness."""
+        model, variables, prompt = target_lm
+        want = generate(model, variables, prompt, max_new_tokens=20)
+        for seed in (7, 8):
+            dm, dv = _draft(seed)
+            got, stats = speculative_generate(
+                model, variables, dm, dv, prompt,
+                max_new_tokens=20, gamma=3)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+
+    def test_self_draft_accepts_everything(self, target_lm):
+        """Draft == target: every proposal accepted, so N tokens take
+        ceil((N-1)/(gamma+1)) rounds after the free first token."""
+        model, variables, prompt = target_lm
+        n, gamma = 19, 3
+        want = generate(model, variables, prompt, max_new_tokens=n)
+        got, stats = speculative_generate(
+            model, variables, model, variables, prompt,
+            max_new_tokens=n, gamma=gamma)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert int(stats["rounds"]) == math.ceil((n - 1) / (gamma + 1))
+        assert int(stats["drafted_accepted"]) == \
+            int(stats["rounds"]) * gamma
+
+    def test_gqa_rope_target_with_plain_draft(self):
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96,
+                             num_kv_heads=2, position_embedding="rope")
+        model = GPTLM(cfg, pad_token_id=-1)
+        prompt = jnp.array([[3, 1, 4]], jnp.int32)
+        variables = model.init(jax.random.PRNGKey(2), prompt)
+        want = generate(model, variables, prompt, max_new_tokens=12)
+        dm, dv = _draft(9)
+        got, _ = speculative_generate(model, variables, dm, dv, prompt,
+                                      max_new_tokens=12, gamma=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_jittable(self, target_lm):
+        model, variables, prompt = target_lm
+        dm, dv = _draft(7)
+        fn = jax.jit(lambda tv, dvv, p: speculative_generate(
+            model, tv, dm, dvv, p, max_new_tokens=10, gamma=2)[0])
+        a = fn(variables, dv, prompt)
+        b = fn(variables, dv, prompt)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestValidation:
+    def test_batch_one_only(self, target_lm):
+        model, variables, _ = target_lm
+        dm, dv = _draft(7)
+        with pytest.raises(ValueError, match="batch-1"):
+            speculative_generate(model, variables, dm, dv,
+                                 jnp.ones((2, 4), jnp.int32), 8)
+
+    def test_gamma_positive(self, target_lm):
+        model, variables, prompt = target_lm
+        dm, dv = _draft(7)
+        with pytest.raises(ValueError, match="gamma"):
+            speculative_generate(model, variables, dm, dv, prompt, 8,
+                                 gamma=0)
+
+    def test_budget_checked_with_slack(self, target_lm):
+        model, variables, prompt = target_lm
+        dm, dv = _draft(7)
+        with pytest.raises(ValueError, match="max_len"):
+            speculative_generate(model, variables, dm, dv, prompt,
+                                 max_new_tokens=90, gamma=4)
+
+    def test_max_new_tokens_positive(self, target_lm):
+        model, variables, prompt = target_lm
+        dm, dv = _draft(7)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            speculative_generate(model, variables, dm, dv, prompt,
+                                 max_new_tokens=0)
