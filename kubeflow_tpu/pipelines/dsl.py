@@ -212,26 +212,37 @@ class Pipeline:
 class _PipelineContext:
     _local = threading.local()
 
-    def __init__(self, name: str, description: str):
+    def __init__(self, name: str, description: str,
+                 task_prefix: str = ""):
         self.pipeline = Pipeline(name, description, {}, {})
         self._counts: dict[str, int] = {}
         self.cond_stack: list["Condition"] = []
+        # nested-pipeline inlining: tasks are BORN with their final
+        # prefixed names, so every intra-sub TaskOutput reference is
+        # correct by construction and references passed in from the
+        # caller are never rewritten (a post-hoc rename pass cannot tell
+        # an outer producer from a same-named sub task)
+        self.task_prefix = task_prefix
 
     @classmethod
     def current(cls) -> "_PipelineContext | None":
         return getattr(cls._local, "ctx", None)
 
     def __enter__(self):
+        # re-entrant: nested-pipeline tracing opens a child context and
+        # must restore the ENCLOSING one on exit, not clear it
+        self._prev = _PipelineContext.current()
         self._local.ctx = self
         return self
 
     def __exit__(self, *exc):
-        self._local.ctx = None
+        self._local.ctx = self._prev
 
     def add_task(self, comp: Component, arguments: dict[str, Any]) -> Task:
         n = self._counts.get(comp.name, 0)
         self._counts[comp.name] = n + 1
-        tname = comp.name if n == 0 else f"{comp.name}-{n + 1}"
+        base = comp.name if n == 0 else f"{comp.name}-{n + 1}"
+        tname = f"{self.task_prefix}{base}"
         task = Task(
             name=tname, component=comp, arguments=arguments,
             conditions=list(self.cond_stack),
@@ -419,14 +430,66 @@ def sweep(name: str, manifest: str, timeout_s: float = 3600.0) -> SweepComponent
     return SweepComponent(name=name, manifest=manifest, timeout_s=timeout_s)
 
 
+def _inline_subpipeline(f: Callable, pname: str, outer: "_PipelineContext",
+                        overrides: dict):
+    """kfp v2 pipeline-in-pipeline: calling a @pipeline inside another
+    traces the sub-pipeline and INLINES its tasks into the caller —
+    flattening is execution-equivalent to upstream's sub-DAG component
+    and keeps one IR/runner shape. Sub-pipeline arguments substitute
+    directly (constants, the caller's params, or upstream TaskOutputs);
+    tasks are born with invocation-unique prefixed names (no post-hoc
+    rename pass, so outer references can never be miswired by a name
+    collision) and inherit the caller's active `when` conditions. The
+    traced return value flows back verbatim — a sub returning its own
+    parameter passes the caller's value through."""
+    sig = inspect.signature(f)
+    placeholders: dict[str, Any] = {}
+    for arg_name, p in sig.parameters.items():
+        if arg_name in overrides:
+            placeholders[arg_name] = overrides[arg_name]
+        elif p.default is not inspect.Parameter.empty:
+            placeholders[arg_name] = p.default
+        else:
+            raise TypeError(
+                f"nested pipeline {pname!r}: missing argument {arg_name!r}")
+    unknown = set(overrides) - set(sig.parameters)
+    if unknown:
+        raise TypeError(
+            f"nested pipeline {pname!r}: unknown argument(s) "
+            f"{sorted(unknown)}")
+    # invocation-unique prefix: first call 'sub-', k-th call 'sub-k-'
+    inv_key = f"__pipeline__{pname}"
+    n = outer._counts.get(inv_key, 0)
+    outer._counts[inv_key] = n + 1
+    prefix = f"{pname}-" if n == 0 else f"{pname}-{n + 1}-"
+    sub_ctx = _PipelineContext(pname, "", task_prefix=prefix)
+    outer_conds = list(outer.cond_stack)
+    with sub_ctx:
+        result = f(**placeholders)
+    for tname, task in sub_ctx.pipeline.tasks.items():
+        if tname in outer.pipeline.tasks:
+            raise ValueError(
+                f"nested pipeline {pname!r}: inlined task name {tname!r} "
+                "collides with an existing task — rename the component or "
+                "the sub-pipeline")
+        task.conditions = outer_conds + task.conditions
+        outer.pipeline.tasks[tname] = task
+    return result
+
+
 def pipeline(fn: Callable | None = None, *, name: str | None = None,
              description: str = ""):
-    """Trace a pipeline function into a Pipeline DAG."""
+    """Trace a pipeline function into a Pipeline DAG. Calling a @pipeline
+    from inside another @pipeline inlines it as a sub-DAG (kfp v2
+    pipeline-in-pipeline composition) and returns its result TaskOutput."""
 
     def wrap(f: Callable) -> Callable[..., Pipeline]:
         pname = name or f.__name__.replace("_", "-")
 
         def build(**overrides) -> Pipeline:
+            outer = _PipelineContext.current()
+            if outer is not None:
+                return _inline_subpipeline(f, pname, outer, overrides)
             sig = inspect.signature(f)
             ctx = _PipelineContext(pname, description or (f.__doc__ or "").strip())
             placeholders = {}

@@ -498,3 +498,158 @@ class TestParallelDag:
         assert run.tasks["boom"].state == TaskState.FAILED
         assert run.tasks["child"].state == TaskState.SKIPPED
         assert run.tasks["independent"].state == TaskState.SUCCEEDED
+
+
+class TestNestedPipelines:
+    """kfp v2 pipeline-in-pipeline: calling a @pipeline inside another
+    inlines its DAG (prefixed names, rewired references, inherited
+    conditions)."""
+
+    def _sub(self):
+        @dsl.component
+        def double(x: int) -> int:
+            return x * 2
+
+        @dsl.component
+        def inc(x: int) -> int:
+            return x + 1
+
+        @dsl.pipeline(name="double-inc")
+        def double_inc(x: int = 1) -> int:
+            d = double(x=x)
+            return inc(x=d)
+
+        return double_inc
+
+    def test_inline_composition_runs_end_to_end(self, tmp_path):
+        double_inc = self._sub()
+
+        @dsl.component
+        def add(a: int, b: int) -> int:
+            return a + b
+
+        @dsl.pipeline(name="outer")
+        def outer(x: int = 5) -> int:
+            first = double_inc(x=x)        # (5*2)+1 = 11
+            second = double_inc(x=first)   # (11*2)+1 = 23
+            return add(a=first, b=second)  # 34
+
+        p = outer()
+        names = set(p.tasks)
+        # both invocations inlined with unique prefixed names
+        assert "double-inc-double" in names and "double-inc-inc" in names
+        assert "double-inc-2-double" in names and "double-inc-2-inc" in names
+        ir = compile_pipeline(p)
+        validate_ir(ir)
+        run = LocalPipelineRunner(work_dir=str(tmp_path)).run(ir)
+        assert run.state == TaskState.SUCCEEDED, run.error
+        assert run.output == 34
+
+    def test_outer_when_applies_to_inlined_tasks(self, tmp_path):
+        double_inc = self._sub()
+
+        @dsl.component
+        def gate() -> int:
+            return 0
+
+        @dsl.pipeline(name="gated")
+        def gated() -> int:
+            g = gate()
+            with dsl.when(g, ">", 5):
+                out = double_inc(x=3)
+            return out
+
+        p = gated()
+        ir = compile_pipeline(p)
+        validate_ir(ir)
+        run = LocalPipelineRunner(work_dir=str(tmp_path)).run(ir)
+        assert run.state == TaskState.SUCCEEDED, run.error
+        # the whole inlined sub-DAG was skipped by the outer condition
+        assert run.tasks["double-inc-double"].state == TaskState.SKIPPED
+        assert run.tasks["double-inc-inc"].state == TaskState.SKIPPED
+
+    def test_missing_argument_rejected(self):
+        @dsl.component
+        def ident(x: int) -> int:
+            return x
+
+        @dsl.pipeline(name="needs-arg")
+        def needs_arg(x: int) -> int:
+            return ident(x=x)
+
+        @dsl.pipeline(name="caller")
+        def caller() -> int:
+            return needs_arg()
+
+        with pytest.raises(TypeError, match="missing argument"):
+            caller()
+
+    def test_unknown_argument_rejected(self):
+        double_inc = self._sub()
+
+        @dsl.pipeline(name="caller2")
+        def caller2() -> int:
+            return double_inc(nope=3)
+
+        with pytest.raises(TypeError, match="unknown argument"):
+            caller2()
+
+    def test_standalone_build_unchanged(self):
+        double_inc = self._sub()
+        p = double_inc(x=4)
+        assert set(p.tasks) == {"double", "inc"}
+        assert p.result.producer == "inc"
+
+
+    def test_outer_task_name_never_miswired(self, tmp_path):
+        """An outer task built from the SAME component as a sub-local one
+        must keep its wiring (the bug a post-hoc rename pass had)."""
+        @dsl.component
+        def double(x: int) -> int:
+            return x * 2
+
+        @dsl.component
+        def inc(x: int) -> int:
+            return x + 1
+
+        @dsl.pipeline(name="sub")
+        def sub(x: int = 1) -> int:
+            return inc(x=double(x=x))
+
+        @dsl.pipeline(name="outer2")
+        def outer2(x: int = 3) -> int:
+            d = double(x=x)          # outer task named 'double'
+            return sub(x=d)          # sub also uses component 'double'
+
+        p = outer2()
+        ir = compile_pipeline(p)
+        validate_ir(ir)  # the rename-pass bug made this a self-cycle
+        run = LocalPipelineRunner(work_dir=str(tmp_path)).run(ir)
+        assert run.state == TaskState.SUCCEEDED, run.error
+        assert run.output == 13  # inc(double(double(3)))
+
+    def test_param_passthrough_return(self, tmp_path):
+        @dsl.component
+        def double(x: int) -> int:
+            return x * 2
+
+        @dsl.component
+        def add(a: int, b: int) -> int:
+            return a + b
+
+        @dsl.pipeline(name="passthru")
+        def passthru(x: int = 1) -> int:
+            double(x=x)   # side task; the RETURN is the parameter itself
+            return x
+
+        @dsl.pipeline(name="outer3")
+        def outer3(x: int = 5) -> int:
+            v = passthru(x=x)
+            return add(a=v, b=1)
+
+        p = outer3()
+        ir = compile_pipeline(p)
+        validate_ir(ir)
+        run = LocalPipelineRunner(work_dir=str(tmp_path)).run(ir)
+        assert run.state == TaskState.SUCCEEDED, run.error
+        assert run.output == 6  # the parameter passed through, not None
