@@ -410,19 +410,15 @@ def cmd_scale(args) -> int:
 def cmd_generate(args) -> int:
     """KV-cache text generation against a saved gpt-lm predictor dir
     (the serving model-dir contract; tokenizer.json beside it when the
-    prompt is text rather than ids)."""
+    prompt is text rather than ids). --draft-model-dir switches to
+    speculative decoding: the draft proposes, the target verifies —
+    output is exactly the target's greedy decode, faster."""
     import numpy as np
 
-    from kubeflow_tpu.serving.model import JaxModel
     from kubeflow_tpu.utils import select_device
 
     select_device(args.device)
-    jm = JaxModel("cli", args.model_dir)
-    jm.load()
-    if jm.config.get("generate") is None:
-        print("error: model dir has no generate config (not a gpt-lm "
-              "generative predictor)", file=sys.stderr)
-        return 2
+
     tok = None
     tok_path = Path(args.model_dir) / "tokenizer.json"
     if tok_path.exists():
@@ -439,6 +435,58 @@ def cmd_generate(args) -> int:
             print("error: no tokenizer.json in the model dir — pass the "
                   "prompt as space-separated token ids", file=sys.stderr)
             return 2
+
+    # gen-config checks come from config.json alone — no weight loading
+    # before cheap validation
+    try:
+        tcfg = json.loads(
+            (Path(args.model_dir) / "config.json").read_text())
+    except OSError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    gen = tcfg.get("generate")
+    if gen is None:
+        print("error: model dir has no generate config (not a gpt-lm "
+              "generative predictor)", file=sys.stderr)
+        return 2
+
+    if args.draft_model_dir:
+        from kubeflow_tpu.models.speculative import speculative_generate
+        from kubeflow_tpu.serving.model import load_generative_model
+
+        if float(gen.get("temperature", 0.0)) > 0 or \
+                int(gen.get("num_beams", 1)) > 1:
+            print("error: speculative decoding is greedy-only; the target "
+                  "config sets temperature/num_beams", file=sys.stderr)
+            return 2
+        tmod, tvars, _ = load_generative_model(Path(args.model_dir))
+        dmod, dvars, _ = load_generative_model(Path(args.draft_model_dir))
+        if tmod.cfg.vocab_size != dmod.cfg.vocab_size:
+            print(f"error: draft vocab {dmod.cfg.vocab_size} != target "
+                  f"vocab {tmod.cfg.vocab_size}", file=sys.stderr)
+            return 2
+        try:
+            out_ids, stats = speculative_generate(
+                tmod, tvars, dmod, dvars, ids,
+                max_new_tokens=int(gen.get("max_new_tokens", 32)),
+                gamma=args.gamma,
+            )
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        out = np.asarray(out_ids)[0]
+        rounds = int(stats["rounds"])
+        accepted = int(stats["drafted_accepted"])
+        print(f"[speculative] rounds={rounds} drafted_accepted={accepted} "
+              f"tokens={len(out)}", file=sys.stderr)
+        print(tok.decode(out) if tok is not None else
+              " ".join(map(str, out)))
+        return 0
+
+    from kubeflow_tpu.serving.model import JaxModel
+
+    jm = JaxModel("cli", args.model_dir)
+    jm.load()
     out = np.asarray(jm(ids)["predictions"])[0]
     print(tok.decode(out) if tok is not None else " ".join(map(str, out)))
     return 0
@@ -523,6 +571,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--prompt", required=True,
                    help="text (tokenizer.json in the dir) or token ids")
     p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+    p.add_argument("--draft-model-dir", default="",
+                   help="speculative decoding: a small gpt-lm predictor "
+                        "dir proposing tokens the target verifies "
+                        "(greedy-only; output is exactly the target's)")
+    p.add_argument("--gamma", type=int, default=4,
+                   help="speculated tokens per round")
 
     p = add("serve", cmd_serve, help="serve an InferenceService until Ctrl-C")
     p.add_argument("-f", "--filename", required=True)

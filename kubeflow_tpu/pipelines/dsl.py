@@ -457,11 +457,14 @@ def _inline_subpipeline(f: Callable, pname: str, outer: "_PipelineContext",
         raise TypeError(
             f"nested pipeline {pname!r}: unknown argument(s) "
             f"{sorted(unknown)}")
-    # invocation-unique prefix: first call 'sub-', k-th call 'sub-k-'
+    # invocation-unique prefix, CHAINED through the enclosing context's
+    # own prefix so doubly-nested pipelines reached from different parents
+    # get distinct names ('a-g-inc' vs 'b-g-inc', not a spurious collision)
     inv_key = f"__pipeline__{pname}"
     n = outer._counts.get(inv_key, 0)
     outer._counts[inv_key] = n + 1
-    prefix = f"{pname}-" if n == 0 else f"{pname}-{n + 1}-"
+    local = f"{pname}-" if n == 0 else f"{pname}-{n + 1}-"
+    prefix = f"{outer.task_prefix}{local}"
     sub_ctx = _PipelineContext(pname, "", task_prefix=prefix)
     outer_conds = list(outer.cond_stack)
     with sub_ctx:

@@ -193,16 +193,17 @@ def save_predictor(
     return d
 
 
-def _load_predict_fn(model_dir: Path):
-    """Rebuild the flax predictor from the model-dir contract. Returns
-    (predict_fn, config, example) — the one definition both the jit-at-load
-    path and the AOT exporter (serving/aot.py) compile from."""
+def load_generative_model(model_dir: Path):
+    """(module, variables, config) rebuilt from a model-dir — the raw
+    pieces compositional decode paths consume (e.g. speculative decoding:
+    `kubeflow_tpu generate --draft-model-dir`)."""
     import inspect
 
     import jax
     import jax.numpy as jnp
     from flax import serialization
 
+    model_dir = Path(model_dir)
     config = json.loads((model_dir / CONFIG_FILE).read_text())
     module = _build_family(config["family"], dict(config["kwargs"]))
     example = np.zeros(config["input_shape"], dtype=config["input_dtype"])
@@ -223,6 +224,20 @@ def _load_predict_fn(model_dir: Path):
         )
     else:
         variables = serialization.from_bytes(target, raw)
+    return module, variables, config
+
+
+def _load_predict_fn(model_dir: Path):
+    """Rebuild the flax predictor from the model-dir contract. Returns
+    (predict_fn, config, example) — the one definition both the jit-at-load
+    path and the AOT exporter (serving/aot.py) compile from."""
+    import inspect
+
+    module, variables, config = load_generative_model(model_dir)
+    example = np.zeros(config["input_shape"], dtype=config["input_dtype"])
+    kwargs = {}
+    if "train" in inspect.signature(module.__call__).parameters:
+        kwargs["train"] = False
 
     gen = config.get("generate")
     if gen is not None:

@@ -653,3 +653,40 @@ class TestNestedPipelines:
         run = LocalPipelineRunner(work_dir=str(tmp_path)).run(ir)
         assert run.state == TaskState.SUCCEEDED, run.error
         assert run.output == 6  # the parameter passed through, not None
+
+
+class TestDeepNesting:
+    def test_grandchild_reached_from_two_parents(self, tmp_path):
+        """Prefixes chain through enclosing contexts: the same grandchild
+        inlined under two different parents gets distinct names."""
+        @dsl.component
+        def inc(x: int) -> int:
+            return x + 1
+
+        @dsl.component
+        def add(a: int, b: int) -> int:
+            return a + b
+
+        @dsl.pipeline(name="g")
+        def g(x: int = 0) -> int:
+            return inc(x=x)
+
+        @dsl.pipeline(name="a")
+        def a(x: int = 0) -> int:
+            return g(x=x)
+
+        @dsl.pipeline(name="b")
+        def b(x: int = 0) -> int:
+            return g(x=x)
+
+        @dsl.pipeline(name="top")
+        def top(x: int = 10) -> int:
+            return add(a=a(x=x), b=b(x=x))  # (10+1)+(10+1) = 22
+
+        p = top()
+        assert "a-g-inc" in p.tasks and "b-g-inc" in p.tasks
+        ir = compile_pipeline(p)
+        validate_ir(ir)
+        run = LocalPipelineRunner(work_dir=str(tmp_path)).run(ir)
+        assert run.state == TaskState.SUCCEEDED, run.error
+        assert run.output == 22

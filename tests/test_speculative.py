@@ -110,3 +110,91 @@ class TestValidation:
         with pytest.raises(ValueError, match="max_new_tokens"):
             speculative_generate(model, variables, dm, dv, prompt,
                                  max_new_tokens=0)
+
+
+class TestCliSpeculative:
+    def test_cli_generate_with_draft(self, tmp_path, target_lm, capsys):
+        from kubeflow_tpu.cli import main
+        from kubeflow_tpu.serving.model import save_predictor
+
+        model, variables, prompt = target_lm
+        tdir = save_predictor(
+            tmp_path / "target", "gpt-lm", dict(variables),
+            np.asarray(prompt, np.int32),
+            generate={"max_new_tokens": 8},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        dm, dv = _draft(7)
+        ddir = save_predictor(
+            tmp_path / "draft", "gpt-lm", dict(dv),
+            np.asarray(prompt, np.int32),
+            generate={"max_new_tokens": 8},
+            size="tiny",
+            config={"dropout_rate": 0.0, "max_len": 96, "hidden_size": 32,
+                    "num_heads": 2, "mlp_dim": 64, "num_layers": 1},
+        )
+        prompt_str = " ".join(str(int(t)) for t in np.asarray(prompt)[0])
+        rc = main(["generate", "--model-dir", str(tdir),
+                   "--prompt", prompt_str, "--device", "cpu"])
+        assert rc == 0
+        plain = capsys.readouterr().out.strip()
+        rc = main(["generate", "--model-dir", str(tdir),
+                   "--draft-model-dir", str(ddir),
+                   "--prompt", prompt_str, "--device", "cpu"])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "[speculative] rounds=" in captured.err
+        assert captured.out.strip() == plain  # target-exact through the CLI
+
+    def test_cli_rejects_sampling_target(self, tmp_path, target_lm, capsys):
+        from kubeflow_tpu.cli import main
+        from kubeflow_tpu.serving.model import save_predictor
+
+        model, variables, prompt = target_lm
+        tdir = save_predictor(
+            tmp_path / "target-s", "gpt-lm", dict(variables),
+            np.asarray(prompt, np.int32),
+            generate={"max_new_tokens": 8, "temperature": 0.7},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        dm, dv = _draft(7)
+        ddir = save_predictor(
+            tmp_path / "draft-s", "gpt-lm", dict(dv),
+            np.asarray(prompt, np.int32),
+            generate={"max_new_tokens": 8},
+            size="tiny",
+            config={"dropout_rate": 0.0, "max_len": 96, "hidden_size": 32,
+                    "num_heads": 2, "mlp_dim": 64, "num_layers": 1},
+        )
+        rc = main(["generate", "--model-dir", str(tdir),
+                   "--draft-model-dir", str(ddir),
+                   "--prompt", "1 2 3", "--device", "cpu"])
+        assert rc == 2
+        assert "greedy-only" in capsys.readouterr().err
+
+    def test_cli_gamma_zero_is_clean_error(self, tmp_path, target_lm,
+                                           capsys):
+        from kubeflow_tpu.cli import main
+        from kubeflow_tpu.serving.model import save_predictor
+
+        model, variables, prompt = target_lm
+        tdir = save_predictor(
+            tmp_path / "t2", "gpt-lm", dict(variables),
+            np.asarray(prompt, np.int32),
+            generate={"max_new_tokens": 8},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        dm, dv = _draft(7)
+        ddir = save_predictor(
+            tmp_path / "d2", "gpt-lm", dict(dv),
+            np.asarray(prompt, np.int32),
+            generate={"max_new_tokens": 8},
+            size="tiny",
+            config={"dropout_rate": 0.0, "max_len": 96, "hidden_size": 32,
+                    "num_heads": 2, "mlp_dim": 64, "num_layers": 1},
+        )
+        rc = main(["generate", "--model-dir", str(tdir),
+                   "--draft-model-dir", str(ddir), "--gamma", "0",
+                   "--prompt", "1 2 3", "--device", "cpu"])
+        assert rc == 2
+        assert "error: gamma" in capsys.readouterr().err
