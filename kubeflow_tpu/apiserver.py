@@ -306,6 +306,30 @@ class PlatformServer:
         if kind not in cluster.KINDS:
             return 404, {"error": f"unknown kind {kind!r}"}
 
+        # -------- run visualization report (KFP viz-server analogue)
+        if (kind == "pipelineruns" and len(parts) == 6
+                and parts[5] == "report" and method == "GET"):
+            cr = cluster.get("pipelineruns", f"{parts[3]}/{parts[4]}")
+            if cr is None:
+                return 404, {"error":
+                             f"pipelinerun {parts[3]}/{parts[4]} not found"}
+            ctrl = self.platform.controllers.get("pipelinerun")
+            result = (ctrl.result_for(parts[3], parts[4])
+                      if ctrl is not None else None)
+            # identity check: the retained result must belong to THIS CR's
+            # finished run — a deleted-and-recreated run of the same name
+            # must never serve the old run's report
+            if (result is None or not cr.status.run_id
+                    or getattr(result, "run_id", "") != cr.status.run_id):
+                return 404, {"error":
+                             "no retained result for this run (it did not "
+                             "finish in this platform process)"}
+            from kubeflow_tpu.pipelines.viz import render_run_report
+
+            return 200, _Html(render_run_report(
+                result, pipeline_name=cr.spec.pipeline_spec.get(
+                    "pipelineInfo", {}).get("name", "")))
+
         # -------- subresources on jobs
         if kind == "jobs" and len(parts) == 6 and parts[5] == "logs" and method == "GET":
             if cluster.get("jobs", f"{parts[3]}/{parts[4]}") is None:

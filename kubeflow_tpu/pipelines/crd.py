@@ -67,6 +67,14 @@ class PipelineRunController(ControllerBase):
     """Executes PipelineRun objects; one executor thread per run."""
 
     ERROR_EVENT_KIND = "pipelineruns"
+    #: finished-run results retained for the visualization report
+    _RESULT_CAP = 64
+
+    def result_for(self, namespace: str, name: str):
+        """The runner's full result for a finished run (None when the run
+        never finished here — e.g. a platform restart)."""
+        with self._mu:
+            return self._results.get(f"{namespace}/{name}")
 
     def __init__(
         self,
@@ -80,6 +88,9 @@ class PipelineRunController(ControllerBase):
         self.work_dir = work_dir
         self.platform = platform
         self._running: set[str] = set()  # uids with a live executor thread
+        # key -> the runner's full result (task artifacts included) for
+        # the visualization report; bounded by _RESULT_CAP, oldest evicted
+        self._results: dict[str, object] = {}
         self._mu = threading.Lock()
         self.metrics.update({
             "pipelineruns_total": 0,
@@ -139,6 +150,10 @@ class PipelineRunController(ControllerBase):
                 platform=self.platform,
             )
             result = runner.run(run.spec.pipeline_spec, run.spec.arguments)
+            with self._mu:
+                self._results[key] = result
+                while len(self._results) > self._RESULT_CAP:
+                    self._results.pop(next(iter(self._results)))
             state = "Succeeded" if result.succeeded else "Failed"
             tasks = {t: r.state.value for t, r in result.tasks.items()}
             output, error, run_id = result.output, "", result.run_id

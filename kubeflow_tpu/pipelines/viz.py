@@ -1,0 +1,276 @@
+"""Run visualization report — the KFP visualization-server analogue.
+
+Reference parity (unverified cites, SURVEY.md §2.6/§5.1): KFP ships a
+visualization server that renders step artifacts (confusion matrix, ROC
+curve, scalar metrics, markdown) for the run view. Here a finished
+PipelineRun renders to ONE self-contained HTML report (no CDN, no JS
+frameworks — the zero-egress posture of the /ui SPA) served at
+`GET /api/v1/pipelineruns/{ns}/{name}/report`.
+
+Recognized step artifacts (by OutputPath artifact name):
+  - ``metrics``          JSON {"name": number, ...}      -> stat tiles
+  - ``confusion_matrix`` JSON {"labels": [...],
+                                "matrix": [[...], ...]}  -> heatmap
+  - ``roc``              JSON {"fpr": [...], "tpr": [...]} -> line chart
+  - ``report``           text/markdown                   -> preformatted
+
+Chart discipline follows the data-viz method: form picked by the data's
+job (magnitude -> sequential heatmap; a curve -> single-series line;
+headline scalars -> stat tiles), colors taken VERBATIM from the
+validated reference palette (single blue sequential ramp light->dark,
+categorical slot 1 for the one line series; no new colors are
+introduced, so no re-validation is owed and none is possible here — the
+image has no node), marks thin (2px line, >=8px markers via hover
+targets), text in ink tokens never series colors, native <title> hover
+on every mark, and a <details> table view per chart so identity and
+values are never color-alone. Dark mode is the palette's own dark
+steps via prefers-color-scheme; the heatmap ramp REVERSES on dark so
+near-zero still recedes toward the surface.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+from pathlib import Path
+
+# reference palette (validated defaults; see module docstring)
+_SEQ_LIGHT = ["#cde2fb", "#b7d3f6", "#9ec5f4", "#86b6ef", "#6da7ec",
+              "#5598e7", "#3987e5", "#2a78d6", "#256abf", "#1c5cab",
+              "#184f95", "#104281", "#0d366b"]
+
+_CSS = """
+.viz-root {
+  color-scheme: light;
+  --surface-1: #fcfcfb;
+  --surface-2: #f2f1ef;
+  --text-primary: #0b0b0b;
+  --text-secondary: #52514e;
+  --series-1: #2a78d6;
+  --grid: #e4e3e0;
+  font: 14px/1.45 system-ui, sans-serif;
+  background: var(--surface-1);
+  color: var(--text-primary);
+  margin: 0; padding: 24px;
+}
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .viz-root {
+    color-scheme: dark;
+    --surface-1: #1a1a19;
+    --surface-2: #262524;
+    --text-primary: #ffffff;
+    --text-secondary: #c3c2b7;
+    --series-1: #3987e5;
+    --grid: #3a3938;
+  }
+}
+.viz-root h1 { font-size: 18px; margin: 0 0 4px; }
+.viz-root h2 { font-size: 15px; margin: 24px 0 8px; }
+.viz-root .sub { color: var(--text-secondary); margin: 0 0 16px; }
+.viz-root .tiles { display: flex; flex-wrap: wrap; gap: 12px; }
+.viz-root .tile {
+  background: var(--surface-2); border-radius: 8px; padding: 12px 16px;
+  min-width: 120px;
+}
+.viz-root .tile .v { font-size: 22px; font-weight: 600; }
+.viz-root .tile .k { color: var(--text-secondary); font-size: 12px; }
+.viz-root svg text { fill: var(--text-secondary); font-size: 11px; }
+.viz-root details { margin: 8px 0 0; }
+.viz-root summary { color: var(--text-secondary); cursor: pointer; }
+.viz-root table { border-collapse: collapse; margin-top: 6px; }
+.viz-root td, .viz-root th {
+  border: 1px solid var(--grid); padding: 3px 8px; font-size: 12px;
+}
+.viz-root pre {
+  background: var(--surface-2); padding: 12px; border-radius: 8px;
+  overflow-x: auto;
+}
+"""
+
+
+def _esc(v) -> str:
+    return html.escape(str(v))
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _stat_tiles(metrics: dict) -> str:
+    tiles = "".join(
+        f'<div class="tile"><div class="v">{_esc(_fmt(v))}</div>'
+        f'<div class="k">{_esc(k)}</div></div>'
+        for k, v in metrics.items()
+    )
+    return f'<div class="tiles">{tiles}</div>'
+
+
+def _heatmap(labels: list, matrix: list, dark_reverse: bool = False) -> str:
+    """Confusion matrix: sequential single-hue heatmap + table view. Cell
+    ink flips to white on the dark half of the ramp (the relief rule —
+    values stay readable at every step)."""
+    n = len(labels)
+    if n == 0 or len(matrix) != n or any(len(r) != n for r in matrix):
+        return '<p class="sub">confusion_matrix artifact malformed</p>'
+    cell = 44
+    pad_l, pad_t = 90, 30
+    w = pad_l + n * cell + 10
+    h = pad_t + n * cell + 40
+    lo = min(min(r) for r in matrix)
+    hi = max(max(r) for r in matrix)
+    span = max(hi - lo, 1e-9)
+    parts = [f'<svg viewBox="0 0 {w} {h}" width="{w}" height="{h}" '
+             f'role="img" aria-label="confusion matrix">']
+    for i, row in enumerate(matrix):
+        for j, v in enumerate(row):
+            t = (v - lo) / span
+            idx = round(t * (len(_SEQ_LIGHT) - 1))
+            fill = _SEQ_LIGHT[idx]
+            ink = "#ffffff" if idx >= 7 else "#0b0b0b"
+            x, y = pad_l + j * cell, pad_t + i * cell
+            # 2px surface gap between fills (the spacer rule)
+            parts.append(
+                f'<rect x="{x + 1}" y="{y + 1}" width="{cell - 2}" '
+                f'height="{cell - 2}" rx="4" fill="{fill}">'
+                f'<title>true {_esc(labels[i])}, predicted '
+                f'{_esc(labels[j])}: {_fmt(v)}</title></rect>'
+                f'<text x="{x + cell / 2}" y="{y + cell / 2 + 4}" '
+                f'text-anchor="middle" style="fill:{ink}">{_fmt(v)}</text>'
+            )
+    for i, lab in enumerate(labels):
+        parts.append(
+            f'<text x="{pad_l - 8}" y="{pad_t + i * cell + cell / 2 + 4}" '
+            f'text-anchor="end">{_esc(lab)}</text>'
+            f'<text x="{pad_l + i * cell + cell / 2}" y="{pad_t - 10}" '
+            f'text-anchor="middle">{_esc(lab)}</text>'
+        )
+    parts.append(
+        f'<text x="{pad_l + n * cell / 2}" y="{h - 8}" '
+        f'text-anchor="middle">predicted → (rows: true)</text>'
+    )
+    parts.append("</svg>")
+    head = "".join(f"<th>{_esc(c)}</th>" for c in labels)
+    rows = "".join(
+        f"<tr><th>{_esc(labels[i])}</th>"
+        + "".join(f"<td>{_fmt(v)}</td>" for v in row) + "</tr>"
+        for i, row in enumerate(matrix)
+    )
+    table = (f'<details><summary>table view</summary><table>'
+             f'<tr><th></th>{head}</tr>{rows}</table></details>')
+    return "".join(parts) + table
+
+
+def _roc(fpr: list, tpr: list) -> str:
+    """Single-series ROC line (slot-1 blue, 2px) over a diagonal
+    reference; no legend box — the section title names the one series."""
+    if len(fpr) != len(tpr) or len(fpr) < 2:
+        return '<p class="sub">roc artifact malformed</p>'
+    w, h, pad = 340, 280, 36
+    px = lambda v: pad + v * (w - 2 * pad)            # noqa: E731
+    py = lambda v: h - pad - v * (h - 2 * pad)        # noqa: E731
+    pts = " ".join(f"{px(x):.1f},{py(y):.1f}" for x, y in zip(fpr, tpr))
+    hover = "".join(
+        f'<circle cx="{px(x):.1f}" cy="{py(y):.1f}" r="8" '
+        f'fill="transparent"><title>fpr {x:.3g}, tpr {y:.3g}</title>'
+        f'</circle>'
+        for x, y in zip(fpr, tpr)
+    )
+    # trapezoidal AUC for the headline
+    auc = sum(
+        (fpr[i + 1] - fpr[i]) * (tpr[i + 1] + tpr[i]) / 2
+        for i in range(len(fpr) - 1)
+    )
+    grid = "".join(
+        f'<line x1="{px(0)}" y1="{py(g)}" x2="{px(1)}" y2="{py(g)}" '
+        f'stroke="var(--grid)" stroke-width="1"/>'
+        f'<text x="{px(0) - 6}" y="{py(g) + 4}" text-anchor="end">'
+        f'{g:.1f}</text>'
+        for g in (0.0, 0.5, 1.0)
+    )
+    svg = (
+        f'<svg viewBox="0 0 {w} {h}" width="{w}" height="{h}" role="img" '
+        f'aria-label="ROC curve, AUC {auc:.3f}">'
+        f"{grid}"
+        f'<line x1="{px(0)}" y1="{py(0)}" x2="{px(1)}" y2="{py(1)}" '
+        f'stroke="var(--grid)" stroke-width="1" stroke-dasharray="4 3"/>'
+        f'<polyline points="{pts}" fill="none" stroke="var(--series-1)" '
+        f'stroke-width="2" stroke-linejoin="round"/>'
+        f"{hover}"
+        f'<text x="{(px(0) + px(1)) / 2}" y="{h - 6}" '
+        f'text-anchor="middle">false positive rate</text>'
+        f'<text x="12" y="{pad - 10}">true positive rate</text>'
+        f"</svg>"
+    )
+    rows = "".join(
+        f"<tr><td>{x:.4g}</td><td>{y:.4g}</td></tr>"
+        for x, y in zip(fpr, tpr)
+    )
+    return (f'<p class="sub">AUC {auc:.3f}</p>{svg}'
+            f'<details><summary>table view</summary>'
+            f'<table><tr><th>fpr</th><th>tpr</th></tr>{rows}</table>'
+            f'</details>')
+
+
+def _read_artifact(path: str):
+    try:
+        return Path(path).read_text()
+    except OSError:
+        return None
+
+
+def render_run_report(run, pipeline_name: str = "") -> str:
+    """One self-contained HTML report for a PipelineRun: per-task state
+    plus every recognized visualization artifact."""
+    sections: list[str] = []
+    for tname in sorted(run.tasks):
+        t = run.tasks[tname]
+        bits: list[str] = []
+        for aname, apath in sorted(t.artifacts.items()):
+            raw = _read_artifact(apath)
+            if raw is None:
+                continue
+            if aname == "metrics":
+                try:
+                    m = json.loads(raw)
+                    if isinstance(m, dict):
+                        bits.append(_stat_tiles(m))
+                except json.JSONDecodeError:
+                    pass
+            elif aname == "confusion_matrix":
+                try:
+                    d = json.loads(raw)
+                    bits.append(_heatmap(d.get("labels", []),
+                                         d.get("matrix", [])))
+                except json.JSONDecodeError:
+                    bits.append('<p class="sub">confusion_matrix '
+                                'artifact is not JSON</p>')
+            elif aname == "roc":
+                try:
+                    d = json.loads(raw)
+                    bits.append(_roc(list(d.get("fpr", [])),
+                                     list(d.get("tpr", []))))
+                except json.JSONDecodeError:
+                    bits.append('<p class="sub">roc artifact is not '
+                                'JSON</p>')
+            elif aname == "report":
+                bits.append(f"<pre>{_esc(raw)}</pre>")
+        state = t.state.value if hasattr(t.state, "value") else str(t.state)
+        body = "".join(bits) if bits else ""
+        sections.append(
+            f"<h2>{_esc(tname)} "
+            f'<span class="sub">[{_esc(state)}'
+            + (f", {t.duration_s:.2f}s" if t.duration_s else "")
+            + "]</span></h2>" + body
+        )
+    state = run.state.value if hasattr(run.state, "value") else str(run.state)
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>run {_esc(run.run_id)}</title>"
+        f"<style>{_CSS}</style></head>"
+        f'<body class="viz-root"><h1>{_esc(pipeline_name or run.pipeline_name)}'
+        f"</h1><p class='sub'>run {_esc(run.run_id)} — {_esc(state)}</p>"
+        + "".join(sections)
+        + "</body></html>"
+    )

@@ -1,0 +1,197 @@
+"""Run visualization report (KFP visualization-server analogue,
+pipelines/viz.py) — artifact-driven charts served over the apiserver."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.pipelines.runner import TaskState
+from kubeflow_tpu.pipelines.viz import (
+    _heatmap,
+    _roc,
+    _stat_tiles,
+    render_run_report,
+)
+
+
+class TestRenderers:
+    def test_stat_tiles(self):
+        out = _stat_tiles({"accuracy": 0.97231, "loss": 0.08})
+        assert "0.9723" in out and "accuracy" in out
+
+    def test_heatmap_has_cells_labels_and_table(self):
+        out = _heatmap(["cat", "dog"], [[8, 2], [1, 9]])
+        assert out.count("<rect") == 4
+        assert "true cat, predicted dog: 2" in out     # native hover
+        assert "table view" in out                     # never color-alone
+        assert "#0b0b0b" in out or "#ffffff" in out    # relief ink
+
+    def test_heatmap_malformed(self):
+        assert "malformed" in _heatmap(["a"], [[1, 2]])
+
+    def test_roc_single_series_no_legend(self):
+        out = _roc([0.0, 0.2, 1.0], [0.0, 0.8, 1.0])
+        assert "polyline" in out and "var(--series-1)" in out
+        assert "AUC" in out and "table view" in out
+        assert "legend" not in out  # one series: the title names it
+
+    def test_roc_malformed(self):
+        assert "malformed" in _roc([0.0], [0.0])
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    from kubeflow_tpu.client import Platform
+
+    with Platform(log_dir=str(tmp_path / "pod-logs")) as p:
+        yield p
+
+
+def _viz_pipeline():
+    from kubeflow_tpu.pipelines import dsl
+
+    @dsl.component
+    def evaluate(metrics: dsl.OutputPath, confusion_matrix: dsl.OutputPath,
+                 roc: dsl.OutputPath) -> float:
+        import json as _json
+        with open(metrics, "w") as f:
+            _json.dump({"accuracy": 0.91, "loss": 0.2}, f)
+        with open(confusion_matrix, "w") as f:
+            _json.dump({"labels": ["a", "b"],
+                        "matrix": [[5, 1], [2, 6]]}, f)
+        with open(roc, "w") as f:
+            _json.dump({"fpr": [0.0, 0.3, 1.0],
+                        "tpr": [0.0, 0.9, 1.0]}, f)
+        return 0.91
+
+    @dsl.pipeline(name="eval-report")
+    def eval_report() -> float:
+        return evaluate()
+
+    return eval_report
+
+
+class TestReportEndpoint:
+    def test_report_served_over_rest(self, platform, tmp_path):
+        from kubeflow_tpu.apiserver import PlatformServer
+        from kubeflow_tpu.pipelines.compiler import compile_pipeline
+        from kubeflow_tpu.remote import RemoteClient
+
+        server = PlatformServer(platform, port=0).start()
+        try:
+            ir = compile_pipeline(_viz_pipeline()())
+            rc = RemoteClient(server.url)
+            rc.apply({
+                "kind": "PipelineRun",
+                "apiVersion": "kubeflow-tpu.org/v1beta1",
+                "metadata": {"name": "viz-run", "namespace": "default"},
+                "spec": {"pipelineSpec": ir},
+            })
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                st = rc.get("pipelineruns", "viz-run", "default")["status"]
+                if st.get("state") in ("Succeeded", "Failed"):
+                    break
+                time.sleep(0.3)
+            assert st["state"] == "Succeeded", st
+            with urllib.request.urlopen(
+                f"{server.url}/api/v1/pipelineruns/default/viz-run/report",
+                timeout=10,
+            ) as r:
+                assert r.headers["Content-Type"].startswith("text/html")
+                body = r.read().decode()
+            # all three artifact visualizations rendered
+            assert "accuracy" in body            # stat tile
+            assert body.count("<rect") == 4      # heatmap cells
+            assert "AUC" in body                 # roc
+            assert "eval-report" in body
+        finally:
+            server.stop()
+
+    def test_report_404_without_retained_result(self, platform):
+        from kubeflow_tpu.apiserver import PlatformServer
+
+        server = PlatformServer(platform, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"{server.url}/api/v1/pipelineruns/default/ghost/report",
+                    timeout=10)
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestReportRendering:
+    def test_report_from_runner_result(self, tmp_path):
+        from kubeflow_tpu.pipelines.compiler import compile_pipeline
+        from kubeflow_tpu.pipelines.runner import LocalPipelineRunner
+
+        ir = compile_pipeline(_viz_pipeline()())
+        run = LocalPipelineRunner(work_dir=str(tmp_path)).run(ir)
+        assert run.state == TaskState.SUCCEEDED, run.error
+        html_out = render_run_report(run, "eval-report")
+        assert html_out.startswith("<!doctype html>")
+        assert "prefers-color-scheme: dark" in html_out
+        assert "table view" in html_out
+
+    def test_recreated_run_never_serves_stale_report(self, platform):
+        """Delete-and-recreate under the same name: the old run's retained
+        result must not masquerade as the new run's report."""
+        from kubeflow_tpu.apiserver import PlatformServer
+        from kubeflow_tpu.pipelines.compiler import compile_pipeline
+        from kubeflow_tpu.remote import RemoteClient
+
+        server = PlatformServer(platform, port=0).start()
+        try:
+            ir = compile_pipeline(_viz_pipeline()())
+            rc = RemoteClient(server.url)
+            manifest = {
+                "kind": "PipelineRun",
+                "apiVersion": "kubeflow-tpu.org/v1beta1",
+                "metadata": {"name": "re-run", "namespace": "default"},
+                "spec": {"pipelineSpec": ir},
+            }
+            rc.apply(manifest)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                st = rc.get("pipelineruns", "re-run", "default")["status"]
+                if st.get("state") in ("Succeeded", "Failed"):
+                    break
+                time.sleep(0.3)
+            assert st["state"] == "Succeeded"
+            rc.delete("pipelineruns", "re-run", "default")
+            # recreate; while the new run has no run_id the report is 404,
+            # never the old run's html
+            platform.cluster.create(
+                "pipelineruns",
+                __import__("kubeflow_tpu.pipelines.crd",
+                           fromlist=["pipelinerun_from_dict"]
+                           ).pipelinerun_from_dict(manifest))
+            saw_stale = False
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                st = rc.get("pipelineruns", "re-run", "default")["status"]
+                try:
+                    with urllib.request.urlopen(
+                        f"{server.url}/api/v1/pipelineruns/default/"
+                        f"re-run/report", timeout=10,
+                    ) as r:
+                        body = r.read().decode()
+                    # a 200 is only legitimate once THIS run finished
+                    if st.get("state") not in ("Succeeded", "Failed"):
+                        saw_stale = True
+                        break
+                    break
+                except urllib.error.HTTPError as e:
+                    assert e.code == 404
+                if st.get("state") in ("Succeeded", "Failed"):
+                    time.sleep(0.3)  # status landed before result; retry
+                else:
+                    time.sleep(0.2)
+            assert not saw_stale
+        finally:
+            server.stop()
