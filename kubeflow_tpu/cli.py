@@ -492,6 +492,31 @@ def cmd_generate(args) -> int:
     return 0
 
 
+def cmd_import_gpt2(args) -> int:
+    """HF/torch GPT-2 checkpoint -> serving-ready gpt-lm predictor dir
+    (the migration on-ramp: bring reference-stack weights, serve on TPU)."""
+    from kubeflow_tpu.train.convert import import_gpt2
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+    try:
+        out = import_gpt2(
+            args.checkpoint, args.out,
+            num_heads=args.num_heads or None,
+            max_new_tokens=args.max_new_tokens, max_len=args.max_len,
+            prompt_len=args.prompt_len,
+        )
+    except (OSError, KeyError, ValueError) as exc:
+        print(f"import error: {exc}", file=sys.stderr)
+        return 2
+    print(f"serving-ready predictor dir: {out}\n"
+          f"  serve:    python -m kubeflow_tpu.serving.server "
+          f"--model-name gpt2 --model-dir {out}\n"
+          f"  generate: python -m kubeflow_tpu generate --model-dir {out} "
+          f"--prompt '<ids or text>'")
+    return 0
+
+
 def cmd_tokenize(args) -> int:
     """Train a BPE tokenizer from a text file (one document per line) and
     write tokenizer.json — pairs with `generate` and gpt-lm predictors."""
@@ -558,6 +583,21 @@ def main(argv: list[str] | None = None) -> int:
                    help="after completion, resume with this maxTrialCount "
                         "(resumePolicy=LongRunning)")
     p.add_argument("--log-dir", default=".kubeflow_tpu/pod-logs")
+
+    p = add("import-gpt2", cmd_import_gpt2,
+            help="convert an HF/torch GPT-2 checkpoint into a "
+                 "serving-ready gpt-lm predictor dir")
+    p.add_argument("--checkpoint", required=True,
+                   help="torch .pt/.bin with a GPT2(LMHead)Model state dict")
+    p.add_argument("-o", "--out", required=True)
+    p.add_argument("--num-heads", type=int, default=0,
+                   help="attention head count (required unless the "
+                        "checkpoint carries config.n_head — a bare state "
+                        "dict does not determine it)")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--max-len", type=int, default=None)
+    p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
 
     p = add("tokenize", cmd_tokenize,
             help="train a BPE tokenizer from a text file")

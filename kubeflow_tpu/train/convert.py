@@ -1,0 +1,201 @@
+"""Checkpoint import — HF/torch GPT-2 weights into the in-tree GPTLM.
+
+The migration story in one step (docs/migration.md): a user of the
+reference stack arrives with torch checkpoints; this converts an HF
+``GPT2LMHeadModel`` state dict into GPTLM variables — numerically
+verified logit-for-logit (test_convert) — and `kubeflow_tpu import-gpt2`
+packages the result as a serving-ready gpt-lm predictor dir (KV-cache
+decode, AOT-exportable, int8-quantizable downstream).
+
+Architecture mapping (both are pre-LN GPT-2):
+
+  wte.weight (V,H)           -> token_embed.embedding  (tied LM head too)
+  wpe.weight (P,H)           -> position_embed.embedding
+  h.N.ln_1 {weight,bias}     -> layer_N.ln_attn {scale,bias}
+  h.N.attn.c_attn (H,3H)+3H  -> query/key/value kernels (H,heads,hd)+bias
+                                (HF Conv1D stores (in,out) — no transpose)
+  h.N.attn.c_proj (H,H)+H    -> attn_out kernel (heads,hd,H)+bias
+  h.N.ln_2                   -> layer_N.ln_mlp
+  h.N.mlp.c_fc (H,4H)        -> mlp_up; h.N.mlp.c_proj (4H,H) -> mlp_down
+  ln_f                       -> ln_final
+
+HF's gelu_new is the tanh approximation — flax nn.gelu's default — so
+activations match bit-for-bit in spirit and to fp tolerance in practice.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubeflow_tpu.models.gpt import GPTConfig
+
+
+def _np(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach")
+                      else t, np.float32)
+
+
+def _strip(state_dict: dict) -> dict:
+    """Normalize HF key prefixes (GPT2LMHeadModel nests the transformer;
+    DDP saves add 'module.') — the ONE place prefix handling lives."""
+    out = {}
+    for k, v in state_dict.items():
+        k = k.removeprefix("module.").removeprefix("transformer.")
+        out[k] = v
+    return out
+
+
+def torch_gpt2_to_variables(state_dict: dict, cfg: GPTConfig) -> dict:
+    """HF GPT2LMHeadModel (or GPT2Model) state dict -> GPTLM variables."""
+    sd = _strip(state_dict)
+    h, heads = cfg.hidden_size, cfg.num_heads
+    hd = h // heads
+    if cfg.num_kv_heads and cfg.num_kv_heads != heads:
+        raise ValueError(
+            "GPT-2 checkpoints are MHA — convert with num_kv_heads=0")
+    if cfg.position_embedding != "learned":
+        raise ValueError("GPT-2 checkpoints carry learned positions")
+
+    def need(key: str) -> np.ndarray:
+        if key not in sd:
+            raise KeyError(
+                f"checkpoint is missing {key!r} — not a GPT-2 state dict?")
+        return _np(sd[key])
+
+    wte = need("wte.weight")
+    if wte.shape != (cfg.vocab_size, h):
+        raise ValueError(
+            f"wte {wte.shape} != (vocab_size {cfg.vocab_size}, "
+            f"hidden {h}) — config does not match the checkpoint")
+    wpe = need("wpe.weight")
+    if wpe.shape[0] < cfg.max_len:
+        raise ValueError(
+            f"checkpoint has {wpe.shape[0]} positions < max_len "
+            f"{cfg.max_len}")
+    params: dict = {
+        "token_embed": {"embedding": wte},
+        "position_embed": {"embedding": wpe[: cfg.max_len]},
+        "ln_final": {"scale": need("ln_f.weight"),
+                     "bias": need("ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        ca_w = need(p + "attn.c_attn.weight")      # (H, 3H), (in, out)
+        ca_b = need(p + "attn.c_attn.bias")        # (3H,)
+        qw, kw, vw = np.split(ca_w, 3, axis=1)
+        qb, kb, vb = np.split(ca_b, 3)
+        proj_w = need(p + "attn.c_proj.weight")    # (H, H)
+        params[f"layer_{i}"] = {
+            "ln_attn": {"scale": need(p + "ln_1.weight"),
+                        "bias": need(p + "ln_1.bias")},
+            "ln_mlp": {"scale": need(p + "ln_2.weight"),
+                       "bias": need(p + "ln_2.bias")},
+            "attention": {
+                "query": {"kernel": qw.reshape(h, heads, hd),
+                          "bias": qb.reshape(heads, hd)},
+                "key": {"kernel": kw.reshape(h, heads, hd),
+                        "bias": kb.reshape(heads, hd)},
+                "value": {"kernel": vw.reshape(h, heads, hd),
+                          "bias": vb.reshape(heads, hd)},
+                "attn_out": {"kernel": proj_w.reshape(heads, hd, h),
+                             "bias": need(p + "attn.c_proj.bias")},
+            },
+            "mlp_up": {"kernel": need(p + "mlp.c_fc.weight"),
+                       "bias": need(p + "mlp.c_fc.bias")},
+            "mlp_down": {"kernel": need(p + "mlp.c_proj.weight"),
+                         "bias": need(p + "mlp.c_proj.bias")},
+        }
+    return {"params": params}
+
+
+def config_from_hf(hf_config, max_len: int | None = None,
+                   dtype=None) -> GPTConfig:
+    """GPTConfig mirroring a transformers GPT2Config."""
+    import jax.numpy as jnp
+
+    return GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        mlp_dim=4 * hf_config.n_embd,
+        max_len=min(max_len or hf_config.n_positions,
+                    hf_config.n_positions),
+        dropout_rate=0.0,
+        dtype=dtype or jnp.float32,
+    )
+
+
+def import_gpt2(checkpoint_path: str, out_dir: str,
+                num_heads: int | None = None,
+                max_new_tokens: int = 32, max_len: int | None = None,
+                prompt_len: int = 16) -> str:
+    """torch .pt/.bin GPT-2 checkpoint -> serving-ready gpt-lm predictor
+    dir. Every dimension except the head count is read off the tensors;
+    ``num_heads`` must come from the caller or a 'config' entry in the
+    blob ({'state_dict': ..., 'config': {'n_head': N, ...}}) — a bare
+    state dict does NOT determine it, and a wrong head split converts to
+    a numerically wrong model."""
+    import torch
+
+    from kubeflow_tpu.serving.model import save_predictor
+
+    # the documented contract is tensors + a plain config dict — nothing
+    # here needs full unpickling, so never execute checkpoint pickles
+    import pickle
+
+    try:
+        blob = torch.load(checkpoint_path, map_location="cpu",
+                          weights_only=True)
+    except (pickle.UnpicklingError, RuntimeError) as exc:
+        raise ValueError(
+            "checkpoint is not loadable as plain tensors (weights_only) — "
+            "save it as torch.save(model.state_dict()), not the whole "
+            f"module: {exc}") from exc
+    if not isinstance(blob, dict):
+        raise ValueError(
+            "checkpoint must be a state dict (torch.save(model."
+            "state_dict())) or {'state_dict': ..., 'config': {...}}, "
+            f"got {type(blob).__name__}")
+    if "state_dict" in blob:
+        state_dict, cfg_d = blob["state_dict"], blob.get("config", {})
+        if not isinstance(cfg_d, dict):
+            raise ValueError(
+                "'config' entry must be a plain dict of GPT2Config "
+                f"fields, got {type(cfg_d).__name__}")
+    else:
+        state_dict, cfg_d = blob, {}
+    sd = _strip(state_dict)
+    wte = _np(sd["wte.weight"])
+    wpe = _np(sd["wpe.weight"])
+    n_layer = 1 + max(
+        int(k.split(".")[1]) for k in sd if k.startswith("h."))
+    hidden = _np(sd["h.0.attn.c_attn.weight"]).shape[0]
+    n_head = num_heads or int(cfg_d.get("n_head", 0))
+    if not n_head:
+        raise ValueError(
+            "num_heads is required: a bare state dict does not determine "
+            "the head count (pass --num-heads, or save the checkpoint as "
+            "{'state_dict': ..., 'config': {'n_head': N}})")
+    if hidden % n_head:
+        raise ValueError(
+            f"hidden {hidden} not divisible by num_heads {n_head}")
+    cfg = GPTConfig(
+        vocab_size=wte.shape[0], hidden_size=hidden, num_layers=n_layer,
+        num_heads=n_head, mlp_dim=_np(sd["h.0.mlp.c_fc.weight"]).shape[1],
+        max_len=min(max_len or wpe.shape[0], wpe.shape[0]),
+        dropout_rate=0.0,
+    )
+    variables = torch_gpt2_to_variables(sd, cfg)
+    example = np.zeros((1, prompt_len), np.int32)
+    return str(save_predictor(
+        out_dir, "gpt-lm", variables, example,
+        generate={"max_new_tokens": max_new_tokens},
+        size="small",
+        config={
+            "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers, "num_heads": cfg.num_heads,
+            "mlp_dim": cfg.mlp_dim, "max_len": cfg.max_len,
+            "dropout_rate": 0.0,
+        },
+    ))
