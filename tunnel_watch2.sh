@@ -26,6 +26,7 @@ stage() {  # stage <artifact> <timeout_s> <cmd...>
 while :; do
   if [ -f probe_flash_stage1.txt.done ] && [ -f probe_flash_fix.txt.done ] \
      && [ -f probe_flash_xlabwd.txt.done ] \
+     && [ -f bench_r3_suite2.jsonl.done ] \
      && [ -f probe_flash_debug2.txt.done ] \
      && [ -f probe_flash_debug.txt.done ]; then
     echo "all stages captured at $(date -u +%H:%M:%S)" >> tunnel_watch2.log
@@ -43,6 +44,8 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
     echo "=== tunnel alive at $(date -u +%H:%M:%S) ===" >> tunnel_watch2.log
     { stage probe_flash_stage1.txt 600 python -u probe_flash_stage1.py \
         && stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py \
+        && stage bench_r3_suite2.jsonl 2400 \
+             env KFT_BENCH_DEADLINE_S=2300 python bench.py --suite \
         && stage probe_flash_debug2.txt 900 python -u probe_flash_debug2.py \
         && stage probe_flash_fix.txt 1200 python -u probe_flash_fix.py \
         && stage probe_flash_debug.txt 900 python -u probe_flash_debug.py; } \
