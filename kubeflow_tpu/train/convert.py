@@ -35,12 +35,15 @@ def _np(t) -> np.ndarray:
                       else t, np.float32)
 
 
-def _strip(state_dict: dict) -> dict:
-    """Normalize HF key prefixes (GPT2LMHeadModel nests the transformer;
-    DDP saves add 'module.') — the ONE place prefix handling lives."""
+def _strip(state_dict: dict,
+           prefixes: tuple = ("module.", "transformer.")) -> dict:
+    """Normalize HF key prefixes (task models nest the backbone —
+    'transformer.' for GPT-2, 'bert.' for BERT; DDP saves add 'module.')
+    — the ONE place prefix handling lives."""
     out = {}
     for k, v in state_dict.items():
-        k = k.removeprefix("module.").removeprefix("transformer.")
+        for p in prefixes:
+            k = k.removeprefix(p)
         out[k] = v
     return out
 
@@ -106,6 +109,125 @@ def torch_gpt2_to_variables(state_dict: dict, cfg: GPTConfig) -> dict:
                          "bias": need(p + "mlp.c_proj.bias")},
         }
     return {"params": params}
+
+
+def torch_bert_to_variables(state_dict: dict, cfg, num_classes: int) -> dict:
+    """HF BertForSequenceClassification (or BertModel + a classifier head)
+    state dict -> BertForSequenceClassification variables. torch Linear
+    stores (out, in) — every kernel transposes (unlike GPT-2's Conv1D)."""
+    sd = _strip(state_dict, ("module.", "bert."))
+    h, heads = cfg.hidden_size, cfg.num_heads
+    hd = h // heads
+
+    def need(key: str) -> np.ndarray:
+        if key not in sd:
+            raise KeyError(
+                f"checkpoint is missing {key!r} — not a BERT state dict?")
+        return _np(sd[key])
+
+    def lin(prefix: str):
+        """torch Linear (out,in)+bias -> flax (in,out) kernel + bias."""
+        return need(prefix + ".weight").T, need(prefix + ".bias")
+
+    wte = need("embeddings.word_embeddings.weight")
+    if wte.shape != (cfg.vocab_size, h):
+        raise ValueError(
+            f"word_embeddings {wte.shape} != (vocab_size "
+            f"{cfg.vocab_size}, hidden {h})")
+    wpe = need("embeddings.position_embeddings.weight")
+    if wpe.shape[0] < cfg.max_len:
+        raise ValueError(
+            f"checkpoint has {wpe.shape[0]} positions < max_len "
+            f"{cfg.max_len}")
+    enc: dict = {
+        "embeddings": {
+            "token_embed": {"embedding": wte},
+            "position_embed": {"embedding": wpe[: cfg.max_len]},
+            "type_embed": {
+                "embedding":
+                    need("embeddings.token_type_embeddings.weight")},
+            "ln_embed": {
+                "scale": need("embeddings.LayerNorm.weight"),
+                "bias": need("embeddings.LayerNorm.bias")},
+        },
+    }
+    for i in range(cfg.num_layers):
+        p = f"encoder.layer.{i}."
+        qw, qb = lin(p + "attention.self.query")
+        kw, kb = lin(p + "attention.self.key")
+        vw, vb = lin(p + "attention.self.value")
+        ow, ob = lin(p + "attention.output.dense")
+        up_w, up_b = lin(p + "intermediate.dense")
+        dn_w, dn_b = lin(p + "output.dense")
+        enc[f"layer_{i}"] = {
+            "attention": {
+                "query": {"kernel": qw.reshape(h, heads, hd),
+                          "bias": qb.reshape(heads, hd)},
+                "key": {"kernel": kw.reshape(h, heads, hd),
+                        "bias": kb.reshape(heads, hd)},
+                "value": {"kernel": vw.reshape(h, heads, hd),
+                          "bias": vb.reshape(heads, hd)},
+                "attn_out": {"kernel": ow.reshape(heads, hd, h),
+                             "bias": ob},
+            },
+            "ln_attn": {
+                "scale": need(p + "attention.output.LayerNorm.weight"),
+                "bias": need(p + "attention.output.LayerNorm.bias")},
+            "mlp_up": {"kernel": up_w, "bias": up_b},
+            "mlp_down": {"kernel": dn_w, "bias": dn_b},
+            "ln_mlp": {"scale": need(p + "output.LayerNorm.weight"),
+                       "bias": need(p + "output.LayerNorm.bias")},
+        }
+    pool_w, pool_b = lin("pooler.dense")
+    params = {"encoder": enc,
+              "pooler": {"kernel": pool_w, "bias": pool_b}}
+    if "classifier.weight" in sd:
+        cw, cb = need("classifier.weight").T, need("classifier.bias")
+        if cw.shape != (h, num_classes):
+            raise ValueError(
+                f"classifier head is {tuple(cw.shape[::-1])}, expected "
+                f"({num_classes} classes, hidden {h})")
+        params["classifier"] = {"kernel": cw, "bias": cb}
+    else:
+        # BertModel checkpoint without a task head: fresh zero head (the
+        # fine-tune-from-pretrained shape)
+        params["classifier"] = {
+            "kernel": np.zeros((h, num_classes), np.float32),
+            "bias": np.zeros((num_classes,), np.float32)}
+    return {"params": params}
+
+
+def bert_config_from_hf(hf_config, max_len: int | None = None, dtype=None):
+    """BertConfig mirroring a transformers BertConfig. Fails fast on
+    architectural variants the in-tree encoder does not implement — a
+    silent convert of those would produce garbage logits."""
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.bert import BertConfig
+
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new"):
+        raise ValueError(
+            f"unsupported hidden_act {act!r}: the in-tree encoder is "
+            "gelu-only (transformers' erf-gelu vs flax's tanh approx "
+            "differ only at fp tolerance; other activations do not)")
+    pet = getattr(hf_config, "position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise ValueError(
+            f"unsupported position_embedding_type {pet!r}: the in-tree "
+            "encoder uses absolute learned positions")
+    return BertConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        mlp_dim=hf_config.intermediate_size,
+        max_len=min(max_len or hf_config.max_position_embeddings,
+                    hf_config.max_position_embeddings),
+        dropout_rate=0.0,
+        pad_token_id=hf_config.pad_token_id or 0,
+        dtype=dtype or jnp.float32,
+    )
 
 
 def config_from_hf(hf_config, max_len: int | None = None,

@@ -137,3 +137,100 @@ class TestImportCommand:
                    "--out", str(tmp_path / "dir3"), "--device", "cpu"])
         assert rc == 2
         assert "import error" in capsys.readouterr().err
+
+
+@pytest.fixture(scope="module")
+def hf_bert():
+    hf_cfg = transformers.BertConfig(
+        vocab_size=200, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, num_labels=3,
+    )
+    torch.manual_seed(0)
+    m = transformers.BertForSequenceClassification(hf_cfg)
+    m.eval()
+    return m
+
+
+class TestBertParity:
+    def test_converted_weights_reproduce_hf_logits(self, hf_bert):
+        from kubeflow_tpu.models.bert import BertForSequenceClassification
+        from kubeflow_tpu.train.convert import (
+            bert_config_from_hf,
+            torch_bert_to_variables,
+        )
+
+        cfg = bert_config_from_hf(hf_bert.config)
+        variables = torch_bert_to_variables(
+            hf_bert.state_dict(), cfg, num_classes=3)
+        model = BertForSequenceClassification(cfg=cfg, num_classes=3)
+        ids = np.array([[5, 17, 99, 3, 42, 7, 1, 8]], np.int64)
+        with torch.no_grad():
+            want = hf_bert(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model.apply(
+            {"params": variables["params"]},
+            jnp.asarray(ids, jnp.int32), False))
+        np.testing.assert_allclose(got, want, atol=6e-3, rtol=6e-3)
+        assert got.argmax(-1).tolist() == want.argmax(-1).tolist()
+
+    def test_padding_mask_agrees(self, hf_bert):
+        """Our model derives the attention mask from pad_token_id; HF
+        takes it explicitly — padded inputs must still agree."""
+        from kubeflow_tpu.models.bert import BertForSequenceClassification
+        from kubeflow_tpu.train.convert import (
+            bert_config_from_hf,
+            torch_bert_to_variables,
+        )
+
+        cfg = bert_config_from_hf(hf_bert.config)
+        variables = torch_bert_to_variables(
+            hf_bert.state_dict(), cfg, num_classes=3)
+        model = BertForSequenceClassification(cfg=cfg, num_classes=3)
+        ids = np.array([[5, 17, 99, 0, 0, 0]], np.int64)  # pad id 0
+        mask = (ids != 0).astype(np.int64)
+        with torch.no_grad():
+            want = hf_bert(torch.tensor(ids),
+                           attention_mask=torch.tensor(mask)).logits.numpy()
+        got = np.asarray(model.apply(
+            {"params": variables["params"]},
+            jnp.asarray(ids, jnp.int32), False))
+        np.testing.assert_allclose(got, want, atol=6e-3, rtol=6e-3)
+
+    def test_headless_bert_model_gets_fresh_head(self, hf_bert):
+        from kubeflow_tpu.train.convert import (
+            bert_config_from_hf,
+            torch_bert_to_variables,
+        )
+
+        cfg = bert_config_from_hf(hf_bert.config)
+        sd = {k: v for k, v in hf_bert.state_dict().items()
+              if not k.startswith("classifier.")}
+        variables = torch_bert_to_variables(sd, cfg, num_classes=5)
+        assert variables["params"]["classifier"]["kernel"].shape == (64, 5)
+
+    def test_missing_key_is_clear(self, hf_bert):
+        from kubeflow_tpu.train.convert import (
+            bert_config_from_hf,
+            torch_bert_to_variables,
+        )
+
+        cfg = bert_config_from_hf(hf_bert.config)
+        sd = dict(hf_bert.state_dict())
+        sd.pop("bert.embeddings.word_embeddings.weight")
+        with pytest.raises(KeyError, match="word_embeddings"):
+            torch_bert_to_variables(sd, cfg, num_classes=3)
+
+    def test_unsupported_variants_fail_fast(self, hf_bert):
+        import copy
+
+        from kubeflow_tpu.train.convert import bert_config_from_hf
+
+        c1 = copy.deepcopy(hf_bert.config)
+        c1.hidden_act = "relu"
+        with pytest.raises(ValueError, match="hidden_act"):
+            bert_config_from_hf(c1)
+        c2 = copy.deepcopy(hf_bert.config)
+        c2.position_embedding_type = "relative_key"
+        with pytest.raises(ValueError, match="position_embedding_type"):
+            bert_config_from_hf(c2)
