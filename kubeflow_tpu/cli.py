@@ -422,11 +422,21 @@ def cmd_generate(args) -> int:
     tok = None
     tok_path = Path(args.model_dir) / "tokenizer.json"
     if tok_path.exists():
-        from kubeflow_tpu.train.tokenizer import Tokenizer
+        # dispatches: in-tree trainable BPE or an imported GPT-2
+        # byte-level one (import-gpt2 --vocab-json/--merges-txt)
+        from kubeflow_tpu.train.bpe_gpt2 import load_any_tokenizer
 
-        tok = Tokenizer.load(tok_path)
+        tok = load_any_tokenizer(tok_path)
     if tok is not None:
-        ids = np.asarray([tok.encode(args.prompt, eos=False)], np.int32)
+        try:
+            encoded = tok.encode(args.prompt, eos=False)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        if not encoded:
+            print("error: prompt encodes to zero tokens", file=sys.stderr)
+            return 2
+        ids = np.asarray([encoded], np.int32)
     else:
         try:
             ids = np.asarray([[int(t) for t in args.prompt.split()]],
@@ -505,6 +515,7 @@ def cmd_import_gpt2(args) -> int:
             num_heads=args.num_heads or None,
             max_new_tokens=args.max_new_tokens, max_len=args.max_len,
             prompt_len=args.prompt_len,
+            vocab_json=args.vocab_json, merges_txt=args.merges_txt,
         )
     except (OSError, KeyError, ValueError) as exc:
         print(f"import error: {exc}", file=sys.stderr)
@@ -597,6 +608,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--max-len", type=int, default=None)
     p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument("--vocab-json", default=None,
+                   help="HF vocab.json — with --merges-txt, bundles the "
+                        "checkpoint's byte-level BPE as tokenizer.json")
+    p.add_argument("--merges-txt", default=None)
     p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
 
     p = add("tokenize", cmd_tokenize,

@@ -251,7 +251,8 @@ def config_from_hf(hf_config, max_len: int | None = None,
 def import_gpt2(checkpoint_path: str, out_dir: str,
                 num_heads: int | None = None,
                 max_new_tokens: int = 32, max_len: int | None = None,
-                prompt_len: int = 16) -> str:
+                prompt_len: int = 16, vocab_json: str | None = None,
+                merges_txt: str | None = None) -> str:
     """torch .pt/.bin GPT-2 checkpoint -> serving-ready gpt-lm predictor
     dir. Every dimension except the head count is read off the tensors;
     ``num_heads`` must come from the caller or a 'config' entry in the
@@ -308,11 +309,30 @@ def import_gpt2(checkpoint_path: str, out_dir: str,
         max_len=min(max_len or wpe.shape[0], wpe.shape[0]),
         dropout_rate=0.0,
     )
+    # tokenizer validation happens BEFORE any weight conversion and any
+    # artifact write — an invalid pair must not leave a predictor dir
+    # behind that silently serves raw ids
+    if (vocab_json is None) != (merges_txt is None):
+        raise ValueError(
+            "pass BOTH --vocab-json and --merges-txt (the HF checkpoint's "
+            "tokenizer files) or neither")
+    tok = None
+    if vocab_json is not None:
+        from kubeflow_tpu.train.bpe_gpt2 import Gpt2Tokenizer
+
+        tok = Gpt2Tokenizer.load(vocab_json, merges_txt)
+        max_id = max(tok.vocab.values(), default=-1)
+        if max_id >= cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer ids reach {max_id} but the model's vocab is "
+                f"{cfg.vocab_size} — wrong vocab.json for this checkpoint")
     variables = torch_gpt2_to_variables(sd, cfg)
     example = np.zeros((1, prompt_len), np.int32)
-    return str(save_predictor(
+    out = str(save_predictor(
         out_dir, "gpt-lm", variables, example,
-        generate={"max_new_tokens": max_new_tokens},
+        # GPT-2 has no pad token ('!' is legitimately id 0): -1 disables
+        # the served pad-in-prompt rejection for ids that never occur
+        generate={"max_new_tokens": max_new_tokens, "pad_token_id": -1},
         size="small",
         config={
             "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
@@ -321,3 +341,8 @@ def import_gpt2(checkpoint_path: str, out_dir: str,
             "dropout_rate": 0.0,
         },
     ))
+    if tok is not None:
+        from pathlib import Path
+
+        tok.save(Path(out) / "tokenizer.json")
+    return out

@@ -234,3 +234,178 @@ class TestBertParity:
         c2.position_embedding_type = "relative_key"
         with pytest.raises(ValueError, match="position_embedding_type"):
             bert_config_from_hf(c2)
+
+
+class TestGpt2ByteBpe:
+    """Byte-level BPE parity with transformers.GPT2Tokenizer over a
+    handcrafted (offline) vocab/merges pair."""
+
+    @pytest.fixture(scope="class")
+    def pair(self, tmp_path_factory):
+        from kubeflow_tpu.train.bpe_gpt2 import (
+            Gpt2Tokenizer,
+            bytes_to_unicode,
+        )
+
+        d = tmp_path_factory.mktemp("bpe")
+        vocab = {u: i for i, u in enumerate(bytes_to_unicode().values())}
+        merges = [("h", "e"), ("l", "l"), ("he", "ll"), ("Ġ", "w"),
+                  ("Ġw", "o"), ("o", "r"), ("Ġwo", "r"), ("Ġwor", "ld"),
+                  ("l", "d"), ("1", "2"), ("'", "s")]
+        # merge list must be consistent: every product enters the vocab
+        fixed = []
+        for a, b in merges:
+            if a in vocab and b in vocab:
+                fixed.append((a, b))
+                vocab.setdefault(a + b, len(vocab))
+        vocab.setdefault("<|endoftext|>", len(vocab))
+        (d / "vocab.json").write_text(
+            __import__("json").dumps(vocab), encoding="utf-8")
+        # trailing newline matters: transformers drops the final line of
+        # merges.txt (real files always end with one)
+        (d / "merges.txt").write_text(
+            "#version: 0.2\n"
+            + "\n".join(f"{a} {b}" for a, b in fixed) + "\n",
+            encoding="utf-8")
+        ours = Gpt2Tokenizer.load(d / "vocab.json", d / "merges.txt")
+        theirs = transformers.GPT2Tokenizer(
+            vocab_file=str(d / "vocab.json"),
+            merges_file=str(d / "merges.txt"))
+        return ours, theirs
+
+    @pytest.mark.parametrize("text", [
+        "hello world",
+        "hello  world's 12 worlds!",
+        "tabs\tand\nnewlines  end ",
+        "under_score __dunder__",
+        "unicode café — dash",
+        "digits 123 4.5e6",
+    ])
+    def test_encode_matches_transformers(self, pair, text):
+        ours, theirs = pair
+        assert ours.encode(text) == theirs.encode(text)
+
+    def test_decode_round_trips(self, pair):
+        ours, _ = pair
+        for text in ("hello world", "café 12's", " leading space"):
+            assert ours.decode(ours.encode(text)) == text
+
+    def test_save_load_dispatch(self, pair, tmp_path):
+        from kubeflow_tpu.train.bpe_gpt2 import (
+            Gpt2Tokenizer,
+            load_any_tokenizer,
+        )
+
+        ours, _ = pair
+        ours.save(tmp_path / "tokenizer.json")
+        back = load_any_tokenizer(tmp_path / "tokenizer.json")
+        assert isinstance(back, Gpt2Tokenizer)
+        assert back.encode("hello world") == ours.encode("hello world")
+        # the in-tree trainable tokenizer still dispatches to itself
+        from kubeflow_tpu.train.tokenizer import Tokenizer
+
+        t = Tokenizer.train(["some text here", "more text"], vocab_size=64)
+        t.save(tmp_path / "word.json")
+        assert isinstance(load_any_tokenizer(tmp_path / "word.json"),
+                          Tokenizer)
+
+
+class TestImportWithTokenizer:
+    def test_text_in_text_out(self, hf_model, tmp_path, capsys):
+        """Weights + tokenizer in one import: the served predictor takes
+        TEXT through the CLI."""
+        import json as _json
+
+        from kubeflow_tpu.cli import main
+        from kubeflow_tpu.train.bpe_gpt2 import bytes_to_unicode
+
+        vocab = {u: i for i, u in enumerate(bytes_to_unicode().values())}
+        # model vocab is 128: trim the table to fit and keep it consistent
+        vocab = {u: i for u, i in vocab.items() if i < 128}
+        (tmp_path / "vocab.json").write_text(_json.dumps(vocab))
+        (tmp_path / "merges.txt").write_text("#version: 0.2\n")
+        ckpt = tmp_path / "gpt2.pt"
+        torch.save(hf_model.state_dict(), str(ckpt))
+        rc = main(["import-gpt2", "--checkpoint", str(ckpt),
+                   "--num-heads", "4", "--out", str(tmp_path / "d"),
+                   "--vocab-json", str(tmp_path / "vocab.json"),
+                   "--merges-txt", str(tmp_path / "merges.txt"),
+                   "--max-new-tokens", "4", "--prompt-len", "3",
+                   "--device", "cpu"])
+        assert rc == 0
+        capsys.readouterr()
+        rc = main(["generate", "--model-dir", str(tmp_path / "d"),
+                   "--prompt", "hi!", "--device", "cpu"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip()
+        assert out  # decoded text, not ids
+        assert not all(tok.isdigit() for tok in out.split())
+
+    def test_tokenizer_files_must_pair(self, hf_model, tmp_path):
+        ckpt = tmp_path / "gpt2.pt"
+        torch.save(hf_model.state_dict(), str(ckpt))
+        with pytest.raises(ValueError, match="BOTH"):
+            import_gpt2(str(ckpt), str(tmp_path / "x"), num_heads=4,
+                        vocab_json=str(tmp_path / "vocab.json"))
+
+    def test_trimmed_vocab_encode_is_clear_error(self, pair=None):
+        import json as _json
+        import tempfile
+        from pathlib import Path
+
+        from kubeflow_tpu.train.bpe_gpt2 import (
+            Gpt2Tokenizer,
+            bytes_to_unicode,
+        )
+
+        d = Path(tempfile.mkdtemp())
+        vocab = {u: i for i, u in enumerate(bytes_to_unicode().values())
+                 if i < 128}  # ASCII-ish only
+        (d / "v.json").write_text(_json.dumps(vocab))
+        (d / "m.txt").write_text("#version: 0.2\n")
+        tok = Gpt2Tokenizer.load(d / "v.json", d / "m.txt")
+        # the space byte remaps to 'Ġ', which sits past the trimmed cutoff
+        with pytest.raises(ValueError, match="trimmed"):
+            tok.encode("hello world")
+
+    def test_oversized_tokenizer_leaves_no_artifact(self, hf_model,
+                                                    tmp_path):
+        import json as _json
+
+        from kubeflow_tpu.train.bpe_gpt2 import bytes_to_unicode
+
+        # sparse ids far past the model's 128-vocab
+        vocab = {u: i * 100 for i, u in
+                 enumerate(bytes_to_unicode().values())}
+        (tmp_path / "vocab.json").write_text(_json.dumps(vocab))
+        (tmp_path / "merges.txt").write_text("#version: 0.2\n")
+        ckpt = tmp_path / "gpt2.pt"
+        torch.save(hf_model.state_dict(), str(ckpt))
+        with pytest.raises(ValueError, match="wrong vocab.json"):
+            import_gpt2(str(ckpt), str(tmp_path / "out"), num_heads=4,
+                        vocab_json=str(tmp_path / "vocab.json"),
+                        merges_txt=str(tmp_path / "merges.txt"))
+        assert not (tmp_path / "out").exists()
+
+    def test_empty_prompt_clean_error(self, hf_model, tmp_path, capsys):
+        import json as _json
+
+        from kubeflow_tpu.cli import main
+        from kubeflow_tpu.train.bpe_gpt2 import bytes_to_unicode
+
+        vocab = {u: i for i, u in enumerate(bytes_to_unicode().values())
+                 if i < 128}
+        (tmp_path / "vocab.json").write_text(_json.dumps(vocab))
+        (tmp_path / "merges.txt").write_text("#version: 0.2\n")
+        ckpt = tmp_path / "gpt2.pt"
+        torch.save(hf_model.state_dict(), str(ckpt))
+        assert main(["import-gpt2", "--checkpoint", str(ckpt),
+                     "--num-heads", "4", "--out", str(tmp_path / "e"),
+                     "--vocab-json", str(tmp_path / "vocab.json"),
+                     "--merges-txt", str(tmp_path / "merges.txt"),
+                     "--prompt-len", "3", "--device", "cpu"]) == 0
+        capsys.readouterr()
+        rc = main(["generate", "--model-dir", str(tmp_path / "e"),
+                   "--prompt", "", "--device", "cpu"])
+        assert rc == 2
+        assert "zero tokens" in capsys.readouterr().err
