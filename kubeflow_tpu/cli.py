@@ -528,6 +528,26 @@ def cmd_import_gpt2(args) -> int:
     return 0
 
 
+def cmd_import_bert(args) -> int:
+    """HF/torch BERT checkpoint -> serving-ready classifier predictor."""
+    from kubeflow_tpu.train.convert import import_bert
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+    try:
+        out = import_bert(
+            args.checkpoint, args.out,
+            num_heads=args.num_heads or None,
+            num_classes=args.num_classes or None,
+            max_len=args.max_len,
+        )
+    except (OSError, KeyError, ValueError) as exc:
+        print(f"import error: {exc}", file=sys.stderr)
+        return 2
+    print(f"serving-ready predictor dir: {out}")
+    return 0
+
+
 def cmd_tokenize(args) -> int:
     """Train a BPE tokenizer from a text file (one document per line) and
     write tokenizer.json — pairs with `generate` and gpt-lm predictors."""
@@ -612,6 +632,17 @@ def main(argv: list[str] | None = None) -> int:
                    help="HF vocab.json — with --merges-txt, bundles the "
                         "checkpoint's byte-level BPE as tokenizer.json")
     p.add_argument("--merges-txt", default=None)
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+
+    p = add("import-bert", cmd_import_bert,
+            help="convert an HF/torch BERT checkpoint into a "
+                 "serving-ready bert-classifier predictor dir")
+    p.add_argument("--checkpoint", required=True)
+    p.add_argument("-o", "--out", required=True)
+    p.add_argument("--num-heads", type=int, default=0)
+    p.add_argument("--num-classes", type=int, default=0,
+                   help="required for headless BertModel checkpoints")
+    p.add_argument("--max-len", type=int, default=None)
     p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
 
     p = add("tokenize", cmd_tokenize,

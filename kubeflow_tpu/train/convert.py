@@ -197,6 +197,73 @@ def torch_bert_to_variables(state_dict: dict, cfg, num_classes: int) -> dict:
     return {"params": params}
 
 
+def import_bert(checkpoint_path: str, out_dir: str,
+                num_heads: int | None = None,
+                num_classes: int | None = None,
+                max_len: int | None = None) -> str:
+    """torch .pt/.bin BERT checkpoint -> serving-ready bert-classifier
+    predictor dir. Dimensions read off the tensors; the head count must
+    come from the caller or a 'config' entry (same contract as
+    import_gpt2); num_classes defaults to the checkpoint's classifier
+    head (required when importing a headless BertModel)."""
+    from kubeflow_tpu.models.bert import BertConfig
+    from kubeflow_tpu.serving.model import save_predictor
+
+    state_dict, cfg_d = _load_torch_blob(checkpoint_path)
+    # the same fail-fast bert_config_from_hf performs: a variant the
+    # encoder does not implement must not import into garbage logits
+    act = cfg_d.get("hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new"):
+        raise ValueError(
+            f"unsupported hidden_act {act!r}: the in-tree encoder is "
+            "gelu-only")
+    pet = cfg_d.get("position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise ValueError(
+            f"unsupported position_embedding_type {pet!r}: the in-tree "
+            "encoder uses absolute learned positions")
+    sd = _strip(state_dict, ("module.", "bert."))
+    wte = _np(sd["embeddings.word_embeddings.weight"])
+    wpe = _np(sd["embeddings.position_embeddings.weight"])
+    n_layer = 1 + max(int(k.split(".")[2]) for k in sd
+                      if k.startswith("encoder.layer."))
+    hidden = wte.shape[1]
+    n_head = num_heads or int(cfg_d.get("num_attention_heads", 0))
+    if not n_head:
+        raise ValueError(
+            "num_heads is required: a bare state dict does not determine "
+            "the head count (pass --num-heads, or save the checkpoint as "
+            "{'state_dict': ..., 'config': {'num_attention_heads': N}})")
+    if hidden % n_head:
+        raise ValueError(
+            f"hidden {hidden} not divisible by num_heads {n_head}")
+    if num_classes is None:
+        if "classifier.weight" not in sd:
+            raise ValueError(
+                "num_classes is required for a headless BertModel "
+                "checkpoint (no classifier.weight)")
+        num_classes = _np(sd["classifier.weight"]).shape[0]
+    cfg = BertConfig(
+        vocab_size=wte.shape[0], hidden_size=hidden, num_layers=n_layer,
+        num_heads=n_head,
+        mlp_dim=_np(sd["encoder.layer.0.intermediate.dense.weight"]).shape[0],
+        max_len=min(max_len or wpe.shape[0], wpe.shape[0]),
+        dropout_rate=0.0,
+    )
+    variables = torch_bert_to_variables(sd, cfg, num_classes=num_classes)
+    example = np.zeros((1, min(16, cfg.max_len)), np.int32)
+    return str(save_predictor(
+        out_dir, "bert-classifier", variables, example,
+        size="base", num_classes=num_classes,
+        config={
+            "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers, "num_heads": cfg.num_heads,
+            "mlp_dim": cfg.mlp_dim, "max_len": cfg.max_len,
+            "dropout_rate": 0.0,
+        },
+    ))
+
+
 def bert_config_from_hf(hf_config, max_len: int | None = None, dtype=None):
     """BertConfig mirroring a transformers BertConfig. Fails fast on
     architectural variants the in-tree encoder does not implement — a
@@ -248,24 +315,13 @@ def config_from_hf(hf_config, max_len: int | None = None,
     )
 
 
-def import_gpt2(checkpoint_path: str, out_dir: str,
-                num_heads: int | None = None,
-                max_new_tokens: int = 32, max_len: int | None = None,
-                prompt_len: int = 16, vocab_json: str | None = None,
-                merges_txt: str | None = None) -> str:
-    """torch .pt/.bin GPT-2 checkpoint -> serving-ready gpt-lm predictor
-    dir. Every dimension except the head count is read off the tensors;
-    ``num_heads`` must come from the caller or a 'config' entry in the
-    blob ({'state_dict': ..., 'config': {'n_head': N, ...}}) — a bare
-    state dict does NOT determine it, and a wrong head split converts to
-    a numerically wrong model."""
-    import torch
-
-    from kubeflow_tpu.serving.model import save_predictor
-
-    # the documented contract is tensors + a plain config dict — nothing
-    # here needs full unpickling, so never execute checkpoint pickles
+def _load_torch_blob(checkpoint_path: str) -> tuple[dict, dict]:
+    """(state_dict, config_dict) from a torch checkpoint, loaded with
+    weights_only (checkpoint pickles are never executed) — the one
+    loader both importers share."""
     import pickle
+
+    import torch
 
     try:
         blob = torch.load(checkpoint_path, map_location="cpu",
@@ -284,10 +340,31 @@ def import_gpt2(checkpoint_path: str, out_dir: str,
         state_dict, cfg_d = blob["state_dict"], blob.get("config", {})
         if not isinstance(cfg_d, dict):
             raise ValueError(
-                "'config' entry must be a plain dict of GPT2Config "
+                "'config' entry must be a plain dict of HF config "
                 f"fields, got {type(cfg_d).__name__}")
-    else:
-        state_dict, cfg_d = blob, {}
+        return state_dict, cfg_d
+    return blob, {}
+
+
+def import_gpt2(checkpoint_path: str, out_dir: str,
+                num_heads: int | None = None,
+                max_new_tokens: int = 32, max_len: int | None = None,
+                prompt_len: int = 16, vocab_json: str | None = None,
+                merges_txt: str | None = None) -> str:
+    """torch .pt/.bin GPT-2 checkpoint -> serving-ready gpt-lm predictor
+    dir. Every dimension except the head count is read off the tensors;
+    ``num_heads`` must come from the caller or a 'config' entry in the
+    blob ({'state_dict': ..., 'config': {'n_head': N, ...}}) — a bare
+    state dict does NOT determine it, and a wrong head split converts to
+    a numerically wrong model."""
+    from kubeflow_tpu.serving.model import save_predictor
+
+    state_dict, cfg_d = _load_torch_blob(checkpoint_path)
+    act = cfg_d.get("activation_function", "gelu_new")
+    if act not in ("gelu", "gelu_new"):
+        raise ValueError(
+            f"unsupported activation_function {act!r}: the in-tree "
+            "decoder is gelu-only")
     sd = _strip(state_dict)
     wte = _np(sd["wte.weight"])
     wpe = _np(sd["wpe.weight"])

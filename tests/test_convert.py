@@ -409,3 +409,66 @@ class TestImportWithTokenizer:
                    "--prompt", "", "--device", "cpu"])
         assert rc == 2
         assert "zero tokens" in capsys.readouterr().err
+
+
+class TestImportBert:
+    def test_checkpoint_to_serving_dir(self, hf_bert, tmp_path):
+        from kubeflow_tpu.serving.model import JaxModel
+        from kubeflow_tpu.train.convert import import_bert
+
+        ckpt = tmp_path / "bert.pt"
+        torch.save(hf_bert.state_dict(), str(ckpt))
+        out = import_bert(str(ckpt), str(tmp_path / "served"), num_heads=4)
+        jm = JaxModel("bert", out)
+        jm.load()
+        ids = np.array([[5, 17, 99, 3, 42, 7, 1, 8]], np.int32)
+        got = jm(ids)
+        with torch.no_grad():
+            want = hf_bert(
+                torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        assert np.asarray(got["predictions"]).tolist() == \
+            want.argmax(-1).tolist()
+        np.testing.assert_allclose(np.asarray(got["logits"]), want,
+                                   atol=6e-3, rtol=6e-3)
+
+    def test_cli_and_head_requirements(self, hf_bert, tmp_path, capsys):
+        from kubeflow_tpu.cli import main
+
+        ckpt = tmp_path / "bert.pt"
+        torch.save(hf_bert.state_dict(), str(ckpt))
+        rc = main(["import-bert", "--checkpoint", str(ckpt),
+                   "--out", str(tmp_path / "x"), "--device", "cpu"])
+        assert rc == 2
+        assert "num_heads is required" in capsys.readouterr().err
+        rc = main(["import-bert", "--checkpoint", str(ckpt),
+                   "--num-heads", "4",
+                   "--out", str(tmp_path / "y"), "--device", "cpu"])
+        assert rc == 0
+        assert "serving-ready" in capsys.readouterr().out
+
+    def test_headless_requires_classes(self, hf_bert, tmp_path):
+        from kubeflow_tpu.train.convert import import_bert
+
+        sd = {k: v for k, v in hf_bert.state_dict().items()
+              if not k.startswith("classifier.")}
+        ckpt = tmp_path / "headless.pt"
+        torch.save(sd, str(ckpt))
+        with pytest.raises(ValueError, match="num_classes"):
+            import_bert(str(ckpt), str(tmp_path / "z"), num_heads=4)
+        out = import_bert(str(ckpt), str(tmp_path / "z2"), num_heads=4,
+                          num_classes=7)
+        import json as _json
+        cfgd = _json.loads(
+            (__import__("pathlib").Path(out) / "config.json").read_text())
+        assert cfgd["kwargs"]["num_classes"] == 7
+
+    def test_variant_config_fails_fast_at_import(self, hf_bert, tmp_path):
+        from kubeflow_tpu.train.convert import import_bert
+
+        ckpt = tmp_path / "variant.pt"
+        torch.save({"state_dict": hf_bert.state_dict(),
+                    "config": {"num_attention_heads": 4,
+                               "position_embedding_type": "relative_key"}},
+                   str(ckpt))
+        with pytest.raises(ValueError, match="position_embedding_type"):
+            import_bert(str(ckpt), str(tmp_path / "v"))
