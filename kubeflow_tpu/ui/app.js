@@ -454,9 +454,21 @@ function pipelineRunDetail(o) {
   const tasks = ((ir.root || {}).dag || {}).tasks || {};
   const states = (o.status || {}).tasks || {};
   const names = Object.keys(tasks);
+  const ns = (o.metadata || {}).namespace || "default";
+  const nm = (o.metadata || {}).name || "";
+  // a report exists only for runs that FINISHED here with a run id (a
+  // run that died before executing retains no result — the endpoint
+  // would 404, so render no link)
+  const reportable = ["Succeeded", "Failed"].includes(
+    (o.status || {}).state || "") && (o.status || {}).runId;
+  const href = `/api/v1/pipelineruns/${encodeURIComponent(ns)}/` +
+    `${encodeURIComponent(nm)}/report`;
   const header = kvTable([
     ["state", badge((o.status || {}).state || "-")],
     ["run id", esc((o.status || {}).runId || "-")],
+    ["report", reportable
+      ? `<a href="${esc(href)}" target="_blank">visualization report</a>`
+      : "-"],
     ["error", (o.status || {}).error ?
       `<span class="error-text">${esc(o.status.error)}</span>` : "-"],
   ]);

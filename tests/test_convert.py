@@ -472,3 +472,29 @@ class TestImportBert:
                    str(ckpt))
         with pytest.raises(ValueError, match="position_embedding_type"):
             import_bert(str(ckpt), str(tmp_path / "v"))
+
+
+class TestBpeProperties:
+    def test_round_trip_arbitrary_text(self):
+        """With the full 256-byte base vocab, decode(encode(x)) == x for
+        ANY string — the no-UNK property of byte-level BPE."""
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        from kubeflow_tpu.train.bpe_gpt2 import (
+            Gpt2Tokenizer,
+            bytes_to_unicode,
+        )
+
+        vocab = {u: i for i, u in enumerate(bytes_to_unicode().values())}
+        merges = [("h", "e"), ("Ġ", "t")]
+        for a, b in merges:  # every merge product must be in the vocab
+            vocab.setdefault(a + b, len(vocab))
+        tok = Gpt2Tokenizer(vocab, merges)
+
+        @settings(max_examples=200, deadline=None)
+        @given(st.text(max_size=64))
+        def check(text):
+            assert tok.decode(tok.encode(text)) == text
+
+        check()
