@@ -17,6 +17,7 @@ re-triggers the key anyway.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Iterable
 
 from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
@@ -46,6 +47,15 @@ class ControllerBase:
             "reconcile_total": 0,
             "reconcile_errors_total": 0,
         }
+        # reconcile-duration histogram (controller-runtime parity,
+        # SURVEY §5.5). += on these is read-modify-write, NOT atomic:
+        # multiple native workers run the Python callback concurrently,
+        # so observation and the render-time snapshot take this lock
+        self.latency_buckets: tuple[float, ...] = (
+            0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0)
+        self.latency_counts = [0] * (len(self.latency_buckets) + 1)
+        self.latency_sum = 0.0
+        self._latency_mu = threading.Lock()
 
     # ------------------------------------------------------ subclass hooks
 
@@ -90,6 +100,21 @@ class ControllerBase:
 
     # ----------------------------------------------------------- internals
 
+    def _observe_latency(self, seconds: float) -> None:
+        with self._latency_mu:
+            for i, le in enumerate(self.latency_buckets):
+                if seconds <= le:
+                    self.latency_counts[i] += 1
+                    break
+            else:
+                self.latency_counts[-1] += 1  # +Inf
+            self.latency_sum += seconds
+
+    def latency_snapshot(self) -> tuple[list[int], float]:
+        """(bucket counts, sum) read consistently for /metrics."""
+        with self._latency_mu:
+            return list(self.latency_counts), self.latency_sum
+
     def _watch_loop(self) -> None:
         q = self.cluster.watch()
         while not self._stop.is_set():
@@ -113,6 +138,7 @@ class ControllerBase:
         Must never raise: ctypes would swallow the exception and report
         rc=0 (success), silently forgetting a failing key."""
         key = key_b.decode()
+        t0 = time.perf_counter()
         try:
             self.metrics["reconcile_total"] += 1
             requeue_after = self.reconcile(key)
@@ -130,3 +156,7 @@ class ControllerBase:
             except Exception:  # noqa: BLE001
                 pass
             return 2
+        finally:
+            # one observation on EVERY exit path (_observe_latency cannot
+            # raise: pure arithmetic under its own lock)
+            self._observe_latency(time.perf_counter() - t0)

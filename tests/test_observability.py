@@ -95,3 +95,50 @@ class TestProfilerToggle:
         # jax.profiler writes plugins/profile/<ts>/*.trace.json.gz (or .pb)
         produced = list((tmp_path / "trace").rglob("*"))
         assert any(p.is_file() for p in produced), "no trace files written"
+
+
+class TestReconcileLatencyHistogram:
+    def test_histogram_rendered_and_cumulative(self, tmp_path):
+        from kubeflow_tpu.client import Platform, TrainingClient
+        from kubeflow_tpu.observability import render_metrics
+
+        with Platform(log_dir=str(tmp_path / "logs")) as p:
+            import sys
+            import time as _t
+
+            from kubeflow_tpu.api import (
+                ContainerSpec, JAXJob, JAXJobSpec, ObjectMeta,
+                PodTemplateSpec, ReplicaSpec, REPLICA_WORKER,
+            )
+
+            script = tmp_path / "ok.py"
+            script.write_text("print('ok')")
+            TrainingClient(p).create_job(JAXJob(
+                metadata=ObjectMeta(name="histo"),
+                spec=JAXJobSpec(replica_specs={
+                    REPLICA_WORKER: ReplicaSpec(
+                        replicas=1,
+                        template=PodTemplateSpec(container=ContainerSpec(
+                            command=[sys.executable, str(script)]))),
+                }),
+            ))
+            deadline = _t.monotonic() + 30
+            while _t.monotonic() < deadline:
+                j = p.cluster.get("jobs", "default/histo")
+                if j is not None and j.status.is_finished:
+                    break
+                _t.sleep(0.1)
+            text = render_metrics(p)
+        assert "# TYPE kftpu_job_reconcile_duration_seconds histogram" in text
+        import re
+
+        buckets = re.findall(
+            r'kftpu_job_reconcile_duration_seconds_bucket\{le="([^"]+)"\} '
+            r"(\d+)", text)
+        assert buckets and buckets[-1][0] == "+Inf"
+        counts = [int(n) for _, n in buckets]
+        assert counts == sorted(counts)          # cumulative
+        assert counts[-1] > 0                    # reconciles observed
+        m = re.search(
+            r"kftpu_job_reconcile_duration_seconds_count (\d+)", text)
+        assert int(m.group(1)) == counts[-1]
