@@ -101,13 +101,10 @@ class ControllerBase:
     # ----------------------------------------------------------- internals
 
     def _observe_latency(self, seconds: float) -> None:
+        from kubeflow_tpu.utils.prom import observe
+
         with self._latency_mu:
-            for i, le in enumerate(self.latency_buckets):
-                if seconds <= le:
-                    self.latency_counts[i] += 1
-                    break
-            else:
-                self.latency_counts[-1] += 1  # +Inf
+            observe(self.latency_buckets, self.latency_counts, seconds)
             self.latency_sum += seconds
 
     def latency_snapshot(self) -> tuple[list[int], float]:

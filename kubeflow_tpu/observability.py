@@ -40,17 +40,13 @@ def render_metrics(platform) -> str:
         )
         # reconcile-duration histogram (controller-runtime parity):
         # cumulative le buckets + _sum/_count in exposition format
-        hname = f"kftpu_{cname}_reconcile_duration_seconds"
-        lines.append(f"# TYPE {hname} histogram")
+        from kubeflow_tpu.utils.prom import render_histogram
+
         counts, total = ctrl.latency_snapshot()
-        cum = 0
-        for le, n in zip(ctrl.latency_buckets, counts):
-            cum += n
-            lines.append(f'{hname}_bucket{{le="{le}"}} {cum}')
-        cum += counts[-1]
-        lines.append(f'{hname}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{hname}_sum {total:.6f}")
-        lines.append(f"{hname}_count {cum}")
+        render_histogram(
+            lines, f"kftpu_{cname}_reconcile_duration_seconds",
+            ctrl.latency_buckets, counts, total,
+        )
 
     cluster = platform.cluster
     # one TYPE line, then one sample per label — repeated TYPE lines for the

@@ -41,6 +41,11 @@ class RequestLogger:
         # (model, protocol, code) -> count; model -> (latency_sum_s, count)
         self.requests_total: dict[tuple[str, str, int], int] = {}
         self.latency: dict[str, list[float]] = {}
+        # per-model latency histogram buckets (serving SLOs live in the
+        # tail, which a sum/count summary cannot show)
+        self.latency_buckets: tuple[float, ...] = (
+            0.005, 0.025, 0.1, 0.25, 1.0, 2.5, 10.0)
+        self.latency_hist: dict[str, list[int]] = {}
 
     def log(self, model: str, protocol: str, code: int, latency_s: float,
             req_bytes: int, resp_bytes: int) -> None:
@@ -50,6 +55,11 @@ class RequestLogger:
             agg = self.latency.setdefault(model, [0.0, 0])
             agg[0] += latency_s
             agg[1] += 1
+            from kubeflow_tpu.utils.prom import observe
+
+            hist = self.latency_hist.setdefault(
+                model, [0] * (len(self.latency_buckets) + 1))
+            observe(self.latency_buckets, hist, latency_s)
             if self._fh is not None:
                 self._fh.write(json.dumps({
                     "ts": time.time(),
@@ -72,13 +82,16 @@ class RequestLogger:
                     f'kfserving_requests_total{{model="{model}",'
                     f'protocol="{proto}",code="{code}"}} {n}'
                 )
-            lines.append("# TYPE kfserving_request_latency_seconds summary")
+            from kubeflow_tpu.utils.prom import render_histogram
+
+            lines.append("# TYPE kfserving_request_latency_seconds histogram")
             for model, (s, n) in sorted(self.latency.items()):
-                lines.append(
-                    f'kfserving_request_latency_seconds_sum{{model="{model}"}} {s:.6f}'
-                )
-                lines.append(
-                    f'kfserving_request_latency_seconds_count{{model="{model}"}} {n}'
+                render_histogram(
+                    lines, "kfserving_request_latency_seconds",
+                    self.latency_buckets,
+                    self.latency_hist.get(
+                        model, [0] * (len(self.latency_buckets) + 1)),
+                    s, labels=f'model="{model}",', emit_type=False,
                 )
             return "\n".join(lines) + "\n"
 

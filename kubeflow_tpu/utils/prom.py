@@ -1,0 +1,37 @@
+"""Prometheus histogram helpers — the ONE implementation of bucket
+observation and exposition-format rendering shared by every /metrics
+surface (controller reconcile latencies, serving request latencies)."""
+
+from __future__ import annotations
+
+
+def observe(buckets: tuple[float, ...], counts: list[int],
+            value: float) -> None:
+    """Record one observation into per-bucket counts (+Inf in the last
+    slot). Caller owns locking."""
+    for i, le in enumerate(buckets):
+        if value <= le:
+            counts[i] += 1
+            return
+    counts[-1] += 1
+
+
+def render_histogram(lines: list[str], name: str,
+                     buckets: tuple[float, ...], counts: list[int],
+                     total_sum: float, labels: str = "",
+                     emit_type: bool = True) -> None:
+    """Append exposition-format histogram lines: cumulative le buckets
+    (+Inf == _count by construction), _sum, _count. `labels` is a
+    pre-rendered 'key="value",' prefix for per-series histograms."""
+    if emit_type:
+        lines.append(f"# TYPE {name} histogram")
+    cum = 0
+    for le, n in zip(buckets, counts):
+        cum += n
+        lines.append(f'{name}_bucket{{{labels}le="{le}"}} {cum}')
+    cum += counts[-1]
+    lines.append(f'{name}_bucket{{{labels}le="+Inf"}} {cum}')
+    lines.append(f"{name}_sum{{{labels[:-1]}}} {total_sum:.6f}"
+                 if labels else f"{name}_sum {total_sum:.6f}")
+    lines.append(f"{name}_count{{{labels[:-1]}}} {cum}"
+                 if labels else f"{name}_count {cum}")

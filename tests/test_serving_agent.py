@@ -196,3 +196,26 @@ class TestRepositoryAPI:
             assert exc.code == 500  # missing artifact surfaces as load error
         finally:
             srv.stop()
+
+
+class TestLatencyHistogram:
+    def test_histogram_rendered_cumulative_per_model(self, tmp_path):
+        from kubeflow_tpu.serving.agent import RequestLogger
+
+        lg = RequestLogger(str(tmp_path / "reqs.jsonl"))
+        for lat in (0.001, 0.01, 0.01, 0.3, 99.0):
+            lg.log("m1", "v2", 200, lat, 10, 20)
+        lg.log("m2", "v1", 200, 0.05, 1, 1)
+        text = lg.render_metrics()
+        lg.close()
+        assert "# TYPE kfserving_request_latency_seconds histogram" in text
+        import re
+
+        m1 = re.findall(
+            r'kfserving_request_latency_seconds_bucket\{model="m1",'
+            r'le="([^"]+)"\} (\d+)', text)
+        assert m1[-1] == ("+Inf", "5")
+        counts = [int(n) for _, n in m1]
+        assert counts == sorted(counts)
+        assert 'latency_seconds_count{model="m1"} 5' in text
+        assert 'latency_seconds_count{model="m2"} 1' in text
