@@ -80,6 +80,12 @@ class JAXJobSpec:
     # KFTPU_PROFILE_DIR and the in-tree trainer writes a jax.profiler
     # (perfetto-compatible) trace per process under it.
     profile_dir: str = ""
+    # TFJob successPolicy parity: "" = the kind's success replica decides
+    # (chief/master/launcher/worker-0; JAX jobs always need all workers);
+    # "AllWorkers" = every worker AND the success replica must complete
+    # (passive replicas — PS/scheduler/server — stay excluded: they never
+    # exit and are reaped on success)
+    success_policy: str = ""
 
 
 @dataclass

@@ -51,6 +51,17 @@ def validate_job(job: TrainJob) -> TrainJob:
     """Validate + default a job spec in place. Raises ValidationError."""
     if not job.metadata.name:
         raise ValidationError("metadata.name", "name is required")
+    if job.spec.success_policy not in ("", "AllWorkers"):
+        raise ValidationError(
+            "spec.successPolicy",
+            f"{job.spec.success_policy!r} must be \"\" or \"AllWorkers\"",
+        )
+    if job.spec.success_policy == "AllWorkers" and job.kind == JobKind.MPI:
+        raise ValidationError(
+            "spec.successPolicy",
+            "AllWorkers cannot apply to MPIJob: its workers idle (sshd "
+            "analogue) and never exit, so the job could never succeed",
+        )
     if not _NAME_RE.match(job.metadata.name) or len(job.metadata.name) > 63:
         raise ValidationError(
             "metadata.name",

@@ -410,21 +410,29 @@ class JobController(ControllerBase):
             ): p
             for p in pods
         }
-        if job.kind == JobKind.JAX:
+        def all_workers_succeeded() -> bool:
             workers = job.spec.replica_specs.get(REPLICA_WORKER)
             n = workers.replicas if workers else 0
             if n == 0:
                 return False
             return all(
-                (p := by.get((REPLICA_WORKER, i))) is not None
-                and p.status.phase == PodPhase.SUCCEEDED
+                (w := by.get((REPLICA_WORKER, i))) is not None
+                and w.status.phase == PodPhase.SUCCEEDED
                 for i in range(n)
             )
+
+        if job.kind == JobKind.JAX:
+            return all_workers_succeeded()
         success_rtype = SUCCESS_REPLICA[job.kind]
         if success_rtype not in job.spec.replica_specs:
             success_rtype = REPLICA_WORKER
         p = by.get((success_rtype, 0))
-        return p is not None and p.status.phase == PodPhase.SUCCEEDED
+        decider_done = p is not None and p.status.phase == PodPhase.SUCCEEDED
+        if job.spec.success_policy != "AllWorkers":
+            return decider_done
+        # TFJob successPolicy=AllWorkers: the decider AND every worker
+        # replica must complete (passive PS-style replicas excluded)
+        return decider_done and all_workers_succeeded()
 
     def _cleanup_finished(
         self, job: TrainJob, key: str, pods: list[Pod]

@@ -109,6 +109,11 @@ class LocalRunner:
             if r.rtype == success_rtype and (r.index == 0 or r.rtype == REPLICA_WORKER)
         ]
         verdict = bool(deciders) and all(r.exit_code == 0 for r in deciders)
+        if verdict and job.spec.success_policy == "AllWorkers":
+            # same verdict the controller reaches for this spec: every
+            # worker must complete cleanly, not just the decider
+            workers = [r for r in results if r.rtype == REPLICA_WORKER]
+            verdict = all(r.exit_code == 0 for r in workers)
 
         st = job.status
         st.start_time = st.start_time or _now()

@@ -288,3 +288,131 @@ class TestPaddleJob:
         done = client.wait_for_job_conditions("pd1", timeout_s=60)
         assert done.status.is_succeeded
         assert "paddle master done" in client.get_job_logs("pd1", rtype="master")
+
+
+class TestSuccessPolicy:
+    """TFJob successPolicy parity: AllWorkers requires every worker to
+    complete, not just the deciding replica."""
+
+    def _tf_job(self, tmp_path, name, policy, worker_sleep="0"):
+        import sys
+
+        from kubeflow_tpu.api import (
+            ContainerSpec, ObjectMeta, PodTemplateSpec, ReplicaSpec,
+            REPLICA_CHIEF, REPLICA_WORKER,
+        )
+        from kubeflow_tpu.api.jobs import JAXJobSpec, TFJob
+
+        fast = tmp_path / "fast.py"
+        fast.write_text("print('done')")
+        slow = tmp_path / "slow.py"
+        slow.write_text(f"import time; time.sleep({worker_sleep}); print('w')")
+        return TFJob(
+            metadata=ObjectMeta(name=name),
+            spec=JAXJobSpec(
+                success_policy=policy,
+                replica_specs={
+                    REPLICA_CHIEF: ReplicaSpec(
+                        replicas=1,
+                        template=PodTemplateSpec(container=ContainerSpec(
+                            command=[sys.executable, str(fast)]))),
+                    REPLICA_WORKER: ReplicaSpec(
+                        replicas=2,
+                        template=PodTemplateSpec(container=ContainerSpec(
+                            command=[sys.executable, str(slow)]))),
+                },
+            ),
+        )
+
+    def test_default_chief_decides(self, client, tmp_path):
+        client.create_job(self._tf_job(tmp_path, "tf-chief", "", "30"))
+        done = client.wait_for_job_conditions("tf-chief", timeout_s=60)
+        # chief finished instantly; workers still sleeping — job succeeded
+        assert done.status.is_succeeded
+
+    def test_all_workers_waits_for_every_worker(self, client, tmp_path):
+        import time as _t
+
+        client.create_job(
+            self._tf_job(tmp_path, "tf-all", "AllWorkers", "3"))
+        # once the chief has FINISHED (asserted — not assumed) the job
+        # must still not be succeeded: workers are sleeping under
+        # AllWorkers
+        from kubeflow_tpu.controller.podruntime import PodPhase
+
+        deadline = _t.monotonic() + 30
+        chief_done = False
+        while _t.monotonic() < deadline:
+            pod = client.platform.cluster.get("pods", "default/tf-all-chief-0")
+            if pod is not None and pod.status.phase == PodPhase.SUCCEEDED:
+                chief_done = True
+                break
+            _t.sleep(0.1)
+        assert chief_done
+        j = client.get_job("tf-all")
+        assert not j.status.is_succeeded
+        done = client.wait_for_job_conditions("tf-all", timeout_s=60)
+        assert done.status.is_succeeded
+
+    def test_invalid_policy_rejected(self, tmp_path):
+        import pytest as _pytest
+
+        from kubeflow_tpu.api.validation import ValidationError, validate_job
+
+        job = self._tf_job(tmp_path, "tf-bad", "SomeWorkers")
+        with _pytest.raises(ValidationError, match="AllWorkers"):
+            validate_job(job)
+
+    def test_mpi_all_workers_rejected(self, tmp_path):
+        import sys
+
+        import pytest as _pytest
+
+        from kubeflow_tpu.api import (
+            ContainerSpec, ObjectMeta, PodTemplateSpec, ReplicaSpec,
+            REPLICA_LAUNCHER, REPLICA_WORKER,
+        )
+        from kubeflow_tpu.api.jobs import JAXJobSpec, MPIJob
+        from kubeflow_tpu.api.validation import ValidationError, validate_job
+
+        job = MPIJob(
+            metadata=ObjectMeta(name="mpi-bad"),
+            spec=JAXJobSpec(
+                success_policy="AllWorkers",
+                replica_specs={
+                    REPLICA_LAUNCHER: ReplicaSpec(
+                        replicas=1,
+                        template=PodTemplateSpec(container=ContainerSpec(
+                            command=[sys.executable, "-c", "print(1)"]))),
+                    REPLICA_WORKER: ReplicaSpec(
+                        replicas=2,
+                        template=PodTemplateSpec(container=ContainerSpec(
+                            command=[sys.executable, "-c", "print(1)"]))),
+                },
+            ),
+        )
+        with _pytest.raises(ValidationError, match="MPIJob"):
+            validate_job(job)
+
+    def test_local_runner_parity(self, tmp_path):
+        """LocalRunner reaches the SAME AllWorkers verdict the controller
+        would: a failing worker fails the job even when the chief exits 0."""
+        import sys
+
+        from kubeflow_tpu.runtime import LocalRunner
+
+        job = self._tf_job(tmp_path, "tf-local", "AllWorkers")
+        bad = tmp_path / "bad.py"
+        bad.write_text("raise SystemExit(1)")
+        from kubeflow_tpu.api import REPLICA_WORKER
+
+        job.spec.replica_specs[REPLICA_WORKER].template.container.command = [
+            sys.executable, str(bad)]
+        res = LocalRunner(log_dir=str(tmp_path / "lr")).run(job)
+        assert not res.succeeded
+        # default policy: same spec succeeds (chief decides)
+        job2 = self._tf_job(tmp_path, "tf-local2", "")
+        job2.spec.replica_specs[REPLICA_WORKER].template.container.command = [
+            sys.executable, str(bad)]
+        res2 = LocalRunner(log_dir=str(tmp_path / "lr2")).run(job2)
+        assert res2.succeeded
