@@ -1,0 +1,73 @@
+"""ViT image classification — the MXU-native image path.
+
+Reference parity: kubeflow/examples ships image-classification training
+images (SURVEY.md L6); the in-tree ViT family (models/vit.py) is the
+performance-first counterpoint to the conv-bound ResNet flagship on this
+backend: patch embedding is one reshape + one matmul, the encoder reuses
+the BERT layer stack, and every FLOP is a matmul the MXU tiles natively.
+
+  python -m examples.vit --device=cpu --size=tiny --steps=20
+  python -m examples.vit --device=tpu --size=base --bf16
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv: list[str] | None = None) -> float:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+    p.add_argument("--size", default="tiny", choices=["tiny", "base"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--fused-steps", type=int, default=1,
+                   help="optimizer steps per jit dispatch (lax.scan chunks)")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--no-bf16", dest="bf16", action="store_false")
+    p.add_argument("--data-parallel", type=int, default=-1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--checkpoint-dir", default=None)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.vit import ViTClassifier, ViTConfig
+    from kubeflow_tpu.parallel import MeshConfig
+    from kubeflow_tpu.train import Trainer, TrainerConfig
+    from kubeflow_tpu.train.data import synthetic_image_dataset
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    mk = ViTConfig.tiny if args.size == "tiny" else ViTConfig.base
+    cfg = mk(num_classes=args.num_classes, dtype=dtype, dropout_rate=0.0)
+    dataset = synthetic_image_dataset(
+        n_train=args.batch_size * 8,
+        n_test=args.batch_size * 2,
+        shape=(cfg.image_size, cfg.image_size, 3),
+        num_classes=args.num_classes,
+    )
+    trainer = Trainer(
+        ViTClassifier(cfg),
+        TrainerConfig(
+            fused_steps=args.fused_steps,
+            batch_size=args.batch_size,
+            steps=args.steps,
+            learning_rate=args.lr,
+            compute_dtype=dtype,
+            checkpoint_dir=args.checkpoint_dir,
+            mesh=MeshConfig(data=args.data_parallel, fsdp=args.fsdp),
+            log_every_steps=10,
+        ),
+    )
+    _, metrics = trainer.fit(dataset)
+    return metrics.get("final_accuracy", 0.0)
+
+
+if __name__ == "__main__":
+    main()

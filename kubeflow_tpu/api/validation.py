@@ -56,12 +56,21 @@ def validate_job(job: TrainJob) -> TrainJob:
             "spec.successPolicy",
             f"{job.spec.success_policy!r} must be \"\" or \"AllWorkers\"",
         )
-    if job.spec.success_policy == "AllWorkers" and job.kind == JobKind.MPI:
-        raise ValidationError(
-            "spec.successPolicy",
-            "AllWorkers cannot apply to MPIJob: its workers idle (sshd "
-            "analogue) and never exit, so the job could never succeed",
-        )
+    if job.spec.success_policy == "AllWorkers":
+        if job.kind == JobKind.MPI:
+            raise ValidationError(
+                "spec.successPolicy",
+                "AllWorkers cannot apply to MPIJob: its workers idle "
+                "(sshd analogue) and never exit, so the job could never "
+                "succeed",
+            )
+        workers = job.spec.replica_specs.get("worker")
+        if workers is None or workers.replicas == 0:
+            raise ValidationError(
+                "spec.successPolicy",
+                "AllWorkers requires at least one worker replica (the "
+                "controller would wait on workers that never exist)",
+            )
     if not _NAME_RE.match(job.metadata.name) or len(job.metadata.name) > 63:
         raise ValidationError(
             "metadata.name",

@@ -424,7 +424,12 @@ class JobController(ControllerBase):
         if job.kind == JobKind.JAX:
             return all_workers_succeeded()
         success_rtype = SUCCESS_REPLICA[job.kind]
-        if success_rtype not in job.spec.replica_specs:
+        rs = job.spec.replica_specs.get(success_rtype)
+        if rs is None or rs.replicas == 0:
+            # present-but-empty decider spec falls back exactly like
+            # LocalRunner (runtime/local.py): worker-0 decides — a
+            # 0-replica chief never gets a pod, so waiting on it would
+            # leave the job unfinishable
             success_rtype = REPLICA_WORKER
         p = by.get((success_rtype, 0))
         decider_done = p is not None and p.status.phase == PodPhase.SUCCEEDED

@@ -28,6 +28,7 @@ from kubeflow_tpu.api import (
     REPLICA_WORKER,
 )
 from kubeflow_tpu.api.jobs import JAXJobSpec, MPIJob, PyTorchJob, TFJob
+from kubeflow_tpu.api.validation import ValidationError, validate_job
 from kubeflow_tpu.client import Platform, TrainingClient
 from kubeflow_tpu.controller.fakecluster import PodPhase
 
@@ -295,14 +296,6 @@ class TestSuccessPolicy:
     complete, not just the deciding replica."""
 
     def _tf_job(self, tmp_path, name, policy, worker_sleep="0"):
-        import sys
-
-        from kubeflow_tpu.api import (
-            ContainerSpec, ObjectMeta, PodTemplateSpec, ReplicaSpec,
-            REPLICA_CHIEF, REPLICA_WORKER,
-        )
-        from kubeflow_tpu.api.jobs import JAXJobSpec, TFJob
-
         fast = tmp_path / "fast.py"
         fast.write_text("print('done')")
         slow = tmp_path / "slow.py"
@@ -338,8 +331,6 @@ class TestSuccessPolicy:
         # once the chief has FINISHED (asserted — not assumed) the job
         # must still not be succeeded: workers are sleeping under
         # AllWorkers
-        from kubeflow_tpu.controller.podruntime import PodPhase
-
         deadline = _t.monotonic() + 30
         chief_done = False
         while _t.monotonic() < deadline:
@@ -355,26 +346,26 @@ class TestSuccessPolicy:
         assert done.status.is_succeeded
 
     def test_invalid_policy_rejected(self, tmp_path):
-        import pytest as _pytest
-
-        from kubeflow_tpu.api.validation import ValidationError, validate_job
-
         job = self._tf_job(tmp_path, "tf-bad", "SomeWorkers")
-        with _pytest.raises(ValidationError, match="AllWorkers"):
+        with pytest.raises(ValidationError, match="AllWorkers"):
             validate_job(job)
 
+    def test_workerless_all_workers_rejected(self, tmp_path):
+        job = self._tf_job(tmp_path, "tf-nw", "AllWorkers")
+        job.spec.replica_specs[REPLICA_WORKER].replicas = 0
+        with pytest.raises(ValidationError, match="at least one worker"):
+            validate_job(job)
+
+    def test_zero_replica_chief_falls_back_to_worker(self, client, tmp_path):
+        """Present-but-empty chief spec: worker-0 decides, in parity with
+        LocalRunner (a 0-replica chief never gets a pod)."""
+        job = self._tf_job(tmp_path, "tf-zc", "", "0")
+        job.spec.replica_specs[REPLICA_CHIEF].replicas = 0
+        client.create_job(job)
+        done = client.wait_for_job_conditions("tf-zc", timeout_s=60)
+        assert done.status.is_succeeded
+
     def test_mpi_all_workers_rejected(self, tmp_path):
-        import sys
-
-        import pytest as _pytest
-
-        from kubeflow_tpu.api import (
-            ContainerSpec, ObjectMeta, PodTemplateSpec, ReplicaSpec,
-            REPLICA_LAUNCHER, REPLICA_WORKER,
-        )
-        from kubeflow_tpu.api.jobs import JAXJobSpec, MPIJob
-        from kubeflow_tpu.api.validation import ValidationError, validate_job
-
         job = MPIJob(
             metadata=ObjectMeta(name="mpi-bad"),
             spec=JAXJobSpec(
@@ -391,21 +382,17 @@ class TestSuccessPolicy:
                 },
             ),
         )
-        with _pytest.raises(ValidationError, match="MPIJob"):
+        with pytest.raises(ValidationError, match="MPIJob"):
             validate_job(job)
 
     def test_local_runner_parity(self, tmp_path):
         """LocalRunner reaches the SAME AllWorkers verdict the controller
         would: a failing worker fails the job even when the chief exits 0."""
-        import sys
-
         from kubeflow_tpu.runtime import LocalRunner
 
         job = self._tf_job(tmp_path, "tf-local", "AllWorkers")
         bad = tmp_path / "bad.py"
         bad.write_text("raise SystemExit(1)")
-        from kubeflow_tpu.api import REPLICA_WORKER
-
         job.spec.replica_specs[REPLICA_WORKER].template.container.command = [
             sys.executable, str(bad)]
         res = LocalRunner(log_dir=str(tmp_path / "lr")).run(job)
