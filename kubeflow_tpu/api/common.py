@@ -64,6 +64,14 @@ def is_retryable_exit_code(code: int) -> bool:
     return code >= RETRYABLE_EXIT_CODE_MIN
 
 
+def _scalar_str(v) -> str:
+    """String form of a YAML scalar, rendering booleans the way the
+    manifest author wrote them ('true'/'false')."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
 @dataclass
 class ObjectMeta:
     """Minimal object metadata (k8s ObjectMeta analogue)."""
@@ -73,6 +81,19 @@ class ObjectMeta:
     labels: dict[str, str] = field(default_factory=dict)
     annotations: dict[str, str] = field(default_factory=dict)
     uid: str = ""
+
+    def __post_init__(self):
+        # k8s labels/annotations are string-typed; unquoted YAML scalars
+        # (numbers/bools) and an explicit `labels:` null must normalize at
+        # parse time or selectors silently never match (same coercion
+        # ContainerSpec applies to env/command/args)
+        self.labels = {
+            str(k): _scalar_str(v) for k, v in (self.labels or {}).items()
+        }
+        self.annotations = {
+            str(k): _scalar_str(v)
+            for k, v in (self.annotations or {}).items()
+        }
     # Set by the object store on admission (k8s semantics); empty until then so
     # spec serialization stays deterministic for golden-file tests.
     creation_timestamp: str = ""

@@ -7,7 +7,7 @@ so that CLIs and SDKs in OTHER processes can drive the platform the way
 kubectl/k8s clients drive the reference:
 
   GET    /healthz | /metrics | /readyz
-  GET    /api/v1/{kind}                     list (all namespaces)
+  GET    /api/v1/{kind}                     list (?namespace=, ?labelSelector=k=v|k==v|k!=v[,..])
   GET    /api/v1/{kind}?watch=true          NDJSON event stream (list+watch:
                                             current objects replay as ADDED;
                                             &timeoutSeconds=N bounds it;
@@ -359,6 +359,41 @@ class PlatformServer:
 
                 objs = [o for o in objs
                         if can_read(cluster, o.metadata.namespace, user)]
+            if "namespace" in query:
+                objs = [o for o in objs
+                        if o.metadata.namespace == query["namespace"]]
+            if "labelSelector" in query:
+                # kubectl equality selectors: k=v | k==v | k!=v, comma-ANDed
+                terms: list[tuple[str, str, bool]] = []
+                for pair in query["labelSelector"].split(","):
+                    if not pair:
+                        continue
+                    if "!=" in pair:
+                        k, _, v = pair.partition("!=")
+                        eq = False
+                    elif "==" in pair:
+                        k, _, v = pair.partition("==")
+                        eq = True
+                    elif "=" in pair:
+                        k, _, v = pair.partition("=")
+                        eq = True
+                    else:
+                        return 400, {"error":
+                                     "labelSelector must be "
+                                     "k=v|k==v|k!=v[,more]"}
+                    terms.append((k, v, eq))
+
+                def matches(o) -> bool:
+                    labels = o.metadata.labels or {}
+                    for k, v, eq in terms:
+                        if eq and labels.get(k) != v:
+                            return False
+                        # k8s != semantics: a MISSING key satisfies !=
+                        if not eq and labels.get(k) == v:
+                            return False
+                    return True
+
+                objs = [o for o in objs if matches(o)]
             return 200, [_serialize(kind, o) for o in objs]
         if method == "GET" and len(parts) == 5:
             obj = cluster.get(kind, f"{parts[3]}/{parts[4]}")

@@ -314,3 +314,70 @@ def test_remote_train_convenience(remote):
 def test_remote_train_unknown_family(remote):
     with pytest.raises(ValueError, match="unknown family"):
         remote.train("x", family="nope")
+
+
+class TestListFilters:
+    def test_namespace_and_label_selector(self, remote):
+        for name, ns, labels in (
+            ("nb-a", "default", {"team": "x", "tier": "dev"}),
+            ("nb-b", "default", {"team": "y"}),
+            ("nb-c", "other", {"team": "x"}),
+        ):
+            remote.apply({
+                "kind": "Notebook", "apiVersion": "kubeflow-tpu.org/v1",
+                "metadata": {"name": name, "namespace": ns,
+                             "labels": labels},
+            })
+        import urllib.request
+        import json as _json
+
+        def names(qs):
+            with urllib.request.urlopen(
+                    f"{remote.server}/api/v1/notebooks{qs}",
+                    timeout=10) as r:
+                return sorted(o["metadata"]["name"]
+                              for o in _json.loads(r.read()))
+
+        assert names("") == ["nb-a", "nb-b", "nb-c"]
+        assert names("?namespace=default") == ["nb-a", "nb-b"]
+        assert names("?labelSelector=team%3Dx") == ["nb-a", "nb-c"]
+        assert names("?namespace=default&labelSelector=team%3Dx") == ["nb-a"]
+        assert names("?labelSelector=team%3Dx,tier%3Ddev") == ["nb-a"]
+
+    def test_bad_selector_400(self, remote):
+        import urllib.error
+        import urllib.request
+
+        import pytest as _p
+
+        with _p.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{remote.server}/api/v1/notebooks?labelSelector=oops",
+                timeout=10)
+        assert e.value.code == 400
+
+    def test_selector_operators_and_null_labels(self, remote):
+        remote.apply({
+            "kind": "Notebook", "apiVersion": "kubeflow-tpu.org/v1",
+            "metadata": {"name": "nb-null", "labels": None},
+        })
+        remote.apply({
+            "kind": "Notebook", "apiVersion": "kubeflow-tpu.org/v1",
+            "metadata": {"name": "nb-num", "labels": {"tier": 1}},
+        })
+        import json as _json
+        import urllib.request
+
+        def names(qs):
+            with urllib.request.urlopen(
+                    f"{remote.server}/api/v1/notebooks{qs}",
+                    timeout=10) as r:
+                return sorted(o["metadata"]["name"]
+                              for o in _json.loads(r.read()))
+
+        # null labels never 500, kubectl == works, numeric labels coerce
+        assert "nb-null" not in names("?labelSelector=tier%3D1")
+        assert names("?labelSelector=tier%3D%3D1") == ["nb-num"]
+        # != matches objects MISSING the key (k8s semantics)
+        assert "nb-null" in names("?labelSelector=tier%21%3D1")
+        assert "nb-num" not in names("?labelSelector=tier%21%3D1")
