@@ -86,7 +86,16 @@ class ObjectMeta:
         # k8s labels/annotations are string-typed; unquoted YAML scalars
         # (numbers/bools) and an explicit `labels:` null must normalize at
         # parse time or selectors silently never match (same coercion
-        # ContainerSpec applies to env/command/args)
+        # ContainerSpec applies to env/command/args). A null VALUE is
+        # rejected like k8s admission does — coercing it to the string
+        # "None" would make `team=None` unexpectedly match.
+        for which, d in (("label", self.labels),
+                         ("annotation", self.annotations)):
+            for k, v in (d or {}).items():
+                if v is None:
+                    raise ValueError(
+                        f"{which} {k!r} has a null value (write an empty "
+                        f"string, or drop the key)")
         self.labels = {
             str(k): _scalar_str(v) for k, v in (self.labels or {}).items()
         }

@@ -367,7 +367,8 @@ class PlatformServer:
                 terms: list[tuple[str, str, bool]] = []
                 for pair in query["labelSelector"].split(","):
                     if not pair:
-                        continue
+                        return 400, {"error":
+                                     "labelSelector has an empty term"}
                     if "!=" in pair:
                         k, _, v = pair.partition("!=")
                         eq = False
@@ -381,6 +382,9 @@ class PlatformServer:
                         return 400, {"error":
                                      "labelSelector must be "
                                      "k=v|k==v|k!=v[,more]"}
+                    if not k:
+                        return 400, {"error":
+                                     "labelSelector term has an empty key"}
                     terms.append((k, v, eq))
 
                 def matches(o) -> bool:

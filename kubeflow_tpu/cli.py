@@ -369,14 +369,17 @@ def cmd_apply(args) -> int:
 def cmd_get(args) -> int:
     client = _remote(args)
     if args.name:
+        if args.selector:
+            print("error: a name and a selector cannot both be given "
+                  "(kubectl semantics)", file=sys.stderr)
+            return 2
         print(json.dumps(client.get(args.kind, args.name, args.namespace), indent=2))
         return 0
-    objs = client.list(args.kind)
-    if not args.all_namespaces:
-        objs = [
-            o for o in objs
-            if o.get("metadata", {}).get("namespace", "default") == args.namespace
-        ]
+    objs = client.list(
+        args.kind,
+        namespace="" if args.all_namespaces else args.namespace,
+        label_selector=args.selector,
+    )
     for o in objs:
         meta = o.get("metadata", {})
         status = o.get("status", {})
@@ -716,6 +719,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("name", nargs="?", default="")
     p.add_argument("-n", "--namespace", default="default")
     p.add_argument("-A", "--all-namespaces", action="store_true")
+    p.add_argument("-l", "--selector", default="",
+                   help="label selector: k=v | k==v | k!=v, comma-ANDed")
 
     p = server_arg(add("logs", cmd_logs, help="print a job replica's log (remote)"))
     p.add_argument("name")

@@ -65,8 +65,17 @@ class RemoteClient:
             raise ValueError(f"unknown kind {data.get('kind')!r}")
         return self._request("POST", f"/api/v1/{bucket}", data)
 
-    def list(self, kind: str) -> list[dict]:
-        return self._request("GET", f"/api/v1/{kind}")
+    def list(self, kind: str, namespace: str = "",
+             label_selector: str = "") -> list[dict]:
+        """List objects; optional server-side filters (kubectl parity):
+        namespace, and equality selectors k=v | k==v | k!=v comma-ANDed."""
+        params = {}
+        if namespace:
+            params["namespace"] = namespace
+        if label_selector:
+            params["labelSelector"] = label_selector
+        qs = f"?{urllib.parse.urlencode(params)}" if params else ""
+        return self._request("GET", f"/api/v1/{kind}{qs}")
 
     def get(self, kind: str, name: str, namespace: str = "default") -> dict:
         return self._request("GET", f"/api/v1/{kind}/{namespace}/{name}")

@@ -316,6 +316,15 @@ def test_remote_train_unknown_family(remote):
         remote.train("x", family="nope")
 
 
+def _list_names(remote, qs: str) -> list[str]:
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(f"{remote.server}/api/v1/notebooks{qs}",
+                                timeout=10) as r:
+        return sorted(o["metadata"]["name"] for o in _json.loads(r.read()))
+
+
 class TestListFilters:
     def test_namespace_and_label_selector(self, remote):
         for name, ns, labels in (
@@ -328,16 +337,7 @@ class TestListFilters:
                 "metadata": {"name": name, "namespace": ns,
                              "labels": labels},
             })
-        import urllib.request
-        import json as _json
-
-        def names(qs):
-            with urllib.request.urlopen(
-                    f"{remote.server}/api/v1/notebooks{qs}",
-                    timeout=10) as r:
-                return sorted(o["metadata"]["name"]
-                              for o in _json.loads(r.read()))
-
+        names = lambda qs: _list_names(remote, qs)  # noqa: E731
         assert names("") == ["nb-a", "nb-b", "nb-c"]
         assert names("?namespace=default") == ["nb-a", "nb-b"]
         assert names("?labelSelector=team%3Dx") == ["nb-a", "nb-c"]
@@ -365,19 +365,32 @@ class TestListFilters:
             "kind": "Notebook", "apiVersion": "kubeflow-tpu.org/v1",
             "metadata": {"name": "nb-num", "labels": {"tier": 1}},
         })
-        import json as _json
-        import urllib.request
-
-        def names(qs):
-            with urllib.request.urlopen(
-                    f"{remote.server}/api/v1/notebooks{qs}",
-                    timeout=10) as r:
-                return sorted(o["metadata"]["name"]
-                              for o in _json.loads(r.read()))
-
+        names = lambda qs: _list_names(remote, qs)  # noqa: E731
         # null labels never 500, kubectl == works, numeric labels coerce
         assert "nb-null" not in names("?labelSelector=tier%3D1")
         assert names("?labelSelector=tier%3D%3D1") == ["nb-num"]
         # != matches objects MISSING the key (k8s semantics)
         assert "nb-null" in names("?labelSelector=tier%21%3D1")
         assert "nb-num" not in names("?labelSelector=tier%21%3D1")
+
+
+    def test_null_label_value_rejected_at_admission(self, remote):
+        from kubeflow_tpu.remote import ApiError
+
+        with pytest.raises(ApiError) as e:
+            remote.apply({
+                "kind": "Notebook", "apiVersion": "kubeflow-tpu.org/v1",
+                "metadata": {"name": "nb-nullv",
+                             "labels": {"team": None}},
+            })
+        assert e.value.code == 422
+
+    def test_empty_selector_terms_400(self, remote):
+        import urllib.error
+        import urllib.request
+
+        for qs in ("?labelSelector=,", "?labelSelector=%3Dv"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"{remote.server}/api/v1/notebooks{qs}", timeout=10)
+            assert e.value.code == 400, qs
