@@ -306,6 +306,23 @@ class PlatformServer:
         if kind not in cluster.KINDS:
             return 404, {"error": f"unknown kind {kind!r}"}
 
+        # -------- run lineage graph (MLMD read side)
+        if (kind == "pipelineruns" and len(parts) == 6
+                and parts[5] == "lineage" and method == "GET"):
+            cr = cluster.get("pipelineruns", f"{parts[3]}/{parts[4]}")
+            if cr is None:
+                return 404, {"error":
+                             f"pipelinerun {parts[3]}/{parts[4]} not found"}
+            if not cr.status.run_id:
+                return 404, {"error": "run has no lineage yet (no run id)"}
+            ctrl = self.platform.controllers.get("pipelinerun")
+            if ctrl is None:
+                return 404, {"error": "pipelines application is disabled"}
+            from kubeflow_tpu.pipelines.lineage import run_lineage
+
+            return 200, run_lineage(ctrl.metadata_store(),
+                                    cr.status.run_id)
+
         # -------- run visualization report (KFP viz-server analogue)
         if (kind == "pipelineruns" and len(parts) == 6
                 and parts[5] == "report" and method == "GET"):

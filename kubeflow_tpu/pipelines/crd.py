@@ -70,6 +70,19 @@ class PipelineRunController(ControllerBase):
     #: finished-run results retained for the visualization report
     _RESULT_CAP = 64
 
+    def metadata_store(self):
+        """The controller's MLMD store (opened on first use)."""
+        import os
+
+        from kubeflow_tpu.native import MetadataStore
+
+        with self._ms_mu:
+            if self._metadata_store is None:
+                # MetadataStore.__init__ creates the parent directory
+                self._metadata_store = MetadataStore(
+                    os.path.join(self.work_dir, "mlmd.db"))
+            return self._metadata_store
+
     def result_for(self, namespace: str, name: str):
         """The runner's full result for a finished run (None when the run
         never finished here — e.g. a platform restart)."""
@@ -87,6 +100,12 @@ class PipelineRunController(ControllerBase):
                          resync_period_s=2.0)
         self.work_dir = work_dir
         self.platform = platform
+        # platform-run lineage (MLMD write side, SURVEY §2.6): one durable
+        # store per controller, shared by every runner it spawns (the C++
+        # store is internally locked); lazily opened so merely
+        # constructing a platform never touches disk
+        self._metadata_store = None
+        self._ms_mu = threading.Lock()
         self._running: set[str] = set()  # uids with a live executor thread
         # key -> the runner's full result (task artifacts included) for
         # the visualization report; bounded by _RESULT_CAP, oldest evicted
@@ -148,6 +167,7 @@ class PipelineRunController(ControllerBase):
                 work_dir=self.work_dir,
                 cache=run.spec.cache,
                 platform=self.platform,
+                metadata_store=self.metadata_store(),
             )
             result = runner.run(run.spec.pipeline_spec, run.spec.arguments)
             with self._mu:

@@ -23,6 +23,7 @@ import sys
 import textwrap
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
@@ -95,7 +96,12 @@ class LocalPipelineRunner:
         with self._seq_lock:
             self._run_seq += 1
             seq = self._run_seq
-        run_id = f"{ir['pipelineInfo']['name']}-{seq:04d}-{int(time.time())}"
+        # uuid suffix: seq resets with every runner instance and the
+        # timestamp is second-granular, so two controllers (or two CRs in
+        # the same second) would otherwise collide — and colliding run_ids
+        # MERGE lineage graphs in the shared durable MLMD store
+        run_id = (f"{ir['pipelineInfo']['name']}-{seq:04d}-"
+                  f"{int(time.time())}-{uuid.uuid4().hex[:6]}")
         run_dir = self.work_dir / "runs" / run_id
         run_dir.mkdir(parents=True, exist_ok=True)
 

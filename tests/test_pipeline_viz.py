@@ -195,3 +195,67 @@ class TestReportRendering:
             assert not saw_stale
         finally:
             server.stop()
+
+
+class TestLineageEndpoint:
+    def test_platform_run_records_and_serves_lineage(self, platform):
+        """Platform-executed PipelineRuns record MLMD lineage and serve
+        the run's graph at .../lineage (KFP MLMD read-side parity)."""
+        from kubeflow_tpu.apiserver import PlatformServer
+        from kubeflow_tpu.pipelines.compiler import compile_pipeline
+        from kubeflow_tpu.remote import RemoteClient
+
+        server = PlatformServer(platform, port=0).start()
+        try:
+            ir = compile_pipeline(_viz_pipeline()())
+            rc = RemoteClient(server.url)
+            rc.apply({
+                "kind": "PipelineRun",
+                "apiVersion": "kubeflow-tpu.org/v1beta1",
+                "metadata": {"name": "lin-run", "namespace": "default"},
+                "spec": {"pipelineSpec": ir},
+            })
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                st = rc.get("pipelineruns", "lin-run", "default")["status"]
+                if st.get("state") in ("Succeeded", "Failed"):
+                    break
+                time.sleep(0.3)
+            assert st["state"] == "Succeeded", st
+            with urllib.request.urlopen(
+                f"{server.url}/api/v1/pipelineruns/default/lin-run/lineage",
+                timeout=10,
+            ) as r:
+                graph = json.loads(r.read())
+            names = {e["name"] for e in graph["executions"]}
+            assert any(n.endswith("/evaluate") for n in names)
+            art_names = {a["name"] for a in graph["artifacts"]}
+            assert any(n.endswith("/out/confusion_matrix")
+                       for n in art_names)
+            assert any(n.endswith("/out/roc") for n in art_names)
+            # edges reference real nodes, with directions
+            exec_ids = {e["id"] for e in graph["executions"]}
+            art_ids = {a["id"] for a in graph["artifacts"]}
+            assert graph["edges"]
+            for edge in graph["edges"]:
+                assert edge["execution"] in exec_ids
+                assert edge["artifact"] in art_ids
+                assert edge["direction"] in ("input", "output")
+            # file artifacts carry their uri
+            assert any(a.get("uri") for a in graph["artifacts"]
+                       if a["type"] == "file")
+        finally:
+            server.stop()
+
+    def test_lineage_404_before_run_id(self, platform):
+        from kubeflow_tpu.apiserver import PlatformServer
+
+        server = PlatformServer(platform, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"{server.url}/api/v1/pipelineruns/default/none/lineage",
+                    timeout=10)
+            assert e.value.code == 404
+        finally:
+            server.stop()
