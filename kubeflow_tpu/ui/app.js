@@ -461,13 +461,15 @@ function pipelineRunDetail(o) {
   // would 404, so render no link)
   const reportable = ["Succeeded", "Failed"].includes(
     (o.status || {}).state || "") && (o.status || {}).runId;
-  const href = `/api/v1/pipelineruns/${encodeURIComponent(ns)}/` +
-    `${encodeURIComponent(nm)}/report`;
+  const base = `/api/v1/pipelineruns/${encodeURIComponent(ns)}/` +
+    `${encodeURIComponent(nm)}`;
   const header = kvTable([
     ["state", badge((o.status || {}).state || "-")],
     ["run id", esc((o.status || {}).runId || "-")],
     ["report", reportable
-      ? `<a href="${esc(href)}" target="_blank">visualization report</a>`
+      ? `<a href="${esc(base + "/report")}" target="_blank">` +
+        `visualization report</a> · ` +
+        `<a href="${esc(base + "/lineage")}" target="_blank">lineage</a>`
       : "-"],
     ["error", (o.status || {}).error ?
       `<span class="error-text">${esc(o.status.error)}</span>` : "-"],
