@@ -463,14 +463,18 @@ function pipelineRunDetail(o) {
     (o.status || {}).state || "") && (o.status || {}).runId;
   const base = `/api/v1/pipelineruns/${encodeURIComponent(ns)}/` +
     `${encodeURIComponent(nm)}`;
+  // lineage is served for ANY run with a run id (a running run has a
+  // partial graph); the report only exists after the run finishes here
+  const reportLink = reportable
+    ? `<a href="${esc(base + "/report")}" target="_blank">` +
+      `visualization report</a>` : "";
+  const lineageLink = (o.status || {}).runId
+    ? `<a href="${esc(base + "/lineage")}" target="_blank">lineage</a>` : "";
+  const links = [reportLink, lineageLink].filter(Boolean).join(" · ");
   const header = kvTable([
     ["state", badge((o.status || {}).state || "-")],
     ["run id", esc((o.status || {}).runId || "-")],
-    ["report", reportable
-      ? `<a href="${esc(base + "/report")}" target="_blank">` +
-        `visualization report</a> · ` +
-        `<a href="${esc(base + "/lineage")}" target="_blank">lineage</a>`
-      : "-"],
+    ["report", links || "-"],
     ["error", (o.status || {}).error ?
       `<span class="error-text">${esc(o.status.error)}</span>` : "-"],
   ]);
