@@ -16,6 +16,7 @@ kubectl/k8s clients drive the reference:
   POST   /api/v1/{kind}                     create (manifest body)
   DELETE /api/v1/{kind}/{ns}/{name}         delete (cascade for jobs/isvc)
   GET    /api/v1/jobs/{ns}/{name}/logs?replicaType=worker&index=0
+                                            (&follow=true streams, kubectl logs -f)
   POST   /api/v1/jobs/{ns}/{name}/scale     {"replicas": N}
   GET    /api/v1/events/{ns}/{name}         events for an object
 
@@ -118,6 +119,41 @@ def _deserialize(manifest: dict):
         except ValueError as exc:
             raise ValidationError("binding", str(exc)) from exc
     return bucket, obj
+
+
+_POD_SEGMENT_RE = None
+
+
+def _pod_log_name(name: str, query: dict) -> str | None:
+    """The replica pod name for a logs request, or None when the query
+    carries non-label characters (a traversal attempt like
+    replicaType=x/../../ns2/victim must never reach the filesystem)."""
+    global _POD_SEGMENT_RE
+    if _POD_SEGMENT_RE is None:
+        import re
+
+        _POD_SEGMENT_RE = re.compile(r"^[a-z0-9]([a-z0-9-]*[a-z0-9])?$")
+    rtype = query.get("replicaType", "worker")
+    index = query.get("index", "0")
+    if not _POD_SEGMENT_RE.match(rtype) or not index.isdigit():
+        return None
+    return f"{name}-{rtype}-{index}"
+
+
+def _check_ns_access(cluster, user: str, namespace: str,
+                     verb: str) -> tuple[int, dict] | None:
+    """The ONE kfam gate both plain and streaming routes call — a
+    hand-rolled copy per streaming branch would eventually ship a route
+    open. Returns an error reply or None."""
+    if not user:
+        return None
+    from kubeflow_tpu.controller.kfam import check_access
+
+    try:
+        check_access(cluster, namespace, user, verb)
+    except PermissionError as exc:
+        return 403, {"error": str(exc)}
+    return None
 
 
 class _Html(str):
@@ -265,7 +301,7 @@ class PlatformServer:
         # enforced when the caller asserts an identity (kubeflow-userid);
         # profiles/namespaces stay platform-admin surfaces.
         if user and kind not in ("profiles", "namespaces"):
-            from kubeflow_tpu.controller.kfam import check_access, role_of
+            from kubeflow_tpu.controller.kfam import role_of
 
             verb_ns: tuple[str, str] | None = None
             if method == "GET" and len(parts) >= 5:
@@ -278,10 +314,10 @@ class PlatformServer:
             elif method == "DELETE" and len(parts) == 5:
                 verb_ns = ("delete", parts[3])
             if verb_ns is not None:
-                try:
-                    check_access(cluster, verb_ns[1], user, verb_ns[0])
-                except PermissionError as exc:
-                    return 403, {"error": str(exc)}
+                err = _check_ns_access(cluster, user, verb_ns[1],
+                                       verb_ns[0])
+                if err is not None:
+                    return err
                 # bindings grant access — managing them needs the SAME
                 # admin gate as /kfam/v1/bindings, or any edit-role user
                 # could grant themselves admin through this route
@@ -351,7 +387,10 @@ class PlatformServer:
         if kind == "jobs" and len(parts) == 6 and parts[5] == "logs" and method == "GET":
             if cluster.get("jobs", f"{parts[3]}/{parts[4]}") is None:
                 return 404, {"error": f"job {parts[3]}/{parts[4]} not found"}
-            pod_name = f"{parts[4]}-{query.get('replicaType', 'worker')}-{query.get('index', '0')}"
+            pod_name = _pod_log_name(parts[4], query)
+            if pod_name is None:
+                return 400, {"error": "replicaType/index must be a label "
+                                      "and a number"}
             return 200, self.platform._read_pod_log(pod_name, parts[3])  # raw text
         if kind == "jobs" and len(parts) == 6 and parts[5] == "scale" and method == "POST":
             from kubeflow_tpu.client import TrainingClient
@@ -520,6 +559,49 @@ class PlatformServer:
         cluster.delete("bindings", key)
         return 200, {"deleted": key}
 
+    # --------------------------------------------------------------- logs
+
+    def stream_logs(self, wfile, namespace: str, name: str,
+                    pod_name: str, timeout_s: float) -> None:
+        """kubectl `logs -f` analogue: tail the replica's log file,
+        streaming appended bytes until the pod reaches a terminal phase
+        or the JOB finishes/vanishes (plus one final drain), or the
+        client disconnects. A pod that has not been CREATED yet (the
+        reconcile race right after submit) is waited on, not treated as
+        terminal."""
+        import time
+
+        from kubeflow_tpu.controller.fakecluster import PodPhase
+
+        cluster = self.platform.cluster
+        path = self.platform.pod_runtime.log_path(pod_name, namespace)
+        deadline = time.monotonic() + timeout_s
+        offset = 0
+        try:
+            while time.monotonic() < deadline:
+                pod = cluster.get("pods", f"{namespace}/{pod_name}")
+                job = cluster.get("jobs", f"{namespace}/{name}")
+                done = (
+                    (pod is not None and pod.status.phase in (
+                        PodPhase.SUCCEEDED, PodPhase.FAILED))
+                    or job is None or job.status.is_finished
+                )
+                try:
+                    with open(path, "rb") as fh:
+                        fh.seek(offset)
+                        chunk = fh.read()
+                except OSError:
+                    chunk = b""
+                if chunk:
+                    wfile.write(chunk)
+                    wfile.flush()
+                    offset += len(chunk)
+                if done:
+                    return  # terminal phase AND the tail fully drained
+                time.sleep(0.2)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client went away — normal follow termination
+
     # -------------------------------------------------------------- watch
 
     def stream_watch(self, wfile, kind: str, query: dict,
@@ -605,6 +687,53 @@ class PlatformServer:
                         self.wfile, kind, query,
                         user=self.headers.get("kubeflow-userid", ""),
                     )
+                    return
+                if (
+                    method == "GET"
+                    and query.get("follow") in ("true", "1")
+                    and len(parts) == 6
+                    and parts[:3] == ["api", "v1", "jobs"]
+                    and parts[5] == "logs"
+                ):
+                    # everything that can fail is decided BEFORE the 200
+                    # headers go out — a streaming response cannot change
+                    # its status code later
+                    err = _check_ns_access(
+                        server.platform.cluster,
+                        self.headers.get("kubeflow-userid", ""),
+                        parts[3], "get")
+                    if err is not None:
+                        self._reply(*err)
+                        return
+                    if server.platform.cluster.get(
+                            "jobs", f"{parts[3]}/{parts[4]}") is None:
+                        self._reply(404, {"error":
+                                          f"job {parts[3]}/{parts[4]} "
+                                          "not found"})
+                        return
+                    pod_name = _pod_log_name(parts[4], query)
+                    if pod_name is None:
+                        self._reply(400, {"error":
+                                          "replicaType/index must be a "
+                                          "label and a number"})
+                        return
+                    try:
+                        timeout_s = min(
+                            max(float(query.get("timeoutSeconds", "3600")),
+                                1.0), 86400.0)
+                    except ValueError:
+                        self._reply(400, {"error":
+                                          "timeoutSeconds must be a "
+                                          "number"})
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; charset=utf-8")
+                    self.send_header("Transfer-Encoding", "identity")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    server.stream_logs(self.wfile, parts[3], parts[4],
+                                       pod_name, timeout_s)
                     return
                 self._dispatch_plain(method)
 

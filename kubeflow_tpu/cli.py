@@ -392,7 +392,13 @@ def cmd_get(args) -> int:
 
 
 def cmd_logs(args) -> int:
-    print(_remote(args).job_logs(args.name, args.namespace, args.rtype, args.index),
+    client = _remote(args)
+    if args.follow:
+        for chunk in client.follow_job_logs(
+                args.name, args.namespace, args.rtype, args.index):
+            print(chunk, end="", flush=True)
+        return 0
+    print(client.job_logs(args.name, args.namespace, args.rtype, args.index),
           end="")
     return 0
 
@@ -724,6 +730,8 @@ def main(argv: list[str] | None = None) -> int:
 
     p = server_arg(add("logs", cmd_logs, help="print a job replica's log (remote)"))
     p.add_argument("name")
+    p.add_argument("-f", "--follow", action="store_true",
+                   help="stream appended log output until the pod finishes")
     p.add_argument("--rtype", default="worker")
     p.add_argument("--index", type=int, default=0)
     p.add_argument("-n", "--namespace", default="default")

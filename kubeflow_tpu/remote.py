@@ -80,6 +80,34 @@ class RemoteClient:
     def get(self, kind: str, name: str, namespace: str = "default") -> dict:
         return self._request("GET", f"/api/v1/{kind}/{namespace}/{name}")
 
+    def follow_job_logs(self, name: str, namespace: str = "default",
+                        replica_type: str = "worker", index: int = 0,
+                        timeout_s: float = 3600.0):
+        """kubectl `logs -f` analogue: yields decoded chunks as the
+        replica writes them, ending when the pod finishes."""
+        qs = urllib.parse.urlencode({
+            "replicaType": replica_type, "index": index,
+            "follow": "true", "timeoutSeconds": timeout_s,
+        })
+        import codecs
+
+        req = urllib.request.Request(
+            f"{self.server}/api/v1/jobs/{namespace}/{name}/logs?{qs}")
+        # incremental decoding: a multi-byte UTF-8 char split across
+        # chunk boundaries must not decode to U+FFFD pairs
+        dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
+        with urllib.request.urlopen(req, timeout=timeout_s + 5) as r:
+            while True:
+                chunk = r.read1(65536)
+                if not chunk:
+                    tail = dec.decode(b"", final=True)
+                    if tail:
+                        yield tail
+                    return
+                text = dec.decode(chunk)
+                if text:
+                    yield text
+
     def delete(self, kind: str, name: str, namespace: str = "default") -> dict:
         return self._request("DELETE", f"/api/v1/{kind}/{namespace}/{name}")
 

@@ -394,3 +394,97 @@ class TestListFilters:
                 urllib.request.urlopen(
                     f"{remote.server}/api/v1/notebooks{qs}", timeout=10)
             assert e.value.code == 400, qs
+
+
+class TestLogFollow:
+    def test_follow_streams_until_pod_finishes(self, remote, tmp_path):
+        """kubectl logs -f parity: chunks arrive while the pod runs; the
+        stream ends after the terminal phase with the full log."""
+        import textwrap
+        import urllib.request
+
+        script = tmp_path / "ticker.py"
+        script.write_text(textwrap.dedent("""
+            import sys, time
+            for i in range(5):
+                print(f"tick {i}", flush=True)
+                time.sleep(0.3)
+            print("done", flush=True)
+        """))
+        remote.apply({
+            "kind": "JAXJob", "apiVersion": "kubeflow-tpu.org/v1",
+            "metadata": {"name": "follower"},
+            "spec": {"replicaSpecs": {"worker": {
+                "replicas": 1,
+                "template": {"container": {
+                    "command": [__import__("sys").executable, str(script)],
+                }},
+            }}},
+        })
+        url = (f"{remote.server}/api/v1/jobs/default/follower/logs"
+               f"?follow=true&timeoutSeconds=60")
+        body = b""
+        with urllib.request.urlopen(url, timeout=90) as r:
+            while True:
+                chunk = r.read1(65536)
+                if not chunk:
+                    break
+                body += chunk
+        text = body.decode()
+        assert "tick 0" in text and "tick 4" in text and "done" in text
+
+    def test_sdk_follow_generator(self, remote, tmp_path):
+        script = tmp_path / "one.py"
+        script.write_text("print('solo line')")
+        remote.apply({
+            "kind": "JAXJob", "apiVersion": "kubeflow-tpu.org/v1",
+            "metadata": {"name": "solo"},
+            "spec": {"replicaSpecs": {"worker": {
+                "replicas": 1,
+                "template": {"container": {
+                    "command": [__import__("sys").executable, str(script)],
+                }},
+            }}},
+        })
+        text = "".join(remote.follow_job_logs("solo", timeout_s=60))
+        assert "solo line" in text
+
+    def test_follow_traversal_rejected(self, remote, tmp_path):
+        import urllib.error
+        import urllib.request
+
+        script = tmp_path / "t.py"
+        script.write_text("print('x')")
+        remote.apply({
+            "kind": "JAXJob", "apiVersion": "kubeflow-tpu.org/v1",
+            "metadata": {"name": "trav"},
+            "spec": {"replicaSpecs": {"worker": {
+                "replicas": 1,
+                "template": {"container": {
+                    "command": [__import__("sys").executable, str(script)],
+                }},
+            }}},
+        })
+        bad = ("?follow=true&replicaType=x%2F..%2F..%2Fother%2Fvictim"
+               "&index=0")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{remote.server}/api/v1/jobs/default/trav/logs{bad}",
+                timeout=10)
+        assert e.value.code == 400
+        # the non-follow route rejects the same traversal
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{remote.server}/api/v1/jobs/default/trav/logs"
+                "?replicaType=..%2Fx&index=0", timeout=10)
+        assert e.value.code == 400
+
+    def test_follow_unknown_job_404_and_bad_timeout_400(self, remote):
+        import urllib.error
+        import urllib.request
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{remote.server}/api/v1/jobs/default/nope/logs"
+                "?follow=true", timeout=10)
+        assert e.value.code == 404
