@@ -100,12 +100,6 @@ class GPTConfig:
                 raise ValueError(
                     "rope needs an even head_dim "
                     f"(got {self.hidden_size // self.num_heads})")
-            if self.attention in ("ring", "ulysses"):
-                raise ValueError(
-                    "rope under context parallelism is not wired: the "
-                    "per-shard rotation offset is not plumbed through the "
-                    f"{self.attention} path — use dense|flash, or "
-                    "learned positions with context parallelism")
         if self.moe_experts and self.moe_top_k > self.moe_experts:
             raise ValueError(
                 f"moe_top_k {self.moe_top_k} > moe_experts "
@@ -124,18 +118,9 @@ class GPTConfig:
         return GPTConfig(**d)
 
 
-def apply_rope(x, pos, theta: float = 10000.0):
-    """Rotary position embedding (half-split convention): rotate each
-    head-dim pair by pos * theta^(-2i/d). x: (B, L, H, D), pos: (L,)."""
-    d = x.shape[-1]
-    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # (L, D/2)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate(
-        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
-    return out.astype(x.dtype)
+# shared with the context-parallel attention paths (parallel/rope.py);
+# re-exported here as the family's public name
+from kubeflow_tpu.parallel.rope import apply_rope  # noqa: E402
 
 
 def causal_dense_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
@@ -172,7 +157,12 @@ class CausalSelfAttention(nn.Module):
         if decode:
             y = self._cached_attention(q, k, v)
         else:
-            if c.position_embedding == "rope":
+            rope_inside = (c.position_embedding == "rope"
+                           and c.attention in ("ring", "ulysses"))
+            if c.position_embedding == "rope" and not rope_inside:
+                # dense/flash see the full local sequence: rotate here.
+                # ring/ulysses shard the sequence — THEY rotate, by global
+                # position, inside their shard regions
                 pos = jnp.arange(q.shape[1])
                 q = apply_rope(q, pos, c.rope_theta)
                 k = apply_rope(k, pos, c.rope_theta)
@@ -193,8 +183,9 @@ class CausalSelfAttention(nn.Module):
                 )
             else:
                 attn_fn = _resolve_attention(c.attention)
+                kw = ({"rope_theta": c.rope_theta} if rope_inside else {})
                 y = attn_fn(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
-                            block=c.attention_block, causal=True)
+                            block=c.attention_block, causal=True, **kw)
         return nn.DenseGeneral(
             c.hidden_size, axis=(-2, -1), dtype=c.dtype, name="attn_out"
         )(y)

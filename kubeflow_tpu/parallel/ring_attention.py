@@ -154,9 +154,17 @@ def blockwise_attention(q, k, v, bias, block: int = 256, causal: bool = False):
 # ------------------------------------------------------------------------ ring
 
 
+def _rope_qk(q, k, pos, theta):
+    """Rotate q and k by the given (global) positions — the ONE rope
+    application the context-parallel paths share."""
+    from kubeflow_tpu.parallel.rope import apply_rope
+
+    return apply_rope(q, pos, theta), apply_rope(k, pos, theta)
+
+
 def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
                    block: int = 256, axis_name: str = AXIS_CONTEXT,
-                   causal: bool = False):
+                   causal: bool = False, rope_theta: float | None = None):
     """Ring attention over the `context` mesh axis.
 
     Inside: per-device online-softmax accumulation against the local KV
@@ -174,6 +182,8 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
         raise NotImplementedError("attention dropout unsupported in ring path")
     ctx = _context_size()
     if ctx == 1:
+        if rope_theta is not None:
+            q, k = _rope_qk(q, k, jnp.arange(q.shape[1]), rope_theta)
         return blockwise_attention(q, k, v, bias, block, causal=causal)
 
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -183,7 +193,16 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
         idx = jax.lax.axis_index(axis_name)
         perm = [(i, (i + 1) % ring) for i in range(ring)]
         l_loc = q.shape[1]
-        q_pos = idx * l_loc + jnp.arange(l_loc) if causal else None
+        # ONE global-position vector drives both the rope rotation and
+        # the causal mask — computing it twice invites desync
+        pos = idx * l_loc + jnp.arange(l_loc)
+        if rope_theta is not None:
+            # rotate by GLOBAL position before the ring starts: each
+            # shard rotates its LOCAL q and k once, and rotated K blocks
+            # then travel the ring carrying their rotation (the same
+            # invariant the KV cache keeps by storing rotated keys)
+            q, k = _rope_qk(q, k, pos, rope_theta)
+        q_pos = pos if causal else None
 
         def step(i, carry_kv):
             carry, kv = carry_kv
@@ -218,7 +237,7 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
 
 def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
                       block: int = 256, axis_name: str = AXIS_CONTEXT,
-                      causal: bool = False):
+                      causal: bool = False, rope_theta: float | None = None):
     """Ulysses context parallelism: all-to-all seq<->head re-shard.
 
     Each device exchanges its sequence shard for a head shard (one all-to-all
@@ -230,6 +249,8 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
         raise NotImplementedError("attention dropout unsupported in ulysses path")
     ctx = _context_size()
     if ctx == 1:
+        if rope_theta is not None:
+            q, k = _rope_qk(q, k, jnp.arange(q.shape[1]), rope_theta)
         return blockwise_attention(q, k, v, bias, block, causal=causal)
     mesh = jax.sharding.get_abstract_mesh()
     model = mesh.shape.get(AXIS_MODEL, 1)
@@ -251,7 +272,10 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
             bias, axis_name, axis=3, tiled=True
         )
         # after the exchange every device holds the FULL sequence for its
-        # heads, so causal masking is the ordinary global-position mask
+        # heads, so causal masking is the ordinary global-position mask —
+        # and rope rotation is the ordinary global arange
+        if rope_theta is not None:
+            qg, kg = _rope_qk(qg, kg, jnp.arange(qg.shape[1]), rope_theta)
         o = blockwise_attention(qg, kg, vg, bias_g, block, causal=causal)
         return jax.lax.all_to_all(
             o, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True

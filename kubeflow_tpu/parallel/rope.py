@@ -1,0 +1,21 @@
+"""Rotary position embedding — shared by the GPT family and the
+context-parallel attention paths (which must rotate by GLOBAL position
+inside their shard regions; see ring/ulysses in ring_attention.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def apply_rope(x, pos, theta: float = 10000.0):
+    """Rotary position embedding (half-split convention): rotate each
+    head-dim pair by pos * theta^(-2i/d). x: (B, L, H, D), pos: (L,)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # (L, D/2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
