@@ -315,8 +315,10 @@ def cmd_platform(args) -> int:
             print(f"kfdef error: {exc}", file=sys.stderr)
             return 1
         apps = kfdef.spec.applications or ["(all)"]
+        extra = (f" activator={platform.activator.url}"
+                 if platform.activator is not None else "")
         print(f"platform {kfdef.metadata.name!r} serving at {server.url} "
-              f"applications={','.join(apps)}", flush=True)
+              f"applications={','.join(apps)}{extra}", flush=True)
         try:
             threading.Event().wait()
         except KeyboardInterrupt:

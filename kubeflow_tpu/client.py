@@ -91,14 +91,15 @@ class Platform:
             self.metrics_server = MetricsServer(self, port=port).start()
         return self.metrics_server.url
 
-    def start_activator(self, port: int = 0) -> str:
+    def start_activator(self, port: int = 0,
+                        host: str = "127.0.0.1") -> str:
         """Serverless front door for InferenceServices (Knative activator
         analogue): stable per-service URLs, canary traffic split, and
         request-holding scale-from-zero. Returns the URL."""
         from kubeflow_tpu.serving.activator import Activator
 
         if self.activator is None:
-            self.activator = Activator(self, port=port).start()
+            self.activator = Activator(self, port=port, host=host).start()
         return self.activator.url
 
     def _read_pod_log(self, pod_name: str, namespace: str = "default") -> str:

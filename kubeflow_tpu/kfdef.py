@@ -34,6 +34,9 @@ APPLICATIONS: dict[str, tuple[str, ...]] = {
 class KfDefServer:
     host: str = "127.0.0.1"
     port: int = 8080
+    # serverless front door (serving/activator.py): 0 = pick a free
+    # port; None/absent = no activator
+    activator_port: int | None = None
 
 
 @dataclass
@@ -84,6 +87,12 @@ def validate_kfdef(kfdef: KfDef) -> None:
         raise ValueError(
             "spec.profiles declared but the 'profiles' application is "
             "disabled — nothing would reconcile them")
+    if (kfdef.spec.server.activator_port is not None
+            and kfdef.spec.applications
+            and "kserve" not in kfdef.spec.applications):
+        raise ValueError(
+            "server.activatorPort declared but the 'kserve' application "
+            "is disabled — the front door could never activate anything")
 
 
 def kfdef_from_dict(manifest: dict) -> KfDef:
@@ -117,6 +126,9 @@ spec:
   server:
     host: 127.0.0.1
     port: 8080
+    # uncomment for the serverless front door (stable per-service URLs,
+    # scale-from-zero request holding; requires the kserve application):
+    # activatorPort: 8081
   # Component families to run (drop entries to slim the deployment;
   # omit the list entirely to run everything):
   applications:
@@ -195,6 +207,11 @@ def apply_kfdef(kfdef: KfDef, base_dir: str | Path = "."):
         server = PlatformServer(
             platform, port=spec.server.port, host=spec.server.host,
         ).start()
+        if spec.server.activator_port is not None:
+            # same bind host as the API server it fronts — a 0.0.0.0
+            # deployment must not hide the front door on loopback
+            platform.start_activator(port=spec.server.activator_port,
+                                     host=spec.server.host)
     except BaseException:
         if server is not None:
             server.stop()

@@ -156,3 +156,38 @@ class TestValidationHardening:
         assert "init error" in capsys.readouterr().err
         assert main(["platform", "-f", str(tmp_path / "nope.yaml")]) == 1
         assert "kfdef error" in capsys.readouterr().err
+
+
+class TestActivator:
+    def test_kfdef_starts_activator(self, tmp_path):
+        manifest = yaml.safe_load(SCAFFOLD)
+        manifest["spec"]["applications"] = ["kserve", "profiles"]
+        manifest["spec"]["profiles"] = []
+        manifest["spec"]["logDir"] = str(tmp_path / "pod-logs")
+        manifest["spec"]["server"] = {"port": 0, "activatorPort": 0}
+        kfdef = kfdef_from_dict(manifest)
+        platform, server = apply_kfdef(kfdef, base_dir=tmp_path)
+        try:
+            assert platform.activator is not None
+            code, _ = _get(f"{server.url}/healthz")
+            assert code == 200
+            # the activator answers (404 for unknown services, not dead)
+            import urllib.error
+            import urllib.request
+
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"{platform.activator.url}/default/ghost/v1/models/g",
+                    timeout=10)
+            assert e.value.code == 404
+        finally:
+            server.stop()
+            platform.stop()
+
+    def test_activator_requires_kserve_app(self):
+        manifest = yaml.safe_load(SCAFFOLD)
+        manifest["spec"]["applications"] = ["training"]
+        manifest["spec"]["profiles"] = []
+        manifest["spec"]["server"] = {"port": 0, "activatorPort": 0}
+        with pytest.raises(ValueError, match="kserve"):
+            kfdef_from_dict(manifest)
