@@ -291,3 +291,42 @@ class TestScaleToZero:
                 f"{platform.activator.url}/default/ghost/v1/models/g",
                 timeout=10)
         assert e.value.code == 404
+
+
+class TestActivatorCanarySplit:
+    def test_weighted_round_robin(self, platform):
+        """The activator honors canaryTrafficPercent deterministically
+        (the istio VirtualService weight analogue) and falls back to
+        canary when the primary has no ready endpoints."""
+        from types import SimpleNamespace as NS
+
+        from kubeflow_tpu.serving.activator import Activator
+
+        act = Activator(platform)
+
+        def isvc(primary, canary, pct):
+            return NS(
+                metadata=NS(namespace="default", name="svc"),
+                spec=NS(canary_traffic_percent=pct),
+                status=NS(
+                    endpoints=[NS(url=u, ready=True) for u in primary],
+                    canary_endpoints=[NS(url=u, ready=True)
+                                      for u in canary],
+                ),
+            )
+
+        o = isvc(["p0", "p1"], ["c0"], 30)
+        picks = [act._pick_endpoint(o) for _ in range(100)]
+        assert picks.count("c0") == 30
+        assert picks.count("p0") + picks.count("p1") == 70
+        # zero percent: canary never serves
+        o2 = isvc(["p0"], ["c0"], 0)
+        act._rr.clear()
+        assert all(act._pick_endpoint(o2) == "p0" for _ in range(20))
+        # no ready primary: canary serves regardless of percent
+        o3 = isvc([], ["c0"], 0)
+        act._rr.clear()
+        assert act._pick_endpoint(o3) == "c0"
+        # nothing ready at all
+        o4 = isvc([], [], 50)
+        assert act._pick_endpoint(o4) is None
