@@ -323,10 +323,15 @@ class TestActivatorCanarySplit:
         o2 = isvc(["p0"], ["c0"], 0)
         act._rr.clear()
         assert all(act._pick_endpoint(o2) == "p0" for _ in range(20))
-        # no ready primary: canary serves regardless of percent
-        o3 = isvc([], ["c0"], 0)
+        # no ready primary + pct>0: the canary takes all traffic
+        o3 = isvc([], ["c0"], 30)
         act._rr.clear()
-        assert act._pick_endpoint(o3) == "c0"
+        assert all(act._pick_endpoint(o3) == "c0" for _ in range(10))
+        # no ready primary + pct=0: a dark-launch canary must NOT serve
+        # (the request falls to the activation wait instead)
+        o3b = isvc([], ["c0"], 0)
+        act._rr.clear()
+        assert act._pick_endpoint(o3b) is None
         # nothing ready at all
         o4 = isvc([], [], 50)
         assert act._pick_endpoint(o4) is None
