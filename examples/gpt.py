@@ -36,6 +36,9 @@ def main(argv: list[str] | None = None) -> float:
                         "0 = MHA)")
     p.add_argument("--position-embedding", default="learned",
                    choices=["learned", "rope"])
+    p.add_argument("--attention-window", type=int, default=0,
+                   help="sliding-window attention (Mistral): 0 = full "
+                        "causal; dense attention only")
     p.add_argument("--checkpoint-dir", default=None)
     args = p.parse_args(argv)
 
@@ -60,6 +63,7 @@ def main(argv: list[str] | None = None) -> float:
         dropout_rate=0.0 if args.attention != "dense" else 0.1,
         num_kv_heads=args.num_kv_heads,
         position_embedding=args.position_embedding,
+        attention_window=args.attention_window,
     )
     if args.model_parallel > 1 and args.num_kv_heads and \
             args.num_kv_heads % args.model_parallel:

@@ -61,6 +61,12 @@ class GPTConfig:
     # pattern extrapolates with sequence position)
     position_embedding: str = "learned"
     rope_theta: float = 10000.0
+    # sliding-window attention (Mistral): each query attends to at most
+    # the previous `attention_window` positions (itself included). 0 =
+    # full causal. Composes with GQA + rope; dense + decode paths only
+    # (ring/ulysses/flash reject a window — their block/ring masking
+    # does not carry it yet)
+    attention_window: int = 0
     mlp_dim: int = 3072
     max_len: int = 1024
     dropout_rate: float = 0.1
@@ -100,6 +106,15 @@ class GPTConfig:
                 raise ValueError(
                     "rope needs an even head_dim "
                     f"(got {self.hidden_size // self.num_heads})")
+        if self.attention_window:
+            if self.attention_window < 1:
+                raise ValueError(
+                    f"attention_window {self.attention_window} must be "
+                    ">= 1 (or 0 for full causal)")
+            if self.attention != "dense":
+                raise ValueError(
+                    "attention_window is wired for the dense + decode "
+                    f"paths only (got attention={self.attention!r})")
         if self.moe_experts and self.moe_top_k > self.moe_experts:
             raise ValueError(
                 f"moe_top_k {self.moe_top_k} > moe_experts "
@@ -124,14 +139,20 @@ from kubeflow_tpu.parallel.rope import apply_rope  # noqa: E402
 
 
 def causal_dense_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
-                           block=None):
-    """Reference causal softmax attention (numerics baseline for tests)."""
+                           block=None, window: int = 0):
+    """Reference causal softmax attention (numerics baseline for tests).
+    window > 0 adds Mistral-style sliding-window masking: query i sees
+    keys in (i - window, i]."""
     depth = q.shape[-1]
     s = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(depth).astype(q.dtype)
     if bias is not None:
         s = s + bias
     lq, lk = q.shape[1], k.shape[1]
     mask = jnp.tril(jnp.ones((lq, lk), bool))
+    if window:
+        rows = jnp.arange(lq)[:, None]
+        cols = jnp.arange(lk)[None, :]
+        mask = mask & (rows - cols < window)
     s = jnp.where(mask[None, None], s.astype(jnp.float32), -1e9)
     probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     if dropout_rng is not None and dropout_rate > 0.0:
@@ -180,6 +201,7 @@ class CausalSelfAttention(nn.Module):
                 y = causal_dense_attention(
                     q, k, v, bias, dropout_rng=rng,
                     dropout_rate=c.dropout_rate if train else 0.0,
+                    window=c.attention_window,
                 )
             else:
                 attn_fn = _resolve_attention(c.attention)
@@ -229,8 +251,12 @@ class CausalSelfAttention(nn.Module):
         s = s / jnp.sqrt(jnp.float32(d))
         # causal + not-yet-written mask in one comparison: a key position is
         # visible iff it <= this query's position (unwritten slots are all
-        # > cur + l - 1 by construction)
+        # > cur + l - 1 by construction). A sliding window additionally
+        # hides keys older than window-1 positions.
         visible = k_pos[None, :] <= q_pos[:, None]       # (L, max_len)
+        if c.attention_window:
+            visible = visible & (
+                q_pos[:, None] - k_pos[None, :] < c.attention_window)
         s = jnp.where(visible[None, None, None], s, -1e9)
         p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
         y = jnp.einsum("bkglm,bmkd->blkgd", p, cv.value)
