@@ -352,6 +352,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     rng: jax.Array | None = None,
+    eos_token_id: int | None = None,
 ) -> jax.Array:
     """Autoregressive generation with the KV cache — fully jittable.
 
@@ -362,6 +363,13 @@ def generate(
     logits when top_k > 0. Static shapes throughout: ONE prefill executable
     + ONE decode-step executable inside a lax.scan, the TPU decode shape.
     The LM's max_len bounds prompt_len + max_new_tokens.
+
+    eos_token_id: per-row early stop under static shapes — once a row
+    emits EOS, every later position in that row is EOS (callers trim at
+    the first occurrence). The decode loop still runs max_new_tokens
+    steps (TPU-idiomatic: no data-dependent trip count), but finished
+    rows feed EOS forward so their cache stays consistent with the
+    clamped output.
     """
     b, prompt_len = prompt_ids.shape
     if max_new_tokens < 1:
@@ -391,19 +399,24 @@ def generate(
     )
     rng, key = jax.random.split(rng)
     tok = sample(logits[:, -1], key)
+    done0 = (jnp.full((b,), False) if eos_token_id is None
+             else tok == eos_token_id)
 
     def step(carry, _):
-        cache, tok, rng = carry
+        cache, tok, rng, done = carry
         logits, cache = model.apply(
             {**variables, **cache}, tok[:, None], decode=True,
             mutable=["cache"],
         )
         rng, key = jax.random.split(rng)
         nxt = sample(logits[:, 0], key)
-        return (cache, nxt, rng), tok
+        if eos_token_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+            done = done | (nxt == eos_token_id)
+        return (cache, nxt, rng, done), tok
 
-    (_, last, _), toks = jax.lax.scan(
-        step, (cache, tok, rng), None, length=max_new_tokens - 1
+    (_, last, _, _), toks = jax.lax.scan(
+        step, (cache, tok, rng, done0), None, length=max_new_tokens - 1
     )
     out = jnp.concatenate([toks, last[None]], axis=0)
     return out.T  # (B, max_new_tokens)

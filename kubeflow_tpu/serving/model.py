@@ -165,8 +165,10 @@ def save_predictor(
     collections). `variables` is {'params': ..., maybe 'batch_stats': ...}.
 
     generate: for causal-LM families, decode parameters (max_new_tokens,
-    temperature, top_k) — the predictor then serves token GENERATION (ids
-    in -> generated ids out, KV-cache decode loop) instead of logits.
+    temperature, top_k, eos_token_id — rows clamp to EOS after emitting
+    it, incompatible with num_beams > 1) — the predictor then serves
+    token GENERATION (ids in -> generated ids out, KV-cache decode loop)
+    instead of logits.
 
     quantize: int8 weight-only artifact (~4x smaller params.msgpack;
     per-output-channel scales, dequantized once at load — serving/quant.py)."""
@@ -251,6 +253,13 @@ def _load_predict_fn(model_dir: Path):
                 "generate config: num_beams > 1 and temperature > 0 are "
                 "mutually exclusive (beam search is deterministic)"
             )
+        eos_raw = gen.get("eos_token_id")
+        eos_id = None if eos_raw is None else int(eos_raw)
+        if num_beams > 1 and eos_id is not None:
+            raise ValueError(
+                "generate config: eos_token_id is not supported with "
+                "num_beams > 1 (beam search scores full-length beams)"
+            )
         if num_beams > 1:
             def predict_fn(x):
                 ids, _ = _beam_search(
@@ -270,12 +279,14 @@ def _load_predict_fn(model_dir: Path):
                     temperature=temperature,
                     top_k=int(gen.get("top_k", 0)),
                     rng=key,
+                    eos_token_id=eos_id,
                 )
         else:
             def predict_fn(x):
                 return _generate(
                     module, variables, x,
                     max_new_tokens=int(gen.get("max_new_tokens", 32)),
+                    eos_token_id=eos_id,
                 )
     else:
         def predict_fn(x):

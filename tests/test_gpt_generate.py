@@ -604,3 +604,74 @@ class TestSlidingWindow:
             GPTConfig.tiny(attention_window=4, attention="ring")
         with pytest.raises(ValueError, match=">= 1"):
             GPTConfig.tiny(attention_window=-2)
+
+
+class TestEosEarlyStop:
+    def test_rows_clamp_after_eos_independently(self, lm):
+        """Once a row emits EOS every later position is EOS (clients trim
+        at the first occurrence); other rows keep generating."""
+        model, variables, prompt = lm
+        plain = np.asarray(generate(model, variables, prompt,
+                                    max_new_tokens=10))
+        # pick each row's SECOND generated token as its eos so the clamp
+        # has something to do in one row without affecting the other
+        eos = int(plain[0, 1])
+        got = np.asarray(generate(model, variables, prompt,
+                                  max_new_tokens=10, eos_token_id=eos))
+        saw_eos = False
+        for b in range(got.shape[0]):
+            row = got[b].tolist()
+            if eos in row:
+                saw_eos = True
+                first = row.index(eos)
+                assert all(t == eos for t in row[first:])
+                # tokens BEFORE eos match the unclamped decode
+                assert row[:first] == plain[b, :first].tolist()
+            else:
+                # a row that never finished must be untouched by the
+                # other row's clamp
+                assert row == plain[b].tolist()
+        assert saw_eos  # the chosen eos must actually exercise the clamp
+
+    def test_no_eos_matches_plain_generate(self, lm):
+        model, variables, prompt = lm
+        a = generate(model, variables, prompt, max_new_tokens=6)
+        b = generate(model, variables, prompt, max_new_tokens=6,
+                     eos_token_id=10**6)  # never emitted
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_serving_config_plumbs_eos(self, tmp_path, lm):
+        from kubeflow_tpu.serving.model import JaxModel, save_predictor
+
+        model, variables, prompt = lm
+        plain = np.asarray(generate(model, variables, prompt,
+                                    max_new_tokens=8))
+        eos = int(plain[0, 1])
+        out_dir = save_predictor(
+            tmp_path / "eos", "gpt-lm", dict(variables),
+            np.asarray(prompt, np.int32),
+            generate={"max_new_tokens": 8, "eos_token_id": eos},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 64},
+        )
+        jm = JaxModel("eos", out_dir)
+        jm.load()
+        got = np.asarray(jm(np.asarray(prompt, np.int32))["predictions"])
+        row = got[0].tolist()
+        first = row.index(eos)
+        assert all(t == eos for t in row[first:])
+
+
+    def test_beam_search_config_rejects_eos(self, tmp_path, lm):
+        from kubeflow_tpu.serving.model import JaxModel, save_predictor
+
+        model, variables, prompt = lm
+        out_dir = save_predictor(
+            tmp_path / "beameos", "gpt-lm", dict(variables),
+            np.asarray(prompt, np.int32),
+            generate={"max_new_tokens": 4, "num_beams": 2,
+                      "eos_token_id": 3},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 64},
+        )
+        jm = JaxModel("be", out_dir)
+        with pytest.raises(ValueError, match="eos_token_id"):
+            jm.load()
