@@ -509,6 +509,9 @@ def cmd_generate(args) -> int:
     jm = JaxModel("cli", args.model_dir)
     jm.load()
     out = np.asarray(jm(ids)["predictions"])[0]
+    eos = gen.get("eos_token_id")
+    if eos is not None and int(eos) in out.tolist():
+        out = out[: out.tolist().index(int(eos))]  # trim the clamp tail
     print(tok.decode(out) if tok is not None else " ".join(map(str, out)))
     return 0
 

@@ -405,11 +405,15 @@ def import_gpt2(checkpoint_path: str, out_dir: str,
                 f"{cfg.vocab_size} — wrong vocab.json for this checkpoint")
     variables = torch_gpt2_to_variables(sd, cfg)
     example = np.zeros((1, prompt_len), np.int32)
+    gen_cfg = {"max_new_tokens": max_new_tokens, "pad_token_id": -1}
+    # GPT-2 has no pad token ('!' is legitimately id 0): -1 disables the
+    # served pad-in-prompt rejection. When the tokenizer is bundled, its
+    # <|endoftext|> becomes the served eos (rows clamp; generate trims).
+    if tok is not None and "<|endoftext|>" in tok.vocab:
+        gen_cfg["eos_token_id"] = int(tok.vocab["<|endoftext|>"])
     out = str(save_predictor(
         out_dir, "gpt-lm", variables, example,
-        # GPT-2 has no pad token ('!' is legitimately id 0): -1 disables
-        # the served pad-in-prompt rejection for ids that never occur
-        generate={"max_new_tokens": max_new_tokens, "pad_token_id": -1},
+        generate=gen_cfg,
         size="small",
         config={
             "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
