@@ -515,9 +515,20 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
 #   "scratch" — pallas, cross-grid-step VMEM accumulators.
 #   "loop"    — pallas, fori_loop per output block, no cross-step scratch
 #               (r3 fix candidate; hardware verdict: still NaN — the bug
-#               is in the shared ds dataflow, bisect staged in
-#               tunnel_watch2.sh / probe_flash_stage1.py).
-# All three are numerically identical in interpret/CPU mode
+#               is in the shared ds dataflow).
+#   "loop2"   — r4 fix candidate from the r3 NaN forensics. The hardware
+#               evidence isolates the dd operand: dv (which never reads
+#               dd) is clean in the SAME dkv kernel invocation whose
+#               dk/dbias NaN, the forward out/lse are finite (out_err
+#               6e-5; dv correct ⇒ p ⇒ lse reads fine), and every ds
+#               term is mathematically finite. dd is the one operand
+#               produced by an XLA reduction and read through a
+#               lane-dim-1 BlockSpec (1, block_q, 1) — the layout public
+#               TPU flash kernels avoid for row statistics. loop2 drops
+#               the dd operand entirely: the kernels take the forward
+#               output tile o (a normal (block_q, d) operand, like dO)
+#               and recompute D = Σ_d dO∘O in-kernel in f32.
+# All variants are numerically identical in interpret/CPU mode
 # (test_ring_attention pins it).
 FLASH_BWD_IMPL = "xla"
 
@@ -671,6 +682,151 @@ def _flash_dkv_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     dbias_ref[0] = db_acc.astype(dbias_ref.dtype)
 
 
+def _flash_dq_loop2_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
+                           lse_ref, dq_ref, *, scale, n_kv, causal,
+                           block_q, block_k):
+    """dq for one q block, D recomputed in-kernel from (dO, O) tiles —
+    no lane-dim-1 dd operand (see FLASH_BWD_IMPL "loop2" note)."""
+    iq = pl.program_id(1)
+    qb = q_ref[0]
+    dob = do_ref[0]
+    lseb = lse_ref[0]
+    ddb = (dob.astype(jnp.float32) * o_ref[0].astype(jnp.float32)).sum(
+        axis=-1, keepdims=True)
+
+    def body(ik, acc):
+        kb = k_ref[0, pl.dslice(ik * block_k, block_k), :]
+        vb = v_ref[0, pl.dslice(ik * block_k, block_k), :]
+        bias_row = bias_ref[0, 0, 0, pl.dslice(ik * block_k, block_k)]
+        p = _flash_bwd_scores(qb, kb, bias_row, lseb, scale, causal, iq, ik,
+                              block_q, block_k)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - ddb)
+        return acc + jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        upper = jnp.minimum(
+            (iq * block_q + block_q - 1) // block_k + 1, n_kv
+        )
+    else:
+        upper = n_kv
+    acc = jax.lax.fori_loop(
+        0, upper, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    )
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_loop2_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
+                            lse_ref, dk_ref, dv_ref, dbias_ref,
+                            *, scale, n_q, causal, block_q, block_k):
+    """dk/dv/dbias for one kv block, D recomputed in-kernel per q tile
+    from (dO, O) — no lane-dim-1 dd operand."""
+    ik = pl.program_id(1)
+    kb = k_ref[0]
+    vb = v_ref[0]
+    bias_row = bias_ref[0, 0, 0, :]
+    d = q_ref.shape[2]
+
+    def body(iq, carry):
+        dk_acc, dv_acc, db_acc = carry
+        qb = q_ref[0, pl.dslice(iq * block_q, block_q), :]
+        dob = do_ref[0, pl.dslice(iq * block_q, block_q), :]
+        ob = o_ref[0, pl.dslice(iq * block_q, block_q), :]
+        lseb = lse_ref[0, pl.dslice(iq * block_q, block_q), :]
+        ddb = (dob.astype(jnp.float32) * ob.astype(jnp.float32)).sum(
+            axis=-1, keepdims=True)
+        p = _flash_bwd_scores(qb, kb, bias_row, lseb, scale, causal, iq, ik,
+                              block_q, block_k)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - ddb)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        db_acc = db_acc + ds.sum(axis=0, keepdims=True)
+        return dk_acc, dv_acc, db_acc
+
+    if causal:
+        lower = (ik * block_k) // block_q
+    else:
+        lower = 0
+    init = (
+        jnp.zeros((block_k, d), jnp.float32),
+        jnp.zeros((block_k, d), jnp.float32),
+        jnp.zeros((1, block_k), jnp.float32),
+    )
+    dk_acc, dv_acc, db_acc = jax.lax.fori_loop(lower, n_q, body, init)
+    dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+    dbias_ref[0] = db_acc.astype(dbias_ref.dtype)
+
+
+def _flash_backward_loop2(qf, kf, vf, bias, gf, of, lse, *, b, h, lq, lk, d,
+                          scale, block_q, block_k, n_q, n_kv, causal,
+                          interpret, out_dtypes):
+    """loop2 backward: grid over output blocks, D in-kernel from (dO, O)."""
+    dq_dtype, dk_dtype, dv_dtype = out_dtypes
+    dqf = pl.pallas_call(
+        functools.partial(_flash_dq_loop2_kernel, scale=scale, n_kv=n_kv,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=(b * h, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, lk, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, lk, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, 1, lk), lambda bh, iq, h=h: (bh // h, 0, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, iq: (bh, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), dq_dtype),
+        interpret=interpret,
+    )(qf, kf, vf, bias, gf, of, lse)
+
+    dkf, dvf, dbias_bh = pl.pallas_call(
+        functools.partial(_flash_dkv_loop2_kernel, scale=scale, n_q=n_q,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid=(b * h, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, lq, d), lambda bh, ik: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik: (bh, ik, 0)),
+            pl.BlockSpec(
+                (1, 1, 1, block_k), lambda bh, ik, h=h: (bh // h, 0, 0, ik)
+            ),
+            pl.BlockSpec((1, lq, d), lambda bh, ik: (bh, 0, 0)),
+            pl.BlockSpec((1, lq, d), lambda bh, ik: (bh, 0, 0)),
+            pl.BlockSpec((1, lq, 1), lambda bh, ik: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, ik: (bh, 0, ik)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lk, d), dk_dtype),
+            jax.ShapeDtypeStruct((b * h, lk, d), dv_dtype),
+            jax.ShapeDtypeStruct((b * h, 1, lk), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, bias, gf, of, lse)
+    return dqf, dkf, dvf, dbias_bh
+
+
 def _flash_backward_loop(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
                          scale, block_q, block_k, n_q, n_kv, causal,
                          interpret, out_dtypes):
@@ -736,23 +892,28 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
     fold = lambda t, L: t.transpose(0, 2, 1, 3).reshape(b * h, L, d)  # noqa: E731
     qf, kf, vf = fold(q, lq), fold(k, lk), fold(v, lk)
     of, gf = fold(o, lq), fold(g, lq)
-    # D_i = Σ_d dO_i · O_i  (FlashAttention-2 eq. for the softmax jacobian)
-    dd = (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1, keepdims=True)
     n_q, n_kv = lq // block_q, lk // block_k
     interpret = jax.default_backend() == "cpu"
 
+    def _dd():
+        # D_i = Σ_d dO_i · O_i (FlashAttention-2 softmax-jacobian term) —
+        # only the xla/loop/scratch backwards consume this XLA-produced
+        # reduction; loop2 recomputes D in-kernel (its raison d'être)
+        return (gf.astype(jnp.float32) * of.astype(jnp.float32)).sum(
+            -1, keepdims=True)
+
     if (impl or FLASH_BWD_IMPL) == "xla":
         dqf, dkf, dvf, dbias = _flash_backward_xla(
-            qf, kf, vf, bias, gf, lse, dd, b=b, h=h, lq=lq, lk=lk, d=d,
+            qf, kf, vf, bias, gf, lse, _dd(), b=b, h=h, lq=lq, lk=lk, d=d,
             scale=scale, block_k=block_k, causal=causal,
             out_dtypes=(q.dtype, k.dtype, v.dtype), bias_dtype=bias.dtype,
         )
         unfold = lambda t, L: t.reshape(b, h, L, d).transpose(0, 2, 1, 3)  # noqa: E731
         return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
 
-    if (impl or FLASH_BWD_IMPL) == "loop":
-        dqf, dkf, dvf, dbias_bh = _flash_backward_loop(
-            qf, kf, vf, bias, gf, lse, dd, b=b, h=h, lq=lq, lk=lk, d=d,
+    if (impl or FLASH_BWD_IMPL) == "loop2":
+        dqf, dkf, dvf, dbias_bh = _flash_backward_loop2(
+            qf, kf, vf, bias, gf, of, lse, b=b, h=h, lq=lq, lk=lk, d=d,
             scale=scale, block_q=block_q, block_k=block_k, n_q=n_q,
             n_kv=n_kv, causal=causal, interpret=interpret,
             out_dtypes=(q.dtype, k.dtype, v.dtype),
@@ -762,6 +923,19 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
         dbias = dbias[:, None, :, :].astype(bias.dtype)  # (B, 1, 1, Lk)
         return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
 
+    if (impl or FLASH_BWD_IMPL) == "loop":
+        dqf, dkf, dvf, dbias_bh = _flash_backward_loop(
+            qf, kf, vf, bias, gf, lse, _dd(), b=b, h=h, lq=lq, lk=lk, d=d,
+            scale=scale, block_q=block_q, block_k=block_k, n_q=n_q,
+            n_kv=n_kv, causal=causal, interpret=interpret,
+            out_dtypes=(q.dtype, k.dtype, v.dtype),
+        )
+        unfold = lambda t, L: t.reshape(b, h, L, d).transpose(0, 2, 1, 3)  # noqa: E731
+        dbias = dbias_bh.reshape(b, h, 1, lk).sum(axis=1, keepdims=False)
+        dbias = dbias[:, None, :, :].astype(bias.dtype)  # (B, 1, 1, Lk)
+        return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
+
+    dd = _dd()
     qspec = pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0))
     bspec = pl.BlockSpec(

@@ -233,12 +233,14 @@ class TestFlashFusedBackward:
 
 
 class TestFlashBackwardImpls:
-    """All three backward implementations ("scratch": pallas with
+    """All backward implementations ("scratch": pallas with
     cross-grid-step VMEM accumulators; "loop": pallas fori_loop per
-    output block; "xla": residual-consuming einsums, the Mosaic-safe
-    default after BOTH pallas variants NaN'd in the r3 hardware verdict)
-    must agree with each other and the dense reference, causal and
-    full."""
+    output block; "loop2": loop with D recomputed in-kernel from (dO, O)
+    instead of the lane-dim-1 dd operand, the r4 Mosaic-NaN fix
+    candidate; "xla": residual-consuming einsums, the Mosaic-safe
+    default after both r3 pallas variants NaN'd in the r3 hardware
+    verdict) must agree with each other and the dense reference, causal
+    and full."""
 
     def _qkvb(self, lq=32, lk=32):
         import jax as _jax
@@ -263,10 +265,10 @@ class TestFlashBackwardImpls:
         grads = {
             impl: _flash_backward(q, k, v, bias, out, lse, g, 8, 8, causal,
                                   impl=impl)
-            for impl in ("scratch", "loop", "xla")
+            for impl in ("scratch", "loop", "loop2", "xla")
         }
         ref = grads["scratch"]
-        for impl in ("loop", "xla"):
+        for impl in ("loop", "loop2", "xla"):
             for name, x, y in zip(("dq", "dk", "dv", "dbias"),
                                   ref, grads[impl]):
                 np.testing.assert_allclose(

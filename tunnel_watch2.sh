@@ -1,9 +1,18 @@
 #!/bin/bash
-# Round-3 second watcher: capture the flash-backward NaN bisection at the
-# next tunnel window (probe_flash_debug + probe_flash_debug2). Same stage
-# discipline as tunnel_watch.sh.
+# Round-4 tunnel watcher: at the next live TPU window capture, in order,
+#   1. probe_flash_r4.txt   — consolidated flash-backward verdict (loop2
+#                             fix, term bisect host/dev-fed, xla numerics,
+#                             timing) — short and decisive, runs first;
+#   2. bench_r4_suite.jsonl — full fixed-protocol bench suite (fresh
+#                             baseline capture for everything shipped
+#                             after the r3-fixed window);
+#   3. probe_resnet.txt     — conv-ceiling / ResNet MFU probe (VERDICT #5),
+#                             skipped until probe_resnet.py exists;
+#   4. probe_flash_xlabwd.txt — xla-backward timing/numerics detail.
+# Same stage discipline as r3: .done marks success; partial output is
+# appended on failure and the stage retries at the next window.
 cd /root/repo
-MAX_HOURS=${MAX_HOURS:-10}
+MAX_HOURS=${MAX_HOURS:-12}
 max_iters=$(( MAX_HOURS * 20 ))
 iters=0
 
@@ -24,11 +33,9 @@ stage() {  # stage <artifact> <timeout_s> <cmd...>
 }
 
 while :; do
-  if [ -f probe_flash_stage1.txt.done ] && [ -f probe_flash_fix.txt.done ] \
-     && [ -f probe_flash_xlabwd.txt.done ] \
-     && [ -f bench_r3_suite2.jsonl.done ] \
-     && [ -f probe_flash_debug2.txt.done ] \
-     && [ -f probe_flash_debug.txt.done ]; then
+  if [ -f probe_flash_r4.txt.done ] && [ -f bench_r4_suite.jsonl.done ] \
+     && { [ ! -f probe_resnet.py ] || [ -f probe_resnet.txt.done ]; } \
+     && [ -f probe_flash_xlabwd.txt.done ]; then
     echo "all stages captured at $(date -u +%H:%M:%S)" >> tunnel_watch2.log
     exit 0
   fi
@@ -42,13 +49,12 @@ import jax, jax.numpy as jnp
 float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
 " >/dev/null 2>&1; then
     echo "=== tunnel alive at $(date -u +%H:%M:%S) ===" >> tunnel_watch2.log
-    { stage probe_flash_stage1.txt 600 python -u probe_flash_stage1.py \
-        && stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py \
-        && stage bench_r3_suite2.jsonl 2400 \
+    { stage probe_flash_r4.txt 1500 python -u probe_flash_r4.py \
+        && stage bench_r4_suite.jsonl 2400 \
              env KFT_BENCH_DEADLINE_S=2300 python bench.py --suite \
-        && stage probe_flash_debug2.txt 900 python -u probe_flash_debug2.py \
-        && stage probe_flash_fix.txt 1200 python -u probe_flash_fix.py \
-        && stage probe_flash_debug.txt 900 python -u probe_flash_debug.py; } \
+        && { [ ! -f probe_resnet.py ] \
+             || stage probe_resnet.txt 1200 python -u probe_resnet.py; } \
+        && stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py; } \
       || sleep 180
   else
     sleep 180
