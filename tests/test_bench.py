@@ -39,6 +39,31 @@ def test_error_record_shape():
     assert rec["attempts"] >= 1
 
 
+def test_error_record_embeds_last_good_capture():
+    """VERDICT r3 weak #1: while a fixed-protocol capture exists on disk, a
+    timeout/error record must carry the last-known-good measurement so the
+    driver's BENCH artifact never reads as a bare 0.0."""
+    import bench
+
+    assert bench._CAPTURES is not None, "capture file missing from repo"
+    captured, protocol = bench._CAPTURES
+    metric = "bert_base_steps_per_sec"
+    assert metric in captured
+    rec = bench._error_record(metric, "steps/sec", TimeoutError("tunnel"))
+    lg = rec["last_good"]
+    assert lg["value"] == captured[metric]["value"] and lg["value"] > 0
+    assert lg["protocol"] == protocol
+    assert lg["capture_source"].startswith("bench_r")
+    assert lg["captured_at"].endswith("Z")
+    assert lg["mfu"] is not None
+    # a metric with no capture yet gets no fabricated payload
+    rec2 = bench._error_record("never_captured_metric", "u", TimeoutError("t"))
+    assert "last_good" not in rec2
+    # and the adopted baseline follows the same capture
+    assert bench.BENCH_BASELINE[metric] == captured[metric]["value"]
+    assert bench.BASELINE_PROTOCOL == protocol
+
+
 def test_backend_error_classifier():
     import bench
 
