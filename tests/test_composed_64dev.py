@@ -1,0 +1,115 @@
+"""Six-axis composed-mesh proof (VERDICT r3 missing #4): ALL of
+data/fsdp/model/context/expert/pipeline >= 2 in ONE train step, on a
+64-device virtual mesh — GPT decoder pipeline with MoE + causal ring
+attention + rope + GQA inside the stages, warning-free. Plus the
+production-shape compile-only checks (VERDICT r3 weak #5): the full train
+step lowered AND XLA-compiled at real model dims (GPT-2s 768/12L/1k-seq,
+BERT-base 768/12L/512-seq) over composed meshes via abstract sharded args.
+
+Runs in subprocesses because the device counts differ from the suite's
+8-device conftest and XLA_FLAGS must be set before backend init.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+
+SIXAXIS_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from kubeflow_tpu.models import (GPTConfig, GPTPipelineLM, causal_lm_loss,
+                                 causal_lm_eval_metrics)
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.train import Trainer, TrainerConfig
+from kubeflow_tpu.train.data import synthetic_lm_dataset
+
+cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=64, attention="ring",
+                     attention_block=8, position_embedding="rope",
+                     num_kv_heads=2, moe_experts=4)
+mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, context=2,
+                             expert=2, pipeline=2))
+assert all(v >= 2 for v in mesh.shape.values()), dict(mesh.shape)
+ds = synthetic_lm_dataset(n_train=32, n_test=16, seq_len=32,
+                          vocab_size=cfg.vocab_size)
+tr = Trainer(GPTPipelineLM(cfg, num_stages=2, n_micro=2),
+             TrainerConfig(batch_size=16, steps=1, log_every_steps=10**9),
+             loss_fn=causal_lm_loss, eval_metrics_fn=causal_lm_eval_metrics,
+             mesh=mesh)
+state = tr.init_state(ds.x_train[:16])
+state, m = tr.train_step(state, (ds.x_train[:16], ds.y_train[:16]))
+loss = float(m["loss"])
+assert 0.0 < loss < 50.0, loss
+print(f"SIXAXIS_OK loss={loss:.4f} mesh={dict(mesh.shape)}")
+"""
+
+PRODSHAPE_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from kubeflow_tpu.models import (BertConfig, GPTConfig, GPTPipelineLM,
+                                 causal_lm_loss, causal_lm_eval_metrics)
+from kubeflow_tpu.models.bert_pp import BertPipelineClassifier
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.train import Trainer, TrainerConfig
+
+# GPT-2-small real dims on the decoder composed mesh
+gmesh = build_mesh(MeshConfig(data=2, fsdp=2, context=2, pipeline=2))
+gcfg = GPTConfig.small(dropout_rate=0.0, attention="ring",
+                       attention_block=256, position_embedding="rope",
+                       num_kv_heads=4)
+assert gcfg.hidden_size == 768 and gcfg.num_layers == 12
+tr = Trainer(GPTPipelineLM(gcfg, num_stages=2, n_micro=2),
+             TrainerConfig(batch_size=16, steps=1, log_every_steps=10**9),
+             loss_fn=causal_lm_loss, eval_metrics_fn=causal_lm_eval_metrics,
+             mesh=gmesh)
+x = np.zeros((16, 1024), np.int32)
+tr.compile_check(x, x)
+print("PRODSHAPE_GPT_OK")
+
+# BERT-base real dims on the encoder composed mesh (model axis in play:
+# 12 heads over model:2, 768 hidden over fsdp:2, seq 512 over context:2)
+bmesh = build_mesh(MeshConfig(fsdp=2, model=2, context=2, pipeline=2))
+bcfg = BertConfig.base(dropout_rate=0.0, attention="ring",
+                       attention_block=128)
+assert bcfg.hidden_size == 768 and bcfg.num_layers == 12
+tr = Trainer(BertPipelineClassifier(bcfg, num_stages=2, n_micro=2),
+             TrainerConfig(batch_size=8, steps=1, log_every_steps=10**9),
+             mesh=bmesh)
+xb = np.zeros((8, 512), np.int32)
+tr.compile_check(xb, np.zeros((8,), np.int32))
+print("PRODSHAPE_BERT_OK")
+"""
+
+
+def _run(script: str, timeout: int = 900):
+    return subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=timeout,
+        cwd=str(REPO),
+        env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin",
+             "HOME": "/root", "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_six_axis_train_step_64dev():
+    proc = _run(SIXAXIS_SCRIPT)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "SIXAXIS_OK" in proc.stdout
+    # composition must stay warning-free: an involuntary full-remat
+    # reshard at a shard_map boundary is a silent performance cliff
+    assert "Involuntary full rematerialization" not in proc.stderr, (
+        proc.stderr[-3000:]
+    )
+
+
+def test_production_shape_compile_checks_16dev():
+    proc = _run(PRODSHAPE_SCRIPT)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "PRODSHAPE_GPT_OK" in proc.stdout
+    assert "PRODSHAPE_BERT_OK" in proc.stdout
