@@ -390,6 +390,56 @@ def bench_gpt2s_gqa_decode(**kw) -> dict:
         metric="gpt2s_gqa_decode_tokens_per_sec_per_chip", **kw)
 
 
+def bench_gpt2s_continuous_serve(rows: int = 8, n_requests: int = 24,
+                                 prompt_len: int = 128,
+                                 new_tokens: int = 64) -> dict:
+    """Continuous-batching serving throughput: n_requests concurrent
+    GPT-2s decodes interleaved on a fixed `rows`-row engine (iteration-
+    level scheduling, serving/continuous.py). The number of record is
+    aggregate generated tokens/sec/chip — the comparison against
+    gpt2s_decode (one blocking batch) is the serving win: admissions
+    refill retiring rows, so the decode executable never runs below
+    capacity while requests queue."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    cfg = GPTConfig.small(dtype=jnp.bfloat16, dropout_rate=0.0,
+                          max_len=prompt_len + new_tokens)
+    model = GPTLM(cfg)
+    prompt_host = jax.random.randint(
+        jax.random.PRNGKey(1), (n_requests, prompt_len), 1, cfg.vocab_size,
+        jnp.int32)
+    prompts = np.asarray(prompt_host)
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.asarray(prompts[:1]))
+    eng = ContinuousBatcher(model, variables, max_rows=rows,
+                            default_max_new_tokens=new_tokens)
+    # warmup: compile prefill + decode-step + splice once
+    eng.submit(prompts[0], max_new_tokens=2)
+    eng.run_until_idle()
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
+    eng.run_until_idle()
+    toks = sum(len(r.result(timeout=0) if r.done.is_set() else ())
+               for r in reqs)
+    dt = time.perf_counter() - t0
+    assert toks == n_requests * new_tokens, toks
+    n_params = sum(
+        x.size for x in jax.tree_util.tree_leaves(variables["params"]))
+    r = {
+        "metric": "gpt2s_continuous_serve_tokens_per_sec_per_chip",
+        "value": round(toks / dt, 1),
+        "unit": "tokens/sec/chip",
+        "rows": rows, "n_requests": n_requests,
+        "decode_dispatches": eng.step_count,
+    }
+    return _finish(r, dt, eng.step_count, 2 * n_params * rows)
+
+
 def bench_mnist_mlp(steps: int = 60, batch_size: int = 512) -> dict:
     from kubeflow_tpu.models import MnistMLP
     from kubeflow_tpu.train import Trainer, TrainerConfig
@@ -591,6 +641,8 @@ SUITE_BENCHES = [
     (bench_gpt2s_decode, "gpt2s_decode_tokens_per_sec_per_chip", "tokens/sec/chip"),
     (bench_gpt2s_gqa_decode, "gpt2s_gqa_decode_tokens_per_sec_per_chip",
      "tokens/sec/chip"),
+    (bench_gpt2s_continuous_serve,
+     "gpt2s_continuous_serve_tokens_per_sec_per_chip", "tokens/sec/chip"),
 ]
 
 

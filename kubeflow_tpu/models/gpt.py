@@ -232,18 +232,32 @@ class CausalSelfAttention(nn.Module):
         cv = self.variable(
             "cache", "cached_value",
             lambda: jnp.zeros((b, c.max_len, kvh, d), c.dtype))
+        # PER-ROW index (B,): in-flight rows may sit at different depths
+        # (continuous batching, serving/continuous.py); uniform decode
+        # (generate/speculative) is the all-rows-equal special case
         idx = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
-        cur = idx.value
-        q_pos = cur + jnp.arange(l)                      # (L,)
+            "cache", "cache_index", lambda: jnp.zeros((b,), jnp.int32))
+        cur = idx.value                                  # (B,)
+        q_pos = cur[:, None] + jnp.arange(l)[None, :]    # (B, L)
         if c.position_embedding == "rope":
             # rotate by ABSOLUTE position before the cache write: cached
             # keys carry their rotation, so one decode step only rotates
             # the new (q, k) pair
             q = apply_rope(q, q_pos, c.rope_theta)
             k = apply_rope(k, q_pos, c.rope_theta)
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+        if l == 1:
+            # decode step: batched scatter — each row writes at ITS index
+            rows = jnp.arange(b)
+            ck.value = ck.value.at[rows, cur].set(k[:, 0])
+            cv.value = cv.value.at[rows, cur].set(v[:, 0])
+        else:
+            # prefill (L > 1): all rows start together (generate and the
+            # continuous engine both prefill from index 0 per call), so a
+            # single dynamic_update_slice does the write
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, cur[0], 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, cur[0], 0, 0))
         idx.value = cur + l
         k_pos = jnp.arange(c.max_len)                    # (max_len,)
         qg = q.reshape(b, l, kvh, h // kvh, d)
@@ -251,13 +265,13 @@ class CausalSelfAttention(nn.Module):
         s = s / jnp.sqrt(jnp.float32(d))
         # causal + not-yet-written mask in one comparison: a key position is
         # visible iff it <= this query's position (unwritten slots are all
-        # > cur + l - 1 by construction). A sliding window additionally
-        # hides keys older than window-1 positions.
-        visible = k_pos[None, :] <= q_pos[:, None]       # (L, max_len)
+        # > that row's cur + l - 1 by construction). A sliding window
+        # additionally hides keys older than window-1 positions.
+        visible = k_pos[None, None, :] <= q_pos[:, :, None]  # (B, L, max_len)
         if c.attention_window:
             visible = visible & (
-                q_pos[:, None] - k_pos[None, :] < c.attention_window)
-        s = jnp.where(visible[None, None, None], s, -1e9)
+                q_pos[:, :, None] - k_pos[None, None, :] < c.attention_window)
+        s = jnp.where(visible[:, None, None], s, -1e9)
         p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
         y = jnp.einsum("bkglm,bmkd->blkgd", p, cv.value)
         return y.reshape(b, l, h, d)
@@ -310,12 +324,14 @@ class GPTLM(nn.Module):
         )
         x = token_embed(input_ids)
         if decode:
-            # autoregressive mode: positions continue from the running
-            # offset; attention masking is positional via the KV cache
+            # autoregressive mode: positions continue from the PER-ROW
+            # running offset (rows at different depths under continuous
+            # batching); attention masking is positional via the KV cache
             # (generation prompts are unpadded — see generate())
+            b = input_ids.shape[0]
             pidx = self.variable(
-                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32))
-            pos = pidx.value + jnp.arange(input_ids.shape[1])[None, :]
+                "cache", "pos_index", lambda: jnp.zeros((b,), jnp.int32))
+            pos = pidx.value[:, None] + jnp.arange(input_ids.shape[1])[None, :]
             pidx.value = pidx.value + input_ids.shape[1]
             bias = None
         else:

@@ -35,7 +35,9 @@ def _set_cache_index(cache: dict, value) -> dict:
     def fix(path, leaf):
         name = getattr(path[-1], "key", path[-1]) if path else ""
         if name in ("cache_index", "pos_index"):
-            return jnp.asarray(value, leaf.dtype)
+            # indices are per-row (B,) vectors; speculative is batch-1 so
+            # one value fills every row
+            return jnp.full_like(leaf, value)
         return leaf
 
     return jax.tree_util.tree_map_with_path(fix, cache)
@@ -49,6 +51,7 @@ def speculative_generate(
     prompt_ids: jax.Array,
     max_new_tokens: int,
     gamma: int = 4,
+    eos_token_id: int | None = None,
 ):
     """Greedy speculative decoding. Returns (tokens (1, max_new_tokens),
     stats dict with 'rounds' and 'drafted_accepted').
@@ -57,6 +60,12 @@ def speculative_generate(
     needs per-row cache indices). The draft must share the target's
     vocabulary; nothing else — architectures, sizes, and even weights may
     differ arbitrarily.
+
+    eos_token_id mirrors generate()'s contract: once EOS lands in the
+    emitted prefix the loop stops (no more speculation rounds for a
+    sequence the target has finished) and every position after the first
+    EOS is clamped to EOS — callers trim at the first occurrence, and
+    the output past EOS matches generate(..., eos_token_id=...) exactly.
     """
     b, prompt_len = prompt_ids.shape
     if b != 1:
@@ -136,8 +145,12 @@ def speculative_generate(
         return (buf, n, t_cache, d_cache, rounds + 1, accepted_total + a)
 
     def cond(state):
-        _, n, *_rest = state
-        return n < max_new_tokens
+        buf, n, *_rest = state
+        more = n < max_new_tokens
+        if eos_token_id is not None:
+            emitted = jnp.arange(buf.shape[0]) < n
+            more = more & ~jnp.any(emitted & (buf == eos_token_id))
+        return more
 
     state0 = (buf0, jnp.asarray(1, jnp.int32),
               {"cache": _set_cache_index(t_cache["cache"],
@@ -146,7 +159,15 @@ def speculative_generate(
               jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
     buf, n, _, _, rounds, accepted = jax.lax.while_loop(
         cond, round_body, state0)
-    return buf[None, :max_new_tokens], {
+    out = buf[:max_new_tokens]
+    if eos_token_id is not None:
+        # clamp past the first EOS (rounds overshoot by up to gamma tokens)
+        pos = jnp.arange(max_new_tokens)
+        hit = out == eos_token_id
+        first = jnp.argmax(hit)  # 0 when no hit; guarded by jnp.any below
+        out = jnp.where(jnp.any(hit) & (pos > first),
+                        jnp.int32(eos_token_id), out)
+    return out[None, :], {
         "rounds": rounds, "drafted_accepted": accepted,
         "tokens": jnp.minimum(n, max_new_tokens),
     }

@@ -9,12 +9,16 @@ import jax.numpy as jnp
 
 def apply_rope(x, pos, theta: float = 10000.0):
     """Rotary position embedding (half-split convention): rotate each
-    head-dim pair by pos * theta^(-2i/d). x: (B, L, H, D), pos: (L,)."""
+    head-dim pair by pos * theta^(-2i/d). x: (B, L, H, D); pos: (L,)
+    shared across the batch, or (B, L) per-row (continuous-batching
+    decode, where in-flight rows sit at different depths)."""
     d = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # (L, D/2)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = pos.astype(jnp.float32)[..., None] * freqs  # (..., L, D/2)
+    if ang.ndim == 2:                                 # shared (L, D/2)
+        ang = ang[None]
+    cos = jnp.cos(ang)[:, :, None, :]                 # (B|1, L, 1, D/2)
+    sin = jnp.sin(ang)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)

@@ -1,0 +1,224 @@
+"""Continuous batching for generative serving — iteration-level scheduling.
+
+SURVEY §2.5 (KServe model server): the reference's serving runtimes process
+one request batch at a time, so concurrent generative requests serialize
+whole decodes behind each other. TPU redesign of that surface: decode
+throughput is HBM-bandwidth-bound — every decode step streams the full
+weight set regardless of how many rows ride it — so a half-empty batch
+wastes exactly the bandwidth the chip is bound by. The engine (Orca-style
+iteration-level scheduling; row slots instead of vLLM paging) keeps ONE
+static-shape decode executable hot and splices sequences in and out
+BETWEEN steps:
+
+  - admission: a queued prompt prefills into a free row (per-prompt-length
+    prefill executable, batch-1), and a jitted row-splice writes that
+    row's cache slice + per-row index into the live batch cache
+    (models/gpt.py keeps cache_index/pos_index per-row (B,) for exactly
+    this)
+  - every tick advances ALL in-flight rows one token in one dispatch —
+    rows at different depths, one executable
+  - rows retire on EOS or their token budget; the slot readmits the next
+    queued request without stalling the other rows
+
+Greedy-only (like speculative decoding): each row's output is EXACTLY
+generate()'s greedy decode for that prompt alone — per-row position
+masking keeps rows independent. (MoE models break that independence:
+capacity-limited dispatch couples rows; the engine refuses them.)
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class _InFlight:
+    slot: int
+    max_new_tokens: int
+    eos_token_id: int | None
+    tokens: list = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        return np.asarray(self.tokens, np.int32)
+
+
+class ContinuousBatcher:
+    """Fixed-row continuous-batching decode engine over a GPTLM.
+
+    submit() enqueues a prompt and returns a handle whose .result() blocks
+    for the generated ids; tick() runs one scheduling round (admit + one
+    decode step); run_until_idle() drains everything (the synchronous mode
+    tests and the bench use); start()/stop() run ticks on a daemon thread
+    (the serving mode).
+    """
+
+    def __init__(self, module, variables, max_rows: int = 8,
+                 default_max_new_tokens: int = 32,
+                 eos_token_id: int | None = None):
+        cfg = module.cfg
+        if getattr(cfg, "moe_experts", 0):
+            raise ValueError(
+                "continuous batching requires row-independent decode; MoE "
+                "capacity dispatch couples rows (drop pattern depends on "
+                "batch composition)")
+        self.module = module
+        self.variables = variables
+        self.max_rows = int(max_rows)
+        self.max_len = int(cfg.max_len)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self._lock = threading.Lock()
+        self._queue: list[tuple[np.ndarray, _InFlight]] = []
+        self._rows: list[_InFlight | None] = [None] * self.max_rows
+        self._toks = np.zeros((self.max_rows,), np.int32)
+        self._prefill_cache: dict[int, object] = {}  # prompt_len -> jitted
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.step_count = 0  # decode dispatches (the scheduling metric)
+
+        # live batch cache: created by one R-row dummy decode step
+        _, cache = module.apply(
+            variables, jnp.zeros((self.max_rows, 1), jnp.int32),
+            decode=True, mutable=["cache"])
+        self._cache = cache["cache"]
+
+        def _splice(big, row, i):
+            """Write batch-1 row-cache `row` into slot i of the live
+            cache — every leaf's leading dim is the row dim."""
+            def leaf(b, r):
+                return jax.lax.dynamic_update_slice(
+                    b, r.astype(b.dtype), (i,) + (0,) * (b.ndim - 1))
+            return jax.tree.map(leaf, big, row)
+
+        self._splice = jax.jit(_splice)
+
+        def _step(cache_col, toks, active):
+            logits, new_cache = module.apply(
+                {**variables, "cache": cache_col},
+                toks[:, None], decode=True, mutable=["cache"])
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            # free rows keep decoding garbage (their slot is overwritten
+            # wholesale on admission) — but their index must not creep past
+            # max_len, so park it at 0
+            def park(path, leaf):
+                name = getattr(path[-1], "key", "")
+                if name in ("cache_index", "pos_index"):
+                    return jnp.where(active, leaf, 0)
+                return leaf
+            new_cache = jax.tree_util.tree_map_with_path(
+                park, new_cache["cache"])
+            return nxt, new_cache
+
+        self._step = jax.jit(_step)
+
+    # ---------------------------------------------------------------- API
+
+    def submit(self, prompt_ids, max_new_tokens: int | None = None,
+               eos_token_id: int | None = None) -> _InFlight:
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        budget = int(max_new_tokens or self.default_max_new_tokens)
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        if ids.size + budget > self.max_len:
+            raise ValueError(
+                f"prompt {ids.size} + max_new_tokens {budget} exceeds "
+                f"max_len {self.max_len}")
+        req = _InFlight(slot=-1, max_new_tokens=budget,
+                        eos_token_id=(self.eos_token_id if eos_token_id
+                                      is None else eos_token_id))
+        with self._lock:
+            self._queue.append((ids, req))
+        return req
+
+    def _prefill(self, ids: np.ndarray):
+        fn = self._prefill_cache.get(ids.size)
+        if fn is None:
+            def prefill(x):
+                logits, cache = self.module.apply(
+                    self.variables, x, decode=True, mutable=["cache"])
+                first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return first, cache["cache"]
+            fn = self._prefill_cache[ids.size] = jax.jit(prefill)
+        return fn(ids[None, :])
+
+    def _retire(self, slot: int) -> None:
+        req = self._rows[slot]
+        self._rows[slot] = None
+        req.done.set()
+
+    def tick(self) -> bool:
+        """One scheduling round: admit queued prompts into free rows, then
+        advance every in-flight row one token. Returns True if any work
+        remains."""
+        with self._lock:
+            # ---- admission: prefill into free rows -----------------------
+            for slot in range(self.max_rows):
+                if self._rows[slot] is not None or not self._queue:
+                    continue
+                ids, req = self._queue.pop(0)
+                first, row_cache = self._prefill(ids)
+                self._cache = self._splice(
+                    self._cache, row_cache, jnp.int32(slot))
+                req.slot = slot
+                req.tokens.append(int(first[0]))
+                self._rows[slot] = req
+                self._toks[slot] = int(first[0])
+                # the prefill's first token may already finish the row
+                if self._finished(req):
+                    self._retire(slot)
+            active = np.array([r is not None for r in self._rows])
+            if not active.any():
+                return bool(self._queue)
+            # ---- one decode step for every in-flight row -----------------
+            nxt, self._cache = self._step(
+                self._cache, jnp.asarray(self._toks),
+                jnp.asarray(active))
+            self.step_count += 1
+            nxt = np.asarray(nxt)
+            for slot, req in enumerate(self._rows):
+                if req is None:
+                    continue
+                req.tokens.append(int(nxt[slot]))
+                self._toks[slot] = int(nxt[slot])
+                if self._finished(req):
+                    self._retire(slot)
+            return bool(self._queue) or any(
+                r is not None for r in self._rows)
+
+    @staticmethod
+    def _finished(req: _InFlight) -> bool:
+        if len(req.tokens) >= req.max_new_tokens:
+            return True
+        return (req.eos_token_id is not None
+                and req.tokens[-1] == req.eos_token_id)
+
+    def run_until_idle(self) -> None:
+        while self.tick():
+            pass
+
+    # ------------------------------------------------------- serving mode
+
+    def start(self) -> "ContinuousBatcher":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.tick():
+                self._stop.wait(0.002)  # idle: poll the queue cheaply
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

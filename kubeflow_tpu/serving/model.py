@@ -310,6 +310,7 @@ class JaxModel(Model):
         self.model_dir = Path(model_dir)
         self._predict_fn = None
         self._aot_batch: int | None = None
+        self._engine = None  # continuous-batching decode engine
         self.config: dict = {}
 
     def load(self) -> None:
@@ -317,6 +318,34 @@ class JaxModel(Model):
         import jax.numpy as jnp
 
         from kubeflow_tpu.serving import aot
+
+        cfg_path = self.model_dir / CONFIG_FILE
+        cfg = json.loads(cfg_path.read_text()) if cfg_path.exists() else {}
+        gen = cfg.get("generate") or {}
+        if gen.get("continuous"):
+            # continuous batching (serving/continuous.py): concurrent
+            # requests interleave decode steps on one fixed-row engine
+            # instead of serializing whole decodes. Greedy-only, jit path
+            # (the engine's executables splice rows — not exportable as
+            # one fixed computation).
+            if float(gen.get("temperature", 0.0)) > 0.0 \
+                    or int(gen.get("num_beams", 1)) > 1:
+                raise ValueError(
+                    "generate config: continuous batching is greedy-only "
+                    "(temperature == 0, num_beams == 1)")
+            from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+            module, variables, self.config = load_generative_model(
+                self.model_dir)
+            eos = gen.get("eos_token_id")
+            self._engine = ContinuousBatcher(
+                module, variables,
+                max_rows=int(gen.get("continuous_rows", 8)),
+                default_max_new_tokens=int(gen.get("max_new_tokens", 32)),
+                eos_token_id=None if eos is None else int(eos),
+            ).start()
+            self.ready = True
+            return
 
         if aot.aot_available(self.model_dir):
             self.config = json.loads((self.model_dir / CONFIG_FILE).read_text())
@@ -362,6 +391,20 @@ class JaxModel(Model):
                     f"generation prompts must not contain the pad token id "
                     f"{pad}: send equal-length unpadded prompts"
                 )
+        if getattr(self, "_engine", None) is not None:
+            budget = int(gen.get("max_new_tokens", 32))
+            eos = gen.get("eos_token_id")
+            reqs = [self._engine.submit(row, max_new_tokens=budget)
+                    for row in x]
+            outs = []
+            for r in reqs:
+                ids = r.result(timeout=300.0)
+                if ids.size < budget:  # generate()'s clamp contract: rows
+                    ids = np.concatenate([  # pad past EOS with EOS
+                        ids, np.full((budget - ids.size,), int(eos),
+                                     np.int32)])
+                outs.append(ids)
+            return np.stack(outs)
         if self._sampling:
             import jax
 

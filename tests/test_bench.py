@@ -74,6 +74,24 @@ def test_backend_error_classifier():
     assert not bench._is_backend_init_error(ValueError("shape mismatch"))
 
 
+def test_bench_continuous_serve_smoke(monkeypatch):
+    """Continuous-serving bench runs end-to-end (tiny dims on CPU) and
+    emits the metric contract with the scheduling fields."""
+    import bench
+    from kubeflow_tpu import models
+
+    monkeypatch.setattr(
+        models.GPTConfig, "small",
+        staticmethod(lambda **kw: models.GPTConfig.tiny(**kw)),
+    )
+    r = bench.bench_gpt2s_continuous_serve(
+        rows=2, n_requests=4, prompt_len=8, new_tokens=4)
+    assert r["metric"] == "gpt2s_continuous_serve_tokens_per_sec_per_chip"
+    assert r["value"] > 0
+    assert r["decode_dispatches"] >= 3  # interleaved, not 4x sequential
+    assert r["rows"] == 2 and r["n_requests"] == 4
+
+
 def test_bench_gpt_flash_smoke(monkeypatch):
     """Long-context GPT bench runs end-to-end (tiny dims, interpret-mode
     pallas on CPU) and emits the metric contract."""

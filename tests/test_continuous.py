@@ -1,0 +1,186 @@
+"""Continuous batching engine (serving/continuous.py): per-row exactness
+vs generate(), iteration-level scheduling (slots readmit mid-flight), and
+the threaded serving mode."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.gpt import GPTConfig, GPTLM, generate
+from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96)
+    model = GPTLM(cfg, pad_token_id=-1)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.ones((1, 5), jnp.int32))
+    return model, variables
+
+
+def _prompt(seed, n, vocab=512):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 1, vocab, jnp.int32))
+
+
+class TestExactness:
+    def test_mixed_rows_match_solo_greedy_decode(self, lm):
+        """The defining property: every row of a mixed batch — different
+        prompt lengths, different budgets, rows admitted while others are
+        mid-flight — yields EXACTLY generate()'s solo greedy decode."""
+        model, variables = lm
+        eng = ContinuousBatcher(model, variables, max_rows=3)
+        jobs = []
+        for seed, plen, budget in ((1, 4, 12), (2, 7, 20), (3, 5, 6),
+                                   (4, 9, 16), (5, 3, 24), (6, 6, 9)):
+            p = _prompt(seed, plen)
+            jobs.append((p, budget, eng.submit(p, max_new_tokens=budget)))
+        eng.run_until_idle()
+        for p, budget, req in jobs:
+            want = np.asarray(generate(
+                model, variables, p[None, :], max_new_tokens=budget))[0]
+            np.testing.assert_array_equal(req.result(timeout=1), want)
+
+    def test_eos_retires_row_early(self, lm):
+        model, variables = lm
+        p = _prompt(7, 5)
+        plain = np.asarray(generate(model, variables, p[None, :],
+                                    max_new_tokens=16))[0]
+        eos = int(plain[4])  # provably emitted at step 5
+        eng = ContinuousBatcher(model, variables, max_rows=2,
+                                eos_token_id=eos)
+        req = eng.submit(p, max_new_tokens=16)
+        eng.run_until_idle()
+        out = req.result(timeout=1)
+        assert out[-1] == eos and len(out) == 5  # stopped AT the eos
+        np.testing.assert_array_equal(out, plain[:5])
+
+    def test_moe_model_refused(self):
+        cfg = GPTConfig.tiny(dropout_rate=0.0, moe_experts=2)
+        model = GPTLM(cfg, pad_token_id=-1)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.ones((1, 4), jnp.int32))
+        with pytest.raises(ValueError, match="row-independent"):
+            ContinuousBatcher(model, variables)
+
+    def test_budget_validated(self, lm):
+        model, variables = lm
+        eng = ContinuousBatcher(model, variables, max_rows=2)
+        with pytest.raises(ValueError, match="max_len"):
+            eng.submit(_prompt(1, 80), max_new_tokens=32)
+
+
+class TestScheduling:
+    def test_interleaving_beats_sequential_dispatch_count(self, lm):
+        """N requests through R rows must take far fewer decode dispatches
+        than N solo decodes — the whole point of iteration-level
+        scheduling (each dispatch advances up to R rows at once)."""
+        model, variables = lm
+        budget, n_req, rows = 16, 8, 4
+        eng = ContinuousBatcher(model, variables, max_rows=rows)
+        for seed in range(n_req):
+            eng.submit(_prompt(seed + 10, 5), max_new_tokens=budget)
+        eng.run_until_idle()
+        sequential_steps = n_req * (budget - 1)  # generate(): n-1 steps each
+        assert eng.step_count <= sequential_steps // 2, (
+            eng.step_count, sequential_steps)
+
+    def test_slot_readmission_mid_flight(self, lm):
+        """A short row retires and its slot admits a queued request while
+        the long row is still decoding — pinned by the dispatch count:
+        short(4) + queued(4) overlap the long row's 24 steps entirely, so
+        the total stays ~24, far below the 32 a blocking batch would
+        need."""
+        model, variables = lm
+        eng = ContinuousBatcher(model, variables, max_rows=2)
+        long_req = eng.submit(_prompt(20, 5), max_new_tokens=24)
+        eng.submit(_prompt(21, 5), max_new_tokens=4)
+        eng.submit(_prompt(22, 5), max_new_tokens=4)  # queued: no free row
+        eng.run_until_idle()
+        assert long_req.result(timeout=1).shape == (24,)
+        assert eng.step_count <= 26  # 23 (long) + admission slack
+
+
+class TestServingIntegration:
+    def test_gpt_lm_predictor_with_continuous_engine(self, tmp_path, lm):
+        """generate config {continuous: true} routes the gpt-lm predictor
+        through the engine: concurrent predicts from separate threads
+        share the rows and every output matches the plain jit predictor."""
+        from kubeflow_tpu.serving.model import JaxModel, save_predictor
+
+        model, variables = lm
+        d = save_predictor(
+            tmp_path / "gpt-cb", "gpt-lm", dict(variables),
+            np.zeros((1, 6), np.int32),
+            generate={"max_new_tokens": 8, "continuous": True,
+                      "continuous_rows": 3},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        jm = JaxModel("gpt-cb", d)
+        jm.load()
+        assert jm._engine is not None
+        try:
+            outs = {}
+
+            def client(seed):
+                p = _prompt(seed, 6)[None, :]
+                outs[seed] = (p, np.asarray(jm(p)["predictions"]))
+
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(40, 45)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(outs) == 5
+            for p, got in outs.values():
+                want = np.asarray(generate(model, variables, p,
+                                           max_new_tokens=8))
+                np.testing.assert_array_equal(got, want)
+        finally:
+            jm._engine.stop()
+
+    def test_continuous_rejects_sampling_config(self, tmp_path, lm):
+        from kubeflow_tpu.serving.model import JaxModel, save_predictor
+
+        model, variables = lm
+        d = save_predictor(
+            tmp_path / "gpt-bad", "gpt-lm", dict(variables),
+            np.zeros((1, 6), np.int32),
+            generate={"max_new_tokens": 8, "continuous": True,
+                      "temperature": 0.7},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        with pytest.raises(ValueError, match="greedy-only"):
+            JaxModel("gpt-bad", d).load()
+
+
+class TestServingMode:
+    def test_threaded_engine_serves_concurrent_clients(self, lm):
+        model, variables = lm
+        eng = ContinuousBatcher(model, variables, max_rows=4).start()
+        try:
+            results = {}
+
+            def client(seed):
+                p = _prompt(seed, 6)
+                req = eng.submit(p, max_new_tokens=10)
+                results[seed] = (p, req.result(timeout=60))
+
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(30, 36)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=90)
+            assert len(results) == 6
+            for p, got in results.values():
+                want = np.asarray(generate(
+                    model, variables, p[None, :], max_new_tokens=10))[0]
+                np.testing.assert_array_equal(got, want)
+        finally:
+            eng.stop()
