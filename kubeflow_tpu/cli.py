@@ -486,16 +486,20 @@ def cmd_generate(args) -> int:
             print(f"error: draft vocab {dmod.cfg.vocab_size} != target "
                   f"vocab {tmod.cfg.vocab_size}", file=sys.stderr)
             return 2
+        eos = gen.get("eos_token_id")
         try:
             out_ids, stats = speculative_generate(
                 tmod, tvars, dmod, dvars, ids,
                 max_new_tokens=int(gen.get("max_new_tokens", 32)),
                 gamma=args.gamma,
+                eos_token_id=None if eos is None else int(eos),
             )
         except ValueError as exc:
             print(f"error: {exc}", file=sys.stderr)
             return 2
         out = np.asarray(out_ids)[0]
+        if eos is not None and int(eos) in out.tolist():
+            out = out[: out.tolist().index(int(eos))]  # trim the clamp tail
         rounds = int(stats["rounds"])
         accepted = int(stats["drafted_accepted"])
         print(f"[speculative] rounds={rounds} drafted_accepted={accepted} "

@@ -135,9 +135,9 @@ def _heatmap(labels: list, matrix: list, dark_reverse: bool = False) -> str:
                 f'<rect x="{x + 1}" y="{y + 1}" width="{cell - 2}" '
                 f'height="{cell - 2}" rx="4" fill="{fill}">'
                 f'<title>true {_esc(labels[i])}, predicted '
-                f'{_esc(labels[j])}: {_fmt(v)}</title></rect>'
+                f'{_esc(labels[j])}: {_esc(_fmt(v))}</title></rect>'
                 f'<text x="{x + cell / 2}" y="{y + cell / 2 + 4}" '
-                f'text-anchor="middle" style="fill:{ink}">{_fmt(v)}</text>'
+                f'text-anchor="middle" style="fill:{ink}">{_esc(_fmt(v))}</text>'
             )
     for i, lab in enumerate(labels):
         parts.append(
@@ -154,7 +154,7 @@ def _heatmap(labels: list, matrix: list, dark_reverse: bool = False) -> str:
     head = "".join(f"<th>{_esc(c)}</th>" for c in labels)
     rows = "".join(
         f"<tr><th>{_esc(labels[i])}</th>"
-        + "".join(f"<td>{_fmt(v)}</td>" for v in row) + "</tr>"
+        + "".join(f"<td>{_esc(_fmt(v))}</td>" for v in row) + "</tr>"
         for i, row in enumerate(matrix)
     )
     table = (f'<details><summary>table view</summary><table>'

@@ -96,6 +96,8 @@ class Activator:
         key = f"{ns}/{name}"
         isvc = self.platform.cluster.get("inferenceservices", key)
         if isvc is None:
+            with self._rr_mu:  # deleted service: drop its rr counter so a
+                self._rr.pop(key, None)  # long-lived activator never leaks
             return 404, f'{{"error": "inferenceservice {key} not found"}}' \
                 .encode(), "application/json"
         url = self._pick_endpoint(isvc)

@@ -553,9 +553,11 @@ class EnasSuggester:
                     zip(self.parameters, self.axes))
                 if assignments.get(p.name) in axis
             ]
-            if not matched:
-                # foreign/hand-injected trial: the policy never produced
-                # it — neither gradient NOR baseline may learn from it
+            if len(matched) != len(self.parameters):
+                # foreign/hand-injected trial (any dim off the policy
+                # grid): the policy never produced it — neither gradient
+                # NOR baseline may learn from it, even for the dims that
+                # happen to lie on the grid
                 continue
             reward = self.sign * objective
             adv = reward - (baseline if baseline is not None else reward)

@@ -135,6 +135,18 @@ class TestEnasHardening:
         b = s._replay(legit + legit)
         assert all(np.allclose(x, y) for x, y in zip(a, b))
 
+    def test_partially_on_grid_trial_contributes_nothing(self):
+        """ADVICE r3: a hand-injected trial where only SOME dims lie on
+        the policy grid must not update the matched dims' logits or move
+        the EMA baseline either."""
+        s = EnasSuggester(ARCH, seed=0)
+        legit = [({"op0": "sep3", "op1": "conv3", "width": "64"}, 1.0)]
+        half_foreign = [({"op0": "sep3", "op1": "conv3",
+                          "width": "not-a-width"}, 100.0)]
+        a = s._replay(legit + half_foreign + legit)
+        b = s._replay(legit + legit)
+        assert all(np.allclose(x, y) for x, y in zip(a, b))
+
     def test_temperature_scaled_policy_still_learns(self):
         s = EnasSuggester(ARCH, seed=4, temperature=2.0)
         hist = _drive(s, _fitness, rounds=30, per_round=3)

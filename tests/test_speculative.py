@@ -72,6 +72,27 @@ class TestTargetExactness:
                                       max_new_tokens=12, gamma=4)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    def test_eos_early_stop_matches_generate(self, target_lm):
+        """ADVICE r3: with eos_token_id the output must equal
+        generate(..., eos_token_id=...) — clamped after the first EOS —
+        and the loop must stop speculating once EOS lands (fewer rounds
+        than the no-eos run when EOS appears early)."""
+        model, variables, prompt = target_lm
+        n = 20
+        plain = np.asarray(generate(model, variables, prompt,
+                                    max_new_tokens=n))[0]
+        eos = int(plain[6])  # a token greedy decode provably emits early
+        want = generate(model, variables, prompt, max_new_tokens=n,
+                        eos_token_id=eos)
+        dm, dv = _draft(7)
+        got, stats = speculative_generate(
+            model, variables, dm, dv, prompt, max_new_tokens=n, gamma=3,
+            eos_token_id=eos)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        _, stats_noeos = speculative_generate(
+            model, variables, dm, dv, prompt, max_new_tokens=n, gamma=3)
+        assert int(stats["rounds"]) < int(stats_noeos["rounds"])
+
     def test_jittable(self, target_lm):
         model, variables, prompt = target_lm
         dm, dv = _draft(7)
