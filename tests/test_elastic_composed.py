@@ -1,0 +1,123 @@
+"""Elastic restart under the composed trainer (VERDICT r3 next #8).
+
+The gang-restart POD machinery (fault-injected kill -> controller restart
+-> rerun resumes from the checkpoint dir) is pinned in test_elastic.py for
+DP workers; what this file pins is the NUMERICS of resuming the hardest
+state pytree: pipeline-stacked stage params x expert-sharded MoE kernels
+x adapter-only LoRA optimizer moments, on a {fsdp, expert, pipeline} mesh.
+An interrupted-and-resumed run must be bit-for-bit the uninterrupted run
+— same per-step losses after resume, same final parameters — or a
+preempted composed job silently trains a different model.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import BertConfig
+from kubeflow_tpu.models.bert_pp import BertPipelineClassifier
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.train import Trainer, TrainerConfig
+from kubeflow_tpu.train.data import synthetic_text_dataset
+from kubeflow_tpu.train.lora import LoraModel, lora_tx
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = BertConfig.tiny(dropout_rate=0.0, moe_experts=4,
+                          attention="ring", attention_block=8)
+    ds = synthetic_text_dataset(n_train=64, n_test=8, seq_len=16,
+                                vocab_size=cfg.vocab_size)
+    return cfg, ds
+
+
+def _mk(cfg, ckpt_dir, cpu_devices):
+    mesh = build_mesh(MeshConfig(fsdp=2, expert=2, pipeline=2),
+                      cpu_devices[:8])
+    return Trainer(
+        LoraModel(BertPipelineClassifier(cfg, num_stages=2, n_micro=2),
+                  rank=4),
+        TrainerConfig(batch_size=8, steps=6, log_every_steps=10**9,
+                      checkpoint_dir=str(ckpt_dir)),
+        tx=lora_tx,
+        mesh=mesh,
+    )
+
+
+def _batches(ds, n):
+    return [(ds.x_train[i * 8:(i + 1) * 8], ds.y_train[i * 8:(i + 1) * 8])
+            for i in range(n)]
+
+
+def test_resume_is_bitwise_equivalent_to_uninterrupted(
+        tmp_path, setup, cpu_devices):
+    cfg, ds = setup
+    batches = _batches(ds, 6)
+
+    # ---- run A: 6 uninterrupted steps --------------------------------
+    ta = _mk(cfg, tmp_path / "a", cpu_devices)
+    state = ta.init_state(ds.x_train[:8])
+    losses_a = []
+    for b in batches:
+        state, m = ta.train_step(state, b)
+        losses_a.append(float(m["loss"]))
+    final_a = jax.tree.leaves(state.params)
+
+    # ---- run B: 3 steps, checkpoint, NEW trainer resumes, 3 more -----
+    tb1 = _mk(cfg, tmp_path / "b", cpu_devices)
+    state_b = tb1.init_state(ds.x_train[:8])
+    losses_b = []
+    for b in batches[:3]:
+        state_b, m = tb1.train_step(state_b, b)
+        losses_b.append(float(m["loss"]))
+    tb1.checkpointer.save(3, state_b)
+    tb1.checkpointer.wait()
+    del state_b  # the "kill": nothing survives but the checkpoint
+
+    tb2 = _mk(cfg, tmp_path / "b", cpu_devices)
+    restored = tb2.checkpointer.restore_latest(
+        tb2.init_state(ds.x_train[:8]))
+    assert restored is not None and restored[0] == 3
+    state_b = restored[1]
+    # the restored step counter drives the rng fold — continuity depends
+    # on it, so pin it explicitly
+    assert int(state_b.step) == 3
+    for b in batches[3:]:
+        state_b, m = tb2.train_step(state_b, b)
+        losses_b.append(float(m["loss"]))
+
+    # loss continuity: the resumed steps reproduce the uninterrupted run
+    np.testing.assert_allclose(losses_b, losses_a, rtol=1e-6)
+    # and the final composed state matches leaf-for-leaf
+    final_b = jax.tree.leaves(state_b.params)
+    assert len(final_a) == len(final_b)
+    for a, b in zip(final_a, final_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_restored_composed_shardings_survive(tmp_path, setup, cpu_devices):
+    """Restore must land every leaf back on its mesh axes: stage params on
+    `pipeline`, expert kernels on `expert`, LoRA adapters per-stage —
+    resharding-on-restore would silently serialize the pipeline."""
+    cfg, ds = setup
+    t1 = _mk(cfg, tmp_path / "c", cpu_devices)
+    state = t1.init_state(ds.x_train[:8])
+    state, _ = t1.train_step(state, _batches(ds, 1)[0])
+    t1.checkpointer.save(1, state)
+    t1.checkpointer.wait()
+
+    t2 = _mk(cfg, tmp_path / "c", cpu_devices)
+    restored = t2.checkpointer.restore_latest(t2.init_state(ds.x_train[:8]))
+    assert restored is not None
+    params = restored[1].params
+    stage_kernel = params["base"]["stages"]["layer_0"]["attention"][
+        "query"]["kernel"]
+    assert stage_kernel.sharding.spec[0] == "pipeline"
+    moe_kernel = params["base"]["stages"]["layer_0"]["moe"]["w_up"]
+    moe_axes = [a for part in moe_kernel.sharding.spec if part
+                for a in (part if isinstance(part, tuple) else (part,))]
+    assert "expert" in moe_axes and "pipeline" in moe_axes
+    lora_a = params["lora"]["stages"]["layer_0"]["attention"]["query"][
+        "kernel"]["lora_a"]
+    assert lora_a.sharding.spec[0] == "pipeline"
