@@ -35,10 +35,26 @@ class FeasibleSpace:
 
 
 @dataclass
+class ParameterCondition:
+    """Gates a conditional parameter on a parent's value (hierarchical
+    search spaces: e.g. moe_experts only matters when use_moe=true).
+    Semantics are SMAC-style: suggesters always propose a value for every
+    dimension (so learning algorithms see a fixed-dimensional space), but
+    an INACTIVE parameter is dropped at trial-template render time —
+    template lines whose placeholders are all inactive vanish from the
+    rendered job."""
+
+    parameter: str = ""            # parent ParameterSpec.name
+    values: list[str] = field(default_factory=lambda: [])  # activating values
+
+
+@dataclass
 class ParameterSpec:
     name: str = ""
     parameter_type: ParameterType = ParameterType.DOUBLE
     feasible_space: FeasibleSpace = field(default_factory=FeasibleSpace)
+    # None = unconditional (the common case)
+    active_when: ParameterCondition | None = None
 
 
 class ObjectiveType(str, enum.Enum):
@@ -47,12 +63,39 @@ class ObjectiveType(str, enum.Enum):
 
 
 @dataclass
+class ObjectiveTerm:
+    """One extra objective for multi-objective experiments: collected like
+    an additional metric, but it also steers optimal-trial selection
+    (weighted scalarization) and the Pareto front."""
+
+    metric_name: str = ""
+    type: ObjectiveType = ObjectiveType.MAXIMIZE
+    weight: float = 1.0
+
+
+@dataclass
 class Objective:
     type: ObjectiveType = ObjectiveType.MAXIMIZE
-    # stop the experiment early once the best trial reaches this value
+    # stop the experiment early once the best trial reaches this value;
+    # with additional_objectives the goal still reads the PRIMARY metric
     goal: float | None = None
     objective_metric_name: str = ""
     additional_metric_names: list[str] = field(default_factory=lambda: [])
+    # multi-objective: optimal trial = best weighted scalarization
+    # (every term oriented into the primary type's direction);
+    # status.pareto_front reports the non-dominated set
+    additional_objectives: list[ObjectiveTerm] = field(
+        default_factory=lambda: [])
+
+    @property
+    def collected_metric_names(self) -> list[str]:
+        """Every non-primary metric the collector must gather: the
+        additional metrics plus each additional objective's metric."""
+        names = list(self.additional_metric_names)
+        for term in self.additional_objectives:
+            if term.metric_name not in names:
+                names.append(term.metric_name)
+        return names
 
 
 @dataclass
@@ -206,6 +249,9 @@ class ExperimentStatus:
     trials_failed: int = 0
     trials_early_stopped: int = 0
     current_optimal_trial: OptimalTrial | None = None
+    # multi-objective experiments: the non-dominated succeeded trials
+    # (empty for single-objective)
+    pareto_front: list[OptimalTrial] = field(default_factory=lambda: [])
     start_time: str = ""
     completion_time: str = ""
     message: str = ""
@@ -227,18 +273,73 @@ class Experiment:
     api_version: str = "kubeflow-tpu.org/v1beta1"
 
 
-def render_trial_spec(template: TrialTemplate, assignments: dict[str, str]) -> str:
+def inactive_parameters(parameters: list[ParameterSpec],
+                        assignments: dict[str, str]) -> set[str]:
+    """Names of conditional parameters whose gate is NOT satisfied by this
+    trial's assignments (see ParameterCondition semantics)."""
+    out = set()
+    for p in parameters:
+        cond = p.active_when
+        if cond is None:
+            continue
+        if assignments.get(cond.parameter) not in cond.values:
+            out.add(p.name)
+    return out
+
+
+def scalarized_objective(obj: Objective, observation: Observation
+                         ) -> float | None:
+    """The value optimal-trial selection ranks by, oriented in the PRIMARY
+    objective's direction (so the existing type-aware comparators apply).
+
+    Single-objective: the primary metric itself. Multi-objective: primary
+    + Σ weight·metric for each additional term, each term sign-flipped
+    when its direction opposes the primary's. A finished trial missing any
+    term ranks worst (nan)."""
+    primary = observation.metric(obj.objective_metric_name)
+    if primary is None:
+        return None
+    total = primary.latest
+    for term in obj.additional_objectives:
+        m = observation.metric(term.metric_name)
+        if m is None:
+            return float("nan")
+        sign = 1.0 if term.type == obj.type else -1.0
+        total += sign * term.weight * m.latest
+    return total
+
+
+def render_trial_spec(template: TrialTemplate, assignments: dict[str, str],
+                      parameters: list[ParameterSpec] | None = None) -> str:
     """Substitute ${trialParameters.<name>} placeholders (katib's
-    trialTemplate substitution contract)."""
+    trialTemplate substitution contract).
+
+    Conditional spaces: when `parameters` is given, placeholders bound to
+    INACTIVE search parameters take their line with them — any template
+    line that contains only inactive placeholders (of the lines that
+    contain placeholders at all) is removed, so a conditional CLI flag or
+    env entry vanishes instead of rendering `--flag=`."""
     out = template.trial_spec
+    inactive = (inactive_parameters(parameters, assignments)
+                if parameters is not None else set())
+    dead_tokens = []
     for tp in template.trial_parameters:
-        value = assignments.get(tp.reference or tp.name)
+        ref = tp.reference or tp.name
+        token = "${trialParameters." + tp.name + "}"
+        if ref in inactive:
+            dead_tokens.append(token)
+            continue
+        value = assignments.get(ref)
         if value is None:
             raise ValueError(
                 f"trial parameter {tp.name!r} references unknown search "
                 f"parameter {tp.reference!r}"
             )
-        out = out.replace("${trialParameters." + tp.name + "}", value)
+        out = out.replace(token, value)
+    if dead_tokens:
+        kept = [line for line in out.split("\n")
+                if not any(t in line for t in dead_tokens)]
+        out = "\n".join(kept)
     return out
 
 
@@ -262,8 +363,42 @@ def validate_experiment(exp: Experiment) -> Experiment:
         else:
             if not fs.list:
                 raise ValueError(f"parameter {p.name}: categorical space needs list")
+    by_name = {p.name: p for p in exp.spec.parameters}
+    for p in exp.spec.parameters:
+        cond = p.active_when
+        if cond is None:
+            continue
+        parent = by_name.get(cond.parameter)
+        if parent is None or parent is p:
+            raise ValueError(
+                f"parameter {p.name}: active_when.parameter "
+                f"{cond.parameter!r} must name another experiment parameter")
+        if parent.active_when is not None:
+            raise ValueError(
+                f"parameter {p.name}: active_when parent {cond.parameter!r} "
+                "is itself conditional — only one level of nesting is "
+                "supported")
+        if not cond.values:
+            raise ValueError(
+                f"parameter {p.name}: active_when.values must be non-empty")
+        if parent.parameter_type in (ParameterType.CATEGORICAL,
+                                     ParameterType.DISCRETE):
+            unknown = [v for v in cond.values
+                       if v not in parent.feasible_space.list]
+            if unknown:
+                raise ValueError(
+                    f"parameter {p.name}: active_when.values {unknown} not "
+                    f"in parent {cond.parameter!r}'s feasible list")
     if not exp.spec.objective.objective_metric_name:
         raise ValueError("experiment: objective.objectiveMetricName required")
+    for term in exp.spec.objective.additional_objectives:
+        if not term.metric_name:
+            raise ValueError(
+                "experiment: additional_objectives entries need metricName")
+        if term.metric_name == exp.spec.objective.objective_metric_name:
+            raise ValueError(
+                f"experiment: additional objective {term.metric_name!r} "
+                "duplicates the primary objective")
     algo = exp.spec.algorithm.algorithm_name
     if algo == "darts":
         raise ValueError(

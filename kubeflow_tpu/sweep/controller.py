@@ -12,6 +12,7 @@ user jobs — the sweep engine composes with, not bypasses, the control plane.
 
 from __future__ import annotations
 
+import math
 import statistics
 import zlib
 from typing import Callable
@@ -31,6 +32,7 @@ from kubeflow_tpu.sweep.api import (
     TrialCondition,
     TrialSpec,
     render_trial_spec,
+    scalarized_objective,
 )
 from kubeflow_tpu.api.common import ObjectMeta, utcnow as _now
 from kubeflow_tpu.sweep.collector import observation_from_log
@@ -163,9 +165,13 @@ class ExperimentController(ControllerBase):
         best = self._optimal(exp, succeeded)
         if best is not None:
             st.current_optimal_trial = best
+        st.pareto_front = self._pareto_front(exp, succeeded)
 
         # -- termination
         obj = exp.spec.objective
+        # the goal reads the PRIMARY metric of the optimal trial (multi-
+        # objective scalarization picks the trial; the goal stays a
+        # primary-metric contract, matching katib's single-goal semantics)
         goal_met = (
             best is not None
             and obj.goal is not None
@@ -345,14 +351,14 @@ class ExperimentController(ControllerBase):
 
             return observation_from_tfevents(
                 self._tfevents_dir(exp, trial),
-                obj.objective_metric_name, obj.additional_metric_names,
+                obj.objective_metric_name, obj.collected_metric_names,
             )
         log = self.log_reader(
             f"{trial.metadata.name}-{exp.spec.metrics_replica_type}-0",
             trial.metadata.namespace,
         )
         return observation_from_log(
-            log, obj.objective_metric_name, obj.additional_metric_names
+            log, obj.objective_metric_name, obj.collected_metric_names
         )
 
     @staticmethod
@@ -450,14 +456,16 @@ class ExperimentController(ControllerBase):
             del self._timeline_cache[k]
 
     def _optimal(self, exp: Experiment, succeeded: list[Trial]) -> OptimalTrial | None:
+        """Best trial by the (scalarized, for multi-objective) objective —
+        katib's currentOptimalTrial."""
         obj = exp.spec.objective
         best_t, best_v = None, None
         for t in succeeded:
-            m = t.status.observation.metric(obj.objective_metric_name)
-            if m is None:
+            v = scalarized_objective(obj, t.status.observation)
+            if v is None or math.isnan(v):
                 continue
-            if best_v is None or _strictly_better(obj.type, m.latest, best_v):
-                best_t, best_v = t, m.latest
+            if best_v is None or _strictly_better(obj.type, v, best_v):
+                best_t, best_v = t, v
         if best_t is None:
             return None
         return OptimalTrial(
@@ -466,13 +474,59 @@ class ExperimentController(ControllerBase):
             observation=best_t.status.observation,
         )
 
+    def _pareto_front(self, exp: Experiment,
+                      succeeded: list[Trial]) -> list[OptimalTrial]:
+        """Non-dominated succeeded trials over (primary + additional
+        objectives); empty for single-objective experiments."""
+        obj = exp.spec.objective
+        if not obj.additional_objectives:
+            return []
+        terms = [(obj.objective_metric_name, obj.type)] + [
+            (t.metric_name, t.type) for t in obj.additional_objectives]
+
+        def vector(t: Trial) -> list[float] | None:
+            vs = []
+            for name, typ in terms:
+                m = t.status.observation.metric(name)
+                if m is None:
+                    return None
+                # orient every term as MAXIMIZE for the dominance test
+                vs.append(m.latest if typ == ObjectiveType.MAXIMIZE
+                          else -m.latest)
+            return vs
+
+        scored = [(t, vector(t)) for t in succeeded]
+        scored = [(t, v) for t, v in scored
+                  if v is not None and not any(math.isnan(x) for x in v)]
+
+        def dominated(v, others):
+            return any(
+                all(o >= x for o, x in zip(w, v))
+                and any(o > x for o, x in zip(w, v))
+                for _, w in others)
+
+        front = [
+            OptimalTrial(
+                trial_name=t.metadata.name,
+                parameter_assignments=list(t.spec.parameter_assignments),
+                observation=t.status.observation,
+            )
+            for t, v in scored
+            if not dominated(v, [(u, w) for u, w in scored if u is not t])
+        ]
+        front.sort(key=lambda o: o.trial_name)
+        return front
+
     def _spawn_trials(self, exp: Experiment, trials: list[Trial], count: int) -> int:
         obj = exp.spec.objective
         history = []
         for t in trials:
-            m = t.status.observation.metric(obj.objective_metric_name)
-            if m is not None:
-                o = m.latest
+            # suggesters learn the SCALARIZED value under multi-objective
+            # (one number, primary-oriented) — the same quantity optimal-
+            # trial selection ranks by
+            v = scalarized_objective(obj, t.status.observation)
+            if v is not None:
+                o = v
             elif t.status.is_finished:
                 o = float("nan")  # finished without objective: ranks worst
             else:
@@ -513,7 +567,9 @@ class ExperimentController(ControllerBase):
                     parameter_assignments=[
                         ParameterAssignment(name=k, value=v) for k, v in a.items()
                     ],
-                    rendered_spec=render_trial_spec(exp.spec.trial_template, a),
+                    rendered_spec=render_trial_spec(
+                        exp.spec.trial_template, a,
+                        parameters=exp.spec.parameters),
                 ),
             )
             try:
@@ -612,6 +668,7 @@ def _exp_fingerprint(st) -> tuple:
         st.trials_failed,
         st.trials_early_stopped,
         st.message,
+        tuple(o.trial_name for o in st.pareto_front),
         st.current_optimal_trial.trial_name if st.current_optimal_trial else "",
         (
             tuple(

@@ -1,0 +1,315 @@
+"""Multi-objective experiments + conditional (hierarchical) search spaces
+(VERDICT r3 next #7; katib's additionalMetricNames generalized into
+additional objective terms with scalarized optimal-trial selection and a
+Pareto front, plus SMAC-style conditional parameters)."""
+
+import sys
+import textwrap
+
+import pytest
+
+from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.client import Platform
+from kubeflow_tpu.sweep import (
+    AlgorithmSpec,
+    Experiment,
+    ExperimentSpec,
+    FeasibleSpace,
+    Objective,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    SweepClient,
+    TrialParameterSpec,
+    TrialTemplate,
+)
+from kubeflow_tpu.sweep.api import (
+    Metric,
+    Observation,
+    ObjectiveTerm,
+    ParameterCondition,
+    inactive_parameters,
+    render_trial_spec,
+    scalarized_objective,
+    validate_experiment,
+)
+
+
+def p_cat(name, values, active_when=None):
+    return ParameterSpec(
+        name=name, parameter_type=ParameterType.CATEGORICAL,
+        feasible_space=FeasibleSpace(list=[str(v) for v in values]),
+        active_when=active_when,
+    )
+
+
+def obs(**metrics):
+    return Observation(metrics=[
+        Metric(name=k, latest=v, min=v, max=v) for k, v in metrics.items()])
+
+
+class TestScalarization:
+    def test_single_objective_is_primary(self):
+        o = Objective(objective_metric_name="acc")
+        assert scalarized_objective(o, obs(acc=0.9)) == 0.9
+
+    def test_opposing_term_subtracts(self):
+        # maximize acc, minimize latency with weight 0.1:
+        # scalar = acc - 0.1 * latency (primary-oriented: higher better)
+        o = Objective(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="acc",
+            additional_objectives=[ObjectiveTerm(
+                metric_name="latency", type=ObjectiveType.MINIMIZE,
+                weight=0.1)])
+        assert scalarized_objective(o, obs(acc=0.9, latency=2.0)) == \
+            pytest.approx(0.9 - 0.2)
+
+    def test_aligned_term_adds(self):
+        o = Objective(
+            type=ObjectiveType.MINIMIZE, objective_metric_name="loss",
+            additional_objectives=[ObjectiveTerm(
+                metric_name="val_loss", type=ObjectiveType.MINIMIZE,
+                weight=0.5)])
+        assert scalarized_objective(o, obs(loss=1.0, val_loss=2.0)) == \
+            pytest.approx(2.0)  # lower-better orientation preserved
+
+    def test_missing_term_ranks_worst(self):
+        import math
+
+        o = Objective(
+            objective_metric_name="acc",
+            additional_objectives=[ObjectiveTerm(metric_name="latency")])
+        assert math.isnan(scalarized_objective(o, obs(acc=0.9)))
+
+
+class TestConditionalSpace:
+    PARAMS = [
+        p_cat("use_moe", ["true", "false"]),
+        p_cat("moe_experts", ["2", "4"],
+              active_when=ParameterCondition(parameter="use_moe",
+                                             values=["true"])),
+    ]
+
+    def test_inactive_detection(self):
+        assert inactive_parameters(
+            self.PARAMS, {"use_moe": "false", "moe_experts": "4"}) == \
+            {"moe_experts"}
+        assert inactive_parameters(
+            self.PARAMS, {"use_moe": "true", "moe_experts": "4"}) == set()
+
+    def test_render_drops_inactive_lines(self):
+        tpl = TrialTemplate(
+            trial_spec=("args:\n"
+                        "  - --use-moe=${trialParameters.um}\n"
+                        "  - --moe-experts=${trialParameters.me}\n"),
+            trial_parameters=[
+                TrialParameterSpec(name="um", reference="use_moe"),
+                TrialParameterSpec(name="me", reference="moe_experts"),
+            ])
+        off = render_trial_spec(
+            tpl, {"use_moe": "false", "moe_experts": "4"},
+            parameters=self.PARAMS)
+        assert "--use-moe=false" in off and "moe-experts" not in off
+        on = render_trial_spec(
+            tpl, {"use_moe": "true", "moe_experts": "4"},
+            parameters=self.PARAMS)
+        assert "--moe-experts=4" in on
+
+    def test_validation(self):
+        def mk(params, objective=None):
+            return Experiment(
+                metadata=ObjectMeta(name="v"),
+                spec=ExperimentSpec(
+                    parameters=params,
+                    objective=objective or Objective(
+                        objective_metric_name="m"),
+                ))
+
+        with pytest.raises(ValueError, match="another experiment parameter"):
+            validate_experiment(mk([
+                p_cat("a", ["1"], active_when=ParameterCondition(
+                    parameter="ghost", values=["1"]))]))
+        with pytest.raises(ValueError, match="one level"):
+            validate_experiment(mk([
+                p_cat("a", ["1", "2"]),
+                p_cat("b", ["1"], active_when=ParameterCondition(
+                    parameter="a", values=["1"])),
+                p_cat("c", ["1"], active_when=ParameterCondition(
+                    parameter="b", values=["1"]))]))
+        with pytest.raises(ValueError, match="not in parent"):
+            validate_experiment(mk([
+                p_cat("a", ["1", "2"]),
+                p_cat("b", ["1"], active_when=ParameterCondition(
+                    parameter="a", values=["9"]))]))
+        with pytest.raises(ValueError, match="duplicates the primary"):
+            validate_experiment(mk(
+                [p_cat("a", ["1"])],
+                Objective(objective_metric_name="m",
+                          additional_objectives=[
+                              ObjectiveTerm(metric_name="m")])))
+
+
+def test_sample_manifest_roundtrip_and_validates():
+    from pathlib import Path
+
+    from kubeflow_tpu.sweep.serde import (
+        experiment_from_yaml,
+        experiment_to_yaml,
+    )
+
+    exp = experiment_from_yaml(
+        Path("samples/experiment_multiobjective.yaml").read_text())
+    validate_experiment(exp)
+    cond = exp.spec.parameters[2].active_when
+    assert cond.parameter == "useMoe" and cond.values == ["true"]
+    term = exp.spec.objective.additional_objectives[0]
+    assert term.metric_name == "steps_per_sec" and term.weight == 0.01
+    assert exp.spec.objective.collected_metric_names == [
+        "final_loss", "steps_per_sec"]
+    again = experiment_from_yaml(experiment_to_yaml(exp))
+    assert experiment_to_yaml(again) == experiment_to_yaml(exp)
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    with Platform(log_dir=str(tmp_path / "pod-logs"),
+                  capacity_chips=16) as p:
+        yield p
+
+
+@pytest.fixture()
+def sweep(platform, tmp_path):
+    return SweepClient(platform, work_dir=str(tmp_path / "sweeps"))
+
+
+class TestMultiObjectiveE2E:
+    def test_scalarized_optimal_and_pareto_front(self, sweep, tmp_path):
+        """Grid over x∈{a,b,c}: acc rises with x while latency explodes at
+        the top — the weighted optimum is the MIDDLE point (primary alone
+        would pick the top), and the Pareto front holds every point except
+        the dominated bottom one."""
+        script = tmp_path / "trial.py"
+        script.write_text(textwrap.dedent(
+            """
+            import os
+            x = os.environ["X_PARAM"]
+            acc = {"a": 0.5, "b": 0.8, "c": 0.9}[x]
+            lat = {"a": 1.0, "b": 1.0, "c": 9.0}[x]
+            print(f"objective={acc}")
+            print(f"latency={lat}")
+            """))
+        spec = textwrap.dedent(f"""
+            apiVersion: kubeflow-tpu.org/v1
+            kind: JAXJob
+            spec:
+              replicaSpecs:
+                worker:
+                  replicas: 1
+                  template:
+                    container:
+                      command: [{sys.executable}, {script}]
+                      env:
+                        X_PARAM: "${{trialParameters.x}}"
+            """)
+        exp = Experiment(
+            metadata=ObjectMeta(name="mo-exp"),
+            spec=ExperimentSpec(
+                parameters=[p_cat("x", ["a", "b", "c"])],
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE,
+                    objective_metric_name="objective",
+                    additional_objectives=[ObjectiveTerm(
+                        metric_name="latency",
+                        type=ObjectiveType.MINIMIZE, weight=0.05)],
+                ),
+                algorithm=AlgorithmSpec(algorithm_name="grid"),
+                trial_template=TrialTemplate(
+                    trial_spec=spec,
+                    trial_parameters=[
+                        TrialParameterSpec(name="x", reference="x")]),
+                max_trial_count=10,
+                parallel_trial_count=3,
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("mo-exp", timeout_s=120)
+        assert done.status.condition.value == "Succeeded"
+        # scalarized: a=0.45, b=0.75, c=0.45 -> b wins (primary alone: c)
+        assert sweep.get_optimal_hyperparameters("mo-exp") == {"x": "b"}
+        # latency landed in the optimal trial's observation too
+        best = done.status.current_optimal_trial
+        assert best.observation.metric("latency").latest == 1.0
+        # pareto: b dominates a (>=acc, <=lat, one strict); c undominated
+        front = {
+            next(a.value for a in o.parameter_assignments if a.name == "x")
+            for o in done.status.pareto_front}
+        assert front == {"b", "c"}
+
+    def test_conditional_space_e2e(self, sweep, tmp_path):
+        """moe_experts only reaches the trial when use_moe=true: rendered
+        specs for use_moe=false trials carry NO MOE_EXPERTS env, and the
+        experiment still runs every grid point to completion."""
+        script = tmp_path / "trial.py"
+        script.write_text(textwrap.dedent(
+            """
+            import os
+            moe = os.environ.get("MOE_EXPERTS")
+            use = os.environ["USE_MOE"] == "true"
+            assert (moe is not None) == use, (moe, use)
+            score = (0.6 + 0.1 * int(moe or 0)) if use else 0.5
+            print(f"objective={score}")
+            """))
+        spec = textwrap.dedent(f"""
+            apiVersion: kubeflow-tpu.org/v1
+            kind: JAXJob
+            spec:
+              replicaSpecs:
+                worker:
+                  replicas: 1
+                  template:
+                    container:
+                      command: [{sys.executable}, {script}]
+                      env:
+                        USE_MOE: "${{trialParameters.um}}"
+                        MOE_EXPERTS: "${{trialParameters.me}}"
+            """)
+        exp = Experiment(
+            metadata=ObjectMeta(name="cond-exp"),
+            spec=ExperimentSpec(
+                parameters=[
+                    p_cat("use_moe", ["true", "false"]),
+                    p_cat("moe_experts", ["2", "4"],
+                          active_when=ParameterCondition(
+                              parameter="use_moe", values=["true"])),
+                ],
+                objective=Objective(
+                    type=ObjectiveType.MAXIMIZE,
+                    objective_metric_name="objective"),
+                algorithm=AlgorithmSpec(algorithm_name="grid"),
+                trial_template=TrialTemplate(
+                    trial_spec=spec,
+                    trial_parameters=[
+                        TrialParameterSpec(name="um", reference="use_moe"),
+                        TrialParameterSpec(name="me",
+                                           reference="moe_experts")]),
+                max_trial_count=10,
+                parallel_trial_count=2,
+            ),
+        )
+        sweep.create_experiment(exp)
+        done = sweep.wait_for_experiment("cond-exp", timeout_s=120)
+        assert done.status.condition.value == "Succeeded"
+        # best: use_moe=true with the most experts
+        best = sweep.get_optimal_hyperparameters("cond-exp")
+        assert best["use_moe"] == "true" and best["moe_experts"] == "4"
+        # rendered specs for inactive trials dropped the MOE env line
+        saw_off = saw_on = False
+        for t in sweep.list_trials("cond-exp"):
+            a = t.assignments_dict()
+            if a["use_moe"] == "false":
+                assert "MOE_EXPERTS" not in t.spec.rendered_spec
+                saw_off = True
+            else:
+                assert "MOE_EXPERTS" in t.spec.rendered_spec
+                saw_on = True
+        assert saw_off and saw_on
