@@ -530,7 +530,13 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
 #               and recompute D = Σ_d dO∘O in-kernel in f32.
 # All variants are numerically identical in interpret/CPU mode
 # (test_ring_attention pins it).
-FLASH_BWD_IMPL = "xla"
+# KFT_FLASH_BWD_IMPL overrides the default: tunnel_watch2.sh sets it to
+# loop2 for the bench capture iff probe_flash_r4 records loop2 as BOTH
+# Mosaic-PASS and at-least-as-fast as the xla backward — so a single
+# window can validate the fix AND benchmark through it.
+import os as _os  # noqa: E402
+
+FLASH_BWD_IMPL = _os.environ.get("KFT_FLASH_BWD_IMPL", "xla")
 
 
 def _flash_backward_xla(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,

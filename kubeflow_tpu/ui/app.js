@@ -100,7 +100,7 @@ const KINDS = {
         esc(((o.spec || {}).algorithm || {}).algorithmName || "-"),
         badge(st.condition || "-"),
         esc(`${st.trialsSucceeded || 0}/${st.trials || 0}`),
-        best ? esc(Number(best.value).toPrecision(5)) : "-",
+        best ? esc(Number(best.latest ?? best.value).toPrecision(5)) : "-",
       ];
     },
   },
@@ -112,7 +112,7 @@ const KINDS = {
       return [
         esc((o.metadata.labels || {})["kubeflow-tpu.org/experiment-name"] || "-"),
         badge((o.status || {}).condition || "-"),
-        m ? esc(Number(m.value).toPrecision(5)) : "-",
+        m ? esc(Number(m.latest ?? m.value).toPrecision(5)) : "-",
         esc(((o.spec || {}).parameterAssignments || [])
           .map((a) => `${a.name}=${a.value}`).join(" ")),
       ];
@@ -369,15 +369,26 @@ async function experimentDetail(o) {
     ["assignments", esc((opt.parameterAssignments || [])
       .map((a) => `${a.name}=${a.value}`).join(" "))],
     [objName, esc(((opt.observation || {}).metrics || [])
-      .map((m) => `${m.name}=${Number(m.value).toPrecision(6)}`).join(" "))],
+      .map((m) => `${m.name}=${Number(m.latest ?? m.value).toPrecision(6)}`).join(" "))],
   ]) : `<p class="muted">no optimal trial yet</p>`;
+  // multi-objective experiments: the non-dominated set
+  const front = (o.status || {}).paretoFront || [];
+  const frontHtml = front.length ? `<h3>pareto front (${front.length})</h3>
+    <table><tr><th>trial</th><th>assignments</th><th>metrics</th></tr>${
+      front.map((p) => `<tr><td>${esc(p.trialName)}</td>
+        <td>${esc((p.parameterAssignments || [])
+          .map((a) => `${a.name}=${a.value}`).join(" "))}</td>
+        <td>${esc(((p.observation || {}).metrics || [])
+          .map((m) => `${m.name}=${Number(m.latest ?? m.value).toPrecision(5)}`)
+          .join(" "))}</td></tr>`).join("")
+    }</table>` : "";
   const rows = trials.map((t) => {
     const m = (((t.status || {}).observation || {}).metrics || [])
       .find((m) => m.name === objName) ||
       (((t.status || {}).observation || {}).metrics || [])[0];
     return `<tr><td>${esc(t.metadata.name)}</td>
       <td>${badge((t.status || {}).condition || "-")}</td>
-      <td>${m ? esc(Number(m.value).toPrecision(5)) : "-"}</td>
+      <td>${m ? esc(Number(m.latest ?? m.value).toPrecision(5)) : "-"}</td>
       <td>${esc(((t.spec || {}).parameterAssignments || [])
         .map((a) => `${a.name}=${a.value}`).join(" "))}</td></tr>`;
   }).join("");
@@ -388,6 +399,7 @@ async function experimentDetail(o) {
       ["state", badge((o.status || {}).condition || "-")],
     ])}
     <h3>optimal trial</h3>${optHtml}
+    ${frontHtml}
     <h3>${esc(objName)} per trial</h3>
     ${trialChart(trials, objName, objType)}
     <h3>trials (${trials.length})</h3>
@@ -403,8 +415,8 @@ function trialChart(trials, objName, objType) {
   trials.forEach((t, i) => {
     const ms = ((t.status || {}).observation || {}).metrics || [];
     const m = ms.find((x) => x.name === objName) || ms[0];
-    if (m && isFinite(Number(m.value))) {
-      pts.push({ i, v: Number(m.value), name: t.metadata.name });
+    if (m && isFinite(Number(m.latest ?? m.value))) {
+      pts.push({ i, v: Number(m.latest ?? m.value), name: t.metadata.name });
     }
   });
   if (pts.length < 2) {

@@ -50,8 +50,20 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
 " >/dev/null 2>&1; then
     echo "=== tunnel alive at $(date -u +%H:%M:%S) ===" >> tunnel_watch2.log
     { stage probe_flash_r4.txt 1500 python -u probe_flash_r4.py \
-        && stage bench_r4_suite.jsonl 2400 \
-             env KFT_BENCH_DEADLINE_S=2300 python bench.py --suite \
+        && { # flip the training benches onto the pallas backward iff the
+             # probe recorded it Mosaic-PASS and >= as fast as the xla one
+             BWD=xla
+             if grep -q "loop2_causal=PASS" probe_flash_r4.txt 2>/dev/null \
+                && grep -q "loop2_full=PASS" probe_flash_r4.txt; then
+               L2=$(grep -o "flash_loop2_fwdbwd_ms=[0-9.]*" probe_flash_r4.txt | tail -1 | cut -d= -f2)
+               XL=$(grep -o "flash_xla_fwdbwd_ms=[0-9.]*" probe_flash_r4.txt | tail -1 | cut -d= -f2)
+               if [ -n "$L2" ] && [ -n "$XL" ] \
+                  && awk "BEGIN{exit !($L2 <= $XL)}"; then BWD=loop2; fi
+             fi
+             echo "bench KFT_FLASH_BWD_IMPL=$BWD" >> tunnel_watch2.log
+             stage bench_r4_suite.jsonl 2400 \
+               env KFT_BENCH_DEADLINE_S=2300 KFT_FLASH_BWD_IMPL=$BWD \
+               python bench.py --suite; } \
         && { [ ! -f probe_resnet.py ] \
              || stage probe_resnet.txt 1200 python -u probe_resnet.py; } \
         && stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py; } \
