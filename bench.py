@@ -266,11 +266,15 @@ def bench_bert_base(steps: int = 20, batch_size: int = 16, seq_len: int = 128) -
     return _finish(r, dt, steps, 6 * 110e6 * tokens + attn)
 
 
-def bench_gpt2s_flash_2k(steps: int = 10, batch_size: int = 4, seq_len: int = 2048) -> dict:
+def bench_gpt2s_flash_2k(steps: int = 10, batch_size: int = 4,
+                         seq_len: int = 2048, window: int = 0,
+                         metric: str = "gpt2s_flash_2k_tokens_per_sec_per_chip",
+                         ) -> dict:
     """GPT-2-small causal LM at 2k context through the pallas flash kernel —
     the long-context path (SURVEY.md §5.7). On TPU this is the Mosaic-
     compiled (non-interpret) kernel, so the metric doubles as the kernel's
-    production validation."""
+    production validation. window > 0 runs the sliding-window variant
+    (the kernel skips KV blocks outside the window: O(L·W) attention)."""
     import jax.numpy as jnp
 
     from kubeflow_tpu.models import GPTConfig, GPTLM, causal_lm_loss
@@ -278,7 +282,8 @@ def bench_gpt2s_flash_2k(steps: int = 10, batch_size: int = 4, seq_len: int = 20
     from kubeflow_tpu.train.data import synthetic_lm_dataset
 
     cfg = GPTConfig.small(dtype=jnp.bfloat16, dropout_rate=0.0,
-                          attention="flash", max_len=seq_len)
+                          attention="flash", max_len=seq_len,
+                          attention_window=window)
     ds = synthetic_lm_dataset(n_train=batch_size, n_test=batch_size,
                               seq_len=seq_len, vocab_size=cfg.vocab_size)
     trainer = Trainer(
@@ -291,15 +296,26 @@ def bench_gpt2s_flash_2k(steps: int = 10, batch_size: int = 4, seq_len: int = 20
     batch = (ds.x_train[:batch_size], ds.y_train[:batch_size])
     dt = _timed_steps(trainer, state, batch, steps)
     tokens = batch_size * seq_len
-    # 6·N per token fwd+bwd (N ≈ 124M) + causal attention score/value
-    # matmuls: 12·L·s²·h·bs with the causal half discount
-    attn = 12 * cfg.num_layers * seq_len * seq_len * cfg.hidden_size * batch_size // 2
+    # 6·N per token fwd+bwd (N ≈ 124M) + attention score/value matmuls:
+    # 12·L·s·min(s/2, window)·h·bs (causal half discount, or the window)
+    per_q = min(seq_len // 2, window) if window else seq_len // 2
+    attn = 12 * cfg.num_layers * seq_len * per_q * cfg.hidden_size * batch_size
     r = {
-        "metric": "gpt2s_flash_2k_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(steps * tokens / dt, 1),
         "unit": "tokens/sec/chip",
     }
+    if window:
+        r["window"] = window
     return _finish(r, dt, steps, 6 * 124e6 * tokens + attn)
+
+
+def bench_gpt2s_swa_2k(**kw) -> dict:
+    """Sliding-window (Mistral) flash at 2k context, window 256: the
+    block-skipping kernel's O(L·W) win over full causal — compare
+    tokens/sec against gpt2s_flash_2k."""
+    return bench_gpt2s_flash_2k(
+        window=256, metric="gpt2s_swa_2k_tokens_per_sec_per_chip", **kw)
 
 
 def bench_vitb16(steps: int = 30, batch_size: int = 128, image_size: int = 224) -> dict:
@@ -638,6 +654,8 @@ SUITE_BENCHES = [
     FLAGSHIP,
     (bench_vitb16, "vitb16_images_per_sec_per_chip", "images/sec/chip"),
     (bench_gpt2s_flash_2k, "gpt2s_flash_2k_tokens_per_sec_per_chip", "tokens/sec/chip"),
+    (bench_gpt2s_swa_2k, "gpt2s_swa_2k_tokens_per_sec_per_chip",
+     "tokens/sec/chip"),
     (bench_gpt2s_decode, "gpt2s_decode_tokens_per_sec_per_chip", "tokens/sec/chip"),
     (bench_gpt2s_gqa_decode, "gpt2s_gqa_decode_tokens_per_sec_per_chip",
      "tokens/sec/chip"),

@@ -79,23 +79,26 @@ def _context_size() -> int:
 # --------------------------------------------------------------------- jnp core
 
 
-def _online_block(carry, kv, q, scale, q_pos=None, k_pos=None):
+def _online_block(carry, kv, q, scale, q_pos=None, k_pos=None,
+                  window: int = 0):
     """One online-softmax accumulation step against a KV block.
 
     carry: (o_acc f32 (B,Lq,H,D), m (B,H,Lq,1) running max, l (B,H,Lq,1) sum)
     kv:    (k_blk, v_blk, bias_blk (B,1,1,Lk))
     q_pos/k_pos: global token positions (Lq,)/(Lk,) for causal masking —
     positions, not block indices, so the mask stays correct when blocks live
-    on different ring shards.
+    on different ring shards. window > 0 additionally hides keys older than
+    window-1 positions (Mistral sliding window; requires causal positions).
     """
     o_acc, m, l = carry
     k_blk, v_blk, bias_blk = kv
     s = jnp.einsum("blhd,bmhd->bhlm", q, k_blk).astype(jnp.float32) * scale
     s = s + bias_blk.astype(jnp.float32)
     if q_pos is not None:
-        s = s + jnp.where(
-            k_pos[None, :] > q_pos[:, None], NEG_INF, 0.0
-        )[None, None, :, :]
+        masked = k_pos[None, :] > q_pos[:, None]
+        if window:
+            masked = masked | (q_pos[:, None] - k_pos[None, :] >= window)
+        s = s + jnp.where(masked, NEG_INF, 0.0)[None, None, :, :]
     m_new = jnp.maximum(m, s.max(-1, keepdims=True))
     corr = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new)
@@ -118,14 +121,19 @@ def _init_carry(q):
     )
 
 
-def blockwise_attention(q, k, v, bias, block: int = 256, causal: bool = False):
+def blockwise_attention(q, k, v, bias, block: int = 256, causal: bool = False,
+                        window: int = 0):
     """Memory-efficient attention: lax.scan over KV blocks, online softmax.
 
     Differentiable everywhere (the autodiff of scan recomputes nothing extra
     beyond the saved block residuals); the numerics reference for both the
     pallas kernel and the ring path. causal=True masks k_pos > q_pos (global
     positions; the ring path reconstructs per-shard positions itself).
+    window > 0 (requires causal) is the Mistral sliding window: query i
+    sees keys in (i - window, i].
     """
+    if window and not causal:
+        raise ValueError("attention window requires causal=True")
     b, lk, h, d = k.shape
     scale = 1.0 / (q.shape[-1] ** 0.5)
     block = min(block, lk)
@@ -142,7 +150,7 @@ def blockwise_attention(q, k, v, bias, block: int = 256, causal: bool = False):
         k_blk, v_blk, bias_blk, kp = kv
         return _online_block(
             carry, (k_blk, v_blk, bias_blk), q, scale,
-            q_pos, kp if causal else None,
+            q_pos, kp if causal else None, window=window,
         ), None
 
     carry, _ = jax.lax.scan(
@@ -292,12 +300,27 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
 # ------------------------------------------------------------------ pallas fwd
 
 
+def _block_live(iq, ik, block_q, block_k, causal, window):
+    """Whether a (q_block, kv_block) pair can contribute: at-or-below the
+    causal diagonal AND, under a sliding window, not entirely older than
+    every query's window."""
+    live = ik * block_k <= iq * block_q + (block_q - 1)
+    if window:
+        live = jnp.logical_and(
+            live,
+            ik * block_k + (block_k - 1) >= iq * block_q - (window - 1),
+        )
+    return live
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                   m_scr, l_scr, acc_scr,
                   *, scale: float, n_kv: int, causal: bool,
-                  block_q: int, block_k: int):
+                  block_q: int, block_k: int, window: int = 0):
     """Flash-attention forward tile: one (batch*head, q_block) position,
-    sequential grid over KV blocks with VMEM online-softmax accumulators."""
+    sequential grid over KV blocks with VMEM online-softmax accumulators.
+    window > 0 (with causal) masks keys older than window-1 positions and
+    skips KV blocks wholly outside every query's window."""
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -322,7 +345,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
             cols = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = s + jnp.where(cols > rows, NEG_INF, 0.0)
+            masked = cols > rows
+            if window:
+                masked = masked | (rows - cols >= window)
+            s = s + jnp.where(masked, NEG_INF, 0.0)
         m_prev = m_scr[:]  # (bq, 1)
         m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
         corr = jnp.exp(m_prev - m_new)
@@ -335,9 +361,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         m_scr[:] = m_new
 
     if causal:
-        # KV blocks strictly above the diagonal contribute nothing — skip
-        # their matmuls entirely (halves long-context causal FLOPs)
-        pl.when(ik * block_k <= iq * block_q + (block_q - 1))(_compute)
+        # KV blocks strictly above the diagonal — or wholly outside the
+        # sliding window — contribute nothing: skip their matmuls entirely
+        # (halves long-context causal FLOPs; window makes it O(L·W))
+        pl.when(_block_live(iq, ik, block_q, block_k, causal, window))(
+            _compute)
     else:
         _compute()
 
@@ -349,14 +377,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 
 
 def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
-                   causal: bool = False, want_lse: bool = False):
+                   causal: bool = False, want_lse: bool = False,
+                   window: int = 0):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = 1.0 / (d**0.5)
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     if lq % block_q or lk % block_k:
-        out = blockwise_attention(q, k, v, bias, causal=causal)
+        out = blockwise_attention(q, k, v, bias, causal=causal,
+                                  window=window)
         return (out, None) if want_lse else out
     # fold heads into batch: (B*H, L, D)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
@@ -366,7 +396,7 @@ def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
 
     kernel = functools.partial(
         _flash_kernel, scale=scale, n_kv=n_kv, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, window=window,
     )
     of, lse = pl.pallas_call(
         kernel,
@@ -402,7 +432,7 @@ def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
 
 
 def _flash_bwd_scores(q, k, bias_row, lse, scale, causal, iq, ik,
-                      block_q, block_k):
+                      block_q, block_k, window: int = 0):
     """Recompute the probability tile p = exp(s - lse) for one (q, kv) block
     pair — shared by the dq and dk/dv kernels."""
     s = jax.lax.dot_general(
@@ -416,13 +446,16 @@ def _flash_bwd_scores(q, k, bias_row, lse, scale, causal, iq, ik,
         cols = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        s = s + jnp.where(cols > rows, NEG_INF, 0.0)
+        masked = cols > rows
+        if window:
+            masked = masked | (rows - cols >= window)
+        s = s + jnp.where(masked, NEG_INF, 0.0)
     return jnp.exp(s - lse)
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
                      dq_ref, acc_scr, *, scale, n_kv, causal,
-                     block_q, block_k):
+                     block_q, block_k, window: int = 0):
     """dq tile: sequential grid over KV blocks, accumulator in VMEM.
     ds = p * (dO·vᵀ − D);  dq = scale · Σ_k ds·k."""
     iq = pl.program_id(1)
@@ -435,7 +468,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
     def _compute():
         p = _flash_bwd_scores(
             q_ref[0], k_ref[0], bias_ref[0, 0, 0, :], lse_ref[0],
-            scale, causal, iq, ik, block_q, block_k,
+            scale, causal, iq, ik, block_q, block_k, window,
         )
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -448,7 +481,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
         )
 
     if causal:
-        pl.when(ik * block_k <= iq * block_q + (block_q - 1))(_compute)
+        pl.when(_block_live(iq, ik, block_q, block_k, causal, window))(
+            _compute)
     else:
         _compute()
 
@@ -459,7 +493,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
                       dk_ref, dv_ref, dbias_ref, dk_scr, dv_scr, db_scr,
-                      *, scale, n_q, causal, block_q, block_k):
+                      *, scale, n_q, causal, block_q, block_k,
+                      window: int = 0):
     """dk/dv/dbias tiles: sequential grid over Q blocks per KV block.
     dv = Σ_q pᵀ·dO;  dk = scale · Σ_q dsᵀ·q;  dbias = Σ_q Σ_rows ds."""
     ik = pl.program_id(1)
@@ -474,7 +509,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
     def _compute():
         p = _flash_bwd_scores(
             q_ref[0], k_ref[0], bias_ref[0, 0, 0, :], lse_ref[0],
-            scale, causal, iq, ik, block_q, block_k,
+            scale, causal, iq, ik, block_q, block_k, window,
         )
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -492,7 +527,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
         db_scr[:] += ds.sum(axis=0, keepdims=True)
 
     if causal:
-        pl.when(ik * block_k <= iq * block_q + (block_q - 1))(_compute)
+        pl.when(_block_live(iq, ik, block_q, block_k, causal, window))(
+            _compute)
     else:
         _compute()
 
@@ -540,7 +576,8 @@ FLASH_BWD_IMPL = _os.environ.get("KFT_FLASH_BWD_IMPL", "xla")
 
 
 def _flash_backward_xla(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
-                        scale, block_k, causal, out_dtypes, bias_dtype):
+                        scale, block_k, causal, out_dtypes, bias_dtype,
+                        window: int = 0):
     """Flash backward as XLA einsums over KV blocks, from saved residuals.
 
     Cheaper than jax.vjp(blockwise_attention) — which must REPLAY the
@@ -568,7 +605,10 @@ def _flash_backward_xla(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
         if causal:
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (lq, block_k), 1)
-            s = s + jnp.where(cols > rows, NEG_INF, 0.0)
+            masked = cols > rows
+            if window:
+                masked = masked | (rows - cols >= window)
+            s = s + jnp.where(masked, NEG_INF, 0.0)
         p = jnp.exp(s - lse)                                 # (BH, Lq, bk)
         dp = jnp.einsum("bqd,bkd->bqk", gf, vj,
                         preferred_element_type=jnp.float32)
@@ -599,7 +639,7 @@ def _flash_backward_xla(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
 
 def _flash_dq_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                           dd_ref, dq_ref, *, scale, n_kv, causal,
-                          block_q, block_k):
+                          block_q, block_k, window: int = 0):
     """dq for one q block: fori_loop over kv blocks, accumulator carried as
     a loop value (registers/VMEM), output written exactly once."""
     iq = pl.program_id(1)
@@ -613,7 +653,7 @@ def _flash_dq_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         vb = v_ref[0, pl.dslice(ik * block_k, block_k), :]
         bias_row = bias_ref[0, 0, 0, pl.dslice(ik * block_k, block_k)]
         p = _flash_bwd_scores(qb, kb, bias_row, lseb, scale, causal, iq, ik,
-                              block_q, block_k)
+                              block_q, block_k, window)
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -629,17 +669,20 @@ def _flash_dq_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         upper = jnp.minimum(
             (iq * block_q + block_q - 1) // block_k + 1, n_kv
         )
+        lower = (jnp.maximum(iq * block_q - (window - 1), 0) // block_k
+                 if window else 0)
     else:
-        upper = n_kv
+        upper, lower = n_kv, 0
     acc = jax.lax.fori_loop(
-        0, upper, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+        lower, upper, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
     )
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                            dd_ref, dk_ref, dv_ref, dbias_ref,
-                           *, scale, n_q, causal, block_q, block_k):
+                           *, scale, n_q, causal, block_q, block_k,
+                           window: int = 0):
     """dk/dv/dbias for one kv block: fori_loop over q blocks, three
     accumulators carried as loop values, outputs written exactly once."""
     ik = pl.program_id(1)
@@ -655,7 +698,7 @@ def _flash_dkv_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         lseb = lse_ref[0, pl.dslice(iq * block_q, block_q), :]
         ddb = dd_ref[0, pl.dslice(iq * block_q, block_q), :]
         p = _flash_bwd_scores(qb, kb, bias_row, lseb, scale, causal, iq, ik,
-                              block_q, block_k)
+                              block_q, block_k, window)
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -675,14 +718,17 @@ def _flash_dkv_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     if causal:
         # q blocks strictly above the diagonal see nothing of this kv block
         lower = (ik * block_k) // block_q
+        upper = (jnp.minimum(
+            (ik * block_k + block_k - 1 + window - 1) // block_q + 1, n_q)
+            if window else n_q)
     else:
-        lower = 0
+        lower, upper = 0, n_q
     init = (
         jnp.zeros((block_k, d), jnp.float32),
         jnp.zeros((block_k, d), jnp.float32),
         jnp.zeros((1, block_k), jnp.float32),
     )
-    dk_acc, dv_acc, db_acc = jax.lax.fori_loop(lower, n_q, body, init)
+    dk_acc, dv_acc, db_acc = jax.lax.fori_loop(lower, upper, body, init)
     dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
     dbias_ref[0] = db_acc.astype(dbias_ref.dtype)
@@ -690,7 +736,7 @@ def _flash_dkv_loop_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
 
 def _flash_dq_loop2_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
                            lse_ref, dq_ref, *, scale, n_kv, causal,
-                           block_q, block_k):
+                           block_q, block_k, window: int = 0):
     """dq for one q block, D recomputed in-kernel from (dO, O) tiles —
     no lane-dim-1 dd operand (see FLASH_BWD_IMPL "loop2" note)."""
     iq = pl.program_id(1)
@@ -705,7 +751,7 @@ def _flash_dq_loop2_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
         vb = v_ref[0, pl.dslice(ik * block_k, block_k), :]
         bias_row = bias_ref[0, 0, 0, pl.dslice(ik * block_k, block_k)]
         p = _flash_bwd_scores(qb, kb, bias_row, lseb, scale, causal, iq, ik,
-                              block_q, block_k)
+                              block_q, block_k, window)
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -720,17 +766,22 @@ def _flash_dq_loop2_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
         upper = jnp.minimum(
             (iq * block_q + block_q - 1) // block_k + 1, n_kv
         )
+        # sliding window: kv blocks wholly older than every query's
+        # window contribute nothing
+        lower = (jnp.maximum(iq * block_q - (window - 1), 0) // block_k
+                 if window else 0)
     else:
-        upper = n_kv
+        upper, lower = n_kv, 0
     acc = jax.lax.fori_loop(
-        0, upper, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+        lower, upper, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
     )
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_loop2_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
                             lse_ref, dk_ref, dv_ref, dbias_ref,
-                            *, scale, n_q, causal, block_q, block_k):
+                            *, scale, n_q, causal, block_q, block_k,
+                            window: int = 0):
     """dk/dv/dbias for one kv block, D recomputed in-kernel per q tile
     from (dO, O) — no lane-dim-1 dd operand."""
     ik = pl.program_id(1)
@@ -748,7 +799,7 @@ def _flash_dkv_loop2_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
         ddb = (dob.astype(jnp.float32) * ob.astype(jnp.float32)).sum(
             axis=-1, keepdims=True)
         p = _flash_bwd_scores(qb, kb, bias_row, lseb, scale, causal, iq, ik,
-                              block_q, block_k)
+                              block_q, block_k, window)
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -767,14 +818,19 @@ def _flash_dkv_loop2_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
 
     if causal:
         lower = (ik * block_k) // block_q
+        # sliding window: q blocks wholly past this kv block's window
+        # (r >= c + window for every r, c) contribute nothing
+        upper = (jnp.minimum(
+            (ik * block_k + block_k - 1 + window - 1) // block_q + 1, n_q)
+            if window else n_q)
     else:
-        lower = 0
+        lower, upper = 0, n_q
     init = (
         jnp.zeros((block_k, d), jnp.float32),
         jnp.zeros((block_k, d), jnp.float32),
         jnp.zeros((1, block_k), jnp.float32),
     )
-    dk_acc, dv_acc, db_acc = jax.lax.fori_loop(lower, n_q, body, init)
+    dk_acc, dv_acc, db_acc = jax.lax.fori_loop(lower, upper, body, init)
     dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
     dbias_ref[0] = db_acc.astype(dbias_ref.dtype)
@@ -782,12 +838,13 @@ def _flash_dkv_loop2_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, o_ref,
 
 def _flash_backward_loop2(qf, kf, vf, bias, gf, of, lse, *, b, h, lq, lk, d,
                           scale, block_q, block_k, n_q, n_kv, causal,
-                          interpret, out_dtypes):
+                          interpret, out_dtypes, window: int = 0):
     """loop2 backward: grid over output blocks, D in-kernel from (dO, O)."""
     dq_dtype, dk_dtype, dv_dtype = out_dtypes
     dqf = pl.pallas_call(
         functools.partial(_flash_dq_loop2_kernel, scale=scale, n_kv=n_kv,
-                          causal=causal, block_q=block_q, block_k=block_k),
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(b * h, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
@@ -805,7 +862,8 @@ def _flash_backward_loop2(qf, kf, vf, bias, gf, of, lse, *, b, h, lq, lk, d,
 
     dkf, dvf, dbias_bh = pl.pallas_call(
         functools.partial(_flash_dkv_loop2_kernel, scale=scale, n_q=n_q,
-                          causal=causal, block_q=block_q, block_k=block_k),
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(b * h, n_kv),
         in_specs=[
             pl.BlockSpec((1, lq, d), lambda bh, ik: (bh, 0, 0)),
@@ -835,14 +893,15 @@ def _flash_backward_loop2(qf, kf, vf, bias, gf, of, lse, *, b, h, lq, lk, d,
 
 def _flash_backward_loop(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
                          scale, block_q, block_k, n_q, n_kv, causal,
-                         interpret, out_dtypes):
+                         interpret, out_dtypes, window: int = 0):
     """Loop-variant backward: grid over output blocks only; the full
     opposite-axis sequence is resident per kernel invocation (fine for the
     per-shard lengths context parallelism leaves on a chip)."""
     dq_dtype, dk_dtype, dv_dtype = out_dtypes
     dqf = pl.pallas_call(
         functools.partial(_flash_dq_loop_kernel, scale=scale, n_kv=n_kv,
-                          causal=causal, block_q=block_q, block_k=block_k),
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(b * h, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
@@ -860,7 +919,8 @@ def _flash_backward_loop(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
 
     dkf, dvf, dbias_bh = pl.pallas_call(
         functools.partial(_flash_dkv_loop_kernel, scale=scale, n_q=n_q,
-                          causal=causal, block_q=block_q, block_k=block_k),
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(b * h, n_kv),
         in_specs=[
             pl.BlockSpec((1, lq, d), lambda bh, ik: (bh, 0, 0)),
@@ -889,7 +949,7 @@ def _flash_backward_loop(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
 
 
 def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
-                    impl: str | None = None):
+                    impl: str | None = None, window: int = 0):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = 1.0 / (d**0.5)
@@ -913,6 +973,7 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
             qf, kf, vf, bias, gf, lse, _dd(), b=b, h=h, lq=lq, lk=lk, d=d,
             scale=scale, block_k=block_k, causal=causal,
             out_dtypes=(q.dtype, k.dtype, v.dtype), bias_dtype=bias.dtype,
+            window=window,
         )
         unfold = lambda t, L: t.reshape(b, h, L, d).transpose(0, 2, 1, 3)  # noqa: E731
         return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
@@ -922,7 +983,7 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
             qf, kf, vf, bias, gf, of, lse, b=b, h=h, lq=lq, lk=lk, d=d,
             scale=scale, block_q=block_q, block_k=block_k, n_q=n_q,
             n_kv=n_kv, causal=causal, interpret=interpret,
-            out_dtypes=(q.dtype, k.dtype, v.dtype),
+            out_dtypes=(q.dtype, k.dtype, v.dtype), window=window,
         )
         unfold = lambda t, L: t.reshape(b, h, L, d).transpose(0, 2, 1, 3)  # noqa: E731
         dbias = dbias_bh.reshape(b, h, 1, lk).sum(axis=1, keepdims=False)
@@ -934,7 +995,7 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
             qf, kf, vf, bias, gf, lse, _dd(), b=b, h=h, lq=lq, lk=lk, d=d,
             scale=scale, block_q=block_q, block_k=block_k, n_q=n_q,
             n_kv=n_kv, causal=causal, interpret=interpret,
-            out_dtypes=(q.dtype, k.dtype, v.dtype),
+            out_dtypes=(q.dtype, k.dtype, v.dtype), window=window,
         )
         unfold = lambda t, L: t.reshape(b, h, L, d).transpose(0, 2, 1, 3)  # noqa: E731
         dbias = dbias_bh.reshape(b, h, 1, lk).sum(axis=1, keepdims=False)
@@ -951,7 +1012,8 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
 
     dqf = pl.pallas_call(
         functools.partial(_flash_dq_kernel, scale=scale, n_kv=n_kv,
-                          causal=causal, block_q=block_q, block_k=block_k),
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(b * h, n_q, n_kv),
         in_specs=[qspec, kspec, kspec, bspec, qspec, rowspec, rowspec],
         out_specs=qspec,
@@ -969,7 +1031,8 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
     rowspec2 = pl.BlockSpec((1, block_q, 1), lambda bh, ik, iq: (bh, iq, 0))
     dkf, dvf, dbias_bh = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, scale=scale, n_q=n_q,
-                          causal=causal, block_q=block_q, block_k=block_k),
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          window=window),
         grid=(b * h, n_kv, n_q),
         in_specs=[qspec2, kspec2, kspec2, bspec2, qspec2, rowspec2, rowspec2],
         out_specs=[
@@ -995,31 +1058,33 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
     return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, bias, block_q, block_k, causal):
-    return _flash_forward(q, k, v, bias, block_q, block_k, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, block_q, block_k, causal, window):
+    return _flash_forward(q, k, v, bias, block_q, block_k, causal,
+                          window=window)
 
 
-def _flash_fwd(q, k, v, bias, block_q, block_k, causal):
+def _flash_fwd(q, k, v, bias, block_q, block_k, causal, window):
     # one source of truth for the fused-vs-fallback decision: the forward
     # itself — lse is None exactly when it took the blockwise fallback
     out, lse = _flash_forward(
-        q, k, v, bias, block_q, block_k, causal, want_lse=True
+        q, k, v, bias, block_q, block_k, causal, want_lse=True,
+        window=window,
     )
     return out, (q, k, v, bias, out if lse is not None else None, lse)
 
 
-def _flash_bwd(block_q, block_k, causal, residuals, g):
+def _flash_bwd(block_q, block_k, causal, window, residuals, g):
     q, k, v, bias, o, lse = residuals
     if lse is not None:
         # fused pallas backward: recompute probability tiles from the saved
         # logsumexp — no O(L²) residuals, no full forward replay
         return _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k,
-                               causal)
+                               causal, window=window)
     # ragged shapes fell back to blockwise in the forward: mirror it here
     _, vjp = jax.vjp(
         lambda q, k, v, bias: blockwise_attention(
-            q, k, v, bias, block_k, causal=causal
+            q, k, v, bias, block_k, causal=causal, window=window
         ),
         q, k, v, bias,
     )
@@ -1030,9 +1095,15 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
-                    block: int = 128, causal: bool = False):
+                    block: int = 128, causal: bool = False,
+                    window: int = 0):
     """Pallas flash attention (single device / per-shard). Fused pallas
-    forward AND backward; attention dropout unsupported."""
+    forward AND backward; attention dropout unsupported. window > 0
+    (requires causal) is the Mistral sliding window — whole KV blocks
+    outside the window are skipped in forward and backward, making the
+    attention cost O(L·window) instead of O(L²/2)."""
     if dropout_rate:
         raise NotImplementedError("attention dropout unsupported in flash path")
-    return _flash(q, k, v, bias, block, block, causal)
+    if window and not causal:
+        raise ValueError("attention window requires causal=True")
+    return _flash(q, k, v, bias, block, block, causal, window)

@@ -599,6 +599,23 @@ class TestSlidingWindow:
         np.testing.assert_allclose(np.asarray(logits[:, 2]),
                                    np.asarray(l2[:, 2]), atol=1e-5)
 
+    def test_flash_window_matches_dense_window(self):
+        """attention=flash honors the sliding window: same params, same
+        inputs, flash logits == dense logits (the kernel skips whole KV
+        blocks outside the window — O(L·W) long-context training)."""
+        kw = dict(dropout_rate=0.0, max_len=64, attention_window=6,
+                  position_embedding="rope", num_kv_heads=2)
+        dense = GPTLM(GPTConfig.tiny(**kw), pad_token_id=-1)
+        flash = GPTLM(GPTConfig.tiny(attention="flash", attention_block=8,
+                                     **kw), pad_token_id=-1)
+        ids = jax.random.randint(jax.random.PRNGKey(3), (2, 32), 1,
+                                 512, jnp.int32)
+        variables = dense.init(jax.random.PRNGKey(1), ids)
+        ld = dense.apply(variables, ids)
+        lf = flash.apply(variables, ids)
+        np.testing.assert_allclose(np.asarray(ld), np.asarray(lf),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_validation(self):
         with pytest.raises(ValueError, match="dense"):
             GPTConfig.tiny(attention_window=4, attention="ring")

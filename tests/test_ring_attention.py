@@ -225,10 +225,10 @@ class TestFlashFusedBackward:
         from kubeflow_tpu.parallel.ring_attention import _flash_fwd
 
         q, k, v, bias = self._qkvb()
-        _, res = _flash_fwd(q, k, v, bias, 8, 8, False)
+        _, res = _flash_fwd(q, k, v, bias, 8, 8, False, 0)
         assert res[5] is not None  # lse saved -> pallas bwd path
         # ragged shapes fall back to the recomputing path
-        _, res = _flash_fwd(q[:, :30], k, v, bias, 8, 8, False)
+        _, res = _flash_fwd(q[:, :30], k, v, bias, 8, 8, False, 0)
         assert res[5] is None
 
 
@@ -280,3 +280,94 @@ class TestFlashBackwardImpls:
         from kubeflow_tpu.parallel import ring_attention as ra
 
         assert ra.FLASH_BWD_IMPL == "xla"
+
+
+class TestSlidingWindowFlash:
+    """window > 0 (Mistral sliding window): flash fwd/bwd vs the dense
+    windowed reference across window/block geometries — window smaller
+    than a block, spanning blocks, and larger than the sequence (== plain
+    causal)."""
+
+    def _qkvbg(self, l=64):
+        import jax as _jax
+
+        ks = _jax.random.split(_jax.random.PRNGKey(3), 5)
+        q = _jax.random.normal(ks[0], (2, l, 4, 16), jnp.float32)
+        k = _jax.random.normal(ks[1], (2, l, 4, 16), jnp.float32)
+        v = _jax.random.normal(ks[2], (2, l, 4, 16), jnp.float32)
+        bias = _jax.random.normal(ks[3], (2, 1, 1, l), jnp.float32) * 0.3
+        g = _jax.random.normal(ks[4], (2, l, 4, 16), jnp.float32)
+        return q, k, v, bias, g
+
+    def _dense_ref(self, q, k, v, bias, window):
+        from kubeflow_tpu.models.gpt import causal_dense_attention
+
+        return causal_dense_attention(q, k, v, bias[:, :, :, :],
+                                      window=window)
+
+    @pytest.mark.parametrize("window,block", [
+        (5, 8),     # window inside a block
+        (12, 8),    # window spans blocks
+        (1, 8),     # degenerate: self-attention only
+        (999, 8),   # wider than the sequence == plain causal
+        (10, 16),
+    ])
+    def test_forward_matches_dense_window_reference(self, window, block):
+        from kubeflow_tpu.parallel.ring_attention import flash_attention
+
+        q, k, v, bias, _ = self._qkvbg()
+        got = flash_attention(q, k, v, bias, block=block, causal=True,
+                              window=window)
+        want = self._dense_ref(q, k, v, bias, window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("impl", ["xla", "loop", "loop2", "scratch"])
+    @pytest.mark.parametrize("window", [5, 12])
+    def test_all_backward_impls_match_dense_grads(self, impl, window):
+        from kubeflow_tpu.parallel import ring_attention as ra
+        from kubeflow_tpu.parallel.ring_attention import flash_attention
+
+        q, k, v, bias, g = self._qkvbg()
+
+        def loss_flash(q, k, v, bias):
+            return (flash_attention(q, k, v, bias, block=8, causal=True,
+                                    window=window) * g).sum()
+
+        def loss_dense(q, k, v, bias):
+            return (self._dense_ref(q, k, v, bias, window) * g).sum()
+
+        old = ra.FLASH_BWD_IMPL
+        try:
+            ra.FLASH_BWD_IMPL = impl
+            got = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        finally:
+            ra.FLASH_BWD_IMPL = old
+        want = jax.grad(loss_dense, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        for name, a, b in zip(("dq", "dk", "dv", "dbias"), got, want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-5, atol=3e-5,
+                err_msg=f"{impl}:{name}")
+
+    def test_window_requires_causal(self):
+        from kubeflow_tpu.parallel.ring_attention import (
+            blockwise_attention,
+            flash_attention,
+        )
+
+        q, k, v, bias, _ = self._qkvbg(l=16)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, bias, causal=False, window=4)
+        with pytest.raises(ValueError, match="causal"):
+            blockwise_attention(q, k, v, bias, causal=False, window=4)
+
+    def test_ragged_fallback_honors_window(self):
+        """Non-block-divisible lengths take the blockwise fallback, which
+        must apply the same window."""
+        from kubeflow_tpu.parallel.ring_attention import flash_attention
+
+        q, k, v, bias, _ = self._qkvbg(l=30)  # ragged vs block=8
+        got = flash_attention(q, k, v, bias, block=8, causal=True, window=7)
+        want = self._dense_ref(q, k, v, bias, 7)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
