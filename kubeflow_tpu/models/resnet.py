@@ -92,9 +92,12 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     small_inputs: bool = False
     # Conv lowering: "xla" = lax conv HLO, "im2col" = slices+matmul
-    # (models/conv.py — param-compatible), "auto" = im2col on the axon
-    # backend where conv HLOs run ~200x below matmul throughput
-    # (docs/perf.md), xla elsewhere.
+    # (models/conv.py — param-compatible), "auto" = im2col only when the
+    # backend registers as the legacy "axon" name. The r2 "convs run 200x
+    # below matmul" reading was per-dispatch-floor pollution: r3's fused
+    # device-born steps ran FASTER through lax.conv (docs/perf.md), and
+    # the live chip registers backend "tpu", so auto == xla there.
+    # probe_resnet.py carries the per-shape A/B that settles it for good.
     conv_impl: str = "auto"
 
     def _conv_cls(self) -> ModuleDef:

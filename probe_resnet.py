@@ -97,7 +97,10 @@ def main() -> None:
             _pet()
 
     # ---- A: conv-vs-GEMM twins at the dominant shapes --------------------
-    # (spatial, channels) per residual stage; 3x3 cin==cout chains cleanly
+    # (spatial, channels) per residual stage; 3x3 cin==cout chains cleanly.
+    # BOTH lowerings measured per shape: im2col (slices+matmul) AND the
+    # native lax.conv HLO — r3's fused-step evidence favored lax.conv on
+    # this backend (docs/perf.md), this probe settles it per-shape.
     shapes = [(56, 64), (28, 128), (14, 256), (7, 512)]
     if cpu:
         shapes = [(14, 32)]
@@ -110,7 +113,16 @@ def main() -> None:
             y = im2col_conv(c, k)
             return (y * 0.1 + c * 0.9).astype(c.dtype)  # chained, stable
 
-        timed_scan(conv_step, x, flops, f"conv3x3_{hw}x{hw}x{ch}")
+        timed_scan(conv_step, x, flops, f"conv3x3_im2col_{hw}x{hw}x{ch}")
+
+        def lax_step(c, k=k):
+            y = jax.lax.conv_general_dilated(
+                c, k, window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=c.dtype)
+            return (y * 0.1 + c * 0.9).astype(c.dtype)
+
+        timed_scan(lax_step, x, flops, f"conv3x3_laxconv_{hw}x{hw}x{ch}")
 
         m, kk = B * hw * hw, 9 * ch
         a = born((m, kk), key=hw + 2)
@@ -166,12 +178,14 @@ def main() -> None:
 
     timed_scan(s2d_step, xs, flops7, "stem_s2d_4x4s1")
 
-    # ---- C: full model fwd+bwd, batch sweep ------------------------------
+    # ---- C: full model fwd+bwd — batch sweep x conv lowering -------------
     from kubeflow_tpu.models import ResNet50
 
-    for bs in ((4,) if cpu else (128, 256)):
+    for bs, impl in ([(4, "xla")] if cpu
+                     else [(128, "xla"), (128, "im2col"), (256, "xla")]):
         img = 32 if cpu else 224
-        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                         conv_impl=impl)
         xb = born((bs, img, img, 3), key=60)
         yb = jnp.zeros((bs,), jnp.int32)
         variables = jax.jit(model.init)(jax.random.PRNGKey(0), xb)
@@ -197,7 +211,7 @@ def main() -> None:
             return jax.tree.map(lambda a, b: a - 1e-6 * b.astype(a.dtype),
                                 p, g)
 
-        timed_scan(train_probe, params, flops, f"resnet50_fwdbwd_b{bs}")
+        timed_scan(train_probe, params, flops, f"resnet50_{impl}_fwdbwd_b{bs}")
         _pet()
 
     print("RESULT probe_resnet=complete", flush=True)
