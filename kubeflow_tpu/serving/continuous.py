@@ -20,10 +20,13 @@ BETWEEN steps:
   - rows retire on EOS or their token budget; the slot readmits the next
     queued request without stalling the other rows
 
-Greedy-only (like speculative decoding): each row's output is EXACTLY
-generate()'s greedy decode for that prompt alone — per-row position
-masking keeps rows independent. (MoE models break that independence:
-capacity-limited dispatch couples rows; the engine refuses them.)
+Greedy rows are EXACTLY generate()'s greedy decode for that prompt alone —
+per-row position masking keeps rows independent. (MoE models break that
+independence: capacity-limited dispatch couples rows; the engine refuses
+them.) Sampling rows (per-request temperature, engine-level top_k) draw
+on-device via per-row keys folded from the request key and the row's step
+count — deterministic per key, and greedy/sampling rows mix freely in one
+batch.
 """
 
 from __future__ import annotations
@@ -41,6 +44,8 @@ class _InFlight:
     slot: int
     max_new_tokens: int
     eos_token_id: int | None
+    temperature: float = 0.0
+    key: object = None  # jax PRNG key for sampling rows
     tokens: list = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
 
@@ -62,7 +67,8 @@ class ContinuousBatcher:
 
     def __init__(self, module, variables, max_rows: int = 8,
                  default_max_new_tokens: int = 32,
-                 eos_token_id: int | None = None):
+                 eos_token_id: int | None = None, top_k: int = 0,
+                 seed: int = 0):
         cfg = module.cfg
         if getattr(cfg, "moe_experts", 0):
             raise ValueError(
@@ -75,6 +81,9 @@ class ContinuousBatcher:
         self.max_len = int(cfg.max_len)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.top_k = int(top_k)  # static: one decode executable
+        self._seed = int(seed)
+        self._submitted = 0
         self._lock = threading.Lock()
         self._queue: list[tuple[np.ndarray, _InFlight]] = []
         self._rows: list[_InFlight | None] = [None] * self.max_rows
@@ -99,12 +108,26 @@ class ContinuousBatcher:
             return jax.tree.map(leaf, big, row)
 
         self._splice = jax.jit(_splice)
+        top_k_ = self.top_k
 
-        def _step(cache_col, toks, active):
+        def _pick(logits, temps, keys):
+            """Per-row next token: argmax where temperature == 0, else a
+            categorical draw with that row's key (top_k is engine-static
+            so everything stays one executable)."""
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            if top_k_ > 0:
+                kth = jax.lax.top_k(scaled, top_k_)[0][..., -1:]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            sampled = jax.vmap(jax.random.categorical)(
+                keys, scaled).astype(jnp.int32)
+            return jnp.where(temps > 0, sampled, greedy)
+
+        def _step(cache_col, toks, active, temps, keys):
             logits, new_cache = module.apply(
                 {**variables, "cache": cache_col},
                 toks[:, None], decode=True, mutable=["cache"])
-            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            nxt = _pick(logits[:, 0].astype(jnp.float32), temps, keys)
             # free rows keep decoding garbage (their slot is overwritten
             # wholesale on admission) — but their index must not creep past
             # max_len, so park it at 0
@@ -119,10 +142,17 @@ class ContinuousBatcher:
 
         self._step = jax.jit(_step)
 
+        def _pick_first(logits, temp, key):
+            return _pick(logits[None].astype(jnp.float32),
+                         jnp.asarray([temp], jnp.float32), key[None])[0]
+
+        self._pick_first = jax.jit(_pick_first)
+
     # ---------------------------------------------------------------- API
 
     def submit(self, prompt_ids, max_new_tokens: int | None = None,
-               eos_token_id: int | None = None) -> _InFlight:
+               eos_token_id: int | None = None, temperature: float = 0.0,
+               key=None) -> _InFlight:
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         budget = int(max_new_tokens or self.default_max_new_tokens)
         if ids.size < 1:
@@ -131,10 +161,18 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt {ids.size} + max_new_tokens {budget} exceeds "
                 f"max_len {self.max_len}")
-        req = _InFlight(slot=-1, max_new_tokens=budget,
-                        eos_token_id=(self.eos_token_id if eos_token_id
-                                      is None else eos_token_id))
         with self._lock:
+            self._submitted += 1
+            if key is None:
+                # per-request key: engine seed folded with a monotonically
+                # advancing submit counter (same contract as the sampling
+                # predictor's per-request keys)
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(self._seed), self._submitted)
+            req = _InFlight(slot=-1, max_new_tokens=budget,
+                            eos_token_id=(self.eos_token_id if eos_token_id
+                                          is None else eos_token_id),
+                            temperature=float(temperature), key=key)
             self._queue.append((ids, req))
         return req
 
@@ -144,8 +182,7 @@ class ContinuousBatcher:
             def prefill(x):
                 logits, cache = self.module.apply(
                     self.variables, x, decode=True, mutable=["cache"])
-                first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                return first, cache["cache"]
+                return logits[:, -1], cache["cache"]
             fn = self._prefill_cache[ids.size] = jax.jit(prefill)
         return fn(ids[None, :])
 
@@ -164,13 +201,16 @@ class ContinuousBatcher:
                 if self._rows[slot] is not None or not self._queue:
                     continue
                 ids, req = self._queue.pop(0)
-                first, row_cache = self._prefill(ids)
+                last_logits, row_cache = self._prefill(ids)
                 self._cache = self._splice(
                     self._cache, row_cache, jnp.int32(slot))
+                first = self._pick_first(
+                    last_logits[0], req.temperature,
+                    jax.random.fold_in(req.key, 0))
                 req.slot = slot
-                req.tokens.append(int(first[0]))
+                req.tokens.append(int(first))
                 self._rows[slot] = req
-                self._toks[slot] = int(first[0])
+                self._toks[slot] = int(first)
                 # the prefill's first token may already finish the row
                 if self._finished(req):
                     self._retire(slot)
@@ -178,9 +218,17 @@ class ContinuousBatcher:
             if not active.any():
                 return bool(self._queue)
             # ---- one decode step for every in-flight row -----------------
+            zero = jax.random.PRNGKey(0)
+            temps = np.array(
+                [r.temperature if r is not None else 0.0
+                 for r in self._rows], np.float32)
+            keys = jnp.stack([
+                jax.random.fold_in(r.key, len(r.tokens))
+                if r is not None and r.temperature > 0 else zero
+                for r in self._rows])
             nxt, self._cache = self._step(
                 self._cache, jnp.asarray(self._toks),
-                jnp.asarray(active))
+                jnp.asarray(active), jnp.asarray(temps), keys)
             self.step_count += 1
             nxt = np.asarray(nxt)
             for slot, req in enumerate(self._rows):

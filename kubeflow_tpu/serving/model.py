@@ -325,14 +325,14 @@ class JaxModel(Model):
         if gen.get("continuous"):
             # continuous batching (serving/continuous.py): concurrent
             # requests interleave decode steps on one fixed-row engine
-            # instead of serializing whole decodes. Greedy-only, jit path
-            # (the engine's executables splice rows — not exportable as
-            # one fixed computation).
-            if float(gen.get("temperature", 0.0)) > 0.0 \
-                    or int(gen.get("num_beams", 1)) > 1:
+            # instead of serializing whole decodes. Greedy or sampling
+            # (per-request keys, engine-static top_k); jit path (the
+            # engine's executables splice rows — not exportable as one
+            # fixed computation).
+            if int(gen.get("num_beams", 1)) > 1:
                 raise ValueError(
-                    "generate config: continuous batching is greedy-only "
-                    "(temperature == 0, num_beams == 1)")
+                    "generate config: continuous batching does not "
+                    "compose with beam search (num_beams == 1)")
             from kubeflow_tpu.serving.continuous import ContinuousBatcher
 
             module, variables, self.config = load_generative_model(
@@ -343,6 +343,8 @@ class JaxModel(Model):
                 max_rows=int(gen.get("continuous_rows", 8)),
                 default_max_new_tokens=int(gen.get("max_new_tokens", 32)),
                 eos_token_id=None if eos is None else int(eos),
+                top_k=int(gen.get("top_k", 0)),
+                seed=int(gen.get("seed", 0)),
             ).start()
             self.ready = True
             return
@@ -394,7 +396,9 @@ class JaxModel(Model):
         if getattr(self, "_engine", None) is not None:
             budget = int(gen.get("max_new_tokens", 32))
             eos = gen.get("eos_token_id")
-            reqs = [self._engine.submit(row, max_new_tokens=budget)
+            temp = float(gen.get("temperature", 0.0))
+            reqs = [self._engine.submit(row, max_new_tokens=budget,
+                                        temperature=temp)
                     for row in x]
             outs = []
             for r in reqs:

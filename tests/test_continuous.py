@@ -144,7 +144,7 @@ class TestServingIntegration:
         finally:
             jm._engine.stop()
 
-    def test_continuous_rejects_sampling_config(self, tmp_path, lm):
+    def test_continuous_rejects_beam_config(self, tmp_path, lm):
         from kubeflow_tpu.serving.model import JaxModel, save_predictor
 
         model, variables = lm
@@ -152,11 +152,48 @@ class TestServingIntegration:
             tmp_path / "gpt-bad", "gpt-lm", dict(variables),
             np.zeros((1, 6), np.int32),
             generate={"max_new_tokens": 8, "continuous": True,
-                      "temperature": 0.7},
+                      "num_beams": 4},
             size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
         )
-        with pytest.raises(ValueError, match="greedy-only"):
+        with pytest.raises(ValueError, match="beam"):
             JaxModel("gpt-bad", d).load()
+
+
+class TestSampling:
+    def test_sampling_deterministic_per_key_and_mixes_with_greedy(self, lm):
+        """Sampling rows draw with per-request keys (same key -> same
+        output) while greedy rows in the SAME batch still match solo
+        greedy decode exactly."""
+        model, variables = lm
+        key = jax.random.PRNGKey(42)
+        p_greedy, p_sample = _prompt(50, 6), _prompt(51, 6)
+
+        def run():
+            eng = ContinuousBatcher(model, variables, max_rows=2, top_k=8)
+            rg = eng.submit(p_greedy, max_new_tokens=10)
+            rs = eng.submit(p_sample, max_new_tokens=10,
+                            temperature=0.8, key=key)
+            eng.run_until_idle()
+            return rg.result(timeout=1), rs.result(timeout=1)
+
+        g1, s1 = run()
+        g2, s2 = run()
+        want = np.asarray(generate(
+            model, variables, p_greedy[None, :], max_new_tokens=10))[0]
+        np.testing.assert_array_equal(g1, want)  # greedy row unaffected
+        np.testing.assert_array_equal(s1, s2)    # same key -> same draw
+        np.testing.assert_array_equal(g1, g2)
+
+    def test_different_keys_vary(self, lm):
+        model, variables = lm
+        eng = ContinuousBatcher(model, variables, max_rows=2, top_k=0,
+                                seed=7)
+        p = _prompt(52, 6)
+        reqs = [eng.submit(p, max_new_tokens=16, temperature=1.0)
+                for _ in range(4)]
+        eng.run_until_idle()
+        outs = {tuple(r.result(timeout=1).tolist()) for r in reqs}
+        assert len(outs) > 1  # auto-derived per-request keys differ
 
 
 class TestServingMode:
