@@ -432,8 +432,12 @@ def bench_gpt2s_continuous_serve(rows: int = 8, n_requests: int = 24,
     prompts = np.asarray(prompt_host)
     variables = jax.jit(model.init)(
         jax.random.PRNGKey(0), jnp.asarray(prompts[:1]))
+    # steps_per_tick amortizes the tunnel's ~14 ms dispatch floor over 8
+    # tokens/row per host round-trip (scheduling granularity stays
+    # iteration-level; see serving/continuous.py)
     eng = ContinuousBatcher(model, variables, max_rows=rows,
-                            default_max_new_tokens=new_tokens)
+                            default_max_new_tokens=new_tokens,
+                            steps_per_tick=8)
     # warmup: compile prefill + decode-step + splice once
     eng.submit(prompts[0], max_new_tokens=2)
     eng.run_until_idle()

@@ -68,7 +68,7 @@ class ContinuousBatcher:
     def __init__(self, module, variables, max_rows: int = 8,
                  default_max_new_tokens: int = 32,
                  eos_token_id: int | None = None, top_k: int = 0,
-                 seed: int = 0):
+                 seed: int = 0, steps_per_tick: int = 1):
         cfg = module.cfg
         if getattr(cfg, "moe_experts", 0):
             raise ValueError(
@@ -82,6 +82,12 @@ class ContinuousBatcher:
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_token_id = eos_token_id
         self.top_k = int(top_k)  # static: one decode executable
+        # decode steps per dispatch: scheduling stays iteration-level at
+        # granularity T, but T tokens amortize one host round-trip — the
+        # lever for dispatch-floored links (the axon tunnel's ~14 ms/step
+        # would otherwise cap aggregate throughput at rows/14ms regardless
+        # of chip speed). Rows retiring mid-scan just discard their tail.
+        self.steps_per_tick = max(1, int(steps_per_tick))
         self._seed = int(seed)
         self._submitted = 0
         self._lock = threading.Lock()
@@ -123,7 +129,9 @@ class ContinuousBatcher:
                 keys, scaled).astype(jnp.int32)
             return jnp.where(temps > 0, sampled, greedy)
 
-        def _step(cache_col, toks, active, temps, keys):
+        T = self.steps_per_tick
+
+        def _one(cache_col, toks, active, temps, keys):
             logits, new_cache = module.apply(
                 {**variables, "cache": cache_col},
                 toks[:, None], decode=True, mutable=["cache"])
@@ -136,9 +144,23 @@ class ContinuousBatcher:
                 if name in ("cache_index", "pos_index"):
                     return jnp.where(active, leaf, 0)
                 return leaf
-            new_cache = jax.tree_util.tree_map_with_path(
+            return nxt, jax.tree_util.tree_map_with_path(
                 park, new_cache["cache"])
-            return nxt, new_cache
+
+        def _step(cache_col, toks, active, temps, base_keys, starts):
+            """T chained decode steps in ONE dispatch; returns the (T, R)
+            emitted tokens. Rows that retire mid-scan decode on — their
+            tail is discarded on the host (iteration-level scheduling at
+            granularity T)."""
+            def body(carry, j):
+                cache_col, toks = carry
+                keys = jax.vmap(jax.random.fold_in)(base_keys, starts + j)
+                nxt, cache_col = _one(cache_col, toks, active, temps, keys)
+                return (cache_col, nxt), nxt
+
+            (cache_col, _), out = jax.lax.scan(
+                body, (cache_col, toks), jnp.arange(T))
+            return out, cache_col
 
         self._step = jax.jit(_step)
 
@@ -217,27 +239,32 @@ class ContinuousBatcher:
             active = np.array([r is not None for r in self._rows])
             if not active.any():
                 return bool(self._queue)
-            # ---- one decode step for every in-flight row -----------------
+            # ---- T decode steps for every in-flight row ------------------
             zero = jax.random.PRNGKey(0)
             temps = np.array(
                 [r.temperature if r is not None else 0.0
                  for r in self._rows], np.float32)
-            keys = jnp.stack([
-                jax.random.fold_in(r.key, len(r.tokens))
-                if r is not None and r.temperature > 0 else zero
+            base_keys = jnp.stack([
+                r.key if r is not None and r.temperature > 0 else zero
                 for r in self._rows])
-            nxt, self._cache = self._step(
+            starts = np.array(
+                [len(r.tokens) if r is not None else 0
+                 for r in self._rows], np.int32)
+            out, self._cache = self._step(
                 self._cache, jnp.asarray(self._toks),
-                jnp.asarray(active), jnp.asarray(temps), keys)
-            self.step_count += 1
-            nxt = np.asarray(nxt)
+                jnp.asarray(active), jnp.asarray(temps), base_keys,
+                jnp.asarray(starts))
+            self.step_count += 1  # dispatches (the scheduling metric)
+            out = np.asarray(out)  # (T, R)
             for slot, req in enumerate(self._rows):
                 if req is None:
                     continue
-                req.tokens.append(int(nxt[slot]))
-                self._toks[slot] = int(nxt[slot])
-                if self._finished(req):
-                    self._retire(slot)
+                for j in range(out.shape[0]):
+                    req.tokens.append(int(out[j, slot]))
+                    self._toks[slot] = int(out[j, slot])
+                    if self._finished(req):
+                        self._retire(slot)  # discard the scan tail
+                        break
             return bool(self._queue) or any(
                 r is not None for r in self._rows)
 

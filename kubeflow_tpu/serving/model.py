@@ -345,6 +345,7 @@ class JaxModel(Model):
                 eos_token_id=None if eos is None else int(eos),
                 top_k=int(gen.get("top_k", 0)),
                 seed=int(gen.get("seed", 0)),
+                steps_per_tick=int(gen.get("continuous_steps_per_tick", 1)),
             ).start()
             self.ready = True
             return

@@ -105,6 +105,49 @@ class TestScheduling:
         assert eng.step_count <= 26  # 23 (long) + admission slack
 
 
+class TestMultiStepTicks:
+    def test_exactness_and_dispatch_amortization(self, lm):
+        """steps_per_tick=4: outputs stay EXACTLY solo greedy decode
+        (mid-scan retirement discards the tail) while dispatches shrink
+        ~4x — the lever for dispatch-floored links (axon tunnel)."""
+        model, variables = lm
+        eng = ContinuousBatcher(model, variables, max_rows=2,
+                                steps_per_tick=4)
+        jobs = []
+        for seed, plen, budget in ((60, 4, 13), (61, 7, 6), (62, 5, 21),
+                                   (63, 6, 10)):
+            p = _prompt(seed, plen)
+            jobs.append((p, budget, eng.submit(p, max_new_tokens=budget)))
+        eng.run_until_idle()
+        for p, budget, req in jobs:
+            want = np.asarray(generate(
+                model, variables, p[None, :], max_new_tokens=budget))[0]
+            np.testing.assert_array_equal(req.result(timeout=1), want)
+        eng1 = ContinuousBatcher(model, variables, max_rows=2)
+        for seed, plen, budget in ((60, 4, 13), (61, 7, 6), (62, 5, 21),
+                                   (63, 6, 10)):
+            eng1.submit(_prompt(seed, plen), max_new_tokens=budget)
+        eng1.run_until_idle()
+        assert eng.step_count * 2 < eng1.step_count
+
+    def test_sampling_keys_consistent_across_tick_sizes(self, lm):
+        """The per-step key schedule is position-based, so the SAME request
+        key yields the SAME sampled sequence whether ticks carry 1 or 4
+        steps."""
+        model, variables = lm
+        key = jax.random.PRNGKey(9)
+        p = _prompt(64, 5)
+        outs = []
+        for t in (1, 4):
+            eng = ContinuousBatcher(model, variables, max_rows=2,
+                                    steps_per_tick=t, top_k=8)
+            req = eng.submit(p, max_new_tokens=12, temperature=0.9,
+                             key=key)
+            eng.run_until_idle()
+            outs.append(req.result(timeout=1))
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+
 class TestServingIntegration:
     def test_gpt_lm_predictor_with_continuous_engine(self, tmp_path, lm):
         """generate config {continuous: true} routes the gpt-lm predictor
