@@ -139,6 +139,56 @@ def main() -> None:
             traceback.print_exc(file=sys.stderr)
             _pet()
 
+    # ---------------- A2: sliding-window kernels on Mosaic ---------------
+    # window=256 at the same production shape: fwd + loop2/xla backwards
+    # vs the blockwise windowed reference (the r4 O(L·W) kernels are
+    # interpret-validated only until this line records PASS)
+    try:
+        win = 64 if interpret else 256
+
+        def loss_wref(q, k, v, bias):
+            return (blockwise_attention(q, k, v, bias, block=256,
+                                        causal=True, window=win
+                                        ).astype(jnp.float32)
+                    * ct.astype(jnp.float32)).sum()
+
+        wref = jax.jit(jax.grad(loss_wref, argnums=(0, 1, 2, 3)))(
+            q, k, v, bias)
+        wout, wlse = jax.jit(
+            lambda q, k, v, bias: _flash_forward(
+                q, k, v, bias, 256, 256, True, want_lse=True, window=win)
+        )(q, k, v, bias)
+        ref_out = jax.jit(
+            lambda q, k, v, bias: blockwise_attention(
+                q, k, v, bias, block=256, causal=True, window=win)
+        )(q, k, v, bias)
+        fwd_err = float(jnp.max(jnp.abs(
+            wout.astype(jnp.float32) - ref_out.astype(jnp.float32))))
+        print(f"RESULT swa_fwd={'PASS' if fwd_err < 0.02 else 'FAIL'} "
+              f"err={fwd_err:.4g} window={win}", flush=True)
+        _pet()
+        for impl in ("loop2", "xla"):
+            try:
+                got = jax.jit(
+                    lambda q, k, v, bias, out, lse, g, i=impl:
+                    _flash_backward(q, k, v, bias, out, lse, g, 256, 256,
+                                    True, impl=i, window=win)
+                )(q, k, v, bias, wout, wlse, ct)
+                errs = [float(jnp.max(jnp.abs(
+                    a.astype(jnp.float32) - r.astype(jnp.float32))))
+                    for a, r in zip(got, wref)]
+                ok = max(errs[:3]) < 0.25 and errs[3] < 2.0
+                print(f"RESULT swa_{impl}={'PASS' if ok else 'FAIL'} "
+                      f"dq={errs[0]:.4g} dk={errs[1]:.4g} dv={errs[2]:.4g} "
+                      f"dbias={errs[3]:.4g}", flush=True)
+            except Exception as exc:  # noqa: BLE001
+                print(f"RESULT swa_{impl}=ERROR {type(exc).__name__}",
+                      flush=True)
+            _pet()
+    except Exception as exc:  # noqa: BLE001
+        print(f"RESULT swa_setup=ERROR {type(exc).__name__}", flush=True)
+        _pet()
+
     # ---------------- B/C: term bisect, host-fed then device-fed ---------
     block = 128 if interpret else 256
     dd_ = 64
