@@ -572,7 +572,13 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
 # window can validate the fix AND benchmark through it.
 import os as _os  # noqa: E402
 
+_FLASH_BWD_IMPLS = ("xla", "loop2", "loop", "scratch")
 FLASH_BWD_IMPL = _os.environ.get("KFT_FLASH_BWD_IMPL", "xla")
+if FLASH_BWD_IMPL not in _FLASH_BWD_IMPLS:
+    raise ValueError(
+        f"KFT_FLASH_BWD_IMPL={FLASH_BWD_IMPL!r} is not one of "
+        f"{_FLASH_BWD_IMPLS} — refusing to fall through to an arbitrary "
+        "backward (the scratch kernels NaN on Mosaic)")
 
 
 def _flash_backward_xla(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
@@ -1002,6 +1008,10 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
         dbias = dbias[:, None, :, :].astype(bias.dtype)  # (B, 1, 1, Lk)
         return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
 
+    if (impl or FLASH_BWD_IMPL) != "scratch":
+        raise ValueError(
+            f"unknown flash backward impl {(impl or FLASH_BWD_IMPL)!r} "
+            f"(one of {_FLASH_BWD_IMPLS})")
     dd = _dd()
     qspec = pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0))

@@ -215,58 +215,67 @@ class ContinuousBatcher:
 
     def tick(self) -> bool:
         """One scheduling round: admit queued prompts into free rows, then
-        advance every in-flight row one token. Returns True if any work
-        remains."""
-        with self._lock:
-            # ---- admission: prefill into free rows -----------------------
-            for slot in range(self.max_rows):
-                if self._rows[slot] is not None or not self._queue:
-                    continue
+        advance every in-flight row steps_per_tick tokens. Returns True if
+        any work remains.
+
+        Locking: tick() is single-ticker by contract (run_until_idle OR
+        the serving thread); rows/cache/toks are ticker-private. The lock
+        guards ONLY the shared queue, so submit() from request threads
+        never waits behind device dispatches."""
+        # ---- admission: prefill into free rows ---------------------------
+        for slot in range(self.max_rows):
+            if self._rows[slot] is not None:
+                continue
+            with self._lock:
+                if not self._queue:
+                    break
                 ids, req = self._queue.pop(0)
-                last_logits, row_cache = self._prefill(ids)
-                self._cache = self._splice(
-                    self._cache, row_cache, jnp.int32(slot))
-                first = self._pick_first(
-                    last_logits[0], req.temperature,
-                    jax.random.fold_in(req.key, 0))
-                req.slot = slot
-                req.tokens.append(int(first))
-                self._rows[slot] = req
-                self._toks[slot] = int(first)
-                # the prefill's first token may already finish the row
-                if self._finished(req):
-                    self._retire(slot)
-            active = np.array([r is not None for r in self._rows])
-            if not active.any():
+            last_logits, row_cache = self._prefill(ids)
+            self._cache = self._splice(
+                self._cache, row_cache, jnp.int32(slot))
+            first = self._pick_first(
+                last_logits[0], req.temperature,
+                jax.random.fold_in(req.key, 0))
+            req.slot = slot
+            req.tokens.append(int(first))
+            self._rows[slot] = req
+            self._toks[slot] = int(first)
+            # the prefill's first token may already finish the row
+            if self._finished(req):
+                self._retire(slot)
+        active = np.array([r is not None for r in self._rows])
+        if not active.any():
+            with self._lock:
                 return bool(self._queue)
-            # ---- T decode steps for every in-flight row ------------------
-            zero = jax.random.PRNGKey(0)
-            temps = np.array(
-                [r.temperature if r is not None else 0.0
-                 for r in self._rows], np.float32)
-            base_keys = jnp.stack([
-                r.key if r is not None and r.temperature > 0 else zero
-                for r in self._rows])
-            starts = np.array(
-                [len(r.tokens) if r is not None else 0
-                 for r in self._rows], np.int32)
-            out, self._cache = self._step(
-                self._cache, jnp.asarray(self._toks),
-                jnp.asarray(active), jnp.asarray(temps), base_keys,
-                jnp.asarray(starts))
-            self.step_count += 1  # dispatches (the scheduling metric)
-            out = np.asarray(out)  # (T, R)
-            for slot, req in enumerate(self._rows):
-                if req is None:
-                    continue
-                for j in range(out.shape[0]):
-                    req.tokens.append(int(out[j, slot]))
-                    self._toks[slot] = int(out[j, slot])
-                    if self._finished(req):
-                        self._retire(slot)  # discard the scan tail
-                        break
-            return bool(self._queue) or any(
-                r is not None for r in self._rows)
+        # ---- T decode steps for every in-flight row ----------------------
+        zero = jax.random.PRNGKey(0)
+        temps = np.array(
+            [r.temperature if r is not None else 0.0
+             for r in self._rows], np.float32)
+        base_keys = jnp.stack([
+            r.key if r is not None and r.temperature > 0 else zero
+            for r in self._rows])
+        starts = np.array(
+            [len(r.tokens) if r is not None else 0
+             for r in self._rows], np.int32)
+        out, self._cache = self._step(
+            self._cache, jnp.asarray(self._toks),
+            jnp.asarray(active), jnp.asarray(temps), base_keys,
+            jnp.asarray(starts))
+        self.step_count += 1  # dispatches (the scheduling metric)
+        out = np.asarray(out)  # (T, R)
+        for slot, req in enumerate(self._rows):
+            if req is None:
+                continue
+            for j in range(out.shape[0]):
+                req.tokens.append(int(out[j, slot]))
+                self._toks[slot] = int(out[j, slot])
+                if self._finished(req):
+                    self._retire(slot)  # discard the scan tail
+                    break
+        with self._lock:
+            pending = bool(self._queue)
+        return pending or any(r is not None for r in self._rows)
 
     @staticmethod
     def _finished(req: _InFlight) -> bool:

@@ -322,13 +322,14 @@ def render_trial_spec(template: TrialTemplate, assignments: dict[str, str],
     out = template.trial_spec
     inactive = (inactive_parameters(parameters, assignments)
                 if parameters is not None else set())
-    dead_tokens = []
+    dead_tokens, live_tokens = [], []
     for tp in template.trial_parameters:
         ref = tp.reference or tp.name
         token = "${trialParameters." + tp.name + "}"
         if ref in inactive:
             dead_tokens.append(token)
             continue
+        live_tokens.append(token)
         value = assignments.get(ref)
         if value is None:
             raise ValueError(
@@ -337,6 +338,19 @@ def render_trial_spec(template: TrialTemplate, assignments: dict[str, str],
             )
         out = out.replace(token, value)
     if dead_tokens:
+        # a line mixing an inactive placeholder with an ACTIVE one has no
+        # safe rendering (dropping it loses the active substitution;
+        # keeping it leaves a raw placeholder) — template authors must put
+        # conditional flags on their own line, enforced loudly. Live
+        # placeholders are already substituted in `out`, so detect the mix
+        # on the ORIGINAL template's lines.
+        for line in template.trial_spec.split("\n"):
+            if (any(t in line for t in dead_tokens)
+                    and any(t in line for t in live_tokens)):
+                raise ValueError(
+                    "conditional parameter placeholder shares a template "
+                    f"line with an active one: {line.strip()!r} — put "
+                    "conditional flags/envs on their own line")
         kept = [line for line in out.split("\n")
                 if not any(t in line for t in dead_tokens)]
         out = "\n".join(kept)

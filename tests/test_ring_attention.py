@@ -281,6 +281,34 @@ class TestFlashBackwardImpls:
 
         assert ra.FLASH_BWD_IMPL == "xla"
 
+    def test_unknown_impl_fails_fast(self):
+        """A typo'd impl (or env override) must raise, not fall through to
+        the scratch kernels that NaN on Mosaic."""
+        import subprocess
+        import sys
+
+        from kubeflow_tpu.parallel.ring_attention import (
+            _flash_backward,
+            _flash_forward,
+        )
+
+        q, k, v, bias, g = (x.astype(jnp.float32) for x in self._qkvb())
+        out, lse = _flash_forward(q, k, v, bias, 8, 8, False, want_lse=True)
+        with pytest.raises(ValueError, match="unknown flash backward"):
+            _flash_backward(q, k, v, bias, out, lse, g, 8, 8, False,
+                            impl="Loop2")
+        # the env override is validated at import
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import kubeflow_tpu.parallel.ring_attention"],
+            capture_output=True, text=True, timeout=240,
+            env={"KFT_FLASH_BWD_IMPL": "loop3", "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": ".", "PATH": "/usr/bin:/bin",
+                 "HOME": "/root"},
+        )
+        assert proc.returncode != 0
+        assert "KFT_FLASH_BWD_IMPL" in proc.stderr
+
 
 class TestSlidingWindowFlash:
     """window > 0 (Mistral sliding window): flash fwd/bwd vs the dense

@@ -115,6 +115,28 @@ class TestConditionalSpace:
             parameters=self.PARAMS)
         assert "--moe-experts=4" in on
 
+    def test_render_rejects_mixed_active_inactive_line(self):
+        """A line carrying BOTH an active and an inactive placeholder has
+        no safe rendering — render must refuse loudly, not silently drop
+        the active substitution."""
+        tpl = TrialTemplate(
+            trial_spec=("command:\n"
+                        "  - train --use-moe=${trialParameters.um} "
+                        "--moe-experts=${trialParameters.me}\n"),
+            trial_parameters=[
+                TrialParameterSpec(name="um", reference="use_moe"),
+                TrialParameterSpec(name="me", reference="moe_experts"),
+            ])
+        with pytest.raises(ValueError, match="own line"):
+            render_trial_spec(
+                tpl, {"use_moe": "false", "moe_experts": "4"},
+                parameters=self.PARAMS)
+        # ACTIVE trials render the same template fine
+        ok = render_trial_spec(
+            tpl, {"use_moe": "true", "moe_experts": "4"},
+            parameters=self.PARAMS)
+        assert "--use-moe=true --moe-experts=4" in ok
+
     def test_validation(self):
         def mk(params, objective=None):
             return Experiment(
