@@ -111,11 +111,10 @@ class GPTConfig:
                 raise ValueError(
                     f"attention_window {self.attention_window} must be "
                     ">= 1 (or 0 for full causal)")
-            if self.attention not in ("dense", "flash"):
+            if self.attention not in ("dense", "flash", "ring", "ulysses"):
                 raise ValueError(
-                    "attention_window is wired for the dense, flash, and "
-                    "decode paths (ring/ulysses reject a window — their "
-                    f"ring masking is global; got {self.attention!r})")
+                    "attention_window composes with dense/flash/ring/"
+                    f"ulysses + decode (got attention={self.attention!r})")
         if self.moe_experts and self.moe_top_k > self.moe_experts:
             raise ValueError(
                 f"moe_top_k {self.moe_top_k} > moe_experts "
@@ -207,7 +206,7 @@ class CausalSelfAttention(nn.Module):
             else:
                 attn_fn = _resolve_attention(c.attention)
                 kw = ({"rope_theta": c.rope_theta} if rope_inside else {})
-                if c.attention == "flash" and c.attention_window:
+                if c.attention_window:
                     kw["window"] = c.attention_window
                 y = attn_fn(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
                             block=c.attention_block, causal=True, **kw)

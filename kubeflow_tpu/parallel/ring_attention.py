@@ -170,9 +170,23 @@ def _rope_qk(q, k, pos, theta):
     return apply_rope(q, pos, theta), apply_rope(k, pos, theta)
 
 
+def _ring_hops(ring: int, l_loc: int, window: int) -> int:
+    """Ring steps that can contribute under a causal sliding window.
+
+    Query shard i needs KV from source shards [i - h, i] where the oldest
+    key any of its queries can see is global position i·l_loc − window + 1
+    (query p = 0). Source shard at hop s is (i − s) mod ring, so the
+    largest useful hop is ceil(window / l_loc) — uniform across shards
+    (SPMD-safe: window, l_loc, ring are all static)."""
+    if not window:
+        return ring
+    return min(ring, -(-window // l_loc) + 1)
+
+
 def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
                    block: int = 256, axis_name: str = AXIS_CONTEXT,
-                   causal: bool = False, rope_theta: float | None = None):
+                   causal: bool = False, rope_theta: float | None = None,
+                   window: int = 0):
     """Ring attention over the `context` mesh axis.
 
     Inside: per-device online-softmax accumulation against the local KV
@@ -185,14 +199,25 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
     [i·L_loc, (i+1)·L_loc); the KV block at ring step s originated on shard
     (i - s) mod ring, so its positions are reconstructed per step — the
     hard part of causal ring attention (SURVEY.md §7 hard-part 2).
+
+    window > 0 (requires causal) is the Mistral sliding window — and on
+    the ring it is a COMMUNICATION win, not just masking: hops past
+    ceil(window/L_loc) carry only keys every local query has already
+    out-scrolled, so the ring runs min(ring, ceil(window/L_loc)+1) steps
+    instead of ring_size. At 32k context over an 8-shard ring with a 4k
+    window that is 2 hops instead of 8 — both the ppermute traffic and
+    the score matmuls drop ~4x.
     """
     if dropout_rate:
         raise NotImplementedError("attention dropout unsupported in ring path")
+    if window and not causal:
+        raise ValueError("attention window requires causal=True")
     ctx = _context_size()
     if ctx == 1:
         if rope_theta is not None:
             q, k = _rope_qk(q, k, jnp.arange(q.shape[1]), rope_theta)
-        return blockwise_attention(q, k, v, bias, block, causal=causal)
+        return blockwise_attention(q, k, v, bias, block, causal=causal,
+                                   window=window)
 
     scale = 1.0 / (q.shape[-1] ** 0.5)
 
@@ -211,24 +236,27 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
             # invariant the KV cache keeps by storing rotated keys)
             q, k = _rope_qk(q, k, pos, rope_theta)
         q_pos = pos if causal else None
+        hops = _ring_hops(ring, l_loc, window) if causal else ring
 
         def step(i, carry_kv):
             carry, kv = carry_kv
             if causal:
                 src = (idx - i) % ring  # shard this KV block originated on
                 k_pos = src * l_loc + jnp.arange(l_loc)
-                carry = _online_block(carry, kv, q, scale, q_pos, k_pos)
+                carry = _online_block(carry, kv, q, scale, q_pos, k_pos,
+                                      window=window)
             else:
                 carry = _online_block(carry, kv, q, scale)
             # rotate KV (+ its bias slice) one hop; unconditional so the
             # collective never sits inside data-dependent control flow (the
-            # final rotation just restores original placement). XLA overlaps
-            # the ppermute with the next iteration's matmuls.
+            # final rotation restores placement on a full ring; a window-
+            # shortened ring just stops — the kv copy is consumed). XLA
+            # overlaps the ppermute with the next iteration's matmuls.
             kv = jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
             return (carry, kv)
 
         carry, _ = jax.lax.fori_loop(
-            0, ring, step, (_init_carry(q), (k, v, bias))
+            0, hops, step, (_init_carry(q), (k, v, bias))
         )
         return _finalize(*carry, q.dtype)
 
@@ -245,21 +273,26 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
 
 def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
                       block: int = 256, axis_name: str = AXIS_CONTEXT,
-                      causal: bool = False, rope_theta: float | None = None):
+                      causal: bool = False, rope_theta: float | None = None,
+                      window: int = 0):
     """Ulysses context parallelism: all-to-all seq<->head re-shard.
 
     Each device exchanges its sequence shard for a head shard (one all-to-all
     over ICI), runs full-sequence blockwise attention on its heads, and
     scatters back. Cheaper than ring when heads >= ring size and sequence
-    fits after the exchange.
+    fits after the exchange. window > 0 (requires causal) applies the
+    Mistral sliding window in the local full-sequence attention.
     """
     if dropout_rate:
         raise NotImplementedError("attention dropout unsupported in ulysses path")
+    if window and not causal:
+        raise ValueError("attention window requires causal=True")
     ctx = _context_size()
     if ctx == 1:
         if rope_theta is not None:
             q, k = _rope_qk(q, k, jnp.arange(q.shape[1]), rope_theta)
-        return blockwise_attention(q, k, v, bias, block, causal=causal)
+        return blockwise_attention(q, k, v, bias, block, causal=causal,
+                                   window=window)
     mesh = jax.sharding.get_abstract_mesh()
     model = mesh.shape.get(AXIS_MODEL, 1)
     heads = q.shape[2]
@@ -284,7 +317,8 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
         # and rope rotation is the ordinary global arange
         if rope_theta is not None:
             qg, kg = _rope_qk(qg, kg, jnp.arange(qg.shape[1]), rope_theta)
-        o = blockwise_attention(qg, kg, vg, bias_g, block, causal=causal)
+        o = blockwise_attention(qg, kg, vg, bias_g, block, causal=causal,
+                                window=window)
         return jax.lax.all_to_all(
             o, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
         )

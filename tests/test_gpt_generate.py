@@ -617,8 +617,9 @@ class TestSlidingWindow:
                                    rtol=2e-4, atol=2e-4)
 
     def test_validation(self):
-        with pytest.raises(ValueError, match="dense"):
-            GPTConfig.tiny(attention_window=4, attention="ring")
+        # every training attention kind composes with a window now
+        for kind in ("dense", "flash", "ring", "ulysses"):
+            GPTConfig.tiny(attention_window=4, attention=kind)
         with pytest.raises(ValueError, match=">= 1"):
             GPTConfig.tiny(attention_window=-2)
 

@@ -389,6 +389,58 @@ class TestSlidingWindowFlash:
         with pytest.raises(ValueError, match="causal"):
             blockwise_attention(q, k, v, bias, causal=False, window=4)
 
+    @pytest.mark.parametrize("attn", [ring_attention, ulysses_attention])
+    @pytest.mark.parametrize("window", [5, 20, 40])
+    def test_context_parallel_window_matches_dense(self, attn, window):
+        """Ring/Ulysses sliding window vs the dense windowed reference on a
+        4-shard context mesh — windows inside one shard (16 local), across
+        shards, and spanning most of the sequence. On the ring a static
+        window also SHORTENS the ring (fewer ppermute hops)."""
+        from kubeflow_tpu.models.gpt import causal_dense_attention
+
+        q, k, v, bias, _ = self._qkvbg()
+        want = causal_dense_attention(q, k, v, bias, window=window)
+        mesh = build_mesh(MeshConfig(data=2, context=4))
+        with jax.set_mesh(mesh):
+            got = jax.jit(functools.partial(
+                attn, causal=True, window=window))(q, k, v, bias)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_ring_window_grads_match_dense(self):
+        from kubeflow_tpu.models.gpt import causal_dense_attention
+
+        q, k, v, bias, g = self._qkvbg()
+
+        def loss_ref(q, k, v, bias):
+            return (causal_dense_attention(q, k, v, bias, window=10)
+                    * g).sum()
+
+        want = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        mesh = build_mesh(MeshConfig(data=2, context=4))
+        with jax.set_mesh(mesh):
+
+            def loss_ring(q, k, v, bias):
+                return (ring_attention(q, k, v, bias, causal=True,
+                                       window=10) * g).sum()
+
+            got = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2, 3)))(
+                q, k, v, bias)
+        for name, a, b in zip(("dq", "dk", "dv", "dbias"), got, want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+                err_msg=name)
+
+    def test_ring_hop_count_shrinks_with_window(self):
+        from kubeflow_tpu.parallel.ring_attention import _ring_hops
+
+        assert _ring_hops(8, 4096, 0) == 8        # no window: full ring
+        assert _ring_hops(8, 4096, 4096) == 2     # one-shard window
+        assert _ring_hops(8, 4096, 8192) == 3
+        assert _ring_hops(8, 4096, 100) == 2      # sub-shard window
+        assert _ring_hops(8, 4096, 10**9) == 8    # huge window: capped
+        assert _ring_hops(4, 16, 16 * 3) == 4     # == ring
+
     def test_ragged_fallback_honors_window(self):
         """Non-block-divisible lengths take the blockwise fallback, which
         must apply the same window."""
