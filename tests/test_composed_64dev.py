@@ -29,7 +29,7 @@ from kubeflow_tpu.train.data import synthetic_lm_dataset
 
 cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=64, attention="ring",
                      attention_block=8, position_embedding="rope",
-                     num_kv_heads=2, moe_experts=4)
+                     num_kv_heads=2, moe_experts=4, attention_window=12)
 mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, context=2,
                              expert=2, pipeline=2))
 assert all(v >= 2 for v in mesh.shape.values()), dict(mesh.shape)

@@ -177,6 +177,17 @@ class TestSerde:
         again = isvc_from_yaml(isvc_to_yaml(isvc))
         assert isvc_to_yaml(again) == isvc_to_yaml(isvc)
 
+    def test_gptlm_sample_roundtrip(self):
+        from kubeflow_tpu.serving.serde import isvc_from_yaml, isvc_to_yaml
+
+        text = Path("samples/inferenceservice_gptlm.yaml").read_text()
+        isvc = isvc_from_yaml(text)
+        validate_isvc(isvc)
+        assert isvc.metadata.name == "gpt-lm-server"
+        assert isvc.spec.autoscaling.min_replicas == 0  # scale-to-zero
+        again = isvc_from_yaml(isvc_to_yaml(isvc))
+        assert isvc_to_yaml(again) == isvc_to_yaml(isvc)
+
 
 @pytest.fixture()
 def platform(tmp_path):
