@@ -534,6 +534,7 @@ def cmd_import_gpt2(args) -> int:
             max_new_tokens=args.max_new_tokens, max_len=args.max_len,
             prompt_len=args.prompt_len,
             vocab_json=args.vocab_json, merges_txt=args.merges_txt,
+            continuous_rows=args.continuous_rows,
         )
     except (OSError, KeyError, ValueError) as exc:
         print(f"import error: {exc}", file=sys.stderr)
@@ -650,6 +651,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="HF vocab.json — with --merges-txt, bundles the "
                         "checkpoint's byte-level BPE as tokenizer.json")
     p.add_argument("--merges-txt", default=None)
+    p.add_argument("--continuous-rows", type=int, default=0,
+                   help="serve through the continuous-batching engine "
+                        "with this many decode rows (0 = plain decode)")
     p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
 
     p = add("import-bert", cmd_import_bert,

@@ -350,7 +350,8 @@ def import_gpt2(checkpoint_path: str, out_dir: str,
                 num_heads: int | None = None,
                 max_new_tokens: int = 32, max_len: int | None = None,
                 prompt_len: int = 16, vocab_json: str | None = None,
-                merges_txt: str | None = None) -> str:
+                merges_txt: str | None = None,
+                continuous_rows: int = 0) -> str:
     """torch .pt/.bin GPT-2 checkpoint -> serving-ready gpt-lm predictor
     dir. Every dimension except the head count is read off the tensors;
     ``num_heads`` must come from the caller or a 'config' entry in the
@@ -406,6 +407,12 @@ def import_gpt2(checkpoint_path: str, out_dir: str,
     variables = torch_gpt2_to_variables(sd, cfg)
     example = np.zeros((1, prompt_len), np.int32)
     gen_cfg = {"max_new_tokens": max_new_tokens, "pad_token_id": -1}
+    if continuous_rows:
+        # serve through the continuous-batching engine (iteration-level
+        # scheduling, serving/continuous.py): the imported checkpoint is
+        # production-serving-ready out of the box
+        gen_cfg["continuous"] = True
+        gen_cfg["continuous_rows"] = int(continuous_rows)
     # GPT-2 has no pad token ('!' is legitimately id 0): -1 disables the
     # served pad-in-prompt rejection. When the tokenizer is bundled, its
     # <|endoftext|> becomes the served eos (rows clamp; generate trims).

@@ -115,6 +115,47 @@ class TestImportCommand:
         assert rc == 0
         assert "serving-ready" in capsys.readouterr().out
 
+    def test_continuous_rows_flag_serves_through_engine(self, hf_model,
+                                                        tmp_path, capsys):
+        """--continuous-rows: the imported checkpoint's predictor dir
+        carries the continuous-batching generate config, and JaxModel
+        serves it through the engine with outputs equal to the plain
+        predictor's greedy decode."""
+        import json as _json
+
+        from kubeflow_tpu.cli import main
+        from kubeflow_tpu.serving.model import JaxModel
+
+        ckpt = tmp_path / "gpt2cb.pt"
+        torch.save(hf_model.state_dict(), str(ckpt))
+        rc = main(["import-gpt2", "--checkpoint", str(ckpt),
+                   "--num-heads", "4", "--max-new-tokens", "5",
+                   "--continuous-rows", "2",
+                   "--out", str(tmp_path / "cb"), "--device", "cpu"])
+        assert rc == 0
+        capsys.readouterr()
+        cfg = _json.loads((tmp_path / "cb" / "config.json").read_text())
+        assert cfg["generate"]["continuous"] is True
+        assert cfg["generate"]["continuous_rows"] == 2
+        # plain twin for the expected output
+        rc = main(["import-gpt2", "--checkpoint", str(ckpt),
+                   "--num-heads", "4", "--max-new-tokens", "5",
+                   "--out", str(tmp_path / "plain"), "--device", "cpu"])
+        assert rc == 0
+        capsys.readouterr()
+        jm_cb = JaxModel("cb", tmp_path / "cb")
+        jm_cb.load()
+        assert jm_cb._engine is not None
+        try:
+            ids = np.array([[10, 11, 12]], np.int32)
+            jm_plain = JaxModel("plain", tmp_path / "plain")
+            jm_plain.load()
+            np.testing.assert_array_equal(
+                np.asarray(jm_cb(ids)["predictions"]),
+                np.asarray(jm_plain(ids)["predictions"]))
+        finally:
+            jm_cb._engine.stop()
+
     def test_config_entry_supplies_heads(self, hf_model, tmp_path):
         ckpt = tmp_path / "with_cfg.pt"
         torch.save({"state_dict": hf_model.state_dict(),
