@@ -61,6 +61,12 @@ class GPTConfig:
     # pattern extrapolates with sequence position)
     position_embedding: str = "learned"
     rope_theta: float = 10000.0
+    # rolling decode cache (Mistral serving): with a sliding window, the
+    # KV cache can be a ring buffer of this many slots instead of a full
+    # (max_len)-deep buffer — decode attention bandwidth and cache memory
+    # scale with the capacity, not the context budget. Prompts must fit
+    # capacity - window + 1 positions (trace-time check); 0 = full cache.
+    kv_cache_capacity: int = 0
     # sliding-window attention (Mistral): each query attends to at most
     # the previous `attention_window` positions (itself included). 0 =
     # full causal. Composes with GQA + rope; dense + decode paths only
@@ -115,6 +121,23 @@ class GPTConfig:
                 raise ValueError(
                     "attention_window composes with dense/flash/ring/"
                     f"ulysses + decode (got attention={self.attention!r})")
+        if self.kv_cache_capacity:
+            if not self.attention_window:
+                raise ValueError(
+                    "kv_cache_capacity (rolling decode cache) requires "
+                    "attention_window — without a window, arbitrarily old "
+                    "keys stay visible and may never be evicted")
+            if self.kv_cache_capacity < self.attention_window:
+                raise ValueError(
+                    f"kv_cache_capacity {self.kv_cache_capacity} < "
+                    f"attention_window {self.attention_window}: a slot "
+                    "would be evicted while still inside every query's "
+                    "window")
+            if self.kv_cache_capacity >= self.max_len:
+                raise ValueError(
+                    f"kv_cache_capacity {self.kv_cache_capacity} >= "
+                    f"max_len {self.max_len}: rolling would only cost "
+                    "masking math — leave it 0 for the plain full cache")
         if self.moe_experts and self.moe_top_k > self.moe_experts:
             raise ValueError(
                 f"moe_top_k {self.moe_top_k} > moe_experts "
@@ -228,12 +251,21 @@ class CausalSelfAttention(nn.Module):
         c = self.cfg
         b, l, h, d = q.shape
         kvh = k.shape[2]
+        # Rolling cache (kv_cache_capacity with a sliding window): the
+        # buffer is a ring of C slots instead of max_len — decode
+        # attention bandwidth and cache memory scale with C. Capacity
+        # math: a block write of L positions evicts positions <= last - C,
+        # and the earliest query in the block still needs back to
+        # cur - window + 1, so C >= window + L - 1 keeps every visible
+        # key (checked below at trace time).
+        C = c.kv_cache_capacity or c.max_len
+        rolling = C < c.max_len
         ck = self.variable(
             "cache", "cached_key",
-            lambda: jnp.zeros((b, c.max_len, kvh, d), c.dtype))
+            lambda: jnp.zeros((b, C, kvh, d), c.dtype))
         cv = self.variable(
             "cache", "cached_value",
-            lambda: jnp.zeros((b, c.max_len, kvh, d), c.dtype))
+            lambda: jnp.zeros((b, C, kvh, d), c.dtype))
         # PER-ROW index (B,): in-flight rows may sit at different depths
         # (continuous batching, serving/continuous.py); uniform decode
         # (generate/speculative) is the all-rows-equal special case
@@ -247,11 +279,23 @@ class CausalSelfAttention(nn.Module):
             # the new (q, k) pair
             q = apply_rope(q, q_pos, c.rope_theta)
             k = apply_rope(k, q_pos, c.rope_theta)
+        if rolling and l > C - c.attention_window + 1:
+            raise ValueError(
+                f"prompt/block of {l} positions exceeds the rolling "
+                f"cache's budget (capacity {C} - window "
+                f"{c.attention_window} + 1 = {C - c.attention_window + 1})"
+                " — raise kv_cache_capacity")
         if l == 1:
-            # decode step: batched scatter — each row writes at ITS index
+            # decode step: batched scatter — each row writes at ITS slot
             rows = jnp.arange(b)
-            ck.value = ck.value.at[rows, cur].set(k[:, 0])
-            cv.value = cv.value.at[rows, cur].set(v[:, 0])
+            ck.value = ck.value.at[rows, cur % C].set(k[:, 0])
+            cv.value = cv.value.at[rows, cur % C].set(v[:, 0])
+        elif rolling:
+            # prefill onto the ring: slots may wrap; l <= C (from the
+            # budget check), so the l slots are distinct
+            slots = (cur[0] + jnp.arange(l)) % C
+            ck.value = ck.value.at[:, slots].set(k)
+            cv.value = cv.value.at[:, slots].set(v)
         else:
             # prefill (L > 1): all rows start together (generate and the
             # continuous engine both prefill from index 0 per call), so a
@@ -261,18 +305,36 @@ class CausalSelfAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v, (0, cur[0], 0, 0))
         idx.value = cur + l
-        k_pos = jnp.arange(c.max_len)                    # (max_len,)
         qg = q.reshape(b, l, kvh, h // kvh, d)
         s = jnp.einsum("blkgd,bmkd->bkglm", qg, ck.value).astype(jnp.float32)
         s = s / jnp.sqrt(jnp.float32(d))
-        # causal + not-yet-written mask in one comparison: a key position is
-        # visible iff it <= this query's position (unwritten slots are all
-        # > that row's cur + l - 1 by construction). A sliding window
-        # additionally hides keys older than window-1 positions.
-        visible = k_pos[None, None, :] <= q_pos[:, :, None]  # (B, L, max_len)
-        if c.attention_window:
-            visible = visible & (
-                q_pos[:, :, None] - k_pos[None, None, :] < c.attention_window)
+        if rolling:
+            # slot j holds the NEWEST position p ≡ j (mod C) this row has
+            # written: p_j = last - ((last - j) mod C); unwritten slots
+            # reconstruct to p_j < 0. Visible = written AND causal AND
+            # inside the window. (Incompatible with speculative rewind:
+            # after a rewind, slot identity is ambiguous — speculative
+            # rejects rolling configs.)
+            j = jnp.arange(C)
+            last = (cur + l - 1)[:, None]                # (B, 1)
+            p_j = last - ((last - j[None, :]) % C)       # (B, C)
+            visible = (
+                (p_j[:, None, :] >= 0)
+                & (p_j[:, None, :] <= q_pos[:, :, None])
+                & (q_pos[:, :, None] - p_j[:, None, :] < c.attention_window)
+            )
+        else:
+            k_pos = jnp.arange(C)                        # (max_len,)
+            # causal + not-yet-written mask in one comparison: a key
+            # position is visible iff it <= this query's position
+            # (unwritten slots are all > that row's cur + l - 1 by
+            # construction). A sliding window additionally hides keys
+            # older than window-1 positions.
+            visible = k_pos[None, None, :] <= q_pos[:, :, None]
+            if c.attention_window:
+                visible = visible & (
+                    q_pos[:, :, None] - k_pos[None, None, :]
+                    < c.attention_window)
         s = jnp.where(visible[:, None, None], s, -1e9)
         p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
         y = jnp.einsum("bkglm,bmkd->blkgd", p, cv.value)

@@ -82,6 +82,12 @@ def speculative_generate(
             raise ValueError(
                 f"{name}.cfg.max_len {m.cfg.max_len} < prompt {prompt_len} "
                 f"+ max_new_tokens {max_new_tokens} + gamma+1 {gamma + 1}")
+        if getattr(m.cfg, "kv_cache_capacity", 0):
+            raise ValueError(
+                f"{name} uses a rolling KV cache (kv_cache_capacity) — "
+                "speculative rewind makes ring-slot identity ambiguous "
+                "(a rewound index cannot tell stale newer writes from "
+                "valid older ones); serve rolling models without a draft")
 
     # prefill both caches over the prompt; first token comes from the
     # target alone (same as plain greedy)

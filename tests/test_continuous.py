@@ -105,6 +105,28 @@ class TestScheduling:
         assert eng.step_count <= 26  # 23 (long) + admission slack
 
 
+class TestRollingCacheEngine:
+    def test_engine_over_rolling_cache_model(self):
+        """Continuous batching composes with the rolling KV cache: row
+        splices carry C-slot buffers and outputs still match solo greedy
+        decode (which itself matches the full-cache model)."""
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96,
+                             attention_window=6, kv_cache_capacity=14)
+        model = GPTLM(cfg, pad_token_id=-1)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.ones((1, 5), jnp.int32))
+        eng = ContinuousBatcher(model, variables, max_rows=2,
+                                steps_per_tick=3)
+        jobs = [(p, b, eng.submit(p, max_new_tokens=b))
+                for p, b in ((_prompt(70, 5), 20), (_prompt(71, 8), 12),
+                             (_prompt(72, 4), 25))]
+        eng.run_until_idle()
+        for p, budget, req in jobs:
+            want = np.asarray(generate(
+                model, variables, p[None, :], max_new_tokens=budget))[0]
+            np.testing.assert_array_equal(req.result(timeout=1), want)
+
+
 class TestMultiStepTicks:
     def test_exactness_and_dispatch_amortization(self, lm):
         """steps_per_tick=4: outputs stay EXACTLY solo greedy decode

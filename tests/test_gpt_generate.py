@@ -624,6 +624,73 @@ class TestSlidingWindow:
             GPTConfig.tiny(attention_window=-2)
 
 
+class TestRollingKvCache:
+    """kv_cache_capacity: the ring-buffer decode cache for sliding-window
+    models must decode EXACTLY like the full max_len cache — including
+    after the ring wraps — at a fraction of the memory."""
+
+    def _twins(self, capacity, window=6, max_len=96, **kw):
+        base = dict(dropout_rate=0.0, max_len=max_len,
+                    attention_window=window, **kw)
+        full = GPTLM(GPTConfig.tiny(**base), pad_token_id=-1)
+        roll = GPTLM(GPTConfig.tiny(kv_cache_capacity=capacity, **base),
+                     pad_token_id=-1)
+        prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 7), 1,
+                                    512, jnp.int32)
+        variables = full.init(jax.random.PRNGKey(4), prompt)
+        return full, roll, variables, prompt
+
+    @pytest.mark.parametrize("capacity", [12, 13, 20])
+    def test_decode_matches_full_cache_past_wrap(self, capacity):
+        full, roll, variables, prompt = self._twins(capacity)
+        n = 40  # prompt 7 + 40 tokens: the ring wraps 2-3 times
+        want = generate(full, variables, prompt, max_new_tokens=n)
+        got = generate(roll, variables, prompt, max_new_tokens=n)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_rope_gqa_rolling(self):
+        full, roll, variables, prompt = self._twins(
+            capacity=14, position_embedding="rope", num_kv_heads=2)
+        want = generate(full, variables, prompt, max_new_tokens=30)
+        got = generate(roll, variables, prompt, max_new_tokens=30)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_cache_is_actually_small(self):
+        _, roll, variables, prompt = self._twins(capacity=12)
+        _, cache = roll.apply(variables, prompt, decode=True,
+                              mutable=["cache"])
+        key = cache["cache"]["layer_0"]["attention"]["cached_key"]
+        assert key.shape[1] == 12  # C slots, not max_len (96)
+
+    def test_prompt_exceeding_budget_fails_loudly(self):
+        _, roll, variables, _ = self._twins(capacity=12, window=6)
+        big = jnp.ones((1, 8), jnp.int32)  # budget = 12 - 6 + 1 = 7
+        with pytest.raises(ValueError, match="rolling"):
+            roll.apply(variables, big, decode=True, mutable=["cache"])
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="requires attention_window"):
+            GPTConfig.tiny(kv_cache_capacity=16)
+        with pytest.raises(ValueError, match="evicted"):
+            GPTConfig.tiny(attention_window=32, kv_cache_capacity=16,
+                           max_len=64)
+        with pytest.raises(ValueError, match="full cache"):
+            GPTConfig.tiny(attention_window=8, kv_cache_capacity=256,
+                           max_len=256)
+
+    def test_speculative_rejects_rolling(self):
+        from kubeflow_tpu.models.speculative import speculative_generate
+
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96,
+                             attention_window=6, kv_cache_capacity=16)
+        m = GPTLM(cfg, pad_token_id=-1)
+        prompt = jnp.ones((1, 4), jnp.int32)
+        variables = m.init(jax.random.PRNGKey(0), prompt)
+        with pytest.raises(ValueError, match="rolling"):
+            speculative_generate(m, variables, m, variables, prompt,
+                                 max_new_tokens=8)
+
+
 class TestEosEarlyStop:
     def test_rows_clamp_after_eos_independently(self, lm):
         """Once a row emits EOS every later position is EOS (clients trim
