@@ -92,6 +92,22 @@ def test_bench_continuous_serve_smoke(monkeypatch):
     assert r["rows"] == 2 and r["n_requests"] == 4
 
 
+def test_bench_rolling_decode_smoke(monkeypatch):
+    import bench
+    from kubeflow_tpu import models
+
+    monkeypatch.setattr(
+        models.GPTConfig, "small",
+        staticmethod(lambda **kw: models.GPTConfig.tiny(**kw)),
+    )
+    r = bench.bench_gpt2s_rolling_decode(
+        batch_size=2, prompt_len=6, new_tokens=4, window=8, capacity=16,
+        budget_len=64)
+    assert r["metric"] == "gpt2s_rolling_decode_tokens_per_sec_per_chip"
+    assert r["value"] > 0 and r["full_cache_tokens_per_sec"] > 0
+    assert r["capacity"] == 16
+
+
 def test_bench_gpt_flash_smoke(monkeypatch):
     """Long-context GPT bench runs end-to-end (tiny dims, interpret-mode
     pallas on CPU) and emits the metric contract."""
