@@ -71,14 +71,23 @@ _CAPTURE_FILES = (
 )
 
 
-def _load_captures() -> tuple[dict[str, dict], str] | None:
-    """Parse the newest fixed-protocol capture: {metric: record} + protocol.
+def _load_captures(base_dir: str | None = None
+                   ) -> tuple[dict[str, dict], str] | None:
+    """Merge fixed-protocol captures PER METRIC, newest file winning.
+
+    Merging matters: a partial r4 capture (the watcher appends partial
+    output when a suite times out mid-window) must refresh the metrics it
+    DID capture without erasing the r3 values for the ones it didn't —
+    wholesale file replacement would reintroduce the bare-0.0 error
+    records this machinery exists to prevent.
 
     Each record keeps the full emitted line (value, mfu, steps_per_sec, ...)
     plus capture provenance (source file, mtime as ISO timestamp) so an
     error record can embed a self-sufficient last-known-good payload."""
-    here = os.path.dirname(os.path.abspath(__file__))
-    for fname, protocol in _CAPTURE_FILES:
+    here = base_dir or os.path.dirname(os.path.abspath(__file__))
+    merged: dict[str, dict] = {}
+    newest_protocol = None
+    for fname, protocol in reversed(_CAPTURE_FILES):  # oldest first
         path = os.path.join(here, fname)
         try:
             captured: dict[str, dict] = {}
@@ -101,9 +110,13 @@ def _load_captures() -> tuple[dict[str, dict], str] | None:
                 for r in captured.values():
                     r["capture_source"] = fname
                     r["captured_at"] = stamp
-                return captured, protocol
+                    r["capture_protocol"] = protocol
+                merged.update(captured)  # newer file overwrites per metric
+                newest_protocol = protocol
         except OSError:
             continue
+    if merged:
+        return merged, newest_protocol
     return None
 
 
@@ -670,7 +683,8 @@ def _error_record(metric: str, unit: str, exc: BaseException) -> dict:
                 "unit": good.get("unit", unit),
                 "mfu": good.get("mfu"),
                 "steps_per_sec": good.get("steps_per_sec"),
-                "protocol": protocol,
+                # per-metric protocol: a merged capture set can mix files
+                "protocol": good.get("capture_protocol", protocol),
                 "capture_source": good["capture_source"],
                 "captured_at": good["captured_at"],
             }

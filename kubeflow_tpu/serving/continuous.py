@@ -48,10 +48,13 @@ class _InFlight:
     key: object = None  # jax PRNG key for sampling rows
     tokens: list = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
+    error: str | None = None
 
     def result(self, timeout: float | None = None) -> np.ndarray:
         if not self.done.wait(timeout):
             raise TimeoutError("generation did not finish in time")
+        if self.error is not None:
+            raise RuntimeError(f"generation failed: {self.error}")
         return np.asarray(self.tokens, np.int32)
 
 
@@ -79,6 +82,12 @@ class ContinuousBatcher:
         self.variables = variables
         self.max_rows = int(max_rows)
         self.max_len = int(cfg.max_len)
+        # rolling-cache models bound the prefill length (models/gpt.py
+        # capacity law); validate at submit() so a too-long prompt is the
+        # CALLER's error, not a trace-time exception on the engine thread
+        cap = int(getattr(cfg, "kv_cache_capacity", 0) or 0)
+        self.max_prompt_len = (
+            cap - int(cfg.attention_window) + 1 if cap else self.max_len)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_token_id = eos_token_id
         self.top_k = int(top_k)  # static: one decode executable
@@ -183,6 +192,10 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt {ids.size} + max_new_tokens {budget} exceeds "
                 f"max_len {self.max_len}")
+        if ids.size > self.max_prompt_len:
+            raise ValueError(
+                f"prompt {ids.size} exceeds the rolling cache's prefill "
+                f"budget {self.max_prompt_len} (capacity - window + 1)")
         with self._lock:
             self._submitted += 1
             if key is None:
@@ -230,15 +243,17 @@ class ContinuousBatcher:
                 if not self._queue:
                     break
                 ids, req = self._queue.pop(0)
+            # seat the row BEFORE device work: a prefill failure must find
+            # the request in _rows so _fail_all unblocks its caller
+            req.slot = slot
+            self._rows[slot] = req
             last_logits, row_cache = self._prefill(ids)
             self._cache = self._splice(
                 self._cache, row_cache, jnp.int32(slot))
             first = self._pick_first(
                 last_logits[0], req.temperature,
                 jax.random.fold_in(req.key, 0))
-            req.slot = slot
             req.tokens.append(int(first))
-            self._rows[slot] = req
             self._toks[slot] = int(first)
             # the prefill's first token may already finish the row
             if self._finished(req):
@@ -298,8 +313,25 @@ class ContinuousBatcher:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            if not self.tick():
+            try:
+                busy = self.tick()
+            except Exception as exc:  # noqa: BLE001 — the engine must
+                # survive a poisoned round: fail every request it was
+                # carrying (their threads unblock with the error instead
+                # of hanging to timeout) and keep serving fresh ones
+                self._fail_all(f"{type(exc).__name__}: {exc}")
+                busy = False
+            if not busy:
                 self._stop.wait(0.002)  # idle: poll the queue cheaply
+
+    def _fail_all(self, reason: str) -> None:
+        with self._lock:
+            queued = [req for _, req in self._queue]
+            self._queue.clear()
+        for req in queued + [r for r in self._rows if r is not None]:
+            req.error = reason
+            req.done.set()
+        self._rows = [None] * self.max_rows
 
     def stop(self) -> None:
         self._stop.set()

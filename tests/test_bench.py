@@ -64,6 +64,26 @@ def test_error_record_embeds_last_good_capture():
     assert bench.BASELINE_PROTOCOL == protocol
 
 
+def test_partial_new_capture_merges_per_metric(tmp_path):
+    """A partial r4 capture (watcher timeout mid-suite) must refresh the
+    metrics it DID record while KEEPING the r3 values for the rest —
+    wholesale file replacement would reintroduce bare-0.0 error records
+    for the lost metrics."""
+    import bench
+
+    (tmp_path / "bench_r3_fixed.jsonl").write_text(
+        json.dumps({"metric": "a", "value": 10.0, "mfu": 0.5}) + "\n"
+        + json.dumps({"metric": "b", "value": 20.0, "mfu": 0.2}) + "\n")
+    (tmp_path / "bench_r4_suite.jsonl").write_text(
+        json.dumps({"metric": "a", "value": 11.0, "mfu": 0.6}) + "\n")
+    captured, protocol = bench._load_captures(str(tmp_path))
+    assert protocol == "r4-fixed"
+    assert captured["a"]["value"] == 11.0          # refreshed by r4
+    assert captured["a"]["capture_protocol"] == "r4-fixed"
+    assert captured["b"]["value"] == 20.0          # KEPT from r3
+    assert captured["b"]["capture_protocol"] == "r3-fixed"
+
+
 def test_backend_error_classifier():
     import bench
 

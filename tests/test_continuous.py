@@ -105,6 +105,49 @@ class TestScheduling:
         assert eng.step_count <= 26  # 23 (long) + admission slack
 
 
+class TestResilience:
+    def test_over_budget_prompt_rejected_at_submit(self):
+        """Rolling-cache prefill budget is the CALLER's error at submit
+        time — not a trace-time exception killing the engine thread."""
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96,
+                             attention_window=6, kv_cache_capacity=12)
+        model = GPTLM(cfg, pad_token_id=-1)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.ones((1, 4), jnp.int32))
+        eng = ContinuousBatcher(model, variables, max_rows=2)
+        with pytest.raises(ValueError, match="prefill budget"):
+            eng.submit(_prompt(80, 8), max_new_tokens=4)  # budget = 7
+
+    def test_poisoned_tick_fails_requests_not_the_engine(self, lm):
+        """An exception inside a serving-thread tick must unblock the
+        carried requests with the error AND leave the engine serving
+        fresh requests — not die silently while clients hang."""
+        model, variables = lm
+        eng = ContinuousBatcher(model, variables, max_rows=2).start()
+        try:
+            boom = {"armed": True}
+            orig = eng._prefill
+
+            def exploding(ids):
+                if boom["armed"]:
+                    boom["armed"] = False
+                    raise RuntimeError("injected prefill failure")
+                return orig(ids)
+
+            eng._prefill = exploding
+            bad = eng.submit(_prompt(81, 5), max_new_tokens=6)
+            with pytest.raises(RuntimeError, match="injected"):
+                bad.result(timeout=30)
+            # the engine survived: a fresh request completes correctly
+            p = _prompt(82, 5)
+            good = eng.submit(p, max_new_tokens=6)
+            want = np.asarray(generate(
+                model, variables, p[None, :], max_new_tokens=6))[0]
+            np.testing.assert_array_equal(good.result(timeout=60), want)
+        finally:
+            eng.stop()
+
+
 class TestRollingCacheEngine:
     def test_engine_over_rolling_cache_model(self):
         """Continuous batching composes with the rolling KV cache: row
