@@ -143,7 +143,33 @@ class ModelServer:
 
     def handle_get(self, path: str) -> tuple[int, object]:
         if path == "/metrics":
-            return 200, self.logger.render_metrics()  # raw prometheus text
+            text = self.logger.render_metrics()  # raw prometheus text
+            # continuous-batching engines publish scheduler gauges
+            eng_lines = []
+            for name, m in sorted(self.models.items()):
+                eng = getattr(m, "_engine", None)
+                if eng is None:
+                    continue
+                busy = sum(1 for r in eng._rows if r is not None)
+                with eng._lock:
+                    queued = len(eng._queue)
+                eng_lines += [
+                    f'kfserving_engine_decode_dispatches_total'
+                    f'{{model="{name}"}} {eng.step_count}',
+                    f'kfserving_engine_rows_busy{{model="{name}"}} {busy}',
+                    f'kfserving_engine_rows_total{{model="{name}"}} '
+                    f'{eng.max_rows}',
+                    f'kfserving_engine_queue_depth{{model="{name}"}} '
+                    f'{queued}',
+                ]
+            if eng_lines:
+                text += "\n".join(
+                    ["# TYPE kfserving_engine_decode_dispatches_total "
+                     "counter",
+                     "# TYPE kfserving_engine_rows_busy gauge",
+                     "# TYPE kfserving_engine_queue_depth gauge"]
+                    + eng_lines) + "\n"
+            return 200, text
         if path == "/v2":
             return 200, {
                 "name": SERVER_NAME,

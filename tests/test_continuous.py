@@ -252,6 +252,41 @@ class TestServingIntegration:
         finally:
             jm._engine.stop()
 
+    def test_engine_metrics_on_server(self, tmp_path, lm):
+        """/metrics exposes the engine's scheduler gauges for
+        continuous-batching models."""
+        import urllib.request
+
+        from kubeflow_tpu.serving.model import JaxModel, save_predictor
+        from kubeflow_tpu.serving.server import ModelServer
+
+        model, variables = lm
+        d = save_predictor(
+            tmp_path / "gpt-m", "gpt-lm", dict(variables),
+            np.zeros((1, 6), np.int32),
+            generate={"max_new_tokens": 4, "continuous": True,
+                      "continuous_rows": 2},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        jm = JaxModel("gpt-m", d)
+        jm.load()
+        try:
+            srv = ModelServer(port=0)
+            srv.register(jm)
+            srv.start()
+            try:
+                jm(np.asarray(_prompt(90, 6))[None, :])
+                with urllib.request.urlopen(
+                        f"{srv.url}/metrics", timeout=10) as r:
+                    text = r.read().decode()
+                assert 'kfserving_engine_rows_total{model="gpt-m"} 2' in text
+                assert "kfserving_engine_decode_dispatches_total" in text
+                assert "kfserving_engine_queue_depth" in text
+            finally:
+                srv.stop()
+        finally:
+            jm._engine.stop()
+
     def test_continuous_rejects_beam_config(self, tmp_path, lm):
         from kubeflow_tpu.serving.model import JaxModel, save_predictor
 
