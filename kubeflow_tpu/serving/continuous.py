@@ -71,7 +71,8 @@ class ContinuousBatcher:
     def __init__(self, module, variables, max_rows: int = 8,
                  default_max_new_tokens: int = 32,
                  eos_token_id: int | None = None, top_k: int = 0,
-                 seed: int = 0, steps_per_tick: int = 1):
+                 seed: int = 0, steps_per_tick: int = 1,
+                 prefill_buckets: tuple[int, ...] | None = None):
         cfg = module.cfg
         if getattr(cfg, "moe_experts", 0):
             raise ValueError(
@@ -88,6 +89,25 @@ class ContinuousBatcher:
         cap = int(getattr(cfg, "kv_cache_capacity", 0) or 0)
         self.max_prompt_len = (
             cap - int(cfg.attention_window) + 1 if cap else self.max_len)
+        # bucketed prefill: pad prompts right to the smallest bucket and
+        # rewind the per-row index to the true length inside the jitted
+        # prefill — ONE executable per bucket instead of one per distinct
+        # prompt length (unbounded compile cache in production). The
+        # stale pad rows are invisible under the full cache's position
+        # mask; a ROLLING cache cannot tell stale newer writes from valid
+        # older ones (same hazard as speculative rewind), so buckets are
+        # refused there.
+        if prefill_buckets is not None:
+            if cap:
+                raise ValueError(
+                    "prefill_buckets requires the full KV cache: the pad "
+                    "rewind makes rolling ring-slot identity ambiguous")
+            buckets = tuple(sorted(int(x) for x in prefill_buckets))
+            if not buckets or buckets[0] < 1:
+                raise ValueError(f"bad prefill_buckets {prefill_buckets}")
+            self.prefill_buckets = buckets
+        else:
+            self.prefill_buckets = None
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.eos_token_id = eos_token_id
         self.top_k = int(top_k)  # static: one decode executable
@@ -196,6 +216,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt {ids.size} exceeds the rolling cache's prefill "
                 f"budget {self.max_prompt_len} (capacity - window + 1)")
+        if (self.prefill_buckets is not None
+                and ids.size > self.prefill_buckets[-1]):
+            raise ValueError(
+                f"prompt {ids.size} exceeds the largest prefill bucket "
+                f"{self.prefill_buckets[-1]}")
         with self._lock:
             self._submitted += 1
             if key is None:
@@ -212,14 +237,44 @@ class ContinuousBatcher:
         return req
 
     def _prefill(self, ids: np.ndarray):
-        fn = self._prefill_cache.get(ids.size)
+        if self.prefill_buckets is None:
+            fn = self._prefill_cache.get(ids.size)
+            if fn is None:
+                def prefill(x):
+                    logits, cache = self.module.apply(
+                        self.variables, x, decode=True, mutable=["cache"])
+                    return logits[:, -1], cache["cache"]
+                fn = self._prefill_cache[ids.size] = jax.jit(prefill)
+            return fn(ids[None, :])
+        # bucketed: pad right, take logits at the TRUE last position, and
+        # rewind cache_index/pos_index to the true length — pad rows stay
+        # invisible under the position mask
+        bucket = next((b for b in self.prefill_buckets if b >= ids.size),
+                      None)
+        if bucket is None:
+            raise ValueError(
+                f"prompt {ids.size} exceeds the largest prefill bucket "
+                f"{self.prefill_buckets[-1]}")
+        fn = self._prefill_cache.get(bucket)
         if fn is None:
-            def prefill(x):
+            def prefill(x, true_len):
                 logits, cache = self.module.apply(
                     self.variables, x, decode=True, mutable=["cache"])
-                return logits[:, -1], cache["cache"]
-            fn = self._prefill_cache[ids.size] = jax.jit(prefill)
-        return fn(ids[None, :])
+                last = jax.lax.dynamic_index_in_dim(
+                    logits, true_len - 1, axis=1, keepdims=False)
+
+                def rewind(path, leaf):
+                    name = getattr(path[-1], "key", "")
+                    if name in ("cache_index", "pos_index"):
+                        return jnp.full_like(leaf, true_len)
+                    return leaf
+
+                return last, jax.tree_util.tree_map_with_path(
+                    rewind, cache["cache"])
+            fn = self._prefill_cache[bucket] = jax.jit(prefill)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:ids.size] = ids
+        return fn(padded[None, :], jnp.int32(ids.size))
 
     def _retire(self, slot: int) -> None:
         req = self._rows[slot]

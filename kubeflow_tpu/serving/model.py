@@ -346,6 +346,9 @@ class JaxModel(Model):
                 top_k=int(gen.get("top_k", 0)),
                 seed=int(gen.get("seed", 0)),
                 steps_per_tick=int(gen.get("continuous_steps_per_tick", 1)),
+                prefill_buckets=(
+                    tuple(gen["continuous_prefill_buckets"])
+                    if gen.get("continuous_prefill_buckets") else None),
             ).start()
             self.ready = True
             return

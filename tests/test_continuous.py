@@ -105,6 +105,42 @@ class TestScheduling:
         assert eng.step_count <= 26  # 23 (long) + admission slack
 
 
+class TestBucketedPrefill:
+    def test_outputs_exact_and_executables_bounded(self, lm):
+        """Bucketed prefill: assorted prompt lengths share per-bucket
+        executables (compile cache bounded by the bucket list, not by
+        distinct lengths) and every output still equals solo greedy
+        decode."""
+        model, variables = lm
+        eng = ContinuousBatcher(model, variables, max_rows=2,
+                                prefill_buckets=(8, 16))
+        jobs = []
+        for seed, plen in ((90, 3), (91, 5), (92, 8), (93, 11), (94, 16),
+                           (95, 6)):
+            p = _prompt(seed, plen)
+            jobs.append((p, eng.submit(p, max_new_tokens=9)))
+        eng.run_until_idle()
+        for p, req in jobs:
+            want = np.asarray(generate(
+                model, variables, p[None, :], max_new_tokens=9))[0]
+            np.testing.assert_array_equal(req.result(timeout=1), want)
+        # 6 distinct lengths -> at most 2 prefill executables
+        assert set(eng._prefill_cache) <= {8, 16}
+
+    def test_oversized_prompt_and_rolling_refused(self, lm):
+        model, variables = lm
+        eng = ContinuousBatcher(model, variables, max_rows=2,
+                                prefill_buckets=(8,))
+        with pytest.raises(ValueError, match="largest prefill bucket"):
+            eng.submit(_prompt(96, 12), max_new_tokens=4)
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96,
+                             attention_window=6, kv_cache_capacity=12)
+        rolling = GPTLM(cfg, pad_token_id=-1)
+        rv = rolling.init(jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32))
+        with pytest.raises(ValueError, match="rolling"):
+            ContinuousBatcher(rolling, rv, prefill_buckets=(8,))
+
+
 class TestResilience:
     def test_over_budget_prompt_rejected_at_submit(self):
         """Rolling-cache prefill budget is the CALLER's error at submit
