@@ -61,8 +61,9 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
                   && awk "BEGIN{exit !($L2 <= $XL)}"; then BWD=loop2; fi
              fi
              echo "bench KFT_FLASH_BWD_IMPL=$BWD" >> tunnel_watch2.log
-             stage bench_r4_suite.jsonl 2400 \
-               env KFT_BENCH_DEADLINE_S=2300 KFT_FLASH_BWD_IMPL=$BWD \
+             # 10-bench suite: ~30-40 min through the tunnel
+             stage bench_r4_suite.jsonl 3600 \
+               env KFT_BENCH_DEADLINE_S=3500 KFT_FLASH_BWD_IMPL=$BWD \
                python bench.py --suite; } \
         && { [ ! -f probe_resnet.py ] \
              || stage probe_resnet.txt 1200 python -u probe_resnet.py; } \
