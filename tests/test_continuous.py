@@ -375,6 +375,80 @@ class TestSampling:
         assert len(outs) > 1  # auto-derived per-request keys differ
 
 
+class TestPlatformE2E:
+    def test_continuous_predictor_through_platform(self, tmp_path, lm):
+        """Continuous batching through the WHOLE platform: storage pull ->
+        server pod (subprocess) -> concurrent v1 predicts -> every client
+        gets exactly its solo greedy decode."""
+        import json as _json
+        import urllib.request
+
+        from kubeflow_tpu.client import Platform
+        from kubeflow_tpu.controller.fakecluster import ObjectMeta
+        from kubeflow_tpu.serving.api import (
+            InferenceService,
+            InferenceServiceSpec,
+            PredictorRuntime,
+            PredictorSpec,
+        )
+        from kubeflow_tpu.serving.client import ServingClient
+        from kubeflow_tpu.serving.controller import (
+            ISVC_LABEL,
+            PORT_ANNOTATION,
+        )
+        from kubeflow_tpu.serving.model import save_predictor
+
+        model, variables = lm
+        src = save_predictor(
+            tmp_path / "src", "gpt-lm", dict(variables),
+            np.zeros((1, 6), np.int32),
+            generate={"max_new_tokens": 5, "continuous": True,
+                      "continuous_rows": 3, "continuous_steps_per_tick": 2},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        with Platform(log_dir=str(tmp_path / "logs")) as p:
+            sc = ServingClient(p)
+            sc.create(InferenceService(
+                metadata=ObjectMeta(name="llm-cb"),
+                spec=InferenceServiceSpec(predictor=PredictorSpec(
+                    runtime=PredictorRuntime.JAX,
+                    storage_uri=f"file://{src}",
+                    device="cpu",
+                )),
+            ))
+            sc.wait_ready("llm-cb", timeout_s=180)
+            pods = p.cluster.list(
+                "pods",
+                lambda q: q.metadata.labels.get(ISVC_LABEL) == "llm-cb",
+            )
+            port = pods[0].metadata.annotations[PORT_ANNOTATION]
+            outs = {}
+
+            def client(seed):
+                prm = _prompt(seed, 6)[None, :]
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/models/llm-cb:predict",
+                    data=_json.dumps(
+                        {"instances": np.asarray(prm).tolist()}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                body = _json.loads(
+                    urllib.request.urlopen(req, timeout=120).read())
+                outs[seed] = (prm, np.asarray(body["predictions"]))
+
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(100, 104)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+        assert len(outs) == 4
+        for prm, got in outs.values():
+            want = np.asarray(generate(model, variables, prm,
+                                       max_new_tokens=5))
+            np.testing.assert_array_equal(got, want)
+
+
 class TestServingMode:
     def test_threaded_engine_serves_concurrent_clients(self, lm):
         model, variables = lm
