@@ -1,0 +1,119 @@
+"""Regenerate TUNNEL_STATUS.md — the at-a-glance capture-state artifact.
+
+VERDICT r4 #8: the watcher's state (windows seen, stages pending, metric
+coverage) must be visible to every session — builder, judge, driver —
+without reading tunnel_watch logs. tunnel_watch3.sh runs this on every
+poll loop; it is also safe to run by hand. Imports bench (no jax at module
+level) for the capture-merge logic so the coverage table can never drift
+from what bench.py itself would adopt.
+
+  python tunnel_status.py --alive 0|1   # watcher poll result for the header
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import bench
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# (artifact, description) in the exact order tunnel_watch3.sh runs them
+STAGES = [
+    ("bench_r5_headline.jsonl",
+     "headline: resnet+bert only, <5 min — banks the north-star numbers"),
+    ("probe_flash_r5.txt",
+     "flash-backward verdict: loop2 + dd-prekernel candidates, term bisect"),
+    ("bench_r5_suite.jsonl",
+     "full fixed-protocol suite (resume-seeded; never-captured rows first)"),
+    ("probe_resnet.txt",
+     "conv ceiling / stem A-B (shipped flags) for the ResNet MFU verdict"),
+    ("probe_flash_xlabwd.txt", "xla-backward timing/numerics detail"),
+]
+
+WATCH_LOG = "tunnel_watch3.log"
+
+
+def _stage_state(artifact: str) -> tuple[str, str]:
+    """(status, detail) for one staged artifact."""
+    path = os.path.join(HERE, artifact)
+    script_missing = (
+        artifact.startswith("probe_")
+        and not os.path.exists(os.path.join(
+            HERE, artifact.replace(".txt", ".py"))))
+    if script_missing:
+        return "not staged", "probe script absent"
+    if os.path.exists(path + ".done"):
+        return "DONE", _mtime(path + ".done")
+    if os.path.exists(path):
+        detail = f"partial since {_mtime(path)}"
+        if artifact.endswith(".jsonl"):
+            with open(path) as fh:
+                rows = bench._parse_capture_lines(fh)
+            detail += f", {len(rows)} row(s) banked"
+        return "partial", detail
+    return "pending", "no output yet"
+
+
+def _mtime(path: str) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                         time.gmtime(os.path.getmtime(path)))
+
+
+def _windows_seen() -> list[str]:
+    lines = []
+    try:
+        with open(os.path.join(HERE, WATCH_LOG)) as fh:
+            lines = [ln.strip() for ln in fh if "tunnel alive" in ln]
+    except OSError:
+        pass
+    return lines
+
+
+def main() -> None:
+    alive = None
+    if "--alive" in sys.argv:
+        alive = sys.argv[sys.argv.index("--alive") + 1] == "1"
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    out = ["# Tunnel capture status", "",
+           f"Generated {now} by tunnel_status.py "
+           f"(regenerated on every tunnel_watch3.sh poll).", ""]
+    if alive is not None:
+        out += [f"**Last probe:** tunnel {'ALIVE' if alive else 'down'} "
+                f"at {now}", ""]
+    windows = _windows_seen()
+    out += [f"**Live windows seen by this watcher:** {len(windows)}"]
+    out += [f"- `{w}`" for w in windows[-8:]]
+    out += ["", "## Stages", "",
+            "| artifact | status | detail | purpose |", "|---|---|---|---|"]
+    for artifact, desc in STAGES:
+        status, detail = _stage_state(artifact)
+        out.append(f"| `{artifact}` | {status} | {detail} | {desc} |")
+
+    out += ["", "## Metric coverage (merged captures, newest wins)", "",
+            "| metric | value | mfu | protocol | captured |",
+            "|---|---|---|---|---|"]
+    captures = bench._load_captures()
+    captured = captures[0] if captures else {}
+    for _fn, metric, unit in bench.SUITE_BENCHES:
+        r = captured.get(metric)
+        if r:
+            out.append(
+                f"| {metric} | {r['value']} {unit} | {r.get('mfu')} | "
+                f"{r.get('capture_protocol')} | {r.get('captured_at')} |")
+        else:
+            out.append(f"| {metric} | — | — | — | **NEVER** |")
+    never = [m for _f, m, _u in bench.SUITE_BENCHES if m not in captured]
+    out += ["",
+            f"Never captured: {len(never)}/{len(bench.SUITE_BENCHES)}"
+            + (f" ({', '.join(never)})" if never else ""), ""]
+
+    with open(os.path.join(HERE, "TUNNEL_STATUS.md"), "w") as fh:
+        fh.write("\n".join(out))
+
+
+if __name__ == "__main__":
+    main()

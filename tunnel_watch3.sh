@@ -1,0 +1,117 @@
+#!/bin/bash
+# Round-5 tunnel watcher — rebuilt for the window geometry this environment
+# actually provides (observed live windows: 12-17 minutes, many hours apart;
+# VERDICT r4 weak #1). Three changes vs tunnel_watch2.sh:
+#   1. A <5-min HEADLINE stage (bench.py --headline: resnet+bert only) runs
+#      FIRST, so any window — however short — banks the two north-star
+#      numbers under the current protocol before anything long is attempted.
+#   2. Capture stages run bench.py with KFT_BENCH_RESUME=1: rows already in
+#      this round's on-disk captures are skipped and the remaining rows run
+#      never-captured-first, so successive short windows CONVERGE on full
+#      coverage instead of restarting at mnist every time.
+#   3. stage() APPENDS partial output to the artifact on every exit path
+#      (resume means a later success emits only the missing rows, so the
+#      old move-over-artifact semantics would erase banked lines), and
+#      TUNNEL_STATUS.md is regenerated every loop so capture state is
+#      visible without reading this log (VERDICT r4 #8).
+# Stage order: headline bench -> flash probe (flip verdict) -> full suite
+# -> resnet probe -> xla-backward detail. .done marks stage completion.
+cd /root/repo
+MAX_HOURS=${MAX_HOURS:-48}
+max_iters=$(( MAX_HOURS * 20 ))
+iters=0
+
+stage() {  # stage <artifact> <timeout_s> <cmd...>
+  local artifact="$1" tmo="$2"; shift 2
+  [ -f "$artifact.done" ] && return 0
+  timeout "$tmo" "$@" > "$artifact.tmp" 2> "$artifact.stderr"
+  local rc=$?
+  echo "stage $artifact rc=$rc at $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> tunnel_watch3.log
+  # always append: partial rows bank immediately, and a resumed success
+  # emits only the rows the artifact does not already hold
+  cat "$artifact.tmp" >> "$artifact" 2>/dev/null
+  rm -f "$artifact.tmp"
+  if [ "$rc" -eq 0 ]; then
+    touch "$artifact.done"
+    return 0
+  fi
+  return 1
+}
+
+last_val() {  # last_val <key> — LAST recorded value for key in the probe
+  # artifact. stage() APPENDS partial runs, so an early PASS must not
+  # outvote a later FAIL (or vice versa): only the final line per key
+  # counts, mirroring bench.py's last-line-per-metric capture contract.
+  grep -o "$1=[A-Za-z0-9.]*" probe_flash_r5.txt 2>/dev/null | tail -1 | cut -d= -f2
+}
+
+pick_flash_bwd() {
+  # Flip the suite's training benches onto a pallas backward IFF the probe
+  # recorded it Mosaic-PASS on causal AND full AND sliding-window (the
+  # suite includes the windowed swa row — ADVICE r4: flipping on
+  # causal/full alone could measure that row through broken numerics)
+  # AND it is at least as fast as the xla backward. Prefers the faster
+  # PASSing candidate: loop2 (in-kernel D recompute) vs ddpre (dd produced
+  # by a pallas pre-kernel).
+  local best=xla best_ms=""
+  local XL
+  XL=$(last_val flash_xla_fwdbwd_ms)
+  for cand in loop2 ddpre; do
+    if [ "$(last_val ${cand}_causal)" = PASS ] \
+       && [ "$(last_val ${cand}_full)" = PASS ] \
+       && [ "$(last_val swa_${cand})" = PASS ]; then
+      local MS
+      MS=$(last_val flash_${cand}_fwdbwd_ms)
+      if [ -n "$MS" ] && [ -n "$XL" ] && awk "BEGIN{exit !($MS <= $XL)}"; then
+        if [ -z "$best_ms" ] || awk "BEGIN{exit !($MS < $best_ms)}"; then
+          best=$cand; best_ms=$MS
+        fi
+      fi
+    fi
+  done
+  echo "$best"
+}
+
+while :; do
+  if [ -f bench_r5_headline.jsonl.done ] && [ -f bench_r5_suite.jsonl.done ] \
+     && { [ ! -f probe_flash_r5.py ] || [ -f probe_flash_r5.txt.done ]; } \
+     && { [ ! -f probe_resnet.py ] || [ -f probe_resnet.txt.done ]; } \
+     && { [ ! -f probe_flash_xlabwd.py ] || [ -f probe_flash_xlabwd.txt.done ]; }; then
+    echo "all stages captured at $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> tunnel_watch3.log
+    python tunnel_status.py >/dev/null 2>&1
+    exit 0
+  fi
+  iters=$(( iters + 1 ))
+  if [ "$iters" -gt "$max_iters" ]; then
+    echo "tunnel_watch3: iteration budget reached" >> tunnel_watch3.log
+    python tunnel_status.py >/dev/null 2>&1
+    exit 1
+  fi
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
+" >/dev/null 2>&1; then
+    echo "=== tunnel alive at $(date -u +%Y-%m-%dT%H:%M:%SZ) ===" >> tunnel_watch3.log
+    python tunnel_status.py --alive 1 >/dev/null 2>&1
+    { stage bench_r5_headline.jsonl 330 \
+        env KFT_BENCH_RESUME=1 KFT_BENCH_DEADLINE_S=280 \
+        python bench.py --headline \
+      && { [ ! -f probe_flash_r5.py ] \
+           || stage probe_flash_r5.txt 1500 python -u probe_flash_r5.py; } \
+      && { BWD=$(pick_flash_bwd)
+           echo "bench KFT_FLASH_BWD_IMPL=$BWD" >> tunnel_watch3.log
+           stage bench_r5_suite.jsonl 3600 \
+             env KFT_BENCH_RESUME=1 KFT_BENCH_DEADLINE_S=3500 \
+                 KFT_FLASH_BWD_IMPL=$BWD \
+             python bench.py --suite; } \
+      && { [ ! -f probe_resnet.py ] \
+           || stage probe_resnet.txt 1200 python -u probe_resnet.py; } \
+      && { [ ! -f probe_flash_xlabwd.py ] \
+           || stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py; } ; } \
+      || sleep 120
+    python tunnel_status.py >/dev/null 2>&1
+  else
+    python tunnel_status.py --alive 0 >/dev/null 2>&1
+    sleep 180
+  fi
+done
