@@ -598,15 +598,27 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, dd_ref,
 #               the dd operand entirely: the kernels take the forward
 #               output tile o (a normal (block_q, d) operand, like dO)
 #               and recompute D = Σ_d dO∘O in-kernel in f32.
+#   "ddpre"   — r5 fix candidate B (VERDICT r4 weak #2: one window, one
+#               candidate). Keeps the loop kernels' dd operand but
+#               produces it with a TRIVIAL pallas pre-kernel instead of
+#               an XLA reduction — so the (BH, Lq, 1) row-stat array is
+#               pallas-laid-out exactly like the forward's lse, which the
+#               same kernels read cleanly. If the producer-layout theory
+#               is right, ddpre passes; if ddpre NaNs while loop2 passes,
+#               the bug is the lane-dim-1 CONSUMER BlockSpec itself.
+#               Either way one window yields a decisive answer AND at
+#               least one working pallas backward (or a minimal
+#               reproducer for a backend bug).
 # All variants are numerically identical in interpret/CPU mode
 # (test_ring_attention pins it).
-# KFT_FLASH_BWD_IMPL overrides the default: tunnel_watch2.sh sets it to
-# loop2 for the bench capture iff probe_flash_r4 records loop2 as BOTH
-# Mosaic-PASS and at-least-as-fast as the xla backward — so a single
-# window can validate the fix AND benchmark through it.
+# KFT_FLASH_BWD_IMPL overrides the default: tunnel_watch3.sh flips the
+# bench capture onto whichever candidate probe_flash_r5 records as
+# Mosaic-PASS (causal AND full AND sliding-window) and fastest, if that
+# is at-least-as-fast as the xla backward — so a single window can
+# validate a fix AND benchmark through it.
 import os as _os  # noqa: E402
 
-_FLASH_BWD_IMPLS = ("xla", "loop2", "loop", "scratch")
+_FLASH_BWD_IMPLS = ("xla", "loop2", "ddpre", "loop", "scratch")
 FLASH_BWD_IMPL = _os.environ.get("KFT_FLASH_BWD_IMPL", "xla")
 if FLASH_BWD_IMPL not in _FLASH_BWD_IMPLS:
     raise ValueError(
@@ -988,6 +1000,30 @@ def _flash_backward_loop(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
     return dqf, dkf, dvf, dbias_bh
 
 
+def _dd_prekernel(gf, of, *, b, h, lq, d, block_q, n_q, interpret):
+    """D = Σ_d dO∘O produced by a trivial pallas kernel, so the
+    (BH, Lq, 1) row-stat operand the loop kernels read through their
+    lane-dim-1 BlockSpec is PALLAS-laid-out — exactly like the forward's
+    lse, which those kernels demonstrably read cleanly on hardware
+    (r3 probe: dv correct ⇒ p ⇒ lse fine). Fix candidate B for the
+    Mosaic dd NaN (see FLASH_BWD_IMPL "ddpre" note)."""
+    def kernel(do_ref, o_ref, dd_ref):
+        dd_ref[0] = (do_ref[0].astype(jnp.float32)
+                     * o_ref[0].astype(jnp.float32)).sum(-1, keepdims=True)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq: (bh, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1), lambda bh, iq: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, 1), jnp.float32),
+        interpret=interpret,
+    )(gf, of)
+
+
 def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
                     impl: str | None = None, window: int = 0):
     b, lq, h, d = q.shape
@@ -1030,9 +1066,15 @@ def _flash_backward(q, k, v, bias, o, lse, g, block_q, block_k, causal,
         dbias = dbias[:, None, :, :].astype(bias.dtype)  # (B, 1, 1, Lk)
         return unfold(dqf, lq), unfold(dkf, lk), unfold(dvf, lk), dbias
 
-    if (impl or FLASH_BWD_IMPL) == "loop":
+    if (impl or FLASH_BWD_IMPL) in ("loop", "ddpre"):
+        # same loop kernels either way; ddpre differs ONLY in who produces
+        # the dd operand (pallas pre-kernel vs XLA reduction) — the exact
+        # single-variable experiment the r3 forensics call for
+        dd = (_dd_prekernel(gf, of, b=b, h=h, lq=lq, d=d, block_q=block_q,
+                            n_q=n_q, interpret=interpret)
+              if (impl or FLASH_BWD_IMPL) == "ddpre" else _dd())
         dqf, dkf, dvf, dbias_bh = _flash_backward_loop(
-            qf, kf, vf, bias, gf, lse, _dd(), b=b, h=h, lq=lq, lk=lk, d=d,
+            qf, kf, vf, bias, gf, lse, dd, b=b, h=h, lq=lq, lk=lk, d=d,
             scale=scale, block_q=block_q, block_k=block_k, n_q=n_q,
             n_kv=n_kv, causal=causal, interpret=interpret,
             out_dtypes=(q.dtype, k.dtype, v.dtype), window=window,

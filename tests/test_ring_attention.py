@@ -265,10 +265,10 @@ class TestFlashBackwardImpls:
         grads = {
             impl: _flash_backward(q, k, v, bias, out, lse, g, 8, 8, causal,
                                   impl=impl)
-            for impl in ("scratch", "loop", "loop2", "xla")
+            for impl in ("scratch", "loop", "loop2", "ddpre", "xla")
         }
         ref = grads["scratch"]
-        for impl in ("loop", "loop2", "xla"):
+        for impl in ("loop", "loop2", "ddpre", "xla"):
             for name, x, y in zip(("dq", "dk", "dv", "dbias"),
                                   ref, grads[impl]):
                 np.testing.assert_allclose(
@@ -350,7 +350,7 @@ class TestSlidingWindowFlash:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
-    @pytest.mark.parametrize("impl", ["xla", "loop", "loop2", "scratch"])
+    @pytest.mark.parametrize("impl", ["xla", "loop", "loop2", "ddpre", "scratch"])
     @pytest.mark.parametrize("window", [5, 12])
     def test_all_backward_impls_match_dense_grads(self, impl, window):
         from kubeflow_tpu.parallel import ring_attention as ra

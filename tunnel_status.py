@@ -63,13 +63,34 @@ def _mtime(path: str) -> str:
 
 
 def _windows_seen() -> list[str]:
-    lines = []
+    """Distinct live windows from the watcher log: consecutive 'alive'
+    polls <20 min apart are the SAME window (one window survives several
+    loop iterations when a stage inside it fails and the loop re-polls) —
+    counting raw alive lines would overstate how often the tunnel opens,
+    the exact stat the capture plan is calibrated against."""
+    stamps = []
     try:
         with open(os.path.join(HERE, WATCH_LOG)) as fh:
-            lines = [ln.strip() for ln in fh if "tunnel alive" in ln]
+            for ln in fh:
+                if "tunnel alive" in ln and " at " in ln:
+                    stamp = ln.strip().split(" at ")[1].split(" ")[0]
+                    try:
+                        t = time.mktime(time.strptime(
+                            stamp, "%Y-%m-%dT%H:%M:%SZ"))
+                    except ValueError:
+                        continue
+                    stamps.append((t, stamp))
     except OSError:
         pass
-    return lines
+    windows: list[str] = []
+    last_t = None
+    for t, stamp in stamps:
+        if last_t is None or t - last_t > 20 * 60:
+            windows.append(f"window opened {stamp}")
+        else:
+            windows[-1] = windows[-1].split(" — ")[0] + f" — last alive {stamp}"
+        last_t = t
+    return windows
 
 
 def main() -> None:

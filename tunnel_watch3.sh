@@ -93,22 +93,31 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
 " >/dev/null 2>&1; then
     echo "=== tunnel alive at $(date -u +%Y-%m-%dT%H:%M:%SZ) ===" >> tunnel_watch3.log
     python tunnel_status.py --alive 1 >/dev/null 2>&1
-    { stage bench_r5_headline.jsonl 330 \
-        env KFT_BENCH_RESUME=1 KFT_BENCH_DEADLINE_S=280 \
-        python bench.py --headline \
-      && { [ ! -f probe_flash_r5.py ] \
-           || stage probe_flash_r5.txt 1500 python -u probe_flash_r5.py; } \
-      && { BWD=$(pick_flash_bwd)
-           echo "bench KFT_FLASH_BWD_IMPL=$BWD" >> tunnel_watch3.log
-           stage bench_r5_suite.jsonl 3600 \
-             env KFT_BENCH_RESUME=1 KFT_BENCH_DEADLINE_S=3500 \
-                 KFT_FLASH_BWD_IMPL=$BWD \
-             python bench.py --suite; } \
-      && { [ ! -f probe_resnet.py ] \
-           || stage probe_resnet.txt 1200 python -u probe_resnet.py; } \
-      && { [ ! -f probe_flash_xlabwd.py ] \
-           || stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py; } ; } \
-      || sleep 120
+    # headline gates the rest (its failure means the window died); the
+    # flash probe is BEST-EFFORT before the suite — it resumes by
+    # skipping sections whose RESULT keys the appended artifact already
+    # holds, and pick_flash_bwd tolerates a partial artifact (falls back
+    # to xla), so a slow probe can never starve the suite's
+    # never-captured rows (the r4 failure mode)
+    if stage bench_r5_headline.jsonl 330 \
+         env KFT_BENCH_RESUME=1 KFT_BENCH_DEADLINE_S=280 \
+         python bench.py --headline; then
+      [ ! -f probe_flash_r5.py ] \
+        || stage probe_flash_r5.txt 900 python -u probe_flash_r5.py \
+        || true
+      BWD=$(pick_flash_bwd)
+      echo "bench KFT_FLASH_BWD_IMPL=$BWD" >> tunnel_watch3.log
+      stage bench_r5_suite.jsonl 3600 \
+          env KFT_BENCH_RESUME=1 KFT_BENCH_DEADLINE_S=3500 \
+              KFT_FLASH_BWD_IMPL=$BWD \
+          python bench.py --suite \
+        && { [ ! -f probe_resnet.py ] \
+             || stage probe_resnet.txt 1200 python -u probe_resnet.py; } \
+        && { [ ! -f probe_flash_xlabwd.py ] \
+             || stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py; }
+    else
+      sleep 120
+    fi
     python tunnel_status.py >/dev/null 2>&1
   else
     python tunnel_status.py --alive 0 >/dev/null 2>&1
