@@ -28,6 +28,8 @@ from kubeflow_tpu.models.resnet import (
     ResNet50,
     ResNet101,
     ResNet152,
+    s2d_pack,
+    stem_weights_7x7_to_s2d,
 )
 
 __all__ = [
@@ -51,4 +53,6 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ResNet152",
+    "s2d_pack",
+    "stem_weights_7x7_to_s2d",
 ]

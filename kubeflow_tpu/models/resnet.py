@@ -26,6 +26,37 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+def s2d_pack(x):
+    """Space-to-depth 2x2 pack: (B, H, W, C) -> (B, H/2, W/2, 4C).
+
+    Channel order: c' = di*2C + dj*C + c for the (di, dj) sub-pixel — the
+    layout `stem_weights_7x7_to_s2d` assumes. The packed stem trades the
+    lane-starved K=49*3=147 stem GEMM for a lane-denser K=16*12=192 one
+    with identical FLOPs (probe_resnet.py section B measures the win)."""
+    b, h, w, c = x.shape
+    return x.reshape(b, h // 2, 2, w // 2, 2, c).transpose(
+        0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+
+
+def stem_weights_7x7_to_s2d(w7):
+    """EXACT weight transform: 7x7/s2 SAME stem kernel -> the equivalent
+    4x4/s1 kernel over the s2d-packed input.
+
+    On an even input, SAME for k=7/s=2 pads (2, 3); the 7x7 kernel
+    embeds in an 8x8/s2 kernel with a trailing zero row/col
+    (w8[:7, :7] = w7, taps at rows 2i-2 .. 2i+5 with the +5 tap zero).
+    An 8x8/s2 conv equals a 4x4/s1 conv on the packed input with
+    w4[u, v, di*2C+dj*C+c, o] = w8[2u+di, 2v+dj, c, o] and packed
+    padding (1, 2), output exactly H/2 — so logits match the 7x7 model
+    to dtype rounding (pinned by tests/test_models_resnet.py)."""
+    kh, kw, cin, cout = w7.shape
+    assert (kh, kw) == (7, 7), w7.shape
+    w8 = jnp.zeros((8, 8, cin, cout), w7.dtype).at[:7, :7].set(w7)
+    # split each 8-tap axis a = 2u + di into (u, di)
+    w4 = w8.reshape(4, 2, 4, 2, cin, cout).transpose(0, 2, 1, 3, 4, 5)
+    return w4.reshape(4, 4, 4 * cin, cout)
+
+
 class BottleneckBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut on shape change."""
 
@@ -97,15 +128,32 @@ class ResNet(nn.Module):
     # below matmul" reading was per-dispatch-floor pollution: r3's fused
     # device-born steps ran FASTER through lax.conv (docs/perf.md), and
     # the live chip registers backend "tpu", so auto == xla there.
-    # probe_resnet.py carries the per-shape A/B that settles it for good.
-    conv_impl: str = "auto"
+    # probe_resnet.py carries the per-shape A/B that settles it per shape.
+    # PER-STAGE override: a sequence of 5 impls (stem, stage1..stage4) —
+    # e.g. ("im2col", "xla", "xla", "xla", "xla") — so a probe verdict
+    # like "im2col wins only at the lane-starved shapes" is shippable as
+    # a config flip, no model surgery.
+    conv_impl: str | Sequence[str] = "auto"
+    # Stem variant: "7x7" = canonical 7x7/s2 + maxpool; "s2d" = space-to-
+    # depth 2x2 pack + 4x4/s1 conv (+ the same maxpool) — identical math
+    # under `stem_weights_7x7_to_s2d` (exact, tested), lane-denser GEMM
+    # (K 147 -> 192). Shipped as config so a probe_resnet verdict flips
+    # the bench via KFT_RESNET_STEM with zero code change.
+    stem: str = "7x7"
 
-    def _conv_cls(self) -> ModuleDef:
+    def _impl_for(self, stage: int) -> str:
+        """stage 0 = stem, 1..4 = residual stages."""
         impl = self.conv_impl
+        if not isinstance(impl, str):
+            impl = impl[stage]
         if impl == "auto":
             import jax
 
             impl = "im2col" if jax.default_backend() == "axon" else "xla"
+        return impl
+
+    def _conv_cls(self, stage: int = 0) -> ModuleDef:
+        impl = self._impl_for(stage)
         if impl == "im2col":
             from kubeflow_tpu.models.conv import ConvCompat
 
@@ -119,7 +167,8 @@ class ResNet(nn.Module):
         if x.ndim == 2:  # flat grayscale vectors (mnist-style fixtures)
             side = int(x.shape[-1] ** 0.5)
             x = x.reshape((x.shape[0], side, side, 1))
-        conv = partial(self._conv_cls(), use_bias=False, dtype=self.dtype)
+        stem_conv = partial(self._conv_cls(0), use_bias=False,
+                            dtype=self.dtype)
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,
@@ -129,13 +178,25 @@ class ResNet(nn.Module):
         )
         x = x.astype(self.dtype)
         if self.small_inputs:
-            x = conv(self.width, (3, 3), name="conv_init")(x)
+            x = stem_conv(self.width, (3, 3), name="conv_init")(x)
+        elif self.stem == "s2d":
+            x = s2d_pack(x)
+            # SAME for k=4/s=1 pads (1,2) — exactly the 7x7/s2 SAME
+            # receptive field (see stem_weights_7x7_to_s2d); default
+            # padding keeps the stem compatible with ConvCompat/im2col,
+            # which supports SAME only. Output is H/2 x W/2.
+            x = stem_conv(self.width, (4, 4), name="conv_init")(x)
+        elif self.stem == "7x7":
+            x = stem_conv(self.width, (7, 7), strides=(2, 2),
+                          name="conv_init")(x)
         else:
-            x = conv(self.width, (7, 7), strides=(2, 2), name="conv_init")(x)
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = nn.relu(norm(name="bn_init")(x))
         if not self.small_inputs:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, n_blocks in enumerate(self.stage_sizes):
+            conv = partial(self._conv_cls(i + 1), use_bias=False,
+                           dtype=self.dtype)
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
                 x = self.block_cls(

@@ -1,0 +1,49 @@
+"""Shared machinery for the staged hardware probes (probe_flash_r5,
+probe_resnet): cross-window resume banking and the ERROR-exit contract.
+
+The watcher (tunnel_watch3.sh) appends probe stdout to the artifact on
+every exit path and marks `.done` only on exit 0. Probes therefore:
+  - skip work whose RESULT keys are already BANKED (recorded with a
+    non-ERROR value) so successive short windows converge;
+  - exit nonzero when any section recorded ERROR this run, so the stage
+    is NOT marked done and the un-banked ERROR keys retry at the next
+    window (a deterministic ERROR re-runs cheaply — everything else is
+    banked and skipped).
+This mirrors bench.py's last-line-per-metric capture contract and
+tunnel_watch3.sh's last_val parsing.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ERRORS: list[str] = []
+
+
+def banked_keys(artifact: str) -> set[str]:
+    """RESULT keys recorded with a non-ERROR value in the appended
+    artifact (KFT_PROBE_ARTIFACT overrides the path for tests)."""
+    path = os.environ.get("KFT_PROBE_ARTIFACT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), artifact)
+    keys: set[str] = set()
+    try:
+        with open(path) as fh:
+            for ln in fh:
+                if ln.startswith("RESULT ") and "=" in ln:
+                    key, val = ln[len("RESULT "):].split("=", 1)
+                    if val.split(None, 1)[0].strip() != "ERROR":
+                        keys.add(key.strip())
+    except OSError:
+        pass
+    return keys
+
+
+def record_error(key: str) -> None:
+    """Note an ERROR verdict so exit_code() keeps the stage retryable."""
+    _ERRORS.append(key)
+
+
+def exit_code() -> int:
+    """0 = everything this run succeeded or was banked; 2 = at least one
+    section ERRORed (stage stays un-done; banked keys still skip)."""
+    return 2 if _ERRORS else 0

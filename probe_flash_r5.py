@@ -61,27 +61,14 @@ def _watchdog():
 threading.Thread(target=_watchdog, daemon=True).start()
 
 
+import probe_common
+
+
 def _banked_keys() -> set[str]:
-    """RESULT keys already in the appended artifact from earlier partial
-    windows. tunnel_watch3's stage() appends on every exit path, so a
-    section whose sentinel keys are banked is SKIPPED on re-run — the
-    probe, like bench.py, must converge across short windows instead of
-    restarting at section A every time."""
-    keys: set[str] = set()
-    path = os.environ.get("KFT_PROBE_ARTIFACT") or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "probe_flash_r5.txt")
-    try:
-        with open(path) as fh:
-            for ln in fh:
-                if ln.startswith("RESULT ") and "=" in ln:
-                    key, val = ln[len("RESULT "):].split("=", 1)
-                    # PASS/FAIL/measurements are verdicts and bank;
-                    # ERROR may be transient (window died mid-op) — retry
-                    if val.split(None, 1)[0].strip() != "ERROR":
-                        keys.add(key.strip())
-    except OSError:
-        pass
-    return keys
+    """Cross-window resume: sections whose RESULT keys are banked are
+    SKIPPED on re-run (probe_common; ERROR values never bank — the probe
+    exits nonzero on any ERROR so the stage stays retryable)."""
+    return probe_common.banked_keys("probe_flash_r5.txt")
 
 
 def main() -> None:
@@ -170,9 +157,11 @@ def main() -> None:
                 except Exception as exc:  # noqa: BLE001 — verdict, not crash
                     print(f"RESULT {impl}_{tag}=ERROR {type(exc).__name__}",
                           flush=True)
+                    probe_common.record_error(f"{impl}_{tag}")
                 _pet()
         except Exception as exc:  # noqa: BLE001
             print(f"RESULT setup_{tag}=ERROR {type(exc).__name__}", flush=True)
+            probe_common.record_error(f"setup_{tag}")
             traceback.print_exc(file=sys.stderr)
             _pet()
 
@@ -225,11 +214,13 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 print(f"RESULT swa_{impl}=ERROR {type(exc).__name__}",
                       flush=True)
+                probe_common.record_error(f"swa_{impl}")
             _pet()
     except StopIteration:
         pass  # banked by an earlier window
     except Exception as exc:  # noqa: BLE001
         print(f"RESULT swa_setup=ERROR {type(exc).__name__}", flush=True)
+        probe_common.record_error("swa_setup")
         _pet()
 
     # ---------------- B/C: term bisect, host-fed then device-fed ---------
@@ -298,6 +289,7 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 print(f"RESULT {label}_{term}=ERROR {type(exc).__name__}",
                       flush=True)
+                probe_common.record_error(f"{label}_{term}")
             _pet()
 
     try:
@@ -312,6 +304,7 @@ def main() -> None:
                   jax.device_put(dd_host[None]))
     except Exception as exc:  # noqa: BLE001
         print(f"RESULT host_terms=ERROR {type(exc).__name__}", flush=True)
+        probe_common.record_error("host_terms")
         _pet()
 
     try:
@@ -334,6 +327,7 @@ def main() -> None:
         run_terms("dev", lse_dev, dd_dev)
     except Exception as exc:  # noqa: BLE001
         print(f"RESULT dev_terms=ERROR {type(exc).__name__}", flush=True)
+        probe_common.record_error("dev_terms")
         traceback.print_exc(file=sys.stderr)
         _pet()
         of_dev = None
@@ -357,6 +351,7 @@ def main() -> None:
         run_terms("pre", lse_dev, dd_pre)
     except Exception as exc:  # noqa: BLE001
         print(f"RESULT pre_terms=ERROR {type(exc).__name__}", flush=True)
+        probe_common.record_error("pre_terms")
         traceback.print_exc(file=sys.stderr)
         _pet()
 
@@ -403,6 +398,7 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             print(f"RESULT flash_{impl}_timing=ERROR {type(exc).__name__}",
                   flush=True)
+            probe_common.record_error(f"flash_{impl}_timing")
         _pet()
     ra.FLASH_BWD_IMPL = "xla"
 
@@ -411,3 +407,4 @@ def main() -> None:
 
 if __name__ == "__main__":
     main()
+    sys.exit(probe_common.exit_code())

@@ -76,3 +76,51 @@ def test_resnet_bf16_compute():
     assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(state.params))
     state, m = trainer.train_step(state, (ds.x_train[:16], ds.y_train[:16]))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_s2d_stem_exactly_matches_7x7(monkeypatch):
+    """VERDICT r4 #3: the space-to-depth stem must be a SHIPPED config
+    option whose numerics equal the canonical 7x7/s2 stem under the exact
+    weight transform — so a positive probe verdict flips the bench via
+    flags with no re-training story needed."""
+    from kubeflow_tpu.models import ResNet, stem_weights_7x7_to_s2d
+    from kubeflow_tpu.models.resnet import BottleneckBlock
+
+    kw = dict(stage_sizes=(1, 1), block_cls=BottleneckBlock, num_classes=7,
+              width=8, dtype=jnp.float32)
+    m7 = ResNet(stem="7x7", **kw)
+    ms = ResNet(stem="s2d", **kw)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3), jnp.float32)
+    v7 = jax.jit(m7.init)(jax.random.PRNGKey(1), x)
+    vs = jax.jit(ms.init)(jax.random.PRNGKey(2), x)
+    assert vs["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 8)
+    # graft the transformed 7x7 stem weights into the s2d model
+    vs = jax.tree_util.tree_map(lambda a: a, vs)  # deep copy via rebuild
+    params = dict(v7["params"])
+    params["conv_init"] = {
+        "kernel": stem_weights_7x7_to_s2d(
+            v7["params"]["conv_init"]["kernel"])}
+    y7 = m7.apply({"params": v7["params"],
+                   "batch_stats": v7["batch_stats"]}, x)
+    ys = ms.apply({"params": params,
+                   "batch_stats": v7["batch_stats"]}, x)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(y7),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_per_stage_conv_impl_smoke():
+    """conv_impl as a 5-tuple (stem, stage1..4) lowers each stage through
+    its own conv path and matches the single-impl model's numerics."""
+    from kubeflow_tpu.models import ResNet
+    from kubeflow_tpu.models.resnet import BottleneckBlock
+
+    kw = dict(stage_sizes=(1, 1), block_cls=BottleneckBlock, num_classes=5,
+              width=8, dtype=jnp.float32)
+    ref = ResNet(conv_impl="xla", **kw)
+    mix = ResNet(conv_impl=("im2col", "xla", "im2col"), **kw)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3), jnp.float32)
+    v = jax.jit(ref.init)(jax.random.PRNGKey(1), x)
+    y_ref = ref.apply(v, x)
+    y_mix = mix.apply(v, x)  # param-compatible by construction
+    np.testing.assert_allclose(np.asarray(y_mix), np.asarray(y_ref),
+                               rtol=2e-5, atol=2e-5)

@@ -114,7 +114,8 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
         && { [ ! -f probe_resnet.py ] \
              || stage probe_resnet.txt 1200 python -u probe_resnet.py; } \
         && { [ ! -f probe_flash_xlabwd.py ] \
-             || stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py; }
+             || stage probe_flash_xlabwd.txt 900 python -u probe_flash_xlabwd.py; } \
+        || sleep 120   # fast-failing stage must not spin the poll budget
     else
       sleep 120
     fi
