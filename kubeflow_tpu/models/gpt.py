@@ -297,13 +297,16 @@ class CausalSelfAttention(nn.Module):
             ck.value = ck.value.at[:, slots].set(k)
             cv.value = cv.value.at[:, slots].set(v)
         else:
-            # prefill (L > 1): all rows start together (generate and the
-            # continuous engine both prefill from index 0 per call), so a
-            # single dynamic_update_slice does the write
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k, (0, cur[0], 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v, (0, cur[0], 0, 0))
+            # block write (L > 1) at PER-ROW depths: a vmapped per-row
+            # dynamic_update_slice — all-rows-equal prefill (generate,
+            # engine admission) is the special case, and rows at DIFFERENT
+            # depths (the continuous engine's speculative verify pass,
+            # serving/continuous.py) write each at their own index
+            def row_write(buf, kv, start):
+                return jax.lax.dynamic_update_slice(buf, kv, (start, 0, 0))
+
+            ck.value = jax.vmap(row_write)(ck.value, k, cur)
+            cv.value = jax.vmap(row_write)(cv.value, v, cur)
         idx.value = cur + l
         qg = q.reshape(b, l, kvh, h // kvh, d)
         s = jnp.einsum("blkgd,bmkd->bkglm", qg, ck.value).astype(jnp.float32)
@@ -357,12 +360,19 @@ class GPTBlock(nn.Module):
         x = constrain(x + y, ACT_SPEC)
         h = nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x)
         if c.moe_experts:
+            # short decode blocks route DROPLESS (no capacity, row-
+            # independent) so KV-cache decode — solo, continuous-batched,
+            # or speculative verify — never couples rows through the drop
+            # pattern; long blocks (prompt prefill) keep routed dispatch
+            # (dense-all-experts at L=1k would multiply prefill MLP FLOPs
+            # by E/k). MOE_DROPLESS_MAX_LEN is module-level (defined
+            # below; resolved at call time).
             h = MoeMlp(
                 hidden_size=c.hidden_size, mlp_dim=c.mlp_dim,
                 num_experts=c.moe_experts, top_k=c.moe_top_k,
                 capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
                 name="moe",
-            )(h)
+            )(h, dropless=decode and x.shape[1] <= MOE_DROPLESS_MAX_LEN)
         else:
             h = nn.gelu(nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_up")(h))
             h = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_down")(h)
@@ -422,6 +432,39 @@ class GPTLM(nn.Module):
 
 
 GPTLM.PARTITION_RULES = PARTITION_RULES
+
+
+# Decode blocks at or under this many tokens route MoE DROPLESS (dense
+# all-experts — row-independent, exact for continuous batching and
+# speculative verify); longer blocks (prompt prefill) keep the routed
+# capacity dispatch whose FLOPs scale with top_k, not num_experts. The
+# engine prefills batch-1, so routed prefill is trivially row-independent
+# there, and solo generate() takes the identical branch per shape — the
+# engine-equals-solo exactness contract holds on both sides of the
+# threshold.
+MOE_DROPLESS_MAX_LEN = 16
+
+
+def set_cache_indices(cache: dict, values=None, active=None) -> dict:
+    """Rewrite every layer's per-row cache_index (and the LM's pos_index).
+
+    The ONE owner of the cache-index contract (speculative rewind, the
+    continuous engine's row parking and spec-round rewind all route here —
+    three hand-rolled copies diverged before). values: scalar or (B,)
+    replacement; None keeps the existing value. active: (B,) bool mask —
+    rows where it is False park at 0 (so free rows' garbage decode can
+    never creep an index past max_len)."""
+    def fix(path, leaf):
+        name = getattr(path[-1], "key", path[-1]) if path else ""
+        if name in ("cache_index", "pos_index"):
+            vals = (leaf if values is None else jnp.broadcast_to(
+                jnp.asarray(values), leaf.shape).astype(leaf.dtype))
+            if active is not None:
+                vals = jnp.where(active, vals, 0)
+            return vals
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
 
 
 def generate(

@@ -26,21 +26,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from kubeflow_tpu.models.gpt import GPTLM
+from kubeflow_tpu.models.gpt import GPTLM, set_cache_indices
 
-
-def _set_cache_index(cache: dict, value) -> dict:
-    """Rewind/advance every layer's cache_index (and the LM's pos_index)
-    to `value` — the whole cost of rejecting speculated tokens."""
-    def fix(path, leaf):
-        name = getattr(path[-1], "key", path[-1]) if path else ""
-        if name in ("cache_index", "pos_index"):
-            # indices are per-row (B,) vectors; speculative is batch-1 so
-            # one value fills every row
-            return jnp.full_like(leaf, value)
-        return leaf
-
-    return jax.tree_util.tree_map_with_path(fix, cache)
+# Rewind/advance every layer's cache_index (and the LM's pos_index) —
+# the whole cost of rejecting speculated tokens. One shared owner of the
+# index-rewrite contract (models/gpt.py); batch-1 here, so one scalar
+# fills every row.
+_set_cache_index = set_cache_indices
 
 
 def speculative_generate(

@@ -105,7 +105,7 @@ class MoeMlp(nn.Module):
     global_dispatch: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, dropless: bool = False) -> jax.Array:
         h, f, e = self.hidden_size, self.mlp_dim, self.num_experts
         router = self.param(
             "router", nn.initializers.normal(stddev=0.02), (h, e), jnp.float32
@@ -115,6 +115,32 @@ class MoeMlp(nn.Module):
         b_up = self.param("b_up", nn.initializers.zeros, (e, f))
         w_down = self.param("w_down", init, (e, f, h))
         b_down = self.param("b_down", nn.initializers.zeros, (e, h))
+
+        if dropless:
+            # DROPLESS routing — the decode path (VERDICT r4 #6). Every
+            # token gets its full top-k combine, no capacity, no cumsum:
+            # each token's output depends only on ITS hidden state, so
+            # rows are independent and continuous batching / speculative
+            # verify compose with MoE exactly (capacity dispatch couples
+            # rows: the drop pattern depends on batch composition).
+            # Cost: every expert runs on every token — at decode widths
+            # (1..gamma+1 tokens/row) the weights stream from HBM anyway
+            # (bandwidth-bound), so the extra FLOPs ride the same bytes.
+            # No aux loss: decode never trains.
+            b, l, _ = x.shape
+            xt = x.reshape(b * l, h)
+            logits = xt.astype(jnp.float32) @ router        # (T, E)
+            probs = jax.nn.softmax(logits, axis=-1)
+            gates, idx = jax.lax.top_k(probs, self.top_k)
+            gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+            weight = (jax.nn.one_hot(idx, e, dtype=jnp.float32)
+                      * gates[..., None]).sum(1)            # (T, E)
+            up = jnp.einsum("th,ehf->etf", xt, w_up.astype(xt.dtype))
+            act = nn.gelu(up + b_up.astype(xt.dtype)[:, None, :])
+            down = jnp.einsum("etf,efh->eth", act, w_down.astype(xt.dtype))
+            down = down + b_down.astype(xt.dtype)[:, None, :]
+            y = jnp.einsum("te,eth->th", weight.astype(xt.dtype), down)
+            return y.reshape(b, l, h)
 
         mesh = jax.sharding.get_abstract_mesh()
         ep = 1 if mesh.empty else mesh.shape.get(AXIS_EXPERT, 1)

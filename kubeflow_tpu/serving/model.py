@@ -338,6 +338,16 @@ class JaxModel(Model):
             module, variables, self.config = load_generative_model(
                 self.model_dir)
             eos = gen.get("eos_token_id")
+            # speculative continuous serving: a second model dir provides
+            # the draft (same pattern as the CLI's --draft-model-dir);
+            # relative paths resolve against the target's model dir
+            draft_module = draft_variables = None
+            if gen.get("continuous_draft_dir"):
+                ddir = Path(gen["continuous_draft_dir"])
+                if not ddir.is_absolute():
+                    ddir = self.model_dir / ddir
+                draft_module, draft_variables, _ = load_generative_model(
+                    ddir)
             self._engine = ContinuousBatcher(
                 module, variables,
                 max_rows=int(gen.get("continuous_rows", 8)),
@@ -349,6 +359,9 @@ class JaxModel(Model):
                 prefill_buckets=(
                     tuple(gen["continuous_prefill_buckets"])
                     if gen.get("continuous_prefill_buckets") else None),
+                draft_module=draft_module,
+                draft_variables=draft_variables,
+                gamma=int(gen.get("speculative_gamma", 4)),
             ).start()
             self.ready = True
             return

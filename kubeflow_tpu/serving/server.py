@@ -150,12 +150,19 @@ class ModelServer:
                 eng = getattr(m, "_engine", None)
                 if eng is None:
                     continue
+                # gauges are instantaneous best-effort reads: the ticker
+                # mutates _rows/step_count OUTSIDE the engine lock by
+                # design (the lock guards only the submit queue — see
+                # tick()'s locking note), so only _queue needs the lock;
+                # a mid-tick read can be off by one row/dispatch, which a
+                # scrape-interval consumer cannot observe
                 busy = sum(1 for r in eng._rows if r is not None)
+                dispatches = eng.step_count
                 with eng._lock:
                     queued = len(eng._queue)
                 eng_lines += [
                     f'kfserving_engine_decode_dispatches_total'
-                    f'{{model="{name}"}} {eng.step_count}',
+                    f'{{model="{name}"}} {dispatches}',
                     f'kfserving_engine_rows_busy{{model="{name}"}} {busy}',
                     f'kfserving_engine_rows_total{{model="{name}"}} '
                     f'{eng.max_rows}',
@@ -167,6 +174,7 @@ class ModelServer:
                     ["# TYPE kfserving_engine_decode_dispatches_total "
                      "counter",
                      "# TYPE kfserving_engine_rows_busy gauge",
+                     "# TYPE kfserving_engine_rows_total gauge",
                      "# TYPE kfserving_engine_queue_depth gauge"]
                     + eng_lines) + "\n"
             return 200, text

@@ -197,6 +197,25 @@ def test_bench_continuous_serve_smoke(monkeypatch):
     assert r["model_flops_per_step"] == 2 * n_params * 2 * 8
 
 
+def test_bench_spec_serve_smoke(monkeypatch):
+    """Speculative-continuous bench runs end-to-end (tiny dims on CPU):
+    self-draft means every round accepts gamma tokens, so the dispatch
+    count sits near requests*new_tokens/(rows*(gamma+1))."""
+    import bench
+    from kubeflow_tpu import models
+
+    monkeypatch.setattr(
+        models.GPTConfig, "small",
+        staticmethod(lambda **kw: models.GPTConfig.tiny(**kw)),
+    )
+    r = bench.bench_gpt2s_spec_serve(
+        rows=2, n_requests=4, prompt_len=8, new_tokens=8, gamma=3)
+    assert r["metric"] == "gpt2s_spec_serve_tokens_per_sec_per_chip"
+    assert r["value"] > 0 and r["gamma"] == 3
+    # 4 requests x 8 tokens through 2 rows at 4 tokens/round = 4 dispatches
+    assert r["decode_dispatches"] <= 5
+
+
 def test_bench_rolling_decode_smoke(monkeypatch):
     import bench
     from kubeflow_tpu import models

@@ -59,13 +59,27 @@ class TestExactness:
         assert out[-1] == eos and len(out) == 5  # stopped AT the eos
         np.testing.assert_array_equal(out, plain[:5])
 
-    def test_moe_model_refused(self):
-        cfg = GPTConfig.tiny(dropout_rate=0.0, moe_experts=2)
+    def test_moe_rows_match_solo_decode(self):
+        """MoE models serve through the engine EXACTLY (VERDICT r4 #6):
+        decode routes dropless (parallel/moe.py), so a row's output never
+        depends on which other rows share the batch — pinned per row
+        against solo generate() with mixed in-flight depths."""
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96, moe_experts=4,
+                             moe_top_k=2)
         model = GPTLM(cfg, pad_token_id=-1)
         variables = model.init(jax.random.PRNGKey(0),
                                jnp.ones((1, 4), jnp.int32))
-        with pytest.raises(ValueError, match="row-independent"):
-            ContinuousBatcher(model, variables)
+        eng = ContinuousBatcher(model, variables, max_rows=2)
+        jobs = []
+        for seed, plen, budget in ((31, 4, 10), (32, 7, 14), (33, 5, 6),
+                                   (34, 6, 8)):
+            p = _prompt(seed, plen)
+            jobs.append((p, budget, eng.submit(p, max_new_tokens=budget)))
+        eng.run_until_idle()
+        for p, budget, req in jobs:
+            want = np.asarray(generate(
+                model, variables, p[None, :], max_new_tokens=budget))[0]
+            np.testing.assert_array_equal(req.result(timeout=1), want)
 
     def test_budget_validated(self, lm):
         model, variables = lm
@@ -474,3 +488,149 @@ class TestServingMode:
                 np.testing.assert_array_equal(got, want)
         finally:
             eng.stop()
+
+
+class TestSpeculative:
+    """Speculative decoding INSIDE the engine (VERDICT r4 #5): per-row
+    draft/verify with row-local cache_index rewind under the full cache."""
+
+    @pytest.fixture(scope="class")
+    def spec(self):
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96)
+        target = GPTLM(cfg, pad_token_id=-1)
+        tvars = target.init(jax.random.PRNGKey(0),
+                            jnp.ones((1, 5), jnp.int32))
+        # distinct draft (different seed => imperfect agreement: rows
+        # genuinely diverge in accepted length every round)
+        dvars = target.init(jax.random.PRNGKey(9),
+                            jnp.ones((1, 5), jnp.int32))
+        return target, tvars, dvars
+
+    def test_rows_match_solo_speculative_and_greedy(self, spec):
+        """Defining property: every row of a mixed spec batch equals BOTH
+        solo speculative_generate AND plain greedy generate() (speculative
+        is target-exact), with rows at different depths mid-flight."""
+        from kubeflow_tpu.models.speculative import speculative_generate
+
+        target, tvars, dvars = spec
+        eng = ContinuousBatcher(target, tvars, max_rows=3,
+                                draft_module=target, draft_variables=dvars,
+                                gamma=3)
+        jobs = []
+        for seed, plen, budget in ((1, 4, 12), (2, 7, 20), (3, 5, 6),
+                                   (4, 9, 16), (5, 3, 24), (6, 6, 9)):
+            p = _prompt(seed, plen)
+            jobs.append((p, budget, eng.submit(p, max_new_tokens=budget)))
+        eng.run_until_idle()
+        for p, budget, req in jobs:
+            got = req.result(timeout=1)
+            want = np.asarray(generate(
+                target, tvars, p[None, :], max_new_tokens=budget))[0]
+            np.testing.assert_array_equal(got, want)
+            solo, _ = speculative_generate(
+                target, tvars, target, dvars, jnp.asarray(p)[None, :],
+                max_new_tokens=budget, gamma=3)
+            np.testing.assert_array_equal(got, np.asarray(solo)[0])
+
+    def test_dispatch_count_drops_vs_plain_continuous(self, spec):
+        """Self-draft (perfect agreement) pins the mechanics: every round
+        accepts gamma tokens, so the spec engine needs far fewer
+        dispatches than the plain engine at the same budgets."""
+        target, tvars, _ = spec
+        prompts = [_prompt(s, 5) for s in range(4)]
+        plain = ContinuousBatcher(target, tvars, max_rows=2)
+        for p in prompts:
+            plain.submit(p, max_new_tokens=16)
+        plain.run_until_idle()
+        spec_eng = ContinuousBatcher(target, tvars, max_rows=2,
+                                     draft_module=target,
+                                     draft_variables=tvars, gamma=3)
+        reqs = [spec_eng.submit(p, max_new_tokens=16) for p in prompts]
+        spec_eng.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            want = np.asarray(generate(
+                target, tvars, p[None, :], max_new_tokens=16))[0]
+            np.testing.assert_array_equal(r.result(timeout=1), want)
+        # self-draft: each round emits gamma+1=4 tokens/row vs 1 for plain
+        assert spec_eng.step_count * 3 <= plain.step_count, (
+            spec_eng.step_count, plain.step_count)
+
+    def test_spec_refusals(self, spec):
+        target, tvars, dvars = spec
+        with pytest.raises(ValueError, match="temperature-0"):
+            eng = ContinuousBatcher(target, tvars, max_rows=2,
+                                    draft_module=target,
+                                    draft_variables=dvars)
+            eng.submit(_prompt(1, 4), max_new_tokens=4, temperature=0.7)
+        with pytest.raises(ValueError, match="steps_per_tick"):
+            ContinuousBatcher(target, tvars, max_rows=2, steps_per_tick=4,
+                              draft_module=target, draft_variables=dvars)
+        with pytest.raises(ValueError, match="prefill_buckets"):
+            ContinuousBatcher(target, tvars, max_rows=2,
+                              prefill_buckets=(16,),
+                              draft_module=target, draft_variables=dvars)
+        with pytest.raises(ValueError, match="gamma"):
+            eng = ContinuousBatcher(target, tvars, max_rows=2,
+                                    draft_module=target,
+                                    draft_variables=dvars, gamma=8)
+            # 5 + 85 + 9 > 96
+            eng.submit(_prompt(1, 5), max_new_tokens=85)
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96,
+                             attention_window=8, kv_cache_capacity=24)
+        rolling = GPTLM(cfg, pad_token_id=-1)
+        rvars = rolling.init(jax.random.PRNGKey(0),
+                             jnp.ones((1, 5), jnp.int32))
+        with pytest.raises(ValueError, match="rolling"):
+            ContinuousBatcher(rolling, rvars, max_rows=2,
+                              draft_module=rolling, draft_variables=rvars)
+
+    def test_eos_mid_round_retires_exactly(self, spec):
+        """EOS landing inside an accepted block must stop the row AT the
+        eos token, matching generate(..., eos)'s trimmed output."""
+        target, tvars, dvars = spec
+        p = _prompt(7, 5)
+        plain = np.asarray(generate(target, tvars, p[None, :],
+                                    max_new_tokens=16))[0]
+        eos = int(plain[4])
+        eng = ContinuousBatcher(target, tvars, max_rows=2, eos_token_id=eos,
+                                draft_module=target, draft_variables=dvars,
+                                gamma=3)
+        req = eng.submit(p, max_new_tokens=16)
+        eng.run_until_idle()
+        out = req.result(timeout=1)
+        assert out[-1] == eos and len(out) == 5
+        np.testing.assert_array_equal(out, plain[:5])
+
+    def test_predictor_with_continuous_draft_dir(self, tmp_path, spec):
+        """generate config {continuous: true, continuous_draft_dir: ...}
+        routes the predictor through the SPECULATIVE engine; outputs
+        equal the plain greedy predictor (target-exactness end-to-end
+        through the serving surface)."""
+        from kubeflow_tpu.serving.model import JaxModel, save_predictor
+
+        target, tvars, dvars = spec
+        ddir = save_predictor(
+            tmp_path / "draft", "gpt-lm", {"params": dvars["params"]},
+            np.zeros((1, 6), np.int32),
+            generate={"max_new_tokens": 8},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        d = save_predictor(
+            tmp_path / "gpt-spec", "gpt-lm", dict(tvars),
+            np.zeros((1, 6), np.int32),
+            generate={"max_new_tokens": 8, "continuous": True,
+                      "continuous_rows": 2,
+                      "continuous_draft_dir": str(ddir),
+                      "speculative_gamma": 3},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        jm = JaxModel("gpt-spec", d)
+        jm.load()
+        assert jm._engine is not None and jm._engine.draft_module is not None
+        try:
+            p = _prompt(77, 6)[None, :]
+            got = np.asarray(jm(p)["predictions"])
+            want = np.asarray(generate(target, tvars, p, max_new_tokens=8))
+            np.testing.assert_array_equal(got, want)
+        finally:
+            jm._engine.stop()
