@@ -118,3 +118,55 @@ def build_multislice_mesh(
             f"the DCN slice boundary"
         )
     return mesh
+
+
+# ---------------------------------------------------------------- manual region
+
+# Trace-time marker: set while a stage body is being traced INSIDE an
+# already-manual shard_map region (gpipe's pipeline ring). Collective
+# constructs that normally open their OWN shard_map (ring/ulysses
+# attention, MoE dispatch) consult it and fall back to their
+# auto-partitioned formulation instead of nesting — reverse-mode AD
+# through a nested shard_map inside a manual region produces WRONG
+# cotangents in current JAX (forward exact, gradients corrupted; found
+# by the r5 real-dim composed execution test: finite loss, NaN/exploding
+# grad-norm growing geometrically with layers-per-stage). The
+# auto-partitioned bodies compute identical math and let the XLA
+# partitioner insert the context/expert collectives.
+import contextvars as _contextvars
+
+_IN_MANUAL_REGION = _contextvars.ContextVar("kft_in_manual_region",
+                                            default=False)
+
+
+class manual_region:
+    """Context manager marking 'tracing inside a manual shard_map body'.
+
+    Explicit marker (gpipe sets it around stage bodies); in_manual_region
+    ALSO auto-detects via the abstract mesh's axis types, so a future
+    manual construct that forgets the marker still routes its inner
+    collectives safely."""
+
+    def __enter__(self):
+        self._tok = _IN_MANUAL_REGION.set(True)
+        return self
+
+    def __exit__(self, *exc):
+        _IN_MANUAL_REGION.reset(self._tok)
+        return False
+
+
+def in_manual_region() -> bool:
+    """True while tracing inside any manual shard_map region — via the
+    explicit marker OR the ambient abstract mesh's axis types (inside a
+    shard_map body the bound axes report Manual), so detection does not
+    depend on every manual-region author remembering the marker."""
+    if _IN_MANUAL_REGION.get():
+        return True
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty:
+        return False
+    try:
+        return any(str(t) == "Manual" for t in mesh.axis_types)
+    except AttributeError:  # older jax without axis_types
+        return False

@@ -44,6 +44,7 @@ from kubeflow_tpu.parallel.mesh import (
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_MODEL,
+    in_manual_region,
 )
 
 # Param-path regex -> PartitionSpec for MoE params (merged into model rules).
@@ -176,14 +177,17 @@ class MoeMlp(nn.Module):
             combine = combine.astype(xt.dtype)
             dispatch = dispatch.astype(xt.dtype)
             expert_in = jnp.einsum("tec,th->ech", dispatch, xt)  # (E, C, H)
-            if ep > 1:
+            # the explicit all-to-all needs AXIS_EXPERT bound as manual;
+            # the auto-partitioned path (manual_axes=(), e.g. inside a
+            # gpipe stage) lets XLA place the exchange itself
+            if ep > 1 and manual_axes:
                 # exchange token slots: (E, C, H) -> (E/ep, ep*C, H); each
                 # group now holds every shard's slots for ITS experts
                 expert_in = jax.lax.all_to_all(
                     expert_in, AXIS_EXPERT, split_axis=0, concat_axis=1, tiled=True
                 )
             out = ffn(expert_in, wu, bu, wd, bd)
-            if ep > 1:
+            if ep > 1 and manual_axes:
                 out = jax.lax.all_to_all(
                     out, AXIS_EXPERT, split_axis=1, concat_axis=0, tiled=True
                 )
@@ -195,7 +199,12 @@ class MoeMlp(nn.Module):
 
         local = not self.global_dispatch
         manual: tuple = ()
-        if not mesh.empty:
+        # inside a gpipe stage body (in_manual_region): a NESTED
+        # shard_map's reverse AD corrupts cotangents in current JAX (see
+        # mesh.manual_region and the ring_attention note) — keep
+        # manual=() so the dispatch runs auto-partitioned below (global
+        # capacity pool; XLA inserts the expert collectives)
+        if not mesh.empty and not in_manual_region():
             if local and (ep > 1 or dp > 1 or fs > 1 or cp > 1):
                 manual = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
                 if x.shape[0] % (dp * fs * ep) or x.shape[1] % cp:

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE
+from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE, manual_region
 
 
 def _pin(tree: Any, batch_dim: int) -> Any:
@@ -120,11 +120,16 @@ def gpipe(
     body = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
 
     if pp == 1:
-        # no pipeline axis: sequential scan over stages, same numerics
+        # no pipeline axis: sequential scan over stages, same numerics —
+        # including the SAME collective-construct routing as the pp>1
+        # ring (manual_region), so e.g. MoE dispatch picks the identical
+        # capacity-pool semantics in both modes
         def seq_tick(carry, sp):
             act, s = carry
             r = None if rng is None else jax.random.fold_in(rng, s)
-            return (body(sp, act, stage=s, rng=r), s + 1), None
+            with manual_region():
+                out = body(sp, act, stage=s, rng=r)
+            return (out, s + 1), None
 
         (out, _), _ = jax.lax.scan(
             seq_tick, (x, jnp.int32(0)), params_stacked
@@ -190,7 +195,14 @@ def gpipe(
             r = None if rng is None else jax.random.fold_in(
                 jax.random.fold_in(rng, stage), t
             )
-            out = _pin(body(params, inp, stage=stage, rng=r), batch_dim=0)
+            # stage bodies trace inside THIS shard_map's manual region:
+            # collective constructs (ring/ulysses attention, MoE dispatch)
+            # must not nest their own shard_map here — nested-manual
+            # reverse AD corrupts cotangents (see mesh.manual_region) —
+            # so the marker routes them to their auto-partitioned forms
+            with manual_region():
+                out = _pin(body(params, inp, stage=stage, rng=r),
+                           batch_dim=0)
             # last stage emits microbatch t-(S-1) once the pipe is full
             emit_idx = t - (n_stages - 1)
             is_emit = jnp.logical_and(stage == ring - 1, emit_idx >= 0)

@@ -51,6 +51,7 @@ from kubeflow_tpu.parallel.mesh import (
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_MODEL,
+    in_manual_region,
 )
 from kubeflow_tpu.parallel.sharding import BATCH_AXES
 
@@ -213,7 +214,15 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
     if window and not causal:
         raise ValueError("attention window requires causal=True")
     ctx = _context_size()
-    if ctx == 1:
+    if ctx == 1 or in_manual_region():
+        # ctx == 1: nothing to ring over. in_manual_region (inside a
+        # gpipe stage): a NESTED shard_map's reverse AD corrupts
+        # cotangents in current JAX (forward exact, grads exploding
+        # geometrically with layers-per-stage — caught by the r5
+        # real-dim composed step: finite loss, NaN grad-norm; pinned by
+        # tests/test_composed_realdim.py). Identical math on the
+        # auto-partitioned global-shaped values — the XLA partitioner
+        # inserts the context collectives itself.
         if rope_theta is not None:
             q, k = _rope_qk(q, k, jnp.arange(q.shape[1]), rope_theta)
         return blockwise_attention(q, k, v, bias, block, causal=causal,
@@ -288,7 +297,8 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
     if window and not causal:
         raise ValueError("attention window requires causal=True")
     ctx = _context_size()
-    if ctx == 1:
+    if ctx == 1 or in_manual_region():
+        # same nested-manual AD hazard as ring_attention (see note there)
         if rope_theta is not None:
             q, k = _rope_qk(q, k, jnp.arange(q.shape[1]), rope_theta)
         return blockwise_attention(q, k, v, bias, block, causal=causal,

@@ -386,7 +386,11 @@ class Trainer:
         new_state = state.replace(
             step=state.step + 1, params=params, opt_state=opt_state, extra=new_extra
         )
-        return new_state, {"loss": loss, "accuracy": acc}
+        # global grad-norm as a first-class metric: the standard training
+        # health signal (divergence shows here before the loss moves), and
+        # the finiteness witness the real-dim composed execution test pins
+        return new_state, {"loss": loss, "accuracy": acc,
+                           "grad_norm": optax.global_norm(grads)}
 
     def _eval_step(self, state: TrainState, batch) -> dict:
         x, y, w = batch  # w: validity mask for padded tail batches

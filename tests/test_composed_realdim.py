@@ -1,0 +1,76 @@
+"""Real-dimension composed-mesh EXECUTION (VERDICT r4 #4): one optimizer
+step of GPT-2-small at real dims — 768 hidden / 12 layers / seq 512 /
+vocab 50257 — on an {fsdp:2, context:2, pipeline:2} 8-device mesh,
+asserting finite loss AND finite global grad-norm AND the expected
+shardings on the RETURNED state.
+
+Compile-only checks lower and compile this shape but never execute it;
+tiny-dim executions never see real-dim numerics. The gap was real: the
+first run of this test found finite loss with NaN gradients — the
+nested-shard_map cotangent corruption under pipeline+ring composition
+(fixed via mesh.manual_region; unit-pinned by test_pipeline_grads.py).
+This test keeps the END-TO-END witness: the flagship composed config
+trains with sane gradients at production dims.
+
+Slow (~2-4 min on the 8-device CPU mesh: one real 124M-param fwd+bwd).
+"""
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.tree_util import tree_flatten_with_path
+
+from kubeflow_tpu.models import (
+    GPTConfig,
+    GPTPipelineLM,
+    causal_lm_eval_metrics,
+    causal_lm_loss,
+)
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.train import Trainer, TrainerConfig
+
+
+def test_real_dim_composed_step_executes_with_finite_grads():
+    mesh = build_mesh(MeshConfig(fsdp=2, context=2, pipeline=2))
+    cfg = GPTConfig.small(dropout_rate=0.0, attention="ring",
+                          attention_block=128, position_embedding="rope",
+                          num_kv_heads=4, max_len=512)
+    assert cfg.hidden_size == 768 and cfg.num_layers == 12
+    assert cfg.vocab_size == 50257
+    tr = Trainer(
+        GPTPipelineLM(cfg, num_stages=2, n_micro=2),
+        TrainerConfig(batch_size=4, steps=1, log_every_steps=10**9),
+        loss_fn=causal_lm_loss, eval_metrics_fn=causal_lm_eval_metrics,
+        mesh=mesh,
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randint(1, cfg.vocab_size, size=(4, 512)).astype(np.int32)
+    t0 = time.time()
+    state = tr.init_state(x)
+    state, m = tr.train_step(state, (x, x))
+    loss, gnorm = float(m["loss"]), float(m["grad_norm"])
+    wall = time.time() - t0
+    # ln(50257) ~ 10.8: a first-step CE loss near that is a REAL forward
+    assert np.isfinite(loss) and 8.0 < loss < 14.0, loss
+    # the r4-era code returned NaN here (finite loss, corrupted backward)
+    assert np.isfinite(gnorm) and 0.0 < gnorm < 100.0, gnorm
+
+    # expected shardings on the RETURNED state: stage params on
+    # `pipeline`, with fsdp sharding present somewhere in the stage tree
+    leaves, _ = tree_flatten_with_path(state.params)
+
+    def spec_axes(leaf):
+        return [a for part in (leaf.sharding.spec or ()) if part
+                for a in (part if isinstance(part, tuple) else (part,))]
+
+    stage_leaves = [(p, l) for p, l in leaves if "stages" in str(p)]
+    assert stage_leaves
+    assert all(l.sharding.spec and l.sharding.spec[0] == "pipeline"
+               for _, l in stage_leaves)
+    assert any("fsdp" in spec_axes(l) for _, l in stage_leaves)
+    # wall-time on record for ROUND5_NOTES (printed with pytest -s)
+    print(f"\nREALDIM step wall={wall:.1f}s loss={loss:.4f} "
+          f"grad_norm={gnorm:.4f}")

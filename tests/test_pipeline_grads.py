@@ -1,0 +1,126 @@
+"""Gradient correctness of collective constructs INSIDE the pipeline
+(VERDICT r4 #4 fallout): nested shard_map reverse-AD corrupts cotangents
+in current JAX — forward exact, gradients exploding geometrically with
+layers-per-stage. ring/ulysses attention and MoE dispatch therefore fall
+back to their auto-partitioned forms inside a gpipe stage
+(mesh.manual_region); these tests pin gpipe gradients EQUAL to the
+sequential-stage ground truth, which the old nesting violated at ratio
+~90x for two LN+ring layers per stage (and ~1e9 per stage-pair at model
+scale)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.mesh import in_manual_region, manual_region
+from kubeflow_tpu.parallel.moe import MoeMlp
+from kubeflow_tpu.parallel.pipeline import gpipe, stack_stage_params
+from kubeflow_tpu.parallel.ring_attention import ring_attention
+
+B, L, H, D = 4, 16, 2, 8
+HID = H * D
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshConfig(context=2, pipeline=2))
+
+
+def _inputs():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(k1, (B, L, HID), jnp.float32) * 0.3
+    g = jax.random.normal(k2, (B, L, HID), jnp.float32) * 0.3
+    ws = [jax.random.normal(jax.random.fold_in(k3, i), (HID, HID),
+                            jnp.float32) * 0.1 for i in range(2)]
+    return x, g, ws
+
+
+def _ln(x):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5)
+
+
+def _grad(mesh, loss, x):
+    with jax.set_mesh(mesh):
+        return jax.jit(jax.grad(loss))(x)
+
+
+def test_manual_region_marker():
+    assert not in_manual_region()
+    with manual_region():
+        assert in_manual_region()
+        with manual_region():
+            assert in_manual_region()
+        assert in_manual_region()
+    assert not in_manual_region()
+
+
+def test_gpipe_ring_grads_match_sequential(mesh):
+    """Two LN+ring layers per stage — the exact shape that exploded 90x
+    under the old nested shard_map — must now give gradients equal to
+    applying the stages sequentially (same function, same grads)."""
+    x0, g, ws = _inputs()
+    bias = jnp.zeros((B, 1, 1, L))
+    params = stack_stage_params(ws)
+
+    def stage_fn(sp, act, *, stage, rng):
+        h, b = act
+        for _ in range(2):
+            bsz = h.shape[0]
+            q = (_ln(h) @ sp).reshape(bsz, L, H, D)
+            h = h + ring_attention(q, q, q, b, causal=True,
+                                   block=8).reshape(bsz, L, HID)
+        return (h, b)
+
+    def loss_pp(x):
+        return (gpipe(stage_fn, params, (x, bias), 2)[0] * g).sum()
+
+    def loss_seq(x):
+        act = (x, bias)
+        for i in range(2):
+            act = stage_fn(ws[i], act, stage=i, rng=None)
+        return (act[0] * g).sum()
+
+    gr_pp = _grad(mesh, loss_pp, x0)
+    gr_seq = _grad(mesh, loss_seq, x0)
+    # forward identical too (gpipe's numerics contract)
+    with jax.set_mesh(mesh):
+        np.testing.assert_allclose(
+            float(jax.jit(loss_pp)(x0)), float(jax.jit(loss_seq)(x0)),
+            rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gr_pp), np.asarray(gr_seq),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gpipe_moe_grads_match_sequential(mesh):
+    """MoE dispatch inside a gpipe stage routes auto-partitioned (no
+    nested shard_map) — gradients must match the sequential ground
+    truth computed through the SAME auto path."""
+    x0, g, _ = _inputs()
+    moe = MoeMlp(hidden_size=HID, mlp_dim=32, num_experts=2, top_k=1)
+    mvars = [moe.init(jax.random.fold_in(jax.random.PRNGKey(7), i), x0)
+             for i in range(2)]
+    params = stack_stage_params([v["params"] for v in mvars])
+
+    def stage_fn(sp, act, *, stage, rng):
+        h = act[0]
+        y = moe.apply({"params": sp}, h)
+        return (h + y,)
+
+    def loss_pp(x):
+        return (gpipe(stage_fn, params, (x,), 2)[0] * g).sum()
+
+    def loss_seq(x):
+        act = (x,)
+        with manual_region():  # same dispatch path as inside gpipe
+            for i in range(2):
+                act = stage_fn(mvars[i]["params"], act, stage=i, rng=None)
+        return (act[0] * g).sum()
+
+    gr_pp = _grad(mesh, loss_pp, x0)
+    gr_seq = _grad(mesh, loss_seq, x0)
+    np.testing.assert_allclose(np.asarray(gr_pp), np.asarray(gr_seq),
+                               rtol=2e-5, atol=2e-6)
