@@ -167,6 +167,7 @@ def in_manual_region() -> bool:
     if mesh.empty:
         return False
     try:
-        return any(str(t) == "Manual" for t in mesh.axis_types)
-    except AttributeError:  # older jax without axis_types
+        manual = jax.sharding.AxisType.Manual
+        return any(t == manual for t in mesh.axis_types)
+    except AttributeError:  # older jax without axis_types/AxisType
         return False

@@ -1,0 +1,241 @@
+"""Round-5b flash-backward forensics — WHICH SIDE of the r3/r4/r5 NaN
+comparison is actually NaN.
+
+Motivation (probe_flash_r5.txt, captured 2026-08-01): ALL four backward
+impls (loop2 / ddpre / loop / xla) FAILed with dq=dk=dbias=nan while dv
+was finite with error values IDENTICAL to four significant digits across
+impls — and identical to the r3 capture. Four independent code paths do
+not NaN identically; a shared comparand does. Every verdict so far
+compared |impl − ref| where ref = jax.grad through blockwise_attention
+ON TPU — a NaN on EITHER side prints nan. Meanwhile the r5 term bisect
+showed every impl-side intermediate finite. Hypothesis: the REFERENCE
+autodiff is the NaN source, and the pallas backwards have been correct
+all along.
+
+That hypothesis has product consequences beyond the verdict: blockwise
+attention's autodiff IS the training gradient path for ring/ulysses
+context parallelism (ring_attention.py:150-160,255-270) — if its grad
+NaNs on real TPU, long-context training is broken on hardware in a way
+no CPU test can see.
+
+Sections (every RESULT prints immediately; banked keys skip on re-run):
+  A. side isolation — per-tensor NaN COUNTS of (a) the blockwise
+     reference's own grads and (b) each impl's outputs, separately.
+     refnan_* > 0 with implnan_* == 0 confirms the hypothesis.
+  B. f32 dense-softmax reference (no scan, no online softmax, f32
+     through-and-through) — grads must be finite; verdicts
+     v2_{impl}_{tag} compare each impl against THIS reference. PASS
+     here is the Mosaic-correctness verdict SURVEY §2.8 has waited
+     four rounds for.
+  C. blockwise-autodiff bisect: dtype (f32 inputs) x scan length
+     (block=1024 = single step) x size (l=512) — localizes the
+     reference NaN for the product fix.
+  D. swa (window=256) side isolation + v2 verdicts vs windowed dense.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import threading
+import time
+import traceback
+
+WATCHDOG_S = 300.0
+_last = [time.monotonic()]
+
+
+def _pet():
+    _last[0] = time.monotonic()
+
+
+def _watchdog():
+    while True:
+        time.sleep(5.0)
+        if time.monotonic() - _last[0] > WATCHDOG_S:
+            print(f"RESULT watchdog=hang idle_s={WATCHDOG_S}", flush=True)
+            os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+
+import probe_common
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("KFT_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["KFT_BENCH_PLATFORM"])
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.parallel.ring_attention import (
+        _flash_backward,
+        _flash_forward,
+        blockwise_attention,
+    )
+
+    banked = probe_common.banked_keys("probe_flash_r5b.txt")
+    interpret = jax.default_backend() == "cpu"
+    dev = jax.devices()[0]
+    print(f"RESULT device_kind={dev.device_kind!r} platform={dev.platform} "
+          f"interpret={interpret}", flush=True)
+    _pet()
+
+    def born(*shape, key, dtype=jnp.bfloat16):
+        x = jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+        return jax.jit(lambda v: (v * 0.125).astype(dtype))(x)
+
+    def nans(x):
+        return int(jnp.isnan(jnp.asarray(x, jnp.float32)).sum())
+
+    def gstats(g):
+        return " ".join(
+            f"{n}:{nans(t)}" for n, t in zip(("dq", "dk", "dv", "dbias"), g))
+
+    if interpret:
+        b, l, h, d = 1, 256, 2, 64
+        win = 64
+    else:
+        b, l, h, d = 2, 1024, 12, 64
+        win = 256
+    q = born(b, l, h, d, key=0)
+    k = born(b, l, h, d, key=1)
+    v = born(b, l, h, d, key=2)
+    bias = jnp.zeros((b, 1, 1, l), jnp.bfloat16)
+    ct = born(b, l, h, d, key=3)
+    scale = 1.0 / (d ** 0.5)
+
+    NEG = -1e9
+
+    def dense_ref(q, k, v, bias, causal, window=0):
+        """f32 dense softmax attention — no scan, no online statistics."""
+        s = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        s = s + bias.astype(jnp.float32)
+        if causal:
+            pos = jnp.arange(s.shape[-1])
+            masked = pos[None, :] > pos[:, None]
+            if window:
+                masked = masked | (pos[:, None] - pos[None, :] >= window)
+            s = s + jnp.where(masked, NEG, 0.0)[None, None, :, :]
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhlm,bmhd->blhd", p, v.astype(jnp.float32))
+
+    # ------------- A + B: side isolation and dense-reference verdicts ----
+    for causal, window, tag in ((False, 0, "full"), (True, 0, "causal"),
+                                (True, win, "swa")):
+        # A: reference-side NaN count (the blockwise autodiff the r3/r4/r5
+        # probes compared against)
+        if f"refnan_{tag}" not in banked:
+            try:
+                def loss_bw(q, k, v, bias, c=causal, w=window):
+                    return (blockwise_attention(q, k, v, bias, block=256,
+                                                causal=c, window=w)
+                            .astype(jnp.float32)
+                            * ct.astype(jnp.float32)).sum()
+
+                ref = jax.jit(jax.grad(loss_bw, argnums=(0, 1, 2, 3)))(
+                    q, k, v, bias)
+                print(f"RESULT refnan_{tag}={gstats(ref)}", flush=True)
+            except Exception as exc:  # noqa: BLE001
+                print(f"RESULT refnan_{tag}=ERROR {type(exc).__name__}",
+                      flush=True)
+                probe_common.record_error(f"refnan_{tag}")
+                traceback.print_exc(file=sys.stderr)
+            _pet()
+
+        # B: dense f32 reference grads + per-impl NaN counts and verdicts
+        try:
+            need = ([f"densenan_{tag}"]
+                    + [f"v2_{i}_{tag}" for i in ("loop2", "ddpre", "xla")]
+                    + [f"implnan_{i}_{tag}" for i in ("loop2", "ddpre", "xla")])
+            if all(key in banked for key in need):
+                continue
+
+            def loss_dense(q, k, v, bias, c=causal, w=window):
+                return (dense_ref(q, k, v, bias, c, w)
+                        * ct.astype(jnp.float32)).sum()
+
+            dref = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2, 3)))(
+                q, k, v, bias)
+            print(f"RESULT densenan_{tag}={gstats(dref)}", flush=True)
+            _pet()
+            out, lse = jax.jit(
+                lambda q, k, v, bias, c=causal, w=window: _flash_forward(
+                    q, k, v, bias, 256, 256, c, want_lse=True, window=w)
+            )(q, k, v, bias)
+            for impl in ("loop2", "ddpre", "xla"):
+                try:
+                    got = jax.jit(
+                        lambda q, k, v, bias, out, lse, g, c=causal,
+                               w=window, i=impl: _flash_backward(
+                            q, k, v, bias, out, lse, g, 256, 256, c,
+                            impl=i, window=w)
+                    )(q, k, v, bias, out, lse, ct)
+                    print(f"RESULT implnan_{impl}_{tag}={gstats(got)}",
+                          flush=True)
+                    errs = [float(jnp.max(jnp.abs(
+                        a.astype(jnp.float32) - r.astype(jnp.float32))))
+                        for a, r in zip(got, dref)]
+                    ok = max(errs[:3]) < 0.25 and errs[3] < 2.0
+                    print(f"RESULT v2_{impl}_{tag}="
+                          f"{'PASS' if ok else 'FAIL'} dq={errs[0]:.4g} "
+                          f"dk={errs[1]:.4g} dv={errs[2]:.4g} "
+                          f"dbias={errs[3]:.4g}", flush=True)
+                except Exception as exc:  # noqa: BLE001
+                    print(f"RESULT v2_{impl}_{tag}=ERROR "
+                          f"{type(exc).__name__}", flush=True)
+                    probe_common.record_error(f"v2_{impl}_{tag}")
+                _pet()
+        except Exception as exc:  # noqa: BLE001
+            print(f"RESULT dense_setup_{tag}=ERROR {type(exc).__name__}",
+                  flush=True)
+            probe_common.record_error(f"dense_setup_{tag}")
+            traceback.print_exc(file=sys.stderr)
+            _pet()
+
+    # ------------- C: blockwise-autodiff bisect --------------------------
+    # Each variant isolates one axis of the reference NaN: input dtype,
+    # scan length (block=l means ONE online step), problem size.
+    bis = (
+        ("bwgrad_f32", dict(block=256, dtype=jnp.float32, l2=l)),
+        ("bwgrad_1block", dict(block=l, dtype=jnp.bfloat16, l2=l)),
+        ("bwgrad_l512", dict(block=256, dtype=jnp.bfloat16, l2=512)),
+        ("bwgrad_2block", dict(block=l // 2, dtype=jnp.bfloat16, l2=l)),
+    )
+    for name, cfg in bis:
+        for causal in (False, True):
+            tag = f"{name}_{'causal' if causal else 'full'}"
+            if tag in banked:
+                continue
+            try:
+                l2 = cfg["l2"]
+                qq = born(b, l2, h, d, key=20, dtype=cfg["dtype"])
+                kk = born(b, l2, h, d, key=21, dtype=cfg["dtype"])
+                vv = born(b, l2, h, d, key=22, dtype=cfg["dtype"])
+                cc = born(b, l2, h, d, key=23, dtype=jnp.float32)
+                bb = jnp.zeros((b, 1, 1, l2), cfg["dtype"])
+
+                def loss_bw2(qq, kk, vv, bb, c=causal, blk=cfg["block"]):
+                    return (blockwise_attention(qq, kk, vv, bb, block=blk,
+                                                causal=c)
+                            .astype(jnp.float32) * cc).sum()
+
+                g2 = jax.jit(jax.grad(loss_bw2, argnums=(0, 1, 2, 3)))(
+                    qq, kk, vv, bb)
+                print(f"RESULT {tag}={gstats(g2)}", flush=True)
+            except Exception as exc:  # noqa: BLE001
+                print(f"RESULT {tag}=ERROR {type(exc).__name__}", flush=True)
+                probe_common.record_error(tag)
+                traceback.print_exc(file=sys.stderr)
+            _pet()
+
+    print("RESULT probe_flash_r5b=complete", flush=True)
+    sys.exit(probe_common.exit_code())
+
+
+if __name__ == "__main__":
+    main()

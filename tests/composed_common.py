@@ -1,0 +1,17 @@
+"""Shared helpers for the composed-mesh subprocess suites
+(test_composed_16dev / test_composed_64dev)."""
+
+
+def unexpected_remat_warnings(stderr: str) -> list[str]:
+    """Full-remat warnings EXCEPT the one known, accepted case: the MoE
+    dispatch einsum inside a pipeline stage. MoE routes auto-partitioned
+    there (nested-shard_map reverse AD corrupts cotangents — the r5
+    real-dim execution finding, see mesh.manual_region), and the
+    partitioner remats one small (T,E,C) dispatch transpose (upstream
+    XLA b/433785288). Correct gradients > one dispatch-tensor reshard;
+    any OTHER involuntary remat still fails the test."""
+    return [
+        ln for ln in stderr.splitlines()
+        if "Involuntary full rematerialization" in ln
+        and "moe/tke,tkc->tec" not in ln
+    ]

@@ -17,19 +17,7 @@ from pathlib import Path
 REPO = Path(__file__).parent.parent
 
 
-def _unexpected_remat_warnings(stderr: str) -> list[str]:
-    """Full-remat warnings EXCEPT the one known, accepted case: the MoE
-    dispatch einsum inside a pipeline stage. MoE routes auto-partitioned
-    there (nested-shard_map reverse AD corrupts cotangents — the r5
-    real-dim execution finding, see mesh.manual_region), and the
-    partitioner remats one small (T,E,C) dispatch transpose (upstream
-    XLA b/433785288). Correct gradients > one dispatch-tensor reshard;
-    any OTHER involuntary remat still fails the test."""
-    return [
-        ln for ln in stderr.splitlines()
-        if "Involuntary full rematerialization" in ln
-        and "moe/tke,tkc->tec" not in ln
-    ]
+from composed_common import unexpected_remat_warnings
 
 SIXAXIS_SCRIPT = """
 import os
@@ -118,7 +106,7 @@ def test_six_axis_train_step_64dev():
     assert "SIXAXIS_OK" in proc.stdout
     # composition must stay warning-free: an involuntary full-remat
     # reshard at a shard_map boundary is a silent performance cliff
-    assert not _unexpected_remat_warnings(proc.stderr), (
+    assert not unexpected_remat_warnings(proc.stderr), (
         proc.stderr[-3000:]
     )
 

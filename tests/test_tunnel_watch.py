@@ -87,8 +87,13 @@ def test_pick_flash_bwd_requires_swa_pass(tmp_path):
     end = text.index("\n}", text.index("pick_flash_bwd() {")) + 2
     fn = text[start:end]
 
-    def pick(probe: str) -> str:
+    def pick(probe: str, probe_b: str = "") -> str:
         (tmp_path / "probe_flash_r5.txt").write_text(probe)
+        pb = tmp_path / "probe_flash_r5b.txt"
+        if probe_b:
+            pb.write_text(probe_b)
+        elif pb.exists():
+            pb.unlink()
         out = subprocess.run(
             ["bash", "-c", f"cd {tmp_path}; {fn}\npick_flash_bwd"],
             capture_output=True, text=True, timeout=30)
@@ -112,3 +117,29 @@ def test_pick_flash_bwd_requires_swa_pass(tmp_path):
     flaky = (base + "RESULT swa_loop2=PASS\n"
              + "RESULT loop2_causal=FAIL\n")
     assert pick(flaky) == "xla"
+    # r5b dense-reference verdicts rescue a candidate the r5 blockwise
+    # reference poisoned (refnan on TPU -> every r5 key FAIL): v2 PASS on
+    # all three flavors flips, using the r5 artifact's timings
+    poisoned = ("RESULT flash_xla_fwdbwd_ms=100\n"
+                "RESULT ddpre_causal=FAIL\nRESULT ddpre_full=FAIL\n"
+                "RESULT swa_ddpre=FAIL\n"
+                "RESULT flash_ddpre_fwdbwd_ms=80\n")
+    v2 = ("RESULT v2_ddpre_causal=PASS\nRESULT v2_ddpre_full=PASS\n"
+          "RESULT v2_ddpre_swa=PASS\n")
+    assert pick(poisoned) == "xla"
+    assert pick(poisoned, v2) == "ddpre"
+    # v2 missing the swa verdict must NOT flip (same ADVICE r4 rule)
+    v2_noswa = ("RESULT v2_ddpre_causal=PASS\n"
+                "RESULT v2_ddpre_full=PASS\n")
+    assert pick(poisoned, v2_noswa) == "xla"
+    # precedence, not OR: when ANY v2 verdict exists for a candidate, a v2
+    # FAIL vetoes that candidate even if every r5 key says PASS (candidate
+    # and the suspect r5 blockwise reference could share a bug)
+    r5_all_pass = ("RESULT flash_xla_fwdbwd_ms=100\n"
+                   "RESULT ddpre_causal=PASS\nRESULT ddpre_full=PASS\n"
+                   "RESULT swa_ddpre=PASS\n"
+                   "RESULT flash_ddpre_fwdbwd_ms=80\n")
+    v2_fail = ("RESULT v2_ddpre_causal=FAIL\nRESULT v2_ddpre_full=PASS\n"
+               "RESULT v2_ddpre_swa=PASS\n")
+    assert pick(r5_all_pass) == "ddpre"
+    assert pick(r5_all_pass, v2_fail) == "xla"

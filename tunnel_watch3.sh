@@ -45,21 +45,39 @@ last_val() {  # last_val <key> — LAST recorded value for key in the probe
   grep -o "$1=[A-Za-z0-9.]*" probe_flash_r5.txt 2>/dev/null | tail -1 | cut -d= -f2
 }
 
+last_val_b() {  # same contract, round-5b artifact (dense-reference verdicts)
+  grep -o "$1=[A-Za-z0-9.]*" probe_flash_r5b.txt 2>/dev/null | tail -1 | cut -d= -f2
+}
+
 pick_flash_bwd() {
-  # Flip the suite's training benches onto a pallas backward IFF the probe
+  # Flip the suite's training benches onto a pallas backward IFF a probe
   # recorded it Mosaic-PASS on causal AND full AND sliding-window (the
   # suite includes the windowed swa row — ADVICE r4: flipping on
   # causal/full alone could measure that row through broken numerics)
   # AND it is at least as fast as the xla backward. Prefers the faster
   # PASSing candidate: loop2 (in-kernel D recompute) vs ddpre (dd produced
-  # by a pallas pre-kernel).
+  # by a pallas pre-kernel). Verdict source order: round-5b v2 keys
+  # (dense f32 reference — the r5 probe's blockwise-autodiff reference
+  # NaNs on TPU, poisoning every r3/r4/r5 comparison) then the r5 keys.
   local best=xla best_ms=""
   local XL
   XL=$(last_val flash_xla_fwdbwd_ms)
   for cand in loop2 ddpre; do
-    if [ "$(last_val ${cand}_causal)" = PASS ] \
-       && [ "$(last_val ${cand}_full)" = PASS ] \
-       && [ "$(last_val swa_${cand})" = PASS ]; then
+    # precedence, not OR: when the r5b artifact holds ANY v2 verdict for
+    # this candidate, the dense-f32 reference is authoritative — an r5
+    # PASS must not outvote a v2 FAIL (candidate and the suspect r5
+    # blockwise reference could share a bug)
+    local ok=no
+    if [ -n "$(last_val_b v2_${cand}_causal)$(last_val_b v2_${cand}_full)$(last_val_b v2_${cand}_swa)" ]; then
+      [ "$(last_val_b v2_${cand}_causal)" = PASS ] \
+        && [ "$(last_val_b v2_${cand}_full)" = PASS ] \
+        && [ "$(last_val_b v2_${cand}_swa)" = PASS ] && ok=yes
+    else
+      [ "$(last_val ${cand}_causal)" = PASS ] \
+        && [ "$(last_val ${cand}_full)" = PASS ] \
+        && [ "$(last_val swa_${cand})" = PASS ] && ok=yes
+    fi
+    if [ "$ok" = yes ]; then
       local MS
       MS=$(last_val flash_${cand}_fwdbwd_ms)
       if [ -n "$MS" ] && [ -n "$XL" ] && awk "BEGIN{exit !($MS <= $XL)}"; then
@@ -75,6 +93,7 @@ pick_flash_bwd() {
 while :; do
   if [ -f bench_r5_headline.jsonl.done ] && [ -f bench_r5_suite.jsonl.done ] \
      && { [ ! -f probe_flash_r5.py ] || [ -f probe_flash_r5.txt.done ]; } \
+     && { [ ! -f probe_flash_r5b.py ] || [ -f probe_flash_r5b.txt.done ]; } \
      && { [ ! -f probe_resnet.py ] || [ -f probe_resnet.txt.done ]; } \
      && { [ ! -f probe_flash_xlabwd.py ] || [ -f probe_flash_xlabwd.txt.done ]; }; then
     echo "all stages captured at $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> tunnel_watch3.log
@@ -104,6 +123,11 @@ float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum())
          python bench.py --headline; then
       [ ! -f probe_flash_r5.py ] \
         || stage probe_flash_r5.txt 900 python -u probe_flash_r5.py \
+        || true
+      # r5b: WHICH SIDE NaNs (dense-f32-reference verdicts) — decides the
+      # backward flip now that the r5 blockwise reference is itself suspect
+      [ ! -f probe_flash_r5b.py ] \
+        || stage probe_flash_r5b.txt 900 python -u probe_flash_r5b.py \
         || true
       BWD=$(pick_flash_bwd)
       echo "bench KFT_FLASH_BWD_IMPL=$BWD" >> tunnel_watch3.log
