@@ -32,6 +32,7 @@ Layout contract under context parallelism (models/bert.py ACT_SPEC):
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any
 
 import jax
@@ -56,6 +57,15 @@ from kubeflow_tpu.parallel.mesh import (
 from kubeflow_tpu.parallel.sharding import BATCH_AXES
 
 NEG_INF = -1e9
+
+# Gradient path for blockwise_attention (and therefore the ring/ulysses
+# local attention). Read and validated ONCE at import — like
+# KFT_FLASH_BWD_IMPL below — because a trace-time read would silently
+# ignore env changes after a jitted train step has compiled.
+BLOCKWISE_VJP = os.environ.get("KFT_BLOCKWISE_VJP", "custom")
+if BLOCKWISE_VJP not in ("custom", "autodiff"):
+    raise ValueError(
+        f"KFT_BLOCKWISE_VJP={BLOCKWISE_VJP!r} is not 'custom' or 'autodiff'")
 
 # batch rides ALL data-like axes — sharding.BATCH_AXES, the one canonical
 # definition (expert parallelism subdivides data parallelism; an earlier
@@ -93,13 +103,7 @@ def _online_block(carry, kv, q, scale, q_pos=None, k_pos=None,
     """
     o_acc, m, l = carry
     k_blk, v_blk, bias_blk = kv
-    s = jnp.einsum("blhd,bmhd->bhlm", q, k_blk).astype(jnp.float32) * scale
-    s = s + bias_blk.astype(jnp.float32)
-    if q_pos is not None:
-        masked = k_pos[None, :] > q_pos[:, None]
-        if window:
-            masked = masked | (q_pos[:, None] - k_pos[None, :] >= window)
-        s = s + jnp.where(masked, NEG_INF, 0.0)[None, None, :, :]
+    s = _block_scores(q, k_blk, bias_blk, scale, q_pos, k_pos, window)
     m_new = jnp.maximum(m, s.max(-1, keepdims=True))
     corr = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new)
@@ -122,21 +126,9 @@ def _init_carry(q):
     )
 
 
-def blockwise_attention(q, k, v, bias, block: int = 256, causal: bool = False,
-                        window: int = 0):
-    """Memory-efficient attention: lax.scan over KV blocks, online softmax.
-
-    Differentiable everywhere (the autodiff of scan recomputes nothing extra
-    beyond the saved block residuals); the numerics reference for both the
-    pallas kernel and the ring path. causal=True masks k_pos > q_pos (global
-    positions; the ring path reconstructs per-shard positions itself).
-    window > 0 (requires causal) is the Mistral sliding window: query i
-    sees keys in (i - window, i].
-    """
-    if window and not causal:
-        raise ValueError("attention window requires causal=True")
+def _kv_blocks(k, v, bias, block):
+    """Split KV (+ bias + key positions) into scan-ready block stacks."""
     b, lk, h, d = k.shape
-    scale = 1.0 / (q.shape[-1] ** 0.5)
     block = min(block, lk)
     n_blocks = lk // block
     if n_blocks * block != lk:  # ragged tail: fall back to one block
@@ -144,8 +136,29 @@ def blockwise_attention(q, k, v, bias, block: int = 256, causal: bool = False,
     kb = k.reshape(b, n_blocks, block, h, d).transpose(1, 0, 2, 3, 4)
     vb = v.reshape(b, n_blocks, block, h, d).transpose(1, 0, 2, 3, 4)
     bias_b = bias.reshape(b, 1, 1, n_blocks, block).transpose(3, 0, 1, 2, 4)
+    k_pos = jnp.arange(lk).reshape(n_blocks, block)
+    return kb, vb, bias_b, k_pos, block
+
+
+def _block_scores(q, k_blk, bias_blk, scale, q_pos, kp, window):
+    """The ONE score computation the forward and the custom backward share
+    — bit-identical recompute keeps exp(s - lse) consistent with the lse
+    the forward saved."""
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k_blk).astype(jnp.float32) * scale
+    s = s + bias_blk.astype(jnp.float32)
+    if q_pos is not None:
+        masked = kp[None, :] > q_pos[:, None]
+        if window:
+            masked = masked | (q_pos[:, None] - kp[None, :] >= window)
+        s = s + jnp.where(masked, NEG_INF, 0.0)[None, None, :, :]
+    return s
+
+
+def _blockwise_fwd_impl(q, k, v, bias, block, causal, window):
+    """Online-softmax scan over KV blocks -> (out, lse (B,H,Lq,1) f32)."""
+    kb, vb, bias_b, k_pos, _ = _kv_blocks(k, v, bias, block)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
     q_pos = jnp.arange(q.shape[1]) if causal else None
-    k_pos_blocks = jnp.arange(lk).reshape(n_blocks, block)
 
     def step(carry, kv):
         k_blk, v_blk, bias_blk, kp = kv
@@ -154,10 +167,109 @@ def blockwise_attention(q, k, v, bias, block: int = 256, causal: bool = False,
             q_pos, kp if causal else None, window=window,
         ), None
 
-    carry, _ = jax.lax.scan(
-        step, _init_carry(q), (kb, vb, bias_b, k_pos_blocks)
+    (o_acc, m, l), _ = jax.lax.scan(
+        step, _init_carry(q), (kb, vb, bias_b, k_pos)
     )
-    return _finalize(*carry, q.dtype)
+    return _finalize(o_acc, m, l, q.dtype), m + jnp.log(l)
+
+
+def _blockwise_bwd_impl(q, k, v, bias, out, lse, g, block, causal, window):
+    """FlashAttention-2-style backward: recompute p = exp(s − lse) block
+    by block from the saved logsumexp; residual memory is O(L), not the
+    O(L²/block · n_blocks) probability tiles reverse-AD of the forward
+    scan would save. Also the gradient path ring/ulysses local attention
+    actually trains through — kept out of reverse-AD entirely because
+    the r5 hardware forensics (probe_flash_r5b, docs/perf.md §Round 5)
+    implicate the scan-autodiff max/exp chain for dq/dk/dbias NaNs on
+    Mosaic."""
+    kb, vb, bias_b, k_pos, _ = _kv_blocks(k, v, bias, block)
+    b, lk, h, d = k.shape
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    q_pos = jnp.arange(q.shape[1]) if causal else None
+    gf = g.astype(jnp.float32)
+    # D_i = Σ_d dO∘O — the dv-free half of ds = p·(dp − D)
+    dd = jnp.einsum("blhd,blhd->bhl", gf, out.astype(jnp.float32))[..., None]
+
+    def step(dq_acc, kv):
+        k_blk, v_blk, bias_blk, kp = kv
+        s = _block_scores(q, k_blk, bias_blk, scale, q_pos,
+                          kp if causal else None, window)
+        p = jnp.exp(s - lse)
+        dp = jnp.einsum("blhd,bmhd->bhlm", gf,
+                        v_blk.astype(jnp.float32))
+        ds = p * (dp - dd)
+        # matmuls mirror the forward's precision: operands in the input
+        # dtype, f32 accumulation (MXU-native)
+        dsq = ds.astype(q.dtype)
+        dq_blk = jnp.einsum("bhlm,bmhd->blhd", dsq, k_blk,
+                            preferred_element_type=jnp.float32) * scale
+        dk_blk = jnp.einsum("bhlm,blhd->bmhd", dsq, q,
+                            preferred_element_type=jnp.float32) * scale
+        dv_blk = jnp.einsum("bhlm,blhd->bmhd", p.astype(q.dtype), g,
+                            preferred_element_type=jnp.float32)
+        dbias_blk = ds.sum(axis=(1, 2))  # bias (B,1,1,Lk) broadcasts h, Lq
+        return dq_acc + dq_blk, (dk_blk, dv_blk, dbias_blk)
+
+    dq, (dks, dvs, dbs) = jax.lax.scan(
+        step, jnp.zeros(q.shape, jnp.float32), (kb, vb, bias_b, k_pos)
+    )
+    dk = dks.transpose(1, 0, 2, 3, 4).reshape(b, lk, h, d)
+    dv = dvs.transpose(1, 0, 2, 3, 4).reshape(b, lk, h, d)
+    dbias = dbs.transpose(1, 0, 2).reshape(b, lk)[:, None, None, :]
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias.astype(bias.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _blockwise_cvjp(block, causal, window, q, k, v, bias):
+    out, _ = _blockwise_fwd_impl(q, k, v, bias, block, causal, window)
+    return out
+
+
+def _blockwise_cvjp_fwd(block, causal, window, q, k, v, bias):
+    out, lse = _blockwise_fwd_impl(q, k, v, bias, block, causal, window)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _blockwise_cvjp_bwd(block, causal, window, res, g):
+    q, k, v, bias, out, lse = res
+    return _blockwise_bwd_impl(q, k, v, bias, out, lse, g, block, causal,
+                               window)
+
+
+_blockwise_cvjp.defvjp(_blockwise_cvjp_fwd, _blockwise_cvjp_bwd)
+
+
+def blockwise_attention(q, k, v, bias, block: int = 256, causal: bool = False,
+                        window: int = 0, vjp: str | None = None):
+    """Memory-efficient attention: lax.scan over KV blocks, online softmax.
+
+    The numerics reference for both the pallas kernel and the ring path.
+    causal=True masks k_pos > q_pos (global positions; the ring path
+    reconstructs per-shard positions itself). window > 0 (requires causal)
+    is the Mistral sliding window: query i sees keys in (i - window, i].
+
+    vjp selects the gradient path (default: KFT_BLOCKWISE_VJP, validated
+    at import time):
+      "custom"   (default) FlashAttention-2-style custom VJP — the
+                 backward recomputes probabilities from the saved
+                 logsumexp, so residuals are O(L) and reverse-AD never
+                 traverses the online max/exp chain (which the r5
+                 hardware forensics implicate for NaN gradients on
+                 Mosaic — docs/perf.md §Round 5).
+      "autodiff" reverse-AD through the forward scan (pre-r5 behavior;
+                 kept as the forensics subject and escape hatch).
+    """
+    if window and not causal:
+        raise ValueError("attention window requires causal=True")
+    if vjp is None:
+        vjp = BLOCKWISE_VJP
+    if vjp == "autodiff":
+        out, _ = _blockwise_fwd_impl(q, k, v, bias, block, causal, window)
+        return out
+    if vjp != "custom":
+        raise ValueError(f"unknown blockwise vjp {vjp!r}")
+    return _blockwise_cvjp(block, causal, window, q, k, v, bias)
 
 
 # ------------------------------------------------------------------------ ring

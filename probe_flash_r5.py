@@ -123,8 +123,14 @@ def main() -> None:
             continue  # whole flavor banked by an earlier window
 
         def loss_ref(q, k, v, bias, c=causal):
+            # vjp="autodiff" pins the HISTORIC reference: every r3/r4/r5
+            # artifact compared against the scan-autodiff grads, and the
+            # r5 keys are classified as the suspect-autodiff tier by
+            # tunnel_watch3.pick_flash_bwd — a re-run must not silently
+            # switch to the r5 custom-VJP default (r5b owns that tier)
             return (blockwise_attention(q, k, v, bias, block=256,
-                                        causal=c).astype(jnp.float32)
+                                        causal=c, vjp="autodiff"
+                                        ).astype(jnp.float32)
                     * ct.astype(jnp.float32)).sum()
 
         try:
@@ -175,9 +181,10 @@ def main() -> None:
         win = 64 if interpret else 256
 
         def loss_wref(q, k, v, bias):
+            # vjp="autodiff": same historic-reference pin as loss_ref
             return (blockwise_attention(q, k, v, bias, block=256,
-                                        causal=True, window=win
-                                        ).astype(jnp.float32)
+                                        causal=True, window=win,
+                                        vjp="autodiff").astype(jnp.float32)
                     * ct.astype(jnp.float32)).sum()
 
         if not swa_todo and "swa_fwd" in banked:

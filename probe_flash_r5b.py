@@ -127,13 +127,15 @@ def main() -> None:
     # ------------- A + B: side isolation and dense-reference verdicts ----
     for causal, window, tag in ((False, 0, "full"), (True, 0, "causal"),
                                 (True, win, "swa")):
-        # A: reference-side NaN count (the blockwise autodiff the r3/r4/r5
-        # probes compared against)
+        # A: reference-side NaN count (the blockwise AUTODIFF the r3/r4/r5
+        # probes compared against — vjp="autodiff" pins the forensic
+        # subject now that the shipped default is the FA2 custom VJP)
         if f"refnan_{tag}" not in banked:
             try:
                 def loss_bw(q, k, v, bias, c=causal, w=window):
                     return (blockwise_attention(q, k, v, bias, block=256,
-                                                causal=c, window=w)
+                                                causal=c, window=w,
+                                                vjp="autodiff")
                             .astype(jnp.float32)
                             * ct.astype(jnp.float32)).sum()
 
@@ -147,9 +149,35 @@ def main() -> None:
                 traceback.print_exc(file=sys.stderr)
             _pet()
 
+        # A2: the SHIPPED path — blockwise custom VJP (r5 default; the
+        # gradient ring/ulysses local attention trains through). NaN
+        # counts AND a verdict against the dense f32 reference below.
+        if (f"custnan_{tag}" not in banked
+                or f"v2_blockwise_{tag}" not in banked):
+            try:
+                def loss_cv(q, k, v, bias, c=causal, w=window):
+                    return (blockwise_attention(q, k, v, bias, block=256,
+                                                causal=c, window=w,
+                                                vjp="custom")
+                            .astype(jnp.float32)
+                            * ct.astype(jnp.float32)).sum()
+
+                cust = jax.jit(jax.grad(loss_cv, argnums=(0, 1, 2, 3)))(
+                    q, k, v, bias)
+                print(f"RESULT custnan_{tag}={gstats(cust)}", flush=True)
+            except Exception as exc:  # noqa: BLE001
+                cust = None
+                print(f"RESULT custnan_{tag}=ERROR {type(exc).__name__}",
+                      flush=True)
+                probe_common.record_error(f"custnan_{tag}")
+                traceback.print_exc(file=sys.stderr)
+            _pet()
+        else:
+            cust = None
+
         # B: dense f32 reference grads + per-impl NaN counts and verdicts
         try:
-            need = ([f"densenan_{tag}"]
+            need = ([f"densenan_{tag}", f"v2_blockwise_{tag}"]
                     + [f"v2_{i}_{tag}" for i in ("loop2", "ddpre", "xla")]
                     + [f"implnan_{i}_{tag}" for i in ("loop2", "ddpre", "xla")])
             if all(key in banked for key in need):
@@ -163,6 +191,16 @@ def main() -> None:
                 q, k, v, bias)
             print(f"RESULT densenan_{tag}={gstats(dref)}", flush=True)
             _pet()
+            if cust is not None and f"v2_blockwise_{tag}" not in banked:
+                errs = [float(jnp.max(jnp.abs(
+                    a.astype(jnp.float32) - r.astype(jnp.float32))))
+                    for a, r in zip(cust, dref)]
+                ok = max(errs[:3]) < 0.25 and errs[3] < 2.0
+                print(f"RESULT v2_blockwise_{tag}="
+                      f"{'PASS' if ok else 'FAIL'} dq={errs[0]:.4g} "
+                      f"dk={errs[1]:.4g} dv={errs[2]:.4g} "
+                      f"dbias={errs[3]:.4g}", flush=True)
+                _pet()
             out, lse = jax.jit(
                 lambda q, k, v, bias, c=causal, w=window: _flash_forward(
                     q, k, v, bias, 256, 256, c, want_lse=True, window=w)
@@ -221,7 +259,7 @@ def main() -> None:
 
                 def loss_bw2(qq, kk, vv, bb, c=causal, blk=cfg["block"]):
                     return (blockwise_attention(qq, kk, vv, bb, block=blk,
-                                                causal=c)
+                                                causal=c, vjp="autodiff")
                             .astype(jnp.float32) * cc).sum()
 
                 g2 = jax.jit(jax.grad(loss_bw2, argnums=(0, 1, 2, 3)))(
