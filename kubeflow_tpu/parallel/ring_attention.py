@@ -173,6 +173,30 @@ def _blockwise_fwd_impl(q, k, v, bias, block, causal, window):
     return _finalize(o_acc, m, l, q.dtype), m + jnp.log(l)
 
 
+def _block_grads(q, k_blk, v_blk, bias_blk, g, gf, dd, lse, scale,
+                 q_pos, k_pos, window):
+    """FA2 per-block gradients — the ONE gradient-math implementation the
+    blockwise AND ring custom backwards share (a drift between them would
+    be invisible to tests that only compare each against dense).
+
+    Matmuls mirror the forward's precision: operands in the input dtype,
+    f32 accumulation (MXU-native). Returns (dq_blk, dk_blk, dv_blk,
+    dbias_rows (B, Lk_blk))."""
+    s = _block_scores(q, k_blk, bias_blk, scale, q_pos, k_pos, window)
+    p = jnp.exp(s - lse)
+    dp = jnp.einsum("blhd,bmhd->bhlm", gf, v_blk.astype(jnp.float32))
+    ds = p * (dp - dd)
+    dsq = ds.astype(q.dtype)
+    dq_blk = jnp.einsum("bhlm,bmhd->blhd", dsq, k_blk,
+                        preferred_element_type=jnp.float32) * scale
+    dk_blk = jnp.einsum("bhlm,blhd->bmhd", dsq, q,
+                        preferred_element_type=jnp.float32) * scale
+    dv_blk = jnp.einsum("bhlm,blhd->bmhd", p.astype(q.dtype), g,
+                        preferred_element_type=jnp.float32)
+    dbias_rows = ds.sum(axis=(1, 2))  # bias (B,1,1,Lk) broadcasts h, Lq
+    return dq_blk, dk_blk, dv_blk, dbias_rows
+
+
 def _blockwise_bwd_impl(q, k, v, bias, out, lse, g, block, causal, window):
     """FlashAttention-2-style backward: recompute p = exp(s − lse) block
     by block from the saved logsumexp; residual memory is O(L), not the
@@ -192,22 +216,9 @@ def _blockwise_bwd_impl(q, k, v, bias, out, lse, g, block, causal, window):
 
     def step(dq_acc, kv):
         k_blk, v_blk, bias_blk, kp = kv
-        s = _block_scores(q, k_blk, bias_blk, scale, q_pos,
-                          kp if causal else None, window)
-        p = jnp.exp(s - lse)
-        dp = jnp.einsum("blhd,bmhd->bhlm", gf,
-                        v_blk.astype(jnp.float32))
-        ds = p * (dp - dd)
-        # matmuls mirror the forward's precision: operands in the input
-        # dtype, f32 accumulation (MXU-native)
-        dsq = ds.astype(q.dtype)
-        dq_blk = jnp.einsum("bhlm,bmhd->blhd", dsq, k_blk,
-                            preferred_element_type=jnp.float32) * scale
-        dk_blk = jnp.einsum("bhlm,blhd->bmhd", dsq, q,
-                            preferred_element_type=jnp.float32) * scale
-        dv_blk = jnp.einsum("bhlm,blhd->bmhd", p.astype(q.dtype), g,
-                            preferred_element_type=jnp.float32)
-        dbias_blk = ds.sum(axis=(1, 2))  # bias (B,1,1,Lk) broadcasts h, Lq
+        dq_blk, dk_blk, dv_blk, dbias_blk = _block_grads(
+            q, k_blk, v_blk, bias_blk, g, gf, dd, lse, scale,
+            q_pos, kp if causal else None, window)
         return dq_acc + dq_blk, (dk_blk, dv_blk, dbias_blk)
 
     dq, (dks, dvs, dbs) = jax.lax.scan(
@@ -299,7 +310,7 @@ def _ring_hops(ring: int, l_loc: int, window: int) -> int:
 def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
                    block: int = 256, axis_name: str = AXIS_CONTEXT,
                    causal: bool = False, rope_theta: float | None = None,
-                   window: int = 0):
+                   window: int = 0, vjp: str | None = None):
     """Ring attention over the `context` mesh axis.
 
     Inside: per-device online-softmax accumulation against the local KV
@@ -320,6 +331,11 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
     instead of ring_size. At 32k context over an 8-shard ring with a 4k
     window that is 2 hops instead of 8 — both the ppermute traffic and
     the score matmuls drop ~4x.
+
+    vjp: "custom" (default via KFT_BLOCKWISE_VJP) runs the ring-rotating
+    FA2-style backward (_ring_core_bwd): O(L_loc) residuals and no
+    reverse-AD through the online max/exp chain (the r5 Mosaic-NaN
+    suspect); "autodiff" reverse-ADs the forward ring (pre-r5 behavior).
     """
     if dropout_rate:
         raise NotImplementedError("attention dropout unsupported in ring path")
@@ -338,48 +354,30 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
         if rope_theta is not None:
             q, k = _rope_qk(q, k, jnp.arange(q.shape[1]), rope_theta)
         return blockwise_attention(q, k, v, bias, block, causal=causal,
-                                   window=window)
+                                   window=window, vjp=vjp)
 
     scale = 1.0 / (q.shape[-1] ** 0.5)
+    if vjp is None:
+        vjp = BLOCKWISE_VJP
+    if vjp not in ("custom", "autodiff"):
+        raise ValueError(f"unknown ring vjp {vjp!r}")
 
     def per_device(q, k, v, bias):
-        ring = jax.lax.axis_size(axis_name)
-        idx = jax.lax.axis_index(axis_name)
-        perm = [(i, (i + 1) % ring) for i in range(ring)]
-        l_loc = q.shape[1]
-        # ONE global-position vector drives both the rope rotation and
-        # the causal mask — computing it twice invites desync
-        pos = idx * l_loc + jnp.arange(l_loc)
+        # _ring_positions is the ONE definition of the global-position
+        # vector — rope here and the causal masks in _ring_fwd_impl /
+        # _ring_core_bwd all call it, so they cannot desync
+        pos = _ring_positions(axis_name, q.shape[1])
         if rope_theta is not None:
             # rotate by GLOBAL position before the ring starts: each
             # shard rotates its LOCAL q and k once, and rotated K blocks
             # then travel the ring carrying their rotation (the same
             # invariant the KV cache keeps by storing rotated keys)
             q, k = _rope_qk(q, k, pos, rope_theta)
-        q_pos = pos if causal else None
-        hops = _ring_hops(ring, l_loc, window) if causal else ring
-
-        def step(i, carry_kv):
-            carry, kv = carry_kv
-            if causal:
-                src = (idx - i) % ring  # shard this KV block originated on
-                k_pos = src * l_loc + jnp.arange(l_loc)
-                carry = _online_block(carry, kv, q, scale, q_pos, k_pos,
-                                      window=window)
-            else:
-                carry = _online_block(carry, kv, q, scale)
-            # rotate KV (+ its bias slice) one hop; unconditional so the
-            # collective never sits inside data-dependent control flow (the
-            # final rotation restores placement on a full ring; a window-
-            # shortened ring just stops — the kv copy is consumed). XLA
-            # overlaps the ppermute with the next iteration's matmuls.
-            kv = jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
-            return (carry, kv)
-
-        carry, _ = jax.lax.fori_loop(
-            0, hops, step, (_init_carry(q), (k, v, bias))
-        )
-        return _finalize(*carry, q.dtype)
+        if vjp == "autodiff":
+            out, _ = _ring_fwd_impl(axis_name, causal, window, scale,
+                                    q, k, v, bias)
+            return out
+        return _ring_core(axis_name, causal, window, scale, q, k, v, bias)
 
     return jax.shard_map(
         per_device,
@@ -387,6 +385,119 @@ def ring_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
         out_specs=QKV_SPEC,
         check_vma=False,
     )(q, k, v, bias)
+
+
+def _ring_positions(axis_name, l_loc):
+    """Global token positions of this shard's local sequence block — the
+    ONE definition rope and the fwd/bwd causal masks share."""
+    return jax.lax.axis_index(axis_name) * l_loc + jnp.arange(l_loc)
+
+
+def _ring_fwd_impl(axis_name, causal, window, scale, q, k, v, bias):
+    """The ring forward: per-hop online-softmax accumulation against the
+    visiting KV block, ppermute rotating (k, v, bias) one hop per step.
+    Returns (out, lse (B,H,Lq,1) f32) — lse is the residual the custom
+    backward recomputes probabilities from."""
+    ring = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+    l_loc = q.shape[1]
+    q_pos = _ring_positions(axis_name, l_loc) if causal else None
+    hops = _ring_hops(ring, l_loc, window) if causal else ring
+
+    def step(i, carry_kv):
+        carry, kv = carry_kv
+        if causal:
+            src = (idx - i) % ring  # shard this KV block originated on
+            k_pos = src * l_loc + jnp.arange(l_loc)
+            carry = _online_block(carry, kv, q, scale, q_pos, k_pos,
+                                  window=window)
+        else:
+            carry = _online_block(carry, kv, q, scale)
+        # rotate KV (+ its bias slice) one hop; unconditional so the
+        # collective never sits inside data-dependent control flow (the
+        # final rotation restores placement on a full ring; a window-
+        # shortened ring just stops — the kv copy is consumed). XLA
+        # overlaps the ppermute with the next iteration's matmuls.
+        kv = jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
+        return (carry, kv)
+
+    (o_acc, m, l), _ = jax.lax.fori_loop(
+        0, hops, step, (_init_carry(q), (k, v, bias))
+    )
+    return _finalize(o_acc, m, l, q.dtype), m + jnp.log(l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _ring_core(axis_name, causal, window, scale, q, k, v, bias):
+    out, _ = _ring_fwd_impl(axis_name, causal, window, scale, q, k, v, bias)
+    return out
+
+
+def _ring_core_fwd(axis_name, causal, window, scale, q, k, v, bias):
+    out, lse = _ring_fwd_impl(axis_name, causal, window, scale, q, k, v,
+                              bias)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _ring_core_bwd(axis_name, causal, window, scale, res, g):
+    """Ring-rotating FlashAttention-2-style backward.
+
+    The KV blocks travel the SAME ring as the forward, and a zero-init
+    (dk, dv, dbias) accumulator travels WITH each block: when device i
+    attends the block originating on shard (i − s), it adds that hop's
+    dk/dv/dbias contribution to the visiting accumulator before both
+    rotate on. After `hops` rotations block j sits on shard (j + hops);
+    a single closing ppermute by −hops returns every accumulator to its
+    home shard with contributions from ALL query shards on board (a full
+    ring needs no closing hop — ring rotations compose to identity).
+    dq accumulates locally. Like the blockwise custom VJP, probabilities
+    are recomputed as exp(s − lse) from the saved global logsumexp, so
+    reverse-AD never traverses the online max/exp chain and residual
+    memory stays O(L_loc) per device."""
+    q, k, v, bias, out, lse = res
+    ring = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+    l_loc = q.shape[1]
+    q_pos = _ring_positions(axis_name, l_loc) if causal else None
+    hops = _ring_hops(ring, l_loc, window) if causal else ring
+    gf = g.astype(jnp.float32)
+    dd = jnp.einsum("blhd,blhd->bhl", gf, out.astype(jnp.float32))[..., None]
+
+    def step(i, carry):
+        dq, k_c, v_c, bias_c, dk_c, dv_c, dbias_c = carry
+        if causal:
+            src = (idx - i) % ring
+            k_pos = src * l_loc + jnp.arange(l_loc)
+        else:
+            k_pos = None
+        dq_blk, dk_blk, dv_blk, dbias_rows = _block_grads(
+            q, k_c, v_c, bias_c, g, gf, dd, lse, scale, q_pos, k_pos,
+            window)
+        dq = dq + dq_blk
+        dk_c = dk_c + dk_blk
+        dv_c = dv_c + dv_blk
+        dbias_c = dbias_c + dbias_rows[:, None, None, :]
+        rot = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        return (dq, rot(k_c), rot(v_c), rot(bias_c),
+                rot(dk_c), rot(dv_c), rot(dbias_c))
+
+    zeros_f32 = lambda x: jnp.zeros(x.shape, jnp.float32)
+    dq, _, _, _, dk, dv, dbias = jax.lax.fori_loop(
+        0, hops, step,
+        (zeros_f32(q), k, v, bias, zeros_f32(k), zeros_f32(v),
+         zeros_f32(bias)),
+    )
+    if hops % ring:  # closing rotation: send accumulators home in one hop
+        home = [(i, (i - hops) % ring) for i in range(ring)]
+        go = lambda x: jax.lax.ppermute(x, axis_name, home)
+        dk, dv, dbias = go(dk), go(dv), go(dbias)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias.astype(bias.dtype))
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
 # --------------------------------------------------------------------- ulysses
