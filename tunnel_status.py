@@ -26,6 +26,8 @@ STAGES = [
      "headline: resnet+bert only, <5 min — banks the north-star numbers"),
     ("probe_flash_r5.txt",
      "flash-backward verdict: loop2 + dd-prekernel candidates, term bisect"),
+    ("probe_flash_r5b.txt",
+     "which-side forensics: per-side NaN counts + dense-f32 v2 verdicts"),
     ("bench_r5_suite.jsonl",
      "full fixed-protocol suite (resume-seeded; never-captured rows first)"),
     ("probe_resnet.txt",
