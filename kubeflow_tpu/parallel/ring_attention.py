@@ -645,13 +645,32 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 
 def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
                    causal: bool = False, want_lse: bool = False,
-                   window: int = 0):
+                   window: int = 0, dimsem: bool | None = None):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = 1.0 / (d**0.5)
+    if dimsem is None:
+        dimsem = FLASH_DIMSEM
+    # KFT_FLASH_BLOCK_Q/K adopt a probe-timed FORWARD tile only — the
+    # backward keeps the caller's geometry, which is what the backward
+    # verdicts validated (the fwd-only sweep must not retile the
+    # NaN-history backward kernels). lse is per-row, so fwd/bwd tiles
+    # are independent.
+    env_tiled = FLASH_BLOCK_Q or FLASH_BLOCK_K
+    if env_tiled:
+        block_q = FLASH_BLOCK_Q or block_q
+        block_k = FLASH_BLOCK_K or block_k
     block_q = min(block_q, lq)
     block_k = min(block_k, lk)
     if lq % block_q or lk % block_k:
+        if env_tiled:
+            import warnings
+
+            warnings.warn(
+                f"KFT_FLASH_BLOCK_Q/K=({FLASH_BLOCK_Q},{FLASH_BLOCK_K}) "
+                f"does not tile (lq={lq}, lk={lk}); flash fell back to "
+                "blockwise — the capture is NOT measuring the adopted "
+                "kernel geometry", stacklevel=2)
         out = blockwise_attention(q, k, v, bias, causal=causal,
                                   window=window)
         return (out, None) if want_lse else out
@@ -690,6 +709,11 @@ def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=jax.default_backend() == "cpu",
+        # the KV dim is a sequential accumulation (scratch carries m/l/acc
+        # across ik); bh and iq cells are independent
+        **({"compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))}
+           if dimsem else {}),
     )(qf, kf, vf, bias)
     out = of.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
     return (out, lse) if want_lse else out
@@ -858,6 +882,17 @@ if FLASH_BWD_IMPL not in _FLASH_BWD_IMPLS:
         f"KFT_FLASH_BWD_IMPL={FLASH_BWD_IMPL!r} is not one of "
         f"{_FLASH_BWD_IMPLS} — refusing to fall through to an arbitrary "
         "backward (the scratch kernels NaN on Mosaic)")
+
+# Capture-campaign tuning knobs, import-time like KFT_FLASH_BWD_IMPL:
+#   KFT_FLASH_BLOCK_Q / KFT_FLASH_BLOCK_K  override flash_attention's
+#     square `block` with an asymmetric tile (probe_flash_r5b section F
+#     times the candidates; the only timed geometry so far was square).
+#   KFT_FLASH_DIMSEM=1  annotates the forward grid (parallel, parallel,
+#     arbitrary) via Mosaic CompilerParams — numerics re-verified by the
+#     probe before any bench adopts it.
+FLASH_BLOCK_Q = int(_os.environ.get("KFT_FLASH_BLOCK_Q", "0"))
+FLASH_BLOCK_K = int(_os.environ.get("KFT_FLASH_BLOCK_K", "0"))
+FLASH_DIMSEM = _os.environ.get("KFT_FLASH_DIMSEM", "") == "1"
 
 
 def _flash_backward_xla(qf, kf, vf, bias, gf, lse, dd, *, b, h, lq, lk, d,
@@ -1425,4 +1460,6 @@ def flash_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
         raise NotImplementedError("attention dropout unsupported in flash path")
     if window and not causal:
         raise ValueError("attention window requires causal=True")
+    # KFT_FLASH_BLOCK_Q/K apply inside _flash_forward (forward tile only;
+    # the backward keeps this block — its validated geometry)
     return _flash(q, k, v, bias, block, block, causal, window)

@@ -274,6 +274,74 @@ def main() -> None:
                 traceback.print_exc(file=sys.stderr)
             _pet()
 
+    # ------------- F: forward-tile geometry sweep ------------------------
+    # The only geometry ever timed on Mosaic is SQUARE blocks (r3:
+    # 128/256/512, 256 best at 2.81 TFLOPs). Attention is ~43% of GPT-2s
+    # FLOPs at 2k, so kernel throughput is the training-MFU lever.
+    # Times fwd-only for asymmetric (block_q, block_k) candidates and the
+    # dimension_semantics annotation, with a numerics gate vs the shipped
+    # (256, 256, no-dimsem) forward. KFT_FLASH_BLOCK_Q/K / KFT_FLASH_DIMSEM
+    # adopt a winner at the next capture.
+    def timed_ms(fn, *args, iters=8):
+        fn(*args)[0].block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(*args)
+        jax.tree.map(lambda x: x.block_until_ready(), r)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    try:
+        geoms = [(128, 256, False), (256, 512, False), (512, 256, False),
+                 (256, 1024, False), (512, 512, False),
+                 (256, 256, True), (512, 256, True)]
+        todo = ("ftime_bq256_bk256_ms" not in banked) or any(
+            f"ftime_bq{bq}_bk{bk}{'_ds' if ds else ''}_ms" not in banked
+            for bq, bk, ds in geoms if bq <= l and bk <= l)
+        if not todo:
+            raise StopIteration  # whole sweep banked: skip the baseline too
+        fq = born(2, l, h, 64, key=30)
+        fk = born(2, l, h, 64, key=31)
+        fv = born(2, l, h, 64, key=32)
+        fb = jnp.zeros((2, 1, 1, l), jnp.bfloat16)
+        base_fn = jax.jit(lambda q, k, v, b: _flash_forward(
+            q, k, v, b, 256, 256, True, want_lse=True, dimsem=False))
+        base_out = base_fn(fq, fk, fv, fb)[0]
+        if "ftime_bq256_bk256_ms" not in banked:
+            print(f"RESULT ftime_bq256_bk256_ms="
+                  f"{timed_ms(base_fn, fq, fk, fv, fb):.2f}", flush=True)
+            _pet()
+        for bq, bk, ds_flag in geoms:
+            if bq > l or bk > l:
+                continue
+            key = f"ftime_bq{bq}_bk{bk}{'_ds' if ds_flag else ''}"
+            if f"{key}_ms" in banked:
+                continue
+            try:
+                fn = jax.jit(lambda q, k, v, b, bq=bq, bk=bk, d2=ds_flag:
+                             _flash_forward(q, k, v, b, bq, bk, True,
+                                            want_lse=True, dimsem=d2))
+                err = float(jnp.max(jnp.abs(
+                    fn(fq, fk, fv, fb)[0].astype(jnp.float32)
+                    - base_out.astype(jnp.float32))))
+                if err > 0.02:
+                    print(f"RESULT {key}_ms=FAILNUM err={err:.4g}",
+                          flush=True)
+                else:
+                    print(f"RESULT {key}_ms="
+                          f"{timed_ms(fn, fq, fk, fv, fb):.2f}", flush=True)
+            except Exception as exc:  # noqa: BLE001
+                print(f"RESULT {key}_ms=ERROR {type(exc).__name__}",
+                      flush=True)
+                # timing candidates are best-effort: an unsupported
+                # geometry must not keep the stage retrying forever
+            _pet()
+    except StopIteration:
+        pass  # sweep fully banked by an earlier window
+    except Exception as exc:  # noqa: BLE001
+        print(f"RESULT ftime_setup=ERROR {type(exc).__name__}", flush=True)
+        traceback.print_exc(file=sys.stderr)
+        _pet()
+
     print("RESULT probe_flash_r5b=complete", flush=True)
     sys.exit(probe_common.exit_code())
 
