@@ -44,6 +44,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import sys
 import time
 
@@ -270,9 +271,14 @@ def bench_resnet50(steps: int = 30, batch_size: int = 128, image_size: int = 224
     # config, so a positive probe_resnet verdict flips the flagship bench
     # with env flags, zero code change): stem "7x7"|"s2d" (exact-equivalent
     # under stem_weights_7x7_to_s2d), conv_impl "auto"|"xla"|"im2col" or a
-    # comma-list of 5 per-stage impls (stem,stage1..4)
-    stem = os.environ.get("KFT_RESNET_STEM", "7x7")
-    conv_impl: str | tuple = os.environ.get("KFT_RESNET_CONV_IMPL", "auto")
+    # comma-list of 5 per-stage impls (stem,stage1..4). With no env flags
+    # set, the verdict is adopted AUTOMATICALLY from probe_resnet.txt's
+    # fastest full-model row at this batch size — so the driver's plain
+    # `python bench.py` benefits from a probe that landed the same round.
+    auto = _resnet_probe_flags(batch_size)
+    stem = os.environ.get("KFT_RESNET_STEM") or (auto or ("7x7",))[0]
+    conv_impl: str | tuple = (os.environ.get("KFT_RESNET_CONV_IMPL")
+                              or (auto or (None, "auto"))[1])
     if "," in conv_impl:
         conv_impl = tuple(conv_impl.split(","))
         if len(conv_impl) != 5:
@@ -298,8 +304,41 @@ def bench_resnet50(steps: int = 30, batch_size: int = 128, image_size: int = 224
         "stem": stem,
         "conv_impl": (",".join(conv_impl)
                       if isinstance(conv_impl, tuple) else conv_impl),
+        "flags_from": ("env" if os.environ.get("KFT_RESNET_STEM")
+                       or os.environ.get("KFT_RESNET_CONV_IMPL")
+                       else ("probe_resnet" if auto else "default")),
     }
     return _finish(r, dt, steps, 3 * 4.09e9 * batch_size)
+
+
+def _resnet_probe_flags(batch_size: int,
+                        path: str | None = None) -> tuple[str, str] | None:
+    """(stem, conv_impl) of the fastest probe_resnet full-model row at this
+    batch size, or None if the probe has not banked any.
+
+    probe_resnet section C rows are configs a bench can adopt verbatim
+    (`resnet50_{impl}_{stem}_fwdbwd_b{bs}_ms=<ms> tflops=<tf>`); the
+    artifact is append-accumulated across windows, so the LAST line per
+    key wins (same contract as tunnel_watch3.last_val)."""
+    path = path or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "probe_resnet.txt")
+    best: tuple[float, str, str] | None = None
+    try:
+        rows: dict[str, float] = {}
+        with open(path) as fh:
+            for ln in fh:
+                m = re.match(
+                    rf"RESULT resnet50_(\w+)_(\w+)_fwdbwd_b{batch_size}"
+                    r"_ms=([0-9.]+)", ln.strip())
+                if m:
+                    rows[f"{m.group(1)}|{m.group(2)}"] = float(m.group(3))
+        for key, ms in rows.items():
+            impl, stem = key.split("|")
+            if best is None or ms < best[0]:
+                best = (ms, stem, impl)
+    except OSError:
+        return None
+    return (best[1], best[2]) if best else None
 
 
 def bench_bert_base(steps: int = 20, batch_size: int = 16, seq_len: int = 128) -> dict:

@@ -247,3 +247,26 @@ def test_bench_gpt_flash_smoke(monkeypatch):
     assert r["metric"] == "gpt2s_flash_2k_tokens_per_sec_per_chip"
     assert r["value"] > 0
     assert r["model_flops_per_step"] > 0
+
+
+def test_resnet_probe_flag_adoption(tmp_path):
+    """bench_resnet50 adopts the fastest probe_resnet full-model row at its
+    batch size (last line per key wins, append-accumulated artifact); env
+    flags override; absent/empty artifact -> None (defaults)."""
+    import bench
+
+    art = tmp_path / "probe_resnet.txt"
+    assert bench._resnet_probe_flags(128, str(art)) is None
+    art.write_text(
+        "RESULT resnet50_xla_7x7_fwdbwd_b128_ms=20.000 tflops=40.00\n"
+        "RESULT resnet50_xla_s2d_fwdbwd_b128_ms=15.500 tflops=52.00\n"
+        "RESULT resnet50_im2col_7x7_fwdbwd_b128_ms=30.000 tflops=26.00\n"
+        "RESULT resnet50_xla_s2d_fwdbwd_b256_ms=1.000 tflops=99.00\n"
+    )
+    assert bench._resnet_probe_flags(128, str(art)) == ("s2d", "xla")
+    assert bench._resnet_probe_flags(256, str(art)) == ("s2d", "xla")
+    assert bench._resnet_probe_flags(64, str(art)) is None
+    # append semantics: a later re-measurement of the same key wins
+    with art.open("a") as fh:
+        fh.write("RESULT resnet50_xla_7x7_fwdbwd_b128_ms=10.000 tflops=80.00\n")
+    assert bench._resnet_probe_flags(128, str(art)) == ("7x7", "xla")
