@@ -275,10 +275,19 @@ def bench_resnet50(steps: int = 30, batch_size: int = 128, image_size: int = 224
     # set, the verdict is adopted AUTOMATICALLY from probe_resnet.txt's
     # fastest full-model row at this batch size — so the driver's plain
     # `python bench.py` benefits from a probe that landed the same round.
-    auto = _resnet_probe_flags(batch_size)
-    stem = os.environ.get("KFT_RESNET_STEM") or (auto or ("7x7",))[0]
-    conv_impl: str | tuple = (os.environ.get("KFT_RESNET_CONV_IMPL")
-                              or (auto or (None, "auto"))[1])
+    env_set = (os.environ.get("KFT_RESNET_STEM")
+               or os.environ.get("KFT_RESNET_CONV_IMPL"))
+    if env_set:
+        # operator pinned the config: env wins WHOLESALE (a probe value
+        # must not silently fill the other half of a pinned pair)
+        auto = None
+        stem = os.environ.get("KFT_RESNET_STEM", "7x7")
+        conv_impl: str | tuple = os.environ.get("KFT_RESNET_CONV_IMPL",
+                                                "auto")
+    else:
+        auto = _resnet_probe_flags(batch_size)
+        stem = (auto or ("7x7",))[0]
+        conv_impl = (auto or (None, "auto"))[1]
     if "," in conv_impl:
         conv_impl = tuple(conv_impl.split(","))
         if len(conv_impl) != 5:
@@ -304,8 +313,7 @@ def bench_resnet50(steps: int = 30, batch_size: int = 128, image_size: int = 224
         "stem": stem,
         "conv_impl": (",".join(conv_impl)
                       if isinstance(conv_impl, tuple) else conv_impl),
-        "flags_from": ("env" if os.environ.get("KFT_RESNET_STEM")
-                       or os.environ.get("KFT_RESNET_CONV_IMPL")
+        "flags_from": ("env" if env_set
                        else ("probe_resnet" if auto else "default")),
     }
     return _finish(r, dt, steps, 3 * 4.09e9 * batch_size)
@@ -324,15 +332,27 @@ def _resnet_probe_flags(batch_size: int,
                                 "probe_resnet.txt")
     best: tuple[float, str, str] | None = None
     try:
-        rows: dict[str, float] = {}
+        # last line per key wins — INCLUDING a later =ERROR re-measurement,
+        # which invalidates the key (adopting a config whose most recent
+        # probe run failed would crash the flagship bench)
+        rows: dict[str, float | None] = {}
         with open(path) as fh:
             for ln in fh:
+                ln = ln.strip()
                 m = re.match(
                     rf"RESULT resnet50_(\w+)_(\w+)_fwdbwd_b{batch_size}"
-                    r"_ms=([0-9.]+)", ln.strip())
+                    r"_ms=([0-9.]+)", ln)
                 if m:
                     rows[f"{m.group(1)}|{m.group(2)}"] = float(m.group(3))
+                    continue
+                m = re.match(
+                    rf"RESULT resnet50_(\w+)_(\w+)_fwdbwd_b{batch_size}"
+                    r"=ERROR", ln)
+                if m:
+                    rows[f"{m.group(1)}|{m.group(2)}"] = None
         for key, ms in rows.items():
+            if ms is None:
+                continue
             impl, stem = key.split("|")
             if best is None or ms < best[0]:
                 best = (ms, stem, impl)

@@ -36,9 +36,10 @@ from kubeflow_tpu.parallel.moe import MOE_PARTITION_RULES, MoeMlp
 PARTITION_RULES: list[tuple[str, P]] = [
     (r"(query|key|value)/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
     (r"attn_out/kernel$", P(AXIS_MODEL, AXIS_FSDP)),
-    (r"mlp_up/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
+    (r"(mlp_up|mlp_gate)/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
     (r"mlp_down/kernel$", P(AXIS_MODEL, AXIS_FSDP)),
     (r"token_embed/embedding$", P(AXIS_MODEL, AXIS_FSDP)),
+    (r"lm_head/kernel$", P(AXIS_FSDP, AXIS_MODEL)),
     (r"position_embed/embedding$", P(None, AXIS_FSDP)),
     *MOE_PARTITION_RULES,
 ]
@@ -69,9 +70,9 @@ class GPTConfig:
     kv_cache_capacity: int = 0
     # sliding-window attention (Mistral): each query attends to at most
     # the previous `attention_window` positions (itself included). 0 =
-    # full causal. Composes with GQA + rope; dense + decode paths only
-    # (ring/ulysses/flash reject a window — their block/ring masking
-    # does not carry it yet)
+    # full causal. Composes with GQA + rope on EVERY path since r4:
+    # dense, decode, flash (whole out-of-window KV blocks skipped,
+    # O(L·W)), ring (hop count shrinks to ceil(window/L_loc)+1), ulysses
     attention_window: int = 0
     mlp_dim: int = 3072
     max_len: int = 1024
@@ -79,6 +80,19 @@ class GPTConfig:
     dtype: Any = jnp.float32
     attention: str = "dense"  # dense | ring | ulysses | flash
     attention_block: int = 128
+    # Llama/Mistral-shape knobs (GPTConfig.llama() sets all four):
+    #   norm       "layernorm" (GPT-2) | "rmsnorm" (scale-only, no mean
+    #              subtraction — cheaper on TPU: one reduction, no bias add)
+    #   activation "gelu" (single up-projection) | "swiglu"
+    #              (silu(gate)·up — two up-projections; mlp_dim is the
+    #              intermediate width in both cases)
+    #   use_bias   False drops bias from every projection and LayerNorm
+    #   tie_embeddings  False reads logits from a separate lm_head matmul
+    #              instead of token_embed.attend (Llama unties; GPT-2 ties)
+    norm: str = "layernorm"
+    activation: str = "gelu"
+    use_bias: bool = True
+    tie_embeddings: bool = True
     # rematerialize each block on backward (jax.checkpoint): activation
     # memory drops from O(layers x seq x hidden) to O(seq x hidden) at the
     # cost of one extra forward — the standard long-context HBM lever
@@ -143,6 +157,21 @@ class GPTConfig:
                 f"moe_top_k {self.moe_top_k} > moe_experts "
                 f"{self.moe_experts}"
             )
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"norm {self.norm!r} is not layernorm|rmsnorm")
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(
+                f"activation {self.activation!r} is not gelu|swiglu")
+        if self.moe_experts and (self.activation != "gelu"
+                                 or not self.use_bias):
+            # MoeMlp has its own fixed gelu + bias parameters; silently
+            # overriding the llama knobs inside the MoE branch would hand
+            # back a gelu, biased MLP under a config that promises swiglu/
+            # bias-free (Mixtral-style swiglu experts are future work)
+            raise ValueError(
+                "moe_experts does not compose with activation='swiglu' or "
+                "use_bias=False yet — MoeMlp's experts are gelu+bias "
+                "(see parallel/moe.py)")
 
     @staticmethod
     def small(**kw) -> "GPTConfig":
@@ -152,6 +181,20 @@ class GPTConfig:
     def tiny(**kw) -> "GPTConfig":
         d = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
                  mlp_dim=128, max_len=256)
+        d.update(kw)
+        return GPTConfig(**d)
+
+    @staticmethod
+    def llama(**kw) -> "GPTConfig":
+        """Llama/Mistral-shaped decoder: RMSNorm, SwiGLU, rope, GQA-ready,
+        bias-free, untied head. Defaults to a test-sized shape; pass real
+        dims for production (Mistral-7B ≈ hidden 4096, layers 32, heads
+        32, num_kv_heads 8, mlp_dim 14336, attention_window 4096)."""
+        d = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+                 num_kv_heads=2, mlp_dim=176, max_len=256,
+                 norm="rmsnorm", activation="swiglu", use_bias=False,
+                 tie_embeddings=False, position_embedding="rope",
+                 dropout_rate=0.0)
         d.update(kw)
         return GPTConfig(**d)
 
@@ -184,6 +227,13 @@ def causal_dense_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
     return jnp.einsum("bhlm,bmhd->blhd", probs, v)
 
 
+def _decoder_norm(c: "GPTConfig", name: str):
+    """The block norm: LayerNorm (GPT-2) or scale-only RMSNorm (Llama)."""
+    if c.norm == "rmsnorm":
+        return nn.RMSNorm(dtype=c.dtype, name=name)
+    return nn.LayerNorm(dtype=c.dtype, name=name, use_bias=c.use_bias)
+
+
 class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
 
@@ -193,7 +243,7 @@ class CausalSelfAttention(nn.Module):
         head_dim = c.hidden_size // c.num_heads
         kv_heads = c.num_kv_heads or c.num_heads
         heads = lambda n, name: nn.DenseGeneral(  # noqa: E731
-            (n, head_dim), dtype=c.dtype, name=name
+            (n, head_dim), dtype=c.dtype, name=name, use_bias=c.use_bias
         )
         q = heads(c.num_heads, "query")(x)
         k = heads(kv_heads, "key")(x)
@@ -234,7 +284,8 @@ class CausalSelfAttention(nn.Module):
                 y = attn_fn(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
                             block=c.attention_block, causal=True, **kw)
         return nn.DenseGeneral(
-            c.hidden_size, axis=(-2, -1), dtype=c.dtype, name="attn_out"
+            c.hidden_size, axis=(-2, -1), dtype=c.dtype, name="attn_out",
+            use_bias=c.use_bias,
         )(y)
 
     def _cached_attention(self, q, k, v):
@@ -353,12 +404,12 @@ class GPTBlock(nn.Module):
     def __call__(self, x, bias, train: bool, decode: bool = False):
         c = self.cfg
         y = CausalSelfAttention(c, name="attention")(
-            nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x), bias, train,
+            _decoder_norm(c, "ln_attn")(x), bias, train,
             decode=decode,
         )
         y = nn.Dropout(c.dropout_rate, deterministic=not train)(y)
         x = constrain(x + y, ACT_SPEC)
-        h = nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x)
+        h = _decoder_norm(c, "ln_mlp")(x)
         if c.moe_experts:
             # short decode blocks route DROPLESS (no capacity, row-
             # independent) so KV-cache decode — solo, continuous-batched,
@@ -373,9 +424,19 @@ class GPTBlock(nn.Module):
                 capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
                 name="moe",
             )(h, dropless=decode and x.shape[1] <= MOE_DROPLESS_MAX_LEN)
+        elif c.activation == "swiglu":
+            # Llama MLP: silu(gate)·up, both width mlp_dim, then down
+            gate = nn.Dense(c.mlp_dim, dtype=c.dtype, use_bias=c.use_bias,
+                            name="mlp_gate")(h)
+            up = nn.Dense(c.mlp_dim, dtype=c.dtype, use_bias=c.use_bias,
+                          name="mlp_up")(h)
+            h = nn.Dense(c.hidden_size, dtype=c.dtype, use_bias=c.use_bias,
+                         name="mlp_down")(nn.silu(gate) * up)
         else:
-            h = nn.gelu(nn.Dense(c.mlp_dim, dtype=c.dtype, name="mlp_up")(h))
-            h = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_down")(h)
+            h = nn.gelu(nn.Dense(c.mlp_dim, dtype=c.dtype,
+                                 use_bias=c.use_bias, name="mlp_up")(h))
+            h = nn.Dense(c.hidden_size, dtype=c.dtype, use_bias=c.use_bias,
+                         name="mlp_down")(h)
         h = nn.Dropout(c.dropout_rate, deterministic=not train)(h)
         return constrain(x + h, ACT_SPEC)
 
@@ -426,8 +487,12 @@ class GPTLM(nn.Module):
         )
         for i in range(c.num_layers):
             x = block_cls(c, name=f"layer_{i}")(x, bias, train, decode)
-        x = nn.LayerNorm(dtype=c.dtype, name="ln_final")(x)
-        logits = token_embed.attend(x)  # weight-tied head
+        x = _decoder_norm(c, "ln_final")(x)
+        if c.tie_embeddings:
+            logits = token_embed.attend(x)  # weight-tied head (GPT-2)
+        else:
+            logits = nn.Dense(c.vocab_size, dtype=c.dtype, use_bias=False,
+                              name="lm_head")(x)  # untied (Llama)
         return logits.astype(jnp.float32)
 
 
