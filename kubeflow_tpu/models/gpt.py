@@ -162,16 +162,6 @@ class GPTConfig:
         if self.activation not in ("gelu", "swiglu"):
             raise ValueError(
                 f"activation {self.activation!r} is not gelu|swiglu")
-        if self.moe_experts and (self.activation != "gelu"
-                                 or not self.use_bias):
-            # MoeMlp has its own fixed gelu + bias parameters; silently
-            # overriding the llama knobs inside the MoE branch would hand
-            # back a gelu, biased MLP under a config that promises swiglu/
-            # bias-free (Mixtral-style swiglu experts are future work)
-            raise ValueError(
-                "moe_experts does not compose with activation='swiglu' or "
-                "use_bias=False yet — MoeMlp's experts are gelu+bias "
-                "(see parallel/moe.py)")
 
     @staticmethod
     def small(**kw) -> "GPTConfig":
@@ -422,6 +412,7 @@ class GPTBlock(nn.Module):
                 hidden_size=c.hidden_size, mlp_dim=c.mlp_dim,
                 num_experts=c.moe_experts, top_k=c.moe_top_k,
                 capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
+                activation=c.activation, use_bias=c.use_bias,
                 name="moe",
             )(h, dropless=decode and x.shape[1] <= MOE_DROPLESS_MAX_LEN)
         elif c.activation == "swiglu":

@@ -217,10 +217,45 @@ def test_llama_serves_through_continuous_engine():
         np.testing.assert_array_equal(req.result(timeout=1), want)
 
 
-def test_moe_rejects_llama_knobs():
-    """MoeMlp experts are gelu+bias: the llama knobs must be rejected, not
-    silently overridden, when composed with moe_experts."""
-    with pytest.raises(ValueError, match="moe_experts does not compose"):
-        GPTConfig.llama(moe_experts=4)
-    # gelu+bias MoE still fine
-    GPTConfig.tiny(moe_experts=4, dropout_rate=0.0)
+class TestMixtralShape:
+    """llama knobs + moe_experts = the Mixtral decoder: swiglu bias-free
+    EXPERTS (MoeMlp activation/use_bias thread through from the config)."""
+
+    def test_expert_params_are_swiglu_bias_free(self):
+        from flax import traverse_util
+
+        cfg = GPTConfig.llama(moe_experts=4, moe_top_k=2, max_len=32)
+        model = GPTLM(cfg, pad_token_id=-1)
+        variables = model.init(jax.random.PRNGKey(5),
+                               jnp.array([[1, 2, 3]], jnp.int32))
+        names = set(traverse_util.flatten_dict(variables["params"],
+                                               sep="/"))
+        assert any(n.endswith("moe/w_gate") for n in names)
+        assert not any("/b_up" in n or "/b_gate" in n or "/b_down" in n
+                       for n in names)
+
+    def test_decode_matches_full_forward(self):
+        cfg = GPTConfig.llama(moe_experts=4, moe_top_k=2, max_len=48)
+        model = GPTLM(cfg, pad_token_id=-1)
+        prompt = jnp.array([[6, 2, 8]], jnp.int32)
+        variables = model.init(jax.random.PRNGKey(6), prompt)
+        got = generate(model, variables, prompt, max_new_tokens=6)
+        want = _greedy_reference(model, variables, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_trains_with_aux_loss(self):
+        from kubeflow_tpu.train import Trainer, TrainerConfig
+        from kubeflow_tpu.train.data import synthetic_lm_dataset
+
+        cfg = GPTConfig.llama(moe_experts=4, max_len=32)
+        ds = synthetic_lm_dataset(n_train=16, n_test=8, seq_len=16,
+                                  vocab_size=cfg.vocab_size)
+        trainer = Trainer(GPTLM(cfg),
+                          TrainerConfig(batch_size=8,
+                                        log_every_steps=10**9),
+                          loss_fn=causal_lm_loss)
+        state = trainer.init_state(ds.x_train[:8])
+        state, m = trainer.train_step(state, (ds.x_train[:8],
+                                              ds.y_train[:8]))
+        assert np.isfinite(float(m["loss"]))
+        assert np.isfinite(float(m["grad_norm"]))
