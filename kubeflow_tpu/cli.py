@@ -547,6 +547,32 @@ def cmd_import_gpt2(args) -> int:
     return 0
 
 
+def cmd_import_llama(args) -> int:
+    """HF/torch Llama/Mistral checkpoint -> serving-ready gpt-lm predictor
+    dir (GPTConfig.llama family: rope + GQA + RMSNorm + SwiGLU)."""
+    from kubeflow_tpu.train.convert import import_llama
+    from kubeflow_tpu.utils import select_device
+
+    select_device(args.device)
+    try:
+        out = import_llama(
+            args.checkpoint, args.out,
+            num_heads=args.num_heads or None,
+            max_new_tokens=args.max_new_tokens, max_len=args.max_len,
+            prompt_len=args.prompt_len,
+            continuous_rows=args.continuous_rows,
+        )
+    except (OSError, KeyError, ValueError) as exc:
+        print(f"import error: {exc}", file=sys.stderr)
+        return 2
+    print(f"serving-ready predictor dir: {out}\n"
+          f"  serve:    python -m kubeflow_tpu.serving.server "
+          f"--model-name llama --model-dir {out}\n"
+          f"  generate: python -m kubeflow_tpu generate --model-dir {out} "
+          f"--prompt '<ids>'")
+    return 0
+
+
 def cmd_import_bert(args) -> int:
     """HF/torch BERT checkpoint -> serving-ready classifier predictor."""
     from kubeflow_tpu.train.convert import import_bert
@@ -651,6 +677,26 @@ def main(argv: list[str] | None = None) -> int:
                    help="HF vocab.json — with --merges-txt, bundles the "
                         "checkpoint's byte-level BPE as tokenizer.json")
     p.add_argument("--merges-txt", default=None)
+    p.add_argument("--continuous-rows", type=int, default=0,
+                   help="serve through the continuous-batching engine "
+                        "with this many decode rows (0 = plain decode)")
+    p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
+
+    p = add("import-llama", cmd_import_llama,
+            help="convert an HF/torch Llama/Mistral checkpoint into a "
+                 "serving-ready gpt-lm predictor dir (rope+GQA+RMSNorm+"
+                 "SwiGLU family)")
+    p.add_argument("--checkpoint", required=True,
+                   help="torch .pt/.bin with a Llama/MistralForCausalLM "
+                        "state dict")
+    p.add_argument("-o", "--out", required=True)
+    p.add_argument("--num-heads", type=int, default=0,
+                   help="attention head count (required unless the "
+                        "checkpoint carries config.num_attention_heads; "
+                        "num_kv_heads is read off k_proj)")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--max-len", type=int, default=None)
+    p.add_argument("--prompt-len", type=int, default=16)
     p.add_argument("--continuous-rows", type=int, default=0,
                    help="serve through the continuous-batching engine "
                         "with this many decode rows (0 = plain decode)")

@@ -93,6 +93,9 @@ class GPTConfig:
     activation: str = "gelu"
     use_bias: bool = True
     tie_embeddings: bool = True
+    # norm epsilon (flax default 1e-6); HF checkpoints vary (Llama-2 uses
+    # 1e-5) and the importer threads the checkpoint's value for parity
+    norm_eps: float = 1e-6
     # rematerialize each block on backward (jax.checkpoint): activation
     # memory drops from O(layers x seq x hidden) to O(seq x hidden) at the
     # cost of one extra forward — the standard long-context HBM lever
@@ -220,8 +223,9 @@ def causal_dense_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
 def _decoder_norm(c: "GPTConfig", name: str):
     """The block norm: LayerNorm (GPT-2) or scale-only RMSNorm (Llama)."""
     if c.norm == "rmsnorm":
-        return nn.RMSNorm(dtype=c.dtype, name=name)
-    return nn.LayerNorm(dtype=c.dtype, name=name, use_bias=c.use_bias)
+        return nn.RMSNorm(dtype=c.dtype, name=name, epsilon=c.norm_eps)
+    return nn.LayerNorm(dtype=c.dtype, name=name, use_bias=c.use_bias,
+                        epsilon=c.norm_eps)
 
 
 class CausalSelfAttention(nn.Module):

@@ -111,6 +111,88 @@ def torch_gpt2_to_variables(state_dict: dict, cfg: GPTConfig) -> dict:
     return {"params": params}
 
 
+def torch_llama_to_variables(state_dict: dict, cfg: GPTConfig) -> dict:
+    """HF LlamaForCausalLM / MistralForCausalLM state dict -> GPTLM
+    variables (the GPTConfig.llama family). torch Linear stores
+    (out, in), so every projection transposes. No rope permutation is
+    needed: apply_rope (parallel/rope.py) uses the same half-split
+    rotate-half convention as transformers' Llama."""
+    sd = _strip(state_dict, prefixes=("module.", "model."))
+    h, heads = cfg.hidden_size, cfg.num_heads
+    hd = h // heads
+    kvh = cfg.num_kv_heads or heads
+    if cfg.position_embedding != "rope" or cfg.norm != "rmsnorm" \
+            or cfg.activation != "swiglu":
+        raise ValueError(
+            "llama checkpoints need a llama-shaped config "
+            "(GPTConfig.llama: rope + rmsnorm + swiglu); got "
+            f"position_embedding={cfg.position_embedding!r} "
+            f"norm={cfg.norm!r} activation={cfg.activation!r}")
+
+    def need(key: str) -> np.ndarray:
+        if key not in sd:
+            raise KeyError(
+                f"checkpoint is missing {key!r} — not a Llama/Mistral "
+                "state dict?")
+        return _np(sd[key])
+
+    emb = need("embed_tokens.weight")
+    if emb.shape != (cfg.vocab_size, h):
+        raise ValueError(
+            f"embed_tokens {emb.shape} != (vocab_size {cfg.vocab_size}, "
+            f"hidden {h}) — config does not match the checkpoint")
+    params: dict = {
+        "token_embed": {"embedding": emb},
+        "ln_final": {"scale": need("norm.weight")},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": need("lm_head.weight").T}
+    elif "lm_head.weight" in sd and not np.allclose(
+            _np(sd["lm_head.weight"]), emb):
+        raise ValueError(
+            "config says tie_embeddings but the checkpoint's lm_head "
+            "differs from embed_tokens — convert with "
+            "tie_embeddings=False")
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}."
+        attn = {
+            "query": {"kernel":
+                      need(p + "self_attn.q_proj.weight").T.reshape(
+                          h, heads, hd)},
+            "key": {"kernel":
+                    need(p + "self_attn.k_proj.weight").T.reshape(
+                        h, kvh, hd)},
+            "value": {"kernel":
+                      need(p + "self_attn.v_proj.weight").T.reshape(
+                          h, kvh, hd)},
+            "attn_out": {"kernel":
+                         need(p + "self_attn.o_proj.weight").T.reshape(
+                             heads, hd, h)},
+        }
+        layer = {
+            "ln_attn": {"scale": need(p + "input_layernorm.weight")},
+            "ln_mlp": {"scale":
+                       need(p + "post_attention_layernorm.weight")},
+            "attention": attn,
+            "mlp_gate": {"kernel": need(p + "mlp.gate_proj.weight").T},
+            "mlp_up": {"kernel": need(p + "mlp.up_proj.weight").T},
+            "mlp_down": {"kernel": need(p + "mlp.down_proj.weight").T},
+        }
+        if cfg.use_bias:
+            attn["query"]["bias"] = need(
+                p + "self_attn.q_proj.bias").reshape(heads, hd)
+            attn["key"]["bias"] = need(
+                p + "self_attn.k_proj.bias").reshape(kvh, hd)
+            attn["value"]["bias"] = need(
+                p + "self_attn.v_proj.bias").reshape(kvh, hd)
+            attn["attn_out"]["bias"] = need(p + "self_attn.o_proj.bias")
+            layer["mlp_gate"]["bias"] = need(p + "mlp.gate_proj.bias")
+            layer["mlp_up"]["bias"] = need(p + "mlp.up_proj.bias")
+            layer["mlp_down"]["bias"] = need(p + "mlp.down_proj.bias")
+        params[f"layer_{i}"] = layer
+    return {"params": params}
+
+
 def torch_bert_to_variables(state_dict: dict, cfg, num_classes: int) -> dict:
     """HF BertForSequenceClassification (or BertModel + a classifier head)
     state dict -> BertForSequenceClassification variables. torch Linear
@@ -315,6 +397,48 @@ def config_from_hf(hf_config, max_len: int | None = None,
     )
 
 
+def llama_config_from_hf(hf_config, max_len: int | None = None,
+                         dtype=None) -> GPTConfig:
+    """GPTConfig.llama mirroring a transformers LlamaConfig /
+    MistralConfig (accepts the config object or a plain field dict).
+    Fails fast on variants the in-tree decoder does not implement."""
+    import jax.numpy as jnp
+
+    get = (hf_config.get if isinstance(hf_config, dict)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    act = get("hidden_act", "silu")
+    if act != "silu":
+        raise ValueError(
+            f"unsupported hidden_act {act!r}: llama-family conversion "
+            "targets swiglu (silu) MLPs")
+    attn_bias = bool(get("attention_bias", False))
+    mlp_bias = bool(get("mlp_bias", False))
+    if attn_bias != mlp_bias:
+        raise ValueError(
+            "attention_bias != mlp_bias is not representable: the "
+            "in-tree use_bias knob covers every projection")
+    heads = get("num_attention_heads")
+    hf_max = get("max_position_embeddings", 2048)
+    final_max = min(max_len or hf_max, hf_max)
+    window = get("sliding_window", None) or 0
+    return GPTConfig.llama(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=heads,
+        num_kv_heads=get("num_key_value_heads", heads) or heads,
+        mlp_dim=get("intermediate_size"),
+        max_len=final_max,
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-6)),
+        use_bias=attn_bias,
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+        # a window >= the served context is pure masking overhead
+        attention_window=(window if window and window < final_max else 0),
+        dtype=dtype or jnp.float32,
+    )
+
+
 def _load_torch_blob(checkpoint_path: str) -> tuple[dict, dict]:
     """(state_dict, config_dict) from a torch checkpoint, loaded with
     weights_only (checkpoint pickles are never executed) — the one
@@ -344,6 +468,104 @@ def _load_torch_blob(checkpoint_path: str) -> tuple[dict, dict]:
                 f"fields, got {type(cfg_d).__name__}")
         return state_dict, cfg_d
     return blob, {}
+
+
+def import_llama(checkpoint_path: str, out_dir: str,
+                 num_heads: int | None = None,
+                 max_new_tokens: int = 32, max_len: int | None = None,
+                 prompt_len: int = 16,
+                 continuous_rows: int = 0) -> str:
+    """torch .pt/.bin Llama/Mistral checkpoint -> serving-ready gpt-lm
+    predictor dir (GPTConfig.llama family: rope + GQA + RMSNorm + SwiGLU,
+    untied or tied head, optional sliding window from the HF config).
+
+    Every dimension except the head count is read off the tensors —
+    including num_kv_heads (k_proj rows / head_dim). ``num_heads`` must
+    come from the caller or a 'config' entry in the blob
+    ({'state_dict': ..., 'config': {'num_attention_heads': N, ...}})."""
+    from kubeflow_tpu.serving.model import save_predictor
+
+    state_dict, cfg_d = _load_torch_blob(checkpoint_path)
+    sd = _strip(state_dict, prefixes=("module.", "model."))
+    if "embed_tokens.weight" not in sd:
+        raise ValueError(
+            "checkpoint has no 'embed_tokens.weight' — not a "
+            "Llama/Mistral state dict? (GPT-2 checkpoints go through "
+            "import-gpt2)")
+    emb = _np(sd["embed_tokens.weight"])
+    layer_ids = [int(k.split(".")[1]) for k in sd
+                 if k.startswith("layers.")]
+    if not layer_ids:
+        raise ValueError(
+            "checkpoint has no 'layers.N.*' keys — not a Llama/Mistral "
+            "state dict?")
+    n_layer = 1 + max(layer_ids)
+    hidden = emb.shape[1]
+    n_head = num_heads or int(cfg_d.get("num_attention_heads", 0))
+    if not n_head:
+        raise ValueError(
+            "num_heads is required: a bare state dict does not determine "
+            "the head count (pass --num-heads, or save the checkpoint as "
+            "{'state_dict': ..., 'config': {'num_attention_heads': N}})")
+    if hidden % n_head:
+        raise ValueError(
+            f"hidden {hidden} not divisible by num_heads {n_head}")
+    hd = hidden // n_head
+    cfg_hd = cfg_d.get("head_dim")
+    if cfg_hd and int(cfg_hd) != hd:
+        raise ValueError(
+            f"explicit head_dim {cfg_hd} != hidden/num_heads {hd}: "
+            "decoupled-head-dim variants (Mistral-Nemo-style) are not "
+            "representable by the in-tree family")
+    kv_rows = _np(sd["layers.0.self_attn.k_proj.weight"]).shape[0]
+    if kv_rows % hd:
+        raise ValueError(
+            f"k_proj rows {kv_rows} not divisible by head_dim {hd} — "
+            "wrong num_heads for this checkpoint?")
+    hf_fields = dict(cfg_d)
+    hf_fields.setdefault("vocab_size", emb.shape[0])
+    hf_fields.setdefault("hidden_size", hidden)
+    hf_fields.setdefault("num_hidden_layers", n_layer)
+    hf_fields.setdefault("num_attention_heads", n_head)
+    hf_fields.setdefault("num_key_value_heads", kv_rows // hd)
+    hf_fields.setdefault(
+        "intermediate_size",
+        _np(sd["layers.0.mlp.gate_proj.weight"]).shape[0])
+    hf_fields.setdefault("attention_bias",
+                         "layers.0.self_attn.q_proj.bias" in sd)
+    hf_fields.setdefault("mlp_bias", "layers.0.mlp.gate_proj.bias" in sd)
+    hf_fields.setdefault("tie_word_embeddings", "lm_head.weight" not in sd)
+    cfg = llama_config_from_hf(hf_fields, max_len=max_len)
+    variables = torch_llama_to_variables(sd, cfg)
+    example = np.zeros((1, prompt_len), np.int32)
+    gen_cfg: dict = {"max_new_tokens": max_new_tokens, "pad_token_id": -1}
+    if continuous_rows:
+        gen_cfg["continuous"] = True
+        gen_cfg["continuous_rows"] = int(continuous_rows)
+    eos = cfg_d.get("eos_token_id")
+    if isinstance(eos, (list, tuple)):
+        # Llama-3-style configs list several stop ids; the served decode
+        # loop clamps on ONE — use the first (the primary <|end_of_text|>)
+        eos = eos[0] if eos else None
+    if eos is not None:
+        gen_cfg["eos_token_id"] = int(eos)
+    return str(save_predictor(
+        out_dir, "gpt-lm", variables, example,
+        generate=gen_cfg,
+        size="small",
+        config={
+            "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers, "num_heads": cfg.num_heads,
+            "num_kv_heads": cfg.num_kv_heads, "mlp_dim": cfg.mlp_dim,
+            "max_len": cfg.max_len, "dropout_rate": 0.0,
+            "position_embedding": "rope", "rope_theta": cfg.rope_theta,
+            "norm": "rmsnorm", "activation": "swiglu",
+            "use_bias": cfg.use_bias,
+            "tie_embeddings": cfg.tie_embeddings,
+            "norm_eps": cfg.norm_eps,
+            "attention_window": cfg.attention_window,
+        },
+    ))
 
 
 def import_gpt2(checkpoint_path: str, out_dir: str,

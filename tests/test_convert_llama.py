@@ -1,0 +1,193 @@
+"""HF/torch Llama/Mistral checkpoint import (train/convert.py):
+logit-for-logit parity with transformers (rope/GQA/RMSNorm/SwiGLU all in
+the comparison path), and the one-command path to a serving dir."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from kubeflow_tpu.models.gpt import GPTLM, generate  # noqa: E402
+from kubeflow_tpu.train.convert import (  # noqa: E402
+    import_llama,
+    llama_config_from_hf,
+    torch_llama_to_variables,
+)
+
+
+def _tiny_hf(seed=0, **kw):
+    d = dict(vocab_size=128, hidden_size=64, intermediate_size=112,
+             num_hidden_layers=2, num_attention_heads=4,
+             num_key_value_heads=2, max_position_embeddings=64,
+             rms_norm_eps=1e-5, rope_theta=10000.0,
+             attention_bias=False, mlp_bias=False,
+             tie_word_embeddings=False)
+    d.update(kw)
+    hf_cfg = transformers.LlamaConfig(**d)
+    torch.manual_seed(seed)
+    m = transformers.LlamaForCausalLM(hf_cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def hf_llama():
+    return _tiny_hf()
+
+
+def _ids(n=12, vocab=128, seed=3):
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, vocab, (1, n)).astype(np.int64)
+
+
+def _hf_logits(m, ids):
+    with torch.no_grad():
+        return m(torch.from_numpy(ids)).logits.numpy()
+
+
+class TestLogitParity:
+    def test_converted_weights_reproduce_hf_logits(self, hf_llama):
+        cfg = llama_config_from_hf(hf_llama.config)
+        variables = torch_llama_to_variables(hf_llama.state_dict(), cfg)
+        ids = _ids()
+        got = np.asarray(GPTLM(cfg, pad_token_id=-1).apply(
+            variables, jnp.asarray(ids, jnp.int32)))
+        want = _hf_logits(hf_llama, ids)
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+    def test_greedy_continuations_match(self, hf_llama):
+        cfg = llama_config_from_hf(hf_llama.config)
+        variables = torch_llama_to_variables(hf_llama.state_dict(), cfg)
+        ids = _ids(6)
+        ours = np.asarray(generate(
+            GPTLM(cfg, pad_token_id=-1), variables,
+            jnp.asarray(ids, jnp.int32), max_new_tokens=8))
+        with torch.no_grad():
+            hf = hf_llama.generate(
+                torch.from_numpy(ids), max_new_tokens=8, do_sample=False,
+                pad_token_id=0)
+        np.testing.assert_array_equal(ours[0], hf.numpy()[0, 6:])
+
+    def test_mha_variant(self):
+        m = _tiny_hf(seed=1, num_key_value_heads=4)  # MHA: kv == heads
+        cfg = llama_config_from_hf(m.config)
+        variables = torch_llama_to_variables(m.state_dict(), cfg)
+        ids = _ids(8, seed=5)
+        got = np.asarray(GPTLM(cfg, pad_token_id=-1).apply(
+            variables, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(got, _hf_logits(m, ids), atol=2e-4)
+
+    def test_tied_embedding_variant(self):
+        m = _tiny_hf(seed=2, tie_word_embeddings=True)
+        cfg = llama_config_from_hf(m.config)
+        assert cfg.tie_embeddings
+        variables = torch_llama_to_variables(m.state_dict(), cfg)
+        ids = _ids(8, seed=6)
+        got = np.asarray(GPTLM(cfg, pad_token_id=-1).apply(
+            variables, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(got, _hf_logits(m, ids), atol=2e-4)
+
+    def test_attention_bias_variant(self):
+        m = _tiny_hf(seed=4, attention_bias=True, mlp_bias=True)
+        cfg = llama_config_from_hf(m.config)
+        assert cfg.use_bias
+        variables = torch_llama_to_variables(m.state_dict(), cfg)
+        ids = _ids(8, seed=7)
+        got = np.asarray(GPTLM(cfg, pad_token_id=-1).apply(
+            variables, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(got, _hf_logits(m, ids), atol=2e-4)
+
+    def test_missing_key_is_a_clear_error(self, hf_llama):
+        cfg = llama_config_from_hf(hf_llama.config)
+        sd = dict(hf_llama.state_dict())
+        sd.pop("model.layers.0.mlp.gate_proj.weight")
+        with pytest.raises(KeyError, match="gate_proj"):
+            torch_llama_to_variables(sd, cfg)
+
+    def test_mixed_bias_rejected(self, hf_llama):
+        with pytest.raises(ValueError, match="attention_bias != mlp_bias"):
+            llama_config_from_hf(dict(
+                vocab_size=128, hidden_size=64, intermediate_size=112,
+                num_hidden_layers=2, num_attention_heads=4,
+                attention_bias=True, mlp_bias=False))
+
+
+class TestImportLlama:
+    def test_checkpoint_to_serving_dir(self, hf_llama, tmp_path):
+        from kubeflow_tpu.serving.model import JaxModel
+
+        ckpt = tmp_path / "llama.pt"
+        torch.save({"state_dict": hf_llama.state_dict(),
+                    "config": hf_llama.config.to_dict()}, ckpt)
+        out = import_llama(str(ckpt), str(tmp_path / "srv"),
+                           max_new_tokens=8)
+        model = JaxModel("llama", out)
+        model.load()
+        ids = _ids(6, seed=9)
+        got = model.predict(ids.astype(np.int32))
+        with torch.no_grad():
+            hf = hf_llama.generate(
+                torch.from_numpy(ids), max_new_tokens=8, do_sample=False,
+                pad_token_id=0)
+        np.testing.assert_array_equal(np.asarray(got)[0], hf.numpy()[0, 6:])
+
+    def test_bare_state_dict_needs_heads(self, hf_llama, tmp_path):
+        ckpt = tmp_path / "bare.pt"
+        torch.save(hf_llama.state_dict(), ckpt)
+        with pytest.raises(ValueError, match="num_heads is required"):
+            import_llama(str(ckpt), str(tmp_path / "srv2"))
+        # with heads passed, kv_heads reads off k_proj and parity holds
+        out = import_llama(str(ckpt), str(tmp_path / "srv3"), num_heads=4,
+                           max_new_tokens=4)
+        assert (tmp_path / "srv3" / "config.json").exists()
+
+    def test_cli(self, hf_llama, tmp_path, capsys):
+        from kubeflow_tpu.cli import main
+
+        ckpt = tmp_path / "llama.pt"
+        torch.save({"state_dict": hf_llama.state_dict(),
+                    "config": hf_llama.config.to_dict()}, ckpt)
+        rc = main(["import-llama", "--checkpoint", str(ckpt),
+                   "-o", str(tmp_path / "cli_out"), "--device", "cpu",
+                   "--max-new-tokens", "4"])
+        assert rc == 0
+        assert "serving-ready predictor dir" in capsys.readouterr().out
+
+
+class TestRobustErrors:
+    def test_gpt2_checkpoint_clear_error(self, tmp_path):
+        torch.save({"wte.weight": torch.zeros(4, 4)}, tmp_path / "g.pt")
+        with pytest.raises(ValueError, match="not a.*Llama"):
+            import_llama(str(tmp_path / "g.pt"), str(tmp_path / "o"))
+
+    def test_no_layer_keys_clear_error(self, tmp_path):
+        torch.save({"model.embed_tokens.weight": torch.zeros(8, 4)},
+                   tmp_path / "e.pt")
+        with pytest.raises(ValueError, match="layers"):
+            import_llama(str(tmp_path / "e.pt"), str(tmp_path / "o"),
+                         num_heads=2)
+
+    def test_decoupled_head_dim_rejected(self, hf_llama, tmp_path):
+        ckpt = tmp_path / "hd.pt"
+        cfg_d = hf_llama.config.to_dict()
+        cfg_d["head_dim"] = 128  # != hidden/num_heads (16)
+        torch.save({"state_dict": hf_llama.state_dict(),
+                    "config": cfg_d}, ckpt)
+        with pytest.raises(ValueError, match="head_dim"):
+            import_llama(str(ckpt), str(tmp_path / "o"))
+
+    def test_list_eos_takes_first(self, hf_llama, tmp_path):
+        import json
+
+        ckpt = tmp_path / "eos.pt"
+        cfg_d = hf_llama.config.to_dict()
+        cfg_d["eos_token_id"] = [7, 9]
+        torch.save({"state_dict": hf_llama.state_dict(),
+                    "config": cfg_d}, ckpt)
+        out = import_llama(str(ckpt), str(tmp_path / "o"),
+                           max_new_tokens=4)
+        served = json.loads((tmp_path / "o" / "config.json").read_text())
+        assert served["generate"]["eos_token_id"] == 7
