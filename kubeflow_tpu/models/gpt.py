@@ -527,6 +527,19 @@ def set_cache_indices(cache: dict, values=None, active=None) -> dict:
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
+def eos_id_array(eos_token_id):
+    """Normalize an eos spec — int, or a sequence of stop ids (Llama-3
+    instruct checkpoints stop on any of several) — to a 1-D int32 array,
+    or None. The FIRST id is the canonical clamp token every decode path
+    emits after a row finishes."""
+    if eos_token_id is None:
+        return None
+    ids = jnp.atleast_1d(jnp.asarray(eos_token_id, jnp.int32))
+    if ids.size == 0:
+        return None
+    return ids
+
+
 def generate(
     model: GPTLM,
     variables: dict,
@@ -535,7 +548,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     rng: jax.Array | None = None,
-    eos_token_id: int | None = None,
+    eos_token_id=None,
 ) -> jax.Array:
     """Autoregressive generation with the KV cache — fully jittable.
 
@@ -547,12 +560,13 @@ def generate(
     + ONE decode-step executable inside a lax.scan, the TPU decode shape.
     The LM's max_len bounds prompt_len + max_new_tokens.
 
-    eos_token_id: per-row early stop under static shapes — once a row
-    emits EOS, every later position in that row is EOS (callers trim at
-    the first occurrence). The decode loop still runs max_new_tokens
-    steps (TPU-idiomatic: no data-dependent trip count), but finished
-    rows feed EOS forward so their cache stays consistent with the
-    clamped output.
+    eos_token_id: per-row early stop under static shapes — an int or a
+    sequence of stop ids (any of which finishes the row; Llama-3-style).
+    Once a row emits a stop id, every later position in that row is the
+    FIRST stop id (callers trim at the first occurrence). The decode
+    loop still runs max_new_tokens steps (TPU-idiomatic: no
+    data-dependent trip count), but finished rows feed the clamp token
+    forward so their cache stays consistent with the clamped output.
     """
     b, prompt_len = prompt_ids.shape
     if max_new_tokens < 1:
@@ -582,8 +596,9 @@ def generate(
     )
     rng, key = jax.random.split(rng)
     tok = sample(logits[:, -1], key)
-    done0 = (jnp.full((b,), False) if eos_token_id is None
-             else tok == eos_token_id)
+    stops = eos_id_array(eos_token_id)
+    done0 = (jnp.full((b,), False) if stops is None
+             else jnp.isin(tok, stops))
 
     def step(carry, _):
         cache, tok, rng, done = carry
@@ -593,9 +608,9 @@ def generate(
         )
         rng, key = jax.random.split(rng)
         nxt = sample(logits[:, 0], key)
-        if eos_token_id is not None:
-            nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
-            done = done | (nxt == eos_token_id)
+        if stops is not None:
+            nxt = jnp.where(done, stops[0], nxt)
+            done = done | jnp.isin(nxt, stops)
         return (cache, nxt, rng, done), tok
 
     (_, last, _, _), toks = jax.lax.scan(

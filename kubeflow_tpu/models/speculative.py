@@ -142,12 +142,16 @@ def speculative_generate(
         d_cache = {"cache": _set_cache_index(d_cache["cache"], base)}
         return (buf, n, t_cache, d_cache, rounds + 1, accepted_total + a)
 
+    from kubeflow_tpu.models.gpt import eos_id_array
+
+    stops = eos_id_array(eos_token_id)
+
     def cond(state):
         buf, n, *_rest = state
         more = n < max_new_tokens
-        if eos_token_id is not None:
+        if stops is not None:
             emitted = jnp.arange(buf.shape[0]) < n
-            more = more & ~jnp.any(emitted & (buf == eos_token_id))
+            more = more & ~jnp.any(emitted & jnp.isin(buf, stops))
         return more
 
     state0 = (buf0, jnp.asarray(1, jnp.int32),
@@ -158,13 +162,12 @@ def speculative_generate(
     buf, n, _, _, rounds, accepted = jax.lax.while_loop(
         cond, round_body, state0)
     out = buf[:max_new_tokens]
-    if eos_token_id is not None:
-        # clamp past the first EOS (rounds overshoot by up to gamma tokens)
+    if stops is not None:
+        # clamp past the first stop id (rounds overshoot by up to gamma)
         pos = jnp.arange(max_new_tokens)
-        hit = out == eos_token_id
+        hit = jnp.isin(out, stops)
         first = jnp.argmax(hit)  # 0 when no hit; guarded by jnp.any below
-        out = jnp.where(jnp.any(hit) & (pos > first),
-                        jnp.int32(eos_token_id), out)
+        out = jnp.where(jnp.any(hit) & (pos > first), stops[0], out)
     return out[None, :], {
         "rounds": rounds, "drafted_accepted": accepted,
         "tokens": jnp.minimum(n, max_new_tokens),

@@ -51,11 +51,22 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _eos_tuple(eos) -> tuple[int, ...] | None:
+    """Normalize an eos spec (int | sequence | None) to a tuple of stop
+    ids for host-side retire checks — mirrors models.gpt.eos_id_array."""
+    if eos is None:
+        return None
+    if isinstance(eos, (list, tuple, np.ndarray)):
+        ids = tuple(int(x) for x in np.asarray(eos).reshape(-1))
+        return ids or None
+    return (int(eos),)
+
+
 @dataclass
 class _InFlight:
     slot: int
     max_new_tokens: int
-    eos_token_id: int | None
+    eos_token_id: tuple[int, ...] | None
     temperature: float = 0.0
     key: object = None  # jax PRNG key for sampling rows
     tokens: list = field(default_factory=list)
@@ -82,7 +93,7 @@ class ContinuousBatcher:
 
     def __init__(self, module, variables, max_rows: int = 8,
                  default_max_new_tokens: int = 32,
-                 eos_token_id: int | None = None, top_k: int = 0,
+                 eos_token_id=None, top_k: int = 0,
                  seed: int = 0, steps_per_tick: int = 1,
                  prefill_buckets: tuple[int, ...] | None = None,
                  draft_module=None, draft_variables=None, gamma: int = 4):
@@ -150,7 +161,7 @@ class ContinuousBatcher:
         else:
             self.prefill_buckets = None
         self.default_max_new_tokens = int(default_max_new_tokens)
-        self.eos_token_id = eos_token_id
+        self.eos_token_id = _eos_tuple(eos_token_id)
         self.top_k = int(top_k)  # static: one decode executable
         # decode steps per dispatch: scheduling stays iteration-level at
         # granularity T, but T tokens amortize one host round-trip — the
@@ -303,7 +314,7 @@ class ContinuousBatcher:
     # ---------------------------------------------------------------- API
 
     def submit(self, prompt_ids, max_new_tokens: int | None = None,
-               eos_token_id: int | None = None, temperature: float = 0.0,
+               eos_token_id=None, temperature: float = 0.0,
                key=None) -> _InFlight:
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         budget = int(max_new_tokens or self.default_max_new_tokens)
@@ -343,8 +354,9 @@ class ContinuousBatcher:
                 key = jax.random.fold_in(
                     jax.random.PRNGKey(self._seed), self._submitted)
             req = _InFlight(slot=-1, max_new_tokens=budget,
-                            eos_token_id=(self.eos_token_id if eos_token_id
-                                          is None else eos_token_id),
+                            eos_token_id=(self.eos_token_id
+                                          if eos_token_id is None
+                                          else _eos_tuple(eos_token_id)),
                             temperature=float(temperature), key=key)
             self._queue.append((ids, req))
         return req
@@ -505,7 +517,7 @@ class ContinuousBatcher:
         if len(req.tokens) >= req.max_new_tokens:
             return True
         return (req.eos_token_id is not None
-                and req.tokens[-1] == req.eos_token_id)
+                and req.tokens[-1] in req.eos_token_id)
 
     def run_until_idle(self) -> None:
         while self.tick():

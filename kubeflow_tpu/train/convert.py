@@ -411,6 +411,15 @@ def llama_config_from_hf(hf_config, max_len: int | None = None,
         raise ValueError(
             f"unsupported hidden_act {act!r}: llama-family conversion "
             "targets swiglu (silu) MLPs")
+    scaling = get("rope_scaling", None)
+    if scaling:
+        # Llama-3.1+ long-context checkpoints rescale rope frequencies;
+        # converting with plain rope would serve silently-wrong logits at
+        # every position — fail fast instead (same contract as hidden_act)
+        raise ValueError(
+            f"rope_scaling {scaling!r} is not implemented by the in-tree "
+            "rope (parallel/rope.py applies plain theta frequencies); "
+            "converting would produce numerically wrong attention")
     attn_bias = bool(get("attention_bias", False))
     mlp_bias = bool(get("mlp_bias", False))
     if attn_bias != mlp_bias:
@@ -544,11 +553,14 @@ def import_llama(checkpoint_path: str, out_dir: str,
         gen_cfg["continuous_rows"] = int(continuous_rows)
     eos = cfg_d.get("eos_token_id")
     if isinstance(eos, (list, tuple)):
-        # Llama-3-style configs list several stop ids; the served decode
-        # loop clamps on ONE — use the first (the primary <|end_of_text|>)
-        eos = eos[0] if eos else None
+        # Llama-3-style configs list several stop ids — the decode paths
+        # stop on ANY of them (generate/speculative/continuous all take
+        # the full set; the first id is the post-stop clamp token)
+        eos = [int(x) for x in eos] or None
+    elif eos is not None:
+        eos = int(eos)
     if eos is not None:
-        gen_cfg["eos_token_id"] = int(eos)
+        gen_cfg["eos_token_id"] = eos
     return str(save_predictor(
         out_dir, "gpt-lm", variables, example,
         generate=gen_cfg,

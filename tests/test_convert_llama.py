@@ -179,7 +179,10 @@ class TestRobustErrors:
         with pytest.raises(ValueError, match="head_dim"):
             import_llama(str(ckpt), str(tmp_path / "o"))
 
-    def test_list_eos_takes_first(self, hf_llama, tmp_path):
+    def test_list_eos_served_in_full(self, hf_llama, tmp_path):
+        """Llama-3-style stop-id LISTS reach the served gen config whole:
+        the decode paths stop on ANY of them (a first-id-only import
+        would never stop instruct turns, which end on the second id)."""
         import json
 
         ckpt = tmp_path / "eos.pt"
@@ -190,4 +193,13 @@ class TestRobustErrors:
         out = import_llama(str(ckpt), str(tmp_path / "o"),
                            max_new_tokens=4)
         served = json.loads((tmp_path / "o" / "config.json").read_text())
-        assert served["generate"]["eos_token_id"] == 7
+        assert served["generate"]["eos_token_id"] == [7, 9]
+
+    def test_rope_scaling_rejected(self, hf_llama, tmp_path):
+        ckpt = tmp_path / "rs.pt"
+        cfg_d = hf_llama.config.to_dict()
+        cfg_d["rope_scaling"] = {"rope_type": "llama3", "factor": 8.0}
+        torch.save({"state_dict": hf_llama.state_dict(),
+                    "config": cfg_d}, ckpt)
+        with pytest.raises(ValueError, match="rope_scaling"):
+            import_llama(str(ckpt), str(tmp_path / "o"))

@@ -760,3 +760,38 @@ class TestEosEarlyStop:
         jm = JaxModel("be", out_dir)
         with pytest.raises(ValueError, match="eos_token_id"):
             jm.load()
+
+
+class TestMultiStopIds:
+    """eos_token_id as a SEQUENCE (Llama-3 instruct: several stop ids):
+    rows stop on ANY listed id and clamp with the first."""
+
+    def test_generate_list_eos_matches_firing_single_id(self, lm):
+        model, variables, prompt = lm
+        base = generate(model, variables, prompt, max_new_tokens=8)
+        # pick the id the greedy rollout actually emits at step 3: listing
+        # it (with a never-emitted id) must stop there, exactly like the
+        # single-id contract for that id
+        firing = int(np.asarray(base)[0, 3])
+        single = generate(model, variables, prompt, max_new_tokens=8,
+                          eos_token_id=firing)
+        multi = generate(model, variables, prompt, max_new_tokens=8,
+                         eos_token_id=[firing, 10**6 % model.cfg.vocab_size])
+        # clamp token differs (first listed id) only if firing != first —
+        # firing IS first here, so the outputs are identical
+        np.testing.assert_array_equal(np.asarray(single), np.asarray(multi))
+
+    def test_engine_list_eos_retires_row(self, lm):
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+        model, variables, prompt = lm
+        base = np.asarray(generate(model, variables, prompt,
+                                   max_new_tokens=8))[0]
+        firing = int(base[3])
+        first = int(np.argmax(base == firing))  # first occurrence wins
+        eng = ContinuousBatcher(model, variables, max_rows=2,
+                                eos_token_id=[firing])
+        req = eng.submit(np.asarray(prompt)[0], max_new_tokens=8)
+        eng.run_until_idle()
+        got = req.result(timeout=1)
+        assert got[-1] == firing and len(got) == first + 1
