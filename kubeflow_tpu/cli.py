@@ -471,15 +471,27 @@ def cmd_generate(args) -> int:
               "generative predictor)", file=sys.stderr)
         return 2
 
+    def trim_at_stop(out, eos):
+        """Trim at the FIRST occurrence of ANY stop id (int or list)."""
+        if eos is None:
+            return out
+        stops = [int(x) for x in eos] if isinstance(eos, list) else [int(eos)]
+        toks = out.tolist()
+        hits = [toks.index(s) for s in stops if s in toks]
+        return out[: min(hits)] if hits else out
+
     if args.draft_model_dir:
+        import jax
+
         from kubeflow_tpu.models.speculative import speculative_generate
         from kubeflow_tpu.serving.model import load_generative_model
 
-        if float(gen.get("temperature", 0.0)) > 0 or \
-                int(gen.get("num_beams", 1)) > 1:
-            print("error: speculative decoding is greedy-only; the target "
-                  "config sets temperature/num_beams", file=sys.stderr)
+        if int(gen.get("num_beams", 1)) > 1:
+            print("error: speculative decoding is incompatible with beam "
+                  "search (num_beams > 1 in the target config)",
+                  file=sys.stderr)
             return 2
+        temp = float(gen.get("temperature", 0.0))
         tmod, tvars, _ = load_generative_model(Path(args.model_dir))
         dmod, dvars, _ = load_generative_model(Path(args.draft_model_dir))
         if tmod.cfg.vocab_size != dmod.cfg.vocab_size:
@@ -492,14 +504,17 @@ def cmd_generate(args) -> int:
                 tmod, tvars, dmod, dvars, ids,
                 max_new_tokens=int(gen.get("max_new_tokens", 32)),
                 gamma=args.gamma,
-                eos_token_id=None if eos is None else int(eos),
+                eos_token_id=eos,
+                # temperature > 0 runs the rejection-sampling scheme —
+                # target-distribution-exact; per-invocation key from the
+                # CLI seed
+                temperature=temp,
+                rng=(jax.random.PRNGKey(args.seed) if temp > 0 else None),
             )
         except ValueError as exc:
             print(f"error: {exc}", file=sys.stderr)
             return 2
-        out = np.asarray(out_ids)[0]
-        if eos is not None and int(eos) in out.tolist():
-            out = out[: out.tolist().index(int(eos))]  # trim the clamp tail
+        out = trim_at_stop(np.asarray(out_ids)[0], eos)
         rounds = int(stats["rounds"])
         accepted = int(stats["drafted_accepted"])
         print(f"[speculative] rounds={rounds} drafted_accepted={accepted} "
@@ -512,10 +527,8 @@ def cmd_generate(args) -> int:
 
     jm = JaxModel("cli", args.model_dir)
     jm.load()
-    out = np.asarray(jm(ids)["predictions"])[0]
-    eos = gen.get("eos_token_id")
-    if eos is not None and int(eos) in out.tolist():
-        out = out[: out.tolist().index(int(eos))]  # trim the clamp tail
+    out = trim_at_stop(np.asarray(jm(ids)["predictions"])[0],
+                       gen.get("eos_token_id"))
     print(tok.decode(out) if tok is not None else " ".join(map(str, out)))
     return 0
 
@@ -727,10 +740,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--device", default="auto", choices=["tpu", "cpu", "auto"])
     p.add_argument("--draft-model-dir", default="",
                    help="speculative decoding: a small gpt-lm predictor "
-                        "dir proposing tokens the target verifies "
-                        "(greedy-only; output is exactly the target's)")
+                        "dir proposing tokens the target verifies. "
+                        "Greedy configs emit exactly the target's greedy "
+                        "decode; temperature>0 configs run rejection "
+                        "sampling (target-distribution-exact)")
     p.add_argument("--gamma", type=int, default=4,
                    help="speculated tokens per round")
+    p.add_argument("--seed", type=int, default=0,
+                   help="PRNG seed for sampled speculative decoding")
 
     p = add("serve", cmd_serve, help="serve an InferenceService until Ctrl-C")
     p.add_argument("-f", "--filename", required=True)

@@ -7,10 +7,12 @@ proposes `gamma` tokens autoregressively, then the TARGET model scores
 all of them in ONE forward pass (a gamma+1-token prefill over the KV
 cache — MXU-shaped work instead of gamma bandwidth-bound steps) and
 accepts the longest prefix it agrees with, emitting its own correction
-token at the first disagreement. Greedy mode here: acceptance is
-argmax-match, so the output is EXACTLY the target model's greedy decode
-for ANY draft — a random draft only costs speed, never correctness
-(pinned by test).
+token at the first disagreement. Two modes, both target-exact for ANY
+draft (a bad draft only costs speed, never correctness — pinned by
+tests): greedy (temperature 0, acceptance is argmax-match, output IS the
+target's greedy decode) and rejection SAMPLING (temperature > 0,
+acceptance probability min(1, p_t/p_d) with residual resampling — the
+output DISTRIBUTION equals sampling the target directly).
 
 TPU-first shape: `gamma` is static, every round is the same two
 executables (draft scan + target prefill), and the variable accepted
@@ -44,14 +46,30 @@ def speculative_generate(
     max_new_tokens: int,
     gamma: int = 4,
     eos_token_id: int | None = None,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
 ):
-    """Greedy speculative decoding. Returns (tokens (1, max_new_tokens),
-    stats dict with 'rounds' and 'drafted_accepted').
+    """Speculative decoding. Returns (tokens (1, max_new_tokens), stats
+    dict with 'rounds' and 'drafted_accepted').
+
+    temperature == 0 (default): greedy — acceptance is argmax-match, the
+    output is EXACTLY the target model's greedy decode for ANY draft.
+
+    temperature > 0: SPECULATIVE SAMPLING (Leviathan/Chen rejection
+    scheme, needs `rng`) — proposal x_i ~ p_draft is accepted with
+    probability min(1, p_target(x_i)/p_draft(x_i)); the first rejection
+    resamples from the normalized residual max(0, p_target − p_draft),
+    and an all-accepted round samples the bonus token from p_target.
+    The OUTPUT DISTRIBUTION equals sampling the target directly — for
+    any draft — though individual draws differ from generate()'s
+    (different uses of the key). Pinned statistically in tests plus the
+    draft==target invariant (every proposal accepted).
 
     Batch size 1 (rows diverge in accepted length; a batched variant
-    needs per-row cache indices). The draft must share the target's
-    vocabulary; nothing else — architectures, sizes, and even weights may
-    differ arbitrarily.
+    needs per-row cache indices — serving/continuous.py has the rowwise
+    greedy version). The draft must share the target's vocabulary;
+    nothing else — architectures, sizes, and even weights may differ
+    arbitrarily.
 
     eos_token_id mirrors generate()'s contract: once EOS lands in the
     emitted prefix the loop stops (no more speculation rounds for a
@@ -69,6 +87,11 @@ def speculative_generate(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
+    sampling = temperature > 0.0
+    if sampling and rng is None:
+        raise ValueError("speculative sampling (temperature > 0) needs rng")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # carried but unused in greedy mode
     for m, name in ((target, "target"), (draft, "draft")):
         if prompt_len + max_new_tokens + gamma + 1 > m.cfg.max_len:
             raise ValueError(
@@ -82,53 +105,97 @@ def speculative_generate(
                 "valid older ones); serve rolling models without a draft")
 
     # prefill both caches over the prompt; first token comes from the
-    # target alone (same as plain greedy)
+    # target alone (same as plain greedy/sampled decode)
     t_logits, t_cache = target.apply(
         target_variables, prompt_ids, decode=True, mutable=["cache"])
     _, d_cache = draft.apply(
         draft_variables, prompt_ids, decode=True, mutable=["cache"])
-    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (1,)
+    rng, first_key = jax.random.split(rng)
+    if sampling:
+        first = jax.random.categorical(
+            first_key, t_logits[:, -1] / jnp.float32(temperature)
+        ).astype(jnp.int32)                                    # (1,)
+    else:
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
 
     buf0 = jnp.zeros((max_new_tokens + gamma + 1,), jnp.int32)
     buf0 = buf0.at[0].set(first[0])
 
     def draft_step(carry, _):
-        cache, tok = carry
+        cache, tok, key = carry
         logits, cache = draft.apply(
             {**draft_variables, **cache}, tok[:, None], decode=True,
             mutable=["cache"])
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        return (cache, nxt), nxt
+        row = logits[:, -1]                                # (1, V)
+        if sampling:
+            key, k = jax.random.split(key)
+            scaled = row / jnp.float32(temperature)
+            nxt = jax.random.categorical(k, scaled).astype(jnp.int32)
+            probs = jax.nn.softmax(scaled, axis=-1)[0]     # (V,)
+        else:
+            nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            probs = jnp.zeros((row.shape[-1],), jnp.float32)  # unused
+        return (cache, nxt, key), (nxt, probs)
 
     def round_body(state):
-        buf, n, t_cache, d_cache, rounds, accepted_total = state
+        buf, n, t_cache, d_cache, rounds, accepted_total, rng = state
         last = buf[n - 1][None]                                # (1,)
+        rng, d_key, u_key, c_key = jax.random.split(rng, 4)
         # --- draft proposes gamma tokens ------------------------------
-        (d_cache, p_last), proposals = jax.lax.scan(
-            draft_step, (d_cache, last), None, length=gamma)
+        (d_cache, p_last, _), (proposals, d_probs) = jax.lax.scan(
+            draft_step, (d_cache, last, d_key), None, length=gamma)
         proposals = proposals[:, 0]                            # (gamma,)
         # one extra draft step writes p_gamma into the draft cache (its
         # proposal is discarded) so an all-accepted round leaves no
         # unwritten row below the advanced cache index
-        (d_cache, _), _ = draft_step((d_cache, p_last), None)
+        (d_cache, _, _), _ = draft_step((d_cache, p_last, d_key), None)
         # --- target scores last + ALL proposals in ONE pass -----------
         inp = jnp.concatenate([last, proposals])[None, :]   # (1, gamma+1)
         logits, t_cache_adv = target.apply(
             {**target_variables, **t_cache}, inp, decode=True,
             mutable=["cache"])
-        # t_tokens[i] = target's own choice after accepting i proposals
-        t_tokens = jnp.argmax(logits[0], axis=-1).astype(
-            jnp.int32)                                      # (gamma+1,)
-        # accept while the draft matches the target's own choice
-        agree = jnp.cumprod(
-            (proposals == t_tokens[:gamma]).astype(jnp.int32))
-        a = agree.sum()                     # accepted draft tokens, 0..gamma
-        # emit proposals[:a], then the target's correction t_tokens[a]
-        # (when a == gamma that's the target's continuation past the whole
-        # accepted block); slots past a+1 hold the correction too — they
-        # are overwritten by the next round or trimmed at max_new_tokens
+        if sampling:
+            # Leviathan/Chen rejection: accept x_i with prob
+            # min(1, p_t(x_i)/p_d(x_i)); first rejection resamples from
+            # the normalized residual max(0, p_t − p_d); an all-accepted
+            # round samples the bonus token from p_t — output
+            # distribution == sampling the target directly.
+            p_t = jax.nn.softmax(
+                logits[0] / jnp.float32(temperature), axis=-1
+            )                                               # (gamma+1, V)
+            pt_x = jnp.take_along_axis(
+                p_t[:gamma], proposals[:, None], axis=-1)[:, 0]
+            pd_x = jnp.take_along_axis(
+                d_probs, proposals[:, None], axis=-1)[:, 0]
+            u = jax.random.uniform(u_key, (gamma,))
+            ok = u < jnp.minimum(1.0, pt_x / jnp.maximum(pd_x, 1e-30))
+            agree = jnp.cumprod(ok.astype(jnp.int32))
+            a = agree.sum()                 # accepted draft tokens
+            residual = jnp.clip(p_t[:gamma] - d_probs, 0.0)
+            rs = residual.sum(-1, keepdims=True)
+            # rejection at i implies p_t[i] != p_d[i] somewhere, so
+            # rs > 0 there; the where guards fp underflow only
+            res_norm = jnp.where(rs > 0, residual / jnp.maximum(rs, 1e-30),
+                                 p_t[:gamma])
+            corr_rows = jnp.concatenate([res_norm, p_t[gamma:]], axis=0)
+            corr = jax.random.categorical(
+                c_key, jnp.log(jnp.maximum(corr_rows[a], 1e-30))
+            ).astype(jnp.int32)
+        else:
+            # t_tokens[i] = target's own choice after accepting i
+            # proposals; accept while the draft matches it
+            t_tokens = jnp.argmax(logits[0], axis=-1).astype(
+                jnp.int32)                                  # (gamma+1,)
+            agree = jnp.cumprod(
+                (proposals == t_tokens[:gamma]).astype(jnp.int32))
+            a = agree.sum()                 # accepted draft tokens
+            corr = t_tokens[a]
+        # emit proposals[:a], then the correction token (when a == gamma
+        # that's the target's continuation past the whole accepted
+        # block); slots past a+1 hold the correction too — they are
+        # overwritten by the next round or trimmed at max_new_tokens
         padded = jnp.concatenate([proposals, jnp.zeros((1,), jnp.int32)])
-        upd = jnp.where(jnp.arange(gamma + 1) < a, padded, t_tokens[a])
+        upd = jnp.where(jnp.arange(gamma + 1) < a, padded, corr)
         buf = jax.lax.dynamic_update_slice(buf, upd, (n,))
         n = n + a + 1
         # --- cache bookkeeping ----------------------------------------
@@ -140,7 +207,8 @@ def speculative_generate(
         t_cache = {"cache": _set_cache_index(
             t_cache_adv["cache"], base)}
         d_cache = {"cache": _set_cache_index(d_cache["cache"], base)}
-        return (buf, n, t_cache, d_cache, rounds + 1, accepted_total + a)
+        return (buf, n, t_cache, d_cache, rounds + 1, accepted_total + a,
+                rng)
 
     from kubeflow_tpu.models.gpt import eos_id_array
 
@@ -158,8 +226,8 @@ def speculative_generate(
               {"cache": _set_cache_index(t_cache["cache"],
                                          prompt_len)},
               {"cache": _set_cache_index(d_cache["cache"], prompt_len)},
-              jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
-    buf, n, _, _, rounds, accepted = jax.lax.while_loop(
+              jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32), rng)
+    buf, n, _, _, rounds, accepted, _ = jax.lax.while_loop(
         cond, round_body, state0)
     out = buf[:max_new_tokens]
     if stops is not None:

@@ -167,7 +167,11 @@ class TestCliSpeculative:
         assert "[speculative] rounds=" in captured.err
         assert captured.out.strip() == plain  # target-exact through the CLI
 
-    def test_cli_rejects_sampling_target(self, tmp_path, target_lm, capsys):
+    def test_cli_sampled_target_runs_rejection_scheme(self, tmp_path,
+                                                      target_lm, capsys):
+        """A temperature>0 target config runs SPECULATIVE SAMPLING through
+        the CLI (r5: the greedy-only gate is gone) — deterministic per
+        --seed, different across seeds; beam search still rejected."""
         from kubeflow_tpu.cli import main
         from kubeflow_tpu.serving.model import save_predictor
 
@@ -187,11 +191,31 @@ class TestCliSpeculative:
             config={"dropout_rate": 0.0, "max_len": 96, "hidden_size": 32,
                     "num_heads": 2, "mlp_dim": 64, "num_layers": 1},
         )
-        rc = main(["generate", "--model-dir", str(tdir),
+        def run(seed):
+            rc = main(["generate", "--model-dir", str(tdir),
+                       "--draft-model-dir", str(ddir),
+                       "--prompt", "1 2 3", "--device", "cpu",
+                       "--seed", str(seed)])
+            cap = capsys.readouterr()
+            assert rc == 0, cap.err
+            assert "[speculative] rounds=" in cap.err
+            return cap.out.strip()
+
+        a, b, c = run(1), run(1), run(2)
+        assert a == b                       # deterministic per seed
+        assert len(a.split()) == 8
+        # beam search remains incompatible
+        bdir = save_predictor(
+            tmp_path / "target-b", "gpt-lm", dict(variables),
+            np.asarray(prompt, np.int32),
+            generate={"max_new_tokens": 8, "num_beams": 2},
+            size="tiny", config={"dropout_rate": 0.0, "max_len": 96},
+        )
+        rc = main(["generate", "--model-dir", str(bdir),
                    "--draft-model-dir", str(ddir),
                    "--prompt", "1 2 3", "--device", "cpu"])
         assert rc == 2
-        assert "greedy-only" in capsys.readouterr().err
+        assert "beam" in capsys.readouterr().err
 
     def test_cli_gamma_zero_is_clean_error(self, tmp_path, target_lm,
                                            capsys):
@@ -219,3 +243,74 @@ class TestCliSpeculative:
                    "--prompt", "1 2 3", "--device", "cpu"])
         assert rc == 2
         assert "error: gamma" in capsys.readouterr().err
+
+
+class TestSpeculativeSampling:
+    """temperature > 0: Leviathan/Chen rejection sampling — output
+    distribution equals sampling the target directly, for any draft."""
+
+    def test_needs_rng(self, target_lm):
+        model, variables, prompt = target_lm
+        d_model, d_vars = _draft(7)
+        with pytest.raises(ValueError, match="needs rng"):
+            speculative_generate(model, variables, d_model, d_vars,
+                                 prompt, 8, temperature=1.0)
+
+    def test_draft_equals_target_accepts_every_proposal(self, target_lm):
+        """p_t == p_d makes the acceptance ratio exactly 1: every
+        proposal accepted regardless of the uniform draws."""
+        model, variables, prompt = target_lm
+        out, stats = jax.jit(lambda key: speculative_generate(
+            model, variables, model, variables, prompt, 12, gamma=3,
+            temperature=1.0, rng=key))(jax.random.PRNGKey(4))
+        assert int(stats["drafted_accepted"]) == 3 * int(stats["rounds"])
+        assert np.asarray(out).shape == (1, 12)
+
+    def test_deterministic_per_key(self, target_lm):
+        model, variables, prompt = target_lm
+        d_model, d_vars = _draft(8)
+        f = jax.jit(lambda key: speculative_generate(
+            model, variables, d_model, d_vars, prompt, 10, gamma=2,
+            temperature=0.8, rng=key)[0])
+        a = np.asarray(f(jax.random.PRNGKey(5)))
+        b = np.asarray(f(jax.random.PRNGKey(5)))
+        c = np.asarray(f(jax.random.PRNGKey(6)))
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_output_distribution_matches_direct_target_sampling(self):
+        """Two-sample check on the second emitted token's marginal: the
+        rejection pipeline (through a DIFFERENT, untrained draft) vs
+        generate()'s direct target sampling, N=1500 draws each on an
+        8-token vocab. A wrong acceptance ratio or residual would shift
+        total variation far beyond the ~0.02 sampling noise."""
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=32, vocab_size=8,
+                             hidden_size=16, num_heads=2, mlp_dim=32,
+                             num_layers=1)
+        model = GPTLM(cfg, pad_token_id=-1)
+        prompt = jnp.array([[3, 5, 1]], jnp.int32)
+        variables = model.init(jax.random.PRNGKey(10), prompt)
+        d_model, d_vars = _draft(11, vocab_size=8)
+        n = 1500
+        keys = jax.random.split(jax.random.PRNGKey(12), n)
+        spec = jax.jit(jax.vmap(lambda key: speculative_generate(
+            model, variables, d_model, d_vars, prompt, 2, gamma=2,
+            temperature=1.0, rng=key)[0][0]))(keys)
+        ref = jax.jit(jax.vmap(lambda key: generate(
+            model, variables, prompt, 2, temperature=1.0,
+            rng=key)[0]))(jax.random.split(jax.random.PRNGKey(13), n))
+        for pos in (0, 1):
+            hs = np.bincount(np.asarray(spec)[:, pos], minlength=8) / n
+            hr = np.bincount(np.asarray(ref)[:, pos], minlength=8) / n
+            tv = 0.5 * np.abs(hs - hr).sum()
+            assert tv < 0.08, (pos, tv, hs, hr)
+
+    def test_greedy_mode_unchanged_by_rng_arg(self, target_lm):
+        model, variables, prompt = target_lm
+        d_model, d_vars = _draft(9)
+        base, _ = speculative_generate(model, variables, d_model, d_vars,
+                                       prompt, 10, gamma=2)
+        withk, _ = speculative_generate(model, variables, d_model, d_vars,
+                                        prompt, 10, gamma=2,
+                                        rng=jax.random.PRNGKey(99))
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(withk))
