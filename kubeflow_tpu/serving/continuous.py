@@ -35,10 +35,13 @@ verifies every row's (last + proposals) block in one (R, gamma+1) pass,
 and each row rewinds to ITS accepted length through the per-row
 cache_index/pos_index vectors (the solo speculative rewind applied
 rowwise; models/gpt.py's block write lands each row's verify block at its
-own depth). Outputs stay target-greedy-exact per row; rows emit 1..gamma+1
-tokens per dispatch, the decode-throughput lever on dispatch-floored
-links. Temperature-0 rows only; rolling caches and prefill buckets are
-refused (rewind/pad hazards documented at the guards).
+own depth). Greedy rows stay target-greedy-exact; temperature>0 rows run
+the rowwise Leviathan/Chen rejection scheme (accept with min(1, p_t/p_d),
+residual resample, bonus token from p_t — target-distribution-exact),
+and both kinds mix in the one executable. Rows emit 1..gamma+1 tokens
+per dispatch, the decode-throughput lever on dispatch-floored links.
+Rolling caches, prefill buckets, and engine-level top_k on sampled rows
+are refused (hazards documented at the guards).
 """
 
 from __future__ import annotations
@@ -259,42 +262,95 @@ class ContinuousBatcher:
             def _set_row_indices(cache, values, active):
                 return set_cache_indices(cache, values, active)
 
-            def _spec_step(t_cache, d_cache, toks, active, depths):
+            def _spec_step(t_cache, d_cache, toks, active, depths, temps,
+                           base_keys):
                 """One speculative round for ALL rows in one dispatch:
                 draft proposes G tokens/row (G chained batch-R steps),
                 target verifies (R, G+1) in one pass, each row accepts
-                its own prefix and rewinds to its own depth. Returns the
-                (R, G+1) emission buffer and per-row accept counts."""
+                its own prefix and rewinds to its own depth. Greedy rows
+                (temp == 0) accept on argmax-match; sampled rows run the
+                Leviathan/Chen rejection per row — accept with
+                min(1, p_t/p_d), residual resample at the first
+                rejection, bonus token from p_t (the solo
+                models/speculative.py scheme applied rowwise; greedy and
+                sampled rows mix in ONE executable via where(temps>0)).
+                Per-(row, round, step) keys fold the request key with
+                depth*(G+3)+j — depth strictly increases per round, so
+                keys never repeat. Returns the (R, G+1) emission buffer
+                and per-row accept counts."""
                 t_cache = _set_row_indices(t_cache, depths, active)
                 d_cache = _set_row_indices(d_cache, depths, active)
+                tp = jnp.maximum(temps, 1e-6)[:, None]       # (R, 1)
+                key_base = depths * (G + 3)
 
-                def draft_step(carry, _):
+                def draft_step(carry, j):
                     cache, tok = carry
                     logits, new = draft_module.apply(
                         {**draft_variables, "cache": cache}, tok[:, None],
                         decode=True, mutable=["cache"])
-                    nxt = jnp.argmax(
-                        logits[:, -1], axis=-1).astype(jnp.int32)
-                    return (new["cache"], nxt), nxt
+                    row = logits[:, -1].astype(jnp.float32)  # (R, V)
+                    greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                    keys = jax.vmap(jax.random.fold_in)(
+                        base_keys, key_base + j)
+                    sampled = jax.vmap(jax.random.categorical)(
+                        keys, row / tp).astype(jnp.int32)
+                    nxt = jnp.where(temps > 0, sampled, greedy)
+                    probs = jax.nn.softmax(row / tp, axis=-1)
+                    return (new["cache"], nxt), (nxt, probs)
 
-                (d_cache, p_last), props = jax.lax.scan(
-                    draft_step, (d_cache, toks), None, length=G)
+                (d_cache, p_last), (props, d_probs) = jax.lax.scan(
+                    draft_step, (d_cache, toks), jnp.arange(G))
                 props = props.T                              # (R, G)
+                d_probs = d_probs.transpose(1, 0, 2)         # (R, G, V)
                 # extra draft write (solo speculative does the same) so an
                 # all-accepted round leaves no unwritten draft row
-                (d_cache, _), _ = draft_step((d_cache, p_last), None)
+                (d_cache, _), _ = draft_step((d_cache, p_last),
+                                             jnp.int32(G + 2))
                 inp = jnp.concatenate([toks[:, None], props], axis=1)
                 logits, t_adv = module.apply(
                     {**variables, "cache": t_cache}, inp,
                     decode=True, mutable=["cache"])
                 t_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                agree = jnp.cumprod(
-                    (props == t_tokens[:, :G]).astype(jnp.int32), axis=1)
+                # --- acceptance: argmax-match (greedy) | rejection ----
+                p_t = jax.nn.softmax(
+                    logits.astype(jnp.float32) / tp[..., None], axis=-1
+                )                                            # (R, G+1, V)
+                pt_x = jnp.take_along_axis(
+                    p_t[:, :G], props[..., None], axis=-1)[..., 0]
+                pd_x = jnp.take_along_axis(
+                    d_probs, props[..., None], axis=-1)[..., 0]
+                u_keys = jax.vmap(jax.random.fold_in)(
+                    base_keys, key_base + G)
+                u = jax.vmap(
+                    lambda k: jax.random.uniform(k, (G,)))(u_keys)
+                ok_sampled = u < jnp.minimum(
+                    1.0, pt_x / jnp.maximum(pd_x, 1e-30))
+                ok_greedy = props == t_tokens[:, :G]
+                ok = jnp.where(temps[:, None] > 0, ok_sampled, ok_greedy)
+                agree = jnp.cumprod(ok.astype(jnp.int32), axis=1)
                 a = agree.sum(axis=1)                        # (R,)
+                # --- correction token ---------------------------------
+                residual = jnp.clip(p_t[:, :G] - d_probs, 0.0)
+                rs = residual.sum(-1, keepdims=True)
+                res_norm = jnp.where(
+                    rs > 0, residual / jnp.maximum(rs, 1e-30),
+                    p_t[:, :G])
+                corr_rows = jnp.concatenate(
+                    [res_norm, p_t[:, G:]], axis=1)          # (R, G+1, V)
+                picked = jnp.take_along_axis(
+                    corr_rows, a[:, None, None], axis=1)[:, 0]
+                c_keys = jax.vmap(jax.random.fold_in)(
+                    base_keys, key_base + G + 1)
+                corr_sampled = jax.vmap(jax.random.categorical)(
+                    c_keys, jnp.log(jnp.maximum(picked, 1e-30))
+                ).astype(jnp.int32)[:, None]
+                corr_greedy = jnp.take_along_axis(
+                    t_tokens, a[:, None], axis=1)
+                corr = jnp.where(temps[:, None] > 0, corr_sampled,
+                                 corr_greedy)
                 padded = jnp.concatenate(
                     [props, jnp.zeros((props.shape[0], 1), jnp.int32)],
                     axis=1)
-                corr = jnp.take_along_axis(t_tokens, a[:, None], axis=1)
                 upd = jnp.where(
                     jnp.arange(G + 1)[None, :] < a[:, None], padded, corr)
                 new_depths = depths + a + 1
@@ -321,11 +377,17 @@ class ContinuousBatcher:
         if ids.size < 1:
             raise ValueError("empty prompt")
         if self.draft_module is not None:
-            if temperature > 0:
+            if temperature > 0 and self.top_k > 0:
+                # greedy rows ignore top_k, so greedy-only deployments
+                # with a configured top_k keep constructing/serving; the
+                # refusal fires only where it matters — a SAMPLED row,
+                # whose rejection scheme must accept against the draft's
+                # ACTUAL proposal distribution (a top_k-truncated
+                # p_d/p_t pair needs both sides renormalized
+                # consistently; not implemented)
                 raise ValueError(
-                    "speculative engine serves temperature-0 rows only "
-                    "(greedy acceptance is argmax-match); submit sampling "
-                    "requests to a non-speculative engine")
+                    "sampled rows in the speculative engine do not "
+                    "compose with engine-level top_k")
             lim = min(self.max_len, self.draft_module.cfg.max_len)
             if ids.size + budget + self.gamma + 1 > lim:
                 raise ValueError(
@@ -459,13 +521,7 @@ class ContinuousBatcher:
         if self.draft_module is not None:
             return self._spec_tick(active)
         # ---- T decode steps for every in-flight row ----------------------
-        zero = jax.random.PRNGKey(0)
-        temps = np.array(
-            [r.temperature if r is not None else 0.0
-             for r in self._rows], np.float32)
-        base_keys = jnp.stack([
-            r.key if r is not None and r.temperature > 0 else zero
-            for r in self._rows])
+        temps, base_keys = self._row_sampling_state()
         starts = np.array(
             [len(r.tokens) if r is not None else 0
              for r in self._rows], np.int32)
@@ -491,10 +547,13 @@ class ContinuousBatcher:
     def _spec_tick(self, active: np.ndarray) -> bool:
         """One speculative round for every in-flight row (one dispatch):
         each row emits between 1 and gamma+1 tokens — its own accepted
-        prefix plus the target's correction. Greedy-exact per row."""
+        prefix plus the correction. Greedy rows are target-greedy-exact;
+        sampled rows run the rowwise rejection scheme."""
+        temps, base_keys = self._row_sampling_state()
         upd, a, self._cache, self._dcache = self._spec_step(
             self._cache, self._dcache, jnp.asarray(self._toks),
-            jnp.asarray(active), jnp.asarray(self._depths))
+            jnp.asarray(active), jnp.asarray(self._depths),
+            jnp.asarray(temps), base_keys)
         self.step_count += 1  # dispatches (the scheduling metric)
         upd = np.asarray(upd)                               # (R, gamma+1)
         a = np.asarray(a)                                   # (R,)
@@ -511,6 +570,19 @@ class ContinuousBatcher:
         with self._lock:
             pending = bool(self._queue)
         return pending or any(r is not None for r in self._rows)
+
+    def _row_sampling_state(self):
+        """(temps (R,) f32, base_keys (R, 2)) marshalled from the row
+        table — the ONE definition both decode paths (plain tick and
+        _spec_tick) feed their executables."""
+        zero = jax.random.PRNGKey(0)
+        temps = np.array(
+            [r.temperature if r is not None else 0.0
+             for r in self._rows], np.float32)
+        base_keys = jnp.stack([
+            r.key if r is not None and r.temperature > 0 else zero
+            for r in self._rows])
+        return temps, base_keys
 
     @staticmethod
     def _finished(req: _InFlight) -> bool:

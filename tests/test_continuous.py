@@ -557,11 +557,9 @@ class TestSpeculative:
 
     def test_spec_refusals(self, spec):
         target, tvars, dvars = spec
-        with pytest.raises(ValueError, match="temperature-0"):
-            eng = ContinuousBatcher(target, tvars, max_rows=2,
-                                    draft_module=target,
-                                    draft_variables=dvars)
-            eng.submit(_prompt(1, 4), max_new_tokens=4, temperature=0.7)
+        # temperature > 0 rows are ACCEPTED since the r5 rowwise
+        # rejection-sampling extension (TestSpeculativeSampledRows);
+        # engine-level top_k remains refused with a draft
         with pytest.raises(ValueError, match="steps_per_tick"):
             ContinuousBatcher(target, tvars, max_rows=2, steps_per_tick=4,
                               draft_module=target, draft_variables=dvars)
@@ -634,3 +632,120 @@ class TestSpeculative:
             np.testing.assert_array_equal(got, want)
         finally:
             jm._engine.stop()
+
+
+class TestSpeculativeSampledRows:
+    """Sampled rows (temperature > 0) inside the speculative engine —
+    the rowwise Leviathan/Chen rejection scheme, mixing freely with
+    greedy rows in one executable."""
+
+    @pytest.fixture(scope="class")
+    def spec(self):
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96)
+        target = GPTLM(cfg, pad_token_id=-1)
+        tvars = target.init(jax.random.PRNGKey(0),
+                            jnp.ones((1, 5), jnp.int32))
+        dvars = target.init(jax.random.PRNGKey(9),
+                            jnp.ones((1, 5), jnp.int32))
+        return target, tvars, dvars
+
+    def test_self_draft_sampled_rows_accept_everything(self, spec):
+        """p_d == p_t (draft IS the target) makes the acceptance ratio
+        exactly 1: every proposal accepted, regardless of the uniforms."""
+        target, tvars, _ = spec
+        eng = ContinuousBatcher(target, tvars, max_rows=2,
+                                draft_module=target, draft_variables=tvars,
+                                gamma=3)
+        req = eng.submit(_prompt(1, 5), max_new_tokens=12, temperature=1.0)
+        eng.run_until_idle()
+        assert len(req.result(timeout=1)) == 12
+        # all-accept => ceil((12-1)/4) spec dispatches + 1 prefill-ish
+        # round; the scheduling metric proves gamma-token strides
+        assert eng.step_count <= 3
+
+    def test_greedy_rows_stay_exact_when_mixed_with_sampled(self, spec):
+        """The r5-session-1 contract survives the sampling extension:
+        greedy rows in a batch that ALSO carries sampled rows still equal
+        solo generate()."""
+        target, tvars, dvars = spec
+        eng = ContinuousBatcher(target, tvars, max_rows=3,
+                                draft_module=target, draft_variables=dvars,
+                                gamma=3)
+        greedy_jobs = []
+        for seed, plen, budget in ((1, 4, 12), (3, 5, 6)):
+            p = _prompt(seed, plen)
+            greedy_jobs.append((p, budget,
+                                eng.submit(p, max_new_tokens=budget)))
+        sampled = eng.submit(_prompt(2, 6), max_new_tokens=15,
+                             temperature=0.9)
+        eng.run_until_idle()
+        for p, budget, req in greedy_jobs:
+            want = np.asarray(generate(
+                target, tvars, p[None, :], max_new_tokens=budget))[0]
+            np.testing.assert_array_equal(req.result(timeout=1), want)
+        assert len(sampled.result(timeout=1)) == 15
+
+    def test_sampled_rows_deterministic_per_key(self, spec):
+        target, tvars, dvars = spec
+
+        def run(key_seed):
+            eng = ContinuousBatcher(
+                target, tvars, max_rows=2, draft_module=target,
+                draft_variables=dvars, gamma=2)
+            req = eng.submit(_prompt(4, 5), max_new_tokens=10,
+                             temperature=0.8,
+                             key=jax.random.PRNGKey(key_seed))
+            eng.run_until_idle()
+            return req.result(timeout=1)
+
+        a, b, c = run(7), run(7), run(8)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_sampled_row_distribution_matches_direct_sampling(self):
+        """Two-sample TV check: the SECOND emitted token of an engine
+        sampled-spec row (produced by the first rejection round through a
+        mismatched draft) vs direct target sampling, N=400 requests
+        through ONE engine (rows recycle; per-request keys)."""
+        cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=32, vocab_size=8,
+                             hidden_size=16, num_heads=2, mlp_dim=32,
+                             num_layers=1)
+        target = GPTLM(cfg, pad_token_id=-1)
+        prompt = np.array([3, 5, 1], np.int32)
+        tvars = target.init(jax.random.PRNGKey(10), prompt[None, :])
+        dvars = target.init(jax.random.PRNGKey(11), prompt[None, :])
+        eng = ContinuousBatcher(target, tvars, max_rows=4,
+                                draft_module=target, draft_variables=dvars,
+                                gamma=2)
+        n = 400
+        reqs = [eng.submit(prompt, max_new_tokens=2, temperature=1.0,
+                           key=jax.random.PRNGKey(1000 + i))
+                for i in range(n)]
+        eng.run_until_idle()
+        toks = np.stack([r.result(timeout=5) for r in reqs])  # (n, 2)
+        ref = jax.jit(jax.vmap(lambda key: generate(
+            target, tvars, jnp.asarray(prompt)[None, :], 2,
+            temperature=1.0, rng=key)[0]))(
+                jax.random.split(jax.random.PRNGKey(13), n))
+        ref = np.asarray(ref)
+        for pos in (0, 1):
+            hs = np.bincount(toks[:, pos], minlength=8) / n
+            hr = np.bincount(ref[:, pos], minlength=8) / n
+            tv = 0.5 * np.abs(hs - hr).sum()
+            assert tv < 0.12, (pos, tv, hs, hr)
+
+
+    def test_top_k_refused_only_for_sampled_submit(self, spec):
+        """Engine-level top_k + draft still CONSTRUCTS and serves greedy
+        traffic (deployed greedy configs must not break at load); the
+        refusal fires at submit() for sampled rows only."""
+        target, tvars, dvars = spec
+        eng = ContinuousBatcher(target, tvars, max_rows=2, top_k=5,
+                                draft_module=target, draft_variables=dvars)
+        req = eng.submit(_prompt(6, 4), max_new_tokens=6)  # greedy: fine
+        with pytest.raises(ValueError, match="top_k"):
+            eng.submit(_prompt(7, 4), max_new_tokens=6, temperature=0.7)
+        eng.run_until_idle()
+        want = np.asarray(generate(
+            target, tvars, _prompt(6, 4)[None, :], max_new_tokens=6))[0]
+        np.testing.assert_array_equal(req.result(timeout=1), want)
