@@ -254,7 +254,10 @@ def _load_predict_fn(model_dir: Path):
                 "mutually exclusive (beam search is deterministic)"
             )
         eos_raw = gen.get("eos_token_id")
-        eos_id = None if eos_raw is None else int(eos_raw)
+        # int or a stop-id list (Llama-3 imports) — generate() takes both
+        eos_id = (None if eos_raw is None
+                  else [int(x) for x in eos_raw]
+                  if isinstance(eos_raw, (list, tuple)) else int(eos_raw))
         if num_beams > 1 and eos_id is not None:
             raise ValueError(
                 "generate config: eos_token_id is not supported with "
@@ -352,7 +355,8 @@ class JaxModel(Model):
                 module, variables,
                 max_rows=int(gen.get("continuous_rows", 8)),
                 default_max_new_tokens=int(gen.get("max_new_tokens", 32)),
-                eos_token_id=None if eos is None else int(eos),
+                # int or stop-id list — the engine normalizes either
+                eos_token_id=eos,
                 top_k=int(gen.get("top_k", 0)),
                 seed=int(gen.get("seed", 0)),
                 steps_per_tick=int(gen.get("continuous_steps_per_tick", 1)),
@@ -417,12 +421,17 @@ class JaxModel(Model):
             reqs = [self._engine.submit(row, max_new_tokens=budget,
                                         temperature=temp)
                     for row in x]
+            # eos may be a stop-id LIST (Llama-3 imports); the clamp
+            # token past a retired row is the FIRST id — generate()'s
+            # contract
+            clamp = (int(eos[0]) if isinstance(eos, (list, tuple))
+                     else None if eos is None else int(eos))
             outs = []
             for r in reqs:
                 ids = r.result(timeout=300.0)
-                if ids.size < budget:  # generate()'s clamp contract: rows
-                    ids = np.concatenate([  # pad past EOS with EOS
-                        ids, np.full((budget - ids.size,), int(eos),
+                if ids.size < budget:  # pad past the stop with the clamp
+                    ids = np.concatenate([
+                        ids, np.full((budget - ids.size,), clamp,
                                      np.int32)])
                 outs.append(ids)
             return np.stack(outs)

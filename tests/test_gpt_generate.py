@@ -795,3 +795,38 @@ class TestMultiStopIds:
         eng.run_until_idle()
         got = req.result(timeout=1)
         assert got[-1] == firing and len(got) == first + 1
+
+    def test_served_predictor_list_eos(self, lm, tmp_path):
+        """A predictor dir whose generate config carries a stop-id LIST
+        (Llama-3 imports) loads and serves through BOTH the solo gpt-lm
+        path and the continuous engine, early rows padded with the first
+        stop id (the clamp token)."""
+        from kubeflow_tpu.serving.model import JaxModel, save_predictor
+
+        model, variables, prompt = lm
+        p0 = np.asarray(prompt, np.int32)[:1]
+        base = np.asarray(generate(model, variables, jnp.asarray(p0),
+                                   max_new_tokens=8))[0]
+        firing = int(base[3])
+        first = int(np.argmax(base == firing))
+        never = (firing + 1) % model.cfg.vocab_size
+        for name, extra in (("solo", {}),
+                            ("cont", {"continuous": True,
+                                      "continuous_rows": 2})):
+            d = save_predictor(
+                tmp_path / name, "gpt-lm", dict(variables), p0,
+                generate={"max_new_tokens": 8, "pad_token_id": -1,
+                          "eos_token_id": [firing, never], **extra},
+                size="tiny", config={"dropout_rate": 0.0, "max_len": 64},
+            )
+            m = JaxModel(name, d)
+            m.load()
+            try:
+                out = np.asarray(m.predict(p0))
+                assert out.shape == (1, 8)
+                np.testing.assert_array_equal(out[0][: first + 1],
+                                              base[: first + 1])
+                assert (out[0][first:] == firing).all()
+            finally:
+                if getattr(m, "_engine", None) is not None:
+                    m._engine.stop()
