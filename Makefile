@@ -1,0 +1,33 @@
+# Top-level developer entry points. The native core has its own Makefile
+# (kubeflow_tpu/native/Makefile) for building libkfcore.so and the
+# sanitizer self-test binaries.
+
+NATIVE := kubeflow_tpu/native
+
+.PHONY: test test-chaos selftest-sanitizers native
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# recovery drills only (seeded fault injection — docs/chaos.md)
+test-chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_drills.py -q -m chaos
+
+native:
+	$(MAKE) -C $(NATIVE)
+
+# Run the prebuilt ASan/UBSan + TSan self-tests of the native core
+# (workqueue, expectations, event hub, reconciler, metastore). The
+# checked-in binaries are the fast path; a binary that is missing or was
+# linked against a sanitizer runtime this machine doesn't ship (ldd
+# reports 'not found') is rebuilt from source first.
+selftest-sanitizers:
+	@for t in selftest_asan selftest_tsan; do \
+	  bin=$(NATIVE)/build/$$t; \
+	  if ! ldd $$bin >/dev/null 2>&1 || ldd $$bin | grep -q "not found"; then \
+	    echo "rebuilding $$t (prebuilt binary not runnable here)"; \
+	    $(MAKE) -B -C $(NATIVE) build/$$t || exit 1; \
+	  fi; \
+	done
+	$(NATIVE)/build/selftest_asan
+	$(NATIVE)/build/selftest_tsan

@@ -1,0 +1,479 @@
+"""Deterministic chaos layer: seeded fault injection for recovery drills.
+
+The platform's core contract is gang-restart-from-checkpoint fault tolerance
+(SURVEY.md §5.3-§5.4); this module is how that contract gets *exercised*.
+A FaultPlan is a seed-derived, byte-for-byte reproducible schedule of faults;
+a ChaosEngine attached to a Platform injects them at the layer boundaries the
+real system fails at:
+
+  - FakeCluster.update        -> ConflictError storms (apiserver 409 bursts)
+  - WatchSubscription.get     -> dropped watch streams (forced relists, the
+                                 'resourceVersion expired' path) and delayed
+                                 event delivery (informer lag)
+  - PodRuntime._launch        -> startup stalls (slow image pull / TPU slice
+                                 allocation)
+  - running pods              -> kills with retryable (signal -> 128+signum)
+                                 or non-retryable exit codes
+  - Checkpointer saves        -> fsync delays and torn writes (an atomic-
+                                 rename checkpointer surfaces a torn write as
+                                 a MISSING newest checkpoint, so injection
+                                 drops the save after the delay)
+
+Reproducibility contract: FaultPlan.from_seed(s) is a pure function of
+(s, profile) — plan.describe() is byte-identical across runs and
+plan.digest() names it. Injection *order* under free-running threads is not
+replayed tick-for-tick (neither are real outages); the drill suite instead
+asserts semantic convergence — every drill ends Succeeded/Ready within a
+bounded reconcile budget. To reproduce a failed drill, re-run with its
+logged seed: the same faults are armed with the same parameters.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import random
+import signal
+import threading
+import time
+from dataclasses import dataclass, field, fields
+
+from kubeflow_tpu.controller.fakecluster import ConflictError, PodPhase
+from kubeflow_tpu.utils.retry import with_conflict_retry
+
+
+# --------------------------------------------------------------- fault specs
+
+
+@dataclass(frozen=True)
+class ConflictStorm:
+    """Reject a fraction of updates on one kind with ConflictError until the
+    injection budget is spent (apiserver optimistic-concurrency burst)."""
+
+    kind: str = "jobs"
+    rate: float = 0.5
+    count: int = 8
+
+
+@dataclass(frozen=True)
+class WatchDrop:
+    """Force a full relist on every Nth watch delivery (the 'watch too old'
+    recovery path), `count` times total across all subscriptions."""
+
+    every_n: int = 40
+    count: int = 4
+
+
+@dataclass(frozen=True)
+class EventDelay:
+    """Stall a fraction of watch deliveries by delay_s (informer lag)."""
+
+    rate: float = 0.15
+    delay_s: float = 0.03
+    count: int = 40
+
+
+@dataclass(frozen=True)
+class PodKill:
+    """Kill up to `times` distinct running pods matching `name_glob` after
+    they have been running for `after_running_s`. signal != 0 kills the real
+    process (exit normalizes to 128+signum — retryable); signal == 0 instead
+    marks the pod Failed with `exit_code` (non-retryable codes < 128)."""
+
+    name_glob: str = "*"
+    after_running_s: float = 0.2
+    signal: int = int(signal.SIGKILL)
+    exit_code: int = 1
+    times: int = 1
+
+
+@dataclass(frozen=True)
+class StartStall:
+    """Delay the launch of up to `count` pods matching `name_glob` by
+    delay_s (slow image pull / TPU slice allocation)."""
+
+    name_glob: str = "*"
+    delay_s: float = 0.25
+    count: int = 1
+
+
+@dataclass(frozen=True)
+class CheckpointFault:
+    """save() faults: every save sleeps save_delay_s (slow fsync); every
+    torn_every_n-th save is dropped after the delay (torn write under
+    atomic-rename semantics = the checkpoint never becomes visible)."""
+
+    save_delay_s: float = 0.02
+    torn_every_n: int = 0
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A complete, seed-stamped fault schedule. Immutable; describe() is the
+    canonical byte-stable form and digest() its reproducibility fingerprint."""
+
+    seed: int
+    conflict_storms: tuple[ConflictStorm, ...] = ()
+    watch_drops: tuple[WatchDrop, ...] = ()
+    event_delays: tuple[EventDelay, ...] = ()
+    pod_kills: tuple[PodKill, ...] = ()
+    start_stalls: tuple[StartStall, ...] = ()
+    checkpoint: CheckpointFault | None = None
+
+    @classmethod
+    def from_seed(cls, seed: int, profile: str = "default") -> "FaultPlan":
+        """Derive a plan from a seed — same (seed, profile) => identical
+        plan, byte for byte. Profiles pick which layers get hit:
+
+          default   — a bit of everything, drill-sized
+          apiserver — conflict storms + watch drops only
+          pods      — kills + startup stalls only
+          storage   — checkpoint faults only
+        """
+        rng = random.Random(f"kftpu-chaos-{profile}-{seed}")
+        r = lambda lo, hi: round(rng.uniform(lo, hi), 4)  # noqa: E731
+        apiserver = profile in ("default", "apiserver")
+        pods = profile in ("default", "pods")
+        storage = profile in ("default", "storage")
+        if profile not in ("default", "apiserver", "pods", "storage"):
+            raise ValueError(f"unknown chaos profile {profile!r}")
+        return cls(
+            seed=seed,
+            conflict_storms=(
+                ConflictStorm("jobs", rate=r(0.2, 0.6), count=rng.randint(4, 10)),
+                ConflictStorm("pods", rate=r(0.1, 0.4), count=rng.randint(4, 10)),
+            ) if apiserver else (),
+            watch_drops=(
+                WatchDrop(every_n=rng.randint(30, 80), count=rng.randint(2, 5)),
+            ) if apiserver else (),
+            event_delays=(
+                EventDelay(rate=r(0.05, 0.2), delay_s=r(0.01, 0.05),
+                           count=rng.randint(20, 60)),
+            ) if apiserver else (),
+            pod_kills=(
+                PodKill("*", after_running_s=r(0.1, 0.5), times=1),
+            ) if pods else (),
+            start_stalls=(
+                StartStall("*", delay_s=r(0.1, 0.4), count=rng.randint(1, 2)),
+            ) if pods else (),
+            checkpoint=CheckpointFault(
+                save_delay_s=r(0.005, 0.05), torn_every_n=rng.randint(2, 4)
+            ) if storage else None,
+        )
+
+    def describe(self) -> str:
+        """Canonical text form — field order fixed by the dataclass
+        definitions, floats already rounded at construction, no dict
+        iteration anywhere: byte-for-byte stable for a given plan."""
+        lines = [f"fault-plan seed={self.seed}"]
+
+        def emit(label: str, spec) -> None:
+            kv = " ".join(
+                f"{f.name}={getattr(spec, f.name)!r}" for f in fields(spec)
+            )
+            lines.append(f"  {label}: {kv}")
+
+        for s in self.conflict_storms:
+            emit("conflict-storm", s)
+        for s in self.watch_drops:
+            emit("watch-drop", s)
+        for s in self.event_delays:
+            emit("event-delay", s)
+        for s in self.pod_kills:
+            emit("pod-kill", s)
+        for s in self.start_stalls:
+            emit("start-stall", s)
+        if self.checkpoint is not None:
+            emit("checkpoint", self.checkpoint)
+        return "\n".join(lines) + "\n"
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.describe().encode()).hexdigest()[:16]
+
+
+# -------------------------------------------------------------------- engine
+
+
+@dataclass
+class _KillState:
+    spec: PodKill
+    remaining: int = field(default=0)
+
+    def __post_init__(self):
+        self.remaining = self.spec.times
+
+
+class ChaosEngine:
+    """Arms a FaultPlan against a Platform (or bare cluster/runtime).
+
+    Hook-based, not monkeypatch-based: FakeCluster and PodRuntime carry a
+    `chaos` attachment point and call into the engine at their fault
+    boundaries; detach() disarms everything. All draws come from one seeded
+    RNG under a lock, and every injection increments a counter in
+    `self.metrics` (exported as kftpu_chaos_* via observability.py).
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.rng = random.Random(plan.seed)
+        self._mu = threading.Lock()
+        self.metrics: dict[str, int] = {
+            "conflicts_injected_total": 0,
+            "watch_drops_total": 0,
+            "event_delays_total": 0,
+            "pod_kills_total": 0,
+            "pod_failures_injected_total": 0,
+            "start_stalls_total": 0,
+            "ckpt_saves_delayed_total": 0,
+            "ckpt_saves_torn_total": 0,
+        }
+        self._storm_budget = {id(s): s.count for s in plan.conflict_storms}
+        self._drop_budget = {id(d): d.count for d in plan.watch_drops}
+        self._delay_budget = {id(d): d.count for d in plan.event_delays}
+        self._stall_budget = {id(s): s.count for s in plan.start_stalls}
+        self._kills = [_KillState(k) for k in plan.pod_kills]
+        self._watch_counts: dict[int, int] = {}
+        self._killed_uids: set[str] = set()
+        self._ckpt_saves = 0
+        self._platform = None
+        self._cluster = None
+        self._runtime = None
+        self._stop = threading.Event()
+        self._killer: threading.Thread | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def attach(self, platform=None, cluster=None, pod_runtime=None) -> "ChaosEngine":
+        """Arm the plan. Pass a Platform (wires everything + /metrics), or a
+        bare cluster and/or pod_runtime for unit-scope drills."""
+        self._platform = platform
+        self._cluster = cluster if cluster is not None else (
+            platform.cluster if platform is not None else None
+        )
+        self._runtime = pod_runtime if pod_runtime is not None else (
+            getattr(platform, "pod_runtime", None)
+        )
+        if self._cluster is not None:
+            self._cluster.chaos = self
+        if self._runtime is not None:
+            self._runtime.chaos = self
+        if platform is not None:
+            platform.chaos = self
+        if self._kills and self._cluster is not None and self._runtime is not None:
+            self._killer = threading.Thread(
+                target=self._kill_loop, name="chaos-killer", daemon=True
+            )
+            self._killer.start()
+        return self
+
+    def detach(self) -> None:
+        self._stop.set()
+        if self._killer is not None:
+            self._killer.join(timeout=5.0)
+            self._killer = None
+        if self._cluster is not None and self._cluster.chaos is self:
+            self._cluster.chaos = None
+        if self._runtime is not None and getattr(self._runtime, "chaos", None) is self:
+            self._runtime.chaos = None
+        if self._platform is not None and getattr(self._platform, "chaos", None) is self:
+            self._platform.chaos = None
+
+    def __enter__(self) -> "ChaosEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.detach()
+
+    def quiescent(self) -> bool:
+        """True once every BUDGETED fault is spent (storms, drops, delays,
+        kills, stalls) — asserting convergence only makes sense after the
+        armed faults have fully landed. Checkpoint faults are periodic
+        (torn_every_n), not budgeted, so they never block quiescence."""
+        with self._mu:
+            return (
+                all(v <= 0 for v in self._storm_budget.values())
+                and all(v <= 0 for v in self._drop_budget.values())
+                and all(v <= 0 for v in self._delay_budget.values())
+                and all(v <= 0 for v in self._stall_budget.values())
+                and all(k.remaining <= 0 for k in self._kills)
+            )
+
+    # ------------------------------------------------- fakecluster hooks
+
+    def on_update(self, kind: str, key: str) -> None:
+        """Called by FakeCluster.update before applying a write; raising
+        ConflictError here is indistinguishable from a real stale write, so
+        every caller's retry discipline gets exercised for free."""
+        with self._mu:
+            for storm in self.plan.conflict_storms:
+                if storm.kind != kind:
+                    continue
+                if self._storm_budget.get(id(storm), 0) <= 0:
+                    continue
+                if self.rng.random() >= storm.rate:
+                    continue
+                self._storm_budget[id(storm)] -= 1
+                self.metrics["conflicts_injected_total"] += 1
+                raise ConflictError(
+                    f"chaos[seed={self.plan.seed}]: injected conflict on "
+                    f"{kind} {key}"
+                )
+
+    def on_watch_get(self, sub_id: int) -> float | str | None:
+        """Called once per WatchSubscription delivery attempt. Returns
+        'drop' (force a relist), a delay in seconds, or None."""
+        with self._mu:
+            n = self._watch_counts[sub_id] = self._watch_counts.get(sub_id, 0) + 1
+            for d in self.plan.watch_drops:
+                if self._drop_budget.get(id(d), 0) > 0 and n % d.every_n == 0:
+                    self._drop_budget[id(d)] -= 1
+                    self.metrics["watch_drops_total"] += 1
+                    return "drop"
+            for d in self.plan.event_delays:
+                if (
+                    self._delay_budget.get(id(d), 0) > 0
+                    and self.rng.random() < d.rate
+                ):
+                    self._delay_budget[id(d)] -= 1
+                    self.metrics["event_delays_total"] += 1
+                    return d.delay_s
+        return None
+
+    # -------------------------------------------------- podruntime hooks
+
+    def on_pod_launch(self, pod) -> None:
+        """Called by PodRuntime._launch before spawning; sleeping here IS the
+        fault (slow image pull / slice allocation stalls the kubelet path)."""
+        delay = None
+        with self._mu:
+            for s in self.plan.start_stalls:
+                if self._stall_budget.get(id(s), 0) <= 0:
+                    continue
+                if not fnmatch.fnmatch(pod.metadata.name, s.name_glob):
+                    continue
+                self._stall_budget[id(s)] -= 1
+                self.metrics["start_stalls_total"] += 1
+                delay = s.delay_s
+                break
+        if delay is not None:
+            time.sleep(delay)
+
+    def _kill_loop(self) -> None:
+        """Watch running pods; kill matching ones per plan. Kills are keyed
+        by pod UID, so a restarted incarnation (same name, new uid) is a
+        fresh target only while a spec still has budget."""
+        due: dict[str, float] = {}
+        while not self._stop.is_set():
+            with self._mu:
+                armed = [k for k in self._kills if k.remaining > 0]
+            if not armed:
+                return
+            now = time.time()  # PodStatus.start_time is wall-clock
+            for pod in self._cluster.list("pods"):
+                if pod.status.phase != PodPhase.RUNNING:
+                    continue
+                uid = pod.metadata.uid
+                if uid in self._killed_uids:
+                    continue
+                ks = next(
+                    (
+                        k for k in armed
+                        if fnmatch.fnmatch(pod.metadata.name, k.spec.name_glob)
+                    ),
+                    None,
+                )
+                if ks is None:
+                    continue
+                started = pod.status.start_time or now
+                fire_at = due.setdefault(
+                    uid, started + ks.spec.after_running_s
+                )
+                if now < fire_at:
+                    continue
+                with self._mu:
+                    if ks.remaining <= 0 or uid in self._killed_uids:
+                        continue
+                    # reserve the budget; restored below if the kill misses
+                    ks.remaining -= 1
+                    self._killed_uids.add(uid)
+                if not self._fire_kill(pod, ks.spec):
+                    # target vanished between snapshot and injection (e.g.
+                    # the pod finished): the budget was NOT spent — the next
+                    # matching running pod is still a target
+                    with self._mu:
+                        ks.remaining += 1
+                        self._killed_uids.discard(uid)
+            self._stop.wait(0.03)
+
+    def _fire_kill(self, pod, spec: PodKill) -> bool:
+        """Returns True only when the fault actually landed."""
+        if spec.signal:
+            if self._runtime.inject_kill(pod.key, spec.signal):
+                with self._mu:
+                    self.metrics["pod_kills_total"] += 1
+                return True
+            return False
+        # signal == 0: fail the pod via the store with a chosen exit code
+        # (non-retryable codes < 128 are unreachable through real signals)
+        uid, code = pod.metadata.uid, spec.exit_code
+
+        def attempt():
+            cur = self._cluster.get("pods", pod.key, copy_obj=True)
+            if cur is None or cur.metadata.uid != uid:
+                return None
+            cur.status.phase = PodPhase.FAILED
+            cur.status.exit_code = code
+            cur.status.finish_time = time.time()
+            cur.status.message = f"chaos[seed={self.plan.seed}]: injected failure"
+            return self._cluster.update("pods", cur)
+
+        try:
+            if with_conflict_retry(attempt) is not None:
+                self._runtime.inject_kill(pod.key)  # reap the real process
+                with self._mu:
+                    self.metrics["pod_failures_injected_total"] += 1
+                return True
+        except (ConflictError, KeyError):
+            pass  # pod churned away mid-injection; the drill moves on
+        return False
+
+    # ------------------------------------------------- checkpointer hook
+
+    def on_checkpoint_save(self) -> bool:
+        """Returns True when this save should be TORN (dropped after the
+        delay); always applies the plan's fsync delay first."""
+        ck = self.plan.checkpoint
+        if ck is None:
+            return False
+        with self._mu:
+            self._ckpt_saves += 1
+            n = self._ckpt_saves
+            self.metrics["ckpt_saves_delayed_total"] += 1
+            torn = bool(ck.torn_every_n) and n % ck.torn_every_n == 0
+            if torn:
+                self.metrics["ckpt_saves_torn_total"] += 1
+        if ck.save_delay_s > 0:
+            time.sleep(ck.save_delay_s)
+        return torn
+
+
+class ChaosCheckpointer:
+    """Fault-injecting wrapper with the Checkpointer save/restore surface.
+
+    Slow saves sleep before committing; torn saves never commit — under
+    atomic-rename checkpointing a partial write is exactly a checkpoint
+    that fails to become visible, so restore_latest() serves the previous
+    step and the resume path gets exercised against real data loss.
+    """
+
+    def __init__(self, inner, engine: ChaosEngine):
+        self._inner = inner
+        self._engine = engine
+
+    def save(self, step: int, state, metrics: dict | None = None) -> None:
+        if self._engine.on_checkpoint_save():
+            return  # torn: the save never becomes visible
+        self._inner.save(step, state, metrics=metrics)
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)

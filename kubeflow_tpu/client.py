@@ -9,7 +9,6 @@ job controller into one unit with real subprocess workloads.
 
 from __future__ import annotations
 
-import time
 from pathlib import Path
 
 from kubeflow_tpu.api.common import JobConditionType
@@ -20,6 +19,7 @@ from kubeflow_tpu.controller.gang import GangScheduler
 from kubeflow_tpu.controller.jobcontroller import JobController, delete_job_cascade
 from kubeflow_tpu.controller.profile import check_job_admission
 from kubeflow_tpu.controller.podruntime import PodRuntime
+from kubeflow_tpu.utils.retry import BackoffPolicy, poll_until
 
 
 class Platform:
@@ -265,17 +265,25 @@ class TrainingClient:
         timeout_s: float = 120.0,
         poll_s: float = 0.1,
     ) -> TrainJob:
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        def reached() -> TrainJob | None:
             job = self.get_job(name, namespace)
             if job is not None:
                 for cond in expected:
                     if job.status.has_condition(cond):
                         return job
-            time.sleep(poll_s)
-        raise TimeoutError(
-            f"job {namespace}/{name} did not reach {expected} in {timeout_s}s"
-        )
+            return None
+
+        try:
+            return poll_until(
+                reached,
+                timeout_s=timeout_s,
+                policy=BackoffPolicy(base_s=0.02, max_s=poll_s, jitter=0.5),
+            )
+        except TimeoutError:
+            raise TimeoutError(
+                f"job {namespace}/{name} did not reach {expected} "
+                f"in {timeout_s}s"
+            ) from None
 
     def get_job_logs(
         self, name: str, namespace: str = "default", rtype: str = "worker", index: int = 0

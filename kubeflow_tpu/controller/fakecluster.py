@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from kubeflow_tpu.api.common import ObjectMeta, utcnow as _ts
+from kubeflow_tpu.utils.retry import BackoffPolicy, with_conflict_retry
 
 
 class EventType(str, enum.Enum):
@@ -65,6 +66,20 @@ class WatchSubscription:
             return self._pending.popleft()
         if self._closed:
             raise queue.Empty
+        chaos = self._cluster.chaos
+        if chaos is not None:
+            action = chaos.on_watch_get(self._sub_id)
+            if action == "drop":
+                # injected 'watch too old': this stream loses its place and
+                # must recover exactly like a real overflow — full relist.
+                # Recurse with the CALLER'S timeout: when the store is empty
+                # the relist queues nothing and the caller still deserves a
+                # blocking wait, not an instant queue.Empty
+                with self._cluster._mu:
+                    self._relist_locked()
+                return self.get(timeout=timeout)
+            if action:
+                time.sleep(action)  # injected informer lag
         hub = self._cluster._hub
         rc, seq, etype_code, _kind, _key = hub.poll(
             self._sub_id, 0.0 if timeout is None else timeout
@@ -189,6 +204,9 @@ class FakeCluster:
         self._rv = 0
         self.events: list[ClusterEvent] = []
         self.capacity_chips = 8  # schedulable "chips" for the gang scheduler
+        #: fault-injection attachment point (chaos.ChaosEngine.attach);
+        #: None in production — every hook call is gated on it
+        self.chaos = None
 
     # ------------------------------------------------------------------ CRUD
 
@@ -212,6 +230,11 @@ class FakeCluster:
         """Swap in `obj`. Rejects stale writes: obj's resource_version must
         match the stored one (always true when mutating the stored object in
         place; snapshot writers get ConflictError and must re-read)."""
+        chaos = self.chaos
+        if chaos is not None:
+            # outside _mu: an injected ConflictError must not be
+            # distinguishable from a real one by lock-hold side effects
+            chaos.on_update(kind, self._key(obj))
         with self._mu:
             key = self._key(obj)
             stored = self._objects[kind].get(key)
@@ -241,21 +264,29 @@ class FakeCluster:
         backoff_s: float = 0.02,
     ) -> Any:
         """Optimistic-concurrency update: deep-copied snapshot -> mutate ->
-        swap; retried on ConflictError. The ONE sanctioned way for clients to
+        swap; retried on ConflictError under the shared jittered-backoff
+        policy (utils/retry.py). The ONE sanctioned way for clients to
         update stored objects (mutating the live object in place would make
         half-applied changes visible to controllers and defeat conflict
         detection — every hand-rolled copy of this loop has eventually
         dropped the copy)."""
-        for _ in range(retries):
+
+        def attempt():
             obj = self.get(kind, key, copy_obj=True)
             if obj is None:
                 raise KeyError(key)
             mutate(obj)
-            try:
-                return self.update(kind, obj)
-            except ConflictError:
-                time.sleep(backoff_s)
-        raise ConflictError(f"update of {kind}/{key} kept conflicting")
+            return self.update(kind, obj)
+
+        policy = BackoffPolicy(
+            base_s=backoff_s, max_s=backoff_s * 8, max_attempts=retries
+        )
+        try:
+            return with_conflict_retry(attempt, policy=policy)
+        except ConflictError as exc:
+            raise ConflictError(
+                f"update of {kind}/{key} kept conflicting"
+            ) from exc
 
     def get(self, kind: str, key: str, copy_obj: bool = False) -> Any | None:
         """Fetch by key. copy_obj=True returns a deep snapshot — required by

@@ -8,6 +8,7 @@ count, and a gang occupies whole slices (SURVEY.md §2.2 gang semantics).
 
 from __future__ import annotations
 
+import copy
 import math
 import threading
 
@@ -19,6 +20,7 @@ from kubeflow_tpu.controller.fakecluster import (
     PodGroup,
     PodPhase,
 )
+from kubeflow_tpu.utils.retry import with_conflict_retry
 
 
 def topology_chips(topology: str) -> int:
@@ -195,11 +197,16 @@ class GangScheduler:
                 # already counted and the survivors are picked up by the
                 # late-member path above — never an uncounted half-gang.
                 self._bound_chips[pg.key] = (pg.metadata.uid, chips_needed)
-                pg.phase = "Running"
+                # copy-before-mutate: a rejected write must leave the STORED
+                # group untouched (phase still Pending) so the next sweep
+                # re-admits it cleanly instead of seeing a half-flipped state
+                admitted = copy.deepcopy(pg)
+                admitted.phase = "Running"
                 try:
-                    self.cluster.update("podgroups", pg)
+                    self.cluster.update("podgroups", admitted)
                 except (ConflictError, KeyError):
-                    # group replaced/deleted under us: release and move on
+                    # group replaced/deleted/contended under us: release and
+                    # move on; the periodic sweep retries admission
                     self._bound_chips.pop(pg.key, None)
                     continue
                 self._bind(pending, prefix="slice-0-host")
@@ -244,11 +251,12 @@ class GangScheduler:
             if entry is None:
                 continue
             released += entry[1]
-            victim.phase = "Pending"
+            evicted = copy.deepcopy(victim)  # never half-flip the stored one
+            evicted.phase = "Pending"
             try:
-                self.cluster.update("podgroups", victim)
+                self.cluster.update("podgroups", evicted)
             except (ConflictError, KeyError):
-                pass
+                pass  # reservation already released; the sweep re-admits
             for p in self._members(victim):
                 try:
                     self.cluster.delete("pods", p.key)
@@ -312,13 +320,30 @@ class GangScheduler:
 
     def _bind(self, pods: list[Pod], prefix: str) -> None:
         """Bind each pod, tolerating concurrent replacement of individuals
-        (the group's reservation is already held by the caller)."""
+        (the group's reservation is already held by the caller).
+
+        Conflict-retried copy-on-write, NOT in-place mutation: setting
+        .node on the live stored object and then losing the update to a
+        ConflictError leaves the store showing a bound pod that no watch
+        event ever announced — the runtime never launches it and the
+        late-member path (which keys on `not status.node`) never rebinds
+        it, wedging the gang forever."""
         for i, p in enumerate(pods):
-            p.status.node = f"{prefix}-{i}"
+            node = f"{prefix}-{i}"
+
+            def attempt(key=p.key, uid=p.metadata.uid, node=node):
+                cur = self.cluster.get("pods", key, copy_obj=True)
+                if cur is None or cur.metadata.uid != uid:
+                    return None  # replaced; late path rebinds the new one
+                if cur.status.node or cur.status.phase != PodPhase.PENDING:
+                    return None  # already bound/advanced elsewhere
+                cur.status.node = node
+                return self.cluster.update("pods", cur)
+
             try:
-                self.cluster.update("pods", p)
+                with_conflict_retry(attempt)
             except (ConflictError, KeyError):
-                continue  # this member was replaced; late path rebinds it
+                continue  # kept conflicting; the periodic sweep rebinds it
 
     def _ns_quota_would_block(
         self, pg: PodGroup, chips_needed: int, holdings: dict

@@ -38,6 +38,7 @@ from kubeflow_tpu.controller.fakecluster import (
 from kubeflow_tpu.controller.poddefault import apply_pod_defaults
 from kubeflow_tpu.native import Expectations
 from kubeflow_tpu.runtime.rendezvous import LocalResolver
+from kubeflow_tpu.utils.retry import BackoffPolicy
 
 JOB_NAME_LABEL = "kubeflow-tpu.org/job-name"
 REPLICA_TYPE_LABEL = "kubeflow-tpu.org/replica-type"
@@ -46,6 +47,12 @@ REPLICA_INDEX_LABEL = "kubeflow-tpu.org/replica-index"
 # world size live: any mismatch with the current spec forces a whole-gang
 # re-mesh (elastic scale event), never an in-place patch.
 WORLD_SIZE_LABEL = "kubeflow-tpu.org/world-size"
+
+#: gang-restart requeue schedule (crashloop-backoff analogue): the Nth
+#: restart of a job waits ~2x longer before its recreate pass, so a crash
+#: storm cannot hot-loop pod churn. Jittered so simultaneous gang restarts
+#: (e.g. after a node loss) don't stampede the scheduler in lockstep.
+RESTART_BACKOFF = BackoffPolicy(base_s=0.05, max_s=2.0, jitter=0.5)
 
 
 class JobController(ControllerBase):
@@ -73,7 +80,17 @@ class JobController(ControllerBase):
             "jobs_remeshed_total": 0,
             "pods_created_total": 0,
             "pods_deleted_total": 0,
+            # recovery observability (chaos drills assert on these): how many
+            # jobs came back from >=1 restart, how many reconcile passes and
+            # restarts that recovery consumed — the measurable shape of the
+            # gang-restart-from-checkpoint contract
+            "jobs_recovered_total": 0,
+            "recovery_reconcile_passes_total": 0,
+            "recovery_restarts_consumed_total": 0,
         })
+        #: per-job reconcile passes spent since its first restart; folded
+        #: into recovery_* counters when the job reaches Succeeded
+        self._recovery_passes: dict[str, int] = {}
 
     # -------------------------------------------------------------- informer
 
@@ -130,9 +147,14 @@ class JobController(ControllerBase):
             self.exp.delete(key)
             self.wq.forget(key)
             self._resolvers.pop(key, None)
+            self._recovery_passes.pop(key, None)
             return None
 
         st = job.status
+        if st.restart_count and not st.is_finished:
+            # recovery in progress: every pass until the terminal condition
+            # counts toward the job's convergence cost
+            self._recovery_passes[key] = self._recovery_passes.get(key, 0) + 1
         entry_fp = _status_fingerprint(st)
         if not st.conditions:
             # persist-then-emit: a ConflictError before the persist must not
@@ -209,6 +231,13 @@ class JobController(ControllerBase):
             self._update_replica_statuses(job, pods)
             self.cluster.update("jobs", job)
             self.metrics["jobs_succeeded_total"] += 1
+            if st.restart_count:
+                # the job survived faults: record what the recovery cost
+                self.metrics["jobs_recovered_total"] += 1
+                self.metrics["recovery_restarts_consumed_total"] += st.restart_count
+                self.metrics["recovery_reconcile_passes_total"] += (
+                    self._recovery_passes.pop(key, 0)
+                )
             self.cluster.record_event("jobs", key, "JobSucceeded", "completed")
             return 0.0  # immediate cleanup pass
 
@@ -400,7 +429,9 @@ class JobController(ControllerBase):
             f"worker failure -> gang restart {st.restart_count}",
             type="Warning",
         )
-        return 0.05
+        # Nth restart waits exponentially longer before the recreate pass
+        # (shared jittered-backoff policy — no more fixed 50ms hot requeue)
+        return RESTART_BACKOFF.delay_for(st.restart_count - 1)
 
     def _is_succeeded(self, job: TrainJob, pods: list[Pod]) -> bool:
         by = {
@@ -472,6 +503,7 @@ class JobController(ControllerBase):
         self._update_replica_statuses(job, pods)
         self.cluster.update("jobs", job)
         self.metrics["jobs_failed_total"] += 1
+        self._recovery_passes.pop(key, None)  # recovery lost, not converged
         self.cluster.record_event("jobs", key, reason, msg, type="Warning")
 
     def _delete_pods(self, key: str, pods: list[Pod]) -> None:

@@ -21,6 +21,11 @@ from kubeflow_tpu.controller.fakecluster import (
     Pod,
     PodPhase,
 )
+from kubeflow_tpu.utils.retry import with_conflict_retry
+
+
+class _StaleIncarnation(Exception):
+    """Internal: the pod a status write was aimed at is gone or replaced."""
 
 
 try:  # resolved ONCE in the parent: the post-fork child must not import or
@@ -65,6 +70,8 @@ class PodRuntime:
         self.inherit_env = inherit_env
         self.bind_pending_default = bind_pending_default
         self.errors = 0  # surfaced so silent failures are still countable
+        #: fault-injection attachment point (chaos.ChaosEngine.attach)
+        self.chaos = None
         self._procs: dict[str, tuple[str, subprocess.Popen]] = {}
         self._mu = threading.Lock()
         self._stop = threading.Event()
@@ -139,17 +146,56 @@ class PodRuntime:
             if not pod.status.node and (
                 pod.scheduler_name == "default" and self.bind_pending_default
             ):
-                pod.status.node = "local-node"
-                try:
-                    self.cluster.update("pods", pod)
-                except KeyError:
-                    return  # deleted between our read and write
+                def bind(p):
+                    if p.status.node or p.status.phase != PodPhase.PENDING:
+                        return False  # someone else bound/advanced it
+                    p.status.node = "local-node"
+
+                # conflict-safe: a dropped bind would orphan the pod forever
+                # (no resync re-delivers pod events)
+                self._update_pod_status(pod.key, pod.metadata.uid, bind)
             elif pod.status.node:
                 self._launch(pod)
+
+    def _update_pod_status(self, key: str, uid: str, mutate_status) -> bool:
+        """Conflict-retried status write gated on the pod incarnation: the
+        kubelet must never lose a status transition to a concurrent writer
+        (a silently dropped ConflictError here strands the pod — and with it
+        the whole gang — in its previous phase), and must never stamp a NEW
+        incarnation with the old one's verdict. Returns False when the pod
+        is gone or replaced."""
+
+        def attempt():
+            pod = self.cluster.get("pods", key, copy_obj=True)
+            if pod is None or pod.metadata.uid != uid:
+                raise _StaleIncarnation
+            if mutate_status(pod) is False:  # mutator declined on fresh state
+                raise _StaleIncarnation
+            return self.cluster.update("pods", pod)
+
+        try:
+            with_conflict_retry(attempt)
+            return True
+        except _StaleIncarnation:
+            return False
+        except (ConflictError, KeyError):
+            # retry budget exhausted under a genuine storm, or deleted
+            # mid-write: surfaced as a countable runtime error, not a hang
+            self.errors += 1
+            self.cluster.record_event(
+                "pods", key, "PodStatusWriteLost",
+                "status write kept conflicting", type="Warning",
+            )
+            return False
 
     # ---------------------------------------------------------------- execution
 
     def _launch(self, pod: Pod) -> None:
+        if self.chaos is not None:
+            # injected startup stall (slow image pull / TPU slice allocation)
+            # happens before the runtime lock — it delays THIS pod's spawn,
+            # not the reaping of every other pod
+            self.chaos.on_pod_launch(pod)
         with self._mu:
             held = self._procs.get(pod.key)
             if held is not None:
@@ -187,23 +233,25 @@ class PodRuntime:
                         preexec_fn=lambda pid=os.getpid(): _die_with_parent(pid),
                     )
             except OSError as exc:
-                pod.status.phase = PodPhase.FAILED
-                pod.status.exit_code = 127
-                pod.status.message = str(exc)
-                try:
-                    self.cluster.update("pods", pod)
-                except KeyError:
-                    pass  # deleted concurrently; nothing to report against
+                def spawn_failed(p, msg=str(exc)):
+                    p.status.phase = PodPhase.FAILED
+                    p.status.exit_code = 127
+                    p.status.message = msg
+
+                self._update_pod_status(
+                    pod.key, pod.metadata.uid, spawn_failed
+                )
                 return
             self._procs[pod.key] = (pod.metadata.uid, proc)
-        pod.status.phase = PodPhase.RUNNING
-        pod.status.pid = proc.pid
-        pod.status.start_time = time.time()
-        try:
-            self.cluster.update("pods", pod)
-        except KeyError:
-            # the pod was deleted while we were spawning its process: the
-            # process must not outlive its (gone) pod
+
+        def running(p, pid=proc.pid):
+            p.status.phase = PodPhase.RUNNING
+            p.status.pid = pid
+            p.status.start_time = time.time()
+
+        if not self._update_pod_status(pod.key, pod.metadata.uid, running):
+            # the pod was deleted/replaced while we were spawning its
+            # process: the process must not outlive its (gone) pod
             self._kill(pod.key)
             return
         threading.Thread(
@@ -212,20 +260,28 @@ class PodRuntime:
 
     def _reap(self, key: str, uid: str, proc: subprocess.Popen) -> None:
         code = proc.wait()
+        if code < 0:
+            # signal death normalizes to the k8s/shell 128+signum convention,
+            # which is what is_retryable_exit_code speaks (SIGKILL -> 137:
+            # retryable infrastructure loss; plain exit(1) stays permanent)
+            code = 128 - code
         with self._mu:
             held = self._procs.get(key)
             if held is not None and held[1] is proc:
                 self._procs.pop(key, None)
-        pod = self.cluster.get("pods", key)
-        if pod is None or pod.metadata.uid != uid:
-            return  # a newer incarnation owns this name now
-        pod.status.exit_code = code
-        pod.status.finish_time = time.time()
-        pod.status.phase = PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
-        try:
-            self.cluster.update("pods", pod)
-        except (ConflictError, KeyError):
-            pass  # pod replaced/deleted while exiting; verdict is moot
+
+        def finished(p):
+            if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                return False  # verdict already recorded (injected failure)
+            p.status.exit_code = code
+            p.status.finish_time = time.time()
+            p.status.phase = (
+                PodPhase.SUCCEEDED if code == 0 else PodPhase.FAILED
+            )
+
+        # conflict-retried: losing this write would leave a completed pod
+        # Running forever and the owning job unfinishable
+        self._update_pod_status(key, uid, finished)
 
     def _kill(self, key: str) -> None:
         with self._mu:

@@ -1,0 +1,38 @@
+// TSan-build-only shim. glibc >= 2.30 implements
+// std::condition_variable::wait_until via pthread_cond_clockwait, which
+// older libtsan runtimes (gcc <= 10) do NOT intercept: TSan then never
+// observes the mutex release inside the wait and reports false "double
+// lock of a mutex" / data races on everything the lock protects.
+//
+// Defining the symbol in the main binary interposes BOTH glibc's version
+// and (on newer toolchains) libtsan's interceptor, and forwards to
+// pthread_cond_timedwait — which every libtsan intercepts — after
+// rebasing a CLOCK_MONOTONIC absolute deadline onto CLOCK_REALTIME.
+// Clock skew during the rebase only shifts a timeout by nanoseconds; the
+// selftest's waits all tolerate that. Linked ONLY into selftest_tsan.
+
+#include <pthread.h>
+#include <time.h>
+
+#include <cstdint>
+
+extern "C" int pthread_cond_clockwait(pthread_cond_t* cond,
+                                      pthread_mutex_t* mu, clockid_t clk,
+                                      const struct timespec* abstime) {
+  struct timespec target = *abstime;
+  if (clk == CLOCK_MONOTONIC) {
+    struct timespec mono, real;
+    clock_gettime(CLOCK_MONOTONIC, &mono);
+    clock_gettime(CLOCK_REALTIME, &real);
+    int64_t delta_ns =
+        (static_cast<int64_t>(abstime->tv_sec) - mono.tv_sec) * 1000000000LL +
+        (abstime->tv_nsec - mono.tv_nsec);
+    if (delta_ns < 0) delta_ns = 0;
+    int64_t tgt_ns =
+        static_cast<int64_t>(real.tv_sec) * 1000000000LL + real.tv_nsec +
+        delta_ns;
+    target.tv_sec = static_cast<time_t>(tgt_ns / 1000000000LL);
+    target.tv_nsec = static_cast<long>(tgt_ns % 1000000000LL);
+  }
+  return pthread_cond_timedwait(cond, mu, &target);
+}

@@ -48,6 +48,17 @@ def render_metrics(platform) -> str:
             ctrl.latency_buckets, counts, total,
         )
 
+    # chaos-drill injection counters (kubeflow_tpu/chaos.py): exported so
+    # recovery behavior is measurable against what was actually injected
+    chaos = getattr(platform, "chaos", None)
+    if chaos is not None:
+        for mname, v in sorted(chaos.metrics.items()):
+            counter(f"kftpu_chaos_{mname}", v)
+        gauge(
+            "kftpu_chaos_plan_seed", chaos.plan.seed,
+            help_="seed of the armed fault plan (reproduce with this)",
+        )
+
     cluster = platform.cluster
     # one TYPE line, then one sample per label — repeated TYPE lines for the
     # same metric are invalid exposition format and fail real scrapes

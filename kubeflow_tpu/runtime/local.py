@@ -19,6 +19,7 @@ from kubeflow_tpu.api.jobs import SUCCESS_REPLICA, TrainJob, REPLICA_CHIEF, REPL
 from kubeflow_tpu.api.validation import validate_job
 from kubeflow_tpu.controller.envcontract import synthesize_env
 from kubeflow_tpu.runtime.rendezvous import LocalResolver
+from kubeflow_tpu.utils.retry import Deadline
 
 
 @dataclass
@@ -78,18 +79,15 @@ class LocalRunner:
                     )
                 procs.append((rtype, i, proc, log_path, time.monotonic()))
 
-        deadline = (
-            time.monotonic() + timeout
-            if timeout is not None
-            else (
-                time.monotonic() + job.spec.run_policy.active_deadline_seconds
-                if job.spec.run_policy.active_deadline_seconds
-                else None
-            )
+        # one shared deadline for the whole gang (utils/retry.Deadline):
+        # explicit timeout wins, else runPolicy.activeDeadlineSeconds
+        deadline = Deadline(
+            timeout if timeout is not None
+            else job.spec.run_policy.active_deadline_seconds or None
         )
         results: list[ReplicaResult] = []
         for rtype, i, proc, log_path, t0 in procs:
-            remaining = None if deadline is None else max(0.1, deadline - time.monotonic())
+            remaining = deadline.remaining(floor=0.1)
             try:
                 code = proc.wait(timeout=remaining)
             except subprocess.TimeoutExpired:

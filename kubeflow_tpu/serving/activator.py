@@ -22,18 +22,33 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from kubeflow_tpu.utils.retry import BackoffPolicy, Deadline, poll_until
+
 #: annotation the activator stamps (epoch seconds) when a request arrives
 #: for a scaled-to-zero service; the controller reads it as demand
 DEMAND_ANNOTATION = "serving.kubeflow-tpu.org/activator-demand"
 
+#: cold-start status polling: fast first checks (sub-second cold starts on
+#: AOT-exported predictors answer immediately), jittered exponential ramp
+#: to a gentle steady poll so a thundering herd of held requests doesn't
+#: hammer the store in lockstep
+COLD_START_POLL = BackoffPolicy(
+    base_s=0.02, max_s=0.3, multiplier=2.0, jitter=0.5
+)
+
 
 class Activator:
     def __init__(self, platform, port: int = 0, host: str = "127.0.0.1",
-                 activation_timeout_s: float = 45.0):
+                 activation_timeout_s: float = 45.0,
+                 retry_after_s: float = 10.0):
         self.platform = platform
         self.host = host
         self.port = port
+        #: explicit per-request deadline: a request held through a cold start
+        #: that exceeds this gets 503 + Retry-After instead of holding the
+        #: connection (and its server thread) forever
         self.activation_timeout_s = activation_timeout_s
+        self.retry_after_s = retry_after_s
         self._httpd: ThreadingHTTPServer | None = None
         self._rr: dict[str, int] = {}
         self._rr_mu = threading.Lock()
@@ -70,28 +85,49 @@ class Activator:
             pass  # deleted mid-request (handle() will 404/503) or hot
             # contention — the endpoint poll below still observes scale-up
 
-    def _await_endpoint(self, key: str) -> str | None:
-        """Hold the request through a cold start: demand is signalled,
-        then the ISVC status is polled until a ready endpoint appears."""
+    def _await_endpoint(self, key: str, deadline: Deadline) -> str | None:
+        """Hold the request through a cold start: demand is signalled, then
+        the ISVC status is polled under the shared jittered-backoff policy
+        until a ready endpoint appears or the request deadline lapses."""
         cluster = self.platform.cluster
-        deadline = time.monotonic() + self.activation_timeout_s
         self._signal_demand(key)
-        while time.monotonic() < deadline:
+        _gone = object()  # service deleted mid-hold: stop early, not timeout
+
+        def probe():
             isvc = cluster.get("inferenceservices", key)
             if isvc is None:
-                return None
-            url = self._pick_endpoint(isvc)
-            if url is not None:
-                return url
-            time.sleep(0.15)
-        return None
+                return _gone
+            return self._pick_endpoint(isvc)
+
+        try:
+            out = poll_until(
+                probe,
+                timeout_s=deadline.remaining(floor=0.0),
+                policy=COLD_START_POLL,
+                describe=f"ready endpoint for {key}",
+            )
+        except TimeoutError:
+            return None
+        return None if out is _gone else out
+
+    def _unavailable(self, msg: str) -> tuple[int, bytes, str, dict]:
+        """503 with an explicit Retry-After: the client re-dials after the
+        hint instead of the activator holding its connection forever."""
+        return (
+            503,
+            f'{{"error": "{msg}"}}'.encode(),
+            "application/json",
+            {"Retry-After": str(int(self.retry_after_s))},
+        )
 
     def handle(self, method: str, path: str, body: bytes | None,
-               content_type: str) -> tuple[int, bytes, str]:
+               content_type: str) -> tuple[int, bytes, str, dict]:
+        """-> (status, payload, content-type, extra headers)."""
+        deadline = Deadline(self.activation_timeout_s)
         parts = path.lstrip("/").split("/", 2)
         if len(parts) < 3:
             return 404, b'{"error": "route is /<namespace>/<name>/<path>"}', \
-                "application/json"
+                "application/json", {}
         ns, name, rest = parts
         key = f"{ns}/{name}"
         isvc = self.platform.cluster.get("inferenceservices", key)
@@ -99,13 +135,14 @@ class Activator:
             with self._rr_mu:  # deleted service: drop its rr counter so a
                 self._rr.pop(key, None)  # long-lived activator never leaks
             return 404, f'{{"error": "inferenceservice {key} not found"}}' \
-                .encode(), "application/json"
+                .encode(), "application/json", {}
         url = self._pick_endpoint(isvc)
         if url is None:
-            url = self._await_endpoint(key)
+            url = self._await_endpoint(key, deadline)
         if url is None:
-            return 503, b'{"error": "activation timed out: no replica became ready"}', \
-                "application/json"
+            return self._unavailable(
+                "activation timed out: no replica became ready"
+            )
 
         def proxy(endpoint: str):
             req = urllib.request.Request(
@@ -115,10 +152,10 @@ class Activator:
             try:
                 with urllib.request.urlopen(req, timeout=60.0) as r:
                     return r.status, r.read(), \
-                        r.headers.get("Content-Type", "application/json")
+                        r.headers.get("Content-Type", "application/json"), {}
             except urllib.error.HTTPError as e:
                 return e.code, e.read(), \
-                    e.headers.get("Content-Type", "application/json")
+                    e.headers.get("Content-Type", "application/json"), {}
             except (urllib.error.URLError, OSError):
                 return None  # transport failure — caller decides
 
@@ -126,14 +163,16 @@ class Activator:
         if out is not None:
             return out
         # replica died between probe and proxy: one retry through the
-        # cold-start wait (self-heal will restore it)
-        retry = self._await_endpoint(key)
+        # cold-start wait, still bounded by the SAME request deadline
+        # (self-heal will restore it)
+        retry = self._await_endpoint(key, deadline)
         if retry is None:
-            return 503, b'{"error": "no ready replica"}', "application/json"
+            return self._unavailable("no ready replica")
         out = proxy(retry)
         if out is not None:
             return out
-        return 502, b'{"error": "replica unreachable"}', "application/json"
+        return 502, b'{"error": "replica unreachable"}', \
+            "application/json", {}
 
     # ----------------------------------------------------------- lifecycle
 
@@ -147,13 +186,15 @@ class Activator:
             def _serve(self, method: str):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else None
-                code, payload, ctype = activator.handle(
+                code, payload, ctype, extra = activator.handle(
                     method, self.path, body,
                     self.headers.get("Content-Type", "application/json"),
                 )
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
+                for name, value in extra.items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(payload)
 

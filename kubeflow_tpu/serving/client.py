@@ -10,12 +10,12 @@ from __future__ import annotations
 
 import itertools
 import json
-import time
 import urllib.error
 import urllib.request
 
 from kubeflow_tpu.serving.api import InferenceService, validate_isvc
 from kubeflow_tpu.serving.controller import ISVC_LABEL
+from kubeflow_tpu.utils.retry import BackoffPolicy, poll_until
 
 
 class ServingClient:
@@ -52,13 +52,16 @@ class ServingClient:
         self, name: str, namespace: str = "default", timeout_s: float = 120.0,
         poll_s: float = 0.2,
     ) -> InferenceService:
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        def ready() -> InferenceService | None:
             isvc = self.get(name, namespace)
-            if isvc is not None and isvc.status.ready:
-                return isvc
-            time.sleep(poll_s)
-        raise TimeoutError(f"inferenceservice {namespace}/{name} not ready in {timeout_s}s")
+            return isvc if isvc is not None and isvc.status.ready else None
+
+        return poll_until(
+            ready,
+            timeout_s=timeout_s,
+            policy=BackoffPolicy(base_s=0.02, max_s=poll_s, jitter=0.5),
+            describe=f"inferenceservice {namespace}/{name} ready",
+        )
 
     # -------------------------------------------------------------- requests
 

@@ -14,10 +14,10 @@ import inspect
 import json
 import sys
 import textwrap
-import time
 from pathlib import Path
 
 from kubeflow_tpu.api.common import ObjectMeta
+from kubeflow_tpu.utils.retry import BackoffPolicy, poll_until
 from kubeflow_tpu.sweep.api import (
     ExperimentCondition,
     AlgorithmSpec,
@@ -133,13 +133,16 @@ class SweepClient:
         self, name: str, namespace: str = "default", timeout_s: float = 300.0,
         poll_s: float = 0.2,
     ) -> Experiment:
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        def finished() -> Experiment | None:
             exp = self.get_experiment(name, namespace)
-            if exp is not None and exp.status.is_finished:
-                return exp
-            time.sleep(poll_s)
-        raise TimeoutError(f"experiment {namespace}/{name} not finished in {timeout_s}s")
+            return exp if exp is not None and exp.status.is_finished else None
+
+        return poll_until(
+            finished,
+            timeout_s=timeout_s,
+            policy=BackoffPolicy(base_s=0.02, max_s=poll_s, jitter=0.5),
+            describe=f"experiment {namespace}/{name} finished",
+        )
 
     def get_optimal_hyperparameters(
         self, name: str, namespace: str = "default"

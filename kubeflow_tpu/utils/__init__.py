@@ -1,5 +1,19 @@
-"""Shared utilities: device selection, logging, timing."""
+"""Shared utilities: device selection, retry/backoff policy, logging."""
 
 from kubeflow_tpu.utils.device import select_device
+from kubeflow_tpu.utils.retry import (
+    BackoffPolicy,
+    Deadline,
+    poll_until,
+    retry_call,
+    with_conflict_retry,
+)
 
-__all__ = ["select_device"]
+__all__ = [
+    "select_device",
+    "BackoffPolicy",
+    "Deadline",
+    "poll_until",
+    "retry_call",
+    "with_conflict_retry",
+]

@@ -1,0 +1,724 @@
+"""Chaos drills: seeded fault injection against the platform's recovery
+contracts (kubeflow_tpu/chaos.py + utils/retry.py).
+
+Each drill arms a deterministic FaultPlan, drives a real workload (live
+controllers, real subprocess pods), and asserts SEMANTIC convergence —
+Succeeded/Ready within a bounded reconcile budget — plus that the injected
+faults actually landed (chaos counters) and that recovery was measurable
+(kftpu_job_jobs_recovered_total & friends through observability.py).
+"""
+
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    ElasticPolicy,
+    JAXJob,
+    JAXJobSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.chaos import (
+    ChaosCheckpointer,
+    ChaosEngine,
+    CheckpointFault,
+    ConflictStorm,
+    EventDelay,
+    FaultPlan,
+    PodKill,
+    StartStall,
+    WatchDrop,
+)
+from kubeflow_tpu.client import Platform, TrainingClient
+from kubeflow_tpu.controller.fakecluster import (
+    EventType,
+    FakeCluster,
+    Pod,
+)
+from kubeflow_tpu.utils.retry import (
+    BackoffPolicy,
+    poll_until,
+    retry_call,
+    with_conflict_retry,
+)
+
+pytestmark = pytest.mark.chaos
+
+#: every drill must converge within this many reconcile passes of the job
+#: controller — the bound that makes "recovers" a checkable claim instead
+#: of "eventually, maybe"
+RECONCILE_BUDGET = 400
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16)
+    with p:
+        yield p
+
+
+@pytest.fixture()
+def client(platform):
+    return TrainingClient(platform)
+
+
+def make_job(tmp_path, name, body, replicas=2, backoff_limit=3, elastic=None):
+    path = tmp_path / f"{name}.py"
+    path.write_text(textwrap.dedent(body))
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        spec=JAXJobSpec(
+            replica_specs={
+                REPLICA_WORKER: ReplicaSpec(
+                    replicas=replicas,
+                    restart_policy=RestartPolicy.ON_FAILURE,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(command=[sys.executable, str(path)])
+                    ),
+                )
+            },
+            run_policy=RunPolicy(
+                backoff_limit=backoff_limit, elastic_policy=elastic
+            ),
+        ),
+    )
+
+
+MARKER_WAITER = """
+import os, time
+while not os.path.exists({marker!r}):
+    time.sleep(0.03)
+print("world", os.environ["JAX_NUM_PROCESSES"],
+      "rank", os.environ["JAX_PROCESS_ID"], flush=True)
+"""
+
+
+# --------------------------------------------------------------- fault plans
+
+
+class TestFaultPlanDeterminism:
+    def test_same_seed_byte_for_byte(self):
+        a, b = FaultPlan.from_seed(1234), FaultPlan.from_seed(1234)
+        assert a == b
+        assert a.describe() == b.describe()
+        assert a.digest() == b.digest()
+        # describe() round-trips stably however many times it's rendered
+        assert a.describe() == FaultPlan.from_seed(1234).describe()
+
+    def test_different_seeds_differ(self):
+        assert FaultPlan.from_seed(1).describe() != FaultPlan.from_seed(2).describe()
+        assert FaultPlan.from_seed(1).digest() != FaultPlan.from_seed(2).digest()
+
+    def test_profiles_scope_the_layers(self):
+        api = FaultPlan.from_seed(7, profile="apiserver")
+        assert api.conflict_storms and not api.pod_kills
+        assert api.checkpoint is None
+        pods = FaultPlan.from_seed(7, profile="pods")
+        assert pods.pod_kills and not pods.conflict_storms
+        storage = FaultPlan.from_seed(7, profile="storage")
+        assert storage.checkpoint is not None and not storage.pod_kills
+        with pytest.raises(ValueError, match="unknown chaos profile"):
+            FaultPlan.from_seed(7, profile="nope")
+
+    def test_describe_names_every_armed_fault(self):
+        text = FaultPlan.from_seed(42).describe()
+        for label in ("conflict-storm", "watch-drop", "event-delay",
+                      "pod-kill", "start-stall", "checkpoint"):
+            assert label in text
+        assert text.startswith("fault-plan seed=42")
+
+
+# -------------------------------------------------------------- retry policy
+
+
+class TestRetryPolicy:
+    def test_seeded_rng_makes_delays_reproducible(self):
+        import random
+
+        pol = BackoffPolicy(base_s=0.01, max_s=1.0)
+        a = [pol.delay_for(i, random.Random(5)) for i in range(6)]
+        b = [pol.delay_for(i, random.Random(5)) for i in range(6)]
+        assert a == b
+        # un-jittered caps ramp exponentially and saturate
+        caps = [pol.cap_for(i) for i in range(12)]
+        assert caps[0] == 0.01 and caps[-1] == 1.0
+        assert caps == sorted(caps)
+
+    def test_retry_call_reraises_after_budget(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise ValueError("always")
+
+        with pytest.raises(ValueError, match="always"):
+            retry_call(
+                boom,
+                policy=BackoffPolicy(base_s=0.001, max_s=0.002, max_attempts=4),
+                retry_on=(ValueError,),
+            )
+        assert len(calls) == 4
+
+    def test_retry_call_recovers(self):
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise ValueError("transient")
+            return "ok"
+
+        assert retry_call(
+            flaky,
+            policy=BackoffPolicy(base_s=0.001, max_s=0.002, max_attempts=10),
+            retry_on=(ValueError,),
+        ) == "ok"
+
+    def test_retry_call_deadline_budget(self):
+        """deadline_s bounds total retry time: the call gives up (re-raising
+        the real failure) once the next sleep would overshoot it."""
+        calls = []
+
+        def boom():
+            calls.append(time.monotonic())
+            raise ValueError("still down")
+
+        t0 = time.monotonic()
+        with pytest.raises(ValueError, match="still down"):
+            retry_call(
+                boom,
+                policy=BackoffPolicy(
+                    base_s=0.05, max_s=0.05, jitter=0.0, deadline_s=0.2
+                ),
+                retry_on=(ValueError,),
+            )
+        assert time.monotonic() - t0 < 2.0
+        assert 2 <= len(calls) <= 6  # retried some, then the deadline won
+
+    def test_poll_until_timeout_and_success(self):
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="thing"):
+            poll_until(
+                lambda: None, timeout_s=0.15,
+                policy=BackoffPolicy(base_s=0.01, max_s=0.02),
+                describe="thing",
+            )
+        assert time.monotonic() - t0 < 5.0
+        flag = {"at": time.monotonic() + 0.1}
+        out = poll_until(
+            lambda: "done" if time.monotonic() >= flag["at"] else None,
+            timeout_s=5.0,
+            policy=BackoffPolicy(base_s=0.01, max_s=0.02),
+        )
+        assert out == "done"
+
+    def test_with_conflict_retry_against_live_writer(self):
+        """An RMW caller converges even when every attempt races a writer
+        that bumps the resource_version between read and write."""
+        cluster = FakeCluster()
+        cluster.create("pods", Pod(metadata=ObjectMeta(name="contended")))
+
+        races = {"left": 3}
+
+        def mutate_with_contention():
+            obj = cluster.get("pods", "default/contended", copy_obj=True)
+            if races["left"] > 0:
+                races["left"] -= 1
+                # a competing writer lands first -> our update must conflict
+                cluster.read_modify_write(
+                    "pods", "default/contended", lambda p: None
+                )
+            obj.env["winner"] = "rmw"
+            return cluster.update("pods", obj)
+
+        with_conflict_retry(mutate_with_contention)
+        assert cluster.get("pods", "default/contended").env["winner"] == "rmw"
+        assert races["left"] == 0
+
+
+# ---------------------------------------------------- watch overflow / relist
+
+
+class TestWatchOverflowRelist:
+    def test_slow_subscriber_gets_full_added_relist(self):
+        """A subscriber that falls behind WATCH_CAPACITY events recovers via
+        a complete ADDED relist of current state (informer 'resourceVersion
+        expired' semantics), then resumes the live tail."""
+
+        class SmallCluster(FakeCluster):
+            WATCH_CAPACITY = 64
+
+        cluster = SmallCluster()
+        for i in range(5):
+            cluster.create("pods", Pod(metadata=ObjectMeta(name=f"p{i}")))
+        sub = cluster.watch(replay=False)
+        # overflow the subscription without polling it
+        for _ in range(SmallCluster.WATCH_CAPACITY * 3):
+            cluster.read_modify_write("pods", "default/p0", lambda p: None)
+
+        seen = []
+        while True:
+            try:
+                seen.append(sub.get(timeout=0.0))
+            except Exception:  # queue.Empty
+                break
+        assert seen, "overflowed subscriber delivered nothing"
+        assert all(etype == EventType.ADDED for etype, _, _ in seen)
+        assert sorted(obj.key for _, _, obj in seen) == [
+            f"default/p{i}" for i in range(5)
+        ]
+
+        # stream resumes live after the relist
+        cluster.read_modify_write("pods", "default/p3", lambda p: None)
+        etype, kind, obj = sub.get(timeout=1.0)
+        assert (etype, kind, obj.key) == (
+            EventType.MODIFIED, "pods", "default/p3"
+        )
+        sub.close()
+
+    def test_reconciler_converges_after_forced_relists(
+        self, platform, client, tmp_path
+    ):
+        """Injected watch drops (the same _relist_locked path an overflow
+        takes) hit every live subscription mid-job; the level-triggered
+        reconcilers must converge regardless."""
+        plan = FaultPlan(
+            seed=11,
+            watch_drops=(WatchDrop(every_n=10, count=6),),
+            event_delays=(EventDelay(rate=0.2, delay_s=0.01, count=20),),
+        )
+        with ChaosEngine(plan).attach(platform) as engine:
+            job = make_job(tmp_path, "relistjob", "print('fine')", replicas=2)
+            client.create_job(job)
+            done = client.wait_for_job_conditions("relistjob", timeout_s=60)
+            assert done.status.has_condition(JobConditionType.SUCCEEDED)
+            assert engine.metrics["watch_drops_total"] > 0
+
+
+# ------------------------------------------------------------------- drills
+
+
+class TestGangRestartDrill:
+    def test_kill_under_apiserver_chaos_recovers_within_budget(
+        self, platform, client, tmp_path
+    ):
+        """Worker loss + conflict storm + watch chaos: the gang restarts
+        once, every status write survives the storm (no pod stuck in a
+        stale phase), and the job converges inside the reconcile budget."""
+        marker = tmp_path / "go"
+        plan = FaultPlan(
+            seed=2024,
+            conflict_storms=(
+                ConflictStorm("jobs", rate=0.4, count=6),
+                ConflictStorm("pods", rate=0.3, count=6),
+            ),
+            watch_drops=(WatchDrop(every_n=25, count=3),),
+            pod_kills=(
+                PodKill("ganggrill-worker-1", after_running_s=0.3, times=1),
+            ),
+            start_stalls=(StartStall("ganggrill-*", delay_s=0.15, count=1),),
+        )
+        engine = ChaosEngine(plan).attach(platform)
+        try:
+            job = make_job(
+                tmp_path, "ganggrill",
+                MARKER_WAITER.format(marker=str(marker)), replicas=2,
+            )
+            client.create_job(job)
+            # hold the workers until the injected kill has landed and the
+            # gang actually restarted
+            restarted = poll_until(
+                lambda: (
+                    (j := client.get_job("ganggrill")) is not None
+                    and j.status.restart_count >= 1
+                ) or None,
+                timeout_s=30.0,
+                describe="gang restart observed",
+            )
+            assert restarted
+            marker.write_text("go")
+            done = client.wait_for_job_conditions("ganggrill", timeout_s=60)
+        finally:
+            engine.detach()
+        assert done.status.has_condition(JobConditionType.SUCCEEDED), (
+            done.status.conditions
+        )
+        assert done.status.restart_count == 1
+        assert done.status.replica_statuses[REPLICA_WORKER].succeeded == 2
+        # the faults actually landed
+        assert engine.metrics["pod_kills_total"] == 1
+        assert engine.metrics["conflicts_injected_total"] > 0
+        assert engine.metrics["start_stalls_total"] == 1
+        # bounded convergence, and measurable recovery
+        jm = platform.controller.metrics
+        assert jm["reconcile_total"] <= RECONCILE_BUDGET, jm["reconcile_total"]
+        assert jm["jobs_recovered_total"] == 1
+        assert jm["recovery_restarts_consumed_total"] == 1
+        assert jm["recovery_reconcile_passes_total"] >= 1
+        assert any(
+            e.reason == "GangRestart"
+            for e in platform.cluster.events_for("default/ganggrill")
+        )
+
+    def test_nonretryable_injected_exit_fails_permanently(
+        self, platform, client, tmp_path
+    ):
+        """signal=0 kills mark the pod Failed with a sub-128 exit code; under
+        RestartPolicy.EXIT_CODE that must consume ZERO restarts."""
+        marker = tmp_path / "go"  # never written: pod must die by injection
+        plan = FaultPlan(
+            seed=31,
+            pod_kills=(
+                PodKill("permfail-worker-0", after_running_s=0.2,
+                        signal=0, exit_code=3, times=1),
+            ),
+        )
+        job = make_job(
+            tmp_path, "permfail",
+            MARKER_WAITER.format(marker=str(marker)), replicas=1,
+        )
+        job.spec.replica_specs[REPLICA_WORKER].restart_policy = (
+            RestartPolicy.EXIT_CODE
+        )
+        with ChaosEngine(plan).attach(platform) as engine:
+            client.create_job(job)
+            done = client.wait_for_job_conditions("permfail", timeout_s=60)
+            assert done.status.is_failed
+            assert done.status.restart_count == 0
+            cond = done.status.condition(JobConditionType.FAILED)
+            assert cond.reason == "NonRetryableExit"
+            assert engine.metrics["pod_failures_injected_total"] == 1
+
+    def test_signal_death_normalizes_to_retryable_exit_code(
+        self, platform, client, tmp_path
+    ):
+        """A SIGKILLed worker reports 137 (128+9): retryable under
+        RestartPolicy.EXIT_CODE, exactly like the kubelet reports it."""
+        marker = tmp_path / "go"
+        plan = FaultPlan(
+            seed=32,
+            pod_kills=(
+                PodKill("sigjob-worker-0", after_running_s=0.25, times=1),
+            ),
+        )
+        job = make_job(
+            tmp_path, "sigjob",
+            MARKER_WAITER.format(marker=str(marker)), replicas=1,
+        )
+        job.spec.replica_specs[REPLICA_WORKER].restart_policy = (
+            RestartPolicy.EXIT_CODE
+        )
+        with ChaosEngine(plan).attach(platform):
+            client.create_job(job)
+            poll_until(
+                lambda: (
+                    (j := client.get_job("sigjob")) is not None
+                    and j.status.restart_count >= 1
+                ) or None,
+                timeout_s=30.0,
+                describe="retryable signal restart",
+            )
+            marker.write_text("go")
+            done = client.wait_for_job_conditions("sigjob", timeout_s=60)
+        assert done.status.has_condition(JobConditionType.SUCCEEDED)
+        assert done.status.restart_count == 1
+
+
+class TestElasticRemeshDrill:
+    def test_scale_up_under_conflict_storm(self, platform, client, tmp_path):
+        """Elastic re-mesh while the apiserver throws 409 bursts at every
+        layer: the SDK's scale lands (conflict-retried RMW), the gang
+        re-meshes to the new world size, and converges."""
+        marker = tmp_path / "go"
+        plan = FaultPlan(
+            seed=555,
+            conflict_storms=(
+                ConflictStorm("jobs", rate=0.5, count=8),
+                ConflictStorm("pods", rate=0.3, count=8),
+            ),
+            event_delays=(EventDelay(rate=0.15, delay_s=0.02, count=30),),
+        )
+        engine = ChaosEngine(plan).attach(platform)
+        try:
+            job = make_job(
+                tmp_path, "stormscale",
+                MARKER_WAITER.format(marker=str(marker)), replicas=2,
+                elastic=ElasticPolicy(min_replicas=1, max_replicas=8),
+            )
+            client.create_job(job)
+            poll_until(
+                lambda: (
+                    (j := client.get_job("stormscale")) is not None
+                    and (rs := j.status.replica_statuses.get(REPLICA_WORKER))
+                    and rs.active == 2
+                ) or None,
+                timeout_s=30.0,
+                describe="2 workers running",
+            )
+            client.scale_job("stormscale", 4)
+            poll_until(
+                lambda: (
+                    (j := client.get_job("stormscale")) is not None
+                    and (rs := j.status.replica_statuses.get(REPLICA_WORKER))
+                    and rs.active == 4
+                ) or None,
+                timeout_s=30.0,
+                describe="4 workers running post-remesh",
+            )
+            marker.write_text("go")
+            done = client.wait_for_job_conditions("stormscale", timeout_s=60)
+        finally:
+            engine.detach()
+        assert done.status.has_condition(JobConditionType.SUCCEEDED)
+        assert done.status.replica_statuses[REPLICA_WORKER].succeeded == 4
+        assert engine.metrics["conflicts_injected_total"] > 0
+        assert any(
+            e.reason == "ElasticRemesh"
+            for e in platform.cluster.events_for("default/stormscale")
+        )
+        for i in range(4):
+            assert "world 4" in client.get_job_logs("stormscale", index=i)
+        assert platform.controller.metrics["reconcile_total"] <= RECONCILE_BUDGET
+
+
+class TestScaleFromZeroDrill:
+    def test_cold_start_under_conflict_storm(self, platform):
+        """Scale-from-zero through the activator while ISVC writes face a
+        conflict storm: the held request still answers correctly."""
+        import json
+        import urllib.request
+
+        from kubeflow_tpu.serving import ServingClient
+        from kubeflow_tpu.serving.api import (
+            AutoscalingSpec,
+            InferenceService,
+            InferenceServiceSpec,
+            PredictorRuntime,
+            PredictorSpec,
+        )
+
+        serving = ServingClient(platform)
+        serving.create(InferenceService(
+            metadata=ObjectMeta(name="chaos-zero"),
+            spec=InferenceServiceSpec(
+                predictor=PredictorSpec(
+                    runtime=PredictorRuntime.CUSTOM,
+                    model_class="tests.serving_fixtures:DoubleModel",
+                    replicas=1,
+                ),
+                autoscaling=AutoscalingSpec(
+                    min_replicas=0, max_replicas=2,
+                    target_qps_per_replica=1000.0,
+                    scale_interval_s=0.3,
+                    scale_to_zero_grace_s=1.5,
+                ),
+            ),
+        ))
+        serving.wait_ready("chaos-zero", timeout_s=60)
+        url = platform.start_activator()
+
+        # idle past the grace -> reaped to zero
+        poll_until(
+            lambda: (
+                (isvc := serving.get("chaos-zero")) is not None
+                and isvc.spec.predictor.replicas == 0
+                and isvc.status.replicas_ready == 0
+            ) or None,
+            timeout_s=45.0,
+            describe="scaled to zero",
+        )
+
+        plan = FaultPlan(
+            seed=909,
+            conflict_storms=(
+                ConflictStorm("inferenceservices", rate=0.5, count=6),
+            ),
+        )
+        with ChaosEngine(plan).attach(platform) as engine:
+            req = urllib.request.Request(
+                f"{url}/default/chaos-zero/v1/models/chaos-zero:predict",
+                data=json.dumps({"instances": [[3.0]]}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert json.loads(r.read())["predictions"] == [[6.0]]
+        assert serving.get("chaos-zero").spec.predictor.replicas >= 1
+        # the storm was real (demand stamp + scale-up writes got 409s)
+        assert engine.metrics["conflicts_injected_total"] > 0
+
+    def test_activation_deadline_returns_503_with_retry_after(self):
+        """A service that can never become ready must get a bounded 503 +
+        Retry-After, not an indefinitely held connection."""
+        from types import SimpleNamespace
+
+        from kubeflow_tpu.serving.activator import Activator
+        from kubeflow_tpu.serving.api import (
+            InferenceService,
+            InferenceServiceSpec,
+            PredictorRuntime,
+            PredictorSpec,
+        )
+
+        cluster = FakeCluster()  # no controllers: cold start can't finish
+        cluster.create("inferenceservices", InferenceService(
+            metadata=ObjectMeta(name="stuck"),
+            spec=InferenceServiceSpec(
+                predictor=PredictorSpec(
+                    runtime=PredictorRuntime.CUSTOM,
+                    model_class="tests.serving_fixtures:DoubleModel",
+                ),
+            ),
+        ))
+        act = Activator(
+            SimpleNamespace(cluster=cluster),
+            activation_timeout_s=0.4, retry_after_s=7.0,
+        )
+        t0 = time.monotonic()
+        code, payload, ctype, headers = act.handle(
+            "POST", "/default/stuck/v1/models/stuck:predict", b"{}",
+            "application/json",
+        )
+        held = time.monotonic() - t0
+        assert code == 503
+        assert headers == {"Retry-After": "7"}
+        assert b"error" in payload
+        assert 0.3 <= held < 5.0, held  # deadline bounded the hold
+        # demand WAS signalled before giving up (scale-from-zero trigger)
+        from kubeflow_tpu.serving.activator import DEMAND_ANNOTATION
+
+        stamped = cluster.get("inferenceservices", "default/stuck")
+        assert DEMAND_ANNOTATION in stamped.metadata.annotations
+
+
+class TestCheckpointResumeDrill:
+    def test_resume_past_killed_step_under_chaos(
+        self, platform, client, tmp_path
+    ):
+        """File-checkpointing worker killed mid-run by the plan; the
+        restarted gang resumes from the last checkpoint, not step 0."""
+        ckpt = tmp_path / "ckpt"
+        plan = FaultPlan(
+            seed=77,
+            conflict_storms=(ConflictStorm("pods", rate=0.3, count=5),),
+            pod_kills=(
+                PodKill("chaosresume-worker-0", after_running_s=0.8, times=1),
+            ),
+        )
+        job = make_job(
+            tmp_path,
+            "chaosresume",
+            f"""
+            import os, time
+            ckpt, total = {str(ckpt)!r}, 60
+            start = int(open(ckpt).read()) if os.path.exists(ckpt) else 0
+            print("start_step", start, flush=True)
+            for step in range(start, total):
+                time.sleep(0.03)
+                with open(ckpt + ".tmp", "w") as f:
+                    f.write(str(step + 1))
+                os.replace(ckpt + ".tmp", ckpt)
+            print("final_step", total)
+            """,
+            replicas=1,
+        )
+        with ChaosEngine(plan).attach(platform) as engine:
+            client.create_job(job)
+            done = client.wait_for_job_conditions("chaosresume", timeout_s=90)
+        assert done.status.has_condition(JobConditionType.SUCCEEDED)
+        assert done.status.restart_count >= 1
+        assert engine.metrics["pod_kills_total"] == 1
+        log = client.get_job_logs("chaosresume")
+        resumed_starts = [
+            int(line.split()[1])
+            for line in log.splitlines()
+            if line.startswith("start_step")
+        ]
+        assert resumed_starts and resumed_starts[-1] > 0, log
+        assert "final_step 60" in log
+        assert platform.controller.metrics["reconcile_total"] <= RECONCILE_BUDGET
+
+    def test_torn_and_slow_saves_never_corrupt_restore(self, tmp_path):
+        """ChaosCheckpointer over the real orbax-backed Checkpointer: slow
+        saves only delay; torn saves never become visible, so restore_latest
+        always serves a complete earlier step."""
+        from kubeflow_tpu.train.checkpoint import Checkpointer
+
+        plan = FaultPlan(
+            seed=13,
+            checkpoint=CheckpointFault(save_delay_s=0.01, torn_every_n=2),
+        )
+        engine = ChaosEngine(plan)
+        inner = Checkpointer(
+            str(tmp_path / "ckpt"), max_to_keep=8, async_save=False
+        )
+        ck = ChaosCheckpointer(inner, engine)
+        state = {"x": np.arange(4, dtype=np.float32)}
+        try:
+            for step in (1, 2, 3, 4):  # 2 and 4 are torn (every 2nd)
+                ck.save(step, {"x": state["x"] * step})
+            assert ck.latest_step() == 3
+            restored_step, restored = ck.restore_latest(state)
+            assert restored_step == 3
+            np.testing.assert_allclose(restored["x"], state["x"] * 3)
+        finally:
+            inner.close()
+        assert engine.metrics["ckpt_saves_torn_total"] == 2
+        assert engine.metrics["ckpt_saves_delayed_total"] == 4
+
+
+# ------------------------------------------------------------ observability
+
+
+class TestDrillObservability:
+    def test_chaos_and_recovery_counters_exported(
+        self, platform, client, tmp_path
+    ):
+        """Smoke: after a drill, /metrics carries both what was injected
+        (kftpu_chaos_*) and what recovery cost (kftpu_job_recovery_*)."""
+        from kubeflow_tpu.observability import render_metrics
+
+        marker = tmp_path / "go"
+        plan = FaultPlan(
+            seed=888,
+            pod_kills=(
+                PodKill("obsjob-worker-0", after_running_s=0.25, times=1),
+            ),
+        )
+        with ChaosEngine(plan).attach(platform):
+            job = make_job(
+                tmp_path, "obsjob",
+                MARKER_WAITER.format(marker=str(marker)), replicas=1,
+            )
+            client.create_job(job)
+            poll_until(
+                lambda: (
+                    (j := client.get_job("obsjob")) is not None
+                    and j.status.restart_count >= 1
+                ) or None,
+                timeout_s=30.0,
+                describe="restart observed",
+            )
+            marker.write_text("go")
+            done = client.wait_for_job_conditions("obsjob", timeout_s=60)
+            assert done.status.has_condition(JobConditionType.SUCCEEDED)
+            text = render_metrics(platform)
+        assert "kftpu_chaos_pod_kills_total 1" in text
+        assert "kftpu_chaos_plan_seed 888" in text
+        assert "kftpu_job_jobs_recovered_total 1" in text
+        assert "kftpu_job_recovery_restarts_consumed_total 1" in text
+        # passes-to-recovery is a real, positive measurement
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("kftpu_job_recovery_reconcile_passes_total")
+        )
+        assert int(line.split()[-1]) >= 1
