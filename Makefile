@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test test-chaos selftest-sanitizers native
+.PHONY: test test-chaos test-trace selftest-sanitizers native
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -12,6 +12,11 @@ test:
 # recovery drills only (seeded fault injection — docs/chaos.md)
 test-chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_drills.py -q -m chaos
+
+# tracing + flight-recorder suite, incl. the gang-restart trace drill
+# (docs/observability.md)
+test-trace:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q -m trace
 
 native:
 	$(MAKE) -C $(NATIVE)

@@ -292,6 +292,19 @@ class PlatformServer:
             from kubeflow_tpu.observability import render_metrics
 
             return 200, render_metrics(self.platform)  # raw text
+        if parsed.path == "/debug/trace":
+            # flight-recorder dump: text span tree by default,
+            # ?format=chrome for the Perfetto-loadable trace-event JSON
+            tracer = getattr(self.platform, "tracer", None)
+            if tracer is None:
+                return 404, {"error": "tracing is not enabled "
+                                      "(Platform.start_tracing)"}
+            from kubeflow_tpu.tracing import render_span_tree, to_chrome_trace
+
+            spans = tracer.snapshot()
+            if query.get("format") == "chrome":
+                return 200, to_chrome_trace(spans, service=tracer.service)
+            return 200, render_span_tree(spans)  # raw text
         if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
             return 404, {"error": f"no route {parsed.path!r}"}
         kind = parts[2]
@@ -605,9 +618,11 @@ class PlatformServer:
     # -------------------------------------------------------------- watch
 
     def stream_watch(self, wfile, kind: str, query: dict,
-                     user: str = "") -> None:
+                     user: str = "", request_id: str = "") -> None:
         """Write an NDJSON watch stream for one kind until timeout/disconnect.
-        Identified callers only see namespaces kfam lets them read."""
+        Identified callers only see namespaces kfam lets them read. Every
+        event line carries the stream's requestId (the trace-context
+        carrier), so a client can attribute events to its own watch call."""
         import queue as queue_mod
         import time
 
@@ -642,11 +657,14 @@ class PlatformServer:
                     continue
                 if ekind != kind or not want(obj):
                     continue
-                line = json.dumps({
+                record = {
                     "type": etype.name
                     if hasattr(etype, "name") else str(etype),
                     "object": _serialize(kind, obj),
-                }) + "\n"
+                }
+                if request_id:
+                    record["requestId"] = request_id
+                line = json.dumps(record) + "\n"
                 wfile.write(line.encode())
                 wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
@@ -664,6 +682,16 @@ class PlatformServer:
                 pass
 
             def _dispatch(self, method):
+                # every request gets an id (assigned here when the caller
+                # didn't send one) — echoed on ALL responses and error
+                # bodies, and stamped onto the request's trace span: this
+                # is the trace-context carrier across the HTTP boundary
+                rid = self.headers.get("X-Request-Id", "")
+                if not rid:
+                    import uuid
+
+                    rid = uuid.uuid4().hex[:16]
+                self._request_id = rid
                 # watch requests stream — they never go through _reply
                 parsed = urllib.parse.urlparse(self.path)
                 query = dict(urllib.parse.parse_qsl(parsed.query))
@@ -682,11 +710,18 @@ class PlatformServer:
                     self.send_header("Content-Type", "application/x-ndjson")
                     self.send_header("Transfer-Encoding", "identity")
                     self.send_header("Connection", "close")
+                    self.send_header("X-Request-Id", rid)
                     self.end_headers()
-                    server.stream_watch(
-                        self.wfile, kind, query,
-                        user=self.headers.get("kubeflow-userid", ""),
-                    )
+                    from kubeflow_tpu.tracing import tracer_of
+
+                    with tracer_of(server.platform).span(
+                        "http.watch", kind=kind, request_id=rid,
+                    ):
+                        server.stream_watch(
+                            self.wfile, kind, query,
+                            user=self.headers.get("kubeflow-userid", ""),
+                            request_id=rid,
+                        )
                     return
                 if (
                     method == "GET"
@@ -731,6 +766,7 @@ class PlatformServer:
                                      "text/plain; charset=utf-8")
                     self.send_header("Transfer-Encoding", "identity")
                     self.send_header("Connection", "close")
+                    self.send_header("X-Request-Id", rid)
                     self.end_headers()
                     server.stream_logs(self.wfile, parts[3], parts[4],
                                        pod_name, timeout_s)
@@ -746,18 +782,32 @@ class PlatformServer:
                     except json.JSONDecodeError as exc:
                         self._reply(400, {"error": f"bad json: {exc}"})
                         return
-                try:
-                    code, payload = server.handle(
-                        method, self.path, body,
-                        user=self.headers.get("kubeflow-userid", ""),
-                    )
-                except ConflictError as exc:
-                    code, payload = 409, {"error": str(exc)}
-                except Exception as exc:  # noqa: BLE001 — surface as 500
-                    code, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}
+                # the span makes every cluster write this request performs
+                # carry the request's context: downstream watch deliveries
+                # and reconcile passes parent-link back to this API call
+                from kubeflow_tpu.tracing import tracer_of
+
+                with tracer_of(server.platform).span(
+                    "http.request", method=method, path=self.path,
+                    request_id=self._request_id,
+                ) as sp:
+                    try:
+                        code, payload = server.handle(
+                            method, self.path, body,
+                            user=self.headers.get("kubeflow-userid", ""),
+                        )
+                    except ConflictError as exc:
+                        code, payload = 409, {"error": str(exc)}
+                    except Exception as exc:  # noqa: BLE001 — surface as 500
+                        code, payload = 500, {
+                            "error": f"{type(exc).__name__}: {exc}"}
+                    sp.set_attribute("status", code)
                 self._reply(code, payload)
 
             def _reply(self, code, payload):
+                rid = getattr(self, "_request_id", "")
+                if (rid and isinstance(payload, dict) and "error" in payload):
+                    payload.setdefault("requestId", rid)
                 if isinstance(payload, _Asset):
                     data, ctype = payload
                 elif isinstance(payload, _Html):
@@ -769,6 +819,8 @@ class PlatformServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                if rid:
+                    self.send_header("X-Request-Id", rid)
                 self.end_headers()
                 self.wfile.write(data)
 

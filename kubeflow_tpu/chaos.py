@@ -241,6 +241,12 @@ class ChaosEngine:
         self._stop = threading.Event()
         self._killer: threading.Thread | None = None
 
+    def _tracer(self):
+        """The attached cluster's tracer (None when tracing is off): every
+        injection lands as an annotated span/event in the SAME timeline the
+        recovery unfolds in, so fault cause and recovery cost co-render."""
+        return self._cluster.tracer if self._cluster is not None else None
+
     # ----------------------------------------------------------- lifecycle
 
     def attach(self, platform=None, cluster=None, pod_runtime=None) -> "ChaosEngine":
@@ -314,6 +320,12 @@ class ChaosEngine:
                     continue
                 self._storm_budget[id(storm)] -= 1
                 self.metrics["conflicts_injected_total"] += 1
+                tracer = self._tracer()
+                if tracer is not None:
+                    # inherits the writer's current span (e.g. the reconcile
+                    # pass whose update this 409 is about to reject)
+                    tracer.event("chaos.conflict", kind=kind, key=key,
+                                 seed=self.plan.seed)
                 raise ConflictError(
                     f"chaos[seed={self.plan.seed}]: injected conflict on "
                     f"{kind} {key}"
@@ -328,6 +340,10 @@ class ChaosEngine:
                 if self._drop_budget.get(id(d), 0) > 0 and n % d.every_n == 0:
                     self._drop_budget[id(d)] -= 1
                     self.metrics["watch_drops_total"] += 1
+                    tracer = self._tracer()
+                    if tracer is not None:
+                        tracer.event("chaos.watch_drop", parent=None,
+                                     sub=sub_id, seed=self.plan.seed)
                     return "drop"
             for d in self.plan.event_delays:
                 if (
@@ -356,6 +372,11 @@ class ChaosEngine:
                 delay = s.delay_s
                 break
         if delay is not None:
+            tracer = self._tracer()
+            if tracer is not None:
+                # inherits the pod.launch span: the stall shows inside it
+                tracer.event("chaos.start_stall", pod=pod.metadata.name,
+                             delay_s=delay, seed=self.plan.seed)
             time.sleep(delay)
 
     def _kill_loop(self) -> None:
@@ -407,6 +428,21 @@ class ChaosEngine:
 
     def _fire_kill(self, pod, spec: PodKill) -> bool:
         """Returns True only when the fault actually landed."""
+        tracer = self._tracer()
+        if tracer is None:
+            return self._fire_kill_inner(pod, spec)
+        # a root span: the kill STARTS a causal chain (kill -> pod.exit ->
+        # watch -> reconcile -> rebind ...); inject_kill records this
+        # context so the runtime's reap parent-links the exit to it
+        with tracer.span("chaos.pod_kill", parent=None, pod=pod.key,
+                         uid=pod.metadata.uid, signal=spec.signal,
+                         exit_code=spec.exit_code,
+                         seed=self.plan.seed) as sp:
+            landed = self._fire_kill_inner(pod, spec)
+            sp.set_attribute("landed", landed)
+            return landed
+
+    def _fire_kill_inner(self, pod, spec: PodKill) -> bool:
         if spec.signal:
             if self._runtime.inject_kill(pod.key, spec.signal):
                 with self._mu:
@@ -425,6 +461,16 @@ class ChaosEngine:
             cur.status.exit_code = code
             cur.status.finish_time = time.time()
             cur.status.message = f"chaos[seed={self.plan.seed}]: injected failure"
+            if self._tracer() is not None:
+                from kubeflow_tpu.tracing import (
+                    CARRIER_ANNOTATION,
+                    current_context,
+                )
+
+                ctx = current_context()  # the chaos.pod_kill span
+                if ctx is not None:
+                    cur.metadata.annotations[CARRIER_ANNOTATION] = \
+                        ctx.to_header()
             return self._cluster.update("pods", cur)
 
         try:

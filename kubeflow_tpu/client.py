@@ -68,6 +68,7 @@ class Platform:
         self.autoscaler = TrainingAutoscaler(self.cluster, self.gang_scheduler)
         self.metrics_server = None  # started on demand
         self.activator = None  # started on demand (serverless front door)
+        self.tracer = None  # enabled on demand (start_tracing)
         # single registry: observability iterates THIS, so a new controller
         # can never silently fall out of /metrics
         self.controllers = {
@@ -90,6 +91,36 @@ class Platform:
         if self.metrics_server is None:
             self.metrics_server = MetricsServer(self, port=port).start()
         return self.metrics_server.url
+
+    def start_tracing(self, capacity: int = 4096, trace_dir: str = ""):
+        """Arm span tracing + the flight recorder (docs/observability.md).
+
+        Every layer (apiserver, controllers, gang scheduler, pod runtime,
+        activator, chaos engine) starts emitting spans into one bounded
+        in-memory ring; span counters join /metrics as kftpu_trace_*.
+        `trace_dir`, when set, also rides the pod env contract so worker
+        processes flush their own spans there for merged export
+        (tracing.export_merged_trace). Returns the Tracer."""
+        from kubeflow_tpu.tracing import Tracer
+
+        if self.tracer is None:
+            self.tracer = Tracer(capacity=capacity, trace_dir=trace_dir,
+                                 service="platform")
+        self.tracer.armed = True
+        self.cluster.tracer = self.tracer  # (re-)arm every layer
+        return self.tracer
+
+    def stop_tracing(self) -> None:
+        """Freeze span EMISSION everywhere — detach from the cluster AND
+        disarm the tracer itself (the apiserver/activator reach it via
+        `platform.tracer`, so detaching alone would let HTTP spans keep
+        evicting the captured ring). The recorded ring stays on
+        `self.tracer`: /debug/trace, /metrics kftpu_trace_*, and snapshot
+        exports keep serving exactly what was captured; reading a trace
+        never mutates it. start_tracing() re-arms the same recorder."""
+        self.cluster.tracer = None
+        if self.tracer is not None:
+            self.tracer.armed = False
 
     def start_activator(self, port: int = 0,
                         host: str = "127.0.0.1") -> str:

@@ -22,6 +22,7 @@ from typing import Iterable
 
 from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
 from kubeflow_tpu.native import ReconcileDriver, WorkQueue
+from kubeflow_tpu.tracing import consume_delivered_context
 
 
 class ControllerBase:
@@ -56,6 +57,11 @@ class ControllerBase:
         self.latency_counts = [0] * (len(self.latency_buckets) + 1)
         self.latency_sum = 0.0
         self._latency_mu = threading.Lock()
+        #: key -> SpanContext of the watch event that (last) enqueued it —
+        #: the reconcile span's parent link. Only populated while a tracer
+        #: is attached to the cluster; single writer (the informer thread),
+        #: readers pop under the GIL.
+        self._trigger_ctx: dict[str, object] = {}
 
     # ------------------------------------------------------ subclass hooks
 
@@ -119,9 +125,15 @@ class ControllerBase:
                 etype, kind, obj = q.get(timeout=0.2)
             except Exception:  # queue.Empty only
                 continue
+            ctx = (consume_delivered_context()
+                   if self.cluster.tracer is not None else None)
             self.observe_event(etype, kind, obj)
             key = self.kind_filter(etype, kind, obj)
             if key is not None:
+                if ctx is not None:
+                    if len(self._trigger_ctx) > 4096:  # leak backstop
+                        self._trigger_ctx.clear()
+                    self._trigger_ctx[key] = ctx
                 self.wq.add(key)
 
     def _resync_loop(self) -> None:
@@ -133,18 +145,36 @@ class ControllerBase:
         """The Python half of the native worker loop (reconciler.cc):
         business logic + metrics/events only — queue discipline is C++'s.
         Must never raise: ctypes would swallow the exception and report
-        rc=0 (success), silently forgetting a failing key."""
+        rc=0 (success), silently forgetting a failing key.
+
+        With a tracer attached, each pass runs inside a `reconcile` span
+        parented to the watch event that enqueued the key (resync passes
+        are roots) — everything the pass writes inherits that context."""
         key = key_b.decode()
+        tracer = self.cluster.tracer
+        if tracer is None:
+            return self._reconcile_pass(key, after_ptr, None)
+        with tracer.span("reconcile", parent=self._trigger_ctx.pop(key, None),
+                         controller=self.name, key=key) as sp:
+            return self._reconcile_pass(key, after_ptr, sp)
+
+    def _reconcile_pass(self, key: str, after_ptr, sp) -> int:
         t0 = time.perf_counter()
         try:
             self.metrics["reconcile_total"] += 1
             requeue_after = self.reconcile(key)
             after_ptr[0] = -1.0 if requeue_after is None else float(requeue_after)
+            if sp is not None and requeue_after is not None:
+                sp.set_attribute("requeue_after_s", round(requeue_after, 4))
             return 0
         except ConflictError:
+            if sp is not None:
+                sp.set_attribute("outcome", "conflict")
             return 1
         except Exception as exc:  # noqa: BLE001 — reconcile must not die
             self.metrics["reconcile_errors_total"] += 1
+            if sp is not None:
+                sp.set_attribute("error", f"{type(exc).__name__}: {exc}")
             try:
                 self.cluster.record_event(
                     self.ERROR_EVENT_KIND, key, "ReconcileError", str(exc),

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from kubeflow_tpu.api.common import ObjectMeta, utcnow as _ts
+from kubeflow_tpu.tracing import current_context, set_delivered_context
 from kubeflow_tpu.utils.retry import BackoffPolicy, with_conflict_retry
 
 
@@ -61,8 +62,15 @@ class WatchSubscription:
                 self._pending.append((EventType.ADDED, kind, obj))
 
     def get(self, timeout: float | None = None):
-        """Next (etype, kind, obj); raises queue.Empty on timeout."""
+        """Next (etype, kind, obj); raises queue.Empty on timeout.
+
+        When the cluster carries a tracer, each delivery also publishes the
+        originating write's SpanContext to this thread (tracing
+        set_delivered_context) so consumer loops can link their work to the
+        event that caused it; relisted events carry none."""
         if self._pending:
+            if self._cluster.tracer is not None:
+                set_delivered_context(None)  # relists have no causal write
             return self._pending.popleft()
         if self._closed:
             raise queue.Empty
@@ -87,9 +95,12 @@ class WatchSubscription:
         if rc == hub.EVENT:
             with self._cluster._mu:
                 snap = self._cluster._snapshots.get(seq)
+                ctx = self._cluster._event_ctx.get(seq)
                 if snap is None:  # window expired under extreme lag
                     self._relist_locked()
             if snap is not None:
+                if self._cluster.tracer is not None:
+                    set_delivered_context(ctx)
                 return snap
             return self.get(timeout=0.0)
         if rc == hub.OVERFLOWED:
@@ -200,6 +211,9 @@ class FakeCluster:
         # under a stuck REST watch client
         self._hub = EventHub(self.WATCH_CAPACITY)
         self._snapshots: dict[int, tuple[EventType, str, Any]] = {}
+        #: seq -> SpanContext of the write that published the event (only
+        #: populated while a tracer is attached; evicted with _snapshots)
+        self._event_ctx: dict[int, Any] = {}
         self._snapshot_min = 0
         self._rv = 0
         self.events: list[ClusterEvent] = []
@@ -207,6 +221,9 @@ class FakeCluster:
         #: fault-injection attachment point (chaos.ChaosEngine.attach);
         #: None in production — every hook call is gated on it
         self.chaos = None
+        #: tracing attachment point (Platform.start_tracing); None = off —
+        #: every hook call is gated on it, same discipline as chaos
+        self.tracer = None
 
     # ------------------------------------------------------------------ CRUD
 
@@ -329,9 +346,17 @@ class FakeCluster:
         # atomic with respect to subscribe-and-relist
         seq = self._hub.publish(_ETYPE_CODE[etype], kind, self._key(obj))
         self._snapshots[seq] = (etype, kind, obj)
+        if self.tracer is not None:
+            # the writer's current span becomes the event's causal parent:
+            # a reconcile's pod create/update is traceable to whatever the
+            # subscriber does with it
+            ctx = current_context()
+            if ctx is not None:
+                self._event_ctx[seq] = ctx
         floor = seq - 2 * self.WATCH_CAPACITY
         while self._snapshot_min <= floor:
             self._snapshots.pop(self._snapshot_min, None)
+            self._event_ctx.pop(self._snapshot_min, None)
             self._snapshot_min += 1
 
     # ---------------------------------------------------------------- events

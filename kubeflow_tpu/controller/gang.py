@@ -20,6 +20,7 @@ from kubeflow_tpu.controller.fakecluster import (
     PodGroup,
     PodPhase,
 )
+from kubeflow_tpu.tracing import NOOP_TRACER, consume_delivered_context
 from kubeflow_tpu.utils.retry import with_conflict_retry
 
 
@@ -81,17 +82,19 @@ class GangScheduler:
                 # periodic retry: a gang may fit now that capacity freed up
                 self._try_schedule_safe()
                 continue
+            trigger = (consume_delivered_context()
+                       if self.cluster.tracer is not None else None)
             if kind == "podgroups" and etype == EventType.DELETED:
                 with self._mu:
                     held = self._bound_chips.get(obj.key)
                     if held is not None and held[0] == obj.metadata.uid:
                         self._bound_chips.pop(obj.key)
             if kind in ("pods", "podgroups"):
-                self._try_schedule_safe()
+                self._try_schedule_safe(trigger)
 
-    def _try_schedule_safe(self) -> None:
+    def _try_schedule_safe(self, trigger=None) -> None:
         try:
-            self._try_schedule()
+            self._try_schedule(trigger)
         except ConflictError:
             pass  # an object was replaced mid-pass; next event retries
         except Exception as exc:  # noqa: BLE001 — the scheduler must not die
@@ -101,7 +104,10 @@ class GangScheduler:
                 f"{type(exc).__name__}: {exc}", type="Warning",
             )
 
-    def _try_schedule(self) -> None:
+    def _try_schedule(self, trigger=None) -> None:
+        # single read (races stop_tracing); the noop fallback keeps every
+        # bind site a single with-block instead of traced/untraced twins
+        tracer = self.cluster.tracer or NOOP_TRACER
         with self._mu:
             # Priority order: under contention the highest-priority gang
             # admits first; FIFO (creation time) breaks ties so equal-
@@ -159,7 +165,12 @@ class GangScheduler:
                         self._bound_chips[pg.key] = (
                             pg.metadata.uid, held + extra
                         )
-                        self._bind(late, prefix="slice-0-host-late")
+                        with tracer.span(
+                            "gang.bind", parent=trigger, group=pg.key,
+                            uid=pg.metadata.uid, members=len(late),
+                            chips=extra, late=True,
+                        ):
+                            self._bind(late, prefix="slice-0-host-late")
                     continue
                 members = self._members(pg)
                 pending = [
@@ -209,7 +220,12 @@ class GangScheduler:
                     # move on; the periodic sweep retries admission
                     self._bound_chips.pop(pg.key, None)
                     continue
-                self._bind(pending, prefix="slice-0-host")
+                with tracer.span(
+                    "gang.bind", parent=trigger, group=pg.key,
+                    uid=pg.metadata.uid, members=len(pending),
+                    chips=chips_needed,
+                ):
+                    self._bind(pending, prefix="slice-0-host")
                 self.cluster.record_event(
                     "podgroups", pg.key, "Scheduled",
                     f"gang of {len(pending)} bound ({chips_needed} chips)",
@@ -251,6 +267,12 @@ class GangScheduler:
             if entry is None:
                 continue
             released += entry[1]
+            tracer = self.cluster.tracer  # single read: races stop_tracing
+            if tracer is not None:
+                tracer.event(
+                    "gang.preempt", victim=victim.key, chips=entry[1],
+                    by=pg.key,
+                )
             evicted = copy.deepcopy(victim)  # never half-flip the stored one
             evicted.phase = "Pending"
             try:

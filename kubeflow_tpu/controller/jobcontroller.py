@@ -38,6 +38,7 @@ from kubeflow_tpu.controller.fakecluster import (
 from kubeflow_tpu.controller.poddefault import apply_pod_defaults
 from kubeflow_tpu.native import Expectations
 from kubeflow_tpu.runtime.rendezvous import LocalResolver
+from kubeflow_tpu.tracing import ENV_TRACE_DIR, ENV_TRACEPARENT, current_context
 from kubeflow_tpu.utils.retry import BackoffPolicy
 
 JOB_NAME_LABEL = "kubeflow-tpu.org/job-name"
@@ -209,6 +210,12 @@ class JobController(ControllerBase):
                 f"re-meshing gang to {job.total_replicas()} replicas",
             )
             self.cluster.update("jobs", job)
+            tracer = self.cluster.tracer  # single read: races stop_tracing
+            if tracer is not None:
+                tracer.event(
+                    "job.elastic_remesh", key=key,
+                    world_size=job.total_replicas(),
+                )
             self._delete_pods(key, pods)
             self._delete_podgroup(job)
             self._resolvers.pop(key, None)
@@ -296,6 +303,16 @@ class JobController(ControllerBase):
         if not to_create:
             return 0
 
+        tracer = self.cluster.tracer
+        if tracer is None:
+            return self._create_pods(job, key, to_create, None)
+        with tracer.span("job.create_pods", key=key, count=len(to_create),
+                         world_size=job.total_replicas(),
+                         restart=job.status.restart_count):
+            return self._create_pods(job, key, to_create, tracer)
+
+    def _create_pods(self, job: TrainJob, key: str,
+                     to_create: list[tuple[str, int]], tracer) -> int:
         self._ensure_podgroup(job)
         # The resolver must persist across passes within one gang incarnation
         # (pods created in different passes need identical port maps), but a
@@ -305,13 +322,27 @@ class JobController(ControllerBase):
         if resolver is None or _replica_signature(resolver.job) != _replica_signature(job):
             resolver = LocalResolver(job)
             self._resolvers[key] = resolver
+            if tracer is not None:
+                # the port-map build IS local rendezvous setup: every pod of
+                # this incarnation connects through the endpoints fixed here
+                tracer.event("job.rendezvous", key=key,
+                             world_size=job.total_replicas())
         if job.kind == JobKind.MPI:
             self._materialize_hostfile(job, resolver)
+        # trace context rides the env contract into the pods: workers join
+        # the creating pass's trace and flush spans to the shared trace_dir
+        trace_env: dict[str, str] = {}
+        if tracer is not None and tracer.trace_dir:
+            trace_env[ENV_TRACE_DIR] = tracer.trace_dir
+            ctx = current_context()
+            if ctx is not None:
+                trace_env[ENV_TRACEPARENT] = ctx.to_header()
         self.exp.expect_creations(key, len(to_create))
         for rtype, i in to_create:
             env = synthesize_env(job, rtype, i)
             if self.local_rewrite:
                 env = resolver.rewrite_env(env)
+            env.update(trace_env)
             c = job.spec.replica_specs[rtype].template.container
             # job-level labels (e.g. the experiment label) propagate to pods,
             # mirroring k8s template-label propagation
@@ -421,6 +452,28 @@ class JobController(ControllerBase):
             f"restart {st.restart_count}/{limit}",
         )
         self.cluster.update("jobs", job)
+        tracer = self.cluster.tracer  # single read: races stop_tracing,
+        # and an exception here would retry a pass that ALREADY committed
+        # the restart_count increment (double-charging backoff_limit)
+        if tracer is not None:
+            from kubeflow_tpu.tracing import CARRIER_ANNOTATION, SpanContext
+
+            # parent = the failed pod's exit span (carried on the object),
+            # NOT this pass's trigger: multiple watch events coalesce into
+            # one pass, but the restart is causally the failure's child
+            cause = next(
+                (SpanContext.from_header(
+                    p.metadata.annotations.get(CARRIER_ANNOTATION, ""))
+                 for p in failed
+                 if p.metadata.annotations.get(CARRIER_ANNOTATION)),
+                None,
+            )
+            attrs = dict(key=key, restart=st.restart_count, limit=limit,
+                         failed=len(failed))
+            if cause is not None:
+                tracer.event("job.gang_restart", parent=cause, **attrs)
+            else:
+                tracer.event("job.gang_restart", **attrs)
         self._delete_pods(key, pods)
         self._delete_podgroup(job)
         self.metrics["jobs_restarted_total"] += 1

@@ -21,6 +21,11 @@ from kubeflow_tpu.controller.fakecluster import (
     Pod,
     PodPhase,
 )
+from kubeflow_tpu.tracing import (
+    CARRIER_ANNOTATION,
+    consume_delivered_context,
+    current_context,
+)
 from kubeflow_tpu.utils.retry import with_conflict_retry
 
 
@@ -76,6 +81,13 @@ class PodRuntime:
         self._mu = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # tracing side tables (only populated while cluster.tracer is set):
+        # launch-span / kill-injection contexts keyed by (pod key, uid) —
+        # the uid guard matters during gang restarts, where the old
+        # incarnation's reaper runs concurrently with the NEW incarnation's
+        # launch under the same key and must not steal its context
+        self._launch_ctx: dict[tuple[str, str], object] = {}
+        self._kill_ctx: dict[tuple[str, str], object] = {}
 
     # ---------------------------------------------------------------- lifecycle
 
@@ -120,8 +132,10 @@ class PodRuntime:
                 continue
             if kind != "pods":
                 continue
+            trigger = (consume_delivered_context()
+                       if self.cluster.tracer is not None else None)
             try:
-                self._handle_pod_event(etype, obj)
+                self._handle_pod_event(etype, obj, trigger)
             except ConflictError:
                 continue  # stale event for a replaced incarnation — drop it
             except Exception as exc:  # noqa: BLE001 — the kubelet must not die
@@ -131,8 +145,15 @@ class PodRuntime:
                     f"{type(exc).__name__}: {exc}", type="Warning",
                 )
 
-    def _handle_pod_event(self, etype: EventType, pod: Pod) -> None:
+    def _handle_pod_event(self, etype: EventType, pod: Pod,
+                          trigger=None) -> None:
         if etype == EventType.DELETED:
+            tracer = self.cluster.tracer
+            if tracer is not None and pod.key in self._procs:
+                # parent = whatever deleted the pod (gang restart teardown,
+                # cascade delete) — the kill is visible in that span's tree
+                tracer.event("pod.kill", parent=trigger, pod=pod.key,
+                             uid=pod.metadata.uid)
             self._kill(pod.key)
             return
         # Events deliver the object as of notify time; after a delete+
@@ -155,7 +176,7 @@ class PodRuntime:
                 # (no resync re-delivers pod events)
                 self._update_pod_status(pod.key, pod.metadata.uid, bind)
             elif pod.status.node:
-                self._launch(pod)
+                self._launch(pod, trigger)
 
     def _update_pod_status(self, key: str, uid: str, mutate_status) -> bool:
         """Conflict-retried status write gated on the pod incarnation: the
@@ -190,7 +211,19 @@ class PodRuntime:
 
     # ---------------------------------------------------------------- execution
 
-    def _launch(self, pod: Pod) -> None:
+    def _launch(self, pod: Pod, trigger=None) -> None:
+        tracer = self.cluster.tracer
+        if tracer is None:
+            return self._launch_pod(pod)
+        # the span covers injected startup stalls + spawn + the Running
+        # status write, parented to the bind/reconcile event that caused it;
+        # its context is kept so pod.exit can link back to this incarnation
+        with tracer.span("pod.launch", parent=trigger, pod=pod.key,
+                         uid=pod.metadata.uid, node=pod.status.node) as sp:
+            self._launch_ctx[(pod.key, pod.metadata.uid)] = sp.context
+            return self._launch_pod(pod)
+
+    def _launch_pod(self, pod: Pod) -> None:
         if self.chaos is not None:
             # injected startup stall (slow image pull / TPU slice allocation)
             # happens before the runtime lock — it delays THIS pod's spawn,
@@ -281,9 +314,45 @@ class PodRuntime:
 
         # conflict-retried: losing this write would leave a completed pod
         # Running forever and the owning job unfinishable
-        self._update_pod_status(key, uid, finished)
+        tracer = self.cluster.tracer
+        if tracer is None:
+            self._update_pod_status(key, uid, finished)
+            return
+        # parent-link the exit to what ended the incarnation — an injected
+        # kill when one was recorded, else the launch — and run the status
+        # write INSIDE the span so its MODIFIED watch event carries this
+        # context: kill -> exit -> (watch) -> reconcile is one chain
+        # pop BOTH side-table entries (a short-circuiting `or` of pops
+        # would leak the launch ctx of every killed incarnation), then
+        # prefer the kill as the more causal parent
+        kill_ctx = self._kill_ctx.pop((key, uid), None)
+        launch_ctx = self._launch_ctx.pop((key, uid), None)
+        parent = kill_ctx or launch_ctx
+        with tracer.span("pod.exit", parent=parent, pod=key, uid=uid,
+                         exit_code=code) as sp:
+            # a tracer disarmed mid-flight yields the noop span, whose
+            # context is None — then there is simply no carrier to stamp
+            ctx = sp.context
+            carrier = ctx.to_header() if ctx is not None else ""
+
+            def finished_with_carrier(p):
+                if finished(p) is False:
+                    return False
+                # the exit's span context travels ON the object: whatever
+                # controller acts on this failure later (the gang-restart
+                # decision) can parent-link to it, immune to watch-delivery
+                # coalescing races
+                if carrier:
+                    p.metadata.annotations[CARRIER_ANNOTATION] = carrier
+
+            self._update_pod_status(key, uid, finished_with_carrier)
 
     def _kill(self, key: str) -> None:
+        # drop side-table entries for EVERY incarnation of this key (the
+        # dicts are small: bounded by live pods plus in-flight reaps)
+        for table in (self._launch_ctx, self._kill_ctx):
+            for k in [k for k in table if k[0] == key]:
+                table.pop(k, None)
         with self._mu:
             held = self._procs.pop(key, None)
         if held is not None:
@@ -304,6 +373,13 @@ class PodRuntime:
             held = self._procs.get(key)
         if held is None:
             return False
+        if self.cluster.tracer is not None:
+            # remember the injector's span so the reaped exit links to it
+            # (the chaos engine fires kills inside an annotated span);
+            # keyed to the incarnation actually being killed
+            ctx = current_context()
+            if ctx is not None:
+                self._kill_ctx[(key, held[0])] = ctx
         _, proc = held
         try:
             os.killpg(proc.pid, sig)

@@ -59,6 +59,22 @@ def render_metrics(platform) -> str:
             help_="seed of the armed fault plan (reproduce with this)",
         )
 
+    # span tracing (kubeflow_tpu/tracing): volume + loss accounting for the
+    # flight recorder, so a ring sized too small for the span rate is
+    # visible as kftpu_trace_spans_dropped_total
+    tracer = getattr(platform, "tracer", None)
+    if tracer is not None and tracer.recorder is not None:
+        for mname, v in sorted(tracer.metrics.items()):
+            counter(f"kftpu_trace_{mname}", v)
+        gauge(
+            "kftpu_trace_recorder_spans", len(tracer.recorder),
+            help_="completed spans currently held in the flight recorder",
+        )
+        gauge(
+            "kftpu_trace_recorder_capacity", tracer.recorder.capacity,
+            help_="flight recorder ring size",
+        )
+
     cluster = platform.cluster
     # one TYPE line, then one sample per label — repeated TYPE lines for the
     # same metric are invalid exposition format and fail real scrapes

@@ -91,9 +91,17 @@ def initialize_from_env(
     if platform:
         jax.config.update("jax_platforms", platform)
     if coord and n > 1:
-        jax.distributed.initialize(
-            coordinator_address=coord, num_processes=n, process_id=pid
-        )
+        # the gang's rendezvous is the canonical recovery-path span: a
+        # restarted gang's wall-clock between rebind and first step is
+        # mostly spent right here. No-op unless the pod env carries
+        # KFTPU_TRACE_DIR (tracing.init_worker_from_env).
+        from kubeflow_tpu.tracing import init_worker_from_env
+
+        tracer = init_worker_from_env(service="worker")
+        with tracer.span("rendezvous", coordinator=coord, world=n, rank=pid):
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=n, process_id=pid
+            )
     return DistContext(
         process_id=pid, num_processes=n, coordinator=coord,
         num_slices=num_slices, slice_id=slice_id,

@@ -138,7 +138,17 @@ class Activator:
                 .encode(), "application/json", {}
         url = self._pick_endpoint(isvc)
         if url is None:
-            url = self._await_endpoint(key, deadline)
+            # cold start: the whole request-hold window is one span, so
+            # activation latency renders alongside the controller's
+            # scale-up work in the same timeline
+            from kubeflow_tpu.tracing import tracer_of
+
+            with tracer_of(self.platform).span(
+                "activator.cold_start_hold", isvc=key,
+            ) as sp:
+                url = self._await_endpoint(key, deadline)
+                sp.set_attribute("outcome",
+                                 "ready" if url is not None else "timeout")
         if url is None:
             return self._unavailable(
                 "activation timed out: no replica became ready"

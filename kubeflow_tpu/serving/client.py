@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -120,18 +121,51 @@ class ServingClient:
 
         return self._read_modify_write(name, namespace, mutate)
 
+    #: re-dials on 503 + Retry-After before giving up (the first attempt
+    #: plus max_retries redials)
+    RETRY_AFTER_MAX_RETRIES = 2
+    #: a server-advertised hint is clamped here — a misconfigured activator
+    #: must not park a client for minutes
+    RETRY_AFTER_CAP_S = 30.0
+
     def _post(self, url: str, payload: dict, timeout_s: float) -> dict:
-        req = urllib.request.Request(
-            url,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=timeout_s) as r:
-                return json.loads(r.read())
-        except urllib.error.HTTPError as exc:
-            detail = exc.read().decode(errors="replace")
-            raise RuntimeError(f"HTTP {exc.code} from {url}: {detail}") from exc
+        # timeout_s bounds the WHOLE call — dials, advertised waits, and
+        # redials all draw from one budget, so a caller's 2s request can
+        # never be parked for minutes by a server hinting Retry-After: 30
+        data = json.dumps(payload).encode()
+        deadline = time.monotonic() + timeout_s
+        for attempt in range(self.RETRY_AFTER_MAX_RETRIES + 1):
+            remaining = max(deadline - time.monotonic(), 0.01)
+            req = urllib.request.Request(
+                url, data=data,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=remaining) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as exc:
+                detail = exc.read().decode(errors="replace")
+                # 503 + Retry-After (the activator's cold-start/overload
+                # signal): the SERVER knows when capacity returns — sleep
+                # its advertised interval and re-dial, instead of layering
+                # our own backoff schedule on top of its hint
+                hint = (exc.headers.get("Retry-After")
+                        if exc.code == 503 else None)
+                if hint is not None and attempt < self.RETRY_AFTER_MAX_RETRIES:
+                    try:
+                        delay = float(hint)
+                    except ValueError:
+                        delay = None  # HTTP-date form: not worth parsing
+                    if delay is not None and delay >= 0:
+                        delay = min(delay, self.RETRY_AFTER_CAP_S)
+                        if time.monotonic() + delay < deadline:
+                            time.sleep(delay)
+                            continue
+                        # the advertised wait overshoots the caller's
+                        # budget: surface the 503 now, don't park past it
+                raise RuntimeError(
+                    f"HTTP {exc.code} from {url}: {detail}") from exc
+        raise AssertionError("unreachable")  # loop always returns or raises
 
     def predict(
         self, name: str, instances: list, namespace: str = "default",

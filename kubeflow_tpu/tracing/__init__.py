@@ -1,0 +1,60 @@
+"""kubeflow_tpu.tracing — span-level visibility from apiserver to train step.
+
+Dependency-free distributed tracing + a bounded in-memory flight recorder.
+See core.py for the span model and export.py for the Chrome-trace/Perfetto
+and text-tree exporters; docs/observability.md for the operator guide.
+"""
+
+from kubeflow_tpu.tracing.core import (
+    CARRIER_ANNOTATION,
+    ENV_TRACE_DIR,
+    ENV_TRACEPARENT,
+    NOOP_TRACER,
+    FlightRecorder,
+    NoopTracer,
+    Span,
+    SpanContext,
+    Tracer,
+    consume_delivered_context,
+    current_context,
+    flush,
+    get_tracer,
+    init_worker_from_env,
+    set_delivered_context,
+    set_tracer,
+    tracer_of,
+)
+from kubeflow_tpu.tracing.export import (
+    collect_worker_traces,
+    export_merged_trace,
+    load_chrome_trace,
+    render_span_tree,
+    to_chrome_trace,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "CARRIER_ANNOTATION",
+    "ENV_TRACE_DIR",
+    "ENV_TRACEPARENT",
+    "NOOP_TRACER",
+    "FlightRecorder",
+    "NoopTracer",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "collect_worker_traces",
+    "consume_delivered_context",
+    "current_context",
+    "export_merged_trace",
+    "flush",
+    "get_tracer",
+    "init_worker_from_env",
+    "load_chrome_trace",
+    "render_span_tree",
+    "set_delivered_context",
+    "set_tracer",
+    "to_chrome_trace",
+    "tracer_of",
+    "write_chrome_trace",
+]

@@ -1,0 +1,151 @@
+"""Span exporters — Chrome trace-event JSON (Perfetto-loadable) + text tree.
+
+Both operate on the flight recorder's span dicts (core.Span.to_dict):
+
+  {"name", "trace", "span", "parent", "ts" (s), "dur" (s), "pid", "tid",
+   "attrs": {...}}
+
+The Chrome form round-trips: `load_chrome_trace` reads a file written by
+`write_chrome_trace` back into span dicts, so per-process worker traces
+(flushed by tracing.flush at pod exit) merge with the platform recorder's
+snapshot into ONE timeline — `ui.perfetto.dev` → "Open trace file".
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+
+
+def to_chrome_trace(spans: list[dict], service: str = "kftpu") -> dict:
+    """Chrome trace-event JSON object: one complete ("X") event per span,
+    ts/dur in microseconds of wall-clock, args carrying the span identity
+    (trace/span/parent ids) plus every attribute."""
+    events = []
+    pids = {}
+    for s in spans:
+        pid = s.get("pid", 0)
+        if pid not in pids:
+            pids[pid] = True
+            events.append({
+                "ph": "M", "name": "process_name", "pid": pid,
+                "args": {"name": f"{service}-{pid}" if pid else service},
+            })
+        events.append({
+            "name": s["name"],
+            "cat": "kftpu",
+            "ph": "X",
+            "ts": round(s["ts"] * 1e6, 3),
+            # Perfetto drops 0-width slices; events get a 1us sliver
+            "dur": max(round(s["dur"] * 1e6, 3), 1.0),
+            "pid": pid,
+            "tid": s.get("tid", 0),
+            "args": {
+                "trace_id": s["trace"],
+                "span_id": s["span"],
+                "parent_id": s.get("parent", ""),
+                **s.get("attrs", {}),
+            },
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, spans: list[dict],
+                       service: str = "kftpu") -> str:
+    with open(path, "w") as fh:
+        json.dump(to_chrome_trace(spans, service=service), fh)
+    return path
+
+
+def load_chrome_trace(path: str) -> list[dict]:
+    """Read a write_chrome_trace file back into span dicts."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    spans = []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args", {}))
+        spans.append({
+            "name": ev.get("name", ""),
+            "trace": args.pop("trace_id", ""),
+            "span": args.pop("span_id", ""),
+            "parent": args.pop("parent_id", ""),
+            "ts": ev.get("ts", 0.0) / 1e6,
+            "dur": ev.get("dur", 0.0) / 1e6,
+            "pid": ev.get("pid", 0),
+            "tid": ev.get("tid", 0),
+            "attrs": args,
+        })
+    return spans
+
+
+def collect_worker_traces(trace_dir: str) -> list[dict]:
+    """Every span flushed by worker processes into trace_dir
+    (trace-*.json files, the tracing.flush naming)."""
+    spans: list[dict] = []
+    for path in sorted(_glob.glob(os.path.join(trace_dir, "trace-*.json"))):
+        try:
+            spans.extend(load_chrome_trace(path))
+        except (OSError, json.JSONDecodeError):
+            continue  # torn flush of a dying pod — skip, don't fail export
+    return spans
+
+
+def export_merged_trace(path: str, tracer, trace_dir: str | None = None,
+                        extra_spans: list[dict] | None = None) -> str:
+    """The one-call drill export: platform recorder snapshot + every worker
+    flush found in trace_dir (defaults to the tracer's own) + extras,
+    written as a single Perfetto-loadable file."""
+    spans = list(tracer.snapshot())
+    d = trace_dir if trace_dir is not None else tracer.trace_dir
+    if d:
+        spans.extend(collect_worker_traces(d))
+    if extra_spans:
+        spans.extend(extra_spans)
+    spans.sort(key=lambda s: s["ts"])
+    return write_chrome_trace(path, spans,
+                              service=getattr(tracer, "service", "kftpu"))
+
+
+def render_span_tree(spans: list[dict]) -> str:
+    """Plain-text causal tree: one block per trace (ordered by first span
+    start), children indented under parents, each line
+    `name  <dur>ms  [attrs]`. Spans whose parent is outside the snapshot
+    (evicted from the ring, or remote) render as roots."""
+    by_id = {s["span"]: s for s in spans}
+    children: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    for s in spans:
+        parent = s.get("parent", "")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    for kids in children.values():
+        kids.sort(key=lambda s: s["ts"])
+
+    lines: list[str] = []
+
+    def emit(s: dict, depth: int) -> None:
+        attrs = s.get("attrs", {})
+        extra = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        lines.append(
+            f"{'  ' * depth}{s['name']}  {s['dur'] * 1e3:.2f}ms"
+            + (f"  [{extra}]" if extra else "")
+        )
+        for kid in children.get(s["span"], []):
+            emit(kid, depth + 1)
+
+    # group roots by trace so one causal chain renders contiguously
+    traces: dict[str, list[dict]] = {}
+    for r in roots:
+        traces.setdefault(r["trace"], []).append(r)
+    for trace_id, trace_roots in sorted(
+        traces.items(), key=lambda kv: min(r["ts"] for r in kv[1])
+    ):
+        lines.append(f"trace {trace_id}")
+        for r in sorted(trace_roots, key=lambda s: s["ts"]):
+            emit(r, 1)
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -38,9 +38,26 @@ from kubeflow_tpu.parallel.sharding import (
     stacked_batch_sharding,
     state_shardings,
 )
+from kubeflow_tpu.tracing import get_tracer, init_worker_from_env
 from kubeflow_tpu.train import metrics as metrics_lib
 from kubeflow_tpu.train.checkpoint import Checkpointer
 from kubeflow_tpu.train.data import Dataset, batches, prefetch_to_device
+
+
+def _traced_data_iter(tracer, it):
+    """Wrap a batch iterator so each HOST-side fetch (shuffle/stack/device
+    put — everything before the step dispatch) is a train.data_load span.
+    Only installed when tracing is enabled; the plain loop is untouched."""
+    it = iter(it)
+    while True:
+        sp = tracer.start_span("train.data_load")
+        try:
+            batch = next(it)
+        except StopIteration:
+            sp.end()
+            return
+        sp.end()
+        yield batch
 
 
 class TrainState(struct.PyTreeNode):
@@ -539,9 +556,20 @@ class Trainer:
         event_dir = c.event_dir or os.environ.get("KFTPU_EVENT_DIR", "")
         events = metrics_lib.TfEventsWriter(event_dir) if event_dir else None
 
+        # Tracing: the installed tracer, else one from the pod env contract
+        # (KFTPU_TRACE_DIR — the controller injects it when the platform
+        # traces with a trace_dir; init_worker_from_env keeps an already-
+        # installed tracer and is a no-op without the env). Untraced runs
+        # get the NOOP tracer: every span call below is then a shared
+        # inert object, off the hot path.
+        tracer = init_worker_from_env(service="trainer")
+
         start_step = 0
         if resume and self.checkpointer is not None:
-            restored = self.checkpointer.restore_latest(state)
+            with tracer.span("checkpoint.restore") as sp:
+                restored = self.checkpointer.restore_latest(state)
+                sp.set_attribute(
+                    "step", restored[0] if restored is not None else -1)
             if restored is not None:
                 start_step, state = restored
                 metrics_lib.emit(step=start_step, resumed=1)
@@ -564,7 +592,8 @@ class Trainer:
                 pass
         try:
             return self._fit_loop(
-                dataset, c, state, start_step, events, preempted, on_epoch_end
+                dataset, c, state, start_step, events, preempted,
+                on_epoch_end, tracer,
             )
         finally:
             if prev_handler is not None:
@@ -576,8 +605,15 @@ class Trainer:
                     pass
 
     def _fit_loop(self, dataset, c, state, start_step, events, preempted,
-                  on_epoch_end):
+                  on_epoch_end, tracer=None):
         import os
+
+        if tracer is None:
+            tracer = get_tracer()
+
+        def save_ckpt(step, st, metrics=None):
+            with tracer.span("checkpoint.save", step=step):
+                self.checkpointer.save(step, st, metrics=metrics)
 
         per_epoch = len(dataset.x_train) // c.batch_size
         if per_epoch == 0:
@@ -624,7 +660,7 @@ class Trainer:
                 # GC'd as "not best", never mislabeled with stale metrics,
                 # and never returned by best_step — while restore_latest
                 # still resumes from it
-                self.checkpointer.save(global_step, state)
+                save_ckpt(global_step, state)
                 self.checkpointer.wait()
                 metrics_lib.emit(step=global_step, preempted=1)
                 stop["flag"] = True
@@ -634,7 +670,7 @@ class Trainer:
                 and not c.keep_best_metric
                 and (global_step % c.checkpoint_every_steps) < took
             ):
-                self.checkpointer.save(global_step, state)
+                save_ckpt(global_step, state)
             return False
 
         while global_step < total_steps:
@@ -652,10 +688,13 @@ class Trainer:
             if fused_k > 1:
                 k = fused_k
                 pending: list = []
-                for b in batches(
+                batch_src = batches(
                     dataset.x_train, dataset.y_train, c.batch_size,
                     seed=c.seed + epoch,
-                ):
+                )
+                if tracer.enabled:
+                    batch_src = _traced_data_iter(tracer, batch_src)
+                for b in batch_src:
                     if global_step >= total_steps or stop["flag"]:
                         break
                     if total_steps - global_step >= k:
@@ -665,22 +704,26 @@ class Trainer:
                                 np.stack(z) for z in zip(*pending)
                             )
                             pending = []
-                            state, m = self.train_chunk(state, stacked, k)
+                            with tracer.span("train.chunk",
+                                             step=global_step, steps=k):
+                                state, m = self.train_chunk(state, stacked, k)
                             if after(k, m):
                                 break
                     else:
-                        state, m = self.train_step(state, b)
+                        with tracer.span("train.step", step=global_step):
+                            state, m = self.train_step(state, b)
                         if after(1, m):
                             break
                 # epoch tail smaller than a chunk: per-step
                 for b in pending:
                     if global_step >= total_steps or stop["flag"]:
                         break
-                    state, m = self.train_step(state, b)
+                    with tracer.span("train.step", step=global_step):
+                        state, m = self.train_step(state, b)
                     if after(1, m):
                         break
             else:
-                for bx, by in prefetch_to_device(
+                batch_src = prefetch_to_device(
                     batches(
                         dataset.x_train, dataset.y_train,
                         # process_local: each host feeds its 1/P slice of
@@ -693,21 +736,26 @@ class Trainer:
                     ),
                     self.mesh,
                     process_local=self._process_local,
-                ):
+                )
+                if tracer.enabled:
+                    batch_src = _traced_data_iter(tracer, batch_src)
+                for bx, by in batch_src:
                     if global_step >= total_steps or stop["flag"]:
                         break
-                    state, m = self.train_step(state, (bx, by))
+                    with tracer.span("train.step", step=global_step):
+                        state, m = self.train_step(state, (bx, by))
                     if after(1, m):
                         break
             if stop["flag"]:
                 return state, {**last, "preempted": 1.0}
             epoch += 1
             if epoch % c.eval_every_epochs == 0:
-                ev = self.evaluate(state, dataset)
+                with tracer.span("train.eval", step=global_step):
+                    ev = self.evaluate(state, dataset)
                 last_eval[0] = dict(ev)
                 if self.checkpointer is not None and c.keep_best_metric:
                     # best-mode cadence: metrics only exist at evals
-                    self.checkpointer.save(global_step, state, metrics=ev)
+                    save_ckpt(global_step, state, metrics=ev)
                 metrics_lib.emit(step=global_step, **{f"eval_{k}": v for k, v in ev.items()})
                 last.update({f"eval_{k}": v for k, v in ev.items()})
                 if events is not None:
@@ -738,9 +786,10 @@ class Trainer:
                                              early_stopped=1)
                             break
 
-        final_eval = self.evaluate(state, dataset)
+        with tracer.span("train.eval", step=global_step, final=True):
+            final_eval = self.evaluate(state, dataset)
         if self.checkpointer is not None:
-            self.checkpointer.save(global_step, state, metrics=dict(final_eval))
+            save_ckpt(global_step, state, metrics=dict(final_eval))
             self.checkpointer.wait()
         metrics_lib.emit(step=global_step, **{f"final_{k}": v for k, v in final_eval.items()})
         if events is not None:

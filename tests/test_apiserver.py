@@ -488,3 +488,57 @@ class TestLogFollow:
                 f"{remote.server}/api/v1/jobs/default/nope/logs"
                 "?follow=true", timeout=10)
         assert e.value.code == 404
+
+
+class TestRequestId:
+    """Every request gets an X-Request-Id (assigned when the caller sent
+    none), echoed on the response and inside error bodies — the carrier the
+    tracing subsystem propagates through the platform."""
+
+    @pytest.fixture()
+    def server(self, tmp_path):
+        with Platform(log_dir=str(tmp_path / "pod-logs")) as p:
+            srv = PlatformServer(p, port=0).start()
+            yield srv
+            srv.stop()
+
+    def test_assigned_when_absent(self, server):
+        import urllib.request
+
+        with urllib.request.urlopen(f"{server.url}/api/v1/jobs",
+                                    timeout=5) as r:
+            rid = r.headers["X-Request-Id"]
+        assert rid and len(rid) == 16
+        int(rid, 16)  # hex — generated, not echoed garbage
+
+    def test_echoed_when_present(self, server):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{server.url}/healthz", headers={"X-Request-Id": "caller-7"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.headers["X-Request-Id"] == "caller-7"
+
+    def test_error_body_carries_it(self, server):
+        import json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{server.url}/api/v1/frobs", headers={"X-Request-Id": "err-1"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        body = json.loads(ei.value.read())
+        assert ei.value.headers["X-Request-Id"] == "err-1"
+        assert body["requestId"] == "err-1"
+        assert "error" in body
+
+    def test_distinct_per_request(self, server):
+        import urllib.request
+
+        ids = set()
+        for _ in range(3):
+            with urllib.request.urlopen(f"{server.url}/api/v1/jobs",
+                                        timeout=5) as r:
+                ids.add(r.headers["X-Request-Id"])
+        assert len(ids) == 3

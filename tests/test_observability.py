@@ -3,6 +3,7 @@
 import sys
 import textwrap
 import urllib.request
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -61,6 +62,46 @@ class TestMetricsEndpoint:
         assert "kftpu_isvc_workqueue_depth" in body
         with urllib.request.urlopen(f"{url}/healthz", timeout=5) as r:
             assert r.read() == b"ok\n"
+
+
+class TestGoldenExposition:
+    """Golden-style pin of the FULL rendered exposition text for a fresh
+    (unstarted) platform with tracing armed — every metric name, TYPE/HELP
+    line, label, and ordering. A metric rename or removal (including the
+    kftpu_trace_* series) fails here loudly instead of silently breaking
+    scrapes and dashboards. Regenerate after an INTENTIONAL change with:
+
+        KFTPU_UPDATE_GOLDEN=1 pytest tests/test_observability.py -k golden
+    """
+
+    GOLDEN = Path(__file__).resolve().parent / "golden" / \
+        "metrics_exposition.txt"
+
+    def test_full_exposition_matches_golden(self, tmp_path):
+        import os
+
+        from kubeflow_tpu.observability import render_metrics
+
+        p = Platform(log_dir=str(tmp_path / "logs"))
+        p.start_tracing(capacity=4096)
+        text = render_metrics(p)
+        # the new series really are in the pinned surface
+        for needle in (
+            "kftpu_trace_spans_started_total",
+            "kftpu_trace_spans_finished_total",
+            "kftpu_trace_spans_dropped_total",
+            "kftpu_trace_recorder_spans",
+            "kftpu_trace_recorder_capacity 4096",
+        ):
+            assert needle in text, needle
+        if os.environ.get("KFTPU_UPDATE_GOLDEN"):
+            self.GOLDEN.write_text(text)
+        golden = self.GOLDEN.read_text()
+        assert text == golden, (
+            "rendered /metrics exposition diverged from the golden file — "
+            "if the change is intentional, regenerate with "
+            "KFTPU_UPDATE_GOLDEN=1 (see class docstring)"
+        )
 
 
 class TestProfilerToggle:

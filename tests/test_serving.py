@@ -409,3 +409,101 @@ class TestMultiTensorV2:
             {"predictions": np.array([1.0]), "scores": np.array([0.5])}
         )
         assert [k for k, _ in arrays] == ["predictions", "scores"]
+
+
+class TestRetryAfterHonored:
+    """serving client x activator contract: a 503 carrying Retry-After means
+    'the SERVER knows when capacity returns' — the client must sleep that
+    advertised interval and re-dial, not apply its own backoff schedule."""
+
+    class _Flaky:
+        """Tiny HTTP server: N 503+Retry-After responses, then 200."""
+
+        def __init__(self, fail_times: int, retry_after: str):
+            import threading
+            from http.server import BaseHTTPRequestHandler, HTTPServer
+
+            state = {"left": fail_times, "times": []}
+            self.state = state
+
+            class H(BaseHTTPRequestHandler):
+                def log_message(self, *a):
+                    pass
+
+                def do_POST(self):
+                    self.rfile.read(
+                        int(self.headers.get("Content-Length", 0)))
+                    state["times"].append(time.monotonic())
+                    if state["left"] > 0:
+                        state["left"] -= 1
+                        body = b'{"error": "cold start"}'
+                        self.send_response(503)
+                        self.send_header("Retry-After", retry_after)
+                    else:
+                        body = json.dumps({"predictions": [[2.0]]}).encode()
+                        self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            self.httpd = HTTPServer(("127.0.0.1", 0), H)
+            self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+            import threading as _t
+
+            _t.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+        def stop(self):
+            self.httpd.shutdown()
+            self.httpd.server_close()
+
+    def _client(self):
+        # _post needs no platform state — a bare instance suffices
+        return ServingClient.__new__(ServingClient)
+
+    def test_sleeps_advertised_interval_then_redials(self):
+        srv = self._Flaky(fail_times=1, retry_after="0.4")
+        try:
+            out = self._client()._post(srv.url, {"instances": [[1.0]]}, 5.0)
+        finally:
+            srv.stop()
+        assert out == {"predictions": [[2.0]]}
+        t = srv.state["times"]
+        assert len(t) == 2
+        # the gap between dials is the server's hint, not a client schedule
+        assert 0.4 <= t[1] - t[0] < 2.0, t[1] - t[0]
+
+    def test_gives_up_after_retry_budget(self):
+        srv = self._Flaky(fail_times=10, retry_after="0.05")
+        try:
+            with pytest.raises(RuntimeError, match="HTTP 503"):
+                self._client()._post(srv.url, {"instances": [[1.0]]}, 5.0)
+        finally:
+            srv.stop()
+        # initial dial + RETRY_AFTER_MAX_RETRIES redials, then surface
+        assert len(srv.state["times"]) == ServingClient.RETRY_AFTER_MAX_RETRIES + 1
+
+    def test_hint_exceeding_caller_budget_is_not_honored(self):
+        """timeout_s bounds the WHOLE call: a hint that would sleep past
+        the caller's deadline surfaces the 503 instead of parking."""
+        srv = self._Flaky(fail_times=10, retry_after="5")
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError, match="HTTP 503"):
+                self._client()._post(srv.url, {"instances": [[1.0]]}, 0.5)
+            assert time.monotonic() - t0 < 2.0
+            assert len(srv.state["times"]) == 1  # no redial past budget
+        finally:
+            srv.stop()
+
+    def test_503_without_hint_raises_immediately(self):
+        srv = self._Flaky(fail_times=10, retry_after="")
+        # empty Retry-After parses as no hint -> no sleep, immediate raise
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError, match="HTTP 503"):
+                self._client()._post(srv.url, {"instances": [[1.0]]}, 5.0)
+            assert time.monotonic() - t0 < 1.0
+            assert len(srv.state["times"]) == 1
+        finally:
+            srv.stop()

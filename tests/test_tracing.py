@@ -1,0 +1,503 @@
+"""Tracing subsystem tests — span model, flight recorder, exporters, layer
+integration, and the acceptance drill: a chaos gang-restart renders as ONE
+causal Chrome trace (kill -> pod exit -> watch-linked reconcile -> rebind ->
+rendezvous -> first post-restore training step)."""
+
+import json
+import sys
+import textwrap
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu import tracing
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    JAXJob,
+    JAXJobSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.chaos import ChaosEngine, FaultPlan, PodKill
+from kubeflow_tpu.client import Platform, TrainingClient
+from kubeflow_tpu.tracing import (
+    NOOP_TRACER,
+    SpanContext,
+    Tracer,
+    export_merged_trace,
+    load_chrome_trace,
+    render_span_tree,
+    to_chrome_trace,
+)
+from kubeflow_tpu.utils.retry import poll_until
+
+pytestmark = pytest.mark.trace
+
+
+# ------------------------------------------------------------------- core
+
+
+class TestSpanCore:
+    def test_nesting_and_ids(self):
+        tr = Tracer()
+        with tr.span("root", layer="test") as root:
+            assert len(root.trace_id) == 32 and len(root.span_id) == 16
+            with tr.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+            mark = tr.event("mark", x=1)
+        spans = {s["name"]: s for s in tr.snapshot()}
+        assert set(spans) == {"root", "child", "mark"}
+        assert spans["mark"]["parent"] == root.span_id
+        assert spans["child"]["dur"] <= spans["root"]["dur"]
+        # root closed last but started first; all share one trace
+        assert {s["trace"] for s in spans.values()} == {root.trace_id}
+
+    def test_explicit_parent_and_roots(self):
+        tr = Tracer()
+        a = tr.event("a")
+        b = tr.event("b", parent=a.context)
+        c = tr.event("c", parent=None)  # forced root
+        assert b.trace_id == a.trace_id and b.parent_id == a.span_id
+        assert c.trace_id != a.trace_id and c.parent_id == ""
+
+    def test_exception_stamps_error_attr(self):
+        tr = Tracer()
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("no")
+        (span,) = tr.snapshot()
+        assert span["attrs"]["error"] == "ValueError: no"
+
+    def test_ring_bound_and_drop_accounting(self):
+        tr = Tracer(capacity=8)
+        for i in range(20):
+            tr.event(f"e{i}")
+        assert len(tr.recorder) == 8
+        assert tr.metrics == {
+            "spans_started_total": 20,
+            "spans_finished_total": 20,
+            "spans_dropped_total": 12,
+        }
+        # the ring keeps the NEWEST spans
+        assert [s["name"] for s in tr.snapshot()] == [
+            f"e{i}" for i in range(12, 20)
+        ]
+
+    def test_context_header_round_trip(self):
+        ctx = SpanContext("a" * 32, "b" * 16)
+        back = SpanContext.from_header(ctx.to_header())
+        assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
+        assert SpanContext.from_header("") is None
+        assert SpanContext.from_header("nodash") is None
+
+    def test_disabled_tracer_is_near_zero_overhead(self):
+        """The off-by-default contract: a noop span per step must be far
+        under 1% of any real step dispatch (which is >= ~50us)."""
+        tr = NOOP_TRACER
+        n = 50_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            with tr.span("train.step", step=i):
+                pass
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 5e-6, f"noop span cost {per_call * 1e6:.2f}us"
+        assert tr.snapshot() == [] and tr.metrics == {}
+
+
+# -------------------------------------------------------------- exporters
+
+
+class TestExporters:
+    def _sample(self):
+        tr = Tracer()
+        with tr.span("root", phase="demo"):
+            with tr.span("child"):
+                pass
+        return tr.snapshot()
+
+    def test_chrome_trace_shape_and_round_trip(self, tmp_path):
+        spans = self._sample()
+        doc = to_chrome_trace(spans, service="unit")
+        slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(slices) == 2
+        for ev in slices:
+            assert ev["ts"] > 0 and ev["dur"] >= 1.0  # microseconds
+            assert {"trace_id", "span_id", "parent_id"} <= set(ev["args"])
+        # process_name metadata makes Perfetto label the track
+        assert any(e["ph"] == "M" for e in doc["traceEvents"])
+        path = tmp_path / "t.json"
+        tracing.write_chrome_trace(str(path), spans, service="unit")
+        back = load_chrome_trace(str(path))
+        assert {(s["name"], s["span"], s["parent"]) for s in back} == {
+            (s["name"], s["span"], s["parent"]) for s in spans
+        }
+
+    def test_span_tree_renders_nesting(self):
+        spans = self._sample()
+        text = render_span_tree(spans)
+        root_line = next(ln for ln in text.splitlines() if "root" in ln)
+        child_line = next(ln for ln in text.splitlines() if "child" in ln)
+        assert text.startswith("trace ")
+        # child indented one level deeper than root
+        indent = lambda ln: len(ln) - len(ln.lstrip())  # noqa: E731
+        assert indent(child_line) == indent(root_line) + 2
+        assert "[phase=demo]" in root_line
+
+    def test_merged_export_includes_worker_files(self, tmp_path):
+        tr = Tracer(trace_dir=str(tmp_path))
+        tr.event("platform.thing")
+        # a "worker" flush in the same dir
+        worker = Tracer(trace_dir=str(tmp_path), service="w")
+        worker.event("worker.thing")
+        tracing.flush(worker)
+        out = tmp_path / "merged.json"
+        export_merged_trace(str(out), tr)
+        names = {s["name"] for s in load_chrome_trace(str(out))}
+        assert names == {"platform.thing", "worker.thing"}
+
+
+# ------------------------------------------------------- worker bootstrap
+
+
+class TestWorkerEnvInit:
+    def test_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv(tracing.ENV_TRACE_DIR, raising=False)
+        assert tracing.init_worker_from_env() is NOOP_TRACER
+
+    def test_installs_with_parent_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(tracing.ENV_TRACE_DIR, str(tmp_path))
+        monkeypatch.setenv(tracing.ENV_TRACEPARENT, "a" * 32 + "-" + "b" * 16)
+        try:
+            tr = tracing.init_worker_from_env(service="t")
+            assert tr.enabled
+            with tr.span("top") as sp:
+                assert sp.trace_id == "a" * 32
+                assert sp.parent_id == "b" * 16
+            path = tracing.flush(tr)
+            assert Path(path).exists()
+            (span,) = load_chrome_trace(path)
+            assert span["name"] == "top"
+        finally:
+            tracing.set_tracer(None)
+        assert tracing.get_tracer() is NOOP_TRACER
+
+
+# ----------------------------------------------------- platform integration
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16)
+    with p:
+        yield p
+
+
+def make_job(tmp_path, name, body, replicas=2, backoff_limit=3, env=None):
+    path = tmp_path / f"{name}.py"
+    path.write_text(textwrap.dedent(body))
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        spec=JAXJobSpec(
+            replica_specs={
+                REPLICA_WORKER: ReplicaSpec(
+                    replicas=replicas,
+                    restart_policy=RestartPolicy.ON_FAILURE,
+                    template=PodTemplateSpec(
+                        container=ContainerSpec(
+                            command=[sys.executable, str(path)],
+                            env=dict(env or {}),
+                        )
+                    ),
+                )
+            },
+            run_policy=RunPolicy(backoff_limit=backoff_limit),
+        ),
+    )
+
+
+class TestPlatformIntegration:
+    def test_clean_job_emits_linked_spans(self, platform, tmp_path):
+        tr = platform.start_tracing()
+        client = TrainingClient(platform)
+        client.create_job(make_job(tmp_path, "tracejob", "print('ok')",
+                                   replicas=2))
+        done = client.wait_for_job_conditions("tracejob", timeout_s=60)
+        assert done.status.has_condition(JobConditionType.SUCCEEDED)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            names = {s["name"] for s in tr.snapshot()}
+            if {"pod.exit", "job.rendezvous"} <= names:
+                break
+            time.sleep(0.1)
+        spans = tr.snapshot()
+        by_id = {s["span"]: s for s in spans}
+        names = {s["name"] for s in spans}
+        assert {"reconcile", "job.create_pods", "job.rendezvous",
+                "gang.bind", "pod.launch", "pod.exit"} <= names
+        # causal links: create_pods under a reconcile pass, launches under
+        # the gang bind, all in one trace
+        create = next(s for s in spans if s["name"] == "job.create_pods")
+        assert by_id[create["parent"]]["name"] == "reconcile"
+        launches = [s for s in spans if s["name"] == "pod.launch"]
+        # a launch is triggered by whichever watch delivery first shows the
+        # pod bound — usually the bind-status MODIFIED (parent: gang.bind),
+        # but the ADDED event can race the bind and win (parent: the
+        # creating job.create_pods span). Either way it's the same trace.
+        assert launches and all(
+            by_id[s["parent"]]["name"] in ("gang.bind", "job.create_pods")
+            for s in launches
+        )
+        assert all(s["trace"] == create["trace"] for s in launches)
+        # pod incarnation is stamped everywhere
+        assert all(s["attrs"]["uid"] for s in launches)
+
+    def test_metrics_export_and_watch_request_id(self, platform, tmp_path):
+        from kubeflow_tpu.apiserver import PlatformServer
+
+        tr = platform.start_tracing(capacity=512)
+        server = PlatformServer(platform, port=0).start()
+        try:
+            client = TrainingClient(platform)
+            client.create_job(make_job(tmp_path, "obs", "print('hi')",
+                                       replicas=1))
+            client.wait_for_job_conditions("obs", timeout_s=60)
+            # watch events carry the stream's request id
+            req = urllib.request.Request(
+                f"{server.url}/api/v1/jobs?watch=true&timeoutSeconds=1",
+                headers={"X-Request-Id": "watch-1"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.headers["X-Request-Id"] == "watch-1"
+                lines = [json.loads(x) for x in r.read().splitlines() if x]
+            assert lines and all(x["requestId"] == "watch-1" for x in lines)
+            with urllib.request.urlopen(f"{server.url}/metrics",
+                                        timeout=5) as r:
+                text = r.read().decode()
+            assert "kftpu_trace_spans_started_total" in text
+            assert "kftpu_trace_spans_finished_total" in text
+            assert "kftpu_trace_spans_dropped_total" in text
+            assert "kftpu_trace_recorder_capacity 512" in text
+            started = int(next(
+                ln for ln in text.splitlines()
+                if ln.startswith("kftpu_trace_spans_started_total")
+            ).split()[-1])
+            assert started > 0
+        finally:
+            server.stop()
+        assert tr.snapshot()
+
+    def test_stop_tracing_detaches_but_ring_stays_readable(self, platform):
+        tr = platform.start_tracing()
+        assert platform.cluster.tracer is tr
+        tr.event("before-stop")
+        platform.stop_tracing()
+        # emission frozen EVERYWHERE — including surfaces that reach the
+        # tracer through platform.tracer rather than cluster.tracer (the
+        # apiserver wraps every HTTP request, so an unfrozen tracer would
+        # let trace reads evict the very spans being read)
+        assert platform.cluster.tracer is None
+        assert platform.tracer is tr and not tr.armed
+        tr.event("after-stop")  # degrades to the shared noop span
+        assert [s["name"] for s in platform.tracer.snapshot()] == \
+            ["before-stop"]
+        # re-arming reuses the same recorder
+        assert platform.start_tracing() is tr
+        assert platform.cluster.tracer is tr and tr.armed
+        tr.event("re-armed")
+        assert [s["name"] for s in tr.snapshot()] == \
+            ["before-stop", "re-armed"]
+
+
+# --------------------------------------------------------- acceptance drill
+
+
+WORKER_BODY = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from kubeflow_tpu import tracing
+
+t = tracing.init_worker_from_env()
+rank = os.environ.get("JAX_PROCESS_ID", "?")
+with t.span("rendezvous", rank=rank,
+            world=os.environ.get("JAX_NUM_PROCESSES", "?")):
+    while not os.path.exists({marker!r}):
+        time.sleep(0.03)
+with t.span("train.step", step=0, rank=rank):
+    time.sleep(0.01)
+tracing.flush()
+print("done", rank, flush=True)
+"""
+
+
+class TestGangRestartTraceDrill:
+    def test_recovery_renders_as_one_causal_trace(self, platform, tmp_path):
+        """Seeded pod kill under tracing: the merged Chrome export holds the
+        full recovery path — chaos kill -> pod exit -> (watch-delivered)
+        reconcile -> gang restart -> pod re-create -> rebind -> worker
+        rendezvous -> first post-restore training step — with parent links
+        across every process boundary and monotonic wall-clock order."""
+        repo = str(Path(__file__).resolve().parents[1])
+        marker = tmp_path / "go"
+        tr = platform.start_tracing(trace_dir=str(tmp_path / "traces"))
+        client = TrainingClient(platform)
+        plan = FaultPlan(
+            seed=4242,
+            pod_kills=(
+                PodKill("drill-worker-0", after_running_s=0.3, times=1),
+            ),
+        )
+        engine = ChaosEngine(plan).attach(platform)
+        try:
+            client.create_job(make_job(
+                tmp_path, "drill",
+                WORKER_BODY.format(repo=repo, marker=str(marker)),
+                replicas=2,
+            ))
+            poll_until(
+                lambda: (
+                    (j := client.get_job("drill")) is not None
+                    and j.status.restart_count >= 1
+                ) or None,
+                timeout_s=30.0,
+                describe="gang restart observed",
+            )
+            marker.write_text("go")
+            done = client.wait_for_job_conditions("drill", timeout_s=60)
+        finally:
+            engine.detach()
+        assert done.status.has_condition(JobConditionType.SUCCEEDED)
+        assert done.status.restart_count == 1
+
+        # worker flushes are atexit: wait for both post-restore files
+        poll_until(
+            lambda: len(list((tmp_path / "traces").glob("trace-*.json"))) >= 2
+            or None,
+            timeout_s=15.0,
+            describe="worker trace flushes",
+        )
+        out = tmp_path / "drill-trace.json"
+        export_merged_trace(str(out), tr)
+        spans = load_chrome_trace(str(out))
+        by_id = {s["span"]: s for s in spans}
+
+        def one(name, **attrs):
+            found = [
+                s for s in spans if s["name"] == name
+                and all(s["attrs"].get(k) == v for k, v in attrs.items())
+            ]
+            assert found, f"no span {name} {attrs}"
+            return found[0]
+
+        # 1. the injected kill, stamped with cause (seed) and target uid
+        kill = one("chaos.pod_kill", landed=True)
+        assert kill["attrs"]["seed"] == 4242
+        assert kill["attrs"]["pod"] == "default/drill-worker-0"
+        # 2. the pod's exit parent-links to the kill (cross-thread link via
+        # the runtime's kill-context table)
+        exit_ = one("pod.exit", pod="default/drill-worker-0",
+                    uid=kill["attrs"]["uid"])
+        assert exit_["parent"] == kill["span"]
+        assert exit_["attrs"]["exit_code"] == 137  # 128+SIGKILL
+        # 3. the gang-restart decision parent-links to the exit (the exit
+        # span's context rode ON the pod object, so the link survives
+        # watch-event coalescing), putting kill -> exit -> restart in one
+        # parent chain / one trace id
+        restart = one("job.gang_restart", key="default/drill")
+        assert restart["parent"] == exit_["span"]
+        assert restart["trace"] == kill["trace"]
+        # ... and the decision was made by job-controller reconcile passes
+        # running between the kill and the restart (watch delivery -> pass)
+        assert any(
+            s["attrs"].get("controller") == "job"
+            and kill["ts"] - 0.25 <= s["ts"] <= restart["ts"]
+            for s in spans if s["name"] == "reconcile"
+        ), "no job reconcile pass between kill and restart decision"
+        # 5. recovery: the restart incarnation's pod re-create + rebind
+        create = one("job.create_pods", restart=1)
+        bind = next(
+            s for s in sorted(spans, key=lambda s: s["ts"])
+            if s["name"] == "gang.bind" and s["ts"] >= create["ts"]
+        )
+        # 6. the workers joined the controller's trace via the env contract:
+        # their spans parent-link to the create_pods span that made them
+        rendezvous = [s for s in spans if s["name"] == "rendezvous"]
+        steps = [s for s in spans if s["name"] == "train.step"]
+        assert len(rendezvous) == 2 and len(steps) == 2  # both survivors
+        for s in rendezvous + steps:
+            assert s["trace"] == create["trace"]
+            assert s["parent"] == create["span"]
+        first_step = min(steps, key=lambda s: s["ts"])
+        # 7. monotonic wall-clock order along the whole recovery path
+        chain = [kill, exit_, restart, create, bind, first_step]
+        stamps = [s["ts"] for s in chain]
+        assert stamps == sorted(stamps), [
+            (s["name"], s["ts"]) for s in chain
+        ]
+        # the worker's step ends after the rendezvous hold ended
+        assert first_step["ts"] >= min(s["ts"] for s in rendezvous)
+        # 8. the text tree renders the same snapshot without error
+        tree = render_span_tree(spans)
+        assert "chaos.pod_kill" in tree and "train.step" in tree
+        # the injection landed exactly once and no span was lost: the whole
+        # recovery fits the recorder, so the export above is complete
+        assert engine.metrics["pod_kills_total"] == 1
+        from kubeflow_tpu.observability import render_metrics
+
+        assert "kftpu_trace_spans_dropped_total 0" in render_metrics(platform)
+
+
+# ------------------------------------------------------------ trainer spans
+
+
+class TestTrainerSpans:
+    def test_traced_data_iter_wraps_each_fetch(self):
+        """The data-load wrapper (installed only when tracing is enabled)
+        must pass batches through untouched and record one span per fetch
+        (plus the final exhausted probe)."""
+        from kubeflow_tpu.train.trainer import _traced_data_iter
+
+        tr = Tracer()
+        assert list(_traced_data_iter(tr, iter([1, 2, 3]))) == [1, 2, 3]
+        assert [s["name"] for s in tr.snapshot()] == ["train.data_load"] * 4
+
+    def test_fit_emits_step_data_and_checkpoint_spans(self, tmp_path):
+        import jax
+
+        if not hasattr(jax, "set_mesh"):
+            pytest.skip("Trainer.fit needs jax.set_mesh (newer jax); the "
+                        "whole trainer suite is unavailable on this jax")
+        from kubeflow_tpu.models import MnistMLP
+        from kubeflow_tpu.train import Trainer, TrainerConfig
+        from kubeflow_tpu.train.data import synthetic_image_dataset
+
+        tr = Tracer()
+        tracing.set_tracer(tr)
+        try:
+            ds = synthetic_image_dataset(n_train=64, n_test=32, shape=(8, 8, 1))
+            trainer = Trainer(
+                MnistMLP(hidden=(8,)),
+                TrainerConfig(
+                    batch_size=32, steps=3, log_every_steps=1,
+                    checkpoint_dir=str(tmp_path / "ckpt"),
+                    checkpoint_every_steps=1,
+                ),
+            )
+            trainer.fit(ds)
+        finally:
+            tracing.set_tracer(None)
+        names = [s["name"] for s in tr.snapshot()]
+        assert names.count("train.step") == 3
+        assert "train.data_load" in names
+        assert "checkpoint.save" in names
+        assert "checkpoint.restore" in names
+        assert "train.eval" in names
+        steps = [s for s in tr.snapshot() if s["name"] == "train.step"]
+        assert [s["attrs"]["step"] for s in steps] == [0, 1, 2]
