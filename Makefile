@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test test-chaos test-trace selftest-sanitizers native
+.PHONY: test test-chaos test-trace test-health selftest-sanitizers native
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -17,6 +17,11 @@ test-chaos:
 # (docs/observability.md)
 test-trace:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q -m trace
+
+# liveness layer: heartbeat leases, hang/straggler detection, and the
+# verified-checkpoint fallback drill (docs/health.md)
+test-health:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_health_drills.py -q -m health
 
 native:
 	$(MAKE) -C $(NATIVE)

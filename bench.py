@@ -31,7 +31,8 @@ so vs_baseline is the ratio to this repo's first recorded measurement
                                   # first stage, banking the north-star
                                   # numbers inside even a short tunnel window
 
-Window-capture mode (KFT_BENCH_RESUME=1, set by tunnel_watch3.sh, never by
+Window-capture mode (KFT_BENCH_RESUME=1, set by an external watcher
+wrapper — the in-repo tunnel_watch scripts were retired in PR 3 — never by
 the driver): rows already banked in this round's on-disk capture files are
 seeded into KFT_BENCH_DONE and skipped, and the remaining rows run
 never-captured-first then stalest-first — so a sequence of short tunnel
@@ -75,7 +76,7 @@ BASELINE_PROTOCOL = "r2-initial-presync"
 
 # Fixed-protocol capture files, newest first. The adopted baseline AND the
 # last_good payload on error records both merge from these per metric
-# (tunnel_watch3.sh writes the r5 captures at the next live window; the
+# (a window-capture watcher banks rows into these at each live window; the
 # headline file holds the <5-min resnet+bert stage so a short window still
 # banks the north-star numbers before the full suite is attempted).
 _CAPTURE_FILES = (
@@ -184,8 +185,8 @@ WATCHDOG_S = float(os.environ.get("KFT_BENCH_WATCHDOG_S", "240"))
 # timeout kill the process before any structured line was emitted). The
 # budget starts at the FIRST exec (KFT_BENCH_T0 survives re-execs); when it
 # expires, error records for every still-owed metric are emitted and the
-# process exits — the driver always gets parseable lines. tunnel_watch.sh
-# raises this for window captures; the driver's bare run uses the default,
+# process exits — the driver always gets parseable lines. Window-capture
+# watchers raise this via the env; the driver's bare run uses the default,
 # which sits well under its observed >=20-min kill budget.
 DEADLINE_S = float(os.environ.get("KFT_BENCH_DEADLINE_S", "900"))
 _T0 = float(os.environ.get("KFT_BENCH_T0", "0")) or time.time()
@@ -327,7 +328,7 @@ def _resnet_probe_flags(batch_size: int,
     probe_resnet section C rows are configs a bench can adopt verbatim
     (`resnet50_{impl}_{stem}_fwdbwd_b{bs}_ms=<ms> tflops=<tf>`); the
     artifact is append-accumulated across windows, so the LAST line per
-    key wins (same contract as tunnel_watch3.last_val)."""
+    key wins (the window-capture watcher contract)."""
     path = path or os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "probe_resnet.txt")
     best: tuple[float, str, str] | None = None
@@ -745,7 +746,7 @@ def _emit_provisional() -> None:
     hangs or fails, so a later hard kill (driver timeout, SIGKILL) still
     leaves a parseable record on stdout. A successful retry emits the real
     line afterwards — consumers take the LAST line per metric (the same
-    contract tunnel_watch.sh documents). Once per whole run (survives
+    contract the window-capture protocol documents). Once per whole run (survives
     re-exec via env marker); deliberately NOT added to KFT_BENCH_DONE so
     the metric is still retried."""
     if os.environ.get("KFT_BENCH_PROVISIONAL"):

@@ -622,7 +622,13 @@ class PlatformServer:
         """Write an NDJSON watch stream for one kind until timeout/disconnect.
         Identified callers only see namespaces kfam lets them read. Every
         event line carries the stream's requestId (the trace-context
-        carrier), so a client can attribute events to its own watch call."""
+        carrier), so a client can attribute events to its own watch call.
+
+        Keepalive contract: when no event has been written for
+        keepaliveSeconds (default 10, clamp [0.5, 60]), a
+        {"type": "KEEPALIVE"} line goes out instead — so a QUIET stream and
+        a DEAD connection are distinguishable client-side (remote.py treats
+        a stream silent past the keepalive budget as gone and relists)."""
         import queue as queue_mod
         import time
 
@@ -631,7 +637,15 @@ class PlatformServer:
         cluster = self.platform.cluster
         ns_filter = query.get("namespace", "")
         name_filter = query.get("name", "")
-        timeout_s = min(float(query.get("timeoutSeconds", "60")), 600.0)
+        try:
+            timeout_s = min(float(query.get("timeoutSeconds", "60")), 600.0)
+        except ValueError:
+            timeout_s = 60.0
+        try:
+            keepalive_s = min(
+                max(float(query.get("keepaliveSeconds", "10")), 0.5), 60.0)
+        except ValueError:
+            keepalive_s = 10.0
         deadline = time.monotonic() + timeout_s
 
         def want(obj) -> bool:
@@ -647,11 +661,24 @@ class PlatformServer:
             return True
 
         q = cluster.watch(replay=True)
+        last_write = time.monotonic()
         try:
             while time.monotonic() < deadline:
+                # keepalive check BEFORE the blocking get, so a queue kept
+                # busy by filtered-out events (other kinds/namespaces) still
+                # honors the one-line-per-keepalive_s contract — an idle
+                # stream on a churning cluster must not look dead
+                if time.monotonic() - last_write >= keepalive_s:
+                    record = {"type": "KEEPALIVE"}
+                    if request_id:
+                        record["requestId"] = request_id
+                    wfile.write((json.dumps(record) + "\n").encode())
+                    wfile.flush()
+                    last_write = time.monotonic()
                 try:
                     etype, ekind, obj = q.get(
-                        timeout=min(0.5, max(deadline - time.monotonic(), 0.01))
+                        timeout=min(0.5, keepalive_s / 2.0,
+                                    max(deadline - time.monotonic(), 0.01))
                     )
                 except queue_mod.Empty:
                     continue
@@ -667,6 +694,7 @@ class PlatformServer:
                 line = json.dumps(record) + "\n"
                 wfile.write(line.encode())
                 wfile.flush()
+                last_write = time.monotonic()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass  # client went away — normal watch termination
         finally:

@@ -13,11 +13,23 @@ real system fails at:
   - PodRuntime._launch        -> startup stalls (slow image pull / TPU slice
                                  allocation)
   - running pods              -> kills with retryable (signal -> 128+signum)
-                                 or non-retryable exit codes
+                                 or non-retryable exit codes, and HANGS
+                                 (SIGSTOP: the process stays alive, exits
+                                 never, heartbeats stop — the liveness
+                                 layer's lease detector is the only thing
+                                 that can catch it, docs/health.md)
+  - heartbeat writes          -> dropped liveness reports (a healthy worker
+                                 that LOOKS hung), armed in-process via
+                                 HeartbeatWriter.chaos or cross-process via
+                                 the KFTPU_HB_DROP env carrier
   - Checkpointer saves        -> fsync delays and torn writes (an atomic-
                                  rename checkpointer surfaces a torn write as
                                  a MISSING newest checkpoint, so injection
                                  drops the save after the delay)
+  - Checkpointer restores     -> restore-side corruption: the newest
+                                 COMMITTED step's bytes are flipped before
+                                 the restore, exercising the verify ->
+                                 quarantine -> fallback path
 
 Reproducibility contract: FaultPlan.from_seed(s) is a pure function of
 (s, profile) — plan.describe() is byte-identical across runs and
@@ -98,13 +110,41 @@ class StartStall:
 
 
 @dataclass(frozen=True)
+class PodHang:
+    """SIGSTOP up to `times` distinct running pods matching `name_glob`
+    after they have run for `after_running_s`: the process stays ALIVE (no
+    exit code ever), its heartbeats stop — the deadlocked-collective /
+    stuck-data-loader failure mode only lease expiry can detect."""
+
+    name_glob: str = "*"
+    after_running_s: float = 0.2
+    times: int = 1
+
+
+@dataclass(frozen=True)
+class HeartbeatDrop:
+    """Drop a fraction of heartbeat writes, `count` total — liveness
+    reports lost in transit, so detection tuning gets exercised against
+    flaky reporting, not just clean silence. In-process writers consult the
+    engine directly; subprocess workers get the same schedule via the
+    KFTPU_HB_DROP env carrier ("rate:seed:count") injected at pod launch."""
+
+    rate: float = 0.3
+    count: int = 10
+
+
+@dataclass(frozen=True)
 class CheckpointFault:
     """save() faults: every save sleeps save_delay_s (slow fsync); every
     torn_every_n-th save is dropped after the delay (torn write under
-    atomic-rename semantics = the checkpoint never becomes visible)."""
+    atomic-rename semantics = the checkpoint never becomes visible).
+    restore faults: every corrupt_restore_every_n-th restore_latest first
+    flips bytes in the newest committed step, so the verify-on-restore ->
+    quarantine -> fallback contract is what gets drilled."""
 
     save_delay_s: float = 0.02
     torn_every_n: int = 0
+    corrupt_restore_every_n: int = 0
 
 
 @dataclass(frozen=True)
@@ -118,6 +158,8 @@ class FaultPlan:
     event_delays: tuple[EventDelay, ...] = ()
     pod_kills: tuple[PodKill, ...] = ()
     start_stalls: tuple[StartStall, ...] = ()
+    pod_hangs: tuple[PodHang, ...] = ()
+    heartbeat_drops: tuple[HeartbeatDrop, ...] = ()
     checkpoint: CheckpointFault | None = None
 
     @classmethod
@@ -129,14 +171,33 @@ class FaultPlan:
           apiserver — conflict storms + watch drops only
           pods      — kills + startup stalls only
           storage   — checkpoint faults only
+          liveness  — hangs, heartbeat drops, restore-side corruption (the
+                      failure modes only the health layer can catch)
         """
         rng = random.Random(f"kftpu-chaos-{profile}-{seed}")
         r = lambda lo, hi: round(rng.uniform(lo, hi), 4)  # noqa: E731
         apiserver = profile in ("default", "apiserver")
         pods = profile in ("default", "pods")
         storage = profile in ("default", "storage")
-        if profile not in ("default", "apiserver", "pods", "storage"):
+        liveness = profile == "liveness"
+        if profile not in ("default", "apiserver", "pods", "storage",
+                           "liveness"):
             raise ValueError(f"unknown chaos profile {profile!r}")
+        if liveness:
+            return cls(
+                seed=seed,
+                pod_hangs=(
+                    PodHang("*", after_running_s=r(0.1, 0.5), times=1),
+                ),
+                heartbeat_drops=(
+                    HeartbeatDrop(rate=r(0.2, 0.5),
+                                  count=rng.randint(5, 15)),
+                ),
+                checkpoint=CheckpointFault(
+                    save_delay_s=0.0, torn_every_n=0,
+                    corrupt_restore_every_n=rng.randint(2, 4),
+                ),
+            )
         return cls(
             seed=seed,
             conflict_storms=(
@@ -183,6 +244,10 @@ class FaultPlan:
             emit("pod-kill", s)
         for s in self.start_stalls:
             emit("start-stall", s)
+        for s in self.pod_hangs:
+            emit("pod-hang", s)
+        for s in self.heartbeat_drops:
+            emit("heartbeat-drop", s)
         if self.checkpoint is not None:
             emit("checkpoint", self.checkpoint)
         return "\n".join(lines) + "\n"
@@ -196,7 +261,10 @@ class FaultPlan:
 
 @dataclass
 class _KillState:
-    spec: PodKill
+    """Budget tracker for a pod-targeting fault (kills and hangs share the
+    spec shape: name_glob / after_running_s / times)."""
+
+    spec: PodKill | PodHang
     remaining: int = field(default=0)
 
     def __post_init__(self):
@@ -222,19 +290,25 @@ class ChaosEngine:
             "watch_drops_total": 0,
             "event_delays_total": 0,
             "pod_kills_total": 0,
+            "pod_hangs_total": 0,
             "pod_failures_injected_total": 0,
             "start_stalls_total": 0,
+            "hb_drops_total": 0,
             "ckpt_saves_delayed_total": 0,
             "ckpt_saves_torn_total": 0,
+            "ckpt_restores_corrupted_total": 0,
         }
         self._storm_budget = {id(s): s.count for s in plan.conflict_storms}
         self._drop_budget = {id(d): d.count for d in plan.watch_drops}
         self._delay_budget = {id(d): d.count for d in plan.event_delays}
         self._stall_budget = {id(s): s.count for s in plan.start_stalls}
+        self._hb_budget = {id(h): h.count for h in plan.heartbeat_drops}
         self._kills = [_KillState(k) for k in plan.pod_kills]
+        self._hangs = [_KillState(h) for h in plan.pod_hangs]
         self._watch_counts: dict[int, int] = {}
         self._killed_uids: set[str] = set()
         self._ckpt_saves = 0
+        self._ckpt_restores = 0
         self._platform = None
         self._cluster = None
         self._runtime = None
@@ -265,7 +339,8 @@ class ChaosEngine:
             self._runtime.chaos = self
         if platform is not None:
             platform.chaos = self
-        if self._kills and self._cluster is not None and self._runtime is not None:
+        if ((self._kills or self._hangs)
+                and self._cluster is not None and self._runtime is not None):
             self._killer = threading.Thread(
                 target=self._kill_loop, name="chaos-killer", daemon=True
             )
@@ -292,9 +367,11 @@ class ChaosEngine:
 
     def quiescent(self) -> bool:
         """True once every BUDGETED fault is spent (storms, drops, delays,
-        kills, stalls) — asserting convergence only makes sense after the
-        armed faults have fully landed. Checkpoint faults are periodic
-        (torn_every_n), not budgeted, so they never block quiescence."""
+        kills, hangs, stalls) — asserting convergence only makes sense
+        after the armed faults have fully landed. Checkpoint faults are
+        periodic (torn_every_n / corrupt_restore_every_n) and heartbeat
+        drops may land inside worker processes (the env carrier) where the
+        engine cannot observe them — neither blocks quiescence."""
         with self._mu:
             return (
                 all(v <= 0 for v in self._storm_budget.values())
@@ -302,6 +379,7 @@ class ChaosEngine:
                 and all(v <= 0 for v in self._delay_budget.values())
                 and all(v <= 0 for v in self._stall_budget.values())
                 and all(k.remaining <= 0 for k in self._kills)
+                and all(h.remaining <= 0 for h in self._hangs)
             )
 
     # ------------------------------------------------- fakecluster hooks
@@ -380,13 +458,14 @@ class ChaosEngine:
             time.sleep(delay)
 
     def _kill_loop(self) -> None:
-        """Watch running pods; kill matching ones per plan. Kills are keyed
-        by pod UID, so a restarted incarnation (same name, new uid) is a
-        fresh target only while a spec still has budget."""
-        due: dict[str, float] = {}
+        """Watch running pods; kill or hang matching ones per plan. Faults
+        are keyed by pod UID, so a restarted incarnation (same name, new
+        uid) is a fresh target only while a spec still has budget."""
+        due: dict[tuple[str, int], float] = {}
         while not self._stop.is_set():
             with self._mu:
-                armed = [k for k in self._kills if k.remaining > 0]
+                armed = [k for k in self._kills + self._hangs
+                         if k.remaining > 0]
             if not armed:
                 return
             now = time.time()  # PodStatus.start_time is wall-clock
@@ -407,17 +486,19 @@ class ChaosEngine:
                     continue
                 started = pod.status.start_time or now
                 fire_at = due.setdefault(
-                    uid, started + ks.spec.after_running_s
+                    (uid, id(ks)), started + ks.spec.after_running_s
                 )
                 if now < fire_at:
                     continue
                 with self._mu:
                     if ks.remaining <= 0 or uid in self._killed_uids:
                         continue
-                    # reserve the budget; restored below if the kill misses
+                    # reserve the budget; restored below if the fault misses
                     ks.remaining -= 1
                     self._killed_uids.add(uid)
-                if not self._fire_kill(pod, ks.spec):
+                fire = (self._fire_hang if isinstance(ks.spec, PodHang)
+                        else self._fire_kill)
+                if not fire(pod, ks.spec):
                     # target vanished between snapshot and injection (e.g.
                     # the pod finished): the budget was NOT spent — the next
                     # matching running pod is still a target
@@ -425,6 +506,29 @@ class ChaosEngine:
                         ks.remaining += 1
                         self._killed_uids.discard(uid)
             self._stop.wait(0.03)
+
+    def _fire_hang(self, pod, spec: PodHang) -> bool:
+        """SIGSTOP the pod's process group: alive, unreapable, silent. The
+        ONLY recovery path is the liveness lease — exit-code detection
+        never fires because there is no exit."""
+        tracer = self._tracer()
+        if tracer is None:
+            return self._fire_hang_inner(pod, spec)
+        # a root span: the hang starts the causal chain the lease detector
+        # will continue (pod_hang -> missed heartbeats -> lease expiry ->
+        # gang restart)
+        with tracer.span("chaos.pod_hang", parent=None, pod=pod.key,
+                         uid=pod.metadata.uid, seed=self.plan.seed) as sp:
+            landed = self._fire_hang_inner(pod, spec)
+            sp.set_attribute("landed", landed)
+            return landed
+
+    def _fire_hang_inner(self, pod, spec: PodHang) -> bool:
+        if not self._runtime.inject_kill(pod.key, signal.SIGSTOP):
+            return False
+        with self._mu:
+            self.metrics["pod_hangs_total"] += 1
+        return True
 
     def _fire_kill(self, pod, spec: PodKill) -> bool:
         """Returns True only when the fault actually landed."""
@@ -483,6 +587,39 @@ class ChaosEngine:
             pass  # pod churned away mid-injection; the drill moves on
         return False
 
+    # ------------------------------------------------- heartbeat hooks
+
+    def on_heartbeat_write(self) -> bool:
+        """Called by an in-process HeartbeatWriter with `.chaos` attached;
+        True means this liveness report is lost in transit."""
+        with self._mu:
+            for h in self.plan.heartbeat_drops:
+                if self._hb_budget.get(id(h), 0) <= 0:
+                    continue
+                if self.rng.random() >= h.rate:
+                    continue
+                self._hb_budget[id(h)] -= 1
+                self.metrics["hb_drops_total"] += 1
+                return True
+        return False
+
+    def pod_env(self, pod) -> dict[str, str]:
+        """Extra env for a pod about to launch (PodRuntime._launch_pod):
+        heartbeat-drop faults cross the process boundary as the
+        KFTPU_HB_DROP carrier, seeded per plan so subprocess workers drop
+        the same schedule every run. The FIRST drop spec rides the env and
+        its `count` is a PER-WORKER budget, enforced (and counted, via
+        HeartbeatWriter.dropped) inside each worker — the engine cannot
+        observe out-of-process drops, so they debit no engine budget and
+        never gate quiescent()."""
+        from kubeflow_tpu.health import ENV_HEARTBEAT_DROP
+
+        for h in self.plan.heartbeat_drops:
+            return {
+                ENV_HEARTBEAT_DROP: f"{h.rate}:{self.plan.seed}:{h.count}"
+            }
+        return {}
+
     # ------------------------------------------------- checkpointer hook
 
     def on_checkpoint_save(self) -> bool:
@@ -502,6 +639,63 @@ class ChaosEngine:
             time.sleep(ck.save_delay_s)
         return torn
 
+    def on_checkpoint_restore(self) -> bool:
+        """Returns True when this restore should find its newest committed
+        step CORRUPTED (bytes flipped post-commit — the bit-rot / partial-
+        overwrite class orbax's atomic rename cannot protect against). The
+        metric is NOT bumped here: an empty dir has nothing to corrupt, so
+        the injector reports back via note_ckpt_corruption_landed only once
+        bytes actually flipped."""
+        ck = self.plan.checkpoint
+        if ck is None or not ck.corrupt_restore_every_n:
+            return False
+        with self._mu:
+            self._ckpt_restores += 1
+            return self._ckpt_restores % ck.corrupt_restore_every_n == 0
+
+    def note_ckpt_corruption_landed(self) -> None:
+        with self._mu:
+            self.metrics["ckpt_restores_corrupted_total"] += 1
+
+
+def corrupt_newest_checkpoint(directory: str) -> int | None:
+    """Flip the leading bytes of the newest committed step's largest
+    payload file (the manifest itself is left intact — the point is a
+    checksum MISMATCH, not a missing manifest). Returns the corrupted step,
+    or None when there is nothing committed to corrupt. Shared by the
+    restore-fault injection and drills that stage corruption directly."""
+    import os
+
+    from kubeflow_tpu.health import CKPT_MANIFEST_NAME
+
+    try:
+        steps = [int(n) for n in os.listdir(directory)
+                 if n.isdigit() and os.path.isdir(os.path.join(directory, n))]
+    except OSError:
+        return None
+    if not steps:
+        return None
+    step = max(steps)
+    root = os.path.join(directory, str(step))
+    candidates = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            if name == CKPT_MANIFEST_NAME or name.endswith(".tmp"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                candidates.append((os.path.getsize(path), path))
+            except OSError:
+                continue
+    if not candidates:
+        return None
+    _size, target = max(candidates)
+    with open(target, "r+b") as fh:
+        head = fh.read(64)
+        fh.seek(0)
+        fh.write(bytes(b ^ 0xFF for b in head))
+    return step
+
 
 class ChaosCheckpointer:
     """Fault-injecting wrapper with the Checkpointer save/restore surface.
@@ -509,7 +703,10 @@ class ChaosCheckpointer:
     Slow saves sleep before committing; torn saves never commit — under
     atomic-rename checkpointing a partial write is exactly a checkpoint
     that fails to become visible, so restore_latest() serves the previous
-    step and the resume path gets exercised against real data loss.
+    step and the resume path gets exercised against real data loss. Armed
+    restore corruption flips bytes in the newest COMMITTED step before the
+    restore, so the verifying checkpointer's quarantine + fallback path is
+    what actually runs.
     """
 
     def __init__(self, inner, engine: ChaosEngine):
@@ -520,6 +717,13 @@ class ChaosCheckpointer:
         if self._engine.on_checkpoint_save():
             return  # torn: the save never becomes visible
         self._inner.save(step, state, metrics=metrics)
+
+    def restore_latest(self, abstract_state):
+        if (self._engine.on_checkpoint_restore()
+                and corrupt_newest_checkpoint(self._inner.directory)
+                is not None):
+            self._engine.note_ckpt_corruption_landed()
+        return self._inner.restore_latest(abstract_state)
 
     def __getattr__(self, name: str):
         return getattr(self._inner, name)

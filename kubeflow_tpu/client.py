@@ -31,7 +31,10 @@ class Platform:
         log_dir: str = ".kubeflow_tpu/pod-logs",
         capacity_chips: int = 8,
         controller_workers: int = 2,
+        liveness=None,
     ):
+        """liveness: optional health.LivenessConfig tuning the hang/straggler
+        failure detector (docs/health.md); None = defaults."""
         from kubeflow_tpu.controller.devservers import (
             NotebookController,
             PVCViewerController,
@@ -47,7 +50,12 @@ class Platform:
         self.cluster.capacity_chips = capacity_chips
         self.pod_runtime = PodRuntime(self.cluster, log_dir=log_dir)
         self.gang_scheduler = GangScheduler(self.cluster)
-        self.controller = JobController(self.cluster, workers=controller_workers)
+        self.controller = JobController(
+            self.cluster, workers=controller_workers, liveness=liveness,
+            # heartbeats live next to the pod logs, so test platforms rooted
+            # in a tmp dir keep their liveness state there too
+            heartbeat_dir=str(Path(log_dir).parent / "heartbeats"),
+        )
         self.experiment_controller = ExperimentController(
             self.cluster, log_reader=self._read_pod_log,
             observation_db=str(Path(log_dir).parent / "sweep-observations.db"),

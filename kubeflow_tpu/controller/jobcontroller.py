@@ -14,6 +14,7 @@ runPolicy.backoffLimit), not a single-pod restart (SURVEY.md §5.3).
 
 from __future__ import annotations
 
+import os
 import time
 
 from kubeflow_tpu.api.common import (
@@ -29,6 +30,7 @@ from kubeflow_tpu.api.common import ObjectMeta
 from kubeflow_tpu.controller.base import ControllerBase
 from kubeflow_tpu.controller.envcontract import synthesize_env
 from kubeflow_tpu.controller.fakecluster import (
+    ConflictError,
     EventType,
     FakeCluster,
     Pod,
@@ -36,10 +38,19 @@ from kubeflow_tpu.controller.fakecluster import (
     PodPhase,
 )
 from kubeflow_tpu.controller.poddefault import apply_pod_defaults
+from kubeflow_tpu.health import (
+    ENV_HEARTBEAT_FILE,
+    HUNG_POD_EXIT_CODE,
+    DeadVerdict,
+    LivenessConfig,
+    LivenessDetector,
+    heartbeat_path,
+    job_heartbeat_dir,
+)
 from kubeflow_tpu.native import Expectations
 from kubeflow_tpu.runtime.rendezvous import LocalResolver
 from kubeflow_tpu.tracing import ENV_TRACE_DIR, ENV_TRACEPARENT, current_context
-from kubeflow_tpu.utils.retry import BackoffPolicy
+from kubeflow_tpu.utils.retry import BackoffPolicy, with_conflict_retry
 
 JOB_NAME_LABEL = "kubeflow-tpu.org/job-name"
 REPLICA_TYPE_LABEL = "kubeflow-tpu.org/replica-type"
@@ -65,12 +76,21 @@ class JobController(ControllerBase):
         workers: int = 1,
         resync_period_s: float = 5.0,
         local_rewrite: bool = True,
+        liveness: LivenessConfig | None = None,
+        heartbeat_dir: str = "",
     ):
         super().__init__(
             cluster, name="job", workers=workers, resync_period_s=resync_period_s
         )
         self.exp = Expectations(ttl_s=30.0)
         self.local_rewrite = local_rewrite
+        # liveness layer (docs/health.md): lease/straggler failure detector
+        # + where worker heartbeat files live; pods get the per-incarnation
+        # path via the env contract (ENV_HEARTBEAT_FILE)
+        self.liveness = LivenessDetector(liveness)
+        self.heartbeat_dir = heartbeat_dir or os.path.join(
+            os.environ.get("KFTPU_STATE_DIR", ".kubeflow_tpu"), "heartbeats"
+        )
         self._resolvers: dict[str, LocalResolver] = {}
         # prometheus-style counters (SURVEY.md §5.5)
         self.metrics.update({
@@ -149,6 +169,7 @@ class JobController(ControllerBase):
             self.wq.forget(key)
             self._resolvers.pop(key, None)
             self._recovery_passes.pop(key, None)
+            self._reap_heartbeats(ns, name)
             return None
 
         st = job.status
@@ -226,6 +247,12 @@ class JobController(ControllerBase):
             )
             return 0.05
 
+        # -- liveness: a hung worker never reaches FAILED on its own — the
+        # lease/straggler detector marks it, then the normal gang-restart
+        # path below takes over on the requeued pass
+        if self.liveness.config.enabled and self._check_liveness(job, key, pods):
+            return 0.0
+
         # -- failure handling (gang semantics)
         failed = [p for p in pods if p.status.phase == PodPhase.FAILED]
         if failed:
@@ -265,7 +292,21 @@ class JobController(ControllerBase):
         if _status_fingerprint(st) != entry_fp:
             st.last_reconcile_time = _now_ts()
             self.cluster.update("jobs", job)
-        return 0.2 if created else None
+        if created:
+            return 0.2
+        # lease cadence: while MONITORED workers run (heartbeat file exists
+        # — the same opt-in-by-behavior rule the detector applies), re-check
+        # liveness a few times per timeout window instead of waiting out the
+        # 5s resync, which would make small timeouts undetectable within
+        # their own window. Never-beating legacy jobs stay on resync cadence.
+        if self.liveness.config.enabled and any(
+            p.status.phase == PodPhase.RUNNING
+            and (hb := p.env.get(ENV_HEARTBEAT_FILE))
+            and os.path.exists(hb)
+            for p in pods
+        ):
+            return self.liveness.config.requeue_delay()
+        return None
 
     # ---------------------------------------------------------- sub-steps
 
@@ -343,6 +384,15 @@ class JobController(ControllerBase):
             if self.local_rewrite:
                 env = resolver.rewrite_env(env)
             env.update(trace_env)
+            # liveness contract: a per-INCARNATION heartbeat path (the
+            # restart count is baked into the name, so a restarted gang is
+            # never judged by its predecessor's stale file). setdefault: a
+            # user-supplied path wins, like the rest of the env contract.
+            env.setdefault(ENV_HEARTBEAT_FILE, heartbeat_path(
+                self.heartbeat_dir, job.metadata.namespace,
+                job.metadata.name, job.replica_name(rtype, i),
+                job.status.restart_count,
+            ))
             c = job.spec.replica_specs[rtype].template.container
             # job-level labels (e.g. the experiment label) propagate to pods,
             # mirroring k8s template-label propagation
@@ -406,6 +456,72 @@ class JobController(ControllerBase):
             priority=resolve_priority(sp.priority_class if sp else ""),
         )
         self.cluster.create("podgroups", pg)
+
+    def _check_liveness(self, job: TrainJob, key: str, pods: list[Pod]) -> int:
+        """Run the lease/straggler detector over this gang and mark every
+        verdict's pod FAILED. Returns how many pods were declared dead —
+        the caller requeues immediately so the SAME gang-restart machinery
+        that handles crashes handles hangs."""
+        declared = 0
+        for v in self.liveness.check(pods):
+            if self._declare_pod_dead(key, v):
+                declared += 1
+        return declared
+
+    def _declare_pod_dead(self, key: str, v: DeadVerdict) -> bool:
+        """Conflict-retried, incarnation-guarded FAILED write for one
+        liveness verdict, inside a health.* span whose context rides the
+        pod object (CARRIER_ANNOTATION) — the gang restart parent-links to
+        the detection, exactly like it links to a crash's exit span."""
+        tracer = self.cluster.tracer
+        span_name = (
+            "health.lease_expired" if v.reason == "LivenessLeaseExpired"
+            else "health.straggler"
+        )
+
+        def declare(carrier: str) -> bool:
+            def attempt():
+                cur = self.cluster.get("pods", v.key, copy_obj=True)
+                if cur is None or cur.metadata.uid != v.uid:
+                    return None
+                if cur.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                    return None  # raced a real exit: its verdict wins
+                cur.status.phase = PodPhase.FAILED
+                cur.status.exit_code = HUNG_POD_EXIT_CODE
+                cur.status.finish_time = time.time()
+                cur.status.message = f"{v.reason}: {v.message}"
+                if carrier:
+                    from kubeflow_tpu.tracing import CARRIER_ANNOTATION
+
+                    cur.metadata.annotations[CARRIER_ANNOTATION] = carrier
+                return self.cluster.update("pods", cur)
+
+            try:
+                return with_conflict_retry(attempt) is not None
+            except (ConflictError, KeyError):
+                return False  # churned away mid-declaration; next pass re-checks
+
+        if tracer is None:
+            ok = declare("")
+        else:
+            with tracer.span(span_name, pod=v.key, uid=v.uid,
+                             heartbeat_age_s=round(v.heartbeat_age_s, 3),
+                             step=v.step) as sp:
+                ctx = sp.context
+                ok = declare(ctx.to_header() if ctx is not None else "")
+                sp.set_attribute("declared", ok)
+        if ok:
+            self.liveness.bump("pods_declared_dead_total")
+            self.liveness.bump(
+                "leases_expired_total"
+                if v.reason == "LivenessLeaseExpired"
+                else "stragglers_declared_total")
+            self.cluster.record_event(
+                "pods", v.key, v.reason, v.message, type="Warning")
+            self.cluster.record_event(
+                "jobs", key, v.reason,
+                f"{v.key}: {v.message}", type="Warning")
+        return ok
 
     def _handle_failures(
         self, job: TrainJob, key: str, pods: list[Pod], failed: list[Pod]
@@ -544,9 +660,23 @@ class JobController(ControllerBase):
             age = time.time() - _parse_ts(job.status.completion_time)
             if age >= ttl:
                 self.cluster.delete("jobs", key)
+                self._reap_heartbeats(
+                    job.metadata.namespace, job.metadata.name)
                 return None
             return ttl - age
         return None
+
+    def _reap_heartbeats(self, namespace: str, name: str) -> None:
+        """Remove a deleted job's heartbeat subtree — incarnation files are
+        small but unbounded over crashloops, and a stale file must never
+        greet a later same-named job (the pid gate would filter it, but the
+        disk growth would not filter itself)."""
+        import shutil
+
+        shutil.rmtree(
+            job_heartbeat_dir(self.heartbeat_dir, namespace, name),
+            ignore_errors=True,
+        )
 
     def _fail(
         self, job: TrainJob, key: str, pods: list[Pod], reason: str, msg: str

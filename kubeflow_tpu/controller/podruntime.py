@@ -21,6 +21,7 @@ from kubeflow_tpu.controller.fakecluster import (
     Pod,
     PodPhase,
 )
+from kubeflow_tpu.health import ENV_HEARTBEAT_FILE, read_heartbeat
 from kubeflow_tpu.tracing import (
     CARRIER_ANNOTATION,
     consume_delivered_context,
@@ -88,6 +89,10 @@ class PodRuntime:
         # launch under the same key and must not steal its context
         self._launch_ctx: dict[tuple[str, str], object] = {}
         self._kill_ctx: dict[tuple[str, str], object] = {}
+        # liveness side table: heartbeat file per live incarnation (from the
+        # pod env contract), so the kubelet layer can surface per-pod
+        # heartbeat age (kftpu_health_heartbeat_age_seconds)
+        self._hb_paths: dict[tuple[str, str], str] = {}
 
     # ---------------------------------------------------------------- lifecycle
 
@@ -220,7 +225,8 @@ class PodRuntime:
         # its context is kept so pod.exit can link back to this incarnation
         with tracer.span("pod.launch", parent=trigger, pod=pod.key,
                          uid=pod.metadata.uid, node=pod.status.node) as sp:
-            self._launch_ctx[(pod.key, pod.metadata.uid)] = sp.context
+            with self._mu:  # _kill sweeps these tables under the lock
+                self._launch_ctx[(pod.key, pod.metadata.uid)] = sp.context
             return self._launch_pod(pod)
 
     def _launch_pod(self, pod: Pod) -> None:
@@ -245,6 +251,10 @@ class PodRuntime:
             log_path.parent.mkdir(parents=True, exist_ok=True)
             env = dict(os.environ) if self.inherit_env else {}
             env.update(pod.env)
+            if self.chaos is not None:
+                # cross-process fault carriers (e.g. seeded heartbeat-write
+                # drops) ride the env into the worker
+                env.update(self.chaos.pod_env(pod))
             command = list(pod.command)
             if command and command[0] in ("python", "python3"):
                 # symbolic interpreter: manifests and remote clients say
@@ -276,6 +286,9 @@ class PodRuntime:
                 )
                 return
             self._procs[pod.key] = (pod.metadata.uid, proc)
+            hb_path = pod.env.get(ENV_HEARTBEAT_FILE, "")
+            if hb_path:
+                self._hb_paths[(pod.key, pod.metadata.uid)] = hb_path
 
         def running(p, pid=proc.pid):
             p.status.phase = PodPhase.RUNNING
@@ -302,6 +315,7 @@ class PodRuntime:
             held = self._procs.get(key)
             if held is not None and held[1] is proc:
                 self._procs.pop(key, None)
+            self._hb_paths.pop((key, uid), None)
 
         def finished(p):
             if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
@@ -324,9 +338,11 @@ class PodRuntime:
         # context: kill -> exit -> (watch) -> reconcile is one chain
         # pop BOTH side-table entries (a short-circuiting `or` of pops
         # would leak the launch ctx of every killed incarnation), then
-        # prefer the kill as the more causal parent
-        kill_ctx = self._kill_ctx.pop((key, uid), None)
-        launch_ctx = self._launch_ctx.pop((key, uid), None)
+        # prefer the kill as the more causal parent; locked so _kill's
+        # table sweep never iterates a dict resizing under it
+        with self._mu:
+            kill_ctx = self._kill_ctx.pop((key, uid), None)
+            launch_ctx = self._launch_ctx.pop((key, uid), None)
         parent = kill_ctx or launch_ctx
         with tracer.span("pod.exit", parent=parent, pod=key, uid=uid,
                          exit_code=code) as sp:
@@ -348,12 +364,14 @@ class PodRuntime:
             self._update_pod_status(key, uid, finished_with_carrier)
 
     def _kill(self, key: str) -> None:
-        # drop side-table entries for EVERY incarnation of this key (the
-        # dicts are small: bounded by live pods plus in-flight reaps)
-        for table in (self._launch_ctx, self._kill_ctx):
-            for k in [k for k in table if k[0] == key]:
-                table.pop(k, None)
         with self._mu:
+            # drop side-table entries for EVERY incarnation of this key (the
+            # dicts are small: bounded by live pods plus in-flight reaps);
+            # under the lock — a reaper popping concurrently would resize
+            # the dict mid-iteration
+            for table in (self._launch_ctx, self._kill_ctx, self._hb_paths):
+                for k in [k for k in table if k[0] == key]:
+                    table.pop(k, None)
             held = self._procs.pop(key, None)
         if held is not None:
             _, proc = held
@@ -364,6 +382,23 @@ class PodRuntime:
                     proc.kill()
                 except ProcessLookupError:
                     pass
+
+    # -------------------------------------------------------------- liveness
+
+    def heartbeat_ages(self, now: float | None = None) -> dict[tuple[str, str], float]:
+        """Per-incarnation heartbeat age in seconds for every live pod that
+        has heartbeat at least once — the kubelet-side liveness surface
+        (exported as kftpu_health_heartbeat_age_seconds). Pods that never
+        beat are absent: they are unmonitored, not stale."""
+        now = time.time() if now is None else now
+        with self._mu:
+            entries = list(self._hb_paths.items())
+        out: dict[tuple[str, str], float] = {}
+        for (key, uid), path in entries:
+            hb = read_heartbeat(path)
+            if hb is not None:
+                out[(key, uid)] = max(now - hb.ts, 0.0)
+        return out
 
     # ---------------------------------------------------------------- faults
 
@@ -379,7 +414,8 @@ class PodRuntime:
             # keyed to the incarnation actually being killed
             ctx = current_context()
             if ctx is not None:
-                self._kill_ctx[(key, held[0])] = ctx
+                with self._mu:  # _kill sweeps these tables under the lock
+                    self._kill_ctx[(key, held[0])] = ctx
         _, proc = held
         try:
             os.killpg(proc.pid, sig)

@@ -1,0 +1,406 @@
+"""Liveness layer — heartbeat leases, hang/straggler detection, checkpoint
+integrity accounting.
+
+Exit-code failure detection (podruntime reaping a dead process) only covers
+workers that *die*. At pod scale the dominant loss mode is the worker that
+*hangs* — a deadlocked collective, a stuck data loader, a silent stall — which
+never reaches PodPhase.FAILED and wedges the whole gang forever (arxiv
+2011.03641 / 1909.09756 both attribute lost pod-hours primarily to
+stragglers and hangs, not clean crashes). This module closes that gap:
+
+  - Workers emit monotonic heartbeats (step number + wall time + pid) to a
+    per-incarnation file named by the KFTPU_HEARTBEAT_FILE env var, which the
+    job controller injects next to KFTPU_TRACE_DIR. The trainer beats every
+    optimizer step; runtime/distributed.py beats around rendezvous.
+  - A lease-based failure detector (LivenessDetector, driven from
+    jobcontroller reconcile passes) declares a pod dead when its lease
+    expires — no fresh heartbeat within `liveness_timeout_s` — or when it
+    straggles: >= `straggler_steps` behind the gang's median step
+    continuously for `straggler_window_s`. Declared pods are marked FAILED
+    (retryable 128+ exit code) so the existing gang-restart-from-checkpoint
+    path takes over; counters are distinct from crash deaths
+    (kftpu_health_* via observability.py).
+  - train/checkpoint.py keeps its integrity counters here (module-global:
+    checkpointers live in whichever process opened them), exported as
+    kftpu_ckpt_verify_*.
+
+Monitoring is opt-in by behavior: a pod that never writes a heartbeat is
+never lease-judged (exit-code detection still applies), so workloads that
+predate the contract cannot be false-positived into a gang restart.
+
+Dependency-light by design (stdlib only): imported by the controller, the
+trainer, the distributed bootstrap, and chaos without dragging jax in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import statistics
+import threading
+import time
+from dataclasses import dataclass, field
+
+#: env var naming the heartbeat file one worker incarnation writes
+ENV_HEARTBEAT_FILE = "KFTPU_HEARTBEAT_FILE"
+#: chaos carrier for heartbeat-write drops: "rate:seed:count" (see
+#: chaos.HeartbeatDrop) — parsed by HeartbeatWriter.from_env so subprocess
+#: workers drop writes deterministically without reaching the engine
+ENV_HEARTBEAT_DROP = "KFTPU_HB_DROP"
+
+#: exit code stamped on a pod declared dead by the detector: >= 128 so
+#: RestartPolicy.EXIT_CODE treats a hang like infrastructure loss
+#: (retryable), never like an application bug (permanent)
+HUNG_POD_EXIT_CODE = 137
+
+#: filename of the per-step integrity manifest train/checkpoint.py writes
+#: inside each committed checkpoint step directory (defined here so
+#: chaos.py can corrupt around it without importing orbax)
+CKPT_MANIFEST_NAME = "kftpu-manifest.json"
+
+
+# ----------------------------------------------------------------- heartbeats
+
+
+@dataclass(frozen=True)
+class Heartbeat:
+    """One liveness sample: the newest progress a worker claims."""
+
+    step: int
+    phase: str
+    ts: float
+    pid: int
+
+
+def read_heartbeat(path: str) -> Heartbeat | None:
+    """Parse a heartbeat file; None when missing/partial (a torn write is
+    indistinguishable from no write — the atomic-rename writer makes torn
+    reads impossible in practice, but a corrupt file must not crash the
+    detector)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            raw = json.load(fh)
+        return Heartbeat(
+            step=int(raw["step"]), phase=str(raw.get("phase", "")),
+            ts=float(raw["ts"]), pid=int(raw.get("pid", 0)),
+        )
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+class HeartbeatWriter:
+    """Atomic heartbeat emitter for one worker incarnation.
+
+    Every beat() rewrites the file via tmp + os.replace, so readers always
+    see a complete JSON document. Beats inside `min_interval_s` of the last
+    write are throttled regardless of content — a fast training loop must
+    not turn liveness into per-step fsync traffic, and a 50ms reporting
+    floor is invisible next to lease/straggler windows measured in seconds.
+    """
+
+    def __init__(self, path: str, min_interval_s: float = 0.05):
+        self.path = path
+        self.min_interval_s = min_interval_s
+        #: chaos attachment point (ChaosEngine.on_heartbeat_write) for
+        #: in-process drills; None in production
+        self.chaos = None
+        self._last_ts = 0.0
+        self.written = 0
+        self.dropped = 0
+        self._drop_rng: random.Random | None = None
+        self._drop_rate = 0.0
+        self._drop_budget = 0
+        try:  # once, not per beat; re-attempted in beat() if racing cleanup
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        except OSError:
+            pass
+
+    @classmethod
+    def from_env(cls) -> "HeartbeatWriter | None":
+        """Writer per the pod env contract; None when the pod carries no
+        heartbeat path (standalone runs). KFTPU_HB_DROP ("rate:seed:count")
+        arms deterministic chaos drops for subprocess workers."""
+        path = os.environ.get(ENV_HEARTBEAT_FILE, "")
+        if not path:
+            return None
+        w = cls(path)
+        drop = os.environ.get(ENV_HEARTBEAT_DROP, "")
+        if drop:
+            try:
+                rate, seed, count = drop.split(":")
+                w._drop_rate = float(rate)
+                w._drop_rng = random.Random(int(seed))
+                w._drop_budget = int(count)
+            except ValueError:
+                pass  # malformed chaos carrier: drops simply stay unarmed
+        return w
+
+    def _dropped_by_chaos(self) -> bool:
+        if self.chaos is not None and self.chaos.on_heartbeat_write():
+            return True
+        if (
+            self._drop_rng is not None
+            and self._drop_budget > 0
+            and self._drop_rng.random() < self._drop_rate
+        ):
+            self._drop_budget -= 1
+            return True
+        return False
+
+    def beat(self, step: int = -1, phase: str = "train") -> bool:
+        """Record liveness; returns True when a write actually landed."""
+        now = time.time()
+        if now - self._last_ts < self.min_interval_s:
+            return False
+        if self._dropped_by_chaos():
+            self.dropped += 1
+            return False
+        payload = json.dumps(
+            {"step": step, "phase": phase, "ts": now, "pid": os.getpid()}
+        )
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            try:
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    fh.write(payload)
+            except FileNotFoundError:  # parent dir raced away post-__init__
+                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    fh.write(payload)
+            os.replace(tmp, self.path)
+        except OSError:
+            return False  # liveness reporting must never kill the worker
+        self._last_ts = now
+        self.written += 1
+        return True
+
+
+def heartbeat_path(
+    root: str, namespace: str, job_name: str, pod_name: str, incarnation: int
+) -> str:
+    """Per-incarnation heartbeat file path. The incarnation (the job's
+    restart_count at pod-create time) is part of the name so a restarted
+    gang never reads — or is judged by — its predecessor's stale file."""
+    return os.path.abspath(
+        os.path.join(root, namespace, job_name, f"{pod_name}-r{incarnation}.hb")
+    )
+
+
+def job_heartbeat_dir(root: str, namespace: str, job_name: str) -> str:
+    """The per-job directory heartbeat_path files live under — removed
+    wholesale when the job is deleted, so incarnation files never outlive
+    (or get misread by) a later same-named job."""
+    return os.path.abspath(os.path.join(root, namespace, job_name))
+
+
+# ------------------------------------------------------------------- detector
+
+
+@dataclass(frozen=True)
+class LivenessConfig:
+    """Tuning for the lease/straggler failure detector (docs/health.md).
+
+    liveness_timeout_s must exceed the longest legitimate heartbeat gap —
+    first-step compilation, full-dataset eval — or healthy gangs get
+    restarted; the trainer beats per step, so nothing refreshes a lease
+    DURING a multi-minute XLA compile. The default is therefore
+    deliberately generous (5 min): a wedged gang is still reclaimed, while
+    big-model compiles pass undisturbed — tighten it per job once the real
+    step cadence is known. straggler_steps/window catch the worker that is
+    alive and beating but not progressing with the gang.
+    """
+
+    liveness_timeout_s: float = 300.0
+    straggler_steps: int = 500
+    straggler_window_s: float = 120.0
+    enabled: bool = True
+
+    def requeue_delay(self) -> float:
+        """Reconcile cadence while pods are monitored: 4 checks per lease
+        window, bounded so tiny drill timeouts don't hot-loop the queue and
+        production timeouts still re-check every couple of seconds."""
+        return min(max(self.liveness_timeout_s / 4.0, 0.05), 2.0)
+
+
+@dataclass(frozen=True)
+class DeadVerdict:
+    """One pod the detector wants declared failed."""
+
+    key: str
+    uid: str
+    reason: str          # "LivenessLeaseExpired" | "StragglerDetected"
+    message: str
+    heartbeat_age_s: float
+    step: int
+
+
+class LivenessDetector:
+    """Pure decision core of the liveness layer: given one gang's pods,
+    return which are dead by lease or straggling. The job controller owns
+    acting on the verdicts (status writes, events, spans); this class owns
+    only reading heartbeats and the per-incarnation straggler windows, so
+    it is unit-testable without a cluster."""
+
+    def __init__(self, config: LivenessConfig | None = None):
+        self.config = config or LivenessConfig()
+        self.metrics: dict[str, int] = {
+            "leases_expired_total": 0,
+            "stragglers_declared_total": 0,
+            "pods_declared_dead_total": 0,
+            "heartbeats_observed_total": 0,
+        }
+        #: (pod key, uid) -> when the incarnation first fell >= K steps
+        #: behind the gang median (cleared the moment it catches up)
+        self._behind: dict[tuple[str, str], float] = {}
+        #: one detector serves EVERY job the controller reconciles, and
+        #: reconcile workers run concurrently — counter += and the _behind
+        #: windows are read-modify-write, same guard discipline as
+        #: ControllerBase's latency histogram
+        self._mu = threading.Lock()
+
+    def bump(self, name: str, n: int = 1) -> None:
+        with self._mu:
+            self.metrics[name] = self.metrics.get(name, 0) + n
+
+    def observe(self, pod) -> tuple[Heartbeat | None, str]:
+        """The pod's current heartbeat, pid-gated to its incarnation.
+
+        Returns (heartbeat, path). A file whose pid does not match the
+        running process is a leftover from some earlier same-named pod and
+        must neither prove nor disprove liveness.
+        """
+        path = pod.env.get(ENV_HEARTBEAT_FILE, "")
+        if not path:
+            return None, ""
+        hb = read_heartbeat(path)
+        if hb is None:
+            return None, path
+        if pod.status.pid and hb.pid and hb.pid != pod.status.pid:
+            return None, path
+        return hb, path
+
+    def check(self, pods, now: float | None = None) -> list[DeadVerdict]:
+        """Evaluate one gang. Only RUNNING pods that have heartbeat at least
+        once are lease-judged (monitoring is opt-in by behavior); straggler
+        judgment additionally needs >= 2 monitored peers to define a median
+        worth being behind."""
+        cfg = self.config
+        if not cfg.enabled:
+            return []
+        now = time.time() if now is None else now
+        with self._mu:
+            return self._check_locked(pods, now)
+
+    def _check_locked(self, pods, now: float) -> list[DeadVerdict]:
+        cfg = self.config
+        from kubeflow_tpu.controller.fakecluster import PodPhase
+
+        monitored: list[tuple] = []  # (pod, heartbeat)
+        live_keys: set[tuple[str, str]] = set()
+        gang_keys: set[str] = set()
+        for pod in pods:
+            gang_keys.add(pod.key)
+            if pod.status.phase != PodPhase.RUNNING:
+                continue
+            live_keys.add((pod.key, pod.metadata.uid))
+            hb, _path = self.observe(pod)
+            if hb is not None:
+                monitored.append((pod, hb))
+                self.metrics["heartbeats_observed_total"] += 1
+        # prune straggler windows of THIS gang's replaced/stopped
+        # incarnations only — the detector is shared across every job the
+        # controller reconciles, and a per-call global prune would wipe the
+        # other gangs' open windows on every pass. Entries of deleted jobs
+        # are bounded by the backstop below.
+        for k in [
+            k for k in self._behind
+            if k[0] in gang_keys and k not in live_keys
+        ]:
+            self._behind.pop(k, None)
+        if len(self._behind) > 4096:  # leak backstop (deleted jobs)
+            self._behind.clear()
+
+        verdicts: list[DeadVerdict] = []
+        for pod, hb in monitored:
+            # the lease baseline is the newest of (heartbeat, process
+            # start): a just-started incarnation is never judged by a file
+            # that predates it
+            baseline = max(hb.ts, pod.status.start_time or 0.0)
+            age = now - baseline
+            if age > cfg.liveness_timeout_s:
+                verdicts.append(DeadVerdict(
+                    key=pod.key, uid=pod.metadata.uid,
+                    reason="LivenessLeaseExpired",
+                    message=(
+                        f"no heartbeat for {age:.1f}s "
+                        f"(> liveness_timeout {cfg.liveness_timeout_s}s; "
+                        f"last step {hb.step}, phase {hb.phase!r})"
+                    ),
+                    heartbeat_age_s=age, step=hb.step,
+                ))
+        dead = {(v.key, v.uid) for v in verdicts}
+
+        progressing = [
+            (pod, hb) for pod, hb in monitored
+            if (pod.key, pod.metadata.uid) not in dead and hb.step >= 0
+        ]
+        if len(progressing) >= 2 and cfg.straggler_steps > 0:
+            median = statistics.median(hb.step for _, hb in progressing)
+            for pod, hb in progressing:
+                k = (pod.key, pod.metadata.uid)
+                if median - hb.step >= cfg.straggler_steps:
+                    first = self._behind.setdefault(k, now)
+                    lag = now - first
+                    if lag >= cfg.straggler_window_s:
+                        self._behind.pop(k, None)
+                        verdicts.append(DeadVerdict(
+                            key=pod.key, uid=pod.metadata.uid,
+                            reason="StragglerDetected",
+                            message=(
+                                f"step {hb.step} is "
+                                f"{median - hb.step:.0f} behind gang median "
+                                f"{median:.0f} for {lag:.1f}s "
+                                f"(>= {cfg.straggler_steps} steps for "
+                                f"{cfg.straggler_window_s}s)"
+                            ),
+                            heartbeat_age_s=now - hb.ts, step=hb.step,
+                        ))
+                else:
+                    self._behind.pop(k, None)
+        return verdicts
+
+
+# ------------------------------------- checkpoint-verify counters (global)
+
+#: process-global integrity counters for train/checkpoint.py — checkpointers
+#: are constructed ad hoc (trainer, pipelines, drills), so a per-instance
+#: dict would be invisible to /metrics; observability.py exports this
+#: registry as kftpu_ckpt_verify_*
+_CKPT_MU = threading.Lock()
+_CKPT_VERIFY_METRICS: dict[str, int] = {
+    "manifests_written_total": 0,
+    "steps_verified_total": 0,
+    "steps_corrupt_total": 0,
+    "steps_quarantined_total": 0,
+    "fallback_restores_total": 0,
+    "unverified_restores_total": 0,
+}
+
+
+def ckpt_verify_bump(name: str, n: int = 1) -> None:
+    with _CKPT_MU:
+        _CKPT_VERIFY_METRICS[name] = _CKPT_VERIFY_METRICS.get(name, 0) + n
+
+
+def ckpt_verify_snapshot() -> dict[str, int]:
+    with _CKPT_MU:
+        return dict(_CKPT_VERIFY_METRICS)
+
+
+def reset_ckpt_verify_metrics() -> None:
+    """Test hook: the registry is process-global, so exposition-golden tests
+    zero it to pin the fresh-process surface."""
+    with _CKPT_MU:
+        for k in _CKPT_VERIFY_METRICS:
+            _CKPT_VERIFY_METRICS[k] = 0

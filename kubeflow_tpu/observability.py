@@ -48,6 +48,32 @@ def render_metrics(platform) -> str:
             ctrl.latency_buckets, counts, total,
         )
 
+    # liveness layer (kubeflow_tpu/health.py): lease expiries and straggler
+    # declarations counted apart from crash deaths, plus per-incarnation
+    # heartbeat age straight from the kubelet layer's side table
+    liveness = getattr(getattr(platform, "controller", None), "liveness", None)
+    if liveness is not None:
+        for mname, v in sorted(liveness.metrics.items()):
+            counter(f"kftpu_health_{mname}", v)
+    runtime = getattr(platform, "pod_runtime", None)
+    if runtime is not None:
+        ages = runtime.heartbeat_ages()
+        if ages:
+            lines.append("# TYPE kftpu_health_heartbeat_age_seconds gauge")
+            for (key, uid), age in sorted(ages.items()):
+                lines.append(
+                    f'kftpu_health_heartbeat_age_seconds'
+                    f'{{pod="{key}",uid="{uid}"}} {age:.3f}'
+                )
+
+    # checkpoint integrity verification (train/checkpoint.py): the registry
+    # is process-global — checkpointers are constructed ad hoc by trainers,
+    # drills, and pipelines, and all of them report here
+    from kubeflow_tpu.health import ckpt_verify_snapshot
+
+    for mname, v in sorted(ckpt_verify_snapshot().items()):
+        counter(f"kftpu_ckpt_verify_{mname}", v)
+
     # chaos-drill injection counters (kubeflow_tpu/chaos.py): exported so
     # recovery behavior is measurable against what was actually injected
     chaos = getattr(platform, "chaos", None)

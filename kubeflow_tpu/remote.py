@@ -129,20 +129,33 @@ class RemoteClient:
     # ------------------------------------------------------------------ watch
 
     def watch(self, kind: str, namespace: str = "", name: str = "",
-              timeout_s: float = 60.0):
+              timeout_s: float = 60.0, keepalive_s: float = 10.0):
         """NDJSON watch stream: yields {"type": ..., "object": ...} events
         (list+watch: current objects arrive first as ADDED). Terminates when
-        the server-side timeout elapses."""
+        the server-side timeout elapses.
+
+        Deadness detection: the server guarantees at least one line per
+        keepalive_s (KEEPALIVE lines, filtered out here). The socket read
+        timeout is set to ~2x that budget, so a stream with NO bytes past it
+        — a dropped connection, previously indistinguishable from a quiet
+        one — raises TimeoutError/OSError: callers (see _wait_terminal)
+        treat it as dead, close, and relist."""
         q = urllib.parse.urlencode({
             "watch": "true", "timeoutSeconds": f"{timeout_s:.0f}",
+            "keepaliveSeconds": f"{keepalive_s:g}",
             **({"namespace": namespace} if namespace else {}),
             **({"name": name} if name else {}),
         })
         req = urllib.request.Request(f"{self.server}/api/v1/{kind}?{q}")
-        with urllib.request.urlopen(req, timeout=timeout_s + 10.0) as resp:
+        quiet_budget = max(2.0 * keepalive_s + 2.0, 5.0)
+        with urllib.request.urlopen(req, timeout=quiet_budget) as resp:
             for line in resp:
-                if line.strip():
-                    yield json.loads(line)
+                if not line.strip():
+                    continue
+                ev = json.loads(line)
+                if ev.get("type") == "KEEPALIVE":
+                    continue  # liveness only — never an API event
+                yield ev
 
     def wait_for_job(self, name: str, namespace: str = "default",
                      timeout_s: float = 600.0, poll_s: float = 0.5) -> dict:

@@ -59,6 +59,14 @@ def initialize_from_env(
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     n = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
     pid = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    # liveness: announce this incarnation BEFORE anything that can wedge
+    # (jax import, distributed rendezvous) — a worker stuck right here is
+    # exactly the hang the lease detector exists for (docs/health.md)
+    from kubeflow_tpu.health import HeartbeatWriter
+
+    hb = HeartbeatWriter.from_env()
+    if hb is not None:
+        hb.beat(step=-1, phase="rendezvous")
     # multislice contract: on real Cloud TPU these are consumed by libtpu's
     # megascale transport; here they carry the slice topology into the mesh
     # builder (slice-major device order => data-like axes ride DCN)
@@ -102,6 +110,9 @@ def initialize_from_env(
             jax.distributed.initialize(
                 coordinator_address=coord, num_processes=n, process_id=pid
             )
+    if hb is not None:
+        # the gang is formed: subsequent beats come from the training loop
+        hb.beat(step=-1, phase="rendezvous-done")
     return DistContext(
         process_id=pid, num_processes=n, coordinator=coord,
         num_slices=num_slices, slice_id=slice_id,

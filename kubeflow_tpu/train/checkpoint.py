@@ -1,19 +1,37 @@
-"""Checkpoint/resume contract — orbax-backed.
+"""Checkpoint/resume contract — orbax-backed, with integrity verification.
 
 Reference parity: the platform delegates checkpointing to frameworks and
 guarantees restart semantics + durable paths (SURVEY.md §5.4). Here orbax
 async checkpointing is the in-tree contract; the controller guarantees the
 same checkpoint dir across gang restarts, so `restore_latest` + step-offset
 resume is all a trainer needs for fault tolerance.
+
+Integrity layer (docs/health.md): orbax's atomic-rename commit protects
+against *torn* saves (a partial write never becomes visible), but not
+against a committed step whose bytes later rot or get scribbled on — and a
+corrupt NEWEST step turns "restart from checkpoint" into a crash loop.
+Every committed step therefore gets a content-checksum manifest
+(kftpu-manifest.json inside the step dir); restore_latest verifies the
+chosen step against it, quarantines a corrupt step out of the checkpoint
+tree, and falls back to the previous verified step. Counters land in the
+process-global kftpu_ckpt_verify_* registry (kubeflow_tpu/health.py) and a
+fallback opens a `checkpoint.fallback` span in the worker's trace.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import shutil
+import threading
+import time
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+from kubeflow_tpu.health import CKPT_MANIFEST_NAME, ckpt_verify_bump
 
 
 class Checkpointer:
@@ -21,27 +39,41 @@ class Checkpointer:
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  async_save: bool = True, keep_best_metric: str | None = None,
-                 best_mode: str = "max"):
+                 best_mode: str = "max", verify: bool = True):
         """keep_best_metric: retain the max_to_keep BEST checkpoints by this
         eval-metric key (passed via save(metrics=...)) instead of the newest
-        — the model-selection contract (restore_best serves the winner)."""
+        — the model-selection contract (restore_best serves the winner).
+        verify: write per-step checksum manifests and verify-on-restore with
+        quarantine + fallback (docs/health.md)."""
         self.directory = os.path.abspath(directory)
         self.keep_best_metric = keep_best_metric
+        self.verify = verify
         os.makedirs(self.directory, exist_ok=True)
-        best_kw = {}
+        self._mgr_kwargs = dict(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+        )
         if keep_best_metric:
-            best_kw = dict(
+            self._mgr_kwargs.update(
                 best_fn=lambda m: float(m[keep_best_metric]),
                 best_mode=best_mode,
             )
-        self._mgr = ocp.CheckpointManager(
+        self._async = async_save
+        self._manifest_mu = threading.Lock()
+        self._mgr = self._open()
+
+    def _open(self):
+        return ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                enable_async_checkpointing=async_save,
-                **best_kw,
-            ),
+            options=ocp.CheckpointManagerOptions(**self._mgr_kwargs),
         )
+
+    def _reopen(self) -> None:
+        """Rebuild the orbax manager after the on-disk step set changed
+        underneath it (a quarantine): its cached step list must not keep
+        serving — or GC'ing — a step that is no longer there."""
+        self._mgr.close()
+        self._mgr = self._open()
 
     def save(self, step: int, state: Any,
              metrics: dict | None = None) -> None:
@@ -58,6 +90,18 @@ class Checkpointer:
             step, args=ocp.args.StandardSave(state),
             **({"metrics": metrics} if metrics is not None else {}),
         )
+        if self.verify:
+            # sync mode: the step is committed, manifest inline. Async mode
+            # hashes on a helper thread that first WAITS for this step's
+            # commit to land — the whole point of async checkpointing is
+            # that the training loop never blocks on checkpoint-sized I/O,
+            # but the newest step is exactly the one a crash leaves behind,
+            # so it must not stay unmanifested until the next save.
+            if self._async:
+                self._spawn_manifest_writer(step)
+            else:
+                with self._manifest_mu:
+                    self._write_manifests()
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -66,7 +110,11 @@ class Checkpointer:
         return self._mgr.best_step()
 
     def restore_best(self, abstract_state: Any) -> tuple[int, Any] | None:
-        """Restore the best-metric checkpoint (keep_best_metric mode)."""
+        """Restore the best-metric checkpoint (keep_best_metric mode).
+
+        Verification applies but fallback does not: "second-best" is not a
+        meaningful stand-in for a corrupt best — the step is quarantined and
+        None returned so the caller decides."""
         if not self.keep_best_metric:
             # orbax best_step() falls back to latest_step() when best
             # tracking is off — silently serving the newest (possibly
@@ -79,23 +127,210 @@ class Checkpointer:
         step = self._mgr.best_step()
         if step is None:
             return None
+        if self.verify:
+            verdict = self._verify_step(step)
+            if verdict is False:
+                self._quarantine(step)
+                return None
+            # same accounting contract as restore_latest: model-selection
+            # restores must not vanish from the kftpu_ckpt_verify_* series
+            ckpt_verify_bump(
+                "steps_verified_total" if verdict
+                else "unverified_restores_total")
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract_state)
         )
         return step, restored
 
     def restore_latest(self, abstract_state: Any) -> tuple[int, Any] | None:
-        """Restore newest checkpoint into the structure/shardings of
-        `abstract_state` (a real or jax.eval_shape state). None if empty."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
-        return step, restored
+        """Restore the newest VERIFIED checkpoint into the structure/
+        shardings of `abstract_state` (a real or jax.eval_shape state).
+        A newest step that fails its manifest is quarantined and the next-
+        newest verified step served instead, so a corrupt save can cost at
+        most one checkpoint interval, never the whole run. None if empty."""
+        if not self.verify:
+            step = self._mgr.latest_step()
+            if step is None:
+                return None
+            return step, self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state))
+
+        quarantined: list[int] = []   # moved out of the tree
+        unmovable: list[int] = []     # corrupt but the move itself failed
+        steps = sorted(self._mgr.all_steps())
+        while steps:
+            step = steps.pop()
+            verdict = self._verify_step(step)
+            if verdict is False:
+                # even when the quarantine move fails (ENOSPC, EACCES) the
+                # corrupt step must still be SKIPPED — serving flipped
+                # bytes is never an option — but telemetry must not claim
+                # a removal that didn't happen
+                (quarantined if self._quarantine(step)
+                 else unmovable).append(step)
+                continue
+            if verdict is None:
+                # no manifest (pre-verify checkpoint, or a crash between
+                # commit and manifest): restorable, but say so in metrics
+                ckpt_verify_bump("unverified_restores_total")
+            else:
+                ckpt_verify_bump("steps_verified_total")
+            if quarantined or unmovable:
+                from kubeflow_tpu.tracing import get_tracer
+
+                ckpt_verify_bump("fallback_restores_total")
+                attrs = {"step": step,
+                         "quarantined": ",".join(map(str, quarantined))}
+                if unmovable:
+                    attrs["skipped_unmovable"] = ",".join(map(str, unmovable))
+                with get_tracer().span("checkpoint.fallback", **attrs):
+                    restored = self._mgr.restore(
+                        step, args=ocp.args.StandardRestore(abstract_state))
+            else:
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(abstract_state))
+            return step, restored
+        return None
+
+    # ----------------------------------------------------------- integrity
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _step_files(self, step: int) -> list[str]:
+        """Relative paths of one committed step's payload files (manifest
+        and writer tmp files excluded), sorted for a stable manifest."""
+        root = self._step_dir(step)
+        out: list[str] = []
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in filenames:
+                if name == CKPT_MANIFEST_NAME or name.endswith(".tmp"):
+                    continue
+                out.append(os.path.relpath(os.path.join(dirpath, name), root))
+        return sorted(out)
+
+    def _spawn_manifest_writer(self, step: int) -> None:
+        """One short-lived daemon thread per async save: it waits (off the
+        training thread, by watching the directory — never by touching the
+        manager, which is not thread-safe) for THIS step's atomic commit to
+        appear, then manifests every committed step still lacking one.
+        Overlapping writers are idempotent: manifest existence is checked
+        under the lock."""
+        def run():
+            deadline = time.time() + 120.0
+            path = self._step_dir(step)
+            while time.time() < deadline and not os.path.isdir(path):
+                time.sleep(0.05)
+            with self._manifest_mu:
+                self._write_manifests()
+
+        threading.Thread(target=run, name="ckpt-manifest", daemon=True).start()
+
+    def _committed_steps(self) -> list[int]:
+        """Committed steps straight from the directory: orbax's commit is
+        an atomic rename to the bare step number (in-flight saves live in
+        non-numeric tmp dirs), so a numeric dir IS a complete step. Disk
+        enumeration keeps the manifest writer independent of the manager's
+        cached step list (and of its thread-affinity)."""
+        try:
+            return sorted(
+                int(n) for n in os.listdir(self.directory)
+                if n.isdigit()
+                and os.path.isdir(os.path.join(self.directory, n))
+            )
+        except OSError:
+            return []
+
+    def _write_manifests(self) -> None:
+        """Checksum-manifest every committed step that lacks one."""
+        for step in self._committed_steps():
+            root = self._step_dir(step)
+            manifest = os.path.join(root, CKPT_MANIFEST_NAME)
+            if os.path.exists(manifest):
+                continue
+            files = {}
+            try:
+                for rel in self._step_files(step):
+                    files[rel] = {
+                        "sha256": _sha256(os.path.join(root, rel)),
+                        "size": os.path.getsize(os.path.join(root, rel)),
+                    }
+                tmp = manifest + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump({"step": step, "files": files,
+                               "created": time.time()}, fh)
+                os.replace(tmp, manifest)
+            except OSError:
+                continue  # a racing GC removed the step mid-walk
+            ckpt_verify_bump("manifests_written_total")
+
+    def _verify_step(self, step: int) -> bool | None:
+        """True = checksums match, False = corrupt, None = no manifest."""
+        root = self._step_dir(step)
+        manifest = os.path.join(root, CKPT_MANIFEST_NAME)
+        try:
+            with open(manifest, "r", encoding="utf-8") as fh:
+                want = json.load(fh)["files"]
+        except (OSError, ValueError, KeyError):
+            if not os.path.exists(manifest):
+                return None
+            ckpt_verify_bump("steps_corrupt_total")
+            return False  # unreadable manifest IS corruption
+        have = set(self._step_files(step))
+        if set(want) - have:  # missing payload files
+            ckpt_verify_bump("steps_corrupt_total")
+            return False
+        for rel, meta in want.items():
+            path = os.path.join(root, rel)
+            try:
+                if (os.path.getsize(path) != meta["size"]
+                        or _sha256(path) != meta["sha256"]):
+                    ckpt_verify_bump("steps_corrupt_total")
+                    return False
+            except OSError:
+                ckpt_verify_bump("steps_corrupt_total")
+                return False
+        return True
+
+    def _quarantine(self, step: int) -> bool:
+        """Move a corrupt step out of the checkpoint tree (never delete:
+        the bytes are evidence) and re-open the manager so its cached step
+        list forgets it. Holds the manifest lock: an in-flight async
+        manifest writer is still using the manager being replaced. Returns
+        False when the move itself failed (the step is still on disk —
+        callers must skip it but not report it removed)."""
+        with self._manifest_mu:
+            dst = os.path.join(self.directory, "quarantine",
+                               f"{step}-{int(time.time() * 1000)}")
+            try:
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                shutil.move(self._step_dir(step), dst)
+            except OSError:
+                return False
+            ckpt_verify_bump("steps_quarantined_total")
+            self._reopen()
+        from kubeflow_tpu.tracing import get_tracer
+
+        get_tracer().event("checkpoint.quarantine", step=step, moved_to=dst)
+        return True
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        if self.verify:
+            with self._manifest_mu:  # joins any in-flight async writer
+                self._write_manifests()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        if self.verify:
+            with self._manifest_mu:
+                self._write_manifests()
         self._mgr.close()
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()

@@ -611,6 +611,17 @@ class Trainer:
         if tracer is None:
             tracer = get_tracer()
 
+        # liveness contract (docs/health.md): one heartbeat per optimizer
+        # step through the pod env's KFTPU_HEARTBEAT_FILE — the lease the
+        # platform's hang detector judges this worker by. None (no env) for
+        # standalone runs; beat() throttles itself, so this is off the hot
+        # path either way.
+        from kubeflow_tpu.health import HeartbeatWriter
+
+        hb = HeartbeatWriter.from_env()
+        if hb is not None:
+            hb.beat(step=start_step, phase="fit-start")
+
         def save_ckpt(step, st, metrics=None):
             with tracer.span("checkpoint.save", step=step):
                 self.checkpointer.save(step, st, metrics=metrics)
@@ -639,6 +650,8 @@ class Trainer:
         def after(took: int, m) -> bool:
             nonlocal global_step, last
             global_step += took
+            if hb is not None:
+                hb.beat(step=global_step)
             timer.tick(items=took * c.batch_size, steps=took)
             if (global_step % c.log_every_steps) < took or global_step == total_steps:
                 last = {k: float(v) for k, v in m.items()}
@@ -752,6 +765,10 @@ class Trainer:
             if epoch % c.eval_every_epochs == 0:
                 with tracer.span("train.eval", step=global_step):
                     ev = self.evaluate(state, dataset)
+                if hb is not None:
+                    # evals can outlast a step-sized lease window: refresh
+                    # the lease the moment the eval pass finishes
+                    hb.beat(step=global_step, phase="eval")
                 last_eval[0] = dict(ev)
                 if self.checkpointer is not None and c.keep_best_metric:
                     # best-mode cadence: metrics only exist at evals

@@ -205,6 +205,73 @@ class TestRetryPolicy:
         assert time.monotonic() - t0 < 2.0
         assert 2 <= len(calls) <= 6  # retried some, then the deadline won
 
+    def test_deadline_shorter_than_first_backoff_sleep(self):
+        """A deadline the FIRST retry sleep would already overshoot must
+        re-raise after exactly one call — never sleep past the budget and
+        never retry 'one last time' outside it."""
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise ValueError("down")
+
+        t0 = time.monotonic()
+        with pytest.raises(ValueError, match="down"):
+            retry_call(
+                boom,
+                policy=BackoffPolicy(
+                    base_s=0.5, max_s=0.5, jitter=0.0, deadline_s=0.01
+                ),
+                retry_on=(ValueError,),
+            )
+        assert len(calls) == 1
+        assert time.monotonic() - t0 < 0.4  # the 0.5s sleep never happened
+
+    def test_poll_until_budget_exhausts_mid_sleep(self):
+        """A poll delay larger than the remaining budget is clamped TO the
+        remaining budget, and the final poll still happens AT the deadline
+        — the condition gets its last look instead of timing out mid-sleep."""
+        calls = []
+
+        def never():
+            calls.append(time.monotonic())
+            return None
+
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="clamped"):
+            poll_until(
+                never, timeout_s=0.12,
+                # un-jittered 1s delay: without clamping, ONE sleep would
+                # blow 8x past the budget
+                policy=BackoffPolicy(base_s=1.0, max_s=1.0, jitter=0.0),
+                describe="clamped",
+            )
+        took = time.monotonic() - t0
+        assert took < 0.9, took            # the 1s delay was clamped
+        assert len(calls) >= 2             # initial poll + the at-deadline poll
+        assert calls[-1] - t0 >= 0.12 - 0.02
+
+    def test_with_conflict_retry_giveup_surfaces_last_conflict(self):
+        """Budget exhaustion must re-raise the LAST ConflictError — the
+        freshest account of what kept conflicting, not the first or a
+        generic wrapper."""
+        from kubeflow_tpu.controller.fakecluster import ConflictError
+
+        n = {"v": 0}
+
+        def always_conflicts():
+            n["v"] += 1
+            raise ConflictError(f"attempt {n['v']} conflicted")
+
+        with pytest.raises(ConflictError, match="attempt 3 conflicted"):
+            with_conflict_retry(
+                always_conflicts,
+                policy=BackoffPolicy(
+                    base_s=0.001, max_s=0.002, max_attempts=3
+                ),
+            )
+        assert n["v"] == 3
+
     def test_poll_until_timeout_and_success(self):
         t0 = time.monotonic()
         with pytest.raises(TimeoutError, match="thing"):

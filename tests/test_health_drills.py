@@ -1,0 +1,684 @@
+"""Liveness-layer drills (kubeflow_tpu/health.py + docs/health.md).
+
+Heartbeat leases, hang/straggler detection, and verified-checkpoint
+fallback — the failure class exit codes cannot see: a worker that is alive
+but not making progress, and a newest checkpoint whose bytes lie. The
+acceptance drill runs the whole chain end to end: PodHang (SIGSTOP, no
+process exit) -> missed heartbeats -> lease expiry -> gang restart ->
+corrupt-newest quarantined -> resume from the previous verified step,
+asserted via job status, kftpu_health_* / kftpu_ckpt_verify_* metrics, and
+parent-linked spans from health.lease_expired down to the first
+post-restore train.step.
+"""
+
+import json
+import os
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api import (
+    ContainerSpec,
+    JAXJob,
+    JAXJobSpec,
+    JobConditionType,
+    ObjectMeta,
+    PodTemplateSpec,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    REPLICA_WORKER,
+)
+from kubeflow_tpu.chaos import (
+    ChaosEngine,
+    CheckpointFault,
+    FaultPlan,
+    HeartbeatDrop,
+    PodHang,
+    corrupt_newest_checkpoint,
+)
+from kubeflow_tpu.client import Platform, TrainingClient
+from kubeflow_tpu.controller.fakecluster import Pod, PodPhase
+from kubeflow_tpu.health import (
+    ENV_HEARTBEAT_FILE,
+    HUNG_POD_EXIT_CODE,
+    HeartbeatWriter,
+    LivenessConfig,
+    LivenessDetector,
+    heartbeat_path,
+    read_heartbeat,
+)
+from kubeflow_tpu.utils.retry import poll_until
+
+pytestmark = pytest.mark.health
+
+REPO = str(Path(__file__).resolve().parents[1])
+
+
+# ------------------------------------------------------------- heartbeats
+
+
+class TestHeartbeat:
+    def test_write_read_roundtrip_is_atomic_json(self, tmp_path):
+        path = str(tmp_path / "hb" / "w0.hb")
+        w = HeartbeatWriter(path, min_interval_s=0.0)
+        assert w.beat(step=7, phase="train")
+        hb = read_heartbeat(path)
+        assert hb.step == 7 and hb.phase == "train"
+        assert hb.pid == os.getpid()
+        assert abs(hb.ts - time.time()) < 5.0
+        # time-floor throttle: per-step beats must not become per-step
+        # fsync traffic — inside the floor NOTHING writes, new step or not
+        w.min_interval_s = 60.0
+        assert not w.beat(step=8)
+        assert not w.beat(step=9)
+        w.min_interval_s = 0.0
+        assert w.beat(step=9)
+        assert w.written == 2
+
+    def test_partial_file_reads_as_none(self, tmp_path):
+        path = tmp_path / "torn.hb"
+        path.write_text('{"step": 3, "ph')  # torn write analogue
+        assert read_heartbeat(str(path)) is None
+        assert read_heartbeat(str(tmp_path / "missing.hb")) is None
+
+    def test_from_env_requires_contract(self, monkeypatch, tmp_path):
+        monkeypatch.delenv(ENV_HEARTBEAT_FILE, raising=False)
+        assert HeartbeatWriter.from_env() is None
+        monkeypatch.setenv(ENV_HEARTBEAT_FILE, str(tmp_path / "w.hb"))
+        w = HeartbeatWriter.from_env()
+        assert w is not None and w.beat(step=1)
+
+    def test_env_armed_drops_are_seed_deterministic(self, monkeypatch, tmp_path):
+        from kubeflow_tpu.health import ENV_HEARTBEAT_DROP
+
+        monkeypatch.setenv(ENV_HEARTBEAT_FILE, str(tmp_path / "w.hb"))
+        monkeypatch.setenv(ENV_HEARTBEAT_DROP, "0.5:1234:6")
+
+        def pattern():
+            w = HeartbeatWriter.from_env()
+            w.min_interval_s = 0.0
+            return [w.beat(step=i) for i in range(30)]
+
+        a, b = pattern(), pattern()
+        assert a == b                      # same seed, same drop schedule
+        assert 0 < a.count(False) <= 6     # some dropped, budget respected
+
+    def test_in_process_chaos_drops(self, tmp_path):
+        plan = FaultPlan(seed=5, heartbeat_drops=(HeartbeatDrop(rate=1.0, count=3),))
+        engine = ChaosEngine(plan)
+        w = HeartbeatWriter(str(tmp_path / "w.hb"), min_interval_s=0.0)
+        w.chaos = engine
+        results = [w.beat(step=i) for i in range(5)]
+        assert results == [False, False, False, True, True]
+        assert engine.metrics["hb_drops_total"] == 3
+        assert w.dropped == 3
+        assert engine.quiescent()
+
+
+# --------------------------------------------------------------- detector
+
+
+def _pod(name, tmp_path, step, ts, pid=4321, phase=PodPhase.RUNNING,
+         start_time=None):
+    path = str(tmp_path / f"{name}.hb")
+    with open(path, "w") as fh:
+        json.dump({"step": step, "phase": "train", "ts": ts, "pid": pid}, fh)
+    p = Pod(metadata=ObjectMeta(name=name), env={ENV_HEARTBEAT_FILE: path})
+    p.metadata.uid = f"uid-{name}"
+    p.status.phase = phase
+    p.status.pid = pid
+    p.status.start_time = start_time if start_time is not None else ts
+    return p
+
+
+class TestLivenessDetector:
+    def test_lease_expiry_on_stale_heartbeat(self, tmp_path):
+        det = LivenessDetector(LivenessConfig(liveness_timeout_s=1.0))
+        now = time.time()
+        fresh = _pod("w0", tmp_path, step=10, ts=now - 0.2)
+        stale = _pod("w1", tmp_path, step=10, ts=now - 5.0,
+                     start_time=now - 10.0)
+        verdicts = det.check([fresh, stale], now=now)
+        assert [v.key for v in verdicts] == ["default/w1"]
+        assert verdicts[0].reason == "LivenessLeaseExpired"
+        assert verdicts[0].heartbeat_age_s > 1.0
+
+    def test_fresh_incarnation_not_judged_by_stale_file(self, tmp_path):
+        """A pod that just started next to a leftover heartbeat file must
+        get a full lease window from ITS start, not be declared instantly."""
+        det = LivenessDetector(LivenessConfig(liveness_timeout_s=1.0))
+        now = time.time()
+        # stale file (old ts) but the pod itself started 0.1s ago
+        p = _pod("w0", tmp_path, step=3, ts=now - 60.0, start_time=now - 0.1)
+        assert det.check([p], now=now) == []
+        # wrong-pid files (some earlier same-named pod) prove nothing either
+        q = _pod("w1", tmp_path, step=3, ts=now - 60.0, pid=999,
+                 start_time=now - 60.0)
+        q.status.pid = 1000
+        assert det.check([q], now=now) == []
+
+    def test_never_heartbeating_pod_is_unmonitored(self, tmp_path):
+        det = LivenessDetector(LivenessConfig(liveness_timeout_s=0.1))
+        p = Pod(metadata=ObjectMeta(name="quiet"), env={
+            ENV_HEARTBEAT_FILE: str(tmp_path / "never-written.hb")})
+        p.status.phase = PodPhase.RUNNING
+        p.status.start_time = time.time() - 100.0
+        assert det.check([p]) == []  # opt-in by behavior
+
+    def test_straggler_declared_after_window(self, tmp_path):
+        det = LivenessDetector(LivenessConfig(
+            liveness_timeout_s=60.0, straggler_steps=5,
+            straggler_window_s=0.2))
+        now = time.time()
+        pods = [
+            _pod("w0", tmp_path, step=100, ts=now),
+            _pod("w1", tmp_path, step=101, ts=now),
+            _pod("w2", tmp_path, step=80, ts=now),  # 20 behind median
+        ]
+        assert det.check(pods, now=now) == []          # window opens
+        assert det.check(pods, now=now + 0.1) == []    # still inside window
+        verdicts = det.check(pods, now=now + 0.25)
+        assert [v.key for v in verdicts] == ["default/w2"]
+        assert verdicts[0].reason == "StragglerDetected"
+
+    def test_straggler_windows_survive_other_gangs_checks(self, tmp_path):
+        """The detector is shared across every job the controller
+        reconciles: another gang's check must not wipe this gang's open
+        straggler window (the per-call prune is gang-scoped)."""
+        det = LivenessDetector(LivenessConfig(
+            liveness_timeout_s=60.0, straggler_steps=5,
+            straggler_window_s=0.2))
+        now = time.time()
+        gang_a = [
+            _pod("a0", tmp_path, step=100, ts=now),
+            _pod("a1", tmp_path, step=100, ts=now),
+            _pod("a2", tmp_path, step=80, ts=now),
+        ]
+        gang_b = [
+            _pod("b0", tmp_path, step=5, ts=now),
+            _pod("b1", tmp_path, step=5, ts=now),
+        ]
+        assert det.check(gang_a, now=now) == []       # a2's window opens
+        assert det.check(gang_b, now=now + 0.1) == [] # other job's pass
+        verdicts = det.check(gang_a, now=now + 0.25)
+        assert [v.key for v in verdicts] == ["default/a2"]
+
+    def test_straggler_window_resets_on_catchup(self, tmp_path):
+        det = LivenessDetector(LivenessConfig(
+            liveness_timeout_s=60.0, straggler_steps=5,
+            straggler_window_s=0.2))
+        now = time.time()
+        pods = [
+            _pod("w0", tmp_path, step=100, ts=now),
+            _pod("w1", tmp_path, step=100, ts=now),
+            _pod("w2", tmp_path, step=90, ts=now),
+        ]
+        assert det.check(pods, now=now) == []
+        # w2 catches up: the window must clear, not keep accruing
+        pods[2] = _pod("w2", tmp_path, step=99, ts=now)
+        assert det.check(pods, now=now + 0.1) == []
+        pods[2] = _pod("w2", tmp_path, step=90, ts=now)
+        assert det.check(pods, now=now + 0.3) == []    # fresh window
+        verdicts = det.check(pods, now=now + 0.6)
+        assert [v.key for v in verdicts] == ["default/w2"]
+
+
+# ------------------------------------------------------ checkpoint verify
+
+
+class TestCheckpointVerify:
+    def test_corrupt_newest_quarantined_and_fallback(self, tmp_path):
+        from kubeflow_tpu.health import ckpt_verify_snapshot
+        from kubeflow_tpu.train.checkpoint import Checkpointer
+
+        before = ckpt_verify_snapshot()
+        d = str(tmp_path / "ckpt")
+        ck = Checkpointer(d, max_to_keep=8, async_save=False)
+        x = np.arange(4, dtype=np.float32)
+        for step in (1, 2, 3):
+            ck.save(step, {"x": x * step})
+        assert corrupt_newest_checkpoint(d) == 3
+        step, restored = ck.restore_latest({"x": x})
+        assert step == 2
+        np.testing.assert_allclose(restored["x"], x * 2)
+        # the corrupt step left the tree as evidence, not as a landmine
+        assert ck.latest_step() == 2
+        q = os.listdir(os.path.join(d, "quarantine"))
+        assert len(q) == 1 and q[0].startswith("3-")
+        ck.close()
+        after = ckpt_verify_snapshot()
+        assert after["steps_quarantined_total"] - before["steps_quarantined_total"] == 1
+        assert after["fallback_restores_total"] - before["fallback_restores_total"] == 1
+        assert after["steps_corrupt_total"] - before["steps_corrupt_total"] == 1
+        assert after["manifests_written_total"] - before["manifests_written_total"] == 3
+
+    def test_async_save_manifests_newest_step_without_wait(self, tmp_path):
+        """Async mode must not leave the NEWEST committed step unmanifested
+        until the next save — that step is exactly what a crash leaves
+        behind, and an unmanifested step cannot be quarantined. The
+        background writer waits for the commit, off the training thread."""
+        from kubeflow_tpu.health import CKPT_MANIFEST_NAME
+        from kubeflow_tpu.train.checkpoint import Checkpointer
+
+        d = str(tmp_path / "ckpt")
+        ck = Checkpointer(d, max_to_keep=4, async_save=True)
+        try:
+            ck.save(7, {"x": np.arange(4, dtype=np.float32)})
+            # deliberately NO wait()/close() before the assertion
+            poll_until(
+                lambda: os.path.exists(
+                    os.path.join(d, "7", CKPT_MANIFEST_NAME)) or None,
+                timeout_s=30.0, describe="async newest-step manifest",
+            )
+        finally:
+            ck.close()
+
+    def test_missing_manifest_restores_but_counts_unverified(self, tmp_path):
+        from kubeflow_tpu.health import (
+            CKPT_MANIFEST_NAME,
+            ckpt_verify_snapshot,
+        )
+        from kubeflow_tpu.train.checkpoint import Checkpointer
+
+        d = str(tmp_path / "ckpt")
+        ck = Checkpointer(d, max_to_keep=4, async_save=False)
+        x = np.arange(3, dtype=np.float32)
+        ck.save(1, {"x": x})
+        os.remove(os.path.join(d, "1", CKPT_MANIFEST_NAME))
+        before = ckpt_verify_snapshot()
+        step, _restored = ck.restore_latest({"x": x})
+        assert step == 1  # pre-verify checkpoints stay restorable
+        after = ckpt_verify_snapshot()
+        assert after["unverified_restores_total"] - before["unverified_restores_total"] == 1
+        ck.close()
+
+    def test_chaos_restore_corruption_hits_verify_path(self, tmp_path):
+        """The ChaosCheckpointer restore fault + the verifying checkpointer
+        compose: every 2nd restore finds its newest step corrupted and falls
+        back one verified step, never serving flipped bytes."""
+        from kubeflow_tpu.chaos import ChaosCheckpointer
+        from kubeflow_tpu.train.checkpoint import Checkpointer
+
+        plan = FaultPlan(seed=21, checkpoint=CheckpointFault(
+            save_delay_s=0.0, torn_every_n=0, corrupt_restore_every_n=2))
+        engine = ChaosEngine(plan)
+        inner = Checkpointer(str(tmp_path / "ckpt"), max_to_keep=8,
+                             async_save=False)
+        ck = ChaosCheckpointer(inner, engine)
+        x = np.arange(4, dtype=np.float32)
+        for step in (1, 2, 3):
+            ck.save(step, {"x": x * step})
+        step, restored = ck.restore_latest({"x": x})   # 1st restore: clean
+        assert step == 3
+        step, restored = ck.restore_latest({"x": x})   # 2nd: corrupted
+        assert step == 2
+        np.testing.assert_allclose(restored["x"], x * 2)
+        assert engine.metrics["ckpt_restores_corrupted_total"] == 1
+        inner.close()
+
+    def test_verify_metrics_exported_via_observability(self, tmp_path):
+        """kftpu_ckpt_verify_* rides /metrics exposition (the registry is
+        process-global, so any platform's render carries it)."""
+        from kubeflow_tpu.health import ckpt_verify_snapshot
+        from kubeflow_tpu.observability import render_metrics
+
+        p = Platform(log_dir=str(tmp_path / "logs"))
+        text = render_metrics(p)
+        snap = ckpt_verify_snapshot()
+        for name in ("steps_quarantined_total", "fallback_restores_total",
+                     "manifests_written_total"):
+            assert f"kftpu_ckpt_verify_{name} {snap[name]}" in text
+        for name in ("leases_expired_total", "stragglers_declared_total",
+                     "pods_declared_dead_total"):
+            assert f"kftpu_health_{name} 0" in text
+
+
+# ------------------------------------------------------- watch keepalive
+
+
+class TestWatchKeepalive:
+    def test_server_emits_keepalive_on_quiet_stream(self, tmp_path):
+        import urllib.request
+
+        from kubeflow_tpu.apiserver import PlatformServer
+
+        with Platform(log_dir=str(tmp_path / "logs")) as p:
+            srv = PlatformServer(p, port=0).start()
+            try:
+                url = (f"{srv.url}/api/v1/jobs?watch=true"
+                       f"&timeoutSeconds=5&keepaliveSeconds=0.6")
+                t0 = time.monotonic()
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    line = resp.readline()
+                took = time.monotonic() - t0
+                ev = json.loads(line)
+                assert ev["type"] == "KEEPALIVE"
+                assert "requestId" in ev
+                assert 0.4 <= took < 4.0, took
+            finally:
+                srv.stop()
+
+    def test_client_filters_keepalives_and_sees_events(self, tmp_path):
+        from kubeflow_tpu.apiserver import PlatformServer
+        from kubeflow_tpu.remote import RemoteClient
+
+        with Platform(log_dir=str(tmp_path / "logs")) as p:
+            srv = PlatformServer(p, port=0).start()
+            try:
+                remote = RemoteClient(srv.url)
+                script = tmp_path / "ok.py"
+                script.write_text("print('ok')")
+
+                def create_later():
+                    time.sleep(1.0)  # let >=1 keepalive cross the wire first
+                    TrainingClient(p).create_job(JAXJob(
+                        metadata=ObjectMeta(name="kajob"),
+                        spec=JAXJobSpec(replica_specs={
+                            REPLICA_WORKER: ReplicaSpec(
+                                replicas=1,
+                                template=PodTemplateSpec(
+                                    container=ContainerSpec(command=[
+                                        sys.executable, str(script)]))),
+                        })))
+
+                threading.Thread(target=create_later, daemon=True).start()
+                for ev in remote.watch("jobs", timeout_s=15,
+                                       keepalive_s=0.5):
+                    assert ev["type"] != "KEEPALIVE"  # filtered, never yielded
+                    assert ev["object"]["metadata"]["name"] == "kajob"
+                    break
+                else:
+                    pytest.fail("no real event delivered")
+            finally:
+                srv.stop()
+
+    def test_silent_connection_is_declared_dead(self):
+        """A server that accepts the watch but never writes again (dropped
+        connection) must surface as an error within the keepalive budget —
+        before this contract, it was indistinguishable from a quiet stream
+        and the client hung for the full server timeout."""
+        import socket
+
+        from kubeflow_tpu.remote import RemoteClient
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        held = []
+
+        def mute_server():
+            conn, _ = srv.accept()
+            conn.recv(65536)
+            conn.sendall(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: application/x-ndjson\r\n"
+                b"Connection: close\r\n\r\n"
+            )
+            held.append(conn)  # keep open, send nothing: a wedged stream
+
+        threading.Thread(target=mute_server, daemon=True).start()
+        client = RemoteClient(f"http://127.0.0.1:{port}")
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            for _ev in client.watch("jobs", timeout_s=60, keepalive_s=0.5):
+                pytest.fail("mute server cannot produce events")
+        took = time.monotonic() - t0
+        assert took < 30.0, took  # the 60s server timeout was NOT waited out
+        srv.close()
+        for c in held:
+            c.close()
+
+
+# ------------------------------------------------------- acceptance drill
+
+
+#: the drill worker: heartbeats + verified checkpoints + spans. First
+#: incarnation (cold start) saves steps 1..3 then holds in a heartbeating
+#: steady loop — progress only stops when chaos SIGSTOPs it. A restarted
+#: incarnation resumes from the newest VERIFIED step and runs to completion.
+DRILL_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from kubeflow_tpu.health import HeartbeatWriter
+hb = HeartbeatWriter.from_env()
+assert hb is not None, "pod env carried no heartbeat contract"
+from kubeflow_tpu import tracing
+t = tracing.init_worker_from_env(service="worker")
+import numpy as np
+from kubeflow_tpu.train.checkpoint import Checkpointer
+ck = Checkpointer({ckpt!r}, max_to_keep=8, async_save=False)
+state = {{"x": np.arange(4, dtype=np.float32)}}
+with t.span("checkpoint.restore"):
+    restored = ck.restore_latest(state)
+start = 0
+if restored is not None:
+    start, state = restored
+print("start_step", start, flush=True)
+if start == 0:
+    for step in (1, 2, 3):
+        with t.span("train.step", step=step):
+            hb.beat(step=step)
+            ck.save(step, {{"x": np.arange(4, dtype=np.float32) * step}})
+            hb.beat(step=step, phase="saved")  # refresh across the save too
+    ck.wait()
+    open({ready!r}, "w").write("ready")
+    while True:  # alive and heartbeating until the injected hang freezes us
+        hb.beat(step=3, phase="steady")
+        time.sleep(0.04)
+else:
+    for step in range(start + 1, 6):
+        with t.span("train.step", step=step):
+            hb.beat(step=step)
+            ck.save(step, {{"x": np.arange(4, dtype=np.float32) * step}})
+            hb.beat(step=step, phase="saved")
+    ck.close()
+    tracing.flush()
+    print("final_step 5", flush=True)
+"""
+
+
+class TestLivenessGangRestartDrill:
+    def test_hang_lease_restart_and_verified_fallback(self, tmp_path):
+        """The full liveness chain, deterministic end to end: a PodHang
+        (process ALIVE, zero exit) is detected purely by lease expiry, the
+        gang restarts, the corrupted newest checkpoint is quarantined, and
+        training resumes from the previous verified step."""
+        from kubeflow_tpu.observability import render_metrics
+        from kubeflow_tpu.tracing.export import (
+            export_merged_trace,
+            load_chrome_trace,
+        )
+
+        ckpt = tmp_path / "ckpt"
+        ready = tmp_path / "ready"
+        script = tmp_path / "hangjob.py"
+        script.write_text(textwrap.dedent(DRILL_WORKER.format(
+            repo=REPO, ckpt=str(ckpt), ready=str(ready))))
+        # 3s lease: an order of magnitude above the worker's worst honest
+        # inter-beat gap (beats bracket every save), so a loaded machine
+        # cannot fake a hang — a tighter value was observed double-counting
+        # restarts under parallel-suite load
+        cfg = LivenessConfig(liveness_timeout_s=3.0,
+                             straggler_steps=10 ** 6,  # lease only, here
+                             straggler_window_s=60.0)
+        p = Platform(log_dir=str(tmp_path / "pod-logs"), liveness=cfg)
+        engine = None
+        with p:
+            tr = p.start_tracing(trace_dir=str(tmp_path / "traces"))
+            client = TrainingClient(p)
+            client.create_job(JAXJob(
+                metadata=ObjectMeta(name="hangjob"),
+                spec=JAXJobSpec(
+                    replica_specs={REPLICA_WORKER: ReplicaSpec(
+                        replicas=1,
+                        restart_policy=RestartPolicy.ON_FAILURE,
+                        template=PodTemplateSpec(container=ContainerSpec(
+                            command=[sys.executable, str(script)])))},
+                    run_policy=RunPolicy(backoff_limit=3),
+                )))
+            try:
+                # phase 1: worker reaches steady state with 3 verified saves
+                poll_until(lambda: ready.exists() or None, timeout_s=90.0,
+                           describe="worker steady with 3 checkpoints")
+                # phase 2: stage restore-side corruption on the NEWEST step,
+                # then arm the hang — the worker is frozen mid-heartbeat
+                assert corrupt_newest_checkpoint(str(ckpt)) == 3
+                engine = ChaosEngine(FaultPlan(
+                    seed=4711,
+                    pod_hangs=(PodHang("hangjob-worker-0",
+                                       after_running_s=0.0, times=1),),
+                )).attach(p)
+                t_hang = time.monotonic()
+                # phase 3: lease expiry (no exit code ever) -> gang restart
+                poll_until(
+                    lambda: (
+                        (j := client.get_job("hangjob")) is not None
+                        and j.status.restart_count >= 1
+                    ) or None,
+                    timeout_s=30.0, describe="lease-driven gang restart",
+                )
+                detect_s = time.monotonic() - t_hang
+                # detection bounded by timeout + a few checker cadences
+                # (cadence = timeout/4), with slack for a loaded machine
+                assert detect_s < cfg.liveness_timeout_s + 6.0, detect_s
+                # phase 4: the restarted gang resumes and completes
+                done = client.wait_for_job_conditions("hangjob", timeout_s=90)
+            finally:
+                if engine is not None:
+                    engine.detach()
+            assert done.status.has_condition(JobConditionType.SUCCEEDED), (
+                done.status.conditions)
+            assert done.status.restart_count == 1
+
+            # resume came from step 2 — the corrupt step 3 was quarantined
+            log = client.get_job_logs("hangjob")
+            assert "start_step 2" in log, log
+            assert "final_step 5" in log
+            q = os.listdir(ckpt / "quarantine")
+            assert len(q) == 1 and q[0].startswith("3-")
+
+            # the declared death used the retryable liveness exit code
+            events = [e for e in p.cluster.events_for("default/hangjob")
+                      if e.reason == "LivenessLeaseExpired"]
+            assert events, "no LivenessLeaseExpired event on the job"
+            assert any(e.reason == "GangRestart"
+                       for e in p.cluster.events_for("default/hangjob"))
+
+            # metrics: detection is distinct from crash deaths, and the
+            # injected hang landed exactly once
+            text = render_metrics(p)
+            assert "kftpu_health_leases_expired_total 1" in text
+            assert "kftpu_health_pods_declared_dead_total 1" in text
+            assert "kftpu_health_stragglers_declared_total 0" in text
+            # the injected hang landed exactly once, and nothing was KILLED
+            # — detection ran purely on missed heartbeats (the engine is
+            # already detached here, so its counters are read directly)
+            assert engine.metrics["pod_hangs_total"] == 1
+            assert engine.metrics["pod_kills_total"] == 0
+
+            # spans: lease expiry -> gang restart -> pod re-create -> the
+            # worker's fallback restore and first post-restore step, parent-
+            # linked across the process boundary
+            poll_until(
+                lambda: list((tmp_path / "traces").glob("trace-*.json"))
+                or None,
+                timeout_s=15.0, describe="worker trace flush",
+            )
+            out = tmp_path / "drill-trace.json"
+            export_merged_trace(str(out), tr)
+            spans = load_chrome_trace(str(out))
+
+            def one(name, **attrs):
+                found = [
+                    s for s in spans if s["name"] == name
+                    and all(s["attrs"].get(k) == v for k, v in attrs.items())
+                ]
+                assert found, f"no span {name} {attrs}"
+                return found[0]
+
+            hang = one("chaos.pod_hang", landed=True)
+            lease = one("health.lease_expired", declared=True)
+            assert lease["attrs"]["pod"] == "default/hangjob-worker-0"
+            assert lease["attrs"]["heartbeat_age_s"] > cfg.liveness_timeout_s
+            restart = one("job.gang_restart", key="default/hangjob")
+            # the restart decision is causally the lease expiry's child
+            # (CARRIER_ANNOTATION on the declared pod), one trace id
+            assert restart["parent"] == lease["span"]
+            assert restart["trace"] == lease["trace"]
+            create = one("job.create_pods", restart=1)
+            # post-restore worker spans joined the creating pass's trace
+            fallback = one("checkpoint.fallback", step=2)
+            assert fallback["attrs"]["quarantined"] == "3"
+            assert fallback["trace"] == create["trace"]
+            post_steps = [
+                s for s in spans
+                if s["name"] == "train.step" and s["ts"] >= create["ts"]
+            ]
+            assert len(post_steps) == 3  # steps 3, 4, 5 of the resumed run
+            for s in post_steps:
+                assert s["trace"] == create["trace"]
+                assert s["parent"] == create["span"]
+            first_step = min(post_steps, key=lambda s: s["ts"])
+            chain = [hang, lease, restart, create, fallback, first_step]
+            stamps = [s["ts"] for s in chain]
+            assert stamps == sorted(stamps), [
+                (s["name"], s["ts"]) for s in chain]
+
+    def test_declared_pod_carries_retryable_exit_code(self, tmp_path):
+        """Unit-scope: a lease verdict marks the pod FAILED with the 128+
+        liveness exit code, so RestartPolicy.EXIT_CODE treats hangs as
+        infrastructure loss (retryable), never as an app bug."""
+        from kubeflow_tpu.api.common import is_retryable_exit_code
+
+        assert is_retryable_exit_code(HUNG_POD_EXIT_CODE)
+
+    def test_heartbeat_env_injected_per_incarnation(self, tmp_path):
+        """The controller's env contract carries a heartbeat path that
+        changes with the restart count — a restarted gang is never judged
+        by its predecessor's file."""
+        a = heartbeat_path("/hb", "default", "job1", "job1-worker-0", 0)
+        b = heartbeat_path("/hb", "default", "job1", "job1-worker-0", 1)
+        assert a != b and a.endswith("-r0.hb") and b.endswith("-r1.hb")
+
+    def test_heartbeat_age_surfaced_by_pod_runtime(self, tmp_path):
+        """podruntime exposes per-incarnation heartbeat age for every live
+        pod that has beaten at least once (kftpu_health_heartbeat_age gauge)."""
+        from kubeflow_tpu.observability import render_metrics
+
+        hold = tmp_path / "hold"
+        script = tmp_path / "beater.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            sys.path.insert(0, {REPO!r})
+            from kubeflow_tpu.health import HeartbeatWriter
+            hb = HeartbeatWriter.from_env()
+            hb.beat(step=1)
+            while not os.path.exists({str(hold)!r}):
+                time.sleep(0.02)
+        """))
+        with Platform(log_dir=str(tmp_path / "logs")) as p:
+            TrainingClient(p).create_job(JAXJob(
+                metadata=ObjectMeta(name="beatjob"),
+                spec=JAXJobSpec(replica_specs={
+                    REPLICA_WORKER: ReplicaSpec(
+                        replicas=1,
+                        template=PodTemplateSpec(container=ContainerSpec(
+                            command=[sys.executable, str(script)]))),
+                })))
+            ages = poll_until(
+                lambda: p.pod_runtime.heartbeat_ages() or None,
+                timeout_s=30.0, describe="heartbeat age surfaced",
+            )
+            (key, _uid), age = next(iter(ages.items()))
+            assert key == "default/beatjob-worker-0"
+            assert 0.0 <= age < 30.0
+            assert "kftpu_health_heartbeat_age_seconds" in render_metrics(p)
+            hold.write_text("go")
+            TrainingClient(p).wait_for_job_conditions("beatjob", timeout_s=30)

@@ -80,8 +80,13 @@ class TestGoldenExposition:
     def test_full_exposition_matches_golden(self, tmp_path):
         import os
 
+        from kubeflow_tpu.health import reset_ckpt_verify_metrics
         from kubeflow_tpu.observability import render_metrics
 
+        # kftpu_ckpt_verify_* is process-global (checkpointers report from
+        # wherever they were opened); zero it so this pins the same fresh-
+        # process surface regardless of which tests ran first
+        reset_ckpt_verify_metrics()
         p = Platform(log_dir=str(tmp_path / "logs"))
         p.start_tracing(capacity=4096)
         text = render_metrics(p)
@@ -92,6 +97,10 @@ class TestGoldenExposition:
             "kftpu_trace_spans_dropped_total",
             "kftpu_trace_recorder_spans",
             "kftpu_trace_recorder_capacity 4096",
+            "kftpu_health_leases_expired_total",
+            "kftpu_health_stragglers_declared_total",
+            "kftpu_ckpt_verify_steps_quarantined_total",
+            "kftpu_ckpt_verify_fallback_restores_total",
         ):
             assert needle in text, needle
         if os.environ.get("KFTPU_UPDATE_GOLDEN"):
