@@ -4,10 +4,22 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test test-chaos test-trace test-health selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health selftest-sanitizers native
 
-test:
+test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# kftpu-check: AST invariant linter (docs/analysis.md). Exits non-zero on
+# any finding not pinned in tests/golden/lint_baseline.json; regenerate
+# with `KFTPU_UPDATE_LINT_BASELINE=1 python -m kubeflow_tpu.analysis`
+# (only to shrink it — never grow it to dodge a new finding).
+lint:
+	python -m kubeflow_tpu.analysis
+
+# kftpu-check's own suite: checker fixtures, baseline round-trip, and the
+# lock-order/race detector unit tests (docs/analysis.md)
+test-analysis:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m analysis
 
 # recovery drills only (seeded fault injection — docs/chaos.md)
 test-chaos:

@@ -1,0 +1,34 @@
+"""kftpu-check — static AST invariant linter + runtime lock-order detector.
+
+Two halves, one goal: the invariants PRs 1-3 paid for (conflict-retried
+status writes, jittered sleeps, closed spans, surfaced retryables, one
+env-var registry, golden-pinned metrics, consistent lock order) hold under
+refactor pressure mechanically, not by reviewer memory.
+
+  - ``python -m kubeflow_tpu.analysis`` / ``make lint``: the linter
+    (linter.py + checkers.py), with a checked-in baseline pinning
+    pre-existing debt.
+  - ``KFTPU_LOCKCHECK=1`` + ``lockcheck.make_lock``: the runtime
+    lock-order/race detector, live under the chaos and health drill
+    suites.
+
+See docs/analysis.md for the rule catalog and workflows.
+"""
+
+from kubeflow_tpu.analysis.linter import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+    main,
+    run_linter,
+    save_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "apply_baseline",
+    "load_baseline",
+    "main",
+    "run_linter",
+    "save_baseline",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from kubeflow_tpu.analysis.linter import main
+
+sys.exit(main())

@@ -1,0 +1,657 @@
+"""Project-native invariant checkers for kftpu-check (docs/analysis.md).
+
+Each checker encodes one invariant the platform already paid to learn
+(the PR-1 gang._bind live-mutation wedge, the silent ConflictError drops,
+the un-jittered sleep storms). They are deliberately heuristic — a linter
+that over-fires gets allow-commented into noise — so every rule documents
+exactly what it matches and every fixture in tests/test_analysis.py pins
+both that it fires and that it does NOT over-fire.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from kubeflow_tpu.analysis.linter import Finding, Module
+
+#: rule id -> one-line doc (the `--list-rules` catalog)
+RULES = {
+    "KFTPU-SLEEP": (
+        "naked time.sleep in controller/serving/apiserver code — use "
+        "BackoffPolicy / poll_until / backoff_sleep / hinted_sleep "
+        "(utils/retry.py) so every wait is jittered and deadline-clamped"
+    ),
+    "KFTPU-CONFLICT": (
+        "mutation of a live cluster object (watch-delivered, get() without "
+        "copy_obj=True, or a list() loop variable) — the gang._bind wedge "
+        "class; mutate a deep snapshot inside read_modify_write / "
+        "with_conflict_retry instead"
+    ),
+    "KFTPU-SPAN": (
+        "span opened but not context-managed / not closed on error paths; "
+        "or CARRIER_ANNOTATION stamped after the status write already "
+        "published its event (stamp it inside the same mutate closure)"
+    ),
+    "KFTPU-EXCEPT": (
+        "bare `except:`, or a swallowed retryable — a handler catching "
+        "Exception/BaseException/ConflictError whose whole body is "
+        "pass/continue; count it, log it, or re-raise"
+    ),
+    "KFTPU-ENV": (
+        "KFTPU_* env-var string literal outside the registry "
+        "(utils/envvars.py) — injector and reader drift silently"
+    ),
+    "KFTPU-METRIC": (
+        "kftpu_* metric emitted in code but absent from the golden "
+        "exposition (tests/golden/metrics_exposition.txt), or golden "
+        "metric with no emitter in code"
+    ),
+}
+
+#: paths (posix, relative) the KFTPU-SLEEP rule governs
+_SLEEP_SCOPE = ("kubeflow_tpu/controller/", "kubeflow_tpu/serving/")
+_SLEEP_FILES = ("kubeflow_tpu/apiserver.py", "kubeflow_tpu/health.py")
+
+#: the env registry module — the one place KFTPU_* literals belong
+_ENV_REGISTRY = "kubeflow_tpu/utils/envvars.py"
+
+_ENV_RE = re.compile(r"^KFTPU_[A-Z][A-Z0-9_]*$")
+_METRIC_TOKEN_RE = re.compile(r"kftpu_[a-z0-9_]+")
+_FRAGMENT_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+CARRIER_VALUE = "tracing.kubeflow-tpu.org/carrier"
+
+
+def _func_scopes(tree: ast.Module) -> Iterator[tuple[ast.AST, list[ast.stmt]]]:
+    """(scope node, its DIRECT body statements) for the module and every
+    function — nested functions belong to their own scope, not the parent's."""
+    yield tree, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.body
+
+
+def _walk_scope(stmts: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements IN SOURCE ORDER without descending into nested
+    function scopes. A FunctionDef/Lambda encountered here is yielded but
+    not expanded — its body belongs to its own scope (it gets its own
+    _func_scopes entry). Source order matters: the conflict checker's
+    live-name tracking is a forward dataflow pass."""
+    from collections import deque
+
+    queue = deque(stmts)
+    while queue:
+        node = queue.popleft()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        # prepend children so a statement's parts are seen before the
+        # next statement (pre-order, left-to-right)
+        queue.extendleft(reversed(list(ast.iter_child_nodes(node))))
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """['self', 'cluster', 'get'] for self.cluster.get; [] when the chain
+    roots in something other than a Name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+class Checker:
+    rule = ""
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, module: Module, lineno: int, message: str) -> Finding:
+        return Finding(
+            rule=self.rule, path=module.path, line=lineno, message=message,
+            line_text=module.line_text(lineno),
+        )
+
+
+# -------------------------------------------------------------- KFTPU-SLEEP
+
+
+class SleepChecker(Checker):
+    """time.sleep in reconcile/serving/apiserver code. The sanctioned ways
+    to wait live in utils/retry.py (and chaos injection sites carry an
+    explicit allow comment — the sleep IS the injected fault there)."""
+
+    rule = "KFTPU-SLEEP"
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not (module.path.startswith(_SLEEP_SCOPE)
+                or module.path in _SLEEP_FILES):
+            return
+        from_time_sleep = any(
+            isinstance(n, ast.ImportFrom) and n.module == "time"
+            and any(a.name == "sleep" for a in n.names)
+            for n in ast.walk(module.tree)
+        )
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = (
+                isinstance(f, ast.Attribute) and f.attr == "sleep"
+                and isinstance(f.value, ast.Name) and f.value.id == "time"
+            ) or (
+                from_time_sleep
+                and isinstance(f, ast.Name) and f.id == "sleep"
+            )
+            if hit:
+                yield self.finding(
+                    module, node.lineno,
+                    "naked time.sleep in control-plane code — use "
+                    "poll_until/retry_call, or backoff_sleep/hinted_sleep "
+                    "from utils/retry.py (jittered + deadline-clamped)",
+                )
+
+
+# ----------------------------------------------------------- KFTPU-CONFLICT
+
+
+class ConflictChecker(Checker):
+    """Live-object mutation: the exact class of the PR-1 gang._bind wedge.
+
+    A name is LIVE in a scope when it was bound from
+      - ``x = <anything>.get("kind", ...)`` without ``copy_obj=True``
+      - ``etype, kind, x = <watch>.get(...)`` (watch delivery)
+      - ``for x in <anything>.list(...)``
+    and stops being live when rebound from copy.deepcopy(...) or a
+    constructor call. Mutating ``x.status...``, ``x.phase`` or
+    ``x.metadata...`` while live is flagged: those writes bypass
+    resource_version conflict detection and are half-visible to every
+    other controller. Mutate-closure parameters are NOT tracked — the
+    read_modify_write discipline hands closures a deep snapshot.
+    """
+
+    rule = "KFTPU-CONFLICT"
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for _scope, body in _func_scopes(module.tree):
+            yield from self._check_scope(module, body)
+
+    def _is_live_get(self, call: ast.Call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "get"):
+            return False
+        if not (call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            return False
+        for kw in call.keywords:
+            if kw.arg == "copy_obj" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return False
+        return True
+
+    def _is_snapshot(self, value: ast.AST) -> bool:
+        """deepcopy()/constructor calls produce private copies."""
+        if not isinstance(value, ast.Call):
+            return False
+        chain = _attr_chain(value.func)
+        if chain and chain[-1] == "deepcopy":
+            return True
+        # Constructor heuristic: CamelCase callee (Pod(), PodStatus(), ...)
+        name = chain[-1] if chain else ""
+        return bool(name) and name[0].isupper()
+
+    def _check_scope(self, module: Module,
+                     body: list[ast.stmt]) -> Iterator[Finding]:
+        live: set[str] = set()
+        for node in _walk_scope(body):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+                # watch unpack: etype, kind, obj = q.get(...)
+                if (len(targets) == 1 and isinstance(targets[0], ast.Tuple)
+                        and len(targets[0].elts) == 3
+                        and isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Attribute)
+                        and value.func.attr == "get"
+                        and all(isinstance(e, ast.Name)
+                                for e in targets[0].elts)):
+                    live.add(targets[0].elts[2].id)
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        if isinstance(value, ast.Call) and self._is_live_get(value):
+                            live.add(t.id)
+                        elif self._is_snapshot(value) or t.id in live:
+                            live.discard(t.id)
+                # mutations via attribute/subscript targets
+                for t in targets:
+                    yield from self._check_target(module, t, live, node.lineno)
+            elif isinstance(node, ast.AugAssign):
+                yield from self._check_target(module, node.target, live,
+                                              node.lineno)
+            elif isinstance(node, ast.For):
+                it = node.iter
+                if (isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Attribute)
+                        and it.func.attr == "list"
+                        and isinstance(node.target, ast.Name)):
+                    live.add(node.target.id)
+
+    def _check_target(self, module: Module, target: ast.AST, live: set,
+                      lineno: int) -> Iterator[Finding]:
+        node = target
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        chain = _attr_chain(node)
+        if len(chain) < 2 or chain[0] not in live:
+            return
+        mutated = set(chain[1:])
+        if mutated & {"status", "metadata", "phase", "spec"}:
+            yield self.finding(
+                module, lineno,
+                f"mutates live cluster object `{chain[0]}` "
+                f"(`{'.'.join(chain)}`) — the gang._bind wedge class: "
+                "use cluster.read_modify_write / a copy_obj=True snapshot "
+                "under with_conflict_retry",
+            )
+
+
+# --------------------------------------------------------------- KFTPU-SPAN
+
+
+class SpanChecker(Checker):
+    """Span lifecycle + carrier ordering.
+
+    (a) ``<tracer>.span(...)`` / ``.start_span(...)`` (receiver must
+    mention `tracer` — a project convention that keeps re.Match.span()
+    out of scope) must be a `with` context, or be .end()ed inside a
+    `finally`. A span dropped on an error path never reaches the flight
+    recorder and silently truncates the causal chain.
+
+    (b) CARRIER_ANNOTATION must be stamped BEFORE (or in the same mutate
+    closure as) the status write that publishes the watch event; stamped
+    after a ``cluster.update(...)`` in the same scope, the event the
+    consumers react to has already gone out without it.
+    """
+
+    rule = "KFTPU-SPAN"
+
+    def _is_tracer_receiver(self, func: ast.Attribute) -> bool:
+        chain = _attr_chain(func.value)
+        return any("tracer" in part.lower() for part in chain)
+
+    def _span_calls(self, body: list[ast.stmt]) -> list[ast.Call]:
+        out = []
+        for node in _walk_scope(body):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("span", "start_span")
+                    and self._is_tracer_receiver(node.func)):
+                out.append(node)
+        return out
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for _scope, body in _func_scopes(module.tree):
+            yield from self._check_lifecycle(module, body)
+            yield from self._check_carrier_order(module, body)
+
+    # -- (a) lifecycle
+
+    def _check_lifecycle(self, module: Module,
+                         body: list[ast.stmt]) -> Iterator[Finding]:
+        spans = self._span_calls(body)
+        if not spans:
+            return
+        with_ctx: set[int] = set()       # id() of calls used as with-items
+        assigned: dict[int, str] = {}    # id() of call -> target name
+        for node in _walk_scope(body):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    with_ctx.add(id(item.context_expr))
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                assigned[id(node.value)] = node.targets[0].id
+        # names .end()ed, and whether that end is inside a finally block
+        ends: dict[str, bool] = {}
+        for node in _walk_scope(body):
+            if isinstance(node, ast.Try):
+                for fin in node.finalbody:
+                    for sub in ast.walk(fin):
+                        name = self._end_target(sub)
+                        if name:
+                            ends[name] = True
+        for node in _walk_scope(body):
+            name = self._end_target(node)
+            if name:
+                ends.setdefault(name, False)
+        for call in spans:
+            if id(call) in with_ctx:
+                continue
+            name = assigned.get(id(call))
+            if name is None:
+                yield self.finding(
+                    module, call.lineno,
+                    "span opened but neither context-managed nor assigned "
+                    "— it can never be closed (use `with tracer.span(...)`)",
+                )
+            elif name not in ends:
+                yield self.finding(
+                    module, call.lineno,
+                    f"span `{name}` opened but never closed in this scope "
+                    "— use `with tracer.span(...)` (records on error exits "
+                    "too)",
+                )
+            elif not ends[name]:
+                yield self.finding(
+                    module, call.lineno,
+                    f"span `{name}` is ended outside try/finally — an "
+                    "error path leaks it; use `with tracer.span(...)`",
+                )
+
+    def _end_target(self, node: ast.AST) -> str | None:
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "end"
+                and isinstance(node.func.value, ast.Name)):
+            return node.func.value.id
+        return None
+
+    # -- (b) carrier ordering
+
+    def _is_carrier_sub(self, target: ast.AST) -> bool:
+        if not isinstance(target, ast.Subscript):
+            return False
+        s = target.slice
+        if isinstance(s, ast.Name) and s.id == "CARRIER_ANNOTATION":
+            return True
+        return isinstance(s, ast.Constant) and s.value == CARRIER_VALUE
+
+    def _check_carrier_order(self, module: Module,
+                             body: list[ast.stmt]) -> Iterator[Finding]:
+        update_lines: list[int] = []
+        carrier_lines: list[int] = []
+        for node in _walk_scope(body):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("update", "read_modify_write")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                update_lines.append(node.lineno)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if self._is_carrier_sub(t):
+                        carrier_lines.append(node.lineno)
+        if not update_lines or not carrier_lines:
+            return
+        first_update = min(update_lines)
+        for ln in carrier_lines:
+            if ln > first_update:
+                yield self.finding(
+                    module, ln,
+                    "CARRIER_ANNOTATION stamped AFTER a cluster write in "
+                    "the same scope — the status write's watch event "
+                    "already published without the carrier; stamp it "
+                    "inside the same mutate closure, before the write",
+                )
+
+
+# ------------------------------------------------------------- KFTPU-EXCEPT
+
+
+class ExceptChecker(Checker):
+    """Bare excepts and swallowed retryables (the PR-1 silent
+    ConflictError drops). A handler body consisting solely of pass /
+    continue / ``...`` makes the failure invisible: no counter, no event,
+    no log, no re-raise."""
+
+    rule = "KFTPU-EXCEPT"
+
+    _BROAD = {"Exception", "BaseException"}
+    _RETRYABLE = {"ConflictError"}
+
+    def _caught_names(self, handler: ast.ExceptHandler) -> set[str]:
+        t = handler.type
+        nodes = t.elts if isinstance(t, ast.Tuple) else [t] if t else []
+        names = set()
+        for n in nodes:
+            chain = _attr_chain(n)
+            if chain:
+                names.add(chain[-1])
+        return names
+
+    def _body_is_silent(self, handler: ast.ExceptHandler) -> bool:
+        for stmt in handler.body:
+            if isinstance(stmt, (ast.Pass, ast.Continue)):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+                continue  # docstring/ellipsis
+            return False
+        return True
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield self.finding(
+                    module, node.lineno,
+                    "bare `except:` catches KeyboardInterrupt/SystemExit "
+                    "too — name the exceptions you mean",
+                )
+                continue
+            caught = self._caught_names(node)
+            if not self._body_is_silent(node):
+                continue
+            if caught & self._RETRYABLE:
+                yield self.finding(
+                    module, node.lineno,
+                    "swallowed ConflictError — the PR-1 wedge class: a "
+                    "dropped optimistic-concurrency failure strands state "
+                    "silently; count it, record an event, or re-raise",
+                )
+            elif caught & self._BROAD:
+                yield self.finding(
+                    module, node.lineno,
+                    "except Exception with a pass-only body hides every "
+                    "failure class — narrow the type or make it countable",
+                )
+
+
+# ---------------------------------------------------------------- KFTPU-ENV
+
+
+def _docstring_ids(tree: ast.AST) -> set[int]:
+    """id()s of every docstring Constant node — module/class/function bodies
+    whose first statement is a bare string. Shared by the checkers that
+    exempt prose (a docstring mentioning KFTPU_FOO or kftpu_bar is
+    documentation, not an emit site)."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant):
+                out.add(id(body[0].value))
+    return out
+
+
+class EnvChecker(Checker):
+    """KFTPU_* string literals outside the registry. Docstrings are
+    exempt (prose); code literals are not — they are exactly how the
+    injector and the reader drift apart."""
+
+    rule = "KFTPU-ENV"
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if module.path == _ENV_REGISTRY:
+            return
+        docstrings = _docstring_ids(module.tree)
+        for node in ast.walk(module.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and id(node) not in docstrings
+                    and _ENV_RE.match(node.value)):
+                yield self.finding(
+                    module, node.lineno,
+                    f'env var "{node.value}" spelled inline — import the '
+                    "constant from kubeflow_tpu.utils.envvars (single "
+                    "registry; injector/reader cannot drift)",
+                )
+
+
+# ------------------------------------------------------------- KFTPU-METRIC
+
+
+class MetricChecker(Checker):
+    """Two-way pin between kftpu_* metric names in code and the golden
+    exposition. Code side is collected across every linted module; the
+    comparison happens in finalize()."""
+
+    rule = "KFTPU-METRIC"
+
+    #: exposition suffixes the histogram renderer appends
+    _HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+    def __init__(self, golden_path: Path):
+        self.golden_path = Path(golden_path)
+        #: full kftpu_* tokens found in string literals -> first (path, line)
+        self.tokens: dict[str, tuple[str, int]] = {}
+        #: discriminating static f-string prefixes -> first (path, line)
+        self.prefixes: dict[str, tuple[str, int]] = {}
+        #: snake_case literals usable as name fragments (suffix matching)
+        self.fragments: set[str] = set()
+        self._allowed_lines: dict[str, set[int]] = {}
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        self._allowed_lines[module.path] = {
+            ln for ln, rules in module.allow.items() if self.rule in rules
+        }
+        docstrings = _docstring_ids(module.tree)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if id(node) in docstrings:
+                    continue  # prose mentions metrics; only code emits them
+                for tok in _METRIC_TOKEN_RE.findall(node.value):
+                    if tok.endswith("_"):
+                        # "kftpu_chaos_" in a startswith()/concat is a
+                        # family reference, not a metric name
+                        self.prefixes.setdefault(
+                            tok, (module.path, node.lineno))
+                    else:
+                        self.tokens.setdefault(tok, (module.path, node.lineno))
+                if _FRAGMENT_RE.match(node.value):
+                    self.fragments.add(node.value)
+            elif isinstance(node, ast.JoinedStr) and node.values:
+                first = node.values[0]
+                if isinstance(first, ast.Constant) \
+                        and isinstance(first.value, str) \
+                        and first.value.startswith("kftpu_"):
+                    m = re.match(r"[a-z0-9_]+", first.value)
+                    # a FAMILY prefix only when the dynamic part continues
+                    # the name (f"kftpu_chaos_{m}"); a complete name with
+                    # formatting after it (f"kftpu_foo_total {v}") is a
+                    # token, collected from the Constant child above
+                    if m and len(m.group(0)) > len("kftpu_") \
+                            and m.group(0) == first.value:
+                        self.prefixes.setdefault(
+                            m.group(0), (module.path, node.lineno))
+                last = node.values[-1]
+                if isinstance(last, ast.Constant) \
+                        and isinstance(last.value, str):
+                    m = re.match(r"^_([a-z0-9_]+)", last.value)
+                    if m:
+                        self.fragments.add(m.group(1))
+        return ()
+
+    def _golden_names(self) -> dict[str, int]:
+        names: dict[str, int] = {}
+        for i, line in enumerate(
+                self.golden_path.read_text(encoding="utf-8").splitlines(), 1):
+            if not line.startswith("kftpu_"):
+                continue
+            name = re.match(r"[a-z0-9_]+", line).group(0)
+            for suf in self._HISTO_SUFFIXES:
+                if name.endswith(suf):
+                    name = name[: -len(suf)]
+                    break
+            names.setdefault(name, i)
+        return names
+
+    def finalize(self) -> Iterator[Finding]:
+        if not self.golden_path.exists():
+            return
+        golden = self._golden_names()
+        golden_set = set(golden)
+        rel_golden = self.golden_path.name
+
+        def allowed(path: str, line: int) -> bool:
+            return line in self._allowed_lines.get(path, ()) or \
+                (line - 1) in self._allowed_lines.get(path, ())
+
+        # code -> golden: literal names and specific families must exist
+        for tok, (path, line) in sorted(self.tokens.items()):
+            if tok in golden_set or allowed(path, line):
+                continue
+            yield Finding(
+                rule=self.rule, path=path, line=line,
+                message=(
+                    f"metric `{tok}` emitted in code but absent from the "
+                    f"golden exposition ({rel_golden}) — regen with "
+                    "KFTPU_UPDATE_GOLDEN=1, or it is emitted conditionally "
+                    "and invisible to the pin"
+                ),
+                line_text=tok,
+            )
+        for prefix, (path, line) in sorted(self.prefixes.items()):
+            if allowed(path, line):
+                continue
+            if not any(g.startswith(prefix) for g in golden_set):
+                yield Finding(
+                    rule=self.rule, path=path, line=line,
+                    message=(
+                        f"metric family `{prefix}*` emitted in code but no "
+                        f"such metric in the golden exposition ({rel_golden})"
+                    ),
+                    line_text=prefix,
+                )
+        # golden -> code: every pinned name needs an emitter
+        for name, line in sorted(golden.items()):
+            covered = (
+                name in self.tokens
+                or any(name.startswith(p) for p in self.prefixes)
+                or any(name.endswith("_" + f) for f in self.fragments)
+            )
+            if not covered:
+                yield Finding(
+                    rule=self.rule,
+                    path=rel_golden, line=line,
+                    message=(
+                        f"golden exposition pins `{name}` but no code emits "
+                        "it — stale golden? regen with KFTPU_UPDATE_GOLDEN=1"
+                    ),
+                    line_text=name,
+                )
+
+
+def make_checkers(golden_metrics: Path) -> list[Checker]:
+    return [
+        SleepChecker(),
+        ConflictChecker(),
+        SpanChecker(),
+        ExceptChecker(),
+        EnvChecker(),
+        MetricChecker(golden_metrics),
+    ]

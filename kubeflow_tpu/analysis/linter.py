@@ -1,0 +1,275 @@
+"""kftpu-check — AST invariant linter core (the static half of analysis/).
+
+The platform's hard-won invariants (PRs 1-3) are mechanical facts about
+source code: every status write conflict-retried, no naked ``time.sleep``
+in reconcile paths, spans context-managed, retryables never swallowed,
+env-var names spelled only in the registry, metric names in lockstep with
+the golden exposition. This module turns them from reviewer memory into
+``make lint``:
+
+  - checkers (checkers.py) walk each module's AST and yield Findings;
+  - inline ``# kftpu: allow=RULE[,RULE]`` comments (same line or the line
+    above) suppress a finding WITH a visible, reviewable justification;
+  - a checked-in baseline (tests/golden/lint_baseline.json) pins
+    pre-existing debt so only NEW findings fail the build — regenerate
+    with ``KFTPU_UPDATE_LINT_BASELINE=1 python -m kubeflow_tpu.analysis``.
+
+Baseline entries are ``RULE|path|stripped source line`` (not line numbers,
+which drift on every unrelated edit); duplicates are matched as a
+multiset, so adding a second identical violation on a new line still
+fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from kubeflow_tpu.utils.envvars import ENV_UPDATE_LINT_BASELINE
+
+#: default baseline location, relative to the lint root
+BASELINE_PATH = "tests/golden/lint_baseline.json"
+#: default golden metrics exposition, relative to the lint root
+GOLDEN_METRICS_PATH = "tests/golden/metrics_exposition.txt"
+
+_ALLOW_RE = re.compile(r"#\s*kftpu:\s*allow=([A-Z0-9_,-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One invariant violation: rule id + location + what to do instead."""
+
+    rule: str
+    path: str          # posix-relative to the lint root
+    line: int          # 1-based
+    message: str
+    line_text: str = ""  # stripped source line (baseline identity)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.line_text}"
+
+
+@dataclass
+class Module:
+    """One parsed source file as the checkers see it."""
+
+    path: str                 # posix-relative
+    tree: ast.Module
+    lines: list[str]          # raw source lines (index 0 = line 1)
+    allow: dict[int, set]     # lineno -> rule ids allowed there
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def allowed(self, rule: str, lineno: int) -> bool:
+        """An allow comment suppresses on its own line or the next one
+        (so a justification can sit above a long statement)."""
+        for ln in (lineno, lineno - 1):
+            if rule in self.allow.get(ln, ()):  # noqa: SIM110
+                return True
+        return False
+
+
+def _parse_allows(source: str) -> dict[int, set]:
+    allow: dict[int, set] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                m = _ALLOW_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                    allow.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass  # unparsable file — the ast pass reports it as KFTPU-PARSE
+    return allow
+
+
+def load_module(root: Path, rel_path: str) -> Module:
+    """Parse one file. Raises SyntaxError on an unparsable file — the
+    caller (run_linter) turns that into a KFTPU-PARSE finding instead
+    of dying."""
+    source = (root / rel_path).read_text(encoding="utf-8")
+    tree = ast.parse(source, filename=rel_path)
+    return Module(
+        path=rel_path,
+        tree=tree,
+        lines=source.splitlines(),
+        allow=_parse_allows(source),
+    )
+
+
+def discover(root: Path, paths: list[str]) -> list[str]:
+    """Python files under the given paths, posix-relative to root, sorted.
+    __pycache__ and hidden dirs excluded; protos (generated) excluded."""
+    out: set[str] = set()
+    for p in paths:
+        target = root / p
+        if target.is_file() and target.suffix == ".py":
+            out.add(Path(p).as_posix())
+            continue
+        for f in target.rglob("*.py"):
+            rel = f.relative_to(root).as_posix()
+            if "__pycache__" in rel or "/protos/" in rel:
+                continue
+            if any(part.startswith(".") for part in rel.split("/")):
+                continue
+            out.add(rel)
+    return sorted(out)
+
+
+# -------------------------------------------------------------------- engine
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    #: baseline entries that no longer match any finding (stale debt)
+    stale_baseline: list[str] = field(default_factory=list)
+    #: findings not covered by the baseline — these fail the build
+    new: list[Finding] = field(default_factory=list)
+
+
+def run_linter(
+    root: Path,
+    paths: list[str] | None = None,
+    golden_metrics: str | None = None,
+) -> list[Finding]:
+    """All findings (inline-allowed ones already filtered), sorted."""
+    from kubeflow_tpu.analysis.checkers import make_checkers
+
+    root = Path(root)
+    checkers = make_checkers(
+        golden_metrics=root / (golden_metrics or GOLDEN_METRICS_PATH)
+    )
+    findings: list[Finding] = []
+    for rel in discover(root, paths or ["kubeflow_tpu"]):
+        try:
+            module = load_module(root, rel)
+        except SyntaxError as exc:
+            findings.append(Finding(
+                rule="KFTPU-PARSE", path=rel, line=exc.lineno or 1,
+                message=f"syntax error: {exc.msg}",
+            ))
+            continue
+        for checker in checkers:
+            for f in checker.check(module):
+                if not module.allowed(f.rule, f.line):
+                    findings.append(f)
+    for checker in checkers:
+        findings.extend(checker.finalize())
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def apply_baseline(findings: list[Finding], baseline: list[str]) -> LintResult:
+    """Multiset-match findings against baseline keys."""
+    budget: dict[str, int] = {}
+    for key in baseline:
+        budget[key] = budget.get(key, 0) + 1
+    res = LintResult(findings=findings)
+    for f in findings:
+        k = f.baseline_key
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            res.new.append(f)
+    res.stale_baseline = [k for k, n in budget.items() for _ in range(n)]
+    return res
+
+
+def load_baseline(path: Path) -> list[str]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return list(data.get("findings", []))
+
+
+def save_baseline(path: Path, findings: list[Finding]) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = {
+        "comment": (
+            "kftpu-check baseline: pre-existing lint debt, pinned so only "
+            "NEW findings fail `make lint`. Regenerate with "
+            "KFTPU_UPDATE_LINT_BASELINE=1 python -m kubeflow_tpu.analysis "
+            "— and shrink it when you fix an entry, never grow it to dodge "
+            "a new finding."
+        ),
+        "findings": sorted(f.baseline_key for f in findings),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+# ----------------------------------------------------------------------- CLI
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    from kubeflow_tpu.analysis.checkers import RULES
+
+    parser = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu.analysis",
+        description="kftpu-check: AST invariant linter (docs/analysis.md)",
+    )
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/dirs to lint (default: kubeflow_tpu)")
+    parser.add_argument("--root", default=".",
+                        help="lint root; paths and the baseline are relative to it")
+    parser.add_argument("--baseline", default=BASELINE_PATH,
+                        help=f"baseline file (default {BASELINE_PATH})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, baselined or not")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings")
+    parser.add_argument("--golden-metrics", default=GOLDEN_METRICS_PATH,
+                        help="golden exposition the KFTPU-METRIC rule pins against")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, doc in RULES.items():
+            print(f"{rule}: {doc}")
+        return 0
+
+    root = Path(args.root).resolve()
+    findings = run_linter(root, args.paths or None,
+                          golden_metrics=args.golden_metrics)
+
+    update = args.update_baseline or (
+        os.environ.get(ENV_UPDATE_LINT_BASELINE, "") == "1"
+    )
+    baseline_path = root / args.baseline
+    if update:
+        save_baseline(baseline_path, findings)
+        print(f"baseline updated: {len(findings)} finding(s) pinned in "
+              f"{baseline_path}")
+        return 0
+
+    baseline = [] if args.no_baseline else load_baseline(baseline_path)
+    res = apply_baseline(findings, baseline)
+    for f in res.new:
+        print(f.render())
+    for key in res.stale_baseline:
+        print(f"warning: stale baseline entry (fixed? shrink the baseline): "
+              f"{key}", file=sys.stderr)
+    n_base = len(findings) - len(res.new)
+    if res.new:
+        print(f"\nkftpu-check: {len(res.new)} new finding(s) "
+              f"({n_base} baselined). See docs/analysis.md.", file=sys.stderr)
+        return 1
+    print(f"kftpu-check: clean ({n_base} baselined finding(s), "
+          f"{len(res.stale_baseline)} stale baseline entr(y/ies))")
+    return 0

@@ -1,0 +1,434 @@
+"""Runtime lock-order / race detector — the dynamic half of kftpu-check.
+
+The control plane runs ~23 threaded modules (fakecluster, gang, podruntime,
+health, activator, tracing, ...) whose locks nest: the gang scheduler holds
+its own ``_mu`` while writing through ``cluster.update`` (which takes the
+cluster's ``_mu``), reapers take the runtime lock while the watch loop holds
+the cluster lock, and so on. A *consistent* acquisition order is the only
+thing standing between that and a deadlock — and nothing enforced it.
+
+This module is a drop-in ``threading.Lock``/``RLock`` replacement factory:
+
+    from kubeflow_tpu.analysis.lockcheck import make_lock
+    self._mu = make_lock("gang.GangScheduler._mu")
+
+Disabled (the default), an instrumented lock is a thin passthrough — one
+attribute check per acquire. Enabled (``KFTPU_LOCKCHECK=1`` in the env, or
+``lockcheck.enable()``), every acquire records:
+
+  - the cross-thread lock acquisition-order graph, keyed by lock *name*
+    (lockdep-style: two instances of the same lock site are one node, so
+    an inversion between two platforms in one process still surfaces);
+  - the acquisition stack of the first observation of each edge;
+  - locks held longer than ``LONG_HOLD_S`` with their acquisition stacks.
+
+``report()`` returns cycles (each a list of edges with both acquisition
+stacks — a potential deadlock even if the threads never actually collided)
+and the long-hold records. The chaos and health drill suites run with the
+detector live and assert zero cycles (tests/test_chaos_drills.py,
+tests/test_health_drills.py).
+
+``GuardedState`` complements the graph: a tiny attribute container that
+asserts its owning lock is held on every access, turning "this dict is
+only touched under _mu" from a comment into a checked invariant.
+
+Stdlib-only and import-light: imported by the earliest modules (tracing,
+fakecluster) before anything heavy loads.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from kubeflow_tpu.utils.envvars import ENV_LOCKCHECK
+
+#: a lock held longer than this (seconds) is reported with its acquisition
+#: stack — control-plane locks here should be held for microseconds
+LONG_HOLD_S = 5.0
+
+#: stack frames captured per acquisition (compact: (file, line, func))
+_STACK_DEPTH = 12
+
+
+class _State:
+    """Process-global detector state. One instance; guarded by its own
+    PLAIN lock (the detector must never instrument itself)."""
+
+    def __init__(self) -> None:
+        self.mu = threading.Lock()
+        self.enabled = os.environ.get(ENV_LOCKCHECK, "") == "1"
+        #: (held_name, acquired_name) -> (held_stack, acquired_stack)
+        self.edges: dict[tuple[str, str], tuple[list, list]] = {}
+        #: [{name, held_s, stack}] — locks held past LONG_HOLD_S
+        self.long_holds: list[dict] = []
+        self.acquires = 0
+
+
+_STATE = _State()
+_HELD = threading.local()  # per-thread stack of live _Held entries
+
+
+class _Held:
+    __slots__ = ("lock", "name", "t0", "stack")
+
+    def __init__(self, lock, name: str, t0: float, stack: list):
+        self.lock = lock
+        self.name = name
+        self.t0 = t0
+        self.stack = stack
+
+
+def _held_stack() -> list:
+    st = getattr(_HELD, "stack", None)
+    if st is None:
+        st = _HELD.stack = []
+    return st
+
+
+def _capture_stack() -> list:
+    """Compact acquisition stack: [(file, line, func), ...], innermost
+    first, lockcheck's own frames skipped. sys._getframe is an order of
+    magnitude cheaper than traceback.extract_stack — this runs per acquire
+    while the detector is live under the drill suites."""
+    out = []
+    f = sys._getframe(1)
+    while f is not None and len(out) < _STACK_DEPTH:
+        code = f.f_code
+        if not code.co_filename.endswith("lockcheck.py"):
+            out.append((code.co_filename, f.f_lineno, code.co_name))
+        f = f.f_back
+    return out
+
+
+def enable() -> None:
+    _STATE.enabled = True
+
+
+def disable() -> None:
+    _STATE.enabled = False
+
+
+def is_enabled() -> bool:
+    return _STATE.enabled
+
+
+def reset() -> None:
+    """Drop all recorded edges/holds (test isolation). Does not touch the
+    enabled flag or any thread's held stack."""
+    with _STATE.mu:
+        _STATE.edges.clear()
+        _STATE.long_holds.clear()
+        _STATE.acquires = 0
+
+
+def snapshot() -> dict:
+    """Capture enabled flag + recorded findings so a unit test can reset
+    the detector for isolation and later restore() whatever a pre-armed
+    KFTPU_LOCKCHECK=1 run had accumulated — without wiping the findings
+    the at-exit dump is supposed to report."""
+    with _STATE.mu:
+        return {
+            "enabled": _STATE.enabled,
+            "edges": dict(_STATE.edges),
+            "long_holds": list(_STATE.long_holds),
+            "acquires": _STATE.acquires,
+        }
+
+
+def restore(snap: dict) -> None:
+    """Put back a snapshot() — counterpart for fixture teardown."""
+    with _STATE.mu:
+        _STATE.edges = dict(snap["edges"])
+        _STATE.long_holds = list(snap["long_holds"])
+        _STATE.acquires = snap["acquires"]
+    _STATE.enabled = snap["enabled"]
+
+
+class _InstrumentedLock:
+    """Wraps one threading.Lock/RLock. All bookkeeping is gated on the
+    global enabled flag AT ACQUIRE TIME, so enable()/disable() need no
+    reconstruction of the locks already embedded in live objects."""
+
+    __slots__ = ("_lock", "name", "_reentrant")
+
+    def __init__(self, name: str, reentrant: bool):
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self.name = name
+        self._reentrant = reentrant
+
+    # -- threading.Lock API
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok and _STATE.enabled:
+            self._note_acquired()
+        return ok
+
+    def release(self) -> None:
+        # Unwind whenever this thread has live entries, not just while
+        # enabled: a disable() landing while a daemon thread is inside a
+        # critical section must not strand a stale _Held (which would
+        # fake re-entrancy, pin held_by_me() True, and record false
+        # order edges after the next enable()). Disabled-from-birth
+        # threads have an empty/absent stack — one getattr, no scan.
+        if _STATE.enabled or getattr(_HELD, "stack", None):
+            self._note_released()
+        self._lock.release()
+
+    def __enter__(self) -> "_InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        if self._reentrant:
+            raise AttributeError("RLock has no locked()")
+        return self._lock.locked()
+
+    # -- detector hooks
+
+    def held_by_me(self) -> bool:
+        """True when THIS thread's live held-stack contains this lock —
+        GuardedState's assertion primitive. Only meaningful while the
+        detector is enabled (the held stack is not maintained otherwise)."""
+        return any(h.lock is self for h in _held_stack())
+
+    def _note_acquired(self) -> None:
+        held = _held_stack()
+        stack = _capture_stack()
+        new_edges = []
+        for h in held:
+            if h.lock is self:
+                # re-entrant acquire (RLock): no new ordering information
+                break
+        else:
+            for h in held:
+                # h.lock is never self here (the loop above broke on
+                # re-entrancy), so a same-NAME pair is two instances of one
+                # lock site nesting — a (name, name) self-edge, lockdep's
+                # same-class-nesting warning: thread 1 doing instA->instB
+                # while thread 2 does instB->instA is a real deadlock the
+                # name-keyed graph would otherwise never see
+                key = (h.name, self.name)
+                if key not in _STATE.edges:
+                    new_edges.append((key, h.stack, stack))
+        held.append(_Held(self, self.name, time.monotonic(), stack))
+        if new_edges:
+            with _STATE.mu:
+                for key, held_stack, acq_stack in new_edges:
+                    _STATE.edges.setdefault(key, (held_stack, acq_stack))
+        _STATE.acquires += 1  # benign race: coarse counter
+
+    def _note_released(self) -> None:
+        held = _held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is self:
+                entry = held.pop(i)
+                held_for = time.monotonic() - entry.t0
+                if held_for >= LONG_HOLD_S:
+                    with _STATE.mu:
+                        _STATE.long_holds.append({
+                            "name": self.name,
+                            "held_s": round(held_for, 3),
+                            "stack": entry.stack,
+                        })
+                return
+        # released a lock acquired before enable(): nothing to unwind
+
+
+def make_lock(name: str) -> _InstrumentedLock:
+    """A named, detector-aware mutex (threading.Lock semantics)."""
+    return _InstrumentedLock(name, reentrant=False)
+
+
+def make_rlock(name: str) -> _InstrumentedLock:
+    """A named, detector-aware re-entrant mutex (threading.RLock)."""
+    return _InstrumentedLock(name, reentrant=True)
+
+
+# --------------------------------------------------------------- reporting
+
+
+def _find_cycles(edges: dict) -> list[list[tuple[str, str]]]:
+    """Elementary cycles in the acquisition-order digraph (iterative DFS
+    over lock names). Each cycle is returned once as its edge list."""
+    graph: dict[str, list[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+    cycles: list[list[tuple[str, str]]] = []
+    seen_cycles: set[tuple[str, ...]] = set()
+
+    def dfs(start: str) -> None:
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == start:
+                    cyc = path + [start]
+                    # canonical form: rotate so the smallest name leads
+                    names = cyc[:-1]
+                    i = names.index(min(names))
+                    canon = tuple(names[i:] + names[:i])
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        cycles.append(
+                            [(cyc[j], cyc[j + 1]) for j in range(len(cyc) - 1)]
+                        )
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+
+    for name in graph:
+        dfs(name)
+    return cycles
+
+
+def _fmt_stack(stack: list) -> list[str]:
+    return [f"{f}:{line} in {func}" for f, line, func in stack]
+
+
+def report() -> dict:
+    """Snapshot of the detector's findings.
+
+    Returns {"cycles": [...], "long_holds": [...], "edges": N,
+    "acquires": N}. Each cycle entry is a list of
+    {"edge": "A -> B", "held_stack": [...], "acquired_stack": [...]}:
+    the stacks are from the FIRST observation of that ordering, i.e. where
+    A was acquired and where B was acquired while A was held."""
+    with _STATE.mu:
+        edges = dict(_STATE.edges)
+        long_holds = list(_STATE.long_holds)
+        acquires = _STATE.acquires
+    cycles_out = []
+    for cycle in _find_cycles(edges):
+        entry = []
+        for a, b in cycle:
+            held_stack, acq_stack = edges[(a, b)]
+            entry.append({
+                "edge": f"{a} -> {b}",
+                "held_stack": _fmt_stack(held_stack),
+                "acquired_stack": _fmt_stack(acq_stack),
+            })
+        cycles_out.append(entry)
+    return {
+        "cycles": cycles_out,
+        "long_holds": [
+            {**lh, "stack": _fmt_stack(lh["stack"])} for lh in long_holds
+        ],
+        "edges": len(edges),
+        "acquires": acquires,
+    }
+
+
+def format_report(rep: dict | None = None) -> str:
+    """Human-readable report (what the drill suites print on failure)."""
+    rep = report() if rep is None else rep
+    lines = [
+        f"lockcheck: {rep['acquires']} acquires, {rep['edges']} order edges,"
+        f" {len(rep['cycles'])} cycle(s), {len(rep['long_holds'])} long hold(s)"
+    ]
+    for cyc in rep["cycles"]:
+        lines.append("POTENTIAL DEADLOCK (lock-order inversion):")
+        for e in cyc:
+            lines.append(f"  {e['edge']}")
+            lines.append("    first lock acquired at:")
+            lines.extend(f"      {s}" for s in e["held_stack"][:6])
+            lines.append("    second lock acquired (first still held) at:")
+            lines.extend(f"      {s}" for s in e["acquired_stack"][:6])
+    for lh in rep["long_holds"]:
+        lines.append(f"LONG HOLD: {lh['name']} held {lh['held_s']}s, acquired at:")
+        lines.extend(f"    {s}" for s in lh["stack"][:6])
+    return "\n".join(lines)
+
+
+def dump_report(path: str = "lockcheck_report.txt", rep: dict | None = None) -> str:
+    """Write the report to ``path`` (JSON when the name ends in ``.json``,
+    the ``format_report`` text otherwise) and return the path. These
+    artifacts (``lockcheck_report*.txt|json``) are .gitignore'd."""
+    rep = report() if rep is None else rep
+    if path.endswith(".json"):
+        import json
+
+        body = json.dumps(rep, indent=2, sort_keys=True) + "\n"
+    else:
+        body = format_report(rep) + "\n"
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(body)
+    return path
+
+
+def _dump_at_exit() -> None:
+    """KFTPU_LOCKCHECK=1 runs leave a report file behind when the process
+    saw a cycle or a long hold — drills assert inline, but ad-hoc runs
+    (make test-chaos, a repro script) would otherwise lose the stacks."""
+    if not _STATE.enabled:
+        return
+    rep = report()
+    if rep["cycles"] or rep["long_holds"]:
+        try:
+            path = dump_report(rep=rep)
+            print(f"lockcheck: findings written to {path}", file=sys.stderr)
+        except OSError:
+            print(format_report(rep), file=sys.stderr)
+
+
+if os.environ.get(ENV_LOCKCHECK, "") == "1":
+    import atexit
+
+    atexit.register(_dump_at_exit)
+
+
+# ------------------------------------------------------------ guarded state
+
+
+class GuardedState:
+    """Attribute container that asserts its owning lock is held on access.
+
+    Usage::
+
+        self._mu = make_lock("gang.GangScheduler._mu")
+        self._guarded = GuardedState(self._mu, bound_chips={})
+        ...
+        with self._mu:
+            self._guarded.bound_chips[key] = entry
+
+    Access outside the lock raises AssertionError *while the detector is
+    enabled*; disabled, access is a plain attribute read (no overhead
+    beyond one flag check), so production paths pay nothing.
+    """
+
+    __slots__ = ("_lock", "_fields")
+
+    def __init__(self, lock: _InstrumentedLock, **fields):
+        object.__setattr__(self, "_lock", lock)
+        object.__setattr__(self, "_fields", dict(fields))
+
+    def _check(self, name: str) -> None:
+        if _STATE.enabled and not self._lock.held_by_me():
+            raise AssertionError(
+                f"GuardedState.{name} accessed without holding "
+                f"{self._lock.name}"
+            )
+
+    def __getattr__(self, name: str):
+        fields = object.__getattribute__(self, "_fields")
+        if name in fields:
+            self._check(name)
+            return fields[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value) -> None:
+        fields = object.__getattribute__(self, "_fields")
+        if name not in fields:
+            # a typo'd field must not silently fork state away from the
+            # real ledger — declare every field at construction
+            raise AttributeError(
+                f"GuardedState has no declared field {name!r}"
+            )
+        self._check(name)
+        fields[name] = value

@@ -37,7 +37,7 @@ from kubeflow_tpu.api.serde import (
     to_dict,
 )
 from kubeflow_tpu.api.validation import ValidationError, validate_job
-from kubeflow_tpu.controller.fakecluster import ConflictError
+from kubeflow_tpu.controller.fakecluster import ConflictError, WatchClosed
 
 
 def _serialize(kind: str, obj) -> dict:
@@ -582,16 +582,20 @@ class PlatformServer:
         client disconnects. A pod that has not been CREATED yet (the
         reconcile race right after submit) is waited on, not treated as
         terminal."""
-        import time
-
         from kubeflow_tpu.controller.fakecluster import PodPhase
+        from kubeflow_tpu.utils.retry import BackoffPolicy, Deadline, backoff_sleep
 
         cluster = self.platform.cluster
         path = self.platform.pod_runtime.log_path(pod_name, namespace)
-        deadline = time.monotonic() + timeout_s
+        deadline = Deadline(timeout_s)
+        # responsive while the pod is chatty, settling to a gentle 200ms
+        # tail poll; half jitter so N concurrent follows don't phase-lock
+        # on the store lock (same rationale as POLL_POLICY)
+        poll = BackoffPolicy(base_s=0.02, max_s=0.2, multiplier=2.0, jitter=0.5)
+        attempt = 0
         offset = 0
         try:
-            while time.monotonic() < deadline:
+            while not deadline.expired():
                 pod = cluster.get("pods", f"{namespace}/{pod_name}")
                 job = cluster.get("jobs", f"{namespace}/{name}")
                 done = (
@@ -609,9 +613,11 @@ class PlatformServer:
                     wfile.write(chunk)
                     wfile.flush()
                     offset += len(chunk)
+                    attempt = 0  # pod is chatty: snap back to the fast poll
                 if done:
                     return  # terminal phase AND the tail fully drained
-                time.sleep(0.2)
+                backoff_sleep(poll, attempt, deadline=deadline)
+                attempt += 1
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass  # client went away — normal follow termination
 
@@ -682,6 +688,11 @@ class PlatformServer:
                     )
                 except queue_mod.Empty:
                     continue
+                except WatchClosed:
+                    # subscription died at the hub (GONE/closed) — end the
+                    # stream cleanly; the client relists on reconnect, the
+                    # same contract as the server-side timeout
+                    break
                 if ekind != kind or not want(obj):
                     continue
                 record = {

@@ -50,6 +50,7 @@ import threading
 import time
 from dataclasses import dataclass, field, fields
 
+from kubeflow_tpu.analysis.lockcheck import make_lock
 from kubeflow_tpu.controller.fakecluster import ConflictError, PodPhase
 from kubeflow_tpu.utils.retry import with_conflict_retry
 
@@ -284,7 +285,7 @@ class ChaosEngine:
     def __init__(self, plan: FaultPlan):
         self.plan = plan
         self.rng = random.Random(plan.seed)
-        self._mu = threading.Lock()
+        self._mu = make_lock("chaos.ChaosEngine._mu")
         self.metrics: dict[str, int] = {
             "conflicts_injected_total": 0,
             "watch_drops_total": 0,
@@ -292,6 +293,7 @@ class ChaosEngine:
             "pod_kills_total": 0,
             "pod_hangs_total": 0,
             "pod_failures_injected_total": 0,
+            "pod_failures_lost_races_total": 0,
             "start_stalls_total": 0,
             "hb_drops_total": 0,
             "ckpt_saves_delayed_total": 0,
@@ -578,13 +580,20 @@ class ChaosEngine:
             return self._cluster.update("pods", cur)
 
         try:
-            if with_conflict_retry(attempt) is not None:
-                self._runtime.inject_kill(pod.key)  # reap the real process
-                with self._mu:
-                    self.metrics["pod_failures_injected_total"] += 1
-                return True
+            landed = with_conflict_retry(attempt) is not None
         except (ConflictError, KeyError):
-            pass  # pod churned away mid-injection; the drill moves on
+            landed = False
+        if landed:
+            self._runtime.inject_kill(pod.key)  # reap the real process
+            with self._mu:
+                self.metrics["pod_failures_injected_total"] += 1
+            return True
+        # pod churned away mid-injection (uid replaced -> attempt returned
+        # None, or the write kept conflicting/vanished): the drill moves
+        # on — but the lost injection is counted so a plan that *planned*
+        # N kills and landed M is a visible difference
+        with self._mu:
+            self.metrics["pod_failures_lost_races_total"] += 1
         return False
 
     # ------------------------------------------------- heartbeat hooks

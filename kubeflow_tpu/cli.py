@@ -492,6 +492,18 @@ def cmd_generate(args) -> int:
                   file=sys.stderr)
             return 2
         temp = float(gen.get("temperature", 0.0))
+        if temp > 0 and int(gen.get("top_k", 0)) > 0:
+            # mirror the continuous engine's refusal (serving/continuous.py
+            # submit): a SAMPLED row's rejection scheme must accept against
+            # the draft's ACTUAL proposal distribution — a top_k-truncated
+            # p_d/p_t pair needs both sides renormalized consistently,
+            # which speculative_generate does not implement. Silently
+            # ignoring top_k here would serve a DIFFERENT distribution
+            # than the same predictor without --draft-model-dir.
+            print("error: speculative decoding with temperature > 0 does "
+                  "not compose with top_k > 0 in the target config",
+                  file=sys.stderr)
+            return 2
         tmod, tvars, _ = load_generative_model(Path(args.model_dir))
         dmod, dvars, _ = load_generative_model(Path(args.draft_model_dir))
         if tmod.cfg.vocab_size != dmod.cfg.vocab_size:

@@ -20,7 +20,12 @@ import threading
 import time
 from typing import Iterable
 
-from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
+from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.controller.fakecluster import (
+    ConflictError,
+    FakeCluster,
+    WatchPoller,
+)
 from kubeflow_tpu.native import ReconcileDriver, WorkQueue
 from kubeflow_tpu.tracing import consume_delivered_context
 
@@ -47,6 +52,10 @@ class ControllerBase:
         self.metrics: dict[str, int] = {
             "reconcile_total": 0,
             "reconcile_errors_total": 0,
+            # a broken watch subscription in the informer loop
+            "informer_errors_total": 0,
+            # record_event failures while reporting a reconcile error
+            "event_record_failures_total": 0,
         }
         # reconcile-duration histogram (controller-runtime parity,
         # SURVEY §5.5). += on these is read-modify-write, NOT atomic:
@@ -56,7 +65,7 @@ class ControllerBase:
             0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0)
         self.latency_counts = [0] * (len(self.latency_buckets) + 1)
         self.latency_sum = 0.0
-        self._latency_mu = threading.Lock()
+        self._latency_mu = make_lock("base.ControllerBase._latency_mu")
         #: key -> SpanContext of the watch event that (last) enqueued it —
         #: the reconcile span's parent link. Only populated while a tracer
         #: is attached to the cluster; single writer (the informer thread),
@@ -119,12 +128,16 @@ class ControllerBase:
             return list(self.latency_counts), self.latency_sum
 
     def _watch_loop(self) -> None:
-        q = self.cluster.watch()
+        def count_error():
+            self.metrics["informer_errors_total"] += 1
+
+        poller = WatchPoller(self.cluster, timeout=0.2,
+                             count_error=count_error)
         while not self._stop.is_set():
-            try:
-                etype, kind, obj = q.get(timeout=0.2)
-            except Exception:  # queue.Empty only
+            ev = poller.get()
+            if ev is None:
                 continue
+            etype, kind, obj = ev
             ctx = (consume_delivered_context()
                    if self.cluster.tracer is not None else None)
             self.observe_event(etype, kind, obj)
@@ -180,8 +193,10 @@ class ControllerBase:
                     self.ERROR_EVENT_KIND, key, "ReconcileError", str(exc),
                     type="Warning",
                 )
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — reporting must not mask exc
+                # countable, not silent: a failing event sink would
+                # otherwise hide every reconcile error after the first
+                self.metrics["event_record_failures_total"] += 1
             return 2
         finally:
             # one observation on EVERY exit path (_observe_latency cannot

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import os
 
+from kubeflow_tpu.utils.envvars import ENV_PROFILE_DIR, ENV_STATE_DIR
 from kubeflow_tpu.api.jobs import (
     DEFAULT_PORTS,
     JobKind,
@@ -104,7 +105,7 @@ def jax_env(job: TrainJob, rtype: str, index: int) -> dict[str, str]:
         env["MEGASCALE_SLICE_ID"] = str(index // per_slice)
     if job.spec.profile_dir:
         # per-process subdir so N workers' traces never collide
-        env["KFTPU_PROFILE_DIR"] = f"{job.spec.profile_dir}/process-{index}"
+        env[ENV_PROFILE_DIR] = f"{job.spec.profile_dir}/process-{index}"
     return env
 
 
@@ -186,7 +187,7 @@ def mpi_hostfile_path(job: TrainJob) -> str:
     """Where the job controller materializes the hostfile (the ConfigMap-
     mount analogue): a per-job path every pod can read. Override the root
     with KFTPU_STATE_DIR."""
-    root = os.environ.get("KFTPU_STATE_DIR", ".kubeflow_tpu")
+    root = os.environ.get(ENV_STATE_DIR, ".kubeflow_tpu")
     return os.path.abspath(
         os.path.join(
             root, "mpi", job.metadata.namespace, job.metadata.name, "hostfile"

@@ -22,7 +22,13 @@ from typing import Any, Callable
 
 from kubeflow_tpu.api.common import ObjectMeta, utcnow as _ts
 from kubeflow_tpu.tracing import current_context, set_delivered_context
-from kubeflow_tpu.utils.retry import BackoffPolicy, with_conflict_retry
+from kubeflow_tpu.analysis.lockcheck import make_rlock
+from kubeflow_tpu.utils.retry import (
+    POLL_POLICY,
+    BackoffPolicy,
+    backoff_sleep,
+    with_conflict_retry,
+)
 
 
 class EventType(str, enum.Enum):
@@ -34,6 +40,13 @@ class EventType(str, enum.Enum):
 class ConflictError(Exception):
     """Optimistic-concurrency failure: the object changed since it was read
     (k8s 409 Conflict analogue). Callers re-read and retry."""
+
+
+class WatchClosed(Exception):
+    """The subscription is dead (closed locally or GONE at the hub): no
+    event will EVER arrive again. Distinct from queue.Empty — an idle
+    timeout — so informer loops can resubscribe instead of silently
+    polling a corpse forever."""
 
 
 _ETYPE_CODE = {EventType.ADDED: 0, EventType.MODIFIED: 1, EventType.DELETED: 2}
@@ -73,7 +86,7 @@ class WatchSubscription:
                 set_delivered_context(None)  # relists have no causal write
             return self._pending.popleft()
         if self._closed:
-            raise queue.Empty
+            raise WatchClosed(f"subscription {self._sub_id} closed")
         chaos = self._cluster.chaos
         if chaos is not None:
             action = chaos.on_watch_get(self._sub_id)
@@ -87,7 +100,9 @@ class WatchSubscription:
                     self._relist_locked()
                 return self.get(timeout=timeout)
             if action:
-                time.sleep(action)  # injected informer lag
+                # the sleep IS the injected fault (seeded informer lag) —
+                # jitter/backoff would distort the planned schedule
+                time.sleep(action)  # kftpu: allow=KFTPU-SLEEP
         hub = self._cluster._hub
         rc, seq, etype_code, _kind, _key = hub.poll(
             self._sub_id, 0.0 if timeout is None else timeout
@@ -107,12 +122,64 @@ class WatchSubscription:
             with self._cluster._mu:
                 self._relist_locked()
             return self.get(timeout=0.0)
-        raise queue.Empty  # EMPTY or GONE
+        if rc == hub.GONE:
+            raise WatchClosed(f"subscription {self._sub_id} gone at hub")
+        raise queue.Empty  # EMPTY: idle timeout, the stream is still live
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
             self._cluster._hub.unsubscribe(self._sub_id)
+
+
+class WatchPoller:
+    """The ONE informer get-with-recovery loop body, shared by every watch
+    thread (ControllerBase, GangScheduler, PodRuntime — previously three
+    hand-rolled copies that drifted).
+
+    get() returns the next (etype, kind, obj) or None when nothing was
+    delivered this round, with the failure taxonomy handled uniformly:
+
+      - queue.Empty      -> idle timeout: reset the error backoff, None
+      - WatchClosed      -> the stream is DEAD, no event will ever arrive:
+                            count it, resubscribe, back off, None
+      - anything else    -> broken subscription: count it, back off (an
+                            instantly-failing get() must not busy-spin the
+                            daemon thread), None — the loop stays alive
+
+    ``count_error`` is the owner's failure counter (a zero-arg callable);
+    errors are always counted, never degraded into an idle poll.
+    """
+
+    def __init__(self, cluster: "FakeCluster", timeout: float,
+                 count_error: Callable[[], None]):
+        self._cluster = cluster
+        self._timeout = timeout
+        self._count_error = count_error
+        self._attempt = 0
+        self.q = cluster.watch()
+
+    def get(self):
+        try:
+            ev = self.q.get(timeout=self._timeout)
+        except queue.Empty:
+            self._attempt = 0
+            return None
+        except WatchClosed:
+            # a dead subscription can only be replaced — polling it again
+            # would be the silent idle-poll-forever wedge
+            self._count_error()
+            backoff_sleep(POLL_POLICY, self._attempt)
+            self._attempt += 1
+            self.q = self._cluster.watch()
+            return None
+        except Exception:  # noqa: BLE001 — the informer must not die
+            self._count_error()
+            backoff_sleep(POLL_POLICY, self._attempt)
+            self._attempt += 1
+            return None
+        self._attempt = 0
+        return ev
 
 
 class PodPhase(str, enum.Enum):
@@ -202,7 +269,7 @@ class FakeCluster:
     def __init__(self) -> None:
         from kubeflow_tpu.native import EventHub
 
-        self._mu = threading.RLock()
+        self._mu = make_rlock("fakecluster.FakeCluster._mu")
         self._objects: dict[str, dict[str, Any]] = {k: {} for k in self.KINDS}
         # native informer fan-out (SURVEY.md §2.8 "Go controller machinery"):
         # sequencing + bounded per-subscriber buffers live in C++
@@ -327,8 +394,11 @@ class FakeCluster:
         """Subscribe to all events; optionally replay current objects as
         ADDED (informer initial list+watch semantics). The returned
         subscription is queue.Queue-shaped (.get(timeout=) raising
-        queue.Empty); a subscriber that falls WATCH_CAPACITY events behind
-        is transparently relisted (k8s "watch too old" semantics)."""
+        queue.Empty when idle, WatchClosed once the stream is dead —
+        closed locally or GONE at the hub); a subscriber that falls
+        WATCH_CAPACITY events behind is transparently relisted (k8s
+        "watch too old" semantics). WatchPoller packages the standard
+        reaction (resubscribe + relist) for informer loops."""
         with self._mu:
             # subscribe-then-snapshot under the lock: no event can be missed
             # between the initial list and the live tail

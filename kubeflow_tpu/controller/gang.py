@@ -12,6 +12,8 @@ import copy
 import math
 import threading
 
+from kubeflow_tpu.analysis.lockcheck import GuardedState, make_lock
+
 from kubeflow_tpu.controller.fakecluster import (
     ConflictError,
     EventType,
@@ -19,6 +21,7 @@ from kubeflow_tpu.controller.fakecluster import (
     Pod,
     PodGroup,
     PodPhase,
+    WatchPoller,
 )
 from kubeflow_tpu.tracing import NOOP_TRACER, consume_delivered_context
 from kubeflow_tpu.utils.retry import with_conflict_retry
@@ -55,14 +58,21 @@ class GangScheduler:
     def __init__(self, cluster: FakeCluster):
         self.cluster = cluster
         self.errors = 0  # surfaced so silent failures are still countable
+        #: benign optimistic-concurrency losses (an object was replaced
+        #: mid-pass; the next event or sweep retries) — counted, never
+        #: silently dropped: a storm of these is contention worth seeing
+        self.conflicts = 0
         self._stop = threading.Event()
-        self._mu = threading.Lock()
+        self._mu = make_lock("gang.GangScheduler._mu")
         # group key -> (group uid, chips held). The uid guards release: a
         # re-meshed job deletes + recreates its podgroup under the SAME key,
         # and the old group's DELETED watch event can arrive after the new
         # group bound — releasing on key alone would drop the replacement's
         # reservation and let other gangs overcommit the chips.
-        self._bound_chips: dict[str, tuple[str, int]] = {}
+        # GuardedState: every access asserts _mu is held when the lockcheck
+        # detector is armed — this table IS the chip ledger; an unlocked
+        # read was the PR-1 wedge's cousin waiting to happen.
+        self._guarded = GuardedState(self._mu, bound_chips={})
 
     def start(self) -> None:
         t = threading.Thread(target=self._loop, name="gang-scheduler", daemon=True)
@@ -74,21 +84,25 @@ class GangScheduler:
     # ------------------------------------------------------------------ loop
 
     def _loop(self) -> None:
-        q = self.cluster.watch()
+        def count_error():
+            self.errors += 1
+
+        poller = WatchPoller(self.cluster, timeout=0.5,
+                             count_error=count_error)
         while not self._stop.is_set():
-            try:
-                etype, kind, obj = q.get(timeout=0.5)
-            except Exception:
+            ev = poller.get()
+            if ev is None:
                 # periodic retry: a gang may fit now that capacity freed up
                 self._try_schedule_safe()
                 continue
+            etype, kind, obj = ev
             trigger = (consume_delivered_context()
                        if self.cluster.tracer is not None else None)
             if kind == "podgroups" and etype == EventType.DELETED:
                 with self._mu:
-                    held = self._bound_chips.get(obj.key)
+                    held = self._guarded.bound_chips.get(obj.key)
                     if held is not None and held[0] == obj.metadata.uid:
-                        self._bound_chips.pop(obj.key)
+                        self._guarded.bound_chips.pop(obj.key)
             if kind in ("pods", "podgroups"):
                 self._try_schedule_safe(trigger)
 
@@ -96,7 +110,7 @@ class GangScheduler:
         try:
             self._try_schedule(trigger)
         except ConflictError:
-            pass  # an object was replaced mid-pass; next event retries
+            self.conflicts += 1  # object replaced mid-pass; next event retries
         except Exception as exc:  # noqa: BLE001 — the scheduler must not die
             self.errors += 1
             self.cluster.record_event(
@@ -133,7 +147,7 @@ class GangScheduler:
                         # Reservation is recomputed from members actually
                         # covered (bound + late) so a member whose bind failed
                         # and retries here is never charged twice.
-                        entry = self._bound_chips.get(pg.key)
+                        entry = self._guarded.bound_chips.get(pg.key)
                         held = (
                             entry[1]
                             if entry and entry[0] == pg.metadata.uid
@@ -149,7 +163,7 @@ class GangScheduler:
                                 1 for p in self._members(pg) if p.status.node
                             )
                             extra = max(0, bound + len(late) - held)
-                        used = sum(c for _, c in self._bound_chips.values())
+                        used = sum(c for _, c in self._guarded.bound_chips.values())
                         if used + extra > self.cluster.capacity_chips:
                             self.cluster.record_event(
                                 "podgroups", pg.key, "Unschedulable",
@@ -162,7 +176,7 @@ class GangScheduler:
                             continue
                         # reserve before binding: a failed pod update must
                         # never leave bound pods holding uncounted chips
-                        self._bound_chips[pg.key] = (
+                        self._guarded.bound_chips[pg.key] = (
                             pg.metadata.uid, held + extra
                         )
                         with tracer.span(
@@ -185,7 +199,7 @@ class GangScheduler:
                 # must not be allowed to evict anyone
                 if self._ns_quota_blocked(pg, chips_needed):
                     continue
-                used = sum(c for _, c in self._bound_chips.values())
+                used = sum(c for _, c in self._guarded.bound_chips.values())
                 if used + chips_needed > self.cluster.capacity_chips:
                     # volcano preempt-action analogue: a higher-priority gang
                     # may evict strictly-lower-priority bound gangs (their
@@ -193,7 +207,7 @@ class GangScheduler:
                     freed = self._try_preempt(
                         pg, chips_needed - (self.cluster.capacity_chips - used)
                     )
-                    used = sum(c for _, c in self._bound_chips.values())
+                    used = sum(c for _, c in self._guarded.bound_chips.values())
                     if not freed or used + chips_needed > self.cluster.capacity_chips:
                         self.cluster.record_event(
                             "podgroups", pg.key, "Unschedulable",
@@ -207,7 +221,7 @@ class GangScheduler:
                 # mid-loop (pod replaced concurrently), the reservation is
                 # already counted and the survivors are picked up by the
                 # late-member path above — never an uncounted half-gang.
-                self._bound_chips[pg.key] = (pg.metadata.uid, chips_needed)
+                self._guarded.bound_chips[pg.key] = (pg.metadata.uid, chips_needed)
                 # copy-before-mutate: a rejected write must leave the STORED
                 # group untouched (phase still Pending) so the next sweep
                 # re-admits it cleanly instead of seeing a half-flipped state
@@ -218,7 +232,7 @@ class GangScheduler:
                 except (ConflictError, KeyError):
                     # group replaced/deleted/contended under us: release and
                     # move on; the periodic sweep retries admission
-                    self._bound_chips.pop(pg.key, None)
+                    self._guarded.bound_chips.pop(pg.key, None)
                     continue
                 with tracer.span(
                     "gang.bind", parent=trigger, group=pg.key,
@@ -244,7 +258,7 @@ class GangScheduler:
         victims = []
         available = 0
         for other in self.cluster.list("podgroups"):
-            entry = self._bound_chips.get(other.key)
+            entry = self._guarded.bound_chips.get(other.key)
             if entry is None or entry[0] != other.metadata.uid:
                 continue
             if other.priority >= pg.priority:
@@ -263,7 +277,7 @@ class GangScheduler:
         for victim in victims:
             if released >= need:
                 break
-            entry = self._bound_chips.pop(victim.key, None)
+            entry = self._guarded.bound_chips.pop(victim.key, None)
             if entry is None:
                 continue
             released += entry[1]
@@ -278,7 +292,8 @@ class GangScheduler:
             try:
                 self.cluster.update("podgroups", evicted)
             except (ConflictError, KeyError):
-                pass  # reservation already released; the sweep re-admits
+                # reservation already released; the sweep re-admits
+                self.conflicts += 1
             for p in self._members(victim):
                 try:
                     self.cluster.delete("pods", p.key)
@@ -304,7 +319,7 @@ class GangScheduler:
         """Chips not held by any bound gang (autoscaler input)."""
         with self._mu:
             return self.cluster.capacity_chips - sum(
-                c for _, c in self._bound_chips.values()
+                c for _, c in self._guarded.bound_chips.values()
             )
 
     def pending_demand_chips(self, exclude_keys: set[str] | None = None) -> int:
@@ -317,7 +332,7 @@ class GangScheduler:
         list pass (this is called from every autoscaled job's reconcile)."""
         demand = 0
         with self._mu:
-            holdings = dict(self._bound_chips)
+            holdings = dict(self._guarded.bound_chips)
         bound = {k: uid for k, (uid, _) in holdings.items()}
         pending_by_group: dict[str, int] = {}
         for p in self.cluster.list("pods"):
@@ -365,6 +380,7 @@ class GangScheduler:
             try:
                 with_conflict_retry(attempt)
             except (ConflictError, KeyError):
+                self.conflicts += 1
                 continue  # kept conflicting; the periodic sweep rebinds it
 
     def _ns_quota_would_block(
@@ -388,11 +404,11 @@ class GangScheduler:
         """Admission-path quota check (caller holds _mu); records the event."""
         from kubeflow_tpu.controller.profile import namespace_quota
 
-        if not self._ns_quota_would_block(pg, chips_needed, self._bound_chips):
+        if not self._ns_quota_would_block(pg, chips_needed, self._guarded.bound_chips):
             return False
         quota = namespace_quota(self.cluster, pg.metadata.namespace)
         ns_used = sum(
-            c for k, (_, c) in self._bound_chips.items()
+            c for k, (_, c) in self._guarded.bound_chips.items()
             if k.split("/", 1)[0] == pg.metadata.namespace
         )
         self.cluster.record_event(

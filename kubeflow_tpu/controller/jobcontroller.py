@@ -50,6 +50,7 @@ from kubeflow_tpu.health import (
 from kubeflow_tpu.native import Expectations
 from kubeflow_tpu.runtime.rendezvous import LocalResolver
 from kubeflow_tpu.tracing import ENV_TRACE_DIR, ENV_TRACEPARENT, current_context
+from kubeflow_tpu.utils.envvars import ENV_STATE_DIR
 from kubeflow_tpu.utils.retry import BackoffPolicy, with_conflict_retry
 
 JOB_NAME_LABEL = "kubeflow-tpu.org/job-name"
@@ -89,7 +90,7 @@ class JobController(ControllerBase):
         # path via the env contract (ENV_HEARTBEAT_FILE)
         self.liveness = LivenessDetector(liveness)
         self.heartbeat_dir = heartbeat_dir or os.path.join(
-            os.environ.get("KFTPU_STATE_DIR", ".kubeflow_tpu"), "heartbeats"
+            os.environ.get(ENV_STATE_DIR, ".kubeflow_tpu"), "heartbeats"
         )
         self._resolvers: dict[str, LocalResolver] = {}
         # prometheus-style counters (SURVEY.md §5.5)

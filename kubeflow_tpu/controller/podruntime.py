@@ -20,6 +20,7 @@ from kubeflow_tpu.controller.fakecluster import (
     FakeCluster,
     Pod,
     PodPhase,
+    WatchPoller,
 )
 from kubeflow_tpu.health import ENV_HEARTBEAT_FILE, read_heartbeat
 from kubeflow_tpu.tracing import (
@@ -27,6 +28,7 @@ from kubeflow_tpu.tracing import (
     consume_delivered_context,
     current_context,
 )
+from kubeflow_tpu.analysis.lockcheck import make_lock
 from kubeflow_tpu.utils.retry import with_conflict_retry
 
 
@@ -76,10 +78,14 @@ class PodRuntime:
         self.inherit_env = inherit_env
         self.bind_pending_default = bind_pending_default
         self.errors = 0  # surfaced so silent failures are still countable
+        #: events dropped because they raced a gang restart (stale
+        #: incarnation / conflicting write) — benign, but countable so a
+        #: storm of them is visible instead of silently absorbed
+        self.stale_event_drops = 0
         #: fault-injection attachment point (chaos.ChaosEngine.attach)
         self.chaos = None
         self._procs: dict[str, tuple[str, subprocess.Popen]] = {}
-        self._mu = threading.Lock()
+        self._mu = make_lock("podruntime.PodRuntime._mu")
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # tracing side tables (only populated while cluster.tracer is set):
@@ -129,12 +135,16 @@ class PodRuntime:
     # ---------------------------------------------------------------- watching
 
     def _watch_loop(self) -> None:
-        q = self.cluster.watch()
+        def count_error():
+            self.errors += 1
+
+        poller = WatchPoller(self.cluster, timeout=0.2,
+                             count_error=count_error)
         while not self._stop.is_set():
-            try:
-                etype, kind, obj = q.get(timeout=0.2)
-            except Exception:
+            ev = poller.get()
+            if ev is None:
                 continue
+            etype, kind, obj = ev
             if kind != "pods":
                 continue
             trigger = (consume_delivered_context()
@@ -142,7 +152,11 @@ class PodRuntime:
             try:
                 self._handle_pod_event(etype, obj, trigger)
             except ConflictError:
-                continue  # stale event for a replaced incarnation — drop it
+                # stale event for a replaced incarnation — droppable, but
+                # never silently: a storm of these means a controller is
+                # fighting the runtime over pod status
+                self.stale_event_drops += 1
+                continue
             except Exception as exc:  # noqa: BLE001 — the kubelet must not die
                 self.errors += 1
                 self.cluster.record_event(

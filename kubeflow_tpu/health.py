@@ -42,12 +42,17 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-#: env var naming the heartbeat file one worker incarnation writes
-ENV_HEARTBEAT_FILE = "KFTPU_HEARTBEAT_FILE"
-#: chaos carrier for heartbeat-write drops: "rate:seed:count" (see
-#: chaos.HeartbeatDrop) — parsed by HeartbeatWriter.from_env so subprocess
-#: workers drop writes deterministically without reaching the engine
-ENV_HEARTBEAT_DROP = "KFTPU_HB_DROP"
+from kubeflow_tpu.analysis.lockcheck import GuardedState, make_lock
+
+#: env-var names come from the single registry (utils/envvars.py,
+#: KFTPU-ENV lint rule); re-exported here for the existing importers
+#: (chaos.HeartbeatDrop drops ride ENV_HEARTBEAT_DROP as "rate:seed:count",
+#: parsed by HeartbeatWriter.from_env so subprocess workers drop writes
+#: deterministically without reaching the engine)
+from kubeflow_tpu.utils.envvars import (  # noqa: F401 (re-export)
+    ENV_HEARTBEAT_DROP,
+    ENV_HEARTBEAT_FILE,
+)
 
 #: exit code stamped on a pod declared dead by the detector: >= 128 so
 #: RestartPolicy.EXIT_CODE treats a hang like infrastructure loss
@@ -250,14 +255,17 @@ class LivenessDetector:
             "pods_declared_dead_total": 0,
             "heartbeats_observed_total": 0,
         }
-        #: (pod key, uid) -> when the incarnation first fell >= K steps
-        #: behind the gang median (cleared the moment it catches up)
-        self._behind: dict[tuple[str, str], float] = {}
         #: one detector serves EVERY job the controller reconciles, and
-        #: reconcile workers run concurrently — counter += and the _behind
+        #: reconcile workers run concurrently — counter += and the behind
         #: windows are read-modify-write, same guard discipline as
-        #: ControllerBase's latency histogram
-        self._mu = threading.Lock()
+        #: ControllerBase's latency histogram. GuardedState turns "only
+        #: under _mu" into a checked invariant when KFTPU_LOCKCHECK=1;
+        #: the dict lives ONLY inside it (no plain-attribute alias to
+        #: bypass the check). behind: (pod key, uid) -> when the
+        #: incarnation first fell >= K steps behind the gang median
+        #: (cleared the moment it catches up).
+        self._mu = make_lock("health.LivenessDetector._mu")
+        self._guarded = GuardedState(self._mu, behind={})
 
     def bump(self, name: str, n: int = 1) -> None:
         with self._mu:
@@ -294,6 +302,7 @@ class LivenessDetector:
 
     def _check_locked(self, pods, now: float) -> list[DeadVerdict]:
         cfg = self.config
+        behind = self._guarded.behind  # asserts _mu is held (lockcheck)
         from kubeflow_tpu.controller.fakecluster import PodPhase
 
         monitored: list[tuple] = []  # (pod, heartbeat)
@@ -314,12 +323,12 @@ class LivenessDetector:
         # other gangs' open windows on every pass. Entries of deleted jobs
         # are bounded by the backstop below.
         for k in [
-            k for k in self._behind
+            k for k in behind
             if k[0] in gang_keys and k not in live_keys
         ]:
-            self._behind.pop(k, None)
-        if len(self._behind) > 4096:  # leak backstop (deleted jobs)
-            self._behind.clear()
+            behind.pop(k, None)
+        if len(behind) > 4096:  # leak backstop (deleted jobs)
+            behind.clear()
 
         verdicts: list[DeadVerdict] = []
         for pod, hb in monitored:
@@ -350,10 +359,10 @@ class LivenessDetector:
             for pod, hb in progressing:
                 k = (pod.key, pod.metadata.uid)
                 if median - hb.step >= cfg.straggler_steps:
-                    first = self._behind.setdefault(k, now)
+                    first = behind.setdefault(k, now)
                     lag = now - first
                     if lag >= cfg.straggler_window_s:
-                        self._behind.pop(k, None)
+                        behind.pop(k, None)
                         verdicts.append(DeadVerdict(
                             key=pod.key, uid=pod.metadata.uid,
                             reason="StragglerDetected",
@@ -367,7 +376,7 @@ class LivenessDetector:
                             heartbeat_age_s=now - hb.ts, step=hb.step,
                         ))
                 else:
-                    self._behind.pop(k, None)
+                    behind.pop(k, None)
         return verdicts
 
 
@@ -377,7 +386,7 @@ class LivenessDetector:
 #: are constructed ad hoc (trainer, pipelines, drills), so a per-instance
 #: dict would be invisible to /metrics; observability.py exports this
 #: registry as kftpu_ckpt_verify_*
-_CKPT_MU = threading.Lock()
+_CKPT_MU = make_lock("health._CKPT_MU")
 _CKPT_VERIFY_METRICS: dict[str, int] = {
     "manifests_written_total": 0,
     "steps_verified_total": 0,

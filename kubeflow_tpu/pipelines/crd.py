@@ -15,6 +15,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any
 
+from kubeflow_tpu.analysis.lockcheck import make_lock
 from kubeflow_tpu.api.common import ObjectMeta, utcnow as _now
 from kubeflow_tpu.controller.base import ControllerBase
 from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
@@ -105,12 +106,12 @@ class PipelineRunController(ControllerBase):
         # store is internally locked); lazily opened so merely
         # constructing a platform never touches disk
         self._metadata_store = None
-        self._ms_mu = threading.Lock()
+        self._ms_mu = make_lock("crd.PipelineRunController._ms_mu")
         self._running: set[str] = set()  # uids with a live executor thread
         # key -> the runner's full result (task artifacts included) for
         # the visualization report; bounded by _RESULT_CAP, oldest evicted
         self._results: dict[str, object] = {}
-        self._mu = threading.Lock()
+        self._mu = make_lock("crd.PipelineRunController._mu")
         self.metrics.update({
             "pipelineruns_total": 0,
             "pipelineruns_succeeded_total": 0,
@@ -184,27 +185,31 @@ class PipelineRunController(ControllerBase):
         except Exception as exc:  # noqa: BLE001 — a bad IR must not kill the controller
             state, tasks, output, run_id = "Failed", {}, None, ""
             error = f"{type(exc).__name__}: {exc}"
+        class _Vanished(Exception):
+            """Run deleted/replaced while executing — nothing to finalize."""
+
+        def finalize(cur):
+            if cur.metadata.uid != uid:
+                raise _Vanished
+            cur.status.state = state
+            cur.status.tasks = tasks
+            cur.status.output = output
+            cur.status.error = error
+            cur.status.run_id = run_id
+            cur.status.completion_time = _now()
+
         try:
-            done = False
-            for _ in range(10):  # optimistic-concurrency retry on status write
-                cur = self.cluster.get("pipelineruns", key, copy_obj=True)
-                if cur is None or cur.metadata.uid != uid:
-                    return  # deleted/replaced while executing
-                cur.status.state = state
-                cur.status.tasks = tasks
-                cur.status.output = output
-                cur.status.error = error
-                cur.status.run_id = run_id
-                cur.status.completion_time = _now()
-                try:
-                    self.cluster.update("pipelineruns", cur)
-                    done = True
-                    break
-                except ConflictError:
-                    continue
-                except KeyError:
-                    return
-            if not done:
+            # the ONE sanctioned conflict loop (read_modify_write), not a
+            # hand-rolled retry — and its give-up is recorded, not silent
+            try:
+                self.cluster.read_modify_write("pipelineruns", key, finalize)
+            except (_Vanished, KeyError):
+                return  # deleted/replaced while executing
+            except ConflictError:
+                self.cluster.record_event(
+                    "pipelineruns", key, "StatusWriteLost",
+                    "terminal status write kept conflicting", type="Warning",
+                )
                 return
         finally:
             # only AFTER the terminal status is durable (or the run is gone)

@@ -21,13 +21,13 @@ import shutil
 import subprocess
 import sys
 import textwrap
-import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
 
+from kubeflow_tpu.analysis.lockcheck import make_lock
 from kubeflow_tpu.native import MetadataStore
 from kubeflow_tpu.pipelines.compiler import validate_ir
 
@@ -86,7 +86,7 @@ class LocalPipelineRunner:
         self.ms = metadata_store
         # run() is called from multiple schedule threads (ScheduleManager):
         # the id sequence must be atomic or run dirs/lineage keys collide
-        self._seq_lock = threading.Lock()
+        self._seq_lock = make_lock("runner.LocalPipelineRunner._seq_lock")
         self._run_seq = 0
 
     # ----------------------------------------------------------------- run

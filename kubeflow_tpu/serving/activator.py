@@ -22,6 +22,7 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from kubeflow_tpu.analysis.lockcheck import make_lock
 from kubeflow_tpu.utils.retry import BackoffPolicy, Deadline, poll_until
 
 #: annotation the activator stamps (epoch seconds) when a request arrives
@@ -51,7 +52,9 @@ class Activator:
         self.retry_after_s = retry_after_s
         self._httpd: ThreadingHTTPServer | None = None
         self._rr: dict[str, int] = {}
-        self._rr_mu = threading.Lock()
+        self._rr_mu = make_lock("activator.Activator._rr_mu")
+        #: demand stamps lost to delete/conflict races (benign; countable)
+        self.demand_signal_losses = 0
 
     # ------------------------------------------------------------- routing
 
@@ -82,8 +85,10 @@ class Activator:
             self.platform.cluster.read_modify_write(
                 "inferenceservices", key, stamp)
         except (KeyError, ConflictError):
-            pass  # deleted mid-request (handle() will 404/503) or hot
-            # contention — the endpoint poll below still observes scale-up
+            # deleted mid-request (handle() will 404/503) or hot
+            # contention — the endpoint poll below still observes
+            # scale-up; counted so a demand-stamp storm is visible
+            self.demand_signal_losses += 1
 
     def _await_endpoint(self, key: str, deadline: Deadline) -> str | None:
         """Hold the request through a cold start: demand is signalled, then

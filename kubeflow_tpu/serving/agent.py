@@ -16,6 +16,8 @@ from __future__ import annotations
 import json
 import threading
 import time
+
+from kubeflow_tpu.analysis.lockcheck import make_lock
 from collections import deque
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -33,7 +35,7 @@ class RequestLogger:
 
     def __init__(self, path: str | None = None):
         self.path = Path(path) if path else None
-        self._mu = threading.Lock()
+        self._mu = make_lock("agent.RequestLogger._mu")
         self._fh = None
         if self.path is not None:
             self.path.parent.mkdir(parents=True, exist_ok=True)

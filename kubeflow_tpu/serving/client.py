@@ -10,13 +10,17 @@ from __future__ import annotations
 
 import itertools
 import json
-import time
 import urllib.error
 import urllib.request
 
 from kubeflow_tpu.serving.api import InferenceService, validate_isvc
 from kubeflow_tpu.serving.controller import ISVC_LABEL
-from kubeflow_tpu.utils.retry import BackoffPolicy, poll_until
+from kubeflow_tpu.utils.retry import (
+    BackoffPolicy,
+    Deadline,
+    hinted_sleep,
+    poll_until,
+)
 
 
 class ServingClient:
@@ -133,9 +137,9 @@ class ServingClient:
         # redials all draw from one budget, so a caller's 2s request can
         # never be parked for minutes by a server hinting Retry-After: 30
         data = json.dumps(payload).encode()
-        deadline = time.monotonic() + timeout_s
+        deadline = Deadline(timeout_s)
         for attempt in range(self.RETRY_AFTER_MAX_RETRIES + 1):
-            remaining = max(deadline - time.monotonic(), 0.01)
+            remaining = deadline.remaining(floor=0.01)
             req = urllib.request.Request(
                 url, data=data,
                 headers={"Content-Type": "application/json"},
@@ -157,12 +161,12 @@ class ServingClient:
                     except ValueError:
                         delay = None  # HTTP-date form: not worth parsing
                     if delay is not None and delay >= 0:
-                        delay = min(delay, self.RETRY_AFTER_CAP_S)
-                        if time.monotonic() + delay < deadline:
-                            time.sleep(delay)
+                        # hinted_sleep caps the advertised wait and refuses
+                        # to park past the caller's budget — False means
+                        # surface the 503 now instead of overshooting
+                        if hinted_sleep(delay, cap_s=self.RETRY_AFTER_CAP_S,
+                                        deadline=deadline):
                             continue
-                        # the advertised wait overshoots the caller's
-                        # budget: surface the 503 now, don't park past it
                 raise RuntimeError(
                     f"HTTP {exc.code} from {url}: {detail}") from exc
         raise AssertionError("unreachable")  # loop always returns or raises

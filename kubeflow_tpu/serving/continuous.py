@@ -47,6 +47,8 @@ are refused (hazards documented at the guards).
 from __future__ import annotations
 
 import threading
+
+from kubeflow_tpu.analysis.lockcheck import make_lock
 from dataclasses import dataclass, field
 
 import jax
@@ -174,7 +176,7 @@ class ContinuousBatcher:
         self.steps_per_tick = max(1, int(steps_per_tick))
         self._seed = int(seed)
         self._submitted = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("continuous.ContinuousBatcher._lock")
         self._queue: list[tuple[np.ndarray, _InFlight]] = []
         self._rows: list[_InFlight | None] = [None] * self.max_rows
         self._toks = np.zeros((self.max_rows,), np.int32)

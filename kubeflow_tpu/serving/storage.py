@@ -23,17 +23,19 @@ from __future__ import annotations
 import json
 import os
 import shutil
-import threading
 from dataclasses import dataclass
 from pathlib import Path
 from urllib.parse import urlparse
 
+from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.utils.envvars import ENV_OBJECT_STORE_EMULATOR, ENV_PVC_ROOT
+
 # PVC mount root: pvc://volume-name/sub/path -> $KFTPU_PVC_ROOT/volume-name/sub/path
-PVC_ROOT_ENV = "KFTPU_PVC_ROOT"
+PVC_ROOT_ENV = ENV_PVC_ROOT
 DEFAULT_PVC_ROOT = ".kubeflow_tpu/volumes"
 
 # local tree emulating gs://, s3://, hf://, http(s):// object stores
-EMULATOR_ENV = "KFTPU_OBJECT_STORE_EMULATOR"
+EMULATOR_ENV = ENV_OBJECT_STORE_EMULATOR
 
 _REMOTE_SCHEMES = ("gs", "s3", "hf", "http", "https")
 # per-destination pull cache: object key -> (size, mtime) of the fetched copy
@@ -219,16 +221,16 @@ def resolve_uri(storage_uri: str) -> Path:
 # from ThreadingHTTPServer threads; two pulls racing into one dest would
 # cross rmtree/fetch and tear the tree. In-process is sufficient — replicas
 # are separate processes with per-replica dest dirs.
-_PULL_LOCKS: dict[str, threading.Lock] = {}
-_PULL_LOCKS_GUARD = threading.Lock()
+_PULL_LOCKS: dict[str, object] = {}
+_PULL_LOCKS_GUARD = make_lock("storage._PULL_LOCKS_GUARD")
 
 
-def _dest_lock(dest: Path) -> threading.Lock:
+def _dest_lock(dest: Path):
     key = str(Path(dest).resolve())
     with _PULL_LOCKS_GUARD:
         lock = _PULL_LOCKS.get(key)
         if lock is None:
-            lock = _PULL_LOCKS[key] = threading.Lock()
+            lock = _PULL_LOCKS[key] = make_lock(f"storage._dest_lock[{key}]")
     return lock
 
 

@@ -33,10 +33,11 @@ import time
 import uuid
 from collections import deque
 
-#: env var naming the directory worker processes flush their spans into
-ENV_TRACE_DIR = "KFTPU_TRACE_DIR"
-#: env var carrying the parent SpanContext into a pod ("traceid-spanid")
-ENV_TRACEPARENT = "KFTPU_TRACEPARENT"
+# env-var names live in the single registry (utils/envvars.py, KFTPU-ENV
+# lint rule); re-exported here because this module IS their consumer-side
+# home and existing imports expect them
+from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.utils.envvars import ENV_TRACE_DIR, ENV_TRACEPARENT
 #: object annotation carrying the SpanContext of the write that decided the
 #: object's fate (e.g. the pod.exit span) — readable by any controller that
 #: later acts on the object, independent of watch-delivery races
@@ -44,12 +45,12 @@ CARRIER_ANNOTATION = "tracing.kubeflow-tpu.org/carrier"
 
 #: implicit parent for spans started in this thread/context
 _CURRENT: contextvars.ContextVar = contextvars.ContextVar(
-    "kftpu_current_span", default=None
+    "kftpu_current_span", default=None  # kftpu: allow=KFTPU-METRIC (contextvar name, not a metric)
 )
 #: SpanContext attached to the most recent watch event delivered on this
 #: thread (set by WatchSubscription.get, consumed by informer loops)
 _DELIVERED: contextvars.ContextVar = contextvars.ContextVar(
-    "kftpu_delivered_event_ctx", default=None
+    "kftpu_delivered_event_ctx", default=None  # kftpu: allow=KFTPU-METRIC (contextvar name, not a metric)
 )
 
 #: sentinel: "inherit the parent from the current context"
@@ -181,7 +182,7 @@ class FlightRecorder:
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
         self._ring: deque = deque(maxlen=capacity)
-        self._mu = threading.Lock()
+        self._mu = make_lock("tracing.FlightRecorder._mu")
         self.started = 0
         self.finished = 0
         self.dropped = 0

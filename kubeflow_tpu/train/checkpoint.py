@@ -31,6 +31,7 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
+from kubeflow_tpu.analysis.lockcheck import make_lock
 from kubeflow_tpu.health import CKPT_MANIFEST_NAME, ckpt_verify_bump
 
 
@@ -59,7 +60,7 @@ class Checkpointer:
                 best_mode=best_mode,
             )
         self._async = async_save
-        self._manifest_mu = threading.Lock()
+        self._manifest_mu = make_lock("checkpoint.Checkpointer._manifest_mu")
         self._mgr = self._open()
 
     def _open(self):

@@ -39,6 +39,7 @@ from kubeflow_tpu.parallel.sharding import (
     state_shardings,
 )
 from kubeflow_tpu.tracing import get_tracer, init_worker_from_env
+from kubeflow_tpu.utils.envvars import ENV_EVENT_DIR, ENV_PROFILE_DIR
 from kubeflow_tpu.train import metrics as metrics_lib
 from kubeflow_tpu.train.checkpoint import Checkpointer
 from kubeflow_tpu.train.data import Dataset, batches, prefetch_to_device
@@ -54,9 +55,12 @@ def _traced_data_iter(tracer, it):
         try:
             batch = next(it)
         except StopIteration:
-            sp.end()
             return
-        sp.end()
+        finally:
+            # close BEFORE yielding (the span times the fetch, not the
+            # consumer) and on EVERY exit — a data-loader exception used
+            # to leak the span and truncate the causal chain
+            sp.end()
         yield batch
 
 
@@ -530,7 +534,7 @@ class Trainer:
         import os
 
         profile_dir = self.config.profile_dir or os.environ.get(
-            "KFTPU_PROFILE_DIR", ""
+            ENV_PROFILE_DIR, ""
         )
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
@@ -553,7 +557,7 @@ class Trainer:
         c = self.config
         state = self.init_state(dataset.x_train[: c.batch_size])
 
-        event_dir = c.event_dir or os.environ.get("KFTPU_EVENT_DIR", "")
+        event_dir = c.event_dir or os.environ.get(ENV_EVENT_DIR, "")
         events = metrics_lib.TfEventsWriter(event_dir) if event_dir else None
 
         # Tracing: the installed tracer, else one from the pod env contract

@@ -4,6 +4,8 @@ from kubeflow_tpu.utils.device import select_device
 from kubeflow_tpu.utils.retry import (
     BackoffPolicy,
     Deadline,
+    backoff_sleep,
+    hinted_sleep,
     poll_until,
     retry_call,
     with_conflict_retry,
@@ -13,6 +15,8 @@ __all__ = [
     "select_device",
     "BackoffPolicy",
     "Deadline",
+    "backoff_sleep",
+    "hinted_sleep",
     "poll_until",
     "retry_call",
     "with_conflict_retry",

@@ -1,0 +1,62 @@
+"""The KFTPU_* environment-variable registry — ONE place where every
+platform env-var name is spelled out.
+
+The pod env contract crosses a process boundary: the controller side
+*injects* these variables (envcontract.synthesize_env, jobcontroller pod
+creation, chaos.pod_env) and the worker side *reads* them (trainer,
+tracing.init_worker_from_env, health.HeartbeatWriter.from_env). A typo'd
+or renamed literal on either side doesn't fail loudly — the reader just
+sees "unset" and silently degrades (no heartbeats, no trace flush, no
+profile). Centralizing the names makes injector/reader drift impossible,
+and the KFTPU-ENV lint rule (kubeflow_tpu/analysis) enforces that no
+module outside this registry spells a ``KFTPU_*`` string literal.
+
+Import the constant, never inline the string:
+
+    from kubeflow_tpu.utils.envvars import ENV_TRACE_DIR
+    os.environ.get(ENV_TRACE_DIR, "")
+
+Stdlib-free on purpose: imported by the earliest-loading modules
+(tracing, health) without dragging anything in.
+"""
+
+from __future__ import annotations
+
+# --------------------------------------------------------------- pod contract
+
+#: directory worker processes flush their trace spans into
+ENV_TRACE_DIR = "KFTPU_TRACE_DIR"
+#: parent SpanContext carried into a pod ("traceid-spanid")
+ENV_TRACEPARENT = "KFTPU_TRACEPARENT"
+#: per-incarnation heartbeat file one worker writes (liveness lease)
+ENV_HEARTBEAT_FILE = "KFTPU_HEARTBEAT_FILE"
+#: chaos carrier for seeded heartbeat-write drops ("rate:seed:count")
+ENV_HEARTBEAT_DROP = "KFTPU_HB_DROP"
+#: jax.profiler trace output dir (per-process; JAXJob profile toggle)
+ENV_PROFILE_DIR = "KFTPU_PROFILE_DIR"
+#: tfevents scalar output dir for TensorBoard
+ENV_EVENT_DIR = "KFTPU_EVENT_DIR"
+
+# ------------------------------------------------------------- platform state
+
+#: root for controller-side state (hostfiles, heartbeats, pod logs)
+ENV_STATE_DIR = "KFTPU_STATE_DIR"
+#: PVC mount root: pvc://volume/sub -> $KFTPU_PVC_ROOT/volume/sub
+ENV_PVC_ROOT = "KFTPU_PVC_ROOT"
+#: file-backed object-store emulator root (gs://, s3:// resolve under it)
+ENV_OBJECT_STORE_EMULATOR = "KFTPU_OBJECT_STORE_EMULATOR"
+
+# ----------------------------------------------------------- developer tools
+
+#: "1" arms the runtime lock-order/race detector (analysis/lockcheck.py)
+ENV_LOCKCHECK = "KFTPU_LOCKCHECK"
+#: "1" regenerates the lint baseline instead of failing on findings
+ENV_UPDATE_LINT_BASELINE = "KFTPU_UPDATE_LINT_BASELINE"
+#: "1" regenerates golden files (metrics exposition) instead of diffing
+ENV_UPDATE_GOLDEN = "KFTPU_UPDATE_GOLDEN"
+
+#: every name defined above, for tooling that wants the full contract
+ALL_ENV_VARS = tuple(
+    v for k, v in sorted(globals().items())
+    if k.startswith("ENV_") and isinstance(v, str)
+)

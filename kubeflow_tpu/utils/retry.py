@@ -13,6 +13,10 @@ Three consumption modes:
   - ``poll_until(fn, ...)``              — poll `fn` until it returns non-None
   - ``with_conflict_retry(fn)``          — retry a read-modify-write attempt
                                            on ConflictError (k8s 409 analogue)
+  - ``backoff_sleep(policy, attempt)``   — one jittered, deadline-clamped
+                                           pause inside a hand-written loop
+  - ``hinted_sleep(hint_s, ...)``        — honor a server's Retry-After hint
+                                           within the caller's budget
 
 Chaos drills (kubeflow_tpu/chaos.py) pass a seeded ``random.Random`` as
 `rng` so injected-fault schedules stay reproducible; production callers
@@ -21,6 +25,7 @@ default to the module-level generator.
 
 from __future__ import annotations
 
+import math
 import random
 import time
 from dataclasses import dataclass
@@ -45,7 +50,22 @@ class BackoffPolicy:
     deadline_s: float | None = None
 
     def cap_for(self, attempt: int) -> float:
-        """Un-jittered delay ceiling for the Nth retry (attempt 0 = first)."""
+        """Un-jittered delay ceiling for the Nth retry (attempt 0 = first).
+
+        The ramp saturates at max_s; the exponent is clamped BEFORE
+        evaluation because `multiplier ** attempt` overflows a float for
+        attempt ~1024 — and long-lived poll loops (log follow, watch
+        reconnect) legitimately reach unbounded attempt counts."""
+        if self.base_s <= 0.0:
+            return 0.0  # degenerate no-wait policy (and log() needs base > 0)
+        if self.base_s >= self.max_s:
+            return self.max_s
+        if self.multiplier > 1.0:
+            # smallest n with base * m**n >= max: beyond it, the answer
+            # is max_s without ever computing the power
+            saturated = math.log(self.max_s / self.base_s, self.multiplier)
+            if attempt >= saturated:
+                return self.max_s
         return min(self.max_s, self.base_s * self.multiplier ** attempt)
 
     def delay_for(self, attempt: int, rng: random.Random | None = None) -> float:
@@ -142,6 +162,53 @@ def poll_until(
             delay = min(delay, rem)
         time.sleep(max(delay, 0.0))
         attempt += 1
+
+
+def backoff_sleep(
+    policy: BackoffPolicy,
+    attempt: int,
+    *,
+    deadline: Deadline | None = None,
+    rng: random.Random | None = None,
+) -> float:
+    """The ONE sanctioned way to pause inside a hand-written poll loop
+    (loops that can't be shaped as poll_until because each iteration does
+    real work, e.g. streaming log bytes): sleeps the policy's jittered
+    delay for `attempt`, clamped to the deadline's remaining budget.
+    Returns the seconds actually slept (0.0 when the deadline is already
+    spent). The KFTPU-SLEEP lint rule exists because every naked
+    `time.sleep(k)` in a reconcile path eventually phase-locked a fleet
+    or overshot a budget."""
+    delay = policy.delay_for(attempt, rng)
+    if deadline is not None:
+        rem = deadline.remaining()
+        if rem is not None:
+            delay = min(delay, max(rem, 0.0))
+    if delay > 0.0:
+        time.sleep(delay)
+    return delay
+
+
+def hinted_sleep(
+    hint_s: float,
+    *,
+    cap_s: float | None = None,
+    deadline: Deadline | None = None,
+) -> bool:
+    """Honor a server-advertised wait (Retry-After) within the caller's
+    budget: sleep min(hint, cap) unless that would overshoot the
+    deadline. Returns True when the wait was taken (caller re-dials) and
+    False when it would overshoot (caller surfaces the error now instead
+    of parking past its own budget)."""
+    delay = max(hint_s, 0.0)
+    if cap_s is not None:
+        delay = min(delay, cap_s)
+    if deadline is not None:
+        rem = deadline.remaining()
+        if rem is not None and delay >= rem:
+            return False
+    time.sleep(delay)
+    return True
 
 
 def with_conflict_retry(

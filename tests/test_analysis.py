@@ -1,0 +1,519 @@
+"""kftpu-check tests — the linter's checkers (positive AND negative
+fixtures per rule: firing is half the contract, not over-firing is the
+other half), the baseline round-trip, and the runtime lock-order
+detector (docs/analysis.md)."""
+
+import json
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.analysis import lockcheck
+from kubeflow_tpu.analysis.linter import (
+    apply_baseline,
+    load_baseline,
+    main as lint_main,
+    run_linter,
+    save_baseline,
+)
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def write_tree(root: Path, files: dict) -> Path:
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return root
+
+
+def lint(root: Path, **kw):
+    return run_linter(root, ["kubeflow_tpu"], **kw)
+
+
+def rules_at(findings, path=None):
+    return [(f.rule, f.line) for f in findings
+            if path is None or f.path == path]
+
+
+# ----------------------------------------------------------- KFTPU-SLEEP
+
+
+class TestSleepChecker:
+    def test_fires_in_controller_and_serving_and_apiserver(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/x.py": """
+                import time
+                def poll():
+                    time.sleep(0.2)
+            """,
+            "kubeflow_tpu/serving/y.py": """
+                from time import sleep
+                def wait():
+                    sleep(1)
+            """,
+            "kubeflow_tpu/apiserver.py": """
+                import time
+                def follow():
+                    time.sleep(0.1)
+            """,
+        }))
+        assert [f.rule for f in findings] == ["KFTPU-SLEEP"] * 3
+
+    def test_out_of_scope_and_allow_comment(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            # train/ is not reconcile-path scope
+            "kubeflow_tpu/train/z.py": """
+                import time
+                def slow():
+                    time.sleep(5)
+            """,
+            "kubeflow_tpu/controller/c.py": """
+                import time
+                def inject(action):
+                    # the sleep IS the injected fault
+                    time.sleep(action)  # kftpu: allow=KFTPU-SLEEP
+            """,
+        }))
+        assert findings == []
+
+
+# -------------------------------------------------------- KFTPU-CONFLICT
+
+
+class TestConflictChecker:
+    def test_get_without_copy_then_status_write(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/c.py": """
+                def reconcile(self, key):
+                    pod = self.cluster.get("pods", key)
+                    pod.status.phase = "Failed"
+            """,
+        }))
+        assert [f.rule for f in findings] == ["KFTPU-CONFLICT"]
+
+    def test_watch_delivered_object_mutation(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/w.py": """
+                def loop(self, q):
+                    etype, kind, obj = q.get(timeout=0.2)
+                    obj.metadata.annotations["x"] = "y"
+            """,
+        }))
+        assert [f.rule for f in findings] == ["KFTPU-CONFLICT"]
+
+    def test_list_loop_variable_mutation(self, tmp_path):
+        # the gang._bind wedge class: mutating live objects from list()
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/g.py": """
+                def bind(self, pods):
+                    for p in self.cluster.list("pods"):
+                        p.status.node = "node-1"
+            """,
+        }))
+        assert [f.rule for f in findings] == ["KFTPU-CONFLICT"]
+
+    def test_snapshots_closure_params_and_constructors_pass(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/ok.py": """
+                import copy
+                def good(self, key):
+                    snap = self.cluster.get("pods", key, copy_obj=True)
+                    snap.status.phase = "Failed"          # deep snapshot
+                    live = self.cluster.get("pods", key)
+                    live2 = copy.deepcopy(live)
+                    live2.status.phase = "Failed"         # deepcopy
+                    pod = Pod()
+                    pod.status.phase = "Pending"          # fresh object
+
+                    def mutate(p):
+                        p.status.phase = "Failed"         # closure param
+
+                    self.cluster.read_modify_write("pods", key, mutate)
+            """,
+        }))
+        assert findings == []
+
+
+# ------------------------------------------------------------ KFTPU-SPAN
+
+
+class TestSpanChecker:
+    def test_span_dropped_and_never_ended(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/s.py": """
+                def a(tracer):
+                    tracer.span("x")            # dropped on the floor
+                def b(tracer):
+                    sp = tracer.start_span("y") # never closed
+                    work()
+            """,
+        }))
+        assert [f.rule for f in findings] == ["KFTPU-SPAN"] * 2
+
+    def test_end_outside_finally_flagged(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/s.py": """
+                def c(tracer):
+                    sp = tracer.start_span("z")
+                    work()
+                    sp.end()                    # leaks if work() raises
+            """,
+        }))
+        assert [(f.rule, f.line) for f in findings] == [("KFTPU-SPAN", 3)]
+
+    def test_with_and_finally_and_event_pass(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/ok.py": """
+                import re
+                def good(tracer):
+                    with tracer.span("a"):
+                        work()
+                    sp = tracer.start_span("b")
+                    try:
+                        work()
+                    finally:
+                        sp.end()
+                    tracer.event("c")
+                    m = re.match("x", "xy")
+                    return m.span()             # not a tracer span
+            """,
+        }))
+        assert findings == []
+
+    def test_carrier_stamped_after_update(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/cr.py": """
+                def bad(cluster, pod, CARRIER_ANNOTATION, carrier):
+                    pod.status.phase = "Failed"
+                    cluster.update("pods", pod)
+                    pod.metadata.annotations[CARRIER_ANNOTATION] = carrier
+                    cluster.update("pods", pod)
+            """,
+        }))
+        assert [f.rule for f in findings] == ["KFTPU-SPAN"]
+
+    def test_carrier_before_write_passes(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/ok.py": """
+                def good(cluster, pod, CARRIER_ANNOTATION, carrier):
+                    pod.metadata.annotations[CARRIER_ANNOTATION] = carrier
+                    pod.status.phase = "Failed"
+                    cluster.update("pods", pod)
+            """,
+        }))
+        assert findings == []
+
+
+# ---------------------------------------------------------- KFTPU-EXCEPT
+
+
+class TestExceptChecker:
+    def test_bare_and_swallowed(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/e.py": """
+                def f():
+                    try:
+                        work()
+                    except:
+                        pass
+                def g():
+                    try:
+                        work()
+                    except Exception:
+                        pass
+                def h():
+                    for _ in range(3):
+                        try:
+                            work()
+                        except (ConflictError, KeyError):
+                            continue
+            """,
+        }))
+        assert [f.rule for f in findings] == ["KFTPU-EXCEPT"] * 3
+
+    def test_narrow_counted_and_allowed_pass(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/controller/ok.py": """
+                import queue
+                def f(self, q):
+                    try:
+                        q.get(timeout=0.2)
+                    except queue.Empty:
+                        pass                      # narrow type: fine
+                    try:
+                        work()
+                    except ConflictError:
+                        self.conflicts += 1       # counted: fine
+                    try:
+                        work()
+                    except Exception:  # kftpu: allow=KFTPU-EXCEPT
+                        pass
+            """,
+        }))
+        assert findings == []
+
+
+# ------------------------------------------------------------- KFTPU-ENV
+
+
+class TestEnvChecker:
+    def test_inline_literal_flagged_docstring_not(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/worker.py": '''
+                """Reads KFTPU_TRACE_DIR from the pod env contract."""
+                import os
+                def trace_dir():
+                    return os.environ.get("KFTPU_TRACE_DIR", "")
+            ''',
+        }))
+        assert [(f.rule, f.line) for f in findings] == [("KFTPU-ENV", 5)]
+
+    def test_registry_module_is_exempt(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/utils/envvars.py": """
+                ENV_TRACE_DIR = "KFTPU_TRACE_DIR"
+            """,
+        }))
+        assert findings == []
+
+
+# ---------------------------------------------------------- KFTPU-METRIC
+
+
+class TestMetricChecker:
+    GOLDEN = "kftpu_foo_total 0\nkftpu_baz_total 1\n"
+
+    def test_both_directions(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "kubeflow_tpu/m.py": """
+                def render(lines, v):
+                    lines.append(f"kftpu_foo_total {v}")     # in golden: ok
+                    lines.append(f"kftpu_bar_total {v}")     # not in golden
+            """,
+        })
+        (root / "tests/golden").mkdir(parents=True)
+        (root / "tests/golden/metrics_exposition.txt").write_text(self.GOLDEN)
+        findings = lint(root)
+        assert [(f.rule, f.line_text) for f in findings] == [
+            ("KFTPU-METRIC", "kftpu_bar_total"),   # emitted, not pinned
+            ("KFTPU-METRIC", "kftpu_baz_total"),   # pinned, no emitter
+        ]
+
+    def test_family_prefix_and_fragment_cover_golden(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "kubeflow_tpu/m.py": """
+                METRICS = {"baz_total": 0}
+                def render(lines, fam):
+                    for k, v in METRICS.items():
+                        lines.append(f"kftpu_foo_{k} {v}")
+            """,
+        })
+        (root / "tests/golden").mkdir(parents=True)
+        (root / "tests/golden/metrics_exposition.txt").write_text(
+            "kftpu_foo_total 0\nkftpu_other_baz_total 1\n")
+        # kftpu_foo_total covered by the kftpu_foo_ family prefix;
+        # kftpu_other_baz_total covered by the "baz_total" key fragment
+        assert lint(root) == []
+
+    def test_missing_golden_disables_rule(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "kubeflow_tpu/m.py": 'NAME = "kftpu_anything_total"\n',
+        })
+        assert lint(root) == []
+
+
+# --------------------------------------------------------------- baseline
+
+
+class TestBaseline:
+    TREE = {
+        "kubeflow_tpu/controller/x.py": """
+            import time
+            def poll():
+                time.sleep(0.2)
+        """,
+    }
+
+    def test_roundtrip_and_new_finding(self, tmp_path):
+        root = write_tree(tmp_path, self.TREE)
+        findings = lint(root)
+        assert len(findings) == 1
+        bl = root / "tests/golden/lint_baseline.json"
+        save_baseline(bl, findings)
+        res = apply_baseline(lint(root), load_baseline(bl))
+        assert res.new == [] and res.stale_baseline == []
+        # a SECOND identical sleep on a new line is a NEW finding — the
+        # baseline is a multiset, not a set of shapes
+        (root / "kubeflow_tpu/controller/x.py").write_text(
+            "import time\ndef poll():\n    time.sleep(0.2)\n"
+            "def poll2():\n    time.sleep(0.2)\n"
+        )
+        res = apply_baseline(lint(root), load_baseline(bl))
+        assert len(res.new) == 1
+        # fixing the original marks the entry stale (shrink the baseline)
+        (root / "kubeflow_tpu/controller/x.py").write_text("x = 1\n")
+        res = apply_baseline(lint(root), load_baseline(bl))
+        assert res.new == [] and len(res.stale_baseline) == 1
+
+    def test_env_var_regen_and_exit_codes(self, tmp_path, monkeypatch):
+        root = write_tree(tmp_path, self.TREE)
+        assert lint_main(["--root", str(root)]) == 1  # unbaselined -> fail
+        monkeypatch.setenv("KFTPU_UPDATE_LINT_BASELINE", "1")
+        assert lint_main(["--root", str(root)]) == 0  # regen
+        monkeypatch.delenv("KFTPU_UPDATE_LINT_BASELINE")
+        assert lint_main(["--root", str(root)]) == 0  # pinned -> clean
+        data = json.loads(
+            (root / "tests/golden/lint_baseline.json").read_text())
+        assert len(data["findings"]) == 1
+
+
+class TestRepoIsClean:
+    def test_head_has_zero_unbaselined_findings(self):
+        """The acceptance pin: `make lint` is clean on the repo at HEAD.
+        If this fails you either fix the new finding or consciously,
+        reviewably, regenerate the baseline."""
+        findings = run_linter(REPO_ROOT)
+        baseline = load_baseline(REPO_ROOT / "tests/golden/lint_baseline.json")
+        res = apply_baseline(findings, baseline)
+        assert res.new == [], "\n".join(f.render() for f in res.new)
+        assert res.stale_baseline == [], res.stale_baseline
+
+
+# -------------------------------------------------------------- lockcheck
+
+
+@pytest.fixture()
+def detector():
+    # snapshot/restore, not reset/disable: under a pre-armed
+    # KFTPU_LOCKCHECK=1 full-suite run these unit tests must not wipe the
+    # findings accumulated by earlier tests (the at-exit dump reports them)
+    # nor leave the detector disarmed for the suites that follow.
+    snap = lockcheck.snapshot()
+    lockcheck.reset()
+    lockcheck.enable()
+    yield lockcheck
+    lockcheck.restore(snap)
+
+
+class TestLockcheck:
+    def test_two_thread_inversion_reports_cycle_with_stacks(self, detector):
+        a = lockcheck.make_lock("test.A")
+        b = lockcheck.make_lock("test.B")
+
+        def thread_ab():
+            with a:
+                with b:
+                    pass
+
+        def thread_ba():
+            with b:
+                with a:
+                    pass
+
+        for fn in (thread_ab, thread_ba):  # sequential: no real deadlock
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        rep = detector.report()
+        assert len(rep["cycles"]) == 1
+        [cycle] = rep["cycles"]
+        edges = {e["edge"] for e in cycle}
+        assert edges == {"test.A -> test.B", "test.B -> test.A"}
+        # both acquisition stacks are named: where the held lock was taken
+        # and where the second was taken while it was held
+        blob = "\n".join(
+            s for e in cycle for s in e["held_stack"] + e["acquired_stack"]
+        )
+        assert "thread_ab" in blob and "thread_ba" in blob
+        assert "POTENTIAL DEADLOCK" in lockcheck.format_report(rep)
+
+    def test_consistent_order_is_clean(self, detector):
+        a = lockcheck.make_lock("test.A")
+        b = lockcheck.make_lock("test.B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        rep = detector.report()
+        assert rep["cycles"] == [] and rep["edges"] == 1
+
+    def test_rlock_reentry_makes_no_self_edge(self, detector):
+        r = lockcheck.make_rlock("test.R")
+        with r:
+            with r:
+                pass
+        rep = detector.report()
+        assert rep["edges"] == 0 and rep["cycles"] == []
+
+    def test_same_name_cross_instance_nesting_is_a_cycle(self, detector):
+        """Two INSTANCES of one lock site nesting (two platforms in one
+        process) is lockdep's same-class-nesting warning: instA->instB in
+        one thread and instB->instA in another is a real deadlock that
+        identity-keyed graphs never see. The name-keyed self-edge flags it
+        from the FIRST observation, no inverse ordering needed."""
+        inst_a = lockcheck.make_lock("test.same._mu")
+        inst_b = lockcheck.make_lock("test.same._mu")
+        with inst_a:
+            with inst_b:
+                pass
+        rep = detector.report()
+        assert len(rep["cycles"]) == 1
+        [[edge]] = rep["cycles"]
+        assert edge["edge"] == "test.same._mu -> test.same._mu"
+
+    def test_long_hold_records_acquisition_stack(self, detector, monkeypatch):
+        monkeypatch.setattr(lockcheck, "LONG_HOLD_S", 0.05)
+        lock = lockcheck.make_lock("test.slow")
+
+        def holder():
+            with lock:
+                time.sleep(0.08)
+
+        holder()
+        rep = detector.report()
+        assert [lh["name"] for lh in rep["long_holds"]] == ["test.slow"]
+        assert any("holder" in s for s in rep["long_holds"][0]["stack"])
+
+    def test_disabled_records_nothing(self):
+        snap = lockcheck.snapshot()
+        try:
+            lockcheck.reset()
+            lockcheck.disable()
+            a = lockcheck.make_lock("test.A2")
+            b = lockcheck.make_lock("test.B2")
+            with a:
+                with b:
+                    pass
+            assert lockcheck.report()["edges"] == 0
+        finally:
+            lockcheck.restore(snap)
+
+    def test_dump_report_writes_text_and_json(self, detector, tmp_path):
+        a = lockcheck.make_lock("test.DA")
+        b = lockcheck.make_lock("test.DB")
+        for first, second in ((a, b), (b, a)):  # sequential inversion
+            with first:
+                with second:
+                    pass
+        txt = detector.dump_report(str(tmp_path / "lockcheck_report.txt"))
+        assert "POTENTIAL DEADLOCK" in open(txt, encoding="utf-8").read()
+        js = detector.dump_report(str(tmp_path / "lockcheck_report.json"))
+        loaded = json.loads(open(js, encoding="utf-8").read())
+        assert len(loaded["cycles"]) == 1
+
+    def test_guarded_state_asserts_owning_lock(self, detector):
+        mu = lockcheck.make_lock("test.mu")
+        state = lockcheck.GuardedState(mu, table={})
+        with mu:
+            state.table["k"] = 1  # held: fine
+        with pytest.raises(AssertionError, match="test.mu"):
+            state.table  # noqa: B018 — the access IS the assertion
+        lockcheck.disable()
+        assert state.table == {"k": 1}  # disabled: plain access

@@ -44,6 +44,8 @@ from kubeflow_tpu.controller.fakecluster import (
     EventType,
     FakeCluster,
     Pod,
+    WatchClosed,
+    WatchPoller,
 )
 from kubeflow_tpu.utils.retry import (
     BackoffPolicy,
@@ -53,6 +55,8 @@ from kubeflow_tpu.utils.retry import (
 )
 
 pytestmark = pytest.mark.chaos
+# every test here runs with the lock-order detector armed: the marker-scoped
+# lockcheck_armed autouse fixture lives in conftest.py
 
 #: every drill must converge within this many reconcile passes of the job
 #: controller — the bound that makes "recovers" a checkable claim instead
@@ -352,6 +356,40 @@ class TestWatchOverflowRelist:
             EventType.MODIFIED, "pods", "default/p3"
         )
         sub.close()
+
+    def test_closed_subscription_raises_watch_closed_not_empty(self):
+        """A dead stream must be distinguishable from an idle one: mapping
+        GONE to queue.Empty is how an informer silently polls a corpse
+        forever (the error-degraded-to-idle wedge class)."""
+        cluster = FakeCluster()
+        sub = cluster.watch(replay=False)
+        sub.close()
+        with pytest.raises(WatchClosed):
+            sub.get(timeout=0.0)
+        # hub-side death (unsubscribed underneath us) is WatchClosed too
+        sub2 = cluster.watch(replay=False)
+        cluster._hub.unsubscribe(sub2._sub_id)
+        with pytest.raises(WatchClosed):
+            sub2.get(timeout=0.0)
+
+    def test_watch_poller_resubscribes_after_closed(self):
+        """WatchPoller (the shared informer loop body) treats WatchClosed as
+        a counted, recoverable error: it resubscribes and the loop sees
+        subsequent events — it does not idle-poll the dead stream."""
+        cluster = FakeCluster()
+        errors = []
+        poller = WatchPoller(cluster, timeout=0.0,
+                             count_error=lambda: errors.append(1))
+        dead = poller.q
+        dead.close()
+        assert poller.get() is None          # the death round: counted,
+        assert len(errors) == 1              # resubscribed, not raised
+        assert poller.q is not dead
+        cluster.create("pods", Pod(metadata=ObjectMeta(name="fresh")))
+        etype, kind, obj = poller.get()
+        assert (etype, kind, obj.key) == (
+            EventType.ADDED, "pods", "default/fresh"
+        )
 
     def test_reconciler_converges_after_forced_relists(
         self, platform, client, tmp_path

@@ -148,3 +148,49 @@ def test_training_client_train_rejects_unknown_family(tmp_path):
     with Platform(log_dir=str(tmp_path / "logs")) as p:
         with _pytest.raises(ValueError, match="unknown family"):
             TrainingClient(p).train("x", family="nope")
+
+
+class TestGenerateSpeculativeGuards:
+    """ADVICE r5: the --draft-model-dir path must refuse gen configs whose
+    sampled output would NOT match the same predictor served without a
+    draft — mirroring the continuous engine's submit() guard ("sampled
+    rows ... do not compose with engine-level top_k"). The checks run on
+    config.json alone, before any weight loading."""
+
+    def _model_dir(self, tmp_path, gen):
+        mdir = tmp_path / "model"
+        mdir.mkdir()
+        (mdir / "config.json").write_text(json.dumps({"generate": gen}))
+        return str(mdir)
+
+    def test_topk_with_temperature_is_rejected(self, tmp_path, capsys):
+        mdir = self._model_dir(
+            tmp_path, {"temperature": 0.7, "top_k": 5, "max_new_tokens": 8})
+        rc = main(["generate", "--model-dir", mdir, "--prompt", "1 2 3",
+                   "--draft-model-dir", str(tmp_path / "draft"),
+                   "--device", "cpu"])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "top_k" in err and "temperature" in err
+
+    def test_greedy_with_topk_passes_the_guard(self, tmp_path, capsys):
+        """temperature == 0 ignores top_k (greedy decode): the guard must
+        NOT fire — the run proceeds to weight loading, whose failure on
+        this empty dir is a different, later error (not rc=2 top_k)."""
+        mdir = self._model_dir(
+            tmp_path, {"temperature": 0.0, "top_k": 5, "max_new_tokens": 8})
+        with pytest.raises(Exception):
+            main(["generate", "--model-dir", mdir, "--prompt", "1 2 3",
+                  "--draft-model-dir", str(tmp_path / "draft"),
+                  "--device", "cpu"])
+        err = capsys.readouterr().err
+        assert "top_k" not in err
+
+    def test_beam_search_still_rejected(self, tmp_path, capsys):
+        mdir = self._model_dir(
+            tmp_path, {"num_beams": 4, "max_new_tokens": 8})
+        rc = main(["generate", "--model-dir", mdir, "--prompt", "1 2 3",
+                   "--draft-model-dir", str(tmp_path / "draft"),
+                   "--device", "cpu"])
+        assert rc == 2
+        assert "beam" in capsys.readouterr().err

@@ -56,6 +56,8 @@ from kubeflow_tpu.health import (
 from kubeflow_tpu.utils.retry import poll_until
 
 pytestmark = pytest.mark.health
+# every test here runs with the lock-order detector armed: the marker-scoped
+# lockcheck_armed autouse fixture lives in conftest.py
 
 REPO = str(Path(__file__).resolve().parents[1])
 
