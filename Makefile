@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -34,6 +34,12 @@ test-trace:
 # verified-checkpoint fallback drill (docs/health.md)
 test-health:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_health_drills.py -q -m health
+
+# profiling layer: trace analytics + golden trace-shape drill + the
+# CPU-proxy perf gate against tests/golden/prof_budgets.json
+# (docs/profiling.md; KFTPU_UPDATE_PROF_BUDGETS=1 regenerates budgets)
+test-prof:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_profiling.py tests/test_prof_gate.py -q -m prof
 
 native:
 	$(MAKE) -C $(NATIVE)

@@ -30,6 +30,10 @@ so vs_baseline is the ratio to this repo's first recorded measurement
   python bench.py --headline      # ONLY resnet+bert (<5 min): the watcher's
                                   # first stage, banking the north-star
                                   # numbers inside even a short tunnel window
+  python bench.py --cpu-proxy     # fixed-seed CPU perf workloads with phase
+                                  # breakdowns (profiling/cpu_proxy.py) — the
+                                  # tier-1 perf gate's input, no TPU needed;
+                                  # --only NEEDLE filters workloads
 
 Window-capture mode (KFT_BENCH_RESUME=1, set by an external watcher
 wrapper — the in-repo tunnel_watch scripts were retired in PR 3 — never by
@@ -975,7 +979,35 @@ SUITE_BENCHES = [
 ]
 
 
+def run_cpu_proxy() -> int:
+    """`bench.py --cpu-proxy`: the tier-1 perf surface (docs/profiling.md).
+
+    Runs the fixed-seed CPU workloads (profiling/cpu_proxy.py: traced MLP
+    train steps, continuous-serve ticks, a 200-pod reconcile storm on
+    FakeCluster) and emits ONE JSON line per workload with its phase
+    breakdown and anchor-relative ratios — the numbers the perf-gate test
+    (tests/test_prof_gate.py) compares against tests/golden/
+    prof_budgets.json. None of the tunnel resilience machinery applies:
+    this path must be deterministic and CPU-only by construction, so a
+    perf regression fails `make test` instead of waiting for hardware.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from kubeflow_tpu.profiling.cpu_proxy import run_all
+
+    only = ""
+    if "--only" in sys.argv:
+        only = sys.argv[sys.argv.index("--only") + 1]
+    for rec in run_all(only=only):
+        print(json.dumps(rec))
+        sys.stdout.flush()
+    return 0
+
+
 def main() -> None:
+    if "--cpu-proxy" in sys.argv:
+        sys.exit(run_cpu_proxy())
     if os.environ.get("KFT_BENCH_PLATFORM"):
         # debugging escape hatch (e.g. KFT_BENCH_PLATFORM=cpu when the TPU
         # tunnel is unavailable); config update, not env — see utils/device.py

@@ -305,6 +305,22 @@ class PlatformServer:
             if query.get("format") == "chrome":
                 return 200, to_chrome_trace(spans, service=tracer.service)
             return 200, render_span_tree(spans)  # raw text
+        if parsed.path == "/debug/profile":
+            # trace analytics over the same recorder (+ worker flushes in
+            # the tracer's trace_dir): step-time breakdown, goodput,
+            # control-plane percentiles, restart attribution — JSON by
+            # default, ?format=text for the operator table. The numbers
+            # are the ones `kftpu profile` and kftpu_prof_* serve
+            # (kubeflow_tpu/profiling, docs/profiling.md).
+            if getattr(self.platform, "tracer", None) is None:
+                return 404, {"error": "tracing is not enabled "
+                                      "(Platform.start_tracing)"}
+            from kubeflow_tpu.profiling import profile_platform, render_text
+
+            prof = profile_platform(self.platform)
+            if query.get("format") == "text":
+                return 200, render_text(prof)  # raw text
+            return 200, prof
         if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
             return 404, {"error": f"no route {parsed.path!r}"}
         kind = parts[2]

@@ -618,6 +618,49 @@ def cmd_import_bert(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Render a step-time/goodput/control-plane breakdown — from a trace
+    directory (worker flushes + a platform export) or a live platform's
+    /debug/profile endpoint (docs/profiling.md)."""
+    from kubeflow_tpu.profiling import (
+        ProfileError,
+        build_profile,
+        load_trace_dir,
+        render_text,
+    )
+
+    if bool(args.trace_dir) == bool(args.server):
+        print("error: pass exactly one of --trace-dir or --server",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.server:
+            import urllib.request
+
+            url = f"{args.server.rstrip('/')}/debug/profile"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                prof = json.loads(r.read())
+        else:
+            prof = build_profile(load_trace_dir(args.trace_dir))
+    except ProfileError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    except (OSError, ValueError, KeyError, TypeError) as exc:
+        # urllib errors (refused/404) and malformed server payloads (a
+        # non-profile JSON body crashing the renderer) land here — one
+        # diagnostic line, never a traceback
+        print(f"error: {exc!r}", file=sys.stderr)
+        return 2
+    out = json.dumps(prof, indent=2) + "\n" if args.json \
+        else render_text(prof)
+    if args.output:
+        Path(args.output).write_text(out)
+        print(f"wrote {args.output}", file=sys.stderr)
+    else:
+        print(out, end="")
+    return 0
+
+
 def cmd_tokenize(args) -> int:
     """Train a BPE tokenizer from a text file (one document per line) and
     write tokenizer.json — pairs with `generate` and gpt-lm predictors."""
@@ -760,6 +803,19 @@ def main(argv: list[str] | None = None) -> int:
                    help="speculated tokens per round")
     p.add_argument("--seed", type=int, default=0,
                    help="PRNG seed for sampled speculative decoding")
+
+    p = add("profile", cmd_profile,
+            help="step-time / goodput / control-plane breakdown from a "
+                 "trace dir or a live platform (docs/profiling.md)")
+    p.add_argument("--trace-dir", default="",
+                   help="directory of trace exports (worker trace-*.json "
+                        "flushes + a platform export / spans *.jsonl)")
+    p.add_argument("--server", default="",
+                   help="live platform URL — fetches /debug/profile")
+    p.add_argument("--json", action="store_true",
+                   help="emit the profile as JSON instead of the table")
+    p.add_argument("-o", "--output", default="",
+                   help="write the report to a file instead of stdout")
 
     p = add("serve", cmd_serve, help="serve an InferenceService until Ctrl-C")
     p.add_argument("-f", "--filename", required=True)

@@ -168,7 +168,12 @@ class ControllerBase:
         if tracer is None:
             return self._reconcile_pass(key, after_ptr, None)
         with tracer.span("reconcile", parent=self._trigger_ctx.pop(key, None),
-                         controller=self.name, key=key) as sp:
+                         controller=self.name, key=key,
+                         # pending keys at pass start: the profiler's
+                         # reconcile-serialization signal (a controller
+                         # whose depth grows while p99 holds is
+                         # queue-bound, not pass-bound)
+                         queue_depth=len(self.wq)) as sp:
             return self._reconcile_pass(key, after_ptr, sp)
 
     def _reconcile_pass(self, key: str, after_ptr, sp) -> int:

@@ -14,22 +14,17 @@ from __future__ import annotations
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from kubeflow_tpu.utils.prom import Exposition, observe
+
 
 def render_metrics(platform) -> str:
     """Aggregate platform state into Prometheus text format."""
-    lines: list[str] = []
-
-    def counter(name: str, value, help_: str = "") -> None:
-        if help_:
-            lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name} {value}")
-
-    def gauge(name: str, value, help_: str = "", labels: str = "") -> None:
-        if help_:
-            lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name}{labels} {value}")
+    # one builder, one HELP/TYPE declaration path (utils/prom.Exposition):
+    # repeated TYPE lines for a family are exposition-format violations,
+    # and multi-sample families below (per-kind gauges, per-controller
+    # quantiles) would hand-roll that bug without the de-dup
+    exp = Exposition()
+    counter, gauge = exp.counter, exp.gauge
 
     for cname, ctrl in platform.controllers.items():
         for mname, v in sorted(ctrl.metrics.items()):
@@ -40,11 +35,9 @@ def render_metrics(platform) -> str:
         )
         # reconcile-duration histogram (controller-runtime parity):
         # cumulative le buckets + _sum/_count in exposition format
-        from kubeflow_tpu.utils.prom import render_histogram
-
         counts, total = ctrl.latency_snapshot()
-        render_histogram(
-            lines, f"kftpu_{cname}_reconcile_duration_seconds",
+        exp.histogram(
+            f"kftpu_{cname}_reconcile_duration_seconds",
             ctrl.latency_buckets, counts, total,
         )
 
@@ -58,13 +51,9 @@ def render_metrics(platform) -> str:
     runtime = getattr(platform, "pod_runtime", None)
     if runtime is not None:
         ages = runtime.heartbeat_ages()
-        if ages:
-            lines.append("# TYPE kftpu_health_heartbeat_age_seconds gauge")
-            for (key, uid), age in sorted(ages.items()):
-                lines.append(
-                    f'kftpu_health_heartbeat_age_seconds'
-                    f'{{pod="{key}",uid="{uid}"}} {age:.3f}'
-                )
+        for (key, uid), age in sorted(ages.items()):
+            gauge("kftpu_health_heartbeat_age_seconds", f"{age:.3f}",
+                  labels=f'{{pod="{key}",uid="{uid}"}}')
 
     # checkpoint integrity verification (train/checkpoint.py): the registry
     # is process-global — checkpointers are constructed ad hoc by trainers,
@@ -101,18 +90,67 @@ def render_metrics(platform) -> str:
             help_="flight recorder ring size",
         )
 
+        # profiling analytics (kubeflow_tpu/profiling, docs/profiling.md):
+        # the same breakdown /debug/profile and `kftpu profile` serve,
+        # derived from the recorder snapshot (+ worker flushes in
+        # trace_dir) at scrape time — scrapers get step-time histograms
+        # and goodput without a second instrumentation path
+        from kubeflow_tpu.profiling import (
+            PROF_BUCKETS,
+            control_plane_stats,
+            goodput as prof_goodput,
+            platform_spans,
+            step_breakdown,
+        )
+
+        spans, _dropped = platform_spans(platform)
+        steps = step_breakdown(spans)
+        for fam, phase, help_ in (
+            ("kftpu_prof_step_time_seconds", "wall",
+             "per-step cycle wall time (end of previous step to end of "
+             "this one)"),
+            ("kftpu_prof_data_load_seconds", "data_load",
+             "host-side input fetch time charged to each step cycle"),
+            ("kftpu_prof_stall_seconds", "stall",
+             "per-step unattributed remainder (wall - accounted phases)"),
+        ):
+            counts = [0] * (len(PROF_BUCKETS) + 1)
+            total = 0.0
+            for st in steps:
+                observe(PROF_BUCKETS, counts, st[phase])
+                total += st[phase]
+            exp.histogram(fam, PROF_BUCKETS, counts, total, help_=help_)
+        gauge(
+            "kftpu_prof_goodput_ratio",
+            prof_goodput(spans, steps)["goodput"],
+            help_="productive step time over the trace window "
+                  "(docs/profiling.md)",
+        )
+        # stable label set: every registered controller gets its quantile
+        # samples (0 until reconcile spans exist), so dashboards and the
+        # golden pin see the same series on a fresh and a busy platform
+        rec_stats = control_plane_stats(spans)["reconcile"]
+        for ctrl in sorted(set(platform.controllers) | set(rec_stats)):
+            st = rec_stats.get(ctrl)
+            for q, key in (("0.5", "p50_s"), ("0.99", "p99_s")):
+                gauge(
+                    "kftpu_prof_reconcile_latency_seconds",
+                    st[key] if st else 0.0,
+                    help_="reconcile-duration quantiles per controller, "
+                          "derived from reconcile spans",
+                    labels=f'{{controller="{ctrl}",quantile="{q}"}}',
+                )
+
     cluster = platform.cluster
-    # one TYPE line, then one sample per label — repeated TYPE lines for the
-    # same metric are invalid exposition format and fail real scrapes
-    lines.append("# TYPE kftpu_objects gauge")
     for kind in cluster.KINDS:
-        lines.append(f'kftpu_objects{{kind="{kind}"}} {len(cluster.list(kind))}')
+        gauge("kftpu_objects", len(cluster.list(kind)),
+              labels=f'{{kind="{kind}"}}')
     gauge("kftpu_events_total", len(cluster.events))
     gauge(
         "kftpu_capacity_chips", cluster.capacity_chips,
         help_="schedulable chips in the gang scheduler",
     )
-    return "\n".join(lines) + "\n"
+    return exp.text()
 
 
 class MetricsServer:

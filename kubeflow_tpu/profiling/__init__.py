@@ -1,0 +1,51 @@
+"""kubeflow_tpu.profiling — trace analytics over the flight recorder.
+
+The answer layer on top of tracing/ (docs/profiling.md): step-time
+breakdowns with an explicit stall remainder, goodput per job incarnation
+with restart overhead attributed along the causal chain, control-plane
+latency percentiles, golden-pinnable restart trace shapes, and the
+CPU-proxy perf workloads that gate `make test` on regressions.
+
+Surfaces: `GET /debug/profile` (apiserver), the `profile` CLI subcommand,
+the `kftpu_prof_*` /metrics families (observability.py), and
+`bench.py --cpu-proxy` — all reading report.build_profile, so they agree
+by construction.
+"""
+
+from kubeflow_tpu.profiling.analytics import (
+    PROF_BUCKETS,
+    aggregate_steps,
+    ancestry,
+    control_plane_stats,
+    goodput,
+    percentile,
+    restart_chains,
+    restart_shape,
+    step_breakdown,
+)
+from kubeflow_tpu.profiling.report import (
+    ProfileError,
+    build_profile,
+    load_trace_dir,
+    platform_spans,
+    profile_platform,
+    render_text,
+)
+
+__all__ = [
+    "PROF_BUCKETS",
+    "ProfileError",
+    "aggregate_steps",
+    "ancestry",
+    "build_profile",
+    "control_plane_stats",
+    "goodput",
+    "load_trace_dir",
+    "percentile",
+    "platform_spans",
+    "profile_platform",
+    "render_text",
+    "restart_chains",
+    "restart_shape",
+    "step_breakdown",
+]

@@ -1,0 +1,514 @@
+"""CPU-proxy perf workloads — perf regressions provable WITHOUT the TPU.
+
+With the live tunnel hung, a perf claim that only a hardware bench can
+falsify is unfalsifiable (ROADMAP re-anchor note). These workloads run the
+same code paths the real benches exercise — traced MLP train steps,
+continuous-serve decode ticks, a reconcile storm on FakeCluster — on CPU
+with fixed seeds, and express every phase as a RATIO to an in-run anchor
+measured by the same machinery:
+
+  - mlp_train anchors data_load / stall to the jit step's own compute
+    time (a machine running everything 2x slower moves numerator and
+    denominator together; a code change that slows ONLY the input
+    pipeline moves the ratio);
+  - reconcile_storm anchors reconcile percentiles to a calibration unit
+    (the median of a fixed FakeCluster get loop — the same store lock +
+    deepcopy machinery a reconcile pass runs through);
+  - serve_ticks anchors per-dispatch engine time to a fixed jit matmul.
+
+Ratios are gated against checked-in budgets (tests/golden/
+prof_budgets.json; `KFTPU_UPDATE_PROF_BUDGETS=1` regenerates) with
+generous multipliers, so `make test` fails on an injected 2x slowdown
+while machine-speed drift passes. The test-only chaos hook
+(KFTPU_PROF_CHAOS="phase:N") REPEATS the phase's deterministic work N
+times — no sleeps, so the injection scales with the machine exactly like
+a real regression would.
+
+Phase medians (not means) across steps make single-GC-pause outliers
+irrelevant on both the budget-regen and the gate side.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from kubeflow_tpu.utils.envvars import ENV_PROF_CHAOS
+
+#: default allowed measured/budget ratio per workload (a phase fails the
+#: gate when measured_rel > budget_rel * ratio + GATE_SLACK)
+DEFAULT_MAX_RATIO = 1.5
+#: absolute slack added to every allowance: tiny phases (stall on an idle
+#: CPU) have huge relative noise but bounded absolute effect
+GATE_SLACK = 0.08
+
+
+def chaos_repeats(phase: str) -> int:
+    """Work-repeat factor for a phase from the test-only chaos hook env
+    (KFTPU_PROF_CHAOS="data_load:2,reconcile:2"). 1 = untouched."""
+    raw = os.environ.get(ENV_PROF_CHAOS, "")
+    for term in raw.split(","):
+        name, _, factor = term.partition(":")
+        if name.strip() == phase and factor:
+            try:
+                return max(1, int(round(float(factor))))
+            except ValueError:
+                continue
+    return 1
+
+
+def _median(values: list[float]) -> float:
+    vs = sorted(values)
+    return vs[len(vs) // 2] if vs else 0.0
+
+
+def _best_of(fn, gated_phase: str, runs: int = 2) -> dict:
+    """Run a workload `runs` times and keep the run with the LOWEST gated
+    ratio — scheduler/GC noise only ever inflates a run, while a real
+    regression (or the chaos hook) inflates every run, so best-of-N
+    narrows the gate's noise band without blunting its teeth."""
+    best = None
+    for _ in range(runs):
+        rec = fn()
+        if best is None or rec["rel"][gated_phase] \
+                < best["rel"][gated_phase]:
+            best = rec
+    return best
+
+
+# ------------------------------------------------------------- mlp_train
+
+
+def _mlp_step():
+    """One cached jit SGD step for a fixed tiny MLP (no mesh machinery —
+    must run on every jax this repo supports, incl. 0.4.x without
+    jax.set_mesh)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, x, y):
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        logits = h @ params["w2"] + params["b2"]
+        onehot = jax.nn.one_hot(y, 10)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+
+    @jax.jit
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        params = jax.tree.map(lambda p, g: p - 0.05 * g, params, grads)
+        return params, loss
+
+    return step
+
+
+_MLP_STEP = None
+
+
+def mlp_train(steps: int = 16, batch: int = 128, pool: int = 2048) -> dict:
+    """Fixed-seed MLP train loop traced with the REAL span names
+    (train.data_load / train.step) and broken down by the REAL analytics
+    engine — the cpu-proxy twin of the trainer hot loop."""
+    global _MLP_STEP
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.profiling.analytics import step_breakdown
+    from kubeflow_tpu.tracing import Tracer
+
+    if _MLP_STEP is None:
+        _MLP_STEP = _mlp_step()
+    rng = np.random.default_rng(0)
+    base = rng.standard_normal((pool, 784)).astype(np.float32)
+    labels = rng.integers(0, 10, size=pool).astype(np.int32)
+    params = {
+        "w1": jnp.asarray(rng.standard_normal((784, 128)).astype(np.float32)
+                          * 0.05),
+        "b1": jnp.zeros((128,), jnp.float32),
+        "w2": jnp.asarray(rng.standard_normal((128, 10)).astype(np.float32)
+                          * 0.05),
+        "b2": jnp.zeros((10,), jnp.float32),
+    }
+    repeats = chaos_repeats("data_load")
+    buf = np.empty_like(base)  # reused: allocator churn is not the phase
+
+    def fetch(i: int):
+        # the deterministic host-side input-pipeline work the gate
+        # watches: shuffle + whole-pool normalize + slice per step, into a
+        # preallocated buffer so the measurement is the WORK, not the
+        # allocator's mood across rounds
+        x = y = None
+        for _ in range(repeats):
+            perm = np.random.default_rng(1000 + i).permutation(pool)
+            np.take(base, perm, axis=0, out=buf)
+            mu = buf.mean(axis=0)
+            sd = buf.std(axis=0)
+            np.subtract(buf, mu, out=buf)
+            np.divide(buf, sd + 1e-6, out=buf)
+            x = buf[:batch].copy()
+            y = labels[perm[:batch]]
+        return x, y
+
+    def raw_fetch_once() -> float:
+        # the identical numpy kernels, UN-spanned and UN-chaosed (fixed
+        # perm, repeats ignored): the data_load anchor. Numerator and
+        # denominator share kernels and buffers, so machine-speed noise
+        # cancels almost exactly, while the chaos repeat — and any
+        # regression in the span/accounting path the traced loop runs
+        # through — moves only the numerator.
+        perm = np.random.default_rng(999).permutation(pool)
+        t0 = time.perf_counter()
+        np.take(base, perm, axis=0, out=buf)
+        mu = buf.mean(axis=0)
+        sd = buf.std(axis=0)
+        np.subtract(buf, mu, out=buf)
+        np.divide(buf, sd + 1e-6, out=buf)
+        buf[:batch].copy()
+        return time.perf_counter() - t0
+
+    # warmup outside the trace: jit compile must not pollute step 0
+    wx, wy = fetch(-1)
+    params, loss = _MLP_STEP(params, wx, wy)
+    float(loss)
+    import gc
+
+    # two traced runs, per-phase MIN of the in-run medians: scheduler /
+    # frequency noise only inflates a run, a real regression (or the
+    # chaos hook) inflates both — same rationale as _best_of, applied
+    # per phase so numerator and denominator are each at their floor
+    runs: list[dict[str, float]] = []
+    n_steps = 0
+    for _ in range(2):
+        tracer = Tracer(capacity=8 * steps)
+        # same GC posture every run: earlier workloads' garbage otherwise
+        # triggers collections inside the numpy fetch and skews data_load
+        gc.collect()
+        for i in range(steps):
+            with tracer.span("train.data_load", seq=i):
+                x, y = fetch(i)
+            with tracer.span("train.step", step=i):
+                params, loss = _MLP_STEP(params, x, y)
+                float(loss)  # host read: the true per-step sync
+        per_step = step_breakdown(tracer.snapshot())
+        n_steps = len(per_step)
+        runs.append({
+            p: _median([s[p] for s in per_step])
+            for p in ("data_load", "compute", "stall")
+        })
+    data = min(r["data_load"] for r in runs)
+    compute = min(r["compute"] for r in runs)
+    stall = min(r["stall"] for r in runs)
+    # the data_load anchor: min over medians-of-8 raw fetches, sampled
+    # after each traced run (either window may catch interference)
+    gc.collect()
+    fetch_unit = min(
+        _median([raw_fetch_once() for _ in range(8)]) for _ in range(3))
+    return {
+        "workload": "mlp_train",
+        "steps": n_steps,
+        "anchor": "raw_fetch/compute",
+        "anchor_s": round(fetch_unit, 6),
+        "phases_s": {"data_load": round(data, 6),
+                     "compute": round(compute, 6),
+                     "stall": round(stall, 6)},
+        # data_load vs the raw twin of its own kernels (ratio ~= 1 + span
+        # machinery overhead, machine-invariant); stall vs the jit step
+        "rel": {"data_load": (round(data / fetch_unit, 4)
+                              if fetch_unit else 0.0),
+                "stall": round(stall / compute, 4) if compute else 0.0},
+    }
+
+
+# ------------------------------------------------------------ serve_ticks
+
+
+def serve_ticks(rows: int = 4, n_requests: int = 6, prompt_len: int = 12,
+                new_tokens: int = 8) -> dict:
+    """Continuous-batching decode ticks on a tiny fixed-seed GPT: the
+    per-dispatch engine time (scheduling + splice + decode step) in units
+    of a fixed jit matmul — the serving analogue of the step breakdown."""
+    import jax
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        # same version gap that fails Trainer.fit in tier-1 (jax 0.4.x):
+        # the GPT/serving model path needs the newer mesh API. A skipped
+        # record is emitted (and excluded from gating) rather than a
+        # crash, so the other proxies keep their teeth on this jax.
+        return {
+            "workload": "serve_ticks",
+            "skipped": "jax lacks jax.sharding.get_abstract_mesh "
+                       "(GPT/serving path needs newer jax)",
+            "phases_s": {}, "rel": {},
+        }
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=2, mlp_dim=128, dropout_rate=0.0,
+                    max_len=prompt_len + new_tokens + 2)
+    model = GPTLM(cfg)
+    rng = np.random.default_rng(2)
+    prompts = rng.integers(1, cfg.vocab_size,
+                           size=(n_requests, prompt_len)).astype(np.int32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                    jnp.asarray(prompts[:1]))
+    eng = ContinuousBatcher(model, variables, max_rows=rows,
+                            default_max_new_tokens=new_tokens)
+    # warmup: compile prefill + decode + splice once, outside the timing
+    eng.submit(prompts[0], max_new_tokens=2)
+    eng.run_until_idle()
+    step0 = eng.step_count
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
+    eng.run_until_idle()
+    dt = time.perf_counter() - t0
+    dispatches = max(eng.step_count - step0, 1)
+    toks = sum(len(r.result(timeout=0)) for r in reqs if r.done.is_set())
+    unit = _calibration_unit()
+    per_dispatch = dt / dispatches
+    return {
+        "workload": "serve_ticks",
+        "dispatches": dispatches,
+        "tokens": toks,
+        "anchor": "matmul_unit",
+        "anchor_s": round(unit, 6),
+        "phases_s": {"tick": round(per_dispatch, 6)},
+        "rel": {"tick": round(per_dispatch / unit, 4) if unit else 0.0},
+    }
+
+
+_CALIBRATION_UNIT = None
+
+
+def _calibration_unit() -> float:
+    """Median seconds of a fixed 256x256 jit matmul + host read — the
+    machine-speed normalizer for workloads without an in-run compute
+    anchor. Cached per process (the gate compares one process's run)."""
+    global _CALIBRATION_UNIT
+    if _CALIBRATION_UNIT is not None:
+        return _CALIBRATION_UNIT
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    a = jnp.asarray(np.random.default_rng(3)
+                    .standard_normal((256, 256)).astype(np.float32))
+    f = jax.jit(lambda m: (m @ m).sum())
+    float(f(a))  # compile
+    samples = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        float(f(a))
+        samples.append(time.perf_counter() - t0)
+    _CALIBRATION_UNIT = _median(samples)
+    return _CALIBRATION_UNIT
+
+
+# -------------------------------------------------------- reconcile_storm
+
+
+def reconcile_storm(n_pods: int = 200, gets_per_pass: int = 8,
+                    timeout_s: float = 60.0) -> dict:
+    """N-pod reconcile storm on a bare FakeCluster: one ADDED event per
+    pod drives one reconcile pass through the real informer -> workqueue
+    -> native-driver path, each pass doing a fixed amount of store-read
+    work. Reconcile-duration percentiles come from the REAL reconcile
+    spans (ControllerBase emits them) and are normalized by a calibration
+    loop over the same get machinery."""
+    from kubeflow_tpu.controller.base import ControllerBase
+    from kubeflow_tpu.controller.fakecluster import FakeCluster, Pod
+    from kubeflow_tpu.api.common import ObjectMeta
+    from kubeflow_tpu.profiling.analytics import control_plane_stats
+    from kubeflow_tpu.tracing import Tracer
+    from kubeflow_tpu.utils.retry import poll_until
+
+    repeats = chaos_repeats("reconcile")
+
+    class StormController(ControllerBase):
+        ERROR_EVENT_KIND = "pods"
+
+        def kind_filter(self, etype, kind, obj):
+            if kind == "pods" and obj.metadata.name.startswith("storm-"):
+                return obj.key
+            return None
+
+        def resync_keys(self):
+            return ()
+
+        def reconcile(self, key):
+            # read-only convergent pass: fixed get work, no write-back —
+            # the storm stays exactly one pass per ADDED event
+            for _ in range(repeats):
+                for _ in range(gets_per_pass):
+                    self.cluster.get("pods", key, copy_obj=True)
+            return None
+
+    cluster = FakeCluster()
+    tracer = Tracer(capacity=8 * n_pods)
+    cluster.tracer = tracer
+
+    # calibration: the same store-lock + deepcopy path a pass runs through.
+    # Collect first — garbage left by earlier workloads otherwise triggers
+    # gen-0 GC passes inside the deepcopy loop and skews the unit ~40%
+    import gc
+
+    ref = Pod(metadata=ObjectMeta(name="storm-calibration"))
+    cluster.create("pods", ref)
+
+    # min over medians-of-40 blocks: transient interference (a lingering
+    # thread from a previous workload, a GC pass) inflates SOME blocks;
+    # a real store regression inflates all of them, so min still scales
+    def store_unit_blocks(n: int) -> float:
+        medians = []
+        for _ in range(n):
+            gc.collect()
+            samples = []
+            for _ in range(40):
+                t0 = time.perf_counter()
+                cluster.get("pods", ref.key, copy_obj=True)
+                samples.append(time.perf_counter() - t0)
+            medians.append(_median(samples))
+        return min(medians)
+
+    unit_before = store_unit_blocks(3)
+
+    # one worker: the gate watches per-PASS cost, and a second worker only
+    # adds store-lock contention noise to the median it is gated on
+    # bulk wave lands BEFORE the controller starts: the informer's initial
+    # list+watch replay delivers all N at once, so the gated median
+    # measures pass cost, not creator-vs-informer lock contention (which
+    # is bimodal run-to-run and would blunt the gate)
+    for i in range(n_pods):
+        cluster.create("pods", Pod(metadata=ObjectMeta(
+            name=f"storm-{i:04d}")))
+    live_wave = max(n_pods // 10, 1)
+    ctrl = StormController(cluster, "storm", workers=1)
+    gc.collect()  # same GC posture for the measured passes as the unit
+    ctrl.start()
+    try:
+        poll_until(
+            lambda: ctrl.metrics["reconcile_total"] >= n_pods + 1 or None,
+            timeout_s=timeout_s, describe="reconcile storm drained",
+        )
+        # small LIVE wave, each create under a span: the published events
+        # carry its context, so reconcile passes parent-link to it and
+        # the watch-delivery percentiles are measured, not vacuous
+        for i in range(live_wave):
+            with tracer.span("storm.submit", i=i):
+                cluster.create("pods", Pod(metadata=ObjectMeta(
+                    name=f"storm-live-{i:04d}")))
+        poll_until(
+            lambda: (ctrl.metrics["reconcile_total"]
+                     >= n_pods + live_wave + 1) or None,
+            timeout_s=timeout_s, describe="live wave drained",
+        )
+    finally:
+        ctrl.stop()
+        cluster.tracer = None
+    # re-sample after the drain: the unit wants the machine's UNLOADED
+    # store speed, and either window may have caught interference
+    unit = min(unit_before, store_unit_blocks(2)) * gets_per_pass
+    stats = control_plane_stats(tracer.snapshot())["reconcile"]["storm"]
+    return {
+        "workload": "reconcile_storm",
+        "passes": stats["count"],
+        "pods": n_pods,
+        "anchor": "store_get_unit",
+        "anchor_s": round(unit, 6),
+        "phases_s": {"reconcile_p50": stats["p50_s"],
+                     "reconcile_p99": stats["p99_s"]},
+        # only the MEDIAN is gated: a 200-sample p99 is ~the 2nd-worst
+        # sample (GC/scheduler noise), reported for operators but too
+        # jittery to gate `make test` on
+        "rel": {
+            "reconcile_p50": round(stats["p50_s"] / unit, 4) if unit else 0.0,
+        },
+        "reconcile_p99_units": (round(stats["p99_s"] / unit, 4)
+                                if unit else 0.0),
+        "watch_delay_p99_s": stats["watch_delay_p99_s"],
+    }
+
+
+# ----------------------------------------------------------------- harness
+
+WORKLOADS = ("mlp_train", "serve_ticks", "reconcile_storm")
+
+
+def run_all(only: str = "") -> list[dict]:
+    """Run every workload (or those whose name contains `only`),
+    best-of-2 on each workload's primary gated phase."""
+    fns = {
+        "mlp_train": mlp_train,  # per-phase min-of-2 internally
+        "serve_ticks": serve_ticks,
+        "reconcile_storm": lambda: _best_of(reconcile_storm,
+                                            "reconcile_p50"),
+    }
+    return [fns[name]() for name in WORKLOADS
+            if not only or only in name]
+
+
+# ------------------------------------------------------------------- gate
+
+
+def make_budgets(results: list[dict]) -> dict:
+    """Budget-file shape from measured results (the
+    KFTPU_UPDATE_PROF_BUDGETS=1 regen path)."""
+    budgets: dict = {}
+    for rec in results:
+        if rec.get("skipped"):
+            # record WHY there is no baseline: when a later environment
+            # (e.g. a jax upgrade) can run the workload, the gate treats
+            # this marker as "unbudgeted by circumstance, regen when you
+            # can" instead of failing every untouched tree
+            budgets[rec["workload"]] = {"skipped_on_regen": rec["skipped"]}
+            continue
+        budgets[rec["workload"]] = {
+            "rel": dict(rec["rel"]),
+            "max_ratio": DEFAULT_MAX_RATIO,
+            # the engine tick mixes python scheduling with jit dispatch —
+            # its anchor (a bare matmul) tracks it less tightly than the
+            # in-run anchors, so it gets a looser multiplier
+            "ratios": ({"tick": 3.0}
+                       if rec["workload"] == "serve_ticks" else {}),
+        }
+    return budgets
+
+
+def check_budgets(results: list[dict], budgets: dict) -> list[str]:
+    """Gate: each measured phase ratio must stay inside its budget times
+    the allowed multiplier. Returns violation strings (empty = pass).
+    Missing budgets are violations too — a new workload cannot silently
+    run ungated."""
+    violations: list[str] = []
+    for rec in results:
+        if rec.get("skipped"):
+            continue  # environment can't run it — reported, not gated
+        b = budgets.get(rec["workload"])
+        if b is None:
+            violations.append(
+                f"{rec['workload']}: no checked-in budget "
+                "(regen with KFTPU_UPDATE_PROF_BUDGETS=1)")
+            continue
+        if "skipped_on_regen" in b and "rel" not in b:
+            # the checked-in budgets were generated on an env that could
+            # not run this workload; now it CAN — there is no baseline to
+            # gate against, and bricking `make test` on an env upgrade
+            # would punish the wrong change. Ungated until regenerated.
+            continue
+        default_ratio = b.get("max_ratio", DEFAULT_MAX_RATIO)
+        for phase, rel in sorted(rec["rel"].items()):
+            budget_rel = b.get("rel", {}).get(phase)
+            if budget_rel is None:
+                violations.append(
+                    f"{rec['workload']}.{phase}: no budget for phase")
+                continue
+            ratio = b.get("ratios", {}).get(phase, default_ratio)
+            allowed = budget_rel * ratio + GATE_SLACK
+            if rel > allowed:
+                violations.append(
+                    f"{rec['workload']}.{phase}: measured {rel:.3f} > "
+                    f"allowed {allowed:.3f} "
+                    f"(budget {budget_rel:.3f} x {ratio})")
+    return violations

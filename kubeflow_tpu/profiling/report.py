@@ -1,0 +1,195 @@
+"""Profile report — the ONE breakdown every surface serves.
+
+`build_profile` turns a span list into the canonical profile dict;
+`profile_platform` feeds it the platform recorder + any worker flushes in
+the tracer's trace_dir. `GET /debug/profile`, the `profile` CLI
+subcommand, and the `kftpu_prof_*` /metrics families all read THIS module,
+so the three surfaces can never disagree about what a step cost.
+
+`load_trace_dir` is the CLI's strict loader: unlike
+tracing.export.collect_worker_traces (which skips torn files so a drill
+export never fails), an operator pointing the profiler at a directory
+wants to know when a file is corrupt, empty, or missing the platform
+side — each such case raises ProfileError with a one-line diagnostic.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+
+from kubeflow_tpu.profiling.analytics import (
+    PLATFORM_SPAN_NAMES,
+    aggregate_steps,
+    control_plane_stats,
+    goodput,
+    restart_chains,
+    step_breakdown,
+)
+
+
+class ProfileError(Exception):
+    """A trace set the profiler cannot analyze — message is the one-line
+    operator diagnostic (the CLI prints it and exits 2)."""
+
+
+def build_profile(spans: list[dict], dropped: int = 0) -> dict:
+    """The canonical profile dict for a span snapshot.
+
+    `dropped` is the recorder's spans_dropped_total: a non-zero value
+    means the ring evicted spans and the breakdown may under-account —
+    the report says so instead of silently producing wrong attributions.
+    """
+    steps = step_breakdown(spans)
+    return {
+        "spans": len(spans),
+        "dropped_spans": dropped,
+        "incomplete": dropped > 0,
+        "steps": aggregate_steps(steps),
+        "goodput": goodput(spans, steps),
+        "control_plane": control_plane_stats(spans),
+        "restarts": restart_chains(spans),
+    }
+
+
+#: parsed worker flushes keyed by path -> ((mtime_ns, size), spans):
+#: /metrics is scraped on an interval and worker trace files are
+#: write-once (atexit flush), so re-parsing every file per scrape would
+#: grow scrape latency with job history for no information
+_WORKER_CACHE: dict[str, tuple[tuple, list[dict]]] = {}
+
+
+def _cached_worker_traces(trace_dir: str) -> list[dict]:
+    import json
+
+    from kubeflow_tpu.tracing import load_chrome_trace
+
+    spans: list[dict] = []
+    for path in sorted(_glob.glob(os.path.join(trace_dir,
+                                               "trace-*.json"))):
+        try:
+            st = os.stat(path)
+            sig = (st.st_mtime_ns, st.st_size)
+            hit = _WORKER_CACHE.get(path)
+            if hit is None or hit[0] != sig:
+                if len(_WORKER_CACHE) > 256:  # leak backstop
+                    _WORKER_CACHE.clear()
+                hit = (sig, load_chrome_trace(path))
+                _WORKER_CACHE[path] = hit
+            spans.extend(hit[1])
+        except (OSError, json.JSONDecodeError):
+            continue  # torn flush of a dying pod — same as export side
+    return spans
+
+
+def platform_spans(platform) -> tuple[list[dict], int]:
+    """(spans, dropped) for a live platform: the flight-recorder snapshot
+    merged with any worker flushes in the tracer's trace_dir."""
+    tracer = getattr(platform, "tracer", None)
+    if tracer is None or tracer.recorder is None:
+        return [], 0
+    spans = list(tracer.snapshot())
+    if tracer.trace_dir:
+        spans.extend(_cached_worker_traces(tracer.trace_dir))
+    spans.sort(key=lambda s: s["ts"])
+    return spans, tracer.recorder.dropped
+
+
+def profile_platform(platform) -> dict:
+    spans, dropped = platform_spans(platform)
+    return build_profile(spans, dropped=dropped)
+
+
+def load_trace_dir(trace_dir: str) -> list[dict]:
+    """Strictly load every trace file in a directory: Chrome trace-event
+    `*.json` (tracing.flush / export_merged_trace output) and raw span
+    `*.jsonl` dumps (write_spans_jsonl, one span dict per line)."""
+    import json
+
+    from kubeflow_tpu.tracing import load_chrome_trace
+    from kubeflow_tpu.tracing.export import load_spans_jsonl
+
+    if not os.path.isdir(trace_dir):
+        raise ProfileError(f"trace dir {trace_dir!r} does not exist")
+    paths = sorted(_glob.glob(os.path.join(trace_dir, "*.json"))
+                   + _glob.glob(os.path.join(trace_dir, "*.jsonl")))
+    if not paths:
+        raise ProfileError(
+            f"no trace files (*.json / *.jsonl) in {trace_dir!r}")
+    spans: list[dict] = []
+    for path in paths:
+        try:
+            if path.endswith(".jsonl"):
+                spans.extend(load_spans_jsonl(path))
+            else:
+                spans.extend(load_chrome_trace(path))
+        except (OSError, json.JSONDecodeError, ValueError) as exc:
+            raise ProfileError(
+                f"unreadable trace file {os.path.basename(path)}: {exc}"
+            ) from exc
+    if not spans:
+        raise ProfileError(f"trace files in {trace_dir!r} hold no spans")
+    if not any(s["name"] in PLATFORM_SPAN_NAMES for s in spans):
+        raise ProfileError(
+            "trace dir holds only worker spans (no platform trace) — "
+            "export the platform recorder too (tracing.flush(platform."
+            "tracer) / export_merged_trace), or use --server against a "
+            "live platform")
+    spans.sort(key=lambda s: s["ts"])
+    return spans
+
+
+# ------------------------------------------------------------ text rendering
+
+
+def _ms(v: float) -> str:
+    return f"{v * 1e3:.2f}ms"
+
+
+def render_text(profile: dict) -> str:
+    """Operator-facing table form of a profile dict (the default
+    `profile` CLI / `?format=text` rendering)."""
+    lines = [f"kftpu profile — {profile['spans']} spans"]
+    if profile.get("incomplete"):
+        lines.append(
+            f"WARNING: breakdown incomplete "
+            f"({profile['dropped_spans']} spans dropped from the flight "
+            "recorder — raise start_tracing(capacity=))")
+    st = profile["steps"]
+    lines.append(f"step-time breakdown ({st['count']} steps, "
+                 f"{st['wall_s']:.3f}s wall):")
+    lines.append("  phase        total_s    frac")
+    for phase in ("data_load", "compute", "checkpoint", "stall"):
+        lines.append(
+            f"  {phase:<12} {st['phases_s'][phase]:>8.3f}  "
+            f"{st['fractions'][phase] * 100:>5.1f}%")
+    lines.append(
+        f"  per-step: mean {_ms(st['per_step']['mean_s'])}  "
+        f"p50 {_ms(st['per_step']['p50_s'])}  "
+        f"p99 {_ms(st['per_step']['p99_s'])}")
+    g = profile["goodput"]
+    lines.append(
+        f"goodput: {g['goodput']:.3f} ({g['productive_s']:.3f}s productive "
+        f"/ {g['window_s']:.3f}s window, "
+        f"{len(g['incarnations'])} incarnation(s), "
+        f"restart overhead {g['restart_overhead_s']:.3f}s)")
+    for ch in profile["restarts"]:
+        lines.append(
+            f"restart {ch['restart']}: {' -> '.join(ch['chain'])} "
+            f"(overhead {ch['overhead_s']:.3f}s, "
+            f"{'monotonic' if ch['monotonic'] else 'OUT-OF-ORDER'})")
+    cp = profile["control_plane"]
+    if cp["reconcile"]:
+        lines.append("control plane (reconcile):")
+        lines.append("  controller     passes   p50       p99       "
+                     "watch_p99")
+        for ctrl, r in sorted(cp["reconcile"].items()):
+            lines.append(
+                f"  {ctrl:<14} {r['count']:>6}   {_ms(r['p50_s']):>8}  "
+                f"{_ms(r['p99_s']):>8}  {_ms(r['watch_delay_p99_s']):>8}")
+    if cp.get("http"):
+        h = cp["http"]
+        lines.append(
+            f"http.request: {h['count']} requests, p50 {_ms(h['p50_s'])}, "
+            f"p99 {_ms(h['p99_s'])}")
+    return "\n".join(lines) + "\n"

@@ -28,9 +28,11 @@ from kubeflow_tpu.tracing.export import (
     collect_worker_traces,
     export_merged_trace,
     load_chrome_trace,
+    load_spans_jsonl,
     render_span_tree,
     to_chrome_trace,
     write_chrome_trace,
+    write_spans_jsonl,
 )
 
 __all__ = [
@@ -51,10 +53,12 @@ __all__ = [
     "get_tracer",
     "init_worker_from_env",
     "load_chrome_trace",
+    "load_spans_jsonl",
     "render_span_tree",
     "set_delivered_context",
     "set_tracer",
     "to_chrome_trace",
     "tracer_of",
     "write_chrome_trace",
+    "write_spans_jsonl",
 ]

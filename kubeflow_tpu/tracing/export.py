@@ -81,6 +81,40 @@ def load_chrome_trace(path: str) -> list[dict]:
     return spans
 
 
+def write_spans_jsonl(path: str, spans: list[dict]) -> str:
+    """Raw span-dict dump, one JSON object per line — the cheapest durable
+    form of a recorder snapshot (no Chrome envelope), consumed by the
+    profiler (`kftpu profile --trace-dir`)."""
+    with open(path, "w") as fh:
+        for s in spans:
+            fh.write(json.dumps(s) + "\n")
+    return path
+
+
+def load_spans_jsonl(path: str) -> list[dict]:
+    """Read a write_spans_jsonl file back. STRICT by design: a torn or
+    hand-edited line raises ValueError naming the line — the profiler must
+    report a corrupt input rather than silently analyze half a trace."""
+    spans: list[dict] = []
+    with open(path) as fh:
+        for n, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                s = json.loads(line)
+                if not isinstance(s, dict) or "name" not in s \
+                        or "ts" not in s:
+                    raise ValueError("not a span dict")
+            except ValueError as exc:
+                raise ValueError(f"corrupt span line {n}: {exc}") from exc
+            s.setdefault("dur", 0.0)
+            s.setdefault("parent", "")
+            s.setdefault("attrs", {})
+            spans.append(s)
+    return spans
+
+
 def collect_worker_traces(trace_dir: str) -> list[dict]:
     """Every span flushed by worker processes into trace_dir
     (trace-*.json files, the tracing.flush naming)."""

@@ -48,10 +48,15 @@ from kubeflow_tpu.train.data import Dataset, batches, prefetch_to_device
 def _traced_data_iter(tracer, it):
     """Wrap a batch iterator so each HOST-side fetch (shuffle/stack/device
     put — everything before the step dispatch) is a train.data_load span.
-    Only installed when tracing is enabled; the plain loop is untouched."""
+    Only installed when tracing is enabled; the plain loop is untouched.
+    Each span carries its fetch sequence number so the profiler
+    (kubeflow_tpu/profiling) can pair fetches with step cycles
+    deterministically instead of by wall-clock alone."""
     it = iter(it)
+    seq = 0
     while True:
-        sp = tracer.start_span("train.data_load")
+        sp = tracer.start_span("train.data_load", seq=seq)
+        seq += 1
         try:
             batch = next(it)
         except StopIteration:

@@ -16,6 +16,52 @@ def observe(buckets: tuple[float, ...], counts: list[int],
     counts[-1] += 1
 
 
+class Exposition:
+    """Exposition-format builder with ONE HELP/TYPE declaration path.
+
+    Repeated `# TYPE` lines for the same family are invalid exposition
+    format (real scrapers reject them); every per-sample emitter used to
+    hand-roll its own declaration, which made that violation one labeled
+    loop away. Here the first emission for a family declares it and every
+    later sample just appends — so multi-sample families (per-kind
+    gauges, per-controller quantiles) are correct by construction.
+    """
+
+    def __init__(self):
+        self.lines: list[str] = []
+        self._declared: set[str] = set()
+
+    def declare(self, name: str, type_: str, help_: str = "") -> None:
+        """Emit the HELP/TYPE header for a family exactly once — callable
+        directly for families whose samples are conditional but whose
+        presence in the exposition is pinned (golden stability)."""
+        if name in self._declared:
+            return
+        self._declared.add(name)
+        if help_:
+            self.lines.append(f"# HELP {name} {help_}")
+        self.lines.append(f"# TYPE {name} {type_}")
+
+    def counter(self, name: str, value, help_: str = "") -> None:
+        self.declare(name, "counter", help_)
+        self.lines.append(f"{name} {value}")
+
+    def gauge(self, name: str, value, help_: str = "",
+              labels: str = "") -> None:
+        self.declare(name, "gauge", help_)
+        self.lines.append(f"{name}{labels} {value}")
+
+    def histogram(self, name: str, buckets: tuple[float, ...],
+                  counts: list[int], total_sum: float,
+                  labels: str = "", help_: str = "") -> None:
+        self.declare(name, "histogram", help_)
+        render_histogram(self.lines, name, buckets, counts, total_sum,
+                         labels=labels, emit_type=False)
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + ("\n" if self.lines else "")
+
+
 def render_histogram(lines: list[str], name: str,
                      buckets: tuple[float, ...], counts: list[int],
                      total_sum: float, labels: str = "",

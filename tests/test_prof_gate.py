@@ -1,0 +1,142 @@
+"""CPU-proxy perf gate — the tier-1 teeth (docs/profiling.md).
+
+An untouched tree must pass against tests/golden/prof_budgets.json; an
+injected 2x slowdown in `data_load` or `reconcile` (the test-only
+KFTPU_PROF_CHAOS work-repeat hook) must FAIL the gate. Regenerate budgets
+after an intentional perf change with:
+
+    KFTPU_UPDATE_PROF_BUDGETS=1 pytest tests/test_prof_gate.py -k gate
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.profiling import cpu_proxy
+from kubeflow_tpu.utils.envvars import (
+    ENV_PROF_CHAOS,
+    ENV_UPDATE_PROF_BUDGETS,
+)
+
+pytestmark = pytest.mark.prof
+
+BUDGETS = Path(__file__).resolve().parent / "golden" / "prof_budgets.json"
+
+
+class TestPerfGate:
+    def test_untouched_tree_passes_gate(self, monkeypatch):
+        """The acceptance run: every workload inside its checked-in
+        budget. With KFTPU_UPDATE_PROF_BUDGETS=1 this REGENERATES the
+        budget file from the measured tree instead of gating."""
+        monkeypatch.delenv(ENV_PROF_CHAOS, raising=False)
+        results = cpu_proxy.run_all()
+        if os.environ.get(ENV_UPDATE_PROF_BUDGETS):
+            BUDGETS.write_text(
+                json.dumps(cpu_proxy.make_budgets(results), indent=2,
+                           sort_keys=True) + "\n")
+            return
+        budgets = json.loads(BUDGETS.read_text())
+        violations = cpu_proxy.check_budgets(results, budgets)
+        assert not violations, (
+            "CPU-proxy perf gate failed — a phase regressed past its "
+            "budget. If the slowdown is intentional, regenerate with "
+            f"KFTPU_UPDATE_PROF_BUDGETS=1. Violations: {violations}"
+        )
+
+    def test_injected_data_load_slowdown_fails(self, monkeypatch):
+        """The gate's teeth: a 2x data_load slowdown (work repeated, not
+        slept, so it scales with the machine like a real regression)
+        must fail even though the machine is unchanged."""
+        monkeypatch.setenv(ENV_PROF_CHAOS, "data_load:2")
+        results = cpu_proxy.run_all(only="mlp_train")
+        violations = cpu_proxy.check_budgets(
+            results, json.loads(BUDGETS.read_text()))
+        assert any("mlp_train.data_load" in v for v in violations), \
+            violations
+
+    def test_injected_reconcile_slowdown_fails(self, monkeypatch):
+        monkeypatch.setenv(ENV_PROF_CHAOS, "reconcile:2")
+        results = cpu_proxy.run_all(only="reconcile_storm")
+        violations = cpu_proxy.check_budgets(
+            results, json.loads(BUDGETS.read_text()))
+        assert any("reconcile_storm.reconcile_p50" in v
+                   for v in violations), violations
+
+
+class TestGateLogic:
+    """check_budgets unit behavior on synthetic results — no timing."""
+
+    def _rec(self, **rel):
+        return {"workload": "w", "rel": rel, "phases_s": {}}
+
+    def test_within_budget_passes(self):
+        budgets = {"w": {"rel": {"a": 1.0}, "max_ratio": 1.5}}
+        assert cpu_proxy.check_budgets([self._rec(a=1.4)], budgets) == []
+
+    def test_over_budget_fails_with_diagnostic(self):
+        budgets = {"w": {"rel": {"a": 1.0}, "max_ratio": 1.5}}
+        (v,) = cpu_proxy.check_budgets([self._rec(a=2.0)], budgets)
+        assert "w.a" in v and "allowed" in v
+
+    def test_per_phase_ratio_override(self):
+        budgets = {"w": {"rel": {"a": 1.0}, "max_ratio": 1.5,
+                         "ratios": {"a": 3.0}}}
+        assert cpu_proxy.check_budgets([self._rec(a=2.9)], budgets) == []
+
+    def test_missing_budget_is_a_violation(self):
+        (v,) = cpu_proxy.check_budgets([self._rec(a=1.0)], {})
+        assert "no checked-in budget" in v
+        budgets = {"w": {"rel": {}, "max_ratio": 1.5}}
+        (v,) = cpu_proxy.check_budgets([self._rec(a=1.0)], budgets)
+        assert "no budget for phase" in v
+
+    def test_skipped_workload_not_gated(self):
+        rec = {"workload": "serve_ticks", "skipped": "no jax feature",
+               "rel": {}, "phases_s": {}}
+        assert cpu_proxy.check_budgets([rec], {}) == []
+        budgets = cpu_proxy.make_budgets([rec])
+        assert budgets == {"serve_ticks":
+                           {"skipped_on_regen": "no jax feature"}}
+        # an env upgrade that CAN now run it must not brick the gate:
+        # there is no baseline, so the workload runs ungated until the
+        # budgets are regenerated on the new env
+        ran = {"workload": "serve_ticks", "rel": {"tick": 5.0},
+               "phases_s": {"tick": 0.01}}
+        assert cpu_proxy.check_budgets([ran], budgets) == []
+        # a workload with NO entry at all is still a loud violation
+        (v,) = cpu_proxy.check_budgets(
+            [{"workload": "brand_new", "rel": {"a": 1.0},
+              "phases_s": {}}], budgets)
+        assert "no checked-in budget" in v
+
+    def test_chaos_repeats_parsing(self, monkeypatch):
+        monkeypatch.setenv(ENV_PROF_CHAOS, "data_load:2, reconcile:3.6")
+        assert cpu_proxy.chaos_repeats("data_load") == 2
+        assert cpu_proxy.chaos_repeats("reconcile") == 4
+        assert cpu_proxy.chaos_repeats("other") == 1
+        monkeypatch.setenv(ENV_PROF_CHAOS, "data_load:junk")
+        assert cpu_proxy.chaos_repeats("data_load") == 1
+
+
+class TestBenchEntryPoint:
+    def test_bench_cpu_proxy_emits_breakdown_lines(self):
+        """`bench.py --cpu-proxy` is the operator/driver surface: one
+        JSON line per workload with phases + anchor-relative ratios."""
+        repo = Path(__file__).resolve().parents[1]
+        out = subprocess.run(
+            [sys.executable, str(repo / "bench.py"), "--cpu-proxy",
+             "--only", "mlp_train"],
+            capture_output=True, text=True, timeout=180,
+            cwd=str(repo),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        recs = [json.loads(ln) for ln in out.stdout.splitlines()
+                if ln.startswith("{")]
+        (rec,) = [r for r in recs if r.get("workload") == "mlp_train"]
+        assert rec["rel"]["data_load"] > 0
+        assert set(rec["phases_s"]) == {"data_load", "compute", "stall"}

@@ -1,0 +1,559 @@
+"""Profiling layer tests — trace analytics over the flight recorder.
+
+Covers: the step-breakdown invariant (phases sum to cycle wall, stall is
+the remainder), goodput/restart attribution along cross-process parent
+links, control-plane percentiles, the golden trace-SHAPE pin for the
+canonical gang-restart drill, the FlightRecorder overflow contract
+(exact drop accounting surfaced by /metrics AND the profiler), the
+`profile` CLI error paths (rc=2, one-line diagnostics), and the
+three-surface agreement (`/debug/profile` == `kftpu profile` ==
+`kftpu_prof_*`)."""
+
+import json
+import os
+import textwrap
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu import tracing
+from kubeflow_tpu.cli import main as cli_main
+from kubeflow_tpu.profiling import (
+    aggregate_steps,
+    build_profile,
+    control_plane_stats,
+    goodput,
+    profile_platform,
+    render_text,
+    restart_chains,
+    restart_shape,
+    step_breakdown,
+)
+from kubeflow_tpu.tracing import Tracer, write_spans_jsonl
+
+pytestmark = pytest.mark.prof
+
+
+def mk(name, ts, dur, *, span=None, parent="", pid=1, trace="t1", **attrs):
+    """Synthetic span dict with exact timings — the analytics engine's
+    whole input contract, so tests control every number."""
+    return {
+        "name": name, "trace": trace,
+        "span": span or f"{name}@{ts}",
+        "parent": parent, "ts": ts, "dur": dur,
+        "pid": pid, "tid": 0, "attrs": dict(attrs),
+    }
+
+
+# ----------------------------------------------------------- breakdown core
+
+
+class TestStepBreakdown:
+    def test_phases_sum_to_cycle_wall(self):
+        spans = [
+            mk("train.data_load", 0.0, 0.2, seq=0),
+            mk("train.step", 0.2, 0.5, step=0),
+            mk("train.data_load", 0.7, 0.1, seq=1),
+            mk("checkpoint.save", 0.8, 0.3, step=1),
+            mk("train.step", 1.2, 0.4, step=1),
+        ]
+        steps = step_breakdown(spans)
+        assert [s["step"] for s in steps] == [0, 1]
+        s0, s1 = steps
+        assert s0["wall"] == pytest.approx(0.7)
+        assert s0["data_load"] == pytest.approx(0.2)
+        assert s0["compute"] == pytest.approx(0.5)
+        assert s0["stall"] == pytest.approx(0.0)
+        assert s1["wall"] == pytest.approx(0.9)
+        assert s1["checkpoint"] == pytest.approx(0.3)
+        assert s1["stall"] == pytest.approx(0.1)
+        for s in steps:
+            assert s["data_load"] + s["compute"] + s["checkpoint"] \
+                + s["stall"] == pytest.approx(s["wall"], abs=1e-9)
+
+    def test_workers_partition_by_pid(self):
+        spans = [
+            mk("train.step", 0.0, 0.5, pid=1, step=0),
+            mk("train.step", 0.1, 0.5, pid=2, step=0),
+        ]
+        steps = step_breakdown(spans)
+        assert {s["pid"] for s in steps} == {1, 2}
+        agg = aggregate_steps(steps)
+        assert agg["count"] == 2
+        assert sum(agg["fractions"].values()) == pytest.approx(1.0, abs=0.01)
+
+    def test_no_steps_is_empty_not_crash(self):
+        assert step_breakdown([mk("reconcile", 0, 0.1)]) == []
+        agg = aggregate_steps([])
+        assert agg["count"] == 0 and agg["wall_s"] == 0
+
+
+class TestControlPlane:
+    def test_percentiles_and_watch_delay(self):
+        req = mk("http.request", 0.0, 0.1, span="rq")
+        spans = [req] + [
+            mk("reconcile", 0.2 + 0.1 * i, 0.01 * (i + 1), parent="rq",
+               span=f"r{i}", controller="job", key="default/j",
+               queue_depth=i)
+            for i in range(10)
+        ]
+        cp = control_plane_stats(spans)
+        job = cp["reconcile"]["job"]
+        assert job["count"] == 10
+        assert job["p50_s"] == pytest.approx(0.05)
+        assert job["p99_s"] == pytest.approx(0.10)
+        # pass i starts 0.2+0.1i, the publishing write ended at 0.1 —
+        # delays 0.1..1.0, nearest-rank median
+        assert job["watch_delay_p50_s"] == pytest.approx(0.5)
+        assert job["watch_delay_samples"] == 10
+        assert job["mean_queue_depth"] == pytest.approx(4.5)
+        assert cp["http"]["count"] == 1
+
+    def test_evicted_parent_means_no_delay_sample(self):
+        spans = [mk("reconcile", 1.0, 0.01, parent="gone",
+                    controller="job")]
+        cp = control_plane_stats(spans)
+        assert cp["reconcile"]["job"]["watch_delay_samples"] == 0
+
+
+class TestGoodputAndRestarts:
+    def _drill_spans(self):
+        kill = mk("chaos.pod_kill", 0.0, 0.0, span="k", seed=7,
+                  pod="default/d-worker-0", landed=True)
+        exit_ = mk("pod.exit", 0.5, 0.0, span="x", parent="k",
+                   exit_code=137)
+        restart = mk("job.gang_restart", 0.7, 0.0, span="g", parent="x",
+                     restart=1, key="default/d")
+        create = mk("job.create_pods", 1.0, 0.1, span="c", restart=1)
+        workers = []
+        for pid in (11, 12):
+            workers += [
+                mk("rendezvous", 1.2, 0.2, span=f"rv{pid}", parent="c",
+                   pid=pid),
+                mk("train.data_load", 1.5, 0.1, span=f"dl{pid}",
+                   parent="c", pid=pid),
+                mk("train.step", 1.6, 0.3, span=f"st{pid}", parent="c",
+                   pid=pid, step=0),
+            ]
+        return [kill, exit_, restart, create] + workers
+
+    def test_restart_chain_attribution(self):
+        spans = self._drill_spans()
+        (ch,) = restart_chains(spans)
+        assert ch["chain"] == ["chaos.pod_kill", "pod.exit",
+                               "job.gang_restart", "job.create_pods",
+                               "train.step"]
+        assert ch["root"] == "chaos.pod_kill"
+        # first post-restore step starts at 1.6; kill landed at 0.0
+        assert ch["overhead_s"] == pytest.approx(1.6)
+        assert ch["monotonic"] and ch["steps"] == 2 and ch["rendezvous"] == 2
+
+    def test_goodput_accounting(self):
+        spans = self._drill_spans()
+        g = goodput(spans)
+        inc = {i["restart"]: i for i in g["incarnations"]}
+        assert inc[1]["steps"] == 2
+        assert inc[1]["productive_s"] == pytest.approx(0.6)
+        assert inc[1]["rendezvous_s"] == pytest.approx(0.4)
+        assert g["restart_overhead_s"] == pytest.approx(1.6)
+        # window 0.0 -> 1.9 (last step end)
+        assert g["window_s"] == pytest.approx(1.9)
+        assert g["goodput"] == pytest.approx(0.6 / 1.9, abs=0.01)
+        # total overhead excludes the restart window's own rendezvous
+        # (it is inside the kill->first-step wall) — overhead can never
+        # exceed the elapsed window
+        assert g["overhead_s"] == pytest.approx(1.6)
+        assert g["overhead_s"] <= g["window_s"]
+
+    def test_empty_trace_profiles_without_crash(self):
+        prof = build_profile([])
+        assert prof["goodput"]["restart_overhead_s"] == 0.0
+        # the text renderer must survive an empty platform (a /debug/
+        # profile?format=text hit right after start_tracing)
+        assert "0 steps" in render_text(prof)
+
+    def test_concurrent_restarts_attribute_by_job_key(self):
+        """Two jobs both at restart=1: each chain must resolve to ITS
+        job's create span, not whichever came first."""
+        spans = []
+        for j, (key, pid) in enumerate((("default/a", 21),
+                                        ("default/b", 22))):
+            base = j * 0.01  # job b's spans slightly later
+            spans += [
+                mk("pod.exit", 0.5 + base, 0.0, span=f"x{j}",
+                   exit_code=137, trace=f"t{j}"),
+                mk("job.gang_restart", 0.7 + base, 0.0, span=f"g{j}",
+                   parent=f"x{j}", restart=1, key=key, trace=f"t{j}"),
+                mk("job.create_pods", 1.0 + base, 0.1, span=f"c{j}",
+                   restart=1, key=key, trace=f"t{j}"),
+                mk("train.step", 2.0 + j, 0.3, span=f"s{j}",
+                   parent=f"c{j}", pid=pid, step=0, trace=f"t{j}"),
+            ]
+        chains = restart_chains(spans)
+        assert len(chains) == 2
+        # job a's first step at 2.0, job b's at 3.0 — counter-only
+        # matching would give both chains job a's numbers
+        assert chains[0]["overhead_s"] == pytest.approx(2.0 - 0.5)
+        assert chains[1]["overhead_s"] == pytest.approx(3.0 - 0.51)
+
+    def test_in_process_run_has_one_implicit_incarnation(self):
+        spans = [mk("train.step", 0.0, 0.5, step=0),
+                 mk("checkpoint.save", 0.5, 0.2, step=0)]
+        g = goodput(spans)
+        assert len(g["incarnations"]) == 1
+        assert g["incarnations"][0]["checkpoint_s"] == pytest.approx(0.2)
+
+    def test_restart_shape_text_is_structural(self):
+        text = restart_shape(self._drill_spans())
+        assert text == textwrap.dedent("""\
+            chaos.pod_kill
+              pod.exit exit_code=137
+                job.gang_restart restart=1
+            job.create_pods restart=1
+              rendezvous x2
+              train.data_load x2
+              train.step x2
+            order: monotonic
+        """)
+
+
+# ---------------------------------------------------- recorder overflow
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    from kubeflow_tpu.client import Platform
+
+    p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16)
+    with p:
+        yield p
+
+
+class TestRecorderOverflow:
+    def test_overflow_accounting_reaches_every_surface(self, platform):
+        """Fill the bounded ring past capacity: the drop count must be
+        exact, /metrics must export it, and the profiler must say the
+        breakdown is incomplete instead of silently mis-attributing."""
+        from kubeflow_tpu.observability import render_metrics
+
+        tr = platform.start_tracing(capacity=8)
+        for i in range(20):
+            tr.event(f"e{i}")
+        platform.stop_tracing()
+        rec = tr.recorder
+        assert (rec.started, rec.finished, rec.dropped) == (20, 20, 12)
+        assert len(rec) == 8
+        text = render_metrics(platform)
+        assert "kftpu_trace_spans_dropped_total 12" in text
+        prof = profile_platform(platform)
+        assert prof["dropped_spans"] == 12 and prof["incomplete"]
+        assert "breakdown incomplete (12 spans dropped" \
+            in render_text(prof)
+
+    def test_unfilled_ring_reports_complete(self, platform):
+        tr = platform.start_tracing(capacity=64)
+        tr.event("only")
+        platform.stop_tracing()
+        prof = profile_platform(platform)
+        assert prof["dropped_spans"] == 0 and not prof["incomplete"]
+        assert "incomplete" not in render_text(prof)
+
+
+# ------------------------------------------------------- surface agreement
+
+
+def _synthetic_run():
+    """A deterministic mixed platform+worker span set: two step cycles,
+    a reconcile pass, an http request."""
+    return [
+        mk("http.request", 0.0, 0.05, span="rq", method="POST",
+           path="/api/v1/jobs"),
+        mk("reconcile", 0.1, 0.02, span="rc", parent="rq",
+           controller="job", key="default/j", queue_depth=1),
+        mk("train.data_load", 0.2, 0.1, pid=9, seq=0),
+        mk("train.step", 0.3, 0.4, pid=9, step=0),
+        mk("train.data_load", 0.7, 0.1, pid=9, seq=1),
+        mk("train.step", 0.8, 0.5, pid=9, step=1),
+    ]
+
+
+class TestSurfacesAgree:
+    def test_debug_profile_cli_and_metrics_match(self, platform, tmp_path,
+                                                 capsys):
+        """One fixture run, three surfaces: /debug/profile (JSON + text),
+        `profile --server` / `--trace-dir`, and the kftpu_prof_* metric
+        families must all report the same breakdown numbers."""
+        from kubeflow_tpu.apiserver import PlatformServer
+
+        tr = platform.start_tracing()
+        for s in _synthetic_run():
+            tr.recorder.record(s)
+        # freeze: the surfaces' own http traffic must not grow the trace
+        # between reads, or the comparisons below race their own effect
+        platform.stop_tracing()
+        server = PlatformServer(platform, port=0).start()
+        try:
+            with urllib.request.urlopen(f"{server.url}/debug/profile",
+                                        timeout=10) as r:
+                prof = json.loads(r.read())
+            with urllib.request.urlopen(
+                    f"{server.url}/debug/profile?format=text",
+                    timeout=10) as r:
+                text_report = r.read().decode()
+            with urllib.request.urlopen(f"{server.url}/metrics",
+                                        timeout=10) as r:
+                metrics = r.read().decode()
+            assert cli_main(["profile", "--server", server.url,
+                             "--json"]) == 0
+            cli_prof = json.loads(capsys.readouterr().out)
+        finally:
+            server.stop()
+        # CLI over HTTP == raw endpoint
+        assert cli_prof == prof
+        # trace-dir mode over the identical span dump == live endpoint
+        write_spans_jsonl(str(tmp_path / "spans.jsonl"), _synthetic_run())
+        assert cli_main(["profile", "--trace-dir", str(tmp_path),
+                         "--json"]) == 0
+        dir_prof = json.loads(capsys.readouterr().out)
+        assert dir_prof["steps"] == prof["steps"]
+        assert dir_prof["goodput"] == prof["goodput"]
+        assert dir_prof["control_plane"] == prof["control_plane"]
+        # the numbers themselves
+        st = prof["steps"]
+        # worker pid 9: cycles 0.2->0.7 and 0.7->1.3, fully accounted
+        assert st["count"] == 2
+        assert st["wall_s"] == pytest.approx(1.1)
+        assert st["phases_s"]["data_load"] == pytest.approx(0.2)
+        assert st["phases_s"]["compute"] == pytest.approx(0.9)
+        assert st["phases_s"]["stall"] == pytest.approx(0.0)
+        assert f"step-time breakdown ({st['count']} steps" in text_report
+        # /metrics histograms carry the same totals
+        assert "kftpu_prof_step_time_seconds_count 2" in metrics
+        sum_line = next(
+            ln for ln in metrics.splitlines()
+            if ln.startswith("kftpu_prof_step_time_seconds_sum"))
+        assert float(sum_line.split()[-1]) == pytest.approx(st["wall_s"])
+        dl_sum = next(
+            ln for ln in metrics.splitlines()
+            if ln.startswith("kftpu_prof_data_load_seconds_sum"))
+        assert float(dl_sum.split()[-1]) == pytest.approx(
+            st["phases_s"]["data_load"])
+        good_line = next(
+            ln for ln in metrics.splitlines()
+            if ln.startswith("kftpu_prof_goodput_ratio"))
+        assert float(good_line.split()[-1]) == pytest.approx(
+            prof["goodput"]["goodput"])
+        # per-controller quantile gauge matches the profile's percentile
+        rec_line = next(
+            ln for ln in metrics.splitlines()
+            if ln.startswith("kftpu_prof_reconcile_latency_seconds"
+                             '{controller="job",quantile="0.5"}'))
+        assert float(rec_line.split()[-1]) == pytest.approx(
+            prof["control_plane"]["reconcile"]["job"]["p50_s"])
+
+    def test_debug_profile_404_without_tracing(self, platform):
+        from kubeflow_tpu.apiserver import PlatformServer
+
+        server = PlatformServer(platform, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{server.url}/debug/profile",
+                                       timeout=10)
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+
+# -------------------------------------------------------- CLI error paths
+
+
+class TestProfileCliErrors:
+    """Satellite contract: each bad input yields rc=2 with a ONE-LINE
+    diagnostic on stderr — never a traceback."""
+
+    def _run(self, capsys, *argv):
+        rc = cli_main(["profile", *argv])
+        err = capsys.readouterr().err
+        assert "Traceback" not in err
+        return rc, err.strip()
+
+    def test_empty_trace_dir(self, tmp_path, capsys):
+        rc, err = self._run(capsys, "--trace-dir", str(tmp_path))
+        assert rc == 2
+        assert err.startswith("error:") and "no trace files" in err
+        assert "\n" not in err
+
+    def test_missing_trace_dir(self, tmp_path, capsys):
+        rc, err = self._run(capsys, "--trace-dir",
+                            str(tmp_path / "nope"))
+        assert rc == 2 and "does not exist" in err
+
+    def test_worker_only_trace_dir(self, tmp_path, capsys):
+        write_spans_jsonl(str(tmp_path / "spans.jsonl"), [
+            mk("train.step", 0.0, 0.5, pid=9, step=0),
+            mk("rendezvous", 0.6, 0.1, pid=9),
+        ])
+        rc, err = self._run(capsys, "--trace-dir", str(tmp_path))
+        assert rc == 2
+        assert "only worker spans" in err and "\n" not in err
+
+    def test_corrupt_jsonl_line(self, tmp_path, capsys):
+        good = json.dumps(mk("reconcile", 0.0, 0.1, controller="job"))
+        (tmp_path / "spans.jsonl").write_text(
+            good + "\n{not json]\n")
+        rc, err = self._run(capsys, "--trace-dir", str(tmp_path))
+        assert rc == 2
+        assert "corrupt span line 2" in err and "\n" not in err
+
+    def test_flag_exclusivity_and_dead_server(self, tmp_path, capsys):
+        rc, err = self._run(capsys)
+        assert rc == 2 and "exactly one of" in err
+        rc, err = self._run(capsys, "--trace-dir", str(tmp_path),
+                            "--server", "http://x")
+        assert rc == 2
+        # connection refused surfaces as the one-line diagnostic too
+        rc, err = self._run(capsys, "--server",
+                            "http://127.0.0.1:1")
+        assert rc == 2 and err.startswith("error:")
+
+
+# --------------------------------------------- gang-restart breakdown drill
+
+
+WORKER_BODY = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from kubeflow_tpu import tracing
+
+t = tracing.init_worker_from_env()
+rank = os.environ.get("JAX_PROCESS_ID", "?")
+with t.span("rendezvous", rank=rank,
+            world=os.environ.get("JAX_NUM_PROCESSES", "?")):
+    while not os.path.exists({marker!r}):
+        time.sleep(0.03)
+for i in range(3):
+    with t.span("train.data_load", seq=i):
+        time.sleep(0.01)
+    with t.span("train.step", step=i, rank=rank):
+        time.sleep(0.02)
+with t.span("checkpoint.save", step=3):
+    time.sleep(0.01)
+tracing.flush()
+print("done", rank, flush=True)
+"""
+
+GOLDEN_SHAPE = Path(__file__).resolve().parent / "golden" / \
+    "trace_shape_gang_restart.txt"
+
+
+@pytest.mark.chaos
+class TestGangRestartProfileDrill:
+    def test_breakdown_and_golden_shape(self, platform, tmp_path):
+        """The canonical seeded gang-restart drill, profiled: the
+        step-time breakdown's phases sum to cycle wall-time, restart
+        overhead is attributed to the chaos kill's causal chain, and the
+        span-tree SHAPE (names, parentage, monotonic ordering) matches
+        the checked-in golden — a causal-chain regression diffs
+        structurally instead of by eyeball."""
+        from kubeflow_tpu.api import JobConditionType
+        from kubeflow_tpu.chaos import ChaosEngine, FaultPlan, PodKill
+        from kubeflow_tpu.client import TrainingClient
+        from kubeflow_tpu.tracing import export_merged_trace, \
+            load_chrome_trace
+        from kubeflow_tpu.utils.retry import poll_until
+        from tests.test_tracing import make_job
+
+        repo = str(Path(__file__).resolve().parents[1])
+        marker = tmp_path / "go"
+        tr = platform.start_tracing(trace_dir=str(tmp_path / "traces"))
+        client = TrainingClient(platform)
+        plan = FaultPlan(
+            seed=4242,
+            pod_kills=(PodKill("profdrill-worker-0",
+                               after_running_s=0.3, times=1),),
+        )
+        engine = ChaosEngine(plan).attach(platform)
+        try:
+            client.create_job(make_job(
+                tmp_path, "profdrill",
+                WORKER_BODY.format(repo=repo, marker=str(marker)),
+                replicas=2,
+            ))
+            poll_until(
+                lambda: (
+                    (j := client.get_job("profdrill")) is not None
+                    and j.status.restart_count >= 1
+                ) or None,
+                timeout_s=30.0,
+                describe="gang restart observed",
+            )
+            marker.write_text("go")
+            done = client.wait_for_job_conditions("profdrill", timeout_s=60)
+        finally:
+            engine.detach()
+        assert done.status.has_condition(JobConditionType.SUCCEEDED)
+        poll_until(
+            lambda: len(list((tmp_path / "traces").glob("trace-*.json")))
+            >= 2 or None,
+            timeout_s=15.0, describe="worker trace flushes",
+        )
+        out = tmp_path / "merged.json"
+        export_merged_trace(str(out), tr)
+        spans = load_chrome_trace(str(out))
+
+        # --- breakdown invariant: phases partition every step cycle
+        steps = step_breakdown(spans)
+        assert len(steps) == 6  # 2 survivors x 3 steps
+        for s in steps:
+            assert s["data_load"] + s["compute"] + s["checkpoint"] \
+                + s["stall"] == pytest.approx(s["wall"], abs=1e-6)
+            assert s["data_load"] > 0 and s["compute"] > 0
+
+        # --- restart overhead attributed to the kill's causal chain
+        prof = build_profile(spans)
+        (ch,) = prof["restarts"]
+        assert ch["root"] == "chaos.pod_kill"
+        assert ch["chain"][:4] == ["chaos.pod_kill", "pod.exit",
+                                   "job.gang_restart", "job.create_pods"]
+        assert ch["overhead_s"] > 0.0 and ch["monotonic"]
+        assert prof["goodput"]["restart_overhead_s"] \
+            == pytest.approx(ch["overhead_s"])
+        inc = {i["restart"]: i for i in prof["goodput"]["incarnations"]}
+        assert inc[1]["steps"] == 6 and inc[1]["productive_s"] > 0
+        # the job controller's reconcile passes show up in control-plane
+        assert prof["control_plane"]["reconcile"]["job"]["count"] > 0
+
+        # --- golden trace-shape pin (KFTPU_UPDATE_GOLDEN=1 regenerates)
+        shape = restart_shape(spans)
+        if os.environ.get("KFTPU_UPDATE_GOLDEN"):
+            GOLDEN_SHAPE.write_text(shape)
+        assert shape == GOLDEN_SHAPE.read_text(), (
+            "gang-restart trace SHAPE diverged from the golden — a causal "
+            "link or span name changed; if intentional, regenerate with "
+            "KFTPU_UPDATE_GOLDEN=1"
+        )
+
+
+# --------------------------------------------------------- jsonl round trip
+
+
+class TestSpansJsonl:
+    def test_round_trip(self, tmp_path):
+        spans = _synthetic_run()
+        path = str(tmp_path / "s.jsonl")
+        write_spans_jsonl(path, spans)
+        assert tracing.load_spans_jsonl(path) == spans
+
+    def test_strict_on_corruption(self, tmp_path):
+        path = tmp_path / "s.jsonl"
+        path.write_text('{"name": "a", "ts": 1}\nnot-json\n')
+        with pytest.raises(ValueError, match="corrupt span line 2"):
+            tracing.load_spans_jsonl(str(path))
+
+    def test_missing_fields_rejected(self, tmp_path):
+        path = tmp_path / "s.jsonl"
+        path.write_text('{"nope": 1}\n')
+        with pytest.raises(ValueError, match="not a span dict"):
+            tracing.load_spans_jsonl(str(path))
