@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -40,6 +40,13 @@ test-health:
 # (docs/profiling.md; KFTPU_UPDATE_PROF_BUDGETS=1 regenerates budgets)
 test-prof:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_profiling.py tests/test_prof_gate.py -q -m prof
+
+# control-plane scale-out suite: sharded/filtered watch drills, keyed-pool
+# per-key ordering, status-write group commit, and the 10k-pod storm gate
+# (docs/architecture.md "Control-plane scaling")
+test-cplane:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_cplane.py -q -m cplane
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 native:
 	$(MAKE) -C $(NATIVE)

@@ -983,9 +983,11 @@ def run_cpu_proxy() -> int:
     """`bench.py --cpu-proxy`: the tier-1 perf surface (docs/profiling.md).
 
     Runs the fixed-seed CPU workloads (profiling/cpu_proxy.py: traced MLP
-    train steps, continuous-serve ticks, a 200-pod reconcile storm on
-    FakeCluster) and emits ONE JSON line per workload with its phase
-    breakdown and anchor-relative ratios — the numbers the perf-gate test
+    train steps, continuous-serve ticks, a 200-pod traced reconcile storm,
+    and the 10k-pod cplane_storm — jobs/sec-to-Running + reconcile passes
+    per gang restart through the sharded watch/pool/coalesced-write path)
+    and emits ONE JSON line per workload with its phase breakdown and
+    anchor-relative ratios — the numbers the perf-gate test
     (tests/test_prof_gate.py) compares against tests/golden/
     prof_budgets.json. None of the tunnel resilience machinery applies:
     this path must be deterministic and CPU-only by construction, so a

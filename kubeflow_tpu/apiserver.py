@@ -639,6 +639,34 @@ class PlatformServer:
 
     # -------------------------------------------------------------- watch
 
+    @staticmethod
+    def _parse_watch_selector(raw: str):
+        """labelSelector for watch streams: k=v | k==v (equality) | bare
+        k (key-presence), comma-ANDed — the subset the hub can push down
+        server-side. Returns (selector_or_None, error_or_None); k!=v (the
+        list endpoint's negation form) is rejected up front because a
+        stream cannot signal 400 after its headers go out."""
+        if not raw:
+            return None, None
+        selector: dict[str, str | None] = {}
+        for term in raw.split(","):
+            term = term.strip()
+            if not term:
+                return None, "labelSelector has an empty term"
+            if "!=" in term:
+                return None, ("labelSelector negation (k!=v) is not "
+                              "supported on watch streams")
+            if "==" in term:
+                k, _, v = term.partition("==")
+            elif "=" in term:
+                k, _, v = term.partition("=")
+            else:
+                k, v = term, None  # presence
+            if not k:
+                return None, "labelSelector term has an empty key"
+            selector[k] = v
+        return selector, None
+
     def stream_watch(self, wfile, kind: str, query: dict,
                      user: str = "", request_id: str = "") -> None:
         """Write an NDJSON watch stream for one kind until timeout/disconnect.
@@ -659,6 +687,12 @@ class PlatformServer:
         cluster = self.platform.cluster
         ns_filter = query.get("namespace", "")
         name_filter = query.get("name", "")
+        # validated by _parse_watch_selector in the dispatch (a stream
+        # cannot 400 after its headers went out); pushed down to the
+        # store's watch hub together with the kind, so this stream's
+        # buffer only ever holds events it would emit
+        selector, _err = self._parse_watch_selector(
+            query.get("labelSelector", ""))
         try:
             timeout_s = min(float(query.get("timeoutSeconds", "60")), 600.0)
         except ValueError:
@@ -682,7 +716,11 @@ class PlatformServer:
                 return False
             return True
 
-        q = cluster.watch(replay=True)
+        # server-side filtering end-to-end: the hub never buffers other
+        # kinds (or non-matching labels) for this stream, so one slow REST
+        # watcher of a quiet kind no longer pays for a pod storm
+        q = cluster.watch(replay=True, kinds=(kind,),
+                          label_selector=selector)
         last_write = time.monotonic()
         try:
             while time.monotonic() < deadline:
@@ -760,6 +798,13 @@ class PlatformServer:
                     kind = parts[2]
                     if kind not in server.platform.cluster.KINDS:
                         self._reply(404, {"error": f"unknown kind {kind!r}"})
+                        return
+                    # selector validation must precede the 200: a stream
+                    # cannot change its status code once headers are out
+                    _sel, sel_err = server._parse_watch_selector(
+                        query.get("labelSelector", ""))
+                    if sel_err is not None:
+                        self._reply(400, {"error": sel_err})
                         return
                     self.send_response(200)
                     self.send_header("Content-Type", "application/x-ndjson")

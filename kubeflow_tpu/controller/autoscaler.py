@@ -42,6 +42,8 @@ POLICY_CAPACITY = "capacity"
 class TrainingAutoscaler(ControllerBase):
     """Scales elastic, annotation-opted-in jobs on chip capacity."""
 
+    WATCH_KINDS = ("jobs", "podgroups")
+
     def __init__(
         self,
         cluster: FakeCluster,

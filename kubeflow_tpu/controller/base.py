@@ -12,12 +12,22 @@ implements (SURVEY.md §2.1 'Common JobController'). Subclasses provide:
 ConflictError from optimistic-concurrency writes is treated as benign
 (requeue, no error event) — the conflicting write's own watch event
 re-triggers the key anyway.
+
+Worker model (docs/architecture.md "Control-plane scaling"): with
+``workers=N`` the controller runs a KEYED pool — N native work queues,
+each drained by its own single-worker ReconcileDriver, with
+``crc32(key) % N`` routing every add. Distinct objects reconcile
+concurrently while any one object's passes stay strictly serialized on
+one worker (each queue also keeps the native dedupe/dirty-replay
+discipline per key). ``workers=1`` degenerates to exactly the old single
+queue + single driver.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import zlib
 from typing import Iterable
 
 from kubeflow_tpu.analysis.lockcheck import make_lock
@@ -26,13 +36,99 @@ from kubeflow_tpu.controller.fakecluster import (
     FakeCluster,
     WatchPoller,
 )
-from kubeflow_tpu.native import ReconcileDriver, WorkQueue
+from kubeflow_tpu.native import RECONCILE_CB, ReconcileDriver, WorkQueue
 from kubeflow_tpu.tracing import consume_delivered_context
+
+
+class KeyedWorkQueuePool:
+    """N rate-limited work queues with stable key->queue routing, each
+    drained by one native worker: the per-key ordering contract of a
+    single-worker controller, at N-way concurrency across keys.
+
+    crc32 (not builtin hash) so the shard a key lands on is stable across
+    processes and runs — requeue storms replay identically under seeded
+    chaos. API mirrors the single WorkQueue it replaces (add/add_after/
+    forget/num_requeues/len/shutdown), so callers don't care which they
+    hold."""
+
+    def __init__(self, n_queues: int, base_delay_s: float, max_delay_s: float):
+        self.queues = [
+            WorkQueue(base_delay_s=base_delay_s, max_delay_s=max_delay_s)
+            for _ in range(max(1, n_queues))
+        ]
+        self._drivers: list[ReconcileDriver] = []
+
+    def _route(self, key: str) -> WorkQueue:
+        if len(self.queues) == 1:
+            return self.queues[0]
+        return self.queues[zlib.crc32(key.encode()) % len(self.queues)]
+
+    # -- WorkQueue-shaped API (key-routed)
+
+    def add(self, key: str) -> None:
+        self._route(key).add(key)
+
+    def add_after(self, key: str, delay_s: float) -> None:
+        self._route(key).add_after(key, delay_s)
+
+    def add_rate_limited(self, key: str) -> float:
+        return self._route(key).add_rate_limited(key)
+
+    def forget(self, key: str) -> None:
+        self._route(key).forget(key)
+
+    def num_requeues(self, key: str) -> int:
+        return self._route(key).num_requeues(key)
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self.queues)
+
+    def depths(self) -> list[int]:
+        """Pending keys per worker queue (kftpu_cplane_worker_queue_depth):
+        a skewed profile means hot keys are hashing onto one worker."""
+        return [len(q) for q in self.queues]
+
+    def shutdown(self) -> None:
+        for q in self.queues:
+            q.shutdown()
+
+    @property
+    def shutting_down(self) -> bool:
+        return all(q.shutting_down for q in self.queues)
+
+    # -- driver lifecycle
+
+    def start_drivers(self, callback) -> None:
+        """One single-worker native driver per queue; ONE shared ctypes
+        trampoline (the callback object must outlive every driver — each
+        ReconcileDriver's finalizer keeps a reference)."""
+        cb = callback if isinstance(callback, RECONCILE_CB) \
+            else RECONCILE_CB(callback)
+        self._drivers = [ReconcileDriver(q, 1, cb) for q in self.queues]
+
+    def close_drivers(self) -> None:
+        for d in self._drivers:
+            d.close()
+        self._drivers = []
 
 
 class ControllerBase:
     #: object kind whose events carry reconcile errors (for record_event)
     ERROR_EVENT_KIND = "jobs"
+
+    #: kinds this controller's informer subscribes to — a SERVER-SIDE
+    #: filter (the native hub never buffers other kinds for it), so a storm
+    #: on unrelated kinds costs it nothing. None = the legacy full stream.
+    #: kind_filter() remains the authoritative event->key mapper either way.
+    WATCH_KINDS: tuple[str, ...] | None = None
+
+    #: optional per-kind label selectors ({kind: {label: value-or-None}}),
+    #: pushed into the hub alongside the kind filter: a controller that
+    #: only acts on pods carrying its ownership label (JOB_NAME_LABEL
+    #: class) stops paying for every other pod's status churn — at 10k
+    #: pods that client-side discard was the fan-out ceiling. Takes
+    #: precedence over WATCH_KINDS when set (its keys ARE the kinds).
+    WATCH_SELECTORS: dict[str, dict | None] | None = None
 
     def __init__(
         self,
@@ -45,7 +141,8 @@ class ControllerBase:
     ):
         self.cluster = cluster
         self.name = name
-        self.wq = WorkQueue(base_delay_s=wq_base_delay_s, max_delay_s=wq_max_delay_s)
+        self.wq = KeyedWorkQueuePool(
+            workers, base_delay_s=wq_base_delay_s, max_delay_s=wq_max_delay_s)
         self.resync_period_s = resync_period_s
         self._stop = threading.Event()
         self._n_workers = workers
@@ -95,11 +192,12 @@ class ControllerBase:
         threading.Thread(
             target=self._watch_loop, name=f"{self.name}-informer", daemon=True
         ).start()
-        # workers are NATIVE: reconciler.cc owns the thread pool and the
+        # workers are NATIVE: reconciler.cc owns the threads and the
         # forget/requeue/rate-limit/done discipline (SURVEY.md §2.8 item 2 —
         # the reference's worker goroutines are native too); only
-        # self.reconcile(key) runs in Python, via the callback below
-        self._driver = ReconcileDriver(self.wq, self._n_workers, self._reconcile_cb)
+        # self.reconcile(key) runs in Python, via the callback below. One
+        # driver per pool queue = the keyed-ordering contract.
+        self.wq.start_drivers(self._reconcile_cb)
         threading.Thread(
             target=self._resync_loop, name=f"{self.name}-resync", daemon=True
         ).start()
@@ -107,11 +205,9 @@ class ControllerBase:
     def stop(self) -> None:
         self._stop.set()
         self.wq.shutdown()
-        if getattr(self, "_driver", None) is not None:
-            # close (join + free), not just stop: the driver's callback keeps
-            # this controller strongly reachable until freed
-            self._driver.close()
-            self._driver = None
+        # close (join + free), not just stop: each driver's callback keeps
+        # this controller strongly reachable until freed
+        self.wq.close_drivers()
 
     # ----------------------------------------------------------- internals
 
@@ -132,7 +228,9 @@ class ControllerBase:
             self.metrics["informer_errors_total"] += 1
 
         poller = WatchPoller(self.cluster, timeout=0.2,
-                             count_error=count_error)
+                             count_error=count_error,
+                             kinds=self.WATCH_KINDS,
+                             selectors=self.WATCH_SELECTORS)
         while not self._stop.is_set():
             ev = poller.get()
             if ev is None:

@@ -77,6 +77,11 @@ class ServerCRController(ControllerBase):
             cluster, name=self.CR_KIND, workers=workers,
             resync_period_s=resync_period_s,
         )
+        # instance-level: CR_KIND/POD_LABEL are subclass config, not known
+        # at class definition time on this shared base (the selector keys
+        # are also the kind filter)
+        self.WATCH_SELECTORS = {self.CR_KIND: None,
+                                "pods": {self.POD_LABEL: None}}
 
     def command_for(self, cr, port: int) -> tuple[list[str], dict[str, str], str]:
         """(command, env, working_dir) for the server pod."""

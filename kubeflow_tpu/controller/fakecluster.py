@@ -7,6 +7,18 @@ watch streams. Pods ARE eventually executed — by the PodRuntime (podruntime
 
 Objects are plain dataclasses; keys are "ns/name". Watch events are
 (event_type, kind, obj) tuples delivered to subscriber queues.
+
+Concurrency model (docs/architecture.md "Control-plane scaling"): the store
+is sharded per kind — every CRUD op takes only its kind's lock, so a pod
+status storm never serializes against job or podgroup traffic. The
+snapshot window, resource-version counter, and event log each have their
+own small lock, always acquired INSIDE a shard lock (shard → snap/rv/ev is
+the one sanctioned order; shard locks nest only in KINDS order, and only
+on the multi-kind relist path). Reads hand out the stored reference under
+the lock and deep-copy OUTSIDE it: stored objects are replaced, never
+mutated in place (the RCU discipline KFTPU-CONFLICT enforces), so the
+reference is a stable snapshot and the expensive copy no longer serializes
+every other store op behind it.
 """
 
 from __future__ import annotations
@@ -22,7 +34,7 @@ from typing import Any, Callable
 
 from kubeflow_tpu.api.common import ObjectMeta, utcnow as _ts
 from kubeflow_tpu.tracing import current_context, set_delivered_context
-from kubeflow_tpu.analysis.lockcheck import make_rlock
+from kubeflow_tpu.analysis.lockcheck import make_lock, make_rlock
 from kubeflow_tpu.utils.retry import (
     POLL_POLICY,
     BackoffPolicy,
@@ -52,6 +64,22 @@ class WatchClosed(Exception):
 _ETYPE_CODE = {EventType.ADDED: 0, EventType.MODIFIED: 1, EventType.DELETED: 2}
 
 
+def matches_labels(obj: Any, selector: dict[str, str | None] | None) -> bool:
+    """Label selector (k8s `labelSelector=` analogue): each term is an
+    equality match, or — when the value is None — a key-presence match."""
+    if not selector:
+        return True
+    meta = getattr(obj, "metadata", None)
+    labels = getattr(meta, "labels", None) or {}
+    for k, v in selector.items():
+        if v is None:
+            if k not in labels:
+                return False
+        elif labels.get(k) != v:
+            return False
+    return True
+
+
 class WatchSubscription:
     """queue.Queue-shaped view over one native event-hub subscription.
 
@@ -59,20 +87,63 @@ class WatchSubscription:
     snapshots the cluster retained; an overflowed (or snapshot-expired)
     subscriber transparently receives a fresh relist — current objects as
     ADDED — exactly how an informer recovers from 'resourceVersion expired'.
-    """
 
-    def __init__(self, cluster: "FakeCluster", sub_id: int):
+    Server-side filtering: ``filters`` ({kind: label-selector-or-None})
+    is pushed into the native hub — events outside it are never BUFFERED
+    for this stream, so an irrelevant storm can neither overflow it nor
+    cost it per-event work; relists only list (and selector-match) the
+    covered kinds. Label selectors here are identity markers stamped at
+    creation (JOB_NAME_LABEL-class), so an object's match-state never
+    changes over its life. A flat ``label_selector`` without kinds is
+    applied at resolution time only (nothing to push down)."""
+
+    def __init__(self, cluster: "FakeCluster", sub_id: int,
+                 filters: dict[str, dict | None] | None = None,
+                 label_selector: dict[str, str | None] | None = None):
         self._cluster = cluster
         self._sub_id = sub_id
+        self.filters = dict(filters) if filters else None
+        self.label_selector = dict(label_selector) if label_selector else None
         self._pending: deque = deque()
         self._closed = False
 
-    def _relist_locked(self) -> None:
-        """Queue a full relist; caller holds cluster._mu."""
+    def _matches(self, kind: str, obj: Any) -> bool:
+        if self.filters is not None:
+            if kind not in self.filters:
+                return False
+            return matches_labels(obj, self.filters[kind])
+        return matches_labels(obj, self.label_selector)
+
+    def _covered_kinds(self) -> tuple[str, ...]:
+        """Covered kinds in canonical KINDS order (= shard lock order)."""
+        if self.filters is None:
+            return self._cluster.KINDS
+        return tuple(k for k in self._cluster.KINDS if k in self.filters)
+
+    def _relist(self, locks_held: bool = False) -> None:
+        """Queue a fresh relist of the covered kinds.
+
+        Recovery relists (overflow / snapshot-window expiry) take one
+        shard lock at a time: the hub keeps buffering live events during
+        the walk, so nothing can be missed — an object written between
+        two kind listings shows up in its listing, its event, or both
+        (at-least-once, the informer relist contract; a brief
+        newer-then-older tail replay is possible, as it always was on
+        this path — consumers are level-triggered). The INITIAL replay
+        calls this with ``locks_held=True`` from watch(), which holds
+        every covered shard lock across subscribe+list, so a fresh
+        stream starts with the strong no-inversion guarantee."""
         self._pending.clear()
-        for kind in self._cluster.KINDS:
-            for obj in self._cluster._objects[kind].values():
-                self._pending.append((EventType.ADDED, kind, obj))
+        cluster = self._cluster
+        for kind in self._covered_kinds():
+            if locks_held:
+                objs = list(cluster._objects[kind].values())
+            else:
+                with cluster._locked(kind):
+                    objs = list(cluster._objects[kind].values())
+            for obj in objs:
+                if self._matches(kind, obj):
+                    self._pending.append((EventType.ADDED, kind, obj))
 
     def get(self, timeout: float | None = None):
         """Next (etype, kind, obj); raises queue.Empty on timeout.
@@ -81,50 +152,66 @@ class WatchSubscription:
         originating write's SpanContext to this thread (tracing
         set_delivered_context) so consumer loops can link their work to the
         event that caused it; relisted events carry none."""
-        if self._pending:
-            if self._cluster.tracer is not None:
-                set_delivered_context(None)  # relists have no causal write
-            return self._pending.popleft()
-        if self._closed:
-            raise WatchClosed(f"subscription {self._sub_id} closed")
-        chaos = self._cluster.chaos
-        if chaos is not None:
-            action = chaos.on_watch_get(self._sub_id)
-            if action == "drop":
-                # injected 'watch too old': this stream loses its place and
-                # must recover exactly like a real overflow — full relist.
-                # Recurse with the CALLER'S timeout: when the store is empty
-                # the relist queues nothing and the caller still deserves a
-                # blocking wait, not an instant queue.Empty
-                with self._cluster._mu:
-                    self._relist_locked()
-                return self.get(timeout=timeout)
-            if action:
-                # the sleep IS the injected fault (seeded informer lag) —
-                # jitter/backoff would distort the planned schedule
-                time.sleep(action)  # kftpu: allow=KFTPU-SLEEP
-        hub = self._cluster._hub
-        rc, seq, etype_code, _kind, _key = hub.poll(
-            self._sub_id, 0.0 if timeout is None else timeout
-        )
-        if rc == hub.EVENT:
-            with self._cluster._mu:
-                snap = self._cluster._snapshots.get(seq)
-                ctx = self._cluster._event_ctx.get(seq)
+        # None keeps the original non-blocking contract (hub poll 0.0);
+        # otherwise a deadline so filtered/expired records consume the
+        # remaining budget instead of restarting or abandoning it
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        budget = timeout
+        first = True
+        while True:
+            if self._pending:
+                if self._cluster.tracer is not None:
+                    set_delivered_context(None)  # relists: no causal write
+                return self._pending.popleft()
+            if self._closed:
+                raise WatchClosed(f"subscription {self._sub_id} closed")
+            chaos = self._cluster.chaos
+            if chaos is not None and first:
+                action = chaos.on_watch_get(self._sub_id)
+                if action == "drop":
+                    # injected 'watch too old': this stream loses its place
+                    # and must recover exactly like a real overflow — full
+                    # relist, then keep waiting with the CALLER'S timeout
+                    # (an empty store must still block, not instantly
+                    # raise queue.Empty)
+                    self._relist()
+                    first = False
+                    continue
+                if action:
+                    # the sleep IS the injected fault (seeded informer
+                    # lag) — jitter/backoff would distort the schedule
+                    time.sleep(action)  # kftpu: allow=KFTPU-SLEEP
+            first = False
+            hub = self._cluster._hub
+            rc, seq, etype_code, _kind, _key = hub.poll(
+                self._sub_id, 0.0 if budget is None else budget
+            )
+            if rc == hub.EVENT:
+                with self._cluster._snap_mu:
+                    snap = self._cluster._snapshots.get(seq)
+                    ctx = self._cluster._event_ctx.get(seq)
                 if snap is None:  # window expired under extreme lag
-                    self._relist_locked()
-            if snap is not None:
+                    self._relist()
+                    budget = 0.0
+                    continue
+                if not self._matches(snap[1], snap[2]):
+                    # filtered out at resolution: spend what remains of
+                    # the caller's budget on the next record
+                    if deadline is not None:
+                        budget = max(deadline - time.monotonic(), 0.0)
+                    continue
                 if self._cluster.tracer is not None:
                     set_delivered_context(ctx)
                 return snap
-            return self.get(timeout=0.0)
-        if rc == hub.OVERFLOWED:
-            with self._cluster._mu:
-                self._relist_locked()
-            return self.get(timeout=0.0)
-        if rc == hub.GONE:
-            raise WatchClosed(f"subscription {self._sub_id} gone at hub")
-        raise queue.Empty  # EMPTY: idle timeout, the stream is still live
+            if rc == hub.OVERFLOWED:
+                self._relist()
+                budget = 0.0
+                continue
+            if rc == hub.GONE:
+                raise WatchClosed(
+                    f"subscription {self._sub_id} gone at hub")
+            raise queue.Empty  # EMPTY: idle timeout, stream still live
 
     def close(self) -> None:
         if not self._closed:
@@ -152,12 +239,20 @@ class WatchPoller:
     """
 
     def __init__(self, cluster: "FakeCluster", timeout: float,
-                 count_error: Callable[[], None]):
+                 count_error: Callable[[], None],
+                 kinds: tuple[str, ...] | None = None,
+                 label_selector: dict[str, str | None] | None = None,
+                 selectors: dict[str, dict | None] | None = None):
         self._cluster = cluster
         self._timeout = timeout
         self._count_error = count_error
+        self._kinds = tuple(kinds) if kinds else None
+        self._label_selector = label_selector
+        self._selectors = selectors
         self._attempt = 0
-        self.q = cluster.watch()
+        self.q = cluster.watch(kinds=self._kinds,
+                               label_selector=self._label_selector,
+                               selectors=self._selectors)
 
     def get(self):
         try:
@@ -171,7 +266,9 @@ class WatchPoller:
             self._count_error()
             backoff_sleep(POLL_POLICY, self._attempt)
             self._attempt += 1
-            self.q = self._cluster.watch()
+            self.q = self._cluster.watch(
+                kinds=self._kinds, label_selector=self._label_selector,
+                selectors=self._selectors)
             return None
         except Exception:  # noqa: BLE001 — the informer must not die
             self._count_error()
@@ -253,8 +350,25 @@ class ClusterEvent:
     timestamp: float = field(default_factory=time.time)
 
 
+class _ShardGuard:
+    """Context manager over an ALREADY-ACQUIRED shard lock (the acquire —
+    with contention accounting — happens in FakeCluster._locked)."""
+
+    __slots__ = ("_mu",)
+
+    def __init__(self, mu):
+        self._mu = mu
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._mu.release()
+        return False
+
+
 class FakeCluster:
-    """Thread-safe object store + watch hub."""
+    """Thread-safe object store + watch hub, sharded per kind."""
 
     KINDS = (
         "jobs", "pods", "podgroups", "experiments", "trials",
@@ -269,7 +383,17 @@ class FakeCluster:
     def __init__(self) -> None:
         from kubeflow_tpu.native import EventHub
 
-        self._mu = make_rlock("fakecluster.FakeCluster._mu")
+        # one lock per kind: a pod status storm contends only with pod
+        # traffic. Distinct lockcheck names per kind so the relist path's
+        # fixed KINDS-order nesting is visible (and checkable) in the
+        # acquisition graph instead of collapsing into a self-edge.
+        self._shard_mu = {
+            k: make_rlock(f"fakecluster.FakeCluster._shard_mu[{k}]")
+            for k in self.KINDS
+        }
+        #: contended acquisitions per kind (bumped under the just-acquired
+        #: shard lock) — exported as kftpu_cplane_shard_lock_waits_total
+        self._lock_waits: dict[str, int] = {k: 0 for k in self.KINDS}
         self._objects: dict[str, dict[str, Any]] = {k: {} for k in self.KINDS}
         # native informer fan-out (SURVEY.md §2.8 "Go controller machinery"):
         # sequencing + bounded per-subscriber buffers live in C++
@@ -277,12 +401,19 @@ class FakeCluster:
         # in a window matching the hub capacity so memory is bounded even
         # under a stuck REST watch client
         self._hub = EventHub(self.WATCH_CAPACITY)
+        # snapshot window + publish ordering: publish and snapshot-record
+        # happen together under _snap_mu, so a subscriber can never poll a
+        # seq whose snapshot hasn't landed yet (cross-shard writers would
+        # otherwise interleave publish and record)
+        self._snap_mu = make_lock("fakecluster.FakeCluster._snap_mu")
         self._snapshots: dict[int, tuple[EventType, str, Any]] = {}
         #: seq -> SpanContext of the write that published the event (only
         #: populated while a tracer is attached; evicted with _snapshots)
         self._event_ctx: dict[int, Any] = {}
         self._snapshot_min = 0
+        self._rv_mu = make_lock("fakecluster.FakeCluster._rv_mu")
         self._rv = 0
+        self._ev_mu = make_lock("fakecluster.FakeCluster._ev_mu")
         self.events: list[ClusterEvent] = []
         self.capacity_chips = 8  # schedulable "chips" for the gang scheduler
         #: fault-injection attachment point (chaos.ChaosEngine.attach);
@@ -292,20 +423,37 @@ class FakeCluster:
         #: every hook call is gated on it, same discipline as chaos
         self.tracer = None
 
+    def _locked(self, kind: str):
+        """The kind's shard lock, with contention accounting: a failed
+        try-acquire is a wait another thread imposed — the control-plane
+        serialization signal kftpu_cplane_shard_lock_waits_total exports."""
+        mu = self._shard_mu[kind]
+        if not mu.acquire(blocking=False):
+            mu.acquire()
+            self._lock_waits[kind] += 1  # under the lock: no lost updates
+        return _ShardGuard(mu)
+
+    def _next_rv(self) -> int:
+        with self._rv_mu:
+            self._rv += 1
+            return self._rv
+
+    def lock_wait_counts(self) -> dict[str, int]:
+        """Per-kind contended-acquire counts (coarse snapshot)."""
+        return dict(self._lock_waits)
+
     # ------------------------------------------------------------------ CRUD
 
     def create(self, kind: str, obj: Any) -> Any:
-        with self._mu:
+        with self._locked(kind):
             key = self._key(obj)
             if key in self._objects[kind]:
                 raise KeyError(f"{kind} {key} already exists")
             if not obj.metadata.uid:
-                self._rv += 1
-                obj.metadata.uid = f"uid-{self._rv}"
+                obj.metadata.uid = f"uid-{self._next_rv()}"
             if not obj.metadata.creation_timestamp:
                 obj.metadata.creation_timestamp = _ts()
-            self._rv += 1
-            obj.metadata.resource_version = self._rv
+            obj.metadata.resource_version = self._next_rv()
             self._objects[kind][key] = obj
             self._notify(EventType.ADDED, kind, obj)
             return obj
@@ -316,10 +464,10 @@ class FakeCluster:
         place; snapshot writers get ConflictError and must re-read)."""
         chaos = self.chaos
         if chaos is not None:
-            # outside _mu: an injected ConflictError must not be
+            # outside the shard lock: an injected ConflictError must not be
             # distinguishable from a real one by lock-hold side effects
             chaos.on_update(kind, self._key(obj))
-        with self._mu:
+        with self._locked(kind):
             key = self._key(obj)
             stored = self._objects[kind].get(key)
             if stored is None:
@@ -330,18 +478,77 @@ class FakeCluster:
                     f"{obj.metadata.resource_version} != "
                     f"{stored.metadata.resource_version}"
                 )
-            self._rv += 1
-            obj.metadata.resource_version = self._rv
+            obj.metadata.resource_version = self._next_rv()
             self._objects[kind][key] = obj
             self._notify(EventType.MODIFIED, kind, obj)
             return obj
 
     def delete(self, kind: str, key: str) -> Any | None:
-        with self._mu:
+        with self._locked(kind):
             obj = self._objects[kind].pop(key, None)
             if obj is not None:
                 self._notify(EventType.DELETED, kind, obj)
             return obj
+
+    def batch_update(
+        self, kind: str,
+        ops: list[tuple[str, Callable[[Any], Any], Any]],
+        copier: Callable[[Any], Any] | None = None,
+    ) -> list[Any | None]:
+        """Apply N read-copy-update mutations under ONE shard lock hold.
+
+        Each op is ``(key, mutate, event_ctx)``: the stored object is
+        copied (``copier``, default deepcopy), mutated, versioned, and
+        swapped in — semantically N back-to-back read_modify_write calls,
+        but with zero conflict retries (the write lock is held across the
+        batch) and one lock acquisition total. The coalescing tier above
+        this (StatusWriteBuffer) is how per-pod status storms stop
+        serializing the store. ``event_ctx`` is the ORIGINATING WRITER'S
+        SpanContext, published with the MODIFIED event in place of the
+        flusher thread's (none): causal parent links through coalesced
+        writes stay exactly what the per-op path would have produced.
+        Returns one entry per op: the updated object, or None when the key
+        is missing or ``mutate`` returned False (declined on fresh state —
+        the incarnation-guard convention `_update_pod_status` already
+        uses). A mutator that RAISES fails only its own op — the entry is
+        the exception instance, the batch's other ops commit normally
+        (read_modify_write parity: each caller sees only its own
+        failure).
+
+        ``copier`` exists because status writers touch only
+        ``obj.status`` + ``metadata.annotations``: a targeted copy that
+        shares the untouched payload (command/env/labels) is several times
+        cheaper than deepcopy and just as safe under the store's
+        replace-never-mutate discipline. Chaos conflict injection is the
+        CALLER'S job (the buffer routes injected conflicts through the
+        single-op retry path so drills still exercise it).
+        """
+        copier = copy.deepcopy if copier is None else copier
+        results: list[Any | None] = []
+        with self._locked(kind):
+            store = self._objects[kind]
+            for key, mutate, ctx in ops:
+                stored = store.get(key)
+                if stored is None:
+                    results.append(None)
+                    continue
+                obj = copier(stored)
+                try:
+                    declined = mutate(obj) is False
+                except Exception as exc:  # noqa: BLE001 — isolate the op
+                    # one bad mutator must not abort (or mis-ack) ops that
+                    # already committed in this batch; the store is
+                    # untouched for THIS op (the copy is discarded)
+                    results.append(exc)
+                    continue
+                if declined:
+                    results.append(None)
+                    continue
+                obj.metadata.resource_version = self._next_rv()
+                store[key] = obj
+                self._notify(EventType.MODIFIED, kind, obj, ctx=ctx)
+                results.append(obj)
+        return results
 
     def read_modify_write(
         self, kind: str, key: str, mutate, retries: int = 10,
@@ -377,68 +584,117 @@ class FakeCluster:
         any caller that mutates and writes back (read-copy-update), so
         concurrent writers are detected via resource_version instead of
         silently interleaving on a shared live object."""
-        with self._mu:
+        with self._locked(kind):
             obj = self._objects[kind].get(key)
-            return copy.deepcopy(obj) if copy_obj and obj is not None else obj
+        # the copy runs OUTSIDE the lock: stored objects are replaced, not
+        # mutated (RCU discipline), so the reference is a stable snapshot
+        # and a 30us deepcopy no longer serializes the whole shard
+        return copy.deepcopy(obj) if copy_obj and obj is not None else obj
 
     def list(
         self, kind: str, selector: Callable[[Any], bool] | None = None
     ) -> list[Any]:
-        with self._mu:
+        with self._locked(kind):
             objs = list(self._objects[kind].values())
         return [o for o in objs if selector is None or selector(o)]
 
     # ----------------------------------------------------------------- watch
 
-    def watch(self, replay: bool = True) -> "WatchSubscription":
-        """Subscribe to all events; optionally replay current objects as
-        ADDED (informer initial list+watch semantics). The returned
-        subscription is queue.Queue-shaped (.get(timeout=) raising
-        queue.Empty when idle, WatchClosed once the stream is dead —
-        closed locally or GONE at the hub); a subscriber that falls
-        WATCH_CAPACITY events behind is transparently relisted (k8s
-        "watch too old" semantics). WatchPoller packages the standard
-        reaction (resubscribe + relist) for informer loops."""
-        with self._mu:
-            # subscribe-then-snapshot under the lock: no event can be missed
-            # between the initial list and the live tail
-            sub_id = self._hub.subscribe()
-            sub = WatchSubscription(self, sub_id)
-            if replay:
-                sub._relist_locked()
+    def watch(self, replay: bool = True,
+              kinds: tuple[str, ...] | None = None,
+              label_selector: dict[str, str | None] | None = None,
+              selectors: dict[str, dict | None] | None = None,
+              ) -> "WatchSubscription":
+        """Subscribe to events; optionally replay current objects as
+        ADDED (informer initial list+watch semantics).
+
+        ``kinds`` and label selectors filter SERVER-SIDE: the native hub
+        never buffers filtered-out events into this subscription, so an
+        unrelated storm can neither overflow it nor cost it resolution
+        work — at 10k pods the client-side discard this replaces WAS the
+        control-plane ceiling. ``label_selector`` ({key: value, or None
+        for presence}) applies to every watched kind; ``selectors``
+        ({kind: selector-or-None}) sets per-kind selectors (a controller
+        typically wants ALL of its own kind but only the pods carrying
+        its ownership label). The returned subscription is
+        queue.Queue-shaped (.get(timeout=) raising queue.Empty when idle,
+        WatchClosed once the stream is dead — closed locally or GONE at
+        the hub); a subscriber that falls WATCH_CAPACITY events behind is
+        transparently relisted (k8s "watch too old" semantics).
+        WatchPoller packages the standard reaction (resubscribe + relist)
+        for informer loops.
+
+        Subscribe and the replay listing happen while every covered
+        shard lock is held (acquired in KINDS order — the one sanctioned
+        shard->shard nesting), so no event can be missed between the
+        initial list and the live tail AND the tail can never replay an
+        event older than what the listing showed (no deleted-then-
+        recreated inversion on a fresh stream). Writers hold exactly one
+        shard lock, so this cannot deadlock them."""
+        if selectors is not None:
+            filters = dict(selectors)
+        elif kinds:
+            filters = {k: label_selector for k in kinds}
+        else:
+            filters = None  # full stream; flat selector applies on resolve
+        if not replay:
+            sub_id = self._hub.subscribe(filters=filters)
+            return WatchSubscription(self, sub_id, filters=filters,
+                                     label_selector=label_selector)
+        covered = (self.KINDS if filters is None
+                   else tuple(k for k in self.KINDS if k in filters))
+        guards = [self._locked(k) for k in covered]
+        try:
+            sub_id = self._hub.subscribe(filters=filters)
+            sub = WatchSubscription(self, sub_id, filters=filters,
+                                    label_selector=label_selector)
+            sub._relist(locks_held=True)
+        finally:
+            for g in reversed(guards):
+                g.__exit__(None, None, None)
         return sub
 
     def unwatch(self, sub: "WatchSubscription") -> None:
         sub.close()
 
-    def _notify(self, etype: EventType, kind: str, obj: Any) -> None:
-        # caller holds self._mu (all CRUD paths); publish + snapshot are
-        # atomic with respect to subscribe-and-relist
-        seq = self._hub.publish(_ETYPE_CODE[etype], kind, self._key(obj))
-        self._snapshots[seq] = (etype, kind, obj)
-        if self.tracer is not None:
-            # the writer's current span becomes the event's causal parent:
-            # a reconcile's pod create/update is traceable to whatever the
-            # subscriber does with it
-            ctx = current_context()
+    #: sentinel: _notify should read the calling thread's current span
+    _CALLER_CTX = object()
+
+    def _notify(self, etype: EventType, kind: str, obj: Any,
+                ctx: Any = _CALLER_CTX) -> None:
+        # caller holds the kind's shard lock (all CRUD paths). Publish and
+        # snapshot-record are atomic under _snap_mu so no subscriber can
+        # poll a seq whose snapshot a cross-shard writer hasn't landed yet
+        # (shard -> snap is the sanctioned nesting order). ctx overrides
+        # the caller-thread context for batched writes applied on a
+        # flusher thread on behalf of the real writer.
+        if ctx is FakeCluster._CALLER_CTX:
+            ctx = current_context() if self.tracer is not None else None
+        with self._snap_mu:
+            seq = self._hub.publish(_ETYPE_CODE[etype], kind, self._key(obj),
+                                    labels=obj.metadata.labels)
+            self._snapshots[seq] = (etype, kind, obj)
             if ctx is not None:
+                # the writer's current span becomes the event's causal
+                # parent: a reconcile's pod create/update is traceable to
+                # whatever the subscriber does with it
                 self._event_ctx[seq] = ctx
-        floor = seq - 2 * self.WATCH_CAPACITY
-        while self._snapshot_min <= floor:
-            self._snapshots.pop(self._snapshot_min, None)
-            self._event_ctx.pop(self._snapshot_min, None)
-            self._snapshot_min += 1
+            floor = seq - 2 * self.WATCH_CAPACITY
+            while self._snapshot_min <= floor:
+                self._snapshots.pop(self._snapshot_min, None)
+                self._event_ctx.pop(self._snapshot_min, None)
+                self._snapshot_min += 1
 
     # ---------------------------------------------------------------- events
 
     def record_event(
         self, kind: str, key: str, reason: str, message: str, type: str = "Normal"
     ) -> None:
-        with self._mu:
+        with self._ev_mu:
             self.events.append(ClusterEvent(key, kind, reason, message, type))
 
     def events_for(self, key: str) -> list[ClusterEvent]:
-        with self._mu:
+        with self._ev_mu:
             return [e for e in self.events if e.object_key == key]
 
     @staticmethod

@@ -88,7 +88,8 @@ class GangScheduler:
             self.errors += 1
 
         poller = WatchPoller(self.cluster, timeout=0.5,
-                             count_error=count_error)
+                             count_error=count_error,
+                             kinds=("pods", "podgroups"))
         while not self._stop.is_set():
             ev = poller.get()
             if ev is None:

@@ -71,6 +71,11 @@ RESTART_BACKOFF = BackoffPolicy(base_s=0.05, max_s=2.0, jitter=0.5)
 class JobController(ControllerBase):
     """Reconciles every job in the cluster. Start one per process."""
 
+    # every job, but only pods this controller owns: unlabeled pod
+    # storms (serving, notebooks, bare runs) cost it nothing. The keys
+    # are also the kind filter (WATCH_SELECTORS subsumes WATCH_KINDS).
+    WATCH_SELECTORS = {"jobs": None, "pods": {JOB_NAME_LABEL: None}}
+
     def __init__(
         self,
         cluster: FakeCluster,

@@ -22,6 +22,7 @@ from kubeflow_tpu.controller.fakecluster import (
     PodPhase,
     WatchPoller,
 )
+from kubeflow_tpu.controller.statusbuffer import StatusWriteBuffer
 from kubeflow_tpu.health import ENV_HEARTBEAT_FILE, read_heartbeat
 from kubeflow_tpu.tracing import (
     CARRIER_ANNOTATION,
@@ -29,13 +30,6 @@ from kubeflow_tpu.tracing import (
     current_context,
 )
 from kubeflow_tpu.analysis.lockcheck import make_lock
-from kubeflow_tpu.utils.retry import with_conflict_retry
-
-
-class _StaleIncarnation(Exception):
-    """Internal: the pod a status write was aimed at is gone or replaced."""
-
-
 try:  # resolved ONCE in the parent: the post-fork child must not import or
     # allocate (another thread may hold the import/malloc lock at fork time)
     import ctypes as _ctypes
@@ -82,6 +76,10 @@ class PodRuntime:
         #: incarnation / conflicting write) — benign, but countable so a
         #: storm of them is visible instead of silently absorbed
         self.stale_event_drops = 0
+        #: coalescing group-commit for pod status transitions: N
+        #: concurrent bind/Running/finished writes fold into one locked
+        #: flush (docs/architecture.md "Control-plane scaling")
+        self.status_writes = StatusWriteBuffer(cluster, kind="pods")
         #: fault-injection attachment point (chaos.ChaosEngine.attach)
         self.chaos = None
         self._procs: dict[str, tuple[str, subprocess.Popen]] = {}
@@ -120,6 +118,9 @@ class PodRuntime:
 
         atexit.unregister(self.stop)
         self._stop.set()
+        # drain coalesced status writes before killing pods: a buffered
+        # "finished" transition must not be lost to teardown
+        self.status_writes.close()
         with self._mu:
             procs = [proc for _, proc in self._procs.values()]
         for p in procs:
@@ -139,7 +140,7 @@ class PodRuntime:
             self.errors += 1
 
         poller = WatchPoller(self.cluster, timeout=0.2,
-                             count_error=count_error)
+                             count_error=count_error, kinds=("pods",))
         while not self._stop.is_set():
             ev = poller.get()
             if ev is None:
@@ -198,26 +199,17 @@ class PodRuntime:
                 self._launch(pod, trigger)
 
     def _update_pod_status(self, key: str, uid: str, mutate_status) -> bool:
-        """Conflict-retried status write gated on the pod incarnation: the
+        """Coalesced status write gated on the pod incarnation: the
         kubelet must never lose a status transition to a concurrent writer
-        (a silently dropped ConflictError here strands the pod — and with it
-        the whole gang — in its previous phase), and must never stamp a NEW
-        incarnation with the old one's verdict. Returns False when the pod
-        is gone or replaced."""
-
-        def attempt():
-            pod = self.cluster.get("pods", key, copy_obj=True)
-            if pod is None or pod.metadata.uid != uid:
-                raise _StaleIncarnation
-            if mutate_status(pod) is False:  # mutator declined on fresh state
-                raise _StaleIncarnation
-            return self.cluster.update("pods", pod)
-
+        (a silently dropped ConflictError here strands the pod — and with
+        it the whole gang — in its previous phase), and must never stamp a
+        NEW incarnation with the old one's verdict. Returns False when the
+        pod is gone or replaced. N transitions landing together (a gang's
+        worth of Running writes, a reap wave) fold into one locked flush
+        via StatusWriteBuffer; injected conflicts still exercise the
+        single-op retry path."""
         try:
-            with_conflict_retry(attempt)
-            return True
-        except _StaleIncarnation:
-            return False
+            return self.status_writes.write(key, uid, mutate_status)
         except (ConflictError, KeyError):
             # retry budget exhausted under a genuine storm, or deleted
             # mid-write: surfaced as a countable runtime error, not a hang

@@ -74,6 +74,8 @@ def check_job_admission(cluster: FakeCluster, job) -> None:
 class ProfileController(ControllerBase):
     """Profile -> Namespace lifecycle."""
 
+    WATCH_KINDS = ("profiles",)
+
     ERROR_EVENT_KIND = "profiles"
 
     def __init__(self, cluster: FakeCluster, workers: int = 1,

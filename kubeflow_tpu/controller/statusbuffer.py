@@ -1,0 +1,246 @@
+"""StatusWriteBuffer — leader-combined per-pod status writes.
+
+At 10k pods the kubelet layer's status transitions (bind, Running,
+finished) were the control plane's write amplifier: every transition was
+its own conflict-retried read-copy-update round trip — two shard-lock
+acquisitions, a full deepcopy, and a retry loop racing every other
+writer. This buffer coalesces them with a COMBINING scheme (flat-combining
+/ group-commit): a writer that arrives while no flush is running becomes
+the LEADER and applies everything pending — its own op plus whatever
+concurrent writers enqueued — through ``FakeCluster.batch_update`` under
+one lock hold; the others just wait for their ack. No dedicated flusher
+thread: a solo writer IS its own leader and pays zero cross-thread
+handoff (measured: a worker->flusher->worker Event round trip costs more
+than the write it carries), while a storm's writers fold into each
+other's batches automatically (docs/architecture.md "Control-plane
+scaling").
+
+Contract preserved from the per-op path it replaces:
+
+  - **incarnation guard** — an op carries the uid it was aimed at; the
+    mutate runs only if the stored pod still IS that incarnation (and may
+    itself decline on fresh state by returning False);
+  - **ordering** — ops flush in enqueue order, so a writer that stamps
+    ``CARRIER_ANNOTATION`` before a phase transition keeps that order;
+  - **conflict-retry** — injected ConflictErrors (chaos.on_update) route
+    the op through the classic single-op conflict-retried path, so the
+    PR-1 drill class still exercises real retry machinery;
+  - **causality** — each op captures its writer's SpanContext at enqueue
+    and the batch publishes it with the MODIFIED event, so reconcile
+    spans parent-link exactly as if the writer had called update()
+    itself.
+
+Writers touch only ``pod.status`` and ``pod.metadata.annotations`` —
+that's what makes the cheap targeted copy safe; anything else must go
+through ``read_modify_write``.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import threading
+from typing import Any, Callable
+
+from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.controller.fakecluster import ConflictError, FakeCluster
+from kubeflow_tpu.tracing import current_context
+from kubeflow_tpu.utils.retry import with_conflict_retry
+
+
+def pod_status_copier(pod: Any) -> Any:
+    """RCU copy specialized to status writers: fresh status + metadata
+    (with its own annotations dict), everything else — command, env,
+    labels — shared with the stored object, which nobody mutates in
+    place. ~5x cheaper than deepcopy, and the deepcopy inside the write
+    lock was the single largest term in the 10k-pod storm profile."""
+    meta = dataclasses.replace(
+        pod.metadata, annotations=dict(pod.metadata.annotations))
+    return dataclasses.replace(
+        pod, metadata=meta, status=copy.copy(pod.status))
+
+
+class _Op:
+    __slots__ = ("key", "uid", "mutate", "ctx", "done", "ok", "exc")
+
+    def __init__(self, key: str, uid: str, mutate, ctx):
+        self.key = key
+        self.uid = uid
+        self.mutate = mutate
+        self.ctx = ctx
+        self.done = threading.Event()
+        self.ok = False
+        self.exc: BaseException | None = None
+
+
+class StatusWriteBuffer:
+    """Combining group-commit over batch_update: sync-ack writes, one
+    shard-lock hold per batch, no background thread."""
+
+    #: a leader this far gone is treated as wedged; the follower reclaims
+    #: its op (if still pending) and applies it through the single path
+    ACK_TIMEOUT_S = 30.0
+
+    def __init__(self, cluster: FakeCluster, kind: str = "pods",
+                 max_batch: int = 256,
+                 copier: Callable[[Any], Any] | None = pod_status_copier):
+        self.cluster = cluster
+        self.kind = kind
+        self.max_batch = max_batch
+        self.copier = copier
+        self._mu = make_lock("statusbuffer.StatusWriteBuffer._mu")
+        self._pending: list[_Op] = []
+        self._leading = False
+        self.metrics: dict[str, int] = {
+            "writes_total": 0,
+            "flushes_total": 0,
+            # writes that shared their flush with at least one other write
+            # (the coalescing win the batching exists for)
+            "coalesced_writes_total": 0,
+            # chaos-injected conflicts routed through the single-op path
+            "conflict_fallbacks_total": 0,
+            # close()-time batches that failed to apply (teardown races a
+            # dying store) — countable, never silent
+            "teardown_flush_failures_total": 0,
+        }
+
+    # ------------------------------------------------------------- writers
+
+    def write(self, key: str, uid: str, mutate_status) -> bool:
+        """Apply ``mutate_status`` to the stored object iff it is still
+        incarnation ``uid`` (empty uid = don't guard). True when applied;
+        False when the object is gone, replaced, or the mutator declined.
+        Raises ConflictError only when the chaos-conflict fallback path
+        exhausts its retry budget — same surface as read_modify_write."""
+        chaos = self.cluster.chaos
+        if chaos is not None:
+            try:
+                # the same injection point update() honors, fired per
+                # logical write: batching must not make injected conflict
+                # storms invisible
+                chaos.on_update(self.kind, key)
+            except ConflictError:
+                with self._mu:
+                    self.metrics["writes_total"] += 1
+                    self.metrics["conflict_fallbacks_total"] += 1
+                return self._write_single(key, uid, mutate_status)
+        ctx = (current_context()
+               if self.cluster.tracer is not None else None)
+        op = _Op(key, uid, mutate_status, ctx)
+        with self._mu:
+            self.metrics["writes_total"] += 1
+            self._pending.append(op)
+            lead = not self._leading
+            if lead:
+                self._leading = True
+        if not lead:
+            # a leader is flushing: it will drain us before it steps down
+            if op.done.wait(self.ACK_TIMEOUT_S):
+                return self._result(op)
+            # wedged leader: reclaim the op if it was never drained and
+            # apply it ourselves — applied once, never twice or zero times
+            with self._mu:
+                mine = op in self._pending
+                if mine:
+                    self._pending.remove(op)
+            if mine:
+                return self._write_single(key, uid, mutate_status)
+            op.done.wait()  # drained: the ack WILL come
+            return self._result(op)
+        # leader: drain until nothing is pending — ops enqueued while we
+        # flush have no other leader, so stepping down early would strand
+        # them until their timeout
+        batch: list[_Op] = []
+        try:
+            while True:
+                with self._mu:
+                    batch = self._pending[:self.max_batch]
+                    del self._pending[:len(batch)]
+                    if not batch:
+                        self._leading = False
+                        break
+                    self.metrics["flushes_total"] += 1
+                    if len(batch) > 1:
+                        self.metrics["coalesced_writes_total"] += len(batch)
+                self._flush(batch)
+        except BaseException:
+            # never leave the buffer leaderless with ops pending, and
+            # never abandon an EXTRACTED batch unacked: an async
+            # exception landing between drain and _flush would otherwise
+            # strand those followers past even their wedge timeout (the
+            # ops are no longer in _pending, so reclaim can't find them)
+            with self._mu:
+                self._leading = False
+            for o in batch:
+                o.done.set()  # ok stays False: not applied
+            raise
+        return self._result(op)
+
+    @staticmethod
+    def _result(op: _Op) -> bool:
+        if op.exc is not None:
+            raise op.exc  # the op's own mutator raised (rmw parity)
+        return op.ok
+
+    def _write_single(self, key: str, uid: str, mutate_status) -> bool:
+        """The classic per-op conflict-retried read-copy-update — the
+        fallback that keeps injected conflict storms exercising real
+        retry machinery."""
+
+        def attempt():
+            obj = self.cluster.get(self.kind, key, copy_obj=True)
+            if obj is None or (uid and obj.metadata.uid != uid):
+                return None
+            if mutate_status(obj) is False:
+                return None
+            return self.cluster.update(self.kind, obj)
+
+        try:
+            return with_conflict_retry(attempt) is not None
+        except KeyError:
+            return False
+
+    # ------------------------------------------------------------- combine
+
+    def _guard(self, op: _Op):
+        def mutate(obj):
+            if op.uid and obj.metadata.uid != op.uid:
+                return False  # stale incarnation: never stamp the new one
+            return op.mutate(obj)
+
+        return mutate
+
+    def _flush(self, batch: list[_Op]) -> None:
+        try:
+            results = self.cluster.batch_update(
+                self.kind,
+                [(op.key, self._guard(op), op.ctx) for op in batch],
+                copier=self.copier,
+            )
+            for op, res in zip(batch, results):
+                if isinstance(res, BaseException):
+                    # the op's own mutator raised: surface it to ITS
+                    # writer (read_modify_write parity), not the batch
+                    op.exc = res
+                else:
+                    op.ok = res is not None
+        finally:
+            # acks on EVERY path: a follower must never hang on our error
+            for op in batch:
+                op.done.set()
+
+    def close(self) -> None:
+        """Apply anything still pending (teardown stragglers)."""
+        while True:
+            with self._mu:
+                batch = self._pending[:self.max_batch]
+                del self._pending[:len(batch)]
+                if batch:
+                    self.metrics["flushes_total"] += 1
+            if not batch:
+                break
+            try:
+                self._flush(batch)
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                with self._mu:
+                    self.metrics["teardown_flush_failures_total"] += 1

@@ -51,6 +51,7 @@ def _probe(url: str, timeout_s: float = 0.5) -> bool:
 
 
 class TensorboardController(ControllerBase):
+    WATCH_SELECTORS = {"tensorboards": None, "pods": {TB_LABEL: None}}
     ERROR_EVENT_KIND = "tensorboards"
 
     def __init__(self, cluster: FakeCluster, workers: int = 1,

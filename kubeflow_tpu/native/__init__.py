@@ -96,10 +96,17 @@ def lib() -> ctypes.CDLL:
         L.kf_hub_free.argtypes = [ctypes.c_void_p]
         L.kf_hub_subscribe.restype = ctypes.c_longlong
         L.kf_hub_subscribe.argtypes = [ctypes.c_void_p]
+        L.kf_hub_subscribe_filtered.restype = ctypes.c_longlong
+        L.kf_hub_subscribe_filtered.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         L.kf_hub_unsubscribe.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
         L.kf_hub_publish.restype = ctypes.c_longlong
         L.kf_hub_publish.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        L.kf_hub_publish_labeled.restype = ctypes.c_longlong
+        L.kf_hub_publish_labeled.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p,
         ]
         L.kf_hub_poll.restype = ctypes.c_int
         L.kf_hub_poll.argtypes = [
@@ -299,13 +306,56 @@ class EventHub:
         self._h = self._L.kf_hub_new(capacity)
         self.capacity = capacity
 
-    def subscribe(self) -> int:
-        return self._L.kf_hub_subscribe(self._h)
+    @staticmethod
+    def _esc(s: str) -> str:
+        """Escape the filter-spec/CSV metacharacters in a label key or
+        value. Applied identically on the publish and subscribe sides, so
+        the hub's equality match compares consistently-ENCODED strings —
+        C++ never needs to decode, and a value like "x,app=b" can neither
+        forge nor hide a selector match."""
+        return (s.replace("%", "%25").replace(",", "%2C")
+                .replace(";", "%3B").replace(":", "%3A")
+                .replace("=", "%3D"))
+
+    @classmethod
+    def filter_spec(cls, filters) -> str:
+        """Render {kind: selector | None} to the native filter string
+        ("kind[:k[=v][,k2]];..."). selector = {label: value | None};
+        a None value means "label present, any value"."""
+        parts = []
+        for kind, sel in filters.items():
+            if sel:
+                terms = ",".join(
+                    cls._esc(k) if v is None
+                    else f"{cls._esc(k)}={cls._esc(v)}"
+                    for k, v in sorted(sel.items()))
+                parts.append(f"{kind}:{terms}")
+            else:
+                parts.append(kind)
+        return ";".join(parts)
+
+    def subscribe(self, kinds=None, filters=None) -> int:
+        """Subscribe; ``filters`` ({kind: label-selector-or-None}) or
+        ``kinds`` (iterable — every kind unfiltered) installs a
+        server-side filter: events outside it are never buffered for this
+        subscriber, so they can neither overflow it nor cost it work."""
+        if filters is None and kinds:
+            filters = {k: None for k in kinds}
+        if not filters:
+            return self._L.kf_hub_subscribe(self._h)
+        return self._L.kf_hub_subscribe_filtered(
+            self._h, self.filter_spec(filters).encode())
 
     def unsubscribe(self, sub_id: int) -> None:
         self._L.kf_hub_unsubscribe(self._h, sub_id)
 
-    def publish(self, etype: int, kind: str, key: str) -> int:
+    def publish(self, etype: int, kind: str, key: str,
+                labels: dict | None = None) -> int:
+        if labels:
+            csv = ",".join(f"{self._esc(k)}={self._esc(v)}"
+                           for k, v in labels.items())
+            return self._L.kf_hub_publish_labeled(
+                self._h, etype, kind.encode(), key.encode(), csv.encode())
         return self._L.kf_hub_publish(self._h, etype, kind.encode(), key.encode())
 
     def poll(self, sub_id: int, timeout_s: float):

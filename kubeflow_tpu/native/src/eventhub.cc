@@ -22,7 +22,10 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
+#include <set>
+#include <sstream>
 #include <string>
 
 namespace {
@@ -34,41 +37,134 @@ struct Event {
   std::string key;
 };
 
+// one selector term: label key -> required value. kPresenceOnly (a control
+// byte the %-escaped wire format can never contain) means "key present,
+// any value"; an EMPTY string is a real equality-to-empty-value match
+// (k8s `labelSelector=team=` form) — the two must not be conflated or a
+// stream's live tail and its Python-side relist diverge.
+const char kPresenceOnly[] = "\x01";
+using Selector = std::map<std::string, std::string>;
+
 struct Subscriber {
   std::deque<Event> buf;
   bool overflowed = false;
+  // server-side filter: kind -> label selector (empty selector = every
+  // object of that kind). Empty map = all kinds, no filtering. Filtered-
+  // out events are never buffered, so an unrelated storm can neither
+  // overflow this subscriber nor cost it per-event resolution work (the
+  // control-plane fan-out fix: previously every subscriber received
+  // every event and discarded irrelevant ones in Python, and at 10k pods
+  // that client-side discard WAS the concurrency ceiling).
+  std::map<std::string, Selector> filters;
+  // per-subscriber wakeup: Publish notifies only the subscribers that
+  // actually RECEIVED the event — a hub-wide cv made every publish wake
+  // every idle watcher (8 bystanders x 20k events = 160k spurious
+  // scheduler round-trips in a 10k-pod storm). shared_ptr so a Poll
+  // blocked on it survives a racing Unsubscribe.
+  std::shared_ptr<std::condition_variable> cv =
+      std::make_shared<std::condition_variable>();
 };
 
 class EventHub {
  public:
   explicit EventHub(int capacity) : capacity_(capacity < 1 ? 1 : capacity) {}
 
-  int64_t Subscribe() {
+  // filter_spec: "kind[:k[=v][,k2[=v2]]];kind2..." — per-kind label
+  // selectors; empty/null = all kinds. A selector term without '=' means
+  // "label key present, any value".
+  int64_t Subscribe(const char* filter_spec) {
     std::lock_guard<std::mutex> lk(mu_);
     int64_t id = next_sub_++;
-    subs_.emplace(id, Subscriber{});
+    Subscriber sub;
+    if (filter_spec != nullptr && filter_spec[0] != '\0') {
+      std::stringstream ss(filter_spec);
+      std::string entry;
+      while (std::getline(ss, entry, ';')) {
+        if (entry.empty()) continue;
+        auto colon = entry.find(':');
+        std::string kind = entry.substr(0, colon);
+        Selector sel;
+        if (colon != std::string::npos) {
+          std::stringstream terms(entry.substr(colon + 1));
+          std::string term;
+          while (std::getline(terms, term, ',')) {
+            if (term.empty()) continue;
+            auto eq = term.find('=');
+            if (eq == std::string::npos) {
+              sel[term] = kPresenceOnly;
+            } else {
+              sel[term.substr(0, eq)] = term.substr(eq + 1);
+            }
+          }
+        }
+        if (!kind.empty()) sub.filters[kind] = std::move(sel);
+      }
+    }
+    subs_.emplace(id, std::move(sub));
     return id;
   }
 
   void Unsubscribe(int64_t id) {
     std::lock_guard<std::mutex> lk(mu_);
-    subs_.erase(id);
+    auto it = subs_.find(id);
+    if (it == subs_.end()) return;
+    // wake any Poll blocked on this subscriber before the entry goes:
+    // it re-locks, re-finds, and reports GONE
+    it->second.cv->notify_all();
+    subs_.erase(it);
   }
 
-  int64_t Publish(int etype, const char* kind, const char* key) {
+  // labels_csv: the object's labels as "k=v,k2=v2" (may be empty) —
+  // parsed at most once per publish, and only when some subscriber
+  // actually carries a label selector for this kind.
+  int64_t Publish(int etype, const char* kind, const char* key,
+                  const char* labels_csv) {
     std::lock_guard<std::mutex> lk(mu_);
     int64_t seq = next_seq_++;
+    std::map<std::string, std::string> labels;
+    bool labels_parsed = false;
     for (auto& [id, sub] : subs_) {
+      if (!sub.filters.empty()) {
+        auto it = sub.filters.find(kind);
+        if (it == sub.filters.end()) continue;  // kind filtered out
+        const Selector& sel = it->second;
+        if (!sel.empty()) {
+          if (!labels_parsed) {
+            labels_parsed = true;
+            if (labels_csv != nullptr && labels_csv[0] != '\0') {
+              std::stringstream ss(labels_csv);
+              std::string term;
+              while (std::getline(ss, term, ',')) {
+                auto eq = term.find('=');
+                if (eq != std::string::npos) {
+                  labels[term.substr(0, eq)] = term.substr(eq + 1);
+                }
+              }
+            }
+          }
+          bool match = true;
+          for (const auto& [k, v] : sel) {
+            auto l = labels.find(k);
+            if (l == labels.end() ||
+                (v != kPresenceOnly && l->second != v)) {
+              match = false;
+              break;
+            }
+          }
+          if (!match) continue;  // label-selector filtered out
+        }
+      }
       if (sub.overflowed) continue;  // already requires a relist
       if (static_cast<int>(sub.buf.size()) >= capacity_) {
         // slow consumer: drop its backlog, force relist
         sub.buf.clear();
         sub.overflowed = true;
+        sub.cv->notify_all();  // an overflow IS a deliverable condition
         continue;
       }
       sub.buf.push_back(Event{seq, etype, kind, key});
+      sub.cv->notify_all();
     }
-    cv_.notify_all();
     return seq;
   }
 
@@ -97,8 +193,11 @@ class EventHub {
         *key = ev.key;
         return 0;
       }
+      // local shared_ptr: the cv outlives a racing Unsubscribe (which
+      // notifies first, so this wait wakes and reports GONE)
+      std::shared_ptr<std::condition_variable> cv = sub.cv;
       if (timeout_s <= 0 ||
-          cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+          cv->wait_until(lk, deadline) == std::cv_status::timeout) {
         auto again = subs_.find(id);
         if (again == subs_.end()) return 3;
         if (again->second.overflowed) {
@@ -119,7 +218,6 @@ class EventHub {
 
  private:
   std::mutex mu_;
-  std::condition_variable cv_;
   std::map<int64_t, Subscriber> subs_;
   int capacity_;
   int64_t next_sub_ = 1;
@@ -140,7 +238,13 @@ void* kf_hub_new(int capacity) { return new EventHub(capacity); }
 void kf_hub_free(void* h) { delete static_cast<EventHub*>(h); }
 
 long long kf_hub_subscribe(void* h) {
-  return static_cast<EventHub*>(h)->Subscribe();
+  return static_cast<EventHub*>(h)->Subscribe(nullptr);
+}
+
+// filter_spec: "kind[:k[=v][,k2]];kind2..." per-kind label selectors;
+// ""/null = all kinds unfiltered.
+long long kf_hub_subscribe_filtered(void* h, const char* filter_spec) {
+  return static_cast<EventHub*>(h)->Subscribe(filter_spec);
 }
 
 void kf_hub_unsubscribe(void* h, long long id) {
@@ -149,7 +253,14 @@ void kf_hub_unsubscribe(void* h, long long id) {
 
 long long kf_hub_publish(void* h, int etype, const char* kind,
                          const char* key) {
-  return static_cast<EventHub*>(h)->Publish(etype, kind, key);
+  return static_cast<EventHub*>(h)->Publish(etype, kind, key, nullptr);
+}
+
+// publish with the object's labels ("k=v,k2=v2") so label-selector
+// subscribers can be matched server-side.
+long long kf_hub_publish_labeled(void* h, int etype, const char* kind,
+                                 const char* key, const char* labels_csv) {
+  return static_cast<EventHub*>(h)->Publish(etype, kind, key, labels_csv);
 }
 
 // rc as in EventHub::Poll; on rc==0, *out_seq/*out_etype are set and

@@ -26,6 +26,7 @@ def render_metrics(platform) -> str:
     exp = Exposition()
     counter, gauge = exp.counter, exp.gauge
 
+    worker_depths: list[tuple[str, list[int]]] = []
     for cname, ctrl in platform.controllers.items():
         for mname, v in sorted(ctrl.metrics.items()):
             counter(f"kftpu_{cname}_{mname}", v)
@@ -33,6 +34,7 @@ def render_metrics(platform) -> str:
             f"kftpu_{cname}_workqueue_depth", len(ctrl.wq),
             help_="pending reconcile keys",
         )
+        worker_depths.append((cname, ctrl.wq.depths()))
         # reconcile-duration histogram (controller-runtime parity):
         # cumulative le buckets + _sum/_count in exposition format
         counts, total = ctrl.latency_snapshot()
@@ -40,6 +42,31 @@ def render_metrics(platform) -> str:
             f"kftpu_{cname}_reconcile_duration_seconds",
             ctrl.latency_buckets, counts, total,
         )
+
+    # keyed-pool shape (docs/architecture.md "Control-plane scaling"): one
+    # depth sample per worker queue — a skewed profile means hot keys are
+    # hashing onto one worker. Emitted AFTER the per-controller loop so
+    # the family's samples form one contiguous exposition group.
+    for cname, depths in worker_depths:
+        for i, depth in enumerate(depths):
+            gauge(
+                "kftpu_cplane_worker_queue_depth", depth,
+                help_="pending keys per keyed-pool worker queue",
+                labels=f'{{controller="{cname}",worker="{i}"}}',
+            )
+
+    # control-plane scale-out signals (docs/architecture.md): shard-lock
+    # contention on the sharded store, and the status-write coalescing
+    # effectiveness of the kubelet layer's group commit
+    counter(
+        "kftpu_cplane_shard_lock_waits_total",
+        sum(platform.cluster.lock_wait_counts().values()),
+    )
+    runtime_sb = getattr(getattr(platform, "pod_runtime", None),
+                         "status_writes", None)
+    if runtime_sb is not None:
+        for mname, v in sorted(runtime_sb.metrics.items()):
+            counter(f"kftpu_cplane_status_{mname}", v)
 
     # liveness layer (kubeflow_tpu/health.py): lease expiries and straggler
     # declarations counted apart from crash deaths, plus per-incarnation

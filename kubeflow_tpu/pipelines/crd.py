@@ -67,6 +67,8 @@ def pipelinerun_from_dict(manifest: dict) -> PipelineRunCR:
 class PipelineRunController(ControllerBase):
     """Executes PipelineRun objects; one executor thread per run."""
 
+    WATCH_KINDS = ("pipelineruns",)
+
     ERROR_EVENT_KIND = "pipelineruns"
     #: finished-run results retained for the visualization report
     _RESULT_CAP = 64

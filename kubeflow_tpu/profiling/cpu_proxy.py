@@ -81,8 +81,9 @@ def _best_of(fn, gated_phase: str, runs: int = 2) -> dict:
 
 def _mlp_step():
     """One cached jit SGD step for a fixed tiny MLP (no mesh machinery —
-    must run on every jax this repo supports, incl. 0.4.x without
-    jax.set_mesh)."""
+    must run on every jax this repo supports; mesh-requiring proxies go
+    through utils/compat.set_mesh and skip-with-reason when even the
+    compat chain has no resolution)."""
     import jax
     import jax.numpy as jnp
 
@@ -431,9 +432,222 @@ def reconcile_storm(n_pods: int = 200, gets_per_pass: int = 8,
     }
 
 
+# ----------------------------------------------------------- cplane_storm
+
+
+#: ownership label of the cplane-storm controller's pods
+STORM_LABEL = "kubeflow-tpu.org/cplane-storm"
+
+#: frozen PRE-REFACTOR measurement of cplane_storm's exact scenario (10k
+#: pods, 8 bystander informers, 100-pod gang restart) on the single-lock
+#: store with unfiltered watch fan-out and per-pod conflict-retried status
+#: writes — captured at the PR-8 base commit, recorded here so every
+#: budget regen carries the before/after pair. per-pod units
+#: (time-to-Running / store-get unit) is the machine-invariant number;
+#: jobs/sec is the same run's absolute throughput on the capture machine.
+BASELINE_SINGLE_LOCK = {
+    "jobs_per_s_to_running": 697.7,
+    "to_running_units_per_pod": 48.17,
+    "passes_per_gang_restart": 269,
+}
+
+#: the platform's OTHER pods-watching controllers, as (name, ownership
+#: label) — the fan-out the sharded watch path exists to neutralize. Each
+#: bystander informer subscribes pods-with-its-label (server-side): a
+#: storm of someone else's pods never reaches it. Pre-refactor, every one
+#: of these received and discarded every event client-side, and at 10k
+#: pods that discard work was the control-plane ceiling.
+BYSTANDER_CONTROLLERS = (
+    ("job", "kubeflow-tpu.org/job-name"),
+    ("tensorboard", "kubeflow-tpu.org/tensorboard"),
+    ("inferenceservice", "kubeflow-tpu.org/inferenceservice"),
+    ("experiment", "kubeflow-tpu.org/experiment-name"),
+    ("notebook", "kubeflow-tpu.org/notebook"),
+    ("pvcviewer", "kubeflow-tpu.org/pvcviewer"),
+    ("autoscaler", "kubeflow-tpu.org/autoscaled"),
+    ("pipelinerun", "kubeflow-tpu.org/pipelinerun"),
+)
+
+
+def cplane_storm(n_pods: int = 10000, gang_size: int = 100,
+                 workers: int = 4, timeout_s: float = 300.0) -> dict:
+    """10k-pod control-plane tier (ROADMAP item 3): N pods driven to
+    Running through the FULL scaled path — label-filtered watch fan-out,
+    keyed worker pool, coalesced status writes — in the platform's real
+    subscriber shape (one owning controller + 8 bystander informers),
+    reporting jobs/sec-to-Running and reconcile passes per gang restart.
+
+    Untraced on purpose (production posture; the 200-pod storm keeps the
+    traced percentiles): this workload gates THROUGHPUT. The gated ratio
+    is per-pod time-to-Running in store-get units, so the budget is
+    machine-speed invariant; the absolute jobs/sec lands in the budget
+    regen next to the frozen pre-refactor single-lock baseline
+    (docs/perf.md "Control-plane scale-out")."""
+    import threading
+
+    from kubeflow_tpu.api.common import ObjectMeta
+    from kubeflow_tpu.controller.base import ControllerBase
+    from kubeflow_tpu.controller.fakecluster import (
+        FakeCluster, Pod, PodPhase, WatchPoller)
+    from kubeflow_tpu.controller.statusbuffer import StatusWriteBuffer
+    from kubeflow_tpu.utils.retry import poll_until
+
+    repeats = chaos_repeats("reconcile")
+    cluster = FakeCluster()
+    buffer = StatusWriteBuffer(cluster, kind="pods")
+    marked = [0]
+    marked_mu = threading.Lock()
+
+    class StormController(ControllerBase):
+        ERROR_EVENT_KIND = "pods"
+        # server-side push-down: only pods carrying the storm label ever
+        # reach this informer's buffer
+        WATCH_SELECTORS = {"pods": {STORM_LABEL: None}}
+
+        def kind_filter(self, etype, kind, obj):
+            if kind == "pods" and STORM_LABEL in obj.metadata.labels:
+                return obj.key
+            return None
+
+        def resync_keys(self):
+            return ()
+
+        def reconcile(self, key):
+            pod = None
+            for _ in range(repeats):
+                pod = self.cluster.get("pods", key)
+            if pod is None or pod.status.phase != PodPhase.PENDING:
+                return None
+            uid = pod.metadata.uid
+
+            def to_running(p):
+                if p.status.phase != PodPhase.PENDING:
+                    return False
+                p.status.phase = PodPhase.RUNNING
+                p.status.node = "local-node"
+                p.status.start_time = time.time()
+
+            if buffer.write(key, uid, to_running):
+                with marked_mu:
+                    marked[0] += 1
+            return None
+
+    # bystander informers: the other controllers' watch loops, doing what
+    # an informer does with a delivered event (resolve + map + discard).
+    # With server-side selectors they receive nothing for storm pods —
+    # that absence is the measured win, so they must actually be running.
+    stop_bystanders = threading.Event()
+
+    def bystander(label: str):
+        wp = WatchPoller(cluster, timeout=0.1, count_error=lambda: None,
+                         selectors={"pods": {label: None}})
+        while not stop_bystanders.is_set():
+            ev = wp.get()
+            if ev is not None:
+                etype, kind, obj = ev
+                obj.metadata.labels.get(label)  # the controller's map step
+
+    bystander_threads = [
+        threading.Thread(target=bystander, args=(label,),
+                         name=f"bystander-{name}", daemon=True)
+        for name, label in BYSTANDER_CONTROLLERS
+    ]
+
+    import gc
+
+    # calibration twin of the 200-pod storm: the same store-lock + deepcopy
+    # machinery, measured as min over medians-of-40 blocks
+    ref = Pod(metadata=ObjectMeta(name="calibration"))
+    cluster.create("pods", ref)
+
+    def store_unit_blocks(n: int) -> float:
+        medians = []
+        for _ in range(n):
+            gc.collect()
+            samples = []
+            for _ in range(40):
+                t0 = time.perf_counter()
+                cluster.get("pods", ref.key, copy_obj=True)
+                samples.append(time.perf_counter() - t0)
+            medians.append(_median(samples))
+        return min(medians)
+
+    unit_before = store_unit_blocks(3)
+
+    def storm_pod(i: int) -> Pod:
+        return Pod(metadata=ObjectMeta(name=f"storm-{i:05d}",
+                                       labels={STORM_LABEL: "1"}))
+
+    # bulk wave BEFORE the controller starts (informer replay delivers all
+    # N at once), same rationale as reconcile_storm
+    for i in range(n_pods):
+        cluster.create("pods", storm_pod(i))
+    for t in bystander_threads:
+        t.start()
+    ctrl = StormController(cluster, "cplane", workers=workers)
+    gc.collect()
+    t0 = time.perf_counter()
+    ctrl.start()
+    try:
+        poll_until(lambda: marked[0] >= n_pods or None,
+                   timeout_s=timeout_s, describe="pods to Running")
+        dt = time.perf_counter() - t0
+
+        # gang restart: kill + recreate one gang's worth of pods (new
+        # incarnations), count reconcile passes to reconverge — the
+        # passes-per-restart convergence-efficiency signal. Let the
+        # initial wave's MODIFIED backlog drain first or its passes
+        # pollute the restart count.
+        drain_deadline = time.monotonic() + timeout_s
+        prev = -1
+        while time.monotonic() < drain_deadline:
+            cur = ctrl.metrics["reconcile_total"]
+            if cur == prev and len(ctrl.wq) == 0:
+                break
+            prev = cur
+            time.sleep(0.05)
+        passes0 = ctrl.metrics["reconcile_total"]
+        for i in range(gang_size):
+            cluster.delete("pods", f"default/storm-{i:05d}")
+        for i in range(gang_size):
+            cluster.create("pods", storm_pod(i))
+        poll_until(lambda: marked[0] >= n_pods + gang_size or None,
+                   timeout_s=timeout_s, describe="gang restart reconverged")
+        restart_passes = ctrl.metrics["reconcile_total"] - passes0
+    finally:
+        stop_bystanders.set()
+        ctrl.stop()
+        buffer.close()
+    unit = min(unit_before, store_unit_blocks(2))
+    per_pod = dt / n_pods
+    return {
+        "workload": "cplane_storm",
+        "pods": n_pods,
+        "workers": workers,
+        "bystanders": len(BYSTANDER_CONTROLLERS),
+        "seconds_to_running": round(dt, 3),
+        "jobs_per_s_to_running": round(n_pods / dt, 1),
+        "passes_per_gang_restart": restart_passes,
+        "coalesced_writes": buffer.metrics["coalesced_writes_total"],
+        "flushes": buffer.metrics["flushes_total"],
+        "shard_lock_waits": sum(cluster.lock_wait_counts().values()),
+        "anchor": "store_get_unit",
+        "anchor_s": round(unit, 9),
+        "phases_s": {"to_running_per_pod": round(per_pod, 9)},
+        # gated: per-pod convergence cost in store-get units (machine-
+        # invariant), and passes per restarted pod (a COUNT — catches
+        # reconcile-amplification regressions no timing gate can)
+        "rel": {
+            "to_running": round(per_pod / unit, 4) if unit else 0.0,
+            "passes_per_pod_restart": round(
+                restart_passes / gang_size, 4),
+        },
+    }
+
+
 # ----------------------------------------------------------------- harness
 
-WORKLOADS = ("mlp_train", "serve_ticks", "reconcile_storm")
+WORKLOADS = ("mlp_train", "serve_ticks", "reconcile_storm", "cplane_storm")
 
 
 def run_all(only: str = "") -> list[dict]:
@@ -444,6 +658,7 @@ def run_all(only: str = "") -> list[dict]:
         "serve_ticks": serve_ticks,
         "reconcile_storm": lambda: _best_of(reconcile_storm,
                                             "reconcile_p50"),
+        "cplane_storm": lambda: _best_of(cplane_storm, "to_running"),
     }
     return [fns[name]() for name in WORKLOADS
             if not only or only in name]
@@ -473,6 +688,15 @@ def make_budgets(results: list[dict]) -> dict:
             "ratios": ({"tick": 3.0}
                        if rec["workload"] == "serve_ticks" else {}),
         }
+        if rec["workload"] == "cplane_storm":
+            # the acceptance record: this tree's throughput next to the
+            # frozen pre-refactor single-lock capture (ISSUE 8 asks for
+            # both numbers in every regen) — informational, the gate runs
+            # on the machine-invariant "rel" ratios above
+            budgets["cplane_storm"]["jobs_per_s_at_regen"] = rec[
+                "jobs_per_s_to_running"]
+            budgets["cplane_storm"]["baseline_single_lock"] = dict(
+                BASELINE_SINGLE_LOCK)
     return budgets
 
 

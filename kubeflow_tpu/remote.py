@@ -129,7 +129,8 @@ class RemoteClient:
     # ------------------------------------------------------------------ watch
 
     def watch(self, kind: str, namespace: str = "", name: str = "",
-              timeout_s: float = 60.0, keepalive_s: float = 10.0):
+              timeout_s: float = 60.0, keepalive_s: float = 10.0,
+              label_selector: str = ""):
         """NDJSON watch stream: yields {"type": ..., "object": ...} events
         (list+watch: current objects arrive first as ADDED). Terminates when
         the server-side timeout elapses.
@@ -145,6 +146,9 @@ class RemoteClient:
             "keepaliveSeconds": f"{keepalive_s:g}",
             **({"namespace": namespace} if namespace else {}),
             **({"name": name} if name else {}),
+            # "k=v,k2" — filtered SERVER-side (the apiserver pushes it
+            # into the watch hub), not client-side after transfer
+            **({"labelSelector": label_selector} if label_selector else {}),
         })
         req = urllib.request.Request(f"{self.server}/api/v1/{kind}?{q}")
         quiet_budget = max(2.0 * keepalive_s + 2.0, 5.0)

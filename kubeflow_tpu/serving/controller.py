@@ -75,6 +75,8 @@ def probe_ready(url: str, timeout_s: float = 0.5) -> bool:
 
 
 class InferenceServiceController(ControllerBase):
+    WATCH_SELECTORS = {"inferenceservices": None,
+                       "pods": {ISVC_LABEL: None}}
     ERROR_EVENT_KIND = "inferenceservices"
 
     def __init__(self, cluster: FakeCluster, workers: int = 1,

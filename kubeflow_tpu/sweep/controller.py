@@ -44,6 +44,11 @@ EXPERIMENT_LABEL = "kubeflow-tpu.org/experiment-name"
 class ExperimentController(ControllerBase):
     """Reconciles experiments: suggest -> render -> launch -> observe."""
 
+    WATCH_SELECTORS = {"experiments": None,
+                       "trials": {EXPERIMENT_LABEL: None},
+                       "jobs": {EXPERIMENT_LABEL: None},
+                       "pods": {EXPERIMENT_LABEL: None}}
+
     ERROR_EVENT_KIND = "experiments"
 
     def __init__(

@@ -202,12 +202,11 @@ def prefetch_to_device(
     """
     from collections import deque
 
-    import jax
-
     from kubeflow_tpu.parallel.sharding import shard_batch
+    from kubeflow_tpu.utils import compat
 
     buf: deque = deque()
-    with jax.set_mesh(mesh):
+    with compat.set_mesh(mesh):
         for b in it:
             buf.append(shard_batch(b, mesh, process_local=process_local))
             if len(buf) >= size:

@@ -31,6 +31,7 @@ from flax import struct
 from jax.sharding import Mesh
 
 from kubeflow_tpu.parallel import build_mesh, MeshConfig
+from kubeflow_tpu.utils import compat
 from kubeflow_tpu.parallel.sharding import (
     put_global,
     put_process_local,
@@ -293,7 +294,7 @@ class Trainer:
         # steady-state stepping. (with_sharding_constraint rather than jit
         # out_shardings: the latter's outputs also keep layout=None and the
         # re-specialization returns.)
-        with jax.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh):
             abstract = jax.eval_shape(build, x)
             shardings = state_shardings(abstract, self.mesh, self.partition_rules)
             return jax.jit(
@@ -309,7 +310,7 @@ class Trainer:
         divisibility/partitioning bugs; lowering+compiling the step over
         abstract args can, at any model size, in seconds)."""
         build, x = self._state_builder(sample_x)
-        with jax.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh):
             abstract = jax.eval_shape(build, x)
             shardings = state_shardings(abstract, self.mesh, self.partition_rules)
             return jax.tree.map(
@@ -329,7 +330,7 @@ class Trainer:
                                       np.asarray(sample_y).dtype)
                  if sample_y is not None
                  else jax.ShapeDtypeStruct((np.shape(sample_x)[0],), np.int32))
-        with jax.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh):
             return jax.jit(self._train_step, donate_argnums=0).lower(
                 abstract, (x_sds, y_sds)).compile()
 
@@ -441,7 +442,7 @@ class Trainer:
     def train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         # ambient mesh enables P-form with_sharding_constraint pins inside
         # models (bert.constrain) without threading the mesh through modules
-        with jax.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh):
             return self._jit_train_step(state, self._place(batch))
 
     def train_steps_fused(
@@ -458,7 +459,7 @@ class Trainer:
         metrics. Real `fit` keeps per-step dispatch — host data arrives per
         step and prefetch overlaps the transfer — but benches and synthetic-
         data loops should use this."""
-        with jax.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh):
             batch = self._place(batch)
             compiled = self._fused_compiled.get(n)
             if compiled is not None:
@@ -504,7 +505,7 @@ class Trainer:
 
     def train_chunk(self, state: TrainState, stacked, k: int):
         """Run k steps over a host-stacked chunk (k, B, ...) in one dispatch."""
-        with jax.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh):
             s = stacked_batch_sharding(self.mesh)
             place = put_process_local if self._process_local else put_global
             xs = jax.tree.map(lambda a: place(a, s), stacked)
@@ -520,7 +521,7 @@ class Trainer:
         every dispatch (docs/perf.md), so this is the single placement site
         benches rely on. `compiled(state, placed_batch)` runs with the
         jit-declared state donation."""
-        with jax.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh):
             batch = self._place(batch)
             batch = jax.jit(lambda t: jax.tree.map(lambda a: a + 0, t))(batch)
             compiled = self._fused_fn(n).lower(state, batch).compile()
@@ -848,7 +849,7 @@ class Trainer:
                 # labels may be token-level (B, L) — pad with the full shape
                 by = np.concatenate([by, np.zeros((pad, *by.shape[1:]), by.dtype)])
             w = (np.arange(bs) < n).astype(np.float32)
-            with jax.set_mesh(self.mesh):
+            with compat.set_mesh(self.mesh):
                 m = self._jit_eval_step(state, shard_batch((bx, by, w), self.mesh))
             tot_loss += float(m["loss_sum"])
             correct += float(m["correct"])

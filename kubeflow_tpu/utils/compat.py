@@ -1,0 +1,59 @@
+"""Version-portable jax shims — ONE place where API drift is absorbed.
+
+The repo targets a wide jax range (the CI image ships 0.4.37; TPU images
+ship 0.5-0.7): ``jax.set_mesh`` only exists from ~0.6, its predecessor
+``jax.sharding.use_mesh`` from ~0.5, and on 0.4.x the ambient mesh is the
+``with mesh:`` context manager. Every Trainer.fit path previously called
+``jax.set_mesh`` directly and failed WHOLESALE on 0.4.37 — resolve the
+fallback chain here, once, at import of the call site.
+
+Callers that cannot run under ANY resolution should skip with the
+carried reason instead of raising:
+
+    from kubeflow_tpu.utils.compat import MeshUnavailable, set_mesh
+    try:
+        with set_mesh(mesh):
+            ...
+    except MeshUnavailable as e:
+        pytest.skip(str(e))  # or emit a structured-skip record
+"""
+
+from __future__ import annotations
+
+
+class MeshUnavailable(RuntimeError):
+    """No ambient-mesh mechanism exists on this jax — carry the reason so
+    callers can skip-with-reason instead of crashing wholesale."""
+
+
+def _resolve():
+    import jax
+
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh, "jax.set_mesh"
+    use_mesh = getattr(jax.sharding, "use_mesh", None)
+    if use_mesh is not None:
+        return use_mesh, "jax.sharding.use_mesh"
+
+    # 0.4.x: Mesh IS a context manager — entering it sets the legacy
+    # ambient (physical) mesh, which is what with_sharding_constraint /
+    # pjit-with-PartitionSpec consulted before the set_mesh API existed
+    def _legacy(mesh):
+        if hasattr(mesh, "__enter__"):
+            return mesh
+        raise MeshUnavailable(
+            "this jax has no jax.set_mesh / jax.sharding.use_mesh and "
+            f"{type(mesh).__name__} is not a context manager "
+            "(AbstractMesh on 0.4.x?) — ambient mesh unavailable")
+
+    return _legacy, "legacy `with mesh:`"
+
+
+_SET_MESH, MESH_IMPL = _resolve()
+
+
+def set_mesh(mesh):
+    """Context manager making ``mesh`` ambient, on any supported jax.
+    Raises MeshUnavailable (with the reason) when this jax has no
+    equivalent for the given mesh object."""
+    return _SET_MESH(mesh)

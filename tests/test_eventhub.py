@@ -5,7 +5,12 @@ import queue
 import threading
 
 from kubeflow_tpu.api.common import ObjectMeta
-from kubeflow_tpu.controller.fakecluster import EventType, FakeCluster, Pod
+from kubeflow_tpu.controller.fakecluster import (
+    EventType,
+    FakeCluster,
+    Pod,
+    WatchClosed,
+)
 from kubeflow_tpu.native import EventHub
 
 
@@ -93,12 +98,15 @@ class TestWatchSubscription:
         assert all(e == EventType.ADDED for e in seen.values())
         c.unwatch(sub)
 
-    def test_closed_subscription_raises_empty(self):
+    def test_closed_subscription_raises_watch_closed(self):
+        # close() kills the stream for good: the distinct WatchClosed (not
+        # queue.Empty, which means "idle but live") is what lets informer
+        # loops resubscribe instead of polling a corpse forever
         c = FakeCluster()
         sub = c.watch()
         sub.close()
         try:
             sub.get(timeout=0.05)
-            raise AssertionError("expected queue.Empty")
-        except queue.Empty:
+            raise AssertionError("expected WatchClosed")
+        except WatchClosed:
             pass
