@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -46,6 +46,13 @@ test-prof:
 # (docs/architecture.md "Control-plane scaling")
 test-cplane:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_cplane.py -q -m cplane
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
+
+# serving-fleet suite: paged-KV prefix reuse, chunked-prefill equivalence,
+# router admission/shed + the seeded replica-kill drill, and the
+# serve_fleet cpu-proxy gate (docs/serving.md)
+test-fleet:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m fleet
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 native:

@@ -77,6 +77,12 @@ class Platform:
         self.metrics_server = None  # started on demand
         self.activator = None  # started on demand (serverless front door)
         self.tracer = None  # enabled on demand (start_tracing)
+        #: serving fleets (serving/fleet): "ns/name" -> FleetRouter.
+        #: register_fleet() adds one; /metrics aggregates kftpu_fleet_*
+        #: over this registry and the activator's queue-depth-aware pick
+        #: reads fleet_load_view (callable -> {endpoint url: load})
+        self.fleet_routers: dict[str, object] = {}
+        self.fleet_load_view = None
         # single registry: observability iterates THIS, so a new controller
         # can never silently fall out of /metrics
         self.controllers = {
@@ -130,6 +136,17 @@ class Platform:
         if self.tracer is not None:
             self.tracer.armed = False
 
+    def register_fleet(self, key: str, router, load_view=None):
+        """Attach a serving fleet (serving/fleet.FleetRouter) under
+        "namespace/name": its kftpu_fleet_* counters join /metrics, its
+        demand signal becomes autoscaler input, and `load_view` (callable
+        -> {endpoint url: load}) makes the activator's ready-endpoint
+        pick queue-depth-aware (docs/serving.md)."""
+        self.fleet_routers[key] = router
+        if load_view is not None:
+            self.fleet_load_view = load_view
+        return router
+
     def start_activator(self, port: int = 0,
                         host: str = "127.0.0.1") -> str:
         """Serverless front door for InferenceServices (Knative activator
@@ -163,6 +180,8 @@ class Platform:
         if self.activator is not None:
             self.activator.stop()
             self.activator = None
+        for router in self.fleet_routers.values():
+            router.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None

@@ -68,6 +68,57 @@ def render_metrics(platform) -> str:
         for mname, v in sorted(runtime_sb.metrics.items()):
             counter(f"kftpu_cplane_status_{mname}", v)
 
+    # serving fleet (kubeflow_tpu/serving/fleet, docs/serving.md):
+    # admission/shed/requeue accounting, queue+latency autoscaler signals,
+    # and the prefix-reuse ledger, aggregated over every registered
+    # router. Families render ZERO-valued on a fleetless platform so the
+    # golden exposition pins a stable surface (KFTPU-METRIC contract).
+    routers = list(getattr(platform, "fleet_routers", {}).values())
+    snaps = [r.snapshot() for r in routers]
+
+    def fleet_sum(field_):
+        return sum(s.get(field_, 0) for s in snaps)
+
+    for fam, field_, help_ in (
+        ("kftpu_fleet_requests_admitted_total", "requests_admitted_total",
+         "requests past the SLO admission gate"),
+        ("kftpu_fleet_requests_shed_total", "requests_shed_total",
+         "requests shed with 503 + Retry-After by admission control"),
+        ("kftpu_fleet_requests_requeued_total", "requests_requeued_total",
+         "in-flight requests requeued to a surviving replica"),
+        ("kftpu_fleet_requests_completed_total", "requests_completed_total",
+         None),
+        ("kftpu_fleet_requests_failed_total", "requests_failed_total",
+         None),
+        ("kftpu_fleet_replica_kills_total", "replica_kills_total", None),
+    ):
+        counter(fam, fleet_sum(field_), help_=help_)
+    prefill = reused = 0
+    for r in routers:
+        for rep in r.replicas:
+            prefill += rep.engine.prefill_tokens_total
+            reused += rep.engine.prefill_tokens_reused
+    counter("kftpu_fleet_prefill_tokens_total", prefill,
+            help_="prompt tokens the engines actually computed")
+    counter("kftpu_fleet_prefill_tokens_reused_total", reused,
+            help_="prompt tokens seeded from the paged-KV prefix pool")
+    for fam, field_, help_ in (
+        ("kftpu_fleet_queue_depth", "queue_depth",
+         "queued + in-flight requests across live replicas"),
+        ("kftpu_fleet_pending_tokens", "pending_tokens",
+         "token backlog (queued prompts + in-flight budgets)"),
+        ("kftpu_fleet_replicas_alive", "replicas_alive", None),
+        ("kftpu_fleet_demand_replicas", "demand_replicas",
+         "autoscaler demand signal from the queue/latency view"),
+    ):
+        gauge(fam, fleet_sum(field_), help_=help_)
+    for q, field_ in (("0.5", "ttft_p50_s"), ("0.99", "ttft_p99_s")):
+        gauge("kftpu_fleet_ttft_seconds",
+              max((s.get(field_, 0.0) for s in snaps), default=0.0),
+              help_="time-to-first-token quantiles over the fleet's "
+                    "sample window",
+              labels=f'{{quantile="{q}"}}')
+
     # liveness layer (kubeflow_tpu/health.py): lease expiries and straggler
     # declarations counted apart from crash deaths, plus per-incarnation
     # heartbeat age straight from the kubelet layer's side table

@@ -23,6 +23,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from kubeflow_tpu.utils import compat
+
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_MODEL = "model"
@@ -163,7 +165,7 @@ def in_manual_region() -> bool:
     depend on every manual-region author remembering the marker."""
     if _IN_MANUAL_REGION.get():
         return True
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh.empty:
         return False
     try:

@@ -46,6 +46,7 @@ except Exception:  # pragma: no cover
     pl = None
     pltpu = None
 
+from kubeflow_tpu.utils import compat
 from kubeflow_tpu.parallel.mesh import (
     AXIS_CONTEXT,
     AXIS_DATA,
@@ -76,11 +77,11 @@ BIAS_SPEC = P(BATCH_AXES, None, None, AXIS_CONTEXT)
 
 
 def _context_size() -> int:
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh.empty:
         try:  # eager path; raises inside jit, where abstract mesh is set
             mesh = jax.sharding.get_mesh()
-        except ValueError:
+        except (ValueError, AttributeError):  # 0.4.x has no get_mesh
             return 1
     if mesh.empty or AXIS_CONTEXT not in mesh.shape:
         return 1
@@ -526,7 +527,7 @@ def ulysses_attention(q, k, v, bias, dropout_rng=None, dropout_rate=0.0,
             q, k = _rope_qk(q, k, jnp.arange(q.shape[1]), rope_theta)
         return blockwise_attention(q, k, v, bias, block, causal=causal,
                                    window=window)
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     model = mesh.shape.get(AXIS_MODEL, 1)
     heads = q.shape[2]
     if (heads // model) % ctx:

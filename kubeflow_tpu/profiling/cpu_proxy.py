@@ -76,6 +76,22 @@ def _best_of(fn, gated_phase: str, runs: int = 2) -> dict:
     return best
 
 
+def _min_phases(fn, phases: tuple[str, ...], runs: int = 2) -> dict:
+    """Per-PHASE min over `runs` runs (the mlp_train rationale applied
+    across whole-workload repetitions): each timing phase lands at its
+    own noise floor. Count phases are deterministic and identical across
+    runs, so taking the first record for everything else is exact."""
+    recs = [fn() for _ in range(runs)]
+    best = recs[0]
+    for rec in recs[1:]:
+        for p in phases:
+            if rec["rel"][p] < best["rel"][p]:
+                best["rel"][p] = rec["rel"][p]
+                if p in rec.get("phases_s", {}):
+                    best["phases_s"][p] = rec["phases_s"][p]
+    return best
+
+
 # ------------------------------------------------------------- mlp_train
 
 
@@ -228,18 +244,6 @@ def serve_ticks(rows: int = 4, n_requests: int = 6, prompt_len: int = 12,
     per-dispatch engine time (scheduling + splice + decode step) in units
     of a fixed jit matmul — the serving analogue of the step breakdown."""
     import jax
-
-    if not hasattr(jax.sharding, "get_abstract_mesh"):
-        # same version gap that fails Trainer.fit in tier-1 (jax 0.4.x):
-        # the GPT/serving model path needs the newer mesh API. A skipped
-        # record is emitted (and excluded from gating) rather than a
-        # crash, so the other proxies keep their teeth on this jax.
-        return {
-            "workload": "serve_ticks",
-            "skipped": "jax lacks jax.sharding.get_abstract_mesh "
-                       "(GPT/serving path needs newer jax)",
-            "phases_s": {}, "rel": {},
-        }
     import jax.numpy as jnp
     import numpy as np
 
@@ -305,6 +309,172 @@ def _calibration_unit() -> float:
         samples.append(time.perf_counter() - t0)
     _CALIBRATION_UNIT = _median(samples)
     return _CALIBRATION_UNIT
+
+
+# ------------------------------------------------------------ serve_fleet
+
+
+def _arm_decode_chaos(engines, repeats: int) -> None:
+    """KFTPU_PROF_CHAOS="decode_tick:N": repeat each engine's per-tick
+    device dispatches (decode scan + prefill chunk) N times — work
+    repeated, never slept, so the injection scales with the machine
+    exactly like a real engine regression. The calibration anchor does
+    NOT pass through these wrappers, so the gate's teeth bite."""
+    if repeats <= 1:
+        return
+    import jax
+
+    def wrap(fn):
+        def run(*args, **kwargs):
+            # pure jitted calls: same inputs, state unchanged. Each call
+            # is SERIALIZED (block before the next dispatch) — XLA's CPU
+            # client otherwise executes the independent duplicates on
+            # idle pool threads in parallel and the injected work
+            # disappears from the wall clock.
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            for _ in range(repeats - 1):
+                jax.block_until_ready(fn(*args, **kwargs))
+            return out
+        return run
+
+    for eng in engines:
+        eng._step = wrap(eng._step)
+        eng._apply_chunk = wrap(eng._apply_chunk)
+
+
+def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
+                prompt_len: int = 12, shared_prefix: int = 8,
+                new_tokens: int = 6, block: int = 4, chunk: int = 4,
+                seed: int = 5) -> dict:
+    """The fleet drill as a perf workload (docs/serving.md): N replica
+    engines sharing one paged-KV pool behind the router, seeded open-loop
+    tick-driven load with a mid-run replica kill. Everything the timed
+    phase does is engine work, so arrivals/kill scheduled in TICK units
+    make the TTFT-over-anchor ratio machine-speed invariant. Gated:
+
+      - ttft_p99     p99 TTFT in calibration-matmul units (the serving
+                     latency SLO, with the kill's requeue cost inside it)
+      - reuse_computed_frac   computed prefill tokens / total prefill
+                     positions during the load phase — a COUNT ratio; a
+                     prefix-reuse regression drives it toward 1.0
+      - dropped      requests lost across the replica kill — budget 0;
+                     the zero-drop requeue contract, gated
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+    from kubeflow_tpu.serving.fleet import (
+        FleetRouter,
+        PagedKVPool,
+        make_prompts,
+        run_loadtest_sync,
+    )
+
+    repeats = chaos_repeats("decode_tick")
+    window = 40  # steady-state decode ticks in the dedicated window
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=2, mlp_dim=128, dropout_rate=0.0,
+                    max_len=prompt_len + new_tokens + window + 12)
+    model = GPTLM(cfg)
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, prompt_len), jnp.int32))
+    pool = PagedKVPool(block_size=block, capacity_blocks=512)
+    engines = [
+        ContinuousBatcher(model, variables, max_rows=rows,
+                          default_max_new_tokens=new_tokens,
+                          paged_kv=pool, prefill_chunk=chunk)
+        for _ in range(replicas)
+    ]
+    _arm_decode_chaos(engines, repeats)
+    router = FleetRouter(engines)
+    # make_prompts' prompt_len is the BODY length; the shared prefix
+    # prepends, so total = prompt_len (the configured budget)
+    body_len = prompt_len - shared_prefix
+    prompts = make_prompts(n_requests, seed=seed, vocab=cfg.vocab_size,
+                           prompt_len=body_len,
+                           shared_prefix=shared_prefix)
+    # warmup OUTSIDE the timed window: compile every executable the load
+    # phase dispatches (chunk prefill, decode step, splice, first-token
+    # pick) on every replica — the gate measures serving, not XLA
+    warm = make_prompts(replicas, seed=seed + 1, vocab=cfg.vocab_size,
+                        prompt_len=body_len,
+                        shared_prefix=shared_prefix)
+    for eng, w in zip(engines, warm):
+        eng.submit(w, max_new_tokens=2)
+        eng.run_until_idle()
+        # second pass with the SAME prompt: full pool match -> suffix-1
+        # prefill — the shape a post-kill requeue dispatches (its blocks
+        # are already pooled). Without this, the requeued request pays a
+        # chunk-1 compile INSIDE the timed phase and owns p99.
+        eng.submit(w, max_new_tokens=2)
+        eng.run_until_idle()
+    import gc
+
+    gc.collect()
+    report = run_loadtest_sync(
+        router, prompts, seed=seed, mean_gap_ticks=0.6,
+        new_tokens=new_tokens, kill_at_tick=8, kill_replica=1)
+    summary = report.summary()
+    # the report's prefill ledger is a per-run DELTA (warmup excluded)
+    computed = report.prefill_tokens_total
+    reused = report.prefill_tokens_reused
+    # steady-state decode window on the survivors: fill every row, let
+    # the chunked admissions complete, then time `window` round-robin
+    # passes of IDENTICAL decode work. The mean over identical ticks is
+    # far less noisy than a p99 sample — this phase is what gives the
+    # decode_tick chaos its teeth, while ttft_p99 pins the latency SLO.
+    alive = [r.engine for r in router.replicas if r.alive]
+    steady = [eng.submit(p, max_new_tokens=window + 8)
+              for eng in alive for p in make_prompts(
+                  rows, seed=seed + 2, vocab=cfg.vocab_size,
+                  prompt_len=body_len, shared_prefix=shared_prefix)]
+    for _ in range(rows * (prompt_len // chunk + 2)):
+        for eng in alive:
+            eng.tick()
+        if all(not e._pending and all(e._rows) for e in alive):
+            break
+    gc.collect()
+    t0 = time.perf_counter()
+    for _ in range(window):
+        for eng in alive:
+            eng.tick()
+    decode_tick = (time.perf_counter() - t0) / window
+    for eng in alive:  # drain the window rows untimed
+        eng.run_until_idle()
+    assert all(h.done.is_set() for h in steady)
+    unit = _calibration_unit()
+    ttft_p99 = summary["ttft_p99_s"]
+    return {
+        "workload": "serve_fleet",
+        "replicas": replicas,
+        "requests": n_requests,
+        "completed": summary["completed"],
+        "dropped_count": summary["dropped"],
+        "requeued": summary["requeued"],
+        "replica_killed": True,
+        "ticks": report.ticks,
+        "prefill_tokens_computed": computed,
+        "prefill_tokens_reused": reused,
+        "anchor": "matmul_unit",
+        "anchor_s": round(unit, 6),
+        "phases_s": {"ttft_p50": summary["ttft_p50_s"],
+                     "ttft_p99": ttft_p99,
+                     "decode_tick": round(decode_tick, 6)},
+        "rel": {
+            "ttft_p99": round(ttft_p99 / unit, 4) if unit else 0.0,
+            "decode_tick": round(decode_tick / unit, 4) if unit else 0.0,
+            # COUNT ratios — machine-invariant by construction
+            "reuse_computed_frac": round(
+                computed / max(computed + reused, 1), 4),
+            "dropped": summary["dropped"],
+        },
+        "tokens_per_s_total": summary["tokens_per_s_total"],
+    }
 
 
 # -------------------------------------------------------- reconcile_storm
@@ -647,7 +817,8 @@ def cplane_storm(n_pods: int = 10000, gang_size: int = 100,
 
 # ----------------------------------------------------------------- harness
 
-WORKLOADS = ("mlp_train", "serve_ticks", "reconcile_storm", "cplane_storm")
+WORKLOADS = ("mlp_train", "serve_ticks", "serve_fleet",
+             "reconcile_storm", "cplane_storm")
 
 
 def run_all(only: str = "") -> list[dict]:
@@ -656,6 +827,8 @@ def run_all(only: str = "") -> list[dict]:
     fns = {
         "mlp_train": mlp_train,  # per-phase min-of-2 internally
         "serve_ticks": serve_ticks,
+        "serve_fleet": lambda: _min_phases(
+            serve_fleet, ("ttft_p99", "decode_tick")),
         "reconcile_storm": lambda: _best_of(reconcile_storm,
                                             "reconcile_p50"),
         "cplane_storm": lambda: _best_of(cplane_storm, "to_running"),
@@ -684,9 +857,17 @@ def make_budgets(results: list[dict]) -> dict:
             "max_ratio": DEFAULT_MAX_RATIO,
             # the engine tick mixes python scheduling with jit dispatch —
             # its anchor (a bare matmul) tracks it less tightly than the
-            # in-run anchors, so it gets a looser multiplier
+            # in-run anchors, so it gets a looser multiplier. serve_fleet:
+            # ttft_p99 must stay under the decode_tick:2 chaos multiplier
+            # (~1.8x, the dispatch fraction of a tick) or the teeth
+            # wouldn't bite; the count ratios are exact and get tight
+            # multipliers (dropped gates on the +0.08 slack alone: any
+            # drop is a violation).
             "ratios": ({"tick": 3.0}
-                       if rec["workload"] == "serve_ticks" else {}),
+                       if rec["workload"] == "serve_ticks" else
+                       {"ttft_p99": 1.4, "decode_tick": 1.2,
+                        "reuse_computed_frac": 1.25, "dropped": 1.0}
+                       if rec["workload"] == "serve_fleet" else {}),
         }
         if rec["workload"] == "cplane_storm":
             # the acceptance record: this tree's throughput next to the

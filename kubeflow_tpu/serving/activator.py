@@ -23,7 +23,12 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubeflow_tpu.analysis.lockcheck import make_lock
-from kubeflow_tpu.utils.retry import BackoffPolicy, Deadline, poll_until
+from kubeflow_tpu.utils.retry import (
+    BackoffPolicy,
+    Deadline,
+    backoff_sleep,
+    poll_until,
+)
 
 #: annotation the activator stamps (epoch seconds) when a request arrives
 #: for a scaled-to-zero service; the controller reads it as demand
@@ -37,11 +42,19 @@ COLD_START_POLL = BackoffPolicy(
     base_s=0.02, max_s=0.3, multiplier=2.0, jitter=0.5
 )
 
+#: proxy retry after a replica died between probe and proxy: bounded
+#: attempts under the shared jittered policy, every sleep clamped to the
+#: REQUEST deadline (the hand-rolled single retry this replaces could
+#: neither back off nor take a second bite at a flapping fleet)
+PROXY_RETRY = BackoffPolicy(
+    base_s=0.02, max_s=0.5, multiplier=2.0, jitter=0.5, max_attempts=3
+)
+
 
 class Activator:
     def __init__(self, platform, port: int = 0, host: str = "127.0.0.1",
                  activation_timeout_s: float = 45.0,
-                 retry_after_s: float = 10.0):
+                 retry_after_s: float = 10.0, load_view=None):
         self.platform = platform
         self.host = host
         self.port = port
@@ -50,6 +63,11 @@ class Activator:
         #: connection (and its server thread) forever
         self.activation_timeout_s = activation_timeout_s
         self.retry_after_s = retry_after_s
+        #: optional queue-depth view: callable() -> {endpoint url: load}
+        #: (the fleet router's load_view mapped to urls — docs/serving.md).
+        #: With a view, ready-endpoint picks go least-loaded instead of
+        #: round-robin; falls back to platform.fleet_load_view when unset.
+        self.load_view = load_view
         self._httpd: ThreadingHTTPServer | None = None
         self._rr: dict[str, int] = {}
         self._rr_mu = make_lock("activator.Activator._rr_mu")
@@ -58,20 +76,40 @@ class Activator:
 
     # ------------------------------------------------------------- routing
 
+    def _least_loaded(self, urls: list[str], n: int) -> str | None:
+        """Queue-depth-aware pick: the endpoint with the smallest load in
+        the router's view; unknown endpoints count as load 0 (fresh
+        replicas attract traffic). Ties break by the rr counter so equal
+        loads still spread."""
+        view = self.load_view or getattr(
+            self.platform, "fleet_load_view", None)
+        if view is None or not urls:
+            return None
+        try:
+            loads = view()
+        except Exception:  # noqa: BLE001 — a broken view must not 500 the
+            return None    # request path; fall back to round-robin
+        ranked = sorted(urls, key=lambda u: loads.get(u, 0))
+        floor = loads.get(ranked[0], 0)
+        tied = [u for u in ranked if loads.get(u, 0) == floor]
+        return tied[n % len(tied)]
+
     def _pick_endpoint(self, isvc) -> str | None:
-        """Weighted round-robin: canary endpoints receive
-        canaryTrafficPercent of requests when both sets are ready."""
+        """Canary-weighted pick over ready endpoints: canary endpoints
+        receive canaryTrafficPercent of requests when both sets are
+        ready; within a set the pick is least-loaded when a fleet load
+        view is wired, round-robin otherwise."""
         primary = [e.url for e in isvc.status.endpoints if e.ready]
         canary = [e.url for e in isvc.status.canary_endpoints if e.ready]
         key = f"{isvc.metadata.namespace}/{isvc.metadata.name}"
         with self._rr_mu:
             n = self._rr[key] = self._rr.get(key, -1) + 1
         pct = isvc.spec.canary_traffic_percent
-        if canary and pct > 0 and (primary == [] or (n % 100) < pct):
-            return canary[n % len(canary)]
-        if primary:
-            return primary[n % len(primary)]
-        return None
+        pool = (canary if canary and pct > 0
+                and (primary == [] or (n % 100) < pct) else primary)
+        if not pool:
+            return None
+        return self._least_loaded(pool, n) or pool[n % len(pool)]
 
     def _signal_demand(self, key: str) -> None:
         def stamp(isvc):
@@ -174,18 +212,20 @@ class Activator:
             except (urllib.error.URLError, OSError):
                 return None  # transport failure — caller decides
 
-        out = proxy(url)
-        if out is not None:
-            return out
-        # replica died between probe and proxy: one retry through the
-        # cold-start wait, still bounded by the SAME request deadline
-        # (self-heal will restore it)
-        retry = self._await_endpoint(key, deadline)
-        if retry is None:
-            return self._unavailable("no ready replica")
-        out = proxy(retry)
-        if out is not None:
-            return out
+        # replica died between probe and proxy: bounded retries on the
+        # shared BackoffPolicy, every sleep AND every re-pick clamped to
+        # the SAME request deadline (self-heal will restore the replica;
+        # the fleet load view keeps re-picks off the corpse's queue)
+        for attempt in range(PROXY_RETRY.max_attempts + 1):
+            out = proxy(url)
+            if out is not None:
+                return out
+            if attempt >= PROXY_RETRY.max_attempts or deadline.expired():
+                break
+            backoff_sleep(PROXY_RETRY, attempt, deadline=deadline)
+            url = self._await_endpoint(key, deadline)
+            if url is None:
+                return self._unavailable("no ready replica")
         return 502, b'{"error": "replica unreachable"}', \
             "application/json", {}
 

@@ -12,6 +12,7 @@ import itertools
 import json
 import urllib.error
 import urllib.request
+from dataclasses import dataclass
 
 from kubeflow_tpu.serving.api import InferenceService, validate_isvc
 from kubeflow_tpu.serving.controller import ISVC_LABEL
@@ -21,6 +22,21 @@ from kubeflow_tpu.utils.retry import (
     hinted_sleep,
     poll_until,
 )
+
+
+@dataclass
+class RequestTiming:
+    """Per-request accounting predict_timed returns (load-test harness
+    input): wall_s (dial to response, INCLUDING 503 re-dial waits),
+    ttft_s (engine-reported when streaming, else wall), tokens_per_s
+    (engine-reported aggregate decode rate, None for non-streaming
+    models), attempts and retry_wait_s (the Retry-After budget path)."""
+
+    wall_s: float
+    ttft_s: float
+    tokens_per_s: float | None
+    attempts: int
+    retry_wait_s: float
 
 
 class ServingClient:
@@ -132,13 +148,17 @@ class ServingClient:
     #: must not park a client for minutes
     RETRY_AFTER_CAP_S = 30.0
 
-    def _post(self, url: str, payload: dict, timeout_s: float) -> dict:
+    def _post(self, url: str, payload: dict, timeout_s: float,
+              stats: dict | None = None) -> dict:
         # timeout_s bounds the WHOLE call — dials, advertised waits, and
         # redials all draw from one budget, so a caller's 2s request can
-        # never be parked for minutes by a server hinting Retry-After: 30
+        # never be parked for minutes by a server hinting Retry-After: 30.
+        # `stats` (predict_timed) collects attempts/hinted-wait accounting.
         data = json.dumps(payload).encode()
         deadline = Deadline(timeout_s)
         for attempt in range(self.RETRY_AFTER_MAX_RETRIES + 1):
+            if stats is not None:
+                stats["attempts"] = attempt + 1
             remaining = deadline.remaining(floor=0.01)
             req = urllib.request.Request(
                 url, data=data,
@@ -166,6 +186,10 @@ class ServingClient:
                         # surface the 503 now instead of overshooting
                         if hinted_sleep(delay, cap_s=self.RETRY_AFTER_CAP_S,
                                         deadline=deadline):
+                            if stats is not None:
+                                stats["retry_wait_s"] = stats.get(
+                                    "retry_wait_s", 0.0) + min(
+                                    delay, self.RETRY_AFTER_CAP_S)
                             continue
                 raise RuntimeError(
                     f"HTTP {exc.code} from {url}: {detail}") from exc
@@ -179,6 +203,37 @@ class ServingClient:
         base = self._endpoint(name, namespace)
         return self._post(
             f"{base}/v1/models/{name}:predict", {"instances": instances}, timeout_s
+        )
+
+    def predict_timed(
+        self, name: str, instances: list, namespace: str = "default",
+        timeout_s: float = 30.0,
+    ) -> tuple[dict, "RequestTiming"]:
+        """Streaming-aware predict: (response, RequestTiming). TTFT and
+        tokens/sec come from the SERVER's per-request engine timestamps
+        when an engine/fleet serves the model (the response's "timing"
+        block — serving/server.py); a model without streaming falls back
+        to HTTP wall time. 503 + Retry-After re-dials ride the same
+        budgeted `_post` path, and their count/wait land in the timing —
+        the load-test harness charges shed-then-retry latency to the
+        request, not to nobody."""
+        import time as _time
+
+        base = self._endpoint(name, namespace)
+        stats: dict = {}
+        t0 = _time.perf_counter()
+        out = self._post(
+            f"{base}/v1/models/{name}:predict", {"instances": instances},
+            timeout_s, stats=stats)
+        wall = _time.perf_counter() - t0
+        timing = out.get("timing") or {}
+        ttft = timing.get("ttft_s")
+        return out, RequestTiming(
+            wall_s=wall,
+            ttft_s=wall if ttft is None else ttft,
+            tokens_per_s=timing.get("tokens_per_s"),
+            attempts=stats.get("attempts", 1),
+            retry_wait_s=stats.get("retry_wait_s", 0.0),
         )
 
     def infer(

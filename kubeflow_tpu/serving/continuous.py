@@ -47,6 +47,7 @@ are refused (hazards documented at the guards).
 from __future__ import annotations
 
 import threading
+import time
 
 from kubeflow_tpu.analysis.lockcheck import make_lock
 from dataclasses import dataclass, field
@@ -77,6 +78,43 @@ class _InFlight:
     tokens: list = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     error: str | None = None
+    # streaming/timing surface (the fleet tier and the load-test harness
+    # read these): submit/first-token/done timestamps plus optional
+    # callbacks — on_token(req, tok) per emitted token, on_done(req) once
+    # at retire OR failure. Callbacks run on the ENGINE thread: keep them
+    # cheap and never call back into this engine under its lock.
+    t_submit: float = 0.0
+    t_first: float | None = None
+    t_done: float | None = None
+    on_token: object = None
+    on_done: object = None
+
+    def push(self, tok: int) -> None:
+        """Engine-side token emission — the ONE append path, so TTFT is
+        stamped exactly when the first token exists."""
+        if not self.tokens:
+            self.t_first = time.perf_counter()
+        self.tokens.append(tok)
+        if self.on_token is not None:
+            self.on_token(self, tok)
+
+    def finish(self, error: str | None = None) -> None:
+        self.error = error if self.error is None else self.error
+        self.t_done = time.perf_counter()
+        self.done.set()
+        if self.on_done is not None:
+            self.on_done(self)
+
+    @property
+    def ttft_s(self) -> float | None:
+        return None if self.t_first is None else self.t_first - self.t_submit
+
+    @property
+    def tokens_per_s(self) -> float | None:
+        if self.t_first is None or self.t_done is None:
+            return None
+        dt = self.t_done - self.t_first
+        return len(self.tokens) / dt if dt > 0 else float("inf")
 
     def result(self, timeout: float | None = None) -> np.ndarray:
         if not self.done.wait(timeout):
@@ -84,6 +122,20 @@ class _InFlight:
         if self.error is not None:
             raise RuntimeError(f"generation failed: {self.error}")
         return np.asarray(self.tokens, np.int32)
+
+
+@dataclass
+class _PendingPrefill:
+    """A seated row whose prompt is still prefilling (chunked admission):
+    the batch-1 row cache being built, the next position to compute, and
+    the pool refs backing any reused prefix."""
+
+    req: _InFlight
+    ids: np.ndarray
+    pos: int
+    cache: object
+    last_logits: object = None
+    match_refs: list = field(default_factory=list)
 
 
 class ContinuousBatcher:
@@ -101,8 +153,40 @@ class ContinuousBatcher:
                  eos_token_id=None, top_k: int = 0,
                  seed: int = 0, steps_per_tick: int = 1,
                  prefill_buckets: tuple[int, ...] | None = None,
-                 draft_module=None, draft_variables=None, gamma: int = 4):
+                 draft_module=None, draft_variables=None, gamma: int = 4,
+                 prefill_chunk: int = 0, paged_kv=None):
         cfg = module.cfg
+        # chunked prefill (prefill_chunk > 0): long prompts admit in
+        # fixed-token chunks interleaved with decode ticks — at most ONE
+        # chunk of prefill work per tick, so a 4k-token prompt never
+        # stalls in-flight decode rows more than one chunk budget. The
+        # per-row block-write path (models/gpt.py vmapped
+        # dynamic_update_slice at each row's cache_index) makes the
+        # chunked cache identical to a one-shot prefill's, so the first
+        # token — and every token after it — is token-identical.
+        # paged_kv (fleet.PagedKVPool): prefix reuse at admission — the
+        # pool's matched prefix K/V seeds the row cache and only the
+        # suffix runs through the model (docs/serving.md).
+        self.prefill_chunk = int(prefill_chunk)
+        self.paged_kv = paged_kv
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}")
+        if self.prefill_chunk or paged_kv is not None:
+            what = ("prefill_chunk" if self.prefill_chunk else "paged_kv")
+            if draft_module is not None:
+                raise ValueError(
+                    f"{what} does not compose with the speculative engine "
+                    "yet: the draft cache would need the same chunked/"
+                    "seeded admission")
+            if prefill_buckets is not None:
+                raise ValueError(
+                    f"{what} replaces bucketed prefill — the chunk walk "
+                    "already bounds the executable count; configure one")
+            if getattr(cfg, "kv_cache_capacity", 0):
+                raise ValueError(
+                    f"{what} requires the full KV cache: ring-slot "
+                    "identity is ambiguous for seeded/partial prefixes")
         # MoE models are row-independent at decode since the decode path
         # routes DROPLESS (parallel/moe.py, VERDICT r4 #6): no capacity,
         # no cross-row drop coupling — so the engine serves them exactly.
@@ -190,6 +274,18 @@ class ContinuousBatcher:
             variables, jnp.zeros((self.max_rows, 1), jnp.int32),
             decode=True, mutable=["cache"])
         self._cache = cache["cache"]
+        # chunked/seeded admission state: slot -> in-progress prefill;
+        # ticker-private like _rows. _row_blocks holds the paged pool refs
+        # a DECODING row still pins (released at retire).
+        self._pending: dict[int, _PendingPrefill] = {}
+        self._row_blocks: dict[int, list] = {}
+        self._chunk_order: list[int] = []  # FIFO of pending slots
+        self._chunk_fns: dict[int, object] = {}  # suffix len -> jitted
+        self._row_template = None  # lazy batch-1 np zero cache twin
+        #: prefill-unit accounting (the prefix-reuse proof reads these):
+        #: tokens the model actually computed vs tokens seeded for free
+        self.prefill_tokens_total = 0
+        self.prefill_tokens_reused = 0
         if draft_module is not None:
             _, dcache = draft_module.apply(
                 draft_variables, jnp.zeros((self.max_rows, 1), jnp.int32),
@@ -373,7 +469,7 @@ class ContinuousBatcher:
 
     def submit(self, prompt_ids, max_new_tokens: int | None = None,
                eos_token_id=None, temperature: float = 0.0,
-               key=None) -> _InFlight:
+               key=None, on_token=None, on_done=None) -> _InFlight:
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         budget = int(max_new_tokens or self.default_max_new_tokens)
         if ids.size < 1:
@@ -421,7 +517,9 @@ class ContinuousBatcher:
                             eos_token_id=(self.eos_token_id
                                           if eos_token_id is None
                                           else _eos_tuple(eos_token_id)),
-                            temperature=float(temperature), key=key)
+                            temperature=float(temperature), key=key,
+                            t_submit=time.perf_counter(),
+                            on_token=on_token, on_done=on_done)
             self._queue.append((ids, req))
         return req
 
@@ -475,10 +573,111 @@ class ContinuousBatcher:
             fn = self._draft_prefill_cache[ids.size] = jax.jit(prefill)
         return fn(ids[None, :])
 
+    # -------------------------------------------- chunked/seeded prefill
+
+    def _apply_chunk(self, cache, chunk: np.ndarray):
+        """One prefill chunk through the model on a batch-1 row cache:
+        (last-position logits, advanced cache). Jitted per chunk length —
+        with prefill_chunk set the executable count is bounded by
+        chunk + remainder lengths, the production compile-cache story
+        bucketed prefill approximated."""
+        fn = self._chunk_fns.get(chunk.size)
+        if fn is None:
+            def apply(cache, x):
+                logits, new = self.module.apply(
+                    {**self.variables, "cache": cache}, x,
+                    decode=True, mutable=["cache"])
+                return logits[:, -1], new["cache"]
+            fn = self._chunk_fns[chunk.size] = jax.jit(apply)
+        return fn(cache, chunk[None, :])
+
+    def _row_cache_template(self):
+        from kubeflow_tpu.serving.fleet.pagedkv import make_row_template
+
+        if self._row_template is None:
+            self._row_template = make_row_template(self._cache)
+        return self._row_template
+
+    def _begin_prefill(self, slot: int, ids: np.ndarray,
+                       req: _InFlight) -> None:
+        """Seat a row on the chunked/seeded admission path: reuse any
+        pooled prefix, then either finish the suffix now (prefill_chunk
+        == 0) or leave the row pending for chunk-per-tick advancement."""
+        from kubeflow_tpu.serving.fleet.pagedkv import seed_row_cache
+
+        template = self._row_cache_template()
+        cache = None
+        pos, refs = 0, []
+        if self.paged_kv is not None:
+            m = self.paged_kv.match(ids)
+            # at least one position must run through the model — the row
+            # needs the last position's logits to pick its first token
+            pos = min(m.length, ids.size - 1)
+            if pos > 0:
+                # seed_row_cache copies every leaf itself — seeding from
+                # the template directly spares the hot reuse path a whole
+                # wasted row-cache memcpy per admission
+                cache = seed_row_cache(template, m.kv, pos)
+                refs = m.blocks
+                self.prefill_tokens_reused += pos
+            elif m.blocks:
+                self.paged_kv.release(m.blocks)
+        if cache is None:
+            # leaves are np arrays: fresh copy per admission
+            cache = jax.tree.map(np.copy, template)
+        pend = _PendingPrefill(req=req, ids=ids, pos=pos, cache=cache,
+                               match_refs=refs)
+        self._pending[slot] = pend
+        self._chunk_order.append(slot)
+        if not self.prefill_chunk:
+            while slot in self._pending:  # suffix in one pass
+                self._advance_prefill(slot)
+
+    def _advance_prefill(self, slot: int) -> None:
+        """Run ONE chunk (or the whole remaining suffix when chunking is
+        off) of a pending row's prompt; completes admission when the last
+        position's logits exist."""
+        pend = self._pending[slot]
+        take = (len(pend.ids) - pend.pos if not self.prefill_chunk
+                else min(self.prefill_chunk, len(pend.ids) - pend.pos))
+        chunk = pend.ids[pend.pos:pend.pos + take]
+        pend.last_logits, pend.cache = self._apply_chunk(pend.cache, chunk)
+        pend.pos += take
+        self.prefill_tokens_total += take
+        if pend.pos >= len(pend.ids):
+            self._finish_prefill(slot)
+
+    def _finish_prefill(self, slot: int) -> None:
+        """Admission completes: publish the prompt's K/V to the paged
+        pool, splice the row cache into the live batch, emit the first
+        token."""
+        pend = self._pending.pop(slot)
+        self._chunk_order.remove(slot)
+        req = pend.req
+        if self.paged_kv is not None:
+            from kubeflow_tpu.serving.fleet.pagedkv import extract_prompt_kv
+
+            kv = extract_prompt_kv(pend.cache, len(pend.ids))
+            held = self.paged_kv.insert(pend.ids, kv)
+            # insert's refs cover (and extend) the admission match's
+            self.paged_kv.release(pend.match_refs)
+            self._row_blocks[slot] = held
+        self._cache = self._splice(
+            self._cache, pend.cache, jnp.int32(slot))
+        first = self._pick_first(
+            pend.last_logits[0], req.temperature,
+            jax.random.fold_in(req.key, 0))
+        req.push(int(first))
+        self._toks[slot] = int(first)
+        if self._finished(req):
+            self._retire(slot)
+
     def _retire(self, slot: int) -> None:
         req = self._rows[slot]
         self._rows[slot] = None
-        req.done.set()
+        if self.paged_kv is not None:
+            self.paged_kv.release(self._row_blocks.pop(slot, []))
+        req.finish()
 
     def tick(self) -> bool:
         """One scheduling round: admit queued prompts into free rows, then
@@ -490,6 +689,7 @@ class ContinuousBatcher:
         guards ONLY the shared queue, so submit() from request threads
         never waits behind device dispatches."""
         # ---- admission: prefill into free rows ---------------------------
+        chunked = self.prefill_chunk > 0 or self.paged_kv is not None
         for slot in range(self.max_rows):
             if self._rows[slot] is not None:
                 continue
@@ -501,7 +701,13 @@ class ContinuousBatcher:
             # the request in _rows so _fail_all unblocks its caller
             req.slot = slot
             self._rows[slot] = req
+            if chunked:
+                # chunked/seeded path: pooled prefix reuse + (with
+                # prefill_chunk) chunk-per-tick interleaving below
+                self._begin_prefill(slot, ids, req)
+                continue
             last_logits, row_cache = self._prefill(ids)
+            self.prefill_tokens_total += ids.size
             self._cache = self._splice(
                 self._cache, row_cache, jnp.int32(slot))
             if self.draft_module is not None:
@@ -511,15 +717,22 @@ class ContinuousBatcher:
             first = self._pick_first(
                 last_logits[0], req.temperature,
                 jax.random.fold_in(req.key, 0))
-            req.tokens.append(int(first))
+            req.push(int(first))
             self._toks[slot] = int(first)
             # the prefill's first token may already finish the row
             if self._finished(req):
                 self._retire(slot)
-        active = np.array([r is not None for r in self._rows])
+        # ---- chunked prefill: ONE chunk per tick, FIFO over pending rows,
+        # so admission work interleaves with — never starves — the decode
+        # dispatch below (the one-chunk-budget stall bound)
+        if self._chunk_order:
+            self._advance_prefill(self._chunk_order[0])
+        active = np.array(
+            [r is not None and s not in self._pending
+             for s, r in enumerate(self._rows)])
         if not active.any():
             with self._lock:
-                return bool(self._queue)
+                return bool(self._queue) or bool(self._pending)
         if self.draft_module is not None:
             return self._spec_tick(active)
         # ---- T decode steps for every in-flight row ----------------------
@@ -534,10 +747,10 @@ class ContinuousBatcher:
         self.step_count += 1  # dispatches (the scheduling metric)
         out = np.asarray(out)  # (T, R)
         for slot, req in enumerate(self._rows):
-            if req is None:
-                continue
+            if req is None or slot in self._pending:
+                continue  # pending rows decoded garbage; discard
             for j in range(out.shape[0]):
-                req.tokens.append(int(out[j, slot]))
+                req.push(int(out[j, slot]))
                 self._toks[slot] = int(out[j, slot])
                 if self._finished(req):
                     self._retire(slot)  # discard the scan tail
@@ -564,7 +777,7 @@ class ContinuousBatcher:
                 continue
             self._depths[slot] += int(a[slot]) + 1
             for j in range(int(a[slot]) + 1):
-                req.tokens.append(int(upd[slot, j]))
+                req.push(int(upd[slot, j]))
                 self._toks[slot] = int(upd[slot, j])
                 if self._finished(req):
                     self._retire(slot)  # discard the round's tail
@@ -622,9 +835,16 @@ class ContinuousBatcher:
         with self._lock:
             queued = [req for _, req in self._queue]
             self._queue.clear()
+        if self.paged_kv is not None:
+            for pend in self._pending.values():
+                self.paged_kv.release(pend.match_refs)
+            for refs in self._row_blocks.values():
+                self.paged_kv.release(refs)
+        self._pending.clear()
+        self._chunk_order.clear()
+        self._row_blocks.clear()
         for req in queued + [r for r in self._rows if r is not None]:
-            req.error = reason
-            req.done.set()
+            req.finish(error=reason)
         self._rows = [None] * self.max_rows
 
     def stop(self) -> None:

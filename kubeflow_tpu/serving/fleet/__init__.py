@@ -1,0 +1,46 @@
+"""kftpu-fleet — the serving tier between the activator and N engines.
+
+ROADMAP item 2 (serving at planetary scale): paged/block KV cache with
+prefix reuse (pagedkv.py), queue-depth-aware routing + SLO admission +
+replica-kill requeue across N ContinuousBatcher replicas (router.py), and
+the seeded open-loop load-test harness (loadtest.py — the serving
+analogue of the chaos drills). Chunked prefill lives in the engine itself
+(serving/continuous.py `prefill_chunk`); the pool plugs in there via the
+engine's `paged_kv` parameter. docs/serving.md is the operator guide.
+"""
+
+from kubeflow_tpu.serving.fleet.loadtest import (
+    LoadReport,
+    make_prompts,
+    run_loadtest,
+    run_loadtest_sync,
+)
+from kubeflow_tpu.serving.fleet.pagedkv import (
+    PagedKVPool,
+    PrefixMatch,
+    extract_prompt_kv,
+    make_row_template,
+    seed_row_cache,
+)
+from kubeflow_tpu.serving.fleet.router import (
+    FleetOverloaded,
+    FleetRequest,
+    FleetRouter,
+    Replica,
+)
+
+__all__ = [
+    "FleetOverloaded",
+    "FleetRequest",
+    "FleetRouter",
+    "LoadReport",
+    "PagedKVPool",
+    "PrefixMatch",
+    "Replica",
+    "extract_prompt_kv",
+    "make_prompts",
+    "make_row_template",
+    "run_loadtest",
+    "run_loadtest_sync",
+    "seed_row_cache",
+]

@@ -1,0 +1,376 @@
+"""Paged/block KV cache with prefix reuse — the fleet's prefill saver.
+
+Decode is HBM-bandwidth-bound, but PREFILL is compute-bound and scales
+with prompt length — and production prompts share long prefixes (the
+system prompt, few-shot preambles). vLLM pages the decode cache; this
+tier pages the *prefix* store instead, because the in-engine decode cache
+is already a fixed-row static-shape buffer (the TPU-idiomatic layout,
+serving/continuous.py) and what repeats across requests is the prompt:
+
+  - prompts split into fixed-size BLOCKS (`block_size` tokens); each
+    fully-prefilled block's K/V (every layer, rope-rotated, position
+    [p0, p1)) is stored once, keyed by the CHAIN HASH of its content —
+    sha1(parent_digest + token bytes) — so block identity encodes the
+    whole prefix, not just the block's own tokens;
+  - the block table is REFCOUNTED: a sequence holds references to the
+    blocks its prompt maps to from admission to retire, and eviction
+    (LRU, leaf-first) only ever removes unreferenced blocks;
+  - divergence is COPY-ON-WRITE: blocks are immutable — two prompts that
+    split mid-block simply stop matching at the split, and extending a
+    shared partial tail block allocates a new block (``cow_copies``)
+    instead of mutating the one the other sequence still references.
+
+On admission the engine asks ``match(ids)``: the longest cached chain
+comes back as gathered per-layer K/V, is written into the row cache at
+positions [0, shared) with ``cache_index``/``pos_index`` seeded to
+`shared`, and the model prefills ONLY the suffix — the per-row index
+machinery models/gpt.py keeps for continuous batching makes the seeded
+row indistinguishable from one the model prefilled itself. Position
+alignment makes the reuse exact: cached K carries its absolute-position
+rotation, and a prompt prefix always sits at positions [0, L).
+
+Host-side numpy on purpose: the pool is the fleet tier's shared store
+(N engines on N threads hit one pool under one lock), and the arrays
+only cross to the device inside the admitting engine's jitted prefill.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubeflow_tpu.analysis.lockcheck import make_lock
+
+#: digest of the empty prefix — the chain root every block hangs off
+ROOT = b"kftpu-fleet-root"
+
+
+def _digest(parent: bytes, ids: np.ndarray) -> bytes:
+    return hashlib.sha1(parent + ids.astype(np.int32).tobytes()).digest()
+
+
+# --------------------------------------------------- cache-pytree helpers
+
+
+def _walk(tree, prefix=""):
+    """Yield (path, leaf) for every array leaf of a nested-dict cache
+    pytree — the flax cache collection is plain dicts, so a stable
+    '/'-joined key path is enough to pair extract with seed."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _walk(tree[k], f"{prefix}/{k}")
+    else:
+        yield prefix, tree
+
+
+def extract_prompt_kv(row_cache, length: int) -> dict[str, np.ndarray]:
+    """Per-position K/V of a batch-1 row cache's first `length` positions:
+    {leaf path -> (length, kv_heads, head_dim) np array} for every
+    cached_key/cached_value leaf. The pool stores slices of these."""
+    out: dict[str, np.ndarray] = {}
+    for path, leaf in _walk(row_cache):
+        name = path.rsplit("/", 1)[-1]
+        if name in ("cached_key", "cached_value"):
+            out[path] = np.asarray(leaf)[0, :length].copy()
+    return out
+
+
+def make_row_template(live_cache) -> dict:
+    """Batch-1 zeroed np twin of the engine's live cache pytree — the
+    starting point for a seeded (prefix-reused) or chunked prefill."""
+
+    def zero(tree):
+        if isinstance(tree, dict):
+            return {k: zero(v) for k, v in tree.items()}
+        a = np.asarray(tree)
+        return np.zeros((1,) + a.shape[1:], a.dtype)
+
+    return zero(live_cache)
+
+
+def seed_row_cache(template: dict, kv: dict[str, np.ndarray],
+                   shared: int) -> dict:
+    """Fresh batch-1 row cache with the pool's gathered K/V written at
+    positions [0, shared) and every cache_index/pos_index leaf set to
+    `shared` — exactly the state a one-shot prefill of those tokens
+    leaves behind, so the suffix prefill continues seamlessly."""
+
+    def build(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: build(v, f"{prefix}/{k}") for k, v in tree.items()}
+        name = prefix.rsplit("/", 1)[-1]
+        if name in ("cache_index", "pos_index"):
+            return np.full_like(tree, shared)
+        got = kv.get(prefix)
+        if got is None:
+            return tree.copy()
+        buf = tree.copy()
+        buf[0, :shared] = got[:shared]
+        return buf
+
+    return build(template)
+
+
+# ------------------------------------------------------------------ pool
+
+
+@dataclass
+class _Block:
+    digest: bytes
+    parent: bytes
+    ids: np.ndarray                      # (n,) int32, n <= block_size
+    kv: dict[str, np.ndarray]            # path -> (n, kvh, d)
+    full: bool
+    refcount: int = 0
+    last_used: int = 0
+    children: set = field(default_factory=set)
+
+
+@dataclass
+class PrefixMatch:
+    """Result of PagedKVPool.match: `length` cached positions, gathered
+    K/V per leaf path, and the block refs the caller now holds (release
+    via PagedKVPool.release when the sequence retires)."""
+
+    length: int
+    kv: dict[str, np.ndarray]
+    blocks: list[bytes]
+
+
+class PagedKVPool:
+    """Refcounted block table over prompt-prefix K/V (module docstring)."""
+
+    def __init__(self, block_size: int = 8, capacity_blocks: int = 1024):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if capacity_blocks < 1:
+            raise ValueError(
+                f"capacity_blocks must be >= 1, got {capacity_blocks}")
+        self.block_size = int(block_size)
+        self.capacity_blocks = int(capacity_blocks)
+        self._table: dict[bytes, _Block] = {}
+        self._clock = 0
+        self._mu = make_lock("fleet.PagedKVPool._mu")
+        self.metrics = {
+            "blocks_cached": 0,
+            "blocks_evicted_total": 0,
+            "blocks_reused_total": 0,
+            "tokens_reused_total": 0,
+            "cow_copies_total": 0,
+        }
+
+    # ------------------------------------------------------------- match
+
+    def match(self, ids) -> PrefixMatch:
+        """Longest cached prefix of `ids`: full-block chain first, then at
+        most one partial tail block whose content is a prefix of the
+        remainder. Acquires one reference per matched block."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        with self._mu:
+            self._clock += 1
+            parent = ROOT
+            blocks: list[_Block] = []
+            pos = 0
+            while pos + self.block_size <= ids.size:
+                d = _digest(parent, ids[pos:pos + self.block_size])
+                blk = self._table.get(d)
+                if blk is None or not blk.full:
+                    break
+                blocks.append(blk)
+                parent = d
+                pos += self.block_size
+            # partial tail: the longest child of the last matched block
+            # whose tokens prefix the remaining ids (COW keeps several
+            # divergent partials alive side by side — pick the best)
+            tail: _Block | None = None
+            rest = ids[pos:]
+            siblings = (self._root_children() if parent == ROOT
+                        else self._table[parent].children)
+            for child_d in list(siblings):
+                child = self._table.get(child_d)
+                if child is None or child.full or child.ids.size > rest.size:
+                    continue
+                if np.array_equal(child.ids, rest[:child.ids.size]) and (
+                        tail is None or child.ids.size > tail.ids.size):
+                    tail = child
+            if tail is not None:
+                blocks.append(tail)
+                pos += tail.ids.size
+            for blk in blocks:
+                blk.refcount += 1
+                blk.last_used = self._clock
+            kv: dict[str, np.ndarray] = {}
+            if blocks:
+                for path in blocks[0].kv:
+                    kv[path] = np.concatenate(
+                        [b.kv[path] for b in blocks], axis=0)
+                self.metrics["blocks_reused_total"] += len(blocks)
+                self.metrics["tokens_reused_total"] += pos
+            return PrefixMatch(length=pos, kv=kv,
+                               blocks=[b.digest for b in blocks])
+
+    def _root_children(self):
+        return [d for d, b in self._table.items() if b.parent == ROOT]
+
+    # ------------------------------------------------------------ insert
+
+    def insert(self, ids, kv: dict[str, np.ndarray]) -> list[bytes]:
+        """Store the prompt's blocks (full blocks plus one partial tail)
+        from its per-position K/V, sharing any blocks already cached.
+        Extending a cached partial block that other sequences still
+        reference allocates a NEW block (copy-on-write) — blocks are
+        immutable once published. Returns held block refs."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        with self._mu:
+            self._clock += 1
+            parent = ROOT
+            held: list[bytes] = []
+            pos = 0
+            while pos < ids.size:
+                take = min(self.block_size, ids.size - pos)
+                chunk = ids[pos:pos + take]
+                d = _digest(parent, chunk)
+                blk = self._table.get(d)
+                if blk is None:
+                    prev = self._table.get(parent)
+                    if prev is not None and not prev.full:
+                        # can't chain off a partial block — stop here
+                        break
+                    blk = _Block(
+                        digest=d, parent=parent, ids=chunk.copy(),
+                        kv={p: a[pos:pos + take].copy()
+                            for p, a in kv.items()},
+                        full=take == self.block_size,
+                    )
+                    if self._covered_by_sibling(blk):
+                        # a longer partial with the same content prefix
+                        # already exists — adding this one only splits
+                        # future matches
+                        break
+                    if any(self._prefixed_partial(blk)):
+                        # the new block EXTENDS a partial some sequence
+                        # still references: publish beside it instead of
+                        # mutating it — copy-on-write on divergence
+                        self.metrics["cow_copies_total"] += 1
+                    self._table[d] = blk
+                    if parent != ROOT:
+                        self._table[parent].children.add(d)
+                    self.metrics["blocks_cached"] = len(self._table)
+                blk.refcount += 1
+                blk.last_used = self._clock
+                held.append(d)
+                if not blk.full:
+                    break  # a partial tail ends the chain by definition
+                parent = d
+                pos += take
+            self._evict_to_capacity()
+            return held
+
+    def _prefixed_partial(self, blk: _Block):
+        """Live partial siblings whose content is a strict prefix of
+        `blk` — the blocks a naive in-place extension would corrupt."""
+        sibs = (self._table[blk.parent].children if blk.parent != ROOT
+                else self._root_children())
+        for d in list(sibs):
+            sib = self._table.get(d)
+            if sib is not None and not sib.full and sib.refcount > 0 \
+                    and sib.ids.size < blk.ids.size \
+                    and np.array_equal(sib.ids, blk.ids[:sib.ids.size]):
+                yield sib
+
+    def _covered_by_sibling(self, blk: _Block) -> bool:
+        """True when an existing partial sibling already stores `blk`'s
+        exact content as its prefix (so matching uses the longer one)."""
+        sibs = (self._table[blk.parent].children if blk.parent != ROOT
+                else self._root_children())
+        for d in sibs:
+            sib = self._table.get(d)
+            if sib is not None and not sib.full \
+                    and sib.ids.size >= blk.ids.size \
+                    and np.array_equal(sib.ids[:blk.ids.size], blk.ids):
+                return True
+        return False
+
+    def extend(self, ref: bytes, ids, kv: dict[str, np.ndarray]) -> bytes:
+        """Grow a held partial block with more positions. Shared blocks
+        (refcount > 1) are copied first — copy-on-write on divergence —
+        so the other holders keep matching the block they admitted
+        against. Returns the (possibly new) held ref."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        with self._mu:
+            self._clock += 1
+            blk = self._table.get(ref)
+            if blk is None:
+                raise KeyError("unknown block ref")
+            if blk.full:
+                raise ValueError("cannot extend a full block")
+            if blk.ids.size + ids.size > self.block_size:
+                raise ValueError(
+                    f"extension {ids.size} overflows block "
+                    f"(have {blk.ids.size}, block_size {self.block_size})")
+            new_ids = np.concatenate([blk.ids, ids])
+            d = _digest(blk.parent, new_ids)
+            new = _Block(
+                digest=d, parent=blk.parent, ids=new_ids,
+                kv={p: np.concatenate([blk.kv[p], kv[p]], axis=0)
+                    for p in blk.kv},
+                full=new_ids.size == self.block_size,
+                refcount=1, last_used=self._clock,
+            )
+            if blk.refcount > 1:
+                # shared: publish the extension beside the original
+                self.metrics["cow_copies_total"] += 1
+                blk.refcount -= 1
+            else:
+                # sole holder: the original entry retires with us
+                self._drop(blk)
+            self._table[d] = new
+            if blk.parent != ROOT:
+                self._table[blk.parent].children.add(d)
+            self.metrics["blocks_cached"] = len(self._table)
+            self._evict_to_capacity()
+            return d
+
+    # ----------------------------------------------------------- release
+
+    def release(self, refs: list[bytes]) -> None:
+        """Drop the references a retired sequence held; unreferenced
+        blocks stay cached (that is the reuse) until LRU eviction."""
+        with self._mu:
+            for d in refs:
+                blk = self._table.get(d)
+                if blk is not None and blk.refcount > 0:
+                    blk.refcount -= 1
+            self._evict_to_capacity()
+
+    def _drop(self, blk: _Block) -> None:
+        self._table.pop(blk.digest, None)
+        parent = self._table.get(blk.parent)
+        if parent is not None:
+            parent.children.discard(blk.digest)
+
+    def _evict_to_capacity(self) -> None:
+        """LRU, leaf-first: only unreferenced childless blocks leave, so
+        a live sequence's chain (and any chain it hangs off) survives."""
+        while len(self._table) > self.capacity_blocks:
+            victims = [b for b in self._table.values()
+                       if b.refcount == 0 and not b.children]
+            if not victims:
+                return  # everything evictable is pinned — over-capacity
+            victim = min(victims, key=lambda b: b.last_used)
+            self._drop(victim)
+            self.metrics["blocks_evicted_total"] += 1
+        self.metrics["blocks_cached"] = len(self._table)
+
+    # ------------------------------------------------------------- debug
+
+    def refcounts(self) -> dict[bytes, int]:
+        with self._mu:
+            return {d: b.refcount for d, b in self._table.items()}
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._table)
+

@@ -1,0 +1,421 @@
+"""Fleet router — queue-depth-aware routing + SLO admission over N engines.
+
+The tier between the activator and the ContinuousBatcher replicas
+(ROADMAP item 2). One engine per InferenceService caps throughput at one
+chip's decode bandwidth; the fleet runs N replica engines behind ONE
+submit() surface with three production behaviors the solo engine lacks:
+
+  - **least-loaded routing**: every submit lands on the replica with the
+    smallest pending-token load (queued prompts + in-flight remaining
+    budgets), not round-robin — a replica stuck behind a 4k-token prompt
+    stops receiving traffic until it drains;
+  - **SLO admission control**: estimated TTFT (pending tokens ahead /
+    the fleet's observed service rate) beyond `ttft_slo_s` sheds the
+    request with FleetOverloaded carrying a Retry-After hint — the same
+    503 + Retry-After contract the activator already speaks, so clients
+    (serving/client.py `_post`) re-dial on the server's schedule instead
+    of piling onto a saturated fleet;
+  - **zero-drop replica kill**: when a replica dies mid-flight, every
+    request it was carrying — queued or decoding — is requeued onto a
+    surviving replica via the engines' on_done callbacks; nothing is
+    dropped, and `requeued_total` counts the disruption. Greedy rows
+    re-decode to the identical tokens (engine exactness contract), so a
+    requeue costs latency, never correctness.
+
+The demand signal (`demand_replicas()`) is the autoscaler's input:
+pending tokens over (service rate x TTFT SLO), clamped to at least the
+alive replica count when queues are hot — the `kftpu_fleet_*` queue and
+latency families in /metrics carry the same numbers for dashboards.
+
+Paged-KV prefix reuse composes: hand each replica engine the SAME
+PagedKVPool and a system prompt prefills once per fleet, not once per
+replica admission (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubeflow_tpu.analysis.lockcheck import make_lock
+
+#: EWMA weight of each completed request's observed decode rate
+_RATE_ALPHA = 0.2
+
+#: bound on the TTFT sample window backing the p50/p99 gauges
+_TTFT_WINDOW = 512
+
+
+class FleetOverloaded(RuntimeError):
+    """Admission shed: the fleet cannot meet the TTFT SLO for this
+    request. `retry_after_s` is the server-side hint the HTTP surfaces
+    forward as a 503 Retry-After header."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+@dataclass
+class Replica:
+    """One engine slot in the fleet: the ContinuousBatcher plus the
+    router's liveness view of it."""
+
+    name: str
+    engine: object
+    alive: bool = True
+
+    def pending_tokens(self) -> int:
+        """The routing load signal: queued prompt+budget tokens plus the
+        remaining budgets of in-flight rows. Best-effort reads of the
+        ticker-private row table (same contract as the /metrics gauges —
+        a mid-tick read is off by at most one row)."""
+        eng = self.engine
+        with eng._lock:
+            queued = sum(ids.size + req.max_new_tokens
+                         for ids, req in eng._queue)
+        rows = sum(max(req.max_new_tokens - len(req.tokens), 1)
+                   for req in eng._rows if req is not None)
+        return queued + rows
+
+    def depth(self) -> int:
+        eng = self.engine
+        with eng._lock:
+            queued = len(eng._queue)
+        return queued + sum(1 for r in eng._rows if r is not None)
+
+
+@dataclass
+class FleetRequest:
+    """Router-level handle: survives replica kills (the engine handle it
+    wraps is replaced on requeue). result() blocks for the tokens of the
+    final successful attempt; TTFT is measured from fleet submission to
+    the first token the CLIENT would have seen (requeues reset it —
+    the wait is real)."""
+
+    prompt: np.ndarray
+    kwargs: dict
+    t_submit: float
+    replica: str = ""
+    attempts: int = 0
+    tokens: list = field(default_factory=list)
+    t_first: float | None = None
+    t_done: float | None = None
+    error: str | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+    on_token: object = None
+
+    @property
+    def ttft_s(self) -> float | None:
+        return None if self.t_first is None else self.t_first - self.t_submit
+
+    @property
+    def tokens_per_s(self) -> float | None:
+        if self.t_first is None or self.t_done is None:
+            return None
+        dt = self.t_done - self.t_first
+        return len(self.tokens) / dt if dt > 0 else float("inf")
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError("fleet request did not finish in time")
+        if self.error is not None:
+            raise RuntimeError(f"fleet request failed: {self.error}")
+        return np.asarray(self.tokens, np.int32)
+
+
+class FleetRouter:
+    """N replica engines behind one submit() (module docstring)."""
+
+    def __init__(self, replicas, ttft_slo_s: float = 0.0,
+                 retry_after_s: float = 1.0,
+                 service_rate_tokens_per_s: float = 0.0,
+                 max_requeues: int = 3):
+        """replicas: list of (name, ContinuousBatcher) or engines (named
+        replica-<i>). ttft_slo_s: 0 disables admission shedding.
+        service_rate_tokens_per_s: initial service-rate estimate; 0 defers
+        admission control until the first completion calibrates it."""
+        self.replicas: list[Replica] = []
+        for i, r in enumerate(replicas):
+            name, eng = r if isinstance(r, tuple) else (f"replica-{i}", r)
+            self.replicas.append(Replica(name=name, engine=eng))
+        if not self.replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.ttft_slo_s = float(ttft_slo_s)
+        self.retry_after_s = float(retry_after_s)
+        self.max_requeues = int(max_requeues)
+        self._rate = float(service_rate_tokens_per_s)
+        self._mu = make_lock("fleet.FleetRouter._mu")
+        self._ttfts = collections.deque(maxlen=_TTFT_WINDOW)
+        self.metrics = {
+            "requests_admitted_total": 0,
+            "requests_shed_total": 0,
+            "requests_requeued_total": 0,
+            "requests_completed_total": 0,
+            "requests_failed_total": 0,
+            "replica_kills_total": 0,
+        }
+
+    # ----------------------------------------------------------- routing
+
+    def _alive(self) -> list[Replica]:
+        return [r for r in self.replicas if r.alive]
+
+    def load_view(self) -> dict[str, int]:
+        """Per-replica pending-token load — the activator's queue-depth-
+        aware endpoint pick reads this (serving/activator.py)."""
+        return {r.name: r.pending_tokens() for r in self.replicas if r.alive}
+
+    def queue_depth(self) -> int:
+        return sum(r.depth() for r in self._alive())
+
+    def pending_tokens(self) -> int:
+        return sum(r.pending_tokens() for r in self._alive())
+
+    def estimated_ttft_s(self, prompt_len: int) -> float | None:
+        """Admission estimate: tokens ahead of this prompt's first token
+        over the fleet's observed service rate. None until a completion
+        has calibrated the rate (admission stays open — shedding on a
+        guess would turn cold starts into outages)."""
+        if self._rate <= 0.0:
+            return None
+        alive = self._alive()
+        if not alive:
+            return float("inf")
+        ahead = min(r.pending_tokens() for r in alive) + prompt_len
+        return ahead / self._rate
+
+    def admit_or_raise(self, prompt_tokens: int) -> None:
+        """The admission gate alone: raises FleetOverloaded when the
+        estimated TTFT for `prompt_tokens` more prompt work exceeds the
+        SLO. Callers submitting a BATCH gate once with the batch total
+        (then submit ungated) so a shed can never orphan half-admitted
+        rows on the fleet."""
+        est = self.estimated_ttft_s(prompt_tokens)
+        if self.ttft_slo_s > 0.0 and est is not None \
+                and est > self.ttft_slo_s:
+            with self._mu:
+                self.metrics["requests_shed_total"] += 1
+            raise FleetOverloaded(
+                f"estimated TTFT {est:.3f}s exceeds SLO "
+                f"{self.ttft_slo_s:.3f}s", retry_after_s=max(
+                    self.retry_after_s,
+                    min(est - self.ttft_slo_s, 30.0)))
+
+    def submit(self, prompt_ids, gate: bool = True,
+               **kwargs) -> FleetRequest:
+        """Admission-gate then route to the least-loaded live replica.
+        Raises FleetOverloaded (with retry_after_s) on shed — including
+        when no replica is alive, counted as a shed, never as an
+        admission."""
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if gate:
+            self.admit_or_raise(ids.size)
+        on_token = kwargs.pop("on_token", None)
+        freq = FleetRequest(prompt=ids, kwargs=dict(kwargs),
+                            t_submit=time.perf_counter(),
+                            on_token=on_token)
+        try:
+            self._dispatch(freq)
+        except FleetOverloaded:
+            with self._mu:
+                self.metrics["requests_shed_total"] += 1
+            raise
+        # counted only once the request is really on a replica, so
+        # admitted == completed + failed + in-flight always holds
+        with self._mu:
+            self.metrics["requests_admitted_total"] += 1
+        return freq
+
+    def _pick(self) -> Replica:
+        alive = self._alive()
+        if not alive:
+            raise FleetOverloaded("no live replicas",
+                                  retry_after_s=self.retry_after_s)
+        return min(alive, key=lambda r: r.pending_tokens())
+
+    def _dispatch(self, freq: FleetRequest) -> None:
+        # the fleet handle rides INSIDE the engine callbacks (partial
+        # binding) — a registry keyed on the engine handle would race the
+        # replica's ticker, which can emit tokens between submit() and
+        # any later registration. The pick AND the enqueue happen under
+        # _mu, ordered against kill_replica's alive=False flip (also
+        # under _mu): either this dispatch lands before the kill — and
+        # the kill's _fail_all requeues it — or the pick already excludes
+        # the corpse. Without the ordering, an enqueue racing the kill
+        # strands the request on a stopped ticker's queue forever.
+        from functools import partial
+
+        with self._mu:
+            rep = self._pick()
+            freq.replica = rep.name
+            freq.attempts += 1
+            rep.engine.submit(
+                freq.prompt, on_token=partial(self._on_token, freq),
+                on_done=partial(self._on_done, freq), **freq.kwargs)
+
+    # --------------------------------------------- engine-thread callbacks
+
+    def _on_token(self, freq: FleetRequest, handle, tok: int) -> None:
+        if freq.done.is_set():
+            return
+        if freq.t_first is None:
+            freq.t_first = time.perf_counter()
+        freq.tokens.append(tok)
+        if freq.on_token is not None:
+            freq.on_token(freq, tok)
+
+    def _on_done(self, freq: FleetRequest, handle) -> None:
+        """Runs on the finishing replica's engine thread. Success
+        completes the fleet handle; a replica-death failure requeues onto
+        a survivor — the zero-drop contract."""
+        if freq.done.is_set():
+            return
+        if handle.error is None:
+            freq.tokens = [int(t) for t in handle.tokens]
+            freq.t_done = time.perf_counter()
+            with self._mu:
+                self.metrics["requests_completed_total"] += 1
+                if freq.ttft_s is not None:
+                    self._ttfts.append(freq.ttft_s)
+                self._observe_rate(freq)
+            freq.done.set()
+            return
+        if freq.attempts > self.max_requeues:
+            freq.error = f"gave up after {freq.attempts} attempts: " \
+                         f"{handle.error}"
+            with self._mu:
+                self.metrics["requests_failed_total"] += 1
+            freq.done.set()
+            return
+        # replica died (or poisoned round): start over on a survivor.
+        # Partial tokens are discarded — greedy decode reproduces them
+        # exactly; TTFT restarts because the client's wait does too.
+        freq.tokens = []
+        freq.t_first = None
+        with self._mu:
+            self.metrics["requests_requeued_total"] += 1
+        try:
+            self._dispatch(freq)
+        except FleetOverloaded as exc:
+            freq.error = str(exc)
+            with self._mu:
+                self.metrics["requests_failed_total"] += 1
+            freq.done.set()
+
+    def _observe_rate(self, freq: FleetRequest) -> None:
+        """EWMA of completed requests' end-to-end token rate — PROMPT +
+        output tokens over client-experienced wall time, the same unit
+        pending_tokens() counts (queued prompts + budgets). Mixing units
+        here would inflate estimated TTFT by the prompt/output ratio and
+        shed long-prompt traffic the fleet could comfortably serve.
+        Caller holds _mu."""
+        wall = (freq.t_done or 0.0) - freq.t_submit
+        if wall <= 0.0:
+            return
+        rate = (freq.prompt.size + len(freq.tokens)) / wall
+        self._rate = (rate if self._rate <= 0.0
+                      else (1 - _RATE_ALPHA) * self._rate
+                      + _RATE_ALPHA * rate)
+
+    @property
+    def service_rate_tokens_per_s(self) -> float:
+        return self._rate
+
+    # ------------------------------------------------------------ chaos
+
+    def kill_replica(self, name_or_idx) -> Replica:
+        """Chaos entry (the drills' mid-run kill): stop the replica's
+        ticker and fail everything it carries — the on_done callbacks
+        requeue every request onto the survivors."""
+        rep = (self.replicas[name_or_idx]
+               if isinstance(name_or_idx, int)
+               else next(r for r in self.replicas
+                         if r.name == name_or_idx))
+        with self._mu:
+            # ordered against _dispatch (also under _mu): any dispatch
+            # that won the race has ALREADY enqueued, so the _fail_all
+            # below requeues it; later picks exclude the corpse
+            rep.alive = False
+            self.metrics["replica_kills_total"] += 1
+        rep.engine.stop()
+        rep.engine._fail_all("replica killed")
+        return rep
+
+    def add_replica(self, engine, name: str = "") -> Replica:
+        """Scale-out entry (the autoscaler's add path)."""
+        rep = Replica(name=name or f"replica-{len(self.replicas)}",
+                      engine=engine)
+        self.replicas.append(rep)
+        return rep
+
+    # ------------------------------------------------------- autoscaling
+
+    def demand_replicas(self) -> int:
+        """Desired replica count from the queue/latency signal: pending
+        tokens over what ONE replica can serve inside the TTFT SLO (the
+        EWMA service rate is a per-request — i.e. per-replica-queue —
+        rate, so it is NOT divided by the alive count: demand must
+        depend on the backlog, not on how many replicas currently exist,
+        or scale-out would raise its own demand signal). The floor is
+        the number of BUSY replicas (scale-in only below actual use);
+        the ceiling is the autoscaler's call."""
+        alive = self._alive()
+        busy = sum(1 for r in alive if r.depth() > 0)
+        per_replica = self._rate * self.ttft_slo_s
+        if per_replica <= 0.0:
+            return max(1, busy)
+        import math
+
+        return max(1, busy, math.ceil(self.pending_tokens() / per_replica))
+
+    # --------------------------------------------------------- reporting
+
+    def ttft_percentiles(self) -> dict[str, float]:
+        with self._mu:
+            samples = sorted(self._ttfts)
+        if not samples:
+            return {"p50_s": 0.0, "p99_s": 0.0}
+        return {
+            "p50_s": samples[len(samples) // 2],
+            "p99_s": samples[min(len(samples) - 1,
+                                 int(len(samples) * 0.99))],
+        }
+
+    def snapshot(self) -> dict:
+        """One coherent metrics view for /metrics and the load report."""
+        with self._mu:
+            m = dict(self.metrics)
+        m["queue_depth"] = self.queue_depth()
+        m["pending_tokens"] = self.pending_tokens()
+        m["replicas_alive"] = len(self._alive())
+        m["demand_replicas"] = self.demand_replicas()
+        m["service_rate_tokens_per_s"] = round(self._rate, 3)
+        m.update({f"ttft_{k}": round(v, 6)
+                  for k, v in self.ttft_percentiles().items()})
+        return m
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> "FleetRouter":
+        for r in self._alive():
+            r.engine.start()
+        return self
+
+    def stop(self) -> None:
+        for r in self._alive():
+            r.engine.stop()
+
+    def run_until_idle(self) -> None:
+        """Synchronous drive (tests, the cpu-proxy scenario): round-robin
+        one tick per live replica until every queue and row is empty."""
+        while True:
+            busy = False
+            for r in self._alive():
+                busy = r.engine.tick() or busy
+            if not busy:
+                return

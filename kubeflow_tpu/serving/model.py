@@ -314,6 +314,7 @@ class JaxModel(Model):
         self._predict_fn = None
         self._aot_batch: int | None = None
         self._engine = None  # continuous-batching decode engine
+        self._fleet = None   # multi-replica fleet router (serving/fleet)
         self.config: dict = {}
 
     def load(self) -> None:
@@ -351,22 +352,53 @@ class JaxModel(Model):
                     ddir = self.model_dir / ddir
                 draft_module, draft_variables, _ = load_generative_model(
                     ddir)
-            self._engine = ContinuousBatcher(
-                module, variables,
-                max_rows=int(gen.get("continuous_rows", 8)),
-                default_max_new_tokens=int(gen.get("max_new_tokens", 32)),
-                # int or stop-id list — the engine normalizes either
-                eos_token_id=eos,
-                top_k=int(gen.get("top_k", 0)),
-                seed=int(gen.get("seed", 0)),
-                steps_per_tick=int(gen.get("continuous_steps_per_tick", 1)),
-                prefill_buckets=(
-                    tuple(gen["continuous_prefill_buckets"])
-                    if gen.get("continuous_prefill_buckets") else None),
-                draft_module=draft_module,
-                draft_variables=draft_variables,
-                gamma=int(gen.get("speculative_gamma", 4)),
-            ).start()
+            # fleet extensions (docs/serving.md): chunked prefill, a
+            # per-model paged-KV pool for prefix reuse, and with
+            # fleet_replicas > 1 a FleetRouter over N engines sharing the
+            # pool — SLO admission sheds surface as 503 + Retry-After
+            paged_kv = None
+            if int(gen.get("paged_kv_block", 0)) > 0:
+                from kubeflow_tpu.serving.fleet import PagedKVPool
+
+                paged_kv = PagedKVPool(
+                    block_size=int(gen["paged_kv_block"]),
+                    capacity_blocks=int(
+                        gen.get("paged_kv_capacity_blocks", 1024)))
+
+            def build_engine():
+                return ContinuousBatcher(
+                    module, variables,
+                    max_rows=int(gen.get("continuous_rows", 8)),
+                    default_max_new_tokens=int(
+                        gen.get("max_new_tokens", 32)),
+                    # int or stop-id list — the engine normalizes either
+                    eos_token_id=eos,
+                    top_k=int(gen.get("top_k", 0)),
+                    seed=int(gen.get("seed", 0)),
+                    steps_per_tick=int(
+                        gen.get("continuous_steps_per_tick", 1)),
+                    prefill_buckets=(
+                        tuple(gen["continuous_prefill_buckets"])
+                        if gen.get("continuous_prefill_buckets") else None),
+                    draft_module=draft_module,
+                    draft_variables=draft_variables,
+                    gamma=int(gen.get("speculative_gamma", 4)),
+                    prefill_chunk=int(gen.get("prefill_chunk", 0)),
+                    paged_kv=paged_kv,
+                )
+
+            n_replicas = int(gen.get("fleet_replicas", 1))
+            if n_replicas > 1:
+                from kubeflow_tpu.serving.fleet import FleetRouter
+
+                self._fleet = FleetRouter(
+                    [build_engine() for _ in range(n_replicas)],
+                    ttft_slo_s=float(gen.get("fleet_ttft_slo_s", 0.0)),
+                    retry_after_s=float(
+                        gen.get("fleet_retry_after_s", 1.0)),
+                ).start()
+            else:
+                self._engine = build_engine().start()
             self.ready = True
             return
 
@@ -414,27 +446,10 @@ class JaxModel(Model):
                     f"generation prompts must not contain the pad token id "
                     f"{pad}: send equal-length unpadded prompts"
                 )
-        if getattr(self, "_engine", None) is not None:
-            budget = int(gen.get("max_new_tokens", 32))
-            eos = gen.get("eos_token_id")
-            temp = float(gen.get("temperature", 0.0))
-            reqs = [self._engine.submit(row, max_new_tokens=budget,
-                                        temperature=temp)
-                    for row in x]
-            # eos may be a stop-id LIST (Llama-3 imports); the clamp
-            # token past a retired row is the FIRST id — generate()'s
-            # contract
-            clamp = (int(eos[0]) if isinstance(eos, (list, tuple))
-                     else None if eos is None else int(eos))
-            outs = []
-            for r in reqs:
-                ids = r.result(timeout=300.0)
-                if ids.size < budget:  # pad past the stop with the clamp
-                    ids = np.concatenate([
-                        ids, np.full((budget - ids.size,), clamp,
-                                     np.int32)])
-                outs.append(ids)
-            return np.stack(outs)
+        if getattr(self, "_engine", None) is not None \
+                or getattr(self, "_fleet", None) is not None:
+            out, _ = self._engine_predict_timed(x, gen)
+            return out
         if self._sampling:
             import jax
 
@@ -460,6 +475,75 @@ class JaxModel(Model):
                 )
             return aot.padded_chunk_predict(self._predict_fn, x, self._aot_batch)
         return np.asarray(self._predict_fn(x))
+
+    def _engine_predict_timed(self, x: np.ndarray, gen: dict):
+        """Engine/fleet decode for a prompt batch, with the streaming
+        timing the load-test client reads: ({rows}, {"ttft_s",
+        "tokens_per_s"}). Fleet admission sheds (FleetOverloaded)
+        propagate — the server maps them to 503 + Retry-After."""
+        budget = int(gen.get("max_new_tokens", 32))
+        eos = gen.get("eos_token_id")
+        temp = float(gen.get("temperature", 0.0))
+        if self._fleet is not None:
+            # gate ONCE with the whole batch's prompt work, then submit
+            # ungated: a shed on row k would otherwise orphan the k rows
+            # already admitted — decode capacity burned on answers
+            # nobody reads, exactly what admission control exists to
+            # prevent
+            self._fleet.admit_or_raise(int(sum(len(row) for row in x)))
+            submit = lambda row, **kw: self._fleet.submit(  # noqa: E731
+                row, gate=False, **kw)
+        else:
+            submit = self._engine.submit
+        reqs = [submit(row, max_new_tokens=budget, temperature=temp)
+                for row in x]
+        # eos may be a stop-id LIST (Llama-3 imports); the clamp
+        # token past a retired row is the FIRST id — generate()'s
+        # contract
+        clamp = (int(eos[0]) if isinstance(eos, (list, tuple))
+                 else None if eos is None else int(eos))
+        outs = []
+        for r in reqs:
+            ids = r.result(timeout=300.0)
+            if ids.size < budget:  # pad past the stop with the clamp
+                ids = np.concatenate([
+                    ids, np.full((budget - ids.size,), clamp,
+                                 np.int32)])
+            outs.append(ids)
+        ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+        rates = [r.tokens_per_s for r in reqs
+                 if r.tokens_per_s not in (None, float("inf"))]
+        timing = {
+            "ttft_s": round(min(ttfts), 6) if ttfts else None,
+            "tokens_per_s": (round(sum(rates), 3) if rates else None),
+        }
+        return np.stack(outs), timing
+
+    def close(self) -> None:
+        """Stop the engine/fleet ticker threads (server shutdown path)."""
+        if self._engine is not None:
+            self._engine.stop()
+        if self._fleet is not None:
+            self._fleet.stop()
+
+    def predict_timed(self, inputs: np.ndarray):
+        """predict() plus per-request streaming timing when an engine or
+        fleet serves the model — (output, timing|None). The v1 server
+        surfaces the timing so clients (ServingClient.predict_timed)
+        measure TTFT from the engine's own token timestamps instead of
+        guessing from HTTP wall time."""
+        x = np.asarray(inputs, dtype=self.config["input_dtype"])
+        gen = self.config.get("generate")
+        if gen is not None and (self._engine is not None
+                                or self._fleet is not None):
+            pad = int(gen.get("pad_token_id", 0))
+            if (x == pad).any():
+                raise ValueError(
+                    f"generation prompts must not contain the pad token id "
+                    f"{pad}: send equal-length unpadded prompts"
+                )
+            return self._engine_predict_timed(x, gen)
+        return self.predict(inputs), None
 
     def postprocess(self, outputs: np.ndarray) -> dict:
         """Classification contract: logits -> class + per-class scores.

@@ -45,12 +45,14 @@ def _np_to_datatype(arr: np.ndarray) -> str:
 
 
 class _RawJSON:
-    """Pre-serialized JSON response body (single-serialization hot path)."""
+    """Pre-serialized JSON response body (single-serialization hot path);
+    optionally carries extra response headers (503 Retry-After)."""
 
-    __slots__ = ("data",)
+    __slots__ = ("data", "headers")
 
-    def __init__(self, data: bytes):
+    def __init__(self, data: bytes, headers: dict | None = None):
         self.data = data
+        self.headers = headers or {}
 
 
 class ModelServer:
@@ -99,7 +101,11 @@ class ModelServer:
         b = self._batchers.pop(name, None)
         if b is not None:
             b.stop()
-        return self.models.pop(name, None) is not None
+        m = self.models.pop(name, None)
+        close = getattr(m, "close", None)
+        if close is not None:
+            close()  # engine/fleet ticker threads die with the model
+        return m is not None
 
     def _call_model(self, m: Model, arr):
         # dict inputs (multi-input models) cannot coalesce on a shared batch
@@ -130,6 +136,10 @@ class ModelServer:
     def stop(self) -> None:
         for b in self._batchers.values():
             b.stop()
+        for m in self.models.values():
+            close = getattr(m, "close", None)
+            if close is not None:
+                close()
         self.logger.close()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -146,29 +156,47 @@ class ModelServer:
             text = self.logger.render_metrics()  # raw prometheus text
             # continuous-batching engines publish scheduler gauges
             eng_lines = []
+            fleet_lines = []
             for name, m in sorted(self.models.items()):
-                eng = getattr(m, "_engine", None)
-                if eng is None:
-                    continue
-                # gauges are instantaneous best-effort reads: the ticker
-                # mutates _rows/step_count OUTSIDE the engine lock by
-                # design (the lock guards only the submit queue — see
-                # tick()'s locking note), so only _queue needs the lock;
-                # a mid-tick read can be off by one row/dispatch, which a
-                # scrape-interval consumer cannot observe
-                busy = sum(1 for r in eng._rows if r is not None)
-                dispatches = eng.step_count
-                with eng._lock:
-                    queued = len(eng._queue)
-                eng_lines += [
-                    f'kfserving_engine_decode_dispatches_total'
-                    f'{{model="{name}"}} {dispatches}',
-                    f'kfserving_engine_rows_busy{{model="{name}"}} {busy}',
-                    f'kfserving_engine_rows_total{{model="{name}"}} '
-                    f'{eng.max_rows}',
-                    f'kfserving_engine_queue_depth{{model="{name}"}} '
-                    f'{queued}',
-                ]
+                fleet = getattr(m, "_fleet", None)
+                engines = ([(name, getattr(m, "_engine", None))]
+                           if fleet is None else
+                           [(f"{name}:{r.name}", r.engine)
+                            for r in fleet.replicas])
+                if fleet is not None:
+                    snap = fleet.snapshot()
+                    fleet_lines += [
+                        f'kfserving_fleet_{k}{{model="{name}"}} {v}'
+                        for k, v in sorted(snap.items())
+                        if isinstance(v, (int, float))
+                    ]
+                for label, eng in engines:
+                    if eng is None:
+                        continue
+                    # gauges are instantaneous best-effort reads: the
+                    # ticker mutates _rows/step_count OUTSIDE the engine
+                    # lock by design (the lock guards only the submit
+                    # queue — see tick()'s locking note), so only _queue
+                    # needs the lock; a mid-tick read can be off by one
+                    # row/dispatch, which a scrape-interval consumer
+                    # cannot observe
+                    busy = sum(1 for r in eng._rows if r is not None)
+                    dispatches = eng.step_count
+                    with eng._lock:
+                        queued = len(eng._queue)
+                    eng_lines += [
+                        f'kfserving_engine_decode_dispatches_total'
+                        f'{{model="{label}"}} {dispatches}',
+                        f'kfserving_engine_rows_busy{{model="{label}"}} '
+                        f'{busy}',
+                        f'kfserving_engine_rows_total{{model="{label}"}} '
+                        f'{eng.max_rows}',
+                        f'kfserving_engine_queue_depth{{model="{label}"}} '
+                        f'{queued}',
+                    ]
+            if fleet_lines:
+                text += "# TYPE kfserving_fleet gauge\n" \
+                    + "\n".join(fleet_lines) + "\n"
             if eng_lines:
                 text += "\n".join(
                     ["# TYPE kfserving_engine_decode_dispatches_total "
@@ -245,13 +273,17 @@ class ModelServer:
         import time as _time
 
         t0 = _time.perf_counter()
-        code, payload = fn(name, body)
+        out = fn(name, body)
+        # handlers return (code, payload) or (code, payload, headers) —
+        # the fleet's 503 shed carries its Retry-After hint through here
+        code, payload = out[0], out[1]
+        headers = out[2] if len(out) > 2 else None
         # serialize exactly once: the handler sends these bytes verbatim
         data = json.dumps(payload).encode()
         self.logger.log(
             name, protocol, code, _time.perf_counter() - t0, req_bytes, len(data)
         )
-        return code, _RawJSON(data)
+        return code, _RawJSON(data, headers)
 
     def _repo_load(self, name: str, body: dict) -> tuple[int, dict]:
         """Load (or reload) a model from the repository dir or a storage URI
@@ -322,25 +354,45 @@ class ModelServer:
             return 503, {"error": f"model {name!r} not ready"}
         return m
 
-    def _predict_v1(self, name: str, body: dict) -> tuple[int, dict]:
+    def _predict_v1(self, name: str, body: dict) -> tuple:
+        from kubeflow_tpu.serving.fleet import FleetOverloaded
+
         m = self._get_ready_model(name)
         if isinstance(m, tuple):
             return m
         instances = body.get("instances")
         if instances is None:
             return 400, {"error": "v1 request must carry 'instances'"}
+        timing = None
         try:
-            out = self._call_model(m, np.asarray(instances))
+            if getattr(m, "_engine", None) is not None \
+                    or getattr(m, "_fleet", None) is not None:
+                # engine/fleet decode: thread the streaming timing
+                # (TTFT, tokens/sec) into the response so clients see
+                # engine truth, not HTTP wall-time guesses
+                raw, timing = m.predict_timed(
+                    m.preprocess(np.asarray(instances)))
+                out = m.postprocess(raw)
+            else:
+                out = self._call_model(m, np.asarray(instances))
+        except FleetOverloaded as exc:
+            # the activator's existing shed contract: the client re-dials
+            # after the hint (serving/client.py _post)
+            return 503, {"error": str(exc)}, {
+                "Retry-After": str(max(1, int(round(exc.retry_after_s))))}
         except Exception as exc:  # noqa: BLE001 — surface as 500, keep serving
             return 500, {"error": f"{type(exc).__name__}: {exc}"}
         if isinstance(out, dict):
             # ndarray values (multi-output runtimes) must be JSON-ready
             body = {k: v.tolist() if isinstance(v, np.ndarray) else v
                     for k, v in out.items()}
-            if "predictions" in body:
-                return 200, body
-            return 200, {"predictions": body}
-        return 200, {"predictions": np.asarray(out).tolist()}
+            if "predictions" not in body:
+                body = {"predictions": body}
+        else:
+            body = {"predictions": np.asarray(out).tolist()}
+        if timing is not None:
+            body["timing"] = timing
+        return 200, body
 
     def _explain_v1(self, name: str, body: dict) -> tuple[int, dict]:
         m = self._get_ready_model(name)
@@ -361,7 +413,9 @@ class ModelServer:
             return 200, out
         return 200, {"explanations": np.asarray(out).tolist()}
 
-    def _infer_v2(self, name: str, body: dict) -> tuple[int, dict]:
+    def _infer_v2(self, name: str, body: dict) -> tuple:
+        from kubeflow_tpu.serving.fleet import FleetOverloaded
+
         m = self._get_ready_model(name)
         if isinstance(m, tuple):
             return m
@@ -382,6 +436,11 @@ class ModelServer:
                 arr = {t.get("name", f"input-{i}"): decode(t)
                        for i, t in enumerate(inputs)}
             out = self._call_model(m, arr)
+        except FleetOverloaded as exc:
+            # same shed contract as v1: clients back off on the server's
+            # schedule instead of hard-failing or piling on immediately
+            return 503, {"error": str(exc)}, {
+                "Retry-After": str(max(1, int(round(exc.retry_after_s))))}
         except Exception as exc:  # noqa: BLE001
             return 500, {"error": f"{type(exc).__name__}: {exc}"}
         arrays = self.postprocess_arrays(out)
@@ -406,8 +465,10 @@ def _make_handler(server: ModelServer):
             print(f"[http] {fmt % args}", flush=True)
 
         def _reply(self, code: int, payload) -> None:
+            extra = {}
             if isinstance(payload, _RawJSON):
                 data, ctype = payload.data, "application/json"
+                extra = payload.headers
             elif isinstance(payload, str):
                 data, ctype = payload.encode(), "text/plain; version=0.0.4"
             else:
@@ -415,6 +476,8 @@ def _make_handler(server: ModelServer):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for name, value in extra.items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
 

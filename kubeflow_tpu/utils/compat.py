@@ -57,3 +57,36 @@ def set_mesh(mesh):
     Raises MeshUnavailable (with the reason) when this jax has no
     equivalent for the given mesh object."""
     return _SET_MESH(mesh)
+
+
+def _resolve_get_mesh():
+    import jax
+
+    getter = getattr(jax.sharding, "get_abstract_mesh", None)
+    if getter is not None:
+        return getter, "jax.sharding.get_abstract_mesh"
+
+    # 0.4.x: the ambient mesh lives on the thread-resources env (what the
+    # legacy `with mesh:` context sets). The physical Mesh object carries
+    # the same `.empty` / `.shape` surface the callers consult, so it
+    # stands in for the AbstractMesh directly.
+    def _legacy():
+        from jax._src import mesh as mesh_lib
+
+        return mesh_lib.thread_resources.env.physical_mesh
+
+    return _legacy, "legacy thread_resources physical mesh"
+
+
+_GET_MESH, GET_MESH_IMPL = _resolve_get_mesh()
+
+
+def get_abstract_mesh():
+    """The ambient mesh (``.empty`` when none is set), on any supported
+    jax. On 0.4.x this is the thread-local physical mesh the legacy
+    ``with mesh:`` context manager sets — same ``.empty``/``.shape``
+    surface, so sharding-aware call sites (models/bert.constrain,
+    parallel/*) run unmodified on every jax this repo supports. Before
+    this shim, every GPT/BERT forward pass — and with it the whole
+    serving stack — failed wholesale on jax 0.4.37."""
+    return _GET_MESH()

@@ -38,11 +38,14 @@ def lockcheck_armed(request):
     fault injection exercises the threaded control plane's nastiest
     interleavings, so this is exactly where a lock-order inversion (a
     potential deadlock) or a wedged-long hold would first show. Zero
-    cycles is an acceptance contract, not a nice-to-have. Scoped by
-    marker so the rest of the suite runs with the detector's production
-    default (disabled passthrough)."""
+    cycles is an acceptance contract, not a nice-to-have. The fleet
+    drills join the set: N engine tickers + router callbacks + one shared
+    paged-KV pool lock is exactly the nesting the detector exists for.
+    Scoped by marker so the rest of the suite runs with the detector's
+    production default (disabled passthrough)."""
     if not (request.node.get_closest_marker("chaos")
-            or request.node.get_closest_marker("health")):
+            or request.node.get_closest_marker("health")
+            or request.node.get_closest_marker("fleet")):
         yield
         return
     from kubeflow_tpu.analysis import lockcheck

@@ -50,14 +50,19 @@ class TestExactness:
         p = _prompt(7, 5)
         plain = np.asarray(generate(model, variables, p[None, :],
                                     max_new_tokens=16))[0]
-        eos = int(plain[4])  # provably emitted at step 5
+        eos = int(plain[4])  # provably emitted by step 5
+        # the FIRST occurrence wins (it may precede step 5: greedy decode
+        # numerics vary across jax/XLA versions and repeated tokens are
+        # common on the tiny fixture) — same contract as the engine-list
+        # eos test in test_gpt_generate.py
+        first = int(np.argmax(plain == eos))
         eng = ContinuousBatcher(model, variables, max_rows=2,
                                 eos_token_id=eos)
         req = eng.submit(p, max_new_tokens=16)
         eng.run_until_idle()
         out = req.result(timeout=1)
-        assert out[-1] == eos and len(out) == 5  # stopped AT the eos
-        np.testing.assert_array_equal(out, plain[:5])
+        assert out[-1] == eos and len(out) == first + 1  # stopped AT eos
+        np.testing.assert_array_equal(out, plain[:first + 1])
 
     def test_moe_rows_match_solo_decode(self):
         """MoE models serve through the engine EXACTLY (VERDICT r4 #6):
@@ -590,14 +595,15 @@ class TestSpeculative:
         plain = np.asarray(generate(target, tvars, p[None, :],
                                     max_new_tokens=16))[0]
         eos = int(plain[4])
+        first = int(np.argmax(plain == eos))  # first occurrence wins
         eng = ContinuousBatcher(target, tvars, max_rows=2, eos_token_id=eos,
                                 draft_module=target, draft_variables=dvars,
                                 gamma=3)
         req = eng.submit(p, max_new_tokens=16)
         eng.run_until_idle()
         out = req.result(timeout=1)
-        assert out[-1] == eos and len(out) == 5
-        np.testing.assert_array_equal(out, plain[:5])
+        assert out[-1] == eos and len(out) == first + 1
+        np.testing.assert_array_equal(out, plain[:first + 1])
 
     def test_predictor_with_continuous_draft_dir(self, tmp_path, spec):
         """generate config {continuous: true, continuous_draft_dir: ...}

@@ -66,6 +66,27 @@ class TestPerfGate:
         assert any("reconcile_storm.reconcile_p50" in v
                    for v in violations), violations
 
+    def test_injected_decode_tick_slowdown_fails(self, monkeypatch):
+        """The fleet gate's teeth: doubling the engines' per-tick device
+        dispatches (work repeated AND serialized, never slept) must fail
+        the serve_fleet budget even though the machine is unchanged."""
+        monkeypatch.setenv(ENV_PROF_CHAOS, "decode_tick:2")
+        results = cpu_proxy.run_all(only="serve_fleet")
+        violations = cpu_proxy.check_budgets(
+            results, json.loads(BUDGETS.read_text()))
+        assert any("serve_fleet." in v for v in violations), violations
+
+    def test_fleet_drill_zero_drops_in_gate_run(self, monkeypatch):
+        """The serve_fleet record itself is a drill: a replica dies
+        mid-run and the acceptance bar — zero dropped requests, every
+        admission completed — holds in the same run the budgets gate."""
+        monkeypatch.delenv(ENV_PROF_CHAOS, raising=False)
+        (rec,) = cpu_proxy.run_all(only="serve_fleet")
+        assert rec["replica_killed"] and rec["requeued"] >= 1
+        assert rec["dropped_count"] == 0
+        assert rec["completed"] == rec["requests"]
+        assert rec["rel"]["reuse_computed_frac"] < 1.0
+
 
 class TestGateLogic:
     """check_budgets unit behavior on synthetic results — no timing."""
