@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -53,6 +53,14 @@ test-cplane:
 # serve_fleet cpu-proxy gate (docs/serving.md)
 test-fleet:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m fleet
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
+
+# training hot-path suite: restart-warm compile cache (warm incarnation
+# = zero backend compiles), AsyncLoader edge drills under the lock-order
+# detector, analytics splits, and the train_restart_warm cpu-proxy gate
+# (docs/perf.md "MFU hunt")
+test-hotpath:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_hotpath.py -q -m hotpath
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 native:

@@ -50,7 +50,7 @@ from kubeflow_tpu.health import (
 from kubeflow_tpu.native import Expectations
 from kubeflow_tpu.runtime.rendezvous import LocalResolver
 from kubeflow_tpu.tracing import ENV_TRACE_DIR, ENV_TRACEPARENT, current_context
-from kubeflow_tpu.utils.envvars import ENV_STATE_DIR
+from kubeflow_tpu.utils.envvars import ENV_COMPILE_CACHE_DIR, ENV_STATE_DIR
 from kubeflow_tpu.utils.retry import BackoffPolicy, with_conflict_retry
 
 JOB_NAME_LABEL = "kubeflow-tpu.org/job-name"
@@ -84,6 +84,7 @@ class JobController(ControllerBase):
         local_rewrite: bool = True,
         liveness: LivenessConfig | None = None,
         heartbeat_dir: str = "",
+        compile_cache_dir: str = "",
     ):
         super().__init__(
             cluster, name="job", workers=workers, resync_period_s=resync_period_s
@@ -96,6 +97,13 @@ class JobController(ControllerBase):
         self.liveness = LivenessDetector(liveness)
         self.heartbeat_dir = heartbeat_dir or os.path.join(
             os.environ.get(ENV_STATE_DIR, ".kubeflow_tpu"), "heartbeats"
+        )
+        # persistent XLA compile cache shared by EVERY incarnation of every
+        # job (entries are content-keyed, so sharing one dir is safe): a
+        # gang-restarted worker replays its train-step executables instead
+        # of re-tracing+recompiling (utils/compile_cache.py, docs/perf.md)
+        self.compile_cache_dir = compile_cache_dir or os.path.join(
+            os.environ.get(ENV_STATE_DIR, ".kubeflow_tpu"), "compile-cache"
         )
         self._resolvers: dict[str, LocalResolver] = {}
         # prometheus-style counters (SURVEY.md §5.5)
@@ -399,6 +407,10 @@ class JobController(ControllerBase):
                 job.metadata.name, job.replica_name(rtype, i),
                 job.status.restart_count,
             ))
+            # restart-warm compile contract: unlike the heartbeat path the
+            # cache dir is NOT per-incarnation — surviving the restart is
+            # the whole point (the restarted worker's warm_start hits it)
+            env.setdefault(ENV_COMPILE_CACHE_DIR, self.compile_cache_dir)
             c = job.spec.replica_specs[rtype].template.container
             # job-level labels (e.g. the experiment label) propagate to pods,
             # mirroring k8s template-label propagation

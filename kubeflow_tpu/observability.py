@@ -119,6 +119,28 @@ def render_metrics(platform) -> str:
                     "sample window",
               labels=f'{{quantile="{q}"}}')
 
+    # training hot path (utils/compile_cache.py + train/data.AsyncLoader,
+    # docs/perf.md "MFU hunt"): restart-warm compile reuse and the async
+    # host-loader ledger. Both registries are process-global — trainers
+    # are constructed ad hoc by jobs, drills, and benches — and families
+    # render ZERO-valued on an idle platform so the golden exposition
+    # pins a stable surface (KFTPU-METRIC contract).
+    from kubeflow_tpu.train.data import loader_metrics_snapshot
+    from kubeflow_tpu.utils.compile_cache import compile_metrics_snapshot
+
+    for mname, v in sorted(compile_metrics_snapshot().items()):
+        counter(f"kftpu_train_compile_{mname}", v)
+    loader_snap = loader_metrics_snapshot()
+    live_loaders = loader_snap.pop("live_loaders")
+    for mname, v in sorted(loader_snap.items()):
+        counter(f"kftpu_train_loader_{mname}",
+                v if isinstance(v, int) else f"{v:.6f}")
+    gauge(
+        "kftpu_train_loader_live", live_loaders,
+        help_="AsyncLoader producer threads still running "
+              "(a wedged loader thread shows here)",
+    )
+
     # liveness layer (kubeflow_tpu/health.py): lease expiries and straggler
     # declarations counted apart from crash deaths, plus per-incarnation
     # heartbeat age straight from the kubelet layer's side table

@@ -74,6 +74,14 @@ def step_breakdown(spans: list[dict]) -> list[dict]:
     Each returned dict satisfies
     ``data_load + compute + checkpoint + stall == wall`` (stall is the
     remainder, floored at 0 against float noise).
+
+    data_load itself splits sum-exactly into ``data_wait + data_assemble
+    == data_load``: when the async host loader stamps a ``wait_s`` attr
+    (queue-blocked time — what the critical path actually paid),
+    data_wait is that portion (clamped to the span) and data_assemble the
+    in-span remainder; spans without the attr (the inline loader) are all
+    assemble — the split shows how much host work the background thread
+    moved OFF the critical path.
     """
     by_pid: dict[int, list[dict]] = {}
     for s in spans:
@@ -95,7 +103,14 @@ def step_breakdown(spans: list[dict]) -> list[dict]:
             # charges at least the step's own duration
             wall = max(end - prev_end, st["dur"])
             in_window = lambda s: prev_end < _end(s) <= end  # noqa: E731
-            d = sum(s["dur"] for s in data if in_window(s))
+            d = wait = 0.0
+            for s in data:
+                if in_window(s):
+                    d += s["dur"]
+                    # wait is clamped to the span so the split can never
+                    # exceed what the cycle was actually charged
+                    wait += min(float(s["attrs"].get("wait_s", 0.0)),
+                                s["dur"])
             c = sum(s["dur"] for s in ckpt if in_window(s))
             compute = st["dur"]
             stall = max(wall - compute - d - c, 0.0)
@@ -105,6 +120,8 @@ def step_breakdown(spans: list[dict]) -> list[dict]:
                 "ts": st["ts"],
                 "wall": wall,
                 "data_load": d,
+                "data_wait": wait,
+                "data_assemble": d - wait,
                 "compute": compute,
                 "checkpoint": c,
                 "stall": stall,
@@ -119,10 +136,19 @@ def aggregate_steps(steps: list[dict]) -> dict:
     totals = {p: sum(s[p] for s in steps) for p in phases}
     wall = sum(s["wall"] for s in steps)
     walls = sorted(s["wall"] for s in steps)
+    data = totals["data_load"]
+    wait = sum(s["data_wait"] for s in steps)
     return {
         "count": len(steps),
         "wall_s": round(wall, 6),
         "phases_s": {p: round(v, 6) for p, v in totals.items()},
+        # the async-loader split of data_load (wait + assemble == load):
+        # assemble is host work still ON the critical path — the number
+        # the AsyncLoader exists to drive toward zero
+        "data_load_split": {
+            "queue_wait_s": round(wait, 6),
+            "assemble_s": round(data - wait, 6),
+        },
         "fractions": {
             p: (round(v / wall, 4) if wall else 0.0)
             for p, v in totals.items()
@@ -341,20 +367,49 @@ def restart_chains(spans: list[dict]) -> list[dict]:
     kill -> pod exit -> restart decision), the matching restart
     incarnation's create/rendezvous/step spans, the wall-clock overhead
     from the chain root to the first post-restore step, and whether the
-    whole path is monotonic in wall-clock."""
+    whole path is monotonic in wall-clock.
+
+    overhead_s splits sum-exactly into ``compile_s + restore_s +
+    rendezvous_s + schedule_s``: compile is the incarnation's
+    train.compile span(s) (the re-trace+recompile cost the restart-warm
+    cache exists to erase), restore its checkpoint.restore, rendezvous
+    its gang bring-up, and schedule the remainder — the control-plane
+    path from the root cause through pod exit, restart decision, create,
+    bind, and process start (each floored at 0 against clock skew)."""
     chains = []
     for r in _resolve_chains(spans):
         up, create, first_step = r["up"], r["create"], r["first_step"]
         path = up + ([create] if create else []) \
             + ([first_step] if first_step else [])
         stamps = [s["ts"] for s in path]
+        overhead = (round(max(first_step["ts"] - up[0]["ts"], 0.0), 6)
+                    if first_step and up else 0.0)
+        # phase spans of THIS incarnation that precede its first step:
+        # only time inside the overhead window can be attributed to it
+        pre = [s for s in r["kids"]
+               if first_step is None or s["ts"] < first_step["ts"]]
+        compile_s = min(sum(s["dur"] for s in pre
+                            if s["name"] == "train.compile"), overhead)
+        restore_s = min(sum(s["dur"] for s in pre
+                            if s["name"] == "checkpoint.restore"),
+                        max(overhead - compile_s, 0.0))
+        rdv_s = min(sum(s["dur"] for s in r["rendezvous"]
+                        if first_step is None
+                        or s["ts"] < first_step["ts"]),
+                    max(overhead - compile_s - restore_s, 0.0))
+        compile_s = round(compile_s, 6)
+        restore_s = round(restore_s, 6)
+        rdv_s = round(rdv_s, 6)
         chains.append({
             "restart": r["rs"]["attrs"].get("restart"),
             "chain": [s["name"] for s in path],
             "root": up[0]["name"] if up else "",
-            "overhead_s": round(
-                max(first_step["ts"] - up[0]["ts"], 0.0), 6)
-            if first_step and up else 0.0,
+            "overhead_s": overhead,
+            "compile_s": compile_s,
+            "restore_s": restore_s,
+            "rendezvous_s": rdv_s,
+            "schedule_s": max(round(
+                overhead - compile_s - restore_s - rdv_s, 6), 0.0),
             "rendezvous": len(r["rendezvous"]),
             "steps": len(r["steps"]),
             "monotonic": stamps == sorted(stamps),

@@ -31,13 +31,12 @@ AOT_META = "aot.json"
 
 
 def _compile_cache_on(cache_dir: str | Path) -> None:
-    import jax
+    # one cache-config path for serving cold-start AND training restart
+    # (utils/compile_cache.py) — kept as the module-local name the serving
+    # tests and operators already import
+    from kubeflow_tpu.utils.compile_cache import enable_persistent_cache
 
-    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
-    # default thresholds skip caching cheap compiles — a serving cold start
-    # must hit the cache for EVERY executable, however small
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    enable_persistent_cache(cache_dir)
 
 
 def export_predictor(

@@ -13,8 +13,10 @@ compiles once.
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
-from typing import Iterator
+from typing import Callable, Iterator
 
 import numpy as np
 
@@ -213,6 +215,210 @@ def prefetch_to_device(
                 yield buf.popleft()
         while buf:
             yield buf.popleft()
+
+
+# ----------------------------------------------------------- async host load
+
+#: process-global loader accounting — the kftpu_train_loader_* /metrics
+#: families (observability.py reads the snapshot; trainers/drills construct
+#: loaders ad hoc, so a registry is the only stable aggregation point)
+_LOADER_MU = threading.Lock()
+_LOADER_METRICS = {
+    "batches_total": 0,          # batches handed to a consumer
+    "queue_wait_seconds_total": 0.0,   # consumer time blocked on the queue
+    "assemble_seconds_total": 0.0,     # producer-thread host work (overlapped)
+    "errors_total": 0,           # loader-thread exceptions re-raised
+    "threads_started_total": 0,
+}
+_LIVE_LOADERS = 0
+
+
+def loader_metrics_snapshot() -> dict:
+    with _LOADER_MU:
+        return dict(_LOADER_METRICS, live_loaders=_LIVE_LOADERS)
+
+
+def reset_loader_metrics() -> None:
+    """Test hook: zero the counters (live_loaders is recomputed live)."""
+    with _LOADER_MU:
+        for k in _LOADER_METRICS:
+            _LOADER_METRICS[k] = 0 if isinstance(
+                _LOADER_METRICS[k], int) else 0.0
+
+
+class _LoaderStop(Exception):
+    """Internal: consumer closed while the producer was blocked."""
+
+
+class AsyncLoader:
+    """Background-thread host input pipeline: batch assembly + host
+    sharding off the step critical path (ROADMAP item 5; the MLPerf
+    async-input-pipeline move of 1909.09756).
+
+    Pulls items from `src` on a worker thread, applies `transform` (the
+    expensive host work — e.g. ``shard_batch``, whose ``device_put`` is
+    asynchronous, so the device transfer ALSO starts ahead of consumption;
+    this is how the loader composes with the existing device prefetch),
+    and hands results over a bounded queue. Contract:
+
+      - iteration order and content are EXACTLY `transform(x) for x in
+        src` — the thread moves work, never semantics;
+      - a producer-side exception is re-raised on the CONSUMING thread at
+        the position it occurred (KFTPU-EXCEPT clean: never swallowed);
+      - `close()` (or exhaustion) joins the worker — an early-exiting
+        consumer leaks no thread; idempotent, safe from `finally`;
+      - per-batch timing lands on `last_wait_s` (consumer blocked time —
+        what the step critical path actually paid) and
+        `last_assemble_s` (producer host work — overlapped), the numbers
+        the trainer stamps on its `train.data_load` spans so the step
+        breakdown splits queue-wait from host-assemble;
+      - locks are lockcheck-named (analysis/lockcheck.py), so the
+        KFTPU_LOCKCHECK=1 drills see the loader's lock in the global
+        acquisition-order graph.
+    """
+
+    def __init__(
+        self,
+        src: Iterator,
+        transform: Callable | None = None,
+        size: int = 2,
+        mesh=None,
+        name: str = "train.loader",
+    ):
+        from kubeflow_tpu.analysis.lockcheck import make_lock
+
+        self._src = iter(src)
+        self._transform = transform
+        self._mesh = mesh
+        self._size = max(1, size)
+        self._mu = make_lock(f"data.AsyncLoader._mu[{name}]")
+        self._not_empty = threading.Condition(self._mu)
+        self._not_full = threading.Condition(self._mu)
+        self._buf: list = []          # bounded by _size
+        self._done = False            # producer exhausted src
+        self._stopped = False         # consumer closed
+        self._exc: BaseException | None = None
+        self.last_wait_s = 0.0
+        self.last_assemble_s = 0.0
+        global _LIVE_LOADERS
+        with _LOADER_MU:
+            _LOADER_METRICS["threads_started_total"] += 1
+            _LIVE_LOADERS += 1
+        self._counted_live = True
+        self._thread = threading.Thread(
+            target=self._run, name=f"kftpu-{name}", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ producer
+
+    def _run(self) -> None:
+        try:
+            if self._mesh is not None:
+                from kubeflow_tpu.utils import compat
+
+                with compat.set_mesh(self._mesh):
+                    self._produce()
+            else:
+                self._produce()
+        except _LoaderStop:
+            # consumer closed early — normal shutdown; still mark done so
+            # a straggling next() can never block on a dead producer
+            with self._mu:
+                self._done = True
+                self._not_empty.notify_all()
+        except BaseException as e:  # noqa: BLE001 — carried to the consumer
+            with self._mu:
+                self._exc = e
+                self._done = True
+                self._not_empty.notify_all()
+            with _LOADER_MU:
+                _LOADER_METRICS["errors_total"] += 1
+        else:
+            with self._mu:
+                self._done = True
+                self._not_empty.notify_all()
+        finally:
+            # the live gauge tracks RUNNING loader threads: every terminal
+            # path drops it here (natural exhaustion included — a drained
+            # loader never shows as a phantom leak), while a producer
+            # wedged inside transform never reaches this and keeps its
+            # count — exactly the leak kftpu_train_loader_live exposes
+            global _LIVE_LOADERS
+            with _LOADER_MU:
+                if self._counted_live:
+                    self._counted_live = False
+                    _LIVE_LOADERS -= 1
+
+    def _produce(self) -> None:
+        for item in self._src:
+            t0 = time.perf_counter()
+            out = self._transform(item) if self._transform else item
+            dt = time.perf_counter() - t0
+            with _LOADER_MU:
+                _LOADER_METRICS["assemble_seconds_total"] += dt
+            with self._mu:
+                while len(self._buf) >= self._size and not self._stopped:
+                    self._not_full.wait(timeout=0.1)
+                if self._stopped:
+                    raise _LoaderStop
+                self._buf.append((out, dt))
+                self._not_empty.notify()
+
+    # ------------------------------------------------------------ consumer
+
+    def __iter__(self) -> "AsyncLoader":
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        with self._mu:
+            while not self._buf and not self._done:
+                self._not_empty.wait(timeout=0.1)
+            if self._buf:
+                out, assemble = self._buf.pop(0)
+                self._not_full.notify()
+            else:
+                exc = self._exc
+                self._exc = None
+                if exc is not None:
+                    raise exc  # the producer's failure, on OUR thread
+                raise StopIteration
+        wait = time.perf_counter() - t0
+        self.last_wait_s = wait
+        self.last_assemble_s = assemble
+        with _LOADER_MU:
+            _LOADER_METRICS["batches_total"] += 1
+            _LOADER_METRICS["queue_wait_seconds_total"] += wait
+        return out
+
+    def pop_stats(self) -> dict[str, float]:
+        """Timing of the most recent batch — stamped onto the consumer's
+        train.data_load span (wait is ON the critical path; assemble is
+        the overlapped producer work, reported for the overlap ratio)."""
+        return {"wait_s": self.last_wait_s,
+                "assemble_s": self.last_assemble_s}
+
+    def close(self) -> None:
+        """Stop the producer and JOIN its thread (no daemon leak); safe to
+        call repeatedly and after exhaustion. The bounded buffer is
+        dropped — a closing consumer wants out, not the backlog (a
+        straggling next() gets StopIteration, never a stale pre-close
+        batch). A producer wedged inside `transform` (join times out)
+        keeps its live-loader count: kftpu_train_loader_live exists to
+        expose exactly that leak (the producer's own exit clears it)."""
+        with self._mu:
+            self._stopped = True
+            self._buf.clear()
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+        self._thread.join(timeout=30.0)
+
+    def __enter__(self) -> "AsyncLoader":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
 
 # ------------------------------------------------------------- sharded files

@@ -43,16 +43,27 @@ from kubeflow_tpu.tracing import get_tracer, init_worker_from_env
 from kubeflow_tpu.utils.envvars import ENV_EVENT_DIR, ENV_PROFILE_DIR
 from kubeflow_tpu.train import metrics as metrics_lib
 from kubeflow_tpu.train.checkpoint import Checkpointer
-from kubeflow_tpu.train.data import Dataset, batches, prefetch_to_device
+from kubeflow_tpu.train.data import (
+    AsyncLoader,
+    Dataset,
+    batches,
+    prefetch_to_device,
+)
 
 
-def _traced_data_iter(tracer, it):
+def _traced_data_iter(tracer, it, stats_from=None):
     """Wrap a batch iterator so each HOST-side fetch (shuffle/stack/device
     put — everything before the step dispatch) is a train.data_load span.
     Only installed when tracing is enabled; the plain loop is untouched.
     Each span carries its fetch sequence number so the profiler
     (kubeflow_tpu/profiling) can pair fetches with step cycles
-    deterministically instead of by wall-clock alone."""
+    deterministically instead of by wall-clock alone.
+
+    `stats_from` (an AsyncLoader) stamps the queue-wait vs host-assemble
+    split on each span: wait_s is what the step critical path actually
+    paid, assemble_s the producer-thread work that overlapped compute —
+    profiling.step_breakdown splits data_load into data_wait/data_assemble
+    from these, sum-exactly."""
     it = iter(it)
     seq = 0
     while True:
@@ -60,6 +71,10 @@ def _traced_data_iter(tracer, it):
         seq += 1
         try:
             batch = next(it)
+            if stats_from is not None:
+                st = stats_from.pop_stats()
+                sp.set_attribute("wait_s", round(st["wait_s"], 9))
+                sp.set_attribute("assemble_s", round(st["assemble_s"], 9))
         except StopIteration:
             return
         finally:
@@ -136,6 +151,19 @@ class TrainerConfig:
     # train/data.py load_dataset_shards) and jax assembles the global
     # batch across hosts
     data_placement: str = "replicated"  # replicated | process_local
+    # persistent XLA compile-cache dir (utils/compile_cache.py); "" defers
+    # to the pod env contract (the jobcontroller injects a platform-wide
+    # dir that SURVIVES gang restarts). When a dir resolves either way,
+    # fit() warm-starts the train-step executables under a train.compile
+    # span — a restarted incarnation performs zero backend compilations
+    # of the train step (docs/perf.md "MFU hunt").
+    compile_cache_dir: str = ""
+    # background-thread host input pipeline (train/data.AsyncLoader):
+    # batch assembly + host sharding run off the step critical path,
+    # composing with the async device_put transfer. Batch order and
+    # content are identical either way; False restores the inline
+    # double-buffered prefetch.
+    async_loader: bool = True
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -206,6 +234,8 @@ class Trainer:
         self._fused_cache: dict[int, Callable] = {}  # n -> jitted n-step scan
         self._fused_compiled: dict[int, Any] = {}  # n -> AOT executable
         self._fused_data_cache: dict[int, Callable] = {}  # k -> data-scan
+        self._fused_data_compiled: dict[int, Any] = {}  # k -> AOT executable
+        self._step_compiled: Any = None  # warm_start's AOT single-step
         self._jit_eval_step = jax.jit(self._eval_step)
         self.checkpointer = (
             Checkpointer(
@@ -443,7 +473,19 @@ class Trainer:
         # ambient mesh enables P-form with_sharding_constraint pins inside
         # models (bert.constrain) without threading the mesh through modules
         with compat.set_mesh(self.mesh):
-            return self._jit_train_step(state, self._place(batch))
+            placed = self._place(batch)
+            if self._step_compiled is not None:
+                try:
+                    # warm_start's executable (reloaded from the compile
+                    # cache on a restarted incarnation, or AOT-compiled at
+                    # setup) — same program as the jit path; a signature
+                    # mismatch falls through to jit dispatch ONCE and
+                    # drops the executable (retrying every step would put
+                    # a raise/catch on the hot path this PR exists to thin)
+                    return self._step_compiled(state, placed)
+                except (TypeError, ValueError):
+                    self._step_compiled = None
+            return self._jit_train_step(state, placed)
 
     def train_steps_fused(
         self, state: TrainState, batch, n: int
@@ -509,6 +551,14 @@ class Trainer:
             s = stacked_batch_sharding(self.mesh)
             place = put_process_local if self._process_local else put_global
             xs = jax.tree.map(lambda a: place(a, s), stacked)
+            compiled = self._fused_data_compiled.get(k)
+            if compiled is not None:
+                try:
+                    # warm_start's k-scan executable — same
+                    # drop-on-mismatch contract as train_step
+                    return compiled(state, xs)
+                except (TypeError, ValueError):
+                    self._fused_data_compiled.pop(k, None)
             return self._fused_data_fn(k)(state, xs)
 
     def compile_fused(self, state: TrainState, batch, n: int):
@@ -527,6 +577,161 @@ class Trainer:
             compiled = self._fused_fn(n).lower(state, batch).compile()
             self._fused_compiled[n] = compiled
         return compiled, batch
+
+    # ----------------------------------------------------------- warm start
+
+    def _executable_key(self, placed_batch, kind: str) -> str:
+        """Everything that changes the compiled step program, folded into
+        one content key (utils/compile_cache.executable_key adds jax
+        version + backend). Functions are keyed by qualname + a hash of
+        their BYTECODE (co_code/co_consts), so editing a custom loss_fn's
+        body invalidates the cached binary; closure VALUES and code the
+        function merely calls are not captured — a cache dir shared
+        across such changes should be cleared (the entries are otherwise
+        content-addressed and safe to share)."""
+        import functools
+        import hashlib
+
+        from kubeflow_tpu.utils import compile_cache as cc
+
+        c = self.config
+
+        def _code_blob(code) -> bytes:
+            # recursive bytecode fingerprint: nested functions/lambdas are
+            # code objects inside co_consts whose repr carries a memory
+            # address — descend into them instead of repr'ing (the same
+            # key-poison the model repr is scrubbed of below)
+            parts = [code.co_code]
+            for const in code.co_consts:
+                if hasattr(const, "co_code"):
+                    parts.append(_code_blob(const))
+                else:
+                    parts.append(repr(const).encode())
+            return b"|".join(parts)
+
+        def _fn_id(fn) -> str:
+            if isinstance(fn, functools.partial):
+                kw = sorted((fn.keywords or {}).items())
+                return (f"partial({_fn_id(fn.func)},"
+                        f"args={fn.args!r},kw={kw!r})")
+            code = getattr(fn, "__code__", None) or getattr(
+                getattr(type(fn), "__call__", None), "__code__", None)
+            name = getattr(fn, "__qualname__", None) or type(fn).__name__
+            if code is not None:
+                digest = hashlib.sha256(_code_blob(code)).hexdigest()[:12]
+                return f"{name}#{digest}"
+            # no bytecode to fingerprint (C callable): name-only — stable
+            # across processes, unlike a repr carrying a memory address
+            return name
+
+        import re
+
+        batch_avals = jax.tree.map(
+            lambda a: (tuple(a.shape), str(a.dtype)), placed_batch)
+        # default object reprs carry a memory address — key-poison that
+        # would make every process miss; strip it so such models key by
+        # class (weaker, but stable) while flax reprs keep their fields
+        model_repr = re.sub(r" at 0x[0-9a-fA-F]+", "", repr(self.model))
+        return cc.executable_key(
+            kind=kind,
+            model=model_repr,
+            apply_fn=_fn_id(self.apply_fn),
+            loss_fn=_fn_id(self.loss_fn),
+            eval_metrics_fn=_fn_id(self.eval_metrics_fn),
+            mesh=tuple(sorted(self.mesh.shape.items())),
+            batch=batch_avals,
+            compute_dtype=str(jnp.dtype(c.compute_dtype)),
+            opt=(c.learning_rate, c.weight_decay, c.grad_clip_norm,
+                 c.lr_schedule, c.lr_final_fraction, c.warmup_steps,
+                 c.steps, c.grad_accum_steps),
+        )
+
+    def warm_start(self, sample_x, sample_y, cache_dir: str = "",
+                   fused_k: int = 1) -> dict:
+        """Make the train-step executables exist WITHOUT paying a backend
+        compile on a restarted incarnation (ROADMAP item 5; the restart-
+        recompile cost of 2011.03641).
+
+        Enables the persistent XLA cache at `cache_dir` (or the resolved
+        config/env dir), then per program (single step; plus the k-step
+        data-scan when fused_k > 1): reload the serialized executable by
+        content key — trace AND compile skipped — else AOT-compile it
+        (backend compile served from the persistent cache when warm) and
+        serialize it for the next incarnation. Returns the attribution
+        dict fit() stamps on its train.compile span; no-op ({"enabled":
+        False}) when no cache dir resolves anywhere."""
+        from kubeflow_tpu.utils import compile_cache as cc
+
+        cache_dir = cc.cache_dir_from_env(
+            cache_dir or self.config.compile_cache_dir)
+        if not cache_dir:
+            return {"enabled": False}
+        cc.enable_persistent_cache(cache_dir)
+        before = cc.compile_counts()
+        reloaded: list[str] = []
+        compiled_now: list[str] = []
+        sample_x = np.asarray(sample_x)
+        sample_y = np.asarray(sample_y)
+        # the per-step loop feeds each process ONLY its slice of the
+        # global batch (fit's per-step path divides batch_size by the
+        # process count under process_local); the fused k-scan stacks
+        # FULL batches — warm each program at the exact shape it will see
+        local = max(len(sample_x) // (jax.process_count()
+                                      if self._process_local else 1), 1)
+        with compat.set_mesh(self.mesh):
+            # the content key needs only the batch avals (+ config/mesh);
+            # the abstract state — an eval_shape trace of the whole model
+            # build — is built LAZILY, only when something must actually
+            # compile: on the warm path the reload skips tracing entirely
+            abstract = None
+
+            def _abstract():
+                nonlocal abstract
+                if abstract is None:
+                    abstract = self.abstract_state(sample_x[:local])
+                return abstract
+
+            placed = self._place((sample_x[:local], sample_y[:local]))
+            key = self._executable_key(placed, kind="train_step")
+            loaded = cc.load_executable(cache_dir, key)
+            if loaded is None:
+                loaded = self._jit_train_step.lower(
+                    _abstract(), placed).compile()
+                cc.save_executable(cache_dir, key, loaded)
+                compiled_now.append("train_step")
+            else:
+                reloaded.append("train_step")
+            self._step_compiled = loaded
+            if fused_k > 1:
+                s = stacked_batch_sharding(self.mesh)
+                place = (put_process_local if self._process_local
+                         else put_global)
+                stacked = tuple(
+                    np.stack([a] * fused_k) for a in (sample_x, sample_y))
+                xs = jax.tree.map(lambda a: place(a, s), stacked)
+                kkey = self._executable_key(
+                    xs, kind=f"train_chunk_{fused_k}")
+                kc = cc.load_executable(cache_dir, kkey)
+                if kc is None:
+                    kc = self._fused_data_fn(fused_k).lower(
+                        _abstract(), xs).compile()
+                    cc.save_executable(cache_dir, kkey, kc)
+                    compiled_now.append(f"train_chunk_{fused_k}")
+                else:
+                    reloaded.append(f"train_chunk_{fused_k}")
+                self._fused_data_compiled[fused_k] = kc
+        after = cc.compile_counts()
+        return {
+            "enabled": True,
+            "cache_dir": cache_dir,
+            "key": key,
+            "reloaded": ",".join(reloaded),
+            "compiled": ",".join(compiled_now),
+            "backend_misses": (after["backend_misses_total"]
+                               - before["backend_misses_total"]),
+            "backend_requests": (after["requests_total"]
+                                 - before["requests_total"]),
+        }
 
     # ------------------------------------------------------------------- fit
 
@@ -561,6 +766,15 @@ class Trainer:
         import os
 
         c = self.config
+        # Enable the persistent compile cache BEFORE the first compile:
+        # jax latches the cache state at first use, so enabling it after
+        # init_state would leave this process's cache writes silently
+        # skipped (see utils/compile_cache.enable_persistent_cache).
+        from kubeflow_tpu.utils import compile_cache as _cc
+
+        cache_dir = _cc.cache_dir_from_env(c.compile_cache_dir)
+        if cache_dir:
+            _cc.enable_persistent_cache(cache_dir)
         state = self.init_state(dataset.x_train[: c.batch_size])
 
         event_dir = c.event_dir or os.environ.get(ENV_EVENT_DIR, "")
@@ -583,6 +797,24 @@ class Trainer:
             if restored is not None:
                 start_step, state = restored
                 metrics_lib.emit(step=start_step, resumed=1)
+
+        # Restart-warm compile (docs/perf.md "MFU hunt"): with a compile
+        # cache configured (config or the pod env the jobcontroller
+        # injects), pin the step executables NOW under a train.compile
+        # span — so a restarted incarnation's recompile cost is zero
+        # backend compiles, and the profiler can split restart overhead
+        # into compile vs restore vs schedule. Without a cache dir this
+        # is a no-op and the first step compiles inline, as before.
+        if cache_dir:
+            per_epoch = len(dataset.x_train) // c.batch_size
+            with tracer.span("train.compile") as sp:
+                info = self.warm_start(
+                    dataset.x_train[:c.batch_size],
+                    dataset.y_train[:c.batch_size],
+                    fused_k=min(c.fused_steps, max(per_epoch, 1)),
+                )
+                for k, v in info.items():
+                    sp.set_attribute(k, v)
 
         # TPU preemption contract: on SIGTERM save a checkpoint and exit
         # cleanly so the gang restart resumes instead of losing the epoch
@@ -746,29 +978,55 @@ class Trainer:
                     if after(1, m):
                         break
             else:
-                batch_src = prefetch_to_device(
-                    batches(
-                        dataset.x_train, dataset.y_train,
-                        # process_local: each host feeds its 1/P slice of
-                        # the GLOBAL batch (equal counts guaranteed by
-                        # load_dataset_shards), keeping step counts in
-                        # lockstep across the gang
-                        c.batch_size // (jax.process_count()
-                                         if self._process_local else 1),
-                        seed=c.seed + epoch,
-                    ),
-                    self.mesh,
-                    process_local=self._process_local,
+                raw = batches(
+                    dataset.x_train, dataset.y_train,
+                    # process_local: each host feeds its 1/P slice of
+                    # the GLOBAL batch (equal counts guaranteed by
+                    # load_dataset_shards), keeping step counts in
+                    # lockstep across the gang
+                    c.batch_size // (jax.process_count()
+                                     if self._process_local else 1),
+                    seed=c.seed + epoch,
                 )
+                loader = None
+                if c.async_loader:
+                    # batch assembly + host sharding on a background
+                    # thread (train/data.AsyncLoader): shard_batch's
+                    # device_put is asynchronous, so the transfer also
+                    # starts ahead of consumption — the double-buffered
+                    # prefetch's overlap, plus the host work itself off
+                    # the step critical path
+                    loader = AsyncLoader(
+                        raw,
+                        transform=lambda b: shard_batch(
+                            b, self.mesh,
+                            process_local=self._process_local),
+                        size=2,
+                        mesh=self.mesh,
+                    )
+                    batch_src = loader
+                else:
+                    batch_src = prefetch_to_device(
+                        raw, self.mesh,
+                        process_local=self._process_local,
+                    )
                 if tracer.enabled:
-                    batch_src = _traced_data_iter(tracer, batch_src)
-                for bx, by in batch_src:
-                    if global_step >= total_steps or stop["flag"]:
-                        break
-                    with tracer.span("train.step", step=global_step):
-                        state, m = self.train_step(state, (bx, by))
-                    if after(1, m):
-                        break
+                    batch_src = _traced_data_iter(
+                        tracer, batch_src, stats_from=loader)
+                try:
+                    for bx, by in batch_src:
+                        if global_step >= total_steps or stop["flag"]:
+                            break
+                        with tracer.span("train.step", step=global_step):
+                            state, m = self.train_step(state, (bx, by))
+                        if after(1, m):
+                            break
+                finally:
+                    # every exit path (preemption, early stop, the steps
+                    # boundary, an exception) joins the loader thread —
+                    # an abandoned epoch must not leak its producer
+                    if loader is not None:
+                        loader.close()
             if stop["flag"]:
                 return state, {**last, "preempted": 1.0}
             epoch += 1

@@ -1,0 +1,245 @@
+"""Persistent XLA compile cache + serialized executables — ONE config path.
+
+Two layers of compile reuse, shared by serving cold-start (serving/aot.py)
+and training gang-restart (train/trainer.py warm_start):
+
+  1. The **persistent XLA compilation cache**: `enable_persistent_cache`
+     points jax's backend-compile cache at a directory (thresholds zeroed —
+     a restarted process must hit for EVERY executable, however small).
+     A re-traced program whose HLO matches a cached entry skips the XLA
+     compiler entirely; the `/jax/compilation_cache/cache_misses`
+     monitoring counter (install_compile_listener / compile_counts) is the
+     proof both the serving AOT tests and the `train_restart_warm`
+     cpu-proxy gate assert on.
+  2. **Serialized executables**: `save_executable` / `load_executable`
+     persist a jitted program's COMPILED form (jax.experimental.
+     serialize_executable) keyed by `executable_key(...)` — reloading
+     skips trace AND compile, the strongest restart-warm path. Keys must
+     cover everything that changes the program: model-config hash, mesh
+     shape, batch shapes/dtypes, compute dtype, jax version.
+
+Why restart-warm matters (ROADMAP item 5, papers 1909.09756 / 2011.03641):
+every gang restart previously paid a full re-trace+recompile of the train
+step — orchestration overhead capping goodput while the chips idle. With
+the cache dir injected into pod env (ENV_COMPILE_CACHE_DIR, jobcontroller)
+and surviving restarts, a restarted incarnation performs zero backend
+compilations of the train step.
+
+Process-global metrics land in /metrics as the kftpu_train_compile_*
+families (observability.py); `reset_compile_metrics` is the test hook.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from pathlib import Path
+
+from kubeflow_tpu.utils.envvars import ENV_COMPILE_CACHE_DIR
+
+#: suffix of serialized-executable artifacts inside <cache_dir>/executables
+EXECUTABLE_SUFFIX = ".kfexec"
+
+#: size bound for the executables dir — the shared cache deliberately
+#: survives restarts and nothing else ever deletes from it, so without a
+#: cap a long-lived platform accumulates one artifact per distinct
+#: (model, shape, dtype, knobs, jax version) forever. Oldest-mtime
+#: artifacts are evicted after each save; reloads touch mtime, so the
+#: sweep is LRU in practice. (The XLA persistent-cache entries beside it
+#: are jax's own; bound those with jax's cache-size flags where needed.)
+EXECUTABLE_DIR_MAX_BYTES = 2 << 30
+
+_MU = threading.Lock()
+#: process-global counters (kftpu_train_compile_* in /metrics). Backend
+#: miss/request counts come from the jax monitoring listener; the
+#: executable reload/save counts from load_/save_executable.
+_METRICS = {
+    "requests_total": 0,          # backend compiles that consulted the cache
+    "backend_misses_total": 0,    # backend compiles the XLA compiler ran
+    "executable_reloads_total": 0,  # deserialized pre-compiled executables
+    "executable_saves_total": 0,    # executables serialized for later runs
+}
+_LISTENER_INSTALLED = False
+
+
+def enable_persistent_cache(cache_dir: str | Path) -> None:
+    """Point jax's persistent backend-compile cache at `cache_dir` and
+    zero the size/time thresholds (the default thresholds skip caching
+    cheap compiles — a restarted incarnation must hit the cache for EVERY
+    executable, however small). Also installs the miss-counting listener
+    so compile_counts() deltas are meaningful from the first compile.
+
+    jax LATCHES the cache state at the first compile: a process that
+    compiled anything before this call (e.g. a trainer whose init ran
+    first) has the cache pinned "disabled/not initialized", and a later
+    config update alone leaves every subsequent write silently skipped —
+    reads would miss and NO miss event would ever fire, making a
+    zero-miss assertion vacuously true. reset_cache() drops the latch so
+    the next compile re-initializes against the directory just set."""
+    import jax
+    from jax.experimental.compilation_cache import (
+        compilation_cache as jax_cc,
+    )
+
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax_cc.reset_cache()
+    install_compile_listener()
+
+
+def cache_dir_from_env(explicit: str = "") -> str:
+    """The effective cache dir: an explicit config value wins, else the
+    pod env contract (ENV_COMPILE_CACHE_DIR, injected by the
+    jobcontroller), else "" (caching off)."""
+    return explicit or os.environ.get(ENV_COMPILE_CACHE_DIR, "")
+
+
+def install_compile_listener() -> None:
+    """Count backend compile requests/misses process-globally via the
+    jax.monitoring events the compilation cache emits. Idempotent; safe
+    to call before any cache is enabled (events simply don't fire)."""
+    global _LISTENER_INSTALLED
+    with _MU:
+        if _LISTENER_INSTALLED:
+            return
+        _LISTENER_INSTALLED = True
+    import jax.monitoring as mon
+
+    def _listener(event: str, **kwargs) -> None:
+        if event == "/jax/compilation_cache/cache_misses":
+            with _MU:
+                _METRICS["backend_misses_total"] += 1
+        elif event == "/jax/compilation_cache/compile_requests_use_cache":
+            with _MU:
+                _METRICS["requests_total"] += 1
+
+    mon.register_event_listener(_listener)
+
+
+def compile_counts() -> dict[str, int]:
+    """Snapshot of the process-global counters — subtract two snapshots
+    to get the misses/requests a code region caused (the zero-backend-
+    compilations assertion pattern)."""
+    with _MU:
+        return dict(_METRICS)
+
+
+def compile_metrics_snapshot() -> dict[str, int]:
+    """Alias used by observability.render_metrics (kftpu_train_compile_*)."""
+    return compile_counts()
+
+
+def reset_compile_metrics() -> None:
+    """Test hook: zero the counters (the listener stays installed)."""
+    with _MU:
+        for k in _METRICS:
+            _METRICS[k] = 0
+
+
+def executable_key(**parts) -> str:
+    """Deterministic content key for a serialized executable. Callers pass
+    everything that changes the compiled program (model-config hash, mesh
+    shape, batch shapes/dtypes, compute dtype, optimizer knobs, fused step
+    count); jax version and backend are always folded in — a cache dir
+    shared across upgrades must never replay a stale binary."""
+    import jax
+
+    parts = dict(parts)
+    parts["jax_version"] = jax.__version__
+    parts["backend"] = jax.default_backend()
+    blob = "\x1f".join(f"{k}={parts[k]!r}" for k in sorted(parts))
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def executable_path(cache_dir: str | Path, key: str) -> Path:
+    return Path(cache_dir) / "executables" / f"{key}{EXECUTABLE_SUFFIX}"
+
+
+def save_executable(cache_dir: str | Path, key: str, compiled) -> Path | None:
+    """Serialize a compiled executable (jax.experimental
+    .serialize_executable) under its key. Returns the path, or None when
+    this jax cannot serialize (the persistent backend cache still covers
+    the restart — degraded, not broken). Writes are atomic (tmp+rename)
+    so a killed pod never leaves a torn artifact for the next one."""
+    try:
+        import pickle
+
+        from jax.experimental.serialize_executable import serialize
+    except ImportError:
+        return None
+    path = executable_path(cache_dir, key)
+    try:
+        payload, in_tree, out_tree = serialize(compiled)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(path.suffix + f".tmp.{os.getpid()}")
+        with open(tmp, "wb") as fh:
+            pickle.dump((payload, in_tree, out_tree), fh)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — serialization support varies by
+        # backend/version; a failed save must never fail training, and the
+        # persistent backend cache above still makes the restart warm
+        return None
+    with _MU:
+        _METRICS["executable_saves_total"] += 1
+    _evict_lru(path.parent, keep=path)
+    return path
+
+
+def _evict_lru(exec_dir: Path,
+               keep: Path | None = None,
+               max_bytes: int | None = None) -> None:
+    """Drop oldest-mtime executables until the dir fits the size bound
+    (the entry just saved is never the victim). Best-effort: a racing
+    pod deleting the same file is fine."""
+    limit = EXECUTABLE_DIR_MAX_BYTES if max_bytes is None else max_bytes
+    try:
+        entries = []
+        for p in exec_dir.glob(f"*{EXECUTABLE_SUFFIX}"):
+            st = p.stat()
+            entries.append((st.st_mtime, st.st_size, p))
+        total = sum(size for _, size, _ in entries)
+        for _, size, p in sorted(entries):
+            if total <= limit:
+                break
+            if keep is not None and p == keep:
+                continue
+            p.unlink()
+            total -= size
+    except OSError:
+        return
+
+
+def load_executable(cache_dir: str | Path, key: str):
+    """Deserialize a previously saved executable — trace AND compile are
+    both skipped. Returns the loaded callable, or None when absent /
+    unreadable / built by an incompatible jax (key covers version, but a
+    torn write or backend drift still degrades gracefully to None)."""
+    path = executable_path(cache_dir, key)
+    if not path.exists():
+        return None
+    try:
+        import pickle
+
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        with open(path, "rb") as fh:
+            payload, in_tree, out_tree = pickle.load(fh)
+        loaded = deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:  # noqa: BLE001 — a corrupt artifact must degrade to
+        # a normal (cache-warm) compile, never crash the incarnation
+        try:
+            path.unlink()  # quarantine-by-removal: don't retry it forever
+        except OSError:
+            pass
+        return None
+    try:
+        os.utime(path)  # a hit is a use: keep it young for the LRU sweep
+    except OSError:
+        pass  # kftpu: allow=KFTPU-EXCEPT (best-effort mtime touch)
+    with _MU:
+        _METRICS["executable_reloads_total"] += 1
+    return loaded

@@ -34,6 +34,11 @@ ENV_HEARTBEAT_FILE = "KFTPU_HEARTBEAT_FILE"
 ENV_HEARTBEAT_DROP = "KFTPU_HB_DROP"
 #: jax.profiler trace output dir (per-process; JAXJob profile toggle)
 ENV_PROFILE_DIR = "KFTPU_PROFILE_DIR"
+#: persistent XLA compile-cache directory (utils/compile_cache.py). The
+#: jobcontroller injects a per-platform path that SURVIVES gang restarts,
+#: so a restarted incarnation replays its train-step executables from the
+#: cache instead of paying a full re-trace+recompile (docs/perf.md)
+ENV_COMPILE_CACHE_DIR = "KFTPU_COMPILE_CACHE_DIR"
 #: tfevents scalar output dir for TensorBoard
 ENV_EVENT_DIR = "KFTPU_EVENT_DIR"
 

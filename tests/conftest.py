@@ -41,11 +41,14 @@ def lockcheck_armed(request):
     cycles is an acceptance contract, not a nice-to-have. The fleet
     drills join the set: N engine tickers + router callbacks + one shared
     paged-KV pool lock is exactly the nesting the detector exists for.
+    The hotpath drills too: the AsyncLoader's producer/consumer condition
+    pair is a brand-new cross-thread lock site on the trainer hot path.
     Scoped by marker so the rest of the suite runs with the detector's
     production default (disabled passthrough)."""
     if not (request.node.get_closest_marker("chaos")
             or request.node.get_closest_marker("health")
-            or request.node.get_closest_marker("fleet")):
+            or request.node.get_closest_marker("fleet")
+            or request.node.get_closest_marker("hotpath")):
         yield
         return
     from kubeflow_tpu.analysis import lockcheck

@@ -82,11 +82,16 @@ class TestGoldenExposition:
 
         from kubeflow_tpu.health import reset_ckpt_verify_metrics
         from kubeflow_tpu.observability import render_metrics
+        from kubeflow_tpu.train.data import reset_loader_metrics
+        from kubeflow_tpu.utils.compile_cache import reset_compile_metrics
 
-        # kftpu_ckpt_verify_* is process-global (checkpointers report from
-        # wherever they were opened); zero it so this pins the same fresh-
-        # process surface regardless of which tests ran first
+        # kftpu_ckpt_verify_* / kftpu_train_* are process-global (the
+        # reporters are constructed wherever trainers run); zero them so
+        # this pins the same fresh-process surface regardless of which
+        # tests ran first
         reset_ckpt_verify_metrics()
+        reset_loader_metrics()
+        reset_compile_metrics()
         p = Platform(log_dir=str(tmp_path / "logs"))
         p.start_tracing(capacity=4096)
         text = render_metrics(p)
