@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -61,6 +61,13 @@ test-fleet:
 # (docs/perf.md "MFU hunt")
 test-hotpath:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_hotpath.py -q -m hotpath
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
+
+# kftpu-partition suite: logical-axis rule derivation, legacy round-trip,
+# hybrid-mesh guard, bf16-by-default numerics gate, buffer-donation
+# accounting, and the grad_overlap cpu-proxy gate (docs/partitioner.md)
+test-partition:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_partitioner.py -q -m partition
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 native:

@@ -81,8 +81,8 @@ class VocabEmbed(nn.Embed):
         mesh = compat.get_abstract_mesh()
         if mesh.empty:
             return super().__call__(inputs)
-        (table,) = self.promote_dtype(self.embedding, dtype=self.dtype,
-                                      inexact=False)
+        (table,) = compat.promote_dtype(self, self.embedding,
+                                        dtype=self.dtype, inexact=False)
         if mesh.shape.get(AXIS_MODEL, 1) > 1:
             onehot = jax.nn.one_hot(inputs, self.num_embeddings, dtype=table.dtype)
             return jnp.dot(onehot, table)
@@ -321,9 +321,12 @@ class BertForMaskedLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-# the Trainer picks TP rules up from the model class (trainer.py)
+# the Trainer picks TP rules up from the model class (trainer.py); the
+# encoder family is MXU-heavy, so AUTO compute dtype resolves to bf16 on
+# accelerator backends (trainer.resolve_compute_dtype)
 for _cls in (BertEncoder, BertForSequenceClassification, BertForMaskedLM):
     _cls.PARTITION_RULES = PARTITION_RULES
+    _cls.PREFERRED_COMPUTE_DTYPE = jnp.bfloat16
 
 
 # --------------------------------------------------------------- MLM training

@@ -492,6 +492,10 @@ class GPTLM(nn.Module):
 
 
 GPTLM.PARTITION_RULES = PARTITION_RULES
+# bf16-by-default (trainer.resolve_compute_dtype): transformer LM matmuls
+# are MXU-bound — on accelerator backends the Trainer flips the module's
+# compute dtype to this unless the user pins compute_dtype explicitly
+GPTLM.PREFERRED_COMPUTE_DTYPE = jnp.bfloat16
 
 
 # Decode blocks at or under this many tokens route MoE DROPLESS (dense

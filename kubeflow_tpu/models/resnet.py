@@ -116,6 +116,11 @@ class ResNet(nn.Module):
     stem + maxpool for a 3x3 stem (CIFAR/MNIST-scale images).
     """
 
+    #: MXU-heavy: the Trainer's AUTO compute dtype resolves to bf16 on
+    #: accelerator backends (trainer.resolve_compute_dtype clones the
+    #: module with `dtype` flipped; params stay f32)
+    PREFERRED_COMPUTE_DTYPE = jnp.bfloat16
+
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
     num_classes: int = 1000

@@ -142,3 +142,5 @@ class ViTClassifier(nn.Module):
 
 
 ViTClassifier.PARTITION_RULES = PARTITION_RULES
+# MXU-heavy: AUTO compute dtype resolves to bf16 on accelerator backends
+ViTClassifier.PREFERRED_COMPUTE_DTYPE = jnp.bfloat16

@@ -140,6 +140,25 @@ def render_metrics(platform) -> str:
         help_="AsyncLoader producer threads still running "
               "(a wedged loader thread shows here)",
     )
+    # gradient-communication ledger (parallel/partitioner.py, docs/
+    # partitioner.md "Overlap mechanics"): host-visible comm time left ON
+    # the step critical path, and the latest overlapped/serialized
+    # step-time ratio the grad_overlap machinery measured. Process-global
+    # and zero-valued when idle, like the loader/compile families above.
+    from kubeflow_tpu.parallel.partitioner import comm_metrics_snapshot
+
+    comm_snap = comm_metrics_snapshot()
+    counter("kftpu_train_comm_seconds_total",
+            f"{comm_snap['comm_seconds_total']:.6f}",
+            help_="gradient-collective wall time charged to step "
+                  "critical paths (train.comm spans)")
+    counter("kftpu_train_comm_overlap_measurements_total",
+            comm_snap["overlap_measurements_total"])
+    gauge(
+        "kftpu_train_overlap_ratio", comm_snap["overlap_ratio"],
+        help_="latest overlapped/serialized step-time ratio from the "
+              "grad_overlap measurement (lower is better; 0 = none yet)",
+    )
 
     # liveness layer (kubeflow_tpu/health.py): lease expiries and straggler
     # declarations counted apart from crash deaths, plus per-incarnation

@@ -7,10 +7,12 @@ annotations; XLA inserts the ICI/DCN collectives.
 """
 
 from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh, AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_CONTEXT, AXIS_EXPERT, AXIS_PIPELINE
+from kubeflow_tpu.parallel.partitioner import Partitioner
 from kubeflow_tpu.parallel import ring_attention
 
 __all__ = [
     "MeshConfig",
+    "Partitioner",
     "build_mesh",
     "ring_attention",
     "AXIS_DATA",

@@ -14,7 +14,6 @@ TP:   models publish PARTITION_RULES — (path_regex, PartitionSpec) pairs
 
 from __future__ import annotations
 
-import re
 from typing import Any, Sequence
 
 import jax
@@ -48,19 +47,12 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 def fsdp_param_pspec(shape: tuple[int, ...], fsdp_size: int, min_size: int = 2**12) -> P:
     """Shard the largest dim divisible by fsdp_size; tiny params replicate.
 
-    min_size gate: sharding a 128-float bias wastes a collective; only params
-    with >= min_size elements are sharded (same heuristic big FSDP impls use).
-    """
-    if fsdp_size <= 1 or int(np.prod(shape)) < min_size:
-        return P()
-    # prefer the largest divisible dim (most even split, fewest pad bytes)
-    candidates = [i for i, d in enumerate(shape) if d % fsdp_size == 0]
-    if not candidates:
-        return P()
-    dim = max(candidates, key=lambda i: shape[i])
-    spec: list[Any] = [None] * len(shape)
-    spec[dim] = AXIS_FSDP
-    return P(*spec)
+    Thin wrapper: the heuristic now lives in parallel/partitioner.py as
+    the derivation's bottom tier (min_size gate unchanged — sharding a
+    128-float bias wastes a collective)."""
+    from kubeflow_tpu.parallel.partitioner import heuristic_pspec
+
+    return heuristic_pspec(shape, fsdp_size, min_size)
 
 
 def param_shardings(params: Any, mesh: Mesh, min_size: int = 2**12) -> Any:
@@ -140,44 +132,32 @@ def shard_batch(batch: Any, mesh: Mesh, process_local: bool = False) -> Any:
 
 
 def _path_str(path) -> str:
-    parts = []
-    for k in path:
-        if hasattr(k, "key"):
-            parts.append(str(k.key))
-        elif hasattr(k, "name"):
-            parts.append(str(k.name))
-        elif hasattr(k, "idx"):
-            parts.append(str(k.idx))
-        else:
-            parts.append(str(k))
-    return "/".join(parts)
+    """Thin wrapper: partitioner.path_str_of is the one stringifier, so
+    legacy and partitioner-side rule matching can never see different
+    path strings for the same leaf."""
+    from kubeflow_tpu.parallel.partitioner import path_str_of
+
+    return path_str_of(path)
 
 
 def _spec_fits(spec: P, shape: tuple[int, ...], mesh: Mesh) -> bool:
-    """A rule spec applies only if rank matches and every named dim divides."""
-    if len(spec) > len(shape):
-        return False
-    for dim, axes in enumerate(spec):
-        if axes is None:
-            continue
-        axes = axes if isinstance(axes, tuple) else (axes,)
-        size = int(np.prod([mesh.shape[a] for a in axes]))
-        if shape[dim] % size != 0:
-            return False
-    return True
+    """A rule spec applies only if rank matches and every named dim divides.
+    Thin wrapper over partitioner.spec_fits (the all-or-nothing legacy
+    contract; the partitioner's own tier fits per-dim instead)."""
+    from kubeflow_tpu.parallel.partitioner import spec_fits
+
+    return spec_fits(spec, shape, mesh)
 
 
 def state_pspec(
     path_str: str, shape: tuple[int, ...], mesh: Mesh, rules: Rules | None
 ) -> P:
-    """PartitionSpec for one state leaf: rules first, FSDP heuristic second."""
-    if len(shape) == 0:
-        return P()
-    if rules:
-        for pattern, spec in rules:
-            if re.search(pattern, path_str) and _spec_fits(spec, shape, mesh):
-                return spec
-    return fsdp_param_pspec(shape, mesh.shape[AXIS_FSDP])
+    """PartitionSpec for one state leaf: rules first, FSDP heuristic second.
+    Thin wrapper — parallel/partitioner.resolve_pspec is the one owner of
+    this derivation; existing callers keep this entry point."""
+    from kubeflow_tpu.parallel.partitioner import resolve_pspec
+
+    return resolve_pspec(path_str, shape, mesh, rules)
 
 
 def shard_state(state: Any, mesh: Mesh, rules: Rules | None = None) -> Any:

@@ -33,6 +33,11 @@ STEP_NAMES = ("train.step", "train.chunk")
 DATA_NAMES = ("train.data_load",)
 #: checkpoint I/O spans accounted inside a cycle
 CKPT_NAMES = ("checkpoint.save", "checkpoint.restore")
+#: gradient-communication spans accounted inside a cycle: host-visible
+#: time spent waiting on gradient collectives that did NOT overlap the
+#: backward pass (the grad_overlap cpu-proxy workload emits these; on
+#: hardware a step with full comm/compute overlap shows ~zero here)
+COMM_NAMES = ("train.comm",)
 #: span names that only the PLATFORM process emits — used to tell a
 #: platform-bearing trace apart from a workers-only flush directory
 PLATFORM_SPAN_NAMES = frozenset((
@@ -72,8 +77,11 @@ def step_breakdown(spans: list[dict]) -> list[dict]:
     cycle its END falls inside — fetch/save work is sequential with the
     step dispatch on the worker thread, so windows partition the phases.
     Each returned dict satisfies
-    ``data_load + compute + checkpoint + stall == wall`` (stall is the
-    remainder, floored at 0 against float noise).
+    ``data_load + compute + checkpoint + comm + stall == wall`` (stall is
+    the remainder, floored at 0 against float noise). ``comm`` counts
+    `train.comm` spans — gradient-collective time left ON the critical
+    path; a fully overlapped step charges ~nothing here (ROADMAP item 5's
+    comm/compute-overlap front, gated by the grad_overlap workload).
 
     data_load itself splits sum-exactly into ``data_wait + data_assemble
     == data_load``: when the async host loader stamps a ``wait_s`` attr
@@ -96,6 +104,8 @@ def step_breakdown(spans: list[dict]) -> list[dict]:
                       key=_end)
         ckpt = sorted((s for s in ss if s["name"] in CKPT_NAMES),
                       key=_end)
+        comm = sorted((s for s in ss if s["name"] in COMM_NAMES),
+                      key=_end)
         prev_end = ss[0]["ts"]
         for st in sorted(steps, key=_end):
             end = _end(st)
@@ -112,8 +122,9 @@ def step_breakdown(spans: list[dict]) -> list[dict]:
                     wait += min(float(s["attrs"].get("wait_s", 0.0)),
                                 s["dur"])
             c = sum(s["dur"] for s in ckpt if in_window(s))
+            cm = sum(s["dur"] for s in comm if in_window(s))
             compute = st["dur"]
-            stall = max(wall - compute - d - c, 0.0)
+            stall = max(wall - compute - d - c - cm, 0.0)
             out.append({
                 "pid": pid,
                 "step": st["attrs"].get("step"),
@@ -124,6 +135,7 @@ def step_breakdown(spans: list[dict]) -> list[dict]:
                 "data_assemble": d - wait,
                 "compute": compute,
                 "checkpoint": c,
+                "comm": cm,
                 "stall": stall,
             })
             prev_end = end
@@ -132,7 +144,7 @@ def step_breakdown(spans: list[dict]) -> list[dict]:
 
 def aggregate_steps(steps: list[dict]) -> dict:
     """Totals + per-step distribution over step_breakdown() output."""
-    phases = ("data_load", "compute", "checkpoint", "stall")
+    phases = ("data_load", "compute", "checkpoint", "comm", "stall")
     totals = {p: sum(s[p] for s in steps) for p in phases}
     wall = sum(s["wall"] for s in steps)
     walls = sorted(s["wall"] for s in steps)

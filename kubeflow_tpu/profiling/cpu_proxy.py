@@ -303,6 +303,191 @@ def mlp_train(steps: int = 16, batch: int = _MLP_BATCH,
     }
 
 
+# ---------------------------------------------------------- grad_overlap
+
+
+def grad_overlap(layers: int = 8, dim: int = 384, batch: int = 256,
+                 steps: int = 6) -> dict:
+    """Comm/compute-overlap gate (ROADMAP item 5, the `mlp_train` blind
+    spot the re-anchor names): the SAME per-layer backward + per-layer
+    gradient-communication work run two ways —
+
+      - overlapped: each layer's gradient is handed to a dedicated comm
+        engine the moment backward produces it, and the engine works
+        while the remaining backward keeps running — the schedule the
+        trainer's per-rule `with_sharding_constraint`s
+        (partitioner.constrain_grads) let XLA's latency-hiding scheduler
+        build on TPU, where the collective rides the ICI engine in
+        parallel with the MXU. On this CPU proxy the engine is a worker
+        thread driving device-1 dispatches (jax CPU executes
+        concurrently across host threads — measured, same mechanism the
+        AsyncLoader gate uses), and only the post-backward residual
+        drain lands on the critical path (`train.comm` span);
+      - serialized: the full backward completes first, then every
+        layer's comm runs on the critical path — the no-overlap schedule
+        (one big all-reduce after backward).
+
+    Gated: ``overlap_ratio`` = overlapped/serialized step wall (in-run,
+    machine-invariant — both sides run identical kernels in the same
+    process). The chaos hook ``KFTPU_PROF_CHAOS="grad_overlap:2"``
+    FORCES SERIALIZATION of the overlapped loop (the engine is joined
+    after every hand-off; work unchanged, pipelining destroyed), driving
+    the ratio to ~1.0 — and must fail the gate. Which gradients get a
+    collective comes from a REAL Partitioner's rule-derived specs over a
+    transformer-shaped param tree, so the workload consumes the same
+    derivation the trainer does.
+    """
+    import queue
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.parallel.partitioner import (
+        Partitioner,
+        record_comm,
+    )
+    from kubeflow_tpu.profiling.analytics import step_breakdown
+    from kubeflow_tpu.tracing import Tracer
+
+    forced_serial = chaos_repeats("grad_overlap") > 1
+    devs = jax.devices()
+    comm_dev = devs[1 % len(devs)]
+    rng = np.random.default_rng(11)
+    # transformer-shaped param paths: the partitioner's logical rules
+    # decide which grads are sharded (and therefore owe a collective)
+    pt = Partitioner()
+    paths = [f"h{i}/attn/query/kernel" for i in range(layers)]
+    specs = [pt.spec_for(p, (dim, dim)) for p in paths]
+    comm_layers = [i for i, s in enumerate(specs)
+                   if any(a is not None for a in tuple(s))]
+    Ws = [jnp.asarray(rng.standard_normal((dim, dim)).astype(np.float32)
+                      * 0.05) for _ in range(layers)]
+    mix = jax.device_put(
+        jnp.asarray(rng.standard_normal((dim, dim)).astype(np.float32)
+                    * 0.05), comm_dev)
+    cot0 = jnp.asarray(rng.standard_normal((batch, dim))
+                       .astype(np.float32))
+
+    @jax.jit
+    def bwd(cot, w):
+        # one layer of "remaining backward": produces this layer's grad
+        # and the next cotangent (a dependent chain, like real reverse-mode)
+        g = cot.T @ (cot @ w)
+        return jnp.tanh(cot @ w.T), g
+
+    @jax.jit
+    def comm_op(g, m):
+        # the all-reduce stand-in: device-1 work proportional to the
+        # gradient, off the backward's device
+        return jnp.tanh(g @ m) @ m
+
+    def comm_submit(g):
+        # async hand-off to the comm device: the transfer starts now
+        return comm_op(jax.device_put(g, comm_dev), mix)
+
+    # warmup: compile + first transfers outside every timed window
+    c, g = bwd(cot0, Ws[0])
+    jax.block_until_ready(comm_submit(g))
+    jax.block_until_ready(c)
+
+    def run_overlapped(tracer, i):
+        """Backward on the main thread; comm engine thread drains a
+        queue of grads as they appear. Returns the step wall time."""
+        work: queue.Queue = queue.Queue()
+        done: list = []
+
+        def engine():
+            while True:
+                item = work.get()
+                if item is None:
+                    return
+                done.append(jax.block_until_ready(comm_submit(item)))
+
+        t = threading.Thread(target=engine, name="kftpu-comm-engine",
+                             daemon=True)
+        t0 = time.perf_counter()
+        t.start()
+        with tracer.span("train.step", step=i):
+            cot = cot0
+            for l in range(layers):
+                cot, g = bwd(cot, Ws[l])
+                if l in comm_layers:
+                    work.put(g)
+                    if forced_serial:
+                        # chaos: wait for the engine to finish THIS
+                        # gradient before the next backward layer —
+                        # work identical, overlap destroyed
+                        while not work.empty() or len(done) < sum(
+                                1 for x in comm_layers if x <= l):
+                            time.sleep(0)
+            jax.block_until_ready(cot)
+        with tracer.span("train.comm", step=i):
+            # residual: whatever the engine has not finished by the time
+            # backward ends is un-overlapped comm on the critical path
+            work.put(None)
+            t.join()
+        return time.perf_counter() - t0
+
+    def run_serialized(tracer, i):
+        t0 = time.perf_counter()
+        with tracer.span("train.step", step=i):
+            cot = cot0
+            grads = []
+            for l in range(layers):
+                cot, g = bwd(cot, Ws[l])
+                if l in comm_layers:
+                    grads.append(g)
+            jax.block_until_ready(cot)
+            jax.block_until_ready(grads)
+        with tracer.span("train.comm", step=i):
+            for g in grads:
+                jax.block_until_ready(comm_submit(g))
+        return time.perf_counter() - t0
+
+    import gc
+
+    recs = []
+    for _ in range(2):
+        gc.collect()
+        otr, str_ = Tracer(capacity=8 * steps), Tracer(capacity=8 * steps)
+        over = _median([run_overlapped(otr, i) for i in range(steps)])
+        seri = _median([run_serialized(str_, i) for i in range(steps)])
+        ocomm = _median([s["comm"] for s in step_breakdown(otr.snapshot())
+                         if s["comm"] > 0] or [0.0])
+        scomm = _median([s["comm"] for s in step_breakdown(str_.snapshot())
+                         if s["comm"] > 0] or [0.0])
+        recs.append({"over": over, "serial": seri,
+                     "ocomm": ocomm, "scomm": scomm})
+    # per-phase min across runs (the mlp_train rationale): noise only
+    # ever inflates; the chaos hook inflates BOTH runs' overlapped side
+    over = min(r["over"] for r in recs)
+    seri = min(r["serial"] for r in recs)
+    ocomm = min(r["ocomm"] for r in recs)
+    scomm = min(r["scomm"] for r in recs)
+    ratio = over / seri if seri else 0.0
+    record_comm(ocomm, overlap_ratio=ratio)
+    return {
+        "workload": "grad_overlap",
+        "layers": layers,
+        "comm_layers": len(comm_layers),
+        "steps": steps,
+        "anchor": "serialized_step",
+        "anchor_s": round(seri, 6),
+        "phases_s": {"step_overlapped": round(over, 6),
+                     "step_serialized": round(seri, 6),
+                     "comm_residual": round(ocomm, 6),
+                     "comm_serialized": round(scomm, 6)},
+        "rel": {
+            # the gated in-run ratio: <1 means the engine genuinely hid
+            # comm behind the remaining backward; forced serialization
+            # (the chaos teeth) drives it to ~1
+            "overlap_ratio": round(ratio, 4),
+        },
+    }
+
+
 # ----------------------------------------------------- train_restart_warm
 
 
@@ -1029,8 +1214,9 @@ def cplane_storm(n_pods: int = 10000, gang_size: int = 100,
 
 # ----------------------------------------------------------------- harness
 
-WORKLOADS = ("mlp_train", "train_restart_warm", "serve_ticks",
-             "serve_fleet", "reconcile_storm", "cplane_storm")
+WORKLOADS = ("mlp_train", "grad_overlap", "train_restart_warm",
+             "serve_ticks", "serve_fleet", "reconcile_storm",
+             "cplane_storm")
 
 
 def run_all(only: str = "") -> list[dict]:
@@ -1038,6 +1224,7 @@ def run_all(only: str = "") -> list[dict]:
     best-of-2 on each workload's primary gated phase."""
     fns = {
         "mlp_train": mlp_train,  # per-phase min-of-2 internally
+        "grad_overlap": lambda: _best_of(grad_overlap, "overlap_ratio"),
         "train_restart_warm": lambda: _best_of(train_restart_warm,
                                                "warm_cold_ratio"),
         "serve_ticks": serve_ticks,
@@ -1087,13 +1274,26 @@ def make_budgets(results: list[dict]) -> dict:
                        # incarnation fails the gate (slack only); the
                        # in-run warm/cold timing ratio keeps the default
                        {"warm_backend_compiles": 1.0}
-                       if rec["workload"] == "train_restart_warm" else {}),
+                       if rec["workload"] == "train_restart_warm" else
+                       # forced serialization (the chaos teeth) lands at
+                       # ~1.0; the allowance must sit BELOW that or the
+                       # teeth cannot bite, and above the regen budget's
+                       # noise band — 1.2x + slack does both for a
+                       # healthy (<0.75) overlap ratio
+                       {"overlap_ratio": 1.2}
+                       if rec["workload"] == "grad_overlap" else {}),
             # per-phase slack override: the default absolute slack would
             # swamp a near-zero budget (0.02*1.5 + 0.08 tolerates a 5x
             # regression of the async win) — tighten it so a partial
-            # re-inlining of host input work fails, not just a blowup
+            # re-inlining of host input work fails, not just a blowup.
+            # grad_overlap: the forced-serial chaos lands ~0.9, so the
+            # allowance must stay clearly below that — the default slack
+            # would close half the gap between a healthy ratio and the
+            # serialized one
             "slacks": ({"data_load_async": 0.03}
-                       if rec["workload"] == "mlp_train" else {}),
+                       if rec["workload"] == "mlp_train" else
+                       {"overlap_ratio": 0.03}
+                       if rec["workload"] == "grad_overlap" else {}),
         }
         if rec["workload"] == "cplane_storm":
             # the acceptance record: this tree's throughput next to the

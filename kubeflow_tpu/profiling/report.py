@@ -159,7 +159,7 @@ def render_text(profile: dict) -> str:
     lines.append(f"step-time breakdown ({st['count']} steps, "
                  f"{st['wall_s']:.3f}s wall):")
     lines.append("  phase        total_s    frac")
-    for phase in ("data_load", "compute", "checkpoint", "stall"):
+    for phase in ("data_load", "compute", "checkpoint", "comm", "stall"):
         lines.append(
             f"  {phase:<12} {st['phases_s'][phase]:>8.3f}  "
             f"{st['fractions'][phase] * 100:>5.1f}%")

@@ -361,7 +361,7 @@ class ContinuousBatcher:
                 return set_cache_indices(cache, values, active)
 
             def _spec_step(t_cache, d_cache, toks, active, depths, temps,
-                           base_keys):
+                           base_keys, any_sampled):
                 """One speculative round for ALL rows in one dispatch:
                 draft proposes G tokens/row (G chained batch-R steps),
                 target verifies (R, G+1) in one pass, each row accepts
@@ -375,7 +375,19 @@ class ContinuousBatcher:
                 Per-(row, round, step) keys fold the request key with
                 depth*(G+3)+j — depth strictly increases per round, so
                 keys never repeat. Returns the (R, G+1) emission buffer
-                and per-row accept counts."""
+                and per-row accept counts.
+
+                `any_sampled` is STATIC (jit retraces when the greedy/
+                sampled mix changes, exactly like prefill buckets
+                retrace per bucket): an all-greedy batch specializes to
+                the cheap executable — no (R, G+1, V) softmaxes, no
+                per-draft-step categorical draws, no residual clip/
+                normalize/resample — so greedy-only speculative
+                deployments keep paying only argmax (ADVICE r5).
+                Greedy rows' tokens are IDENTICAL either way: the mixed
+                executable computes the sampling machinery and discards
+                it rowwise via where(temps>0); the specialized one just
+                never computes it (pinned by test_continuous)."""
                 t_cache = _set_row_indices(t_cache, depths, active)
                 d_cache = _set_row_indices(d_cache, depths, active)
                 tp = jnp.maximum(temps, 1e-6)[:, None]       # (R, 1)
@@ -388,6 +400,8 @@ class ContinuousBatcher:
                         decode=True, mutable=["cache"])
                     row = logits[:, -1].astype(jnp.float32)  # (R, V)
                     greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                    if not any_sampled:
+                        return (new["cache"], greedy), greedy
                     keys = jax.vmap(jax.random.fold_in)(
                         base_keys, key_base + j)
                     sampled = jax.vmap(jax.random.categorical)(
@@ -396,10 +410,14 @@ class ContinuousBatcher:
                     probs = jax.nn.softmax(row / tp, axis=-1)
                     return (new["cache"], nxt), (nxt, probs)
 
-                (d_cache, p_last), (props, d_probs) = jax.lax.scan(
+                (d_cache, p_last), ys = jax.lax.scan(
                     draft_step, (d_cache, toks), jnp.arange(G))
+                if any_sampled:
+                    props, d_probs = ys
+                    d_probs = d_probs.transpose(1, 0, 2)     # (R, G, V)
+                else:
+                    props = ys
                 props = props.T                              # (R, G)
-                d_probs = d_probs.transpose(1, 0, 2)         # (R, G, V)
                 # extra draft write (solo speculative does the same) so an
                 # all-accepted round leaves no unwritten draft row
                 (d_cache, _), _ = draft_step((d_cache, p_last),
@@ -410,42 +428,49 @@ class ContinuousBatcher:
                     decode=True, mutable=["cache"])
                 t_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 # --- acceptance: argmax-match (greedy) | rejection ----
-                p_t = jax.nn.softmax(
-                    logits.astype(jnp.float32) / tp[..., None], axis=-1
-                )                                            # (R, G+1, V)
-                pt_x = jnp.take_along_axis(
-                    p_t[:, :G], props[..., None], axis=-1)[..., 0]
-                pd_x = jnp.take_along_axis(
-                    d_probs, props[..., None], axis=-1)[..., 0]
-                u_keys = jax.vmap(jax.random.fold_in)(
-                    base_keys, key_base + G)
-                u = jax.vmap(
-                    lambda k: jax.random.uniform(k, (G,)))(u_keys)
-                ok_sampled = u < jnp.minimum(
-                    1.0, pt_x / jnp.maximum(pd_x, 1e-30))
                 ok_greedy = props == t_tokens[:, :G]
-                ok = jnp.where(temps[:, None] > 0, ok_sampled, ok_greedy)
+                if any_sampled:
+                    p_t = jax.nn.softmax(
+                        logits.astype(jnp.float32) / tp[..., None], axis=-1
+                    )                                        # (R, G+1, V)
+                    pt_x = jnp.take_along_axis(
+                        p_t[:, :G], props[..., None], axis=-1)[..., 0]
+                    pd_x = jnp.take_along_axis(
+                        d_probs, props[..., None], axis=-1)[..., 0]
+                    u_keys = jax.vmap(jax.random.fold_in)(
+                        base_keys, key_base + G)
+                    u = jax.vmap(
+                        lambda k: jax.random.uniform(k, (G,)))(u_keys)
+                    ok_sampled = u < jnp.minimum(
+                        1.0, pt_x / jnp.maximum(pd_x, 1e-30))
+                    ok = jnp.where(temps[:, None] > 0, ok_sampled,
+                                   ok_greedy)
+                else:
+                    ok = ok_greedy
                 agree = jnp.cumprod(ok.astype(jnp.int32), axis=1)
                 a = agree.sum(axis=1)                        # (R,)
                 # --- correction token ---------------------------------
-                residual = jnp.clip(p_t[:, :G] - d_probs, 0.0)
-                rs = residual.sum(-1, keepdims=True)
-                res_norm = jnp.where(
-                    rs > 0, residual / jnp.maximum(rs, 1e-30),
-                    p_t[:, :G])
-                corr_rows = jnp.concatenate(
-                    [res_norm, p_t[:, G:]], axis=1)          # (R, G+1, V)
-                picked = jnp.take_along_axis(
-                    corr_rows, a[:, None, None], axis=1)[:, 0]
-                c_keys = jax.vmap(jax.random.fold_in)(
-                    base_keys, key_base + G + 1)
-                corr_sampled = jax.vmap(jax.random.categorical)(
-                    c_keys, jnp.log(jnp.maximum(picked, 1e-30))
-                ).astype(jnp.int32)[:, None]
                 corr_greedy = jnp.take_along_axis(
                     t_tokens, a[:, None], axis=1)
-                corr = jnp.where(temps[:, None] > 0, corr_sampled,
-                                 corr_greedy)
+                if any_sampled:
+                    residual = jnp.clip(p_t[:, :G] - d_probs, 0.0)
+                    rs = residual.sum(-1, keepdims=True)
+                    res_norm = jnp.where(
+                        rs > 0, residual / jnp.maximum(rs, 1e-30),
+                        p_t[:, :G])
+                    corr_rows = jnp.concatenate(
+                        [res_norm, p_t[:, G:]], axis=1)      # (R, G+1, V)
+                    picked = jnp.take_along_axis(
+                        corr_rows, a[:, None, None], axis=1)[:, 0]
+                    c_keys = jax.vmap(jax.random.fold_in)(
+                        base_keys, key_base + G + 1)
+                    corr_sampled = jax.vmap(jax.random.categorical)(
+                        c_keys, jnp.log(jnp.maximum(picked, 1e-30))
+                    ).astype(jnp.int32)[:, None]
+                    corr = jnp.where(temps[:, None] > 0, corr_sampled,
+                                     corr_greedy)
+                else:
+                    corr = corr_greedy
                 padded = jnp.concatenate(
                     [props, jnp.zeros((props.shape[0], 1), jnp.int32)],
                     axis=1)
@@ -457,7 +482,7 @@ class ContinuousBatcher:
                 d_cache = _set_row_indices(d_cache, new_depths, active)
                 return upd, a, t_cache, d_cache
 
-            self._spec_step = jax.jit(_spec_step)
+            self._spec_step = jax.jit(_spec_step, static_argnums=(7,))
 
         def _pick_first(logits, temp, key):
             return _pick(logits[None].astype(jnp.float32),
@@ -765,10 +790,14 @@ class ContinuousBatcher:
         prefix plus the correction. Greedy rows are target-greedy-exact;
         sampled rows run the rowwise rejection scheme."""
         temps, base_keys = self._row_sampling_state()
+        # STATIC any-sampled flag: an all-greedy batch dispatches the
+        # specialized executable with no rejection-sampling machinery;
+        # the first sampled admission retraces once (like a new prefill
+        # bucket) and the mixed executable serves from then on
         upd, a, self._cache, self._dcache = self._spec_step(
             self._cache, self._dcache, jnp.asarray(self._toks),
             jnp.asarray(active), jnp.asarray(self._depths),
-            jnp.asarray(temps), base_keys)
+            jnp.asarray(temps), base_keys, bool((temps > 0).any()))
         self.step_count += 1  # dispatches (the scheduling metric)
         upd = np.asarray(upd)                               # (R, gamma+1)
         a = np.asarray(a)                                   # (R,)

@@ -31,13 +31,13 @@ from flax import struct
 from jax.sharding import Mesh
 
 from kubeflow_tpu.parallel import build_mesh, MeshConfig
+from kubeflow_tpu.parallel.partitioner import Partitioner
 from kubeflow_tpu.utils import compat
 from kubeflow_tpu.parallel.sharding import (
     put_global,
     put_process_local,
     shard_batch,
     stacked_batch_sharding,
-    state_shardings,
 )
 from kubeflow_tpu.tracing import get_tracer, init_worker_from_env
 from kubeflow_tpu.utils.envvars import ENV_EVENT_DIR, ENV_PROFILE_DIR
@@ -119,7 +119,15 @@ class TrainerConfig:
     # chunk-granular.
     fused_steps: int = 1
     seed: int = 0
-    compute_dtype: Any = jnp.float32  # bfloat16 for MXU-heavy models
+    # None = AUTO: MXU-heavy model families (GPT/BERT/ViT/ResNet publish
+    # PREFERRED_COMPUTE_DTYPE = bfloat16) train in bf16 on accelerator
+    # backends — the module's compute dtype is flipped so the matmuls
+    # actually run on the MXU, params stay f32 — while CPU (no MXU;
+    # emulated bf16 is strictly slower) and preference-less models keep
+    # f32. An explicit value is always honored verbatim: compute_dtype=
+    # jnp.float32 is the documented bf16 opt-out, and an explicit
+    # bfloat16 keeps today's input-cast behavior on any backend.
+    compute_dtype: Any = None
     eval_every_epochs: int = 1
     checkpoint_dir: str | None = None
     checkpoint_every_steps: int = 200
@@ -201,16 +209,36 @@ class Trainer:
         eval_metrics_fn: Callable | None = None,
         mesh: Mesh | None = None,
         partition_rules: Any = None,
+        partitioner: Partitioner | None = None,
     ):
-        self.model = model
         self.config = config
-        self.mesh = mesh if mesh is not None else build_mesh(config.mesh or MeshConfig())
+        if partitioner is not None and mesh is not None \
+                and mesh is not partitioner.mesh:
+            raise ValueError(
+                "mesh and partitioner disagree: pass one or the other "
+                "(the partitioner's mesh is the one every sharding is "
+                "derived over)")
+        self.mesh = (
+            partitioner.mesh if partitioner is not None and mesh is None
+            else mesh if mesh is not None
+            else build_mesh(config.mesh or MeshConfig())
+        )
         # models may publish TP rules as a PARTITION_RULES attribute
         self.partition_rules = (
             partition_rules
             if partition_rules is not None
             else getattr(model, "PARTITION_RULES", None)
         )
+        # the partitioner OWNS the sharding (parallel/partitioner.py):
+        # model rules become its explicit top tier, the logical-axis
+        # rules and FSDP heuristic sit beneath, and the trainer consumes
+        # its hooks (state_shardings, constrain_grads, deterministic_rng)
+        self.partitioner = partitioner or Partitioner(
+            mesh=self.mesh, path_specs=self.partition_rules)
+        # bf16-by-default resolution (docs/partitioner.md): may rebuild
+        # the module with its family's preferred compute dtype
+        self.model, self.compute_dtype = self.resolve_compute_dtype(
+            model, config)
         self.loss_fn = loss_fn
         # per-example (loss, accuracy) for eval AND the train-step accuracy
         # metric; tasks whose loss shifts/masks (causal LM) supply a matching
@@ -286,6 +314,52 @@ class Trainer:
             opt = optax.chain(optax.clip_by_global_norm(c.grad_clip_norm), opt)
         return opt
 
+    # -------------------------------------------------------------- dtype
+
+    @staticmethod
+    def resolve_compute_dtype(model, config: TrainerConfig,
+                              backend: str | None = None):
+        """bf16-by-default policy (ROADMAP item 5): returns the (possibly
+        rebuilt) module and the resolved compute dtype.
+
+        An explicit config.compute_dtype always wins verbatim — passing
+        jnp.float32 is the bf16 opt-out. Under AUTO (None), a model
+        publishing PREFERRED_COMPUTE_DTYPE (the MXU-heavy families) gets
+        that dtype on accelerator backends, and the module is REBUILT
+        (flax clone) with its internal compute dtype flipped so the
+        matmuls genuinely run in bf16 — a trainer-side input cast alone
+        would be promoted straight back to f32 by dtype-pinned modules.
+        Params stay f32 (flax param_dtype is separate). CPU resolves
+        AUTO to f32: there is no MXU to feed, and emulated bf16 is
+        strictly slower. `backend` is injectable so the bf16 numerics
+        gate can exercise the accelerator policy on the CPU suite."""
+        if config.compute_dtype is not None:
+            return model, config.compute_dtype
+        pref = getattr(model, "PREFERRED_COMPUTE_DTYPE", None)
+        backend = backend or jax.default_backend()
+        if pref is None or backend == "cpu":
+            return model, jnp.float32
+        return Trainer._module_with_dtype(model, pref), pref
+
+    @staticmethod
+    def _module_with_dtype(model, dt):
+        """Rebuild a flax module with its compute dtype flipped: cfg-style
+        models (GPT/BERT/ViT carry a frozen config dataclass with a
+        `dtype` field) get a replaced cfg, attr-style models (ResNet) a
+        cloned attr; anything else is returned unchanged (the input cast
+        still applies)."""
+        import dataclasses
+
+        cfg = getattr(model, "cfg", None)
+        if dataclasses.is_dataclass(cfg) and hasattr(cfg, "dtype"):
+            return model.clone(cfg=dataclasses.replace(cfg, dtype=dt))
+        if hasattr(model, "dtype"):
+            try:
+                return model.clone(dtype=dt)
+            except TypeError:
+                return model
+        return model
+
     # ------------------------------------------------------------------ init
 
     def _state_builder(self, sample_x: np.ndarray):
@@ -323,10 +397,13 @@ class Trainer:
         # second multi-second remote compile inside what should be
         # steady-state stepping. (with_sharding_constraint rather than jit
         # out_shardings: the latter's outputs also keep layout=None and the
-        # re-specialization returns.)
-        with compat.set_mesh(self.mesh):
+        # re-specialization returns.) deterministic_rng: partitionable
+        # threefry, so the constrained build draws the SAME bits the
+        # single-device build would — the layout-invariant-init contract
+        # the fsdp-vs-single numerics tests pin (parallel/partitioner.py).
+        with compat.set_mesh(self.mesh), self.partitioner.deterministic_rng():
             abstract = jax.eval_shape(build, x)
-            shardings = state_shardings(abstract, self.mesh, self.partition_rules)
+            shardings = self.partitioner.state_shardings(abstract)
             return jax.jit(
                 lambda x: jax.tree.map(
                     jax.lax.with_sharding_constraint, build(x), shardings
@@ -342,7 +419,7 @@ class Trainer:
         build, x = self._state_builder(sample_x)
         with compat.set_mesh(self.mesh):
             abstract = jax.eval_shape(build, x)
-            shardings = state_shardings(abstract, self.mesh, self.partition_rules)
+            shardings = self.partitioner.state_shardings(abstract)
             return jax.tree.map(
                 lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
                 abstract, shardings,
@@ -360,15 +437,88 @@ class Trainer:
                                       np.asarray(sample_y).dtype)
                  if sample_y is not None
                  else jax.ShapeDtypeStruct((np.shape(sample_x)[0],), np.int32))
-        with compat.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh), self.partitioner.deterministic_rng():
             return jax.jit(self._train_step, donate_argnums=0).lower(
                 abstract, (x_sds, y_sds)).compile()
+
+    #: donation gate threshold: leaves at or above this many BYTES must
+    #: alias (the params/opt-state weights whose double-buffering is the
+    #: HBM cost donation exists to erase). Sub-threshold leaves (biases,
+    #: norm scales — a few hundred bytes) are reported, not gated: XLA's
+    #: allocator may pack/skip aliasing tiny buffers at its discretion,
+    #: and their copies are noise at real model sizes.
+    DONATION_MIN_BYTES = 4096
+
+    def donation_stats(self, sample_x, sample_y,
+                       fused_k: int | None = None) -> dict:
+        """Buffer-donation accounting straight off the compiled step.
+
+        The optimizer update runs INSIDE the one jitted step with the
+        state donated (donate_argnums=0 on the single step, the n-scan
+        and the k-data-scan alike), so params/opt-state update in place
+        — at real model sizes an un-donated step doubles peak HBM. This
+        parses the input_output_alias table of the lowered executable
+        and maps aliased entry parameters back to state leaves:
+        `unexpected_copies` counts leaves >= DONATION_MIN_BYTES that
+        FAILED to alias an output buffer (budget 0 — gated by
+        tests/test_partitioner.py); `unaliased_small` the sub-threshold
+        remainder (reported only — tiny-buffer packing is backend
+        discretion). Everything comes from the compiled HLO, so a
+        regression in donation coverage (a dtype mismatch breaking the
+        alias, a new un-donated state leaf) is caught at lower time with
+        no device run."""
+        import re as _re
+
+        def stats_of(compiled, leaves):
+            alias_lines = [l for l in compiled.as_text().splitlines()
+                           if "input_output_alias" in l]
+            # entry form: `{out_idx...}: (param_number, {...}, may-alias)`
+            # — state leaves flatten to entry params 0..N-1 (donated args
+            # come first), so the param number IS the leaf index
+            aliased = set()
+            for line in alias_lines:
+                aliased.update(int(p) for p in _re.findall(
+                    r"\((\d+), \{[^)]*?\}, (?:may|must)-alias\)", line))
+            big_missing, small_missing = [], []
+            for i, (path, leaf) in enumerate(leaves):
+                if i in aliased:
+                    continue
+                size = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+                (big_missing if size >= self.DONATION_MIN_BYTES
+                 else small_missing).append(
+                    f"{'/'.join(str(getattr(k, 'key', k)) for k in path)}"
+                    f":{size}B")
+            return {"aliased": len(aliased & set(range(len(leaves)))),
+                    "state_leaves": len(leaves),
+                    "unexpected_copies": len(big_missing),
+                    "unaliased_big": big_missing,
+                    "unaliased_small": len(small_missing)}
+
+        sample_y = np.asarray(sample_y)
+        abstract = self.abstract_state(sample_x)
+        leaves = jax.tree_util.tree_leaves_with_path(abstract)
+        x_sds = jax.ShapeDtypeStruct(
+            np.shape(sample_x), np.asarray(sample_x).dtype)
+        y_sds = jax.ShapeDtypeStruct(sample_y.shape, sample_y.dtype)
+        with compat.set_mesh(self.mesh), self.partitioner.deterministic_rng():
+            step = jax.jit(self._train_step, donate_argnums=0).lower(
+                abstract, (x_sds, y_sds)).compile()
+            out = {"train_step": stats_of(step, leaves)}
+            if fused_k:
+                xs = jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct(
+                        (fused_k, *s.shape), s.dtype), (x_sds, y_sds))
+                comp = self._fused_data_fn(fused_k).lower(
+                    abstract, xs).compile()
+                out[f"train_chunk_{fused_k}"] = stats_of(comp, leaves)
+        return out
 
     # ------------------------------------------------------------------ steps
 
     def _cast(self, x):
-        """Cast float leaves to compute_dtype; ints (token ids) untouched."""
-        dt = self.config.compute_dtype
+        """Cast float leaves to the RESOLVED compute dtype; ints (token
+        ids) untouched."""
+        dt = self.compute_dtype
         return jax.tree.map(
             lambda a: a.astype(dt) if jnp.issubdtype(a.dtype, jnp.floating) else a, x
         )
@@ -396,6 +546,13 @@ class Trainer:
             (loss, (logits, new_extra)), grads = jax.value_and_grad(
                 self._loss_of, has_aux=True
             )(state.params, state.extra, x, y, step_rng)
+            # comm/compute overlap (docs/partitioner.md): pin every
+            # gradient to its param's rule-derived layout HERE, where
+            # backward produces it — XLA's scheduler can then start each
+            # gradient's reduce-scatter/all-reduce while the rest of the
+            # backward is still running, instead of one serialized
+            # all-reduce after it (1909.09756's first MFU front)
+            grads = self.partitioner.constrain_grads(grads)
             acc = self.eval_metrics_fn(logits.astype(jnp.float32), y)[1].mean()
         else:
             # microbatch scan: grads averaged across n_acc slices before ONE
@@ -417,6 +574,10 @@ class Trainer:
                 (l, (lg, new_extra)), g = jax.value_and_grad(
                     self._loss_of, has_aux=True
                 )(state.params, extra, mx, my, rng_i)
+                # per-microbatch constraint: under accumulation the
+                # overlap window is each microbatch's backward, so the
+                # collective is pinned where that backward emits it
+                g = self.partitioner.constrain_grads(g)
                 a = self.eval_metrics_fn(lg.astype(jnp.float32), my)[1].mean()
                 grads_acc = jax.tree.map(jnp.add, grads_acc, g)
                 return (grads_acc, loss_acc + l, acc_acc + a, new_extra,
@@ -471,8 +632,10 @@ class Trainer:
 
     def train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
         # ambient mesh enables P-form with_sharding_constraint pins inside
-        # models (bert.constrain) without threading the mesh through modules
-        with compat.set_mesh(self.mesh):
+        # models (bert.constrain) without threading the mesh through
+        # modules; deterministic_rng keeps traced random draws (dropout,
+        # fold_in) layout-invariant — see init_state
+        with compat.set_mesh(self.mesh), self.partitioner.deterministic_rng():
             placed = self._place(batch)
             if self._step_compiled is not None:
                 try:
@@ -501,7 +664,7 @@ class Trainer:
         metrics. Real `fit` keeps per-step dispatch — host data arrives per
         step and prefetch overlaps the transfer — but benches and synthetic-
         data loops should use this."""
-        with compat.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh), self.partitioner.deterministic_rng():
             batch = self._place(batch)
             compiled = self._fused_compiled.get(n)
             if compiled is not None:
@@ -547,7 +710,7 @@ class Trainer:
 
     def train_chunk(self, state: TrainState, stacked, k: int):
         """Run k steps over a host-stacked chunk (k, B, ...) in one dispatch."""
-        with compat.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh), self.partitioner.deterministic_rng():
             s = stacked_batch_sharding(self.mesh)
             place = put_process_local if self._process_local else put_global
             xs = jax.tree.map(lambda a: place(a, s), stacked)
@@ -571,7 +734,7 @@ class Trainer:
         every dispatch (docs/perf.md), so this is the single placement site
         benches rely on. `compiled(state, placed_batch)` runs with the
         jit-declared state donation."""
-        with compat.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh), self.partitioner.deterministic_rng():
             batch = self._place(batch)
             batch = jax.jit(lambda t: jax.tree.map(lambda a: a + 0, t))(batch)
             compiled = self._fused_fn(n).lower(state, batch).compile()
@@ -640,7 +803,16 @@ class Trainer:
             eval_metrics_fn=_fn_id(self.eval_metrics_fn),
             mesh=tuple(sorted(self.mesh.shape.items())),
             batch=batch_avals,
-            compute_dtype=str(jnp.dtype(c.compute_dtype)),
+            # the RESOLVED dtype (bf16-by-default may differ from the
+            # config literal) and the partitioner's whole rule surface:
+            # a cached binary compiled under different sharding rules or
+            # compute dtype must never be replayed (PR-10's restart-warm
+            # zero-compile guarantee survives because the key moves with
+            # these knobs instead of silently matching)
+            compute_dtype=str(jnp.dtype(self.compute_dtype)),
+            partition=tuple(sorted(
+                (k, repr(v))
+                for k, v in self.partitioner.key_fields().items())),
             opt=(c.learning_rate, c.weight_decay, c.grad_clip_norm,
                  c.lr_schedule, c.lr_final_fraction, c.warmup_steps,
                  c.steps, c.grad_accum_steps),
@@ -678,7 +850,7 @@ class Trainer:
         # FULL batches — warm each program at the exact shape it will see
         local = max(len(sample_x) // (jax.process_count()
                                       if self._process_local else 1), 1)
-        with compat.set_mesh(self.mesh):
+        with compat.set_mesh(self.mesh), self.partitioner.deterministic_rng():
             # the content key needs only the batch avals (+ config/mesh);
             # the abstract state — an eval_shape trace of the whole model
             # build — is built LAZILY, only when something must actually
@@ -1107,7 +1279,8 @@ class Trainer:
                 # labels may be token-level (B, L) — pad with the full shape
                 by = np.concatenate([by, np.zeros((pad, *by.shape[1:]), by.dtype)])
             w = (np.arange(bs) < n).astype(np.float32)
-            with compat.set_mesh(self.mesh):
+            with compat.set_mesh(self.mesh), \
+                    self.partitioner.deterministic_rng():
                 m = self._jit_eval_step(state, shard_batch((bx, by, w), self.mesh))
             tot_loss += float(m["loss_sum"])
             correct += float(m["correct"])

@@ -90,3 +90,18 @@ def get_abstract_mesh():
     this shim, every GPT/BERT forward pass — and with it the whole
     serving stack — failed wholesale on jax 0.4.37."""
     return _GET_MESH()
+
+
+def promote_dtype(module, *args, dtype=None, inexact=True):
+    """flax's dtype-promotion helper on any supported flax: newer flax
+    exposes it as a Module METHOD (module.promote_dtype), this repo's
+    floor (0.10.0) only as flax.linen.dtypes.promote_dtype. Before this
+    shim every GPT/BERT forward under an ACTIVE mesh — i.e. every
+    Trainer-driven step, which always runs inside compat.set_mesh —
+    failed wholesale on VocabEmbed's TP lookup path."""
+    fn = getattr(module, "promote_dtype", None)
+    if fn is not None:
+        return fn(*args, dtype=dtype, inexact=inexact)
+    from flax.linen.dtypes import promote_dtype as _promote
+
+    return _promote(*args, dtype=dtype, inexact=inexact)

@@ -15,6 +15,64 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Persistent XLA compile cache for the INFERENCE-ONLY suites (threshold
+# zeroed so tiny test programs qualify): those files compile the SAME
+# tiny-GPT decode/prefill programs over and over from different tests,
+# and content-keyed dedup converts the repeats to cache hits — measured
+# on the continuous+generate subset: 276s no-cache vs 232s COLD cache
+# (intra-run dedup alone) vs 130s warm, identical pass/fail sets. The
+# cache is NOT enabled suite-wide: on this jaxlib, replaying a cached
+# donated TRAINING executable into a checkpoint-resumed fit loop
+# corrupts the heap (malloc double-linked-list aborts in
+# test_checkpoint_resume — reproduced, minimized to fit(resume=True)
+# under a zero-threshold cache; inference programs never trip it), so
+# training suites stay uncached and the fixture below flips the cache
+# per test file. The dir is repo-local and gitignored; entries are keyed
+# by HLO content + jax version, so staleness across code changes is
+# structural.
+_COMPILE_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)),
+    ".kubeflow_tpu", "test-compile-cache")
+
+#: test files safe and beneficial under the cache: inference-only
+#: suites plus training suites that NEVER restore a checkpoint into a
+#: fit loop (the minimized corruption vector needs fit(resume=True) —
+#: train-without-restore ran clean across full cached suite runs). The
+#: compile-cache suites (hotpath/AOT/prof/partitioner) manage cache
+#: config or pin compile counts themselves and every checkpoint-using
+#: file is deliberately NOT listed.
+_COMPILE_CACHE_FILES = frozenset((
+    "test_continuous.py",
+    "test_gpt_generate.py",
+    "test_fleet.py",
+    "test_serving.py",
+    "test_serving_agent.py",
+    "test_serving_grpc.py",
+    "test_serving_rollouts.py",
+    "test_serving_runtimes.py",
+    "test_composed_16dev.py",
+    "test_composed_64dev.py",
+    "test_composed_realdim.py",
+    "test_conv_im2col.py",
+    "test_data_shards.py",
+    "test_gpt.py",
+    "test_gpt_moe.py",
+    "test_gpt_pp.py",
+    "test_llama.py",
+    "test_models_bert.py",
+    "test_models_resnet.py",
+    "test_oneshot.py",
+    "test_parallel_mesh.py",
+    "test_pipeline.py",
+    "test_pipeline_controlflow.py",
+    "test_pipeline_grads.py",
+    "test_pipeline_viz.py",
+    "test_remat.py",
+    "test_ring_attention.py",
+    "test_speculative.py",
+    "test_vit.py",
+))
+
 # The axon sitecustomize force-registers the TPU plugin in every interpreter;
 # a config update (which wins over env) is required to actually get CPU.
 import jax  # noqa: E402
@@ -29,6 +87,58 @@ def cpu_devices():
     import jax
 
     return jax.devices("cpu")
+
+
+#: the process's startup cache config, restored whenever the cache flips
+#: OFF (hardcoding jax's defaults would silently drift across upgrades)
+_CACHE_DEFAULTS = {
+    "jax_compilation_cache_dir": jax.config.jax_compilation_cache_dir,
+    "jax_persistent_cache_min_compile_time_secs":
+        jax.config.jax_persistent_cache_min_compile_time_secs,
+    "jax_persistent_cache_min_entry_size_bytes":
+        jax.config.jax_persistent_cache_min_entry_size_bytes,
+}
+
+
+@pytest.fixture(autouse=True)
+def serving_compile_cache(request):
+    """Flip the persistent compile cache on for the inference-only files
+    in _COMPILE_CACHE_FILES and off elsewhere (see the module comment:
+    cached TRAINING executables replayed into a resumed fit corrupt the
+    heap on this jaxlib, so the cache is file-scoped, not global).
+    reset_cache() drops jax's latched cache object on every flip — the
+    next compile re-initializes from the current config (the PR-10
+    latch lesson; utils/compile_cache.enable_persistent_cache does the
+    same for tests that point the cache at their own dirs)."""
+    try:
+        fname = os.path.basename(str(request.node.path))
+    except Exception:
+        fname = ""
+    want = (fname in _COMPILE_CACHE_FILES
+            and not os.environ.get("KFTPU_TEST_NO_COMPILE_CACHE"))
+    # compare against the LIVE config, not our own bookkeeping: a test
+    # that re-points the cache at its own dir (the AOT/hotpath pattern)
+    # must not leave later allowlisted tests writing into its tmp dir,
+    # and a dir some test chose for itself is left alone
+    cur = jax.config.jax_compilation_cache_dir
+    if want and cur != _COMPILE_CACHE_DIR:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _jax_cc,
+        )
+
+        jax.config.update("jax_compilation_cache_dir", _COMPILE_CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _jax_cc.reset_cache()
+    elif not want and cur == _COMPILE_CACHE_DIR:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _jax_cc,
+        )
+
+        for k, v in _CACHE_DEFAULTS.items():
+            jax.config.update(k, v)
+        _jax_cc.reset_cache()
+    yield
 
 
 @pytest.fixture(autouse=True)
@@ -48,7 +158,8 @@ def lockcheck_armed(request):
     if not (request.node.get_closest_marker("chaos")
             or request.node.get_closest_marker("health")
             or request.node.get_closest_marker("fleet")
-            or request.node.get_closest_marker("hotpath")):
+            or request.node.get_closest_marker("hotpath")
+            or request.node.get_closest_marker("partition")):
         yield
         return
     from kubeflow_tpu.analysis import lockcheck

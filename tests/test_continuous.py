@@ -691,6 +691,43 @@ class TestSpeculativeSampledRows:
             np.testing.assert_array_equal(req.result(timeout=1), want)
         assert len(sampled.result(timeout=1)) == 15
 
+    def test_all_greedy_batches_keep_specialized_executable(self, spec):
+        """ADVICE r5: _spec_step is jit-specialized on a STATIC
+        any-sampled flag. An all-greedy speculative deployment dispatches
+        the cheap executable — no (R, G+1, V) softmaxes, no per-draft
+        categorical draws ever traced — and its tokens are IDENTICAL to
+        the general executable's greedy rows (which compute the sampling
+        machinery and discard it via where(temps>0)). The first sampled
+        admission retraces exactly once, like a new prefill bucket."""
+        target, tvars, dvars = spec
+        greedy_spec = ((1, 4, 8), (3, 5, 5))
+        eng = ContinuousBatcher(
+            target, tvars, max_rows=3, draft_module=target,
+            draft_variables=dvars, gamma=3)
+        # phase 1 — all-greedy batch: dispatches the SPECIALIZED
+        # executable only
+        jobs = [eng.submit(_prompt(seed, plen), max_new_tokens=budget)
+                for seed, plen, budget in greedy_spec]
+        eng.run_until_idle()
+        specialized = [np.asarray(r.result(timeout=1)) for r in jobs]
+        cheap_traced = getattr(eng._spec_step, "_cache_size", None)
+        if cheap_traced is not None:
+            assert eng._spec_step._cache_size() == 1
+        # phase 2 — mix change: the SAME greedy prompts re-submitted
+        # alongside a sampled row dispatch the general executable
+        # (exactly one retrace, like a new prefill bucket)
+        jobs = [eng.submit(_prompt(seed, plen), max_new_tokens=budget)
+                for seed, plen, budget in greedy_spec]
+        eng.submit(_prompt(2, 6), max_new_tokens=8, temperature=0.9)
+        eng.run_until_idle()
+        general = [np.asarray(r.result(timeout=1)) for r in jobs]
+        if cheap_traced is not None:
+            assert eng._spec_step._cache_size() == 2
+        # identical tokens both ways — the specialization is purely a
+        # cost specialization, never a semantic one
+        for a, b in zip(specialized, general):
+            np.testing.assert_array_equal(a, b)
+
     def test_sampled_rows_deterministic_per_key(self, spec):
         target, tvars, dvars = spec
 

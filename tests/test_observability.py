@@ -89,9 +89,12 @@ class TestGoldenExposition:
         # reporters are constructed wherever trainers run); zero them so
         # this pins the same fresh-process surface regardless of which
         # tests ran first
+        from kubeflow_tpu.parallel.partitioner import reset_comm_metrics
+
         reset_ckpt_verify_metrics()
         reset_loader_metrics()
         reset_compile_metrics()
+        reset_comm_metrics()
         p = Platform(log_dir=str(tmp_path / "logs"))
         p.start_tracing(capacity=4096)
         text = render_metrics(p)

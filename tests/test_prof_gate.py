@@ -76,6 +76,26 @@ class TestPerfGate:
             results, json.loads(BUDGETS.read_text()))
         assert any("serve_fleet." in v for v in violations), violations
 
+    def test_forced_serialization_fails_grad_overlap_gate(self,
+                                                          monkeypatch):
+        """The overlap gate's teeth: KFTPU_PROF_CHAOS="grad_overlap:2"
+        FORCES SERIALIZATION of the overlapped loop (comm engine joined
+        after every hand-off — work identical, pipelining destroyed),
+        driving the overlapped/serialized ratio toward 1.0, which must
+        fail the checked-in budget while the untouched tree passes."""
+        monkeypatch.setenv(ENV_PROF_CHAOS, "grad_overlap:2")
+        results = cpu_proxy.run_all(only="grad_overlap")
+        violations = cpu_proxy.check_budgets(
+            results, json.loads(BUDGETS.read_text()))
+        assert any("grad_overlap.overlap_ratio" in v
+                   for v in violations), violations
+        # record-level sanity on the same (chaos) run: the partitioner
+        # derived sharded specs for every layer, so comm work existed to
+        # serialize (the untouched acceptance — ratio within budget and
+        # residual comm hidden — is covered by the untouched-tree gate)
+        (rec,) = results
+        assert rec["comm_layers"] > 0
+
     def test_restart_warm_zero_backend_compiles(self, monkeypatch):
         """The restart-warm acceptance record (ISSUE 10): the warm
         incarnation of the simulated gang restart performs ZERO backend
