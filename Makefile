@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -68,6 +68,14 @@ test-hotpath:
 # accounting, and the grad_overlap cpu-proxy gate (docs/partitioner.md)
 test-partition:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_partitioner.py -q -m partition
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
+
+# kftpu-reqtrace suite: serving request tracing (golden kill→requeue
+# trace shape), the bounded TSDB, SLO burn-rate evaluation, /debug/slo
+# surface agreement, and the decode-tick burn teeth in the prof gate
+# (docs/slo.md)
+test-slo:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q -m slo
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 native:

@@ -321,6 +321,28 @@ class PlatformServer:
             if query.get("format") == "text":
                 return 200, render_text(prof)  # raw text
             return 200, prof
+        if parsed.path == "/debug/slo":
+            # SLO burn-rate report + per-request breakdown over the same
+            # recorder — JSON by default, ?format=text for the operator
+            # table. One build path with the `slo` CLI
+            # (monitoring/report.build_slo_report; docs/slo.md). Serves
+            # the request breakdown even before start_slo(); 404 only
+            # when there is no tracing to read requests from either.
+            if getattr(self.platform, "tracer", None) is None \
+                    and getattr(self.platform, "slo_monitor", None) is None:
+                return 404, {"error": "neither tracing nor the SLO "
+                                      "monitor is enabled "
+                                      "(Platform.start_tracing / "
+                                      "Platform.start_slo)"}
+            from kubeflow_tpu.monitoring import (
+                build_slo_report,
+                render_slo_text,
+            )
+
+            report = build_slo_report(self.platform)
+            if query.get("format") == "text":
+                return 200, render_slo_text(report)  # raw text
+            return 200, report
         if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
             return 404, {"error": f"no route {parsed.path!r}"}
         kind = parts[2]

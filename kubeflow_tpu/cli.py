@@ -661,6 +661,51 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_slo(args) -> int:
+    """Render the SLO burn-rate report + per-request breakdown — from a
+    live platform's /debug/slo endpoint, or request-breakdown-only from
+    a trace directory (docs/slo.md). Shares the /debug/slo build path
+    (monitoring/report), so the two surfaces cannot disagree."""
+    from kubeflow_tpu.monitoring import (
+        build_slo_report_from_spans,
+        render_slo_text,
+    )
+    from kubeflow_tpu.profiling import ProfileError, load_trace_dir
+
+    if bool(args.trace_dir) == bool(args.server):
+        print("error: pass exactly one of --trace-dir or --server",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.server:
+            import urllib.request
+
+            url = f"{args.server.rstrip('/')}/debug/slo"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                report = json.loads(r.read())
+        else:
+            # trace-dir mode has no live TSDB: the report is the request
+            # breakdown alone (alerts need a running monitor)
+            report = build_slo_report_from_spans(
+                load_trace_dir(args.trace_dir))
+    except ProfileError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    except (OSError, ValueError, KeyError, TypeError) as exc:
+        # urllib errors (refused/404) and malformed server payloads land
+        # here — one diagnostic line, never a traceback
+        print(f"error: {exc!r}", file=sys.stderr)
+        return 2
+    out = json.dumps(report, indent=2) + "\n" if args.json \
+        else render_slo_text(report)
+    if args.output:
+        Path(args.output).write_text(out)
+        print(f"wrote {args.output}", file=sys.stderr)
+    else:
+        print(out, end="")
+    return 0
+
+
 def cmd_tokenize(args) -> int:
     """Train a BPE tokenizer from a text file (one document per line) and
     write tokenizer.json — pairs with `generate` and gpt-lm predictors."""
@@ -814,6 +859,19 @@ def main(argv: list[str] | None = None) -> int:
                    help="live platform URL — fetches /debug/profile")
     p.add_argument("--json", action="store_true",
                    help="emit the profile as JSON instead of the table")
+    p.add_argument("-o", "--output", default="",
+                   help="write the report to a file instead of stdout")
+
+    p = add("slo", cmd_slo,
+            help="SLO burn-rate report + per-request serving breakdown "
+                 "from a live platform or a trace dir (docs/slo.md)")
+    p.add_argument("--server", default="",
+                   help="live platform URL — fetches /debug/slo")
+    p.add_argument("--trace-dir", default="",
+                   help="directory of trace exports (request breakdown "
+                        "only; burn rates need a live monitor)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as JSON instead of the table")
     p.add_argument("-o", "--output", default="",
                    help="write the report to a file instead of stdout")
 

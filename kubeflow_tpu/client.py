@@ -77,6 +77,11 @@ class Platform:
         self.metrics_server = None  # started on demand
         self.activator = None  # started on demand (serverless front door)
         self.tracer = None  # enabled on demand (start_tracing)
+        #: SLO burn-rate monitor over a bounded TSDB (start_slo):
+        #: /debug/slo, the `slo` CLI, and kftpu_slo_* read these
+        self.slo_monitor = None
+        self.slo_tsdb = None
+        self._slo_sampler = None
         #: serving fleets (serving/fleet): "ns/name" -> FleetRouter.
         #: register_fleet() adds one; /metrics aggregates kftpu_fleet_*
         #: over this registry and the activator's queue-depth-aware pick
@@ -122,6 +127,10 @@ class Platform:
                                  service="platform")
         self.tracer.armed = True
         self.cluster.tracer = self.tracer  # (re-)arm every layer
+        # fleets registered BEFORE tracing was enabled join now —
+        # register_fleet/start_tracing must compose in either order
+        for router in self.fleet_routers.values():
+            self._wire_fleet(router)
         return self.tracer
 
     def stop_tracing(self) -> None:
@@ -141,11 +150,85 @@ class Platform:
         "namespace/name": its kftpu_fleet_* counters join /metrics, its
         demand signal becomes autoscaler input, and `load_view` (callable
         -> {endpoint url: load}) makes the activator's ready-endpoint
-        pick queue-depth-aware (docs/serving.md)."""
+        pick queue-depth-aware (docs/serving.md). When tracing / the SLO
+        monitor are live, the router and its engines inherit the
+        platform tracer (per-request spans, docs/slo.md) and TSDB
+        (decode-tick/TTFT series) unless they brought their own."""
         self.fleet_routers[key] = router
         if load_view is not None:
             self.fleet_load_view = load_view
+        self._wire_fleet(router)
         return router
+
+    def _wire_fleet(self, router) -> None:
+        # the router owns engine wiring (FleetRouter.wire_monitoring →
+        # _wire_engine, the same path add_replica uses), so the platform
+        # cannot drift from the fleet's own attach rules
+        wire = getattr(router, "wire_monitoring", None)
+        if wire is not None:
+            wire(tracer=self.tracer, tsdb=self.slo_tsdb)
+
+    def start_slo(self, configs=None, sample_interval_s: float | None = None,
+                  capacity: int | None = None):
+        """Arm the SLO burn-rate monitor (docs/slo.md): a bounded
+        ring-buffer TSDB, a background sampling tick over the existing
+        kftpu_* families, and declarative objectives evaluated as
+        multi-window burn rates. Registered fleets' engines start
+        feeding decode-tick/TTFT series. Surfaces: GET /debug/slo,
+        `python -m kubeflow_tpu slo`, kftpu_slo_* in /metrics, and
+        FleetRouter.demand_replicas_burn. Returns the SLOMonitor."""
+        import os as _os
+
+        from kubeflow_tpu.monitoring import (
+            MetricSampler,
+            SLOMonitor,
+            TimeSeriesStore,
+        )
+        from kubeflow_tpu.utils.envvars import (
+            ENV_SLO_CAPACITY,
+            ENV_SLO_TICK_S,
+        )
+
+        if self.slo_monitor is not None:
+            # a second start_slo re-arms the sampler (the stop_slo
+            # freeze contract) — it must not silently DROP overrides
+            # the caller believes took effect
+            if configs is not None or sample_interval_s is not None \
+                    or capacity is not None:
+                raise ValueError(
+                    "start_slo: the SLO monitor is already running — "
+                    "configs/interval/capacity cannot be changed in "
+                    "place (series and burn state would be torn); "
+                    "build a new Platform to reconfigure")
+        else:
+            if capacity is None:
+                capacity = int(_os.environ.get(ENV_SLO_CAPACITY, "512"))
+            if sample_interval_s is None:
+                sample_interval_s = float(
+                    _os.environ.get(ENV_SLO_TICK_S, "1.0"))
+            self.slo_tsdb = TimeSeriesStore(capacity_per_series=capacity)
+            self.slo_monitor = SLOMonitor(self.slo_tsdb, configs)
+            for router in self.fleet_routers.values():
+                self._wire_fleet(router)
+            self._slo_sampler = MetricSampler(
+                self, self.slo_tsdb, interval_s=sample_interval_s,
+                monitor=self.slo_monitor)
+        self.slo_tsdb.armed = True
+        self._slo_sampler.start()  # re-arms after stop_slo too
+        return self.slo_monitor
+
+    def stop_slo(self) -> None:
+        """Freeze the monitoring plane: stop the sampling tick AND
+        disarm the TSDB, so hot-path producers (the engines' decode-
+        tick/TTFT hooks, which keep their reference) degrade to no-ops
+        — reading a captured incident window can never evict it (the
+        stop_tracing freeze contract applied to samples). The monitor
+        and its recorded series stay readable; start_slo() re-arms the
+        same store."""
+        if self._slo_sampler is not None:
+            self._slo_sampler.stop()
+        if self.slo_tsdb is not None:
+            self.slo_tsdb.armed = False
 
     def start_activator(self, port: int = 0,
                         host: str = "127.0.0.1") -> str:
@@ -177,6 +260,7 @@ class Platform:
         return self
 
     def stop(self) -> None:
+        self.stop_slo()
         if self.activator is not None:
             self.activator.stop()
             self.activator = None

@@ -1,0 +1,44 @@
+"""kubeflow_tpu.monitoring — bounded TSDB + SLO burn-rate monitor.
+
+Dependency-free monitoring plane over the platform's existing metric
+families: a fixed-capacity ring-buffer time-series store (tsdb.py, the
+FlightRecorder design applied to samples), a sampling tick that turns
+the /metrics exposition into series (sampler.py), declarative SLO
+objectives evaluated as multi-window burn rates (slo.py), and the one
+report build path every surface serves (report.py). Operator guide:
+docs/slo.md.
+"""
+
+from kubeflow_tpu.monitoring.report import (
+    build_slo_report,
+    build_slo_report_from_spans,
+    render_slo_text,
+)
+from kubeflow_tpu.monitoring.sampler import (
+    MetricSampler,
+    parse_exposition,
+    sample_platform,
+)
+from kubeflow_tpu.monitoring.slo import (
+    BURN_RATE_CAP,
+    Alert,
+    SLOConfig,
+    SLOMonitor,
+    default_slos,
+)
+from kubeflow_tpu.monitoring.tsdb import TimeSeriesStore
+
+__all__ = [
+    "Alert",
+    "BURN_RATE_CAP",
+    "MetricSampler",
+    "SLOConfig",
+    "SLOMonitor",
+    "TimeSeriesStore",
+    "build_slo_report",
+    "build_slo_report_from_spans",
+    "default_slos",
+    "parse_exposition",
+    "render_slo_text",
+    "sample_platform",
+]

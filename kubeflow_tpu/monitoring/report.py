@@ -1,0 +1,94 @@
+"""SLO report — the ONE build path every SLO surface serves.
+
+`build_slo_report` assembles the canonical report dict: the per-request
+phase breakdown (profiling.analytics.request_breakdown over the
+platform's request spans — the serving analogue of the step breakdown),
+the configured objectives with their last burn rates, the currently
+firing alerts, and the TSDB's volume/loss accounting. `GET /debug/slo`,
+the ``slo`` CLI subcommand, and tests all read THIS module, so the
+surfaces can never disagree about whether an SLO is burning
+(tests/test_slo.py pins exact agreement, the TestSurfacesAgree
+pattern).
+"""
+
+from __future__ import annotations
+
+
+def build_slo_report_from_spans(spans: list[dict],
+                                monitor=None) -> dict:
+    """The canonical report for a span snapshot + optional live monitor
+    (None = request breakdown only, the trace-dir CLI mode)."""
+    from kubeflow_tpu.profiling.analytics import (
+        aggregate_requests,
+        request_breakdown,
+    )
+
+    report = {
+        "requests": aggregate_requests(request_breakdown(spans)),
+        "slos": [],
+        "alerts": [],
+        "tsdb": {},
+    }
+    if monitor is not None:
+        alerts = monitor.evaluate()
+        report["slos"] = monitor.describe()
+        report["alerts"] = [a.to_dict() for a in alerts]
+        report["tsdb"] = monitor.tsdb.stats()
+    return report
+
+
+def build_slo_report(platform) -> dict:
+    """Live-platform form: flight-recorder spans (+ worker flushes) and
+    the platform's SLO monitor, when started (Platform.start_slo)."""
+    from kubeflow_tpu.profiling.report import platform_spans
+
+    spans, _dropped = platform_spans(platform)
+    return build_slo_report_from_spans(
+        spans, monitor=getattr(platform, "slo_monitor", None))
+
+
+def render_slo_text(report: dict) -> str:
+    """Operator-facing table form (the default ``slo`` CLI rendering)."""
+    lines = ["kftpu slo"]
+    alerts = report.get("alerts", [])
+    if alerts:
+        lines.append(f"FIRING: {len(alerts)} alert(s)")
+        for a in alerts:
+            lines.append(f"  [{a['severity']}] {a['message']}")
+    else:
+        lines.append("no alerts firing")
+    slos = report.get("slos", [])
+    if slos:
+        lines.append("objectives:")
+        lines.append("  name                  fired  samples  burn rates")
+        for s in slos:
+            burns = " ".join(f"{k}s={v:.2f}"
+                             for k, v in sorted(s["burn_rates"].items(),
+                                                key=lambda kv: -float(
+                                                    kv[0])))
+            lines.append(
+                f"  {s['name']:<20}  {str(s['fired']):<5}  "
+                f"{s['samples']:>7}  {burns}")
+    rq = report.get("requests") or {}
+    if rq.get("count"):
+        lines.append(
+            f"requests: {rq['count']} traced "
+            f"({rq['by_outcome'].get('completed', 0)} completed, "
+            f"{rq['by_outcome'].get('shed', 0)} shed, "
+            f"{rq['by_outcome'].get('failed', 0)} failed)")
+        lines.append("  phase        total_s    frac")
+        for phase in ("admission", "queue", "prefill", "decode", "stall"):
+            lines.append(
+                f"  {phase:<12} {rq['phases_s'][phase]:>8.3f}  "
+                f"{rq['fractions'][phase] * 100:>5.1f}%")
+        w = rq["wall"]
+        lines.append(
+            f"  per-request wall: mean {w['mean_s'] * 1e3:.2f}ms  "
+            f"p50 {w['p50_s'] * 1e3:.2f}ms  p99 {w['p99_s'] * 1e3:.2f}ms")
+    ts = report.get("tsdb") or {}
+    if ts:
+        lines.append(
+            f"tsdb: {ts['series']} series, {ts['samples_total']} samples "
+            f"({ts['samples_dropped_total']} dropped, "
+            f"{ts['series_rejected_total']} series rejected)")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,110 @@
+"""Sampling tick — the existing kftpu_* families become TSDB series.
+
+The platform's metric surface is the Prometheus text exposition
+(observability.render_metrics): one build path every scraper already
+trusts. The sampler reuses it verbatim — parse the exposition, record
+every sample as a TSDB point — so the SLO monitor can never disagree
+with /metrics about what a counter said, and a new family joins the
+monitoring plane with zero extra plumbing. Histogram bucket samples are
+skipped (they would explode the bounded series set and no SLO reads
+cumulative buckets; _sum/_count pass through, which is what a rate
+query wants anyway).
+
+The tick runs on its own thread (MetricSampler), paced by an Event wait
+— never on a serving or reconcile path. Cost note: a tick renders the
+FULL exposition, and with tracing armed that includes the analytics
+families (step/request breakdowns over the recorder ring) — bounded by
+the ring size and paid on this thread only; a deployment that finds the
+default 1s tick heavy raises KFTPU_SLO_TICK_S rather than losing the
+one-build-path guarantee.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kubeflow_tpu.monitoring.tsdb import TimeSeriesStore
+
+
+def parse_exposition(text: str) -> dict[str, float]:
+    """Prometheus text exposition -> {sample name (labels verbatim):
+    value}. Comment lines, unparsable values, and histogram buckets are
+    skipped."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, sep, value = line.rpartition(" ")
+        if not sep or "_bucket{" in name:
+            continue
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def sample_platform(platform, tsdb: TimeSeriesStore,
+                    ts: float | None = None) -> int:
+    """One sampling tick: render the platform's /metrics exposition and
+    record every (non-bucket) sample. Returns how many were recorded."""
+    from kubeflow_tpu.observability import render_metrics
+
+    return tsdb.record_many(parse_exposition(render_metrics(platform)),
+                            ts=ts)
+
+
+class MetricSampler:
+    """Background sampling tick over a platform (Platform.start_slo).
+
+    One daemon thread, Event-paced (never a naked sleep); stop() joins
+    it. A render/parse failure is counted and the tick continues — the
+    monitoring plane outliving a scrape bug is the point of having one.
+    """
+
+    def __init__(self, platform, tsdb: TimeSeriesStore,
+                 interval_s: float = 1.0, monitor=None):
+        """monitor (SLOMonitor), when given, is evaluate()d on every
+        tick after sampling — that is what keeps the kftpu_slo_burn_rate
+        / kftpu_slo_alert_active gauges LIVE for a scraper that only
+        ever polls /metrics (evaluation must not depend on someone
+        happening to GET /debug/slo)."""
+        self.platform = platform
+        self.tsdb = tsdb
+        self.monitor = monitor
+        self.interval_s = max(float(interval_s), 0.01)
+        self.ticks = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sample_once(self) -> int:
+        try:
+            n = sample_platform(self.platform, self.tsdb)
+            if self.monitor is not None:
+                self.monitor.evaluate()
+        except Exception:  # noqa: BLE001 — a torn scrape must not kill
+            # the sampling thread; the gap is visible as a missing tick
+            self.errors += 1
+            return 0
+        self.ticks += 1
+        return n
+
+    def start(self) -> "MetricSampler":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="kftpu-slo-sampler", daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -1,0 +1,295 @@
+"""SLO burn-rate monitor — declarative objectives over the TSDB.
+
+An SLO here is the SRE-book shape: an objective ("TTFT p99 under 1s with
+a 5% error budget"), evaluated as MULTI-WINDOW BURN RATES over the
+time-series store. The burn rate is how fast the error budget is being
+spent — bad-sample fraction over a window divided by the allowed
+fraction — and an alert fires only when EVERY configured window burns
+past its threshold: the long window proves the problem is real (not one
+noisy tick), the short window proves it is still happening (the alert
+clears quickly once the cause does). Evaluation is pure reads over the
+TSDB — the monitor never touches the serving hot path.
+
+Three objective kinds cover the platform's gates:
+
+  - ``above``   — per-sample violation when value > threshold (latency
+                  series: TTFT, decode tick);
+  - ``below``   — violation when value < threshold (goodness ratios:
+                  goodput);
+  - ``increase``— the window's counter increase measured against an
+                  allowed-events budget; budget 0 is the zero-drop
+                  contract (ANY increase saturates the burn rate).
+
+Alerts are structured objects (`Alert`) surfaced via GET /debug/slo, the
+``slo`` CLI, and the kftpu_slo_* metric families (docs/slo.md); the
+fleet's burn-rate-aware demand signal
+(FleetRouter.demand_replicas_burn) consumes the same evaluation, which
+is what ROADMAP item 3's autoscaling loop closes over.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.monitoring.tsdb import TimeSeriesStore
+
+#: burn rates are capped here so a zero-budget violation (zero-drop) is
+#: representable in finite JSON and a gauge — "the budget is gone and
+#: then some", not a number anyone averages
+BURN_RATE_CAP = 1000.0
+
+#: default (window_s, fire-at-burn) pairs: a 5-minute window proving the
+#: burn is real and a 1-minute window proving it is current
+DEFAULT_WINDOWS: tuple[tuple[float, float], ...] = ((300.0, 1.0),
+                                                    (60.0, 1.0))
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """One declarative objective (docs/slo.md for the syntax).
+
+    `metric` names a TSDB series — either a sampled kftpu_* exposition
+    sample (labels included verbatim, e.g.
+    ``kftpu_fleet_ttft_seconds{quantile="0.99"}``) or a hot-path series
+    like ``serving.decode_tick_s``. `budget` is the allowed bad-sample
+    fraction (`above`/`below`) or allowed events per window
+    (`increase`, where 0 = zero-tolerance). `windows` is a tuple of
+    (window_s, burn_threshold); ALL must exceed for the alert to fire.
+    """
+
+    name: str
+    metric: str
+    kind: str = "above"  # above | below | increase
+    threshold: float = 0.0
+    budget: float = 0.01
+    windows: tuple[tuple[float, float], ...] = DEFAULT_WINDOWS
+    severity: str = "page"
+    description: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("above", "below", "increase"):
+            raise ValueError(
+                f"SLO {self.name!r}: kind must be above|below|increase, "
+                f"got {self.kind!r}")
+        if not self.windows:
+            raise ValueError(f"SLO {self.name!r}: needs >= 1 window")
+        if self.kind != "increase" and self.budget <= 0:
+            raise ValueError(
+                f"SLO {self.name!r}: a fraction budget must be > 0 "
+                "(use kind='increase' with budget 0 for zero-tolerance)")
+
+
+@dataclass
+class Alert:
+    """A fired SLO: which objective, how hard each window is burning,
+    and when the newest offending evidence was seen (`fired_at` is the
+    newest in-window sample's timestamp, NOT evaluation time — so two
+    surfaces evaluating seconds apart over a frozen store agree)."""
+
+    slo: str
+    metric: str
+    severity: str
+    message: str
+    fired_at: float
+    burn_rates: dict = field(default_factory=dict)
+    observed: float = 0.0
+    threshold: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "slo": self.slo,
+            "metric": self.metric,
+            "severity": self.severity,
+            "message": self.message,
+            "fired_at": round(self.fired_at, 6),
+            "burn_rates": {k: round(v, 4)
+                           for k, v in self.burn_rates.items()},
+            "observed": round(self.observed, 6),
+            "threshold": self.threshold,
+        }
+
+
+def default_slos() -> tuple[SLOConfig, ...]:
+    """The platform default objective set (docs/slo.md): serving tail
+    latency, decode cadence, training goodput, and the zero-drop
+    contract — the four numbers the production-day soak report gates
+    (ROADMAP item 6)."""
+    return (
+        SLOConfig(
+            "serving_ttft_p99",
+            metric='kftpu_fleet_ttft_seconds{quantile="0.99"}',
+            kind="above", threshold=1.0, budget=0.05,
+            description="fleet p99 time-to-first-token under 1s"),
+        SLOConfig(
+            "serving_decode_tick",
+            metric="serving.decode_tick_s",
+            kind="above", threshold=0.25, budget=0.05,
+            description="engine decode dispatch cadence under 250ms"),
+        SLOConfig(
+            "train_goodput",
+            metric="kftpu_prof_goodput_ratio",
+            kind="below", threshold=0.5, budget=0.5,
+            description="productive step time over the trace window"),
+        SLOConfig(
+            "serving_zero_drop",
+            metric="kftpu_fleet_requests_failed_total",
+            kind="increase", budget=0.0,
+            description="no fleet request may ever fail (the requeue "
+                        "contract)"),
+    )
+
+
+class SLOMonitor:
+    """Evaluates a set of SLOConfigs over one TimeSeriesStore.
+
+    evaluate() computes every objective's per-window burn rates, updates
+    the monitor's counters and last-evaluation state (what the
+    kftpu_slo_* gauges render), and returns the fired Alerts. describe()
+    is the stable JSON view /debug/slo and the CLI share.
+    """
+
+    def __init__(self, tsdb: TimeSeriesStore,
+                 configs: tuple[SLOConfig, ...] | list | None = None):
+        self.tsdb = tsdb
+        self.configs: tuple[SLOConfig, ...] = tuple(
+            configs if configs is not None else default_slos())
+        names = [c.name for c in self.configs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO names: {sorted(names)}")
+        # evaluate() is called from /debug/slo handler threads while
+        # describe() is read by the sampler's render_metrics pass and
+        # demand_replicas_burn — counters and the last-eval table share
+        # one lock so a reader never sees a half-updated pass
+        self._mu = make_lock("monitoring.SLOMonitor._mu")
+        self.evaluations_total = 0
+        self.alerts_fired_total = 0
+        #: name -> {"burn_rates", "fired", "observed", "samples"} of the
+        #: most recent evaluate() (zeros before the first)
+        self._last: dict[str, dict] = {
+            c.name: {"burn_rates": {self._wkey(w): 0.0
+                                    for w, _ in c.windows},
+                     "fired": False, "observed": 0.0, "samples": 0}
+            for c in self.configs
+        }
+
+    @staticmethod
+    def _wkey(window_s: float) -> str:
+        return str(int(window_s)) if float(window_s).is_integer() \
+            else str(window_s)
+
+    # ------------------------------------------------------------ burn math
+
+    def _window_state(self, cfg: SLOConfig, window_s: float,
+                      now: float | None) -> tuple[float, float, int, float]:
+        """(burn, observed, n_samples, newest_ts) for one window."""
+        if cfg.kind == "increase":
+            inc = self.tsdb.delta(cfg.metric, window_s, now=now)
+            samples = self.tsdb.window(cfg.metric, window_s, now=now)
+            newest = samples[-1][0] if samples else 0.0
+            if inc <= 0:
+                return 0.0, inc, len(samples), newest
+            burn = (BURN_RATE_CAP if cfg.budget <= 0
+                    else min(inc / cfg.budget, BURN_RATE_CAP))
+            return burn, inc, len(samples), newest
+        samples = self.tsdb.window(cfg.metric, window_s, now=now)
+        if not samples:
+            return 0.0, 0.0, 0, 0.0
+        values = [v for _, v in samples]
+        if cfg.kind == "above":
+            bad = sum(1 for v in values if v > cfg.threshold)
+            observed = max(values)
+        else:  # below
+            bad = sum(1 for v in values if v < cfg.threshold)
+            observed = min(values)
+        burn = min((bad / len(values)) / cfg.budget, BURN_RATE_CAP)
+        return burn, observed, len(values), samples[-1][0]
+
+    def burn_rates(self, cfg: SLOConfig,
+                   now: float | None = None) -> dict[str, float]:
+        """Per-window burn rates for one objective (no state update)."""
+        return {self._wkey(w): self._window_state(cfg, w, now)[0]
+                for w, _ in cfg.windows}
+
+    # ----------------------------------------------------------- evaluation
+
+    def evaluate(self, now: float | None = None) -> list[Alert]:
+        """One evaluation pass: updates last-eval state + counters,
+        returns the currently-firing alerts (most severe burn first)."""
+        alerts: list[Alert] = []
+        states: dict[str, dict] = {}
+        for cfg in self.configs:
+            burns: dict[str, float] = {}
+            fired = True
+            observed = 0.0
+            n = 0
+            newest = 0.0
+            for window_s, fire_at in cfg.windows:
+                burn, obs, count, ts = self._window_state(
+                    cfg, window_s, now)
+                burns[self._wkey(window_s)] = burn
+                if count > 0:
+                    observed, n = obs, max(n, count)
+                    newest = max(newest, ts)
+                if burn < fire_at or count == 0:
+                    fired = False
+            states[cfg.name] = {
+                "burn_rates": {k: round(v, 4) for k, v in burns.items()},
+                "fired": fired, "observed": observed, "samples": n,
+            }
+            if fired:
+                alerts.append(Alert(
+                    slo=cfg.name, metric=cfg.metric,
+                    severity=cfg.severity,
+                    message=(
+                        f"SLO {cfg.name}: {cfg.metric} burn rates "
+                        + ", ".join(f"{k}s={v:.2f}"
+                                    for k, v in burns.items())
+                        + f" (kind={cfg.kind}, threshold="
+                        f"{cfg.threshold}, budget={cfg.budget})"),
+                    fired_at=newest, burn_rates=burns,
+                    observed=observed, threshold=cfg.threshold))
+        with self._mu:
+            # publish the whole pass atomically: a concurrent
+            # describe() sees either the previous evaluation or this
+            # one, never a mix
+            self._last.update(states)
+            self.evaluations_total += 1
+            self.alerts_fired_total += len(alerts)
+        alerts.sort(key=lambda a: -max(a.burn_rates.values()))
+        return alerts
+
+    # ------------------------------------------------------------ reporting
+
+    def describe(self) -> list[dict]:
+        """Config + last-evaluation state per objective — the ONE view
+        /debug/slo, the CLI, and the kftpu_slo_* gauges render from."""
+        with self._mu:
+            snapshot = {name: dict(state)
+                        for name, state in self._last.items()}
+        out = []
+        for cfg in self.configs:
+            last = snapshot[cfg.name]
+            out.append({
+                "name": cfg.name,
+                "metric": cfg.metric,
+                "kind": cfg.kind,
+                "threshold": cfg.threshold,
+                "budget": cfg.budget,
+                "windows": [[w, t] for w, t in cfg.windows],
+                "severity": cfg.severity,
+                "description": cfg.description,
+                "fired": last["fired"],
+                "burn_rates": dict(last["burn_rates"]),
+                "observed": round(last["observed"], 6),
+                "samples": last["samples"],
+            })
+        return out
+
+    @property
+    def metrics(self) -> dict[str, int]:
+        with self._mu:
+            return {
+                "evaluations_total": self.evaluations_total,
+                "alerts_fired_total": self.alerts_fired_total,
+            }

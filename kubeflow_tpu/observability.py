@@ -119,6 +119,58 @@ def render_metrics(platform) -> str:
                     "sample window",
               labels=f'{{quantile="{q}"}}')
 
+    # SLO burn-rate monitor (kubeflow_tpu/monitoring, docs/slo.md):
+    # evaluation/alert counters, per-objective burn-rate and alert
+    # gauges, and the TSDB's volume/loss accounting. A platform without
+    # start_slo() renders the DEFAULT objective set zero-valued so the
+    # golden exposition pins a stable surface (KFTPU-METRIC contract).
+    from kubeflow_tpu.monitoring import SLOMonitor, default_slos
+
+    monitor = getattr(platform, "slo_monitor", None)
+    if monitor is not None:
+        slo_states = monitor.describe()
+        slo_counts = monitor.metrics
+        tsdb_stats = monitor.tsdb.stats()
+    else:
+        slo_states = [
+            {"name": c.name, "fired": False,
+             "burn_rates": {SLOMonitor._wkey(w): 0.0
+                            for w, _ in c.windows}}
+            for c in default_slos()
+        ]
+        slo_counts = {"evaluations_total": 0, "alerts_fired_total": 0}
+        tsdb_stats = {"series": 0, "samples_total": 0,
+                      "samples_dropped_total": 0,
+                      "series_rejected_total": 0}
+    counter("kftpu_slo_evaluations_total",
+            slo_counts["evaluations_total"],
+            help_="SLO monitor evaluation passes")
+    counter("kftpu_slo_alerts_fired_total",
+            slo_counts["alerts_fired_total"],
+            help_="alerts fired across evaluations (docs/slo.md)")
+    counter("kftpu_slo_samples_total", tsdb_stats["samples_total"],
+            help_="samples recorded into the monitoring TSDB")
+    counter("kftpu_slo_samples_dropped_total",
+            tsdb_stats["samples_dropped_total"],
+            help_="samples evicted from full series rings (raise "
+                  "KFTPU_SLO_CAPACITY)")
+    counter("kftpu_slo_series_rejected_total",
+            tsdb_stats["series_rejected_total"],
+            help_="new series refused past the bounded series set")
+    gauge("kftpu_slo_series", tsdb_stats["series"],
+          help_="live series in the monitoring TSDB")
+    for st in slo_states:
+        gauge("kftpu_slo_alert_active", 1 if st["fired"] else 0,
+              help_="1 while the objective's multi-window burn alert "
+                    "fires",
+              labels=f'{{slo="{st["name"]}"}}')
+    for st in slo_states:
+        for wkey in sorted(st["burn_rates"], key=float, reverse=True):
+            gauge("kftpu_slo_burn_rate", st["burn_rates"][wkey],
+                  help_="error-budget burn rate per objective window "
+                        "(1.0 = burning exactly the budget)",
+                  labels=f'{{slo="{st["name"]}",window_s="{wkey}"}}')
+
     # training hot path (utils/compile_cache.py + train/data.AsyncLoader,
     # docs/perf.md "MFU hunt"): restart-warm compile reuse and the async
     # host-loader ledger. Both registries are process-global — trainers
@@ -216,14 +268,42 @@ def render_metrics(platform) -> str:
         # and goodput without a second instrumentation path
         from kubeflow_tpu.profiling import (
             PROF_BUCKETS,
+            REQUEST_PHASES,
             control_plane_stats,
             goodput as prof_goodput,
             platform_spans,
+            request_breakdown,
             step_breakdown,
         )
 
         spans, _dropped = platform_spans(platform)
         steps = step_breakdown(spans)
+        # serving request breakdown (the step-breakdown analogue over
+        # `request` root spans — profiling/analytics.request_breakdown):
+        # per-request wall histogram + sum-exact phase totals, the same
+        # numbers /debug/slo and the `slo` CLI serve (docs/slo.md)
+        reqs = request_breakdown(spans)
+        req_counts = [0] * (len(PROF_BUCKETS) + 1)
+        req_total = 0.0
+        for rq in reqs:
+            observe(PROF_BUCKETS, req_counts, rq["wall"])
+            req_total += rq["wall"]
+        exp.histogram(
+            "kftpu_request_wall_seconds", PROF_BUCKETS, req_counts,
+            req_total,
+            help_="serving request wall time (submit to done, requeues "
+                  "included) from request root spans")
+        for phase in REQUEST_PHASES:
+            counter(
+                "kftpu_request_phase_seconds_total",
+                f"{sum(rq[phase] for rq in reqs):.6f}",
+                help_="per-phase serving request time; phases sum "
+                      "exactly to request wall (docs/slo.md)",
+                labels=f'{{phase="{phase}"}}')
+        counter("kftpu_request_requeues_total",
+                sum(max(rq["attempts"] - 1, 0) for rq in reqs),
+                help_="extra dispatch attempts across traced requests "
+                      "(the replica-kill requeue chain)")
         for fam, phase, help_ in (
             ("kftpu_prof_step_time_seconds", "wall",
              "per-step cycle wall time (end of previous step to end of "

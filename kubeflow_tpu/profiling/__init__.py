@@ -14,11 +14,15 @@ by construction.
 
 from kubeflow_tpu.profiling.analytics import (
     PROF_BUCKETS,
+    REQUEST_PHASES,
+    aggregate_requests,
     aggregate_steps,
     ancestry,
     control_plane_stats,
     goodput,
     percentile,
+    request_breakdown,
+    request_shape,
     restart_chains,
     restart_shape,
     step_breakdown,
@@ -34,7 +38,9 @@ from kubeflow_tpu.profiling.report import (
 
 __all__ = [
     "PROF_BUCKETS",
+    "REQUEST_PHASES",
     "ProfileError",
+    "aggregate_requests",
     "aggregate_steps",
     "ancestry",
     "build_profile",
@@ -45,6 +51,8 @@ __all__ = [
     "platform_spans",
     "profile_platform",
     "render_text",
+    "request_breakdown",
+    "request_shape",
     "restart_chains",
     "restart_shape",
     "step_breakdown",

@@ -49,6 +49,18 @@ PLATFORM_SPAN_NAMES = frozenset((
 #: shared histogram buckets for the kftpu_prof_* families (seconds)
 PROF_BUCKETS: tuple[float, ...] = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0)
 
+#: the serving request root span (serving/fleet/router, serving/continuous)
+REQUEST_ROOT = "request"
+#: request child-span name -> breakdown phase it is charged to
+REQUEST_PHASE_NAMES = {
+    "request.admission": "admission",
+    "engine.queue_wait": "queue",
+    "engine.prefill_chunk": "prefill",
+    "engine.decode": "decode",
+}
+#: the phases of a request cycle, in charge order (stall = remainder)
+REQUEST_PHASES = ("admission", "queue", "prefill", "decode", "stall")
+
 
 def percentile(sorted_values: list[float], q: float) -> float:
     """Nearest-rank percentile over an already-sorted list (0 when empty).
@@ -460,4 +472,129 @@ def restart_shape(spans: list[dict]) -> str:
                 lines.append(f"  {name} x{counts[name]}")
         lines.append("order: " + ("monotonic" if rec["monotonic"]
                                   else "OUT-OF-ORDER"))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ------------------------------------------------- serving request breakdown
+
+
+def request_breakdown(spans: list[dict]) -> list[dict]:
+    """Per-request phase accounting — the serving analogue of
+    step_breakdown, one dict per `request` root span.
+
+    The request's wall time is its root span's duration (fleet submit →
+    done, requeues included). Child spans are charged to their phase
+    (REQUEST_PHASE_NAMES: the admission decision, engine queue wait,
+    prefill chunks, decode windows — a requeued request's second attempt
+    contributes additional queue/prefill/decode time under the SAME
+    root) and ``stall`` is DEFINED as the remainder, so
+
+        admission + queue + prefill + decode + stall == wall
+
+    holds EXACTLY on every row (the acceptance pin,
+    tests/test_slo.py). Phase charges are clamped in time order so a
+    child that overruns the root (clock noise at the requeue seam) can
+    never drive stall negative. Rows also carry the reuse ledger
+    (reused/computed prefill tokens off the chunk spans' attrs) and the
+    request's identity attrs.
+    """
+    by_parent: dict[str, list[dict]] = {}
+    for s in spans:
+        if s["name"] in REQUEST_PHASE_NAMES:
+            by_parent.setdefault(s.get("parent", ""), []).append(s)
+    out: list[dict] = []
+    for root in sorted((s for s in spans if s["name"] == REQUEST_ROOT),
+                       key=lambda s: s["ts"]):
+        wall = root["dur"]
+        phases = {p: 0.0 for p in REQUEST_PHASES}
+        computed = reused = 0
+        remaining = wall
+        for child in sorted(by_parent.get(root["span"], []),
+                            key=lambda s: s["ts"]):
+            phase = REQUEST_PHASE_NAMES[child["name"]]
+            charge = min(child["dur"], remaining)
+            phases[phase] += charge
+            remaining -= charge
+            if child["name"] == "engine.prefill_chunk":
+                computed += int(child["attrs"].get("tokens_computed", 0))
+                reused += int(child["attrs"].get("tokens_reused", 0))
+        phases["stall"] = max(remaining, 0.0)
+        out.append({
+            "request_id": root["attrs"].get("request_id", ""),
+            "trace": root["trace"],
+            "ts": root["ts"],
+            "wall": wall,
+            **phases,
+            "outcome": root["attrs"].get("outcome", ""),
+            "attempts": root["attrs"].get("attempts", 1),
+            "tokens": root["attrs"].get("tokens", 0),
+            "prefill_tokens_computed": computed,
+            "prefill_tokens_reused": reused,
+        })
+    return out
+
+
+def aggregate_requests(reqs: list[dict]) -> dict:
+    """Totals + distribution over request_breakdown() output — the
+    shape /debug/slo, the slo CLI, and the kftpu_request_* families
+    render (monitoring/report.py)."""
+    walls = sorted(r["wall"] for r in reqs)
+    wall = sum(walls)
+    totals = {p: sum(r[p] for r in reqs) for p in REQUEST_PHASES}
+    by_outcome: dict[str, int] = {}
+    for r in reqs:
+        key = r["outcome"] or "unknown"
+        by_outcome[key] = by_outcome.get(key, 0) + 1
+    return {
+        "count": len(reqs),
+        "wall_s": round(wall, 6),
+        "by_outcome": by_outcome,
+        "phases_s": {p: round(v, 6) for p, v in totals.items()},
+        "fractions": {
+            p: (round(v / wall, 4) if wall else 0.0)
+            for p, v in totals.items()
+        },
+        "wall": {
+            "mean_s": round(wall / len(reqs), 6) if reqs else 0.0,
+            "p50_s": round(percentile(walls, 0.50), 6),
+            "p99_s": round(percentile(walls, 0.99), 6),
+        },
+        "prefill_tokens_computed": sum(
+            r["prefill_tokens_computed"] for r in reqs),
+        "prefill_tokens_reused": sum(
+            r["prefill_tokens_reused"] for r in reqs),
+    }
+
+
+def request_shape(spans: list[dict]) -> str:
+    """Canonical, golden-pinnable text form of the serving request
+    traces (the restart_shape analogue): every `request` root with its
+    outcome/attempts and collapsed child-span counts, then every
+    replica-kill event with the requeues parent-linked to it — names
+    and parentage only, no ids or times, so a structural regression (a
+    dropped carrier, a requeue orphaned from its kill) diffs loudly
+    while timing noise never does."""
+    by_parent: dict[str, list[dict]] = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent", ""), []).append(s)
+
+    def kid_counts(span_id: str) -> list[str]:
+        counts: dict[str, int] = {}
+        for s in by_parent.get(span_id, []):
+            counts[s["name"]] = counts.get(s["name"], 0) + 1
+        return [f"  {name} x{counts[name]}" for name in sorted(counts)]
+
+    lines: list[str] = []
+    for root in sorted((s for s in spans if s["name"] == REQUEST_ROOT),
+                       key=lambda s: s["ts"]):
+        lines.append(
+            f"request outcome={root['attrs'].get('outcome')} "
+            f"attempts={root['attrs'].get('attempts', 1)}")
+        lines.extend(kid_counts(root["span"]))
+    for kill in sorted(
+            (s for s in spans if s["name"] == "fleet.replica_kill"),
+            key=lambda s: s["ts"]):
+        lines.append(
+            f"fleet.replica_kill replica={kill['attrs'].get('replica')}")
+        lines.extend(kid_counts(kill["span"]))
     return "\n".join(lines) + ("\n" if lines else "")

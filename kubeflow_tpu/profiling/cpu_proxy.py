@@ -78,11 +78,16 @@ def _best_of(fn, gated_phase: str, runs: int = 2) -> dict:
     return best
 
 
-def _min_phases(fn, phases: tuple[str, ...], runs: int = 2) -> dict:
+def _min_phases(fn, phases: tuple[str, ...], runs: int = 2,
+                attach: dict | None = None) -> dict:
     """Per-PHASE min over `runs` runs (the mlp_train rationale applied
     across whole-workload repetitions): each timing phase lands at its
     own noise floor. Count phases are deterministic and identical across
-    runs, so taking the first record for everything else is exact."""
+    runs, so taking the first record for everything else is exact.
+    `attach` maps a phase to top-level record keys that must travel WITH
+    that phase's winning run (serve_fleet's `slo` sub-dict rides
+    slo_decode_burn — the acceptance record must not show run 1's burn
+    rates next to run 2's gated value)."""
     recs = [fn() for _ in range(runs)]
     best = recs[0]
     for rec in recs[1:]:
@@ -91,6 +96,9 @@ def _min_phases(fn, phases: tuple[str, ...], runs: int = 2) -> dict:
                 best["rel"][p] = rec["rel"][p]
                 if p in rec.get("phases_s", {}):
                     best["phases_s"][p] = rec["phases_s"][p]
+                for key in (attach or {}).get(p, ()):
+                    if key in rec:
+                        best[key] = rec[key]
     return best
 
 
@@ -740,6 +748,16 @@ def _arm_decode_chaos(engines, repeats: int) -> None:
         eng._apply_chunk = wrap(eng._apply_chunk)
 
 
+#: decode-tick SLO threshold = this headroom x an IN-RUN healthy tick
+#: median measured on an un-chaos-wrapped engine after warmup (the
+#: mlp_train in-run-anchor trick): the untouched tree's samples sit at
+#: ~1.0x the anchor, the decode_tick:2 chaos at ~2.0x, so the alert
+#: FIRES under injected slowdown and stays quiet otherwise regardless
+#: of machine speed (the falsifiable-teeth acceptance;
+#: tests/test_prof_gate.py)
+DECODE_SLO_HEADROOM = 1.4
+
+
 def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
                 prompt_len: int = 12, shared_prefix: int = 8,
                 new_tokens: int = 6, block: int = 4, chunk: int = 4,
@@ -757,12 +775,24 @@ def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
                      prefix-reuse regression drives it toward 1.0
       - dropped      requests lost across the replica kill — budget 0;
                      the zero-drop requeue contract, gated
+      - slo_decode_burn   the decode-tick SLO's long-window burn rate
+                     over the monitoring TSDB (docs/slo.md) — 0 on a
+                     healthy tree (budget 0 + slack), driven to its cap
+                     by the decode_tick:2 chaos, so the burn-rate
+                     monitor itself has gated teeth
+
+    The run is fully monitored: engines trace every request (the
+    breakdown summary rides the record) and feed decode-tick samples to
+    a TSDB whose recording sits INSIDE the gated steady window — the
+    decode_tick budget passing WITH sampling live is the monitor's
+    off-the-hot-path claim in falsifiable form (2011.03641).
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+    from kubeflow_tpu.monitoring import SLOConfig, SLOMonitor, TimeSeriesStore
     from kubeflow_tpu.serving.continuous import ContinuousBatcher
     from kubeflow_tpu.serving.fleet import (
         FleetRouter,
@@ -770,6 +800,7 @@ def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
         make_prompts,
         run_loadtest_sync,
     )
+    from kubeflow_tpu.tracing import Tracer
 
     repeats = chaos_repeats("decode_tick")
     window = 40  # steady-state decode ticks in the dedicated window
@@ -780,14 +811,18 @@ def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
     variables = jax.jit(model.init)(
         jax.random.PRNGKey(0),
         jnp.zeros((1, prompt_len), jnp.int32))
+    # the SLO threshold is anchored BEFORE the run (the unit is cached
+    # per process, so later rel computations reuse this same value)
+    unit = _calibration_unit()
     pool = PagedKVPool(block_size=block, capacity_blocks=512)
+    tracer = Tracer(capacity=8192, service="serve_fleet")
+    tsdb = TimeSeriesStore(capacity_per_series=2048)
     engines = [
         ContinuousBatcher(model, variables, max_rows=rows,
                           default_max_new_tokens=new_tokens,
                           paged_kv=pool, prefill_chunk=chunk)
         for _ in range(replicas)
     ]
-    _arm_decode_chaos(engines, repeats)
     router = FleetRouter(engines)
     # make_prompts' prompt_len is the BODY length; the shared prefix
     # prepends, so total = prompt_len (the configured budget)
@@ -797,7 +832,10 @@ def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
                            shared_prefix=shared_prefix)
     # warmup OUTSIDE the timed window: compile every executable the load
     # phase dispatches (chunk prefill, decode step, splice, first-token
-    # pick) on every replica — the gate measures serving, not XLA
+    # pick) on every replica — the gate measures serving, not XLA.
+    # Tracing/TSDB attach AFTER it: warmup requests must pollute neither
+    # the request breakdown nor the decode-tick SLO series (a warmup
+    # tick carries compile time — a guaranteed false bad-sample).
     warm = make_prompts(replicas, seed=seed + 1, vocab=cfg.vocab_size,
                         prompt_len=body_len,
                         shared_prefix=shared_prefix)
@@ -810,13 +848,58 @@ def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
         # chunk-1 compile INSIDE the timed phase and owns p99.
         eng.submit(w, max_new_tokens=2)
         eng.run_until_idle()
+    # in-run healthy decode anchor for the SLO threshold: fill replica
+    # 0's rows and median-time UNWRAPPED full-load ticks — the chaos
+    # hook arms only after this, so the threshold is immune to the
+    # injection while the monitored samples are not
+    eng0 = engines[0]
+    for p in make_prompts(rows, seed=seed + 3, vocab=cfg.vocab_size,
+                          prompt_len=body_len,
+                          shared_prefix=shared_prefix):
+        eng0.submit(p, max_new_tokens=24)
+    for _ in range(rows * (prompt_len // chunk + 2)):
+        eng0.tick()
+        if not eng0._pending and all(eng0._rows):
+            break
+    # measure through the SAME machinery the monitored samples use (a
+    # scratch TSDB on the engine's own decode-tick hook), so anchor and
+    # samples are the identical quantity — a full-tick stopwatch here
+    # would fold in per-tick host overhead the samples don't carry and
+    # blunt the teeth
+    anchor_tsdb = TimeSeriesStore()
+    eng0.tsdb = anchor_tsdb
+    for _ in range(12):
+        eng0.tick()
+    eng0.tsdb = None
+    healthy_tick = _median(
+        [v for _, v in anchor_tsdb.window("serving.decode_tick_s",
+                                          3600.0)])
+    eng0.run_until_idle()
+    _arm_decode_chaos(engines, repeats)
+    router.tracer = tracer
+    for eng in engines:
+        eng.tracer = tracer
+        eng.tsdb = tsdb
     import gc
 
     gc.collect()
+
+    def sample_counters(_tick, rtr):
+        # the zero-drop SLO's input: the fleet failure counter becomes a
+        # TSDB series once per loadtest tick (the on_tick sampling hook)
+        tsdb.record("fleet.requests_failed_total",
+                    rtr.metrics["requests_failed_total"])
+
+    t0_wall = time.time()
     report = run_loadtest_sync(
         router, prompts, seed=seed, mean_gap_ticks=0.6,
-        new_tokens=new_tokens, kill_at_tick=8, kill_replica=1)
+        new_tokens=new_tokens, kill_at_tick=8, kill_replica=1,
+        on_tick=sample_counters)
     summary = report.summary()
+    # snapshot the LOAD phase's request spans before the steady-state
+    # rows below add theirs: the breakdown summary states what the
+    # seeded drill proved (requests traced == requests submitted)
+    load_spans = tracer.snapshot()
     # the report's prefill ledger is a per-run DELTA (warmup excluded)
     computed = report.prefill_tokens_total
     reused = report.prefill_tokens_reused
@@ -844,8 +927,37 @@ def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
     for eng in alive:  # drain the window rows untimed
         eng.run_until_idle()
     assert all(h.done.is_set() for h in steady)
-    unit = _calibration_unit()
     ttft_p99 = summary["ttft_p99_s"]
+
+    # ---- SLO evaluation over the TSDB the run filled (docs/slo.md):
+    # the decode-tick objective's threshold is anchored in calibration
+    # units (machine-invariant like the gate itself); both windows must
+    # burn for the alert to fire. Whole-run long window + last-quarter
+    # short window, integer-rounded so burn keys stay stable.
+    import math
+
+    from kubeflow_tpu.profiling.analytics import (
+        aggregate_requests,
+        request_breakdown,
+    )
+
+    now = time.time()
+    span_s = float(math.ceil(now - t0_wall) + 1)
+    slo_threshold = DECODE_SLO_HEADROOM * healthy_tick
+    decode_slo = SLOConfig(
+        "serving_decode_tick", metric="serving.decode_tick_s",
+        kind="above", threshold=slo_threshold, budget=0.25,
+        windows=((span_s, 1.0), (max(float(math.ceil(span_s / 4)), 1.0),
+                                 1.0)))
+    drop_slo = SLOConfig(
+        "serving_zero_drop", metric="fleet.requests_failed_total",
+        kind="increase", budget=0.0, windows=((span_s, 1.0),))
+    monitor = SLOMonitor(tsdb, (decode_slo, drop_slo))
+    alerts = monitor.evaluate(now=now)
+    states = {s["name"]: s for s in monitor.describe()}
+    burn_long = states["serving_decode_tick"]["burn_rates"][
+        SLOMonitor._wkey(span_s)]
+    breakdown = aggregate_requests(request_breakdown(load_spans))
     return {
         "workload": "serve_fleet",
         "replicas": replicas,
@@ -869,7 +981,27 @@ def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
             "reuse_computed_frac": round(
                 computed / max(computed + reused, 1), 4),
             "dropped": summary["dropped"],
+            # the burn-rate row: 0.0 healthy (budget 0 + slack), driven
+            # to the cap by the decode_tick chaos — the SLO monitor's
+            # own gated teeth
+            "slo_decode_burn": round(min(burn_long, 10.0), 4),
         },
+        "slo": {
+            "decode_tick": {
+                "fired": states["serving_decode_tick"]["fired"],
+                "burn_rates": states["serving_decode_tick"]["burn_rates"],
+                "threshold_s": round(slo_threshold, 6),
+                "healthy_tick_s": round(healthy_tick, 6),
+                "samples": states["serving_decode_tick"]["samples"],
+            },
+            "zero_drop": {
+                "fired": states["serving_zero_drop"]["fired"],
+                "burn_rates": states["serving_zero_drop"]["burn_rates"],
+            },
+            "alerts": [a.slo for a in alerts],
+        },
+        "request_breakdown": breakdown,
+        "monitor_samples": tsdb.stats()["samples_total"],
         "tokens_per_s_total": summary["tokens_per_s_total"],
     }
 
@@ -1229,7 +1361,8 @@ def run_all(only: str = "") -> list[dict]:
                                                "warm_cold_ratio"),
         "serve_ticks": serve_ticks,
         "serve_fleet": lambda: _min_phases(
-            serve_fleet, ("ttft_p99", "decode_tick")),
+            serve_fleet, ("ttft_p99", "decode_tick", "slo_decode_burn"),
+            attach={"slo_decode_burn": ("slo",)}),
         "reconcile_storm": lambda: _best_of(reconcile_storm,
                                             "reconcile_p50"),
         "cplane_storm": lambda: _best_of(cplane_storm, "to_running"),
@@ -1266,8 +1399,15 @@ def make_budgets(results: list[dict]) -> dict:
             # drop is a violation).
             "ratios": ({"tick": 3.0}
                        if rec["workload"] == "serve_ticks" else
+                       # slo_decode_burn: a healthy tree burns only tail
+                       # noise (well under the 1.0 firing line), while
+                       # the decode_tick:2 chaos pushes the majority of
+                       # samples past the in-run threshold (burn >> 1) —
+                       # the 2.0 ratio leaves room for healthy noise and
+                       # still fails the chaos run by a wide margin
                        {"ttft_p99": 1.4, "decode_tick": 1.2,
-                        "reuse_computed_frac": 1.25, "dropped": 1.0}
+                        "reuse_computed_frac": 1.25, "dropped": 1.0,
+                        "slo_decode_burn": 2.0}
                        if rec["workload"] == "serve_fleet" else
                        # warm_backend_compiles is an exact COUNT with a
                        # zero budget: ONE backend compile in the warm
@@ -1293,7 +1433,13 @@ def make_budgets(results: list[dict]) -> dict:
             "slacks": ({"data_load_async": 0.03}
                        if rec["workload"] == "mlp_train" else
                        {"overlap_ratio": 0.03}
-                       if rec["workload"] == "grad_overlap" else {}),
+                       if rec["workload"] == "grad_overlap" else
+                       # burn tail-noise band: healthy runs land ~0.1-0.2
+                       # (a few samples past the in-run threshold), the
+                       # chaos runs at 3+ — the widened slack tolerates a
+                       # noisy machine's tail without closing the gap
+                       {"slo_decode_burn": 0.3}
+                       if rec["workload"] == "serve_fleet" else {}),
         }
         if rec["workload"] == "cplane_storm":
             # the acceptance record: this tree's throughput next to the

@@ -30,13 +30,17 @@ class RequestTiming:
     input): wall_s (dial to response, INCLUDING 503 re-dial waits),
     ttft_s (engine-reported when streaming, else wall), tokens_per_s
     (engine-reported aggregate decode rate, None for non-streaming
-    models), attempts and retry_wait_s (the Retry-After budget path)."""
+    models), attempts and retry_wait_s (the Retry-After budget path),
+    and request_id — the server-assigned/echoed X-Request-Id, the handle
+    that joins this timing row to the server's `request` span and log
+    lines (docs/slo.md)."""
 
     wall_s: float
     ttft_s: float
     tokens_per_s: float | None
     attempts: int
     retry_wait_s: float
+    request_id: str = ""
 
 
 class ServingClient:
@@ -166,8 +170,13 @@ class ServingClient:
             )
             try:
                 with urllib.request.urlopen(req, timeout=remaining) as r:
+                    if stats is not None:
+                        stats["request_id"] = r.headers.get(
+                            "X-Request-Id", "")
                     return json.loads(r.read())
             except urllib.error.HTTPError as exc:
+                if stats is not None and exc.headers.get("X-Request-Id"):
+                    stats["request_id"] = exc.headers["X-Request-Id"]
                 detail = exc.read().decode(errors="replace")
                 # 503 + Retry-After (the activator's cold-start/overload
                 # signal): the SERVER knows when capacity returns — sleep
@@ -234,6 +243,7 @@ class ServingClient:
             tokens_per_s=timing.get("tokens_per_s"),
             attempts=stats.get("attempts", 1),
             retry_wait_s=stats.get("retry_wait_s", 0.0),
+            request_id=stats.get("request_id", ""),
         )
 
     def infer(

@@ -50,6 +50,7 @@ import threading
 import time
 
 from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.tracing.core import armed_tracer, current_context
 from dataclasses import dataclass, field
 
 import jax
@@ -88,12 +89,28 @@ class _InFlight:
     t_done: float | None = None
     on_token: object = None
     on_done: object = None
+    # request-tracing state (docs/slo.md): trace_ctx is the `request`
+    # root span's pre-allocated identity — engine spans (queue wait,
+    # prefill chunks, decode window) parent to it as they complete, and
+    # the root itself is recorded retroactively at finish() when this
+    # engine OWNS it (own_root; a fleet-submitted request's root belongs
+    # to the router). Retro recording means no open Span ever rides the
+    # ticker thread — an error path cannot leak one.
+    trace_ctx: object = None
+    parent_ctx: object = None
+    own_root: bool = False
+    request_id: str = ""
+    _tracer: object = None
+    _tsdb: object = None
+    t_submit_wall: float = 0.0
+    t_first_wall: float | None = None
 
     def push(self, tok: int) -> None:
         """Engine-side token emission — the ONE append path, so TTFT is
         stamped exactly when the first token exists."""
         if not self.tokens:
             self.t_first = time.perf_counter()
+            self.t_first_wall = time.time()
         self.tokens.append(tok)
         if self.on_token is not None:
             self.on_token(self, tok)
@@ -101,6 +118,29 @@ class _InFlight:
     def finish(self, error: str | None = None) -> None:
         self.error = error if self.error is None else self.error
         self.t_done = time.perf_counter()
+        if self._tsdb is not None and self.error is None \
+                and self.ttft_s is not None:
+            self._tsdb.record("serving.ttft_s", self.ttft_s)
+        tr = self._tracer
+        if tr is not None:
+            if self.t_first is not None:
+                attrs = {"tokens": len(self.tokens)}
+                if self.error is not None:
+                    # a killed replica's partial decode window: real time
+                    # spent, tokens discarded by the requeue contract
+                    attrs["error"] = self.error
+                tr.record_span(
+                    "engine.decode", self.t_first_wall,
+                    self.t_done - self.t_first, parent=self.trace_ctx,
+                    **attrs)
+            if self.own_root:
+                tr.record_span(
+                    "request", self.t_submit_wall,
+                    self.t_done - self.t_submit, context=self.trace_ctx,
+                    parent=self.parent_ctx,
+                    request_id=self.request_id,
+                    outcome="failed" if self.error else "completed",
+                    tokens=len(self.tokens))
         self.done.set()
         if self.on_done is not None:
             self.on_done(self)
@@ -154,7 +194,16 @@ class ContinuousBatcher:
                  seed: int = 0, steps_per_tick: int = 1,
                  prefill_buckets: tuple[int, ...] | None = None,
                  draft_module=None, draft_variables=None, gamma: int = 4,
-                 prefill_chunk: int = 0, paged_kv=None):
+                 prefill_chunk: int = 0, paged_kv=None,
+                 tracer=None, tsdb=None):
+        # tracer (tracing.Tracer): per-request spans — queue wait, one
+        # span per prefill chunk (reused-vs-computed counts), decode
+        # window, and a `request` root when no fleet owns one. tsdb
+        # (monitoring.TimeSeriesStore): decode-tick and TTFT samples
+        # for the SLO burn-rate monitor. Both default off at zero cost
+        # on the tick path (docs/slo.md).
+        self.tracer = tracer
+        self.tsdb = tsdb
         cfg = module.cfg
         # chunked prefill (prefill_chunk > 0): long prompts admit in
         # fixed-token chunks interleaved with decode ticks — at most ONE
@@ -494,7 +543,8 @@ class ContinuousBatcher:
 
     def submit(self, prompt_ids, max_new_tokens: int | None = None,
                eos_token_id=None, temperature: float = 0.0,
-               key=None, on_token=None, on_done=None) -> _InFlight:
+               key=None, on_token=None, on_done=None,
+               trace_ctx=None, request_id: str = "") -> _InFlight:
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         budget = int(max_new_tokens or self.default_max_new_tokens)
         if ids.size < 1:
@@ -545,6 +595,27 @@ class ContinuousBatcher:
                             temperature=float(temperature), key=key,
                             t_submit=time.perf_counter(),
                             on_token=on_token, on_done=on_done)
+            req.t_submit_wall = time.time()
+            req._tsdb = self.tsdb
+            tr = armed_tracer(self.tracer)
+            if tr is not None:
+                req._tracer = tr
+                req.request_id = request_id
+                if trace_ctx is not None:
+                    # the fleet router owns the `request` root span; the
+                    # engine only contributes phase spans under it
+                    req.trace_ctx = trace_ctx
+                else:
+                    req.own_root = True
+                    req.parent_ctx = current_context()
+                    req.trace_ctx = tr.allocate_context(
+                        parent=req.parent_ctx)
+                    if not req.request_id:
+                        from kubeflow_tpu.serving.requestid import (
+                            get_request_id,
+                        )
+
+                        req.request_id = get_request_id()
             self._queue.append((ids, req))
         return req
 
@@ -650,6 +721,11 @@ class ContinuousBatcher:
         if cache is None:
             # leaves are np arrays: fresh copy per admission
             cache = jax.tree.map(np.copy, template)
+        if pos > 0 and req._tracer is not None:
+            # the prefix-reuse ledger's trace form: these positions were
+            # seeded from the paged pool, never computed
+            req._tracer.event("engine.prefill_seed", parent=req.trace_ctx,
+                              tokens_reused=pos)
         pend = _PendingPrefill(req=req, ids=ids, pos=pos, cache=cache,
                                match_refs=refs)
         self._pending[slot] = pend
@@ -666,7 +742,16 @@ class ContinuousBatcher:
         take = (len(pend.ids) - pend.pos if not self.prefill_chunk
                 else min(self.prefill_chunk, len(pend.ids) - pend.pos))
         chunk = pend.ids[pend.pos:pend.pos + take]
+        # the FIRST computed chunk (no logits yet) carries the seeded
+        # reuse count — reused-vs-computed per chunk off the pool ledger
+        reused = pend.pos if pend.last_logits is None else 0
+        w0, p0 = time.time(), time.perf_counter()
         pend.last_logits, pend.cache = self._apply_chunk(pend.cache, chunk)
+        if pend.req._tracer is not None:
+            pend.req._tracer.record_span(
+                "engine.prefill_chunk", w0, time.perf_counter() - p0,
+                parent=pend.req.trace_ctx, tokens_computed=take,
+                tokens_reused=reused, pos=pend.pos + take)
         pend.pos += take
         self.prefill_tokens_total += take
         if pend.pos >= len(pend.ids):
@@ -726,12 +811,23 @@ class ContinuousBatcher:
             # the request in _rows so _fail_all unblocks its caller
             req.slot = slot
             self._rows[slot] = req
+            if req._tracer is not None:
+                req._tracer.record_span(
+                    "engine.queue_wait", req.t_submit_wall,
+                    time.perf_counter() - req.t_submit,
+                    parent=req.trace_ctx, slot=slot)
             if chunked:
                 # chunked/seeded path: pooled prefix reuse + (with
                 # prefill_chunk) chunk-per-tick interleaving below
                 self._begin_prefill(slot, ids, req)
                 continue
+            w0, p0 = time.time(), time.perf_counter()
             last_logits, row_cache = self._prefill(ids)
+            if req._tracer is not None:
+                req._tracer.record_span(
+                    "engine.prefill_chunk", w0, time.perf_counter() - p0,
+                    parent=req.trace_ctx, tokens_computed=ids.size,
+                    tokens_reused=0)
             self.prefill_tokens_total += ids.size
             self._cache = self._splice(
                 self._cache, row_cache, jnp.int32(slot))
@@ -765,12 +861,26 @@ class ContinuousBatcher:
         starts = np.array(
             [len(r.tokens) if r is not None else 0
              for r in self._rows], np.int32)
+        # one read per tick: start_slo's live-attach assigns self.tsdb
+        # from another thread, and a torn double-read would record an
+        # absolute perf_counter value as a decode-tick sample
+        tsdb = self.tsdb
+        t_dec = time.perf_counter() if tsdb is not None else 0.0
         out, self._cache = self._step(
             self._cache, jnp.asarray(self._toks),
             jnp.asarray(active), jnp.asarray(temps), base_keys,
             jnp.asarray(starts))
         self.step_count += 1  # dispatches (the scheduling metric)
         out = np.asarray(out)  # (T, R)
+        if tsdb is not None:
+            # the decode-tick SLO series (docs/slo.md): one sample per
+            # dispatch, measured to the host-visible sync (np.asarray).
+            # Cost is one perf_counter read + a deque append — the
+            # decode_tick perf gate runs WITH this live and keeps its
+            # budget (tests/test_prof_gate.py), which is the off-the-
+            # hot-path claim in falsifiable form
+            tsdb.record("serving.decode_tick_s",
+                        time.perf_counter() - t_dec)
         for slot, req in enumerate(self._rows):
             if req is None or slot in self._pending:
                 continue  # pending rows decoded garbage; discard
@@ -794,6 +904,8 @@ class ContinuousBatcher:
         # specialized executable with no rejection-sampling machinery;
         # the first sampled admission retraces once (like a new prefill
         # bucket) and the mixed executable serves from then on
+        tsdb = self.tsdb  # one read: live-attach races a torn pair
+        t_dec = time.perf_counter() if tsdb is not None else 0.0
         upd, a, self._cache, self._dcache = self._spec_step(
             self._cache, self._dcache, jnp.asarray(self._toks),
             jnp.asarray(active), jnp.asarray(self._depths),
@@ -801,6 +913,9 @@ class ContinuousBatcher:
         self.step_count += 1  # dispatches (the scheduling metric)
         upd = np.asarray(upd)                               # (R, gamma+1)
         a = np.asarray(a)                                   # (R,)
+        if tsdb is not None:
+            tsdb.record("serving.decode_tick_s",
+                        time.perf_counter() - t_dec)
         for slot, req in enumerate(self._rows):
             if req is None:
                 continue

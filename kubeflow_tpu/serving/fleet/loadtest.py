@@ -54,6 +54,13 @@ class LoadReport:
     requeued: int = 0
     ttft_s: list = field(default_factory=list)
     tokens_per_s: list = field(default_factory=list)
+    # per-request shed-retry attribution (threaded mode): how many
+    # submit attempts each request took and how long it spent in
+    # CLIENT-side Retry-After backoff — kept apart from TTFT so the
+    # harness percentiles separate server-side queueing from the
+    # client's own waiting (previously conflated into wall time)
+    attempts: list = field(default_factory=list)
+    retry_wait_s: list = field(default_factory=list)
     wall_s: float = 0.0
     ticks: int = 0  # sync mode: round-robin loop passes driven
     tokens_out: int = 0
@@ -85,6 +92,14 @@ class LoadReport:
                 self._pct(self.tokens_per_s, 0.50), 3),
             "prefill_tokens_total": self.prefill_tokens_total,
             "prefill_tokens_reused": self.prefill_tokens_reused,
+            "retried": sum(1 for a in self.attempts if a > 1),
+            "attempts_mean": round(
+                sum(self.attempts) / len(self.attempts), 3)
+            if self.attempts else 0.0,
+            "retry_wait_p50_s": round(
+                self._pct(self.retry_wait_s, 0.50), 6),
+            "retry_wait_p99_s": round(
+                self._pct(self.retry_wait_s, 0.99), 6),
         }
 
 
@@ -169,6 +184,7 @@ def run_loadtest(router: FleetRouter, prompts: list[np.ndarray],
             pacer.wait(gap)
             if kill_replica is not None and i == kill_after:
                 router.kill_replica(kill_replica)
+            waited = 0.0
             for attempt in range(shed_retries + 1):
                 try:
                     handles[i] = router.submit(p, max_new_tokens=new_tokens)
@@ -177,7 +193,13 @@ def run_loadtest(router: FleetRouter, prompts: list[np.ndarray],
                     if attempt == shed_retries:
                         report.shed += 1
                     else:
-                        pacer.wait(min(exc.retry_after_s, 2.0))
+                        hinted = min(exc.retry_after_s, 2.0)
+                        pacer.wait(hinted)
+                        waited += hinted
+            # recorded for EVERY request (retries or not) so the
+            # percentiles line up index-free with ttft_s
+            report.attempts.append(attempt + 1)
+            report.retry_wait_s.append(waited)
         deadline = time.monotonic() + timeout_s
         for h in handles:
             if h is not None:
@@ -191,11 +213,15 @@ def run_loadtest(router: FleetRouter, prompts: list[np.ndarray],
 def run_loadtest_sync(router: FleetRouter, prompts: list[np.ndarray],
                       seed: int = 0, mean_gap_ticks: float = 1.0,
                       new_tokens: int = 8, kill_at_tick: int = 0,
-                      kill_replica=None, max_ticks: int = 100000) -> LoadReport:
+                      kill_replica=None, max_ticks: int = 100000,
+                      on_tick=None) -> LoadReport:
     """Tick-driven run (no threads, no sleeps): arrivals land on seeded
     tick offsets, the kill fires at `kill_at_tick`, and every unit of
     work is an engine tick — machine-speed cancels out of anchor-relative
-    ratios (the cpu-proxy serve_fleet mode)."""
+    ratios (the cpu-proxy serve_fleet mode). `on_tick(tick, router)`,
+    when given, runs after each round-robin pass — the monitoring
+    plane's sampling hook (the serve_fleet drill records the fleet's
+    counter families into its TSDB here)."""
     rng = random.Random(seed)
     arrivals: list[tuple[int, int]] = []  # (tick, prompt index)
     t = 0.0
@@ -224,6 +250,8 @@ def run_loadtest_sync(router: FleetRouter, prompts: list[np.ndarray],
         for rep in router.replicas:
             if rep.alive:
                 busy = rep.engine.tick() or busy
+        if on_tick is not None:
+            on_tick(tick, router)
         tick += 1
         if not busy and not arrivals and killed:
             break

@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.tracing.core import armed_tracer, current_context
 
 #: EWMA weight of each completed request's observed decode rate
 _RATE_ALPHA = 0.2
@@ -53,11 +54,17 @@ _TTFT_WINDOW = 512
 class FleetOverloaded(RuntimeError):
     """Admission shed: the fleet cannot meet the TTFT SLO for this
     request. `retry_after_s` is the server-side hint the HTTP surfaces
-    forward as a 503 Retry-After header."""
+    forward as a 503 Retry-After header. `trace_ctx`/`request_id` are
+    stamped by submit() when tracing is armed, so the 503 body can carry
+    the shed decision's span context back to the client
+    (serving/server.py — a shed request is attributable, not just
+    gone)."""
 
     def __init__(self, msg: str, retry_after_s: float):
         super().__init__(msg)
         self.retry_after_s = retry_after_s
+        self.trace_ctx = None
+        self.request_id = ""
 
 
 @dataclass
@@ -108,6 +115,15 @@ class FleetRequest:
     error: str | None = None
     done: threading.Event = field(default_factory=threading.Event)
     on_token: object = None
+    # request-tracing state: the router owns the `request` root span for
+    # fleet requests — trace_ctx is its pre-allocated identity (engine
+    # phase spans parent to it across requeues), recorded retroactively
+    # when the request completes/sheds/fails (docs/slo.md)
+    trace_ctx: object = None
+    parent_ctx: object = None
+    request_id: str = ""
+    _tracer: object = None
+    t_submit_wall: float = 0.0
 
     @property
     def ttft_s(self) -> float | None:
@@ -134,17 +150,33 @@ class FleetRouter:
     def __init__(self, replicas, ttft_slo_s: float = 0.0,
                  retry_after_s: float = 1.0,
                  service_rate_tokens_per_s: float = 0.0,
-                 max_requeues: int = 3):
+                 max_requeues: int = 3, tracer=None):
         """replicas: list of (name, ContinuousBatcher) or engines (named
         replica-<i>). ttft_slo_s: 0 disables admission shedding.
         service_rate_tokens_per_s: initial service-rate estimate; 0 defers
-        admission control until the first completion calibrates it."""
+        admission control until the first completion calibrates it.
+        tracer (tracing.Tracer): per-request root spans + the
+        kill→requeue causal chain; propagated to replica engines that
+        have none of their own, so one tracer covers the whole fleet
+        (docs/slo.md)."""
+        self.tracer = tracer
+        #: monitoring TSDB propagated to replica engines (set by
+        #: Platform._wire_fleet); carried here so add_replica — the
+        #: autoscaler's scale-out path, active exactly when the burn
+        #: monitor is — wires NEW replicas into the decode-tick/TTFT
+        #: series too, not just the ones present at registration
+        self.tsdb = None
         self.replicas: list[Replica] = []
         for i, r in enumerate(replicas):
             name, eng = r if isinstance(r, tuple) else (f"replica-{i}", r)
+            self._wire_engine(eng)
             self.replicas.append(Replica(name=name, engine=eng))
         if not self.replicas:
             raise ValueError("a fleet needs at least one replica")
+        #: replica name -> the fleet.replica_kill event's SpanContext —
+        #: what a requeue parent-links to (the chaos.pod_kill →
+        #: gang_restart chain, serving edition)
+        self._kill_ctx: dict[str, object] = {}
         self.ttft_slo_s = float(ttft_slo_s)
         self.retry_after_s = float(retry_after_s)
         self.max_requeues = int(max_requeues)
@@ -159,6 +191,32 @@ class FleetRouter:
             "requests_failed_total": 0,
             "replica_kills_total": 0,
         }
+
+    def _wire_engine(self, engine) -> None:
+        """The ONE engine-attach path for the fleet's tracer + TSDB
+        (constructor, add_replica, and Platform._wire_fleet all funnel
+        here): an engine that brought its own keeps it; any future
+        replica-attach path inherits both or neither, never a drifted
+        half."""
+        if self.tracer is not None \
+                and getattr(engine, "tracer", None) is None:
+            engine.tracer = self.tracer
+        if self.tsdb is not None \
+                and getattr(engine, "tsdb", None) is None:
+            engine.tsdb = self.tsdb
+
+    def wire_monitoring(self, tracer=None, tsdb=None) -> None:
+        """Late-attach monitoring to the whole fleet (Platform wiring:
+        register_fleet / start_tracing / start_slo in any order): set
+        the fleet-level tracer/TSDB unless already present, then wire
+        every current replica. Future add_replica calls inherit
+        automatically."""
+        if tracer is not None and self.tracer is None:
+            self.tracer = tracer
+        if tsdb is not None and self.tsdb is None:
+            self.tsdb = tsdb
+        for rep in self.replicas:
+            self._wire_engine(rep.engine)
 
     # ----------------------------------------------------------- routing
 
@@ -211,25 +269,94 @@ class FleetRouter:
         """Admission-gate then route to the least-loaded live replica.
         Raises FleetOverloaded (with retry_after_s) on shed — including
         when no replica is alive, counted as a shed, never as an
-        admission."""
+        admission. With tracing armed every request gets a `request`
+        root span (recorded retroactively at completion) whose children
+        are the admission decision, per-attempt dispatches, and the
+        engine's queue-wait/prefill-chunk/decode spans."""
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
-        if gate:
-            self.admit_or_raise(ids.size)
         on_token = kwargs.pop("on_token", None)
+        rid = kwargs.pop("request_id", "")
         freq = FleetRequest(prompt=ids, kwargs=dict(kwargs),
                             t_submit=time.perf_counter(),
                             on_token=on_token)
+        freq.t_submit_wall = time.time()
+        tr = armed_tracer(self.tracer)
+        if tr is not None:
+            if not rid:
+                from kubeflow_tpu.serving.requestid import get_request_id
+
+                rid = get_request_id()
+            freq._tracer = tr
+            freq.parent_ctx = current_context()
+            freq.trace_ctx = tr.allocate_context(parent=freq.parent_ctx)
+        freq.request_id = rid
+        try:
+            if gate:
+                self.admit_or_raise(ids.size)
+        except FleetOverloaded as exc:
+            self._trace_shed(freq, exc)
+            raise
+        if tr is not None:
+            tr.event("request.admission", parent=freq.trace_ctx,
+                     decision="admit", prompt_tokens=int(ids.size),
+                     request_id=freq.request_id)
         try:
             self._dispatch(freq)
-        except FleetOverloaded:
+        except FleetOverloaded as exc:
             with self._mu:
                 self.metrics["requests_shed_total"] += 1
+            self._trace_shed(freq, exc)
             raise
         # counted only once the request is really on a replica, so
         # admitted == completed + failed + in-flight always holds
         with self._mu:
             self.metrics["requests_admitted_total"] += 1
         return freq
+
+    def _trace_shed(self, freq: FleetRequest, exc: FleetOverloaded) -> None:
+        """Record the shed decision as the request's (terminal) trace and
+        hand its context to the exception so the 503 body can carry it."""
+        exc.trace_ctx = freq.trace_ctx
+        exc.request_id = freq.request_id
+        if freq._tracer is None:
+            return
+        freq._tracer.event(
+            "request.admission", parent=freq.trace_ctx, decision="shed",
+            retry_after_s=round(exc.retry_after_s, 3),
+            request_id=freq.request_id)
+        freq._tracer.record_span(
+            "request", freq.t_submit_wall,
+            time.perf_counter() - freq.t_submit, context=freq.trace_ctx,
+            parent=freq.parent_ctx, request_id=freq.request_id,
+            outcome="shed")
+
+    def record_shed(self, exc: FleetOverloaded, prompt_tokens: int,
+                    request_id: str = "") -> FleetOverloaded:
+        """Trace a shed decided OUTSIDE submit() — the batch-gate path
+        (JaxModel gates once with the whole batch via admit_or_raise,
+        then submits ungated): records the shed `request` root +
+        admission event and stamps the exception's trace_ctx/request_id
+        so the 503 body carries them, exactly like a submit()-path shed.
+        Returns the (mutated) exception for `raise ... from` chains."""
+        tr = armed_tracer(self.tracer)
+        if not request_id:
+            from kubeflow_tpu.serving.requestid import get_request_id
+
+            request_id = get_request_id()
+        exc.request_id = request_id
+        if tr is None:
+            return exc
+        parent = current_context()
+        ctx = tr.allocate_context(parent=parent)
+        tr.event("request.admission", parent=ctx, decision="shed",
+                 prompt_tokens=int(prompt_tokens),
+                 retry_after_s=round(exc.retry_after_s, 3),
+                 request_id=request_id)
+        tr.record_span("request", time.time(), 0.0, context=ctx,
+                       parent=parent, request_id=request_id,
+                       outcome="shed")
+        exc.trace_ctx = ctx
+        return exc
 
     def _pick(self) -> Replica:
         alive = self._alive()
@@ -254,9 +381,16 @@ class FleetRouter:
             rep = self._pick()
             freq.replica = rep.name
             freq.attempts += 1
+            if freq._tracer is not None:
+                freq._tracer.event(
+                    "fleet.dispatch", parent=freq.trace_ctx,
+                    replica=rep.name, attempt=freq.attempts,
+                    request_id=freq.request_id)
             rep.engine.submit(
                 freq.prompt, on_token=partial(self._on_token, freq),
-                on_done=partial(self._on_done, freq), **freq.kwargs)
+                on_done=partial(self._on_done, freq),
+                trace_ctx=freq.trace_ctx, request_id=freq.request_id,
+                **freq.kwargs)
 
     # --------------------------------------------- engine-thread callbacks
 
@@ -283,6 +417,7 @@ class FleetRouter:
                 if freq.ttft_s is not None:
                     self._ttfts.append(freq.ttft_s)
                 self._observe_rate(freq)
+            self._record_root(freq, "completed")
             freq.done.set()
             return
         if freq.attempts > self.max_requeues:
@@ -290,6 +425,7 @@ class FleetRouter:
                          f"{handle.error}"
             with self._mu:
                 self.metrics["requests_failed_total"] += 1
+            self._record_root(freq, "failed")
             freq.done.set()
             return
         # replica died (or poisoned round): start over on a survivor.
@@ -299,13 +435,41 @@ class FleetRouter:
         freq.t_first = None
         with self._mu:
             self.metrics["requests_requeued_total"] += 1
+        if freq._tracer is not None:
+            # parent-linked to the replica-kill event exactly like the
+            # chaos.pod_kill → job.gang_restart chain: the kill is the
+            # ROOT of the disruption, each requeue a consequence of it
+            # (falls back to the request's own trace for a non-kill
+            # poisoned round)
+            freq._tracer.event(
+                "fleet.requeue",
+                parent=self._kill_ctx.get(freq.replica) or freq.trace_ctx,
+                request_id=freq.request_id, from_replica=freq.replica,
+                attempt=freq.attempts)
         try:
             self._dispatch(freq)
         except FleetOverloaded as exc:
             freq.error = str(exc)
             with self._mu:
                 self.metrics["requests_failed_total"] += 1
+            self._record_root(freq, "failed")
             freq.done.set()
+
+    def _record_root(self, freq: FleetRequest, outcome: str) -> None:
+        """Retroactively record the request's root span at its terminal
+        transition (the one place done.set() is reached from)."""
+        if freq._tracer is None:
+            return
+        end = freq.t_done if freq.t_done is not None \
+            else time.perf_counter()
+        attrs = {"request_id": freq.request_id, "outcome": outcome,
+                 "attempts": freq.attempts, "replica": freq.replica,
+                 "tokens": len(freq.tokens)}
+        if freq.error is not None:
+            attrs["error"] = freq.error
+        freq._tracer.record_span(
+            "request", freq.t_submit_wall, end - freq.t_submit,
+            context=freq.trace_ctx, parent=freq.parent_ctx, **attrs)
 
     def _observe_rate(self, freq: FleetRequest) -> None:
         """EWMA of completed requests' end-to-end token rate — PROMPT +
@@ -336,6 +500,16 @@ class FleetRouter:
                if isinstance(name_or_idx, int)
                else next(r for r in self.replicas
                          if r.name == name_or_idx))
+        tr = armed_tracer(self.tracer)
+        if tr is not None:
+            # the root of the disruption chain (the serving analogue of
+            # chaos.pod_kill): every request the corpse was carrying
+            # parent-links its fleet.requeue here — stamped BEFORE
+            # _fail_all so the requeue callbacks can see it
+            ev = tr.event("fleet.replica_kill", parent=None,
+                          replica=rep.name)
+            if ev.context is not None:
+                self._kill_ctx[rep.name] = ev.context
         with self._mu:
             # ordered against _dispatch (also under _mu): any dispatch
             # that won the race has ALREADY enqueued, so the _fail_all
@@ -347,7 +521,11 @@ class FleetRouter:
         return rep
 
     def add_replica(self, engine, name: str = "") -> Replica:
-        """Scale-out entry (the autoscaler's add path)."""
+        """Scale-out entry (the autoscaler's add path). The new engine
+        inherits the fleet's tracer AND monitoring TSDB (unless it
+        brought its own), so scale-out replicas are visible to the SLO
+        series from their first tick."""
+        self._wire_engine(engine)
         rep = Replica(name=name or f"replica-{len(self.replicas)}",
                       engine=engine)
         self.replicas.append(rep)
@@ -372,6 +550,39 @@ class FleetRouter:
         import math
 
         return max(1, busy, math.ceil(self.pending_tokens() / per_replica))
+
+    #: the burn-rate multiplier on demand is clamped here: a saturated
+    #: (capped) burn must scale the fleet decisively, not to infinity
+    BURN_DEMAND_CAP = 4.0
+
+    def demand_replicas_burn(self, monitor,
+                             slos: tuple[str, ...] = (
+                                 "serving_ttft_p99",
+                                 "serving_decode_tick",
+                                 "serving_zero_drop")) -> int:
+        """Burn-rate-aware demand (the ROADMAP item 3 substrate): the
+        queue-math demand signal, scaled up by the worst serving-SLO
+        burn rate from the monitor's LAST evaluation. The queue signal
+        alone can sit at steady state while the error budget burns (a
+        decode-tick regression serves the same backlog slower); a burn
+        past 1.0 means the fleet is failing its objectives at current
+        size, so demand multiplies by the burn (clamped to
+        BURN_DEMAND_CAP — the autoscaler's step bound, not ours). A
+        quiet burn leaves the base signal untouched, so scale-IN still
+        follows the queue math. Callers evaluate() the monitor on their
+        own cadence; this reads state, never the TSDB."""
+        base = self.demand_replicas()
+        burn = 0.0
+        for state in monitor.describe():
+            if state["name"] in slos:
+                rates = state.get("burn_rates", {})
+                if rates:
+                    burn = max(burn, max(rates.values()))
+        if burn <= 1.0:
+            return base
+        import math
+
+        return max(base, math.ceil(base * min(burn, self.BURN_DEMAND_CAP)))
 
     # --------------------------------------------------------- reporting
 

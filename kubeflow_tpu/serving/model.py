@@ -489,8 +489,16 @@ class JaxModel(Model):
             # ungated: a shed on row k would otherwise orphan the k rows
             # already admitted — decode capacity burned on answers
             # nobody reads, exactly what admission control exists to
-            # prevent
-            self._fleet.admit_or_raise(int(sum(len(row) for row in x)))
+            # prevent. A shed here is traced like a submit()-path shed
+            # (record_shed), so the 503 body carries the decision's
+            # span ctx + request id.
+            from kubeflow_tpu.serving.fleet import FleetOverloaded
+
+            batch_tokens = int(sum(len(row) for row in x))
+            try:
+                self._fleet.admit_or_raise(batch_tokens)
+            except FleetOverloaded as exc:
+                raise self._fleet.record_shed(exc, batch_tokens)
             submit = lambda row, **kw: self._fleet.submit(  # noqa: E731
                 row, gate=False, **kw)
         else:

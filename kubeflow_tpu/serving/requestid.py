@@ -1,0 +1,33 @@
+"""X-Request-Id propagation for the serving data plane.
+
+The apiserver has assigned/echoed X-Request-Id on control-plane requests
+since PR 2; this module gives the MODEL server the same contract without
+threading a parameter through every Model method: the HTTP handler
+assigns (or echoes) the id and parks it in a contextvar, and anything
+downstream on the same request thread — the fleet router's `request`
+root span, error bodies, the 503 shed response — reads it back. Handler
+threads are per-request (ThreadingHTTPServer), so the contextvar can
+never leak across concurrent requests.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import uuid
+
+_REQUEST_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "serving_request_id", default="")
+
+
+def new_request_id() -> str:
+    """A fresh id in the apiserver's shape (16 hex chars)."""
+    return uuid.uuid4().hex[:16]
+
+
+def set_request_id(rid: str) -> None:
+    _REQUEST_ID.set(rid or "")
+
+
+def get_request_id() -> str:
+    """The current request's id ("" outside a serving request)."""
+    return _REQUEST_ID.get()

@@ -28,6 +28,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from kubeflow_tpu.serving.model import Model
+from kubeflow_tpu.serving.requestid import (
+    get_request_id,
+    new_request_id,
+    set_request_id,
+)
 
 SERVER_NAME = "kubeflow-tpu-modelserver"
 SERVER_VERSION = "0.1"
@@ -278,6 +283,13 @@ class ModelServer:
         # the fleet's 503 shed carries its Retry-After hint through here
         code, payload = out[0], out[1]
         headers = out[2] if len(out) > 2 else None
+        # error bodies carry the request id (the apiserver's existing
+        # contract, extended to the model server): a logged 4xx/5xx —
+        # including the fleet's 503 shed — is greppable back to its
+        # X-Request-Id without the client having kept the header
+        rid = get_request_id()
+        if code >= 400 and isinstance(payload, dict) and rid:
+            payload.setdefault("request_id", rid)
         # serialize exactly once: the handler sends these bytes verbatim
         data = json.dumps(payload).encode()
         self.logger.log(
@@ -346,6 +358,20 @@ class ModelServer:
             "shape": [-1, *cfg["input_shape"][1:]],
         }
 
+    @staticmethod
+    def _shed_body(exc) -> dict:
+        """The 503 shed response body: error + the shed decision's span
+        context and request id when tracing stamped them
+        (serving/fleet/router.FleetOverloaded)."""
+        body = {"error": str(exc)}
+        ctx = getattr(exc, "trace_ctx", None)
+        if ctx is not None:
+            body["trace"] = ctx.to_header()
+        rid = getattr(exc, "request_id", "") or get_request_id()
+        if rid:
+            body["request_id"] = rid
+        return body
+
     def _get_ready_model(self, name: str) -> Model | tuple[int, dict]:
         m = self.models.get(name)
         if m is None:
@@ -377,8 +403,10 @@ class ModelServer:
                 out = self._call_model(m, np.asarray(instances))
         except FleetOverloaded as exc:
             # the activator's existing shed contract: the client re-dials
-            # after the hint (serving/client.py _post)
-            return 503, {"error": str(exc)}, {
+            # after the hint (serving/client.py _post). The body carries
+            # the shed decision's span context, so a shed request is
+            # attributable in the trace, not just gone
+            return 503, self._shed_body(exc), {
                 "Retry-After": str(max(1, int(round(exc.retry_after_s))))}
         except Exception as exc:  # noqa: BLE001 — surface as 500, keep serving
             return 500, {"error": f"{type(exc).__name__}: {exc}"}
@@ -439,7 +467,7 @@ class ModelServer:
         except FleetOverloaded as exc:
             # same shed contract as v1: clients back off on the server's
             # schedule instead of hard-failing or piling on immediately
-            return 503, {"error": str(exc)}, {
+            return 503, self._shed_body(exc), {
                 "Retry-After": str(max(1, int(round(exc.retry_after_s))))}
         except Exception as exc:  # noqa: BLE001
             return 500, {"error": f"{type(exc).__name__}: {exc}"}
@@ -464,6 +492,14 @@ def _make_handler(server: ModelServer):
         def log_message(self, fmt, *args):  # route to stdout for pod logs
             print(f"[http] {fmt % args}", flush=True)
 
+        def _assign_request_id(self) -> None:
+            # assign-or-echo (the apiserver's control-plane contract,
+            # extended end-to-end through the serving path): the id
+            # rides a contextvar on this request thread so the fleet's
+            # `request` root span and every error body can stamp it
+            set_request_id(self.headers.get("X-Request-Id")
+                           or new_request_id())
+
         def _reply(self, code: int, payload) -> None:
             extra = {}
             if isinstance(payload, _RawJSON):
@@ -472,20 +508,27 @@ def _make_handler(server: ModelServer):
             elif isinstance(payload, str):
                 data, ctype = payload.encode(), "text/plain; version=0.0.4"
             else:
+                if code >= 400 and isinstance(payload, dict) \
+                        and get_request_id():
+                    payload.setdefault("request_id", get_request_id())
                 data, ctype = json.dumps(payload).encode(), "application/json"
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            if get_request_id():
+                self.send_header("X-Request-Id", get_request_id())
             for name, value in extra.items():
                 self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
 
         def do_GET(self):  # noqa: N802 (http.server API)
+            self._assign_request_id()
             code, payload = server.handle_get(self.path)
             self._reply(code, payload)
 
         def do_POST(self):  # noqa: N802
+            self._assign_request_id()
             length = int(self.headers.get("Content-Length", 0))
             try:
                 body = json.loads(self.rfile.read(length) or b"{}")

@@ -15,6 +15,7 @@ from kubeflow_tpu.tracing.core import (
     Span,
     SpanContext,
     Tracer,
+    armed_tracer,
     consume_delivered_context,
     current_context,
     flush,
@@ -45,6 +46,7 @@ __all__ = [
     "Span",
     "SpanContext",
     "Tracer",
+    "armed_tracer",
     "collect_worker_traces",
     "consume_delivered_context",
     "current_context",

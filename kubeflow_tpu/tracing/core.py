@@ -255,6 +255,61 @@ class Tracer:
     # `with` sites, start_span() at manual begin/end sites
     span = start_span
 
+    # ------------------------------------------------- retroactive recording
+
+    def allocate_context(self, parent=_INHERIT) -> SpanContext | None:
+        """Pre-allocate the identity of a span that will be recorded LATER
+        with record_span(context=...). The serving data plane needs this
+        shape: a request's root span can only be emitted once the request
+        finishes (its duration is the whole point), but the engine spans
+        recorded along the way must already parent to it. Pre-allocating
+        the (trace_id, span_id) pair lets children link immediately while
+        the root stays un-emitted — no open Span object rides the engine
+        threads, so an error path can never leak one (the KFTPU-SPAN
+        hazard class, avoided by construction). Returns None when
+        disarmed."""
+        if not self.armed:
+            return None
+        if parent is _INHERIT:
+            parent = _CURRENT.get() or self.default_parent
+        elif isinstance(parent, Span):
+            parent = parent.context
+        trace_id = parent.trace_id if parent is not None else uuid.uuid4().hex
+        return SpanContext(trace_id, uuid.uuid4().hex[:16])
+
+    def record_span(self, name: str, start: float, duration: float,
+                    context: SpanContext | None = None, parent=None,
+                    **attrs) -> SpanContext | None:
+        """Record a COMPLETED interval retroactively: `start` is wall-clock
+        seconds (time.time), `duration` perf-counter-derived seconds —
+        the same clock convention live Spans use. `context` is a
+        pre-allocated identity (allocate_context) whose children may
+        already be in the recorder; `parent` a SpanContext (or Span) the
+        recorded span links under. With no context one is derived from
+        the parent. Returns the recorded span's context (None when
+        disarmed)."""
+        if not self.armed:
+            return None
+        if isinstance(parent, Span):
+            parent = parent.context
+        if context is None:
+            trace_id = (parent.trace_id if parent is not None
+                        else uuid.uuid4().hex)
+            context = SpanContext(trace_id, uuid.uuid4().hex[:16])
+        self.recorder.note_started()
+        self.recorder.record({
+            "name": name,
+            "trace": context.trace_id,
+            "span": context.span_id,
+            "parent": parent.span_id if parent is not None else "",
+            "ts": float(start),
+            "dur": max(float(duration), 0.0),
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "attrs": attrs,
+        })
+        return context
+
     def event(self, name: str, parent=_INHERIT, **attrs):
         """Zero-duration span, recorded immediately (point-in-time marks:
         a kill landing, a conflict injected, a gang restart decided)."""
@@ -300,6 +355,13 @@ class NoopTracer:
     def event(self, name: str, parent=None, **attrs) -> _NoopSpan:
         return _NOOP_SPAN
 
+    def allocate_context(self, parent=None) -> None:
+        return None
+
+    def record_span(self, name: str, start: float, duration: float,
+                    context=None, parent=None, **attrs) -> None:
+        return None
+
     def snapshot(self) -> list[dict]:
         return []
 
@@ -331,6 +393,16 @@ def set_tracer(tracer: "Tracer | None") -> "Tracer | NoopTracer":
 def tracer_of(obj) -> "Tracer | NoopTracer":
     """The tracer attached to a platform/cluster, else NOOP."""
     return getattr(obj, "tracer", None) or NOOP_TRACER
+
+
+def armed_tracer(tracer) -> "Tracer | None":
+    """`tracer` if it is a live (enabled AND armed) Tracer, else None —
+    the one predicate the serving data plane uses to decide whether to
+    pay for span bookkeeping on a request (None/NOOP/disarmed all mean
+    'emit nothing')."""
+    if tracer is None or not getattr(tracer, "enabled", False):
+        return None
+    return tracer if getattr(tracer, "armed", True) else None
 
 
 def current_context() -> SpanContext | None:

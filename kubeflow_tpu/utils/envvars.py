@@ -66,6 +66,15 @@ ENV_UPDATE_PROF_BUDGETS = "KFTPU_UPDATE_PROF_BUDGETS"
 #: repeats a phase's deterministic work N times (profiling/cpu_proxy.py)
 ENV_PROF_CHAOS = "KFTPU_PROF_CHAOS"
 
+# ------------------------------------------------------------ SLO monitoring
+
+#: sampling-tick interval in seconds for the SLO monitor's background
+#: scrape of the kftpu_* families (Platform.start_slo; docs/slo.md)
+ENV_SLO_TICK_S = "KFTPU_SLO_TICK_S"
+#: per-series ring capacity of the SLO monitor's time-series store
+#: (monitoring/tsdb.py — samples past it evict oldest, counted)
+ENV_SLO_CAPACITY = "KFTPU_SLO_CAPACITY"
+
 #: every name defined above, for tooling that wants the full contract
 ALL_ENV_VARS = tuple(
     v for k, v in sorted(globals().items())

@@ -42,9 +42,10 @@ class Exposition:
             self.lines.append(f"# HELP {name} {help_}")
         self.lines.append(f"# TYPE {name} {type_}")
 
-    def counter(self, name: str, value, help_: str = "") -> None:
+    def counter(self, name: str, value, help_: str = "",
+                labels: str = "") -> None:
         self.declare(name, "counter", help_)
-        self.lines.append(f"{name} {value}")
+        self.lines.append(f"{name}{labels} {value}")
 
     def gauge(self, name: str, value, help_: str = "",
               labels: str = "") -> None:

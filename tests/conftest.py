@@ -45,6 +45,7 @@ _COMPILE_CACHE_FILES = frozenset((
     "test_continuous.py",
     "test_gpt_generate.py",
     "test_fleet.py",
+    "test_slo.py",
     "test_serving.py",
     "test_serving_agent.py",
     "test_serving_grpc.py",
@@ -159,7 +160,8 @@ def lockcheck_armed(request):
             or request.node.get_closest_marker("health")
             or request.node.get_closest_marker("fleet")
             or request.node.get_closest_marker("hotpath")
-            or request.node.get_closest_marker("partition")):
+            or request.node.get_closest_marker("partition")
+            or request.node.get_closest_marker("slo")):
         yield
         return
     from kubeflow_tpu.analysis import lockcheck

@@ -69,12 +69,23 @@ class TestPerfGate:
     def test_injected_decode_tick_slowdown_fails(self, monkeypatch):
         """The fleet gate's teeth: doubling the engines' per-tick device
         dispatches (work repeated AND serialized, never slept) must fail
-        the serve_fleet budget even though the machine is unchanged."""
+        the serve_fleet budget even though the machine is unchanged —
+        AND the decode-tick SLO burn-rate alert must FIRE on the same
+        run (ISSUE 12's falsifiable-teeth acceptance: the monitor sees
+        the regression the gate sees)."""
         monkeypatch.setenv(ENV_PROF_CHAOS, "decode_tick:2")
         results = cpu_proxy.run_all(only="serve_fleet")
         violations = cpu_proxy.check_budgets(
             results, json.loads(BUDGETS.read_text()))
         assert any("serve_fleet." in v for v in violations), violations
+        assert any("serve_fleet.slo_decode_burn" in v
+                   for v in violations), violations
+        (rec,) = results
+        assert rec["slo"]["decode_tick"]["fired"] is True
+        assert "serving_decode_tick" in rec["slo"]["alerts"]
+        # every configured window must be burning past the budget line
+        assert all(b >= 1.0 for b in
+                   rec["slo"]["decode_tick"]["burn_rates"].values())
 
     def test_forced_serialization_fails_grad_overlap_gate(self,
                                                           monkeypatch):
@@ -125,6 +136,21 @@ class TestPerfGate:
         assert rec["dropped_count"] == 0
         assert rec["completed"] == rec["requests"]
         assert rec["rel"]["reuse_computed_frac"] < 1.0
+        # the monitored drill's alert-quiet half of the teeth: an
+        # untouched tree burns only tail noise and fires nothing, with
+        # the sampling tick live INSIDE the gated decode window (the
+        # monitor-overhead acceptance — the decode_tick budget above
+        # gates the run that carried the sampling)
+        assert rec["slo"]["decode_tick"]["fired"] is False
+        assert rec["slo"]["zero_drop"]["fired"] is False
+        assert rec["slo"]["alerts"] == []
+        assert rec["slo"]["decode_tick"]["samples"] > 0
+        assert rec["monitor_samples"] > 0
+        # every load request was traced and its phases sum to its wall
+        # (the request_breakdown acceptance on the seeded drill)
+        assert rec["request_breakdown"]["count"] == rec["requests"]
+        assert rec["request_breakdown"]["by_outcome"] == {
+            "completed": rec["requests"]}
 
 
 class TestGateLogic:

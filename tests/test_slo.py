@@ -1,0 +1,855 @@
+"""kftpu-reqtrace suite — serving request tracing, the bounded TSDB, and
+the SLO burn-rate monitor (docs/slo.md).
+
+Covers: TimeSeriesStore ring bounds/dropped accounting and windowed
+rate/delta/quantile queries, exposition sampling, burn-rate math for all
+three objective kinds with the multi-window veto, the request-breakdown
+invariant (admission+queue+prefill+decode+stall sum EXACTLY to request
+wall), the seeded traced fleet drill with its golden kill→requeue trace
+SHAPE pin (tests/golden/trace_shape_request_requeue.txt), X-Request-Id
+end-to-end through the model server, shed-retry attribution in the
+load-test report, the burn-rate-aware demand signal, and the
+three-surface agreement (`/debug/slo` == `kftpu slo` ==
+monitoring.build_slo_report)."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.cli import main as cli_main
+from kubeflow_tpu.monitoring import (
+    BURN_RATE_CAP,
+    SLOConfig,
+    SLOMonitor,
+    TimeSeriesStore,
+    build_slo_report,
+    build_slo_report_from_spans,
+    default_slos,
+    parse_exposition,
+    render_slo_text,
+    sample_platform,
+)
+from kubeflow_tpu.profiling import (
+    REQUEST_PHASES,
+    aggregate_requests,
+    request_breakdown,
+    request_shape,
+)
+from kubeflow_tpu.tracing import Tracer
+
+pytestmark = pytest.mark.slo
+
+GOLDEN_SHAPE = Path(__file__).resolve().parent / "golden" / \
+    "trace_shape_request_requeue.txt"
+
+
+def mk(name, ts, dur, *, span=None, parent="", pid=1, trace="t1", **attrs):
+    return {
+        "name": name, "trace": trace,
+        "span": span or f"{name}@{ts}",
+        "parent": parent, "ts": ts, "dur": dur,
+        "pid": pid, "tid": 0, "attrs": dict(attrs),
+    }
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+
+    cfg = GPTConfig.tiny(dropout_rate=0.0, max_len=96)
+    model = GPTLM(cfg)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, 8), jnp.int32))
+    return model, variables
+
+
+def _prompt(seed, n, vocab=512):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, vocab, size=(n,)).astype(np.int32)
+
+
+# ------------------------------------------------------------------- TSDB
+
+
+class TestTimeSeriesStore:
+    def test_ring_bound_and_dropped_accounting(self):
+        ts = TimeSeriesStore(capacity_per_series=4)
+        for i in range(7):
+            ts.record("a", float(i), ts=float(i))
+        st = ts.stats()
+        assert st["samples_total"] == 7
+        assert st["samples_dropped_total"] == 3  # exact, FlightRecorder-style
+        # the ring holds the NEWEST capacity samples
+        assert [v for _, v in ts.window("a", 100.0, now=10.0)] == [
+            3.0, 4.0, 5.0, 6.0]
+
+    def test_series_set_is_bounded_and_rejections_counted(self):
+        ts = TimeSeriesStore(capacity_per_series=8, max_series=2)
+        assert ts.record("a", 1.0) and ts.record("b", 1.0)
+        assert not ts.record("c", 1.0)  # refused, never raises
+        assert ts.record("a", 2.0)  # existing series still records
+        assert ts.stats()["series_rejected_total"] == 1
+        assert ts.names() == ["a", "b"]
+
+    def test_delta_is_reset_aware(self):
+        ts = TimeSeriesStore()
+        for i, v in enumerate([0.0, 5.0, 2.0, 4.0]):  # reset after 5
+            ts.record("c", v, ts=float(i))
+        # 0->5 (+5), 5->2 reset (counts the post-reset value 2), 2->4 (+2)
+        assert ts.delta("c", 100.0, now=3.0) == pytest.approx(9.0)
+        assert ts.rate("c", 100.0, now=3.0) == pytest.approx(0.09)
+
+    def test_delta_counts_window_edge_increment(self):
+        ts = TimeSeriesStore()
+        ts.record("c", 10.0, ts=0.0)
+        ts.record("c", 13.0, ts=50.0)
+        # the pre-window sample is the baseline: the step into the
+        # window is visible even though only one sample is inside it
+        assert ts.delta("c", 60.0, now=60.0) == pytest.approx(3.0)
+
+    def test_quantile_mean_latest(self):
+        ts = TimeSeriesStore()
+        for i in range(10):
+            ts.record("q", float(i), ts=float(i))
+        assert ts.latest("q") == 9.0
+        # nearest-rank (the analytics.percentile convention): idx
+        # round(0.5 * 9) == 4 under round-half-even
+        assert ts.quantile("q", 0.5, window_s=100.0, now=9.0) \
+            == pytest.approx(4.0)
+        assert ts.mean("q", 100.0, now=9.0) == pytest.approx(4.5)
+        # windowing excludes old samples
+        assert ts.mean("q", 3.0, now=9.0) == pytest.approx(8.0)
+        assert ts.quantile("missing", 0.5, 10.0) == 0.0
+
+    def test_record_many_one_timestamp(self):
+        ts = TimeSeriesStore()
+        assert ts.record_many({"a": 1, "b": 2}, ts=5.0) == 2
+        assert ts.window("a", 1.0, now=5.0) == [(5.0, 1.0)]
+
+
+class TestExpositionSampling:
+    def test_parse_skips_comments_and_buckets(self):
+        text = (
+            "# HELP kftpu_x total\n# TYPE kftpu_x counter\n"
+            "kftpu_x 3\n"
+            'kftpu_h_bucket{le="0.1"} 5\n'
+            "kftpu_h_sum 0.4\nkftpu_h_count 7\n"
+            'kftpu_g{quantile="0.99"} 1.25\n'
+            "kftpu_bad not_a_number\n")
+        out = parse_exposition(text)
+        assert out == {"kftpu_x": 3.0, "kftpu_h_sum": 0.4,
+                       "kftpu_h_count": 7.0,
+                       'kftpu_g{quantile="0.99"}': 1.25}
+
+    def test_sample_platform_records_kftpu_families(self, tmp_path):
+        from kubeflow_tpu.client import Platform
+
+        with Platform(log_dir=str(tmp_path / "pod-logs")) as p:
+            ts = TimeSeriesStore()
+            n = sample_platform(p, ts)
+            assert n > 0
+            # the default SLO set's fleet input series exists (zero-valued)
+            assert 'kftpu_fleet_ttft_seconds{quantile="0.99"}' in ts.names()
+            assert ts.latest("kftpu_fleet_requests_failed_total") == 0.0
+
+
+# ------------------------------------------------------------- burn rates
+
+
+def _fill(ts, name, values, t0=0.0, dt=1.0):
+    for i, v in enumerate(values):
+        ts.record(name, float(v), ts=t0 + i * dt)
+
+
+class TestSLOMonitor:
+    def test_above_burn_and_fire(self):
+        ts = TimeSeriesStore()
+        _fill(ts, "lat", [0.1] * 10 + [2.0] * 10, t0=0.0)
+        cfg = SLOConfig("lat99", metric="lat", kind="above", threshold=1.0,
+                        budget=0.25, windows=((20.0, 1.0), (5.0, 1.0)))
+        mon = SLOMonitor(ts, (cfg,))
+        alerts = mon.evaluate(now=19.0)
+        assert len(alerts) == 1
+        a = alerts[0]
+        # long window: 10/20 bad / 0.25 = 2.0; short (last 5s): all bad
+        assert a.burn_rates["20"] == pytest.approx(2.0)
+        assert a.burn_rates["5"] == pytest.approx(4.0)
+        assert a.fired_at == 19.0  # newest offending sample, not eval time
+        assert a.observed == 2.0
+        assert mon.metrics == {"evaluations_total": 1,
+                               "alerts_fired_total": 1}
+
+    def test_short_window_vetoes_recovered_burn(self):
+        """The multi-window contract: an old violation burst must NOT
+        keep firing once the short window is clean again."""
+        ts = TimeSeriesStore()
+        _fill(ts, "lat", [2.0] * 10 + [0.1] * 10, t0=0.0)
+        cfg = SLOConfig("lat99", metric="lat", kind="above", threshold=1.0,
+                        budget=0.25, windows=((20.0, 1.0), (5.0, 1.0)))
+        mon = SLOMonitor(ts, (cfg,))
+        assert mon.evaluate(now=19.0) == []
+        state = mon.describe()[0]
+        assert state["burn_rates"]["20"] == pytest.approx(2.0)  # still hot
+        assert state["burn_rates"]["5"] == 0.0  # but current = quiet
+        assert state["fired"] is False
+
+    def test_below_kind_for_goodness_ratios(self):
+        ts = TimeSeriesStore()
+        _fill(ts, "goodput", [0.9, 0.2, 0.1, 0.2], t0=0.0)
+        cfg = SLOConfig("gp", metric="goodput", kind="below",
+                        threshold=0.5, budget=0.5, windows=((10.0, 1.0),))
+        mon = SLOMonitor(ts, (cfg,))
+        (a,) = mon.evaluate(now=3.0)
+        assert a.burn_rates["10"] == pytest.approx(1.5)  # 3/4 bad / 0.5
+        assert a.observed == pytest.approx(0.1)  # worst (min) observed
+
+    def test_zero_budget_increase_saturates(self):
+        ts = TimeSeriesStore()
+        _fill(ts, "failed", [0, 0, 1, 1], t0=0.0)
+        cfg = SLOConfig("drops", metric="failed", kind="increase",
+                        budget=0.0, windows=((10.0, 1.0),))
+        mon = SLOMonitor(ts, (cfg,))
+        (a,) = mon.evaluate(now=3.0)
+        assert a.burn_rates["10"] == BURN_RATE_CAP
+        # flat counter -> quiet
+        ts2 = TimeSeriesStore()
+        _fill(ts2, "failed", [3, 3, 3], t0=0.0)
+        mon2 = SLOMonitor(ts2, (cfg,))
+        assert mon2.evaluate(now=2.0) == []
+
+    def test_no_samples_never_fires(self):
+        mon = SLOMonitor(TimeSeriesStore(), (SLOConfig(
+            "lat", metric="lat", kind="above", threshold=1.0,
+            budget=0.01),))
+        assert mon.evaluate() == []
+        assert mon.describe()[0]["samples"] == 0
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SLOConfig("x", metric="m", kind="sideways")
+        with pytest.raises(ValueError):
+            SLOConfig("x", metric="m", kind="above", budget=0.0)
+        with pytest.raises(ValueError):
+            SLOConfig("x", metric="m", windows=())
+        with pytest.raises(ValueError):
+            SLOMonitor(TimeSeriesStore(),
+                       (SLOConfig("x", metric="m"),
+                        SLOConfig("x", metric="n")))
+
+    def test_default_slos_cover_the_soak_gates(self):
+        names = {c.name for c in default_slos()}
+        assert names == {"serving_ttft_p99", "serving_decode_tick",
+                         "train_goodput", "serving_zero_drop"}
+
+
+# ------------------------------------------------------ request breakdown
+
+
+class TestRequestBreakdown:
+    def test_phases_sum_exactly_to_wall(self):
+        spans = [
+            mk("request", 0.0, 1.0, span="r1", request_id="abc",
+               outcome="completed", attempts=1, tokens=8),
+            mk("request.admission", 0.0, 0.0, parent="r1"),
+            mk("engine.queue_wait", 0.0, 0.2, parent="r1"),
+            mk("engine.prefill_chunk", 0.2, 0.1, parent="r1",
+               tokens_computed=4, tokens_reused=8),
+            mk("engine.prefill_chunk", 0.3, 0.1, parent="r1",
+               tokens_computed=4, tokens_reused=0),
+            mk("engine.decode", 0.4, 0.5, parent="r1", tokens=8),
+        ]
+        (row,) = request_breakdown(spans)
+        assert row["wall"] == 1.0
+        assert row["queue"] == pytest.approx(0.2)
+        assert row["prefill"] == pytest.approx(0.2)
+        assert row["decode"] == pytest.approx(0.5)
+        assert row["stall"] == pytest.approx(0.1)
+        assert sum(row[p] for p in REQUEST_PHASES) == row["wall"]  # EXACT
+        assert row["prefill_tokens_computed"] == 8
+        assert row["prefill_tokens_reused"] == 8
+        assert row["request_id"] == "abc"
+
+    def test_overrunning_child_clamps_never_negative_stall(self):
+        spans = [
+            mk("request", 0.0, 0.5, span="r1", outcome="completed"),
+            mk("engine.decode", 0.0, 0.9, parent="r1"),  # clock noise
+            mk("engine.queue_wait", 0.4, 0.3, parent="r1"),
+        ]
+        (row,) = request_breakdown(spans)
+        assert row["decode"] == pytest.approx(0.5)
+        assert row["queue"] == 0.0  # nothing left to charge
+        assert row["stall"] == 0.0
+        assert sum(row[p] for p in REQUEST_PHASES) == row["wall"]
+
+    def test_aggregate_counts_outcomes(self):
+        spans = [
+            mk("request", 0.0, 0.4, span="r1", outcome="completed"),
+            mk("request", 1.0, 0.0, span="r2", outcome="shed"),
+            mk("request", 2.0, 0.6, span="r3", outcome="completed"),
+        ]
+        agg = aggregate_requests(request_breakdown(spans))
+        assert agg["count"] == 3
+        assert agg["by_outcome"] == {"completed": 2, "shed": 1}
+        assert agg["wall"]["p99_s"] == pytest.approx(0.6)
+        assert sum(agg["phases_s"][p] for p in REQUEST_PHASES) \
+            == pytest.approx(agg["wall_s"])
+
+
+# ---------------------------------------------------- traced fleet drill
+
+
+def _traced_drill(lm):
+    """The seeded sync drill with a mid-run kill, fully traced — the
+    canonical request-trace fixture (deterministic: tick-driven, seeded
+    arrivals, fixed kill tick)."""
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+    from kubeflow_tpu.serving.fleet import (
+        FleetOverloaded,
+        FleetRouter,
+        PagedKVPool,
+        make_prompts,
+        run_loadtest_sync,
+    )
+
+    model, variables = lm
+    tracer = Tracer(capacity=4096, service="drill")
+    tsdb = TimeSeriesStore()
+    pool = PagedKVPool(block_size=4, capacity_blocks=128)
+    engines = [ContinuousBatcher(model, variables, max_rows=2,
+                                 default_max_new_tokens=4, paged_kv=pool,
+                                 prefill_chunk=4, tracer=tracer, tsdb=tsdb)
+               for _ in range(2)]
+    router = FleetRouter(engines, tracer=tracer)
+    prompts = make_prompts(8, seed=3, vocab=512, prompt_len=4,
+                           shared_prefix=4)
+    report = run_loadtest_sync(router, prompts, seed=3,
+                               mean_gap_ticks=0.5, new_tokens=4,
+                               kill_at_tick=3, kill_replica=1)
+    # one deterministic shed at the end: preset the rate so the
+    # estimator is calibrated, then demand an impossible TTFT
+    router.ttft_slo_s = 1e-9
+    router._rate = 1.0
+    shed_exc = None
+    try:
+        router.submit(_prompt(99, 6), max_new_tokens=4)
+    except FleetOverloaded as exc:
+        shed_exc = exc
+    return tracer, tsdb, router, report, shed_exc
+
+
+class TestTracedFleetDrill:
+    def test_drill_breakdown_and_golden_shape(self, lm):
+        """The acceptance drill: zero drops across the kill, every
+        request traced with phases summing EXACTLY to its wall, the
+        requeue parent-linked to the kill event, and the whole causal
+        SHAPE pinned against the golden (KFTPU_UPDATE_GOLDEN=1
+        regenerates)."""
+        tracer, tsdb, router, report, shed_exc = _traced_drill(lm)
+        s = report.summary()
+        assert s["dropped"] == 0 and s["completed"] == 8
+        assert s["requeued"] >= 1
+        spans = tracer.snapshot()
+        rows = request_breakdown(spans)
+        # every load request + the shed traced
+        assert len(rows) == 9
+        for row in rows:
+            assert sum(row[p] for p in REQUEST_PHASES) == row["wall"]
+        outcomes = aggregate_requests(rows)["by_outcome"]
+        assert outcomes == {"completed": 8, "shed": 1}
+        # the shed carried its span ctx out on the exception (the 503
+        # body contract) and the ctx resolves to the recorded shed root
+        assert shed_exc is not None and shed_exc.trace_ctx is not None
+        shed_roots = [s for s in spans if s["name"] == "request"
+                      and s["attrs"].get("outcome") == "shed"]
+        assert [s["span"] for s in shed_roots] \
+            == [shed_exc.trace_ctx.span_id]
+        # requeue events are parent-linked to the kill event — the
+        # chaos.pod_kill → gang_restart chain, serving edition
+        kills = [s for s in spans if s["name"] == "fleet.replica_kill"]
+        requeues = [s for s in spans if s["name"] == "fleet.requeue"]
+        assert len(kills) == 1 and len(requeues) == s["requeued"]
+        assert all(r["parent"] == kills[0]["span"] for r in requeues)
+        assert all(r["trace"] == kills[0]["trace"] for r in requeues)
+        # requeued requests re-dispatched: attempts attr matches events
+        requeued_rows = [r for r in rows if r["attempts"] > 1]
+        assert sum(r["attempts"] - 1 for r in requeued_rows) \
+            == len(requeues)
+        # decode-tick + TTFT series flowed to the TSDB off the hot path
+        assert tsdb.quantile("serving.decode_tick_s", 0.5, 3600.0) > 0
+        assert len(tsdb.window("serving.ttft_s", 3600.0)) == 8
+        # --- golden trace-shape pin (KFTPU_UPDATE_GOLDEN=1 regenerates)
+        shape = request_shape(spans)
+        if os.environ.get("KFTPU_UPDATE_GOLDEN"):
+            GOLDEN_SHAPE.write_text(shape)
+        assert shape == GOLDEN_SHAPE.read_text(), (
+            "request trace SHAPE diverged from the golden — a causal "
+            "link regressed (dropped carrier / orphaned requeue), or "
+            "regen deliberately with KFTPU_UPDATE_GOLDEN=1"
+        )
+
+    def test_engine_owns_root_span_without_fleet(self, lm):
+        """A solo engine request (no router) still gets a `request`
+        root: the engine allocates and records it itself."""
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+        from kubeflow_tpu.serving.requestid import set_request_id
+
+        model, variables = lm
+        tracer = Tracer(capacity=256, service="engine")
+        eng = ContinuousBatcher(model, variables, max_rows=2,
+                                tracer=tracer)
+        set_request_id("rid-solo")
+        try:
+            req = eng.submit(_prompt(42, 6), max_new_tokens=3)
+            eng.run_until_idle()
+        finally:
+            set_request_id("")
+        assert req.result(timeout=1).size == 3
+        spans = tracer.snapshot()
+        (root,) = [s for s in spans if s["name"] == "request"]
+        assert root["attrs"]["outcome"] == "completed"
+        assert root["attrs"]["request_id"] == "rid-solo"
+        kids = {s["name"] for s in spans if s["parent"] == root["span"]}
+        assert {"engine.queue_wait", "engine.prefill_chunk",
+                "engine.decode"} <= kids
+        (row,) = request_breakdown(spans)
+        assert sum(row[p] for p in REQUEST_PHASES) == row["wall"]
+
+    def test_batch_gate_shed_is_traced_via_record_shed(self, lm):
+        """The JaxModel batch-gate path (admit_or_raise outside
+        submit()) sheds with the same traced contract: record_shed
+        stamps the exception and records the shed root."""
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+        from kubeflow_tpu.serving.fleet import FleetOverloaded, FleetRouter
+
+        model, variables = lm
+        tracer = Tracer(capacity=64, service="gate")
+        router = FleetRouter(
+            [ContinuousBatcher(model, variables, max_rows=2)],
+            ttft_slo_s=1e-9, service_rate_tokens_per_s=1.0,
+            tracer=tracer)
+        with pytest.raises(FleetOverloaded) as exc:
+            router.admit_or_raise(100)
+        out = router.record_shed(exc.value, 100, request_id="batch-rid")
+        assert out is exc.value and out.request_id == "batch-rid"
+        (root,) = [s for s in tracer.snapshot() if s["name"] == "request"]
+        assert root["span"] == out.trace_ctx.span_id
+        assert root["attrs"] == {"request_id": "batch-rid",
+                                 "outcome": "shed"}
+        (ev,) = [s for s in tracer.snapshot()
+                 if s["name"] == "request.admission"]
+        assert ev["parent"] == root["span"]
+        assert ev["attrs"]["decision"] == "shed"
+        assert ev["attrs"]["prompt_tokens"] == 100
+
+    def test_disarmed_tracer_emits_nothing(self, lm):
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+        from kubeflow_tpu.serving.fleet import FleetRouter
+
+        model, variables = lm
+        tracer = Tracer(capacity=64, service="off")
+        tracer.armed = False
+        router = FleetRouter(
+            [ContinuousBatcher(model, variables, max_rows=2,
+                               tracer=tracer)], tracer=tracer)
+        req = router.submit(_prompt(7, 5), max_new_tokens=3)
+        router.run_until_idle()
+        assert req.result(timeout=1).size == 3
+        assert tracer.snapshot() == []
+
+    def test_demand_replicas_burn_scales_on_burning_slo(self, lm):
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+        from kubeflow_tpu.serving.fleet import FleetRouter
+
+        model, variables = lm
+        router = FleetRouter([ContinuousBatcher(model, variables,
+                                                max_rows=2)])
+        ts = TimeSeriesStore()
+        _fill(ts, "serving.decode_tick_s", [2.0] * 20,
+              t0=time.time() - 20)
+        mon = SLOMonitor(ts, (SLOConfig(
+            "serving_decode_tick", metric="serving.decode_tick_s",
+            kind="above", threshold=1.0, budget=0.25,
+            windows=((300.0, 1.0), (60.0, 1.0))),))
+        # before evaluation the burn state is zero -> base signal
+        assert router.demand_replicas_burn(mon) == router.demand_replicas()
+        mon.evaluate()
+        base = router.demand_replicas()
+        scaled = router.demand_replicas_burn(mon)
+        assert scaled == base * router.BURN_DEMAND_CAP  # burn 4 / cap 4
+        # an SLO outside the serving set is ignored
+        assert router.demand_replicas_burn(mon, slos=("other",)) == base
+
+
+# ------------------------------------------------- X-Request-Id satellite
+
+
+class TestRequestIdEndToEnd:
+    def test_server_assigns_echoes_and_stamps_errors(self):
+        from serving_fixtures import DoubleModel
+
+        from kubeflow_tpu.serving.server import ModelServer
+
+        srv = ModelServer([DoubleModel("double")], port=0).start()
+        try:
+            # echo: the client's id comes back on the header
+            req = urllib.request.Request(
+                f"{srv.url}/v1/models/double:predict",
+                data=json.dumps({"instances": [[1.0]]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "client-chose-this"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.headers["X-Request-Id"] == "client-chose-this"
+                assert json.loads(r.read())["predictions"] == [[2.0]]
+            # assign: no client id -> server mints one
+            req = urllib.request.Request(
+                f"{srv.url}/v1/models/double:predict",
+                data=json.dumps({"instances": [[1.0]]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert len(r.headers["X-Request-Id"]) == 16
+            # error bodies carry it (logged path AND plain-dict path)
+            req = urllib.request.Request(
+                f"{srv.url}/v1/models/missing:predict",
+                data=json.dumps({"instances": [[1.0]]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "err-id"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 404
+            body = json.loads(exc.value.read())
+            assert body["request_id"] == "err-id"
+            assert exc.value.headers["X-Request-Id"] == "err-id"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{srv.url}/no/such/route",
+                                       timeout=10)
+            assert json.loads(exc.value.read())["request_id"]
+        finally:
+            srv.stop()
+
+    def test_predict_timed_carries_request_id(self):
+        from serving_fixtures import DoubleModel
+
+        from kubeflow_tpu.serving.client import ServingClient
+        from kubeflow_tpu.serving.server import ModelServer
+
+        srv = ModelServer([DoubleModel("double")], port=0).start()
+        try:
+            client = ServingClient.__new__(ServingClient)
+            client._endpoint = lambda name, ns: srv.url
+            _out, timing = ServingClient.predict_timed(
+                client, "double", [[1.0]])
+            assert len(timing.request_id) == 16  # server-assigned
+        finally:
+            srv.stop()
+
+    def test_fleet_shed_503_body_carries_trace_ctx(self, lm):
+        """The wire form of the shed contract: 503 body carries the shed
+        decision's span context + request id alongside Retry-After."""
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+        from kubeflow_tpu.serving.fleet import FleetRouter
+        from kubeflow_tpu.serving.server import ModelServer
+        from kubeflow_tpu.serving.model import Model
+
+        model, variables = lm
+        tracer = Tracer(capacity=256, service="shed")
+        router = FleetRouter(
+            [ContinuousBatcher(model, variables, max_rows=2,
+                               tracer=tracer)],
+            ttft_slo_s=1e-9, service_rate_tokens_per_s=1.0,
+            tracer=tracer)
+
+        class FleetModel(Model):
+            def load(self):
+                self.ready = True
+
+            def predict(self, inputs):
+                return router.submit(np.asarray(inputs).reshape(-1))
+
+        srv = ModelServer([FleetModel("fm")], port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"{srv.url}/v1/models/fm:predict",
+                data=json.dumps({"instances": [1, 2, 3]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "shed-rid"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 503
+            assert int(exc.value.headers["Retry-After"]) >= 1
+            body = json.loads(exc.value.read())
+            assert body["request_id"] == "shed-rid"
+            # the ctx in the body resolves to the recorded shed root span
+            trace_id, _, span_id = body["trace"].partition("-")
+            (root,) = [s for s in tracer.snapshot()
+                       if s["name"] == "request"]
+            assert (root["trace"], root["span"]) == (trace_id, span_id)
+            assert root["attrs"]["outcome"] == "shed"
+            assert root["attrs"]["request_id"] == "shed-rid"
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------- loadtest retry attribution
+
+
+class TestLoadtestRetryAccounting:
+    def test_threaded_report_separates_backoff_from_ttft(self, lm):
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+        from kubeflow_tpu.serving.fleet import (
+            FleetRouter,
+            make_prompts,
+            run_loadtest,
+        )
+
+        model, variables = lm
+        # calibrated estimator + microscopic SLO: every submit sheds,
+        # retries wait the hint, then counts as shed — fully offline
+        router = FleetRouter(
+            [ContinuousBatcher(model, variables, max_rows=2)],
+            ttft_slo_s=1e-9, service_rate_tokens_per_s=1e9,
+            retry_after_s=0.01)
+        prompts = make_prompts(3, seed=1, vocab=512, prompt_len=4)
+        report = run_loadtest(router, prompts, seed=1, mean_gap_s=0.0,
+                              new_tokens=2, shed_retries=1, timeout_s=10)
+        s = report.summary()
+        assert s["shed"] == 3
+        assert s["retried"] == 3  # every request re-dialed once
+        assert s["attempts_mean"] == pytest.approx(2.0)
+        assert s["retry_wait_p99_s"] > 0
+        assert len(report.attempts) == len(report.retry_wait_s) == 3
+
+    def test_sync_mode_reports_zeroed_retry_fields(self, lm):
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+        from kubeflow_tpu.serving.fleet import (
+            FleetRouter,
+            make_prompts,
+            run_loadtest_sync,
+        )
+
+        model, variables = lm
+        router = FleetRouter([ContinuousBatcher(model, variables,
+                                                max_rows=2)])
+        report = run_loadtest_sync(
+            router, make_prompts(2, seed=2, vocab=512, prompt_len=4),
+            seed=2, new_tokens=2)
+        s = report.summary()
+        assert s["completed"] == 2
+        assert s["retried"] == 0 and s["attempts_mean"] == 0.0
+        assert s["retry_wait_p99_s"] == 0.0
+
+
+# -------------------------------------------------- surfaces must agree
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    from kubeflow_tpu.client import Platform
+
+    p = Platform(log_dir=str(tmp_path / "pod-logs"))
+    with p:
+        yield p
+
+
+def _request_run():
+    """Deterministic request spans for the surface-agreement pin."""
+    return [
+        mk("request", 100.0, 1.0, span="r1", request_id="a",
+           outcome="completed", attempts=1, tokens=4),
+        mk("engine.queue_wait", 100.0, 0.25, parent="r1"),
+        mk("engine.prefill_chunk", 100.25, 0.25, parent="r1",
+           tokens_computed=8, tokens_reused=4),
+        mk("engine.decode", 100.5, 0.5, parent="r1", tokens=4),
+        mk("request", 101.0, 0.5, span="r2", request_id="b",
+           outcome="failed", attempts=2, tokens=0),
+    ]
+
+
+class TestSurfacesAgree:
+    def test_debug_slo_cli_and_report_match(self, platform, capsys):
+        """One frozen fixture, three surfaces: /debug/slo (JSON + text),
+        `kftpu slo --server --json`, and build_slo_report must agree;
+        the kftpu_slo_* gauges carry the same burn rates."""
+        from kubeflow_tpu.apiserver import PlatformServer
+
+        tr = platform.start_tracing()
+        for s in _request_run():
+            tr.recorder.record(s)
+        platform.start_slo(sample_interval_s=3600.0)
+        # seed a burning series in the past-minute window (the 3600s
+        # sampler interval means no tick interleaves), THEN freeze:
+        # stop_slo disarms the TSDB and stop_tracing the recorder —
+        # long windows make the burn rates invariant to read skew
+        now = time.time()
+        for i in range(10):
+            assert platform.slo_tsdb.record("serving.decode_tick_s", 9.9,
+                                            ts=now - 30 + i)
+        platform.stop_slo()
+        # frozen: a late hot-path producer cannot evict the capture
+        assert not platform.slo_tsdb.record("serving.decode_tick_s", 0.1)
+        platform.stop_tracing()
+        server = PlatformServer(platform, port=0).start()
+        try:
+            with urllib.request.urlopen(f"{server.url}/debug/slo",
+                                        timeout=10) as r:
+                report = json.loads(r.read())
+            with urllib.request.urlopen(
+                    f"{server.url}/debug/slo?format=text", timeout=10) as r:
+                text = r.read().decode()
+            with urllib.request.urlopen(f"{server.url}/metrics",
+                                        timeout=10) as r:
+                metrics = r.read().decode()
+            assert cli_main(["slo", "--server", server.url,
+                             "--json"]) == 0
+            cli_report = json.loads(capsys.readouterr().out)
+        finally:
+            server.stop()
+        direct = build_slo_report(platform)
+        # CLI over HTTP == raw endpoint; direct build == both (alerts/
+        # burn rates are stable: the windows dwarf the read skew and
+        # fired_at is the newest SAMPLE ts, not evaluation time)
+        assert cli_report == report
+        assert direct == report
+        # the decode-tick SLO is burning: 10 samples all over threshold
+        (alert,) = [a for a in report["alerts"]
+                    if a["slo"] == "serving_decode_tick"]
+        assert alert["fired_at"] == pytest.approx(now - 21, abs=1e-3)
+        assert "FIRING" in text and "serving_decode_tick" in text
+        # request breakdown identical across surfaces and correct
+        rq = report["requests"]
+        assert rq["count"] == 2
+        assert rq["by_outcome"] == {"completed": 1, "failed": 1}
+        assert rq["phases_s"]["queue"] == pytest.approx(0.25)
+        assert sum(rq["phases_s"][p] for p in REQUEST_PHASES) \
+            == pytest.approx(rq["wall_s"])
+        # /metrics gauges mirror the describe() state the report carries
+        slo_state = {s["name"]: s for s in report["slos"]}[
+            "serving_decode_tick"]
+        line = next(ln for ln in metrics.splitlines() if ln.startswith(
+            'kftpu_slo_burn_rate{slo="serving_decode_tick",'
+            'window_s="60"}'))
+        assert float(line.split()[-1]) == pytest.approx(
+            slo_state["burn_rates"]["60"])
+        active = next(ln for ln in metrics.splitlines() if ln.startswith(
+            'kftpu_slo_alert_active{slo="serving_decode_tick"}'))
+        assert active.split()[-1] == "1"
+        # request families carry the fixture's totals
+        wall_sum = next(ln for ln in metrics.splitlines()
+                        if ln.startswith("kftpu_request_wall_seconds_sum"))
+        assert float(wall_sum.split()[-1]) == pytest.approx(1.5)
+
+    def test_trace_dir_mode_shares_build_path(self, tmp_path, capsys):
+        from kubeflow_tpu.tracing import write_spans_jsonl
+
+        spans = _request_run() + [mk("reconcile", 0.0, 0.1,
+                                     controller="job")]
+        write_spans_jsonl(str(tmp_path / "spans.jsonl"), spans)
+        assert cli_main(["slo", "--trace-dir", str(tmp_path),
+                         "--json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report == build_slo_report_from_spans(spans)
+        assert report["requests"]["count"] == 2
+        assert report["slos"] == [] and report["alerts"] == []
+
+    def test_debug_slo_404_without_tracing_or_monitor(self, platform):
+        from kubeflow_tpu.apiserver import PlatformServer
+
+        server = PlatformServer(platform, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{server.url}/debug/slo",
+                                       timeout=10)
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+    def test_cli_error_paths(self, tmp_path, capsys):
+        assert cli_main(["slo"]) == 2  # neither flag
+        assert cli_main(["slo", "--trace-dir", str(tmp_path / "none"),
+                         "--server", "http://x"]) == 2  # both
+        assert cli_main(["slo", "--trace-dir",
+                         str(tmp_path / "missing")]) == 2
+        assert cli_main(["slo", "--server",
+                         "http://127.0.0.1:1/closed"]) == 2
+        err = capsys.readouterr().err
+        assert "error:" in err and "Traceback" not in err
+
+
+# ----------------------------------------------- platform sampler wiring
+
+
+class TestPlatformSLOWiring:
+    def test_start_slo_samples_and_wires_fleets(self, platform, lm):
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+        from kubeflow_tpu.serving.fleet import FleetRouter
+
+        model, variables = lm
+        router = FleetRouter([ContinuousBatcher(model, variables,
+                                                max_rows=2)])
+        # register BEFORE tracing/slo exist: the wiring must compose in
+        # either order (start_tracing/start_slo wire existing fleets)
+        platform.register_fleet("default/svc", router)
+        platform.start_tracing()
+        mon = platform.start_slo(sample_interval_s=0.05)
+        try:
+            assert platform.start_slo() is mon  # idempotent
+            deadline = time.monotonic() + 10
+            while (platform.slo_tsdb.stats()["samples_total"] == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)  # kftpu: allow=KFTPU-SLEEP (test pacing)
+            assert platform.slo_tsdb.stats()["samples_total"] > 0
+            # the registered fleet's engine feeds the platform TSDB and
+            # inherits the platform tracer (the register_fleet wiring)
+            eng = router.replicas[0].engine
+            assert eng.tsdb is platform.slo_tsdb
+            assert eng.tracer is platform.tracer
+            assert router.tracer is platform.tracer
+            req = router.submit(_prompt(5, 4), max_new_tokens=2)
+            router.run_until_idle()
+            assert req.result(timeout=1).size == 2
+            assert len(platform.slo_tsdb.window("serving.ttft_s",
+                                                3600.0)) == 1
+            (root,) = [s for s in platform.tracer.snapshot()
+                       if s["name"] == "request"]
+            assert root["attrs"]["outcome"] == "completed"
+            # scale-out replicas (the autoscaler's add path) inherit the
+            # tracer AND the TSDB — a new replica is visible to the SLO
+            # series from its first tick
+            rep = router.add_replica(ContinuousBatcher(model, variables,
+                                                       max_rows=2))
+            assert rep.engine.tsdb is platform.slo_tsdb
+            assert rep.engine.tracer is platform.tracer
+            # a second start_slo with overrides must refuse loudly, not
+            # silently keep the old monitor's config
+            with pytest.raises(ValueError):
+                platform.start_slo(sample_interval_s=9.0)
+            # the sampler tick EVALUATES the monitor, so a scraper that
+            # only polls /metrics still sees live burn/alert gauges
+            deadline = time.monotonic() + 10
+            while (mon.metrics["evaluations_total"] == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)  # kftpu: allow=KFTPU-SLEEP (test pacing)
+            assert mon.metrics["evaluations_total"] > 0
+        finally:
+            platform.stop_slo()
+        # stop_slo freezes the store: the wired engine's hot-path hook
+        # degrades to a no-op instead of evicting the capture
+        frozen = platform.slo_tsdb.stats()["samples_total"]
+        req2 = router.submit(_prompt(6, 4), max_new_tokens=2)
+        router.run_until_idle()
+        assert req2.result(timeout=1).size == 2
+        assert platform.slo_tsdb.stats()["samples_total"] == frozen
+        # start_slo re-arms the SAME store
+        platform.start_slo()
+        try:
+            assert platform.slo_tsdb.armed
+        finally:
+            platform.stop_slo()
