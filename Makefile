@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo test-decode selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -76,6 +76,16 @@ test-partition:
 # (docs/slo.md)
 test-slo:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q -m slo
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
+
+# kftpu-decode suite: decode rows growing paged block chains
+# (allocate-on-boundary, COW-safe sharing), block-budgeted admission,
+# chain adoption by digest, speculative x chunked composition pinned
+# token-identical, the disaggregated prefill/decode tier, and the
+# resume-from-KV requeue drill + serve_disagg cpu-proxy gate
+# (docs/serving.md "Disaggregated prefill/decode")
+test-decode:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q -m decode
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 native:

@@ -531,6 +531,40 @@ def set_cache_indices(cache: dict, values=None, active=None) -> dict:
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
+def gather_kv_rows(cache: dict, starts, window: int) -> dict:
+    """Gather every cached_key/cached_value leaf's per-row slice
+    ``[starts[b] : starts[b] + window]`` -> {'/'-joined leaf path:
+    (B, window, kv_heads, head_dim)}.
+
+    The read-side twin of the per-row block write: rows sit at different
+    depths (continuous batching), so the gather is a vmapped per-row
+    dynamic_slice at each row's own start — ONE dispatch per tick
+    regardless of row count. The serving engine uses it to extract the
+    decode step's freshly-written K/V for the paged pool's per-row block
+    chains (serving/fleet/pagedkv.py); `window` is static (T decode
+    steps, or gamma+1 for a speculative round), so jitting the caller
+    yields one executable per window length."""
+    starts = jnp.asarray(starts, jnp.int32)
+    out: dict = {}
+
+    def walk(tree, prefix=""):
+        if isinstance(tree, dict):
+            for k in sorted(tree):
+                walk(tree[k], f"{prefix}/{k}")
+            return
+        name = prefix.rsplit("/", 1)[-1]
+        if name in ("cached_key", "cached_value"):
+            def row(buf, s, _w=window):
+                return jax.lax.dynamic_slice(
+                    buf, (s,) + (0,) * (buf.ndim - 1),
+                    (_w,) + buf.shape[1:])
+
+            out[prefix] = jax.vmap(row)(tree, starts)
+
+    walk(cache)
+    return out
+
+
 def eos_id_array(eos_token_id):
     """Normalize an eos spec — int, or a sequence of stop ids (Llama-3
     instruct checkpoints stop on any of several) — to a 1-D int32 array,

@@ -86,6 +86,13 @@ def render_metrics(platform) -> str:
          "requests shed with 503 + Retry-After by admission control"),
         ("kftpu_fleet_requests_requeued_total", "requests_requeued_total",
          "in-flight requests requeued to a surviving replica"),
+        ("kftpu_fleet_requeues_resumed_total", "requeues_resumed_total",
+         "requeues that resumed from the surviving paged-KV chain"),
+        ("kftpu_fleet_requeue_resumed_tokens_total",
+         "requeue_resumed_tokens_total",
+         "tokens salvaged from surviving KV chains instead of re-decoded"),
+        ("kftpu_fleet_prefill_handoffs_total", "prefill_handoffs_total",
+         "chains handed from the prefill tier to a decode replica"),
         ("kftpu_fleet_requests_completed_total", "requests_completed_total",
          None),
         ("kftpu_fleet_requests_failed_total", "requests_failed_total",
@@ -102,6 +109,25 @@ def render_metrics(platform) -> str:
             help_="prompt tokens the engines actually computed")
     counter("kftpu_fleet_prefill_tokens_reused_total", reused,
             help_="prompt tokens seeded from the paged-KV prefix pool")
+    # paged-KV pool health (fleet/pagedkv.py): the pinned working set and
+    # the eviction/COW churn, deduped across routers sharing one pool —
+    # previously only the prefill reuse ledger was surfaced
+    pools: dict[int, object] = {}
+    for r in routers:
+        for rep in r.replicas:
+            p = getattr(rep.engine, "paged_kv", None)
+            if p is not None:
+                pools[id(p)] = p
+    gauge("kftpu_fleet_kv_blocks_in_use",
+          sum(p.blocks_in_use() for p in pools.values()),
+          help_="paged-KV blocks pinned by live sequences (the "
+                "block-budgeted admission working set)")
+    counter("kftpu_fleet_kv_evictions_total",
+            sum(p.metrics["blocks_evicted_total"] for p in pools.values()),
+            help_="unreferenced paged-KV blocks evicted (LRU, leaf-first)")
+    counter("kftpu_fleet_kv_cow_copies_total",
+            sum(p.metrics["cow_copies_total"] for p in pools.values()),
+            help_="copy-on-write block copies on shared-chain divergence")
     for fam, field_, help_ in (
         ("kftpu_fleet_queue_depth", "queue_depth",
          "queued + in-flight requests across live replicas"),

@@ -1006,6 +1006,284 @@ def serve_fleet(replicas: int = 3, rows: int = 2, n_requests: int = 24,
     }
 
 
+# ------------------------------------------------------------ serve_disagg
+
+
+def serve_disagg(rows: int = 2, n_requests: int = 18,
+                 long_body: int = 20, short_body: int = 4,
+                 shared_prefix: int = 8, new_tokens: int = 6,
+                 block: int = 4, chunk: int = 4, seed: int = 9) -> dict:
+    """The disaggregated prefill/decode tier vs the mixed fleet, SAME
+    long-prompt-heavy mix (docs/serving.md "Disaggregated prefill/
+    decode"): two four-replica fleets serve identical seeded arrivals —
+    (a) the BASELINE: 4 mixed replicas, every engine interleaving
+    chunked prefill with its decode rows; (b) the DISAGG tier: 2 prefill
+    replicas (chunks only, stall bound lifted via max_chunks_per_tick)
+    publishing finished chains through the shared paged pool + 2 decode
+    replicas adopting chains by digest and decoding from the first
+    generated position. Both phases kill one decode-serving replica
+    mid-run. Gated:
+
+      - ttft_p99 / decode_tick      disagg tier, calibration-matmul
+                                    units. decode_tick is the median
+                                    DISPATCH time on the decode tier
+                                    during the load — sampled through
+                                    the same engine tsdb hook the SLO
+                                    monitor reads, so the decode_tick:2
+                                    chaos doubles exactly what the gate
+                                    measures
+      - ttft_p99_vs_fleet /         the acceptance ratios: the disagg
+        decode_tick_vs_fleet        tier at or below the mixed fleet on
+                                    the same mix. decode_tick_vs_fleet
+                                    compares median FULL-TICK wall on
+                                    decode-serving engines (the row's
+                                    inter-token latency — in the mixed
+                                    fleet those ticks interleave chunk
+                                    work; on the decode tier they never
+                                    do: long prompts never occupy a
+                                    decode slot)
+      - dropped                     budget 0 — zero-drop across the kill
+      - requeue_scratch_frac        requeues that re-decoded from
+                                    scratch / requeues: the resume-from-
+                                    KV rescue must carry the kill
+                                    (PR-9's baseline behavior was 1.0)
+
+    KFTPU_PROF_CHAOS="decode_tick:2" doubles every engine's per-tick
+    dispatches in BOTH phases — the absolute decode_tick/ttft rows fail
+    while the vs_fleet ratios stay put — and the decode-tick SLO monitor
+    watching the disagg tier must stay alert-quiet on an untouched tree
+    (tests/test_prof_gate.py pins both sides).
+    """
+    import gc
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+    from kubeflow_tpu.monitoring import SLOConfig, SLOMonitor, TimeSeriesStore
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+    from kubeflow_tpu.serving.fleet import (
+        FleetRouter,
+        PagedKVPool,
+        make_prompts,
+        run_loadtest_sync,
+    )
+
+    repeats = chaos_repeats("decode_tick")
+    long_len = shared_prefix + long_body
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=2, mlp_dim=128, dropout_rate=0.0,
+                    max_len=long_len + new_tokens + 22)  # + anchor rows
+    model = GPTLM(cfg)
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    unit = _calibration_unit()
+    # the long-prompt-heavy mix: 2/3 long, 1/3 short, all sharing the
+    # system prefix — identical prompts and arrival offsets per phase
+    longs = make_prompts(n_requests, seed=seed, vocab=cfg.vocab_size,
+                         prompt_len=long_body, shared_prefix=shared_prefix)
+    shorts = make_prompts(n_requests, seed=seed + 1, vocab=cfg.vocab_size,
+                          prompt_len=short_body,
+                          shared_prefix=shared_prefix)
+    prompts = [shorts[i] if i % 3 == 2 else longs[i]
+               for i in range(n_requests)]
+
+    def run_phase(disagg: bool):
+        pool = PagedKVPool(block_size=block, capacity_blocks=1024)
+
+        def mk(**kw):
+            return ContinuousBatcher(
+                model, variables, max_rows=rows,
+                default_max_new_tokens=new_tokens,
+                paged_kv=pool, prefill_chunk=chunk, **kw)
+
+        if disagg:
+            sampled = [mk() for _ in range(2)]
+            reps = ([(f"prefill-{i}", mk(max_chunks_per_tick=rows),
+                      "prefill") for i in range(2)]
+                    + [(f"decode-{i}", e, "decode")
+                       for i, e in enumerate(sampled)])
+            kill = "decode-0"
+        else:
+            sampled = [mk() for _ in range(4)]
+            reps = sampled
+            kill = 1
+        router = FleetRouter(reps)
+        engines = [r.engine for r in router.replicas]
+        # warmup OUTSIDE every timed window: compile each engine's chunk
+        # fns (full + remainder + the pool-match suffix-1 shape), decode
+        # step, splice, first-token pick, and the paged chain-append
+        # extraction window — the gate measures serving, not XLA
+        for eng in engines:
+            for w in (longs[0], shorts[0]):
+                eng.submit(w, max_new_tokens=2)
+                eng.run_until_idle()
+                eng.submit(w, max_new_tokens=2)
+                eng.run_until_idle()
+        # in-run healthy decode anchor (the serve_fleet trick): median
+        # UNWRAPPED decode-tick samples on a decode-serving engine,
+        # through the same tsdb hook the monitored samples use — armed
+        # BEFORE the chaos wrap so the SLO threshold is injection-immune
+        eng0 = sampled[0]
+        for p in make_prompts(rows, seed=seed + 3, vocab=cfg.vocab_size,
+                              prompt_len=long_body,
+                              shared_prefix=shared_prefix):
+            eng0.submit(p, max_new_tokens=new_tokens + 14)
+        for _ in range(rows * (long_len // chunk + 2)):
+            eng0.tick()
+            if not eng0._pending and all(eng0._rows):
+                break
+        anchor_tsdb = TimeSeriesStore()
+        eng0.tsdb = anchor_tsdb
+        for _ in range(12):
+            eng0.tick()
+        eng0.tsdb = None
+        healthy_tick = _median(
+            [v for _, v in anchor_tsdb.window("serving.decode_tick_s",
+                                              3600.0)])
+        eng0.run_until_idle()
+        _arm_decode_chaos(engines, repeats)
+        tsdb = TimeSeriesStore(capacity_per_series=4096)
+        for eng in sampled:
+            eng.tsdb = tsdb
+        # per-tick wall samples on the decode-SERVING engines: a tick
+        # counts when the engine entered it with >=1 active decode row —
+        # in the mixed fleet those ticks interleave chunk work (the cost
+        # the disagg split removes from the decode path), on the disagg
+        # decode tier they never do
+        samples: list[float] = []
+
+        def timed(eng):
+            orig = eng.tick
+
+            def run():
+                busy_decode = any(
+                    r is not None and s not in eng._pending
+                    for s, r in enumerate(eng._rows))
+                t0 = time.perf_counter()
+                busy = orig()
+                dt = time.perf_counter() - t0
+                if busy_decode:
+                    samples.append(dt)
+                return busy
+
+            return run
+
+        for eng in sampled:
+            eng.tick = timed(eng)
+
+        def sample_counters(_tick, rtr):
+            tsdb.record("fleet.requests_failed_total",
+                        rtr.metrics["requests_failed_total"])
+
+        # load-phase delta base: warmup + anchor traffic must not count
+        # toward the "decode tier computed zero prompt tokens" proof
+        decode_prefill0 = sum(
+            r.engine.prefill_tokens_total for r in router.replicas
+            if r.role == "decode")
+        gc.collect()
+        t0_wall = time.time()
+        report = run_loadtest_sync(
+            router, prompts, seed=seed, mean_gap_ticks=1.0,
+            new_tokens=new_tokens, kill_at_tick=10, kill_replica=kill,
+            on_tick=sample_counters)
+        decode_prefill = sum(
+            r.engine.prefill_tokens_total for r in router.replicas
+            if r.role == "decode") - decode_prefill0
+        return {
+            "router": router,
+            "summary": report.summary(),
+            "tick_median": _median(samples),
+            "dispatch_median": _median(
+                [v for _, v in tsdb.window("serving.decode_tick_s",
+                                           3600.0)]),
+            "tsdb": tsdb,
+            "healthy_tick": healthy_tick,
+            "t0_wall": t0_wall,
+            "decode_prefill": decode_prefill,
+        }
+
+    fleet = run_phase(disagg=False)
+    gc.collect()
+    dis = run_phase(disagg=True)
+
+    # ---- SLO evaluation over the DISAGG tier's TSDB (the PR-12 monitor
+    # must stay alert-quiet through the drill; the decode_tick:2 chaos
+    # drives it past the in-run threshold on every window)
+    now = time.time()
+    span_s = float(math.ceil(now - dis["t0_wall"]) + 1)
+    slo_threshold = DECODE_SLO_HEADROOM * dis["healthy_tick"]
+    monitor = SLOMonitor(dis["tsdb"], (
+        SLOConfig("serving_decode_tick", metric="serving.decode_tick_s",
+                  kind="above", threshold=slo_threshold, budget=0.25,
+                  windows=((span_s, 1.0),
+                           (max(float(math.ceil(span_s / 4)), 1.0), 1.0))),
+        SLOConfig("serving_zero_drop",
+                  metric="fleet.requests_failed_total",
+                  kind="increase", budget=0.0, windows=((span_s, 1.0),)),
+    ))
+    alerts = monitor.evaluate(now=now)
+    states = {s["name"]: s for s in monitor.describe()}
+
+    ds, fs = dis["summary"], fleet["summary"]
+    d_router = dis["router"]
+    requeued = max(ds["requeued"], 1)
+    return {
+        "workload": "serve_disagg",
+        "replicas": 4,
+        "requests": n_requests,
+        "completed": ds["completed"],
+        "dropped_count": ds["dropped"],
+        "fleet_dropped_count": fs["dropped"],
+        "requeued": ds["requeued"],
+        "resumed": ds["resumed"],
+        "resumed_tokens": ds["resumed_tokens"],
+        "handoffs": d_router.metrics["prefill_handoffs_total"],
+        "decode_tier_prefill_tokens": dis["decode_prefill"],
+        "replica_killed": True,
+        "anchor": "matmul_unit",
+        "anchor_s": round(unit, 6),
+        "phases_s": {
+            "ttft_p99": ds["ttft_p99_s"],
+            "decode_tick": round(dis["dispatch_median"], 6),
+            "decode_tick_wall": round(dis["tick_median"], 6),
+            "fleet_ttft_p99": fs["ttft_p99_s"],
+            "fleet_decode_tick_wall": round(fleet["tick_median"], 6),
+        },
+        "rel": {
+            "ttft_p99": round(ds["ttft_p99_s"] / unit, 4) if unit else 0.0,
+            "decode_tick": round(dis["dispatch_median"] / unit, 4)
+            if unit else 0.0,
+            # the acceptance ratios: disagg at or below the mixed fleet
+            # on the SAME mix — in-run, machine-invariant
+            "ttft_p99_vs_fleet": round(
+                ds["ttft_p99_s"] / max(fs["ttft_p99_s"], 1e-12), 4),
+            "decode_tick_vs_fleet": round(
+                dis["tick_median"] / max(fleet["tick_median"], 1e-12), 4),
+            # COUNT rows — exact, tight-gated
+            "dropped": ds["dropped"] + fs["dropped"],
+            "requeue_scratch_frac": round(
+                (ds["requeued"] - ds["resumed"]) / requeued, 4),
+        },
+        "slo": {
+            "decode_tick": {
+                "fired": states["serving_decode_tick"]["fired"],
+                "burn_rates": states["serving_decode_tick"]["burn_rates"],
+                "threshold_s": round(slo_threshold, 6),
+                "healthy_tick_s": round(dis["healthy_tick"], 6),
+                "samples": states["serving_decode_tick"]["samples"],
+            },
+            "zero_drop": {
+                "fired": states["serving_zero_drop"]["fired"],
+                "burn_rates": states["serving_zero_drop"]["burn_rates"],
+            },
+            "alerts": [a.slo for a in alerts],
+        },
+        "tokens_per_s_total": ds["tokens_per_s_total"],
+    }
+
+
 # -------------------------------------------------------- reconcile_storm
 
 
@@ -1347,8 +1625,8 @@ def cplane_storm(n_pods: int = 10000, gang_size: int = 100,
 # ----------------------------------------------------------------- harness
 
 WORKLOADS = ("mlp_train", "grad_overlap", "train_restart_warm",
-             "serve_ticks", "serve_fleet", "reconcile_storm",
-             "cplane_storm")
+             "serve_ticks", "serve_fleet", "serve_disagg",
+             "reconcile_storm", "cplane_storm")
 
 
 def run_all(only: str = "") -> list[dict]:
@@ -1363,6 +1641,10 @@ def run_all(only: str = "") -> list[dict]:
         "serve_fleet": lambda: _min_phases(
             serve_fleet, ("ttft_p99", "decode_tick", "slo_decode_burn"),
             attach={"slo_decode_burn": ("slo",)}),
+        "serve_disagg": lambda: _min_phases(
+            serve_disagg, ("ttft_p99", "decode_tick",
+                           "ttft_p99_vs_fleet", "decode_tick_vs_fleet"),
+            attach={"decode_tick": ("slo",)}),
         "reconcile_storm": lambda: _best_of(reconcile_storm,
                                             "reconcile_p50"),
         "cplane_storm": lambda: _best_of(cplane_storm, "to_running"),
@@ -1405,10 +1687,35 @@ def make_budgets(results: list[dict]) -> dict:
                        # samples past the in-run threshold (burn >> 1) —
                        # the 2.0 ratio leaves room for healthy noise and
                        # still fails the chaos run by a wide margin
-                       {"ttft_p99": 1.4, "decode_tick": 1.2,
+                       # decode_tick 1.4: engine dispatches are small
+                       # (~1ms) and scheduler noise moves them 15-25%
+                       # run to run on a busy box, while the
+                       # decode_tick:2 chaos doubles them (~2x the
+                       # regen baseline) — 1.4 + slack clears healthy
+                       # noise and still fails the chaos run wide
+                       {"ttft_p99": 1.4, "decode_tick": 1.4,
                         "reuse_computed_frac": 1.25, "dropped": 1.0,
                         "slo_decode_burn": 2.0}
                        if rec["workload"] == "serve_fleet" else
+                       # serve_disagg: the vs_fleet rows are in-run
+                       # ratios of two medians measured by identical
+                       # machinery — tight multipliers hold them at or
+                       # below the mixed-fleet shape; the count rows
+                       # (dropped, scratch-requeue fraction) gate on
+                       # slack alone, so one dropped request or one
+                       # full re-decode past the regen baseline fails.
+                       # decode_tick's absolute row gets 1.5: the disagg
+                       # decode tier's dispatches are the smallest
+                       # timed unit in the suite (~1.5 matmul units) and
+                       # scheduler noise moves them ~30% run to run,
+                       # while the decode_tick:2 chaos lands at ~2x the
+                       # regen baseline — 1.5 + slack keeps the teeth
+                       # biting with margin on both sides
+                       {"ttft_p99": 1.4, "decode_tick": 1.5,
+                        "ttft_p99_vs_fleet": 1.2,
+                        "decode_tick_vs_fleet": 1.2,
+                        "dropped": 1.0, "requeue_scratch_frac": 1.0}
+                       if rec["workload"] == "serve_disagg" else
                        # warm_backend_compiles is an exact COUNT with a
                        # zero budget: ONE backend compile in the warm
                        # incarnation fails the gate (slack only); the

@@ -104,6 +104,16 @@ class _InFlight:
     _tsdb: object = None
     t_submit_wall: float = 0.0
     t_first_wall: float | None = None
+    # paged-KV lifetime state (fleet.pagedkv.SequenceChain): `chain` is
+    # set when ownership TRANSFERS to the handle's consumer — a
+    # keep_chain retire (the disaggregated prefill→decode handoff) or a
+    # replica-kill _fail_all (the resume-from-KV requeue). `resumed`
+    # rows continue a chain mid-decode: their pre-fed tokens never
+    # re-fire callbacks and their engine-side TTFT is not a first token.
+    chain: object = None
+    resumed: bool = False
+    _resume: object = None          # (SequenceChain, tokens) until seated
+    _keep_chain: bool = False
 
     def push(self, tok: int) -> None:
         """Engine-side token emission — the ONE append path, so TTFT is
@@ -119,12 +129,16 @@ class _InFlight:
         self.error = error if self.error is None else self.error
         self.t_done = time.perf_counter()
         if self._tsdb is not None and self.error is None \
-                and self.ttft_s is not None:
+                and self.ttft_s is not None and not self.resumed:
+            # resumed rows have no first token — their t_first marks the
+            # resume point and must not pollute the TTFT SLO series
             self._tsdb.record("serving.ttft_s", self.ttft_s)
         tr = self._tracer
         if tr is not None:
             if self.t_first is not None:
                 attrs = {"tokens": len(self.tokens)}
+                if self.resumed:
+                    attrs["resumed"] = True
                 if self.error is not None:
                     # a killed replica's partial decode window: real time
                     # spent, tokens discarded by the requeue contract
@@ -168,7 +182,11 @@ class _InFlight:
 class _PendingPrefill:
     """A seated row whose prompt is still prefilling (chunked admission):
     the batch-1 row cache being built, the next position to compute, and
-    the pool refs backing any reused prefix."""
+    the pool refs backing any reused prefix. With a draft model the
+    draft's own batch-1 cache marches through the same chunk schedule
+    (d_cache/d_pos) — admission completes when BOTH are done. A resume
+    row (`resume`) has its target cache fully seeded from the pool and
+    only waits on the draft (no draft: it never pends at all)."""
 
     req: _InFlight
     ids: np.ndarray
@@ -176,6 +194,9 @@ class _PendingPrefill:
     cache: object
     last_logits: object = None
     match_refs: list = field(default_factory=list)
+    d_cache: object = None
+    d_pos: int = 0
+    resume: bool = False
 
 
 class ContinuousBatcher:
@@ -195,6 +216,7 @@ class ContinuousBatcher:
                  prefill_buckets: tuple[int, ...] | None = None,
                  draft_module=None, draft_variables=None, gamma: int = 4,
                  prefill_chunk: int = 0, paged_kv=None,
+                 block_budget: bool = False, max_chunks_per_tick: int = 1,
                  tracer=None, tsdb=None):
         # tracer (tracing.Tracer): per-request spans — queue wait, one
         # span per prefill chunk (reused-vs-computed counts), decode
@@ -213,21 +235,29 @@ class ContinuousBatcher:
         # dynamic_update_slice at each row's cache_index) makes the
         # chunked cache identical to a one-shot prefill's, so the first
         # token — and every token after it — is token-identical.
-        # paged_kv (fleet.PagedKVPool): prefix reuse at admission — the
-        # pool's matched prefix K/V seeds the row cache and only the
-        # suffix runs through the model (docs/serving.md).
+        # paged_kv (fleet.PagedKVPool): the pool is the KV substrate for
+        # the WHOLE row lifetime — the matched prefix K/V seeds the row
+        # cache at admission (only the suffix runs through the model),
+        # and every decode dispatch appends its freshly-written K/V to
+        # the row's block chain (docs/serving.md). block_budget=True
+        # additionally gates admission on the pool's free-block count
+        # (prompt + budget blocks must fit the working set) instead of
+        # row slots alone. max_chunks_per_tick lifts the one-chunk
+        # stall bound for PURE-PREFILL replicas (the disaggregated
+        # tier's prefill role has no decode rows to starve).
         self.prefill_chunk = int(prefill_chunk)
         self.paged_kv = paged_kv
+        self.block_budget = bool(block_budget) and paged_kv is not None
+        self.max_chunks_per_tick = int(max_chunks_per_tick)
         if self.prefill_chunk < 0:
             raise ValueError(
                 f"prefill_chunk must be >= 0, got {prefill_chunk}")
+        if self.max_chunks_per_tick < 1:
+            raise ValueError(
+                f"max_chunks_per_tick must be >= 1, got "
+                f"{max_chunks_per_tick}")
         if self.prefill_chunk or paged_kv is not None:
             what = ("prefill_chunk" if self.prefill_chunk else "paged_kv")
-            if draft_module is not None:
-                raise ValueError(
-                    f"{what} does not compose with the speculative engine "
-                    "yet: the draft cache would need the same chunked/"
-                    "seeded admission")
             if prefill_buckets is not None:
                 raise ValueError(
                     f"{what} replaces bucketed prefill — the chunk walk "
@@ -324,13 +354,21 @@ class ContinuousBatcher:
             decode=True, mutable=["cache"])
         self._cache = cache["cache"]
         # chunked/seeded admission state: slot -> in-progress prefill;
-        # ticker-private like _rows. _row_blocks holds the paged pool refs
-        # a DECODING row still pins (released at retire).
+        # ticker-private like _rows. _row_chains holds each DECODING
+        # row's pool block chain (SequenceChain) — the pool-side twin of
+        # the row's cache slice, grown per dispatch, released at retire
+        # (or transferred to the handle on keep_chain/kill).
         self._pending: dict[int, _PendingPrefill] = {}
-        self._row_blocks: dict[int, list] = {}
+        self._row_chains: dict[int, object] = {}
         self._chunk_order: list[int] = []  # FIFO of pending slots
         self._chunk_fns: dict[int, object] = {}  # suffix len -> jitted
+        self._draft_chunk_fns: dict[int, object] = {}
         self._row_template = None  # lazy batch-1 np zero cache twin
+        self._draft_row_template = None
+        # per-row cache depth (prompt + cache-written decode positions):
+        # host-side truth like _toks — the spec step's rewind base AND
+        # the paged chain-append's extraction start
+        self._depths = np.zeros((self.max_rows,), np.int32)
         #: prefill-unit accounting (the prefix-reuse proof reads these):
         #: tokens the model actually computed vs tokens seeded for free
         self.prefill_tokens_total = 0
@@ -341,9 +379,6 @@ class ContinuousBatcher:
                 decode=True, mutable=["cache"])
             self._dcache = dcache["cache"]
             self._draft_prefill_cache: dict[int, object] = {}
-            # per-row cache depth (prompt + written decode tokens); the
-            # spec step's rewind base. Host-side truth, like _toks.
-            self._depths = np.zeros((self.max_rows,), np.int32)
 
         def _splice(big, row, i):
             """Write batch-1 row-cache `row` into slot i of the live
@@ -370,6 +405,7 @@ class ContinuousBatcher:
             return jnp.where(temps > 0, sampled, greedy)
 
         T = self.steps_per_tick
+        paged = paged_kv is not None
 
         def _one(cache_col, toks, active, temps, keys):
             from kubeflow_tpu.models.gpt import set_cache_indices
@@ -383,11 +419,16 @@ class ContinuousBatcher:
             # max_len, so park it at 0
             return nxt, set_cache_indices(new_cache["cache"], active=active)
 
-        def _step(cache_col, toks, active, temps, base_keys, starts):
+        def _step(cache_col, toks, active, temps, base_keys, starts,
+                  depths):
             """T chained decode steps in ONE dispatch; returns the (T, R)
             emitted tokens. Rows that retire mid-scan decode on — their
             tail is discarded on the host (iteration-level scheduling at
-            granularity T)."""
+            granularity T). With a paged pool the dispatch ALSO gathers
+            the freshly-written K/V window [depths, depths+T) per row
+            (models/gpt.gather_kv_rows) — the chain-append extraction
+            rides the step executable instead of costing a second
+            dispatch on the tick path."""
             def body(carry, j):
                 cache_col, toks = carry
                 keys = jax.vmap(jax.random.fold_in)(base_keys, starts + j)
@@ -396,6 +437,10 @@ class ContinuousBatcher:
 
             (cache_col, _), out = jax.lax.scan(
                 body, (cache_col, toks), jnp.arange(T))
+            if paged:
+                from kubeflow_tpu.models.gpt import gather_kv_rows
+
+                return out, cache_col, gather_kv_rows(cache_col, depths, T)
             return out, cache_col
 
         self._step = jax.jit(_step)
@@ -529,6 +574,11 @@ class ContinuousBatcher:
                 t_cache = _set_row_indices(
                     t_adv["cache"], new_depths, active)
                 d_cache = _set_row_indices(d_cache, new_depths, active)
+                if paged:
+                    from kubeflow_tpu.models.gpt import gather_kv_rows
+
+                    win = gather_kv_rows(t_cache, depths, G + 1)
+                    return upd, a, t_cache, d_cache, win
                 return upd, a, t_cache, d_cache
 
             self._spec_step = jax.jit(_spec_step, static_argnums=(7,))
@@ -544,11 +594,49 @@ class ContinuousBatcher:
     def submit(self, prompt_ids, max_new_tokens: int | None = None,
                eos_token_id=None, temperature: float = 0.0,
                key=None, on_token=None, on_done=None,
-               trace_ctx=None, request_id: str = "") -> _InFlight:
+               trace_ctx=None, request_id: str = "",
+               keep_chain: bool = False, resume_from=None) -> _InFlight:
+        # keep_chain: retire transfers the row's paged block chain to the
+        # handle (handle.chain) instead of releasing it — the
+        # disaggregated prefill replica's publish side. resume_from =
+        # (SequenceChain, tokens): seat the row by SEEDING its cache from
+        # the chain (no prefill compute) with `tokens` already emitted —
+        # the decode replica's adopt side AND the kill-requeue resume;
+        # max_new_tokens still bounds the TOTAL tokens, resumed included.
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         budget = int(max_new_tokens or self.default_max_new_tokens)
         if ids.size < 1:
             raise ValueError("empty prompt")
+        if resume_from is not None:
+            if self.paged_kv is None:
+                raise ValueError("resume_from requires a paged_kv pool")
+            chain, toks = resume_from
+            if chain.frozen:
+                raise ValueError("cannot resume from a frozen chain")
+            if chain.pool is not self.paged_kv:
+                raise ValueError(
+                    "resume chain lives in a different pool than this "
+                    "engine's")
+            if not toks:
+                raise ValueError("resume_from needs >= 1 emitted token")
+            if chain.length != ids.size + len(toks) - 1:
+                raise ValueError(
+                    f"resume chain covers {chain.length} positions, "
+                    f"expected prompt {ids.size} + {len(toks)} tokens "
+                    f"- 1 = {ids.size + len(toks) - 1}")
+            if len(toks) >= budget:
+                raise ValueError(
+                    "resume tokens already meet max_new_tokens")
+        if self.block_budget and resume_from is None:
+            import math
+
+            need = math.ceil((ids.size + budget)
+                             / self.paged_kv.block_size)
+            if need > self.paged_kv.capacity_blocks:
+                raise ValueError(
+                    f"prompt {ids.size} + budget {budget} needs {need} "
+                    f"KV blocks, beyond the pool's capacity "
+                    f"{self.paged_kv.capacity_blocks}")
         if self.draft_module is not None:
             if temperature > 0 and self.top_k > 0:
                 # greedy rows ignore top_k, so greedy-only deployments
@@ -597,6 +685,11 @@ class ContinuousBatcher:
                             on_token=on_token, on_done=on_done)
             req.t_submit_wall = time.time()
             req._tsdb = self.tsdb
+            req._keep_chain = bool(keep_chain)
+            if resume_from is not None:
+                chain, toks = resume_from
+                req._resume = (chain, [int(t) for t in toks])
+                req.resumed = True
             tr = armed_tracer(self.tracer)
             if tr is not None:
                 req._tracer = tr
@@ -694,11 +787,22 @@ class ContinuousBatcher:
             self._row_template = make_row_template(self._cache)
         return self._row_template
 
+    def _draft_row_cache_template(self):
+        from kubeflow_tpu.serving.fleet.pagedkv import make_row_template
+
+        if self._draft_row_template is None:
+            self._draft_row_template = make_row_template(self._dcache)
+        return self._draft_row_template
+
     def _begin_prefill(self, slot: int, ids: np.ndarray,
                        req: _InFlight) -> None:
         """Seat a row on the chunked/seeded admission path: reuse any
         pooled prefix, then either finish the suffix now (prefill_chunk
-        == 0) or leave the row pending for chunk-per-tick advancement."""
+        == 0) or leave the row pending for chunk-per-tick advancement.
+        With a draft model the draft's batch-1 cache prefills over the
+        SAME chunk schedule (the pool stores only target K/V, so the
+        draft computes every position — it only shapes acceptance
+        speed, never the emitted tokens)."""
         from kubeflow_tpu.serving.fleet.pagedkv import seed_row_cache
 
         template = self._row_cache_template()
@@ -728,52 +832,158 @@ class ContinuousBatcher:
                               tokens_reused=pos)
         pend = _PendingPrefill(req=req, ids=ids, pos=pos, cache=cache,
                                match_refs=refs)
+        if self.draft_module is not None:
+            pend.d_cache = jax.tree.map(np.copy,
+                                        self._draft_row_cache_template())
         self._pending[slot] = pend
         self._chunk_order.append(slot)
         if not self.prefill_chunk:
             while slot in self._pending:  # suffix in one pass
                 self._advance_prefill(slot)
 
+    def _admit_resume(self, slot: int, ids: np.ndarray,
+                      req: _InFlight) -> None:
+        """Seat a row by RESUMING its paged chain: the pool's gathered
+        K/V seeds the whole cache (zero prefill compute — the
+        disaggregated handoff / kill-requeue admission), the emitted
+        tokens are pre-fed without re-firing callbacks, and decode
+        continues from the chain's end. With a draft model the draft
+        cache still prefills (chunked) over the known token history —
+        draft state isn't pooled, but it never changes emitted tokens."""
+        from kubeflow_tpu.serving.fleet.pagedkv import seed_row_cache
+
+        chain, toks = req._resume
+        full_ids = (np.concatenate([ids, np.asarray(toks[:-1], np.int32)])
+                    if len(toks) > 1 else ids)
+        _, kv = self.paged_kv.gather(chain.refs)
+        cache = seed_row_cache(self._row_cache_template(), kv,
+                               chain.length)
+        req.tokens = list(toks)      # pre-fed: callbacks never re-fire
+        req.t_first = time.perf_counter()   # the resume point, not TTFT
+        req.t_first_wall = time.time()
+        if req._tracer is not None:
+            req._tracer.event(
+                "engine.resume", parent=req.trace_ctx,
+                resumed_positions=int(chain.length),
+                tokens_resumed=len(toks), slot=slot)
+        if self.draft_module is not None:
+            pend = _PendingPrefill(req=req, ids=full_ids,
+                                   pos=len(full_ids), cache=cache,
+                                   resume=True)
+            pend.d_cache = jax.tree.map(
+                np.copy, self._draft_row_cache_template())
+            self._pending[slot] = pend
+            self._chunk_order.append(slot)
+            self._row_chains[slot] = chain
+            req._resume = None
+            if not self.prefill_chunk:
+                while slot in self._pending:
+                    self._advance_prefill(slot)
+            return
+        self._cache = self._splice(self._cache, cache, jnp.int32(slot))
+        self._toks[slot] = int(toks[-1])
+        self._depths[slot] = chain.length
+        self._row_chains[slot] = chain
+        req._resume = None
+
+    def _apply_draft_chunk(self, cache, chunk: np.ndarray):
+        """One draft-prefill chunk on a batch-1 draft row cache (cache
+        only — the draft's logits are never needed at admission)."""
+        fn = self._draft_chunk_fns.get(chunk.size)
+        if fn is None:
+            def apply(cache, x):
+                _, new = self.draft_module.apply(
+                    {**self.draft_variables, "cache": cache}, x,
+                    decode=True, mutable=["cache"])
+                return new["cache"]
+            fn = self._draft_chunk_fns[chunk.size] = jax.jit(apply)
+        return fn(cache, chunk[None, :])
+
     def _advance_prefill(self, slot: int) -> None:
-        """Run ONE chunk (or the whole remaining suffix when chunking is
-        off) of a pending row's prompt; completes admission when the last
-        position's logits exist."""
+        """Run ONE chunk unit (or the whole remaining work when chunking
+        is off) of a pending row: a target chunk while the prompt suffix
+        remains, plus a draft chunk while the draft cache lags; completes
+        admission when both are done."""
         pend = self._pending[slot]
-        take = (len(pend.ids) - pend.pos if not self.prefill_chunk
-                else min(self.prefill_chunk, len(pend.ids) - pend.pos))
-        chunk = pend.ids[pend.pos:pend.pos + take]
-        # the FIRST computed chunk (no logits yet) carries the seeded
-        # reuse count — reused-vs-computed per chunk off the pool ledger
-        reused = pend.pos if pend.last_logits is None else 0
-        w0, p0 = time.time(), time.perf_counter()
-        pend.last_logits, pend.cache = self._apply_chunk(pend.cache, chunk)
-        if pend.req._tracer is not None:
-            pend.req._tracer.record_span(
-                "engine.prefill_chunk", w0, time.perf_counter() - p0,
-                parent=pend.req.trace_ctx, tokens_computed=take,
-                tokens_reused=reused, pos=pend.pos + take)
-        pend.pos += take
-        self.prefill_tokens_total += take
-        if pend.pos >= len(pend.ids):
+        whole = not self.prefill_chunk
+        if pend.pos < len(pend.ids):
+            take = (len(pend.ids) - pend.pos if whole
+                    else min(self.prefill_chunk, len(pend.ids) - pend.pos))
+            chunk = pend.ids[pend.pos:pend.pos + take]
+            # the FIRST computed chunk (no logits yet) carries the seeded
+            # reuse count — reused-vs-computed per chunk off the ledger
+            reused = pend.pos if pend.last_logits is None else 0
+            w0, p0 = time.time(), time.perf_counter()
+            pend.last_logits, pend.cache = self._apply_chunk(pend.cache,
+                                                             chunk)
+            if pend.req._tracer is not None:
+                pend.req._tracer.record_span(
+                    "engine.prefill_chunk", w0, time.perf_counter() - p0,
+                    parent=pend.req.trace_ctx, tokens_computed=take,
+                    tokens_reused=reused, pos=pend.pos + take)
+            pend.pos += take
+            self.prefill_tokens_total += take
+        if pend.d_cache is not None and pend.d_pos < len(pend.ids):
+            take = (len(pend.ids) - pend.d_pos if whole
+                    else min(self.prefill_chunk,
+                             len(pend.ids) - pend.d_pos))
+            chunk = pend.ids[pend.d_pos:pend.d_pos + take]
+            w0, p0 = time.time(), time.perf_counter()
+            pend.d_cache = self._apply_draft_chunk(pend.d_cache, chunk)
+            if pend.req._tracer is not None:
+                # distinct name: request_breakdown charges it to the
+                # prefill phase but its tokens never enter the
+                # reused-vs-computed prompt ledger (draft work is
+                # acceptance fuel, not prompt prefill)
+                pend.req._tracer.record_span(
+                    "engine.draft_prefill_chunk", w0,
+                    time.perf_counter() - p0, parent=pend.req.trace_ctx,
+                    tokens_computed=take, pos=pend.d_pos + take)
+            pend.d_pos += take
+        if pend.pos >= len(pend.ids) and (
+                pend.d_cache is None or pend.d_pos >= len(pend.ids)):
             self._finish_prefill(slot)
 
     def _finish_prefill(self, slot: int) -> None:
         """Admission completes: publish the prompt's K/V to the paged
-        pool, splice the row cache into the live batch, emit the first
-        token."""
+        pool (becoming the row's lifetime block chain), splice the row
+        cache into the live batch, emit the first token. Resume rows
+        skip publish and first-token — their chain and tokens already
+        exist."""
         pend = self._pending.pop(slot)
         self._chunk_order.remove(slot)
         req = pend.req
+        if pend.resume:
+            # chain already held in _row_chains; tokens pre-fed
+            self._cache = self._splice(
+                self._cache, pend.cache, jnp.int32(slot))
+            if pend.d_cache is not None:
+                self._dcache = self._splice(
+                    self._dcache, pend.d_cache, jnp.int32(slot))
+            self._toks[slot] = int(req.tokens[-1])
+            self._depths[slot] = len(pend.ids)
+            return
         if self.paged_kv is not None:
-            from kubeflow_tpu.serving.fleet.pagedkv import extract_prompt_kv
+            from kubeflow_tpu.serving.fleet.pagedkv import (
+                SequenceChain,
+                extract_prompt_kv,
+            )
 
             kv = extract_prompt_kv(pend.cache, len(pend.ids))
             held = self.paged_kv.insert(pend.ids, kv)
             # insert's refs cover (and extend) the admission match's
             self.paged_kv.release(pend.match_refs)
-            self._row_blocks[slot] = held
+            # expect_length marks chains that could not cover the whole
+            # prompt (insert stopped at a covered-by-sibling boundary)
+            # as frozen: release-only, never appended or resumed
+            self._row_chains[slot] = SequenceChain(
+                self.paged_kv, held, expect_length=len(pend.ids))
         self._cache = self._splice(
             self._cache, pend.cache, jnp.int32(slot))
+        if pend.d_cache is not None:
+            self._dcache = self._splice(
+                self._dcache, pend.d_cache, jnp.int32(slot))
+        self._depths[slot] = len(pend.ids)
         first = self._pick_first(
             pend.last_logits[0], req.temperature,
             jax.random.fold_in(req.key, 0))
@@ -785,9 +995,60 @@ class ContinuousBatcher:
     def _retire(self, slot: int) -> None:
         req = self._rows[slot]
         self._rows[slot] = None
-        if self.paged_kv is not None:
-            self.paged_kv.release(self._row_blocks.pop(slot, []))
+        chain = self._row_chains.pop(slot, None)
+        if chain is not None:
+            if req._keep_chain:
+                # ownership to the handle's consumer — the disaggregated
+                # router adopts the chain for the decode tier
+                req.chain = chain
+            else:
+                chain.release()
         req.finish()
+
+    def _blocks_fit(self, ids: np.ndarray, req: _InFlight) -> bool:
+        """Block-budgeted admission check: does the pool's free-block
+        count cover this request's worst-case growth (prompt + budget;
+        a resume chain already pins its blocks, so only the remaining
+        budget counts)? Conservative — prefix reuse can only need
+        less."""
+        import math
+
+        bs = self.paged_kv.block_size
+        if req._resume is not None:
+            chain, _ = req._resume
+            need = math.ceil(max(
+                ids.size + req.max_new_tokens - chain.length, 0) / bs)
+        else:
+            need = math.ceil((ids.size + req.max_new_tokens) / bs)
+        return self.paged_kv.available_blocks() >= need
+
+    def _append_decode_kv(self, win, active: np.ndarray,
+                          window: int, counts=None) -> None:
+        """Grow each alive row's pool block chain with the positions the
+        decode dispatch just wrote: the paged pool stays the KV substrate
+        for the WHOLE lifetime, so a killed replica's rows can resume
+        from their surviving chains and follow-on turns match into the
+        generated suffix. `win` is the gathered per-row K/V window the
+        step dispatch itself returned (the extraction rides the decode
+        executable — no second dispatch). Rows that retired mid-dispatch
+        already released their chain; frozen chains never grow."""
+        rows = [slot for slot in range(self.max_rows)
+                if active[slot] and self._rows[slot] is not None
+                and slot in self._row_chains
+                and not self._row_chains[slot].frozen]
+        if not rows:
+            return
+        win = jax.device_get(win)
+        for slot in rows:
+            n = window if counts is None else int(counts[slot])
+            req = self._rows[slot]
+            k = len(req.tokens)
+            # position p holds the KV of sequence token p; the window
+            # [d, d+n) maps to emitted tokens [k-n-1, k-1) (the dispatch
+            # INPUTS — the newest token's KV lands next dispatch)
+            ids_seg = req.tokens[k - n - 1:k - 1]
+            self._row_chains[slot].append(
+                ids_seg, {p: a[slot, :n] for p, a in win.items()})
 
     def tick(self) -> bool:
         """One scheduling round: admit queued prompts into free rows, then
@@ -806,6 +1067,12 @@ class ContinuousBatcher:
             with self._lock:
                 if not self._queue:
                     break
+                if self.block_budget \
+                        and not self._blocks_fit(*self._queue[0]):
+                    # block-budgeted admission: the pool's free-block
+                    # count, not the row slot, is the admission token —
+                    # FIFO preserved (head waits, nothing jumps it)
+                    break
                 ids, req = self._queue.pop(0)
             # seat the row BEFORE device work: a prefill failure must find
             # the request in _rows so _fail_all unblocks its caller
@@ -816,6 +1083,11 @@ class ContinuousBatcher:
                     "engine.queue_wait", req.t_submit_wall,
                     time.perf_counter() - req.t_submit,
                     parent=req.trace_ctx, slot=slot)
+            if req._resume is not None:
+                # resume admission: seed the whole cache from the paged
+                # chain — zero prefill compute, decode continues
+                self._admit_resume(slot, ids, req)
+                continue
             if chunked:
                 # chunked/seeded path: pooled prefix reuse + (with
                 # prefill_chunk) chunk-per-tick interleaving below
@@ -834,7 +1106,7 @@ class ContinuousBatcher:
             if self.draft_module is not None:
                 self._dcache = self._splice(
                     self._dcache, self._draft_prefill(ids), jnp.int32(slot))
-                self._depths[slot] = ids.size
+            self._depths[slot] = ids.size
             first = self._pick_first(
                 last_logits[0], req.temperature,
                 jax.random.fold_in(req.key, 0))
@@ -843,11 +1115,15 @@ class ContinuousBatcher:
             # the prefill's first token may already finish the row
             if self._finished(req):
                 self._retire(slot)
-        # ---- chunked prefill: ONE chunk per tick, FIFO over pending rows,
-        # so admission work interleaves with — never starves — the decode
-        # dispatch below (the one-chunk-budget stall bound)
-        if self._chunk_order:
+        # ---- chunked prefill: one chunk unit per tick (FIFO over pending
+        # rows) so admission work interleaves with — never starves — the
+        # decode dispatch below (the one-chunk-budget stall bound). A
+        # pure-prefill replica (the disaggregated tier) raises
+        # max_chunks_per_tick: it has no decode rows to starve.
+        chunks = self.max_chunks_per_tick
+        while self._chunk_order and chunks > 0:
             self._advance_prefill(self._chunk_order[0])
+            chunks -= 1
         active = np.array(
             [r is not None and s not in self._pending
              for s, r in enumerate(self._rows)])
@@ -861,15 +1137,21 @@ class ContinuousBatcher:
         starts = np.array(
             [len(r.tokens) if r is not None else 0
              for r in self._rows], np.int32)
+        depths0 = self._depths.copy()  # pre-dispatch: the append window
         # one read per tick: start_slo's live-attach assigns self.tsdb
         # from another thread, and a torn double-read would record an
         # absolute perf_counter value as a decode-tick sample
         tsdb = self.tsdb
         t_dec = time.perf_counter() if tsdb is not None else 0.0
-        out, self._cache = self._step(
+        res = self._step(
             self._cache, jnp.asarray(self._toks),
             jnp.asarray(active), jnp.asarray(temps), base_keys,
-            jnp.asarray(starts))
+            jnp.asarray(starts), jnp.asarray(depths0))
+        win = None
+        if self.paged_kv is not None:
+            out, self._cache, win = res
+        else:
+            out, self._cache = res
         self.step_count += 1  # dispatches (the scheduling metric)
         out = np.asarray(out)  # (T, R)
         if tsdb is not None:
@@ -890,6 +1172,9 @@ class ContinuousBatcher:
                 if self._finished(req):
                     self._retire(slot)  # discard the scan tail
                     break
+        if self.paged_kv is not None:
+            self._append_decode_kv(win, active, out.shape[0])
+        self._depths[active] += out.shape[0]
         with self._lock:
             pending = bool(self._queue)
         return pending or any(r is not None for r in self._rows)
@@ -906,10 +1191,15 @@ class ContinuousBatcher:
         # bucket) and the mixed executable serves from then on
         tsdb = self.tsdb  # one read: live-attach races a torn pair
         t_dec = time.perf_counter() if tsdb is not None else 0.0
-        upd, a, self._cache, self._dcache = self._spec_step(
+        res = self._spec_step(
             self._cache, self._dcache, jnp.asarray(self._toks),
             jnp.asarray(active), jnp.asarray(self._depths),
             jnp.asarray(temps), base_keys, bool((temps > 0).any()))
+        win = None
+        if self.paged_kv is not None:
+            upd, a, self._cache, self._dcache, win = res
+        else:
+            upd, a, self._cache, self._dcache = res
         self.step_count += 1  # dispatches (the scheduling metric)
         upd = np.asarray(upd)                               # (R, gamma+1)
         a = np.asarray(a)                                   # (R,)
@@ -917,8 +1207,8 @@ class ContinuousBatcher:
             tsdb.record("serving.decode_tick_s",
                         time.perf_counter() - t_dec)
         for slot, req in enumerate(self._rows):
-            if req is None:
-                continue
+            if req is None or slot in self._pending:
+                continue  # pending rows' round output is garbage
             self._depths[slot] += int(a[slot]) + 1
             for j in range(int(a[slot]) + 1):
                 req.push(int(upd[slot, j]))
@@ -926,6 +1216,11 @@ class ContinuousBatcher:
                 if self._finished(req):
                     self._retire(slot)  # discard the round's tail
                     break
+        if self.paged_kv is not None:
+            # each alive row accepted a+1 tokens: its verify pass wrote
+            # valid K/V at [depth0, depth0 + a + 1) — append exactly that
+            self._append_decode_kv(win, active, self.gamma + 1,
+                                   counts=a + 1)
         with self._lock:
             pending = bool(self._queue)
         return pending or any(r is not None for r in self._rows)
@@ -979,15 +1274,41 @@ class ContinuousBatcher:
         with self._lock:
             queued = [req for _, req in self._queue]
             self._queue.clear()
+
+        def hand_off(req, chain) -> None:
+            # a usable chain TRANSFERS to the handle only when the FLEET
+            # ROUTER is listening (it wired this engine and its on_done
+            # requeue resumes-or-releases every transferred chain — the
+            # zero-redecode rescue); a direct consumer's on_done has no
+            # such contract, so its chain releases and the blocks become
+            # reuse inventory instead of leaking pins
+            if chain is None:
+                return
+            if req is not None and req.on_done is not None \
+                    and getattr(self, "_fleet_managed", False) \
+                    and not chain.frozen:
+                req.chain = chain
+            else:
+                chain.release()
+
         if self.paged_kv is not None:
             for pend in self._pending.values():
                 self.paged_kv.release(pend.match_refs)
-            for refs in self._row_blocks.values():
-                self.paged_kv.release(refs)
+            for slot, chain in self._row_chains.items():
+                hand_off(self._rows[slot], chain)
+            for req in queued:
+                if req._resume is not None:
+                    chain, _ = req._resume
+                    req._resume = None
+                    hand_off(req, chain)
         self._pending.clear()
         self._chunk_order.clear()
-        self._row_blocks.clear()
+        self._row_chains.clear()
         for req in queued + [r for r in self._rows if r is not None]:
+            if req._resume is not None:
+                # a seated-but-unqueued resume cannot exist; queued ones
+                # were handled above — clear defensively
+                req._resume = None
             req.finish(error=reason)
         self._rows = [None] * self.max_rows
 

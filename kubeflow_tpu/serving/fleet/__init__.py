@@ -18,6 +18,7 @@ from kubeflow_tpu.serving.fleet.loadtest import (
 from kubeflow_tpu.serving.fleet.pagedkv import (
     PagedKVPool,
     PrefixMatch,
+    SequenceChain,
     extract_prompt_kv,
     make_row_template,
     seed_row_cache,
@@ -37,6 +38,7 @@ __all__ = [
     "PagedKVPool",
     "PrefixMatch",
     "Replica",
+    "SequenceChain",
     "extract_prompt_kv",
     "make_prompts",
     "make_row_template",

@@ -52,6 +52,11 @@ class LoadReport:
     shed: int = 0
     dropped: int = 0
     requeued: int = 0
+    # the resume-from-KV rescue split: requeues that resumed from the
+    # dead replica's surviving block chain vs. re-decoded from scratch
+    # (requeued - resumed), plus the tokens those resumes salvaged
+    resumed: int = 0
+    resumed_tokens: int = 0
     ttft_s: list = field(default_factory=list)
     tokens_per_s: list = field(default_factory=list)
     # per-request shed-retry attribution (threaded mode): how many
@@ -81,6 +86,8 @@ class LoadReport:
             "shed": self.shed,
             "dropped": self.dropped,
             "requeued": self.requeued,
+            "resumed": self.resumed,
+            "resumed_tokens": self.resumed_tokens,
             "wall_s": round(self.wall_s, 6),
             "tokens_out": self.tokens_out,
             "tokens_per_s_total": (
@@ -129,6 +136,8 @@ def _counters(router: FleetRouter) -> dict:
     inflate a report — LoadReport states what THIS run proved."""
     return {
         "requeued": router.metrics["requests_requeued_total"],
+        "resumed": router.metrics["requeues_resumed_total"],
+        "resumed_tokens": router.metrics["requeue_resumed_tokens_total"],
         "prefill_total": sum(r.engine.prefill_tokens_total
                              for r in router.replicas),
         "prefill_reused": sum(r.engine.prefill_tokens_reused
@@ -152,6 +161,8 @@ def _collect(router: FleetRouter, report: LoadReport, handles: list,
             report.tokens_per_s.append(h.tokens_per_s)
     now = _counters(router)
     report.requeued = now["requeued"] - base["requeued"]
+    report.resumed = now["resumed"] - base["resumed"]
+    report.resumed_tokens = now["resumed_tokens"] - base["resumed_tokens"]
     report.prefill_tokens_total = now["prefill_total"] \
         - base["prefill_total"]
     report.prefill_tokens_reused = now["prefill_reused"] \

@@ -1,11 +1,27 @@
-"""Paged/block KV cache with prefix reuse — the fleet's prefill saver.
+"""Paged/block KV cache — the single KV substrate for the request lifetime.
 
 Decode is HBM-bandwidth-bound, but PREFILL is compute-bound and scales
 with prompt length — and production prompts share long prefixes (the
-system prompt, few-shot preambles). vLLM pages the decode cache; this
-tier pages the *prefix* store instead, because the in-engine decode cache
-is already a fixed-row static-shape buffer (the TPU-idiomatic layout,
-serving/continuous.py) and what repeats across requests is the prompt:
+system prompt, few-shot preambles). The pool pages the K/V store the way
+vLLM does, with one TPU-idiomatic twist: the in-engine decode buffer
+stays a fixed-row static-shape scratch window (serving/continuous.py —
+the shape XLA wants), while the POOL is the store of record for the
+whole request lifetime. Prompt blocks land at admission; decode rows
+append each generated position's K/V into their chain as they go
+(``SequenceChain`` — allocate-on-boundary, COW preserved), so:
+
+  - memory accounting is working-set-proportional: admission can be
+    block-budgeted (``available_blocks``) instead of slot-budgeted;
+  - a finished prefill's chain can be ADOPTED by another replica
+    (``adopt``/``gather`` — the disaggregated prefill/decode handoff);
+  - a replica killed mid-decode leaves its chain behind, and the requeue
+    RESUMES from the surviving blocks instead of re-decoding from
+    scratch (router.py);
+  - a follow-on conversation turn whose prompt is the previous prompt +
+    completion matches deep into the GENERATED chain, not just the old
+    prompt.
+
+The original prefix-reuse contract is unchanged:
 
   - prompts split into fixed-size BLOCKS (`block_size` tokens); each
     fully-prefilled block's K/V (every layer, rope-rotated, position
@@ -152,6 +168,12 @@ class PagedKVPool:
         self.capacity_blocks = int(capacity_blocks)
         self._table: dict[bytes, _Block] = {}
         self._clock = 0
+        #: blocks with refcount > 0, maintained incrementally on every
+        #: 0<->1 transition (_ref/_unref/_drop) — the block-budgeted
+        #: admission gate and the blocks_in_use gauge read it O(1)
+        #: instead of scanning the table under the shared pool lock on
+        #: every admission attempt
+        self._pinned = 0
         self._mu = make_lock("fleet.PagedKVPool._mu")
         self.metrics = {
             "blocks_cached": 0,
@@ -160,6 +182,39 @@ class PagedKVPool:
             "tokens_reused_total": 0,
             "cow_copies_total": 0,
         }
+
+    # ------------------------------------------------------- block budget
+
+    def blocks_in_use(self) -> int:
+        """Blocks some live sequence still references — the pinned
+        working set (the ``kftpu_fleet_kv_blocks_in_use`` gauge).
+        Unreferenced cached blocks are reuse inventory, not use: they
+        evict on demand."""
+        with self._mu:
+            return self._pinned
+
+    def available_blocks(self) -> int:
+        """Blocks a new sequence could claim right now: capacity minus
+        the pinned working set (cached-but-unreferenced blocks evict on
+        demand, so they count as available). The engine's block-budgeted
+        admission gate reads this instead of counting row slots."""
+        with self._mu:
+            return max(self.capacity_blocks - self._pinned, 0)
+
+    def _ref(self, blk: _Block) -> None:
+        """Acquire one reference under self._mu (the ONE increment
+        path: keeps the pinned counter exact on 0->1)."""
+        blk.refcount += 1
+        if blk.refcount == 1:
+            self._pinned += 1
+        blk.last_used = self._clock
+
+    def _unref(self, blk: _Block) -> None:
+        """Drop one reference under self._mu (exact on 1->0)."""
+        if blk.refcount > 0:
+            blk.refcount -= 1
+            if blk.refcount == 0:
+                self._pinned -= 1
 
     # ------------------------------------------------------------- match
 
@@ -199,8 +254,7 @@ class PagedKVPool:
                 blocks.append(tail)
                 pos += tail.ids.size
             for blk in blocks:
-                blk.refcount += 1
-                blk.last_used = self._clock
+                self._ref(blk)
             kv: dict[str, np.ndarray] = {}
             if blocks:
                 for path in blocks[0].kv:
@@ -258,8 +312,7 @@ class PagedKVPool:
                     if parent != ROOT:
                         self._table[parent].children.add(d)
                     self.metrics["blocks_cached"] = len(self._table)
-                blk.refcount += 1
-                blk.last_used = self._clock
+                self._ref(blk)
                 held.append(d)
                 if not blk.full:
                     break  # a partial tail ends the chain by definition
@@ -312,26 +365,139 @@ class PagedKVPool:
                     f"(have {blk.ids.size}, block_size {self.block_size})")
             new_ids = np.concatenate([blk.ids, ids])
             d = _digest(blk.parent, new_ids)
+            existing = self._table.get(d)
+            if existing is not None:
+                # the identical extension is already published (two rows
+                # decoding the same continuation off a shared tail):
+                # SHARE it — overwriting would orphan its holders'
+                # refcounts and a later sole-holder extend would drop a
+                # block someone still references
+                self._ref(existing)
+                if blk.refcount > 1:
+                    self._unref(blk)
+                else:
+                    self._drop(blk)
+                self.metrics["blocks_cached"] = len(self._table)
+                return d
             new = _Block(
                 digest=d, parent=blk.parent, ids=new_ids,
                 kv={p: np.concatenate([blk.kv[p], kv[p]], axis=0)
                     for p in blk.kv},
                 full=new_ids.size == self.block_size,
-                refcount=1, last_used=self._clock,
             )
             if blk.refcount > 1:
                 # shared: publish the extension beside the original
                 self.metrics["cow_copies_total"] += 1
-                blk.refcount -= 1
+                self._unref(blk)
             else:
                 # sole holder: the original entry retires with us
                 self._drop(blk)
             self._table[d] = new
+            self._ref(new)
             if blk.parent != ROOT:
                 self._table[blk.parent].children.add(d)
             self.metrics["blocks_cached"] = len(self._table)
             self._evict_to_capacity()
             return d
+
+    def append_child(self, parent: bytes, ids,
+                     kv: dict[str, np.ndarray]) -> bytes:
+        """Publish ONE new block (partial or full) as a child of `parent`
+        (a held FULL block, or ROOT) and acquire a reference on it — the
+        decode-growth allocation path (SequenceChain.append calls this at
+        every block boundary). An identical block already cached is
+        shared instead of duplicated (two greedy decodes of the same
+        prompt converge onto one chain); publishing beside a live partial
+        whose content this block extends counts a COW copy exactly like
+        insert()'s divergence path."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        if not 0 < ids.size <= self.block_size:
+            raise ValueError(
+                f"block of {ids.size} tokens (block_size "
+                f"{self.block_size})")
+        with self._mu:
+            self._clock += 1
+            if parent != ROOT:
+                par = self._table.get(parent)
+                if par is None:
+                    raise KeyError("unknown parent block ref")
+                if not par.full:
+                    raise ValueError("cannot chain off a partial block")
+            d = _digest(parent, ids)
+            blk = self._table.get(d)
+            if blk is None:
+                blk = _Block(
+                    digest=d, parent=parent, ids=ids.copy(),
+                    kv={p: np.asarray(a)[:ids.size].copy()
+                        for p, a in kv.items()},
+                    full=ids.size == self.block_size,
+                )
+                if any(self._prefixed_partial(blk)):
+                    self.metrics["cow_copies_total"] += 1
+                self._table[d] = blk
+                if parent != ROOT:
+                    self._table[parent].children.add(d)
+                self.metrics["blocks_cached"] = len(self._table)
+            self._ref(blk)
+            self._evict_to_capacity()
+            return d
+
+    # ------------------------------------------------- adoption / gather
+
+    def adopt(self, refs: list[bytes]) -> None:
+        """Acquire one additional reference per block of a chain BY
+        DIGEST — the disaggregated handoff's contract: a prefill replica
+        publishes its finished chain, and a decode replica (in another
+        process, eventually) re-acquires it from the digests alone.
+        Raises KeyError if any block is gone (the publisher must hold its
+        own refs until the adopter confirms)."""
+        with self._mu:
+            self._clock += 1
+            blocks = []
+            for d in refs:
+                blk = self._table.get(d)
+                if blk is None:
+                    raise KeyError("chain block evicted before adoption")
+                blocks.append(blk)
+            for blk in blocks:
+                self._ref(blk)
+
+    def gather(self, refs: list[bytes]):
+        """Materialize a held chain: (token ids, per-leaf concatenated
+        K/V) over every position the chain covers — what seeds a decode
+        replica's row cache on adoption or resume. The caller must hold
+        references on every block (adopt/insert/append_child)."""
+        with self._mu:
+            self._clock += 1
+            blocks = []
+            for d in refs:
+                blk = self._table.get(d)
+                if blk is None:
+                    raise KeyError("unknown block ref")
+                blk.last_used = self._clock
+                blocks.append(blk)
+        if not blocks:
+            return np.zeros((0,), np.int32), {}
+        # concatenate OUTSIDE the pool lock: blocks are immutable once
+        # published and the snapshot above keeps them alive, while this
+        # copy is the largest single memory op in the pool (a whole
+        # request's K/V) — holding _mu here would stall every other
+        # replica's admission/append hot path behind each handoff
+        ids = np.concatenate([b.ids for b in blocks])
+        kv = {p: np.concatenate([b.kv[p] for b in blocks], axis=0)
+              for p in blocks[0].kv}
+        return ids, kv
+
+    def chain_info(self, refs: list[bytes]) -> tuple[int, int]:
+        """(total positions, positions in the partial tail — 0 when the
+        chain ends on a full block) for a held chain."""
+        with self._mu:
+            total = tail = 0
+            for d in refs:
+                blk = self._table[d]
+                total += blk.ids.size
+                tail = 0 if blk.full else blk.ids.size
+            return total, tail
 
     # ----------------------------------------------------------- release
 
@@ -341,11 +507,15 @@ class PagedKVPool:
         with self._mu:
             for d in refs:
                 blk = self._table.get(d)
-                if blk is not None and blk.refcount > 0:
-                    blk.refcount -= 1
+                if blk is not None:
+                    self._unref(blk)
             self._evict_to_capacity()
 
     def _drop(self, blk: _Block) -> None:
+        if blk.refcount > 0:
+            # a still-held block leaving the table (extend's sole-holder
+            # retire-with-us path) leaves the pinned set too
+            self._pinned -= 1
         self._table.pop(blk.digest, None)
         parent = self._table.get(blk.parent)
         if parent is not None:
@@ -373,4 +543,73 @@ class PagedKVPool:
     def __len__(self) -> int:
         with self._mu:
             return len(self._table)
+
+
+# ------------------------------------------------------- sequence chains
+
+
+class SequenceChain:
+    """A decode row's held block chain over its WHOLE lifetime (prompt +
+    generated tokens) — the per-row block table the engine keeps while
+    the row is in flight.
+
+    Ownership travels with the object: the admitting engine builds it
+    from insert()'s held refs, appends each decode dispatch's new K/V
+    (allocate-on-boundary: the partial tail extends via the pool's
+    COW-safe ``extend`` until full, then a fresh child block via
+    ``append_child``), and releases it at retire. On a replica kill the
+    engine transfers the chain to the request handle instead of
+    releasing, and the router hands it to the surviving replica — whose
+    resume admission seeds its row cache from ``pool.gather`` and keeps
+    appending to the same object.
+
+    ``frozen`` marks a chain that could not cover every cached position
+    (insert stopped early at a covered-by-sibling or partial-parent
+    boundary): it releases normally but never appends and never resumes
+    — the requeue path falls back to re-decoding from scratch.
+    """
+
+    def __init__(self, pool: PagedKVPool, refs: list[bytes],
+                 expect_length: int | None = None):
+        self.pool = pool
+        self.refs = list(refs)
+        self.length, self._tail_len = pool.chain_info(self.refs)
+        self.frozen = (expect_length is not None
+                       and self.length != expect_length)
+
+    def append(self, ids, kv: dict[str, np.ndarray]) -> None:
+        """Append `len(ids)` generated positions' K/V to the chain —
+        `kv` maps leaf path -> (n, kv_heads, head_dim). Fills the partial
+        tail first (pool.extend: COW when another sequence shares it),
+        then allocates fresh blocks at each boundary."""
+        if self.frozen:
+            raise ValueError("cannot append to a frozen chain")
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        bs = self.pool.block_size
+        part = {p: np.asarray(a) for p, a in kv.items()}
+        i = 0
+        while i < ids.size:
+            if self._tail_len:
+                take = min(bs - self._tail_len, ids.size - i)
+                self.refs[-1] = self.pool.extend(
+                    self.refs[-1], ids[i:i + take],
+                    {p: a[i:i + take] for p, a in part.items()})
+                self._tail_len += take
+            else:
+                take = min(bs, ids.size - i)
+                parent = self.refs[-1] if self.refs else ROOT
+                self.refs.append(self.pool.append_child(
+                    parent, ids[i:i + take],
+                    {p: a[i:i + take] for p, a in part.items()}))
+                self._tail_len = take
+            if self._tail_len == bs:
+                self._tail_len = 0
+            i += take
+            self.length += take
+
+    def release(self) -> None:
+        self.pool.release(self.refs)
+        self.refs = []
+        self.length = 0
+        self._tail_len = 0
 

@@ -18,9 +18,23 @@ submit() surface with three production behaviors the solo engine lacks:
   - **zero-drop replica kill**: when a replica dies mid-flight, every
     request it was carrying — queued or decoding — is requeued onto a
     surviving replica via the engines' on_done callbacks; nothing is
-    dropped, and `requeued_total` counts the disruption. Greedy rows
-    re-decode to the identical tokens (engine exactness contract), so a
-    requeue costs latency, never correctness.
+    dropped, and `requeued_total` counts the disruption. A request whose
+    paged-KV chain survives the kill RESUMES from it on the survivor
+    (tokens kept, zero re-prefill, zero re-decode —
+    `requeues_resumed_total` / `requeue_resumed_tokens_total` count the
+    rescue); only a chainless request re-decodes from scratch, and
+    greedy rows then re-decode to identical tokens either way.
+
+**Disaggregated prefill/decode** (replica `role`): tag replicas
+"prefill" / "decode" (default "mixed") and the router splits the
+request lifetime across tiers — new requests route least-loaded onto
+the prefill tier, which runs chunked prefill (budget-1, `keep_chain`)
+and publishes the finished block chain through the SHARED paged pool;
+the router hands the chain to a decode replica whose resume admission
+seeds its row cache from the pool and decodes from the first generated
+position. Long prompts never occupy a decode slot, and pure-prefill
+replicas lift the one-chunk-per-tick stall bound
+(`max_chunks_per_tick`) because they have no decode rows to starve.
 
 The demand signal (`demand_replicas()`) is the autoscaler's input:
 pending tokens over (service rate x TTFT SLO), clamped to at least the
@@ -70,11 +84,15 @@ class FleetOverloaded(RuntimeError):
 @dataclass
 class Replica:
     """One engine slot in the fleet: the ContinuousBatcher plus the
-    router's liveness view of it."""
+    router's liveness view of it. `role` places it in the disaggregated
+    split: "mixed" (default) serves whole requests, "prefill" serves the
+    chunked-prefill leg only (publishing chains through the shared
+    pool), "decode" adopts published chains and decodes them."""
 
     name: str
     engine: object
     alive: bool = True
+    role: str = "mixed"
 
     def pending_tokens(self) -> int:
         """The routing load signal: queued prompt+budget tokens plus the
@@ -115,6 +133,21 @@ class FleetRequest:
     error: str | None = None
     done: threading.Event = field(default_factory=threading.Event)
     on_token: object = None
+    #: client-stream high-water mark: positions already forwarded to
+    #: on_token — a re-dispatch re-decoding streamed positions (scratch
+    #: requeue, frozen-chain fallback) must not re-deliver them
+    delivered: int = 0
+    # disaggregated / resume state: `stage` is the lifetime leg the next
+    # dispatch serves ("" = whole request on a mixed replica, "prefill"
+    # = the budget-1 chain-publishing leg, "decode" = adopt-and-decode);
+    # `chain` is a surviving SequenceChain waiting to be handed to the
+    # next engine (ownership passes on dispatch); `budget`/`eos` are the
+    # request's resolved decode budget and stop set (the router needs
+    # them to split the lifetime without re-deriving engine defaults).
+    stage: str = ""
+    chain: object = None
+    budget: int = 0
+    eos: tuple | None = None
     # request-tracing state: the router owns the `request` root span for
     # fleet requests — trace_ctx is its pre-allocated identity (engine
     # phase spans parent to it across requeues), recorded retroactively
@@ -151,14 +184,17 @@ class FleetRouter:
                  retry_after_s: float = 1.0,
                  service_rate_tokens_per_s: float = 0.0,
                  max_requeues: int = 3, tracer=None):
-        """replicas: list of (name, ContinuousBatcher) or engines (named
-        replica-<i>). ttft_slo_s: 0 disables admission shedding.
-        service_rate_tokens_per_s: initial service-rate estimate; 0 defers
-        admission control until the first completion calibrates it.
-        tracer (tracing.Tracer): per-request root spans + the
-        kill→requeue causal chain; propagated to replica engines that
-        have none of their own, so one tracer covers the whole fleet
-        (docs/slo.md)."""
+        """replicas: list of engines (named replica-<i>), (name, engine)
+        pairs, or (name, engine, role) triples — role "prefill"/"decode"
+        arms the disaggregated split (docstring), which requires every
+        engine to share ONE paged_kv pool (the chain-handoff medium) and
+        at least one replica on each side of the split. ttft_slo_s: 0
+        disables admission shedding. service_rate_tokens_per_s: initial
+        service-rate estimate; 0 defers admission control until the
+        first completion calibrates it. tracer (tracing.Tracer):
+        per-request root spans + the kill→requeue causal chain;
+        propagated to replica engines that have none of their own, so
+        one tracer covers the whole fleet (docs/slo.md)."""
         self.tracer = tracer
         #: monitoring TSDB propagated to replica engines (set by
         #: Platform._wire_fleet); carried here so add_replica — the
@@ -168,11 +204,31 @@ class FleetRouter:
         self.tsdb = None
         self.replicas: list[Replica] = []
         for i, r in enumerate(replicas):
-            name, eng = r if isinstance(r, tuple) else (f"replica-{i}", r)
+            role = "mixed"
+            if isinstance(r, tuple):
+                name, eng = r[0], r[1]
+                if len(r) > 2:
+                    role = r[2]
+            else:
+                name, eng = f"replica-{i}", r
+            if role not in ("mixed", "prefill", "decode"):
+                raise ValueError(f"unknown replica role {role!r}")
             self._wire_engine(eng)
-            self.replicas.append(Replica(name=name, engine=eng))
+            self.replicas.append(Replica(name=name, engine=eng, role=role))
         if not self.replicas:
             raise ValueError("a fleet needs at least one replica")
+        if self.disaggregated:
+            pools = {id(r.engine.paged_kv): r.engine.paged_kv
+                     for r in self.replicas}
+            if any(p is None for p in pools.values()) or len(pools) != 1:
+                raise ValueError(
+                    "a disaggregated fleet needs every replica on ONE "
+                    "shared paged_kv pool — it is the chain-handoff "
+                    "medium")
+            if not any(r.role in ("decode", "mixed") for r in self.replicas):
+                raise ValueError(
+                    "a disaggregated fleet needs at least one decode-"
+                    "capable (decode/mixed) replica")
         #: replica name -> the fleet.replica_kill event's SpanContext —
         #: what a requeue parent-links to (the chaos.pod_kill →
         #: gang_restart chain, serving edition)
@@ -187,10 +243,17 @@ class FleetRouter:
             "requests_admitted_total": 0,
             "requests_shed_total": 0,
             "requests_requeued_total": 0,
+            "requeues_resumed_total": 0,
+            "requeue_resumed_tokens_total": 0,
+            "prefill_handoffs_total": 0,
             "requests_completed_total": 0,
             "requests_failed_total": 0,
             "replica_kills_total": 0,
         }
+
+    @property
+    def disaggregated(self) -> bool:
+        return any(r.role == "prefill" for r in self.replicas)
 
     def _wire_engine(self, engine) -> None:
         """The ONE engine-attach path for the fleet's tracer + TSDB
@@ -204,6 +267,11 @@ class FleetRouter:
         if self.tsdb is not None \
                 and getattr(engine, "tsdb", None) is None:
             engine.tsdb = self.tsdb
+        # mark the engine router-managed: _fail_all may transfer a dying
+        # row's chain to the handle ONLY when this router's requeue is
+        # listening to release-or-resume it — a direct engine consumer
+        # with an on_done callback would otherwise leak pinned blocks
+        engine._fleet_managed = True
 
     def wire_monitoring(self, tracer=None, tsdb=None) -> None:
         """Late-attach monitoring to the whole fleet (Platform wiring:
@@ -280,6 +348,18 @@ class FleetRouter:
                             t_submit=time.perf_counter(),
                             on_token=on_token)
         freq.t_submit_wall = time.time()
+        # resolve the lifetime split's inputs once: the decode budget and
+        # stop set (engine defaults otherwise live behind the dispatch)
+        eng0 = self.replicas[0].engine
+        freq.budget = int(kwargs.get("max_new_tokens")
+                          or eng0.default_max_new_tokens)
+        if kwargs.get("eos_token_id") is not None:
+            from kubeflow_tpu.serving.continuous import _eos_tuple
+
+            freq.eos = _eos_tuple(kwargs["eos_token_id"])
+        else:
+            freq.eos = eng0.eos_token_id
+        freq.stage = "prefill" if self.disaggregated else ""
         tr = armed_tracer(self.tracer)
         if tr is not None:
             if not rid:
@@ -358,14 +438,23 @@ class FleetRouter:
         exc.trace_ctx = ctx
         return exc
 
-    def _pick(self) -> Replica:
+    def _pick(self, stage: str = "") -> Replica:
         alive = self._alive()
         if not alive:
             raise FleetOverloaded("no live replicas",
                                   retry_after_s=self.retry_after_s)
+        if self.disaggregated and stage:
+            # tier-aware pick: the prefill leg lands on prefill-capable
+            # replicas, the decode leg on decode-capable ones. A wiped
+            # tier degrades to any live replica (every engine CAN do
+            # both — the role is a routing policy, not a capability)
+            want = (("prefill", "mixed") if stage == "prefill"
+                    else ("decode", "mixed"))
+            tier = [r for r in alive if r.role in want]
+            alive = tier or alive
         return min(alive, key=lambda r: r.pending_tokens())
 
-    def _dispatch(self, freq: FleetRequest) -> None:
+    def _dispatch(self, freq: FleetRequest, handoff: bool = False) -> None:
         # the fleet handle rides INSIDE the engine callbacks (partial
         # binding) — a registry keyed on the engine handle would race the
         # replica's ticker, which can emit tokens between submit() and
@@ -377,20 +466,46 @@ class FleetRouter:
         # strands the request on a stopped ticker's queue forever.
         from functools import partial
 
+        kwargs = dict(freq.kwargs)
+        # the budget/stop set resolved at submit() govern the WHOLE
+        # lifetime regardless of which replica serves a leg: engines in
+        # one fleet may carry different defaults, and the split/resume
+        # arithmetic (and the prefill leg's `finished` check) must not
+        # shift with the replica the dispatch happens to land on
+        kwargs["max_new_tokens"] = freq.budget
+        if freq.eos is not None and "eos_token_id" not in kwargs:
+            kwargs["eos_token_id"] = freq.eos
+        chain, resume_tokens = None, None
+        if freq.stage == "prefill":
+            # the chain-publishing leg: emit the first token only, keep
+            # the finished chain on the handle for the decode tier
+            kwargs["max_new_tokens"] = 1
+            kwargs["keep_chain"] = True
+        elif freq.chain is not None:
+            # adopt-and-decode (the disagg handoff / kill-requeue
+            # resume): ownership of the chain passes to the engine
+            chain, resume_tokens = freq.chain, list(freq.tokens)
+            kwargs["resume_from"] = (chain, resume_tokens)
         with self._mu:
-            rep = self._pick()
+            rep = self._pick(freq.stage)
             freq.replica = rep.name
-            freq.attempts += 1
+            if not handoff:
+                # a handoff is one lifetime split across tiers, not a
+                # retry — attempts stays the requeue odometer
+                freq.attempts += 1
             if freq._tracer is not None:
                 freq._tracer.event(
                     "fleet.dispatch", parent=freq.trace_ctx,
                     replica=rep.name, attempt=freq.attempts,
+                    stage=freq.stage or "full",
                     request_id=freq.request_id)
             rep.engine.submit(
                 freq.prompt, on_token=partial(self._on_token, freq),
                 on_done=partial(self._on_done, freq),
                 trace_ctx=freq.trace_ctx, request_id=freq.request_id,
-                **freq.kwargs)
+                **kwargs)
+            if chain is not None:
+                freq.chain = None  # the engine owns it now
 
     # --------------------------------------------- engine-thread callbacks
 
@@ -400,17 +515,78 @@ class FleetRouter:
         if freq.t_first is None:
             freq.t_first = time.perf_counter()
         freq.tokens.append(tok)
-        if freq.on_token is not None:
+        # `delivered` is the client's high-water mark: a re-dispatch that
+        # re-decodes already-streamed positions (scratch requeue, the
+        # frozen-chain fallback) re-emits them into freq.tokens, but the
+        # client's on_token must see each position ONCE (greedy re-decode
+        # reproduces them identically, so skipping is exact)
+        if freq.on_token is not None and len(freq.tokens) > freq.delivered:
             freq.on_token(freq, tok)
+        freq.delivered = max(freq.delivered, len(freq.tokens))
 
     def _on_done(self, freq: FleetRequest, handle) -> None:
         """Runs on the finishing replica's engine thread. Success
-        completes the fleet handle; a replica-death failure requeues onto
-        a survivor — the zero-drop contract."""
+        completes the fleet handle (or, on the disaggregated prefill
+        leg, hands the published chain to the decode tier); a
+        replica-death failure requeues onto a survivor — the zero-drop
+        contract — RESUMING from the surviving paged-KV chain when one
+        exists instead of re-decoding from scratch."""
         if freq.done.is_set():
             return
         if handle.error is None:
-            freq.tokens = [int(t) for t in handle.tokens]
+            if freq.stage == "prefill":
+                freq.tokens = [int(t) for t in handle.tokens]
+                chain = getattr(handle, "chain", None)
+                if chain is not None and chain.frozen:
+                    # insert() stopped early at admission (covered-by-
+                    # sibling / partial-parent boundary), so the chain
+                    # cannot cover the row's positions: nothing to hand
+                    # off — release it and take the chainless fallback
+                    # (a frozen chain must never reach resume_from:
+                    # submit refuses it, and on this engine-thread
+                    # callback that refusal would strand the client)
+                    chain.release()
+                    handle.chain = None
+                    chain = None
+                finished = (len(freq.tokens) >= freq.budget
+                            or (freq.eos is not None
+                                and freq.tokens[-1] in freq.eos))
+                if not finished:
+                    freq.stage = "decode"
+                    if chain is not None:
+                        # the handoff: the prefill replica published the
+                        # chain through the shared pool; a decode
+                        # replica adopts it and decodes from the first
+                        # generated position — the prompt never touches
+                        # a decode slot
+                        freq.chain = chain
+                        with self._mu:
+                            self.metrics["prefill_handoffs_total"] += 1
+                        if freq._tracer is not None:
+                            freq._tracer.event(
+                                "fleet.handoff", parent=freq.trace_ctx,
+                                request_id=freq.request_id,
+                                from_replica=freq.replica,
+                                chain_blocks=len(chain.refs),
+                                chain_tokens=int(chain.length))
+                    else:
+                        # frozen/unpublishable chain: fall back to a
+                        # whole-lifetime dispatch on the decode tier
+                        # (every engine CAN prefill; the split is
+                        # policy, not capability). The re-decode
+                        # re-emits the first token; `delivered` keeps
+                        # the client stream single-copy.
+                        freq.tokens = []
+                    try:
+                        self._dispatch(freq, handoff=True)
+                    except FleetOverloaded as exc:
+                        self._fail(freq, str(exc))
+                    return
+                if chain is not None:
+                    chain.release()  # finished at the first token
+            else:
+                # prefill-finished fall-through already normalized above
+                freq.tokens = [int(t) for t in handle.tokens]
             freq.t_done = time.perf_counter()
             with self._mu:
                 self.metrics["requests_completed_total"] += 1
@@ -420,40 +596,84 @@ class FleetRouter:
             self._record_root(freq, "completed")
             freq.done.set()
             return
+        chain = getattr(handle, "chain", None)
         if freq.attempts > self.max_requeues:
-            freq.error = f"gave up after {freq.attempts} attempts: " \
-                         f"{handle.error}"
-            with self._mu:
-                self.metrics["requests_failed_total"] += 1
-            self._record_root(freq, "failed")
-            freq.done.set()
+            if chain is not None:
+                chain.release()
+                handle.chain = None
+            self._fail(freq, f"gave up after {freq.attempts} attempts: "
+                             f"{handle.error}")
             return
-        # replica died (or poisoned round): start over on a survivor.
-        # Partial tokens are discarded — greedy decode reproduces them
-        # exactly; TTFT restarts because the client's wait does too.
-        freq.tokens = []
-        freq.t_first = None
+        # replica died (or poisoned round): continue on a survivor. A
+        # surviving chain (transferred by the dead engine's _fail_all)
+        # RESUMES — emitted tokens kept, TTFT kept, zero re-prefill and
+        # zero re-decode; without one, partial tokens are discarded and
+        # greedy decode reproduces them exactly from scratch.
+        # token record: freq.tokens is the router's own (what the client
+        # already streamed) — for a request killed while still QUEUED on
+        # the dead replica's resume path, handle.tokens is empty but the
+        # prefill leg's first token lives in freq.tokens and the chain
+        # still rescues; for a seated row the two agree (every emission
+        # flowed through _on_token). The rescue also requires every live
+        # replica to share the chain's pool: a mixed fleet with
+        # per-replica pools (legal, pre-dating the disagg split) must
+        # take the scratch path — resume_from into a different pool is
+        # an engine-side refusal this engine-thread callback cannot
+        # surface to the client
+        resumed = (chain is not None and not chain.frozen
+                   and chain.length >= freq.prompt.size
+                   and len(freq.tokens) > 0
+                   and all(r.engine.paged_kv is chain.pool
+                           for r in self._alive()))
+        if resumed:
+            keep = int(chain.length) - int(freq.prompt.size) + 1
+            freq.tokens = [int(t) for t in freq.tokens][:keep]
+            freq.chain = chain
+            freq.stage = "decode" if self.disaggregated else ""
+        else:
+            if chain is not None:
+                chain.release()
+            keep = 0
+            freq.tokens = []
+            freq.t_first = None
+            freq.stage = "prefill" if self.disaggregated else ""
+        handle.chain = None
         with self._mu:
             self.metrics["requests_requeued_total"] += 1
+            if resumed:
+                self.metrics["requeues_resumed_total"] += 1
+                self.metrics["requeue_resumed_tokens_total"] += keep
         if freq._tracer is not None:
             # parent-linked to the replica-kill event exactly like the
             # chaos.pod_kill → job.gang_restart chain: the kill is the
             # ROOT of the disruption, each requeue a consequence of it
             # (falls back to the request's own trace for a non-kill
-            # poisoned round)
+            # poisoned round). resumed_from_block attributes the rescue:
+            # how many surviving pool blocks the requeue resumed from
+            # (0 = the PR-9 re-decode-from-scratch fallback).
             freq._tracer.event(
                 "fleet.requeue",
                 parent=self._kill_ctx.get(freq.replica) or freq.trace_ctx,
                 request_id=freq.request_id, from_replica=freq.replica,
-                attempt=freq.attempts)
+                attempt=freq.attempts,
+                resumed_from_block=len(chain.refs) if resumed else 0,
+                resumed_tokens=keep)
         try:
             self._dispatch(freq)
         except FleetOverloaded as exc:
-            freq.error = str(exc)
-            with self._mu:
-                self.metrics["requests_failed_total"] += 1
-            self._record_root(freq, "failed")
-            freq.done.set()
+            self._fail(freq, str(exc))
+
+    def _fail(self, freq: FleetRequest, error: str) -> None:
+        """Terminal failure: release any chain the request still owns,
+        count, record, unblock."""
+        if freq.chain is not None:
+            freq.chain.release()
+            freq.chain = None
+        freq.error = error
+        with self._mu:
+            self.metrics["requests_failed_total"] += 1
+        self._record_root(freq, "failed")
+        freq.done.set()
 
     def _record_root(self, freq: FleetRequest, outcome: str) -> None:
         """Retroactively record the request's root span at its terminal
@@ -520,14 +740,43 @@ class FleetRouter:
         rep.engine._fail_all("replica killed")
         return rep
 
-    def add_replica(self, engine, name: str = "") -> Replica:
+    def add_replica(self, engine, name: str = "",
+                    role: str = "mixed") -> Replica:
         """Scale-out entry (the autoscaler's add path). The new engine
         inherits the fleet's tracer AND monitoring TSDB (unless it
         brought its own), so scale-out replicas are visible to the SLO
-        series from their first tick."""
+        series from their first tick. On a disaggregated fleet the
+        constructor's invariant holds here too: the new engine must
+        share the ONE paged_kv pool (a decode-capable replica off the
+        pool would crash the chain handoff/resume on an engine-thread
+        callback, stranding the client)."""
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
+        pools = {id(r.engine.paged_kv): r.engine.paged_kv
+                 for r in self.replicas}
+        if self.disaggregated or role == "prefill":
+            pools[id(engine.paged_kv)] = engine.paged_kv
+            if any(p is None for p in pools.values()) or len(pools) != 1:
+                raise ValueError(
+                    "a disaggregated fleet needs every replica on ONE "
+                    "shared paged_kv pool — it is the chain-handoff "
+                    "medium")
+        elif (len(pools) == 1
+              and next(iter(pools.values())) is not None
+              and engine.paged_kv is not next(iter(pools.values()))):
+            # a fleet whose replicas all share one pool is resume-
+            # capable: the kill-requeue guard decides "every live
+            # replica shares the chain's pool" and then _pick may land
+            # the resume on ANY replica — admitting an off-pool engine
+            # here would let that dispatch race into an engine-side
+            # refusal on the callback thread
+            raise ValueError(
+                "this fleet's replicas share one paged_kv pool (the "
+                "resume-from-KV rescue dispatches chains to any "
+                "replica) — scale-out engines must share it too")
         self._wire_engine(engine)
         rep = Replica(name=name or f"replica-{len(self.replicas)}",
-                      engine=engine)
+                      engine=engine, role=role)
         self.replicas.append(rep)
         return rep
 

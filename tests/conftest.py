@@ -40,10 +40,14 @@ _COMPILE_CACHE_DIR = os.path.join(
 #: train-without-restore ran clean across full cached suite runs). The
 #: compile-cache suites (hotpath/AOT/prof/partitioner) manage cache
 #: config or pin compile counts themselves and every checkpoint-using
-#: file is deliberately NOT listed.
+#: file is deliberately NOT listed. test_decode.py is allowlisted by the
+#: same reasoning as test_fleet.py: pure inference (no Checkpointer, no
+#: fit loop), recompiling the same tiny-GPT chunk/decode/splice programs
+#: across engines.
 _COMPILE_CACHE_FILES = frozenset((
     "test_continuous.py",
     "test_gpt_generate.py",
+    "test_decode.py",
     "test_fleet.py",
     "test_slo.py",
     "test_serving.py",
@@ -161,7 +165,8 @@ def lockcheck_armed(request):
             or request.node.get_closest_marker("fleet")
             or request.node.get_closest_marker("hotpath")
             or request.node.get_closest_marker("partition")
-            or request.node.get_closest_marker("slo")):
+            or request.node.get_closest_marker("slo")
+            or request.node.get_closest_marker("decode")):
         yield
         return
     from kubeflow_tpu.analysis import lockcheck

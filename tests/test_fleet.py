@@ -140,6 +140,72 @@ class TestPagedKVPool:
         assert len(pool) == 3
         assert set(refs_c) <= set(pool.refcounts())
 
+    def test_eviction_under_decode_growth_pressure(self, lm):
+        """ISSUE 13 drill: a pool sized BELOW aggregate demand, its
+        inventory fragmented across retired chains, under live
+        decode-growth pressure (rows appending generated-token KV at
+        every block boundary). Eviction must drain ONLY unreferenced
+        leaf blocks — never a live decode row's chain — and the rows'
+        tokens stay exactly solo generate's (a dropped live block would
+        corrupt the resumed gather/extend path). Refcounts drain to
+        zero at retire and fresh pressure can then reclaim everything."""
+        model, variables = lm
+        pool = PagedKVPool(block_size=4, capacity_blocks=12)
+        # fragment the reuse inventory: distinct retired chains fill the
+        # pool to capacity, all unreferenced (evict-on-demand stock)
+        eng0 = ContinuousBatcher(model, variables, max_rows=2,
+                                 paged_kv=pool)
+        for i in range(3):
+            eng0.submit(_prompt(80 + i, 11), max_new_tokens=6)
+        eng0.run_until_idle()
+        assert len(pool) == pool.capacity_blocks
+        assert all(c == 0 for c in pool.refcounts().values())
+        inventory = set(pool.refcounts())
+        # live decode growth: two in-flight rows whose chains (prompt +
+        # generated, ~5 blocks each) plus the inventory exceed capacity —
+        # every boundary allocation forces an eviction decision
+        eng = ContinuousBatcher(model, variables, max_rows=2,
+                                paged_kv=pool)
+        pa, pb = _prompt(90, 10), _prompt(91, 10)
+        ra = eng.submit(pa, max_new_tokens=8)
+        rb = eng.submit(pb, max_new_tokens=8)
+        evicted0 = pool.metrics["blocks_evicted_total"]
+        while eng.tick():
+            live = set()
+            counts = pool.refcounts()
+            # the O(1) pinned counter stays exact against a full scan
+            # through every grow/share/evict transition of the drill
+            assert pool.blocks_in_use() == sum(
+                1 for c in counts.values() if c > 0)
+            for ch in eng._row_chains.values():
+                if ch is not None:
+                    refs = set(ch.refs)
+                    # every live chain block is still in the table AND
+                    # still referenced — eviction never touched it
+                    assert refs <= set(counts)
+                    assert all(counts[d] > 0 for d in refs)
+                    live |= refs
+            # whatever left the pool came out of the unreferenced stock
+            assert len(pool) <= pool.capacity_blocks + len(live)
+        assert pool.metrics["blocks_evicted_total"] > evicted0, \
+            "no eviction pressure — the drill sized the pool too large"
+        # some fragmented inventory was sacrificed to the live rows
+        assert not inventory <= set(pool.refcounts())
+        np.testing.assert_array_equal(ra.result(timeout=1),
+                                      _want(lm, pa, 8))
+        np.testing.assert_array_equal(rb.result(timeout=1),
+                                      _want(lm, pb, 8))
+        # refcount drain: retire released every hold, the pool is back
+        # at capacity, and fresh pressure can reclaim ALL of it
+        assert all(c == 0 for c in pool.refcounts().values())
+        assert len(pool) <= pool.capacity_blocks
+        big = _prompt(99, 44)                       # 11 blocks in one go
+        refs = pool.insert(big, {
+            "layer_0/attention/cached_key":
+            np.zeros((44, 1, 1), np.float32)})
+        assert set(refs) <= set(pool.refcounts())
+        assert len(pool) <= pool.capacity_blocks
+
 
 # ------------------------------------------------------ chunked prefill
 
@@ -199,10 +265,12 @@ class TestChunkedPrefill:
         with pytest.raises(ValueError, match="bucketed"):
             ContinuousBatcher(model, variables, prefill_chunk=4,
                               prefill_buckets=(8, 16))
-        with pytest.raises(ValueError, match="speculative"):
-            ContinuousBatcher(model, variables, prefill_chunk=4,
-                              draft_module=model,
-                              draft_variables=variables)
+        # speculative x chunked COMPOSES now (tests/test_decode.py pins
+        # token-identity); only the bucket/rolling hazards stay refused
+        eng = ContinuousBatcher(model, variables, prefill_chunk=4,
+                                draft_module=model,
+                                draft_variables=variables)
+        assert eng.prefill_chunk == 4 and eng.draft_module is not None
         rolled = GPTLM(GPTConfig.tiny(dropout_rate=0.0, max_len=96,
                                       attention_window=8,
                                       kv_cache_capacity=16))

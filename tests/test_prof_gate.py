@@ -87,6 +87,26 @@ class TestPerfGate:
         assert all(b >= 1.0 for b in
                    rec["slo"]["decode_tick"]["burn_rates"].values())
 
+    def test_injected_decode_tick_slowdown_fails_disagg(self,
+                                                        monkeypatch):
+        """The disagg gate's teeth (ISSUE 13): the same decode_tick:2
+        injection must fail serve_disagg's absolute decode_tick budget
+        and FIRE the decode-tick SLO watching the disagg tier — while
+        the in-run vs_fleet ratios stay put (both phases carry the
+        injection, so the tier-vs-fleet claim is injection-immune by
+        construction and must NOT be what fails)."""
+        monkeypatch.setenv(ENV_PROF_CHAOS, "decode_tick:2")
+        results = cpu_proxy.run_all(only="serve_disagg")
+        violations = cpu_proxy.check_budgets(
+            results, json.loads(BUDGETS.read_text()))
+        assert any("serve_disagg.decode_tick" in v
+                   and "vs_fleet" not in v for v in violations), violations
+        (rec,) = results
+        assert rec["slo"]["decode_tick"]["fired"] is True
+        assert "serving_decode_tick" in rec["slo"]["alerts"]
+        assert all(b >= 1.0 for b in
+                   rec["slo"]["decode_tick"]["burn_rates"].values())
+
     def test_forced_serialization_fails_grad_overlap_gate(self,
                                                           monkeypatch):
         """The overlap gate's teeth: KFTPU_PROF_CHAOS="grad_overlap:2"
@@ -151,6 +171,38 @@ class TestPerfGate:
         assert rec["request_breakdown"]["count"] == rec["requests"]
         assert rec["request_breakdown"]["by_outcome"] == {
             "completed": rec["requests"]}
+
+    def test_disagg_drill_resumes_from_surviving_kv(self, monkeypatch):
+        """The serve_disagg record is ISSUE 13's acceptance drill: a
+        long-prompt-heavy mix on the disaggregated tier, one decode
+        replica killed mid-run — dropped=0 AND >=1 request RESUMED from
+        the surviving KV chain (re-decoded-from-scratch strictly below
+        the PR-9 baseline, which re-decoded every requeue), long
+        prompts computed ZERO prompt positions on the decode tier, and
+        the PR-12 SLO monitor stayed alert-quiet through the whole
+        drill."""
+        monkeypatch.delenv(ENV_PROF_CHAOS, raising=False)
+        (rec,) = cpu_proxy.run_all(only="serve_disagg")
+        assert rec["replica_killed"] and rec["requeued"] >= 1
+        assert rec["dropped_count"] == 0
+        assert rec["fleet_dropped_count"] == 0
+        assert rec["completed"] == rec["requests"]
+        # the resume rescue: strictly fewer scratch re-decodes than the
+        # PR-9 baseline behavior (scratch == requeued)
+        assert rec["resumed"] >= 1 and rec["resumed_tokens"] >= 1
+        assert rec["requeued"] - rec["resumed"] < rec["requeued"]
+        assert rec["rel"]["requeue_scratch_frac"] < 1.0
+        # the tier contract: every prompt prefilled on the prefill tier
+        assert rec["handoffs"] == rec["requests"]
+        assert rec["decode_tier_prefill_tokens"] == 0
+        # the disagg shape at or below the mixed fleet on the same mix
+        assert rec["rel"]["ttft_p99_vs_fleet"] <= 1.0
+        assert rec["rel"]["decode_tick_vs_fleet"] <= 1.0
+        # alert-quiet through the kill (the monitored half of the teeth)
+        assert rec["slo"]["decode_tick"]["fired"] is False
+        assert rec["slo"]["zero_drop"]["fired"] is False
+        assert rec["slo"]["alerts"] == []
+        assert rec["slo"]["decode_tick"]["samples"] > 0
 
 
 class TestGateLogic:
