@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo test-decode selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo test-decode test-soak selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -86,6 +86,17 @@ test-slo:
 # (docs/serving.md "Disaggregated prefill/decode")
 test-decode:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q -m decode
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
+
+# kftpu-storm suite: the closed autoscaling loop (scale-up cooldown,
+# graceful-drain scale-down, loss-free drain-kill resume, scale-to-zero
+# + wake-on-arrival, hang detection, frozen-scaler chaos mode), the
+# golden scaler decision trace, activator cold-start Retry-After
+# calibration, SLO monitoring across scaler activity, and the seeded
+# production-day soak + its prod_day cpu-proxy gate
+# (docs/autoscaling.md)
+test-soak:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_soak.py -q -m soak
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 native:

@@ -63,6 +63,7 @@ class Platform:
         self.isvc_controller = InferenceServiceController(
             self.cluster,
             model_cache_dir=str(Path(log_dir).parent / "model-cache"),
+            platform=self,
         )
         self.profile_controller = ProfileController(self.cluster)
         self.tensorboard_controller = TensorboardController(self.cluster)

@@ -145,6 +145,53 @@ def render_metrics(platform) -> str:
                     "sample window",
               labels=f'{{quantile="{q}"}}')
 
+    # fleet autoscaler (serving/fleet/scaler.py, docs/autoscaling.md):
+    # the closed loop's decision ledger — scale events, graceful-drain
+    # vs polite-kill outcomes, scale-to-zero/wake cycles, hang
+    # detections — aggregated over every registered fleet's scaler and
+    # ZERO-valued on a scalerless platform (KFTPU-METRIC contract)
+    scalers = [s for s in (getattr(r, "scaler", None) for r in routers)
+               if s is not None]
+
+    def scaler_sum(field_):
+        return sum(s.metrics.get(field_, 0) for s in scalers)
+
+    for fam, field_, help_ in (
+        ("kftpu_scaler_evaluations_total", "evaluations_total",
+         "scaling-loop passes over the demand signal"),
+        ("kftpu_scaler_frozen_evaluations_total",
+         "frozen_evaluations_total",
+         "passes that evaluated but acted on nothing (the "
+         "scaler_freeze chaos mode)"),
+        ("kftpu_scaler_scale_ups_total", "scale_ups_total", None),
+        ("kftpu_scaler_scale_downs_total", "scale_downs_total", None),
+        ("kftpu_scaler_replicas_added_total", "replicas_added_total",
+         None),
+        ("kftpu_scaler_replicas_removed_total",
+         "replicas_removed_total", None),
+        ("kftpu_scaler_drains_completed_total", "drains_completed_total",
+         "scale-down drains that emptied gracefully"),
+        ("kftpu_scaler_drain_kills_total", "drain_kills_total",
+         "drains finished as a polite kill after the grace window "
+         "(requests chain-resumed onto survivors)"),
+        ("kftpu_scaler_hangs_detected_total", "hangs_detected_total",
+         "replicas declared hung (work held, engine not advancing)"),
+        ("kftpu_scaler_scale_to_zero_total", "scale_to_zero_total",
+         None),
+        ("kftpu_scaler_scale_from_zero_total", "scale_from_zero_total",
+         "wake-on-arrival cold starts out of the scaled-to-zero state"),
+    ):
+        counter(fam, scaler_sum(field_), help_=help_)
+    gauge("kftpu_scaler_target_replicas",
+          sum(s.target_replicas for s in scalers),
+          help_="the demand signal's last clamped target")
+    gauge("kftpu_scaler_frozen",
+          sum(1 for s in scalers if s.frozen),
+          help_="scalers currently frozen (chaos mode)")
+    gauge("kftpu_scaler_cold_start_seconds",
+          max((s.cold_start_ewma_s for s in scalers), default=0.0),
+          help_="EWMA of observed replica cold-start durations")
+
     # SLO burn-rate monitor (kubeflow_tpu/monitoring, docs/slo.md):
     # evaluation/alert counters, per-objective burn-rate and alert
     # gauges, and the TSDB's volume/loss accounting. A platform without

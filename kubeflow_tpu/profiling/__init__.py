@@ -25,6 +25,7 @@ from kubeflow_tpu.profiling.analytics import (
     request_shape,
     restart_chains,
     restart_shape,
+    scaler_shape,
     step_breakdown,
 )
 from kubeflow_tpu.profiling.report import (
@@ -55,5 +56,6 @@ __all__ = [
     "request_shape",
     "restart_chains",
     "restart_shape",
+    "scaler_shape",
     "step_breakdown",
 ]

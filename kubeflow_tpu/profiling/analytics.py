@@ -598,3 +598,29 @@ def request_shape(spans: list[dict]) -> str:
             f"fleet.replica_kill replica={kill['attrs'].get('replica')}")
         lines.extend(kid_counts(kill["span"]))
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def scaler_shape(spans: list[dict]) -> str:
+    """Canonical, golden-pinnable text form of the autoscaler's decision
+    traces (the request_shape analogue for serving/fleet/scaler.py):
+    every `scaler.evaluate` event in time order with its decision and
+    demand, then the scale/drain/kill/hang events parent-linked to it as
+    collapsed `name xN` counts — names and parentage only, no ids or
+    times, so a decision that loses its causal link to the burn
+    evaluation that triggered it (the attributability contract) diffs
+    loudly while timing noise never does."""
+    by_parent: dict[str, list[dict]] = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent", ""), []).append(s)
+    lines: list[str] = []
+    for ev in sorted((s for s in spans if s["name"] == "scaler.evaluate"),
+                     key=lambda s: s["ts"]):
+        lines.append(
+            f"scaler.evaluate decision={ev['attrs'].get('decision')} "
+            f"demand={ev['attrs'].get('demand')}")
+        counts: dict[str, int] = {}
+        for s in by_parent.get(ev["span"], []):
+            counts[s["name"]] = counts.get(s["name"], 0) + 1
+        for name in sorted(counts):
+            lines.append(f"  {name} x{counts[name]}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -50,6 +50,12 @@ PROXY_RETRY = BackoffPolicy(
     base_s=0.02, max_s=0.5, multiplier=2.0, jitter=0.5, max_attempts=3
 )
 
+#: EWMA weight of each observed cold-start hold duration
+_COLD_ALPHA = 0.3
+#: Retry-After = observed cold start x this margin (the replica should
+#: actually be up when the client re-dials, not merely almost)
+_COLD_HINT_MARGIN = 1.25
+
 
 class Activator:
     def __init__(self, platform, port: int = 0, host: str = "127.0.0.1",
@@ -73,6 +79,12 @@ class Activator:
         self._rr_mu = make_lock("activator.Activator._rr_mu")
         #: demand stamps lost to delete/conflict races (benign; countable)
         self.demand_signal_losses = 0
+        #: EWMA of OBSERVED cold-start hold durations (request arrival →
+        #: ready endpoint): calibrates the 503 Retry-After hint so storm
+        #: clients back off proportionally to how long a cold start
+        #: actually takes HERE, instead of a static guess; 0.0 =
+        #: uncalibrated (the static retry_after_s stays the fallback)
+        self.cold_start_ewma_s = 0.0
 
     # ------------------------------------------------------------- routing
 
@@ -153,6 +165,33 @@ class Activator:
             return None
         return None if out is _gone else out
 
+    def observe_cold_start(self, duration_s: float) -> None:
+        """Feed one successful cold-start hold into the EWMA (handle()
+        calls this when a held request actually got an endpoint — a
+        timeout is censored, not a sample)."""
+        if duration_s <= 0.0:
+            return
+        self.cold_start_ewma_s = (
+            duration_s if self.cold_start_ewma_s <= 0.0
+            else (1 - _COLD_ALPHA) * self.cold_start_ewma_s
+            + _COLD_ALPHA * duration_s)
+
+    def retry_after_hint_s(self) -> int:
+        """The 503 Retry-After hint: observed-cold-start EWMA with a
+        margin when calibrated (clients re-dial about when a replica
+        will really be ready — a storm backs off proportionally), the
+        static configured value as the uncalibrated fallback. Never
+        above the static value: the config is the operator's ceiling."""
+        ceiling = int(self.retry_after_s)
+        if self.cold_start_ewma_s <= 0.0 or ceiling < 1:
+            # uncalibrated — or a sub-second configured ceiling, where
+            # the 1s floor below would EXCEED the operator's value
+            return ceiling
+        import math
+
+        hinted = math.ceil(self.cold_start_ewma_s * _COLD_HINT_MARGIN)
+        return max(1, min(hinted, ceiling))
+
     def _unavailable(self, msg: str) -> tuple[int, bytes, str, dict]:
         """503 with an explicit Retry-After: the client re-dials after the
         hint instead of the activator holding its connection forever."""
@@ -160,7 +199,7 @@ class Activator:
             503,
             f'{{"error": "{msg}"}}'.encode(),
             "application/json",
-            {"Retry-After": str(int(self.retry_after_s))},
+            {"Retry-After": str(self.retry_after_hint_s())},
         )
 
     def handle(self, method: str, path: str, body: bytes | None,
@@ -189,7 +228,13 @@ class Activator:
             with tracer_of(self.platform).span(
                 "activator.cold_start_hold", isvc=key,
             ) as sp:
+                t0 = time.monotonic()
                 url = self._await_endpoint(key, deadline)
+                if url is not None:
+                    # a COMPLETED hold calibrates the Retry-After hint
+                    # (a timeout is censored — it proves nothing about
+                    # how long a successful cold start takes)
+                    self.observe_cold_start(time.monotonic() - t0)
                 sp.set_attribute("outcome",
                                  "ready" if url is not None else "timeout")
         if url is None:

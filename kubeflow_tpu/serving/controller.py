@@ -80,7 +80,8 @@ class InferenceServiceController(ControllerBase):
     ERROR_EVENT_KIND = "inferenceservices"
 
     def __init__(self, cluster: FakeCluster, workers: int = 1,
-                 resync_period_s: float = 1.0, model_cache_dir: str = ".kubeflow_tpu/model-cache"):
+                 resync_period_s: float = 1.0, model_cache_dir: str = ".kubeflow_tpu/model-cache",
+                 platform=None):
         # readiness probing rides the resync cadence
         super().__init__(
             cluster, name="isvc", workers=workers,
@@ -88,6 +89,11 @@ class InferenceServiceController(ControllerBase):
             wq_base_delay_s=0.01, wq_max_delay_s=5.0,
         )
         self.model_cache_dir = model_cache_dir
+        #: back-reference for the fleet-demand autoscale path: an ISVC
+        #: whose fleet is registered (Platform.register_fleet under the
+        #: same "ns/name" key) scales from FleetRouter.demand_replicas_
+        #: burn instead of the request-rate estimate (docs/autoscaling.md)
+        self.platform = platform
         # probes are blocking HTTP calls: run them off a pool so one slow
         # replica can't serialize readiness detection for everything else
         self._probe_pool = ThreadPoolExecutor(max_workers=8,
@@ -326,6 +332,18 @@ class InferenceServiceController(ControllerBase):
                 self._last_traffic[key] = now
             return
 
+        # fleet-demand path (docs/autoscaling.md): when this service's
+        # FleetRouter is registered on the platform, the burn-rate-aware
+        # demand signal replaces the request-rate estimate — the signal
+        # already folds queue depth, service rate, AND the SLO burn
+        # (demand_replicas_burn), so the HPA math below would be a
+        # worse duplicate of it
+        fleet = (getattr(self.platform, "fleet_routers", {}) or {}) \
+            .get(key) if self.platform is not None else None
+        if fleet is not None:
+            self._autoscale_fleet(isvc, key, a, fleet, now)
+            return
+
         prev = self._qps_samples.get(key)
         if prev is not None and now - prev[0] < a.scale_interval_s:
             return  # inside the decision window: no sampling, no blocking IO
@@ -388,6 +406,47 @@ class InferenceServiceController(ControllerBase):
                   f"idle {now - self._last_traffic[key]:.0f}s >= "
                   f"scaleToZeroGraceS {a.scale_to_zero_grace_s:.0f}s")
         self._scale_to(isvc, key, a, desired, reason=reason)
+
+    def _autoscale_fleet(self, isvc: InferenceService, key: str, a,
+                         fleet, now: float) -> None:
+        """Demand-signal replica decision: desired count straight from
+        the fleet's burn-aware demand (the FleetScaler consumes the same
+        signal in-process; here it sizes the ISVC's replica SET), one
+        decision per scale interval, scale-to-zero only after the idle
+        grace window — the serverless semantics of the qps path kept."""
+        prev = self._qps_samples.get(key)
+        if prev is not None and now - prev[0] < a.scale_interval_s:
+            return
+        self._qps_samples[key] = (now, {})
+        monitor = getattr(self.platform, "slo_monitor", None)
+        demand = (fleet.demand_replicas_burn(monitor)
+                  if monitor is not None else fleet.demand_replicas())
+        self._last_traffic.setdefault(key, now)
+        # demand_replicas floors at 1 while ANY replica serves (its own
+        # scale-in floor, by design) — so a floor-1 reading is NOT
+        # traffic; only demand past the floor or actual queued work
+        # refreshes the idle clock, or scaleToZeroGraceS could never
+        # elapse and the serverless contract would be silently dead
+        if demand > 1 or fleet.queue_depth() > 0:
+            self._last_traffic[key] = now
+        floor = a.min_replicas
+        idle = False
+        if floor == 0:
+            idle = (now - self._last_traffic[key]
+                    >= a.scale_to_zero_grace_s)
+            floor = 0 if idle else 1
+        if idle and fleet.queue_depth() == 0:
+            # idle past the grace with nothing queued: override the
+            # signal's alive-floor of 1 and reap to zero
+            desired = 0
+        else:
+            desired = int(min(max(demand, floor), a.max_replicas))
+        if desired == isvc.spec.predictor.replicas:
+            return
+        self._scale_to(
+            isvc, key, a, desired,
+            reason=f"fleet demand {demand} "
+                   f"({'burn-aware' if monitor is not None else 'queue'})")
 
     def _scale_to(self, isvc: InferenceService, key: str, a, desired: int,
                   reason: str) -> None:

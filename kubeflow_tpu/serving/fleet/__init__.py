@@ -2,9 +2,11 @@
 
 ROADMAP item 2 (serving at planetary scale): paged/block KV cache with
 prefix reuse (pagedkv.py), queue-depth-aware routing + SLO admission +
-replica-kill requeue across N ContinuousBatcher replicas (router.py), and
+replica-kill requeue across N ContinuousBatcher replicas (router.py),
 the seeded open-loop load-test harness (loadtest.py — the serving
-analogue of the chaos drills). Chunked prefill lives in the engine itself
+analogue of the chaos drills), and the closed autoscaling loop
+(scaler.py: FleetScaler consumes the burn-aware demand signal —
+docs/autoscaling.md). Chunked prefill lives in the engine itself
 (serving/continuous.py `prefill_chunk`); the pool plugs in there via the
 engine's `paged_kv` parameter. docs/serving.md is the operator guide.
 """
@@ -29,15 +31,21 @@ from kubeflow_tpu.serving.fleet.router import (
     FleetRouter,
     Replica,
 )
+from kubeflow_tpu.serving.fleet.scaler import (
+    FleetScaler,
+    ScalerConfig,
+)
 
 __all__ = [
     "FleetOverloaded",
     "FleetRequest",
     "FleetRouter",
+    "FleetScaler",
     "LoadReport",
     "PagedKVPool",
     "PrefixMatch",
     "Replica",
+    "ScalerConfig",
     "SequenceChain",
     "extract_prompt_kv",
     "make_prompts",

@@ -93,6 +93,11 @@ class Replica:
     engine: object
     alive: bool = True
     role: str = "mixed"
+    #: scale-down drain (serving/fleet/scaler.py): a draining replica
+    #: stops ADMITTING (excluded from _pick) but keeps ticking its
+    #: in-flight rows until empty — a drain is a polite kill_replica,
+    #: taken only when the grace window expires with work still seated
+    draining: bool = False
 
     def pending_tokens(self) -> int:
         """The routing load signal: queued prompt+budget tokens plus the
@@ -183,7 +188,8 @@ class FleetRouter:
     def __init__(self, replicas, ttft_slo_s: float = 0.0,
                  retry_after_s: float = 1.0,
                  service_rate_tokens_per_s: float = 0.0,
-                 max_requeues: int = 3, tracer=None):
+                 max_requeues: int = 3, tracer=None,
+                 demand_tokens_per_replica: float = 0.0):
         """replicas: list of engines (named replica-<i>), (name, engine)
         pairs, or (name, engine, role) triples — role "prefill"/"decode"
         arms the disaggregated split (docstring), which requires every
@@ -233,9 +239,30 @@ class FleetRouter:
         #: what a requeue parent-links to (the chaos.pod_kill →
         #: gang_restart chain, serving edition)
         self._kill_ctx: dict[str, object] = {}
+        #: wake-on-arrival signal (serving/fleet/scaler.py): arrivals
+        #: that found NO admittable replica (scaled to zero, or every
+        #: survivor draining) are counted here so the demand signal —
+        #: whose queue-math EWMA has no live engine updating it in that
+        #: state — is pinned to the arrivals themselves, never to a
+        #: stale service rate
+        self._wake_pending = 0
+        self._wake_ts = 0.0
+        #: the autoscaler, when one is driving this fleet
+        #: (FleetScaler.__init__ sets it; observability and the ISVC
+        #: controller wiring read it)
+        self.scaler = None
         self.ttft_slo_s = float(ttft_slo_s)
         self.retry_after_s = float(retry_after_s)
         self.max_requeues = int(max_requeues)
+        #: explicit per-replica capacity target for the demand signal
+        #: (tokens of backlog one replica should own — the working-set
+        #: form: replicas x rows x (prompt + budget) is the natural
+        #: value). When set it replaces the EWMA-rate x SLO estimate in
+        #: demand_replicas(): a scaling POLICY wants to add capacity
+        #: BEFORE latency degrades, and the rate estimate only moves
+        #: after it has (the tick-driven soak also pins this because
+        #: its serialized engine loop distorts wall-clock rates).
+        self.demand_tokens_per_replica = float(demand_tokens_per_replica)
         self._rate = float(service_rate_tokens_per_s)
         self._mu = make_lock("fleet.FleetRouter._mu")
         self._ttfts = collections.deque(maxlen=_TTFT_WINDOW)
@@ -291,6 +318,12 @@ class FleetRouter:
     def _alive(self) -> list[Replica]:
         return [r for r in self.replicas if r.alive]
 
+    def _admittable(self) -> list[Replica]:
+        """Replicas a NEW dispatch may land on: alive and not draining
+        (a draining replica still ticks its in-flight rows — the drain
+        contract — but admits nothing)."""
+        return [r for r in self.replicas if r.alive and not r.draining]
+
     def load_view(self) -> dict[str, int]:
         """Per-replica pending-token load — the activator's queue-depth-
         aware endpoint pick reads this (serving/activator.py)."""
@@ -309,7 +342,7 @@ class FleetRouter:
         guess would turn cold starts into outages)."""
         if self._rate <= 0.0:
             return None
-        alive = self._alive()
+        alive = self._admittable()
         if not alive:
             return float("inf")
         ahead = min(r.pending_tokens() for r in alive) + prompt_len
@@ -342,6 +375,18 @@ class FleetRouter:
         are the admission decision, per-attempt dispatches, and the
         engine's queue-wait/prefill-chunk/decode spans."""
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if not self.replicas:
+            # scaled to zero with the replica LIST empty (the scaler
+            # reaps drained shells): there is no engine to resolve
+            # defaults from — shed with the wake signal stamped, the
+            # same contract _pick applies when entries exist but none
+            # admit. Found by the prod_day soak's first scale-to-zero.
+            with self._mu:
+                self._wake_pending += 1
+                self._wake_ts = time.time()
+                self.metrics["requests_shed_total"] += 1
+            raise FleetOverloaded("no live replicas",
+                                  retry_after_s=self.retry_after_s)
         on_token = kwargs.pop("on_token", None)
         rid = kwargs.pop("request_id", "")
         freq = FleetRequest(prompt=ids, kwargs=dict(kwargs),
@@ -439,8 +484,14 @@ class FleetRouter:
         return exc
 
     def _pick(self, stage: str = "") -> Replica:
-        alive = self._alive()
+        alive = self._admittable()
         if not alive:
+            # wake-on-arrival: the arrival is the scale-from-zero demand
+            # signal (the activator's DEMAND_ANNOTATION, in-process) —
+            # recorded BEFORE the shed so demand_replicas() sees it even
+            # though this request bounces with Retry-After
+            self._wake_pending += 1
+            self._wake_ts = time.time()
             raise FleetOverloaded("no live replicas",
                                   retry_after_s=self.retry_after_s)
         if self.disaggregated and stage:
@@ -692,16 +743,28 @@ class FleetRouter:
             context=freq.trace_ctx, parent=freq.parent_ctx, **attrs)
 
     def _observe_rate(self, freq: FleetRequest) -> None:
-        """EWMA of completed requests' end-to-end token rate — PROMPT +
-        output tokens over client-experienced wall time, the same unit
-        pending_tokens() counts (queued prompts + budgets). Mixing units
-        here would inflate estimated TTFT by the prompt/output ratio and
-        shed long-prompt traffic the fleet could comfortably serve.
+        """EWMA of completed requests' SERVICE token rate — PROMPT +
+        output tokens over the served window (submit-or-first-token to
+        done), the same unit pending_tokens() counts (queued prompts +
+        budgets). Mixing prompt/output units here would inflate
+        estimated TTFT by their ratio and shed long-prompt traffic the
+        fleet could comfortably serve.
+
+        The window deliberately EXCLUDES queue wait (it starts at the
+        first token when one exists): estimated TTFT divides the
+        backlog by this rate, so folding queueing into the denominator
+        is a positive feedback loop — a transient backlog depresses the
+        "rate", which sheds admissions, which stops completions, which
+        pins the rate low FOREVER (nothing completes while everything
+        sheds). The prod_day soak found exactly that shed-lock: one
+        congested peak and the fleet refused traffic it was idle for.
         Caller holds _mu."""
-        wall = (freq.t_done or 0.0) - freq.t_submit
-        if wall <= 0.0:
+        done = freq.t_done or 0.0
+        served = done - (freq.t_first
+                         if freq.t_first is not None else freq.t_submit)
+        if served <= 0.0:
             return
-        rate = (freq.prompt.size + len(freq.tokens)) / wall
+        rate = (freq.prompt.size + len(freq.tokens)) / served
         self._rate = (rate if self._rate <= 0.0
                       else (1 - _RATE_ALPHA) * self._rate
                       + _RATE_ALPHA * rate)
@@ -712,21 +775,21 @@ class FleetRouter:
 
     # ------------------------------------------------------------ chaos
 
-    def kill_replica(self, name_or_idx) -> Replica:
+    def kill_replica(self, name_or_idx, parent=None) -> Replica:
         """Chaos entry (the drills' mid-run kill): stop the replica's
         ticker and fail everything it carries — the on_done callbacks
-        requeue every request onto the survivors."""
-        rep = (self.replicas[name_or_idx]
-               if isinstance(name_or_idx, int)
-               else next(r for r in self.replicas
-                         if r.name == name_or_idx))
+        requeue every request onto the survivors. `parent` links the
+        kill event under a decision span (the scaler's drain-timeout
+        polite kill parents it to the fleet.scale_down that ordered the
+        drain); None keeps the kill a root — the chaos shape."""
+        rep = self._resolve(name_or_idx)
         tr = armed_tracer(self.tracer)
         if tr is not None:
             # the root of the disruption chain (the serving analogue of
             # chaos.pod_kill): every request the corpse was carrying
             # parent-links its fleet.requeue here — stamped BEFORE
             # _fail_all so the requeue callbacks can see it
-            ev = tr.event("fleet.replica_kill", parent=None,
+            ev = tr.event("fleet.replica_kill", parent=parent,
                           replica=rep.name)
             if ev.context is not None:
                 self._kill_ctx[rep.name] = ev.context
@@ -780,6 +843,47 @@ class FleetRouter:
         self.replicas.append(rep)
         return rep
 
+    def begin_drain(self, name_or_idx) -> Replica:
+        """Scale-down entry (the scaler's graceful half): the replica
+        stops admitting — _pick excludes it, ordered under _mu against
+        in-flight dispatches exactly like kill_replica's alive flip —
+        but keeps ticking its seated rows. In-flight requests finish in
+        place; remove_replica() reaps the empty shell, and a drain that
+        outlives its grace window is finished as a polite kill_replica
+        (the PR-13 requeue resumes every survivor from its chain)."""
+        rep = self._resolve(name_or_idx)
+        with self._mu:
+            rep.draining = True
+        return rep
+
+    def cancel_drain(self, name_or_idx) -> Replica:
+        """Un-drain: the cheapest scale-up (no cold start) when demand
+        returns before the drain finished."""
+        rep = self._resolve(name_or_idx)
+        with self._mu:
+            rep.draining = False
+        return rep
+
+    def remove_replica(self, name_or_idx) -> Replica:
+        """Reap a replica that can no longer carry work: drained empty,
+        or dead (killed — _fail_all already requeued its requests). A
+        live admitting replica, or a draining one with rows still
+        seated, is refused — removal would strand its clients."""
+        rep = self._resolve(name_or_idx)
+        with self._mu:
+            if rep.alive and (not rep.draining or rep.depth() > 0):
+                raise ValueError(
+                    f"replica {rep.name!r} still carries work (or still "
+                    "admits) — drain it empty or kill_replica first")
+            self.replicas.remove(rep)
+        return rep
+
+    def _resolve(self, name_or_idx) -> Replica:
+        return (self.replicas[name_or_idx]
+                if isinstance(name_or_idx, int)
+                else next(r for r in self.replicas
+                          if r.name == name_or_idx))
+
     # ------------------------------------------------------- autoscaling
 
     def demand_replicas(self) -> int:
@@ -790,15 +894,40 @@ class FleetRouter:
         depend on the backlog, not on how many replicas currently exist,
         or scale-out would raise its own demand signal). The floor is
         the number of BUSY replicas (scale-in only below actual use);
-        the ceiling is the autoscaler's call."""
+        the ceiling is the autoscaler's call.
+
+        Scaled-to-zero guard: with no admittable replica the EWMA
+        service rate has no live engine updating it, so the queue math
+        is pinned instead of trusted — any queued work or any arrival
+        recorded since the fleet emptied (the wake signal _pick stamps
+        before shedding) demands one replica, and only a truly idle
+        fleet demands zero (the scale-to-zero steady state). The signal
+        can therefore never return 0 while anything is waiting."""
         alive = self._alive()
-        busy = sum(1 for r in alive if r.depth() > 0)
-        per_replica = self._rate * self.ttft_slo_s
+        serving = [r for r in alive if not r.draining]
+        if not serving:
+            backlog = sum(r.pending_tokens() for r in alive)
+            return 1 if (self._wake_pending > 0 or backlog > 0) else 0
+        busy = sum(1 for r in serving if r.depth() > 0)
+        per_replica = (self.demand_tokens_per_replica
+                       or self._rate * self.ttft_slo_s)
         if per_replica <= 0.0:
             return max(1, busy)
         import math
 
         return max(1, busy, math.ceil(self.pending_tokens() / per_replica))
+
+    def wake_pending(self) -> int:
+        """Arrivals shed for want of ANY admittable replica since the
+        last clear — the scale-from-zero trigger the scaler consumes."""
+        with self._mu:
+            return self._wake_pending
+
+    def clear_wake(self) -> None:
+        """Scaler acknowledgment: capacity is being added for the
+        recorded arrivals (FleetScaler's scale-from-zero path)."""
+        with self._mu:
+            self._wake_pending = 0
 
     #: the burn-rate multiplier on demand is clamped here: a saturated
     #: (capped) burn must scale the fleet decisively, not to infinity

