@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo test-decode test-soak selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo test-decode test-soak test-pods selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -97,6 +97,17 @@ test-decode:
 # (docs/autoscaling.md)
 test-soak:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_soak.py -q -m soak
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
+
+# kftpu-pods suite: cross-process pod-backed replicas — real subprocess
+# workers behind the length-prefixed AF_UNIX wire protocol, the
+# digest-checked paged-KV handoff codec, SIGKILL mid-decode zero-drop
+# chain resume, SIGSTOP heartbeat-age hang indictment + scaler
+# replacement, torn-frame retry idempotency, end-to-end deadline
+# propagation, and the serve_pods cpu-proxy gate with its wire-fault
+# teeth (docs/serving.md "Pod-backed replicas")
+test-pods:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_pods.py -q -m pods
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 native:

@@ -22,6 +22,10 @@ real system fails at:
                                  that LOOKS hung), armed in-process via
                                  HeartbeatWriter.chaos or cross-process via
                                  the KFTPU_HB_DROP env carrier
+  - pod wire calls            -> connection resets, replies delayed past
+                                 the propagated deadline, torn/truncated
+                                 frames (the podclient transport — faults
+                                 no in-process kill can reach)
   - Checkpointer saves        -> fsync delays and torn writes (an atomic-
                                  rename checkpointer surfaces a torn write as
                                  a MISSING newest checkpoint, so injection
@@ -135,6 +139,22 @@ class HeartbeatDrop:
 
 
 @dataclass(frozen=True)
+class WireFault:
+    """Fault one pod-wire client call (serving/fleet/podclient.py):
+    kind='reset' closes the socket before the request goes out
+    (connection reset mid-stream -> redial + retry), kind='delay' stalls
+    the call by delay_s so a propagated Deadline expires in flight, and
+    kind='torn' truncates the reply frame mid-read (the length prefix
+    makes the tear detectable — PodWireError, never a resync). Each
+    matching call draws at `rate` until `count` injections are spent."""
+
+    kind: str = "reset"
+    rate: float = 0.5
+    delay_s: float = 0.0
+    count: int = 2
+
+
+@dataclass(frozen=True)
 class CheckpointFault:
     """save() faults: every save sleeps save_delay_s (slow fsync); every
     torn_every_n-th save is dropped after the delay (torn write under
@@ -161,6 +181,7 @@ class FaultPlan:
     start_stalls: tuple[StartStall, ...] = ()
     pod_hangs: tuple[PodHang, ...] = ()
     heartbeat_drops: tuple[HeartbeatDrop, ...] = ()
+    wire_faults: tuple[WireFault, ...] = ()
     checkpoint: CheckpointFault | None = None
 
     @classmethod
@@ -174,6 +195,8 @@ class FaultPlan:
           storage   — checkpoint faults only
           liveness  — hangs, heartbeat drops, restore-side corruption (the
                       failure modes only the health layer can catch)
+          wire      — pod-wire faults only (reset / delay / torn frame on
+                      the podclient transport)
         """
         rng = random.Random(f"kftpu-chaos-{profile}-{seed}")
         r = lambda lo, hi: round(rng.uniform(lo, hi), 4)  # noqa: E731
@@ -182,8 +205,21 @@ class FaultPlan:
         storage = profile in ("default", "storage")
         liveness = profile == "liveness"
         if profile not in ("default", "apiserver", "pods", "storage",
-                           "liveness"):
+                           "liveness", "wire"):
             raise ValueError(f"unknown chaos profile {profile!r}")
+        if profile == "wire":
+            return cls(
+                seed=seed,
+                wire_faults=(
+                    WireFault("reset", rate=r(0.3, 0.7),
+                              count=rng.randint(1, 3)),
+                    WireFault("delay", rate=r(0.2, 0.5),
+                              delay_s=r(0.05, 0.2),
+                              count=rng.randint(1, 2)),
+                    WireFault("torn", rate=r(0.3, 0.7),
+                              count=rng.randint(1, 3)),
+                ),
+            )
         if liveness:
             return cls(
                 seed=seed,
@@ -249,6 +285,8 @@ class FaultPlan:
             emit("pod-hang", s)
         for s in self.heartbeat_drops:
             emit("heartbeat-drop", s)
+        for s in self.wire_faults:
+            emit("wire-fault", s)
         if self.checkpoint is not None:
             emit("checkpoint", self.checkpoint)
         return "\n".join(lines) + "\n"
@@ -296,6 +334,9 @@ class ChaosEngine:
             "pod_failures_lost_races_total": 0,
             "start_stalls_total": 0,
             "hb_drops_total": 0,
+            "wire_resets_total": 0,
+            "wire_delays_total": 0,
+            "wire_torn_total": 0,
             "ckpt_saves_delayed_total": 0,
             "ckpt_saves_torn_total": 0,
             "ckpt_restores_corrupted_total": 0,
@@ -305,6 +346,7 @@ class ChaosEngine:
         self._delay_budget = {id(d): d.count for d in plan.event_delays}
         self._stall_budget = {id(s): s.count for s in plan.start_stalls}
         self._hb_budget = {id(h): h.count for h in plan.heartbeat_drops}
+        self._wire_budget = {id(w): w.count for w in plan.wire_faults}
         self._kills = [_KillState(k) for k in plan.pod_kills]
         self._hangs = [_KillState(h) for h in plan.pod_hangs]
         self._watch_counts: dict[int, int] = {}
@@ -611,6 +653,33 @@ class ChaosEngine:
                 self.metrics["hb_drops_total"] += 1
                 return True
         return False
+
+    # ------------------------------------------------- pod-wire hooks
+
+    def on_wire_op(self) -> "str | tuple[str, float] | None":
+        """Called by PodClient once per wire call. Returns None (clean),
+        'reset' (close the socket before sending), 'torn' (truncate the
+        reply mid-read), or ('delay', seconds) — stall the call so a
+        propagated deadline can expire in flight. Like env-carried
+        heartbeat drops, wire budgets never gate quiescent(): the retry
+        layer absorbs them asynchronously and drills assert on the
+        injection counters instead."""
+        with self._mu:
+            for w in self.plan.wire_faults:
+                if self._wire_budget.get(id(w), 0) <= 0:
+                    continue
+                if self.rng.random() >= w.rate:
+                    continue
+                self._wire_budget[id(w)] -= 1
+                if w.kind == "reset":
+                    self.metrics["wire_resets_total"] += 1
+                    return "reset"
+                if w.kind == "torn":
+                    self.metrics["wire_torn_total"] += 1
+                    return "torn"
+                self.metrics["wire_delays_total"] += 1
+                return ("delay", w.delay_s)
+        return None
 
     def pod_env(self, pod) -> dict[str, str]:
         """Extra env for a pod about to launch (PodRuntime._launch_pod):

@@ -192,6 +192,39 @@ def render_metrics(platform) -> str:
           max((s.cold_start_ewma_s for s in scalers), default=0.0),
           help_="EWMA of observed replica cold-start durations")
 
+    # pod-backed serving replicas (serving/fleet/podclient.py): the
+    # cross-process tier's lifecycle and wire-health ledger — spawns,
+    # kills (graceful and SIGKILL alike), retried/reset wire ops,
+    # deadline rejections, and the KV-handoff volume crossing the
+    # process boundary. Module-global like the ckpt-verify counters
+    # (pods outlive any one router) and ZERO-valued with no pod tier
+    # (KFTPU-METRIC contract).
+    from kubeflow_tpu.serving.fleet.podclient import (
+        pod_heartbeat_age_max_s,
+        pod_metrics_snapshot,
+    )
+
+    pod_help = {
+        "spawns_total": "pod worker processes launched (spawn_pod)",
+        "kills_total": "pod workers terminated — graceful kills, wire "
+                       "deaths, and real SIGKILLs alike",
+        "wire_retries_total": "pod wire ops retried under the backoff "
+                              "policy (resets, torn frames, 503 "
+                              "backpressure)",
+        "wire_resets_total": "pod wire connections torn down by fault "
+                             "injection (chaos WireFault)",
+        "deadline_rejects_total": "pod calls refused 504 — the "
+                                  "propagated deadline was spent on "
+                                  "arrival",
+        "handoff_bytes_total": "serialized paged-KV chain bytes that "
+                               "crossed a pod process boundary",
+    }
+    for mname, v in sorted(pod_metrics_snapshot().items()):
+        counter(f"kftpu_pod_{mname}", v, help_=pod_help.get(mname))
+    gauge("kftpu_pod_heartbeat_age_seconds", pod_heartbeat_age_max_s(),
+          help_="oldest live pod worker heartbeat age (the hang "
+                "watch's SIGSTOP signal); 0 with no live pods")
+
     # SLO burn-rate monitor (kubeflow_tpu/monitoring, docs/slo.md):
     # evaluation/alert counters, per-objective burn-rate and alert
     # gauges, and the TSDB's volume/loss accounting. A platform without

@@ -4,11 +4,15 @@ ROADMAP item 2 (serving at planetary scale): paged/block KV cache with
 prefix reuse (pagedkv.py), queue-depth-aware routing + SLO admission +
 replica-kill requeue across N ContinuousBatcher replicas (router.py),
 the seeded open-loop load-test harness (loadtest.py — the serving
-analogue of the chaos drills), and the closed autoscaling loop
+analogue of the chaos drills), the closed autoscaling loop
 (scaler.py: FleetScaler consumes the burn-aware demand signal —
-docs/autoscaling.md). Chunked prefill lives in the engine itself
-(serving/continuous.py `prefill_chunk`); the pool plugs in there via the
-engine's `paged_kv` parameter. docs/serving.md is the operator guide.
+docs/autoscaling.md), and cross-process pod-backed replicas
+(podworker.py / podclient.py over the wire.py protocol: each replica a
+real subprocess, killed with real signals, with the paged-KV handoff
+crossing the process boundary). Chunked prefill lives in the engine
+itself (serving/continuous.py `prefill_chunk`); the pool plugs in there
+via the engine's `paged_kv` parameter. docs/serving.md is the operator
+guide.
 """
 
 from kubeflow_tpu.serving.fleet.loadtest import (
@@ -31,9 +35,24 @@ from kubeflow_tpu.serving.fleet.router import (
     FleetRouter,
     Replica,
 )
+from kubeflow_tpu.serving.fleet.podclient import (
+    PodClient,
+    PodHandle,
+    attach_router_death,
+    pod_heartbeat_age_max_s,
+    pod_metrics_snapshot,
+    spawn_pod,
+    wire_pod_deaths,
+)
 from kubeflow_tpu.serving.fleet.scaler import (
     FleetScaler,
     ScalerConfig,
+)
+from kubeflow_tpu.serving.fleet.wire import (
+    PodCallError,
+    PodDead,
+    PodDeadlineExpired,
+    PodWireError,
 )
 
 __all__ = [
@@ -43,14 +62,25 @@ __all__ = [
     "FleetScaler",
     "LoadReport",
     "PagedKVPool",
+    "PodCallError",
+    "PodClient",
+    "PodDead",
+    "PodDeadlineExpired",
+    "PodHandle",
+    "PodWireError",
     "PrefixMatch",
     "Replica",
     "ScalerConfig",
     "SequenceChain",
+    "attach_router_death",
     "extract_prompt_kv",
     "make_prompts",
     "make_row_template",
+    "pod_heartbeat_age_max_s",
+    "pod_metrics_snapshot",
     "run_loadtest",
     "run_loadtest_sync",
     "seed_row_cache",
+    "spawn_pod",
+    "wire_pod_deaths",
 ]

@@ -1,0 +1,797 @@
+"""Pod client — the router-side engine facade over a subprocess pod.
+
+spawn_pod() launches ``python -m kubeflow_tpu.serving.fleet.podworker``
+and returns a PodClient that quacks exactly like the ContinuousBatcher
+surface the FleetRouter consumes (submit/tick/start/stop/_fail_all,
+`_queue`/`_rows`/`paged_kv`/counter mirrors) — so a Replica whose engine
+is a real subprocess is indistinguishable to the routing, requeue,
+autoscaling, and load-test layers. What changes is the failure model:
+
+  - every wire call rides utils/retry (BackoffPolicy + per-op Deadline
+    propagated in the envelope as REMAINING seconds; 503 replies honor
+    the worker's Retry-After hint via hinted_sleep); exhaustion — or a
+    vanished process — escalates to pod death;
+  - pod death fires `on_death` (wire_pod_deaths flips the Replica
+    under router._mu) and then fails every local handle, whose on_done
+    callbacks drive the router's zero-drop requeue exactly like an
+    in-process _fail_all;
+  - the paged-KV handoff crosses the process boundary: a prefill pod's
+    finished chain arrives serialized in its done event and is
+    re-inserted (digest-cross-checked) into the ROUTER-side home pool;
+    a decode-leg dispatch serializes the home chain into the submit
+    payload and KEEPS the home refs as the handle's recovery chain —
+    on pod death that surviving chain transfers to the handle, and the
+    router's token record resumes the decode with zero re-prefill.
+    The home pool is shared by every PodClient of a fleet, so the
+    router's resume-pool invariant holds unchanged.
+
+Locking: `_wire_mu` (socket) is a LEAF — nothing else is ever taken
+under it; `_tick_mu` serializes tick rounds and event dispatch and may
+reach router._mu through callbacks; `_lock` guards the handle table
+only. submit() runs UNDER router._mu, so its failure path never fires
+callbacks — it marks the pod quietly dead and raises PodDead for the
+router's dispatch loop to re-pick (death propagation happens after _mu
+is released).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.serving.fleet.wire import (
+    PodCallError,
+    PodDead,
+    PodDeadlineExpired,
+    PodWireError,
+    recv_frame,
+    send_frame,
+    serialize_chain,
+)
+from kubeflow_tpu.utils.envvars import (
+    ENV_POD_NAME,
+    ENV_POD_SOCKET,
+    ENV_POD_SPEC,
+)
+from kubeflow_tpu.utils.retry import (
+    BackoffPolicy,
+    Deadline,
+    hinted_sleep,
+    poll_until,
+    retry_call,
+)
+
+#: default wire retry shape: fast, bounded — exhaustion must surface as
+#: pod death within a few hundred ms, not park the dispatch path
+DEFAULT_WIRE_POLICY = BackoffPolicy(
+    base_s=0.02, max_s=0.25, multiplier=2.0, jitter=1.0, max_attempts=5)
+
+
+# ------------------------------------------------- kftpu_pod_* registry
+
+#: process-global pod metric families (observability.py renders them
+#: zero-valued-stable as kftpu_pod_*) — module-global like the
+#: checkpoint-verify counters in health.py: pods outlive any single
+#: router, and a dead pod's kill must stay counted after its client is
+#: garbage
+_POD_METRICS = {
+    "spawns_total": 0,
+    "kills_total": 0,
+    "wire_retries_total": 0,
+    "wire_resets_total": 0,
+    "deadline_rejects_total": 0,
+    "handoff_bytes_total": 0,
+}
+_POD_METRICS_MU = make_lock("fleet.pod_metrics._mu")
+#: live clients, for the heartbeat-age gauge (discarded on death)
+_LIVE_CLIENTS: list["PodClient"] = []
+
+
+def pod_metric_bump(name: str, n: int = 1) -> None:
+    with _POD_METRICS_MU:
+        _POD_METRICS[name] = _POD_METRICS.get(name, 0) + int(n)
+
+
+def pod_metrics_snapshot() -> dict[str, int]:
+    with _POD_METRICS_MU:
+        return dict(_POD_METRICS)
+
+
+def reset_pod_metrics() -> None:
+    """Test isolation (the golden-exposition reset path)."""
+    with _POD_METRICS_MU:
+        for k in _POD_METRICS:
+            _POD_METRICS[k] = 0
+        del _LIVE_CLIENTS[:]
+
+
+def pod_heartbeat_age_max_s() -> float:
+    """Oldest live pod heartbeat in seconds — 0.0 with no live pods or
+    no heartbeat contract armed (zero-valued-stable for /metrics)."""
+    with _POD_METRICS_MU:
+        clients = list(_LIVE_CLIENTS)
+    ages = [a for a in (c.heartbeat_age() for c in clients)
+            if a is not None]
+    return round(max(ages), 6) if ages else 0.0
+
+
+def _register_live(client: "PodClient") -> None:
+    with _POD_METRICS_MU:
+        if client not in _LIVE_CLIENTS:
+            _LIVE_CLIENTS.append(client)
+
+
+def _unregister_live(client: "PodClient") -> None:
+    with _POD_METRICS_MU:
+        if client in _LIVE_CLIENTS:
+            _LIVE_CLIENTS.remove(client)
+
+
+def _chain_payload_bytes(ser: dict) -> int:
+    """Approximate wire size of a serialized chain (the b64 bodies are
+    >99% of the frame) — the kftpu_pod_handoff_bytes_total unit."""
+    n = len(ser.get("ids", {}).get("b64", ""))
+    for spec in ser.get("kv", {}).values():
+        n += len(spec.get("b64", ""))
+    return n
+
+
+# ------------------------------------------------------------- handles
+
+
+class PodHandle:
+    """The client-side mirror of a worker _InFlight row: same streaming
+    and timing surface (the router's callbacks and the load-test
+    collector read these), fed from the pod's event stream."""
+
+    __slots__ = (
+        "slot", "request_id", "rid", "max_new_tokens", "tokens", "done",
+        "error", "t_submit", "t_first", "t_done", "on_token", "on_done",
+        "trace_ctx", "chain", "resumed", "recovery_chain",
+    )
+
+    def __init__(self, rid: str, max_new_tokens: int,
+                 on_token=None, on_done=None, trace_ctx=None,
+                 request_id: str = ""):
+        self.slot = -1
+        self.rid = rid
+        self.request_id = request_id
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens: list[int] = []
+        self.done = threading.Event()
+        self.error: str | None = None
+        self.t_submit = time.perf_counter()
+        self.t_first: float | None = None
+        self.t_done: float | None = None
+        self.on_token = on_token
+        self.on_done = on_done
+        self.trace_ctx = trace_ctx
+        #: a chain whose ownership transferred TO this handle (adopted
+        #: prefill handoff, or the recovery chain on pod death) — the
+        #: router's _on_done consumes or releases it
+        self.chain = None
+        self.resumed = False
+        #: the HOME-pool chain backing a decode-leg resume: held (not
+        #: released) until the pod finishes, so a SIGKILL mid-decode
+        #: still has the surviving blocks to resume from
+        self.recovery_chain = None
+
+    def push(self, tok: int) -> None:
+        if not self.tokens:
+            self.t_first = time.perf_counter()
+        self.tokens.append(int(tok))
+        if self.on_token is not None:
+            self.on_token(self, tok)
+
+    def finish(self, error: str | None = None) -> None:
+        if self.done.is_set():
+            return
+        self.error = error if self.error is None else self.error
+        self.t_done = time.perf_counter()
+        self.done.set()
+        if self.on_done is not None:
+            self.on_done(self)
+
+    @property
+    def ttft_s(self) -> float | None:
+        return None if self.t_first is None \
+            else self.t_first - self.t_submit
+
+    @property
+    def tokens_per_s(self) -> float | None:
+        if self.t_first is None or self.t_done is None:
+            return None
+        dt = self.t_done - self.t_first
+        return len(self.tokens) / dt if dt > 0 else float("inf")
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.error is not None:
+            raise RuntimeError(f"generation failed: {self.error}")
+        return np.asarray(self.tokens, np.int32)
+
+
+# -------------------------------------------------------------- client
+
+
+class PodClient:
+    """Engine facade over one worker process (see module docstring)."""
+
+    def __init__(self, name: str, socket_path: str, *,
+                 proc: "subprocess.Popen | None" = None,
+                 heartbeat_path: str | None = None,
+                 stderr_path: str | None = None,
+                 policy: BackoffPolicy | None = None,
+                 op_timeout_s: float = 30.0,
+                 ticks_per_call: int = 1,
+                 chaos=None):
+        self.name = name
+        self.socket_path = socket_path
+        self.proc = proc
+        self.heartbeat_path = heartbeat_path
+        self.stderr_path = stderr_path
+        self.policy = policy or DEFAULT_WIRE_POLICY
+        self.op_timeout_s = float(op_timeout_s)
+        self.ticks_per_call = max(int(ticks_per_call), 1)
+        self.chaos = chaos
+        self._rng = random.Random(f"kftpu-pod-{name}")
+        # --- engine facade surface the Replica/router reads
+        self._queue: list = []          # always empty: rows seat remotely
+        self._rows: list[PodHandle] = []
+        self._lock = make_lock("fleet.PodClient._lock")
+        self.paged_kv = None            # the router-side HOME pool
+        self.tracer = None
+        self.tsdb = None
+        self._fleet_managed = False
+        self.step_count = 0
+        self.prefill_tokens_total = 0
+        self.prefill_tokens_reused = 0
+        self.default_max_new_tokens = 32
+        self.eos_token_id: tuple[int, ...] | None = None
+        self.worker_pid: int | None = None
+        # --- wire state
+        self._wire_mu = make_lock("fleet.PodClient._wire_mu")
+        self._tick_mu = make_lock("fleet.PodClient._tick_mu")
+        self._sock: socket.socket | None = None
+        self._seq = 0
+        self._acked = 0
+        self._rid_counter = 0
+        self._by_rid: dict[str, PodHandle] = {}
+        self._worker_depth = 0
+        # --- death state
+        self.dead = False
+        self.dead_reason: str | None = None
+        self._death_propagated = False
+        self.on_death = None
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --------------------------------------------------------- wire ops
+
+    def _close_socket(self) -> None:
+        s, self._sock = self._sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _ensure_conn(self, timeout_s: float) -> socket.socket:
+        if self._sock is None:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(timeout_s)
+            try:
+                s.connect(self.socket_path)
+            except OSError as e:
+                s.close()
+                raise PodWireError(f"connect failed: {e}") from e
+            self._sock = s
+        else:
+            self._sock.settimeout(timeout_s)
+        return self._sock
+
+    def _attempt(self, verb: str, payload: dict,
+                 deadline: Deadline | None, timeout_s: float) -> dict:
+        if self.dead:
+            raise PodDead(self.dead_reason or f"pod {self.name} dead")
+        fault = self.chaos.on_wire_op() if self.chaos is not None \
+            else None
+        if isinstance(fault, tuple):  # ("delay", s): stall in flight
+            # deliberately unclamped by the deadline — the fault MODELS
+            # a stall that overshoots the budget, so the envelope's
+            # remaining_s goes non-positive and the worker 504s
+            hinted_sleep(fault[1])
+        with self._wire_mu:
+            if fault == "reset":
+                self._close_socket()
+                pod_metric_bump("wire_resets_total")
+                raise PodWireError("chaos: connection reset")
+            self._seq += 1
+            env = {"verb": verb, "seq": self._seq,
+                   "deadline_s": (deadline.remaining()
+                                  if deadline is not None else None)}
+            env.update(payload)
+            try:
+                sock = self._ensure_conn(timeout_s)
+                send_frame(sock, env)
+                if fault == "torn":
+                    # truncate the reply mid-read, then drop the
+                    # connection: exactly the partial frame the length
+                    # prefix exists to detect
+                    sock.recv(2)
+                    self._close_socket()
+                    raise PodWireError("chaos: torn frame")
+                reply = recv_frame(sock)
+            except OSError as e:
+                self._close_socket()
+                raise PodWireError(f"{type(e).__name__}: {e}") from e
+            except PodWireError:
+                self._close_socket()
+                raise
+            if int(reply.get("seq", -1)) != self._seq:
+                self._close_socket()
+                raise PodWireError(
+                    f"reply seq {reply.get('seq')} != {self._seq}")
+        if reply.get("ok"):
+            return reply
+        code = int(reply.get("code", 500))
+        if code == 503:
+            # server-side backpressure: honor Retry-After within the
+            # caller's budget, then let the retry layer re-dial
+            if hinted_sleep(float(reply.get("retry_after_s", 0.05)),
+                            cap_s=1.0, deadline=deadline):
+                raise PodWireError("503 overloaded (retry-after taken)")
+            raise PodDeadlineExpired(
+                "503 overloaded and no budget left for Retry-After")
+        if code == 504:
+            pod_metric_bump("deadline_rejects_total")
+            raise PodDeadlineExpired(reply.get("error", "deadline"))
+        raise PodCallError(code, reply.get("error", "pod call failed"))
+
+    def call(self, verb: str, payload: dict | None = None, *,
+             deadline: Deadline | None = None,
+             timeout_s: float | None = None) -> dict:
+        """One wire verb under the retry policy. Raises PodWireError on
+        exhausted transport faults, PodDeadlineExpired on a spent
+        budget, PodCallError on an application refusal, PodDead once
+        the pod is marked dead."""
+        attempts = 0
+        t = timeout_s if timeout_s is not None else self.op_timeout_s
+
+        def attempt():
+            nonlocal attempts
+            attempts += 1
+            return self._attempt(verb, dict(payload or {}), deadline, t)
+
+        try:
+            out = retry_call(attempt, policy=self.policy,
+                             retry_on=(PodWireError,), rng=self._rng)
+        except PodWireError:
+            # exhaustion escalating to pod death: accounted by
+            # kills_total, not as N "absorbed" retries — the
+            # wire_retries family counts only faults the retry layer
+            # actually rode through (the serve_pods gate pins it 0 on a
+            # healthy tree, >0 under the WireFault chaos)
+            raise
+        if attempts > 1:
+            pod_metric_bump("wire_retries_total", attempts - 1)
+        return out
+
+    # ---------------------------------------------------------- spawn
+
+    def connect(self, timeout_s: float = 180.0) -> "PodClient":
+        """Wait for the worker's socket (bound only after its in-process
+        warmup) and complete the hello handshake."""
+
+        def ready():
+            if self.proc is not None and self.proc.poll() is not None:
+                raise PodDead(
+                    f"pod {self.name} exited rc={self.proc.returncode} "
+                    f"before ready (stderr: {self.stderr_path})")
+            return True if os.path.exists(self.socket_path) else None
+
+        poll_until(ready, timeout_s=timeout_s,
+                   describe=f"pod {self.name} socket")
+        hello = self.call("hello", timeout_s=max(self.op_timeout_s, 10.0))
+        self.worker_pid = int(hello["pid"])
+        self.default_max_new_tokens = int(
+            hello["default_max_new_tokens"])
+        eos = hello.get("eos_token_id")
+        self.eos_token_id = tuple(int(t) for t in eos) if eos else None
+        _register_live(self)
+        return self
+
+    @property
+    def pid(self) -> int | None:
+        if self.worker_pid is not None:
+            return self.worker_pid
+        return self.proc.pid if self.proc is not None else None
+
+    def heartbeat_age(self) -> float | None:
+        """Seconds since the worker's last liveness beat (None without a
+        heartbeat contract or before the first beat) — what the
+        scaler's hang watch consumes: a SIGSTOPped pod stays alive and
+        connected but this age grows without bound."""
+        if self.heartbeat_path is None:
+            return None
+        from kubeflow_tpu.health import read_heartbeat
+
+        hb = read_heartbeat(self.heartbeat_path)
+        if hb is None:
+            return None
+        return max(time.time() - hb.ts, 0.0)
+
+    # -------------------------------------------------- engine facade
+
+    def submit(self, prompt_ids, max_new_tokens: int | None = None,
+               eos_token_id=None, temperature: float = 0.0,
+               key=None, on_token=None, on_done=None,
+               trace_ctx=None, request_id: str = "",
+               keep_chain: bool = False, resume_from=None) -> PodHandle:
+        """Mirror of ContinuousBatcher.submit over the wire. Runs UNDER
+        router._mu on the dispatch path: a wire failure here must not
+        fire callbacks (the router holds its own lock) — the pod is
+        marked quietly dead and PodDead raised; the router's dispatch
+        loop re-picks and propagates the death after releasing _mu."""
+        if self.dead:
+            raise PodDead(self.dead_reason or f"pod {self.name} dead")
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        budget = int(max_new_tokens or self.default_max_new_tokens)
+        with self._lock:
+            self._rid_counter += 1
+            rid = f"{self.name}-{self._rid_counter}"
+        eos = eos_token_id
+        if eos is not None and not isinstance(eos, (int, np.integer)):
+            eos = [int(t) for t in np.asarray(eos).reshape(-1)]
+        elif eos is not None:
+            eos = int(eos)
+        payload = {
+            "rid": rid,
+            "prompt": [int(t) for t in ids],
+            "max_new_tokens": budget,
+            "eos": eos,
+            "temperature": float(temperature),
+            "keep_chain": bool(keep_chain),
+            "resume": None,
+        }
+        handle = PodHandle(rid, budget, on_token=on_token,
+                           on_done=on_done, trace_ctx=trace_ctx,
+                           request_id=request_id)
+        if resume_from is not None:
+            chain, toks = resume_from
+            if chain.frozen:
+                raise ValueError("cannot resume from a frozen chain")
+            if self.paged_kv is not None \
+                    and chain.pool is not self.paged_kv:
+                raise ValueError(
+                    "resume chain lives in a different pool than this "
+                    "pod's home pool")
+            ser = serialize_chain(chain.pool, chain.refs)
+            payload["resume"] = {"chain": ser,
+                                 "tokens": [int(t) for t in toks]}
+            pod_metric_bump("handoff_bytes_total",
+                            _chain_payload_bytes(ser))
+            # the zero-drop collateral: the HOME chain stays held on
+            # the handle — a pod death mid-decode transfers it back to
+            # the router's requeue instead of losing the blocks
+            handle.recovery_chain = chain
+            handle.tokens = [int(t) for t in toks]  # pre-fed, no cbs
+            handle.resumed = True
+            handle.t_first = time.perf_counter()
+        try:
+            self.call("submit", payload)
+        except (PodWireError, PodDead, OSError) as e:
+            self._quiet_dead(f"wire failure during submit: {e}")
+            raise PodDead(
+                f"pod {self.name} died during submit: {e}") from e
+        except PodCallError as e:
+            if e.code == 409 and resume_from is not None:
+                # resume refusal (frozen on re-insert in the worker
+                # pool): release the recovery hold and fall back to
+                # scratch via the router's requeue arithmetic
+                handle.recovery_chain = None
+                resume_from[0].release()
+            raise
+        with self._lock:
+            self._by_rid[rid] = handle
+            self._rows = self._rows + [handle]
+        return handle
+
+    def tick(self) -> bool:
+        """One tick round-trip: drive the worker's engine, drain its
+        event outbox (deduped by cumulative ack — a redelivered event
+        after a torn frame is skipped, never double-pushed), mirror its
+        counters. Event callbacks run OUTSIDE every client lock."""
+        if self.dead:
+            return False
+        with self._tick_mu:
+            if self.dead:
+                return False
+            try:
+                reply = self.call(
+                    "tick",
+                    {"ack": self._acked, "n": self.ticks_per_call})
+            except (PodWireError, OSError) as e:
+                self._mark_dead(f"wire failure during tick: {e}")
+                return False
+            except PodDead:
+                self._propagate_death()
+                return False
+            self.step_count = int(
+                reply.get("step_count", self.step_count))
+            self.prefill_tokens_total = int(
+                reply.get("prefill_tokens_total",
+                          self.prefill_tokens_total))
+            self.prefill_tokens_reused = int(
+                reply.get("prefill_tokens_reused",
+                          self.prefill_tokens_reused))
+            self._worker_depth = int(reply.get("depth", 0))
+            events = [e for e in reply.get("events", ())
+                      if int(e.get("id", 0)) > self._acked]
+            if events:
+                self._acked = int(events[-1]["id"])
+            for ev in events:
+                self._apply_event(ev)
+            if reply.get("tick_error"):
+                # poisoned engine: its _fail_all events just drained
+                # above; the process itself is now useless — reap it
+                self._mark_dead(
+                    f"worker engine poisoned: {reply['tick_error']}")
+                return False
+            return bool(reply.get("busy")) or bool(self._rows)
+
+    def _apply_event(self, ev: dict) -> None:
+        h = self._by_rid.get(str(ev.get("rid", "")))
+        if h is None or h.done.is_set():
+            return
+        if ev.get("ev") == "token":
+            h.push(int(ev["tok"]))
+            return
+        if ev.get("ev") != "done":
+            return
+        # reconcile: the done event's token list is authoritative; any
+        # suffix the stream hasn't delivered yet (lost with a torn
+        # frame, redelivered here) pushes now
+        final = [int(t) for t in ev.get("tokens", ())]
+        for tok in final[len(h.tokens):]:
+            h.push(tok)
+        error = ev.get("error")
+        if error is None and ev.get("chain") is not None \
+                and self.paged_kv is not None:
+            from kubeflow_tpu.serving.fleet.wire import deserialize_chain
+
+            try:
+                h.chain = deserialize_chain(self.paged_kv, ev["chain"])
+                pod_metric_bump("handoff_bytes_total",
+                                _chain_payload_bytes(ev["chain"]))
+            except (PodWireError, KeyError, ValueError):
+                h.chain = None  # integrity refusal → scratch fallback
+        if error is None and h.recovery_chain is not None:
+            # the resumed decode finished — the home-pool hold served
+            # its purpose
+            h.recovery_chain.release()
+            h.recovery_chain = None
+        if error is not None:
+            self._transfer_recovery(h)
+        with self._lock:
+            self._by_rid.pop(h.rid, None)
+            self._rows = [r for r in self._rows if r is not h]
+        h.finish(error=error)
+
+    def _transfer_recovery(self, h: PodHandle) -> None:
+        """A failing handle's home-pool recovery chain transfers to
+        `h.chain` when the router's requeue is listening (the same
+        conditions ContinuousBatcher._fail_all applies) — otherwise the
+        hold releases so blocks never leak."""
+        chain, h.recovery_chain = h.recovery_chain, None
+        if chain is None:
+            return
+        if h.on_done is not None and self._fleet_managed \
+                and not chain.frozen and h.chain is None:
+            h.chain = chain
+        else:
+            chain.release()
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self) -> "PodClient":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name=f"pod-client-{self.name}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop_evt.is_set():
+            busy = self.tick()
+            if self.dead:
+                return
+            if not busy:
+                self._stop_evt.wait(0.002)
+
+    def stop(self) -> None:
+        """Stop the client ticker thread. Does NOT kill the pod — the
+        router's kill path continues into _fail_all, and a drill's
+        clean shutdown uses kill()/drain() explicitly."""
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Tick until the worker and the local handle table are empty.
+        True on drained; False when the budget ran out first."""
+        deadline = Deadline(timeout_s)
+        while not self.dead:
+            self.tick()
+            with self._lock:
+                local = len(self._rows)
+            if local == 0 and self._worker_depth == 0:
+                return True
+            if deadline.expired():
+                return False
+        return False
+
+    def kill(self, timeout_s: float = 5.0) -> None:
+        """Graceful shutdown: ask the worker to exit, reap, mark dead
+        quietly (no requeue callbacks — drain first if rows matter)."""
+        try:
+            self.call("kill", timeout_s=timeout_s)
+        except (PodWireError, PodDead, PodDeadlineExpired,
+                PodCallError, OSError):
+            pass
+        self._quiet_dead("killed (graceful)")
+
+    # ------------------------------------------------------------ death
+
+    def _kill_process(self) -> None:
+        p = self.proc
+        if p is None:
+            return
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        try:
+            p.wait(timeout=5.0)
+        except (subprocess.TimeoutExpired, OSError):
+            pass
+
+    def _quiet_dead(self, reason: str) -> bool:
+        """Flip dead, close the wire, reap the process — NO callbacks
+        (safe under router._mu). Returns True on the first flip."""
+        with self._lock:
+            if self.dead:
+                return False
+            self.dead, self.dead_reason = True, reason
+        self._stop_evt.set()
+        self._close_socket()
+        self._kill_process()
+        _unregister_live(self)
+        pod_metric_bump("kills_total")
+        return True
+
+    def _propagate_death(self) -> None:
+        """Fire on_death (the Replica alive flip) then fail every local
+        handle — their on_done callbacks drive the router requeue.
+        Must be called with NO client or router locks held."""
+        with self._lock:
+            if self._death_propagated or not self.dead:
+                return
+            self._death_propagated = True
+        if self.on_death is not None:
+            self.on_death(self)
+        self._fail_local(self.dead_reason or "pod died")
+
+    def _fail_local(self, reason: str) -> None:
+        with self._lock:
+            rows, self._rows = self._rows, []
+            self._by_rid = {}
+        for h in rows:
+            self._transfer_recovery(h)
+            h.finish(error=reason)
+
+    def _mark_dead(self, reason: str) -> None:
+        self._quiet_dead(reason)
+        self._propagate_death()
+
+    def _fail_all(self, reason: str) -> None:
+        """The router's kill_replica contract (after its alive flip):
+        terminate the pod and requeue everything it carried."""
+        self._quiet_dead(reason)
+        self._propagate_death()
+
+
+# ----------------------------------------------------------- fleet glue
+
+
+def attach_router_death(client: PodClient, router) -> None:
+    """Wire a pod's death to its Replica: flip alive under router._mu
+    (by engine identity — survives renames and scaler replacements) so
+    _pick and the tick loops exclude the corpse before the requeue
+    callbacks start re-dispatching."""
+
+    def on_death(c):
+        with router._mu:
+            for rep in router.replicas:
+                if rep.engine is c and rep.alive:
+                    rep.alive = False
+                    router.metrics["replica_kills_total"] += 1
+                    break
+
+    client.on_death = on_death
+
+
+def wire_pod_deaths(router) -> None:
+    """attach_router_death over every current PodClient replica."""
+    for rep in router.replicas:
+        if isinstance(rep.engine, PodClient):
+            attach_router_death(rep.engine, router)
+
+
+def spawn_pod(name: str, spec: dict, state_dir: str, *,
+              home_pool=None, policy: BackoffPolicy | None = None,
+              op_timeout_s: float = 30.0, chaos=None,
+              startup_timeout_s: float = 240.0,
+              env_extra: dict | None = None,
+              connect: bool = True) -> PodClient:
+    """Launch one worker subprocess and return its connected client.
+
+    The pod env contract rides os.environ (KFTPU_TRACE_DIR /
+    KFTPU_TRACEPARENT pass through untouched, so worker spans land in
+    the same trace dir the controller merges) plus the pod's own
+    socket/name/spec variables and a per-pod heartbeat file; stderr
+    goes to `<state_dir>/<name>.stderr.log` for post-mortems."""
+    os.makedirs(state_dir, exist_ok=True)
+    spec_path = os.path.join(state_dir, f"{name}.spec.json")
+    with open(spec_path, "w", encoding="utf-8") as fh:
+        json.dump(spec, fh)
+    sock_path = os.path.join(state_dir, f"{name}.sock")
+    try:
+        os.unlink(sock_path)
+    except OSError:
+        pass
+    hb_path = os.path.join(state_dir, f"{name}.hb")
+    stderr_path = os.path.join(state_dir, f"{name}.stderr.log")
+    from kubeflow_tpu.utils.envvars import ENV_HEARTBEAT_FILE
+
+    env = dict(os.environ)
+    env[ENV_POD_SOCKET] = sock_path
+    env[ENV_POD_NAME] = name
+    env[ENV_POD_SPEC] = spec_path
+    env[ENV_HEARTBEAT_FILE] = hb_path
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    with open(stderr_path, "ab") as errf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "kubeflow_tpu.serving.fleet.podworker"],
+            env=env, stdin=subprocess.DEVNULL,
+            stdout=errf, stderr=errf)
+    pod_metric_bump("spawns_total")
+    client = PodClient(name, sock_path, proc=proc,
+                       heartbeat_path=hb_path, stderr_path=stderr_path,
+                       policy=policy, op_timeout_s=op_timeout_s,
+                       chaos=chaos)
+    client.paged_kv = home_pool
+    if connect:
+        try:
+            client.connect(timeout_s=startup_timeout_s)
+        except BaseException:
+            client._quiet_dead("startup failed")
+            raise
+    return client

@@ -1,0 +1,400 @@
+"""Pod worker — one ContinuousBatcher behind an AF_UNIX wire socket.
+
+``python -m kubeflow_tpu.serving.fleet.podworker`` is the serving tier's
+real process boundary: the fleet spawns one of these per replica
+(podclient.spawn_pod), each hosting its own model, paged-KV pool, and
+engine, reachable only through the length-prefixed JSON protocol in
+wire.py. The worker is deliberately SINGLE-THREADED — one connection,
+one verb at a time, engine ticks driven by the client's `tick` verb —
+so the process owns no locks and a SIGKILL can never leave a
+half-updated shared structure behind; all cross-request state the
+router needs to survive a kill lives on the CLIENT side (the router's
+token record), which is exactly the zero-drop contract.
+
+Env contract (utils/envvars.py): KFTPU_POD_SOCKET (bind path),
+KFTPU_POD_NAME (trace service / heartbeat identity), KFTPU_POD_SPEC
+(JSON engine spec), plus the existing pod contract — KFTPU_TRACE_DIR /
+KFTPU_TRACEPARENT ride through tracing.init_worker_from_env so a dead
+pod's spans still land in /debug/trace, and KFTPU_HEARTBEAT_FILE arms
+the per-tick liveness beat the router's hang watch consumes (SIGSTOP =
+alive-but-silent, detectable only by heartbeat age).
+
+Delivery reliability: every token/done event enters a monotonic-id
+OUTBOX and is re-sent on every tick reply until the client's cumulative
+ack prunes it — a torn frame or connection reset loses no tokens, it
+just redelivers (the client dedups by event id). Submits are idempotent
+by request id for the same reason. Backpressure is HTTP-shaped: a full
+queue answers 503 with retry_after_s, an expired propagated deadline
+answers 504 — the client's retry policy (utils/retry) honors both.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import time
+
+from kubeflow_tpu.serving.fleet.wire import (
+    PodWireError,
+    error_reply,
+    ok_reply,
+    recv_frame,
+    send_frame,
+    serialize_chain,
+)
+from kubeflow_tpu.utils.envvars import (
+    ENV_POD_NAME,
+    ENV_POD_SOCKET,
+    ENV_POD_SPEC,
+)
+
+
+class PodServer:
+    """The worker-side protocol state machine around one engine."""
+
+    def __init__(self, name: str, spec: dict, tracer=None):
+        self.name = name
+        self.spec = spec
+        self.tracer = tracer
+        self._events: list[dict] = []        # outbox, pruned by acks
+        self._next_event_id = 1
+        self._seen_rids: set[str] = set()    # submit idempotency
+        self._dying: str | None = None       # poisoned-engine reason
+        self.engine, self.pool = self._build_engine()
+        from kubeflow_tpu.health import HeartbeatWriter
+
+        self.hb = HeartbeatWriter.from_env()
+        self._warmup()
+
+    # ------------------------------------------------------------ build
+
+    def _build_engine(self):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+        from kubeflow_tpu.serving.continuous import ContinuousBatcher
+        from kubeflow_tpu.serving.fleet.pagedkv import PagedKVPool
+
+        spec = self.spec
+        cfg = GPTConfig(**spec["model"])
+        model = GPTLM(cfg)
+        # deterministic weights from the spec's init seed and a FIXED
+        # init shape: every pod of a fleet builds byte-identical
+        # parameters from the spec alone — no weight shipping
+        variables = jax.jit(model.init)(
+            jax.random.PRNGKey(int(spec.get("init_seed", 0))),
+            jnp.zeros((1, min(8, cfg.max_len)), jnp.int32))
+        pool_spec = spec.get("pool") or {}
+        pool = PagedKVPool(
+            block_size=int(pool_spec.get("block_size", 8)),
+            capacity_blocks=int(pool_spec.get("capacity_blocks", 1024)))
+        eng = ContinuousBatcher(
+            model, variables,
+            max_rows=int(spec.get("max_rows", 4)),
+            default_max_new_tokens=int(
+                spec.get("default_max_new_tokens", 32)),
+            eos_token_id=spec.get("eos_token_id"),
+            seed=int(spec.get("seed", 0)),
+            prefill_chunk=int(spec.get("prefill_chunk", 0)),
+            paged_kv=pool,
+            block_budget=bool(spec.get("block_budget", False)),
+            max_chunks_per_tick=int(spec.get("max_chunks_per_tick", 1)),
+            tracer=(self.tracer
+                    if getattr(self.tracer, "enabled", False) else None),
+        )
+        repeats = int(spec.get("chaos_decode_repeats", 1))
+        if repeats > 1:
+            # the cpu-proxy gate's decode chaos, armed in-process from
+            # the spec (NOT the env: the controller decides per fleet)
+            from kubeflow_tpu.profiling.cpu_proxy import _arm_decode_chaos
+
+            _arm_decode_chaos([eng], repeats)
+        return eng, pool
+
+    def _warmup(self) -> None:
+        """Compile every executable the serve phase dispatches BEFORE
+        the socket goes live — the gate measures serving, not XLA."""
+        import numpy as np
+
+        prompts = self.spec.get("warmup_prompts") or []
+        new_toks = int(self.spec.get("warmup_new_tokens", 2))
+        repeats = int(self.spec.get("warmup_repeats", 2))
+        for prompt in prompts:
+            ids = np.asarray(prompt, np.int32)
+            for _ in range(max(repeats, 1)):
+                self.engine.submit(ids, max_new_tokens=new_toks)
+                self.engine.run_until_idle()
+        if self.spec.get("warmup_resume") and prompts:
+            # the decode-leg shapes: keep_chain retire (chain-append
+            # extraction window) and the resume-admission splice — every
+            # handoff dispatch hits both, so compile them before the
+            # socket goes live
+            ids = np.asarray(prompts[0], np.int32)
+            req = self.engine.submit(ids, max_new_tokens=new_toks,
+                                     keep_chain=True)
+            self.engine.run_until_idle()
+            chain = getattr(req, "chain", None)
+            if chain is not None and not chain.frozen:
+                keep = int(chain.length) - int(ids.size) + 1
+                if 0 < keep <= len(req.tokens) and keep < new_toks:
+                    req.chain = None
+                    self.engine.submit(
+                        ids, max_new_tokens=new_toks,
+                        resume_from=(chain, [int(t) for t
+                                             in req.tokens[:keep]]))
+                    self.engine.run_until_idle()
+                else:
+                    chain.release()
+                    req.chain = None
+
+    # ----------------------------------------------------------- events
+
+    def _emit(self, ev: dict) -> None:
+        ev["id"] = self._next_event_id
+        self._next_event_id += 1
+        self._events.append(ev)
+
+    def _on_token(self, req, tok: int) -> None:
+        self._emit({"ev": "token", "rid": req.request_id,
+                    "tok": int(tok)})
+
+    def _on_done(self, req) -> None:
+        ev = {
+            "ev": "done",
+            "rid": req.request_id,
+            "error": req.error,
+            "tokens": [int(t) for t in req.tokens],
+            "resumed": bool(req.resumed),
+            "ttft_s": req.ttft_s,
+            "tps": req.tokens_per_s,
+            "chain": None,
+        }
+        chain = getattr(req, "chain", None)
+        if chain is not None and chain.refs and not chain.frozen:
+            # keep_chain retire: the finished chain crosses the wire as
+            # serialized blocks; the local refs release immediately —
+            # the payload carries everything the adopter needs
+            ev["chain"] = serialize_chain(self.pool, chain.refs)
+        if chain is not None:
+            chain.release()
+            req.chain = None
+        self._emit(ev)
+
+    # ------------------------------------------------------------ verbs
+
+    def handle(self, env: dict) -> dict:
+        seq = int(env.get("seq", 0))
+        verb = env.get("verb", "")
+        deadline_s = env.get("deadline_s")
+        if deadline_s is not None and float(deadline_s) <= 0.0:
+            return error_reply(seq, 504,
+                               f"deadline expired before {verb!r}")
+        fn = getattr(self, f"_verb_{verb}", None)
+        if fn is None:
+            return error_reply(seq, 400, f"unknown verb {verb!r}")
+        try:
+            return fn(seq, env)
+        except Exception as e:  # noqa: BLE001 — protocol boundary
+            return error_reply(seq, 500, f"{type(e).__name__}: {e}")
+
+    def _verb_hello(self, seq: int, env: dict) -> dict:
+        eng = self.engine
+        return ok_reply(
+            seq, name=self.name, pid=os.getpid(),
+            default_max_new_tokens=eng.default_max_new_tokens,
+            eos_token_id=(list(eng.eos_token_id)
+                          if eng.eos_token_id else None),
+            block_size=self.pool.block_size)
+
+    def _depth(self) -> int:
+        eng = self.engine
+        return (len(eng._queue)
+                + sum(1 for r in eng._rows if r is not None))
+
+    def _verb_submit(self, seq: int, env: dict) -> dict:
+        import numpy as np
+
+        from kubeflow_tpu.serving.fleet.wire import deserialize_chain
+
+        if self._dying is not None:
+            return error_reply(seq, 500,
+                               f"engine poisoned: {self._dying}")
+        rid = str(env.get("rid", ""))
+        if rid and rid in self._seen_rids:
+            # redelivery after a torn ack: the original submit landed
+            return ok_reply(seq, dup=True, depth=self._depth())
+        max_queue = int(self.spec.get("max_queue", 0))
+        if max_queue and len(self.engine._queue) >= max_queue:
+            return error_reply(seq, 503, "queue full",
+                               retry_after_s=0.05)
+        resume = None
+        if env.get("resume") is not None:
+            chain = deserialize_chain(self.pool, env["resume"]["chain"])
+            if chain.frozen:
+                # the receiving pool could not cover every position
+                # (covered-by-sibling) — refuse rather than resume on
+                # silently wrong K/V; the client falls back to scratch
+                chain.release()
+                return error_reply(
+                    seq, 409, "resume chain frozen on re-insert")
+            resume = (chain, [int(t) for t in env["resume"]["tokens"]])
+        req = self.engine.submit(
+            np.asarray(env["prompt"], np.int32),
+            max_new_tokens=env.get("max_new_tokens"),
+            eos_token_id=env.get("eos"),
+            temperature=float(env.get("temperature", 0.0)),
+            on_token=self._on_token,
+            on_done=self._on_done,
+            request_id=rid,
+            keep_chain=bool(env.get("keep_chain", False)),
+            resume_from=resume)
+        # request_id normally only sticks under an armed tracer; the
+        # event stream is keyed by it, so pin it unconditionally
+        req.request_id = rid
+        if rid:
+            self._seen_rids.add(rid)
+        return ok_reply(seq, depth=self._depth())
+
+    def _verb_tick(self, seq: int, env: dict) -> dict:
+        ack = int(env.get("ack", 0))
+        if ack:
+            self._events = [e for e in self._events if e["id"] > ack]
+        busy = False
+        n = max(int(env.get("n", 1)), 1)
+        if self._dying is None:
+            try:
+                for _ in range(n):
+                    busy = self.engine.tick()
+                    if not busy:
+                        break
+            except Exception as e:  # noqa: BLE001 — poisoned engine
+                self._dying = f"{type(e).__name__}: {e}"
+                self.engine._fail_all(
+                    f"worker tick failed: {self._dying}")
+                busy = False
+        if self.hb is not None:
+            self.hb.beat(step=self.engine.step_count, phase="serve")
+        if self.tracer is not None and getattr(self.tracer, "enabled",
+                                               False):
+            from kubeflow_tpu.tracing.core import flush
+
+            # idempotent per-pid file: a SIGKILL between flushes loses
+            # at most one tick batch of spans, never the file
+            flush(self.tracer)
+        eng = self.engine
+        return ok_reply(
+            seq, events=list(self._events), busy=busy,
+            depth=self._depth(), step_count=eng.step_count,
+            prefill_tokens_total=eng.prefill_tokens_total,
+            prefill_tokens_reused=eng.prefill_tokens_reused,
+            tick_error=self._dying)
+
+    def _verb_drain(self, seq: int, env: dict) -> dict:
+        return ok_reply(seq, depth=self._depth())
+
+    def _verb_heartbeat(self, seq: int, env: dict) -> dict:
+        if self.hb is not None:
+            self.hb.beat(step=self.engine.step_count, phase="serve")
+        return ok_reply(seq, pid=os.getpid())
+
+    def _verb_kill(self, seq: int, env: dict) -> dict:
+        return ok_reply(seq, dying=True)
+
+    # ------------------------------------------------------------ serve
+
+    def serve(self, sock_path: str) -> None:
+        try:
+            os.unlink(sock_path)
+        except OSError:
+            pass
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(sock_path)
+        srv.listen(1)
+        if self.hb is not None:
+            self.hb.beat(step=0, phase="serve")
+        while True:
+            conn, _addr = srv.accept()
+            try:
+                self._serve_conn(conn)
+            except (PodWireError, OSError):
+                pass  # client went away: re-accept (the client redials)
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        while True:
+            env = recv_frame(conn)
+            reply = self.handle(env)
+            send_frame(conn, reply)
+            if reply.get("dying"):
+                if (self.tracer is not None
+                        and getattr(self.tracer, "enabled", False)):
+                    from kubeflow_tpu.tracing.core import flush
+
+                    flush(self.tracer)
+                conn.close()
+                os._exit(0)
+
+
+def _arm_orphan_watchdog() -> None:
+    """A pod must never outlive its spawner. The client process owns the
+    lifecycle, but a SIGKILLed spawner (a timed-out test runner, an OOM
+    kill) runs no teardown — without this, the worker parks on accept()
+    forever. PR_SET_PDEATHSIG asks the kernel to SIGKILL this process
+    the moment the spawning thread exits; Linux-only, best-effort."""
+    if sys.platform != "linux":
+        return
+    try:
+        import ctypes
+        import signal as _signal
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, _signal.SIGKILL, 0, 0, 0)  # 1 = PR_SET_PDEATHSIG
+    except (OSError, AttributeError, TypeError):
+        return
+    # close the arming race: the parent may have died between fork and
+    # prctl, in which case we are already reparented and no signal comes
+    if os.getppid() == 1:
+        os._exit(0)
+
+
+def main() -> int:
+    _arm_orphan_watchdog()
+    # the axon sitecustomize force-registers the TPU plugin in every
+    # interpreter; a config update (which wins over env) is required to
+    # actually get CPU (same reasoning as tests/conftest.py)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    name = os.environ.get(ENV_POD_NAME, "pod")
+    sock_path = os.environ[ENV_POD_SOCKET]
+    with open(os.environ[ENV_POD_SPEC], encoding="utf-8") as fh:
+        spec = json.load(fh)
+    if spec.get("compile_cache_dir"):
+        # inference-only programs are safe under the persistent cache
+        # (the tests/conftest.py corruption vector needs a resumed fit
+        # loop) and every pod of a fleet compiles the SAME executables
+        from kubeflow_tpu.utils.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache(spec["compile_cache_dir"])
+    from kubeflow_tpu.tracing.core import init_worker_from_env
+
+    tracer = init_worker_from_env(service=name)
+    t0 = time.perf_counter()
+    server = PodServer(name, spec, tracer=tracer)
+    print(f"[podworker {name}] ready in {time.perf_counter() - t0:.2f}s "
+          f"pid={os.getpid()}", file=sys.stderr, flush=True)
+    server.serve(sock_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.serving.fleet.wire import PodCallError, PodDead
 from kubeflow_tpu.tracing.core import armed_tracer, current_context
 
 #: EWMA weight of each completed request's observed decode rate
@@ -537,26 +538,61 @@ class FleetRouter:
             # resume): ownership of the chain passes to the engine
             chain, resume_tokens = freq.chain, list(freq.tokens)
             kwargs["resume_from"] = (chain, resume_tokens)
-        with self._mu:
-            rep = self._pick(freq.stage)
-            freq.replica = rep.name
-            if not handoff:
-                # a handoff is one lifetime split across tiers, not a
-                # retry — attempts stays the requeue odometer
-                freq.attempts += 1
-            if freq._tracer is not None:
-                freq._tracer.event(
-                    "fleet.dispatch", parent=freq.trace_ctx,
-                    replica=rep.name, attempt=freq.attempts,
-                    stage=freq.stage or "full",
-                    request_id=freq.request_id)
-            rep.engine.submit(
-                freq.prompt, on_token=partial(self._on_token, freq),
-                on_done=partial(self._on_done, freq),
-                trace_ctx=freq.trace_ctx, request_id=freq.request_id,
-                **kwargs)
-            if chain is not None:
-                freq.chain = None  # the engine owns it now
+        # pod-backed replicas can die INSIDE submit (the wire fails
+        # before the request ever seats): the admission-window gap the
+        # in-process ordering comment above cannot cover. The dispatch
+        # loop absorbs it under _mu — flip the corpse, re-pick a
+        # survivor — and propagates the death (requeue callbacks for
+        # whatever the corpse carried) only after _mu is released,
+        # because those callbacks re-enter this very lock.
+        corpses = []
+        try:
+            with self._mu:
+                if not handoff:
+                    # a handoff is one lifetime split across tiers, not
+                    # a retry — attempts stays the requeue odometer
+                    freq.attempts += 1
+                while True:
+                    rep = self._pick(freq.stage)
+                    freq.replica = rep.name
+                    if freq._tracer is not None:
+                        freq._tracer.event(
+                            "fleet.dispatch", parent=freq.trace_ctx,
+                            replica=rep.name, attempt=freq.attempts,
+                            stage=freq.stage or "full",
+                            request_id=freq.request_id)
+                    try:
+                        rep.engine.submit(
+                            freq.prompt,
+                            on_token=partial(self._on_token, freq),
+                            on_done=partial(self._on_done, freq),
+                            trace_ctx=freq.trace_ctx,
+                            request_id=freq.request_id, **kwargs)
+                    except PodDead:
+                        rep.alive = False
+                        self.metrics["replica_kills_total"] += 1
+                        corpses.append(rep.engine)
+                        continue
+                    break
+                if chain is not None:
+                    freq.chain = None  # the engine owns it now
+        except PodCallError as exc:
+            if exc.code != 409 or chain is None:
+                raise
+            # resume refused by the worker (chain frozen on re-insert —
+            # the receiving pool could not cover every position): the
+            # client already released the home chain; fall back to a
+            # whole-lifetime scratch dispatch, same as the frozen-chain
+            # path in _on_done. `delivered` keeps the stream single-copy
+            # across the re-decode.
+            freq.chain = None
+            freq.tokens = []
+            freq.t_first = None
+            freq.stage = "prefill" if self.disaggregated else ""
+            self._dispatch(freq, handoff=True)
+        finally:
+            for eng in corpses:
+                eng._propagate_death()
 
     # --------------------------------------------- engine-thread callbacks
 

@@ -83,6 +83,13 @@ class ScalerConfig:
     #: evaluations a work-holding replica may sit without engine step
     #: progress before it is declared hung and killed
     hang_detect_evals: int = 6
+    #: wall-clock heartbeat-age ceiling for pod-backed replicas, seconds
+    #: (0 = disabled). An engine exposing heartbeat_age() whose worker
+    #: has not beaten for longer than this while holding work is
+    #: indicted IMMEDIATELY — a SIGSTOPped pod keeps its socket and its
+    #: mirrored step_count frozen, so only the worker-side beat exposes
+    #: it faster than hang_detect_evals' stall count
+    heartbeat_max_age_s: float = 0.0
     #: replicas added per scale-up decision at most (the step bound the
     #: BURN_DEMAND_CAP multiplier is clamped against)
     max_step_up: int = 2
@@ -377,6 +384,21 @@ class FleetScaler:
             self._progress[rep.name] = (steps, stalled)
             if stalled >= cfg.hang_detect_evals:
                 suspects.append((rep, stalled))
+            elif cfg.heartbeat_max_age_s > 0.0:
+                # pod-backed liveness: the worker beats per tick verb;
+                # an age past the ceiling with work seated means the
+                # PROCESS is wedged (SIGSTOP, hard page stall) even
+                # though the wire and the mirrored counters look merely
+                # idle. A fresh beat, conversely, is live evidence for
+                # the peer-progress guard below.
+                age_fn = getattr(rep.engine, "heartbeat_age", None)
+                age = age_fn() if callable(age_fn) else None
+                if age is None:
+                    pass
+                elif age <= cfg.heartbeat_max_age_s:
+                    advanced = True
+                elif rep.depth() > 0:
+                    suspects.append((rep, stalled))
         # the straggler contract (health.py's gang-median, fleet
         # edition): a stalled replica is indicted only against PEER
         # progress — some other replica advanced this pass — or when it

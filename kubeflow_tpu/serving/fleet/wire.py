@@ -1,0 +1,220 @@
+"""Pod wire protocol — framing, envelopes, and the KV-chain handoff codec.
+
+The pod tier (podworker.py / podclient.py) speaks length-prefixed JSON
+over a local AF_UNIX socket: a 4-byte big-endian length followed by one
+UTF-8 JSON object per frame (NDJSON semantics — one object per message —
+with an explicit length prefix so a torn TCP-style partial read is
+DETECTABLE instead of silently resynchronized). This module is the
+transport's pure half: no sockets are owned here, no jax is imported —
+the router can import the exception types without dragging a worker
+runtime into its process.
+
+Envelope contract (client -> worker):
+
+    {"verb": str, "seq": int, "deadline_s": float|null, ...payload}
+
+`deadline_s` is the REMAINING budget at send time (a wall-clock instant
+would not survive clock skew between processes; a remaining-seconds
+relative deadline is what gRPC propagates for the same reason). The
+worker re-anchors it on receipt and rejects already-expired work with a
+504-shaped error reply instead of burning ticks on an answer nobody is
+waiting for.
+
+Reply contract (worker -> client):
+
+    {"seq": int, "ok": true,  ...result}
+    {"seq": int, "ok": false, "code": int, "error": str,
+     "retry_after_s": float?}        # 503 carries Retry-After
+
+Chain handoff codec: a finished prefill chain crosses the process
+boundary as its token ids + per-leaf K/V (base64 of the raw array
+bytes) + the pool's OWN content digests for every block + a sha256 over
+the arrays. Deserialization re-inserts into the receiving pool and then
+cross-checks the refs the local insert produced against the refs the
+sender claimed — the chain digests are content-derived
+(sha1(parent + ids)), so any corruption of ids in flight shows up as a
+digest mismatch even before the sha256 of the K/V bytes is consulted.
+This is the PR-3 checkpoint-manifest discipline applied to the KV path.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+from typing import Any
+
+import numpy as np
+
+#: frame length prefix: 4-byte big-endian unsigned
+_LEN = struct.Struct(">I")
+
+#: hard per-frame ceiling — a corrupt length prefix must not convince the
+#: reader to allocate gigabytes (chains of the test/proxy models are KB-MB)
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+class PodWireError(RuntimeError):
+    """A wire-level failure talking to a pod: connection reset, torn
+    frame, truncated read. Retryable by policy — the client redials and
+    retries; exhaustion escalates to pod death."""
+
+
+class PodDead(RuntimeError):
+    """The pod is gone (process exited, marked dead, or retries
+    exhausted). Deliberately NOT a PodWireError: the client's
+    retry_on=(PodWireError,) must never spin against a corpse — the
+    router re-picks a replica instead."""
+
+
+class PodDeadlineExpired(RuntimeError):
+    """The propagated deadline was already spent when the worker saw
+    the envelope (a 504-shaped reply). Not retryable: the budget is
+    gone no matter how healthy the wire is."""
+
+
+class PodCallError(RuntimeError):
+    """An application-level refusal from the worker (bad verb, poisoned
+    engine, resume-chain refusal). Carries the reply's `code`; not
+    retryable at the transport layer."""
+
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = int(code)
+
+
+# ------------------------------------------------------------- framing
+
+
+def send_frame(sock: socket.socket, obj: dict) -> int:
+    """Serialize `obj` and write one length-prefixed frame; returns the
+    frame size in bytes (header included). Raises OSError on a dead
+    socket — callers wrap transport faults into PodWireError."""
+    data = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    sock.sendall(_LEN.pack(len(data)) + data)
+    return _LEN.size + len(data)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly `n` bytes or raise PodWireError: a short read IS the
+    torn-frame condition the length prefix exists to expose."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise PodWireError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> dict:
+    """Read one length-prefixed JSON frame. PodWireError on EOF, torn
+    frame, oversized length, or undecodable payload."""
+    (n,) = _LEN.unpack(recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME_BYTES:
+        raise PodWireError(f"frame length {n} exceeds {MAX_FRAME_BYTES}")
+    data = recv_exact(sock, n)
+    try:
+        obj = json.loads(data.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise PodWireError(f"undecodable frame: {e}") from e
+    if not isinstance(obj, dict):
+        raise PodWireError("frame payload is not an object")
+    return obj
+
+
+# --------------------------------------------------------- chain codec
+
+
+def _b64(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    return {
+        "shape": list(a.shape),
+        "dtype": str(a.dtype),
+        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def _unb64(spec: dict) -> np.ndarray:
+    raw = base64.b64decode(spec["b64"])
+    return np.frombuffer(raw, dtype=np.dtype(spec["dtype"])).reshape(
+        spec["shape"]).copy()
+
+
+def _payload_sha256(ids: np.ndarray, kv: dict[str, np.ndarray]) -> str:
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(ids, np.int32).tobytes())
+    for path in sorted(kv):
+        h.update(path.encode("utf-8"))
+        h.update(np.ascontiguousarray(kv[path]).tobytes())
+    return h.hexdigest()
+
+
+def serialize_chain(pool, refs: list[bytes]) -> dict:
+    """Serialize a HELD chain (the caller keeps its refs until the
+    receiver confirms adoption) into a JSON-safe dict: ids + per-leaf
+    K/V + the pool's content digests + a sha256 over the raw bytes."""
+    ids, kv = pool.gather(refs)
+    return {
+        "n": int(ids.size),
+        "ids": _b64(np.asarray(ids, np.int32)),
+        "kv": {path: _b64(a) for path, a in kv.items()},
+        "refs": [d.hex() for d in refs],
+        "sha256": _payload_sha256(ids, kv),
+    }
+
+
+def deserialize_chain(pool, payload: dict):
+    """Re-insert a serialized chain into `pool` and return a
+    SequenceChain holding the produced refs.
+
+    Integrity is checked twice: the sha256 over the decoded arrays must
+    match the sender's, and — when the local insert covered every
+    position — the content digests the local pool produced must equal
+    the digests the sender claimed (they are the same sha1 chain over
+    the same ids, so inequality means corruption, not divergence). An
+    insert that stops early (covered-by-sibling / partial-parent in the
+    receiving pool) yields a FROZEN chain, which the engine's resume
+    validation rejects — the requeue then falls back to scratch, never
+    to silently wrong K/V. Raises PodWireError on integrity failure."""
+    from kubeflow_tpu.serving.fleet.pagedkv import SequenceChain
+
+    ids = _unb64(payload["ids"])
+    if ids.size != int(payload["n"]):
+        raise PodWireError(
+            f"chain length mismatch: {ids.size} ids vs n={payload['n']}")
+    kv = {path: _unb64(spec) for path, spec in payload["kv"].items()}
+    got = _payload_sha256(ids, kv)
+    if got != payload["sha256"]:
+        raise PodWireError(
+            f"chain payload sha256 mismatch ({got[:12]} != "
+            f"{str(payload['sha256'])[:12]})")
+    held = pool.insert(ids, kv)
+    chain = SequenceChain(pool, held, expect_length=int(payload["n"]))
+    if not chain.frozen:
+        claimed = list(payload.get("refs", ()))
+        if claimed and [d.hex() for d in held] != claimed:
+            chain.release()
+            raise PodWireError("chain digest mismatch after re-insert")
+    return chain
+
+
+# ----------------------------------------------------------- envelopes
+
+
+def error_reply(seq: int, code: int, msg: str,
+                retry_after_s: float | None = None) -> dict:
+    rep: dict[str, Any] = {"seq": seq, "ok": False,
+                           "code": int(code), "error": str(msg)}
+    if retry_after_s is not None:
+        rep["retry_after_s"] = float(retry_after_s)
+    return rep
+
+
+def ok_reply(seq: int, **result) -> dict:
+    rep: dict[str, Any] = {"seq": seq, "ok": True}
+    rep.update(result)
+    return rep

@@ -41,6 +41,12 @@ ENV_PROFILE_DIR = "KFTPU_PROFILE_DIR"
 ENV_COMPILE_CACHE_DIR = "KFTPU_COMPILE_CACHE_DIR"
 #: tfevents scalar output dir for TensorBoard
 ENV_EVENT_DIR = "KFTPU_EVENT_DIR"
+#: AF_UNIX socket path a serving pod worker binds (podworker/podclient)
+ENV_POD_SOCKET = "KFTPU_POD_SOCKET"
+#: the pod worker's replica name (trace service, heartbeat identity)
+ENV_POD_NAME = "KFTPU_POD_NAME"
+#: path to the JSON engine spec a pod worker builds its batcher from
+ENV_POD_SPEC = "KFTPU_POD_SPEC"
 
 # ------------------------------------------------------------- platform state
 

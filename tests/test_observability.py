@@ -90,11 +90,13 @@ class TestGoldenExposition:
         # this pins the same fresh-process surface regardless of which
         # tests ran first
         from kubeflow_tpu.parallel.partitioner import reset_comm_metrics
+        from kubeflow_tpu.serving.fleet.podclient import reset_pod_metrics
 
         reset_ckpt_verify_metrics()
         reset_loader_metrics()
         reset_compile_metrics()
         reset_comm_metrics()
+        reset_pod_metrics()
         p = Platform(log_dir=str(tmp_path / "logs"))
         p.start_tracing(capacity=4096)
         text = render_metrics(p)
@@ -109,6 +111,10 @@ class TestGoldenExposition:
             "kftpu_health_stragglers_declared_total",
             "kftpu_ckpt_verify_steps_quarantined_total",
             "kftpu_ckpt_verify_fallback_restores_total",
+            "kftpu_pod_spawns_total",
+            "kftpu_pod_wire_retries_total",
+            "kftpu_pod_handoff_bytes_total",
+            "kftpu_pod_heartbeat_age_seconds",
         ):
             assert needle in text, needle
         if os.environ.get("KFTPU_UPDATE_GOLDEN"):

@@ -1,0 +1,450 @@
+"""kftpu-pods suite — cross-process pod-backed serving replicas
+(kubeflow_tpu/serving/fleet/{wire,podworker,podclient}.py, docs/serving.md
+"Pod-backed replicas").
+
+Every replica here is a REAL subprocess: a podworker hosting one
+ContinuousBatcher behind the length-prefixed AF_UNIX wire protocol. The
+drills cover the full failure matrix the tier ships with — SIGKILL
+mid-decode (zero drops, chain-resume rescue), SIGSTOP (heartbeat-age hang
+indictment and scaler replacement), torn frames (retry + submit
+idempotency), deadline propagation (504 across the wire), the
+admission-window kill (a pod dying between admission and seating), and
+the digest-checked paged-KV handoff codec. Runs under the lock-order
+detector (conftest arms it for the `pods` marker).
+
+Workers share the repo-local persistent compile cache (the conftest
+inference-cache reasoning applies: pure inference, no fit loop), so the
+N subprocess spawns in this file compile the tiny-GPT programs once.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.fleet import (
+    FleetRouter,
+    PagedKVPool,
+    make_prompts,
+    run_loadtest_sync,
+    spawn_pod,
+    wire_pod_deaths,
+)
+from kubeflow_tpu.serving.fleet.podclient import (
+    attach_router_death,
+    pod_metrics_snapshot,
+)
+from kubeflow_tpu.serving.fleet.scaler import FleetScaler, ScalerConfig
+from kubeflow_tpu.serving.fleet.wire import (
+    PodDeadlineExpired,
+    PodWireError,
+    deserialize_chain,
+    serialize_chain,
+)
+from kubeflow_tpu.utils.retry import Deadline
+
+pytestmark = pytest.mark.pods
+
+VOCAB = 64
+PROMPT = 4
+PREFIX = 2
+NEW = 4
+
+#: the conftest inference compile cache — workers are fresh processes,
+#: so without it every spawn in this file recompiles the same programs
+_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".kubeflow_tpu", "test-compile-cache")
+
+
+def _spec(**over) -> dict:
+    warm = make_prompts(1, seed=99, vocab=VOCAB, prompt_len=PROMPT,
+                        shared_prefix=PREFIX)
+    spec = {
+        "model": {"vocab_size": VOCAB, "hidden_size": 32, "num_layers": 1,
+                  "num_heads": 2, "mlp_dim": 64, "dropout_rate": 0.0,
+                  "max_len": PREFIX + PROMPT + NEW + 24},
+        "seed": 0, "init_seed": 7, "max_rows": 2,
+        "default_max_new_tokens": NEW, "eos_token_id": None,
+        "prefill_chunk": 0,
+        "pool": {"block_size": 4, "capacity_blocks": 256},
+        "warmup_prompts": [[int(t) for t in p] for p in warm],
+        "warmup_new_tokens": NEW, "warmup_repeats": 1,
+        "warmup_resume": True,
+        "compile_cache_dir": _CACHE_DIR,
+        "max_queue": 64,
+    }
+    spec.update(over)
+    return spec
+
+
+def _run_to_done(client, handles, timeout_s: float = 60.0) -> None:
+    deadline = Deadline(timeout_s)
+    while any(not h.done.is_set() for h in handles):
+        client.tick()
+        assert not deadline.expired(), "pod never finished the handles"
+
+
+@pytest.fixture(scope="module")
+def state_dir(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("pods"))
+
+
+@pytest.fixture(scope="module")
+def pod(state_dir):
+    """One long-lived worker shared by the non-destructive drills."""
+    home = PagedKVPool(block_size=4, capacity_blocks=256)
+    c = spawn_pod("shared-0", _spec(), state_dir, home_pool=home)
+    yield c
+    c.kill(timeout_s=5.0)
+
+
+def _prompt(seed: int) -> np.ndarray:
+    return make_prompts(1, seed=seed, vocab=VOCAB, prompt_len=PROMPT,
+                        shared_prefix=PREFIX)[0]
+
+
+class TestChainCodec:
+    """The digest-keyed handoff serialization — pure, no subprocess."""
+
+    def _chain_material(self, n: int, seed: int = 3):
+        rng = np.random.default_rng(seed)
+        ids = rng.integers(1, VOCAB, size=n).astype(np.int32)
+        kv = {"l0/k": rng.standard_normal((n, 2, 4)).astype(np.float32),
+              "l0/v": rng.standard_normal((n, 2, 4)).astype(np.float32)}
+        return ids, kv
+
+    def test_round_trip_bit_exact(self):
+        src = PagedKVPool(block_size=4, capacity_blocks=64)
+        dst = PagedKVPool(block_size=4, capacity_blocks=64)
+        ids, kv = self._chain_material(10)
+        refs = src.insert(ids, kv)
+        ser = serialize_chain(src, refs)
+        chain = deserialize_chain(dst, ser)
+        assert not chain.frozen and chain.length == 10
+        got_ids, got_kv = dst.gather(chain.refs)
+        np.testing.assert_array_equal(got_ids, ids)
+        for path in kv:
+            np.testing.assert_array_equal(got_kv[path], kv[path])
+        # the receiving pool re-derived the SAME content digests the
+        # sender claimed — the cross-process identity the router's
+        # adoption-by-digest relies on
+        assert [d.hex() for d in chain.refs] == ser["refs"]
+        chain.release()
+
+    def test_corrupt_payload_refused(self):
+        src = PagedKVPool(block_size=4, capacity_blocks=64)
+        ids, kv = self._chain_material(10)
+        ser = serialize_chain(src, src.insert(ids, kv))
+        # flip one byte of one K/V leaf: sha256 over the raw arrays
+        torn = {**ser, "kv": {**ser["kv"]}}
+        path = sorted(torn["kv"])[0]
+        b64 = torn["kv"][path]["b64"]
+        torn["kv"][path] = {**torn["kv"][path],
+                            "b64": ("A" if b64[0] != "A" else "B")
+                            + b64[1:]}
+        with pytest.raises(PodWireError):
+            deserialize_chain(PagedKVPool(4, 64), torn)
+        # a tampered digest list is caught even when the bytes verify
+        lied = {**ser, "refs": ["00" * 20] + ser["refs"][1:]}
+        with pytest.raises(PodWireError):
+            deserialize_chain(PagedKVPool(4, 64), lied)
+
+    def test_partial_insert_yields_frozen_chain(self):
+        """A receiving pool already holding a LONGER partial with the
+        same content prefix stops the re-insert early: the codec must
+        hand back a FROZEN chain (the engine's resume validation then
+        refuses it → scratch fallback), never silently-wrong K/V."""
+        src = PagedKVPool(block_size=4, capacity_blocks=64)
+        dst = PagedKVPool(block_size=4, capacity_blocks=64)
+        ids, kv = self._chain_material(10)  # 2 full blocks + 2-pos tail
+        ser = serialize_chain(src, src.insert(ids, kv))
+        longer_ids = np.concatenate([ids, ids[:1]])  # 3-pos tail sibling
+        longer_kv = {p: np.concatenate([a, a[:1]]) for p, a in kv.items()}
+        held = dst.insert(longer_ids, longer_kv)
+        chain = deserialize_chain(dst, ser)
+        assert chain.frozen
+        chain.release()
+        dst.release(held)
+
+
+class TestPodLifecycle:
+    def test_spawn_serve_deterministic(self, pod):
+        """hello handshake happened (pid, defaults), greedy decode is
+        reproducible across submits, counters mirror the worker."""
+        assert pod.worker_pid is not None and pod.worker_pid > 0
+        assert pod.default_max_new_tokens == NEW
+        p = _prompt(11)
+        h1 = pod.submit(p, max_new_tokens=NEW)
+        _run_to_done(pod, [h1])
+        assert h1.error is None and len(h1.tokens) == NEW
+        h2 = pod.submit(p, max_new_tokens=NEW)
+        _run_to_done(pod, [h2])
+        assert h2.tokens == h1.tokens  # greedy + seeded init weights
+        assert pod.step_count > 0
+        assert pod.prefill_tokens_total > 0
+        assert pod._queue == [] and pod._rows == []
+        assert pod.heartbeat_age() is not None
+        assert pod.heartbeat_age() < 30.0
+
+    def test_deadline_propagates_to_worker_504(self, pod):
+        """A spent Deadline rides the envelope; the WORKER refuses with
+        504 and the client surfaces PodDeadlineExpired + the metric —
+        budget enforcement is end-to-end, not client-side guesswork."""
+        base = pod_metrics_snapshot()["deadline_rejects_total"]
+        d = Deadline(1e-9)
+        time.sleep(0.01)
+        with pytest.raises(PodDeadlineExpired):
+            pod.call("heartbeat", deadline=d)
+        assert pod_metrics_snapshot()["deadline_rejects_total"] == base + 1
+        # the pod is fine — only the budget was refused
+        assert pod.call("heartbeat")["ok"]
+
+    def test_torn_frame_retried_submit_idempotent(self, pod):
+        """A reply torn mid-frame (send landed, read truncated) is
+        retried by the wire policy; the worker dedupes the re-sent rid
+        so the row seats ONCE and the decode emits exactly its budget —
+        the redelivery-not-duplication half of the outbox contract."""
+
+        class OneTear:
+            def __init__(self):
+                self.left = 1
+
+            def on_wire_op(self):
+                if self.left:
+                    self.left -= 1
+                    return "torn"
+                return None
+
+        base = pod_metrics_snapshot()["wire_retries_total"]
+        pod.chaos = OneTear()
+        try:
+            h = pod.submit(_prompt(12), max_new_tokens=NEW)
+        finally:
+            pod.chaos = None
+        _run_to_done(pod, [h])
+        assert h.error is None
+        assert len(h.tokens) == NEW  # seated once, never twice
+        assert pod_metrics_snapshot()["wire_retries_total"] > base
+
+    def test_chain_handoff_resume_across_pods(self, pod, state_dir):
+        """The cross-process rescue primitive end-to-end: pod A decodes
+        with keep_chain, its chain crosses the wire into the HOME pool,
+        and pod B resumes from it — token-identical to A's own run."""
+        p = _prompt(13)
+        straight = pod.submit(p, max_new_tokens=NEW)
+        _run_to_done(pod, [straight])
+        base = pod_metrics_snapshot()["handoff_bytes_total"]
+        h = pod.submit(p, max_new_tokens=NEW, keep_chain=True)
+        _run_to_done(pod, [h])
+        assert h.chain is not None and not h.chain.frozen
+        assert h.chain.pool is pod.paged_kv  # adopted into the HOME pool
+        assert pod_metrics_snapshot()["handoff_bytes_total"] > base
+        other = spawn_pod("resume-1", _spec(), state_dir,
+                          home_pool=pod.paged_kv)
+        try:
+            keep = int(h.chain.length) - int(p.size) + 1
+            assert 0 < keep <= len(h.tokens)
+            r = other.submit(p, max_new_tokens=NEW,
+                             resume_from=(h.chain, h.tokens[:keep]))
+            _run_to_done(other, [r])
+            assert r.error is None and r.resumed
+            assert r.tokens == straight.tokens
+        finally:
+            other.kill(timeout_s=5.0)
+
+    def test_drain_then_reap(self, state_dir):
+        """Graceful teardown: drain ticks until the worker AND the local
+        handle table are empty, then kill reaps the process."""
+        c = spawn_pod("drain-0", _spec(), state_dir,
+                      home_pool=PagedKVPool(4, 64))
+        hs = [c.submit(_prompt(20 + i), max_new_tokens=NEW)
+              for i in range(3)]
+        assert c.drain(timeout_s=60.0)
+        for h in hs:
+            assert h.done.is_set() and h.error is None
+            assert len(h.tokens) == NEW
+        c.kill(timeout_s=5.0)
+        assert c.dead
+        assert c.proc.poll() is not None  # reaped, not orphaned
+
+    def test_orphaned_worker_reaped_on_spawner_death(self, tmp_path):
+        """A SIGKILLed spawner runs no teardown (a timed-out test
+        runner, an OOM kill) — the worker's kernel pdeathsig watchdog
+        must reap it anyway, never leaving a parked pod behind."""
+        import json
+        import subprocess
+        import sys
+
+        from kubeflow_tpu.utils.envvars import (
+            ENV_POD_NAME,
+            ENV_POD_SOCKET,
+            ENV_POD_SPEC,
+        )
+
+        spec_path = tmp_path / "spec.json"
+        spec_path.write_text(json.dumps(_spec()))
+        # an intermediary interpreter spawns the worker then exits at
+        # once: the worker is orphaned before it even finishes importing
+        launcher = (
+            "import os, subprocess, sys\n"
+            "env = dict(os.environ)\n"
+            f"env[{ENV_POD_SPEC!r}] = {str(spec_path)!r}\n"
+            f"env[{ENV_POD_SOCKET!r}] = {str(tmp_path / 'w.sock')!r}\n"
+            f"env[{ENV_POD_NAME!r}] = 'orphan-0'\n"
+            "env['JAX_PLATFORMS'] = 'cpu'\n"
+            "p = subprocess.Popen([sys.executable, '-m',"
+            " 'kubeflow_tpu.serving.fleet.podworker'], env=env,"
+            " stderr=subprocess.DEVNULL)\n"
+            "print(p.pid, flush=True)\n"
+        )
+        out = subprocess.run([sys.executable, "-c", launcher],
+                             capture_output=True, text=True, timeout=60)
+        worker_pid = int(out.stdout.strip())
+        deadline = Deadline(30.0)
+        while True:
+            try:
+                os.kill(worker_pid, 0)
+            except ProcessLookupError:
+                break  # reaped by the kernel, as armed
+            if deadline.expired():
+                os.kill(worker_pid, signal.SIGKILL)
+                pytest.fail("orphaned worker outlived its spawner")
+            time.sleep(0.1)
+
+
+class TestRouterIntegration:
+    def test_sigkill_mid_decode_zero_drop_chain_resume(self, state_dir):
+        """The acceptance drill in miniature (the full gated version is
+        the serve_pods cpu-proxy workload): prefill pod + two decode
+        pods behind the router, one decode pod SIGKILLed by PID
+        mid-run. Zero drops; at least one requeue rescued by resuming
+        the home-pool chain instead of re-decoding from scratch."""
+        home = PagedKVPool(block_size=4, capacity_blocks=512)
+        spec = _spec()
+        roles = (("pf-0", "prefill"), ("dc-0", "decode"),
+                 ("dc-1", "decode"))
+        clients = [spawn_pod(n, spec, state_dir, home_pool=home,
+                             connect=False) for n, _r in roles]
+        try:
+            for c in clients:
+                c.connect()
+            router = FleetRouter([(c.name, c, role)
+                                  for c, (_n, role) in zip(clients, roles)])
+            wire_pod_deaths(router)
+            victim = clients[1]
+            prompts = make_prompts(6, seed=31, vocab=VOCAB,
+                                   prompt_len=PROMPT, shared_prefix=PREFIX)
+            killed = {"done": False}
+
+            def on_tick(tick, _rtr):
+                if not killed["done"] and tick >= 3:
+                    killed["done"] = True
+                    os.kill(victim.worker_pid, signal.SIGKILL)
+
+            report = run_loadtest_sync(
+                router, prompts, seed=31, mean_gap_ticks=1.0,
+                new_tokens=NEW, kill_replica=None, on_tick=on_tick)
+            rs = report.summary()
+            assert killed["done"]
+            assert rs["dropped"] == 0
+            assert rs["completed"] == len(prompts)
+            assert rs["requeued"] >= 1
+            assert rs["resumed"] >= 1  # chain rescue, not scratch
+            (vrep,) = [r for r in router.replicas
+                       if r.engine is victim]
+            assert not vrep.alive
+            assert router.metrics["replica_kills_total"] >= 1
+            assert router.metrics["prefill_handoffs_total"] == len(prompts)
+        finally:
+            for c in clients:
+                c.kill(timeout_s=2.0)
+
+    def test_admission_window_kill_repicks(self, state_dir):
+        """The regression ISSUE 16 names: a pod dying BETWEEN admission
+        and seating (the router picked it; the submit hits a corpse).
+        The dispatch loop must flip the replica, re-pick a survivor
+        under the same admission, and lose nothing — not raise out of
+        submit, not leak the request."""
+        home = PagedKVPool(block_size=4, capacity_blocks=256)
+        spec = _spec()
+        clients = [spawn_pod(n, spec, state_dir, home_pool=home,
+                             connect=False) for n in ("adm-0", "adm-1")]
+        try:
+            for c in clients:
+                c.connect()
+            router = FleetRouter([(c.name, c) for c in clients])
+            wire_pod_deaths(router)
+            # the kill lands in the admission window: the process dies
+            # NOW, the client only discovers it inside router.submit
+            os.kill(clients[0].worker_pid, signal.SIGKILL)
+            reqs = [router.submit(_prompt(40 + i), max_new_tokens=NEW)
+                    for i in range(4)]
+            survivor = clients[1]
+            deadline = Deadline(60.0)
+            while any(not r.done.is_set() for r in reqs):
+                survivor.tick()
+                assert not deadline.expired()
+            for r in reqs:
+                assert r.error is None
+                assert r.result(timeout=1).size == NEW
+            assert router.metrics["requests_failed_total"] == 0
+            (corpse,) = [r for r in router.replicas
+                         if r.engine is clients[0]]
+            assert not corpse.alive
+        finally:
+            for c in clients:
+                c.kill(timeout_s=2.0)
+
+    def test_sigstop_hang_indicted_by_heartbeat_and_replaced(
+            self, state_dir):
+        """SIGSTOP is the failure SIGKILL drills can't see: the process
+        keeps its socket and its mirrored counters — only the
+        per-tick heartbeat stops. The scaler's hang watch (ScalerConfig
+        .heartbeat_max_age_s) must indict the wedged pod by beat age,
+        kill it, spawn a replacement through engine_factory, and the
+        requeued request must complete on the replacement."""
+        home = PagedKVPool(block_size=4, capacity_blocks=256)
+        spec = _spec()
+        a = spawn_pod("stop-0", spec, state_dir, home_pool=home)
+        router = FleetRouter([(a.name, a)])
+        wire_pod_deaths(router)
+        spawned = []
+
+        def factory():
+            c = spawn_pod(f"stop-repl-{len(spawned)}", spec, state_dir,
+                          home_pool=home)
+            attach_router_death(c, router)
+            spawned.append(c)
+            return c
+
+        scaler = FleetScaler(
+            router, factory,
+            ScalerConfig(min_replicas=1, max_replicas=2,
+                         hang_detect_evals=10 ** 6,  # heartbeat-only
+                         heartbeat_max_age_s=1.0),
+            threaded=True)
+        try:
+            req = router.submit(_prompt(50), max_new_tokens=NEW)
+            a.tick()  # a beat exists; the row is seated
+            os.kill(a.worker_pid, signal.SIGSTOP)
+            time.sleep(1.3)  # the beat goes stale past the ceiling
+            deadline = Deadline(120.0)
+            while scaler.metrics["hangs_detected_total"] < 1:
+                scaler.evaluate()
+                assert not deadline.expired(), "hang never indicted"
+                time.sleep(0.05)
+            assert req.result(timeout=60).size == NEW
+            assert req.error is None
+            assert router.metrics["requests_requeued_total"] >= 1
+            assert a.dead  # the corpse was reaped, not leaked
+            assert len(spawned) == 1
+        finally:
+            for c in [a] + spawned:
+                try:
+                    c.stop()
+                    c.kill(timeout_s=2.0)
+                except (RuntimeError, OSError):  # teardown best-effort
+                    pass

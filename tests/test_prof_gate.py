@@ -259,6 +259,55 @@ class TestPerfGate:
         assert rec["slo"]["alerts"] == []
         assert rec["slo"]["decode_tick"]["samples"] > 0
 
+    def test_injected_wire_faults_fail_pods_gate(self, monkeypatch):
+        """The pod gate's teeth (ISSUE 16): KFTPU_PROF_CHAOS="wire:1"
+        arms the seeded wire-fault plan on the decode pods' client
+        sockets — connection resets and torn frames mid-call. Every
+        fault must be absorbed by the retry envelope (the drill still
+        completes with zero drops), but the retries themselves must
+        FAIL the wire_retries budget row, which the untouched tree
+        pins at 0: wire faults are never free, and never silent."""
+        monkeypatch.setenv(ENV_PROF_CHAOS, "wire:1")
+        results = cpu_proxy.run_all(only="serve_pods")
+        violations = cpu_proxy.check_budgets(
+            results, json.loads(BUDGETS.read_text()))
+        assert any("serve_pods.wire_retries" in v
+                   for v in violations), violations
+        (rec,) = results
+        assert rec["wire_chaos_armed"] is True
+        assert rec["rel"]["wire_retries"] >= 1
+        # the faults were absorbed, not dropped: the zero-drop contract
+        # holds THROUGH the wire chaos
+        assert rec["dropped_count"] == 0
+        assert rec["completed"] == rec["requests"]
+
+    def test_pods_drill_real_kill_zero_drop(self, monkeypatch):
+        """The serve_pods record is ISSUE 16's acceptance drill: three
+        real subprocess pods (one prefill, two decode) behind the
+        router, one decode pod SIGKILLed by PID mid-run — dropped=0
+        EXACT, every requeued request re-seated, >=1 rescued by a
+        cross-process paged-KV chain resume (digest-verified over the
+        wire) instead of a scratch re-decode, and every prompt
+        prefilled on the prefill pod then handed off by digest."""
+        monkeypatch.delenv(ENV_PROF_CHAOS, raising=False)
+        (rec,) = cpu_proxy.run_all(only="serve_pods")
+        assert rec["replica_killed"] and rec["pod_kills"] >= 1
+        assert rec["dropped_count"] == 0
+        assert rec["completed"] == rec["requests"]
+        # the rescue: at least one requeued request resumed from the
+        # serialized chain the dead pod's client still held
+        assert rec["requeued"] >= 1
+        assert rec["resumed"] >= 1 and rec["resumed_tokens"] >= 1
+        assert rec["rel"]["kill_unrescued"] == 0
+        assert rec["rel"]["requeue_scratch_frac"] < 1.0
+        # the tier contract crossed process boundaries: every prompt
+        # prefilled in the prefill pod, chains adopted by digest
+        assert rec["handoffs"] == rec["requests"]
+        assert rec["handoff_bytes"] > 0
+        # a healthy wire carries zero retries (the teeth's baseline)
+        assert rec["wire_chaos_armed"] is False
+        assert rec["rel"]["wire_retries"] == 0
+
 
 class TestGateLogic:
     """check_budgets unit behavior on synthetic results — no timing."""
