@@ -4,7 +4,7 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo test-decode test-soak test-pods selftest-sanitizers native
+.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo test-decode test-soak test-pods test-sched selftest-sanitizers native
 
 test: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
@@ -108,6 +108,17 @@ test-soak:
 # teeth (docs/serving.md "Pod-backed replicas")
 test-pods:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_pods.py -q -m pods
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
+
+# kftpu-chipsched suite: the shared chip ledger both workload classes
+# claim through — slice-aware placement, priority preemption through
+# the gang-restart path (sched.preempt→job.gang_restart span link +
+# restart-warm resume), DRF tenant quotas with borrow/reclaim, the
+# deny/Retry-After contract, /debug/sched surface agreement, and the
+# diurnal_storm cpu-proxy gate with its sched_freeze teeth
+# (docs/scheduler.md)
+test-sched:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chipsched.py -q -m sched
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 native:

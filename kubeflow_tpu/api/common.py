@@ -59,6 +59,11 @@ class JobConditionType(str, enum.Enum):
 # convention for RestartPolicyExitCode.
 RETRYABLE_EXIT_CODE_MIN = 128
 
+# The preempted exit class (128 + SIGTERM): a chip-scheduler eviction is
+# retryable BY CONSTRUCTION — the gang restarts from checkpoint once
+# capacity frees, riding the same backoff as a crash (docs/scheduler.md).
+PREEMPTED_EXIT_CODE = 143
+
 
 def is_retryable_exit_code(code: int) -> bool:
     return code >= RETRYABLE_EXIT_CODE_MIN

@@ -343,6 +343,23 @@ class PlatformServer:
             if query.get("format") == "text":
                 return 200, render_slo_text(report)  # raw text
             return 200, report
+        if parsed.path == "/debug/sched":
+            # chip-scheduler report: inventory, claim table, per-tenant
+            # fair-share accounting, decision counters — JSON by
+            # default, ?format=text for the operator table. One build
+            # path with the `sched` CLI (scheduler/report
+            # .build_sched_report; docs/scheduler.md).
+            if getattr(self.platform, "chip_scheduler", None) is None:
+                return 404, {"error": "platform has no chip scheduler"}
+            from kubeflow_tpu.scheduler import (
+                build_sched_report,
+                render_sched_text,
+            )
+
+            report = build_sched_report(self.platform)
+            if query.get("format") == "text":
+                return 200, render_sched_text(report)  # raw text
+            return 200, report
         if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
             return 404, {"error": f"no route {parsed.path!r}"}
         kind = parts[2]

@@ -706,6 +706,39 @@ def cmd_slo(args) -> int:
     return 0
 
 
+def cmd_sched(args) -> int:
+    """Render the chip-scheduler report — inventory, claim table,
+    per-tenant fair-share accounting, decision counters — from a live
+    platform's /debug/sched endpoint (docs/scheduler.md). Shares the
+    /debug/sched build path (scheduler/report), so the two surfaces
+    cannot disagree about who holds which chips."""
+    from kubeflow_tpu.scheduler import render_sched_text
+
+    if not args.server:
+        print("error: pass --server (the report needs the live ledger)",
+              file=sys.stderr)
+        return 2
+    try:
+        import urllib.request
+
+        url = f"{args.server.rstrip('/')}/debug/sched"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            report = json.loads(r.read())
+    except (OSError, ValueError, KeyError, TypeError) as exc:
+        # urllib errors (refused/404) and malformed server payloads land
+        # here — one diagnostic line, never a traceback
+        print(f"error: {exc!r}", file=sys.stderr)
+        return 2
+    out = json.dumps(report, indent=2) + "\n" if args.json \
+        else render_sched_text(report)
+    if args.output:
+        Path(args.output).write_text(out)
+        print(f"wrote {args.output}", file=sys.stderr)
+    else:
+        print(out, end="")
+    return 0
+
+
 def cmd_tokenize(args) -> int:
     """Train a BPE tokenizer from a text file (one document per line) and
     write tokenizer.json — pairs with `generate` and gpt-lm predictors."""
@@ -870,6 +903,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--trace-dir", default="",
                    help="directory of trace exports (request breakdown "
                         "only; burn rates need a live monitor)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the report as JSON instead of the table")
+    p.add_argument("-o", "--output", default="",
+                   help="write the report to a file instead of stdout")
+
+    p = add("sched", cmd_sched,
+            help="chip-scheduler report: inventory, claims, tenant "
+                 "shares, preempt/deny counters (docs/scheduler.md)")
+    p.add_argument("--server", default="",
+                   help="live platform URL — fetches /debug/sched")
     p.add_argument("--json", action="store_true",
                    help="emit the report as JSON instead of the table")
     p.add_argument("-o", "--output", default="",

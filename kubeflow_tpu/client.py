@@ -49,7 +49,31 @@ class Platform:
         self.cluster = FakeCluster()
         self.cluster.capacity_chips = capacity_chips
         self.pod_runtime = PodRuntime(self.cluster, log_dir=log_dir)
-        self.gang_scheduler = GangScheduler(self.cluster)
+        # ONE chip inventory for both workload classes (docs/scheduler.md):
+        # the gang scheduler routes admission through it, registered
+        # fleets claim replica chips from it, and /debug/sched +
+        # kftpu_sched_* read it
+        import os as _os
+
+        from kubeflow_tpu.scheduler.chipsched import (
+            DEFAULT_RETRY_AFTER_S,
+            ChipScheduler,
+        )
+        from kubeflow_tpu.utils.envvars import (
+            ENV_SCHED_CHIPS_PER_SLICE,
+            ENV_SCHED_RETRY_AFTER_S,
+        )
+
+        self.chip_scheduler = ChipScheduler(
+            capacity_fn=lambda: self.cluster.capacity_chips,
+            tracer_fn=lambda: self.cluster.tracer,
+            chips_per_slice=int(
+                _os.environ.get(ENV_SCHED_CHIPS_PER_SLICE, "8")),
+            retry_after_s=float(
+                _os.environ.get(ENV_SCHED_RETRY_AFTER_S,
+                                str(DEFAULT_RETRY_AFTER_S))))
+        self.gang_scheduler = GangScheduler(
+            self.cluster, chipsched=self.chip_scheduler)
         self.controller = JobController(
             self.cluster, workers=controller_workers, liveness=liveness,
             # heartbeats live next to the pod logs, so test platforms rooted

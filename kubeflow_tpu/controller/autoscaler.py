@@ -125,8 +125,11 @@ class TrainingAutoscaler(ControllerBase):
 
         chips_per_worker = self._chips_per_worker(job, replicas)
         own_groups = {f"{job.namespace}/{job.name}"}
-        demand = self.scheduler.pending_demand_chips(exclude_keys=own_groups)
-        free = self.scheduler.free_chips()
+        # ONE snapshot for both numbers: the old paired reads (demand
+        # then free) let a concurrent bind count the same gang's chips
+        # in both, over-growing the target — the shared ledger's
+        # demand_and_free closes that window and counts what it avoided
+        demand, free = self.scheduler.demand_and_free(exclude_keys=own_groups)
         rs = job.status.replica_statuses.get(REPLICA_WORKER)
         if rs is not None and (rs.succeeded > 0 or rs.failed > 0):
             # completing or recovering: pods EXITED — any scale would re-mesh

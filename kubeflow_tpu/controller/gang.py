@@ -55,7 +55,7 @@ def resolve_priority(priority_class: str) -> int:
 
 
 class GangScheduler:
-    def __init__(self, cluster: FakeCluster):
+    def __init__(self, cluster: FakeCluster, chipsched=None):
         self.cluster = cluster
         self.errors = 0  # surfaced so silent failures are still countable
         #: benign optimistic-concurrency losses (an object was replaced
@@ -70,9 +70,23 @@ class GangScheduler:
         # group bound — releasing on key alone would drop the replacement's
         # reservation and let other gangs overcommit the chips.
         # GuardedState: every access asserts _mu is held when the lockcheck
-        # detector is armed — this table IS the chip ledger; an unlocked
-        # read was the PR-1 wedge's cousin waiting to happen.
+        # detector is armed — an unlocked read was the PR-1 wedge's cousin
+        # waiting to happen.
         self._guarded = GuardedState(self._mu, bound_chips={})
+        # The SHARED chip ledger (scheduler/chipsched.py): capacity math
+        # routes through it so training gangs and serving fleets draw
+        # from one inventory. A private instance (the default) preserves
+        # standalone behavior; client.Platform passes the shared one.
+        # Lock order is gang._mu -> chipsched._mu only — the scheduler's
+        # evictor callback re-enters us WITHOUT its lock held.
+        if chipsched is None:
+            from kubeflow_tpu.scheduler.chipsched import ChipScheduler
+
+            chipsched = ChipScheduler(
+                capacity_fn=lambda: cluster.capacity_chips,
+                tracer_fn=lambda: cluster.tracer)
+        self.chipsched = chipsched
+        chipsched.evictor = self.evict_for_scheduler
 
     def start(self) -> None:
         t = threading.Thread(target=self._loop, name="gang-scheduler", daemon=True)
@@ -104,6 +118,7 @@ class GangScheduler:
                     held = self._guarded.bound_chips.get(obj.key)
                     if held is not None and held[0] == obj.metadata.uid:
                         self._guarded.bound_chips.pop(obj.key)
+                        self.chipsched.release(obj.key, uid=obj.metadata.uid)
             if kind in ("pods", "podgroups"):
                 self._try_schedule_safe(trigger)
 
@@ -164,16 +179,15 @@ class GangScheduler:
                                 1 for p in self._members(pg) if p.status.node
                             )
                             extra = max(0, bound + len(late) - held)
-                        used = sum(c for _, c in self._guarded.bound_chips.values())
-                        if used + extra > self.cluster.capacity_chips:
+                        if extra and self._ns_quota_blocked(pg, extra):
+                            continue
+                        if extra and not self._ledger_add(pg, extra):
                             self.cluster.record_event(
                                 "podgroups", pg.key, "Unschedulable",
                                 f"late members need {extra} chips, "
-                                f"{self.cluster.capacity_chips - used} free",
+                                f"{self.chipsched.free_chips()} free",
                                 type="Warning",
                             )
-                            continue
-                        if extra and self._ns_quota_blocked(pg, extra):
                             continue
                         # reserve before binding: a failed pod update must
                         # never leave bound pods holding uncounted chips
@@ -200,20 +214,26 @@ class GangScheduler:
                 # must not be allowed to evict anyone
                 if self._ns_quota_blocked(pg, chips_needed):
                     continue
-                used = sum(c for _, c in self._guarded.bound_chips.values())
-                if used + chips_needed > self.cluster.capacity_chips:
+                # admission routes through the SHARED ledger: serving
+                # replica claims count against the same inventory, and
+                # the grant records the slice placement
+                grant = self._ledger_claim(pg, chips_needed)
+                if not grant.ok:
                     # volcano preempt-action analogue: a higher-priority gang
                     # may evict strictly-lower-priority bound gangs (their
-                    # jobs gang-restart from checkpoint once capacity frees)
-                    freed = self._try_preempt(
-                        pg, chips_needed - (self.cluster.capacity_chips - used)
-                    )
-                    used = sum(c for _, c in self._guarded.bound_chips.values())
-                    if not freed or used + chips_needed > self.cluster.capacity_chips:
+                    # jobs gang-restart from checkpoint once capacity frees).
+                    # Only a CAPACITY deny escalates — a quota/frozen deny
+                    # could never use the preempted chips.
+                    if grant.reason == "capacity":
+                        if self._try_preempt(
+                            pg, chips_needed - self.chipsched.free_chips()
+                        ):
+                            grant = self._ledger_claim(pg, chips_needed)
+                    if not grant.ok:
                         self.cluster.record_event(
                             "podgroups", pg.key, "Unschedulable",
                             f"gang needs {chips_needed} chips, "
-                            f"{self.cluster.capacity_chips - used} free",
+                            f"{self.chipsched.free_chips()} free",
                             type="Warning",
                         )
                         continue
@@ -234,6 +254,7 @@ class GangScheduler:
                     # group replaced/deleted/contended under us: release and
                     # move on; the periodic sweep retries admission
                     self._guarded.bound_chips.pop(pg.key, None)
+                    self.chipsched.release(pg.key, uid=pg.metadata.uid)
                     continue
                 with tracer.span(
                     "gang.bind", parent=trigger, group=pg.key,
@@ -282,6 +303,7 @@ class GangScheduler:
             if entry is None:
                 continue
             released += entry[1]
+            self.chipsched.release(victim.key, uid=entry[0])
             tracer = self.cluster.tracer  # single read: races stop_tracing
             if tracer is not None:
                 tracer.event(
@@ -314,14 +336,96 @@ class GangScheduler:
             )
         return released >= need
 
+    # ---------------------------------------------------- the shared ledger
+
+    def _ledger_claim(self, pg: PodGroup, chips: int):
+        """Admission-path claim against the shared inventory. Tenant is
+        the gang's namespace; the gang does its OWN preemption (below),
+        so the ledger never evicts on a gang's behalf."""
+        return self.chipsched.claim_gang(
+            pg.key, pg.metadata.uid, chips, priority=pg.priority,
+            tenant=pg.metadata.namespace, preempt=False)
+
+    def _ledger_add(self, pg: PodGroup, extra: int) -> bool:
+        """Late-member growth: extend the held claim, or recharge a
+        vanished one (a bound chips-gang whose entry was lost)."""
+        if self.chipsched.held(pg.key):
+            return self.chipsched.grow_gang(pg.key, pg.metadata.uid, extra)
+        return self._ledger_claim(pg, extra).ok
+
+    def evict_for_scheduler(self, key: str, uid: str, chips: int,
+                            carrier: str, by: str = "") -> bool:
+        """Scheduler-driven preemption (a serving claim evicted this
+        gang). Unlike gang-vs-gang preemption — which deletes pods and
+        lets the owner recreate them — the victims' pods are marked
+        FAILED with the PREEMPTED exit class (retryable) and the
+        ``sched.preempt`` span context as their exit carrier, so the
+        job controller's gang-restart path owns the teardown: the
+        ``job.gang_restart`` event parent-links to the preemption,
+        backoff rides RESTART_BACKOFF, and the compile-cache warm
+        resume composes unchanged (docs/scheduler.md). Called by the
+        ChipScheduler WITHOUT its lock held."""
+        import time as _time
+
+        from kubeflow_tpu.api.common import PREEMPTED_EXIT_CODE
+        from kubeflow_tpu.tracing import CARRIER_ANNOTATION
+
+        with self._mu:
+            held = self._guarded.bound_chips.get(key)
+            if held is None or held[0] != uid:
+                return False
+            self._guarded.bound_chips.pop(key)
+        pg = self.cluster.get("podgroups", key)
+        if pg is not None and pg.metadata.uid == uid:
+            evicted = copy.deepcopy(pg)  # never half-flip the stored one
+            evicted.phase = "Pending"
+            try:
+                self.cluster.update("podgroups", evicted)
+            except (ConflictError, KeyError):
+                self.conflicts += 1
+            members = self._members(pg)
+        else:
+            members = []
+        for p in members:
+            if p.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+
+            def attempt(pkey=p.key, puid=p.metadata.uid):
+                cur = self.cluster.get("pods", pkey, copy_obj=True)
+                if cur is None or cur.metadata.uid != puid:
+                    return None
+                if cur.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                    return None  # raced a real exit: its verdict wins
+                cur.status.phase = PodPhase.FAILED
+                cur.status.exit_code = PREEMPTED_EXIT_CODE
+                cur.status.finish_time = _time.time()
+                cur.status.message = f"Preempted: chips reclaimed for {by}"
+                if carrier:
+                    cur.metadata.annotations[CARRIER_ANNOTATION] = carrier
+                return self.cluster.update("pods", cur)
+
+            try:
+                with_conflict_retry(attempt)
+            except (ConflictError, KeyError):
+                self.conflicts += 1
+        self.cluster.record_event(
+            "podgroups", key, "Preempted",
+            f"evicted ({chips} chips) by chip scheduler for {by}; "
+            f"gang-restarts when capacity frees", type="Warning",
+        )
+        self.cluster.record_event(
+            "jobs", key, "Preempted",
+            f"gang preempted by scheduler claim {by}; will gang-restart",
+            type="Warning",
+        )
+        return True
+
     # ------------------------------------------------------- capacity views
 
     def free_chips(self) -> int:
-        """Chips not held by any bound gang (autoscaler input)."""
-        with self._mu:
-            return self.cluster.capacity_chips - sum(
-                c for _, c in self._guarded.bound_chips.values()
-            )
+        """Chips free in the SHARED ledger — not held by any bound gang
+        OR serving replica claim (autoscaler input)."""
+        return self.chipsched.free_chips()
 
     def pending_demand_chips(self, exclude_keys: set[str] | None = None) -> int:
         """Total chips wanted by gangs that are ready (>= min_member pending
@@ -331,9 +435,34 @@ class GangScheduler:
         for them would pin the yielder at min forever while chips sit idle.
         `exclude_keys` masks a job's own group(s). Pods are grouped in one
         list pass (this is called from every autoscaled job's reconcile)."""
-        demand = 0
         with self._mu:
             holdings = dict(self._guarded.bound_chips)
+        return self._pending_demand(holdings, exclude_keys)
+
+    def demand_and_free(
+        self, exclude_keys: set[str] | None = None
+    ) -> tuple[int, int]:
+        """ONE consistent (pending demand, free chips) snapshot — the
+        fix for the paired-read race: pending_demand_chips() then
+        free_chips() as two calls lets a bind land in between, counting
+        the same gang's chips in BOTH numbers (demand at read one, used
+        at read two) and over-growing the autoscaler's target. Here the
+        holdings snapshot and the free count come from one pass, and a
+        pending group that ALREADY holds a ledger reservation (the
+        reserve->flip-Running admission window) is skipped from demand
+        and counted as double-count-avoided chips."""
+        with self._mu:
+            holdings = dict(self._guarded.bound_chips)
+            free = self.chipsched.free_chips()
+        avoided = [0]
+        demand = self._pending_demand(holdings, exclude_keys, avoided)
+        self.chipsched.note_double_count_avoided(avoided[0])
+        return demand, free
+
+    def _pending_demand(self, holdings: dict,
+                        exclude_keys: set[str] | None,
+                        avoided: list | None = None) -> int:
+        demand = 0
         bound = {k: uid for k, (uid, _) in holdings.items()}
         pending_by_group: dict[str, int] = {}
         for p in self.cluster.list("pods"):
@@ -342,6 +471,11 @@ class GangScheduler:
                 pending_by_group[gk] = pending_by_group.get(gk, 0) + 1
         for pg in self.cluster.list("podgroups"):
             if pg.phase == "Running" or bound.get(pg.key) == pg.metadata.uid:
+                if (avoided is not None and pg.phase != "Running"
+                        and bound.get(pg.key) == pg.metadata.uid):
+                    # reserved but not yet flipped Running: the old
+                    # paired reads would have double-counted these chips
+                    avoided[0] += holdings[pg.key][1]
                 continue
             if exclude_keys and pg.key in exclude_keys:
                 continue

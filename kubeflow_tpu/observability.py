@@ -16,6 +16,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubeflow_tpu.utils.prom import Exposition, observe
 
+#: preempt-to-resume histogram buckets (seconds): a resume rides a
+#: diurnal trough, so the range runs sub-second (unit drills) to
+#: minutes (a gang parked across a whole serving peak)
+SCHED_RESUME_BUCKETS = (0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0,
+                        120.0, 300.0)
+
 
 def render_metrics(platform) -> str:
     """Aggregate platform state into Prometheus text format."""
@@ -191,6 +197,90 @@ def render_metrics(platform) -> str:
     gauge("kftpu_scaler_cold_start_seconds",
           max((s.cold_start_ewma_s for s in scalers), default=0.0),
           help_="EWMA of observed replica cold-start durations")
+
+    # chip scheduler (kubeflow_tpu/scheduler, docs/scheduler.md): the
+    # shared inventory BOTH workload classes claim through — the grant/
+    # deny/preemption/quota decision counters, the free-chip view, the
+    # per-tenant fair-share accounting, and the preempt-to-resume
+    # latency histogram. One consistent snapshot (ChipScheduler holds
+    # its mutex once), ZERO-valued on a schedulerless platform and with
+    # the per-tenant families DECLARED even before any tenant has
+    # claimed (KFTPU-METRIC contract: the golden pins a stable
+    # surface).
+    sched = getattr(platform, "chip_scheduler", None)
+    sched_snap = sched.snapshot() if sched is not None else {}
+    sched_counts = sched_snap.get("metrics", {})
+    for fam, field_, help_ in (
+        ("kftpu_sched_grants_total", "grants_total",
+         "chip claims admitted (gangs and serving replicas alike)"),
+        ("kftpu_sched_denies_total", "denies_total",
+         "chip claims refused (frozen / quota / capacity) with a "
+         "Retry-After hint and a traced sched.deny"),
+        ("kftpu_sched_preemptions_total", "preemptions_total",
+         "lower-priority gang claims evicted for a claim that could "
+         "not otherwise fit (each emits a sched.preempt span)"),
+        ("kftpu_sched_quota_borrows_total", "quota_borrows_total",
+         "grants that ran a tenant past its fair-share entitlement "
+         "on idle (reclaimable) chips"),
+        ("kftpu_sched_quota_reclaims_total", "quota_reclaims_total",
+         "preemptions that reclaimed borrowed chips for an "
+         "under-entitlement tenant"),
+        ("kftpu_sched_resumes_total", "resumes_total",
+         "preempted gangs that re-claimed their chips (closes a "
+         "preempt-to-resume latency sample)"),
+        ("kftpu_sched_reclaimed_chips_total", "reclaimed_chips_total",
+         "chips returned to the pool by releases and evictions"),
+        ("kftpu_sched_double_count_avoided_chips_total",
+         "double_count_avoided_chips_total",
+         "pending-gang chips the combined demand_and_free snapshot "
+         "kept out of demand because the ledger already holds them "
+         "(the autoscaler paired-read race, counted)"),
+    ):
+        counter(fam, sched_counts.get(field_, 0), help_=help_)
+    gauge("kftpu_sched_free_chips", sched_snap.get("free_chips", 0),
+          help_="unclaimed chips in the shared ledger")
+    gauge("kftpu_sched_used_chips", sched_snap.get("used_chips", 0))
+    gauge("kftpu_sched_frozen",
+          1 if sched_snap.get("frozen") else 0,
+          help_="1 while the ledger refuses all claims (the "
+                "sched_freeze chaos mode)")
+    gauge("kftpu_sched_quota_enforced",
+          1 if sched_snap.get("quota_enforced") else 0,
+          help_="1 once set_shares armed fair-share tenant quotas")
+    tenant_fams = (
+        ("kftpu_sched_tenant_share", "share",
+         "armed fair-share weight per tenant"),
+        ("kftpu_sched_tenant_entitled_chips", "entitled_chips",
+         "weighted max-min chip entitlement under the armed shares"),
+        ("kftpu_sched_tenant_used_chips", "used_chips",
+         "chips each tenant's claims currently hold"),
+        ("kftpu_sched_tenant_borrowed_chips", "borrowed_chips",
+         "held chips past the entitlement (reclaim-eligible)"),
+    )
+    for fam, _, help_ in tenant_fams:
+        exp.declare(fam, "gauge", help_)
+    # zero-valued-stable (the kftpu_slo_* pattern): an idle ledger still
+    # exposes the two default claim tenants, so the families are pinned
+    # in the golden exposition with samples, not just HELP/TYPE
+    tenants = sched_snap.get("tenants", {}) or {
+        t: {"share": 0.0, "entitled_chips": 0, "used_chips": 0,
+            "borrowed_chips": 0}
+        for t in ("default", "serving")
+    }
+    for t, info in sorted(tenants.items()):
+        for fam, field_, _ in tenant_fams:
+            gauge(fam, info[field_], labels=f'{{tenant="{t}"}}')
+    # preempt-to-resume: eviction to re-grant wall time — the latency a
+    # batch gang actually waited for serving to hand the chips back
+    resume_counts = [0] * (len(SCHED_RESUME_BUCKETS) + 1)
+    resume_total = 0.0
+    for s in sched_snap.get("preempt_to_resume_s", ()):
+        observe(SCHED_RESUME_BUCKETS, resume_counts, s)
+        resume_total += s
+    exp.histogram(
+        "kftpu_sched_preempt_to_resume_seconds", SCHED_RESUME_BUCKETS,
+        resume_counts, resume_total,
+        help_="preempted-gang eviction-to-resume wall time")
 
     # pod-backed serving replicas (serving/fleet/podclient.py): the
     # cross-process tier's lifecycle and wire-health ledger — spawns,

@@ -1,0 +1,32 @@
+"""kubeflow_tpu.scheduler — the cluster-wide chip scheduler.
+
+One chip inventory for both workload classes: training gangs (via
+controller/gang.py) and serving fleets (via serving/fleet/scaler.py)
+claim and release through the same slice-aware, priority/preemption,
+fair-share ledger (docs/scheduler.md)."""
+
+from kubeflow_tpu.scheduler.chipsched import (
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
+    PRIORITY_SERVING,
+    ChipScheduler,
+    Deny,
+    Grant,
+)
+from kubeflow_tpu.scheduler.report import (
+    build_sched_report,
+    build_sched_report_from_scheduler,
+    render_sched_text,
+)
+
+__all__ = [
+    "PRIORITY_BATCH",
+    "PRIORITY_INTERACTIVE",
+    "PRIORITY_SERVING",
+    "ChipScheduler",
+    "Deny",
+    "Grant",
+    "build_sched_report",
+    "build_sched_report_from_scheduler",
+    "render_sched_text",
+]

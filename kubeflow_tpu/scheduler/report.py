@@ -1,0 +1,86 @@
+"""Chip-scheduler report — the ONE build path every sched surface serves.
+
+`build_sched_report` assembles the canonical report dict from the
+ChipScheduler's consistent snapshot: inventory (capacity / free / per-
+slice occupancy), the claim table, per-tenant share accounting, and the
+grant/deny/preempt counters with preempt-to-resume latency stats.
+`GET /debug/sched`, the ``sched`` CLI subcommand, and tests all read
+THIS module, so the surfaces can never disagree about who holds which
+chips (tests/test_chipsched.py pins exact agreement, the
+TestSurfacesAgree pattern from /debug/slo).
+"""
+
+from __future__ import annotations
+
+
+def build_sched_report_from_scheduler(sched) -> dict:
+    """The canonical report for one ChipScheduler."""
+    snap = sched.snapshot()
+    samples = snap.pop("preempt_to_resume_s")
+    stats = {"count": len(samples)}
+    if samples:
+        ordered = sorted(samples)
+        stats["mean_s"] = sum(ordered) / len(ordered)
+        stats["max_s"] = ordered[-1]
+    snap["preempt_to_resume"] = stats
+    return snap
+
+
+def build_sched_report(platform) -> dict:
+    """Live-platform form: the platform's shared chip scheduler."""
+    sched = getattr(platform, "chip_scheduler", None)
+    if sched is None:
+        raise ValueError("platform has no chip scheduler")
+    return build_sched_report_from_scheduler(sched)
+
+
+def render_sched_text(report: dict) -> str:
+    """Operator-facing table form (the default ``sched`` CLI rendering)."""
+    lines = ["kftpu sched"]
+    lines.append(
+        f"inventory: {report['used_chips']}/{report['capacity_chips']} "
+        f"chips used ({report['free_chips']} free, "
+        f"{report['chips_per_slice']} chips/slice)"
+        + ("  FROZEN" if report.get("frozen") else ""))
+    lines.append(
+        "slices: "
+        + " ".join(f"[{i}:{f}free]"
+                   for i, f in enumerate(report.get("slice_free", []))))
+    claims = report.get("claims", [])
+    if claims:
+        lines.append("claims:")
+        lines.append(
+            "  key                           kind     tenant     chips"
+            "  prio   borrowed  slices")
+        for c in claims:
+            slices = ",".join(f"{i}x{n}" for i, n in c["slices"])
+            lines.append(
+                f"  {c['key']:<28}  {c['kind']:<7}  {c['tenant']:<9}  "
+                f"{c['chips']:>5}  {c['priority']:>5}  "
+                f"{c['borrowed']:>8}  {slices}")
+    else:
+        lines.append("claims: none")
+    tenants = report.get("tenants", {})
+    if tenants:
+        hdr = "enforced" if report.get("quota_enforced") else "unenforced"
+        lines.append(f"tenants ({hdr}):")
+        for t, info in sorted(tenants.items()):
+            lines.append(
+                f"  {t:<12} share={info['share']:<4g} "
+                f"entitled={info['entitled_chips']} "
+                f"used={info['used_chips']} "
+                f"borrowed={info['borrowed_chips']}")
+    m = report.get("metrics", {})
+    lines.append(
+        f"counters: grants={m.get('grants_total', 0)} "
+        f"denies={m.get('denies_total', 0)} "
+        f"preemptions={m.get('preemptions_total', 0)} "
+        f"resumes={m.get('resumes_total', 0)} "
+        f"borrows={m.get('quota_borrows_total', 0)} "
+        f"reclaims={m.get('quota_reclaims_total', 0)}")
+    pr = report.get("preempt_to_resume", {})
+    if pr.get("count"):
+        lines.append(
+            f"preempt->resume: {pr['count']} sample(s), "
+            f"mean {pr['mean_s']:.3f}s, max {pr['max_s']:.3f}s")
+    return "\n".join(lines) + "\n"

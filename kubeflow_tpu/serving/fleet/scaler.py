@@ -109,7 +109,9 @@ class FleetScaler:
 
     def __init__(self, router, engine_factory, config: ScalerConfig |
                  None = None, monitor=None, tracer=None,
-                 threaded: bool = False, on_release=None):
+                 threaded: bool = False, on_release=None,
+                 chipsched=None, chips_per_replica: int = 1,
+                 tenant: str = "serving", claim_prefix: str = "fleet"):
         """engine_factory() -> a NEW engine, constructed, warmed (first
         dispatch compiled), and sharing the fleet's paged_kv pool when
         the fleet has one (router.add_replica enforces the invariant).
@@ -120,10 +122,21 @@ class FleetScaler:
         the tick-driven soak leaves engines passive). on_release(engine)
         receives each GRACEFULLY-drained engine (emptied, stopped,
         healthy) — the warm-standby recycling hook; killed/hung engines
-        never pass through it."""
+        never pass through it. chipsched (scheduler.ChipScheduler):
+        the shared chip ledger — every cold-started replica claims
+        chips_per_replica chips under ``tenant`` before it exists
+        (preemption-then-grant: a claim that cannot fit evicts the
+        lowest-priority batch gang), and every removal releases them; a
+        deny is traced (sched.deny) and counted while the burn signal
+        keeps demanding. None = no chip accounting (standalone fleets,
+        the pre-ledger contract)."""
         self.router = router
         self.engine_factory = engine_factory
         self.on_release = on_release
+        self.chipsched = chipsched
+        self.chips_per_replica = chips_per_replica
+        self.tenant = tenant
+        self.claim_prefix = claim_prefix
         self.cfg = config or ScalerConfig()
         self.monitor = monitor
         self.tracer = tracer if tracer is not None else router.tracer
@@ -158,7 +171,11 @@ class FleetScaler:
             "hangs_detected_total": 0,
             "scale_to_zero_total": 0,
             "scale_from_zero_total": 0,
+            "chip_denies_total": 0,
         }
+        #: last Deny from the chip ledger (Retry-After surface): the
+        #: caller's hint for when demanding again might succeed
+        self.last_deny = None
         router.scaler = self
 
     # ------------------------------------------------------------ chaos
@@ -236,7 +253,8 @@ class FleetScaler:
             from_zero = n_serving == 0
             ctx = eval_ctx(demand, burn, "scale_up")
             for _ in range(need):
-                self._scale_up_one(tr, ctx, from_zero=from_zero)
+                if not self._scale_up_one(tr, ctx, from_zero=from_zero):
+                    break  # chip deny: stop burning claims this pass
                 from_zero = False
             self._last_scale_up_eval = i
             self._low_demand_evals = 0
@@ -285,11 +303,13 @@ class FleetScaler:
 
     # -------------------------------------------------------- sub-steps
 
-    def _scale_up_one(self, tr, ctx, from_zero: bool) -> None:
+    def _scale_up_one(self, tr, ctx, from_zero: bool) -> bool:
         # a draining replica is capacity we already own: cancel a drain
         # instead of paying a cold start — the one with the MOST seated
         # work (it has the most to lose to a drain-grace polite kill;
-        # the emptiest is about to be reaped anyway and costs nothing)
+        # the emptiest is about to be reaped anyway and costs nothing).
+        # Its chip claim was never released (that happens in _remove),
+        # so no new claim is needed.
         if self._draining:
             def seated(name):
                 try:
@@ -304,10 +324,27 @@ class FleetScaler:
                          undrained=True, cold_start_s=0.0)
             with self._mu:
                 self.metrics["replicas_added_total"] += 1
-            return
+            return True
+        name = f"scaled-{self._created}"
+        # a cold start claims its chips FIRST: the shared ledger may
+        # preempt a batch gang to make room (preemption-then-grant); a
+        # deny leaves the fleet as-is — the burn signal keeps demanding
+        # and the Deny's retry_after_s is the caller's hint
+        if self.chipsched is not None:
+            res = self.chipsched.claim_replica(
+                self._claim_key(name), chips=self.chips_per_replica,
+                tenant=self.tenant)
+            if not res.ok:
+                self.last_deny = res
+                with self._mu:
+                    self.metrics["chip_denies_total"] += 1
+                if tr is not None:
+                    tr.event("fleet.scale_up_denied", parent=ctx,
+                             replica=name, reason=res.reason,
+                             retry_after_s=res.retry_after_s)
+                return False
         t0 = time.perf_counter()
         engine = self.engine_factory()
-        name = f"scaled-{self._created}"
         self._created += 1
         rep = self.router.add_replica(engine, name=name)
         if self.threaded:
@@ -324,6 +361,10 @@ class FleetScaler:
         if tr is not None:
             tr.event("fleet.scale_up", parent=ctx, replica=rep.name,
                      from_zero=from_zero, cold_start_s=round(dt, 4))
+        return True
+
+    def _claim_key(self, replica_name: str) -> str:
+        return f"{self.claim_prefix}/{replica_name}"
 
     def _begin_drain(self, rep, eval_i: int, tr, ctx,
                      reason: str) -> None:
@@ -444,5 +485,10 @@ class FleetScaler:
         # must go with it, or months of scale-up/drain cycles (names
         # never reused) leak one entry per replica ever created
         self._progress.pop(rep.name, None)
+        # ... and its chip claim returns to the shared pool — the
+        # release half of the ledger contract (a preempted batch gang
+        # resumes on exactly these chips)
+        if self.chipsched is not None:
+            self.chipsched.release(self._claim_key(rep.name))
         with self._mu:
             self.metrics["replicas_removed_total"] += 1

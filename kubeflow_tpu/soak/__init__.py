@@ -12,16 +12,29 @@ as the `prod_day` cpu-proxy workload (profiling/cpu_proxy.py), with
 `KFTPU_PROF_CHAOS="scaler_freeze:1"` as the falsifiable teeth: a scaler
 that stops reacting while the waves continue must fire the SLO
 burn-rate alert and fail the gate. docs/autoscaling.md is the guide.
+
+kftpu-chipsched adds the diurnal storm (`run_diurnal_storm`): the same
+day re-run on a chip-CONSTRAINED cluster where peak serving demand
+cannot fit without preempting batch training through the shared
+ChipScheduler ledger — real JAXJob gangs evicted via the gang-restart
+path, resumed when the trough frees chips, gated on preemption-to-
+resume latency, zero serving SLO violations, and a batch goodput
+floor. `KFTPU_PROF_CHAOS="sched_freeze:1"` (the ledger stops granting)
+is its teeth. docs/scheduler.md is the guide.
 """
 
 from kubeflow_tpu.soak.scenario import (
     SoakConfig,
+    StormConfig,
     calibrated_default_slos,
+    run_diurnal_storm,
     run_prod_day,
 )
 
 __all__ = [
     "SoakConfig",
+    "StormConfig",
     "calibrated_default_slos",
+    "run_diurnal_storm",
     "run_prod_day",
 ]

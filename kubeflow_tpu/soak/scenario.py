@@ -697,3 +697,491 @@ def _arrivals(rate: float, rng: random.Random) -> int:
     if rng.random() < rate - n:
         n += 1
     return n
+
+
+# ------------------------------------------------------- the diurnal storm
+
+
+@dataclass(frozen=True)
+class StormConfig(SoakConfig):
+    """The chip-constrained day (docs/scheduler.md): the prod-day waves
+    re-run on a cluster where peak serving demand CANNOT fit without
+    preempting batch training. 12 chips, 4 per slice: two 4-chip batch
+    gangs hold 8, the base serving replica 1 — three free. The evening
+    peak demands more replicas than the free pool covers, so the shared
+    ledger's preemption-then-grant evicts the youngest (borrowed) gang;
+    the trough and the night release chips and the gang gang-restarts
+    back in. Every number below is sized so both transitions MUST
+    happen on the seeded schedule."""
+
+    capacity_chips: int = 12
+    chips_per_slice: int = 4
+    batch_gangs: int = 2
+    batch_workers: int = 2
+    #: 2x2 = 4 chips = one whole slice per gang
+    batch_topology: str = "2x2"
+    #: higher evening peak + heavier replicas than the free pool:
+    #: serving claims 2 chips per replica, so only TWO replicas fit
+    #: beside the gangs (8 + 2x2 = 12) — the third claim of either
+    #: peak must evict a batch gang (preemption-then-grant), and
+    #: max_replicas is reachable only over preempted chips
+    peak2_rate: float = 3.4
+    serving_chips_per_replica: int = 2
+    max_replicas: int = 4
+    #: post-drain bound on waiting for the evicted gang's rebind
+    resume_wait_ticks: int = 2000
+
+
+class _BatchGangLeg:
+    """Batch training gangs on a real control plane, drawing from the
+    SAME chip ledger as the serving fleet: a FakeCluster + GangScheduler
+    + JobController stack whose jobs reserve whole slices through
+    `ChipScheduler.claim_gang`. Pods are never started (no runtime —
+    the leg measures scheduling, not training): a gang is "running" when
+    its podgroup is admitted and bound. A scheduler eviction marks the
+    pods FAILED with the PREEMPTED exit class; the job controller's
+    gang-restart path recreates them and the gang re-admits when the
+    serving fleet releases chips — preempt-to-resume is measured in
+    ticks by polling the podgroup phase."""
+
+    def __init__(self, cfg: StormConfig, tracer, workdir: str):
+        import os
+
+        from kubeflow_tpu.controller.fakecluster import FakeCluster
+        from kubeflow_tpu.controller.gang import (
+            GangScheduler,
+            topology_chips,
+        )
+        from kubeflow_tpu.controller.jobcontroller import JobController
+        from kubeflow_tpu.scheduler.chipsched import ChipScheduler
+
+        self.cfg = cfg
+        self.cluster = FakeCluster()
+        self.cluster.capacity_chips = cfg.capacity_chips
+        self.cluster.tracer = tracer
+        #: THE shared inventory: the gang scheduler admits through it
+        #: and the FleetScaler claims replica chips from it
+        self.ledger = ChipScheduler(
+            capacity_fn=lambda: self.cluster.capacity_chips,
+            tracer_fn=lambda: self.cluster.tracer,
+            chips_per_slice=cfg.chips_per_slice)
+        self.gang = GangScheduler(self.cluster, chipsched=self.ledger)
+        self.jc = JobController(
+            self.cluster, workers=1,
+            heartbeat_dir=os.path.join(workdir, "heartbeats"),
+            compile_cache_dir=os.path.join(workdir, "compile-cache"))
+        self.gang_chips = topology_chips(cfg.batch_topology)
+        self.job_keys = [
+            f"default/storm-batch-{i}" for i in range(cfg.batch_gangs)]
+        self._bound: dict[str, bool] = {}
+        self._evicted_at: dict[str, int] = {}
+        self.preemptions_seen = 0
+        self.resume_ticks: list[int] = []
+        self.goodput_samples: list[float] = []
+
+    def start(self) -> "_BatchGangLeg":
+        from kubeflow_tpu.api.common import (
+            ContainerSpec,
+            ObjectMeta,
+            PodTemplateSpec,
+            ReplicaSpec,
+            RestartPolicy,
+            RunPolicy,
+            SchedulingPolicy,
+        )
+        from kubeflow_tpu.api.jobs import (
+            JAXJob,
+            JAXJobSpec,
+            REPLICA_WORKER,
+        )
+
+        self.jc.start()
+        self.gang.start()
+        for i in range(self.cfg.batch_gangs):
+            job = JAXJob(
+                metadata=ObjectMeta(name=f"storm-batch-{i}"),
+                spec=JAXJobSpec(
+                    replica_specs={REPLICA_WORKER: ReplicaSpec(
+                        replicas=self.cfg.batch_workers,
+                        # the preemption contract: exit 143 (128+SIGTERM)
+                        # is retryable BY CONSTRUCTION under ExitCode
+                        restart_policy=RestartPolicy.EXIT_CODE,
+                        template=PodTemplateSpec(
+                            container=ContainerSpec(
+                                command=["python", "-c", "pass"])))},
+                    run_policy=RunPolicy(
+                        backoff_limit=64,
+                        scheduling_policy=SchedulingPolicy(
+                            slice_topology=self.cfg.batch_topology)),
+                ))
+            self.cluster.create("jobs", job)
+        return self
+
+    def wait_bound(self, timeout_s: float = 30.0) -> None:
+        """Block until every gang is admitted (the pre-day steady
+        state; the storm's transitions are measured from here)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.gang._try_schedule_safe()
+            if all(self._pg_bound(k) for k in self.job_keys):
+                return
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"batch gangs failed to bind: "
+            f"{[(k, self._pg_bound(k)) for k in self.job_keys]}")
+
+    def _pg_bound(self, key: str) -> bool:
+        pg = self.cluster.get("podgroups", key)
+        return pg is not None and pg.phase == "Running"
+
+    def nudge(self) -> None:
+        """One synchronous scheduling pass — the tick loop calls this
+        after the scaler may have released chips, so a rebind lands on
+        the tick that freed the capacity (the gang thread's 0.5s poll
+        would smear the resume latency across wall time)."""
+        self.gang._try_schedule_safe()
+
+    def step(self, tick: int) -> float:
+        """Poll gang state; returns the chips-weighted goodput sample
+        (bound batch chips / total batch chips)."""
+        for key in self.job_keys:
+            bound = self._pg_bound(key)
+            was = self._bound.get(key, False)
+            if was and not bound:
+                # the only unbind in this leg is a scheduler eviction
+                self._evicted_at[key] = tick
+                self.preemptions_seen += 1
+            elif bound and not was and key in self._evicted_at:
+                self.resume_ticks.append(
+                    tick - self._evicted_at.pop(key))
+            self._bound[key] = bound
+        total = self.gang_chips * len(self.job_keys)
+        sample = (sum(self.gang_chips for k in self.job_keys
+                      if self._bound.get(k)) / total) if total else 1.0
+        self.goodput_samples.append(sample)
+        return sample
+
+    def all_bound(self) -> bool:
+        return all(self._bound.get(k) for k in self.job_keys)
+
+    def finish(self) -> dict:
+        self.gang.stop()
+        self.jc.stop()
+        restarts = {}
+        for key in self.job_keys:
+            job = self.cluster.get("jobs", key)
+            restarts[key] = job.status.restart_count if job else -1
+        mean = (sum(self.goodput_samples) / len(self.goodput_samples)
+                if self.goodput_samples else 1.0)
+        return {
+            "gangs": len(self.job_keys),
+            "gang_chips": self.gang_chips,
+            "preemptions_seen": self.preemptions_seen,
+            "resume_ticks": list(self.resume_ticks),
+            "resumed": len(self.resume_ticks),
+            "restart_counts": restarts,
+            "goodput_mean": round(mean, 4),
+            "goodput_min": round(
+                min(self.goodput_samples, default=1.0), 4),
+        }
+
+
+def run_diurnal_storm(cfg: StormConfig | None = None,
+                      frozen: bool = False, tracer=None) -> dict:
+    """One chip-constrained production day (StormConfig docstring):
+    the prod-day serving waves with the fleet's replica chips claimed
+    from the SAME ledger two batch training gangs occupy. The peaks
+    force preemption-then-grant (a batch gang is evicted through the
+    gang-restart path), the trough and the night force the resume —
+    gated on p99 TTFT, zero drops, ZERO serving SLO violations,
+    preempt-to-resume latency in ticks, and the batch goodput floor.
+    `frozen=True` is the sched_freeze chaos mode: the ledger stops
+    granting (admission-only outage — releases still work) while the
+    waves continue, so the fleet is pinned at one replica through both
+    peaks and the SLO burn alert must catch it."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.gpt import GPTConfig, GPTLM
+    from kubeflow_tpu.monitoring.report import build_slo_report_from_spans
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+    from kubeflow_tpu.tracing import Tracer
+
+    cfg = cfg or StormConfig()
+    rng = random.Random(f"kftpu-storm-{cfg.seed}")
+    prompt_len = cfg.shared_prefix + cfg.prompt_body
+    gpt_cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=2, mlp_dim=128, dropout_rate=0.0,
+                        max_len=prompt_len + cfg.new_tokens + 18)
+    model = GPTLM(gpt_cfg)
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    pool = PagedKVPool(block_size=cfg.block, capacity_blocks=1024)
+    tsdb = TimeSeriesStore(capacity_per_series=4096)
+    tracer = tracer if tracer is not None else Tracer(
+        capacity=16384, service="diurnal_storm")
+    warm_prompt = make_prompts(1, seed=cfg.seed + 90,
+                               vocab=gpt_cfg.vocab_size,
+                               prompt_len=cfg.prompt_body,
+                               shared_prefix=cfg.shared_prefix)[0]
+
+    def build_warm_engine():
+        eng = ContinuousBatcher(
+            model, variables, max_rows=cfg.rows,
+            default_max_new_tokens=cfg.new_tokens,
+            paged_kv=pool, prefill_chunk=cfg.chunk)
+        for _ in range(2):
+            eng.submit(warm_prompt, max_new_tokens=2)
+            eng.run_until_idle()
+        return eng
+
+    # ---- the batch leg + THE ledger (fair-share DRF over chips:
+    # batch and serving tenants entitled half the cluster each, so the
+    # second gang runs on BORROWED chips — the claim an under-share
+    # serving peak is entitled to reclaim)
+    workdir = tempfile.mkdtemp(prefix="kftpu-storm-")
+    leg = _BatchGangLeg(cfg, tracer, workdir)
+    ledger = leg.ledger
+    ledger.set_shares({"default": 1.0, "serving": 1.0})
+    leg.start()
+    leg.wait_bound()
+
+    standby = [build_warm_engine() for _ in range(cfg.max_replicas + 1)]
+    in_day_builds = [0]
+
+    def engine_factory():
+        if standby:
+            return standby.pop()
+        in_day_builds[0] += 1
+        return build_warm_engine()
+
+    # ---- the fleet: one warm replica up, its chip claimed like every
+    # scaled replica's will be (the scaler's claim-key convention, so
+    # a later drain of the base releases the right claim)
+    first = engine_factory()
+    router = FleetRouter([("scaled-base", first)], max_requeues=5,
+                         tracer=tracer)
+    base_grant = ledger.claim_replica(
+        "fleet/scaled-base", chips=cfg.serving_chips_per_replica,
+        tenant="serving")
+    assert base_grant.ok, base_grant
+
+    # ---- in-run anchors (the prod-day trick): healthy decode tick
+    # measured before monitoring attaches
+    for p in make_prompts(cfg.rows, seed=cfg.seed + 91,
+                          vocab=gpt_cfg.vocab_size,
+                          prompt_len=cfg.prompt_body,
+                          shared_prefix=cfg.shared_prefix):
+        first.submit(p, max_new_tokens=cfg.new_tokens + 12)
+    for _ in range(cfg.rows * (prompt_len // cfg.chunk + 2)):
+        first.tick()
+        if not first._pending and all(first._rows):
+            break
+    anchor_tsdb = TimeSeriesStore()
+    saved_tsdb, first.tsdb = first.tsdb, anchor_tsdb
+    for _ in range(12):
+        first.tick()
+    first.tsdb = saved_tsdb
+    healthy_tick = sorted(
+        v for _, v in anchor_tsdb.window("serving.decode_tick_s",
+                                         3600.0))
+    healthy_tick = healthy_tick[len(healthy_tick) // 2]
+    first.run_until_idle()
+    router.wire_monitoring(tsdb=tsdb)
+
+    admission_slo_s = 500.0 * healthy_tick
+    decode_threshold = DECODE_SLO_HEADROOM * healthy_tick
+    router.ttft_slo_s = admission_slo_s
+    router.retry_after_s = max(8.0 * healthy_tick, 1e-4)
+    router.demand_tokens_per_replica = float(
+        cfg.rows * (prompt_len + cfg.new_tokens))
+    monitor = SLOMonitor(tsdb, calibrated_default_slos(
+        TTFT_SLO_TICKS, decode_threshold))
+    scaler = FleetScaler(
+        router, engine_factory,
+        ScalerConfig(
+            min_replicas=1, max_replicas=cfg.max_replicas,
+            scale_up_cooldown_evals=cfg.scale_up_cooldown_evals,
+            scale_down_stable_evals=cfg.scale_down_stable_evals,
+            idle_to_zero_evals=cfg.idle_to_zero_evals,
+            drain_grace_evals=cfg.drain_grace_evals,
+            hang_detect_evals=cfg.hang_detect_evals),
+        monitor=monitor, tracer=tracer,
+        on_release=standby.append,
+        # the tentpole wiring: every scaled replica claims its chip
+        # from the SAME ledger the batch gangs occupy
+        chipsched=ledger,
+        chips_per_replica=cfg.serving_chips_per_replica,
+        tenant="serving")
+    if frozen:
+        ledger.freeze()  # the sched_freeze chaos: granting stops
+
+    prompts = make_prompts(
+        int(cfg.day_ticks * max(cfg.peak1_rate, cfg.peak2_rate)) + 64,
+        seed=cfg.seed, vocab=gpt_cfg.vocab_size,
+        prompt_len=cfg.prompt_body, shared_prefix=cfg.shared_prefix)
+
+    handles: dict[int, object] = {}
+    retries: list[tuple[int, int]] = []
+    shed_retries = 0
+    ttft_ticks: list[int] = []
+    arrival_tick: dict[int, int] = {}
+    first_tok_tick: dict[int, int] = {}
+    cur_tick = [0]
+    collected: set[int] = set()
+    n_submitted = 0
+    replicas_peak = 1
+
+    def _note_first_token(idx: int):
+        def cb(_freq, _tok):
+            first_tok_tick.setdefault(idx, cur_tick[0])
+        return cb
+
+    def submit(idx: int, tick: int) -> None:
+        nonlocal shed_retries
+        try:
+            handles[idx] = router.submit(
+                prompts[idx], max_new_tokens=cfg.new_tokens,
+                on_token=_note_first_token(idx))
+            arrival_tick[idx] = tick
+        except FleetOverloaded as exc:
+            shed_retries += 1
+            delay = min(max(1, round(exc.retry_after_s
+                                     / max(healthy_tick, 1e-9))), 25)
+            retries.append((tick + delay, idx))
+
+    def one_tick(tick: int, arrivals: int) -> None:
+        nonlocal n_submitted, replicas_peak
+        cur_tick[0] = tick
+        for _ in range(arrivals):
+            if n_submitted < len(prompts):
+                submit(n_submitted, tick)
+                n_submitted += 1
+        for due, idx in list(retries):
+            if due <= tick:
+                retries.remove((due, idx))
+                submit(idx, tick)
+        for rep in list(router.replicas):
+            if rep.alive:
+                rep.engine.tick()
+        for idx, h in list(handles.items()):
+            if idx not in collected and h.done.is_set() \
+                    and h.error is None:
+                collected.add(idx)
+                if idx in first_tok_tick:
+                    dt = first_tok_tick[idx] - arrival_tick[idx]
+                    ttft_ticks.append(dt)
+                    tsdb.record(
+                        'kftpu_fleet_ttft_seconds{quantile="0.99"}',
+                        float(dt))
+        tsdb.record("kftpu_fleet_requests_failed_total",
+                    router.metrics["requests_failed_total"])
+        tsdb.record("kftpu_prof_goodput_ratio", leg.step(tick))
+        if tick % cfg.slo_eval_every == 0:
+            monitor.evaluate()
+        scaler.evaluate()
+        # rebind on the tick that freed chips: a drain completed in
+        # THIS evaluate released its claim — give the evicted gang its
+        # synchronous admission pass now, not at the 0.5s poll
+        leg.nudge()
+        replicas_peak = max(replicas_peak, len(router._admittable()))
+
+    t0 = time.perf_counter()
+    tick = 0
+    try:
+        for tick in range(cfg.day_ticks):
+            one_tick(tick, _arrivals(arrival_rate(tick, cfg), rng))
+        # night: serve out the backlog, then keep the loop alive until
+        # the evicted gang is back (the scale-down that frees its
+        # chips is itself ticks away) — both bounded
+        while tick < cfg.day_ticks + cfg.max_drain_ticks:
+            tick += 1
+            if (not retries
+                    and all(h.done.is_set() for h in handles.values())
+                    and len(handles) + len(retries) >= n_submitted):
+                break
+            one_tick(tick, 0)
+        resume_deadline = tick + cfg.resume_wait_ticks
+        while not frozen and not leg.all_bound() \
+                and tick < resume_deadline:
+            tick += 1
+            one_tick(tick, 0)
+    finally:
+        wall_s = time.perf_counter() - t0
+        batch = leg.finish()
+        for rep in router.replicas:
+            rep.engine.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    dropped = sum(
+        1 for h in handles.values()
+        if h.error is not None or not h.done.is_set()
+    ) + len(retries)
+
+    report = build_slo_report_from_spans(tracer.snapshot(),
+                                         monitor=monitor)
+    states = {s["name"]: s for s in report["slos"]}
+    serving_alerts = [a["slo"] for a in report["alerts"]
+                      if a["slo"].startswith("serving_")]
+    worst_burn = 0.0
+    for name in ("serving_ttft_p99", "serving_decode_tick",
+                 "serving_zero_drop"):
+        rates = states.get(name, {}).get("burn_rates", {})
+        if rates:
+            worst_burn = max(worst_burn, max(rates.values()))
+
+    def _p99(values):
+        s = sorted(values)
+        return s[min(len(s) - 1, int(len(s) * 0.99))] if s else 0.0
+
+    resume_mean = (sum(batch["resume_ticks"])
+                   / len(batch["resume_ticks"])
+                   if batch["resume_ticks"] else 0.0)
+    m = scaler.metrics
+
+    return {
+        "seed": cfg.seed,
+        "frozen": frozen,
+        "ticks": tick + 1,
+        "day_ticks": cfg.day_ticks,
+        "wall_s": round(wall_s, 3),
+        "capacity_chips": cfg.capacity_chips,
+        "chips_per_slice": cfg.chips_per_slice,
+        "n_requests": n_submitted,
+        "completed": len(collected),
+        "dropped": dropped,
+        "shed_retries": shed_retries,
+        "requeued": router.metrics["requests_requeued_total"],
+        "replicas_peak": replicas_peak,
+        "in_day_engine_builds": in_day_builds[0],
+        "scaler": dict(m),
+        "chip_denies": m["chip_denies_total"],
+        "sched": dict(ledger.metrics),
+        "sched_snapshot": ledger.snapshot(),
+        "batch": batch,
+        "preempt_to_resume_ticks_mean": round(resume_mean, 2),
+        "preempt_to_resume_ticks_max": float(
+            max(batch["resume_ticks"], default=0)),
+        "preempt_to_resume_s": list(ledger.preempt_to_resume_s),
+        "ttft_p99_ticks": float(_p99(ttft_ticks)),
+        "ttft_bad_frac": round(
+            sum(1 for t in ttft_ticks if t > TTFT_SLO_TICKS)
+            / max(len(ttft_ticks), 1), 4),
+        "ttft_threshold_ticks": TTFT_SLO_TICKS,
+        "healthy_tick_s": round(healthy_tick, 6),
+        "slo": {
+            "alerts": [a["slo"] for a in report["alerts"]],
+            "serving_alerts": serving_alerts,
+            "worst_serving_burn": round(worst_burn, 4),
+            "states": {
+                name: {"fired": st["fired"],
+                       "burn_rates": st["burn_rates"],
+                       "samples": st["samples"]}
+                for name, st in states.items()
+            },
+        },
+        "report": {
+            "requests": report["requests"],
+            "tsdb": report["tsdb"],
+        },
+    }

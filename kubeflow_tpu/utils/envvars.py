@@ -72,6 +72,16 @@ ENV_UPDATE_PROF_BUDGETS = "KFTPU_UPDATE_PROF_BUDGETS"
 #: repeats a phase's deterministic work N times (profiling/cpu_proxy.py)
 ENV_PROF_CHAOS = "KFTPU_PROF_CHAOS"
 
+# ------------------------------------------------------------ chip scheduler
+
+#: chips per slice in the shared chip ledger's inventory — the slice-
+#: aware bin-packing granularity (scheduler/chipsched.py; Platform
+#: construction reads it, docs/scheduler.md)
+ENV_SCHED_CHIPS_PER_SLICE = "KFTPU_SCHED_CHIPS_PER_SLICE"
+#: Retry-After hint (seconds) a chip-claim deny carries back to the
+#: caller (the activator's Retry-After idiom, scheduler edition)
+ENV_SCHED_RETRY_AFTER_S = "KFTPU_SCHED_RETRY_AFTER_S"
+
 # ------------------------------------------------------------ SLO monitoring
 
 #: sampling-tick interval in seconds for the SLO monitor's background

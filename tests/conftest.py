@@ -169,7 +169,8 @@ def lockcheck_armed(request):
             or request.node.get_closest_marker("slo")
             or request.node.get_closest_marker("soak")
             or request.node.get_closest_marker("decode")
-            or request.node.get_closest_marker("pods")):
+            or request.node.get_closest_marker("pods")
+            or request.node.get_closest_marker("sched")):
         yield
         return
     from kubeflow_tpu.analysis import lockcheck

@@ -1,0 +1,783 @@
+"""kftpu-chipsched suite — the shared chip ledger both workload classes
+claim through (docs/scheduler.md).
+
+Covers: slice-aware placement (whole-slice for slice-multiple gangs,
+contiguous best-fit, the spanning fallback that keeps admission a pure
+total-free predicate), the release/double-claim ledger contracts,
+priority preemption (serving > interactive > batch; lowest-priority/
+youngest victim, scratch-copy feasibility so an infeasible preemption
+never thrashes a gang, replicas never victims), DRF fair-share tenant
+quotas (weighted max-min entitlements, borrow accounting, borrowers
+never preempt → quota deny, under-entitlement reclaim of borrowed
+claims at equal priority), the deny/Retry-After contract down through
+FleetScaler's scale-up path, the autoscaler paired-read race fix
+(demand_and_free one-snapshot + double-count-avoided witness), a
+many-thread contention drill under the lock-order detector (the sched
+marker arms it — tests/conftest.py asserts zero cycles), the seeded
+preempt→gang-restart→warm-resume drill pinning the
+``sched.preempt``→``job.gang_restart`` span link and the PREEMPTED
+(143, retryable) exit class, the zero-backend-compile warm resume
+across a preemption (the PR-10 compile-cache contract, count-gated),
+and /debug/sched surface agreement (endpoint JSON == text == CLI ==
+build_sched_report — the /debug/slo pattern).
+"""
+
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api.common import (
+    ContainerSpec,
+    ObjectMeta,
+    PodTemplateSpec,
+    PREEMPTED_EXIT_CODE,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+)
+from kubeflow_tpu.api.jobs import JAXJob, JAXJobSpec, REPLICA_WORKER
+from kubeflow_tpu.cli import main as cli_main
+from kubeflow_tpu.controller.fakecluster import FakeCluster, PodPhase
+from kubeflow_tpu.controller.gang import GangScheduler
+from kubeflow_tpu.controller.jobcontroller import JobController
+from kubeflow_tpu.scheduler import (
+    build_sched_report,
+    build_sched_report_from_scheduler,
+    render_sched_text,
+)
+from kubeflow_tpu.scheduler.chipsched import (
+    ChipScheduler,
+    DEFAULT_RETRY_AFTER_S,
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
+    PRIORITY_SERVING,
+)
+from kubeflow_tpu.tracing import CARRIER_ANNOTATION, SpanContext, Tracer
+from kubeflow_tpu.utils.envvars import ENV_COMPILE_CACHE_DIR
+
+pytestmark = pytest.mark.sched
+
+
+def _sched(capacity=8, cps=4, tracer=None, **kw):
+    return ChipScheduler(capacity=capacity, chips_per_slice=cps,
+                         tracer_fn=(lambda: tracer), **kw)
+
+
+# --------------------------------------------------------------- placement
+
+
+class TestPlacement:
+    def test_whole_slice_for_slice_multiple_gangs(self):
+        s = _sched(capacity=16, cps=4)
+        g = s.claim_gang("default/a", "u1", 8)
+        assert g.ok and g.placement == "whole_slice"
+        assert g.slices == ((0, 4), (1, 4))
+        assert s.free_chips() == 8 and s.used_chips() == 8
+
+    def test_contiguous_best_fit_packs_fullest_slice(self):
+        s = _sched(capacity=8, cps=4)
+        a = s.claim_gang("default/a", "u1", 2)
+        assert a.ok and a.placement == "contiguous" and a.slices == ((0, 2),)
+        # a 4-chip gang takes the remaining WHOLE slice, not fragments
+        b = s.claim_gang("default/b", "u2", 4)
+        assert b.ok and b.placement == "whole_slice" and b.slices == ((1, 4),)
+        # best fit: the 2 leftover chips on slice 0, not a fresh slice
+        c = s.claim_gang("default/c", "u3", 2)
+        assert c.ok and c.slices == ((0, 2),)
+        assert s.free_chips() == 0
+
+    def test_spanning_keeps_admission_a_total_free_predicate(self):
+        s = _sched(capacity=8, cps=4)
+        assert s.claim_gang("default/a", "u1", 3).ok
+        assert s.claim_gang("default/b", "u2", 3).ok
+        # no single slice holds 2 chips, but the TOTAL does: the gang
+        # still binds (fragmentation changes placement, never admission)
+        c = s.claim_gang("default/c", "u3", 2)
+        assert c.ok and c.placement == "spanning"
+        assert c.slices == ((0, 1), (1, 1))
+        assert s.free_chips() == 0
+
+    def test_replica_best_fit_leaves_whole_slices_for_gangs(self):
+        s = _sched(capacity=12, cps=4)
+        assert s.claim_gang("default/a", "u1", 2).ok  # slice 0: 2 free
+        r = s.claim_replica("fleet/r0", chips=1)
+        # densest slice that fits — NOT an untouched one
+        assert r.ok and r.slices == ((0, 1),)
+        assert s.claim_gang("default/b", "u2", 4).placement == "whole_slice"
+
+    def test_release_returns_chips_and_guards_uid(self):
+        s = _sched(capacity=8, cps=4)
+        assert s.claim_gang("default/a", "u1", 4).ok
+        assert s.release("default/a", uid="stale") == 0  # uid mismatch
+        assert s.held("default/a")
+        assert s.release("default/a", uid="u1") == 4
+        assert not s.held("default/a") and s.free_chips() == 8
+        assert s.release("default/absent") == 0
+        assert s.metrics["reclaimed_chips_total"] == 4
+
+    def test_double_claim_same_key_is_denied(self):
+        s = _sched(capacity=8, cps=4)
+        assert s.claim_gang("default/a", "u1", 2).ok
+        d = s.claim_gang("default/a", "u2", 2)
+        assert not d.ok and d.reason == "capacity"
+        assert s.metrics["denies_total"] == 1
+        assert s.used_chips() == 2  # the held claim is untouched
+
+    def test_capacity_deny_carries_free_count(self):
+        s = _sched(capacity=8, cps=4)
+        assert s.claim_gang("default/a", "u1", 6).ok
+        d = s.claim_gang("default/b", "u2", 4)
+        assert not d.ok and d.reason == "capacity" and d.free == 2
+        assert d.retry_after_s == DEFAULT_RETRY_AFTER_S
+
+    def test_grow_gang_extends_held_claim(self):
+        s = _sched(capacity=8, cps=4)
+        assert s.claim_gang("default/a", "u1", 2).ok
+        assert s.grow_gang("default/a", "u1", 2)
+        assert s.used_chips() == 4
+        snap = s.snapshot()
+        (claim,) = snap["claims"]
+        assert claim["chips"] == 4 and sum(n for _, n in claim["slices"]) == 4
+        assert not s.grow_gang("default/a", "stale", 1)  # uid guard
+        assert not s.grow_gang("default/a", "u1", 99)  # no capacity
+        assert s.used_chips() == 4
+
+
+# -------------------------------------------------- priority + preemption
+
+
+class TestPriorityPreemption:
+    def test_serving_evicts_youngest_lowest_priority_gang(self):
+        tr = Tracer(capacity=256, service="t")
+        s = _sched(capacity=8, cps=4, tracer=tr)
+        evicted = []
+        s.evictor = lambda key, uid, chips, carrier, by="": \
+            evicted.append((key, uid, chips, carrier, by))
+        assert s.claim_gang("default/old", "u1", 4).ok
+        assert s.claim_gang("default/young", "u2", 4).ok
+        g = s.claim_replica("fleet/r0", chips=4)
+        assert g.ok and g.preempted == ("default/young",)
+        assert s.metrics["preemptions_total"] == 1
+        assert not s.held("default/young") and s.held("default/old")
+        ((key, uid, chips, carrier, by),) = evicted
+        assert (key, uid, chips, by) == ("default/young", "u2", 4,
+                                         "fleet/r0")
+        # the carrier is the sched.preempt span's context — the victim's
+        # restart chain parent-links through it
+        ctx = SpanContext.from_header(carrier)
+        (preempt,) = [sp for sp in tr.snapshot()
+                      if sp["name"] == "sched.preempt"]
+        assert ctx is not None and ctx.span_id == preempt["span"]
+        assert preempt["attrs"]["victim"] == "default/young"
+        assert preempt["attrs"]["by"] == "fleet/r0"
+
+    def test_batch_evicted_before_interactive(self):
+        s = _sched(capacity=8, cps=4)
+        assert s.claim_gang("default/inter", "u1", 4,
+                            priority=PRIORITY_INTERACTIVE).ok
+        assert s.claim_gang("default/batch", "u2", 4,
+                            priority=PRIORITY_BATCH).ok
+        g = s.claim_replica("fleet/r0", chips=4)
+        # lowest priority first, even though the interactive gang is older
+        assert g.ok and g.preempted == ("default/batch",)
+        assert s.held("default/inter")
+
+    def test_infeasible_preemption_never_thrashes(self):
+        s = _sched(capacity=8, cps=4)
+        calls = []
+        s.evictor = lambda *a, **kw: calls.append(a)
+        assert s.claim_gang("default/a", "u1", 4).ok
+        d = s.claim_replica("fleet/huge", chips=12)  # > capacity, ever
+        assert not d.ok and d.reason == "capacity"
+        # feasibility was decided on the scratch copy: nothing evicted
+        assert s.metrics["preemptions_total"] == 0 and calls == []
+        assert s.held("default/a")
+
+    def test_replica_claims_are_never_victims(self):
+        s = _sched(capacity=8, cps=4)
+        assert s.claim_replica("fleet/r0", chips=8).ok
+        d = s.claim_gang("default/a", "u1", 4,
+                         priority=PRIORITY_INTERACTIVE, preempt=True)
+        assert not d.ok and s.metrics["preemptions_total"] == 0
+        assert s.held("fleet/r0")
+
+    def test_equal_priority_is_not_preemptible(self):
+        s = _sched(capacity=8, cps=4)
+        assert s.claim_gang("default/a", "u1", 8,
+                            priority=PRIORITY_INTERACTIVE).ok
+        d = s.claim_gang("default/b", "u2", 4,
+                         priority=PRIORITY_INTERACTIVE, preempt=True)
+        assert not d.ok and s.metrics["preemptions_total"] == 0
+
+    def test_resume_after_preemption_samples_latency(self):
+        s = _sched(capacity=8, cps=4)
+        assert s.claim_gang("default/a", "u1", 8).ok
+        assert s.claim_replica("fleet/r0", chips=8).ok  # evicts a
+        assert s.release("fleet/r0") == 8
+        # the victim's re-claim (same key, new uid — the gang-restart
+        # recreate) closes the preempt→resume clock
+        assert s.claim_gang("default/a", "u2", 8).ok
+        assert s.metrics["resumes_total"] == 1
+        assert len(s.preempt_to_resume_s) == 1
+        rep = build_sched_report_from_scheduler(s)
+        assert rep["preempt_to_resume"]["count"] == 1
+        assert rep["preempt_to_resume"]["max_s"] >= 0.0
+
+
+# ------------------------------------------------------- DRF tenant quotas
+
+
+class TestQuotaDRF:
+    def test_weighted_max_min_entitlements(self):
+        s = _sched(capacity=12, cps=4)
+        assert s.entitlements() == {}  # unenforced until armed
+        s.set_shares({"a": 2.0, "b": 1.0})
+        assert s.entitlements() == {"a": 8, "b": 4}
+        with pytest.raises(ValueError):
+            s.set_shares({"a": 0.0})
+        with pytest.raises(ValueError):
+            s.set_shares({"a": -1.0})
+
+    def test_over_entitlement_claim_is_a_counted_borrow(self):
+        s = _sched(capacity=12, cps=4)
+        s.set_shares({"a": 1.0, "b": 1.0})  # 6 chips each
+        g = s.claim_gang("a/j0", "u1", 8, tenant="a")
+        assert g.ok and g.borrowed == 2
+        assert s.metrics["quota_borrows_total"] == 1
+        snap = s.snapshot()
+        assert snap["quota_enforced"]
+        assert snap["tenants"]["a"] == {
+            "share": 1.0, "entitled_chips": 6,
+            "used_chips": 8, "borrowed_chips": 2}
+
+    def test_borrower_never_preempts_quota_deny(self):
+        s = _sched(capacity=8, cps=4)
+        s.set_shares({"a": 1.0, "b": 1.0})  # 4 chips each
+        assert s.claim_gang("b/j0", "u1", 4, tenant="b").ok
+        assert s.claim_gang("a/j0", "u2", 4, tenant="a").ok
+        # tenant a is AT entitlement: 4 more chips would all be borrowed,
+        # and a borrower's only escalation would be preemption — refused
+        # as a QUOTA deny even with preempt=True and victims available
+        d = s.claim_gang("a/j1", "u3", 4, tenant="a",
+                         priority=PRIORITY_SERVING, preempt=True)
+        assert not d.ok and d.reason == "quota"
+        assert s.metrics["preemptions_total"] == 0
+
+    def test_under_entitlement_reclaims_borrowed_at_equal_priority(self):
+        tr = Tracer(capacity=256, service="t")
+        s = _sched(capacity=8, cps=4, tracer=tr)
+        s.set_shares({"a": 1.0, "b": 1.0})
+        assert s.claim_gang("a/j0", "u1", 4, tenant="a").ok
+        g = s.claim_gang("a/j1", "u2", 4, tenant="a")
+        assert g.ok and g.borrowed == 4  # tenant a runs over entitlement
+        # tenant b is UNDER entitlement: its equal-priority claim may
+        # reclaim the borrowed gang (counted as a quota reclaim, not a
+        # plain preemption escalation)
+        r = s.claim_gang("b/j0", "u3", 4, tenant="b", preempt=True)
+        assert r.ok and r.preempted == ("a/j1",)
+        assert s.metrics["quota_reclaims_total"] == 1
+        assert s.metrics["preemptions_total"] == 1
+        (preempt,) = [sp for sp in tr.snapshot()
+                      if sp["name"] == "sched.preempt"]
+        assert preempt["attrs"]["reclaim"] is True
+
+    def test_absent_tenant_runs_entirely_on_borrowed(self):
+        s = _sched(capacity=8, cps=4)
+        s.set_shares({"a": 1.0})
+        g = s.claim_gang("ghost/j0", "u1", 2, tenant="ghost")
+        assert g.ok and g.borrowed == 2
+
+
+# --------------------------------------------------- deny / Retry-After
+
+
+class TestDenyRetryAfter:
+    def test_deny_carries_configured_retry_after(self):
+        s = ChipScheduler(capacity=4, chips_per_slice=4, retry_after_s=2.5)
+        d = s.claim_gang("default/a", "u1", 8)
+        assert not d.ok and d.retry_after_s == 2.5 and d.free == 4
+
+    def test_freeze_is_an_admission_only_outage(self):
+        tr = Tracer(capacity=64, service="t")
+        s = _sched(capacity=8, cps=4, tracer=tr)
+        assert s.claim_gang("default/a", "u1", 4).ok
+        s.freeze()
+        d = s.claim_gang("default/b", "u2", 1)
+        assert not d.ok and d.reason == "frozen"
+        (deny,) = [sp for sp in tr.snapshot() if sp["name"] == "sched.deny"]
+        assert deny["attrs"]["reason"] == "frozen"
+        # releases still work while frozen — held work can drain out
+        assert s.release("default/a", uid="u1") == 4
+        s.thaw()
+        assert s.claim_gang("default/b", "u2", 1).ok
+
+    def test_fleet_scaler_deny_path_counts_and_traces(self):
+        """A quota/capacity-blocked serving scale-up: the FleetScaler
+        claims chips BEFORE building an engine, so a Deny leaves the
+        fleet as-is — counted, Retry-After surfaced on last_deny, and
+        traced as fleet.scale_up_denied (the burn signal keeps
+        demanding; the diurnal-storm gate pins the closed loop)."""
+        from types import SimpleNamespace
+
+        from kubeflow_tpu.serving.fleet import FleetRouter, FleetScaler, \
+            ScalerConfig
+
+        tr = Tracer(capacity=256, service="t")
+        s = _sched(capacity=4, cps=4, tracer=tr, retry_after_s=1.25)
+        # exhaust the pool with an EQUAL-priority claim: preemption-
+        # then-grant cannot save this scale-up, so it must be denied
+        assert s.claim_gang("default/a", "u1", 4,
+                            priority=PRIORITY_SERVING).ok
+
+        def never_called():
+            raise AssertionError("engine_factory ran on a denied claim")
+
+        # one idle seat — the scaler only reads liveness fields from it
+        stub = SimpleNamespace(_lock=threading.Lock(), _queue=[],
+                               _rows=[], step_count=0, paged_kv=None)
+        router = FleetRouter([("seat", stub)], tracer=tr)
+        router.demand_replicas = lambda: 2
+        scaler = FleetScaler(
+            router, never_called,
+            ScalerConfig(min_replicas=1, max_replicas=2,
+                         scale_up_cooldown_evals=1),
+            tracer=tr, chipsched=s, chips_per_replica=2)
+        scaler.evaluate()
+        assert scaler.metrics["chip_denies_total"] == 1
+        assert scaler.last_deny is not None
+        assert not scaler.last_deny.ok
+        assert scaler.last_deny.retry_after_s == 1.25
+        assert [r.name for r in router.replicas] == ["seat"]
+        (denied,) = [sp for sp in tr.snapshot()
+                     if sp["name"] == "fleet.scale_up_denied"]
+        assert denied["attrs"]["retry_after_s"] == 1.25
+        # chips free up -> the SAME demand now lands (the burn signal
+        # kept asking): the claim is granted and the factory runs
+        assert s.release("default/a", uid="u1") == 4
+        with pytest.raises(AssertionError, match="denied claim"):
+            scaler.evaluate()
+        assert s.held(scaler._claim_key("scaled-0"))
+
+
+# --------------------------------------- autoscaler paired-read race fix
+
+
+class TestDemandFreeSnapshot:
+    def test_double_count_avoided_is_counted(self):
+        s = _sched(capacity=8, cps=4)
+        s.note_double_count_avoided(4)
+        s.note_double_count_avoided(0)  # no-op
+        assert s.metrics["double_count_avoided_chips_total"] == 4
+
+    def test_demand_and_free_skips_reserved_pending_group(self):
+        """The reserve→flip-Running admission window: a pending group
+        that ALREADY holds its ledger claim must not count as demand on
+        top of used — the one-snapshot read skips it and counts what the
+        old paired reads would have double-counted."""
+        cluster = FakeCluster()
+        cluster.capacity_chips = 8
+        ledger = ChipScheduler(
+            capacity_fn=lambda: cluster.capacity_chips,
+            tracer_fn=lambda: None, chips_per_slice=4)
+        gang = GangScheduler(cluster, chipsched=ledger)
+        jc = JobController(cluster, workers=1)
+        try:
+            jc.start()
+            gang.start()
+            cluster.create("jobs", _batch_job("raced", workers=2,
+                                              topology="2x2"))
+            _wait(lambda: _pg_phase(cluster, "default/raced") == "Running",
+                  gang)
+            pg = cluster.get("podgroups", "default/raced")
+            # re-open the admission window: reservation held, phase
+            # Pending (exactly the state a concurrent bind pass leaves
+            # between reserve and flip)
+            import copy as _copy
+
+            reopened = _copy.deepcopy(pg)
+            reopened.phase = "Pending"
+            cluster.update("podgroups", reopened)
+            demand, free = gang.demand_and_free()
+            assert demand == 0  # NOT re-counted as pending demand
+            assert free == ledger.free_chips() == 4
+            assert ledger.metrics["double_count_avoided_chips_total"] == 4
+        finally:
+            gang.stop()
+            jc.stop()
+
+
+# ----------------------------------------------------- contention drill
+
+
+class TestContentionDrill:
+    def test_hammered_ledger_stays_consistent_under_lockcheck(self):
+        """Many threads claim/release/snapshot one ledger while an
+        evictor re-enters a second lock (the gang-scheduler shape: the
+        only cross-module edge is gang._mu → chipsched._mu, and evictor
+        callbacks run OUTSIDE chipsched._mu — the sched marker arms the
+        lock-order detector and conftest asserts zero cycles)."""
+        s = _sched(capacity=32, cps=8)
+        s.set_shares({"t0": 1.0, "t1": 1.0, "serving": 2.0})
+        from kubeflow_tpu.analysis.lockcheck import make_lock
+
+        outer = make_lock("tests.contention.outer")
+
+        def evictor(key, uid, chips, carrier, by=""):
+            with outer:  # a well-ordered re-entry, never under _mu
+                s.free_chips()
+
+        s.evictor = evictor
+        stop = threading.Event()
+        errors = []
+
+        def gang_worker(i):
+            n = 0
+            while not stop.is_set():
+                key = f"t{i % 2}/g{i}-{n}"
+                g = s.claim_gang(key, f"u{n}", 1 + (n % 4),
+                                 tenant=f"t{i % 2}")
+                if g.ok:
+                    s.grow_gang(key, f"u{n}", n % 2)
+                    s.release(key, uid=f"u{n}")
+                n += 1
+
+        def replica_worker(i):
+            n = 0
+            while not stop.is_set():
+                key = f"fleet/r{i}-{n}"
+                if s.claim_replica(key, chips=1 + (n % 3)).ok:
+                    s.release(key)
+                n += 1
+
+        def reader():
+            while not stop.is_set():
+                snap = s.snapshot()
+                used = sum(c["chips"] for c in snap["claims"])
+                if used != snap["used_chips"]:
+                    errors.append((used, snap["used_chips"]))
+                if snap["used_chips"] + snap["free_chips"] \
+                        != snap["capacity_chips"]:
+                    errors.append(snap)
+                build_sched_report_from_scheduler(s)
+
+        threads = [threading.Thread(target=gang_worker, args=(i,))
+                   for i in range(3)]
+        threads += [threading.Thread(target=replica_worker, args=(i,))
+                    for i in range(2)]
+        threads += [threading.Thread(target=reader)]
+        for t in threads:
+            t.start()
+        time.sleep(0.8)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive()
+        assert errors == []
+        assert s.used_chips() == 0  # every grant was released
+        assert s.free_chips() == 32
+        assert s.metrics["grants_total"] > 0
+
+
+# ---------------------------------------- preempt → gang-restart drill
+
+
+def _batch_job(name, workers=2, topology="2x2", backoff_limit=64):
+    return JAXJob(
+        metadata=ObjectMeta(name=name),
+        spec=JAXJobSpec(
+            replica_specs={REPLICA_WORKER: ReplicaSpec(
+                replicas=workers,
+                # exit 143 (128+SIGTERM) is retryable BY CONSTRUCTION
+                restart_policy=RestartPolicy.EXIT_CODE,
+                template=PodTemplateSpec(
+                    container=ContainerSpec(
+                        command=[sys.executable, "-c", "pass"])))},
+            run_policy=RunPolicy(
+                backoff_limit=backoff_limit,
+                scheduling_policy=SchedulingPolicy(
+                    slice_topology=topology)),
+        ))
+
+
+def _pg_phase(cluster, key):
+    pg = cluster.get("podgroups", key)
+    return pg.phase if pg is not None else None
+
+
+def _wait(cond, gang=None, timeout_s=30.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if gang is not None:
+            gang._try_schedule_safe()
+        if cond():
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+class TestPreemptRestartDrill:
+    def test_preempt_links_gang_restart_and_resumes_warm(self, tmp_path):
+        """The seeded drill (the diurnal storm's transition, isolated):
+        a bound batch gang is evicted by a serving claim — its pods are
+        marked FAILED with the PREEMPTED exit class and the
+        sched.preempt carrier, the job controller gang-restarts it
+        (job.gang_restart parent-links to the preemption), and when the
+        serving claim releases, the gang re-binds with its resume
+        counted and the SAME compile-cache dir in every incarnation
+        (the warm-resume precondition)."""
+        cluster = FakeCluster()
+        cluster.capacity_chips = 8
+        tracer = Tracer(capacity=4096, service="drill")
+        cluster.tracer = tracer
+        ledger = ChipScheduler(
+            capacity_fn=lambda: cluster.capacity_chips,
+            tracer_fn=lambda: cluster.tracer, chips_per_slice=4)
+        gang = GangScheduler(cluster, chipsched=ledger)
+        cache_dir = str(tmp_path / "compile-cache")
+        jc = JobController(
+            cluster, workers=1,
+            heartbeat_dir=str(tmp_path / "heartbeats"),
+            compile_cache_dir=cache_dir)
+        key = "default/drillgang"
+        jc.start()
+        gang.start()
+        try:
+            cluster.create("jobs", _batch_job("drillgang"))
+            _wait(lambda: _pg_phase(cluster, key) == "Running", gang,
+                  what="gang bind")
+            pods1 = [p for p in cluster.list("pods")
+                     if p.group_name == "drillgang"]
+            assert len(pods1) == 2
+            uids1 = {p.metadata.uid for p in pods1}
+            assert {p.env.get(ENV_COMPILE_CACHE_DIR)
+                    for p in pods1} == {cache_dir}
+            # stop the controller so the eviction's FAILED pods are
+            # observable (not instantly recycled by the restart path)
+            jc.stop()
+
+            grant = ledger.claim_replica("fleet/peak", chips=8)
+            assert grant.ok and grant.preempted == (key,)
+            assert ledger.metrics["preemptions_total"] == 1
+            (preempt,) = [sp for sp in tracer.snapshot()
+                          if sp["name"] == "sched.preempt"]
+            assert preempt["attrs"]["victim"] == key
+            assert preempt["attrs"]["by"] == "fleet/peak"
+            # victims wear the PREEMPTED (retryable) exit class and the
+            # preemption span's carrier; the podgroup fell back Pending
+            failed = [p for p in cluster.list("pods")
+                      if p.metadata.uid in uids1]
+            assert len(failed) == 2
+            for p in failed:
+                assert p.status.phase == PodPhase.FAILED
+                assert p.status.exit_code == PREEMPTED_EXIT_CODE == 143
+                assert "chips reclaimed for fleet/peak" \
+                    in p.status.message
+                ctx = SpanContext.from_header(
+                    p.metadata.annotations[CARRIER_ANNOTATION])
+                assert ctx.span_id == preempt["span"]
+                assert ctx.trace_id == preempt["trace"]
+            assert _pg_phase(cluster, key) == "Pending"
+
+            # the controller returns: the gang-restart path owns the
+            # teardown and parent-links to the preemption
+            jc2 = JobController(
+                cluster, workers=1,
+                heartbeat_dir=str(tmp_path / "heartbeats"),
+                compile_cache_dir=cache_dir)
+            jc2.start()
+            try:
+                _wait(lambda: (cluster.get("jobs", key)
+                               .status.restart_count) >= 1,
+                      what="gang restart")
+                _wait(lambda: [sp for sp in tracer.snapshot()
+                               if sp["name"] == "job.gang_restart"],
+                      what="gang_restart span")
+                (restart,) = [sp for sp in tracer.snapshot()
+                              if sp["name"] == "job.gang_restart"]
+                assert restart["trace"] == preempt["trace"]
+                assert restart["parent"] == preempt["span"]
+                # the gang CANNOT re-bind while serving holds the chips
+                _wait(lambda: len(
+                    [p for p in cluster.list("pods")
+                     if p.group_name == "drillgang"
+                     and p.metadata.uid not in uids1]) == 2,
+                    what="recreated pods")
+                gang._try_schedule_safe()
+                assert _pg_phase(cluster, key) == "Pending"
+                # ... until the peak subsides: release -> resume
+                assert ledger.release("fleet/peak") == 8
+                _wait(lambda: _pg_phase(cluster, key) == "Running", gang,
+                      what="gang resume")
+                assert ledger.metrics["resumes_total"] == 1
+                assert len(ledger.preempt_to_resume_s) == 1
+                # the resumed incarnation sees the SAME cache dir the
+                # first one warmed (PR-10 contract over the preemption
+                # path — the zero-compile count gate is the test below)
+                pods2 = [p for p in cluster.list("pods")
+                         if p.group_name == "drillgang"]
+                assert {p.env.get(ENV_COMPILE_CACHE_DIR)
+                        for p in pods2} == {cache_dir}
+                assert {p.metadata.uid for p in pods2} != uids1
+            finally:
+                jc2.stop()
+        finally:
+            gang.stop()
+            jc.stop()
+
+
+# ------------------------------------- warm resume: zero backend compiles
+
+
+@pytest.fixture()
+def _restore_compile_cache_config():
+    """warm_start flips the PROCESS-GLOBAL jax compilation-cache config;
+    later tests in a shared tier-1 process must see the prior state."""
+    import jax
+
+    saved = {
+        k: getattr(jax.config, k) for k in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
+    yield
+    for k, v in saved.items():
+        jax.config.update(k, v)
+
+
+class TestPreemptedResumeIsWarm:
+    def test_zero_backend_compiles_across_preemption(
+            self, tmp_path, _restore_compile_cache_config):
+        """The count gate on the acceptance contract: a preempted gang's
+        resumed incarnation reloads its executables from the compile
+        cache dir the JobController injected into BOTH incarnations
+        (drill above) — zero backend compiles on the warm side."""
+        import jax
+
+        from kubeflow_tpu.models import MnistMLP
+        from kubeflow_tpu.train import Trainer, TrainerConfig
+        from kubeflow_tpu.utils import compile_cache as cc
+
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((32, 32)).astype(np.float32)
+        y = rng.integers(0, 10, size=32).astype(np.int32)
+        cache_dir = str(tmp_path / "compile-cache")
+
+        def incarnation():
+            return Trainer(
+                MnistMLP(hidden=(8,)),
+                TrainerConfig(batch_size=16, log_every_steps=10**9,
+                              compile_cache_dir=cache_dir))
+
+        t1 = incarnation()  # pre-preemption: warms the cache
+        info1 = t1.warm_start(x[:16], y[:16])
+        assert info1["enabled"] and "train_step" in info1["compiled"]
+
+        jax.clear_caches()  # the preemption-driven gang restart
+        before = cc.compile_counts()
+        t2 = incarnation()  # post-resume: same injected cache dir
+        info2 = t2.warm_start(x[:16], y[:16])
+        assert "train_step" in info2["reloaded"]
+        assert info2["backend_misses"] == 0
+        after = cc.compile_counts()
+        assert after["executable_reloads_total"] \
+            > before["executable_reloads_total"]
+
+
+# ------------------------------------------------- /debug/sched surfaces
+
+
+class TestSurfacesAgree:
+    def test_debug_sched_cli_and_report_match(self, tmp_path, capsys,
+                                              monkeypatch):
+        """One frozen fixture, three surfaces: /debug/sched (JSON +
+        text), `kftpu sched --server --json`, and build_sched_report
+        must agree about who holds which chips (the /debug/slo
+        pattern)."""
+        from kubeflow_tpu.apiserver import PlatformServer
+        from kubeflow_tpu.client import Platform
+        from kubeflow_tpu.utils.envvars import ENV_SCHED_CHIPS_PER_SLICE
+
+        monkeypatch.setenv(ENV_SCHED_CHIPS_PER_SLICE, "4")
+        p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=12)
+        with p:
+            s = p.chip_scheduler
+            s.set_shares({"default": 1.0, "serving": 1.0})
+            assert s.claim_gang("default/held", "u1", 4).ok
+            assert s.claim_replica("fleet/r0", chips=2).ok
+            assert not s.claim_gang("default/huge", "u2", 99).ok
+            server = PlatformServer(p, port=0).start()
+            try:
+                with urllib.request.urlopen(
+                        f"{server.url}/debug/sched", timeout=10) as r:
+                    report = json.loads(r.read())
+                with urllib.request.urlopen(
+                        f"{server.url}/debug/sched?format=text",
+                        timeout=10) as r:
+                    text = r.read().decode()
+                assert cli_main(["sched", "--server", server.url,
+                                 "--json"]) == 0
+                cli_report = json.loads(capsys.readouterr().out)
+                assert cli_main(["sched", "--server", server.url]) == 0
+                cli_text = capsys.readouterr().out
+            finally:
+                server.stop()
+            direct = build_sched_report(p)
+            assert cli_report == report == direct
+            assert cli_text == text == render_sched_text(report)
+            assert report["capacity_chips"] == 12
+            assert report["chips_per_slice"] == 4
+            assert report["used_chips"] == 6 and report["free_chips"] == 6
+            assert {c["key"] for c in report["claims"]} \
+                == {"default/held", "fleet/r0"}
+            assert report["tenants"]["default"]["used_chips"] == 4
+            assert report["metrics"]["denies_total"] == 1
+            assert "default/held" in text and "fleet/r0" in text
+            assert "6/12 chips used" in text
+
+    def test_debug_sched_404_without_scheduler(self, tmp_path,
+                                               monkeypatch):
+        from kubeflow_tpu.apiserver import PlatformServer
+        from kubeflow_tpu.client import Platform
+
+        p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=8)
+        with p:
+            monkeypatch.setattr(p, "chip_scheduler", None)
+            with pytest.raises(ValueError, match="no chip scheduler"):
+                build_sched_report(p)
+            server = PlatformServer(p, port=0).start()
+            try:
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(f"{server.url}/debug/sched",
+                                           timeout=10)
+                assert exc.value.code == 404
+            finally:
+                server.stop()
+
+    def test_cli_error_paths(self, capsys):
+        assert cli_main(["sched"]) == 2  # no --server
+        assert cli_main(["sched", "--server",
+                         "http://127.0.0.1:1/closed"]) == 2
+        err = capsys.readouterr().err
+        assert "error:" in err and "Traceback" not in err
+
+    def test_platform_shares_one_ledger(self, tmp_path):
+        """The tentpole wiring contract: ONE inventory — the platform's
+        chip scheduler IS the gang scheduler's ledger, sized by the
+        cluster's live capacity."""
+        from kubeflow_tpu.client import Platform
+
+        p = Platform(log_dir=str(tmp_path / "pod-logs"), capacity_chips=16)
+        with p:
+            assert p.chip_scheduler is p.gang_scheduler.chipsched
+            assert p.chip_scheduler.capacity_chips == 16
+            assert p.chip_scheduler.evictor \
+                == p.gang_scheduler.evict_for_scheduler

@@ -115,6 +115,13 @@ class TestGoldenExposition:
             "kftpu_pod_wire_retries_total",
             "kftpu_pod_handoff_bytes_total",
             "kftpu_pod_heartbeat_age_seconds",
+            "kftpu_sched_grants_total",
+            "kftpu_sched_denies_total",
+            "kftpu_sched_preemptions_total",
+            "kftpu_sched_quota_borrows_total",
+            "kftpu_sched_free_chips",
+            "kftpu_sched_tenant_share",
+            "kftpu_sched_preempt_to_resume_seconds_bucket",
         ):
             assert needle in text, needle
         if os.environ.get("KFTPU_UPDATE_GOLDEN"):

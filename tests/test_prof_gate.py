@@ -182,6 +182,79 @@ class TestPerfGate:
         assert rec["report_requests"]["count"] > 0
         assert rec["rel"]["dropped"] == 0
 
+    def test_sched_freeze_fires_slo_alert_and_fails_gate(self,
+                                                         monkeypatch):
+        """The diurnal-storm teeth (ISSUE 17): KFTPU_PROF_CHAOS=
+        "sched_freeze:1" freezes the ChipScheduler — it keeps denying
+        while the diurnal waves continue, so the fleet's peak scale-up
+        can never claim chips and never preempts the batch gangs. The
+        serving TTFT burn-rate alert must FIRE and the gate must FAIL
+        on the burn, latency, zero-serving-alerts, and drain-overrun
+        rows, while the untouched tree stays alert-quiet (the drill
+        test below). Even frozen, nothing drops — the backlog serves
+        late through the base replica, never lost — and the batch leg
+        is untouched (goodput 1.0: a frozen ledger cannot evict)."""
+        monkeypatch.setenv(ENV_PROF_CHAOS, "sched_freeze:1")
+        results = cpu_proxy.run_all(only="diurnal_storm")
+        violations = cpu_proxy.check_budgets(
+            results, json.loads(BUDGETS.read_text()))
+        assert any("diurnal_storm.slo_burn" in v
+                   for v in violations), violations
+        assert any("diurnal_storm.ttft_p99" in v
+                   for v in violations), violations
+        assert any("diurnal_storm.serving_alerts" in v
+                   for v in violations), violations
+        assert any("diurnal_storm.drain_overrun_frac" in v
+                   for v in violations), violations
+        (rec,) = results
+        assert rec["frozen_scheduler"] is True
+        assert rec["replicas_peak"] == 1
+        assert rec["chip_denies"] >= 1
+        assert rec["sched"]["denies_total"] >= 1
+        assert rec["sched"]["preemptions_total"] == 0
+        assert "serving_ttft_p99" in rec["slo"]["alerts"]
+        st = rec["slo"]["states"]["serving_ttft_p99"]
+        assert st["fired"] is True
+        assert all(b >= 1.0 for b in st["burn_rates"].values())
+        assert rec["dropped_count"] == 0
+        assert rec["batch"]["goodput_min"] == 1.0
+
+    def test_diurnal_storm_drill_contracts(self, monkeypatch):
+        """The diurnal_storm record is ISSUE 17's acceptance drill: the
+        prod_day waves on a chip-CONSTRAINED cluster whose peak cannot
+        fit without preempting batch training. The shared ledger must
+        actually preempt (a real JAXJob gang evicted through the
+        gang-restart path — restart_count moved), the gang must RESUME
+        once the trough hands the chips back, the quota borrow/reclaim
+        cycle must run (the victim was the over-entitlement borrower),
+        and serving must ride through it with zero drops and zero
+        serving SLO violations — the one report alert-quiet."""
+        monkeypatch.delenv(ENV_PROF_CHAOS, raising=False)
+        (rec,) = cpu_proxy.run_all(only="diurnal_storm")
+        assert rec["dropped_count"] == 0
+        assert rec["completed"] == rec["requests"]
+        assert rec["slo"]["serving_alerts"] == []
+        assert rec["slo"]["alerts"] == []
+        # the forced-preemption geometry did force a preemption, and
+        # the evicted gang came back: every gang bound at the end
+        assert rec["sched"]["preemptions_total"] >= 1
+        assert rec["batch"]["preemptions_seen"] >= 1
+        assert rec["batch"]["resumed"] >= 1
+        assert rec["batch"]["resume_ticks"], rec["batch"]
+        assert rec["sched"]["resumes_total"] >= 1
+        # eviction rode the restart path, not a delete-recreate bypass
+        assert any(c >= 1
+                   for c in rec["batch"]["restart_counts"].values())
+        # DRF quota: the victim gang was borrowing over its entitlement
+        # and the serving claim reclaimed it
+        assert rec["sched"]["quota_borrows_total"] >= 1
+        assert rec["sched"]["quota_reclaims_total"] >= 1
+        # the peak actually needed the preempted chips
+        assert rec["replicas_peak"] >= 3
+        assert rec["rel"]["dropped"] == 0
+        assert rec["rel"]["serving_alerts"] == 0.0
+        assert rec["report_requests"]["count"] > 0
+
     def test_restart_warm_zero_backend_compiles(self, monkeypatch):
         """The restart-warm acceptance record (ISSUE 10): the warm
         incarnation of the simulated gang restart performs ZERO backend
