@@ -100,12 +100,15 @@ test-soak:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
 
 # kftpu-pods suite: cross-process pod-backed replicas — real subprocess
-# workers behind the length-prefixed AF_UNIX wire protocol, the
-# digest-checked paged-KV handoff codec, SIGKILL mid-decode zero-drop
-# chain resume, SIGSTOP heartbeat-age hang indictment + scaler
-# replacement, torn-frame retry idempotency, end-to-end deadline
-# propagation, and the serve_pods cpu-proxy gate with its wire-fault
-# teeth (docs/serving.md "Pod-backed replicas")
+# workers behind the length-prefixed wire protocol over BOTH transports
+# (AF_UNIX and kftpu-net's 127.0.0.1 TCP), the digest-checked paged-KV
+# handoff codec, SIGKILL mid-decode zero-drop chain resume, SIGSTOP
+# heartbeat-age hang indictment + scaler replacement, torn-frame retry
+# idempotency, end-to-end deadline propagation, the network failure
+# family (severed-connection replay, stale-epoch 410 fencing, the
+# partition-heal split-brain drill), and the serve_pods/serve_pods_tcp
+# cpu-proxy gates with their wire-fault and net-fault teeth
+# (docs/serving.md "Pod-backed replicas")
 test-pods:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_pods.py -q -m pods
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof

@@ -155,6 +155,26 @@ class WireFault:
 
 
 @dataclass(frozen=True)
+class NetFault:
+    """The TCP failure family on the pod wire — faults AF_UNIX can
+    never produce (serving/fleet/podclient.py). kind='blackhole' eats
+    one outbound frame before delivery (the replay after reconnect is a
+    FIRST delivery); kind='halfopen' delivers the frame but loses the
+    reply (the worker processed it — the retry's replay is a DUPLICATE
+    only rid-dedup and cumulative acks keep exact); kind='dup' loses an
+    ack in flight so the worker redelivers already-applied events (the
+    client's id-filter must refuse every copy); kind='partition' opens
+    a stateful window of `ops` consecutive calls during which every
+    frame is lost in both directions. Each matching call draws at
+    `rate` until `count` injections (windows, for partition) spend."""
+
+    kind: str = "blackhole"
+    rate: float = 0.5
+    ops: int = 3
+    count: int = 1
+
+
+@dataclass(frozen=True)
 class CheckpointFault:
     """save() faults: every save sleeps save_delay_s (slow fsync); every
     torn_every_n-th save is dropped after the delay (torn write under
@@ -182,6 +202,7 @@ class FaultPlan:
     pod_hangs: tuple[PodHang, ...] = ()
     heartbeat_drops: tuple[HeartbeatDrop, ...] = ()
     wire_faults: tuple[WireFault, ...] = ()
+    net_faults: tuple[NetFault, ...] = ()
     checkpoint: CheckpointFault | None = None
 
     @classmethod
@@ -195,8 +216,13 @@ class FaultPlan:
           storage   — checkpoint faults only
           liveness  — hangs, heartbeat drops, restore-side corruption (the
                       failure modes only the health layer can catch)
-          wire      — pod-wire faults only (reset / delay / torn frame on
-                      the podclient transport)
+          wire      — pod-wire faults (reset / delay / torn frame on the
+                      podclient transport) joined with the TCP net
+                      family (black hole / half-open / duplicate
+                      delivery / partition)
+          net       — the TCP net family alone (the serve_pods_tcp
+                      gate's teeth: every fault here is one AF_UNIX
+                      cannot produce)
         """
         rng = random.Random(f"kftpu-chaos-{profile}-{seed}")
         r = lambda lo, hi: round(rng.uniform(lo, hi), 4)  # noqa: E731
@@ -205,9 +231,27 @@ class FaultPlan:
         storage = profile in ("default", "storage")
         liveness = profile == "liveness"
         if profile not in ("default", "apiserver", "pods", "storage",
-                           "liveness", "wire"):
+                           "liveness", "wire", "net"):
             raise ValueError(f"unknown chaos profile {profile!r}")
+
+        def net_draw() -> tuple[NetFault, ...]:
+            return (
+                NetFault("blackhole", rate=r(0.3, 0.7),
+                         count=rng.randint(1, 2)),
+                NetFault("halfopen", rate=r(0.2, 0.5),
+                         count=rng.randint(1, 2)),
+                NetFault("dup", rate=r(0.2, 0.5),
+                         count=rng.randint(1, 2)),
+                NetFault("partition", rate=r(0.1, 0.3),
+                         ops=rng.randint(2, 4), count=1),
+            )
+
+        if profile == "net":
+            return cls(seed=seed, net_faults=net_draw())
         if profile == "wire":
+            # draw order is part of the plan contract: the PR-15 wire
+            # faults draw FIRST (identical to the pre-net plans for a
+            # given seed), the net family extends the same stream after
             return cls(
                 seed=seed,
                 wire_faults=(
@@ -219,6 +263,7 @@ class FaultPlan:
                     WireFault("torn", rate=r(0.3, 0.7),
                               count=rng.randint(1, 3)),
                 ),
+                net_faults=net_draw(),
             )
         if liveness:
             return cls(
@@ -287,6 +332,8 @@ class FaultPlan:
             emit("heartbeat-drop", s)
         for s in self.wire_faults:
             emit("wire-fault", s)
+        for s in self.net_faults:
+            emit("net-fault", s)
         if self.checkpoint is not None:
             emit("checkpoint", self.checkpoint)
         return "\n".join(lines) + "\n"
@@ -337,6 +384,10 @@ class ChaosEngine:
             "wire_resets_total": 0,
             "wire_delays_total": 0,
             "wire_torn_total": 0,
+            "net_blackholes_total": 0,
+            "net_halfopens_total": 0,
+            "net_dups_total": 0,
+            "net_partitions_total": 0,
             "ckpt_saves_delayed_total": 0,
             "ckpt_saves_torn_total": 0,
             "ckpt_restores_corrupted_total": 0,
@@ -347,6 +398,8 @@ class ChaosEngine:
         self._stall_budget = {id(s): s.count for s in plan.start_stalls}
         self._hb_budget = {id(h): h.count for h in plan.heartbeat_drops}
         self._wire_budget = {id(w): w.count for w in plan.wire_faults}
+        self._net_budget = {id(n): n.count for n in plan.net_faults}
+        self._partition_ops_left = 0
         self._kills = [_KillState(k) for k in plan.pod_kills]
         self._hangs = [_KillState(h) for h in plan.pod_hangs]
         self._watch_counts: dict[int, int] = {}
@@ -659,12 +712,22 @@ class ChaosEngine:
     def on_wire_op(self) -> "str | tuple[str, float] | None":
         """Called by PodClient once per wire call. Returns None (clean),
         'reset' (close the socket before sending), 'torn' (truncate the
-        reply mid-read), or ('delay', seconds) — stall the call so a
-        propagated deadline can expire in flight. Like env-carried
-        heartbeat drops, wire budgets never gate quiescent(): the retry
-        layer absorbs them asynchronously and drills assert on the
-        injection counters instead."""
+        reply mid-read), ('delay', seconds) — stall the call so a
+        propagated deadline can expire in flight — or one of the TCP
+        net family: 'blackhole' / 'partition' (frame lost before
+        delivery; a partition repeats for its whole ops window),
+        'halfopen' (frame delivered, reply lost — the retry's replay is
+        a duplicate), 'dup' (ack lost in flight — the worker redelivers
+        applied events). Like env-carried heartbeat drops, wire and net
+        budgets never gate quiescent(): the retry layer absorbs them
+        asynchronously and drills assert on the injection counters
+        instead."""
+        partition_started = False
+        fault: "str | tuple[str, float] | None" = None
         with self._mu:
+            if self._partition_ops_left > 0:
+                self._partition_ops_left -= 1
+                return "partition"
             for w in self.plan.wire_faults:
                 if self._wire_budget.get(id(w), 0) <= 0:
                     continue
@@ -679,7 +742,38 @@ class ChaosEngine:
                     return "torn"
                 self.metrics["wire_delays_total"] += 1
                 return ("delay", w.delay_s)
-        return None
+            for nf in self.plan.net_faults:
+                if self._net_budget.get(id(nf), 0) <= 0:
+                    continue
+                if self.rng.random() >= nf.rate:
+                    continue
+                self._net_budget[id(nf)] -= 1
+                if nf.kind == "partition":
+                    self.metrics["net_partitions_total"] += 1
+                    self._partition_ops_left = max(int(nf.ops) - 1, 0)
+                    partition_started = True
+                    fault = "partition"
+                elif nf.kind == "blackhole":
+                    self.metrics["net_blackholes_total"] += 1
+                    fault = "blackhole"
+                elif nf.kind == "halfopen":
+                    self.metrics["net_halfopens_total"] += 1
+                    fault = "halfopen"
+                else:
+                    self.metrics["net_dups_total"] += 1
+                    fault = "dup"
+                break
+        if partition_started:
+            # mirror into the kftpu_pod_net_* family (outside _mu: the
+            # pod-metrics lock is a leaf shared with the wire path).
+            # Lazy import — chaos.py must stay importable without the
+            # serving tier.
+            from kubeflow_tpu.serving.fleet.podclient import (
+                pod_metric_bump,
+            )
+
+            pod_metric_bump("net_partitions_injected_total")
+        return fault
 
     def pod_env(self, pod) -> dict[str, str]:
         """Extra env for a pod about to launch (PodRuntime._launch_pod):

@@ -301,8 +301,30 @@ def render_metrics(platform) -> str:
         "wire_retries_total": "pod wire ops retried under the backoff "
                               "policy (resets, torn frames, 503 "
                               "backpressure)",
+        "wire_retries_exhausted_total": "pod wire calls that exhausted "
+                                        "the retry policy — the give-up "
+                                        "that escalates to pod death, "
+                                        "visible here instead of only "
+                                        "as an unexplained kill",
         "wire_resets_total": "pod wire connections torn down by fault "
                              "injection (chaos WireFault)",
+        "net_reconnects_total": "pod wire redials AFTER an established "
+                                "connection — each one exercised the "
+                                "rid-dedup + cumulative-ack replay "
+                                "contract",
+        "net_fenced_frames_total": "frames refused by the epoch fence, "
+                                   "both directions: worker 410s to "
+                                   "stale clients and client refusals "
+                                   "of a fenced pod's late acks/tokens",
+        "net_duplicate_acks_refused_total": "redelivered outbox events "
+                                            "dropped by the cumulative-"
+                                            "ack id filter (lost acks, "
+                                            "replayed ticks) — never "
+                                            "double-pushed",
+        "net_partitions_injected_total": "network partitions opened "
+                                         "against pod hosts (chaos "
+                                         "NetFault windows and drill-"
+                                         "driven set_partitioned)",
         "deadline_rejects_total": "pod calls refused 504 — the "
                                   "propagated deadline was spent on "
                                   "arrival",

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import os
 import time
+from functools import partial
 
 from kubeflow_tpu.utils.envvars import ENV_PROF_CHAOS
 
@@ -1298,7 +1299,7 @@ def serve_disagg(rows: int = 2, n_requests: int = 18,
 
 def serve_pods(n_requests: int = 10, body: int = 6, shared_prefix: int = 4,
                new_tokens: int = 5, block: int = 4, kill_tick: int = 6,
-               seed: int = 11) -> dict:
+               seed: int = 11, transport: str = "unix") -> dict:
     """Cross-process pod-backed replicas under a REAL kill
     (docs/serving.md "Pod-backed replicas"): one prefill + two decode
     pods, each a genuine subprocess behind the AF_UNIX wire protocol,
@@ -1337,6 +1338,16 @@ def serve_pods(n_requests: int = 10, body: int = 6, shared_prefix: int = 4,
                                     MUST fail this row — the teeth —
                                     while an untouched tree retries
                                     nothing
+
+    transport="tcp" is the multi-host axis (`serve_pods_tcp` in the
+    budget file): the same drill dialed over 127.0.0.1 TCP, with two
+    extra COUNT rows — net_reconnects (supervisor redials after an
+    established connection, budget 0) and dup_acks_refused (redelivered
+    events the cumulative-ack filter dropped, budget 0). The
+    KFTPU_PROF_CHAOS="net:1" teeth arm the seeded NetFault plan
+    (black-holes, half-open replies, duplicate deliveries, a partition
+    window) on the decode clients and MUST fail those rows while an
+    untouched tree redials and refuses nothing.
     """
     import gc
     import shutil
@@ -1355,6 +1366,7 @@ def serve_pods(n_requests: int = 10, body: int = 6, shared_prefix: int = 4,
 
     repeats = chaos_repeats("decode_tick")
     wire_teeth = chaos_flag("wire")
+    net_teeth = chaos_flag("net")
     unit = _calibration_unit()
     vocab = 256
     prompts = make_prompts(n_requests, seed=seed, vocab=vocab,
@@ -1395,18 +1407,22 @@ def serve_pods(n_requests: int = 10, body: int = 6, shared_prefix: int = 4,
         # handshakes — total cold start is one worker's warmup, not three
         for name, _role in roles:
             clients.append(spawn_pod(name, spec, state_dir,
-                                     home_pool=home, connect=False))
+                                     home_pool=home, connect=False,
+                                     transport=transport))
         for c in clients:
             c.connect()
         chaos_eng = None
-        if wire_teeth:
+        if wire_teeth or net_teeth:
             from kubeflow_tpu.chaos import ChaosEngine, FaultPlan
 
             # armed AFTER connect so startup handshakes never spend the
             # fault budget; decode clients only — the tick/submit path
-            # the drill measures
+            # the drill measures. wire:1 draws the WireFault plan (the
+            # "wire" profile also carries the net draws); net:1 alone
+            # draws only the NetFault plan
+            profile = "wire" if wire_teeth else "net"
             chaos_eng = ChaosEngine(FaultPlan.from_seed(seed,
-                                                        profile="wire"))
+                                                        profile=profile))
             for c in clients[1:]:
                 c.chaos = chaos_eng
         router = FleetRouter([(c.name, c, role)
@@ -1444,7 +1460,12 @@ def serve_pods(n_requests: int = 10, body: int = 6, shared_prefix: int = 4,
                 # the real thing: SIGKILL the worker PROCESS mid-decode;
                 # the client discovers it through the wire, the router
                 # through on_death
-                os.kill(victim.worker_pid, signal.SIGKILL)
+                try:
+                    os.kill(victim.worker_pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    # a chaos-driven wire death (the net:1 partition
+                    # exhausting the retry budget) already reaped it
+                    pass
 
         pod_base = pod_metrics_snapshot()
         gc.collect()
@@ -1455,10 +1476,16 @@ def serve_pods(n_requests: int = 10, body: int = 6, shared_prefix: int = 4,
         rs = report.summary()
         wire_retries = (pod_now["wire_retries_total"]
                         - pod_base["wire_retries_total"])
+        net_reconnects = (pod_now["net_reconnects_total"]
+                          - pod_base["net_reconnects_total"])
+        dup_acks = (pod_now["net_duplicate_acks_refused_total"]
+                    - pod_base["net_duplicate_acks_refused_total"])
         requeued = max(rs["requeued"], 1)
         rescued = rs["requeued"] >= 1 and rs["resumed"] >= 1
-        return {
-            "workload": "serve_pods",
+        rec = {
+            "workload": ("serve_pods_tcp" if transport == "tcp"
+                         else "serve_pods"),
+            "transport": transport,
             "pods": len(clients),
             "requests": n_requests,
             "completed": rs["completed"],
@@ -1472,6 +1499,9 @@ def serve_pods(n_requests: int = 10, body: int = 6, shared_prefix: int = 4,
             "handoff_bytes": (pod_now["handoff_bytes_total"]
                               - pod_base["handoff_bytes_total"]),
             "wire_chaos_armed": wire_teeth,
+            "net_chaos_armed": net_teeth,
+            "net_reconnects": net_reconnects,
+            "dup_acks_refused": dup_acks,
             "replica_killed": killed["done"],
             "anchor": "matmul_unit",
             "anchor_s": round(unit, 6),
@@ -1493,6 +1523,14 @@ def serve_pods(n_requests: int = 10, body: int = 6, shared_prefix: int = 4,
             },
             "tokens_per_s_total": rs["tokens_per_s_total"],
         }
+        if transport == "tcp":
+            # the multi-host rows (COUNTs, budget 0): a redial after an
+            # established connection or a refused redelivery on an
+            # untouched tree is a regression; the net:1 teeth inflate
+            # both on command
+            rec["rel"]["net_reconnects"] = net_reconnects
+            rec["rel"]["dup_acks_refused"] = dup_acks
+        return rec
     finally:
         for c in clients:
             try:
@@ -2007,13 +2045,14 @@ def cplane_storm(n_pods: int = 10000, gang_size: int = 100,
 
 WORKLOADS = ("mlp_train", "grad_overlap", "train_restart_warm",
              "serve_ticks", "serve_fleet", "serve_disagg", "serve_pods",
-             "prod_day", "diurnal_storm", "reconcile_storm",
-             "cplane_storm")
+             "serve_pods_tcp", "prod_day", "diurnal_storm",
+             "reconcile_storm", "cplane_storm")
 
 
 def run_all(only: str = "") -> list[dict]:
-    """Run every workload (or those whose name contains `only`),
-    best-of-2 on each workload's primary gated phase."""
+    """Run every workload (an exact workload name runs just that one;
+    any other `only` filters by substring), best-of-2 on each
+    workload's primary gated phase."""
     fns = {
         "mlp_train": mlp_train,  # per-phase min-of-2 internally
         "grad_overlap": lambda: _best_of(grad_overlap, "overlap_ratio"),
@@ -2029,6 +2068,9 @@ def run_all(only: str = "") -> list[dict]:
             attach={"decode_tick": ("slo",)}),
         "serve_pods": lambda: _min_phases(
             serve_pods, ("ttft_p99", "decode_tick")),
+        "serve_pods_tcp": lambda: _min_phases(
+            partial(serve_pods, transport="tcp"),
+            ("ttft_p99", "decode_tick")),
         "prod_day": lambda: _min_phases(
             prod_day, ("ttft_p99", "slo_burn", "goodput_gap",
                        "restart_overhead_frac"),
@@ -2043,6 +2085,10 @@ def run_all(only: str = "") -> list[dict]:
                                             "reconcile_p50"),
         "cplane_storm": lambda: _best_of(cplane_storm, "to_running"),
     }
+    if only in fns:
+        # exact workload name: run just it ("serve_pods" must not drag
+        # "serve_pods_tcp" along now that transports are an axis)
+        return [fns[only]()]
     return [fns[name]() for name in WORKLOADS
             if not only or only in name]
 
@@ -2126,11 +2172,17 @@ def make_budgets(results: list[dict]) -> dict:
                        # observed cross-run envelope while a real
                        # regression (a serialization stall, a retry
                        # storm) lands 4-10x
+                       # serve_pods_tcp adds the multi-host COUNT rows
+                       # (net_reconnects, dup_acks_refused, both
+                       # budget 0 — the net:1 teeth's landing zone);
+                       # everything else mirrors serve_pods
                        {"ttft_p99": 2.5, "decode_tick": 2.5,
                         "dropped": 1.0, "kill_unrescued": 1.0,
                         "requeue_scratch_frac": 1.0,
-                        "wire_retries": 1.0}
-                       if rec["workload"] == "serve_pods" else
+                        "wire_retries": 1.0, "net_reconnects": 1.0,
+                        "dup_acks_refused": 1.0}
+                       if rec["workload"] in ("serve_pods",
+                                              "serve_pods_tcp") else
                        # prod_day: ttft_p99 is a TICK COUNT from the
                        # seeded schedule (healthy ~5, frozen-scaler
                        # ~35) — 2.0 + the tick slack below clears

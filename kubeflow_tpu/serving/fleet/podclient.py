@@ -25,6 +25,27 @@ autoscaling, and load-test layers. What changes is the failure model:
     The home pool is shared by every PodClient of a fleet, so the
     router's resume-pool invariant holds unchanged.
 
+Transport: the wire rides a wire.Transport — AF_UNIX (single-host) or
+TCP (multi-host; the worker binds 127.0.0.1:0, publishes the port
+atomically through its port file, and echoes it in the hello). A TCP
+fleet inherits the network's failure family, so the client grows three
+orthogonal states beyond `dead`:
+
+  - `partitioned`: the host is unreachable — wire ops fail without
+    touching the socket, retries exhaust into death, and death paths
+    SKIP the process kill (you cannot signal a host you cannot reach);
+    the worker survives the partition, which is the split-brain hazard;
+  - `fenced`: this client's claim on the replica identity is over (the
+    scaler replaced it, or the worker answered 410 to a stale epoch).
+    A fenced client refuses every late ack/token the healed wire could
+    still deliver (counted kftpu_pod_net_fenced_frames_total) — the
+    router-side half of epoch fencing;
+  - reconnects: _ensure_conn redials transparently inside the envelope
+    Deadline; replays are exact because submits are rid-deduped and the
+    outbox is cumulative-acked (a reconnect never replays tokens or
+    drops acks). Redials after an established connection count
+    kftpu_pod_net_reconnects_total.
+
 Locking: `_wire_mu` (socket) is a LEAF — nothing else is ever taken
 under it; `_tick_mu` serializes tick rounds and event dispatch and may
 reach router._mu through callbacks; `_lock` guards the handle table
@@ -39,7 +60,6 @@ from __future__ import annotations
 import json
 import os
 import random
-import socket
 import subprocess
 import sys
 import threading
@@ -53,14 +73,16 @@ from kubeflow_tpu.serving.fleet.wire import (
     PodDead,
     PodDeadlineExpired,
     PodWireError,
-    recv_frame,
-    send_frame,
+    Transport,
+    make_transport,
     serialize_chain,
 )
 from kubeflow_tpu.utils.envvars import (
     ENV_POD_NAME,
+    ENV_POD_PORT_FILE,
     ENV_POD_SOCKET,
     ENV_POD_SPEC,
+    ENV_POD_TRANSPORT,
 )
 from kubeflow_tpu.utils.retry import (
     BackoffPolicy,
@@ -87,13 +109,33 @@ _POD_METRICS = {
     "spawns_total": 0,
     "kills_total": 0,
     "wire_retries_total": 0,
+    "wire_retries_exhausted_total": 0,
     "wire_resets_total": 0,
     "deadline_rejects_total": 0,
     "handoff_bytes_total": 0,
+    "net_reconnects_total": 0,
+    "net_fenced_frames_total": 0,
+    "net_duplicate_acks_refused_total": 0,
+    "net_partitions_injected_total": 0,
 }
 _POD_METRICS_MU = make_lock("fleet.pod_metrics._mu")
 #: live clients, for the heartbeat-age gauge (discarded on death)
 _LIVE_CLIENTS: list["PodClient"] = []
+
+#: the fleet-wide fence epoch — monotonic across every spawn in this
+#: controller process, NEVER reset (a reset could hand a replacement an
+#: epoch its fenced predecessor already used, which is exactly the
+#: split-brain the fence exists to prevent)
+_FENCE_EPOCH = 0
+
+
+def next_fence_epoch() -> int:
+    """Claim the next fence epoch. Every spawn_pod takes one, so a
+    scaler replacement is BORN with a higher epoch than its victim."""
+    global _FENCE_EPOCH
+    with _POD_METRICS_MU:
+        _FENCE_EPOCH += 1
+        return _FENCE_EPOCH
 
 
 def pod_metric_bump(name: str, n: int = 1) -> None:
@@ -234,9 +276,15 @@ class PodClient:
                  policy: BackoffPolicy | None = None,
                  op_timeout_s: float = 30.0,
                  ticks_per_call: int = 1,
-                 chaos=None):
+                 chaos=None,
+                 transport: str = "unix",
+                 port_file: str | None = None,
+                 epoch: int = 0):
         self.name = name
         self.socket_path = socket_path
+        self.transport_kind = transport
+        self.port_file = port_file
+        self.epoch = int(epoch)
         self.proc = proc
         self.heartbeat_path = heartbeat_path
         self.stderr_path = stderr_path
@@ -262,7 +310,9 @@ class PodClient:
         # --- wire state
         self._wire_mu = make_lock("fleet.PodClient._wire_mu")
         self._tick_mu = make_lock("fleet.PodClient._tick_mu")
-        self._sock: socket.socket | None = None
+        self._transport: Transport | None = None
+        self._ever_connected = False
+        self._port: int | None = None      # discovered TCP port
         self._seq = 0
         self._acked = 0
         self._rid_counter = 0
@@ -273,37 +323,75 @@ class PodClient:
         self.dead_reason: str | None = None
         self._death_propagated = False
         self.on_death = None
+        # --- network state (module docstring: the TCP failure family)
+        self.partitioned = False
+        self.fenced = False
+        self.fence_reason: str | None = None
+        #: the worker process belongs to a SUCCESSOR's claim (fenced by
+        #: a 410) — death paths must not kill it out from under the new
+        #: owner. Distinct from `fenced`: a local _fail_all fences too,
+        #: but the process is ours and reachable, so it still dies.
+        self._disowned = False
         self._stop_evt = threading.Event()
         self._thread: threading.Thread | None = None
 
     # --------------------------------------------------------- wire ops
 
     def _close_socket(self) -> None:
-        s, self._sock = self._sock, None
-        if s is not None:
-            try:
-                s.close()
-            except OSError:
-                pass
+        t, self._transport = self._transport, None
+        if t is not None:
+            t.close()
 
-    def _ensure_conn(self, timeout_s: float) -> socket.socket:
-        if self._sock is None:
-            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            s.settimeout(timeout_s)
+    def _resolve_port(self) -> int:
+        """Discover the TCP port the worker published (its port file is
+        written atomically AFTER the bind, so a readable file IS a
+        listening socket)."""
+        if self._port is not None:
+            return self._port
+        if not self.port_file:
+            raise PodWireError(
+                f"pod {self.name}: tcp transport without a port file")
+        try:
+            with open(self.port_file, encoding="utf-8") as fh:
+                self._port = int(fh.read().strip())
+        except (OSError, ValueError) as e:
+            raise PodWireError(f"port file unreadable: {e}") from e
+        return self._port
+
+    def _ensure_conn(self, timeout_s: float) -> Transport:
+        """The connection supervisor: dial (or redial) the worker. A
+        redial after an ESTABLISHED connection is a reconnect — counted,
+        because every one of them exercised the replay contract."""
+        if self._transport is None:
+            if self.transport_kind == "tcp":
+                address = ("127.0.0.1", self._resolve_port())
+            else:
+                address = self.socket_path
+            t = make_transport(self.transport_kind, address)
             try:
-                s.connect(self.socket_path)
+                t.connect(timeout_s)
             except OSError as e:
-                s.close()
                 raise PodWireError(f"connect failed: {e}") from e
-            self._sock = s
+            self._transport = t
+            if self._ever_connected:
+                pod_metric_bump("net_reconnects_total")
+            self._ever_connected = True
         else:
-            self._sock.settimeout(timeout_s)
-        return self._sock
+            self._transport.settimeout(timeout_s)
+        return self._transport
 
     def _attempt(self, verb: str, payload: dict,
-                 deadline: Deadline | None, timeout_s: float) -> dict:
-        if self.dead:
-            raise PodDead(self.dead_reason or f"pod {self.name} dead")
+                 deadline: Deadline | None, timeout_s: float,
+                 bypass_fence: bool = False) -> dict:
+        if (self.dead or self.fenced) and not bypass_fence:
+            raise PodDead(self.dead_reason or self.fence_reason
+                          or f"pod {self.name} dead")
+        if self.partitioned:
+            # unreachable host: nothing crosses the wire in either
+            # direction — the retry layer backs off and (inside the
+            # Deadline) either outlives the partition or exhausts
+            raise PodWireError(
+                f"pod {self.name} unreachable (partitioned)")
         fault = self.chaos.on_wire_op() if self.chaos is not None \
             else None
         if isinstance(fault, tuple):  # ("delay", s): stall in flight
@@ -316,22 +404,44 @@ class PodClient:
                 self._close_socket()
                 pod_metric_bump("wire_resets_total")
                 raise PodWireError("chaos: connection reset")
+            if fault in ("partition", "blackhole"):
+                # the frame is lost BEFORE delivery (a black hole eats
+                # it; a partition never carries it) — the worker sees
+                # nothing, so the replay after reconnect is the first
+                # delivery, not a duplicate
+                self._close_socket()
+                raise PodWireError(f"chaos: {fault} (frame lost)")
             self._seq += 1
-            env = {"verb": verb, "seq": self._seq,
+            env = {"verb": verb, "seq": self._seq, "epoch": self.epoch,
                    "deadline_s": (deadline.remaining()
                                   if deadline is not None else None)}
             env.update(payload)
+            if fault == "dup" and "ack" in payload:
+                # duplicate delivery, modeled at its true cause: the
+                # previous ack is lost in flight, so the worker's outbox
+                # keeps everything the client already applied and
+                # redelivers it — the id-filter refuses every copy
+                # (kftpu_pod_net_duplicate_acks_refused_total)
+                env["ack"] = 0
             try:
-                sock = self._ensure_conn(timeout_s)
-                send_frame(sock, env)
+                tr = self._ensure_conn(timeout_s)
+                tr.send_frame(env)
+                if fault == "halfopen":
+                    # half-open connection: the frame WAS delivered (the
+                    # worker processes it) but the reply never comes —
+                    # the retry replays the verb, and only rid-dedup +
+                    # cumulative acks keep that replay exact
+                    self._close_socket()
+                    raise PodWireError(
+                        "chaos: half-open connection (reply lost)")
                 if fault == "torn":
                     # truncate the reply mid-read, then drop the
                     # connection: exactly the partial frame the length
                     # prefix exists to detect
-                    sock.recv(2)
+                    tr.sock.recv(2)
                     self._close_socket()
                     raise PodWireError("chaos: torn frame")
-                reply = recv_frame(sock)
+                reply = tr.recv_frame()
             except OSError as e:
                 self._close_socket()
                 raise PodWireError(f"{type(e).__name__}: {e}") from e
@@ -345,6 +455,21 @@ class PodClient:
         if reply.get("ok"):
             return reply
         code = int(reply.get("code", 500))
+        if code == 410:
+            # the worker adopted a NEWER epoch: this client's claim on
+            # the replica identity is over. Fence (terminal — late
+            # events will be refused) but never kill the process: it
+            # now belongs to the successor's claim.
+            pod_metric_bump("net_fenced_frames_total")
+            self._disowned = True
+            # free the wire at once: the worker serves one connection
+            # at a time, and holding this one would starve the very
+            # successor whose epoch just outranked us
+            self._close_socket()
+            self.fence(f"worker refused stale epoch {self.epoch}: "
+                       f"{reply.get('error', '410')}")
+            raise PodDead(
+                f"pod {self.name} fenced: {reply.get('error', '410')}")
         if code == 503:
             # server-side backpressure: honor Retry-After within the
             # caller's budget, then let the retry layer re-dial
@@ -360,7 +485,8 @@ class PodClient:
 
     def call(self, verb: str, payload: dict | None = None, *,
              deadline: Deadline | None = None,
-             timeout_s: float | None = None) -> dict:
+             timeout_s: float | None = None,
+             _bypass_fence: bool = False) -> dict:
         """One wire verb under the retry policy. Raises PodWireError on
         exhausted transport faults, PodDeadlineExpired on a spent
         budget, PodCallError on an application refusal, PodDead once
@@ -371,17 +497,20 @@ class PodClient:
         def attempt():
             nonlocal attempts
             attempts += 1
-            return self._attempt(verb, dict(payload or {}), deadline, t)
+            return self._attempt(verb, dict(payload or {}), deadline, t,
+                                 bypass_fence=_bypass_fence)
 
         try:
             out = retry_call(attempt, policy=self.policy,
                              retry_on=(PodWireError,), rng=self._rng)
         except PodWireError:
-            # exhaustion escalating to pod death: accounted by
-            # kills_total, not as N "absorbed" retries — the
-            # wire_retries family counts only faults the retry layer
-            # actually rode through (the serve_pods gate pins it 0 on a
-            # healthy tree, >0 under the WireFault chaos)
+            # exhaustion escalating to pod death: the N absorbed faults
+            # stay OUT of wire_retries (that family counts only faults
+            # the retry layer actually rode through — the serve_pods
+            # gate pins it 0 on a healthy tree) but the give-up itself
+            # must be visible on /metrics, not just as a kills_total
+            # increment with no cause attached
+            pod_metric_bump("wire_retries_exhausted_total")
             raise
         if attempts > 1:
             pod_metric_bump("wire_retries_total", attempts - 1)
@@ -390,19 +519,34 @@ class PodClient:
     # ---------------------------------------------------------- spawn
 
     def connect(self, timeout_s: float = 180.0) -> "PodClient":
-        """Wait for the worker's socket (bound only after its in-process
-        warmup) and complete the hello handshake."""
+        """Wait for the worker's rendezvous artifact — the AF_UNIX
+        socket path, or the TCP port file (both appear only after the
+        in-process warmup) — and complete the hello handshake. On TCP
+        the hello echoes the worker's bound port, which must match the
+        discovered one (a stale port file from a previous incarnation
+        would otherwise silently dial a stranger)."""
+        rendezvous = (self.port_file if self.transport_kind == "tcp"
+                      else self.socket_path)
 
         def ready():
             if self.proc is not None and self.proc.poll() is not None:
                 raise PodDead(
                     f"pod {self.name} exited rc={self.proc.returncode} "
                     f"before ready (stderr: {self.stderr_path})")
-            return True if os.path.exists(self.socket_path) else None
+            return True if (rendezvous
+                            and os.path.exists(rendezvous)) else None
 
         poll_until(ready, timeout_s=timeout_s,
-                   describe=f"pod {self.name} socket")
+                   describe=f"pod {self.name} {self.transport_kind} "
+                            f"rendezvous")
         hello = self.call("hello", timeout_s=max(self.op_timeout_s, 10.0))
+        if self.transport_kind == "tcp":
+            echoed = hello.get("port")
+            if echoed is not None and self._port is not None \
+                    and int(echoed) != self._port:
+                raise PodDead(
+                    f"pod {self.name} hello port {echoed} != "
+                    f"discovered {self._port}")
         self.worker_pid = int(hello["pid"])
         self.default_max_new_tokens = int(
             hello["default_max_new_tokens"])
@@ -443,8 +587,9 @@ class PodClient:
         fire callbacks (the router holds its own lock) — the pod is
         marked quietly dead and PodDead raised; the router's dispatch
         loop re-picks and propagates the death after releasing _mu."""
-        if self.dead:
-            raise PodDead(self.dead_reason or f"pod {self.name} dead")
+        if self.dead or self.fenced:
+            raise PodDead(self.dead_reason or self.fence_reason
+                          or f"pod {self.name} dead")
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         budget = int(max_new_tokens or self.default_max_new_tokens)
         with self._lock:
@@ -524,8 +669,25 @@ class PodClient:
             except (PodWireError, OSError) as e:
                 self._mark_dead(f"wire failure during tick: {e}")
                 return False
-            except PodDead:
-                self._propagate_death()
+            except PodDead as e:
+                if self.fenced and not self.dead:
+                    # fenced mid-tick (410): terminal for the replica,
+                    # but the PROCESS belongs to the successor now —
+                    # _quiet_dead's fenced guard skips the kill
+                    self._mark_dead(f"fenced: {e}")
+                else:
+                    self._propagate_death()
+                return False
+            if self.fenced or self.dead:
+                # the fence raced the round-trip: a kill/replace landed
+                # while this frame was in flight. Whatever the reply
+                # carries is a LATE delivery from a superseded claim —
+                # refuse every event, ack nothing (the router-side half
+                # of epoch fencing).
+                late = list(reply.get("events", ()))
+                if late:
+                    pod_metric_bump("net_fenced_frames_total",
+                                    len(late))
                 return False
             self.step_count = int(
                 reply.get("step_count", self.step_count))
@@ -536,8 +698,15 @@ class PodClient:
                 reply.get("prefill_tokens_reused",
                           self.prefill_tokens_reused))
             self._worker_depth = int(reply.get("depth", 0))
-            events = [e for e in reply.get("events", ())
+            raw = list(reply.get("events", ()))
+            events = [e for e in raw
                       if int(e.get("id", 0)) > self._acked]
+            if len(raw) > len(events):
+                # redelivery of already-acked events (a lost ack, a
+                # replayed tick after reconnect): each copy is refused
+                # by the cumulative-ack filter, never double-pushed
+                pod_metric_bump("net_duplicate_acks_refused_total",
+                                len(raw) - len(events))
             if events:
                 self._acked = int(events[-1]["id"])
             for ev in events:
@@ -673,14 +842,28 @@ class PodClient:
 
     def _quiet_dead(self, reason: str) -> bool:
         """Flip dead, close the wire, reap the process — NO callbacks
-        (safe under router._mu). Returns True on the first flip."""
+        (safe under router._mu). Returns True on the first flip.
+
+        The process kill is SKIPPED for a partitioned or disowned pod:
+        an unreachable host cannot be signaled, and a 410-fenced
+        worker is already serving its successor's claim — in both
+        cases the worker SURVIVES this death, which is exactly the
+        split-brain hazard the epoch fence exists to neutralize. (A
+        LOCAL fence — _fail_all on a reachable host — still kills: the
+        process is ours.)"""
         with self._lock:
             if self.dead:
                 return False
             self.dead, self.dead_reason = True, reason
         self._stop_evt.set()
         self._close_socket()
-        self._kill_process()
+        if self.partitioned or self._disowned:
+            # the worker outlives this death — whatever it still holds
+            # is a superseded claim and must be refused if the wire
+            # ever heals
+            self.fence(reason)
+        else:
+            self._kill_process()
         _unregister_live(self)
         pod_metric_bump("kills_total")
         return True
@@ -711,9 +894,69 @@ class PodClient:
 
     def _fail_all(self, reason: str) -> None:
         """The router's kill_replica contract (after its alive flip):
-        terminate the pod and requeue everything it carried."""
+        terminate the pod and requeue everything it carried. The kill
+        decision FENCES first — from this point every late ack/token
+        the wire could still deliver (a partition healing after the
+        scaler replaced this replica) is a superseded claim and will be
+        refused, so the requeued rids can never stream twice."""
+        self.fence(reason)
         self._quiet_dead(reason)
         self._propagate_death()
+
+    # ---------------------------------------------------------- fencing
+
+    def fence(self, reason: str) -> None:
+        """Permanently fence this client: its claim on the replica
+        identity is over (scaler replacement, or a worker 410).
+        Idempotent; fencing itself touches no process — whether the
+        worker dies is _quiet_dead's decision (it spares partitioned
+        and disowned workers). A fenced client refuses every event the
+        wire still delivers (net_fenced_frames_total counts each)."""
+        with self._lock:
+            if self.fenced:
+                return
+            self.fenced = True
+            self.fence_reason = reason
+
+    def set_partitioned(self, value: bool) -> None:
+        """Model a network partition to this pod's host: wire ops fail
+        without touching the socket (nothing crosses in either
+        direction) and death paths skip the process kill — the worker
+        keeps running, unreachable. Healing (False) restores the wire;
+        whether frames are then ACCEPTED is the fence's decision."""
+        if value and not self.partitioned:
+            pod_metric_bump("net_partitions_injected_total")
+        self.partitioned = bool(value)
+        if value:
+            with self._wire_mu:
+                self._close_socket()
+
+    def fenced_poll(self, timeout_s: float | None = None) -> dict:
+        """The split-brain drill's heal probe: one tick round-trip
+        against a FENCED pod's still-running worker (bypassing the dead
+        gate), receiving whatever late events its outbox holds — and
+        refusing every one of them. Nothing is acked, no handle is
+        touched; the return value reports what the fenced claim WOULD
+        have delivered, which the drill pins as its zero-duplicate
+        proof. Raises if the pod is not fenced, PodWireError if the
+        worker is unreachable."""
+        if not self.fenced:
+            raise RuntimeError(f"pod {self.name} is not fenced")
+        with self._tick_mu:
+            reply = self.call("tick", {"ack": self._acked, "n": 1},
+                              timeout_s=timeout_s, _bypass_fence=True)
+            late = [e for e in reply.get("events", ())
+                    if int(e.get("id", 0)) > self._acked]
+            if late:
+                pod_metric_bump("net_fenced_frames_total", len(late))
+            return {
+                "late_events": len(late),
+                "late_tokens": sum(1 for e in late
+                                   if e.get("ev") == "token"),
+                "late_done": sum(1 for e in late
+                                 if e.get("ev") == "done"),
+                "refused": len(late),
+            }
 
 
 # ----------------------------------------------------------- fleet glue
@@ -748,23 +991,32 @@ def spawn_pod(name: str, spec: dict, state_dir: str, *,
               op_timeout_s: float = 30.0, chaos=None,
               startup_timeout_s: float = 240.0,
               env_extra: dict | None = None,
-              connect: bool = True) -> PodClient:
+              connect: bool = True,
+              transport: str = "unix") -> PodClient:
     """Launch one worker subprocess and return its connected client.
 
     The pod env contract rides os.environ (KFTPU_TRACE_DIR /
     KFTPU_TRACEPARENT pass through untouched, so worker spans land in
     the same trace dir the controller merges) plus the pod's own
     socket/name/spec variables and a per-pod heartbeat file; stderr
-    goes to `<state_dir>/<name>.stderr.log` for post-mortems."""
+    goes to `<state_dir>/<name>.stderr.log` for post-mortems.
+
+    transport="tcp" puts the wire on 127.0.0.1 TCP: the worker binds an
+    ephemeral port, publishes it through `<state_dir>/<name>.port`, and
+    echoes it in the hello. Every spawn claims the next fence epoch, so
+    a scaler replacement is born with a higher epoch than its victim —
+    the split-brain fence's foundation."""
     os.makedirs(state_dir, exist_ok=True)
     spec_path = os.path.join(state_dir, f"{name}.spec.json")
     with open(spec_path, "w", encoding="utf-8") as fh:
         json.dump(spec, fh)
     sock_path = os.path.join(state_dir, f"{name}.sock")
-    try:
-        os.unlink(sock_path)
-    except OSError:
-        pass
+    port_file = os.path.join(state_dir, f"{name}.port")
+    for stale in (sock_path, port_file):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
     hb_path = os.path.join(state_dir, f"{name}.hb")
     stderr_path = os.path.join(state_dir, f"{name}.stderr.log")
     from kubeflow_tpu.utils.envvars import ENV_HEARTBEAT_FILE
@@ -773,6 +1025,9 @@ def spawn_pod(name: str, spec: dict, state_dir: str, *,
     env[ENV_POD_SOCKET] = sock_path
     env[ENV_POD_NAME] = name
     env[ENV_POD_SPEC] = spec_path
+    env[ENV_POD_TRANSPORT] = transport
+    if transport == "tcp":
+        env[ENV_POD_PORT_FILE] = port_file
     env[ENV_HEARTBEAT_FILE] = hb_path
     env["JAX_PLATFORMS"] = "cpu"
     env.update(env_extra or {})
@@ -786,7 +1041,10 @@ def spawn_pod(name: str, spec: dict, state_dir: str, *,
     client = PodClient(name, sock_path, proc=proc,
                        heartbeat_path=hb_path, stderr_path=stderr_path,
                        policy=policy, op_timeout_s=op_timeout_s,
-                       chaos=chaos)
+                       chaos=chaos, transport=transport,
+                       port_file=(port_file if transport == "tcp"
+                                  else None),
+                       epoch=next_fence_epoch())
     client.paged_kv = home_pool
     if connect:
         try:

@@ -1,10 +1,13 @@
-"""Pod worker — one ContinuousBatcher behind an AF_UNIX wire socket.
+"""Pod worker — one ContinuousBatcher behind a wire socket.
 
 ``python -m kubeflow_tpu.serving.fleet.podworker`` is the serving tier's
 real process boundary: the fleet spawns one of these per replica
 (podclient.spawn_pod), each hosting its own model, paged-KV pool, and
 engine, reachable only through the length-prefixed JSON protocol in
-wire.py. The worker is deliberately SINGLE-THREADED — one connection,
+wire.py — over AF_UNIX (single-host, the PR-15 wire) or TCP
+(KFTPU_POD_TRANSPORT=tcp: bind 127.0.0.1:0, write the kernel-chosen
+port atomically to KFTPU_POD_NET_PORT_FILE, and echo it back through
+the hello so the dial side can cross-check discovery). The worker is deliberately SINGLE-THREADED — one connection,
 one verb at a time, engine ticks driven by the client's `tick` verb —
 so the process owns no locks and a SIGKILL can never leave a
 half-updated shared structure behind; all cross-request state the
@@ -26,6 +29,15 @@ just redelivers (the client dedups by event id). Submits are idempotent
 by request id for the same reason. Backpressure is HTTP-shaped: a full
 queue answers 503 with retry_after_s, an expired propagated deadline
 answers 504 — the client's retry policy (utils/retry) honors both.
+
+Epoch fencing (the TCP failure family): every envelope carries the
+sender's fence epoch. A hello with a HIGHER epoch adopts it (the
+scaler's replacement taking over the replica identity); any frame with
+a LOWER epoch than the adopted one answers 410 — a partitioned client
+that resurfaces after its replacement attached can neither submit nor
+tick, so a partition heal can never produce two replicas serving the
+same rid. The refusal is symmetric: the client fences itself on the
+first 410 and refuses the worker's late acks/tokens (podclient.py).
 """
 
 from __future__ import annotations
@@ -46,8 +58,10 @@ from kubeflow_tpu.serving.fleet.wire import (
 )
 from kubeflow_tpu.utils.envvars import (
     ENV_POD_NAME,
+    ENV_POD_PORT_FILE,
     ENV_POD_SOCKET,
     ENV_POD_SPEC,
+    ENV_POD_TRANSPORT,
 )
 
 
@@ -62,6 +76,8 @@ class PodServer:
         self._next_event_id = 1
         self._seen_rids: set[str] = set()    # submit idempotency
         self._dying: str | None = None       # poisoned-engine reason
+        self._epoch = 0                      # adopted fence epoch
+        self._port: int | None = None        # bound TCP port (tcp only)
         self.engine, self.pool = self._build_engine()
         from kubeflow_tpu.health import HeartbeatWriter
 
@@ -192,6 +208,16 @@ class PodServer:
         if deadline_s is not None and float(deadline_s) <= 0.0:
             return error_reply(seq, 504,
                                f"deadline expired before {verb!r}")
+        # fence gate: stale epochs are refused on EVERY verb — a
+        # presumed-dead client resurfacing after its replacement adopted
+        # a higher epoch can neither submit nor tick (410, terminal on
+        # the client side). A hello with a higher epoch is the adoption
+        # itself (done in _verb_hello so its echo carries the result).
+        env_epoch = int(env.get("epoch", 0))
+        if env_epoch < self._epoch:
+            return error_reply(
+                seq, 410, f"stale epoch {env_epoch} < {self._epoch}: "
+                          f"{verb!r} refused (fenced)")
         fn = getattr(self, f"_verb_{verb}", None)
         if fn is None:
             return error_reply(seq, 400, f"unknown verb {verb!r}")
@@ -202,12 +228,27 @@ class PodServer:
 
     def _verb_hello(self, seq: int, env: dict) -> dict:
         eng = self.engine
+        # epoch adoption: handle() already refused anything stale, so
+        # this hello is the newest claimant — adopt its epoch and echo
+        # it (with the bound TCP port) so the dial side can cross-check
+        # discovery against what the worker actually serves
+        env_epoch = int(env.get("epoch", 0))
+        if env_epoch > self._epoch:
+            # a STRICTLY newer claim starts from a clean slate: the
+            # superseded claim's undelivered events and rid-dedup
+            # entries must never leak into the successor's streams (a
+            # same-epoch hello is a reconnect of the same claim, where
+            # redelivery IS the replay contract — keep everything)
+            self._events.clear()
+            self._seen_rids.clear()
+        self._epoch = max(self._epoch, env_epoch)
         return ok_reply(
             seq, name=self.name, pid=os.getpid(),
             default_max_new_tokens=eng.default_max_new_tokens,
             eos_token_id=(list(eng.eos_token_id)
                           if eng.eos_token_id else None),
-            block_size=self.pool.block_size)
+            block_size=self.pool.block_size,
+            epoch=self._epoch, port=self._port)
 
     def _depth(self) -> int:
         eng = self.engine
@@ -305,13 +346,29 @@ class PodServer:
 
     # ------------------------------------------------------------ serve
 
-    def serve(self, sock_path: str) -> None:
-        try:
-            os.unlink(sock_path)
-        except OSError:
-            pass
-        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        srv.bind(sock_path)
+    def serve(self, sock_path: str, transport: str = "unix",
+              port_file: str | None = None) -> None:
+        if transport == "tcp":
+            # multi-host wire: bind loopback on a kernel-chosen port and
+            # publish it ATOMICALLY (write-then-rename) — the dial side
+            # polls the port file the way it polls the AF_UNIX socket
+            # path, and a torn partial write must never read as a port
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(("127.0.0.1", 0))
+            self._port = int(srv.getsockname()[1])
+            if port_file:
+                tmp = f"{port_file}.{os.getpid()}.tmp"
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    fh.write(str(self._port))
+                os.replace(tmp, port_file)
+        else:
+            try:
+                os.unlink(sock_path)
+            except OSError:
+                pass
+            srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            srv.bind(sock_path)
         srv.listen(1)
         if self.hb is not None:
             self.hb.beat(step=0, phase="serve")
@@ -373,7 +430,11 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
     name = os.environ.get(ENV_POD_NAME, "pod")
-    sock_path = os.environ[ENV_POD_SOCKET]
+    transport = os.environ.get(ENV_POD_TRANSPORT, "unix")
+    sock_path = os.environ.get(ENV_POD_SOCKET, "")
+    port_file = os.environ.get(ENV_POD_PORT_FILE) or None
+    if transport != "tcp" and not sock_path:
+        raise KeyError(ENV_POD_SOCKET)
     with open(os.environ[ENV_POD_SPEC], encoding="utf-8") as fh:
         spec = json.load(fh)
     if spec.get("compile_cache_dir"):
@@ -392,7 +453,7 @@ def main() -> int:
     server = PodServer(name, spec, tracer=tracer)
     print(f"[podworker {name}] ready in {time.perf_counter() - t0:.2f}s "
           f"pid={os.getpid()}", file=sys.stderr, flush=True)
-    server.serve(sock_path)
+    server.serve(sock_path, transport=transport, port_file=port_file)
     return 0
 
 

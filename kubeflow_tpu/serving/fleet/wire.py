@@ -126,6 +126,117 @@ def recv_frame(sock: socket.socket) -> dict:
     return obj
 
 
+# ----------------------------------------------------------- transport
+
+
+class Transport:
+    """The dial-side wire seam: one object owning one stream socket,
+    speaking the length-prefixed JSON framing above. The pod client
+    holds a Transport instead of a raw socket so AF_UNIX (single-host,
+    PR-15) and TCP (multi-host) are the SAME code path — connect /
+    send_frame / recv_frame / close is the whole contract, and every
+    fault surfaces as OSError (dial/send) or PodWireError (recv), which
+    the client's retry supervisor already knows how to absorb.
+
+    `sock` stays a public attribute on purpose: the chaos engine's
+    torn-frame injection reads a deliberate partial frame straight off
+    the socket, and tests reach in to sever a connection out from under
+    the client (the ECONNRESET drill)."""
+
+    #: wire kind tag ("unix" | "tcp") — carried into hellos and logs
+    kind = "base"
+    _family = -1
+
+    def __init__(self, address):
+        self.address = address
+        self.sock: socket.socket | None = None
+
+    def connect(self, timeout_s: float | None = None) -> "Transport":
+        """Dial `address`; OSError propagates (the client's startup
+        poll and redial supervisor own the retry decision)."""
+        s = socket.socket(self._family, socket.SOCK_STREAM)
+        if timeout_s is not None:
+            s.settimeout(timeout_s)
+        try:
+            s.connect(self.address)
+        except OSError:
+            s.close()
+            raise
+        self.sock = s
+        return self
+
+    @property
+    def connected(self) -> bool:
+        return self.sock is not None
+
+    def settimeout(self, timeout_s: float | None) -> None:
+        if self.sock is not None:
+            self.sock.settimeout(timeout_s)
+
+    def send_frame(self, obj: dict) -> int:
+        if self.sock is None:
+            raise PodWireError(f"{self.kind} transport is not connected")
+        return send_frame(self.sock, obj)
+
+    def recv_frame(self) -> dict:
+        if self.sock is None:
+            raise PodWireError(f"{self.kind} transport is not connected")
+        return recv_frame(self.sock)
+
+    def close(self) -> None:
+        s, self.sock = self.sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        state = "connected" if self.connected else "idle"
+        return f"<{type(self).__name__} {self.address!r} {state}>"
+
+
+class UnixTransport(Transport):
+    """AF_UNIX stream transport — the PR-15 single-host wire."""
+
+    kind = "unix"
+    _family = socket.AF_UNIX
+
+    def __init__(self, path: str):
+        super().__init__(str(path))
+
+
+class TcpTransport(Transport):
+    """TCP transport for multi-host fleets. Loopback-only in this tree
+    (the worker binds 127.0.0.1 and hands the kernel-chosen port back
+    through its port file + hello echo); NODELAY is set because every
+    frame is a complete request/reply — Nagle would serialize the tick
+    cadence behind delayed acks for zero batching benefit."""
+
+    kind = "tcp"
+    _family = socket.AF_INET
+
+    def __init__(self, address: tuple[str, int]):
+        host, port = address
+        super().__init__((str(host), int(port)))
+
+    def connect(self, timeout_s: float | None = None) -> "Transport":
+        super().connect(timeout_s)
+        assert self.sock is not None
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return self
+
+
+def make_transport(kind: str, address) -> Transport:
+    """Build the transport for `kind` ("unix" | "tcp"). The address is
+    a socket path for unix, a (host, port) pair for tcp."""
+    if kind == "unix":
+        return UnixTransport(address)
+    if kind == "tcp":
+        return TcpTransport(address)
+    raise ValueError(f"unknown pod transport kind: {kind!r}")
+
+
 # --------------------------------------------------------- chain codec
 
 

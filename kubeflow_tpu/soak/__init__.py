@@ -21,20 +21,32 @@ path, resumed when the trough frees chips, gated on preemption-to-
 resume latency, zero serving SLO violations, and a batch goodput
 floor. `KFTPU_PROF_CHAOS="sched_freeze:1"` (the ledger stops granting)
 is its teeth. docs/scheduler.md is the guide.
+
+kftpu-net re-composes the day on REAL pods (`run_prod_day_pods`): a
+spawn_pod TCP fleet where the kills are SIGKILLs discovered through the
+wire, the hang is a SIGSTOP indicted by heartbeat age, and a mid-peak
+network partition heals only after the scaler has replaced the victim —
+the fenced claim's late deliveries are then read back and refused
+(epoch fencing, docs/serving.md), gated on dropped == 0 EXACT and
+zero duplicate tokens.
 """
 
 from kubeflow_tpu.soak.scenario import (
+    PodSoakConfig,
     SoakConfig,
     StormConfig,
     calibrated_default_slos,
     run_diurnal_storm,
     run_prod_day,
+    run_prod_day_pods,
 )
 
 __all__ = [
+    "PodSoakConfig",
     "SoakConfig",
     "StormConfig",
     "calibrated_default_slos",
     "run_diurnal_storm",
     "run_prod_day",
+    "run_prod_day_pods",
 ]

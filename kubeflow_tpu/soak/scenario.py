@@ -1185,3 +1185,389 @@ def run_diurnal_storm(cfg: StormConfig | None = None,
             "tsdb": report["tsdb"],
         },
     }
+
+
+# ------------------------------------------------ the day, on real pods
+
+
+@dataclass(frozen=True)
+class PodSoakConfig:
+    """One compact production day on REAL pod subprocesses over the TCP
+    transport: every replica is a podworker behind the length-prefixed
+    wire, so the day's faults cross actual sockets. The in-process day
+    (SoakConfig / run_prod_day) owns the scale-to-zero and SLO-burn
+    story; this one owns the NETWORK failure matrix — a SIGKILL
+    discovered through the wire, a SIGSTOP indicted by heartbeat age,
+    and a mid-peak partition that heals only AFTER the scaler has
+    replaced the victim, with the fenced claim's late deliveries
+    refused (docs/serving.md "Pod-backed replicas": epoch fencing).
+    Sized to run in seconds on CPU behind a shared XLA compile cache."""
+
+    seed: int = 23
+    day_ticks: int = 90
+    #: diurnal peaks in arrivals/tick (arrival_rate reads these through
+    #: the same two-peak profile as the in-process day)
+    peak1_rate: float = 0.5
+    peak2_rate: float = 0.6
+    prompt_body: int = 4
+    shared_prefix: int = 2
+    new_tokens: int = 4
+    block: int = 4
+    #: fleet bounds; the floor is 2 so every fault's victim is REPLACED
+    #: (the partition-heal gate is "heal after replacement") — the
+    #: scale-down/scale-to-zero transitions belong to run_prod_day
+    min_replicas: int = 2
+    max_replicas: int = 3
+    #: pre-spawned warm workers: initial replica + min-floor top-up +
+    #: peak headroom + one replacement per injected fault
+    standby: int = 6
+    #: fault schedule, day fractions: SIGKILL in peak 1, SIGSTOP early
+    #: peak 2, partition late peak 2
+    kill_at: float = 0.30
+    hang_at: float = 0.62
+    partition_at: float = 0.74
+    #: hang indictment is heartbeat-only here (beats ride the tick
+    #: verb, so a SIGSTOPped worker's age grows while its mirrored
+    #: step_count freezes — the wall-clock path the in-process day
+    #: cannot exercise)
+    heartbeat_max_age_s: float = 0.4
+    scale_up_cooldown_evals: int = 2
+    #: per-op wire timeout: ALSO a failure detector here — a submit the
+    #: router routes to the SIGSTOPped pod wedges a round-trip, and
+    #: this bound (not the 30s production default) is what converts it
+    #: to a pod death when traffic reaches the wedge before the
+    #: heartbeat watch does
+    op_timeout_s: float = 2.0
+    #: drain ticks are wire round-trips (~ms); the bound must cover the
+    #: heartbeat ceiling's wall-clock wait
+    max_drain_ticks: int = 20000
+    transport: str = "tcp"
+    #: persistent XLA cache shared across the workers (None = the
+    #: stable per-machine temp path; tests pass their repo-local cache)
+    compile_cache_dir: str | None = None
+
+
+def run_prod_day_pods(cfg: PodSoakConfig | None = None) -> dict:
+    """The production day re-composed on a spawn_pod fleet (class
+    docstring above): diurnal traffic + autoscaler + torn checkpoint,
+    with every replica a live subprocess dialed over `cfg.transport`.
+
+    The three faults and what each must prove:
+
+      - **SIGKILL** (peak 1): the client discovers the corpse through
+        the wire (reset, redial refused, retries exhausted), the router
+        requeues, the scaler replaces — zero drops.
+      - **SIGSTOP** (peak 2): sockets stay open, mirrored counters
+        freeze. TWO independent detectors race: the heartbeat age
+        (ScalerConfig.heartbeat_max_age_s) indicts the wedge if no
+        traffic touches it first; a submit the router routes to it
+        wedges a round-trip until the op timeout converts it to a pod
+        death. Either way the wedged pod ends dead, replaced, with its
+        work requeued — the drill gates the outcome, not the winner.
+      - **partition** (late peak 2): the victim's host becomes
+        unreachable (set_partitioned — nothing crosses, the WORKER
+        KEEPS RUNNING). The connection supervisor burns its retry
+        budget, the death fences the claim, the router requeues, the
+        scaler replaces. Only after the replacement lands does the
+        partition HEAL; a fenced_poll then reads the stale worker's
+        late deliveries and refuses every one — the zero-duplicate
+        proof the drill returns.
+
+    Gates (pinned by tests/test_soak.py): dropped == 0 EXACT,
+    token_overruns == 0 (every completed stream is single-copy), and
+    partition.healed_after_replacement with the fenced claim refusing
+    all late events."""
+    import os
+    import signal
+
+    from kubeflow_tpu.serving.fleet import (
+        PagedKVPool as _Pool,
+        spawn_pod,
+        wire_pod_deaths,
+    )
+    from kubeflow_tpu.serving.fleet.podclient import (
+        attach_router_death,
+        pod_metrics_snapshot,
+    )
+    from kubeflow_tpu.serving.fleet.wire import PodWireError
+
+    cfg = cfg or PodSoakConfig()
+    rng = random.Random(f"kftpu-pods-soak-{cfg.seed}")
+    vocab = 64
+    prompt_len = cfg.shared_prefix + cfg.prompt_body
+    warm = make_prompts(1, seed=cfg.seed + 99, vocab=vocab,
+                        prompt_len=cfg.prompt_body,
+                        shared_prefix=cfg.shared_prefix)
+    spec = {
+        "model": {"vocab_size": vocab, "hidden_size": 32, "num_layers": 1,
+                  "num_heads": 2, "mlp_dim": 64, "dropout_rate": 0.0,
+                  "max_len": prompt_len + cfg.new_tokens + 24},
+        "seed": 0, "init_seed": 7, "max_rows": 2,
+        "default_max_new_tokens": cfg.new_tokens, "eos_token_id": None,
+        "prefill_chunk": 0,
+        "pool": {"block_size": cfg.block, "capacity_blocks": 256},
+        "warmup_prompts": [[int(t) for t in p] for p in warm],
+        "warmup_new_tokens": cfg.new_tokens, "warmup_repeats": 1,
+        "warmup_resume": True,
+        "max_queue": 64,
+        "compile_cache_dir": cfg.compile_cache_dir or os.path.join(
+            tempfile.gettempdir(), "kftpu-prof-pods-xla-cache"),
+    }
+    state_dir = tempfile.mkdtemp(prefix="kftpu-pods-soak-")
+    home = _Pool(block_size=cfg.block, capacity_blocks=1024)
+    all_pods: list = []
+
+    def _spawn(name: str, connect: bool):
+        c = spawn_pod(name, spec, state_dir, home_pool=home,
+                      connect=connect, transport=cfg.transport,
+                      op_timeout_s=cfg.op_timeout_s)
+        all_pods.append(c)
+        return c
+
+    t0 = time.perf_counter()
+    try:
+        # warm the whole pool CONCURRENTLY (the serve_pods trick): total
+        # cold start is one worker's warmup, not standby's
+        standby = [_spawn(f"pods-{i}", connect=False)
+                   for i in range(cfg.standby + 1)]
+        for c in standby:
+            c.connect()
+        in_day_spawns = [0]
+        first = standby.pop()
+        router = FleetRouter([("pods-base", first)], max_requeues=5)
+        wire_pod_deaths(router)
+
+        def engine_factory():
+            if standby:
+                c = standby.pop()
+            else:
+                in_day_spawns[0] += 1
+                c = _spawn(f"pods-cold-{in_day_spawns[0]}", connect=True)
+            attach_router_death(c, router)
+            return c
+
+        # admission shedding is last-resort (the run_prod_day
+        # reasoning); the demand signal runs on queue math — two seated
+        # rows per pod is the working set
+        router.ttft_slo_s = 60.0
+        router.retry_after_s = 0.01
+        router.demand_tokens_per_replica = float(
+            2 * (prompt_len + cfg.new_tokens))
+        scaler = FleetScaler(
+            router, engine_factory,
+            ScalerConfig(
+                min_replicas=cfg.min_replicas,
+                max_replicas=cfg.max_replicas,
+                scale_up_cooldown_evals=cfg.scale_up_cooldown_evals,
+                scale_down_stable_evals=10 ** 6,  # no drains: this
+                # day's transitions are the fault replacements
+                hang_detect_evals=10 ** 6,        # heartbeat-only
+                heartbeat_max_age_s=cfg.heartbeat_max_age_s))
+
+        prompts = make_prompts(
+            int(cfg.day_ticks * max(cfg.peak1_rate, cfg.peak2_rate)) + 16,
+            seed=cfg.seed, vocab=vocab, prompt_len=cfg.prompt_body,
+            shared_prefix=cfg.shared_prefix)
+        kill_tick = int(cfg.kill_at * cfg.day_ticks)
+        hang_tick = int(cfg.hang_at * cfg.day_ticks)
+        part_tick = int(cfg.partition_at * cfg.day_ticks)
+
+        handles: dict[int, object] = {}
+        retries: list[tuple[int, int]] = []
+        collected: set[int] = set()
+        hung: set[str] = set()
+        pod_base = pod_metrics_snapshot()
+        n_submitted = 0
+        shed_retries = 0
+        kills_done = 0
+        hang_done = False
+        replicas_peak = 1
+        ckpt: dict = {}
+        hang_victim = None  # the SIGSTOPped pod's PodClient
+        pvictim = None      # the partition victim's PodClient
+        part = {"injected_tick": None, "victim": None, "death_tick": None,
+                "adds_before": 0, "healed_tick": None,
+                "healed_after_replacement": False,
+                "worker_survived_partition": False,
+                "late_events": 0, "late_tokens": 0, "refused": 0}
+
+        def submit(idx: int, tick: int) -> None:
+            nonlocal shed_retries
+            try:
+                handles[idx] = router.submit(
+                    prompts[idx], max_new_tokens=cfg.new_tokens)
+            except FleetOverloaded:
+                shed_retries += 1
+                retries.append((tick + 2, idx))
+
+        def one_tick(tick: int, arrivals: int) -> None:
+            nonlocal n_submitted, kills_done, hang_done, replicas_peak
+            nonlocal pvictim, hang_victim
+            # faults first (the drill order: the world breaks, then
+            # serves). Victims must hold seated work — an idle victim
+            # proves nothing — and enough healthy peers must remain.
+            candidates = [r for r in router._admittable()
+                          if r.name not in hung]
+            if not kills_done and tick >= kill_tick \
+                    and len(candidates) >= 2:
+                busy = [r for r in candidates if r.depth() > 0]
+                if busy:
+                    victim = busy[rng.randrange(len(busy))]
+                    kills_done += 1
+                    os.kill(victim.engine.worker_pid, signal.SIGKILL)
+            if not hang_done and tick >= hang_tick \
+                    and len(candidates) >= 2:
+                busy = [r for r in candidates if r.depth() > 0]
+                if busy:
+                    hung.add(busy[0].name)
+                    hang_victim = busy[0].engine
+                    hang_done = True
+                    os.kill(hang_victim.worker_pid, signal.SIGSTOP)
+            if pvictim is None and tick >= part_tick \
+                    and len(candidates) >= 2:
+                busy = [r for r in candidates if r.depth() > 0] \
+                    or candidates
+                rep = busy[rng.randrange(len(busy))]
+                pvictim = rep.engine
+                part["injected_tick"] = tick
+                part["victim"] = rep.name
+                part["adds_before"] = \
+                    scaler.metrics["replicas_added_total"]
+                pvictim.set_partitioned(True)
+            # the partition HEALS only after the scaler has landed the
+            # replacement — the split-brain window the fence closes
+            if pvictim is not None and part["healed_tick"] is None \
+                    and pvictim.dead \
+                    and scaler.metrics["replicas_added_total"] \
+                    > part["adds_before"]:
+                if part["death_tick"] is None:
+                    part["death_tick"] = tick
+                part["worker_survived_partition"] = (
+                    pvictim.proc is not None
+                    and pvictim.proc.poll() is None)
+                pvictim.set_partitioned(False)
+                part["healed_tick"] = tick
+                part["healed_after_replacement"] = True
+            # a dead pod can't stay "hung" — whichever detector won
+            # (heartbeat indictment or the submit timeout), the kill
+            # ends the SIGSTOP episode. Checked on the CLIENT, not the
+            # replica list: the scaler REMOVES indicted replicas.
+            if hang_victim is not None and hang_victim.dead:
+                hung.clear()
+            for _ in range(arrivals):
+                if n_submitted < len(prompts):
+                    submit(n_submitted, tick)
+                    n_submitted += 1
+            for due, idx in list(retries):
+                if due <= tick:
+                    retries.remove((due, idx))
+                    submit(idx, tick)
+            for rep in list(router.replicas):
+                if rep.alive and rep.name not in hung:
+                    rep.engine.tick()
+            for idx, h in list(handles.items()):
+                if idx not in collected and h.done.is_set() \
+                        and h.error is None:
+                    collected.add(idx)
+            scaler.evaluate()
+            replicas_peak = max(replicas_peak,
+                                len(router._admittable()))
+
+        tick = 0
+        for tick in range(cfg.day_ticks):
+            if not ckpt and tick >= cfg.day_ticks // 2:
+                ckpt = _torn_checkpoint()  # the mid-day torn save
+            one_tick(tick, _arrivals(arrival_rate(tick, cfg), rng))
+        # night drain: no arrivals; the backlog AND the in-flight fault
+        # episodes (a pending heartbeat indictment, the partition heal)
+        # must all settle — drain ticks are real wire round-trips, so
+        # the heartbeat ceiling's wall-clock wait passes through here
+        while tick < cfg.day_ticks + cfg.max_drain_ticks:
+            tick += 1
+            served = (not retries
+                      and all(h.done.is_set() for h in handles.values())
+                      and len(handles) + len(retries) >= n_submitted)
+            settled = (not hung
+                       and (pvictim is None
+                            or part["healed_tick"] is not None))
+            if served and settled:
+                break
+            one_tick(tick, 0)
+
+        # ---- the heal probe: the fenced claim's worker is reachable
+        # again — whatever its outbox still holds (events delivered but
+        # never acked, plus one tick of fresh decode on rows the fleet
+        # already re-served elsewhere) must be REFUSED, not applied
+        if pvictim is not None and pvictim.fenced \
+                and not pvictim.partitioned \
+                and pvictim.proc is not None \
+                and pvictim.proc.poll() is None:
+            try:
+                probe = pvictim.fenced_poll(timeout_s=5.0)
+                part["late_events"] = probe["late_events"]
+                part["late_tokens"] = probe["late_tokens"]
+                part["refused"] = probe["refused"]
+            except (PodWireError, RuntimeError, OSError) as e:
+                part["probe_error"] = str(e)
+
+        dropped = sum(
+            1 for h in handles.values()
+            if h.error is not None or not h.done.is_set()
+        ) + len(retries)
+        # single-copy proof: every completed stream carries EXACTLY the
+        # requested tokens — a duplicate delivery that slipped the
+        # ack/fence filters would overrun
+        token_overruns = 0
+        for idx in collected:
+            if len(handles[idx].result(timeout=5.0)) != cfg.new_tokens:
+                token_overruns += 1
+        pod_now = pod_metrics_snapshot()
+        m = scaler.metrics
+        return {
+            "seed": cfg.seed,
+            "transport": cfg.transport,
+            "ticks": tick + 1,
+            "day_ticks": cfg.day_ticks,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "n_requests": n_submitted,
+            "completed": len(collected),
+            "dropped": dropped,
+            "shed_retries": shed_retries,
+            "token_overruns": token_overruns,
+            "requeued": router.metrics["requests_requeued_total"],
+            "resumed": router.metrics["requeues_resumed_total"],
+            "kills_injected": kills_done,
+            "hang_injected": hang_done,
+            "hang_victim_dead": (hang_victim is not None
+                                 and hang_victim.dead),
+            "hangs_indicted": m["hangs_detected_total"],
+            "partition": dict(part),
+            "replicas_peak": replicas_peak,
+            "in_day_spawns": in_day_spawns[0],
+            "standby_left": len(standby),
+            "ckpt": ckpt,
+            "scaler": dict(m),
+            "pod_metrics": {
+                k: pod_now[k] - pod_base[k]
+                for k in ("net_reconnects_total",
+                          "net_partitions_injected_total",
+                          "net_fenced_frames_total",
+                          "net_duplicate_acks_refused_total",
+                          "wire_retries_total",
+                          "wire_retries_exhausted_total",
+                          "kills_total")
+            },
+        }
+    finally:
+        # drill teardown, not the production path: partitioned and
+        # disowned deaths deliberately leave their workers running
+        # (that IS the split-brain hazard) — reap every survivor here
+        for c in all_pods:
+            try:
+                c.stop()
+            except RuntimeError:  # teardown best-effort
+                pass
+            c.partitioned = False
+            c._disowned = False
+            c._kill_process()
+        shutil.rmtree(state_dir, ignore_errors=True)

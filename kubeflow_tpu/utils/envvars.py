@@ -47,6 +47,11 @@ ENV_POD_SOCKET = "KFTPU_POD_SOCKET"
 ENV_POD_NAME = "KFTPU_POD_NAME"
 #: path to the JSON engine spec a pod worker builds its batcher from
 ENV_POD_SPEC = "KFTPU_POD_SPEC"
+#: wire transport a pod worker serves on: "unix" (default) or "tcp"
+ENV_POD_TRANSPORT = "KFTPU_POD_TRANSPORT"
+#: file a TCP pod worker atomically writes its bound 127.0.0.1 port to
+#: (the controller polls it the way it polls the AF_UNIX socket path)
+ENV_POD_PORT_FILE = "KFTPU_POD_NET_PORT_FILE"
 
 # ------------------------------------------------------------- platform state
 

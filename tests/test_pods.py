@@ -19,6 +19,7 @@ N subprocess spawns in this file compile the tiny-GPT programs once.
 
 import os
 import signal
+import socket
 import time
 
 import numpy as np
@@ -33,11 +34,14 @@ from kubeflow_tpu.serving.fleet import (
     wire_pod_deaths,
 )
 from kubeflow_tpu.serving.fleet.podclient import (
+    PodClient,
     attach_router_death,
+    next_fence_epoch,
     pod_metrics_snapshot,
 )
 from kubeflow_tpu.serving.fleet.scaler import FleetScaler, ScalerConfig
 from kubeflow_tpu.serving.fleet.wire import (
+    PodDead,
     PodDeadlineExpired,
     PodWireError,
     deserialize_chain,
@@ -448,3 +452,151 @@ class TestRouterIntegration:
                     c.kill(timeout_s=2.0)
                 except (RuntimeError, OSError):  # teardown best-effort
                     pass
+
+
+class TestNetTransport:
+    """kftpu-net: the same framing over TCP, and the failure family only
+    a real network socket can express — severed connections replayed
+    exactly once, stale epochs refused in both directions, and a
+    partition's split-brain neutralized by the fence (docs/serving.md
+    "Network failure matrix")."""
+
+    def test_tcp_severed_connection_replays_idempotently(self, state_dir):
+        """An ECONNRESET under an ESTABLISHED connection mid-decode: the
+        connection supervisor redials (counted as a reconnect) and the
+        retry layer replays the tick verb — rid dedup plus cumulative
+        acks make the replay exact, so the stream is token-identical to
+        an unsevered run of the same prompt."""
+        c = spawn_pod("tcp-0", _spec(), state_dir,
+                      home_pool=PagedKVPool(4, 256), transport="tcp")
+        try:
+            assert c._transport is not None and c._transport.kind == "tcp"
+            straight = c.submit(_prompt(31), max_new_tokens=NEW)
+            _run_to_done(c, [straight])
+            base = pod_metrics_snapshot()
+            h = c.submit(_prompt(31), max_new_tokens=NEW)
+            c.tick()  # at least one round-trip lands on the doomed socket
+            c._transport.sock.shutdown(socket.SHUT_RDWR)  # the reset
+            _run_to_done(c, [h])
+            assert h.error is None
+            assert h.tokens == straight.tokens  # replayed, never doubled
+            now = pod_metrics_snapshot()
+            assert now["net_reconnects_total"] > \
+                base["net_reconnects_total"]
+            assert now["wire_retries_total"] > base["wire_retries_total"]
+        finally:
+            c.kill(timeout_s=5.0)
+
+    def test_stale_epoch_refused_both_directions(self, state_dir):
+        """Epoch fencing end to end: a successor client born with a
+        higher fence epoch adopts the worker via hello; the
+        predecessor's next frame is answered 410 — it fences itself and
+        is disowned WITHOUT killing the process (which now serves the
+        successor's claim), and even its bypass-fence probe stays
+        refused. The successor decodes untouched throughout."""
+        a = spawn_pod("epoch-0", _spec(), state_dir,
+                      home_pool=PagedKVPool(4, 256), transport="tcp")
+        b = None
+        try:
+            first = a.submit(_prompt(40), max_new_tokens=NEW)
+            _run_to_done(a, [first])
+            # the worker serves one connection at a time — step aside so
+            # the successor's dial is the next accept
+            with a._wire_mu:
+                a._close_socket()
+            b = PodClient("epoch-0", a.socket_path, proc=None,
+                          heartbeat_path=a.heartbeat_path,
+                          transport="tcp", port_file=a.port_file,
+                          epoch=next_fence_epoch())
+            b.paged_kv = a.paged_kv
+            b.connect(timeout_s=60.0)
+            base = pod_metrics_snapshot()["net_fenced_frames_total"]
+            with b._wire_mu:
+                b._close_socket()  # let the stale client redial
+            # worker-side refusal: the stale client's tick comes back
+            # 410 — terminal, fenced, disowned, and the process spared
+            assert a.tick() is False
+            assert a.fenced and a.dead and a._disowned
+            assert a.proc.poll() is None  # belongs to the successor now
+            assert pod_metrics_snapshot()["net_fenced_frames_total"] \
+                > base
+            # even the bypass-fence heal probe is refused: the worker's
+            # adopted epoch outranks this claim forever
+            with pytest.raises(PodDead):
+                a.fenced_poll(timeout_s=5.0)
+            # the successor's claim is untouched by all of the above
+            r = b.submit(_prompt(40), max_new_tokens=NEW)
+            _run_to_done(b, [r])
+            assert r.error is None
+            assert r.tokens == first.tokens
+        finally:
+            if b is not None:
+                b._close_socket()
+            a._disowned = False  # drill teardown: reap the survivor
+            a._kill_process()
+
+    def test_partition_heal_split_brain_refused(self, state_dir):
+        """The split-brain drill: a partition makes the host unreachable
+        mid-decode, the retry budget burns out, and the death FENCES
+        instead of killing — the worker keeps running on the far side.
+        After the heal, the fenced claim's late deliveries are read
+        back and every one is refused: the handle the fleet already
+        failed over never grows another token."""
+        c = spawn_pod("part-0", _spec(), state_dir,
+                      home_pool=PagedKVPool(4, 256), transport="tcp",
+                      op_timeout_s=2.0)
+        try:
+            h = c.submit(_prompt(41), max_new_tokens=NEW)
+            c.tick()  # the row is seated; maybe a token or two landed
+            ntoks = len(h.tokens)
+            base = pod_metrics_snapshot()
+            c.set_partitioned(True)
+            assert c.tick() is False  # retries exhausted -> pod death
+            assert c.dead and c.fenced
+            assert c.proc.poll() is None  # the worker SURVIVED
+            assert h.done.is_set() and h.error is not None  # requeue
+            c.set_partitioned(False)  # the heal
+            probe = c.fenced_poll(timeout_s=5.0)
+            assert probe["late_events"] >= 1  # the outbox held stale work
+            assert probe["refused"] == probe["late_events"]  # ALL refused
+            assert len(h.tokens) == ntoks  # not one late token applied
+            now = pod_metrics_snapshot()
+            assert now["net_partitions_injected_total"] == \
+                base["net_partitions_injected_total"] + 1
+            assert now["net_fenced_frames_total"] > \
+                base["net_fenced_frames_total"]
+            assert now["wire_retries_exhausted_total"] > \
+                base["wire_retries_exhausted_total"]
+        finally:
+            c.partitioned = False  # drill teardown: reap the survivor
+            c._kill_process()
+
+    def test_chain_handoff_resume_across_tcp_pods(self, state_dir):
+        """The cross-pod rescue primitive rides the TCP wire unchanged:
+        pod A decodes with keep_chain, its chain crosses the network
+        into the HOME pool, and pod B resumes from it — token-identical
+        to A's own straight run."""
+        home = PagedKVPool(block_size=4, capacity_blocks=256)
+        a = spawn_pod("tcp-res-0", _spec(), state_dir, home_pool=home,
+                      transport="tcp")
+        b = None
+        try:
+            p = _prompt(13)
+            straight = a.submit(p, max_new_tokens=NEW)
+            _run_to_done(a, [straight])
+            h = a.submit(p, max_new_tokens=NEW, keep_chain=True)
+            _run_to_done(a, [h])
+            assert h.chain is not None and not h.chain.frozen
+            b = spawn_pod("tcp-res-1", _spec(), state_dir,
+                          home_pool=home, transport="tcp")
+            keep = int(h.chain.length) - int(p.size) + 1
+            assert 0 < keep <= len(h.tokens)
+            r = b.submit(p, max_new_tokens=NEW,
+                         resume_from=(h.chain, h.tokens[:keep]))
+            _run_to_done(b, [r])
+            assert r.error is None and r.resumed
+            assert r.tokens == straight.tokens
+        finally:
+            a.kill(timeout_s=5.0)
+            if b is not None:
+                b.kill(timeout_s=5.0)

@@ -354,6 +354,51 @@ class TestPerfGate:
         assert rec["dropped_count"] == 0
         assert rec["completed"] == rec["requests"]
 
+    def test_injected_net_faults_fail_tcp_pods_gate(self, monkeypatch):
+        """The TCP gate's teeth (kftpu-net): KFTPU_PROF_CHAOS="net:1"
+        arms the seeded network-fault plan — partitions, black holes,
+        half-open connections, duplicate deliveries — on the decode
+        pods' TCP sockets. Every fault must be absorbed (zero drops),
+        but the absorption leaves fingerprints the untouched tree pins
+        at 0: reconnects and/or retries ride the budget rows, so
+        network faults are never free and never silent."""
+        monkeypatch.setenv(ENV_PROF_CHAOS, "net:1")
+        results = cpu_proxy.run_all(only="serve_pods_tcp")
+        violations = cpu_proxy.check_budgets(
+            results, json.loads(BUDGETS.read_text()))
+        assert any("serve_pods_tcp." in v for v in violations), violations
+        (rec,) = results
+        assert rec["workload"] == "serve_pods_tcp"
+        assert rec["net_chaos_armed"] is True
+        # the supervisor redialed through the chaos: replay exercised
+        assert rec["rel"]["net_reconnects"] + rec["rel"]["wire_retries"] \
+            >= 1
+        # absorbed, not dropped — and every stream single-copy
+        assert rec["dropped_count"] == 0
+        assert rec["completed"] == rec["requests"]
+
+    def test_tcp_pods_drill_matches_unix_contract(self, monkeypatch):
+        """The transport axis on the real-kill drill: the SAME workload
+        over TCP must hold the identical zero-drop / rescue / handoff
+        contract, with a quiet network (zero reconnects, zero refused
+        duplicates) on the untouched tree — the baseline the net teeth
+        bite against."""
+        monkeypatch.delenv(ENV_PROF_CHAOS, raising=False)
+        (rec,) = cpu_proxy.run_all(only="serve_pods_tcp")
+        assert rec["workload"] == "serve_pods_tcp"
+        assert rec["transport"] == "tcp"
+        assert rec["replica_killed"] and rec["pod_kills"] >= 1
+        assert rec["dropped_count"] == 0
+        assert rec["completed"] == rec["requests"]
+        assert rec["requeued"] >= 1
+        assert rec["rel"]["kill_unrescued"] == 0
+        assert rec["handoffs"] == rec["requests"]
+        # the quiet-network baseline: no redials, no refused dups
+        assert rec["net_chaos_armed"] is False
+        assert rec["rel"]["net_reconnects"] == 0
+        assert rec["rel"]["dup_acks_refused"] == 0
+        assert rec["rel"]["wire_retries"] == 0
+
     def test_pods_drill_real_kill_zero_drop(self, monkeypatch):
         """The serve_pods record is ISSUE 16's acceptance drill: three
         real subprocess pods (one prefill, two decode) behind the

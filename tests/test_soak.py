@@ -706,3 +706,39 @@ class TestProdDaySoak:
         # the ONE report carried the request breakdown for every traced
         # request (build_slo_report is the single build path)
         assert rec["report"]["requests"]["count"] > 0
+
+
+class TestProdDayPodsSoak:
+    def test_seeded_day_on_real_tcp_pods_holds_every_contract(self):
+        """The production day re-composed on a spawn_pod TCP fleet
+        (run_prod_day_pods): the SIGKILL is discovered through the
+        wire, the SIGSTOP is indicted by heartbeat age (or converted
+        by the op-timeout detector — the drill gates the outcome, not
+        the winner), and the mid-peak partition heals only AFTER the
+        scaler replaced the victim, whose fenced claim then has every
+        late delivery refused. Gates: dropped == 0 EXACT and zero
+        duplicate tokens across every completed stream."""
+        from kubeflow_tpu.soak import PodSoakConfig, run_prod_day_pods
+
+        cache = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".kubeflow_tpu", "test-compile-cache")
+        rec = run_prod_day_pods(PodSoakConfig(compile_cache_dir=cache))
+        assert rec["dropped"] == 0                 # EXACT, the headline
+        assert rec["token_overruns"] == 0          # single-copy streams
+        assert rec["completed"] == rec["n_requests"] > 10
+        assert rec["kills_injected"] >= 1
+        assert rec["hang_injected"] and rec["hang_victim_dead"]
+        part = rec["partition"]
+        assert part["injected_tick"] is not None
+        assert part["healed_after_replacement"] is True
+        assert part["worker_survived_partition"] is True
+        # the fenced claim delivered late work after the heal and ALL
+        # of it was refused — the zero-duplicate proof
+        assert part["refused"] == part["late_events"]
+        assert "probe_error" not in part
+        assert rec["ckpt"]["fallback_ok"] is True
+        pm = rec["pod_metrics"]
+        assert pm["net_partitions_injected_total"] == 1
+        assert pm["net_reconnects_total"] >= 1
+        assert pm["kills_total"] >= 3  # SIGKILL + wedge + partition
