@@ -4,9 +4,9 @@
 
 NATIVE := kubeflow_tpu/native
 
-.PHONY: test lint test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo test-decode test-soak test-pods test-sched selftest-sanitizers native
+.PHONY: test lint modelcheck test-analysis test-chaos test-trace test-health test-prof test-cplane test-fleet test-hotpath test-partition test-slo test-decode test-soak test-pods test-sched test-protocheck selftest-sanitizers native
 
-test: lint
+test: lint modelcheck
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 
 # kftpu-check: AST invariant linter (docs/analysis.md). Exits non-zero on
@@ -15,6 +15,14 @@ test: lint
 # (only to shrink it — never grow it to dodge a new finding).
 lint:
 	python -m kubeflow_tpu.analysis
+
+# kftpu-protocheck: bounded-exhaustive model checking of the wire /
+# paged-KV-handoff / chip-ledger protocol state machines, with minimal
+# counterexample schedules on violation (docs/analysis.md "Protocol
+# model checking"; KFTPU_MODELCHECK_DEPTH / KFTPU_MODELCHECK_SEED widen
+# the sweep). Sub-second at the default budget — a `make test` step.
+modelcheck:
+	python -m kubeflow_tpu.analysis --modelcheck
 
 # kftpu-check's own suite: checker fixtures, baseline round-trip, and the
 # lock-order/race detector unit tests (docs/analysis.md)
@@ -123,6 +131,12 @@ test-pods:
 test-sched:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chipsched.py -q -m sched
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof_gate.py -q -m prof
+
+# kftpu-protocheck suite: exploration-kernel unit tests, HEAD-clean pins,
+# the per-mutation counterexample pins, and recorded-trace conformance
+# (docs/analysis.md "Protocol model checking")
+test-protocheck: modelcheck
+	JAX_PLATFORMS=cpu python -m pytest tests/test_protocheck.py -q -m modelcheck
 
 native:
 	$(MAKE) -C $(NATIVE)

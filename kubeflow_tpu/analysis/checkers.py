@@ -49,6 +49,12 @@ RULES = {
         "exposition (tests/golden/metrics_exposition.txt), or golden "
         "metric with no emitter in code"
     ),
+    "KFTPU-VERB": (
+        "wire verb / error code / envelope field spelled inline in the "
+        "pod endpoints (podclient.py, podworker.py) — import the "
+        "VERB_*/CODE_*/F_*/EV_* constant from serving/fleet/wire.py so "
+        "the two sides of the wire cannot drift"
+    ),
 }
 
 #: paths (posix, relative) the KFTPU-SLEEP rule governs
@@ -646,6 +652,164 @@ class MetricChecker(Checker):
                 )
 
 
+# --------------------------------------------------------------- KFTPU-VERB
+
+#: the wire registry module — the one place verbs/codes/fields belong
+_WIRE_REGISTRY = "kubeflow_tpu/serving/fleet/wire.py"
+#: the endpoint modules the rule governs (the two sides of the wire)
+_WIRE_ENDPOINTS = (
+    "kubeflow_tpu/serving/fleet/podclient.py",
+    "kubeflow_tpu/serving/fleet/podworker.py",
+)
+
+
+class VerbChecker(Checker):
+    """Two-phase pin between the wire registry and the pod endpoints.
+
+    check() harvests the VERB_*/CODE_*/F_*/EV_* constants from the linted
+    tree's wire.py and collects literal candidates from podclient.py /
+    podworker.py; finalize() flags the overlaps. A registered verb or
+    event kind as ANY string constant and a registered code as ANY int
+    constant is a finding (docstrings exempt — prose may name the wire);
+    a registered field name only in envelope-access positions (dict-
+    display key, subscript index, first argument to .get/.pop/
+    .setdefault) so an error message mentioning "epoch" stays legal.
+    ``__slots__`` tuples (attribute names) and ``log_event(...)``
+    arguments (protocol telemetry describing the wire) are exempt.
+    A tree with no wire.py yields no findings (fixture trees lint clean).
+    """
+
+    rule = "KFTPU-VERB"
+
+    def __init__(self):
+        self.verbs: dict[str, str] = {}    # literal -> constant name
+        self.codes: dict[int, str] = {}
+        self.fields: dict[str, str] = {}
+        self.kinds: dict[str, str] = {}
+        #: (path, line, line_text, literal, context) awaiting finalize
+        self._pending: list[tuple[str, int, str, object, str]] = []
+        self._allowed_lines: dict[str, set[int]] = {}
+
+    def _harvest(self, module: Module) -> None:
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Constant):
+                continue
+            v = node.value.value
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if t.id.startswith("VERB_") and isinstance(v, str):
+                    self.verbs[v] = t.id
+                elif t.id.startswith("CODE_") and isinstance(v, int) \
+                        and not isinstance(v, bool):
+                    self.codes[v] = t.id
+                elif t.id.startswith("F_") and isinstance(v, str):
+                    self.fields[v] = t.id
+                elif t.id.startswith("EV_") and isinstance(v, str):
+                    self.kinds[v] = t.id
+
+    @staticmethod
+    def _field_positions(tree: ast.Module) -> set:
+        """id()s of Constant nodes sitting in envelope-access positions."""
+        pos: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if isinstance(k, ast.Constant):
+                        pos.add(id(k))
+            elif isinstance(node, ast.Subscript):
+                if isinstance(node.slice, ast.Constant):
+                    pos.add(id(node.slice))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("get", "pop", "setdefault") \
+                    and node.args and isinstance(node.args[0], ast.Constant):
+                pos.add(id(node.args[0]))
+        return pos
+
+    @staticmethod
+    def _exempt_nodes(tree: ast.Module) -> set:
+        """id()s of Constant nodes that LOOK like wire literals but are
+        not wire traffic: __slots__ members and log_event arguments."""
+        ex: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else "")
+                if name == "log_event":
+                    for a in list(node.args) + [k.value for k in
+                                                node.keywords]:
+                        if isinstance(a, ast.Constant):
+                            ex.add(id(a))
+            elif isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == "__slots__"
+                            for t in node.targets) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                for e in node.value.elts:
+                    if isinstance(e, ast.Constant):
+                        ex.add(id(e))
+        return ex
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if module.path == _WIRE_REGISTRY:
+            self._harvest(module)
+            return
+        if module.path not in _WIRE_ENDPOINTS:
+            return
+        self._allowed_lines[module.path] = {
+            ln for ln, rules in module.allow.items() if self.rule in rules
+        }
+        docstrings = _docstring_ids(module.tree)
+        field_pos = self._field_positions(module.tree)
+        exempt = self._exempt_nodes(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Constant) \
+                    or id(node) in docstrings or id(node) in exempt:
+                continue
+            v = node.value
+            if isinstance(v, str):
+                ctx = "field" if id(node) in field_pos else "str"
+            elif isinstance(v, int) and not isinstance(v, bool):
+                ctx = "int"
+            else:
+                continue
+            self._pending.append((module.path, node.lineno,
+                                  module.line_text(node.lineno), v, ctx))
+        return
+        yield  # pragma: no cover — makes check() a generator like its peers
+
+    def finalize(self) -> Iterator[Finding]:
+        if not (self.verbs or self.codes or self.fields or self.kinds):
+            return  # no registry in the linted tree — nothing to pin
+        for path, line, text, value, ctx in self._pending:
+            allowed = self._allowed_lines.get(path, ())
+            if line in allowed or (line - 1) in allowed:
+                continue
+            const = what = None
+            if ctx == "int":
+                const, what = self.codes.get(value), "wire error code"
+            elif value in self.verbs:
+                const, what = self.verbs[value], "wire verb"
+            elif value in self.kinds:
+                const, what = self.kinds[value], "wire event kind"
+            elif ctx == "field" and value in self.fields:
+                const, what = self.fields[value], "envelope field"
+            if const is None:
+                continue
+            yield Finding(
+                rule=self.rule, path=path, line=line,
+                message=(
+                    f"{what} {value!r} spelled inline — import {const} "
+                    "from serving/fleet/wire.py (single registry; the "
+                    "two sides of the wire cannot drift)"
+                ),
+                line_text=text,
+            )
+
+
 def make_checkers(golden_metrics: Path) -> list[Checker]:
     return [
         SleepChecker(),
@@ -654,4 +818,5 @@ def make_checkers(golden_metrics: Path) -> list[Checker]:
         ExceptChecker(),
         EnvChecker(),
         MetricChecker(golden_metrics),
+        VerbChecker(),
     ]

@@ -198,6 +198,10 @@ def load_baseline(path: Path) -> list[str]:
 
 
 def save_baseline(path: Path, findings: list[Finding]) -> None:
+    save_baseline_keys(path, [f.baseline_key for f in findings])
+
+
+def save_baseline_keys(path: Path, keys: list[str]) -> None:
     path.parent.mkdir(parents=True, exist_ok=True)
     payload = {
         "comment": (
@@ -207,9 +211,19 @@ def save_baseline(path: Path, findings: list[Finding]) -> None:
             "— and shrink it when you fix an entry, never grow it to dodge "
             "a new finding."
         ),
-        "findings": sorted(f.baseline_key for f in findings),
+        "findings": sorted(keys),
     }
     path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def describe_baseline_key(key: str) -> str:
+    """``RULE in path: line`` for a stale-entry warning — the parts a
+    reader needs to find (or confirm the death of) the debt."""
+    parts = key.split("|", 2)
+    if len(parts) != 3:
+        return key
+    rule, path, line_text = parts
+    return f"{rule} in {path}: {line_text or '<no line text>'}"
 
 
 # ----------------------------------------------------------------------- CLI
@@ -237,12 +251,36 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--golden-metrics", default=GOLDEN_METRICS_PATH,
                         help="golden exposition the KFTPU-METRIC rule pins against")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop stale entries from the baseline (debt "
+                             "that no current finding matches), then lint "
+                             "against the pruned baseline")
+    parser.add_argument("--modelcheck", action="store_true",
+                        help="run the protocol model checker "
+                             "(analysis/protocheck) instead of linting")
+    parser.add_argument("--modelcheck-depth", type=int, default=None,
+                        help="exhaustive exploration depth override "
+                             "(default per-model; KFTPU_MODELCHECK_DEPTH)")
+    parser.add_argument("--modelcheck-seed", type=int, default=None,
+                        help="random-walk frontier seed "
+                             "(default 0; KFTPU_MODELCHECK_SEED)")
+    parser.add_argument("--conform", nargs="+", metavar="LOG", default=None,
+                        help="replay recorded protocol event logs through "
+                             "the model trace acceptors instead of linting")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule, doc in RULES.items():
             print(f"{rule}: {doc}")
         return 0
+
+    if args.modelcheck:
+        from kubeflow_tpu.analysis.protocheck import main_modelcheck
+        return main_modelcheck(depth=args.modelcheck_depth,
+                               seed=args.modelcheck_seed)
+    if args.conform:
+        from kubeflow_tpu.analysis.protocheck import main_conform
+        return main_conform(args.conform)
 
     root = Path(args.root).resolve()
     findings = run_linter(root, args.paths or None,
@@ -259,12 +297,30 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     baseline = [] if args.no_baseline else load_baseline(baseline_path)
+    if args.prune_baseline and baseline:
+        stale_budget: dict[str, int] = {}
+        for key in apply_baseline(findings, baseline).stale_baseline:
+            stale_budget[key] = stale_budget.get(key, 0) + 1
+        kept = []
+        for key in baseline:
+            if stale_budget.get(key, 0) > 0:
+                stale_budget[key] -= 1
+                print(f"pruned: {describe_baseline_key(key)}")
+            else:
+                kept.append(key)
+        if len(kept) != len(baseline):
+            save_baseline_keys(baseline_path, kept)
+            print(f"baseline pruned: {len(baseline) - len(kept)} stale "
+                  f"entr(y/ies) dropped, {len(kept)} kept in "
+                  f"{baseline_path}")
+        baseline = kept
     res = apply_baseline(findings, baseline)
     for f in res.new:
         print(f.render())
     for key in res.stale_baseline:
-        print(f"warning: stale baseline entry (fixed? shrink the baseline): "
-              f"{key}", file=sys.stderr)
+        print(f"warning: stale baseline entry (fixed? shrink the baseline "
+              f"or run --prune-baseline): {describe_baseline_key(key)}",
+              file=sys.stderr)
     n_base = len(findings) - len(res.new)
     if res.new:
         print(f"\nkftpu-check: {len(res.new)} new finding(s) "

@@ -1,0 +1,56 @@
+"""kftpu-protocheck: bounded-exhaustive protocol model checking.
+
+What the lock-order detector (analysis/lockcheck.py) is to locking,
+this package is to the platform's three distributed protocols — the
+epoch-fenced pod wire, the paged-KV chain handoff, and the chip-ledger
+admission path. A tiny explicit-state kernel (kernel.py) enumerates
+every interleaving of small pure-Python models of those protocols up to
+a bounded depth (plus a seeded random-walk frontier beyond it), checks
+the contracts the seeded chaos drills can only sample, and renders
+minimal counterexample schedules when one breaks.
+
+Each model carries seeded mutation knobs; the suite pins that every
+mutation yields a counterexample (the checker can see the bug class)
+while HEAD explores clean. The event-log hook (eventlog.py) and trace
+acceptors (conform.py) tie the models to reality: recorded drill traces
+must be accepted runs. docs/analysis.md "Protocol model checking".
+"""
+
+from .conform import (ACCEPTORS, TraceRejected, check_kv_trace,
+                      check_ledger_trace, check_trace, check_wire_trace)
+from .eventlog import arm, armed_path, disarm, log_event, read_log
+from .kernel import ExploreResult, Model, Violation, explore
+from .kv_model import KVModel
+from .ledger_model import LedgerModel
+from .runner import (ALL_MODELS, default_budget, main_conform,
+                     main_modelcheck, protocheck_metrics_snapshot,
+                     reset_protocheck_metrics, run_modelcheck)
+from .wire_model import WireModel
+
+__all__ = [
+    "ACCEPTORS",
+    "ALL_MODELS",
+    "ExploreResult",
+    "KVModel",
+    "LedgerModel",
+    "Model",
+    "TraceRejected",
+    "Violation",
+    "WireModel",
+    "arm",
+    "armed_path",
+    "check_kv_trace",
+    "check_ledger_trace",
+    "check_trace",
+    "check_wire_trace",
+    "default_budget",
+    "disarm",
+    "explore",
+    "log_event",
+    "main_conform",
+    "main_modelcheck",
+    "protocheck_metrics_snapshot",
+    "read_log",
+    "reset_protocheck_metrics",
+    "run_modelcheck",
+]

@@ -1,0 +1,206 @@
+"""Trace conformance: recorded drill logs must be accepted model runs.
+
+The models in this package could drift into a comforting fiction — clean
+because they stopped resembling the implementation. Conformance closes
+the loop: the real endpoints log protocol events (eventlog.py, armed in
+the drill suites), and each model ships a trace acceptor here that
+replays a recorded log and rejects any event sequence the protocol's
+contracts forbid. A drill that passes while its trace is rejected means
+the MODEL is wrong (or the implementation is, which the drill missed) —
+either way a finding.
+
+Acceptors are deliberately written against the *observable* event
+vocabulary the hooks emit, not internal state, so multi-process logs
+(the pod worker appends to the same file as the client) stay checkable
+in file append order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["TraceRejected", "check_wire_trace", "check_kv_trace",
+           "check_ledger_trace", "check_trace", "ACCEPTORS"]
+
+
+class TraceRejected(AssertionError):
+    """A recorded event log is not an accepted run of the model."""
+
+
+def _reject(i: int, rec: dict, why: str) -> None:
+    raise TraceRejected(f"event {i}: {why}: {rec}")
+
+
+def check_wire_trace(events: List[dict]) -> int:
+    """Accept or reject a recorded wire-protocol log.
+
+    Checks, in file order: worker epoch adoptions are monotonic and a
+    strictly-newer adoption purged the outbox; 410 refusals really were
+    stale; worker event ids are strictly monotonic; per client epoch the
+    delivered stream is duplicate-free with increasing ids, at most one
+    done per rid, and nothing delivered after done (the single-copy /
+    ack-filter contract — a duplicated token frame rejects here).
+    """
+    w_epoch = 0
+    last_emit: Dict[int, int] = {}              # worker pid -> max id
+    seen: Set[Tuple[int, int]] = set()          # (client epoch, event id)
+    last_id: Dict[int, int] = {}                # client epoch -> max id
+    done_rids: Set[Tuple[int, str]] = set()     # (client epoch, rid)
+    n = 0
+    for i, rec in enumerate(events):
+        if rec.get("proto") != "wire":
+            continue
+        n += 1
+        ev = rec.get("ev")
+        if ev == "adopt":
+            old, new = int(rec["old"]), int(rec["new"])
+            if new < old:
+                _reject(i, rec, "epoch adoption went backwards")
+            if new > old and not rec.get("purged"):
+                _reject(i, rec, "strictly-newer epoch adopted without "
+                                "purging outbox/rids")
+            w_epoch = max(w_epoch, new)
+        elif ev == "refuse_stale":
+            if int(rec["env_epoch"]) >= int(rec["epoch"]):
+                _reject(i, rec, "410 refused a non-stale epoch")
+        elif ev == "emit":
+            # id space is per worker incarnation: key on the pid the
+            # subprocess stamped (a respawned worker starts over at 1)
+            pid = int(rec.get("pid", 0))
+            eid = int(rec["id"])
+            if eid <= last_emit.get(pid, 0):
+                _reject(i, rec, "worker event id not monotonic")
+            last_emit[pid] = eid
+        elif ev == "deliver":
+            epoch, eid = int(rec["epoch"]), int(rec["id"])
+            rid = str(rec.get("rid"))
+            if (epoch, eid) in seen:
+                _reject(i, rec, "duplicate event id delivered to the "
+                                "app (ack filter breached)")
+            seen.add((epoch, eid))
+            if eid <= last_id.get(epoch, 0):
+                _reject(i, rec, "delivered event id not increasing "
+                                "for this client")
+            last_id[epoch] = eid
+            if (epoch, rid) in done_rids:
+                _reject(i, rec, "event delivered after done for rid")
+            if rec.get("kind") == "done":
+                done_rids.add((epoch, rid))
+        elif ev in ("submit", "fenced", "tick"):
+            pass  # contextual events; no acceptance constraint alone
+    return n
+
+
+def check_kv_trace(events: List[dict]) -> int:
+    """Accept or reject a recorded paged-KV pool log.
+
+    Every reported refcount must be non-negative; adopt and release may
+    only name digests the log has already published or extended (no
+    conjured blocks, no release of the unknown).
+    """
+    known: Set[str] = set()
+    n = 0
+    for i, rec in enumerate(events):
+        if rec.get("proto") != "kv":
+            continue
+        n += 1
+        ev = rec.get("ev")
+        if ev == "publish":
+            for d, rc in zip(rec.get("digests", []), rec.get("rcs", [])):
+                if int(rc) < 1:
+                    _reject(i, rec, f"publish left digest {d} "
+                                    f"unreferenced (rc={rc})")
+                known.add(str(d))
+        elif ev == "extend":
+            if int(rec.get("rc", 1)) < 1:
+                _reject(i, rec, "extend produced an unreferenced block")
+            known.add(str(rec["digest"]))
+        elif ev == "adopt":
+            if str(rec["digest"]) not in known:
+                _reject(i, rec, "adopted a digest the log never "
+                                "published")
+            if int(rec.get("rc", 1)) < 1:
+                _reject(i, rec, "adoption left the block unreferenced")
+        elif ev == "release":
+            for d, rc in zip(rec.get("digests", []), rec.get("rcs", [])):
+                if int(rc) < 0:
+                    _reject(i, rec, f"release drove digest {d} "
+                                    f"refcount negative ({rc})")
+                if str(d) not in known:
+                    _reject(i, rec, f"released digest {d} the log "
+                                    f"never published")
+    return n
+
+
+def check_ledger_trace(events: List[dict]) -> int:
+    """Accept or reject a recorded chip-ledger log.
+
+    Grants/releases are logged in ledger-lock commit order, so they ARE
+    the sequential history: a live key must not be granted again
+    (no-double-grant), free+held must equal the event's capacity
+    (chip conservation under a moving autoscaled capacity), and a
+    borrowing grant must not carry evictions (borrowers never preempt).
+    """
+    live: Dict[str, int] = {}  # key -> chips
+    n = 0
+    for i, rec in enumerate(events):
+        if rec.get("proto") != "ledger":
+            continue
+        n += 1
+        ev = rec.get("ev")
+        if ev == "grant":
+            key = str(rec["key"])
+            if key in live:
+                _reject(i, rec, f"double-grant: key {key!r} already "
+                                f"live")
+            if int(rec.get("borrowed", 0)) > 0 and rec.get("evicted"):
+                _reject(i, rec, "borrowing grant evicted victims")
+            for vk in rec.get("evicted", []):
+                live.pop(str(vk), None)
+            live[key] = int(rec["chips"])
+            _check_conservation(i, rec, live)
+        elif ev == "grow":
+            key = str(rec["key"])
+            if key not in live:
+                _reject(i, rec, f"grow of a key never granted: {key!r}")
+            live[key] = int(rec["chips"])
+            _check_conservation(i, rec, live)
+        elif ev == "release":
+            live.pop(str(rec["key"]), None)
+            _check_conservation(i, rec, live)
+    return n
+
+
+def _check_conservation(i: int, rec: dict, live: Dict[str, int]) -> None:
+    cap = rec.get("capacity")
+    free = rec.get("free")
+    if cap is None or free is None:
+        return
+    held = sum(live.values())
+    if int(free) < 0:
+        _reject(i, rec, f"free chips negative ({free})")
+    if int(free) + held != int(cap):
+        _reject(i, rec, f"chips not conserved: free {free} + held "
+                        f"{held} != capacity {cap}")
+
+
+ACCEPTORS = {
+    "wire": check_wire_trace,
+    "kv": check_kv_trace,
+    "ledger": check_ledger_trace,
+}
+
+
+def check_trace(events: List[dict],
+                proto: Optional[str] = None) -> Dict[str, int]:
+    """Run every (or one) acceptor over a recorded log.
+
+    Returns {proto: events_checked}; raises TraceRejected on the first
+    unacceptable event.
+    """
+    counts: Dict[str, int] = {}
+    for name, acceptor in ACCEPTORS.items():
+        if proto is not None and name != proto:
+            continue
+        counts[name] = acceptor(events)
+    return counts

@@ -1,0 +1,80 @@
+"""The cheap protocol event-log hook the real endpoints call.
+
+podclient/podworker (wire), PagedKVPool (kv) and ChipScheduler (ledger)
+call :func:`log_event` at their protocol-significant transitions. Off by
+default: when neither :func:`arm` has been called nor ``KFTPU_PROTOLOG``
+is set, the call is a dict lookup and a return — safe on hot paths, the
+same posture as the lock-order detector's disabled passthrough.
+
+When armed, events append as JSON lines to a file. A *file* rather than
+an in-memory list because the pod worker is a real subprocess: it
+inherits ``KFTPU_PROTOLOG`` through its environment and appends to the
+same log the parent's client appends to, so one trace captures both ends
+of the wire. Each line is one event dict plus ``proto`` (which model it
+belongs to: "wire", "kv", "ledger") and ``src`` (who logged it).
+
+``protocheck conform`` (and the drill-suite round-trip tests) then
+replay a recorded log through the matching model's trace checker — the
+conformance loop that keeps the models honest against reality.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Iterable, List, Optional
+
+from kubeflow_tpu.utils.envvars import ENV_PROTOLOG
+
+__all__ = ["arm", "disarm", "armed_path", "log_event", "read_log"]
+
+_MU = threading.Lock()
+_PATH: Optional[str] = None  # explicit in-process arm (beats the env var)
+
+
+def arm(path: str) -> None:
+    """Arm the hook in this process, appending to ``path``."""
+    global _PATH
+    with _MU:
+        _PATH = path
+
+
+def disarm() -> None:
+    global _PATH
+    with _MU:
+        _PATH = None
+
+
+def armed_path() -> Optional[str]:
+    """The active log path, or None when the hook is off."""
+    return _PATH or os.environ.get(ENV_PROTOLOG) or None
+
+
+def log_event(proto: str, src: str, ev: str, **fields) -> None:
+    """Append one protocol event if armed; no-op (and cheap) otherwise."""
+    path = _PATH or os.environ.get(ENV_PROTOLOG)
+    if not path:
+        return
+    rec = {"proto": proto, "src": src, "ev": ev}
+    rec.update(fields)
+    line = json.dumps(rec, sort_keys=True, default=str) + "\n"
+    # one write() of one line in append mode: atomic enough for the
+    # multi-process drill logs this captures (POSIX O_APPEND)
+    with _MU:
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(line)
+
+
+def read_log(path: str, proto: Optional[str] = None) -> List[dict]:
+    """Load a recorded log, optionally filtered to one protocol."""
+    events: List[dict] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if proto is None or rec.get("proto") == proto:
+                events.append(rec)
+    return events

@@ -1,0 +1,237 @@
+"""Protocol model: paged-KV chain handoff (serving/fleet/pagedkv.py).
+
+Abstracts the pool's refcounted block store and the publish → adopt-by-
+digest → extend/COW → release lifecycle a kill-requeue rides through
+(the SIGKILL mid-decode zero-drop drill in tests/test_pods.py):
+
+- blocks are (digest, tokens) pairs with a pool refcount; a chain is an
+  ordered tuple of digests held by a *holder* (a request's home or
+  recovery hold);
+- two holders, H0 (the original request) and H1 (the adopter — router
+  recovery or a sibling hit), over a two-block chain;
+- actions: publish the chain, adopt it by digest, extend the tail
+  (sharing when sole holder, copy-on-write when shared), release a
+  hold, kill-requeue (H0's death releases its hold; resume re-adopts by
+  digest), and evict refcount-zero blocks.
+
+The model keeps the pool's *implementation* refcount separate from the
+ground truth (who actually holds what), so bookkeeping bugs surface as
+divergence rather than being defined away.
+
+Invariants:
+
+- ``refcount-conserved`` — every block's pool refcount equals the
+  number of holds that reference it; never negative.
+- ``no-orphan-pin``      — a block with refcount > 0 is referenced by
+  some live hold (pinned memory always has an owner), and a block with
+  refcount 0 is never referenced by a live hold (use-after-free).
+- ``resume-identity``    — an adopted chain gathers exactly the token
+  stream the original published (resume-token-identity across the
+  kill-requeue).
+
+Mutation knobs (pinned to yield counterexamples in tests):
+
+- ``double_release``  — releasing a hold decrements each block twice
+  (the classic refcount underflow).
+- ``cow_leak``        — extend-under-sharing copies the tail but skips
+  the unref of the original (orphaned pinned block).
+- ``adopt_corrupt``   — adoption resolves the digest to a block with a
+  truncated token payload (a digest check that stopped checking).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Tuple
+
+from .kernel import Model
+
+__all__ = ["KVModel"]
+
+#: the published chain: two blocks and their token payloads
+CHAIN: Tuple[Tuple[str, Tuple[int, ...]], ...] = (
+    ("b1", (1, 2)), ("b2", (3, 4)))
+#: the extension tokens H0 may append after publishing
+EXT: Tuple[int, ...] = (5, 6)
+
+
+class Hold(NamedTuple):
+    alive: bool
+    refs: Tuple[str, ...]          # digests, in chain order
+    expect: Tuple[int, ...]        # tokens this hold must gather
+
+
+class KVState(NamedTuple):
+    #: pool blocks: (digest, tokens, refcount) — the implementation view
+    blocks: Tuple[Tuple[str, Tuple[int, ...], int], ...]
+    holds: Tuple[Hold, ...]        # index 0 = H0 (origin), 1 = H1
+    published: bool
+    extended: bool
+    killed: bool
+
+
+def _pool(blocks) -> Dict[str, Tuple[Tuple[int, ...], int]]:
+    return {d: (toks, rc) for d, toks, rc in blocks}
+
+
+def _freeze(pool: Dict[str, Tuple[Tuple[int, ...], int]]):
+    return tuple(sorted((d, toks, rc) for d, (toks, rc) in pool.items()))
+
+
+class KVModel(Model):
+    name = "kv"
+    mutations = ("double_release", "cow_leak", "adopt_corrupt")
+
+    def initial(self) -> KVState:
+        return KVState(
+            blocks=(),
+            holds=(Hold(True, (), ()), Hold(False, (), ())),
+            published=False, extended=False, killed=False)
+
+    # ------------------------------------------------------------ helpers
+
+    def _ref(self, pool, digest: str, n: int = 1) -> None:
+        toks, rc = pool[digest]
+        pool[digest] = (toks, rc + n)
+
+    def _unref(self, pool, digest: str) -> None:
+        n = 2 if self.mutation == "double_release" else 1
+        if digest in pool:
+            toks, rc = pool[digest]
+            pool[digest] = (toks, rc - n)
+
+    def _set_hold(self, s: KVState, i: int, h: Hold,
+                  pool) -> KVState:
+        holds = list(s.holds)
+        holds[i] = h
+        return s._replace(blocks=_freeze(pool), holds=tuple(holds))
+
+    # ------------------------------------------------------------ actions
+
+    def actions(self, s: KVState) -> List[Tuple[str, KVState]]:
+        out: List[Tuple[str, KVState]] = []
+        pool0 = _pool(s.blocks)
+        h0, h1 = s.holds
+
+        # H0 publishes the chain: blocks inserted with refcount 1
+        if not s.published and h0.alive:
+            pool = dict(pool0)
+            for d, toks in CHAIN:
+                pool[d] = (toks, 1)
+            ns = self._set_hold(
+                s._replace(published=True), 0,
+                Hold(True, tuple(d for d, _ in CHAIN),
+                     tuple(t for _, toks in CHAIN for t in toks)),
+                pool)
+            out.append(("h0.publish", ns))
+
+        # H1 adopts by digest (router recovery / sibling prefix hit). A
+        # present block is adoptable even at refcount 0 — eviction, not
+        # release, is what invalidates a digest
+        if s.published and not h1.alive:
+            tail = CHAIN[-1][0]
+            if all(d in pool0 for d, _ in CHAIN):
+                pool = dict(pool0)
+                expect: List[int] = []
+                for d, _ in CHAIN:
+                    self._ref(pool, d)
+                    toks = pool[d][0]
+                    if self.mutation == "adopt_corrupt":
+                        toks = toks[:-1]  # truncated payload adopted as-is
+                    expect.extend(toks)
+                # what adoption must reproduce: the ORIGINAL stream
+                want = tuple(t for _, toks in CHAIN for t in toks)
+                got = tuple(expect)
+                ns = self._set_hold(
+                    s, 1, Hold(True, tuple(d for d, _ in CHAIN),
+                               want if got == want else got), pool)
+                # record divergence by storing what was actually gathered
+                out.append(("h1.adopt(" + tail + ")", ns))
+
+        # H0 extends its tail. Sole holder mutates in place; a shared
+        # tail takes the COW path: copy, ref the copy, unref the original
+        if (s.published and not s.extended and h0.alive
+                and h0.refs):
+            tail = h0.refs[-1]
+            toks, rc = pool0[tail]
+            pool = dict(pool0)
+            if rc > 1:
+                new_d = tail + "'"
+                pool[new_d] = (toks + EXT, 1)
+                if self.mutation != "cow_leak":
+                    self._unref(pool, tail)
+                refs = h0.refs[:-1] + (new_d,)
+                label = "h0.extend/cow"
+            else:
+                # sole holder: the real pool drops the old partial and
+                # re-inserts under the extension's content digest — the
+                # old digest stops resolving
+                new_d = tail + "+"
+                del pool[tail]
+                pool[new_d] = (toks + EXT, rc)
+                refs = h0.refs[:-1] + (new_d,)
+                label = "h0.extend/grow"
+            ns = self._set_hold(
+                s._replace(extended=True), 0,
+                Hold(True, refs, h0.expect + EXT), pool)
+            out.append((label, ns))
+
+        # kill-requeue: H0 dies, its hold is released (the worker's
+        # _on_done/release path after _fail_all)
+        if h0.alive and h0.refs and not s.killed:
+            pool = dict(pool0)
+            for d in h0.refs:
+                self._unref(pool, d)
+            ns = self._set_hold(
+                s._replace(killed=True), 0, Hold(False, (), ()), pool)
+            out.append(("h0.kill-requeue", ns))
+
+        # H1 releases its hold when finished
+        if h1.alive and h1.refs:
+            pool = dict(pool0)
+            for d in h1.refs:
+                self._unref(pool, d)
+            ns = self._set_hold(s, 1, Hold(False, (), ()), pool)
+            out.append(("h1.release", ns))
+
+        # eviction reclaims any refcount-zero block (LRU's endpoint)
+        for d, (toks, rc) in sorted(pool0.items()):
+            if rc == 0:
+                pool = dict(pool0)
+                del pool[d]
+                out.append((f"evict({d})",
+                            s._replace(blocks=_freeze(pool))))
+
+        return out
+
+    # --------------------------------------------------------- invariants
+
+    def invariants(self, s: KVState) -> List[str]:
+        bad: List[str] = []
+        pool = _pool(s.blocks)
+        truth: Dict[str, int] = {}
+        for h in s.holds:
+            if h.alive:
+                for d in h.refs:
+                    truth[d] = truth.get(d, 0) + 1
+        for d, (toks, rc) in sorted(pool.items()):
+            if rc < 0:
+                bad.append(f"refcount-conserved: block {d} refcount {rc} "
+                           f"went negative")
+            elif rc != truth.get(d, 0):
+                bad.append(f"refcount-conserved: block {d} refcount {rc} "
+                           f"but {truth.get(d, 0)} live hold(s) "
+                           f"reference it")
+            if rc > 0 and truth.get(d, 0) == 0:
+                bad.append(f"no-orphan-pin: block {d} pinned "
+                           f"(refcount {rc}) with no live holder")
+        for d in truth:
+            if d not in pool:
+                bad.append(f"no-orphan-pin: live hold references "
+                           f"evicted block {d} (use-after-free)")
+        want = tuple(t for _, toks in CHAIN for t in toks)
+        h1 = s.holds[1]
+        if h1.alive and h1.refs and h1.expect != want:
+            bad.append(f"resume-identity: adopted chain gathers "
+                       f"{list(h1.expect)} but the original published "
+                       f"{list(want)}")
+        return bad

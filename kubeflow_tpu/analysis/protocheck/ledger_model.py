@@ -1,0 +1,231 @@
+"""Protocol model: the shared chip ledger (scheduler/chipsched.py).
+
+A micro-inventory of the ChipScheduler's admission path — capacity 8
+chips in two 4-chip slices, two tenants entitled to half each — with the
+moves the real ledger makes under concurrent claimants:
+
+- ``claim``   — a tenant claims a gang (4 chips, needs a whole slice)
+  or a replica (2 chips, best-fit); admission computes the DRF borrow
+  (usage beyond entitlement while the other tenant is under),
+- ``preempt`` — a claim that cannot place may evict strictly-lower-
+  priority gangs (and at-or-equal-priority *borrowed* claims), but only
+  after a feasibility check proves the claim then places — and never
+  when the claim itself would be borrowing,
+- ``release``— returns chips to the free pool.
+
+The model carries the implementation's free-chip ledger *separately*
+from the claims it derives from, so double-accounting bugs show up as
+divergence instead of being true by construction.
+
+Invariants:
+
+- ``chips-conserved``   — implementation free + sum(claim chips) equals
+  capacity, per slice and in total; free never negative.
+- ``no-double-grant``   — a claim key is granted at most once
+  concurrently.
+- ``borrower-no-preempt`` — an admission that borrowed beyond its DRF
+  entitlement never evicted anyone to do it.
+- ``feasible-commit``   — every preemption is committed together with a
+  successful placement (no victims evicted for a claim that then
+  failed to place).
+
+Mutation knobs (pinned to yield counterexamples in tests):
+
+- ``skip_double_claim_check`` — admission stops refusing a key that is
+  already granted.
+- ``borrow_preempts``         — a borrowing claim is allowed to evict.
+- ``evict_before_check``      — victims are committed before the
+  placement feasibility check instead of after.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+from .kernel import Model
+
+__all__ = ["LedgerModel"]
+
+CAPACITY = 8
+SLICES = 2
+CPS = 4  # chips per slice
+ENTITLEMENT = {"t0": 4, "t1": 4, "t2": 4}
+
+#: the candidate claims the concurrent clients race to admit:
+#: (key, tenant, kind, chips, priority)
+CANDIDATES: Tuple[Tuple[str, str, str, int, int], ...] = (
+    ("t0/batch", "t0", "replica", 2, 0),     # preemptible batch replica
+    ("t0/serve", "t0", "replica", 2, 2000),  # serving replica
+    ("t1/serve", "t1", "replica", 2, 2000),  # serving replica
+    ("t1/gang", "t1", "gang", 4, 1000),      # interactive gang (t1)
+    ("t2/gang", "t2", "gang", 4, 1000),      # interactive gang (t2)
+)
+
+
+class Claim(NamedTuple):
+    key: str
+    tenant: str
+    chips: int
+    priority: int
+    borrowed: int
+    #: chips placed per slice index
+    slices: Tuple[int, ...]
+
+
+class LedgerState(NamedTuple):
+    claims: Tuple[Claim, ...]
+    free_impl: int                  # the implementation's own counter
+    #: set when a borrowing admission evicted someone (must never)
+    borrower_preempted: bool
+    #: set when victims were evicted and the claim then failed to place
+    evicted_for_nothing: bool
+
+
+class LedgerModel(Model):
+    name = "ledger"
+    mutations = ("skip_double_claim_check", "borrow_preempts",
+                 "evict_before_check")
+
+    def initial(self) -> LedgerState:
+        return LedgerState(claims=(), free_impl=CAPACITY,
+                           borrower_preempted=False,
+                           evicted_for_nothing=False)
+
+    # ------------------------------------------------------------ placing
+
+    @staticmethod
+    def _slice_free(claims: Tuple[Claim, ...]) -> List[int]:
+        free = [CPS] * SLICES
+        for c in claims:
+            for i, n in enumerate(c.slices):
+                free[i] -= n
+        return free
+
+    @classmethod
+    def _place(cls, claims: Tuple[Claim, ...], kind: str,
+               chips: int) -> Optional[Tuple[int, ...]]:
+        free = cls._slice_free(claims)
+        if kind == "gang":
+            # gangs take whole slices (the whole_slice fast path)
+            for i in range(SLICES):
+                if free[i] == CPS and chips == CPS:
+                    placed = [0] * SLICES
+                    placed[i] = chips
+                    return tuple(placed)
+            return None
+        # replicas best-fit the fullest slice with room
+        best = None
+        for i in range(SLICES):
+            if free[i] >= chips and (best is None or free[i] < free[best]):
+                best = i
+        if best is None:
+            return None
+        placed = [0] * SLICES
+        placed[best] = chips
+        return tuple(placed)
+
+    # ------------------------------------------------------------ actions
+
+    def actions(self, s: LedgerState) -> List[Tuple[str, LedgerState]]:
+        out: List[Tuple[str, LedgerState]] = []
+        held_keys = {c.key for c in s.claims}
+
+        for key, tenant, kind, chips, prio in CANDIDATES:
+            if (key in held_keys
+                    and self.mutation != "skip_double_claim_check"):
+                continue  # the real _claim denies a live key up front
+            ns = self._admit(s, key, tenant, kind, chips, prio)
+            if ns is not None:
+                out.append((f"claim({key})", ns))
+
+        for c in s.claims:
+            ns = s._replace(
+                claims=tuple(x for x in s.claims if x is not c),
+                free_impl=s.free_impl + c.chips)
+            out.append((f"release({c.key})", ns))
+        return out
+
+    def _admit(self, s: LedgerState, key: str, tenant: str, kind: str,
+               chips: int, prio: int) -> Optional[LedgerState]:
+        # DRF borrow: usage beyond entitlement is borrowed capacity
+        used_t = sum(c.chips for c in s.claims if c.tenant == tenant)
+        borrowed = max(0, min(chips, used_t + chips - ENTITLEMENT[tenant]))
+
+        placed = self._place(s.claims, kind, chips)
+        if placed is not None:
+            claim = Claim(key, tenant, chips, prio, borrowed, placed)
+            return s._replace(claims=s.claims + (claim,),
+                              free_impl=s.free_impl - chips)
+
+        # no room: the preemption path. Borrowers never preempt —
+        # beyond-entitlement demand waits instead of evicting
+        if borrowed > 0 and self.mutation != "borrow_preempts":
+            return None
+        # victim candidates: strictly-lower-priority claims, plus
+        # at-or-equal priority claims that are themselves borrowing
+        # (reclaim); evicted lowest-priority-first, youngest-first,
+        # one at a time until the claim places (minimal victim set)
+        pool = [c for c in s.claims
+                if c.priority < prio
+                or (c.borrowed > 0 and c.priority <= prio)]
+        pool.sort(key=lambda c: (c.priority, -s.claims.index(c)))
+        if not pool:
+            return None
+        evicted: List[Claim] = []
+        placed = None
+        for v in pool:
+            evicted.append(v)
+            survivors = tuple(c for c in s.claims if c not in evicted)
+            placed = self._place(survivors, kind, chips)
+            if placed is not None:
+                break
+        if placed is None:
+            if self.mutation == "evict_before_check":
+                # victims were already committed before the check
+                survivors = tuple(
+                    c for c in s.claims if c not in evicted)
+                return s._replace(
+                    claims=survivors,
+                    free_impl=s.free_impl
+                    + sum(c.chips for c in evicted),
+                    evicted_for_nothing=True)
+            return None  # feasibility check fails → nothing committed
+        survivors = tuple(c for c in s.claims if c not in evicted)
+        claim = Claim(key, tenant, chips, prio, borrowed, placed)
+        return s._replace(
+            claims=survivors + (claim,),
+            free_impl=s.free_impl
+            + sum(c.chips for c in evicted) - chips,
+            borrower_preempted=s.borrower_preempted or borrowed > 0)
+
+    # --------------------------------------------------------- invariants
+
+    def invariants(self, s: LedgerState) -> List[str]:
+        bad: List[str] = []
+        held = sum(c.chips for c in s.claims)
+        if s.free_impl < 0:
+            bad.append(f"chips-conserved: free counter went negative "
+                       f"({s.free_impl})")
+        if s.free_impl + held != CAPACITY:
+            bad.append(f"chips-conserved: free {s.free_impl} + held "
+                       f"{held} != capacity {CAPACITY}")
+        for i, free in enumerate(self._slice_free(s.claims)):
+            if free < 0:
+                bad.append(f"chips-conserved: slice {i} oversubscribed "
+                           f"by {-free} chips")
+        for c in s.claims:
+            if sum(c.slices) != c.chips:
+                bad.append(f"chips-conserved: claim {c.key} placed "
+                           f"{sum(c.slices)} chips but holds {c.chips}")
+        keys = [c.key for c in s.claims]
+        for k in sorted(set(keys)):
+            if keys.count(k) > 1:
+                bad.append(f"no-double-grant: key {k!r} granted "
+                           f"{keys.count(k)} times concurrently")
+        if s.borrower_preempted:
+            bad.append("borrower-no-preempt: a beyond-entitlement "
+                       "(borrowing) admission evicted a victim")
+        if s.evicted_for_nothing:
+            bad.append("feasible-commit: victims were evicted for a "
+                       "claim that then failed to place")
+        return bad

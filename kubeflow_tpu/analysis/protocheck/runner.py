@@ -1,0 +1,130 @@
+"""The modelcheck driver behind ``python -m kubeflow_tpu.analysis
+--modelcheck`` and ``make modelcheck``.
+
+Runs every registered protocol model through the exploration kernel with
+a tier-1-safe bounded budget (overridable via KFTPU_MODELCHECK_DEPTH /
+KFTPU_MODELCHECK_SEED), prints a one-line verdict per model plus any
+counterexample schedules, and feeds the ``kftpu_protocheck_*`` counters
+the metrics exposition renders.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.utils.envvars import (ENV_MODELCHECK_DEPTH,
+                                        ENV_MODELCHECK_SEED)
+
+from .kernel import ExploreResult, Model, explore
+from .kv_model import KVModel
+from .ledger_model import LedgerModel
+from .wire_model import WireModel
+
+__all__ = [
+    "ALL_MODELS",
+    "default_budget",
+    "run_modelcheck",
+    "protocheck_metrics_snapshot",
+    "reset_protocheck_metrics",
+]
+
+ALL_MODELS = (WireModel, KVModel, LedgerModel)
+
+#: per-model exhaustive depth that keeps the full sweep tier-1-cheap
+#: (a few seconds total on one CPU) while covering every counterexample
+#: the shipped mutations need — the random-walk frontier probes past it
+DEFAULT_DEPTH = {"wire": 8, "kv": 12, "ledger": 8}
+DEFAULT_WALKS = 64
+DEFAULT_WALK_DEPTH = 32
+
+_METRICS_MU = threading.Lock()
+_METRICS: Dict[str, int] = {
+    "models_checked_total": 0,
+    "states_explored_total": 0,
+    "violations_total": 0,
+}
+
+
+def protocheck_metrics_snapshot() -> Dict[str, int]:
+    with _METRICS_MU:
+        return dict(_METRICS)
+
+
+def reset_protocheck_metrics() -> None:
+    with _METRICS_MU:
+        for k in _METRICS:
+            _METRICS[k] = 0
+
+
+def default_budget() -> Dict[str, int]:
+    """The effective depth/seed budget, env overrides applied."""
+    depth_env = os.environ.get(ENV_MODELCHECK_DEPTH)
+    seed = int(os.environ.get(ENV_MODELCHECK_SEED, "0") or 0)
+    budget = {"seed": seed}
+    for name, depth in DEFAULT_DEPTH.items():
+        budget[name] = int(depth_env) if depth_env else depth
+    return budget
+
+
+def run_modelcheck(*, depth: Optional[int] = None,
+                   seed: Optional[int] = None,
+                   models=None, quiet: bool = False) -> List[ExploreResult]:
+    """Explore every model; returns per-model results (and counts them)."""
+    budget = default_budget()
+    results: List[ExploreResult] = []
+    for cls in (models if models is not None else ALL_MODELS):
+        model: Model = cls() if isinstance(cls, type) else cls
+        d = depth if depth is not None else budget.get(model.name, 8)
+        res = explore(model, depth=d,
+                      seed=seed if seed is not None else budget["seed"],
+                      walks=DEFAULT_WALKS, walk_depth=DEFAULT_WALK_DEPTH)
+        results.append(res)
+        with _METRICS_MU:
+            _METRICS["models_checked_total"] += 1
+            _METRICS["states_explored_total"] += res.states_explored
+            _METRICS["violations_total"] += len(res.violations)
+        if not quiet:
+            verdict = "clean" if res.ok else "VIOLATED"
+            print(f"protocheck: {model.name}: {verdict} — "
+                  f"{res.states_explored} states, {res.transitions} "
+                  f"transitions, depth {res.max_depth_reached}, "
+                  f"{res.truncated_frontier} frontier states probed by "
+                  f"{res.random_walk_steps} random-walk steps")
+            for v in res.violations:
+                print(v.render())
+    return results
+
+
+def main_modelcheck(depth: Optional[int] = None,
+                    seed: Optional[int] = None) -> int:
+    """CLI entry: 0 when every model explores clean, 1 otherwise."""
+    results = run_modelcheck(depth=depth, seed=seed)
+    bad = sum(len(r.violations) for r in results)
+    if bad:
+        print(f"protocheck: {bad} invariant violation(s) across "
+              f"{len(results)} model(s)")
+        return 1
+    return 0
+
+
+def main_conform(paths: List[str]) -> int:
+    """CLI entry for ``--conform LOG [LOG...]``: replay recorded drill
+    logs through every model's trace acceptor."""
+    from .conform import TraceRejected, check_trace
+    from .eventlog import read_log
+    rc = 0
+    for path in paths:
+        events = read_log(path)
+        try:
+            counts = check_trace(events)
+        except TraceRejected as e:
+            print(f"protocheck: conform: {path}: REJECTED: {e}")
+            rc = 1
+            continue
+        checked = {k: v for k, v in counts.items() if v}
+        desc = ", ".join(f"{k}={v}" for k, v in sorted(checked.items()))
+        print(f"protocheck: conform: {path}: accepted "
+              f"({desc or 'no protocol events'})")
+    return rc

@@ -1,0 +1,271 @@
+"""Protocol model: the epoch-fenced pod wire (podclient × podworker).
+
+A pure-Python abstraction of the real endpoints' state machines
+(serving/fleet/podworker.py handle/_verb_* and podclient.py
+_attempt/tick/_apply_event), small enough to enumerate:
+
+- ONE request rid with a fixed workload (1 token then done) — the
+  protocol's obligations are per-request, so one rid exercises them all;
+- up to TWO client incarnations: the original (epoch 1) and one
+  supervisor respawn (epoch 2), the minimal population where fencing,
+  410 refusal and state purge-on-adoption can go wrong;
+- the lossy network folded into RPC *outcomes* exactly as the real
+  chaos faults land: ``lost`` (blackhole — request never delivered),
+  ``noreply`` (half-open — delivered, reply lost), ``ok``, and for tick
+  ``okdup`` (reply duplicated — the client applies the same event batch
+  twice, which the ack filter must refuse).
+
+Worker semantics mirrored: monotonic event ids; cumulative-ack outbox
+pruned by the tick request's ack; rid dedup on submit; hello adopts a
+strictly-newer epoch by PURGING outbox + seen rids + queued work; every
+verb from a staler epoch refused with 410, which fences that client.
+
+Invariants checked at every reached state:
+
+- ``epoch-monotonic``   — the worker epoch never trails an adoption.
+- ``fence-complete``    — after adopting epoch E, no outbox entry, seen
+  rid or queued work tagged with an older epoch survives (a superseded
+  claim's state must never leak into the successor).
+- ``single-copy``       — token streams are delivered single-copy: no
+  duplicate event id reaches the app, and no client sees more tokens
+  for the rid than the request generates.
+- ``acked-complete``    — a client that saw ``done`` saw the full token
+  stream first (nothing it acked was lost).
+
+Mutation knobs (each must produce a counterexample — pinned in tests):
+
+- ``skip_outbox_purge`` — hello adopts a newer epoch without clearing
+  outbox/rids/queue (the exact leak 410 fencing exists to prevent).
+- ``drop_rid_dedup``    — submit stops deduplicating rids, so a retried
+  submit enqueues the request twice.
+- ``ack_unseen``        — the client acks one event id beyond what it
+  delivered, letting the worker prune an event it never saw.
+- ``no_ack_filter``     — the client applies tick events without the
+  ``id > acked`` redelivery filter.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+from .kernel import Model
+
+__all__ = ["WireModel"]
+
+RID = "r"
+MAX_TOKENS = 1  # the fixed workload: one token, then done
+
+
+class Client(NamedTuple):
+    epoch: int
+    #: hello completed — the real connect() rendezvous always precedes
+    #: submit/tick, so the model gates them on it too
+    connected: bool
+    fenced: bool
+    acked: int
+    #: events delivered to the app layer: (rid, kind, id)
+    got: Tuple[Tuple[str, str, int], ...]
+    done: bool
+
+
+class WireState(NamedTuple):
+    w_epoch: int          # worker's adopted epoch
+    adopted: int          # highest epoch any hello successfully adopted
+    next_id: int          # worker's monotonic event-id counter
+    #: worker outbox: (id, rid, kind, emit_epoch)
+    outbox: Tuple[Tuple[int, str, str, int], ...]
+    #: rids the worker deduplicates on, tagged with submit epoch
+    rids: Tuple[Tuple[str, int], ...]
+    #: queued engine work: (rid, epoch, tokens_emitted)
+    queue: Tuple[Tuple[str, int, int], ...]
+    #: tokens emitted per (rid, epoch) — survives outbox pruning
+    emitted: Tuple[Tuple[Tuple[str, int], int], ...]
+    clients: Tuple[Client, ...]
+    respawned: bool
+
+
+class WireModel(Model):
+    name = "wire"
+    mutations = ("skip_outbox_purge", "drop_rid_dedup",
+                 "ack_unseen", "no_ack_filter")
+
+    def initial(self) -> WireState:
+        c0 = Client(epoch=1, connected=False, fenced=False, acked=0,
+                    got=(), done=False)
+        return WireState(w_epoch=0, adopted=0, next_id=1, outbox=(),
+                         rids=(), queue=(), emitted=(), clients=(c0,),
+                         respawned=False)
+
+    # ------------------------------------------------------ worker verbs
+
+    def _w_hello(self, s: WireState, epoch: int) -> WireState:
+        if epoch > s.w_epoch and self.mutation != "skip_outbox_purge":
+            s = s._replace(outbox=(), rids=(), queue=())
+        return s._replace(w_epoch=max(s.w_epoch, epoch),
+                          adopted=max(s.adopted, epoch))
+
+    def _w_submit(self, s: WireState, epoch: int) -> WireState:
+        if (self.mutation != "drop_rid_dedup"
+                and any(r == RID for r, _ in s.rids)):
+            return s  # dup reply — already queued or served
+        return s._replace(rids=s.rids + ((RID, epoch),),
+                          queue=s.queue + ((RID, epoch, 0),))
+
+    def _w_prune(self, s: WireState, ack: int) -> WireState:
+        return s._replace(
+            outbox=tuple(e for e in s.outbox if e[0] > ack))
+
+    # -------------------------------------------------------- the client
+
+    def _apply_events(self, c: Client,
+                      events: Tuple[Tuple[int, str, str, int], ...],
+                      times: int) -> Client:
+        for _ in range(times):
+            for eid, rid, kind, _epoch in events:
+                if eid <= c.acked and self.mutation != "no_ack_filter":
+                    continue  # redelivery refused by the ack filter
+                c = c._replace(got=c.got + ((rid, kind, eid),),
+                               acked=max(c.acked, eid),
+                               done=c.done or kind == "done")
+        return c
+
+    # ----------------------------------------------------------- actions
+
+    def actions(self, s: WireState) -> List[Tuple[str, WireState]]:
+        out: List[Tuple[str, WireState]] = []
+
+        def put(label: str, ns: WireState) -> None:
+            if ns != s:
+                out.append((label, ns))
+
+        for i, c in enumerate(s.clients):
+            if c.fenced:
+                continue  # a fenced client refuses to touch the wire
+            stale = c.epoch < s.w_epoch
+
+            def with_client(ns: WireState, nc: Client) -> WireState:
+                cl = list(ns.clients)
+                cl[i] = nc
+                return ns._replace(clients=tuple(cl))
+
+            # hello —— lost leaves no trace; delivered either fences a
+            # stale epoch (410) or adopts a newer one
+            if stale:
+                put(f"c{i}.hello->410",
+                    with_client(s, c._replace(fenced=True)))
+            else:
+                ns = self._w_hello(s, c.epoch)
+                put(f"c{i}.hello(e{c.epoch})",
+                    with_client(ns, c._replace(connected=True)))
+
+            # submit —— retried freely until the client saw done
+            if not c.done and c.connected:
+                if stale:
+                    put(f"c{i}.submit->410",
+                        with_client(s, c._replace(fenced=True)))
+                else:
+                    ns = self._w_submit(s, c.epoch)
+                    put(f"c{i}.submit({RID})", ns)
+                    # half-open: worker enqueued, reply lost — the retry
+                    # that follows is what rid dedup exists for
+                    put(f"c{i}.submit({RID})/noreply", ns)
+
+            # tick —— ack prunes, reply delivers (maybe twice), either
+            # leg can vanish
+            ack = c.acked + 1 if self.mutation == "ack_unseen" else c.acked
+            if not c.connected:
+                continue
+            if stale:
+                put(f"c{i}.tick->410",
+                    with_client(s, c._replace(fenced=True)))
+            else:
+                ns = self._w_prune(s, ack)
+                events = ns.outbox
+                put(f"c{i}.tick/noreply", ns)
+                put(f"c{i}.tick(ack={ack})",
+                    with_client(ns, self._apply_events(c, events, 1)))
+                if events:
+                    put(f"c{i}.tick(ack={ack})/okdup",
+                        with_client(ns, self._apply_events(c, events, 2)))
+
+        # the engine: one step of work on the queue head
+        if s.queue:
+            rid, epoch, toks = s.queue[0]
+            if toks < MAX_TOKENS:
+                eid = s.next_id
+                ns = s._replace(
+                    next_id=eid + 1,
+                    outbox=s.outbox + ((eid, rid, "token", epoch),),
+                    queue=((rid, epoch, toks + 1),) + s.queue[1:],
+                    emitted=_bump(s.emitted, (rid, epoch)))
+                put(f"w.emit(token#{eid})", ns)
+            else:
+                eid = s.next_id
+                ns = s._replace(
+                    next_id=eid + 1,
+                    outbox=s.outbox + ((eid, rid, "done", epoch),),
+                    queue=s.queue[1:])
+                put(f"w.emit(done#{eid})", ns)
+
+        # the supervisor: one respawn with the next fence epoch
+        if not s.respawned:
+            succ = Client(epoch=max(c.epoch for c in s.clients) + 1,
+                          connected=False, fenced=False, acked=0,
+                          got=(), done=False)
+            put(f"respawn(e{succ.epoch})",
+                s._replace(clients=s.clients + (succ,), respawned=True))
+
+        return out
+
+    # -------------------------------------------------------- invariants
+
+    def invariants(self, s: WireState) -> List[str]:
+        bad: List[str] = []
+        if s.w_epoch < s.adopted:
+            bad.append(f"epoch-monotonic: worker epoch {s.w_epoch} "
+                       f"trails adopted {s.adopted}")
+        if s.adopted:
+            for eid, rid, kind, epoch in s.outbox:
+                if epoch < s.w_epoch:
+                    bad.append(f"fence-complete: outbox event #{eid} "
+                               f"({kind}) from fenced epoch {epoch} "
+                               f"survived adoption of {s.w_epoch}")
+                    break
+            for rid, epoch in s.rids:
+                if epoch < s.w_epoch:
+                    bad.append(f"fence-complete: rid {rid!r} from fenced "
+                               f"epoch {epoch} survived adoption of "
+                               f"{s.w_epoch}")
+                    break
+            for rid, epoch, _ in s.queue:
+                if epoch < s.w_epoch:
+                    bad.append(f"fence-complete: queued work for {rid!r} "
+                               f"from fenced epoch {epoch} survived "
+                               f"adoption of {s.w_epoch}")
+                    break
+        for (rid, epoch), n in s.emitted:
+            if n > MAX_TOKENS:
+                bad.append(f"single-copy: worker emitted {n} tokens for "
+                           f"{rid!r} (request generates {MAX_TOKENS})")
+        for i, c in enumerate(s.clients):
+            ids = [eid for _, _, eid in c.got]
+            if len(ids) != len(set(ids)):
+                bad.append(f"single-copy: client {i} delivered a "
+                           f"duplicate event id to the app: {ids}")
+            toks = sum(1 for _, kind, _ in c.got if kind == "token")
+            if toks > MAX_TOKENS:
+                bad.append(f"single-copy: client {i} delivered {toks} "
+                           f"tokens for {RID!r} (request generates "
+                           f"{MAX_TOKENS})")
+            if c.done and toks < MAX_TOKENS:
+                bad.append(f"acked-complete: client {i} saw done with "
+                           f"only {toks}/{MAX_TOKENS} tokens delivered "
+                           f"(an acked event was lost)")
+        return bad
+
+
+def _bump(emitted: Tuple[Tuple[Tuple[str, int], int], ...],
+          key: Tuple[str, int]) -> Tuple[Tuple[Tuple[str, int], int], ...]:
+    d = dict(emitted)
+    d[key] = d.get(key, 0) + 1
+    return tuple(sorted(d.items()))

@@ -337,6 +337,25 @@ def render_metrics(platform) -> str:
           help_="oldest live pod worker heartbeat age (the hang "
                 "watch's SIGSTOP signal); 0 with no live pods")
 
+    # protocol model checker (kubeflow_tpu/analysis/protocheck,
+    # docs/analysis.md "Protocol model checking"): sweep accounting —
+    # nonzero only after `make modelcheck` / run_modelcheck() ran in
+    # this process
+    from kubeflow_tpu.analysis.protocheck import protocheck_metrics_snapshot
+    protocheck_help = {
+        "models_checked_total": "protocol models swept by the "
+                                "bounded-exhaustive explorer "
+                                "(wire/kv/ledger x runs)",
+        "states_explored_total": "distinct protocol states visited "
+                                 "across all modelcheck sweeps",
+        "violations_total": "invariant violations found (0 at HEAD; "
+                            "nonzero means a counterexample schedule "
+                            "was rendered)",
+    }
+    for mname, v in sorted(protocheck_metrics_snapshot().items()):
+        counter(f"kftpu_protocheck_{mname}", v,
+                help_=protocheck_help.get(mname))
+
     # SLO burn-rate monitor (kubeflow_tpu/monitoring, docs/slo.md):
     # evaluation/alert counters, per-objective burn-rate and alert
     # gauges, and the TSDB's volume/loss accounting. A platform without

@@ -60,6 +60,7 @@ import time
 from dataclasses import dataclass, field
 
 from kubeflow_tpu.analysis.lockcheck import GuardedState, make_lock
+from kubeflow_tpu.analysis.protocheck.eventlog import log_event
 
 #: The platform priority ladder (ISSUE: serving > interactive > batch).
 #: Values align with gang.PRIORITY_CLASSES so a gang claim's PodGroup
@@ -236,6 +237,9 @@ class ChipScheduler:
             c.slices = tuple(sorted(merged.items()))
             c.chips += extra
             self.metrics["grants_total"] += 1
+            log_event("ledger", "sched", "grow", key=key, chips=c.chips,
+                      extra=extra, capacity=self.capacity_chips,
+                      free=self._free_locked())
             return True
 
     def release(self, key: str, uid: str = "") -> int:
@@ -249,7 +253,36 @@ class ChipScheduler:
                 return 0
             self._guarded.claims.pop(key)
             self.metrics["reclaimed_chips_total"] += c.chips
+            log_event("ledger", "sched", "release", key=key,
+                      chips=c.chips, capacity=self.capacity_chips,
+                      free=self._free_locked())
             return c.chips
+
+    def audit(self) -> dict:
+        """Chip-conservation audit — the drill suites call this after a
+        storm. Asserts, under the ledger lock: every claim's slice
+        placement sums to exactly its chips, no slice is oversubscribed,
+        and the per-slice free chips account for every held chip (so a
+        lost or double-counted grant cannot hide). Returns the audited
+        figures for the caller's own asserts."""
+        with self._mu:
+            cap = self.capacity_chips
+            claims = self._guarded.claims
+            for c in claims.values():
+                placed = sum(k for _, k in c.slices)
+                assert placed == c.chips, (
+                    f"ledger audit: claim {c.key!r} holds {c.chips} "
+                    f"chips but its slices sum to {placed}")
+            slice_free = self._slice_free()
+            assert min(slice_free, default=0) >= 0, (
+                f"ledger audit: slice oversubscribed: {slice_free}")
+            held = sum(c.chips for c in claims.values())
+            assert sum(slice_free) == cap - held, (
+                f"ledger audit: chips not conserved: per-slice free "
+                f"{slice_free} != capacity {cap} - held {held}")
+            return {"capacity": cap, "held": held,
+                    "free": cap - held, "claims": len(claims),
+                    "slice_free": slice_free}
 
     # ------------------------------------------------------------- views
 
@@ -479,6 +512,11 @@ class ChipScheduler:
             if t0 is not None and kind == "gang":
                 self.preempt_to_resume_s.append(time.monotonic() - t0)
                 self.metrics["resumes_total"] += 1
+            log_event("ledger", "sched", "grant", key=key,
+                      chips=chips, borrowed=borrowed,
+                      capacity=self.capacity_chips,
+                      free=self._free_locked(),
+                      evicted=[v.key for v in evict_plan])
             return Grant(key=key, chips=chips, slices=placed[0],
                          placement=placed[1], borrowed=borrowed,
                          preempted=tuple(v.key for v in evict_plan)), \

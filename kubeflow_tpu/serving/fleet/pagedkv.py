@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.analysis.protocheck.eventlog import log_event
 
 #: digest of the empty prefix — the chain root every block hangs off
 ROOT = b"kftpu-fleet-root"
@@ -255,6 +256,10 @@ class PagedKVPool:
                 pos += tail.ids.size
             for blk in blocks:
                 self._ref(blk)
+            if blocks:
+                log_event("kv", "pool", "publish",
+                          digests=[b.digest.hex() for b in blocks],
+                          rcs=[b.refcount for b in blocks])
             kv: dict[str, np.ndarray] = {}
             if blocks:
                 for path in blocks[0].kv:
@@ -319,6 +324,10 @@ class PagedKVPool:
                 parent = d
                 pos += take
             self._evict_to_capacity()
+            if held:
+                log_event("kv", "pool", "publish",
+                          digests=[d.hex() for d in held],
+                          rcs=[self._table[d].refcount for d in held])
             return held
 
     def _prefixed_partial(self, blk: _Block):
@@ -378,6 +387,9 @@ class PagedKVPool:
                 else:
                     self._drop(blk)
                 self.metrics["blocks_cached"] = len(self._table)
+                log_event("kv", "pool", "extend", parent=ref.hex(),
+                          digest=d.hex(), cow=False,
+                          rc=existing.refcount)
                 return d
             new = _Block(
                 digest=d, parent=blk.parent, ids=new_ids,
@@ -385,7 +397,8 @@ class PagedKVPool:
                     for p in blk.kv},
                 full=new_ids.size == self.block_size,
             )
-            if blk.refcount > 1:
+            cow = blk.refcount > 1
+            if cow:
                 # shared: publish the extension beside the original
                 self.metrics["cow_copies_total"] += 1
                 self._unref(blk)
@@ -398,6 +411,8 @@ class PagedKVPool:
                 self._table[blk.parent].children.add(d)
             self.metrics["blocks_cached"] = len(self._table)
             self._evict_to_capacity()
+            log_event("kv", "pool", "extend", parent=ref.hex(),
+                      digest=d.hex(), cow=cow, rc=new.refcount)
             return d
 
     def append_child(self, parent: bytes, ids,
@@ -440,6 +455,8 @@ class PagedKVPool:
                 self.metrics["blocks_cached"] = len(self._table)
             self._ref(blk)
             self._evict_to_capacity()
+            log_event("kv", "pool", "publish", digests=[d.hex()],
+                      rcs=[blk.refcount])
             return d
 
     # ------------------------------------------------- adoption / gather
@@ -461,6 +478,8 @@ class PagedKVPool:
                 blocks.append(blk)
             for blk in blocks:
                 self._ref(blk)
+                log_event("kv", "pool", "adopt", digest=blk.digest.hex(),
+                          rc=blk.refcount)
 
     def gather(self, refs: list[bytes]):
         """Materialize a held chain: (token ids, per-leaf concatenated
@@ -505,10 +524,16 @@ class PagedKVPool:
         """Drop the references a retired sequence held; unreferenced
         blocks stay cached (that is the reuse) until LRU eviction."""
         with self._mu:
+            dropped: list[_Block] = []
             for d in refs:
                 blk = self._table.get(d)
                 if blk is not None:
                     self._unref(blk)
+                    dropped.append(blk)
+            if dropped:
+                log_event("kv", "pool", "release",
+                          digests=[b.digest.hex() for b in dropped],
+                          rcs=[b.refcount for b in dropped])
             self._evict_to_capacity()
 
     def _drop(self, blk: _Block) -> None:

@@ -68,12 +68,52 @@ import time
 import numpy as np
 
 from kubeflow_tpu.analysis.lockcheck import make_lock
+from kubeflow_tpu.analysis.protocheck.eventlog import log_event
 from kubeflow_tpu.serving.fleet.wire import (
+    CODE_BUSY,
+    CODE_CONFLICT,
+    CODE_DEADLINE,
+    CODE_FENCED,
+    CODE_INTERNAL,
+    EV_DONE,
+    EV_TOKEN,
+    F_ACK,
+    F_CHAIN,
+    F_CODE,
+    F_DEADLINE_S,
+    F_DEPTH,
+    F_EOS,
+    F_EPOCH,
+    F_ERROR,
+    F_EV,
+    F_EVENTS,
+    F_ID,
+    F_KEEP_CHAIN,
+    F_MAX_NEW_TOKENS,
+    F_N,
+    F_OK,
+    F_PORT,
+    F_PROMPT,
+    F_RESUME,
+    F_RETRY_AFTER_S,
+    F_RID,
+    F_SEQ,
+    F_STEP_COUNT,
+    F_TEMPERATURE,
+    F_TICK_ERROR,
+    F_TOK,
+    F_TOKENS,
+    F_VERB,
+    F_BUSY,
     PodCallError,
     PodDead,
     PodDeadlineExpired,
     PodWireError,
     Transport,
+    VERB_HELLO,
+    VERB_KILL,
+    VERB_SUBMIT,
+    VERB_TICK,
     make_transport,
     serialize_chain,
 )
@@ -412,17 +452,17 @@ class PodClient:
                 self._close_socket()
                 raise PodWireError(f"chaos: {fault} (frame lost)")
             self._seq += 1
-            env = {"verb": verb, "seq": self._seq, "epoch": self.epoch,
-                   "deadline_s": (deadline.remaining()
+            env = {F_VERB: verb, F_SEQ: self._seq, F_EPOCH: self.epoch,
+                   F_DEADLINE_S: (deadline.remaining()
                                   if deadline is not None else None)}
             env.update(payload)
-            if fault == "dup" and "ack" in payload:
+            if fault == "dup" and F_ACK in payload:
                 # duplicate delivery, modeled at its true cause: the
                 # previous ack is lost in flight, so the worker's outbox
                 # keeps everything the client already applied and
                 # redelivers it — the id-filter refuses every copy
                 # (kftpu_pod_net_duplicate_acks_refused_total)
-                env["ack"] = 0
+                env[F_ACK] = 0
             try:
                 tr = self._ensure_conn(timeout_s)
                 tr.send_frame(env)
@@ -448,40 +488,42 @@ class PodClient:
             except PodWireError:
                 self._close_socket()
                 raise
-            if int(reply.get("seq", -1)) != self._seq:
+            if int(reply.get(F_SEQ, -1)) != self._seq:
                 self._close_socket()
                 raise PodWireError(
-                    f"reply seq {reply.get('seq')} != {self._seq}")
-        if reply.get("ok"):
+                    f"reply seq {reply.get(F_SEQ)} != {self._seq}")
+        if reply.get(F_OK):
             return reply
-        code = int(reply.get("code", 500))
-        if code == 410:
+        code = int(reply.get(F_CODE, CODE_INTERNAL))
+        if code == CODE_FENCED:
             # the worker adopted a NEWER epoch: this client's claim on
             # the replica identity is over. Fence (terminal — late
             # events will be refused) but never kill the process: it
             # now belongs to the successor's claim.
             pod_metric_bump("net_fenced_frames_total")
+            log_event("wire", "client", "fenced", epoch=self.epoch,
+                      pod=self.name)
             self._disowned = True
             # free the wire at once: the worker serves one connection
             # at a time, and holding this one would starve the very
             # successor whose epoch just outranked us
             self._close_socket()
             self.fence(f"worker refused stale epoch {self.epoch}: "
-                       f"{reply.get('error', '410')}")
+                       f"{reply.get(F_ERROR, code)}")
             raise PodDead(
-                f"pod {self.name} fenced: {reply.get('error', '410')}")
-        if code == 503:
+                f"pod {self.name} fenced: {reply.get(F_ERROR, code)}")
+        if code == CODE_BUSY:
             # server-side backpressure: honor Retry-After within the
             # caller's budget, then let the retry layer re-dial
-            if hinted_sleep(float(reply.get("retry_after_s", 0.05)),
+            if hinted_sleep(float(reply.get(F_RETRY_AFTER_S, 0.05)),
                             cap_s=1.0, deadline=deadline):
-                raise PodWireError("503 overloaded (retry-after taken)")
+                raise PodWireError("overloaded (retry-after taken)")
             raise PodDeadlineExpired(
-                "503 overloaded and no budget left for Retry-After")
-        if code == 504:
+                "overloaded and no budget left for Retry-After")
+        if code == CODE_DEADLINE:
             pod_metric_bump("deadline_rejects_total")
-            raise PodDeadlineExpired(reply.get("error", "deadline"))
-        raise PodCallError(code, reply.get("error", "pod call failed"))
+            raise PodDeadlineExpired(reply.get(F_ERROR, "deadline"))
+        raise PodCallError(code, reply.get(F_ERROR, "pod call failed"))
 
     def call(self, verb: str, payload: dict | None = None, *,
              deadline: Deadline | None = None,
@@ -539,9 +581,10 @@ class PodClient:
         poll_until(ready, timeout_s=timeout_s,
                    describe=f"pod {self.name} {self.transport_kind} "
                             f"rendezvous")
-        hello = self.call("hello", timeout_s=max(self.op_timeout_s, 10.0))
+        hello = self.call(VERB_HELLO,
+                          timeout_s=max(self.op_timeout_s, 10.0))
         if self.transport_kind == "tcp":
-            echoed = hello.get("port")
+            echoed = hello.get(F_PORT)
             if echoed is not None and self._port is not None \
                     and int(echoed) != self._port:
                 raise PodDead(
@@ -601,13 +644,13 @@ class PodClient:
         elif eos is not None:
             eos = int(eos)
         payload = {
-            "rid": rid,
-            "prompt": [int(t) for t in ids],
-            "max_new_tokens": budget,
-            "eos": eos,
-            "temperature": float(temperature),
-            "keep_chain": bool(keep_chain),
-            "resume": None,
+            F_RID: rid,
+            F_PROMPT: [int(t) for t in ids],
+            F_MAX_NEW_TOKENS: budget,
+            F_EOS: eos,
+            F_TEMPERATURE: float(temperature),
+            F_KEEP_CHAIN: bool(keep_chain),
+            F_RESUME: None,
         }
         handle = PodHandle(rid, budget, on_token=on_token,
                            on_done=on_done, trace_ctx=trace_ctx,
@@ -622,8 +665,8 @@ class PodClient:
                     "resume chain lives in a different pool than this "
                     "pod's home pool")
             ser = serialize_chain(chain.pool, chain.refs)
-            payload["resume"] = {"chain": ser,
-                                 "tokens": [int(t) for t in toks]}
+            payload[F_RESUME] = {F_CHAIN: ser,
+                                 F_TOKENS: [int(t) for t in toks]}
             pod_metric_bump("handoff_bytes_total",
                             _chain_payload_bytes(ser))
             # the zero-drop collateral: the HOME chain stays held on
@@ -634,13 +677,15 @@ class PodClient:
             handle.resumed = True
             handle.t_first = time.perf_counter()
         try:
-            self.call("submit", payload)
+            self.call(VERB_SUBMIT, payload)
+            log_event("wire", "client", "submit", rid=rid,
+                      epoch=self.epoch, resumed=bool(resume_from))
         except (PodWireError, PodDead, OSError) as e:
             self._quiet_dead(f"wire failure during submit: {e}")
             raise PodDead(
                 f"pod {self.name} died during submit: {e}") from e
         except PodCallError as e:
-            if e.code == 409 and resume_from is not None:
+            if e.code == CODE_CONFLICT and resume_from is not None:
                 # resume refusal (frozen on re-insert in the worker
                 # pool): release the recovery hold and fall back to
                 # scratch via the router's requeue arithmetic
@@ -664,8 +709,8 @@ class PodClient:
                 return False
             try:
                 reply = self.call(
-                    "tick",
-                    {"ack": self._acked, "n": self.ticks_per_call})
+                    VERB_TICK,
+                    {F_ACK: self._acked, F_N: self.ticks_per_call})
             except (PodWireError, OSError) as e:
                 self._mark_dead(f"wire failure during tick: {e}")
                 return False
@@ -684,23 +729,23 @@ class PodClient:
                 # carries is a LATE delivery from a superseded claim —
                 # refuse every event, ack nothing (the router-side half
                 # of epoch fencing).
-                late = list(reply.get("events", ()))
+                late = list(reply.get(F_EVENTS, ()))
                 if late:
                     pod_metric_bump("net_fenced_frames_total",
                                     len(late))
                 return False
             self.step_count = int(
-                reply.get("step_count", self.step_count))
+                reply.get(F_STEP_COUNT, self.step_count))
             self.prefill_tokens_total = int(
                 reply.get("prefill_tokens_total",
                           self.prefill_tokens_total))
             self.prefill_tokens_reused = int(
                 reply.get("prefill_tokens_reused",
                           self.prefill_tokens_reused))
-            self._worker_depth = int(reply.get("depth", 0))
-            raw = list(reply.get("events", ()))
+            self._worker_depth = int(reply.get(F_DEPTH, 0))
+            raw = list(reply.get(F_EVENTS, ()))
             events = [e for e in raw
-                      if int(e.get("id", 0)) > self._acked]
+                      if int(e.get(F_ID, 0)) > self._acked]
             if len(raw) > len(events):
                 # redelivery of already-acked events (a lost ack, a
                 # replayed tick after reconnect): each copy is refused
@@ -708,41 +753,44 @@ class PodClient:
                 pod_metric_bump("net_duplicate_acks_refused_total",
                                 len(raw) - len(events))
             if events:
-                self._acked = int(events[-1]["id"])
+                self._acked = int(events[-1][F_ID])
             for ev in events:
                 self._apply_event(ev)
-            if reply.get("tick_error"):
+            if reply.get(F_TICK_ERROR):
                 # poisoned engine: its _fail_all events just drained
                 # above; the process itself is now useless — reap it
                 self._mark_dead(
-                    f"worker engine poisoned: {reply['tick_error']}")
+                    f"worker engine poisoned: {reply[F_TICK_ERROR]}")
                 return False
-            return bool(reply.get("busy")) or bool(self._rows)
+            return bool(reply.get(F_BUSY)) or bool(self._rows)
 
     def _apply_event(self, ev: dict) -> None:
-        h = self._by_rid.get(str(ev.get("rid", "")))
+        h = self._by_rid.get(str(ev.get(F_RID, "")))
         if h is None or h.done.is_set():
             return
-        if ev.get("ev") == "token":
-            h.push(int(ev["tok"]))
+        log_event("wire", "client", "deliver", rid=str(ev.get(F_RID)),
+                  id=int(ev.get(F_ID, 0)), kind=str(ev.get(F_EV)),
+                  epoch=self.epoch)
+        if ev.get(F_EV) == EV_TOKEN:
+            h.push(int(ev[F_TOK]))
             return
-        if ev.get("ev") != "done":
+        if ev.get(F_EV) != EV_DONE:
             return
         # reconcile: the done event's token list is authoritative; any
         # suffix the stream hasn't delivered yet (lost with a torn
         # frame, redelivered here) pushes now
-        final = [int(t) for t in ev.get("tokens", ())]
+        final = [int(t) for t in ev.get(F_TOKENS, ())]
         for tok in final[len(h.tokens):]:
             h.push(tok)
-        error = ev.get("error")
-        if error is None and ev.get("chain") is not None \
+        error = ev.get(F_ERROR)
+        if error is None and ev.get(F_CHAIN) is not None \
                 and self.paged_kv is not None:
             from kubeflow_tpu.serving.fleet.wire import deserialize_chain
 
             try:
-                h.chain = deserialize_chain(self.paged_kv, ev["chain"])
+                h.chain = deserialize_chain(self.paged_kv, ev[F_CHAIN])
                 pod_metric_bump("handoff_bytes_total",
-                                _chain_payload_bytes(ev["chain"]))
+                                _chain_payload_bytes(ev[F_CHAIN]))
             except (PodWireError, KeyError, ValueError):
                 h.chain = None  # integrity refusal → scratch fallback
         if error is None and h.recovery_chain is not None:
@@ -818,7 +866,7 @@ class PodClient:
         """Graceful shutdown: ask the worker to exit, reap, mark dead
         quietly (no requeue callbacks — drain first if rows matter)."""
         try:
-            self.call("kill", timeout_s=timeout_s)
+            self.call(VERB_KILL, timeout_s=timeout_s)
         except (PodWireError, PodDead, PodDeadlineExpired,
                 PodCallError, OSError):
             pass
@@ -943,18 +991,18 @@ class PodClient:
         if not self.fenced:
             raise RuntimeError(f"pod {self.name} is not fenced")
         with self._tick_mu:
-            reply = self.call("tick", {"ack": self._acked, "n": 1},
+            reply = self.call(VERB_TICK, {F_ACK: self._acked, F_N: 1},
                               timeout_s=timeout_s, _bypass_fence=True)
-            late = [e for e in reply.get("events", ())
-                    if int(e.get("id", 0)) > self._acked]
+            late = [e for e in reply.get(F_EVENTS, ())
+                    if int(e.get(F_ID, 0)) > self._acked]
             if late:
                 pod_metric_bump("net_fenced_frames_total", len(late))
             return {
                 "late_events": len(late),
                 "late_tokens": sum(1 for e in late
-                                   if e.get("ev") == "token"),
+                                   if e.get(F_EV) == EV_TOKEN),
                 "late_done": sum(1 for e in late
-                                 if e.get("ev") == "done"),
+                                 if e.get(F_EV) == EV_DONE),
                 "refused": len(late),
             }
 

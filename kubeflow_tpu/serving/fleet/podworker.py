@@ -48,7 +48,37 @@ import socket
 import sys
 import time
 
+from kubeflow_tpu.analysis.protocheck.eventlog import log_event
 from kubeflow_tpu.serving.fleet.wire import (
+    CODE_BAD_REQUEST,
+    CODE_BUSY,
+    CODE_CONFLICT,
+    CODE_DEADLINE,
+    CODE_FENCED,
+    CODE_INTERNAL,
+    EV_DONE,
+    EV_TOKEN,
+    F_ACK,
+    F_CHAIN,
+    F_DEADLINE_S,
+    F_DYING,
+    F_EOS,
+    F_EPOCH,
+    F_ERROR,
+    F_EV,
+    F_ID,
+    F_KEEP_CHAIN,
+    F_MAX_NEW_TOKENS,
+    F_N,
+    F_PROMPT,
+    F_RESUME,
+    F_RESUMED,
+    F_RID,
+    F_SEQ,
+    F_TEMPERATURE,
+    F_TOK,
+    F_TOKENS,
+    F_VERB,
     PodWireError,
     error_reply,
     ok_reply,
@@ -169,31 +199,33 @@ class PodServer:
     # ----------------------------------------------------------- events
 
     def _emit(self, ev: dict) -> None:
-        ev["id"] = self._next_event_id
+        ev[F_ID] = self._next_event_id
         self._next_event_id += 1
         self._events.append(ev)
+        log_event("wire", "worker", "emit", id=ev[F_ID],
+                  kind=ev.get(F_EV), rid=ev.get(F_RID), pid=os.getpid())
 
     def _on_token(self, req, tok: int) -> None:
-        self._emit({"ev": "token", "rid": req.request_id,
-                    "tok": int(tok)})
+        self._emit({F_EV: EV_TOKEN, F_RID: req.request_id,
+                    F_TOK: int(tok)})
 
     def _on_done(self, req) -> None:
         ev = {
-            "ev": "done",
-            "rid": req.request_id,
-            "error": req.error,
-            "tokens": [int(t) for t in req.tokens],
-            "resumed": bool(req.resumed),
+            F_EV: EV_DONE,
+            F_RID: req.request_id,
+            F_ERROR: req.error,
+            F_TOKENS: [int(t) for t in req.tokens],
+            F_RESUMED: bool(req.resumed),
             "ttft_s": req.ttft_s,
             "tps": req.tokens_per_s,
-            "chain": None,
+            F_CHAIN: None,
         }
         chain = getattr(req, "chain", None)
         if chain is not None and chain.refs and not chain.frozen:
             # keep_chain retire: the finished chain crosses the wire as
             # serialized blocks; the local refs release immediately —
             # the payload carries everything the adopter needs
-            ev["chain"] = serialize_chain(self.pool, chain.refs)
+            ev[F_CHAIN] = serialize_chain(self.pool, chain.refs)
         if chain is not None:
             chain.release()
             req.chain = None
@@ -202,29 +234,35 @@ class PodServer:
     # ------------------------------------------------------------ verbs
 
     def handle(self, env: dict) -> dict:
-        seq = int(env.get("seq", 0))
-        verb = env.get("verb", "")
-        deadline_s = env.get("deadline_s")
+        seq = int(env.get(F_SEQ, 0))
+        verb = env.get(F_VERB, "")
+        deadline_s = env.get(F_DEADLINE_S)
         if deadline_s is not None and float(deadline_s) <= 0.0:
-            return error_reply(seq, 504,
+            return error_reply(seq, CODE_DEADLINE,
                                f"deadline expired before {verb!r}")
         # fence gate: stale epochs are refused on EVERY verb — a
         # presumed-dead client resurfacing after its replacement adopted
         # a higher epoch can neither submit nor tick (410, terminal on
         # the client side). A hello with a higher epoch is the adoption
         # itself (done in _verb_hello so its echo carries the result).
-        env_epoch = int(env.get("epoch", 0))
+        env_epoch = int(env.get(F_EPOCH, 0))
         if env_epoch < self._epoch:
+            log_event("wire", "worker", "refuse_stale",
+                      env_epoch=env_epoch, epoch=self._epoch, verb=verb,
+                      pid=os.getpid())
             return error_reply(
-                seq, 410, f"stale epoch {env_epoch} < {self._epoch}: "
-                          f"{verb!r} refused (fenced)")
+                seq, CODE_FENCED,
+                f"stale epoch {env_epoch} < {self._epoch}: "
+                f"{verb!r} refused (fenced)")
         fn = getattr(self, f"_verb_{verb}", None)
         if fn is None:
-            return error_reply(seq, 400, f"unknown verb {verb!r}")
+            return error_reply(seq, CODE_BAD_REQUEST,
+                               f"unknown verb {verb!r}")
         try:
             return fn(seq, env)
         except Exception as e:  # noqa: BLE001 — protocol boundary
-            return error_reply(seq, 500, f"{type(e).__name__}: {e}")
+            return error_reply(seq, CODE_INTERNAL,
+                               f"{type(e).__name__}: {e}")
 
     def _verb_hello(self, seq: int, env: dict) -> dict:
         eng = self.engine
@@ -232,7 +270,8 @@ class PodServer:
         # this hello is the newest claimant — adopt its epoch and echo
         # it (with the bound TCP port) so the dial side can cross-check
         # discovery against what the worker actually serves
-        env_epoch = int(env.get("epoch", 0))
+        env_epoch = int(env.get(F_EPOCH, 0))
+        purged = False
         if env_epoch > self._epoch:
             # a STRICTLY newer claim starts from a clean slate: the
             # superseded claim's undelivered events and rid-dedup
@@ -241,6 +280,10 @@ class PodServer:
             # redelivery IS the replay contract — keep everything)
             self._events.clear()
             self._seen_rids.clear()
+            purged = True
+        log_event("wire", "worker", "adopt", old=self._epoch,
+                  new=max(self._epoch, env_epoch), purged=purged,
+                  pid=os.getpid())
         self._epoch = max(self._epoch, env_epoch)
         return ok_reply(
             seq, name=self.name, pid=os.getpid(),
@@ -261,36 +304,38 @@ class PodServer:
         from kubeflow_tpu.serving.fleet.wire import deserialize_chain
 
         if self._dying is not None:
-            return error_reply(seq, 500,
+            return error_reply(seq, CODE_INTERNAL,
                                f"engine poisoned: {self._dying}")
-        rid = str(env.get("rid", ""))
+        rid = str(env.get(F_RID, ""))
         if rid and rid in self._seen_rids:
             # redelivery after a torn ack: the original submit landed
+            log_event("wire", "worker", "dup_submit", rid=rid,
+                      pid=os.getpid())
             return ok_reply(seq, dup=True, depth=self._depth())
         max_queue = int(self.spec.get("max_queue", 0))
         if max_queue and len(self.engine._queue) >= max_queue:
-            return error_reply(seq, 503, "queue full",
+            return error_reply(seq, CODE_BUSY, "queue full",
                                retry_after_s=0.05)
         resume = None
-        if env.get("resume") is not None:
-            chain = deserialize_chain(self.pool, env["resume"]["chain"])
+        if env.get(F_RESUME) is not None:
+            chain = deserialize_chain(self.pool, env[F_RESUME][F_CHAIN])
             if chain.frozen:
                 # the receiving pool could not cover every position
                 # (covered-by-sibling) — refuse rather than resume on
                 # silently wrong K/V; the client falls back to scratch
                 chain.release()
                 return error_reply(
-                    seq, 409, "resume chain frozen on re-insert")
-            resume = (chain, [int(t) for t in env["resume"]["tokens"]])
+                    seq, CODE_CONFLICT, "resume chain frozen on re-insert")
+            resume = (chain, [int(t) for t in env[F_RESUME][F_TOKENS]])
         req = self.engine.submit(
-            np.asarray(env["prompt"], np.int32),
-            max_new_tokens=env.get("max_new_tokens"),
-            eos_token_id=env.get("eos"),
-            temperature=float(env.get("temperature", 0.0)),
+            np.asarray(env[F_PROMPT], np.int32),
+            max_new_tokens=env.get(F_MAX_NEW_TOKENS),
+            eos_token_id=env.get(F_EOS),
+            temperature=float(env.get(F_TEMPERATURE, 0.0)),
             on_token=self._on_token,
             on_done=self._on_done,
             request_id=rid,
-            keep_chain=bool(env.get("keep_chain", False)),
+            keep_chain=bool(env.get(F_KEEP_CHAIN, False)),
             resume_from=resume)
         # request_id normally only sticks under an armed tracer; the
         # event stream is keyed by it, so pin it unconditionally
@@ -300,11 +345,11 @@ class PodServer:
         return ok_reply(seq, depth=self._depth())
 
     def _verb_tick(self, seq: int, env: dict) -> dict:
-        ack = int(env.get("ack", 0))
+        ack = int(env.get(F_ACK, 0))
         if ack:
-            self._events = [e for e in self._events if e["id"] > ack]
+            self._events = [e for e in self._events if e[F_ID] > ack]
         busy = False
-        n = max(int(env.get("n", 1)), 1)
+        n = max(int(env.get(F_N, 1)), 1)
         if self._dying is None:
             try:
                 for _ in range(n):
@@ -389,7 +434,7 @@ class PodServer:
             env = recv_frame(conn)
             reply = self.handle(env)
             send_frame(conn, reply)
-            if reply.get("dying"):
+            if reply.get(F_DYING):
                 if (self.tracer is not None
                         and getattr(self.tracer, "enabled", False)):
                     from kubeflow_tpu.tracing.core import flush

@@ -85,6 +85,89 @@ class PodCallError(RuntimeError):
         self.code = int(code)
 
 
+# ---------------------------------------------------- the wire registry
+#
+# Every verb name, error code, and envelope/reply/event field name the
+# two endpoints exchange, spelled out ONCE. podclient.py and podworker.py
+# must import these instead of inlining the strings: the rid-collision
+# class of bug — client writes one spelling, worker reads another, and
+# the reader just sees "unset" — is the envvars.py story replayed on the
+# wire, so it gets the same cure (a single registry) and the same lint
+# teeth (KFTPU-VERB flags literal drift in the endpoint modules).
+
+# verbs (envelope F_VERB values; the worker dispatches _verb_<name>)
+VERB_HELLO = "hello"
+VERB_SUBMIT = "submit"
+VERB_TICK = "tick"
+VERB_DRAIN = "drain"
+VERB_HEARTBEAT = "heartbeat"
+VERB_KILL = "kill"
+WIRE_VERBS = frozenset({
+    VERB_HELLO, VERB_SUBMIT, VERB_TICK, VERB_DRAIN, VERB_HEARTBEAT,
+    VERB_KILL,
+})
+
+# error-reply codes (HTTP-shaped, carried in F_CODE)
+CODE_BAD_REQUEST = 400   # unknown verb / malformed envelope
+CODE_CONFLICT = 409      # resume chain frozen on re-insert
+CODE_FENCED = 410        # stale epoch — terminal for that claimant
+CODE_INTERNAL = 500      # worker-side exception / dying engine
+CODE_BUSY = 503          # queue full; carries F_RETRY_AFTER_S
+CODE_DEADLINE = 504      # propagated deadline already spent
+WIRE_CODES = frozenset({
+    CODE_BAD_REQUEST, CODE_CONFLICT, CODE_FENCED, CODE_INTERNAL,
+    CODE_BUSY, CODE_DEADLINE,
+})
+
+# envelope fields (client -> worker)
+F_VERB = "verb"
+F_SEQ = "seq"
+F_EPOCH = "epoch"
+F_DEADLINE_S = "deadline_s"
+F_ACK = "ack"
+F_N = "n"
+F_RID = "rid"
+F_PROMPT = "prompt"
+F_MAX_NEW_TOKENS = "max_new_tokens"
+F_EOS = "eos"
+F_TEMPERATURE = "temperature"
+F_KEEP_CHAIN = "keep_chain"
+F_RESUME = "resume"
+
+# reply fields (worker -> client)
+F_OK = "ok"
+F_CODE = "code"
+F_ERROR = "error"
+F_RETRY_AFTER_S = "retry_after_s"
+F_EVENTS = "events"
+F_BUSY = "busy"
+F_DEPTH = "depth"
+F_DUP = "dup"
+F_DYING = "dying"
+F_PORT = "port"
+F_STEP_COUNT = "step_count"
+F_TICK_ERROR = "tick_error"
+
+# outbox event fields and kinds (inside F_EVENTS / F_CHAIN payloads)
+F_EV = "ev"
+F_ID = "id"
+F_TOK = "tok"
+F_TOKENS = "tokens"
+F_RESUMED = "resumed"
+F_CHAIN = "chain"
+EV_TOKEN = "token"
+EV_DONE = "done"
+WIRE_EVENT_KINDS = frozenset({EV_TOKEN, EV_DONE})
+
+WIRE_FIELDS = frozenset({
+    F_VERB, F_SEQ, F_EPOCH, F_DEADLINE_S, F_ACK, F_N, F_RID, F_PROMPT,
+    F_MAX_NEW_TOKENS, F_EOS, F_TEMPERATURE, F_KEEP_CHAIN, F_RESUME,
+    F_OK, F_CODE, F_ERROR, F_RETRY_AFTER_S, F_EVENTS, F_BUSY, F_DEPTH,
+    F_DUP, F_DYING, F_PORT, F_STEP_COUNT, F_TICK_ERROR,
+    F_EV, F_ID, F_TOK, F_TOKENS, F_RESUMED, F_CHAIN,
+})
+
+
 # ------------------------------------------------------------- framing
 
 
@@ -318,14 +401,14 @@ def deserialize_chain(pool, payload: dict):
 
 def error_reply(seq: int, code: int, msg: str,
                 retry_after_s: float | None = None) -> dict:
-    rep: dict[str, Any] = {"seq": seq, "ok": False,
-                           "code": int(code), "error": str(msg)}
+    rep: dict[str, Any] = {F_SEQ: seq, F_OK: False,
+                           F_CODE: int(code), F_ERROR: str(msg)}
     if retry_after_s is not None:
-        rep["retry_after_s"] = float(retry_after_s)
+        rep[F_RETRY_AFTER_S] = float(retry_after_s)
     return rep
 
 
 def ok_reply(seq: int, **result) -> dict:
-    rep: dict[str, Any] = {"seq": seq, "ok": True}
+    rep: dict[str, Any] = {F_SEQ: seq, F_OK: True}
     rep.update(result)
     return rep

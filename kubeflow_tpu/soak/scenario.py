@@ -1138,6 +1138,9 @@ def run_diurnal_storm(cfg: StormConfig | None = None,
                    / len(batch["resume_ticks"])
                    if batch["resume_ticks"] else 0.0)
     m = scaler.metrics
+    # conservation audit: a full day of preempt/grant/release churn
+    # must leave the ledger internally consistent (asserts inside)
+    ledger_audit = ledger.audit()
 
     return {
         "seed": cfg.seed,
@@ -1158,6 +1161,7 @@ def run_diurnal_storm(cfg: StormConfig | None = None,
         "chip_denies": m["chip_denies_total"],
         "sched": dict(ledger.metrics),
         "sched_snapshot": ledger.snapshot(),
+        "ledger_audit": ledger_audit,
         "batch": batch,
         "preempt_to_resume_ticks_mean": round(resume_mean, 2),
         "preempt_to_resume_ticks_max": float(

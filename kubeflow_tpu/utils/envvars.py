@@ -76,6 +76,15 @@ ENV_UPDATE_PROF_BUDGETS = "KFTPU_UPDATE_PROF_BUDGETS"
 #: test-only chaos hook for the CPU-proxy perf gate: "phase:N[,phase:N]"
 #: repeats a phase's deterministic work N times (profiling/cpu_proxy.py)
 ENV_PROF_CHAOS = "KFTPU_PROF_CHAOS"
+#: exhaustive-BFS depth bound for the protocol model checker
+#: (analysis/protocheck — docs/analysis.md "Protocol model checking")
+ENV_MODELCHECK_DEPTH = "KFTPU_MODELCHECK_DEPTH"
+#: seed for the random-walk frontier the model checker runs past the
+#: exhaustive bound (analysis/protocheck)
+ENV_MODELCHECK_SEED = "KFTPU_MODELCHECK_SEED"
+#: JSONL path the wire/KV/ledger protocol event-log hooks append to when
+#: armed (off when unset; analysis/protocheck conformance checking)
+ENV_PROTOLOG = "KFTPU_PROTOLOG"
 
 # ------------------------------------------------------------ chip scheduler
 

@@ -191,3 +191,42 @@ def lockcheck_armed(request):
         if not was_enabled:
             lockcheck.disable()
         assert not rep["cycles"], lockcheck.format_report(rep)
+
+
+class ProtoLog:
+    """Handle the `protolog` fixture yields: the armed event-log path
+    plus the conformance check the drill runs on what it recorded."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    def events(self) -> list:
+        from kubeflow_tpu.analysis.protocheck import read_log
+        return read_log(self.path)
+
+    def counts(self) -> dict:
+        """Replay the recorded log through every protocol trace
+        acceptor; raises TraceRejected on an unacceptable run."""
+        from kubeflow_tpu.analysis.protocheck import check_trace
+        return check_trace(self.events())
+
+
+@pytest.fixture
+def protolog(tmp_path, monkeypatch):
+    """Arm the protocheck event log (kubeflow_tpu/analysis/protocheck/
+    eventlog.py) for one drill. Exported via the environment so worker
+    SUBPROCESSES inherit it — the recorded trace interleaves both sides
+    of the wire in file-append order. At teardown the trace is replayed
+    through the model trace acceptors: a drill that passes while its
+    trace is rejected means the protocol models drifted from the
+    implementation (or the implementation broke in a way the drill
+    missed) — either way a finding (docs/analysis.md "Protocol model
+    checking")."""
+    from kubeflow_tpu.utils.envvars import ENV_PROTOLOG
+
+    path = tmp_path / "protocol-events.jsonl"
+    monkeypatch.setenv(ENV_PROTOLOG, str(path))
+    log = ProtoLog(path)
+    yield log
+    if path.exists():
+        log.counts()  # raises TraceRejected on a non-conformant run

@@ -329,6 +329,84 @@ class TestMetricChecker:
         assert lint(root) == []
 
 
+# ------------------------------------------------------------- KFTPU-VERB
+
+
+class TestVerbChecker:
+    REGISTRY = """
+        VERB_SUBMIT = "submit"
+        EV_DONE = "done"
+        CODE_GONE_EPOCH = 410
+        F_EPOCH = "epoch"
+    """
+
+    def test_inline_verb_code_and_field_fire(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/serving/fleet/wire.py": self.REGISTRY,
+            "kubeflow_tpu/serving/fleet/podclient.py": """
+                def send(sock, env):
+                    sock.call("submit", env)
+                    if env.get("status") == 410:
+                        raise RuntimeError("pod gone")
+                    return env["epoch"]
+            """,
+        }))
+        assert all(f.rule == "KFTPU-VERB" for f in findings)
+        msgs = [f.message for f in findings]
+        assert len(findings) == 3
+        assert any("VERB_SUBMIT" in m for m in msgs)        # verb literal
+        assert any("CODE_GONE_EPOCH" in m for m in msgs)    # code literal
+        assert any("F_EPOCH" in m for m in msgs)            # subscript key
+
+    def test_prose_slots_log_event_and_plain_strings_exempt(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/serving/fleet/wire.py": self.REGISTRY,
+            "kubeflow_tpu/serving/fleet/podworker.py": '''
+                """Worker half: prose may say submit or done freely."""
+
+                class Handle:
+                    __slots__ = ("done",)   # attribute, not a wire kind
+
+                def run(env, log_event):
+                    log_event("wire", "worker", "emit", kind="done")
+                    # "epoch" outside an envelope-access position is an
+                    # error message, not wire traffic
+                    raise RuntimeError("epoch mismatch for " + str(env))
+            ''',
+        }))
+        assert findings == []
+
+    def test_non_endpoint_modules_are_not_governed(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/serving/fleet/wire.py": self.REGISTRY,
+            "kubeflow_tpu/controller/replay.py": """
+                def label():
+                    return "submit"
+            """,
+        }))
+        assert findings == []
+
+    def test_no_registry_in_tree_yields_no_findings(self, tmp_path):
+        # fixture trees for the OTHER rules must keep linting clean
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/serving/fleet/podclient.py": """
+                def send(sock):
+                    sock.call("submit")
+            """,
+        }))
+        assert findings == []
+
+    def test_allow_comment_suppresses(self, tmp_path):
+        findings = lint(write_tree(tmp_path, {
+            "kubeflow_tpu/serving/fleet/wire.py": self.REGISTRY,
+            "kubeflow_tpu/serving/fleet/podclient.py": """
+                def send(sock):
+                    sock.call("submit")  # kftpu: allow=KFTPU-VERB
+            """,
+        }))
+        assert findings == []
+
+
 # --------------------------------------------------------------- baseline
 
 
@@ -372,6 +450,33 @@ class TestBaseline:
         data = json.loads(
             (root / "tests/golden/lint_baseline.json").read_text())
         assert len(data["findings"]) == 1
+
+    def test_stale_warning_names_rule_and_file(self, tmp_path, capsys):
+        root = write_tree(tmp_path, self.TREE)
+        assert lint_main(["--root", str(root), "--update-baseline"]) == 0
+        (root / "kubeflow_tpu/controller/x.py").write_text("x = 1\n")
+        capsys.readouterr()
+        assert lint_main(["--root", str(root)]) == 0  # stale is a warning
+        err = capsys.readouterr().err
+        assert "stale baseline entry" in err
+        assert "KFTPU-SLEEP in kubeflow_tpu/controller/x.py" in err
+        assert "time.sleep(0.2)" in err  # the pinned line, for the hunt
+
+    def test_prune_baseline_drops_stale_entries(self, tmp_path, capsys):
+        root = write_tree(tmp_path, self.TREE)
+        assert lint_main(["--root", str(root), "--update-baseline"]) == 0
+        (root / "kubeflow_tpu/controller/x.py").write_text("x = 1\n")
+        capsys.readouterr()
+        assert lint_main(["--root", str(root), "--prune-baseline"]) == 0
+        out = capsys.readouterr().out
+        assert "pruned: KFTPU-SLEEP in kubeflow_tpu/controller/x.py" in out
+        assert "baseline pruned: 1 stale" in out
+        data = json.loads(
+            (root / "tests/golden/lint_baseline.json").read_text())
+        assert data["findings"] == []
+        # the pruned baseline round-trips: next run is clean, no warnings
+        assert lint_main(["--root", str(root)]) == 0
+        assert "stale" not in capsys.readouterr().err
 
 
 class TestRepoIsClean:

@@ -417,7 +417,8 @@ class TestDemandFreeSnapshot:
 
 
 class TestContentionDrill:
-    def test_hammered_ledger_stays_consistent_under_lockcheck(self):
+    def test_hammered_ledger_stays_consistent_under_lockcheck(
+            self, protolog):
         """Many threads claim/release/snapshot one ledger while an
         evictor re-enters a second lock (the gang-scheduler shape: the
         only cross-module edge is gang._mu → chipsched._mu, and evictor
@@ -458,6 +459,10 @@ class TestContentionDrill:
 
         def reader():
             while not stop.is_set():
+                try:
+                    s.audit()  # conservation, probed live mid-storm
+                except AssertionError as e:
+                    errors.append(("audit", str(e)))
                 snap = s.snapshot()
                 used = sum(c["chips"] for c in snap["claims"])
                 if used != snap["used_chips"]:
@@ -483,6 +488,11 @@ class TestContentionDrill:
         assert s.used_chips() == 0  # every grant was released
         assert s.free_chips() == 32
         assert s.metrics["grants_total"] > 0
+        audit = s.audit()
+        assert audit["held"] == 0 and audit["free"] == 32
+        # grant/grow/release events were logged in _mu commit order, so
+        # they ARE the sequential history — an accepted ledger run
+        assert protolog.counts()["ledger"] > 0
 
 
 # ---------------------------------------- preempt → gang-restart drill
@@ -523,7 +533,8 @@ def _wait(cond, gang=None, timeout_s=30.0, what="condition"):
 
 
 class TestPreemptRestartDrill:
-    def test_preempt_links_gang_restart_and_resumes_warm(self, tmp_path):
+    def test_preempt_links_gang_restart_and_resumes_warm(self, tmp_path,
+                                                         protolog):
         """The seeded drill (the diurnal storm's transition, isolated):
         a bound batch gang is evicted by a serving claim — its pods are
         marked FAILED with the PREEMPTED exit class and the
@@ -565,6 +576,7 @@ class TestPreemptRestartDrill:
             grant = ledger.claim_replica("fleet/peak", chips=8)
             assert grant.ok and grant.preempted == (key,)
             assert ledger.metrics["preemptions_total"] == 1
+            assert ledger.audit()["held"] == 8  # conserved post-evict
             (preempt,) = [sp for sp in tracer.snapshot()
                           if sp["name"] == "sched.preempt"]
             assert preempt["attrs"]["victim"] == key
@@ -630,6 +642,12 @@ class TestPreemptRestartDrill:
         finally:
             gang.stop()
             jc.stop()
+        # the preempt→release→resume history is an accepted ledger run,
+        # and the eviction is visible: a grant carrying the victim key
+        events = protolog.events()
+        assert any(e.get("ev") == "grant" and key in e.get("evicted", [])
+                   for e in events)
+        assert protolog.counts()["ledger"] > 0
 
 
 # ------------------------------------- warm resume: zero backend compiles

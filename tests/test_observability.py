@@ -89,6 +89,7 @@ class TestGoldenExposition:
         # reporters are constructed wherever trainers run); zero them so
         # this pins the same fresh-process surface regardless of which
         # tests ran first
+        from kubeflow_tpu.analysis.protocheck import reset_protocheck_metrics
         from kubeflow_tpu.parallel.partitioner import reset_comm_metrics
         from kubeflow_tpu.serving.fleet.podclient import reset_pod_metrics
 
@@ -97,6 +98,7 @@ class TestGoldenExposition:
         reset_compile_metrics()
         reset_comm_metrics()
         reset_pod_metrics()
+        reset_protocheck_metrics()
         p = Platform(log_dir=str(tmp_path / "logs"))
         p.start_tracing(capacity=4096)
         text = render_metrics(p)
@@ -115,6 +117,9 @@ class TestGoldenExposition:
             "kftpu_pod_wire_retries_total",
             "kftpu_pod_handoff_bytes_total",
             "kftpu_pod_heartbeat_age_seconds",
+            "kftpu_protocheck_models_checked_total",
+            "kftpu_protocheck_states_explored_total",
+            "kftpu_protocheck_violations_total",
             "kftpu_sched_grants_total",
             "kftpu_sched_denies_total",
             "kftpu_sched_preemptions_total",

@@ -320,7 +320,8 @@ class TestPodLifecycle:
 
 
 class TestRouterIntegration:
-    def test_sigkill_mid_decode_zero_drop_chain_resume(self, state_dir):
+    def test_sigkill_mid_decode_zero_drop_chain_resume(self, state_dir,
+                                                       protolog):
         """The acceptance drill in miniature (the full gated version is
         the serve_pods cpu-proxy workload): prefill pod + two decode
         pods behind the router, one decode pod SIGKILLed by PID
@@ -365,6 +366,10 @@ class TestRouterIntegration:
         finally:
             for c in clients:
                 c.kill(timeout_s=2.0)
+        # the recorded trace is an ACCEPTED run of the protocol models —
+        # both protocols the drill exercises left real events behind
+        counts = protolog.counts()
+        assert counts["wire"] > 0 and counts["kv"] > 0
 
     def test_admission_window_kill_repicks(self, state_dir):
         """The regression ISSUE 16 names: a pod dying BETWEEN admission
@@ -487,7 +492,8 @@ class TestNetTransport:
         finally:
             c.kill(timeout_s=5.0)
 
-    def test_stale_epoch_refused_both_directions(self, state_dir):
+    def test_stale_epoch_refused_both_directions(self, state_dir,
+                                                 protolog):
         """Epoch fencing end to end: a successor client born with a
         higher fence epoch adopts the worker via hello; the
         predecessor's next frame is answered 410 — it fences itself and
@@ -534,8 +540,17 @@ class TestNetTransport:
                 b._close_socket()
             a._disowned = False  # drill teardown: reap the survivor
             a._kill_process()
+        # the fence is visible in the trace: an epoch adoption that
+        # purged, and at least one refused stale frame — and the whole
+        # log is an accepted run
+        events = protolog.events()
+        assert any(e.get("ev") == "adopt" and e.get("purged")
+                   for e in events)
+        assert any(e.get("ev") == "refuse_stale" for e in events)
+        assert protolog.counts()["wire"] > 0
 
-    def test_partition_heal_split_brain_refused(self, state_dir):
+    def test_partition_heal_split_brain_refused(self, state_dir,
+                                                protolog):
         """The split-brain drill: a partition makes the host unreachable
         mid-decode, the retry budget burns out, and the death FENCES
         instead of killing — the worker keeps running on the far side.
@@ -570,6 +585,9 @@ class TestNetTransport:
         finally:
             c.partitioned = False  # drill teardown: reap the survivor
             c._kill_process()
+        # nothing the partition did put an unacceptable event in the
+        # trace — the refused late deliveries never logged as delivered
+        assert protolog.counts()["wire"] > 0
 
     def test_chain_handoff_resume_across_tcp_pods(self, state_dir):
         """The cross-pod rescue primitive rides the TCP wire unchanged:
@@ -600,3 +618,36 @@ class TestNetTransport:
             a.kill(timeout_s=5.0)
             if b is not None:
                 b.kill(timeout_s=5.0)
+
+
+# ------------------------------------------------ trace-conformance teeth
+
+
+class TestTraceConformance:
+    def test_hand_corrupted_trace_rejected(self, state_dir, protolog):
+        """Falsifiability of the conformance gate itself: record ONE
+        clean single-pod run, then duplicate one delivered token frame
+        in the log — the wire acceptor must reject the corrupted copy
+        (single-copy breached: the exact duplication the cumulative-ack
+        filter exists to prevent), while the pristine recording stays
+        an accepted run."""
+        from kubeflow_tpu.analysis.protocheck import (
+            TraceRejected,
+            check_trace,
+        )
+
+        c = spawn_pod("conf-0", _spec(), state_dir,
+                      home_pool=PagedKVPool(4, 256))
+        try:
+            h = c.submit(_prompt(40), max_new_tokens=NEW)
+            _run_to_done(c, [h])
+        finally:
+            c.kill(timeout_s=2.0)
+        events = protolog.events()
+        assert protolog.counts()["wire"] > 0  # pristine: accepted
+        frames = [e for e in events
+                  if e.get("ev") == "deliver" and e.get("kind") == "token"]
+        assert frames  # the run really delivered tokens
+        corrupted = events + [dict(frames[0])]
+        with pytest.raises(TraceRejected):
+            check_trace(corrupted)

@@ -1,0 +1,453 @@
+"""kftpu-protocheck suite (kubeflow_tpu/analysis/protocheck/,
+docs/analysis.md "Protocol model checking").
+
+Four layers, mirroring the package:
+
+- exploration-kernel unit tests on a toy model — BFS minimality of the
+  counterexample schedule, state dedup, the depth bound, and the seeded
+  random-walk frontier probing past it;
+- HEAD-explores-clean pins for all three protocol models at the `make
+  modelcheck` budget — the gate the Makefile step relies on;
+- the falsifiability matrix: EVERY mutation knob on every model must
+  yield a counterexample, and the violated invariant must be the one
+  that mutation's bug class breaks (a checker that can't see the bug
+  class has no business being green);
+- the event-log / trace-acceptor layer: synthetic accept/reject cases
+  per protocol, the eventlog arm/record round trip, and the CLI exits
+  (`python -m kubeflow_tpu.analysis --modelcheck / --conform`).
+
+The REAL-trace conformance drills live with their subjects —
+tests/test_pods.py (wire + KV, subprocess workers) and
+tests/test_chipsched.py (ledger) arm the `protolog` fixture.
+"""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.analysis.protocheck import (
+    ALL_MODELS,
+    KVModel,
+    LedgerModel,
+    Model,
+    TraceRejected,
+    WireModel,
+    check_trace,
+    default_budget,
+    explore,
+    log_event,
+    main_conform,
+    main_modelcheck,
+    protocheck_metrics_snapshot,
+    read_log,
+    run_modelcheck,
+)
+from kubeflow_tpu.analysis.protocheck.runner import DEFAULT_DEPTH
+from kubeflow_tpu.utils.envvars import ENV_MODELCHECK_DEPTH, ENV_PROTOLOG
+
+pytestmark = pytest.mark.modelcheck
+
+
+# ------------------------------------------------------- kernel, on a toy
+
+
+class _Counter(Model):
+    """Toy model: a counter that can +1 or +2; invariant breaks at >= 5.
+    The minimal schedule to 5 is three actions (2+2+1 in some order)."""
+
+    name = "counter"
+    mutations = ("start_at_four",)
+
+    def initial(self):
+        return 4 if self.mutation == "start_at_four" else 0
+
+    def actions(self, n):
+        return [(f"+1(from {n})", n + 1), (f"+2(from {n})", n + 2)]
+
+    def invariants(self, n):
+        return [f"bound: counter hit {n}"] if n >= 5 else []
+
+
+class _DeepBug(Model):
+    """Clean inside any small exhaustive bound; breaks at depth 12 — what
+    the random-walk frontier exists to probe."""
+
+    name = "deep"
+
+    def initial(self):
+        return 0
+
+    def actions(self, n):
+        return [("step", n + 1)]
+
+    def invariants(self, n):
+        return ["deep: reached 12"] if n >= 12 else []
+
+
+class TestKernel:
+    def test_bfs_counterexample_is_minimal(self):
+        res = explore(_Counter(), depth=10)
+        assert not res.ok
+        # BFS: the first recorded violation is a shortest path to a bad
+        # state — 2+2 reaches 4 in two actions, the third steps to >= 5
+        assert len(res.violations[0].schedule) == 3
+        assert "bound" in res.violations[0].invariant
+        rendered = res.violations[0].render()
+        assert "counterexample (3 events)" in rendered
+        assert "1." in rendered  # numbered, event-by-event
+
+    def test_states_deduplicate_across_paths(self):
+        # +1+2 and +2+1 converge on the same counter value: the explored
+        # state count is the number of DISTINCT values, not of paths
+        res = explore(_Counter(), depth=2, walks=0)
+        assert res.states_explored == 5  # {0, 1, 2, 3, 4}
+        assert res.transitions == 6  # 2 each from the expanded {0, 1, 2}
+
+    def test_depth_bound_truncates_frontier(self):
+        res = explore(_Counter(), depth=1, walks=0)
+        assert res.ok  # 1 and 2 are both clean
+        assert res.max_depth_reached == 1
+        assert res.truncated_frontier == 2  # {1, 2} awaiting depth 2
+
+    def test_random_walks_probe_past_the_bound(self):
+        shallow = explore(_DeepBug(), depth=4, walks=0)
+        assert shallow.ok  # the bound alone cannot see depth 12
+        probed = explore(_DeepBug(), depth=4, seed=0, walks=4,
+                         walk_depth=16)
+        assert not probed.ok
+        assert probed.random_walk_steps > 0
+        assert len(probed.violations[0].schedule) >= 12
+
+    def test_deterministic_under_seed(self):
+        a = explore(_DeepBug(), depth=4, seed=7, walks=4, walk_depth=16)
+        b = explore(_DeepBug(), depth=4, seed=7, walks=4, walk_depth=16)
+        assert [v.schedule for v in a.violations] == \
+            [v.schedule for v in b.violations]
+        assert a.random_walk_steps == b.random_walk_steps
+
+    def test_violation_in_initial_state(self):
+        res = explore(_Counter(mutation="start_at_four"), depth=2)
+        # 4 is clean but one +1 breaks — and with max_violations the
+        # schedule is still minimal (one event)
+        assert not res.ok
+        assert len(res.violations[0].schedule) == 1
+
+    def test_unknown_mutation_refused_at_construction(self):
+        with pytest.raises(ValueError, match="unknown mutation"):
+            _Counter(mutation="start_at_fourty")
+        with pytest.raises(ValueError, match="unknown mutation"):
+            WireModel(mutation="skip_outbox_purg")  # the typo'd pin
+
+
+# ------------------------------------- HEAD explores clean (the gate)
+
+
+class TestHeadClean:
+    @pytest.mark.parametrize("cls", ALL_MODELS,
+                             ids=[c.name for c in ALL_MODELS])
+    def test_model_explores_clean_at_default_budget(self, cls):
+        res = explore(cls(), depth=DEFAULT_DEPTH[cls.name], seed=0,
+                      walks=64, walk_depth=32)
+        assert res.ok, "\n".join(v.render() for v in res.violations)
+        # the sweep really covered a state space, not a stub
+        assert res.states_explored > 20
+        assert res.transitions > res.states_explored
+
+    def test_run_modelcheck_clean_and_counted(self):
+        before = protocheck_metrics_snapshot()
+        results = run_modelcheck(quiet=True)
+        assert all(r.ok for r in results)
+        assert len(results) == len(ALL_MODELS)
+        after = protocheck_metrics_snapshot()
+        assert after["models_checked_total"] == \
+            before["models_checked_total"] + len(ALL_MODELS)
+        assert after["states_explored_total"] > \
+            before["states_explored_total"]
+        assert after["violations_total"] == before["violations_total"]
+
+    def test_depth_env_override_widens_budget(self, monkeypatch):
+        monkeypatch.setenv(ENV_MODELCHECK_DEPTH, "3")
+        budget = default_budget()
+        assert all(budget[m.name] == 3 for m in ALL_MODELS)
+        monkeypatch.delenv(ENV_MODELCHECK_DEPTH)
+        assert default_budget()["wire"] == DEFAULT_DEPTH["wire"]
+
+
+# ---------------------- falsifiability: every mutation must be caught
+
+#: mutation -> the invariant its bug class breaks (message prefix)
+MUTATION_CATCHES = {
+    ("wire", "skip_outbox_purge"): "fence-complete",
+    ("wire", "drop_rid_dedup"): "single-copy",
+    ("wire", "ack_unseen"): "acked-complete",
+    ("wire", "no_ack_filter"): "single-copy",
+    ("kv", "double_release"): "refcount-conserved",
+    ("kv", "cow_leak"): "refcount-conserved",
+    ("kv", "adopt_corrupt"): "resume-identity",
+    ("ledger", "skip_double_claim_check"): "no-double-grant",
+    ("ledger", "borrow_preempts"): "borrower-no-preempt",
+    ("ledger", "evict_before_check"): "feasible-commit",
+}
+
+
+class TestMutationTeeth:
+    def test_matrix_is_complete(self):
+        """Every shipped mutation knob has a pin below — adding a knob
+        without a counterexample pin fails HERE, not silently."""
+        shipped = {(c.name, m) for c in ALL_MODELS for m in c.mutations}
+        assert shipped == set(MUTATION_CATCHES)
+        # ISSUE 20 floor: >= 6 total, >= 2 per model
+        assert len(shipped) >= 6
+        per_model = {c.name: len(c.mutations) for c in ALL_MODELS}
+        assert all(n >= 2 for n in per_model.values()), per_model
+
+    @pytest.mark.parametrize(
+        "model_name,mutation",
+        sorted(MUTATION_CATCHES),
+        ids=[f"{m}-{k}" for m, k in sorted(MUTATION_CATCHES)])
+    def test_mutation_yields_counterexample(self, model_name, mutation):
+        cls = {c.name: c for c in ALL_MODELS}[model_name]
+        res = explore(cls(mutation=mutation),
+                      depth=DEFAULT_DEPTH[model_name], seed=0,
+                      walks=64, walk_depth=32)
+        assert not res.ok, (
+            f"mutation {mutation!r} explored clean — the checker cannot "
+            f"see this bug class")
+        v = res.violations[0]
+        want = MUTATION_CATCHES[(model_name, mutation)]
+        assert v.invariant.startswith(want), v.invariant
+        assert v.schedule  # a real event schedule, not the initial state
+        assert v.render()  # renders without blowing up
+
+
+# ------------------------------------------------- event log round trip
+
+
+class TestEventLog:
+    def test_disarmed_is_a_noop(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(ENV_PROTOLOG, raising=False)
+        log_event("wire", "client", "submit", rid="r1")
+        # nothing armed: no file, no error — the hook costs a dict get
+        assert list(tmp_path.iterdir()) == []
+
+    def test_armed_records_and_reads_back(self, tmp_path, monkeypatch):
+        path = tmp_path / "events.jsonl"
+        monkeypatch.setenv(ENV_PROTOLOG, str(path))
+        log_event("wire", "worker", "emit", id=1, kind="token", pid=42)
+        log_event("kv", "pool", "adopt", digest="ab", rc=2)
+        events = read_log(str(path))
+        assert [e["proto"] for e in events] == ["wire", "kv"]
+        assert events[0] == {"proto": "wire", "src": "worker",
+                             "ev": "emit", "id": 1, "kind": "token",
+                             "pid": 42}
+        assert read_log(str(path), proto="kv") == [events[1]]
+
+    def test_unserializable_fields_stringified(self, tmp_path,
+                                               monkeypatch):
+        path = tmp_path / "events.jsonl"
+        monkeypatch.setenv(ENV_PROTOLOG, str(path))
+        log_event("kv", "pool", "publish", digests=[b"\x01".hex()],
+                  blob=object())  # default=str: the hook NEVER raises
+        (rec,) = read_log(str(path))
+        assert rec["digests"] == ["01"]
+
+
+# ------------------------------------------- trace acceptors, synthetic
+
+
+def _wire(ev, **kw):
+    return {"proto": "wire", "src": kw.pop("src", "worker"),
+            "ev": ev, **kw}
+
+
+class TestWireAcceptor:
+    def test_clean_run_accepted(self):
+        events = [
+            _wire("adopt", old=0, new=1, purged=True, pid=9),
+            _wire("submit", src="client", rid="r", epoch=1),
+            _wire("emit", id=1, kind="token", rid="r", pid=9),
+            _wire("emit", id=2, kind="done", rid="r", pid=9),
+            _wire("deliver", src="client", rid="r", id=1, kind="token",
+                  epoch=1),
+            _wire("deliver", src="client", rid="r", id=2, kind="done",
+                  epoch=1),
+        ]
+        assert check_trace(events)["wire"] == 6
+
+    def test_duplicate_delivery_rejected(self):
+        events = [
+            _wire("deliver", src="client", rid="r", id=1, kind="token",
+                  epoch=1),
+            _wire("deliver", src="client", rid="r", id=1, kind="token",
+                  epoch=1),
+        ]
+        with pytest.raises(TraceRejected, match="duplicate event id"):
+            check_trace(events)
+
+    def test_delivery_after_done_rejected(self):
+        events = [
+            _wire("deliver", src="client", rid="r", id=1, kind="done",
+                  epoch=1),
+            _wire("deliver", src="client", rid="r", id=2, kind="token",
+                  epoch=1),
+        ]
+        with pytest.raises(TraceRejected, match="after done"):
+            check_trace(events)
+
+    def test_backwards_adoption_rejected(self):
+        with pytest.raises(TraceRejected, match="backwards"):
+            check_trace([_wire("adopt", old=3, new=2, purged=True)])
+
+    def test_unpurged_adoption_rejected(self):
+        with pytest.raises(TraceRejected, match="without purging"):
+            check_trace([_wire("adopt", old=1, new=2, purged=False)])
+
+    def test_non_stale_410_rejected(self):
+        with pytest.raises(TraceRejected, match="non-stale"):
+            check_trace([_wire("refuse_stale", env_epoch=2, epoch=2,
+                               verb="tick")])
+
+    def test_emit_ids_monotonic_per_worker_incarnation(self):
+        # a RESPAWNED worker (new pid) restarts its id space at 1 —
+        # accepted; the same pid going backwards is not
+        ok = [_wire("emit", id=1, kind="token", pid=10),
+              _wire("emit", id=2, kind="done", pid=10),
+              _wire("emit", id=1, kind="token", pid=11)]
+        assert check_trace(ok)["wire"] == 3
+        bad = ok + [_wire("emit", id=1, kind="token", pid=11)]
+        with pytest.raises(TraceRejected, match="not monotonic"):
+            check_trace(bad)
+
+
+def _kv(ev, **kw):
+    return {"proto": "kv", "src": "pool", "ev": ev, **kw}
+
+
+class TestKVAcceptor:
+    def test_publish_adopt_release_accepted(self):
+        events = [
+            _kv("publish", digests=["aa", "bb"], rcs=[1, 1]),
+            _kv("adopt", digest="aa", rc=2),
+            _kv("extend", parent="bb", digest="cc", cow=False, rc=1),
+            _kv("release", digests=["aa", "cc"], rcs=[1, 0]),
+        ]
+        assert check_trace(events)["kv"] == 4
+
+    def test_adopting_unpublished_digest_rejected(self):
+        with pytest.raises(TraceRejected, match="never\\s+published"):
+            check_trace([_kv("adopt", digest="aa", rc=1)])
+
+    def test_negative_refcount_rejected(self):
+        events = [
+            _kv("publish", digests=["aa"], rcs=[1]),
+            _kv("release", digests=["aa"], rcs=[-1]),
+        ]
+        with pytest.raises(TraceRejected, match="negative"):
+            check_trace(events)
+
+    def test_unreferenced_publish_rejected(self):
+        with pytest.raises(TraceRejected, match="unreferenced"):
+            check_trace([_kv("publish", digests=["aa"], rcs=[0])])
+
+
+def _ledger(ev, **kw):
+    return {"proto": "ledger", "src": "sched", "ev": ev, **kw}
+
+
+class TestLedgerAcceptor:
+    def test_grant_grow_release_conserves(self):
+        events = [
+            _ledger("grant", key="a", chips=4, borrowed=0, capacity=8,
+                    free=4, evicted=[]),
+            _ledger("grow", key="a", chips=6, extra=2, capacity=8,
+                    free=2),
+            _ledger("grant", key="b", chips=2, borrowed=2, capacity=8,
+                    free=0, evicted=[]),
+            _ledger("release", key="a", chips=6, capacity=8, free=6),
+        ]
+        assert check_trace(events)["ledger"] == 4
+
+    def test_double_grant_rejected(self):
+        events = [
+            _ledger("grant", key="a", chips=2, borrowed=0, capacity=8,
+                    free=6, evicted=[]),
+            _ledger("grant", key="a", chips=2, borrowed=0, capacity=8,
+                    free=4, evicted=[]),
+        ]
+        with pytest.raises(TraceRejected, match="double-grant"):
+            check_trace(events)
+
+    def test_borrowing_grant_with_evictions_rejected(self):
+        events = [
+            _ledger("grant", key="v", chips=4, borrowed=0, capacity=8,
+                    free=4, evicted=[]),
+            _ledger("grant", key="a", chips=4, borrowed=2, capacity=8,
+                    free=4, evicted=["v"]),
+        ]
+        with pytest.raises(TraceRejected, match="borrowing grant"):
+            check_trace(events)
+
+    def test_eviction_frees_the_victims_chips(self):
+        events = [
+            _ledger("grant", key="v", chips=8, borrowed=0, capacity=8,
+                    free=0, evicted=[]),
+            _ledger("grant", key="a", chips=4, borrowed=0, capacity=8,
+                    free=4, evicted=["v"]),
+        ]
+        assert check_trace(events)["ledger"] == 2
+
+    def test_conservation_breach_rejected(self):
+        events = [_ledger("grant", key="a", chips=4, borrowed=0,
+                          capacity=8, free=6, evicted=[])]
+        with pytest.raises(TraceRejected, match="not conserved"):
+            check_trace(events)
+
+    def test_grow_of_unknown_key_rejected(self):
+        events = [_ledger("grow", key="ghost", chips=2, extra=2,
+                          capacity=8, free=6)]
+        with pytest.raises(TraceRejected, match="never granted"):
+            check_trace(events)
+
+
+# --------------------------------------------------------- CLI surfaces
+
+
+class TestCLI:
+    def test_main_modelcheck_clean_exit(self, capsys):
+        assert main_modelcheck() == 0
+        out = capsys.readouterr().out
+        for name in ("wire", "kv", "ledger"):
+            assert f"protocheck: {name}: clean" in out
+
+    def test_linter_main_dispatches_modelcheck(self, capsys):
+        from kubeflow_tpu.analysis.linter import main
+        assert main(["--modelcheck"]) == 0
+        assert "protocheck: wire: clean" in capsys.readouterr().out
+
+    def test_conform_accepts_recorded_log(self, tmp_path, capsys):
+        log = tmp_path / "drill.jsonl"
+        lines = [
+            _wire("adopt", old=0, new=1, purged=True, pid=5),
+            _wire("emit", id=1, kind="token", rid="r", pid=5),
+            _kv("publish", digests=["aa"], rcs=[1]),
+        ]
+        log.write_text("".join(json.dumps(e) + "\n" for e in lines))
+        assert main_conform([str(log)]) == 0
+        out = capsys.readouterr().out
+        assert "accepted" in out and "wire=2" in out and "kv=1" in out
+
+    def test_conform_rejects_corrupt_log(self, tmp_path, capsys):
+        log = tmp_path / "bad.jsonl"
+        ev = _wire("deliver", src="client", rid="r", id=1, kind="token",
+                   epoch=1)
+        log.write_text(json.dumps(ev) + "\n" + json.dumps(ev) + "\n")
+        assert main_conform([str(log)]) == 1
+        assert "REJECTED" in capsys.readouterr().out
+
+    def test_linter_main_dispatches_conform(self, tmp_path, capsys):
+        from kubeflow_tpu.analysis.linter import main
+        log = tmp_path / "empty.jsonl"
+        log.write_text("")
+        assert main(["--conform", str(log)]) == 0
+        assert "no protocol events" in capsys.readouterr().out
